@@ -1,0 +1,65 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle ~v1.8 (fluid) — static Program IR + IR autodiff +
+whole-program XLA compilation, imperative mode, distributed training via
+jax.sharding meshes, AMP, checkpointing, data pipelines.
+
+Typical fluid-style use:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data("x", [784])
+    y = fluid.layers.fc(x, 10, act="softmax")
+    ...
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={...}, fetch_list=[...])
+"""
+from __future__ import annotations
+
+# op registrations (import for side effects)
+from . import ops  # noqa: F401
+
+from .framework.core import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    VarType,
+    XLAPlace,
+    convert_dtype,
+    get_flags,
+    is_compiled_with_tpu,
+    set_flags,
+)
+from .framework import initializer  # noqa: F401
+from .framework import unique_name  # noqa: F401
+from .framework.backward import append_backward, gradients  # noqa: F401
+from .framework.executor import Executor, Scope, global_scope  # noqa: F401
+from .framework.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .framework.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .framework.program import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+    switch_main_program,
+    switch_startup_program,
+)
+
+from . import clip  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metrics  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import profiler  # noqa: F401
+
+# fluid-style aliases
+CUDAPlace = XLAPlace  # reference scripts swap transparently
+data = layers.data
+
+__version__ = "0.1.0"

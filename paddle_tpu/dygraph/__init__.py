@@ -1,0 +1,18 @@
+"""Imperative (DyGraph) mode — parity with paddle/fluid/imperative/ +
+python/paddle/fluid/dygraph/. Eager execution on jax arrays with an autograd
+tape; see base.py / layers.py."""
+from .base import enabled, guard, grad, no_grad, to_variable, enable_dygraph, disable_dygraph  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .varbase import VarBase  # noqa: F401
+from .nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    Embedding,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
+from .jit import TracedLayer  # noqa: F401

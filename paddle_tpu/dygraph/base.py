@@ -1,0 +1,56 @@
+"""DyGraph mode switches — parity with fluid/dygraph/base.py
+(guard:247, to_variable:533, grad:314, enabled, no_grad)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from .varbase import VarBase, grad, no_grad_ctx
+
+_in_dygraph_mode = False
+
+
+def enabled() -> bool:
+    return _in_dygraph_mode
+
+
+in_dygraph_mode = enabled
+
+
+def enable_dygraph(place=None):
+    global _in_dygraph_mode
+    _in_dygraph_mode = True
+
+
+def disable_dygraph():
+    global _in_dygraph_mode
+    _in_dygraph_mode = False
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    global _in_dygraph_mode
+    saved = _in_dygraph_mode
+    _in_dygraph_mode = True
+    try:
+        yield
+    finally:
+        _in_dygraph_mode = saved
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)
+
+
+def no_grad(fn=None):
+    if fn is None:
+        return no_grad_ctx()
+
+    def wrapper(*args, **kwargs):
+        with no_grad_ctx():
+            return fn(*args, **kwargs)
+
+    return wrapper

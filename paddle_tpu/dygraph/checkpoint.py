@@ -1,0 +1,36 @@
+"""DyGraph checkpointing — parity with fluid/dygraph/checkpoint.py
+(save_dygraph:33, load_dygraph:98)."""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+from .varbase import VarBase
+
+
+def save_dygraph(state_dict, model_path: str):
+    payload = {}
+    opt_payload = {}
+    is_optimizer_state = any(not isinstance(v, VarBase) for v in state_dict.values())
+    for k, v in state_dict.items():
+        arr = np.asarray(v.value if isinstance(v, VarBase) else v)
+        payload[k] = arr
+    suffix = ".pdopt" if is_optimizer_state else ".pdparams"
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + suffix + ".npz", **payload)
+
+
+def load_dygraph(model_path: str):
+    params = None
+    opt = None
+    p_path = model_path + ".pdparams.npz"
+    o_path = model_path + ".pdopt.npz"
+    if os.path.exists(p_path):
+        data = np.load(p_path)
+        params = OrderedDict((k, data[k]) for k in data.files)
+    if os.path.exists(o_path):
+        data = np.load(o_path)
+        opt = OrderedDict((k, data[k]) for k in data.files)
+    return params, opt

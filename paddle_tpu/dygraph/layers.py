@@ -1,0 +1,170 @@
+"""dygraph.Layer — parity with fluid/dygraph/layers.py:60 (Layer):
+parameter registration, sublayers, state_dict, train/eval mode, hooks."""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.param_attr import ParamAttr
+from .varbase import VarBase
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or type(self).__name__.lower()
+        )
+        self._dtype = dtype
+        self.training = True
+        self._parameters: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+
+    def full_name(self):
+        return self._full_name
+
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+
+    # -- parameter management ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype="float32", is_bias=False,
+                         default_initializer=None):
+        import jax
+
+        attr = ParamAttr._to_attr(attr)
+        name = attr.name or unique_name.generate(
+            f"{self._full_name}.{'b' if is_bias else 'w'}"
+        )
+        init = attr.initializer or default_initializer
+        value = _materialize_init(init, shape, dtype, is_bias)
+        p = VarBase(value, name=name, persistable=True, trainable=attr.trainable)
+        p.stop_gradient = not attr.trainable
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        return tensor
+
+    def parameters(self, include_sublayers=True) -> List[VarBase]:
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, VarBase]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for lname, l in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{lname}" if prefix else lname
+            yield from l.named_parameters(sub_prefix)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            out.append(l)
+            out.extend(l.sublayers())
+        return out
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix=""):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self._parameters.items():
+            dest[structured_name_prefix + name] = p
+        for name, b in self._buffers.items():
+            dest[structured_name_prefix + name] = b
+        if include_sublayers:
+            for lname, l in self._sub_layers.items():
+                l.state_dict(dest, True, structured_name_prefix + lname + ".")
+        return dest
+
+    def set_dict(self, state_dict, include_sublayers=True, use_structured_name=True):
+        own = self.state_dict()
+        for key, var in own.items():
+            if key in state_dict:
+                val = state_dict[key]
+                var.set_value(val.value if isinstance(val, VarBase) else val)
+
+    load_dict = set_dict
+    set_state_dict = set_dict
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and value.persistable:
+            self.__dict__.setdefault("_parameters", OrderedDict())[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", OrderedDict())[name] = value
+        object.__setattr__(self, name, value)
+
+
+def _materialize_init(init, shape, dtype, is_bias):
+    """Evaluate a static-graph Initializer eagerly into a numpy array."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import initializer as I
+
+    shape = tuple(int(s) for s in shape)
+    key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    if init is None:
+        init = I.ConstantInitializer(0.0) if is_bias else I.XavierInitializer()
+    if isinstance(init, I.ConstantInitializer):
+        return jnp.full(shape, init.value, dtype=dtype)
+    if isinstance(init, I.UniformInitializer):
+        return jax.random.uniform(key, shape, minval=init.low, maxval=init.high).astype(dtype)
+    if isinstance(init, I.NormalInitializer):
+        return (init.loc + init.scale * jax.random.normal(key, shape)).astype(dtype)
+    if isinstance(init, I.TruncatedNormalInitializer):
+        return (init.loc + init.scale * jax.random.truncated_normal(key, -2, 2, shape)).astype(dtype)
+    if isinstance(init, I.XavierInitializer):
+        fi, fo = I._fan_in_out(_FakeVar(shape))
+        fi = init.fan_in or fi
+        fo = init.fan_out or fo
+        if init.uniform:
+            lim = math.sqrt(6.0 / (fi + fo))
+            return jax.random.uniform(key, shape, minval=-lim, maxval=lim).astype(dtype)
+        return (math.sqrt(2.0 / (fi + fo)) * jax.random.normal(key, shape)).astype(dtype)
+    if isinstance(init, I.MSRAInitializer):
+        fi, _ = I._fan_in_out(_FakeVar(shape))
+        fi = init.fan_in or fi
+        if init.uniform:
+            lim = math.sqrt(6.0 / fi)
+            return jax.random.uniform(key, shape, minval=-lim, maxval=lim).astype(dtype)
+        return (math.sqrt(2.0 / fi) * jax.random.normal(key, shape)).astype(dtype)
+    if isinstance(init, I.NumpyArrayInitializer):
+        return jnp.asarray(init.value).astype(dtype)
+    raise NotImplementedError(f"initializer {type(init).__name__} in dygraph")
+
+
+class _FakeVar:
+    def __init__(self, shape):
+        self.shape = shape

@@ -1,0 +1,281 @@
+"""DyGraph NN layers — parity with fluid/dygraph/nn.py (Conv2D, Pool2D, FC/
+Linear, BatchNorm, Embedding, LayerNorm, Dropout, ...). Forward math reuses the
+same lowering functions as the static-graph ops (ops/nn.py) via apply_op, so
+static and eager modes share kernels exactly like the reference (imperative
+PreparedOp runs the same OpKernels)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.initializer import ConstantInitializer, NormalInitializer
+from .layers import Layer
+from .varbase import VarBase, apply_op
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim],
+                                            attr=param_attr, dtype=dtype)
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter([output_dim], attr=bias_attr, dtype=dtype,
+                                       is_bias=True)
+        )
+
+    def forward(self, x):
+        def fn(xv, wv, *b):
+            out = jnp.matmul(xv, wv, preferred_element_type=jnp.float32).astype(xv.dtype)
+            if b:
+                out = out + b[0]
+            return _apply_act(out, self._act)
+
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+FC = Linear
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        fsize = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+        self._strides = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+        self._paddings = padding if isinstance(padding, (list, tuple)) else [padding] * 2
+        self._dilations = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 2
+        self._groups = groups or 1
+        fan_in = (num_channels // self._groups) * int(np.prod(fsize))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups] + list(fsize),
+            attr=param_attr, dtype=dtype,
+            default_initializer=NormalInitializer(0.0, float(np.sqrt(2.0 / fan_in))),
+        )
+        self.bias = (
+            None if bias_attr is False
+            else self.create_parameter([num_filters], attr=bias_attr, dtype=dtype,
+                                       is_bias=True)
+        )
+
+    def forward(self, x):
+        def fn(xv, wv, *b):
+            dn = lax.conv_dimension_numbers(xv.shape, wv.shape, ("NCHW", "OIHW", "NCHW"))
+            out = lax.conv_general_dilated(
+                xv, wv, window_strides=list(self._strides),
+                padding=[(p, p) for p in self._paddings],
+                rhs_dilation=list(self._dilations),
+                dimension_numbers=dn, feature_group_count=self._groups,
+            ).astype(xv.dtype)
+            if b:
+                out = out + b[0].reshape(1, -1, 1, 1)
+            return _apply_act(out, self._act)
+
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = dict(
+            pooling_type=pool_type,
+            ksize=pool_size if isinstance(pool_size, (list, tuple)) else [pool_size] * 2,
+            strides=pool_stride if isinstance(pool_stride, (list, tuple)) else [pool_stride] * 2,
+            paddings=pool_padding if isinstance(pool_padding, (list, tuple)) else [pool_padding] * 2,
+            global_pooling=global_pooling, ceil_mode=ceil_mode,
+            exclusive=exclusive,
+        )
+
+    def forward(self, x):
+        from ..ops.nn import pool2d as pool_lower
+
+        class _Op:
+            attrs = self._attrs
+
+            def attr(self, k, d=None):
+                return self.attrs.get(k, d)
+
+        def fn(xv):
+            return pool_lower(None, _Op(), {"X": [xv]})["Out"]
+
+        return apply_op(fn, x)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", use_global_stats=False,
+                 trainable_statistics=False):
+        super().__init__()
+        self._act = act
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._layout = data_layout
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self._mean = VarBase(jnp.zeros([num_channels], dtype), persistable=True,
+                             stop_gradient=True, trainable=False)
+        self._variance = VarBase(jnp.ones([num_channels], dtype), persistable=True,
+                                 stop_gradient=True, trainable=False)
+        self.register_buffer("_mean", self._mean)
+        self.register_buffer("_variance", self._variance)
+
+    def forward(self, x):
+        training = self.training and not self._use_global_stats
+        axes = (0,) + tuple(range(2, len(x.shape))) if self._layout == "NCHW" else tuple(range(len(x.shape) - 1))
+        shape = (1, -1) + (1,) * (len(x.shape) - 2) if self._layout == "NCHW" else (1,) * (len(x.shape) - 1) + (-1,)
+
+        if training:
+            mean = jnp.mean(x.value.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.value.astype(jnp.float32), axis=axes)
+            self._mean.value = (self._mean.value * self._momentum
+                                + mean * (1 - self._momentum))
+            self._variance.value = (self._variance.value * self._momentum
+                                    + var * (1 - self._momentum))
+        else:
+            mean, var = self._mean.value, self._variance.value
+
+        eps = self._epsilon
+        act = self._act
+
+        def fn(xv, sv, bv):
+            y = (xv.astype(jnp.float32) - mean.reshape(shape)) * lax.rsqrt(
+                var.reshape(shape).astype(jnp.float32) + eps)
+            y = y * sv.reshape(shape) + bv.reshape(shape)
+            return _apply_act(y.astype(xv.dtype), act)
+
+        return apply_op(fn, x, self.weight, self.bias)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        self._padding_idx = (
+            -1 if padding_idx is None
+            else padding_idx if padding_idx >= 0 else size[0] + padding_idx
+        )
+        self.weight = self.create_parameter(list(size), attr=param_attr, dtype=dtype,
+                                            default_initializer=NormalInitializer(0, 0.02))
+
+    def forward(self, ids):
+        pad = self._padding_idx
+
+        def fn(wv, idsv):
+            idx = idsv.astype(jnp.int32)
+            if idx.ndim > 1 and idx.shape[-1] == 1:
+                idx = jnp.squeeze(idx, -1)
+            out = jnp.take(wv, jnp.clip(idx, 0, wv.shape[0] - 1), axis=0)
+            if pad >= 0:
+                out = jnp.where((idx == pad)[..., None], 0.0, out)
+            return out
+
+        return apply_op(fn, self.weight, ids)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self._act = act
+        self.weight = (
+            self.create_parameter(self._shape, attr=param_attr, dtype=dtype,
+                                  default_initializer=ConstantInitializer(1.0))
+            if scale else None
+        )
+        self.bias = (
+            self.create_parameter(self._shape, attr=bias_attr, dtype=dtype,
+                                  is_bias=True)
+            if shift else None
+        )
+
+    def forward(self, x):
+        ndim = len(self._shape)
+        eps = self._epsilon
+        act = self._act
+
+        def fn(xv, *sb):
+            axes = tuple(range(xv.ndim - ndim, xv.ndim))
+            xf = xv.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes, keepdims=True)
+            var = jnp.var(xf, axis=axes, keepdims=True)
+            y = (xf - mean) * lax.rsqrt(var + eps)
+            i = 0
+            if self.weight is not None:
+                y = y * sb[i].astype(jnp.float32)
+                i += 1
+            if self.bias is not None:
+                y = y + sb[i].astype(jnp.float32)
+            return _apply_act(y.astype(xv.dtype), act)
+
+        args = (x,)
+        if self.weight is not None:
+            args += (self.weight,)
+        if self.bias is not None:
+            args += (self.bias,)
+        return apply_op(fn, *args)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=None, dropout_implementation="downgrade_in_infer",
+                 is_test=False):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+        self._key = jax.random.PRNGKey(seed if seed is not None else np.random.randint(2**31))
+
+    def forward(self, x):
+        if not self.training or self._p == 0.0:
+            if self._impl == "downgrade_in_infer":
+                return apply_op(lambda xv: xv * (1 - self._p), x) if False else x
+            return x
+        self._key, sub = jax.random.split(self._key)
+        p, impl = self._p, self._impl
+
+        def fn(xv):
+            keep = jax.random.bernoulli(sub, 1 - p, xv.shape)
+            if impl == "upscale_in_train":
+                return jnp.where(keep, xv / (1 - p), 0).astype(xv.dtype)
+            return jnp.where(keep, xv, 0).astype(xv.dtype)
+
+        return apply_op(fn, x)
+
+
+def _apply_act(x, act):
+    if act is None:
+        return x
+    if act == "relu":
+        return jax.nn.relu(x)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act == "tanh":
+        return jnp.tanh(x)
+    if act == "softmax":
+        return jax.nn.softmax(x, axis=-1)
+    if act == "gelu":
+        return jax.nn.gelu(x)
+    if act == "leaky_relu":
+        return jax.nn.leaky_relu(x)
+    if act == "swish":
+        return jax.nn.silu(x)
+    raise NotImplementedError(f"activation {act}")

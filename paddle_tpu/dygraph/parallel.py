@@ -1,0 +1,111 @@
+"""DyGraph data parallel — parity with fluid/dygraph/parallel.py
+(DataParallel:225 with scale_loss + apply_collective_grads over
+imperative/all_reduce.cc + NCCLParallelContext socket bootstrap,
+imperative/nccl_context.cc:29-80).
+
+TPU-native: ranks are jax processes (jax.distributed), collectives run via
+jax.pmap-style psum on gradient application; on a single host with one chip
+DataParallel degrades to a transparent wrapper (nranks==1), matching the
+reference behavior."""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .varbase import VarBase, apply_op
+
+
+class ParallelEnv:
+    """Env contract parity with ParallelEnv/prepare_context: reads the
+    PADDLE_* variables set by paddle.distributed.launch."""
+
+    def __init__(self):
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", str(jax.process_count())))
+        self._dev_id = int(os.getenv("FLAGS_selected_gpus", "0").split(",")[0] or 0)
+        self._trainer_endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """Bootstrap parity with prepare_context: initializes jax.distributed from
+    the PADDLE_* env (replaces raw-socket ncclUniqueId exchange)."""
+    env = ParallelEnv()
+    if env.nranks > 1 and jax.process_count() == 1:
+        coordinator = env.trainer_endpoints[0] if env.trainer_endpoints else None
+        if coordinator:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=env.nranks,
+                process_id=env.local_rank,
+            )
+    return env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy
+        self._nranks = ParallelEnv().nranks
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._nranks <= 1:
+            return loss
+        return apply_op(lambda l: l / self._nranks, loss)
+
+    def apply_collective_grads(self):
+        """Allreduce grads across processes. With jax.distributed multi-process
+        on TPU, per-process arrays are already globally addressable; here we
+        mean-reduce leaf grads via a tiny pmapped psum when nranks>1."""
+        if self._nranks <= 1:
+            return
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                p._grad = _cross_process_mean(p._grad)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_dict(self, *args, **kwargs):
+        return self._layers.set_dict(*args, **kwargs)
+
+
+def _cross_process_mean(x):
+    # single-host fallback: identity; multi-process uses psum over 'dp'
+    if jax.process_count() == 1:
+        return x
+    fn = jax.pmap(lambda v: jax.lax.psum(v, "i") / jax.device_count(), axis_name="i")
+    return fn(x[None])[0]

@@ -1,0 +1,245 @@
+"""VarBase + autograd tape: the imperative engine.
+
+Capability parity with paddle/fluid/imperative/ — `Tracer::TraceOp`
+(tracer.cc:45-90) records GradOpNodes per eager op; `BasicEngine::Execute`
+(basic_engine.cc:159) runs the reverse sweep with GradientAccumulator summing.
+Here eager ops run as jax computations (dispatched per-op, like the reference's
+eager kernel calls) and the tape records jax.vjp closures; backward() is the
+BasicEngine equivalent.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Tape:
+    def __init__(self):
+        self.entries: List[tuple] = []  # (outputs, inputs, vjp_fn)
+        self.enabled = True
+
+    def record(self, outputs, inputs, vjp_fn):
+        if self.enabled:
+            self.entries.append((outputs, inputs, vjp_fn))
+
+    def clear(self):
+        self.entries.clear()
+
+
+_tape = _Tape()
+
+
+def get_tape() -> _Tape:
+    return _tape
+
+
+class no_grad_ctx:
+    def __enter__(self):
+        self._saved = _tape.enabled
+        _tape.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tape.enabled = self._saved
+
+
+class VarBase:
+    """Eager tensor — parity with imperative::VarBase (imperative/layer.h)."""
+
+    def __init__(self, value, name: Optional[str] = None, stop_gradient: bool = False,
+                 persistable: bool = False, trainable: bool = True):
+        if isinstance(value, VarBase):
+            value = value.value
+        self.value = jnp.asarray(value)
+        from ..framework import unique_name
+
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self._grad: Optional[jnp.ndarray] = None
+
+    # -- info ---------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def gradient_value(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def gradient(self):
+        return self.gradient_value
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def detach(self) -> "VarBase":
+        return VarBase(self.value, stop_gradient=True)
+
+    def astype(self, dtype):
+        return apply_op(lambda x: x.astype(dtype), self)
+
+    def set_value(self, value):
+        if isinstance(value, VarBase):
+            value = value.value
+        self.value = jnp.asarray(value)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, retain_graph: bool = False):
+        run_backward([self], retain_graph=retain_graph)
+
+    # -- arithmetic ---------------------------------------------------------
+    def _bin(self, other, fn, reverse=False):
+        o = other.value if isinstance(other, VarBase) else other
+        a, b = (other, self) if reverse else (self, other)
+        return apply_op(fn, a, b)
+
+    def __add__(self, o):
+        return self._bin(o, jnp.add)
+
+    def __radd__(self, o):
+        return self._bin(o, jnp.add, True)
+
+    def __sub__(self, o):
+        return self._bin(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._bin(o, jnp.subtract, True)
+
+    def __mul__(self, o):
+        return self._bin(o, jnp.multiply)
+
+    def __rmul__(self, o):
+        return self._bin(o, jnp.multiply, True)
+
+    def __truediv__(self, o):
+        return self._bin(o, jnp.divide)
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __getitem__(self, idx):
+        return apply_op(lambda x: x[idx], self)
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape}, dtype={self.dtype})\n{self.value}"
+
+    def __len__(self):
+        return int(self.value.shape[0])
+
+
+def _unwrap(v):
+    return v.value if isinstance(v, VarBase) else v
+
+
+def apply_op(fn: Callable, *inputs, n_outs: int = 1, **kwargs):
+    """Run `fn` eagerly on VarBase/array inputs; record vjp on the tape.
+
+    Differentiable inputs are the VarBase args with stop_gradient=False and
+    floating dtype; everything else is closed over.
+    """
+    var_inputs = [(i, v) for i, v in enumerate(inputs) if isinstance(v, VarBase)]
+    diff = [
+        (i, v) for i, v in var_inputs
+        if not v.stop_gradient and jnp.issubdtype(v.value.dtype, jnp.floating)
+        and _tape.enabled
+    ]
+    vals = [_unwrap(v) for v in inputs]
+
+    if not diff:
+        out_vals = fn(*vals, **kwargs)
+        return _wrap_outputs(out_vals, stop_gradient=True)
+
+    diff_idx = [i for i, _ in diff]
+
+    def partial_fn(*diff_vals):
+        merged = list(vals)
+        for i, dv in zip(diff_idx, diff_vals):
+            merged[i] = dv
+        return fn(*merged, **kwargs)
+
+    out_vals, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
+    outs = _wrap_outputs(out_vals, stop_gradient=False)
+    out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+    _tape.record([o for o in out_list if isinstance(o, VarBase)],
+                 [v for _, v in diff], vjp_fn)
+    return outs
+
+
+def _wrap_outputs(out_vals, stop_gradient):
+    if isinstance(out_vals, (list, tuple)):
+        return type(out_vals)(
+            VarBase(v, stop_gradient=stop_gradient) if v is not None else None
+            for v in out_vals
+        )
+    return VarBase(out_vals, stop_gradient=stop_gradient)
+
+
+def run_backward(roots: Sequence[VarBase], retain_graph: bool = False):
+    """BasicEngine::Execute parity: reverse sweep, sum-accumulate grads."""
+    grads = {}
+    for r in roots:
+        grads[id(r)] = jnp.ones_like(r.value)
+    for outputs, inputs, vjp_fn in reversed(_tape.entries):
+        out_list = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        cotangents_single = []
+        any_grad = False
+        for o in out_list:
+            g = grads.get(id(o))
+            if g is None:
+                g = jnp.zeros_like(o.value)
+            else:
+                any_grad = True
+            cotangents_single.append(g)
+        if not any_grad:
+            continue
+        ct = cotangents_single[0] if len(cotangents_single) == 1 else tuple(cotangents_single)
+        in_grads = vjp_fn(ct)
+        for v, g in zip(inputs, in_grads):
+            if g is None:
+                continue
+            prev = grads.get(id(v))
+            grads[id(v)] = g if prev is None else prev + g
+            # leaf accumulation (params and user vars)
+            if v._grad is None:
+                v._grad = grads[id(v)]
+            else:
+                v._grad = v._grad + g
+    if not retain_graph:
+        _tape.clear()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad / fluid.dygraph.grad — parity with PartialGradEngine
+    (imperative/partial_grad_engine.cc)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = {id(v): v._grad for v in inputs}
+    for v in inputs:
+        v._grad = None
+    run_backward(list(outputs), retain_graph=bool(retain_graph))
+    results = []
+    for v in inputs:
+        g = v._grad
+        if g is None and not allow_unused:
+            g = jnp.zeros_like(v.value)
+        results.append(VarBase(g, stop_gradient=True) if g is not None else None)
+        v._grad = saved[id(v)]
+    return results

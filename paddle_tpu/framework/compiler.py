@@ -1,0 +1,98 @@
+"""CompiledProgram / BuildStrategy — parity with python/paddle/fluid/compiler.py
+(CompiledProgram:87, with_data_parallel:160) and framework/details/
+build_strategy.h:58-141.
+
+The reference's with_data_parallel builds a multi-GPU SSA graph executed by
+ParallelExecutor with NCCL allreduce op-handles. Here the SAME API instead
+annotates the program for mesh execution: the Executor shards the batch over a
+data-parallel jax.sharding.Mesh axis and XLA inserts the gradient allreduce —
+ParallelExecutor, op handles and NCCL rings have no equivalent code because
+GSPMD subsumes them (SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class BuildStrategy:
+    """Knob parity with details/build_strategy.h. Most knobs are XLA-owned;
+    they are accepted and recorded so reference scripts run unmodified."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    reduce_strategy: int = 0
+    gradient_scale_strategy: int = 0
+    debug_graphviz_path: str = ""
+    enable_sequential_execution: bool = False
+    fuse_elewise_add_act_ops: bool = False  # XLA fuses anyway
+    fuse_bn_act_ops: bool = False
+    fuse_relu_depthwise_conv: bool = False
+    fuse_broadcast_ops: bool = False
+    fuse_all_optimizer_ops: bool = False
+    fuse_all_reduce_ops: bool = False
+    enable_inplace: bool = True  # donation ≙ inplace
+    memory_optimize: bool = True
+    sync_batch_norm: bool = False
+    num_trainers: int = 1
+    trainer_id: int = 0
+    nccl_comm_num: int = 1
+    use_hierarchical_allreduce: bool = False
+    hierarchical_allreduce_inter_nranks: int = 0
+
+
+@dataclasses.dataclass
+class ExecutionStrategy:
+    num_threads: int = 0
+    num_iteration_per_drop_scope: int = 100
+    num_iteration_per_run: int = 1
+    use_thread_barrier: bool = False
+
+
+class CompiledProgram:
+    """Wraps a Program with execution annotations. `with_data_parallel`
+    switches the Executor into mesh (pjit) mode over all local devices."""
+
+    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
+        self.program = program_or_graph
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = ExecutionStrategy()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._share_vars_from = None
+        self._places = None
+        # ring_id -> mesh axis name (collective ops lower over these)
+        self._mesh_axes = {}
+        self._data_parallel_axis = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        if exec_strategy is not None:
+            self.exec_strategy = exec_strategy
+        self._share_vars_from = share_vars_from
+        self._places = places
+        self._data_parallel_axis = "dp"
+        self._mesh_axes = {0: "dp"}
+        self.program._annotations["data_parallel"] = True
+        return self
+
+    @property
+    def num_devices(self):
+        if self._places is not None:
+            return len(self._places)
+        return jax.local_device_count()

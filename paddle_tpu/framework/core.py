@@ -1,0 +1,157 @@
+"""Core type system for the TPU-native framework.
+
+Capability parity with the reference's ``paddle/fluid/framework/framework.proto``
+(VarType enum at framework.proto:104-137) and ``platform/place.h`` — but instead
+of an enum dispatched to per-device CUDA kernels, dtypes map straight to JAX
+dtypes and Places map to JAX device sets.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class VarType(enum.IntEnum):
+    """Variable kinds — mirrors framework.proto:104-137 VarType.Type."""
+
+    # value types (tensor dtypes)
+    BOOL = 0
+    INT16 = 1
+    INT32 = 2
+    INT64 = 3
+    FP16 = 4
+    FP32 = 5
+    FP64 = 6
+    UINT8 = 20
+    INT8 = 21
+    BF16 = 22
+    # container / structural types
+    LOD_TENSOR = 7
+    SELECTED_ROWS = 8
+    FEED_MINIBATCH = 9
+    FETCH_LIST = 10
+    STEP_SCOPES = 11
+    LOD_RANK_TABLE = 12
+    LOD_TENSOR_ARRAY = 13
+    PLACE_LIST = 14
+    READER = 15
+    RAW = 17
+    TUPLE = 18
+
+
+_DTYPE_TO_VARTYPE = {
+    "bool": VarType.BOOL,
+    "int16": VarType.INT16,
+    "int32": VarType.INT32,
+    "int64": VarType.INT64,
+    "float16": VarType.FP16,
+    "float32": VarType.FP32,
+    "float64": VarType.FP64,
+    "uint8": VarType.UINT8,
+    "int8": VarType.INT8,
+    "bfloat16": VarType.BF16,
+}
+_VARTYPE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_VARTYPE.items()}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize any dtype spec (str / np / jnp / VarType) to a canonical string."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, VarType):
+        return _VARTYPE_TO_DTYPE[dtype]
+    if isinstance(dtype, str):
+        if dtype in _DTYPE_TO_VARTYPE:
+            return dtype
+        return np.dtype(dtype).name
+    if dtype in (jnp.bfloat16,):
+        return "bfloat16"
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    return name
+
+
+def dtype_to_jax(dtype) -> jnp.dtype:
+    s = convert_dtype(dtype)
+    if s == "bfloat16":
+        return jnp.bfloat16
+    return jnp.dtype(s)
+
+
+def dtype_is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "float32", "float64", "bfloat16")
+
+
+# ---------------------------------------------------------------------------
+# Places — reference platform/place.h. On the TPU build a Place names a JAX
+# backend; `XLAPlace` is the canonical accelerator place.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Place:
+    backend: str = "default"
+    device_id: int = 0
+
+    def jax_device(self):
+        if self.backend == "default":
+            return jax.devices()[self.device_id]
+        return jax.devices(self.backend)[self.device_id]
+
+    def __repr__(self):  # pragma: no cover
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__(backend="cpu", device_id=device_id)
+
+
+class XLAPlace(Place):
+    """The accelerator place: whatever JAX's default backend exposes (TPU)."""
+
+    def __init__(self, device_id: int = 0):
+        super().__init__(backend="default", device_id=device_id)
+
+
+# Alias so reference scripts that say CUDAPlace keep working on TPU.
+TPUPlace = XLAPlace
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform not in ("cpu",) for d in jax.devices())
+
+
+# ---------------------------------------------------------------------------
+# Global flags registry — reference platform/flags.cc (gflags). Most reference
+# flags control allocator/cudnn behavior that XLA owns; we keep the registry so
+# `fluid.set_flags`/`get_flags` style code works and a few flags are live.
+# ---------------------------------------------------------------------------
+
+_GLOBAL_FLAGS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_allocator_strategy": "xla_managed",
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_use_system_allocator": False,
+    "FLAGS_executor_log_deps": False,
+}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _GLOBAL_FLAGS[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _GLOBAL_FLAGS.get(k) for k in flags}
+
+
+def get_flag(name, default=None):
+    return _GLOBAL_FLAGS.get(name, default)

@@ -1,0 +1,541 @@
+"""Static-graph Program IR.
+
+Capability parity with the reference's Python IR mirror
+(python/paddle/fluid/framework.py: Program:3852, Block:2391, Operator:1822,
+Variable:835, Parameter:4962) over the C++ desc layer
+(paddle/fluid/framework/framework.proto). Here the IR is Python-native and
+JSON-serializable; execution compiles whole Blocks to XLA (see executor.py)
+instead of interpreting per-op kernels.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import unique_name
+from .core import VarType, convert_dtype
+
+GRAD_SUFFIX = "@GRAD"
+
+
+class Variable:
+    """A named tensor slot in a Block — reference framework.py:835.
+
+    ``shape`` may contain -1 (unknown / batch dims); actual shapes are fixed at
+    Executor compile time from feed shapes, since XLA requires static shapes.
+    """
+
+    def __init__(
+        self,
+        block: "Block",
+        name: Optional[str] = None,
+        shape=None,
+        dtype="float32",
+        type: VarType = VarType.LOD_TENSOR,
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        is_data: bool = False,
+        need_check_feed: bool = False,
+        initializer=None,
+        **kwargs,
+    ):
+        self.block = block
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else ()
+        self.dtype = convert_dtype(dtype)
+        self.type = type
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.need_check_feed = need_check_feed
+        # Optional initializer record (consumed when building startup programs).
+        self.initializer = initializer
+        # op that produced it last (filled lazily when needed)
+
+    # -- info helpers -------------------------------------------------------
+    @property
+    def grad_name(self) -> str:
+        return self.name + GRAD_SUFFIX
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def astype(self, dtype):
+        from ..layers import tensor as tensor_layers
+
+        return tensor_layers.cast(self, dtype)
+
+    def _desc_dict(self):
+        return {
+            "name": self.name,
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "type": int(self.type),
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "is_data": self.is_data,
+        }
+
+    def __repr__(self):
+        return (
+            f"Var({self.name}: shape={list(self.shape)}, dtype={self.dtype}, "
+            f"{'persistable, ' if self.persistable else ''}"
+            f"stop_gradient={self.stop_gradient})"
+        )
+
+    # Operator sugar so `a + b` works in static graph mode (reference patches
+    # these via monkey-patching in math_op_patch.py).
+    def _binary(self, other, fn_name, reverse=False):
+        from ..layers import math_op_patch
+
+        return math_op_patch.binary_op(self, other, fn_name, reverse)
+
+    def __add__(self, other):
+        return self._binary(other, "elementwise_add")
+
+    def __radd__(self, other):
+        return self._binary(other, "elementwise_add", reverse=True)
+
+    def __sub__(self, other):
+        return self._binary(other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        return self._binary(other, "elementwise_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binary(other, "elementwise_mul")
+
+    def __rmul__(self, other):
+        return self._binary(other, "elementwise_mul", reverse=True)
+
+    def __truediv__(self, other):
+        return self._binary(other, "elementwise_div")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, "elementwise_div", reverse=True)
+
+    def __pow__(self, other):
+        return self._binary(other, "elementwise_pow")
+
+    def __neg__(self):
+        from ..layers import tensor as tensor_layers
+
+        return tensor_layers.scale(self, scale=-1.0)
+
+    def __eq__(self, other):
+        return self is other
+
+    def __hash__(self):
+        return id(self)
+
+
+class Parameter(Variable):
+    """Trainable persistable variable — reference framework.py:4962."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        self.trainable = kwargs.pop("trainable", True)
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.do_model_average = kwargs.pop("do_model_average", None)
+        self.is_distributed = kwargs.pop("is_distributed", False)
+        kwargs["persistable"] = True
+        super().__init__(block, shape=shape, dtype=dtype, **kwargs)
+        self.stop_gradient = not self.trainable
+
+    def __repr__(self):
+        return f"Param({self.name}: shape={list(self.shape)}, dtype={self.dtype})"
+
+
+class Operator:
+    """One op node — reference framework.py:1822 / framework.proto OpDesc.
+
+    inputs/outputs map slot name -> list of variable names; attrs are plain
+    python values (scalars, lists, strings, or int block indices for control
+    flow sub-blocks).
+    """
+
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.block = block
+        self.type = type
+        self.inputs: Dict[str, List[str]] = _normalize_io(inputs)
+        self.outputs: Dict[str, List[str]] = _normalize_io(outputs)
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+
+    # -- accessors ----------------------------------------------------------
+    def input(self, slot) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self) -> List[str]:
+        return [n for names in self.inputs.values() for n in names]
+
+    @property
+    def output_arg_names(self) -> List[str]:
+        return [n for names in self.outputs.values() for n in names]
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def _set_attr(self, name, val):
+        self.attrs[name] = val
+        self.block.program._bump_version()
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def _desc_dict(self):
+        return {
+            "type": self.type,
+            "inputs": {k: list(v) for k, v in self.inputs.items()},
+            "outputs": {k: list(v) for k, v in self.outputs.items()},
+            "attrs": copy.deepcopy(self.attrs),
+        }
+
+    def __repr__(self):
+        ins = ", ".join(f"{k}={v}" for k, v in self.inputs.items())
+        outs = ", ".join(f"{k}={v}" for k, v in self.outputs.items())
+        return f"{{{self.type}: ({ins}) -> ({outs})}}"
+
+
+def _normalize_io(io: Optional[Dict[str, Any]]) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = OrderedDict()
+    if not io:
+        return out
+    for slot, vals in io.items():
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        names = []
+        for v in vals:
+            if isinstance(v, Variable):
+                names.append(v.name)
+            elif isinstance(v, str):
+                names.append(v)
+            else:
+                raise TypeError(f"bad i/o entry for slot {slot}: {v!r}")
+        out[slot] = names
+    return out
+
+
+class Block:
+    """A straight-line list of ops + a var table — reference framework.py:2391."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        # sub-block chaining for backward (grad block of a forward sub-block)
+        self.forward_block_idx = -1
+        self.vars: "OrderedDict[str, Variable]" = OrderedDict()
+        self.ops: List[Operator] = []
+
+    @property
+    def parent_block(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    # -- var management -----------------------------------------------------
+    def create_var(self, **kwargs) -> Variable:
+        name = kwargs.get("name")
+        if name is not None and name in self.vars:
+            return self.vars[name]
+        var = Variable(self, **kwargs)
+        self.vars[var.name] = var
+        return var
+
+    def create_parameter(self, **kwargs) -> Parameter:
+        shape = kwargs.pop("shape")
+        dtype = kwargs.pop("dtype", "float32")
+        global_block = self.program.global_block()
+        param = Parameter(global_block, shape=shape, dtype=dtype, **kwargs)
+        global_block.vars[param.name] = param
+        return param
+
+    def var(self, name: str) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            raise ValueError(f"Variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars
+
+    def _var_recursive(self, name: str) -> Variable:
+        blk: Optional[Block] = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        raise ValueError(f"Variable {name!r} not found in block hierarchy")
+
+    def _has_var_recursive(self, name: str) -> bool:
+        blk: Optional[Block] = self
+        while blk is not None:
+            if name in blk.vars:
+                return True
+            blk = blk.parent_block
+        return False
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- op management ------------------------------------------------------
+    def append_op(self, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.append(op)
+        self.program._bump_version()
+        self._infer_shape(op)
+        return op
+
+    def _insert_op(self, index, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.insert(index, op)
+        self.program._bump_version()
+        self._infer_shape(op)
+        return op
+
+    def _prepend_op(self, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        return self._insert_op(0, type, inputs=inputs, outputs=outputs, attrs=attrs)
+
+    def _remove_op(self, index):
+        del self.ops[index]
+        self.program._bump_version()
+
+    def _infer_shape(self, op: Operator):
+        from .registry import infer_shape_for_op
+
+        infer_shape_for_op(self, op)
+
+    def _desc_dict(self):
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "forward_block_idx": self.forward_block_idx,
+            "vars": [v._desc_dict() for v in self.vars.values()],
+            "params": [v.name for v in self.vars.values() if isinstance(v, Parameter)],
+            "ops": [op._desc_dict() for op in self.ops],
+        }
+
+    def __repr__(self):
+        lines = [f"Block {self.idx} (parent {self.parent_idx}):"]
+        lines += [f"  {op}" for op in self.ops]
+        return "\n".join(lines)
+
+
+class Program:
+    """A whole program: a tree of Blocks — reference framework.py:3852."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._seed_counter = 0
+        # list of (feed_name,) / fetch info filled by io helpers
+        self._is_start_up_program = False
+        self._pass_applied = []
+        # distributed annotations (filled by fleet/transpilers)
+        self._annotations: Dict[str, Any] = {}
+
+    # -- block management ---------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def block(self, idx: int) -> Block:
+        return self.blocks[idx]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        new_idx = len(self.blocks)
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        blk = Block(self, new_idx, parent_idx=parent)
+        self.blocks.append(blk)
+        self.current_block_idx = new_idx
+        return blk
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    # -- parameters ---------------------------------------------------------
+    def all_parameters(self) -> List[Parameter]:
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    # -- cloning ------------------------------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program.__new__(Program)
+        p.blocks = []
+        p.current_block_idx = 0
+        p.random_seed = self.random_seed
+        p._seed_counter = self._seed_counter
+        p._is_start_up_program = self._is_start_up_program
+        p._pass_applied = list(self._pass_applied)
+        p._annotations = copy.deepcopy(self._annotations)
+        for blk in self.blocks:
+            nb = Block(p, blk.idx, blk.parent_idx)
+            nb.forward_block_idx = blk.forward_block_idx
+            p.blocks.append(nb)
+        for blk, nb in zip(self.blocks, p.blocks):
+            for v in blk.vars.values():
+                if isinstance(v, Parameter):
+                    nv = Parameter(
+                        nb,
+                        shape=v.shape,
+                        dtype=v.dtype,
+                        name=v.name,
+                        trainable=v.trainable,
+                        optimize_attr=copy.deepcopy(v.optimize_attr),
+                        regularizer=v.regularizer,
+                        is_distributed=v.is_distributed,
+                    )
+                    nv.stop_gradient = v.stop_gradient
+                else:
+                    nv = Variable(
+                        nb,
+                        name=v.name,
+                        shape=v.shape,
+                        dtype=v.dtype,
+                        type=v.type,
+                        persistable=v.persistable,
+                        stop_gradient=v.stop_gradient,
+                        is_data=v.is_data,
+                    )
+                nb.vars[nv.name] = nv
+            for op in blk.ops:
+                if for_test and op.attr("is_test_skip", False):
+                    continue
+                nop = Operator(
+                    nb,
+                    type=op.type,
+                    inputs={k: list(v) for k, v in op.inputs.items()},
+                    outputs={k: list(v) for k, v in op.outputs.items()},
+                    attrs=copy.deepcopy(op.attrs),
+                )
+                if for_test and "is_test" in _TEST_MODE_ATTR_OPS.get(op.type, ()):
+                    nop.attrs["is_test"] = True
+                nb.ops.append(nop)
+        return p
+
+    def _bump_version(self):
+        self._mutation_counter = getattr(self, "_mutation_counter", 0) + 1
+
+    def _version_token(self):
+        """Cheap mutation token for executor compile caching: counts every
+        append/insert/remove/attr-set (the executor also holds a strong ref to
+        the program, so id() cannot be reused while an entry is cached)."""
+        return (
+            getattr(self, "_mutation_counter", 0),
+            tuple((len(b.ops), len(b.vars)) for b in self.blocks),
+        )
+
+    def _fingerprint(self) -> str:
+        """Stable hash of the full desc for executor compile caching."""
+        import hashlib
+        import json
+
+        payload = json.dumps(
+            [b._desc_dict() for b in self.blocks], sort_keys=True, default=str
+        )
+        return hashlib.sha1(payload.encode()).hexdigest()
+
+    def _desc_dict(self):
+        return {
+            "version": 1,
+            "random_seed": self.random_seed,
+            "blocks": [b._desc_dict() for b in self.blocks],
+        }
+
+    def __repr__(self):
+        return "\n".join(repr(b) for b in self.blocks)
+
+
+# ops whose behavior flips in test mode (dropout/batch_norm) — used by clone(for_test)
+_TEST_MODE_ATTR_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+    "sync_batch_norm": ("is_test",),
+}
+
+
+# ---------------------------------------------------------------------------
+# Default program stack — reference framework.py:5163-5330
+# ---------------------------------------------------------------------------
+
+_main_program_ = Program()
+_startup_program_ = Program()
+_startup_program_._is_start_up_program = True
+
+
+def default_main_program() -> Program:
+    return _main_program_
+
+
+def default_startup_program() -> Program:
+    return _startup_program_
+
+
+def switch_main_program(program: Program) -> Program:
+    global _main_program_
+    old = _main_program_
+    _main_program_ = program
+    return old
+
+
+def switch_startup_program(program: Program) -> Program:
+    global _startup_program_
+    old = _startup_program_
+    _startup_program_ = program
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)
+
+
+_name_scope_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    _name_scope_stack.append(prefix)
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()

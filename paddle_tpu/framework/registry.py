@@ -1,0 +1,352 @@
+"""Op registry: declarative op specs with JAX lowerings.
+
+Replaces the reference's static kernel registry
+(paddle/fluid/framework/op_registry.h:223-291 REGISTER_OPERATOR /
+REGISTER_OP_*_KERNEL + op_info.h OpInfoMap). Instead of per-(place,dtype,layout)
+kernels, each op registers ONE lowering function Block-op -> jax computation;
+XLA specializes for device/dtype. Grad ops are first-class IR ops (parity with
+GradOpDescMakerBase, grad_op_desc_maker.h); by default the grad lowering is the
+jax.vjp of the forward lowering — whole-program XLA CSE removes the replayed
+forward, so this costs nothing after fusion.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import dtype_to_jax, dtype_is_floating
+
+GRAD_SUFFIX = "@GRAD"
+
+# ---------------------------------------------------------------------------
+# Spec + registry
+# ---------------------------------------------------------------------------
+
+LowerFn = Callable[["LowerCtx", "Operator", Dict[str, List[Any]]], Dict[str, List[Any]]]
+
+
+@dataclasses.dataclass
+class OpSpec:
+    type: str
+    lower: LowerFn
+    # shape inference for build-time metadata; None -> eval_shape fallback
+    infer_shape: Optional[Callable] = None
+    # 'auto' = default vjp-backed grad op; None = non-differentiable;
+    # callable(op, block, grad_map) -> list[Operator-descs] = custom maker
+    grad: Any = "auto"
+    # slots eligible for gradients (None = every floating-point input slot)
+    diff_inputs: Optional[Sequence[str]] = None
+    # slots whose inputs are NOT needed by the default grad lowering replay
+    needs_rng: bool = False
+    # op mutates persistable state (optimizer ops) — affects executor outputs
+    is_optimizer: bool = False
+
+
+_OPS: Dict[str, OpSpec] = {}
+
+
+def register_op(type: str, **kwargs):
+    """Decorator: @register_op("relu") def _(ctx, op, ins): ..."""
+
+    def deco(fn: LowerFn):
+        _OPS[type] = OpSpec(type=type, lower=fn, **kwargs)
+        return fn
+
+    return deco
+
+
+def get_op_spec(type: str) -> OpSpec:
+    spec = _OPS.get(type)
+    if spec is None:
+        if type.endswith("_grad") and type[: -len("_grad")] in _OPS:
+            return _generic_grad_spec(type)
+        raise NotImplementedError(f"op {type!r} has no registered lowering")
+    return spec
+
+
+def has_op(type: str) -> bool:
+    return type in _OPS or (type.endswith("_grad") and type[: -len("_grad")] in _OPS)
+
+
+def all_op_types() -> List[str]:
+    return sorted(_OPS)
+
+
+# ---------------------------------------------------------------------------
+# Lowering context
+# ---------------------------------------------------------------------------
+
+
+class LowerCtx:
+    """Carried through a Block lowering: the value environment and ambient state.
+
+    env maps var name -> jax value. Mesh/axis info is used by collective ops
+    (c_allreduce_* etc.) which lower to lax.p* over named mesh axes — the
+    TPU-native replacement for NCCL ring_ids (platform/collective_helper.h).
+    """
+
+    def __init__(self, program, block, env, rng_key=None, mesh_axes=None, is_test=False):
+        self.program = program
+        self.block = block
+        self.env: Dict[str, Any] = env
+        self._rng_key = rng_key
+        self._rng_counter = 0
+        # ring_id -> mesh axis name mapping for collectives
+        self.mesh_axes: Dict[int, str] = mesh_axes or {}
+        self.is_test = is_test
+
+    def next_rng(self, salt: int = 0):
+        if self._rng_key is None:
+            # deterministic fallback (e.g. shape inference)
+            self._rng_key = jax.random.PRNGKey(0)
+        key = jax.random.fold_in(self._rng_key, self._rng_counter * 1000003 + salt)
+        self._rng_counter += 1
+        return key
+
+    def rng_for(self, op):
+        """Deterministic key derived from the op's first output name.
+
+        Grad-op vjp replay of a random forward op re-derives the SAME key (the
+        fake forward op carries the original output names), so the replayed
+        randomness is bit-identical and XLA CSE merges it with the forward.
+        """
+        import zlib
+
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(0)
+        names = [n for ns in op.outputs.values() for n in ns]
+        salt = zlib.crc32(("|".join(sorted(names))).encode()) & 0x7FFFFFFF
+        return jax.random.fold_in(self._rng_key, salt)
+
+    def axis_name(self, ring_id: int) -> Optional[str]:
+        return self.mesh_axes.get(ring_id)
+
+
+def run_lowering(ctx: LowerCtx, op) -> None:
+    """Execute one op's lowering against ctx.env (in place)."""
+    spec = get_op_spec(op.type)
+    ins = {
+        slot: [ctx.env[n] for n in names]
+        for slot, names in op.inputs.items()
+        if all(n in ctx.env for n in names)
+    }
+    outs = spec.lower(ctx, op, ins)
+    _bind_outputs(ctx.env, op, outs)
+
+
+def _bind_outputs(env, op, outs: Dict[str, Any]):
+    for slot, vals in outs.items():
+        names = op.outputs.get(slot, [])
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for name, val in zip(names, vals):
+            if val is not None and name != "@EMPTY@":
+                env[name] = val
+
+
+# ---------------------------------------------------------------------------
+# Generic vjp-backed grad op
+# ---------------------------------------------------------------------------
+
+
+def _generic_grad_spec(grad_type: str) -> OpSpec:
+    fwd_type = grad_type[: -len("_grad")]
+    fwd_spec = _OPS[fwd_type]
+
+    def lower_grad(ctx: LowerCtx, op, ins):
+        return lower_vjp_grad(ctx, op, ins, fwd_spec)
+
+    return OpSpec(type=grad_type, lower=lower_grad, grad=None)
+
+
+class _FakeOp:
+    """Light op stand-in so a grad lowering can replay the forward lowering."""
+
+    __slots__ = ("type", "inputs", "outputs", "attrs", "block")
+
+    def __init__(self, type, inputs, outputs, attrs, block):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+        self.block = block
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+
+def lower_vjp_grad(ctx: LowerCtx, op, ins, fwd_spec: OpSpec):
+    """Default grad lowering: jax.vjp of the forward lowering.
+
+    The grad op (built by the default grad maker in backward.py) carries the
+    forward op's desc in attrs['__fwd__']: {type, inputs, outputs, attrs}.
+    Its inputs hold the forward inputs under their original slots plus the
+    output grads under '<slot>@GRAD'; outputs are '<slot>@GRAD' per fwd input.
+    """
+    fwd = op.attrs["__fwd__"]
+    fwd_inputs: Dict[str, List[str]] = fwd["inputs"]
+    fwd_outputs: Dict[str, List[str]] = fwd["outputs"]
+
+    fake = _FakeOp(fwd["type"], fwd_inputs, fwd_outputs, dict(fwd["attrs"]), ctx.block)
+
+    # Which input slots are differentiable?
+    if fwd_spec.diff_inputs is not None:
+        diff_slots = [s for s in fwd_spec.diff_inputs if s in fwd_inputs]
+    else:
+        diff_slots = []
+        for slot, names in fwd_inputs.items():
+            vals = [ctx.env[n] for n in names if n in ctx.env]
+            if vals and all(jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) for v in vals):
+                diff_slots.append(slot)
+    # only produce grads the op actually asks for
+    diff_slots = [s for s in diff_slots if (s + GRAD_SUFFIX) in op.outputs]
+
+    const_ins = {
+        slot: [ctx.env[n] for n in names]
+        for slot, names in fwd_inputs.items()
+        if slot not in diff_slots and all(n in ctx.env for n in names)
+    }
+    diff_ins = {slot: [ctx.env[n] for n in fwd_inputs[slot]] for slot in diff_slots}
+
+    # Deterministic rng replay: reuse the forward op's rng salt so XLA CSE can
+    # dedupe the recomputed forward against the original forward computation.
+    salt = fwd["attrs"].get("__rng_salt__", 0)
+    saved_counter = ctx._rng_counter
+
+    def fwd_fn(d_ins):
+        ctx._rng_counter = saved_counter  # stable keys across vjp traces
+        merged = dict(const_ins)
+        merged.update(d_ins)
+        outs = fwd_spec.lower(ctx, fake, merged)
+        flat = []
+        for oslot in sorted(fwd_outputs):
+            names = fwd_outputs[oslot]
+            vals = outs.get(oslot)
+            if vals is None:
+                vals = [None] * len(names)
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for v in vals:
+                flat.append(v)
+        return flat
+
+    primal_flat, vjp_fn = jax.vjp(fwd_fn, diff_ins)
+
+    # Assemble cotangents for every forward output, zeros where no grad flows.
+    cotangents = []
+    i = 0
+    for oslot in sorted(fwd_outputs):
+        for name in fwd_outputs[oslot]:
+            gname = None
+            # grad op convention: out-grad input slot is '<oslot>@GRAD'
+            gslot = oslot + GRAD_SUFFIX
+            if gslot in op.inputs:
+                idx = fwd_outputs[oslot].index(name)
+                if idx < len(op.inputs[gslot]):
+                    gname = op.inputs[gslot][idx]
+            if gname is not None and gname in ctx.env:
+                g = ctx.env[gname]
+            else:
+                p = primal_flat[i]
+                g = jnp.zeros_like(p) if p is not None else None
+            cotangents.append(g)
+            i += 1
+
+    # jax.vjp requires non-None cotangents matching primal structure
+    cotangents = [
+        jnp.zeros_like(p) if (g is None and p is not None) else g
+        for g, p in zip(cotangents, primal_flat)
+    ]
+    (grads,) = vjp_fn(cotangents)
+
+    out: Dict[str, Any] = {}
+    for slot in diff_slots:
+        out[slot + GRAD_SUFFIX] = grads[slot]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Build-time shape inference
+# ---------------------------------------------------------------------------
+
+_DYN = 97  # stand-in extent for -1 dims during eval_shape (prime, unlikely real)
+
+
+def infer_shape_for_op(block, op) -> None:
+    """Fill output Variable shapes/dtypes at graph-build time.
+
+    Uses the op's registered infer_shape if present, else jax.eval_shape over
+    the lowering with -1 dims substituted; -1 is restored on dims that come
+    back as the stand-in extent. This is metadata only — executor compilation
+    re-traces with real feed shapes.
+    """
+    try:
+        spec = get_op_spec(op.type)
+    except NotImplementedError:
+        return
+    if spec.infer_shape is not None:
+        spec.infer_shape(block, op)
+        return
+    if op.type.endswith("_grad"):
+        _infer_grad_shapes(block, op)
+        return
+
+    try:
+        slots, flat = [], []
+        for slot, names in op.inputs.items():
+            for n in names:
+                v = block._var_recursive(n)
+                shape = tuple(_DYN if d == -1 else d for d in v.shape)
+                slots.append(slot)
+                flat.append(jax.ShapeDtypeStruct(shape, dtype_to_jax(v.dtype)))
+
+        def f(*args):
+            ins: Dict[str, List[Any]] = {}
+            for slot, val in zip(slots, args):
+                ins.setdefault(slot, []).append(val)
+            ctx = LowerCtx(block.program, block, {})
+            return spec.lower(ctx, op, ins)
+
+        outs = jax.eval_shape(f, *flat)
+    except Exception:
+        return  # metadata-only; executor will still compile with real shapes
+
+    for slot, vals in outs.items():
+        names = op.outputs.get(slot, [])
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for name, val in zip(names, vals):
+            if val is None or not block._has_var_recursive(name):
+                continue
+            var = block._var_recursive(name)
+            var.shape = tuple(-1 if d == _DYN else int(d) for d in val.shape)
+            var.dtype = jnp.dtype(val.dtype).name if val.dtype != jnp.bfloat16 else "bfloat16"
+
+
+def _infer_grad_shapes(block, op):
+    """Grad of x has x's shape/dtype."""
+    for slot, names in op.outputs.items():
+        if not slot.endswith(GRAD_SUFFIX):
+            continue
+        src_slot = slot[: -len(GRAD_SUFFIX)]
+        src_names = op.inputs.get(src_slot, [])
+        for gname, sname in zip(names, src_names):
+            if block._has_var_recursive(gname) and block._has_var_recursive(sname):
+                gvar = block._var_recursive(gname)
+                svar = block._var_recursive(sname)
+                gvar.shape = tuple(svar.shape)
+                gvar.dtype = svar.dtype

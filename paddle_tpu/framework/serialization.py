@@ -1,0 +1,64 @@
+"""Program desc (de)serialization.
+
+Capability parity with the reference's protobuf ProgramDesc persistence
+(framework/framework.proto + program_desc.cc): the full IR round-trips through
+a JSON-able dict so save_inference_model / fluid.io.save artifacts are
+self-contained. (The reference uses protobuf binary; the format here is JSON —
+same information content, versioned.)
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from .core import VarType
+from .program import Block, Operator, Parameter, Program, Variable
+
+
+def program_to_desc(program: Program) -> Dict:
+    return program._desc_dict()
+
+
+def program_from_desc(desc: Dict) -> Program:
+    program = Program.__new__(Program)
+    program.blocks = []
+    program.current_block_idx = 0
+    program.random_seed = desc.get("random_seed", 0)
+    program._seed_counter = 0
+    program._is_start_up_program = False
+    program._pass_applied = []
+    program._annotations = {}
+    for bdesc in desc["blocks"]:
+        blk = Block(program, bdesc["idx"], bdesc.get("parent_idx", -1))
+        blk.forward_block_idx = bdesc.get("forward_block_idx", -1)
+        program.blocks.append(blk)
+    for bdesc, blk in zip(desc["blocks"], program.blocks):
+        params = set(bdesc.get("params", []))
+        for vdesc in bdesc["vars"]:
+            if vdesc["name"] in params:
+                var = Parameter(
+                    blk, shape=vdesc["shape"], dtype=vdesc["dtype"],
+                    name=vdesc["name"],
+                )
+                var.stop_gradient = vdesc.get("stop_gradient", False)
+            else:
+                var = Variable(
+                    blk,
+                    name=vdesc["name"],
+                    shape=vdesc["shape"],
+                    dtype=vdesc["dtype"],
+                    type=VarType(vdesc.get("type", int(VarType.LOD_TENSOR))),
+                    persistable=vdesc.get("persistable", False),
+                    stop_gradient=vdesc.get("stop_gradient", False),
+                    is_data=vdesc.get("is_data", False),
+                )
+            blk.vars[var.name] = var
+        for odesc in bdesc["ops"]:
+            op = Operator(
+                blk,
+                type=odesc["type"],
+                inputs=odesc.get("inputs", {}),
+                outputs=odesc.get("outputs", {}),
+                attrs=odesc.get("attrs", {}),
+            )
+            blk.ops.append(op)
+    return program

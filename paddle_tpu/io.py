@@ -1,0 +1,223 @@
+"""Model / checkpoint save-load — parity with python/paddle/fluid/io.py
+(save_vars:224, save_persistables:598, load_vars:667, load_persistables:902,
+save_inference_model:1093, load_inference_model:1303, save:1598, load:1662).
+
+The reference serializes each LoDTensor through save/load *ops*; here tensors
+are jax.Arrays in the Scope, serialized as one .npz per save call plus a JSON
+program desc (see framework/serialization.py for the desc format). Orbax-style
+async sharded checkpointing for the distributed path lives in
+parallel/checkpoint.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .framework.executor import Executor, Scope, global_scope
+from .framework.program import Program, Variable, default_main_program
+from .framework.serialization import program_from_desc, program_to_desc
+
+__all__ = [
+    "save_vars", "load_vars", "save_persistables", "load_persistables",
+    "save_params", "load_params", "save_inference_model", "load_inference_model",
+    "save", "load", "set_program_state", "get_program_state",
+]
+
+
+def _scope_np(scope: Scope, name: str):
+    v = scope.find_var(name)
+    if v is None:
+        return None
+    arr = np.asarray(v)
+    return arr
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        filename = "__params__"
+    payload = {}
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else v
+        arr = _scope_np(scope, name)
+        if arr is None:
+            continue
+        if str(arr.dtype) == "bfloat16":
+            arr = arr.astype(np.float32)
+        payload[name] = arr
+    np.savez(os.path.join(dirname, filename + ".npz"), **payload)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
+    if filename is None:
+        filename = "__params__"
+    path = os.path.join(dirname, filename + ".npz")
+    data = np.load(path)
+    scope = global_scope()
+    import jax.numpy as jnp
+
+    by_name = {(v.name if isinstance(v, Variable) else v): v for v in vars}
+    for name in data.files:
+        if name not in by_name:
+            continue
+        arr = data[name]
+        var = by_name[name]
+        if isinstance(var, Variable) and var.dtype == "bfloat16":
+            arr = jnp.asarray(arr).astype(jnp.bfloat16)
+        scope.set_var(name, jnp.asarray(arr))
+
+
+def _is_persistable(v: Variable) -> bool:
+    return v.persistable and not v.is_data
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    from .framework.program import Parameter
+
+    save_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    from .framework.program import Parameter
+
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names: List[str], target_vars,
+                         executor, main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """Prune the program to the feed→fetch slice (reference framework/prune.cc)
+    and save desc + params."""
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    pruned = prune_program(main_program, feeded_var_names,
+                           [v.name for v in target_vars])
+    os.makedirs(dirname, exist_ok=True)
+    desc = program_to_desc(pruned)
+    desc["_feed_names"] = list(feeded_var_names)
+    desc["_fetch_names"] = [v.name for v in target_vars]
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(desc, f)
+    if not program_only:
+        save_persistables(executor, dirname, pruned, filename=params_filename)
+    return [v.name for v in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename)) as f:
+        desc = json.load(f)
+    program = program_from_desc(desc)
+    feed_names = desc.get("_feed_names", [])
+    fetch_names = desc.get("_fetch_names", [])
+    try:
+        load_persistables(executor, dirname, program, filename=params_filename)
+    except FileNotFoundError:
+        pass
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
+
+
+def save(program: Program, model_path: str):
+    """Single-file program+params save (fluid.io.save:1598)."""
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdmodel", "w") as f:
+        json.dump(program_to_desc(program), f)
+    scope = global_scope()
+    payload = {}
+    for v in program.list_vars():
+        if v.persistable:
+            arr = _scope_np(scope, v.name)
+            if arr is not None:
+                payload[v.name] = arr
+    np.savez(model_path + ".pdparams.npz", **payload)
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    import jax.numpy as jnp
+
+    data = np.load(model_path + ".pdparams.npz")
+    scope = global_scope()
+    names = {v.name for v in (var_list or program.list_vars())}
+    for name in data.files:
+        if name in names:
+            scope.set_var(name, jnp.asarray(data[name]))
+
+
+def get_program_state(program: Optional[Program] = None):
+    program = program or default_main_program()
+    scope = global_scope()
+    out = {}
+    for v in program.list_vars():
+        if v.persistable:
+            arr = _scope_np(scope, v.name)
+            if arr is not None:
+                out[v.name] = arr
+    return out
+
+
+def set_program_state(program: Program, state_dict):
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    for name, arr in state_dict.items():
+        scope.set_var(name, jnp.asarray(arr))
+
+
+def prune_program(program: Program, feed_names: List[str],
+                  fetch_names: List[str]) -> Program:
+    """Backward slice from fetch vars — parity with framework/prune.cc."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type.endswith("_grad") or _is_opt_op(op.type):
+            continue
+        if any(n in needed for n in op.output_arg_names):
+            keep.append(op)
+            needed.update(op.input_arg_names)
+    keep.reverse()
+    block.ops = keep
+    used = set(feed_names) | set(fetch_names)
+    for op in keep:
+        used.update(op.input_arg_names)
+        used.update(op.output_arg_names)
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+    return pruned
+
+
+def _is_opt_op(op_type: str) -> bool:
+    from .framework.registry import has_op, get_op_spec
+
+    if not has_op(op_type):
+        return False
+    return get_op_spec(op_type).is_optimizer

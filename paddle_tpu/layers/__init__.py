@@ -1,0 +1,18 @@
+"""Layer-function API — parity with python/paddle/fluid/layers/."""
+from . import math_op_patch  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401
+from . import collective  # noqa: F401
+from . import control_flow  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    cosine_decay,
+    exponential_decay,
+    inverse_time_decay,
+    linear_lr_warmup,
+    natural_exp_decay,
+    noam_decay,
+    piecewise_decay,
+    polynomial_decay,
+)

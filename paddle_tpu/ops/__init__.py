@@ -1,0 +1,9 @@
+"""Op lowering library. Importing this package registers all op specs."""
+from . import math  # noqa: F401
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import collective  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import sequence  # noqa: F401
+from . import amp_ops  # noqa: F401

@@ -1,0 +1,72 @@
+"""AMP ops — parity with reference operators/amp/
+(check_finite_and_unscale / amp_check_finite_and_scale + update_loss_scaling).
+bf16 is the native TPU low-precision type; loss scaling is provided for fp16
+parity with the reference's dynamic-loss-scale machinery
+(contrib/mixed_precision/decorator.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("amp_check_finite_and_scale", grad=None)
+def amp_check_finite_and_scale(ctx, op, ins):
+    xs = ins["X"]
+    scale = ins["Scale"][0].reshape(())
+    finite = jnp.asarray(True)
+    outs = []
+    for x in xs:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(x)))
+    for x in xs:
+        outs.append(x / scale)
+    return {"Out": outs, "FoundInfinite": jnp.logical_not(finite)[None]}
+
+
+@register_op("check_finite_and_unscale", grad=None)
+def check_finite_and_unscale(ctx, op, ins):
+    return amp_check_finite_and_scale(ctx, op, ins)
+
+
+@register_op("update_loss_scaling", grad=None, is_optimizer=True)
+def update_loss_scaling(ctx, op, ins):
+    """Dynamic loss scaling state machine (reference
+    operators/amp/update_loss_scaling_op.cc)."""
+    found_inf = ins["FoundInfinite"][0].reshape(())
+    prev_scale = ins["PrevLossScaling"][0].reshape(())
+    good = ins["InGoodSteps"][0].reshape(())
+    bad = ins["InBadSteps"][0].reshape(())
+    incr_every = op.attr("incr_every_n_steps", 1000)
+    decr_every = op.attr("decr_every_n_nan_or_inf", 2)
+    incr_ratio = op.attr("incr_ratio", 2.0)
+    decr_ratio = op.attr("decr_ratio", 0.5)
+
+    new_bad = jnp.where(found_inf, bad + 1, 0)
+    new_good = jnp.where(found_inf, 0, good + 1)
+    scale_up = new_good >= incr_every
+    scale_down = new_bad >= decr_every
+    new_scale = jnp.where(
+        scale_down, jnp.maximum(prev_scale * decr_ratio, 1.0),
+        jnp.where(scale_up, prev_scale * incr_ratio, prev_scale),
+    )
+    new_good = jnp.where(scale_up, 0, new_good)
+    new_bad = jnp.where(scale_down, 0, new_bad)
+
+    outs = {}
+    if "X" in ins:
+        # zero-out grads on overflow so the optimizer step is a no-op
+        outs["Out"] = [jnp.where(found_inf, jnp.zeros_like(x), x) for x in ins["X"]]
+    outs.update({
+        "LossScaling": new_scale[None],
+        "OutGoodSteps": new_good[None].astype(jnp.int32),
+        "OutBadSteps": new_bad[None].astype(jnp.int32),
+    })
+    return outs
+
+
+@register_op("cast_with_ptr", grad=None)
+def cast_with_ptr(ctx, op, ins):  # helper used by AMP rewriter
+    from ..framework.core import dtype_to_jax
+
+    return {"Out": ins["X"][0].astype(dtype_to_jax(op.attr("out_dtype")))}

@@ -1,0 +1,139 @@
+"""Collective op lowerings — the TPU-native replacement for
+reference operators/collective/ (c_allreduce_{sum,max,min,prod}, c_allgather,
+c_reducescatter, c_broadcast, c_comm_init, c_gen_nccl_id, c_sync_*_stream;
+kernels at c_allreduce_op.h:33-110 call ncclAllReduce on ring `ring_id`).
+
+Here ring_id maps to a NAMED MESH AXIS (ctx.mesh_axes: ring_id -> axis name);
+inside pjit/shard_map the ops lower to lax.psum/all_gather/ppermute and XLA
+emits ICI/DCN collectives. Outside any mesh (single-device executor) they are
+identity — same semantics as a 1-rank ring. The NCCL bootstrap ops
+(c_gen_nccl_id / c_comm_init) become no-ops: jax.distributed.initialize plays
+the coordinator role.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _axis(ctx, op):
+    ring_id = op.attr("ring_id", 0)
+    return ctx.axis_name(ring_id)
+
+
+def _allreduce(reduce_fn):
+    def lower(ctx, op, ins):
+        x = ins["X"][0]
+        ax = _axis(ctx, op)
+        if ax is None:
+            return {"Out": x}
+        return {"Out": reduce_fn(x, ax)}
+
+    return lower
+
+
+register_op("c_allreduce_sum", diff_inputs=("X",))(_allreduce(lax.psum))
+register_op("c_allreduce_max", diff_inputs=("X",))(_allreduce(lax.pmax))
+register_op("c_allreduce_min", diff_inputs=("X",))(_allreduce(lax.pmin))
+
+
+@register_op("c_allreduce_prod", diff_inputs=("X",))
+def c_allreduce_prod(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": x}
+    # no lax.pprod; exp-sum-log trick is unstable — use all_gather+prod
+    g = lax.all_gather(x, ax)
+    return {"Out": jnp.prod(g, axis=0)}
+
+
+@register_op("c_allgather", diff_inputs=("X",))
+def c_allgather(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    nranks = op.attr("nranks", 1)
+    if ax is None:
+        return {"Out": x}
+    g = lax.all_gather(x, ax)  # (nranks, ...)
+    return {"Out": jnp.reshape(g, (g.shape[0] * g.shape[1],) + g.shape[2:])}
+
+
+@register_op("c_reducescatter", diff_inputs=("X",))
+def c_reducescatter(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": x}
+    nranks = lax.axis_size(ax)
+    return {"Out": lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)}
+
+
+@register_op("c_broadcast", diff_inputs=("X",))
+def c_broadcast(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    root = op.attr("root", 0)
+    if ax is None:
+        return {"Out": x}
+    # select root's value on every rank: gather then index (XLA lowers to bcast)
+    g = lax.all_gather(x, ax)
+    return {"Out": g[root]}
+
+
+@register_op("c_concat", diff_inputs=("X",))
+def c_concat(ctx, op, ins):
+    """Model-parallel concat (gather along last dim over the ring)."""
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)}
+
+
+@register_op("c_split", diff_inputs=("X",))
+def c_split(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": x}
+    nranks = lax.axis_size(ax)
+    rank = lax.axis_index(ax)
+    piece = x.shape[-1] // nranks
+    return {"Out": lax.dynamic_slice_in_dim(x, rank * piece, piece, axis=x.ndim - 1)}
+
+
+@register_op("c_identity", diff_inputs=("X",))
+def c_identity(ctx, op, ins):
+    return {"Out": ins["X"][0]}
+
+
+# Bootstrap / sync ops: capability subsumed by jax.distributed + XLA program
+# order. Kept as registered no-ops so transpiled reference programs execute.
+for _t in ("c_comm_init", "c_comm_init_all", "c_gen_nccl_id",
+           "c_sync_calc_stream", "c_sync_comm_stream", "c_wait_comm",
+           "c_wait_compute", "barrier"):
+    register_op(_t, grad=None)(
+        (lambda t: lambda ctx, op, ins: (
+            {"Out": ins["X"][0]} if "X" in ins and ins["X"] else {}
+        ))(_t)
+    )
+
+
+@register_op("broadcast", diff_inputs=("X",))
+def legacy_broadcast(ctx, op, ins):
+    return c_broadcast(ctx, op, ins)
+
+
+@register_op("allreduce", diff_inputs=("X",))
+def legacy_allreduce(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": x}
+    red = op.attr("reduce_type", 0)
+    fn = [lax.psum, lax.pmax, lax.pmin][red] if red in (0, 1, 2) else lax.psum
+    return {"Out": fn(x, ax)}

@@ -1,0 +1,178 @@
+"""Control-flow op lowerings: while / cond with sub-Blocks.
+
+Parity with reference operators/controlflow/while_op.cc (runs a sub-Block with
+an inner Executor per iteration) and conditional_block_op.cc. Here a sub-Block
+lowers to a traced jax function and the loop becomes lax.while_loop / lax.cond
+— XLA-compilable control flow with static shapes, per the TPU execution model.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import LowerCtx, register_op, run_lowering
+
+
+def _block_reads_writes(block):
+    written, read = set(), set()
+    for op in block.ops:
+        for n in op.input_arg_names:
+            if n not in written:
+                read.add(n)
+        for n in op.output_arg_names:
+            written.add(n)
+    return read, written
+
+
+def _run_sub_block(ctx: LowerCtx, block, env: Dict):
+    sub_ctx = LowerCtx(ctx.program, block, env, rng_key=ctx._rng_key,
+                       mesh_axes=ctx.mesh_axes, is_test=ctx.is_test)
+    sub_ctx._rng_counter = ctx._rng_counter + 7919
+    for op in block.ops:
+        run_lowering(sub_ctx, op)
+    return env
+
+
+@register_op("while", grad=None)
+def while_op(ctx, op, ins):
+    """Carried state = vars written by the sub-block that already exist in the
+    parent env (loop variables), plus the condition var. Everything else the
+    sub-block reads is closed over (loop-invariant)."""
+    sub_block = ctx.program.block(op.attr("sub_block"))
+    cond_name = op.inputs["Condition"][0]
+    read, written = _block_reads_writes(sub_block)
+
+    carry_names = sorted(
+        {n for n in written if n in ctx.env} | {cond_name}
+    )
+    invariant = {n: ctx.env[n] for n in read if n in ctx.env and n not in carry_names}
+
+    def cond_fn(carry):
+        c = carry[cond_name]
+        return jnp.reshape(c, ()).astype(jnp.bool_)
+
+    def body_fn(carry):
+        env = dict(invariant)
+        env.update(carry)
+        _run_sub_block(ctx, sub_block, env)
+        return {n: env[n] for n in carry_names}
+
+    init = {n: ctx.env[n] for n in carry_names}
+    final = lax.while_loop(cond_fn, body_fn, init)
+    # publish results back by name (Out list + all carried vars)
+    for n, v in final.items():
+        ctx.env[n] = v
+    return {}
+
+
+@register_op("conditional_block", grad=None)
+def conditional_block(ctx, op, ins):
+    """True-branch-only conditional (reference conditional_block_op.cc).
+    Lowered as lax.cond with an identity false branch over the written vars —
+    vars the branch writes must pre-exist in env (select_input pattern) or be
+    written unconditionally by zero-init."""
+    sub_block = ctx.program.block(op.attr("sub_block"))
+    cond_val = ins["Cond"][0]
+    is_scalar_condition = op.attr("is_scalar_condition", True)
+    pred = jnp.reshape(cond_val, ()).astype(jnp.bool_) if is_scalar_condition else jnp.all(cond_val)
+
+    read, written = _block_reads_writes(sub_block)
+    carry_names = sorted(n for n in written if n in ctx.env)
+    invariant = {n: ctx.env[n] for n in read if n in ctx.env and n not in carry_names}
+
+    def true_fn(carry):
+        env = dict(invariant)
+        env.update(carry)
+        _run_sub_block(ctx, sub_block, env)
+        return {n: env[n] for n in carry_names}
+
+    def false_fn(carry):
+        return carry
+
+    init = {n: ctx.env[n] for n in carry_names}
+    final = lax.cond(pred, true_fn, false_fn, init)
+    for n, v in final.items():
+        ctx.env[n] = v
+    return {}
+
+
+@register_op("cond", grad=None)
+def cond_op(ctx, op, ins):
+    """Two-branch functional cond (this framework's native form; built by
+    layers.cond). Attrs: true_block, false_block; outputs Out = the aligned
+    return vars of the two branches."""
+    pred = jnp.reshape(ins["Cond"][0], ()).astype(jnp.bool_)
+    tb = ctx.program.block(op.attr("true_block"))
+    fb = ctx.program.block(op.attr("false_block"))
+    true_outs = op.attr("true_outs")  # var names produced by each branch
+    false_outs = op.attr("false_outs")
+
+    def make_branch(block, out_names):
+        def fn(_):
+            env = dict(ctx.env)
+            _run_sub_block(ctx, block, env)
+            return tuple(env[n] for n in out_names)
+
+        return fn
+
+    outs = lax.cond(pred, make_branch(tb, true_outs), make_branch(fb, false_outs),
+                    None)
+    return {"Out": list(outs)}
+
+
+@register_op("select_input", grad=None)
+def select_input(ctx, op, ins):
+    mask = jnp.reshape(ins["Mask"][0], ()).astype(jnp.int32)
+    xs = ins["X"]
+    return {"Out": lax.switch(mask, [lambda i=i: xs[i] for i in range(len(xs))])}
+
+
+@register_op("select_output", grad=None)
+def select_output(ctx, op, ins):
+    # writes input to the output slot selected by mask; with static program
+    # structure both outputs receive the value, selection resolved downstream
+    return {"Out": [ins["X"][0] for _ in op.outputs.get("Out", [])]}
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray ops — env value is a python list of arrays (host-side
+# structure; inside while loops these become stacked carries via layers.scan)
+# ---------------------------------------------------------------------------
+
+
+@register_op("write_to_array", grad=None)
+def write_to_array(ctx, op, ins):
+    x = ins["X"][0]
+    i = int(jnp.reshape(jnp.asarray(ins["I"][0]), ()))  # static index required
+    name = op.outputs["Out"][0]
+    arr = list(ctx.env.get(name, []))
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    return {"Out": [arr]}
+
+
+@register_op("read_from_array", grad=None)
+def read_from_array(ctx, op, ins):
+    arr = ins["X"][0]
+    i = int(jnp.reshape(jnp.asarray(ins["I"][0]), ()))
+    return {"Out": arr[i]}
+
+
+@register_op("array_length", grad=None)
+def array_length(ctx, op, ins):
+    return {"Out": jnp.asarray([len(ins["X"][0])], dtype=jnp.int64)}
+
+
+@register_op("tensor_array_to_tensor", grad=None)
+def tensor_array_to_tensor(ctx, op, ins):
+    axis = op.attr("axis", 0)
+    arr = ins["X"][0]
+    if op.attr("use_stack", False):
+        out = jnp.stack(arr, axis=axis)
+    else:
+        out = jnp.concatenate(arr, axis=axis)
+    return {"Out": out, "OutIndex": jnp.asarray([a.shape[axis] for a in arr], dtype=jnp.int32)}

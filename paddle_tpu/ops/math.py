@@ -1,0 +1,402 @@
+"""Math / elementwise / reduction op lowerings.
+
+Capability parity with reference operators/elementwise/ (~8k LoC CUDA),
+operators/reduce_ops/, and the dense-math portion of operators/*.cc — each
+multi-hundred-line CUDA kernel family collapses to a jnp/lax expression that
+XLA fuses and tiles onto the VPU/MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import dtype_to_jax
+from ..framework.registry import register_op
+
+# ---------------------------------------------------------------------------
+# Creation / fill ops (reference operators/fill_constant_op.cc etc.)
+# ---------------------------------------------------------------------------
+
+
+@register_op("fill_constant", grad=None)
+def fill_constant(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape", [])]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    value = op.attr("value", 0.0)
+    if "ShapeTensor" in ins and ins["ShapeTensor"]:
+        shape = [int(x) for x in np.asarray(ins["ShapeTensor"][0])]
+    return {"Out": jnp.full(shape, value, dtype=dtype)}
+
+
+@register_op("fill_zeros_like", grad=None)
+def fill_zeros_like(ctx, op, ins):
+    return {"Out": jnp.zeros_like(ins["X"][0])}
+
+
+@register_op("fill_any_like", grad=None)
+def fill_any_like(ctx, op, ins):
+    dtype = op.attr("dtype")
+    x = ins["X"][0]
+    dt = dtype_to_jax(dtype) if dtype is not None else x.dtype
+    return {"Out": jnp.full_like(x, op.attr("value", 0.0), dtype=dt)}
+
+
+@register_op("assign")
+def assign(ctx, op, ins):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("shape", grad=None)
+def shape_op(ctx, op, ins):
+    return {"Out": jnp.asarray(ins["Input"][0].shape, dtype=jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# Elementwise binary ops with axis broadcasting
+# (reference operators/elementwise/elementwise_op_function.h broadcast rules:
+#  Y's shape aligns to X at `axis`; -1 means numpy-style ranks-aligned-right)
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_y(x, y, axis):
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    # insert trailing singleton dims so y aligns at `axis`
+    new_shape = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        new_shape[axis + i] = d
+    return jnp.reshape(y, new_shape)
+
+
+def _ew(fn):
+    def lower(ctx, op, ins):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _broadcast_y(x, y, op.attr("axis", -1))
+        return {"Out": fn(x, y)}
+
+    return lower
+
+
+register_op("elementwise_add")(_ew(jnp.add))
+register_op("elementwise_sub")(_ew(jnp.subtract))
+register_op("elementwise_mul")(_ew(jnp.multiply))
+register_op("elementwise_div")(_ew(jnp.divide))
+register_op("elementwise_min")(_ew(jnp.minimum))
+register_op("elementwise_max")(_ew(jnp.maximum))
+register_op("elementwise_pow")(_ew(jnp.power))
+register_op("elementwise_mod", grad=None)(_ew(jnp.mod))
+register_op("elementwise_floordiv", grad=None)(_ew(jnp.floor_divide))
+
+
+@register_op("scale")
+def scale(ctx, op, ins):
+    x = ins["X"][0]
+    s = op.attr("scale", 1.0)
+    if "ScaleTensor" in ins and ins["ScaleTensor"]:
+        s = ins["ScaleTensor"][0]
+    bias = op.attr("bias", 0.0)
+    if op.attr("bias_after_scale", True):
+        return {"Out": x * s + jnp.asarray(bias, x.dtype)}
+    return {"Out": (x + jnp.asarray(bias, x.dtype)) * s}
+
+
+@register_op("sum")
+def sum_op(ctx, op, ins):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("clip")
+def clip(ctx, op, ins):
+    return {"Out": jnp.clip(ins["X"][0], op.attr("min"), op.attr("max"))}
+
+
+@register_op("cast", diff_inputs=("X",))
+def cast(ctx, op, ins):
+    return {"Out": ins["X"][0].astype(dtype_to_jax(op.attr("out_dtype")))}
+
+
+# ---------------------------------------------------------------------------
+# Unary math (reference operators/activation_op.* one templated file)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "reciprocal": lambda x: 1.0 / x,
+    "square": jnp.square,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "sign": jnp.sign,
+    "erf": jax.scipy.special.erf,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "softsign": jax.nn.soft_sign,
+}
+
+for _name, _fn in _UNARY.items():
+    register_op(_name)(
+        (lambda fn: lambda ctx, op, ins: {"Out": fn(ins["X"][0])})(_fn)
+    )
+
+
+@register_op("pow")
+def pow_op(ctx, op, ins):
+    factor = op.attr("factor", 1.0)
+    if "FactorTensor" in ins and ins["FactorTensor"]:
+        factor = ins["FactorTensor"][0]
+    return {"Out": jnp.power(ins["X"][0], factor)}
+
+
+# ---------------------------------------------------------------------------
+# Matmul family — the MXU path. bf16-friendly, batched.
+# (reference operators/matmul_op.cc, mul_op.cc, bmm, dot)
+# ---------------------------------------------------------------------------
+
+
+@register_op("matmul")
+def matmul(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx, ty = op.attr("transpose_X", False), op.attr("transpose_Y", False)
+    alpha = op.attr("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x.dtype))
+    out = out.astype(x.dtype)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+def _acc_type(dtype):
+    # accumulate matmuls in f32 when inputs are low-precision (MXU native)
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return dtype
+
+
+@register_op("matmul_v2")
+def matmul_v2(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    if op.attr("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if op.attr("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x.dtype)).astype(x.dtype)
+    return {"Out": out}
+
+
+@register_op("mul")
+def mul(ctx, op, ins):
+    """reference mul_op: flattens X to 2D at x_num_col_dims, Y at y_num_col_dims."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = op.attr("x_num_col_dims", 1)
+    ync = op.attr("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xnc])), int(np.prod(xs[xnc:]))))
+    y2 = y.reshape((int(np.prod(ys[:ync])), int(np.prod(ys[ync:]))))
+    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x2.dtype)).astype(x.dtype)
+    return {"Out": out.reshape(xs[:xnc] + ys[ync:])}
+
+
+@register_op("bmm")
+def bmm(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.matmul(x, y, preferred_element_type=_acc_type(x.dtype)).astype(x.dtype)}
+
+
+@register_op("dot")
+def dot(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}
+
+
+# ---------------------------------------------------------------------------
+# Reductions (reference operators/reduce_ops/)
+# ---------------------------------------------------------------------------
+
+
+def _reduce(fn, differentiable=True):
+    def lower(ctx, op, ins):
+        x = ins["X"][0]
+        dims = op.attr("dim", [0])
+        keep = op.attr("keep_dim", False)
+        if op.attr("reduce_all", False) or dims is None or len(dims) == 0:
+            axes = tuple(range(x.ndim))
+        else:
+            axes = tuple(d % max(x.ndim, 1) for d in dims)
+        return {"Out": fn(x, axis=axes, keepdims=keep)}
+
+    return lower
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_all", grad=None)(_reduce(jnp.all))
+register_op("reduce_any", grad=None)(_reduce(jnp.any))
+
+
+@register_op("mean")
+def mean(ctx, op, ins):
+    return {"Out": jnp.mean(ins["X"][0])}
+
+
+@register_op("logsumexp")
+def logsumexp(ctx, op, ins):
+    x = ins["X"][0]
+    dims = op.attr("dim", None) or op.attr("axis", None)
+    keep = op.attr("keep_dim", False) or op.attr("keepdim", False)
+    axes = tuple(dims) if dims else None
+    return {"Out": jax.scipy.special.logsumexp(x, axis=axes, keepdims=keep)}
+
+
+# ---------------------------------------------------------------------------
+# Comparison / logical (reference operators/controlflow/compare_op.cc)
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+}
+for _name, _fn in _CMP.items():
+    register_op(_name, grad=None)(
+        (lambda fn: lambda ctx, op, ins: {"Out": fn(ins["X"][0], ins["Y"][0])})(_fn)
+    )
+
+register_op("logical_and", grad=None)(
+    lambda ctx, op, ins: {"Out": jnp.logical_and(ins["X"][0], ins["Y"][0])}
+)
+register_op("logical_or", grad=None)(
+    lambda ctx, op, ins: {"Out": jnp.logical_or(ins["X"][0], ins["Y"][0])}
+)
+register_op("logical_xor", grad=None)(
+    lambda ctx, op, ins: {"Out": jnp.logical_xor(ins["X"][0], ins["Y"][0])}
+)
+register_op("logical_not", grad=None)(
+    lambda ctx, op, ins: {"Out": jnp.logical_not(ins["X"][0])}
+)
+
+
+@register_op("isfinite", grad=None)
+def isfinite(ctx, op, ins):
+    # reference isfinite_op reduces to a single bool over the whole tensor
+    return {"Out": jnp.all(jnp.isfinite(ins["X"][0]))[None]}
+
+
+@register_op("isfinite_v2", grad=None)
+def isfinite_v2(ctx, op, ins):
+    return {"Out": jnp.isfinite(ins["X"][0])}
+
+
+@register_op("isnan_v2", grad=None)
+def isnan_v2(ctx, op, ins):
+    return {"Out": jnp.isnan(ins["X"][0])}
+
+
+@register_op("isinf_v2", grad=None)
+def isinf_v2(ctx, op, ins):
+    return {"Out": jnp.isinf(ins["X"][0])}
+
+
+# ---------------------------------------------------------------------------
+# argmax/argmin/argsort/topk (reference arg_min_max_op, argsort_op, top_k_op)
+# ---------------------------------------------------------------------------
+
+
+@register_op("arg_max", grad=None)
+def arg_max(ctx, op, ins):
+    axis = op.attr("axis", -1)
+    return {"Out": jnp.argmax(ins["X"][0], axis=axis).astype(jnp.int64)}
+
+
+@register_op("arg_min", grad=None)
+def arg_min(ctx, op, ins):
+    axis = op.attr("axis", -1)
+    return {"Out": jnp.argmin(ins["X"][0], axis=axis).astype(jnp.int64)}
+
+
+@register_op("argsort", grad=None)
+def argsort(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", -1)
+    desc = op.attr("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k", diff_inputs=())
+def top_k(ctx, op, ins):
+    x = ins["X"][0]
+    k = op.attr("k", 1)
+    if "K" in ins and ins["K"]:
+        k = int(np.asarray(ins["K"][0]))
+    vals, idx = lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k_v2", diff_inputs=())
+def top_k_v2(ctx, op, ins):
+    x = ins["X"][0]
+    k = op.attr("k", 1)
+    if op.attr("largest", True):
+        vals, idx = lax.top_k(x, k)
+    else:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("accuracy", grad=None)
+def accuracy(ctx, op, ins):
+    """reference operators/metrics/accuracy_op: Out from topk Indices vs Label."""
+    idx = ins["Indices"][0]
+    label = ins["Label"][0]
+    if label.ndim == idx.ndim - 1:
+        label = label[..., None]
+    correct = jnp.any(idx == label, axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = correct.shape[0] if correct.ndim else 1
+    acc = num_correct.astype(jnp.float32) / float(np.prod(correct.shape))
+    return {
+        "Accuracy": acc[None],
+        "Correct": num_correct[None],
+        "Total": jnp.asarray([int(np.prod(correct.shape))], dtype=jnp.int32),
+    }
+
+
+@register_op("increment", grad=None)
+def increment(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": x + jnp.asarray(op.attr("step", 1.0), dtype=x.dtype)}

@@ -1,0 +1,599 @@
+"""Neural-net op lowerings: conv/pool/norm/softmax/dropout/embedding/losses.
+
+Capability parity with the dense-NN portion of reference
+paddle/fluid/operators/ (conv_op.cc + conv_cudnn_op.cu, pool_op, batch_norm_op,
+layer_norm_op, softmax_op, dropout_op, lookup_table_op, activation_op,
+cross_entropy_op, softmax_with_cross_entropy_op, …). Convs/matmuls lower to
+lax conv/dot so XLA tiles them onto the MXU; everything elementwise fuses.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import dtype_to_jax
+from ..framework.registry import register_op
+
+# ---------------------------------------------------------------------------
+# Activations (reference operators/activation_op.cc — one templated family)
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softplus": jax.nn.softplus,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    "hard_swish": lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0,
+}
+for _name, _fn in _ACTS.items():
+    register_op(_name)(
+        (lambda fn: lambda ctx, op, ins: {"Out": fn(ins["X"][0])})(_fn)
+    )
+
+
+@register_op("leaky_relu")
+def leaky_relu(ctx, op, ins):
+    alpha = op.attr("alpha", 0.02)
+    return {"Out": jax.nn.leaky_relu(ins["X"][0], negative_slope=alpha)}
+
+
+@register_op("elu")
+def elu(ctx, op, ins):
+    return {"Out": jax.nn.elu(ins["X"][0], alpha=op.attr("alpha", 1.0))}
+
+
+@register_op("gelu")
+def gelu(ctx, op, ins):
+    return {"Out": jax.nn.gelu(ins["X"][0], approximate=op.attr("approximate", False))}
+
+
+@register_op("prelu")
+def prelu(ctx, op, ins):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = op.attr("mode", "all")
+    if mode == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register_op("softmax")
+def softmax(ctx, op, ins):
+    axis = op.attr("axis", -1)
+    return {"Out": jax.nn.softmax(ins["X"][0], axis=axis)}
+
+
+@register_op("log_softmax")
+def log_softmax(ctx, op, ins):
+    axis = op.attr("axis", -1)
+    return {"Out": jax.nn.log_softmax(ins["X"][0], axis=axis)}
+
+
+@register_op("softmax_with_cross_entropy", diff_inputs=("Logits",))
+def softmax_with_cross_entropy(ctx, op, ins):
+    """reference operators/softmax_with_cross_entropy_op.cc — fused, stable."""
+    logits = ins["Logits"][0]
+    label = ins["Label"][0]
+    axis = op.attr("axis", -1)
+    soft_label = op.attr("soft_label", False)
+    ignore_index = op.attr("ignore_index", -100)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lbl, 0, logits.shape[axis] - 1), axis), axis=axis
+        )
+        loss = -picked
+        mask = jnp.expand_dims(lbl, axis) != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("cross_entropy", diff_inputs=("X",))
+def cross_entropy(ctx, op, ins):
+    """reference operators/cross_entropy_op.cc: X is probabilities."""
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    soft_label = op.attr("soft_label", False)
+    eps = 1e-12
+    if soft_label:
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lbl = label.astype(jnp.int32)
+        if lbl.ndim == x.ndim:
+            lbl = jnp.squeeze(lbl, -1)
+        picked = jnp.take_along_axis(x, lbl[..., None], axis=-1)
+        loss = -jnp.log(picked + eps)
+    return {"Y": loss}
+
+
+@register_op("bce_loss", diff_inputs=("X",))
+def bce_loss(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-12
+    return {"Out": -(label * jnp.log(x + eps) + (1 - label) * jnp.log(1 - x + eps))}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", diff_inputs=("X",))
+def sigmoid_ce_logits(ctx, op, ins):
+    x, label = ins["X"][0], ins["Label"][0]
+    ignore_index = op.attr("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if op.attr("normalize", False):
+        norm = jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1.0)
+        loss = loss / norm
+    return {"Out": loss}
+
+
+@register_op("smooth_l1_loss", diff_inputs=("X",))
+def smooth_l1_loss(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = op.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    return {"Diff": diff, "Out": jnp.sum(elem, axis=tuple(range(1, x.ndim)), keepdims=False)[..., None]}
+
+
+@register_op("huber_loss", diff_inputs=("X",))
+def huber_loss(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = op.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Residual": r, "Out": out}
+
+
+@register_op("mse_loss", diff_inputs=("X",))
+def mse_loss(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("kldiv_loss", diff_inputs=("X",))
+def kldiv_loss(ctx, op, ins):
+    x, target = ins["X"][0], ins["Target"][0]
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    red = op.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": loss}
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling — MXU ops (reference conv_op.cc, conv_cudnn_op.cu,
+# pool_op.cc; cuDNN algo search is replaced by XLA's conv emitter)
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv_padding(padding, ndim):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    p = _pair(padding, ndim)
+    if len(p) == ndim:
+        return [(int(x), int(x)) for x in p]
+    if len(p) == 2 * ndim:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(ndim)]
+    raise ValueError(f"bad padding {padding}")
+
+
+@register_op("conv2d", diff_inputs=("Input", "Filter"))
+def conv2d(ctx, op, ins):
+    x = ins["Input"][0]  # NCHW
+    w = ins["Filter"][0]  # OIHW (I = C/groups)
+    groups = op.attr("groups", 1) or 1
+    strides = _pair(op.attr("strides", [1, 1]))
+    dilations = _pair(op.attr("dilations", [1, 1]))
+    padding = _conv_padding(op.attr("paddings", [0, 0]), 2)
+    data_format = op.attr("data_format", "NCHW")
+    if data_format in ("NHWC",):
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "OIHW", "NHWC"))
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=padding,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None,
+    ).astype(x.dtype)
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d", diff_inputs=("Input", "Filter"))
+def depthwise_conv2d(ctx, op, ins):
+    # groups == channels; same lowering, XLA specializes
+    return conv2d(ctx, op, ins)
+
+
+@register_op("conv3d", diff_inputs=("Input", "Filter"))
+def conv3d(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    groups = op.attr("groups", 1) or 1
+    strides = _pair(op.attr("strides", [1, 1, 1]), 3)
+    dilations = _pair(op.attr("dilations", [1, 1, 1]), 3)
+    padding = _conv_padding(op.attr("paddings", [0, 0, 0]), 3)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups,
+    ).astype(x.dtype)
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose", diff_inputs=("Input", "Filter"))
+def conv2d_transpose(ctx, op, ins):
+    x, w = ins["Input"][0], ins["Filter"][0]  # NCHW, IOHW in paddle
+    strides = _pair(op.attr("strides", [1, 1]))
+    paddings = _pair(op.attr("paddings", [0, 0]))
+    dilations = _pair(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    # paddle filter layout for transpose conv: (in, out/groups, kh, kw)
+    kh, kw = w.shape[2], w.shape[3]
+    pad = [
+        (dilations[0] * (kh - 1) - paddings[0], dilations[0] * (kh - 1) - paddings[0]),
+        (dilations[1] * (kw - 1) - paddings[1], dilations[1] * (kw - 1) - paddings[1]),
+    ]
+    w_t = jnp.swapaxes(w, 0, 1)  # -> (out/g, in, kh, kw)
+    w_t = jnp.flip(w_t, axis=(2, 3))
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=pad,
+        lhs_dilation=strides, dimension_numbers=dn, feature_group_count=groups,
+    ).astype(x.dtype)
+    return {"Output": out}
+
+
+@register_op("pool2d", diff_inputs=("X",))
+def pool2d(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    ptype = op.attr("pooling_type", "max")
+    ksize = _pair(op.attr("ksize", [2, 2]))
+    strides = _pair(op.attr("strides", [1, 1]))
+    paddings = _pair(op.attr("paddings", [0, 0]))
+    global_pool = op.attr("global_pooling", False)
+    adaptive = op.attr("adaptive", False)
+    exclusive = op.attr("exclusive", True)
+    ceil_mode = op.attr("ceil_mode", False)
+
+    if global_pool or (adaptive and tuple(ksize) == (1, 1)):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x, axis=(2, 3), keepdims=True)}
+    if adaptive:
+        oh, ow = ksize
+        h, w = x.shape[2], x.shape[3]
+        assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible sizes"
+        x5 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x5, axis=(3, 5))}
+
+    window = (1, 1) + tuple(ksize)
+    strides4 = (1, 1) + tuple(strides)
+    pad4 = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    if ceil_mode:
+        # pad the right edge so the last window fits
+        new_pad = []
+        for i, (lo, hi) in enumerate(pad4):
+            if i >= 2:
+                size = x.shape[i] + lo + hi
+                rem = (size - window[i]) % strides4[i]
+                if rem:
+                    hi += strides4[i] - rem
+            new_pad.append((lo, hi))
+        pad4 = new_pad
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides4, pad4)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides4, pad4)
+        if exclusive and any(p for pp in pad4 for p in pp):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides4, pad4)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": out.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Normalization ops
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_norm", diff_inputs=("X", "Scale", "Bias"))
+def batch_norm(ctx, op, ins):
+    """reference operators/batch_norm_op.cc (+cudnn). NCHW or NC...; in
+    training mode also emits updated moving stats (MeanOut/VarianceOut alias
+    the persistable Mean/Variance vars, in-place by name in the env)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    is_test = op.attr("is_test", False) or op.attr("use_global_stats", False)
+    data_layout = op.attr("data_layout", "NCHW")
+
+    if data_layout == "NCHW":
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = (1,) * (x.ndim - 1) + (-1,)
+
+    if is_test:
+        mean, var = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+    else:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        mean_out = mean_in * momentum + mean * (1 - momentum)
+        var_out = var_in * momentum + var * (1 - momentum)
+        saved_mean, saved_var = mean, var
+
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    y = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    y = y * scale.reshape(shape) + bias.reshape(shape)
+    return {
+        "Y": y.astype(x.dtype),
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": inv,
+    }
+
+
+@register_op("layer_norm", diff_inputs=("X", "Scale", "Bias"))
+def layer_norm(ctx, op, ins):
+    """reference operators/layer_norm_op.cc: normalize over dims >= begin_norm_axis."""
+    x = ins["X"][0]
+    eps = op.attr("epsilon", 1e-5)
+    bna = op.attr("begin_norm_axis", 1)
+    axes = tuple(range(bna, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    if "Scale" in ins and ins["Scale"]:
+        y = y * ins["Scale"][0].reshape((1,) * bna + x.shape[bna:]).astype(jnp.float32)
+    if "Bias" in ins and ins["Bias"]:
+        y = y + ins["Bias"][0].reshape((1,) * bna + x.shape[bna:]).astype(jnp.float32)
+    return {
+        "Y": y.astype(x.dtype),
+        "Mean": jnp.squeeze(mean, axes),
+        "Variance": jnp.squeeze(var, axes),
+    }
+
+
+@register_op("instance_norm", diff_inputs=("X", "Scale", "Bias"))
+def instance_norm(ctx, op, ins):
+    x = ins["X"][0]
+    eps = op.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if "Scale" in ins and ins["Scale"]:
+        y = y * ins["Scale"][0].reshape(shape)
+    if "Bias" in ins and ins["Bias"]:
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": y, "SavedMean": jnp.squeeze(mean, axes), "SavedVariance": jnp.squeeze(var, axes)}
+
+
+@register_op("group_norm", diff_inputs=("X", "Scale", "Bias"))
+def group_norm(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    g = op.attr("groups", 1)
+    eps = op.attr("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if "Scale" in ins and ins["Scale"]:
+        y = y * ins["Scale"][0].reshape(shape)
+    if "Bias" in ins and ins["Bias"]:
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": y, "Mean": jnp.reshape(mean, (n, g)), "Variance": jnp.reshape(var, (n, g))}
+
+
+@register_op("l2_normalize", diff_inputs=("X",))
+def l2_normalize(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", -1)
+    eps = op.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+# ---------------------------------------------------------------------------
+# Dropout — random; key derived from output names so grad replay is CSE-able
+# ---------------------------------------------------------------------------
+
+
+@register_op("dropout", diff_inputs=("X",), needs_rng=True)
+def dropout(ctx, op, ins):
+    x = ins["X"][0]
+    p = op.attr("dropout_prob", 0.5)
+    is_test = op.attr("is_test", False)
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test or p == 0.0:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        if is_test and impl == "upscale_in_train":
+            out = x
+        return {"Out": out, "Mask": jnp.ones_like(x, dtype=jnp.uint8)}
+    key = ctx.rng_for(op)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return {"Out": out.astype(x.dtype), "Mask": keep.astype(jnp.uint8)}
+
+
+# ---------------------------------------------------------------------------
+# Embedding (reference lookup_table_op.cc; sparse grad becomes dense
+# scatter-add via vjp of take — on TPU a segment-sum, MXU-free)
+# ---------------------------------------------------------------------------
+
+
+@register_op("lookup_table", diff_inputs=("W",))
+def lookup_table(ctx, op, ins):
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    padding_idx = op.attr("padding_idx", -1)
+    sq = ids.shape[-1] == 1
+    idx = jnp.squeeze(ids, -1) if sq and ids.ndim > 1 else ids
+    idx = idx.astype(jnp.int32)
+    out = jnp.take(w, jnp.clip(idx, 0, w.shape[0] - 1), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
+    return {"Out": out}
+
+
+@register_op("lookup_table_v2", diff_inputs=("W",))
+def lookup_table_v2(ctx, op, ins):
+    w = ins["W"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    padding_idx = op.attr("padding_idx", -1)
+    out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return {"Out": out}
+
+
+@register_op("one_hot", grad=None)
+def one_hot(ctx, op, ins):
+    ids = ins["X"][0]
+    depth = op.attr("depth")
+    if ids.shape and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    return {"Out": jax.nn.one_hot(ids.astype(jnp.int32), depth, dtype=jnp.float32)}
+
+
+@register_op("one_hot_v2", grad=None)
+def one_hot_v2(ctx, op, ins):
+    ids = ins["X"][0]
+    depth = op.attr("depth")
+    return {"Out": jax.nn.one_hot(ids.astype(jnp.int32), depth, dtype=jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Interpolation / padding
+# ---------------------------------------------------------------------------
+
+
+@register_op("nearest_interp", diff_inputs=("X",))
+def nearest_interp(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    oh = op.attr("out_h", -1)
+    ow = op.attr("out_w", -1)
+    scale = op.attr("scale", 0.0)
+    if scale and (oh <= 0 or ow <= 0):
+        oh = int(x.shape[2] * scale)
+        ow = int(x.shape[3] * scale)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
+    return {"Out": out}
+
+
+@register_op("bilinear_interp", diff_inputs=("X",))
+def bilinear_interp(ctx, op, ins):
+    x = ins["X"][0]
+    oh = op.attr("out_h", -1)
+    ow = op.attr("out_w", -1)
+    scale = op.attr("scale", 0.0)
+    if scale and (oh <= 0 or ow <= 0):
+        oh = int(x.shape[2] * scale)
+        ow = int(x.shape[3] * scale)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
+    return {"Out": out}
+
+
+@register_op("pad", diff_inputs=("X",))
+def pad(ctx, op, ins):
+    x = ins["X"][0]
+    p = op.attr("paddings")
+    val = op.attr("pad_value", 0.0)
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=val)}
+
+
+@register_op("pad2d", diff_inputs=("X",))
+def pad2d(ctx, op, ins):
+    x = ins["X"][0]
+    p = op.attr("paddings", [0, 0, 0, 0])
+    mode = op.attr("mode", "constant")
+    val = op.attr("pad_value", 0.0)
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pairs, constant_values=val)}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pairs, mode=jmode)}
+
+
+@register_op("clip_by_norm", diff_inputs=("X",))
+def clip_by_norm(ctx, op, ins):
+    x = ins["X"][0]
+    max_norm = op.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": (x.astype(jnp.float32) * scale).astype(x.dtype)}
+
+
+@register_op("unfold", diff_inputs=("X",))
+def unfold(ctx, op, ins):
+    """im2col (reference operators/unfold_op.cc / math/im2col): NCHW ->
+    (N, C*kh*kw, L)."""
+    x = ins["X"][0]
+    ks = op.attr("kernel_sizes")
+    strides = op.attr("strides", [1, 1])
+    if isinstance(strides, int):
+        strides = [strides, strides]
+    pads = op.attr("paddings", [0, 0])
+    if isinstance(pads, int):
+        pads = [pads, pads]
+    dil = op.attr("dilations", [1, 1])
+    if isinstance(dil, int):
+        dil = [dil, dil]
+    n, c, h, w = x.shape
+    p1 = pads[1] if len(pads) > 1 else pads[0]
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(ks), window_strides=tuple(strides),
+        padding=[(pads[0], pads[0]), (p1, p1)],
+        rhs_dilation=tuple(dil),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    # patches: (N, C*kh*kw, OH, OW) -> (N, C*kh*kw, L)
+    return {"Y": patches.reshape(n, patches.shape[1], -1)}

@@ -1,0 +1,295 @@
+"""Optimizer update op lowerings.
+
+Parity with reference paddle/fluid/operators/optimizers/ (45 files: sgd_op,
+momentum_op (+LARS), adam_op, adamax_op, adagrad_op, adadelta_op, rmsprop_op,
+ftrl_op, lamb_op, dpsgd_op, decayed_adagrad_op, proximal_*). Each op updates
+Param/accumulators in place by writing outputs with the same var names —
+the executor donates those buffers so XLA updates them in HBM without copies.
+All math in f32 master form when the param is low-precision.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+def _lr(ins):
+    lr = ins["LearningRate"][0]
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op("sgd", grad=None, is_optimizer=True)
+def sgd(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": p - _lr(ins).astype(p.dtype) * g.astype(p.dtype)}
+
+
+@register_op("momentum", grad=None, is_optimizer=True)
+def momentum(ctx, op, ins):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    mu = op.attr("mu", 0.9)
+    use_nesterov = op.attr("use_nesterov", False)
+    regularization = op.attr("regularization_method", "")
+    coeff = op.attr("regularization_coeff", 0.0)
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    if regularization == "l2_decay":
+        gf = gf + coeff * pf
+    v_new = mu * v.astype(jnp.float32) + gf
+    if use_nesterov:
+        p_new = pf - (gf + mu * v_new) * lr
+    else:
+        p_new = pf - lr * v_new
+    return {"ParamOut": p_new.astype(p.dtype), "VelocityOut": v_new.astype(v.dtype)}
+
+
+@register_op("lars_momentum", grad=None, is_optimizer=True)
+def lars_momentum(ctx, op, ins):
+    """reference optimizers/lars_momentum_op.cc — layer-wise adaptive rate."""
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    mu = op.attr("mu", 0.9)
+    lars_coeff = op.attr("lars_coeff", 0.001)
+    lars_wd = op.attr("lars_weight_decay", 0.0005)
+    eps = op.attr("epsilon", 0.0)
+    pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(pf * pf))
+    g_norm = jnp.sqrt(jnp.sum(gf * gf))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + eps),
+        lr,
+    )
+    v_new = mu * v.astype(jnp.float32) + local_lr * (gf + lars_wd * pf)
+    return {"ParamOut": (pf - v_new).astype(p.dtype), "VelocityOut": v_new.astype(v.dtype)}
+
+
+@register_op("adam", grad=None, is_optimizer=True)
+def adam(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    v_new = b2 * v + (1 - b2) * gf * gf
+    b1p_f = b1p.reshape(()).astype(jnp.float32)
+    b2p_f = b2p.reshape(()).astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1 - b2p_f * b2) / (1 - b1p_f * b1)
+    p_new = p.astype(jnp.float32) - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {
+        "ParamOut": p_new.astype(p.dtype),
+        "Moment1Out": m_new,
+        "Moment2Out": v_new,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("adamw", grad=None, is_optimizer=True)
+def adamw(ctx, op, ins):
+    """Decoupled weight decay (paddle 2.0 AdamW; not in fluid 1.8 op set but
+    part of the capability surface via optimizer.py parity)."""
+    p = ins["Param"][0]
+    coeff = op.attr("coeff", 0.01)
+    lr = _lr(ins).astype(jnp.float32)
+    out = adam(ctx, op, ins)
+    decayed = out["ParamOut"].astype(jnp.float32) - lr * coeff * p.astype(jnp.float32)
+    out["ParamOut"] = decayed.astype(p.dtype)
+    return out
+
+
+@register_op("adamax", grad=None, is_optimizer=True)
+def adamax(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf_norm = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    b1, b2 = op.attr("beta1", 0.9), op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(gf))
+    lr_t = lr / (1 - b1p.reshape(()))
+    p_new = p.astype(jnp.float32) - lr_t * m_new / (inf_new + eps)
+    return {"ParamOut": p_new.astype(p.dtype), "MomentOut": m_new, "InfNormOut": inf_new}
+
+
+@register_op("adagrad", grad=None, is_optimizer=True)
+def adagrad(ctx, op, ins):
+    p, g, moment = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    eps = op.attr("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    m_new = moment + gf * gf
+    p_new = p.astype(jnp.float32) - lr * gf / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new.astype(p.dtype), "MomentOut": m_new}
+
+
+@register_op("decayed_adagrad", grad=None, is_optimizer=True)
+def decayed_adagrad(ctx, op, ins):
+    p, g, moment = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    decay = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    m_new = decay * moment + (1 - decay) * gf * gf
+    p_new = p.astype(jnp.float32) - lr * gf / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new.astype(p.dtype), "MomentOut": m_new}
+
+
+@register_op("adadelta", grad=None, is_optimizer=True)
+def adadelta(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    gf = g.astype(jnp.float32)
+    g_acc = rho * avg_sq_g + (1 - rho) * gf * gf
+    update = -jnp.sqrt((avg_sq_u + eps) / (g_acc + eps)) * gf
+    u_acc = rho * avg_sq_u + (1 - rho) * update * update
+    return {
+        "ParamOut": (p.astype(jnp.float32) + update).astype(p.dtype),
+        "AvgSquaredGradOut": g_acc,
+        "AvgSquaredUpdateOut": u_acc,
+    }
+
+
+@register_op("rmsprop", grad=None, is_optimizer=True)
+def rmsprop(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    eps = op.attr("epsilon", 1e-10)
+    decay = op.attr("decay", 0.9)
+    momentum_c = op.attr("momentum", 0.0)
+    centered = op.attr("centered", False)
+    gf = g.astype(jnp.float32)
+    ms_new = decay * ms + (1 - decay) * gf * gf
+    outs = {}
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_new = decay * mg + (1 - decay) * gf
+        denom = jnp.sqrt(ms_new - mg_new * mg_new + eps)
+        outs["MeanGradOut"] = mg_new
+    else:
+        denom = jnp.sqrt(ms_new + eps)
+    mom_new = momentum_c * mom + lr * gf / denom
+    outs.update({
+        "ParamOut": (p.astype(jnp.float32) - mom_new).astype(p.dtype),
+        "MeanSquareOut": ms_new,
+        "MomentOut": mom_new,
+    })
+    return outs
+
+
+@register_op("ftrl", grad=None, is_optimizer=True)
+def ftrl(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq_acc, lin_acc = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    lr_power = op.attr("lr_power", -0.5)
+    gf = g.astype(jnp.float32)
+    new_sq = sq_acc + gf * gf
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq_acc)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq_acc, -lr_power)) / lr
+    new_lin = lin_acc + gf - sigma * p.astype(jnp.float32)
+    if lr_power == -0.5:
+        x = -new_lin
+        y = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        x = -new_lin
+        y = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1)
+    p_new = jnp.where(jnp.abs(new_lin) > l1, (pre - new_lin) / y, jnp.zeros_like(x))
+    return {
+        "ParamOut": p_new.astype(p.dtype),
+        "SquaredAccumOut": new_sq,
+        "LinearAccumOut": new_lin,
+    }
+
+
+@register_op("lamb", grad=None, is_optimizer=True)
+def lamb(ctx, op, ins):
+    """reference optimizers/lamb_op.cc — layer-adaptive large-batch Adam."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    b1, b2 = op.attr("beta1", 0.9), op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-6)
+    wd = op.attr("weight_decay", 0.01)
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    v_new = b2 * v + (1 - b2) * gf * gf
+    m_hat = m_new / (1 - b1p.reshape(()).astype(jnp.float32) * b1)
+    v_hat = v_new / (1 - b2p.reshape(()).astype(jnp.float32) * b2)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * pf
+    p_norm = jnp.sqrt(jnp.sum(pf * pf))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_new = pf - lr * ratio * r
+    return {
+        "ParamOut": p_new.astype(p.dtype),
+        "Moment1Out": m_new,
+        "Moment2Out": v_new,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("dpsgd", grad=None, is_optimizer=True, needs_rng=True)
+def dpsgd(ctx, op, ins):
+    """reference optimizers/dpsgd_op.cc — differentially-private SGD."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    clip = op.attr("clip", 10.0)
+    batch_size = op.attr("batch_size", 16.0)
+    sigma = op.attr("sigma", 1.0)
+    gf = g.astype(jnp.float32)
+    g_norm = jnp.sqrt(jnp.sum(gf * gf))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(g_norm, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.rng_for(op), gf.shape)
+    g_priv = (gf * scale + noise) / batch_size
+    return {"ParamOut": (p.astype(jnp.float32) - lr * g_priv).astype(p.dtype)}
+
+
+@register_op("proximal_gd", grad=None, is_optimizer=True)
+def proximal_gd(ctx, op, ins):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins).astype(jnp.float32)
+    l1, l2 = op.attr("l1", 0.0), op.attr("l2", 0.0)
+    prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": p_new.astype(p.dtype)}
+
+
+@register_op("ema_update", grad=None, is_optimizer=True)
+def ema_update(ctx, op, ins):
+    p, ema = ins["Param"][0], ins["Ema"][0]
+    decay = op.attr("decay", 0.999)
+    return {"EmaOut": decay * ema + (1.0 - decay) * p.astype(ema.dtype)}
+
+
+@register_op("lookahead_update", grad=None, is_optimizer=True)
+def lookahead_update(ctx, op, ins):
+    p, slow = ins["Param"][0], ins["Slow"][0]
+    step = ins["Step"][0].reshape(())
+    alpha = op.attr("alpha", 0.5)
+    k = op.attr("k", 5)
+    sync = (step % k) == 0
+    new_slow = jnp.where(sync, slow + alpha * (p.astype(slow.dtype) - slow), slow)
+    new_p = jnp.where(sync, new_slow.astype(p.dtype), p)
+    return {"ParamOut": new_p, "SlowOut": new_slow}

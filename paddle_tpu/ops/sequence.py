@@ -1,0 +1,132 @@
+"""Sequence ops on padded dense tensors + length masks.
+
+The reference's LoD (level-of-detail) ragged tensors (lod_tensor.h:104) and
+operators/sequence_ops/* assume variable-length rows packed contiguously.
+XLA requires static shapes, so the TPU-native representation is
+(batch, max_len, ...) padding + an explicit Length tensor — the standard TPU
+idiom. These ops cover the capability of seq_pool/seq_softmax/seq_expand/
+sequence_mask et al. on that representation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import dtype_to_jax
+from ..framework.registry import register_op
+
+
+@register_op("sequence_mask", grad=None)
+def sequence_mask(ctx, op, ins):
+    x = ins["X"][0]  # lengths
+    maxlen = op.attr("maxlen", -1)
+    if "MaxLenTensor" in ins and ins["MaxLenTensor"]:
+        maxlen = int(np.asarray(ins["MaxLenTensor"][0]))
+    if maxlen < 0:
+        raise ValueError("sequence_mask on TPU requires static maxlen")
+    dtype = dtype_to_jax(op.attr("out_dtype", "int64"))
+    rng = jnp.arange(maxlen)
+    mask = rng[None, :] < x[:, None].astype(jnp.int32)
+    return {"Y": mask.astype(dtype)}
+
+
+@register_op("sequence_pool", diff_inputs=("X",))
+def sequence_pool(ctx, op, ins):
+    """X: (B, T, D) padded; Length optional (B,). pooltype SUM/AVERAGE/MAX/
+    SQRT/LAST/FIRST (reference operators/sequence_ops/sequence_pool_op)."""
+    x = ins["X"][0]
+    ptype = op.attr("pooltype", "SUM").upper()
+    if "Length" in ins and ins["Length"]:
+        ln = ins["Length"][0].astype(jnp.int32)
+        mask = (jnp.arange(x.shape[1])[None, :] < ln[:, None]).astype(x.dtype)
+        xm = x * mask[..., None]
+        denom = jnp.maximum(ln.astype(x.dtype), 1)[:, None]
+    else:
+        ln = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+        mask = jnp.ones(x.shape[:2], x.dtype)
+        xm = x
+        denom = jnp.asarray(float(x.shape[1]), x.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(xm, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(xm, axis=1) / denom
+    elif ptype == "SQRT":
+        out = jnp.sum(xm, axis=1) / jnp.sqrt(denom)
+    elif ptype == "MAX":
+        neg = jnp.where(mask[..., None] > 0, x, -jnp.inf)
+        out = jnp.max(neg, axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(ln - 1, 0)
+        out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(ptype)
+    return {"Out": out, "MaxIndex": None}
+
+
+@register_op("sequence_softmax", diff_inputs=("X",))
+def sequence_softmax(ctx, op, ins):
+    x = ins["X"][0]  # (B, T)
+    if "Length" in ins and ins["Length"]:
+        ln = ins["Length"][0].astype(jnp.int32)
+        mask = jnp.arange(x.shape[1])[None, :] < ln[:, None]
+        masked = jnp.where(mask, x, -jnp.inf)
+        return {"Out": jax.nn.softmax(masked, axis=1)}
+    return {"Out": jax.nn.softmax(x, axis=1)}
+
+
+@register_op("sequence_expand", diff_inputs=("X",))
+def sequence_expand(ctx, op, ins):
+    # padded-dense capability version: broadcast X (B, D) to Y's time dim
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == y.ndim:
+        return {"Out": jnp.broadcast_to(x, y.shape)}
+    return {"Out": jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])}
+
+
+@register_op("sequence_reverse", diff_inputs=("X",))
+def sequence_reverse(ctx, op, ins):
+    x = ins["X"][0]
+    if "Length" in ins and ins["Length"]:
+        ln = ins["Length"][0].astype(jnp.int32)
+        t = x.shape[1]
+        idx = jnp.arange(t)[None, :]
+        rev_idx = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)
+        return {"Y": jnp.take_along_axis(x, rev_idx[..., None].astype(jnp.int32)
+                                          if x.ndim == 3 else rev_idx.astype(jnp.int32), axis=1)}
+    return {"Y": jnp.flip(x, axis=1)}
+
+
+@register_op("sequence_concat", diff_inputs=("X",))
+def sequence_concat(ctx, op, ins):
+    return {"Out": jnp.concatenate(ins["X"], axis=1)}
+
+
+@register_op("sequence_pad", diff_inputs=("X",))
+def sequence_pad(ctx, op, ins):
+    # dense representation: already padded; passthrough + lengths
+    x = ins["X"][0]
+    return {"Out": x, "Length": jnp.full((x.shape[0],), x.shape[1], jnp.int64)}
+
+
+@register_op("sequence_unpad", diff_inputs=("X",))
+def sequence_unpad(ctx, op, ins):
+    return {"Out": ins["X"][0]}
+
+
+@register_op("im2sequence", diff_inputs=("X",))
+def im2sequence(ctx, op, ins):
+    x = ins["X"][0]  # NCHW
+    ks = op.attr("kernels")
+    strides = op.attr("strides", [1, 1])
+    pads = op.attr("paddings", [0, 0, 0, 0])
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(ks), window_strides=tuple(strides),
+        padding=[(pads[0], pads[2]), (pads[1], pads[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    n, ckk, oh, ow = patches.shape
+    return {"Out": patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)}

@@ -1,0 +1,426 @@
+"""Tensor-manipulation + random op lowerings.
+
+Parity with reference operators/{reshape,transpose,concat,split,stack,slice,
+gather,scatter,expand,squeeze,unsqueeze,flatten,where,cumsum,range,
+gaussian_random,uniform_random,truncated_gaussian_random}_op.* — each lowers
+to a jnp/lax expression; layout changes are free for XLA to fold.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import dtype_to_jax
+from ..framework.registry import register_op
+
+
+def _infer_reshape(block, op):
+    x = block._var_recursive(op.input("X")[0])
+    shape = list(op.attr("shape", []))
+    # resolve 0 (copy dim) and -1 (infer)
+    out_shape = []
+    for i, d in enumerate(shape):
+        if d == 0:
+            out_shape.append(x.shape[i] if i < len(x.shape) else -1)
+        else:
+            out_shape.append(d)
+    if -1 in out_shape and all(d != -1 for d in x.shape):
+        known = int(np.prod([d for d in out_shape if d != -1]))
+        total = int(np.prod(x.shape))
+        out_shape[out_shape.index(-1)] = total // known
+    for name in op.output("Out"):
+        v = block._var_recursive(name)
+        v.shape = tuple(out_shape)
+        v.dtype = x.dtype
+
+
+@register_op("reshape2", diff_inputs=("X",), infer_shape=_infer_reshape)
+def reshape2(ctx, op, ins):
+    x = ins["X"][0]
+    shape = list(op.attr("shape", []))
+    if "Shape" in ins and ins["Shape"]:
+        shape = [int(s) for s in np.asarray(ins["Shape"][0])]
+    shape = [x.shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    return {"Out": jnp.reshape(x, shape), "XShape": None}
+
+
+register_op("reshape", diff_inputs=("X",), infer_shape=_infer_reshape)(
+    lambda ctx, op, ins: {"Out": jnp.reshape(
+        ins["X"][0],
+        [ins["X"][0].shape[i] if d == 0 else d for i, d in enumerate(op.attr("shape", []))],
+    )}
+)
+
+
+@register_op("transpose2", diff_inputs=("X",))
+def transpose2(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": jnp.transpose(x, op.attr("axis")), "XShape": None}
+
+
+register_op("transpose", diff_inputs=("X",))(
+    lambda ctx, op, ins: {"Out": jnp.transpose(ins["X"][0], op.attr("axis"))}
+)
+
+
+@register_op("concat", diff_inputs=("X",))
+def concat(ctx, op, ins):
+    axis = op.attr("axis", 0)
+    if "AxisTensor" in ins and ins["AxisTensor"]:
+        axis = int(np.asarray(ins["AxisTensor"][0]))
+    return {"Out": jnp.concatenate(ins["X"], axis=axis)}
+
+
+@register_op("split", diff_inputs=("X",))
+def split(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", 0)
+    num = op.attr("num", 0)
+    sections = op.attr("sections", [])
+    if num:
+        parts = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1].tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    return {"Out": parts}
+
+
+@register_op("stack", diff_inputs=("X",))
+def stack(ctx, op, ins):
+    return {"Y": jnp.stack(ins["X"], axis=op.attr("axis", 0))}
+
+
+@register_op("unstack", diff_inputs=("X",))
+def unstack(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", 0)
+    num = x.shape[axis]
+    parts = [jnp.squeeze(p, axis) for p in jnp.split(x, num, axis=axis)]
+    return {"Y": parts}
+
+
+def _infer_squeeze(block, op):
+    x = block._var_recursive(op.input("X")[0])
+    axes = op.attr("axes", [])
+    if axes:
+        shape = [d for i, d in enumerate(x.shape) if i not in [a % len(x.shape) for a in axes]]
+    else:
+        shape = [d for d in x.shape if d != 1]
+    for name in op.output("Out"):
+        v = block._var_recursive(name)
+        v.shape = tuple(shape)
+        v.dtype = x.dtype
+
+
+@register_op("squeeze2", diff_inputs=("X",), infer_shape=_infer_squeeze)
+def squeeze2(ctx, op, ins):
+    x = ins["X"][0]
+    axes = op.attr("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes)
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+    else:
+        axes = tuple(i for i, d in enumerate(x.shape) if d == 1)
+    return {"Out": jnp.squeeze(x, axes), "XShape": None}
+
+
+register_op("squeeze", diff_inputs=("X",), infer_shape=_infer_squeeze)(
+    lambda ctx, op, ins: squeeze2(ctx, op, ins)
+)
+
+
+def _infer_unsqueeze(block, op):
+    x = block._var_recursive(op.input("X")[0])
+    axes = op.attr("axes", [])
+    shape = list(x.shape)
+    for a in sorted(axes):
+        shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+    for name in op.output("Out"):
+        v = block._var_recursive(name)
+        v.shape = tuple(shape)
+        v.dtype = x.dtype
+
+
+@register_op("unsqueeze2", diff_inputs=("X",), infer_shape=_infer_unsqueeze)
+def unsqueeze2(ctx, op, ins):
+    x = ins["X"][0]
+    for a in sorted(op.attr("axes", [])):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x, "XShape": None}
+
+
+register_op("unsqueeze", diff_inputs=("X",), infer_shape=_infer_unsqueeze)(
+    lambda ctx, op, ins: unsqueeze2(ctx, op, ins)
+)
+
+
+def _infer_flatten(block, op):
+    x = block._var_recursive(op.input("X")[0])
+    axis = op.attr("axis", 1)
+    lead = x.shape[:axis]
+    tail = x.shape[axis:]
+    lead_prod = -1 if any(d == -1 for d in lead) else int(np.prod(lead)) if lead else 1
+    tail_prod = -1 if any(d == -1 for d in tail) else int(np.prod(tail)) if tail else 1
+    for name in op.output("Out"):
+        v = block._var_recursive(name)
+        v.shape = (lead_prod, tail_prod)
+        v.dtype = x.dtype
+
+
+@register_op("flatten2", diff_inputs=("X",), infer_shape=_infer_flatten)
+def flatten2(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return {"Out": jnp.reshape(x, (lead, -1)), "XShape": None}
+
+
+register_op("flatten", diff_inputs=("X",), infer_shape=_infer_flatten)(
+    lambda ctx, op, ins: flatten2(ctx, op, ins)
+)
+
+
+@register_op("flatten_contiguous_range", diff_inputs=("X",))
+def flatten_contiguous_range(ctx, op, ins):
+    x = ins["X"][0]
+    start = op.attr("start_axis", 1)
+    stop = op.attr("stop_axis", -1)
+    if stop < 0:
+        stop += x.ndim
+    shape = x.shape[:start] + (int(np.prod(x.shape[start : stop + 1])),) + x.shape[stop + 1 :]
+    return {"Out": jnp.reshape(x, shape), "XShape": None}
+
+
+@register_op("slice", diff_inputs=("Input",))
+def slice_op(ctx, op, ins):
+    x = ins["Input"][0]
+    axes = op.attr("axes")
+    starts = op.attr("starts")
+    ends = op.attr("ends")
+    decrease = op.attr("decrease_axis", [])
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        idx[ax] = slice(st, en)
+    out = x[tuple(idx)]
+    if decrease:
+        out = jnp.squeeze(out, axis=tuple(decrease))
+    return {"Out": out}
+
+
+@register_op("strided_slice", diff_inputs=("Input",))
+def strided_slice(ctx, op, ins):
+    x = ins["Input"][0]
+    axes = op.attr("axes")
+    starts, ends, strides = op.attr("starts"), op.attr("ends"), op.attr("strides")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("gather", diff_inputs=("X",))
+def gather(ctx, op, ins):
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = jnp.squeeze(idx, 1)
+    return {"Out": jnp.take(x, idx, axis=op.attr("axis", 0) or 0)}
+
+
+@register_op("gather_nd", diff_inputs=("X",))
+def gather_nd(ctx, op, ins):
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32)
+    nd = idx.shape[-1]
+    return {"Out": x[tuple(jnp.moveaxis(idx, -1, 0))] if nd == x.ndim else
+            x[tuple(jnp.moveaxis(idx, -1, 0)[i] for i in range(nd))]}
+
+
+@register_op("scatter", diff_inputs=("X", "Updates"))
+def scatter(ctx, op, ins):
+    x = ins["X"][0]
+    idx = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = jnp.squeeze(idx, 1)
+    if op.attr("overwrite", True):
+        return {"Out": x.at[idx].set(upd)}
+    return {"Out": x.at[idx].add(upd)}
+
+
+@register_op("scatter_nd_add", diff_inputs=("X", "Updates"))
+def scatter_nd_add(ctx, op, ins):
+    x, idx, upd = ins["X"][0], ins["Index"][0].astype(jnp.int32), ins["Updates"][0]
+    nd = idx.shape[-1]
+    index_tuple = tuple(jnp.moveaxis(idx, -1, 0)[i] for i in range(nd))
+    return {"Out": x.at[index_tuple].add(upd)}
+
+
+@register_op("expand", diff_inputs=("X",))
+def expand(ctx, op, ins):
+    x = ins["X"][0]
+    times = op.attr("expand_times")
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("expand_as", diff_inputs=("X",))
+def expand_as(ctx, op, ins):
+    x, target = ins["X"][0], ins["target_tensor"][0]
+    reps = [t // s for t, s in zip(target.shape, x.shape)]
+    return {"Out": jnp.tile(x, reps)}
+
+
+@register_op("expand_v2", diff_inputs=("X",))
+def expand_v2(ctx, op, ins):
+    x = ins["X"][0]
+    shape = op.attr("shape")
+    shape = [x.shape[i] if d == -1 else d for i, d in enumerate(shape)]
+    return {"Out": jnp.broadcast_to(x, shape)}
+
+
+@register_op("tile", diff_inputs=("X",))
+def tile(ctx, op, ins):
+    return {"Out": jnp.tile(ins["X"][0], op.attr("repeat_times"))}
+
+
+@register_op("where", diff_inputs=("X", "Y"))
+def where(ctx, op, ins):
+    return {"Out": jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])}
+
+
+@register_op("where_index", grad=None)
+def where_index(ctx, op, ins):
+    # dynamic-shape op: returns indices of nonzero — static upper bound needed
+    # on TPU; provided for CPU/host use (inference utilities).
+    cond = ins["Condition"][0]
+    return {"Out": jnp.stack(jnp.nonzero(cond, size=int(np.prod(cond.shape))), axis=1).astype(jnp.int64)}
+
+
+@register_op("cumsum", diff_inputs=("X",))
+def cumsum(ctx, op, ins):
+    x = ins["X"][0]
+    axis = op.attr("axis", -1)
+    if op.attr("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if op.attr("exclusive", False):
+        pad_width = [(0, 0)] * x.ndim
+        pad_width[axis] = (1, 0)
+        out = jnp.pad(out, pad_width)[
+            tuple(slice(0, -1) if i == axis % x.ndim else slice(None) for i in range(x.ndim))
+        ]
+    if op.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    return {"Out": out}
+
+
+@register_op("range", grad=None)
+def range_op(ctx, op, ins):
+    start = np.asarray(ins["Start"][0]).item()
+    end = np.asarray(ins["End"][0]).item()
+    step = np.asarray(ins["Step"][0]).item()
+    return {"Out": jnp.arange(start, end, step)}
+
+
+@register_op("linspace", grad=None)
+def linspace(ctx, op, ins):
+    start = np.asarray(ins["Start"][0]).item()
+    stop = np.asarray(ins["Stop"][0]).item()
+    num = int(np.asarray(ins["Num"][0]).item())
+    return {"Out": jnp.linspace(start, stop, num, dtype=dtype_to_jax(op.attr("dtype", "float32")))}
+
+
+@register_op("flip", diff_inputs=("X",))
+def flip(ctx, op, ins):
+    return {"Out": jnp.flip(ins["X"][0], axis=tuple(op.attr("axis")))}
+
+
+@register_op("roll", diff_inputs=("X",))
+def roll(ctx, op, ins):
+    return {"Out": jnp.roll(ins["X"][0], op.attr("shifts"), axis=tuple(op.attr("axis")))}
+
+
+@register_op("tril_triu", diff_inputs=("X",))
+def tril_triu(ctx, op, ins):
+    x = ins["X"][0]
+    diag = op.attr("diagonal", 0)
+    if op.attr("lower", True):
+        return {"Out": jnp.tril(x, diag)}
+    return {"Out": jnp.triu(x, diag)}
+
+
+@register_op("unique", grad=None)
+def unique(ctx, op, ins):
+    # host-side / CPU utility op (dynamic output shape); TPU programs should
+    # not contain it inside jit regions.
+    x = ins["X"][0]
+    out, idx = np.unique(np.asarray(x), return_inverse=True)
+    return {"Out": jnp.asarray(out), "Index": jnp.asarray(idx.astype(np.int32))}
+
+
+# ---------------------------------------------------------------------------
+# Random ops — deterministic keys from output names (see registry.rng_for)
+# (reference gaussian_random_op.cc, uniform_random_op.cc use curand/seed attr)
+# ---------------------------------------------------------------------------
+
+
+@register_op("gaussian_random", grad=None, needs_rng=True)
+def gaussian_random(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape")]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    seed = op.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng_for(op)
+    return {"Out": (mean + std * jax.random.normal(key, shape)).astype(dtype)}
+
+
+@register_op("uniform_random", grad=None, needs_rng=True)
+def uniform_random(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape")]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    lo, hi = op.attr("min", -1.0), op.attr("max", 1.0)
+    seed = op.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng_for(op)
+    return {"Out": jax.random.uniform(key, shape, minval=lo, maxval=hi).astype(dtype)}
+
+
+@register_op("truncated_gaussian_random", grad=None, needs_rng=True)
+def truncated_gaussian_random(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape")]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    seed = op.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng_for(op)
+    out = jax.random.truncated_normal(key, -2.0, 2.0, shape) * std + mean
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("randint", grad=None, needs_rng=True)
+def randint(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape")]
+    key = ctx.rng_for(op)
+    return {"Out": jax.random.randint(key, shape, op.attr("low", 0), op.attr("high", 100)).astype(
+        dtype_to_jax(op.attr("dtype", "int64")))}
+
+
+@register_op("randperm", grad=None, needs_rng=True)
+def randperm(ctx, op, ins):
+    n = op.attr("n")
+    key = ctx.rng_for(op)
+    return {"Out": jax.random.permutation(key, n).astype(dtype_to_jax(op.attr("dtype", "int64")))}
+
+
+@register_op("assign_value", grad=None)
+def assign_value(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape")]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    values = np.asarray(op.attr("values"), dtype=np.float64)
+    return {"Out": jnp.asarray(values.reshape(shape)).astype(dtype)}

@@ -1,0 +1,648 @@
+"""Optimizer classes — parity with python/paddle/fluid/optimizer.py (4,304 LoC,
+19 optimizers: SGD:842, Momentum:936, LarsMomentum:1486, Adagrad:1600, Adam:1716,
+Adamax:1982, Dpsgd:2154, DecayedAdagrad:2249, Adadelta:2359, RMSProp:2478,
+Ftrl:2666, Lamb:2825, ModelAverage:2997, EMA:3306, Pipeline:3556,
+Recompute:3858, Lookahead:4150).
+
+minimize() = append_backward (IR autodiff) + regularization + grad clip +
+per-param optimizer update ops. The whole thing compiles into ONE XLA program
+with the forward/backward — the reference's fuse_optimizer_ops_pass is
+subsumed by XLA fusion.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.initializer import ConstantInitializer
+from .framework.layer_helper import LayerHelper
+from .framework.program import (
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+    "LarsMomentum", "LarsMomentumOptimizer", "Adagrad", "AdagradOptimizer",
+    "DecayedAdagrad", "DecayedAdagradOptimizer", "Adadelta", "AdadeltaOptimizer",
+    "Adam", "AdamOptimizer", "AdamW", "Adamax", "AdamaxOptimizer", "Dpsgd",
+    "DpsgdOptimizer", "RMSProp", "RMSPropOptimizer", "Ftrl", "FtrlOptimizer",
+    "Lamb", "LambOptimizer", "ExponentialMovingAverage", "ModelAverage",
+    "RecomputeOptimizer", "LookaheadOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var: Optional[Variable] = None
+        self.type = "optimizer"
+
+    # -- learning rate ------------------------------------------------------
+    def _create_lr_var(self, program: Program) -> Variable:
+        if isinstance(self._learning_rate, Variable):
+            return self._learning_rate
+        if self._lr_var is not None and self._lr_var.block.program is program:
+            return self._lr_var
+        from .layers.tensor import create_global_var
+
+        name = unique_name.generate("learning_rate")
+        self._lr_var = create_global_var(
+            shape=[1], value=float(self._learning_rate), dtype="float32",
+            persistable=True, name=name,
+        )
+        return self._lr_var
+
+    @property
+    def learning_rate_var(self):
+        return self._lr_var
+
+    def current_step_lr(self):
+        return self._learning_rate
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Parameter, fill_value=0.0,
+                         shape=None, dtype="float32") -> Variable:
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        acc_name = unique_name.generate(f"{param.name}_{name}")
+        shape = list(shape if shape is not None else param.shape)
+        main_block = default_main_program().global_block()
+        var = main_block.create_var(
+            name=acc_name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True,
+        )
+        startup_block = default_startup_program().global_block()
+        sv = startup_block.create_var(
+            name=acc_name, shape=shape, dtype=dtype, persistable=True
+        )
+        ConstantInitializer(fill_value)(sv, startup_block)
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    # -- main entry ---------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        # grad clip first (reference fluid/clip.py appends clip ops), then
+        # regularization (weight decay appended onto grads).
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        params_grads = self._append_regularization_ops(params_grads)
+        program = default_main_program()
+        lr = self._create_lr_var(program)
+        ops = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            ops.append(self._append_optimize_op(program.global_block(), (p, g), lr))
+        self._finish_update(program.global_block(), params_grads)
+        return ops
+
+    def _append_regularization_ops(self, params_grads):
+        from .regularizer import append_regularization_ops
+
+        return append_regularization_ops(params_grads, self.regularization)
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def _param_lr(self, param: Parameter, lr_var):
+        """Per-param learning-rate multiplier (ParamAttr.learning_rate)."""
+        mult = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return lr_var
+        from .layers.tensor import scale as scale_layer
+
+        return scale_layer(lr_var, scale=float(mult))
+
+
+class SGDOptimizer(Optimizer):
+    """fluid.optimizer.SGD (optimizer.py:842)."""
+
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    """fluid.optimizer.Momentum (optimizer.py:936)."""
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        velocity = self._add_accumulator("velocity", p)
+        return block.append_op(
+            type="momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [velocity],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """fluid.optimizer.LarsMomentum (optimizer.py:1486)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, grad_clip=None,
+                 name=None, epsilon=0.0):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        velocity = self._add_accumulator("velocity", p)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [velocity],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay,
+                   "epsilon": self._epsilon},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 grad_clip=None, name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        moment = self._add_accumulator("moment", p, fill_value=self._initial)
+        return block.append_op(
+            type="adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [moment],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "decayed_adagrad"
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        moment = self._add_accumulator("moment", p)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [moment],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "MomentOut": [moment]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "adadelta"
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        g_acc = self._add_accumulator("_avg_squared_grad", p)
+        u_acc = self._add_accumulator("_avg_squared_update", p)
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [g_acc],
+                    "AvgSquaredUpdate": [u_acc]},
+            outputs={"ParamOut": [p], "AvgSquaredGradOut": [g_acc],
+                     "AvgSquaredUpdateOut": [u_acc]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    """fluid.optimizer.Adam (optimizer.py:1716)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 regularization=None, grad_clip=None, name=None, lazy_mode=False):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "adam"
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, fill_value=1.0, shape=[1])
+        b2p = self._add_accumulator("beta2_pow_acc", p, fill_value=1.0, shape=[1])
+        return block.append_op(
+            type=self.type,
+            inputs={"Param": [p], "Grad": [g], "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs=self._op_attrs(),
+        )
+
+    def _op_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon}
+
+
+class AdamW(AdamOptimizer):
+    """Decoupled weight decay Adam (paddle.optimizer.AdamW surface)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 weight_decay=0.01, regularization=None, grad_clip=None, name=None,
+                 apply_decay_param_fun=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
+                         grad_clip, name)
+        self.type = "adamw"
+        self._coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            saved, self.type = self.type, "adam"
+            try:
+                return super()._append_optimize_op(block, param_and_grad, lr_var)
+            finally:
+                self.type = saved
+        return super()._append_optimize_op(block, param_and_grad, lr_var)
+
+    def _op_attrs(self):
+        attrs = super()._op_attrs()
+        if self.type == "adamw":
+            attrs["coeff"] = self._coeff
+        return attrs
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        moment = self._add_accumulator("moment", p)
+        inf_norm = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                    shape=[1])
+        return block.append_op(
+            type="adamax",
+            inputs={"Param": [p], "Grad": [g], "Moment": [moment],
+                    "InfNorm": [inf_norm], "Beta1Pow": [b1p],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "MomentOut": [moment],
+                     "InfNormOut": [inf_norm]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self, block, params_grads):
+        for p, g in params_grads:
+            if g is None:
+                continue
+            b1p = self._accumulators["beta1_pow_acc"][p.name]
+            block.append_op(
+                type="scale",
+                inputs={"X": [b1p]},
+                outputs={"Out": [b1p]},
+                attrs={"scale": self._beta1},
+            )
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "dpsgd"
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        return block.append_op(
+            type="dpsgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "rmsprop"
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        ms = self._add_accumulator("mean_square", p)
+        mom = self._add_accumulator("momentum", p)
+        inputs = {"Param": [p], "Grad": [g], "MeanSquare": [ms], "Moment": [mom],
+                  "LearningRate": [self._param_lr(p, lr_var)]}
+        outputs = {"ParamOut": [p], "MeanSquareOut": [ms], "MomentOut": [mom]}
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p)
+            inputs["MeanGrad"] = [mg]
+            outputs["MeanGradOut"] = [mg]
+        return block.append_op(
+            type="rmsprop", inputs=inputs, outputs=outputs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        sq = self._add_accumulator("squared", p)
+        lin = self._add_accumulator("linear", p)
+        return block.append_op(
+            type="ftrl",
+            inputs={"Param": [p], "Grad": [g], "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    """fluid.optimizer.Lamb (optimizer.py:2825)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, regularization=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
+                         grad_clip, name)
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _op_attrs(self):
+        attrs = super()._op_attrs()
+        attrs["weight_decay"] = self._weight_decay
+        return attrs
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            saved = self._weight_decay
+            self._weight_decay = 0.0
+            try:
+                return super()._append_optimize_op(block, param_and_grad, lr_var)
+            finally:
+                self._weight_decay = saved
+        return super()._append_optimize_op(block, param_and_grad, lr_var)
+
+
+class ExponentialMovingAverage:
+    """fluid.optimizer.ExponentialMovingAverage (optimizer.py:3306).
+
+    Maintains EMA shadow vars updated after each optimizer step; apply()/
+    restore() swap params for evaluation.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars: Dict[str, Variable] = {}
+        self._params: List[Parameter] = []
+
+    def update(self):
+        block = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            ema_name = self._name + p.name + ".ema"
+            ema = block.create_var(name=ema_name, shape=p.shape, dtype=p.dtype,
+                                   persistable=True, stop_gradient=True)
+            sv = startup.create_var(name=ema_name, shape=p.shape, dtype=p.dtype,
+                                    persistable=True)
+            ConstantInitializer(0.0)(sv, startup)
+            self._ema_vars[p.name] = ema
+            self._params.append(p)
+            # ema = decay*ema + (1-decay)*param
+            block.append_op(
+                type="ema_update",
+                inputs={"Param": [p], "Ema": [ema]},
+                outputs={"EmaOut": [ema]},
+                attrs={"decay": self._decay},
+            )
+
+    def apply(self, executor, need_restore=True):
+        import numpy as _np
+
+        from .framework.executor import global_scope
+
+        scope = global_scope()
+        self._backup = {}
+        for p in self._params:
+            self._backup[p.name] = scope.find_var(p.name)
+            ema = scope.find_var(self._ema_vars[p.name].name)
+            if ema is not None:
+                scope.set_var(p.name, ema)
+        return _EMAGuard(self, executor, need_restore)
+
+    def restore(self, executor=None):
+        from .framework.executor import global_scope
+
+        scope = global_scope()
+        for name, val in getattr(self, "_backup", {}).items():
+            scope.set_var(name, val)
+
+
+class _EMAGuard:
+    def __init__(self, ema, executor, need_restore):
+        self._ema, self._executor, self._need_restore = ema, executor, need_restore
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._need_restore:
+            self._ema.restore(self._executor)
+
+
+class ModelAverage(Optimizer):
+    """fluid.optimizer.ModelAverage (optimizer.py:2997) — simplified EMA-style
+    parameter averaging over a sliding window."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, None, name)
+        self._ema = ExponentialMovingAverage(decay=1.0 - average_window_rate)
+
+    def update(self):
+        self._ema.update()
+
+    def apply(self, executor, need_restore=True):
+        return self._ema.apply(executor, need_restore)
+
+    def restore(self, executor=None):
+        self._ema.restore(executor)
+
+
+class RecomputeOptimizer(Optimizer):
+    """fluid.optimizer.Recompute (optimizer.py:3858): wraps an inner optimizer;
+    checkpoints mark recompute segments. On TPU, segments lower under
+    jax.checkpoint (remat) — recorded via program annotations consumed by the
+    executor lowering."""
+
+    def __init__(self, optimizer: Optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks,
+                               checkpoints=self._checkpoints)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        return self.apply_optimize(loss, startup_program, params_grads), params_grads
+
+
+class LookaheadOptimizer:
+    """fluid.optimizer.LookaheadOptimizer (optimizer.py:4150): fast/slow weights."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        ops, params_grads = self.inner_optimizer.minimize(loss, startup_program)
+        block = default_main_program().global_block()
+        startup = default_startup_program().global_block()
+        # slow param copies + periodic interpolation via lookahead_update op
+        step = _get_or_create_global_step()
+        for p, g in params_grads:
+            slow_name = p.name + "@SLOW"
+            slow = block.create_var(name=slow_name, shape=p.shape, dtype=p.dtype,
+                                    persistable=True, stop_gradient=True)
+            sv = startup.create_var(name=slow_name, shape=p.shape, dtype=p.dtype,
+                                    persistable=True)
+            # initialize slow weights to the initial fast weights
+            startup.append_op(type="assign", inputs={"X": [p.name]},
+                              outputs={"Out": [slow_name]})
+            block.append_op(
+                type="lookahead_update",
+                inputs={"Param": [p], "Slow": [slow], "Step": [step]},
+                outputs={"ParamOut": [p], "SlowOut": [slow]},
+                attrs={"alpha": self.alpha, "k": self.k},
+            )
+        return ops, params_grads
+
+
+def _get_or_create_global_step() -> Variable:
+    """Persistable int64 step counter incremented once per run."""
+    main = default_main_program()
+    block = main.global_block()
+    name = "@LR_DECAY_COUNTER@"
+    if block.has_var(name):
+        return block.var(name)
+    var = block.create_var(name=name, shape=[1], dtype="int64", persistable=True,
+                           stop_gradient=True)
+    startup = default_startup_program().global_block()
+    sv = startup.create_var(name=name, shape=[1], dtype="int64", persistable=True)
+    ConstantInitializer(0.0)(sv, startup)
+    block._prepend_op(
+        type="increment", inputs={"X": [var]}, outputs={"Out": [var]},
+        attrs={"step": 1.0},
+    )
+    return var
+
+
+# Short aliases matching paddle 2.0-preview naming
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Dpsgd = DpsgdOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer

@@ -1,0 +1,89 @@
+"""Profiler — parity with python/paddle/fluid/profiler.py
+(start_profiler/stop_profiler/profiler context, reset_profiler).
+
+The reference has a host event profiler + CUPTI device tracer serialized to
+profiler.proto with chrome-trace export (tools/timeline.py). Here the device
+side is jax.profiler (XPlane, viewable in TensorBoard/Perfetto) and the host
+side is a lightweight event recorder with chrome-trace export
+(utils/timeline.py)."""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import List, Optional
+
+import jax
+
+_events: List[dict] = []
+_active = False
+_trace_dir: Optional[str] = None
+
+
+class RecordEvent:
+    """RAII op-level host event — parity with platform::RecordEvent."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if _active:
+            _events.append({
+                "name": self.name,
+                "ph": "X",
+                "ts": self.t0 / 1000.0,
+                "dur": (time.perf_counter_ns() - self.t0) / 1000.0,
+                "pid": os.getpid(),
+                "tid": 0,
+            })
+
+
+record_event = RecordEvent
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    global _active, _trace_dir
+    _active = True
+    _events.clear()
+    _trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+    try:
+        jax.profiler.start_trace(_trace_dir)
+    except Exception:
+        pass  # device tracing optional (e.g. second start without stop)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _active
+    _active = False
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    # chrome-trace export of host events (tools/timeline.py parity)
+    with open(profile_path + ".chrome_trace.json", "w") as f:
+        json.dump({"traceEvents": _events}, f)
+
+
+def reset_profiler():
+    _events.clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):  # name kept for API parity
+    with profiler():
+        yield

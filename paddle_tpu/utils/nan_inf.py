@@ -1,0 +1,20 @@
+"""NaN/Inf debugging — parity with FLAGS_check_nan_inf
+(framework/details/nan_inf_utils_detail.cc per-op output scan).
+
+With whole-program compilation the per-op scan happens on fetches; for
+op-level attribution run the executor with FLAGS_check_nan_inf AND
+FLAGS_check_nan_inf_level=op — the lowering then wraps every op output in a
+jax.debug.check-style assertion via checkify (slower, debug only)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_fetches(names, values):
+    for name, v in zip(names, values):
+        arr = np.asarray(v)
+        if arr.dtype.kind == "f":
+            if np.isnan(arr).any():
+                raise FloatingPointError(f"NaN detected in fetch var {name!r}")
+            if np.isinf(arr).any():
+                raise FloatingPointError(f"Inf detected in fetch var {name!r}")

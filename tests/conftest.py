@@ -1,0 +1,37 @@
+"""Test config: force CPU with 8 virtual devices so sharding/collective tests
+run without TPU hardware (SURVEY.md §4: the reference tests multi-node as
+multi-process single-host; we test multi-chip as multi-device single-process).
+Must run before jax import."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+# The environment may have imported jax at interpreter startup (sitecustomize)
+# with a different platform baked into the config — override it directly so the
+# env var is honored even then.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Reset the default program stack between tests."""
+    import paddle_tpu.framework.program as P
+    from paddle_tpu.framework import unique_name
+
+    old_main, old_startup = P._main_program_, P._startup_program_
+    P._main_program_ = P.Program()
+    P._startup_program_ = P.Program()
+    P._startup_program_._is_start_up_program = True
+    gen = unique_name.switch()
+    yield
+    P._main_program_ = old_main
+    P._startup_program_ = old_startup
+    unique_name.switch(gen)

@@ -1,0 +1,176 @@
+"""OpTest harness — parity with the reference's
+python/paddle/fluid/tests/unittests/op_test.py (:170): declare op type + numpy
+inputs/attrs (+ optionally expected outputs); check_output builds a one-op
+program and runs it through the Executor; check_grad compares the IR-autodiff
+analytic gradient against numeric finite differences (op_test.py:57
+get_numeric_gradient)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.backward import append_backward
+
+
+class OpTest:
+    op_type: str = ""
+    inputs: Dict[str, np.ndarray] = {}
+    attrs: Dict = {}
+    outputs: Dict[str, np.ndarray] = {}
+
+    def setup(self):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def _build_program(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        in_vars, out_vars = {}, {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            input_names = {}
+            for slot, val in self.inputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = []
+                for i, v in enumerate(vals):
+                    v = np.asarray(v)
+                    name = f"in_{slot}_{i}"
+                    block.create_var(name=name, shape=v.shape,
+                                     dtype=str(v.dtype), is_data=True,
+                                     stop_gradient=False)
+                    names.append(name)
+                input_names[slot] = names
+            output_names = {}
+            for slot, val in self.outputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = []
+                for i, v in enumerate(vals):
+                    name = f"out_{slot}_{i}"
+                    block.create_var(name=name, shape=np.asarray(v).shape,
+                                     dtype=str(np.asarray(v).dtype))
+                    names.append(name)
+                output_names[slot] = names
+            block.append_op(type=self.op_type, inputs=input_names,
+                            outputs=output_names, attrs=dict(self.attrs))
+        return main, startup, input_names, output_names
+
+    def _feed(self):
+        feed = {}
+        for slot, val in self.inputs.items():
+            vals = val if isinstance(val, list) else [val]
+            for i, v in enumerate(vals):
+                feed[f"in_{slot}_{i}"] = np.asarray(v)
+        return feed
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        self.setup()
+        main, startup, _, output_names = self._build_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fetch = [n for names in output_names.values() for n in names]
+        results = exe.run(main, feed=self._feed(), fetch_list=fetch)
+        i = 0
+        for slot, val in self.outputs.items():
+            vals = val if isinstance(val, list) else [val]
+            for expect in vals:
+                got = results[i]
+                np.testing.assert_allclose(
+                    got, expect, atol=atol, rtol=rtol,
+                    err_msg=f"{self.op_type} output {slot}[{i}] mismatch",
+                )
+                i += 1
+
+    # ------------------------------------------------------------------
+    def check_grad(self, inputs_to_check: List[str], output_name: str,
+                   max_relative_error=0.005, eps=1e-3, atol=1e-4,
+                   loss_weights: Optional[np.ndarray] = None):
+        """Analytic (IR append_backward) vs numeric finite-difference grads of
+        sum(output * loss_weights) wrt each requested input. Pass loss_weights
+        when sum(output) has an identically-zero gradient (e.g. softmax)."""
+        self.setup()
+        if loss_weights is not None:
+            self._loss_weights = np.asarray(loss_weights, dtype="float32")
+        else:
+            self._loss_weights = None
+
+        analytic = self._analytic_grads(inputs_to_check, output_name)
+        for slot in inputs_to_check:
+            num = self._numeric_grad(slot, output_name, eps)
+            ana = analytic[slot]
+            denom = np.maximum(np.maximum(np.abs(num), np.abs(ana)), 1e-3)
+            rel = np.abs(num - ana) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{self.op_type} grad wrt {slot}: max rel err {rel.max():.5f} "
+                f"(numeric {num.ravel()[:4]} vs analytic {ana.ravel()[:4]})"
+            )
+
+    def _analytic_grads(self, inputs_to_check, output_name):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            input_names = {}
+            for slot, val in self.inputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = []
+                for i, v in enumerate(vals):
+                    v = np.asarray(v)
+                    name = f"in_{slot}_{i}"
+                    var = block.create_var(name=name, shape=v.shape,
+                                           dtype=str(v.dtype), is_data=True)
+                    var.stop_gradient = False
+                    names.append(name)
+                input_names[slot] = names
+            output_names = {}
+            for slot, val in self.outputs.items():
+                vals = val if isinstance(val, list) else [val]
+                names = []
+                for i, v in enumerate(vals):
+                    name = f"out_{slot}_{i}"
+                    block.create_var(name=name, shape=np.asarray(v).shape,
+                                     dtype=str(np.asarray(v).dtype))
+                    names.append(name)
+                output_names[slot] = names
+            block.append_op(type=self.op_type, inputs=input_names,
+                            outputs=output_names, attrs=dict(self.attrs))
+            out_var = block.var(output_names[output_name][0])
+            if getattr(self, "_loss_weights", None) is not None:
+                w = fluid.layers.assign(self._loss_weights)
+                out_var = fluid.layers.elementwise_mul(out_var, w)
+            loss = fluid.layers.reduce_sum(out_var)
+            append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fetch = [f"in_{slot}_0@GRAD" for slot in inputs_to_check]
+        res = exe.run(main, feed=self._feed(), fetch_list=fetch)
+        return {slot: r for slot, r in zip(inputs_to_check, res)}
+
+    def _numeric_grad(self, slot, output_name, eps):
+        main, startup, input_names, output_names = self._build_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out_name = output_names[output_name][0]
+        base_feed = self._feed()
+
+        weights = getattr(self, "_loss_weights", None)
+
+        def f(x_flat):
+            feed = dict(base_feed)
+            feed[f"in_{slot}_0"] = x_flat.reshape(base_feed[f"in_{slot}_0"].shape)
+            (out,) = exe.run(main, feed=feed, fetch_list=[out_name])
+            out = out.astype(np.float64)
+            if weights is not None:
+                out = out * weights
+            return float(np.sum(out))
+
+        x0 = base_feed[f"in_{slot}_0"].astype(np.float64).ravel().copy()
+        grad = np.zeros_like(x0)
+        for i in range(x0.size):
+            xp = x0.copy(); xp[i] += eps
+            xm = x0.copy(); xm[i] -= eps
+            grad[i] = (f(xp.astype(base_feed[f"in_{slot}_0"].dtype))
+                       - f(xm.astype(base_feed[f"in_{slot}_0"].dtype))) / (2 * eps)
+        return grad.reshape(base_feed[f"in_{slot}_0"].shape).astype(np.float32)

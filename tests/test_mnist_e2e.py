@@ -1,0 +1,92 @@
+"""End-to-end 'book' test (reference tests/book/test_recognize_digits.py
+capability): fluid-style LeNet, static Program + append_backward + SGD on one
+device — asserts the loss decreases on a learnable synthetic task."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def build_lenet():
+    img = fluid.layers.data("img", [1, 28, 28], dtype="float32")
+    label = fluid.layers.data("label", [1], dtype="int64")
+    c1 = fluid.layers.conv2d(img, 6, 5, padding=2, act="relu")
+    p1 = fluid.layers.pool2d(c1, 2, "max", 2)
+    c2 = fluid.layers.conv2d(p1, 16, 5, act="relu")
+    p2 = fluid.layers.pool2d(c2, 2, "max", 2)
+    f1 = fluid.layers.fc(p2, 120, act="relu")
+    f2 = fluid.layers.fc(f1, 84, act="relu")
+    logits = fluid.layers.fc(f2, 10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.reduce_mean(loss)
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    return img, label, avg_loss, acc
+
+
+def synthetic_batch(rng, n=64):
+    x = rng.rand(n, 1, 28, 28).astype("float32")
+    y = x.reshape(n, -1)[:, :10].argmax(1).astype("int64").reshape(n, 1)
+    return x, y
+
+
+def test_lenet_sgd_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, avg_loss, acc = build_lenet()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(30):
+        x, y = synthetic_batch(rng)
+        (l, a) = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[avg_loss, acc])
+        losses.append(float(l))
+    assert losses[-1] < losses[0], f"no learning: {losses[0]} -> {losses[-1]}"
+
+
+def test_lenet_adam_and_test_program_clone():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, avg_loss, acc = build_lenet()
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    first = None
+    for _ in range(20):
+        x, y = synthetic_batch(rng)
+        (l,) = exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_loss])
+        if first is None:
+            first = float(l)
+    # eval on the cloned test program (no optimizer ops)
+    x, y = synthetic_batch(rng)
+    (lt,) = exe.run(test_prog, feed={"img": x, "label": y}, fetch_list=[avg_loss])
+    assert float(lt) < first
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.ones((2, 4), dtype="float32")}
+    (before,) = exe.run(main, feed=feed, fetch_list=[y])
+    fluid.io.save_persistables(exe, str(tmp_path), main_program=main)
+
+    # perturb params, reload, check restored
+    scope = fluid.global_scope()
+    import jax.numpy as jnp
+
+    for p in main.all_parameters():
+        scope.set_var(p.name, jnp.zeros(p.shape, dtype=p.dtype))
+    (zeroed,) = exe.run(main, feed=feed, fetch_list=[y])
+    assert np.abs(zeroed).sum() == 0
+    fluid.io.load_persistables(exe, str(tmp_path), main_program=main)
+    (after,) = exe.run(main, feed=feed, fetch_list=[y])
+    np.testing.assert_allclose(before, after, rtol=1e-6)

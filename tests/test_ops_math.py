@@ -1,0 +1,211 @@
+"""Op unit tests (math/elementwise/reduce/matmul) with numeric grad checks —
+mirrors reference unittests/test_elementwise_*_op.py, test_matmul_op.py,
+test_reduce_op.py via the OpTest harness."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3,).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestElementwiseMul(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_mul"
+        x = np.random.rand(3, 4).astype("float32") + 0.5
+        y = np.random.rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmul(OpTest):
+    def setup(self):
+        self.op_type = "matmul"
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": False, "alpha": 1.0}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestMatmulTranspose(OpTest):
+    def setup(self):
+        self.op_type = "matmul"
+        x = np.random.rand(5, 4).astype("float32")
+        y = np.random.rand(3, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True, "alpha": 1.0}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestMul(OpTest):
+    def setup(self):
+        self.op_type = "mul"
+        x = np.random.rand(4, 2, 3).astype("float32")
+        y = np.random.rand(6, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(4, 6) @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestReduceSum(OpTest):
+    def setup(self):
+        self.op_type = "reduce_sum"
+        x = np.random.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    def setup(self):
+        self.op_type = "reduce_mean"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [], "keep_dim": False, "reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.mean(), dtype="float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestScale(OpTest):
+    def setup(self):
+        self.op_type = "scale"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0, "bias_after_scale": True}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSum(OpTest):
+    def setup(self):
+        self.op_type = "sum"
+        xs = [np.random.rand(3, 4).astype("float32") for _ in range(3)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    def setup(self):
+        self.op_type = "softmax"
+        x = np.random.rand(4, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        # sum(softmax) has identically-zero grad; weight the loss
+        w = np.random.RandomState(7).rand(4, 7).astype("float32")
+        # fp32 finite differences on O(1e-3) grad entries: allow 5% rel err
+        self.check_grad(["X"], "Out", max_relative_error=0.05, loss_weights=w)
+
+
+class TestCast(OpTest):
+    def setup(self):
+        self.op_type = "cast"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "float64", "in_dtype": "float32"}
+        self.outputs = {"Out": x.astype("float64")}
+
+    def test_output(self):
+        # jax x64 disabled -> f64 truncates to f32; compare values only
+        self.check_output(atol=1e-6)
+
+
+UNARY_CASES = [
+    ("exp", np.exp, 0.1, 1.0),
+    ("log", np.log, 0.5, 2.0),
+    ("sqrt", np.sqrt, 0.5, 2.0),
+    ("square", np.square, -1.0, 1.0),
+    ("abs", np.abs, 0.2, 1.0),
+    ("tanh", np.tanh, -1.0, 1.0),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), -1.0, 1.0),
+    ("relu", lambda x: np.maximum(x, 0), 0.05, 1.0),
+]
+
+
+@pytest.mark.parametrize("name,fn,lo,hi", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary_op(name, fn, lo, hi):
+    class T(OpTest):
+        def setup(self):
+            self.op_type = name
+            x = np.random.uniform(lo, hi, (3, 4)).astype("float32")
+            self.inputs = {"X": x}
+            self.attrs = {}
+            self.outputs = {"Out": fn(x).astype("float32")}
+
+    t = T()
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
