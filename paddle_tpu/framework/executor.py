@@ -76,19 +76,87 @@ def global_scope() -> Scope:
     return _global_scope
 
 
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """How a program maps onto a device mesh."""
+
+    mode: str = "single"  # single | gspmd | shard_map
+    axes: Tuple[Tuple[str, int], ...] = ()
+    data_axis: Optional[str] = None
+    # ring_id -> axis name (collective ops lower over these)
+    ring_axes: Any = dataclasses.field(default_factory=dict)
+
+    def signature(self):
+        return (self.mode, self.axes, self.data_axis,
+                tuple(sorted(self.ring_axes.items())) if self.ring_axes else ())
+
+
+_plan_cache: Dict[Tuple, Optional[MeshPlan]] = {}
+
+
+def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
+    """Derive the mesh plan from CompiledProgram state / program annotations.
+    Memoized per (program/compiled identity, version) — Executor.run calls
+    this once per step."""
+    cache_key = (id(program), id(compiled), program._version_token())
+    if cache_key in _plan_cache:
+        return _plan_cache[cache_key]
+
+    plan: Optional[MeshPlan] = None
+    ann = program._annotations
+    if compiled is not None and compiled._is_data_parallel:
+        ring_axes = dict(compiled._mesh_axes)
+        has_collectives = any(
+            op.type.startswith("c_") or op.type in ("allreduce", "broadcast")
+            for op in program.global_block().ops
+        )
+        mode = "shard_map" if has_collectives else "gspmd"
+        dp_size = len(compiled._places) if compiled._places else -1
+        plan = MeshPlan(mode=mode, axes=(("dp", dp_size),), data_axis="dp",
+                        ring_axes=ring_axes or {0: "dp"})
+    elif "mesh" in ann:
+        m = ann["mesh"]
+        plan = MeshPlan(
+            mode=m.get("mode", "gspmd"),
+            axes=tuple(tuple(a) for a in m.get("axes", ())),
+            data_axis=m.get("data_axis"),
+            ring_axes=dict(m.get("ring_axes", {})),
+        )
+    if len(_plan_cache) > 4096:
+        _plan_cache.clear()
+    _plan_cache[cache_key] = plan
+    return plan
+
+
 class _CompiledBlock:
-    """One jit-compiled executable for (program, feed signature, fetch list)."""
+    """One jit-compiled executable for (program, feed signature, fetch list).
+
+    Three execution modes replace the reference's executor zoo
+    (Executor / ParallelExecutor+SSA graph / NCCL rings):
+      - single: one device, plain jit.
+      - gspmd:  a jax.sharding.Mesh + NamedShardings on params/feeds; XLA's
+        partitioner inserts gradient all-reduces etc. (subsumes
+        ParallelExecutor's AllReduceOpHandle graph, details/build_strategy).
+      - shard_map: per-rank program semantics for Fleet-transpiled programs
+        that carry explicit c_allreduce_*/c_broadcast ops (ring_id -> mesh
+        axis); matches the reference's collective-op execution model exactly.
+    """
 
     def __init__(self, program: Program, feed_sig, fetch_names, param_names,
-                 written_names, mesh_axes=None, donate: bool = True):
+                 written_names, mesh_plan=None, donate: bool = True,
+                 scope: Optional["Scope"] = None):
         self.program = program
         self.feed_names = [n for n, _, _ in feed_sig]
         self.fetch_names = list(fetch_names)
         self.param_names = list(param_names)
         self.written_names = list(written_names)
-        self.mesh_axes = mesh_axes or {}
+        self.mesh_plan = mesh_plan
+        mesh_axes = (mesh_plan.ring_axes if mesh_plan else {})
         block = program.global_block()
-        checkpoints = program._annotations.get("recompute_checkpoints")
+        written = set(written_names)
 
         def fn(mutable_params: Dict[str, Any], const_params: Dict[str, Any],
                feeds: Dict[str, Any], rng_key):
@@ -97,15 +165,118 @@ class _CompiledBlock:
             env.update(mutable_params)
             env.update(feeds)
             ctx = LowerCtx(program, block, env, rng_key=rng_key,
-                           mesh_axes=self.mesh_axes)
+                           mesh_axes=mesh_axes)
             for op in block.ops:
                 run_lowering(ctx, op)
             fetches = [env[n] for n in self.fetch_names]
+            # a declared persistable output may legitimately stay unbound
+            # (bootstrap no-op lowerings, @EMPTY@ grads) — tolerate it
             new_state = {n: env[n] for n in self.written_names if n in env}
             return fetches, new_state
 
         donate_args = (0,) if donate else ()
-        self._jitted = jax.jit(fn, donate_argnums=donate_args)
+
+        if mesh_plan is None or mesh_plan.mode == "single":
+            self._jitted = jax.jit(fn, donate_argnums=donate_args)
+            self.mesh = None
+            self._concat_fetches = False
+            return
+
+        from ..parallel.mesh import build_mesh, named_sharding
+
+        mesh = build_mesh(mesh_plan.axes)
+        self.mesh = mesh
+        n_dev = int(np.prod(mesh.devices.shape))
+        data_axis = mesh_plan.data_axis
+        block_vars = block.vars
+
+        def param_spec(name):
+            var = block_vars.get(name)
+            return getattr(var, "sharding", None) if var is not None else None
+
+        def feed_dims(shape):
+            """Shard the batch (dim 0) only when it divides the mesh evenly;
+            small feeds (lr tensors, flags) stay replicated."""
+            if shape and shape[0] % n_dev == 0 and shape[0] > 0:
+                return (data_axis,) + (None,) * (len(shape) - 1)
+            return None
+
+        if mesh_plan.mode == "gspmd":
+            mutable_sh = {n: named_sharding(mesh, param_spec(n))
+                          for n in self.param_names if n in written}
+            const_sh = {n: named_sharding(mesh, param_spec(n))
+                        for n in self.param_names if n not in written}
+            feed_sh = {n: named_sharding(mesh, feed_dims(shape))
+                       for n, shape, _ in feed_sig}
+            rng_sh = named_sharding(mesh, None)
+            self._jitted = jax.jit(
+                fn,
+                in_shardings=(mutable_sh, const_sh, feed_sh, rng_sh),
+                donate_argnums=donate_args,
+            )
+            self._concat_fetches = False
+            return
+
+        # shard_map mode: per-rank execution, explicit collectives in program.
+        # Fetches are concatenated along dim 0 across ranks — parity with
+        # ParallelExecutor's fetch merge (a fetched scalar loss comes back as
+        # one value per device, exactly like the reference).
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map as _shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+        import jax.numpy as _jnp
+
+        # discover which written names are actually produced (abstract-eval
+        # probe, so the shard_map out_specs pytree is known before tracing)
+        def _aval(x):
+            a = jnp.asarray(x) if not hasattr(x, "shape") else x
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        mutable_avals = {n: _aval(scope.find_var(n)) for n in self.param_names
+                         if n in written and scope is not None and scope.has_var(n)}
+        const_avals = {n: _aval(scope.find_var(n)) for n in self.param_names
+                       if n not in written and scope is not None and scope.has_var(n)}
+        feed_avals = {n: jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt) if dt != "bfloat16" else jnp.bfloat16)
+                      for n, shape, dt in feed_sig}
+        key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        try:
+            _, state_shape = jax.eval_shape(fn, mutable_avals, const_avals,
+                                            feed_avals, key_aval)
+            produced = sorted(state_shape.keys())
+        except Exception:
+            produced = list(self.written_names)
+        self._produced_state = produced
+
+        def per_rank(mutable_params, const_params, feeds, rng_key):
+            fetches, new_state = fn(mutable_params, const_params, feeds, rng_key)
+            fetches = [_jnp.atleast_1d(f) for f in fetches]
+            new_state = {n: new_state[n] for n in produced}
+            return fetches, new_state
+
+        mutable_specs = {n: P() for n in self.param_names if n in written}
+        const_specs = {n: P() for n in self.param_names if n not in written}
+        feed_specs = {
+            n: P(*fd) if (fd := feed_dims(shape)) else P()
+            for n, shape, _ in feed_sig
+        }
+        fetch_specs = [P(data_axis) for _ in fetch_names]
+        state_specs = {n: P() for n in produced}
+
+        smap_kwargs = dict(
+            mesh=mesh,
+            in_specs=(mutable_specs, const_specs, feed_specs, P()),
+            out_specs=(fetch_specs, state_specs),
+        )
+        try:
+            wrapped = _shard_map(per_rank, **smap_kwargs, check_vma=False)
+        except TypeError:  # older jax spells it check_rep
+            wrapped = _shard_map(per_rank, **smap_kwargs, check_rep=False)
+        self._jitted = jax.jit(wrapped, donate_argnums=donate_args)
+        self._concat_fetches = True
 
     def __call__(self, scope: Scope, feed: Dict[str, Any], rng_key):
         mutable = {}
@@ -154,11 +325,10 @@ class Executor:
     ):
         from .compiler import CompiledProgram
 
-        mesh_axes = None
+        compiled = None
         if isinstance(program, CompiledProgram):
             compiled = program
             program = compiled.program
-            mesh_axes = compiled._mesh_axes
         if program is None:
             program = default_main_program()
         scope = scope or global_scope()
@@ -180,11 +350,13 @@ class Executor:
             feed_arrays[name] = arr
             feed_sig.append((name, tuple(arr.shape), str(arr.dtype)))
 
+        mesh_plan = plan_for_program(program, compiled)
         key = (
             id(program),
             program._version_token(),
             tuple(feed_sig),
             tuple(fetch_names),
+            mesh_plan.signature() if mesh_plan else None,
         )
         exe = self._cache.get(key)
         if exe is None:
@@ -192,12 +364,13 @@ class Executor:
             param_names, written = _analyze_persistables(program)
             exe = _CompiledBlock(
                 program, feed_sig, fetch_names, param_names, written,
-                mesh_axes=mesh_axes,
+                mesh_plan=mesh_plan, scope=scope,
             )
             self._cache[key] = exe
             logger.info(
-                "compiled program: %d ops, %d params, %d feeds",
+                "compiled program: %d ops, %d params, %d feeds, mesh=%s",
                 len(block.ops), len(param_names), len(feed_sig),
+                mesh_plan.mode if mesh_plan else "single",
             )
 
         seed = program.random_seed or 0

@@ -1,0 +1,93 @@
+"""Fleet abstract base — parity with fluid/incubate/fleet/base/fleet_base.py
+(init/init_worker/init_server/distributed_optimizer surface)."""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class Fleet(ABC):
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._optimizer = None
+        self._is_initialized = False
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(is_collective=True)
+        role_maker.generate_role()
+        self._role_maker = role_maker
+        self._is_initialized = True
+        return self
+
+    # -- role info ----------------------------------------------------------
+    def is_first_worker(self) -> bool:
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self) -> int:
+        return self._role_maker.worker_index()
+
+    def worker_num(self) -> int:
+        return self._role_maker.worker_num()
+
+    def is_worker(self) -> bool:
+        return self._role_maker.is_worker()
+
+    def server_num(self) -> int:
+        return self._role_maker.server_num()
+
+    def server_index(self) -> int:
+        return self._role_maker.server_index()
+
+    def is_server(self) -> bool:
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self):
+        return self._role_maker.get_trainer_endpoints()
+
+    def server_endpoints(self):
+        return self._role_maker.get_pserver_endpoints()
+
+    # -- lifecycle ----------------------------------------------------------
+    @abstractmethod
+    def init_worker(self):
+        ...
+
+    @abstractmethod
+    def init_server(self, model_dir=None):
+        ...
+
+    @abstractmethod
+    def run_server(self):
+        ...
+
+    @abstractmethod
+    def stop_worker(self):
+        ...
+
+    @abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        ...
+
+    @abstractmethod
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        ...
+
+    @abstractmethod
+    def save_persistables(self, executor, dirname, main_program=None):
+        ...
+
+
+class DistributedOptimizer(ABC):
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    @abstractmethod
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ...

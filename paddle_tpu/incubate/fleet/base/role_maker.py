@@ -1,0 +1,115 @@
+"""Role makers — parity with fluid/incubate/fleet/base/role_maker.py (1,115
+LoC: RoleMakerBase, PaddleCloudRoleMaker reading the PADDLE_* env contract at
+:501-536, UserDefinedRoleMaker, MPI/Gloo role makers for PS).
+
+The TPU build keeps the same env contract; rendezvous/barrier duties the
+reference delegates to Gloo/MPI are served by the jax.distributed coordinator.
+"""
+from __future__ import annotations
+
+import os
+from enum import IntEnum
+from typing import List, Optional
+
+
+class Role(IntEnum):
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._trainer_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+        self._role: Optional[Role] = None
+        self._current_id = -1
+        self._generated = False
+
+    def generate_role(self):
+        raise NotImplementedError
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return len(self._trainer_endpoints)
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return self._trainer_endpoints
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return self._server_endpoints
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Reads the PADDLE_* env contract (role_maker.py:501-536)."""
+
+    def __init__(self, is_collective: bool = False):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._generated:
+            return
+        if self._is_collective:
+            self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+            self._trainer_endpoints = [e for e in eps.split(",") if e] or ["127.0.0.1:6070"]
+            self._role = Role.WORKER
+        else:
+            port = os.getenv("PADDLE_PORT", "6070")
+            pserver_ips = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = [e for e in pserver_ips.split(",") if e]
+            role = os.getenv("TRAINING_ROLE", "TRAINER").upper()
+            if role == "PSERVER":
+                self._role = Role.SERVER
+                cur = os.getenv("POD_IP", "127.0.0.1") + ":" + port
+                self._current_id = (
+                    self._server_endpoints.index(cur)
+                    if cur in self._server_endpoints else 0
+                )
+            else:
+                self._role = Role.WORKER
+                self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            n = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+            self._trainer_endpoints = [f"trainer-{i}" for i in range(n)]
+        self._generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._trainer_endpoints = [f"trainer-{i}" for i in range(worker_num)]
+        self._server_endpoints = server_endpoints or []
+
+    def generate_role(self):
+        self._generated = True
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._trainer_endpoints = worker_endpoints or ["127.0.0.1:6070"]
+        self._role = Role.WORKER
+
+    def generate_role(self):
+        self._generated = True

@@ -137,3 +137,15 @@ def legacy_allreduce(ctx, op, ins):
     red = op.attr("reduce_type", 0)
     fn = [lax.psum, lax.pmax, lax.pmin][red] if red in (0, 1, 2) else lax.psum
     return {"Out": fn(x, ax)}
+
+
+@register_op("c_allreduce_avg", diff_inputs=("X",))
+def c_allreduce_avg(ctx, op, ins):
+    """Mean-allreduce: the reference expresses this as scale_loss_grad
+    (1/nranks) + c_allreduce_sum (transpiler/collective.py:178); fused here so
+    one transpiled program works for any mesh size."""
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": lax.pmean(x, ax)}

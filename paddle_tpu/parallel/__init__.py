@@ -1,0 +1,6 @@
+"""Distributed & parallelism — the TPU-native replacement for the reference's
+ParallelExecutor/NCCL stack (SURVEY.md §2.3): device meshes + GSPMD shardings
++ shard_map collectives instead of SSA graphs + rings."""
+from .mesh import MeshConfig, build_mesh, current_mesh, mesh_guard  # noqa: F401
+from . import env  # noqa: F401
+from .launch import launch  # noqa: F401

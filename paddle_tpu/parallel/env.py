@@ -1,0 +1,60 @@
+"""Distributed environment contract.
+
+Parity with the reference's PADDLE_* env-var contract
+(incubate/fleet/base/role_maker.py:501-536 PaddleCloudRoleMaker reads
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS /
+PADDLE_CURRENT_ENDPOINT) and distributed/utils.py:338-375. The bootstrap that
+the reference does via gRPC gen_nccl_id / raw sockets is jax.distributed
+coordinator initialization here.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+
+def trainer_id() -> int:
+    return int(os.getenv("PADDLE_TRAINER_ID", "0"))
+
+
+def trainer_num() -> int:
+    v = os.getenv("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    return max(jax.process_count(), 1)
+
+
+def trainer_endpoints() -> List[str]:
+    eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+    return [e for e in eps.split(",") if e]
+
+
+def current_endpoint() -> str:
+    return os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+
+_initialized = False
+
+
+def init_distributed_env(coordinator: Optional[str] = None) -> None:
+    """Initialize multi-process JAX from the PADDLE_* contract (replaces the
+    reference's c_gen_nccl_id + c_comm_init bootstrap ops)."""
+    global _initialized
+    if _initialized or trainer_num() <= 1 or jax.process_count() > 1:
+        _initialized = True
+        return
+    eps = trainer_endpoints()
+    coordinator = coordinator or (eps[0] if eps else None)
+    if coordinator is None:
+        raise RuntimeError(
+            "multi-trainer env without PADDLE_TRAINER_ENDPOINTS — cannot "
+            "determine the jax.distributed coordinator address"
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=trainer_num(),
+        process_id=trainer_id(),
+    )
+    _initialized = True

@@ -1,0 +1,106 @@
+"""Multi-process launcher — parity with python/paddle/distributed/launch.py
+(:193 launch, utils.py:338-375 env contract): spawns one worker process per
+device/host slot, sets the PADDLE_* env, watches children and aborts the job
+on any failure (TrainerProc watch loop parity).
+
+On TPU the normal deployment is one process per HOST (all local chips in one
+process), so --nproc_per_node defaults to 1; the per-GPU spawning of the
+reference maps to per-host here.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def get_cluster_endpoints(node_ips: List[str], nproc_per_node: int,
+                          start_port: int = 6070) -> List[str]:
+    eps = []
+    for ip in node_ips:
+        for i in range(nproc_per_node):
+            eps.append(f"{ip}:{start_port + i}")
+    return eps
+
+
+def launch(training_script: str, script_args: Optional[List[str]] = None,
+           cluster_node_ips: str = "127.0.0.1", node_ip: str = "127.0.0.1",
+           nproc_per_node: int = 1, started_port: int = 6070,
+           log_dir: Optional[str] = None) -> int:
+    node_ips = [ip.strip() for ip in cluster_node_ips.split(",")]
+    endpoints = get_cluster_endpoints(node_ips, nproc_per_node, started_port)
+    node_rank = node_ips.index(node_ip)
+    procs = []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for local_rank in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        })
+        out = (open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
+               if log_dir else None)
+        p = subprocess.Popen(
+            [sys.executable, training_script] + list(script_args or []),
+            env=env, stdout=out, stderr=subprocess.STDOUT if out else None,
+        )
+        procs.append((rank, p, out))
+
+    # watch loop: abort the whole job if any worker dies (parity with
+    # distributed/utils.py TrainerProc watch)
+    exit_code = 0
+    try:
+        while procs:
+            alive = []
+            for rank, p, out in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive.append((rank, p, out))
+                elif ret != 0:
+                    exit_code = ret
+                    sys.stderr.write(f"worker {rank} exited with {ret}; "
+                                     "terminating job\n")
+                    for _, q, _ in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    procs = []
+                    alive = []
+                    break
+            procs = alive
+            if procs:
+                time.sleep(1)
+    finally:
+        for _, p, out in procs:
+            if p.poll() is None:
+                p.terminate()
+            if out:
+                out.close()
+    return exit_code
+
+
+def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cluster_node_ips", default="127.0.0.1")
+    ap.add_argument("--node_ip", default="127.0.0.1")
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--started_port", type=int, default=6070)
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("training_script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    sys.exit(launch(args.training_script, args.script_args,
+                    args.cluster_node_ips, args.node_ip, args.nproc_per_node,
+                    args.started_port, args.log_dir))
+
+
+if __name__ == "__main__":
+    main()

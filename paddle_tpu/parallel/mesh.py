@@ -1,0 +1,81 @@
+"""Device mesh management.
+
+Replaces the reference's NCCL ring registry (platform/collective_helper.h:62
+NCCLCommContext keyed ring_id->comm) with named jax.sharding.Mesh axes:
+ring_id -> axis name is the only mapping collectives need; XLA routes the
+collectives over ICI/DCN according to the mesh's device layout.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Logical mesh shape: ordered (axis_name, size) pairs. size -1 = infer
+    from the device count (at most one)."""
+
+    axes: List[Tuple[str, int]]
+
+    def resolve(self, n_devices: int) -> List[Tuple[str, int]]:
+        fixed = 1
+        infer_idx = None
+        for i, (name, size) in enumerate(self.axes):
+            if size == -1:
+                infer_idx = i
+            else:
+                fixed *= size
+        axes = list(self.axes)
+        if infer_idx is not None:
+            axes[infer_idx] = (axes[infer_idx][0], max(n_devices // fixed, 1))
+        return axes
+
+
+def build_mesh(config: MeshConfig | Sequence[Tuple[str, int]],
+               devices: Optional[Sequence] = None) -> Mesh:
+    if not isinstance(config, MeshConfig):
+        config = MeshConfig(list(config))
+    devices = list(devices if devices is not None else jax.devices())
+    axes = config.resolve(len(devices))
+    shape = tuple(s for _, s in axes)
+    names = tuple(n for n, _ in axes)
+    total = int(np.prod(shape))
+    dev_array = np.array(devices[:total]).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+_current_mesh: Optional[Mesh] = None
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    global _current_mesh
+    old = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = old
+
+
+def spec_for(var_sharding: Optional[Sequence[Optional[str]]]) -> P:
+    """Convert a per-dim axis-name tuple (None = replicated dim) to a
+    PartitionSpec."""
+    if var_sharding is None:
+        return P()
+    return P(*var_sharding)
+
+
+def named_sharding(mesh: Mesh, var_sharding=None) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(var_sharding))

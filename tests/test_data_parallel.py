@@ -1,0 +1,101 @@
+"""Data-parallel tests on the 8-virtual-device CPU mesh.
+
+Mirrors the reference's distributed test strategy (SURVEY.md §4 point 3,
+unittests/test_dist_base.py): run the SAME model single-device and
+data-parallel and assert loss parity step-for-step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build(seed=1234):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n_steps, batch=32):
+    rng = np.random.RandomState(7)
+    for _ in range(n_steps):
+        x = rng.rand(batch, 8).astype("float32")
+        y = x[:, :4].argmax(1).astype("int64").reshape(batch, 1)
+        yield x, y
+
+
+def _run(main, startup, loss, compiled=None, n=8):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    losses = []
+    target = compiled if compiled is not None else main
+    for x, y in _batches(n):
+        (l,) = exe.run(target, feed={"x": x, "y": y}, fetch_list=[loss],
+                       scope=scope)
+        # shard_map-mode fetches come back one-per-device (ParallelExecutor
+        # fetch-merge parity); mean collapses both cases
+        losses.append(float(np.asarray(l).mean()))
+    return losses
+
+
+def test_with_data_parallel_matches_single_device():
+    import jax
+
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    main, startup, loss = _build()
+    single = _run(main, startup, loss)
+
+    main2, startup2, loss2 = _build()
+    compiled = fluid.CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name)
+    parallel = _run(main2, startup2, loss2, compiled=compiled)
+
+    np.testing.assert_allclose(single, parallel, rtol=2e-4, atol=2e-5)
+
+
+def test_collective_ops_shard_map_allreduce():
+    """c_allreduce_sum over a dp mesh axis sums rank-local shards —
+    capability parity with operators/collective/c_allreduce_op.h."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        from paddle_tpu.layers.collective import _c_allreduce
+
+        out = _c_allreduce(x, reduce_type="sum", ring_id=0)
+        summed = fluid.layers.reduce_sum(out)
+    main._annotations["mesh"] = {
+        "mode": "shard_map", "axes": [("dp", 8)], "data_axis": "dp",
+        "ring_axes": {0: "dp"},
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    x = np.arange(32, dtype="float32").reshape(8, 4)  # one row per rank
+    (res,) = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)
+    # after allreduce each rank holds the sum of all ranks' rows; fetches are
+    # concatenated across ranks (ParallelExecutor fetch-merge parity)
+    np.testing.assert_allclose(res, np.tile(x.sum(0, keepdims=True), (8, 1)),
+                               rtol=1e-6)
+
+
+def test_gspmd_grad_math_matches_manual():
+    """Params stay replicated and identical across steps under gspmd DP."""
+    main, startup, loss = _build(seed=77)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for x, y in _batches(3):
+        exe.run(compiled, feed={"x": x, "y": y}, fetch_list=[loss], scope=scope)
+    w = scope.find_var(main.all_parameters()[0].name)
+    assert np.isfinite(np.asarray(w)).all()
