@@ -1,0 +1,90 @@
+"""Flagship benchmark: GPT training-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+The reference publishes no in-repo numbers (BASELINE.md — all N/A), so
+``vs_baseline`` reports measured model-FLOPs-utilization (MFU) against the
+chip's peak — an absolute, hardware-grounded yardstick that carries across
+rounds.
+"""
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _peak_flops(device) -> float:
+    """Best-effort peak bf16 FLOP/s for the device (fallbacks are rough)."""
+    kind = getattr(device, "device_kind", "cpu").lower()
+    table = {
+        "v6e": 918e12, "v6 lite": 918e12, "v5e": 394e12, "v5 lite": 394e12,
+        "v5p": 459e12, "v4": 275e12, "v3": 123e12, "v2": 45e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 1e12  # CPU / unknown
+
+
+def main():
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel import parallelize as PZ
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=False)
+        batch, T, steps = 32, 1024, 10
+    else:  # CPU smoke path so the bench always produces a line
+        cfg = G.GPT_TINY.scaled(num_layers=2)
+        batch, T, steps = 4, 32, 3
+
+    pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg, devices=[dev])
+    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
+
+    # warmup (compile)
+    params, opt, loss, _ = step(params, opt, tokens, labels)
+    float(loss)
+
+    # sync each step: block_until_ready on a chained async queue is not
+    # reliable through the remote-TPU tunnel, and fetching the scalar loss
+    # costs ~nothing against a full train step
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss, _ = step(params, opt, tokens, labels)
+        float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = steps * batch * T / dt
+    n_params = G.num_params(params)
+    # fwd+bwd ~= 6 * N FLOPs/token (+ attention term), standard estimate
+    attn = 6 * cfg.num_layers * cfg.d_model * T
+    flops_per_token = 6 * n_params + attn
+    mfu = tokens_per_s * flops_per_token / _peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu, 4),
+        "detail": {
+            "model_params": int(n_params),
+            "seq_len": T, "batch": batch, "steps": steps,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "loss": round(float(loss), 4),
+            "mfu": round(mfu, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -94,7 +94,9 @@ class MeshPlan:
                 tuple(sorted(self.ring_axes.items())) if self.ring_axes else ())
 
 
-_plan_cache: Dict[Tuple, Optional[MeshPlan]] = {}
+# value holds strong refs to (program, compiled) so an id() is never reused
+# by a different live object while its entry is cached
+_plan_cache: Dict[Tuple, Tuple[Optional[MeshPlan], Any, Any]] = {}
 
 
 def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
@@ -103,7 +105,7 @@ def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
     this once per step."""
     cache_key = (id(program), id(compiled), program._version_token())
     if cache_key in _plan_cache:
-        return _plan_cache[cache_key]
+        return _plan_cache[cache_key][0]
 
     plan: Optional[MeshPlan] = None
     ann = program._annotations
@@ -127,7 +129,7 @@ def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
         )
     if len(_plan_cache) > 4096:
         _plan_cache.clear()
-    _plan_cache[cache_key] = plan
+    _plan_cache[cache_key] = (plan, program, compiled)
     return plan
 
 
@@ -179,7 +181,6 @@ class _CompiledBlock:
         if mesh_plan is None or mesh_plan.mode == "single":
             self._jitted = jax.jit(fn, donate_argnums=donate_args)
             self.mesh = None
-            self._concat_fetches = False
             return
 
         from ..parallel.mesh import build_mesh, named_sharding
@@ -214,7 +215,6 @@ class _CompiledBlock:
                 in_shardings=(mutable_sh, const_sh, feed_sh, rng_sh),
                 donate_argnums=donate_args,
             )
-            self._concat_fetches = False
             return
 
         # shard_map mode: per-rank execution, explicit collectives in program.
@@ -276,7 +276,6 @@ class _CompiledBlock:
         except TypeError:  # older jax spells it check_rep
             wrapped = _shard_map(per_rank, **smap_kwargs, check_rep=False)
         self._jitted = jax.jit(wrapped, donate_argnums=donate_args)
-        self._concat_fetches = True
 
     def __call__(self, scope: Scope, feed: Dict[str, Any], rng_key):
         mutable = {}

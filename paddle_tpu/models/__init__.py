@@ -1,0 +1,11 @@
+"""Model zoo for the TPU-native framework.
+
+The reference ships models as book examples and external repos
+(python/paddle/fluid/tests/book/, PaddleRec/PaddleNLP configs referenced from
+README.md). Here the zoo is first-class: static-graph builders (LeNet, ResNet,
+word2vec-style) mirroring the book tests, plus a pure-JAX flagship GPT decoder
+designed for dp/pp/tp/sp execution on a TPU mesh (the reference's 2020-era
+stack had no tensor/sequence parallelism — SURVEY.md §2.3; this is the
+north-star GPT config built TPU-first).
+"""
+from . import gpt  # noqa: F401

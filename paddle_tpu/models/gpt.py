@@ -1,0 +1,248 @@
+"""Flagship GPT decoder — pure JAX, designed for TPU mesh execution.
+
+The reference has no GPT implementation (2020-era); its largest NLP config is
+ERNIE/transformer encoder (python/paddle/fluid/tests/unittests/dist_transformer.py).
+This model is the north-star GPT-3-style decoder (BASELINE.md: GPT-3-1.3B
+pipeline+tensor parallel) built TPU-first:
+
+- parameters are a flat pytree with per-layer leaves stacked on a leading
+  ``num_layers`` axis so the layer loop is a single ``lax.scan`` (one XLA
+  While, compiled once per layer shape — no unrolled 48-layer HLO),
+- every leaf has a declared :class:`jax.sharding.PartitionSpec` over the
+  ``(dp, pp, tp)`` mesh (see :mod:`paddle_tpu.parallel.parallelize` for the
+  shard_map execution engine: GPipe over pp, Megatron TP + sequence
+  parallelism over tp, data parallel over dp),
+- compute dtype is configurable (bf16 by default on TPU — MXU-native),
+  master params stay f32.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    d_model: int = 2048
+    d_ff: int = 8192
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16   # compute dtype (params stay f32)
+    remat: bool = True          # jax.checkpoint each block (HBM <-> FLOPs)
+    use_flash: bool = False     # Pallas flash-attention kernel on TPU
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.num_heads == 0
+        return self.d_model // self.num_heads
+
+    def scaled(self, **kw) -> "GPTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# 124M-ish config for single-chip benches; tiny config for tests/dryruns.
+GPT_SMALL = GPTConfig(vocab_size=50304, max_seq_len=1024, num_layers=12,
+                      num_heads=12, d_model=768, d_ff=3072)
+GPT_TINY = GPTConfig(vocab_size=256, max_seq_len=64, num_layers=4,
+                     num_heads=4, d_model=64, d_ff=128, dtype=jnp.float32,
+                     remat=False)
+
+
+def init_params(key, cfg: GPTConfig) -> Dict[str, Any]:
+    """GPT-2-style init. Per-layer leaves are stacked on axis 0 (num_layers).
+
+    QKV is stored as [L, D, 3, nh, hd] and the output projection as
+    [L, nh, hd, D] so tensor parallelism shards the *head* dimension — the
+    natural Megatron split (column-parallel QKV, row-parallel proj).
+    """
+    L, D, F = cfg.num_layers, cfg.d_model, cfg.d_ff
+    nh, hd, V = cfg.num_heads, cfg.head_dim, cfg.vocab_size
+    ks = jax.random.split(key, 8)
+    std = 0.02
+    resid_std = std / math.sqrt(2 * L)
+
+    def norm(k, shape, s=std):
+        return (jax.random.normal(k, shape) * s).astype(jnp.float32)
+
+    return {
+        "wte": norm(ks[0], (V, D)),
+        "wpe": norm(ks[1], (cfg.max_seq_len, D), s=0.01),
+        "lm_head": norm(ks[2], (D, V)),
+        "ln_f_scale": jnp.ones((D,), jnp.float32),
+        "ln_f_bias": jnp.zeros((D,), jnp.float32),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D), jnp.float32),
+            "ln1_bias": jnp.zeros((L, D), jnp.float32),
+            "w_qkv": norm(ks[3], (L, D, 3, nh, hd)),
+            "b_qkv": jnp.zeros((L, 3, nh, hd), jnp.float32),
+            "w_proj": norm(ks[4], (L, nh, hd, D), s=resid_std),
+            "b_proj": jnp.zeros((L, D), jnp.float32),
+            "ln2_scale": jnp.ones((L, D), jnp.float32),
+            "ln2_bias": jnp.zeros((L, D), jnp.float32),
+            "w_fc": norm(ks[5], (L, D, F)),
+            "b_fc": jnp.zeros((L, F), jnp.float32),
+            "w_out": norm(ks[6], (L, F, D), s=resid_std),
+            "b_out": jnp.zeros((L, D), jnp.float32),
+        },
+    }
+
+
+def param_specs(cfg: GPTConfig, pp: str = "pp", tp: str = "tp") -> Dict[str, Any]:
+    """PartitionSpec per leaf over mesh axes (pp, tp). dp never shards params.
+
+    Block leaves are stage-sharded on the stacked layer axis (pp) and
+    head/ffn-sharded (tp) where Megatron splits them; embeddings / final
+    ln / head are replicated (they live on every stage — grads from unused
+    stages are exactly zero, see parallelize.py psum rule).
+    """
+    return {
+        "wte": P(),
+        "wpe": P(),
+        "lm_head": P(),
+        "ln_f_scale": P(),
+        "ln_f_bias": P(),
+        "blocks": {
+            "ln1_scale": P(pp, None),
+            "ln1_bias": P(pp, None),
+            "w_qkv": P(pp, None, None, tp, None),
+            "b_qkv": P(pp, None, tp, None),
+            "w_proj": P(pp, tp, None, None),
+            "b_proj": P(pp, None),
+            "ln2_scale": P(pp, None),
+            "ln2_bias": P(pp, None),
+            "w_fc": P(pp, None, tp),
+            "b_fc": P(pp, tp),
+            "w_out": P(pp, tp, None),
+            "b_out": P(pp, None),
+        },
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _causal_attention(q, k, v, cfg: GPTConfig):
+    """q,k,v: [B, T, nh, hd] -> [B, T, nh, hd]. Plain XLA path; the Pallas
+    flash kernel (ops/pallas_kernels.py) replaces this on TPU when
+    cfg.use_flash — same signature, tiled online-softmax in VMEM."""
+    if cfg.use_flash:
+        from ..ops.pallas_kernels import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    T = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def block_fn(p, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
+    """One transformer block. ``p`` holds this layer's leaves (no L axis —
+    possibly tp-local shards when run under shard_map).
+
+    With ``tp_axis`` the activation ``x`` arrives *sequence-sharded*
+    ([B, T/tp, D], Megatron sequence parallelism): all_gather(seq) before the
+    matmuls, reduce_scatter(seq) after the row-parallel ones. Biases are added
+    on the sequence-sharded side so every bias grad is a partial sum over tp
+    (parallelize.py relies on this for its uniform grad-psum rule).
+    """
+    dt = cfg.dtype
+
+    def gather(y):
+        if tp_axis is None:
+            return y
+        return jax.lax.all_gather(y, tp_axis, axis=1, tiled=True)
+
+    def scatter_sum(y):
+        if tp_axis is None:
+            return y
+        return jax.lax.psum_scatter(y, tp_axis, scatter_dimension=1, tiled=True)
+
+    # --- attention ---
+    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    h = gather(h)                                     # [B, T, D]
+    qkv = jnp.einsum("btd,dcnh->btcnh", h, p["w_qkv"].astype(dt))
+    qkv = qkv + p["b_qkv"].astype(dt)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    a = _causal_attention(q, k, v, cfg)               # [B, T, nh_local, hd]
+    o = jnp.einsum("btnh,nhd->btd", a, p["w_proj"].astype(dt))
+    o = scatter_sum(o)                                # [B, T/tp, D]
+    x = x + o + p["b_proj"].astype(dt)
+
+    # --- mlp ---
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    h = gather(h)
+    h = jnp.einsum("btd,df->btf", h, p["w_fc"].astype(dt)) + p["b_fc"].astype(dt)
+    h = jax.nn.gelu(h, approximate=True)
+    o = jnp.einsum("btf,fd->btd", h, p["w_out"].astype(dt))
+    o = scatter_sum(o)
+    x = x + o + p["b_out"].astype(dt)
+    return x
+
+
+def run_blocks(blocks, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
+    """lax.scan over the stacked layer axis of ``blocks``."""
+    f = block_fn
+    if cfg.remat:
+        f = jax.checkpoint(block_fn, static_argnums=(2, 3))
+
+    def body(h, layer_p):
+        return f(layer_p, h, cfg, tp_axis), None
+
+    x, _ = jax.lax.scan(body, x, blocks)
+    return x
+
+
+def embed(p, tokens, cfg: GPTConfig, pos_offset=0):
+    """tokens [B, T] -> [B, T, D] (compute dtype)."""
+    T = tokens.shape[1]
+    pos = pos_offset + jnp.arange(T)
+    x = p["wte"][tokens] + p["wpe"][pos]
+    return x.astype(cfg.dtype)
+
+
+def logits_fn(p, x, cfg: GPTConfig):
+    x = _layer_norm(x, p["ln_f_scale"], p["ln_f_bias"])
+    return jnp.einsum("btd,dv->btv", x, p["lm_head"].astype(cfg.dtype))
+
+
+def forward(params, tokens, cfg: GPTConfig):
+    """Single-device (or GSPMD) forward: tokens [B, T] -> logits [B, T, V]."""
+    x = embed(params, tokens, cfg)
+    x = run_blocks(params["blocks"], x, cfg)
+    return logits_fn(params, x, cfg)
+
+
+def token_ce(logits, labels):
+    """Summed (not mean) token cross-entropy in f32 — callers normalize, so
+    distributed shards can psum partial sums."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.sum(ll)
+
+
+def loss_fn(params, tokens, labels, cfg: GPTConfig):
+    """Mean next-token loss, single-device semantics."""
+    logits = forward(params, tokens, cfg)
+    return token_ce(logits, labels) / labels.size
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

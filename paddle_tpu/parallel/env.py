@@ -23,7 +23,9 @@ def trainer_num() -> int:
     v = os.getenv("PADDLE_TRAINERS_NUM")
     if v is not None:
         return int(v)
-    return max(jax.process_count(), 1)
+    if _initialized:
+        return max(jax.process_count(), 1)
+    return 1
 
 
 def trainer_endpoints() -> List[str]:
@@ -42,7 +44,10 @@ def init_distributed_env(coordinator: Optional[str] = None) -> None:
     """Initialize multi-process JAX from the PADDLE_* contract (replaces the
     reference's c_gen_nccl_id + c_comm_init bootstrap ops)."""
     global _initialized
-    if _initialized or trainer_num() <= 1 or jax.process_count() > 1:
+    # NOTE: do not touch jax.process_count() (or any backend-querying API)
+    # before jax.distributed.initialize — the query initializes the XLA
+    # backend and initialize() then raises RuntimeError.
+    if _initialized or trainer_num() <= 1:
         _initialized = True
         return
     eps = trainer_endpoints()

@@ -52,6 +52,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
             env=env, stdout=out, stderr=subprocess.STDOUT if out else None,
         )
         procs.append((rank, p, out))
+    all_procs = list(procs)
 
     # watch loop: abort the whole job if any worker dies (parity with
     # distributed/utils.py TrainerProc watch)
@@ -70,16 +71,23 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                     for _, q, _ in procs:
                         if q.poll() is None:
                             q.send_signal(signal.SIGTERM)
-                    procs = []
                     alive = []
                     break
             procs = alive
             if procs:
                 time.sleep(1)
     finally:
-        for _, p, out in procs:
+        # terminate, then reap every child and close its log handle so a
+        # failed job leaves no zombies and no buffered log tail unflushed
+        for _, p, out in all_procs:
             if p.poll() is None:
                 p.terminate()
+        for _, p, out in all_procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
             if out:
                 out.close()
     return exit_code

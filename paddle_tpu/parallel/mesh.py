@@ -47,6 +47,16 @@ def build_mesh(config: MeshConfig | Sequence[Tuple[str, int]],
     shape = tuple(s for _, s in axes)
     names = tuple(n for n, _ in axes)
     total = int(np.prod(shape))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh axes {axes} need {total} devices, have {len(devices)}")
+    had_inferred = any(s == -1 for _, s in config.axes)
+    if had_inferred and total != len(devices):
+        # an inferred axis must tile the device count exactly — silently
+        # running on a subset would skew per-device batch math
+        raise ValueError(
+            f"mesh axes {axes} (with inferred size) cover {total} of "
+            f"{len(devices)} devices — sizes must tile the device count")
     dev_array = np.array(devices[:total]).reshape(shape)
     return Mesh(dev_array, names)
 

@@ -1,0 +1,296 @@
+"""4D-parallel training engine: dp x pp x tp (+sequence parallel) on one mesh.
+
+The reference's parallelism is NCCL data-parallel (ParallelExecutor SSA graph,
+framework/parallel_executor.cc) plus a threaded pipeline trainer
+(framework/pipeline_trainer.cc + section_worker.cc: stages pass Scopes through
+blocking queues) — there is no tensor or sequence parallelism (SURVEY.md §2.3).
+This module is the TPU-native superset, one compiled XLA program instead of
+thread queues:
+
+- **dp**: batch sharded over the ``dp`` mesh axis; gradient all-reduce is a
+  single psum (replaces AllReduceOpHandle / FusedAllReduceOpHandle —
+  framework/details/all_reduce_op_handle.cc).
+- **pp**: GPipe. Block params are stacked [num_layers, ...] and sharded over
+  ``pp`` on the layer axis; the microbatch schedule is a ``lax.scan`` over
+  M + S - 1 ticks with a ``ppermute`` shifting activations stage->stage+1
+  over ICI each tick (replaces SectionWorker scope queues).
+- **tp + sp**: Megatron tensor parallel over ``tp`` (QKV/fc column-split,
+  proj/out row-split) with *sequence parallelism*: activations between blocks
+  stay sharded on the sequence dim over ``tp``, so the row-parallel psum
+  becomes a reduce_scatter and layernorms/dropout run on 1/tp of the tokens.
+
+Gradient correctness uses one uniform rule: inside shard_map each rank
+differentiates the *global* (fully psum-ed) loss w.r.t. its local param
+shards, then each leaf's grad is psum-ed over every mesh axis **not**
+appearing in that leaf's PartitionSpec. This is valid because every
+replicated-leaf use happens on sequence-sharded activations (partial sums
+over tp), tick-masked stages contribute exact zeros (over pp), and the loss
+is batch-partial over dp.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..models import gpt as gpt_mod
+from ..models.gpt import GPTConfig
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=False)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    microbatches: int = 1          # GPipe microbatches (>= pp for low bubble)
+    axis_names: Tuple[str, str, str] = ("dp", "pp", "tp")
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.tp
+
+
+def build_mesh(pcfg: ParallelConfig, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = pcfg.n_devices
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(pcfg.dp, pcfg.pp, pcfg.tp)
+    return Mesh(arr, pcfg.axis_names)
+
+
+def _axes_not_in_spec(spec: P, axis_names) -> Tuple[str, ...]:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return tuple(a for a in axis_names if a not in used)
+
+
+def psum_grads_by_spec(grads, specs, axis_names):
+    """psum each grad leaf over the mesh axes its param is replicated on."""
+    def one(g, s):
+        axes = _axes_not_in_spec(s, axis_names)
+        return jax.lax.psum(g, axes) if axes else g
+
+    return jax.tree_util.tree_map(one, grads, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, specs, mesh):
+    """Place a param pytree on the mesh per its specs."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+
+# ---------------------------------------------------------------------------
+# The per-rank loss: full GPipe/TP/SP forward + CE, returns the GLOBAL loss.
+# ---------------------------------------------------------------------------
+
+def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
+                   pcfg: ParallelConfig):
+    """Runs inside shard_map. Local shapes:
+    tokens/labels [M, mb_local, T]; params['blocks'] leaves [L/pp, ...] with
+    tp-local head/ffn dims; replicated leaves full-size.
+    Returns the global mean token loss (replicated scalar).
+    """
+    dp_ax, pp_ax, tp_ax = pcfg.axis_names
+    S, M = pcfg.pp, pcfg.microbatches
+    tp = pcfg.tp
+    stage = jax.lax.axis_index(pp_ax)
+    tp_idx = jax.lax.axis_index(tp_ax)
+
+    M_, mb, T = tokens.shape
+    Ts = T // tp
+    blocks = params["blocks"]
+
+    def seq_chunk(x2d):  # [mb, T] -> tp-local [mb, Ts]
+        return jax.lax.dynamic_slice_in_dim(x2d, tp_idx * Ts, Ts, axis=1)
+
+    def stage_fn(x):
+        return gpt_mod.run_blocks(blocks, x, cfg,
+                                  tp_axis=tp_ax if tp > 1 else None)
+
+    def mb_loss(x, lbl):  # x [mb, Ts, D] seq-sharded; lbl [mb, T]
+        logits = gpt_mod.logits_fn(params, x, cfg)     # [mb, Ts, V]
+        return gpt_mod.token_ce(logits, seq_chunk(lbl))
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    total_tokens = M * mb * T  # per-dp-rank token count (dp summed via psum)
+
+    def tick(carry, t):
+        state, loss_acc = carry
+        mb_in = jnp.clip(t, 0, M - 1)
+        tok = jax.lax.dynamic_index_in_dim(tokens, mb_in, axis=0,
+                                           keepdims=False)
+        # stage 0 consumes the embedded microbatch; others consume the
+        # ppermuted activation from the previous stage
+        x_emb = gpt_mod.embed(params, seq_chunk(tok), cfg,
+                              pos_offset=tp_idx * Ts)
+        x_in = jnp.where(stage == 0, x_emb, state)
+        out = stage_fn(x_in)
+        # last stage emits a finished microbatch at ticks S-1 .. S-1+M-1
+        out_idx = t - (S - 1)
+        valid = (stage == S - 1) & (out_idx >= 0) & (out_idx < M)
+        lbl = jax.lax.dynamic_index_in_dim(
+            labels, jnp.clip(out_idx, 0, M - 1), axis=0, keepdims=False)
+        l = mb_loss(out, lbl)
+        loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+        state = jax.lax.ppermute(out, pp_ax, perm) if S > 1 else out
+        return (state, loss_acc), None
+
+    D = cfg.d_model
+    state0 = jnp.zeros((mb, Ts, D), cfg.dtype)
+    n_ticks = M + S - 1
+    (state, loss_sum), _ = jax.lax.scan(
+        tick, (state0, jnp.float32(0.0)), jnp.arange(n_ticks))
+
+    # Return the rank-LOCAL partial loss normalized by the GLOBAL token count.
+    # Deliberately no psum here: this function is differentiated per-rank
+    # under shard_map, and with replication checking off a psum would
+    # transpose to another psum, scaling every grad by the rank count.
+    # Summing the per-rank scalars happens (a) implicitly for grads — SPMD AD
+    # seeds cotangent 1 on every rank, so collective transposes yield
+    # d(sum_r local_r)/d(local shard) — and (b) explicitly for the reported
+    # loss value, via the psum in grad_fn OUTSIDE value_and_grad.
+    denom = total_tokens * pcfg.dp
+    return loss_sum / denom
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def init_adamw_state(params):
+    zeros = lambda p: jax.tree_util.tree_map(jnp.zeros_like, p)
+    return {"m": zeros(params), "v": zeros(params),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _adamw_update(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
+                  weight_decay=0.1, grad_clip=1.0):
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree_util.tree_leaves(grads)))
+    scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-6))
+    step = opt["step"] + 1
+    c1 = 1 - b1 ** step.astype(jnp.float32)
+    c2 = 1 - b2 ** step.astype(jnp.float32)
+
+    def upd(p, g, m, v):
+        g = g.astype(jnp.float32) * scale
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        u = (m / c1) / (jnp.sqrt(v / c2) + eps)
+        return p - lr * (u + weight_decay * p), m, v
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(opt["m"])
+    flat_v = treedef.flatten_up_to(opt["v"])
+    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    new_p = treedef.unflatten([o[0] for o in out])
+    new_m = treedef.unflatten([o[1] for o in out])
+    new_v = treedef.unflatten([o[2] for o in out])
+    return new_p, {"m": new_m, "v": new_v, "step": step}, gnorm
+
+
+def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
+                    lr: float = 3e-4, weight_decay: float = 0.1):
+    """Build the jitted 4D-parallel training step.
+
+    Returns ``step(params, opt_state, tokens, labels) ->
+    (params, opt_state, loss, gnorm)``. tokens/labels are
+    [microbatches, global_batch, T] int32.
+    """
+    dp_ax, pp_ax, tp_ax = pcfg.axis_names
+    specs = gpt_mod.param_specs(cfg, pp=pp_ax, tp=tp_ax)
+    data_spec = P(None, dp_ax, None)
+
+    def grad_fn(params, tokens, labels):
+        local_loss, grads = jax.value_and_grad(_pipeline_loss)(
+            params, tokens, labels, cfg, pcfg)
+        loss = jax.lax.psum(local_loss, pcfg.axis_names)
+        grads = psum_grads_by_spec(grads, specs, pcfg.axis_names)
+        return loss, grads
+
+    sharded_grad = shard_map_compat(
+        grad_fn, mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(P(), specs),
+    )
+
+    opt_specs = {"m": specs, "v": specs, "step": P()}
+    param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+    opt_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), opt_specs,
+                                    is_leaf=lambda x: isinstance(x, P))
+    data_sh = NamedSharding(mesh, data_spec)
+
+    @partial(jax.jit,
+             in_shardings=(param_sh, opt_sh, data_sh, data_sh),
+             out_shardings=(param_sh, opt_sh, None, None),
+             donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, labels):
+        loss, grads = sharded_grad(params, tokens, labels)
+        # optimizer update is elementwise: GSPMD partitions it with zero
+        # communication (replaces the reference's fuse_optimizer_ops pass)
+        params, opt_state, gnorm = _adamw_update(
+            params, grads, opt_state, lr, weight_decay=weight_decay)
+        return params, opt_state, loss, gnorm
+
+    return step
+
+
+def make_forward(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh):
+    """Jitted inference forward under dp+tp (GSPMD; pipeline folds into one
+    stage pass per rank is only needed for training throughput)."""
+    specs = gpt_mod.param_specs(cfg, pp=pcfg.axis_names[1],
+                                tp=pcfg.axis_names[2])
+    param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+
+    @partial(jax.jit, in_shardings=(param_sh, NamedSharding(mesh, P(pcfg.axis_names[0], None))))
+    def fwd(params, tokens):
+        return gpt_mod.forward(params, tokens, cfg)
+
+    return fwd
+
+
+def init_sharded(key, cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh):
+    """Initialize params + AdamW state directly with mesh shardings (large
+    models never materialize unsharded)."""
+    specs = gpt_mod.param_specs(cfg, pp=pcfg.axis_names[1], tp=pcfg.axis_names[2])
+    param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+    opt_sh = {"m": param_sh, "v": param_sh, "step": None}
+
+    init_jit = jax.jit(lambda k: gpt_mod.init_params(k, cfg),
+                       out_shardings=param_sh)
+    params = init_jit(key)
+    opt_jit = jax.jit(init_adamw_state, out_shardings=opt_sh)
+    return params, opt_jit(params)
