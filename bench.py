@@ -36,7 +36,7 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:
-        cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=False)
+        cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=True)
         batch, T, steps = 32, 1024, 10
     else:  # CPU smoke path so the bench always produces a line
         cfg = G.GPT_TINY.scaled(num_layers=2)
@@ -66,8 +66,9 @@ def main():
 
     tokens_per_s = steps * batch * T / dt
     n_params = G.num_params(params)
-    # fwd+bwd ~= 6 * N FLOPs/token (+ attention term), standard estimate
-    attn = 6 * cfg.num_layers * cfg.d_model * T
+    # fwd+bwd ~= 6 * N FLOPs/token (+ attention term), standard estimate:
+    # per layer fwd QK^T + AV = 4*T*d FLOPs/token, x3 for fwd+bwd
+    attn = 12 * cfg.num_layers * cfg.d_model * T
     flops_per_token = 6 * n_params + attn
     mfu = tokens_per_s * flops_per_token / _peak_flops(dev)
 

@@ -11,6 +11,7 @@ backward and optimizer update fuse into one XLA module, parameters are donated
 from __future__ import annotations
 
 import logging
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -94,18 +95,24 @@ class MeshPlan:
                 tuple(sorted(self.ring_axes.items())) if self.ring_axes else ())
 
 
-# value holds strong refs to (program, compiled) so an id() is never reused
-# by a different live object while its entry is cached
-_plan_cache: Dict[Tuple, Tuple[Optional[MeshPlan], Any, Any]] = {}
+# weakref-keyed: entries die with their Program instead of pinning up to
+# 4096 dead programs/executables; the compiled object is held by weakref and
+# validated by identity on lookup so id() reuse can't alias entries
+_plan_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
     """Derive the mesh plan from CompiledProgram state / program annotations.
-    Memoized per (program/compiled identity, version) — Executor.run calls
+    Memoized per (program, compiled identity, version) — Executor.run calls
     this once per step."""
-    cache_key = (id(program), id(compiled), program._version_token())
-    if cache_key in _plan_cache:
-        return _plan_cache[cache_key][0]
+    version = program._version_token()
+    sub = _plan_cache.get(program)
+    if sub is not None:
+        hit = sub.get(version)
+        if hit is not None:
+            cref, cached_plan = hit
+            if (cref() if cref is not None else None) is compiled:
+                return cached_plan
 
     plan: Optional[MeshPlan] = None
     ann = program._annotations
@@ -127,9 +134,11 @@ def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
             data_axis=m.get("data_axis"),
             ring_axes=dict(m.get("ring_axes", {})),
         )
-    if len(_plan_cache) > 4096:
-        _plan_cache.clear()
-    _plan_cache[cache_key] = (plan, program, compiled)
+    sub = _plan_cache.setdefault(program, {})
+    if len(sub) > 64:  # bound per-program version history
+        sub.clear()
+    sub[version] = (weakref.ref(compiled) if compiled is not None else None,
+                    plan)
     return plan
 
 

@@ -1,0 +1,372 @@
+"""Hand-written TPU Pallas kernels for the hot ops XLA fusion can't cover.
+
+The reference reaches for native codegen in exactly these situations —
+`operators/jit/` (xbyak CPU JIT) and `framework/ir/fusion_group/` (NVRTC
+runtime CUDA codegen) generate fused kernels at runtime. On TPU the
+equivalent is Pallas (Mosaic): VMEM-tiled kernels feeding the MXU.
+
+Currently:
+  * ``flash_attention`` — FlashAttention-2 style causal attention
+    (tiled online softmax, O(T) memory instead of the O(T^2) logits
+    materialization of the plain XLA path in models/gpt.py), with a
+    hand-written backward (custom_vjp) in the same tiling.
+
+Layout convention: the public API takes ``[B, T, nh, hd]`` (the GPT model's
+activation layout); kernels run on ``[BH, T, hd]`` with a 3-D grid
+``(BH, q_blocks, kv_blocks)`` whose last axis is sequential ("arbitrary"),
+so the running max / sum / accumulator live in VMEM scratch across kv steps.
+The softmax statistics are kept lane-replicated ``(block_q, 128)`` — the
+native TPU layout for per-row scalars.
+
+Tests run the same kernels in interpreter mode on CPU (tests/test_pallas.py);
+on TPU they compile via Mosaic.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NUM_LANES = 128
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _bcast_lanes(x, n):
+    """``x`` is (rows, 128) lane-replicated; return (rows, n) with the same
+    per-row value in every lane."""
+    if n == NUM_LANES:
+        return x
+    if n < NUM_LANES:
+        return x[:, :n]
+    rep, rem = divmod(n, NUM_LANES)
+    if rem:
+        raise ValueError(f"width {n} not a multiple of {NUM_LANES}")
+    return jnp.tile(x, (1, rep))
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    # Causal: kv block strictly above the diagonal band contributes nothing.
+    needed = True
+    if causal:
+        needed = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]                         # (block_q, hd)
+        k = k_ref[0]                         # (block_k, hd)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+
+        m_prev = m_scr[...]                             # (bq, 128) replicated
+        l_prev = l_scr[...]
+        m_curr = jnp.max(s, axis=1)[:, None]            # (bq, 1)
+        m_next = jnp.maximum(m_prev, m_curr)            # (bq, 128) replicated
+        alpha = jnp.exp(m_prev - m_next)                # (bq, 128)
+        p = jnp.exp(s - _bcast_lanes(m_next, block_k))  # (bq, bk)
+        l_next = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+        m_scr[...] = m_next
+        l_scr[...] = l_next
+
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, hd)
+        hd = acc_scr.shape[-1]
+        acc_scr[...] = acc_scr[...] * _bcast_lanes(alpha, hd) + pv
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        hd = acc_scr.shape[-1]
+        l = l_scr[...]
+        l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+        o_ref[0] = (acc_scr[...] * _bcast_lanes(l_inv, hd)).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    bh, t, hd = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    if t % block_q or tk % block_k:
+        raise ValueError(f"seq lens ({t},{tk}) must divide blocks ({block_q},{block_k})")
+    nq, nk = t // block_q, tk // block_k
+
+    grid = (bh, nq, nk)
+    kern = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=nk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, NUM_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
+            pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
+                   *, sm_scale, causal, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    needed = True
+    if causal:
+        needed = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                                 # (bq, 128) replicated
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - _bcast_lanes(lse, block_k))      # (bq, bk)
+
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        di = jnp.sum(do * o, axis=1)[:, None]            # (bq, 1)
+        ds = p * (dp - di) * sm_scale                    # (bq, bk)
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, sm_scale, causal, block_q, block_k, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    needed = True
+    if causal:
+        needed = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - _bcast_lanes(lse, block_k))      # (bq, bk)
+
+        # dV += P^T dO
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype).astype(jnp.float32), do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, hd)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        di = jnp.sum(do * o, axis=1)[:, None]
+        ds = p * (dp - di) * sm_scale                    # (bq, bk)
+        # dK += dS^T Q
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+    bh, t, hd = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    nq, nk = t // block_q, tk // block_k
+
+    dq_kern = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=nk)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, o, do, lse)
+
+    dkv_kern = functools.partial(
+        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_q=nq)
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, NUM_LANES), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, hd), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API (custom_vjp over [BH, T, hd])
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512):
+    """FlashAttention-2 on TPU (Pallas). q,k,v: [B, T, nh, hd] -> [B, T, nh, hd].
+
+    Replaces the O(T^2)-memory XLA attention in models/gpt.py when
+    ``GPTConfig.use_flash``; differentiable via hand-written Pallas backward.
+    """
+    b, t, nh, hd = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * nh, x.shape[1], hd)
+
+    def from_bh(x):
+        return x.reshape(b, nh, t, hd).transpose(0, 2, 1, 3)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, sm_scale, block_q, block_k)
+    return from_bh(o)

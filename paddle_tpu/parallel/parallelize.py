@@ -44,6 +44,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from . import mesh as mesh_mod
 from ..models import gpt as gpt_mod
 from ..models.gpt import GPTConfig
 
@@ -75,8 +76,8 @@ def build_mesh(pcfg: ParallelConfig, devices=None) -> Mesh:
     n = pcfg.n_devices
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
-    arr = np.array(devices[:n]).reshape(pcfg.dp, pcfg.pp, pcfg.tp)
-    return Mesh(arr, pcfg.axis_names)
+    return mesh_mod.build_mesh(
+        list(zip(pcfg.axis_names, (pcfg.dp, pcfg.pp, pcfg.tp))), devices[:n])
 
 
 def _axes_not_in_spec(spec: P, axis_names) -> Tuple[str, ...]:
@@ -159,8 +160,12 @@ def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
         valid = (stage == S - 1) & (out_idx >= 0) & (out_idx < M)
         lbl = jax.lax.dynamic_index_in_dim(
             labels, jnp.clip(out_idx, 0, M - 1), axis=0, keepdims=False)
-        l = mb_loss(out, lbl)
-        loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+        # lax.cond: the vocab projection + CE only runs on the last stage's
+        # M valid ticks instead of every tick on every rank (it costs more
+        # than a stage's transformer blocks at GPT_SMALL scale)
+        l = jax.lax.cond(valid, lambda: mb_loss(out, lbl),
+                         lambda: jnp.float32(0.0))
+        loss_acc = loss_acc + l
         state = jax.lax.ppermute(out, pp_ax, perm) if S > 1 else out
         return (state, loss_acc), None
 
@@ -206,7 +211,10 @@ def _adamw_update(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * g * g
         u = (m / c1) / (jnp.sqrt(v / c2) + eps)
-        return p - lr * (u + weight_decay * p), m, v
+        # standard GPT/Megatron recipe: no decay on 1-D params (biases,
+        # layernorm scales) — only matmul/embedding matrices
+        wd = weight_decay if p.ndim >= 2 else 0.0
+        return p - lr * (u + wd * p), m, v
 
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(grads)

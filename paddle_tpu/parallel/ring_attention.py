@@ -1,0 +1,83 @@
+"""Ring attention — context/sequence parallelism for long sequences.
+
+Absent from the 2020-era reference (SURVEY.md §5 "Long-context/sequence
+parallelism: none"), but first-class here: sequences longer than one chip's
+HBM are sharded over a ``cp`` mesh axis and attention runs as a ring —
+each device holds its sequence chunk of Q permanently and passes K/V chunks
+around the ring with ``lax.ppermute`` (one ICI hop per step), combining
+partial attention with an online-softmax accumulator exactly like
+FlashAttention combines KV tiles (ops/pallas_kernels.py does the same
+within a chip; this does it across chips).
+
+Peak memory per device: O((T/cp)^2) logits per ring step instead of O(T^2);
+comms: cp-1 ppermutes of the local K/V chunk, fully overlappable with
+compute by XLA (latency hiding via collective-permute pipelining).
+
+Differentiable: the ring is a ``lax.scan`` over ppermutes, both of which
+JAX transposes automatically (the VJP is itself a reverse ring).
+
+Use under ``shard_map`` with q/k/v sharded on the sequence dim over
+``axis_name``; see tests/test_ring_attention.py and models/gpt.py (cp axis).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_MASK = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Blockwise ring attention. q,k,v: local chunks [B, T/cp, nh, hd],
+    sequence-sharded over ``axis_name`` (chunk i = rows [i*Tl, (i+1)*Tl)).
+    Returns local output chunk [B, T/cp, nh, hd]. Call inside shard_map.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    cp = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, tl, nh, hd = q.shape
+
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, i):
+        kc, vc, m, l, acc = carry
+        # kc originated on device (my - i) mod cp == its global chunk index.
+        src = (my - i) % cp
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, kc.astype(jnp.float32)) * sm_scale
+        if causal:
+            # chunk-level causal: src > my fully masked; src == my intra-chunk.
+            qpos = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+            kpos = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+            intra = qpos >= kpos                       # [tl, tl]
+            keep = jnp.where(src == my, intra,
+                             jnp.broadcast_to(src < my, (tl, tl)))
+            s = jnp.where(keep[None, None], s, _MASK)
+        m_curr = jnp.max(s, axis=-1)                   # [b, nh, tl]
+        m_new = jnp.maximum(m, m_curr)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])              # [b, nh, tl, tk]
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+        acc_new = acc * alpha[..., None] + pv
+        kc, vc = jax.lax.ppermute(
+            (kc, vc), axis_name, perm=[(j, (j + 1) % cp) for j in range(cp)])
+        return (kc, vc, m_new, l_new, acc_new), None
+
+    # Derive initial accumulators from q so they carry the same manual-axes
+    # "varying over cp" type as the scan outputs (jax>=0.9 shard_map typing).
+    qt = q32.transpose(0, 2, 1, 3)                     # [b, nh, tl, hd]
+    m0 = jnp.full_like(qt[..., 0], -jnp.inf)
+    l0 = jnp.zeros_like(qt[..., 0])
+    a0 = jnp.zeros_like(qt)
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, a0), jnp.arange(cp))
+
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l[..., None]                           # [b, nh, tl, hd]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
