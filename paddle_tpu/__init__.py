@@ -50,6 +50,11 @@ from .framework.program import (  # noqa: F401
 )
 
 from . import clip  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import DataLoader  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .dataset import DatasetFactory  # noqa: F401
+from .reader import batch  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

@@ -1,0 +1,88 @@
+"""DataFeeder — convert python/numpy minibatches into Executor feed dicts.
+
+Capability parity with fluid/data_feeder.py: a DataFeeder is constructed from
+a feed_list of data Variables and converts an iterable of samples (each a
+tuple aligned with feed_list) into {name: batched numpy} with dtype/shape
+checks against the Variable declarations.  The reference converts to
+LoDTensor on the target place; here the Executor device-puts numpy directly
+(XLA owns transfers), so the feeder stops at numpy.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from .framework.core import convert_dtype
+from .framework.program import Variable
+
+__all__ = ["DataFeeder", "check_feed_shape_type"]
+
+
+def _np_dtype(var: Variable):
+    return np.dtype(convert_dtype(var.dtype))
+
+
+def check_feed_shape_type(var: Variable, arr: np.ndarray):
+    """Shape/dtype validation like the reference's need_check_feed path
+    (framework/executor.py check_feed_shape_type)."""
+    declared = list(var.shape)
+    actual = list(arr.shape)
+    if len(declared) == len(actual):
+        for d, a in zip(declared, actual):
+            if d not in (-1, None) and d != a:
+                raise ValueError(
+                    f"feed '{var.name}': declared shape {declared} but got "
+                    f"{actual}")
+    want = _np_dtype(var)
+    if arr.dtype != want:
+        # allow safe same-kind casts (int32->int64 etc.), reject e.g. float->int
+        if np.can_cast(arr.dtype, want, casting="same_kind"):
+            arr = arr.astype(want)
+        else:
+            raise ValueError(
+                f"feed '{var.name}': declared dtype {want} but got {arr.dtype}")
+    return arr
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence[Variable], place=None,
+                 program=None):
+        self.feed_list = list(feed_list)
+        self.place = place
+
+    def feed(self, iterable: Sequence[Sequence[Any]]) -> Dict[str, np.ndarray]:
+        """iterable: list of samples, each sample aligned with feed_list."""
+        cols: List[List[Any]] = [[] for _ in self.feed_list]
+        for sample in iterable:
+            if len(sample) != len(self.feed_list):
+                raise ValueError(
+                    f"sample has {len(sample)} fields, feed_list expects "
+                    f"{len(self.feed_list)}")
+            for c, v in zip(cols, sample):
+                c.append(np.asarray(v))
+        out: Dict[str, np.ndarray] = {}
+        for var, c in zip(self.feed_list, cols):
+            arr = np.stack(c).astype(_np_dtype(var), copy=False)
+            # fluid.layers.data declares [-1, d...]; samples may come flat
+            want_rank = len(var.shape)
+            if arr.ndim == want_rank - 1 and var.shape[-1] == 1:
+                arr = arr.reshape(arr.shape + (1,))
+            elif arr.ndim < want_rank:
+                static = [d for d in var.shape if d not in (-1, None)]
+                if static and int(np.prod(arr.shape[1:])) == int(np.prod(static)):
+                    arr = arr.reshape((arr.shape[0], *static))
+            out[var.name] = check_feed_shape_type(var, arr)
+        return out
+
+    def feed_parallel(self, iterable, num_places: int):
+        """Split one batch across num_places shards (ParallelExecutor-era
+        API, fluid/data_feeder.py feed_parallel)."""
+        feeds = self.feed(iterable)
+        shards = []
+        for i in range(num_places):
+            shard = {}
+            for k, v in feeds.items():
+                shard[k] = np.array_split(v, num_places)[i]
+            shards.append(shard)
+        return shards

@@ -1,0 +1,315 @@
+"""Dataset / DataFeed engine — out-of-Python data path for Rec/PS workloads.
+
+Capability parity with the reference's C++ engine (framework/data_set.cc,
+framework/data_feed.cc): a Dataset owns a file list in the MultiSlot text
+format (each line = one instance; each slot contributes "<n> v1 ... vn"
+tokens — uint64 ids for sparse slots, floats for dense slots), supports
+in-memory load + local/global shuffle + file-list sharding across trainers,
+and feeds the Executor's ``train_from_dataset`` loop.
+
+The parsing hot path is native C++ (paddle_tpu/native/slot_parser.cpp, the
+analogue of MultiSlotDataFeed::ParseOneInstance at data_feed.cc:~700), loaded
+via ctypes with a pure-Python fallback.
+
+TPU-first batching decision: the reference emits LoDTensors with ragged
+offsets; XLA wants static shapes, so variable-length id slots are emitted as
+padded ``[batch, maxlen]`` int64 arrays (pad id 0) plus a ``<slot>__len``
+int64 length vector when requested — the same information content as LoD,
+in a compiler-friendly layout.
+"""
+from __future__ import annotations
+
+import ctypes
+import glob as _glob
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .framework.core import convert_dtype
+from .framework.program import Variable
+
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+# ---------------------------------------------------------------------------
+# native parser binding
+# ---------------------------------------------------------------------------
+
+_lib = None
+_lib_tried = False
+
+
+def _native_lib():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        try:
+            from . import native
+            lib = native.load_library("slot_parser")
+            lib.ps_parse.restype = ctypes.c_void_p
+            lib.ps_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_ubyte),
+                                     ctypes.c_int64]
+            lib.ps_num_instances.restype = ctypes.c_int64
+            lib.ps_num_instances.argtypes = [ctypes.c_void_p]
+            lib.ps_error_line.restype = ctypes.c_int
+            lib.ps_error_line.argtypes = [ctypes.c_void_p]
+            lib.ps_slot_fvals.restype = ctypes.POINTER(ctypes.c_double)
+            lib.ps_slot_fvals.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.POINTER(ctypes.c_int64)]
+            lib.ps_slot_ivals.restype = ctypes.POINTER(ctypes.c_uint64)
+            lib.ps_slot_ivals.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.POINTER(ctypes.c_int64)]
+            lib.ps_slot_lod.restype = ctypes.POINTER(ctypes.c_int64)
+            lib.ps_slot_lod.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.POINTER(ctypes.c_int64)]
+            lib.ps_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _lib = None
+    return _lib
+
+
+def parse_multislot(text: bytes, slot_is_float: Sequence[bool],
+                    force_python: bool = False
+                    ) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    """Parse a MultiSlot text buffer.
+
+    Returns (values, lods): per slot, a flat value array (float64 or uint64)
+    and an int64 offsets array of length n_instances+1.
+    """
+    lib = None if force_python else _native_lib()
+    flags = list(bool(f) for f in slot_is_float)
+    if lib is not None:
+        n_slots = len(flags)
+        flag_arr = (ctypes.c_ubyte * n_slots)(*[1 if f else 0 for f in flags])
+        h = lib.ps_parse(text, len(text), flag_arr, n_slots)
+        try:
+            if lib.ps_error_line(h) >= 0:
+                raise ValueError(
+                    f"malformed MultiSlot record at line {lib.ps_error_line(h)}")
+            values, lods = [], []
+            n = ctypes.c_int64()
+            for s in range(n_slots):
+                if flags[s]:
+                    ptr = lib.ps_slot_fvals(h, s, ctypes.byref(n))
+                    vals = (np.ctypeslib.as_array(ptr, shape=(n.value,)).copy()
+                            if n.value else np.empty((0,), np.float64))
+                else:
+                    ptr = lib.ps_slot_ivals(h, s, ctypes.byref(n))
+                    vals = (np.ctypeslib.as_array(ptr, shape=(n.value,)).copy()
+                            if n.value else np.empty((0,), np.uint64))
+                lptr = lib.ps_slot_lod(h, s, ctypes.byref(n))
+                lod = np.ctypeslib.as_array(lptr, shape=(n.value,)).copy()
+                values.append(vals)
+                lods.append(lod)
+            return values, lods
+        finally:
+            lib.ps_free(h)
+    # Python fallback
+    values_py: List[List[float]] = [[] for _ in flags]
+    lods_py: List[List[int]] = [[0] for _ in flags]
+    for line_no, line in enumerate(text.decode("utf-8").splitlines()):
+        toks = line.split()
+        if not toks:
+            continue
+        pos = 0
+        parsed: List[List[float]] = []
+        try:
+            for is_f in flags:
+                cnt = int(toks[pos]); pos += 1
+                if pos + cnt > len(toks):
+                    raise IndexError
+                conv = float if is_f else int
+                parsed.append([conv(t) for t in toks[pos:pos + cnt]])
+                pos += cnt
+        except (ValueError, IndexError):
+            raise ValueError(f"malformed MultiSlot record at line {line_no}")
+        for s, vals in enumerate(parsed):
+            values_py[s].extend(vals)
+            lods_py[s].append(len(values_py[s]))
+    return ([np.asarray(v, dtype=np.float64 if f else np.uint64)
+             for v, f in zip(values_py, flags)],
+            [np.asarray(l, dtype=np.int64) for l in lods_py])
+
+
+# ---------------------------------------------------------------------------
+# Dataset
+# ---------------------------------------------------------------------------
+
+class DatasetBase:
+    """Common config surface — python/paddle/fluid/dataset.py DatasetBase."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: List[str] = []
+        self.use_vars: List[Variable] = []
+        self.drop_last = False
+        self.emit_lengths = False  # also yield <slot>__len vectors
+        self.pad_to: Optional[int] = None  # fixed sparse-slot pad length
+        self._trainer_id = 0
+        self._trainer_num = 1
+
+    # reference setter surface
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        out: List[str] = []
+        for f in filelist:
+            hits = sorted(_glob.glob(f))
+            out.extend(hits if hits else [f])
+        self.filelist = out
+
+    def set_use_var(self, var_list: Sequence[Variable]):
+        self.use_vars = list(var_list)
+
+    def set_hdfs_config(self, fs_name, fs_ugi):  # accepted for parity
+        pass
+
+    def set_trainer_shard(self, trainer_id: int, trainer_num: int):
+        """File-list sharding across trainers (data_set.cc file dispatch)."""
+        self._trainer_id = trainer_id
+        self._trainer_num = trainer_num
+
+    def set_pad_to(self, maxlen: Optional[int]):
+        """Fix the padded length of sparse id slots.  None (default) buckets
+        the per-batch max up to the next power of two, so the Executor's jit
+        cache sees O(log maxlen) distinct shapes instead of one per batch."""
+        self.pad_to = maxlen
+
+    # -- schema -------------------------------------------------------------
+    def _slot_schema(self):
+        if not self.use_vars:
+            raise ValueError("call set_use_var before reading the dataset")
+        is_float, dims, dtypes = [], [], []
+        for v in self.use_vars:
+            np_dt = np.dtype(convert_dtype(v.dtype))
+            is_float.append(np_dt.kind == "f")
+            static = [d for d in v.shape if d not in (-1, None)]
+            dims.append(int(np.prod(static)) if static else 1)
+            dtypes.append(np_dt)
+        return is_float, dims, dtypes
+
+    def _my_files(self):
+        return [f for i, f in enumerate(self.filelist)
+                if i % self._trainer_num == self._trainer_id]
+
+    def _parse_file(self, path: str):
+        is_float, _, _ = self._slot_schema()
+        with open(path, "rb") as f:
+            return parse_multislot(f.read(), is_float)
+
+    def _instances_of(self, values, lods):
+        """Decompose parsed columnar data back into per-instance tuples of
+        per-slot value arrays (needed for shuffling)."""
+        n = len(lods[0]) - 1
+        out = []
+        for i in range(n):
+            inst = tuple(vals[lod[i]:lod[i + 1]]
+                         for vals, lod in zip(values, lods))
+            out.append(inst)
+        return out
+
+    def _batch_to_feed(self, instances) -> Dict[str, np.ndarray]:
+        is_float, dims, dtypes = self._slot_schema()
+        feed: Dict[str, np.ndarray] = {}
+        for s, var in enumerate(self.use_vars):
+            col = [inst[s] for inst in instances]
+            if is_float[s]:
+                # dense slot: every instance must carry dims[s] values
+                arr = np.stack([c.astype(dtypes[s]) for c in col])
+                static = [d for d in var.shape if d not in (-1, None)]
+                if static:
+                    arr = arr.reshape((len(col), *static))
+                feed[var.name] = arr
+            else:
+                maxlen = max((len(c) for c in col), default=1) or 1
+                if self.pad_to is not None:
+                    if maxlen > self.pad_to:
+                        raise ValueError(
+                            f"slot '{var.name}' has an instance with {maxlen} "
+                            f"ids > set_pad_to({self.pad_to})")
+                    maxlen = self.pad_to
+                else:
+                    # bucket to next power of two: static-shape friendliness
+                    # without a user-declared bound (see module docstring)
+                    maxlen = 1 << (maxlen - 1).bit_length()
+                padded = np.zeros((len(col), maxlen), dtype=np.int64)
+                lens = np.zeros((len(col),), dtype=np.int64)
+                for i, c in enumerate(col):
+                    padded[i, :len(c)] = c.astype(np.int64)
+                    lens[i] = len(c)
+                feed[var.name] = padded
+                if self.emit_lengths:
+                    feed[var.name + "__len"] = lens
+        return feed
+
+
+class InMemoryDataset(DatasetBase):
+    """load_into_memory + local/global shuffle — data_set.cc InMemoryDataset."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: List[Tuple[np.ndarray, ...]] = []
+
+    def load_into_memory(self):
+        self._memory = []
+        for path in self._my_files():
+            values, lods = self._parse_file(path)
+            self._memory.extend(self._instances_of(values, lods))
+
+    def local_shuffle(self):
+        random.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        # single-host capability: reference RPC-shuffles across trainers
+        # (data_set.cc GlobalShuffle); with one host this is a local shuffle.
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._memory = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def __iter__(self):
+        bs = self.batch_size
+        for i in range(0, len(self._memory), bs):
+            chunk = self._memory[i:i + bs]
+            if len(chunk) < bs and self.drop_last:
+                break
+            yield self._batch_to_feed(chunk)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming file-at-a-time dataset — data_set.cc QueueDataset (no
+    in-memory materialization; instances flow straight to batches)."""
+
+    def __iter__(self):
+        pending: List[Tuple[np.ndarray, ...]] = []
+        bs = self.batch_size
+        for path in self._my_files():
+            values, lods = self._parse_file(path)
+            pending.extend(self._instances_of(values, lods))
+            while len(pending) >= bs:
+                yield self._batch_to_feed(pending[:bs])
+                pending = pending[bs:]
+        if pending and not self.drop_last:
+            yield self._batch_to_feed(pending)
+
+
+class DatasetFactory:
+    """fluid.DatasetFactory().create_dataset(name) — dataset.py factory."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")

@@ -111,8 +111,16 @@ def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
         hit = sub.get(version)
         if hit is not None:
             cref, cached_plan = hit
-            if (cref() if cref is not None else None) is compiled:
-                return cached_plan
+            if cref is None:
+                if compiled is None:
+                    return cached_plan
+            else:
+                # a dead weakref must NOT match compiled=None — the cached
+                # plan belonged to a (now GC'd) CompiledProgram, while a plain
+                # run must re-derive from program annotations
+                target = cref()
+                if target is not None and target is compiled:
+                    return cached_plan
 
     plan: Optional[MeshPlan] = None
     ann = program._annotations
@@ -398,6 +406,56 @@ class Executor:
     def run_startup(self, startup_program: Program, scope: Optional[Scope] = None):
         """Convenience alias: startup programs run through the same path."""
         return self.run(program=startup_program, feed={}, fetch_list=[], scope=scope)
+
+    # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None,
+                           print_period: int = 100):
+        """Dataset trainer path — parity with fluid/executor.py:1448.
+
+        The reference hands the Dataset to C++ trainer threads
+        (Executor::RunFromDataset → HogwildWorker loops); here each parsed
+        batch feeds the SAME whole-program XLA computation as ``run`` — the
+        jit cache makes the per-batch dispatch cost negligible, and XLA's
+        async dispatch overlaps host parsing with device compute.
+        """
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period, train=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None,
+                           print_period: int = 100):
+        """Parity with fluid/executor.py:1381 (no optimizer side effects is
+        the caller's responsibility, as in the reference)."""
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period, train=False)
+
+    def _run_from_dataset(self, program, dataset, scope, fetch_list,
+                          fetch_info, print_period, train: bool):
+        if dataset is None:
+            raise ValueError("dataset must be provided")
+        program = program or default_main_program()
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [
+            (v.name if isinstance(v, Variable) else str(v)) for v in fetch_list
+        ]
+        feed_names = {v.name for v in getattr(dataset, "use_vars", [])}
+        step = 0
+        last_fetch = None
+        for batch_feed in dataset:
+            feed = {k: v for k, v in batch_feed.items()
+                    if not feed_names or k in feed_names or k.endswith("__len")}
+            last_fetch = self.run(program=program, feed=feed,
+                                  fetch_list=fetch_list, scope=scope)
+            step += 1
+            if fetch_list and print_period and step % print_period == 0:
+                msg = ", ".join(
+                    f"{name}={np.asarray(val).ravel()[:4]}"
+                    for name, val in zip(fetch_info, last_fetch))
+                logger.info("step %d: %s", step, msg)
+        return last_fetch
 
 
 def _analyze_persistables(program: Program) -> Tuple[List[str], List[str]]:

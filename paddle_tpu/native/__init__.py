@@ -1,0 +1,61 @@
+"""Native (C++) runtime components and their build/load infrastructure.
+
+The reference keeps its data engine, PS runtime, and allocators in C++
+(framework/data_feed.cc, operators/distributed/, memory/allocation/).  Here the
+XLA runtime owns device execution, but host-side hot paths (slot parsing for
+the Dataset engine, the parameter-server table) are real C++ shared libraries,
+compiled on first use with the system toolchain and loaded via ctypes.
+
+Build artifacts are cached under ``paddle_tpu/native/_build/`` keyed by source
+mtime, so the cost is paid once per source change.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_SRC_DIR, "_build")
+_lock = threading.Lock()
+_loaded: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _compiler() -> str:
+    return os.environ.get("CXX", "g++")
+
+
+def load_library(name: str, extra_flags=()):
+    """Compile ``<name>.cpp`` to a shared library (if stale) and dlopen it.
+
+    Returns a ctypes.CDLL.  Raises NativeBuildError if no C++ toolchain is
+    available — callers must degrade to their Python fallback.
+    """
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        src = os.path.join(_SRC_DIR, name + ".cpp")
+        if not os.path.exists(src):
+            raise NativeBuildError(f"no such native source: {src}")
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            cmd = [_compiler(), "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-o", out, src, "-pthread", *extra_flags]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+            except FileNotFoundError as e:
+                raise NativeBuildError(f"C++ compiler not found: {e}") from e
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native build of {name} failed:\n{proc.stderr[-4000:]}")
+        lib = ctypes.CDLL(out)
+        _loaded[name] = lib
+        return lib

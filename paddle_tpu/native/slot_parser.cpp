@@ -1,0 +1,154 @@
+// MultiSlot record parser — the native hot path of the Dataset engine.
+//
+// Capability parity with the reference's C++ DataFeed
+// (framework/data_feed.cc MultiSlotDataFeed::ParseOneInstance /
+// MultiSlotInMemoryDataFeed): parses the textual MultiSlot format, where each
+// line is one instance and each slot contributes "<n> v1 ... vn" tokens —
+// uint64 feasign ids for sparse slots, floats for dense slots.  The parse is
+// done in C++ because PaddleRec-style workloads push hundreds of MB of text
+// per trainer through this path; Python tokenisation is ~30x slower.
+//
+// Interface (ctypes): parse a whole buffer, get per-slot flat value arrays +
+// per-slot LoD offset arrays (length n_instances+1), then free the handle.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct SlotData {
+  std::vector<double> fvals;     // dense/float slots
+  std::vector<uint64_t> ivals;   // sparse/id slots
+  std::vector<int64_t> lod;      // offsets, lod[0]=0, size n_instances+1
+};
+
+struct ParseHandle {
+  std::vector<SlotData> slots;
+  int64_t n_instances = 0;
+  int error_line = -1;  // first malformed line, -1 if clean
+};
+
+// Fast forward over spaces/tabs/CR.
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+inline bool parse_u64(const char*& p, const char* end, uint64_t* out) {
+  p = skip_ws(p, end);
+  if (p >= end || *p < '0' || *p > '9') return false;
+  uint64_t v = 0;
+  while (p < end && *p >= '0' && *p <= '9') { v = v * 10 + (*p - '0'); ++p; }
+  *out = v;
+  return true;
+}
+
+inline bool parse_f64(const char*& p, const char* end, double* out) {
+  p = skip_ws(p, end);
+  if (p >= end) return false;
+  char* q = nullptr;
+  // strtod stops at the first non-number char; line is not NUL-terminated at
+  // its end, but the buffer always ends with '\n' or we pass a bounded copy.
+  double v = strtod(p, &q);
+  if (q == p) return false;
+  *out = v;
+  p = q;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// slot_is_float: per-slot flag array (1 = float slot, 0 = uint64 id slot).
+// Returns an opaque handle (never null); check ps_error_line() for failures.
+void* ps_parse(const char* buf, int64_t len, const unsigned char* slot_is_float,
+               int64_t n_slots) {
+  auto* h = new ParseHandle();
+  h->slots.resize(n_slots);
+  for (auto& s : h->slots) s.lod.push_back(0);
+
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t line_no = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    const char* q = skip_ws(p, line_end);
+    if (q < line_end) {  // non-empty line
+      bool ok = true;
+      for (int64_t s = 0; s < n_slots && ok; ++s) {
+        uint64_t n = 0;
+        ok = parse_u64(q, line_end, &n);
+        if (!ok) break;
+        SlotData& sd = h->slots[s];
+        if (slot_is_float[s]) {
+          for (uint64_t i = 0; i < n && ok; ++i) {
+            double v;
+            ok = parse_f64(q, line_end, &v);
+            if (ok) sd.fvals.push_back(v);
+          }
+        } else {
+          for (uint64_t i = 0; i < n && ok; ++i) {
+            uint64_t v;
+            ok = parse_u64(q, line_end, &v);
+            if (ok) sd.ivals.push_back(v);
+          }
+        }
+        if (ok) sd.lod.push_back(slot_is_float[s]
+                                     ? static_cast<int64_t>(sd.fvals.size())
+                                     : static_cast<int64_t>(sd.ivals.size()));
+      }
+      if (!ok) {
+        if (h->error_line < 0) h->error_line = static_cast<int>(line_no);
+        // roll back the partially-parsed instance: truncate every slot to the
+        // state after the last complete instance.
+        for (int64_t s = 0; s < n_slots; ++s) {
+          SlotData& sd = h->slots[s];
+          sd.lod.resize(h->n_instances + 1);
+          int64_t keep = sd.lod.back();
+          if (slot_is_float[s]) sd.fvals.resize(keep);
+          else sd.ivals.resize(keep);
+        }
+      } else {
+        ++h->n_instances;
+      }
+    }
+    ++line_no;
+    p = line_end + 1;
+  }
+  return h;
+}
+
+int64_t ps_num_instances(void* handle) {
+  return static_cast<ParseHandle*>(handle)->n_instances;
+}
+
+int ps_error_line(void* handle) {
+  return static_cast<ParseHandle*>(handle)->error_line;
+}
+
+// Returns pointer to the slot's flat values; *n_out = element count.
+const double* ps_slot_fvals(void* handle, int64_t slot, int64_t* n_out) {
+  auto& sd = static_cast<ParseHandle*>(handle)->slots[slot];
+  *n_out = static_cast<int64_t>(sd.fvals.size());
+  return sd.fvals.data();
+}
+
+const uint64_t* ps_slot_ivals(void* handle, int64_t slot, int64_t* n_out) {
+  auto& sd = static_cast<ParseHandle*>(handle)->slots[slot];
+  *n_out = static_cast<int64_t>(sd.ivals.size());
+  return sd.ivals.data();
+}
+
+const int64_t* ps_slot_lod(void* handle, int64_t slot, int64_t* n_out) {
+  auto& sd = static_cast<ParseHandle*>(handle)->slots[slot];
+  *n_out = static_cast<int64_t>(sd.lod.size());
+  return sd.lod.data();
+}
+
+void ps_free(void* handle) { delete static_cast<ParseHandle*>(handle); }
+
+}  // extern "C"

@@ -45,8 +45,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
     q32 = q.astype(jnp.float32)
 
-    def step(carry, i):
-        kc, vc, m, l, acc = carry
+    def accumulate(kc, vc, m, l, acc, i):
         # kc originated on device (my - i) mod cp == its global chunk index.
         src = (my - i) % cp
         s = jnp.einsum("bqhd,bkhd->bhqk", q32, kc.astype(jnp.float32)) * sm_scale
@@ -65,9 +64,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         l_new = l * alpha + jnp.sum(p, axis=-1)
         pv = jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
         acc_new = acc * alpha[..., None] + pv
+        return m_new, l_new, acc_new
+
+    def step(carry, i):
+        kc, vc, m, l, acc = carry
+        m, l, acc = accumulate(kc, vc, m, l, acc, i)
         kc, vc = jax.lax.ppermute(
             (kc, vc), axis_name, perm=[(j, (j + 1) % cp) for j in range(cp)])
-        return (kc, vc, m_new, l_new, acc_new), None
+        return (kc, vc, m, l, acc), None
 
     # Derive initial accumulators from q so they carry the same manual-axes
     # "varying over cp" type as the scan outputs (jax>=0.9 shard_map typing).
@@ -75,8 +79,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     m0 = jnp.full_like(qt[..., 0], -jnp.inf)
     l0 = jnp.zeros_like(qt[..., 0])
     a0 = jnp.zeros_like(qt)
-    (_, _, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, m0, l0, a0), jnp.arange(cp))
+    # cp-1 rotate-and-accumulate steps in the scan, then the last chunk is
+    # consumed outside it — no wasted final ppermute (one full K/V ICI hop
+    # per layer forward and its transpose in backward).
+    (kc, vc, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, a0), jnp.arange(cp - 1))
+    m, l, acc = accumulate(kc, vc, m, l, acc, cp - 1)
 
     l = jnp.where(l == 0.0, 1.0, l)
     out = acc / l[..., None]                           # [b, nh, tl, hd]

@@ -1,0 +1,524 @@
+"""Reader decorators + DataLoader — the Python data pipeline.
+
+Capability parity with the reference's reader stack:
+  * reader decorators (python/paddle/reader/decorator.py): ``shuffle``,
+    ``buffered``, ``batch``, ``compose``, ``chain``, ``map_readers``,
+    ``xmap_readers``, ``cache``, ``firstn``, ``multiprocess_reader``.
+  * ``DataLoader`` (fluid/reader.py + fluid/dataloader/): both the
+    ``from_generator`` capacity-buffered feed path and the map-style
+    ``DataLoader(dataset, batch_size, num_workers, ...)`` with real
+    multiprocess workers (fluid/dataloader/dataloader_iter.py).
+
+TPU-first design: instead of the reference's LoDTensorBlockingQueue +
+buffered_reader double-buffering onto a CUDA stream (operators/reader/
+buffered_reader.cc), batches are staged as numpy on a background thread and
+handed to the Executor, which device-puts them; under jit the transfer
+overlaps with the previous step's compute because JAX dispatch is async.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import queue as _queue
+import random
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "shuffle", "buffered", "batch", "compose", "chain", "map_readers",
+    "xmap_readers", "cache", "firstn", "multiprocess_reader",
+    "Dataset", "IterableDataset", "BatchSampler", "DataLoader",
+]
+
+
+# ---------------------------------------------------------------------------
+# reader decorators (a "reader" is a zero-arg callable returning an iterator
+# of samples — the reference's reader protocol)
+# ---------------------------------------------------------------------------
+
+def map_readers(func, *readers):
+    """Apply func elementwise over samples zipped from several readers."""
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Pool-shuffle with a bounded buffer — decorator.py shuffle."""
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+            # fall through keeps filling
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            for s in r():
+                yield s
+    return chained
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into tuple samples; flattens tuple elements like the
+    reference (reader/decorator.py compose): with check_alignment=True,
+    length mismatch raises ComposeNotAligned; with False, silently truncates
+    to the shortest reader."""
+    _missing = object()
+
+    def _flatten(x):
+        out = []
+        for e in x:
+            if isinstance(e, tuple):
+                out.extend(e)
+            else:
+                out.append(e)
+        return tuple(out)
+
+    def composed():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for vals in itertools.zip_longest(*rs, fillvalue=_missing):
+                if any(v is _missing for v in vals):
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                yield _flatten(vals)
+        else:
+            for vals in zip(*rs):
+                yield _flatten(vals)
+    return composed
+
+
+def buffered(reader, size: int):
+    """Producer-thread read-ahead buffer — decorator.py buffered.
+    Producer exceptions are re-raised in the consumer, not swallowed."""
+    _end = object()
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for s in reader():
+                    q.put((False, s))
+            except BaseException as e:
+                q.put((True, e))
+            else:
+                q.put((False, _end))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            is_err, s = q.get()
+            if is_err:
+                raise s
+            if s is _end:
+                break
+            yield s
+    return buffered_reader
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group samples into lists of batch_size — paddle.batch."""
+    def batched():
+        b = []
+        for s in reader():
+            b.append(s)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batched
+
+
+def cache(reader):
+    all_data: List[Any] = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        for s in all_data:
+            yield s
+    return cached
+
+
+def firstn(reader, n: int):
+    def firstn_reader():
+        for i, s in enumerate(reader()):
+            if i >= n:
+                break
+            yield s
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map over a reader using worker threads (reference uses
+    threads too — decorator.py xmap_readers)."""
+    _end = object()
+
+    def xreader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(_end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _end:
+                    out_q.put(_end)
+                    return
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending: Dict[int, Any] = {}
+            next_i = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is _end:
+                    finished += 1
+                    continue
+                i, v = item
+                pending[i] = v
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _end:
+                    finished += 1
+                    continue
+                yield item[1]
+    return xreader
+
+
+_MP_END = ("__paddle_tpu_mp_end__",)
+_MP_ERR = "__paddle_tpu_mp_err__"
+
+
+def multiprocess_reader(readers, use_pipe: bool = True, queue_size: int = 1000):
+    """Fan-in several readers, each in its own OS process (decorator.py
+    multiprocess_reader).  Worker exceptions propagate to the consumer as
+    RuntimeError (exceptions may not pickle across the process boundary, so
+    the traceback travels as text); samples that are literally None are fine
+    because the end-of-stream sentinel is a distinct marker."""
+    def mreader():
+        import traceback
+        ctx = multiprocessing.get_context("fork")
+        q = ctx.Queue(queue_size)
+
+        def work(r):
+            try:
+                for s in r():
+                    q.put(("s", s))
+            except BaseException:
+                q.put((_MP_ERR, traceback.format_exc()))
+            else:
+                q.put(_MP_END)
+
+        procs = [ctx.Process(target=work, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                item = q.get()
+                if item == _MP_END:
+                    finished += 1
+                elif isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] == _MP_ERR:
+                    raise RuntimeError(
+                        f"multiprocess_reader worker failed:\n{item[1]}")
+                else:
+                    yield item[1]
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join()
+    return mreader
+
+
+# ---------------------------------------------------------------------------
+# map/iterable datasets + samplers (fluid/dataloader/dataset.py,
+# batch_sampler.py)
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    """Map-style dataset: __getitem__ + __len__."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset is not subscriptable")
+
+    def __len__(self):
+        raise TypeError("IterableDataset has no len()")
+
+
+class BatchSampler:
+    def __init__(self, dataset=None, indices=None, shuffle: bool = False,
+                 batch_size: int = 1, drop_last: bool = False):
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        if indices is None:
+            indices = list(range(len(dataset)))
+        self.indices = list(indices)
+
+    def __iter__(self):
+        idx = list(self.indices)
+        if self.shuffle:
+            random.shuffle(idx)
+        b = []
+        for i in idx:
+            b.append(i)
+            if len(b) == self.batch_size:
+                yield b
+                b = []
+        if b and not self.drop_last:
+            yield b
+
+    def __len__(self):
+        n = len(self.indices)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(samples: Sequence[Any]):
+    """Stack a list of samples (each a tuple/list of field arrays) into
+    per-field numpy batches — fluid/dataloader/collate.py."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(default_collate_fn([s[i] for s in samples])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate_fn([s[k] for s in samples]) for k in first}
+    if isinstance(first, np.ndarray):
+        return np.stack(samples)
+    if isinstance(first, (int, np.integer)):
+        return np.asarray(samples, dtype=np.int64)
+    if isinstance(first, (float, np.floating)):
+        return np.asarray(samples, dtype=np.float32)
+    return np.asarray(samples)
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+_WORKER_END = "__paddle_tpu_worker_end__"
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+    while True:
+        item = index_queue.get()
+        if item == _WORKER_END:
+            return
+        seq, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data_queue.put((seq, collate_fn(samples)))
+        except Exception as e:  # surface worker errors to the parent
+            data_queue.put((seq, e))
+
+
+class DataLoader:
+    """paddle.io.DataLoader / fluid.io.DataLoader capability.
+
+    Two construction paths, like the reference:
+      * ``DataLoader(dataset, feed_list=..., batch_size=..., num_workers=N)``
+      * ``DataLoader.from_generator(feed_list, capacity)`` then
+        ``set_sample_generator`` / ``set_sample_list_generator`` /
+        ``set_batch_generator``.
+
+    Iterating yields feed dicts (name -> numpy array) when feed_list is given,
+    else tuples of numpy arrays.
+    """
+
+    def __init__(self, dataset=None, feed_list=None, batch_size: int = 1,
+                 shuffle: bool = False, drop_last: bool = False,
+                 num_workers: int = 0, collate_fn=None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 return_list: bool = True, capacity: int = 8):
+        self.dataset = dataset
+        self.feed_list = list(feed_list) if feed_list else None
+        self.num_workers = int(num_workers)
+        self.collate_fn = collate_fn or default_collate_fn
+        self.capacity = capacity
+        self.return_list = return_list
+        self._generator: Optional[Callable] = None
+        self._gen_kind: Optional[str] = None
+        if dataset is not None and not isinstance(dataset, IterableDataset):
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    # -- from_generator path (fluid/reader.py DataLoader.from_generator) ----
+    @classmethod
+    def from_generator(cls, feed_list=None, capacity: int = 8,
+                       use_double_buffer: bool = True, iterable: bool = True,
+                       return_list: bool = False, drop_last: bool = True):
+        return cls(feed_list=feed_list, capacity=capacity,
+                   return_list=return_list, drop_last=drop_last)
+
+    def set_sample_generator(self, reader, batch_size: int,
+                             drop_last: bool = True, places=None):
+        self._generator = batch(reader, batch_size, drop_last=drop_last)
+        self._gen_kind = "sample_list"
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        self._generator = reader
+        self._gen_kind = "sample_list"
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._generator = reader
+        self._gen_kind = "batch"
+        return self
+
+    # -- iteration ----------------------------------------------------------
+    def _names(self):
+        if not self.feed_list:
+            return None
+        return [v if isinstance(v, str) else v.name for v in self.feed_list]
+
+    def _emit(self, fields):
+        names = self._names()
+        if names is None:
+            return tuple(fields)
+        return {n: f for n, f in zip(names, fields)}
+
+    def __iter__(self):
+        if self._generator is not None:
+            yield from self._iter_generator()
+        elif isinstance(self.dataset, IterableDataset):
+            yield from self._iter_iterable()
+        elif self.num_workers > 0:
+            yield from self._iter_multiprocess()
+        else:
+            yield from self._iter_single()
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("DataLoader over a generator has no len()")
+
+    def _iter_generator(self):
+        assert self._generator is not None
+        gen = buffered(self._generator, self.capacity)
+        if self._gen_kind == "batch":
+            for fields in gen():
+                fields = [np.asarray(f) for f in (
+                    fields if isinstance(fields, (tuple, list)) else [fields])]
+                yield self._emit(fields)
+        else:  # sample_list: list of per-sample tuples
+            for samples in gen():
+                cols = self.collate_fn(samples)
+                cols = cols if isinstance(cols, tuple) else (cols,)
+                yield self._emit([np.asarray(c) for c in cols])
+
+    def _iter_iterable(self):
+        b = []
+        for s in iter(self.dataset):
+            b.append(s)
+            if len(b) == self.batch_size:
+                cols = self.collate_fn(b)
+                yield self._emit(list(cols if isinstance(cols, tuple) else (cols,)))
+                b = []
+        if b and not self.drop_last:
+            cols = self.collate_fn(b)
+            yield self._emit(list(cols if isinstance(cols, tuple) else (cols,)))
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            cols = self.collate_fn([self.dataset[i] for i in indices])
+            yield self._emit(list(cols if isinstance(cols, tuple) else (cols,)))
+
+    def _iter_multiprocess(self):
+        ctx = multiprocessing.get_context("fork")
+        index_q = ctx.Queue()
+        data_q = ctx.Queue(self.capacity)
+        workers = [ctx.Process(target=_worker_loop,
+                               args=(self.dataset, index_q, data_q,
+                                     self.collate_fn), daemon=True)
+                   for _ in range(self.num_workers)]
+        for w in workers:
+            w.start()
+        try:
+            batches = list(self.batch_sampler)
+            for seq, indices in enumerate(batches):
+                index_q.put((seq, indices))
+            for _ in workers:
+                index_q.put(_WORKER_END)
+            pending: Dict[int, Any] = {}
+            next_seq = 0
+            received = 0
+            while received < len(batches):
+                seq, cols = data_q.get()
+                received += 1
+                if isinstance(cols, Exception):
+                    raise cols
+                pending[seq] = cols
+                while next_seq in pending:
+                    cols = pending.pop(next_seq)
+                    next_seq += 1
+                    yield self._emit(
+                        list(cols if isinstance(cols, tuple) else (cols,)))
+        finally:
+            for w in workers:
+                w.terminate()
+            for w in workers:
+                w.join()

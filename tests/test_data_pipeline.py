@@ -1,0 +1,245 @@
+"""Data pipeline tests: reader decorators, DataFeeder, DataLoader,
+Dataset/MultiSlot parser (native C++ vs Python fallback), and
+Executor.train_from_dataset end-to-end."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as R
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.dataset import DatasetFactory, parse_multislot
+
+
+# ---------------------------------------------------------------------------
+# reader decorators
+# ---------------------------------------------------------------------------
+
+def _counter(n):
+    def r():
+        for i in range(n):
+            yield i
+    return r
+
+
+def test_reader_decorators():
+    assert list(R.firstn(_counter(10), 3)()) == [0, 1, 2]
+    assert sorted(R.shuffle(_counter(10), 4)()) == list(range(10))
+    assert list(R.chain(_counter(2), _counter(3))()) == [0, 1, 0, 1, 2]
+    assert list(R.batch(_counter(5), 2)()) == [[0, 1], [2, 3], [4]]
+    assert list(R.batch(_counter(5), 2, drop_last=True)()) == [[0, 1], [2, 3]]
+    assert list(R.map_readers(lambda a, b: a + b, _counter(3), _counter(3))()) \
+        == [0, 2, 4]
+    assert list(R.buffered(_counter(100), 10)()) == list(range(100))
+    got = sorted(R.xmap_readers(lambda x: x * 2, _counter(20), 4, 8)())
+    assert got == [2 * i for i in range(20)]
+    ordered = list(R.xmap_readers(lambda x: x * 2, _counter(20), 4, 8,
+                                  order=True)())
+    assert ordered == [2 * i for i in range(20)]
+    cached = R.cache(_counter(4))
+    assert list(cached()) == list(cached()) == [0, 1, 2, 3]
+    comp = R.compose(_counter(3), _counter(3))
+    assert list(comp()) == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_compose_alignment():
+    with pytest.raises(R.ComposeNotAligned):
+        list(R.compose(_counter(3), _counter(5))())
+    # check_alignment=False truncates silently
+    assert list(R.compose(_counter(3), _counter(5),
+                          check_alignment=False)()) == [(0, 0), (1, 1), (2, 2)]
+
+
+def test_reader_error_propagation():
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        list(R.buffered(bad, 4)())
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(R.multiprocess_reader([bad])())
+
+
+def test_multiprocess_reader():
+    got = sorted(R.multiprocess_reader([_counter(5), _counter(5)])())
+    assert got == sorted(list(range(5)) * 2)
+
+
+# ---------------------------------------------------------------------------
+# DataFeeder
+# ---------------------------------------------------------------------------
+
+def test_data_feeder():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+    feeder = DataFeeder([x, y])
+    samples = [(np.ones(4, np.float32) * i, np.array([i])) for i in range(3)]
+    feed = feeder.feed(samples)
+    assert feed["x"].shape == (3, 4) and feed["x"].dtype == np.float32
+    assert feed["y"].shape == (3, 1) and feed["y"].dtype == np.int64
+    np.testing.assert_allclose(feed["x"][2], 2.0)
+
+    with pytest.raises(ValueError):
+        feeder.feed([(np.ones(5, np.float32), np.array([0]))])  # bad shape
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+class _SquareDataset(R.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_single_process():
+    dl = fluid.DataLoader(_SquareDataset(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3 and len(dl) == 3
+    x, y = batches[0]
+    np.testing.assert_allclose(x, [0, 1, 2, 3])
+    np.testing.assert_allclose(y, [0, 1, 4, 9])
+
+
+def test_dataloader_multiprocess_ordered():
+    dl = fluid.DataLoader(_SquareDataset(32), batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 8
+    xs = np.concatenate([b[0] for b in batches])
+    np.testing.assert_allclose(xs, np.arange(32, dtype=np.float32))
+
+
+def test_dataloader_from_generator():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [2], dtype="float32")
+    loader = fluid.DataLoader.from_generator(feed_list=[x], capacity=4)
+
+    def gen():
+        for i in range(6):
+            yield (np.full((2,), i, np.float32),)
+
+    loader.set_sample_generator(gen, batch_size=3)
+    feeds = list(loader)
+    assert len(feeds) == 2
+    assert set(feeds[0].keys()) == {"x"}
+    assert feeds[0]["x"].shape == (3, 2)
+
+
+# ---------------------------------------------------------------------------
+# MultiSlot parsing — native vs python
+# ---------------------------------------------------------------------------
+
+MULTISLOT = b"""2 10 20 3 0.5 1.5 2.5 1 7
+1 30 3 1.0 2.0 3.0 1 8
+"""
+
+
+def test_parse_multislot_both_paths():
+    # slots: ids (sparse), float dense dim3, label id
+    for force_py in (False, True):
+        values, lods = parse_multislot(MULTISLOT, [False, True, False],
+                                       force_python=force_py)
+        np.testing.assert_array_equal(values[0], [10, 20, 30])
+        np.testing.assert_array_equal(lods[0], [0, 2, 3])
+        np.testing.assert_allclose(values[1], [0.5, 1.5, 2.5, 1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(lods[1], [0, 3, 6])
+        np.testing.assert_array_equal(values[2], [7, 8])
+
+
+def test_parse_multislot_malformed():
+    for force_py in (False, True):
+        with pytest.raises(ValueError):
+            parse_multislot(b"3 1 2\n", [False], force_python=force_py)
+
+
+def test_parse_multislot_native_available():
+    from paddle_tpu.dataset import _native_lib
+    assert _native_lib() is not None, "native slot parser failed to build"
+
+
+# ---------------------------------------------------------------------------
+# Dataset end-to-end: train_from_dataset on a tiny linear regression
+# ---------------------------------------------------------------------------
+
+def _write_regression_files(tmpdir, n_files=2, rows=64):
+    rng = np.random.RandomState(0)
+    w_true = np.array([1.5, -2.0, 0.5, 3.0], np.float32)
+    paths = []
+    for fi in range(n_files):
+        path = os.path.join(tmpdir, f"part-{fi}")
+        with open(path, "w") as f:
+            for _ in range(rows):
+                x = rng.randn(4).astype(np.float32)
+                y = float(x @ w_true)
+                xs = " ".join(f"{v:.6f}" for v in x)
+                f.write(f"4 {xs} 1 {y:.6f}\n")
+        paths.append(path)
+    return paths
+
+
+def test_train_from_dataset(tmp_path):
+    paths = _write_regression_files(str(tmp_path))
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+    dataset = DatasetFactory().create_dataset("InMemoryDataset")
+    dataset.set_use_var([x, y])
+    dataset.set_batch_size(16)
+    dataset.set_filelist(paths)
+    dataset.load_into_memory()
+    dataset.local_shuffle()
+    assert dataset.get_memory_data_size() == 128
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup)
+    first = None
+    for epoch in range(8):
+        out = exe.train_from_dataset(prog, dataset, fetch_list=[loss])
+        if first is None:
+            first = float(out[0])
+    assert float(out[0]) < first * 0.1, (first, float(out[0]))
+
+
+def test_queue_dataset_streams(tmp_path):
+    paths = _write_regression_files(str(tmp_path), n_files=3, rows=10)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_use_var([x, y])
+    ds.set_batch_size(8)
+    ds.set_filelist(paths)
+    batches = list(ds)
+    assert sum(b["x"].shape[0] for b in batches) == 30
+    assert batches[0]["x"].shape == (8, 4)
+
+
+def test_dataset_trainer_sharding(tmp_path):
+    paths = _write_regression_files(str(tmp_path), n_files=4, rows=5)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_use_var([x, y])
+    ds.set_filelist(paths)
+    ds.set_trainer_shard(1, 2)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 10  # 2 of 4 files
