@@ -9,3 +9,7 @@ stack had no tensor/sequence parallelism — SURVEY.md §2.3; this is the
 north-star GPT config built TPU-first).
 """
 from . import gpt  # noqa: F401
+from . import resnet  # noqa: F401
+from . import mobilenet  # noqa: F401
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+from .mobilenet import MobileNet, mobilenet_v1, mobilenet_v2  # noqa: F401

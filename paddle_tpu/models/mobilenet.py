@@ -1,0 +1,105 @@
+"""MobileNetV1/V2 — static-graph builders in the fluid layer style.
+
+Depthwise convs hit the conv2d lowering with feature_group_count == channels
+(paddle_tpu/ops/nn.py conv2d/depthwise_conv2d); XLA lowers grouped convs to
+the TPU conv unit directly.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..framework.param_attr import ParamAttr
+
+__all__ = ["mobilenet_v1", "mobilenet_v2", "MobileNet"]
+
+
+def _conv_bn(x, num_filters, filter_size, stride=1, groups=1, act="relu",
+             is_test=False, name: str = ""):
+    x = layers.conv2d(
+        x, num_filters, filter_size, stride=stride,
+        padding=(filter_size - 1) // 2, groups=groups,
+        param_attr=ParamAttr(name=name + "_weights"), bias_attr=False,
+        name=name + ".conv")
+    return layers.batch_norm(
+        x, act=act, is_test=is_test,
+        param_attr=ParamAttr(name=name + "_bn_scale"),
+        bias_attr=ParamAttr(name=name + "_bn_offset"),
+        moving_mean_name=name + "_bn_mean",
+        moving_variance_name=name + "_bn_variance")
+
+
+def _depthwise_separable(x, ch_out, stride, scale, is_test, name):
+    ch_in = x.shape[1]
+    x = _conv_bn(x, ch_in, 3, stride=stride, groups=ch_in, is_test=is_test,
+                 name=name + "_dw")
+    return _conv_bn(x, int(ch_out * scale), 1, is_test=is_test,
+                    name=name + "_sep")
+
+
+def mobilenet_v1(input, class_dim: int = 1000, scale: float = 1.0,
+                 is_test: bool = False, prefix: str = "mbv1"):
+    s = lambda c: int(c * scale)
+    x = _conv_bn(input, s(32), 3, stride=2, is_test=is_test,
+                 name=prefix + "_conv1")
+    cfg = [  # (ch_out, stride)
+        (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+        (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1),
+    ]
+    for i, (ch, st) in enumerate(cfg):
+        x = _depthwise_separable(x, ch, st, scale, is_test,
+                                 f"{prefix}_ds{i + 2}")
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    return layers.fc(x, class_dim,
+                     param_attr=ParamAttr(name=prefix + "_fc_weights"),
+                     bias_attr=ParamAttr(name=prefix + "_fc_offset"))
+
+
+def _inverted_residual(x, ch_out, stride, expansion, is_test, name):
+    ch_in = x.shape[1]
+    hidden = ch_in * expansion
+    y = x
+    if expansion != 1:
+        y = _conv_bn(y, hidden, 1, act="relu6", is_test=is_test,
+                     name=name + "_expand")
+    y = _conv_bn(y, hidden, 3, stride=stride, groups=hidden, act="relu6",
+                 is_test=is_test, name=name + "_dw")
+    y = _conv_bn(y, ch_out, 1, act=None, is_test=is_test,
+                 name=name + "_project")
+    if stride == 1 and ch_in == ch_out:
+        return layers.elementwise_add(x, y)
+    return y
+
+
+def mobilenet_v2(input, class_dim: int = 1000, scale: float = 1.0,
+                 is_test: bool = False, prefix: str = "mbv2"):
+    s = lambda c: max(8, int(c * scale))
+    x = _conv_bn(input, s(32), 3, stride=2, act="relu6", is_test=is_test,
+                 name=prefix + "_conv1")
+    cfg = [  # (expansion, ch_out, repeats, stride)
+        (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+        (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+    ]
+    idx = 0
+    for t, c, n, st in cfg:
+        for i in range(n):
+            x = _inverted_residual(x, s(c), st if i == 0 else 1, t, is_test,
+                                   f"{prefix}_ir{idx}")
+            idx += 1
+    x = _conv_bn(x, s(1280), 1, act="relu6", is_test=is_test,
+                 name=prefix + "_conv_last")
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    return layers.fc(x, class_dim,
+                     param_attr=ParamAttr(name=prefix + "_fc_weights"),
+                     bias_attr=ParamAttr(name=prefix + "_fc_offset"))
+
+
+class MobileNet:
+    def __init__(self, scale: float = 1.0, version: int = 1,
+                 prefix: str = "mbv"):
+        self.scale = scale
+        self.version = version
+        self.prefix = prefix + str(version)
+
+    def net(self, input, class_dim: int = 1000, is_test: bool = False):
+        fn = mobilenet_v1 if self.version == 1 else mobilenet_v2
+        return fn(input, class_dim=class_dim, scale=self.scale,
+                  is_test=is_test, prefix=self.prefix)

@@ -1,0 +1,83 @@
+"""Vision model family: graph construction sanity (param counts vs the
+published architectures) and a book-style convergence test on a tiny input."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet, mobilenet
+
+
+def _param_count(prog):
+    total = 0
+    for var in prog.global_block().vars.values():
+        if isinstance(var, fluid.Parameter) and var.trainable:
+            total += int(np.prod(var.shape))
+    return total
+
+
+def test_resnet50_param_count():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        img = fluid.layers.data("img", [3, 224, 224], dtype="float32")
+        logits = resnet.resnet50(img, class_dim=1000)
+    assert logits.shape[-1] == 1000
+    n = _param_count(prog)
+    # torchvision resnet50: 25,557,032 (incl. BN affine params)
+    assert abs(n - 25_557_032) < 30_000, n
+
+
+def test_resnet18_param_count():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        img = fluid.layers.data("img", [3, 224, 224], dtype="float32")
+        resnet.resnet18(img, class_dim=1000)
+    n = _param_count(prog)
+    # torchvision resnet18: 11,689,512
+    assert abs(n - 11_689_512) < 20_000, n
+
+
+def test_mobilenet_v2_param_count():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        img = fluid.layers.data("img", [3, 224, 224], dtype="float32")
+        mobilenet.mobilenet_v2(img, class_dim=1000)
+    n = _param_count(prog)
+    # torchvision mobilenet_v2: 3,504,872
+    assert abs(n - 3_504_872) < 40_000, n
+
+
+def test_small_resnet_trains():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        logits = resnet.resnet18(img, class_dim=4)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.MomentumOptimizer(0.02, 0.9).minimize(loss)
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # learnable synthetic task: class = quadrant with strongest mean signal
+    imgs = rng.randn(32, 3, 32, 32).astype(np.float32)
+    labels = rng.randint(0, 4, (32, 1)).astype(np.int64)
+    for i in range(32):
+        c = int(labels[i, 0])
+        imgs[i, c % 3] += 2.0 * (1 + c)
+    losses = [float(exe.run(prog, feed={"img": imgs, "label": labels},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_mobilenet_v1_forward():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        logits = mobilenet.mobilenet_v1(img, class_dim=10, scale=0.25,
+                                        is_test=True)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup)
+    out = exe.run(prog, feed={"img": np.zeros((2, 3, 32, 32), np.float32)},
+                  fetch_list=[logits])[0]
+    assert out.shape == (2, 10)
+    assert np.isfinite(out).all()
