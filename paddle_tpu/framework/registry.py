@@ -264,9 +264,13 @@ def lower_vjp_grad(ctx: LowerCtx, op, ins, fwd_spec: OpSpec):
             cotangents.append(g)
             i += 1
 
-    # jax.vjp requires non-None cotangents matching primal structure
+    # jax.vjp requires non-None cotangents matching primal structure; under
+    # AMP a consumer computing in f32 can hand back an f32 cotangent for a
+    # bf16 output — align dtypes to the primal (the cast is exact f32<-bf16)
     cotangents = [
-        jnp.zeros_like(p) if (g is None and p is not None) else g
+        jnp.zeros_like(p) if (g is None and p is not None)
+        else (g.astype(p.dtype) if (g is not None and p is not None
+                                    and g.dtype != p.dtype) else g)
         for g, p in zip(cotangents, primal_flat)
     ]
     (grads,) = vjp_fn(cotangents)

@@ -63,6 +63,15 @@ def shape_op(ctx, op, ins):
 def _broadcast_y(x, y, axis):
     if x.ndim == y.ndim or y.ndim == 0:
         return y
+    if y.ndim > x.ndim:
+        # Y of higher rank only broadcasts if its extra leading dims are 1
+        # (e.g. scalar loss * [1]-shaped loss_scaling); squeeze them away.
+        extra = y.ndim - x.ndim
+        if any(d != 1 for d in y.shape[:extra]):
+            raise ValueError(
+                f"elementwise broadcast: Y rank {y.ndim} > X rank {x.ndim} "
+                f"with non-unit leading dims {y.shape}")
+        return jnp.reshape(y, y.shape[extra:])
     if axis is None or axis == -1:
         axis = x.ndim - y.ndim
     # insert trailing singleton dims so y aligns at `axis`

@@ -223,7 +223,9 @@ def conv2d(ctx, op, ins):
         rhs_dilation=dilations,
         dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None,
+        # no preferred_element_type: its transpose rule mixes an f32 cotangent
+        # with the low-precision filter and lax.conv rejects mixed dtypes;
+        # TPU convs accumulate bf16 inputs in f32 inside the MXU regardless
     ).astype(x.dtype)
     return {"Output": out}
 

@@ -1,0 +1,103 @@
+"""AMP tests: program rewrite (cast insertion + dtype propagation), bf16
+training convergence, fp16 dynamic loss scaling state machine."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import mixed_precision as mp
+
+
+def _build_mlp():
+    img = fluid.layers.data("img", [16], dtype="float32")
+    label = fluid.layers.data("label", [1], dtype="int64")
+    h = fluid.layers.fc(img, 32, act="relu")
+    logits = fluid.layers.fc(h, 4)
+    loss = fluid.layers.reduce_mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    return img, label, loss
+
+
+def test_rewrite_inserts_casts():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img, label, loss = _build_mlp()
+    n_ops_before = len(prog.global_block().ops)
+    mp.rewrite_program(prog, mp.AutoMixedPrecisionLists(), "bfloat16")
+    block = prog.global_block()
+    cast_ops = [op for op in block.ops if op.type == "cast"]
+    assert cast_ops, "no casts inserted"
+    assert len(block.ops) > n_ops_before
+    # every mul (fc matmul) now consumes bf16 inputs
+    for op in block.ops:
+        if op.type == "mul":
+            for n in op.input_arg_names:
+                assert str(block.var(n).dtype) == "bfloat16", (op, n)
+
+
+def test_bf16_training_converges():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img, label, loss = _build_mlp()
+        opt = mp.decorate(fluid.optimizer.SGDOptimizer(0.1))
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 16).astype(np.float32)
+    y = (x[:, :4].argmax(1)).astype(np.int64).reshape(-1, 1)
+    losses = [float(exe.run(prog, feed={"img": x, "label": y},
+                            fetch_list=[loss])[0]) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # parameters stayed fp32 master copies
+    for v in prog.global_block().vars.values():
+        if isinstance(v, fluid.Parameter):
+            assert str(v.dtype) == "float32"
+
+
+def test_fp16_dynamic_loss_scaling_recovers_from_overflow():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img, label, loss = _build_mlp()
+        opt = mp.decorate(fluid.optimizer.SGDOptimizer(0.05), use_bf16=False,
+                          init_loss_scaling=2.0 ** 10,
+                          decr_every_n_nan_or_inf=1, incr_every_n_steps=4)
+        opt.minimize(loss)
+    scaling_var = opt.get_loss_scaling()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 16).astype(np.float32)
+    y = rng.randint(0, 4, (32, 1)).astype(np.int64)
+    scales = []
+    for step in range(10):
+        feed_x = x.copy()
+        if step == 2:  # poison one step to force non-finite grads
+            feed_x[0, 0] = np.inf
+        _, s = exe.run(prog, feed={"img": feed_x, "label": y},
+                       fetch_list=[loss, scaling_var])
+        scales.append(float(s[0]))
+    assert scales[2] < scales[1], scales  # overflow halved the scale
+    assert scales[-1] > scales[2], scales  # good steps grew it back
+    # weights unharmed by the poisoned step
+    state = fluid.io.get_program_state(prog)
+    for name, arr in state.items():
+        assert np.isfinite(arr).all(), name
+
+
+def test_amp_resnet_smoke():
+    from paddle_tpu.models import resnet
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        logits = resnet.resnet18(img, class_dim=4)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        mp.decorate(fluid.optimizer.MomentumOptimizer(0.01, 0.9)).minimize(loss)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    out = exe.run(prog, feed={
+        "img": rng.randn(8, 3, 32, 32).astype(np.float32),
+        "label": rng.randint(0, 4, (8, 1)).astype(np.int64)},
+        fetch_list=[loss])
+    assert np.isfinite(out[0]).all()
