@@ -15,4 +15,11 @@ from .nn import (  # noqa: F401
 )
 from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
-from .jit import TracedLayer  # noqa: F401
+from . import jit  # noqa: F401
+from .jit import (  # noqa: F401
+    InputSpec,
+    ProgramTranslator,
+    TracedLayer,
+    declarative,
+    to_static,
+)

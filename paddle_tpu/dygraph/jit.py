@@ -1,24 +1,159 @@
-"""Dygraph→compiled tracing — parity with fluid/dygraph/jit.py TracedLayer and
-the ProgramTranslator north star (dygraph_to_static): a dygraph Layer traces
-straight into jax.jit."""
+"""Dygraph→compiled tracing — parity with fluid/dygraph/jit.py (TracedLayer,
+jit save/load) and dygraph_to_static/program_translator.py (ProgramTranslator,
+@declarative).
+
+TPU-native design: the reference's ProgramTranslator rewrites Python AST into
+static-graph ops; here tracing IS jax.jit — @declarative stages the dygraph
+function once per input signature, and ``save`` serializes the traced
+computation as StableHLO via jax.export (the deployment artifact that replaces
+the reference's saved ProgramDesc + persistables, io.py:1093).
+"""
 from __future__ import annotations
 
-from typing import Callable, List
+import json
+import os
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .layers import Layer
 from .varbase import VarBase, no_grad_ctx
 
+__all__ = ["TracedLayer", "declarative", "to_static", "ProgramTranslator",
+           "InputSpec", "save", "load", "TranslatedLayer", "not_to_static"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec equivalent: declared feed signature for save."""
+
+    def __init__(self, shape: Sequence[int], dtype: str = "float32",
+                 name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_sds(self) -> jax.ShapeDtypeStruct:
+        shape = tuple(1 if d in (-1, None) else int(d) for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, jnp.dtype(self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class ProgramTranslator:
+    """Singleton switch for @declarative staging — parity with
+    dygraph_to_static/program_translator.py ProgramTranslator.enable()."""
+
+    _instance: Optional["ProgramTranslator"] = None
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    @classmethod
+    def get_instance(cls) -> "ProgramTranslator":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        self.enable_to_static = bool(enable_to_static)
+
+
+def _unwrap(v):
+    return v.value if isinstance(v, VarBase) else v
+
+
+class _StaticFunction:
+    """A dygraph callable staged per input signature (shape/dtype key)."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None):
+        self._fn = fn
+        self._layer = layer
+        self._cache = {}
+
+    def _pure(self):
+        fn, layer = self._fn, self._layer
+        if layer is None:
+            def pure(param_vals, *vs):
+                wrapped = [VarBase(v, stop_gradient=True)
+                           if hasattr(v, "shape") else v for v in vs]
+                with no_grad_ctx():
+                    out = fn(*wrapped)
+                return jax.tree.map(_unwrap, out)
+            return pure, []
+
+        names = list(layer.state_dict().keys())
+
+        def pure(param_vals, *vs):
+            sd = layer.state_dict()
+            saved = [sd[k].value for k in names]
+            try:
+                for k, v in zip(names, param_vals):
+                    sd[k].value = v
+                wrapped = [VarBase(v, stop_gradient=True)
+                           if hasattr(v, "shape") else v for v in vs]
+                with no_grad_ctx():
+                    out = fn(*wrapped)
+                return jax.tree.map(_unwrap, out)
+            finally:
+                for k, v in zip(names, saved):
+                    sd[k].value = v
+        return pure, names
+
+    def __call__(self, *args, **kwargs):
+        if not ProgramTranslator.get_instance().enable_to_static:
+            return self._fn(*args, **kwargs)
+        if kwargs:
+            return self._fn(*args, **kwargs)  # kwargs fall back to eager
+        vals = tuple(_unwrap(a) for a in args)
+        key = tuple((tuple(v.shape), str(v.dtype)) if hasattr(v, "shape")
+                    else ("py", v) for v in vals)
+        if key not in self._cache:
+            pure, names = self._pure()
+            self._cache[key] = (jax.jit(pure), names)
+        jitted, names = self._cache[key]
+        sd = self._layer.state_dict() if self._layer is not None else {}
+        param_vals = [sd[k].value for k in names]
+        out = jitted(param_vals, *vals)
+        return jax.tree.map(
+            lambda o: VarBase(o, stop_gradient=True)
+            if hasattr(o, "shape") else o, out)
+
+
+def declarative(fn: Callable = None):
+    """@declarative / @paddle.jit.to_static: stage a dygraph function through
+    jax.jit.  Bound Layer.forward methods are handled by `save` directly."""
+    if fn is None:
+        return declarative
+
+    sf = _StaticFunction(fn)
+
+    def wrapper(*args, **kwargs):
+        return sf(*args, **kwargs)
+
+    wrapper.__wrapped__ = fn
+    wrapper._static_function = sf
+    return wrapper
+
+
+to_static = declarative
+
+
+def not_to_static(fn: Callable):
+    """Marker: never stage this function (parity with paddle.jit.not_to_static)."""
+    fn._not_to_static = True
+    return fn
+
 
 class TracedLayer:
-    """Wraps a dygraph Layer as a jitted pure function of (params, inputs)."""
+    """Wraps a dygraph Layer as a jitted pure function of (params, inputs) —
+    fluid/dygraph/jit.py TracedLayer."""
 
     def __init__(self, layer: Layer):
         self._layer = layer
-        params = list(layer.state_dict().items())
-        self._param_names = [k for k, _ in params]
+        self._param_names = list(layer.state_dict().keys())
 
         def pure_fn(param_vals, *input_vals):
             sd = layer.state_dict()
@@ -35,60 +170,111 @@ class TracedLayer:
                 for k, v in zip(self._param_names, saved):
                     sd[k].value = v
 
+        self._pure_fn = pure_fn
         self._jitted = jax.jit(pure_fn)
+        self._example_inputs = None
 
     @staticmethod
     def trace(layer: Layer, inputs: List[VarBase]):
         tl = TracedLayer(layer)
+        tl._example_inputs = [i.value if isinstance(i, VarBase) else jnp.asarray(i)
+                              for i in inputs]
         out = tl(*inputs)
         return out, tl
 
     def __call__(self, *inputs):
         sd = self._layer.state_dict()
         param_vals = [sd[k].value for k in self._param_names]
-        input_vals = [i.value if isinstance(i, VarBase) else jnp.asarray(i) for i in inputs]
+        input_vals = [i.value if isinstance(i, VarBase) else jnp.asarray(i)
+                      for i in inputs]
+        if self._example_inputs is None:
+            self._example_inputs = input_vals
         out = self._jitted(param_vals, *input_vals)
         if isinstance(out, tuple):
             return [VarBase(o, stop_gradient=True) for o in out]
         return VarBase(out, stop_gradient=True)
 
     def save_inference_model(self, path, feed=None, fetch=None):
-        """Export the traced computation as StableHLO text (TPU-native
-        inference artifact — reference saves a pruned ProgramDesc)."""
-        sd = self._layer.state_dict()
-        param_vals = [sd[k].value for k in self._param_names]
-
-        def f(*input_vals):
-            return self._jitted(param_vals, *input_vals)
-
-        import os
-
-        os.makedirs(path, exist_ok=True)
-        # Export requires example shapes; users call after a trace() run.
-        with open(os.path.join(path, "model.stablehlo.txt"), "w") as fh:
-            fh.write("traced-jit module; use jax.export for serialization\n")
+        """Serialize params + StableHLO of the traced forward; load with
+        paddle_tpu.dygraph.jit.load."""
+        if self._example_inputs is None:
+            raise RuntimeError("trace the layer (call it once) before saving")
+        specs = [InputSpec(v.shape, str(v.dtype)) for v in self._example_inputs]
+        save(self._layer, path, input_spec=specs)
 
 
-def declarative(fn: Callable):
-    """@declarative / @to_static decorator: jit the dygraph function."""
-    jitted = {}
+# ---------------------------------------------------------------------------
+# jit.save / jit.load — deployment round trip
+# ---------------------------------------------------------------------------
 
-    def wrapper(*args, **kwargs):
-        vals = tuple(a.value if isinstance(a, VarBase) else a for a in args)
-        key = tuple((v.shape, str(v.dtype)) if hasattr(v, "shape") else v for v in vals)
-        if key not in jitted:
-            def pure(*vs):
-                wrapped = [VarBase(v, stop_gradient=True) if hasattr(v, "shape") else v
-                           for v in vs]
-                with no_grad_ctx():
-                    out = fn(*wrapped, **kwargs)
-                return out.value if isinstance(out, VarBase) else out
+def save(layer, path: str, input_spec: Optional[Sequence] = None):
+    """paddle.jit.save equivalent: writes
+      <path>/model.shlo     — jax.export StableHLO of fn(params, *inputs)
+      <path>/params.npz     — parameter arrays (fp32 masters)
+      <path>/meta.json      — param names + input signature
+    """
+    from jax import export as jexport
 
-            jitted[key] = jax.jit(pure)
-        out = jitted[key](*vals)
-        return VarBase(out, stop_gradient=True)
+    if isinstance(layer, Layer):
+        sf = _StaticFunction(layer.forward, layer=layer)
+        pure, names = sf._pure()
+        sd = layer.state_dict()
+        param_vals = [np.asarray(sd[k].value) for k in names]
+    else:  # plain @declarative function
+        fn = getattr(layer, "__wrapped__", layer)
+        sf = _StaticFunction(fn)
+        pure, names = sf._pure()
+        param_vals = []
 
-    return wrapper
+    if input_spec is None:
+        raise ValueError("input_spec is required to save (declares shapes)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(*s) for s in input_spec]
+    sds = [s.to_sds() for s in specs]
+    params_sds = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in param_vals]
+
+    exp = jexport.export(jax.jit(pure))(params_sds, *sds)
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "model.shlo"), "wb") as f:
+        f.write(exp.serialize())
+    np.savez(os.path.join(path, "params.npz"),
+             **{str(i): p for i, p in enumerate(param_vals)})
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"param_names": names,
+                   "input_spec": [{"shape": list(s.shape), "dtype": s.dtype,
+                                   "name": s.name} for s in specs]}, f)
 
 
-to_static = declarative
+class TranslatedLayer:
+    """Loaded deployment artifact — callable like the original Layer
+    (reference TranslatedLayer in dygraph/io.py)."""
+
+    def __init__(self, exported, param_vals, meta):
+        self._exported = exported
+        self._param_vals = param_vals
+        self._meta = meta
+
+    @property
+    def input_spec(self):
+        return [InputSpec(**s) for s in self._meta["input_spec"]]
+
+    def __call__(self, *inputs):
+        vals = [_unwrap(i) for i in inputs]
+        out = self._exported.call(self._param_vals, *vals)
+        return jax.tree.map(
+            lambda o: VarBase(o, stop_gradient=True)
+            if hasattr(o, "shape") else o, out)
+
+    forward = __call__
+
+
+def load(path: str) -> TranslatedLayer:
+    from jax import export as jexport
+
+    with open(os.path.join(path, "model.shlo"), "rb") as f:
+        exp = jexport.deserialize(f.read())
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    npz = np.load(os.path.join(path, "params.npz"))
+    param_vals = [jnp.asarray(npz[str(i)]) for i in range(len(npz.files))]
+    return TranslatedLayer(exp, param_vals, meta)
