@@ -32,7 +32,7 @@ from .framework.core import (  # noqa: F401
 from .framework import initializer  # noqa: F401
 from .framework import unique_name  # noqa: F401
 from .framework.backward import append_backward, gradients  # noqa: F401
-from .framework.executor import Executor, Scope, global_scope  # noqa: F401
+from .framework.executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .framework.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .framework.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .framework.program import (  # noqa: F401

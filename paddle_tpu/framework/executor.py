@@ -70,11 +70,26 @@ class Scope:
         return Scope(parent=self)
 
 
-_global_scope = Scope()
+_scope_stack: List[Scope] = [Scope()]
 
 
 def global_scope() -> Scope:
-    return _global_scope
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    """fluid.executor.scope_guard parity: swap the ambient global scope so
+    io/save/load and Executor.run default into ``scope``."""
+
+    def __init__(self, scope: Scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
 
 
 import dataclasses

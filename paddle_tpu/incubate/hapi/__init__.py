@@ -1,0 +1,10 @@
+"""High-level Model API — parity with paddle/incubate/hapi (Model.fit era).
+
+``Model`` wraps a static-graph network builder; prepare() attaches an
+optimizer/loss/metrics, fit()/evaluate()/predict() drive the Executor with
+whole-program XLA compilation under the hood.
+"""
+from .model import Model, Input  # noqa: F401
+from . import loss  # noqa: F401
+from .loss import CrossEntropy, SoftmaxWithCrossEntropy, MSE  # noqa: F401
+from .callbacks import Callback, ProgBarLogger, ModelCheckpoint  # noqa: F401

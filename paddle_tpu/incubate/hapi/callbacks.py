@@ -1,0 +1,93 @@
+"""hapi callbacks — parity with incubate/hapi/callbacks.py (subset: the
+config/train-loop hook surface, ProgBarLogger, ModelCheckpoint)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "config_callbacks"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def _call(self, name, *args):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq: int = 10, verbose: int = 1):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self.verbose and self._step % self.log_freq == 0:
+            items = ", ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                              if isinstance(v, float))
+            print(f"Epoch {self._epoch} step {self._step}: {items}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            items = ", ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                              if isinstance(v, float))
+            print(f"Eval: {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model and epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.model:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+def config_callbacks(callbacks=None, model=None, log_freq=10, verbose=1,
+                     save_dir=None, save_freq=1):
+    cbs = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbs):
+        cbs.insert(0, ProgBarLogger(log_freq, verbose))
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+        cbs.append(ModelCheckpoint(save_freq, save_dir))
+    for c in cbs:
+        c.set_model(model)
+    return CallbackList(cbs)

@@ -1,0 +1,42 @@
+"""hapi loss classes — parity with incubate/hapi/loss.py.
+
+A Loss builds graph ops from (outputs, labels) variable lists and returns a
+scalar loss variable.
+"""
+from __future__ import annotations
+
+from ... import layers
+
+__all__ = ["Loss", "CrossEntropy", "SoftmaxWithCrossEntropy", "MSE"]
+
+
+class Loss:
+    def forward(self, outputs, labels):
+        raise NotImplementedError
+
+    def __call__(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        return self.forward(list(outs), list(labs))
+
+
+class CrossEntropy(Loss):
+    """Expects softmax-probability outputs (reference hapi CrossEntropy)."""
+
+    def forward(self, outputs, labels):
+        return layers.reduce_mean(
+            layers.cross_entropy(outputs[0], labels[0]))
+
+
+class SoftmaxWithCrossEntropy(Loss):
+    """Expects raw logits — fused, numerically-stable path."""
+
+    def forward(self, outputs, labels):
+        return layers.reduce_mean(
+            layers.softmax_with_cross_entropy(outputs[0], labels[0]))
+
+
+class MSE(Loss):
+    def forward(self, outputs, labels):
+        return layers.reduce_mean(
+            layers.square_error_cost(outputs[0], labels[0]))

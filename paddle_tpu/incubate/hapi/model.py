@@ -1,0 +1,219 @@
+"""hapi Model — parity with incubate/hapi/model.py (Model, Input,
+prepare/fit/evaluate/predict/save/load).
+
+The reference Model adapts one network to both dygraph and static modes; here
+the static Program path IS the TPU-native fast path (whole-program XLA), so
+Model builds three programs from one network builder:
+  train  = forward + loss + metrics + optimizer
+  eval   = forward + loss + metrics   (clone-for-test)
+  predict= forward
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ... import io as fluid_io
+from ... import layers
+from ...framework.executor import Executor, Scope
+from ...framework.core import XLAPlace
+from ...framework.program import Program, program_guard
+from ...reader import DataLoader, Dataset
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "Input"]
+
+
+class Input:
+    """hapi Input descriptor (incubate/hapi/input.py): name/shape/dtype of a
+    feed slot; batch dim None/-1."""
+
+    def __init__(self, shape: Sequence[int], dtype: str = "float32",
+                 name: Optional[str] = None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_var(self):
+        shape = [-1 if d in (None, -1) else int(d) for d in self.shape]
+        return layers.data(self.name, shape[1:] if shape and shape[0] == -1
+                           else shape, dtype=self.dtype,
+                           append_batch_size=(bool(shape) and shape[0] == -1))
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _iter_data(data, feed_names: List[str], batch_size: int, shuffle: bool):
+    """Normalize user data into an iterator of feed dicts.  Accepts a
+    DataLoader, a map-style Dataset, a (x, y) tuple/list of arrays, or any
+    iterable of feed dicts / field tuples."""
+    if isinstance(data, DataLoader):
+        for batch in data:
+            yield (batch if isinstance(batch, dict)
+                   else dict(zip(feed_names, batch)))
+        return
+    if isinstance(data, Dataset):
+        dl = DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        for batch in dl:
+            yield dict(zip(feed_names, batch))
+        return
+    if isinstance(data, (tuple, list)) and data and hasattr(data[0], "shape"):
+        n = data[0].shape[0]
+        idx = np.random.permutation(n) if shuffle else np.arange(n)
+        for s in range(0, n, batch_size):
+            sel = idx[s:s + batch_size]
+            yield {name: np.asarray(arr)[sel]
+                   for name, arr in zip(feed_names, data)}
+        return
+    for batch in data:  # iterable of dicts or tuples
+        yield (batch if isinstance(batch, dict)
+               else dict(zip(feed_names, batch)))
+
+
+class Model:
+    def __init__(self, network: Callable, inputs: Sequence[Input],
+                 labels: Optional[Sequence[Input]] = None):
+        self._network = network
+        self._input_descs = _to_list(inputs)
+        self._label_descs = _to_list(labels)
+        self._place = XLAPlace(0)
+        self._exe = Executor(self._place)
+        self._scope = Scope()
+        self._prepared = False
+        self._startup_ran = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+        self._metrics = _to_list(metrics)
+        self._train_prog = Program()
+        self._startup_prog = Program()
+        from ...framework import unique_name
+        # fresh name namespace per Model so save/load match across instances
+        with unique_name.guard():
+            with program_guard(self._train_prog, self._startup_prog):
+                in_vars = [d.to_var() for d in self._input_descs]
+                lab_vars = [d.to_var() for d in self._label_descs]
+                outs = _to_list(self._network(*in_vars))
+                self._feed_names = [v.name for v in in_vars + lab_vars]
+                self._out_names = [v.name for v in outs]
+                loss_var = None
+                metric_vars = []
+                if loss_function is not None:
+                    loss_var = loss_function(outs, lab_vars)
+                for m in self._metrics:
+                    # in-graph accuracy against label 0 (hapi Accuracy pattern)
+                    metric_vars.append(layers.accuracy(outs[0], lab_vars[0]))
+            # eval program = train program before optimizer ops, test clone
+            self._eval_prog = self._train_prog.clone(for_test=True)
+            self._pred_prog = fluid_io.prune_program(
+                self._eval_prog, [d.name for d in self._input_descs],
+                self._out_names)
+            self._loss_name = loss_var.name if loss_var is not None else None
+            self._metric_names = [v.name for v in metric_vars]
+            if optimizer is not None and loss_var is not None:
+                with program_guard(self._train_prog, self._startup_prog):
+                    optimizer.minimize(loss_var)
+        self._optimizer = optimizer
+        self._prepared = True
+
+    def _ensure_startup(self):
+        if not self._startup_ran:
+            self._exe.run(self._startup_prog, scope=self._scope)
+            self._startup_ran = True
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1,
+            verbose: int = 1, shuffle: bool = True, callbacks=None):
+        assert self._prepared, "call prepare() first"
+        self._ensure_startup()
+        cbks = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                                verbose=verbose, save_dir=save_dir,
+                                save_freq=save_freq)
+        fetches = ([self._loss_name] if self._loss_name else []) \
+            + self._metric_names
+        history: Dict[str, List[float]] = {}
+        cbks.on_train_begin(None)
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, None)
+            logs: Dict[str, Any] = {}
+            for step, feed in enumerate(_iter_data(
+                    train_data, self._feed_names, batch_size, shuffle)):
+                cbks.on_train_batch_begin(step, None)
+                vals = self._exe.run(self._train_prog, feed=feed,
+                                     fetch_list=fetches, scope=self._scope)
+                logs = {name: float(np.asarray(v).mean())
+                        for name, v in zip(
+                            (["loss"] if self._loss_name else [])
+                            + [f"acc_{i}" for i in
+                               range(len(self._metric_names))], vals)}
+                cbks.on_train_batch_end(step, logs)
+            for k, v in logs.items():
+                history.setdefault(k, []).append(v)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size,
+                                          verbose=0, callbacks=cbks)
+                for k, v in eval_logs.items():
+                    history.setdefault("eval_" + k, []).append(v)
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_train_end(None)
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1, verbose: int = 1,
+                 callbacks=None):
+        assert self._prepared, "call prepare() first"
+        self._ensure_startup()
+        fetches = ([self._loss_name] if self._loss_name else []) \
+            + self._metric_names
+        names = (["loss"] if self._loss_name else []) \
+            + [f"acc_{i}" for i in range(len(self._metric_names))]
+        sums = np.zeros(len(fetches))
+        count = 0
+        for feed in _iter_data(eval_data, self._feed_names, batch_size, False):
+            vals = self._exe.run(self._eval_prog, feed=feed,
+                                 fetch_list=fetches, scope=self._scope)
+            bs = next(iter(feed.values())).shape[0]
+            sums += np.array([float(np.asarray(v).mean()) for v in vals]) * bs
+            count += bs
+        logs = dict(zip(names, (sums / max(count, 1)).tolist()))
+        if callbacks is not None:
+            callbacks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1):
+        assert self._prepared, "call prepare() first"
+        self._ensure_startup()
+        input_names = [d.name for d in self._input_descs]
+        outs: List[List[np.ndarray]] = [[] for _ in self._out_names]
+        for feed in _iter_data(test_data, input_names, batch_size, False):
+            feed = {k: v for k, v in feed.items() if k in input_names}
+            vals = self._exe.run(self._pred_prog, feed=feed,
+                                 fetch_list=self._out_names, scope=self._scope)
+            for o, v in zip(outs, vals):
+                o.append(np.asarray(v))
+        return [np.concatenate(o) for o in outs]
+
+    # ------------------------------------------------------------------
+    def save(self, path: str):
+        import os
+        os.makedirs(path, exist_ok=True)
+        from ...framework.executor import scope_guard
+        with scope_guard(self._scope):
+            fluid_io.save_persistables(self._exe, path, self._train_prog)
+
+    def load(self, path: str, skip_mismatch: bool = False):
+        self._ensure_startup()
+        from ...framework.executor import scope_guard
+        with scope_guard(self._scope):
+            fluid_io.load_persistables(self._exe, path, self._train_prog)
+
+    def parameters(self):
+        from ...framework.program import Parameter
+        return [v for v in self._train_prog.global_block().vars.values()
+                if isinstance(v, Parameter)]

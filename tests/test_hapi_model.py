@@ -1,0 +1,73 @@
+"""hapi Model.fit/evaluate/predict/save/load on a synthetic classification
+task (incubate/hapi/model.py capability)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.incubate.hapi import (
+    Input, Model, SoftmaxWithCrossEntropy)
+from paddle_tpu.metrics import MetricBase
+
+
+def _make_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = x[:, :4].argmax(1).astype(np.int64).reshape(-1, 1)
+    return x, y
+
+
+def _network(img):
+    h = fluid.layers.fc(img, 32, act="relu")
+    return fluid.layers.fc(h, 4)
+
+
+def _new_model():
+    return Model(_network,
+                 inputs=[Input([None, 8], "float32", name="img")],
+                 labels=[Input([None, 1], "int64", name="label")])
+
+
+def test_fit_improves_and_evaluate(tmp_path):
+    model = _new_model()
+    model.prepare(fluid.optimizer.AdamOptimizer(1e-2),
+                  SoftmaxWithCrossEntropy(), metrics=["acc"])
+    x, y = _make_data()
+    history = model.fit((x, y), batch_size=64, epochs=8, verbose=0)
+    assert history["loss"][-1] < history["loss"][0] * 0.5, history["loss"]
+
+    ex, ey = _make_data(seed=1)
+    logs = model.evaluate((ex, ey), batch_size=64, verbose=0)
+    assert logs["acc_0"] > 0.8, logs
+
+    preds = model.predict((ex,), batch_size=64)
+    assert preds[0].shape == (256, 4)
+    assert (preds[0].argmax(1).reshape(-1, 1) == ey).mean() > 0.8
+
+    # save → fresh model → load → same eval accuracy
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    model2 = _new_model()
+    model2.prepare(fluid.optimizer.AdamOptimizer(1e-2),
+                   SoftmaxWithCrossEntropy(), metrics=["acc"])
+    model2.load(path)
+    logs2 = model2.evaluate((ex, ey), batch_size=64, verbose=0)
+    np.testing.assert_allclose(logs2["acc_0"], logs["acc_0"], atol=1e-6)
+
+
+def test_fit_with_dataloader():
+    from paddle_tpu.reader import Dataset
+
+    x, y = _make_data(128)
+
+    class DS(Dataset):
+        def __len__(self):
+            return len(x)
+
+        def __getitem__(self, i):
+            return x[i], y[i]
+
+    model = _new_model()
+    model.prepare(fluid.optimizer.SGDOptimizer(0.1),
+                  SoftmaxWithCrossEntropy(), metrics=["acc"])
+    loader = fluid.DataLoader(DS(), feed_list=["img", "label"], batch_size=32)
+    history = model.fit(loader, epochs=4, verbose=0)
+    assert history["loss"][-1] < history["loss"][0], history
