@@ -1,0 +1,12 @@
+"""Distributed runtime: parameter-server transport + host ops.
+
+The collective (mesh/pjit) stack lives in paddle_tpu/parallel/; this package
+is the PS capability (reference operators/distributed/ + distributed_ops/):
+a socket transport over the native C++ table core, surfaced as host ops
+(send/recv/listen_and_serv/...) that the Executor runs between jitted device
+segments.
+"""
+from . import ps_ops  # noqa: F401  (registers host ops)
+from .ps_client import PSClient  # noqa: F401
+from .ps_server import ParameterServer  # noqa: F401
+from .table import DenseTable, SparseTable  # noqa: F401

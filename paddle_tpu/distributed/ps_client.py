@@ -1,0 +1,142 @@
+"""PS client — parity with the reference RPCClient
+(operators/distributed/grpc/grpc_client.cc async_send/get semantics, used by
+the send/recv ops and the Communicator)."""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .ps_server import recv_msg, send_msg
+
+
+class PSClient:
+    """One connection per (client, endpoint); thread-safe via a lock per
+    connection (trainer host ops run sequentially anyway)."""
+
+    _instances: Dict[int, "PSClient"] = {}
+    _instances_lock = threading.Lock()
+
+    def __init__(self, trainer_id: int = 0):
+        self.trainer_id = trainer_id
+        self._conns: Dict[str, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._inited_params = set()
+
+    @classmethod
+    def instance(cls, trainer_id: int = 0) -> "PSClient":
+        with cls._instances_lock:
+            if trainer_id not in cls._instances:
+                cls._instances[trainer_id] = cls(trainer_id)
+            return cls._instances[trainer_id]
+
+    @classmethod
+    def reset_all(cls):
+        with cls._instances_lock:
+            for c in cls._instances.values():
+                c.close()
+            cls._instances.clear()
+
+    # ------------------------------------------------------------------
+    def _conn(self, endpoint: str) -> socket.socket:
+        with self._lock:
+            s = self._conns.get(endpoint)
+            if s is None:
+                host, port = endpoint.rsplit(":", 1)
+                s = self._wait_connect(host or "127.0.0.1", int(port))
+                self._conns[endpoint] = s
+            return s
+
+    @staticmethod
+    def _wait_connect(host, port, timeout: float = 30.0):
+        """wait_port parity (distribute_transpiler config wait_port)."""
+        deadline = time.time() + timeout
+        while True:
+            try:
+                return socket.create_connection((host, port), timeout=5)
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+
+    def _rpc(self, endpoint: str, msg: dict) -> dict:
+        sock = self._conn(endpoint)
+        with self._lock:
+            send_msg(sock, msg)
+            reply = recv_msg(sock)
+        if reply is None:
+            raise ConnectionError(f"pserver {endpoint} closed connection")
+        if reply.get("status") == "error":
+            raise RuntimeError(f"pserver {endpoint}: {reply['error']}")
+        return reply
+
+    # -- op-facing API ------------------------------------------------------
+    def ensure_init(self, endpoint: str, param: str, value: np.ndarray):
+        """First-touch init: server keeps the first value it sees
+        (pserver startup-program initialization parity)."""
+        if (endpoint, param) in self._inited_params:
+            return
+        self._rpc(endpoint, {"cmd": "init_param", "param": param,
+                             "value": np.asarray(value, np.float32)})
+        self._inited_params.add((endpoint, param))
+
+    def push(self, endpoint: str, param: str, grad: np.ndarray,
+             lr: Optional[float] = None):
+        self._rpc(endpoint, {"cmd": "push", "param": param,
+                             "value": np.asarray(grad, np.float32),
+                             "lr": lr, "trainer_id": self.trainer_id})
+
+    def push_delta(self, endpoint: str, param: str, delta: np.ndarray):
+        self._rpc(endpoint, {"cmd": "push_delta", "param": param,
+                             "value": np.asarray(delta, np.float32)})
+
+    def pull(self, endpoint: str, param: str) -> np.ndarray:
+        return self._rpc(endpoint, {"cmd": "pull", "param": param,
+                                    "trainer_id": self.trainer_id})["value"]
+
+    def pull_sparse(self, endpoint: str, param: str,
+                    keys: np.ndarray) -> np.ndarray:
+        return self._rpc(endpoint, {"cmd": "pull_sparse", "param": param,
+                                    "keys": np.asarray(keys, np.uint64)})["value"]
+
+    def push_sparse(self, endpoint: str, param: str, keys: np.ndarray,
+                    grads: np.ndarray, lr: Optional[float] = None):
+        self._rpc(endpoint, {"cmd": "push_sparse", "param": param,
+                             "keys": np.asarray(keys, np.uint64),
+                             "value": np.asarray(grads, np.float32),
+                             "lr": lr})
+
+    def barrier(self, endpoints, name: str):
+        for ep in endpoints:
+            self._rpc(ep, {"cmd": "barrier", "name": name,
+                           "trainer_id": self.trainer_id})
+
+    def complete(self, endpoints):
+        for ep in endpoints:
+            try:
+                self._rpc(ep, {"cmd": "complete",
+                               "trainer_id": self.trainer_id})
+            except (OSError, ConnectionError):
+                pass
+
+    def checkpoint_notify(self, endpoint: str, dirname: str):
+        self._rpc(endpoint, {"cmd": "save", "dirname": dirname})
+
+    def stop_server(self, endpoint: str):
+        try:
+            self._rpc(endpoint, {"cmd": "stop"})
+        except (OSError, ConnectionError, EOFError):
+            pass
+
+    def close(self):
+        with self._lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            self._inited_params.clear()

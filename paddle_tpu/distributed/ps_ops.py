@@ -1,0 +1,155 @@
+"""Host-op implementations for the PS program surface — parity with
+operators/distributed_ops/ (send, recv, send_barrier, fetch_barrier,
+listen_and_serv, checkpoint_notify) and distributed_lookup_table.
+
+These run Python-side between jitted device segments (see
+framework/executor.py host-op segmentation); the server arithmetic is the
+native C++ table (native/ps_table.cpp).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.executor import register_host_op
+from .ps_client import PSClient
+
+__all__ = ["PSClient"]
+
+
+def _scope_np(scope, name):
+    v = scope.find_var(name)
+    if v is None:
+        raise RuntimeError(f"host op: var {name!r} not in scope")
+    return np.asarray(v)
+
+
+def _set_scope(scope, name, arr):
+    import jax.numpy as jnp
+    scope.set_var(name, jnp.asarray(arr))
+
+
+@register_host_op("send")
+def send_op(scope, op, exe):
+    """send_op.cc: push one grad (or GEO delta) to the param's pserver."""
+    eps = op.attr("epmap")
+    param = op.attr("param")
+    tid = int(op.attr("trainer_id", 0))
+    mode = int(op.attr("mode", 0))
+    client = PSClient.instance(tid)
+    grad_name = op.input("X")[0]
+    grad = _scope_np(scope, grad_name)
+    lr_var = op.attr("lr_var", None)
+    lr = None
+    if lr_var and scope.has_var(lr_var):
+        lr = float(np.asarray(scope.find_var(lr_var)).ravel()[0])
+    ep = eps[0]
+    # first-touch server init from the trainer's local startup value
+    if scope.has_var(param):
+        client.ensure_init(ep, param, _scope_np(scope, param))
+    if mode == 3:  # GEO pushes param deltas
+        client.push_delta(ep, param, grad)
+    else:
+        client.push(ep, param, grad, lr=lr)
+
+
+@register_host_op("send_barrier")
+def send_barrier_op(scope, op, exe):
+    eps = op.attr("endpoints")
+    tid = int(op.attr("trainer_id", 0))
+    PSClient.instance(tid).barrier(eps, "send")
+
+
+@register_host_op("fetch_barrier")
+def fetch_barrier_op(scope, op, exe):
+    eps = op.attr("endpoints")
+    tid = int(op.attr("trainer_id", 0))
+    PSClient.instance(tid).barrier(eps, "fetch")
+
+
+@register_host_op("recv")
+def recv_op(scope, op, exe):
+    """recv_op.cc: pull a param from its pserver into scope."""
+    eps = op.attr("epmap")
+    param = op.attr("param")
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    out_name = op.output("Out")[0]
+    if scope.has_var(param):
+        client.ensure_init(eps[0], param, _scope_np(scope, param))
+    value = client.pull(eps[0], param)
+    local = scope.find_var(out_name)
+    if local is not None:
+        value = value.reshape(np.asarray(local).shape)
+    _set_scope(scope, out_name, value)
+
+
+@register_host_op("distributed_lookup_table")
+def distributed_lookup_table_op(scope, op, exe):
+    """distributed_lookup_table_op.cc + parameter_prefetch.cc: remote sparse
+    embedding lookup — ids -> rows from the pserver's sparse table."""
+    eps = op.attr("epmap")
+    table = op.attr("table_name")
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    ids = _scope_np(scope, op.input("Ids")[0])
+    shape = ids.shape
+    rows = client.pull_sparse(eps[0], table, ids.reshape(-1).astype(np.uint64))
+    out = rows.reshape(*shape, -1)
+    if out.shape[-2] == 1 and len(shape) >= 2 and shape[-1] == 1:
+        out = out.reshape(*shape[:-1], -1)  # ids [..., 1] -> emb [..., dim]
+    _set_scope(scope, op.output("Out")[0], out)
+
+
+@register_host_op("distributed_push_sparse")
+def distributed_push_sparse_op(scope, op, exe):
+    """Sparse grad push (the send-side of distributed_lookup_table)."""
+    eps = op.attr("epmap")
+    table = op.attr("table_name")
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    ids = _scope_np(scope, op.input("Ids")[0]).reshape(-1).astype(np.uint64)
+    grads = _scope_np(scope, op.input("Grad")[0])
+    grads = grads.reshape(ids.size, -1)
+    lr_var = op.attr("lr_var", None)
+    lr = None
+    if lr_var and scope.has_var(lr_var):
+        lr = float(np.asarray(scope.find_var(lr_var)).ravel()[0])
+    client.push_sparse(eps[0], table, ids, grads, lr=lr)
+
+
+@register_host_op("listen_and_serv")
+def listen_and_serv_op(scope, op, exe):
+    """listen_and_serv_op.cc: the pserver main loop.  Builds tables from the
+    transpiler-provided configs and serves until a stop RPC arrives."""
+    from .ps_server import ParameterServer
+
+    endpoint = op.attr("endpoint")
+    server = ParameterServer(
+        endpoint,
+        trainer_num=int(op.attr("trainer_num", 1)),
+        sync_mode=bool(op.attr("sync_mode", True)),
+        mode=int(op.attr("mode", 0)),
+    )
+    for tbl in op.attr("tables", []):
+        if tbl.get("is_sparse"):
+            server.register_sparse(tbl["name"], tbl["dim"],
+                                   tbl.get("optimizer", "sgd"),
+                                   tbl.get("lr", 0.01))
+        else:
+            server.register_dense(tbl["name"], tbl["shape"],
+                                  tbl.get("optimizer", "sgd"),
+                                  tbl.get("lr", 0.01))
+    server.start()
+    op._server = server  # for in-process tests / graceful shutdown
+    if op.attr("blocking", True):
+        server.serve_forever()
+
+
+@register_host_op("checkpoint_notify")
+def checkpoint_notify_op(scope, op, exe):
+    eps = op.attr("epmap")
+    dirname = op.attr("dirname")
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    for ep in eps:
+        client.checkpoint_notify(ep, dirname)

@@ -1,0 +1,279 @@
+"""Parameter server — host-side service replacing the reference's gRPC
+listen_and_serv runtime (operators/distributed_ops/listen_and_serv_op.cc +
+operators/distributed/request_handler_impl.cc).
+
+Transport: length-prefixed pickle over TCP sockets (one thread per
+connection, like the reference's gRPC thread pool).  The arithmetic hot path
+— optimizer updates on dense params and sparse embedding rows — is native
+C++ (native/ps_table.cpp) behind the Table classes.
+
+Sync semantics (reference `Communicator` Sync / request_handler barriers):
+pushes to a param accumulate until `trainer_num` arrived, then the averaged
+gradient is applied once and the param version advances; `barrier` gives the
+trainer-side send/fetch barriers.  Async: every push applies immediately.
+GEO: trainers push param deltas which are added raw.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .table import DenseTable, SparseTable
+
+_LEN = struct.Struct("<Q")
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    data = _recv_exact(sock, n)
+    if data is None:
+        return None
+    return pickle.loads(data)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _ParamState:
+    def __init__(self, table):
+        self.table = table
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.accum: Optional[np.ndarray] = None
+        self.accum_lr: Optional[float] = None
+        self.push_count = 0
+        self.version = 0
+
+
+class ParameterServer:
+    """One PS endpoint.  Construct from table configs, then serve()."""
+
+    def __init__(self, endpoint: str, trainer_num: int = 1,
+                 sync_mode: bool = True, mode: int = 0):
+        host, port = endpoint.rsplit(":", 1)
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.trainer_num = trainer_num
+        self.sync_mode = sync_mode
+        self.mode = mode  # DistributedMode: 0 sync / 1 async / 3 geo
+        self.params: Dict[str, _ParamState] = {}
+        self._barriers: Dict[str, tuple] = {}
+        self._barrier_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._threads = []
+        self._completed_trainers = set()  # HeartBeatMonitor-style liveness
+
+    # -- table config -------------------------------------------------------
+    def register_dense(self, name: str, shape, optimizer="sgd", lr=0.01,
+                       **hparams):
+        if name not in self.params:
+            self.params[name] = _ParamState(
+                DenseTable(shape, optimizer, lr, **hparams))
+
+    def register_sparse(self, name: str, dim: int, optimizer="sgd", lr=0.01,
+                        **hparams):
+        if name not in self.params:
+            self.params[name] = _ParamState(
+                SparseTable(dim, optimizer, lr, **hparams))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        """Bind + serve on a background thread; returns once listening."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        if self.port == 0:
+            self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def serve_forever(self):
+        """Blocking serve — what the listen_and_serv host op calls."""
+        if self._sock is None:
+            self.start()
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            if self._sock is not None:
+                # unblock accept
+                poke = socket.create_connection((self.host, self.port),
+                                                timeout=1)
+                poke.close()
+        except OSError:
+            pass
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    # -- serving ------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # surface handler errors to client
+                    reply = {"status": "error", "error": repr(e)}
+                send_msg(conn, reply)
+                if msg.get("cmd") == "stop":
+                    return
+        finally:
+            conn.close()
+
+    # -- request handlers (request_handler_impl.cc parity) -----------------
+    def _handle(self, msg):
+        cmd = msg["cmd"]
+        if cmd == "ping":
+            return {"status": "ok"}
+        if cmd == "stop":
+            self.stop()
+            return {"status": "ok"}
+        if cmd == "barrier":
+            self._barrier(msg["name"], msg["trainer_id"])
+            return {"status": "ok"}
+        if cmd == "complete":  # trainer finished (HeartBeatMonitor COMPLETED)
+            self._completed_trainers.add(msg["trainer_id"])
+            return {"status": "ok"}
+        if cmd == "save":
+            return self._save(msg.get("dirname"))
+
+        name = msg.get("param")
+        st = self.params.get(name)
+        if st is None:
+            return {"status": "error", "error": f"unknown param {name!r}"}
+
+        if cmd == "init_param":
+            with st.lock:
+                if not st.table.initialized:
+                    st.table.set(msg["value"])
+                return {"status": "ok", "initialized": True}
+        if cmd == "pull":
+            with st.lock:
+                if self.sync_mode:
+                    # serve the freshest applied version; trainers order
+                    # pulls behind their send barrier so no wait needed
+                    return {"status": "ok", "value": st.table.pull(),
+                            "version": st.version}
+                return {"status": "ok", "value": st.table.pull(),
+                        "version": st.version}
+        if cmd == "push":
+            self._push_dense(st, msg)
+            return {"status": "ok"}
+        if cmd == "push_delta":  # GEO
+            with st.lock:
+                st.table.add(msg["value"])
+                st.version += 1
+            return {"status": "ok"}
+        if cmd == "pull_sparse":
+            with st.lock:
+                return {"status": "ok", "value": st.table.pull(msg["keys"])}
+        if cmd == "push_sparse":
+            with st.lock:
+                st.table.push(msg["keys"], msg["value"], msg.get("lr"))
+            return {"status": "ok"}
+        return {"status": "error", "error": f"unknown cmd {cmd!r}"}
+
+    def _push_dense(self, st: _ParamState, msg):
+        grad = np.asarray(msg["value"], np.float32)
+        lr = msg.get("lr")
+        with st.cond:
+            if not self.sync_mode:
+                st.table.push(grad, lr)
+                st.version += 1
+                return
+            # sync: accumulate until all live trainers contributed
+            if st.accum is None:
+                st.accum = grad.astype(np.float64)
+            else:
+                st.accum += grad
+            st.accum_lr = lr if lr is not None else st.accum_lr
+            st.push_count += 1
+            need = self.trainer_num - len(self._completed_trainers)
+            if st.push_count >= max(need, 1):
+                st.table.push((st.accum / st.push_count).astype(np.float32),
+                              st.accum_lr)
+                st.accum = None
+                st.push_count = 0
+                st.version += 1
+                st.cond.notify_all()
+            else:
+                target = st.version + 1
+                while st.version < target and not self._stop.is_set():
+                    st.cond.wait(timeout=0.5)
+
+    def _barrier(self, name: str, trainer_id: int):
+        with self._barrier_lock:
+            if name not in self._barriers:
+                self._barriers[name] = [0, threading.Condition(
+                    self._barrier_lock), 0]
+        count_gen = self._barriers[name]
+        with count_gen[1]:
+            count_gen[0] += 1
+            need = self.trainer_num - len(self._completed_trainers)
+            if count_gen[0] >= max(need, 1):
+                count_gen[0] = 0
+                count_gen[2] += 1  # generation
+                count_gen[1].notify_all()
+            else:
+                gen = count_gen[2]
+                while count_gen[2] == gen and not self._stop.is_set():
+                    count_gen[1].wait(timeout=0.5)
+
+    def _save(self, dirname):
+        import os
+        if not dirname:
+            return {"status": "error", "error": "no dirname"}
+        os.makedirs(dirname, exist_ok=True)
+        for name, st in self.params.items():
+            with st.lock:
+                if isinstance(st.table, DenseTable):
+                    np.save(os.path.join(dirname, name.replace("/", "_")),
+                            st.table.pull())
+                else:
+                    keys, vals = st.table.dump()
+                    np.savez(os.path.join(dirname,
+                                          name.replace("/", "_") + ".sparse"),
+                             keys=keys, vals=vals)
+        return {"status": "ok"}

@@ -1,0 +1,147 @@
+"""ctypes binding over the native PS table (native/ps_table.cpp)."""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2, "momentum": 3}
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            from .. import native
+            lib = native.load_library("ps_table")
+            lib.pt_create.restype = ctypes.c_void_p
+            lib.pt_create.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                      ctypes.c_int, ctypes.c_float,
+                                      ctypes.c_float, ctypes.c_float,
+                                      ctypes.c_float]
+            pf = ctypes.POINTER(ctypes.c_float)
+            pu = ctypes.POINTER(ctypes.c_uint64)
+            for name, argtypes in [
+                ("pt_set_lr", [ctypes.c_void_p, ctypes.c_float]),
+                ("pt_set_dense", [ctypes.c_void_p, pf, ctypes.c_int64]),
+                ("pt_pull_dense", [ctypes.c_void_p, pf, ctypes.c_int64]),
+                ("pt_push_dense", [ctypes.c_void_p, pf, ctypes.c_int64]),
+                ("pt_add_dense", [ctypes.c_void_p, pf, ctypes.c_int64]),
+                ("pt_pull_sparse", [ctypes.c_void_p, pu, ctypes.c_int64, pf]),
+                ("pt_push_sparse", [ctypes.c_void_p, pu, ctypes.c_int64, pf]),
+                ("pt_set_sparse", [ctypes.c_void_p, pu, ctypes.c_int64, pf]),
+                ("pt_dump_sparse", [ctypes.c_void_p, pu, pf]),
+                ("pt_free", [ctypes.c_void_p]),
+            ]:
+                getattr(lib, name).argtypes = argtypes
+            lib.pt_sparse_size.restype = ctypes.c_int64
+            lib.pt_sparse_size.argtypes = [ctypes.c_void_p]
+            _lib = lib
+    return _lib
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _uptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+class DenseTable:
+    """Server-side dense parameter + optimizer state."""
+
+    def __init__(self, shape, optimizer: str = "sgd", lr: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8):
+        self.shape = tuple(int(d) for d in shape)
+        self.size = int(np.prod(self.shape)) if self.shape else 1
+        self.optimizer = optimizer
+        lib = _load()
+        self._h = lib.pt_create(0, self.size, OPTIMIZERS[optimizer],
+                                lr, beta1, beta2, eps)
+        self._lib = lib
+        self.initialized = False
+
+    def set(self, value: np.ndarray):
+        v = np.ascontiguousarray(value, dtype=np.float32).reshape(-1)
+        assert v.size == self.size, (v.size, self.size)
+        self._lib.pt_set_dense(self._h, _fptr(v), self.size)
+        self.initialized = True
+
+    def pull(self) -> np.ndarray:
+        out = np.empty((self.size,), np.float32)
+        self._lib.pt_pull_dense(self._h, _fptr(out), self.size)
+        return out.reshape(self.shape)
+
+    def push(self, grad: np.ndarray, lr: Optional[float] = None):
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        if lr is not None:
+            self._lib.pt_set_lr(self._h, float(lr))
+        self._lib.pt_push_dense(self._h, _fptr(g), self.size)
+
+    def add(self, delta: np.ndarray):
+        d = np.ascontiguousarray(delta, dtype=np.float32).reshape(-1)
+        self._lib.pt_add_dense(self._h, _fptr(d), self.size)
+
+    def __del__(self):
+        try:
+            self._lib.pt_free(self._h)
+        except Exception:
+            pass
+
+
+class SparseTable:
+    """Server-side uint64 -> float[dim] embedding table."""
+
+    def __init__(self, dim: int, optimizer: str = "sgd", lr: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8):
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        lib = _load()
+        self._h = lib.pt_create(1, self.dim, OPTIMIZERS[optimizer],
+                                lr, beta1, beta2, eps)
+        self._lib = lib
+        self.initialized = True  # rows lazily zero-init
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        k = np.ascontiguousarray(keys, dtype=np.uint64).reshape(-1)
+        out = np.empty((k.size, self.dim), np.float32)
+        self._lib.pt_pull_sparse(self._h, _uptr(k), k.size, _fptr(out))
+        return out
+
+    def push(self, keys: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None):
+        k = np.ascontiguousarray(keys, dtype=np.uint64).reshape(-1)
+        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(k.size,
+                                                                  self.dim)
+        if lr is not None:
+            self._lib.pt_set_lr(self._h, float(lr))
+        self._lib.pt_push_sparse(self._h, _uptr(k), k.size, _fptr(g))
+
+    def set(self, keys: np.ndarray, vals: np.ndarray):
+        k = np.ascontiguousarray(keys, dtype=np.uint64).reshape(-1)
+        v = np.ascontiguousarray(vals, dtype=np.float32).reshape(k.size,
+                                                                 self.dim)
+        self._lib.pt_set_sparse(self._h, _uptr(k), k.size, _fptr(v))
+
+    def dump(self):
+        n = self._lib.pt_sparse_size(self._h)
+        keys = np.empty((n,), np.uint64)
+        vals = np.empty((n, self.dim), np.float32)
+        if n:
+            self._lib.pt_dump_sparse(self._h, _uptr(keys), _fptr(vals))
+        return keys, vals
+
+    def __len__(self):
+        return int(self._lib.pt_sparse_size(self._h))
+
+    def __del__(self):
+        try:
+            self._lib.pt_free(self._h)
+        except Exception:
+            pass

@@ -331,12 +331,34 @@ class _CompiledBlock:
         return fetches
 
 
+# ---------------------------------------------------------------------------
+# Host ops: ops that run Python-side between jitted device segments (the
+# reference's RPC/PS ops — send/recv/listen_and_serv — execute on the host
+# inside its per-op interpreter; here the Executor splits the block at host
+# ops and jits the device spans around them).
+# ---------------------------------------------------------------------------
+
+_HOST_OPS: Dict[str, Any] = {}
+
+
+def register_host_op(op_type: str):
+    def deco(fn):
+        _HOST_OPS[op_type] = fn
+        return fn
+    return deco
+
+
+def is_host_op_type(t: str) -> bool:
+    return t in _HOST_OPS
+
+
 class Executor:
     """User-facing executor — API parity with fluid/executor.py:890 Executor.run."""
 
     def __init__(self, place: Optional[Place] = None):
         self.place = place or XLAPlace(0)
         self._cache: Dict[Tuple, _CompiledBlock] = {}
+        self._view_cache: Dict[Tuple, Program] = {}
         self._step = 0
 
     def close(self):
@@ -367,6 +389,10 @@ class Executor:
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
         ]
+
+        if any(op.type in _HOST_OPS for op in program.global_block().ops):
+            return self._run_with_host_ops(
+                program, feed, fetch_names, scope, return_numpy)
 
         # normalize feed values to jax arrays (device put happens inside jit)
         feed_arrays: Dict[str, Any] = {}
@@ -421,6 +447,102 @@ class Executor:
     def run_startup(self, startup_program: Program, scope: Optional[Scope] = None):
         """Convenience alias: startup programs run through the same path."""
         return self.run(program=startup_program, feed={}, fetch_list=[], scope=scope)
+
+    # ------------------------------------------------------------------
+    # host-op segmented execution
+    # ------------------------------------------------------------------
+    def _segment_ops(self, ops):
+        """Split the op list into maximal (is_host, [lo, hi)) runs."""
+        segs = []
+        lo = 0
+        while lo < len(ops):
+            host = ops[lo].type in _HOST_OPS
+            hi = lo
+            while hi < len(ops) and (ops[hi].type in _HOST_OPS) == host:
+                hi += 1
+            segs.append((host, lo, hi))
+            lo = hi
+        return segs
+
+    def _slice_view(self, program: Program, lo: int, hi: int,
+                    promote: frozenset) -> Program:
+        """A derived Program running ops[lo:hi] of block 0.  Vars crossing
+        the segment boundary (``promote``) get persistable=True on *copied*
+        Variable objects so the compiled block reads/writes them via scope.
+        Sub-blocks (control flow) are shared by reference."""
+        import copy as _copy
+
+        key = (id(program), program._version_token(), lo, hi, promote)
+        view = self._view_cache.get(key)
+        if view is not None:
+            return view
+        src_block = program.global_block()
+        view = Program()
+        view.random_seed = program.random_seed
+        vb = view.global_block()
+        for name, var in src_block.vars.items():
+            v = _copy.copy(var)
+            if name in promote:
+                v.persistable = True
+            v.block = vb
+            vb.vars[name] = v
+        vb.ops = list(src_block.ops[lo:hi])
+        view.blocks = [vb] + program.blocks[1:]
+        self._view_cache[key] = view
+        if len(self._view_cache) > 256:
+            self._view_cache.clear()
+        return view
+
+    def _run_with_host_ops(self, program, feed, fetch_names, scope,
+                           return_numpy):
+        """Execute a block containing host ops (send/recv/listen_and_serv…):
+        device spans are jitted via the normal cached path; host ops run in
+        Python against the scope (the reference's per-op interpreter did the
+        same, executor.cc op->Run — we only drop to it at host boundaries)."""
+        block = program.global_block()
+        ops = block.ops
+        segs = self._segment_ops(ops)
+
+        # names consumed at/after an op index (for cross-segment promotion)
+        results: Dict[str, Any] = {}
+        for si, (host, lo, hi) in enumerate(segs):
+            if host:
+                for op in ops[lo:hi]:
+                    _HOST_OPS[op.type](scope, op, self)
+                continue
+            seg_ops = ops[lo:hi]
+            produced = {n for op in seg_ops for n in op.output_arg_names}
+            needed_later = set(fetch_names)
+            for _, l2, h2 in segs[si + 1:]:
+                for op in ops[l2:h2]:
+                    needed_later.update(op.input_arg_names)
+            consumed_here = {n for op in seg_ops for n in op.input_arg_names}
+            produced_before = {n for _, l0, h0 in segs[:si]
+                               for op in ops[l0:h0]
+                               for n in op.output_arg_names}
+            promote = frozenset(
+                (produced & needed_later)
+                | (consumed_here & produced_before))
+            view = self._slice_view(program, lo, hi, promote)
+            seg_feed = {n: v for n, v in feed.items()
+                        if n in consumed_here and n not in produced_before
+                        and n not in promote}
+            seg_fetch = [n for n in fetch_names if n in produced]
+            vals = self.run(program=view, feed=seg_feed,
+                            fetch_list=seg_fetch, scope=scope,
+                            return_numpy=return_numpy)
+            results.update(dict(zip(seg_fetch, vals)))
+
+        out = []
+        for n in fetch_names:
+            if n in results:
+                out.append(results[n])
+            else:
+                v = scope.find_var(n)
+                if v is None:
+                    raise RuntimeError(f"fetch {n!r} was never produced")
+                out.append(np.asarray(v) if return_numpy else v)
+        return out
 
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,

@@ -1,0 +1,209 @@
+// Parameter-server table core — the native heart of the PS capability.
+//
+// Capability parity with the reference's pserver optimizer blocks
+// (operators/distributed_ops/listen_and_serv_op.cc runs per-param optimizer
+// sub-blocks on grad arrival) and the PSLib-style sparse tables
+// (framework/fleet/fleet_wrapper.cc Downpour pull/push):
+//   * dense tables: contiguous float32 params with server-side SGD /
+//     Adagrad / Adam update rules,
+//   * sparse tables: uint64 feasign -> float32[dim] rows, lazily created,
+//     with the same update rules per row (plus slot state for adagrad/adam).
+// Thread-safe: one mutex per table (pserver request handlers are
+// multi-threaded, reference request_handler_impl.cc).
+//
+// Exposed as a C ABI for ctypes; the socket transport lives in Python
+// (distributed/ps_server.py) — the hot arithmetic is here.
+
+#include <cstdint>
+#include <cmath>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Opt { OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2, OPT_MOMENTUM = 3 };
+
+struct Table {
+  std::mutex mu;
+  int opt = OPT_SGD;
+  float lr = 0.01f;
+  // adam hyperparams
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;  // beta1 doubles as
+  int64_t adam_step = 0;                            // momentum's mu
+
+  // dense
+  int64_t size = 0;  // element count; 0 => sparse table
+  std::vector<float> w, m0, m1;
+
+  // sparse
+  int64_t dim = 0;
+  float init_range = 0.0f;  // new rows init to 0 (embeddings) by default
+  std::unordered_map<uint64_t, std::vector<float>> rows;       // weights
+  std::unordered_map<uint64_t, std::vector<float>> state0, state1;
+
+  void apply(float* w_, float* m0_, float* m1_, const float* g, int64_t n) {
+    switch (opt) {
+      case OPT_SGD:
+        for (int64_t i = 0; i < n; ++i) w_[i] -= lr * g[i];
+        break;
+      case OPT_ADAGRAD:
+        for (int64_t i = 0; i < n; ++i) {
+          m0_[i] += g[i] * g[i];
+          w_[i] -= lr * g[i] / (std::sqrt(m0_[i]) + 1e-6f);
+        }
+        break;
+      case OPT_MOMENTUM:
+        for (int64_t i = 0; i < n; ++i) {
+          m0_[i] = beta1 * m0_[i] + g[i];
+          w_[i] -= lr * m0_[i];
+        }
+        break;
+      case OPT_ADAM: {
+        // adam_step is advanced by the caller once per logical step
+        float b1t = 1.0f - std::pow(beta1, (float)adam_step);
+        float b2t = 1.0f - std::pow(beta2, (float)adam_step);
+        for (int64_t i = 0; i < n; ++i) {
+          m0_[i] = beta1 * m0_[i] + (1 - beta1) * g[i];
+          m1_[i] = beta2 * m1_[i] + (1 - beta2) * g[i] * g[i];
+          float mhat = m0_[i] / b1t;
+          float vhat = m1_[i] / b2t;
+          w_[i] -= lr * mhat / (std::sqrt(vhat) + eps);
+        }
+        break;
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// kind 0 = dense (size elements), kind 1 = sparse (dim per row).
+void* pt_create(int kind, int64_t size_or_dim, int opt, float lr,
+                float beta1, float beta2, float eps) {
+  auto* t = new Table();
+  t->opt = opt;
+  t->lr = lr;
+  t->beta1 = beta1;
+  t->beta2 = beta2;
+  t->eps = eps;
+  if (kind == 0) {
+    t->size = size_or_dim;
+    t->w.assign(size_or_dim, 0.0f);
+    if (opt != OPT_SGD) {
+      t->m0.assign(size_or_dim, 0.0f);
+      if (opt == OPT_ADAM) t->m1.assign(size_or_dim, 0.0f);
+    }
+  } else {
+    t->dim = size_or_dim;
+  }
+  return t;
+}
+
+void pt_set_lr(void* h, float lr) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  t->lr = lr;
+}
+
+void pt_set_dense(void* h, const float* data, int64_t n) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::memcpy(t->w.data(), data, sizeof(float) * n);
+}
+
+void pt_pull_dense(void* h, float* out, int64_t n) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::memcpy(out, t->w.data(), sizeof(float) * n);
+}
+
+// Apply one aggregated gradient with the table's optimizer.
+void pt_push_dense(void* h, const float* grad, int64_t n) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  if (t->opt == OPT_ADAM) ++t->adam_step;
+  t->apply(t->w.data(), t->m0.data(), t->m1.data(), grad, n);
+}
+
+// Raw add (GEO mode pushes param deltas, communicator.h Geo).
+void pt_add_dense(void* h, const float* delta, int64_t n) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (int64_t i = 0; i < n; ++i) t->w[i] += delta[i];
+}
+
+void pt_pull_sparse(void* h, const uint64_t* keys, int64_t nkeys, float* out) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (int64_t i = 0; i < nkeys; ++i) {
+    auto it = t->rows.find(keys[i]);
+    if (it == t->rows.end()) {
+      std::memset(out + i * t->dim, 0, sizeof(float) * t->dim);
+    } else {
+      std::memcpy(out + i * t->dim, it->second.data(),
+                  sizeof(float) * t->dim);
+    }
+  }
+}
+
+void pt_push_sparse(void* h, const uint64_t* keys, int64_t nkeys,
+                    const float* grads) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  if (t->opt == OPT_ADAM) ++t->adam_step;
+  for (int64_t i = 0; i < nkeys; ++i) {
+    auto& w = t->rows[keys[i]];
+    if (w.empty()) w.assign(t->dim, 0.0f);
+    float* m0 = nullptr;
+    float* m1 = nullptr;
+    if (t->opt != OPT_SGD) {
+      auto& s0 = t->state0[keys[i]];
+      if (s0.empty()) s0.assign(t->dim, 0.0f);
+      m0 = s0.data();
+      if (t->opt == OPT_ADAM) {
+        auto& s1 = t->state1[keys[i]];
+        if (s1.empty()) s1.assign(t->dim, 0.0f);
+        m1 = s1.data();
+      }
+    }
+    t->apply(w.data(), m0, m1, grads + i * t->dim, t->dim);
+  }
+}
+
+// Set explicit sparse rows (startup broadcast / checkpoint load).
+void pt_set_sparse(void* h, const uint64_t* keys, int64_t nkeys,
+                   const float* vals) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (int64_t i = 0; i < nkeys; ++i) {
+    auto& w = t->rows[keys[i]];
+    w.assign(vals + i * t->dim, vals + (i + 1) * t->dim);
+  }
+}
+
+int64_t pt_sparse_size(void* h) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  return static_cast<int64_t>(t->rows.size());
+}
+
+// Dump all sparse rows: caller provides buffers sized pt_sparse_size()*...
+void pt_dump_sparse(void* h, uint64_t* keys_out, float* vals_out) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  int64_t i = 0;
+  for (auto& kv : t->rows) {
+    keys_out[i] = kv.first;
+    std::memcpy(vals_out + i * t->dim, kv.second.data(),
+                sizeof(float) * t->dim);
+    ++i;
+  }
+}
+
+void pt_free(void* h) { delete static_cast<Table*>(h); }
+
+}  // extern "C"

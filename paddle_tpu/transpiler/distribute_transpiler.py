@@ -18,6 +18,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..framework.program import Program, default_main_program
+from .. import distributed as _distributed  # noqa: F401  registers host ops
 
 
 class DistributedMode:
@@ -136,6 +137,15 @@ class DistributeTranspiler:
         opt_types = {"sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop",
                      "lamb", "adamax", "adadelta", "ftrl", "lars_momentum",
                      "decayed_adagrad", "dpsgd"}
+        # optimizer lr input per param (sent with each push so LR schedules
+        # reach the server — the reference sends the lr var to the pserver
+        # sub-block instead)
+        self._lr_var_of = {}
+        for op in block.ops:
+            if op.type in opt_types:
+                lr_ins = op.input("LearningRate")
+                if lr_ins:
+                    self._lr_var_of[op.input("Param")[0]] = lr_ins[0]
         new_ops = [op for op in block.ops if op.type not in opt_types]
         block.ops = new_ops
         prog._bump_version()
@@ -148,6 +158,7 @@ class DistributeTranspiler:
                 attrs={"epmap": eps, "param": p.name,
                        "trainer_id": self.trainer_id,
                        "sync_mode": self.sync_mode,
+                       "lr_var": self._lr_var_of.get(p.name),
                        "mode": self.config.mode},
             )
         if self.sync_mode:
@@ -163,6 +174,10 @@ class DistributeTranspiler:
                 attrs={"epmap": eps, "param": p.name,
                        "trainer_id": self.trainer_id},
             )
+        if self.sync_mode:
+            block.append_op(type="fetch_barrier", attrs={
+                "endpoints": self.pserver_endpoints,
+                "trainer_id": self.trainer_id})
         self.trainer_program = prog
 
     # ------------------------------------------------------------------
@@ -183,15 +198,30 @@ class DistributeTranspiler:
         opt_types = {"sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop",
                      "lamb", "adamax", "adadelta", "ftrl", "lars_momentum",
                      "decayed_adagrad", "dpsgd"}
+        # table configs: optimizer rule + shape per owned param (the server
+        # side of the reference's per-param optimizer sub-blocks)
+        table_opt = {"sgd": "sgd", "momentum": "momentum", "adagrad": "adagrad",
+                     "adam": "adam", "adamw": "adam"}
+        tables = []
         for op in origin_block.ops:
             if op.type in opt_types and op.input("Param")[0] in owned:
                 opt_descs.append(op._desc_dict())
+                pname = op.input("Param")[0]
+                pvar = origin_block.var(pname)
+                tables.append({
+                    "name": pname,
+                    "shape": [int(d) for d in pvar.shape],
+                    "optimizer": table_opt.get(op.type, "sgd"),
+                    "lr": 0.01,  # overwritten per push by the trainer's lr
+                    "is_sparse": False,
+                })
         block.append_op(
             type="listen_and_serv",
             attrs={
                 "endpoint": endpoint,
                 "optimize_ops": opt_descs,
                 "owned_params": sorted(owned),
+                "tables": tables,
                 "trainer_num": self.trainer_num,
                 "sync_mode": self.sync_mode,
                 "mode": self.config.mode,
