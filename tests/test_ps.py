@@ -246,8 +246,14 @@ def test_two_trainer_cluster_matches_local():
     server.register_dense("fc_0.b_0", (1,), "sgd")
     server.start()
     import os
-    old_platform = os.environ.get("JAX_PLATFORMS")
-    os.environ["JAX_PLATFORMS"] = "cpu"  # inherited by spawned children
+    old_env = {k: os.environ.get(k)
+               for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    # children must be pure-CPU: JAX_PLATFORMS=cpu for jax proper, and the
+    # TPU-relay sitecustomize must no-op (it registers the axon backend at
+    # interpreter start; concurrent children contending on the single-chip
+    # relay deadlock against the PS sync barrier)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
     ctx = multiprocessing.get_context("spawn")
     q = ctx.Queue()
     procs = [ctx.Process(target=_trainer_proc,
@@ -270,10 +276,11 @@ def test_two_trainer_cluster_matches_local():
         np.testing.assert_allclose(results[0][1], w_local, rtol=2e-3,
                                    atol=2e-4)
     finally:
-        if old_platform is None:
-            os.environ.pop("JAX_PLATFORMS", None)
-        else:
-            os.environ["JAX_PLATFORMS"] = old_platform
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
         for p in procs:
             if p.is_alive():
                 p.terminate()
