@@ -55,6 +55,9 @@ class RoleMakerBase:
     def get_pserver_endpoints(self) -> List[str]:
         return self._server_endpoints
 
+    def get_current_server_endpoint(self) -> str:
+        return self._server_endpoints[self._current_id]
+
 
 class PaddleCloudRoleMaker(RoleMakerBase):
     """Reads the PADDLE_* env contract (role_maker.py:501-536)."""

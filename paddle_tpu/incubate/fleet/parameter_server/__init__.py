@@ -1,0 +1,130 @@
+"""PS-mode Fleet — parity with
+fluid/incubate/fleet/parameter_server/distribute_transpiler/__init__.py
+(DistributedTranspiler fleet: init_server/run_server/init_worker/
+distributed_optimizer over the DistributeTranspiler).
+
+Usage (reference PS recipe):
+
+    fleet.init(role_maker)
+    optimizer = fleet.distributed_optimizer(fluid.optimizer.SGDOptimizer(0.1))
+    optimizer.minimize(loss)
+    if fleet.is_server():
+        fleet.init_server(); fleet.run_server()          # blocks
+    else:
+        fleet.init_worker()
+        exe.run(fleet.main_program, feed=..., ...)
+        fleet.stop_worker()
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ....framework.executor import Executor
+from ....framework.program import Program, default_main_program, default_startup_program
+from ....transpiler.distribute_transpiler import (
+    DistributeTranspiler, DistributeTranspilerConfig)
+from ..base.fleet_base import Fleet
+from ..base.role_maker import RoleMakerBase
+
+__all__ = ["fleet", "ParameterServerOptimizer", "DistributedTranspiler"]
+
+
+class DistributedTranspiler(Fleet):
+    def __init__(self):
+        super().__init__()
+        self._transpiler: Optional[DistributeTranspiler] = None
+        self.main_program: Optional[Program] = None
+        self.startup_program: Optional[Program] = None
+        self._server = None
+        self._origin_main = None
+        self._origin_startup = None
+
+    # -- fleet lifecycle ----------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = ParameterServerOptimizer(self, optimizer,
+                                                   strategy or
+                                                   DistributeTranspilerConfig())
+        return self._optimizer
+
+    def _transpile(self, config: DistributeTranspilerConfig):
+        t = DistributeTranspiler(config=config)
+        t.transpile(
+            trainer_id=self._role_maker.worker_index(),
+            program=self._origin_main or default_main_program(),
+            pservers=",".join(self._role_maker.get_pserver_endpoints()),
+            trainers=self._role_maker.worker_num(),
+            sync_mode=config.sync_mode,
+            startup_program=self._origin_startup or default_startup_program(),
+        )
+        self._transpiler = t
+        if self._role_maker.is_worker():
+            self.main_program = t.get_trainer_program()
+            self.startup_program = self._origin_startup or default_startup_program()
+        else:
+            ep = self._role_maker.get_current_server_endpoint()
+            self.main_program = t.get_pserver_program(ep)
+            self.startup_program = t.get_startup_program(ep)
+
+    def init_worker(self):
+        pass  # connections are lazy (PSClient wait-port on first send/recv)
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self, blocking: bool = True):
+        """Run the pserver program (listen_and_serv host op)."""
+        assert self.main_program is not None, "call minimize first"
+        ls_op = self.main_program.global_block().ops[0]
+        ls_op.attrs["blocking"] = blocking
+        Executor().run(self.main_program)
+        self._server = getattr(ls_op, "_server", None)
+        return self._server
+
+    def stop_worker(self):
+        from ....distributed import PSClient
+        tid = self._role_maker.worker_index()
+        client = PSClient.instance(tid)
+        client.complete(self._role_maker.get_pserver_endpoints())
+        client.close()
+
+    def stop_server(self):
+        from ....distributed import PSClient
+        client = PSClient.instance(self._role_maker.worker_index())
+        for ep in self._role_maker.get_pserver_endpoints():
+            client.stop_server(ep)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ....distributed import PSClient
+        client = PSClient.instance(self._role_maker.worker_index())
+        for ep in self._role_maker.get_pserver_endpoints():
+            client.checkpoint_notify(ep, dirname)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io as fluid_io
+        fluid_io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or self._origin_main)
+
+
+class ParameterServerOptimizer:
+    """fleet.distributed_optimizer(...) for PS mode — parity with
+    fleet/parameter_server/distribute_transpiler TranspilerOptimizer."""
+
+    def __init__(self, fleet_: DistributedTranspiler, optimizer, config):
+        self._fleet = fleet_
+        self._optimizer = optimizer
+        self._config = config
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        self._fleet._origin_main = loss.block.program
+        self._fleet._origin_startup = startup_program
+        self._fleet._transpile(self._config)
+        return ops, params_grads
+
+
+fleet = DistributedTranspiler()

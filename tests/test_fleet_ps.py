@@ -1,0 +1,70 @@
+"""PS-mode Fleet API: the reference recipe (fleet.init → distributed_optimizer
+→ server/worker split) driven in one process with an in-process pserver."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed import PSClient
+from paddle_tpu.incubate.fleet.base.role_maker import Role, UserDefinedRoleMaker
+from paddle_tpu.incubate.fleet.parameter_server import DistributedTranspiler
+
+
+def _build(seed=0):
+    from paddle_tpu.framework import unique_name
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = seed
+    with unique_name.guard():
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return prog, startup, loss
+
+
+def test_fleet_ps_recipe():
+    PSClient.reset_all()
+    endpoint = "127.0.0.1:0"
+
+    # ---- server side -----------------------------------------------------
+    server_fleet = DistributedTranspiler()
+    server_fleet.init(UserDefinedRoleMaker(
+        current_id=0, role=Role.SERVER, worker_num=1,
+        server_endpoints=[endpoint]))
+    prog_s, startup_s, loss_s = _build()
+    with fluid.program_guard(prog_s, startup_s):
+        opt = server_fleet.distributed_optimizer(
+            fluid.optimizer.SGDOptimizer(0.1))
+        opt.minimize(loss_s)
+    server = server_fleet.run_server(blocking=False)
+    assert server is not None
+
+    try:
+        # ---- worker side -------------------------------------------------
+        worker_fleet = DistributedTranspiler()
+        worker_fleet.init(UserDefinedRoleMaker(
+            current_id=0, role=Role.WORKER, worker_num=1,
+            server_endpoints=[server.endpoint]))
+        prog_w, startup_w, loss_w = _build()
+        with fluid.program_guard(prog_w, startup_w):
+            opt = worker_fleet.distributed_optimizer(
+                fluid.optimizer.SGDOptimizer(0.1))
+            opt.minimize(loss_w)
+        worker_fleet.init_worker()
+
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        exe.run(worker_fleet.startup_program or startup_w, scope=scope)
+
+        rng = np.random.RandomState(0)
+        w_true = np.array([2., -1., 0.5, 1.], np.float32)
+        x = rng.randn(32, 4).astype(np.float32)
+        y = (x @ w_true).reshape(-1, 1).astype(np.float32)
+        losses = [float(exe.run(worker_fleet.main_program,
+                                feed={"x": x, "y": y},
+                                fetch_list=[loss_w], scope=scope)[0])
+                  for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.2, losses
+        worker_fleet.stop_worker()
+    finally:
+        server.stop()
+        PSClient.reset_all()

@@ -287,6 +287,45 @@ def test_two_trainer_cluster_matches_local():
         server.stop()
 
 
+def test_distributed_lookup_table_op():
+    """Remote sparse embedding lookup inside a program (parameter_prefetch
+    capability): ids -> pserver sparse table rows -> downstream device ops."""
+    server = ParameterServer("127.0.0.1:0", trainer_num=1, sync_mode=False)
+    server.register_sparse("emb_table", 3, "sgd", lr=1.0)
+    server.start()
+    try:
+        c = PSClient.instance(0)
+        keys = np.array([5, 9], np.uint64)
+        c.push_sparse(server.endpoint, "emb_table", keys,
+                      -np.arange(6, dtype=np.float32).reshape(2, 3))
+
+        prog = fluid.Program()
+        block = prog.global_block()
+        ids = block.create_var(name="ids", shape=[-1, 1], dtype="int64",
+                               is_data=True)
+        emb = block.create_var(name="emb_out", shape=[-1, 3], dtype="float32")
+        out = block.create_var(name="doubled", shape=[-1, 3], dtype="float32")
+        block.append_op(
+            type="distributed_lookup_table",
+            inputs={"Ids": ["ids"]}, outputs={"Out": ["emb_out"]},
+            attrs={"epmap": [server.endpoint], "table_name": "emb_table",
+                   "trainer_id": 0})
+        block.append_op(type="scale", inputs={"X": ["emb_out"]},
+                        outputs={"Out": ["doubled"]},
+                        attrs={"scale": 2.0, "bias": 0.0})
+
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        import jax.numpy as jnp
+        scope.set_var("ids", jnp.asarray(np.array([[5], [9]], np.int64)))
+        vals = exe.run(prog, feed={}, fetch_list=["doubled"], scope=scope)
+        np.testing.assert_allclose(
+            vals[0], 2.0 * np.arange(6, dtype=np.float32).reshape(2, 3))
+        PSClient.reset_all()
+    finally:
+        server.stop()
+
+
 def test_checkpoint_notify(tmp_path):
     server = ParameterServer("127.0.0.1:0", trainer_num=1, sync_mode=False)
     server.register_dense("w", (2,), "sgd", lr=1.0)
