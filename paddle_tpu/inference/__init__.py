@@ -1,0 +1,19 @@
+"""Inference engine — capability parity with paddle/fluid/inference/
+(AnalysisPredictor + AnalysisConfig, inference/api/analysis_predictor.cc).
+
+TPU-native design: the reference runs a pruned ProgramDesc through IR fuse
+passes and optional TensorRT subgraphs; here the pruned program is lowered
+whole into one XLA computation (fusion is XLA's job) and can additionally be
+exported as a serialized StableHLO artifact (jax.export) — the deployment
+format that replaces paddle_fluid shared-lib packaging.
+"""
+from .predictor import (  # noqa: F401
+    Config,
+    Predictor,
+    create_predictor,
+    export_stablehlo,
+    load_stablehlo_predictor,
+)
+
+__all__ = ["Config", "Predictor", "create_predictor", "export_stablehlo",
+           "load_stablehlo_predictor"]

@@ -1,0 +1,196 @@
+"""Predictor — AnalysisPredictor/AnalysisConfig parity on XLA.
+
+Reference surface (inference/api/paddle_api.h, analysis_predictor.cc):
+  config = Config(model_dir)            # AnalysisConfig
+  predictor = create_predictor(config)
+  predictor.run({"x": batch})           # ZeroCopy-style dict in/out
+Plus the TPU-native export path: ``export_stablehlo`` serializes the pruned
+program (params baked in) via jax.export for serving without Python graph
+machinery.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import io as fluid_io
+from ..framework.core import XLAPlace, dtype_to_jax
+from ..framework.executor import Executor, Scope
+from ..framework.program import Program
+
+__all__ = ["Config", "Predictor", "create_predictor", "export_stablehlo",
+           "load_stablehlo_predictor"]
+
+
+class Config:
+    """AnalysisConfig parity (subset: model paths + precision switches)."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._bf16 = False
+        self._memory_optimize = True  # XLA always does this; kept for parity
+
+    def enable_bf16(self):
+        """Low-precision inference — reference enable_mkldnn_bfloat16 /
+        TensorRT fp16 analogues; on TPU this is the MXU-native mode."""
+        self._bf16 = True
+
+    def switch_ir_optim(self, flag: bool = True):
+        pass  # XLA always optimizes; accepted for parity
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optimize = flag
+
+
+class Predictor:
+    def __init__(self, program: Program, feed_names: List[str],
+                 fetch_names: List[str], scope: Scope,
+                 bf16: bool = False):
+        if bf16:
+            from ..contrib.mixed_precision import cast_model_to_fp16
+            cast_model_to_fp16(program, dest_dtype="bfloat16")
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch_names = list(fetch_names)
+        self._scope = scope
+        self._exe = Executor(XLAPlace(0))
+
+    # -- reference API surface ---------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def run(self, feed: Dict[str, Any]) -> List[np.ndarray]:
+        missing = set(self._feed_names) - set(feed)
+        if missing:
+            raise ValueError(f"missing inputs: {sorted(missing)}")
+        return self._exe.run(self._program,
+                             feed={k: feed[k] for k in self._feed_names},
+                             fetch_list=self._fetch_names, scope=self._scope)
+
+    @property
+    def program(self) -> Program:
+        return self._program
+
+
+def create_predictor(config: Config) -> Predictor:
+    if not config.model_dir:
+        raise ValueError("Config.model_dir is required")
+    exe = Executor(XLAPlace(0))
+    scope = Scope()
+    from ..framework.executor import scope_guard
+    with scope_guard(scope):
+        program, feed_names, fetch_vars = fluid_io.load_inference_model(
+            config.model_dir, exe, model_filename=config.prog_file,
+            params_filename=config.params_file)
+    fetch_names = [v.name if hasattr(v, "name") else str(v)
+                   for v in fetch_vars]
+    return Predictor(program, feed_names, fetch_names, scope,
+                     bf16=config._bf16)
+
+
+# ---------------------------------------------------------------------------
+# StableHLO export of a static program
+# ---------------------------------------------------------------------------
+
+def _program_fn(program: Program, feed_names: Sequence[str],
+                fetch_names: Sequence[str], scope: Scope):
+    """Build fn(feeds_tuple) -> fetches_tuple with params baked as constants
+    from scope (deployment artifact = weights frozen, reference
+    save_inference_model semantics)."""
+    from ..framework.registry import LowerCtx, run_lowering
+
+    block = program.global_block()
+    params = {}
+    for name, var in block.vars.items():
+        if var.persistable:
+            v = scope.find_var(name)
+            if v is not None:
+                params[name] = jnp.asarray(v)
+
+    def fn(*feed_vals):
+        env: Dict[str, Any] = dict(params)
+        env.update(dict(zip(feed_names, feed_vals)))
+        ctx = LowerCtx(program, block, env,
+                       rng_key=jax.random.PRNGKey(0), mesh_axes={})
+        for op in block.ops:
+            run_lowering(ctx, op)
+        return tuple(env[n] for n in fetch_names)
+
+    return fn
+
+
+def export_stablehlo(dirname: str, program: Program,
+                     feed_specs: Dict[str, Any], fetch_names: Sequence[str],
+                     scope: Optional[Scope] = None):
+    """Serialize the program as StableHLO bytes + meta.
+
+    feed_specs: name -> (shape, dtype) or an example ndarray.
+    """
+    from jax import export as jexport
+    from ..framework.executor import global_scope
+
+    scope = scope or global_scope()
+    feed_names = sorted(feed_specs)
+    # export only the feed->fetch slice (reference prune.cc before save)
+    program = fluid_io.prune_program(program, list(feed_names),
+                                     list(fetch_names))
+    sds = []
+    for n in feed_names:
+        spec = feed_specs[n]
+        if hasattr(spec, "shape"):
+            sds.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                            np.asarray(spec).dtype))
+        else:
+            shape, dtype = spec
+            sds.append(jax.ShapeDtypeStruct(
+                tuple(int(d) for d in shape), dtype_to_jax(dtype)))
+    fn = _program_fn(program, feed_names, list(fetch_names), scope)
+    exp = jexport.export(jax.jit(fn))(*sds)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "model.shlo"), "wb") as f:
+        f.write(exp.serialize())
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump({"feed_names": feed_names,
+                   "fetch_names": list(fetch_names)}, f)
+    return exp
+
+
+class StableHLOPredictor:
+    """Runs a serialized StableHLO artifact — no Program machinery needed."""
+
+    def __init__(self, exported, feed_names, fetch_names):
+        self._exported = exported
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def run(self, feed: Dict[str, Any]) -> List[np.ndarray]:
+        vals = [jnp.asarray(feed[n]) for n in self._feed_names]
+        outs = self._exported.call(*vals)
+        return [np.asarray(o) for o in outs]
+
+
+def load_stablehlo_predictor(dirname: str) -> StableHLOPredictor:
+    from jax import export as jexport
+    with open(os.path.join(dirname, "model.shlo"), "rb") as f:
+        exp = jexport.deserialize(f.read())
+    with open(os.path.join(dirname, "meta.json")) as f:
+        meta = json.load(f)
+    return StableHLOPredictor(exp, meta["feed_names"], meta["fetch_names"])

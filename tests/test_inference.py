@@ -1,0 +1,70 @@
+"""Inference engine: save_inference_model -> Predictor round trip, bf16
+inference mode, and StableHLO export/load."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import inference
+
+
+def _train_tiny(tmp_path):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [6], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xb = rng.randn(32, 6).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        for _ in range(20):
+            exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        model_dir = str(tmp_path / "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe, prog)
+        # expected outputs via the pruned forward slice (running the train
+        # program would step the optimizer again and move the weights)
+        fwd = fluid.io.prune_program(prog, ["x"], [pred.name])
+        want = exe.run(fwd, feed={"x": xb[:4]}, fetch_list=[pred])[0]
+    return model_dir, prog, pred, scope, xb, want
+
+
+def test_predictor_roundtrip(tmp_path):
+    model_dir, _, _, _, xb, want = _train_tiny(tmp_path)
+    config = inference.Config(model_dir)
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    got = predictor.run({"x": xb[:4]})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_predictor_bf16(tmp_path):
+    model_dir, _, _, _, xb, want = _train_tiny(tmp_path)
+    config = inference.Config(model_dir)
+    config.enable_bf16()
+    predictor = inference.create_predictor(config)
+    got = predictor.run({"x": xb[:4]})[0]
+    # bf16 has ~3 decimal digits
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+def test_predictor_missing_input(tmp_path):
+    model_dir, _, _, _, xb, _ = _train_tiny(tmp_path)
+    predictor = inference.create_predictor(inference.Config(model_dir))
+    import pytest
+    with pytest.raises(ValueError, match="missing inputs"):
+        predictor.run({})
+
+
+def test_stablehlo_export_roundtrip(tmp_path):
+    model_dir, prog, pred, scope, xb, want = _train_tiny(tmp_path)
+    out_dir = str(tmp_path / "shlo")
+    inference.export_stablehlo(
+        out_dir, prog, {"x": xb[:4]}, [pred.name], scope=scope)
+    p = inference.load_stablehlo_predictor(out_dir)
+    got = p.run({"x": xb[:4]})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
