@@ -51,6 +51,7 @@ from .framework.program import (  # noqa: F401
 
 from . import clip  # noqa: F401
 from . import contrib  # noqa: F401
+from . import distribution  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import DataLoader  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401

@@ -1,0 +1,193 @@
+"""Probability distributions — parity with fluid/distribution.py
+(Uniform, Normal, Categorical, MultivariateNormalDiag: sample / entropy /
+log_prob / kl_divergence).
+
+Like the reference, methods build graph ops over Variables (static mode);
+python floats/np arrays are accepted and lifted to constants.
+"""
+from __future__ import annotations
+
+import math
+from typing import Union
+
+import numpy as np
+
+from . import layers
+from .framework.program import Variable
+from .layers import tensor as ltensor
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _to_var(x, dtype="float32"):
+    if isinstance(x, Variable):
+        return x
+    arr = np.asarray(x, dtype=dtype)
+    return ltensor.assign(arr)
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) — fluid/distribution.py Uniform."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = layers.uniform_random(shape, min=0.0, max=1.0, seed=seed)
+        span = layers.elementwise_sub(self.high, self.low)
+        return layers.elementwise_add(
+            layers.elementwise_mul(u, span), self.low)
+
+    def entropy(self):
+        return layers.log(layers.elementwise_sub(self.high, self.low))
+
+    def log_prob(self, value):
+        value = _to_var(value)
+        span = layers.elementwise_sub(self.high, self.low)
+        lb = layers.cast(layers.less_than(self.low, value), "float32")
+        ub = layers.cast(layers.less_than(value, self.high), "float32")
+        inside = layers.elementwise_mul(lb, ub)
+        return layers.log(
+            layers.elementwise_div(inside, span))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError("uniform KL not in reference either")
+
+
+class Normal(Distribution):
+    """N(loc, scale) — fluid/distribution.py Normal."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = layers.gaussian_random(shape, mean=0.0, std=1.0, seed=seed)
+        return layers.elementwise_add(
+            layers.elementwise_mul(z, self.scale), self.loc)
+
+    def entropy(self):
+        # 0.5 + 0.5 log(2π) + log σ
+        const = 0.5 + 0.5 * math.log(2 * math.pi)
+        return layers.elementwise_add(
+            ltensor.fill_constant([1], "float32", const),
+            layers.log(self.scale))
+
+    def log_prob(self, value):
+        var = layers.elementwise_mul(self.scale, self.scale)
+        diff = layers.elementwise_sub(_to_var(value), self.loc)
+        quad = layers.elementwise_div(
+            layers.elementwise_mul(diff, diff),
+            layers.scale(var, scale=2.0))
+        log_z = layers.elementwise_add(
+            layers.log(self.scale),
+            ltensor.fill_constant([1], "float32", 0.5 * math.log(2 * math.pi)))
+        return layers.elementwise_sub(layers.scale(quad, scale=-1.0), log_z)
+
+    def kl_divergence(self, other: "Normal"):
+        # KL(N0||N1) = log σ1/σ0 + (σ0² + (μ0-μ1)²)/(2σ1²) - 1/2
+        var0 = layers.elementwise_mul(self.scale, self.scale)
+        var1 = layers.elementwise_mul(other.scale, other.scale)
+        dmu = layers.elementwise_sub(self.loc, other.loc)
+        t = layers.elementwise_div(
+            layers.elementwise_add(var0, layers.elementwise_mul(dmu, dmu)),
+            layers.scale(var1, scale=2.0))
+        return layers.elementwise_add(
+            layers.elementwise_sub(
+                layers.log(layers.elementwise_div(other.scale, self.scale)),
+                ltensor.fill_constant([1], "float32", 0.5)),
+            t)
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits — fluid/distribution.py."""
+
+    def __init__(self, logits):
+        self.logits = _to_var(logits)
+
+    def _log_pmf(self):
+        return layers.log_softmax(self.logits)
+
+    def entropy(self):
+        logp = self._log_pmf()
+        p = layers.softmax(self.logits)
+        return layers.scale(
+            layers.reduce_sum(layers.elementwise_mul(p, logp), dim=-1),
+            scale=-1.0)
+
+    def log_prob(self, value):
+        logp = self._log_pmf()
+        oh = layers.one_hot(_to_var(value, "int64"),
+                            self.logits.shape[-1])
+        return layers.reduce_sum(layers.elementwise_mul(logp, oh), dim=-1)
+
+    def kl_divergence(self, other: "Categorical"):
+        logp = self._log_pmf()
+        logq = other._log_pmf()
+        p = layers.softmax(self.logits)
+        return layers.reduce_sum(
+            layers.elementwise_mul(p, layers.elementwise_sub(logp, logq)),
+            dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal — fluid/distribution.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)       # [..., d]
+        self.scale = _to_var(scale)   # diagonal covariance matrix [d, d]
+
+    def _det(self):
+        # product of diagonal entries
+        d = self.scale.shape[-1]
+        diag = layers.reduce_sum(
+            layers.elementwise_mul(
+                self.scale,
+                ltensor.assign(np.eye(d, dtype=np.float32))), dim=-1)
+        return layers.reduce_prod(diag)
+
+    def entropy(self):
+        d = self.scale.shape[-1]
+        const = 0.5 * d * (1.0 + math.log(2 * math.pi))
+        return layers.elementwise_add(
+            ltensor.fill_constant([1], "float32", const),
+            layers.scale(layers.log(self._det()), scale=0.5))
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        d = self.scale.shape[-1]
+        eye = ltensor.assign(np.eye(d, dtype=np.float32))
+        diag0 = layers.reduce_sum(layers.elementwise_mul(self.scale, eye),
+                                  dim=-1)
+        diag1 = layers.reduce_sum(layers.elementwise_mul(other.scale, eye),
+                                  dim=-1)
+        tr = layers.reduce_sum(layers.elementwise_div(diag0, diag1))
+        dmu = layers.elementwise_sub(other.loc, self.loc)
+        quad = layers.reduce_sum(
+            layers.elementwise_div(layers.elementwise_mul(dmu, dmu), diag1))
+        logdet = layers.elementwise_sub(
+            layers.reduce_sum(layers.log(diag1)),
+            layers.reduce_sum(layers.log(diag0)))
+        return layers.scale(
+            layers.elementwise_add(
+                layers.elementwise_add(
+                    layers.elementwise_sub(tr,
+                                           ltensor.fill_constant(
+                                               [1], "float32", float(d))),
+                    quad),
+                logdet),
+            scale=0.5)

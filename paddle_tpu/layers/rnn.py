@@ -1,0 +1,79 @@
+"""RNN layers — fluid/layers/rnn.py surface subset (lstm, gru) over the
+scan-based fused ops in ops/rnn.py."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..ops.rnn import lstm_blob_size
+
+__all__ = ["lstm", "gru"]
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, is_test=False,
+         name=None, default_initializer=None, seed=-1,
+         sequence_length=None, param_attr=None):
+    """fluid.layers.lstm (cudnn path, fluid/layers/rnn.py).
+
+    input: [B, T, D]; init_h/init_c: [num_layers, B, hidden_size].
+    Returns (out [B,T,H], last_h, last_c).
+    """
+    if is_bidirec:
+        raise NotImplementedError("bidirectional lstm: pending")
+    assert hidden_size is not None
+    helper = LayerHelper("lstm", param_attr=param_attr, name=name)
+    d = input.shape[-1]
+    blob = lstm_blob_size(d, hidden_size, num_layers)
+    from ..framework.initializer import UniformInitializer
+    import math
+    k = 1.0 / math.sqrt(hidden_size)
+    w = helper.create_parameter(
+        param_attr, shape=[blob], dtype=input.dtype,
+        default_initializer=default_initializer or UniformInitializer(-k, k))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    last_c = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": [input], "W": [w], "InitH": [init_h], "InitC": [init_c]}
+    if sequence_length is not None:
+        inputs["SequenceLength"] = [sequence_length]
+    helper.append_op(
+        type="cudnn_lstm", inputs=inputs,
+        outputs={"Out": [out.name], "LastH": [last_h.name],
+                 "LastC": [last_c.name]},
+        attrs={"num_layers": num_layers, "hidden_size": hidden_size,
+               "dropout_prob": dropout_prob, "is_test": is_test})
+    return out, last_h, last_c
+
+
+def gru(input, hidden_size: int, init_h=None, sequence_length=None,
+        param_attr=None, bias_attr=None, name=None):
+    """Batch-major GRU layer over the fused_gru op (gru_op.cc gate layout)."""
+    helper = LayerHelper("gru", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    d = input.shape[-1]
+    from ..framework.initializer import UniformInitializer
+    import math
+    k = 1.0 / math.sqrt(hidden_size)
+    init = UniformInitializer(-k, k)
+    wx = helper.create_parameter(param_attr, shape=[d, 3 * hidden_size],
+                                 dtype=input.dtype, default_initializer=init)
+    wh = helper.create_parameter(param_attr, shape=[hidden_size, 3 * hidden_size],
+                                 dtype=input.dtype, default_initializer=init)
+    b = helper.create_parameter(bias_attr, shape=[3 * hidden_size],
+                                dtype=input.dtype, is_bias=True)
+    if init_h is None:
+        raise ValueError("gru requires init_h (shape [B, hidden_size])")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": [input], "WeightX": [wx], "WeightH": [wh], "Bias": [b],
+              "InitH": [init_h]}
+    if sequence_length is not None:
+        inputs["SequenceLength"] = [sequence_length]
+    helper.append_op(
+        type="fused_gru", inputs=inputs,
+        outputs={"Out": [out.name], "LastH": [last_h.name]},
+        attrs={})
+    return out, last_h

@@ -6,4 +6,6 @@ from . import optimizer_ops  # noqa: F401
 from . import collective  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sequence  # noqa: F401
+from . import rnn  # noqa: F401
+from . import detection  # noqa: F401
 from . import amp_ops  # noqa: F401

@@ -1,0 +1,287 @@
+"""Detection ops — parity with operators/detection/ (yolo_box, prior_box,
+box_coder, roi_align as XLA lowerings; multiclass_nms as a HOST op — the
+reference registers it CPU-only as well, multiclass_nms_op.cc, so variable-
+size NMS output never touches the static-shape device graph).
+"""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# yolo_box (detection/yolo_box_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("yolo_box", grad=None)
+def yolo_box(ctx, op, ins):
+    x = ins["X"][0]                       # [N, an*(5+nc), H, W]
+    img_size = ins["ImgSize"][0]          # [N, 2] (h, w)
+    anchors = [int(a) for a in op.attr("anchors")]
+    class_num = int(op.attr("class_num"))
+    conf_thresh = float(op.attr("conf_thresh", 0.01))
+    downsample = int(op.attr("downsample_ratio", 32))
+    clip_bbox = bool(op.attr("clip_bbox", True))
+
+    n, c, h, w = x.shape
+    an = len(anchors) // 2
+    x = x.reshape(n, an, 5 + class_num, h, w)
+    x = jnp.transpose(x, (0, 1, 3, 4, 2))          # [N, an, H, W, 5+nc]
+
+    grid_x = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], x.dtype)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], x.dtype)[None, :, None, None]
+
+    bx = (jax.nn.sigmoid(x[..., 0]) + grid_x) / w   # center, normalized
+    by = (jax.nn.sigmoid(x[..., 1]) + grid_y) / h
+    bw = jnp.exp(x[..., 2]) * aw / (downsample * w)
+    bh = jnp.exp(x[..., 3]) * ah / (downsample * h)
+    conf = jax.nn.sigmoid(x[..., 4])
+    probs = jax.nn.sigmoid(x[..., 5:]) * conf[..., None]
+    probs = jnp.where(conf[..., None] >= conf_thresh, probs, 0.0)
+
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = probs.reshape(n, -1, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+# ---------------------------------------------------------------------------
+# prior_box (detection/prior_box_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("prior_box", grad=None)
+def prior_box(ctx, op, ins):
+    feat = ins["Input"][0]                # [N, C, H, W]
+    image = ins["Image"][0]               # [N, C, IH, IW]
+    min_sizes = [float(s) for s in op.attr("min_sizes")]
+    max_sizes = [float(s) for s in op.attr("max_sizes", [])]
+    aspect_ratios = [float(a) for a in op.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in op.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(op.attr("flip", False))
+    clip = bool(op.attr("clip", False))
+    step_w = float(op.attr("step_w", 0.0))
+    step_h = float(op.attr("step_h", 0.0))
+    offset = float(op.attr("offset", 0.5))
+
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    widths: List[float] = []
+    heights: List[float] = []
+    for k, ms in enumerate(min_sizes):
+        # first: aspect ratio 1 with min size
+        widths.append(ms); heights.append(ms)
+        if max_sizes:
+            prime = math.sqrt(ms * max_sizes[k])
+            widths.append(prime); heights.append(prime)
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            widths.append(ms * math.sqrt(ar))
+            heights.append(ms / math.sqrt(ar))
+    num_priors = len(widths)
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * sh
+    cx = jnp.broadcast_to(cx[None, :, None], (h, w, num_priors))
+    cy = jnp.broadcast_to(cy[:, None, None], (h, w, num_priors))
+    bw = jnp.asarray(widths, jnp.float32)[None, None, :] / 2.0
+    bh = jnp.asarray(heights, jnp.float32)[None, None, :] / 2.0
+    boxes = jnp.stack([(cx - bw) / iw, (cy - bh) / ih,
+                       (cx + bw) / iw, (cy + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, num_priors, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+# ---------------------------------------------------------------------------
+# box_coder (detection/box_coder_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("box_coder", grad=None)
+def box_coder(ctx, op, ins):
+    prior = ins["PriorBox"][0]            # [M, 4]
+    pvar = ins.get("PriorBoxVar", [None])[0]
+    target = ins["TargetBox"][0]
+    code_type = op.attr("code_type", "encode_center_size")
+    normalized = bool(op.attr("box_normalized", True))
+    one = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        ox = (tcx - pcx) / pw / pvar[:, 0]
+        oy = (tcy - pcy) / ph / pvar[:, 1]
+        ow = jnp.log(tw / pw) / pvar[:, 2]
+        oh = jnp.log(th / ph) / pvar[:, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    else:  # decode_center_size; target [M, 4] deltas
+        dcx = target[..., 0] * pvar[:, 0] * pw + pcx
+        dcy = target[..., 1] * pvar[:, 1] * ph + pcy
+        dw = jnp.exp(target[..., 2] * pvar[:, 2]) * pw
+        dh = jnp.exp(target[..., 3] * pvar[:, 3]) * ph
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - one, dcy + dh / 2 - one], axis=-1)
+    return {"OutputBox": out}
+
+
+# ---------------------------------------------------------------------------
+# roi_align (detection/roi_align_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("roi_align", diff_inputs=("X",))
+def roi_align(ctx, op, ins):
+    x = ins["X"][0]                        # [N, C, H, W]
+    rois = ins["ROIs"][0]                  # [R, 4] (x1,y1,x2,y2)
+    batch_ids = ins.get("RoisBatchId", [None])[0]
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    ratio = int(op.attr("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    if batch_ids is None:
+        batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bid):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: [ph, ratio] x [pw, ratio]
+        iy = (jnp.arange(ph)[:, None] * bin_h + y1
+              + (jnp.arange(ratio)[None, :] + 0.5) * bin_h / ratio)
+        ix = (jnp.arange(pw)[:, None] * bin_w + x1
+              + (jnp.arange(ratio)[None, :] + 0.5) * bin_w / ratio)
+        iy = iy.reshape(-1)                 # [ph*ratio]
+        ix = ix.reshape(-1)                 # [pw*ratio]
+        y0 = jnp.clip(jnp.floor(iy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(ix), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        ly = jnp.clip(iy - y0, 0.0, 1.0)
+        lx = jnp.clip(ix - x0, 0.0, 1.0)
+        img = x[bid]                        # [C, H, W]
+        # bilinear: gather 4 corners on the outer product grid
+        v00 = img[:, y0i[:, None], x0i[None, :]]
+        v01 = img[:, y0i[:, None], x1i[None, :]]
+        v10 = img[:, y1i[:, None], x0i[None, :]]
+        v11 = img[:, y1i[:, None], x1i[None, :]]
+        wy = ly[:, None]
+        wx = lx[None, :]
+        val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+               + v10 * wy * (1 - wx) + v11 * wy * wx)   # [C, ph*r, pw*r]
+        val = val.reshape(c, ph, ratio, pw, ratio).mean(axis=(2, 4))
+        return val
+
+    out = jax.vmap(one_roi)(rois, batch_ids)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms — HOST op (CPU-only in the reference too)
+# ---------------------------------------------------------------------------
+
+def _nms_numpy(boxes, scores, iou_thresh, top_k):
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        a = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        b = ((boxes[order[1:], 2] - boxes[order[1:], 0])
+             * (boxes[order[1:], 3] - boxes[order[1:], 1]))
+        iou = inter / np.maximum(a + b - inter, 1e-10)
+        order = order[1:][iou <= iou_thresh]
+    return keep
+
+
+def _register_nms_host_op():
+    from ..framework.executor import register_host_op
+
+    @register_host_op("multiclass_nms")
+    def multiclass_nms(scope, op, exe):
+        import jax.numpy as jnp
+        boxes = np.asarray(scope.find_var(op.input("BBoxes")[0]))   # [N,M,4]
+        scores = np.asarray(scope.find_var(op.input("Scores")[0]))  # [N,C,M]
+        score_thresh = float(op.attr("score_threshold", 0.0))
+        nms_top_k = int(op.attr("nms_top_k", -1))
+        keep_top_k = int(op.attr("keep_top_k", -1))
+        iou = float(op.attr("nms_threshold", 0.3))
+        background = int(op.attr("background_label", 0))
+        outs = []
+        for n in range(boxes.shape[0]):
+            dets = []
+            for cls in range(scores.shape[1]):
+                if cls == background:
+                    continue
+                s = scores[n, cls]
+                mask = s > score_thresh
+                idx = np.nonzero(mask)[0]
+                if idx.size == 0:
+                    continue
+                keep = _nms_numpy(boxes[n, idx], s[idx], iou, nms_top_k)
+                for k in keep:
+                    i = idx[k]
+                    dets.append([float(cls), float(s[i]), *boxes[n, i]])
+            dets.sort(key=lambda d: -d[1])
+            if keep_top_k > 0:
+                dets = dets[:keep_top_k]
+            outs.extend(dets)
+        out = (np.asarray(outs, np.float32) if outs
+               else np.zeros((0, 6), np.float32))
+        scope.set_var(op.output("Out")[0], jnp.asarray(out))
+
+
+_register_nms_host_op()

@@ -1,0 +1,125 @@
+"""Recurrent ops — parity with the reference RNN surface
+(operators/cudnn_lstm_op.cc layers.lstm; operators/gru_op.cc;
+operators/lstm_op.cc dynamic_lstm).
+
+TPU-first design: the recurrence is ONE ``lax.scan`` (a single compiled XLA
+While with an MXU matmul body) instead of the reference's per-timestep kernel
+launches or a T-times unrolled graph.  Weights arrive as one packed blob per
+stack (the cudnn_lstm "W" convention) so multi-layer stacks stay a single
+parameter.  Sequence-length masking replaces LoD raggedness: padded steps
+carry the last valid state through (dynamic_lstm semantics on static shapes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _lstm_layer_sizes(in_dim: int, hidden: int):
+    # [Wx (in,4H), Wh (H,4H), b (4H)]
+    return in_dim * 4 * hidden, hidden * 4 * hidden, 4 * hidden
+
+
+def lstm_blob_size(in_dim: int, hidden: int, num_layers: int) -> int:
+    total = 0
+    d = in_dim
+    for _ in range(num_layers):
+        wx, wh, b = _lstm_layer_sizes(d, hidden)
+        total += wx + wh + b
+        d = hidden
+    return total
+
+
+def _scan_lstm_layer(x, h0, c0, wx, wh, b, seq_len=None):
+    """x: [B,T,D]; returns (out [B,T,H], hT, cT)."""
+    B, T, D = x.shape
+    H = h0.shape[-1]
+
+    def step(carry, inp):
+        h, c = carry
+        xt, t = inp
+        gates = xt @ wx + h @ wh + b           # [B, 4H]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if seq_len is not None:
+            live = (t < seq_len)[:, None]      # [B,1]
+            h_new = jnp.where(live, h_new, h)
+            c_new = jnp.where(live, c_new, c)
+        return (h_new, c_new), h_new
+
+    xs = jnp.swapaxes(x, 0, 1)                 # [T,B,D]
+    ts = jnp.arange(T)
+    (hT, cT), outs = jax.lax.scan(step, (h0, c0), (xs, ts))
+    return jnp.swapaxes(outs, 0, 1), hT, cT
+
+
+@register_op("cudnn_lstm", diff_inputs=("Input", "W", "InitH", "InitC"))
+def cudnn_lstm(ctx, op, ins):
+    """Multi-layer LSTM over a packed weight blob — layers.lstm
+    (fluid/layers/rnn.py lstm -> cudnn_lstm_op.cc)."""
+    x = ins["Input"][0]                         # [B,T,D]
+    w = ins["W"][0]                             # packed blob
+    h0 = ins["InitH"][0]                        # [L,B,H]
+    c0 = ins["InitC"][0]
+    seq_len = ins.get("SequenceLength", [None])[0]
+    num_layers = int(op.attr("num_layers", 1))
+    hidden = int(op.attr("hidden_size"))
+    dropout_prob = float(op.attr("dropout_prob", 0.0))
+    is_test = bool(op.attr("is_test", False))
+
+    out = x
+    hs, cs = [], []
+    off = 0
+    d = x.shape[-1]
+    for layer in range(num_layers):
+        nwx, nwh, nb = _lstm_layer_sizes(d, hidden)
+        wx = w[off:off + nwx].reshape(d, 4 * hidden); off += nwx
+        wh = w[off:off + nwh].reshape(hidden, 4 * hidden); off += nwh
+        b = w[off:off + nb]; off += nb
+        out, hT, cT = _scan_lstm_layer(out, h0[layer], c0[layer], wx, wh, b,
+                                       seq_len)
+        hs.append(hT)
+        cs.append(cT)
+        d = hidden
+        if dropout_prob and not is_test and layer < num_layers - 1:
+            key = ctx.rng_for(op)
+            keep = jax.random.bernoulli(key, 1 - dropout_prob, out.shape)
+            out = jnp.where(keep, out / (1 - dropout_prob), 0.0)
+    return {"Out": out, "LastH": jnp.stack(hs), "LastC": jnp.stack(cs)}
+
+
+@register_op("fused_gru", diff_inputs=("Input", "WeightX", "WeightH", "Bias",
+                                       "InitH"))
+def fused_gru(ctx, op, ins):
+    """Single-layer GRU (gru_op.cc semantics, batch-major static shapes).
+    Gate layout follows the reference: [update u | reset r | candidate c]."""
+    x = ins["Input"][0]                         # [B,T,D]
+    wx = ins["WeightX"][0]                      # [D,3H]
+    wh = ins["WeightH"][0]                      # [H,3H]
+    b = ins["Bias"][0] if "Bias" in ins else None
+    h0 = ins["InitH"][0]                        # [B,H]
+    seq_len = ins.get("SequenceLength", [None])[0]
+    H = wh.shape[0]
+
+    def step(h, inp):
+        xt, t = inp
+        gx = xt @ wx + (b if b is not None else 0.0)    # [B,3H]
+        gh = h @ wh
+        u = jax.nn.sigmoid(gx[:, :H] + gh[:, :H])
+        r = jax.nn.sigmoid(gx[:, H:2 * H] + gh[:, H:2 * H])
+        c = jnp.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
+        h_new = u * h + (1.0 - u) * c
+        if seq_len is not None:
+            live = (t < seq_len)[:, None]
+            h_new = jnp.where(live, h_new, h)
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ts = jnp.arange(x.shape[1])
+    hT, outs = jax.lax.scan(step, h0, (xs, ts))
+    return {"Out": jnp.swapaxes(outs, 0, 1), "LastH": hT}

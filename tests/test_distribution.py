@@ -1,0 +1,75 @@
+"""Distribution lib (fluid/distribution.py parity): sample moments,
+entropy/log_prob/kl against scipy-free closed forms."""
+import math
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import distribution as D
+
+
+def _run(fetches, feed=None):
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    return exe.run(fluid.default_main_program(), feed=feed or {},
+                   fetch_list=fetches)
+
+
+def test_normal():
+    with fluid.program_guard(fluid.Program()):
+        n = D.Normal(1.0, 2.0)
+        s = n.sample([4000])
+        e = n.entropy()
+        lp = n.log_prob(np.array([1.0], np.float32))
+        other = D.Normal(0.0, 1.0)
+        kl = n.kl_divergence(other)
+        sv, ev, lpv, klv = _run([s, e, lp, kl])
+    assert abs(sv.mean() - 1.0) < 0.15 and abs(sv.std() - 2.0) < 0.15
+    want_e = 0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0)
+    np.testing.assert_allclose(ev, want_e, rtol=1e-5)
+    np.testing.assert_allclose(lpv, -math.log(2.0) - 0.5 * math.log(2 * math.pi),
+                               rtol=1e-5)
+    # KL(N(1,2)||N(0,1)) = log(1/2) + (4+1)/2 - 1/2 = 2 - log 2
+    np.testing.assert_allclose(klv, 2.0 - math.log(2.0), rtol=1e-5)
+
+
+def test_uniform():
+    with fluid.program_guard(fluid.Program()):
+        u = D.Uniform(2.0, 6.0)
+        s = u.sample([4000])
+        e = u.entropy()
+        lp = u.log_prob(np.array([3.0], np.float32))
+        sv, ev, lpv = _run([s, e, lp])
+    assert 2.0 <= sv.min() and sv.max() <= 6.0
+    assert abs(sv.mean() - 4.0) < 0.2
+    np.testing.assert_allclose(ev, math.log(4.0), rtol=1e-5)
+    np.testing.assert_allclose(lpv, -math.log(4.0), rtol=1e-5)
+
+
+def test_categorical():
+    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    with fluid.program_guard(fluid.Program()):
+        c = D.Categorical(logits)
+        e = c.entropy()
+        lp = c.log_prob(np.array([2], np.int64))
+        c2 = D.Categorical(np.zeros(3, np.float32))
+        kl = c.kl_divergence(c2)
+        ev, lpv, klv = _run([e, lp, kl])
+    p = np.array([0.1, 0.2, 0.7])
+    np.testing.assert_allclose(ev, -(p * np.log(p)).sum(), rtol=1e-5)
+    np.testing.assert_allclose(lpv, math.log(0.7), rtol=1e-5)
+    np.testing.assert_allclose(klv, (p * np.log(p * 3)).sum(), rtol=1e-4)
+
+
+def test_mvn_diag():
+    with fluid.program_guard(fluid.Program()):
+        m = D.MultivariateNormalDiag(
+            np.zeros(2, np.float32), np.diag([4.0, 9.0]).astype(np.float32))
+        e = m.entropy()
+        other = D.MultivariateNormalDiag(
+            np.zeros(2, np.float32), np.eye(2, dtype=np.float32))
+        kl = m.kl_divergence(other)
+        ev, klv = _run([e, kl])
+    want_e = 0.5 * 2 * (1 + math.log(2 * math.pi)) + 0.5 * math.log(36.0)
+    np.testing.assert_allclose(ev, want_e, rtol=1e-5)
+    # KL = .5 (tr + quad - d - logdet ratio) = .5 (13 - 2 - log 36)
+    np.testing.assert_allclose(klv, 0.5 * (13 - 2 - math.log(36.0)), rtol=1e-5)

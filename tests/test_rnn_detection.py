@@ -1,0 +1,228 @@
+"""RNN (scan-based cudnn_lstm/fused_gru) and detection op tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(prog, feed, fetches, scope=None):
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = scope or fluid.Scope()
+    return exe.run(prog, feed=feed, fetch_list=fetches, scope=scope), scope
+
+
+# ---------------------------------------------------------------------------
+# LSTM / GRU
+# ---------------------------------------------------------------------------
+
+def _np_lstm(x, h0, c0, wx, wh, b):
+    B, T, D = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    outs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        g = x[:, t] @ wx + h @ wh + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        i, f, o = sig(i), sig(f), sig(o)
+        c = f * c + i * np.tanh(gg)
+        h = o * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs, 1), h, c
+
+
+def test_lstm_matches_numpy():
+    B, T, D, H = 2, 5, 3, 4
+    rng = np.random.RandomState(0)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [T, D], dtype="float32")
+        h0 = fluid.layers.data("h0", [1, -1, H], dtype="float32",
+                               append_batch_size=False)
+        c0 = fluid.layers.data("c0", [1, -1, H], dtype="float32",
+                               append_batch_size=False)
+        out, lh, lc = layers.lstm(x, h0, c0, hidden_size=H, num_layers=1)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    h0v = rng.randn(1, B, H).astype(np.float32)
+    c0v = rng.randn(1, B, H).astype(np.float32)
+    got, _ = _run(prog, {"x": xv, "h0": h0v, "c0": c0v},
+                  [out, lh, lc], scope)
+    # rebuild numpy reference from the packed blob
+    wname = [n for n in prog.global_block().vars
+             if n.endswith(".w_0") or "lstm" in n]
+    blob = None
+    for n, v in prog.global_block().vars.items():
+        if getattr(v, "persistable", False) and np.prod(v.shape) == (
+                D * 4 * H + H * 4 * H + 4 * H):
+            blob = np.asarray(scope.find_var(n))
+    assert blob is not None
+    wx = blob[:D * 4 * H].reshape(D, 4 * H)
+    wh = blob[D * 4 * H:D * 4 * H + H * 4 * H].reshape(H, 4 * H)
+    b = blob[-4 * H:]
+    want_out, want_h, want_c = _np_lstm(xv, h0v[0], c0v[0], wx, wh, b)
+    np.testing.assert_allclose(got[0], want_out, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[1][0], want_h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[2][0], want_c, rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_trains():
+    """LSTM last-state regression learns (gradient flows through scan)."""
+    B, T, D, H = 8, 6, 4, 8
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [T, D], dtype="float32")
+        h0 = fluid.layers.data("h0", [1, -1, H], dtype="float32",
+                               append_batch_size=False)
+        c0 = fluid.layers.data("c0", [1, -1, H], dtype="float32",
+                               append_batch_size=False)
+        y = fluid.layers.data("y", [1], dtype="float32")
+        out, lh, lc = layers.lstm(x, h0, c0, hidden_size=H)
+        pred = fluid.layers.fc(fluid.layers.squeeze(lh, axes=[0]), 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    yv = xv.sum(axis=(1, 2), keepdims=False).reshape(-1, 1).astype(np.float32) * 0.1
+    z = np.zeros((1, B, H), np.float32)
+    losses = [float(exe.run(prog, feed={"x": xv, "h0": z, "c0": z, "y": yv},
+                            fetch_list=[loss], scope=scope)[0])
+              for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_gru_masking():
+    """fused_gru with sequence lengths: states freeze past each row's len."""
+    B, T, D, H = 2, 4, 3, 5
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [T, D], dtype="float32")
+        h0 = fluid.layers.data("h0", [-1, H], dtype="float32",
+                               append_batch_size=False)
+        sl = fluid.layers.data("sl", [-1], dtype="int64",
+                               append_batch_size=False)
+        out, lh = layers.gru(x, H, init_h=h0, sequence_length=sl)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    h0v = np.zeros((B, H), np.float32)
+    got, _ = _run(prog, {"x": xv, "h0": h0v,
+                         "sl": np.array([2, 4], np.int64)}, [out, lh], scope)
+    outs, last = got
+    # row 0: steps 2,3 frozen at step-1 state
+    np.testing.assert_allclose(outs[0, 2], outs[0, 1], rtol=1e-6)
+    np.testing.assert_allclose(outs[0, 3], outs[0, 1], rtol=1e-6)
+    np.testing.assert_allclose(last[0], outs[0, 1], rtol=1e-6)
+    # row 1 evolves every step
+    assert not np.allclose(outs[1, 3], outs[1, 2])
+
+
+# ---------------------------------------------------------------------------
+# detection ops
+# ---------------------------------------------------------------------------
+
+def test_box_coder_roundtrip():
+    prog = fluid.Program()
+    rng = np.random.RandomState(0)
+    priors = np.abs(rng.rand(6, 4).astype(np.float32))
+    priors[:, 2:] = priors[:, :2] + 0.5
+    targets = np.abs(rng.rand(6, 4).astype(np.float32))
+    targets[:, 2:] = targets[:, :2] + 0.4
+    with fluid.program_guard(prog):
+        pb = fluid.layers.data("pb", [6, 4], dtype="float32",
+                               append_batch_size=False)
+        tb = fluid.layers.data("tb", [6, 4], dtype="float32",
+                               append_batch_size=False)
+        enc = layers.detection.box_coder(pb, None, tb, "encode_center_size")
+        dec = layers.detection.box_coder(pb, None, enc, "decode_center_size")
+    (encv, decv), _ = _run(prog, {"pb": priors, "tb": targets}, [enc, dec])
+    np.testing.assert_allclose(decv, targets, rtol=1e-4, atol=1e-5)
+
+
+def test_prior_box_shapes_and_range():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        feat = fluid.layers.data("feat", [8, 4, 4], dtype="float32")
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        boxes, var = layers.detection.prior_box(
+            feat, img, min_sizes=[4.0], aspect_ratios=[2.0], flip=True,
+            clip=True)
+    (bv, vv), _ = _run(prog, {"feat": np.zeros((1, 8, 4, 4), np.float32),
+                              "img": np.zeros((1, 3, 32, 32), np.float32)},
+                       [boxes, var])
+    assert bv.shape == (4, 4, 3, 4)   # ar1 + two flipped ratios
+    assert vv.shape == bv.shape
+    assert bv.min() >= 0.0 and bv.max() <= 1.0
+    assert (bv[..., 2] >= bv[..., 0]).all()
+
+
+def test_yolo_box_shapes():
+    an = [10, 13, 16, 30]   # 2 anchors
+    nc = 3
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [2 * (5 + nc), 4, 4], dtype="float32")
+        sz = fluid.layers.data("sz", [2], dtype="int32")
+        boxes, scores = layers.detection.yolo_box(
+            x, sz, an, nc, conf_thresh=0.01, downsample_ratio=8)
+    rng = np.random.RandomState(0)
+    (bv, sv), _ = _run(prog, {
+        "x": rng.randn(1, 16, 4, 4).astype(np.float32),
+        "sz": np.array([[32, 32]], np.int32)}, [boxes, scores])
+    assert bv.shape == (1, 32, 4)
+    assert sv.shape == (1, 32, nc)
+    assert (bv >= 0).all() and (bv <= 31).all()  # clipped to image
+
+
+def test_roi_align_identity():
+    """RoI covering exactly one constant-valued region pools that value."""
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, :4, :4] = 7.0
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        xin = fluid.layers.data("x", [1, 8, 8], dtype="float32")
+        rois = fluid.layers.data("rois", [-1, 4], dtype="float32",
+                                 append_batch_size=False)
+        out = layers.detection.roi_align(xin, rois, pooled_height=2,
+                                         pooled_width=2, spatial_scale=1.0,
+                                         sampling_ratio=2)
+    (ov,), _ = _run(prog, {"x": x, "rois": np.array([[0.5, 0.5, 2.5, 2.5]],
+                                                    np.float32)}, [out])
+    assert ov.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(ov, 7.0, rtol=1e-5)
+
+
+def test_multiclass_nms_host_op():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     np.float32)                       # [1, 3, 4]
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]                      # class 1 scores
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        b = fluid.layers.data("b", [-1, 3, 4], dtype="float32",
+                              append_batch_size=False)
+        s = fluid.layers.data("s", [-1, 2, 3], dtype="float32",
+                              append_batch_size=False)
+        out = layers.detection.multiclass_nms(
+            b, s, score_threshold=0.1, nms_top_k=10, keep_top_k=10,
+            nms_threshold=0.5, background_label=0)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    import jax.numpy as jnp
+    scope.set_var("b", jnp.asarray(boxes))
+    scope.set_var("s", jnp.asarray(scores))
+    vals = exe.run(prog, feed={}, fetch_list=[out], scope=scope)
+    got = vals[0]
+    # box1 suppressed by box0 (IoU ~0.68 > 0.5); far box kept
+    assert got.shape == (2, 6)
+    np.testing.assert_allclose(got[:, 0], 1.0)          # class label
+    np.testing.assert_allclose(sorted(got[:, 1], reverse=True),
+                               [0.9, 0.7], rtol=1e-6)
