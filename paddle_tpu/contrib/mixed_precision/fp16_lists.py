@@ -61,7 +61,7 @@ class AutoMixedPrecisionLists:
         self.unsupported_list = copy.copy(_unsupported)
         self.black_varnames = set(custom_black_varnames or [])
         for op in custom_white_list or []:
-            if op in custom_black_list or []:
+            if op in (custom_black_list or []):
                 raise ValueError(f"op {op} in both custom white and black lists")
             self.white_list.add(op)
             self.black_list.discard(op)

@@ -78,7 +78,6 @@ def rewrite_program(main_program: Program, amp_lists, dest_dtype: str = "bfloat1
 
         cache: Dict[str, str] = {}
         if kind == "white":
-            n_inserted = 0
             for slot, names in list(op.inputs.items()):
                 new_names = []
                 for n in names:
@@ -86,11 +85,10 @@ def rewrite_program(main_program: Program, amp_lists, dest_dtype: str = "bfloat1
                     if v is not None and _is_float(v) and str(v.dtype) == _FLOAT32:
                         new_names.append(_insert_cast(block, i, v, dest_dtype,
                                                       cache))
-                        n_inserted += 1
                     else:
                         new_names.append(n)
                 op.inputs[slot] = new_names
-            i += n_inserted  # op shifted by the inserted casts
+            i += len(cache)  # op shifted by the casts actually inserted
             for n in out_names:
                 if block.has_var(n):
                     v = block.var(n)
@@ -98,7 +96,6 @@ def rewrite_program(main_program: Program, amp_lists, dest_dtype: str = "bfloat1
                         v.dtype = dest_dtype
                         low_vars.add(n)
         elif kind == "black":
-            n_inserted = 0
             for slot, names in list(op.inputs.items()):
                 new_names = []
                 for n in names:
@@ -106,11 +103,10 @@ def rewrite_program(main_program: Program, amp_lists, dest_dtype: str = "bfloat1
                     if v is not None and str(v.dtype) == dest_dtype:
                         new_names.append(_insert_cast(block, i, v, _FLOAT32,
                                                       cache))
-                        n_inserted += 1
                     else:
                         new_names.append(n)
                 op.inputs[slot] = new_names
-            i += n_inserted
+            i += len(cache)
         else:  # gray: follow inputs — outputs go low only if any input is low
             if any(n in low_vars for n in in_names):
                 for n in out_names:

@@ -175,6 +175,7 @@ class ParameterServer:
             return {"status": "ok"}
         if cmd == "complete":  # trainer finished (HeartBeatMonitor COMPLETED)
             self._completed_trainers.add(msg["trainer_id"])
+            self._on_membership_change()
             return {"status": "ok"}
         if cmd == "save":
             return self._save(msg.get("dirname"))
@@ -215,6 +216,36 @@ class ParameterServer:
             return {"status": "ok"}
         return {"status": "error", "error": f"unknown cmd {cmd!r}"}
 
+    def _apply_round_locked(self, st: _ParamState):
+        """Apply the accumulated sync round (caller holds st.cond)."""
+        st.table.push((st.accum / st.push_count).astype(np.float32),
+                      st.accum_lr)
+        st.accum = None
+        st.push_count = 0
+        st.version += 1
+        st.cond.notify_all()
+
+    def _live_trainers(self) -> int:
+        return max(self.trainer_num - len(self._completed_trainers), 1)
+
+    def _on_membership_change(self):
+        """A trainer completed: waiters must recompute `need` — a round that
+        is now fully contributed by the remaining live trainers applies, and
+        barriers that are now satisfied release (HeartBeatMonitor eviction
+        semantics)."""
+        for st in self.params.values():
+            with st.cond:
+                if st.push_count >= self._live_trainers() and st.accum is not None:
+                    self._apply_round_locked(st)
+                else:
+                    st.cond.notify_all()  # let waiters re-evaluate
+        with self._barrier_lock:
+            for count_gen in self._barriers.values():
+                if count_gen[0] >= self._live_trainers() and count_gen[0] > 0:
+                    count_gen[0] = 0
+                    count_gen[2] += 1
+                count_gen[1].notify_all()
+
     def _push_dense(self, st: _ParamState, msg):
         grad = np.asarray(msg["value"], np.float32)
         lr = msg.get("lr")
@@ -230,18 +261,16 @@ class ParameterServer:
                 st.accum += grad
             st.accum_lr = lr if lr is not None else st.accum_lr
             st.push_count += 1
-            need = self.trainer_num - len(self._completed_trainers)
-            if st.push_count >= max(need, 1):
-                st.table.push((st.accum / st.push_count).astype(np.float32),
-                              st.accum_lr)
-                st.accum = None
-                st.push_count = 0
-                st.version += 1
-                st.cond.notify_all()
+            if st.push_count >= self._live_trainers():
+                self._apply_round_locked(st)
             else:
                 target = st.version + 1
                 while st.version < target and not self._stop.is_set():
                     st.cond.wait(timeout=0.5)
+                    # membership may have shrunk while we waited
+                    if (st.version < target and st.accum is not None
+                            and st.push_count >= self._live_trainers()):
+                        self._apply_round_locked(st)
 
     def _barrier(self, name: str, trainer_id: int):
         with self._barrier_lock:
@@ -251,8 +280,7 @@ class ParameterServer:
         count_gen = self._barriers[name]
         with count_gen[1]:
             count_gen[0] += 1
-            need = self.trainer_num - len(self._completed_trainers)
-            if count_gen[0] >= max(need, 1):
+            if count_gen[0] >= self._live_trainers():
                 count_gen[0] = 0
                 count_gen[2] += 1  # generation
                 count_gen[1].notify_all()
@@ -260,6 +288,11 @@ class ParameterServer:
                 gen = count_gen[2]
                 while count_gen[2] == gen and not self._stop.is_set():
                     count_gen[1].wait(timeout=0.5)
+                    if (count_gen[2] == gen
+                            and count_gen[0] >= self._live_trainers()):
+                        count_gen[0] = 0
+                        count_gen[2] += 1
+                        count_gen[1].notify_all()
 
     def _save(self, dirname):
         import os

@@ -34,8 +34,17 @@ class InputSpec:
         self.dtype = dtype
         self.name = name
 
-    def to_sds(self) -> jax.ShapeDtypeStruct:
-        shape = tuple(1 if d in (-1, None) else int(d) for d in self.shape)
+    def to_sds(self, sym_scope=None, sym_prefix: str = "b"):
+        """Dynamic dims (-1/None) become jax.export symbolic dimensions so
+        the saved artifact accepts any size there (batch polymorphism)."""
+        if any(d in (-1, None) for d in self.shape):
+            from jax import export as jexport
+            spec = ", ".join(
+                f"{sym_prefix}{i}" if d in (-1, None) else str(int(d))
+                for i, d in enumerate(self.shape))
+            dims = jexport.symbolic_shape(spec, scope=sym_scope)
+            return jax.ShapeDtypeStruct(dims, jnp.dtype(self.dtype))
+        shape = tuple(int(d) for d in self.shape)
         return jax.ShapeDtypeStruct(shape, jnp.dtype(self.dtype))
 
     def __repr__(self):
@@ -229,7 +238,13 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None):
     if input_spec is None:
         raise ValueError("input_spec is required to save (declares shapes)")
     specs = [s if isinstance(s, InputSpec) else InputSpec(*s) for s in input_spec]
-    sds = [s.to_sds() for s in specs]
+    # one shared symbolic scope: all dynamic dims must co-exist in one export
+    sym_scope = None
+    if any(any(d in (-1, None) for d in s.shape) for s in specs):
+        from jax import export as jexport
+        sym_scope = jexport.SymbolicScope()
+    sds = [s.to_sds(sym_scope, sym_prefix=f"b{i}_")
+           for i, s in enumerate(specs)]
     params_sds = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in param_vals]
 
     exp = jexport.export(jax.jit(pure))(params_sds, *sds)

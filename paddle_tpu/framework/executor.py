@@ -503,7 +503,13 @@ class Executor:
         ops = block.ops
         segs = self._segment_ops(ops)
 
-        # names consumed at/after an op index (for cross-segment promotion)
+        # host ops read inputs from scope — materialize any fed values they
+        # consume (device segments keep taking feeds through jit args)
+        host_inputs = {n for host, lo, hi in segs if host
+                       for op in ops[lo:hi] for n in op.input_arg_names}
+        for n in host_inputs & feed.keys():
+            scope.set_var(n, jnp.asarray(feed[n]))
+
         results: Dict[str, Any] = {}
         for si, (host, lo, hi) in enumerate(segs):
             if host:

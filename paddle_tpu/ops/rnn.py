@@ -87,7 +87,9 @@ def cudnn_lstm(ctx, op, ins):
         cs.append(cT)
         d = hidden
         if dropout_prob and not is_test and layer < num_layers - 1:
-            key = ctx.rng_for(op)
+            # fold in the layer index: rng_for(op) is constant across the
+            # python loop and identical masks at every depth would correlate
+            key = jax.random.fold_in(ctx.rng_for(op), layer)
             keep = jax.random.bernoulli(key, 1 - dropout_prob, out.shape)
             out = jnp.where(keep, out / (1 - dropout_prob), 0.0)
     return {"Out": out, "LastH": jnp.stack(hs), "LastC": jnp.stack(cs)}

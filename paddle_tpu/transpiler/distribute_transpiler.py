@@ -98,20 +98,26 @@ class DistributeTranspiler:
 
         params_grads = self._collect_param_grads()
         self.param_grad_map = params_grads
-        self.var_blocks = slice_vars(
-            [p for p, _ in params_grads], len(self.pserver_endpoints),
-            self.config.min_block_size,
-        )
-        # assign blocks to endpoints round-robin (RoundRobin split_method parity)
+        # Endpoint assignment: each param goes WHOLE to exactly one pserver,
+        # greedily balanced by element count.  (The reference additionally
+        # slices big params into VarBlocks across pservers —
+        # distribute_transpiler.py:80 slice_variable — see slice_vars above;
+        # whole-param placement keeps every table single-owner so push/pull/
+        # checkpoint have one authoritative copy.)
+        sizes = sorted(
+            ((int(np.prod(p.shape)) if p.shape else 1, p.name)
+             for p, _ in params_grads), reverse=True)
+        load = {ep: 0 for ep in self.pserver_endpoints}
+        self.param_to_ep: Dict[str, List[str]] = {}
         self.ep_blocks: Dict[str, List[VarBlock]] = {
             ep: [] for ep in self.pserver_endpoints}
-        for i, blk in enumerate(self.var_blocks):
-            ep = self.pserver_endpoints[i % len(self.pserver_endpoints)]
-            self.ep_blocks[ep].append(blk)
-        self.param_to_ep: Dict[str, List[str]] = {}
-        for ep, blks in self.ep_blocks.items():
-            for b in blks:
-                self.param_to_ep.setdefault(b.varname, []).append(ep)
+        for size, name in sizes:
+            ep = min(self.pserver_endpoints, key=lambda e: load[e])
+            load[ep] += size
+            self.param_to_ep[name] = [ep]
+            self.ep_blocks[ep].append(VarBlock(name, 0, 0, size))
+        self.var_blocks = [b for blks in self.ep_blocks.values()
+                           for b in blks]
         self._build_trainer_program()
         self._transpiled = True
 

@@ -33,6 +33,28 @@ def test_rewrite_inserts_casts():
                 assert str(block.var(n).dtype) == "bfloat16", (op, n)
 
 
+def test_rewrite_duplicate_input_var():
+    """A white op consuming the same fp32 var twice must not skip rewriting
+    the ops that follow (cast-cache vs insert-count regression)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        a = fluid.layers.data("a", [4, 4], dtype="float32")
+        sq = fluid.layers.matmul(a, a)          # duplicate input
+        e = fluid.layers.exp(sq)                # black op right after
+    mp.rewrite_program(prog, mp.AutoMixedPrecisionLists(), "bfloat16")
+    block = prog.global_block()
+    exp_ops = [op for op in block.ops if op.type == "exp"]
+    assert exp_ops, "exp op disappeared"
+    for n in exp_ops[0].input_arg_names:
+        assert str(block.var(n).dtype) == "float32", \
+            "black op after duplicate-input white op was skipped by rewrite"
+
+
+def test_custom_lists_without_black():
+    lists = mp.AutoMixedPrecisionLists(custom_white_list=["gelu"])
+    assert "gelu" in lists.white_list
+
+
 def test_bf16_training_converges():
     prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(prog, startup):

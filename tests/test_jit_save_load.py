@@ -66,6 +66,20 @@ def test_traced_layer_and_roundtrip(tmp_path):
         np.testing.assert_allclose(loaded(x).numpy(), eager_out, rtol=1e-5)
 
 
+def test_jit_save_dynamic_batch(tmp_path):
+    """InputSpec with None batch exports a batch-polymorphic artifact."""
+    with dygraph.guard():
+        model = MLP()
+        path = str(tmp_path / "mlp_dyn")
+        jit.save(model, path, input_spec=[jit.InputSpec([None, 8], "float32")])
+        loaded = jit.load(path)
+        rng = np.random.RandomState(0)
+        for b in (1, 3, 7):
+            x = dygraph.to_variable(rng.randn(b, 8).astype(np.float32))
+            want = model(x).numpy()
+            np.testing.assert_allclose(loaded(x).numpy(), want, rtol=1e-5)
+
+
 def test_jit_save_load_layer(tmp_path):
     with dygraph.guard():
         model = MLP()
