@@ -3,3 +3,4 @@ from .distribute_transpiler import (  # noqa: F401
     DistributeTranspiler,
     DistributeTranspilerConfig,
 )
+from .geo_sgd_transpiler import GeoSgdTranspiler  # noqa: F401

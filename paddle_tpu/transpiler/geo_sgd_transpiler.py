@@ -1,0 +1,110 @@
+"""GEO-SGD transpiler — parity with fluid/transpiler/geo_sgd_transpiler.py +
+the GeoCommunicator (operators/distributed/communicator.h Geo mode).
+
+Semantics: trainers run the FULL local program (forward+backward+optimizer)
+every step; every ``push_nums`` steps each trainer pushes the *delta* of its
+params since the last sync to the pserver (server adds deltas raw —
+ps_server push_delta) and pulls the merged global params back.  This trades
+staleness for communication: k local steps per round-trip.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework.program import Program, default_main_program
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig,
+                                    DistributedMode)
+
+__all__ = ["GeoSgdTranspiler"]
+
+
+def _register_geo_host_op():
+    from ..framework.executor import register_host_op
+
+    @register_host_op("geo_sgd_communicate")
+    def geo_sgd_communicate(scope, op, exe):
+        """Stateful host op: counts steps, and on every k-th pushes param
+        deltas + pulls merged params (GeoCommunicator send/recv threads)."""
+        import jax.numpy as jnp
+        from ..distributed.ps_client import PSClient
+
+        state = getattr(op, "_geo_state", None)
+        if state is None:
+            state = {"step": 0, "snapshots": {}}
+            op._geo_state = state
+        params: List[str] = op.attr("params")
+        epmap: Dict[str, str] = dict(op.attr("param_ep"))
+        k = int(op.attr("push_nums", 100))
+        tid = int(op.attr("trainer_id", 0))
+        client = PSClient.instance(tid)
+
+        if state["step"] == 0:
+            # round 0: server takes the first trainer's init; everyone pulls
+            for p in params:
+                local = np.asarray(scope.find_var(p))
+                client.ensure_init(epmap[p], p, local)
+                merged = client.pull(epmap[p], p).reshape(local.shape)
+                scope.set_var(p, jnp.asarray(merged))
+                state["snapshots"][p] = merged.copy()
+        state["step"] += 1
+        if state["step"] % k != 0:
+            return
+        for p in params:
+            local = np.asarray(scope.find_var(p), dtype=np.float32)
+            delta = local - state["snapshots"][p]
+            client.push_delta(epmap[p], p, delta)
+            merged = client.pull(epmap[p], p).reshape(local.shape)
+            scope.set_var(p, jnp.asarray(merged))
+            state["snapshots"][p] = merged.copy()
+
+
+_register_geo_host_op()
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        config = config or DistributeTranspilerConfig()
+        config.mode = DistributedMode.GEO
+        config.sync_mode = False
+        super().__init__(config)
+
+    def _build_trainer_program(self):
+        """Trainer keeps its local optimizer ops; one geo_sgd_communicate
+        host op appended per step (it self-gates on push_nums)."""
+        prog = self.origin_program.clone()
+        block = prog.global_block()
+        params = [p.name for p, _ in self.param_grad_map]
+        param_ep = {p: self.param_to_ep.get(p, self.pserver_endpoints[:1])[0]
+                    for p in params}
+        block.append_op(
+            type="geo_sgd_communicate",
+            inputs={}, outputs={},
+            attrs={"params": params,
+                   "param_ep": list(param_ep.items()),
+                   "push_nums": self.config.geo_sgd_need_push_nums,
+                   "trainer_id": self.trainer_id})
+        self.trainer_program = prog
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        """GEO pserver: plain SGD-free tables (deltas are added raw)."""
+        prog = Program()
+        block = prog.global_block()
+        origin_block = self.origin_program.global_block()
+        owned = {b.varname for b in self.ep_blocks.get(endpoint, [])}
+        tables = []
+        for name in sorted(owned):
+            pvar = origin_block.var(name)
+            tables.append({"name": name,
+                           "shape": [int(d) for d in pvar.shape],
+                           "optimizer": "sgd", "lr": 1.0,
+                           "is_sparse": False})
+        block.append_op(
+            type="listen_and_serv",
+            attrs={"endpoint": endpoint, "optimize_ops": [],
+                   "owned_params": sorted(owned), "tables": tables,
+                   "trainer_num": self.trainer_num, "sync_mode": False,
+                   "mode": DistributedMode.GEO})
+        return prog

@@ -1,0 +1,78 @@
+"""GEO-SGD: local training with periodic delta push/pull (geo_sgd_transpiler
++ GeoCommunicator capability)."""
+import threading
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed import ParameterServer, PSClient
+from paddle_tpu.transpiler import DistributeTranspilerConfig, GeoSgdTranspiler
+
+
+def _build(seed=0):
+    from paddle_tpu.framework import unique_name
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = seed
+    with unique_name.guard():
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    return prog, startup, loss
+
+
+def test_geo_sgd_two_trainers_converge():
+    PSClient.reset_all()
+    rng = np.random.RandomState(0)
+    w_true = np.array([1.0, 2.0, -1.0, 0.5], np.float32)
+    xs = rng.randn(64, 4).astype(np.float32)
+    ys = (xs @ w_true).reshape(-1, 1).astype(np.float32)
+
+    server = ParameterServer("127.0.0.1:0", trainer_num=2, sync_mode=False,
+                             mode=3)
+    server.register_dense("fc_0.w_0", (4, 1), "sgd", lr=1.0)
+    server.register_dense("fc_0.b_0", (1,), "sgd", lr=1.0)
+    server.start()
+    results = {}
+
+    # program construction is not thread-safe (global unique_name state, as
+    # in the reference) — build sequentially, train concurrently
+    built = []
+    for tid in range(2):
+        cfg = DistributeTranspilerConfig()
+        cfg.geo_sgd_need_push_nums = 5
+        prog, startup, loss = _build()
+        t = GeoSgdTranspiler(cfg)
+        t.transpile(trainer_id=tid, program=prog, pservers=server.endpoint,
+                    trainers=2, sync_mode=False)
+        built.append((t.get_trainer_program(), startup, loss))
+
+    def trainer(tid):
+        tp, startup, loss = built[tid]
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        x, y = xs[tid::2], ys[tid::2]
+        losses = [float(exe.run(tp, feed={"x": x, "y": y},
+                                fetch_list=[loss], scope=scope)[0])
+                  for _ in range(40)]
+        w = np.asarray(scope.find_var("fc_0.w_0")).ravel()
+        results[tid] = (losses, w)
+
+    threads = [threading.Thread(target=trainer, args=(i,)) for i in range(2)]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+            assert not th.is_alive()
+    finally:
+        server.stop()
+        PSClient.reset_all()
+
+    assert len(results) == 2, "a trainer thread crashed"
+    for tid, (losses, w) in results.items():
+        assert losses[-1] < losses[0] * 0.1, (tid, losses[0], losses[-1])
+        np.testing.assert_allclose(w, w_true, atol=0.3)
