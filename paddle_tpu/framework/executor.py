@@ -417,12 +417,14 @@ class Executor:
         )
         exe = self._cache.get(key)
         if exe is None:
+            from ..profiler import RecordEvent
             block = program.global_block()
             param_names, written = _analyze_persistables(program)
-            exe = _CompiledBlock(
-                program, feed_sig, fetch_names, param_names, written,
-                mesh_plan=mesh_plan, scope=scope,
-            )
+            with RecordEvent(f"compile/{len(block.ops)}ops"):
+                exe = _CompiledBlock(
+                    program, feed_sig, fetch_names, param_names, written,
+                    mesh_plan=mesh_plan, scope=scope,
+                )
             self._cache[key] = exe
             logger.info(
                 "compiled program: %d ops, %d params, %d feeds, mesh=%s",
@@ -433,7 +435,9 @@ class Executor:
         seed = program.random_seed or 0
         rng_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
-        fetches = exe(scope, feed_arrays, rng_key)
+        from ..profiler import RecordEvent
+        with RecordEvent("executor_run"):
+            fetches = exe(scope, feed_arrays, rng_key)
 
         if get_flag("FLAGS_check_nan_inf"):
             from ..utils.nan_inf import check_fetches
@@ -511,10 +515,12 @@ class Executor:
             scope.set_var(n, jnp.asarray(feed[n]))
 
         results: Dict[str, Any] = {}
+        from ..profiler import RecordEvent
         for si, (host, lo, hi) in enumerate(segs):
             if host:
                 for op in ops[lo:hi]:
-                    _HOST_OPS[op.type](scope, op, self)
+                    with RecordEvent(f"host_op/{op.type}"):
+                        _HOST_OPS[op.type](scope, op, self)
                 continue
             seg_ops = ops[lo:hi]
             produced = {n for op in seg_ops for n in op.output_arg_names}

@@ -67,6 +67,28 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     # chrome-trace export of host events (tools/timeline.py parity)
     with open(profile_path + ".chrome_trace.json", "w") as f:
         json.dump({"traceEvents": _events}, f)
+    if sorted_key:
+        _print_summary(sorted_key)
+
+
+def _print_summary(sorted_key="total"):
+    """Event table like the reference's profiler summary (profiler.cc
+    PrintProfiler): name, calls, total/avg/min/max ms."""
+    agg = {}
+    for ev in _events:
+        a = agg.setdefault(ev["name"], [0, 0.0, float("inf"), 0.0])
+        a[0] += 1
+        a[1] += ev["dur"]
+        a[2] = min(a[2], ev["dur"])
+        a[3] = max(a[3], ev["dur"])
+    keyfn = {"calls": lambda kv: -kv[1][0], "max": lambda kv: -kv[1][3],
+             "min": lambda kv: kv[1][2], "ave": lambda kv: -(kv[1][1] / kv[1][0]),
+             }.get(sorted_key, lambda kv: -kv[1][1])
+    print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+          f"{'Min(ms)':>10}{'Max(ms)':>10}")
+    for name, (calls, total, mn, mx) in sorted(agg.items(), key=keyfn):
+        print(f"{name:<40}{calls:>8}{total / 1e3:>12.3f}"
+              f"{total / calls / 1e3:>10.3f}{mn / 1e3:>10.3f}{mx / 1e3:>10.3f}")
 
 
 def reset_profiler():

@@ -1,0 +1,79 @@
+"""Per-op micro-benchmark harness — parity with
+operators/benchmark/op_tester.cc (+ op_tester.proto configs): build a one-op
+program, run it through the Executor with warmup, report wall latency.
+
+Under whole-program XLA the "op" is one fused computation; the number is the
+dispatch+execute wall time on the current backend (block_until_ready'd).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["bench_op"]
+
+
+def bench_op(op_type: str, inputs: Dict[str, Any],
+             attrs: Optional[Dict[str, Any]] = None,
+             outputs: Optional[Dict[str, list]] = None,
+             repeat: int = 50, warmup: int = 5) -> Dict[str, Any]:
+    """Run one op `repeat` times; returns latency stats in microseconds.
+
+    inputs: slot -> numpy array (single-var slots) or list of arrays.
+    outputs: slot -> [names]; defaults to {"Out": ["out0"]}.
+    """
+    import jax
+    import paddle_tpu as fluid
+
+    attrs = dict(attrs or {})
+    outputs = outputs or {"Out": ["out0"]}
+
+    prog = fluid.Program()
+    block = prog.global_block()
+    feed = {}
+    in_map: Dict[str, list] = {}
+    for slot, arrs in inputs.items():
+        arrs = arrs if isinstance(arrs, (list, tuple)) else [arrs]
+        names = []
+        for i, a in enumerate(arrs):
+            a = np.asarray(a)
+            name = f"{slot.lower()}_{i}"
+            block.create_var(name=name, shape=list(a.shape),
+                             dtype=str(a.dtype), is_data=True)
+            feed[name] = a
+            names.append(name)
+        in_map[slot] = names
+    out_names = []
+    for slot, names in outputs.items():
+        for n in names:
+            block.create_var(name=n, shape=[-1], dtype="float32")
+            out_names.append(n)
+    block.append_op(type=op_type, inputs=in_map, outputs=dict(outputs),
+                    attrs=attrs)
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    fetch = [out_names[0]] if out_names else []
+    for _ in range(warmup):
+        vals = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope,
+                       return_numpy=False)
+    samples = []
+    for _ in range(repeat):
+        t0 = time.perf_counter_ns()
+        vals = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope,
+                       return_numpy=False)
+        for v in vals:
+            jax.block_until_ready(v)
+        samples.append((time.perf_counter_ns() - t0) / 1e3)
+    samples.sort()
+    return {
+        "op": op_type,
+        "repeat": repeat,
+        "mean_us": float(np.mean(samples)),
+        "p50_us": float(samples[len(samples) // 2]),
+        "p99_us": float(samples[min(len(samples) - 1,
+                                    int(len(samples) * 0.99))]),
+        "min_us": float(samples[0]),
+    }

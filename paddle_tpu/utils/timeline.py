@@ -1,0 +1,42 @@
+"""Timeline tool — parity with tools/timeline.py (profiler records →
+chrome://tracing JSON, with multi-trainer merge).
+
+The reference converts profiler.proto dumps from N trainers into one
+chrome-trace with a pid per trainer; here profiles are the chrome-trace JSON
+files written by paddle_tpu.profiler.stop_profiler, merged the same way.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["Timeline"]
+
+
+class Timeline:
+    def __init__(self, profile_paths: Sequence[Tuple[str, str]]):
+        """profile_paths: list of (trainer_name, path-to-chrome-trace.json)."""
+        self.profile_paths = list(profile_paths)
+
+    def _load(self):
+        merged: List[dict] = []
+        metadata: List[dict] = []
+        for pid, (name, path) in enumerate(self.profile_paths):
+            with open(path) as f:
+                data = json.load(f)
+            metadata.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": name},
+            })
+            for ev in data.get("traceEvents", []):
+                ev = dict(ev)
+                ev["pid"] = pid
+                merged.append(ev)
+        return metadata + merged
+
+    def generate_chrome_trace(self, output_path: str):
+        events = self._load()
+        with open(output_path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return output_path

@@ -1,0 +1,60 @@
+"""Profiler (host events + chrome trace), Timeline merge tool, op bench."""
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+from paddle_tpu.utils.op_bench import bench_op
+from paddle_tpu.utils.timeline import Timeline
+
+
+def test_profiler_records_executor_events(tmp_path):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    path = str(tmp_path / "prof")
+    with profiler.profiler(profile_path=path):
+        for _ in range(3):
+            exe.run(prog, feed={"x": np.zeros((2, 4), np.float32)},
+                    fetch_list=[y], scope=scope)
+    trace = json.load(open(path + ".chrome_trace.json"))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert any(n == "executor_run" for n in names), names
+    assert sum(n == "executor_run" for n in names) == 3
+
+
+def test_timeline_merges_profiles(tmp_path):
+    paths = []
+    for t in range(2):
+        p = tmp_path / f"t{t}.json"
+        p.write_text(json.dumps({"traceEvents": [
+            {"name": "step", "ph": "X", "ts": 1, "dur": 2, "pid": 99,
+             "tid": 0}]}))
+        paths.append((f"trainer{t}", str(p)))
+    out = str(tmp_path / "merged.json")
+    Timeline(paths).generate_chrome_trace(out)
+    merged = json.load(open(out))
+    evs = merged["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == {"trainer0", "trainer1"}
+    pids = {e["pid"] for e in evs if e["ph"] == "X"}
+    assert pids == {0, 1}
+
+
+def test_bench_op():
+    res = bench_op("relu", {"X": np.random.randn(128, 128).astype(np.float32)},
+                   repeat=10, warmup=2)
+    assert res["op"] == "relu"
+    assert 0 < res["min_us"] <= res["mean_us"]
+    assert res["p50_us"] <= res["p99_us"]
+
+
+def test_bench_op_matmul():
+    a = np.random.randn(64, 64).astype(np.float32)
+    res = bench_op("matmul", {"X": a, "Y": a}, repeat=5, warmup=1)
+    assert res["mean_us"] > 0
