@@ -9,6 +9,7 @@ from .rnn import lstm, gru  # noqa: F401
 from . import detection  # noqa: F401
 from . import collective  # noqa: F401
 from . import control_flow  # noqa: F401
+from .control_flow import *  # noqa: F401,F403
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,
     exponential_decay,

@@ -108,16 +108,37 @@ def cond(pred: Variable, true_fn: Callable = None, false_fn: Callable = None,
     if len(t_outs) != len(f_outs):
         raise ValueError("true_fn and false_fn must return the same structure")
 
+    # captured external inputs of both branches become real op inputs so the
+    # backward dependency walk sees them and the generic vjp differentiates
+    # through lax.cond (reference conditional_block grad analog)
+    def _external_reads(idx):
+        blk = prog.block(idx)
+        produced = set()
+        reads = []
+        for op_ in blk.ops:
+            for n in op_.input_arg_names:
+                if n not in produced and n not in reads and n not in blk.vars:
+                    reads.append(n)
+            produced.update(op_.output_arg_names)
+        return reads
+
+    captured = []
+    for idx in (true_idx, false_idx):
+        for n in _external_reads(idx):
+            if n not in captured and n != pred.name:
+                captured.append(n)
+
     outs = [helper.create_variable_for_type_inference(v.dtype) for v in t_outs]
     helper.append_op(
         type="cond",
-        inputs={"Cond": [pred]},
+        inputs={"Cond": [pred], "Input": captured},
         outputs={"Out": outs},
         attrs={
             "true_block": true_idx,
             "false_block": false_idx,
             "true_outs": [v.name for v in t_outs],
             "false_outs": [v.name for v in f_outs],
+            "input_names": list(captured),
         },
     )
     if not outs:
@@ -135,13 +156,31 @@ class Switch:
 
     def __init__(self, name=None):
         self.helper = LayerHelper("switch", name=name)
-        self._cases = []
+        self._case_conds: List[Variable] = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
 
     def case(self, condition):
+        self._case_conds.append(condition)
         return _CaseGuard(self, condition)
 
     def default(self):
         return _CaseGuard(self, None)
+
+    def _none_matched(self) -> Variable:
+        """not any(previous case conditions) — real default semantics."""
+        from . import tensor as tl
+
+        if not self._case_conds:
+            return tl.fill_constant([1], "bool", 1.0)
+        acc = self._case_conds[0]
+        for c in self._case_conds[1:]:
+            acc = tl.logical_or(acc, c)
+        return tl.logical_not(acc)
 
 
 class _CaseGuard:
@@ -169,16 +208,11 @@ class _CaseGuard:
                 attrs={"sub_block": sub_idx, "is_scalar_condition": True},
             )
         else:
-            # default: run when no prior case matched — approximate by
-            # not-any(previous conds); round-1 simplification: always-true
-            # guarded block appended last (paddle semantics require
-            # mutually-exclusive case conditions anyway).
-            from . import tensor as tl
-
-            always = tl.fill_constant([1], "bool", 1.0)
+            # default runs only when no prior case matched
+            none_matched = self.switch._none_matched()
             parent.append_op(
                 type="conditional_block",
-                inputs={"Cond": [always]},
+                inputs={"Cond": [none_matched]},
                 outputs={},
                 attrs={"sub_block": sub_idx, "is_scalar_condition": True},
             )

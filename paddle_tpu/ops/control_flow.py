@@ -11,6 +11,7 @@ from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..framework.registry import LowerCtx, register_op, run_lowering
@@ -99,27 +100,32 @@ def conditional_block(ctx, op, ins):
     return {}
 
 
-@register_op("cond", grad=None)
+@register_op("cond", diff_inputs=("Input",))
 def cond_op(ctx, op, ins):
     """Two-branch functional cond (this framework's native form; built by
     layers.cond). Attrs: true_block, false_block; outputs Out = the aligned
-    return vars of the two branches."""
-    pred = jnp.reshape(ins["Cond"][0], ()).astype(jnp.bool_)
+    return vars of the two branches.  Captured external inputs arrive in the
+    "Input" slot so jax.vjp differentiates through lax.cond (the taken
+    branch's gradient, zeros elsewhere — conditional_block grad parity)."""
+    pred = jnp.reshape(jnp.asarray(ins["Cond"][0]), ()).astype(jnp.bool_)
     tb = ctx.program.block(op.attr("true_block"))
     fb = ctx.program.block(op.attr("false_block"))
     true_outs = op.attr("true_outs")  # var names produced by each branch
     false_outs = op.attr("false_outs")
+    input_names = op.attr("input_names", [])
+    captured = dict(zip(input_names, ins.get("Input", [])))
 
     def make_branch(block, out_names):
-        def fn(_):
+        def fn(cap):
             env = dict(ctx.env)
+            env.update(cap)
             _run_sub_block(ctx, block, env)
             return tuple(env[n] for n in out_names)
 
         return fn
 
-    outs = lax.cond(pred, make_branch(tb, true_outs), make_branch(fb, false_outs),
-                    None)
+    outs = lax.cond(pred, make_branch(tb, true_outs),
+                    make_branch(fb, false_outs), captured)
     return {"Out": list(outs)}
 
 
@@ -143,10 +149,22 @@ def select_output(ctx, op, ins):
 # ---------------------------------------------------------------------------
 
 
+def _static_index(v):
+    """Concrete python int from an index value, or None if traced."""
+    try:
+        return int(np.asarray(v).reshape(()))
+    except Exception:
+        return None
+
+
 @register_op("write_to_array", grad=None)
 def write_to_array(ctx, op, ins):
     x = ins["X"][0]
-    i = int(jnp.reshape(jnp.asarray(ins["I"][0]), ()))  # static index required
+    i = _static_index(ins["I"][0])
+    if i is None:
+        raise NotImplementedError(
+            "write_to_array requires a static index (use fill_constant / "
+            "python ints; dynamic writes belong inside lax.scan carries)")
     name = op.outputs["Out"][0]
     arr = list(ctx.env.get(name, []))
     while len(arr) <= i:
@@ -158,8 +176,14 @@ def write_to_array(ctx, op, ins):
 @register_op("read_from_array", grad=None)
 def read_from_array(ctx, op, ins):
     arr = ins["X"][0]
-    i = int(jnp.reshape(jnp.asarray(ins["I"][0]), ()))
-    return {"Out": arr[i]}
+    i = _static_index(ins["I"][0])
+    if i is not None:
+        return {"Out": arr[i]}
+    # dynamic index: stack homogeneous slots and gather (lax-friendly)
+    stacked = jnp.stack(arr)
+    idx = jnp.reshape(jnp.asarray(ins["I"][0]), ()).astype(jnp.int32)
+    return {"Out": jax.lax.dynamic_index_in_dim(stacked, idx, 0,
+                                                keepdims=False)}
 
 
 @register_op("array_length", grad=None)

@@ -27,6 +27,13 @@ def fill_constant(ctx, op, ins):
     value = op.attr("value", 0.0)
     if "ShapeTensor" in ins and ins["ShapeTensor"]:
         shape = [int(x) for x in np.asarray(ins["ShapeTensor"][0])]
+    # a NUMPY constant, not jnp: jit staging would turn a literal into a
+    # tracer, and downstream consumers that need static values (tensor-array
+    # indices, shape operands) could no longer concretize it.  jnp consumers
+    # fold np arrays transparently.
+    np_dtype = np.dtype(dtype) if dtype != jnp.bfloat16 else None
+    if np_dtype is not None:
+        return {"Out": np.full(shape, value, dtype=np_dtype)}
     return {"Out": jnp.full(shape, value, dtype=dtype)}
 
 

@@ -1,0 +1,125 @@
+"""Control flow: while_loop / While block / cond / Switch / tensor arrays
+through the whole-program XLA executor (layers/control_flow.py over
+lax.while_loop/cond lowerings — reference operators/controlflow/)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(prog, feed, fetches):
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    return exe.run(prog, feed=feed, fetch_list=fetches, scope=scope)
+
+
+def test_while_loop_accumulates():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        i = layers.fill_constant([1], "int64", 0)
+        s = layers.fill_constant([1], "float32", 0.0)
+
+        def cond_fn(i, s):
+            return layers.less_than(i, layers.fill_constant([1], "int64", 10))
+
+        def body(i, s):
+            return [layers.increment(i, value=1),
+                    layers.elementwise_add(s, layers.cast(i, "float32"))]
+
+        i_out, s_out = layers.while_loop(cond_fn, body, [i, s])
+    (iv, sv) = _run(prog, {}, [i_out, s_out])
+    assert int(iv[0]) == 10
+    # s accumulates i AFTER increment: 1+2+...+10
+    assert float(sv[0]) == 55.0
+
+
+def test_cond_branches():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [1], dtype="float32")
+        pred = layers.less_than(
+            layers.reduce_sum(x), layers.fill_constant([1], "float32", 0.0))
+        out = layers.cond(pred,
+                          lambda: layers.fill_constant([1], "float32", -1.0),
+                          lambda: layers.fill_constant([1], "float32", 1.0))
+    neg = _run(prog, {"x": np.array([[-5.0]], np.float32)}, [out])[0]
+    pos = _run(prog, {"x": np.array([[5.0]], np.float32)}, [out])[0]
+    assert float(neg[0]) == -1.0 and float(pos[0]) == 1.0
+
+
+def test_cond_gradient():
+    """Gradient flows through the taken branch (conditional_block grad)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [2], dtype="float32")
+        w = fluid.layers.fc(x, 2)
+        pred = layers.less_than(layers.reduce_sum(w),
+                                layers.fill_constant([1], "float32", 1e9))
+        out = layers.cond(pred,
+                          lambda: layers.scale(w, scale=3.0),
+                          lambda: layers.scale(w, scale=5.0))
+        loss = layers.reduce_mean(out)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    l0 = exe.run(prog, feed={"x": np.ones((4, 2), np.float32)},
+                 fetch_list=[loss], scope=scope)[0]
+    l1 = exe.run(prog, feed={"x": np.ones((4, 2), np.float32)},
+                 fetch_list=[loss], scope=scope)[0]
+    assert not np.allclose(l0, l1), "no parameter update through cond"
+
+
+def test_while_block_style():
+    """fluid 1.x While-block builder style (layers.While guard)."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        i = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", 5)
+        s = layers.fill_constant([1], "float32", 1.0)
+        cond_var = layers.less_than(i, limit)
+        w = layers.While(cond_var)
+        with w.block():
+            layers.assign(layers.scale(s, scale=2.0), output=s)
+            layers.assign(layers.increment(i, value=1, in_place=False),
+                          output=i)
+            layers.assign(layers.less_than(i, limit), output=cond_var)
+    sv = _run(prog, {}, [s])[0]
+    assert float(sv[0]) == 32.0  # 2^5
+
+
+def test_switch_lr_schedule():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        step = fluid.layers.data("step", [1], dtype="int64",
+                                 append_batch_size=False)
+        lr = layers.fill_constant([1], "float32", 0.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(
+                    step, layers.fill_constant([1], "int64", 100))):
+                layers.assign(layers.fill_constant([1], "float32", 0.1),
+                              output=lr)
+            with switch.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.01),
+                              output=lr)
+    early = _run(prog, {"step": np.array([5], np.int64)}, [lr])[0]
+    late = _run(prog, {"step": np.array([500], np.int64)}, [lr])[0]
+    np.testing.assert_allclose(early, 0.1)
+    np.testing.assert_allclose(late, 0.01)
+
+
+def test_tensor_array_write_read():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        arr = layers.create_array("float32")
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        layers.array_write(x, i0, array=arr)
+        layers.array_write(layers.scale(x, scale=2.0), i1, array=arr)
+        back = layers.array_read(arr, i1)
+        n = layers.array_length(arr)
+    xv = np.ones((2, 3), np.float32)
+    bv, nv = _run(prog, {"x": xv}, [back, n])
+    np.testing.assert_allclose(bv, 2.0)
+    assert int(nv[0]) == 2
