@@ -1,0 +1,129 @@
+"""Book-style end-to-end examples — parity with the reference's
+python/paddle/fluid/tests/book/ suite (word2vec, recommender, sentiment
+LSTM), trained on small synthetic data to convergence thresholds, with a
+save/load-inference round trip like the originals."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _exe_scope():
+    return fluid.Executor(fluid.XLAPlace(0)), fluid.Scope()
+
+
+# ---------------------------------------------------------------------------
+# word2vec (book/test_word2vec.py): N-gram LM over embeddings
+# ---------------------------------------------------------------------------
+
+def test_word2vec_ngram(tmp_path):
+    vocab, emb_dim, ctx_len = 32, 16, 3
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        words = fluid.layers.data("words", [ctx_len], dtype="int64")
+        target = fluid.layers.data("target", [1], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[vocab, emb_dim])
+        flat = fluid.layers.reshape(emb, [-1, ctx_len * emb_dim])
+        hidden = fluid.layers.fc(flat, 64, act="relu")
+        logits = fluid.layers.fc(hidden, vocab)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, target))
+        fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+    # synthetic grammar: next word follows the first context word
+    rng = np.random.RandomState(0)
+    ws = rng.randint(0, vocab, (512, ctx_len)).astype(np.int64)
+    tgt = ((ws[:, 0] + 1) % vocab).reshape(-1, 1).astype(np.int64)
+    losses = []
+    for epoch in range(40):
+        l = exe.run(prog, feed={"words": ws, "target": tgt},
+                    fetch_list=[loss], scope=scope)[0]
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    # inference round trip (book tests save + reload the embedding model)
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(str(tmp_path / "w2v"), ["words"],
+                                      [logits], exe, prog)
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(str(tmp_path / "w2v")))
+    out = pred.run({"words": ws[:8]})[0]
+    assert out.shape == (8, vocab)
+
+
+# ---------------------------------------------------------------------------
+# recommender (book/test_recommender_system.py): user/item embeddings -> fc
+# ---------------------------------------------------------------------------
+
+def test_recommender_system():
+    n_users, n_items, dim = 20, 30, 8
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        uid = fluid.layers.data("uid", [1], dtype="int64")
+        iid = fluid.layers.data("iid", [1], dtype="int64")
+        rating = fluid.layers.data("rating", [1], dtype="float32")
+        uemb = fluid.layers.embedding(uid, size=[n_users, dim])
+        iemb = fluid.layers.embedding(iid, size=[n_items, dim])
+        uvec = fluid.layers.fc(fluid.layers.reshape(uemb, [-1, dim]), dim)
+        ivec = fluid.layers.fc(fluid.layers.reshape(iemb, [-1, dim]), dim)
+        pred = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(uvec, ivec), dim=-1, keep_dim=True)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, rating))
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    # low-rank ground truth ratings
+    U = rng.randn(n_users, 3)
+    V = rng.randn(n_items, 3)
+    us = rng.randint(0, n_users, 256).astype(np.int64)
+    its = rng.randint(0, n_items, 256).astype(np.int64)
+    r = np.sum(U[us] * V[its], axis=1, keepdims=True).astype(np.float32)
+    losses = []
+    for epoch in range(60):
+        l = exe.run(prog, feed={"uid": us.reshape(-1, 1),
+                                "iid": its.reshape(-1, 1), "rating": r},
+                    fetch_list=[loss], scope=scope)[0]
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# sentiment LSTM (book/test_understand_sentiment.py): embedding -> LSTM -> fc
+# ---------------------------------------------------------------------------
+
+def test_sentiment_lstm():
+    vocab, emb_dim, hidden, seq = 50, 16, 32, 12
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        text = fluid.layers.data("text", [seq], dtype="int64")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        h0 = fluid.layers.data("h0", [1, -1, hidden], dtype="float32",
+                               append_batch_size=False)
+        c0 = fluid.layers.data("c0", [1, -1, hidden], dtype="float32",
+                               append_batch_size=False)
+        emb = fluid.layers.embedding(text, size=[vocab, emb_dim])
+        out, lh, lc = fluid.layers.lstm(emb, h0, c0, hidden_size=hidden)
+        last = fluid.layers.squeeze(lh, axes=[0])
+        logits = fluid.layers.fc(last, 2)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(logits, label)
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(2)
+    # sentiment = whether "positive tokens" (id < 25) dominate
+    x = rng.randint(0, vocab, (128, seq)).astype(np.int64)
+    y = (np.sum(x < 25, axis=1) > seq // 2).astype(np.int64).reshape(-1, 1)
+    z = np.zeros((1, 128, hidden), np.float32)
+    accs = []
+    for epoch in range(40):
+        _, a = exe.run(prog, feed={"text": x, "label": y, "h0": z, "c0": z},
+                       fetch_list=[loss, acc], scope=scope)
+        accs.append(float(a))
+    assert accs[-1] > 0.9, accs[-5:]
