@@ -1,3 +1,4 @@
+#!/usr/bin/env python
 """Flagship benchmark: GPT training-step throughput on one chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
@@ -5,22 +6,149 @@ The reference publishes no in-repo numbers (BASELINE.md — all N/A), so
 ``vs_baseline`` reports measured model-FLOPs-utilization (MFU) against the
 chip's peak — an absolute, hardware-grounded yardstick that carries across
 rounds.
+
+Hardened launcher/worker design: backend init in this environment can block
+indefinitely inside ``import jax`` when the TPU tunnel is down (the axon PJRT
+plugin dials out at import). The launcher therefore never imports jax itself;
+it probes the accelerator in a subprocess under a timeout and falls back to a
+CPU run marked ``"degraded": true`` so a JSON line is always produced within
+the time budget. Progress streams to stderr throughout.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+TOTAL_BUDGET_S = 390       # stay under the driver's ~7 min ceiling
+PROBE_TIMEOUT_S = 120      # device init should be fast; compile comes later
+CPU_RESERVE_S = 80         # always keep room for the CPU fallback run
 
-import jax
-import jax.numpy as jnp
 
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    # PALLAS_AXON_POOL_IPS triggers the axon PJRT plugin registration in
+    # sitecustomize, which blocks `import jax` on the tunnel — strip it.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _expects_accelerator():
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or \
+        os.environ.get("JAX_PLATFORMS", "").lower() in ("tpu", "axon")
+
+
+def _run_timed(cmd, env, timeout_s):
+    """Run cmd under a timeout with a graceful teardown.
+
+    Killing a python process mid-TPU-session wedges the axon relay (see
+    .claude/skills/verify/SKILL.md), so on timeout send SIGINT first and give
+    the child a grace period to unwind the PJRT client before SIGKILL.
+    """
+    import signal
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=None, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGINT)
+        try:
+            out, _ = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        return None, out or ""
+
+
+def _probe():
+    """Initialize the backend in a subprocess; return platform or None."""
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('PLATFORM=%s KIND=%s' % (d.platform, d.device_kind))")
+    rc, out = _run_timed([sys.executable, "-c", code], dict(os.environ),
+                         PROBE_TIMEOUT_S)
+    if rc is None:
+        _log(f"probe timed out after {PROBE_TIMEOUT_S}s")
+        return None
+    if rc != 0:
+        _log(f"probe failed rc={rc}")
+        return None
+    for tok in out.split():
+        if tok.startswith("PLATFORM="):
+            return tok.split("=", 1)[1]
+    return None
+
+
+def _run_worker(env, timeout_s, extra_args):
+    """Run the worker; return the parsed JSON result line or None."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker"] + extra_args
+    _log(f"worker start (timeout {int(timeout_s)}s): {' '.join(extra_args)}")
+    rc, out = _run_timed(cmd, env, timeout_s)
+    if rc is None:
+        _log("worker timed out")
+        return None
+    if rc != 0:
+        _log(f"worker failed rc={rc}")
+        return None
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    _log("worker produced no JSON line")
+    return None
+
+
+def launcher():
+    t0 = time.time()
+    remaining = lambda: TOTAL_BUDGET_S - (time.time() - t0)
+    result = None
+
+    platform = _probe()
+    _log(f"probe platform: {platform}")
+    saw_accelerator = platform not in (None, "cpu")
+    if saw_accelerator:
+        budget = max(60.0, remaining() - CPU_RESERVE_S)
+        result = _run_worker(dict(os.environ), budget, [])
+        if result is None and remaining() > CPU_RESERVE_S + 120:
+            # flash kernel may be the failure — retry once without it
+            result = _run_worker(dict(os.environ),
+                                 remaining() - CPU_RESERVE_S, ["--no-flash"])
+
+    if result is None:
+        degraded = saw_accelerator or _expects_accelerator()
+        if degraded:
+            _log("falling back to CPU (degraded)")
+        result = _run_worker(_cpu_env(), max(60.0, remaining()), [])
+        if result is not None:
+            result["degraded"] = degraded
+
+    if result is None:
+        result = {"metric": "gpt_train_tokens_per_sec_per_chip", "value": 0.0,
+                  "unit": "tokens/s", "vs_baseline": 0.0, "degraded": True,
+                  "detail": {"error": "all bench attempts failed/timed out"}}
+    result.setdefault("degraded", False)
+    print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
 
 def _peak_flops(device) -> float:
     """Best-effort peak bf16 FLOP/s for the device (fallbacks are rough)."""
     kind = getattr(device, "device_kind", "cpu").lower()
     table = {
         "v6e": 918e12, "v6 lite": 918e12, "v5e": 394e12, "v5 lite": 394e12,
-        "v5p": 459e12, "v4": 275e12, "v3": 123e12, "v2": 45e12,
+        "v5litepod": 394e12, "v5p": 459e12, "v4": 275e12, "v3": 123e12,
+        "v2": 45e12,
     }
     for k, v in table.items():
         if k in kind:
@@ -28,22 +156,28 @@ def _peak_flops(device) -> float:
     return 1e12  # CPU / unknown
 
 
-def main():
+def worker(use_flash: bool):
+    _log("worker: importing jax")
+    import numpy as np
+    import jax
+
+    dev = jax.devices()[0]
+    on_acc = dev.platform != "cpu"
+    _log(f"worker: device {dev.platform}/{getattr(dev, 'device_kind', '?')}")
+
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-
-    if on_tpu:
-        cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=True)
-        batch, T, steps = 32, 1024, 10
+    if on_acc:
+        cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=use_flash)
+        batch, T, steps = 16, 1024, 8
     else:  # CPU smoke path so the bench always produces a line
         cfg = G.GPT_TINY.scaled(num_layers=2)
         batch, T, steps = 4, 32, 3
 
     pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
     mesh = PZ.build_mesh(pcfg, devices=[dev])
+    _log("worker: init params")
     params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
     step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
 
@@ -51,17 +185,22 @@ def main():
     tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
     labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
 
-    # warmup (compile)
+    _log("worker: compiling train step (first call)")
+    tc = time.perf_counter()
     params, opt, loss, _ = step(params, opt, tokens, labels)
-    float(loss)
+    loss0 = float(loss)
+    _log(f"worker: compile+step done in {time.perf_counter() - tc:.1f}s "
+         f"loss={loss0:.4f}")
 
     # sync each step: block_until_ready on a chained async queue is not
     # reliable through the remote-TPU tunnel, and fetching the scalar loss
     # costs ~nothing against a full train step
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         params, opt, loss, _ = step(params, opt, tokens, labels)
         float(loss)
+        _log(f"worker: step {i + 1}/{steps} "
+             f"({(time.perf_counter() - t0) / (i + 1):.3f}s/step)")
     dt = time.perf_counter() - t0
 
     tokens_per_s = steps * batch * T / dt
@@ -73,7 +212,7 @@ def main():
     mfu = tokens_per_s * flops_per_token / _peak_flops(dev)
 
     print(json.dumps({
-        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu, 4),
@@ -81,10 +220,19 @@ def main():
             "model_params": int(n_params),
             "seq_len": T, "batch": batch, "steps": steps,
             "device": str(getattr(dev, "device_kind", dev.platform)),
+            "platform": dev.platform,
+            "flash": bool(on_acc and use_flash),
             "loss": round(float(loss), 4),
             "mfu": round(mfu, 4),
         },
-    }))
+    }), flush=True)
+
+
+def main():
+    if "--worker" in sys.argv:
+        worker(use_flash="--no-flash" not in sys.argv)
+    else:
+        launcher()
 
 
 if __name__ == "__main__":
