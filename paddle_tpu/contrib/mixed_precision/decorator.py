@@ -121,22 +121,29 @@ class OptimizerWithMixedPrecision:
         return getattr(self._optimizer, item)
 
 
-def decorate(optimizer, amp_lists=None, init_loss_scaling: float = 2. ** 15,
+_UNSET = object()
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=_UNSET,
              incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
              incr_ratio: float = 2.0, decr_ratio: float = 0.8,
-             use_dynamic_loss_scaling: bool = True,
+             use_dynamic_loss_scaling=_UNSET,
              use_bf16: bool = True):
     """contrib.mixed_precision.decorate (decorator.py:218).
 
     TPU default is bf16 with loss scale pinned at 1.0 (bf16 shares fp32's
     exponent range so overflow is a non-issue); pass use_bf16=False for the
-    reference's fp16 + dynamic-loss-scale behavior.
+    reference's fp16 + dynamic-loss-scale behavior.  Explicitly passed
+    ``init_loss_scaling`` / ``use_dynamic_loss_scaling`` are honored even
+    under bf16 (reference code ported verbatim keeps its configuration).
     """
     dest = "bfloat16" if use_bf16 else "float16"
-    if use_bf16:
-        init_loss_scaling = 1.0
-        use_dynamic_loss_scaling = False
+    if init_loss_scaling is _UNSET:
+        init_loss_scaling = 1.0 if use_bf16 else 2. ** 15
+    if use_dynamic_loss_scaling is _UNSET:
+        use_dynamic_loss_scaling = not use_bf16
     return OptimizerWithMixedPrecision(
-        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        optimizer, amp_lists, float(init_loss_scaling),
+        bool(use_dynamic_loss_scaling),
         incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
         dest)

@@ -63,7 +63,10 @@ class DataFeeder:
                 c.append(np.asarray(v))
         out: Dict[str, np.ndarray] = {}
         for var, c in zip(self.feed_list, cols):
-            arr = np.stack(c).astype(_np_dtype(var), copy=False)
+            # stack WITHOUT casting: check_feed_shape_type below performs the
+            # validated same-kind conversion (float fed to an int64 var must
+            # raise, not silently truncate)
+            arr = np.stack(c)
             # fluid.layers.data declares [-1, d...]; samples may come flat
             want_rank = len(var.shape)
             if arr.ndim == want_rank - 1 and var.shape[-1] == 1:

@@ -134,11 +134,13 @@ def listen_and_serv_op(scope, op, exe):
         if tbl.get("is_sparse"):
             server.register_sparse(tbl["name"], tbl["dim"],
                                    tbl.get("optimizer", "sgd"),
-                                   tbl.get("lr", 0.01))
+                                   tbl.get("lr", 0.01),
+                                   **tbl.get("hparams", {}))
         else:
             server.register_dense(tbl["name"], tbl["shape"],
                                   tbl.get("optimizer", "sgd"),
-                                  tbl.get("lr", 0.01))
+                                  tbl.get("lr", 0.01),
+                                  **tbl.get("hparams", {}))
     server.start()
     op._server = server  # for in-process tests / graceful shutdown
     if op.attr("blocking", True):

@@ -75,15 +75,33 @@ def _unwrap(v):
 
 
 class _StaticFunction:
-    """A dygraph callable staged per input signature (shape/dtype key)."""
+    """A dygraph callable staged per input signature (shape/dtype key).
+
+    Layer parameters are threaded through the jitted function as arguments
+    (never closed over), so eager updates — set_value, load_dict, optimizer
+    steps — are visible to subsequent staged calls.  A bound ``Layer`` method
+    (``net.forward``) and a method decorated in a class body (where the Layer
+    arrives as ``args[0]``) are both detected and routed through this path.
+    """
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None):
         self._fn = fn
         self._layer = layer
         self._cache = {}
 
-    def _pure(self):
-        fn, layer = self._fn, self._layer
+    def _resolve_layer(self, args):
+        """Return (layer, call_with_self, remaining_args)."""
+        if self._layer is not None:
+            return self._layer, False, args
+        bound = getattr(self._fn, "__self__", None)
+        if isinstance(bound, Layer):
+            return bound, False, args
+        if args and isinstance(args[0], Layer):
+            return args[0], True, args[1:]
+        return None, False, args
+
+    def _pure(self, layer=None, call_with_self=False):
+        fn = self._fn
         if layer is None:
             def pure(param_vals, *vs):
                 wrapped = [VarBase(v, stop_gradient=True)
@@ -104,7 +122,7 @@ class _StaticFunction:
                 wrapped = [VarBase(v, stop_gradient=True)
                            if hasattr(v, "shape") else v for v in vs]
                 with no_grad_ctx():
-                    out = fn(*wrapped)
+                    out = fn(layer, *wrapped) if call_with_self else fn(*wrapped)
                 return jax.tree.map(_unwrap, out)
             finally:
                 for k, v in zip(names, saved):
@@ -116,14 +134,23 @@ class _StaticFunction:
             return self._fn(*args, **kwargs)
         if kwargs:
             return self._fn(*args, **kwargs)  # kwargs fall back to eager
-        vals = tuple(_unwrap(a) for a in args)
+        layer, call_with_self, rest = self._resolve_layer(args)
+        vals = tuple(_unwrap(a) for a in rest)
+        # per-layer caches live ON the layer so they (and the staged closures
+        # that strong-reference it) are reclaimed with the instance — a shared
+        # class-level cache keyed by id(layer) would pin every instance forever
+        if layer is None:
+            cache = self._cache
+        else:
+            cache = layer.__dict__.setdefault(
+                "_declarative_caches", {}).setdefault(id(self), {})
         key = tuple((tuple(v.shape), str(v.dtype)) if hasattr(v, "shape")
                     else ("py", v) for v in vals)
-        if key not in self._cache:
-            pure, names = self._pure()
-            self._cache[key] = (jax.jit(pure), names)
-        jitted, names = self._cache[key]
-        sd = self._layer.state_dict() if self._layer is not None else {}
+        if key not in cache:
+            pure, names = self._pure(layer, call_with_self)
+            cache[key] = (jax.jit(pure), names)
+        jitted, names = cache[key]
+        sd = layer.state_dict() if layer is not None else {}
         param_vals = [sd[k].value for k in names]
         out = jitted(param_vals, *vals)
         return jax.tree.map(
@@ -226,7 +253,7 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None):
 
     if isinstance(layer, Layer):
         sf = _StaticFunction(layer.forward, layer=layer)
-        pure, names = sf._pure()
+        pure, names = sf._pure(layer)
         sd = layer.state_dict()
         param_vals = [np.asarray(sd[k].value) for k in names]
     else:  # plain @declarative function

@@ -47,15 +47,25 @@ def load_library(name: str, extra_flags=()):
         out = os.path.join(_BUILD_DIR, f"lib{name}.so")
         if (not os.path.exists(out)
                 or os.path.getmtime(out) < os.path.getmtime(src)):
+            # compile to a per-pid temp path and os.rename into place (atomic
+            # on POSIX) so concurrent builders in multiple processes (e.g. the
+            # multi-process trainer / DataLoader paths) never dlopen a
+            # partially written .so
+            tmp = f"{out}.{os.getpid()}.tmp"
             cmd = [_compiler(), "-O3", "-std=c++17", "-shared", "-fPIC",
-                   "-o", out, src, "-pthread", *extra_flags]
+                   "-o", tmp, src, "-pthread", *extra_flags]
             try:
                 proc = subprocess.run(cmd, capture_output=True, text=True)
             except FileNotFoundError as e:
                 raise NativeBuildError(f"C++ compiler not found: {e}") from e
             if proc.returncode != 0:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
                 raise NativeBuildError(
                     f"native build of {name} failed:\n{proc.stderr[-4000:]}")
+            os.replace(tmp, out)
         lib = ctypes.CDLL(out)
         _loaded[name] = lib
         return lib

@@ -206,7 +206,8 @@ class DistributeTranspiler:
                      "decayed_adagrad", "dpsgd"}
         # table configs: optimizer rule + shape per owned param (the server
         # side of the reference's per-param optimizer sub-blocks)
-        table_opt = {"sgd": "sgd", "momentum": "momentum", "adagrad": "adagrad",
+        table_opt = {"sgd": "sgd", "momentum": "momentum",
+                     "lars_momentum": "momentum", "adagrad": "adagrad",
                      "adam": "adam", "adamw": "adam"}
         tables = []
         for op in origin_block.ops:
@@ -214,12 +215,26 @@ class DistributeTranspiler:
                 opt_descs.append(op._desc_dict())
                 pname = op.input("Param")[0]
                 pvar = origin_block.var(pname)
+                # forward the optimizer op's hyperparameters so the server-side
+                # table updates with the user's values, not hardcoded defaults
+                # (reference runs the actual optimizer op descs on the pserver);
+                # the native table's beta1 slot doubles as momentum's mu
+                hparams = {}
+                if op.type in ("momentum", "lars_momentum"):
+                    hparams["beta1"] = float(op.attr("mu", 0.9))
+                elif op.type in ("adam", "adamw"):
+                    hparams["beta1"] = float(op.attr("beta1", 0.9))
+                    hparams["beta2"] = float(op.attr("beta2", 0.999))
+                    hparams["eps"] = float(op.attr("epsilon", 1e-8))
+                elif op.type == "adagrad":
+                    hparams["eps"] = float(op.attr("epsilon", 1e-6))
                 tables.append({
                     "name": pname,
                     "shape": [int(d) for d in pvar.shape],
                     "optimizer": table_opt.get(op.type, "sgd"),
                     "lr": 0.01,  # overwritten per push by the trainer's lr
                     "is_sparse": False,
+                    "hparams": hparams,
                 })
         block.append_op(
             type="listen_and_serv",

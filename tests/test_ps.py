@@ -339,3 +339,26 @@ def test_checkpoint_notify(tmp_path):
         c.close()
     finally:
         server.stop()
+
+
+def test_transpiler_forwards_optimizer_hparams():
+    """Momentum's mu / adam's betas must reach the pserver table config
+    (advisor round-1 finding: server silently used hardcoded defaults)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        fluid.optimizer.MomentumOptimizer(0.1, momentum=0.5).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers="127.0.0.1:16217",
+                trainers=1)
+    prog = t.get_pserver_program("127.0.0.1:16217")
+    ls = [op for op in prog.global_block().ops
+          if op.type == "listen_and_serv"][0]
+    tables = ls.attr("tables")
+    assert tables, "no tables in listen_and_serv"
+    by_opt = {tbl["optimizer"]: tbl for tbl in tables}
+    assert "momentum" in by_opt
+    assert by_opt["momentum"]["hparams"]["beta1"] == 0.5
