@@ -138,12 +138,16 @@ class _StaticFunction:
         vals = tuple(_unwrap(a) for a in rest)
         # per-layer caches live ON the layer so they (and the staged closures
         # that strong-reference it) are reclaimed with the instance — a shared
-        # class-level cache keyed by id(layer) would pin every instance forever
+        # class-level cache keyed by id(layer) would pin every instance
+        # forever.  Keyed by the underlying function object (stable across
+        # re-created _StaticFunction wrappers) so re-staging net.forward in a
+        # loop reuses instead of accumulating compiled executables.
         if layer is None:
             cache = self._cache
         else:
+            fn_key = getattr(self._fn, "__func__", self._fn)
             cache = layer.__dict__.setdefault(
-                "_declarative_caches", {}).setdefault(id(self), {})
+                "_declarative_caches", {}).setdefault(fn_key, {})
         key = tuple((tuple(v.shape), str(v.dtype)) if hasattr(v, "shape")
                     else ("py", v) for v in vals)
         if key not in cache:

@@ -17,13 +17,20 @@ from .program import Block, Operator, Parameter, Program, Variable
 from .registry import GRAD_SUFFIX, get_op_spec, has_op
 
 
-def _fwd_desc(op: Operator) -> dict:
-    return {
+def _fwd_desc(op: Operator, rename: Optional[Dict[str, str]] = None) -> dict:
+    r = rename or {}
+    desc = {
         "type": op.type,
-        "inputs": {k: list(v) for k, v in op.inputs.items()},
-        "outputs": {k: list(v) for k, v in op.outputs.items()},
+        "inputs": {k: [r.get(n, n) for n in v] for k, v in op.inputs.items()},
+        "outputs": {k: [r.get(n, n) for n in v] for k, v in op.outputs.items()},
         "attrs": {k: v for k, v in op.attrs.items() if not k.startswith("__fwd")},
     }
+    if r:
+        # pin the original output names for rng replay so recomputed random
+        # ops (dropout masks) reproduce the forward's randomness exactly
+        desc["attrs"]["__rng_names__"] = sorted(
+            n for ns in op.outputs.values() for n in ns)
+    return desc
 
 
 def _compute_requires_grad(block: Block, no_grad_set: Set[str]) -> Set[str]:
@@ -55,6 +62,99 @@ def _compute_requires_grad(block: Block, no_grad_set: Set[str]) -> Set[str]:
 _resolve_hook: Optional[List] = None
 
 
+class _RecomputePlan:
+    """Segment bookkeeping for checkpoint recompute — the IR-transform parity
+    of _append_backward_ops_with_checkpoints_ (reference backward.py:629).
+
+    Forward ops are split into segments ending at each checkpoint-producing
+    op; when the reverse walk reaches a segment's first grad op, the segment's
+    forward ops are re-emitted with renamed outputs, fed through a
+    `recompute_barrier` (lax.optimization_barrier) on the segment's external
+    inputs so XLA CSE cannot merge the recomputation with the original
+    forward.  Grad ops of the segment then replay against the recomputed
+    values; the original intermediates die at the end of the forward, which is
+    the whole memory saving.  The tail after the last checkpoint is not
+    recomputed (same as the reference and jax.checkpoint).
+    """
+
+    def __init__(self, block: Block, fwd_ops: List[Operator],
+                 ckpt_names: List[str]):
+        self.block = block
+        self.fwd_ops = fwd_ops
+        self.ckpt_names = set(ckpt_names)
+        prod_idx: Dict[str, int] = {}
+        for i, op in enumerate(fwd_ops):
+            for n in op.output_arg_names:
+                if n in self.ckpt_names:
+                    prod_idx[n] = i
+        cuts = sorted(set(prod_idx.values()))
+        self.segments: List = []
+        lo = 0
+        for c in cuts:
+            if c >= lo:
+                self.segments.append((lo, c))
+                lo = c + 1
+        self.seg_of: Dict[int, int] = {}
+        for s, (a, b) in enumerate(self.segments):
+            for i in range(a, b + 1):
+                self.seg_of[i] = s
+        self.rename: List[Optional[Dict[str, str]]] = [None] * len(self.segments)
+
+    def rename_for(self, op_index: int) -> Optional[Dict[str, str]]:
+        """Materialize (once) the segment containing op_index; return its
+        name map (original -> recomputed/barriered) or None for the tail."""
+        s = self.seg_of.get(op_index)
+        if s is None:
+            return None
+        if self.rename[s] is not None:
+            return self.rename[s]
+        a, b = self.segments[s]
+        seg_ops = self.fwd_ops[a:b + 1]
+        produced = {n for op in seg_ops for n in op.output_arg_names}
+        rename = {n: f"{n}@RC{s}" for n in produced
+                  if n not in self.ckpt_names}
+        ext: List[str] = []
+        for op in seg_ops:
+            for n in op.input_arg_names:
+                if n not in produced and n not in ext:
+                    ext.append(n)
+        bar = {n: f"{n}@BAR{s}" for n in ext}
+        block = self.block
+        for n, bn in bar.items():
+            src = block._var_recursive(n)
+            block.create_var(name=bn, shape=src.shape, dtype=src.dtype)
+        if bar:
+            block.append_op(type="recompute_barrier",
+                            inputs={"X": list(bar)},
+                            outputs={"Out": list(bar.values())})
+        full = {**bar, **rename}
+        for op in seg_ops:
+            if not has_op(op.type):
+                continue
+            if not any(n in rename for n in op.output_arg_names):
+                continue  # all outputs are stored checkpoints — nothing to redo
+            # a multi-output op may produce both an intermediate and a stored
+            # checkpoint; route the checkpoint output to a dummy var so the
+            # original binding is not clobbered
+            out_name = {n: rename.get(n, f"{n}@RCdup{s}")
+                        for n in op.output_arg_names}
+            for n, rn in out_name.items():
+                src = block._var_recursive(n)
+                block.create_var(name=rn, shape=src.shape, dtype=src.dtype)
+            new_attrs = dict(op.attrs)
+            new_attrs["__rng_names__"] = sorted(op.output_arg_names)
+            block.append_op(
+                type=op.type,
+                inputs={k: [full.get(n, n) for n in v]
+                        for k, v in op.inputs.items()},
+                outputs={k: [out_name[n] for n in v]
+                         for k, v in op.outputs.items()},
+                attrs=new_attrs,
+            )
+        self.rename[s] = full
+        return full
+
+
 def append_backward(
     loss: Variable,
     parameter_list: Optional[List] = None,
@@ -65,9 +165,10 @@ def append_backward(
     """Append grad ops for ``loss``; returns [(param, grad_var), ...].
 
     ``checkpoints`` marks recompute boundaries (parity with
-    _append_backward_ops_with_checkpoints_, backward.py:629): on the TPU build
-    recompute is applied at lowering time via jax.checkpoint on the segments
-    between checkpoint vars (see executor.py), so here we only record them.
+    _append_backward_ops_with_checkpoints_, backward.py:629): forward segments
+    ending at each checkpoint are re-emitted into the backward region behind a
+    `recompute_barrier` op (lax.optimization_barrier), and the segment's grad
+    ops replay against the recomputed values — see _RecomputePlan.
     """
     program: Program = loss.block.program
     block = loss.block
@@ -82,10 +183,12 @@ def append_backward(
             f"loss {loss.name!r} does not depend on any trainable parameter"
         )
 
-    if checkpoints:
-        program._annotations["recompute_checkpoints"] = [
-            v.name if isinstance(v, Variable) else v for v in checkpoints
-        ]
+    ckpt_names = [v.name if isinstance(v, Variable) else v
+                  for v in (checkpoints or ())]
+    if ckpt_names:
+        # introspection-only metadata (tooling/tests); the actual recompute
+        # transform is _RecomputePlan below, not an executor-side consumer
+        program._annotations["recompute_checkpoints"] = list(ckpt_names)
 
     # seed: d loss / d loss = 1
     loss_grad_name = loss.name + GRAD_SUFFIX
@@ -104,6 +207,7 @@ def append_backward(
 
     # snapshot of forward ops (exclude the seed op we just appended)
     fwd_ops = block.ops[:-1]
+    recompute = _RecomputePlan(block, fwd_ops, ckpt_names) if ckpt_names else None
 
     def resolved_grad(name: str) -> Optional[str]:
         """Collapse accumulated grads for `name` into one var (sum if >1)."""
@@ -125,7 +229,8 @@ def append_backward(
 
     param_grads: Dict[str, str] = {}
 
-    for op in reversed(fwd_ops):
+    for op_index in range(len(fwd_ops) - 1, -1, -1):
+        op = fwd_ops[op_index]
         if not has_op(op.type):
             continue
         spec = get_op_spec(op.type)
@@ -184,16 +289,30 @@ def append_backward(
         if not grad_outputs:
             continue
 
+        # recompute: materialize the segment's re-emitted forward (once) and
+        # rewrite the grad op's forward-value references to the recomputed
+        # names; grad var names stay original so cross-segment grad flow and
+        # the final (param, grad) pairing are untouched
+        rmap = recompute.rename_for(op_index) if recompute else None
+
         if callable(spec.grad):
             # custom grad maker appends its own ops
-            spec.grad(op, block, out_grad_inputs, grad_outputs)
+            grad_src_op = op
+            if rmap:
+                import copy as _copy
+                grad_src_op = _copy.copy(op)
+                grad_src_op.inputs = {
+                    k: [rmap.get(n, n) for n in v] for k, v in op.inputs.items()}
+                grad_src_op.outputs = {
+                    k: [rmap.get(n, n) for n in v] for k, v in op.outputs.items()}
+            spec.grad(grad_src_op, block, out_grad_inputs, grad_outputs)
         else:
             g_inputs: Dict[str, List[str]] = {}
             for slot, names in op.inputs.items():
-                g_inputs[slot] = list(names)
+                g_inputs[slot] = [rmap.get(n, n) for n in names] if rmap else list(names)
             for slot, names in op.outputs.items():
                 if slot not in g_inputs:
-                    g_inputs[slot] = list(names)
+                    g_inputs[slot] = [rmap.get(n, n) for n in names] if rmap else list(names)
             g_inputs.update(out_grad_inputs)
             # keep positional alignment with the forward input list: unneeded
             # grads become the @EMPTY@ placeholder (skipped at bind time), so
@@ -203,7 +322,7 @@ def append_backward(
                 for slot, outs in grad_outputs.items()
             }
             attrs = dict(op.attrs)
-            attrs["__fwd__"] = _fwd_desc(op)
+            attrs["__fwd__"] = _fwd_desc(op, rmap)
             block.append_op(
                 type=op.type + "_grad",
                 inputs=g_inputs,

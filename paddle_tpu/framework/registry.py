@@ -108,17 +108,22 @@ class LowerCtx:
         return key
 
     def rng_for(self, op):
-        """Deterministic key derived from the op's first output name.
+        """Deterministic key derived from the op's output names.
 
         Grad-op vjp replay of a random forward op re-derives the SAME key (the
         fake forward op carries the original output names), so the replayed
         randomness is bit-identical and XLA CSE merges it with the forward.
+        Recompute re-emission (backward.py) renames outputs but pins the
+        original names in the ``__rng_names__`` attr so the recomputed
+        randomness (e.g. a dropout mask) matches the forward exactly.
         """
         import zlib
 
         if self._rng_key is None:
             self._rng_key = jax.random.PRNGKey(0)
-        names = [n for ns in op.outputs.values() for n in ns]
+        names = op.attr("__rng_names__") if hasattr(op, "attr") else None
+        if not names:
+            names = [n for ns in op.outputs.values() for n in ns]
         salt = zlib.crc32(("|".join(sorted(names))).encode()) & 0x7FFFFFFF
         return jax.random.fold_in(self._rng_key, salt)
 

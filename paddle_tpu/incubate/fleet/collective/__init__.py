@@ -36,7 +36,7 @@ class DistributedStrategy(BuildStrategy):
         self.use_local_sgd = False
         self.local_sgd_interval = 1
         self.use_amp = False
-        self.amp_loss_scale = 2.0 ** 15
+        self.amp_loss_scale = None  # None = decorate()'s per-dtype default
         self.use_recompute = False
         self.recompute_checkpoints = None
         self.forward_recompute = False
@@ -165,7 +165,13 @@ class CollectiveOptimizer(DistributedOptimizer):
         if strategy.use_amp:
             from ....contrib.mixed_precision import decorate
 
-            inner = decorate(inner, init_loss_scaling=strategy.amp_loss_scale)
+            # only forward a loss scale the user actually set — decorate()
+            # picks the right default per dtype (1.0 bf16 / 2**15 fp16)
+            if strategy.amp_loss_scale is None:
+                inner = decorate(inner)
+            else:
+                inner = decorate(inner,
+                                 init_loss_scaling=strategy.amp_loss_scale)
 
         optimize_ops, params_grads = inner.minimize(
             loss, startup_program, parameter_list, no_grad_set)

