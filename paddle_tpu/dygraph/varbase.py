@@ -18,12 +18,23 @@ import numpy as np
 
 class _Tape:
     def __init__(self):
-        self.entries: List[tuple] = []  # (outputs, inputs, vjp_fn)
+        # (outputs, inputs, vjp_fn, primal_fn, primal_vals, out_container)
+        # primal_fn(*diff_vals) replays the op so create_graph can
+        # differentiate the backward sweep itself; primal_vals are the
+        # forward-time values of the diff inputs (set_value between forward
+        # and backward must not change what the graph recorded);
+        # out_container is the fn's output pytree container (tuple/list/None)
+        # so cotangents are rebuilt with the exact structure jax.vjp expects.
+        # Note: pinning primal_fn/primal_vals keeps operands alive until the
+        # tape clears — the price of higher-order support in eager mode.
+        self.entries: List[tuple] = []
         self.enabled = True
 
-    def record(self, outputs, inputs, vjp_fn):
+    def record(self, outputs, inputs, vjp_fn, primal_fn=None,
+               primal_vals=None, out_container=None):
         if self.enabled:
-            self.entries.append((outputs, inputs, vjp_fn))
+            self.entries.append((outputs, inputs, vjp_fn, primal_fn,
+                                 primal_vals, out_container))
 
     def clear(self):
         self.entries.clear()
@@ -173,11 +184,13 @@ def apply_op(fn: Callable, *inputs, n_outs: int = 1, **kwargs):
             merged[i] = dv
         return fn(*merged, **kwargs)
 
-    out_vals, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
+    primal_vals = tuple(vals[i] for i in diff_idx)
+    out_vals, vjp_fn = jax.vjp(partial_fn, *primal_vals)
     outs = _wrap_outputs(out_vals, stop_gradient=False)
     out_list = outs if isinstance(outs, (list, tuple)) else [outs]
     _tape.record([o for o in out_list if isinstance(o, VarBase)],
-                 [v for _, v in diff], vjp_fn)
+                 [v for _, v in diff], vjp_fn, partial_fn, primal_vals,
+                 type(out_vals) if isinstance(out_vals, (list, tuple)) else None)
     return outs
 
 
@@ -190,56 +203,121 @@ def _wrap_outputs(out_vals, stop_gradient):
     return VarBase(out_vals, stop_gradient=stop_gradient)
 
 
-def run_backward(roots: Sequence[VarBase], retain_graph: bool = False):
-    """BasicEngine::Execute parity: reverse sweep, sum-accumulate grads."""
-    grads = {}
-    for r in roots:
-        grads[id(r)] = jnp.ones_like(r.value)
-    for outputs, inputs, vjp_fn in reversed(_tape.entries):
+def run_backward(roots: Sequence[VarBase], retain_graph: bool = False,
+                 create_graph: bool = False, root_grads=None,
+                 accumulate: bool = True):
+    """BasicEngine::Execute parity: reverse sweep, sum-accumulate grads.
+
+    With ``create_graph`` the cotangent computation for each tape entry runs
+    through ``apply_op`` (re-deriving the vjp from the recorded primal fn at
+    the recorded primal inputs), so the backward sweep is itself taped and a
+    further grad()/backward() differentiates through it — the capability of
+    the reference's PartialGradEngine (imperative/partial_grad_engine.cc).
+    Returns {id(var): grad} over every visited var (raw arrays, or VarBase
+    when create_graph).
+    """
+    grads: dict = {}
+    for i, r in enumerate(roots):
+        seed = None if root_grads is None else root_grads[i]
+        if seed is None:
+            seed = jnp.ones_like(r.value)
+        if create_graph and not isinstance(seed, VarBase):
+            seed = VarBase(seed, stop_gradient=True)
+        elif not create_graph and isinstance(seed, VarBase):
+            seed = seed.value
+        prev = grads.get(id(r))
+        grads[id(r)] = seed if prev is None else prev + seed
+
+    # snapshot: create_graph appends new entries (the taped backward ops)
+    # while we iterate; those belong to the extended graph, not this sweep
+    entries = list(_tape.entries)
+    for outputs, inputs, vjp_fn, primal_fn, primal_vals, out_ctr in \
+            reversed(entries):
         out_list = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-        cotangents_single = []
+        cotangents = []
         any_grad = False
         for o in out_list:
             g = grads.get(id(o))
             if g is None:
-                g = jnp.zeros_like(o.value)
+                z = jnp.zeros_like(o.value)
+                g = VarBase(z, stop_gradient=True) if create_graph else z
             else:
                 any_grad = True
-            cotangents_single.append(g)
+            cotangents.append(g)
         if not any_grad:
             continue
-        ct = cotangents_single[0] if len(cotangents_single) == 1 else tuple(cotangents_single)
-        in_grads = vjp_fn(ct)
+
+        if create_graph and primal_fn is not None:
+            k = len(cotangents)
+
+            def second_fn(*args, _pf=primal_fn, _k=k, _ctr=out_ctr):
+                cts, primals = args[:_k], args[_k:]
+                _, vjp2 = jax.vjp(_pf, *primals)
+                # cotangent pytree must match the recorded fn's output
+                # container exactly (a 1-tuple output needs a 1-tuple ct)
+                ct = _ctr(cts) if _ctr is not None else cts[0]
+                return tuple(vjp2(ct))
+
+            # replay at the forward-time values: set_value between forward
+            # and backward must not change what the graph recorded
+            saved_vals = [v.value for v in inputs]
+            try:
+                for v, rv in zip(inputs, primal_vals):
+                    v.value = rv
+                in_grads = apply_op(second_fn, *cotangents, *inputs)
+            finally:
+                for v, sv in zip(inputs, saved_vals):
+                    v.value = sv
+            if not isinstance(in_grads, (list, tuple)):
+                in_grads = [in_grads]
+        else:
+            ct = out_ctr(cotangents) if out_ctr is not None else cotangents[0]
+            in_grads = vjp_fn(ct)
+
         for v, g in zip(inputs, in_grads):
             if g is None:
                 continue
             prev = grads.get(id(v))
             grads[id(v)] = g if prev is None else prev + g
-            # leaf accumulation (params and user vars)
-            if v._grad is None:
-                v._grad = grads[id(v)]
-            else:
-                v._grad = v._grad + g
+            # leaf accumulation (params and user vars) — _grad stays a raw
+            # array regardless of mode (public .gradient() API).  grad()
+            # computes partial grads without touching .grad, like the
+            # reference's PartialGradEngine — only backward() accumulates.
+            if accumulate:
+                gval = g.value if isinstance(g, VarBase) else g
+                v._grad = gval if v._grad is None else v._grad + gval
     if not retain_graph:
         _tape.clear()
+    return grads
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad / fluid.dygraph.grad — parity with PartialGradEngine
-    (imperative/partial_grad_engine.cc)."""
+    (imperative/partial_grad_engine.cc).  ``create_graph=True`` returns grads
+    that are themselves differentiable (double/higher-order grad)."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    saved = {id(v): v._grad for v in inputs}
-    for v in inputs:
-        v._grad = None
-    run_backward(list(outputs), retain_graph=bool(retain_graph))
+    if retain_graph is None:
+        retain_graph = create_graph
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    gmap = run_backward(list(outputs), retain_graph=retain_graph,
+                        create_graph=create_graph, root_grads=grad_outputs,
+                        accumulate=False)
     results = []
     for v in inputs:
-        g = v._grad
+        g = gmap.get(id(v))
         if g is None and not allow_unused:
-            g = jnp.zeros_like(v.value)
-        results.append(VarBase(g, stop_gradient=True) if g is not None else None)
-        v._grad = saved[id(v)]
+            raise ValueError(
+                f"input {v.name!r} is unreachable from the given outputs; "
+                "pass allow_unused=True to get None for it (reference "
+                "PartialGradEngine raises the same way)")
+        if g is None:
+            results.append(None)
+        elif isinstance(g, VarBase):
+            results.append(g)
+        else:
+            results.append(VarBase(g, stop_gradient=True))
     return results

@@ -1,0 +1,157 @@
+"""Dygraph autograd: paddle.grad parity with the reference PartialGradEngine
+(imperative/partial_grad_engine.cc) including create_graph double/higher-order
+gradients (VERDICT r1: create_graph used to be silently ignored)."""
+import numpy as np
+
+import paddle_tpu as fluid  # noqa: F401
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import varbase as V
+
+
+def test_first_order_grad_matches_backward():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([2.0, 3.0], np.float32))
+        y = x * x
+        (gx,) = dygraph.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0, 6.0])
+
+
+def test_grad_does_not_pollute_leaf_grads():
+    """grad() computes partial grads without accumulating into .grad
+    (PartialGradEngine semantics); only backward() accumulates."""
+    with dygraph.guard():
+        lin = dygraph.Linear(3, 1)
+        xv = dygraph.to_variable(np.ones((2, 3), np.float32))
+        out = lin(xv)
+        dygraph.grad(out, xv, retain_graph=True)
+        for p in lin.parameters():
+            assert p.gradient() is None
+
+
+def test_double_grad_analytic():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([2.0, -1.0], np.float32))
+        y = x * x * x
+        (gx,) = dygraph.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), 3 * np.array([4.0, 1.0]),
+                                   rtol=1e-6)
+        (ggx,) = dygraph.grad(gx, x)
+        np.testing.assert_allclose(ggx.numpy(), 6 * np.array([2.0, -1.0]),
+                                   rtol=1e-6)
+
+
+def test_double_grad_vs_numeric():
+    """Second derivative of a small MLP-ish scalar fn vs central differences."""
+    w0 = np.random.RandomState(0).rand(3).astype("float32")
+
+    def f_np(xs):
+        return float(np.tanh(xs @ w0).sum() + (xs ** 2).sum())
+
+    x0 = np.array([0.3, -0.2, 0.5], np.float32)
+
+    with dygraph.guard():
+        import jax.numpy as jnp
+        x = dygraph.to_variable(x0)
+        w = dygraph.to_variable(w0)
+        y = V.apply_op(lambda a, b: jnp.tanh((a * b).sum()) + (a ** 2).sum(),
+                       x, w)
+        (gx,) = dygraph.grad(y, x, create_graph=True)
+        s = V.apply_op(lambda g: g.sum(), gx)
+        (ggx,) = dygraph.grad(s, x)
+
+    # numeric d/dx_i of sum_j dy/dx_j
+    eps = 1e-3
+    num = np.zeros(3)
+    for i in range(3):
+        for sign in (+1, -1):
+            xp = x0.copy()
+            xp[i] += sign * eps
+            # grad of f at xp (numeric first derivative, summed)
+            g = np.zeros(3)
+            for j in range(3):
+                xq = xp.copy()
+                xq[j] += eps
+                xr = xp.copy()
+                xr[j] -= eps
+                g[j] = (f_np(xq) - f_np(xr)) / (2 * eps)
+            num[i] += sign * g.sum() / (2 * eps)
+    np.testing.assert_allclose(ggx.numpy(), num, rtol=2e-2, atol=2e-2)
+
+
+def test_triple_order():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.5], np.float32))
+        y = x * x * x * x
+        (g1,) = dygraph.grad(y, x, create_graph=True)
+        (g2,) = dygraph.grad(g1, x, create_graph=True)
+        (g3,) = dygraph.grad(g2, x)
+        np.testing.assert_allclose(g1.numpy(), 4 * 1.5 ** 3, rtol=1e-5)
+        np.testing.assert_allclose(g2.numpy(), 12 * 1.5 ** 2, rtol=1e-5)
+        np.testing.assert_allclose(g3.numpy(), 24 * 1.5, rtol=1e-5)
+
+
+def test_gradient_penalty_through_layer():
+    """WGAN-GP pattern: penalty on dD/dx backprops into D's parameters."""
+    with dygraph.guard():
+        lin = dygraph.Linear(3, 1)
+        xv = dygraph.to_variable(
+            np.random.RandomState(0).rand(4, 3).astype("float32"))
+        out = lin(xv)
+        (gx,) = dygraph.grad(out, xv, create_graph=True)
+        sq = gx * gx
+        s = V.apply_op(lambda a: a.sum(), sq)
+        s.backward()
+        w = lin.parameters()[0]
+        # D linear => dD/dx = w per row => penalty = 4*sum(w^2), d/dw = 8w
+        np.testing.assert_allclose(w.gradient().reshape(-1),
+                                   8 * w.numpy().reshape(-1), rtol=1e-4)
+
+
+def test_grad_outputs_seed():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.0, 2.0], np.float32))
+        y = x * x
+        seed = dygraph.to_variable(np.array([3.0, 0.5], np.float32))
+        (gx,) = dygraph.grad(y, x, grad_outputs=[seed])
+        np.testing.assert_allclose(gx.numpy(), [2 * 1 * 3, 2 * 2 * 0.5])
+
+
+def test_double_grad_unary_chain():
+    """Unary ops (single differentiable input) inside a create_graph chain —
+    regression: 1-tuple cotangent structure mismatch crashed the 2nd sweep."""
+    import jax.numpy as jnp
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([0.3, -0.4], np.float32))
+        y = V.apply_op(jnp.tanh, x)
+        (gx,) = dygraph.grad(y, x, create_graph=True)
+        s = V.apply_op(lambda g: g.sum(), gx)
+        (ggx,) = dygraph.grad(s, x)
+        # d2 tanh/dx2 = -2 tanh(x) (1 - tanh(x)^2)
+        t = np.tanh([0.3, -0.4])
+        np.testing.assert_allclose(ggx.numpy(), -2 * t * (1 - t * t),
+                                   rtol=1e-5)
+
+
+def test_create_graph_uses_recorded_values():
+    """set_value between forward and the create_graph sweep must not change
+    recorded gradients (regression: sweep re-read current .value)."""
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([3.0, 7.0], np.float32))
+        w = dygraph.to_variable(np.array([2.0, 5.0], np.float32))
+        y = x * w
+        w.set_value(np.array([100.0, 100.0], np.float32))
+        (gx,) = dygraph.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [2.0, 5.0])
+        np.testing.assert_allclose(w.numpy(), [100.0, 100.0])  # restored
+
+
+def test_allow_unused():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.0], np.float32))
+        z = dygraph.to_variable(np.array([1.0], np.float32))
+        y = x * x
+        import pytest
+        with pytest.raises(ValueError):
+            dygraph.grad(y, z, retain_graph=True)
+        (gz,) = dygraph.grad(y, z, allow_unused=True)
+        assert gz is None
