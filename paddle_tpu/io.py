@@ -2,9 +2,13 @@
 (save_vars:224, save_persistables:598, load_vars:667, load_persistables:902,
 save_inference_model:1093, load_inference_model:1303, save:1598, load:1662).
 
-The reference serializes each LoDTensor through save/load *ops*; here tensors
-are jax.Arrays in the Scope, serialized as one .npz per save call plus a JSON
-program desc (see framework/serialization.py for the desc format). Orbax-style
+Artifacts use the reference's on-disk formats so models interchange with it:
+`__model__` is a binary proto2 ProgramDesc (framework/framework.proto) with
+feed/fetch ops appended exactly like the reference's save_inference_model;
+params are LoDTensor streams (tensor_util.cc TensorToStream) — one file per
+var, or one save_combine stream (sorted by name) when a filename is given.
+The codec lives in framework/paddle_pb.py; legacy JSON/.npz artifacts from
+earlier versions of this repo still load (format is sniffed). Orbax-style
 async sharded checkpointing for the distributed path lives in
 parallel/checkpoint.py.
 """
@@ -16,6 +20,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from .framework import paddle_pb
+from .framework.core import VarType
 from .framework.executor import Executor, Scope, global_scope
 from .framework.program import Program, Variable, default_main_program
 from .framework.serialization import program_from_desc, program_to_desc
@@ -35,15 +41,7 @@ def _scope_np(scope: Scope, name: str):
     return arr
 
 
-def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
-              filename=None):
-    main_program = main_program or default_main_program()
-    if vars is None:
-        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
-    scope = global_scope()
-    os.makedirs(dirname, exist_ok=True)
-    if filename is None:
-        filename = "__params__"
+def _gather_payload(scope, vars):
     payload = {}
     for v in vars:
         name = v.name if isinstance(v, Variable) else v
@@ -53,7 +51,27 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         if str(arr.dtype) == "bfloat16":
             arr = arr.astype(np.float32)
         payload[name] = arr
-    np.savez(os.path.join(dirname, filename + ".npz"), **payload)
+    return payload
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    """filename=None saves one reference-format tensor file per var (the
+    reference's per-var `save` ops); a filename saves one save_combine stream
+    with vars in sorted-name order (reference io.py save_vars)."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    payload = _gather_payload(scope, vars)
+    if filename is None:
+        for name, arr in payload.items():
+            paddle_pb.save_tensor_file(os.path.join(dirname, name), arr)
+    else:
+        names = sorted(payload)
+        paddle_pb.save_combine(os.path.join(dirname, filename),
+                               [(n, payload[n]) for n in names])
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
@@ -61,22 +79,43 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     main_program = main_program or default_main_program()
     if vars is None:
         vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
-    if filename is None:
-        filename = "__params__"
-    path = os.path.join(dirname, filename + ".npz")
-    data = np.load(path)
     scope = global_scope()
     import jax.numpy as jnp
 
     by_name = {(v.name if isinstance(v, Variable) else v): v for v in vars}
-    for name in data.files:
-        if name not in by_name:
-            continue
-        arr = data[name]
-        var = by_name[name]
+
+    def _put(name, arr):
+        var = by_name.get(name)
+        if var is None:
+            return
         if isinstance(var, Variable) and var.dtype == "bfloat16":
             arr = jnp.asarray(arr).astype(jnp.bfloat16)
         scope.set_var(name, jnp.asarray(arr))
+
+    legacy = os.path.join(dirname, (filename or "__params__") + ".npz")
+    if os.path.exists(legacy):
+        data = np.load(legacy)
+        for name in data.files:
+            _put(name, data[name])
+        return
+    if filename is None:
+        missing = []
+        for name in by_name:
+            path = os.path.join(dirname, name)
+            if os.path.exists(path):
+                _put(name, paddle_pb.load_tensor_file(path))
+            else:
+                missing.append(name)
+        if missing and len(missing) == len(by_name):
+            raise FileNotFoundError(
+                f"no saved tensors for any of {sorted(by_name)} under {dirname}")
+    else:
+        path = os.path.join(dirname, filename)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        names = sorted(by_name)
+        for name, arr in paddle_pb.load_combine(path, names).items():
+            _put(name, arr)
 
 
 def _is_persistable(v: Variable) -> bool:
@@ -120,24 +159,73 @@ def save_inference_model(dirname, feeded_var_names: List[str], target_vars,
                            [v.name for v in target_vars])
     os.makedirs(dirname, exist_ok=True)
     desc = program_to_desc(pruned)
-    desc["_feed_names"] = list(feeded_var_names)
-    desc["_fetch_names"] = [v.name for v in target_vars]
+    _append_feed_fetch_descs(desc, list(feeded_var_names),
+                             [v.name for v in target_vars])
     model_filename = model_filename or "__model__"
-    with open(os.path.join(dirname, model_filename), "w") as f:
-        json.dump(desc, f)
+    with open(os.path.join(dirname, model_filename), "wb") as f:
+        f.write(paddle_pb.desc_to_pb(desc))
     if not program_only:
         save_persistables(executor, dirname, pruned, filename=params_filename)
     return [v.name for v in target_vars]
 
 
+def _append_feed_fetch_descs(desc, feed_names, fetch_names):
+    """Mirror the reference save_inference_model (io.py:1093): prepend feed
+    ops reading columns of the FEED_MINIBATCH var 'feed', append fetch ops
+    writing columns of the FETCH_LIST var 'fetch'."""
+    block = desc["blocks"][0]
+    block["vars"].append({"name": "feed", "shape": [], "dtype": "float32",
+                          "type": int(VarType.FEED_MINIBATCH),
+                          "persistable": True, "stop_gradient": True,
+                          "is_data": False})
+    block["vars"].append({"name": "fetch", "shape": [], "dtype": "float32",
+                          "type": int(VarType.FETCH_LIST),
+                          "persistable": True, "stop_gradient": True,
+                          "is_data": False})
+    feed_ops = [{"type": "feed", "inputs": {"X": ["feed"]},
+                 "outputs": {"Out": [name]}, "attrs": {"col": i}}
+                for i, name in enumerate(feed_names)]
+    fetch_ops = [{"type": "fetch", "inputs": {"X": [name]},
+                  "outputs": {"Out": ["fetch"]}, "attrs": {"col": i}}
+                 for i, name in enumerate(fetch_names)]
+    block["ops"] = feed_ops + block["ops"] + fetch_ops
+
+
+def _strip_feed_fetch_descs(desc):
+    """Inverse of _append_feed_fetch_descs, applied on load (our executor
+    feeds/fetches by name, without feed/fetch ops)."""
+    feed_names, fetch_names = [], []
+    for block in desc["blocks"]:
+        kept = []
+        for op in block["ops"]:
+            if op["type"] == "feed":
+                feed_names.append((op["attrs"].get("col", len(feed_names)),
+                                   op["outputs"]["Out"][0]))
+            elif op["type"] == "fetch":
+                fetch_names.append((op["attrs"].get("col", len(fetch_names)),
+                                    op["inputs"]["X"][0]))
+            else:
+                kept.append(op)
+        block["ops"] = kept
+        block["vars"] = [v for v in block["vars"]
+                         if v["name"] not in ("feed", "fetch")]
+    return ([n for _, n in sorted(feed_names)],
+            [n for _, n in sorted(fetch_names)])
+
+
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     model_filename = model_filename or "__model__"
-    with open(os.path.join(dirname, model_filename)) as f:
-        desc = json.load(f)
+    with open(os.path.join(dirname, model_filename), "rb") as f:
+        raw = f.read()
+    if raw[:1] == b"{":  # legacy JSON artifact
+        desc = json.loads(raw.decode("utf-8"))
+        feed_names = desc.get("_feed_names", [])
+        fetch_names = desc.get("_fetch_names", [])
+    else:
+        desc = paddle_pb.desc_from_pb(raw)
+        feed_names, fetch_names = _strip_feed_fetch_descs(desc)
     program = program_from_desc(desc)
-    feed_names = desc.get("_feed_names", [])
-    fetch_names = desc.get("_fetch_names", [])
     try:
         load_persistables(executor, dirname, program, filename=params_filename)
     except FileNotFoundError:
@@ -147,29 +235,39 @@ def load_inference_model(dirname, executor, model_filename=None,
 
 
 def save(program: Program, model_path: str):
-    """Single-file program+params save (fluid.io.save:1598)."""
+    """Single-file program+params save (fluid.io.save:1598): .pdmodel is the
+    binary ProgramDesc, .pdparams a save_combine stream sorted by name."""
     os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
-    with open(model_path + ".pdmodel", "w") as f:
-        json.dump(program_to_desc(program), f)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(paddle_pb.desc_to_pb(program_to_desc(program)))
     scope = global_scope()
-    payload = {}
-    for v in program.list_vars():
-        if v.persistable:
-            arr = _scope_np(scope, v.name)
-            if arr is not None:
-                payload[v.name] = arr
-    np.savez(model_path + ".pdparams.npz", **payload)
+    payload = _gather_payload(scope, [v for v in program.list_vars()
+                                      if v.persistable])
+    names = sorted(payload)
+    paddle_pb.save_combine(model_path + ".pdparams",
+                           [(n, payload[n]) for n in names])
 
 
 def load(program: Program, model_path: str, executor=None, var_list=None):
     import jax.numpy as jnp
 
-    data = np.load(model_path + ".pdparams.npz")
     scope = global_scope()
     names = {v.name for v in (var_list or program.list_vars())}
-    for name in data.files:
+    legacy = model_path + ".pdparams.npz"
+    if os.path.exists(legacy):
+        data = np.load(legacy)
+        for name in data.files:
+            if name in names:
+                scope.set_var(name, jnp.asarray(data[name]))
+        return
+    persistable = {v.name: v for v in program.list_vars() if v.persistable}
+    for name, arr in paddle_pb.load_combine(model_path + ".pdparams",
+                                            sorted(persistable)).items():
         if name in names:
-            scope.set_var(name, jnp.asarray(data[name]))
+            out = jnp.asarray(arr)
+            if persistable[name].dtype == "bfloat16":
+                out = out.astype(jnp.bfloat16)
+            scope.set_var(name, out)
 
 
 def get_program_state(program: Optional[Program] = None):

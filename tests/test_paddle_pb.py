@@ -1,0 +1,363 @@
+"""Protobuf ProgramDesc + reference tensor-format interchange.
+
+Validates the hand-rolled proto2 codec (framework/paddle_pb.py) three ways:
+1. desc-dict -> wire -> desc-dict round trip on a real trained program;
+2. wire compatibility against an *independently constructed*
+   google.protobuf dynamic descriptor of framework.proto's schema
+   (encode-with-ours/decode-with-protobuf and the reverse);
+3. LoDTensor stream / save_combine round trips, and a full
+   save_inference_model -> load_inference_model -> run parity check.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import paddle_pb
+from paddle_tpu.framework.core import VarType
+from paddle_tpu.framework.serialization import program_from_desc, program_to_desc
+
+
+def _build_program():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        pred = fluid.layers.fc(h, size=3, act="softmax")
+    return prog, startup, pred, None
+
+
+# ---------------------------------------------------------------------------
+# 1. round trip
+# ---------------------------------------------------------------------------
+
+def test_desc_pb_round_trip():
+    prog, _, _, _ = _build_program()
+    desc = program_to_desc(prog)
+    data = paddle_pb.desc_to_pb(desc)
+    back = paddle_pb.desc_from_pb(data)
+    assert len(back["blocks"]) == len(desc["blocks"])
+    b0, r0 = desc["blocks"][0], back["blocks"][0]
+    assert [op["type"] for op in r0["ops"]] == [op["type"] for op in b0["ops"]]
+    for op, rop in zip(b0["ops"], r0["ops"]):
+        assert rop["inputs"] == {k: list(v) for k, v in op["inputs"].items()}
+        assert rop["outputs"] == {k: list(v) for k, v in op["outputs"].items()}
+        for name, val in op["attrs"].items():
+            if val is None:
+                continue
+            rv = rop["attrs"][name]
+            if isinstance(val, float):
+                assert rv == pytest.approx(val, rel=1e-6)
+            elif isinstance(val, (list, tuple)) and val and isinstance(val[0], float):
+                assert rv == pytest.approx(list(val), rel=1e-6)
+            else:
+                assert rv == (list(val) if isinstance(val, tuple) else val)
+    vars0 = {v["name"]: v for v in b0["vars"]}
+    for rv in r0["vars"]:
+        v = vars0[rv["name"]]
+        assert rv["persistable"] == v["persistable"]
+        assert list(rv["shape"]) == list(v["shape"])
+        assert rv["dtype"] == v["dtype"]
+
+    rebuilt = program_from_desc(back)
+    assert [op.type for op in rebuilt.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+
+
+def test_attr_types_round_trip():
+    desc = {"blocks": [{"idx": 0, "parent_idx": -1, "vars": [], "ops": [{
+        "type": "dummy",
+        "inputs": {"X": ["a", "b"]},
+        "outputs": {"Out": ["c"]},
+        "attrs": {
+            "i32": 7, "i32neg": -3, "i64": 1 << 40, "f": 0.5, "s": "hello",
+            "ints": [1, -2, 3], "floats": [0.25, -1.5], "strings": ["p", "q"],
+            "flag": True, "flags": [True, False, True],
+            "sub_block": 2, "longs": [1 << 40, -(1 << 40)],
+            "empty": [],
+        }}], "forward_block_idx": -1}]}
+    back = paddle_pb.desc_from_pb(paddle_pb.desc_to_pb(desc))
+    attrs = back["blocks"][0]["ops"][0]["attrs"]
+    assert attrs["i32"] == 7 and attrs["i32neg"] == -3
+    assert attrs["i64"] == 1 << 40
+    assert attrs["f"] == pytest.approx(0.5)
+    assert attrs["s"] == "hello"
+    assert attrs["ints"] == [1, -2, 3]
+    assert attrs["floats"] == pytest.approx([0.25, -1.5])
+    assert attrs["strings"] == ["p", "q"]
+    assert attrs["flag"] is True
+    assert attrs["flags"] == [True, False, True]
+    assert attrs["sub_block"] == 2
+    assert attrs["longs"] == [1 << 40, -(1 << 40)]
+    assert attrs["empty"] == []
+
+
+# ---------------------------------------------------------------------------
+# 2. wire compatibility vs google.protobuf dynamic schema
+# ---------------------------------------------------------------------------
+
+def _make_dynamic_schema():
+    """Rebuild framework.proto's message graph programmatically (field
+    numbers per /root/reference/paddle/fluid/framework/framework.proto) and
+    return {message_name: generated class}."""
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "pd_check.proto"
+    fdp.package = "pdcheck"
+    fdp.syntax = "proto2"
+
+    F = descriptor_pb2.FieldDescriptorProto
+
+    attr_enum = fdp.enum_type.add()
+    attr_enum.name = "AttrType"
+    for i, n in enumerate(["INT", "FLOAT", "STRING", "INTS", "FLOATS",
+                           "STRINGS", "BOOLEAN", "BOOLEANS", "BLOCK", "LONG",
+                           "BLOCKS", "LONGS"]):
+        v = attr_enum.value.add(); v.name = n; v.number = i
+
+    def msg(name):
+        m = fdp.message_type.add(); m.name = name; return m
+
+    def field(m, name, num, ftype, label=F.LABEL_OPTIONAL, type_name=None):
+        f = m.field.add()
+        f.name, f.number, f.type, f.label = name, num, ftype, label
+        if type_name:
+            f.type_name = ".pdcheck." + type_name
+        return f
+
+    version = msg("Version")
+    field(version, "version", 1, F.TYPE_INT64)
+
+    vartype = msg("VarType")
+    type_enum = vartype.enum_type.add(); type_enum.name = "Type"
+    for n, i in [("BOOL", 0), ("INT16", 1), ("INT32", 2), ("INT64", 3),
+                 ("FP16", 4), ("FP32", 5), ("FP64", 6), ("LOD_TENSOR", 7),
+                 ("SELECTED_ROWS", 8), ("FEED_MINIBATCH", 9),
+                 ("FETCH_LIST", 10), ("STEP_SCOPES", 11),
+                 ("LOD_RANK_TABLE", 12), ("LOD_TENSOR_ARRAY", 13),
+                 ("PLACE_LIST", 14), ("READER", 15), ("RAW", 17),
+                 ("TUPLE", 18), ("SIZE_T", 19), ("UINT8", 20), ("INT8", 21)]:
+        v = type_enum.value.add(); v.name = n; v.number = i
+    td = vartype.nested_type.add(); td.name = "TensorDesc"
+    f = td.field.add(); f.name, f.number, f.type, f.label = \
+        "data_type", 1, F.TYPE_ENUM, F.LABEL_REQUIRED
+    f.type_name = ".pdcheck.VarType.Type"
+    f = td.field.add(); f.name, f.number, f.type, f.label = \
+        "dims", 2, F.TYPE_INT64, F.LABEL_REPEATED
+    ltd = vartype.nested_type.add(); ltd.name = "LoDTensorDesc"
+    f = ltd.field.add(); f.name, f.number, f.type, f.label = \
+        "tensor", 1, F.TYPE_MESSAGE, F.LABEL_REQUIRED
+    f.type_name = ".pdcheck.VarType.TensorDesc"
+    f = ltd.field.add(); f.name, f.number, f.type = "lod_level", 2, F.TYPE_INT32
+    f = vartype.field.add(); f.name, f.number, f.type, f.label = \
+        "type", 1, F.TYPE_ENUM, F.LABEL_REQUIRED
+    f.type_name = ".pdcheck.VarType.Type"
+    f = vartype.field.add(); f.name, f.number, f.type = \
+        "selected_rows", 2, F.TYPE_MESSAGE
+    f.type_name = ".pdcheck.VarType.TensorDesc"
+    f = vartype.field.add(); f.name, f.number, f.type = \
+        "lod_tensor", 3, F.TYPE_MESSAGE
+    f.type_name = ".pdcheck.VarType.LoDTensorDesc"
+    f = vartype.field.add(); f.name, f.number, f.type = \
+        "tensor_array", 4, F.TYPE_MESSAGE
+    f.type_name = ".pdcheck.VarType.LoDTensorDesc"
+
+    vardesc = msg("VarDesc")
+    field(vardesc, "name", 1, F.TYPE_STRING, F.LABEL_REQUIRED)
+    field(vardesc, "type", 2, F.TYPE_MESSAGE, F.LABEL_REQUIRED, "VarType")
+    field(vardesc, "persistable", 3, F.TYPE_BOOL)
+    field(vardesc, "need_check_feed", 4, F.TYPE_BOOL)
+
+    opdesc = msg("OpDesc")
+    attr = opdesc.nested_type.add(); attr.name = "Attr"
+    for name, num, ftype, label in [
+            ("name", 1, F.TYPE_STRING, F.LABEL_REQUIRED),
+            ("i", 3, F.TYPE_INT32, F.LABEL_OPTIONAL),
+            ("f", 4, F.TYPE_FLOAT, F.LABEL_OPTIONAL),
+            ("s", 5, F.TYPE_STRING, F.LABEL_OPTIONAL),
+            ("ints", 6, F.TYPE_INT32, F.LABEL_REPEATED),
+            ("floats", 7, F.TYPE_FLOAT, F.LABEL_REPEATED),
+            ("strings", 8, F.TYPE_STRING, F.LABEL_REPEATED),
+            ("b", 10, F.TYPE_BOOL, F.LABEL_OPTIONAL),
+            ("bools", 11, F.TYPE_BOOL, F.LABEL_REPEATED),
+            ("block_idx", 12, F.TYPE_INT32, F.LABEL_OPTIONAL),
+            ("l", 13, F.TYPE_INT64, F.LABEL_OPTIONAL),
+            ("blocks_idx", 14, F.TYPE_INT32, F.LABEL_REPEATED),
+            ("longs", 15, F.TYPE_INT64, F.LABEL_REPEATED)]:
+        f = attr.field.add()
+        f.name, f.number, f.type, f.label = name, num, ftype, label
+    f = attr.field.add(); f.name, f.number, f.type, f.label = \
+        "type", 2, F.TYPE_ENUM, F.LABEL_REQUIRED
+    f.type_name = ".pdcheck.AttrType"
+    var = opdesc.nested_type.add(); var.name = "Var"
+    f = var.field.add(); f.name, f.number, f.type, f.label = \
+        "parameter", 1, F.TYPE_STRING, F.LABEL_REQUIRED
+    f = var.field.add(); f.name, f.number, f.type, f.label = \
+        "arguments", 2, F.TYPE_STRING, F.LABEL_REPEATED
+    field(opdesc, "inputs", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED, "OpDesc.Var")
+    field(opdesc, "outputs", 2, F.TYPE_MESSAGE, F.LABEL_REPEATED, "OpDesc.Var")
+    field(opdesc, "type", 3, F.TYPE_STRING, F.LABEL_REQUIRED)
+    field(opdesc, "attrs", 4, F.TYPE_MESSAGE, F.LABEL_REPEATED, "OpDesc.Attr")
+    field(opdesc, "is_target", 5, F.TYPE_BOOL)
+
+    blockdesc = msg("BlockDesc")
+    field(blockdesc, "idx", 1, F.TYPE_INT32, F.LABEL_REQUIRED)
+    field(blockdesc, "parent_idx", 2, F.TYPE_INT32, F.LABEL_REQUIRED)
+    field(blockdesc, "vars", 3, F.TYPE_MESSAGE, F.LABEL_REPEATED, "VarDesc")
+    field(blockdesc, "ops", 4, F.TYPE_MESSAGE, F.LABEL_REPEATED, "OpDesc")
+    field(blockdesc, "forward_block_idx", 5, F.TYPE_INT32)
+
+    progdesc = msg("ProgramDesc")
+    field(progdesc, "blocks", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED, "BlockDesc")
+    field(progdesc, "version", 4, F.TYPE_MESSAGE, F.LABEL_OPTIONAL, "Version")
+
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    out = {}
+    for name in ["ProgramDesc", "BlockDesc", "OpDesc", "VarDesc", "VarType"]:
+        out[name] = message_factory.GetMessageClass(
+            pool.FindMessageTypeByName("pdcheck." + name))
+    return out
+
+
+def test_wire_compat_with_protobuf():
+    schema = _make_dynamic_schema()
+    prog, _, _, _ = _build_program()
+    desc = program_to_desc(prog)
+    data = paddle_pb.desc_to_pb(desc)
+
+    # ours -> protobuf
+    msg = schema["ProgramDesc"]()
+    msg.ParseFromString(data)
+    assert len(msg.blocks) == len(desc["blocks"])
+    b0 = msg.blocks[0]
+    assert [op.type for op in b0.ops] == \
+        [op["type"] for op in desc["blocks"][0]["ops"]]
+    by_name = {v.name: v for v in b0.vars}
+    for vdesc in desc["blocks"][0]["vars"]:
+        v = by_name[vdesc["name"]]
+        assert v.persistable == bool(vdesc["persistable"])
+        got_dims = list(v.type.lod_tensor.tensor.dims)
+        assert got_dims == [int(d) for d in vdesc["shape"]]
+
+    # protobuf -> ours (protobuf's serializer orders fields by number)
+    rewire = msg.SerializeToString()
+    back = paddle_pb.desc_from_pb(rewire)
+    assert [op["type"] for op in back["blocks"][0]["ops"]] == \
+        [op["type"] for op in desc["blocks"][0]["ops"]]
+    b0_attrs = {op["type"]: op["attrs"] for op in back["blocks"][0]["ops"]}
+    orig_attrs = {op["type"]: op["attrs"] for op in desc["blocks"][0]["ops"]}
+    for ty, attrs in orig_attrs.items():
+        for name, val in attrs.items():
+            if val is None:
+                continue
+            got = b0_attrs[ty][name]
+            if isinstance(val, float):
+                assert got == pytest.approx(val, rel=1e-6)
+            elif isinstance(val, (list, tuple)) and val and \
+                    isinstance(val[0], float):
+                assert got == pytest.approx(list(val), rel=1e-6)
+            else:
+                assert got == (list(val) if isinstance(val, tuple) else val)
+
+
+# ---------------------------------------------------------------------------
+# 3. tensor streams + end-to-end artifacts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "int64",
+                                   "uint8", "bool", "float16"])
+def test_tensor_stream_round_trip(dtype):
+    rng = np.random.RandomState(0)
+    if dtype == "bool":
+        arr = rng.rand(3, 5) > 0.5
+    elif "int" in dtype:
+        arr = rng.randint(0, 100, size=(3, 5)).astype(dtype)
+    else:
+        arr = rng.randn(3, 5).astype(dtype)
+    data = paddle_pb.tensor_to_stream(arr, lod=[[0, 2, 3]])
+    back, lod, end = paddle_pb.tensor_from_stream(data)
+    assert end == len(data)
+    assert lod == [[0, 2, 3]]
+    assert back.dtype == arr.dtype
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_save_combine_round_trip(tmp_path):
+    arrs = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.array([1.5, -2.5], dtype=np.float32)}
+    path = str(tmp_path / "combined")
+    paddle_pb.save_combine(path, sorted(arrs.items()))
+    out = paddle_pb.load_combine(path, sorted(arrs))
+    for name in arrs:
+        np.testing.assert_array_equal(out[name], arrs[name])
+    with pytest.raises(ValueError):
+        paddle_pb.load_combine(path, ["b"])  # trailing bytes -> name mismatch
+
+
+def test_inference_model_pb_round_trip(tmp_path):
+    prog, startup, pred, _ = _build_program()
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+    x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+    before = exe.run(prog, feed={"x": x}, fetch_list=[pred])[0]
+
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [pred], exe, prog)
+
+    raw = open(os.path.join(model_dir, "__model__"), "rb").read()
+    assert raw[:1] != b"{", "model file must be binary protobuf, not JSON"
+    desc = paddle_pb.desc_from_pb(raw)
+    op_types = [op["type"] for op in desc["blocks"][0]["ops"]]
+    assert op_types[0] == "feed" and op_types[-1] == "fetch"
+    var_types = {v["name"]: v["type"] for v in desc["blocks"][0]["vars"]}
+    assert var_types["feed"] == int(VarType.FEED_MINIBATCH)
+    assert var_types["fetch"] == int(VarType.FETCH_LIST)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(place)
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            model_dir, exe2)
+        assert feed_names == ["x"]
+        after = exe2.run(program, feed={"x": x}, fetch_list=fetch_vars)[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_combined_params_file(tmp_path):
+    prog, startup, pred, _ = _build_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+    before = exe.run(prog, feed={"x": x}, fetch_list=[pred])[0]
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [pred], exe, prog,
+                                  params_filename="__params__")
+    assert os.path.exists(os.path.join(model_dir, "__params__"))
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            model_dir, exe2, params_filename="__params__")
+        after = exe2.run(program, feed={"x": x}, fetch_list=fetch_vars)[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_single_file_save_load(tmp_path):
+    prog, startup, pred, _ = _build_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.random.RandomState(3).randn(3, 4).astype(np.float32)
+    before = exe.run(prog, feed={"x": x}, fetch_list=[pred])[0]
+    path = str(tmp_path / "ckpt" / "model")
+    fluid.io.save(prog, path)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.io.load(prog, path)
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        after = exe2.run(prog, feed={"x": x}, fetch_list=[pred])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
