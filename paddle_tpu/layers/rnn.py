@@ -9,7 +9,24 @@ import numpy as np
 from ..framework.layer_helper import LayerHelper
 from ..ops.rnn import lstm_blob_size
 
-__all__ = ["lstm", "gru"]
+__all__ = ["lstm", "gru", "beam_search", "beam_search_decode"]
+
+
+def _derived_attr(attr, suffix):
+    """A layer with several parameters must not reuse one explicit
+    ParamAttr name for all of them; derive '<name>.<suffix>' per param."""
+    from ..framework.param_attr import ParamAttr
+
+    if attr is None or not isinstance(attr, (str, ParamAttr)):
+        return attr
+    attr = ParamAttr._to_attr(attr)
+    if attr.name is None:
+        return attr
+    import copy
+
+    out = copy.copy(attr)
+    out.name = f"{attr.name}.{suffix}"
+    return out
 
 
 def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
@@ -21,12 +38,11 @@ def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
     input: [B, T, D]; init_h/init_c: [num_layers, B, hidden_size].
     Returns (out [B,T,H], last_h, last_c).
     """
-    if is_bidirec:
-        raise NotImplementedError("bidirectional lstm: pending")
     assert hidden_size is not None
     helper = LayerHelper("lstm", param_attr=param_attr, name=name)
     d = input.shape[-1]
-    blob = lstm_blob_size(d, hidden_size, num_layers)
+    blob = lstm_blob_size(d, hidden_size, num_layers,
+                          num_directions=2 if is_bidirec else 1)
     from ..framework.initializer import UniformInitializer
     import math
     k = 1.0 / math.sqrt(hidden_size)
@@ -44,7 +60,8 @@ def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
         outputs={"Out": [out.name], "LastH": [last_h.name],
                  "LastC": [last_c.name]},
         attrs={"num_layers": num_layers, "hidden_size": hidden_size,
-               "dropout_prob": dropout_prob, "is_test": is_test})
+               "dropout_prob": dropout_prob, "is_test": is_test,
+               "is_bidirec": is_bidirec})
     return out, last_h, last_c
 
 
@@ -58,11 +75,14 @@ def gru(input, hidden_size: int, init_h=None, sequence_length=None,
     import math
     k = 1.0 / math.sqrt(hidden_size)
     init = UniformInitializer(-k, k)
-    wx = helper.create_parameter(param_attr, shape=[d, 3 * hidden_size],
+    wx = helper.create_parameter(_derived_attr(param_attr, "wx"),
+                                 shape=[d, 3 * hidden_size],
                                  dtype=input.dtype, default_initializer=init)
-    wh = helper.create_parameter(param_attr, shape=[hidden_size, 3 * hidden_size],
+    wh = helper.create_parameter(_derived_attr(param_attr, "wh"),
+                                 shape=[hidden_size, 3 * hidden_size],
                                  dtype=input.dtype, default_initializer=init)
-    b = helper.create_parameter(bias_attr, shape=[3 * hidden_size],
+    b = helper.create_parameter(_derived_attr(bias_attr, "b"),
+                                shape=[3 * hidden_size],
                                 dtype=input.dtype, is_bias=True)
     if init_h is None:
         raise ValueError("gru requires init_h (shape [B, hidden_size])")
@@ -77,3 +97,52 @@ def gru(input, hidden_size: int, init_h=None, sequence_length=None,
         outputs={"Out": [out.name], "LastH": [last_h.name]},
         attrs={})
     return out, last_h
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """fluid.layers.beam_search (reference layers/rnn.py:2880 /
+    operators/beam_search_op.cc) — dense TPU formulation; see
+    ops/beam_search.py for the state-layout conventions. `ids` is accepted
+    for API parity and unused (token ids are implied by the vocab axis)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference("float32")
+    parent_idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "scores": [scores]},
+        outputs={"selected_ids": [sel_ids.name],
+                 "selected_scores": [sel_scores.name],
+                 "parent_idx": [parent_idx.name]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id),
+               "level": int(level), "is_accumulated": bool(is_accumulated)})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, parent_idx=None,
+                       name=None):
+    """fluid.layers.beam_search_decode (beam_search_decode_op.cc).
+
+    ids/scores/parent_idx are LoDTensorArray vars filled by array_write at
+    each decode step; parent_idx is required in the dense formulation (the
+    reference recovers parents from LoD instead).
+    """
+    if parent_idx is None:
+        raise ValueError(
+            "beam_search_decode requires the parent_idx tensor array "
+            "(collect beam_search(..., return_parent_idx=True) outputs)")
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference("int64")
+    sent_scores = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores], "ParentIdx": [parent_idx]},
+        outputs={"SentenceIds": [sent_ids.name],
+                 "SentenceScores": [sent_scores.name]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id)})
+    return sent_ids, sent_scores

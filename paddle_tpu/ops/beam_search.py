@@ -1,0 +1,129 @@
+"""Beam search ops — TPU-native dense formulation.
+
+Reference: /root/reference/paddle/fluid/operators/beam_search_op.cc (LoD-based
+candidate selection per beam) and beam_search_decode_op.cc (LoD backtracking).
+The reference threads ragged LoD tensors through every step; on XLA static
+shapes we keep the beam state dense instead:
+
+- state layout is ``[batch * beam_size, 1]`` for ids/scores, row-major by
+  batch then beam (row ``b*beam_size + k`` is beam ``k`` of batch ``b``);
+- step 0 uses the standard dense convention: every batch's beams hold the
+  start token and ``pre_scores`` is ``[0, -1e4, -1e4, ...]`` per batch so the
+  duplicated start beams cannot all win top-k (the reference encodes the same
+  fact as LoD ``[[0,1,...,batch]]``). Use a dead-beam sentinel like ``-1e4``
+  that still accumulates additively in float32 — ``-1e9 + logp`` rounds back
+  to ``-1e9`` and destroys the ordering among dead beams;
+- finished beams (``pre_id == end_id``) propose exactly one candidate — the
+  end token with their frozen accumulated score — matching the reference's
+  ended-hypothesis handling;
+- ``parent_idx`` carries global row indices into the previous state, which is
+  what beam_search_decode backtracks through (the reference encodes parents
+  in the output LoD instead).
+
+Everything is lax-friendly: one top_k over [batch, beam*vocab] per step, no
+data-dependent shapes, usable inside lax.while_loop/scan or a host loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+_NEG_INF = -1e9
+
+
+def beam_search_step(pre_ids, pre_scores, scores, beam_size, end_id,
+                     is_accumulated=True):
+    """Pure-jax single step. Shapes: pre_ids/pre_scores [B*K, 1],
+    scores [B*K, V]. Returns (selected_ids [B*K,1], selected_scores [B*K,1],
+    parent_idx [B*K])."""
+    bk, vocab = scores.shape
+    beam = int(beam_size)
+    batch = bk // beam
+    pre_ids = pre_ids.reshape(batch, beam)
+    pre_scores = pre_scores.astype(jnp.float32).reshape(batch, beam)
+    scores = scores.astype(jnp.float32).reshape(batch, beam, vocab)
+
+    if not is_accumulated:
+        scores = jnp.log(jnp.maximum(scores, 1e-20)) + pre_scores[..., None]
+
+    finished = pre_ids == end_id  # [batch, beam]
+    # A finished beam proposes only (end_id, frozen score); a live beam
+    # proposes its full vocab row.
+    end_onehot = jax.nn.one_hot(end_id, vocab, dtype=jnp.bool_)  # [V]
+    candidate = jnp.where(
+        finished[..., None],
+        jnp.where(end_onehot, pre_scores[..., None], _NEG_INF),
+        scores,
+    )  # [batch, beam, V]
+
+    flat = candidate.reshape(batch, beam * vocab)
+    top_scores, top_idx = jax.lax.top_k(flat, beam)  # [batch, beam]
+    beam_idx = top_idx // vocab
+    token_idx = top_idx % vocab
+    batch_base = jnp.arange(batch, dtype=beam_idx.dtype)[:, None] * beam
+    parent = (batch_base + beam_idx).reshape(-1)
+    sel_ids = token_idx.astype(pre_ids.dtype).reshape(-1, 1)
+    sel_scores = top_scores.reshape(-1, 1)
+    return sel_ids, sel_scores, parent
+
+
+@register_op("beam_search", grad=None)
+def _beam_search(ctx, op, ins):
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    sel_ids, sel_scores, parent = beam_search_step(
+        pre_ids, pre_scores, scores,
+        beam_size=int(op.attr("beam_size")),
+        end_id=int(op.attr("end_id")),
+        is_accumulated=bool(op.attr("is_accumulated", True)),
+    )
+    return {"selected_ids": sel_ids, "selected_scores": sel_scores,
+            "parent_idx": parent}
+
+
+def beam_search_backtrack(step_ids, step_scores, step_parents, end_id):
+    """Pure-jax decode. step_ids/step_scores: [T, B*K, 1]; step_parents
+    [T, B*K]. Returns (sentences [B*K, T], final_scores [B*K, 1]).
+
+    Walks parent pointers from the last step backwards (a reverse lax.scan),
+    the dense equivalent of beam_search_decode_op.cc's LoD tree walk. Tokens
+    after a sequence's end_id are filled with end_id.
+    """
+    step_ids = jnp.asarray(step_ids)
+    step_scores = jnp.asarray(step_scores)
+    step_parents = jnp.asarray(step_parents)
+    T, bk = step_ids.shape[0], step_ids.shape[1]
+    ids = step_ids.reshape(T, bk)
+    parents = step_parents.reshape(T, bk)
+
+    def back(row, t):
+        # row: [bk] current row index per final beam, at step t+1
+        tok = ids[t][row]
+        prev = parents[t][row]
+        return prev, tok
+
+    last = jnp.arange(bk)
+    _, toks = jax.lax.scan(back, last, jnp.arange(T - 1, -1, -1))
+    sentences = toks[::-1].T  # [bk, T]
+    # mask tokens after the first end_id with end_id
+    ended = jnp.cumsum(sentences == end_id, axis=1) > 0
+    after_end = jnp.concatenate(
+        [jnp.zeros((bk, 1), bool), ended[:, :-1]], axis=1)
+    sentences = jnp.where(after_end, end_id, sentences)
+    final_scores = step_scores[-1].reshape(bk, 1)
+    return sentences, final_scores
+
+
+@register_op("beam_search_decode", grad=None)
+def _beam_search_decode(ctx, op, ins):
+    # Ids/Scores/ParentIdx are LoDTensorArray vars: python lists of per-step
+    # arrays in the lowering env (ops/control_flow.py array convention).
+    step_ids = jnp.stack([jnp.asarray(a) for a in ins["Ids"][0]])
+    step_scores = jnp.stack([jnp.asarray(a) for a in ins["Scores"][0]])
+    step_parents = jnp.stack([jnp.asarray(a) for a in ins["ParentIdx"][0]])
+    sentences, final_scores = beam_search_backtrack(
+        step_ids, step_scores, step_parents, end_id=int(op.attr("end_id")))
+    return {"SentenceIds": sentences, "SentenceScores": final_scores}

@@ -37,6 +37,19 @@ def fill_constant(ctx, op, ins):
     return {"Out": jnp.full(shape, value, dtype=dtype)}
 
 
+@register_op("fill_constant_batch_size_like", grad=None)
+def fill_constant_batch_size_like(ctx, op, ins):
+    """fill_constant_batch_size_like_op.cc: fill a constant tensor whose
+    output_dim_idx dim is copied from the input's input_dim_idx dim."""
+    x = ins["Input"][0]
+    shape = [int(s) for s in op.attr("shape", [])]
+    in_idx = int(op.attr("input_dim_idx", 0))
+    out_idx = int(op.attr("output_dim_idx", 0))
+    shape[out_idx] = x.shape[in_idx]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    return {"Out": jnp.full(shape, op.attr("value", 0.0), dtype=dtype)}
+
+
 @register_op("fill_zeros_like", grad=None)
 def fill_zeros_like(ctx, op, ins):
     return {"Out": jnp.zeros_like(ins["X"][0])}

@@ -22,14 +22,29 @@ def _lstm_layer_sizes(in_dim: int, hidden: int):
     return in_dim * 4 * hidden, hidden * 4 * hidden, 4 * hidden
 
 
-def lstm_blob_size(in_dim: int, hidden: int, num_layers: int) -> int:
+def lstm_blob_size(in_dim: int, hidden: int, num_layers: int,
+                   num_directions: int = 1) -> int:
     total = 0
     d = in_dim
     for _ in range(num_layers):
         wx, wh, b = _lstm_layer_sizes(d, hidden)
-        total += wx + wh + b
-        d = hidden
+        total += (wx + wh + b) * num_directions
+        d = hidden * num_directions
     return total
+
+
+def _reverse_padded(x, seq_len):
+    """Reverse each row's valid prefix along time, leaving padding in place
+    (the bidirectional backward pass must not start inside the padding)."""
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]                        # [1,T]
+    if seq_len is None:
+        src = (T - 1 - t) * jnp.ones((B, 1), jnp.int32)
+    else:
+        L = seq_len.astype(jnp.int32)[:, None]        # [B,1]
+        src = jnp.where(t < L, L - 1 - t, t)
+    return jnp.take_along_axis(
+        x, src.reshape(B, T, *([1] * (x.ndim - 2))).astype(jnp.int32), axis=1)
 
 
 def _scan_lstm_layer(x, h0, c0, wx, wh, b, seq_len=None):
@@ -71,21 +86,32 @@ def cudnn_lstm(ctx, op, ins):
     hidden = int(op.attr("hidden_size"))
     dropout_prob = float(op.attr("dropout_prob", 0.0))
     is_test = bool(op.attr("is_test", False))
+    is_bidirec = bool(op.attr("is_bidirec", False))
+    directions = 2 if is_bidirec else 1
 
     out = x
     hs, cs = [], []
     off = 0
     d = x.shape[-1]
     for layer in range(num_layers):
-        nwx, nwh, nb = _lstm_layer_sizes(d, hidden)
-        wx = w[off:off + nwx].reshape(d, 4 * hidden); off += nwx
-        wh = w[off:off + nwh].reshape(hidden, 4 * hidden); off += nwh
-        b = w[off:off + nb]; off += nb
-        out, hT, cT = _scan_lstm_layer(out, h0[layer], c0[layer], wx, wh, b,
-                                       seq_len)
-        hs.append(hT)
-        cs.append(cT)
-        d = hidden
+        dir_outs = []
+        for direction in range(directions):
+            nwx, nwh, nb = _lstm_layer_sizes(d, hidden)
+            wx = w[off:off + nwx].reshape(d, 4 * hidden); off += nwx
+            wh = w[off:off + nwh].reshape(hidden, 4 * hidden); off += nwh
+            b = w[off:off + nb]; off += nb
+            state = layer * directions + direction
+            inp = out if direction == 0 else _reverse_padded(out, seq_len)
+            o, hT, cT = _scan_lstm_layer(inp, h0[state], c0[state],
+                                         wx, wh, b, seq_len)
+            if direction == 1:
+                o = _reverse_padded(o, seq_len)
+            dir_outs.append(o)
+            hs.append(hT)
+            cs.append(cT)
+        out = (dir_outs[0] if directions == 1
+               else jnp.concatenate(dir_outs, axis=-1))
+        d = hidden * directions
         if dropout_prob and not is_test and layer < num_layers - 1:
             # fold in the layer index: rng_for(op) is constant across the
             # python loop and identical masks at every depth would correlate

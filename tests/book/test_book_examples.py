@@ -127,3 +127,186 @@ def test_sentiment_lstm():
                        fetch_list=[loss, acc], scope=scope)
         accs.append(float(a))
     assert accs[-1] > 0.9, accs[-5:]
+
+
+# ---------------------------------------------------------------------------
+# machine translation (book/test_machine_translation.py): seq2seq encoder-
+# decoder on a toy reversal language, greedy + beam-search decode
+# ---------------------------------------------------------------------------
+
+def _np_beam_step(pre_ids, pre_scores, scores, beam, end_id, is_accumulated):
+    NEG_INF = -1e9
+    bk, vocab = scores.shape
+    batch = bk // beam
+    sel_ids = np.zeros((bk, 1), np.int64)
+    sel_scores = np.zeros((bk, 1), np.float32)
+    parents = np.zeros(bk, np.int64)
+    for b in range(batch):
+        cands = []
+        for k in range(beam):
+            row = b * beam + k
+            if pre_ids[row, 0] == end_id:
+                cands.append((float(pre_scores[row, 0]), row, end_id))
+                continue
+            row_scores = scores[row].astype(np.float64)
+            if not is_accumulated:
+                row_scores = np.log(np.maximum(row_scores, 1e-20)) + \
+                    float(pre_scores[row, 0])
+            for tok in range(vocab):
+                cands.append((float(row_scores[tok]), row, tok))
+        cands.sort(key=lambda c: -c[0])
+        for k in range(beam):
+            s, parent, tok = cands[k]
+            row = b * beam + k
+            sel_ids[row, 0] = tok
+            sel_scores[row, 0] = s
+            parents[row] = parent
+    return sel_ids, sel_scores, parents
+
+
+def test_machine_translation_seq2seq(tmp_path):
+    """Seq2seq GRU encoder-decoder trained to reverse sequences; decode
+    greedily and with the beam_search op (checked against a numpy beam
+    oracle step-by-step). Mirrors book/test_machine_translation.py with a
+    synthetic corpus."""
+    vocab, emb_dim, hid = 16, 16, 48
+    T = 5
+    EOS, BOS = 1, 2  # tokens 3.. are payload
+    rng = np.random.RandomState(7)
+    N = 256
+    src = rng.randint(3, vocab, (N, T)).astype(np.int64)
+    tgt = src[:, ::-1].copy()
+    # decoder input: [BOS, y_0..y_{T-1}]; label: [y_0..y_{T-1}, EOS]
+    dec_in = np.concatenate([np.full((N, 1), BOS, np.int64), tgt], axis=1)
+    label = np.concatenate([tgt, np.full((N, 1), EOS, np.int64)], axis=1)
+
+    train_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(train_prog, startup):
+        s = fluid.layers.data("src", [T], dtype="int64")
+        d = fluid.layers.data("dec_in", [T + 1], dtype="int64")
+        y = fluid.layers.data("label", [T + 1], dtype="int64")
+        semb = fluid.layers.embedding(s, size=[vocab, emb_dim],
+                                      param_attr=fluid.ParamAttr("src_emb"))
+        h0 = fluid.layers.fill_constant_batch_size_like(
+            semb, shape=[-1, hid], dtype="float32", value=0.0)
+        _, enc = fluid.layers.gru(semb, hid, init_h=h0,
+                                  param_attr=fluid.ParamAttr("enc_gru"),
+                                  bias_attr=fluid.ParamAttr("enc_gru"))
+        demb = fluid.layers.embedding(d, size=[vocab, emb_dim],
+                                      param_attr=fluid.ParamAttr("tgt_emb"))
+        dec_out, _ = fluid.layers.gru(demb, hid, init_h=enc,
+                                      param_attr=fluid.ParamAttr("dec_gru"),
+                                      bias_attr=fluid.ParamAttr("dec_gru"))
+        logits = fluid.layers.fc(dec_out, vocab, num_flatten_dims=2,
+                                 param_attr=fluid.ParamAttr("out_proj"),
+                                 bias_attr=fluid.ParamAttr("out_proj_b"))
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(
+                logits, fluid.layers.unsqueeze(y, [2])))
+        fluid.optimizer.AdamOptimizer(8e-3).minimize(loss)
+
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+    losses = []
+    for epoch in range(400):
+        l = exe.run(train_prog,
+                    feed={"src": src, "dec_in": dec_in, "label": label},
+                    fetch_list=[loss], scope=scope)[0]
+        losses.append(float(l))
+        if losses[-1] < 0.05:
+            break
+    assert losses[-1] < 0.35 * losses[0], (losses[0], losses[-1])
+
+    # --- step programs for decode (share trained params via scope) ---------
+    enc_prog = fluid.Program()
+    with fluid.program_guard(enc_prog, fluid.Program()):
+        s = fluid.layers.data("src", [T], dtype="int64")
+        semb = fluid.layers.embedding(s, size=[vocab, emb_dim],
+                                      param_attr=fluid.ParamAttr("src_emb"))
+        h0 = fluid.layers.fill_constant_batch_size_like(
+            semb, shape=[-1, hid], dtype="float32", value=0.0)
+        _, enc = fluid.layers.gru(semb, hid, init_h=h0,
+                                  param_attr=fluid.ParamAttr("enc_gru"),
+                                  bias_attr=fluid.ParamAttr("enc_gru"))
+    enc_prog = enc_prog.clone(for_test=True)
+
+    def make_step_prog(beam):
+        """One decoder step + the in-graph beam_search op, beam baked into
+        the compiled program (static shapes)."""
+        step_prog = fluid.Program()
+        with fluid.program_guard(step_prog, fluid.Program()):
+            tok = fluid.layers.data("tok", [1], dtype="int64")
+            h = fluid.layers.data("h", [hid], dtype="float32")
+            temb = fluid.layers.embedding(tok, size=[vocab, emb_dim],
+                                          param_attr=fluid.ParamAttr("tgt_emb"))
+            temb = fluid.layers.reshape(temb, [-1, 1, emb_dim])
+            out1, h_new = fluid.layers.gru(temb, hid, init_h=h,
+                                           param_attr=fluid.ParamAttr("dec_gru"),
+                                           bias_attr=fluid.ParamAttr("dec_gru"))
+            logit1 = fluid.layers.fc(out1, vocab, num_flatten_dims=2,
+                                     param_attr=fluid.ParamAttr("out_proj"),
+                                     bias_attr=fluid.ParamAttr("out_proj_b"))
+            prob = fluid.layers.softmax(
+                fluid.layers.reshape(logit1, [-1, vocab]))
+            pre_ids_v = fluid.layers.data("pre_ids", [1], dtype="int64")
+            pre_scores_v = fluid.layers.data("pre_scores", [1], dtype="float32")
+            sel_ids, sel_scores, parent = fluid.layers.beam_search(
+                pre_ids_v, pre_scores_v, None, prob, beam_size=beam,
+                end_id=EOS, is_accumulated=False, return_parent_idx=True)
+        return (step_prog.clone(for_test=True),
+                sel_ids, sel_scores, parent, h_new, prob)
+
+    def decode(batch_src, beam):
+        """Beam decode driven per step (the reference book example drives the
+        same ops inside a While block). Returns [B, beam, T+1] sequences."""
+        from paddle_tpu.ops.beam_search import beam_search_backtrack
+        step_prog, sel_ids, sel_scores, parent, h_new, prob = \
+            make_step_prog(beam)
+        B = batch_src.shape[0]
+        enc_h = np.asarray(exe.run(enc_prog, feed={"src": batch_src},
+                                   fetch_list=[enc], scope=scope)[0])
+        h = np.repeat(enc_h, beam, axis=0)                   # [B*beam, hid]
+        pre_ids = np.full((B * beam, 1), BOS, np.int64)
+        # dead-beam sentinel must stay additive in float32 (-1e9 + logp
+        # would collapse to -1e9 and break tie-breaking vs the oracle)
+        pre_scores = np.where(np.arange(B * beam) % beam == 0, 0.0, -1e4) \
+            .astype(np.float32).reshape(-1, 1)
+        steps = []
+        for t in range(T + 1):
+            # one decoder step + beam_search op, all inside the program
+            ids_sc_par = exe.run(
+                step_prog,
+                feed={"tok": pre_ids, "h": h,
+                      "pre_ids": pre_ids, "pre_scores": pre_scores},
+                fetch_list=[sel_ids, sel_scores, parent, h_new],
+                scope=scope)
+            np_ids, np_sc, np_par, np_h = [np.asarray(v) for v in ids_sc_par]
+            # oracle cross-check of the in-graph beam step
+            probs = np.asarray(exe.run(
+                step_prog, feed={"tok": pre_ids, "h": h,
+                                 "pre_ids": pre_ids,
+                                 "pre_scores": pre_scores},
+                fetch_list=[prob], scope=scope)[0])
+            oid, osc, opar = _np_beam_step(pre_ids, pre_scores, probs,
+                                           beam, EOS, False)
+            np.testing.assert_array_equal(np_ids, oid)
+            np.testing.assert_allclose(np_sc, osc, rtol=1e-4, atol=1e-5)
+            steps.append((np_ids, np_sc, np_par))
+            h = np_h.reshape(B * beam, hid)[np_par]
+            pre_ids, pre_scores = np_ids, np_sc
+        sents, _ = beam_search_backtrack(
+            np.stack([s[0] for s in steps]),
+            np.stack([s[1] for s in steps]),
+            np.stack([s[2] for s in steps]), EOS)
+        return np.asarray(sents).reshape(B, beam, T + 1)
+
+    test_idx = rng.choice(N, 16, replace=False)
+    sents = decode(src[test_idx], beam=3)
+    top = sents[:, 0, :T]  # first beam, payload positions
+    acc = float((top == tgt[test_idx]).mean())
+    assert acc > 0.9, f"beam decode token accuracy {acc}"
+
+    # greedy decode (beam=1) must also solve the task
+    greedy = decode(src[test_idx], beam=1)[:, 0, :T]
+    acc_g = float((greedy == tgt[test_idx]).mean())
+    assert acc_g > 0.9, f"greedy decode token accuracy {acc_g}"

@@ -1,0 +1,207 @@
+"""beam_search / beam_search_decode vs numpy oracles, and bidirectional LSTM.
+
+Reference semantics: operators/beam_search_op.cc (per-step top-k with ended-
+hypothesis freezing), beam_search_decode_op.cc (parent backtracking).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.beam_search import beam_search_step, beam_search_backtrack
+
+NEG_INF = -1e9
+
+
+def np_beam_step(pre_ids, pre_scores, scores, beam, end_id, is_accumulated=True):
+    """Numpy oracle for one dense beam-search step."""
+    bk, vocab = scores.shape
+    batch = bk // beam
+    sel_ids = np.zeros((bk, 1), np.int64)
+    sel_scores = np.zeros((bk, 1), np.float32)
+    parents = np.zeros(bk, np.int64)
+    for b in range(batch):
+        cands = []  # (score, parent_row, token)
+        for k in range(beam):
+            row = b * beam + k
+            if pre_ids[row, 0] == end_id:
+                cands.append((float(pre_scores[row, 0]), row, end_id))
+                continue
+            row_scores = scores[row].astype(np.float64)
+            if not is_accumulated:
+                row_scores = np.log(np.maximum(row_scores, 1e-20)) + \
+                    float(pre_scores[row, 0])
+            for tok in range(vocab):
+                cands.append((float(row_scores[tok]), row, tok))
+        # stable: score desc, then (parent,token) order as produced — matches
+        # lax.top_k's first-occurrence tie-breaking on the flattened axis
+        cands.sort(key=lambda c: -c[0])
+        for k in range(beam):
+            s, parent, tok = cands[k]
+            row = b * beam + k
+            sel_ids[row, 0] = tok
+            sel_scores[row, 0] = s
+            parents[row] = parent
+    return sel_ids, sel_scores, parents
+
+
+def test_beam_step_matches_oracle():
+    rng = np.random.RandomState(0)
+    batch, beam, vocab = 3, 4, 11
+    pre_ids = rng.randint(0, vocab, size=(batch * beam, 1)).astype(np.int64)
+    pre_scores = rng.randn(batch * beam, 1).astype(np.float32)
+    scores = (rng.randn(batch * beam, vocab) * 2).astype(np.float32)
+    end_id = 1
+    # make some beams finished
+    pre_ids[2, 0] = end_id
+    pre_ids[7, 0] = end_id
+
+    got_ids, got_scores, got_parent = [np.asarray(v) for v in beam_search_step(
+        pre_ids, pre_scores, scores, beam, end_id)]
+    exp_ids, exp_scores, exp_parent = np_beam_step(
+        pre_ids, pre_scores, scores, beam, end_id)
+    np.testing.assert_allclose(got_scores, exp_scores, rtol=1e-5)
+    np.testing.assert_array_equal(got_ids, exp_ids)
+    np.testing.assert_array_equal(got_parent, exp_parent)
+
+
+def test_beam_step_log_accumulation():
+    rng = np.random.RandomState(1)
+    batch, beam, vocab = 2, 3, 7
+    pre_ids = rng.randint(2, vocab, size=(batch * beam, 1)).astype(np.int64)
+    pre_scores = rng.randn(batch * beam, 1).astype(np.float32)
+    probs = rng.rand(batch * beam, vocab).astype(np.float32)
+    got = [np.asarray(v) for v in beam_search_step(
+        pre_ids, pre_scores, probs, beam, end_id=0, is_accumulated=False)]
+    exp = np_beam_step(pre_ids, pre_scores, probs, beam, 0,
+                       is_accumulated=False)
+    np.testing.assert_allclose(got[1], exp[1], rtol=1e-5)
+    np.testing.assert_array_equal(got[0], exp[0])
+
+
+def test_beam_search_op_in_program():
+    batch, beam, vocab = 2, 2, 5
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        pre_ids = fluid.layers.data("pre_ids", [1], dtype="int64")
+        pre_scores = fluid.layers.data("pre_scores", [1], dtype="float32")
+        scores = fluid.layers.data("scores", [vocab], dtype="float32")
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pre_ids, pre_scores, None, scores, beam_size=beam, end_id=0,
+            return_parent_idx=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2)
+    feed = {
+        "pre_ids": rng.randint(1, vocab, size=(batch * beam, 1)).astype(np.int64),
+        "pre_scores": rng.randn(batch * beam, 1).astype(np.float32),
+        "scores": rng.randn(batch * beam, vocab).astype(np.float32),
+    }
+    ids, sc, par = exe.run(prog, feed=feed,
+                           fetch_list=[sel_ids, sel_scores, parent])
+    exp = np_beam_step(feed["pre_ids"], feed["pre_scores"], feed["scores"],
+                       beam, 0)
+    np.testing.assert_array_equal(np.asarray(ids), exp[0])
+    np.testing.assert_allclose(np.asarray(sc), exp[1], rtol=1e-5)
+
+
+def test_backtrack_matches_oracle():
+    rng = np.random.RandomState(3)
+    T, batch, beam, vocab = 5, 2, 3, 8
+    bk = batch * beam
+    end_id = 0
+    # run a real multi-step beam search over random logits, collect steps
+    pre_ids = np.full((bk, 1), 2, np.int64)
+    pre_scores = np.where(np.arange(bk) % beam == 0, 0.0, NEG_INF) \
+        .astype(np.float32).reshape(bk, 1)
+    step_ids, step_scores, step_parents = [], [], []
+    for t in range(T):
+        logits = rng.randn(bk, vocab).astype(np.float32)
+        ids, sc, par = np_beam_step(pre_ids, pre_scores, logits, beam, end_id)
+        step_ids.append(ids); step_scores.append(sc); step_parents.append(par)
+        pre_ids, pre_scores = ids, sc
+
+    got_sents, got_scores = [np.asarray(v) for v in beam_search_backtrack(
+        np.stack(step_ids), np.stack(step_scores),
+        np.stack(step_parents), end_id)]
+
+    # numpy backtrack oracle
+    exp = np.zeros((bk, T), np.int64)
+    for row in range(bk):
+        r = row
+        for t in range(T - 1, -1, -1):
+            exp[row, t] = step_ids[t][r, 0]
+            r = step_parents[t][r]
+    # apply the same after-end masking
+    for row in range(bk):
+        seen = False
+        for t in range(T):
+            if seen:
+                exp[row, t] = end_id
+            elif exp[row, t] == end_id:
+                seen = True
+    np.testing.assert_array_equal(got_sents, exp)
+    np.testing.assert_allclose(got_scores, step_scores[-1], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bidirectional LSTM
+# ---------------------------------------------------------------------------
+
+def _np_lstm(x, h0, c0, wx, wh, b):
+    B, T, D = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    outs = np.zeros((B, T, H), np.float32)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        g = x[:, t] @ wx + h @ wh + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs[:, t] = h
+    return outs, h, c
+
+
+def test_bidirectional_lstm_matches_numpy():
+    from paddle_tpu.ops.rnn import lstm_blob_size
+
+    rng = np.random.RandomState(4)
+    B, T, D, H = 2, 5, 3, 4
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [T, D], dtype="float32")
+        init_h = fluid.layers.data("h0", [2, H], dtype="float32")
+        init_c = fluid.layers.data("c0", [2, H], dtype="float32")
+        out, last_h, last_c = fluid.layers.lstm(
+            x, init_h, init_c, hidden_size=H, num_layers=1, is_bidirec=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    blob = lstm_blob_size(D, H, 1, 2)
+    w = rng.randn(blob).astype(np.float32) * 0.3
+    scope = fluid.global_scope()
+    wname = [v.name for v in prog.global_block().vars.values()
+             if v.persistable][0]
+    import jax.numpy as jnp
+    scope.set_var(wname, jnp.asarray(w))
+
+    xb = rng.randn(B, T, D).astype(np.float32)
+    h0 = rng.randn(2, B, H).astype(np.float32)
+    c0 = rng.randn(2, B, H).astype(np.float32)
+    got, gh, gc = exe.run(prog, feed={"x": xb, "h0": h0, "c0": c0},
+                          fetch_list=[out, last_h, last_c])
+
+    off = 0
+    nwx, nwh, nb = D * 4 * H, H * 4 * H, 4 * H
+    fwx = w[off:off + nwx].reshape(D, 4 * H); off += nwx
+    fwh = w[off:off + nwh].reshape(H, 4 * H); off += nwh
+    fb = w[off:off + nb]; off += nb
+    bwx = w[off:off + nwx].reshape(D, 4 * H); off += nwx
+    bwh = w[off:off + nwh].reshape(H, 4 * H); off += nwh
+    bb = w[off:off + nb]
+    f_out, f_h, f_c = _np_lstm(xb, h0[0], c0[0], fwx, fwh, fb)
+    b_out, b_h, b_c = _np_lstm(xb[:, ::-1], h0[1], c0[1], bwx, bwh, bb)
+    exp = np.concatenate([f_out, b_out[:, ::-1]], axis=-1)
+    np.testing.assert_allclose(np.asarray(got), exp, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gh)[0], f_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gh)[1], b_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gc)[1], b_c, rtol=1e-4, atol=1e-5)
