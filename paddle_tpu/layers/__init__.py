@@ -7,6 +7,7 @@ from . import tensor  # noqa: F401
 from . import rnn  # noqa: F401
 from .rnn import lstm, gru, beam_search, beam_search_decode  # noqa: F401
 from . import detection  # noqa: F401
+from .detection import *  # noqa: F401,F403
 from . import collective  # noqa: F401
 from . import control_flow  # noqa: F401
 from .control_flow import *  # noqa: F401,F403

@@ -5,7 +5,8 @@ from __future__ import annotations
 from ..framework.layer_helper import LayerHelper
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "roi_align",
-           "multiclass_nms"]
+           "multiclass_nms", "anchor_generator", "density_prior_box",
+           "roi_pool", "iou_similarity", "box_clip", "sigmoid_focal_loss"]
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
@@ -87,4 +88,90 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
                "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
                "normalized": normalized, "nms_eta": nms_eta,
                "background_label": background_label})
+    return out
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=None, stride=None, offset=0.5, name=None):
+    """fluid.layers.anchor_generator (detection/anchor_generator_op.cc)."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors.name], "Variances": [variances.name]},
+        attrs={"anchor_sizes": [float(v) for v in (anchor_sizes or [64., 128., 256., 512.])],
+               "aspect_ratios": [float(v) for v in (aspect_ratios or [0.5, 1.0, 2.0])],
+               "variances": [float(v) for v in (variance or [0.1, 0.1, 0.2, 0.2])],
+               "stride": [float(v) for v in (stride or [16.0, 16.0])],
+               "offset": float(offset)})
+    return anchors, variances
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=None, clip=False,
+                      steps=None, offset=0.5, flatten_to_2d=False, name=None):
+    """fluid.layers.density_prior_box (detection/density_prior_box_op.cc)."""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes.name], "Variances": [variances.name]},
+        attrs={"densities": [int(v) for v in (densities or [])],
+               "fixed_sizes": [float(v) for v in (fixed_sizes or [])],
+               "fixed_ratios": [float(v) for v in (fixed_ratios or [])],
+               "variances": [float(v) for v in (variance or [0.1, 0.1, 0.2, 0.2])],
+               "clip": bool(clip), "step_w": float(steps[0]),
+               "step_h": float(steps[1]), "offset": float(offset),
+               "flatten_to_2d": bool(flatten_to_2d)})
+    return boxes, variances
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_batch_id=None, name=None):
+    """fluid.layers.roi_pool (roi_pool_op.cc). Returns pooled features;
+    argmax stays internal like the reference python wrapper."""
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op(
+        type="roi_pool", inputs=inputs,
+        outputs={"Out": [out.name], "Argmax": [argmax.name]},
+        attrs={"pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out.name]},
+                     attrs={"box_normalized": bool(box_normalized)})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": [input], "ImInfo": [im_info]},
+                     outputs={"Output": [out.name]}, attrs={})
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    helper = LayerHelper("sigmoid_focal_loss", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sigmoid_focal_loss",
+                     inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+                     outputs={"Out": [out.name]},
+                     attrs={"gamma": float(gamma), "alpha": float(alpha)})
     return out

@@ -35,8 +35,9 @@ def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
          sequence_length=None, param_attr=None):
     """fluid.layers.lstm (cudnn path, fluid/layers/rnn.py).
 
-    input: [B, T, D]; init_h/init_c: [num_layers, B, hidden_size].
-    Returns (out [B,T,H], last_h, last_c).
+    input: [B, T, D]; init_h/init_c: [num_layers * num_directions, B,
+    hidden_size] (directions = 2 when is_bidirec, fwd state before bwd per
+    layer). Returns (out [B, T, H*directions], last_h, last_c).
     """
     assert hidden_size is not None
     helper = LayerHelper("lstm", param_attr=param_attr, name=name)

@@ -285,3 +285,236 @@ def _register_nms_host_op():
 
 
 _register_nms_host_op()
+
+
+# ---------------------------------------------------------------------------
+# anchor_generator (detection/anchor_generator_op.{cc,h})
+# ---------------------------------------------------------------------------
+
+@register_op("anchor_generator", grad=None)
+def anchor_generator(ctx, op, ins):
+    """Anchors [H,W,A,4] in (x1,y1,x2,y2); loop order ratios-outer,
+    sizes-inner per anchor_generator_op.h:62-84."""
+    x = ins["Input"][0]                    # [N, C, H, W]
+    sizes = [float(v) for v in op.attr("anchor_sizes")]
+    ratios = [float(v) for v in op.attr("aspect_ratios")]
+    variances = [float(v) for v in op.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(v) for v in op.attr("stride")]
+    offset = float(op.attr("offset", 0.5))
+    H, W = int(x.shape[2]), int(x.shape[3])
+    sw, sh = stride[0], stride[1]
+
+    wh = []
+    for ar in ratios:
+        area = sw * sh
+        base_w = jnp.round(jnp.sqrt(area / ar))
+        base_h = jnp.round(base_w * ar)
+        for size in sizes:
+            wh.append((size / sw * base_w, size / sh * base_h))
+    aw = jnp.stack([p[0] for p in wh]).astype(jnp.float32)   # [A]
+    ah = jnp.stack([p[1] for p in wh]).astype(jnp.float32)
+    xc = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)  # [W]
+    yc = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)  # [H]
+    xg = xc[None, :, None]
+    yg = yc[:, None, None]
+    coords = jnp.broadcast_arrays(
+        xg - 0.5 * (aw - 1), yg - 0.5 * (ah - 1),
+        xg + 0.5 * (aw - 1), yg + 0.5 * (ah - 1))
+    anchors = jnp.broadcast_to(jnp.stack(coords, axis=-1),
+                               (H, W, len(wh), 4))
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, len(wh), 4))
+    return {"Anchors": anchors, "Variances": var}
+
+
+# ---------------------------------------------------------------------------
+# density_prior_box (detection/density_prior_box_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("density_prior_box", grad=None)
+def density_prior_box(ctx, op, ins):
+    x = ins["Input"][0]                    # [N, C, H, W]
+    img = ins["Image"][0]                  # [N, C, Him, Wim]
+    fixed_sizes = [float(v) for v in op.attr("fixed_sizes", [])]
+    fixed_ratios = [float(v) for v in op.attr("fixed_ratios", [])]
+    densities = [int(v) for v in op.attr("densities", [])]
+    variances = [float(v) for v in op.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(op.attr("clip", True))
+    offset = float(op.attr("offset", 0.5))
+    H, W = int(x.shape[2]), int(x.shape[3])
+    img_h, img_w = float(img.shape[2]), float(img.shape[3])
+    step_w = float(op.attr("step_w", 0.0)) or img_w / W
+    step_h = float(op.attr("step_h", 0.0)) or img_h / H
+    step_average = int((step_w + step_h) * 0.5)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w   # [W]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h   # [H]
+    boxes_per_cell = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = step_average // density
+        for ratio in fixed_ratios:
+            bw = size * float(np.sqrt(ratio))
+            bh = size / float(np.sqrt(ratio))
+            d0x = -step_average / 2.0 + shift / 2.0
+            d0y = -step_average / 2.0 + shift / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    boxes_per_cell.append((d0x + dj * shift, d0y + di * shift,
+                                           bw, bh))
+    A = len(boxes_per_cell)
+    dx = jnp.asarray([b[0] for b in boxes_per_cell], jnp.float32)
+    dy = jnp.asarray([b[1] for b in boxes_per_cell], jnp.float32)
+    bw = jnp.asarray([b[2] for b in boxes_per_cell], jnp.float32)
+    bh = jnp.asarray([b[3] for b in boxes_per_cell], jnp.float32)
+    cxg = cx[None, :, None] + dx                     # [1,W,A]
+    cyg = cy[:, None, None] + dy                     # [H,1,A]
+    x1 = (cxg - bw / 2.0) / img_w
+    y1 = (cyg - bh / 2.0) / img_h
+    x2 = (cxg + bw / 2.0) / img_w
+    y2 = (cyg + bh / 2.0) / img_h
+    x1, x2 = jnp.maximum(x1, 0.0), jnp.minimum(x2, 1.0)
+    y1, y2 = jnp.maximum(y1, 0.0), jnp.minimum(y2, 1.0)
+    boxes = jnp.broadcast_to(
+        jnp.stack(jnp.broadcast_arrays(x1, y1, x2, y2), axis=-1),
+        (H, W, A, 4))
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), (H, W, A, 4))
+    if bool(op.attr("flatten_to_2d", False)):
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return {"Boxes": boxes, "Variances": var}
+
+
+# ---------------------------------------------------------------------------
+# roi_pool (roi_pool_op.h) — static-shape max pool per ROI bin
+# ---------------------------------------------------------------------------
+
+@register_op("roi_pool", diff_inputs=("X",))
+def roi_pool(ctx, op, ins):
+    """Per-bin max via a mask over the full (static) H x W grid — the
+    TPU-native shape for the reference's dynamic-extent bin loops."""
+    x = ins["X"][0]                        # [N, C, H, W]
+    rois = ins["ROIs"][0]                  # [R, 4]
+    batch_ids = ins.get("RoisBatchId", [None])[0]
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = float(op.attr("spatial_scale", 1.0))
+    if batch_ids is None:
+        batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
+    n, c, h, w = x.shape
+    hs = jnp.arange(h)
+    ws = jnp.arange(w)
+
+    def one_roi(roi, bid):
+        rx1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        ry1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        rx2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        ry2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(ry2 - ry1 + 1, 1)
+        rw = jnp.maximum(rx2 - rx1 + 1, 1)
+        bin_h = rh.astype(jnp.float32) / ph
+        bin_w = rw.astype(jnp.float32) / pw
+        pidx = jnp.arange(ph, dtype=jnp.float32)
+        qidx = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(pidx * bin_h).astype(jnp.int32) + ry1,
+                          0, h)                        # [ph]
+        hend = jnp.clip(jnp.ceil((pidx + 1) * bin_h).astype(jnp.int32) + ry1,
+                        0, h)
+        wstart = jnp.clip(jnp.floor(qidx * bin_w).astype(jnp.int32) + rx1,
+                          0, w)                        # [pw]
+        wend = jnp.clip(jnp.ceil((qidx + 1) * bin_w).astype(jnp.int32) + rx1,
+                        0, w)
+        hmask = (hs[None, :] >= hstart[:, None]) & (hs[None, :] < hend[:, None])
+        wmask = (ws[None, :] >= wstart[:, None]) & (ws[None, :] < wend[:, None])
+        img = x[bid]                                   # [C, H, W]
+        # bins are rectangles, so the max is separable: reduce rows first
+        # ([C,ph,W]) then columns ([C,ph,pw]) — a ph*pw-fold smaller
+        # intermediate than masking the full [ph,pw,H,W] grid at once
+        rowm = jnp.where(hmask[None, :, :, None], img[:, None],
+                         -jnp.inf)                     # [C,ph,H,W]
+        rowmax = rowm.max(axis=2)                      # [C,ph,W]
+        rowarg = rowm.argmax(axis=2)                   # [C,ph,W] -> h index
+        colm = jnp.where(wmask[None, None], rowmax[:, :, None, :],
+                         -jnp.inf)                     # [C,ph,pw,W]
+        val = colm.max(axis=-1)                        # [C,ph,pw]
+        warg = colm.argmax(axis=-1)                    # [C,ph,pw] -> w index
+        harg = jnp.take_along_axis(rowarg, warg, axis=-1)  # [C,ph,pw]
+        arg = (harg * w + warg).astype(jnp.int64)
+        empty = ~(hmask.any(-1)[:, None] & wmask.any(-1)[None, :])  # [ph,pw]
+        val = jnp.where(empty[None], 0.0, val)
+        arg = jnp.where(empty[None], -1, arg)
+        return val, arg
+
+    out, argmax = jax.vmap(one_roi)(rois, batch_ids)
+    return {"Out": out, "Argmax": argmax}
+
+
+# ---------------------------------------------------------------------------
+# iou_similarity / box_clip / sigmoid_focal_loss
+# ---------------------------------------------------------------------------
+
+@register_op("iou_similarity", grad=None)
+def iou_similarity(ctx, op, ins):
+    """detection/iou_similarity_op.h: pairwise IoU [N, M]."""
+    a = ins["X"][0]                        # [N,4]
+    b = ins["Y"][0]                        # [M,4]
+    norm = bool(op.attr("box_normalized", True))
+    off = 0.0 if norm else 1.0
+    ax1, ay1, ax2, ay2 = [a[:, i][:, None] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[:, i][None, :] for i in range(4)]
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    inter = (jnp.maximum(ix2 - ix1 + off, 0.0)
+             * jnp.maximum(iy2 - iy1 + off, 0.0))
+    area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+    area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+    return {"Out": inter / jnp.maximum(area_a + area_b - inter, 1e-10)}
+
+
+@register_op("box_clip", grad=None)
+def box_clip(ctx, op, ins):
+    """detection/box_clip_op.h: clip boxes to image (im_h-1, im_w-1).
+
+    Batched boxes [N, M, 4] clip each image against its own im_info row;
+    flat boxes [M, 4] use im_info[0] (single-image case).
+    """
+    boxes = ins["Input"][0]                # [M, 4] or [N, M, 4]
+    im_info = ins["ImInfo"][0]             # [N, 3] (h, w, scale)
+    if boxes.ndim == 3:
+        h = (im_info[:, 0] - 1.0)[:, None]   # [N,1]
+        w = (im_info[:, 1] - 1.0)[:, None]
+    else:
+        h = im_info[0, 0] - 1.0
+        w = im_info[0, 1] - 1.0
+    x1 = jnp.clip(boxes[..., 0], 0.0, w)
+    y1 = jnp.clip(boxes[..., 1], 0.0, h)
+    x2 = jnp.clip(boxes[..., 2], 0.0, w)
+    y2 = jnp.clip(boxes[..., 3], 0.0, h)
+    return {"Output": jnp.stack([x1, y1, x2, y2], axis=-1)}
+
+
+@register_op("sigmoid_focal_loss", diff_inputs=("X",))
+def sigmoid_focal_loss(ctx, op, ins):
+    """detection/sigmoid_focal_loss_op.cu math on dense labels.
+
+    X [N, C] logits; Label [N, 1] int (0 = background, c>=1 -> class c-1,
+    -1 = ignore — contributes no loss, sigmoid_focal_loss_op.cu:53-54);
+    FgNum [1] normalizer.
+    """
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1)
+    fg = jnp.maximum(ins["FgNum"][0].astype(jnp.float32).reshape(()), 1.0)
+    gamma = float(op.attr("gamma", 2.0))
+    alpha = float(op.attr("alpha", 0.25))
+    n, c = x.shape
+    pos = jax.nn.one_hot(label - 1, c, dtype=x.dtype)   # label<=0 -> all zero
+    neg = jnp.where((label != -1)[:, None], 1.0 - pos, 0.0)
+    p = jax.nn.sigmoid(x)
+    ce_pos = -jnp.log(jnp.clip(p, 1e-16))
+    ce_neg = -jnp.log(jnp.clip(1.0 - p, 1e-16))
+    loss = (pos * alpha * (1 - p) ** gamma * ce_pos
+            + neg * (1 - alpha) * p ** gamma * ce_neg)
+    return {"Out": loss / fg}

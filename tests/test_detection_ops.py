@@ -1,0 +1,268 @@
+"""New detection ops vs numpy oracles ported from the reference OpTest
+suites (test_anchor_generator_op.py, test_roi_pool_op.py,
+test_density_prior_box_op.py, test_iou_similarity_op.py etc. semantics)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(prog, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return [np.asarray(v) for v in exe.run(prog, feed=feed, fetch_list=fetch)]
+
+
+def test_anchor_generator_matches_oracle():
+    H, W = 4, 5
+    sizes = [32.0, 64.0]
+    ratios = [0.5, 1.0]
+    stride = [16.0, 16.0]
+    offset = 0.5
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", [8, H, W], dtype="float32")
+        anchors, variances = layers.anchor_generator(
+            x, anchor_sizes=sizes, aspect_ratios=ratios, stride=stride,
+            offset=offset)
+    got_a, got_v = _run(prog, {"x": np.zeros((1, 8, H, W), np.float32)},
+                        [anchors, variances])
+
+    exp = np.zeros((H, W, len(sizes) * len(ratios), 4), np.float32)
+    for hi in range(H):
+        for wi in range(W):
+            xc = wi * stride[0] + offset * (stride[0] - 1)
+            yc = hi * stride[1] + offset * (stride[1] - 1)
+            idx = 0
+            for ar in ratios:
+                area = stride[0] * stride[1]
+                base_w = round(math.sqrt(area / ar))
+                base_h = round(base_w * ar)
+                for s in sizes:
+                    aw = s / stride[0] * base_w
+                    ah = s / stride[1] * base_h
+                    exp[hi, wi, idx] = [xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                                        xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)]
+                    idx += 1
+    np.testing.assert_allclose(got_a, exp, rtol=1e-5)
+    assert got_v.shape == exp.shape
+    np.testing.assert_allclose(got_v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def _np_roi_pool(x, rois, batch_ids, ph, pw, scale):
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    out = np.zeros((R, C, ph, pw), np.float32)
+    for r in range(R):
+        bid = batch_ids[r]
+        x1 = int(round(rois[r, 0] * scale))
+        y1 = int(round(rois[r, 1] * scale))
+        x2 = int(round(rois[r, 2] * scale))
+        y2 = int(round(rois[r, 3] * scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for c in range(C):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(np.floor(i * rh / ph)) + y1, 0), H)
+                    he = min(max(int(np.ceil((i + 1) * rh / ph)) + y1, 0), H)
+                    ws = min(max(int(np.floor(j * rw / pw)) + x1, 0), W)
+                    we = min(max(int(np.ceil((j + 1) * rw / pw)) + x1, 0), W)
+                    if he <= hs or we <= ws:
+                        out[r, c, i, j] = 0.0
+                    else:
+                        out[r, c, i, j] = x[bid, c, hs:he, ws:we].max()
+    return out
+
+
+def test_roi_pool_matches_oracle():
+    rng = np.random.RandomState(0)
+    N, C, H, W = 2, 3, 8, 8
+    x = rng.randn(N, C, H, W).astype(np.float32)
+    rois = np.array([[0, 0, 7, 7], [2, 2, 6, 6], [1, 0, 5, 3]],
+                    np.float32)
+    bids = np.array([0, 1, 1], np.int32)
+    ph = pw = 2
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [C, H, W], dtype="float32")
+        rv = fluid.layers.data("rois", [4], dtype="float32")
+        bv = fluid.layers.data("bids", [], dtype="int32")
+        out = layers.roi_pool(xv, rv, pooled_height=ph, pooled_width=pw,
+                              spatial_scale=1.0, rois_batch_id=bv)
+    got = _run(prog, {"x": x, "rois": rois, "bids": bids}, [out])[0]
+    exp = _np_roi_pool(x, rois, bids, ph, pw, 1.0)
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_roi_pool_spatial_scale():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 11, 11]], np.float32)  # scale .5 -> 0..5
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [1, 6, 6], dtype="float32")
+        rv = fluid.layers.data("rois", [4], dtype="float32")
+        out = layers.roi_pool(xv, rv, pooled_height=3, pooled_width=3,
+                              spatial_scale=0.5)
+    got = _run(prog, {"x": x, "rois": rois}, [out])[0]
+    exp = _np_roi_pool(x, rois, np.zeros(1, np.int32), 3, 3, 0.5)
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_density_prior_box_matches_oracle():
+    H, W = 2, 2
+    img_h = img_w = 32
+    fixed_sizes = [8.0]
+    fixed_ratios = [1.0]
+    densities = [2]
+    offset = 0.5
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", [4, H, W], dtype="float32")
+        img = fluid.layers.data("img", [3, img_h, img_w], dtype="float32")
+        boxes, var = layers.density_prior_box(
+            x, img, densities=densities, fixed_sizes=fixed_sizes,
+            fixed_ratios=fixed_ratios, clip=True, offset=offset)
+    got_b, got_v = _run(prog, {"x": np.zeros((1, 4, H, W), np.float32),
+                               "img": np.zeros((1, 3, img_h, img_w),
+                                               np.float32)},
+                        [boxes, var])
+
+    step_w, step_h = img_w / W, img_h / H
+    step_average = int((step_w + step_h) * 0.5)
+    A = sum(d * d * len(fixed_ratios) for d in densities)
+    exp = np.zeros((H, W, A, 4), np.float32)
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            idx = 0
+            for s, density in zip(fixed_sizes, densities):
+                shift = step_average // density
+                for ratio in fixed_ratios:
+                    bw = s * math.sqrt(ratio)
+                    bh = s / math.sqrt(ratio)
+                    d0x = cx - step_average / 2.0 + shift / 2.0
+                    d0y = cy - step_average / 2.0 + shift / 2.0
+                    for di in range(density):
+                        for dj in range(density):
+                            ccx = d0x + dj * shift
+                            ccy = d0y + di * shift
+                            exp[h, w, idx] = [
+                                max((ccx - bw / 2) / img_w, 0),
+                                max((ccy - bh / 2) / img_h, 0),
+                                min((ccx + bw / 2) / img_w, 1),
+                                min((ccy + bh / 2) / img_h, 1)]
+                            idx += 1
+    np.testing.assert_allclose(got_b, exp, rtol=1e-5, atol=1e-6)
+    assert got_v.shape == exp.shape
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    b = np.array([[0, 0, 10, 10], [10, 10, 20, 20], [100, 100, 101, 101]],
+                 np.float32)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("a", [4], dtype="float32")
+        yv = fluid.layers.data("b", [4], dtype="float32")
+        out = layers.iou_similarity(xv, yv)
+    got = _run(prog, {"a": a, "b": b}, [out])[0]
+    assert got.shape == (2, 3)
+    np.testing.assert_allclose(got[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(got[0, 1], 0.0, atol=1e-7)
+    np.testing.assert_allclose(got[1, 1], 25.0 / 175.0, rtol=1e-5)
+
+
+def test_box_clip():
+    boxes = np.array([[-5, -5, 50, 60], [2, 3, 4, 5]], np.float32)
+    im_info = np.array([[40, 30, 1.0]], np.float32)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        bv = fluid.layers.data("boxes", [4], dtype="float32")
+        iv = fluid.layers.data("im_info", [3], dtype="float32")
+        out = layers.box_clip(bv, iv)
+    got = _run(prog, {"boxes": boxes, "im_info": im_info}, [out])[0]
+    np.testing.assert_allclose(got[0], [0, 0, 29, 39])
+    np.testing.assert_allclose(got[1], [2, 3, 4, 5])
+
+
+def test_sigmoid_focal_loss_matches_oracle():
+    rng = np.random.RandomState(2)
+    N, C = 6, 4
+    x = rng.randn(N, C).astype(np.float32)
+    label = np.array([0, 1, 2, 0, 4, 3], np.int64).reshape(-1, 1)
+    fg = np.array([3], np.int64)
+    gamma, alpha = 2.0, 0.25
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [C], dtype="float32")
+        lv = fluid.layers.data("label", [1], dtype="int64")
+        fv = fluid.layers.data("fg", [], dtype="int64")
+        out = layers.sigmoid_focal_loss(xv, lv, fv, gamma=gamma, alpha=alpha)
+    got = _run(prog, {"x": x, "label": label, "fg": fg}, [out])[0]
+
+    p = 1 / (1 + np.exp(-x.astype(np.float64)))
+    pos = np.zeros((N, C))
+    for i in range(N):
+        if label[i, 0] > 0:
+            pos[i, label[i, 0] - 1] = 1.0
+    loss = (pos * alpha * (1 - p) ** gamma * -np.log(p)
+            + (1 - pos) * (1 - alpha) * p ** gamma * -np.log(1 - p)) / 3.0
+    np.testing.assert_allclose(got, loss, rtol=1e-4, atol=1e-6)
+
+
+def test_roi_pool_grad_flows():
+    """roi_pool is differentiable w.r.t. X (max-pool style subgradient)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 5, 5]], np.float32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", [2, 6, 6], dtype="float32")
+        xv.stop_gradient = False
+        rv = fluid.layers.data("rois", [4], dtype="float32")
+        out = layers.roi_pool(xv, rv, pooled_height=2, pooled_width=2)
+        loss = fluid.layers.reduce_sum(out)
+        from paddle_tpu.framework.backward import append_backward
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    g = np.asarray(exe.run(prog, feed={"x": x, "rois": rois},
+                           fetch_list=["x@GRAD"])[0])
+    # each of the 4 bins contributes exactly one max location
+    assert g.shape == x.shape
+    assert g.sum() == pytest.approx(8.0)  # 2 channels * 4 bins
+    assert (g >= 0).all() and ((g == 1.0).sum() == 8)
+
+
+def test_sigmoid_focal_loss_ignore_label():
+    """label == -1 anchors contribute zero loss (sigmoid_focal_loss_op.cu)."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 4).astype(np.float32)
+    label = np.array([[1], [-1], [0]], np.int64)
+    fg = np.array([1], np.int64)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [4], dtype="float32")
+        lv = fluid.layers.data("label", [1], dtype="int64")
+        fv = fluid.layers.data("fg", [], dtype="int64")
+        out = layers.sigmoid_focal_loss(xv, lv, fv)
+    got = _run(prog, {"x": x, "label": label, "fg": fg}, [out])[0]
+    np.testing.assert_allclose(got[1], 0.0, atol=1e-8)
+    assert np.abs(got[0]).sum() > 0 and np.abs(got[2]).sum() > 0
+
+
+def test_box_clip_batched_per_image():
+    boxes = np.array([[[0, 0, 500, 500]], [[0, 0, 500, 500]]], np.float32)
+    im_info = np.array([[300, 300, 1.0], [800, 800, 1.0]], np.float32)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        bv = fluid.layers.data("boxes", [1, 4], dtype="float32")
+        iv = fluid.layers.data("im_info", [3], dtype="float32")
+        out = layers.box_clip(bv, iv)
+    got = _run(prog, {"boxes": boxes, "im_info": im_info}, [out])[0]
+    np.testing.assert_allclose(got[0, 0], [0, 0, 299, 299])
+    np.testing.assert_allclose(got[1, 0], [0, 0, 500, 500])
