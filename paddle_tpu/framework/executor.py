@@ -421,10 +421,17 @@ class Executor:
             block = program.global_block()
             param_names, written = _analyze_persistables(program)
             with RecordEvent(f"compile/{len(block.ops)}ops"):
-                exe = _CompiledBlock(
-                    program, feed_sig, fetch_names, param_names, written,
-                    mesh_plan=mesh_plan, scope=scope,
-                )
+                if "pipeline" in program._annotations:
+                    from ..parallel.pipeline_program import (
+                        _CompiledPipelineBlock)
+                    exe = _CompiledPipelineBlock(
+                        program, feed_sig, fetch_names, param_names,
+                        written, scope=scope)
+                else:
+                    exe = _CompiledBlock(
+                        program, feed_sig, fetch_names, param_names, written,
+                        mesh_plan=mesh_plan, scope=scope,
+                    )
             self._cache[key] = exe
             logger.info(
                 "compiled program: %d ops, %d params, %d feeds, mesh=%s",

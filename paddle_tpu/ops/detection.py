@@ -483,12 +483,16 @@ def box_clip(ctx, op, ins):
     """
     boxes = ins["Input"][0]                # [M, 4] or [N, M, 4]
     im_info = ins["ImInfo"][0]             # [N, 3] (h, w, scale)
+    # boxes live in the ORIGINAL image frame: divide the (resized) im_info
+    # dims by the scale factor first (bbox_util.h:137 ClipTiledBoxes)
+    imh = jnp.round(im_info[:, 0] / im_info[:, 2])
+    imw = jnp.round(im_info[:, 1] / im_info[:, 2])
     if boxes.ndim == 3:
-        h = (im_info[:, 0] - 1.0)[:, None]   # [N,1]
-        w = (im_info[:, 1] - 1.0)[:, None]
+        h = (imh - 1.0)[:, None]           # [N,1]
+        w = (imw - 1.0)[:, None]
     else:
-        h = im_info[0, 0] - 1.0
-        w = im_info[0, 1] - 1.0
+        h = imh[0] - 1.0
+        w = imw[0] - 1.0
     x1 = jnp.clip(boxes[..., 0], 0.0, w)
     y1 = jnp.clip(boxes[..., 1], 0.0, h)
     x2 = jnp.clip(boxes[..., 2], 0.0, w)
@@ -513,8 +517,10 @@ def sigmoid_focal_loss(ctx, op, ins):
     pos = jax.nn.one_hot(label - 1, c, dtype=x.dtype)   # label<=0 -> all zero
     neg = jnp.where((label != -1)[:, None], 1.0 - pos, 0.0)
     p = jax.nn.sigmoid(x)
-    ce_pos = -jnp.log(jnp.clip(p, 1e-16))
-    ce_neg = -jnp.log(jnp.clip(1.0 - p, 1e-16))
+    # stable log-sigmoid forms (clip(p) would flatline the gradient for
+    # confident negatives, |x| > ~17 in float32)
+    ce_pos = jax.nn.softplus(-x)           # -log(sigmoid(x))
+    ce_neg = jax.nn.softplus(x)            # -log(1 - sigmoid(x))
     loss = (pos * alpha * (1 - p) ** gamma * ce_pos
             + neg * (1 - alpha) * p ** gamma * ce_neg)
     return {"Out": loss / fg}

@@ -35,7 +35,7 @@ __all__ = [
     "Adam", "AdamOptimizer", "AdamW", "Adamax", "AdamaxOptimizer", "Dpsgd",
     "DpsgdOptimizer", "RMSProp", "RMSPropOptimizer", "Ftrl", "FtrlOptimizer",
     "Lamb", "LambOptimizer", "ExponentialMovingAverage", "ModelAverage",
-    "RecomputeOptimizer", "LookaheadOptimizer",
+    "RecomputeOptimizer", "LookaheadOptimizer", "PipelineOptimizer",
 ]
 
 
@@ -582,6 +582,53 @@ class RecomputeOptimizer(Optimizer):
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         return self.apply_optimize(loss, startup_program, params_grads), params_grads
+
+
+class PipelineOptimizer:
+    """fluid.optimizer.PipelineOptimizer (reference optimizer.py:3556-3858).
+
+    The reference splits block-0 into section sub-programs run by
+    SectionWorker threads over scope queues; here minimize records a stage
+    split on the Program and the Executor compiles the forward as GPipe
+    stages over a ("pp", num_stages) mesh axis with a lax.scan microbatch
+    schedule — see parallel/pipeline_program.py. cut_list (lists of cut
+    Variables) picks the stage boundaries like the reference; with no
+    cut_list the forward is split evenly into num_stages. place_list /
+    concurrency_list / queue_size / start_cpu_core_id are accepted for API
+    parity and ignored (XLA owns placement and scheduling on TPU).
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=None, num_stages=None):
+        self._optimizer = optimizer
+        self._cut_list = cut_list
+        if num_stages is None:
+            num_stages = (len(cut_list) + 1) if cut_list else 2
+        self._num_stages = int(num_stages)
+        self._num_microbatches = int(num_microbatches
+                                     or max(1, self._num_stages))
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .parallel.pipeline_program import annotate_pipeline
+
+        block = loss.block
+        program = block.program
+        n_fwd = len(block.ops)
+        params_grads = self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        bwd_end = len(block.ops)
+        opt_ops = self._optimizer.apply_optimize(
+            loss, startup_program, params_grads)
+        annotate_pipeline(
+            program, loss, n_fwd=n_fwd, bwd_end=bwd_end,
+            num_stages=self._num_stages,
+            num_microbatches=self._num_microbatches,
+            cut_list=self._cut_list,
+            trainable_params=[p.name for p, g in params_grads
+                              if g is not None])
+        return opt_ops, params_grads
 
 
 class LookaheadOptimizer:

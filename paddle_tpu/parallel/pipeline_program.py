@@ -1,0 +1,374 @@
+"""Fluid-level pipeline parallelism: run a user Program's forward as GPipe
+stages over a ``pp`` mesh axis.
+
+Reference: PipelineOptimizer (python/paddle/fluid/optimizer.py:3556-3858)
+splits block-0 into section sub-programs executed by SectionWorker threads
+passing Scopes through blocking queues (framework/pipeline_trainer.cc,
+section_worker.cc). The TPU-native equivalent here is ONE compiled program:
+
+- the forward op-list is cut into S contiguous stages (at user cut vars or
+  evenly); the boundary interface (vars produced before / consumed after the
+  cut) is packed into a fixed-size carry vector;
+- a ``shard_map`` over a ``("pp", S)`` mesh runs the schedule; each rank
+  selects its stage body with ``lax.switch(axis_index)``, and activations
+  move stage->stage+1 by ``lax.ppermute`` inside a ``lax.scan`` over
+  M + S - 1 microbatch ticks (the same schedule as the GPT engine,
+  parallelize.py);
+- gradients come from ``jax.grad`` through the whole schedule (scan /
+  ppermute / switch all have transposes), psum'd over ``pp`` so every rank
+  holds full grads; the Program's own backward ops are skipped;
+- the Program's optimizer tail (clip / regularizer / update ops appended by
+  the inner optimizer) then runs unchanged via the normal lowering, with the
+  computed grads seeded under their ``<param>@GRAD`` names — so any fluid
+  optimizer works un-modified under the pipeline.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import GRAD_SUFFIX, LowerCtx, run_lowering
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+# ---------------------------------------------------------------------------
+# annotation (written by PipelineOptimizer.minimize)
+# ---------------------------------------------------------------------------
+
+def annotate_pipeline(program, loss, n_fwd: int, bwd_end: int,
+                      num_stages: int, num_microbatches: int,
+                      cut_list=None, trainable_params: Sequence[str] = ()):
+    """Record the stage split on the program; the Executor routes programs
+    carrying this annotation through _CompiledPipelineBlock."""
+    block = program.global_block()
+    if cut_list:
+        producer = {}
+        for idx, op in enumerate(block.ops[:n_fwd]):
+            for name in op.output_arg_names:
+                producer[name] = idx
+        bounds = []
+        for cut in cut_list:
+            vars_ = cut if isinstance(cut, (list, tuple)) else [cut]
+            idxs = []
+            for v in vars_:
+                name = v.name if hasattr(v, "name") else v
+                if name not in producer:
+                    raise ValueError(
+                        f"pipeline cut variable {name!r} must be produced "
+                        "by a forward op (feeds and parameters cannot be "
+                        "stage boundaries)")
+                idxs.append(producer[name])
+            bounds.append(max(idxs) + 1)
+        bounds = sorted(set(bounds))
+        if bounds and bounds[-1] >= n_fwd:
+            bounds = [b for b in bounds if b < n_fwd]
+        stage_bounds = [0] + bounds + [n_fwd]
+    else:
+        S = int(num_stages)
+        per = max(1, n_fwd // S)
+        stage_bounds = [min(i * per, n_fwd) for i in range(S)] + [n_fwd]
+    stage_ranges = [(stage_bounds[i], stage_bounds[i + 1])
+                    for i in range(len(stage_bounds) - 1)]
+    program._annotations["pipeline"] = {
+        "stage_ranges": stage_ranges,
+        "n_fwd": n_fwd,
+        "bwd_end": bwd_end,
+        "loss": loss.name,
+        "microbatches": int(num_microbatches),
+        "trainable": list(trainable_params),
+    }
+    program._bump_version()
+
+
+# ---------------------------------------------------------------------------
+# compiled pipeline executable
+# ---------------------------------------------------------------------------
+
+class _CompiledPipelineBlock:
+    """Counterpart of executor._CompiledBlock for pipeline-annotated
+    programs. Same call contract: (scope, feeds, rng) -> fetches, and
+    persistable updates written back to the scope."""
+
+    def __init__(self, program, feed_sig, fetch_names, param_names,
+                 written_names, scope):
+        from ..parallel.mesh import build_mesh
+
+        ann = program._annotations["pipeline"]
+        block = program.global_block()
+        ops = block.ops
+        self.program = program
+        self.feed_names = [n for n, _, _ in feed_sig]
+        self.fetch_names = list(fetch_names)
+        self.param_names = list(param_names)
+        self.written_names = list(written_names)
+
+        stage_ranges: List[Tuple[int, int]] = ann["stage_ranges"]
+        S = len(stage_ranges)
+        M = ann["microbatches"]
+        loss_name = ann["loss"]
+        trainable = [n for n in ann["trainable"] if n in param_names]
+        opt_ops = ops[ann["bwd_end"]:]
+        self._S, self._M = S, M
+
+        # ---- static interface analysis -------------------------------------
+        producer: Dict[str, int] = {}
+        for idx, op in enumerate(ops[:ann["n_fwd"]]):
+            for name in op.output_arg_names:
+                producer[name] = idx
+        persist = set(param_names)
+        feed_set = set(self.feed_names)
+        # boundary b sits after stage b (b in 0..S-2)
+        iface_names: List[List[str]] = []
+        for b in range(S - 1):
+            bound = stage_ranges[b][1]
+            names = set()
+            for op in ops[bound:ann["n_fwd"]]:
+                for name in op.input_arg_names:
+                    p = producer.get(name)
+                    if p is None or p >= bound:
+                        continue
+                    if name in persist or name in feed_set:
+                        continue
+                    names.add(name)
+            iface_names.append(sorted(names))
+
+        # ---- shapes: abstract-eval the forward on one microbatch -----------
+        mb_feed_sig = []
+        batch = None
+        for name, shape, dt in feed_sig:
+            var = block.vars.get(name)
+            is_data = bool(getattr(var, "is_data", False)) and len(shape) > 0
+            if is_data:
+                batch = shape[0] if batch is None else batch
+        if batch is None:
+            raise ValueError("pipeline program has no batched data feeds")
+        if batch % M != 0:
+            raise ValueError(
+                f"batch {batch} not divisible by num_microbatches {M}")
+        mb = batch // M
+        self._batched_feeds = set()
+        for name, shape, dt in feed_sig:
+            var = block.vars.get(name)
+            if (getattr(var, "is_data", False) and shape and
+                    shape[0] == batch):
+                self._batched_feeds.add(name)
+                mb_feed_sig.append((name, (mb,) + tuple(shape[1:]), dt))
+            else:
+                mb_feed_sig.append((name, tuple(shape), dt))
+
+        def _aval_of(v):
+            a = jnp.asarray(v) if not hasattr(v, "dtype") else v
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+        param_avals = {n: _aval_of(scope.find_var(n)) for n in param_names
+                       if scope.has_var(n)}
+        feed_avals = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
+                      for n, s, d in mb_feed_sig}
+
+        def fwd_probe(params, feeds):
+            env = dict(params)
+            env.update(feeds)
+            ctx = LowerCtx(program, block, env,
+                           rng_key=jax.random.PRNGKey(0))
+            for op in ops[:ann["n_fwd"]]:
+                run_lowering(ctx, op)
+            return [{n: env[n] for n in names} for names in iface_names]
+
+        iface_avals = jax.eval_shape(fwd_probe, param_avals, feed_avals)
+
+        # ---- carry packing: one fixed-size float32 vector ------------------
+        layouts = []  # per boundary: [(name, shape, size, dtype)]
+        sizes = []
+        for b, avals in enumerate(iface_avals):
+            lay = []
+            total = 0
+            for name in iface_names[b]:
+                av = avals[name]
+                if not jnp.issubdtype(av.dtype, jnp.floating):
+                    raise NotImplementedError(
+                        f"pipeline boundary var {name!r} has dtype "
+                        f"{av.dtype}; only floating interfaces are supported")
+                n_el = int(np.prod(av.shape)) if av.shape else 1
+                lay.append((name, tuple(av.shape), n_el, av.dtype))
+                total += n_el
+            layouts.append(lay)
+            sizes.append(total)
+        K = max(sizes) if sizes else 1
+        self._iface_elems = K
+
+        def pack(b, env):
+            if not layouts[b]:
+                return jnp.zeros((K,), jnp.float32)
+            parts = [env[name].astype(jnp.float32).reshape(-1)
+                     for name, _, _, _ in layouts[b]]
+            vec = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            pad = K - vec.shape[0]
+            return jnp.pad(vec, (0, pad)) if pad else vec
+
+        def unpack(b, vec):
+            out = {}
+            off = 0
+            for name, shape, n_el, dtype in layouts[b]:
+                out[name] = vec[off:off + n_el].reshape(shape).astype(dtype)
+                off += n_el
+            return out
+
+        mesh = build_mesh((("pp", S),))
+        self.mesh = mesh
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        n_fwd = ann["n_fwd"]
+
+        def per_rank(mutable_params, const_params, feeds, rng_key):
+            stage = jax.lax.axis_index("pp")
+            base_params = dict(const_params)
+            base_params.update(mutable_params)
+            split = {}
+            for n, f in feeds.items():
+                if n in self._batched_feeds:
+                    split[n] = f.reshape((M, mb) + tuple(f.shape[1:]))
+                else:
+                    split[n] = f
+
+            def loss_fn(train_params):
+                params = dict(base_params)
+                params.update(train_params)
+
+                def tick(carry, t):
+                    iface, loss_sum = carry
+                    m = jnp.clip(t - stage, 0, M - 1)
+                    feeds_mb = {
+                        n: (jax.lax.dynamic_index_in_dim(f, m, 0,
+                                                         keepdims=False)
+                            if n in self._batched_feeds else f)
+                        for n, f in split.items()
+                    }
+
+                    def make_branch(s):
+                        lo, hi = stage_ranges[s]
+
+                        def branch(vec):
+                            env = dict(params)
+                            env.update(feeds_mb)
+                            if s > 0:
+                                env.update(unpack(s - 1, vec))
+                            ctx = LowerCtx(program, block, env,
+                                           rng_key=rng_key)
+                            for op in ops[lo:hi]:
+                                run_lowering(ctx, op)
+                            if s < S - 1:
+                                return (pack(s, env),
+                                        jnp.zeros((), jnp.float32))
+                            loss = env[loss_name].astype(jnp.float32)
+                            return (jnp.zeros((K,), jnp.float32),
+                                    loss.reshape(()))
+
+                        return branch
+
+                    out, mb_loss = jax.lax.switch(
+                        stage, [make_branch(s) for s in range(S)], iface)
+                    valid = ((t - stage) >= 0) & ((t - stage) < M)
+                    is_last = stage == S - 1
+                    loss_sum = loss_sum + jnp.where(valid & is_last,
+                                                    mb_loss, 0.0)
+                    nxt = (jax.lax.ppermute(out, "pp", perm)
+                           if S > 1 else out)
+                    return (nxt, loss_sum), None
+
+                carry0 = (jnp.zeros((K,), jnp.float32),
+                          jnp.zeros((), jnp.float32))
+                (_, loss_sum), _ = jax.lax.scan(
+                    tick, carry0, jnp.arange(M + S - 1))
+                # rank-LOCAL loss (only the last stage is nonzero): grads
+                # must not differentiate through a psum — its shard_map
+                # transpose re-psums the cotangent, inflating grads by S
+                return loss_sum / M
+
+            train_params = {n: mutable_params[n] for n in trainable
+                            if n in mutable_params}
+            local_loss, grads = jax.value_and_grad(loss_fn)(train_params)
+            loss_val = jax.lax.psum(local_loss, "pp")
+            grads = {n: jax.lax.psum(g, "pp") for n, g in grads.items()}
+
+            # ---- optimizer tail: the Program's own update ops -------------
+            env = dict(base_params)
+            env.update({n: f for n, f in feeds.items()
+                        if n not in self._batched_feeds})
+            env[loss_name] = loss_val
+            for n, g in grads.items():
+                env[n + GRAD_SUFFIX] = g
+            ctx = LowerCtx(program, block, env, rng_key=rng_key)
+            for op in opt_ops:
+                run_lowering(ctx, op)
+
+            fetches = []
+            for name in self.fetch_names:
+                if name == loss_name:
+                    fetches.append(jnp.atleast_1d(loss_val))
+                elif name in env:
+                    fetches.append(jnp.atleast_1d(env[name]))
+                else:
+                    raise NotImplementedError(
+                        f"pipeline fetch {name!r}: only the loss, "
+                        "persistables, and optimizer-phase outputs are "
+                        "fetchable")
+            new_state = {n: env[n] for n in self.written_names if n in env}
+            return fetches, new_state
+
+        from jax.sharding import PartitionSpec as P
+
+        written = set(written_names)
+        mutable_specs = {n: P() for n in param_names if n in written}
+        const_specs = {n: P() for n in param_names if n not in written}
+        feed_specs = {n: P() for n, _, _ in feed_sig}
+        fetch_specs = [P() for _ in fetch_names]
+
+        def _make_jit(produced_state_names):
+            state_specs = {n: P() for n in produced_state_names}
+
+            def wrapped_per_rank(mutable_params, const_params, feeds, key):
+                fetches, new_state = per_rank(mutable_params, const_params,
+                                              feeds, key)
+                return fetches, {n: new_state[n]
+                                 for n in produced_state_names}
+
+            kwargs = dict(mesh=mesh,
+                          in_specs=(mutable_specs, const_specs, feed_specs,
+                                    P()),
+                          out_specs=(fetch_specs, state_specs))
+            try:
+                w = _shard_map(wrapped_per_rank, **kwargs, check_vma=False)
+            except TypeError:
+                w = _shard_map(wrapped_per_rank, **kwargs, check_rep=False)
+            return jax.jit(w, donate_argnums=(0,))
+
+        # discover which written names the opt phase actually produces, via
+        # an eval_shape of per_rank under a fake axis context: simplest is to
+        # run eval_shape on the shard-mapped function itself
+        # the opt-phase env starts from every scope persistable, so all
+        # written names are bound; restrict to the ones present in the scope
+        produced = [n for n in self.written_names if scope.has_var(n)]
+        self._jitted = _make_jit(produced)
+        self._produced = produced
+
+    def __call__(self, scope, feed, rng_key):
+        mutable, const = {}, {}
+        written = set(self.written_names)
+        for n in self.param_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} is not initialized in scope — "
+                    "run the startup program first")
+            (mutable if n in written else const)[n] = v
+        feeds = {n: feed[n] for n in self.feed_names}
+        fetches, new_state = self._jitted(mutable, const, feeds, rng_key)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        return fetches

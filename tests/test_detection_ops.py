@@ -255,6 +255,42 @@ def test_sigmoid_focal_loss_ignore_label():
     assert np.abs(got[0]).sum() > 0 and np.abs(got[2]).sum() > 0
 
 
+def test_box_clip_scale():
+    """im_info dims are for the RESIZED image; boxes are clipped in the
+    original frame (bbox_util.h ClipTiledBoxes divides by scale)."""
+    boxes = np.array([[0, 0, 500, 500]], np.float32)
+    im_info = np.array([[600, 800, 2.0]], np.float32)  # original 300x400
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        bv = fluid.layers.data("boxes", [4], dtype="float32")
+        iv = fluid.layers.data("im_info", [3], dtype="float32")
+        out = layers.box_clip(bv, iv)
+    got = _run(prog, {"boxes": boxes, "im_info": im_info}, [out])[0]
+    np.testing.assert_allclose(got[0], [0, 0, 399, 299])
+
+
+def test_sigmoid_focal_loss_confident_negative_grad():
+    """Gradient must stay nonzero for confident false positives (the naive
+    -log(clip(1-p)) form flatlines above logit ~17)."""
+    x = np.full((1, 2), 20.0, np.float32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", [2], dtype="float32")
+        xv.stop_gradient = False
+        lv = fluid.layers.data("label", [1], dtype="int64")
+        fv = fluid.layers.data("fg", [], dtype="int64")
+        out = layers.sigmoid_focal_loss(xv, lv, fv)
+        loss = fluid.layers.reduce_sum(out)
+        from paddle_tpu.framework.backward import append_backward
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    g = np.asarray(exe.run(prog, feed={"x": x,
+                                       "label": np.zeros((1, 1), np.int64),
+                                       "fg": np.array([1], np.int64)},
+                           fetch_list=["x@GRAD"])[0])
+    assert np.all(np.abs(g) > 0.1), g  # ~ (1-alpha) * 1 * p^gamma
+
+
 def test_box_clip_batched_per_image():
     boxes = np.array([[[0, 0, 500, 500]], [[0, 0, 500, 500]]], np.float32)
     im_info = np.array([[300, 300, 1.0], [800, 800, 1.0]], np.float32)
