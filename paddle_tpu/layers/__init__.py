@@ -6,6 +6,8 @@ from . import nn  # noqa: F401
 from . import tensor  # noqa: F401
 from . import rnn  # noqa: F401
 from .rnn import lstm, gru, beam_search, beam_search_decode  # noqa: F401
+from . import sequence  # noqa: F401
+from .sequence import *  # noqa: F401,F403
 from . import detection  # noqa: F401
 from .detection import *  # noqa: F401,F403
 from . import collective  # noqa: F401

@@ -1,0 +1,193 @@
+"""Sequence-op layer surface — fluid/layers/sequence_lod.py + the CRF/CTC
+entries of fluid/layers/nn.py (linear_chain_crf:1696, crf_decoding:1797,
+warpctc) over the dense padded ops in ops/sequence.py and ops/crf.py.
+
+Dense convention: sequences are (batch, max_len, ...) plus an explicit
+length tensor where the reference threads LoD.
+"""
+from __future__ import annotations
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_reverse", "sequence_conv",
+    "sequence_slice", "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_mask", "linear_chain_crf", "crf_decoding", "warpctc",
+]
+
+
+def sequence_pool(input, pool_type, length=None, pad_value=0.0):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_pool", inputs=ins,
+                     outputs={"Out": [out.name]},
+                     attrs={"pooltype": pool_type.upper(),
+                            "pad_value": float(pad_value)})
+    return out
+
+
+def sequence_softmax(input, length=None):
+    helper = LayerHelper("sequence_softmax")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_softmax", inputs=ins,
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def sequence_reverse(x, length=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_reverse", inputs=ins,
+                     outputs={"Y": [out.name]}, attrs={})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, length=None,
+                  bias_attr=None, param_attr=None, act=None, name=None):
+    """fluid.layers.sequence_conv (sequence_conv_op.cc)."""
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = input.shape[-1]
+    filt = helper.create_parameter(param_attr,
+                                   shape=[filter_size * d, num_filters],
+                                   dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "Filter": [filt]}
+    if length is not None:
+        ins["Length"] = [length]
+    if padding_start is None:
+        padding_start = -((filter_size - 1) // 2)
+    helper.append_op(
+        type="sequence_conv", inputs=ins, outputs={"Out": [out.name]},
+        attrs={"contextLength": int(filter_size),
+               "contextStart": int(padding_start),
+               "contextStride": int(filter_stride)})
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out.name], "OutLength": [out_len.name]}, attrs={})
+    return out
+
+
+def sequence_expand_as(x, y, y_length=None, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": [x], "Y": [y]}
+    if y_length is not None:
+        ins["YLength"] = [y_length]
+    helper.append_op(type="sequence_expand_as", inputs=ins,
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Returns (Out, Length) like the reference sequence_pad."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    ins = {"X": [x], "PadValue": [pad_value]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_pad", inputs=ins,
+                     outputs={"Out": [out.name], "Length": [out_len.name]},
+                     attrs={"padded_length": -1 if maxlen is None
+                            else int(maxlen)})
+    return out, out_len
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out.name]},
+                     attrs={"maxlen": -1 if maxlen is None else int(maxlen),
+                            "out_dtype": dtype})
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
+    """fluid.layers.linear_chain_crf (layers/nn.py:1696). input [B,T,D]
+    emissions; label [B,T]; length [B]. Returns the NLL [B,1]; the
+    transition parameter is created as '<name>.w' ([D+2, D])."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr, name=name)
+    size = input.shape[-1]
+    transition = helper.create_parameter(param_attr,
+                                         shape=[size + 2, size],
+                                         dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    e_exps = helper.create_variable_for_type_inference(input.dtype)
+    t_exps = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf", inputs=ins,
+        outputs={"LogLikelihood": [ll.name], "Alpha": [alpha.name],
+                 "EmissionExps": [e_exps.name],
+                 "TransitionExps": [t_exps.name]},
+        attrs={})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    """fluid.layers.crf_decoding (layers/nn.py:1797): viterbi path, or the
+    per-position correctness indicator when label is given."""
+    helper = LayerHelper("crf_decoding", name=name)
+    trans_name = (param_attr.name if hasattr(param_attr, "name")
+                  else str(param_attr))
+    transition = helper.main_program.global_block().var(trans_name)
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [path.name]}, attrs={})
+    return path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """fluid.layers.warpctc (warpctc_op.cc, padding mode): input [B,T,C]
+    raw logits, label [B,Lmax]."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op(type="warpctc", inputs=ins,
+                     outputs={"Loss": [loss.name]},
+                     attrs={"blank": int(blank),
+                            "norm_by_times": bool(norm_by_times)})
+    return loss

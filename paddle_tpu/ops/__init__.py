@@ -10,3 +10,4 @@ from . import rnn  # noqa: F401
 from . import detection  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import beam_search  # noqa: F401
+from . import crf  # noqa: F401

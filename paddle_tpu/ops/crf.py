@@ -1,0 +1,228 @@
+"""CRF + CTC ops — the sequence-labeling losses the reference ships as
+linear_chain_crf_op.{cc,h}, crf_decoding_op.h, and warpctc_op.cc (external
+warp-ctc library).
+
+Dense TPU formulation (batch, max_len, ...) + Length masks, all recursions
+as lax.scan in log space — one compiled XLA While instead of the reference's
+per-sequence CPU loops, differentiable end-to-end by jax.vjp (warpctc's
+hand-written grad kernel becomes autodiff through the alpha recursion).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.registry import register_op
+
+_NEG = -1e30
+
+
+def crf_nll(emission, transition, label, length):
+    """Negative log likelihood per sequence.
+
+    emission [B,T,D]; transition [D+2,D] (row0 start, row1 end, 2+ pairwise);
+    label [B,T] int; length [B]. Matches linear_chain_crf_op.h semantics
+    (test_linear_chain_crf_op.py oracle).
+    """
+    B, T, D = emission.shape
+    start = transition[0]
+    stop = transition[1]
+    trans = transition[2:]                      # [D,D] trans[i,j]: i -> j
+    e = emission.astype(jnp.float32)
+    lab = label.astype(jnp.int32)
+    L = length.astype(jnp.int32)
+
+    # ---- partition function: alpha recursion in log space ----------------
+    alpha0 = start[None, :] + e[:, 0]           # [B,D]
+
+    def step(alpha, t):
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) \
+            + e[:, t]
+        live = (t < L)[:, None]
+        return jnp.where(live, nxt, alpha), None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)   # [B]
+
+    # ---- gold path score --------------------------------------------------
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < L[:, None]                   # [B,T]
+    em_score = jnp.take_along_axis(e, lab[..., None], axis=2)[..., 0]
+    em_score = jnp.where(valid, em_score, 0.0).sum(axis=1)
+    pair = trans[lab[:, :-1], lab[:, 1:]]        # [B,T-1]
+    pair = jnp.where(valid[:, 1:], pair, 0.0).sum(axis=1)
+    last = jnp.take_along_axis(lab, (L - 1)[:, None], axis=1)[:, 0]
+    score = em_score + pair + start[lab[:, 0]] + stop[last]
+    return (logz - score)[:, None]               # [B,1] NLL
+
+
+@register_op("linear_chain_crf", diff_inputs=("Emission", "Transition"))
+def linear_chain_crf(ctx, op, ins):
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    if "Length" in ins and ins["Length"]:
+        length = ins["Length"][0].reshape(-1)
+    else:
+        length = jnp.full((emission.shape[0],), emission.shape[1], jnp.int32)
+    nll = crf_nll(emission, transition, label, length)
+    # parity outputs (the reference exposes its normalized-exp intermediates)
+    return {"LogLikelihood": nll,
+            "EmissionExps": jnp.exp(emission - emission.max(-1, keepdims=True)),
+            "TransitionExps": jnp.exp(transition),
+            "Alpha": jnp.zeros_like(emission)}
+
+
+def crf_viterbi(emission, transition, length):
+    """Viterbi decode. Returns [B,T] int64 best path (0 past length)."""
+    B, T, D = emission.shape
+    start = transition[0]
+    stop = transition[1]
+    trans = transition[2:]
+    e = emission.astype(jnp.float32)
+    L = length.astype(jnp.int32)
+
+    v0 = start[None, :] + e[:, 0]                # [B,D]
+
+    def fwd(v, t):
+        scores = v[:, :, None] + trans[None]     # [B,D,D]
+        best = scores.max(axis=1) + e[:, t]
+        arg = scores.argmax(axis=1)              # [B,D] backpointer
+        live = (t < L)[:, None]
+        return jnp.where(live, best, v), jnp.where(live, arg, -1)
+
+    v, bptrs = lax.scan(fwd, v0, jnp.arange(1, T))   # bptrs [T-1,B,D]
+    final = v + stop[None, :]
+    last_tag = final.argmax(axis=1)              # [B]
+
+    def back(tag, bp):
+        # bp [B,D]: best predecessor of each tag; -1 marks a dead (padded)
+        # step, where the tag just propagates backwards unchanged
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return jnp.where(prev >= 0, prev, tag), tag
+
+    tag0, path_rev = lax.scan(back, last_tag, bptrs[::-1])
+    # path_rev holds tags for positions T-1 .. 1; tag0 is position 0
+    path = jnp.concatenate([tag0[None], path_rev[::-1]], axis=0).T  # [B,T]
+    t_idx = jnp.arange(T)[None, :]
+    return jnp.where(t_idx < L[:, None], path, 0).astype(jnp.int64)
+
+
+@register_op("crf_decoding", grad=None)
+def crf_decoding(ctx, op, ins):
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    if "Length" in ins and ins["Length"]:
+        length = ins["Length"][0].reshape(-1)
+    else:
+        length = jnp.full((emission.shape[0],), emission.shape[1], jnp.int32)
+    path = crf_viterbi(emission, transition, length)
+    if "Label" in ins and ins["Label"]:
+        label = ins["Label"][0]
+        if label.ndim == 3:
+            label = label[..., 0]
+        t_idx = jnp.arange(path.shape[1])[None, :]
+        valid = t_idx < length.astype(jnp.int32)[:, None]
+        # crf_decoding_op.h: with Label, emit 1 where path==label (0 in pad)
+        path = jnp.where(valid & (label.astype(jnp.int64) == path), 1, 0) \
+            .astype(jnp.int64)
+    return {"ViterbiPath": path}
+
+
+# ---------------------------------------------------------------------------
+# CTC (warpctc_op.cc) — log-space alpha recursion, autodiff grads
+# ---------------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels, logit_lens, label_lens, blank=0):
+    """log_probs [B,T,C] (log-softmaxed); labels [B,Lmax] int; returns [B]
+    negative log likelihood.
+    """
+    B, T, C = log_probs.shape
+    Lmax = labels.shape[1]
+    S = 2 * Lmax + 1
+    lab = labels.astype(jnp.int32)
+    llen = label_lens.astype(jnp.int32)
+    tlen = logit_lens.astype(jnp.int32)
+
+    # extended sequence l' = [blank, l1, blank, l2, ..., blank]
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    s_idx = jnp.arange(S)[None, :]
+    s_valid = s_idx < (2 * llen + 1)[:, None]     # [B,S]
+    # skip-transition allowed where l'[s] != blank and l'[s] != l'[s-2]
+    can_skip = jnp.zeros((B, S), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    def emit(t):
+        # log P(l'[s] at time t): gather [B,S]
+        return jnp.take_along_axis(log_probs[:, t], ext, axis=1)
+
+    a0 = jnp.full((B, S), _NEG)
+    a0 = a0.at[:, 0].set(log_probs[:, 0, blank])
+    first_lab = jnp.take_along_axis(log_probs[:, 0], lab[:, :1], axis=1)[:, 0]
+    a0 = a0.at[:, 1].set(jnp.where(llen > 0, first_lab, _NEG))
+    a0 = jnp.where(s_valid, a0, _NEG)
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((B, 1), _NEG), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), _NEG), alpha[:, :-2]], 1)
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        nxt = jnp.where(s_valid, merged + emit(t), _NEG)
+        live = (t < tlen)[:, None]
+        return jnp.where(live, nxt, alpha), None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, T))
+    end1 = jnp.take_along_axis(alpha, (2 * llen)[:, None], axis=1)[:, 0]
+    end2_idx = jnp.clip(2 * llen - 1, 0, S - 1)
+    end2 = jnp.take_along_axis(alpha, end2_idx[:, None], axis=1)[:, 0]
+    end2 = jnp.where(llen > 0, end2, _NEG)
+    return -jnp.logaddexp(end1, end2)
+
+
+@register_op("warpctc", diff_inputs=("Logits",))
+def warpctc(ctx, op, ins):
+    """warpctc_op.cc in padding mode: Logits [B,T,C] raw activations
+    (softmax applied internally, like warp-ctc), Label [B,Lmax],
+    LogitsLength [B], LabelLength [B]."""
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0]
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    B, T, C = logits.shape
+    tlen = (ins["LogitsLength"][0].reshape(-1)
+            if "LogitsLength" in ins and ins["LogitsLength"]
+            else jnp.full((B,), T, jnp.int32))
+    llen = (ins["LabelLength"][0].reshape(-1)
+            if "LabelLength" in ins and ins["LabelLength"]
+            else jnp.full((B,), labels.shape[1], jnp.int32))
+    blank = int(op.attr("blank", 0))
+    if bool(op.attr("norm_by_times", False)):
+        # warp-ctc normalizes only the GRADIENT by sequence length; the
+        # Loss output stays unscaled (warpctc_op.h WarpCTCGradKernel)
+        inv_t = (1.0 / jnp.maximum(tlen.astype(jnp.float32), 1.0)) \
+            .reshape(-1, 1, 1)
+        logits = _scale_grad(logits, inv_t)
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    loss = ctc_loss(log_probs, labels, tlen, llen, blank=blank)
+    return {"Loss": loss[:, None]}
+
+
+@jax.custom_vjp
+def _scale_grad(x, scale):
+    return x
+
+
+def _scale_grad_fwd(x, scale):
+    return x, scale
+
+
+def _scale_grad_bwd(scale, ct):
+    return (ct * scale, None)
+
+
+_scale_grad.defvjp(_scale_grad_fwd, _scale_grad_bwd)

@@ -64,6 +64,10 @@ def sequence_pool(ctx, op, ins):
         out = x[:, 0]
     else:
         raise NotImplementedError(ptype)
+    # zero-length sequences yield pad_value (sequence_pool_op.h), not
+    # -inf (MAX) / 0 (SUM)
+    pad_value = jnp.asarray(op.attr("pad_value", 0.0), out.dtype)
+    out = jnp.where((ln > 0)[:, None], out, pad_value)
     return {"Out": out, "MaxIndex": None}
 
 
@@ -107,9 +111,27 @@ def sequence_concat(ctx, op, ins):
 
 @register_op("sequence_pad", diff_inputs=("X",))
 def sequence_pad(ctx, op, ins):
-    # dense representation: already padded; passthrough + lengths
+    """Dense frame is already padded; this re-pads to padded_length with
+    PadValue past each row's Length (sequence_pad_op.cc)."""
     x = ins["X"][0]
-    return {"Out": x, "Length": jnp.full((x.shape[0],), x.shape[1], jnp.int64)}
+    B, T = x.shape[0], x.shape[1]
+    pad_value = (ins["PadValue"][0].reshape(())
+                 if ins.get("PadValue") else jnp.asarray(0.0, x.dtype))
+    length = (ins["Length"][0].reshape(-1).astype(jnp.int32)
+              if ins.get("Length")
+              else jnp.full((B,), T, jnp.int32))
+    padded_len = int(op.attr("padded_length", -1))
+    if padded_len > 0 and padded_len != T:
+        if padded_len < T:
+            x = x[:, :padded_len]
+        else:
+            widths = [(0, 0), (0, padded_len - T)] + [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, widths)
+        T = padded_len
+    t = jnp.arange(T)[None, :].reshape((1, T) + (1,) * (x.ndim - 2))
+    valid = t < length.reshape((B,) + (1,) * (x.ndim - 1))
+    out = jnp.where(valid, x, pad_value.astype(x.dtype))
+    return {"Out": out, "Length": length.astype(jnp.int64)}
 
 
 @register_op("sequence_unpad", diff_inputs=("X",))
@@ -130,3 +152,75 @@ def im2sequence(ctx, op, ins):
     )
     n, ckk, oh, ow = patches.shape
     return {"Out": patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)}
+
+
+@register_op("sequence_conv", diff_inputs=("X", "Filter"))
+def sequence_conv(ctx, op, ins):
+    """sequence_ops/sequence_conv_op: context-window projection.
+
+    X [B,T,D] padded (+Length); Filter [context_length*D, F];
+    out[b,t] = concat_k x[b, t+context_start+k] @ Filter, zero outside the
+    window / past Length (the reference's im2col over LoD rows).
+    """
+    x = ins["X"][0]
+    filt = ins["Filter"][0]
+    length = ins["Length"][0].reshape(-1) if ins.get("Length") else None
+    ctx_len = int(op.attr("contextLength"))
+    ctx_start = int(op.attr("contextStart", -((ctx_len - 1) // 2)))
+    if int(op.attr("contextStride", 1)) != 1:
+        raise NotImplementedError(
+            "sequence_conv only supports contextStride=1 (the reference "
+            "enforces the same, sequence_conv_op.cc)")
+    B, T, D = x.shape
+    if length is not None:
+        t_idx = jnp.arange(T)[None, :, None]
+        x = jnp.where(t_idx < length[:, None, None], x, 0.0)
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(x, -off, axis=1)
+        t = jnp.arange(T)
+        valid = ((t + off >= 0) & (t + off < T))[None, :, None]
+        cols.append(jnp.where(valid, shifted, 0.0))
+    stacked = jnp.concatenate(cols, axis=-1)          # [B,T,ctx*D]
+    out = stacked @ filt
+    if length is not None:
+        t_idx = jnp.arange(T)[None, :, None]
+        out = jnp.where(t_idx < length[:, None, None], out, 0.0)
+    return {"Out": out}
+
+
+@register_op("sequence_slice", diff_inputs=("X",))
+def sequence_slice(ctx, op, ins):
+    """sequence_ops/sequence_slice_op: per-sequence [offset, offset+length)
+    window, left-aligned into the padded frame (output Length = Length)."""
+    x = ins["X"][0]                       # [B,T,...]
+    offset = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = jnp.clip(t + offset[:, None], 0, T - 1)     # [B,T]
+    idx = src.reshape((B, T) + (1,) * (x.ndim - 2))
+    shifted = jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)
+    valid = t < length[:, None]
+    valid = valid.reshape((B, T) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(valid, shifted, 0),
+            "OutLength": length}
+
+
+@register_op("sequence_expand_as", diff_inputs=("X",))
+def sequence_expand_as(ctx, op, ins):
+    """sequence_ops/sequence_expand_as_op: broadcast each sequence's single
+    row across Y's length. X [B,D]; YLength [B] -> Out [B,Ty,D] (row b
+    repeated, zero past its length). Ty comes from Y's padded frame."""
+    x = ins["X"][0]                       # [B,D]
+    y = ins["Y"][0]                       # [B,Ty,...] gives the frame
+    length = (ins["YLength"][0].reshape(-1).astype(jnp.int32)
+              if ins.get("YLength")
+              else jnp.full((x.shape[0],), y.shape[1], jnp.int32))
+    B, D = x.shape[0], x.shape[-1]
+    Ty = y.shape[1]
+    out = jnp.broadcast_to(x[:, None, :], (B, Ty, D))
+    t = jnp.arange(Ty)[None, :, None]
+    zero = jnp.zeros((), out.dtype)  # 0.0 would promote int inputs
+    return {"Out": jnp.where(t < length[:, None, None], out, zero)}

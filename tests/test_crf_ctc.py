@@ -1,0 +1,333 @@
+"""CRF / CTC / remaining sequence ops vs oracles.
+
+linear_chain_crf + crf_decoding against brute-force path enumeration
+(exactly what test_linear_chain_crf_op.py's oracle computes, minus the
+incremental normalization); warpctc against torch.nn.functional.ctc_loss.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(prog, feed, fetch, scope=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return [np.asarray(v) for v in
+            exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)]
+
+
+def _brute_crf(emission, transition, label, length):
+    """Enumerate all paths: exact logZ and gold score; returns NLL."""
+    T_, D = emission.shape
+    L = int(length)
+    start, stop, trans = transition[0], transition[1], transition[2:]
+
+    def path_score(path):
+        s = start[path[0]] + emission[0, path[0]]
+        for t in range(1, L):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        return s + stop[path[L - 1]]
+
+    scores = [path_score(p) for p in itertools.product(range(D), repeat=L)]
+    logz = np.log(np.sum(np.exp(np.array(scores, np.float64))))
+    return float(logz - path_score(list(label[:L])))
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, D = 3, 4, 3
+    emission = rng.randn(B, T, D).astype(np.float32)
+    transition = (rng.randn(D + 2, D) * 0.5).astype(np.float32)
+    label = rng.randint(0, D, (B, T)).astype(np.int64)
+    length = np.array([4, 2, 3], np.int64)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        em = fluid.layers.data("em", [T, D], dtype="float32")
+        lb = fluid.layers.data("lb", [T], dtype="int64")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        nll = layers.linear_chain_crf(em, lb, length=ln,
+                                      param_attr=fluid.ParamAttr("crf_w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        import jax.numpy as jnp
+        scope.set_var("crf_w", jnp.asarray(transition))
+        got = _run(prog, {"em": emission, "lb": label, "ln": length},
+                   [nll], scope=scope)[0]
+    for b in range(B):
+        exp = _brute_crf(emission[b], transition, label[b], length[b])
+        np.testing.assert_allclose(got[b, 0], exp, rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    B, T, D = 2, 4, 3
+    emission = rng.randn(B, T, D).astype(np.float32)
+    transition = (rng.randn(D + 2, D) * 0.5).astype(np.float32)
+    length = np.array([4, 3], np.int64)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        em = fluid.layers.data("em", [T, D], dtype="float32")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        # create the transition param through the crf layer, then decode
+        lb = fluid.layers.data("lb", [T], dtype="int64")
+        layers.linear_chain_crf(em, lb, length=ln,
+                                param_attr=fluid.ParamAttr("crf_w2"))
+        path = layers.crf_decoding(em, fluid.ParamAttr("crf_w2"), length=ln)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        import jax.numpy as jnp
+        scope.set_var("crf_w2", jnp.asarray(transition))
+        got = _run(prog, {"em": emission, "ln": length,
+                          "lb": np.zeros((B, T), np.int64)},
+                   [path], scope=scope)[0]
+
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    for b in range(B):
+        L = int(length[b])
+        best, best_path = -1e30, None
+        for p in itertools.product(range(D), repeat=L):
+            s = start[p[0]] + emission[b, 0, p[0]]
+            for t in range(1, L):
+                s += trans[p[t - 1], p[t]] + emission[b, t, p[t]]
+            s += stop[p[L - 1]]
+            if s > best:
+                best, best_path = s, p
+        np.testing.assert_array_equal(got[b, :L], best_path)
+        assert (got[b, L:] == 0).all()
+
+
+def test_warpctc_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(2)
+    B, T, C, Lmax = 3, 6, 5, 3
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, Lmax)).astype(np.int64)
+    tlen = np.array([6, 5, 4], np.int64)
+    llen = np.array([3, 2, 1], np.int64)
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        lg = fluid.layers.data("lg", [T, C], dtype="float32")
+        lb = fluid.layers.data("lb", [Lmax], dtype="int64")
+        tl = fluid.layers.data("tl", [], dtype="int64")
+        ll = fluid.layers.data("ll", [], dtype="int64")
+        loss = layers.warpctc(lg, lb, blank=0, input_length=tl,
+                              label_length=ll)
+    got = _run(prog, {"lg": logits, "lb": labels, "tl": tlen, "ll": llen},
+               [loss])[0]
+
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1).transpose(0, 1)
+    exp = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels), torch.tensor(tlen), torch.tensor(llen),
+        blank=0, reduction="none", zero_infinity=False)
+    np.testing.assert_allclose(got[:, 0], exp.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_warpctc_trains():
+    """CTC loss decreases when training logits toward a target labeling."""
+    rng = np.random.RandomState(3)
+    B, T, C, L = 2, 8, 4, 2
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [T, 8], dtype="float32")
+        lb = fluid.layers.data("lb", [L], dtype="int64")
+        logits = fluid.layers.fc(x, C, num_flatten_dims=2)
+        loss = fluid.layers.reduce_mean(layers.warpctc(logits, lb))
+        fluid.optimizer.AdamOptimizer(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xb = rng.randn(B, T, 8).astype(np.float32)
+    yb = rng.randint(1, C, (B, L)).astype(np.int64)
+    ls = [float(_run(prog, {"x": xb, "lb": yb}, [loss])[0])
+          for _ in range(25)]
+    assert ls[-1] < 0.5 * ls[0], (ls[0], ls[-1])
+
+
+def test_crf_trains_and_decodes():
+    """End-to-end: emissions + CRF learn a noisy tag mapping; viterbi
+    recovers the tags (label_semantic_roles-style micro-task)."""
+    rng = np.random.RandomState(4)
+    B, T, D, V = 32, 6, 4, 12
+    words = rng.randint(0, V, (B, T)).astype(np.int64)
+    tags = (words % D).astype(np.int64)
+    length = np.full((B,), T, np.int64)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        w = fluid.layers.data("w", [T], dtype="int64")
+        tg = fluid.layers.data("tg", [T], dtype="int64")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        emb = fluid.layers.embedding(w, size=[V, 16])
+        em = fluid.layers.fc(emb, D, num_flatten_dims=2)
+        nll = layers.linear_chain_crf(em, tg, length=ln,
+                                      param_attr=fluid.ParamAttr("crf_w3"))
+        loss = fluid.layers.reduce_mean(nll)
+        fluid.optimizer.AdamOptimizer(5e-2).minimize(loss)
+        path = layers.crf_decoding(em, fluid.ParamAttr("crf_w3"), length=ln)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = {"w": words, "tg": tags, "ln": length}
+        ls = []
+        for _ in range(60):
+            ls.append(float(_run(prog, feed, [loss], scope=scope)[0]))
+        assert ls[-1] < 0.3 * ls[0], (ls[0], ls[-1])
+        infer = prog.clone(for_test=True)
+        got = _run(infer, feed, [path], scope=scope)[0]
+    acc = float((got == tags).mean())
+    assert acc > 0.95, acc
+
+
+# ---------------------------------------------------------------------------
+# remaining sequence ops
+# ---------------------------------------------------------------------------
+
+def test_sequence_conv_matches_numpy():
+    rng = np.random.RandomState(5)
+    B, T, D, F = 2, 5, 3, 4
+    ctx_len, ctx_start = 3, -1
+    x = rng.randn(B, T, D).astype(np.float32)
+    filt = rng.randn(ctx_len * D, F).astype(np.float32)
+    length = np.array([5, 3], np.int64)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", [T, D], dtype="float32")
+        lv = fluid.layers.data("len", [], dtype="int64")
+        out = layers.sequence_conv(xv, F, filter_size=ctx_len,
+                                   padding_start=ctx_start, length=lv,
+                                   bias_attr=False,
+                                   param_attr=fluid.ParamAttr("sc_w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        import jax.numpy as jnp
+        scope.set_var("sc_w", jnp.asarray(filt))
+        got = _run(prog, {"x": x, "len": length}, [out], scope=scope)[0]
+
+    exp = np.zeros((B, T, F), np.float32)
+    for b in range(B):
+        L = int(length[b])
+        for t in range(L):
+            window = []
+            for k in range(ctx_len):
+                src = t + ctx_start + k
+                window.append(x[b, src] if 0 <= src < L
+                              else np.zeros(D, np.float32))
+            exp[b, t] = np.concatenate(window) @ filt
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_slice():
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    offset = np.array([[1], [0]], np.int64)
+    length = np.array([[2], [3]], np.int64)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [4, 3], dtype="float32")
+        ov = fluid.layers.data("off", [1], dtype="int64")
+        lv = fluid.layers.data("len", [1], dtype="int64")
+        out = layers.sequence_slice(xv, ov, lv)
+    got = _run(prog, {"x": x, "off": offset, "len": length}, [out])[0]
+    np.testing.assert_allclose(got[0, :2], x[0, 1:3])
+    assert (got[0, 2:] == 0).all()
+    np.testing.assert_allclose(got[1, :3], x[1, :3])
+
+
+def test_sequence_expand_as():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    y = np.zeros((2, 3, 5), np.float32)
+    ylen = np.array([2, 3], np.int64)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [2], dtype="float32")
+        yv = fluid.layers.data("y", [3, 5], dtype="float32")
+        lv = fluid.layers.data("ylen", [], dtype="int64")
+        out = layers.sequence_expand_as(xv, yv, y_length=lv)
+    got = _run(prog, {"x": x, "y": y, "ylen": ylen}, [out])[0]
+    assert got.shape == (2, 3, 2)
+    np.testing.assert_allclose(got[0, :2], [[1, 2], [1, 2]])
+    assert (got[0, 2] == 0).all()
+    np.testing.assert_allclose(got[1], [[3, 4]] * 3)
+
+
+def test_sequence_pool_empty_sequence_pad_value():
+    x = np.ones((2, 3, 2), np.float32)
+    length = np.array([0, 2], np.int64)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [3, 2], dtype="float32")
+        lv = fluid.layers.data("len", [], dtype="int64")
+        mx = layers.sequence_pool(xv, "max", length=lv, pad_value=-7.0)
+        sm = layers.sequence_pool(xv, "sum", length=lv, pad_value=-7.0)
+    got_mx, got_sm = _run(prog, {"x": x, "len": length}, [mx, sm])
+    np.testing.assert_allclose(got_mx[0], [-7.0, -7.0])  # empty -> pad_value
+    np.testing.assert_allclose(got_mx[1], [1.0, 1.0])
+    np.testing.assert_allclose(got_sm[0], [-7.0, -7.0])
+    np.testing.assert_allclose(got_sm[1], [2.0, 2.0])
+
+
+def test_warpctc_norm_by_times_scales_grad_not_loss():
+    rng = np.random.RandomState(6)
+    B, T, C, L = 1, 4, 3, 1
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = np.array([[1]], np.int64)
+
+    def run(norm):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            lg = fluid.layers.data("lg", [T, C], dtype="float32")
+            lg.stop_gradient = False
+            lb = fluid.layers.data("lb", [L], dtype="int64")
+            loss = fluid.layers.reduce_sum(
+                layers.warpctc(lg, lb, norm_by_times=norm))
+            from paddle_tpu.framework.backward import append_backward
+            append_backward(loss)
+        return _run(prog, {"lg": logits, "lb": labels},
+                    [loss, "lg@GRAD"])
+
+    loss0, g0 = run(False)
+    loss1, g1 = run(True)
+    np.testing.assert_allclose(loss0, loss1, rtol=1e-6)  # loss unscaled
+    np.testing.assert_allclose(g1, g0 / T, rtol=1e-5)    # grad scaled by 1/T
+
+
+def test_sequence_pad_maxlen_and_value():
+    x = np.arange(12, dtype=np.float32).reshape(1, 4, 3)
+    length = np.array([2], np.int64)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [4, 3], dtype="float32")
+        lv = fluid.layers.data("len", [], dtype="int64")
+        pv = fluid.layers.fill_constant([1], "float32", -1.0)
+        out, out_len = layers.sequence_pad(xv, pv, maxlen=6, length=lv)
+    got, glen = _run(prog, {"x": x, "len": length}, [out, out_len])
+    assert got.shape == (1, 6, 3)
+    np.testing.assert_allclose(got[0, :2], x[0, :2])
+    assert (got[0, 2:] == -1.0).all()
+    assert glen[0] == 2
+
+
+def test_sequence_expand_as_preserves_int_dtype():
+    x = np.array([[5], [9]], np.int64)
+    y = np.zeros((2, 2, 1), np.float32)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [1], dtype="int64")
+        yv = fluid.layers.data("y", [2, 1], dtype="float32")
+        out = layers.sequence_expand_as(xv, yv)
+    got = _run(prog, {"x": x, "y": y}, [out])[0]
+    assert got.dtype in (np.int64, np.int32), got.dtype
+    np.testing.assert_array_equal(got[:, :, 0], [[5, 5], [9, 9]])
