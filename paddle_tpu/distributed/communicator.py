@@ -1,0 +1,109 @@
+"""Client-side gradient communicator — parity with the reference's
+Communicator stack (operators/distributed/communicator.h: AsyncCommunicator
+:237, HalfAsyncCommunicator :299).
+
+The reference runs send threads that drain per-var queues, merging up to
+``max_merge_var_num`` pending gradients into one RPC. Here the half-async
+send op enqueues into this communicator instead of pushing directly; a
+daemon thread merges (averages) whatever accumulated per (endpoint, param)
+and issues one push — so trainers never block on the network, and the wire
+carries merged rounds.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("paddle_tpu.communicator")
+
+
+class HalfAsyncCommunicator:
+    _instances: Dict[int, "HalfAsyncCommunicator"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, trainer_id: int, max_merge_var_num: int = 20,
+                 send_wait_ms: float = 2.0):
+        from .ps_client import PSClient  # local import: avoid cycle
+
+        self.trainer_id = trainer_id
+        self.max_merge = int(max_merge_var_num)
+        self.wait_s = send_wait_ms / 1000.0
+        self._client = PSClient.instance(trainer_id)
+        self._queues: Dict[Tuple[str, str], List] = defaultdict(list)
+        self._meta: Dict[Tuple[str, str], Optional[float]] = {}
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._inflight = 0
+        self._error: Optional[Exception] = None
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+
+    # -- api ----------------------------------------------------------------
+    @classmethod
+    def instance(cls, trainer_id: int, **kw) -> "HalfAsyncCommunicator":
+        with cls._lock:
+            if trainer_id not in cls._instances:
+                cls._instances[trainer_id] = cls(trainer_id, **kw)
+            return cls._instances[trainer_id]
+
+    def push(self, ep: str, param: str, grad: np.ndarray,
+             lr: Optional[float] = None):
+        with self._cv:
+            self._queues[(ep, param)].append(np.asarray(grad, np.float32))
+            self._meta[(ep, param)] = lr
+            self._cv.notify_all()
+
+    def flush(self):
+        """Block until every queued gradient has been merged and sent;
+        raises the first send error instead of hanging on a dead wire."""
+        with self._cv:
+            while any(self._queues.values()) or self._inflight:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "half-async communicator send failed") from self._error
+                self._cv.wait(timeout=0.05)
+        if self._error is not None:
+            raise RuntimeError(
+                "half-async communicator send failed") from self._error
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout=2.0)
+        with self._lock:
+            type(self)._instances.pop(self.trainer_id, None)
+
+    # -- send thread ---------------------------------------------------------
+    def _send_loop(self):
+        while not self._stop.is_set():
+            batch = []
+            with self._cv:
+                if not any(self._queues.values()):
+                    self._cv.wait(timeout=self.wait_s)
+                for key, q in self._queues.items():
+                    if q:
+                        take = q[:self.max_merge]
+                        del q[:len(take)]
+                        batch.append((key, take, self._meta.get(key)))
+                self._inflight += len(batch)
+            for (ep, param), grads, lr in batch:
+                try:
+                    merged = grads[0] if len(grads) == 1 else \
+                        np.mean(np.stack(grads), axis=0)
+                    self._client.push(ep, param, merged, lr=lr)
+                except Exception as e:
+                    # a dying send thread would strand queued grads and make
+                    # flush() hang forever; record and surface at flush
+                    self._error = e
+                    logger.error("half-async push of %r to %s failed: %r",
+                                 param, ep, e)
+                finally:
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()

@@ -48,6 +48,10 @@ def send_op(scope, op, exe):
         client.ensure_init(ep, param, _scope_np(scope, param))
     if mode == 3:  # GEO pushes param deltas
         client.push_delta(ep, param, grad)
+    elif mode == 2:  # HALF_ASYNC: merge-queue via the communicator
+        from .communicator import HalfAsyncCommunicator
+
+        HalfAsyncCommunicator.instance(tid).push(ep, param, grad, lr=lr)
     else:
         client.push(ep, param, grad, lr=lr)
 
@@ -72,6 +76,12 @@ def recv_op(scope, op, exe):
     eps = op.attr("epmap")
     param = op.attr("param")
     tid = int(op.attr("trainer_id", 0))
+    if int(op.attr("mode", 0)) == 2:
+        # half-async: make sure this trainer's queued grads are on the wire
+        # before pulling (the reference's per-batch communicator flush)
+        from .communicator import HalfAsyncCommunicator
+
+        HalfAsyncCommunicator.instance(tid).flush()
     client = PSClient.instance(tid)
     out_name = op.output("Out")[0]
     if scope.has_var(param):

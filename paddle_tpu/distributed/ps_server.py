@@ -62,6 +62,7 @@ class _ParamState:
         self.accum: Optional[np.ndarray] = None
         self.accum_lr: Optional[float] = None
         self.push_count = 0
+        self.contributors: set = set()
         self.version = 0
 
 
@@ -74,7 +75,8 @@ class ParameterServer:
         self.host, self.port = host or "127.0.0.1", int(port)
         self.trainer_num = trainer_num
         self.sync_mode = sync_mode
-        self.mode = mode  # DistributedMode: 0 sync / 1 async / 3 geo
+        # DistributedMode: 0 sync / 1 async / 2 half-async / 3 geo
+        self.mode = mode
         self.params: Dict[str, _ParamState] = {}
         self._barriers: Dict[str, tuple] = {}
         self._barrier_lock = threading.Lock()
@@ -222,8 +224,27 @@ class ParameterServer:
                       st.accum_lr)
         st.accum = None
         st.push_count = 0
+        st.contributors.clear()
         st.version += 1
         st.cond.notify_all()
+
+    def _accumulate_locked(self, st: _ParamState, grad, lr, trainer_id):
+        """Add one contribution to the open round (caller holds st.cond);
+        returns True when every live trainer has contributed. Distinct
+        trainers are tracked so a fast pusher cannot complete a round
+        alone (half-async pushes never block)."""
+        if st.accum is None:
+            st.accum = grad.astype(np.float64)
+        else:
+            st.accum += grad
+        st.accum_lr = lr if lr is not None else st.accum_lr
+        st.push_count += 1
+        if trainer_id is not None:
+            st.contributors.add(trainer_id)
+        live = self._live_trainers()
+        done = (len(st.contributors) >= live if st.contributors
+                else st.push_count >= live)
+        return done
 
     def _live_trainers(self) -> int:
         return max(self.trainer_num - len(self._completed_trainers), 1)
@@ -249,19 +270,21 @@ class ParameterServer:
     def _push_dense(self, st: _ParamState, msg):
         grad = np.asarray(msg["value"], np.float32)
         lr = msg.get("lr")
+        tid = msg.get("trainer_id")
         with st.cond:
+            if self.mode == 2:
+                # HALF_ASYNC (communicator.h:299): aggregate a full round
+                # from all live trainers before applying — like sync — but
+                # pushers never block on the applied version
+                if self._accumulate_locked(st, grad, lr, tid):
+                    self._apply_round_locked(st)
+                return
             if not self.sync_mode:
                 st.table.push(grad, lr)
                 st.version += 1
                 return
             # sync: accumulate until all live trainers contributed
-            if st.accum is None:
-                st.accum = grad.astype(np.float64)
-            else:
-                st.accum += grad
-            st.accum_lr = lr if lr is not None else st.accum_lr
-            st.push_count += 1
-            if st.push_count >= self._live_trainers():
+            if self._accumulate_locked(st, grad, lr, tid):
                 self._apply_round_locked(st)
             else:
                 target = st.version + 1

@@ -142,7 +142,8 @@ def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
     if compiled is not None and compiled._is_data_parallel:
         ring_axes = dict(compiled._mesh_axes)
         has_collectives = any(
-            op.type.startswith("c_") or op.type in ("allreduce", "broadcast")
+            op.type.startswith("c_")
+            or op.type in ("allreduce", "broadcast", "dgc_momentum")
             for op in program.global_block().ops
         )
         mode = "shard_map" if has_collectives else "gspmd"

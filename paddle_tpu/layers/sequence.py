@@ -56,7 +56,7 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                   bias_attr=None, param_attr=None, act=None, name=None):
     """fluid.layers.sequence_conv (sequence_conv_op.cc)."""
     helper = LayerHelper("sequence_conv", param_attr=param_attr,
-                         bias_attr=bias_attr, name=name)
+                         bias_attr=bias_attr, act=act, name=name)
     d = input.shape[-1]
     filt = helper.create_parameter(param_attr,
                                    shape=[filter_size * d, num_filters],
@@ -123,6 +123,11 @@ def sequence_unpad(x, length, name=None):
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        raise ValueError(
+            "sequence_mask requires a static maxlen on TPU (the reference "
+            "derives it from max(x) at run time, a dynamic shape XLA cannot "
+            "compile); pass maxlen explicitly")
     helper = LayerHelper("sequence_mask", name=name)
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op(type="sequence_mask", inputs={"X": [x]},

@@ -293,3 +293,79 @@ def lookahead_update(ctx, op, ins):
     new_slow = jnp.where(sync, slow + alpha * (p.astype(slow.dtype) - slow), slow)
     new_p = jnp.where(sync, new_slow.astype(p.dtype), p)
     return {"ParamOut": new_p, "SlowOut": new_slow}
+
+
+@register_op("dgc_momentum", grad=None, is_optimizer=True)
+def dgc_momentum(ctx, op, ins):
+    """Deep Gradient Compression momentum (DGCMomentumOptimizer,
+    reference optimizer.py:1071 + details/sparse_all_reduce_op_handle.cc).
+
+    Local accumulation (Lin et al. 2018, w/ momentum correction):
+        u = mu * u + g                (velocity accumulation)
+        v = v + u                     (residual accumulation)
+        mask = |v| in top-k, k = (1 - sparsity) * numel
+        sparse = v * mask; v -= sparse; u *= (1 - mask)  (momentum masking)
+        G = allreduce(sparse)         (reference: gather top-k values+idx
+                                       via the dgc lib; on a TPU mesh the
+                                       masked dense psum over the dp axis
+                                       is the same reduction, riding ICI)
+        p = p - lr * G
+    Before rampup_begin_step, behaves as plain momentum (reference gates
+    compression on the same step counter).
+    """
+    p, g = ins["Param"][0], ins["Grad"][0]
+    u, v = ins["U"][0], ins["V"][0]
+    step = ins["CurrentStep"][0] if ins.get("CurrentStep") else None
+    lr = _lr(ins).astype(jnp.float32)
+    mu = float(op.attr("mu", 0.9))
+    sparsity = float(op.attr("sparsity", 0.999))
+    rampup_begin = float(op.attr("rampup_begin_step", 0.0))
+    ring_id = int(op.attr("ring_id", 0))
+    use_nesterov = bool(op.attr("use_nesterov", False))
+
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    uf = u.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    axis = ctx.axis_name(ring_id)
+
+    def pmean(x):
+        # per-rank grads are local-batch means; averaging over the dp axis
+        # reproduces the reference's nranks-scaled encode + /nranks apply
+        # (dgc_op.h grad_out = nranks*g, dgc_momentum_op.h g/nranks)
+        return jax.lax.pmean(x, axis) if axis else x
+
+    # --- DGC branch: SGD on the aggregated sparse grad (momentum is baked
+    # into the LOCAL u accumulation — dgc_momentum_op.h switches to its sgd
+    # kernel once compression starts) --------------------------------------
+    u_acc = mu * uf + gf
+    v_acc = vf + u_acc
+    flat = v_acc.reshape(-1)
+    numel = flat.shape[0]
+    k = max(1, int(round(numel * (1.0 - sparsity))))
+    thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+    mask = (jnp.abs(flat) >= thresh).reshape(v_acc.shape)
+    sparse = jnp.where(mask, v_acc, 0.0)
+    v_dgc = jnp.where(mask, 0.0, v_acc)
+    u_dgc = jnp.where(mask, 0.0, u_acc)    # momentum factor masking
+    p_dgc = pf - lr * pmean(sparse)
+
+    # --- plain momentum branch (pre-rampup) ---------------------------------
+    g_all = pmean(gf)
+    u_mom = mu * uf + g_all
+    if use_nesterov:
+        p_mom = pf - (g_all + mu * u_mom) * lr
+    else:
+        p_mom = pf - lr * u_mom
+
+    if step is not None:
+        in_dgc = (step.astype(jnp.float32).reshape(()) >= rampup_begin)
+        p_new = jnp.where(in_dgc, p_dgc, p_mom)
+        u_new = jnp.where(in_dgc, u_dgc, u_mom)
+        v_new = jnp.where(in_dgc, v_dgc, vf)
+    else:
+        p_new, u_new, v_new = p_dgc, u_dgc, v_dgc
+    return {"ParamOut": p_new.astype(p.dtype),
+            "UOut": u_new.astype(u.dtype),
+            "VOut": v_new.astype(v.dtype)}

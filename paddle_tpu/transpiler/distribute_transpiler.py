@@ -178,7 +178,8 @@ class DistributeTranspiler:
                 inputs={},
                 outputs={"Out": [p.name]},
                 attrs={"epmap": eps, "param": p.name,
-                       "trainer_id": self.trainer_id},
+                       "trainer_id": self.trainer_id,
+                       "mode": self.config.mode},
             )
         if self.sync_mode:
             block.append_op(type="fetch_barrier", attrs={
