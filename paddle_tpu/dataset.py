@@ -251,6 +251,19 @@ class DatasetBase:
         return feed
 
 
+def _chunk_stream(instances, batch_size, drop_last):
+    """Group an instance iterator into batch-sized chunks — the ONE batching
+    rule shared by sequential iteration and the threaded pipeline."""
+    pending = []
+    for inst in instances:
+        pending.append(inst)
+        if len(pending) == batch_size:
+            yield pending
+            pending = []
+    if pending and not drop_last:
+        yield pending
+
+
 class InMemoryDataset(DatasetBase):
     """load_into_memory + local/global shuffle — data_set.cc InMemoryDataset."""
 
@@ -279,11 +292,8 @@ class InMemoryDataset(DatasetBase):
         return len(self._memory)
 
     def __iter__(self):
-        bs = self.batch_size
-        for i in range(0, len(self._memory), bs):
-            chunk = self._memory[i:i + bs]
-            if len(chunk) < bs and self.drop_last:
-                break
+        for chunk in _chunk_stream(iter(self._memory), self.batch_size,
+                                   self.drop_last):
             yield self._batch_to_feed(chunk)
 
 
@@ -291,17 +301,15 @@ class QueueDataset(DatasetBase):
     """Streaming file-at-a-time dataset — data_set.cc QueueDataset (no
     in-memory materialization; instances flow straight to batches)."""
 
-    def __iter__(self):
-        pending: List[Tuple[np.ndarray, ...]] = []
-        bs = self.batch_size
+    def _instance_stream(self):
         for path in self._my_files():
             values, lods = self._parse_file(path)
-            pending.extend(self._instances_of(values, lods))
-            while len(pending) >= bs:
-                yield self._batch_to_feed(pending[:bs])
-                pending = pending[bs:]
-        if pending and not self.drop_last:
-            yield self._batch_to_feed(pending)
+            yield from self._instances_of(values, lods)
+
+    def __iter__(self):
+        for chunk in _chunk_stream(self._instance_stream(), self.batch_size,
+                                   self.drop_last):
+            yield self._batch_to_feed(chunk)
 
 
 class DatasetFactory:
@@ -313,3 +321,108 @@ class DatasetFactory:
         if datafeed_class == "QueueDataset":
             return QueueDataset()
         raise ValueError(f"unknown dataset class {datafeed_class}")
+
+
+# ---------------------------------------------------------------------------
+# threaded batch pipeline (multi_trainer.cc / hogwild_worker.cc capability)
+# ---------------------------------------------------------------------------
+
+def iter_batches_threaded(dataset: DatasetBase, threads: int,
+                          prefetch: int = 4):
+    """Produce batch feed dicts with file parsing and batch assembly
+    overlapped with consumption.
+
+    The reference runs N HogwildWorker threads each driving its own DataFeed
+    (framework/hogwild_worker.cc, multi_trainer.cc); on TPU the device is
+    driven by one dispatch stream, so the equivalent is a producer pool:
+    files parse concurrently (a bounded window of in-flight parses),
+    `_batch_to_feed` assembly runs in the pool, and a bounded queue keeps
+    at most `prefetch` ready batches ahead of the (asynchronously
+    dispatching) Executor loop — backpressure everywhere, so a streaming
+    QueueDataset never materializes in memory. Batch order is identical to
+    the sequential iterator.
+    """
+    import queue as queue_mod
+    import threading as threading_mod
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    threads = max(1, int(threads))
+    out_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(2, prefetch))
+    stop = threading_mod.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        """Bounded put that aborts when the consumer abandoned us."""
+        while not stop.is_set():
+            try:
+                out_q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def produce(pool):
+        bs = dataset.batch_size
+        try:
+            if isinstance(dataset, InMemoryDataset):
+                chunks = _chunk_stream(iter(dataset._memory), bs,
+                                       dataset.drop_last)
+                for chunk in chunks:
+                    # put blocks when the queue is full, bounding the
+                    # number of outstanding _batch_to_feed futures
+                    if not put(pool.submit(dataset._batch_to_feed, chunk)):
+                        return
+            else:
+                files = dataset._my_files()
+                window: deque = deque()
+                idx = 0
+                pending = []
+
+                def pump_window():
+                    nonlocal idx
+                    while idx < len(files) and len(window) < 2 * threads:
+                        window.append(
+                            pool.submit(dataset._parse_file, files[idx]))
+                        idx += 1
+
+                pump_window()
+                while window:
+                    values, lods = window.popleft().result()
+                    pump_window()
+                    pending.extend(dataset._instances_of(values, lods))
+                    while len(pending) >= bs:
+                        chunk, pending = pending[:bs], pending[bs:]
+                        if not put(pool.submit(dataset._batch_to_feed,
+                                               chunk)):
+                            return
+                if pending and not dataset.drop_last:
+                    if not put(pool.submit(dataset._batch_to_feed, pending)):
+                        return
+        except Exception as e:  # surface in the consumer
+            put(e)
+        finally:
+            put(_END)
+
+    pool = ThreadPoolExecutor(max_workers=threads,
+                              thread_name_prefix="dataset_worker")
+    producer = threading_mod.Thread(target=produce, args=(pool,), daemon=True)
+    producer.start()
+    try:
+        while True:
+            item = out_q.get()
+            if item is _END:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item.result()
+    finally:
+        stop.set()
+        # drain so a blocked producer can observe the stop flag promptly
+        try:
+            while True:
+                out_q.get_nowait()
+        except Exception:
+            pass
+        producer.join(timeout=5)
+        pool.shutdown(wait=False, cancel_futures=True)

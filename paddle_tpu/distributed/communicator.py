@@ -71,13 +71,15 @@ class HalfAsyncCommunicator:
                 "half-async communicator send failed") from self._error
 
     def stop(self):
-        self.flush()
-        self._stop.set()
-        with self._cv:
-            self._cv.notify_all()
-        self._thread.join(timeout=2.0)
-        with self._lock:
-            type(self)._instances.pop(self.trainer_id, None)
+        try:
+            self.flush()
+        finally:
+            self._stop.set()
+            with self._cv:
+                self._cv.notify_all()
+            self._thread.join(timeout=2.0)
+            with self._lock:
+                type(self)._instances.pop(self.trainer_id, None)
 
     # -- send thread ---------------------------------------------------------
     def _send_loop(self):

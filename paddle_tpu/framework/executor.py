@@ -573,12 +573,16 @@ class Executor:
 
         The reference hands the Dataset to C++ trainer threads
         (Executor::RunFromDataset → HogwildWorker loops); here each parsed
-        batch feeds the SAME whole-program XLA computation as ``run`` — the
-        jit cache makes the per-batch dispatch cost negligible, and XLA's
-        async dispatch overlaps host parsing with device compute.
+        batch feeds the SAME whole-program XLA computation as ``run``. With
+        ``thread > 1`` (or dataset.set_thread), file parsing and batch
+        assembly run in a worker pool with a bounded prefetch queue
+        (dataset.iter_batches_threaded) so host-side data work overlaps the
+        asynchronously dispatched device steps — the HogwildWorker/
+        MultiTrainer capability on one dispatch stream.
         """
         return self._run_from_dataset(program, dataset, scope, fetch_list,
-                                      fetch_info, print_period, train=True)
+                                      fetch_info, print_period, train=True,
+                                      thread=thread)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
@@ -587,10 +591,12 @@ class Executor:
         """Parity with fluid/executor.py:1381 (no optimizer side effects is
         the caller's responsibility, as in the reference)."""
         return self._run_from_dataset(program, dataset, scope, fetch_list,
-                                      fetch_info, print_period, train=False)
+                                      fetch_info, print_period, train=False,
+                                      thread=thread)
 
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
-                          fetch_info, print_period, train: bool):
+                          fetch_info, print_period, train: bool,
+                          thread: int = 0):
         if dataset is None:
             raise ValueError("dataset must be provided")
         program = program or default_main_program()
@@ -599,9 +605,16 @@ class Executor:
             (v.name if isinstance(v, Variable) else str(v)) for v in fetch_list
         ]
         feed_names = {v.name for v in getattr(dataset, "use_vars", [])}
+        n_threads = int(thread) or int(getattr(dataset, "thread_num", 1) or 1)
+        if n_threads > 1:
+            from ..dataset import iter_batches_threaded
+
+            batches = iter_batches_threaded(dataset, n_threads)
+        else:
+            batches = iter(dataset)
         step = 0
         last_fetch = None
-        for batch_feed in dataset:
+        for batch_feed in batches:
             feed = {k: v for k, v in batch_feed.items()
                     if not feed_names or k in feed_names or k.endswith("__len")}
             last_fetch = self.run(program=program, feed=feed,

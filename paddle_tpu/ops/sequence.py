@@ -123,13 +123,15 @@ def sequence_pad(ctx, op, ins):
     padded_len = int(op.attr("padded_length", -1))
     if padded_len > 0 and padded_len != T:
         if padded_len < T:
-            # the reference enforces padded_length >= max sequence length;
-            # silently truncating would corrupt rows longer than padded_len
-            raise ValueError(
-                f"sequence_pad: padded_length {padded_len} is smaller than "
-                f"the input frame T={T}")
-        widths = [(0, 0), (0, padded_len - T)] + [(0, 0)] * (x.ndim - 2)
-        x = jnp.pad(x, widths)
+            # T is the padded FRAME width (often a power-of-two bucket),
+            # not the max real length: shrinking the frame is legal as long
+            # as rows fit; clamp Length so downstream masks stay honest
+            # (the reference enforces padded_length >= max actual length)
+            x = x[:, :padded_len]
+            length = jnp.minimum(length, padded_len)
+        else:
+            widths = [(0, 0), (0, padded_len - T)] + [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, widths)
         T = padded_len
     t = jnp.arange(T)[None, :].reshape((1, T) + (1,) * (x.ndim - 2))
     valid = t < length.reshape((B,) + (1,) * (x.ndim - 1))

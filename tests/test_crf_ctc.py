@@ -331,3 +331,20 @@ def test_sequence_expand_as_preserves_int_dtype():
     got = _run(prog, {"x": x, "y": y}, [out])[0]
     assert got.dtype in (np.int64, np.int32), got.dtype
     np.testing.assert_array_equal(got[:, :, 0], [[5, 5], [9, 9]])
+
+
+def test_sequence_pad_shrinks_frame_and_clamps_length():
+    """padded_length smaller than the frame: rows' valid prefixes survive
+    and OutLength clamps (frame width is a bucket, not real max length)."""
+    x = np.arange(12, dtype=np.float32).reshape(1, 4, 3)
+    length = np.array([3], np.int64)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.layers.data("x", [4, 3], dtype="float32")
+        lv = fluid.layers.data("len", [], dtype="int64")
+        pv = fluid.layers.fill_constant([1], "float32", 0.0)
+        out, out_len = layers.sequence_pad(xv, pv, maxlen=2, length=lv)
+    got, glen = _run(prog, {"x": x, "len": length}, [out, out_len])
+    assert got.shape == (1, 2, 3)
+    np.testing.assert_allclose(got[0], x[0, :2])
+    assert glen[0] == 2

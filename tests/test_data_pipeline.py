@@ -243,3 +243,99 @@ def test_dataset_trainer_sharding(tmp_path):
     ds.set_trainer_shard(1, 2)
     ds.load_into_memory()
     assert ds.get_memory_data_size() == 10  # 2 of 4 files
+
+
+# ---------------------------------------------------------------------------
+# threaded dataset trainer (VERDICT #9: honor thread=, overlap parse/compute)
+# ---------------------------------------------------------------------------
+
+def test_threaded_batches_match_sequential(tmp_path):
+    """iter_batches_threaded yields byte-identical batches in the same order
+    as plain iteration, for both dataset kinds."""
+    from paddle_tpu.dataset import iter_batches_threaded
+
+    paths = _write_regression_files(str(tmp_path), n_files=3, rows=20)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+    for kind in ["QueueDataset", "InMemoryDataset"]:
+        ds = DatasetFactory().create_dataset(kind)
+        ds.set_use_var([x, y])
+        ds.set_batch_size(8)
+        ds.set_filelist(paths)
+        if kind == "InMemoryDataset":
+            ds.load_into_memory()
+        seq = list(ds)
+        thr = list(iter_batches_threaded(ds, threads=4))
+        assert len(seq) == len(thr)
+        for a, b in zip(seq, thr):
+            assert a.keys() == b.keys()
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_train_from_dataset_threaded_matches(tmp_path):
+    """thread=4 training gives identical losses to sequential (same batch
+    order, same math)."""
+    paths = _write_regression_files(str(tmp_path))
+
+    def train(thread):
+        prog, startup = fluid.Program(), fluid.Program()
+        prog.random_seed = 11
+        startup.random_seed = 11
+        with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_use_var([x, y])
+        ds.set_batch_size(16)
+        ds.set_filelist(paths)
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        outs = []
+        for _ in range(4):
+            out = exe.train_from_dataset(prog, ds, scope=scope,
+                                         thread=thread, fetch_list=[loss])
+            outs.append(float(out[0]))
+        return outs
+
+    np.testing.assert_allclose(train(4), train(0), rtol=1e-6)
+
+
+def test_threaded_parse_overlaps(tmp_path, monkeypatch):
+    """Throughput: with a slow parser, the threaded pipeline beats the
+    sequential one by roughly the parallelism factor."""
+    import time
+    from paddle_tpu.dataset import QueueDataset, iter_batches_threaded
+
+    paths = _write_regression_files(str(tmp_path), n_files=8, rows=8)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_use_var([x, y])
+    ds.set_batch_size(8)
+    ds.set_filelist(paths)
+
+    real_parse = QueueDataset._parse_file
+
+    def slow_parse(self, path):
+        time.sleep(0.05)
+        return real_parse(self, path)
+
+    monkeypatch.setattr(QueueDataset, "_parse_file", slow_parse)
+    t0 = time.monotonic()
+    n_seq = len(list(ds))
+    t_seq = time.monotonic() - t0
+    t0 = time.monotonic()
+    n_thr = len(list(iter_batches_threaded(ds, threads=8)))
+    t_thr = time.monotonic() - t0
+    assert n_seq == n_thr
+    # 8 files x 50ms serial = 400ms vs ~one 50ms wave + overhead
+    assert t_thr < t_seq * 0.6, (t_seq, t_thr)

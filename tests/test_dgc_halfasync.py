@@ -140,8 +140,6 @@ def test_half_async_communicator_merges():
     comm.push("ep", "w", g2, lr=0.1)
     comm.flush()
     comm._stop.set()
-    total = np.sum([p[1] * (1 if len(pushes) == 2 else 2)
-                    for p in pushes], axis=0)
     # either one merged push of mean=2, or two pushes summing to 4 per elem
     if len(pushes) == 1:
         np.testing.assert_allclose(pushes[0][1], 2 * np.ones(4))
