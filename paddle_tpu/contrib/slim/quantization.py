@@ -1,0 +1,239 @@
+"""Quantization-aware training — parity with
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass :152, QuantizationFreezePass).
+
+The reference rewrites the ir::Graph, inserting fake_quantize/dequantize
+node pairs around every quantizable op; here the same rewrite happens on the
+Program's op list (the IR this framework executes), inserting the combined
+quantize-dequantize ops from ops/quantize_ops.py. Simulated-quant training
+then runs on the normal whole-block XLA path, with straight-through
+gradients.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...framework.program import Operator, Program, Variable
+
+_DEFAULT_QUANTIZABLE = ["conv2d", "depthwise_conv2d", "mul", "matmul"]
+# input slots that carry trainable weights per op type
+_WEIGHT_SLOTS = {
+    "conv2d": "Filter", "depthwise_conv2d": "Filter",
+    "mul": "Y", "matmul": "Y",
+}
+_ACT_SLOTS = {
+    "conv2d": ["Input"], "depthwise_conv2d": ["Input"],
+    "mul": ["X"], "matmul": ["X"],
+}
+
+
+class QuantizationTransformPass:
+    """Insert simulated-quantization ops on the weights and activations of
+    quantizable ops (QAT forward rewrite)."""
+
+    def __init__(self, scope=None, place=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 moving_rate: float = 0.9,
+                 quantizable_op_type: Optional[List[str]] = None,
+                 skip_pattern: str = "skip_quant"):
+        if activation_quantize_type not in ("abs_max",
+                                            "moving_average_abs_max"):
+            raise ValueError(
+                f"unsupported activation_quantize_type "
+                f"{activation_quantize_type!r}")
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unsupported weight_quantize_type {weight_quantize_type!r}")
+        self._scope = scope
+        self._place = place
+        self._wbits = int(weight_bits)
+        self._abits = int(activation_bits)
+        self._act_type = activation_quantize_type
+        self._weight_type = weight_quantize_type
+        self._moving_rate = float(moving_rate)
+        self._op_types = list(quantizable_op_type or _DEFAULT_QUANTIZABLE)
+        self._skip_pattern = skip_pattern
+
+    # ------------------------------------------------------------------
+    def apply(self, program: Program,
+              startup_program: Optional[Program] = None):
+        block = program.global_block()
+        quantized: Dict[str, str] = {}  # var -> its dequantized twin
+        new_ops: List[Operator] = []
+        for op in block.ops:
+            if self._quantizable(op):
+                self._rewrite_op(block, op, quantized, new_ops,
+                                 startup_program)
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+        return program
+
+    def _quantizable(self, op) -> bool:
+        if op.type not in self._op_types:
+            return False
+        if op.attr(self._skip_pattern, False):
+            return False
+        # the reference skips ops whose name_scope contains skip_pattern;
+        # here any output var name carrying the pattern opts the op out
+        return not any(self._skip_pattern in n for n in op.output_arg_names)
+
+    def _rewrite_op(self, block, op, quantized, new_ops, startup_program):
+        wslot = _WEIGHT_SLOTS.get(op.type)
+        for slot in _ACT_SLOTS.get(op.type, []) + ([wslot] if wslot else []):
+            names = op.inputs.get(slot, [])
+            if not names:
+                continue
+            name = names[0]
+            var = block.vars.get(name)
+            if var is None or var.dtype not in ("float32", "bfloat16",
+                                                "float16"):
+                continue
+            is_weight = slot == wslot and getattr(var, "persistable", False)
+            if name not in quantized:
+                quantized[name] = self._insert_quant(
+                    block, new_ops, var, is_weight, startup_program)
+            op.inputs[slot] = [quantized[name]]
+
+    def _insert_quant(self, block, new_ops, var: Variable, is_weight: bool,
+                      startup_program) -> str:
+        qname = var.name + ".quant_dequant"
+        out = block.create_var(name=qname, shape=var.shape, dtype=var.dtype)
+        scale = block.create_var(name=qname + "@scale", shape=[1],
+                                 dtype="float32")
+        if is_weight:
+            if self._weight_type == "channel_wise_abs_max":
+                # conv filters quantize per output channel (axis 0); mul/
+                # matmul weights per output column (axis 1) — quant_axis
+                # convention of fake_channel_wise_quantize_abs_max
+                axis = 0 if len(var.shape) == 4 else 1
+                new_ops.append(Operator(
+                    block, "fake_channel_wise_quantize_dequantize_abs_max",
+                    inputs={"X": [var.name]},
+                    outputs={"Out": [qname], "OutScale": [scale.name]},
+                    attrs={"bit_length": self._wbits, "quant_axis": axis}))
+            else:
+                new_ops.append(Operator(
+                    block, "fake_quantize_dequantize_abs_max",
+                    inputs={"X": [var.name]},
+                    outputs={"Out": [qname], "OutScale": [scale.name]},
+                    attrs={"bit_length": self._wbits}))
+            return qname
+        if self._act_type == "abs_max":
+            new_ops.append(Operator(
+                block, "fake_quantize_dequantize_abs_max",
+                inputs={"X": [var.name]},
+                outputs={"Out": [qname], "OutScale": [scale.name]},
+                attrs={"bit_length": self._abits}))
+            return qname
+        # moving_average_abs_max: persistable scale/accum/state
+        state_vars = []
+        for suffix, init in [("@scale_state", 1.0), ("@scale_accum", 1.0),
+                             ("@in_scale", 1.0)]:
+            sv = block.create_var(name=var.name + suffix, shape=[1],
+                                  dtype="float32", persistable=True)
+            state_vars.append(sv)
+            if startup_program is not None:
+                from ...framework.initializer import ConstantInitializer
+
+                stv = startup_program.global_block().create_var(
+                    name=sv.name, shape=[1], dtype="float32",
+                    persistable=True)
+                ConstantInitializer(init)(stv,
+                                          startup_program.global_block())
+            elif self._scope is not None:
+                # reference calling convention: pass scope (+place) and the
+                # pass initializes its state vars directly
+                import jax.numpy as jnp
+
+                if not self._scope.has_var(sv.name):
+                    self._scope.set_var(
+                        sv.name, jnp.full((1,), init, jnp.float32))
+            else:
+                raise ValueError(
+                    "QuantizationTransformPass with moving_average_abs_max "
+                    "needs either a startup_program (to append initializers)"
+                    " or a scope (to initialize state vars directly)")
+        state, accum, in_scale = state_vars
+        new_ops.append(Operator(
+            block, "fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": [var.name], "InScale": [in_scale.name],
+                    "InAccum": [accum.name], "InState": [state.name]},
+            outputs={"Out": [qname], "OutScale": [in_scale.name],
+                     "OutAccum": [accum.name], "OutState": [state.name]},
+            attrs={"bit_length": self._abits,
+                   "moving_rate": self._moving_rate}))
+        return qname
+
+
+class QuantizationFreezePass:
+    """Fold trained quantization into the program for inference
+    (QuantizationFreezePass capability): weight values in the scope are
+    replaced by their round-tripped INT-N values, weight fake-quant ops
+    drop out (the stored weights already carry the quantization error),
+    and activation quant ops keep running with their frozen moving scales
+    (is_test). On TPU the inference math stays float — the deployment
+    artifact carries quantized weights + recorded scales."""
+
+    def __init__(self, scope, place=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 weight_quantize_type: str = "channel_wise_abs_max"):
+        self._scope = scope
+        self._wbits = int(weight_bits)
+
+    def apply(self, program: Program):
+        import jax.numpy as jnp
+
+        from ...framework.registry import GRAD_SUFFIX, get_op_spec, has_op
+
+        block = program.global_block()
+        qrange = float((1 << (self._wbits - 1)) - 1)
+        # freeze is an inference-only pass (the reference applies it to the
+        # test graph): drop any backward/optimizer tail first, since grad
+        # ops embed forward descs that reference the vars removed below
+        fwd_ops = []
+        for op in block.ops:
+            if op.type.endswith("_grad"):
+                continue
+            if has_op(op.type) and get_op_spec(op.type).is_optimizer:
+                continue
+            if any(n.endswith(GRAD_SUFFIX) for n in op.output_arg_names):
+                continue
+            fwd_ops.append(op)
+        block.ops = fwd_ops
+        new_ops = []
+        renames: Dict[str, str] = {}
+        for op in block.ops:
+            if op.type in ("fake_quantize_dequantize_abs_max",
+                           "fake_channel_wise_quantize_dequantize_abs_max"):
+                src = op.input("X")[0]
+                var = block.vars.get(src)
+                if var is not None and getattr(var, "persistable", False):
+                    arr = np.asarray(self._scope.find_var(src))
+                    axis = int(op.attr("quant_axis", 0))
+                    if op.type.startswith("fake_channel"):
+                        red = tuple(i for i in range(arr.ndim) if i != axis)
+                        scale = np.maximum(
+                            np.max(np.abs(arr), axis=red, keepdims=True),
+                            1e-9)
+                    else:
+                        scale = np.maximum(np.max(np.abs(arr)), 1e-9)
+                    q = np.round(np.clip(arr, -scale, scale)
+                                 / scale * qrange) / qrange * scale
+                    self._scope.set_var(src, jnp.asarray(q, arr.dtype))
+                    renames[op.output("Out")[0]] = src
+                    continue  # drop the weight quant op
+            new_ops.append(op)
+        for op in new_ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [renames.get(n, n) for n in names]
+            if op.type == ("fake_quantize_dequantize_moving_average_"
+                           "abs_max"):
+                op.attrs["is_test"] = True
+        block.ops = new_ops
+        program._bump_version()
+        return program

@@ -377,27 +377,23 @@ def iter_batches_threaded(dataset: DatasetBase, threads: int,
                 files = dataset._my_files()
                 window: deque = deque()
                 idx = 0
-                pending = []
 
-                def pump_window():
+                def windowed_instances():
+                    # instance stream with a bounded window of in-flight
+                    # parses; the SAME _chunk_stream as sequential iteration
+                    # groups it, so batching cannot drift between paths
                     nonlocal idx
-                    while idx < len(files) and len(window) < 2 * threads:
-                        window.append(
-                            pool.submit(dataset._parse_file, files[idx]))
-                        idx += 1
+                    while idx < len(files) or window:
+                        while idx < len(files) and len(window) < 2 * threads:
+                            window.append(
+                                pool.submit(dataset._parse_file, files[idx]))
+                            idx += 1
+                        values, lods = window.popleft().result()
+                        yield from dataset._instances_of(values, lods)
 
-                pump_window()
-                while window:
-                    values, lods = window.popleft().result()
-                    pump_window()
-                    pending.extend(dataset._instances_of(values, lods))
-                    while len(pending) >= bs:
-                        chunk, pending = pending[:bs], pending[bs:]
-                        if not put(pool.submit(dataset._batch_to_feed,
-                                               chunk)):
-                            return
-                if pending and not dataset.drop_last:
-                    if not put(pool.submit(dataset._batch_to_feed, pending)):
+                for chunk in _chunk_stream(windowed_instances(), bs,
+                                           dataset.drop_last):
+                    if not put(pool.submit(dataset._batch_to_feed, chunk)):
                         return
         except Exception as e:  # surface in the consumer
             put(e)

@@ -11,3 +11,4 @@ from . import detection  # noqa: F401
 from . import amp_ops  # noqa: F401
 from . import beam_search  # noqa: F401
 from . import crf  # noqa: F401
+from . import quantize_ops  # noqa: F401

@@ -1,0 +1,92 @@
+"""Fake-quantization ops — reference operators/fake_quantize_op.{cc,h} and
+fake_dequantize_op.cc, the kernels behind contrib/slim QAT.
+
+Simulated INT-N quantization: quantize-dequantize in one op with a
+straight-through estimator (custom_vjp identity) so gradients flow through
+the rounding — the reference gets the same effect from its
+fake_quantize_dequantize grad kernels. All math stays in float on the MXU;
+nothing here blocks XLA fusion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@jax.custom_vjp
+def _ste(x, q):
+    """Pass q forward, route the cotangent straight through to x."""
+    return q
+
+
+def _ste_fwd(x, q):
+    return q, None
+
+
+def _ste_bwd(_, ct):
+    return (ct, None)
+
+
+_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def quant_dequant(x, scale, bits):
+    qrange = float((1 << (bits - 1)) - 1)
+    scale = jnp.maximum(scale, 1e-9)
+    clipped = jnp.clip(x, -scale, scale)
+    q = jnp.round(clipped / scale * qrange) / qrange * scale
+    return _ste(x, q)
+
+
+@register_op("fake_quantize_dequantize_abs_max", diff_inputs=("X",))
+def fake_quantize_dequantize_abs_max(ctx, op, ins):
+    x = ins["X"][0]
+    bits = int(op.attr("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": quant_dequant(x, scale, bits),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             diff_inputs=("X",))
+def fake_channel_wise_quantize_dequantize_abs_max(ctx, op, ins):
+    x = ins["X"][0]
+    bits = int(op.attr("bit_length", 8))
+    axis = int(op.attr("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    out = quant_dequant(x, scale, bits)
+    return {"Out": out, "OutScale": scale.reshape(-1)}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             diff_inputs=("X",))
+def fake_quantize_dequantize_moving_average_abs_max(ctx, op, ins):
+    """Activation quantization with a moving-average range estimate
+    (fake_quantize_op.cc FakeQuantOrWithDequantMovingAverageAbsMaxOp):
+        state  = rho * state + 1
+        accum  = rho * accum + max(|x|)
+        scale  = accum / state
+    At test time the stored InScale is used unchanged.
+    """
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    bits = int(op.attr("bit_length", 8))
+    rho = float(op.attr("moving_rate", 0.9))
+    is_test = bool(op.attr("is_test", False)) or ctx.is_test
+    if is_test:
+        return {"Out": quant_dequant(x, in_scale, bits),
+                "OutScale": in_scale.reshape(1)}
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else in_scale
+    state = ins["InState"][0].reshape(()) if ins.get("InState") else \
+        jnp.asarray(1.0, jnp.float32)
+    cur = jnp.max(jnp.abs(x))
+    state_new = rho * state + 1.0
+    accum_new = rho * accum + cur
+    scale = accum_new / state_new
+    return {"Out": quant_dequant(x, scale, bits),
+            "OutScale": scale.reshape(1),
+            "OutAccum": accum_new.reshape(1),
+            "OutState": state_new.reshape(1)}

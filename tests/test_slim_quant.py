@@ -1,0 +1,167 @@
+"""contrib/slim quantization-aware training (VERDICT missing #7).
+
+Mirrors the reference slim test strategy (slim/tests/test_quantization_pass
+semantics): transform pass inserts fake-quant ops, QAT training converges,
+straight-through grads flow, freeze folds weight quantization, and the
+frozen model's outputs track the QAT model closely.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim.quantization import (
+    QuantizationFreezePass, QuantizationTransformPass)
+
+
+def _build_lenet_ish():
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 3
+    startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [1, 8, 8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        conv = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                   padding=1, act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+        flat = fluid.layers.reshape(pool, [-1, 4 * 4 * 4])
+        logits = fluid.layers.fc(flat, 3)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+    return prog, startup, logits, loss
+
+
+def _data(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 1, 8, 8).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) * 9).astype(np.int64).clip(0, 2) \
+        .reshape(-1, 1)
+    return x, y
+
+
+def test_transform_pass_inserts_fake_quant_ops():
+    prog, startup, logits, loss = _build_lenet_ish()
+    n_before = len(prog.global_block().ops)
+    pass_ = QuantizationTransformPass(
+        activation_quantize_type="moving_average_abs_max",
+        weight_quantize_type="channel_wise_abs_max")
+    pass_.apply(prog, startup_program=startup)
+    types = [op.type for op in prog.global_block().ops]
+    assert len(types) > n_before
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    # quantizable ops now consume the dequantized twins
+    for op in prog.global_block().ops:
+        if op.type == "conv2d":
+            assert op.input("Filter")[0].endswith(".quant_dequant")
+            assert op.input("Input")[0].endswith(".quant_dequant")
+        if op.type == "mul":
+            assert op.input("Y")[0].endswith(".quant_dequant")
+
+
+def test_qat_trains_and_tracks_float():
+    x, y = _data()
+
+    def train(quant):
+        prog, startup, logits, loss = _build_lenet_ish()
+        with fluid.program_guard(prog, startup):
+            pass  # optimizer appended after (possible) quant rewrite
+        if quant:
+            QuantizationTransformPass().apply(prog, startup_program=startup)
+        with fluid.program_guard(prog, startup):
+            fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            losses = []
+            for _ in range(40):
+                l = exe.run(prog, feed={"x": x, "y": y},
+                            fetch_list=[loss], scope=scope)[0]
+                losses.append(float(l))
+        return losses
+
+    fl = train(False)
+    ql = train(True)
+    assert ql[-1] < 0.5 * ql[0], (ql[0], ql[-1])  # QAT converges
+    # 8-bit simulated quant stays close to float training
+    assert abs(ql[-1] - fl[-1]) < 0.35, (fl[-1], ql[-1])
+
+
+def test_ste_gradients_flow_through_quant():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        x.stop_gradient = False
+        h = fluid.layers.fc(x, 4, bias_attr=False)
+        loss = fluid.layers.reduce_sum(h)
+    QuantizationTransformPass(
+        activation_quantize_type="abs_max",
+        weight_quantize_type="abs_max").apply(prog, startup_program=startup)
+    with fluid.program_guard(prog, startup):
+        from paddle_tpu.framework.backward import append_backward
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        xb = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        g = exe.run(prog, feed={"x": xb}, fetch_list=["x@GRAD"],
+                    scope=scope)[0]
+    assert np.abs(np.asarray(g)).sum() > 0.1
+
+
+def test_freeze_pass_folds_weights():
+    x, y = _data()
+    prog, startup, logits, loss = _build_lenet_ish()
+    QuantizationTransformPass().apply(prog, startup_program=startup)
+    with fluid.program_guard(prog, startup):
+        fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for _ in range(20):
+            exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss],
+                    scope=scope)
+        infer = prog.clone(for_test=True)
+        qat_out = np.asarray(exe.run(infer, feed={"x": x[:8], "y": y[:8]},
+                                     fetch_list=[logits], scope=scope)[0])
+
+        w_before = np.asarray(scope.find_var("conv2d_0.w_0")).copy()
+        frozen = QuantizationFreezePass(scope).apply(infer)
+        types = [op.type for op in frozen.global_block().ops]
+        assert "fake_channel_wise_quantize_dequantize_abs_max" not in types
+        w_after = np.asarray(scope.find_var("conv2d_0.w_0"))
+        assert not np.array_equal(w_before, w_after)  # rounded in place
+        # at most 256 distinct values per channel after int8 rounding
+        ch0 = np.unique(w_after[0])
+        assert len(ch0) <= 256
+        frozen_out = np.asarray(exe.run(frozen,
+                                        feed={"x": x[:8], "y": y[:8]},
+                                        fetch_list=[logits], scope=scope)[0])
+    np.testing.assert_allclose(frozen_out, qat_out, rtol=0.1, atol=0.05)
+
+
+def test_transform_pass_scope_init_and_skip(tmp_path):
+    """Reference calling convention: pass a scope, no startup program; and
+    skip_pattern excludes ops whose output names carry the pattern."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        h = fluid.layers.fc(x, 4, name="skip_quant_fc")
+        out = fluid.layers.fc(h, 2)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        QuantizationTransformPass(scope=scope).apply(prog)  # no startup
+        types = [op.type for op in prog.global_block().ops]
+        assert "fake_quantize_dequantize_moving_average_abs_max" in types
+        # the skip_quant-named fc's mul is untouched
+        for op in prog.global_block().ops:
+            if op.type == "mul" and any("skip_quant" in n
+                                        for n in op.output_arg_names):
+                assert not op.input("Y")[0].endswith(".quant_dequant")
+        xb = np.ones((2, 4), np.float32)
+        got = exe.run(prog, feed={"x": xb}, fetch_list=[out], scope=scope)
+        assert np.isfinite(np.asarray(got[0])).all()
