@@ -62,6 +62,13 @@ class QuantizationTransformPass:
     def apply(self, program: Program,
               startup_program: Optional[Program] = None):
         block = program.global_block()
+        if any(op.type.endswith("_grad") for op in block.ops):
+            # grad ops snapshot the forward desc at append_backward time
+            # (framework/backward.py), so rewiring the forward afterwards
+            # would train the UNQUANTIZED network while looking like QAT
+            raise ValueError(
+                "QuantizationTransformPass must run before append_backward/"
+                "minimize: apply the pass first, then add the optimizer")
         quantized: Dict[str, str] = {}  # var -> its dequantized twin
         new_ops: List[Operator] = []
         for op in block.ops:
@@ -191,7 +198,6 @@ class QuantizationFreezePass:
         from ...framework.registry import GRAD_SUFFIX, get_op_spec, has_op
 
         block = program.global_block()
-        qrange = float((1 << (self._wbits - 1)) - 1)
         # freeze is an inference-only pass (the reference applies it to the
         # test graph): drop any backward/optimizer tail first, since grad
         # ops embed forward descs that reference the vars removed below
@@ -214,6 +220,9 @@ class QuantizationFreezePass:
                 var = block.vars.get(src)
                 if var is not None and getattr(var, "persistable", False):
                     arr = np.asarray(self._scope.find_var(src))
+                    # honor the bit width the op actually trained with
+                    bits = int(op.attr("bit_length", self._wbits))
+                    qrange = float((1 << (bits - 1)) - 1)
                     axis = int(op.attr("quant_axis", 0))
                     if op.type.startswith("fake_channel"):
                         red = tuple(i for i in range(arr.ndim) if i != axis)

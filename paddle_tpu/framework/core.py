@@ -133,6 +133,7 @@ def is_compiled_with_tpu() -> bool:
 
 _GLOBAL_FLAGS = {
     "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": "fetch",  # "fetch" | "op" (eager per-op scan)
     "FLAGS_benchmark": False,
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_allocator_strategy": "xla_managed",

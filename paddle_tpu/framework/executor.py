@@ -395,16 +395,17 @@ class Executor:
             return self._run_with_host_ops(
                 program, feed, fetch_names, scope, return_numpy)
 
+        if (get_flag("FLAGS_check_nan_inf")
+                and get_flag("FLAGS_check_nan_inf_level") == "op"):
+            return self._run_op_level_checked(
+                program, feed, fetch_names, scope, return_numpy)
+
         # normalize feed values to jax arrays (device put happens inside jit)
         feed_arrays: Dict[str, Any] = {}
         feed_sig = []
         for name, value in sorted(feed.items()):
-            arr = np.asarray(value)
-            var = (
-                program.global_block().vars.get(name)
-            )
-            if var is not None and var.dtype != arr.dtype.name:
-                arr = arr.astype(np.dtype(var.dtype) if var.dtype != "bfloat16" else jnp.bfloat16)
+            arr = _normalize_feed(program.global_block().vars.get(name),
+                                  value)
             feed_arrays[name] = arr
             feed_sig.append((name, tuple(arr.shape), str(arr.dtype)))
 
@@ -451,6 +452,39 @@ class Executor:
             from ..utils.nan_inf import check_fetches
 
             check_fetches(fetch_names, fetches)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def _run_op_level_checked(self, program, feed, fetch_names, scope,
+                              return_numpy):
+        """FLAGS_check_nan_inf_level=op: interpret the block EAGERLY one op
+        lowering at a time, scanning every floating output on the host —
+        the reference's per-op NaN/Inf localization
+        (details/nan_inf_utils_detail.cc) with op attribution. Debug-only
+        speed; see utils/nan_inf.py."""
+        from ..utils.nan_inf import check_op_outputs
+
+        block = program.global_block()
+        env: Dict[str, Any] = {}
+        for name, var in block.vars.items():
+            if var.persistable and scope.has_var(name):
+                env[name] = scope.find_var(name)
+        for name, value in feed.items():
+            env[name] = jnp.asarray(
+                _normalize_feed(block.vars.get(name), value))
+        seed = program.random_seed or 0
+        rng_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        self._step += 1
+        ctx = LowerCtx(program, block, env, rng_key=rng_key)
+        for op in block.ops:
+            run_lowering(ctx, op)
+            check_op_outputs(op, env)
+        for name, var in block.vars.items():
+            if var.persistable and name in env:
+                scope.set_var(name, env[name])
+        fetches = [env[n] for n in fetch_names]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -626,6 +660,16 @@ class Executor:
                     for name, val in zip(fetch_info, last_fetch))
                 logger.info("step %d: %s", step, msg)
         return last_fetch
+
+
+def _normalize_feed(var, value):
+    """Cast a fed value to its declared var dtype (one rule for the jit and
+    the op-level debug paths)."""
+    arr = np.asarray(value)
+    if var is not None and var.dtype != arr.dtype.name:
+        arr = arr.astype(np.dtype(var.dtype)
+                         if var.dtype != "bfloat16" else jnp.bfloat16)
+    return arr
 
 
 def _analyze_persistables(program: Program) -> Tuple[List[str], List[str]]:

@@ -481,6 +481,9 @@ _TEST_MODE_ATTR_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
     "sync_batch_norm": ("is_test",),
+    # eval must stop mutating the moving quantization-scale state
+    "fake_quantize_dequantize_moving_average_abs_max": ("is_test",),
+    "cudnn_lstm": ("is_test",),
 }
 
 

@@ -1,10 +1,15 @@
 """NaN/Inf debugging — parity with FLAGS_check_nan_inf
 (framework/details/nan_inf_utils_detail.cc per-op output scan).
 
-With whole-program compilation the per-op scan happens on fetches; for
-op-level attribution run the executor with FLAGS_check_nan_inf AND
-FLAGS_check_nan_inf_level=op — the lowering then wraps every op output in a
-jax.debug.check-style assertion via checkify (slower, debug only)."""
+Two levels, like the reference:
+- FLAGS_check_nan_inf_level="fetch" (default): scan fetched values after
+  the whole-block XLA run — cheap, catches that something went non-finite.
+- FLAGS_check_nan_inf_level="op": the Executor interprets the block
+  EAGERLY, one op lowering at a time, checking every floating output on
+  the host and raising with the op type and output name — the reference's
+  per-op localization (plus op attribution, op_call_stack.cc capability).
+  Debug-only speed, exact blame.
+"""
 from __future__ import annotations
 
 import numpy as np
@@ -13,8 +18,40 @@ import numpy as np
 def check_fetches(names, values):
     for name, v in zip(names, values):
         arr = np.asarray(v)
-        if arr.dtype.kind == "f":
+        if arr.dtype.kind != "f":
+            if "float" in str(arr.dtype):  # ml_dtypes kinds report 'V'
+                arr = arr.astype(np.float32)
+            else:
+                continue
+        if np.isnan(arr).any():
+            raise FloatingPointError(f"NaN detected in fetch var {name!r}")
+        if np.isinf(arr).any():
+            raise FloatingPointError(f"Inf detected in fetch var {name!r}")
+
+
+def check_op_outputs(op, env):
+    """Scan one op's outputs in an eager (op-level) run; raises with the
+    op and var responsible (nan_inf_utils_detail.cc per-op behavior)."""
+    for slot, names in op.outputs.items():
+        for name in names:
+            v = env.get(name)
+            if v is None:
+                continue
+            arr = np.asarray(v)
+            if arr.dtype.kind != "f":
+                # ml_dtypes bfloat16/float8 report kind 'V'; they are
+                # float-like and must be scanned too
+                if "float" in str(arr.dtype):
+                    arr = arr.astype(np.float32)
+                else:
+                    continue
+            bad = None
             if np.isnan(arr).any():
-                raise FloatingPointError(f"NaN detected in fetch var {name!r}")
-            if np.isinf(arr).any():
-                raise FloatingPointError(f"Inf detected in fetch var {name!r}")
+                bad = "NaN"
+            elif np.isinf(arr).any():
+                bad = "Inf"
+            if bad:
+                raise FloatingPointError(
+                    f"{bad} detected in output {name!r} (slot {slot}) of op "
+                    f"{op.type!r} — inputs: "
+                    + ", ".join(f"{s}={ns}" for s, ns in op.inputs.items()))

@@ -1,20 +1,29 @@
 """Test config: force CPU with 8 virtual devices so sharding/collective tests
 run without TPU hardware (SURVEY.md §4: the reference tests multi-node as
 multi-process single-host; we test multi-chip as multi-device single-process).
-Must run before jax import."""
+Must run before jax import.
+
+Exception: PADDLE_TPU_NATIVE=1 leaves the platform alone so the tests/tpu
+lane (reference check_output_with_place runs every registered place) can
+exercise the REAL chip: `PADDLE_TPU_NATIVE=1 python -m pytest tests/tpu`.
+"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+_TPU_LANE = os.environ.get("PADDLE_TPU_NATIVE") == "1"
+if not _TPU_LANE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
 
 # The environment may have imported jax at interpreter startup (sitecustomize)
 # with a different platform baked into the config — override it directly so the
 # env var is honored even then.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_LANE:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,87 @@
+"""FLAGS_check_nan_inf levels (details/nan_inf_utils_detail.cc parity):
+fetch-level scan and the op-level eager interpreter with blame attribution.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _nan_program():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        bad = fluid.layers.log(h)  # relu output 0 -> log(0) = -inf
+        out = fluid.layers.reduce_sum(bad)
+    return prog, startup, bad, out
+
+
+def test_fetch_level_detects():
+    prog, startup, bad, out = _nan_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.set_flags({"FLAGS_check_nan_inf": True,
+                     "FLAGS_check_nan_inf_level": "fetch"})
+    try:
+        with pytest.raises(FloatingPointError):
+            exe.run(prog, feed={"x": np.zeros((2, 4), np.float32)},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_op_level_blames_the_op():
+    prog, startup, bad, out = _nan_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.set_flags({"FLAGS_check_nan_inf": True,
+                     "FLAGS_check_nan_inf_level": "op"})
+    try:
+        with pytest.raises(FloatingPointError, match="op 'log'"):
+            exe.run(prog, feed={"x": np.zeros((2, 4), np.float32)},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False,
+                         "FLAGS_check_nan_inf_level": "fetch"})
+
+
+def test_op_level_clean_run_matches_jit():
+    """A healthy program produces the same results through the eager
+    op-level path as the jitted path, and persistables update."""
+    def build():
+        prog, startup = fluid.Program(), fluid.Program()
+        prog.random_seed = 2
+        startup.random_seed = 2
+        with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        return prog, startup, loss
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(8, 4).astype(np.float32)
+    yb = xb[:, :1].astype(np.float32)
+
+    def run(level):
+        prog, startup, loss = build()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            if level:
+                fluid.set_flags({"FLAGS_check_nan_inf": True,
+                                 "FLAGS_check_nan_inf_level": "op"})
+            try:
+                ls = [float(exe.run(prog, feed={"x": xb, "y": yb},
+                                    fetch_list=[loss], scope=scope)[0])
+                      for _ in range(3)]
+            finally:
+                fluid.set_flags({"FLAGS_check_nan_inf": False,
+                                 "FLAGS_check_nan_inf_level": "fetch"})
+        return ls
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)

@@ -63,8 +63,6 @@ def test_qat_trains_and_tracks_float():
 
     def train(quant):
         prog, startup, logits, loss = _build_lenet_ish()
-        with fluid.program_guard(prog, startup):
-            pass  # optimizer appended after (possible) quant rewrite
         if quant:
             QuantizationTransformPass().apply(prog, startup_program=startup)
         with fluid.program_guard(prog, startup):
