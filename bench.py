@@ -115,12 +115,20 @@ def launcher():
     _log(f"probe platform: {platform}")
     saw_accelerator = platform not in (None, "cpu")
     if saw_accelerator:
-        budget = max(60.0, remaining() - CPU_RESERVE_S)
+        budget = max(60.0, remaining() - CPU_RESERVE_S - 90)
         result = _run_worker(dict(os.environ), budget, [])
         if result is None and remaining() > CPU_RESERVE_S + 120:
             # flash kernel may be the failure — retry once without it
             result = _run_worker(dict(os.environ),
                                  remaining() - CPU_RESERVE_S, ["--no-flash"])
+        if result is not None and remaining() > CPU_RESERVE_S + 60:
+            # informational second config in its own process, so a crash
+            # (OOM kill etc.) cannot lose the primary number above
+            wide = _run_worker(dict(os.environ),
+                               remaining() - CPU_RESERVE_S, ["--wide"])
+            if wide is not None:
+                result.setdefault("detail", {})["wide_config"] = \
+                    wide.get("detail", wide)
 
     if result is None:
         degraded = saw_accelerator or _expects_accelerator()
@@ -168,63 +176,85 @@ def worker(use_flash: bool):
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
 
-    if on_acc:
+    def measure(tag, cfg, batch, T, steps):
+        """Compile + run one config; returns (tokens/s, mfu, loss, params).
+
+        Steps are dispatched asynchronously and the chain is forced once at
+        the end — donated params serialize the steps on-device, and syncing
+        per step would bill one tunnel round-trip per step (~25ms here)
+        against pure device time.
+        """
+        pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
+        mesh = PZ.build_mesh(pcfg, devices=[dev])
+        _log(f"worker[{tag}]: init params")
+        params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+        step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, (1, batch, T),
+                              dtype=np.int32)
+        labels = rng.integers(0, cfg.vocab_size, (1, batch, T),
+                              dtype=np.int32)
+        _log(f"worker[{tag}]: compiling train step (first call)")
+        tc = time.perf_counter()
+        params, opt, loss, _ = step(params, opt, tokens, labels)
+        loss0 = float(loss)
+        _log(f"worker[{tag}]: compile+step done in "
+             f"{time.perf_counter() - tc:.1f}s loss={loss0:.4f}")
+        t0 = time.perf_counter()
+        for i in range(steps):
+            params, opt, loss, _ = step(params, opt, tokens, labels)
+        loss_v = float(loss)  # forces the whole chain
+        dt = time.perf_counter() - t0
+        _log(f"worker[{tag}]: {steps} steps in {dt:.2f}s "
+             f"({dt / steps * 1000:.0f} ms/step)")
+        tokens_per_s = steps * batch * T / dt
+        n_params = G.num_params(params)
+        # fwd+bwd ~= 6 * N FLOPs/token (+ attention term), standard
+        # estimate: per layer fwd QK^T + AV = 4*T*d FLOPs/token, x3 fwd+bwd
+        attn = 12 * cfg.num_layers * cfg.d_model * T
+        mfu = tokens_per_s * (6 * n_params + attn) / _peak_flops(dev)
+        return tokens_per_s, mfu, loss_v, n_params
+
+    wide_mode = "--wide" in sys.argv
+    if on_acc and wide_mode:
+        # MXU-saturating width (d_model 2048, head_dim 128) shows the
+        # framework ceiling — GPT_SMALL's 768-wide matmuls cap its MFU well
+        # below what the same code reaches on wider layers
+        cfg = G.GPT_SMALL.scaled(
+            max_seq_len=1024, use_flash=use_flash, d_model=2048,
+            num_heads=16, d_ff=8192, num_layers=6)
+        batch, T, steps = 32, 1024, 8
+        tag = "gpt_wide"
+    elif on_acc:
         cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=use_flash)
-        batch, T, steps = 16, 1024, 8
+        batch, T, steps = 16, 1024, 10
+        tag = "gpt_small"
     else:  # CPU smoke path so the bench always produces a line
         cfg = G.GPT_TINY.scaled(num_layers=2)
         batch, T, steps = 4, 32, 3
+        tag = "gpt_tiny_cpu"
 
-    pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
-    mesh = PZ.build_mesh(pcfg, devices=[dev])
-    _log("worker: init params")
-    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
-    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+    tokens_per_s, mfu, loss_v, n_params = measure(
+        tag, cfg, batch, T, steps)
 
-    rng = np.random.default_rng(0)
-    tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
-    labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
-
-    _log("worker: compiling train step (first call)")
-    tc = time.perf_counter()
-    params, opt, loss, _ = step(params, opt, tokens, labels)
-    loss0 = float(loss)
-    _log(f"worker: compile+step done in {time.perf_counter() - tc:.1f}s "
-         f"loss={loss0:.4f}")
-
-    # sync each step: block_until_ready on a chained async queue is not
-    # reliable through the remote-TPU tunnel, and fetching the scalar loss
-    # costs ~nothing against a full train step
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params, opt, loss, _ = step(params, opt, tokens, labels)
-        float(loss)
-        _log(f"worker: step {i + 1}/{steps} "
-             f"({(time.perf_counter() - t0) / (i + 1):.3f}s/step)")
-    dt = time.perf_counter() - t0
-
-    tokens_per_s = steps * batch * T / dt
-    n_params = G.num_params(params)
-    # fwd+bwd ~= 6 * N FLOPs/token (+ attention term), standard estimate:
-    # per layer fwd QK^T + AV = 4*T*d FLOPs/token, x3 for fwd+bwd
-    attn = 12 * cfg.num_layers * cfg.d_model * T
-    flops_per_token = 6 * n_params + attn
-    mfu = tokens_per_s * flops_per_token / _peak_flops(dev)
-
+    detail = {
+        "config": tag,
+        "model_params": int(n_params),
+        "d_model": cfg.d_model, "num_layers": cfg.num_layers,
+        "seq_len": T, "batch": batch, "steps": steps,
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        "platform": dev.platform,
+        "flash": bool(on_acc and use_flash),
+        "loss": round(loss_v, 4),
+        "tokens_per_s": round(tokens_per_s, 2),
+        "mfu": round(mfu, 4),
+    }
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu, 4),
-        "detail": {
-            "model_params": int(n_params),
-            "seq_len": T, "batch": batch, "steps": steps,
-            "device": str(getattr(dev, "device_kind", dev.platform)),
-            "platform": dev.platform,
-            "flash": bool(on_acc and use_flash),
-            "loss": round(float(loss), 4),
-            "mfu": round(mfu, 4),
-        },
+        "detail": detail,
     }), flush=True)
 
 

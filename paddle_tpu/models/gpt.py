@@ -38,7 +38,16 @@ class GPTConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16   # compute dtype (params stay f32)
     remat: bool = True          # jax.checkpoint each block (HBM <-> FLOPs)
+    # "full": recompute everything (min HBM); "dots": save matmul outputs,
+    # recompute elementwise (recovers most MFU at modest HBM cost)
+    remat_policy: str = "full"
     use_flash: bool = False     # Pallas flash-attention kernel on TPU
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{self.remat_policy!r}")
 
     @property
     def head_dim(self) -> int:
@@ -200,7 +209,12 @@ def run_blocks(blocks, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
     """lax.scan over the stacked layer axis of ``blocks``."""
     f = block_fn
     if cfg.remat:
-        f = jax.checkpoint(block_fn, static_argnums=(2, 3))
+        if cfg.remat_policy == "dots":
+            f = jax.checkpoint(
+                block_fn, static_argnums=(2, 3),
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        else:
+            f = jax.checkpoint(block_fn, static_argnums=(2, 3))
 
     def body(h, layer_p):
         return f(layer_p, h, cfg, tp_axis), None
@@ -229,19 +243,70 @@ def forward(params, tokens, cfg: GPTConfig):
     return logits_fn(params, x, cfg)
 
 
-def token_ce(logits, labels):
+def token_ce(logits, labels, valid=None):
     """Summed (not mean) token cross-entropy in f32 — callers normalize, so
-    distributed shards can psum partial sums."""
+    distributed shards can psum partial sums. ``valid`` masks padding rows.
+
+    lse - gold instead of materializing log_softmax: the full [B,T,V] f32
+    log-prob tensor (3+ GB at GPT-scale vocab) never hits HBM; the cast
+    fuses into the logsumexp reduction.
+    """
     logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.sum(ll)
+    lse = jax.nn.logsumexp(logits, axis=-1)                       # [B,T]
+    gold = jnp.take_along_axis(logits, labels[..., None],
+                               axis=-1)[..., 0]                   # [B,T]
+    ce = lse - gold
+    if valid is not None:
+        ce = jnp.where(valid, ce, 0.0)
+    return jnp.sum(ce)
+
+
+def ce_from_hidden(params, x, labels, cfg: GPTConfig, chunk: int = 2048,
+                   direct_bytes_limit: int = 4 << 30):
+    """Summed token CE straight from hidden states, chunked over rows so the
+    full [rows, V] logits tensor never materializes (at GPT vocab sizes the
+    f32 logits alone are gigabytes — the usual OOM at wide batch). Each
+    chunk recomputes its logits in the backward (jax.checkpoint), costing
+    one extra [chunk, D] x [D, V] matmul per chunk (~1/6 of the vocab-head
+    FLOPs) for an S-fold cut in live logits memory."""
+    head = params["lm_head"]
+    B, T, D = x.shape
+    V = head.shape[-1]
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    rows = x.reshape(B * T, D)
+    labs = labels.reshape(B * T)
+    n = rows.shape[0]
+    # direct path when the f32 logits comfortably fit (chunking buys memory
+    # at ~1/6 extra vocab-head FLOPs — not worth it below ~4 GiB, a quarter
+    # of v5e HBM)
+    if n * V * 4 <= direct_bytes_limit:
+        logits = jnp.einsum("btd,dv->btv", x, head.astype(cfg.dtype))
+        return token_ce(logits, labels)
+    pad = (-n) % chunk
+    if pad:  # remainder rows are masked out of the sum
+        rows = jnp.concatenate([rows, jnp.zeros((pad, D), rows.dtype)])
+        labs = jnp.concatenate([labs, jnp.zeros((pad,), labs.dtype)])
+    valid = (jnp.arange(n + pad) < n).reshape(-1, chunk)
+
+    @jax.checkpoint
+    def chunk_ce(xc, lc, vc):
+        logits = jnp.einsum("rd,dv->rv", xc, head.astype(cfg.dtype))
+        return token_ce(logits, lc, valid=vc)
+
+    def body(acc, args):
+        return acc + chunk_ce(*args), None
+
+    xcs = rows.reshape(-1, chunk, D)
+    lcs = labs.reshape(-1, chunk)
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (xcs, lcs, valid))
+    return total
 
 
 def loss_fn(params, tokens, labels, cfg: GPTConfig):
     """Mean next-token loss, single-device semantics."""
-    logits = forward(params, tokens, cfg)
-    return token_ce(logits, labels) / labels.size
+    x = embed(params, tokens, cfg)
+    x = run_blocks(params["blocks"], x, cfg)
+    return ce_from_hidden(params, x, labels, cfg) / labels.size
 
 
 def num_params(params) -> int:

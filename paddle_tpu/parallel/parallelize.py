@@ -138,8 +138,9 @@ def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
                                   tp_axis=tp_ax if tp > 1 else None)
 
     def mb_loss(x, lbl):  # x [mb, Ts, D] seq-sharded; lbl [mb, T]
-        logits = gpt_mod.logits_fn(params, x, cfg)     # [mb, Ts, V]
-        return gpt_mod.token_ce(logits, seq_chunk(lbl))
+        # chunked CE: full [mb*Ts, V] logits never materialize (see
+        # gpt.ce_from_hidden) — the classic big-vocab OOM at wide batch
+        return gpt_mod.ce_from_hidden(params, x, seq_chunk(lbl), cfg)
 
     perm = [(i, (i + 1) % S) for i in range(S)]
     total_tokens = M * mb * T  # per-dp-rank token count (dp summed via psum)
