@@ -116,16 +116,20 @@ def launcher():
     saw_accelerator = platform not in (None, "cpu")
     if saw_accelerator:
         budget = max(60.0, remaining() - CPU_RESERVE_S - 90)
+        flash_args = []
         result = _run_worker(dict(os.environ), budget, [])
         if result is None and remaining() > CPU_RESERVE_S + 120:
             # flash kernel may be the failure — retry once without it
+            flash_args = ["--no-flash"]
             result = _run_worker(dict(os.environ),
-                                 remaining() - CPU_RESERVE_S, ["--no-flash"])
+                                 remaining() - CPU_RESERVE_S, flash_args)
         if result is not None and remaining() > CPU_RESERVE_S + 60:
             # informational second config in its own process, so a crash
-            # (OOM kill etc.) cannot lose the primary number above
+            # (OOM kill etc.) cannot lose the primary number above; inherits
+            # the flash setting the primary run actually succeeded with
             wide = _run_worker(dict(os.environ),
-                               remaining() - CPU_RESERVE_S, ["--wide"])
+                               remaining() - CPU_RESERVE_S,
+                               ["--wide"] + flash_args)
             if wide is not None:
                 result.setdefault("detail", {})["wide_config"] = \
                     wide.get("detail", wide)

@@ -12,3 +12,4 @@ from . import amp_ops  # noqa: F401
 from . import beam_search  # noqa: F401
 from . import crf  # noqa: F401
 from . import quantize_ops  # noqa: F401
+from . import misc  # noqa: F401

@@ -1,0 +1,507 @@
+"""Long-tail op surface — the smaller reference operators that round out
+parity (reference operators/*.cc cited per op). All static-shape jnp
+lowerings; grads come free from the registry's vjp machinery.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import dtype_to_jax
+from ..framework.registry import register_op
+
+
+# -- creation / shape utilities --------------------------------------------
+
+@register_op("eye", grad=None)
+def eye(ctx, op, ins):
+    rows = int(op.attr("num_rows"))
+    cols = int(op.attr("num_columns", -1))
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    return {"Out": jnp.eye(rows, cols if cols > 0 else rows, dtype=dtype)}
+
+
+@register_op("size", grad=None)
+def size(ctx, op, ins):
+    return {"Out": jnp.asarray(ins["Input"][0].size, jnp.int64)}
+
+
+@register_op("is_empty", grad=None)
+def is_empty(ctx, op, ins):
+    return {"Out": jnp.asarray(ins["X"][0].size == 0)}
+
+
+@register_op("diag", grad=None)
+def diag(ctx, op, ins):
+    return {"Out": jnp.diag(ins["Diagonal"][0])}
+
+
+@register_op("diag_embed", diff_inputs=("Input",))
+def diag_embed(ctx, op, ins):
+    x = ins["Input"][0]
+    offset = int(op.attr("offset", 0))
+    return {"Out": jnp.apply_along_axis(
+        lambda r: jnp.diag(r, k=offset), -1, x)
+        if x.ndim > 1 else jnp.diag(x, k=offset)}
+
+
+@register_op("meshgrid", grad=None)
+def meshgrid(ctx, op, ins):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op("unbind", diff_inputs=("X",))
+def unbind(ctx, op, ins):
+    x = ins["X"][0]
+    axis = int(op.attr("axis", 0))
+    return {"Out": [jnp.squeeze(s, axis)
+                    for s in jnp.split(x, x.shape[axis], axis)]}
+
+
+@register_op("reverse", diff_inputs=("X",))
+def reverse(ctx, op, ins):
+    return {"Out": jnp.flip(ins["X"][0],
+                            axis=[int(a) for a in op.attr("axis")])}
+
+
+@register_op("crop", diff_inputs=("X",))
+def crop(ctx, op, ins):
+    x = ins["X"][0]
+    offsets = [int(v) for v in op.attr("offsets")]
+    shape = [int(v) for v in op.attr("shape")]
+    return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
+
+
+@register_op("pad_constant_like", diff_inputs=("Y",))
+def pad_constant_like(ctx, op, ins):
+    """Pad Y up to X's shape with pad_value (pad_constant_like_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    val = float(op.attr("pad_value", 0.0))
+    widths = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, widths, constant_values=val)}
+
+
+@register_op("shard_index", grad=None)
+def shard_index(ctx, op, ins):
+    """shard_index_op.cc: map global ids to shard-local ids."""
+    x = ins["X"][0]
+    index_num = int(op.attr("index_num"))
+    nshards = int(op.attr("nshards"))
+    shard_id = int(op.attr("shard_id"))
+    ignore = int(op.attr("ignore_value", -1))
+    per = (index_num + nshards - 1) // nshards
+    inside = (x // per) == shard_id
+    return {"Out": jnp.where(inside, x % per, ignore)}
+
+
+# -- elementwise / activations ---------------------------------------------
+
+@register_op("minus", diff_inputs=("X", "Y"))
+def minus(ctx, op, ins):
+    return {"Out": ins["X"][0] - ins["Y"][0]}
+
+
+@register_op("log1p", diff_inputs=("X",))
+def log1p(ctx, op, ins):
+    return {"Out": jnp.log1p(ins["X"][0])}
+
+
+@register_op("log2", diff_inputs=("X",))
+def log2(ctx, op, ins):
+    return {"Out": jnp.log2(ins["X"][0])}
+
+
+@register_op("selu", diff_inputs=("X",))
+def selu(ctx, op, ins):
+    scale = float(op.attr("scale", 1.0507009873554805))
+    alpha = float(op.attr("alpha", 1.6732632423543772))
+    x = ins["X"][0]
+    return {"Out": scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))}
+
+
+@register_op("softshrink", diff_inputs=("X",))
+def softshrink(ctx, op, ins):
+    lam = float(op.attr("lambda", 0.5))
+    x = ins["X"][0]
+    return {"Out": jnp.where(x > lam, x - lam,
+                             jnp.where(x < -lam, x + lam, 0.0))}
+
+
+@register_op("tanh_shrink", diff_inputs=("X",))
+def tanh_shrink(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": x - jnp.tanh(x)}
+
+
+@register_op("stanh", diff_inputs=("X",))
+def stanh(ctx, op, ins):
+    a = float(op.attr("scale_a", 0.67))
+    b = float(op.attr("scale_b", 1.7159))
+    return {"Out": b * jnp.tanh(a * ins["X"][0])}
+
+
+@register_op("maxout", diff_inputs=("X",))
+def maxout(ctx, op, ins):
+    """maxout_op.cc: channels grouped; out C = C/groups (NCHW)."""
+    x = ins["X"][0]
+    groups = int(op.attr("groups"))
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, c // groups, groups, h, w).max(axis=2)}
+
+
+# -- linear algebra ---------------------------------------------------------
+
+@register_op("addmm", diff_inputs=("Input", "X", "Y"))
+def addmm(ctx, op, ins):
+    alpha = float(op.attr("Alpha", 1.0))
+    beta = float(op.attr("Beta", 1.0))
+    return {"Out": beta * ins["Input"][0]
+            + alpha * (ins["X"][0] @ ins["Y"][0])}
+
+
+@register_op("kron", diff_inputs=("X", "Y"))
+def kron(ctx, op, ins):
+    return {"Out": jnp.kron(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("trace", diff_inputs=("Input",))
+def trace(ctx, op, ins):
+    return {"Out": jnp.trace(ins["Input"][0],
+                             offset=int(op.attr("offset", 0)),
+                             axis1=int(op.attr("axis1", 0)),
+                             axis2=int(op.attr("axis2", 1)))}
+
+
+@register_op("inverse", diff_inputs=("Input",))
+def inverse(ctx, op, ins):
+    return {"Output": jnp.linalg.inv(ins["Input"][0])}
+
+
+@register_op("cross", diff_inputs=("X", "Y"))
+def cross(ctx, op, ins):
+    x = ins["X"][0]
+    dim = op.attr("dim", None)
+    if dim is None or int(dim) == -100:
+        # unset: the reference picks the FIRST axis of size 3 (cross_op.cc)
+        axis = next(i for i, d in enumerate(x.shape) if d == 3)
+    else:
+        axis = int(dim)
+    return {"Out": jnp.cross(x, ins["Y"][0], axis=axis)}
+
+
+@register_op("dist", diff_inputs=("X", "Y"))
+def dist(ctx, op, ins):
+    p = float(op.attr("p", 2.0))
+    d = (ins["X"][0] - ins["Y"][0]).ravel()
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(d))
+    elif p == 0:
+        out = jnp.sum(d != 0).astype(d.dtype)
+    else:
+        out = jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return {"Out": out}
+
+
+@register_op("p_norm", diff_inputs=("X",))
+def p_norm(ctx, op, ins):
+    x = ins["X"][0]
+    porder = float(op.attr("porder", 2.0))
+    axis = int(op.attr("axis", -1))
+    keepdim = bool(op.attr("keepdim", False))
+    eps = float(op.attr("epsilon", 1e-12))
+    out = jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim)
+    return {"Out": (out + eps) ** (1.0 / porder)}
+
+
+@register_op("norm", diff_inputs=("X",))
+def norm_op(ctx, op, ins):
+    """norm_op.cc: x / ||x||_2 along axis; Norm output holds the norms."""
+    x = ins["X"][0]
+    axis = int(op.attr("axis", -1))
+    eps = float(op.attr("epsilon", 1e-10))
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / n, "Norm": n}
+
+
+@register_op("squared_l2_norm", diff_inputs=("X",))
+def squared_l2_norm(ctx, op, ins):
+    x = ins["X"][0]
+    return {"Out": jnp.sum(x * x)}
+
+
+@register_op("squared_l2_distance", diff_inputs=("X", "Y"))
+def squared_l2_distance(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - (y if y.shape == x.shape else jnp.broadcast_to(y, x.shape))
+    return {"Out": jnp.sum(sub * sub, axis=tuple(range(1, x.ndim)),
+                           keepdims=True).reshape(x.shape[0], 1),
+            "sub_result": sub}
+
+
+@register_op("l1_norm", diff_inputs=("X",))
+def l1_norm(ctx, op, ins):
+    return {"Out": jnp.sum(jnp.abs(ins["X"][0]))}
+
+
+@register_op("bilinear_tensor_product", diff_inputs=("X", "Y", "Weight",
+                                                     "Bias"))
+def bilinear_tensor_product(ctx, op, ins):
+    """bilinear_tensor_product_op.cc: out[b,k] = x[b] @ W[k] @ y[b] + bias."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": out}
+
+
+@register_op("cos_sim", diff_inputs=("X", "Y"))
+def cos_sim(ctx, op, ins):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+# -- indexing ---------------------------------------------------------------
+
+@register_op("index_select", diff_inputs=("X",))
+def index_select(ctx, op, ins):
+    return {"Out": jnp.take(ins["X"][0], ins["Index"][0].astype(jnp.int32),
+                            axis=int(op.attr("dim", 0)))}
+
+
+@register_op("index_sample", diff_inputs=("X",))
+def index_sample(ctx, op, ins):
+    """index_sample_op.cc: per-row gather. X [B,C], Index [B,K] -> [B,K]."""
+    return {"Out": jnp.take_along_axis(
+        ins["X"][0], ins["Index"][0].astype(jnp.int32), axis=1)}
+
+
+@register_op("scatter_nd", grad=None)
+def scatter_nd(ctx, op, ins):
+    index = ins["Index"][0].astype(jnp.int32)
+    updates = ins["Updates"][0]
+    shape = [int(s) for s in op.attr("shape")]
+    zeros = jnp.zeros(shape, updates.dtype)
+    return {"Out": zeros.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)}
+
+
+@register_op("gather_tree", grad=None)
+def gather_tree(ctx, op, ins):
+    """gather_tree_op.cc: beam-search ancestor backtrace.
+    ids/parents [T, B, K] -> full sequences aligned to final beams."""
+    ids = ins["Ids"][0]
+    parents = ins["Parents"][0].astype(jnp.int32)
+    T, B, K = ids.shape
+
+    def back(beam, t):
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        prev = jnp.take_along_axis(parents[t], beam, axis=1)
+        return prev, tok
+
+    last = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (B, K))
+    _, toks = jax.lax.scan(back, last, jnp.arange(T - 1, -1, -1))
+    return {"Out": toks[::-1]}
+
+
+# -- losses -----------------------------------------------------------------
+
+@register_op("log_loss", diff_inputs=("Predicted",))
+def log_loss(ctx, op, ins):
+    p = ins["Predicted"][0]
+    y = ins["Labels"][0]
+    eps = float(op.attr("epsilon", 1e-4))
+    return {"Loss": -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)}
+
+
+@register_op("rank_loss", diff_inputs=("Left", "Right"))
+def rank_loss(ctx, op, ins):
+    """rank_loss_op.cc: RankNet pairwise loss."""
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+
+
+@register_op("margin_rank_loss", diff_inputs=("X1", "X2"))
+def margin_rank_loss(ctx, op, ins):
+    margin = float(op.attr("margin", 0.0))
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": act, "Activated": (act > 0).astype(x1.dtype)}
+
+
+@register_op("nll_loss", diff_inputs=("X",))
+def nll_loss(ctx, op, ins):
+    """nll_loss_op.cc: X is log-probs [B, C]; Label [B]; optional per-class
+    Weight [C] scales each picked log-prob and the Total_weight."""
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    reduction = op.attr("reduction", "mean")
+    ignore = int(op.attr("ignore_index", -100))
+    picked = -jnp.take_along_axis(x, label[:, None], axis=1)[:, 0]
+    valid = label != ignore
+    if ins.get("Weight"):
+        w = ins["Weight"][0].astype(x.dtype)
+        sample_w = jnp.where(valid, w[jnp.clip(label, 0, w.shape[0] - 1)],
+                             0.0)
+    else:
+        sample_w = valid.astype(x.dtype)
+    picked = jnp.where(valid, picked, 0.0) * sample_w
+    total_w = jnp.maximum(jnp.sum(sample_w), 1e-12)
+    if reduction == "mean":
+        out = jnp.sum(picked) / total_w
+    elif reduction == "sum":
+        out = jnp.sum(picked)
+    else:
+        out = picked
+    return {"Out": out, "Total_weight": total_w}
+
+
+@register_op("label_smooth", diff_inputs=("X",))
+def label_smooth(ctx, op, ins):
+    x = ins["X"][0]
+    eps = float(op.attr("epsilon", 0.0))
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+        return {"Out": (1 - eps) * x + eps * prior}
+    return {"Out": (1 - eps) * x + eps / x.shape[-1]}
+
+
+@register_op("mean_iou", grad=None)
+def mean_iou(ctx, op, ins):
+    """mean_iou_op.cc: per-class IoU mean over num_classes."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    n = int(op.attr("num_classes"))
+    onehot_p = jax.nn.one_hot(pred, n, dtype=jnp.float32)
+    onehot_l = jax.nn.one_hot(label, n, dtype=jnp.float32)
+    inter = jnp.sum(onehot_p * onehot_l, axis=0)
+    # mean_iou_op.h: a mismatch increments BOTH the predicted and the true
+    # class in the wrong table
+    miss = (pred != label)[:, None].astype(jnp.float32)
+    wrong = jnp.sum((onehot_p + onehot_l) * miss, axis=0)
+    # running accumulation across batches via the In* inputs
+    for slot, acc in (("InWrongs", "wrong"), ("InCorrects", "inter")):
+        if ins.get(slot):
+            extra = sum(jnp.asarray(v, jnp.float32) for v in ins[slot])
+            if acc == "wrong":
+                wrong = wrong + extra
+            else:
+                inter = inter + extra
+    union = inter + wrong
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                      1.0)
+    if ins.get("InMeanIou"):
+        prev = jnp.concatenate(
+            [jnp.asarray(v, jnp.float32).reshape(-1)
+             for v in ins["InMeanIou"]])
+        miou = (jnp.sum(prev) + miou) / (prev.shape[0] + 1)
+    return {"OutMeanIou": miou, "OutWrong": wrong, "OutCorrect": inter}
+
+
+# -- vision rearrangement ---------------------------------------------------
+
+@register_op("pixel_shuffle", diff_inputs=("X",))
+def pixel_shuffle(ctx, op, ins):
+    x = ins["X"][0]
+    r = int(op.attr("upscale_factor"))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": x.reshape(n, c // (r * r), h * r, w * r)}
+
+
+@register_op("space_to_depth", diff_inputs=("X",))
+def space_to_depth(ctx, op, ins):
+    x = ins["X"][0]
+    b = int(op.attr("blocksize"))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": x.reshape(n, c * b * b, h // b, w // b)}
+
+
+@register_op("shuffle_channel", diff_inputs=("X",))
+def shuffle_channel(ctx, op, ins):
+    x = ins["X"][0]
+    g = int(op.attr("group"))
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, g, c // g, h, w).swapaxes(1, 2)
+            .reshape(n, c, h, w)}
+
+
+@register_op("temporal_shift", diff_inputs=("X",))
+def temporal_shift(ctx, op, ins):
+    """temporal_shift_op.cc: shift channel slices across the time axis."""
+    x = ins["X"][0]                          # [N*T, C, H, W]
+    seg = int(op.attr("seg_num"))
+    ratio = float(op.attr("shift_ratio", 0.25))
+    nt, c, h, w = x.shape
+    n = nt // seg
+    x = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    fwd = jnp.concatenate(
+        [x[:, 1:, :c1], jnp.zeros_like(x[:, :1, :c1])], axis=1)
+    back = jnp.concatenate(
+        [jnp.zeros_like(x[:, :1, c1:c2]), x[:, :-1, c1:c2]], axis=1)
+    keep = x[:, :, c2:]
+    out = jnp.concatenate([fwd, back, keep], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("lrn", diff_inputs=("X",))
+def lrn(ctx, op, ins):
+    """lrn_op.cc: local response norm across channels (NCHW)."""
+    x = ins["X"][0]
+    n_size = int(op.attr("n", 5))
+    k = float(op.attr("k", 2.0))
+    alpha = float(op.attr("alpha", 1e-4))
+    beta = float(op.attr("beta", 0.75))
+    sq = x * x
+    half = n_size // 2
+    pads = [(0, 0), (half, n_size - 1 - half), (0, 0), (0, 0)]
+    sq = jnp.pad(sq, pads)
+    acc = sum(sq[:, i:i + x.shape[1]] for i in range(n_size))
+    mid = k + alpha * acc
+    return {"Out": x / mid ** beta, "MidOut": mid}
+
+
+@register_op("grid_sampler", diff_inputs=("X", "Grid"))
+def grid_sampler(ctx, op, ins):
+    """grid_sampler_op.cc: bilinear sampling, align_corners=True padding
+    zeros. X [N,C,H,W], Grid [N,Ho,Wo,2] in [-1,1]."""
+    x = ins["X"][0]
+    grid = ins["Grid"][0]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0       # [N,Ho,Wo]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def pick(yy, xx):
+        inside = ((xx >= 0) & (xx < w) & (yy >= 0) & (yy < h))
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        # vmap over batch: x[b, :, yi[b], xi[b]]
+        vals = jax.vmap(lambda img, yb, xb: img[:, yb, xb])(x, yi, xi)
+        return jnp.where(inside[:, None], vals, 0.0)
+
+    v00 = pick(y0, x0)
+    v01 = pick(y0, x0 + 1)
+    v10 = pick(y0 + 1, x0)
+    v11 = pick(y0 + 1, x0 + 1)
+    wxc = wx[:, None]
+    wyc = wy[:, None]
+    out = (v00 * (1 - wyc) * (1 - wxc) + v01 * (1 - wyc) * wxc
+           + v10 * wyc * (1 - wxc) + v11 * wyc * wxc)
+    return {"Output": out}

@@ -1,0 +1,325 @@
+"""Long-tail ops (ops/misc.py) vs numpy oracles — OpTest-style, table-driven
+where the op is a pure elementwise/shape transform."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.program import Operator
+from paddle_tpu.framework.registry import LowerCtx, run_lowering
+
+
+def lower(op_type, inputs, attrs=None, outputs=None):
+    """Run one lowering directly (the OpTest backbone for op kernels)."""
+    import jax.numpy as jnp
+
+    prog = fluid.Program()
+    block = prog.global_block()
+    in_names = {slot: [f"i_{slot}_{i}" for i in range(len(vals))]
+                for slot, vals in inputs.items()}
+    n_out = {k: v for k, v in (outputs or {"Out": 1}).items()}
+    out_names = {slot: [f"o_{slot}_{i}" for i in range(n)]
+                 for slot, n in n_out.items()}
+    env = {}
+    for slot, vals in inputs.items():
+        for name, v in zip(in_names[slot], vals):
+            env[name] = jnp.asarray(v)
+    op = Operator(block, op_type, inputs=in_names, outputs=out_names,
+                  attrs=attrs or {})
+    ctx = LowerCtx(prog, block, env)
+    run_lowering(ctx, op)
+    outs = {slot: [np.asarray(env[n]) for n in names if n in env]
+            for slot, names in out_names.items()}
+    return outs
+
+
+RNG = np.random.RandomState(0)
+X44 = RNG.randn(4, 4).astype(np.float32)
+X_NCHW = RNG.randn(2, 8, 4, 4).astype(np.float32)
+
+
+def test_eye_size_isempty_diag():
+    assert np.array_equal(lower("eye", {}, {"num_rows": 3})["Out"][0],
+                          np.eye(3, dtype=np.float32))
+    assert lower("size", {"Input": [X44]})["Out"][0] == 16
+    assert lower("is_empty", {"X": [np.zeros((0, 3))]})["Out"][0]
+    d = np.array([1.0, 2.0, 3.0], np.float32)
+    assert np.array_equal(lower("diag", {"Diagonal": [d]})["Out"][0],
+                          np.diag(d))
+
+
+def test_elementwise_family():
+    np.testing.assert_allclose(
+        lower("minus", {"X": [X44], "Y": [X44 * 0.5]})["Out"][0], X44 * 0.5)
+    np.testing.assert_allclose(
+        lower("log1p", {"X": [np.abs(X44)]})["Out"][0], np.log1p(np.abs(X44)),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        lower("log2", {"X": [np.abs(X44) + 1]})["Out"][0],
+        np.log2(np.abs(X44) + 1), rtol=1e-6)
+    sc, al = 1.0507009873554805, 1.6732632423543772
+    np.testing.assert_allclose(
+        lower("selu", {"X": [X44]})["Out"][0],
+        sc * np.where(X44 > 0, X44, al * np.expm1(X44)), rtol=1e-5)
+    lam = 0.5
+    np.testing.assert_allclose(
+        lower("softshrink", {"X": [X44]}, {"lambda": lam})["Out"][0],
+        np.where(X44 > lam, X44 - lam, np.where(X44 < -lam, X44 + lam, 0)),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        lower("tanh_shrink", {"X": [X44]})["Out"][0], X44 - np.tanh(X44),
+        rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        lower("stanh", {"X": [X44]}, {"scale_a": 0.67, "scale_b": 1.7159})
+        ["Out"][0], 1.7159 * np.tanh(0.67 * X44), rtol=1e-5)
+
+
+def test_linear_algebra():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(4, 5).astype(np.float32)
+    inp = RNG.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        lower("addmm", {"Input": [inp], "X": [a], "Y": [b]},
+              {"Alpha": 2.0, "Beta": 0.5})["Out"][0],
+        0.5 * inp + 2.0 * (a @ b), rtol=1e-5)
+    np.testing.assert_allclose(
+        lower("kron", {"X": [X44[:2, :2]], "Y": [X44[:3, :3]]})["Out"][0],
+        np.kron(X44[:2, :2], X44[:3, :3]), rtol=1e-6)
+    np.testing.assert_allclose(
+        lower("trace", {"Input": [X44]})["Out"][0], np.trace(X44), rtol=1e-6)
+    m = X44 + 4 * np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(
+        lower("inverse", {"Input": [m]},
+              outputs={"Output": 1})["Output"][0], np.linalg.inv(m),
+        rtol=1e-4, atol=1e-5)
+    v1 = RNG.randn(2, 3).astype(np.float32)
+    v2 = RNG.randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        lower("cross", {"X": [v1], "Y": [v2]})["Out"][0],
+        np.cross(v1, v2), rtol=1e-5)
+    np.testing.assert_allclose(
+        lower("dist", {"X": [X44], "Y": [X44 * 0]}, {"p": 2.0})["Out"][0],
+        np.linalg.norm(X44.ravel()), rtol=1e-5)
+    np.testing.assert_allclose(
+        lower("p_norm", {"X": [X44]}, {"porder": 3.0, "axis": 1})["Out"][0],
+        (np.sum(np.abs(X44) ** 3, 1)) ** (1 / 3), rtol=1e-4)
+    got = lower("norm", {"X": [X44]}, {"axis": 1},
+                outputs={"Out": 1, "Norm": 1})
+    np.testing.assert_allclose(
+        got["Out"][0],
+        X44 / np.sqrt((X44 ** 2).sum(1, keepdims=True) + 1e-10), rtol=1e-5)
+    np.testing.assert_allclose(
+        lower("squared_l2_norm", {"X": [X44]})["Out"][0], (X44 ** 2).sum(),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        lower("l1_norm", {"X": [X44]})["Out"][0], np.abs(X44).sum(),
+        rtol=1e-6)
+    w = RNG.randn(5, 3, 4).astype(np.float32)
+    xx = RNG.randn(2, 3).astype(np.float32)
+    yy = RNG.randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        lower("bilinear_tensor_product",
+              {"X": [xx], "Y": [yy], "Weight": [w]})["Out"][0],
+        np.einsum("bi,kij,bj->bk", xx, w, yy), rtol=1e-4)
+
+
+def test_indexing():
+    idx = np.array([2, 0], np.int64)
+    np.testing.assert_allclose(
+        lower("index_select", {"X": [X44], "Index": [idx]},
+              {"dim": 0})["Out"][0], X44[idx])
+    samp = np.array([[0, 2], [1, 3]], np.int64)
+    np.testing.assert_allclose(
+        lower("index_sample", {"X": [X44[:2]], "Index": [samp]})["Out"][0],
+        np.take_along_axis(X44[:2], samp, axis=1))
+    index = np.array([[1], [3]], np.int64)
+    upd = np.array([9.0, 10.0], np.float32)
+    got = lower("scatter_nd", {"Index": [index], "Updates": [upd]},
+                {"shape": [5]})["Out"][0]
+    exp = np.zeros(5, np.float32)
+    exp[1], exp[3] = 9, 10
+    np.testing.assert_allclose(got, exp)
+
+
+def test_gather_tree():
+    # T=3, B=1, K=2 beams
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.array([[[0, 0]], [[0, 1]], [[1, 0]]], np.int64)
+    got = lower("gather_tree", {"Ids": [ids], "Parents": [parents]})["Out"][0]
+    # final beam 0 traces parents[2][0]=1 -> ids[1][1]=4 whose parent=1 -> ids[0][1]=2
+    np.testing.assert_array_equal(got[:, 0, 0], [2, 4, 5])
+    np.testing.assert_array_equal(got[:, 0, 1], [1, 3, 6])
+
+
+def test_losses():
+    p = np.clip(RNG.rand(4, 1).astype(np.float32), 0.05, 0.95)
+    y = (RNG.rand(4, 1) > 0.5).astype(np.float32)
+    eps = 1e-4
+    np.testing.assert_allclose(
+        lower("log_loss", {"Predicted": [p], "Labels": [y]},
+              {"epsilon": eps}, outputs={"Loss": 1})["Loss"][0],
+        -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps), rtol=1e-5)
+    x = np.log(np.clip(RNG.rand(4, 3).astype(np.float32), 0.1, 1))
+    lab = np.array([0, 2, 1, 2], np.int64)
+    got = lower("nll_loss", {"X": [x], "Label": [lab]},
+                {"reduction": "mean"},
+                outputs={"Out": 1, "Total_weight": 1})["Out"][0]
+    np.testing.assert_allclose(
+        got, np.mean([-x[i, lab[i]] for i in range(4)]), rtol=1e-5)
+    sm = lower("label_smooth", {"X": [np.eye(3, dtype=np.float32)]},
+               {"epsilon": 0.1})["Out"][0]
+    np.testing.assert_allclose(sm, 0.9 * np.eye(3) + 0.1 / 3, rtol=1e-5)
+    lft = RNG.randn(4, 1).astype(np.float32)
+    rgt = RNG.randn(4, 1).astype(np.float32)
+    lbl = (RNG.rand(4, 1) > 0.5).astype(np.float32)
+    np.testing.assert_allclose(
+        lower("rank_loss", {"Left": [lft], "Right": [rgt], "Label": [lbl]})
+        ["Out"][0],
+        np.log1p(np.exp(lft - rgt)) - lbl * (lft - rgt), rtol=1e-5)
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2], np.int64)
+    lab = np.array([0, 1, 2, 2], np.int64)
+    got = lower("mean_iou", {"Predictions": [pred], "Labels": [lab]},
+                {"num_classes": 3},
+                outputs={"OutMeanIou": 1, "OutWrong": 1, "OutCorrect": 1})
+    # class IoUs: 0: 1/1, 1: 1/2, 2: 1/2 -> mean 2/3
+    np.testing.assert_allclose(got["OutMeanIou"][0], 2 / 3, rtol=1e-5)
+
+
+def test_vision_rearrange():
+    r = 2
+    x = RNG.randn(1, 8, 2, 2).astype(np.float32)
+    got = lower("pixel_shuffle", {"X": [x]}, {"upscale_factor": r})["Out"][0]
+    assert got.shape == (1, 2, 4, 4)
+    exp = x.reshape(1, 2, r, r, 2, 2).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(1, 2, 4, 4)
+    np.testing.assert_allclose(got, exp)
+
+    got = lower("space_to_depth", {"X": [X_NCHW]}, {"blocksize": 2})["Out"][0]
+    assert got.shape == (2, 32, 2, 2)
+
+    g = 2
+    got = lower("shuffle_channel", {"X": [X_NCHW]}, {"group": g})["Out"][0]
+    exp = X_NCHW.reshape(2, g, 4, 4, 4).transpose(0, 2, 1, 3, 4) \
+        .reshape(2, 8, 4, 4)
+    np.testing.assert_allclose(got, exp)
+
+    got = lower("maxout", {"X": [X_NCHW]}, {"groups": 2})["Out"][0]
+    np.testing.assert_allclose(
+        got, X_NCHW.reshape(2, 4, 2, 4, 4).max(axis=2))
+
+    seg = 2
+    ts = lower("temporal_shift", {"X": [X_NCHW]},
+               {"seg_num": seg, "shift_ratio": 0.25})["Out"][0]
+    assert ts.shape == X_NCHW.shape
+    xr = X_NCHW.reshape(1, 2, 8, 4, 4)
+    np.testing.assert_allclose(ts.reshape(1, 2, 8, 4, 4)[0, 0, :2],
+                               xr[0, 1, :2])  # forward-shifted slice
+    np.testing.assert_allclose(ts.reshape(1, 2, 8, 4, 4)[0, 1, 2:4],
+                               xr[0, 0, 2:4])  # backward-shifted slice
+    np.testing.assert_allclose(ts.reshape(1, 2, 8, 4, 4)[..., 4:, :, :],
+                               xr[..., 4:, :, :])  # kept slice
+
+
+def test_lrn_matches_numpy():
+    x = RNG.randn(2, 6, 3, 3).astype(np.float32)
+    n_size, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    got = lower("lrn", {"X": [x]},
+                {"n": n_size, "k": k, "alpha": alpha, "beta": beta},
+                outputs={"Out": 1, "MidOut": 1})["Out"][0]
+    exp = np.zeros_like(x)
+    half = n_size // 2
+    for c in range(6):
+        lo, hi = max(0, c - half), min(6, c + n_size - half)
+        acc = (x[:, lo:hi] ** 2).sum(axis=1)
+        exp[:, c] = x[:, c] / (k + alpha * acc) ** beta
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
+
+
+def test_grid_sampler_identity_and_shift():
+    n, c, h, w = 1, 1, 4, 4
+    x = np.arange(16, dtype=np.float32).reshape(n, c, h, w)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, h), np.linspace(-1, 1, w),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+    got = lower("grid_sampler", {"X": [x], "Grid": [grid]},
+                outputs={"Output": 1})["Output"][0]
+    np.testing.assert_allclose(got, x, atol=1e-5)
+    # out-of-bounds pads zero
+    grid2 = grid + 10.0
+    got2 = lower("grid_sampler", {"X": [x], "Grid": [grid2]},
+                 outputs={"Output": 1})["Output"][0]
+    np.testing.assert_allclose(got2, np.zeros_like(x))
+
+
+def test_misc_shape_utils():
+    got = lower("unbind", {"X": [X44]}, {"axis": 0}, outputs={"Out": 4})
+    for i in range(4):
+        np.testing.assert_allclose(got["Out"][i], X44[i])
+    np.testing.assert_allclose(
+        lower("reverse", {"X": [X44]}, {"axis": [0]})["Out"][0], X44[::-1])
+    np.testing.assert_allclose(
+        lower("crop", {"X": [X44]}, {"offsets": [1, 1], "shape": [2, 2]})
+        ["Out"][0], X44[1:3, 1:3])
+    y = X44[:2, :2]
+    np.testing.assert_allclose(
+        lower("pad_constant_like", {"X": [X44], "Y": [y]},
+              {"pad_value": 7.0})["Out"][0],
+        np.pad(y, [(0, 2), (0, 2)], constant_values=7.0))
+    ids = np.array([0, 5, 9, 14], np.int64)
+    got = lower("shard_index", {"X": [ids]},
+                {"index_num": 20, "nshards": 2, "shard_id": 0,
+                 "ignore_value": -1})["Out"][0]
+    np.testing.assert_array_equal(got, [0, 5, 9, -1])
+    ms = lower("meshgrid", {"X": [np.arange(2.0), np.arange(3.0)]},
+               outputs={"Out": 2})
+    np.testing.assert_allclose(ms["Out"][0],
+                               np.meshgrid(np.arange(2.0), np.arange(3.0),
+                                           indexing="ij")[0])
+    cs = lower("cos_sim", {"X": [X44], "Y": [X44]},
+               outputs={"Out": 1, "XNorm": 1, "YNorm": 1})["Out"][0]
+    np.testing.assert_allclose(cs.ravel(), np.ones(4), rtol=1e-5)
+    sqd = lower("squared_l2_distance", {"X": [X44], "Y": [X44 * 0]},
+                outputs={"Out": 1, "sub_result": 1})["Out"][0]
+    np.testing.assert_allclose(sqd.ravel(), (X44 ** 2).sum(1), rtol=1e-5)
+
+
+def test_cross_unset_dim_picks_first_size3_axis():
+    v1 = RNG.randn(3, 5).astype(np.float32)
+    v2 = RNG.randn(3, 5).astype(np.float32)
+    got = lower("cross", {"X": [v1], "Y": [v2]})["Out"][0]
+    np.testing.assert_allclose(got, np.cross(v1, v2, axis=0), rtol=1e-5)
+
+
+def test_nll_loss_class_weights():
+    x = np.log(np.clip(RNG.rand(3, 2).astype(np.float32), 0.1, 1))
+    lab = np.array([0, 1, 1], np.int64)
+    w = np.array([2.0, 0.5], np.float32)
+    got = lower("nll_loss", {"X": [x], "Label": [lab], "Weight": [w]},
+                {"reduction": "mean"},
+                outputs={"Out": 1, "Total_weight": 1})
+    picked = np.array([-x[0, 0] * 2.0, -x[1, 1] * 0.5, -x[2, 1] * 0.5])
+    np.testing.assert_allclose(got["Out"][0], picked.sum() / 3.0, rtol=1e-5)
+    np.testing.assert_allclose(got["Total_weight"][0], 3.0, rtol=1e-6)
+
+
+def test_mean_iou_wrong_counts_both_sides_and_accumulates():
+    pred = np.array([1], np.int64)
+    lab = np.array([2], np.int64)
+    got = lower("mean_iou", {"Predictions": [pred], "Labels": [lab]},
+                {"num_classes": 3},
+                outputs={"OutMeanIou": 1, "OutWrong": 1, "OutCorrect": 1})
+    np.testing.assert_array_equal(got["OutWrong"][0], [0, 1, 1])
+    # accumulate: feed previous wrong/correct back in
+    got2 = lower("mean_iou",
+                 {"Predictions": [np.array([0], np.int64)],
+                  "Labels": [np.array([0], np.int64)],
+                  "InWrongs": [got["OutWrong"][0]],
+                  "InCorrects": [got["OutCorrect"][0]]},
+                 {"num_classes": 3},
+                 outputs={"OutMeanIou": 1, "OutWrong": 1, "OutCorrect": 1})
+    np.testing.assert_array_equal(got2["OutCorrect"][0], [1, 0, 0])
+    np.testing.assert_array_equal(got2["OutWrong"][0], [0, 1, 1])
+    # IoUs: class0 1/1, class1 0/1, class2 0/1 -> mean 1/3
+    np.testing.assert_allclose(got2["OutMeanIou"][0], 1 / 3, rtol=1e-5)
