@@ -429,6 +429,12 @@ class Executor:
                     exe = _CompiledPipelineBlock(
                         program, feed_sig, fetch_names, param_names,
                         written, scope=scope)
+                elif "grad_merge" in program._annotations:
+                    from ..parallel.grad_merge import (
+                        _CompiledGradMergeBlock)
+                    exe = _CompiledGradMergeBlock(
+                        program, feed_sig, fetch_names, param_names,
+                        written, scope=scope)
                 else:
                     exe = _CompiledBlock(
                         program, feed_sig, fetch_names, param_names, written,

@@ -37,6 +37,7 @@ __all__ = [
     "Lamb", "LambOptimizer", "DGCMomentumOptimizer",
     "ExponentialMovingAverage", "ModelAverage",
     "RecomputeOptimizer", "LookaheadOptimizer", "PipelineOptimizer",
+    "GradientMergeOptimizer",
 ]
 
 
@@ -674,6 +675,39 @@ class PipelineOptimizer:
             cut_list=self._cut_list,
             trainable_params=[p.name for p, g in params_grads
                               if g is not None])
+        return opt_ops, params_grads
+
+
+class GradientMergeOptimizer:
+    """Batch-merge / gradient accumulation — the reference's
+    multi_batch_merge_pass (framework/ir/multi_batch_merge_pass.cc) as an
+    optimizer wrapper: the Executor runs the forward+backward region as a
+    lax.scan over k microbatch slices of the fed batch and applies the
+    inner optimizer once on the averaged gradients
+    (parallel/grad_merge.py). With a mean loss this is numerically the
+    same step as feeding the full batch at once — but peak activation
+    memory drops by ~k."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .parallel.grad_merge import annotate_grad_merge
+
+        block = loss.block
+        program = block.program
+        params_grads = self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        bwd_end = len(block.ops)
+        opt_ops = self._optimizer.apply_optimize(
+            loss, startup_program, params_grads)
+        annotate_grad_merge(
+            program, loss, bwd_end, self.k_steps,
+            [g.name for p, g in params_grads if g is not None],
+            avg=self.avg)
         return opt_ops, params_grads
 
 

@@ -1,0 +1,173 @@
+"""Gradient merge (batch-merge) — parity with the reference's
+multi_batch_merge pass (framework/ir/multi_batch_merge_pass.cc), which
+repeats the forward/backward subgraph k times per iteration and applies the
+optimizer once on the merged gradients.
+
+TPU-native shape: ONE compiled program whose fwd+bwd region runs as a
+``lax.scan`` over k microbatch slices of the fed batch, accumulating the
+gradient vars the optimizer tail consumes; the tail then applies once on
+the averaged grads. Semantics match a single large-batch step exactly when
+the loss is a batch mean (tested)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import LowerCtx, run_lowering
+
+
+def annotate_grad_merge(program, loss, bwd_end, k_steps,
+                        grad_names, avg=True):
+    program._annotations["grad_merge"] = {
+        "bwd_end": bwd_end,
+        "k": int(k_steps),
+        "loss": loss.name,
+        "grads": list(grad_names),
+        "avg": bool(avg),
+    }
+    program._bump_version()
+
+
+class _CompiledGradMergeBlock:
+    """Executor counterpart for grad_merge-annotated programs (same call
+    contract as executor._CompiledBlock, single-device)."""
+
+    def __init__(self, program, feed_sig, fetch_names, param_names,
+                 written_names, scope):
+        ann = program._annotations["grad_merge"]
+        block = program.global_block()
+        ops = block.ops
+        k = ann["k"]
+        bwd_end = ann["bwd_end"]
+        loss_name = ann["loss"]
+        grad_names = [g for g in ann["grads"] if g]
+        avg = ann["avg"]
+        self.program = program
+        self.feed_names = [n for n, _, _ in feed_sig]
+        self.fetch_names = list(fetch_names)
+        self.param_names = list(param_names)
+        self.written_names = list(written_names)
+
+        batched = set()
+        batch = None
+        for name, shape, _ in feed_sig:
+            var = block.vars.get(name)
+            if getattr(var, "is_data", False) and shape:
+                if batch is None:
+                    batch = shape[0]
+                elif shape[0] != batch:
+                    raise ValueError(
+                        f"gradient merge: data feed {name!r} has leading "
+                        f"dim {shape[0]} != batch {batch}; all data feeds "
+                        "must share the batch dimension")
+                batched.add(name)
+        if batch is None:
+            raise ValueError("gradient merge needs batched data feeds")
+        if batch % k:
+            raise ValueError(
+                f"batch {batch} not divisible by k_steps {k}")
+        mb = batch // k
+        self._batched = batched
+
+        # persistables mutated in the fwd/bwd region (batch_norm stats)
+        # must thread through the scan carry and reach the tail env
+        fwd_written = [n for n in written_names
+                       if any(n in op.output_arg_names
+                              for op in ops[:bwd_end])]
+        # forward intermediates a caller may fetch (values come from the
+        # LAST microbatch; the loss itself is averaged over all k)
+        fwd_fetch = [n for n in fetch_names
+                     if n != loss_name and n not in grad_names
+                     and any(n in op.output_arg_names
+                             for op in ops[:bwd_end])]
+
+        def fn(mutable_params, const_params, feeds, rng_key):
+            params = dict(const_params)
+            params.update(mutable_params)
+            split = {n: (f.reshape((k, mb) + tuple(f.shape[1:]))
+                         if n in batched else f)
+                     for n, f in feeds.items()}
+
+            def seed_env(i):
+                env = dict(params)
+                for n, f in split.items():
+                    env[n] = (jax.lax.dynamic_index_in_dim(
+                        f, i, 0, keepdims=False) if n in batched else f)
+                return env
+
+            def run_fwd_bwd(env, key):
+                ctx = LowerCtx(program, block, env, rng_key=key)
+                for op in ops[:bwd_end]:
+                    run_lowering(ctx, op)
+
+            def body(carry, i):
+                acc, loss_acc, state, _ = carry
+                env = seed_env(i)
+                env.update(state)  # sequential persistable updates (BN)
+                # distinct randomness per microbatch (dropout masks)
+                run_fwd_bwd(env, jax.random.fold_in(rng_key, i))
+                new_acc = {g: acc[g] + env[g].astype(jnp.float32)
+                           for g in grad_names}
+                new_state = {n: env[n] for n in fwd_written if n in env}
+                fetched = {n: env[n] for n in fwd_fetch if n in env}
+                return (new_acc, loss_acc + env[loss_name]
+                        .astype(jnp.float32), new_state, fetched), None
+
+            # abstract probe shapes the accumulator / carry pytrees
+            def probe():
+                env = seed_env(0)
+                run_fwd_bwd(env, jax.random.PRNGKey(0))
+                return ({g: env[g] for g in grad_names},
+                        {n: env[n] for n in fwd_written if n in env},
+                        {n: env[n] for n in fwd_fetch if n in env})
+
+            g_shapes, s_shapes, f_shapes = jax.eval_shape(probe)
+            acc0 = {g: jnp.zeros(sh.shape, jnp.float32)
+                    for g, sh in g_shapes.items()}
+            state0 = {n: params[n].astype(s_shapes[n].dtype)
+                      if n in params else jnp.zeros(s_shapes[n].shape,
+                                                    s_shapes[n].dtype)
+                      for n in s_shapes}
+            fetch0 = {n: jnp.zeros(sh.shape, sh.dtype)
+                      for n, sh in f_shapes.items()}
+            (acc, loss_sum, fwd_state, fetched), _ = jax.lax.scan(
+                body, (acc0, jnp.float32(0.0), state0, fetch0),
+                jnp.arange(k))
+
+            env = dict(params)
+            env.update({n: f for n, f in feeds.items() if n not in batched})
+            env.update(fwd_state)
+            env.update(fetched)
+            scale = 1.0 / k if avg else 1.0
+            for g in grad_names:
+                # keep the optimizer-input dtype identical to the
+                # non-merged path (bf16 programs must stay bf16)
+                env[g] = (acc[g] * scale).astype(g_shapes[g].dtype)
+            env[loss_name] = loss_sum / k
+            ctx = LowerCtx(program, block, env, rng_key=rng_key)
+            for op in ops[bwd_end:]:
+                run_lowering(ctx, op)
+            fetches = [jnp.atleast_1d(env[n]) for n in self.fetch_names]
+            new_state = {n: env[n] for n in self.written_names if n in env}
+            return fetches, new_state
+
+        self._jitted = jax.jit(fn, donate_argnums=(0,))
+
+    def __call__(self, scope, feed, rng_key):
+        mutable, const = {}, {}
+        written = set(self.written_names)
+        for n in self.param_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} is not initialized in scope — "
+                    "run the startup program first")
+            (mutable if n in written else const)[n] = v
+        feeds = {n: feed[n] for n in self.feed_names}
+        fetches, new_state = self._jitted(mutable, const, feeds, rng_key)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        return fetches

@@ -1,0 +1,100 @@
+"""GradientMergeOptimizer (batch-merge, multi_batch_merge_pass parity):
+k-microbatch accumulation must equal the single full-batch step exactly
+for mean losses."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build(merge_k=None, seed=21):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        sgd = fluid.optimizer.MomentumOptimizer(0.1, 0.9)
+        if merge_k:
+            fluid.optimizer.GradientMergeOptimizer(sgd, k_steps=merge_k) \
+                .minimize(loss)
+        else:
+            sgd.minimize(loss)
+    return main, startup, loss
+
+
+def _train(merge_k, steps=6):
+    main, startup, loss = _build(merge_k)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    rng = np.random.RandomState(3)
+    xb = rng.rand(32, 8).astype("float32")
+    yb = xb[:, :4].argmax(1).astype("int64").reshape(-1, 1)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+            scope=scope)[0]).ravel()[0]) for _ in range(steps)]
+        w = np.asarray(scope.find_var("fc_0.w_0"))
+    return losses, w
+
+
+def test_grad_merge_matches_full_batch():
+    ref_losses, ref_w = _train(None)
+    for k in (2, 4):
+        ml, mw = _train(k)
+        np.testing.assert_allclose(ml, ref_losses, rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(mw, ref_w, rtol=2e-4, atol=1e-5)
+
+
+def test_grad_merge_rejects_indivisible():
+    main, startup, loss = _build(merge_k=3)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        with pytest.raises(ValueError, match="divisible"):
+            exe.run(main, feed={"x": np.zeros((32, 8), np.float32),
+                                "y": np.zeros((32, 1), np.int64)},
+                    fetch_list=[loss], scope=scope)
+
+
+def test_grad_merge_batch_norm_stats_and_extra_fetch():
+    """Forward-written persistables (BN moving stats) thread through the
+    microbatch scan, and forward intermediates stay fetchable."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 4
+    startup.random_seed = 4
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16)
+        h = fluid.layers.batch_norm(h)
+        h = fluid.layers.relu(h)
+        logits = fluid.layers.fc(h, 4)
+        prob = fluid.layers.softmax(logits)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(0.1), k_steps=2).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    rng = np.random.RandomState(5)
+    xb = (rng.rand(16, 8) * 3 + 1).astype("float32")
+    yb = xb[:, :4].argmax(1).astype("int64").reshape(-1, 1)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        # BN moving stats are batch_norm_0.w_1 / .w_2 in this layer's naming
+        mean_name = "batch_norm_0.w_1"
+        before = np.asarray(scope.find_var(mean_name)).copy()
+        out = exe.run(main, feed={"x": xb, "y": yb},
+                      fetch_list=[loss, prob], scope=scope)
+        assert np.asarray(out[1]).shape[-1] == 4  # forward fetch works
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                scope=scope)
+        after = np.asarray(scope.find_var(mean_name))
+    assert not np.allclose(before, after)  # moving stats updated
