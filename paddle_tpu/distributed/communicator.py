@@ -59,16 +59,20 @@ class HalfAsyncCommunicator:
 
     def flush(self):
         """Block until every queued gradient has been merged and sent;
-        raises the first send error instead of hanging on a dead wire."""
+        raises the first send error instead of hanging on a dead wire.
+        The error is cleared once surfaced: a transient push failure is
+        reported exactly once and must not poison every later flush."""
         with self._cv:
             while any(self._queues.values()) or self._inflight:
                 if self._error is not None:
+                    err, self._error = self._error, None
                     raise RuntimeError(
-                        "half-async communicator send failed") from self._error
+                        "half-async communicator send failed") from err
                 self._cv.wait(timeout=0.05)
-        if self._error is not None:
-            raise RuntimeError(
-                "half-async communicator send failed") from self._error
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    "half-async communicator send failed") from err
 
     def stop(self):
         try:

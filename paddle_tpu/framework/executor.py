@@ -256,37 +256,23 @@ class _CompiledBlock:
         # one value per device, exactly like the reference).
         from jax.sharding import PartitionSpec as P
 
-        try:
-            from jax import shard_map as _shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map as _shard_map
-
-        import jax.numpy as _jnp
+        from ..parallel.mesh import (aval_of, feed_aval, jit_shard_map,
+                                     probe_produced_state)
 
         # discover which written names are actually produced (abstract-eval
         # probe, so the shard_map out_specs pytree is known before tracing)
-        def _aval(x):
-            a = jnp.asarray(x) if not hasattr(x, "shape") else x
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
-
-        mutable_avals = {n: _aval(scope.find_var(n)) for n in self.param_names
+        mutable_avals = {n: aval_of(scope.find_var(n)) for n in self.param_names
                          if n in written and scope is not None and scope.has_var(n)}
-        const_avals = {n: _aval(scope.find_var(n)) for n in self.param_names
+        const_avals = {n: aval_of(scope.find_var(n)) for n in self.param_names
                        if n not in written and scope is not None and scope.has_var(n)}
-        feed_avals = {n: jax.ShapeDtypeStruct(tuple(shape), np.dtype(dt) if dt != "bfloat16" else jnp.bfloat16)
-                      for n, shape, dt in feed_sig}
-        key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-        try:
-            _, state_shape = jax.eval_shape(fn, mutable_avals, const_avals,
-                                            feed_avals, key_aval)
-            produced = sorted(state_shape.keys())
-        except Exception:
-            produced = list(self.written_names)
+        feed_avals = {n: feed_aval(shape, dt) for n, shape, dt in feed_sig}
+        produced = probe_produced_state(fn, mutable_avals, const_avals,
+                                        feed_avals, self.written_names)
         self._produced_state = produced
 
         def per_rank(mutable_params, const_params, feeds, rng_key):
             fetches, new_state = fn(mutable_params, const_params, feeds, rng_key)
-            fetches = [_jnp.atleast_1d(f) for f in fetches]
+            fetches = [jnp.atleast_1d(f) for f in fetches]
             new_state = {n: new_state[n] for n in produced}
             return fetches, new_state
 
@@ -299,16 +285,11 @@ class _CompiledBlock:
         fetch_specs = [P(data_axis) for _ in fetch_names]
         state_specs = {n: P() for n in produced}
 
-        smap_kwargs = dict(
-            mesh=mesh,
+        self._jitted = jit_shard_map(
+            per_rank, mesh,
             in_specs=(mutable_specs, const_specs, feed_specs, P()),
             out_specs=(fetch_specs, state_specs),
-        )
-        try:
-            wrapped = _shard_map(per_rank, **smap_kwargs, check_vma=False)
-        except TypeError:  # older jax spells it check_rep
-            wrapped = _shard_map(per_rank, **smap_kwargs, check_rep=False)
-        self._jitted = jax.jit(wrapped, donate_argnums=donate_args)
+            donate_argnums=donate_args)
 
     def __call__(self, scope: Scope, feed: Dict[str, Any], rng_key):
         mutable = {}
@@ -428,13 +409,13 @@ class Executor:
                         _CompiledPipelineBlock)
                     exe = _CompiledPipelineBlock(
                         program, feed_sig, fetch_names, param_names,
-                        written, scope=scope)
+                        written, scope=scope, mesh_plan=mesh_plan)
                 elif "grad_merge" in program._annotations:
                     from ..parallel.grad_merge import (
                         _CompiledGradMergeBlock)
                     exe = _CompiledGradMergeBlock(
                         program, feed_sig, fetch_names, param_names,
-                        written, scope=scope)
+                        written, scope=scope, mesh_plan=mesh_plan)
                 else:
                     exe = _CompiledBlock(
                         program, feed_sig, fetch_names, param_names, written,

@@ -60,6 +60,13 @@ def box_coder(prior_box, prior_box_var, target_box,
 def roi_align(input, rois, pooled_height=1, pooled_width=1,
               spatial_scale=1.0, sampling_ratio=-1, rois_batch_id=None,
               name=None):
+    """RoIAlign (fluid.layers.roi_align parity).
+
+    Note: with ``sampling_ratio<=0`` the reference adaptively picks
+    ``ceil(roi_size/pooled_size)`` samples per bin per ROI; this build uses
+    a fixed 2x2 grid instead (static shapes). Pass ``sampling_ratio>0`` for
+    exact reference parity.
+    """
     helper = LayerHelper("roi_align", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     inputs = {"X": [input], "ROIs": [rois]}

@@ -177,6 +177,13 @@ def roi_align(ctx, op, ins):
     scale = float(op.attr("spatial_scale", 1.0))
     ratio = int(op.attr("sampling_ratio", -1))
     if ratio <= 0:
+        # DEVIATION from the reference (detection/roi_align_op.cc): for
+        # sampling_ratio<=0 the reference adaptively samples
+        # ceil(roi_size/pooled_size) points per bin *per ROI* — a
+        # data-dependent count that XLA's static shapes cannot express.
+        # We use a fixed 2x2 grid per bin (the detectron2 default); large
+        # ROIs are sampled more coarsely than the reference. Pass an
+        # explicit sampling_ratio>0 for exact parity.
         ratio = 2
     if batch_ids is None:
         batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)

@@ -21,6 +21,13 @@ from ..framework.registry import LowerCtx, run_lowering
 
 def annotate_grad_merge(program, loss, bwd_end, k_steps,
                         grad_names, avg=True):
+    block = program.global_block()
+    # anchor the fwd/bwd <-> optimizer-tail boundary on the OPS, not on an
+    # absolute index: a later fleet transpile (GradAllReduce inserts
+    # c_allreduce after each grad's last write) shifts indices, and a stale
+    # bwd_end would truncate the scanned region
+    for op in block.ops[bwd_end:]:
+        op._set_attr("__opt_tail__", 1)
     program._annotations["grad_merge"] = {
         "bwd_end": bwd_end,
         "k": int(k_steps),
@@ -31,17 +38,34 @@ def annotate_grad_merge(program, loss, bwd_end, k_steps,
     program._bump_version()
 
 
+def resolve_tail_start(ops, fallback):
+    """Index of the first optimizer-tail op (see annotate_* anchors);
+    robust to ops inserted into the fwd/bwd region after minimize()."""
+    for idx, op in enumerate(ops):
+        if op.attr("__opt_tail__", 0):
+            return idx
+    return fallback
+
+
 class _CompiledGradMergeBlock:
     """Executor counterpart for grad_merge-annotated programs (same call
-    contract as executor._CompiledBlock, single-device)."""
+    contract as executor._CompiledBlock).
+
+    Composes with data parallelism the way the reference's
+    multi_batch_merge_pass composes with ParallelExecutor: when a
+    ``mesh_plan`` is present the k-microbatch scan runs per device shard —
+    ``gspmd`` mode shards the fed batch over the dp axis and lets the XLA
+    partitioner insert gradient all-reduces; ``shard_map`` mode runs the
+    per-rank program whose own c_allreduce_* ops sync the merged grads
+    (once per k microbatches, on the optimizer tail)."""
 
     def __init__(self, program, feed_sig, fetch_names, param_names,
-                 written_names, scope):
+                 written_names, scope, mesh_plan=None):
         ann = program._annotations["grad_merge"]
         block = program.global_block()
         ops = block.ops
         k = ann["k"]
-        bwd_end = ann["bwd_end"]
+        bwd_end = resolve_tail_start(ops, ann["bwd_end"])
         loss_name = ann["loss"]
         grad_names = [g for g in ann["grads"] if g]
         avg = ann["avg"]
@@ -50,6 +74,8 @@ class _CompiledGradMergeBlock:
         self.fetch_names = list(fetch_names)
         self.param_names = list(param_names)
         self.written_names = list(written_names)
+        self.mesh_plan = mesh_plan
+        mesh_axes = dict(mesh_plan.ring_axes) if mesh_plan else {}
 
         batched = set()
         batch = None
@@ -66,10 +92,30 @@ class _CompiledGradMergeBlock:
                 batched.add(name)
         if batch is None:
             raise ValueError("gradient merge needs batched data feeds")
-        if batch % k:
+
+        # per-rank batch: in shard_map mode each rank sees batch/dp rows
+        # (feeds shard over the single data axis; anything else is out of
+        # scope for a fluid grad-merge program and must fail loudly)
+        shard_ranks = 1
+        shard_mesh = None
+        if mesh_plan is not None and mesh_plan.mode != "single":
+            from .mesh import build_mesh
+            shard_mesh = build_mesh(mesh_plan.axes)
+            if mesh_plan.mode == "shard_map":
+                if mesh_plan.data_axis is None or len(mesh_plan.axes) > 1:
+                    raise NotImplementedError(
+                        "gradient merge composes with a single "
+                        f"data-parallel axis; mesh plan has axes "
+                        f"{mesh_plan.axes} data_axis={mesh_plan.data_axis}")
+                shard_ranks = int(shard_mesh.shape[mesh_plan.data_axis])
+        local_batch = batch // shard_ranks if shard_ranks > 1 else batch
+        if shard_ranks > 1 and batch % shard_ranks:
             raise ValueError(
-                f"batch {batch} not divisible by k_steps {k}")
-        mb = batch // k
+                f"batch {batch} not divisible by {shard_ranks} dp ranks")
+        if local_batch % k:
+            raise ValueError(
+                f"per-rank batch {local_batch} not divisible by k_steps {k}")
+        mb = local_batch // k
         self._batched = batched
 
         # persistables mutated in the fwd/bwd region (batch_norm stats)
@@ -99,7 +145,8 @@ class _CompiledGradMergeBlock:
                 return env
 
             def run_fwd_bwd(env, key):
-                ctx = LowerCtx(program, block, env, rng_key=key)
+                ctx = LowerCtx(program, block, env, rng_key=key,
+                               mesh_axes=mesh_axes)
                 for op in ops[:bwd_end]:
                     run_lowering(ctx, op)
 
@@ -147,14 +194,81 @@ class _CompiledGradMergeBlock:
                 # non-merged path (bf16 programs must stay bf16)
                 env[g] = (acc[g] * scale).astype(g_shapes[g].dtype)
             env[loss_name] = loss_sum / k
-            ctx = LowerCtx(program, block, env, rng_key=rng_key)
+            ctx = LowerCtx(program, block, env, rng_key=rng_key,
+                           mesh_axes=mesh_axes)
             for op in ops[bwd_end:]:
                 run_lowering(ctx, op)
             fetches = [jnp.atleast_1d(env[n]) for n in self.fetch_names]
             new_state = {n: env[n] for n in self.written_names if n in env}
             return fetches, new_state
 
-        self._jitted = jax.jit(fn, donate_argnums=(0,))
+        written = set(written_names)
+        self.mesh = None
+        if mesh_plan is None or mesh_plan.mode == "single":
+            self._jitted = jax.jit(fn, donate_argnums=(0,))
+            return
+
+        from .mesh import (jit_shard_map, named_sharding,
+                           probe_produced_state)
+
+        mesh = shard_mesh
+        self.mesh = mesh
+        n_dev = int(np.prod(mesh.devices.shape))
+        data_axis = mesh_plan.data_axis
+
+        def feed_dims(shape):
+            if shape and shape[0] > 0 and shape[0] % n_dev == 0:
+                return (data_axis,) + (None,) * (len(shape) - 1)
+            return None
+
+        if mesh_plan.mode == "gspmd":
+            mutable_sh = {n: named_sharding(mesh, None)
+                          for n in self.param_names if n in written}
+            const_sh = {n: named_sharding(mesh, None)
+                        for n in self.param_names if n not in written}
+            feed_sh = {n: named_sharding(mesh,
+                                         feed_dims(shape) if n in batched
+                                         else None)
+                       for n, shape, _ in feed_sig}
+            self._jitted = jax.jit(
+                fn,
+                in_shardings=(mutable_sh, const_sh, feed_sh,
+                              named_sharding(mesh, None)),
+                donate_argnums=(0,))
+            return
+
+        # shard_map: per-rank semantics, program's own c_* ops sync grads
+        from jax.sharding import PartitionSpec as P
+
+        from .mesh import aval_of, feed_aval
+
+        mutable_avals = {n: aval_of(scope.find_var(n)) for n in param_names
+                         if n in written and scope.has_var(n)}
+        const_avals = {n: aval_of(scope.find_var(n)) for n in param_names
+                       if n not in written and scope.has_var(n)}
+        feed_avals = {
+            n: feed_aval(((shape[0] // shard_ranks,) + tuple(shape[1:]))
+                         if n in batched else tuple(shape), dt)
+            for n, shape, dt in feed_sig}
+        produced = probe_produced_state(fn, mutable_avals, const_avals,
+                                        feed_avals, self.written_names)
+
+        def per_rank(mutable_params, const_params, feeds, rng_key):
+            fetches, new_state = fn(mutable_params, const_params, feeds,
+                                    rng_key)
+            return fetches, {n: new_state[n] for n in produced}
+
+        mutable_specs = {n: P() for n in self.param_names if n in written}
+        const_specs = {n: P() for n in self.param_names if n not in written}
+        feed_specs = {n: (P(data_axis) if n in batched else P())
+                      for n, _, _ in feed_sig}
+        fetch_specs = [P(data_axis) for _ in fetch_names]
+        state_specs = {n: P() for n in produced}
+        self._jitted = jit_shard_map(
+            per_rank, mesh,
+            in_specs=(mutable_specs, const_specs, feed_specs, P()),
+            out_specs=(fetch_specs, state_specs),
+            donate_argnums=(0,))
 
     def __call__(self, scope, feed, rng_key):
         mutable, const = {}, {}

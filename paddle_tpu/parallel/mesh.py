@@ -89,3 +89,54 @@ def spec_for(var_sharding: Optional[Sequence[Optional[str]]]) -> P:
 
 def named_sharding(mesh: Mesh, var_sharding=None) -> NamedSharding:
     return NamedSharding(mesh, spec_for(var_sharding))
+
+
+def aval_of(x) -> jax.ShapeDtypeStruct:
+    """Abstract value of a scope variable (or anything array-like)."""
+    import jax.numpy as jnp
+
+    a = jnp.asarray(x) if not hasattr(x, "shape") else x
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def feed_aval(shape, dt) -> jax.ShapeDtypeStruct:
+    """Abstract value for a feed signature entry; 'bfloat16' has no numpy
+    dtype and must map to the jax one."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if str(dt) == "bfloat16" else np.dtype(dt)
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def jit_shard_map(per_rank, mesh: Mesh, in_specs, out_specs,
+                  donate_argnums=()):
+    """shard_map + jit with the replication-check kwarg spelled for the
+    running jax version (check_vma on current, check_rep on older). The
+    single wrapping point for the executor / pipeline / grad-merge
+    per-rank executables."""
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        wrapped = _shard_map(per_rank, **kwargs, check_vma=False)
+    except TypeError:  # older jax spells it check_rep
+        wrapped = _shard_map(per_rank, **kwargs, check_rep=False)
+    return jax.jit(wrapped, donate_argnums=donate_argnums)
+
+
+def probe_produced_state(fn, mutable_avals, const_avals, feed_avals,
+                         fallback):
+    """Discover which persistable names ``fn`` actually produces by
+    abstract evaluation (shapes the shard_map out_specs pytree before
+    tracing). Falls back to ``fallback`` when the probe itself cannot
+    run (e.g. collectives that need a bound axis context)."""
+    key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    try:
+        _, state_shape = jax.eval_shape(fn, mutable_avals, const_avals,
+                                        feed_avals, key_aval)
+        return sorted(state_shape.keys())
+    except Exception:
+        return list(fallback)

@@ -32,11 +32,6 @@ import numpy as np
 
 from ..framework.registry import GRAD_SUFFIX, LowerCtx, run_lowering
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
-
 
 # ---------------------------------------------------------------------------
 # annotation (written by PipelineOptimizer.minimize)
@@ -76,6 +71,13 @@ def annotate_pipeline(program, loss, n_fwd: int, bwd_end: int,
         stage_bounds = [min(i * per, n_fwd) for i in range(S)] + [n_fwd]
     stage_ranges = [(stage_bounds[i], stage_bounds[i + 1])
                     for i in range(len(stage_bounds) - 1)]
+    # anchor the region boundaries on the ops (see grad_merge.py
+    # resolve_tail_start): transpiles that insert ops into the backward
+    # region (fleet GradAllReduce) must not shift the optimizer tail
+    for op in block.ops[n_fwd:bwd_end]:
+        op._set_attr("__bwd_op__", 1)
+    for op in block.ops[bwd_end:]:
+        op._set_attr("__opt_tail__", 1)
     program._annotations["pipeline"] = {
         "stage_ranges": stage_ranges,
         "n_fwd": n_fwd,
@@ -97,7 +99,7 @@ class _CompiledPipelineBlock:
     persistable updates written back to the scope."""
 
     def __init__(self, program, feed_sig, fetch_names, param_names,
-                 written_names, scope):
+                 written_names, scope, mesh_plan=None):
         from ..parallel.mesh import build_mesh
 
         ann = program._annotations["pipeline"]
@@ -108,14 +110,47 @@ class _CompiledPipelineBlock:
         self.fetch_names = list(fetch_names)
         self.param_names = list(param_names)
         self.written_names = list(written_names)
+        self.mesh_plan = mesh_plan
+
+        from .grad_merge import resolve_tail_start
 
         stage_ranges: List[Tuple[int, int]] = ann["stage_ranges"]
         S = len(stage_ranges)
         M = ann["microbatches"]
         loss_name = ann["loss"]
         trainable = [n for n in ann["trainable"] if n in param_names]
-        opt_ops = ops[ann["bwd_end"]:]
+        # boundaries are op-anchored (annotate_pipeline), so transpiles
+        # that insert ops after minimize() can't leave a stale bwd_end;
+        # insertions into the FORWARD region would invalidate stage_ranges
+        # and must fail loudly instead of mis-splitting stages
+        n_fwd_now = next(
+            (i for i, op in enumerate(ops)
+             if op.attr("__bwd_op__", 0) or op.attr("__opt_tail__", 0)),
+            ann["n_fwd"])
+        if n_fwd_now != ann["n_fwd"]:
+            raise NotImplementedError(
+                "ops were inserted into the forward region after "
+                "PipelineOptimizer.minimize(); re-run minimize() after "
+                "program surgery so stage boundaries are recomputed")
+        bwd_end = resolve_tail_start(ops, ann["bwd_end"])
+        opt_ops = ops[bwd_end:]
         self._S, self._M = S, M
+
+        # persistables written by the FORWARD region (batch_norm moving
+        # stats, metric states): these update once per microbatch, so they
+        # ride the scan carry and are threaded sequentially through the
+        # schedule, then psum'd as deltas so every rank ends with the
+        # owning stage's final value.
+        written_set = set(written_names)
+        param_set = set(param_names)
+        fwd_written: List[str] = []
+        fwd_written_seen = set()
+        for op in ops[:ann["n_fwd"]]:
+            for name in op.output_arg_names:
+                if (name in written_set and name in param_set
+                        and name not in fwd_written_seen):
+                    fwd_written_seen.add(name)
+                    fwd_written.append(name)
 
         # ---- static interface analysis -------------------------------------
         producer: Dict[str, int] = {}
@@ -139,6 +174,42 @@ class _CompiledPipelineBlock:
                     names.add(name)
             iface_names.append(sorted(names))
 
+        # ---- mesh: (dp?, pp) — composes with data parallelism the way the
+        # reference's PipelineTrainer composes with MultiTrainer replicas:
+        # each dp group runs the full pipeline on its batch shard and grads
+        # are averaged over dp before the (replicated) optimizer tail
+        dp_axes: Tuple[Tuple[str, int], ...] = ()
+        data_axis = None
+        ring_axes: Dict[int, str] = {}
+        if mesh_plan is not None and mesh_plan.axes:
+            dp_axes = tuple(
+                (n, s) for n, s in mesh_plan.axes if n != "pp")
+            if len(dp_axes) > 1:
+                # feeds are sharded (and grads averaged) over exactly one
+                # data axis; a second model-parallel axis has no meaning
+                # for a fluid pipeline program
+                raise NotImplementedError(
+                    f"pipeline composes with a single data-parallel axis; "
+                    f"mesh plan has extra axes {dp_axes}")
+            data_axis = mesh_plan.data_axis
+            ring_axes = dict(mesh_plan.ring_axes)
+        if data_axis is None and dp_axes:
+            data_axis = dp_axes[0][0]
+        mesh = build_mesh(dp_axes + (("pp", S),))
+        self.mesh = mesh
+        dp = int(mesh.shape[data_axis]) if data_axis else 1
+        self._dp = dp
+        has_collectives = any(op.type.startswith("c_") or
+                              op.type in ("allreduce", "broadcast")
+                              for op in ops)
+        if has_collectives and not ring_axes:
+            # a transpiled c_allreduce with no ring->axis mapping would
+            # silently lower as identity and train without gradient sync
+            raise NotImplementedError(
+                "pipeline program contains collective ops but no mesh plan "
+                "maps their ring_ids to mesh axes; run it through "
+                "CompiledProgram.with_data_parallel / a mesh annotation")
+
         # ---- shapes: abstract-eval the forward on one microbatch -----------
         mb_feed_sig = []
         batch = None
@@ -149,10 +220,11 @@ class _CompiledPipelineBlock:
                 batch = shape[0] if batch is None else batch
         if batch is None:
             raise ValueError("pipeline program has no batched data feeds")
-        if batch % M != 0:
+        if batch % (M * dp) != 0:
             raise ValueError(
-                f"batch {batch} not divisible by num_microbatches {M}")
-        mb = batch // M
+                f"batch {batch} not divisible by num_microbatches {M} "
+                f"x dp {dp}")
+        mb = batch // dp // M
         self._batched_feeds = set()
         for name, shape, dt in feed_sig:
             var = block.vars.get(name)
@@ -163,14 +235,11 @@ class _CompiledPipelineBlock:
             else:
                 mb_feed_sig.append((name, tuple(shape), dt))
 
-        def _aval_of(v):
-            a = jnp.asarray(v) if not hasattr(v, "dtype") else v
-            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+        from .mesh import aval_of, feed_aval
 
-        param_avals = {n: _aval_of(scope.find_var(n)) for n in param_names
+        param_avals = {n: aval_of(scope.find_var(n)) for n in param_names
                        if scope.has_var(n)}
-        feed_avals = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
-                      for n, s, d in mb_feed_sig}
+        feed_avals = {n: feed_aval(s, d) for n, s, d in mb_feed_sig}
 
         def fwd_probe(params, feeds):
             env = dict(params)
@@ -183,50 +252,71 @@ class _CompiledPipelineBlock:
 
         iface_avals = jax.eval_shape(fwd_probe, param_avals, feed_avals)
 
-        # ---- carry packing: one fixed-size float32 vector ------------------
-        layouts = []  # per boundary: [(name, shape, size, dtype)]
-        sizes = []
+        # ---- carry packing: one fixed-size vector PER DTYPE ----------------
+        # bf16 activations cross the stage cut as bf16 (half the ppermute
+        # bytes of an f32 carry); integer/bool interface vars (token ids,
+        # masks) ride their own vectors instead of being rejected. bool is
+        # carried as uint8 (collective-friendly) and restored on unpack.
+        def _carry_dt(dt):
+            dt = np.dtype(dt) if not isinstance(dt, np.dtype) else dt
+            return "uint8" if dt == np.dtype(bool) else dt.name
+
+        layouts = []  # per boundary: [(name, shape, n_el, carry_dt, orig_dt)]
+        dtype_sizes: Dict[str, int] = {}
         for b, avals in enumerate(iface_avals):
             lay = []
-            total = 0
+            sizes_b: Dict[str, int] = {}
             for name in iface_names[b]:
                 av = avals[name]
-                if not jnp.issubdtype(av.dtype, jnp.floating):
-                    raise NotImplementedError(
-                        f"pipeline boundary var {name!r} has dtype "
-                        f"{av.dtype}; only floating interfaces are supported")
+                cdt = _carry_dt(av.dtype)
                 n_el = int(np.prod(av.shape)) if av.shape else 1
-                lay.append((name, tuple(av.shape), n_el, av.dtype))
-                total += n_el
+                lay.append((name, tuple(av.shape), n_el, cdt, av.dtype))
+                sizes_b[cdt] = sizes_b.get(cdt, 0) + n_el
             layouts.append(lay)
-            sizes.append(total)
-        K = max(sizes) if sizes else 1
-        self._iface_elems = K
+            for cdt, total in sizes_b.items():
+                dtype_sizes[cdt] = max(dtype_sizes.get(cdt, 0), total)
+        if not dtype_sizes:
+            dtype_sizes = {"float32": 1}
+        carry_dts = sorted(dtype_sizes)
+        self._iface_elems = dict(dtype_sizes)
+
+        def zero_carry():
+            return {cdt: jnp.zeros((dtype_sizes[cdt],), jnp.dtype(cdt))
+                    for cdt in carry_dts}
 
         def pack(b, env):
-            if not layouts[b]:
-                return jnp.zeros((K,), jnp.float32)
-            parts = [env[name].astype(jnp.float32).reshape(-1)
-                     for name, _, _, _ in layouts[b]]
-            vec = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-            pad = K - vec.shape[0]
-            return jnp.pad(vec, (0, pad)) if pad else vec
+            vecs = {}
+            for cdt in carry_dts:
+                parts = [env[name].astype(jnp.dtype(cdt)).reshape(-1)
+                         for name, _, _, c, _ in layouts[b] if c == cdt]
+                if not parts:
+                    vecs[cdt] = jnp.zeros((dtype_sizes[cdt],),
+                                          jnp.dtype(cdt))
+                    continue
+                vec = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+                pad = dtype_sizes[cdt] - vec.shape[0]
+                vecs[cdt] = jnp.pad(vec, (0, pad)) if pad else vec
+            return vecs
 
-        def unpack(b, vec):
+        def unpack(b, vecs):
             out = {}
-            off = 0
-            for name, shape, n_el, dtype in layouts[b]:
-                out[name] = vec[off:off + n_el].reshape(shape).astype(dtype)
-                off += n_el
+            off = {cdt: 0 for cdt in carry_dts}
+            for name, shape, n_el, cdt, orig_dt in layouts[b]:
+                o = off[cdt]
+                out[name] = (vecs[cdt][o:o + n_el].reshape(shape)
+                             .astype(orig_dt))
+                off[cdt] = o + n_el
             return out
 
-        mesh = build_mesh((("pp", S),))
-        self.mesh = mesh
         perm = [(i, (i + 1) % S) for i in range(S)]
         n_fwd = ann["n_fwd"]
 
         def per_rank(mutable_params, const_params, feeds, rng_key):
             stage = jax.lax.axis_index("pp")
+            if dp > 1:
+                # each dp group draws distinct randomness for its shard
+                rng_key = jax.random.fold_in(
+                    rng_key, jax.lax.axis_index(data_axis))
             base_params = dict(const_params)
             base_params.update(mutable_params)
             split = {}
@@ -241,7 +331,7 @@ class _CompiledPipelineBlock:
                 params.update(train_params)
 
                 def tick(carry, t):
-                    iface, loss_sum = carry
+                    iface, loss_sum, fwd_state = carry
                     m = jnp.clip(t - stage, 0, M - 1)
                     feeds_mb = {
                         n: (jax.lax.dynamic_index_in_dim(f, m, 0,
@@ -249,61 +339,102 @@ class _CompiledPipelineBlock:
                             if n in self._batched_feeds else f)
                         for n, f in split.items()
                     }
+                    # distinct randomness per microbatch (dropout masks must
+                    # differ across the M microbatches of one large batch);
+                    # per-op distinctness comes from rng_for's name salt
+                    mb_key = jax.random.fold_in(rng_key, m)
 
                     def make_branch(s):
                         lo, hi = stage_ranges[s]
 
-                        def branch(vec):
+                        def branch(operand):
+                            vec, fstate = operand
                             env = dict(params)
+                            env.update(fstate)
                             env.update(feeds_mb)
                             if s > 0:
                                 env.update(unpack(s - 1, vec))
                             ctx = LowerCtx(program, block, env,
-                                           rng_key=rng_key)
+                                           rng_key=mb_key,
+                                           mesh_axes=ring_axes)
                             for op in ops[lo:hi]:
                                 run_lowering(ctx, op)
+                            new_fstate = {n: env[n] for n in fwd_written}
                             if s < S - 1:
                                 return (pack(s, env),
-                                        jnp.zeros((), jnp.float32))
+                                        jnp.zeros((), jnp.float32),
+                                        new_fstate)
                             loss = env[loss_name].astype(jnp.float32)
-                            return (jnp.zeros((K,), jnp.float32),
-                                    loss.reshape(()))
+                            return (zero_carry(),
+                                    loss.reshape(()), new_fstate)
 
                         return branch
 
-                    out, mb_loss = jax.lax.switch(
-                        stage, [make_branch(s) for s in range(S)], iface)
+                    out, mb_loss, new_fstate = jax.lax.switch(
+                        stage, [make_branch(s) for s in range(S)],
+                        (iface, fwd_state))
                     valid = ((t - stage) >= 0) & ((t - stage) < M)
                     is_last = stage == S - 1
                     loss_sum = loss_sum + jnp.where(valid & is_last,
                                                     mb_loss, 0.0)
-                    nxt = (jax.lax.ppermute(out, "pp", perm)
-                           if S > 1 else out)
-                    return (nxt, loss_sum), None
+                    # warm-up / drain ticks re-run a clipped microbatch: do
+                    # not let them double-update forward-written state
+                    fwd_state = {
+                        n: jnp.where(valid, new_fstate[n], fwd_state[n])
+                        for n in fwd_written
+                    }
+                    nxt = (jax.tree_util.tree_map(
+                        lambda a: jax.lax.ppermute(a, "pp", perm), out)
+                        if S > 1 else out)
+                    return (nxt, loss_sum, fwd_state), None
 
-                carry0 = (jnp.zeros((K,), jnp.float32),
-                          jnp.zeros((), jnp.float32))
-                (_, loss_sum), _ = jax.lax.scan(
+                carry0 = (zero_carry(),
+                          jnp.zeros((), jnp.float32),
+                          {n: jnp.asarray(params[n]) for n in fwd_written})
+                (_, loss_sum, fwd_state_out), _ = jax.lax.scan(
                     tick, carry0, jnp.arange(M + S - 1))
                 # rank-LOCAL loss (only the last stage is nonzero): grads
                 # must not differentiate through a psum — its shard_map
                 # transpose re-psums the cotangent, inflating grads by S
-                return loss_sum / M
+                return loss_sum / M, fwd_state_out
 
             train_params = {n: mutable_params[n] for n in trainable
                             if n in mutable_params}
-            local_loss, grads = jax.value_and_grad(loss_fn)(train_params)
+            (local_loss, fwd_state_local), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(train_params)
             loss_val = jax.lax.psum(local_loss, "pp")
             grads = {n: jax.lax.psum(g, "pp") for n, g in grads.items()}
+            if dp > 1:
+                # global loss = mean over dp shards of per-shard mean loss;
+                # grads follow (each rank's grad is d(local mean)/dparam)
+                loss_val = jax.lax.pmean(loss_val, data_axis)
+                grads = {n: jax.lax.pmean(g, data_axis)
+                         for n, g in grads.items()}
+
+            # forward-written persistables: only the owning stage's rank
+            # holds the true final value; everyone else still has the base,
+            # so a psum of deltas broadcasts the owner's update (then a mean
+            # over dp groups, whose shards saw different data)
+            fwd_final = {}
+            for n in fwd_written:
+                base = jnp.asarray(base_params[n])
+                delta = (fwd_state_local[n] - base).astype(jnp.float32)
+                upd = (base.astype(jnp.float32)
+                       + jax.lax.psum(delta, "pp"))
+                if dp > 1:
+                    upd = jax.lax.pmean(upd, data_axis)
+                fwd_final[n] = upd.astype(base.dtype)
 
             # ---- optimizer tail: the Program's own update ops -------------
             env = dict(base_params)
+            env.update(fwd_final)
             env.update({n: f for n, f in feeds.items()
                         if n not in self._batched_feeds})
             env[loss_name] = loss_val
             for n, g in grads.items():
                 env[n + GRAD_SUFFIX] = g
-            ctx = LowerCtx(program, block, env, rng_key=rng_key)
+            ctx = LowerCtx(program, block, env, rng_key=rng_key,
+                           mesh_axes=ring_axes)
             for op in opt_ops:
                 run_lowering(ctx, op)
 
@@ -326,10 +457,15 @@ class _CompiledPipelineBlock:
         written = set(written_names)
         mutable_specs = {n: P() for n in param_names if n in written}
         const_specs = {n: P() for n in param_names if n not in written}
-        feed_specs = {n: P() for n, _, _ in feed_sig}
+        feed_specs = {n: (P(data_axis) if (dp > 1 and
+                                           n in self._batched_feeds)
+                          else P())
+                      for n, _, _ in feed_sig}
         fetch_specs = [P() for _ in fetch_names]
 
         def _make_jit(produced_state_names):
+            from ..parallel.mesh import jit_shard_map
+
             state_specs = {n: P() for n in produced_state_names}
 
             def wrapped_per_rank(mutable_params, const_params, feeds, key):
@@ -338,15 +474,11 @@ class _CompiledPipelineBlock:
                 return fetches, {n: new_state[n]
                                  for n in produced_state_names}
 
-            kwargs = dict(mesh=mesh,
-                          in_specs=(mutable_specs, const_specs, feed_specs,
-                                    P()),
-                          out_specs=(fetch_specs, state_specs))
-            try:
-                w = _shard_map(wrapped_per_rank, **kwargs, check_vma=False)
-            except TypeError:
-                w = _shard_map(wrapped_per_rank, **kwargs, check_rep=False)
-            return jax.jit(w, donate_argnums=(0,))
+            return jit_shard_map(
+                wrapped_per_rank, mesh,
+                in_specs=(mutable_specs, const_specs, feed_specs, P()),
+                out_specs=(fetch_specs, state_specs),
+                donate_argnums=(0,))
 
         # discover which written names the opt phase actually produces, via
         # an eval_shape of per_rank under a fake axis context: simplest is to

@@ -1,0 +1,245 @@
+"""Composition of the fluid pipeline / gradient-merge executables with data
+parallelism, and the lifted pipeline-boundary dtype restrictions.
+
+Reference: PipelineTrainer composes with MultiTrainer device replicas
+(framework/pipeline_trainer.cc); multi_batch_merge_pass composes with
+ParallelExecutor. Here: _CompiledPipelineBlock runs on a (dp, pp) mesh and
+_CompiledGradMergeBlock runs under the gspmd path.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _mlp_program(pipeline=False, merge_k=None, num_microbatches=2, lr=0.05,
+                 seed=7):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h1 = fluid.layers.fc(x, 16, act="relu",
+                             param_attr=fluid.ParamAttr("w1"),
+                             bias_attr=fluid.ParamAttr("b1"))
+        h2 = fluid.layers.fc(h1, 16, act="relu",
+                             param_attr=fluid.ParamAttr("w2"),
+                             bias_attr=fluid.ParamAttr("b2"))
+        pred = fluid.layers.fc(h2, 1,
+                               param_attr=fluid.ParamAttr("w3"),
+                               bias_attr=fluid.ParamAttr("b3"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        sgd = fluid.optimizer.SGD(lr)
+        if pipeline:
+            fluid.optimizer.PipelineOptimizer(
+                sgd, num_stages=2,
+                num_microbatches=num_microbatches).minimize(loss)
+        elif merge_k:
+            fluid.optimizer.GradientMergeOptimizer(
+                sgd, k_steps=merge_k).minimize(loss)
+        else:
+            sgd.minimize(loss)
+    return prog, startup, loss
+
+
+def _run(prog, startup, loss, data_parallel=False, steps=5, batch=16,
+         wname="w1"):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    rng = np.random.RandomState(0)
+    xb = rng.randn(batch, 8).astype(np.float32)
+    yb = (xb.sum(1, keepdims=True) > 0).astype(np.float32)
+    target = prog
+    if data_parallel:
+        target = fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = []
+        for _ in range(steps):
+            out = exe.run(target, feed={"x": xb, "y": yb},
+                          fetch_list=[loss], scope=scope)
+            losses.append(float(np.mean(np.asarray(out[0]))))
+        w = np.asarray(scope.find_var(wname))
+    return losses, w
+
+
+def test_pipeline_composes_with_data_parallel():
+    """pp=2 x dp=(devices/2): loss/weight parity with single device."""
+    ref_losses, ref_w = _run(*_mlp_program())
+    prog, startup, loss = _mlp_program(pipeline=True)
+    pl, pw = _run(prog, startup, loss, data_parallel=True)
+    np.testing.assert_allclose(pl, ref_losses, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(pw, ref_w, rtol=2e-4, atol=1e-5)
+
+
+def test_grad_merge_composes_with_data_parallel():
+    """grad merge under the gspmd dp path: parity with single device."""
+    ref_losses, ref_w = _run(*_mlp_program())
+    prog, startup, loss = _mlp_program(merge_k=2)
+    ml, mw = _run(prog, startup, loss, data_parallel=True)
+    np.testing.assert_allclose(ml, ref_losses, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(mw, ref_w, rtol=2e-4, atol=1e-5)
+
+
+def test_grad_merge_composes_with_fleet_collective_ops():
+    """CollectiveOptimizer(GradientMergeOptimizer) in collective_ops mode:
+    GradAllReduce inserts c_allreduce_avg INSIDE the recorded fwd/bwd
+    region after minimize(), so the boundary must be op-anchored, not an
+    absolute index (regression: stale bwd_end truncated the scan)."""
+    from paddle_tpu.incubate.fleet.collective import (
+        CollectiveOptimizer, DistributedStrategy)
+
+    ref_losses, ref_w = _run(*_mlp_program())
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 7
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h1 = fluid.layers.fc(x, 16, act="relu",
+                             param_attr=fluid.ParamAttr("w1"),
+                             bias_attr=fluid.ParamAttr("b1"))
+        h2 = fluid.layers.fc(h1, 16, act="relu",
+                             param_attr=fluid.ParamAttr("w2"),
+                             bias_attr=fluid.ParamAttr("b2"))
+        pred = fluid.layers.fc(h2, 1,
+                               param_attr=fluid.ParamAttr("w3"),
+                               bias_attr=fluid.ParamAttr("b3"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        strategy = DistributedStrategy()
+        strategy.mode = "collective_ops"
+        CollectiveOptimizer(
+            fluid.optimizer.GradientMergeOptimizer(
+                fluid.optimizer.SGD(0.05), k_steps=2),
+            strategy).minimize(loss)
+    types = [op.type for op in prog.global_block().ops]
+    assert "c_allreduce_avg" in types
+    ml, mw = _run(prog, startup, loss)
+    np.testing.assert_allclose(ml, ref_losses, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(mw, ref_w, rtol=2e-3, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# lifted boundary restrictions
+# ---------------------------------------------------------------------------
+
+def _int_boundary_program(pipeline):
+    """An int32 mask and a float activation both cross the stage cut."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 11
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h1 = fluid.layers.fc(x, 16, act="relu",
+                             param_attr=fluid.ParamAttr("wa"),
+                             bias_attr=fluid.ParamAttr("ba"))
+        # integer-valued var produced in stage 0, consumed in stage 1
+        mask_i = fluid.layers.cast(
+            fluid.layers.greater_than(
+                h1, fluid.layers.fill_constant([1], "float32", 0.5)),
+            "int32")
+        h2 = fluid.layers.fc(h1, 16, act="relu",
+                             param_attr=fluid.ParamAttr("wb"),
+                             bias_attr=fluid.ParamAttr("bb"))
+        gated = fluid.layers.elementwise_mul(
+            h2, fluid.layers.cast(mask_i, "float32"))
+        pred = fluid.layers.fc(gated, 1,
+                               param_attr=fluid.ParamAttr("wc"),
+                               bias_attr=fluid.ParamAttr("bc"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        sgd = fluid.optimizer.SGD(0.05)
+        if pipeline:
+            fluid.optimizer.PipelineOptimizer(
+                sgd, cut_list=[[h1, mask_i]],
+                num_microbatches=2).minimize(loss)
+        else:
+            sgd.minimize(loss)
+    return prog, startup, loss
+
+
+def test_pipeline_int_var_crosses_cut():
+    ref_losses, ref_w = _run(*_int_boundary_program(False),
+                             steps=4, wname="wa")
+    pl, pw = _run(*_int_boundary_program(True), steps=4, wname="wa")
+    np.testing.assert_allclose(pl, ref_losses, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(pw, ref_w, rtol=2e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# forward-written persistables + per-microbatch rng through the schedule
+# ---------------------------------------------------------------------------
+
+def _bn_dropout_program(mode, k=2, lr=0.05):
+    """mode: 'pipeline' | 'merge' — identical program either way, so the
+    pipeline's per-microbatch semantics can be checked against the
+    grad-merge scan (which is the established single-device oracle for
+    sequential BN-stat updates and per-microbatch dropout masks)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 13
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h1 = fluid.layers.fc(x, 16, act="relu",
+                             param_attr=fluid.ParamAttr("w1"),
+                             bias_attr=fluid.ParamAttr("b1"))
+        h1n = fluid.layers.batch_norm(h1, momentum=0.8,
+                                      moving_mean_name="bn_mean",
+                                      moving_variance_name="bn_variance")
+        h1d = fluid.layers.dropout(h1n, dropout_prob=0.3)
+        h2 = fluid.layers.fc(h1d, 16, act="relu",
+                             param_attr=fluid.ParamAttr("w2"),
+                             bias_attr=fluid.ParamAttr("b2"))
+        pred = fluid.layers.fc(h2, 1,
+                               param_attr=fluid.ParamAttr("w3"),
+                               bias_attr=fluid.ParamAttr("b3"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        sgd = fluid.optimizer.SGD(lr)
+        if mode == "pipeline":
+            fluid.optimizer.PipelineOptimizer(
+                sgd, num_stages=2, num_microbatches=k).minimize(loss)
+        elif mode == "merge":
+            fluid.optimizer.GradientMergeOptimizer(
+                sgd, k_steps=k).minimize(loss)
+        else:
+            sgd.minimize(loss)
+    return prog, startup, loss
+
+
+def _bn_stats_after(mode, steps=3):
+    prog, startup, loss = _bn_dropout_program(mode)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    rng = np.random.RandomState(5)
+    xb = rng.randn(16, 8).astype(np.float32)
+    yb = (xb.sum(1, keepdims=True) > 0).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = [float(np.mean(np.asarray(exe.run(
+            prog, feed={"x": xb, "y": yb}, fetch_list=[loss],
+            scope=scope)[0]))) for _ in range(steps)]
+        stats = {
+            n: np.asarray(scope.find_var(n))
+            for n in ("bn_mean", "bn_variance") if scope.has_var(n)
+        }
+    return losses, stats
+
+
+def test_pipeline_threads_bn_stats_and_microbatch_rng():
+    """The pipelined schedule must update BN moving stats sequentially per
+    microbatch and draw distinct dropout masks per microbatch — exactly
+    what the grad-merge scan does for the same program."""
+    ml, mstats = _bn_stats_after("merge")
+    pl, pstats = _bn_stats_after("pipeline")
+    assert mstats, "expected batch_norm moving stats in scope"
+    np.testing.assert_allclose(pl, ml, rtol=5e-4, atol=1e-5)
+    for n in mstats:
+        np.testing.assert_allclose(
+            pstats[n], mstats[n], rtol=5e-4, atol=1e-5,
+            err_msg=f"moving stat {n} diverged between pipeline and "
+                    "grad-merge execution")
+    # stats must actually have moved off their init (mean 0 / var 1)
+    moved = any(
+        not np.allclose(v, 0.0, atol=1e-6) and not np.allclose(v, 1.0,
+                                                               atol=1e-6)
+        for v in mstats.values())
+    assert moved, "BN moving stats never left their initial values"
