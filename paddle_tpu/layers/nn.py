@@ -23,6 +23,8 @@ __all__ = [
     "square_error_cost", "matmul", "mul", "topk", "accuracy", "one_hot",
     "label_smooth", "pad", "pad2d", "resize_nearest", "resize_bilinear",
     "l2_normalize", "clip", "clip_by_norm", "mean", "pow", "unfold",
+    "continuous_value_model", "data_norm", "nce",
+    "sampled_softmax_with_cross_entropy", "shuffle_batch",
 ]
 
 
@@ -721,4 +723,179 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         attrs={"kernel_sizes": kernel_sizes, "strides": strides,
                "paddings": paddings, "dilations": dilations},
     )
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """fluid.layers.continuous_value_model (layers/nn.py:13865): CTR show/
+    click column transform over cvm op (operators/cvm_op.cc)."""
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cvm", inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]}, attrs={"use_cvm": use_cvm})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """fluid.layers.data_norm (layers/nn.py:3195): global normalization from
+    running BatchSize/BatchSum/BatchSquareSum stats (operators/data_norm_op.cc).
+    The three stats are trainable params whose "grads" carry the batch deltas
+    (see ops/ctr.py data_norm_grad)."""
+    helper = LayerHelper("data_norm", param_attr=param_attr, act=act, name=name)
+    c = input.shape[-1]
+    dtype = "float32"
+    from ..framework.param_attr import ParamAttr
+
+    batch_size = helper.create_parameter(
+        ParamAttr(name=name + ".batch_size" if name else None,
+                  initializer=ConstantInitializer(1e4)),
+        shape=[c], dtype=dtype)
+    batch_sum = helper.create_parameter(
+        ParamAttr(name=name + ".batch_sum" if name else None,
+                  initializer=ConstantInitializer(0.0)),
+        shape=[c], dtype=dtype)
+    batch_square_sum = helper.create_parameter(
+        ParamAttr(name=name + ".batch_square_sum" if name else None,
+                  initializer=ConstantInitializer(1e4)),
+        shape=[c], dtype=dtype)
+    inputs = {"X": [input], "BatchSize": [batch_size],
+              "BatchSum": [batch_sum], "BatchSquareSum": [batch_square_sum]}
+    attrs = {"epsilon": epsilon, "data_layout": data_layout,
+             "slot_dim": slot_dim, "sync_stats": sync_stats,
+             "summary_decay_rate": summary_decay_rate,
+             "enable_scale_and_shift": enable_scale_and_shift}
+    if enable_scale_and_shift:
+        # distinct ParamAttr per param: create_parameter assigns attr.name in
+        # place, so sharing one instance would alias scale onto bias
+        import copy as _copy
+
+        scale_w = helper.create_parameter(
+            _copy.copy(param_attr), shape=[c], dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        bias = helper.create_parameter(
+            _copy.copy(param_attr), shape=[c], dtype=dtype, is_bias=True)
+        inputs["scale_w"] = [scale_w]
+        inputs["bias"] = [bias]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    scales = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(type="data_norm", inputs=inputs,
+                     outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+                     attrs=attrs)
+    return helper.append_activation(out)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """fluid.layers.nce (layers/loss.py:670) over operators/nce_op.cc.
+    ``is_sparse`` is accepted for API parity; grads are dense on TPU (XLA
+    scatter-add — the SelectedRows path is a CPU PS concern)."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[1]
+    num_true = label.shape[1] if len(label.shape) > 1 else 1
+    num_neg_samples = 10 if num_neg_samples is None else int(num_neg_samples)
+    weight = helper.create_parameter(
+        param_attr, shape=[num_total_classes, dim], dtype=input.dtype)
+    bias = None
+    if bias_attr is not False:
+        bias = helper.create_parameter(
+            bias_attr, shape=[num_total_classes, 1], dtype=input.dtype,
+            is_bias=True)
+    sampler_idx = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}[sampler]
+    inputs = {"Input": [input], "Label": [label], "Weight": [weight]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    attrs = {"num_total_classes": int(num_total_classes),
+             "num_neg_samples": num_neg_samples, "seed": seed,
+             "sampler": sampler_idx, "is_sparse": is_sparse}
+    if custom_dist is not None:
+        from ..framework.initializer import NumpyArrayInitializer
+        from ..framework.param_attr import ParamAttr
+        import numpy as _np
+
+        probs = helper.create_parameter(
+            ParamAttr(name=(name + ".dist_probs") if name else None,
+                      initializer=NumpyArrayInitializer(
+                          _np.asarray(custom_dist, dtype="float32")),
+                      trainable=False),
+            shape=[num_total_classes], dtype="float32")
+        inputs["CustomDistProbs"] = [probs]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    sample_labels = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    helper.append_op(type="nce", inputs=inputs,
+                     outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                              "SampleLabels": [sample_labels]},
+                     attrs=attrs)
+    return cost
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None, seed=0):
+    """fluid.layers.sampled_softmax_with_cross_entropy (layers/loss.py:1050):
+    sample_logits + softmax_with_cross_entropy over the sampled columns."""
+    helper = LayerHelper("sample_logits")
+    samples = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    probabilities = helper.create_variable_for_type_inference(
+        logits.dtype, stop_gradient=True)
+    sampled_logits = helper.create_variable_for_type_inference(logits.dtype)
+    sampled_label = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    inputs = {"Logits": [logits], "Labels": [label]}
+    if use_customized_samples:
+        inputs["CustomizedSamples"] = [customized_samples]
+        inputs["CustomizedProbabilities"] = [customized_probabilities]
+    helper.append_op(
+        type="sample_logits", inputs=inputs,
+        outputs={"Samples": [samples], "Probabilities": [probabilities],
+                 "SampledLogits": [sampled_logits],
+                 "SampledLabels": [sampled_label]},
+        attrs={"num_samples": int(num_samples),
+               "use_customized_samples": use_customized_samples,
+               "remove_accidental_hits": remove_accidental_hits,
+               "seed": seed})
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    softmax = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [sampled_logits], "Label": [sampled_label]},
+        outputs={"Softmax": [softmax], "Loss": [loss]},
+        attrs={"soft_label": False, "ignore_index": -100,
+               "numeric_stable_mode": False})
+    return loss
+
+
+def shuffle_batch(x, seed=None):
+    """fluid.contrib.layers.shuffle_batch (contrib/layers/nn.py:761)."""
+    helper = LayerHelper("shuffle_batch")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    shuffle_idx = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    seed_out = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    inputs = {"X": [x]}
+    attrs = {}
+    if seed is not None and not isinstance(seed, int):
+        inputs["Seed"] = [seed]
+    elif seed is not None:
+        attrs["startup_seed"] = int(seed)
+    helper.append_op(type="shuffle_batch", inputs=inputs,
+                     outputs={"Out": [out], "ShuffleIdx": [shuffle_idx],
+                              "SeedOut": [seed_out]},
+                     attrs=attrs)
     return out

@@ -13,6 +13,7 @@ __all__ = [
     "sequence_pool", "sequence_softmax", "sequence_reverse", "sequence_conv",
     "sequence_slice", "sequence_expand_as", "sequence_pad", "sequence_unpad",
     "sequence_mask", "linear_chain_crf", "crf_decoding", "warpctc",
+    "sequence_enumerate", "sequence_erase",
 ]
 
 
@@ -196,3 +197,34 @@ def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
                      attrs={"blank": int(blank),
                             "norm_by_times": bool(norm_by_times)})
     return loss
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None, length=None):
+    """fluid.layers.sequence_enumerate (sequence_lod.py:1234): sliding-window
+    id enumeration; padded form returns (B, T, win_size)."""
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_enumerate", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"win_size": int(win_size),
+                            "pad_value": int(pad_value)})
+    return out
+
+
+def sequence_erase(input, tokens, name=None, length=None):
+    """fluid.layers.sequence_erase: drop listed tokens and left-compact;
+    returns (Out, NewLength) in the padded convention."""
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    new_len = helper.create_variable_for_type_inference(
+        "int64" if length is None else length.dtype, stop_gradient=True)
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_erase", inputs=ins,
+                     outputs={"Out": [out], "Length": [new_len]},
+                     attrs={"tokens": list(tokens)})
+    return out, new_len

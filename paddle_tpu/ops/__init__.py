@@ -13,3 +13,5 @@ from . import beam_search  # noqa: F401
 from . import crf  # noqa: F401
 from . import quantize_ops  # noqa: F401
 from . import misc  # noqa: F401
+from . import ctr  # noqa: F401
+from . import detection_train  # noqa: F401

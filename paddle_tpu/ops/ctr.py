@@ -1,0 +1,339 @@
+"""CTR / recommendation ops (the PaddleRec set).
+
+Reference: the parameter-server CTR training family —
+cvm (operators/cvm_op.cc), nce (operators/nce_op.cc/.h),
+sample_logits (operators/sample_logits_op.cc/.h),
+data_norm (operators/data_norm_op.cc), shuffle_batch
+(operators/shuffle_batch_op.cc), sequence_enumerate / sequence_erase
+(operators/sequence_ops/). These are what Wide&Deep / DeepFM programs built
+against the reference need beyond the generic math/NN ops.
+
+TPU-first notes:
+- negative sampling (nce / sample_logits) uses the reference's log-uniform
+  distribution (math/sampler.cc:56  P(v) = log((v+2)/(v+1)) / log(range+1))
+  implemented as an inverse-CDF transform of jax uniforms — O(1) per draw,
+  no alias tables, fully on-device and replayable (ctx.rng_for) so the
+  vjp-backed grad sees the same samples as the forward.
+- sequence ops follow this repo's padded (batch, max_len) + Length
+  convention (ops/sequence.py) instead of LoD packing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# cvm
+# ---------------------------------------------------------------------------
+
+
+@register_op("cvm", diff_inputs=("X",))
+def cvm(ctx, op, ins):
+    """Continuous-value model op (cvm_op.h CvmComputeKernel): X rows start
+    with [show, click]; use_cvm keeps them (log-transformed), else strips."""
+    x = ins["X"][0]
+    use_cvm = op.attr("use_cvm", True)
+    if use_cvm:
+        c0 = jnp.log(x[:, 0:1] + 1.0)
+        c1 = jnp.log(x[:, 1:2] + 1.0) - c0
+        return {"Y": jnp.concatenate([c0, c1, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+@register_op("cvm_grad", grad=None)
+def cvm_grad(ctx, op, ins):
+    """Reference CvmGradComputeKernel (cvm_op.h:43): dX copies dY into the
+    non-cvm columns and force-sets dX[:, 0:2] = CVM — NOT the analytic vjp
+    of the log transform (the show/click grad is routed to the raw
+    counters), so this op overrides the generic vjp grad."""
+    cvm_in = ins["CVM"][0]
+    dy = ins["Y@GRAD"][0]
+    use_cvm = op.attrs["__fwd__"]["attrs"].get("use_cvm", True)
+    lead = cvm_in.astype(dy.dtype)
+    if use_cvm:
+        dx = jnp.concatenate([lead, dy[:, 2:]], axis=1)
+    else:
+        dx = jnp.concatenate([lead, dy], axis=1)
+    return {"X@GRAD": dx}
+
+
+# ---------------------------------------------------------------------------
+# negative sampling (shared helpers)
+# ---------------------------------------------------------------------------
+
+
+def _log_uniform_sample(key, shape, range_):
+    """Inverse-CDF log-uniform sampler over [0, range_): value =
+    exp(u * log(range_+1)) - 1 (math/sampler.cc:44 Sample())."""
+    u = jax.random.uniform(key, shape)
+    v = jnp.exp(u * np.log(range_ + 1.0)) - 1.0
+    return jnp.clip(v.astype(jnp.int64), 0, range_ - 1)
+
+
+def _log_uniform_prob(values, range_):
+    v = values.astype(jnp.float32)
+    return jnp.log((v + 2.0) / (v + 1.0)) / np.log(range_ + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# nce
+# ---------------------------------------------------------------------------
+
+
+@register_op("nce", diff_inputs=("Input", "Weight", "Bias"), needs_rng=True)
+def nce(ctx, op, ins):
+    """Noise-contrastive estimation (nce_op.h NCEKernel).
+
+    Cost per row i: sum_j w_i * cost_ij over the row's [true..., sampled...]
+    labels, cost = -log(o/(o+b)) for true slots, -log(b/(o+b)) for sampled,
+    o = sigmoid(x.w_label + bias_label), b = P(label) * num_neg_samples.
+    Grads for Input/Weight/Bias come from the generic vjp — analytically
+    identical to NCEGradKernel — with the sample draw replayed bit-exact
+    via ctx.rng_for.
+    """
+    x = ins["Input"][0]                            # [B, d]
+    w = ins["Weight"][0]                           # [K, d]
+    label = ins["Label"][0].astype(jnp.int32)      # [B, num_true]
+    if label.ndim == 1:
+        label = label[:, None]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    sample_weight = ins["SampleWeight"][0] if ins.get("SampleWeight") else None
+
+    num_total = int(op.attr("num_total_classes"))
+    num_neg = int(op.attr("num_neg_samples", 10))
+    sampler_type = int(op.attr("sampler", 0))
+    custom_neg = op.attr("custom_neg_classes", []) or []
+    B, num_true = label.shape
+
+    if custom_neg:
+        neg = jnp.broadcast_to(
+            jnp.asarray(custom_neg, jnp.int32)[None, :], (B, len(custom_neg)))
+        num_neg = len(custom_neg)
+        prob_neg = jnp.full(neg.shape, 1.0 / num_total, jnp.float32)
+    else:
+        key = ctx.rng_for(op)
+        if sampler_type == 1:
+            neg = _log_uniform_sample(key, (B, num_neg), num_total - 1)
+            prob_neg = _log_uniform_prob(neg, num_total - 1)
+        elif sampler_type == 2:
+            probs = ins["CustomDistProbs"][0]
+            neg = jax.random.categorical(
+                key, jnp.log(probs + 1e-20)[None, :].repeat(B, 0),
+                shape=(B, num_neg), axis=-1)
+            prob_neg = probs[neg]
+        else:
+            neg = jax.random.randint(key, (B, num_neg), 0, num_total)
+            prob_neg = jnp.full((B, num_neg), 1.0 / num_total, jnp.float32)
+        neg = neg.astype(jnp.int32)
+
+    samples = jnp.concatenate([label, neg], axis=1)          # [B, S]
+    if sampler_type == 1 and not custom_neg:
+        prob_true = _log_uniform_prob(label, num_total - 1)
+    elif sampler_type == 2 and not custom_neg:
+        prob_true = ins["CustomDistProbs"][0][label]
+    else:
+        prob_true = jnp.full(label.shape, 1.0 / num_total, jnp.float32)
+    prob = jnp.concatenate([prob_true, prob_neg], axis=1)    # [B, S]
+
+    logits = jnp.einsum("bd,bsd->bs", x, w[samples])
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    o = jax.nn.sigmoid(logits)
+    b_noise = prob * num_neg
+    is_true = jnp.arange(samples.shape[1])[None, :] < num_true
+    cost = jnp.where(is_true,
+                     -jnp.log(o / (o + b_noise) + 1e-20),
+                     -jnp.log(b_noise / (o + b_noise) + 1e-20))
+    row_cost = jnp.sum(cost, axis=1, keepdims=True)
+    if sample_weight is not None:
+        row_cost = row_cost * sample_weight.reshape(-1, 1)
+    return {"Cost": row_cost.astype(x.dtype),
+            "SampleLogits": o.astype(x.dtype),
+            "SampleLabels": samples.astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# sample_logits
+# ---------------------------------------------------------------------------
+
+
+@register_op("sample_logits", diff_inputs=("Logits",), needs_rng=True)
+def sample_logits(ctx, op, ins):
+    """Sampled-softmax preprocessing (sample_logits_op.h SampleLogitsKernel):
+    gather [true, sampled] logits, subtract log Q(y|x), optionally mask
+    accidental hits; SampledLabels indexes into the sampled row (0..nt-1)."""
+    logits = ins["Logits"][0]                      # [B, K]
+    labels = ins["Labels"][0].astype(jnp.int32)    # [B, nt]
+    B, K = logits.shape
+    nt = labels.shape[1]
+    num_samples = int(op.attr("num_samples"))
+    remove_hits = op.attr("remove_accidental_hits", True)
+    use_custom = op.attr("use_customized_samples", False)
+
+    if use_custom:
+        samples = ins["CustomizedSamples"][0].astype(jnp.int32)
+        prob = ins["CustomizedProbabilities"][0]
+    else:
+        key = ctx.rng_for(op)
+        neg = _log_uniform_sample(key, (B, num_samples), K).astype(jnp.int32)
+        samples = jnp.concatenate([labels, neg], axis=1)
+        prob = _log_uniform_prob(samples, K)
+
+    sampled = jnp.take_along_axis(logits, samples, axis=1)    # [B, nt+S]
+    if remove_hits:
+        # a sampled negative equal to any true label is masked to -inf-ish
+        hit = (samples[:, :, None] == labels[:, None, :]).any(-1)
+        hit = hit & (jnp.arange(samples.shape[1])[None, :] >= nt)
+        sampled = jnp.where(hit, sampled - 1e20, sampled)
+    sampled = sampled - jnp.log(prob).astype(sampled.dtype)
+    sampled_labels = jnp.broadcast_to(
+        jnp.arange(nt, dtype=jnp.int64)[None, :], (B, nt))
+    return {"Samples": samples.astype(jnp.int64), "Probabilities": prob,
+            "SampledLogits": sampled, "SampledLabels": sampled_labels,
+            "LogitsDim": None, "LabelsDim": None}
+
+
+# ---------------------------------------------------------------------------
+# data_norm
+# ---------------------------------------------------------------------------
+
+
+@register_op("data_norm",
+             diff_inputs=("X", "BatchSize", "BatchSum", "BatchSquareSum"))
+def data_norm(ctx, op, ins):
+    """Global data normalization (data_norm_op.cc:267): means/scales come
+    from running BatchSize/BatchSum/BatchSquareSum stats, Y=(X-mean)*scale.
+    With slot_dim>0, rows whose per-slot show count is ~0 are zeroed
+    (the slot was never displayed)."""
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsquare = ins["BatchSquareSum"][0]
+    slot_dim = int(op.attr("slot_dim", -1))
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsquare)
+    y = (x - means[None, :]) * scales[None, :]
+    enable_ss = op.attr("enable_scale_and_shift", False)
+    if enable_ss:
+        y = y * ins["scale_w"][0][None, :] + ins["bias"][0][None, :]
+    if slot_dim > 0 and not enable_ss:
+        C = x.shape[1]
+        # per slot: show count at column i*slot_dim; zero the whole slot when 0
+        slot_show = x.reshape(x.shape[0], C // slot_dim, slot_dim)[:, :, 0]
+        live = (jnp.abs(slot_show) >= 1e-7)[:, :, None]
+        y = jnp.where(
+            live, y.reshape(x.shape[0], C // slot_dim, slot_dim), 0.0
+        ).reshape(x.shape)
+    return {"Y": y.astype(x.dtype), "Means": means, "Scales": scales}
+
+
+@register_op("data_norm_grad", grad=None)
+def data_norm_grad(ctx, op, ins):
+    """data_norm_op.cc:498 — dX = dY * scale; the stat "grads" are the batch
+    deltas the PS/optimizer adds to the running stats: dBatchSize = N,
+    dBatchSum = column sums of X, dBatchSquareSum = sum((X-mean)^2) + N."""
+    x = ins["X"][0]
+    dy = ins["Y@GRAD"][0]
+    scales = ins["Scales"][0]
+    means = ins["Means"][0]
+    N = x.shape[0]
+    dx = dy * scales[None, :]
+    d_size = jnp.full(scales.shape, float(N), scales.dtype)
+    d_sum = jnp.sum(x, axis=0)
+    d_square = jnp.sum(jnp.square(x - means[None, :]), axis=0) + float(N)
+    return {"X@GRAD": dx.astype(x.dtype), "BatchSize@GRAD": d_size,
+            "BatchSum@GRAD": d_sum, "BatchSquareSum@GRAD": d_square}
+
+
+# ---------------------------------------------------------------------------
+# shuffle_batch
+# ---------------------------------------------------------------------------
+
+
+@register_op("shuffle_batch", diff_inputs=("X",), needs_rng=True)
+def shuffle_batch(ctx, op, ins):
+    """Row shuffle (shuffle_batch_op.cc): permutes dim-0; ShuffleIdx records
+    the permutation so the grad can unshuffle. The vjp of take() scatters
+    dOut back through the same (replayed) permutation — exactly
+    shuffle_batch_grad's behavior."""
+    x = ins["X"][0]
+    seed_in = ins["Seed"][0] if ins.get("Seed") else None
+    startup_seed = int(op.attr("startup_seed", 0))
+    n = x.shape[0]
+    # an explicit seed (Seed input or startup_seed attr) pins the engine like
+    # the reference's std::default_random_engine(seed); otherwise draw from
+    # the program rng stream
+    if seed_in is not None:
+        key = jax.random.PRNGKey(0)
+        key = jax.random.fold_in(key, seed_in.reshape(()).astype(jnp.int32))
+        seed_out = (seed_in.reshape((1,)) + 1).astype(seed_in.dtype)
+    elif startup_seed:
+        key = jax.random.PRNGKey(startup_seed)
+        seed_out = jnp.asarray([startup_seed + 1], jnp.int32)
+    else:
+        key = ctx.rng_for(op)
+        seed_out = jnp.ones((1,), jnp.int32)
+    idx = jax.random.permutation(key, n)
+    out = jnp.take(x, idx, axis=0)
+    return {"Out": out, "ShuffleIdx": idx.astype(jnp.int32),
+            "SeedOut": seed_out}
+
+
+# ---------------------------------------------------------------------------
+# sequence_enumerate / sequence_erase (padded + Length convention)
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_enumerate", grad=None)
+def sequence_enumerate(ctx, op, ins):
+    """Sliding-window enumeration (sequence_ops/sequence_enumerate_op.cc):
+    Out[b, i, j] = X[b, i+j] while i+j is inside the sequence, else
+    pad_value. X: (B, T) ids (+ optional Length)."""
+    x = ins["X"][0]
+    win = int(op.attr("win_size"))
+    pad = op.attr("pad_value", 0)
+    B, T = x.shape[0], x.shape[1]
+    if ins.get("Length"):
+        ln = ins["Length"][0].astype(jnp.int32)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    padded = jnp.pad(x, ((0, 0), (0, win)), constant_values=pad)
+    cols = jnp.arange(T)[:, None] + jnp.arange(win)[None, :]   # [T, win]
+    out = padded[:, cols]                                      # [B, T, win]
+    inside = cols[None, :, :] < ln[:, None, None]
+    out = jnp.where(inside, out, jnp.asarray(pad, x.dtype))
+    # positions past the row's length emit pad as well (they aren't real rows)
+    valid_row = (jnp.arange(T)[None, :] < ln[:, None])[..., None]
+    out = jnp.where(valid_row, out, jnp.asarray(pad, x.dtype))
+    return {"Out": out}
+
+
+@register_op("sequence_erase", grad=None)
+def sequence_erase(ctx, op, ins):
+    """Token removal with left-compaction (sequence_ops/sequence_erase_op.cc).
+    Padded form: erased tokens are squeezed out by a stable keep-first
+    argsort; Length shrinks accordingly. Pad slots are filled with 0."""
+    x = ins["X"][0]
+    tokens = op.attr("tokens", []) or []
+    B, T = x.shape[0], x.shape[1]
+    if ins.get("Length"):
+        ln = ins["Length"][0].astype(jnp.int32)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    in_seq = jnp.arange(T)[None, :] < ln[:, None]
+    erased = jnp.zeros_like(in_seq)
+    for t in tokens:
+        erased = erased | (x == t)
+    keep = in_seq & ~erased
+    # stable sort: kept tokens (key 0..T-1) before dropped/pad (key T+pos)
+    key = jnp.where(keep, jnp.arange(T)[None, :],
+                    T + jnp.arange(T)[None, :])
+    order = jnp.argsort(key, axis=1)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(ln.dtype)
+    out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], gathered, 0)
+    return {"Out": out, "Length": new_len}

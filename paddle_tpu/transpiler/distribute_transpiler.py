@@ -98,6 +98,18 @@ class DistributeTranspiler:
 
         params_grads = self._collect_param_grads()
         self.param_grad_map = params_grads
+        # Params read through a lookup_table marked is_distributed live ONLY
+        # on the pserver sparse table: the trainer pulls rows by id
+        # (distributed_lookup_table / parameter_prefetch.cc) and pushes row
+        # grads (distributed_push_sparse) instead of dense send/recv.
+        self.sparse_params: Dict[str, Dict] = {}
+        for op in self.origin_program.global_block().ops:
+            if op.type == "lookup_table" and (op.attr("is_distributed", False)
+                                              or op.attr("remote_prefetch",
+                                                         False)):
+                wname = op.input("W")[0]
+                wvar = self.origin_program.global_block().var(wname)
+                self.sparse_params[wname] = {"dim": int(wvar.shape[-1])}
         # Endpoint assignment: each param goes WHOLE to exactly one pserver,
         # greedily balanced by element count.  (The reference additionally
         # slices big params into VarBlocks across pservers —
@@ -153,9 +165,39 @@ class DistributeTranspiler:
                 if lr_ins:
                     self._lr_var_of[op.input("Param")[0]] = lr_ins[0]
         new_ops = [op for op in block.ops if op.type not in opt_types]
+        # sparse rewrite: lookup_table on a distributed param becomes a remote
+        # row pull; its grad op becomes a sparse row push of Out@GRAD (the
+        # dense [V, D] scatter the generic lookup_table_grad would build never
+        # materializes on the trainer)
+        for op in new_ops:
+            if op.type == "lookup_table" and \
+                    op.input("W")[0] in self.sparse_params:
+                w = op.input("W")[0]
+                op.type = "distributed_lookup_table"
+                op.inputs = {"Ids": list(op.input("Ids"))}
+                op.attrs = {"epmap": self.param_to_ep.get(
+                                w, self.pserver_endpoints[:1]),
+                            "table_name": w,
+                            "trainer_id": self.trainer_id}
+            elif op.type == "lookup_table_grad" and \
+                    op.input("W") and op.input("W")[0] in self.sparse_params:
+                w = op.input("W")[0]
+                out_grad = op.input("Out" + "@GRAD")[0]
+                op.type = "distributed_push_sparse"
+                op.inputs = {"Ids": list(op.input("Ids")),
+                             "Grad": [out_grad]}
+                op.outputs = {}
+                op.attrs = {"epmap": self.param_to_ep.get(
+                                w, self.pserver_endpoints[:1]),
+                            "table_name": w,
+                            "trainer_id": self.trainer_id,
+                            "sync_mode": self.sync_mode,
+                            "lr_var": self._lr_var_of.get(w)}
         block.ops = new_ops
         prog._bump_version()
         for p, g in self.param_grad_map:
+            if p.name in self.sparse_params:
+                continue  # row grads already pushed by distributed_push_sparse
             eps = self.param_to_ep.get(p.name, self.pserver_endpoints[:1])
             block.append_op(
                 type="send",
@@ -172,6 +214,8 @@ class DistributeTranspiler:
                 "endpoints": self.pserver_endpoints,
                 "trainer_id": self.trainer_id})
         for p, _ in self.param_grad_map:
+            if p.name in self.sparse_params:
+                continue  # rows are pulled per-batch, never recv'd whole
             eps = self.param_to_ep.get(p.name, self.pserver_endpoints[:1])
             block.append_op(
                 type="recv",
@@ -229,14 +273,24 @@ class DistributeTranspiler:
                     hparams["eps"] = float(op.attr("epsilon", 1e-8))
                 elif op.type == "adagrad":
                     hparams["eps"] = float(op.attr("epsilon", 1e-6))
-                tables.append({
-                    "name": pname,
-                    "shape": [int(d) for d in pvar.shape],
-                    "optimizer": table_opt.get(op.type, "sgd"),
-                    "lr": 0.01,  # overwritten per push by the trainer's lr
-                    "is_sparse": False,
-                    "hparams": hparams,
-                })
+                if pname in self.sparse_params:
+                    tables.append({
+                        "name": pname,
+                        "dim": self.sparse_params[pname]["dim"],
+                        "optimizer": table_opt.get(op.type, "sgd"),
+                        "lr": 0.01,
+                        "is_sparse": True,
+                        "hparams": hparams,
+                    })
+                else:
+                    tables.append({
+                        "name": pname,
+                        "shape": [int(d) for d in pvar.shape],
+                        "optimizer": table_opt.get(op.type, "sgd"),
+                        "lr": 0.01,  # overwritten per push by the trainer's lr
+                        "is_sparse": False,
+                        "hparams": hparams,
+                    })
         block.append_op(
             type="listen_and_serv",
             attrs={

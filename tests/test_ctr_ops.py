@@ -1,0 +1,300 @@
+"""CTR/PaddleRec op family: cvm, nce, sample_logits, data_norm,
+shuffle_batch, sequence_enumerate, sequence_erase.
+
+Oracles follow the reference kernels (operators/cvm_op.h, nce_op.h,
+sample_logits_op.h, data_norm_op.cc, sequence_ops/*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.backward import append_backward
+
+from op_test import OpTest
+
+
+class TestCVMOp(OpTest):
+    op_type = "cvm"
+
+    def setup(self):
+        rng = np.random.default_rng(0)
+        x = rng.uniform(0.1, 5.0, (6, 8)).astype("float32")
+        cvm = x[:, :2].copy()
+        self.inputs = {"X": x, "CVM": cvm}
+        self.attrs = {"use_cvm": True}
+        y = x.copy()
+        y[:, 0] = np.log(x[:, 0] + 1)
+        y[:, 1] = np.log(x[:, 1] + 1) - y[:, 0]
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestCVMOpNoUse(OpTest):
+    op_type = "cvm"
+
+    def setup(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(0.1, 5.0, (5, 7)).astype("float32")
+        self.inputs = {"X": x, "CVM": x[:, :2].copy()}
+        self.attrs = {"use_cvm": False}
+        self.outputs = {"Y": x[:, 2:].copy()}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+def test_cvm_grad_matches_reference():
+    """CvmGradComputeKernel (cvm_op.h:43): dX[:, :2] = CVM (not the log vjp),
+    dX[:, 2:] = dY[:, 2:]."""
+    rng = np.random.default_rng(2)
+    x_np = rng.uniform(0.5, 3.0, (4, 6)).astype("float32")
+    cvm_np = rng.uniform(0.1, 1.0, (4, 2)).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 6], dtype="float32",
+                              append_batch_size=False)
+        x.stop_gradient = False
+        c = fluid.layers.data(name="c", shape=[4, 2], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.continuous_value_model(x, c, use_cvm=True)
+        loss = fluid.layers.reduce_sum(y)
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (dx,) = exe.run(main, feed={"x": x_np, "c": cvm_np},
+                    fetch_list=[x.name + "@GRAD"])
+    np.testing.assert_allclose(dx[:, :2], cvm_np, atol=1e-6)
+    np.testing.assert_allclose(dx[:, 2:], np.ones_like(dx[:, 2:]), atol=1e-6)
+
+
+class TestNCEOp(OpTest):
+    """Deterministic via custom_neg_classes (reference nce_op.h PrepareSamples
+    uses them verbatim instead of sampling)."""
+    op_type = "nce"
+
+    def setup(self):
+        rng = np.random.default_rng(3)
+        B, d, K = 5, 8, 20
+        num_true = 1
+        x = rng.standard_normal((B, d)).astype("float32") * 0.3
+        w = rng.standard_normal((K, d)).astype("float32") * 0.3
+        b = rng.standard_normal((K, 1)).astype("float32") * 0.1
+        label = rng.integers(0, K, (B, num_true)).astype("int64")
+        neg = [1, 4, 7]
+        self.inputs = {"Input": x, "Weight": w, "Bias": b, "Label": label}
+        self.attrs = {"num_total_classes": K, "num_neg_samples": len(neg),
+                      "sampler": 0, "seed": 0, "custom_neg_classes": neg}
+        samples = np.concatenate(
+            [label, np.tile(np.asarray(neg, "int64")[None, :], (B, 1))], 1)
+        logits = np.einsum("bd,bsd->bs", x, w[samples]) + \
+            b.reshape(-1)[samples]
+        o = 1.0 / (1.0 + np.exp(-logits))
+        bn = (1.0 / K) * len(neg)
+        cost = np.where(np.arange(samples.shape[1])[None, :] < num_true,
+                        -np.log(o / (o + bn) + 1e-20),
+                        -np.log(bn / (o + bn) + 1e-20))
+        self.outputs = {"Cost": cost.sum(1, keepdims=True).astype("float32"),
+                        "SampleLogits": o.astype("float32"),
+                        "SampleLabels": samples}
+        self._check_slots = ["Cost", "SampleLogits"]
+
+    def test_output(self):
+        self.setup()
+        # SampleLabels is int64 metadata; compare the float outputs
+        self.outputs = {k: v for k, v in self.outputs.items()
+                        if k in self._check_slots}
+        self.check_output(atol=2e-5, rtol=2e-5)
+
+    def test_grad(self):
+        # f32 finite differences on sigmoid/log cost: grads for rarely-hit
+        # classes are ~1e-3, where FD noise dominates — compare loosely
+        self.check_grad(["Input", "Weight", "Bias"], "Cost",
+                        max_relative_error=0.08, eps=2e-3)
+
+
+class TestSampleLogitsOp(OpTest):
+    """Deterministic via use_customized_samples (reference allows feeding
+    Samples/Probabilities directly)."""
+    op_type = "sample_logits"
+
+    def setup(self):
+        rng = np.random.default_rng(4)
+        B, K, nt, S = 4, 12, 1, 3
+        logits = rng.standard_normal((B, K)).astype("float32")
+        labels = rng.integers(0, K, (B, nt)).astype("int64")
+        csamples = np.concatenate(
+            [labels,
+             np.tile(np.asarray([[2, 5, 9]], "int64"), (B, 1))], axis=1)
+        cprobs = np.full((B, nt + S), 0.25, "float32")
+        self.inputs = {"Logits": logits, "Labels": labels,
+                       "CustomizedSamples": csamples,
+                       "CustomizedProbabilities": cprobs}
+        self.attrs = {"num_samples": S, "use_customized_samples": True,
+                      "remove_accidental_hits": False, "seed": 0}
+        sampled = np.take_along_axis(logits, csamples, axis=1) - np.log(cprobs)
+        self.outputs = {
+            "Samples": csamples, "Probabilities": cprobs,
+            "SampledLogits": sampled.astype("float32"),
+            "SampledLabels": np.tile(np.arange(nt, dtype="int64"), (B, 1)),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.setup()
+        self.outputs = {"SampledLogits": self.outputs["SampledLogits"]}
+        self.check_grad(["Logits"], "SampledLogits", max_relative_error=0.02)
+
+
+class TestDataNormOp(OpTest):
+    op_type = "data_norm"
+
+    def setup(self):
+        rng = np.random.default_rng(5)
+        N, C = 6, 5
+        x = rng.standard_normal((N, C)).astype("float32")
+        bsize = np.full((C,), 100.0, "float32")
+        bsum = rng.standard_normal((C,)).astype("float32") * 10
+        bsquare = np.full((C,), 200.0, "float32")
+        self.inputs = {"X": x, "BatchSize": bsize, "BatchSum": bsum,
+                       "BatchSquareSum": bsquare}
+        self.attrs = {"epsilon": 1e-5, "slot_dim": -1}
+        means = bsum / bsize
+        scales = np.sqrt(bsize / bsquare)
+        self.outputs = {"Y": ((x - means) * scales).astype("float32"),
+                        "Means": means, "Scales": scales}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+def test_data_norm_grad_stats():
+    """data_norm_op.cc:498 — the stat grads carry batch deltas: dBatchSize=N,
+    dBatchSum=col-sums, dBatchSquareSum=sum((x-mean)^2)+N; dX=dY*scale."""
+    rng = np.random.default_rng(6)
+    N, C = 5, 3
+    x_np = rng.standard_normal((N, C)).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[N, C], dtype="float32",
+                              append_batch_size=False)
+        x.stop_gradient = False
+        y = fluid.layers.data_norm(x, name="dn")
+        loss = fluid.layers.reduce_sum(y)
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fetches = ["dn.batch_size@GRAD", "dn.batch_sum@GRAD",
+               "dn.batch_square_sum@GRAD", x.name + "@GRAD"]
+    dsize, dsum, dsquare, dx = exe.run(main, feed={"x": x_np},
+                                       fetch_list=fetches)
+    np.testing.assert_allclose(dsize, np.full((C,), float(N)), atol=1e-5)
+    np.testing.assert_allclose(dsum, x_np.sum(0), atol=1e-4)
+    mean = np.zeros((C,), "float32")  # BatchSum init 0 / BatchSize 1e4
+    np.testing.assert_allclose(
+        dsquare, ((x_np - mean) ** 2).sum(0) + N, rtol=1e-5)
+    scales = np.sqrt(np.full((C,), 1e4, "float32") / 1e4)
+    np.testing.assert_allclose(dx, np.ones_like(x_np) * scales, atol=1e-5)
+
+
+def test_shuffle_batch_roundtrip():
+    """Out is a row permutation of X recorded in ShuffleIdx, and the grad
+    routes dOut back through the inverse permutation."""
+    rng = np.random.default_rng(7)
+    x_np = rng.standard_normal((8, 3)).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8, 3], dtype="float32",
+                              append_batch_size=False)
+        x.stop_gradient = False
+        out = fluid.layers.shuffle_batch(x)
+        # weight rows by index so the grad is row-identifying
+        w = fluid.layers.data(name="w", shape=[8, 3], dtype="float32",
+                              append_batch_size=False)
+        loss = fluid.layers.reduce_sum(out * w)
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w_np = np.arange(24, dtype="float32").reshape(8, 3)
+    out_v, idx_v, dx = exe.run(
+        main, feed={"x": x_np, "w": w_np},
+        fetch_list=[out.name, out.name.replace("tmp", "tmp"), x.name + "@GRAD"],
+        fetch_all=False) if False else exe.run(
+        main, feed={"x": x_np, "w": w_np},
+        fetch_list=[out.name,
+                    main.global_block().ops[0].outputs["ShuffleIdx"][0],
+                    x.name + "@GRAD"])
+    idx_v = idx_v.astype(int)
+    np.testing.assert_allclose(out_v, x_np[idx_v], atol=1e-6)
+    # dL/dX[idx[i]] = w[i]
+    expect = np.zeros_like(x_np)
+    expect[idx_v] = w_np
+    np.testing.assert_allclose(dx, expect, atol=1e-6)
+    # the permutation must actually shuffle (overwhelmingly likely for n=8)
+    assert not np.array_equal(idx_v, np.arange(8))
+
+
+class TestSequenceEnumerate(OpTest):
+    op_type = "sequence_enumerate"
+
+    def setup(self):
+        x = np.array([[1, 2, 3, 4, 0], [5, 6, 0, 0, 0]], dtype="int64")
+        ln = np.array([4, 2], dtype="int64")
+        self.inputs = {"X": x, "Length": ln}
+        self.attrs = {"win_size": 2, "pad_value": 0}
+        out = np.zeros((2, 5, 2), dtype="int64")
+        out[0] = [[1, 2], [2, 3], [3, 4], [4, 0], [0, 0]]
+        out[1] = [[5, 6], [6, 0], [0, 0], [0, 0], [0, 0]]
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceErase(OpTest):
+    op_type = "sequence_erase"
+
+    def setup(self):
+        x = np.array([[2, 2, 6, 1, 3, 9, 6, 1, 0, 0],
+                      [1, 9, 6, 1, 0, 0, 0, 0, 0, 0]], dtype="int64")
+        ln = np.array([8, 4], dtype="int64")
+        self.inputs = {"X": x, "Length": ln}
+        self.attrs = {"tokens": [2, 3, 5]}
+        out = np.zeros_like(x)
+        out[0, :5] = [6, 1, 9, 6, 1]
+        out[1, :4] = [1, 9, 6, 1]
+        self.outputs = {"Out": out,
+                        "Length": np.array([5, 4], dtype="int64")}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_nce_random_sampler_trains():
+    """nce with the real (log-uniform) sampler: loss decreases under SGD and
+    the sampled labels include the true label in column 0."""
+    rng = np.random.default_rng(8)
+    B, d, K = 16, 12, 50
+    x_np = rng.standard_normal((B, d)).astype("float32")
+    y_np = rng.integers(0, K, (B, 1)).astype("int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[B, d], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.data(name="y", shape=[B, 1], dtype="int64",
+                              append_batch_size=False)
+        cost = fluid.layers.nce(x, y, K, num_neg_samples=5,
+                                sampler="log_uniform", name="nce")
+        loss = fluid.layers.mean(cost)
+        sgd = fluid.optimizer.SGD(learning_rate=0.5)
+        sgd.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed={"x": x_np, "y": y_np},
+                        fetch_list=[loss.name])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.9, losses
