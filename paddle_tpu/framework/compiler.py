@@ -59,6 +59,23 @@ class ExecutionStrategy:
     use_thread_barrier: bool = False
 
 
+def rewrite_sync_batch_norm(program):
+    """reference compiler.py:367: sync_batch_norm rewrites every BN op in the
+    multi-device graph to the cross-rank variant (both directions: the grad
+    op's vjp replay must re-trace the sync forward so the collective
+    transposes appear in the backward). Note the gspmd engine needs no
+    rewrite — a batch-sharded jnp.mean is already a global reduction — this
+    is for shard_map (per-rank) programs, where plain BN sees local stats."""
+    for op in program.global_block().ops:
+        if op.type == "batch_norm":
+            op.type = "sync_batch_norm"
+        elif op.type == "batch_norm_grad":
+            op.type = "sync_batch_norm_grad"
+            fwd = op.attrs.get("__fwd__")
+            if fwd:
+                fwd["type"] = "sync_batch_norm"
+
+
 class CompiledProgram:
     """Wraps a Program with execution annotations. `with_data_parallel`
     switches the Executor into mesh (pjit) mode over all local devices."""
@@ -89,6 +106,9 @@ class CompiledProgram:
         self._data_parallel_axis = "dp"
         self._mesh_axes = {0: "dp"}
         self.program._annotations["data_parallel"] = True
+        if self.build_strategy.sync_batch_norm and \
+                hasattr(self.program, "global_block"):
+            rewrite_sync_batch_norm(self.program)
         return self
 
     @property

@@ -143,7 +143,8 @@ def plan_for_program(program: Program, compiled=None) -> Optional[MeshPlan]:
         ring_axes = dict(compiled._mesh_axes)
         has_collectives = any(
             op.type.startswith("c_")
-            or op.type in ("allreduce", "broadcast", "dgc_momentum")
+            or op.type in ("allreduce", "broadcast", "dgc_momentum",
+                           "sync_batch_norm", "sync_batch_norm_grad")
             for op in program.global_block().ops
         )
         mode = "shard_map" if has_collectives else "gspmd"

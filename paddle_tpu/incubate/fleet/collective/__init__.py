@@ -193,6 +193,10 @@ class CollectiveOptimizer(DistributedOptimizer):
                 "mode": "shard_map", "axes": [("dp", -1)], "data_axis": "dp",
                 "ring_axes": {0: "dp"},
             }
+            if strategy.sync_batch_norm:
+                from ....framework.compiler import rewrite_sync_batch_norm
+
+                rewrite_sync_batch_norm(program)
         elif strategy.mode == "local_sgd" or strategy.use_local_sgd:
             from ....transpiler.collective import LocalSGD
 

@@ -330,11 +330,11 @@ def pool2d(ctx, op, ins):
 # ---------------------------------------------------------------------------
 
 
-@register_op("batch_norm", diff_inputs=("X", "Scale", "Bias"))
-def batch_norm(ctx, op, ins):
-    """reference operators/batch_norm_op.cc (+cudnn). NCHW or NC...; in
-    training mode also emits updated moving stats (MeanOut/VarianceOut alias
-    the persistable Mean/Variance vars, in-place by name in the env)."""
+def _batch_norm_impl(ctx, op, ins, sync_axis=None):
+    """Shared batch_norm / sync_batch_norm lowering. With ``sync_axis`` the
+    batch statistics are the GLOBAL mean/var over every rank of that mesh
+    axis (one psum of [sum, sqsum] — reference sync_batch_norm_op.cc reduces
+    the same pair over NCCL)."""
     x = ins["X"][0]
     scale, bias = ins["Scale"][0], ins["Bias"][0]
     mean_in, var_in = ins["Mean"][0], ins["Variance"][0]
@@ -356,8 +356,16 @@ def batch_norm(ctx, op, ins):
         mean_out, var_out = mean_in, var_in
     else:
         xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=axes)
-        var = jnp.var(xf, axis=axes)
+        if sync_axis is not None:
+            cnt = float(np.prod([x.shape[a] for a in axes]))
+            s = jax.lax.psum(jnp.sum(xf, axis=axes), sync_axis)
+            sq = jax.lax.psum(jnp.sum(jnp.square(xf), axis=axes), sync_axis)
+            n = cnt * jax.lax.psum(jnp.ones((), jnp.float32), sync_axis)
+            mean = s / n
+            var = sq / n - jnp.square(mean)
+        else:
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
         mean_out = mean_in * momentum + mean * (1 - momentum)
         var_out = var_in * momentum + var * (1 - momentum)
         saved_mean, saved_var = mean, var
@@ -372,6 +380,25 @@ def batch_norm(ctx, op, ins):
         "SavedMean": saved_mean,
         "SavedVariance": inv,
     }
+
+
+@register_op("batch_norm", diff_inputs=("X", "Scale", "Bias"))
+def batch_norm(ctx, op, ins):
+    """reference operators/batch_norm_op.cc (+cudnn). NCHW or NC...; in
+    training mode also emits updated moving stats (MeanOut/VarianceOut alias
+    the persistable Mean/Variance vars, in-place by name in the env)."""
+    return _batch_norm_impl(ctx, op, ins)
+
+
+@register_op("sync_batch_norm", diff_inputs=("X", "Scale", "Bias"))
+def sync_batch_norm(ctx, op, ins):
+    """reference operators/sync_batch_norm_op.cc: batch_norm whose batch
+    statistics (and, through the vjp's collective transposes, the grads) are
+    reduced over the data-parallel mesh axis — small per-device batches
+    normalize exactly like the merged global batch. Falls back to local
+    stats when no dp mesh is active (single-device execution)."""
+    axis = ctx.axis_name(op.attr("ring_id", 0))
+    return _batch_norm_impl(ctx, op, ins, sync_axis=axis)
 
 
 @register_op("layer_norm", diff_inputs=("X", "Scale", "Bias"))

@@ -1,0 +1,123 @@
+"""sync_batch_norm (reference operators/sync_batch_norm_op.cc): BN whose
+batch statistics reduce over the data-parallel ranks.
+
+The op matters on the shard_map (per-rank, explicit-collective) engine —
+fleet collective_ops mode — where plain batch_norm sees only its 4-element
+shard. The gspmd engine needs no sync variant by construction (a
+batch-sharded jnp.mean is already a global reduction). Parity oracle: dp=8
+collective_ops + sync BN == single-device global batch, step for step; plain
+BN in the same mode must NOT match (that divergence is the op's reason to
+exist)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.incubate.fleet.collective import (CollectiveOptimizer,
+                                                  DistributedStrategy)
+
+
+def _build(seed=77):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 12)
+        # NCHW on a 2-D tensor: channel axis 1 — BN over the batch axis
+        h = fluid.layers.batch_norm(h, momentum=0.8)
+        h = fluid.layers.relu(h)
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def _batches(n, batch=32):
+    rng = np.random.RandomState(3)
+    for _ in range(n):
+        x = rng.randn(batch, 6).astype("float32")
+        # heterogeneous scale across the batch so shard-local statistics
+        # genuinely differ from the global ones
+        x[: batch // 2] *= 3.0
+        y = (0.1 * x.sum(1, keepdims=True)).astype("float32")
+        yield x, y
+
+
+def _run(mode, sync=False, n=6):
+    """mode: 'single' | 'collective_ops'."""
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup):
+        if mode == "single":
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        else:
+            strategy = DistributedStrategy()
+            strategy.mode = "collective_ops"
+            strategy.sync_batch_norm = sync
+            CollectiveOptimizer(fluid.optimizer.SGD(0.01),
+                                strategy).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    losses = []
+    for x, y in _batches(n):
+        (l,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss],
+                       scope=scope)
+        losses.append(float(np.asarray(l).mean()))
+    return losses
+
+
+def test_sync_bn_rewrite_applied():
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup):
+        strategy = DistributedStrategy()
+        strategy.mode = "collective_ops"
+        strategy.sync_batch_norm = True
+        CollectiveOptimizer(fluid.optimizer.SGD(0.01),
+                            strategy).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "sync_batch_norm" in types and "batch_norm" not in types
+    assert "sync_batch_norm_grad" in types and "batch_norm_grad" not in types
+
+
+def test_sync_bn_matches_global_batch():
+    import jax
+
+    assert jax.device_count() >= 8
+    single = _run("single")
+    synced = _run("collective_ops", sync=True)
+    np.testing.assert_allclose(single, synced, rtol=5e-4, atol=5e-5)
+
+
+def test_plain_bn_dp_diverges():
+    """Per-rank statistics on 4-element shards are NOT the global batch
+    statistics; without sync BN the collective_ops loss curve drifts.
+    Guards against sync_batch_norm silently lowering to plain batch_norm."""
+    single = _run("single")
+    plain = _run("collective_ops", sync=False)
+    assert not np.allclose(single, plain, rtol=1e-3), (single, plain)
+
+
+def test_sync_bn_single_device_fallback():
+    """Outside any mesh, sync_batch_norm degrades to local statistics (the
+    reference CPU kernel does the same — no comm context, no reduce)."""
+    from paddle_tpu.framework.compiler import rewrite_sync_batch_norm
+
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    rewrite_sync_batch_norm(main)
+    main2, startup2, loss2 = _build()
+    with fluid.program_guard(main2, startup2):
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss2)
+    # fresh Executor per program: the startup rng stream folds in the
+    # executor's step counter, so sharing one would skew the second init
+    exe1 = fluid.Executor(fluid.CPUPlace())
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    exe1.run(startup, scope=s1)
+    exe2.run(startup2, scope=s2)
+    for x, y in _batches(4):
+        (a,) = exe1.run(main, feed={"x": x, "y": y}, fetch_list=[loss],
+                        scope=s1)
+        (b,) = exe2.run(main2, feed={"x": x, "y": y}, fetch_list=[loss2],
+                        scope=s2)
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
