@@ -6,7 +6,10 @@ from ..framework.layer_helper import LayerHelper
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "roi_align",
            "multiclass_nms", "anchor_generator", "density_prior_box",
-           "roi_pool", "iou_similarity", "box_clip", "sigmoid_focal_loss"]
+           "roi_pool", "iou_similarity", "box_clip", "sigmoid_focal_loss",
+           "yolov3_loss", "bipartite_match", "target_assign",
+           "rpn_target_assign", "generate_proposals",
+           "distribute_fpn_proposals", "collect_fpn_proposals"]
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
@@ -182,3 +185,185 @@ def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
                      outputs={"Out": [out.name]},
                      attrs={"gamma": float(gamma), "alpha": float(alpha)})
     return out
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """fluid.layers.yolov3_loss (detection.py:1001) over
+    operators/detection/yolov3_loss_op.cc."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    obj_mask = helper.create_variable_for_type_inference(
+        x.dtype, stop_gradient=True)
+    match_mask = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    ins = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score]
+    helper.append_op(
+        type="yolov3_loss", inputs=ins,
+        outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                 "GTMatchMask": [match_mask]},
+        attrs={"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+               "class_num": int(class_num),
+               "ignore_thresh": float(ignore_thresh),
+               "downsample_ratio": int(downsample_ratio),
+               "use_label_smooth": bool(use_label_smooth),
+               "scale_x_y": float(scale_x_y)})
+    return loss
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    match_indices = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    match_dist = helper.create_variable_for_type_inference(
+        dist_matrix.dtype, stop_gradient=True)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [match_indices],
+                 "ColToRowMatchDist": [match_dist]},
+        attrs={"match_type": match_type or "bipartite",
+               "dist_threshold": float(dist_threshold
+                                       if dist_threshold is not None else 0.5)})
+    return match_indices, match_dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    ins = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
+    helper.append_op(
+        type="target_assign", inputs=ins,
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": int(mismatch_value or 0)})
+    return out, out_weight
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """fluid.layers.rpn_target_assign (detection.py:308). Static-shape
+    variant: index outputs are padded with -1 (the LoD replacement); the
+    predicted score/loc gathers mask padded slots to zero so downstream
+    losses see exact zeros there."""
+    helper = LayerHelper("rpn_target_assign")
+    loc_index = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    score_index = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    target_label = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    target_bbox = helper.create_variable_for_type_inference(
+        bbox_pred.dtype, stop_gradient=True)
+    bbox_inside_weight = helper.create_variable_for_type_inference(
+        bbox_pred.dtype, stop_gradient=True)
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes], "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs=ins,
+        outputs={"LocIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label], "TargetBBox": [target_bbox],
+                 "BBoxInsideWeight": [bbox_inside_weight]},
+        attrs={"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+               "rpn_straddle_thresh": float(rpn_straddle_thresh),
+               "rpn_fg_fraction": float(rpn_fg_fraction),
+               "rpn_positive_overlap": float(rpn_positive_overlap),
+               "rpn_negative_overlap": float(rpn_negative_overlap),
+               "use_random": bool(use_random)})
+    predicted_scores = _masked_batch_gather(helper, cls_logits, score_index)
+    predicted_location = _masked_batch_gather(helper, bbox_pred, loc_index)
+    return (predicted_scores, predicted_location, target_label, target_bbox,
+            bbox_inside_weight)
+
+
+def _masked_batch_gather(helper, x, index):
+    """gather x[b, index[b]] with -1 indices producing zero rows (device-side
+    glue for the static rpn_target_assign outputs)."""
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="masked_batch_gather",
+                     inputs={"X": [x], "Index": [index]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    helper = LayerHelper("generate_proposals", name=name)
+    rpn_rois = helper.create_variable_for_type_inference(bbox_deltas.dtype)
+    rpn_roi_probs = helper.create_variable_for_type_inference(scores.dtype)
+    rois_num = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rpn_rois], "RpnRoiProbs": [rpn_roi_probs],
+                 "RpnRoisNum": [rois_num]},
+        attrs={"pre_nms_topN": int(pre_nms_top_n),
+               "post_nms_topN": int(post_nms_top_n),
+               "nms_thresh": float(nms_thresh), "min_size": float(min_size),
+               "eta": float(eta)})
+    if return_rois_num:
+        return rpn_rois, rpn_roi_probs, rois_num
+    return rpn_rois, rpn_roi_probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_level = max_level - min_level + 1
+    multi_rois = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+                  for _ in range(n_level)]
+    level_nums = [helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True) for _ in range(n_level)]
+    restore_ind = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    ins = {"FpnRois": [fpn_rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="distribute_fpn_proposals", inputs=ins,
+        outputs={"MultiFpnRois": multi_rois,
+                 "MultiLevelRoIsNum": level_nums,
+                 "RestoreIndex": [restore_ind]},
+        attrs={"min_level": int(min_level), "max_level": int(max_level),
+               "refer_level": int(refer_level),
+               "refer_scale": int(refer_scale)})
+    if rois_num is not None:
+        return multi_rois, restore_ind, level_nums
+    return multi_rois, restore_ind
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    fpn_rois = helper.create_variable_for_type_inference(multi_rois[0].dtype)
+    rois_num = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    num_level = max_level - min_level + 1
+    ins = {"MultiLevelRois": list(multi_rois[:num_level]),
+           "MultiLevelScores": list(multi_scores[:num_level])}
+    if rois_num_per_level is not None:
+        ins["MultiLevelRoIsNum"] = list(rois_num_per_level[:num_level])
+    helper.append_op(
+        type="collect_fpn_proposals", inputs=ins,
+        outputs={"FpnRois": [fpn_rois], "RoisNum": [rois_num]},
+        attrs={"post_nms_topN": int(post_nms_top_n)})
+    if rois_num_per_level is not None:
+        return fpn_rois, rois_num
+    return fpn_rois

