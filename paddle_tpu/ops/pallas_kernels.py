@@ -338,11 +338,15 @@ def _flash(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    # lse is lane-replicated (bh, t, 128): save ONE lane as the residual —
+    # the full tensor is ~hd/1 x larger than o itself in f32 and would
+    # dominate live activation memory in no-remat training.
+    return o, (q, k, v, o, lse[..., :1])
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
+    lse = jnp.broadcast_to(lse, lse.shape[:-1] + (NUM_LANES,))
     dq, dk, dv = _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
     return dq, dk, dv
 

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""MFU sweep harness for the flagship GPT bench (tools/, not part of bench.py).
+
+Runs one training-throughput measurement per config in an isolated subprocess
+(OOM/compile failures can't poison the next config) and prints a ranked table.
+Used to pick the bench.py defaults; keep bench.py's MFU formula as the single
+source of truth (this file reuses it by construction: 6N + attention term over
+peak bf16 FLOP/s).
+
+Usage:
+  python tools/mfu_sweep.py                 # run the standard sweep
+  python tools/mfu_sweep.py --one b=32,remat=dots,bq=512,bk=512
+"""
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def worker():
+    sys.path.insert(0, REPO)
+    import numpy as np
+    import jax
+
+    spec = dict(kv.split("=") for kv in sys.argv[2].split(","))
+    batch = int(spec.get("b", 16))
+    steps = int(spec.get("steps", 10))
+    remat = spec.get("remat", "full")          # full | dots | none
+    bq = int(spec.get("bq", 512))
+    bk = int(spec.get("bk", 512))
+    heads = int(spec.get("nh", 0))             # 0 = config default
+    d_model = int(spec.get("d", 768))
+    layers = int(spec.get("L", 12))
+    d_ff = int(spec.get("ff", 4 * d_model))
+    T = int(spec.get("T", 1024))
+    flash = spec.get("flash", "1") == "1"
+
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel import parallelize as PZ
+    from paddle_tpu.ops import pallas_kernels as PK
+
+    # route the sweep's block sizes through the default entry point
+    if bq != 512 or bk != 512:
+        orig = PK.flash_attention
+        def patched(q, k, v, causal=True, sm_scale=None, block_q=512,
+                    block_k=512):
+            return orig(q, k, v, causal=causal, sm_scale=sm_scale,
+                        block_q=bq, block_k=bk)
+        PK.flash_attention = patched
+
+    kw = dict(max_seq_len=T, use_flash=flash, d_model=d_model,
+              num_layers=layers, d_ff=d_ff,
+              remat=(remat != "none"),
+              remat_policy=("dots" if remat == "dots" else "full"))
+    if heads:
+        kw["num_heads"] = heads
+    cfg = G.GPT_SMALL.scaled(**kw)
+
+    dev = jax.devices()[0]
+    pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg, devices=[dev])
+    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
+    tc = time.perf_counter()
+    params, opt, loss, _ = step(params, opt, tokens, labels)
+    float(loss)
+    compile_s = time.perf_counter() - tc
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss, _ = step(params, opt, tokens, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tokens_per_s = steps * batch * T / dt
+
+    n_params = G.num_params(params)
+    attn = 12 * cfg.num_layers * cfg.d_model * T
+    peak = {"v5": 394e12, "v6": 918e12, "v4": 275e12}.get(
+        getattr(dev, "device_kind", "")[:2].lower(), 394e12)
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    if "v5p" in kind:
+        peak = 459e12
+    mfu = tokens_per_s * (6 * n_params + attn) / peak
+    print(json.dumps({"spec": sys.argv[2], "tokens_per_s": round(tokens_per_s, 1),
+                      "mfu": round(mfu, 4), "ms_per_step": round(dt / steps * 1e3, 1),
+                      "compile_s": round(compile_s, 1),
+                      "params": int(n_params)}), flush=True)
+
+
+def run_one(spec, timeout=420):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", spec]
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                           cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"spec": spec, "error": "timeout"}
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-4:]
+        return {"spec": spec, "error": f"rc={p.returncode}", "tail": tail}
+    for line in reversed(p.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"spec": spec, "error": "no json"}
+
+
+def main():
+    if "--worker" in sys.argv:
+        worker()
+        return
+    if "--one" in sys.argv:
+        specs = [sys.argv[sys.argv.index("--one") + 1]]
+    else:
+        specs = [
+            "b=32,remat=full",
+            "b=16,remat=none",
+            "b=16,remat=full,flash=0",    # XLA attention vs Pallas flash
+            "b=16,remat=full,nh=6",       # head_dim 128 (MXU-native lanes)
+            "b=16,remat=full,bq=1024,bk=1024",
+            "b=16,remat=full,bq=256,bk=256",
+            "b=32,remat=full,nh=6,flash=0",
+        ]
+    results = []
+    for s in specs:
+        print(f"[sweep] {s} ...", file=sys.stderr, flush=True)
+        r = run_one(s)
+        print(f"[sweep]   -> {r}", file=sys.stderr, flush=True)
+        results.append(r)
+    ok = [r for r in results if "mfu" in r]
+    ok.sort(key=lambda r: -r["mfu"])
+    for r in ok:
+        print(f"{r['mfu']:.4f}  {r['tokens_per_s']:>10.0f} tok/s  "
+              f"{r['ms_per_step']:>6.1f} ms  {r['spec']}")
+    for r in results:
+        if "mfu" not in r:
+            print(f"FAILED  {r}")
+
+
+if __name__ == "__main__":
+    main()
