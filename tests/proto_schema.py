@@ -1,0 +1,165 @@
+"""Minimal proto2 schema-text parser -> google.protobuf dynamic messages.
+
+Exists so the wire-compat check in test_paddle_pb.py validates
+paddle_tpu/framework/paddle_pb.py against the REFERENCE'S OWN schema file
+(/root/reference/paddle/fluid/framework/framework.proto — schema data, not
+code) rather than a hand transcription that could repeat the same typo on
+both sides. Covers the proto2 subset that file uses: package, message
+(nested), enum, optional/required/repeated scalar+composite fields,
+[default = ...], reserved.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+
+_SCALARS = {
+    "double": "TYPE_DOUBLE", "float": "TYPE_FLOAT", "int64": "TYPE_INT64",
+    "uint64": "TYPE_UINT64", "int32": "TYPE_INT32", "uint32": "TYPE_UINT32",
+    "bool": "TYPE_BOOL", "string": "TYPE_STRING", "bytes": "TYPE_BYTES",
+    "sint32": "TYPE_SINT32", "sint64": "TYPE_SINT64",
+    "fixed32": "TYPE_FIXED32", "fixed64": "TYPE_FIXED64",
+}
+_LABELS = {"optional": "LABEL_OPTIONAL", "required": "LABEL_REQUIRED",
+           "repeated": "LABEL_REPEATED"}
+
+
+def _tokenize(text: str) -> List[str]:
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    text = re.sub(r"//[^\n]*", " ", text)
+    return re.findall(r'"[^"]*"|[A-Za-z_][\w.]*|-?\d+|[{}=;\[\],]', text)
+
+
+class _Tok:
+    def __init__(self, toks):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, t):
+        got = self.next()
+        if got != t:
+            raise ValueError(f"expected {t!r}, got {got!r} at {self.i}")
+
+
+def parse_proto_file(path: str, pool_name: str = "parsed.proto"):
+    """Parse a proto2 file into a FileDescriptorProto."""
+    from google.protobuf import descriptor_pb2
+
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = pool_name
+    fdp.syntax = "proto2"
+    tk = _Tok(_tokenize(open(path).read()))
+
+    def parse_field(container, label_tok):
+        F = descriptor_pb2.FieldDescriptorProto
+        ftype = tk.next()
+        fname = tk.next()
+        tk.expect("=")
+        fnum = int(tk.next())
+        f = container.field.add()
+        f.name, f.number = fname, fnum
+        f.label = getattr(F, _LABELS[label_tok])
+        if ftype in _SCALARS:
+            f.type = getattr(F, _SCALARS[ftype])
+        else:
+            f.type_name = ftype  # resolved relative to scope by the pool
+        if tk.peek() == "[":
+            tk.next()
+            while tk.peek() != "]":
+                t = tk.next()
+                if t == "default":
+                    tk.expect("=")
+                    v = tk.next()
+                    f.default_value = v.strip('"')
+            tk.expect("]")
+        tk.expect(";")
+
+    def parse_enum(container):
+        name = tk.next()
+        e = container.enum_type.add()
+        e.name = name
+        tk.expect("{")
+        while tk.peek() != "}":
+            vname = tk.next()
+            tk.expect("=")
+            vnum = int(tk.next())
+            tk.expect(";")
+            v = e.value.add()
+            v.name, v.number = vname, vnum
+        tk.expect("}")
+        if tk.peek() == ";":
+            tk.next()
+
+    def parse_message(fdp_container):
+        m = fdp_container.message_type.add()
+        m.name = tk.next()
+        _parse_message_body(m)
+
+    def parse_message_into(parent):
+        m = parent.nested_type.add()
+        m.name = tk.next()
+        _parse_message_body(m)
+
+    def _parse_message_body(m):
+        name = m.name
+        tk.expect("{")
+        while tk.peek() != "}":
+            t = tk.next()
+            if t == "message":
+                parse_message_into(m)
+            elif t == "enum":
+                parse_enum(m)
+            elif t in _LABELS:
+                parse_field(m, t)
+            elif t == "reserved":
+                while tk.peek() != ";":
+                    tk.next()
+                tk.next()
+            else:
+                raise ValueError(f"unexpected token in message {name}: {t!r}")
+        tk.expect("}")
+        if tk.peek() == ";":
+            tk.next()
+
+    while tk.peek() is not None:
+        t = tk.next()
+        if t == "syntax":
+            tk.expect("=")
+            tk.next()
+            tk.expect(";")
+        elif t == "package":
+            fdp.package = tk.next()
+            tk.expect(";")
+        elif t == "message":
+            parse_message(fdp)
+        elif t == "enum":
+            parse_enum(fdp)
+        elif t == ";":
+            continue
+        else:
+            raise ValueError(f"unexpected top-level token {t!r}")
+    return fdp
+
+
+def load_messages(path: str, pool_suffix: str = "") -> Dict[str, type]:
+    """Parse ``path`` and return {message_name: generated message class}
+    for every top-level message."""
+    from google.protobuf import descriptor_pool, message_factory
+
+    fdp = parse_proto_file(path, pool_name=f"parsed{pool_suffix}.proto")
+    pool = descriptor_pool.DescriptorPool()
+    fd = pool.Add(fdp)
+    out = {}
+    for name in fd.message_types_by_name:
+        desc = fd.message_types_by_name[name]
+        out[name] = message_factory.GetMessageClass(desc)
+    return out
