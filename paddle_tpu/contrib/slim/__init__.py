@@ -1,1 +1,2 @@
 from . import quantization  # noqa: F401
+from . import prune  # noqa: F401

