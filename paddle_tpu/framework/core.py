@@ -74,11 +74,27 @@ def convert_dtype(dtype) -> str:
     return name
 
 
+_X64_NARROW = {"int64": "int32", "uint64": "uint32", "float64": "float32"}
+
+
 def dtype_to_jax(dtype) -> jnp.dtype:
+    """Compute dtype for a declared var dtype. Serialization keeps the
+    declared width (VarType in the protobuf desc); compute canonicalizes
+    64-bit types to what jax actually runs without x64 — silently, instead
+    of per-op truncation warnings on every int64 astype."""
     s = convert_dtype(dtype)
     if s == "bfloat16":
         return jnp.bfloat16
+    import jax
+
+    if not jax.config.jax_enable_x64 and s in _X64_NARROW:
+        s = _X64_NARROW[s]
     return jnp.dtype(s)
+
+
+def int_index_dtype() -> jnp.dtype:
+    """The int64-declared index dtype as jax will actually carry it."""
+    return dtype_to_jax("int64")
 
 
 def dtype_is_floating(dtype) -> bool:

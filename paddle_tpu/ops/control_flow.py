@@ -188,7 +188,7 @@ def read_from_array(ctx, op, ins):
 
 @register_op("array_length", grad=None)
 def array_length(ctx, op, ins):
-    return {"Out": jnp.asarray([len(ins["X"][0])], dtype=jnp.int64)}
+    return {"Out": jnp.asarray([len(ins["X"][0])], dtype=_I64)}
 
 
 @register_op("tensor_array_to_tensor", grad=None)

@@ -14,8 +14,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..framework.core import dtype_to_jax
+from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
+
+_I64 = int_index_dtype()
 
 
 @register_op("sequence_mask", grad=None)
@@ -136,7 +138,7 @@ def sequence_pad(ctx, op, ins):
     t = jnp.arange(T)[None, :].reshape((1, T) + (1,) * (x.ndim - 2))
     valid = t < length.reshape((B,) + (1,) * (x.ndim - 1))
     out = jnp.where(valid, x, pad_value.astype(x.dtype))
-    return {"Out": out, "Length": length.astype(jnp.int64)}
+    return {"Out": out, "Length": length.astype(_I64)}
 
 
 @register_op("sequence_unpad", diff_inputs=("X",))

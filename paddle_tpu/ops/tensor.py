@@ -12,8 +12,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..framework.core import dtype_to_jax
+from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
+
+_I64 = int_index_dtype()
 
 
 def _infer_reshape(block, op):
@@ -298,7 +300,7 @@ def where_index(ctx, op, ins):
     # dynamic-shape op: returns indices of nonzero — static upper bound needed
     # on TPU; provided for CPU/host use (inference utilities).
     cond = ins["Condition"][0]
-    return {"Out": jnp.stack(jnp.nonzero(cond, size=int(np.prod(cond.shape))), axis=1).astype(jnp.int64)}
+    return {"Out": jnp.stack(jnp.nonzero(cond, size=int(np.prod(cond.shape))), axis=1).astype(_I64)}
 
 
 @register_op("cumsum", diff_inputs=("X",))
