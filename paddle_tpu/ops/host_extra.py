@@ -1,0 +1,320 @@
+"""Host ops, batch 2 — the reference ops whose semantics are inherently
+dynamic-shape or IO-bound and that the reference itself runs CPU-side:
+unique_with_counts, chunk_eval, auc, positive_negative_pair, print,
+save/load/save_combine/load_combine, merge_ids/split_ids, filter_by_instag.
+
+They execute between jitted device segments (executor host-op
+segmentation); tensors cross as numpy.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from ..framework.executor import register_host_op
+
+
+def _np(scope, name):
+    v = scope.find_var(name)
+    if v is None:
+        raise RuntimeError(f"host op: var {name!r} not in scope")
+    return np.asarray(v)
+
+
+def _set(scope, name, arr):
+    import jax.numpy as jnp
+
+    scope.set_var(name, jnp.asarray(arr))
+
+
+@register_host_op("unique_with_counts")
+def unique_with_counts(scope, op, exe):
+    """operators/unique_with_counts_op.cc (CPU-only in the reference):
+    Out = unique values in first-appearance order, Index maps X -> Out,
+    Count = occurrences."""
+    x = _np(scope, op.input("X")[0]).reshape(-1)
+    uniq, first_idx, inverse, counts = np.unique(
+        x, return_index=True, return_inverse=True, return_counts=True)
+    order = np.argsort(first_idx, kind="stable")
+    uniq = uniq[order]
+    counts = counts[order]
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    _set(scope, op.output("Out")[0], uniq)
+    _set(scope, op.output("Index")[0], remap[inverse].astype(np.int64))
+    _set(scope, op.output("Count")[0], counts.astype(np.int64))
+
+
+@register_host_op("print")
+def print_op(scope, op, exe):
+    """operators/print_op.cc: log tensor stats/values, pass through."""
+    name = op.input("In")[0]
+    x = _np(scope, name)
+    message = op.attr("message", "")
+    first_n = int(op.attr("first_n", -1))
+    state = op.attrs.setdefault("__print_count__", [0])
+    state[0] += 1
+    if first_n < 0 or state[0] <= first_n:
+        summarize = int(op.attr("summarize", 20))
+        flat = x.reshape(-1)[:summarize if summarize > 0 else None]
+        print(f"{message} Variable: {name}  shape: {list(x.shape)}  "
+              f"dtype: {x.dtype}  data: {flat}", file=sys.stderr)
+    outs = op.output("Out")
+    if outs:
+        _set(scope, outs[0], x)
+
+
+@register_host_op("save")
+def save_op(scope, op, exe):
+    """operators/save_op.cc: one var in the reference tensor stream."""
+    from ..framework import paddle_pb
+
+    path = op.attr("file_path")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arr = _np(scope, op.input("X")[0])
+    with open(path, "wb") as f:
+        f.write(paddle_pb.tensor_to_stream(arr))
+
+
+@register_host_op("load")
+def load_op(scope, op, exe):
+    """operators/load_op.cc."""
+    from ..framework import paddle_pb
+
+    data = open(op.attr("file_path"), "rb").read()
+    arr, _, _ = paddle_pb.tensor_from_stream(data)
+    _set(scope, op.output("Out")[0], arr)
+
+
+@register_host_op("save_combine")
+def save_combine_op(scope, op, exe):
+    """operators/save_combine_op.cc: concatenated tensor streams."""
+    from ..framework import paddle_pb
+
+    path = op.attr("file_path")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        for name in op.input("X"):
+            f.write(paddle_pb.tensor_to_stream(_np(scope, name)))
+
+
+@register_host_op("load_combine")
+def load_combine_op(scope, op, exe):
+    """operators/load_combine_op.cc."""
+    from ..framework import paddle_pb
+
+    data = open(op.attr("file_path"), "rb").read()
+    offset = 0
+    for name in op.output("Out"):
+        arr, _, offset = paddle_pb.tensor_from_stream(data, offset)
+        _set(scope, name, arr)
+
+
+@register_host_op("merge_ids")
+def merge_ids(scope, op, exe):
+    """operators/distributed_ops/merge_ids_op.cc: scatter per-shard rows
+    back into the original id order (the inverse of split_ids)."""
+    ids_names = op.input("Ids")
+    rows_names = op.input("X")
+    out_names = op.output("Out")
+    all_ids = [_np(scope, n).reshape(-1) for n in ids_names]
+    shard_rows = [_np(scope, n) for n in rows_names]
+    n_shard = len(shard_rows)
+    for ids, out_name in zip(all_ids, out_names):
+        dim = shard_rows[0].shape[-1]
+        out = np.zeros((len(ids), dim), shard_rows[0].dtype)
+        cursor = [0] * n_shard
+        # rows were produced shard-by-shard in id order
+        for i, idv in enumerate(ids):
+            s = int(idv) % n_shard
+            out[i] = shard_rows[s][cursor[s]]
+            cursor[s] += 1
+        _set(scope, out_name, out)
+
+
+@register_host_op("split_ids")
+def split_ids(scope, op, exe):
+    """operators/distributed_ops/split_ids_op.cc: route ids to shards by
+    id % n_shards (dedup preserved as in reference: first occurrence)."""
+    ids = np.concatenate([_np(scope, n).reshape(-1)
+                          for n in op.input("Ids")])
+    out_names = op.output("Out")
+    n = len(out_names)
+    for s, name in enumerate(out_names):
+        _set(scope, name, ids[ids % n == s].reshape(-1, 1))
+
+
+@register_host_op("filter_by_instag")
+def filter_by_instag(scope, op, exe):
+    """operators/filter_by_instag_op.cc: keep rows whose tag set intersects
+    the filter tags. Padded form: Ins [N, D], Ins_tag [N, T] (0 = pad)."""
+    ins_v = _np(scope, op.input("Ins")[0])
+    tags = _np(scope, op.input("Ins_tag")[0])
+    filter_tags = _np(scope, op.input("Filter_tag")[0]).reshape(-1)
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    keep = np.array([bool(np.intersect1d(row[row != 0], filter_tags).size)
+                     for row in tags])
+    idx = np.nonzero(keep)[0]
+    out = ins_v[idx] if idx.size else np.zeros((1,) + ins_v.shape[1:],
+                                               ins_v.dtype)
+    if not idx.size and bool(op.attr("is_lod", True)):
+        out = np.zeros((1,) + ins_v.shape[1:], ins_v.dtype)
+    _set(scope, op.output("Out")[0], out)
+    _set(scope, op.output("LossWeight")[0],
+         np.ones((max(idx.size, 1), 1), np.float32)
+         if idx.size else np.zeros((1, 1), np.float32))
+    _set(scope, op.output("IndexMap")[0],
+         np.stack([idx, idx], axis=1).astype(np.int64)
+         if idx.size else np.zeros((1, 2), np.int64))
+
+
+@register_host_op("auc")
+def auc_op(scope, op, exe):
+    """operators/metrics/auc_op.cc: streaming AUC over stat buckets.
+    StatPos/StatNeg accumulate per-threshold counts across batches."""
+    probs = _np(scope, op.input("Predict")[0])
+    labels = _np(scope, op.input("Label")[0]).reshape(-1)
+    num_thresholds = int(op.attr("num_thresholds", 4095))
+    pos_name = op.input("StatPos")[0]
+    neg_name = op.input("StatNeg")[0]
+    stat_pos = _np(scope, pos_name).astype(np.int64).reshape(-1).copy()
+    stat_neg = _np(scope, neg_name).astype(np.int64).reshape(-1).copy()
+    p1 = probs[:, 1] if probs.ndim == 2 and probs.shape[1] == 2 \
+        else probs.reshape(-1)
+    idx = np.clip((p1 * num_thresholds).astype(np.int64), 0, num_thresholds)
+    for i, lab in zip(idx, labels):
+        if lab:
+            stat_pos[i] += 1
+        else:
+            stat_neg[i] += 1
+    tot_pos = tot_neg = 0.0
+    auc = 0.0
+    for i in range(num_thresholds, -1, -1):
+        auc += stat_neg[i] * tot_pos + stat_pos[i] * stat_neg[i] / 2.0
+        tot_pos += stat_pos[i]
+        tot_neg += stat_neg[i]
+    auc = auc / tot_pos / tot_neg if tot_pos and tot_neg else 0.0
+    _set(scope, op.output("AUC")[0], np.asarray(auc, np.float64))
+    _set(scope, op.output("StatPosOut")[0], stat_pos)
+    _set(scope, op.output("StatNegOut")[0], stat_neg)
+
+
+@register_host_op("positive_negative_pair")
+def positive_negative_pair(scope, op, exe):
+    """operators/metrics/positive_negative_pair_op.cc: ranking pair counts
+    per query — (pos, neg, neutral) over same-query item pairs."""
+    score = _np(scope, op.input("Score")[0]).reshape(-1)
+    label = _np(scope, op.input("Label")[0]).reshape(-1)
+    query = _np(scope, op.input("QueryID")[0]).reshape(-1)
+    pos = neg = neu = 0.0
+    for q in np.unique(query):
+        sel = query == q
+        s, l = score[sel], label[sel]
+        for i in range(len(s)):
+            for j in range(i + 1, len(s)):
+                if l[i] == l[j]:
+                    continue
+                d = (s[i] - s[j]) * (l[i] - l[j])
+                if d > 0:
+                    pos += 1
+                elif d < 0:
+                    neg += 1
+                else:
+                    neu += 1
+    if op.input("AccumulatePositivePair"):
+        pos += float(_np(scope, op.input("AccumulatePositivePair")[0]))
+        neg += float(_np(scope, op.input("AccumulateNegativePair")[0]))
+        neu += float(_np(scope, op.input("AccumulateNeutralPair")[0]))
+    _set(scope, op.output("PositivePair")[0], np.asarray([pos], np.float32))
+    _set(scope, op.output("NegativePair")[0], np.asarray([neg], np.float32))
+    _set(scope, op.output("NeutralPair")[0], np.asarray([neu], np.float32))
+
+
+@register_host_op("chunk_eval")
+def chunk_eval(scope, op, exe):
+    """operators/metrics/chunk_eval_op.cc: chunk-level precision/recall/F1
+    for sequence labeling (IOB/IOE/IOBES/plain schemes). Padded inputs
+    [B, T] with SeqLength."""
+    inference = _np(scope, op.input("Inference")[0])
+    label = _np(scope, op.input("Label")[0])
+    if inference.ndim == 1:
+        inference, label = inference[None], label[None]
+    lengths_in = op.input("SeqLength") if "SeqLength" in op.inputs else []
+    if lengths_in:
+        lengths = _np(scope, lengths_in[0]).reshape(-1)
+    else:
+        lengths = np.full((inference.shape[0],), inference.shape[1])
+    scheme = op.attr("chunk_scheme", "IOB")
+    num_chunk_types = int(op.attr("num_chunk_types"))
+    excluded = set(op.attr("excluded_chunk_types", []) or [])
+
+    def extract(seq):
+        """tag id -> (type, pos) per scheme; returns set of chunks
+        (start, end, type)."""
+        chunks = []
+        start = None
+        cur_type = None
+        n_pos = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+        for i, t in enumerate(list(seq) + [-1]):
+            if t < 0 or t >= n_pos * num_chunk_types + (
+                    1 if scheme != "plain" else 0):
+                ttype, tpos = None, None
+            elif scheme == "plain":
+                ttype, tpos = t, "S"
+            else:
+                if t == n_pos * num_chunk_types:  # O tag
+                    ttype, tpos = None, None
+                else:
+                    ttype = t // n_pos
+                    p = t % n_pos
+                    tpos = {"IOB": "BI", "IOE": "IE",
+                            "IOBES": "BIES"}[scheme][p]
+            if scheme == "plain":
+                if ttype is None or (cur_type is not None
+                                     and ttype != cur_type):
+                    if cur_type is not None:
+                        chunks.append((start, i - 1, cur_type))
+                        cur_type = None
+                if ttype is not None and cur_type is None:
+                    start, cur_type = i, ttype
+                elif ttype is not None and ttype == cur_type:
+                    pass
+                continue
+            begins = tpos in ("B", "S") if tpos else False
+            inside = tpos in ("I", "E") if tpos else False
+            if cur_type is not None and (
+                    ttype != cur_type or begins or tpos is None):
+                chunks.append((start, i - 1, cur_type))
+                cur_type = None
+            if ttype is not None and cur_type is None and ttype not in excluded:
+                start, cur_type = i, ttype
+            if cur_type is not None and tpos in ("E", "S"):
+                chunks.append((start, i, cur_type))
+                cur_type = None
+        return set(chunks)
+
+    n_infer = n_label = n_correct = 0
+    for b in range(inference.shape[0]):
+        L = int(lengths[b])
+        ic = extract(inference[b, :L])
+        lc = extract(label[b, :L])
+        n_infer += len(ic)
+        n_label += len(lc)
+        n_correct += len(ic & lc)
+    precision = n_correct / n_infer if n_infer else 0.0
+    recall = n_correct / n_label if n_label else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall else 0.0)
+    _set(scope, op.output("Precision")[0],
+         np.asarray([precision], np.float32))
+    _set(scope, op.output("Recall")[0], np.asarray([recall], np.float32))
+    _set(scope, op.output("F1-Score")[0], np.asarray([f1], np.float32))
+    _set(scope, op.output("NumInferChunks")[0],
+         np.asarray([n_infer], np.int64))
+    _set(scope, op.output("NumLabelChunks")[0],
+         np.asarray([n_label], np.int64))
+    _set(scope, op.output("NumCorrectChunks")[0],
+         np.asarray([n_correct], np.int64))

@@ -1,0 +1,516 @@
+"""Long-tail op batch 2 (ops/nn_extra.py + ops/host_extra.py): numpy-oracle
+OpTests per reference kernel semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+from op_test import OpTest
+
+
+class TestAffineChannel(OpTest):
+    op_type = "affine_channel"
+
+    def setup(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 4, 4)).astype("float32")
+        s = rng.standard_normal(3).astype("float32")
+        b = rng.standard_normal(3).astype("float32")
+        self.inputs = {"X": x, "Scale": s, "Bias": b}
+        self.attrs = {"data_layout": "NCHW"}
+        self.outputs = {"Out": x * s[None, :, None, None]
+                        + b[None, :, None, None]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Out")
+
+
+class TestMultiplex(OpTest):
+    op_type = "multiplex"
+
+    def setup(self):
+        rng = np.random.default_rng(1)
+        x1 = rng.standard_normal((4, 3)).astype("float32")
+        x2 = rng.standard_normal((4, 3)).astype("float32")
+        ids = np.array([[0], [1], [1], [0]], dtype="int32")
+        self.inputs = {"X": [x1, x2], "Ids": ids}
+        self.attrs = {}
+        out = np.stack([x1[0], x2[1], x2[2], x1[3]])
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMaxPoolWithIndexUnpool(OpTest):
+    op_type = "max_pool2d_with_index"
+
+    def setup(self):
+        x = np.array([[[[1, 2, 3, 4],
+                        [5, 6, 7, 8],
+                        [9, 10, 11, 12],
+                        [13, 14, 15, 16]]]], dtype="float32")
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {
+            "Out": np.array([[[[6, 8], [14, 16]]]], dtype="float32"),
+            "Mask": np.array([[[[5, 7], [13, 15]]]], dtype="int64"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_unpool_roundtrip(self):
+        self.setup()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [1, 4, 4], dtype="float32")
+            block = main.global_block()
+            out = block.create_var(name="pool", shape=[1, 1, 2, 2],
+                                   dtype="float32")
+            mask = block.create_var(name="mask", shape=[1, 1, 2, 2],
+                                    dtype="int64")
+            up = block.create_var(name="up", shape=[1, 1, 4, 4],
+                                  dtype="float32")
+            block.append_op(type="max_pool2d_with_index",
+                            inputs={"X": [x]},
+                            outputs={"Out": [out], "Mask": [mask]},
+                            attrs=dict(self.attrs))
+            block.append_op(type="unpool",
+                            inputs={"X": [out], "Indices": [mask]},
+                            outputs={"Out": [up]},
+                            attrs={"unpooled_height": 4,
+                                   "unpooled_width": 4})
+        exe = fluid.Executor(fluid.CPUPlace())
+        (v,) = exe.run(main, feed={"x": self.inputs["X"]},
+                       fetch_list=["up"])
+        want = np.zeros((1, 1, 4, 4), "float32")
+        want[0, 0, 1, 1], want[0, 0, 1, 3] = 6, 8
+        want[0, 0, 3, 1], want[0, 0, 3, 3] = 14, 16
+        np.testing.assert_allclose(v, want)
+
+
+class TestTrilinearInterp(OpTest):
+    op_type = "trilinear_interp"
+
+    def setup(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 1, 2, 2, 2)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"out_d": 3, "out_h": 3, "out_w": 3,
+                      "align_corners": True}
+        # align_corners linear on each axis: midpoints are averages
+        from itertools import product
+        want = np.zeros((1, 1, 3, 3, 3), "float32")
+        pts = [0.0, 0.5, 1.0]
+        for i, j, k in product(range(3), repeat=3):
+            d, h, w = pts[i], pts[j], pts[k]
+            acc = 0.0
+            for dd, hh, ww in product((0, 1), repeat=3):
+                wgt = ((1 - abs(d - dd)) * (1 - abs(h - hh))
+                       * (1 - abs(w - ww)))
+                acc += wgt * x[0, 0, dd, hh, ww]
+            want[0, 0, i, j, k] = acc
+        self.outputs = {"Out": want}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestBicubicKeysKernel(OpTest):
+    op_type = "bicubic_interp"
+
+    def setup(self):
+        # identity when out size == in size and align_corners
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((1, 2, 4, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 4, "out_w": 4, "align_corners": True}
+        self.outputs = {"Out": x}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestGruUnit(OpTest):
+    op_type = "gru_unit"
+
+    def setup(self):
+        rng = np.random.default_rng(4)
+        B, D = 3, 5
+        xg = rng.standard_normal((B, 3 * D)).astype("float32")
+        hp = rng.standard_normal((B, D)).astype("float32")
+        w = (rng.standard_normal((D, 3 * D)) * 0.5).astype("float32")
+        b = (rng.standard_normal((1, 3 * D)) * 0.1).astype("float32")
+        self.inputs = {"Input": xg, "HiddenPrev": hp, "Weight": w, "Bias": b}
+        self.attrs = {"gate_activation": 1, "activation": 2,
+                      "origin_mode": False}
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        g = xg + b
+        ur = g[:, :2 * D] + hp @ w[:, :2 * D]
+        u, r = sig(ur[:, :D]), sig(ur[:, D:])
+        rhp = r * hp
+        c = np.tanh(g[:, 2 * D:] + rhp @ w[:, 2 * D:])
+        h = u * (c - hp) + hp
+        self.outputs = {"Gate": np.concatenate([u, r, c], 1).astype("float32"),
+                        "ResetHiddenPrev": rhp.astype("float32"),
+                        "Hidden": h.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.setup()
+        self.outputs = {"Hidden": self.outputs["Hidden"]}
+        self.check_grad(["Input", "HiddenPrev", "Weight"], "Hidden",
+                        max_relative_error=0.1)
+
+
+class TestLstmUnit(OpTest):
+    op_type = "lstm_unit"
+
+    def setup(self):
+        rng = np.random.default_rng(5)
+        B, D = 4, 6
+        x = rng.standard_normal((B, 4 * D)).astype("float32")
+        c = rng.standard_normal((B, D)).astype("float32")
+        self.inputs = {"X": x, "C_prev": c}
+        self.attrs = {"forget_bias": 1.0}
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        i, f, o, g = (x[:, :D], x[:, D:2 * D], x[:, 2 * D:3 * D],
+                      x[:, 3 * D:])
+        cn = sig(f + 1.0) * c + sig(i) * np.tanh(g)
+        self.outputs = {"C": cn.astype("float32"),
+                        "H": (sig(o) * np.tanh(cn)).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestHingeLoss(OpTest):
+    op_type = "hinge_loss"
+
+    def setup(self):
+        rng = np.random.default_rng(6)
+        pred = rng.standard_normal((8, 1)).astype("float32")
+        label = rng.integers(0, 2, (8, 1)).astype("float32")
+        self.inputs = {"Logits": pred, "Labels": label}
+        self.attrs = {}
+        self.outputs = {"Loss": np.maximum(
+            0, 1 - (2 * label - 1) * pred).astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBprLoss(OpTest):
+    op_type = "bpr_loss"
+
+    def setup(self):
+        rng = np.random.default_rng(7)
+        B, D = 4, 6
+        x = rng.standard_normal((B, D)).astype("float32")
+        y = rng.integers(0, D, (B, 1)).astype("int64")
+        self.inputs = {"X": x, "Label": y}
+        self.attrs = {}
+        loss = np.zeros((B, 1), "float32")
+        for b in range(B):
+            g = x[b, y[b, 0]]
+            s = 0.0
+            for j in range(D):
+                if j == y[b, 0]:
+                    continue
+                s += np.log1p(np.exp(-(g - x[b, j])))
+            loss[b, 0] = s / (D - 1)
+        self.outputs = {"Loss": loss}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Loss", max_relative_error=0.02)
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def setup(self):
+        rng = np.random.default_rng(8)
+        B, W, Yw = 2, 7, 3
+        x = rng.standard_normal((B, W)).astype("float32")
+        y = rng.standard_normal((B, Yw)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        out = np.zeros_like(x)
+        half = (Yw - 1) // 2
+        for k in range(B):
+            for i in range(W):
+                for j in range(Yw):
+                    out[k, i] += x[k, (i + j - half) % W] * y[k, j]
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestRowConv(OpTest):
+    op_type = "row_conv"
+
+    def setup(self):
+        rng = np.random.default_rng(9)
+        B, T, D, FC = 2, 5, 3, 2
+        x = rng.standard_normal((B, T, D)).astype("float32")
+        f = rng.standard_normal((FC, D)).astype("float32")
+        self.inputs = {"X": x, "Filter": f}
+        self.attrs = {}
+        out = np.zeros_like(x)
+        for b in range(B):
+            for t in range(T):
+                for w in range(FC):
+                    if t + w < T:
+                        out[b, t] += x[b, t + w] * f[w]
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X", "Filter"], "Out", max_relative_error=0.02)
+
+
+class TestFsp(OpTest):
+    op_type = "fsp"
+
+    def setup(self):
+        rng = np.random.default_rng(10)
+        x = rng.standard_normal((2, 3, 4, 5)).astype("float32")
+        y = rng.standard_normal((2, 6, 4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        out = np.einsum("nxhw,nyhw->nxy", x, y) / 20.0
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSpectralNorm(OpTest):
+    op_type = "spectral_norm"
+
+    def setup(self):
+        rng = np.random.default_rng(11)
+        w = rng.standard_normal((4, 6)).astype("float32")
+        u = rng.standard_normal(4).astype("float32")
+        v = rng.standard_normal(6).astype("float32")
+        self.inputs = {"Weight": w, "U": u, "V": v}
+        self.attrs = {"dim": 0, "power_iters": 10, "eps": 1e-12}
+        # many power iterations converge to the true top singular value
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        self.outputs = {"Out": (w / sigma).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-3, rtol=1e-3)
+
+
+class TestShardIndex(OpTest):
+    op_type = "shard_index"
+
+    def setup(self):
+        x = np.array([[1], [6], [12], [19]], dtype="int64")
+        self.inputs = {"X": x}
+        self.attrs = {"index_num": 20, "nshards": 2, "shard_id": 0,
+                      "ignore_value": -1}
+        self.outputs = {"Out": np.array([[1], [6], [-1], [-1]],
+                                        dtype="int64")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFrobeniusNorm(OpTest):
+    op_type = "frobenius_norm"
+
+    def setup(self):
+        rng = np.random.default_rng(12)
+        x = rng.standard_normal((3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.sqrt((x ** 2).sum()).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestCholesky(OpTest):
+    op_type = "cholesky"
+
+    def setup(self):
+        rng = np.random.default_rng(13)
+        a = rng.standard_normal((3, 3)).astype("float32")
+        spd = a @ a.T + 3 * np.eye(3, dtype="float32")
+        self.inputs = {"X": spd}
+        self.attrs = {"upper": False}
+        self.outputs = {"Out": np.linalg.cholesky(spd).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestPartialOps(OpTest):
+    op_type = "partial_concat"
+
+    def setup(self):
+        rng = np.random.default_rng(14)
+        a = rng.standard_normal((3, 6)).astype("float32")
+        b = rng.standard_normal((3, 6)).astype("float32")
+        self.inputs = {"X": [a, b]}
+        self.attrs = {"start_index": 1, "length": 2}
+        self.outputs = {"Out": np.concatenate([a[:, 1:3], b[:, 1:3]], 1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSpaceToDepth(OpTest):
+    op_type = "space_to_depth"
+
+    def setup(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"blocksize": 2}
+        want = x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 3, 5, 1, 2, 4) \
+            .reshape(1, 4, 2, 2)
+        self.outputs = {"Out": want}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCenterLoss(OpTest):
+    op_type = "center_loss"
+
+    def setup(self):
+        rng = np.random.default_rng(15)
+        B, D, K = 4, 3, 5
+        x = rng.standard_normal((B, D)).astype("float32")
+        y = rng.integers(0, K, (B,)).astype("int64")
+        centers = rng.standard_normal((K, D)).astype("float32")
+        rate = np.asarray([0.5], "float32")
+        self.inputs = {"X": x, "Label": y, "Centers": centers,
+                       "CenterUpdateRate": rate}
+        self.attrs = {"need_update": False}
+        diff = x - centers[y]
+        self.outputs = {
+            "Loss": (0.5 * (diff ** 2).sum(1, keepdims=True)).astype(
+                "float32"),
+            "SampleCenterDiff": diff.astype("float32"),
+            "CentersOut": centers,
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# host ops
+# ---------------------------------------------------------------------------
+
+
+def _run_host_op(op_type, inputs, outputs, attrs):
+    main = fluid.Program()
+    block = main.global_block()
+    in_names = {}
+    import jax.numpy as jnp
+    scope = fluid.Scope()
+    for slot, vals in inputs.items():
+        vals = vals if isinstance(vals, list) else [vals]
+        names = []
+        for i, v in enumerate(vals):
+            nm = f"i_{slot}_{i}"
+            block.create_var(name=nm, shape=list(np.asarray(v).shape),
+                             dtype=str(np.asarray(v).dtype), is_data=True)
+            scope.set_var(nm, jnp.asarray(v))
+            names.append(nm)
+        in_names[slot] = names
+    out_names = {}
+    for slot, n in outputs.items():
+        names = []
+        for i in range(n):
+            nm = f"o_{slot}_{i}"
+            block.create_var(name=nm, shape=[1], dtype="float32")
+            names.append(nm)
+        out_names[slot] = names
+    block.append_op(type=op_type, inputs=in_names, outputs=out_names,
+                    attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fetch = [n for ns in out_names.values() for n in ns]
+    vals = exe.run(main, feed={}, fetch_list=fetch, scope=scope)
+    flat = dict(zip(fetch, vals))
+    return {slot: [flat[n] for n in ns] for slot, ns in out_names.items()}
+
+
+def test_unique_with_counts():
+    out = _run_host_op(
+        "unique_with_counts", {"X": np.array([2, 3, 3, 1, 5, 3], "int64")},
+        {"Out": 1, "Index": 1, "Count": 1}, {})
+    np.testing.assert_array_equal(out["Out"][0], [2, 3, 1, 5])
+    np.testing.assert_array_equal(out["Index"][0], [0, 1, 1, 2, 3, 1])
+    np.testing.assert_array_equal(out["Count"][0], [1, 3, 1, 1])
+
+
+def test_auc_op_streams():
+    probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.4, 0.6], [0.7, 0.3]],
+                     "float32")[:, ::-1].copy()
+    # column 1 = positive-class prob: [0.9, 0.2?...] build directly instead:
+    probs = np.array([[0.1, 0.9], [0.8, 0.2], [0.4, 0.6], [0.7, 0.3]],
+                     "float32")
+    labels = np.array([[1], [0], [1], [0]], "int64")
+    nt = 127
+    out = _run_host_op(
+        "auc", {"Predict": probs, "Label": labels,
+                "StatPos": np.zeros(nt + 1, "int64"),
+                "StatNeg": np.zeros(nt + 1, "int64")},
+        {"AUC": 1, "StatPosOut": 1, "StatNegOut": 1},
+        {"num_thresholds": nt})
+    assert float(out["AUC"][0]) == 1.0  # perfectly separable
+
+
+def test_chunk_eval_iob():
+    # tags: B-T0=0, I-T0=1, B-T1=2, I-T1=3, O=4
+    label = np.array([[0, 1, 4, 2, 3, 4]], "int64")
+    infer = np.array([[0, 1, 4, 2, 4, 4]], "int64")
+    out = _run_host_op(
+        "chunk_eval",
+        {"Inference": infer, "Label": label,
+         "SeqLength": np.array([6], "int64")},
+        {"Precision": 1, "Recall": 1, "F1-Score": 1, "NumInferChunks": 1,
+         "NumLabelChunks": 1, "NumCorrectChunks": 1},
+        {"num_chunk_types": 2, "chunk_scheme": "IOB"})
+    assert int(out["NumLabelChunks"][0]) == 2
+    assert int(out["NumInferChunks"][0]) == 2
+    assert int(out["NumCorrectChunks"][0]) == 1
+    assert float(out["Precision"][0]) == 0.5
+
+
+def test_save_load_ops(tmp_path):
+    arr = np.arange(6, dtype="float32").reshape(2, 3)
+    path = str(tmp_path / "t.bin")
+    _run_host_op("save", {"X": arr}, {}, {"file_path": path})
+    out = _run_host_op("load", {}, {"Out": 1}, {"file_path": path})
+    np.testing.assert_array_equal(out["Out"][0], arr)
+
+
+def test_split_merge_ids():
+    ids = np.array([[3], [4], [7], [10]], "int64")
+    out = _run_host_op("split_ids", {"Ids": ids}, {"Out": 2}, {})
+    np.testing.assert_array_equal(out["Out"][0].reshape(-1), [4, 10])
+    np.testing.assert_array_equal(out["Out"][1].reshape(-1), [3, 7])
