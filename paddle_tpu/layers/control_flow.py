@@ -6,13 +6,15 @@ lax.cond (ops/control_flow.py), keeping shapes static as XLA requires.
 """
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..framework.layer_helper import LayerHelper
 from ..framework.program import Variable, default_main_program
 
 __all__ = ["While", "cond", "while_loop", "Switch", "array_write", "array_read",
-           "array_length", "create_array", "increment", "less_than", "equal"]
+           "array_length", "create_array", "increment", "less_than", "equal",
+           "DynamicRNN", "lod_rank_table", "max_sequence_len",
+           "lod_tensor_to_array", "array_to_lod_tensor", "shrink_memory"]
 
 
 class While:
@@ -260,3 +262,243 @@ def array_length(array):
 
 # re-exports used by While conditions
 from .tensor import equal, increment, less_than  # noqa: E402,F401
+
+
+class DynamicRNN:
+    """fluid.layers.DynamicRNN (reference control_flow.py:2927) on the
+    padded representation: ``step_input`` takes [B, T, ...] sequences (+
+    optional per-batch ``length``), the user's block builds one time step,
+    and the whole loop compiles to a single ``lax.scan`` via the
+    ``dynamic_rnn`` op (ops/dynamic_rnn.py — the reference's rank-table /
+    batch-shrink machinery replaced by masking, see that module's docstring).
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(sentence, length=seq_len)
+            prev = drnn.memory(shape=[H], value=0.0)
+            hidden = fluid.layers.fc([word, prev], H, act="tanh")
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        out = drnn()   # [B, T, H], zero past each row's length
+    """
+
+    BEFORE_RNN, IN_RNN, AFTER_RNN = range(3)
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.status = self.BEFORE_RNN
+        self._step_outer: List[Variable] = []
+        self._step_inner: List[Variable] = []
+        self._static_outer: List[Variable] = []
+        self._static_inner: List[str] = []
+        self._mems: List[Variable] = []
+        self._mem_inits: List = []       # Variable | (value, dim)
+        self._mem_updates: Dict[str, str] = {}
+        self._outputs_inner: List[Variable] = []
+        self._length: Variable = None
+        self._outer_outputs: List[Variable] = []
+
+    def block(self):
+        return _DynamicRNNBlock(self)
+
+    def _assert_in_rnn(self, method):
+        if self.status != self.IN_RNN:
+            raise ValueError(f"{method} must be called inside drnn.block()")
+
+    def step_input(self, x, level=0, length=None):
+        self._assert_in_rnn("step_input")
+        if length is not None:
+            self._length = length
+        prog = default_main_program()
+        inner = prog.current_block().create_var(
+            name=f"{x.name}@drnn_step",
+            shape=[x.shape[0]] + list(x.shape[2:]), dtype=x.dtype)
+        self._step_outer.append(x)
+        self._step_inner.append(inner)
+        return inner
+
+    def static_input(self, x):
+        self._assert_in_rnn("static_input")
+        prog = default_main_program()
+        inner = prog.current_block().create_var(
+            name=f"{x.name}@drnn_static", shape=list(x.shape), dtype=x.dtype)
+        self._static_outer.append(x)
+        self._static_inner.append(inner.name)
+        return inner
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        self._assert_in_rnn("memory")
+        prog = default_main_program()
+        if init is not None:
+            mshape = list(init.shape)
+            mdtype = init.dtype
+            self._mem_inits.append(init)
+        else:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            dim = shape[-1] if isinstance(shape, (list, tuple)) else shape
+            mshape = [-1, int(dim)]
+            mdtype = dtype
+            self._mem_inits.append((float(value), int(dim)))
+        mem = prog.current_block().create_var(
+            name=self.helper.create_variable_for_type_inference(
+                mdtype).name + "@drnn_mem",
+            shape=mshape, dtype=mdtype)
+        self._mems.append(mem)
+        return mem
+
+    def update_memory(self, ex_mem, new_mem):
+        self._assert_in_rnn("update_memory")
+        self._mem_updates[ex_mem.name] = new_mem.name
+
+    def output(self, *outputs):
+        self._assert_in_rnn("output")
+        self._outputs_inner.extend(outputs)
+
+    def __call__(self):
+        if self.status != self.AFTER_RNN:
+            raise ValueError("call drnn() after exiting drnn.block()")
+        if len(self._outer_outputs) == 1:
+            return self._outer_outputs[0]
+        return self._outer_outputs
+
+
+class _DynamicRNNBlock:
+    def __init__(self, drnn: DynamicRNN):
+        self.drnn = drnn
+
+    def __enter__(self):
+        self.drnn.status = DynamicRNN.IN_RNN
+        prog = default_main_program()
+        self.sub_block = prog._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        d = self.drnn
+        if not d._step_inner:
+            raise ValueError("DynamicRNN needs at least one step_input")
+        if not d._outputs_inner:
+            raise ValueError("DynamicRNN needs at least one output")
+        prog = default_main_program()
+        sub_idx = prog.current_block_idx
+        sub_block = prog.current_block()
+        prog._rollback()
+        parent = prog.current_block()
+
+        # captured = everything the step block reads that lives outside it
+        inner_defined = {v.name for v in d._step_inner} \
+            | set(d._static_inner) | {m.name for m in d._mems}
+        written, read = set(), set()
+        for op in sub_block.ops:
+            for n in op.input_arg_names:
+                if n not in written and n not in inner_defined:
+                    read.add(n)
+            for n in op.output_arg_names:
+                written.add(n)
+        def _exists(n):
+            try:
+                parent._var_recursive(n)
+                return True
+            except Exception:
+                return False
+
+        captured = sorted(n for n in read if _exists(n))
+
+        T = d._step_outer[0].shape[1]
+        ins = {"StepIn": [v.name for v in d._step_outer],
+               "Captured": captured}
+        if d._static_outer:
+            ins["Static"] = [v.name for v in d._static_outer]
+        var_inits = [m for m in d._mem_inits if isinstance(m, Variable)]
+        if var_inits:
+            ins["Init"] = [v.name for v in var_inits]
+        if d._length is not None:
+            ins["Length"] = [d._length.name]
+
+        outs = []
+        for ov in d._outputs_inner:
+            outer = parent.create_var(
+                name=ov.name + "@drnn_out",
+                shape=[ov.shape[0], T] + list(ov.shape[1:]), dtype=ov.dtype)
+            outs.append(outer)
+        d._outer_outputs = outs
+
+        mem_update = []
+        for m in d._mems:
+            upd = d._mem_updates.get(m.name)
+            if upd is None:
+                raise ValueError(f"memory {m.name} never update_memory()'d")
+            mem_update.append(upd)
+
+        parent.append_op(
+            type="dynamic_rnn",
+            inputs=ins,
+            outputs={"Out": [o.name for o in outs]},
+            attrs={
+                "sub_block": sub_idx,
+                "step_inner": [v.name for v in d._step_inner],
+                "static_inner": list(d._static_inner),
+                "mem_inner": [m.name for m in d._mems],
+                "mem_update": mem_update,
+                "mem_init_const": [None if isinstance(m, Variable) else m
+                                   for m in d._mem_inits],
+                "out_inner": [v.name for v in d._outputs_inner],
+                "captured_names": captured,
+            },
+        )
+        d.status = DynamicRNN.AFTER_RNN
+        return True
+
+
+def lod_rank_table(x, level=0, length=None):
+    """fluid.layers.lod_rank_table — padded form emits the (index, length)
+    table sorted by length desc (ops/dynamic_rnn.py)."""
+    helper = LayerHelper("lod_rank_table")
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    ins = {"X": [x]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="lod_rank_table", inputs=ins,
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    helper.append_op(type="max_sequence_len",
+                     inputs={"RankTable": [rank_table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    helper = LayerHelper("lod_tensor_to_array")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="lod_tensor_to_array",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def array_to_lod_tensor(x, table):
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="array_to_lod_tensor",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def shrink_memory(x, i, table):
+    helper = LayerHelper("shrink_rnn_memory")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="shrink_rnn_memory",
+                     inputs={"X": [x], "I": [i], "RankTable": [table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out

@@ -1,0 +1,190 @@
+"""DynamicRNN machinery — the reference's variable-length RNN authoring
+surface (python/paddle/fluid/layers/control_flow.py:2927 DynamicRNN,
+operators/controlflow lod_rank_table / lod_tensor_to_array /
+array_to_lod_tensor / shrink_rnn_memory).
+
+The reference implementation sorts sequences by length (LoDRankTable),
+explodes the batch into per-timestep arrays, and SHRINKS the active batch as
+short sequences finish — a CPU-scheduler design that XLA cannot compile
+(dynamic shapes every step). The TPU-native equivalent here keeps the batch
+FIXED and runs the user's step block under one ``lax.scan`` over the padded
+time axis; finished rows simply keep computing and their outputs are masked
+to zero afterward — identical results, one compiled While, MXU-shaped
+batches every step.
+
+``dynamic_rnn`` is the workhorse op (built by layers.DynamicRNN); the
+rank-table ops are provided in padded form for program parity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import int_index_dtype
+from ..framework.registry import LowerCtx, register_op, run_lowering
+
+_I64 = int_index_dtype()
+
+
+@register_op("dynamic_rnn")
+def dynamic_rnn(ctx, op, ins):
+    """Run sub_block once per time step under lax.scan.
+
+    Inputs: StepIn (padded [B, T, ...] sequences), Static (per-batch
+    constants), Init (memory initials), Captured (every outer var the block
+    reads — params included, so the generic vjp routes their grads),
+    Length (optional [B] valid lengths).
+    Attrs map inner (sub-block) var names to each slot; Out = stacked
+    per-step outputs [B, T, ...] masked past Length.
+    """
+    sub = ctx.program.block(op.attr("sub_block"))
+    step_inner: List[str] = op.attr("step_inner")
+    static_inner: List[str] = op.attr("static_inner", [])
+    mem_inner: List[str] = op.attr("mem_inner", [])
+    mem_update: List[str] = op.attr("mem_update", [])
+    mem_init_const = op.attr("mem_init_const", [])  # (value, shape) or None
+    out_inner: List[str] = op.attr("out_inner")
+    captured_names: List[str] = op.attr("captured_names", [])
+
+    xs = [jnp.moveaxis(x, 1, 0) for x in ins["StepIn"]]        # [T, B, ...]
+    T = xs[0].shape[0]
+    B = xs[0].shape[1]
+
+    base_env: Dict = {}
+    for name, val in zip(captured_names, ins.get("Captured", [])):
+        base_env[name] = val
+    for name, val in zip(static_inner, ins.get("Static", [])):
+        base_env[name] = val
+
+    inits = list(ins.get("Init", []))
+    carry0 = []
+    ii = 0
+    for mi, const in zip(mem_inner, mem_init_const):
+        if const is not None:
+            value, dim = const
+            carry0.append(jnp.full((B, int(dim)), float(value),
+                                   xs[0].dtype if jnp.issubdtype(
+                                       xs[0].dtype, jnp.floating)
+                                   else jnp.float32))
+        else:
+            carry0.append(inits[ii])
+            ii += 1
+
+    saved_counter = ctx._rng_counter
+
+    def body(carry, xt):
+        env = dict(base_env)
+        env.update(zip(step_inner, xt))
+        env.update(zip(mem_inner, carry))
+        sub_ctx = LowerCtx(ctx.program, sub, env, rng_key=ctx._rng_key,
+                           mesh_axes=ctx.mesh_axes, is_test=ctx.is_test)
+        sub_ctx._rng_counter = saved_counter + 104729
+        for sop in sub.ops:
+            run_lowering(sub_ctx, sop)
+        new_carry = [env[u] for u in mem_update]
+        outs = tuple(env[o] for o in out_inner)
+        return new_carry, outs
+
+    _, stacked = lax.scan(body, carry0, tuple(xs))
+    outs = [jnp.moveaxis(s, 0, 1) for s in stacked]            # [B, T, ...]
+    if ins.get("Length"):
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+        tmask = jnp.arange(T)[None, :] < ln[:, None]           # [B, T]
+        outs = [jnp.where(
+            tmask.reshape(tmask.shape + (1,) * (o.ndim - 2)), o,
+            jnp.zeros((), o.dtype)) for o in outs]
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# rank-table family (padded-form parity)
+# ---------------------------------------------------------------------------
+
+
+@register_op("lod_rank_table", grad=None)
+def lod_rank_table(ctx, op, ins):
+    """operators/controlflow/lod_rank_table_op.cc: (index, length) pairs
+    sorted by length descending (stable). Padded form: X [B, T, ...] +
+    Length [B] -> Out int64 [B, 2]."""
+    if ins.get("Length"):
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        x = ins["X"][0]
+        ln = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    order = jnp.argsort(-ln, stable=True)
+    return {"Out": jnp.stack(
+        [order.astype(_I64), ln[order].astype(_I64)], axis=1)}
+
+
+@register_op("max_sequence_len", grad=None)
+def max_sequence_len(ctx, op, ins):
+    """operators/controlflow/max_sequence_len_op.cc: longest length in the
+    rank table."""
+    table = ins["RankTable"][0]
+    return {"Out": jnp.max(table[:, 1]).reshape(1)}
+
+
+@register_op("lod_tensor_to_array", grad=None)
+def lod_tensor_to_array(ctx, op, ins):
+    """operators/controlflow/lod_tensor_to_array_op.cc: explode the time
+    axis into a tensor array (padded form: T slices of [B, ...]; the
+    reference's per-step batch shrink is replaced by downstream masking)."""
+    x = ins["X"][0]
+    return {"Out": [[x[:, t] for t in range(x.shape[1])]]}
+
+
+@register_op("array_to_lod_tensor", grad=None)
+def array_to_lod_tensor(ctx, op, ins):
+    """operators/controlflow/array_to_lod_tensor_op.cc: inverse — stack the
+    array back onto the time axis."""
+    arr = ins["X"][0]
+    return {"Out": jnp.stack(arr, axis=1)}
+
+
+@register_op("shrink_rnn_memory", diff_inputs=("X",))
+def shrink_rnn_memory(ctx, op, ins):
+    """operators/controlflow/shrink_rnn_memory_op.cc. The reference slices
+    memory to the rows still active at step I (batch shrink under the rank
+    table). Fixed-shape form: rows whose sequence already ended are frozen
+    (pass-through of their previous value would require the carry — here
+    they are masked to zero, matching what the masked scan consumes)."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    step = ins["I"][0].reshape(()).astype(jnp.int32)
+    lengths = table[:, 1]
+    order = table[:, 0]
+    # active rows at this step, mapped back to batch positions
+    active_sorted = (lengths > step)
+    active = jnp.zeros((x.shape[0],), bool).at[order].set(active_sorted)
+    return {"Out": jnp.where(
+        active.reshape((-1,) + (1,) * (x.ndim - 1)), x,
+        jnp.zeros((), x.dtype))}
+
+
+@register_op("split_lod_tensor", grad=None)
+def split_lod_tensor(ctx, op, ins):
+    """operators/controlflow/split_lod_tensor_op.cc (IfElse plumbing):
+    route rows by boolean mask. Fixed-shape form: both outputs keep [B,...]
+    with non-selected rows zeroed (merge_lod_tensor re-interleaves)."""
+    x = ins["X"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    m = mask.reshape(shape)
+    zero = jnp.zeros((), x.dtype)
+    return {"OutTrue": jnp.where(m, x, zero),
+            "OutFalse": jnp.where(m, zero, x)}
+
+
+@register_op("merge_lod_tensor", diff_inputs=("InTrue", "InFalse"))
+def merge_lod_tensor(ctx, op, ins):
+    """operators/controlflow/merge_lod_tensor_op.cc: inverse of split —
+    select each row from the branch its mask routed it to."""
+    t = ins["InTrue"][0]
+    f = ins["InFalse"][0]
+    mask = ins["Mask"][0].reshape(-1).astype(bool)
+    return {"Out": jnp.where(
+        mask.reshape((-1,) + (1,) * (t.ndim - 1)), t, f)}

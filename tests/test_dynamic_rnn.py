@@ -1,0 +1,115 @@
+"""DynamicRNN (reference control_flow.py:2927) on the padded convention:
+the user's per-step block compiles into one lax.scan (ops/dynamic_rnn.py),
+finished rows masked. Oracle: hand-rolled numpy RNN with per-row lengths."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.backward import append_backward
+
+
+def _data(B=4, T=5, D=3, H=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(B, T, D).astype("float32")
+    ln = np.array([5, 3, 4, 1], dtype="int64")
+    return x, ln
+
+
+def _build(B=4, T=5, D=3, H=6):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, D], dtype="float32")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(x, length=ln)
+            prev = drnn.memory(shape=[H], value=0.0)
+            hidden = fluid.layers.fc([word, prev], H, act="tanh", name="cell")
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        out = drnn()
+        last = fluid.layers.sequence_pool(out, "LAST", length=ln)
+        loss = fluid.layers.reduce_mean(fluid.layers.reduce_sum(last, dim=1))
+    return main, startup, out, loss
+
+
+def test_dynamic_rnn_matches_numpy():
+    B, T, D, H = 4, 5, 3, 6
+    x_np, ln_np = _data(B, T, D, H)
+    main, startup, out, loss = _build(B, T, D, H)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    out_v, = exe.run(main, feed={"x": x_np, "ln": ln_np},
+                     fetch_list=[out], scope=scope)
+    w = np.asarray(scope.find_var("cell.w_0"))
+    w2 = np.asarray(scope.find_var("cell.w_1"))
+    b = np.asarray(scope.find_var("cell.b_0"))
+    want = np.zeros((B, T, H), "float32")
+    for bi in range(B):
+        h = np.zeros(H, "float32")
+        for t in range(int(ln_np[bi])):
+            h = np.tanh(x_np[bi, t] @ w + h @ w2 + b)
+            want[bi, t] = h
+    np.testing.assert_allclose(out_v, want, atol=1e-5)
+    # masked past length
+    assert (out_v[3, 1:] == 0).all() and (out_v[1, 3:] == 0).all()
+
+
+def test_dynamic_rnn_trains():
+    B, T, D, H = 4, 5, 3, 6
+    x_np, ln_np = _data(B, T, D, H)
+    main, startup, out, loss = _build(B, T, D, H)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w0 = np.asarray(scope.find_var("cell.w_0")).copy()
+    vals = [float(exe.run(main, feed={"x": x_np, "ln": ln_np},
+                          fetch_list=[loss], scope=scope)[0])
+            for _ in range(8)]
+    w1 = np.asarray(scope.find_var("cell.w_0"))
+    assert not np.allclose(w0, w1), "params did not receive grads"
+    assert vals[-1] < vals[0], vals
+
+
+def test_rank_table_family():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4, 2], dtype="float32")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        table = fluid.layers.lod_rank_table(x, length=ln)
+        mx = fluid.layers.max_sequence_len(table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    x_np = np.zeros((3, 4, 2), "float32")
+    ln_np = np.array([2, 4, 3], "int64")
+    t_v, m_v = exe.run(main, feed={"x": x_np, "ln": ln_np},
+                       fetch_list=[table, mx])
+    np.testing.assert_array_equal(t_v, [[1, 4], [2, 3], [0, 2]])
+    assert int(np.ravel(m_v)[0]) == 4
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        m = fluid.layers.data("m", [1], dtype="bool")
+        block = main.global_block()
+        t = block.create_var(name="t", shape=[-1, 3], dtype="float32")
+        f = block.create_var(name="f", shape=[-1, 3], dtype="float32")
+        o = block.create_var(name="o", shape=[-1, 3], dtype="float32")
+        block.append_op(type="split_lod_tensor",
+                        inputs={"X": [x], "Mask": [m]},
+                        outputs={"OutTrue": [t], "OutFalse": [f]}, attrs={})
+        block.append_op(type="merge_lod_tensor",
+                        inputs={"InTrue": [t], "InFalse": [f], "Mask": [m],
+                                "X": [x]},
+                        outputs={"Out": [o]}, attrs={})
+    exe = fluid.Executor(fluid.CPUPlace())
+    x_np = np.arange(12, dtype="float32").reshape(4, 3)
+    m_np = np.array([[1], [0], [1], [0]], dtype=bool)
+    t_v, f_v, o_v = exe.run(main, feed={"x": x_np, "m": m_np},
+                            fetch_list=["t", "f", "o"])
+    np.testing.assert_array_equal(o_v, x_np)
+    assert (t_v[1] == 0).all() and (f_v[0] == 0).all()
