@@ -1,0 +1,17 @@
+"""API-freeze and op-desc compatibility gates — parity with the reference's
+tools/diff_api.py + tools/check_op_desc.py CI checks. Regenerate the specs
+with `python tools/api_spec.py generate` when a surface change is
+intentional."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def test_api_and_op_desc_frozen():
+    import api_spec
+
+    problems = api_spec.check()
+    assert not problems, "\n".join(
+        problems + ["", "intentional change? run: "
+                    "python tools/api_spec.py generate"])
