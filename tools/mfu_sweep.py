@@ -94,17 +94,28 @@ def worker():
 
 
 def run_one(spec, timeout=420):
+    """SIGINT-first teardown: SIGKILLing a python mid-TPU-session wedges the
+    axon relay (every later backend init hangs) — give the child a grace
+    window to unwind the PJRT client, exactly like bench.py's _run_timed."""
+    import signal
+
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", spec]
-    t0 = time.time()
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
     try:
-        p = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
-                           cwd=REPO)
+        out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGINT)
+        try:
+            out, err = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
         return {"spec": spec, "error": "timeout"}
-    if p.returncode != 0:
-        tail = (p.stderr or "").strip().splitlines()[-4:]
-        return {"spec": spec, "error": f"rc={p.returncode}", "tail": tail}
-    for line in reversed(p.stdout.strip().splitlines()):
+    if proc.returncode != 0:
+        return {"spec": spec, "error": f"rc={proc.returncode}",
+                "tail": (err or "").strip().splitlines()[-6:]}
+    for line in reversed(out.strip().splitlines()):
         if line.startswith("{"):
             return json.loads(line)
     return {"spec": spec, "error": "no json"}
