@@ -1,0 +1,293 @@
+"""Long-tail op batch 3: full-sequence lstm/gru (reference top-level op
+names), deformable convolution v1/v2, position-sensitive / precise RoI
+pooling, inplace ABN.
+
+Same design rules as nn_extra.py: padded [B, T, ...] sequences, vectorized
+bilinear sampling instead of per-RoI CPU loops, grads via the generic vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.registry import register_op
+from .nn import _batch_norm_impl, _ACTS
+
+
+@register_op("lstm", diff_inputs=("Input", "Weight", "Bias", "H0", "C0"))
+def lstm(ctx, op, ins):
+    """operators/lstm_op.cc on padded sequences. Input [B, T, 4D]
+    pre-projected gates in the reference layout (c, i, f, o)
+    (math/detail/lstm_kernel.h:30 value_in/ig/fg/og); Weight [D, 4D]
+    recurrent; Bias [1, 4D] (+[1, 7D] with use_peepholes: checkI/F/O)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    D = w.shape[0]
+    B, T = x.shape[0], x.shape[1]
+    peep = bool(op.attr("use_peepholes", True))
+    bias = ins["Bias"][0].reshape(1, -1) if ins.get("Bias") else None
+    if bias is not None and peep and bias.shape[1] >= 7 * D:
+        b_g = bias[:, :4 * D]
+        ck_i = bias[:, 4 * D:5 * D]
+        ck_f = bias[:, 5 * D:6 * D]
+        ck_o = bias[:, 6 * D:7 * D]
+    else:
+        b_g = bias if bias is not None else 0.0
+        ck_i = ck_f = ck_o = 0.0
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    act_g = acts[op.attr("gate_activation", "sigmoid")]
+    act_c = acts[op.attr("candidate_activation", "tanh")]
+    act_h = acts[op.attr("cell_activation", "tanh")]
+
+    def step(carry, xt):
+        h_p, c_p = carry
+        g = xt + h_p @ w + b_g
+        c_in = act_c(g[:, :D])
+        i = act_g(g[:, D:2 * D] + c_p * ck_i)
+        f = act_g(g[:, 2 * D:3 * D] + c_p * ck_f)
+        c = c_in * i + c_p * f
+        o = act_g(g[:, 3 * D:] + c * ck_o)
+        h = o * act_h(c)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), jnp.moveaxis(x, 1, 0))
+    hidden = jnp.moveaxis(hs, 0, 1)
+    cell = jnp.moveaxis(cs, 0, 1)
+    if op.attr("is_reverse", False):
+        hidden = hidden[:, ::-1]
+        cell = cell[:, ::-1]
+    return {"Hidden": hidden, "Cell": cell,
+            "BatchGate": None, "BatchCellPreAct": None}
+
+
+@register_op("gru", diff_inputs=("Input", "Weight", "Bias", "H0"))
+def gru(ctx, op, ins):
+    """operators/gru_op.cc on padded sequences: Input [B, T, 3D] gates
+    (u, r, c layout per gru_unit_op.h), Weight [D, 3D], H0 [B, D]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    D = w.shape[0]
+    B = x.shape[0]
+    bias = ins["Bias"][0].reshape(1, -1) if ins.get("Bias") else 0.0
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    act_g = acts[op.attr("gate_activation", "sigmoid")]
+    act_c = acts[op.attr("activation", "tanh")]
+    origin = bool(op.attr("origin_mode", False))
+
+    def step(h_p, xt):
+        g = xt + bias
+        ur = g[:, :2 * D] + h_p @ w[:, :2 * D]
+        u = act_g(ur[:, :D])
+        r = act_g(ur[:, D:])
+        c = act_c(g[:, 2 * D:] + (r * h_p) @ w[:, 2 * D:])
+        h = c + u * (h_p - c) if origin else u * (c - h_p) + h_p
+        return h, h
+
+    xs = jnp.moveaxis(x, 1, 0)
+    if op.attr("is_reverse", False):
+        xs = xs[::-1]
+    _, hs = lax.scan(step, h0, xs)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    if op.attr("is_reverse", False):
+        hidden = hidden[:, ::-1]
+    return {"Hidden": hidden, "BatchGate": None,
+            "BatchResetHiddenPrev": None, "BatchHidden": None}
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_sample_nchw(x, py, px):
+    """x [C, H, W]; py/px [...] fractional coords -> [C, ...]. Out-of-range
+    samples are zero (deformable_conv_op.h DmcnIm2colBilinear)."""
+    H, W = x.shape[1], x.shape[2]
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = py - y0
+    wx1 = px - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yi = y0 + dy
+            xi = x0 + dx
+            valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            out = out + jnp.where(valid, wy * wx, 0.0)[None] * x[:, yc, xc]
+    return out
+
+
+def _deformable_conv_impl(ctx, op, ins, with_mask):
+    """operators/deformable_conv_op.cc (v2, modulated) / _v1: sample the
+    input at offset-shifted tap positions (bilinear, zero outside), then a
+    plain matmul with the filter — im2col with learned geometry."""
+    x = ins["Input"][0]                          # [N, Cin, H, W]
+    offset = ins["Offset"][0]                    # [N, 2*dg*kh*kw, Ho, Wo]
+    w = ins["Filter"][0]                         # [Cout, Cin/g, kh, kw]
+    mask = ins["Mask"][0] if (with_mask and ins.get("Mask")) else None
+    strides = [int(s) for s in op.attr("strides", [1, 1])]
+    pads = [int(p) for p in op.attr("paddings", [0, 0])]
+    dils = [int(d) for d in op.attr("dilations", [1, 1])]
+    groups = int(op.attr("groups", 1) or 1)
+    dg = int(op.attr("deformable_groups", 1) or 1)
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = w.shape
+    Ho = (H + 2 * pads[0] - (dils[0] * (kh - 1) + 1)) // strides[0] + 1
+    Wo = (W + 2 * pads[1] - (dils[1] * (kw - 1) + 1)) // strides[1] + 1
+
+    oy = jnp.arange(Ho) * strides[0] - pads[0]
+    ox = jnp.arange(Wo) * strides[1] - pads[1]
+    off = offset.reshape(N, dg, kh * kw, 2, Ho, Wo)
+    cpg = Cin // dg                                # channels per deform group
+
+    def one_image(xi, offi, maski):
+        cols = []
+        for g_ in range(dg):
+            xg = xi[g_ * cpg:(g_ + 1) * cpg]
+            taps = []
+            for ki in range(kh):
+                for kj in range(kw):
+                    t = ki * kw + kj
+                    py = (oy[:, None] + ki * dils[0]
+                          + offi[g_, t, 0])                  # [Ho, Wo]
+                    px = (ox[None, :] + kj * dils[1]
+                          + offi[g_, t, 1])
+                    s = _bilinear_sample_nchw(xg, py, px)    # [cpg, Ho, Wo]
+                    if maski is not None:
+                        s = s * maski[g_ * (kh * kw) + t][None]
+                    taps.append(s)
+            cols.append(jnp.stack(taps, axis=1))   # [cpg, kh*kw, Ho, Wo]
+        return jnp.concatenate(cols, axis=0)       # [Cin, kh*kw, Ho, Wo]
+
+    if mask is not None:
+        col = jax.vmap(one_image)(x, off, mask)
+    else:
+        col = jax.vmap(lambda a, b: one_image(a, b, None))(x, off)
+    # col [N, Cin, kh*kw, Ho, Wo] x w [Cout, Cin/g, kh, kw]
+    wg = w.reshape(groups, Cout // groups, Cin // groups, kh * kw)
+    colg = col.reshape(N, groups, Cin // groups, kh * kw, Ho, Wo)
+    out = jnp.einsum("ngckhw,gock->ngohw", colg, wg)
+    return {"Output": out.reshape(N, Cout, Ho, Wo)}
+
+
+@register_op("deformable_conv", diff_inputs=("Input", "Offset", "Mask",
+                                             "Filter"))
+def deformable_conv(ctx, op, ins):
+    return _deformable_conv_impl(ctx, op, ins, with_mask=True)
+
+
+@register_op("deformable_conv_v1", diff_inputs=("Input", "Offset", "Filter"))
+def deformable_conv_v1(ctx, op, ins):
+    return _deformable_conv_impl(ctx, op, ins, with_mask=False)
+
+
+# ---------------------------------------------------------------------------
+# RoI pooling variants
+# ---------------------------------------------------------------------------
+
+
+@register_op("psroi_pool", diff_inputs=("X",))
+def psroi_pool(ctx, op, ins):
+    """operators/psroi_pool_op.cc: position-sensitive RoI average pooling —
+    input channel layout [out_ch * ph * pw], each output bin averages its
+    OWN channel slice over the bin region. Rois [R, 4] + RoisBatch [R]."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    out_ch = int(op.attr("output_channels"))
+    ph = int(op.attr("pooled_height"))
+    pw = int(op.attr("pooled_width"))
+    scale = float(op.attr("spatial_scale", 1.0))
+    if ins.get("RoisBatch"):
+        rb = ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+    else:
+        rb = jnp.zeros((rois.shape[0],), jnp.int32)
+    N, C, H, W = x.shape
+
+    hw = jnp.arange(H, dtype=jnp.float32)
+    ww = jnp.arange(W, dtype=jnp.float32)
+
+    def one(roi, b):
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1) * scale
+        y2 = (jnp.round(roi[3]) + 1) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        img = x[b]
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                hs = jnp.floor(y1 + i * bh)
+                he = jnp.ceil(y1 + (i + 1) * bh)
+                ws_ = jnp.floor(x1 + j * bw)
+                we = jnp.ceil(x1 + (j + 1) * bw)
+                m = ((hw[:, None] >= hs) & (hw[:, None] < he)
+                     & (ww[None, :] >= ws_) & (ww[None, :] < we))
+                cnt = jnp.maximum(jnp.sum(m), 1.0)
+                # channel slice owning this bin: [out_ch] at (i*pw+j)
+                ch = img.reshape(out_ch, ph * pw, H, W)[:, i * pw + j]
+                outs.append(jnp.sum(ch * m[None], axis=(1, 2)) / cnt)
+        return jnp.stack(outs, 1).reshape(out_ch, ph, pw)
+
+    return {"Out": jax.vmap(one)(rois, rb)}
+
+
+@register_op("prroi_pool", diff_inputs=("X",))
+def prroi_pool(ctx, op, ins):
+    """operators/prroi_pool_op.cc (Precise RoI Pooling): continuous average
+    of the bilinear interpolant over each bin. Computed by dense sub-pixel
+    sampling (4x4 per cell span) — converges to the exact integral and
+    keeps the op one fused gather/sum on device."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph = int(op.attr("pooled_height"))
+    pw = int(op.attr("pooled_width"))
+    scale = float(op.attr("spatial_scale", 1.0))
+    if ins.get("RoisBatch"):
+        rb = ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+    else:
+        rb = jnp.zeros((rois.shape[0],), jnp.int32)
+    S = 4  # sub-samples per bin axis
+
+    def one(roi, b):
+        x1, y1, x2, y2 = roi[0] * scale, roi[1] * scale, \
+            roi[2] * scale, roi[3] * scale
+        bw = jnp.maximum((x2 - x1) / pw, 1e-6)
+        bh = jnp.maximum((y2 - y1) / ph, 1e-6)
+        iy = y1 + (jnp.arange(ph)[:, None, None, None] * bh
+                   + (jnp.arange(S)[None, None, :, None] + 0.5) * bh / S)
+        ix = x1 + (jnp.arange(pw)[None, :, None, None] * bw
+                   + (jnp.arange(S)[None, None, None, :] + 0.5) * bw / S)
+        py = jnp.broadcast_to(iy, (ph, pw, S, S))
+        px = jnp.broadcast_to(ix, (ph, pw, S, S))
+        s = _bilinear_sample_nchw(x[b], py, px)      # [C, ph, pw, S, S]
+        return jnp.mean(s, axis=(3, 4))
+
+    return {"Out": jax.vmap(one)(rois, rb)}
+
+
+@register_op("inplace_abn", diff_inputs=("X", "Scale", "Bias"))
+def inplace_abn(ctx, op, ins):
+    """operators/inplace_abn_op.cc: batch norm + activation in one op (the
+    in-place memory trick is XLA's job — donation/fusion)."""
+    out = _batch_norm_impl(ctx, op, ins)
+    act = op.attr("activation", "identity")
+    if act and act not in ("identity", ""):
+        if act == "leaky_relu":
+            alpha = float(op.attr("alpha", 0.01))
+            out["Y"] = jax.nn.leaky_relu(out["Y"], negative_slope=alpha)
+        elif act == "elu":
+            out["Y"] = jax.nn.elu(out["Y"], alpha=float(op.attr("alpha", 1.0)))
+        else:
+            out["Y"] = _ACTS[act](out["Y"])
+    return out

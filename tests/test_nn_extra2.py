@@ -1,0 +1,206 @@
+"""Op batch 3: lstm/gru full-sequence, deformable conv, psroi/prroi pool,
+inplace_abn — numpy oracles per reference kernels."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+from op_test import OpTest
+
+
+def _sig(v):
+    return 1 / (1 + np.exp(-v))
+
+
+class TestLstmOp(OpTest):
+    op_type = "lstm"
+
+    def setup(self):
+        rng = np.random.default_rng(0)
+        B, T, D = 2, 4, 3
+        x = rng.standard_normal((B, T, 4 * D)).astype("float32")
+        w = (rng.standard_normal((D, 4 * D)) * 0.4).astype("float32")
+        b = (rng.standard_normal((1, 7 * D)) * 0.1).astype("float32")
+        self.inputs = {"Input": x, "Weight": w, "Bias": b}
+        self.attrs = {"use_peepholes": True, "gate_activation": "sigmoid",
+                      "cell_activation": "tanh",
+                      "candidate_activation": "tanh", "is_reverse": False}
+        h = np.zeros((B, D), "float32")
+        c = np.zeros((B, D), "float32")
+        hs, cs = [], []
+        ckI, ckF, ckO = b[0, 4*D:5*D], b[0, 5*D:6*D], b[0, 6*D:7*D]
+        for t in range(T):
+            g = x[:, t] + h @ w + b[:, :4 * D]
+            cin = np.tanh(g[:, :D])
+            i = _sig(g[:, D:2*D] + c * ckI)
+            f = _sig(g[:, 2*D:3*D] + c * ckF)
+            c = cin * i + c * f
+            o = _sig(g[:, 3*D:] + c * ckO)
+            h = o * np.tanh(c)
+            hs.append(h.copy()); cs.append(c.copy())
+        self.outputs = {"Hidden": np.stack(hs, 1).astype("float32"),
+                        "Cell": np.stack(cs, 1).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.setup()
+        self.outputs = {"Hidden": self.outputs["Hidden"]}
+        self.check_grad(["Input", "Weight"], "Hidden",
+                        max_relative_error=0.1)
+
+
+class TestGruOp(OpTest):
+    op_type = "gru"
+
+    def setup(self):
+        rng = np.random.default_rng(1)
+        B, T, D = 2, 3, 4
+        x = rng.standard_normal((B, T, 3 * D)).astype("float32")
+        w = (rng.standard_normal((D, 3 * D)) * 0.4).astype("float32")
+        h0 = rng.standard_normal((B, D)).astype("float32")
+        self.inputs = {"Input": x, "Weight": w, "H0": h0}
+        self.attrs = {"gate_activation": "sigmoid", "activation": "tanh",
+                      "origin_mode": False, "is_reverse": False}
+        h = h0.copy()
+        hs = []
+        for t in range(T):
+            g = x[:, t]
+            ur = g[:, :2*D] + h @ w[:, :2*D]
+            u, r = _sig(ur[:, :D]), _sig(ur[:, D:])
+            c = np.tanh(g[:, 2*D:] + (r * h) @ w[:, 2*D:])
+            h = u * (c - h) + h
+            hs.append(h.copy())
+        self.outputs = {"Hidden": np.stack(hs, 1).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestDeformableConvIdentityOffset(OpTest):
+    """Zero offsets + all-ones mask == plain convolution."""
+    op_type = "deformable_conv"
+
+    def setup(self):
+        rng = np.random.default_rng(2)
+        N, C, H, W = 1, 2, 5, 5
+        kh = kw = 3
+        Cout = 3
+        x = rng.standard_normal((N, C, H, W)).astype("float32")
+        w = (rng.standard_normal((Cout, C, kh, kw)) * 0.5).astype("float32")
+        offset = np.zeros((N, 2 * kh * kw, H, W), "float32")
+        mask = np.ones((N, kh * kw, H, W), "float32")
+        self.inputs = {"Input": x, "Offset": offset, "Mask": mask,
+                       "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1}
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((N, Cout, H, W), "float32")
+        for co in range(Cout):
+            for ci in range(C):
+                for i in range(H):
+                    for j in range(W):
+                        out[0, co, i, j] += np.sum(
+                            xp[0, ci, i:i+3, j:j+3] * w[co, ci])
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.1, eps=2e-3)
+
+
+class TestDeformableConvHalfPixelShift(OpTest):
+    """Constant offset (0, 0.5) on a linear ramp == average of neighbors."""
+    op_type = "deformable_conv_v1"
+
+    def setup(self):
+        N, C, H, W = 1, 1, 4, 6
+        x = np.tile(np.arange(W, dtype="float32"), (H, 1))[None, None]
+        w = np.ones((1, 1, 1, 1), "float32")
+        offset = np.zeros((N, 2, H, W), "float32")
+        offset[:, 1] = 0.5  # x-shift half pixel
+        self.inputs = {"Input": x, "Offset": offset, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 1}
+        out = x + 0.5
+        out[:, :, :, -1] = x[:, :, :, -1] * 0.5  # half outside -> zero pad
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestPsroiPool(OpTest):
+    op_type = "psroi_pool"
+
+    def setup(self):
+        out_ch, ph, pw = 2, 2, 2
+        C = out_ch * ph * pw
+        H = W = 4
+        x = np.zeros((1, C, H, W), "float32")
+        for c in range(C):
+            x[0, c] = c + 1  # constant per channel
+        rois = np.array([[0, 0, 3, 3]], "float32")
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"output_channels": out_ch, "pooled_height": ph,
+                      "pooled_width": pw, "spatial_scale": 1.0}
+        # bin (i,j) of out-channel o averages channel o*ph*pw + i*pw + j,
+        # which is constant -> out[o,i,j] = that constant
+        out = np.zeros((1, out_ch, ph, pw), "float32")
+        for o in range(out_ch):
+            for i in range(ph):
+                for j in range(pw):
+                    out[0, o, i, j] = o * ph * pw + i * pw + j + 1
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestPrroiPool(OpTest):
+    op_type = "prroi_pool"
+
+    def setup(self):
+        # constant image -> every bin averages to the constant
+        x = np.full((1, 3, 6, 6), 2.5, "float32")
+        rois = np.array([[1.0, 1.0, 5.0, 5.0]], "float32")
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        self.outputs = {"Out": np.full((1, 3, 2, 2), 2.5, "float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+def test_inplace_abn_matches_bn_plus_act():
+    rng = np.random.default_rng(3)
+    x_np = rng.standard_normal((4, 3, 2, 2)).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3, 2, 2], dtype="float32")
+        bn = fluid.layers.batch_norm(x, is_test=False)
+        ref = fluid.layers.leaky_relu(bn, alpha=0.2)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main2, startup2):
+        x2 = fluid.layers.data("x", [3, 2, 2], dtype="float32")
+        bn2 = fluid.layers.batch_norm(x2, is_test=False)
+        for op in main2.global_block().ops:
+            if op.type == "batch_norm":
+                op.type = "inplace_abn"
+                op.attrs["activation"] = "leaky_relu"
+                op.attrs["alpha"] = 0.2
+    exe1, exe2 = fluid.Executor(fluid.CPUPlace()), \
+        fluid.Executor(fluid.CPUPlace())
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    exe1.run(startup, scope=s1)
+    exe2.run(startup2, scope=s2)
+    (a,) = exe1.run(main, feed={"x": x_np}, fetch_list=[ref], scope=s1)
+    (b,) = exe2.run(main2, feed={"x": x_np}, fetch_list=[bn2], scope=s2)
+    np.testing.assert_allclose(a, b, atol=1e-5)
