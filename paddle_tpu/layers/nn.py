@@ -23,7 +23,7 @@ __all__ = [
     "square_error_cost", "matmul", "mul", "topk", "accuracy", "one_hot",
     "label_smooth", "pad", "pad2d", "resize_nearest", "resize_bilinear",
     "l2_normalize", "clip", "clip_by_norm", "mean", "pow", "unfold",
-    "continuous_value_model", "data_norm", "nce",
+    "continuous_value_model", "data_norm", "nce", "py_func",
     "sampled_softmax_with_cross_entropy", "shuffle_batch",
 ]
 
@@ -898,4 +898,22 @@ def shuffle_batch(x, seed=None):
                      outputs={"Out": [out], "ShuffleIdx": [shuffle_idx],
                               "SeedOut": [seed_out]},
                      attrs=attrs)
+    return out
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """fluid.layers.py_func (layers/nn.py:13375): run arbitrary Python
+    between device segments (host-op). backward_func is accepted for API
+    parity; py_func outputs are treated as non-differentiable here (the
+    dominant reference use: metrics/logging/data munging)."""
+    from ..ops.misc_extra import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    handle = register_py_func(func)
+    helper.append_op(type="py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)},
+                     attrs={"forward_callable_id": handle})
     return out

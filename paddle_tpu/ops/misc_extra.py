@@ -1,0 +1,153 @@
+"""Long-tail op batch 4: py_func, coalesce_tensor, SelectedRows shims, and
+a faithful XXH64 hash op.
+
+SelectedRows note: this framework's gradients are always dense (XLA
+scatter-add replaces the reference's sparse SelectedRows grads — SURVEY
+§2.6), so merge_selected_rows / get_tensor_from_selected_rows reduce to
+identities on the dense values; they are registered so reference programs
+(GradientClipByGlobalNorm over sparse grads et al.) load and run.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.executor import register_host_op
+from ..framework.registry import register_op
+
+# ---------------------------------------------------------------------------
+# py_func — user Python in the program (operators/py_func_op.cc keeps a
+# registry of callables indexed by the op's handle attr; same design here)
+# ---------------------------------------------------------------------------
+
+_PY_FUNCS: Dict[int, Callable] = {}
+
+
+def register_py_func(fn: Callable) -> int:
+    handle = len(_PY_FUNCS)
+    _PY_FUNCS[handle] = fn
+    return handle
+
+
+@register_host_op("py_func")
+def py_func(scope, op, exe):
+    fn = _PY_FUNCS[int(op.attr("forward_callable_id"))]
+    args = [np.asarray(scope.find_var(n)) for n in op.input("X")]
+    outs = fn(*args)
+    if outs is None:
+        outs = []
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for name, val in zip(op.output("Out"), outs):
+        scope.set_var(name, jnp.asarray(np.asarray(val)))
+
+
+# ---------------------------------------------------------------------------
+# coalesce_tensor — the reference fuses grad buffers into one slab for one
+# big allreduce (coalesce_tensor_op.cc). XLA already fuses collectives; the
+# op keeps program parity: FusedOutput = flat concat, Output = inputs.
+# ---------------------------------------------------------------------------
+
+
+@register_op("coalesce_tensor", grad=None)
+def coalesce_tensor(ctx, op, ins):
+    xs = ins["Input"]
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    return {"FusedOutput": flat, "Output": list(xs)}
+
+
+@register_op("merge_selected_rows", grad=None)
+def merge_selected_rows(ctx, op, ins):
+    """merge_selected_rows_op.cc sums duplicate sparse rows; dense grads
+    have no duplicates — identity."""
+    return {"Out": ins["X"][0]}
+
+
+@register_op("get_tensor_from_selected_rows", grad=None)
+def get_tensor_from_selected_rows(ctx, op, ins):
+    """get_tensor_from_selected_rows_op.cc — dense values pass through."""
+    return {"Out": ins["X"][0]}
+
+
+# ---------------------------------------------------------------------------
+# hash — XXH64(input_row_bytes, seed=ihash) % mod_by (operators/hash_op.h:62)
+# ---------------------------------------------------------------------------
+
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+_M = (1 << 64) - 1
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M
+
+
+def _round(acc, lane):
+    acc = (acc + lane * _P2) & _M
+    return (_rotl(acc, 31) * _P1) & _M
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """Reference-faithful XXH64 (xxhash.c); pure python, host-op only."""
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + _P1 + _P2) & _M
+        v2 = (seed + _P2) & _M
+        v3 = seed & _M
+        v4 = (seed - _P1) & _M
+        while i <= n - 32:
+            lanes = struct.unpack_from("<4Q", data, i)
+            v1 = _round(v1, lanes[0])
+            v2 = _round(v2, lanes[1])
+            v3 = _round(v3, lanes[2])
+            v4 = _round(v4, lanes[3])
+            i += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+             + _rotl(v4, 18)) & _M
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ _round(0, v)) * _P1 + _P4) & _M
+    else:
+        h = (seed + _P5) & _M
+    h = (h + n) & _M
+    while i <= n - 8:
+        (k,) = struct.unpack_from("<Q", data, i)
+        h = ((_rotl(h ^ _round(0, k), 27) * _P1) + _P4) & _M
+        i += 8
+    if i <= n - 4:
+        (k,) = struct.unpack_from("<I", data, i)
+        h = ((_rotl(h ^ (k * _P1) & _M, 23) * _P2) + _P3) & _M
+        i += 4
+    while i < n:
+        h = ((_rotl(h ^ (data[i] * _P5) & _M, 11)) * _P1) & _M
+        i += 1
+    h ^= h >> 33
+    h = (h * _P2) & _M
+    h ^= h >> 29
+    h = (h * _P3) & _M
+    h ^= h >> 32
+    return h
+
+
+@register_host_op("hash")
+def hash_op(scope, op, exe):
+    """operators/hash_op.h: per input row of ids, num_hash bucket values
+    XXH64(row_bytes, seed=ihash) % mod_by."""
+    x = np.asarray(scope.find_var(op.input("X")[0]))
+    mod_by = int(op.attr("mod_by"))
+    num_hash = int(op.attr("num_hash", 1))
+    rows = x.reshape(-1, x.shape[-1]).astype(np.int64)
+    out = np.empty((rows.shape[0], num_hash), np.int64)
+    for r, row in enumerate(rows):
+        data = row.tobytes()
+        for ih in range(num_hash):
+            out[r, ih] = xxh64(data, ih) % mod_by
+    scope.set_var(op.output("Out")[0],
+                  jnp.asarray(out.reshape(x.shape[:-1] + (num_hash,))))

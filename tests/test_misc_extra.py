@@ -1,0 +1,84 @@
+"""Op batch 4: py_func, coalesce_tensor, SelectedRows shims, XXH64 hash."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.misc_extra import xxh64
+
+
+def test_xxh64_official_vectors():
+    assert xxh64(b"") == 0xEF46DB3751D8E999
+    assert xxh64(b"a") == 0xD24EC4F1A98C6E5B
+    assert xxh64(b"abc") == 0x44BC2CF5AD770999
+    assert xxh64(b"a", seed=1) != xxh64(b"a")
+
+
+def test_hash_op_buckets():
+    main = fluid.Program()
+    block = main.global_block()
+    import jax.numpy as jnp
+    scope = fluid.Scope()
+    ids = np.array([[3, 7], [3, 7], [9, 1]], dtype="int64")
+    block.create_var(name="ids", shape=[3, 2], dtype="int64", is_data=True)
+    scope.set_var("ids", jnp.asarray(ids))
+    block.create_var(name="h", shape=[3, 4], dtype="int64")
+    block.append_op(type="hash", inputs={"X": ["ids"]},
+                    outputs={"Out": ["h"]},
+                    attrs={"mod_by": 1000, "num_hash": 4})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (h,) = exe.run(main, feed={}, fetch_list=["h"], scope=scope)
+    assert h.shape == (3, 4)
+    np.testing.assert_array_equal(h[0], h[1])      # same row, same buckets
+    assert not np.array_equal(h[0], h[2])
+    assert (h >= 0).all() and (h < 1000).all()
+    # oracle: first bucket of row0 = XXH64(bytes of [3, 7], seed 0) % 1000
+    want = xxh64(ids[0].tobytes(), 0) % 1000
+    assert int(h[0, 0]) == want
+
+
+def test_py_func_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        block = main.global_block()
+        out = block.create_var(name="pf_out", shape=[-1, 3],
+                               dtype="float32")
+        fluid.layers.py_func(lambda a: a * 2 + 1, x, out)
+        y = fluid.layers.scale(out, scale=10.0) if hasattr(
+            fluid.layers, "scale") else out
+    exe = fluid.Executor(fluid.CPUPlace())
+    x_np = np.ones((2, 3), "float32")
+    res = exe.run(main, feed={"x": x_np},
+                  fetch_list=[y if not isinstance(y, str) else "pf_out"])
+    np.testing.assert_allclose(np.asarray(res[0]),
+                               (x_np * 2 + 1) * 10.0)
+
+
+def test_coalesce_and_selected_rows_shims():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", [2], dtype="float32")
+        b = fluid.layers.data("b", [3], dtype="float32")
+        block = main.global_block()
+        fused = block.create_var(name="fused", shape=[-1], dtype="float32")
+        oa = block.create_var(name="oa", shape=[-1, 2], dtype="float32")
+        ob = block.create_var(name="ob", shape=[-1, 3], dtype="float32")
+        block.append_op(type="coalesce_tensor",
+                        inputs={"Input": [a, b]},
+                        outputs={"FusedOutput": [fused],
+                                 "Output": [oa, ob]},
+                        attrs={})
+        m = block.create_var(name="m", shape=[-1, 2], dtype="float32")
+        block.append_op(type="merge_selected_rows", inputs={"X": [oa]},
+                        outputs={"Out": [m]}, attrs={})
+        g = block.create_var(name="g", shape=[-1, 2], dtype="float32")
+        block.append_op(type="get_tensor_from_selected_rows",
+                        inputs={"X": [m]}, outputs={"Out": [g]}, attrs={})
+    exe = fluid.Executor(fluid.CPUPlace())
+    a_np = np.ones((2, 2), "float32")
+    b_np = np.full((2, 3), 2.0, "float32")
+    f_v, g_v = exe.run(main, feed={"a": a_np, "b": b_np},
+                       fetch_list=["fused", "g"])
+    assert f_v.shape == (10,)
+    np.testing.assert_allclose(np.sort(f_v), np.sort(
+        np.concatenate([a_np.ravel(), b_np.ravel()])))
+    np.testing.assert_allclose(g_v, a_np)
