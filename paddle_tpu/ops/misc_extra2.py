@@ -1,0 +1,174 @@
+"""Long-tail op batch 5: multihead_matmul, DGC encode ops, sequence
+reshape/scatter, trainer-id select, selected-rows split.
+
+DGC note: the reference's EncodeGrad is a packed [2k] (index, value) buffer
+for its custom allgather. On a static-shape device program the natural
+encoding is the masked dense tensor (exactly what the existing
+DGCMomentumOptimizer allreduces); EncodeGrad here is that masked tensor and
+``k`` is emitted for parity/telemetry.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import int_index_dtype
+from ..framework.registry import register_op
+
+_I64 = int_index_dtype()
+
+
+@register_op("multihead_matmul", diff_inputs=("Input", "W", "Bias"))
+def multihead_matmul(ctx, op, ins):
+    """operators/fused/multihead_matmul_op.cc: fused QKV projection +
+    scaled-dot attention. Input [B, S, H]; W [H, 3, nh, hd]; Bias
+    [3, nh, hd]; BiasQK optional [B, nh, S, S] additive mask."""
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    bias = ins["Bias"][0]
+    nh = int(op.attr("head_number"))
+    alpha = float(op.attr("alpha", 1.0))
+    B, S, H = x.shape
+    hd = H // nh
+    w = w.reshape(H, 3, nh, hd)
+    b = bias.reshape(3, nh, hd)
+    qkv = jnp.einsum("bsh,hcnd->bcnsd", x, w) + b[None, :, :, None, :]
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, nh, S, hd]
+    logits = jnp.einsum("bnsd,bntd->bnst", q, k) * alpha
+    if ins.get("BiasQK"):
+        logits = logits + ins["BiasQK"][0]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bnst,bntd->bsnd", probs.astype(v.dtype), v)
+    return {"Out": out.reshape(B, S, H)}
+
+
+@register_op("ref_by_trainer_id", grad=None)
+def ref_by_trainer_id(ctx, op, ins):
+    """operators/distributed_ops/ref_by_trainer_id_op.cc: select
+    X[trainer_id]."""
+    tid = ins["TrainerId"][0].reshape(()).astype(jnp.int32)
+    xs = jnp.stack(ins["X"], axis=0)
+    return {"Out": lax.dynamic_index_in_dim(xs, tid, 0, keepdims=False)}
+
+
+@register_op("sequence_reshape", diff_inputs=("X",))
+def sequence_reshape(ctx, op, ins):
+    """sequence_ops/sequence_reshape_op.cc: re-chunk the feature dim —
+    padded [B, T, D] -> [B, T*D/new_dim, new_dim]; Length scales by
+    D/new_dim."""
+    x = ins["X"][0]
+    new_dim = int(op.attr("new_dim"))
+    B, T, D = x.shape
+    out = x.reshape(B, T * D // new_dim, new_dim)
+    outs = {"Out": out}
+    if ins.get("Length"):
+        ln = ins["Length"][0]
+        outs["Length"] = (ln * D) // new_dim
+    return outs
+
+
+@register_op("sequence_scatter", diff_inputs=("X", "Updates"))
+def sequence_scatter(ctx, op, ins):
+    """sequence_ops/sequence_scatter_op.cc: Out = X; per batch row b,
+    Out[b, ids[b, j]] += updates[b, j] (padded ids with -1 dropped)."""
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    B = x.shape[0]
+    b_idx = jnp.arange(B)[:, None]
+    safe = jnp.where(ids >= 0, ids, x.shape[1])    # OOB -> dropped
+    return {"Out": x.at[b_idx, safe].add(
+        jnp.where((ids >= 0), upd, 0.0), mode="drop")}
+
+
+@register_op("split_selected_rows", grad=None)
+def split_selected_rows(ctx, op, ins):
+    """operators/split_selected_rows_op.cc: split rows by height_sections
+    (dense form: contiguous row ranges)."""
+    x = ins["X"][0]
+    sections = [int(s) for s in op.attr("height_sections")]
+    outs = []
+    off = 0
+    for s in sections:
+        outs.append(x[off:off + s])
+        off += s
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# DGC (deep gradient compression) encode ops — operators/dgc_op.h and
+# dgc_clip_by_norm_op.h; the transport side lives in optimizer.py's
+# DGCMomentumOptimizer (masked allreduce over the dp axis)
+# ---------------------------------------------------------------------------
+
+
+@register_op("dgc", grad=None, is_optimizer=True)
+def dgc(ctx, op, ins):
+    """dgc_op.h DGCOpKernel: momentum-corrected top-k sparsification.
+    u_out = m*u + g (nesterov: m*(u+g)); v_out = u_out + v (+g nesterov);
+    EncodeGrad = v_out masked to its top-k |values|, v_out keeps the
+    residual. Before rampup_begin_step the op passes grads through."""
+    u = ins["U"][0]
+    v = ins["V"][0]
+    g = ins["Grad"][0]
+    m = float(op.attr("m", 0.9))
+    use_nesterov = bool(op.attr("use_nesterov", False))
+    sparsity = jnp.asarray([float(s) for s in
+                            op.attr("sparsity", [0.999])] or [0.999],
+                           jnp.float32)
+    rampup_begin = float(op.attr("rampup_begin_step", 0.0))
+    rampup_step = float(op.attr("rampup_step", 1.0))
+    if ins.get("current_step"):
+        step = ins["current_step"][0].reshape(()).astype(jnp.float32)
+    else:
+        step = jnp.asarray(rampup_begin, jnp.float32)
+
+    # step is a traced tensor (a persistable counter), so the sparsity
+    # schedule and the top-k cut are computed traced: a quantile threshold
+    # replaces the static-k top_k (get_period_sparcity, dgc_op.h:26)
+    idx = jnp.clip(((step - rampup_begin) * len(sparsity)
+                    / max(rampup_step, 1.0)).astype(jnp.int32),
+                   0, len(sparsity) - 1)
+    sp = jnp.take(sparsity, idx)                  # fraction dropped
+    if use_nesterov:
+        u_out = m * (u + g)
+        v_out = v + u_out + g
+    else:
+        u_out = m * u + g
+        v_out = v + u_out
+    flat = v_out.reshape(-1)
+    thresh = jnp.quantile(jnp.abs(flat).astype(jnp.float32), sp)
+    mask = jnp.abs(flat) >= thresh
+    encode = jnp.where(mask, flat, 0.0).reshape(v_out.shape)
+    residual = jnp.where(mask, 0.0, flat).reshape(v_out.shape)
+    k = jnp.sum(mask).astype(jnp.float32)
+    pre = step < rampup_begin                     # pass-through branch
+    return {
+        "U_out": jnp.where(pre, u, u_out),
+        "V_out": jnp.where(pre, v, residual),
+        "EncodeGrad": jnp.where(pre, g, encode),
+        "Grad_out": jnp.where(pre, g, encode),
+        "k": jnp.where(pre, 0.0, k),
+        "GatherBuff": None,
+    }
+
+
+@register_op("dgc_clip_by_norm", diff_inputs=("X",))
+def dgc_clip_by_norm(ctx, op, ins):
+    """dgc_clip_by_norm_op.h: plain clip_by_norm, but inert until
+    current_step reaches rampup_begin_step."""
+    x = ins["X"][0]
+    max_norm = float(op.attr("max_norm"))
+    rampup_begin = float(op.attr("rampup_begin_step", -1.0))
+    step = float(np.asarray(ins["current_step"][0]).reshape(())) \
+        if ins.get("current_step") else rampup_begin
+    if rampup_begin >= 0 and step < rampup_begin:
+        return {"Out": x}
+    norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0)
+    return {"Out": (x * scale).astype(x.dtype)}

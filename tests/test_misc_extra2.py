@@ -1,0 +1,143 @@
+"""Op batch 5: multihead_matmul, DGC encode, sequence reshape/scatter,
+ref_by_trainer_id, split_selected_rows."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+from op_test import OpTest
+
+
+class TestMultiheadMatmul(OpTest):
+    op_type = "multihead_matmul"
+
+    def setup(self):
+        rng = np.random.default_rng(0)
+        B, S, nh, hd = 2, 4, 2, 3
+        H = nh * hd
+        x = rng.standard_normal((B, S, H)).astype("float32")
+        w = (rng.standard_normal((H, 3, nh, hd)) * 0.5).astype("float32")
+        b = (rng.standard_normal((3, nh, hd)) * 0.1).astype("float32")
+        self.inputs = {"Input": x, "W": w, "Bias": b}
+        alpha = 1.0 / np.sqrt(hd)
+        self.attrs = {"head_number": nh, "alpha": float(alpha)}
+        qkv = np.einsum("bsh,hcnd->bcnsd", x, w) + b[None, :, :, None, :]
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        logits = np.einsum("bnsd,bntd->bnst", q, k) * alpha
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        out = np.einsum("bnst,bntd->bsnd", p, v).reshape(B, S, H)
+        self.outputs = {"Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "W"], "Out", max_relative_error=0.1,
+                        eps=2e-3)
+
+
+class TestSequenceReshape(OpTest):
+    op_type = "sequence_reshape"
+
+    def setup(self):
+        x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"new_dim": 2}
+        self.outputs = {"Out": x.reshape(2, 6, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSequenceScatter(OpTest):
+    op_type = "sequence_scatter"
+
+    def setup(self):
+        x = np.zeros((2, 5), "float32")
+        ids = np.array([[1, 3, -1], [0, 0, 4]], dtype="int64")
+        upd = np.array([[1., 2., 9.], [3., 4., 5.]], dtype="float32")
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.attrs = {}
+        out = x.copy()
+        out[0, 1] += 1; out[0, 3] += 2
+        out[1, 0] += 7; out[1, 4] += 5   # duplicate ids accumulate
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+def test_ref_by_trainer_id():
+    main = fluid.Program()
+    block = main.global_block()
+    import jax.numpy as jnp
+    scope = fluid.Scope()
+    feed = {}
+    for i, name in enumerate(["t0", "t1", "t2"]):
+        block.create_var(name=name, shape=[2], dtype="float32", is_data=True)
+        feed[name] = np.full((2,), float(i), "float32")
+    block.create_var(name="tid", shape=[1], dtype="int64", is_data=True)
+    feed["tid"] = np.asarray([2], "int64")
+    block.create_var(name="out", shape=[2], dtype="float32")
+    block.append_op(type="ref_by_trainer_id",
+                    inputs={"X": ["t0", "t1", "t2"], "TrainerId": ["tid"]},
+                    outputs={"Out": ["out"]}, attrs={})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (v,) = exe.run(main, feed=feed, fetch_list=["out"], scope=scope)
+    np.testing.assert_allclose(v, [2.0, 2.0])
+
+
+def test_dgc_encode_residual():
+    """Top-k selection leaves the residual in V_out; selected mass leaves
+    through EncodeGrad (DGC paper semantics, dgc_op.h)."""
+    main = fluid.Program()
+    block = main.global_block()
+    import jax.numpy as jnp
+    scope = fluid.Scope()
+    g = np.array([0.1, -5.0, 0.2, 4.0, -0.3, 0.05], "float32")
+    feed = {}
+    for name, val in [("u", np.zeros(6, "float32")),
+                      ("v", np.zeros(6, "float32")), ("g", g),
+                      ("p", np.zeros(6, "float32")),
+                      ("step", np.asarray([10.0], "float32"))]:
+        block.create_var(name=name, shape=list(val.shape),
+                         dtype=str(val.dtype), is_data=True)
+        feed[name] = val
+    for name in ["u_out", "v_out", "enc", "g_out", "k"]:
+        block.create_var(name=name, shape=[6], dtype="float32")
+    block.append_op(
+        type="dgc",
+        inputs={"U": ["u"], "V": ["v"], "Grad": ["g"], "Param": ["p"],
+                "current_step": ["step"]},
+        outputs={"U_out": ["u_out"], "V_out": ["v_out"],
+                 "EncodeGrad": ["enc"], "Grad_out": ["g_out"], "k": ["k"]},
+        attrs={"m": 0.9, "use_nesterov": False,
+               "sparsity": [0.666], "rampup_begin_step": 0.0,
+               "rampup_step": 1.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    enc, vout, k = exe.run(main, feed=feed,
+                           fetch_list=["enc", "v_out", "k"], scope=scope)
+    # ratio = 1-0.666 -> k = 2: the two largest |v| entries (-5, 4)
+    assert int(k[()] if k.shape == () else k.ravel()[0]) == 2
+    np.testing.assert_allclose(enc, [0, -5, 0, 4, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(vout, [0.1, 0, 0.2, 0, -0.3, 0.05],
+                               atol=1e-6)
+    np.testing.assert_allclose(enc + vout, g, atol=1e-6)
+
+
+def test_split_selected_rows():
+    main = fluid.Program()
+    block = main.global_block()
+    import jax.numpy as jnp
+    scope = fluid.Scope()
+    x = np.arange(12, dtype="float32").reshape(6, 2)
+    block.create_var(name="x", shape=[6, 2], dtype="float32", is_data=True)
+    block.create_var(name="a", shape=[4, 2], dtype="float32")
+    block.create_var(name="b", shape=[2, 2], dtype="float32")
+    block.append_op(type="split_selected_rows", inputs={"X": ["x"]},
+                    outputs={"Out": ["a", "b"]},
+                    attrs={"height_sections": [4, 2]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    a, b = exe.run(main, feed={"x": x}, fetch_list=["a", "b"], scope=scope)
+    np.testing.assert_allclose(a, x[:4])
+    np.testing.assert_allclose(b, x[4:])
