@@ -14,7 +14,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..framework.core import int_index_dtype
 from ..framework.registry import LowerCtx, register_op, run_lowering
+
+_I64 = int_index_dtype()
 
 
 def _block_reads_writes(block):
