@@ -1,0 +1,261 @@
+"""ERNIE/BERT-style transformer encoder — the second north-star model family
+(BASELINE.md: "ERNIE-base pretraining, >=90% scaling efficiency").
+
+The reference's largest NLP config is the ERNIE/transformer encoder driven
+through fluid layers (python/paddle/fluid/tests/unittests/dist_transformer.py);
+this is the TPU-first re-design in the same style as models/gpt.py:
+
+- per-layer leaves stacked on a leading [num_layers] axis -> the encoder is
+  ONE lax.scan (one compiled block regardless of depth),
+- bidirectional flash attention (the Pallas kernel with causal=False) or
+  plain XLA attention,
+- declared PartitionSpecs over a (dp, tp) mesh: Megatron column/row splits
+  on QKV/FFN, batch over dp — gspmd inserts the collectives,
+- pretraining losses the ERNIE way: masked-LM over gathered mask positions
+  (static max_masked count) + next-sentence prediction.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ErnieConfig:
+    vocab_size: int = 30522
+    type_vocab_size: int = 2
+    max_seq_len: int = 512
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash: bool = False
+    max_masked: int = 20          # MLM positions per sample (static)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.num_heads == 0
+        return self.d_model // self.num_heads
+
+    def scaled(self, **kw) -> "ErnieConfig":
+        return dataclasses.replace(self, **kw)
+
+
+ERNIE_BASE = ErnieConfig()
+ERNIE_TINY = ErnieConfig(vocab_size=256, type_vocab_size=2, max_seq_len=64,
+                         num_layers=2, num_heads=4, d_model=32, d_ff=64,
+                         dtype=jnp.float32, remat=False, max_masked=4)
+
+
+def init_params(key, cfg: ErnieConfig) -> Dict[str, Any]:
+    L, D, F = cfg.num_layers, cfg.d_model, cfg.d_ff
+    nh, hd, V = cfg.num_heads, cfg.head_dim, cfg.vocab_size
+    ks = jax.random.split(key, 10)
+    std = 0.02
+
+    def norm(k, shape, s=std):
+        return (jax.random.normal(k, shape) * s).astype(jnp.float32)
+
+    return {
+        "wte": norm(ks[0], (V, D)),
+        "wpe": norm(ks[1], (cfg.max_seq_len, D)),
+        "wse": norm(ks[2], (cfg.type_vocab_size, D)),
+        "ln_emb_scale": jnp.ones((D,), jnp.float32),
+        "ln_emb_bias": jnp.zeros((D,), jnp.float32),
+        "blocks": {
+            "w_qkv": norm(ks[3], (L, D, 3, nh, hd)),
+            "b_qkv": jnp.zeros((L, 3, nh, hd), jnp.float32),
+            "w_proj": norm(ks[4], (L, nh, hd, D), s=std / math.sqrt(2 * L)),
+            "b_proj": jnp.zeros((L, D), jnp.float32),
+            "ln1_scale": jnp.ones((L, D), jnp.float32),
+            "ln1_bias": jnp.zeros((L, D), jnp.float32),
+            "w_fc": norm(ks[5], (L, D, F)),
+            "b_fc": jnp.zeros((L, F), jnp.float32),
+            "w_out": norm(ks[6], (L, F, D), s=std / math.sqrt(2 * L)),
+            "b_out": jnp.zeros((L, D), jnp.float32),
+            "ln2_scale": jnp.ones((L, D), jnp.float32),
+            "ln2_bias": jnp.zeros((L, D), jnp.float32),
+        },
+        # heads: MLM transform + shared-embedding decoder bias, NSP pooler
+        "mlm_w": norm(ks[7], (D, D)),
+        "mlm_b": jnp.zeros((D,), jnp.float32),
+        "mlm_ln_scale": jnp.ones((D,), jnp.float32),
+        "mlm_ln_bias": jnp.zeros((D,), jnp.float32),
+        "mlm_dec_bias": jnp.zeros((V,), jnp.float32),
+        "pool_w": norm(ks[8], (D, D)),
+        "pool_b": jnp.zeros((D,), jnp.float32),
+        "nsp_w": norm(ks[9], (D, 2)),
+        "nsp_b": jnp.zeros((2,), jnp.float32),
+    }
+
+
+def param_specs(cfg: ErnieConfig, tp: str = "tp") -> Dict[str, Any]:
+    """(dp, tp) mesh: embeddings/heads replicated (vocab matmul batch-bound
+    at base scale), blocks Megatron-split on heads/ffn. Layer axis stays
+    unsharded — ERNIE-base depth fits; pp composes via the GPT engine."""
+    return {
+        "wte": P(), "wpe": P(), "wse": P(),
+        "ln_emb_scale": P(), "ln_emb_bias": P(),
+        "blocks": {
+            "w_qkv": P(None, None, None, tp, None),
+            "b_qkv": P(None, None, tp, None),
+            "w_proj": P(None, tp, None, None),
+            "b_proj": P(None, None),
+            "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+            "w_fc": P(None, None, tp), "b_fc": P(None, tp),
+            "w_out": P(None, tp, None), "b_out": P(None, None),
+            "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+        },
+        "mlm_w": P(), "mlm_b": P(), "mlm_ln_scale": P(), "mlm_ln_bias": P(),
+        "mlm_dec_bias": P(), "pool_w": P(), "pool_b": P(),
+        "nsp_w": P(), "nsp_b": P(),
+    }
+
+
+def _ln(x, scale, bias, eps=1e-12):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(
+        x.dtype)
+
+
+def _attention(q, k, v, pad_mask, cfg: ErnieConfig):
+    """Bidirectional attention with padding mask. q,k,v [B,T,nh,hd]."""
+    if cfg.use_flash and pad_mask is None:
+        from ..ops.pallas_kernels import flash_attention
+
+        return flash_attention(q, k, v, causal=False)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if pad_mask is not None:
+        big_neg = jnp.finfo(jnp.float32).min
+        logits = jnp.where(pad_mask[:, None, None, :], logits, big_neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(p, x, pad_mask, cfg: ErnieConfig):
+    dt = cfg.dtype
+    qkv = jnp.einsum("btd,dcnh->btcnh", x, p["w_qkv"].astype(dt)) \
+        + p["b_qkv"].astype(dt)
+    a = _attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], pad_mask, cfg)
+    o = jnp.einsum("btnh,nhd->btd", a, p["w_proj"].astype(dt)) \
+        + p["b_proj"].astype(dt)
+    x = _ln(x + o, p["ln1_scale"], p["ln1_bias"])      # post-LN (BERT)
+    h = jnp.einsum("btd,df->btf", x, p["w_fc"].astype(dt)) \
+        + p["b_fc"].astype(dt)
+    h = jax.nn.gelu(h, approximate=False)
+    o = jnp.einsum("btf,fd->btd", h, p["w_out"].astype(dt)) \
+        + p["b_out"].astype(dt)
+    return _ln(x + o, p["ln2_scale"], p["ln2_bias"])
+
+
+def encode(params, tokens, seg_ids, pad_mask, cfg: ErnieConfig):
+    """tokens/seg_ids [B, T] -> hidden [B, T, D] (compute dtype)."""
+    T = tokens.shape[1]
+    x = params["wte"][tokens] + params["wpe"][jnp.arange(T)] \
+        + params["wse"][seg_ids]
+    x = _ln(x.astype(cfg.dtype), params["ln_emb_scale"],
+            params["ln_emb_bias"])
+
+    f = _block
+    if cfg.remat:
+        f = jax.checkpoint(_block, static_argnums=(3,))
+
+    def body(h, layer_p):
+        return f(layer_p, h, pad_mask, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return x
+
+
+def pretrain_loss(params, batch, cfg: ErnieConfig):
+    """ERNIE/BERT pretraining: masked-LM over the (static count) masked
+    positions + next-sentence prediction on the pooled [CLS].
+
+    batch: tokens [B,T] (mask token substituted), seg_ids [B,T],
+    pad_mask [B,T] bool, mlm_pos [B,M] int (0-padded), mlm_ids [B,M],
+    mlm_valid [B,M] bool, nsp_label [B]."""
+    h = encode(params, batch["tokens"], batch["seg_ids"],
+               batch["pad_mask"], cfg)
+    B, T, D = h.shape
+    M = batch["mlm_pos"].shape[1]
+    b_idx = jnp.arange(B)[:, None]
+    hm = h[b_idx, batch["mlm_pos"]]                    # [B, M, D]
+    hm = jax.nn.gelu(
+        jnp.einsum("bmd,de->bme", hm, params["mlm_w"].astype(cfg.dtype))
+        + params["mlm_b"].astype(cfg.dtype), approximate=False)
+    hm = _ln(hm, params["mlm_ln_scale"], params["mlm_ln_bias"])
+    logits = jnp.einsum("bmd,vd->bmv", hm,
+                        params["wte"].astype(cfg.dtype)) \
+        + params["mlm_dec_bias"].astype(cfg.dtype)     # tied decoder
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, batch["mlm_ids"][..., None], axis=-1)[..., 0]
+    mlm_ce = jnp.where(batch["mlm_valid"], lse - gold, 0.0)
+    n_masked = jnp.maximum(jnp.sum(batch["mlm_valid"]), 1)
+    mlm_loss = jnp.sum(mlm_ce) / n_masked
+
+    pooled = jnp.tanh(h[:, 0] @ params["pool_w"].astype(cfg.dtype)
+                      + params["pool_b"].astype(cfg.dtype))
+    nsp_logits = (pooled @ params["nsp_w"].astype(cfg.dtype)
+                  + params["nsp_b"].astype(cfg.dtype)).astype(jnp.float32)
+    nsp_lse = jax.nn.logsumexp(nsp_logits, axis=-1)
+    nsp_gold = jnp.take_along_axis(
+        nsp_logits, batch["nsp_label"][:, None], axis=-1)[:, 0]
+    nsp_loss = jnp.mean(nsp_lse - nsp_gold)
+    return mlm_loss + nsp_loss, {"mlm": mlm_loss, "nsp": nsp_loss}
+
+
+def make_pretrain_step(cfg: ErnieConfig, mesh=None, dp: str = "dp",
+                       tp: str = "tp", lr: float = 1e-4):
+    """Jitted pretrain step. With a mesh: params sharded per param_specs,
+    batch over dp; gspmd inserts the tp collectives (the encode einsums
+    contract sharded dims) — no shard_map needed at encoder scale."""
+    from jax.sharding import NamedSharding
+
+    specs = param_specs(cfg, tp=tp)
+
+    def loss_fn(params, batch):
+        return pretrain_loss(params, batch, cfg)[0]
+
+    def step(params, opt, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        m = jax.tree_util.tree_map(
+            lambda mo, g: 0.9 * mo + g.astype(mo.dtype), opt["m"], grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, mo: p - lr * mo.astype(p.dtype), params, m)
+        return new_params, {"m": m}, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    opt_sh = {"m": param_sh}
+    data_sh = NamedSharding(mesh, P(dp))
+    batch_sh = {
+        "tokens": data_sh, "seg_ids": data_sh, "pad_mask": data_sh,
+        "mlm_pos": data_sh, "mlm_ids": data_sh, "mlm_valid": data_sh,
+        "nsp_label": data_sh,
+    }
+    return jax.jit(step, in_shardings=(param_sh, opt_sh, batch_sh),
+                   out_shardings=(param_sh, opt_sh, None),
+                   donate_argnums=(0, 1))
+
+
+def init_opt(params):
+    return {"m": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
