@@ -1,0 +1,191 @@
+"""Detection family completion: on-device multiclass NMS (the static-shape
+variant the host multiclass_nms op cannot be), SSD hard-negative mining,
+box_decoder_and_assign, polygon_box_transform, retinanet_target_assign.
+
+multiclass_nms2 here IS the on-device answer: per-class static_nms
+(sequential in selections, parallel over candidates) + a global keep_top_k
+cut, fixed [keep_top_k, 6] output + count — no device->host->device round
+trip inside an inference graph (contrast ops/detection.py's host
+multiclass_nms, kept for LoD-exact parity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.registry import register_op
+from .detection_train import iou_xyxy, static_nms
+
+
+@register_op("multiclass_nms2", grad=None)
+def multiclass_nms2(ctx, op, ins):
+    """detection/multiclass_nms_op.cc (multiclass_nms2 registration —
+    same kernel + Index output). BBoxes [N, M, 4], Scores [N, C, M].
+    Static outputs: Out [N, keep_top_k, 6] (label, score, x1, y1, x2, y2;
+    -1 rows = padding), Index [N, keep_top_k] flat box index, NmsRoisNum."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    score_thresh = float(op.attr("score_threshold", 0.0))
+    nms_top_k = int(op.attr("nms_top_k", 400))
+    keep_top_k = int(op.attr("keep_top_k", 100))
+    nms_thresh = float(op.attr("nms_threshold", 0.3))
+    background = int(op.attr("background_label", 0))
+    N, C, M = scores.shape
+    nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
+
+    def one_class(boxes, sc):
+        s = jnp.where(sc > score_thresh, sc, -jnp.inf)
+        top_s, top_i = lax.top_k(s, nms_top_k)
+        kidx, kscore = static_nms(boxes[top_i], top_s, nms_thresh,
+                                  nms_top_k)
+        src = jnp.where(kidx >= 0, top_i[jnp.maximum(kidx, 0)], -1)
+        return src, kscore                     # [nms_top_k] each
+
+    def one_image(boxes, sc):
+        srcs, kscores, labels = [], [], []
+        for c in range(C):
+            if c == background:
+                continue
+            src, ks = one_class(boxes, sc[c])
+            srcs.append(src)
+            kscores.append(ks)
+            labels.append(jnp.full(src.shape, c, jnp.int32))
+        src = jnp.concatenate(srcs)
+        ks = jnp.concatenate(kscores)
+        lbl = jnp.concatenate(labels)
+        k = min(keep_top_k, src.shape[0])
+        top_s, top_i = lax.top_k(ks, k)
+        valid = top_s > -jnp.inf
+        src_k = jnp.where(valid, src[top_i], -1)
+        lbl_k = jnp.where(valid, lbl[top_i], -1)
+        rows = jnp.concatenate([
+            lbl_k[:, None].astype(boxes.dtype),
+            jnp.where(valid, top_s, -1.0)[:, None],
+            jnp.where(valid[:, None], boxes[jnp.maximum(src_k, 0)], -1.0),
+        ], axis=1)                              # [k, 6]
+        return rows, src_k, jnp.sum(valid).astype(jnp.int32)
+
+    out, idx, num = jax.vmap(one_image)(bboxes, scores)
+    return {"Out": out, "Index": idx[..., None], "NmsRoisNum": num}
+
+
+@register_op("mine_hard_examples", grad=None)
+def mine_hard_examples(ctx, op, ins):
+    """detection/mine_hard_examples_op.cc (max_negative mode): negatives =
+    unmatched priors under neg_dist_threshold, hardest (largest cls loss)
+    first, capped at neg_pos_ratio * num_pos per image. Static outputs:
+    NegIndices [N, P] (-1 padded), UpdatedMatchIndices."""
+    cls_loss = ins["ClsLoss"][0]                    # [N, P]
+    match = ins["MatchIndices"][0].astype(jnp.int32)
+    dist = ins["MatchDist"][0]
+    loc_loss = ins["LocLoss"][0] if ins.get("LocLoss") else None
+    neg_pos_ratio = float(op.attr("neg_pos_ratio", 3.0))
+    neg_dist_threshold = float(op.attr("neg_dist_threshold", 0.5))
+    mining_type = op.attr("mining_type", "max_negative")
+    loss = cls_loss if loc_loss is None or mining_type == "max_negative" \
+        else cls_loss + loc_loss
+    N, P = cls_loss.shape
+
+    eligible = (match == -1) & (dist < neg_dist_threshold)
+    n_pos = jnp.sum(match >= 0, axis=1)
+    n_neg = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32),
+                        jnp.sum(eligible, axis=1))
+    masked = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1).astype(jnp.int32)  # hardest first
+    rank = jnp.arange(P)[None, :]
+    neg_idx = jnp.where(rank < n_neg[:, None], order, -1)
+    # UpdatedMatchIndices: positives keep their match; everything else -1
+    return {"NegIndices": neg_idx,
+            "UpdatedMatchIndices": jnp.where(match >= 0, match, -1)}
+
+
+@register_op("box_decoder_and_assign", grad=None)
+def box_decoder_and_assign(ctx, op, ins):
+    """detection/box_decoder_and_assign_op.h: decode per-class deltas
+    against PriorBox (+1 extents, var-scaled, dw/dh clipped), then assign
+    each RoI the decoded box of its argmax-score class (background col 0
+    excluded)."""
+    prior = ins["PriorBox"][0]                      # [R, 4]
+    pvar = ins["PriorBoxVar"][0].reshape(-1)        # [4]
+    target = ins["TargetBox"][0]                    # [R, C*4]
+    score = ins["BoxScore"][0]                      # [R, C]
+    clip = float(op.attr("box_clip", 4.135))
+    R, C = score.shape
+    t = target.reshape(R, C, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    dw = jnp.minimum(pvar[2] * t[..., 2], clip)
+    dh = jnp.minimum(pvar[3] * t[..., 3], clip)
+    cx = pvar[0] * t[..., 0] * pw[:, None] + pcx[:, None]
+    cy = pvar[1] * t[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+    best = jnp.argmax(score[:, 1:], axis=1) + 1     # skip background col
+    assign = decoded[jnp.arange(R), best]
+    return {"DecodeBox": decoded.reshape(R, C * 4),
+            "OutputAssignBox": assign}
+
+
+@register_op("polygon_box_transform", grad=None)
+def polygon_box_transform(ctx, op, ins):
+    """detection/polygon_box_transform_op.cc (EAST): even geo channels
+    become id_w*4 - v, odd channels id_h*4 - v."""
+    x = ins["Input"][0]                             # [N, G, H, W]
+    N, G, H, W = x.shape
+    id_w = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    id_h = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(G) % 2 == 0)[None, :, None, None]
+    return {"Output": jnp.where(even, id_w * 4 - x, id_h * 4 - x)}
+
+
+@register_op("retinanet_target_assign", grad=None)
+def retinanet_target_assign(ctx, op, ins):
+    """detection/retinanet_target_assign (rpn_target_assign_op.cc second
+    registration): anchor assignment for focal-loss training — NO negative
+    subsampling (every anchor below negative_overlap is background, labels
+    0..num_classes with -1 = ignore between thresholds). Static outputs
+    over ALL anchors: TargetLabel [N, A], TargetBBox [N, A, 4],
+    BBoxInsideWeight [N, A, 4], ForegroundNumber [N, 1]."""
+    anchors = ins["Anchor"][0]
+    gt = ins["GtBoxes"][0]                          # [N, G, 4]
+    gt_labels = ins["GtLabels"][0].astype(jnp.int32)  # [N, G]
+    pos_ov = float(op.attr("positive_overlap", 0.5))
+    neg_ov = float(op.attr("negative_overlap", 0.4))
+
+    def one(gt_i, lbl_i):
+        valid = (gt_i[:, 2] > gt_i[:, 0]) & (gt_i[:, 3] > gt_i[:, 1])
+        iou = iou_xyxy(anchors, gt_i)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        max_iou = jnp.max(iou, axis=1)
+        arg = jnp.argmax(iou, axis=1)
+        best_per_gt = jnp.max(iou, axis=0)
+        is_best = jnp.any((iou >= best_per_gt[None, :] - 1e-6)
+                          & (iou > 0) & valid[None, :], axis=1)
+        fg = (max_iou >= pos_ov) | is_best
+        bg = (~fg) & (max_iou < neg_ov)
+        label = jnp.where(fg, lbl_i[arg],
+                          jnp.where(bg, 0, -1)).astype(jnp.int32)
+        mgt = gt_i[arg]
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        gw = mgt[:, 2] - mgt[:, 0] + 1
+        gh = mgt[:, 3] - mgt[:, 1] + 1
+        gcx = mgt[:, 0] + gw / 2
+        gcy = mgt[:, 1] + gh / 2
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        tb = jnp.where(fg[:, None], tgt, 0.0)
+        wt = jnp.where(fg[:, None], 1.0, 0.0)
+        return label, tb, wt, jnp.sum(fg).astype(jnp.int32)
+
+    lbl, tb, wt, n_fg = jax.vmap(one)(gt, gt_labels)
+    return {"TargetLabel": lbl, "TargetBBox": tb, "BBoxInsideWeight": wt,
+            "ForegroundNumber": n_fg[:, None]}

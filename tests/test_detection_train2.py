@@ -1,0 +1,115 @@
+"""Detection completion ops: on-device multiclass_nms2, hard-negative
+mining, box_decoder_and_assign, polygon transform, retinanet assign."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+from op_test import OpTest
+
+
+def _run_op(op_type, inputs, out_slots, attrs):
+    main = fluid.Program()
+    block = main.global_block()
+    feed = {}
+    in_names = {}
+    for slot, v in inputs.items():
+        nm = f"i_{slot}"
+        v = np.asarray(v)
+        block.create_var(name=nm, shape=list(v.shape), dtype=str(v.dtype),
+                         is_data=True)
+        feed[nm] = v
+        in_names[slot] = [nm]
+    out_names = {s: [f"o_{s}"] for s in out_slots}
+    for s in out_slots:
+        block.create_var(name=f"o_{s}", shape=[1], dtype="float32")
+    block.append_op(type=op_type, inputs=in_names, outputs=out_names,
+                    attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    vals = exe.run(main, feed=feed,
+                   fetch_list=[f"o_{s}" for s in out_slots])
+    return dict(zip(out_slots, vals))
+
+
+def test_multiclass_nms2_device():
+    # 2 classes (0=bg), 4 boxes; two overlapping high-score boxes of class 1
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [50, 50, 60, 60], [100, 100, 110, 110]]], "float32")
+    scores = np.zeros((1, 2, 4), "float32")
+    scores[0, 1] = [0.9, 0.85, 0.7, 0.01]
+    out = _run_op("multiclass_nms2",
+                  {"BBoxes": boxes, "Scores": scores},
+                  ["Out", "Index", "NmsRoisNum"],
+                  {"score_threshold": 0.05, "nms_top_k": 4,
+                   "keep_top_k": 4, "nms_threshold": 0.5,
+                   "background_label": 0})
+    n = int(np.ravel(out["NmsRoisNum"])[0])
+    assert n == 2  # box1 suppressed by box0; box3 below score threshold
+    rows = out["Out"][0][:n]
+    assert (rows[:, 0] == 1).all()                 # class label
+    np.testing.assert_allclose(rows[0, 1], 0.9, atol=1e-6)
+    np.testing.assert_allclose(rows[0, 2:], [0, 0, 10, 10], atol=1e-5)
+    np.testing.assert_allclose(rows[1, 2:], [50, 50, 60, 60], atol=1e-5)
+    # padding rows are -1
+    assert (out["Out"][0][n:, 0] == -1).all()
+
+
+def test_mine_hard_examples_max_negative():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.3, 0.8]], "float32")
+    match = np.array([[2, -1, -1, -1, -1]], "int32")
+    dist = np.array([[0.8, 0.1, 0.2, 0.3, 0.6]], "float32")
+    out = _run_op("mine_hard_examples",
+                  {"ClsLoss": cls_loss, "MatchIndices": match,
+                   "MatchDist": dist},
+                  ["NegIndices", "UpdatedMatchIndices"],
+                  {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+                   "mining_type": "max_negative"})
+    negs = out["NegIndices"][0]
+    # 1 positive -> up to 2 negatives; eligible: priors 1,2,3 (dist<0.5);
+    # hardest two by cls_loss: prior1 (0.9), prior3 (0.3)? no: 2 has 0.5
+    got = [int(v) for v in negs if v >= 0]
+    assert got == [1, 2], got
+    np.testing.assert_array_equal(out["UpdatedMatchIndices"][0],
+                                  [2, -1, -1, -1, -1])
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], "float32")          # w=h=10
+    pvar = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+    target = np.zeros((1, 8), "float32")                 # 2 classes
+    target[0, 4:] = [0.0, 0.0, 0.0, 0.0]
+    score = np.array([[0.3, 0.7]], "float32")
+    out = _run_op("box_decoder_and_assign",
+                  {"PriorBox": prior, "PriorBoxVar": pvar,
+                   "TargetBox": target, "BoxScore": score},
+                  ["DecodeBox", "OutputAssignBox"], {"box_clip": 4.135})
+    # zero deltas decode back to the prior box
+    np.testing.assert_allclose(out["DecodeBox"][0][:4], [0, 0, 9, 9],
+                               atol=1e-5)
+    np.testing.assert_allclose(out["OutputAssignBox"][0], [0, 0, 9, 9],
+                               atol=1e-5)
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 2, 2, 3), "float32")
+    out = _run_op("polygon_box_transform", {"Input": x}, ["Output"], {})
+    o = out["Output"][0]
+    np.testing.assert_array_equal(o[0], [[0, 4, 8], [0, 4, 8]])    # id_w*4
+    np.testing.assert_array_equal(o[1], [[0, 0, 0], [4, 4, 4]])    # id_h*4
+
+
+def test_retinanet_target_assign():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [0, 0, 4, 4]], "float32")
+    gt = np.array([[[1, 1, 9, 9]]], "float32")
+    gt_labels = np.array([[3]], "int32")
+    out = _run_op("retinanet_target_assign",
+                  {"Anchor": anchors, "GtBoxes": gt, "GtLabels": gt_labels},
+                  ["TargetLabel", "TargetBBox", "BBoxInsideWeight",
+                   "ForegroundNumber"],
+                  {"positive_overlap": 0.5, "negative_overlap": 0.4})
+    lbl = out["TargetLabel"][0]
+    assert lbl[0] == 3          # matched anchor carries the gt class
+    assert lbl[1] == 0          # far anchor = background
+    assert int(np.ravel(out["ForegroundNumber"])[0]) == 1
+    assert (out["BBoxInsideWeight"][0][0] == 1).all()
+    assert (out["BBoxInsideWeight"][0][1] == 0).all()
