@@ -17,6 +17,10 @@ from __future__ import annotations
 
 # op registrations (import for side effects)
 from . import ops  # noqa: F401
+# PS/distributed host ops (send/recv/listen_and_serv/...) must be present
+# whenever a transpiled program runs, not only after an explicit
+# `import paddle_tpu.distributed`
+from .distributed import ps_ops as _ps_ops  # noqa: F401
 
 from .framework.core import (  # noqa: F401
     CPUPlace,

@@ -1,0 +1,140 @@
+"""Op batch 6: lod_reset, split_byref, quantize family, queues, PS sparse
+host API."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run(op_type, inputs, out_slots, attrs, out_counts=None):
+    main = fluid.Program()
+    block = main.global_block()
+    feed, in_names = {}, {}
+    for slot, v in inputs.items():
+        vals = v if isinstance(v, list) else [v]
+        names = []
+        for i, vv in enumerate(vals):
+            nm = f"i_{slot}_{i}"
+            vv = np.asarray(vv)
+            block.create_var(name=nm, shape=list(vv.shape),
+                             dtype=str(vv.dtype), is_data=True)
+            feed[nm] = vv
+            names.append(nm)
+        in_names[slot] = names
+    out_names = {}
+    for s in out_slots:
+        n = (out_counts or {}).get(s, 1)
+        out_names[s] = [f"o_{s}_{i}" for i in range(n)]
+        for nm in out_names[s]:
+            block.create_var(name=nm, shape=[1], dtype="float32")
+    block.append_op(type=op_type, inputs=in_names, outputs=out_names,
+                    attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fetch = [n for ns in out_names.values() for n in ns]
+    vals = exe.run(main, feed=feed, fetch_list=fetch)
+    flat = dict(zip(fetch, vals))
+    return {s: [flat[n] for n in ns] for s, ns in out_names.items()}
+
+
+def test_lod_reset():
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+    y = np.array([2, 1], dtype="int64")
+    out = _run("lod_reset", {"X": x, "Y": y}, ["Out", "Length"], {})
+    np.testing.assert_array_equal(out["Out"][0], x)
+    np.testing.assert_array_equal(out["Length"][0], y)
+
+
+def test_split_byref():
+    x = np.arange(12, dtype="float32").reshape(6, 2)
+    out = _run("split_byref", {"X": x}, ["Out"], {"sections": [2, 4]},
+               out_counts={"Out": 2})
+    np.testing.assert_array_equal(out["Out"][0], x[:2])
+    np.testing.assert_array_equal(out["Out"][1], x[2:])
+
+
+def test_quantize_roundtrip():
+    x = np.array([[-1.0, 0.5, 0.25]], "float32")
+    q = _run("quantize", {"Input": x}, ["Output"], {"Scale": 127.0})
+    deq = _run("dequantize", {"Input": q["Output"][0]}, ["Output"],
+               {"Scale": 127.0})
+    np.testing.assert_allclose(deq["Output"][0], x, atol=1 / 127.0)
+    rq = _run("requantize", {"Input": q["Output"][0]}, ["Output"],
+              {"Scale_in": 127.0, "Scale_out": 63.0})
+    assert rq["Output"][0].dtype == np.int8
+
+
+def test_queue_ops():
+    x = np.ones((2, 2), "float32") * 7
+    main = fluid.Program()
+    block = main.global_block()
+    block.create_var(name="x", shape=[2, 2], dtype="float32", is_data=True)
+    block.create_var(name="out", shape=[2, 2], dtype="float32")
+    block.append_op(type="queue_generator", inputs={}, outputs={},
+                    attrs={"names": ["q1"], "capacity": 4})
+    block.append_op(type="enqueue", inputs={"X": ["x"]}, outputs={},
+                    attrs={"queue_name": "q1"})
+    block.append_op(type="dequeue", inputs={}, outputs={"Out": ["out"]},
+                    attrs={"queue_name": "q1"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (v,) = exe.run(main, feed={"x": x}, fetch_list=["out"])
+    np.testing.assert_array_equal(v, x)
+
+
+def test_pull_push_sparse_host_api():
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    server = ParameterServer("127.0.0.1:0", trainer_num=1, sync_mode=False)
+    server.register_sparse("tbl", 3, "sgd", lr=1.0)
+    server.start()
+    try:
+        main = fluid.Program()
+        block = main.global_block()
+        block.create_var(name="ids", shape=[2, 1], dtype="int64",
+                         is_data=True)
+        block.create_var(name="emb", shape=[2, 3], dtype="float32")
+        block.append_op(type="pull_sparse", inputs={"Ids": ["ids"]},
+                        outputs={"Out": ["emb"]},
+                        attrs={"epmap": [server.endpoint],
+                               "table_names": ["tbl"], "trainer_id": 0})
+        block.create_var(name="g", shape=[2, 3], dtype="float32",
+                         is_data=True)
+        block.append_op(type="push_sparse",
+                        inputs={"Ids": ["ids"], "Grad": ["g"]}, outputs={},
+                        attrs={"epmap": [server.endpoint],
+                               "table_names": ["tbl"], "trainer_id": 0})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ids = np.array([[4], [9]], "int64")
+        g = np.ones((2, 3), "float32")
+        (emb,) = exe.run(main, feed={"ids": ids, "g": g},
+                         fetch_list=["emb"])
+        np.testing.assert_allclose(emb, 0.0)       # fresh rows pull zeros
+        (emb2,) = exe.run(main, feed={"ids": ids, "g": g},
+                          fetch_list=["emb"])
+        np.testing.assert_allclose(emb2, -1.0)     # sgd applied the push
+    finally:
+        server.stop()
+        PSClient.reset_all()
+
+
+def test_recv_save(tmp_path):
+    from paddle_tpu.distributed import ParameterServer, PSClient
+    from paddle_tpu.framework import paddle_pb
+
+    server = ParameterServer("127.0.0.1:0", trainer_num=1, sync_mode=False)
+    server.register_dense("w", (2, 2), "sgd")
+    server.start()
+    try:
+        c = PSClient.instance(0)
+        w = np.arange(4, dtype="float32").reshape(2, 2)
+        c.ensure_init(server.endpoint, "w", w)
+        path = str(tmp_path / "w.bin")
+        main = fluid.Program()
+        main.global_block().append_op(
+            type="recv_save", inputs={}, outputs={},
+            attrs={"epmap": [server.endpoint], "param": "w",
+                   "file_path": path, "trainer_id": 0})
+        fluid.Executor(fluid.CPUPlace()).run(main, feed={}, fetch_list=[])
+        arr, _, _ = paddle_pb.tensor_from_stream(open(path, "rb").read())
+        np.testing.assert_array_equal(arr, w)
+    finally:
+        server.stop()
+        PSClient.reset_all()
