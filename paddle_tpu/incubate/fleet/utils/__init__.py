@@ -1,0 +1,1 @@
+from .fs import FS, HDFSClient, LocalFS  # noqa: F401

@@ -1,0 +1,208 @@
+"""Filesystem abstraction for fleet checkpoints — capability parity with
+python/paddle/fluid/incubate/fleet/utils/hdfs.py (HDFSClient shelling to
+`hadoop fs`), plus an explicit LocalFS with the same method surface so
+checkpoint code is storage-agnostic (the reference reaches local files via
+raw os/shutil calls scattered through fleet_util).
+
+HDFSClient degrades gracefully: constructing it without a hadoop binary
+raises only when a command actually runs, and every method goes through one
+retrying runner like the reference's __run_hdfs_cmd.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class FS:
+    """Common surface: exist/dir/file checks, ls, upload/download (no-ops
+    locally), delete, rename, mkdirs, touch, cat."""
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def ls(self, path) -> List[str]:
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def rename(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def touch(self, path):
+        raise NotImplementedError
+
+    def cat(self, path) -> bytes:
+        raise NotImplementedError
+
+    def upload(self, local_path, remote_path, overwrite=False):
+        raise NotImplementedError
+
+    def download(self, remote_path, local_path, overwrite=False):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def ls(self, path):
+        return sorted(os.path.join(path, p) for p in os.listdir(path))
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst, overwrite=False):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(dst)
+            self.delete(dst)
+        os.replace(src, dst)
+
+    def touch(self, path):
+        self.mkdirs(os.path.dirname(path) or ".")
+        open(path, "ab").close()
+
+    def cat(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def upload(self, local_path, remote_path, overwrite=False):
+        if local_path != remote_path:
+            self.mkdirs(os.path.dirname(remote_path) or ".")
+            shutil.copy2(local_path, remote_path)
+
+    def download(self, remote_path, local_path, overwrite=False):
+        self.upload(remote_path, local_path, overwrite)
+
+
+class HDFSClient(FS):
+    """hdfs.py:45 HDFSClient — every call shells `hadoop fs -D... <cmd>`
+    with bounded retries. ``hadoop_bin`` is overridable for testing (the
+    reference hardcodes ``<hadoop_home>/bin/hadoop``)."""
+
+    def __init__(self, hadoop_home: str, configs: Optional[Dict] = None,
+                 retry_times: int = 5, retry_sleep_second: float = 3.0,
+                 hadoop_bin: Optional[str] = None):
+        self.pre_commands = [hadoop_bin
+                             or os.path.join(hadoop_home, "bin", "hadoop"),
+                             "fs"]
+        for k, v in (configs or {}).items():
+            self.pre_commands.append(f"-D{k}={v}")
+        self.retry_times = retry_times
+        self.retry_sleep_second = retry_sleep_second
+
+    # ------------------------------------------------------------------
+    def _run(self, args: List[str], retry_times: Optional[int] = None
+             ) -> Tuple[int, str, str]:
+        cmd = self.pre_commands + args
+        retries = self.retry_times if retry_times is None else retry_times
+        rc, out, err = 1, "", ""
+        for attempt in range(retries + 1):
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                rc, out, err = proc.returncode, proc.stdout, proc.stderr
+            except FileNotFoundError as e:
+                raise RuntimeError(
+                    f"hadoop binary not found: {cmd[0]!r} — pass a valid "
+                    f"hadoop_home/hadoop_bin to HDFSClient") from e
+            if rc == 0:
+                break
+            if attempt < retries:
+                time.sleep(self.retry_sleep_second)
+        return rc, out, err
+
+    # ------------------------------------------------------------------
+    def is_exist(self, path):
+        rc, _, _ = self._run(["-test", "-e", path], retry_times=1)
+        return rc == 0
+
+    def is_dir(self, path):
+        rc, _, _ = self._run(["-test", "-d", path], retry_times=1)
+        return rc == 0
+
+    def is_file(self, path):
+        rc, _, _ = self._run(["-test", "-f", path], retry_times=1)
+        return rc == 0
+
+    def ls(self, path):
+        rc, out, err = self._run(["-ls", path])
+        if rc != 0:
+            raise RuntimeError(f"hdfs ls {path} failed: {err}")
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                files.append(parts[-1])
+        return files
+
+    def mkdirs(self, path):
+        rc, _, err = self._run(["-mkdir", "-p", path])
+        if rc != 0:
+            raise RuntimeError(f"hdfs mkdirs {path} failed: {err}")
+
+    def delete(self, path):
+        rc, _, err = self._run(["-rm", "-r", "-f", path])
+        if rc != 0:
+            raise RuntimeError(f"hdfs delete {path} failed: {err}")
+
+    def rename(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        rc, _, err = self._run(["-mv", src, dst])
+        if rc != 0:
+            raise RuntimeError(f"hdfs rename {src} {dst} failed: {err}")
+
+    def touch(self, path):
+        rc, _, err = self._run(["-touchz", path])
+        if rc != 0:
+            raise RuntimeError(f"hdfs touch {path} failed: {err}")
+
+    def cat(self, path):
+        rc, out, err = self._run(["-cat", path], retry_times=1)
+        if rc != 0:
+            raise RuntimeError(f"hdfs cat {path} failed: {err}")
+        return out.encode()
+
+    def upload(self, local_path, remote_path, overwrite=False):
+        if overwrite and self.is_exist(remote_path):
+            self.delete(remote_path)
+        rc, _, err = self._run(["-put", local_path, remote_path])
+        if rc != 0:
+            raise RuntimeError(
+                f"hdfs upload {local_path} -> {remote_path} failed: {err}")
+
+    def download(self, remote_path, local_path, overwrite=False):
+        if overwrite and os.path.exists(local_path):
+            LocalFS().delete(local_path)
+        rc, _, err = self._run(["-get", remote_path, local_path])
+        if rc != 0:
+            raise RuntimeError(
+                f"hdfs download {remote_path} -> {local_path} failed: {err}")

@@ -1,0 +1,148 @@
+"""Encrypted checkpoint IO (framework/io/crypto parity) + fleet fs
+abstraction (hdfs.py parity; HDFSClient driven against a fake hadoop)."""
+import os
+import stat
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.io_crypto import (AESCipher, CipherFactory,
+                                            CipherUtils, _encrypt_block,
+                                            _expand_key)
+from paddle_tpu.incubate.fleet.utils import HDFSClient, LocalFS
+
+
+def test_aes_fips197_vectors():
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    rk, nr = _expand_key(bytes(range(16)))
+    assert _encrypt_block(pt, rk, nr).hex() == \
+        "69c4e0d86a7b0430d8cdb78070b4c55a"
+    rk, nr = _expand_key(bytes(range(32)))
+    assert _encrypt_block(pt, rk, nr).hex() == \
+        "8ea2b7ca516745bfeafc49904b496089"
+
+
+def test_cipher_roundtrip_and_tamper(tmp_path):
+    c = AESCipher(256)
+    key = CipherUtils.gen_key_to_file(256, str(tmp_path / "k"))
+    assert CipherUtils.read_key_from_file(str(tmp_path / "k")) == key
+    msg = os.urandom(1000) + b"params"
+    blob = c.encrypt(msg, key)
+    assert blob != msg and msg not in blob
+    assert c.decrypt(blob, key) == msg
+    # wrong key fails loudly (authentication, not garbage output)
+    with pytest.raises(ValueError):
+        c.decrypt(blob, b"x" * 32)
+    # bit-flip fails
+    bad = bytearray(blob)
+    bad[20] ^= 1
+    with pytest.raises(ValueError):
+        c.decrypt(bytes(bad), key)
+    # file path API
+    path = str(tmp_path / "enc.bin")
+    c.encrypt_to_file(msg, key, path)
+    assert c.decrypt_from_file(key, path) == msg
+
+
+def test_cipher_factory_config(tmp_path):
+    cfg = tmp_path / "cipher.conf"
+    cfg.write_text("cipher_name: AES_CTR_NoPadding(128)\n")
+    c = CipherFactory.create_cipher(str(cfg))
+    assert c.key_bytes == 16
+    assert CipherFactory.create_cipher(None).key_bytes == 32
+
+
+def test_encrypted_inference_model(tmp_path):
+    """Whole-artifact flow: save_inference_model bytes survive an
+    encrypt->decrypt cycle byte-exactly."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [y], exe, main_program=main)
+    key = CipherUtils.gen_key(256)
+    c = AESCipher()
+    raw = open(os.path.join(d, "__model__"), "rb").read()
+    c.encrypt_to_file(raw, key, os.path.join(d, "__model__.enc"))
+    assert c.decrypt_from_file(key, os.path.join(d, "__model__.enc")) == raw
+
+
+def test_local_fs(tmp_path):
+    fs = LocalFS()
+    p = str(tmp_path / "a" / "b.txt")
+    fs.touch(p)
+    assert fs.is_exist(p) and fs.is_file(p) and not fs.is_dir(p)
+    assert fs.cat(p) == b""
+    fs.rename(p, str(tmp_path / "a" / "c.txt"))
+    assert fs.is_exist(str(tmp_path / "a" / "c.txt"))
+    assert fs.ls(str(tmp_path / "a")) == [str(tmp_path / "a" / "c.txt")]
+    fs.delete(str(tmp_path / "a"))
+    assert not fs.is_exist(str(tmp_path / "a"))
+
+
+FAKE_HADOOP = """#!/bin/sh
+# minimal `hadoop fs` that maps hdfs commands onto a local root
+shift  # drop 'fs'
+ROOT="$FAKE_HDFS_ROOT"
+while [ "${1#-D}" != "$1" ]; do shift; done
+cmd="$1"; shift
+case "$cmd" in
+  -test) flag="$1"; p="$ROOT$2"
+         case "$flag" in
+           -e) [ -e "$p" ] ;;
+           -d) [ -d "$p" ] ;;
+           -f) [ -f "$p" ] ;;
+         esac ;;
+  -mkdir) shift; mkdir -p "$ROOT$1" ;;
+  -touchz) : > "$ROOT$1" ;;
+  -put) cp "$1" "$ROOT$2" ;;
+  -get) cp "$ROOT$1" "$2" ;;
+  -cat) cat "$ROOT$1" ;;
+  -rm) shift; shift; rm -rf "$ROOT$1" ;;
+  -mv) mv "$ROOT$1" "$ROOT$2" ;;
+  -ls) ls -l "$ROOT$1" | tail -n +1 | while read -r a b c d e f g h; do
+         [ -n "$h" ] && echo "x x x x x x x $1/$h"; done ;;
+  *) echo "unknown $cmd" >&2; exit 1 ;;
+esac
+"""
+
+
+def test_hdfs_client_against_fake_hadoop(tmp_path):
+    bin_path = tmp_path / "hadoop"
+    bin_path.write_text(FAKE_HADOOP)
+    bin_path.chmod(bin_path.stat().st_mode | stat.S_IEXEC)
+    root = tmp_path / "hdfs_root"
+    root.mkdir()
+    os.environ["FAKE_HDFS_ROOT"] = str(root)
+    try:
+        client = HDFSClient("unused", {"fs.default.name": "hdfs://x"},
+                            hadoop_bin=str(bin_path), retry_times=0,
+                            retry_sleep_second=0)
+        client.mkdirs("/models")
+        assert client.is_dir("/models")
+        local = tmp_path / "w.bin"
+        local.write_bytes(b"weights")
+        client.upload(str(local), "/models/w.bin")
+        assert client.is_file("/models/w.bin")
+        assert client.cat("/models/w.bin") == b"weights"
+        got = tmp_path / "back.bin"
+        client.download("/models/w.bin", str(got))
+        assert got.read_bytes() == b"weights"
+        client.rename("/models/w.bin", "/models/w2.bin")
+        assert client.is_exist("/models/w2.bin")
+        assert any(p.endswith("w2.bin") for p in client.ls("/models"))
+        client.delete("/models")
+        assert not client.is_exist("/models")
+    finally:
+        os.environ.pop("FAKE_HDFS_ROOT", None)
+
+
+def test_hdfs_client_missing_binary():
+    client = HDFSClient("/nonexistent_hadoop_home", retry_times=0)
+    with pytest.raises(RuntimeError, match="hadoop binary not found"):
+        client.is_exist("/x")
