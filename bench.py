@@ -117,9 +117,15 @@ def launcher():
     if saw_accelerator:
         budget = max(60.0, remaining() - CPU_RESERVE_S - 90)
         flash_args = []
-        result = _run_worker(dict(os.environ), budget, [])
+        # config ladder: no-remat first (the 6N MFU numerator matches the
+        # FLOPs actually run — full remat re-runs the forward and eats ~25%
+        # of measured MFU); fall back to the always-fits remat config, then
+        # to the XLA-attention path if the Pallas kernel is the failure
+        result = _run_worker(dict(os.environ), budget, ["--no-remat"])
+        if result is None and remaining() > CPU_RESERVE_S + 150:
+            result = _run_worker(dict(os.environ),
+                                 remaining() - CPU_RESERVE_S - 90, [])
         if result is None and remaining() > CPU_RESERVE_S + 120:
-            # flash kernel may be the failure — retry once without it
             flash_args = ["--no-flash"]
             result = _run_worker(dict(os.environ),
                                  remaining() - CPU_RESERVE_S, flash_args)
@@ -129,10 +135,20 @@ def launcher():
             # the flash setting the primary run actually succeeded with
             wide = _run_worker(dict(os.environ),
                                remaining() - CPU_RESERVE_S,
-                               ["--wide"] + flash_args)
+                               ["--wide", "--no-remat"] + flash_args)
+            if wide is None and remaining() > CPU_RESERVE_S + 90:
+                wide = _run_worker(dict(os.environ),
+                                   remaining() - CPU_RESERVE_S,
+                                   ["--wide"] + flash_args)
             if wide is not None:
-                result.setdefault("detail", {})["wide_config"] = \
-                    wide.get("detail", wide)
+                # the better-MFU config is the headline (both reported)
+                if wide.get("vs_baseline", 0) > result.get("vs_baseline", 0):
+                    wide.setdefault("detail", {})["small_config"] = \
+                        result.get("detail", result)
+                    result = wide
+                else:
+                    result.setdefault("detail", {})["wide_config"] = \
+                        wide.get("detail", wide)
         if result is not None and remaining() > CPU_RESERVE_S + 60:
             # vision lane (BASELINE.md's first north-star row)
             rn = _run_worker(dict(os.environ),
@@ -318,19 +334,24 @@ def worker(use_flash: bool):
         return tokens_per_s, mfu, loss_v, n_params
 
     wide_mode = "--wide" in sys.argv
+    no_remat = "--no-remat" in sys.argv
     if on_acc and wide_mode:
         # MXU-saturating width (d_model 2048, head_dim 128) shows the
         # framework ceiling — GPT_SMALL's 768-wide matmuls cap its MFU well
-        # below what the same code reaches on wider layers
+        # below what the same code reaches on wider layers. no-remat needs
+        # batch 16 + forced chunked CE to fit HBM (its MFU numerator then
+        # matches the FLOPs actually run).
         cfg = G.GPT_SMALL.scaled(
             max_seq_len=1024, use_flash=use_flash, d_model=2048,
-            num_heads=16, d_ff=8192, num_layers=6)
-        batch, T, steps = 32, 1024, 8
-        tag = "gpt_wide"
+            num_heads=16, d_ff=8192, num_layers=6, remat=not no_remat,
+            ce_direct_bytes_limit=(1 << 30) if no_remat else (4 << 30))
+        batch, T, steps = (16, 1024, 10) if no_remat else (32, 1024, 8)
+        tag = "gpt_wide" + ("_noremat" if no_remat else "")
     elif on_acc:
-        cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=use_flash)
+        cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=use_flash,
+                                 remat=not no_remat)
         batch, T, steps = 16, 1024, 10
-        tag = "gpt_small"
+        tag = "gpt_small" + ("_noremat" if no_remat else "")
     else:  # CPU smoke path so the bench always produces a line
         cfg = G.GPT_TINY.scaled(num_layers=2)
         batch, T, steps = 4, 32, 3

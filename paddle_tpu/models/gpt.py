@@ -42,6 +42,10 @@ class GPTConfig:
     # recompute elementwise (recovers most MFU at modest HBM cost)
     remat_policy: str = "full"
     use_flash: bool = False     # Pallas flash-attention kernel on TPU
+    # chunked-CE threshold: f32 logits above this never materialize
+    # (ce_from_hidden); lower it to trade ~1/6 vocab-head FLOPs for HBM
+    # headroom (e.g. to fit no-remat training)
+    ce_direct_bytes_limit: int = 4 << 30
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
@@ -262,13 +266,15 @@ def token_ce(logits, labels, valid=None):
 
 
 def ce_from_hidden(params, x, labels, cfg: GPTConfig, chunk: int = 2048,
-                   direct_bytes_limit: int = 4 << 30):
+                   direct_bytes_limit: Optional[int] = None):
     """Summed token CE straight from hidden states, chunked over rows so the
     full [rows, V] logits tensor never materializes (at GPT vocab sizes the
     f32 logits alone are gigabytes — the usual OOM at wide batch). Each
     chunk recomputes its logits in the backward (jax.checkpoint), costing
     one extra [chunk, D] x [D, V] matmul per chunk (~1/6 of the vocab-head
     FLOPs) for an S-fold cut in live logits memory."""
+    if direct_bytes_limit is None:
+        direct_bytes_limit = cfg.ce_direct_bytes_limit
     head = params["lm_head"]
     B, T, D = x.shape
     V = head.shape[-1]
