@@ -1,2 +1,4 @@
 from . import quantization  # noqa: F401
 from . import prune  # noqa: F401
+from . import distillation  # noqa: F401
+from . import nas  # noqa: F401

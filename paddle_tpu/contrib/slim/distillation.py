@@ -1,0 +1,165 @@
+"""slim distillation — parity with contrib/slim/distillation/distiller.py:
+merge a frozen teacher program into the student program and attach
+L2 / FSP / soft-label distillation losses.
+
+Program-merge design: teacher vars/ops are cloned into the student program
+under a ``teacher_`` prefix with stop_gradient set (the reference merges
+GraphWrappers the same way, distillation_strategy.py); the combined loss is
+ordinary IR so the whole distilled step still compiles to one XLA program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["merge", "L2Distiller", "FSPDistiller", "SoftLabelDistiller"]
+
+_PREFIX = "teacher_"
+
+
+def merge(teacher_program, student_program, data_name_map: Dict[str, str],
+          scope=None, teacher_scope=None, name_prefix: str = _PREFIX):
+    """Clone the teacher's global block into the student program.
+
+    data_name_map maps teacher data var -> student data var (shared feeds).
+    Teacher parameters are renamed with ``name_prefix`` and marked
+    non-trainable; copy their trained values between scopes yourself or via
+    the returned rename map. Returns {teacher_var: merged_var_name}."""
+    t_block = teacher_program.global_block()
+    s_block = student_program.global_block()
+    rename: Dict[str, str] = dict(data_name_map)
+
+    for name, var in t_block.vars.items():
+        if name in data_name_map:
+            continue
+        new_name = name_prefix + name
+        rename[name] = new_name
+        if new_name in s_block.vars:
+            continue
+        nv = s_block.create_var(
+            name=new_name, shape=list(var.shape), dtype=var.dtype,
+            persistable=var.persistable)
+        nv.stop_gradient = True
+        if var.persistable and getattr(var, "trainable", False) is not None:
+            # cloned teacher params must not join student optimization
+            try:
+                nv.trainable = False
+            except Exception:
+                pass
+
+    for op in t_block.ops:
+        s_block.append_op(
+            type=op.type,
+            inputs={slot: [rename.get(n, n) for n in names]
+                    for slot, names in op.inputs.items()},
+            outputs={slot: [rename.get(n, n) for n in names]
+                     for slot, names in op.outputs.items()},
+            attrs=dict(op.attrs),
+        )
+    return rename
+
+
+def _student_plus(loss_var, weight):
+    from ... import layers
+
+    return layers.scale(loss_var, scale=float(weight)) \
+        if hasattr(layers, "scale") else loss_var
+
+
+class L2Distiller:
+    """distiller.py:25 — mean squared error between feature maps."""
+
+    def __init__(self, student_feature_map: str, teacher_feature_map: str,
+                 distillation_loss_weight: float = 1.0):
+        self.s = student_feature_map
+        self.t = teacher_feature_map
+        self.w = distillation_loss_weight
+
+    def distiller_loss(self, program, student_loss=None):
+        from ... import layers
+        from ...framework.program import program_guard
+
+        block = program.global_block()
+        with program_guard(program):
+            s = block.var(self.s)
+            t = block.var(self.t)
+            t.stop_gradient = True
+            l2 = layers.reduce_mean(layers.square(s - t))
+            dloss = l2 * self.w if self.w != 1.0 else l2
+            if student_loss is not None:
+                return dloss + student_loss, dloss
+            return dloss, dloss
+
+
+class FSPDistiller:
+    """distiller.py:103 — flow-of-solution-procedure matrices of layer
+    pairs, L2-matched between teacher and student (uses the fsp op)."""
+
+    def __init__(self, student_pairs: List[Tuple[str, str]],
+                 teacher_pairs: List[Tuple[str, str]],
+                 distillation_loss_weight: float = 1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.w = distillation_loss_weight
+
+    def _fsp(self, block, a_name, b_name):
+        from ...framework.layer_helper import LayerHelper
+
+        helper = LayerHelper("fsp")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="fsp",
+                         inputs={"X": [block.var(a_name)],
+                                 "Y": [block.var(b_name)]},
+                         outputs={"Out": [out]}, attrs={})
+        return out
+
+    def distiller_loss(self, program, student_loss=None):
+        from ... import layers
+        from ...framework.program import program_guard
+
+        block = program.global_block()
+        with program_guard(program):
+            losses = []
+            for (sa, sb), (ta, tb) in zip(self.student_pairs,
+                                          self.teacher_pairs):
+                s_fsp = self._fsp(block, sa, sb)
+                t_fsp = self._fsp(block, ta, tb)
+                t_fsp.stop_gradient = True
+                losses.append(layers.reduce_mean(
+                    layers.square(s_fsp - t_fsp)))
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            dloss = total * self.w if self.w != 1.0 else total
+            if student_loss is not None:
+                return dloss + student_loss, dloss
+            return dloss, dloss
+
+
+class SoftLabelDistiller:
+    """distiller.py:195 — temperature-softened soft-label cross entropy."""
+
+    def __init__(self, student_feature_map: str, teacher_feature_map: str,
+                 student_temperature: float = 1.0,
+                 teacher_temperature: float = 1.0,
+                 distillation_loss_weight: float = 1.0):
+        self.s = student_feature_map
+        self.t = teacher_feature_map
+        self.st = student_temperature
+        self.tt = teacher_temperature
+        self.w = distillation_loss_weight
+
+    def distiller_loss(self, program, student_loss=None):
+        from ... import layers
+        from ...framework.program import program_guard
+
+        block = program.global_block()
+        with program_guard(program):
+            s = layers.softmax(block.var(self.s) * (1.0 / self.st))
+            t = layers.softmax(block.var(self.t) * (1.0 / self.tt))
+            t.stop_gradient = True
+            ce = layers.reduce_mean(
+                layers.cross_entropy(s, t, soft_label=True))
+            dloss = ce * self.w if self.w != 1.0 else ce
+            if student_loss is not None:
+                return dloss + student_loss, dloss
+            return dloss, dloss

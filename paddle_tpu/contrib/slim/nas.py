@@ -1,0 +1,105 @@
+"""slim NAS — parity with contrib/slim/searcher/controller.py SAController
+(simulated annealing over integer token vectors) and the nas/ search-agent
+loop. Search is pure host-side control; each candidate's reward comes from
+whatever (compiled) training/eval the caller runs.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController", "SearchAgent"]
+
+
+class EvolutionaryController:
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def next_tokens(self, control_token=None):
+        raise NotImplementedError
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """controller.py:59 — accept a worse candidate with probability
+    exp(dreward / T), T decaying by reduce_rate per iteration."""
+
+    def __init__(self, range_table: Optional[List[int]] = None,
+                 reduce_rate: float = 0.85, init_temperature: float = 1024,
+                 max_iter_number: int = 300, seed: Optional[int] = None):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._reward = -1.0
+        self._tokens = None
+        self._max_reward = -1.0
+        self._best_tokens = None
+        self._iter = 0
+        self._constrain_func = None
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.random_sample() <= math.exp(
+                min((reward - self._reward) / max(temperature, 1e-12), 0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token) if control_token else list(self._tokens)
+        new_tokens = tokens[:]
+        index = self._rng.randint(len(self._range_table))
+        new_tokens[index] = (
+            new_tokens[index]
+            + self._rng.randint(self._range_table[index] - 1) + 1
+        ) % self._range_table[index]
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_iter_number):
+            if self._constrain_func(new_tokens):
+                break
+            index = self._rng.randint(len(self._range_table))
+            new_tokens = tokens[:]
+            new_tokens[index] = self._rng.randint(
+                self._range_table[index])
+        return new_tokens
+
+
+class SearchAgent:
+    """nas/search_agent.py in-process form: drive (next_tokens ->
+    reward_fn -> update) for n steps and return the best architecture."""
+
+    def __init__(self, controller: EvolutionaryController):
+        self.controller = controller
+
+    def search(self, reward_fn: Callable[[List[int]], float],
+               steps: int) -> List[int]:
+        for _ in range(steps):
+            tokens = self.controller.next_tokens()
+            reward = float(reward_fn(tokens))
+            self.controller.update(tokens, reward)
+        return self.controller.best_tokens

@@ -1,0 +1,123 @@
+"""slim distillation (teacher-merge + L2/FSP/soft-label losses) and NAS
+(simulated-annealing controller)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim.distillation import (FSPDistiller, L2Distiller,
+                                                  SoftLabelDistiller, merge)
+from paddle_tpu.contrib.slim.nas import SAController, SearchAgent
+
+
+def _teacher_student():
+    from paddle_tpu.framework import unique_name
+
+    teacher = fluid.Program()
+    t_start = fluid.Program()
+    teacher.random_seed = t_start.random_seed = 11
+    with unique_name.guard():
+        with fluid.program_guard(teacher, t_start):
+            x = fluid.layers.data("img", [8], dtype="float32")
+            th = fluid.layers.fc(x, 16, act="relu", name="t_feat")
+            tl = fluid.layers.fc(th, 4, name="t_logits")
+    student = fluid.Program()
+    s_start = fluid.Program()
+    student.random_seed = s_start.random_seed = 12
+    with unique_name.guard():
+        with fluid.program_guard(student, s_start):
+            x = fluid.layers.data("img", [8], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="int64")
+            sh = fluid.layers.fc(x, 16, act="relu", name="s_feat")
+            sl = fluid.layers.fc(sh, 4, name="s_logits")
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.softmax_with_cross_entropy(sl, y))
+    return (teacher, t_start, th, tl), (student, s_start, sh, sl, loss)
+
+
+def test_merge_and_distill_losses_train():
+    (teacher, t_start, th, tl), (student, s_start, sh, sl, loss) = \
+        _teacher_student()
+    rename = merge(teacher, student, {"img": "img"})
+    assert rename[tl.name].startswith("teacher_")
+
+    soft = SoftLabelDistiller(sl.name, rename[tl.name],
+                              student_temperature=2.0,
+                              teacher_temperature=2.0,
+                              distillation_loss_weight=0.5)
+    l2 = L2Distiller(sh.name, rename[th.name], 0.5)
+    with fluid.program_guard(student, s_start):
+        total, d1 = soft.distiller_loss(student, student_loss=loss)
+        total2, d2 = l2.distiller_loss(student, student_loss=total)
+        fluid.optimizer.SGD(0.05).minimize(total2)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    # teacher params live in the student program under teacher_ names;
+    # initialize both startups into one scope (teacher startup writes the
+    # original names -> run teacher startup, then copy into merged names)
+    exe.run(s_start, scope=scope)
+    t_scope = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(t_start, scope=t_scope)
+    import jax.numpy as jnp
+    for p in teacher.global_block().all_parameters():
+        scope.set_var("teacher_" + p.name,
+                      jnp.asarray(np.asarray(t_scope.find_var(p.name))))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype("float32")
+    y = rng.randint(0, 4, (32, 1)).astype("int64")
+    t_param = np.asarray(scope.find_var("teacher_t_feat.w_0")).copy()
+    losses = [float(exe.run(student, feed={"img": x, "y": y},
+                            fetch_list=[total2], scope=scope)[0])
+              for _ in range(15)]
+    assert losses[-1] < losses[0], losses
+    # teacher stayed frozen
+    np.testing.assert_array_equal(
+        t_param, np.asarray(scope.find_var("teacher_t_feat.w_0")))
+    # distill losses are real scalars
+    d_vals = exe.run(student, feed={"img": x, "y": y},
+                     fetch_list=[d1, d2], scope=scope)
+    assert all(np.isfinite(float(np.ravel(v)[0])) for v in d_vals)
+
+
+def test_fsp_distiller_loss():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("im", [3, 8, 8], dtype="float32")
+        a = fluid.layers.conv2d(x, 4, 3, padding=1, name="sa")
+        b = fluid.layers.conv2d(a, 6, 3, padding=1, name="sb")
+        ta = fluid.layers.conv2d(x, 4, 3, padding=1, name="ta")
+        tb = fluid.layers.conv2d(ta, 6, 3, padding=1, name="tb")
+        d = FSPDistiller([(a.name, b.name)], [(ta.name, tb.name)])
+        dloss, _ = d.distiller_loss(main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    (v,) = exe.run(main, feed={"im": rng.randn(2, 3, 8, 8).astype("float32")},
+                   fetch_list=[dloss])
+    assert np.isfinite(float(np.ravel(v)[0])) and float(np.ravel(v)[0]) >= 0
+
+
+def test_sa_controller_finds_optimum():
+    # reward = number of tokens equal to target; SA should find the target
+    target = [2, 0, 3, 1, 2]
+    table = [4, 4, 4, 4, 4]
+    ctl = SAController(range_table=table, reduce_rate=0.9,
+                       init_temperature=1.0, seed=0)
+    ctl.reset(table, [0, 0, 0, 0, 0])
+
+    agent = SearchAgent(ctl)
+    best = agent.search(
+        lambda toks: sum(int(a == b) for a, b in zip(toks, target)), 200)
+    assert sum(int(a == b) for a, b in zip(best, target)) >= 4
+    assert ctl.max_reward >= 4
+
+
+def test_sa_controller_constraint():
+    table = [8, 8]
+    ctl = SAController(range_table=table, seed=1)
+    ctl.reset(table, [1, 1], constrain_func=lambda t: sum(t) <= 6)
+    for _ in range(50):
+        toks = ctl.next_tokens()
+        assert sum(toks) <= 6
+        ctl.update(toks, reward=float(sum(toks)))
