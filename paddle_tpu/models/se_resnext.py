@@ -1,0 +1,136 @@
+"""SE-ResNeXt-50/101/152 — the reference's flagship distributed-test model
+(python/paddle/fluid/tests/unittests/dist_se_resnext.py:54 SE_ResNeXt),
+rebuilt in the fluid layer style: grouped 3x3 (cardinality) bottlenecks with
+squeeze-and-excitation channel gating.
+
+TPU notes: grouped convs lower through lax.conv feature_group_count; the
+SE gate is two tiny fcs + broadcast multiply — pure fusion food for XLA.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import layers
+from ..framework.param_attr import ParamAttr
+
+__all__ = ["SE_ResNeXt", "se_resnext50", "se_resnext101", "se_resnext152"]
+
+_CFG = {
+    50: (32, [3, 4, 6, 3], [128, 256, 512, 1024]),
+    101: (32, [3, 4, 23, 3], [128, 256, 512, 1024]),
+    152: (64, [3, 8, 36, 3], [128, 256, 512, 1024]),
+}
+
+
+class SE_ResNeXt:
+    def __init__(self, layers_: int = 50, prefix: str = "se"):
+        if layers_ not in _CFG:
+            raise ValueError(f"supported layers are {sorted(_CFG)}, "
+                             f"got {layers_}")
+        self.layers = layers_
+        self.prefix = prefix
+        self._n = 0
+
+    def _name(self, tag):
+        self._n += 1
+        return f"{self.prefix}_{tag}{self._n}"
+
+    def conv_bn_layer(self, input, num_filters, filter_size, stride=1,
+                      groups=1, act=None, is_test=False):
+        name = self._name("conv")
+        conv = layers.conv2d(
+            input, num_filters, filter_size, stride=stride,
+            padding=(filter_size - 1) // 2, groups=groups,
+            param_attr=ParamAttr(name=name + "_w"), bias_attr=False,
+            name=name)
+        return layers.batch_norm(conv, act=act, is_test=is_test,
+                                 param_attr=ParamAttr(name=name + "_bn_s"),
+                                 bias_attr=ParamAttr(name=name + "_bn_b"),
+                                 moving_mean_name=name + "_bn_mean",
+                                 moving_variance_name=name + "_bn_var")
+
+    def squeeze_excitation(self, input, num_channels, reduction_ratio,
+                           is_test=False):
+        pool = layers.pool2d(input, pool_type="avg", global_pooling=True)
+        stdv = 1.0 / math.sqrt(pool.shape[1] * 1.0)
+        from ..framework.initializer import UniformInitializer
+
+        squeeze = layers.fc(
+            pool, size=num_channels // reduction_ratio, act="relu",
+            param_attr=ParamAttr(
+                name=self._name("sq") + "_w",
+                initializer=UniformInitializer(-stdv, stdv)))
+        stdv = 1.0 / math.sqrt(squeeze.shape[1] * 1.0)
+        excitation = layers.fc(
+            squeeze, size=num_channels, act="sigmoid",
+            param_attr=ParamAttr(
+                name=self._name("ex") + "_w",
+                initializer=UniformInitializer(-stdv, stdv)))
+        return layers.elementwise_mul(input, excitation, axis=0)
+
+    def shortcut(self, input, ch_out, stride, is_test=False):
+        ch_in = input.shape[1]
+        if ch_in != ch_out or stride != 1:
+            return self.conv_bn_layer(input, ch_out, 1, stride,
+                                      is_test=is_test)
+        return input
+
+    def bottleneck_block(self, input, num_filters, stride, cardinality,
+                         reduction_ratio, is_test=False):
+        conv0 = self.conv_bn_layer(input, num_filters, 1, act="relu",
+                                   is_test=is_test)
+        conv1 = self.conv_bn_layer(conv0, num_filters, 3, stride=stride,
+                                   groups=cardinality, act="relu",
+                                   is_test=is_test)
+        conv2 = self.conv_bn_layer(conv1, num_filters * 2, 1, act=None,
+                                   is_test=is_test)
+        scale = self.squeeze_excitation(conv2, num_filters * 2,
+                                        reduction_ratio, is_test=is_test)
+        short = self.shortcut(input, num_filters * 2, stride,
+                              is_test=is_test)
+        return layers.relu(short + scale)
+
+    def net(self, input, class_dim: int = 1000, is_test: bool = False,
+            dropout_prob: float = 0.2):
+        cardinality, depth, num_filters = _CFG[self.layers]
+        reduction_ratio = 16
+        if self.layers == 152:
+            conv = self.conv_bn_layer(input, 64, 3, stride=2, act="relu",
+                                      is_test=is_test)
+            conv = self.conv_bn_layer(conv, 64, 3, act="relu",
+                                      is_test=is_test)
+            conv = self.conv_bn_layer(conv, 128, 3, act="relu",
+                                      is_test=is_test)
+        else:
+            conv = self.conv_bn_layer(input, 64, 7, stride=2, act="relu",
+                                      is_test=is_test)
+        conv = layers.pool2d(conv, pool_size=3, pool_stride=2,
+                             pool_padding=1, pool_type="max")
+        for block in range(len(depth)):
+            for i in range(depth[block]):
+                conv = self.bottleneck_block(
+                    conv, num_filters[block],
+                    stride=2 if i == 0 and block != 0 else 1,
+                    cardinality=cardinality,
+                    reduction_ratio=reduction_ratio, is_test=is_test)
+        pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+        drop = layers.dropout(pool, dropout_prob=dropout_prob,
+                              is_test=is_test)
+        from ..framework.initializer import ConstantInitializer
+
+        return layers.fc(drop, size=class_dim, act="softmax",
+                         param_attr=ParamAttr(
+                             name=self.prefix + "_fc_w",
+                             initializer=ConstantInitializer(0.05)))
+
+
+def se_resnext50(input, class_dim=1000, **kw):
+    return SE_ResNeXt(50).net(input, class_dim, **kw)
+
+
+def se_resnext101(input, class_dim=1000, **kw):
+    return SE_ResNeXt(101).net(input, class_dim, **kw)
+
+
+def se_resnext152(input, class_dim=1000, **kw):
+    return SE_ResNeXt(152).net(input, class_dim, **kw)
