@@ -1,0 +1,44 @@
+"""Trainer file-barrier — parity with
+incubate/fleet/utils/fleet_barrier_util.py:21 check_all_trainers_ready:
+every trainer drops a ready marker on a shared filesystem and spins until
+all trainer_num markers exist. Storage-agnostic here: any
+:class:`paddle_tpu.incubate.fleet.utils.fs.FS` (LocalFS for single-host
+multiprocess runs, HDFSClient for clusters).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from .fs import FS, LocalFS
+
+__all__ = ["check_all_trainers_ready"]
+
+
+def check_all_trainers_ready(ready_path: str, epoch: int,
+                             trainer_id: int = None,
+                             trainer_num: int = None,
+                             fs: FS = None,
+                             poll_interval: float = 0.2,
+                             timeout: float = 600.0) -> None:
+    if trainer_id is None or trainer_num is None:
+        from ..base.fleet_base import fleet
+
+        trainer_id = fleet.worker_index() if trainer_id is None else trainer_id
+        trainer_num = fleet.worker_num() if trainer_num is None else trainer_num
+    fs = fs or LocalFS()
+    if not fs.is_dir(ready_path):
+        fs.mkdirs(ready_path)
+    marker = os.path.join(ready_path, f"ready.{epoch}.{trainer_id}.done")
+    fs.touch(marker)
+    deadline = time.time() + timeout
+    while True:
+        ready = [p for p in fs.ls(ready_path)
+                 if os.path.basename(p).startswith(f"ready.{epoch}.")]
+        if len(ready) >= trainer_num:
+            return
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"barrier at {ready_path} epoch {epoch}: only "
+                f"{len(ready)}/{trainer_num} trainers ready")
+        time.sleep(poll_interval)

@@ -1,0 +1,104 @@
+"""KV HTTP server — parity with incubate/fleet/utils/http_server.py
+(KVHandler/KVHTTPServer/KVServer): the rendezvous store fleet launchers use
+to exchange endpoints/ready flags before collectives exist.
+
+GET /scope/key -> value bytes; PUT /scope/key stores body; DELETE removes.
+``should_stop`` mirrors the reference's size-contract (stop once every
+scope holds its expected number of deletions).
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Dict
+
+__all__ = ["KVHandler", "KVHTTPServer", "KVServer"]
+
+
+class KVHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence default stderr spam
+        pass
+
+    def _parts(self):
+        path = self.path.strip("/")
+        if "/" not in path:
+            return None, None
+        scope, key = path.split("/", 1)
+        return scope, key
+
+    def do_GET(self):
+        scope, key = self._parts()
+        with self.server.kv_lock:
+            val = self.server.kv.get(scope, {}).get(key)
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_PUT(self):
+        scope, key = self._parts()
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with self.server.kv_lock:
+            self.server.kv.setdefault(scope, {})[key] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        scope, key = self._parts()
+        with self.server.kv_lock:
+            if key in self.server.kv.get(scope, {}):
+                del self.server.kv[scope][key]
+                self.server.delete_kv.setdefault(scope, set()).add(key)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVHTTPServer(HTTPServer):
+    def __init__(self, port, handler):
+        super().__init__(("", port), handler)
+        self.kv: Dict[str, Dict[str, bytes]] = {}
+        self.delete_kv: Dict[str, set] = {}
+        self.kv_lock = threading.Lock()
+
+    def get_deleted_size(self, scope):
+        with self.kv_lock:
+            return len(self.delete_kv.get(scope, ()))
+
+
+class KVServer:
+    """http_server.py:149 — background KV server with a stop contract:
+    ``size`` maps scope -> number of DELETEs that signal completion."""
+
+    def __init__(self, port: int, size: Dict[str, int] = None):
+        self.http_server = KVHTTPServer(port, KVHandler)
+        self.size = dict(size or {})
+        self.listen_thread = None
+
+    @property
+    def port(self):
+        return self.http_server.server_address[1]
+
+    def start(self):
+        self.listen_thread = threading.Thread(
+            target=self.http_server.serve_forever, daemon=True)
+        self.listen_thread.start()
+        return self
+
+    def stop(self):
+        self.http_server.shutdown()
+        if self.listen_thread is not None:
+            self.listen_thread.join(timeout=5)
+        self.http_server.server_close()
+
+    def should_stop(self) -> bool:
+        for scope, want in self.size.items():
+            if self.http_server.get_deleted_size(scope) < want:
+                return False
+        return True
+
+    shoud_stop = should_stop  # reference method name (sic)

@@ -1,0 +1,58 @@
+"""fleet utils: KV http server rendezvous + trainer barrier."""
+import threading
+import urllib.request
+
+import pytest
+
+from paddle_tpu.incubate.fleet.utils import (KVServer,
+                                             check_all_trainers_ready)
+from paddle_tpu.incubate.fleet.utils.fs import LocalFS
+
+
+def test_kv_server_put_get_delete():
+    srv = KVServer(0, size={"init": 2}).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        req = urllib.request.Request(f"{base}/init/ep0", data=b"1.2.3.4:80",
+                                     method="PUT")
+        urllib.request.urlopen(req)
+        got = urllib.request.urlopen(f"{base}/init/ep0").read()
+        assert got == b"1.2.3.4:80"
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"{base}/init/missing")
+        assert not srv.should_stop()
+        for key in ("ep0", "ep1"):
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/init/{key}", data=b"x", method="PUT"))
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/init/{key}", method="DELETE"))
+        assert srv.should_stop()
+    finally:
+        srv.stop()
+
+
+def test_trainer_barrier(tmp_path):
+    path = str(tmp_path / "ready")
+    errs = []
+
+    def trainer(tid):
+        try:
+            check_all_trainers_ready(path, epoch=0, trainer_id=tid,
+                                     trainer_num=3, fs=LocalFS(),
+                                     timeout=20)
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=trainer, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+
+
+def test_trainer_barrier_timeout(tmp_path):
+    with pytest.raises(TimeoutError):
+        check_all_trainers_ready(str(tmp_path / "r2"), epoch=0,
+                                 trainer_id=0, trainer_num=2, fs=LocalFS(),
+                                 poll_interval=0.05, timeout=0.5)
