@@ -54,6 +54,7 @@ from .framework.program import (  # noqa: F401
 )
 
 from . import clip  # noqa: F401
+from . import nets  # noqa: F401
 from . import contrib  # noqa: F401
 from . import distribution  # noqa: F401
 from . import reader  # noqa: F401

@@ -1,0 +1,116 @@
+"""fluid.nets — composite network helpers (python/paddle/fluid/nets.py):
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention. Pure layer composition; everything fuses
+under the whole-program jit.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    """nets.py:29 — conv2d then pool2d."""
+    conv_out = layers.conv2d(
+        input, num_filters, filter_size, stride=conv_stride,
+        padding=conv_padding, dilation=conv_dilation, groups=conv_groups,
+        param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """nets.py:141 — VGG-style conv stack (+BN/dropout per conv) + pool."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+    n = len(conv_num_filter)
+
+    def extend(obj):
+        if not hasattr(obj, "__len__"):
+            return [obj] * n
+        assert len(obj) == n
+        return list(obj)
+
+    conv_padding = extend(conv_padding)
+    conv_filter_size = extend(conv_filter_size)
+    param_attr = extend(param_attr)
+    conv_with_batchnorm = extend(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = extend(conv_batchnorm_drop_rate)
+
+    for i in range(n):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(
+            tmp, conv_num_filter[i], conv_filter_size[i],
+            padding=conv_padding[i], param_attr=param_attr[i],
+            act=local_conv_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(tmp, dropout_prob=drop_rate)
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       length=None):
+    """nets.py:256 — sequence_conv then sequence_pool (padded convention:
+    optional ``length``)."""
+    conv_out = layers.sequence_conv(input, num_filters, filter_size,
+                                    param_attr=param_attr, act=act,
+                                    bias_attr=bias_attr, length=length)
+    return layers.sequence_pool(conv_out, pool_type, length=length)
+
+
+def glu(input, dim=-1):
+    """nets.py:328 — gated linear unit: a * sigmoid(b) over a split."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """nets.py:372 — multi-head scaled-dot attention over fluid layers
+    (queries [B, Tq, D], keys/values [B, Tk, D])."""
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError("queries and keys must share hidden size")
+    d = queries.shape[-1]
+    head_dim = d // num_heads
+
+    def split_heads(x):
+        if num_heads == 1:
+            return x
+        r = layers.reshape(x, shape=[0, 0, num_heads, head_dim])
+        return layers.transpose(r, perm=[0, 2, 1, 3])
+
+    def combine_heads(x):
+        if num_heads == 1:
+            return x
+        t = layers.transpose(x, perm=[0, 2, 1, 3])
+        return layers.reshape(t, shape=[0, 0, num_heads * head_dim])
+
+    q = split_heads(queries)
+    k = split_heads(keys)
+    v = split_heads(values)
+    scaled_q = layers.scale(q, scale=head_dim ** -0.5)
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    return combine_heads(ctx)

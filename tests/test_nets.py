@@ -1,0 +1,66 @@
+"""fluid.nets composite helpers."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_simple_img_conv_pool_and_group():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 28, 28], dtype="float32")
+        a = fluid.nets.simple_img_conv_pool(img, 4, 5, pool_size=2,
+                                            pool_stride=2, act="relu")
+        b = fluid.nets.img_conv_group(a, [8, 8], pool_size=2,
+                                      pool_stride=2, conv_act="relu",
+                                      conv_with_batchnorm=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (v,) = exe.run(main,
+                   feed={"img": np.random.rand(2, 1, 28, 28).astype(
+                       "float32")},
+                   fetch_list=[b])
+    assert v.shape == (2, 8, 6, 6), v.shape
+
+
+def test_glu_halves_channels():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        g = fluid.nets.glu(x, dim=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    x_np = np.random.rand(3, 8).astype("float32")
+    (v,) = exe.run(main, feed={"x": x_np}, fetch_list=[g])
+    want = x_np[:, :4] * (1 / (1 + np.exp(-x_np[:, 4:])))
+    np.testing.assert_allclose(v, want, rtol=1e-5)
+
+
+def test_scaled_dot_product_attention():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data("q", [5, 16], dtype="float32")
+        k = fluid.layers.data("k", [7, 16], dtype="float32")
+        v = fluid.layers.data("v", [7, 16], dtype="float32")
+        ctx = fluid.nets.scaled_dot_product_attention(q, k, v, num_heads=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    out, = exe.run(main, feed={"q": rng.randn(2, 5, 16).astype("float32"),
+                               "k": rng.randn(2, 7, 16).astype("float32"),
+                               "v": rng.randn(2, 7, 16).astype("float32")},
+                   fetch_list=[ctx])
+    assert out.shape == (2, 5, 16)
+    # attention rows are convex combinations: outputs bounded by value range
+    assert np.isfinite(out).all()
+
+
+def test_sequence_conv_pool():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6, 8], dtype="float32")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        out = fluid.nets.sequence_conv_pool(x, 12, 3, act="sigmoid",
+                                            pool_type="max", length=ln)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (v,) = exe.run(main, feed={"x": np.random.rand(2, 6, 8).astype(
+        "float32"), "ln": np.array([6, 3], "int64")}, fetch_list=[out])
+    assert v.shape == (2, 12)
