@@ -1,3 +1,4 @@
 from .fs import FS, HDFSClient, LocalFS  # noqa: F401
 from .http_server import KVServer  # noqa: F401
 from .fleet_barrier_util import check_all_trainers_ready  # noqa: F401
+from .fleet_util import FleetUtil  # noqa: F401

@@ -1,0 +1,212 @@
+"""FleetUtil — the operational subset of
+incubate/fleet/utils/fleet_util.py:53 that carries over to the TPU build:
+rank-0 logging, scope-var zeroing, global AUC/metrics from the streaming
+stat buckets (the auc op's StatPos/StatNeg), dense-param pulls, inference
+model export, and done-file bookkeeping for pass-style training. The
+BoxPS/xbox cache-model paths stay out (BoxPS hardware).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["FleetUtil"]
+
+_logger = logging.getLogger("paddle_tpu.fleet")
+
+
+class FleetUtil:
+    def __init__(self, mode: str = "transpiler", fleet=None):
+        self.mode = mode
+        self._fleet = fleet
+
+    # -- rank-0 logging ----------------------------------------------------
+    def _rank(self) -> int:
+        if self._fleet is not None:
+            try:
+                return self._fleet.worker_index()
+            except Exception:
+                pass
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def rank0_print(self, s: str) -> None:
+        if self._rank() == 0:
+            print(s, flush=True)
+
+    def rank0_info(self, s: str) -> None:
+        if self._rank() == 0:
+            _logger.info(s)
+
+    def rank0_error(self, s: str) -> None:
+        if self._rank() == 0:
+            _logger.error(s)
+
+    # -- scope utilities ---------------------------------------------------
+    def set_zero(self, var_name: str, scope=None, param_type="int64"):
+        """fleet_util.py:121 — zero a stat var (AUC buckets between passes)."""
+        import jax.numpy as jnp
+
+        from ....framework.executor import global_scope
+
+        scope = scope or global_scope()
+        var = scope.find_var(var_name)
+        if var is None:
+            raise KeyError(var_name)
+        arr = np.asarray(var)
+        scope.set_var(var_name, jnp.zeros(arr.shape, arr.dtype))
+
+    # -- global metrics ----------------------------------------------------
+    @staticmethod
+    def _auc_from_stats(stat_pos: np.ndarray, stat_neg: np.ndarray) -> float:
+        """AUC from per-threshold counts (auc op bucket layout)."""
+        stat_pos = np.asarray(stat_pos, np.float64).reshape(-1)
+        stat_neg = np.asarray(stat_neg, np.float64).reshape(-1)
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(len(stat_pos) - 1, -1, -1):
+            auc += stat_neg[i] * tot_pos + stat_pos[i] * stat_neg[i] / 2.0
+            tot_pos += stat_pos[i]
+            tot_neg += stat_neg[i]
+        return auc / tot_pos / tot_neg if tot_pos and tot_neg else 0.0
+
+    def get_global_auc(self, scope=None, stat_pos: str = "_auc_stat_pos",
+                       stat_neg: str = "_auc_stat_neg") -> float:
+        """fleet_util.py:186 — AUC over ALL trainers: sum the local stat
+        buckets across workers (fleet allreduce when available, else the
+        local buckets) and integrate."""
+        from ....framework.executor import global_scope
+
+        scope = scope or global_scope()
+        pos = np.asarray(scope.find_var(stat_pos))
+        neg = np.asarray(scope.find_var(stat_neg))
+        if self._fleet is not None:
+            try:
+                pos = self._fleet.all_reduce(pos)
+                neg = self._fleet.all_reduce(neg)
+            except Exception:
+                pass
+        return self._auc_from_stats(pos, neg)
+
+    def print_global_auc(self, scope=None, stat_pos: str = "_auc_stat_pos",
+                         stat_neg: str = "_auc_stat_neg",
+                         print_prefix: str = "") -> float:
+        auc = self.get_global_auc(scope, stat_pos, stat_neg)
+        self.rank0_print(f"{print_prefix} global auc = {auc:.6f}")
+        return auc
+
+    def get_global_metrics(self, scope=None, stat_pos: str = "_auc_stat_pos",
+                           stat_neg: str = "_auc_stat_neg") -> Dict[str, float]:
+        """fleet_util.py:1268 subset: auc + base counts from the buckets."""
+        from ....framework.executor import global_scope
+
+        scope = scope or global_scope()
+        pos = np.asarray(scope.find_var(stat_pos), dtype=np.float64)
+        neg = np.asarray(scope.find_var(stat_neg), dtype=np.float64)
+        n_pos, n_neg = float(pos.sum()), float(neg.sum())
+        total = n_pos + n_neg
+        return {
+            "auc": self._auc_from_stats(pos, neg),
+            "actual_ctr": n_pos / total if total else 0.0,
+            "total_ins_num": total,
+            "pos_ins_num": n_pos,
+        }
+
+    # -- params / model io -------------------------------------------------
+    def pull_all_dense_params(self, scope, program, endpoints: List[str],
+                              trainer_id: int = 0):
+        """fleet_util.py:833 — refresh every trainable param in scope from
+        the pservers (PS-mode eval path)."""
+        import jax.numpy as jnp
+
+        from ....distributed import PSClient
+
+        client = PSClient.instance(trainer_id)
+        for p in program.global_block().all_parameters():
+            if not getattr(p, "trainable", True):
+                continue
+            val = client.pull(endpoints[0], p.name)
+            scope.set_var(p.name, jnp.asarray(np.asarray(val)))
+
+    def save_paddle_inference_model(self, executor, dirname,
+                                    feeded_var_names, target_vars,
+                                    main_program=None, scope=None):
+        """fleet_util.py:876 — plain save_inference_model (the xbox base
+        conversion is BoxPS-specific)."""
+        from .... import io
+
+        return io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program=main_program)
+
+    # -- pass/done-file bookkeeping ---------------------------------------
+    def write_model_donefile(self, output_path: str, day, pass_id,
+                             xbox_base_key=None, fs=None,
+                             donefile_name: str = "donefile.txt"):
+        """fleet_util.py:362 — append a done record after a pass's model is
+        persisted, so downstream consumers only read finished models."""
+        from .fs import LocalFS
+
+        fs = fs or LocalFS()
+        if self._rank() != 0:
+            return
+        model_path = f"{output_path}/{day}/{pass_id}"
+        record = "\t".join([str(day), str(pass_id),
+                            str(xbox_base_key or int(time.time())),
+                            model_path])
+        done = os.path.join(output_path, donefile_name)
+        existing = fs.cat(done).decode() if fs.is_file(done) else ""
+        if model_path in existing:
+            return
+        if not fs.is_dir(output_path):
+            fs.mkdirs(output_path)
+        tmp = os.path.join(output_path, donefile_name + ".tmp")
+        payload = (existing + record + "\n").encode()
+        fs.touch(tmp)
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        fs.rename(tmp, done, overwrite=True)
+
+    def get_last_save_model(self, output_path: str, fs=None,
+                            donefile_name: str = "donefile.txt"):
+        """fleet_util.py:1158 — (day, pass_id, path) of the newest record,
+        or (-1, -1, "") when none exists."""
+        from .fs import LocalFS
+
+        fs = fs or LocalFS()
+        done = os.path.join(output_path, donefile_name)
+        if not fs.is_file(done):
+            return -1, -1, ""
+        lines = [l for l in fs.cat(done).decode().splitlines() if l.strip()]
+        if not lines:
+            return -1, -1, ""
+        day, pass_id, _key, path = lines[-1].split("\t")
+        return int(day), int(pass_id), path
+
+    def get_online_pass_interval(self, days: str, hours: str,
+                                 split_interval, split_per_pass,
+                                 is_data_hourly_placed: bool = False):
+        """fleet_util.py:1207 — enumerate the file-split names in each
+        online-training pass."""
+        split_interval = int(split_interval)
+        split_per_pass = int(split_per_pass)
+        splits_per_day = 24 * 60 // split_interval
+        pass_per_day = splits_per_day // split_per_pass
+        left_train_hour = int(hours.split(" ")[0]) if hours else 0
+        del left_train_hour  # parity arg; file naming below is canonical
+        online_pass_interval = []
+        for i in range(pass_per_day):
+            passes = []
+            for j in range(split_per_pass):
+                split_idx = i * split_per_pass + j
+                h = split_idx * split_interval // 60
+                m = split_idx * split_interval % 60
+                if is_data_hourly_placed:
+                    passes.append(f"{h:02d}")
+                else:
+                    passes.append(f"{h:02d}{m:02d}")
+            online_pass_interval.append(passes)
+        return online_pass_interval

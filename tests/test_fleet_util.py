@@ -1,0 +1,89 @@
+"""FleetUtil operational subset: AUC from stat buckets, done-file
+bookkeeping, pass intervals, dense pulls."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.incubate.fleet.utils import FleetUtil
+from paddle_tpu.incubate.fleet.utils.fs import LocalFS
+
+
+def test_auc_from_stats_matches_sklearn_style():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(500)
+    labels = (scores + rng.randn(500) * 0.3 > 0.5).astype(int)
+    nt = 255
+    pos = np.zeros(nt + 1, np.int64)
+    neg = np.zeros(nt + 1, np.int64)
+    idx = np.clip((scores * nt).astype(int), 0, nt)
+    for i, l in zip(idx, labels):
+        (pos if l else neg)[i] += 1
+    auc = FleetUtil._auc_from_stats(pos, neg)
+    # exact pairwise AUC oracle
+    s_pos = scores[labels == 1]
+    s_neg = scores[labels == 0]
+    cmp = (s_pos[:, None] > s_neg[None, :]).sum() \
+        + 0.5 * (s_pos[:, None] == s_neg[None, :]).sum()
+    want = cmp / (len(s_pos) * len(s_neg))
+    assert abs(auc - want) < 0.01, (auc, want)
+
+
+def test_set_zero_and_global_metrics():
+    import jax.numpy as jnp
+
+    util = FleetUtil()
+    scope = fluid.Scope()
+    scope.set_var("_auc_stat_pos", jnp.asarray(np.array([0, 5, 5], "int64")))
+    scope.set_var("_auc_stat_neg", jnp.asarray(np.array([10, 0, 0], "int64")))
+    m = util.get_global_metrics(scope)
+    assert m["auc"] == 1.0 and m["pos_ins_num"] == 10 \
+        and m["total_ins_num"] == 20
+    util.set_zero("_auc_stat_pos", scope)
+    assert np.asarray(scope.find_var("_auc_stat_pos")).sum() == 0
+
+
+def test_donefile_roundtrip(tmp_path):
+    util = FleetUtil()
+    out = str(tmp_path / "models")
+    assert util.get_last_save_model(out) == (-1, -1, "")
+    util.write_model_donefile(out, 20260730, 1)
+    util.write_model_donefile(out, 20260730, 2)
+    util.write_model_donefile(out, 20260730, 2)  # dedup
+    day, pass_id, path = util.get_last_save_model(out)
+    assert (day, pass_id) == (20260730, 2)
+    assert path.endswith("20260730/2")
+    lines = LocalFS().cat(f"{out}/donefile.txt").decode().splitlines()
+    assert len(lines) == 2
+
+
+def test_online_pass_interval():
+    util = FleetUtil()
+    passes = util.get_online_pass_interval("", "", split_interval=30,
+                                           split_per_pass=2)
+    assert len(passes) == 24  # 48 half-hour splits / 2 per pass
+    assert passes[0] == ["0000", "0030"]
+    assert passes[-1] == ["2300", "2330"]
+
+
+def test_pull_all_dense_params():
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        fluid.layers.fc(x, 2, name="pf")
+    server = ParameterServer("127.0.0.1:0", trainer_num=1, sync_mode=False)
+    w = np.full((4, 2), 3.0, "float32")
+    b = np.zeros((2,), "float32")
+    server.register_dense("pf.w_0", (4, 2), "sgd")
+    server.register_dense("pf.b_0", (2,), "sgd")
+    server.start()
+    try:
+        c = PSClient.instance(0)
+        c.ensure_init(server.endpoint, "pf.w_0", w)
+        c.ensure_init(server.endpoint, "pf.b_0", b)
+        scope = fluid.Scope()
+        FleetUtil().pull_all_dense_params(scope, main, [server.endpoint])
+        np.testing.assert_array_equal(np.asarray(scope.find_var("pf.w_0")), w)
+    finally:
+        server.stop()
+        PSClient.reset_all()
