@@ -1,0 +1,2 @@
+
+from . import data_generator  # noqa: F401

@@ -1,0 +1,142 @@
+"""DataGenerator — parity with python/paddle/fluid/incubate/data_generator/
+(__init__.py:21): the authoring API that turns user records into the
+MultiSlot text the Dataset engine (and its C++ parser) consumes.
+
+Users override ``generate_sample(line)`` (returning an iterator of
+``[(slot_name, [feasigns...]), ...]``) and optionally ``generate_batch``;
+``run_from_stdin`` / ``run_from_memory`` stream the encoded lines, exactly
+like the reference's mapreduce-side usage.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Iterable, List, Optional, Tuple
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+        self._line_limit = None
+
+    def _set_line_limit(self, line_limit: int):
+        if not isinstance(line_limit, int) or line_limit < 1:
+            raise ValueError("line_limit must be a positive int")
+        self._line_limit = line_limit
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    # -- user hooks --------------------------------------------------------
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "rewrite generate_sample to return an iterator of "
+            "[(name, [feasign, ...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, line) -> str:
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    # -- drivers -----------------------------------------------------------
+    def _run(self, lines: Iterable[str], out) -> int:
+        batch_samples = []
+        n_out = 0
+        for i, line in enumerate(lines):
+            if self._line_limit is not None and i >= self._line_limit:
+                break
+            gen = self.generate_sample(line)
+            for sample in gen():
+                if sample is None:
+                    continue
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    for processed in self.generate_batch(batch_samples)():
+                        out.write(self._gen_str(processed))
+                        n_out += 1
+                    batch_samples = []
+        if batch_samples:
+            for processed in self.generate_batch(batch_samples)():
+                out.write(self._gen_str(processed))
+                n_out += 1
+        return n_out
+
+    def run_from_stdin(self):
+        """__init__.py:101 — encode stdin lines to stdout (the hadoop
+        streaming / dataset preprocessing entry point)."""
+        return self._run(sys.stdin, sys.stdout)
+
+    def run_from_memory(self):
+        """__init__.py:67 — generate without an input stream (the user's
+        generate_sample ignores its line argument)."""
+        return self._run([None], sys.stdout)
+
+    def run_from_lines(self, lines: Iterable[str], out=None):
+        """Convenience for tests/pipelines: encode an iterable, return the
+        emitted text when ``out`` is None."""
+        import io
+
+        buf = out or io.StringIO()
+        self._run(lines, buf)
+        return buf.getvalue() if out is None else None
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Encode ``[(name, [feasigns])...]`` as MultiSlot text:
+    ``<n> v1 .. vn`` per slot, space-joined (data_feed.cc MultiSlotDataFeed
+    line grammar; slot name order must match the Dataset's use-var list).
+    The first sample pins each slot's type (int stays int, any float makes
+    the slot float) and the slot order — later samples must conform."""
+
+    def _gen_str(self, line) -> str:
+        if not isinstance(line, (list, tuple)):
+            raise ValueError("expected [(name, [feasign...]), ...]")
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                ty = "d"
+                for e in elements:
+                    if isinstance(e, float):
+                        ty = "f"
+                        break
+                self._proto_info.append((name, ty))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"sample has {len(line)} slots, first sample had "
+                    f"{len(self._proto_info)}")
+            for (name, elements), (pname, pty) in zip(line,
+                                                      self._proto_info):
+                if name != pname:
+                    raise ValueError(
+                        f"slot order changed: {name!r} vs {pname!r}")
+                if pty == "d" and any(isinstance(e, float)
+                                      for e in elements):
+                    raise ValueError(
+                        f"slot {name!r} was int, got float feasign")
+        parts: List[str] = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            for e in elements:
+                parts.append(str(e))
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Pre-stringified variant: elements are already strings."""
+
+    def _gen_str(self, line) -> str:
+        parts: List[str] = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
