@@ -6,12 +6,24 @@ from .layers import Layer  # noqa: F401
 from .varbase import VarBase  # noqa: F401
 from .nn import (  # noqa: F401
     BatchNorm,
+    BilinearTensorProduct,
     Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
     Dropout,
     Embedding,
+    GroupNorm,
+    GRUUnit,
+    InstanceNorm,
     LayerNorm,
     Linear,
+    NCE,
     Pool2D,
+    PRelu,
+    RowConv,
+    SequenceConv,
+    SpectralNorm,
 )
 from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401

@@ -279,3 +279,447 @@ def _apply_act(x, act):
     if act == "swish":
         return jax.nn.silu(x)
     raise NotImplementedError(f"activation {act}")
+
+
+# ---------------------------------------------------------------------------
+# remaining fluid/dygraph/nn.py classes — forwards reuse the registered op
+# lowerings through a shim (static and eager modes share kernels, like the
+# reference's PreparedOp)
+# ---------------------------------------------------------------------------
+
+
+class _ShimOp:
+    def __init__(self, attrs=None, outputs=None):
+        self.attrs = dict(attrs or {})
+        self.outputs = outputs or {}
+        self.inputs = {}
+
+    def attr(self, k, d=None):
+        return self.attrs.get(k, d)
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+
+class _ShimCtx:
+    _counter = [0]
+
+    def __init__(self):
+        self.is_test = False
+
+    def rng_for(self, op):
+        self._counter[0] += 1
+        return jax.random.fold_in(jax.random.PRNGKey(20260730),
+                                  self._counter[0])
+
+    def axis_name(self, ring_id):
+        return None
+
+
+def _run_lowering(lower, ins, attrs, out_slot):
+    out = lower(_ShimCtx(), _ShimOp(attrs), ins)[out_slot]
+    return out[0] if isinstance(out, (list, tuple)) else out
+
+
+class Conv3D(Layer):
+    """fluid/dygraph/nn.py:278."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        f = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 3
+        self._attrs = dict(
+            strides=list(stride) if isinstance(stride, (list, tuple))
+            else [stride] * 3,
+            paddings=list(padding) if isinstance(padding, (list, tuple))
+            else [padding] * 3,
+            dilations=list(dilation) if isinstance(dilation, (list, tuple))
+            else [dilation] * 3,
+            groups=groups or 1)
+        fan_in = (num_channels // (groups or 1)) * int(np.prod(f))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // (groups or 1)] + list(f),
+            attr=param_attr, dtype=dtype,
+            default_initializer=NormalInitializer(
+                0.0, float(np.sqrt(2.0 / fan_in))))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, x):
+        from ..ops.nn import conv3d as lower
+
+        def fn(xv, wv, *b):
+            out = _run_lowering(lower, {"Input": [xv], "Filter": [wv]},
+                                self._attrs, "Output")
+            if b:
+                out = out + b[0].reshape(1, -1, 1, 1, 1)
+            return _apply_act(out, self._act)
+
+        args = (x, self.weight) + ((self.bias,)
+                                   if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+class Conv2DTranspose(Layer):
+    """fluid/dygraph/nn.py:2443."""
+
+    def __init__(self, num_channels, num_filters, filter_size, output_size=None,
+                 padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        f = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 2
+        self._attrs = dict(
+            strides=list(stride) if isinstance(stride, (list, tuple))
+            else [stride] * 2,
+            paddings=list(padding) if isinstance(padding, (list, tuple))
+            else [padding] * 2,
+            dilations=list(dilation) if isinstance(dilation, (list, tuple))
+            else [dilation] * 2,
+            groups=groups or 1)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // (groups or 1)] + list(f),
+            attr=param_attr, dtype=dtype)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, x):
+        from ..ops.nn import conv2d_transpose as lower
+
+        def fn(xv, wv, *b):
+            out = _run_lowering(lower, {"Input": [xv], "Filter": [wv]},
+                                self._attrs, "Output")
+            if b:
+                out = out + b[0].reshape(1, -1, 1, 1)
+            return _apply_act(out, self._act)
+
+        args = (x, self.weight) + ((self.bias,)
+                                   if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+class Conv3DTranspose(Layer):
+    """fluid/dygraph/nn.py:480 — over the conv3d_transpose op."""
+
+    def __init__(self, num_channels, num_filters, filter_size, padding=0,
+                 stride=1, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        f = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size] * 3
+        self._attrs = dict(
+            strides=list(stride) if isinstance(stride, (list, tuple))
+            else [stride] * 3,
+            paddings=list(padding) if isinstance(padding, (list, tuple))
+            else [padding] * 3,
+            dilations=list(dilation) if isinstance(dilation, (list, tuple))
+            else [dilation] * 3,
+            groups=groups or 1)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // (groups or 1)] + list(f),
+            attr=param_attr, dtype=dtype)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, x):
+        from ..ops.nn_extra import conv3d_transpose as lower
+
+        def fn(xv, wv, *b):
+            out = _run_lowering(lower, {"Input": [xv], "Filter": [wv]},
+                                self._attrs, "Output")
+            if b:
+                out = out + b[0].reshape(1, -1, 1, 1, 1)
+            return _apply_act(out, self._act)
+
+        args = (x, self.weight) + ((self.bias,)
+                                   if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+class InstanceNorm(Layer):
+    """fluid/dygraph/nn.py:999."""
+
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__()
+        self._eps = epsilon
+        self.scale = self.create_parameter(
+            [num_channels], attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, x):
+        eps = self._eps
+
+        def fn(xv, sv, bv):
+            axes = tuple(range(2, xv.ndim))
+            xf = xv.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes, keepdims=True)
+            var = jnp.var(xf, axis=axes, keepdims=True)
+            shape = (1, -1) + (1,) * (xv.ndim - 2)
+            y = (xf - mean) * lax.rsqrt(var + eps)
+            return (y * sv.reshape(shape) + bv.reshape(shape)).astype(
+                xv.dtype)
+
+        return apply_op(fn, x, self.scale, self.bias)
+
+
+class GroupNorm(Layer):
+    """fluid/dygraph/nn.py:2851."""
+
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._groups = groups
+        self._eps = epsilon
+        self._act = act
+        self.weight = self.create_parameter(
+            [channels], attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, x):
+        g, eps, act = self._groups, self._eps, self._act
+
+        def fn(xv, sv, bv):
+            N, C = xv.shape[:2]
+            rest = xv.shape[2:]
+            xg = xv.reshape(N, g, C // g, *rest).astype(jnp.float32)
+            axes = tuple(range(2, xg.ndim))
+            mean = jnp.mean(xg, axis=axes, keepdims=True)
+            var = jnp.var(xg, axis=axes, keepdims=True)
+            y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(xv.shape)
+            shape = (1, -1) + (1,) * (xv.ndim - 2)
+            y = y * sv.reshape(shape) + bv.reshape(shape)
+            return _apply_act(y.astype(xv.dtype), act)
+
+        return apply_op(fn, x, self.weight, self.bias)
+
+
+class SpectralNorm(Layer):
+    """fluid/dygraph/nn.py:2955 — over the spectral_norm op (power
+    iteration buffers kept as layer state)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._attrs = dict(dim=dim, power_iters=power_iters, eps=eps)
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self._u = VarBase(jnp.asarray(
+            np.random.RandomState(0).randn(h), dtype), persistable=True,
+            stop_gradient=True, trainable=False)
+        self._v = VarBase(jnp.asarray(
+            np.random.RandomState(1).randn(w), dtype), persistable=True,
+            stop_gradient=True, trainable=False)
+        self.register_buffer("_u", self._u)
+        self.register_buffer("_v", self._v)
+
+    def forward(self, weight):
+        from ..ops.nn_extra import spectral_norm as lower
+
+        u, v = self._u.value, self._v.value
+
+        def fn(wv):
+            return _run_lowering(
+                lower, {"Weight": [wv], "U": [u], "V": [v]},
+                self._attrs, "Out")
+
+        return apply_op(fn, weight)
+
+
+class GRUUnit(Layer):
+    """fluid/dygraph/nn.py:1807 — one gru_unit step."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        D = size // 3
+        acts = {"identity": 0, "sigmoid": 1, "tanh": 2, "relu": 3}
+        self._attrs = dict(activation=acts[activation],
+                           gate_activation=acts[gate_activation],
+                           origin_mode=origin_mode)
+        self.weight = self.create_parameter([D, 3 * D], attr=param_attr,
+                                            dtype=dtype)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [1, 3 * D], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, input, hidden):
+        from ..ops.nn_extra import gru_unit as lower
+
+        def fn(xv, hv, wv, *b):
+            ins = {"Input": [xv], "HiddenPrev": [hv], "Weight": [wv]}
+            if b:
+                ins["Bias"] = [b[0]]
+            outs = lower(_ShimCtx(), _ShimOp(self._attrs), ins)
+            return outs["Hidden"], outs["ResetHiddenPrev"], outs["Gate"]
+
+        args = (input, hidden, self.weight) + (
+            (self.bias,) if self.bias is not None else ())
+        return apply_op(fn, *args, n_outs=3)
+
+
+class NCE(Layer):
+    """fluid/dygraph/nn.py:1985 — over the nce op."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__()
+        sampler_idx = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}
+        self._attrs = dict(num_total_classes=int(num_total_classes),
+                           num_neg_samples=int(num_neg_samples),
+                           sampler=sampler_idx[sampler], seed=seed,
+                           is_sparse=is_sparse)
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            attr=param_attr, dtype=dtype)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_total_classes, 1], attr=bias_attr, dtype=dtype,
+            is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):
+        from ..ops.ctr import nce as lower
+
+        def fn(xv, wv, lbl, *b):
+            ins = {"Input": [xv], "Weight": [wv], "Label": [lbl]}
+            if b:
+                ins["Bias"] = [b[0]]
+            return lower(_ShimCtx(), _ShimOp(self._attrs), ins)["Cost"]
+
+        args = (input, self.weight, label) + (
+            (self.bias,) if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+class PRelu(Layer):
+    """fluid/dygraph/nn.py:2223."""
+
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape)[1:]
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(0.25))
+
+    def forward(self, x):
+        mode = self._mode
+
+        def fn(xv, av):
+            alpha = av
+            if mode == "channel":
+                alpha = av.reshape((1, -1) + (1,) * (xv.ndim - 2))
+            elif mode == "element":
+                alpha = av.reshape((1,) + av.shape)
+            return jnp.where(xv > 0, xv, alpha * xv)
+
+        return apply_op(fn, x, self.weight)
+
+
+class BilinearTensorProduct(Layer):
+    """fluid/dygraph/nn.py:2327: out_k = x^T W_k y + b_k."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr,
+            dtype=dtype)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [1, output_dim], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, x, y):
+        act = self._act
+
+        def fn(xv, yv, wv, *b):
+            out = jnp.einsum("bi,kij,bj->bk", xv, wv, yv)
+            if b:
+                out = out + b[0]
+            return _apply_act(out.astype(xv.dtype), act)
+
+        args = (x, y, self.weight) + ((self.bias,)
+                                      if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+class SequenceConv(Layer):
+    """fluid/dygraph/nn.py:2678 on the padded convention [B, T, D]."""
+
+    def __init__(self, input_dim, num_filters, filter_size=3,
+                 filter_stride=1, padding=True, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self._filter_size = filter_size
+        self.weight = self.create_parameter(
+            [filter_size * input_dim, num_filters], attr=param_attr,
+            dtype=dtype)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, x, length=None):
+        from ..ops.sequence import sequence_conv as lower
+
+        attrs = dict(contextLength=self._filter_size,
+                     contextStart=-(self._filter_size // 2),
+                     contextStride=1)
+        act = self._act
+
+        def fn(xv, wv, *rest):
+            ins = {"X": [xv], "Filter": [wv]}
+            if length is not None:
+                ins["Length"] = [_unwrap_any(length)]
+            out = _run_lowering(lower, ins, attrs, "Out")
+            if self.bias is not None:
+                out = out + rest[0]
+            return _apply_act(out, act)
+
+        args = (x, self.weight) + ((self.bias,)
+                                   if self.bias is not None else ())
+        return apply_op(fn, *args)
+
+
+class RowConv(Layer):
+    """fluid/dygraph/nn.py:2772 — lookahead row convolution."""
+
+    def __init__(self, input_dim, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self.weight = self.create_parameter(
+            [future_context_size, input_dim], attr=param_attr, dtype=dtype)
+
+    def forward(self, x):
+        from ..ops.nn_extra import row_conv as lower
+
+        act = self._act
+
+        def fn(xv, wv):
+            out = _run_lowering(lower, {"X": [xv], "Filter": [wv]}, {},
+                                "Out")
+            return _apply_act(out, act)
+
+        return apply_op(fn, x, self.weight)
+
+
+def _unwrap_any(v):
+    return v.value if isinstance(v, VarBase) else jnp.asarray(v)

@@ -245,19 +245,26 @@ def bicubic_interp(ctx, op, ins):
 
 
 def _conv_transpose(x, w, strides, paddings, dilations, groups, nd):
-    # w: [Cin, Cout/g, *k] (paddle transposed-conv filter layout)
+    """Transposed conv as an lhs-dilated conv (same recipe as the 2-D op in
+    ops/nn.py conv2d_transpose). w: [Cin, Cout/g, *k] paddle layout ->
+    rhs [Cout, Cin/g, *k], spatially flipped."""
+    k = w.shape[2:]
+    cin, cout_g = w.shape[0], w.shape[1]
+    wg = w.reshape((groups, cin // groups, cout_g) + k)
+    wg = jnp.swapaxes(wg, 1, 2)                      # [g, Cout/g, Cin/g, k]
+    w_t = wg.reshape((groups * cout_g, cin // groups) + k)
+    w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+    pad = [(dilations[i] * (k[i] - 1) - paddings[i],
+            dilations[i] * (k[i] - 1) - paddings[i]) for i in range(nd)]
     dn = lax.conv_dimension_numbers(
-        x.shape, (w.shape[1] * groups, w.shape[0] // groups) + w.shape[2:],
+        x.shape, w_t.shape,
         (("NCHW", "OIHW", "NCHW") if nd == 2 else
          ("NCDHW", "OIDHW", "NCDHW")))
-    pads = [(p, p) for p in paddings]
-    # lax.conv_transpose wants rhs [*k, I, O]-style per dn; easiest correct
-    # route: gradient of the forward conv == transposed conv
-    out = lax.conv_transpose(
-        x, jnp.moveaxis(w, (0, 1), (1, 0)), strides, pads,
-        rhs_dilation=dilations, dimension_numbers=dn,
-        transpose_kernel=True)
-    return out
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * nd, padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+    return out.astype(x.dtype)
 
 
 @register_op("conv3d_transpose", diff_inputs=("Input", "Filter"))
@@ -275,15 +282,10 @@ def conv3d_transpose(ctx, op, ins):
 def depthwise_conv2d_transpose(ctx, op, ins):
     x, w = ins["Input"][0], ins["Filter"][0]
     C = x.shape[1]
-    dn = lax.conv_dimension_numbers(
-        x.shape, (C, 1) + w.shape[2:], ("NCHW", "OIHW", "NCHW"))
-    out = lax.conv_transpose(
-        x, jnp.moveaxis(w, (0, 1), (1, 0)),
-        tuple(op.attr("strides", [1, 1])),
-        [(p, p) for p in op.attr("paddings", [0, 0])],
-        rhs_dilation=tuple(op.attr("dilations", [1, 1])),
-        dimension_numbers=dn, transpose_kernel=True,
-        feature_group_count=C)
+    out = _conv_transpose(
+        x, w, tuple(op.attr("strides", [1, 1])),
+        tuple(op.attr("paddings", [0, 0])),
+        tuple(op.attr("dilations", [1, 1])), C, nd=2)
     return {"Output": out}
 
 
