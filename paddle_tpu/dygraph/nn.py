@@ -319,6 +319,10 @@ class _ShimCtx:
         return None
 
 
+def _ntuple(v, nd):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * nd
+
+
 def _run_lowering(lower, ins, attrs, out_slot):
     out = lower(_ShimCtx(), _ShimOp(attrs), ins)[out_slot]
     return out[0] if isinstance(out, (list, tuple)) else out
@@ -332,16 +336,11 @@ class Conv3D(Layer):
                  bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
         super().__init__()
         self._act = act
-        f = filter_size if isinstance(filter_size, (list, tuple)) \
-            else [filter_size] * 3
-        self._attrs = dict(
-            strides=list(stride) if isinstance(stride, (list, tuple))
-            else [stride] * 3,
-            paddings=list(padding) if isinstance(padding, (list, tuple))
-            else [padding] * 3,
-            dilations=list(dilation) if isinstance(dilation, (list, tuple))
-            else [dilation] * 3,
-            groups=groups or 1)
+        f = _ntuple(filter_size, 3)
+        self._attrs = dict(strides=_ntuple(stride, 3),
+                           paddings=_ntuple(padding, 3),
+                           dilations=_ntuple(dilation, 3),
+                           groups=groups or 1)
         fan_in = (num_channels // (groups or 1)) * int(np.prod(f))
         self.weight = self.create_parameter(
             [num_filters, num_channels // (groups or 1)] + list(f),
@@ -374,16 +373,13 @@ class Conv2DTranspose(Layer):
                  bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
         super().__init__()
         self._act = act
-        f = filter_size if isinstance(filter_size, (list, tuple)) \
-            else [filter_size] * 2
-        self._attrs = dict(
-            strides=list(stride) if isinstance(stride, (list, tuple))
-            else [stride] * 2,
-            paddings=list(padding) if isinstance(padding, (list, tuple))
-            else [padding] * 2,
-            dilations=list(dilation) if isinstance(dilation, (list, tuple))
-            else [dilation] * 2,
-            groups=groups or 1)
+        f = _ntuple(filter_size, 2)
+        self._output_size = (None if output_size is None
+                             else _ntuple(output_size, 2))
+        self._attrs = dict(strides=_ntuple(stride, 2),
+                           paddings=_ntuple(padding, 2),
+                           dilations=_ntuple(dilation, 2),
+                           groups=groups or 1)
         self.weight = self.create_parameter(
             [num_channels, num_filters // (groups or 1)] + list(f),
             attr=param_attr, dtype=dtype)
@@ -396,6 +392,11 @@ class Conv2DTranspose(Layer):
         def fn(xv, wv, *b):
             out = _run_lowering(lower, {"Input": [xv], "Filter": [wv]},
                                 self._attrs, "Output")
+            if self._output_size is not None:
+                # reference semantics: output_size crops the stride-default
+                # output (must lie in (default - stride, default])
+                oh, ow = self._output_size
+                out = out[:, :, :oh, :ow]
             if b:
                 out = out + b[0].reshape(1, -1, 1, 1)
             return _apply_act(out, self._act)
@@ -413,16 +414,11 @@ class Conv3DTranspose(Layer):
                  bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
         super().__init__()
         self._act = act
-        f = filter_size if isinstance(filter_size, (list, tuple)) \
-            else [filter_size] * 3
-        self._attrs = dict(
-            strides=list(stride) if isinstance(stride, (list, tuple))
-            else [stride] * 3,
-            paddings=list(padding) if isinstance(padding, (list, tuple))
-            else [padding] * 3,
-            dilations=list(dilation) if isinstance(dilation, (list, tuple))
-            else [dilation] * 3,
-            groups=groups or 1)
+        f = _ntuple(filter_size, 3)
+        self._attrs = dict(strides=_ntuple(stride, 3),
+                           paddings=_ntuple(padding, 3),
+                           dilations=_ntuple(dilation, 3),
+                           groups=groups or 1)
         self.weight = self.create_parameter(
             [num_channels, num_filters // (groups or 1)] + list(f),
             attr=param_attr, dtype=dtype)
@@ -528,12 +524,28 @@ class SpectralNorm(Layer):
     def forward(self, weight):
         from ..ops.nn_extra import spectral_norm as lower
 
+        # advance the persistent power-iteration state eagerly (reference
+        # kernel updates the U/V buffers every forward), then normalize
+        # with the converged vectors (power_iters=0 in the lowering)
+        wv = _unwrap_any(weight)
+        dim = self._attrs["dim"]
+        eps = self._attrs["eps"]
+        wm = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
         u, v = self._u.value, self._v.value
+        for _ in range(max(int(self._attrs["power_iters"]), 1)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        self._u.value = jax.lax.stop_gradient(u)
+        self._v.value = jax.lax.stop_gradient(v)
+        u_c, v_c = self._u.value, self._v.value
+        attrs = dict(self._attrs, power_iters=0)
 
-        def fn(wv):
+        def fn(wvar):
             return _run_lowering(
-                lower, {"Weight": [wv], "U": [u], "V": [v]},
-                self._attrs, "Out")
+                lower, {"Weight": [wvar], "U": [u_c], "V": [v_c]},
+                attrs, "Out")
 
         return apply_op(fn, weight)
 
@@ -583,6 +595,11 @@ class NCE(Layer):
                            num_neg_samples=int(num_neg_samples),
                            sampler=sampler_idx[sampler], seed=seed,
                            is_sparse=is_sparse)
+        if sampler == "custom_dist" and custom_dist is None:
+            raise ValueError("sampler='custom_dist' needs custom_dist=")
+        self._custom_dist = (None if custom_dist is None else
+                             jnp.asarray(np.asarray(custom_dist,
+                                                    np.float32)))
         self.weight = self.create_parameter([num_total_classes, dim],
                                             attr=param_attr, dtype=dtype)
         self.bias = None if bias_attr is False else self.create_parameter(
@@ -596,6 +613,10 @@ class NCE(Layer):
             ins = {"Input": [xv], "Weight": [wv], "Label": [lbl]}
             if b:
                 ins["Bias"] = [b[0]]
+            if self._custom_dist is not None:
+                ins["CustomDistProbs"] = [self._custom_dist]
+            if sample_weight is not None:
+                ins["SampleWeight"] = [_unwrap_any(sample_weight)]
             return lower(_ShimCtx(), _ShimOp(self._attrs), ins)["Cost"]
 
         args = (input, self.weight, label) + (
@@ -705,8 +726,10 @@ class RowConv(Layer):
                  act=None, dtype="float32"):
         super().__init__()
         self._act = act
+        # reference row_conv filter: current step + future_context rows
         self.weight = self.create_parameter(
-            [future_context_size, input_dim], attr=param_attr, dtype=dtype)
+            [future_context_size + 1, input_dim], attr=param_attr,
+            dtype=dtype)
 
     def forward(self, x):
         from ..ops.nn_extra import row_conv as lower

@@ -254,24 +254,36 @@ def conv3d(ctx, op, ins):
 @register_op("conv2d_transpose", diff_inputs=("Input", "Filter"))
 def conv2d_transpose(ctx, op, ins):
     x, w = ins["Input"][0], ins["Filter"][0]  # NCHW, IOHW in paddle
-    strides = _pair(op.attr("strides", [1, 1]))
-    paddings = _pair(op.attr("paddings", [0, 0]))
-    dilations = _pair(op.attr("dilations", [1, 1]))
-    groups = op.attr("groups", 1) or 1
-    # paddle filter layout for transpose conv: (in, out/groups, kh, kw)
-    kh, kw = w.shape[2], w.shape[3]
-    pad = [
-        (dilations[0] * (kh - 1) - paddings[0], dilations[0] * (kh - 1) - paddings[0]),
-        (dilations[1] * (kw - 1) - paddings[1], dilations[1] * (kw - 1) - paddings[1]),
-    ]
-    w_t = jnp.swapaxes(w, 0, 1)  # -> (out/g, in, kh, kw)
-    w_t = jnp.flip(w_t, axis=(2, 3))
-    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
-    out = lax.conv_general_dilated(
-        x, w_t, window_strides=(1, 1), padding=pad,
-        lhs_dilation=strides, dimension_numbers=dn, feature_group_count=groups,
-    ).astype(x.dtype)
+    out = conv_transpose_nd(
+        x, w, _pair(op.attr("strides", [1, 1])),
+        _pair(op.attr("paddings", [0, 0])),
+        _pair(op.attr("dilations", [1, 1])),
+        op.attr("groups", 1) or 1, nd=2)
     return {"Output": out}
+
+
+def conv_transpose_nd(x, w, strides, paddings, dilations, groups, nd):
+    """Transposed conv as an lhs-dilated conv. w: [Cin, Cout/g, *k] (paddle
+    layout) -> rhs [Cout, Cin/g, *k] via per-group rearrangement, spatially
+    flipped. Shared by conv2d_transpose / conv3d_transpose /
+    depthwise_conv2d_transpose (ops/nn_extra.py)."""
+    k = w.shape[2:]
+    cin, cout_g = w.shape[0], w.shape[1]
+    wg = w.reshape((groups, cin // groups, cout_g) + k)
+    wg = jnp.swapaxes(wg, 1, 2)                      # [g, Cout/g, Cin/g, k]
+    w_t = wg.reshape((groups * cout_g, cin // groups) + k)
+    w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+    pad = [(dilations[i] * (k[i] - 1) - paddings[i],
+            dilations[i] * (k[i] - 1) - paddings[i]) for i in range(nd)]
+    dn = lax.conv_dimension_numbers(
+        x.shape, w_t.shape,
+        (("NCHW", "OIHW", "NCHW") if nd == 2 else
+         ("NCDHW", "OIDHW", "NCDHW")))
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * nd, padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+    return out.astype(x.dtype)
 
 
 @register_op("pool2d", diff_inputs=("X",))

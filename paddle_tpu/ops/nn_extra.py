@@ -244,34 +244,12 @@ def bicubic_interp(ctx, op, ins):
 # ---------------------------------------------------------------------------
 
 
-def _conv_transpose(x, w, strides, paddings, dilations, groups, nd):
-    """Transposed conv as an lhs-dilated conv (same recipe as the 2-D op in
-    ops/nn.py conv2d_transpose). w: [Cin, Cout/g, *k] paddle layout ->
-    rhs [Cout, Cin/g, *k], spatially flipped."""
-    k = w.shape[2:]
-    cin, cout_g = w.shape[0], w.shape[1]
-    wg = w.reshape((groups, cin // groups, cout_g) + k)
-    wg = jnp.swapaxes(wg, 1, 2)                      # [g, Cout/g, Cin/g, k]
-    w_t = wg.reshape((groups * cout_g, cin // groups) + k)
-    w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
-    pad = [(dilations[i] * (k[i] - 1) - paddings[i],
-            dilations[i] * (k[i] - 1) - paddings[i]) for i in range(nd)]
-    dn = lax.conv_dimension_numbers(
-        x.shape, w_t.shape,
-        (("NCHW", "OIHW", "NCHW") if nd == 2 else
-         ("NCDHW", "OIDHW", "NCDHW")))
-    out = lax.conv_general_dilated(
-        x, w_t, window_strides=(1,) * nd, padding=pad,
-        lhs_dilation=strides, rhs_dilation=dilations,
-        dimension_numbers=dn, feature_group_count=groups)
-    return out.astype(x.dtype)
-
-
 @register_op("conv3d_transpose", diff_inputs=("Input", "Filter"))
 def conv3d_transpose(ctx, op, ins):
     """operators/conv_transpose_op.cc, 3-D."""
     x, w = ins["Input"][0], ins["Filter"][0]
-    return {"Output": _conv_transpose(
+    from .nn import conv_transpose_nd
+    return {"Output": conv_transpose_nd(
         x, w, tuple(op.attr("strides", [1, 1, 1])),
         tuple(op.attr("paddings", [0, 0, 0])),
         tuple(op.attr("dilations", [1, 1, 1])),
@@ -281,11 +259,12 @@ def conv3d_transpose(ctx, op, ins):
 @register_op("depthwise_conv2d_transpose", diff_inputs=("Input", "Filter"))
 def depthwise_conv2d_transpose(ctx, op, ins):
     x, w = ins["Input"][0], ins["Filter"][0]
-    C = x.shape[1]
-    out = _conv_transpose(
+    from .nn import conv_transpose_nd
+
+    out = conv_transpose_nd(
         x, w, tuple(op.attr("strides", [1, 1])),
         tuple(op.attr("paddings", [0, 0])),
-        tuple(op.attr("dilations", [1, 1])), C, nd=2)
+        tuple(op.attr("dilations", [1, 1])), x.shape[1], nd=2)
     return {"Output": out}
 
 
