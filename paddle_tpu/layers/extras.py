@@ -1,0 +1,929 @@
+"""Auto-generated + composite layer functions closing the rest of the
+fluid.layers surface.
+
+The reference generates most of its thin layer functions from OpProtos
+(python/paddle/fluid/layers/ops.py generate_layer_fn / layer_function_
+generator.py); :func:`generate_layer_fn` here is the same idea over this
+framework's OpSpec registry: one declarative row per op -> a layer function
+with named args mapped to input slots and attrs. Composites (image_resize,
+dice/npair/rank losses, has_inf/nan, step counters...) are hand-written
+below.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.program import Variable, default_main_program
+
+__all__: List[str] = ["generate_layer_fn"]
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def generate_layer_fn(op_type: str, in_slots: Sequence[str],
+                      out_slots: Sequence[str],
+                      attr_defaults: Optional[Dict] = None,
+                      out_dtypes: Optional[Dict[str, str]] = None,
+                      n_ret: Optional[int] = None, name: str = None):
+    """Build a thin layer fn for a registered op: positional/keyword args
+    named after the (lowercased) input slots; remaining kwargs become op
+    attrs (layer_function_generator.py capability)."""
+    attr_defaults = dict(attr_defaults or {})
+    fn_name = name or op_type
+
+    def layer(*args, name=None, **kwargs):
+        helper = LayerHelper(fn_name, name=name)
+        inputs = {}
+        arg_list = list(args)
+        for slot in in_slots:
+            key = slot.lower()
+            if arg_list:
+                val = arg_list.pop(0)
+            elif key in kwargs:
+                val = kwargs.pop(key)
+            else:
+                val = None
+            if val is None:
+                continue
+            inputs[slot] = list(val) if isinstance(val, (list, tuple)) \
+                else [val]
+        attrs = dict(attr_defaults)
+        attrs.update(kwargs)
+        outs = {}
+        ret = []
+        first_in = next(iter(inputs.values()))[0] if inputs else None
+        for slot in out_slots:
+            dtype = (out_dtypes or {}).get(
+                slot, first_in.dtype if isinstance(first_in, Variable)
+                else "float32")
+            v = helper.create_variable_for_type_inference(dtype)
+            outs[slot] = [v]
+            ret.append(v)
+        helper.append_op(type=op_type, inputs=inputs, outputs=outs,
+                         attrs=attrs)
+        keep = n_ret if n_ret is not None else len(ret)
+        return ret[0] if keep == 1 else tuple(ret[:keep])
+
+    layer.__name__ = fn_name
+    layer.__doc__ = (f"Auto-generated layer for the `{op_type}` op "
+                     f"(reference generate_layer_fn parity).")
+    return layer
+
+
+# ---------------------------------------------------------------------------
+# table-generated single-op layers (op already registered in ops/)
+# ---------------------------------------------------------------------------
+
+_TABLE = [
+    # (fn name, op, in slots, out slots, attr defaults, out dtypes, n_ret)
+    ("affine_channel", "affine_channel", ["X", "Scale", "Bias"], ["Out"],
+     {"data_layout": "NCHW"}, None, 1),
+    ("affine_grid", "affine_grid", ["Theta", "OutputShape"], ["Output"],
+     {}, None, 1),
+    ("multiplex", "multiplex", ["X", "Ids"], ["Out"], {}, None, 1),
+    ("row_conv", "row_conv", ["X", "Filter"], ["Out"], {}, None, 1),
+    ("add_position_encoding", "add_position_encoding", ["X"], ["Out"],
+     {"alpha": 1.0, "beta": 1.0}, None, 1),
+    ("space_to_depth", "space_to_depth", ["X"], ["Out"], {}, None, 1),
+    ("shuffle_channel", "shuffle_channel", ["X"], ["Out"], {"group": 1},
+     None, 1),
+    ("teacher_student_sigmoid_loss", "teacher_student_sigmoid_loss",
+     ["X", "Label"], ["Y"], {}, None, 1),
+    ("bpr_loss", "bpr_loss", ["X", "Label"], ["Loss"], {}, None, 1),
+    ("hinge_loss", "hinge_loss", ["Logits", "Labels"], ["Loss"], {}, None, 1),
+    ("margin_rank_loss", "margin_rank_loss", ["Label", "Left", "Right"],
+     ["Out", "Activated"], {"margin": 0.1}, None, 1),
+    ("rank_loss", "rank_loss", ["Label", "Left", "Right"], ["Out"], {},
+     None, 1),
+    ("log_loss", "log_loss", ["Predicted", "Labels"], ["Loss"],
+     {"epsilon": 1e-4}, None, 1),
+    ("mean_iou", "mean_iou", ["Predictions", "Labels"],
+     ["OutMeanIou", "OutWrong", "OutCorrect"], {}, None, 3),
+    ("cos_sim", "cos_sim", ["X", "Y"], ["Out", "XNorm", "YNorm"], {},
+     None, 1),
+    ("grid_sampler", "grid_sampler", ["X", "Grid"], ["Output"], {}, None, 1),
+    ("pixel_shuffle", "pixel_shuffle", ["X"], ["Out"],
+     {"upscale_factor": 1}, None, 1),
+    ("lod_reset", "lod_reset", ["X", "Y"], ["Out"], {}, None, 1),
+    ("lod_append", "lod_reset", ["X", "Y"], ["Out"], {}, None, 1),
+    ("sequence_reshape", "sequence_reshape", ["X"], ["Out"],
+     {"new_dim": 1}, None, 1),
+    ("sequence_scatter", "sequence_scatter", ["X", "Ids", "Updates"],
+     ["Out"], {}, None, 1),
+    ("scatter_nd_add", "scatter_nd_add", ["X", "Index", "Updates"],
+     ["Out"], {}, None, 1),
+    ("unbind", "unbind", ["X"], ["Out"], {}, None, 1),
+    ("pool3d", "pool3d", ["X"], ["Out"], {"pooling_type": "max"}, None, 1),
+    ("conv3d_transpose_op", "conv3d_transpose", ["Input", "Filter"],
+     ["Output"], {}, None, 1),
+    ("deformable_conv", "deformable_conv",
+     ["Input", "Offset", "Mask", "Filter"], ["Output"],
+     {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+      "groups": 1, "deformable_groups": 1, "im2col_step": 1}, None, 1),
+    ("prroi_pool", "prroi_pool", ["X", "ROIs"], ["Out"],
+     {"pooled_height": 1, "pooled_width": 1, "spatial_scale": 1.0},
+     None, 1),
+    ("psroi_pool", "psroi_pool", ["X", "ROIs"], ["Out"],
+     {"spatial_scale": 1.0}, None, 1),
+    ("polygon_box_transform", "polygon_box_transform", ["Input"],
+     ["Output"], {}, None, 1),
+    ("box_decoder_and_assign", "box_decoder_and_assign",
+     ["PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"],
+     ["DecodeBox", "OutputAssignBox"], {"box_clip": 4.135}, None, 2),
+    ("retinanet_target_assign", "retinanet_target_assign",
+     ["Anchor", "GtBoxes", "GtLabels"],
+     ["TargetLabel", "TargetBBox", "BBoxInsideWeight", "ForegroundNumber"],
+     {"positive_overlap": 0.5, "negative_overlap": 0.4},
+     {"TargetLabel": "int32", "ForegroundNumber": "int32"}, 4),
+    ("brelu", "brelu", ["X"], ["Out"], {"t_min": 0.0, "t_max": 24.0},
+     None, 1),
+    ("soft_relu", "soft_relu", ["X"], ["Out"], {"threshold": 40.0},
+     None, 1),
+    ("selu", "selu", ["X"], ["Out"], {}, None, 1),
+    ("stanh", "stanh", ["X"], ["Out"],
+     {"scale_a": 0.67, "scale_b": 1.7159}, None, 1),
+    ("maxout", "maxout", ["X"], ["Out"], {"groups": 1}, None, 1),
+    ("sampling_id", "sampling_id", ["X"], ["Out"], {},
+     {"Out": "int64"}, 1),
+    ("similarity_focus", "similarity_focus", ["X"], ["Out"], {}, None, 1),
+    ("temporal_shift", "temporal_shift", ["X"], ["Out"],
+     {"seg_num": 1, "shift_ratio": 0.25}, None, 1),
+    ("uniform_random_batch_size_like", "uniform_random_batch_size_like",
+     ["Input"], ["Out"], {"shape": [], "min": -1.0, "max": 1.0}, None, 1),
+    ("gaussian_random_batch_size_like", "gaussian_random_batch_size_like",
+     ["Input"], ["Out"], {"shape": [], "mean": 0.0, "std": 1.0}, None, 1),
+    ("inplace_abn", "inplace_abn",
+     ["X", "Scale", "Bias", "Mean", "Variance"],
+     ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+     {}, None, 1),
+    ("gather_tree", "gather_tree", ["Ids", "Parents"], ["Out"], {},
+     {"Out": "int64"}, 1),
+    ("shard_index_layer", "shard_index", ["X"], ["Out"],
+     {"ignore_value": -1}, {"Out": "int64"}, 1),
+    ("random_crop", "random_crop", ["X"], ["Out"], {"shape": []}, None, 1),
+    ("tensor_array_to_tensor", "tensor_array_to_tensor", ["X"],
+     ["Out"], {"axis": 0, "use_stack": False}, None, 1),
+    ("edit_distance", "edit_distance",
+     ["Hyps", "Refs", "HypsLength", "RefsLength"],
+     ["Out", "SequenceNum"], {"normalized": True},
+     {"SequenceNum": "int64"}, 2),
+]
+
+import sys as _sys
+
+_mod = _sys.modules[__name__]
+from ..framework.registry import has_op as _has_op
+from ..framework.executor import _HOST_OPS as _HOST
+
+for _row in _TABLE:
+    _fn_name, _op, _ins, _outs, _attrs, _odt, _n = _row
+    if not (_has_op(_op) or _op in _HOST):
+        continue  # table rows are aspirational only when the op exists
+    setattr(_mod, _fn_name,
+            generate_layer_fn(_op, _ins, _outs, _attrs, _odt, _n,
+                              name=_fn_name))
+    __all__.append(_fn_name)
+
+
+# ---------------------------------------------------------------------------
+# composites
+# ---------------------------------------------------------------------------
+
+
+@_export
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    """fluid.layers.image_resize (nn.py): dispatch over the interp ops."""
+    op_map = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+              "BICUBIC": "bicubic_interp", "TRILINEAR": "trilinear_interp",
+              "LINEAR": "linear_interp"}
+    op_type = op_map[resample.upper()]
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
+    if out_shape is not None:
+        nd = len(out_shape)
+        names = {1: ["out_w"], 2: ["out_h", "out_w"],
+                 3: ["out_d", "out_h", "out_w"]}[nd]
+        for n, v in zip(names, out_shape):
+            attrs[n] = int(v)
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(type=op_type, inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+@_export
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "LINEAR",
+                        align_corners=align_corners, align_mode=align_mode)
+
+
+@_export
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "TRILINEAR",
+                        align_corners=align_corners, align_mode=align_mode)
+
+
+@_export
+def resize_bicubic(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "BICUBIC",
+                        align_corners=align_corners, align_mode=align_mode)
+
+
+@_export
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len (static shapes)."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    oh = int(round(h * out_short_len / short))
+    ow = int(round(w * out_short_len / short))
+    return image_resize(input, [oh, ow], resample=resample)
+
+
+@_export
+def dice_loss(input, label, epsilon=1e-5):
+    """fluid.layers.dice_loss (nn.py): 1 - 2|X∩Y| / (|X|+|Y|)."""
+    from .tensor import cast, reduce_mean, reduce_sum
+
+    label = cast(label, input.dtype)
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label, dim=reduce_dims)
+    denom = reduce_sum(input, dim=reduce_dims) \
+        + reduce_sum(label, dim=reduce_dims)
+    dice_score = 1 - inse * 2 / (denom + epsilon)
+    return reduce_mean(dice_score)
+
+
+@_export
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """fluid.layers.npair_loss (nn.py): cross-entropy over anchor-positive
+    similarity + L2 on the embeddings."""
+    from .nn import matmul, softmax_with_cross_entropy
+    from .tensor import cast, equal, reduce_mean, reduce_sum, reshape, \
+        transpose
+
+    l2loss = (reduce_mean(reduce_sum(anchor * anchor, dim=1))
+              + reduce_mean(reduce_sum(positive * positive, dim=1))) \
+        * l2_reg
+    sim = matmul(anchor, positive, transpose_y=True)
+    lbl = reshape(labels, [-1, 1])
+    tgt = cast(equal(lbl, transpose(lbl, perm=[1, 0])), "float32")
+    tgt = tgt / reduce_sum(tgt, dim=1, keep_dim=True)
+    ce = softmax_with_cross_entropy(sim, tgt, soft_label=True)
+    return reduce_mean(ce) + l2loss
+
+
+@_export
+def has_inf(x):
+    helper = LayerHelper("has_inf")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="has_inf", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+@_export
+def has_nan(x):
+    helper = LayerHelper("has_nan")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="has_nan", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+@_export
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """fluid.layers.autoincreased_step_counter: persistable int64 counter
+    incremented once per executor run."""
+    helper = LayerHelper("global_step_counter")
+    block = helper.main_program.global_block()
+    name = counter_name or "@STEP_COUNTER@"
+    if name in block.vars:
+        counter = block.var(name)
+    else:
+        counter = block.create_var(name=name, shape=[1], dtype="int64",
+                                   persistable=True)
+        from ..framework.initializer import ConstantInitializer
+
+        startup = helper.startup_program
+        sv = startup.global_block().create_var(
+            name=name, shape=[1], dtype="int64", persistable=True)
+        ConstantInitializer(float(begin - step))(sv,
+                                                 startup.global_block())
+    helper.append_op(type="increment", inputs={"X": [counter]},
+                     outputs={"Out": [counter]}, attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+@_export
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """fluid.layers.create_parameter."""
+    helper = LayerHelper("create_parameter")
+    from ..framework.param_attr import ParamAttr
+
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape=list(shape), dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+@_export
+def sequence_first_step(input, length=None):
+    """fluid.layers.sequence_first_step over sequence_pool FIRST."""
+    from .sequence import sequence_pool
+
+    return sequence_pool(input, "FIRST", length=length)
+
+
+@_export
+def sequence_last_step(input, length=None):
+    from .sequence import sequence_pool
+
+    return sequence_pool(input, "LAST", length=length)
+
+
+@_export
+def sequence_concat(input, name=None):
+    """fluid.layers.sequence_concat: concat padded sequences on time."""
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]}, attrs={"axis": 1})
+    return out
+
+
+@_export
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop_tensor", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    inputs = {"X": [x]}
+    if isinstance(shape, Variable):
+        inputs["Shape"] = [shape]
+    elif shape is not None:
+        attrs["shape"] = [int(s) for s in shape]
+    if isinstance(offsets, Variable):
+        inputs["Offsets"] = [offsets]
+    elif offsets is not None:
+        attrs["offsets"] = [int(o) for o in offsets]
+    helper.append_op(type="crop_tensor", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+crop = crop_tensor
+__all__.append("crop")
+
+
+@_export
+def rank(input):
+    """fluid.layers.rank — static rank as a constant tensor."""
+    from .tensor import fill_constant
+
+    return fill_constant([1], "int32", len(input.shape))
+
+
+# ---------------------------------------------------------------------------
+# wave 2: wrappers over existing ops, param-creating layers, control-flow
+# composites, and the documentation/decorator utilities
+# ---------------------------------------------------------------------------
+
+_TABLE2 = [
+    ("diag", "diag", ["Diagonal"], ["Out"], {}, None, 1),
+    ("eye", "eye", [], ["Out"], {"num_rows": 1, "num_columns": -1,
+                                 "dtype": "float32"}, {"Out": "float32"}, 1),
+    ("is_empty", "is_empty", ["X"], ["Out"], {}, {"Out": "bool"}, 1),
+    ("size", "size", ["Input"], ["Out"], {}, {"Out": "int64"}, 1),
+    ("sum", "sum", ["X"], ["Out"], {}, None, 1),
+    ("reverse", "reverse", ["X"], ["Out"], {"axis": [0]}, None, 1),
+    ("lrn", "lrn", ["X"], ["Out"], {"n": 5, "k": 1.0, "alpha": 1e-4,
+                                    "beta": 0.75}, None, 1),
+    ("scatter_nd", "scatter_nd", ["Index", "Updates"], ["Out"],
+     {"shape": []}, None, 1),
+    ("sequence_expand", "sequence_expand", ["X", "Y"], ["Out"],
+     {"ref_level": -1}, None, 1),
+    ("unique", "unique", ["X"], ["Out", "Index"], {},
+     {"Index": "int64"}, 2),
+    ("unique_with_counts", "unique_with_counts", ["X"],
+     ["Out", "Index", "Count"], {}, {"Index": "int64", "Count": "int64"}, 3),
+    ("elementwise_floordiv", "elementwise_floordiv", ["X", "Y"], ["Out"],
+     {"axis": -1}, None, 1),
+    ("pad_constant_like", "pad_constant_like", ["X", "Y"], ["Out"],
+     {"pad_value": 0.0}, None, 1),
+    ("im2sequence", "im2sequence", ["X"], ["Out"],
+     {"kernels": [1, 1], "strides": [1, 1], "paddings": [0, 0, 0, 0]},
+     None, 1),
+    ("fsp_matrix", "fsp", ["X", "Y"], ["Out"], {}, None, 1),
+    ("hash", "hash", ["X"], ["Out"], {"num_hash": 1, "mod_by": 1},
+     {"Out": "int64"}, 1),
+    ("filter_by_instag", "filter_by_instag",
+     ["Ins", "Ins_tag", "Filter_tag"], ["Out", "LossWeight", "IndexMap"],
+     {"is_lod": True}, {"IndexMap": "int64"}, 3),
+    ("chunk_eval", "chunk_eval", ["Inference", "Label", "SeqLength"],
+     ["Precision", "Recall", "F1-Score", "NumInferChunks",
+      "NumLabelChunks", "NumCorrectChunks"],
+     {"num_chunk_types": 1, "chunk_scheme": "IOB"},
+     {"NumInferChunks": "int64", "NumLabelChunks": "int64",
+      "NumCorrectChunks": "int64"}, 6),
+    ("get_tensor_from_selected_rows", "get_tensor_from_selected_rows",
+     ["X"], ["Out"], {}, None, 1),
+    ("merge_selected_rows", "merge_selected_rows", ["X"], ["Out"], {},
+     None, 1),
+    ("locality_aware_nms", "multiclass_nms2", ["BBoxes", "Scores"],
+     ["Out", "Index", "NmsRoisNum"],
+     {"score_threshold": 0.0, "nms_top_k": 400, "keep_top_k": 100,
+      "nms_threshold": 0.3, "background_label": -1},
+     {"Index": "int64", "NmsRoisNum": "int32"}, 1),
+]
+
+for _row in _TABLE2:
+    _fn_name, _op, _ins, _outs, _attrs, _odt, _n = _row
+    if not (_has_op(_op) or _op in _HOST):
+        continue
+    setattr(_mod, _fn_name,
+            generate_layer_fn(_op, _ins, _outs, _attrs, _odt, _n,
+                              name=_fn_name))
+    __all__.append(_fn_name)
+
+# conv3d_transpose / shard_index reference-named entry points
+conv3d_transpose = generate_layer_fn(
+    "conv3d_transpose", ["Input", "Filter"], ["Output"],
+    {"strides": [1, 1, 1], "paddings": [0, 0, 0], "dilations": [1, 1, 1],
+     "groups": 1}, None, 1, name="conv3d_transpose")
+shard_index = generate_layer_fn(
+    "shard_index", ["X"], ["Out"], {"ignore_value": -1}, {"Out": "int64"},
+    1, name="shard_index")
+__all__ += ["conv3d_transpose", "shard_index"]
+
+
+@_export
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ksize = pool_size if isinstance(pool_size, (list, tuple)) \
+        else [pool_size] * 3
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": list(ksize),
+                            "adaptive": True})
+    return out
+
+
+@_export
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """fluid.layers.spectral_norm — creates the U/V iteration buffers."""
+    from ..framework.initializer import NormalInitializer
+    from ..framework.param_attr import ParamAttr
+
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w = int(np.prod(weight.shape)) // h
+    u = helper.create_parameter(
+        ParamAttr(name=None, initializer=NormalInitializer(0.0, 1.0),
+                  trainable=False), shape=[h], dtype="float32")
+    v = helper.create_parameter(
+        ParamAttr(name=None, initializer=NormalInitializer(0.0, 1.0),
+                  trainable=False), shape=[w], dtype="float32")
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(type="spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+@_export
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """fluid.layers.bilinear_tensor_product over the op of the same math
+    (einsum bi,kij,bj->bk + bias)."""
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         act=act, bias_attr=bias_attr)
+    w = helper.create_parameter(
+        param_attr, shape=[size, x.shape[1], y.shape[1]], dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="bilinear_tensor_product",
+                     inputs={"X": [x], "Y": [y], "Weight": [w]},
+                     outputs={"Out": [out]}, attrs={})
+    pre = helper.append_bias_op(out, dim_start=1)
+    return helper.append_activation(pre)
+
+
+@_export
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """fluid.layers.center_loss — creates the Centers state."""
+    from ..framework.initializer import NormalInitializer
+    from ..framework.param_attr import ParamAttr
+    from .tensor import fill_constant
+
+    helper = LayerHelper("center_loss")
+    centers = helper.create_parameter(
+        ParamAttr(name=None, initializer=NormalInitializer(0.0, 1.0),
+                  trainable=False),
+        shape=[num_classes, input.shape[1]], dtype=input.dtype)
+    rate = alpha if isinstance(alpha, Variable) \
+        else fill_constant([1], "float32", float(alpha))
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="center_loss",
+        inputs={"X": [input], "Label": [label], "Centers": [centers],
+                "CenterUpdateRate": [rate]},
+        outputs={"Loss": [loss], "SampleCenterDiff": [diff],
+                 "CentersOut": [centers]},
+        attrs={"need_update": bool(update_center)})
+    return loss
+
+
+@_export
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """fluid.layers.gru_unit — creates recurrent weight/bias params."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    D = size // 3
+    acts = {"identity": 0, "sigmoid": 1, "tanh": 2, "relu": 3}
+    w = helper.create_parameter(param_attr, shape=[D, 3 * D],
+                                dtype=input.dtype)
+    ins = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, 3 * D],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = [b]
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    rhp = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gru_unit", inputs=ins,
+                     outputs={"Gate": [gate], "ResetHiddenPrev": [rhp],
+                              "Hidden": [out]},
+                     attrs={"activation": acts[activation],
+                            "gate_activation": acts[gate_activation],
+                            "origin_mode": origin_mode})
+    return out, rhp, gate
+
+
+@_export
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """fluid.layers.lstm_unit: fc([x, h]) -> lstm_unit op."""
+    from .nn import fc
+    from .tensor import concat
+
+    D = hidden_t_prev.shape[1]
+    cat = concat([x_t, hidden_t_prev], axis=1)
+    gates = fc(cat, 4 * D, param_attr=param_attr, bias_attr=bias_attr)
+    helper = LayerHelper("lstm_unit", name=name)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+@_export
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """fluid.layers.dynamic_lstm on padded [B, T, 4D] projected input."""
+    helper = LayerHelper("lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = size // 4
+    w = helper.create_parameter(param_attr, shape=[D, 4 * D], dtype=dtype)
+    bwidth = 7 * D if use_peepholes else 4 * D
+    b = helper.create_parameter(bias_attr, shape=[1, bwidth], dtype=dtype,
+                                is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="lstm", inputs=ins,
+                     outputs={"Hidden": [hidden], "Cell": [cell]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    return hidden, cell
+
+
+@_export
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None):
+    """fluid.layers.dynamic_lstmp over the lstmp op."""
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = size // 4
+    w = helper.create_parameter(param_attr, shape=[proj_size, 4 * D],
+                                dtype=dtype)
+    wp = helper.create_parameter(param_attr, shape=[D, proj_size],
+                                 dtype=dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 4 * D], dtype=dtype,
+                                is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "ProjWeight": [wp],
+           "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="lstmp", inputs=ins,
+                     outputs={"Projection": [proj], "Cell": [cell]},
+                     attrs={"proj_clip": float(proj_clip or 0.0)})
+    return proj, cell
+
+
+@_export
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False):
+    """fluid.layers.dynamic_gru on padded [B, T, 3D] projected input."""
+    helper = LayerHelper("gru", param_attr=param_attr, bias_attr=bias_attr)
+    D = size
+    dtype = input.dtype
+    w = helper.create_parameter(param_attr, shape=[D, 3 * D], dtype=dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 3 * D], dtype=dtype,
+                                is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    hidden = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="gru", inputs=ins,
+                     outputs={"Hidden": [hidden]},
+                     attrs={"is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "activation": candidate_activation,
+                            "origin_mode": origin_mode})
+    return hidden
+
+
+@_export
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """fluid.layers.hsigmoid (default complete-binary-tree coding)."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    w = helper.create_parameter(
+        param_attr, shape=[num_classes - 1, input.shape[1]],
+        dtype=input.dtype)
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_classes - 1],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="hsigmoid", inputs=ins,
+                     outputs={"Out": [out], "PreOut": [pre]},
+                     attrs={"num_classes": int(num_classes)})
+    return out
+
+
+@_export
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """fluid.layers.auc over the streaming auc host op (stat buckets are
+    persistable state like the reference's)."""
+    from ..framework.initializer import ConstantInitializer
+    from ..framework.param_attr import ParamAttr
+
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_parameter(
+        ParamAttr(name=None, initializer=ConstantInitializer(0.0),
+                  trainable=False),
+        shape=[num_thresholds + 1], dtype="int64")
+    stat_neg = helper.create_parameter(
+        ParamAttr(name=None, initializer=ConstantInitializer(0.0),
+                  trainable=False),
+        shape=[num_thresholds + 1], dtype="int64")
+    auc_out = helper.create_variable_for_type_inference("float64")
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": [input], "Label": [label],
+                "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                 "StatNegOut": [stat_neg]},
+        attrs={"num_thresholds": num_thresholds, "curve": curve})
+    return auc_out, auc_out, [stat_pos, stat_neg]
+
+
+@_export
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """fluid.layers.ctc_greedy_decoder: argmax -> merge repeats -> strip
+    blanks (padded convention: returns decoded [B, T] + lengths)."""
+    from .tensor import argmax
+
+    helper = LayerHelper("ctc_align", name=name)
+    ids = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int64")
+    ins = {"Input": [ids]}
+    if input_length is not None:
+        ins["InputLength"] = [input_length]
+    helper.append_op(type="ctc_align", inputs=ins,
+                     outputs={"Output": [out], "OutputLength": [out_len]},
+                     attrs={"blank": int(blank), "merge_repeated": True})
+    return out, out_len
+
+
+@_export
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """fluid.layers.Print over the print host op (forward phase)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"first_n": first_n,
+                            "message": message or "",
+                            "summarize": summarize})
+    return out
+
+
+@_export
+def Assert(cond, data=None, summarize=20, name=None):
+    """fluid.layers.Assert over an assert host op."""
+    helper = LayerHelper("assert")
+    helper.append_op(type="assert",
+                     inputs={"Cond": [cond],
+                             **({"Data": list(data)} if data else {})},
+                     outputs={}, attrs={"summarize": summarize})
+
+
+@_export
+def case(pred_fn_pairs, default=None, name=None):
+    """fluid.layers.case: first true predicate wins (built on cond)."""
+    from .control_flow import cond as cond_layer
+
+    def build(pairs):
+        pred, fn = pairs[0]
+        rest = pairs[1:]
+        if rest:
+            return cond_layer(pred, fn, lambda: build(rest))
+        if default is not None:
+            return cond_layer(pred, fn, default)
+        return cond_layer(pred, fn, fn)
+
+    return build(list(pred_fn_pairs))
+
+
+@_export
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """fluid.layers.switch_case over case()."""
+    from .tensor import equal, fill_constant
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    pairs = []
+    for idx, fn in items:
+        c = fill_constant([1], branch_index.dtype, int(idx))
+        pairs.append((equal(branch_index, c), fn))
+    return case(pairs, default=default)
+
+
+# documentation/decorator utilities (layer_function_generator.py surface)
+@_export
+def autodoc(comment=""):
+    def deco(fn):
+        fn.__doc__ = (fn.__doc__ or "") + comment
+        return fn
+
+    return deco
+
+
+@_export
+def templatedoc(op_type=None):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+@_export
+def deprecated(since="", update_to="", reason=""):
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason} "
+                f"{('use ' + update_to) if update_to else ''}",
+                DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return wrapper
+
+    return deco
+
+
+@_export
+def generate_activation_fn(op_type):
+    """layer_function_generator.py:generate_activation_fn parity."""
+    return generate_layer_fn(op_type, ["X"], ["Out"], {}, None, 1,
+                             name=op_type)
+
+
+
+# distribution classes exposed under fluid.layers (reference
+# layers/distributions.py re-export)
+try:
+    from ..distribution import Categorical, MultivariateNormalDiag, \
+        Normal, Uniform  # noqa: F401
+
+    __all__ += ["Normal", "Uniform", "Categorical",
+                "MultivariateNormalDiag"]
+except ImportError:  # pragma: no cover
+    pass
+
+
+@_export
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """The reference's py_reader is superseded by DataLoader in this build
+    (the whole-program jit consumes feeds directly; there is no C++ reader
+    queue to attach). Use fluid.DataLoader / Dataset instead."""
+    raise NotImplementedError(
+        "py_reader is replaced by fluid.DataLoader on this framework "
+        "(feeds stream straight into the compiled program); see "
+        "reader.py DataLoader or dataset.py for the PaddleRec path")
+
+
+@_export
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    raise NotImplementedError(
+        "create_py_reader_by_data is replaced by fluid.DataLoader "
+        "(see py_reader)")
+
+
+@_export
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch is owned by the async dispatch + Dataset prefetch
+    queues on this framework; double_buffer is an identity."""
+    return reader
+
+
+@_export
+def read_file(reader):
+    raise NotImplementedError(
+        "file readers are replaced by fluid.DataLoader / Dataset "
+        "(reader.py, dataset.py)")
+
+
+@_export
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """fluid.layers.reorder_lod_tensor_by_rank: permute batch rows by the
+    rank table's index column (padded convention)."""
+    from .tensor import gather
+
+    helper = LayerHelper("reorder_by_rank")
+    idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="slice", inputs={"Input": [rank_table]},
+                     outputs={"Out": [idx]},
+                     attrs={"axes": [1], "starts": [0], "ends": [1]})
+    return gather(x, idx)
+
+
+
+@_export
+def load(out, file_path, load_as_fp16=False):
+    """fluid.layers.load over the load host op."""
+    helper = LayerHelper("load")
+    helper.append_op(type="load", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"file_path": file_path})
+    return out

@@ -318,3 +318,16 @@ def chunk_eval(scope, op, exe):
          np.asarray([n_label], np.int64))
     _set(scope, op.output("NumCorrectChunks")[0],
          np.asarray([n_correct], np.int64))
+
+
+@register_host_op("assert")
+def assert_op(scope, op, exe):
+    """operators/assert_op.cc: fail loudly when Cond is false."""
+    cond = _np(scope, op.input("Cond")[0])
+    if not bool(np.all(cond)):
+        parts = []
+        for name in op.input("Data") or []:
+            v = _np(scope, name)
+            parts.append(f"{name}={v.reshape(-1)[:int(op.attr('summarize', 20))]}")
+        raise AssertionError(
+            "fluid.layers.Assert failed: cond is false. " + " ".join(parts))

@@ -1,0 +1,255 @@
+"""Op batch 7: the last simple kernels backing the remaining fluid.layers
+names — pool3d, edit_distance, brelu/soft_relu/hsigmoid activations,
+sampling_id, random_crop, *_batch_size_like randoms, has_inf/has_nan,
+similarity_focus.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import dtype_to_jax, int_index_dtype
+from ..framework.registry import register_op
+
+_I64 = int_index_dtype()
+
+
+@register_op("pool3d", diff_inputs=("X",))
+def pool3d(ctx, op, ins):
+    """operators/pool_op.cc, 3-D (NCDHW)."""
+    x = ins["X"][0]
+    ptype = op.attr("pooling_type", "max")
+    ksize = list(op.attr("ksize", [2, 2, 2]))
+    strides = list(op.attr("strides", [1, 1, 1]))
+    paddings = list(op.attr("paddings", [0, 0, 0]))
+    if op.attr("global_pooling", False):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x, axis=(2, 3, 4), keepdims=True)}
+    if op.attr("adaptive", False):
+        od, oh, ow = ksize
+        N, C, D, H, W = x.shape
+        x6 = x.reshape(N, C, od, D // od, oh, H // oh, ow, W // ow)
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x6, axis=(3, 5, 7))}
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, stride, pad)
+    else:
+        s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window,
+                              stride, pad)
+        if op.attr("exclusive", True):
+            cnt = lax.reduce_window(jnp.ones_like(x, jnp.float32), 0.0,
+                                    lax.add, window, stride, pad)
+        else:
+            cnt = float(np.prod(ksize))
+        out = (s / cnt).astype(x.dtype)
+    return {"Out": out}
+
+
+@register_op("brelu", diff_inputs=("X",))
+def brelu(ctx, op, ins):
+    """operators/activation_op.cc BRelu: clip to [t_min, t_max]."""
+    return {"Out": jnp.clip(ins["X"][0], op.attr("t_min", 0.0),
+                            op.attr("t_max", 24.0))}
+
+
+@register_op("soft_relu", diff_inputs=("X",))
+def soft_relu(ctx, op, ins):
+    """operators/activation_op.cc SoftRelu: log(1+exp(clip(x, +-thr)))."""
+    thr = op.attr("threshold", 40.0)
+    x = jnp.clip(ins["X"][0], -thr, thr)
+    return {"Out": jnp.log1p(jnp.exp(x))}
+
+
+@register_op("hsigmoid", diff_inputs=("X", "W", "Bias"))
+def hsigmoid(ctx, op, ins):
+    """operators/hierarchical_sigmoid_op.cc, default (complete binary tree)
+    coding: per sample, walk ceil(log2(num_classes)) tree nodes; loss =
+    sum over path of softplus-style binary CE (math/matrix_bit_code.h)."""
+    x = ins["X"][0]                            # [B, D]
+    w = ins["W"][0]                            # [num_classes-1, D]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    num_classes = int(op.attr("num_classes"))
+    code_len = max(int(np.ceil(np.log2(num_classes))), 1)
+    B = x.shape[0]
+    # bit-code walk: code(c) = c + num_classes; node index = code>>(d+1)-1,
+    # bit = (code>>d)&1 (matrix_bit_code.h SimpleCode)
+    code = label + num_classes
+    ds = jnp.arange(code_len)
+    node = (code[:, None] >> (ds[None, :] + 1)) - 1       # [B, L]
+    bit = (code[:, None] >> ds[None, :]) & 1
+    valid = node >= 0
+    node_c = jnp.maximum(node, 0)
+    logits = jnp.einsum("bd,bld->bl", x, w[node_c])
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[node_c]
+    # binary CE with target bit: softplus(logit) - bit*logit
+    ce = jnp.log1p(jnp.exp(-jnp.abs(logits))) \
+        + jnp.maximum(logits, 0.0) - bit * logits
+    loss = jnp.sum(jnp.where(valid, ce, 0.0), axis=1, keepdims=True)
+    return {"Out": loss.astype(x.dtype), "PreOut": logits}
+
+
+@register_op("sampling_id", grad=None, needs_rng=True)
+def sampling_id(ctx, op, ins):
+    """operators/sampling_id_op.cc: sample a column index per row from the
+    probability rows of X."""
+    x = ins["X"][0]
+    key = ctx.rng_for(op)
+    ids = jax.random.categorical(key, jnp.log(x + 1e-20), axis=-1)
+    return {"Out": ids.astype(_I64)}
+
+
+@register_op("random_crop", grad=None, needs_rng=True)
+def random_crop(ctx, op, ins):
+    """operators/random_crop_op.cc: crop trailing dims to `shape` at a
+    uniformly random offset (per batch element)."""
+    x = ins["X"][0]
+    shape = [int(s) for s in op.attr("shape")]
+    nd = len(shape)
+    key = ctx.rng_for(op)
+    lead = x.shape[: x.ndim - nd]
+    maxoff = [x.shape[x.ndim - nd + i] - shape[i] for i in range(nd)]
+    offs = [jax.random.randint(jax.random.fold_in(key, i), (), 0, m + 1)
+            for i, m in enumerate(maxoff)]
+    starts = [0] * len(lead) + [o for o in offs]
+    sizes = list(lead) + shape
+    return {"Out": lax.dynamic_slice(x, starts, sizes)}
+
+
+@register_op("uniform_random_batch_size_like", grad=None, needs_rng=True)
+def uniform_random_batch_size_like(ctx, op, ins):
+    """operators/uniform_random_batch_size_like_op.cc."""
+    x = ins["Input"][0]
+    shape = [int(s) for s in op.attr("shape")]
+    shape[int(op.attr("output_dim_idx", 0))] = \
+        x.shape[int(op.attr("input_dim_idx", 0))]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    key = ctx.rng_for(op)
+    return {"Out": jax.random.uniform(
+        key, shape, dtype, op.attr("min", -1.0), op.attr("max", 1.0))}
+
+
+@register_op("gaussian_random_batch_size_like", grad=None, needs_rng=True)
+def gaussian_random_batch_size_like(ctx, op, ins):
+    x = ins["Input"][0]
+    shape = [int(s) for s in op.attr("shape")]
+    shape[int(op.attr("output_dim_idx", 0))] = \
+        x.shape[int(op.attr("input_dim_idx", 0))]
+    dtype = dtype_to_jax(op.attr("dtype", "float32"))
+    key = ctx.rng_for(op)
+    return {"Out": op.attr("mean", 0.0)
+            + op.attr("std", 1.0) * jax.random.normal(key, shape, dtype)}
+
+
+@register_op("has_inf", grad=None)
+def has_inf(ctx, op, ins):
+    return {"Out": jnp.any(jnp.isinf(ins["X"][0])).reshape(1)}
+
+
+@register_op("has_nan", grad=None)
+def has_nan(ctx, op, ins):
+    return {"Out": jnp.any(jnp.isnan(ins["X"][0])).reshape(1)}
+
+
+@register_op("similarity_focus", grad=None)
+def similarity_focus(ctx, op, ins):
+    """operators/similarity_focus_op.cc: for the selected channel(s), build
+    a 0/1 focus mask marking, for each (h, w), whether it holds the maximal
+    response in its row or column of the selected channel slice."""
+    x = ins["X"][0]                            # [N, C, H, W]
+    axis = int(op.attr("axis", 1))
+    indexes = [int(i) for i in op.attr("indexes")]
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis=1 only")
+    N, C, H, W = x.shape
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        ch = x[:, idx]                          # [N, H, W]
+        row_max = ch == jnp.max(ch, axis=2, keepdims=True)
+        col_max = ch == jnp.max(ch, axis=1, keepdims=True)
+        m = (row_max | col_max).astype(x.dtype)[:, None]
+        mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
+    return {"Out": mask}
+
+
+@register_op("edit_distance", grad=None)
+def edit_distance(ctx, op, ins):
+    """operators/edit_distance_op.cc: Levenshtein distance per pair of
+    (padded) sequences; normalized divides by the reference length."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)      # [B, Th]
+    ref = ins["Refs"][0].astype(jnp.int32)      # [B, Tr]
+    if ins.get("HypsLength"):
+        hlen = ins["HypsLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        hlen = jnp.full((hyp.shape[0],), hyp.shape[1], jnp.int32)
+    if ins.get("RefsLength"):
+        rlen = ins["RefsLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        rlen = jnp.full((ref.shape[0],), ref.shape[1], jnp.int32)
+    Th, Tr = hyp.shape[1], ref.shape[1]
+    big = jnp.asarray(1e9, jnp.float32)
+
+    def one(h, r, hl, rl):
+        # DP over ref positions as the carried row, scanned over hyp chars
+        j = jnp.arange(Tr + 1, dtype=jnp.float32)
+        row0 = jnp.where(j <= rl, j, big)
+
+        def step(carry, i):
+            row = carry
+            hc = h[i]
+            active_i = (i < hl).astype(jnp.float32)
+
+            def inner(prev_cell, jj):
+                # prev_cell = new_row[jj-1]; row[jj-1], row[jj] from old row
+                sub = row[jj - 1] + jnp.where(hc == r[jj - 1], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(row[jj] + 1.0,
+                                              prev_cell + 1.0), sub)
+                val = jnp.where(jj <= rl, val, big)
+                return val, val
+
+            first = row[0] + 1.0
+            _, rest = lax.scan(inner, first, jnp.arange(1, Tr + 1))
+            new_row = jnp.concatenate([first.reshape(1), rest])
+            row = jnp.where(active_i > 0, new_row, row)
+            return row, None
+
+        row, _ = lax.scan(step, row0, jnp.arange(Th))
+        return row[rl]
+
+    dist = jax.vmap(one)(hyp, ref, hlen, rlen).astype(jnp.float32)
+    seq_num = jnp.asarray(hyp.shape[0], _I64).reshape(1)
+    if op.attr("normalized", True):
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return {"Out": dist.reshape(-1, 1), "SequenceNum": seq_num}
+
+
+@register_op("ctc_align", grad=None)
+def ctc_align(ctx, op, ins):
+    """operators/ctc_align_op.cc: merge repeated labels then remove blanks
+    (padded [B, T] + optional InputLength -> compacted ids + lengths)."""
+    x = ins["Input"][0].astype(jnp.int32)
+    B, T = x.shape
+    if ins.get("InputLength"):
+        ln = ins["InputLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    blank = int(op.attr("blank", 0))
+    merge = bool(op.attr("merge_repeated", True))
+    in_seq = jnp.arange(T)[None, :] < ln[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), x[:, :-1]], 1)
+    keep = in_seq & (x != blank)
+    if merge:
+        keep = keep & (x != prev)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :],
+                                  T + jnp.arange(T)[None, :]), axis=1)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1)
+    out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], gathered, 0)
+    return {"Output": out.astype(_I64),
+            "OutputLength": new_len.reshape(-1, 1).astype(_I64)}

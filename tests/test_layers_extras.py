@@ -1,0 +1,168 @@
+"""The generated/composite layer surface (layers/extras.py): spot-check a
+sample of table-generated wrappers, composites, and control-flow helpers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(build, feeds):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feeds, fetch_list=list(fetches))
+
+
+def test_generated_wrappers_sample():
+    def build():
+        x = fluid.layers.data("x", [3, 4, 4], dtype="float32")
+        s = fluid.layers.data("s", [], dtype="float32")
+        b = fluid.layers.data("b", [], dtype="float32")
+        ac = fluid.layers.affine_channel(x, s, b)
+        sd = fluid.layers.space_to_depth(x, blocksize=2)
+        fro = fluid.layers.has_nan(x)
+        return ac, sd, fro
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    s = np.array([2.0, 1.0, 0.5], "float32")
+    b = np.zeros(3, "float32")
+    ac, sd, nan = _run(build, {"x": x, "s": np.tile(s, (2, 1)),
+                               "b": np.tile(b, (2, 1))}) if False else \
+        _run(build, {"x": x, "s": s, "b": b})
+    np.testing.assert_allclose(ac, x * s[None, :, None, None], atol=1e-5)
+    assert sd.shape == (2, 12, 2, 2)
+    assert not bool(np.ravel(nan)[0])
+
+
+def test_losses_and_metrics():
+    def build():
+        p = fluid.layers.data("p", [1], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        ll = fluid.layers.log_loss(p, y)
+        seg = fluid.layers.data("seg", [4], dtype="float32")
+        lab = fluid.layers.data("lab", [4], dtype="float32")
+        dl = fluid.layers.dice_loss(seg, lab)
+        return ll, dl
+
+    p = np.array([[0.9], [0.2]], "float32")
+    y = np.array([[1.0], [0.0]], "float32")
+    seg = np.array([[1, 1, 0, 0], [0, 1, 1, 0]], "float32")
+    lab = np.array([[1, 0, 0, 0], [0, 1, 1, 1]], "float32")
+    ll, dl = _run(build, {"p": p, "y": y, "seg": seg, "lab": lab})
+    want = -(y * np.log(p + 1e-4) + (1 - y) * np.log(1 - p + 1e-4))
+    np.testing.assert_allclose(ll, want, atol=1e-4)
+    assert 0 <= float(np.ravel(dl)[0]) <= 1
+
+
+def test_param_creating_layers_train():
+    def build():
+        x = fluid.layers.data("x", [6], dtype="float32")
+        yv = fluid.layers.data("yv", [5], dtype="float32")
+        b = fluid.layers.bilinear_tensor_product(x, yv, 3)
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        hs = fluid.layers.hsigmoid(b, lbl, num_classes=6)
+        loss = fluid.layers.mean(hs)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        return (loss,)
+
+    rng = np.random.RandomState(1)
+    feeds = {"x": rng.randn(4, 6).astype("float32"),
+             "yv": rng.randn(4, 5).astype("float32"),
+             "lbl": rng.randint(0, 6, (4, 1)).astype("int64")}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        (loss,) = build.__wrapped__() if hasattr(build, "__wrapped__") \
+            else build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    vals = [float(exe.run(main, feed=feeds, fetch_list=[loss],
+                          scope=scope)[0]) for _ in range(10)]
+    assert vals[-1] < vals[0], vals
+
+
+def test_rnn_sequence_layers():
+    def build():
+        x = fluid.layers.data("x", [4, 12], dtype="float32")
+        h = fluid.layers.dynamic_gru(x, 4)
+        x2 = fluid.layers.data("x2", [4, 16], dtype="float32")
+        hid, cell = fluid.layers.dynamic_lstm(x2, 16)
+        return h, hid, cell
+
+    rng = np.random.RandomState(2)
+    h, hid, cell = _run(build, {"x": rng.randn(2, 4, 12).astype("float32"),
+                                "x2": rng.randn(2, 4, 16).astype(
+                                    "float32")})
+    assert h.shape == (2, 4, 4)
+    assert hid.shape == (2, 4, 4) and cell.shape == (2, 4, 4)
+
+
+def test_ctc_greedy_decoder():
+    def build():
+        prob = fluid.layers.data("prob", [5, 4], dtype="float32")
+        out, ln = fluid.layers.ctc_greedy_decoder(prob, blank=0)
+        return out, ln
+
+    # argmax path: [1,1,0,2,2] -> merge -> [1,0,2] -> strip blank -> [1,2]
+    prob = np.zeros((1, 5, 4), "float32")
+    for t, c in enumerate([1, 1, 0, 2, 2]):
+        prob[0, t, c] = 1.0
+    out, ln = _run(build, {"prob": prob})
+    assert int(np.ravel(ln)[0]) == 2
+    np.testing.assert_array_equal(out[0, :2], [1, 2])
+
+
+def test_case_switch_case_and_print_assert(capsys):
+    def build():
+        i = fluid.layers.data("i", [1], dtype="int64")
+        one = fluid.layers.fill_constant([1], "float32", 1.0)
+
+        r = fluid.layers.switch_case(
+            i, {0: lambda: one * 10.0, 2: lambda: one * 30.0},
+            default=lambda: one * 99.0)
+        return (r,)
+
+    (r,) = _run(build, {"i": np.array([2], "int64")})
+    assert float(np.ravel(r)[0]) == 30.0
+    (r2,) = _run(build, {"i": np.array([1], "int64")})
+    assert float(np.ravel(r2)[0]) == 99.0
+
+
+def test_assert_op_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [1], dtype="float32")
+        c = fluid.layers.less_than(x, fluid.layers.fill_constant(
+            [1], "float32", 0.0))
+        fluid.layers.Assert(c)
+        y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(AssertionError):
+        exe.run(main, feed={"x": np.array([5.0], "float32")},
+                fetch_list=[y])
+
+
+def test_edit_distance_layer():
+    def build():
+        h = fluid.layers.data("h", [4], dtype="int64")
+        r = fluid.layers.data("r", [4], dtype="int64")
+        hl = fluid.layers.data("hl", [], dtype="int64")
+        rl = fluid.layers.data("rl", [], dtype="int64")
+        d, n = fluid.layers.edit_distance(h, r, hl, rl, normalized=False)
+        return d, n
+
+    h = np.array([[1, 2, 3, 0]], "int64")
+    r = np.array([[1, 3, 3, 4]], "int64")
+    d, n = _run(build, {"h": h, "r": r,
+                        "hl": np.array([3], "int64"),
+                        "rl": np.array([4], "int64")})
+    # [1,2,3] vs [1,3,3,4]: sub 2->3 (or ins) + append 4 => 2
+    assert float(np.ravel(d)[0]) == 2.0
+
+
+def test_py_reader_redirects():
+    with pytest.raises(NotImplementedError, match="DataLoader"):
+        fluid.layers.py_reader(64, [[1]], ["float32"])
