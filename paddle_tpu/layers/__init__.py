@@ -15,6 +15,8 @@ from . import control_flow  # noqa: F401
 from .control_flow import *  # noqa: F401,F403
 from . import extras  # noqa: F401
 from .extras import *  # noqa: F401,F403
+from . import rnn_api  # noqa: F401
+from .rnn_api import *  # noqa: F401,F403
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,
     exponential_decay,

@@ -1,0 +1,162 @@
+"""BeamSearchDecoder.decode implementation: beam search as ONE compiled
+scan (DynamicRNN block) — beams folded into the batch dim, per-step
+topk over [beam*vocab], parent-gathered states, gather_tree backtrace.
+
+The reference's BeamSearchDecoder (rnn.py:697) builds the same math from
+While + beam_search ops over shrinking LoD batches; this build keeps shapes
+static: finished beams are forced to extend only with end_token at zero
+added score, so every beam always exists.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+
+
+def _arange_rows(batch_size_ref, n, step):
+    """[B*n] int64 tensor: row b*step repeated n times (base offsets for
+    flattened [B, n] gathers) — built from ops only (no host shapes)."""
+    from .tensor import fill_constant_batch_size_like, reshape, cast
+    from . import tensor as T
+
+    # cumsum of a [B, 1] constant gives b+1 per row -> (b)*step
+    ones = fill_constant_batch_size_like(batch_size_ref, [-1, 1],
+                                         "float32", 1.0)
+    helper = LayerHelper("beam_arange")
+    csum = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="cumsum", inputs={"X": [ones]},
+                     outputs={"Out": [csum]}, attrs={"axis": 0})
+    base = T.scale(csum - ones, scale=float(step))        # [B, 1] = b*step
+    tiled = T.expand(base, expand_times=[1, n])           # [B, n]
+    return cast(reshape(tiled, [-1, 1]), "int64")
+
+
+def beam_decode(decoder, initial_states, max_step_num, batch_size_ref,
+                **kwargs):
+    from .control_flow import DynamicRNN
+    from .nn import log_softmax, topk
+    from .tensor import (cast, concat, elementwise_mod, expand,
+                         fill_constant, fill_constant_batch_size_like,
+                         gather, reshape, transpose)
+    from . import tensor as T
+
+    cell = decoder.cell
+    K = decoder.beam_size
+    multi_state = isinstance(cell.state_shape[0], (list, tuple))
+    states0 = initial_states if isinstance(initial_states, (list, tuple)) \
+        else [initial_states]
+
+    # tile every state to [B*K, ...] and bias beam 0's score
+    def tile_beams(s):
+        e = expand(T.unsqueeze(s, axes=[1]),
+                   expand_times=[1, K] + [1] * (len(s.shape) - 1))
+        return reshape(e, [-1] + list(s.shape[1:]))
+
+    states_tiled = [tile_beams(s) for s in states0]
+    score0_np = np.asarray([[0.0] + [-1e9] * (K - 1)], np.float32)
+    from .tensor import assign as assign_layer
+
+    score_row = assign_layer(score0_np)                    # [1, K]
+    # tile over the UNtiled batch ref -> [B, K] -> [B*K, 1]
+    scores_init = reshape(_expand_to_batch(score_row, states0[0]),
+                          [-1, 1])
+
+    start = fill_constant_batch_size_like(
+        states_tiled[0], [-1, 1], "int64", decoder.start_token)
+
+    steps = int(max_step_num)
+    drive = fill_constant_batch_size_like(
+        states_tiled[0], [-1, steps, 1], "float32", 0.0)
+
+    drnn = DynamicRNN()
+    with drnn.block():
+        drnn.step_input(drive)
+        states = [drnn.memory(init=s) for s in states_tiled]
+        scores = drnn.memory(init=scores_init)             # [B*K, 1]
+        tokens = drnn.memory(init=start)                   # [B*K, 1]
+        fin = drnn.memory(shape=[1], value=0.0)            # finished flag
+
+        emb = decoder.embedding_fn(reshape(tokens, [-1]))
+        cell_states = states if multi_state else states[0]
+        out, new_states = cell.call(emb, cell_states, **kwargs)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        logp = log_softmax(logits)                         # [B*K, V]
+        V = logp.shape[-1]
+        # finished beams may only extend with end_token at zero added score
+        end_mask = assign_layer(
+            ((np.arange(V) != decoder.end_token) * -1e9)
+            .astype(np.float32).reshape(1, V))
+        step_logp = logp * (1.0 - fin) + end_mask * fin
+        total = scores + step_logp                          # [B*K, V]
+        flat = reshape(total, [-1, K * V])                  # [B, K*V]
+        top_s, top_i = topk(flat, k=K)                      # [B, K]
+        from .extras import elementwise_floordiv
+
+        parent = elementwise_floordiv(
+            cast(top_i, "int64"), fill_constant([1], "int64", V))
+        token = elementwise_mod(cast(top_i, "int64"),
+                                fill_constant([1], "int64", V))
+        # flat gather index = b*K + parent
+        base = _arange_rows(flat, K, K)                     # [B*K, 1]
+        gidx = reshape(base + reshape(parent, [-1, 1]), [-1])
+        new_states_l = new_states if multi_state else [new_states]
+        gathered = [gather(s, gidx) for s in new_states_l]
+        for s, g in zip(states, gathered):
+            drnn.update_memory(s, g)
+        new_scores = reshape(top_s, [-1, 1])
+        new_tokens = reshape(token, [-1, 1])
+        drnn.update_memory(scores, new_scores)
+        drnn.update_memory(tokens, new_tokens)
+        fin_g = gather(fin, gidx)
+        now_end = cast(T.equal(new_tokens, fill_constant(
+            [1], "int64", decoder.end_token)), "float32")
+        drnn.update_memory(fin, T.elementwise_max(fin_g, now_end))
+        drnn.output(new_tokens, reshape(parent, [-1, 1]), new_scores)
+
+    ids_seq, parents_seq, scores_seq = drnn()   # [B*K, T, 1]
+    ids_tbk = _to_tbk(ids_seq, K)
+    parents_tbk = _to_tbk(parents_seq, K)
+    helper = LayerHelper("gather_tree")
+    full = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="gather_tree",
+                     inputs={"Ids": [ids_tbk], "Parents": [parents_tbk]},
+                     outputs={"Out": [full]}, attrs={})
+    # [T, B, K] -> [B, K, T]
+    final_ids = transpose(full, perm=[1, 2, 0])
+    final_scores = _last_bk(scores_seq, K)
+    return final_ids, final_scores
+
+
+def _expand_to_batch(row, batch_ref):
+    """Tile a [1, K] constant row to [B, K] using a batch-size-like fill."""
+    from .tensor import fill_constant_batch_size_like
+
+    zeros = fill_constant_batch_size_like(batch_ref, [-1, row.shape[1]],
+                                          "float32", 0.0)
+    return zeros + row
+
+
+def _to_tbk(seq, K):
+    """[B*K, T, 1] -> [T, B, K] (gather_tree layout)."""
+    from .tensor import reshape, transpose
+
+    t = seq.shape[1]
+    r = reshape(seq, [-1, K, t])                           # [B, K, T]
+    return cast_int64(transpose(r, perm=[2, 0, 1]))
+
+
+def cast_int64(x):
+    from .tensor import cast
+
+    return cast(x, "int64")
+
+
+def _last_bk(scores_seq, K):
+    from .sequence import sequence_pool
+    from .tensor import reshape
+
+    last = sequence_pool(scores_seq, "LAST")               # [B*K, 1]
+    return reshape(last, [-1, K])

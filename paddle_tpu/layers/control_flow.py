@@ -13,7 +13,7 @@ from ..framework.program import Variable, default_main_program
 
 __all__ = ["While", "cond", "while_loop", "Switch", "array_write", "array_read",
            "array_length", "create_array", "increment", "less_than", "equal",
-           "DynamicRNN", "lod_rank_table", "max_sequence_len",
+           "DynamicRNN", "StaticRNN", "IfElse", "lod_rank_table", "max_sequence_len",
            "lod_tensor_to_array", "array_to_lod_tensor", "shrink_memory"]
 
 
@@ -502,3 +502,110 @@ def shrink_memory(x, i, table):
                      inputs={"X": [x], "I": [i], "RankTable": [table]},
                      outputs={"Out": [out]}, attrs={})
     return out
+
+
+class StaticRNN:
+    """fluid.layers.StaticRNN (reference control_flow.py:477): fixed-length
+    unroll authoring surface. Same step-block design as DynamicRNN, without
+    per-row lengths (every sequence runs the full T steps)."""
+
+    def __init__(self, name=None):
+        self._drnn = DynamicRNN(name=name)
+        self._outputs = []
+
+    def step(self):
+        return self._drnn.block()
+
+    def step_input(self, x):
+        return self._drnn.step_input(x)
+
+    def step_output(self, o):
+        self._drnn.output(o)
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is not None:
+            return self._drnn.memory(init=init)
+        return self._drnn.memory(shape=shape, value=init_value)
+
+    def update_memory(self, mem, var):
+        self._drnn.update_memory(mem, var)
+
+    def __call__(self):
+        return self._drnn()
+
+
+class IfElse:
+    """fluid.layers.IfElse (reference control_flow.py:1540): row-routing
+    conditional. true_block()/false_block() compute on mask-split rows
+    (split_lod_tensor zeroes the other branch's rows — fixed shapes instead
+    of the reference's row extraction); __call__ merges per the mask."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.helper = LayerHelper("ifelse", name=name)
+        self._in_true = None
+        self._splits = {}          # input var name -> (true, false) vars
+        self._true_outs: List = []
+        self._false_outs: List = []
+
+    class _Branch:
+        def __init__(self, owner, is_true):
+            self.owner = owner
+            self.is_true = is_true
+
+        def __enter__(self):
+            self.owner._in_true = self.is_true
+            return self
+
+        def __exit__(self, exc_type, exc_val, exc_tb):
+            self.owner._in_true = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        if self._in_true is None:
+            raise ValueError("IfElse.input() must run inside a branch block")
+        if x.name not in self._splits:
+            t = self.helper.create_variable_for_type_inference(x.dtype)
+            f = self.helper.create_variable_for_type_inference(x.dtype)
+            self.helper.append_op(
+                type="split_lod_tensor",
+                inputs={"X": [x], "Mask": [self.cond]},
+                outputs={"OutTrue": [t], "OutFalse": [f]}, attrs={})
+            self._splits[x.name] = (t, f)
+        t, f = self._splits[x.name]
+        return t if self._in_true else f
+
+    def output(self, *outs):
+        if self._in_true is None:
+            raise ValueError("IfElse.output() must run inside a branch block")
+        (self._true_outs if self._in_true else self._false_outs).extend(outs)
+
+    def __call__(self):
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError(
+                f"IfElse branches produced {len(self._true_outs)} vs "
+                f"{len(self._false_outs)} outputs")
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            o = self.helper.create_variable_for_type_inference(t.dtype)
+            self.helper.append_op(
+                type="merge_lod_tensor",
+                inputs={"InTrue": [t], "InFalse": [f],
+                        "Mask": [self.cond], "X": [t]},
+                outputs={"Out": [o]}, attrs={})
+            merged.append(o)
+        return merged

@@ -1,0 +1,384 @@
+"""RNN cell / decode API — parity with python/paddle/fluid/layers/rnn.py
+(RNNCell:58, GRUCell:224, LSTMCell:322, rnn:432, Decoder:584,
+BeamSearchDecoder:697, dynamic_decode:1168, DecodeHelper family:1398,
+BasicDecoder:1852) on this framework's compiled-scan machinery.
+
+TPU-first translation: the reference drives these with a While op over
+shrinking LoD batches; here both `rnn` and `dynamic_decode` build their
+per-step block inside :class:`~paddle_tpu.layers.control_flow.DynamicRNN`
+(ops/dynamic_rnn.py — ONE lax.scan, fixed batch, masking instead of batch
+shrink). Decoding runs a fixed `max_step_num` steps with a carried
+`finished` flag; outputs past finish are masked (impute_finished
+semantics), which is the static-shape equivalent of the reference's
+early-exit While.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.program import Variable
+
+__all__ = ["RNNCell", "GRUCell", "LSTMCell", "rnn", "Decoder",
+           "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
+           "SampleEmbeddingHelper", "BasicDecoder", "dynamic_decode",
+           "BeamSearchDecoder"]
+
+
+class RNNCell:
+    """rnn.py:58 — step interface: call(inputs, states) -> (out, states)."""
+
+    def call(self, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states, **kwargs):
+        return self.call(inputs, states, **kwargs)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from .tensor import fill_constant_batch_size_like
+
+        shapes = shape or self.state_shape
+        if isinstance(shapes, (list, tuple)) and shapes and \
+                isinstance(shapes[0], (list, tuple)):
+            return [fill_constant_batch_size_like(
+                batch_ref, [-1] + list(s), dtype, init_value)
+                for s in shapes]
+        return fill_constant_batch_size_like(
+            batch_ref, [-1] + list(shapes), dtype, init_value)
+
+
+class GRUCell(RNNCell):
+    """rnn.py:224 — gru_unit step with an input projection to 3H."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation="sigmoid", activation="tanh",
+                 dtype="float32", name="GRUCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = gate_activation
+        self._act = activation
+        self._dtype = dtype
+        self._name = name
+
+    def call(self, inputs, states):
+        from .extras import gru_unit
+        from .nn import fc
+
+        proj = fc(inputs, 3 * self.hidden_size,
+                  param_attr=self._param_attr, bias_attr=False,
+                  name=self._name + "_proj")
+        new_hidden, _, _ = gru_unit(
+            proj, states, 3 * self.hidden_size,
+            param_attr=self._param_attr, bias_attr=self._bias_attr,
+            activation=self._act, gate_activation=self._gate_act)
+        return new_hidden, new_hidden
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class LSTMCell(RNNCell):
+    """rnn.py:322 — lstm_unit step; states = [hidden, cell]."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation="sigmoid", activation="tanh",
+                 forget_bias=1.0, dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._forget_bias = forget_bias
+        self._name = name
+
+    def call(self, inputs, states):
+        from .extras import lstm_unit
+
+        pre_h, pre_c = states
+        h, c = lstm_unit(inputs, pre_h, pre_c,
+                         forget_bias=self._forget_bias,
+                         param_attr=self._param_attr,
+                         bias_attr=self._bias_attr,
+                         name=self._name)
+        return h, [h, c]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """rnn.py:432 — unroll `cell` over the time axis via DynamicRNN (one
+    compiled scan). Returns (outputs [B, T, ...], final_states)."""
+    from .control_flow import DynamicRNN
+    from .sequence import sequence_pool
+    from .extras import reverse as rev_layer
+    from .tensor import transpose
+
+    if time_major:
+        inputs = transpose(inputs, perm=[1, 0] +
+                           list(range(2, len(inputs.shape))))
+    if is_reverse:
+        inputs = rev_layer(inputs, axis=[1])
+
+    multi_state = isinstance(cell.state_shape[0], (list, tuple))
+    drnn = DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(inputs, length=sequence_length)
+        if initial_states is None:
+            if multi_state:
+                states = [drnn.memory(shape=s, value=0.0)
+                          for s in cell.state_shape]
+            else:
+                states = drnn.memory(shape=cell.state_shape, value=0.0)
+        else:
+            if multi_state:
+                states = [drnn.memory(init=s) for s in initial_states]
+            else:
+                states = drnn.memory(init=initial_states)
+        out, new_states = cell.call(x_t, states, **kwargs)
+        if multi_state:
+            for s, ns in zip(states, new_states):
+                drnn.update_memory(s, ns)
+            drnn.output(out, *list(new_states))
+        else:
+            drnn.update_memory(states, new_states)
+            drnn.output(out, new_states)
+    results = drnn()
+    outputs = results[0]
+    state_seqs = results[1:]
+    if sequence_length is not None:
+        finals = [sequence_pool(s, "LAST", length=sequence_length)
+                  for s in state_seqs]
+    else:
+        finals = [sequence_pool(s, "LAST") for s in state_seqs]
+    final_states = finals if multi_state else finals[0]
+    if is_reverse:
+        outputs = rev_layer(outputs, axis=[1])
+    if time_major:
+        outputs = transpose(outputs, perm=[1, 0] +
+                            list(range(2, len(outputs.shape))))
+    return outputs, final_states
+
+
+# ---------------------------------------------------------------------------
+# decoding
+# ---------------------------------------------------------------------------
+
+
+class Decoder:
+    """rnn.py:584 — initialize/step/finalize protocol."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+class DecodeHelper:
+    """rnn.py:1398 — initialize/sample/next_inputs protocol."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """rnn.py:1467 — teacher forcing: step t consumes inputs[:, t]."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        from .tensor import transpose
+
+        self.inputs = transpose(inputs, perm=[1, 0] + list(
+            range(2, len(inputs.shape)))) if time_major else inputs
+        self.sequence_length = sequence_length
+
+    @property
+    def max_steps(self):
+        return self.inputs.shape[1]
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """rnn.py:1620 — feedback = embedding(argmax(logits))."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens  # [B] int64 var
+        self.end_token = int(end_token)
+
+    def sample(self, logits):
+        from .tensor import argmax
+
+        return argmax(logits, axis=-1)
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """rnn.py:1751 — feedback sampled from softmax(logits)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+
+    def sample(self, logits):
+        from .extras import sampling_id
+        from .nn import softmax
+        from .tensor import scale as scale_layer
+
+        if self.temperature is not None:
+            logits = scale_layer(logits, scale=1.0 / self.temperature)
+        return sampling_id(softmax(logits))
+
+
+class BasicDecoder(Decoder):
+    """rnn.py:1852 — cell + helper (+ output fc)."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """rnn.py:1168 for BasicDecoder: a fixed-length compiled scan with a
+    carried `finished` flag (static-shape equivalent of the early-exit
+    While; finished steps keep emitting the end token and their outputs
+    are maskable via the returned lengths)."""
+    from .control_flow import DynamicRNN
+    from .tensor import (cast, fill_constant_batch_size_like, reduce_sum,
+                         transpose, zeros_like)
+    from . import tensor as T
+
+    if not isinstance(decoder, BasicDecoder):
+        raise NotImplementedError(
+            "dynamic_decode drives BasicDecoder (use BeamSearchDecoder."
+            "decode for beam search)")
+    helper = decoder.helper
+    cell = decoder.cell
+    teacher = isinstance(helper, TrainingHelper)
+    if teacher:
+        steps = helper.max_steps
+    else:
+        if max_step_num is None:
+            raise ValueError("max_step_num is required for free-running "
+                             "decode (static shapes)")
+        steps = int(max_step_num)
+
+    multi_state = isinstance(cell.state_shape[0], (list, tuple))
+
+    # the scan driver: teacher forcing steps over the target sequence;
+    # free-running decode steps over a dummy time axis and feeds back
+    # sampled embeddings through a memory
+    if teacher:
+        drive = helper.inputs
+    else:
+        first = helper.embedding_fn(helper.start_tokens)   # [B, E]
+        drive = fill_constant_batch_size_like(
+            first, [-1, steps, 1], "float32", 0.0)
+
+    drnn = DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(
+            drive, length=helper.sequence_length if teacher else None)
+        if inits is not None:
+            states = [drnn.memory(init=s) for s in inits] if multi_state \
+                else drnn.memory(init=inits)
+        else:
+            if multi_state:
+                states = [drnn.memory(shape=s, value=0.0)
+                          for s in cell.state_shape]
+            else:
+                states = drnn.memory(shape=cell.state_shape, value=0.0)
+        if teacher:
+            cell_in = x_t
+        else:
+            cell_in = drnn.memory(init=first)
+            fin_prev = drnn.memory(shape=[1], value=0.0)   # finished flag
+        out, new_states = cell.call(cell_in, states, **kwargs)
+        logits = decoder.output_fn(out) if decoder.output_fn is not None \
+            else out
+        if multi_state:
+            for s, ns in zip(states, new_states):
+                drnn.update_memory(s, ns)
+        else:
+            drnn.update_memory(states, new_states)
+        if teacher:
+            drnn.output(logits)
+        else:
+            sample_ids = helper.sample(logits)             # [B]
+            next_in = helper.embedding_fn(sample_ids)
+            drnn.update_memory(cell_in, next_in)
+            from .tensor import equal as eq_layer, fill_constant
+
+            endv = fill_constant([1], sample_ids.dtype, helper.end_token)
+            now_end = cast(eq_layer(T.reshape(sample_ids, [-1, 1]), endv),
+                           "float32")
+            fin = T.elementwise_max(fin_prev, now_end) if hasattr(
+                T, "elementwise_max") else fin_prev + now_end - \
+                fin_prev * now_end
+            drnn.update_memory(fin_prev, fin)
+            drnn.output(logits, T.reshape(
+                cast(T.reshape(sample_ids, [-1, 1]), "int64"), [-1, 1]),
+                fin_prev)
+    results = drnn()
+    if teacher:
+        outputs = results if isinstance(results, Variable) else results[0]
+        lengths = helper.sequence_length
+        ret_extra = None
+    else:
+        outputs, ids_seq, fin_seq = results
+        # length = steps until (and including) the first end token
+        alive = 1.0 - T.reshape(fin_seq, [-1, steps])
+        lengths = cast(reduce_sum(alive, dim=1), "int64")
+        ret_extra = ids_seq
+    if output_time_major:
+        outputs = transpose(outputs, perm=[1, 0] + list(
+            range(2, len(outputs.shape))))
+    if return_length:
+        return (outputs, ret_extra, lengths) if ret_extra is not None \
+            else (outputs, lengths)
+    return (outputs, ret_extra) if ret_extra is not None else outputs
+
+
+class BeamSearchDecoder(Decoder):
+    """rnn.py:697 — beam-search decoding over a cell. Implemented
+    functionally with the beam folded into the batch dim and a compiled
+    per-step topk; gather_tree reconstructs the predecessor chains
+    (operators/gather_tree_op.cc)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def decode(self, initial_states, max_step_num, batch_size_ref,
+               **kwargs):
+        """Run beam search for max_step_num steps; returns
+        (token ids [B, beam, T], per-beam scores [B, beam])."""
+        from .beam_decode_impl import beam_decode
+
+        return beam_decode(self, initial_states, int(max_step_num),
+                           batch_size_ref, **kwargs)

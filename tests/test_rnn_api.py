@@ -1,0 +1,141 @@
+"""rnn.py API family: cells, rnn(), dynamic_decode (teacher/greedy/sample),
+BeamSearchDecoder."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_gru_lstm_cells_and_rnn():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [5, 6], dtype="float32")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        cell = fluid.layers.GRUCell(hidden_size=4)
+        out, final = fluid.layers.rnn(cell, x, sequence_length=ln)
+        lcell = fluid.layers.LSTMCell(hidden_size=4)
+        lout, lfinal = fluid.layers.rnn(lcell, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    o, f, lo, lf0, lf1 = exe.run(
+        main, feed={"x": rng.randn(2, 5, 6).astype("float32"),
+                    "ln": np.array([5, 3], "int64")},
+        fetch_list=[out, final, lout, lfinal[0], lfinal[1]])
+    assert o.shape == (2, 5, 4)
+    # masked past length AND final state is the last VALID state
+    assert (o[1, 3:] == 0).all()
+    np.testing.assert_allclose(f[1], o[1, 2], atol=1e-6)
+    assert lo.shape == (2, 5, 4) and lf0.shape == (2, 4)
+
+
+def test_dynamic_decode_teacher_and_greedy():
+    V, E, H, B, T = 12, 6, 8, 3, 5
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        tgt = fluid.layers.data("tgt", [T, E], dtype="float32")
+        cell = fluid.layers.GRUCell(hidden_size=H)
+        helper = fluid.layers.TrainingHelper(tgt)
+        dec = fluid.layers.BasicDecoder(
+            cell, helper, output_fn=lambda h: fluid.layers.fc(
+                h, V, name="dec_out"))
+        logits = fluid.layers.dynamic_decode(dec)
+
+        # greedy free-running decode with embedding feedback
+        emb_w = fluid.layers.create_parameter([V, E], "float32",
+                                              name="dec_emb")
+
+        def embed(ids):
+            return fluid.layers.gather(emb_w, ids)
+
+        start = fluid.layers.data("start", [], dtype="int64")
+        g_helper = fluid.layers.GreedyEmbeddingHelper(embed, start, 0)
+        g_dec = fluid.layers.BasicDecoder(
+            cell, g_helper, output_fn=lambda h: fluid.layers.fc(
+                h, V, name="dec_out"))
+        g_logits, g_ids, g_len = fluid.layers.dynamic_decode(
+            g_dec, max_step_num=4, return_length=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    lv, gv, gi, gl = exe.run(
+        main, feed={"tgt": rng.randn(B, T, E).astype("float32"),
+                    "start": np.ones((B,), "int64")},
+        fetch_list=[logits, g_logits, g_ids, g_len])
+    assert lv.shape == (B, T, V)
+    assert gv.shape == (B, 4, V) and gi.shape[0] == B
+    assert (gl >= 0).all() and (gl <= 4).all()
+
+
+def test_beam_search_decoder():
+    V, E, H, B, K = 10, 4, 6, 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        enc = fluid.layers.data("enc", [H], dtype="float32")
+        emb_w = fluid.layers.create_parameter([V, E], "float32",
+                                              name="bm_emb")
+
+        def embed(ids):
+            return fluid.layers.gather(emb_w, ids)
+
+        cell = fluid.layers.GRUCell(hidden_size=H)
+        bsd = fluid.layers.BeamSearchDecoder(
+            cell, start_token=1, end_token=0, beam_size=K,
+            embedding_fn=embed,
+            output_fn=lambda h: fluid.layers.fc(h, V, name="bm_out"))
+        ids, scores = bsd.decode(enc, max_step_num=4, batch_size_ref=enc)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    iv, sv = exe.run(main, feed={"enc": rng.randn(B, H).astype("float32")},
+                     fetch_list=[ids, scores])
+    assert iv.shape == (B, K, 4)
+    assert sv.shape == (B, K)
+    # beams sorted by score desc per batch row
+    assert (np.diff(sv, axis=1) <= 1e-5).all()
+    assert (iv >= 0).all() and (iv < V).all()
+
+
+def test_static_rnn():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4, 3], dtype="float32")
+        srnn = fluid.layers.StaticRNN()
+        with srnn.step():
+            xt = srnn.step_input(x)
+            prev = srnn.memory(shape=[5], init_value=0.0)
+            h = fluid.layers.fc([xt, prev], 5, act="tanh", name="srnn_fc")
+            srnn.update_memory(prev, h)
+            srnn.step_output(h)
+        out = srnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (o,) = exe.run(main, feed={"x": np.random.RandomState(3).randn(
+        2, 4, 3).astype("float32")}, fetch_list=[out])
+    assert o.shape == (2, 4, 5)
+    assert not np.allclose(o[:, 0], o[:, 3])
+
+
+def test_if_else_row_routing():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2], dtype="float32")
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        first = fluid.layers.slice(x, axes=[1], starts=[0], ends=[1])
+        cond = fluid.layers.less_than(first, zero)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            xi = ie.input(x)
+            ie.output(fluid.layers.scale(xi, scale=-1.0))
+        with ie.false_block():
+            xi = ie.input(x)
+            ie.output(fluid.layers.scale(xi, scale=10.0))
+        (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    x_np = np.array([[-1.0, 2.0], [3.0, 4.0]], "float32")
+    (v,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+    np.testing.assert_allclose(v, [[1.0, -2.0], [30.0, 40.0]])
