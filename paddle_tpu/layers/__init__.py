@@ -17,6 +17,8 @@ from . import extras  # noqa: F401
 from .extras import *  # noqa: F401,F403
 from . import rnn_api  # noqa: F401
 from .rnn_api import *  # noqa: F401,F403
+from . import ssd  # noqa: F401
+from .ssd import *  # noqa: F401,F403
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,
     exponential_decay,

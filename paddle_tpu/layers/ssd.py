@@ -1,0 +1,204 @@
+"""SSD detection stack — fluid.layers ssd_loss (detection.py:1515),
+multi_box_head (:2110), detection_output (:618), composed from this
+framework's primitives (prior_box, bipartite_match, target_assign,
+mine_hard_examples, box_coder, multiclass_nms2) exactly the way the
+reference composes its ops — but with every stage fixed-shape, so a whole
+SSD train step compiles to one XLA program.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["ssd_loss", "multi_box_head", "detection_output"]
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """decode priors with loc deltas, then on-device multiclass NMS.
+    loc [B, P, 4]; scores [B, P, C] (softmax applied here like the
+    reference); returns [B, keep_top_k, 6] padded rows (+ counts)."""
+    from .detection import box_coder
+    from .nn import softmax
+    from .tensor import transpose
+    from .extras import generate_layer_fn
+
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")      # [B, P, 4]
+    cls = transpose(softmax(scores), perm=[0, 2, 1])         # [B, C, P]
+    helper = LayerHelper("detection_output")
+    out = helper.create_variable_for_type_inference(loc.dtype)
+    index = helper.create_variable_for_type_inference("int64")
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="multiclass_nms2",
+        inputs={"BBoxes": [decoded], "Scores": [cls]},
+        outputs={"Out": [out], "Index": [index], "NmsRoisNum": [num]},
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold),
+               "background_label": int(background_label)})
+    if return_index:
+        return out, index
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """detection.py:1515 — the multibox loss:
+    1. IoU(prior, gt) -> bipartite/per-prediction match per image
+    2. hard-negative mining (max_negative)
+    3. loc: smooth_l1 on encoded targets over matched priors
+    4. conf: softmax CE with matched labels, mined negatives as background
+    location [B, P, 4], confidence [B, P, C], gt_box [B, G, 4] (zero rows
+    pad), gt_label [B, G, 1] or [B, G]; returns [B, P, 1] weighted loss
+    (normalized by matched count like the reference)."""
+    from . import tensor as T
+    from .detection import box_coder, iou_similarity
+    from .nn import softmax_with_cross_entropy, smooth_l1
+    from .tensor import cast, reduce_sum, reshape
+
+    helper = LayerHelper("ssd_loss")
+    dtype = location.dtype
+    C = confidence.shape[-1]
+    P = prior_box.shape[0]
+
+    if len(gt_label.shape) == 2:
+        gt_label = T.unsqueeze(gt_label, axes=[2])
+
+    # 1. similarity + match (batched dist [B, G, P])
+    sim = iou_similarity(gt_box, prior_box)                  # [B, G, P]
+    match_idx = helper.create_variable_for_type_inference("int32")
+    match_dist = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [sim]},
+        outputs={"ColToRowMatchIndices": [match_idx],
+                 "ColToRowMatchDist": [match_dist]},
+        attrs={"match_type": match_type,
+               "dist_threshold": float(overlap_threshold)})
+
+    # 2. mined negatives: conf loss as mining signal (reference computes a
+    # temporary softmax CE against background for negatives)
+    bg = helper.create_variable_for_type_inference("int64")
+    from .tensor import fill_constant_batch_size_like
+
+    bg_label = fill_constant_batch_size_like(
+        location, [-1, P, 1], "int64", background_label)
+    mining_ce = softmax_with_cross_entropy(confidence, bg_label)  # [B,P,1]
+    neg_idx = helper.create_variable_for_type_inference("int32")
+    upd_idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mine_hard_examples",
+        inputs={"ClsLoss": [reshape(mining_ce, [-1, P])],
+                "MatchIndices": [match_idx], "MatchDist": [match_dist]},
+        outputs={"NegIndices": [neg_idx],
+                 "UpdatedMatchIndices": [upd_idx]},
+        attrs={"neg_pos_ratio": float(neg_pos_ratio),
+               "neg_dist_threshold": float(neg_overlap),
+               "mining_type": mining_type})
+
+    # 3. targets via target_assign (labels + encoded boxes)
+    lbl_t = helper.create_variable_for_type_inference("int64")
+    lbl_w = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [gt_label], "MatchIndices": [upd_idx],
+                "NegIndices": [neg_idx]},
+        outputs={"Out": [lbl_t], "OutWeight": [lbl_w]},
+        attrs={"mismatch_value": int(background_label)})
+
+    # assign raw gt rows per prior, then encode against the priors
+    box_t = helper.create_variable_for_type_inference(dtype)
+    box_w = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [gt_box], "MatchIndices": [upd_idx]},
+        outputs={"Out": [box_t], "OutWeight": [box_w]},
+        attrs={"mismatch_value": 0})
+    enc_t = box_coder(prior_box, prior_box_var, box_t,
+                      code_type="encode_center_size")        # [B, P, 4]
+
+    # 4. losses
+    conf_loss = softmax_with_cross_entropy(confidence, lbl_t)   # [B, P, 1]
+    conf_loss = conf_loss * lbl_w
+    loc_flat = smooth_l1(reshape(location, [-1, 4]),
+                         reshape(enc_t, [-1, 4]))               # [B*P, 1]
+    loc_loss = reshape(loc_flat, [-1, P, 1]) * box_w
+
+    total = conf_loss_weight * conf_loss + loc_loss_weight * loc_loss
+    if normalize:
+        n_matched = reduce_sum(box_w) + 1e-6
+        total = total / n_matched
+    return total
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """detection.py:2110 — per-feature-map prior boxes + conv loc/conf
+    heads, flattened and concatenated across maps."""
+    from . import tensor as T
+    from .detection import prior_box as prior_box_layer
+    from .nn import conv2d
+    from .tensor import concat, reshape, transpose
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (n_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, inp in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        step_l = [steps[i], steps[i]] if steps else \
+            [step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0]
+        box, var = prior_box_layer(
+            inp, image, min_sizes=[mins] if not isinstance(
+                mins, (list, tuple)) else list(mins),
+            max_sizes=[maxs] if maxs and not isinstance(
+                maxs, (list, tuple)) else (list(maxs) if maxs else None),
+            aspect_ratios=list(ar) if isinstance(ar, (list, tuple))
+            else [ar],
+            variance=list(variance), flip=flip, clip=clip,
+            steps=step_l, offset=offset)
+        box = reshape(box, [-1, 4])
+        var = reshape(var, [-1, 4])
+        num_priors = int(box.shape[0]) // (
+            int(inp.shape[2]) * int(inp.shape[3]))
+        loc = conv2d(inp, num_priors * 4, kernel_size, padding=pad,
+                     stride=stride, name=(name or "mbox") + f"_loc{i}")
+        conf = conv2d(inp, num_priors * num_classes, kernel_size,
+                      padding=pad, stride=stride,
+                      name=(name or "mbox") + f"_conf{i}")
+        # NCHW -> [B, H*W*num_priors, 4 / C]
+        loc = transpose(loc, perm=[0, 2, 3, 1])
+        conf = transpose(conf, perm=[0, 2, 3, 1])
+        locs.append(reshape(loc, [0, -1, 4]))
+        confs.append(reshape(conf, [0, -1, num_classes]))
+        boxes_l.append(box)
+        vars_l.append(var)
+
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    boxes = concat(boxes_l, axis=0)
+    variances = concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances

@@ -147,10 +147,14 @@ def box_coder(ctx, op, ins):
         pvar = jnp.ones_like(prior)
 
     if code_type.startswith("encode"):
-        tw = target[:, 2] - target[:, 0] + one
-        th = target[:, 3] - target[:, 1] + one
-        tcx = target[:, 0] + tw / 2
-        tcy = target[:, 1] + th / 2
+        # ellipsis indexing: targets may be [M, 4] or batched [B, M, 4]
+        # row-aligned against the [M, 4] priors (ssd_loss assigned targets)
+        tw = target[..., 2] - target[..., 0] + one
+        th = target[..., 3] - target[..., 1] + one
+        tcx = target[..., 0] + tw / 2
+        tcy = target[..., 1] + th / 2
+        tw = jnp.maximum(tw, 1e-6)
+        th = jnp.maximum(th, 1e-6)
         ox = (tcx - pcx) / pw / pvar[:, 0]
         oy = (tcy - pcy) / ph / pvar[:, 1]
         ow = jnp.log(tw / pw) / pvar[:, 2]
@@ -471,12 +475,12 @@ def roi_pool(ctx, op, ins):
 @register_op("iou_similarity", grad=None)
 def iou_similarity(ctx, op, ins):
     """detection/iou_similarity_op.h: pairwise IoU [N, M]."""
-    a = ins["X"][0]                        # [N,4]
+    a = ins["X"][0]                        # [N,4] or [B, N, 4]
     b = ins["Y"][0]                        # [M,4]
     norm = bool(op.attr("box_normalized", True))
     off = 0.0 if norm else 1.0
-    ax1, ay1, ax2, ay2 = [a[:, i][:, None] for i in range(4)]
-    bx1, by1, bx2, by2 = [b[:, i][None, :] for i in range(4)]
+    ax1, ay1, ax2, ay2 = [a[..., i][..., :, None] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., i] for i in range(4)]
     ix1 = jnp.maximum(ax1, bx1)
     iy1 = jnp.maximum(ay1, by1)
     ix2 = jnp.minimum(ax2, bx2)

@@ -1,0 +1,47 @@
+"""SSD stack: multi_box_head -> ssd_loss trains; detection_output decodes
+with on-device NMS."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_ssd_training_pipeline():
+    B, C = 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        gt_box = fluid.layers.data("gt_box", [2, 4], dtype="float32")
+        gt_label = fluid.layers.data("gt_label", [2], dtype="int64")
+        f1 = fluid.layers.conv2d(img, 8, 3, stride=4, padding=1,
+                                 act="relu", name="f1")
+        f2 = fluid.layers.conv2d(f1, 8, 3, stride=2, padding=1,
+                                 act="relu", name="f2")
+        locs, confs, boxes, variances = fluid.layers.multi_box_head(
+            [f1, f2], img, base_size=32, num_classes=C,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[4.0, 8.0],
+            max_sizes=[8.0, 16.0], offset=0.5, flip=True)
+        loss = fluid.layers.reduce_sum(fluid.layers.ssd_loss(
+            locs, confs, gt_box, gt_label, boxes, variances))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+        out = fluid.layers.detection_output(
+            locs, confs, boxes, variances, keep_top_k=5,
+            score_threshold=0.01)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.randn(B, 3, 32, 32).astype("float32"),
+        "gt_box": np.tile(np.array([[[0.1, 0.1, 0.4, 0.4],
+                                     [0.5, 0.5, 0.9, 0.9]]], "float32"),
+                          (B, 1, 1)),
+        "gt_label": np.tile(np.array([[1, 2]], "int64"), (B, 1)),
+    }
+    losses = []
+    for _ in range(12):
+        (l,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, losses
+    (det,) = exe.run(main, feed=feed, fetch_list=[out], scope=scope)
+    assert det.shape[0] == B and det.shape[2] == 6
