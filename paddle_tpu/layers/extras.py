@@ -927,3 +927,59 @@ def load(out, file_path, load_as_fp16=False):
                      outputs={"Out": [out]},
                      attrs={"file_path": file_path})
     return out
+
+
+
+@_export
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """fluid.layers.retinanet_detection_output (detection.py) — per-level
+    decode + cross-level NMS, padded [N, keep_top_k, 6] + counts."""
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference("float32")
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="retinanet_detection_output",
+        inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                "Anchors": list(anchors), "ImInfo": [im_info]},
+        outputs={"Out": [out], "NmsRoisNum": [num]},
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold)})
+    return out
+
+
+@_export
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """fluid.layers.generate_proposal_labels (detection.py:2598) on padded
+    batches; fixed [batch_size_per_im] samples, -1-padded labels."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference("float32")
+    labels = helper.create_variable_for_type_inference("int32")
+    tgts = helper.create_variable_for_type_inference("float32")
+    iw = helper.create_variable_for_type_inference("float32")
+    ow = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "GtBoxes": [gt_boxes]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [tgts], "BboxInsideWeights": [iw],
+                 "BboxOutsideWeights": [ow]},
+        attrs={"batch_size_per_im": int(batch_size_per_im),
+               "fg_fraction": float(fg_fraction),
+               "fg_thresh": float(fg_thresh),
+               "bg_thresh_hi": float(bg_thresh_hi),
+               "bg_thresh_lo": float(bg_thresh_lo),
+               "bbox_reg_weights": [float(w) for w in bbox_reg_weights],
+               "class_nums": int(class_nums or 81),
+               "use_random": bool(use_random)})
+    return rois, labels, tgts, iw, ow

@@ -189,3 +189,177 @@ def retinanet_target_assign(ctx, op, ins):
     lbl, tb, wt, n_fg = jax.vmap(one)(gt, gt_labels)
     return {"TargetLabel": lbl, "TargetBBox": tb, "BBoxInsideWeight": wt,
             "ForegroundNumber": n_fg[:, None]}
+
+
+@register_op("retinanet_detection_output", grad=None)
+def retinanet_detection_output(ctx, op, ins):
+    """detection/retinanet_detection_output_op.cc: per-FPN-level decode +
+    per-level score top-k, then one cross-level multiclass NMS. Static
+    form: BBoxes/Scores/Anchors are lists of per-level tensors; outputs
+    padded [N, keep_top_k, 6] + counts."""
+    bboxes_l = ins["BBoxes"]                 # list of [N, Ai, 4] deltas
+    scores_l = ins["Scores"]                 # list of [N, Ai, C] (sigmoid)
+    anchors_l = ins["Anchors"]               # list of [Ai, 4]
+    im_info = ins["ImInfo"][0]               # [N, 3]
+    score_thresh = float(op.attr("score_threshold", 0.05))
+    nms_top_k = int(op.attr("nms_top_k", 1000))
+    keep_top_k = int(op.attr("keep_top_k", 100))
+    nms_thresh = float(op.attr("nms_threshold", 0.3))
+
+    def decode(deltas, anchors, info):
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2 - 1, cy + h / 2 - 1], 1)
+        return jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], 1)
+
+    def one_image(args):
+        per_level_boxes, per_level_scores, info = args
+        all_boxes = jnp.concatenate(per_level_boxes, 0)     # [A, 4]
+        all_scores = jnp.concatenate(per_level_scores, 0)   # [A, C]
+        C = all_scores.shape[1]
+        outs, labels, scs = [], [], []
+        for c in range(C):
+            s = jnp.where(all_scores[:, c] > score_thresh,
+                          all_scores[:, c], -jnp.inf)
+            k = min(nms_top_k, s.shape[0])
+            top_s, top_i = lax.top_k(s, k)
+            kidx, kscore = static_nms(all_boxes[top_i], top_s,
+                                      nms_thresh, k)
+            src = jnp.where(kidx >= 0, top_i[jnp.maximum(kidx, 0)], -1)
+            outs.append(src)
+            scs.append(kscore)
+            labels.append(jnp.full(src.shape, c, jnp.int32))
+        src = jnp.concatenate(outs)
+        ks = jnp.concatenate(scs)
+        lbl = jnp.concatenate(labels)
+        kk = min(keep_top_k, src.shape[0])
+        top_s, top_i = lax.top_k(ks, kk)
+        valid = top_s > -jnp.inf
+        src_k = jnp.where(valid, src[top_i], -1)
+        rows = jnp.concatenate([
+            jnp.where(valid, lbl[top_i], -1)[:, None].astype(
+                all_boxes.dtype),
+            jnp.where(valid, top_s, -1.0)[:, None],
+            jnp.where(valid[:, None], all_boxes[jnp.maximum(src_k, 0)],
+                      -1.0)], 1)
+        return rows, jnp.sum(valid).astype(jnp.int32)
+
+    N = bboxes_l[0].shape[0]
+    rows, nums = [], []
+    for n in range(N):
+        per_boxes = [decode(b[n], a, im_info[n])
+                     for b, a in zip(bboxes_l, anchors_l)]
+        per_scores = [s[n] for s in scores_l]
+        r, c = one_image((per_boxes, per_scores, im_info[n]))
+        rows.append(r)
+        nums.append(c)
+    return {"Out": jnp.stack(rows), "NmsRoisNum": jnp.stack(nums)}
+
+
+@register_op("generate_proposal_labels", grad=None, needs_rng=True)
+def generate_proposal_labels(ctx, op, ins):
+    """detection/generate_proposal_labels_op.cc: sample RoIs for the
+    second Faster R-CNN stage. Static form over padded [N, R, 4] rois and
+    [N, G, 4] gts: per image, IoU-match rois (+appended gts, like the
+    reference), take fg (iou >= fg_thresh, capped at fg_fraction*batch)
+    and bg (bg_thresh_lo <= iou < bg_thresh_hi) into a fixed
+    [batch_size_per_im] sample with -1 padding."""
+    rois = ins["RpnRois"][0]                 # [N, R, 4]
+    gt_classes = ins["GtClasses"][0].astype(jnp.int32)     # [N, G]
+    gt_boxes = ins["GtBoxes"][0]             # [N, G, 4]
+    batch = int(op.attr("batch_size_per_im", 256))
+    fg_frac = float(op.attr("fg_fraction", 0.25))
+    fg_thresh = float(op.attr("fg_thresh", 0.5))
+    bg_hi = float(op.attr("bg_thresh_hi", 0.5))
+    bg_lo = float(op.attr("bg_thresh_lo", 0.0))
+    weights = [float(w) for w in op.attr("bbox_reg_weights",
+                                         [0.1, 0.1, 0.2, 0.2])]
+    class_nums = int(op.attr("class_nums", 81))
+    use_random = bool(op.attr("use_random", True))
+    F = int(batch * fg_frac)
+    key = ctx.rng_for(op) if use_random else None
+
+    def one(rois_i, gt_i, cls_i, key_i):
+        cand = jnp.concatenate([rois_i, gt_i], 0)          # [R+G, 4]
+        valid_gt = (gt_i[:, 2] > gt_i[:, 0]) & (gt_i[:, 3] > gt_i[:, 1])
+        valid_cand = jnp.concatenate([
+            (rois_i[:, 2] > rois_i[:, 0]) & (rois_i[:, 3] > rois_i[:, 1]),
+            valid_gt])
+        iou = iou_xyxy(cand, gt_i)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        max_iou = jnp.where(valid_cand, jnp.max(iou, axis=1), 0.0)
+        arg = jnp.argmax(iou, axis=1)
+        fg_mask = max_iou >= fg_thresh
+        bg_mask = (max_iou < bg_hi) & (max_iou >= bg_lo) & valid_cand \
+            & ~fg_mask
+        A = cand.shape[0]
+
+        def pick(mask, k, kj):
+            if kj is None:
+                pri = jnp.where(mask, jnp.arange(A, dtype=jnp.float32),
+                                2.0 * A + jnp.arange(A, dtype=jnp.float32))
+            else:
+                pri = jnp.where(mask, jax.random.uniform(kj, (A,)),
+                                2.0 + jnp.arange(A, dtype=jnp.float32))
+            order = jnp.argsort(pri)[:k].astype(jnp.int32)
+            ok = mask[order]
+            return jnp.where(ok, order, -1)
+
+        k1 = k2 = None
+        if key_i is not None:
+            k1, k2 = jax.random.split(key_i)
+        fg_idx = pick(fg_mask, F, k1)                      # [F]
+        n_fg = jnp.sum(fg_idx >= 0)
+        bg_pool = pick(bg_mask, batch, k2)
+        n_bg = jnp.minimum(jnp.sum(bg_pool >= 0), batch - n_fg)
+        bg_idx = jnp.where(jnp.arange(batch) < n_bg, bg_pool, -1)
+        cat = jnp.concatenate([fg_idx, bg_idx])
+        is_fg_slot = jnp.arange(F + batch) < F
+        order = jnp.argsort(jnp.where(cat >= 0, 0, 1), stable=True)[:batch]
+        sel = cat[order]
+        sel_fg = is_fg_slot[order] & (sel >= 0)
+        sampled = cand[jnp.maximum(sel, 0)]                # [batch, 4]
+        sampled = jnp.where((sel >= 0)[:, None], sampled, 0.0)
+        mgt = gt_i[arg[jnp.maximum(sel, 0)]]
+        labels = jnp.where(
+            sel < 0, -1,
+            jnp.where(sel_fg, cls_i[arg[jnp.maximum(sel, 0)]], 0))
+        # bbox targets (fg rows only), bbox2delta with reg weights
+        sw = jnp.maximum(sampled[:, 2] - sampled[:, 0], 1.0)
+        sh = jnp.maximum(sampled[:, 3] - sampled[:, 1], 1.0)
+        scx = sampled[:, 0] + sw / 2
+        scy = sampled[:, 1] + sh / 2
+        gw = jnp.maximum(mgt[:, 2] - mgt[:, 0], 1.0)
+        gh = jnp.maximum(mgt[:, 3] - mgt[:, 1], 1.0)
+        gcx = mgt[:, 0] + gw / 2
+        gcy = mgt[:, 1] + gh / 2
+        tgt = jnp.stack([(gcx - scx) / sw / weights[0],
+                         (gcy - scy) / sh / weights[1],
+                         jnp.log(gw / sw) / weights[2],
+                         jnp.log(gh / sh) / weights[3]], 1)
+        tgt = jnp.where(sel_fg[:, None], tgt, 0.0)
+        wt = jnp.where(sel_fg[:, None], 1.0, 0.0)
+        return (sampled, labels.astype(jnp.int32), tgt,
+                jnp.broadcast_to(wt, (batch, 4)),
+                jnp.broadcast_to(wt, (batch, 4)))
+
+    N = rois.shape[0]
+    keys = (jax.random.split(key, N) if key is not None
+            else [None] * N)
+    outs = [one(rois[n], gt_boxes[n], gt_classes[n],
+                keys[n] if key is not None else None) for n in range(N)]
+    stack = lambda i: jnp.stack([o[i] for o in outs])
+    return {"Rois": stack(0), "LabelsInt32": stack(1),
+            "BboxTargets": stack(2), "BboxInsideWeights": stack(3),
+            "BboxOutsideWeights": stack(4)}

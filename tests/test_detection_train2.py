@@ -13,12 +13,16 @@ def _run_op(op_type, inputs, out_slots, attrs):
     feed = {}
     in_names = {}
     for slot, v in inputs.items():
-        nm = f"i_{slot}"
-        v = np.asarray(v)
-        block.create_var(name=nm, shape=list(v.shape), dtype=str(v.dtype),
-                         is_data=True)
-        feed[nm] = v
-        in_names[slot] = [nm]
+        vals = v if isinstance(v, list) else [v]
+        names = []
+        for i, vv in enumerate(vals):
+            nm = f"i_{slot}_{i}"
+            vv = np.asarray(vv)
+            block.create_var(name=nm, shape=list(vv.shape),
+                             dtype=str(vv.dtype), is_data=True)
+            feed[nm] = vv
+            names.append(nm)
+        in_names[slot] = names
     out_names = {s: [f"o_{s}"] for s in out_slots}
     for s in out_slots:
         block.create_var(name=f"o_{s}", shape=[1], dtype="float32")
@@ -113,3 +117,45 @@ def test_retinanet_target_assign():
     assert int(np.ravel(out["ForegroundNumber"])[0]) == 1
     assert (out["BBoxInsideWeight"][0][0] == 1).all()
     assert (out["BBoxInsideWeight"][0][1] == 0).all()
+
+
+def test_generate_proposal_labels_static():
+    rois = np.array([[[0, 0, 10, 10], [20, 20, 30, 30],
+                      [2, 2, 9, 9], [50, 50, 60, 60]]], "float32")
+    gts = np.array([[[1, 1, 9, 9]]], "float32")
+    cls = np.array([[3]], "int32")
+    out = _run_op("generate_proposal_labels",
+                  {"RpnRois": rois, "GtClasses": cls, "GtBoxes": gts},
+                  ["Rois", "LabelsInt32", "BboxTargets",
+                   "BboxInsideWeights", "BboxOutsideWeights"],
+                  {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                   "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                   "bg_thresh_lo": 0.0, "class_nums": 5,
+                   "use_random": False})
+    lbl = out["LabelsInt32"][0]
+    # roi0 (iou~0.63) and the appended gt are fg with class 3; others bg/pad
+    fg = lbl[lbl > 0]
+    assert len(fg) == 2 and (fg == 3).all(), lbl
+    assert (out["BboxInsideWeights"][0][:2] == 1).all()
+
+
+def test_retinanet_detection_output():
+    anchors = [np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32"),
+               np.array([[0, 0, 20, 20]], "float32")]
+    deltas = [np.zeros((1, 2, 4), "float32"),
+              np.zeros((1, 1, 4), "float32")]
+    scores = [np.array([[[0.9, 0.1], [0.6, 0.2]]], "float32"),
+              np.array([[[0.05, 0.8]]], "float32")]
+    iminfo = np.array([[64, 64, 1.0]], "float32")
+    out = _run_op("retinanet_detection_output",
+                  {"BBoxes": deltas, "Scores": scores, "Anchors": anchors,
+                   "ImInfo": iminfo},
+                  ["Out", "NmsRoisNum"],
+                  {"score_threshold": 0.1, "nms_top_k": 3,
+                   "keep_top_k": 4, "nms_threshold": 0.5})
+    n = int(np.ravel(out["NmsRoisNum"])[0])
+    # class 0: 0.9, 0.6 (disjoint); class 1: 0.2, 0.8 (0.1 filtered)
+    assert n == 4
+    rows = out["Out"][0][:n]
+    assert (np.diff(rows[:, 1]) <= 1e-6).all()  # score-sorted
+    np.testing.assert_allclose(rows[0, 1], 0.9, atol=1e-6)
