@@ -983,3 +983,74 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
                "class_nums": int(class_nums or 81),
                "use_random": bool(use_random)})
     return rois, labels, tgts, iw, ow
+
+
+
+@_export
+def deformable_roi_pooling(input, rois, trans=None, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=True,
+                           name=None):
+    """fluid.layers.deformable_roi_pooling over deformable_psroi_pooling."""
+    helper = LayerHelper("deformable_roi_pooling", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    cnt = helper.create_variable_for_type_inference("float32")
+    gh, gw = (group_size if isinstance(group_size, (list, tuple))
+              else (group_size, group_size))
+    output_dim = input.shape[1] // (gh * gw) if position_sensitive \
+        else input.shape[1]
+    ins = {"Input": [input], "ROIs": [rois]}
+    if trans is not None and not no_trans:
+        ins["Trans"] = [trans]
+    helper.append_op(
+        type="deformable_psroi_pooling", inputs=ins,
+        outputs={"Output": [out], "TopCount": [cnt]},
+        attrs={"no_trans": bool(no_trans or trans is None),
+               "spatial_scale": float(spatial_scale),
+               "output_dim": int(output_dim),
+               "group_size": [int(gh), int(gw)],
+               "pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "part_size": [int(p) for p in (part_size or
+                                              (pooled_height,
+                                               pooled_width))],
+               "sample_per_part": int(sample_per_part),
+               "trans_std": float(trans_std)})
+    return out
+
+
+@_export
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    helper = LayerHelper("roi_perspective_transform")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    mat = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out], "Mask": [mask], "TransformMatrix": [mat]},
+        attrs={"transformed_height": int(transformed_height),
+               "transformed_width": int(transformed_width),
+               "spatial_scale": float(spatial_scale)})
+    return out, mask, mat
+
+
+@_export
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes=None, resolution=14):
+    """fluid.layers.generate_mask_labels (Mask R-CNN targets; host-side
+    polygon rasterization like the reference CPU kernel)."""
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = helper.create_variable_for_type_inference("float32")
+    has_mask = helper.create_variable_for_type_inference("int32")
+    mask_int32 = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"Rois": [rois], "LabelsInt32": [labels_int32],
+                "GtSegms": [gt_segms]},
+        outputs={"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
+                 "MaskInt32": [mask_int32]},
+        attrs={"resolution": int(resolution)})
+    return mask_rois, has_mask, mask_int32

@@ -1,0 +1,218 @@
+"""Detection family, final batch: deformable_psroi_pooling,
+roi_perspective_transform, and the generate_mask_labels host op — the last
+three reference detection kernels.
+
+Same fixed-shape vectorization rules as detection_train.py; the mask-label
+rasterizer runs host-side (COCO polygons are ragged CPU data in the
+reference too, generate_mask_labels_op.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.executor import register_host_op
+from ..framework.registry import register_op
+from .nn_extra2 import _bilinear_sample_nchw
+
+
+@register_op("deformable_psroi_pooling", diff_inputs=("Input", "Trans"))
+def deformable_psroi_pooling(ctx, op, ins):
+    """deformable_psroi_pooling_op.h: position-sensitive RoI pooling whose
+    bins are shifted by learned offsets (Trans [R, 2, part_h, part_w],
+    scaled by trans_std * roi extent). Input channels = output_dim *
+    group_h * group_w; each bin averages sample_per_part^2 bilinear
+    samples of its channel group."""
+    x = ins["Input"][0]                         # [N, C, H, W]
+    rois = ins["ROIs"][0]                       # [R, 4]
+    trans = ins["Trans"][0] if ins.get("Trans") else None
+    no_trans = bool(op.attr("no_trans", trans is None))
+    scale = float(op.attr("spatial_scale", 1.0))
+    output_dim = int(op.attr("output_dim"))
+    group = [int(g) for g in op.attr("group_size", [1, 1])]
+    ph = int(op.attr("pooled_height"))
+    pw = int(op.attr("pooled_width"))
+    part = [int(p) for p in op.attr("part_size", [ph, pw])]
+    sample_per_part = int(op.attr("sample_per_part", 4))
+    trans_std = float(op.attr("trans_std", 0.1))
+    if ins.get("RoisBatch"):
+        rb = ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+    else:
+        rb = jnp.zeros((rois.shape[0],), jnp.int32)
+    gh, gw = group
+    S = sample_per_part
+
+    def one(roi, b, tr):
+        # +0.5-rounded roi extents (deformable_psroi_pooling_op.h:76)
+        x1 = jnp.round(roi[0]) * scale - 0.5
+        y1 = jnp.round(roi[1]) * scale - 0.5
+        x2 = (jnp.round(roi[2]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / ph, rw / pw
+        sub_h, sub_w = bin_h / S, bin_w / S
+        img = x[b]
+        outs = []
+        counts = []
+        for i in range(ph):
+            for j in range(pw):
+                if no_trans:
+                    tx = ty = 0.0
+                else:
+                    pi = min(int(i * part[0] / ph), part[0] - 1)
+                    pj = min(int(j * part[1] / pw), part[1] - 1)
+                    tx = tr[0, pi, pj] * trans_std * rw
+                    ty = tr[1, pi, pj] * trans_std * rh
+                ws = j * bin_w + x1 + tx
+                hs = i * bin_h + y1 + ty
+                sy = hs + (jnp.arange(S) + 0.0) * sub_h
+                sx = ws + (jnp.arange(S) + 0.0) * sub_w
+                py = jnp.broadcast_to(sy[:, None], (S, S))
+                px = jnp.broadcast_to(sx[None, :], (S, S))
+                ghi = min(max(int(i * gh / ph), 0), gh - 1)
+                gwi = min(max(int(j * gw / pw), 0), gw - 1)
+                # channel slice for this bin: [output_dim]
+                ch = img.reshape(output_dim, gh, gw, *img.shape[1:])[
+                    :, ghi, gwi]
+                H, W = ch.shape[1], ch.shape[2]
+                inb = ((py >= -0.5) & (py < H - 0.5)
+                       & (px >= -0.5) & (px < W - 0.5))
+                s = _bilinear_sample_nchw(
+                    ch, jnp.clip(py, 0, H - 1), jnp.clip(px, 0, W - 1))
+                s = s * inb[None]
+                cnt = jnp.maximum(jnp.sum(inb), 1)
+                outs.append(jnp.sum(s, axis=(1, 2)) / cnt)
+                counts.append(jnp.sum(inb))
+        out = jnp.stack(outs, 1).reshape(output_dim, ph, pw)
+        cnts = jnp.stack(counts).reshape(1, ph, pw)
+        return out, jnp.broadcast_to(cnts, (output_dim, ph, pw))
+
+    if trans is None:
+        trans_r = jnp.zeros((rois.shape[0], 2, part[0], part[1]),
+                            x.dtype)
+    else:
+        trans_r = trans
+    out, cnt = jax.vmap(one)(rois, rb, trans_r)
+    return {"Output": out, "TopCount": cnt.astype(jnp.float32)}
+
+
+@register_op("roi_perspective_transform", diff_inputs=("X",))
+def roi_perspective_transform(ctx, op, ins):
+    """detection/roi_perspective_transform_op.cc: warp quadrilateral ROIs
+    ([R, 8] corner quads) to a fixed rectangle with the reference's
+    closed-form homography (get_transform_matrix, :110); out-of-range
+    samples are zero and masked."""
+    x = ins["X"][0]                              # [N, C, H, W]
+    rois = ins["ROIs"][0]                        # [R, 8]
+    scale = float(op.attr("spatial_scale", 1.0))
+    th = int(op.attr("transformed_height"))
+    tw = int(op.attr("transformed_width"))
+    if ins.get("RoisBatch"):
+        rb = ins["RoisBatch"][0].reshape(-1).astype(jnp.int32)
+    else:
+        rb = jnp.zeros((rois.shape[0],), jnp.int32)
+    H, W = x.shape[2], x.shape[3]
+
+    def one(roi, b):
+        rx = roi[0::2] * scale
+        ry = roi[1::2] * scale
+        x0, x1_, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1_, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        len1 = jnp.sqrt((x0 - x1_) ** 2 + (y0 - y1_) ** 2)
+        len2 = jnp.sqrt((x1_ - x2) ** 2 + (y1_ - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        nh = max(2, th)
+        nw_f = jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-5)) + 1
+        nw = jnp.clip(nw_f, 2, tw)
+        dx1, dx2, dx3 = x1_ - x2, x3 - x2, x0 - x1_ + x2 - x3
+        dy1, dy2, dy3 = y1_ - y2, y3 - y2, y0 - y1_ + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+        m3 = (y1_ - y0 + m6 * (nw - 1) * y1_) / (nw - 1)
+        m4 = (y3 - y0 + m7 * (nh - 1) * y3) / (nh - 1)
+        m0 = (x1_ - x0 + m6 * (nw - 1) * x1_) / (nw - 1)
+        m1 = (x3 - x0 + m7 * (nh - 1) * x3) / (nh - 1)
+        matrix = jnp.stack([m0, m1, x0, m3, m4, y0, m6, m7,
+                            jnp.asarray(1.0, rx.dtype)])
+        ow = jnp.arange(tw, dtype=rx.dtype)[None, :]
+        oh = jnp.arange(th, dtype=rx.dtype)[:, None]
+        u = m0 * ow + m1 * oh + x0
+        v = m3 * ow + m4 * oh + y0
+        wq = m6 * ow + m7 * oh + 1.0
+        in_w = u / wq
+        in_h = v / wq
+        inb = ((in_w >= -0.5) & (in_w <= W - 0.5)
+               & (in_h >= -0.5) & (in_h <= H - 0.5)
+               & (ow < nw) & (oh < nh))
+        s = _bilinear_sample_nchw(x[b], jnp.clip(in_h, 0, H - 1),
+                                  jnp.clip(in_w, 0, W - 1))
+        out = s * inb[None]
+        return out, inb.astype(jnp.int32)[None], matrix
+
+    out, mask, mats = jax.vmap(one)(rois, rb)
+    return {"Out": out, "Mask": mask, "TransformMatrix": mats,
+            "Out2InIdx": None, "Out2InWeights": None}
+
+
+@register_host_op("generate_mask_labels")
+def generate_mask_labels(scope, op, exe):
+    """detection/generate_mask_labels_op.cc: rasterize COCO polygon
+    ground truth into per-RoI binary mask targets (CPU in the reference
+    too — polygons are ragged host data). Padded convention: GtSegms
+    [N, G, V, 2] polygon vertices (NaN/0-padded rows ignored), Rois
+    [N, R, 4], LabelsInt32 [N, R] (-1 pad). Emits [N*R, resolution^2]
+    mask targets aligned with the input RoI order."""
+    rois = np.asarray(scope.find_var(op.input("Rois")[0]))
+    labels = np.asarray(scope.find_var(op.input("LabelsInt32")[0]))
+    segms = np.asarray(scope.find_var(op.input("GtSegms")[0]))
+    res = int(op.attr("resolution", 14))
+    N, R = labels.shape
+
+    def rasterize(poly, x1, y1, x2, y2):
+        """Scanline polygon fill on the res x res grid mapped to the roi."""
+        mask = np.zeros((res, res), np.int32)
+        pts = poly[~np.isnan(poly).any(-1)]  # NaN rows = padding
+        if len(pts) < 3:
+            return mask
+        w = max(x2 - x1, 1e-5)
+        h = max(y2 - y1, 1e-5)
+        px = (pts[:, 0] - x1) / w * res
+        py = (pts[:, 1] - y1) / h * res
+        # even-odd rule per grid-cell center
+        yy, xx = np.mgrid[0:res, 0:res]
+        cx = xx + 0.5
+        cy = yy + 0.5
+        inside = np.zeros((res, res), bool)
+        j = len(px) - 1
+        for i in range(len(px)):
+            cond = ((py[i] > cy) != (py[j] > cy)) & (
+                cx < (px[j] - px[i]) * (cy - py[i])
+                / (py[j] - py[i] + 1e-12) + px[i])
+            inside ^= cond
+            j = i
+        return inside.astype(np.int32)
+
+    out = np.zeros((N * R, res * res), np.int32)
+    k = 0
+    for n in range(N):
+        for r in range(R):
+            if labels[n, r] > 0:
+                x1, y1, x2, y2 = rois[n, r]
+                # first non-empty polygon for this image (padded convention
+                # carries one gt segm set per positive roi index if G >= R)
+                g = min(r, segms.shape[1] - 1)
+                out[k] = rasterize(segms[n, g], x1, y1, x2, y2).reshape(-1)
+            k += 1
+    import jax.numpy as jnp2
+    scope.set_var(op.output("MaskRois")[0],
+                  jnp2.asarray(rois.reshape(N * R, 4)))
+    scope.set_var(op.output("RoiHasMaskInt32")[0],
+                  jnp2.asarray((labels.reshape(-1) > 0).astype(np.int32)))
+    scope.set_var(op.output("MaskInt32")[0], jnp2.asarray(out))

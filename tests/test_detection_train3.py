@@ -1,0 +1,93 @@
+"""Final detection ops: deformable_psroi_pooling, roi_perspective_transform,
+generate_mask_labels."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run_op(op_type, inputs, out_slots, attrs):
+    main = fluid.Program()
+    block = main.global_block()
+    feed, in_names = {}, {}
+    for slot, v in inputs.items():
+        vals = v if isinstance(v, list) else [v]
+        names = []
+        for i, vv in enumerate(vals):
+            nm = f"i_{slot}_{i}"
+            vv = np.asarray(vv)
+            block.create_var(name=nm, shape=list(vv.shape),
+                             dtype=str(vv.dtype), is_data=True)
+            feed[nm] = vv
+            names.append(nm)
+        in_names[slot] = names
+    out_names = {s: [f"o_{s}"] for s in out_slots}
+    for s in out_slots:
+        block.create_var(name=f"o_{s}", shape=[1], dtype="float32")
+    block.append_op(type=op_type, inputs=in_names, outputs=out_names,
+                    attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    vals = exe.run(main, feed=feed,
+                   fetch_list=[f"o_{s}" for s in out_slots])
+    return dict(zip(out_slots, vals))
+
+
+def test_deformable_psroi_pooling_zero_trans_matches_psroi():
+    """With no_trans the op reduces to plain position-sensitive pooling of
+    constant channel slices."""
+    out_dim, ph, pw = 2, 2, 2
+    C = out_dim * ph * pw
+    x = np.zeros((1, C, 8, 8), "float32")
+    for c in range(C):
+        x[0, c] = c + 1
+    rois = np.array([[0, 0, 7, 7]], "float32")
+    out = _run_op("deformable_psroi_pooling",
+                  {"Input": x, "ROIs": rois},
+                  ["Output", "TopCount"],
+                  {"no_trans": True, "spatial_scale": 1.0,
+                   "output_dim": out_dim, "group_size": [ph, pw],
+                   "pooled_height": ph, "pooled_width": pw,
+                   "part_size": [ph, pw], "sample_per_part": 2,
+                   "trans_std": 0.1})
+    o = out["Output"][0]  # first (only) roi
+    # bin (i,j) of out-channel d reads channel d*ph*pw + gi*pw + gj = const
+    for d in range(out_dim):
+        for i in range(ph):
+            for j in range(pw):
+                assert abs(o[d, i, j] - (d * ph * pw + i * pw + j + 1)) \
+                    < 1e-4
+
+
+def test_roi_perspective_transform_axis_aligned():
+    """An axis-aligned quad behaves like a crop+resize; constant input
+    stays constant inside the mask."""
+    x = np.full((1, 3, 16, 16), 2.0, "float32")
+    # quad corners (clockwise from top-left): covers [2, 10] square
+    rois = np.array([[2, 2, 10, 2, 10, 10, 2, 10]], "float32")
+    out = _run_op("roi_perspective_transform",
+                  {"X": x, "ROIs": rois},
+                  ["Out", "Mask", "TransformMatrix"],
+                  {"spatial_scale": 1.0, "transformed_height": 4,
+                   "transformed_width": 4})
+    o, m = out["Out"][0], out["Mask"][0]  # first roi
+    assert m.sum() > 0
+    inside = o[:, m[0] > 0]
+    np.testing.assert_allclose(inside, 2.0, atol=1e-5)
+
+
+def test_generate_mask_labels_square_polygon():
+    rois = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "float32")
+    labels = np.array([[1, -1]], "int32")
+    # a square polygon covering the left half of roi0
+    segms = np.full((1, 2, 4, 2), np.nan, "float32")
+    segms[0, 0] = [[0, 0], [5, 0], [5, 10], [0, 10]]
+    out = _run_op("generate_mask_labels",
+                  {"Rois": rois, "LabelsInt32": labels, "GtSegms": segms},
+                  ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+                  {"resolution": 8})
+    mask = out["MaskInt32"].reshape(-1, 8, 8)
+    has = out["RoiHasMaskInt32"]
+    np.testing.assert_array_equal(np.ravel(has), [1, 0])
+    m0 = mask[0]
+    assert m0[:, :4].mean() > 0.9     # left half filled
+    assert m0[:, 4:].mean() < 0.1     # right half empty
+    assert mask[1].sum() == 0
