@@ -987,19 +987,25 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
 
 
 @_export
-def deformable_roi_pooling(input, rois, trans=None, no_trans=False,
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
                            spatial_scale=1.0, group_size=(1, 1),
                            pooled_height=1, pooled_width=1, part_size=None,
-                           sample_per_part=1, trans_std=0.1, position_sensitive=True,
-                           name=None):
-    """fluid.layers.deformable_roi_pooling over deformable_psroi_pooling."""
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """fluid.layers.deformable_roi_pooling over deformable_psroi_pooling
+    (fluid signature: trans required, position_sensitive default False;
+    PS mode divides channels by pooled_height*pooled_width)."""
     helper = LayerHelper("deformable_roi_pooling", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     cnt = helper.create_variable_for_type_inference("float32")
     gh, gw = (group_size if isinstance(group_size, (list, tuple))
               else (group_size, group_size))
-    output_dim = input.shape[1] // (gh * gw) if position_sensitive \
-        else input.shape[1]
+    if position_sensitive:
+        output_dim = input.shape[1] // (pooled_height * pooled_width)
+        gh, gw = pooled_height, pooled_width
+    else:
+        output_dim = input.shape[1]
+        gh = gw = 1
     ins = {"Input": [input], "ROIs": [rois]}
     if trans is not None and not no_trans:
         ins["Trans"] = [trans]
@@ -1041,16 +1047,23 @@ def roi_perspective_transform(input, rois, transformed_height,
 def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
                          labels_int32, num_classes=None, resolution=14):
     """fluid.layers.generate_mask_labels (Mask R-CNN targets; host-side
-    polygon rasterization like the reference CPU kernel)."""
+    polygon rasterization like the reference CPU kernel). im_info scales
+    the original-image polygons; crowd gts are excluded; masks land in
+    their class slice when num_classes is given."""
     helper = LayerHelper("generate_mask_labels")
     mask_rois = helper.create_variable_for_type_inference("float32")
     has_mask = helper.create_variable_for_type_inference("int32")
     mask_int32 = helper.create_variable_for_type_inference("int32")
+    ins = {"Rois": [rois], "LabelsInt32": [labels_int32],
+           "GtSegms": [gt_segms]}
+    if im_info is not None:
+        ins["ImInfo"] = [im_info]
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
     helper.append_op(
-        type="generate_mask_labels",
-        inputs={"Rois": [rois], "LabelsInt32": [labels_int32],
-                "GtSegms": [gt_segms]},
+        type="generate_mask_labels", inputs=ins,
         outputs={"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
                  "MaskInt32": [mask_int32]},
-        attrs={"resolution": int(resolution)})
+        attrs={"resolution": int(resolution),
+               "num_classes": int(num_classes or 1)})
     return mask_rois, has_mask, mask_int32

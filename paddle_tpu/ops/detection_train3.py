@@ -161,58 +161,108 @@ def roi_perspective_transform(ctx, op, ins):
             "Out2InIdx": None, "Out2InWeights": None}
 
 
+def _trim_poly(poly):
+    """Valid polygon vertices: NaN rows and the trailing all-zero run are
+    padding ((0,0) is a legal INTERIOR vertex)."""
+    pts = poly[~np.isnan(poly).any(-1)]
+    n = len(pts)
+    while n > 0 and pts[n - 1, 0] == 0.0 and pts[n - 1, 1] == 0.0:
+        n -= 1
+    return pts[:n]
+
+
+def _rasterize(pts, x1, y1, x2, y2, res):
+    """Even-odd scanline fill of a polygon onto the res x res RoI grid."""
+    mask = np.zeros((res, res), np.int32)
+    if len(pts) < 3:
+        return mask
+    w = max(x2 - x1, 1e-5)
+    h = max(y2 - y1, 1e-5)
+    px = (pts[:, 0] - x1) / w * res
+    py = (pts[:, 1] - y1) / h * res
+    yy, xx = np.mgrid[0:res, 0:res]
+    cx = xx + 0.5
+    cy = yy + 0.5
+    inside = np.zeros((res, res), bool)
+    j = len(px) - 1
+    for i in range(len(px)):
+        cond = ((py[i] > cy) != (py[j] > cy)) & (
+            cx < (px[j] - px[i]) * (cy - py[i])
+            / (py[j] - py[i] + 1e-12) + px[i])
+        inside ^= cond
+        j = i
+    return inside.astype(np.int32)
+
+
+def _poly_bbox(pts):
+    if len(pts) == 0:
+        return np.zeros(4, np.float32)
+    return np.array([pts[:, 0].min(), pts[:, 1].min(),
+                     pts[:, 0].max(), pts[:, 1].max()], np.float32)
+
+
+def _iou_np(a, b):
+    iw = max(min(a[2], b[2]) - max(a[0], b[0]), 0.0)
+    ih = max(min(a[3], b[3]) - max(a[1], b[1]), 0.0)
+    inter = iw * ih
+    ua = max((a[2] - a[0]) * (a[3] - a[1])
+             + (b[2] - b[0]) * (b[3] - b[1]) - inter, 1e-6)
+    return inter / ua
+
+
 @register_host_op("generate_mask_labels")
 def generate_mask_labels(scope, op, exe):
-    """detection/generate_mask_labels_op.cc: rasterize COCO polygon
-    ground truth into per-RoI binary mask targets (CPU in the reference
-    too — polygons are ragged host data). Padded convention: GtSegms
-    [N, G, V, 2] polygon vertices (NaN/0-padded rows ignored), Rois
-    [N, R, 4], LabelsInt32 [N, R] (-1 pad). Emits [N*R, resolution^2]
-    mask targets aligned with the input RoI order."""
+    """detection/generate_mask_labels_op.cc: rasterize COCO polygon ground
+    truth into per-RoI mask targets (CPU in the reference too — polygons
+    are ragged host data). Padded convention: GtSegms [N, G, V, 2]
+    (NaN rows or a trailing zero run = padding), Rois [N, R, 4] in
+    IMAGE-SCALED coords, LabelsInt32 [N, R] (-1 pad), optional ImInfo
+    [N, 3] (polygons are original-image coords and get scaled by
+    im_info[2]), optional IsCrowd [N, G] (crowd gts never supply masks).
+    Each positive RoI rasterizes the polygon of its best-IoU gt; with
+    num_classes the mask lands in its class slice of
+    [N*R, num_classes*res^2] like the reference layout."""
     rois = np.asarray(scope.find_var(op.input("Rois")[0]))
     labels = np.asarray(scope.find_var(op.input("LabelsInt32")[0]))
     segms = np.asarray(scope.find_var(op.input("GtSegms")[0]))
+    im_info = (np.asarray(scope.find_var(op.input("ImInfo")[0]))
+               if op.input("ImInfo") else None)
+    is_crowd = (np.asarray(scope.find_var(op.input("IsCrowd")[0]))
+                if op.input("IsCrowd") else None)
     res = int(op.attr("resolution", 14))
+    num_classes = int(op.attr("num_classes", 1))
     N, R = labels.shape
+    G = segms.shape[1]
 
-    def rasterize(poly, x1, y1, x2, y2):
-        """Scanline polygon fill on the res x res grid mapped to the roi."""
-        mask = np.zeros((res, res), np.int32)
-        pts = poly[~np.isnan(poly).any(-1)]  # NaN rows = padding
-        if len(pts) < 3:
-            return mask
-        w = max(x2 - x1, 1e-5)
-        h = max(y2 - y1, 1e-5)
-        px = (pts[:, 0] - x1) / w * res
-        py = (pts[:, 1] - y1) / h * res
-        # even-odd rule per grid-cell center
-        yy, xx = np.mgrid[0:res, 0:res]
-        cx = xx + 0.5
-        cy = yy + 0.5
-        inside = np.zeros((res, res), bool)
-        j = len(px) - 1
-        for i in range(len(px)):
-            cond = ((py[i] > cy) != (py[j] > cy)) & (
-                cx < (px[j] - px[i]) * (cy - py[i])
-                / (py[j] - py[i] + 1e-12) + px[i])
-            inside ^= cond
-            j = i
-        return inside.astype(np.int32)
-
-    out = np.zeros((N * R, res * res), np.int32)
+    out = np.zeros((N * R, num_classes * res * res), np.int32)
     k = 0
     for n in range(N):
+        scale = float(im_info[n, 2]) if im_info is not None else 1.0
+        polys = [_trim_poly(segms[n, g]) * scale for g in range(G)]
+        gt_boxes = [_poly_bbox(p) for p in polys]
         for r in range(R):
             if labels[n, r] > 0:
                 x1, y1, x2, y2 = rois[n, r]
-                # first non-empty polygon for this image (padded convention
-                # carries one gt segm set per positive roi index if G >= R)
-                g = min(r, segms.shape[1] - 1)
-                out[k] = rasterize(segms[n, g], x1, y1, x2, y2).reshape(-1)
+                best, best_iou = -1, 0.0
+                for g in range(G):
+                    if len(polys[g]) < 3:
+                        continue
+                    if is_crowd is not None and is_crowd[n, g]:
+                        continue
+                    iou = _iou_np((x1, y1, x2, y2), gt_boxes[g])
+                    if iou > best_iou:
+                        best, best_iou = g, iou
+                if best >= 0:
+                    m = _rasterize(polys[best], x1, y1, x2, y2, res)
+                    c = min(int(labels[n, r]), num_classes - 1) \
+                        if num_classes > 1 else 0
+                    out[k, c * res * res:(c + 1) * res * res] = \
+                        m.reshape(-1)
             k += 1
-    import jax.numpy as jnp2
+    import jax.numpy as jnp
+
     scope.set_var(op.output("MaskRois")[0],
-                  jnp2.asarray(rois.reshape(N * R, 4)))
+                  jnp.asarray(rois.reshape(N * R, 4)))
     scope.set_var(op.output("RoiHasMaskInt32")[0],
-                  jnp2.asarray((labels.reshape(-1) > 0).astype(np.int32)))
-    scope.set_var(op.output("MaskInt32")[0], jnp2.asarray(out))
+                  jnp.asarray((labels.reshape(-1) > 0).astype(np.int32)))
+    scope.set_var(op.output("MaskInt32")[0], jnp.asarray(out))

@@ -1,0 +1,38 @@
+"""Shared single-op program harness for detection-family tests."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def run_single_op(op_type, inputs, out_slots, attrs, out_counts=None):
+    main = fluid.Program()
+    block = main.global_block()
+    feed, in_names = {}, {}
+    for slot, v in inputs.items():
+        vals = v if isinstance(v, list) else [v]
+        names = []
+        for i, vv in enumerate(vals):
+            nm = f"i_{slot}_{i}"
+            vv = np.asarray(vv)
+            block.create_var(name=nm, shape=list(vv.shape),
+                             dtype=str(vv.dtype), is_data=True)
+            feed[nm] = vv
+            names.append(nm)
+        in_names[slot] = names
+    out_names = {}
+    for s in out_slots:
+        n = (out_counts or {}).get(s, 1)
+        out_names[s] = [f"o_{s}_{i}" for i in range(n)]
+        for nm in out_names[s]:
+            block.create_var(name=nm, shape=[1], dtype="float32")
+    block.append_op(type=op_type, inputs=in_names, outputs=out_names,
+                    attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fetch = [n for ns in out_names.values() for n in ns]
+    vals = exe.run(main, feed=feed, fetch_list=fetch)
+    flat = dict(zip(fetch, vals))
+    out = {}
+    for s, ns in out_names.items():
+        vs = [flat[n] for n in ns]
+        out[s] = vs if len(vs) > 1 else vs[0]
+    return out

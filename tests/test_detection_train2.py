@@ -7,31 +7,7 @@ import paddle_tpu as fluid
 from op_test import OpTest
 
 
-def _run_op(op_type, inputs, out_slots, attrs):
-    main = fluid.Program()
-    block = main.global_block()
-    feed = {}
-    in_names = {}
-    for slot, v in inputs.items():
-        vals = v if isinstance(v, list) else [v]
-        names = []
-        for i, vv in enumerate(vals):
-            nm = f"i_{slot}_{i}"
-            vv = np.asarray(vv)
-            block.create_var(name=nm, shape=list(vv.shape),
-                             dtype=str(vv.dtype), is_data=True)
-            feed[nm] = vv
-            names.append(nm)
-        in_names[slot] = names
-    out_names = {s: [f"o_{s}"] for s in out_slots}
-    for s in out_slots:
-        block.create_var(name=f"o_{s}", shape=[1], dtype="float32")
-    block.append_op(type=op_type, inputs=in_names, outputs=out_names,
-                    attrs=attrs)
-    exe = fluid.Executor(fluid.CPUPlace())
-    vals = exe.run(main, feed=feed,
-                   fetch_list=[f"o_{s}" for s in out_slots])
-    return dict(zip(out_slots, vals))
+from op_harness import run_single_op as _run_op  # noqa: E402
 
 
 def test_multiclass_nms2_device():

@@ -5,30 +5,7 @@ import numpy as np
 import paddle_tpu as fluid
 
 
-def _run_op(op_type, inputs, out_slots, attrs):
-    main = fluid.Program()
-    block = main.global_block()
-    feed, in_names = {}, {}
-    for slot, v in inputs.items():
-        vals = v if isinstance(v, list) else [v]
-        names = []
-        for i, vv in enumerate(vals):
-            nm = f"i_{slot}_{i}"
-            vv = np.asarray(vv)
-            block.create_var(name=nm, shape=list(vv.shape),
-                             dtype=str(vv.dtype), is_data=True)
-            feed[nm] = vv
-            names.append(nm)
-        in_names[slot] = names
-    out_names = {s: [f"o_{s}"] for s in out_slots}
-    for s in out_slots:
-        block.create_var(name=f"o_{s}", shape=[1], dtype="float32")
-    block.append_op(type=op_type, inputs=in_names, outputs=out_names,
-                    attrs=attrs)
-    exe = fluid.Executor(fluid.CPUPlace())
-    vals = exe.run(main, feed=feed,
-                   fetch_list=[f"o_{s}" for s in out_slots])
-    return dict(zip(out_slots, vals))
+from op_harness import run_single_op as _run_op  # noqa: E402
 
 
 def test_deformable_psroi_pooling_zero_trans_matches_psroi():
@@ -91,3 +68,29 @@ def test_generate_mask_labels_square_polygon():
     assert m0[:, :4].mean() > 0.9     # left half filled
     assert m0[:, 4:].mean() < 0.1     # right half empty
     assert mask[1].sum() == 0
+
+
+def test_generate_mask_labels_matches_by_iou_and_scales():
+    """RoIs pick their best-IoU gt polygon (not their index); im_info
+    scales original-image polygons; zero-padded vertices are trimmed."""
+    # two gts: small square at origin-ish, big square at (20..40)
+    segms = np.zeros((1, 2, 6, 2), "float32")
+    segms[0, 0, :4] = [[0, 0], [10, 0], [10, 10], [0, 10]]   # gt0 (+0 pad)
+    segms[0, 1, :4] = [[20, 20], [40, 20], [40, 40], [20, 40]]
+    # rois in 2x-scaled image coords; roi0 overlaps gt1, roi1 overlaps gt0
+    rois = np.array([[[40, 40, 80, 80], [0, 0, 20, 20]]], "float32")
+    labels = np.array([[2, 1]], "int32")
+    im_info = np.array([[100, 100, 2.0]], "float32")
+    out = _run_op("generate_mask_labels",
+                  {"Rois": rois, "LabelsInt32": labels, "GtSegms": segms,
+                   "ImInfo": im_info},
+                  ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+                  {"resolution": 4, "num_classes": 3})
+    mask = out["MaskInt32"].reshape(2, 3, 4, 4)
+    # roi0 (label 2) -> class-2 slice filled from gt1's polygon (x2 scale
+    # makes it exactly cover the roi)
+    assert mask[0, 2].mean() > 0.9
+    assert mask[0, 1].sum() == 0      # not in the wrong class slice
+    # roi1 (label 1) -> class-1 slice from gt0
+    assert mask[1, 1].mean() > 0.9
+    assert mask[1, 2].sum() == 0
