@@ -75,3 +75,101 @@ CUDAPlace = XLAPlace  # reference scripts swap transparently
 data = layers.data
 
 __version__ = "0.1.0"
+
+
+# -- top-level namespace completion (reference fluid/__init__.py __all__) --
+import numpy as _np
+
+# runtime tensor types: device values are jax arrays; the LoD-carrying
+# host-side type the reference exposes maps to numpy here
+Tensor = _np.ndarray
+LoDTensor = _np.ndarray
+LoDTensorArray = list
+from .framework.core import XLAPlace as CUDAPinnedPlace  # alias: pinned
+# host staging is XLA-owned; accepted for API parity
+from .framework import backward as backward  # noqa: F401
+import sys as _sys
+
+_sys.modules[__name__ + ".backward"] = backward
+from .dygraph.varbase import VarBase  # noqa: F401
+from .layers import embedding, one_hot  # noqa: F401
+from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
+from . import transpiler  # noqa: F401
+
+
+def enable_dygraph(place=None):
+    """paddle.fluid.enable_dygraph — enter global eager mode."""
+    from .dygraph import base as _dybase
+
+    _dybase.enable_dygraph(place)
+
+
+def disable_dygraph():
+    from .dygraph import base as _dybase
+
+    _dybase.disable_dygraph()
+
+
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+
+
+def save(program, model_path):
+    """paddle.fluid.save (fluid/io.py save): persistables + program."""
+    from . import io as _io
+    from .framework.executor import Executor
+    from .framework.core import XLAPlace
+    import os as _os
+
+    d = _os.path.dirname(model_path) or "."
+    _os.makedirs(d, exist_ok=True)
+    exe = Executor(XLAPlace(0))
+    _io.save_persistables(exe, d, main_program=program,
+                          filename=_os.path.basename(model_path)
+                          + ".pdparams")
+    with open(model_path + ".pdmodel", "wb") as f:
+        from .framework import paddle_pb
+        from .framework.serialization import program_to_desc
+
+        f.write(paddle_pb.desc_to_pb(program_to_desc(program)))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """paddle.fluid.load — inverse of fluid.save."""
+    from . import io as _io
+    from .framework.executor import Executor
+    from .framework.core import XLAPlace
+    import os as _os
+
+    exe = executor or Executor(XLAPlace(0))
+    _io.load_persistables(exe, _os.path.dirname(model_path) or ".",
+                          main_program=program,
+                          filename=_os.path.basename(model_path)
+                          + ".pdparams")
+
+
+def install_check():
+    """paddle.fluid.install_check.run_check parity: one tiny train step."""
+    import numpy as _np
+
+    from .framework.core import XLAPlace
+    from .framework.executor import Executor
+    from .framework.program import Program, program_guard
+    from . import layers as _l
+    from . import optimizer as _opt
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = _l.data("install_check_x", [4], dtype="float32")
+        y = _l.fc(x, 2)
+        loss = _l.reduce_mean(y)
+        _opt.SGD(0.01).minimize(loss)
+    exe = Executor(XLAPlace(0))
+    exe.run(startup)
+    out = exe.run(main,
+                  feed={"install_check_x":
+                        _np.ones((2, 4), _np.float32)},
+                  fetch_list=[loss])
+    assert _np.isfinite(_np.asarray(out[0])).all()
+    print("Your paddle_tpu works well on this machine.")
+    return True
