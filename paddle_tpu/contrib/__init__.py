@@ -1,2 +1,9 @@
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from . import layers_extra  # noqa: F401
+from .layers_extra import (  # noqa: F401
+    BasicGRUUnit,
+    BasicLSTMUnit,
+    basic_gru,
+    basic_lstm,
+)

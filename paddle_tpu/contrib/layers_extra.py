@@ -1,0 +1,116 @@
+"""contrib.layers RNN implementations — parity with
+python/paddle/fluid/contrib/layers/rnn_impl.py (BasicLSTMUnit,
+BasicGRUUnit, basic_lstm, basic_gru): multi-layer (optionally
+bidirectional) RNNs assembled from the cell API over one compiled scan per
+layer/direction.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["BasicLSTMUnit", "BasicGRUUnit", "basic_lstm", "basic_gru"]
+
+
+class BasicLSTMUnit:
+    """rnn_impl.py BasicLSTMUnit — one LSTM step (gate layout i,f,o,j via
+    the lstm_unit op's fused fc)."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._forget_bias = forget_bias
+        self._name = name_scope or "basic_lstm_unit"
+
+    def __call__(self, input, pre_hidden, pre_cell):
+        h, c = layers.lstm_unit(input, pre_hidden, pre_cell,
+                                forget_bias=self._forget_bias,
+                                param_attr=self._param_attr,
+                                bias_attr=self._bias_attr,
+                                name=self._name)
+        return h, c
+
+
+class BasicGRUUnit:
+    """rnn_impl.py BasicGRUUnit — one GRU step."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._name = name_scope or "basic_gru_unit"
+
+    def __call__(self, input, pre_hidden):
+        proj = layers.fc(input, 3 * self.hidden_size,
+                         param_attr=self._param_attr, bias_attr=False,
+                         name=self._name + "_proj")
+        h, _, _ = layers.gru_unit(proj, pre_hidden, 3 * self.hidden_size,
+                                  param_attr=self._param_attr,
+                                  bias_attr=self._bias_attr)
+        return h
+
+
+def _run_stack(cell_fn, input, num_layers, bidirectional, sequence_length):
+    outs = input
+    for layer_i in range(num_layers):
+        fwd, _ = cell_fn(outs, layer_i, False)
+        if bidirectional:
+            bwd, _ = cell_fn(outs, layer_i, True)
+            outs = layers.concat([fwd, bwd], axis=2)
+        else:
+            outs = fwd
+    return outs
+
+
+def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=None,
+               num_layers=1, sequence_length=None, dropout_prob=0.0,
+               bidirectional=False, batch_first=True, param_attr=None,
+               bias_attr=None, gate_activation=None, activation=None,
+               forget_bias=1.0, dtype="float32", name="basic_lstm"):
+    """rnn_impl.py basic_lstm on padded [B, T, D] input."""
+    if not batch_first:
+        input = layers.transpose(input, perm=[1, 0, 2])
+
+    def cell_fn(x, layer_i, reverse):
+        cell = layers.LSTMCell(hidden_size,
+                               name=f"{name}_l{layer_i}"
+                                    f"{'_rev' if reverse else ''}")
+        return layers.rnn(cell, x, sequence_length=sequence_length,
+                          is_reverse=reverse)
+
+    out = _run_stack(cell_fn, input, num_layers, bidirectional,
+                     sequence_length)
+    if dropout_prob:
+        out = layers.dropout(out, dropout_prob=dropout_prob)
+    if not batch_first:
+        out = layers.transpose(out, perm=[1, 0, 2])
+    return out, None, None
+
+
+def basic_gru(input, init_hidden=None, hidden_size=None, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """rnn_impl.py basic_gru on padded [B, T, D] input."""
+    if not batch_first:
+        input = layers.transpose(input, perm=[1, 0, 2])
+
+    def cell_fn(x, layer_i, reverse):
+        cell = layers.GRUCell(hidden_size,
+                              name=f"{name}_l{layer_i}"
+                                   f"{'_rev' if reverse else ''}")
+        return layers.rnn(cell, x, sequence_length=sequence_length,
+                          is_reverse=reverse)
+
+    out = _run_stack(cell_fn, input, num_layers, bidirectional,
+                     sequence_length)
+    if dropout_prob:
+        out = layers.dropout(out, dropout_prob=dropout_prob)
+    if not batch_first:
+        out = layers.transpose(out, perm=[1, 0, 2])
+    return out, None

@@ -26,6 +26,23 @@ from .nn import (  # noqa: F401
     SpectralNorm,
 )
 from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .containers import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LayerList,
+    LearningRateDecay,
+    LinearLrWarmup,
+    NaturalExpDecay,
+    NoamDecay,
+    ParameterList,
+    PiecewiseDecay,
+    PolynomialDecay,
+    ReduceLROnPlateau,
+    Sequential,
+)
+from ..layers.rnn_api import GRUCell, LSTMCell  # noqa: F401 (cell API is
+# shared between static rnn() and eager use — same step math)
 from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from . import jit  # noqa: F401
 from .jit import (  # noqa: F401
@@ -35,3 +52,23 @@ from .jit import (  # noqa: F401
     declarative,
     to_static,
 )
+
+
+def dygraph_to_static_func(fn):
+    """Alias of @to_static (reference dygraph_to_static_func)."""
+    from .jit import to_static
+
+    return to_static(fn)
+
+
+def start_gperf_profiler():
+    """gperf hooks map to the host-event profiler on this build."""
+    from ..profiler import start_profiler
+
+    start_profiler("All")
+
+
+def stop_gperf_profiler():
+    from ..profiler import stop_profiler
+
+    stop_profiler()

@@ -1,0 +1,273 @@
+"""Dygraph containers + LR decay objects — parity with fluid/dygraph/
+container.py (Sequential, LayerList, ParameterList) and
+learning_rate_scheduler.py (the *Decay classes usable as optimizer
+learning_rate in dygraph mode).
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional
+
+from .layers import Layer
+
+__all__ = ["Sequential", "LayerList", "ParameterList", "LearningRateDecay",
+           "NoamDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+           "CosineDecay", "LinearLrWarmup", "ReduceLROnPlateau"]
+
+
+class Sequential(Layer):
+    """container.py Sequential: callable chain of sublayers."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        self._seq: List[Layer] = []
+        for i, l in enumerate(layers):
+            if isinstance(l, (list, tuple)):
+                name, l = l
+            else:
+                name = str(i)
+            self.add_sublayer(name, l)
+            self._seq.append(l)
+
+    def __getitem__(self, idx):
+        return self._seq[idx]
+
+    def __len__(self):
+        return len(self._seq)
+
+    def forward(self, x):
+        for l in self._seq:
+            x = l(x)
+        return x
+
+
+class LayerList(Layer):
+    """container.py LayerList: indexable list of sublayers."""
+
+    def __init__(self, sublayers: Optional[Iterable[Layer]] = None):
+        super().__init__()
+        self._list: List[Layer] = []
+        for l in sublayers or []:
+            self.append(l)
+
+    def append(self, layer: Layer):
+        self.add_sublayer(str(len(self._list)), layer)
+        self._list.append(layer)
+        return self
+
+    def insert(self, index: int, layer: Layer):
+        self._list.insert(index, layer)
+        for i, l in enumerate(self._list):
+            self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        return self._list[idx]
+
+    def __iter__(self):
+        return iter(self._list)
+
+    def __len__(self):
+        return len(self._list)
+
+
+class ParameterList(Layer):
+    """container.py ParameterList."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self._plist = []
+        for p in parameters or []:
+            self.append(p)
+
+    def append(self, parameter):
+        setattr(self, f"_p{len(self._plist)}", parameter)
+        self._plist.append(parameter)
+        return self
+
+    def __getitem__(self, idx):
+        return self._plist[idx]
+
+    def __iter__(self):
+        return iter(self._plist)
+
+    def __len__(self):
+        return len(self._plist)
+
+
+class LearningRateDecay:
+    """learning_rate_scheduler.py base: step() advances, __call__/current
+    yields the float lr the optimizer multiplies in."""
+
+    def __init__(self, begin=0, step=1):
+        self.step_num = begin
+        self.step_size = step
+
+    def step(self):
+        self.step_num += self.step_size
+
+    def __call__(self):
+        return float(self.current())
+
+    def current(self):
+        raise NotImplementedError
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 learning_rate=1.0):
+        super().__init__(begin, step)
+        self.d_model = d_model
+        self.warmup = warmup_steps
+        self.base = learning_rate
+
+    def current(self):
+        n = max(self.step_num, 1)
+        return self.base * self.d_model ** -0.5 * min(
+            n ** -0.5, n * self.warmup ** -1.5)
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1):
+        super().__init__(begin, step)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def current(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.step_num < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.lr, self.ds, self.dr = learning_rate, decay_steps, decay_rate
+        self.staircase = staircase
+
+    def current(self):
+        div = self.step_num / self.ds
+        if self.staircase:
+            div = math.floor(div)
+        return self.lr * math.exp(-self.dr * div)
+
+
+class ExponentialDecay(NaturalExpDecay):
+    def current(self):
+        div = self.step_num / self.ds
+        if self.staircase:
+            div = math.floor(div)
+        return self.lr * self.dr ** div
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    def current(self):
+        div = self.step_num / self.ds
+        if self.staircase:
+            div = math.floor(div)
+        return self.lr / (1 + self.dr * div)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.ds = decay_steps
+        self.end = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def current(self):
+        n = self.step_num
+        ds = self.ds
+        if self.cycle:
+            ds = ds * max(math.ceil(n / ds), 1)
+        else:
+            n = min(n, ds)
+        return (self.lr - self.end) * (1 - n / ds) ** self.power + self.end
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.spe = step_each_epoch
+        self.epochs = epochs
+
+    def current(self):
+        epoch = math.floor(self.step_num / self.spe)
+        return self.lr * 0.5 * (math.cos(epoch * math.pi / self.epochs)
+                                + 1)
+
+
+class LinearLrWarmup(LearningRateDecay):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1):
+        super().__init__(begin, step)
+        self.inner = learning_rate
+        self.warmup = warmup_steps
+        self.start_lr, self.end_lr = start_lr, end_lr
+
+    def current(self):
+        if self.step_num < self.warmup:
+            return self.start_lr + (self.end_lr - self.start_lr) \
+                * self.step_num / self.warmup
+        if isinstance(self.inner, LearningRateDecay):
+            return self.inner.current()
+        return float(self.inner)
+
+
+class ReduceLROnPlateau(LearningRateDecay):
+    """learning_rate_scheduler.py ReduceLROnPlateau: shrink lr when the
+    tracked metric stops improving."""
+
+    def __init__(self, learning_rate, mode="min", decay_rate=0.1,
+                 patience=10, verbose=False, threshold=1e-4,
+                 threshold_mode="rel", cooldown=0, min_lr=0, eps=1e-8):
+        super().__init__()
+        self.lr = float(learning_rate)
+        self.mode = mode
+        self.decay_rate = decay_rate
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.eps = eps
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+
+    def _better(self, a, b):
+        if self.threshold_mode == "rel":
+            t = 1 - self.threshold if self.mode == "min" \
+                else 1 + self.threshold
+            return a < b * t if self.mode == "min" else a > b * t
+        return a < b - self.threshold if self.mode == "min" \
+            else a > b + self.threshold
+
+    def step(self, metric=None):
+        self.step_num += self.step_size
+        if metric is None:
+            return
+        m = float(metric)
+        if self.best is None or self._better(m, self.best):
+            self.best = m
+            self.num_bad = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                new_lr = max(self.lr * self.decay_rate, self.min_lr)
+                if self.lr - new_lr > self.eps:
+                    self.lr = new_lr
+                self.cooldown_counter = self.cooldown
+                self.num_bad = 0
+
+    def current(self):
+        return self.lr

@@ -1,0 +1,25 @@
+"""contrib basic_lstm/basic_gru stacks."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_basic_lstm_gru_stacks():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6, 5], dtype="float32")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        lstm_out, _, _ = fluid.contrib.basic_lstm(
+            x, hidden_size=7, num_layers=2, sequence_length=ln)
+        gru_out, _ = fluid.contrib.basic_gru(
+            x, hidden_size=4, num_layers=1, bidirectional=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    lo, go = exe.run(main, feed={"x": rng.randn(2, 6, 5).astype("float32"),
+                                 "ln": np.array([6, 3], "int64")},
+                     fetch_list=[lstm_out, gru_out])
+    assert lo.shape == (2, 6, 7)
+    assert (lo[1, 3:] == 0).all()       # masked past length
+    assert go.shape == (2, 6, 8)        # bidirectional concat

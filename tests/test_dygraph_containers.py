@@ -1,0 +1,68 @@
+"""Dygraph containers + LR decay objects."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+
+
+def test_sequential_and_layerlist_train():
+    with dygraph.guard():
+        net = dygraph.Sequential(
+            dygraph.nn.Linear(4, 8, act="relu"),
+            dygraph.nn.Linear(8, 2),
+        )
+        assert len(net) == 2
+        x = dygraph.to_variable(np.ones((3, 4), "float32"))
+        y = net(x)
+        assert tuple(y.shape) == (3, 2)
+        # params are registered through the container
+        assert len(list(net.parameters())) == 4
+
+        ll = dygraph.LayerList([dygraph.nn.Linear(4, 4) for _ in range(3)])
+        ll.append(dygraph.nn.Linear(4, 4))
+        assert len(ll) == 4 and len(list(ll.parameters())) == 8
+        h = x
+        for l in ll:
+            h = l(h)
+        assert tuple(h.shape) == (3, 4)
+
+
+def test_lr_decays_numeric():
+    nd = dygraph.NoamDecay(d_model=512, warmup_steps=100)
+    v1 = nd()
+    for _ in range(200):
+        nd.step()
+    assert nd() < nd.base  # decayed past warmup peak region
+
+    pw = dygraph.PiecewiseDecay([3, 6], [1.0, 0.5, 0.1], begin=0)
+    vals = []
+    for _ in range(8):
+        vals.append(pw())
+        pw.step()
+    assert vals[:3] == [1.0] * 3 and vals[3:6] == [0.5] * 3 \
+        and vals[6:] == [0.1] * 2
+
+    cd = dygraph.CosineDecay(1.0, step_each_epoch=1, epochs=10)
+    first = cd()
+    for _ in range(5):
+        cd.step()
+    assert cd() < first
+
+    pl = dygraph.ReduceLROnPlateau(0.1, patience=1, decay_rate=0.5)
+    pl.step(1.0)
+    pl.step(1.0)  # no improvement x1
+    pl.step(1.0)  # patience exceeded -> decay
+    assert abs(pl() - 0.05) < 1e-9
+
+
+def test_warmup_wraps_decay():
+    inner = dygraph.PiecewiseDecay([100], [1.0, 0.1], begin=0)
+    w = dygraph.LinearLrWarmup(inner, warmup_steps=10, start_lr=0.0,
+                               end_lr=1.0, begin=0)
+    assert w() == 0.0
+    for _ in range(5):
+        w.step()
+    assert 0.4 < w() < 0.6
+    for _ in range(10):
+        w.step()
+    assert w() == 1.0
