@@ -2,3 +2,8 @@ from . import quantization  # noqa: F401
 from . import prune  # noqa: F401
 from . import distillation  # noqa: F401
 from . import nas  # noqa: F401
+from . import post_training_quantization  # noqa: F401
+from .post_training_quantization import (  # noqa: F401
+    PostTrainingQuantization,
+    WeightQuantization,
+)

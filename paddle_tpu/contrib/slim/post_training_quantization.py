@@ -1,0 +1,250 @@
+"""Post-training quantization + weight-only quantization — parity with
+contrib/slim/quantization/post_training_quantization.py
+(PostTrainingQuantization, WeightQuantization).
+
+PTQ design on this framework: run the FP inference program over a
+calibration feed generator, recording per-tensor abs-max (or histogram/KL)
+statistics for every quantizable op's inputs/outputs, then apply the
+existing QuantizationTransformPass + QuantizationFreezePass with the
+calibrated scales pinned (no training pass needed). The saved artifact is
+a regular inference model whose quant ops carry fixed scales.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["PostTrainingQuantization", "WeightQuantization"]
+
+_DEFAULT_QUANT_OPS = ["conv2d", "depthwise_conv2d", "mul", "matmul"]
+
+
+class PostTrainingQuantization:
+    """Calibrate + quantize a saved inference model without training."""
+
+    def __init__(self, executor=None, scope=None, model_dir=None,
+                 model_filename=None, params_filename=None,
+                 batch_generator=None, sample_generator=None,
+                 data_loader=None, batch_size=10, batch_nums=None,
+                 algo="abs_max", quantizable_op_type=None,
+                 is_full_quantize=False, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 is_use_cache_file=False, cache_dir=None):
+        if algo not in ("abs_max", "avg", "KL"):
+            raise ValueError(f"unsupported algo {algo!r}")
+        self._exe = executor
+        self._scope = scope
+        self._model_dir = model_dir
+        self._model_filename = model_filename
+        self._params_filename = params_filename
+        self._gen = batch_generator or sample_generator or data_loader
+        self._batch_nums = batch_nums
+        self._algo = algo
+        self._op_types = list(quantizable_op_type or _DEFAULT_QUANT_OPS)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_qtype = activation_quantize_type
+        self._w_qtype = weight_quantize_type
+        self._program = None
+        self._feed_names = None
+        self._fetch = None
+        self._scales: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    def quantize(self):
+        """Load -> calibrate -> insert quant ops with pinned scales."""
+        import paddle_tpu as fluid
+        from .quantization import (QuantizationFreezePass,
+                                   QuantizationTransformPass)
+
+        scope = self._scope or fluid.global_scope()
+        with fluid.scope_guard(scope):
+            prog, feeds, fetch = fluid.io.load_inference_model(
+                self._model_dir, self._exe,
+                model_filename=self._model_filename,
+                params_filename=self._params_filename)
+            self._program, self._feed_names, self._fetch = \
+                prog, feeds, fetch
+            self._collect_activation_stats(scope)
+
+            # moving_average_abs_max activations: the pass persists an
+            # @in_scale state var per activation, which eval mode reads
+            # WITHOUT updating (round-2 eval-mode freeze) — exactly the
+            # pinning point for calibrated scales
+            pass_ = QuantizationTransformPass(
+                scope=scope, weight_bits=self._wbits,
+                activation_bits=self._abits,
+                activation_quantize_type="moving_average_abs_max",
+                weight_quantize_type=self._w_qtype,
+                quantizable_op_type=self._op_types)
+            pass_.apply(prog)
+            import jax.numpy as jnp
+
+            for name, scale in self._scales.items():
+                sv = name + "@in_scale"
+                if scope.has_var(sv):
+                    scope.set_var(sv, jnp.asarray([scale], jnp.float32))
+            freeze = QuantizationFreezePass(
+                scope, weight_bits=self._wbits,
+                weight_quantize_type=self._w_qtype)
+            freeze.apply(prog)
+        return self._program
+
+    def save_quantized_model(self, save_model_path, model_filename=None,
+                             params_filename=None):
+        import paddle_tpu as fluid
+
+        scope = self._scope or fluid.global_scope()
+        with fluid.scope_guard(scope):
+            fluid.io.save_inference_model(
+                save_model_path, self._feed_names, self._fetch, self._exe,
+                main_program=self._program)
+        return save_model_path
+
+    # ------------------------------------------------------------------
+    def _collect_activation_stats(self, scope):
+        """Drive calibration batches, recording abs-max (or avg of batch
+        abs-max / KL-clipped max) for quantizable-op input activations."""
+        block = self._program.global_block()
+        watch: List[str] = []
+        for op in block.ops:
+            if op.type in self._op_types:
+                for slot, names in op.inputs.items():
+                    for n in names:
+                        var = block.vars.get(n)
+                        if var is not None and not var.persistable \
+                                and var.dtype in ("float32",):
+                            watch.append(n)
+        watch = sorted(set(watch))
+        stats: Dict[str, List[float]] = {n: [] for n in watch}
+        hists: Dict[str, np.ndarray] = {}
+        n_batches = 0
+        for batch in self._iter_batches():
+            vals = self._exe.run(self._program, feed=batch,
+                                 fetch_list=watch, scope=scope)
+            for n, v in zip(watch, vals):
+                v = np.abs(np.asarray(v))
+                stats[n].append(float(v.max()))
+                if self._algo == "KL":
+                    h, _ = np.histogram(v, bins=2048,
+                                        range=(0, max(v.max(), 1e-8)))
+                    hists[n] = hists.get(n, 0) + h
+            n_batches += 1
+            if self._batch_nums and n_batches >= self._batch_nums:
+                break
+        if n_batches == 0:
+            raise ValueError("calibration generator yielded no batches")
+        for n in watch:
+            if self._algo == "abs_max":
+                self._scales[n] = max(stats[n])
+            elif self._algo == "avg":
+                self._scales[n] = float(np.mean(stats[n]))
+            else:  # KL: clip at the bin minimizing KL divergence
+                self._scales[n] = _kl_threshold(hists[n], max(stats[n]))
+
+    def _iter_batches(self):
+        gen = self._gen
+        if gen is None:
+            raise ValueError("PostTrainingQuantization needs a "
+                             "batch_generator/sample_generator/data_loader")
+        it = gen() if callable(gen) else gen
+        for item in it:
+            if isinstance(item, dict):
+                yield item
+            else:
+                yield {name: np.asarray(v)
+                       for name, v in zip(self._feed_names, item)}
+
+
+def _kl_threshold(hist: np.ndarray, abs_max: float) -> float:
+    """Pick the clip threshold minimizing KL(P||Q) over histogram prefixes
+    (the reference's TensorRT-style calibration, simplified)."""
+    hist = hist.astype(np.float64)
+    total = hist.sum()
+    if total == 0:
+        return abs_max
+    best_bin = len(hist)
+    best_kl = np.inf
+    for i in range(128, len(hist) + 1, 64):
+        p = hist[:i].copy()
+        p[-1] += hist[i:].sum()          # clip mass into the last bin
+        p /= p.sum()
+        # quantize prefix to 128 levels then expand back
+        factor = i / 128
+        q = np.add.reduceat(hist[:i],
+                            (np.arange(128) * factor).astype(int))
+        q = np.repeat(q / factor, int(np.ceil(factor)))[:i]
+        qs = q.sum()
+        if qs == 0:
+            continue
+        q /= qs
+        mask = p > 0
+        kl = float(np.sum(p[mask] * np.log(
+            p[mask] / np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_bin = kl, i
+    return abs_max * best_bin / len(hist)
+
+
+class WeightQuantization:
+    """post_training_quantization.py WeightQuantization: weight-only
+    int8/int16 quantization of a saved inference model (deploy-size
+    compression; computation stays float — weights are stored quantized
+    with per-channel scales and dequantized at load)."""
+
+    def __init__(self, model_dir, model_filename=None,
+                 params_filename=None):
+        self._model_dir = model_dir
+        self._model_filename = model_filename
+        self._params_filename = params_filename
+
+    def quantize_weight_to_int(self, save_model_dir,
+                               weight_bits=8,
+                               quantizable_op_type=("conv2d", "mul",
+                                                    "matmul"),
+                               weight_quantize_type="channel_wise_abs_max",
+                               generate_test_model=False, threshold_rate=0.0):
+        import paddle_tpu as fluid
+
+        qmax = (1 << (weight_bits - 1)) - 1
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            prog, feeds, fetch = fluid.io.load_inference_model(
+                self._model_dir, exe,
+                model_filename=self._model_filename,
+                params_filename=self._params_filename)
+            block = prog.global_block()
+            import jax.numpy as jnp
+
+            report = {}
+            for op in block.ops:
+                if op.type not in quantizable_op_type:
+                    continue
+                wslot = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                         "mul": "Y", "matmul": "Y"}.get(op.type)
+                if not wslot or not op.inputs.get(wslot):
+                    continue
+                name = op.inputs[wslot][0]
+                var = block.vars.get(name)
+                if var is None or not var.persistable:
+                    continue
+                w = np.asarray(scope.find_var(name))
+                if weight_quantize_type == "channel_wise_abs_max" \
+                        and w.ndim >= 2:
+                    axis = tuple(range(1, w.ndim))
+                    scale = np.abs(w).max(axis=axis, keepdims=True)
+                else:
+                    scale = np.abs(w).max(keepdims=True)
+                scale = np.maximum(scale, 1e-8)
+                q = np.clip(np.round(w / scale * qmax), -qmax - 1, qmax)
+                deq = (q * scale / qmax).astype(np.float32)
+                scope.set_var(name, jnp.asarray(deq))
+                report[name] = float(
+                    np.abs(deq - w).max() / max(np.abs(w).max(), 1e-8))
+            fluid.io.save_inference_model(save_model_dir, feeds, fetch,
+                                          exe, main_program=prog)
+        return report

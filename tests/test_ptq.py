@@ -1,0 +1,75 @@
+"""Post-training quantization + weight-only quantization."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim import (PostTrainingQuantization,
+                                     WeightQuantization)
+
+
+def _save_fp_model(tmp_path, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu", name="p1")
+        y = fluid.layers.fc(h, 4, name="p2")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        d = str(tmp_path / "fp_model")
+        fluid.io.save_inference_model(d, ["x"], [y], exe,
+                                      main_program=main)
+    return d
+
+
+def test_ptq_quantize_and_save(tmp_path):
+    d = _save_fp_model(tmp_path)
+    rng = np.random.RandomState(0)
+    calib = [{"x": rng.rand(8, 8).astype("float32")} for _ in range(4)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ptq = PostTrainingQuantization(
+        executor=exe, scope=scope, model_dir=d,
+        batch_generator=lambda: iter(calib), batch_nums=4, algo="abs_max")
+    prog = ptq.quantize()
+    types = [op.type for op in prog.global_block().ops]
+    assert any(t.startswith("fake_") for t in types), types
+    qdir = str(tmp_path / "quant_model")
+    ptq.save_quantized_model(qdir)
+    assert os.path.exists(os.path.join(qdir, "__model__"))
+
+    # quantized model loads and runs close to FP on calibration-range data
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        qprog, feeds, fetch = fluid.io.load_inference_model(qdir, exe2)
+        (qv,) = exe2.run(qprog, feed={"x": calib[0]["x"]},
+                         fetch_list=fetch, scope=s2)
+    s3 = fluid.Scope()
+    exe3 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(s3):
+        fprog, ffeeds, ffetch = fluid.io.load_inference_model(d, exe3)
+        (fv,) = exe3.run(fprog, feed={"x": calib[0]["x"]},
+                         fetch_list=ffetch, scope=s3)
+    rel = np.abs(qv - fv).max() / max(np.abs(fv).max(), 1e-6)
+    assert rel < 0.1, rel
+
+
+def test_weight_quantization(tmp_path):
+    d = _save_fp_model(tmp_path, seed=8)
+    wq = WeightQuantization(d)
+    out_dir = str(tmp_path / "wq_model")
+    report = wq.quantize_weight_to_int(out_dir, weight_bits=8)
+    assert report and all(err < 0.02 for err in report.values()), report
+    # quantized-weight model still runs
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        prog, feeds, fetch = fluid.io.load_inference_model(out_dir, exe)
+        (v,) = exe.run(prog, feed={"x": np.ones((2, 8), "float32")},
+                       fetch_list=fetch, scope=scope)
+    assert np.isfinite(v).all()
