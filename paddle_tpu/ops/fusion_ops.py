@@ -1,0 +1,201 @@
+"""Reference fusion_* / fused_* op names as real lowerings.
+
+These exist in the reference as hand-fused CPU/CUDA kernels; on TPU the
+SAME composition written as plain jnp ops fuses under XLA anyway, so each
+lowering here is simply the op's mathematical definition — registering them
+means reference programs that contain fusion ops load and run unchanged
+(operators/fused/*.cc io contracts).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.registry import register_op
+
+
+@register_op("fusion_lstm", diff_inputs=("X", "WeightX", "WeightH", "Bias",
+                                         "H0", "C0"))
+def fusion_lstm(ctx, op, ins):
+    """fused/fusion_lstm_op.cc: XX = X @ WeightX; then the lstm loop with
+    recurrent WeightH. Padded X [B, T, D_in]; gate order (i, f, c, o) like
+    the plain lstm op. use_peepholes is accepted (Bias [1, 4D] only here —
+    the fusion kernel's peephole variant extends Bias to 7D)."""
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]                 # [D_in, 4D]
+    wh = ins["WeightH"][0]                 # [D, 4D]
+    D = wh.shape[0]
+    B = x.shape[0]
+    bias = ins["Bias"][0].reshape(1, -1) if ins.get("Bias") else 0.0
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
+    use_peep = bool(op.attr("use_peepholes", False))
+    if use_peep and bias is not None and bias.shape[-1] >= 7 * D:
+        ck_i = bias[:, 4 * D:5 * D]
+        ck_f = bias[:, 5 * D:6 * D]
+        ck_o = bias[:, 6 * D:7 * D]
+        b_g = bias[:, :4 * D]
+    else:
+        ck_i = ck_f = ck_o = 0.0
+        b_g = bias
+
+    xx = jnp.einsum("btd,de->bte", x, wx)
+
+    def step(carry, xt):
+        h_p, c_p = carry
+        g = xt + h_p @ wh + b_g
+        i = jax.nn.sigmoid(g[:, :D] + c_p * ck_i)
+        f = jax.nn.sigmoid(g[:, D:2 * D] + c_p * ck_f)
+        cand = jnp.tanh(g[:, 2 * D:3 * D])
+        c = i * cand + f * c_p
+        o = jax.nn.sigmoid(g[:, 3 * D:] + c * ck_o)
+        h = o * jnp.tanh(c)
+        return (h, c), (h, c)
+
+    xs = jnp.moveaxis(xx, 1, 0)
+    if op.attr("is_reverse", False):
+        xs = xs[::-1]
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), xs)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    cell = jnp.moveaxis(cs, 0, 1)
+    if op.attr("is_reverse", False):
+        hidden = hidden[:, ::-1]
+        cell = cell[:, ::-1]
+    return {"Hidden": hidden, "Cell": cell, "XX": xx,
+            "BatchedInput": None, "BatchedHidden": None,
+            "BatchedCell": None, "ReorderedH0": None, "ReorderedC0": None}
+
+
+@register_op("fusion_gru", diff_inputs=("X", "WeightX", "WeightH", "Bias",
+                                        "H0"))
+def fusion_gru(ctx, op, ins):
+    """fused/fusion_gru_op.cc: XX = X @ WeightX; gru loop (u, r, c gate
+    layout) with recurrent WeightH [D, 3D]."""
+    x = ins["X"][0]
+    wx = ins["WeightX"][0]
+    wh = ins["WeightH"][0]
+    D = wh.shape[0]
+    B = x.shape[0]
+    bias = ins["Bias"][0].reshape(1, -1) if ins.get("Bias") else 0.0
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    origin = bool(op.attr("origin_mode", False))
+    xx = jnp.einsum("btd,de->bte", x, wx)
+
+    def step(h_p, xt):
+        g = xt + bias
+        ur = g[:, :2 * D] + h_p @ wh[:, :2 * D]
+        u = jax.nn.sigmoid(ur[:, :D])
+        r = jax.nn.sigmoid(ur[:, D:])
+        c = jnp.tanh(g[:, 2 * D:] + (r * h_p) @ wh[:, 2 * D:])
+        h = c + u * (h_p - c) if origin else u * (c - h_p) + h_p
+        return h, h
+
+    xs = jnp.moveaxis(xx, 1, 0)
+    if op.attr("is_reverse", False):
+        xs = xs[::-1]
+    _, hs = lax.scan(step, h0, xs)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    if op.attr("is_reverse", False):
+        hidden = hidden[:, ::-1]
+    return {"Hidden": hidden, "XX": xx, "ReorderedH0": None,
+            "BatchedInput": None, "BatchedOut": None}
+
+
+@register_op("fusion_seqpool_concat", diff_inputs=("X",))
+def fusion_seqpool_concat(ctx, op, ins):
+    """fused/fusion_seqpool_concat_op.cc: sequence_pool each input then
+    concat on the feature axis. Padded inputs [B, T, D_i]."""
+    ptype = op.attr("pooltype", "SUM").upper()
+    outs = []
+    for x in ins["X"]:
+        if ptype == "SUM":
+            outs.append(jnp.sum(x, axis=1))
+        elif ptype == "AVERAGE":
+            outs.append(jnp.mean(x, axis=1))
+        elif ptype == "SQRT":
+            outs.append(jnp.sum(x, axis=1)
+                        / np.sqrt(max(x.shape[1], 1)))
+        else:
+            raise NotImplementedError(ptype)
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("fusion_repeated_fc_relu", diff_inputs=("X", "W", "Bias"))
+def fusion_repeated_fc_relu(ctx, op, ins):
+    """fused/fusion_repeated_fc_relu_op.cc: chain of fc+relu."""
+    x = ins["X"][0]
+    ws = ins["W"]
+    bs = ins.get("Bias", [])
+    relu_outs = []
+    for i, w in enumerate(ws):
+        x = x @ w
+        if i < len(bs):
+            x = x + bs[i].reshape(1, -1)
+        x = jax.nn.relu(x)
+        relu_outs.append(x)
+    return {"Out": x, "ReluOut": relu_outs[:-1]}
+
+
+@register_op("fusion_squared_mat_sub", diff_inputs=("X", "Y"))
+def fusion_squared_mat_sub(ctx, op, ins):
+    """fused/fusion_squared_mat_sub_op.cc: scalar * ((X@Y)^2 - X^2 @ Y^2)
+    (the FM second-order interaction trick)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    scalar = float(op.attr("scalar", 1.0))
+    xy = x @ y
+    x2y2 = (x * x) @ (y * y)
+    return {"Out": scalar * (xy * xy - x2y2),
+            "SquaredX": None, "SquaredY": None, "SquaredXY": None}
+
+
+@register_op("fused_embedding_eltwise_layernorm",
+             diff_inputs=("Embs", "Bias", "Scale"))
+def fused_embedding_eltwise_layernorm(ctx, op, ins):
+    """fused/fused_embedding_eltwise_layernorm_op.cc (ERNIE stack): sum of
+    per-id-tensor embedding lookups, then layernorm."""
+    ids_list = ins["Ids"]
+    embs = ins["Embs"]
+    scale = ins["Scale"][0]
+    bias = ins["Bias"][0]
+    eps = float(op.attr("epsilon", 1e-5))
+    acc = None
+    for ids, table in zip(ids_list, embs):
+        idx = ids.astype(jnp.int32)
+        if idx.ndim > 1 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        e = jnp.take(table, idx, axis=0)
+        acc = e if acc is None else acc + e
+    xf = acc.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps) * scale + bias
+    return {"Out": y.astype(acc.dtype)}
+
+
+@register_op("fusion_seqexpand_concat_fc",
+             diff_inputs=("X", "FCWeight", "FCBias"))
+def fusion_seqexpand_concat_fc(ctx, op, ins):
+    """fused/fusion_seqexpand_concat_fc_op.cc: X[0] is [B, T, D0]; the
+    remaining inputs are per-sequence [B, Di] rows broadcast over T; all
+    concat on features then one fc (+act)."""
+    xs = ins["X"]
+    w = ins["FCWeight"][0]
+    b = ins["FCBias"][0] if ins.get("FCBias") else None
+    base = xs[0]
+    T = base.shape[1]
+    parts = [base]
+    for x in xs[1:]:
+        parts.append(jnp.broadcast_to(x[:, None, :],
+                                      (x.shape[0], T, x.shape[1])))
+    cat = jnp.concatenate(parts, axis=-1)
+    out = jnp.einsum("btd,de->bte", cat, w)
+    if b is not None:
+        out = out + b.reshape(1, 1, -1)
+    act = op.attr("fc_activation", "identity")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return {"Out": out, "FCOut": None}

@@ -1,0 +1,91 @@
+"""fusion_* op lowerings: math parity with their unfused compositions."""
+import numpy as np
+
+from op_harness import run_single_op
+
+def _sig(v):
+    return 1 / (1 + np.exp(-v))
+
+
+def test_fusion_gru_matches_gru_math():
+    rng = np.random.default_rng(0)
+    B, T, Din, D = 2, 3, 5, 4
+    x = rng.standard_normal((B, T, Din)).astype("float32")
+    wx = (rng.standard_normal((Din, 3 * D)) * 0.4).astype("float32")
+    wh = (rng.standard_normal((D, 3 * D)) * 0.4).astype("float32")
+    out = run_single_op("fusion_gru",
+                        {"X": x, "WeightX": wx, "WeightH": wh},
+                        ["Hidden", "XX"], {"origin_mode": False})
+    h = np.zeros((B, D), "float32")
+    xx = x @ wx
+    for t in range(T):
+        g = xx[:, t]
+        ur = g[:, :2 * D] + h @ wh[:, :2 * D]
+        u, r = _sig(ur[:, :D]), _sig(ur[:, D:])
+        c = np.tanh(g[:, 2 * D:] + (r * h) @ wh[:, 2 * D:])
+        h = u * (c - h) + h
+    np.testing.assert_allclose(out["Hidden"][:, -1], h, atol=1e-5)
+
+
+def test_fusion_lstm_shapes_and_finite():
+    rng = np.random.default_rng(1)
+    B, T, Din, D = 2, 4, 6, 3
+    out = run_single_op(
+        "fusion_lstm",
+        {"X": rng.standard_normal((B, T, Din)).astype("float32"),
+         "WeightX": (rng.standard_normal((Din, 4 * D)) * 0.3).astype(
+             "float32"),
+         "WeightH": (rng.standard_normal((D, 4 * D)) * 0.3).astype(
+             "float32"),
+         "Bias": np.zeros((1, 4 * D), "float32")},
+        ["Hidden", "Cell", "XX"], {})
+    assert out["Hidden"].shape == (B, T, D)
+    assert np.isfinite(out["Hidden"]).all()
+    assert not np.allclose(out["Hidden"][:, 0], out["Hidden"][:, -1])
+
+
+def test_fusion_squared_mat_sub():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((3, 4)).astype("float32")
+    y = rng.standard_normal((4, 5)).astype("float32")
+    out = run_single_op("fusion_squared_mat_sub", {"X": x, "Y": y},
+                        ["Out"], {"scalar": 0.5})
+    want = 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y))
+    np.testing.assert_allclose(out["Out"], want, atol=1e-4)
+
+
+def test_fusion_seqpool_concat_and_repeated_fc():
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((2, 3, 4)).astype("float32")
+    b = rng.standard_normal((2, 5, 2)).astype("float32")
+    out = run_single_op("fusion_seqpool_concat", {"X": [a, b]}, ["Out"],
+                        {"pooltype": "SUM"})
+    np.testing.assert_allclose(
+        out["Out"], np.concatenate([a.sum(1), b.sum(1)], 1), atol=1e-5)
+
+    x = rng.standard_normal((3, 4)).astype("float32")
+    w1 = rng.standard_normal((4, 6)).astype("float32")
+    w2 = rng.standard_normal((6, 2)).astype("float32")
+    out = run_single_op("fusion_repeated_fc_relu",
+                        {"X": x, "W": [w1, w2]}, ["Out"], {})
+    want = np.maximum(np.maximum(x @ w1, 0) @ w2, 0)
+    np.testing.assert_allclose(out["Out"], want, atol=1e-5)
+
+
+def test_fused_embedding_eltwise_layernorm():
+    rng = np.random.default_rng(4)
+    V, D = 10, 6
+    ids1 = rng.integers(0, V, (2, 3)).astype("int64")
+    ids2 = rng.integers(0, V, (2, 3)).astype("int64")
+    e1 = rng.standard_normal((V, D)).astype("float32")
+    e2 = rng.standard_normal((V, D)).astype("float32")
+    scale = np.ones(D, "float32")
+    bias = np.zeros(D, "float32")
+    out = run_single_op("fused_embedding_eltwise_layernorm",
+                        {"Ids": [ids1, ids2], "Embs": [e1, e2],
+                         "Scale": scale, "Bias": bias}, ["Out"], {})
+    s = e1[ids1] + e2[ids2]
+    mu = s.mean(-1, keepdims=True)
+    sd = s.std(-1, keepdims=True)
+    want = (s - mu) / np.sqrt(sd ** 2 + 1e-5)
+    np.testing.assert_allclose(out["Out"], want, atol=1e-4)
