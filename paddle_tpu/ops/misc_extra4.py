@@ -253,3 +253,42 @@ def ctc_align(ctx, op, ins):
     out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], gathered, 0)
     return {"Output": out.astype(_I64),
             "OutputLength": new_len.reshape(-1, 1).astype(_I64)}
+
+
+@register_op("rank_attention", diff_inputs=("X", "RankParam"))
+def rank_attention(ctx, op, ins):
+    """operators/rank_attention_op.cc (PaddleRec rank feature attention),
+    per the CUDA expand kernels (rank_attention.cu.h):
+
+    RankOffset [ins, 1+2*max_rank] int: col 0 = this instance's rank
+    (1-based, 0 = invalid); then per slot k the pair (faster_rank_k,
+    ins_index_k). input_help[i, k*D:(k+1)*D] = X[ins_index_k] for valid
+    slots; the per-slot parameter block is RankParam viewed as
+    [n_rank*max_rank, D, out_col] selected by (rank-1)*max_rank +
+    (faster_k-1); Out[i] = sum_k input_help_k @ block_k."""
+    x = ins["X"][0]                                  # [ins, D]
+    rank_offset = ins["RankOffset"][0].astype(jnp.int32)
+    param = ins["RankParam"][0]                      # [n_blocks*D, out_col]
+    max_rank = int(op.attr("MaxRank", 3))
+    D = x.shape[1]
+    out_col = param.shape[1]
+    n_ins = x.shape[0]
+    blocks = param.reshape(-1, D, out_col)           # [n_rank*max_rank, D, C]
+
+    lower = rank_offset[:, 0] - 1                    # [ins]
+    ks = jnp.arange(max_rank)
+    faster = rank_offset[:, 2 * ks + 1] - 1          # [ins, max_rank]
+    index = rank_offset[:, 2 * ks + 2]               # [ins, max_rank]
+    valid = (lower[:, None] >= 0) & (faster >= 0)
+
+    gathered = x[jnp.clip(index, 0, n_ins - 1)]      # [ins, max_rank, D]
+    input_help = jnp.where(valid[..., None], gathered, 0.0)
+    block_idx = jnp.clip(lower[:, None] * max_rank + faster, 0,
+                         blocks.shape[0] - 1)
+    sel = jnp.where(valid[..., None, None],
+                    blocks[block_idx], 0.0)          # [ins, max_rank, D, C]
+    out = jnp.einsum("ikd,ikdc->ic", input_help, sel)
+    ins_rank = jnp.where(lower >= 0, rank_offset[:, 0],
+                         -1).astype(x.dtype)
+    return {"Out": out, "InputHelp": input_help.reshape(n_ins, -1),
+            "InsRank": ins_rank.reshape(-1, 1)}

@@ -89,3 +89,30 @@ def test_fused_embedding_eltwise_layernorm():
     sd = s.std(-1, keepdims=True)
     want = (s - mu) / np.sqrt(sd ** 2 + 1e-5)
     np.testing.assert_allclose(out["Out"], want, atol=1e-4)
+
+
+def test_rank_attention():
+    rng = np.random.default_rng(5)
+    n_ins, D, C, max_rank, n_rank = 3, 4, 2, 2, 3
+    x = rng.standard_normal((n_ins, D)).astype("float32")
+    param = rng.standard_normal((n_rank * max_rank * D, C)).astype(
+        "float32")
+    # ins0: rank 1, slots: (rank1, ins0), (rank2, ins1); ins2 invalid
+    ro = np.array([[1, 1, 0, 2, 1],
+                   [2, 1, 0, 0, 0],
+                   [0, 0, 0, 0, 0]], "int32")
+    out = run_single_op("rank_attention",
+                        {"X": x, "RankOffset": ro, "RankParam": param},
+                        ["Out", "InputHelp", "InsRank"],
+                        {"MaxRank": max_rank})
+    blocks = param.reshape(-1, D, C)
+    # ins0: lower=0; k0: faster=0 -> block 0*2+0=0, input X[0]
+    #        k1: faster=1 -> block 1, input X[1]
+    want0 = x[0] @ blocks[0] + x[1] @ blocks[1]
+    np.testing.assert_allclose(out["Out"][0], want0, atol=1e-5)
+    # ins1: lower=1; k0 valid (block 1*2+0=2, X[0]); k1 invalid
+    want1 = x[0] @ blocks[2]
+    np.testing.assert_allclose(out["Out"][1], want1, atol=1e-5)
+    # ins2 fully invalid -> zeros, InsRank -1
+    np.testing.assert_allclose(out["Out"][2], 0.0, atol=1e-6)
+    assert out["InsRank"][2, 0] == -1 and out["InsRank"][0, 0] == 1
