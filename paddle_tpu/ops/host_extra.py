@@ -331,3 +331,63 @@ def assert_op(scope, op, exe):
             parts.append(f"{name}={v.reshape(-1)[:int(op.attr('summarize', 20))]}")
         raise AssertionError(
             "fluid.layers.Assert failed: cond is false. " + " ".join(parts))
+
+
+@register_host_op("tree_conv")
+def tree_conv(scope, op, exe):
+    """operators/tree_conv_op.cc (TBCNN tree-based convolution) — host op:
+    the patch structure is data-dependent (EdgeSet DFS, math/tree2col.cc).
+    NodesVector [B, N, F]; EdgeSet [B, E, 2] 1-based (u, v) parent->child
+    pairs, zero-terminated; Filter [F, 3, out_size, num_filters].
+    Out [B, N, out_size, num_filters]: per root node, the depth-bounded
+    patch combines node features with (eta_l, eta_r, eta_t) position
+    coefficients, then one matmul with the flattened filter."""
+    nodes = _np(scope, op.input("NodesVector")[0])
+    edges = _np(scope, op.input("EdgeSet")[0]).astype(np.int64)
+    filt = _np(scope, op.input("Filter")[0])
+    max_depth = int(op.attr("max_depth", 2))
+    B, N, F = nodes.shape
+    _, _, out_size, num_filters = filt.shape
+    W = filt.reshape(F * 3, out_size * num_filters)
+    out = np.zeros((B, N, out_size, num_filters), nodes.dtype)
+
+    for b in range(B):
+        # adjacency (1-based), zero-terminated edge list
+        children = {}
+        node_count = 0
+        for u, v in edges[b]:
+            if u == 0 or v == 0:
+                break
+            children.setdefault(int(u), []).append(int(v))
+            node_count += 1
+        node_count += 1
+        for root in range(1, node_count + 1):
+            # DFS patch with (index, pclen, depth) per node
+            patch = [(root, 1, 1, 0)]
+            stack = [(root, 1, 1, 0)]
+            visited = {root}
+            while stack:
+                node, _, _, depth = stack[-1]
+                advanced = False
+                kids = children.get(node, [])
+                for i, v in enumerate(kids):
+                    if v not in visited and depth + 1 < max_depth:
+                        visited.add(v)
+                        stack.append((v, i, len(kids), depth + 1))
+                        patch.append((v, i + 1, len(kids), depth + 1))
+                        advanced = True
+                if not advanced:
+                    stack.pop()
+            acc = np.zeros((F, 3), nodes.dtype)
+            for node, index, pclen, depth in patch:
+                eta_t = (max_depth - depth) / max_depth
+                tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+                eta_l = (1.0 - eta_t) * tmp
+                eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+                feat = nodes[b, node - 1]
+                acc[:, 0] += eta_l * feat
+                acc[:, 1] += eta_r * feat
+                acc[:, 2] += eta_t * feat
+            out[b, root - 1] = (acc.reshape(-1) @ W).reshape(
+                out_size, num_filters)
+    _set(scope, op.output("Out")[0], out)

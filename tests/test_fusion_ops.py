@@ -116,3 +116,39 @@ def test_rank_attention():
     # ins2 fully invalid -> zeros, InsRank -1
     np.testing.assert_allclose(out["Out"][2], 0.0, atol=1e-6)
     assert out["InsRank"][2, 0] == -1 and out["InsRank"][0, 0] == 1
+
+
+def test_tree_conv_single_node_and_star():
+    rng = np.random.default_rng(6)
+    B, N, F, OS, NF = 1, 4, 3, 2, 2
+    nodes = rng.standard_normal((B, N, F)).astype("float32")
+    # star tree: 1 -> 2, 3, 4
+    edges = np.zeros((B, 4, 2), "int32")
+    edges[0, :3] = [[1, 2], [1, 3], [1, 4]]
+    filt = rng.standard_normal((F, 3, OS, NF)).astype("float32")
+    out = run_single_op("tree_conv",
+                        {"NodesVector": nodes, "EdgeSet": edges,
+                         "Filter": filt},
+                        ["Out"], {"max_depth": 2})
+    W = filt.reshape(F * 3, OS * NF)
+
+    def patch_out(items):
+        acc = np.zeros((F, 3), "float32")
+        for node, index, pclen, depth in items:
+            eta_t = (2 - depth) / 2
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1 - eta_t) * tmp
+            eta_r = (1 - eta_t) * (1 - eta_l)
+            f = nodes[0, node - 1]
+            acc[:, 0] += eta_l * f
+            acc[:, 1] += eta_r * f
+            acc[:, 2] += eta_t * f
+        return (acc.reshape(-1) @ W).reshape(OS, NF)
+
+    # root 1's patch: itself + all 3 children (depth 1 < max_depth)
+    want_root = patch_out([(1, 1, 1, 0), (2, 1, 3, 1), (3, 2, 3, 1),
+                           (4, 3, 3, 1)])
+    np.testing.assert_allclose(out["Out"][0, 0], want_root, atol=1e-5)
+    # leaf 2's patch: just itself
+    want_leaf = patch_out([(2, 1, 1, 0)])
+    np.testing.assert_allclose(out["Out"][0, 1], want_leaf, atol=1e-5)
