@@ -199,3 +199,31 @@ def fusion_seqexpand_concat_fc(ctx, op, ins):
     elif act == "tanh":
         out = jnp.tanh(out)
     return {"Out": out, "FCOut": None}
+
+
+@register_op("fusion_seqconv_eltadd_relu",
+             diff_inputs=("X", "Filter", "Bias"))
+def fusion_seqconv_eltadd_relu(ctx, op, ins):
+    """fused/fusion_seqconv_eltadd_relu_op.cc: sequence_conv + bias add +
+    relu on padded [B, T, D] (reuses the registered sequence_conv
+    lowering, contextStart/contextLength window)."""
+    from .sequence import sequence_conv as seq_conv_lower
+
+    class _Shim:
+        def __init__(self, attrs):
+            self.attrs = attrs
+
+        def attr(self, k, d=None):
+            return self.attrs.get(k, d)
+
+    out = seq_conv_lower(
+        ctx, _Shim({"contextLength": op.attr("contextLength", 3),
+                    "contextStart": op.attr("contextStart", -1),
+                    "contextStride": op.attr("contextStride", 1)}),
+        {"X": ins["X"], "Filter": ins["Filter"],
+         **({"Length": ins["Length"]} if ins.get("Length") else {})})
+    y = out["Out"] if not isinstance(out["Out"], (list, tuple)) \
+        else out["Out"][0]
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(1, 1, -1)
+    return {"Out": jax.nn.relu(y), "ColMat": None}

@@ -152,3 +152,20 @@ def test_tree_conv_single_node_and_star():
     # leaf 2's patch: just itself
     want_leaf = patch_out([(2, 1, 1, 0)])
     np.testing.assert_allclose(out["Out"][0, 1], want_leaf, atol=1e-5)
+
+
+def test_fusion_seqconv_eltadd_relu():
+    rng = np.random.default_rng(7)
+    B, T, D, NF = 2, 5, 3, 4
+    x = rng.standard_normal((B, T, D)).astype("float32")
+    # context length 3 starting at -1: filter rows = 3*D
+    f = rng.standard_normal((3 * D, NF)).astype("float32")
+    b = rng.standard_normal((NF,)).astype("float32")
+    out = run_single_op("fusion_seqconv_eltadd_relu",
+                        {"X": x, "Filter": f, "Bias": b}, ["Out"],
+                        {"contextLength": 3, "contextStart": -1})
+    from op_harness import run_single_op as rso
+    ref = rso("sequence_conv", {"X": x, "Filter": f}, ["Out"],
+              {"contextLength": 3, "contextStart": -1})
+    want = np.maximum(ref["Out"] + b.reshape(1, 1, -1), 0)
+    np.testing.assert_allclose(out["Out"], want, atol=1e-5)
