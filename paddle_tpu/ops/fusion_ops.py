@@ -227,3 +227,47 @@ def fusion_seqconv_eltadd_relu(ctx, op, ins):
     if ins.get("Bias"):
         y = y + ins["Bias"][0].reshape(1, 1, -1)
     return {"Out": jax.nn.relu(y), "ColMat": None}
+
+
+@register_op("fused_embedding_fc_lstm",
+             diff_inputs=("Embeddings", "WeightH", "Bias", "H0", "C0"))
+def fused_embedding_fc_lstm(ctx, op, ins):
+    """fused/fused_embedding_fc_lstm_op.cc: embedding lookup IS the input
+    projection (Embeddings [vocab, 4D] rows are pre-projected gates), then
+    the lstm loop. Ids padded [B, T] (or [B, T, 1])."""
+    ids = ins["Ids"][0].astype(jnp.int32)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    emb = ins["Embeddings"][0]             # [vocab, 4D]
+    wh = ins["WeightH"][0]                 # [D, 4D]
+    D = wh.shape[0]
+    B = ids.shape[0]
+    bias = ins["Bias"][0].reshape(1, -1) if ins.get("Bias") else 0.0
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), emb.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), emb.dtype)
+    xx = jnp.take(emb, ids, axis=0)        # [B, T, 4D]
+
+    def step(carry, xt):
+        h_p, c_p = carry
+        g = xt + h_p @ wh + bias
+        # gate order (c, i, f, o) per the lstm kernel family
+        cand = jnp.tanh(g[:, :D])
+        i = jax.nn.sigmoid(g[:, D:2 * D])
+        f = jax.nn.sigmoid(g[:, 2 * D:3 * D])
+        c = i * cand + f * c_p
+        o = jax.nn.sigmoid(g[:, 3 * D:])
+        h = o * jnp.tanh(c)
+        return (h, c), (h, c)
+
+    xs = jnp.moveaxis(xx, 1, 0)
+    if op.attr("is_reverse", False):
+        xs = xs[::-1]
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), xs)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    cell = jnp.moveaxis(cs, 0, 1)
+    if op.attr("is_reverse", False):
+        hidden = hidden[:, ::-1]
+        cell = cell[:, ::-1]
+    return {"Hidden": hidden, "Cell": cell, "XX": None,
+            "BatchedInput": None, "BatchedHidden": None,
+            "BatchedCell": None, "ReorderedH0": None, "ReorderedC0": None}

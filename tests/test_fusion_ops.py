@@ -169,3 +169,17 @@ def test_fusion_seqconv_eltadd_relu():
               {"contextLength": 3, "contextStart": -1})
     want = np.maximum(ref["Out"] + b.reshape(1, 1, -1), 0)
     np.testing.assert_allclose(out["Out"], want, atol=1e-5)
+
+
+def test_fused_embedding_fc_lstm_runs_and_differs_over_time():
+    rng = np.random.default_rng(8)
+    V, D, B, T = 12, 3, 2, 4
+    ids = rng.integers(0, V, (B, T)).astype("int64")
+    emb = (rng.standard_normal((V, 4 * D)) * 0.4).astype("float32")
+    wh = (rng.standard_normal((D, 4 * D)) * 0.4).astype("float32")
+    out = run_single_op("fused_embedding_fc_lstm",
+                        {"Ids": ids, "Embeddings": emb, "WeightH": wh},
+                        ["Hidden", "Cell"], {})
+    assert out["Hidden"].shape == (B, T, D)
+    assert np.isfinite(out["Hidden"]).all()
+    assert not np.allclose(out["Hidden"][:, 0], out["Hidden"][:, -1])
