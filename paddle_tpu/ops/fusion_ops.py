@@ -271,3 +271,61 @@ def fused_embedding_fc_lstm(ctx, op, ins):
     return {"Hidden": hidden, "Cell": cell, "XX": None,
             "BatchedInput": None, "BatchedHidden": None,
             "BatchedCell": None, "ReorderedH0": None, "ReorderedC0": None}
+
+
+@register_op("attention_lstm",
+             diff_inputs=("X", "C0", "H0", "AttentionWeight",
+                          "AttentionBias", "AttentionScalar",
+                          "AttentionScalarBias", "LSTMWeight", "LSTMBias"))
+def attention_lstm(ctx, op, ins):
+    """operators/attention_lstm_op.cc on padded [B, T, M] (+ optional
+    Length): per step, attention scores over the sequence =
+    relu(X @ aw[:M] + ab + prev_cell . aw[M:]) (opt. scalar+bias+relu),
+    softmax over valid tokens, lstm_x = weighted sum of X; one LSTM step
+    with W [(D+M), 4D] (hidden rows first) and gate layout (f, i, o, c)."""
+    x = ins["X"][0]                                  # [B, T, M]
+    c0 = ins["C0"][0]
+    B, T, M = x.shape
+    D = c0.shape[1]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    aw = ins["AttentionWeight"][0].reshape(-1)       # [M + D]
+    ab = (ins["AttentionBias"][0].reshape(()) if ins.get("AttentionBias")
+          else 0.0)
+    a_scalar = (ins["AttentionScalar"][0].reshape(())
+                if ins.get("AttentionScalar") else None)
+    a_scalar_b = (ins["AttentionScalarBias"][0].reshape(())
+                  if ins.get("AttentionScalarBias") else 0.0)
+    lw = ins["LSTMWeight"][0]                        # [D + M, 4D]
+    lb = ins["LSTMBias"][0].reshape(-1)              # [4D]
+    if ins.get("Length"):
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    valid = jnp.arange(T)[None, :] < ln[:, None]     # [B, T]
+
+    atted = jnp.einsum("btm,m->bt", x, aw[:M]) + ab  # [B, T]
+    wh = lw[:D]                                      # hidden rows first
+    wx = lw[D:]
+
+    def step(carry, _t):
+        h_p, c_p = carry
+        score = jax.nn.relu(atted + (c_p @ aw[M:])[:, None])
+        if a_scalar is not None:
+            score = jax.nn.relu(a_scalar * score + a_scalar_b)
+        score = jnp.where(valid, score, -jnp.inf)
+        attn = jax.nn.softmax(score, axis=1)
+        lstm_x = jnp.einsum("bt,btm->bm", attn, x)
+        g = lstm_x @ wx + h_p @ wh + lb
+        f = jax.nn.sigmoid(g[:, :D])
+        i = jax.nn.sigmoid(g[:, D:2 * D])
+        o = jax.nn.sigmoid(g[:, 2 * D:3 * D])
+        cand = jnp.tanh(g[:, 3 * D:])
+        c = f * c_p + i * cand
+        h = o * jnp.tanh(c)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), jnp.arange(T))
+    return {"Hidden": jnp.moveaxis(hs, 0, 1),
+            "Cell": jnp.moveaxis(cs, 0, 1),
+            "AttentionedX": atted.reshape(-1, 1),
+            "AttentionFCOut": None, "LSTMX": None, "LSTMOUT": None}

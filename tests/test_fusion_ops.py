@@ -183,3 +183,21 @@ def test_fused_embedding_fc_lstm_runs_and_differs_over_time():
     assert out["Hidden"].shape == (B, T, D)
     assert np.isfinite(out["Hidden"]).all()
     assert not np.allclose(out["Hidden"][:, 0], out["Hidden"][:, -1])
+
+
+def test_attention_lstm_runs():
+    rng = np.random.default_rng(9)
+    B, T, M, D = 2, 5, 4, 3
+    out = run_single_op(
+        "attention_lstm",
+        {"X": rng.standard_normal((B, T, M)).astype("float32"),
+         "C0": np.zeros((B, D), "float32"),
+         "AttentionWeight": (rng.standard_normal((M + D, 1)) * 0.5).astype(
+             "float32"),
+         "LSTMWeight": (rng.standard_normal((D + M, 4 * D)) * 0.4).astype(
+             "float32"),
+         "LSTMBias": np.zeros((1, 4 * D), "float32")},
+        ["Hidden", "Cell"], {})
+    assert out["Hidden"].shape == (B, T, D)
+    assert np.isfinite(out["Hidden"]).all()
+    assert not np.allclose(out["Hidden"][:, 0], out["Hidden"][:, -1])
