@@ -327,5 +327,5 @@ def attention_lstm(ctx, op, ins):
     (_, _), (hs, cs) = lax.scan(step, (h0, c0), jnp.arange(T))
     return {"Hidden": jnp.moveaxis(hs, 0, 1),
             "Cell": jnp.moveaxis(cs, 0, 1),
-            "AttentionedX": atted.reshape(-1, 1),
+            "AttentionedX": atted[..., None],   # [B, T, 1] padded convention
             "AttentionFCOut": None, "LSTMX": None, "LSTMOUT": None}

@@ -7,8 +7,9 @@ What remains absent after this batch is absent BY DESIGN: fusion_* /
 fused_* (XLA fusion), mkldnn/tensorrt/lite engines, nccl/gen_nccl_id
 (XLA collectives), pull/push_box_sparse (BoxPS hardware), run_program
 (dygraph partial programs stage through jax.jit directly), fl_listen_and_serv
-(federated), pyramid_hash/rank_attention/tree_conv/var_conv_2d/attention_lstm
-(niche fused CPU kernels whose capability the generic op set covers).
+(federated), pyramid_hash/var_conv_2d (niche fused CPU kernels whose
+capability the generic op set covers; rank_attention/tree_conv/
+attention_lstm gained real lowerings after this batch).
 """
 from __future__ import annotations
 
