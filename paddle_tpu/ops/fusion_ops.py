@@ -329,3 +329,43 @@ def attention_lstm(ctx, op, ins):
             "Cell": jnp.moveaxis(cs, 0, 1),
             "AttentionedX": atted[..., None],   # [B, T, 1] padded convention
             "AttentionFCOut": None, "LSTMX": None, "LSTMOUT": None}
+
+
+@register_op("var_conv_2d", diff_inputs=("X", "W"))
+def var_conv_2d(ctx, op, ins):
+    """operators/var_conv_2d_op.cc (text-matching conv over per-sequence
+    variable-size images). Padded form: X [B, C, Hmax, Wmax] with ROW/COL
+    [B] valid heights/widths; SAME-padded conv (the reference pads
+    kernel//2), outputs masked beyond each image's own (ceil(h/s),
+    ceil(w/s)) extent."""
+    x = ins["X"][0]
+    w = ins["W"][0]                         # [Cout, Cin*kh*kw]
+    cin = int(op.attr("InputChannel", 1))
+    cout = int(op.attr("OutputChannel", 1))
+    kh = int(op.attr("KernelH", 1))
+    kw = int(op.attr("KernelW", 1))
+    sh = int(op.attr("StrideH", 1))
+    sw = int(op.attr("StrideW", 1))
+    B, C, H, W = x.shape
+    if ins.get("ROW"):
+        rows = ins["ROW"][0].reshape(-1).astype(jnp.int32)
+    else:
+        rows = jnp.full((B,), H, jnp.int32)
+    if ins.get("COLUMN"):
+        cols = ins["COLUMN"][0].reshape(-1).astype(jnp.int32)
+    else:
+        cols = jnp.full((B,), W, jnp.int32)
+    filt = w.reshape(cout, cin, kh, kw)
+    dn = lax.conv_dimension_numbers(x.shape, filt.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, filt, window_strides=(sh, sw),
+        padding=[(kh // 2, kh // 2), (kw // 2, kw // 2)],
+        dimension_numbers=dn).astype(x.dtype)
+    oh, ow = out.shape[2], out.shape[3]
+    vr = -(-rows // sh)                     # ceil division
+    vc = -(-cols // sw)
+    mask = ((jnp.arange(oh)[None, :, None] < vr[:, None, None])
+            & (jnp.arange(ow)[None, None, :] < vc[:, None, None]))
+    return {"Out": jnp.where(mask[:, None], out, jnp.zeros((), x.dtype)),
+            "Col": None}

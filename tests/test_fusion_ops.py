@@ -201,3 +201,22 @@ def test_attention_lstm_runs():
     assert out["Hidden"].shape == (B, T, D)
     assert np.isfinite(out["Hidden"]).all()
     assert not np.allclose(out["Hidden"][:, 0], out["Hidden"][:, -1])
+
+
+def test_var_conv_2d_masks_per_image_extent():
+    rng = np.random.default_rng(10)
+    B, C, H, W = 2, 1, 6, 6
+    x = rng.standard_normal((B, C, H, W)).astype("float32")
+    w = rng.standard_normal((2, C * 3 * 3)).astype("float32")
+    out = run_single_op(
+        "var_conv_2d",
+        {"X": x, "W": w, "ROW": np.array([6, 3], "int64"),
+         "COLUMN": np.array([6, 4], "int64")},
+        ["Out"], {"InputChannel": C, "OutputChannel": 2,
+                  "KernelH": 3, "KernelW": 3, "StrideH": 1,
+                  "StrideW": 1})
+    o = out["Out"]
+    assert o.shape == (B, 2, 6, 6)
+    assert not np.allclose(o[0], 0)
+    assert (o[1, :, 3:, :] == 0).all() and (o[1, :, :, 4:] == 0).all()
+    assert not np.allclose(o[1, :, :3, :4], 0)
