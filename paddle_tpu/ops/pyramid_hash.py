@@ -1,0 +1,255 @@
+"""pyramid_hash — the last reference op family member
+(operators/pyramid_hash_op.cc): n-gram pyramid hashing embeddings for
+text matching, with bloom-filter white/black lists.
+
+Host op (CPU in the reference too — ragged windows + byte-level hashing):
+- XXH32 over the FLOAT-cast id bytes picks rand_len-wide rows of W with
+  the reference's rolling seed schedule (hash_embedding_ff, :226)
+- white/black lists are the reference's packed bloomfilter blobs
+  (math/bloomfilter.h: magic/m/k/count + bit vector; murmur3_x64_128
+  membership probes) — :func:`bloom_create`/:func:`bloom_add` build
+  wire-compatible blobs for tests/tools
+- padded convention: X [B, T] int ids + optional Length; Out
+  [B, maxW, num_emb] with per-sequence window counts in Length out.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..framework.executor import register_host_op
+from .misc_extra import xxh64  # noqa: F401 (sibling hash util)
+
+_M64 = (1 << 64) - 1
+_MAGIC = 17070416
+
+# ---------------------------------------------------------------------------
+# XXH32 (xxhash spec; hash_embedding_ff uses XXH32(key, len, seed))
+# ---------------------------------------------------------------------------
+
+_P32_1 = 2654435761
+_P32_2 = 2246822519
+_P32_3 = 3266489917
+_P32_4 = 668265263
+_P32_5 = 374761393
+_M32 = 0xFFFFFFFF
+
+
+def _rotl32(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + _P32_1 + _P32_2) & _M32
+        v2 = (seed + _P32_2) & _M32
+        v3 = seed & _M32
+        v4 = (seed - _P32_1) & _M32
+        while i <= n - 16:
+            for j in range(4):
+                (lane,) = struct.unpack_from("<I", data, i + 4 * j)
+                if j == 0:
+                    v1 = (_rotl32((v1 + lane * _P32_2) & _M32, 13)
+                          * _P32_1) & _M32
+                elif j == 1:
+                    v2 = (_rotl32((v2 + lane * _P32_2) & _M32, 13)
+                          * _P32_1) & _M32
+                elif j == 2:
+                    v3 = (_rotl32((v3 + lane * _P32_2) & _M32, 13)
+                          * _P32_1) & _M32
+                else:
+                    v4 = (_rotl32((v4 + lane * _P32_2) & _M32, 13)
+                          * _P32_1) & _M32
+            i += 16
+        h = (_rotl32(v1, 1) + _rotl32(v2, 7) + _rotl32(v3, 12)
+             + _rotl32(v4, 18)) & _M32
+    else:
+        h = (seed + _P32_5) & _M32
+    h = (h + n) & _M32
+    while i <= n - 4:
+        (k,) = struct.unpack_from("<I", data, i)
+        h = (_rotl32((h + k * _P32_3) & _M32, 17) * _P32_4) & _M32
+        i += 4
+    while i < n:
+        h = (_rotl32((h + data[i] * _P32_5) & _M32, 11) * _P32_1) & _M32
+        i += 1
+    h ^= h >> 15
+    h = (h * _P32_2) & _M32
+    h ^= h >> 13
+    h = (h * _P32_3) & _M32
+    h ^= h >> 16
+    return h
+
+
+# ---------------------------------------------------------------------------
+# murmur3_x64_128 + bloom blobs (math/bloomfilter.h)
+# ---------------------------------------------------------------------------
+
+
+def _fmix64(k):
+    k ^= k >> 33
+    k = (k * 0xFF51AFD7ED558CCD) & _M64
+    k ^= k >> 33
+    k = (k * 0xC4CEB9FE1A85EC53) & _M64
+    k ^= k >> 33
+    return k
+
+
+def _rotl64(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def murmur3_x64_128(data: bytes, seed: int):
+    """Reference-faithful variant INCLUDING its tail quirk: the tail is
+    read as two unconditional 8-byte loads (so the buffer is expected to
+    be padded; we zero-pad) masked per len&15."""
+    n = len(data)
+    nblocks = n // 16
+    h1 = h2 = seed & _M64
+    c1 = 0x87C37B91114253D5
+    c2 = 0x4CF5AD432745937F
+    for i in range(nblocks):
+        k1, k2 = struct.unpack_from("<QQ", data, i * 16)
+        k1 = (_rotl64((k1 * c1) & _M64, 31) * c2) & _M64
+        h1 ^= k1
+        h1 = (((_rotl64(h1, 27) + h2) & _M64) * 5 + 0x52DCE729) & _M64
+        k2 = (_rotl64((k2 * c2) & _M64, 33) * c1) & _M64
+        h2 ^= k2
+        h2 = (((_rotl64(h2, 31) + h1) & _M64) * 5 + 0x38495AB5) & _M64
+    tail = data[nblocks * 16:] + b"\x00" * 16
+    t0, t1 = struct.unpack_from("<QQ", tail, 0)
+    flag = n & 15
+    if flag and flag <= 8:
+        t0 &= (0xFFFFFFFFFFFFFFFF >> ((8 - flag) << 3))
+    elif flag > 8:
+        t1 &= (0x00FFFFFFFFFFFFFF >> ((15 - flag) << 3))
+        nk2 = (_rotl64((t1 * c2) & _M64, 33) * c1) & _M64
+        h2 ^= nk2
+    if flag:
+        nk1 = (_rotl64((t0 * c1) & _M64, 31) * c2) & _M64
+        h1 ^= nk1
+    h1 ^= n
+    h2 ^= n
+    h1 = (h1 + h2) & _M64
+    h2 = (h2 + h1) & _M64
+    h1 = _fmix64(h1)
+    h2 = _fmix64(h2)
+    h1 = (h1 + h2) & _M64
+    h2 = (h2 + h1) & _M64
+    return h1, h2
+
+
+def bloom_create(m_bits: int, k: int = 3) -> np.ndarray:
+    """An empty reference-layout bloom blob as a float32 array (the op's
+    storage dtype). Layout: 4 uint64 header + bit vector."""
+    nbytes = 32 + (m_bits + 7) // 8
+    nbytes = (nbytes + 3) // 4 * 4
+    buf = bytearray(nbytes)
+    struct.pack_into("<QQQQ", buf, 0, _MAGIC, m_bits, k, 0)
+    return np.frombuffer(bytes(buf), np.float32).copy()
+
+
+def bloom_add(blob: np.ndarray, key: bytes) -> None:
+    buf = bytearray(blob.tobytes())
+    _, m, k, _ = struct.unpack_from("<QQQQ", buf, 0)
+    for i in range(k):
+        h1, h2 = murmur3_x64_128(key, i)
+        for pos in (h1 % m, h2 % m):
+            buf[32 + (pos >> 3)] |= 0x1 << (0x7 - (pos & 0x7))
+    blob[:] = np.frombuffer(bytes(buf), np.float32)
+
+
+def _bloom_get(buf: bytes, key: bytes) -> bool:
+    magic, m, k, _ = struct.unpack_from("<QQQQ", buf, 0)
+    if magic != _MAGIC:
+        raise ValueError("bloom filter blob: bad magic")
+    for i in range(k):
+        h1, h2 = murmur3_x64_128(key, i)
+        for pos in (h1 % m, h2 % m):
+            if not (buf[32 + (pos >> 3)] & (0x1 << (0x7 - (pos & 0x7)))):
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the op
+# ---------------------------------------------------------------------------
+
+
+@register_host_op("pyramid_hash")
+def pyramid_hash(scope, op, exe):
+    import jax.numpy as jnp
+
+    x = np.asarray(scope.find_var(op.input("X")[0]))
+    w = np.asarray(scope.find_var(op.input("W")[0]))
+    white = (np.asarray(scope.find_var(op.input("WhiteList")[0]))
+             if op.input("WhiteList") else None)
+    black = (np.asarray(scope.find_var(op.input("BlackList")[0]))
+             if op.input("BlackList") else None)
+    num_emb = int(op.attr("num_emb"))
+    rand_len = int(op.attr("rand_len"))
+    space_len = int(op.attr("space_len"))
+    layers = int(op.attr("pyramid_layer", 2))
+    use_filter = bool(op.attr("use_filter", True))
+    white_len = int(op.attr("white_list_len", 0))
+    black_len = int(op.attr("black_list_len", 0))
+    is_training = int(op.attr("is_training", 0))
+    drop_p = float(op.attr("drop_out_percent", 0.0))
+    seed = int(op.attr("seed", 0))
+    rng = np.random.RandomState(seed or 1)
+
+    if x.ndim == 1:
+        x = x[None, :]
+    B, T = x.shape
+    if op.input("Length"):
+        lens = np.asarray(scope.find_var(op.input("Length")[0])) \
+            .reshape(-1).astype(int)
+    else:
+        lens = np.full((B,), T, int)
+    wbuf = white.tobytes() if (use_filter and white_len and
+                               white is not None) else None
+    bbuf = black.tobytes() if (use_filter and black_len and
+                               black is not None) else None
+
+    xf = x.astype(np.float32)
+    max_w = max(1, sum(max(0, T - il) for il in range(1, layers)))
+    out = np.zeros((B, max_w, num_emb), w.dtype)
+    counts = np.zeros((B,), np.int64)
+    for b in range(B):
+        wlen = int(lens[b])
+        if wlen < 2:
+            continue
+        k = 0
+        for ilayer in range(1, min(layers, wlen)):
+            for l in range(wlen - ilayer):
+                term = xf[b, l:l + ilayer + 1].tobytes()
+                keep = True
+                if wbuf is not None:
+                    keep = _bloom_get(wbuf, term)
+                if keep and bbuf is not None:
+                    keep = not _bloom_get(bbuf, term)
+                if keep and is_training and drop_p > 0:
+                    keep = rng.rand() >= drop_p
+                if not keep:
+                    continue
+                row = np.empty(num_emb, w.dtype)
+                pos1 = xxh32(term, 0) % space_len
+                pos2 = xxh32(term, rand_len) % space_len
+                for j in range(0, num_emb, rand_len):
+                    pos3 = xxh32(term, j + 2 * rand_len) % space_len
+                    row[j:j + rand_len] = w[pos1:pos1 + rand_len, 0] \
+                        if w.ndim == 2 and w.shape[1] == 1 \
+                        else w.reshape(-1)[pos1:pos1 + rand_len]
+                    pos1, pos2 = pos2, pos3
+                out[b, k] = row
+                k += 1
+        counts[b] = k
+    scope.set_var(op.output("Out")[0], jnp.asarray(out))
+    if op.output("DropPos"):
+        scope.set_var(op.output("DropPos")[0],
+                      jnp.asarray(counts.reshape(-1, 1)))
+    if op.output("X_Temp_Out"):
+        scope.set_var(op.output("X_Temp_Out")[0], jnp.asarray(xf))

@@ -1,0 +1,71 @@
+"""pyramid_hash: XXH32 vectors, bloom filter roundtrip, n-gram embedding."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.pyramid_hash import (bloom_add, bloom_create, xxh32,
+                                         _bloom_get)
+
+
+def test_xxh32_official_vectors():
+    assert xxh32(b"") == 0x02CC5D05
+    assert xxh32(b"Hello World") == 0xB1FD16EE
+
+
+def test_bloom_filter_membership():
+    blob = bloom_create(1 << 12, k=3)
+    keys = [np.asarray([1.0, 2.0], np.float32).tobytes(),
+            np.asarray([3.0, 4.0], np.float32).tobytes()]
+    for k in keys:
+        bloom_add(blob, k)
+    buf = blob.tobytes()
+    assert all(_bloom_get(buf, k) for k in keys)
+    absent = np.asarray([9.0, 9.0], np.float32).tobytes()
+    assert not _bloom_get(buf, absent)
+
+
+def _run(inputs, attrs):
+    from op_harness import run_single_op
+
+    return run_single_op("pyramid_hash", inputs,
+                         ["Out", "DropPos", "X_Temp_Out"], attrs)
+
+
+def test_pyramid_hash_windows_and_determinism():
+    num_emb, rand_len, space = 8, 4, 64
+    w = np.random.RandomState(0).randn(space + rand_len, 1).astype(
+        "float32")
+    x = np.array([[5, 7, 9, 2]], "int32")
+    out = _run({"X": x, "W": w},
+               {"num_emb": num_emb, "rand_len": rand_len,
+                "space_len": space, "pyramid_layer": 3,
+                "use_filter": False, "white_list_len": 0,
+                "black_list_len": 0, "is_training": 0,
+                "drop_out_percent": 0.0, "seed": 1})
+    # windows: len-2 x3 + len-3 x2 = 5
+    assert int(np.ravel(out["DropPos"])[0]) == 5
+    emb = out["Out"][0]
+    assert not np.allclose(emb[:5], 0)
+    # deterministic
+    out2 = _run({"X": x, "W": w},
+                {"num_emb": num_emb, "rand_len": rand_len,
+                 "space_len": space, "pyramid_layer": 3,
+                 "use_filter": False, "white_list_len": 0,
+                 "black_list_len": 0, "is_training": 0,
+                 "drop_out_percent": 0.0, "seed": 1})
+    np.testing.assert_array_equal(out["Out"], out2["Out"])
+
+
+def test_pyramid_hash_white_list_filters():
+    num_emb, rand_len, space = 4, 2, 32
+    w = np.ones((space + rand_len, 1), "float32")
+    x = np.array([[1, 2, 3]], "int32")
+    # whitelist ONLY the bigram (1,2)
+    blob = bloom_create(1 << 10, k=3)
+    bloom_add(blob, np.asarray([1.0, 2.0], np.float32).tobytes())
+    out = _run({"X": x, "W": w, "WhiteList": blob},
+               {"num_emb": num_emb, "rand_len": rand_len,
+                "space_len": space, "pyramid_layer": 3,
+                "use_filter": True, "white_list_len": 1,
+                "black_list_len": 0, "is_training": 0,
+                "drop_out_percent": 0.0, "seed": 1})
+    assert int(np.ravel(out["DropPos"])[0]) == 1  # only (1,2) survives
