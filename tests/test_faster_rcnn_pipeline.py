@@ -1,0 +1,121 @@
+"""Two-stage detector composition test: the full Faster R-CNN training
+path — backbone -> RPN (rpn_target_assign losses + generate_proposals) ->
+generate_proposal_labels -> roi_align -> box head (cls + reg losses) —
+composes into ONE trainable program (every stage static-shape)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_faster_rcnn_mini_trains():
+    H = W = 32
+    A = 3            # anchors per cell
+    C = 3            # classes (bg + 2)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        im = fluid.layers.data("im", [3, H, W], dtype="float32")
+        gt_box = fluid.layers.data("gt_box", [2, 4], dtype="float32")
+        gt_cls = fluid.layers.data("gt_cls", [2], dtype="int32")
+        im_info = fluid.layers.data("im_info", [3], dtype="float32")
+
+        feat = fluid.layers.conv2d(im, 16, 3, stride=4, padding=1,
+                                   act="relu", name="bb1")       # 8x8
+        anchors, a_var = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[8.0, 16.0, 24.0], aspect_ratios=[1.0],
+            stride=[4.0, 4.0])
+        fh, fw = feat.shape[2], feat.shape[3]
+        n_anchor = fh * fw * A
+
+        rpn_cls = fluid.layers.conv2d(feat, A, 1, name="rpn_cls")
+        rpn_reg = fluid.layers.conv2d(feat, 4 * A, 1, name="rpn_reg")
+
+        # --- RPN losses over static target assignment ---
+        anchors_flat = fluid.layers.reshape(anchors, [-1, 4])
+        cls_flat = fluid.layers.reshape(
+            fluid.layers.transpose(rpn_cls, perm=[0, 2, 3, 1]),
+            [0, n_anchor, 1])
+        reg_flat = fluid.layers.reshape(
+            fluid.layers.transpose(rpn_reg, perm=[0, 2, 3, 1]),
+            [0, n_anchor, 4])
+        ps, pl, lbl, tb, wt = fluid.layers.rpn_target_assign(
+            reg_flat, cls_flat, anchors_flat, a_var, gt_box, None, im_info,
+            rpn_batch_size_per_im=32, rpn_fg_fraction=0.5,
+            rpn_positive_overlap=0.5, rpn_negative_overlap=0.3,
+            use_random=False)
+        valid = fluid.layers.cast(
+            fluid.layers.greater_equal(
+                fluid.layers.cast(lbl, "float32"),
+                fluid.layers.fill_constant([1], "float32", 0.0)),
+            "float32")
+        lbl_f = fluid.layers.cast(lbl, "float32")
+        rpn_cls_loss = fluid.layers.reduce_sum(
+            fluid.layers.sigmoid_cross_entropy_with_logits(
+                fluid.layers.reshape(ps, [0, -1]), lbl_f) * valid) \
+            / (fluid.layers.reduce_sum(valid) + 1.0)
+        rpn_reg_loss = fluid.layers.reduce_sum(
+            fluid.layers.abs(pl - tb) * wt) \
+            / (fluid.layers.reduce_sum(wt) + 1.0)
+
+        # --- proposals + stage-2 sampling ---
+        probs = fluid.layers.sigmoid(rpn_cls)
+        rois, roi_probs, rois_num = fluid.layers.generate_proposals(
+            probs, rpn_reg, im_info, anchors, a_var, pre_nms_top_n=64,
+            post_nms_top_n=16, nms_thresh=0.7, min_size=2.0,
+            return_rois_num=True)
+        s_rois, s_lbl, s_tgt, s_iw, s_ow = \
+            fluid.layers.generate_proposal_labels(
+                rois, gt_cls, None, gt_box, im_info,
+                batch_size_per_im=16, fg_fraction=0.5, fg_thresh=0.5,
+                bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=C,
+                use_random=False)
+
+        # --- box head over roi_align (batch dim folded: B=1 here) ---
+        rois_flat = fluid.layers.reshape(s_rois, [-1, 4])
+        pooled = fluid.layers.roi_align(feat, rois_flat, pooled_height=3,
+                                        pooled_width=3,
+                                        spatial_scale=0.25)
+        head = fluid.layers.fc(fluid.layers.reshape(
+            pooled, [-1, 16 * 9]), 32, act="relu", name="head")
+        cls_logits = fluid.layers.fc(head, C, name="cls_head")
+        reg_out = fluid.layers.fc(head, 4, name="reg_head")
+
+        lbl_flat = fluid.layers.reshape(s_lbl, [-1, 1])
+        valid2 = fluid.layers.cast(
+            fluid.layers.greater_equal(
+                fluid.layers.cast(lbl_flat, "float32"),
+                fluid.layers.fill_constant([1], "float32", 0.0)),
+            "float32")
+        cls_ce = fluid.layers.softmax_with_cross_entropy(
+            cls_logits, fluid.layers.cast(
+                fluid.layers.elementwise_max(
+                    lbl_flat, fluid.layers.fill_constant(
+                        [1], lbl_flat.dtype, 0)), "int64"))
+        cls_loss = fluid.layers.reduce_sum(cls_ce * valid2) \
+            / (fluid.layers.reduce_sum(valid2) + 1.0)
+        tgt_flat = fluid.layers.reshape(s_tgt, [-1, 4])
+        iw_flat = fluid.layers.reshape(s_iw, [-1, 4])
+        reg_loss = fluid.layers.reduce_sum(
+            fluid.layers.abs(reg_out - tgt_flat) * iw_flat) \
+            / (fluid.layers.reduce_sum(iw_flat) + 1.0)
+
+        loss = rpn_cls_loss + rpn_reg_loss + cls_loss + reg_loss
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {
+        "im": rng.randn(1, 3, H, W).astype("float32"),
+        "gt_box": np.array([[[4, 4, 14, 14], [18, 18, 30, 30]]],
+                           "float32"),
+        "gt_cls": np.array([[1, 2]], "int32"),
+        "im_info": np.array([[H, W, 1.0]], "float32"),
+    }
+    losses = []
+    for _ in range(10):
+        (l,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
