@@ -119,3 +119,56 @@ def test_faster_rcnn_mini_trains():
         losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_mask_head_with_host_op_labels():
+    """Mask-target generation (HOST op) interleaves with device segments
+    in one program: labels -> mask head BCE on the rasterized targets."""
+    res = 8
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", [8, 16, 16], dtype="float32")
+        rois = fluid.layers.data("rois", [4, 4], dtype="float32",
+                                 append_batch_size=False)
+        rois3 = fluid.layers.reshape(rois, [1, 4, 4])
+        labels = fluid.layers.data("labels", [1, 4], dtype="int32",
+                                   append_batch_size=False)
+        segms = fluid.layers.data("segms", [1, 4, 6, 2], dtype="float32",
+                                  append_batch_size=False)
+        mask_rois, has_mask, mask_int32 = fluid.layers.generate_mask_labels(
+            None, None, None, segms, rois3, labels, num_classes=1,
+            resolution=res)
+        pooled = fluid.layers.roi_align(feat, mask_rois, pooled_height=res,
+                                        pooled_width=res,
+                                        spatial_scale=0.5)
+        # roi_align's out var has no inferred static shape; pin it for conv
+        pooled = fluid.layers.reshape(pooled, [-1, 8, res, res])
+        mask_logits = fluid.layers.conv2d(pooled, 1, 1, name="mask_head")
+        tgt = fluid.layers.cast(
+            fluid.layers.reshape(mask_int32, [-1, 1, res, res]), "float32")
+        wt = fluid.layers.cast(
+            fluid.layers.reshape(has_mask, [-1, 1, 1, 1]), "float32")
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.sigmoid_cross_entropy_with_logits(
+                mask_logits, tgt) * wt) / (fluid.layers.reduce_sum(wt)
+                                           * res * res + 1.0)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    segs = np.full((1, 4, 6, 2), np.nan, "float32")
+    segs[0, 0, :4] = [[0, 0], [16, 0], [16, 16], [0, 16]]
+    segs[0, 1, :4] = [[16, 16], [32, 16], [32, 32], [16, 32]]
+    feed = {
+        "feat": rng.randn(1, 8, 16, 16).astype("float32"),
+        "rois": np.array([[0, 0, 15, 15], [16, 16, 31, 31],
+                          [0, 0, 8, 8], [20, 20, 30, 30]], "float32"),
+        "labels": np.array([[1, 1, -1, -1]], "int32"),
+        "segms": segs,
+    }
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=scope)[0]) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
