@@ -7,3 +7,5 @@ from .post_training_quantization import (  # noqa: F401
     PostTrainingQuantization,
     WeightQuantization,
 )
+from . import graph_wrapper  # noqa: F401
+from .graph_wrapper import GraphWrapper, OpWrapper, VarWrapper  # noqa: F401

@@ -1,0 +1,162 @@
+"""slim graph API — parity with contrib/slim/graph/graph_wrapper.py
+(VarWrapper:45, OpWrapper:101, GraphWrapper:189): the traversal surface the
+old slim strategies (and user analysis scripts) use to walk a Program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["VarWrapper", "OpWrapper", "GraphWrapper"]
+
+_OPT_OPS = {"sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop",
+            "lamb", "adamax", "adadelta", "ftrl", "lars_momentum",
+            "decayed_adagrad", "dpsgd"}
+
+
+class VarWrapper:
+    def __init__(self, var, graph: "GraphWrapper"):
+        self._var = var
+        self._graph = graph
+
+    def __eq__(self, v):
+        return isinstance(v, VarWrapper) and self._var.name == v._var.name
+
+    def __hash__(self):
+        return hash(self._var.name)
+
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return list(self._var.shape)
+
+    def set_shape(self, shape):
+        self._var.shape = list(shape)
+
+    def inputs(self):
+        """Ops that WRITE this var (graph_wrapper.py:76 semantics)."""
+        return [op for op in self._graph.ops()
+                if self in op.all_outputs()]
+
+    def outputs(self):
+        """Ops that READ this var."""
+        return [op for op in self._graph.ops()
+                if self in op.all_inputs()]
+
+
+class OpWrapper:
+    def __init__(self, op, graph: "GraphWrapper"):
+        self._op = op
+        self._graph = graph
+
+    def __eq__(self, other):
+        return isinstance(other, OpWrapper) and self.idx() == other.idx()
+
+    def __hash__(self):
+        return hash(("op", self.idx()))
+
+    def idx(self):
+        return self._graph._op_index(self._op)
+
+    def type(self):
+        return self._op.type
+
+    def is_bwd_op(self):
+        return self._op.type.endswith("_grad")
+
+    def is_opt_op(self):
+        return self._op.type in _OPT_OPS
+
+    def all_inputs(self):
+        return [self._graph.var(n) for n in self._op.input_arg_names
+                if self._graph.has_var(n)]
+
+    def all_outputs(self):
+        return [self._graph.var(n) for n in self._op.output_arg_names
+                if self._graph.has_var(n)]
+
+    def inputs(self, name):
+        return [self._graph.var(n) for n in self._op.input(name)
+                if self._graph.has_var(n)]
+
+    def outputs(self, name):
+        return [self._graph.var(n) for n in self._op.output(name)
+                if self._graph.has_var(n)]
+
+    def attr(self, name):
+        return self._op.attr(name)
+
+    def set_attr(self, key, value):
+        self._op.attrs[key] = value
+
+
+class GraphWrapper:
+    """graph_wrapper.py:189 — Program traversal with in/out node maps."""
+
+    def __init__(self, program=None, in_nodes=(), out_nodes=()):
+        from ...framework.program import default_main_program
+
+        self.program = program or default_main_program()
+        self.in_nodes = dict(in_nodes) if not isinstance(in_nodes, dict) \
+            else dict(in_nodes)
+        self.out_nodes = dict(out_nodes) if not isinstance(out_nodes, dict) \
+            else dict(out_nodes)
+        self._vars: Dict[str, VarWrapper] = {}
+
+    # ------------------------------------------------------------------
+    def _block(self):
+        return self.program.global_block()
+
+    def _op_index(self, op):
+        for i, o in enumerate(self._block().ops):
+            if o is op:
+                return i
+        return -1
+
+    def has_var(self, name: str) -> bool:
+        return name in self._block().vars
+
+    def var(self, name: str) -> VarWrapper:
+        if name not in self._vars:
+            self._vars[name] = VarWrapper(self._block().var(name), self)
+        return self._vars[name]
+
+    def vars(self) -> List[VarWrapper]:
+        return [self.var(n) for n in self._block().vars]
+
+    def ops(self) -> List[OpWrapper]:
+        return [OpWrapper(op, self) for op in self._block().ops]
+
+    def all_parameters(self) -> List[VarWrapper]:
+        return [self.var(p.name)
+                for p in self._block().all_parameters()]
+
+    def is_parameter(self, var: VarWrapper) -> bool:
+        from ...framework.program import Parameter
+
+        return isinstance(var._var, Parameter)
+
+    def is_persistable(self, var: VarWrapper) -> bool:
+        return bool(getattr(var._var, "persistable", False))
+
+    def numel_params(self) -> int:
+        import numpy as np
+
+        return int(sum(np.prod(p.shape()) for p in self.all_parameters()))
+
+    def pre_ops(self, op: OpWrapper) -> List[OpWrapper]:
+        ins = set(v.name() for v in op.all_inputs())
+        return [o for o in self.ops()
+                if ins & set(v.name() for v in o.all_outputs())]
+
+    def next_ops(self, op: OpWrapper) -> List[OpWrapper]:
+        outs = set(v.name() for v in op.all_outputs())
+        return [o for o in self.ops()
+                if outs & set(v.name() for v in o.all_inputs())]
+
+    def get_param_by_op(self, op: OpWrapper) -> List[VarWrapper]:
+        return [v for v in op.all_inputs() if self.is_parameter(v)]
+
+    def clone(self, for_test: bool = False) -> "GraphWrapper":
+        return GraphWrapper(self.program.clone(for_test=for_test),
+                            dict(self.in_nodes), dict(self.out_nodes))

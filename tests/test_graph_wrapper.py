@@ -1,0 +1,42 @@
+"""slim GraphWrapper traversal surface."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim import GraphWrapper
+
+
+def test_graph_traversal():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu", name="g1")
+        p = fluid.layers.fc(h, 1, name="g2")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    g = GraphWrapper(main, in_nodes={"x": "x"}, out_nodes={"loss": loss.name})
+    params = g.all_parameters()
+    assert {pv.name() for pv in params} == \
+        {"g1.w_0", "g1.b_0", "g2.w_0", "g2.b_0"}
+    assert g.numel_params() == 4 * 8 + 8 + 8 + 1
+    # fwd/bwd/opt classification
+    kinds = {"fwd": 0, "bwd": 0, "opt": 0}
+    for op in g.ops():
+        if op.is_opt_op():
+            kinds["opt"] += 1
+        elif op.is_bwd_op():
+            kinds["bwd"] += 1
+        else:
+            kinds["fwd"] += 1
+    assert kinds["opt"] == 4 and kinds["bwd"] > 0 and kinds["fwd"] > 0
+    # var <-> op wiring: g1.w_0 feeds exactly the mul op(s)
+    w = g.var("g1.w_0")
+    readers = w.outputs()
+    assert any(o.type() in ("mul", "matmul") for o in readers)
+    mul_op = next(o for o in readers if o.type() in ("mul", "matmul"))
+    assert w in mul_op.all_inputs()
+    assert g.get_param_by_op(mul_op) == [w]
+    nxt = g.next_ops(mul_op)
+    assert nxt and all(mul_op.idx() != o.idx() for o in nxt)
+    g2 = g.clone()
+    assert g2.numel_params() == g.numel_params()
