@@ -13,6 +13,7 @@ from . import resnet  # noqa: F401
 from . import mobilenet  # noqa: F401
 from . import ernie  # noqa: F401
 from . import se_resnext  # noqa: F401
+from . import transformer_encoder  # noqa: F401
 from .se_resnext import SE_ResNeXt, se_resnext50, se_resnext101, se_resnext152  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .mobilenet import MobileNet, mobilenet_v1, mobilenet_v2  # noqa: F401
