@@ -21,7 +21,9 @@ import sys
 import time
 
 TOTAL_BUDGET_S = 390       # stay under the driver's ~7 min ceiling
-PROBE_TIMEOUT_S = 120      # device init should be fast; compile comes later
+PROBE_TIMEOUT_S = 90       # device init should be fast; compile comes later
+PROBE_ATTEMPTS = 2         # r03 forfeited the round on ONE timed-out probe;
+                           # a wedged relay claim often clears on the retry
 CPU_RESERVE_S = 80         # always keep room for the CPU fallback run
 
 
@@ -66,21 +68,29 @@ def _run_timed(cmd, env, timeout_s):
         return None, out or ""
 
 
-def _probe():
-    """Initialize the backend in a subprocess; return platform or None."""
+def _probe(attempts=PROBE_ATTEMPTS):
+    """Initialize the backend in a subprocess; return platform or None.
+
+    Retries: a single timed-out probe must not forfeit the round's hardware
+    number (BENCH_r03 lesson) — the axon relay claim left by a dead process
+    typically expires within the first probe's window, so a second attempt
+    succeeds where the first hung.
+    """
     code = ("import jax; d = jax.devices()[0]; "
             "print('PLATFORM=%s KIND=%s' % (d.platform, d.device_kind))")
-    rc, out = _run_timed([sys.executable, "-c", code], dict(os.environ),
-                         PROBE_TIMEOUT_S)
-    if rc is None:
-        _log(f"probe timed out after {PROBE_TIMEOUT_S}s")
-        return None
-    if rc != 0:
-        _log(f"probe failed rc={rc}")
-        return None
-    for tok in out.split():
-        if tok.startswith("PLATFORM="):
-            return tok.split("=", 1)[1]
+    for attempt in range(1, attempts + 1):
+        rc, out = _run_timed([sys.executable, "-c", code], dict(os.environ),
+                             PROBE_TIMEOUT_S)
+        if rc is None:
+            _log(f"probe attempt {attempt}/{attempts} timed out "
+                 f"after {PROBE_TIMEOUT_S}s")
+            continue
+        if rc != 0:
+            _log(f"probe attempt {attempt}/{attempts} failed rc={rc}")
+            continue
+        for tok in out.split():
+            if tok.startswith("PLATFORM="):
+                return tok.split("=", 1)[1]
     return None
 
 

@@ -1,6 +1,11 @@
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
 from . import layers_extra  # noqa: F401
+from . import layers  # noqa: F401
+from .layers import (  # noqa: F401
+    match_matrix_tensor,
+    sequence_topk_avg_pooling,
+)
 from .layers_extra import (  # noqa: F401
     BasicGRUUnit,
     BasicLSTMUnit,

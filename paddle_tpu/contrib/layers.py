@@ -1,0 +1,342 @@
+"""fluid.contrib.layers — parity with
+python/paddle/fluid/contrib/layers/nn.py (__all__ at :33) plus the
+rnn_impl re-exports. Each function builds the same-named op; padded
+[B,T,...]+length tensors stand in for LoD inputs (ops/sequence.py:6).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.core import convert_dtype, VarType
+from .layers_extra import (  # noqa: F401
+    BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,
+)
+
+__all__ = [
+    "fused_elemwise_activation", "sequence_topk_avg_pooling", "var_conv_2d",
+    "match_matrix_tensor", "tree_conv", "fused_embedding_seq_pool",
+    "multiclass_nms2", "search_pyramid_hash", "shuffle_batch",
+    "partial_concat", "partial_sum", "tdm_child", "rank_attention",
+    "tdm_sampler", "batch_fc",
+    # rnn_impl re-exports
+    "BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm",
+]
+
+
+def _dtype_enum(dtype) -> int:
+    from ..framework.core import _DTYPE_TO_VARTYPE
+
+    return int(_DTYPE_TO_VARTYPE[convert_dtype(dtype)])
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """contrib/layers/nn.py:43 — compose a binary elementwise op with unary
+    activations in one op (the reference fuses the kernels; XLA does the
+    same fusion here, the op exists for program parity)."""
+    helper = LayerHelper("fused_elemwise_activation", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    intermediate = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="fused_elemwise_activation",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out], "IntermediateOut": [intermediate]},
+        attrs={"functor_list": list(functor_list), "axis": axis,
+               "scale": scale,
+               "save_intermediate_out": bool(save_intermediate_out)})
+    return out
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None,
+                        x_len=None, y_len=None):
+    """contrib/layers/nn.py:223 — bilinear match matrix between two padded
+    sequence batches; x [B,Tl,D], y [B,Tr,D] (+ optional lengths)."""
+    helper = LayerHelper("match_matrix_tensor", **locals())
+    d = int(x.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[d, channel_num * d],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    ins = {"X": [x], "Y": [y], "W": [w]}
+    if x_len is not None:
+        ins["XLen"] = [x_len]
+    if y_len is not None:
+        ins["YLen"] = [y_len]
+    helper.append_op(type="match_matrix_tensor", inputs=ins,
+                     outputs={"Out": [out], "Tmp": [tmp]},
+                     attrs={"dim_t": int(channel_num)})
+    return helper.append_activation(out), tmp
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """contrib/layers/nn.py:310 — top-k column averages per (channel, row);
+    input [B,C,R,Cw], row/col are [B] valid lengths."""
+    helper = LayerHelper("sequence_topk_avg_pooling", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pos = helper.create_variable_for_type_inference("int32",
+                                                    stop_gradient=True)
+    helper.append_op(type="sequence_topk_avg_pooling",
+                     inputs={"X": [input], "ROW": [row], "COLUMN": [col]},
+                     outputs={"Out": [out], "pos": [pos]},
+                     attrs={"topks": [int(k) for k in topks],
+                            "channel_num": int(channel_num)})
+    return out
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    """contrib/layers/nn.py:119 — conv over per-sequence variable-size
+    images; input [B,C,Hmax,Wmax] with row/col [B] valid extents."""
+    helper = LayerHelper("var_conv_2d", **locals())
+    fh, fw = (filter_size if isinstance(filter_size, (list, tuple))
+              else (filter_size, filter_size))
+    sh, sw = (stride if isinstance(stride, (list, tuple))
+              else (stride, stride))
+    w = helper.create_parameter(
+        param_attr, shape=[int(output_channel),
+                           int(input_channel) * fh * fw], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    col_out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="var_conv_2d",
+        inputs={"X": [input], "ROW": [row], "COLUMN": [col], "W": [w]},
+        outputs={"Out": [out], "Col": [col_out]},
+        attrs={"InputChannel": int(input_channel),
+               "OutputChannel": int(output_channel),
+               "KernelH": fh, "KernelW": fw, "StrideH": sh, "StrideW": sw})
+    return helper.append_activation(out)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """contrib/layers/nn.py:378 — tree-based convolution over parent-child
+    edge sets (host op: graph traversal is inherently dynamic)."""
+    helper = LayerHelper("tree_conv", **locals())
+    dtype = nodes_vector.dtype
+    feature_size = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        param_attr,
+        shape=[feature_size, 3, int(output_size), int(num_filters)],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"output_size": int(output_size), "max_depth": int(max_depth)})
+    if bias_attr:
+        out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
+                             combiner="sum", param_attr=None,
+                             dtype="float32"):
+    """contrib/layers/nn.py:448 — embedding lookup + sequence sum-pool in
+    one op; input [B,T] int ids (padding_idx rows contribute zero)."""
+    helper = LayerHelper("fused_embedding_seq_pool", **locals())
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fused_embedding_seq_pool",
+        inputs={"Ids": [input], "W": [w]},
+        outputs={"Out": [out]},
+        attrs={"combiner": combiner, "is_sparse": bool(is_sparse),
+               "padding_idx": (-1 if padding_idx is None
+                               else int(padding_idx))})
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """contrib/layers/nn.py:515 — NMS that also returns kept indices."""
+    helper = LayerHelper("multiclass_nms2", **locals())
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    index = helper.create_variable_for_type_inference("int32",
+                                                      stop_gradient=True)
+    helper.append_op(
+        type="multiclass_nms2",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "Index": [index]},
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+               "nms_threshold": float(nms_threshold),
+               "normalized": bool(normalized), "nms_eta": float(nms_eta),
+               "background_label": int(background_label)})
+    if return_index:
+        return out, index
+    return out
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed,
+                        lr, param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32"):
+    """contrib/layers/nn.py:645 — pyramid hash embedding (host op)."""
+    helper = LayerHelper("search_pyramid_hash", **locals())
+    w = helper.create_parameter(param_attr, shape=[space_len + rand_len, 1],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    drop_pos = helper.create_variable_for_type_inference(dtype,
+                                                         stop_gradient=True)
+    x_temp = helper.create_variable_for_type_inference(dtype,
+                                                       stop_gradient=True)
+    helper.append_op(
+        type="pyramid_hash",
+        inputs={"X": [input], "W": [w]},
+        outputs={"Out": [out], "DropPos": [drop_pos], "X_Temp_Out": [x_temp]},
+        attrs={"num_emb": int(num_emb), "space_len": int(space_len),
+               "pyramid_layer": int(pyramid_layer),
+               "rand_len": int(rand_len),
+               "drop_out_percent": float(drop_out_percent),
+               "is_training": int(is_training),
+               "use_filter": bool(use_filter),
+               "white_list_len": int(white_list_len),
+               "black_list_len": int(black_list_len),
+               "seed": int(seed), "lr": float(lr)})
+    return out
+
+
+def shuffle_batch(x, seed=None):
+    """contrib/layers/nn.py:761 — random permutation of the batch axis."""
+    helper = LayerHelper("shuffle_batch", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    shuffle_idx = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    seed_out = helper.create_variable_for_type_inference(
+        "int64", stop_gradient=True)
+    helper.append_op(type="shuffle_batch",
+                     inputs={"X": [x]},
+                     outputs={"Out": [out], "ShuffleIdx": [shuffle_idx],
+                              "SeedOut": [seed_out]},
+                     attrs={"startup_seed": int(seed or 0)})
+    return out
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """contrib/layers/nn.py:825 — concat a column slice of each input."""
+    helper = LayerHelper("partial_concat", **locals())
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="partial_concat",
+                     inputs={"X": list(inputs)}, outputs={"Out": [out]},
+                     attrs={"start_index": int(start_index),
+                            "length": int(length)})
+    return out
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """contrib/layers/nn.py:888 — sum a column slice across inputs."""
+    helper = LayerHelper("partial_sum", **locals())
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="partial_sum",
+                     inputs={"X": list(inputs)}, outputs={"Out": [out]},
+                     attrs={"start_index": int(start_index),
+                            "length": int(length)})
+    return out
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """contrib/layers/nn.py:942 — children lookup in the TDM tree-info
+    table (a [node_nums, 3+child_nums] int parameter)."""
+    helper = LayerHelper("tdm_child", **locals())
+    tree_info = helper.create_parameter(
+        param_attr, shape=[int(node_nums), 3 + int(child_nums)],
+        dtype="int32")
+    tree_info.stop_gradient = True
+    child = helper.create_variable_for_type_inference(dtype,
+                                                      stop_gradient=True)
+    mask = helper.create_variable_for_type_inference(dtype,
+                                                     stop_gradient=True)
+    helper.append_op(type="tdm_child",
+                     inputs={"X": [x], "TreeInfo": [tree_info]},
+                     outputs={"Child": [child], "LeafMask": [mask]},
+                     attrs={"child_nums": int(child_nums),
+                            "dtype": _dtype_enum(dtype)})
+    return child, mask
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int32", dtype="int32"):
+    """contrib/layers/nn.py:1027 — layer-wise negative sampling along each
+    item's tree path. Travel [leaf_node_num, n_layers] and Layer
+    [sum(layer_node_num_list)] are int parameters."""
+    helper = LayerHelper("tdm_sampler", **locals())
+    layer_nums = len(neg_samples_num_list)
+    offsets = [0]
+    for n in layer_node_num_list:
+        offsets.append(offsets[-1] + int(n))
+    travel = helper.create_parameter(
+        tree_travel_attr, shape=[int(leaf_node_num), layer_nums],
+        dtype=tree_dtype)
+    layer = helper.create_parameter(
+        tree_layer_attr, shape=[offsets[-1], 1], dtype=tree_dtype)
+    travel.stop_gradient = True
+    layer.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    stop_gradient=True)
+    labels = helper.create_variable_for_type_inference(dtype,
+                                                       stop_gradient=True)
+    mask = helper.create_variable_for_type_inference(dtype,
+                                                     stop_gradient=True)
+    helper.append_op(
+        type="tdm_sampler",
+        inputs={"X": [x], "Travel": [travel], "Layer": [layer]},
+        outputs={"Out": [out], "Labels": [labels], "Mask": [mask]},
+        attrs={"neg_samples_num_list": [int(v) for v in
+                                        neg_samples_num_list],
+               "output_positive": bool(output_positive),
+               "layer_offset_lod": offsets, "seed": int(seed),
+               "dtype": _dtype_enum(dtype)})
+    if not output_list:
+        return out, labels, mask
+    # split into per-layer pieces like the reference's output_list mode
+    from .. import layers as L
+
+    sizes = [int(n) + int(output_positive) for n in neg_samples_num_list]
+    return (L.split(out, sizes, dim=1), L.split(labels, sizes, dim=1),
+            L.split(mask, sizes, dim=1))
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, max_size=0):
+    """contrib/layers/nn.py:1236 — per-rank attention for CTR ranking."""
+    helper = LayerHelper("rank_attention", **locals())
+    rank_param = helper.create_parameter(rank_param_attr,
+                                         shape=rank_param_shape,
+                                         dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    input_help = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    helper.append_op(
+        type="rank_attention",
+        inputs={"X": [input], "RankOffset": [rank_offset],
+                "RankParam": [rank_param]},
+        outputs={"Out": [out], "InputHelp": [input_help]},
+        attrs={"MaxRank": int(max_rank), "MaxSize": int(max_size)})
+    return out
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr, act=None):
+    """contrib/layers/nn.py:1304 — batched per-slot fc."""
+    helper = LayerHelper("batch_fc", **locals())
+    w = helper.create_parameter(param_attr, shape=param_size,
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=bias_size,
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="batch_fc",
+                     inputs={"Input": [input], "W": [w], "Bias": [b]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)

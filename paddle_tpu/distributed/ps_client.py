@@ -62,13 +62,42 @@ class PSClient:
                     raise
                 time.sleep(0.1)
 
-    def _rpc(self, endpoint: str, msg: dict) -> dict:
-        sock = self._conn(endpoint)
-        with self._lock:
-            send_msg(sock, msg)
-            reply = recv_msg(sock)
-        if reply is None:
-            raise ConnectionError(f"pserver {endpoint} closed connection")
+    # cmds safe to resend after a transport error: reads with no
+    # server-side state change. push/push_delta/barrier must NOT be
+    # auto-resent — the server may have applied the request and only the
+    # reply was lost (double-applied grads / double-counted barriers).
+    _IDEMPOTENT = frozenset({"pull", "pull_sparse"})
+
+    def _rpc(self, endpoint: str, msg: dict, _retries: int = 3) -> dict:
+        """One request/response, with reconnect-and-backoff on transport
+        errors (grpc_client.cc channel reconnection parity) for
+        idempotent commands; non-idempotent commands fail fast after
+        cleaning up the dead connection."""
+        if msg.get("cmd") not in self._IDEMPOTENT:
+            _retries = 0
+        delay = 0.2
+        for attempt in range(_retries + 1):
+            try:
+                sock = self._conn(endpoint)
+                with self._lock:
+                    send_msg(sock, msg)
+                    reply = recv_msg(sock)
+                if reply is None:
+                    raise ConnectionError(
+                        f"pserver {endpoint} closed connection")
+                break
+            except (ConnectionError, OSError):
+                with self._lock:
+                    s = self._conns.pop(endpoint, None)
+                    if s is not None:
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                if attempt == _retries:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
         if reply.get("status") == "error":
             raise RuntimeError(f"pserver {endpoint}: {reply['error']}")
         return reply

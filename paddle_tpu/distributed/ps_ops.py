@@ -165,3 +165,52 @@ def checkpoint_notify_op(scope, op, exe):
     client = PSClient.instance(tid)
     for ep in eps:
         client.checkpoint_notify(ep, dirname)
+
+
+@register_host_op("prefetch")
+def prefetch_op(scope, op, exe):
+    """distributed_ops/prefetch_op.cc — block-fetch remote sparse rows for
+    the given ids (same wire path as distributed_lookup_table; the
+    reference splits ids across servers, here the table client does)."""
+    eps = op.attr("epmap")
+    table = op.attr("table_names")
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    tables = table if isinstance(table, (list, tuple)) else [table]
+    in_names = op.input("X")
+    out_names = op.output("Out")
+    for i, (inn, outn) in enumerate(zip(in_names, out_names)):
+        ids = _scope_np(scope, inn).reshape(-1).astype(np.uint64)
+        rows = client.pull_sparse(eps[0], tables[min(i, len(tables) - 1)],
+                                  ids)
+        _set_scope(scope, outn, rows)
+
+
+@register_host_op("push_dense")
+def push_dense_op(scope, op, exe):
+    """distributed_ops/push_dense_op.cc (fleet a-sync dense push): send
+    dense grads to the pserver (send-op path with averaged scale)."""
+    eps = op.attr("epmap", ["127.0.0.1:0"])
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    for name in op.input("Ids") or op.input("X"):
+        val = _scope_np(scope, name)
+        client.push(eps[0], name, val)
+
+
+@register_host_op("lookup_sparse_table")
+def lookup_sparse_table_op(scope, op, exe):
+    """distributed_ops/lookup_sparse_table_op.cc — server-side sparse
+    table lookup with auto-grown rows (init with uniform random when the
+    id is new). Local form: W is the dense table var in scope."""
+    w_name = op.input("W")[0]
+    ids = _scope_np(scope, op.input("Ids")[0]).reshape(-1).astype(np.int64)
+    w = _scope_np(scope, w_name)
+    init_value = float(op.attr("init_value", 0.0))
+    max_id = int(ids.max()) + 1 if ids.size else 0
+    if max_id > w.shape[0]:  # auto-grow like the reference's sparse table
+        grown = np.full((max_id, w.shape[1]), init_value, w.dtype)
+        grown[: w.shape[0]] = w
+        w = grown
+        _set_scope(scope, w_name, w)
+    _set_scope(scope, op.output("Out")[0], w[ids])

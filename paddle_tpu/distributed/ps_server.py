@@ -2,10 +2,15 @@
 listen_and_serv runtime (operators/distributed_ops/listen_and_serv_op.cc +
 operators/distributed/request_handler_impl.cc).
 
-Transport: length-prefixed pickle over TCP sockets (one thread per
-connection, like the reference's gRPC thread pool).  The arithmetic hot path
-— optimizer updates on dense params and sparse embedding rows — is native
-C++ (native/ps_table.cpp) behind the Table classes.
+Transport: a framed binary protocol over TCP sockets (one thread per
+connection, like the reference's gRPC thread pool), mirroring the
+reference's VariableMessage shape (send_recv.proto.in:19-34): a JSON
+header for scalar fields + raw dtype/shape-prefixed tensor buffers.  No
+pickle touches network bytes — a hostile peer can at worst inject data,
+not code — and ndarray payloads move as single memoryview writes instead
+of whole-object pickling.  The arithmetic hot path — optimizer updates on
+dense params and sparse embedding rows — is native C++
+(native/ps_table.cpp) behind the Table classes.
 
 Sync semantics (reference `Communicator` Sync / request_handler barriers):
 pushes to a param accumulate until `trainer_num` arrived, then the averaged
@@ -15,7 +20,7 @@ GEO: trainers push param deltas which are added raw.
 """
 from __future__ import annotations
 
-import pickle
+import json
 import socket
 import struct
 import threading
@@ -25,33 +30,89 @@ import numpy as np
 
 from .table import DenseTable, SparseTable
 
-_LEN = struct.Struct("<Q")
+_MAGIC = b"PT"
+_VERSION = 1
+# frame: magic(2) ver(1) ntensor(1) | json_len(u32) | total_len(u64)
+_FRAME = struct.Struct("<2sBBIQ")
+# per tensor: name_len(u16) | dtype_len(u8) | ndim(u8) | data_len(u64)
+_THDR = struct.Struct("<HBBQ")
+_MAX_FRAME = 1 << 34            # 16 GiB sanity bound on declared lengths
 
 
-def send_msg(sock: socket.socket, obj) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(data)) + data)
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    """Serialize a flat dict of JSON scalars + ndarrays (VariableMessage
+    framing: header describes, raw buffers follow)."""
+    scalars, tensors = {}, []
+    for k, v in obj.items():
+        if isinstance(v, np.ndarray):
+            tensors.append((k, np.ascontiguousarray(v)))
+        elif hasattr(v, "shape") and hasattr(v, "dtype"):   # jax array etc.
+            tensors.append((k, np.ascontiguousarray(np.asarray(v))))
+        else:
+            scalars[k] = v
+    hdr = json.dumps(scalars, separators=(",", ":")).encode()
+    parts = []
+    total = 0
+    for name, arr in tensors:
+        nb = name.encode()
+        dt = np.lib.format.dtype_to_descr(arr.dtype).encode()
+        meta = _THDR.pack(len(nb), len(dt), arr.ndim, arr.nbytes) + nb + dt
+        meta += struct.pack(f"<{arr.ndim}q", *arr.shape)
+        parts.append(meta)
+        parts.append(memoryview(arr).cast("B"))
+        total += len(meta) + arr.nbytes
+    frame = _FRAME.pack(_MAGIC, _VERSION, len(tensors), len(hdr),
+                        len(hdr) + total)
+    sock.sendall(frame)
+    sock.sendall(hdr)
+    for p in parts:
+        sock.sendall(p)
 
 
 def recv_msg(sock: socket.socket):
-    hdr = _recv_exact(sock, _LEN.size)
+    raw = _recv_exact(sock, _FRAME.size)
+    if raw is None:
+        return None
+    magic, ver, ntensor, json_len, total_len = _FRAME.unpack(raw)
+    if magic != _MAGIC or ver != _VERSION:
+        raise ConnectionError("bad PS frame (wrong protocol or version)")
+    if json_len > _MAX_FRAME or total_len > _MAX_FRAME:
+        raise ConnectionError("PS frame length out of bounds")
+    hdr = _recv_exact(sock, json_len)
     if hdr is None:
         return None
-    (n,) = _LEN.unpack(hdr)
-    data = _recv_exact(sock, n)
-    if data is None:
-        return None
-    return pickle.loads(data)
+    obj = json.loads(hdr.decode())
+    for _ in range(ntensor):
+        meta = _recv_exact(sock, _THDR.size)
+        if meta is None:
+            return None
+        name_len, dt_len, ndim, data_len = _THDR.unpack(meta)
+        if data_len > _MAX_FRAME:
+            raise ConnectionError("PS tensor length out of bounds")
+        rest = _recv_exact(sock, name_len + dt_len + 8 * ndim)
+        if rest is None:
+            return None
+        name = rest[:name_len].decode()
+        descr = rest[name_len:name_len + dt_len].decode()
+        shape = struct.unpack(f"<{ndim}q", rest[name_len + dt_len:])
+        data = _recv_exact(sock, data_len)
+        if data is None:
+            return None
+        arr = np.frombuffer(data, dtype=np.lib.format.descr_to_dtype(descr))
+        obj[name] = arr.reshape(shape)
+    return obj
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             return None
-        buf += chunk
-    return buf
+        got += r
+    return buf          # writable: np.frombuffer views stay mutable
 
 
 class _ParamState:

@@ -1,0 +1,14 @@
+"""dygraph_to_static — AST conversion of tensor-dependent Python control
+flow, parity with fluid/dygraph/dygraph_to_static/ (ast_transformer.py:1,
+ifelse_transformer.py:1, loop_transformer.py:1, logical_transformer.py).
+
+The reference rewrites dygraph Python into static-graph ops
+(cond/while_loop). TPU-native equivalent: rewrite into
+``lax.cond`` / ``lax.while_loop`` calls at @declarative staging time —
+dual-mode converters keep plain Python semantics when the predicate is a
+concrete value and emit compiler control flow only when it is traced.
+"""
+from .ast_transformer import convert_to_static, DygraphToStaticAst
+from . import convert_operators as _jst  # noqa: F401
+
+__all__ = ["convert_to_static", "DygraphToStaticAst", "_jst"]

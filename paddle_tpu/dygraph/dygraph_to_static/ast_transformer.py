@@ -1,0 +1,322 @@
+"""AST transformation for @declarative — parity with
+dygraph_to_static/ast_transformer.py DygraphToStaticAst.
+
+Rewrites tensor-dependent Python control flow into calls to the dual-mode
+converters in convert_operators.py:
+
+    if c: A else: B      ->  def __t(): A; return (vars)
+                             def __f(): B; return (vars)
+                             vars = _jst.convert_ifelse(c, __t, __f)
+    while c: B           ->  def __c(v...): return c
+                             def __b(v...): B; return (v...)
+                             v... = _jst.convert_while_loop(__c, __b, (v...))
+    for i in range(n): B ->  _jst.convert_for_range(0, n, 1, __b, (v...))
+    a and b / or / not   ->  _jst.convert_logical_*(lambda: a, lambda: b)
+
+Branch/loop bodies containing return/break/continue/yield, or assignments
+to attributes/subscripts, are left as plain Python (they still work for
+concrete predicates; a traced predicate then raises jax's concretization
+error, matching the reference's unsupported-construct diagnostics).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Set
+
+
+_JST = "_jst"
+
+
+def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
+    names: Set[str] = set()
+    for node in stmts:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    names |= _target_names(tgt)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                names |= _target_names(sub.target)
+    return names
+
+
+def _target_names(tgt) -> Set[str]:
+    if isinstance(tgt, ast.Name):
+        return {tgt.id}
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out = set()
+        for e in tgt.elts:
+            out |= _target_names(e)
+        return out
+    return set()
+
+
+def _has_complex_assign(stmts: List[ast.stmt]) -> bool:
+    for node in stmts:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if not isinstance(tgt, (ast.Name, ast.Tuple, ast.List)):
+                        return True
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                if not isinstance(sub.target, ast.Name):
+                    return True
+    return False
+
+
+def _has_flow_escape(stmts: List[ast.stmt]) -> bool:
+    """return/break/continue/yield anywhere in stmts (not nested defs)."""
+    class Finder(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_Yield(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass  # nested function bodies are their own scope
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    f = Finder()
+    for s in stmts:
+        f.visit(s)
+    return f.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _lambda(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+def _jst_call(func: str, args) -> ast.Call:
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr=func, ctx=ast.Load()),
+        args=list(args), keywords=[])
+
+
+def _ret_tuple(names) -> ast.Return:
+    return ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in names], ctx=ast.Load()))
+
+
+def _assign_tuple(names, value) -> ast.stmt:
+    if len(names) == 1:
+        # single name: converters return a 1-tuple; unpack with a trailing
+        # comma target
+        target = ast.Tuple(elts=[_name(names[0], ast.Store())],
+                           ctx=ast.Store())
+    else:
+        target = ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                           ctx=ast.Store())
+    return ast.Assign(targets=[target], value=value)
+
+
+class LogicalTransformer(ast.NodeTransformer):
+    """a and b -> _jst.convert_logical_and(lambda: a, lambda: b), keeping
+    rhs lazy (logical_transformer.py)."""
+
+    def _lam(self, expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=expr)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        cur = node.values[-1]
+        for prev in reversed(node.values[:-1]):
+            cur = _jst_call(fn, [self._lam(prev), self._lam(cur)])
+        return cur
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def _fresh(self, kind):
+        self._counter += 1
+        return f"__d2s_{kind}_{self._counter}"
+
+    # -- if/else -----------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        bodies = node.body + node.orelse
+        if _has_flow_escape(bodies) or _has_complex_assign(bodies):
+            return node
+        names = sorted(_assigned_names(node.body)
+                       | _assigned_names(node.orelse))
+        if not names:
+            return node
+        tname, fname = self._fresh("true"), self._fresh("false")
+        params = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n, annotation=None) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+
+        def mk(fn_name, body):
+            return ast.FunctionDef(
+                name=fn_name, args=params,
+                body=(list(body) or [ast.Pass()]) + [_ret_tuple(names)],
+                decorator_list=[], returns=None)
+
+        # pre-branch values (UNDEFINED when not yet bound) ride in as args
+        # so one-sided assignments see the outer value instead of
+        # shadow-raising UnboundLocalError
+        arg_vals = ast.Tuple(
+            elts=[_jst_call("ld", [_lambda(_name(n))]) for n in names],
+            ctx=ast.Load())
+        call = _jst_call("convert_ifelse",
+                         [node.test, _name(tname), _name(fname), arg_vals])
+        return [mk(tname, node.body), mk(fname, node.orelse),
+                _assign_tuple(names, call)]
+
+    # -- while -------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body) \
+                or _has_complex_assign(node.body):
+            return node
+        names = sorted(_assigned_names(node.body))
+        if not names:
+            return node
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n, annotation=None) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        body_fn = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [_ret_tuple(names)], decorator_list=[],
+            returns=None)
+        call = _jst_call(
+            "convert_while_loop",
+            [_name(cname), _name(bname),
+             ast.Tuple(elts=[_name(n) for n in names], ctx=ast.Load())])
+        return [cond_fn, body_fn, _assign_tuple(names, call)]
+
+    # -- for i in range(...) ----------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body) \
+                or _has_complex_assign(node.body):
+            return node
+        if not (isinstance(node.target, ast.Name)
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords):
+            return node
+        names = sorted(_assigned_names(node.body) - {node.target.id})
+        if not names:
+            return node
+        rargs = node.iter.args
+        zero = ast.Constant(value=0)
+        one = ast.Constant(value=1)
+        if len(rargs) == 1:
+            start, stop, step = zero, rargs[0], one
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], one
+        else:
+            start, stop, step = rargs
+        bname = self._fresh("forbody")
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=node.target.id, annotation=None)]
+            + [ast.arg(arg=n, annotation=None) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        body_fn = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [_ret_tuple(names)], decorator_list=[],
+            returns=None)
+        call = _jst_call(
+            "convert_for_range",
+            [start, stop, step, _name(bname),
+             ast.Tuple(elts=[_name(n) for n in names], ctx=ast.Load())])
+        return [body_fn, _assign_tuple(names, call)]
+
+
+class DygraphToStaticAst:
+    """Apply the transformer stack to a FunctionDef tree
+    (ast_transformer.py DygraphToStaticAst.get_static_ast)."""
+
+    def transform(self, tree: ast.AST) -> ast.AST:
+        tree = LogicalTransformer().visit(tree)
+        tree = ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(tree)
+        return tree
+
+
+def convert_to_static(fn):
+    """Source-transform ``fn`` for staging; returns ``fn`` unchanged when
+    the source is unavailable or uses no convertible control flow."""
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(source)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fndef = tree.body[0]
+    if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    has_flow = any(isinstance(n, (ast.If, ast.While, ast.For, ast.BoolOp))
+                   for n in ast.walk(fndef))
+    if not has_flow:
+        return fn
+    fndef.decorator_list = []
+    DygraphToStaticAst().transform(tree)
+    namespace = dict(fn.__globals__)
+    from . import convert_operators
+
+    namespace[_JST] = convert_operators
+    # snapshot closure cells so freevars resolve in the regenerated scope
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                namespace[name] = cell.cell_contents
+            except ValueError:
+                pass
+    try:
+        code = compile(tree, filename=f"<dygraph_to_static "
+                       f"{getattr(fn, '__name__', 'fn')}>", mode="exec")
+        exec(code, namespace)
+        new_fn = namespace[fndef.name]
+    except Exception:
+        return fn
+    new_fn.__wrapped_original__ = fn
+    return new_fn

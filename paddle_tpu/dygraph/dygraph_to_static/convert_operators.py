@@ -1,0 +1,178 @@
+"""Runtime converters the transformed AST calls into — parity with
+dygraph_to_static/convert_operators.py (convert_ifelse:210,
+convert_while_loop:42, convert_logical_and/or/not).
+
+Dual-mode: a concrete (non-traced) predicate keeps exact Python
+semantics — branch bodies and loop bodies run as ordinary Python, so
+side effects, python objects, and one-sided assignments all work.  A
+traced predicate (inside @declarative staging) emits lax.cond /
+lax.while_loop, the XLA-native control flow.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _varbase_cls():
+    from ..varbase import VarBase
+
+    return VarBase
+
+
+def _unwrap(v):
+    VarBase = _varbase_cls()
+    return v.value if isinstance(v, VarBase) else v
+
+
+def _is_traced(v) -> bool:
+    return isinstance(_unwrap(v), jax.core.Tracer)
+
+
+def _pred_value(pred):
+    p = _unwrap(pred)
+    if hasattr(p, "shape"):
+        return jnp.reshape(p, ()).astype(jnp.bool_)
+    return p
+
+
+class _Undefined:
+    """Sentinel for names unbound before a converted branch — any use is
+    an error, like the reference's UndefinedVar (utils.py)."""
+
+    def __repr__(self):
+        return "<undefined local>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "local variable used before assignment inside converted "
+            "control flow")
+
+    __bool__ = __add__ = __radd__ = __mul__ = __call__ = _raise
+    __getattr__ = __getitem__ = _raise
+
+
+UNDEFINED = _Undefined()
+
+
+def ld(getter: Callable):
+    """Read a possibly-unbound local for branch-argument passing."""
+    try:
+        return getter()
+    except NameError:           # incl. UnboundLocalError / free-var cases
+        return UNDEFINED
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args=()):
+    """if/else on a tensor predicate. Branch fns take the names assigned
+    in either branch as positional args (their pre-branch values, or
+    UNDEFINED) and return them updated; lax.cond demands both branches
+    produce matching pytrees."""
+    if not _is_traced(pred):
+        return true_fn(*args) if bool(_pred_value(pred)) \
+            else false_fn(*args)
+
+    VarBase = _varbase_cls()
+
+    def norm(fn):
+        def run(_):
+            out = fn(*args)
+            return jax.tree.map(
+                _unwrap, out,
+                is_leaf=lambda x: isinstance(x, VarBase))
+        return run
+
+    out = lax.cond(_pred_value(pred), norm(true_fn), norm(false_fn), None)
+    return jax.tree.map(
+        lambda o: VarBase(o, stop_gradient=True)
+        if hasattr(o, "shape") else o, out)
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable,
+                       loop_vars: Sequence):
+    """while on a tensor condition. cond_fn/body_fn take the loop vars
+    positionally; body returns them updated."""
+    VarBase = _varbase_cls()
+    loop_vars = tuple(loop_vars)
+    first = cond_fn(*loop_vars)
+    if not _is_traced(first) and not any(_is_traced(v) for v in loop_vars):
+        # concrete: plain Python loop (cond re-evaluated each round)
+        while bool(_pred_value(cond_fn(*loop_vars))):
+            out = body_fn(*loop_vars)
+            loop_vars = tuple(out) if isinstance(out, (list, tuple)) \
+                else (out,)
+        return loop_vars
+
+    was_var = [isinstance(v, VarBase) for v in loop_vars]
+
+    def wrap(vals):
+        return tuple(
+            VarBase(v, stop_gradient=True) if w else v
+            for v, w in zip(vals, was_var))
+
+    def cond(vals):
+        return _pred_value(cond_fn(*wrap(vals)))
+
+    def body(vals):
+        out = body_fn(*wrap(vals))
+        out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        return tuple(_unwrap(v) for v in out)
+
+    init = tuple(_unwrap(v) for v in loop_vars)
+    final = lax.while_loop(cond, body, init)
+    return wrap(final)
+
+
+def convert_for_range(start, stop, step, body_fn: Callable,
+                      loop_vars: Sequence):
+    """``for i in range(...)`` with a traced bound, via convert_while_loop.
+    body_fn(i, *loop_vars) -> loop_vars."""
+    VarBase = _varbase_cls()
+    s = _unwrap(start)
+    e = _unwrap(stop)
+    st = _unwrap(step)
+    if not any(isinstance(v, jax.core.Tracer) for v in (s, e, st)):
+        for i in range(int(s), int(e), int(st)):
+            out = body_fn(i, *loop_vars)
+            loop_vars = tuple(out) if isinstance(out, (list, tuple)) \
+                else (out,)
+        return tuple(loop_vars)
+
+    i0 = jnp.asarray(s, jnp.int32)
+
+    def cond(i, *vs):
+        iv = _unwrap(i)
+        return jnp.where(jnp.asarray(st) >= 0, iv < e, iv > e)
+
+    def body(i, *vs):
+        out = body_fn(i, *vs)
+        out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        return (_unwrap(i) + st,) + out
+
+    final = convert_while_loop(cond, body, (i0,) + tuple(loop_vars))
+    return final[1:]
+
+
+def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
+    """`a and b` — rhs stays lazy for Python semantics; traced operands
+    use jnp.logical_and (logical_transformer.py)."""
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs and rhs_fn()
+    return jnp.logical_and(_pred_value(lhs), _pred_value(rhs_fn()))
+
+
+def convert_logical_or(lhs_fn: Callable, rhs_fn: Callable):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs or rhs_fn()
+    return jnp.logical_or(_pred_value(lhs), _pred_value(rhs_fn()))
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not x
+    return jnp.logical_not(_pred_value(x))

@@ -88,6 +88,27 @@ class _StaticFunction:
         self._fn = fn
         self._layer = layer
         self._cache = {}
+        self._converted = None
+
+    def _static_fn(self):
+        """The AST-converted callable (dygraph_to_static): tensor-dependent
+        if/while/for become lax.cond/while_loop so data-dependent Python
+        control flow stages instead of raising a concretization error."""
+        if self._converted is None:
+            from .dygraph_to_static import convert_to_static
+
+            fn = self._fn
+            bound_self = getattr(fn, "__self__", None)
+            target = fn.__func__ if bound_self is not None else fn
+            conv = convert_to_static(target)
+            if bound_self is not None and conv is not target:
+                import functools
+
+                conv = functools.partial(conv, bound_self)
+            elif bound_self is not None:
+                conv = fn
+            self._converted = conv
+        return self._converted
 
     def _resolve_layer(self, args):
         """Return (layer, call_with_self, remaining_args)."""
@@ -101,7 +122,7 @@ class _StaticFunction:
         return None, False, args
 
     def _pure(self, layer=None, call_with_self=False):
-        fn = self._fn
+        fn = self._static_fn()
         if layer is None:
             def pure(param_vals, *vs):
                 wrapped = [VarBase(v, stop_gradient=True)

@@ -64,6 +64,8 @@ def convert_dtype(dtype) -> str:
         return "float32"
     if isinstance(dtype, VarType):
         return _VARTYPE_TO_DTYPE[dtype]
+    if isinstance(dtype, int):   # raw proto enum value (framework.proto:91)
+        return _VARTYPE_TO_DTYPE[VarType(dtype)]
     if isinstance(dtype, str):
         if dtype in _DTYPE_TO_VARTYPE:
             return dtype

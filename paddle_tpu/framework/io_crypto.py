@@ -99,7 +99,16 @@ def _ctr_keystream(key: bytes, nonce: bytes, n: int) -> bytes:
 # Cipher API (cipher.h)
 # ---------------------------------------------------------------------------
 
-_MAGIC = b"PTPUAE1\0"
+_MAGIC_V1 = b"PTPUAE1\0"   # legacy: one key for both CTR and HMAC
+_MAGIC = b"PTPUAE2\0"      # v2: HKDF-style enc/mac subkey separation
+
+
+def _subkeys(key: bytes, key_bytes: int):
+    """Derive independent encryption/MAC subkeys (encrypt-then-MAC key
+    separation): enc = HMAC(key, 'enc'), mac = HMAC(key, 'mac')."""
+    enc = hmac_mod.new(key, b"enc", hashlib.sha256).digest()[:key_bytes]
+    mac = hmac_mod.new(key, b"mac", hashlib.sha256).digest()
+    return enc, mac
 
 
 class Cipher:
@@ -147,25 +156,31 @@ class AESCipher(Cipher):
         if isinstance(plaintext, str):
             plaintext = plaintext.encode()
         key = self._norm_key(key)
+        enc_key, mac_key = _subkeys(key, self.key_bytes)
         nonce = os.urandom(8)
-        stream = _ctr_keystream(key, nonce, len(plaintext))
+        stream = _ctr_keystream(enc_key, nonce, len(plaintext))
         ct = bytes(p ^ s for p, s in zip(plaintext, stream))
-        mac = hmac_mod.new(key, _MAGIC + nonce + ct,
+        mac = hmac_mod.new(mac_key, _MAGIC + nonce + ct,
                            hashlib.sha256).digest()
         return _MAGIC + nonce + ct + mac
 
     def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
         key = self._norm_key(key)
-        if len(ciphertext) < 48 or ciphertext[:8] != _MAGIC:
+        magic = ciphertext[:8]
+        if len(ciphertext) < 48 or magic not in (_MAGIC, _MAGIC_V1):
             raise ValueError("not a paddle_tpu encrypted blob")
+        if magic == _MAGIC_V1:       # legacy files: single shared key
+            enc_key, mac_key = key, key
+        else:
+            enc_key, mac_key = _subkeys(key, self.key_bytes)
         nonce = ciphertext[8:16]
         ct, mac = ciphertext[16:-32], ciphertext[-32:]
-        want = hmac_mod.new(key, _MAGIC + nonce + ct,
+        want = hmac_mod.new(mac_key, magic + nonce + ct,
                             hashlib.sha256).digest()
         if not hmac_mod.compare_digest(mac, want):
             raise ValueError("ciphertext authentication failed "
                              "(wrong key or tampered file)")
-        stream = _ctr_keystream(key, nonce, len(ct))
+        stream = _ctr_keystream(enc_key, nonce, len(ct))
         return bytes(c ^ s for c, s in zip(ct, stream))
 
 

@@ -165,9 +165,10 @@ class FleetUtil:
             fs.mkdirs(output_path)
         tmp = os.path.join(output_path, donefile_name + ".tmp")
         payload = (existing + record + "\n").encode()
-        fs.touch(tmp)
-        with open(tmp, "wb") as f:
-            f.write(payload)
+        # write locally then upload through fs so HDFS backends receive the
+        # payload (a local open() would leave the remote tmp empty and the
+        # rename would wipe the done-record history)
+        fs.put_bytes(tmp, payload)
         fs.rename(tmp, done, overwrite=True)
 
     def get_last_save_model(self, output_path: str, fs=None,

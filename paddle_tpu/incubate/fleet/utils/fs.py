@@ -56,6 +56,21 @@ class FS:
     def download(self, remote_path, local_path, overwrite=False):
         raise NotImplementedError
 
+    def put_bytes(self, path, payload: bytes):
+        """Write ``payload`` to ``path`` on THIS filesystem (write a local
+        tempfile, then upload) — storage-agnostic, unlike open(path,'wb')
+        which only touches the local disk."""
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(prefix="fs_put_")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            self.upload(tmp, path, overwrite=True)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
 
 class LocalFS(FS):
     def is_exist(self, path):
@@ -95,9 +110,12 @@ class LocalFS(FS):
             return f.read()
 
     def upload(self, local_path, remote_path, overwrite=False):
-        if local_path != remote_path:
-            self.mkdirs(os.path.dirname(remote_path) or ".")
-            shutil.copy2(local_path, remote_path)
+        if local_path == remote_path:
+            return
+        if os.path.exists(remote_path) and not overwrite:
+            raise FileExistsError(remote_path)
+        self.mkdirs(os.path.dirname(remote_path) or ".")
+        shutil.copy2(local_path, remote_path)
 
     def download(self, remote_path, local_path, overwrite=False):
         self.upload(remote_path, local_path, overwrite)

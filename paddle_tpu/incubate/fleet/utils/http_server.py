@@ -8,6 +8,7 @@ scope holds its expected number of deletions).
 """
 from __future__ import annotations
 
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import Dict
@@ -42,6 +43,10 @@ class KVHandler(BaseHTTPRequestHandler):
     def do_PUT(self):
         scope, key = self._parts()
         n = int(self.headers.get("Content-Length", 0))
+        if n > self.server.max_body_bytes:
+            self.send_response(413)
+            self.end_headers()
+            return
         body = self.rfile.read(n)
         with self.server.kv_lock:
             self.server.kv.setdefault(scope, {})[key] = body
@@ -59,8 +64,16 @@ class KVHandler(BaseHTTPRequestHandler):
 
 
 class KVHTTPServer(HTTPServer):
+    """Binds to PADDLE_KV_BIND_HOST (default all interfaces, matching the
+    reference) — set it to the pod IP so only the training network can reach
+    the rendezvous store; the port must be firewalled either way. PUT bodies
+    are capped at PADDLE_KV_MAX_BODY_BYTES (default 64 MiB)."""
+
     def __init__(self, port, handler):
-        super().__init__(("", port), handler)
+        host = os.environ.get("PADDLE_KV_BIND_HOST", "")
+        super().__init__((host, port), handler)
+        self.max_body_bytes = int(os.environ.get(
+            "PADDLE_KV_MAX_BODY_BYTES", 64 << 20))
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.delete_kv: Dict[str, set] = {}
         self.kv_lock = threading.Lock()

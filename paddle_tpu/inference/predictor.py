@@ -71,13 +71,58 @@ class Predictor:
     def get_output_names(self) -> List[str]:
         return list(self._fetch_names)
 
-    def run(self, feed: Dict[str, Any]) -> List[np.ndarray]:
+    def run(self, feed: Optional[Dict[str, Any]] = None) -> List[np.ndarray]:
+        """Dict form runs directly; zero-copy form (run() with no args)
+        consumes inputs staged via get_input_handle().copy_from_cpu(), like
+        the reference AnalysisPredictor::ZeroCopyRun
+        (api_impl.cc / analysis_predictor.cc)."""
+        if feed is None:
+            feed = dict(getattr(self, "_staged", {}))
         missing = set(self._feed_names) - set(feed)
         if missing:
             raise ValueError(f"missing inputs: {sorted(missing)}")
-        return self._exe.run(self._program,
+        outs = self._exe.run(self._program,
                              feed={k: feed[k] for k in self._feed_names},
                              fetch_list=self._fetch_names, scope=self._scope)
+        self._outputs = dict(zip(self._fetch_names, outs))
+        return outs
+
+    # -- zero-copy handle surface (get_input_tensor/get_input_handle) ------
+    class _Handle:
+        def __init__(self, pred, name, is_input):
+            self._pred, self._name, self._is_input = pred, name, is_input
+
+        def copy_from_cpu(self, arr):
+            if not self._is_input:
+                raise ValueError("cannot write an output handle")
+            staged = self._pred.__dict__.setdefault("_staged", {})
+            staged[self._name] = np.asarray(arr)
+
+        def reshape(self, shape):
+            pass  # shapes come from the staged array
+
+        def copy_to_cpu(self) -> np.ndarray:
+            outs = getattr(self._pred, "_outputs", None)
+            if outs is None or self._name not in outs:
+                raise RuntimeError("run() has not produced this output yet")
+            return np.asarray(outs[self._name])
+
+    def get_input_handle(self, name: str) -> "Predictor._Handle":
+        if name not in self._feed_names:
+            raise KeyError(name)
+        return Predictor._Handle(self, name, True)
+
+    def get_output_handle(self, name: str) -> "Predictor._Handle":
+        if name not in self._fetch_names:
+            raise KeyError(name)
+        return Predictor._Handle(self, name, False)
+
+    # 1.8 zero-copy spelling (analysis_predictor.cc GetInputTensor)
+    get_input_tensor = get_input_handle
+    get_output_tensor = get_output_handle
+
+    def zero_copy_run(self):
+        return self.run()
 
     @property
     def program(self) -> Program:

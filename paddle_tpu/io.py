@@ -69,7 +69,18 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         for name, arr in payload.items():
             paddle_pb.save_tensor_file(os.path.join(dirname, name), arr)
     else:
-        names = sorted(payload)
+        # program var-declaration order, matching the reference's
+        # save_vars/load_vars contract (io.py:224 iterates list_vars()
+        # unsorted; the combined stream carries no names). A var absent
+        # from scope must be an error: silently skipping would desync the
+        # positional stream from load_vars' name list (the reference's
+        # save_combine op likewise rejects uninitialized inputs).
+        names = [(v.name if isinstance(v, Variable) else v) for v in vars]
+        missing = [n for n in names if n not in payload]
+        if missing:
+            raise RuntimeError(
+                f"save_vars(filename=...): vars not initialized in scope: "
+                f"{missing}")
         paddle_pb.save_combine(os.path.join(dirname, filename),
                                [(n, payload[n]) for n in names])
 
@@ -113,7 +124,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         path = os.path.join(dirname, filename)
         if not os.path.exists(path):
             raise FileNotFoundError(path)
-        names = sorted(by_name)
+        names = [(v.name if isinstance(v, Variable) else v) for v in vars]
         for name, arr in paddle_pb.load_combine(path, names).items():
             _put(name, arr)
 

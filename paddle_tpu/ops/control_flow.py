@@ -17,7 +17,7 @@ from jax import lax
 from ..framework.core import int_index_dtype
 from ..framework.registry import LowerCtx, register_op, run_lowering
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 def _block_reads_writes(block):
@@ -191,7 +191,7 @@ def read_from_array(ctx, op, ins):
 
 @register_op("array_length", grad=None)
 def array_length(ctx, op, ins):
-    return {"Out": jnp.asarray([len(ins["X"][0])], dtype=_I64)}
+    return {"Out": jnp.asarray([len(ins["X"][0])], dtype=_I64())}
 
 
 @register_op("tensor_array_to_tensor", grad=None)
@@ -203,3 +203,90 @@ def tensor_array_to_tensor(ctx, op, ins):
     else:
         out = jnp.concatenate(arr, axis=axis)
     return {"Out": out, "OutIndex": jnp.asarray([a.shape[axis] for a in arr], dtype=jnp.int32)}
+
+
+@register_op("recurrent", diff_inputs=("inputs", "initial_states",
+                                       "parameters"))
+def recurrent(ctx, op, ins):
+    """operators/recurrent_op.cc RecurrentOp — the persisted-program form
+    of StaticRNN: run sub_block once per time step over time-major inputs,
+    wiring each step's ``states`` into the next step's ``ex_states``. The
+    reference loops step scopes on the host; here the step block is lowered
+    once and driven by lax.scan (grad falls out of the default vjp instead
+    of needing recurrent_grad's scope replay)."""
+    sub_block = ctx.program.block(op.attr("sub_block"))
+    reverse = bool(op.attr("reverse", False))
+    ex_names = [str(s) for s in op.attr("ex_states", [])]
+    st_names = [str(s) for s in op.attr("states", [])]
+    in_names = op.inputs.get("inputs", [])
+    param_names = op.inputs.get("parameters", [])
+    out_names = op.outputs.get("outputs", [])
+    xs = {n: v for n, v in zip(in_names, ins.get("inputs", []))}
+    init_states = list(ins.get("initial_states", []))
+    params = {n: v for n, v in zip(param_names, ins.get("parameters", []))}
+
+    read, written = _block_reads_writes(sub_block)
+    bound = set(xs) | set(params) | set(ex_names)
+    invariant = {n: ctx.env[n] for n in read
+                 if n in ctx.env and n not in bound}
+
+    def step(carry, x_t):
+        env = dict(invariant)
+        env.update(params)
+        env.update(x_t)
+        for ex, val in zip(ex_names, carry):
+            env[ex] = val
+        _run_sub_block(ctx, sub_block, env)
+        new_carry = [env[s] for s in st_names]
+        return new_carry, [env[o] for o in out_names]
+
+    _, stacked = lax.scan(step, init_states, xs, reverse=reverse)
+    return {"outputs": stacked, "step_scopes": None}
+
+
+@register_op("rnn_memory_helper", diff_inputs=("X",))
+def rnn_memory_helper(ctx, op, ins):
+    """operators/recurrent_op helper (rnn_memory_helper_op.cc): identity
+    forward; its grad op exists to zero-fill missing memory grads, which
+    the default vjp handles for free."""
+    return {"Out": ins["X"][0]}
+
+
+@register_op("reorder_lod_tensor_by_rank", diff_inputs=("X",))
+def reorder_lod_tensor_by_rank(ctx, op, ins):
+    """operators/reorder_lod_tensor_by_rank_op.cc — permute batch rows to
+    the rank table's order (descending length). Padded convention: the
+    rank-table var carries the sorted row indices (ops/dynamic_rnn.py
+    lod_rank_table)."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    order = table.reshape(-1).astype(jnp.int32)[: x.shape[0]]
+    return {"Out": x[order]}
+
+
+def _alias_op(new_type, base_type, is_test=False, **kw):
+    base = None
+
+    def lower(ctx, op, ins):
+        nonlocal base
+        if base is None:
+            from ..framework.registry import get_op_spec
+
+            base = get_op_spec(base_type)
+        if is_test:
+            ctx = LowerCtx(ctx.program, ctx.block, ctx.env,
+                           rng_key=ctx._rng_key, mesh_axes=ctx.mesh_axes,
+                           is_test=True)
+        return base.lower(ctx, op, ins)
+
+    register_op(new_type, **kw)(lower)
+
+
+# inference-graph variants: same lowering, test mode pinned
+# (conditional_block_op.cc:262 / merge_lod_tensor_op.cc:187)
+_alias_op("conditional_block_infer", "conditional_block", is_test=True,
+          grad=None)
+_alias_op("merge_lod_tensor_infer", "merge_lod_tensor", is_test=True,
+          grad=None)
+# lod_array_length (lod_array_length_op.cc) == array_length here
+_alias_op("lod_array_length", "array_length", grad=None)

@@ -16,7 +16,7 @@ from jax import lax
 from ..framework.core import int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 _NEG = -1e30
 
@@ -111,7 +111,7 @@ def crf_viterbi(emission, transition, length):
     # path_rev holds tags for positions T-1 .. 1; tag0 is position 0
     path = jnp.concatenate([tag0[None], path_rev[::-1]], axis=0).T  # [B,T]
     t_idx = jnp.arange(T)[None, :]
-    return jnp.where(t_idx < L[:, None], path, 0).astype(_I64)
+    return jnp.where(t_idx < L[:, None], path, 0).astype(_I64())
 
 
 @register_op("crf_decoding", grad=None)
@@ -130,8 +130,8 @@ def crf_decoding(ctx, op, ins):
         t_idx = jnp.arange(path.shape[1])[None, :]
         valid = t_idx < length.astype(jnp.int32)[:, None]
         # crf_decoding_op.h: with Label, emit 1 where path==label (0 in pad)
-        path = jnp.where(valid & (label.astype(_I64) == path), 1, 0) \
-            .astype(_I64)
+        path = jnp.where(valid & (label.astype(_I64()) == path), 1, 0) \
+            .astype(_I64())
     return {"ViterbiPath": path}
 
 

@@ -26,7 +26,7 @@ import numpy as np
 from ..framework.core import int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 # ---------------------------------------------------------------------------
@@ -74,7 +74,7 @@ def _log_uniform_sample(key, shape, range_):
     exp(u * log(range_+1)) - 1 (math/sampler.cc:44 Sample())."""
     u = jax.random.uniform(key, shape)
     v = jnp.exp(u * np.log(range_ + 1.0)) - 1.0
-    return jnp.clip(v.astype(_I64), 0, range_ - 1)
+    return jnp.clip(v.astype(_I64()), 0, range_ - 1)
 
 
 def _log_uniform_prob(values, range_):
@@ -156,7 +156,7 @@ def nce(ctx, op, ins):
         row_cost = row_cost * sample_weight.reshape(-1, 1)
     return {"Cost": row_cost.astype(x.dtype),
             "SampleLogits": o.astype(x.dtype),
-            "SampleLabels": samples.astype(_I64)}
+            "SampleLabels": samples.astype(_I64())}
 
 
 # ---------------------------------------------------------------------------
@@ -194,8 +194,8 @@ def sample_logits(ctx, op, ins):
         sampled = jnp.where(hit, sampled - 1e20, sampled)
     sampled = sampled - jnp.log(prob).astype(sampled.dtype)
     sampled_labels = jnp.broadcast_to(
-        jnp.arange(nt, dtype=_I64)[None, :], (B, nt))
-    return {"Samples": samples.astype(_I64), "Probabilities": prob,
+        jnp.arange(nt, dtype=_I64())[None, :], (B, nt))
+    return {"Samples": samples.astype(_I64()), "Probabilities": prob,
             "SampledLogits": sampled, "SampledLabels": sampled_labels,
             "LogitsDim": None, "LabelsDim": None}
 

@@ -15,7 +15,7 @@ import numpy as np
 from ..framework.core import int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 # ---------------------------------------------------------------------------
@@ -458,7 +458,7 @@ def roi_pool(ctx, op, ins):
         val = colm.max(axis=-1)                        # [C,ph,pw]
         warg = colm.argmax(axis=-1)                    # [C,ph,pw] -> w index
         harg = jnp.take_along_axis(rowarg, warg, axis=-1)  # [C,ph,pw]
-        arg = (harg * w + warg).astype(_I64)
+        arg = (harg * w + warg).astype(_I64())
         empty = ~(hmask.any(-1)[:, None] & wmask.any(-1)[None, :])  # [ph,pw]
         val = jnp.where(empty[None], 0.0, val)
         arg = jnp.where(empty[None], -1, arg)

@@ -27,7 +27,7 @@ from jax import lax
 from ..framework.core import int_index_dtype
 from ..framework.registry import LowerCtx, register_op, run_lowering
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 @register_op("dynamic_rnn")
@@ -117,7 +117,7 @@ def lod_rank_table(ctx, op, ins):
         ln = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
     order = jnp.argsort(-ln, stable=True)
     return {"Out": jnp.stack(
-        [order.astype(_I64), ln[order].astype(_I64)], axis=1)}
+        [order.astype(_I64()), ln[order].astype(_I64())], axis=1)}
 
 
 @register_op("max_sequence_len", grad=None)

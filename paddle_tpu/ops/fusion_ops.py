@@ -369,3 +369,64 @@ def var_conv_2d(ctx, op, ins):
             & (jnp.arange(ow)[None, None, :] < vc[:, None, None]))
     return {"Out": jnp.where(mask[:, None], out, jnp.zeros((), x.dtype)),
             "Col": None}
+
+
+@register_op("fused_elemwise_activation", diff_inputs=("X", "Y"))
+def fused_elemwise_activation(ctx, op, ins):
+    """operators/fused/fused_elemwise_activation_op.cc — compose
+    functor_list = [binary, unary] or [unary, binary] in one op. The
+    reference fuses kernels for memory locality; XLA fuses the plain
+    lowering identically, so this is a semantic shim."""
+    x, y = ins["X"][0], ins["Y"][0]
+    functors = [str(f) for f in op.attr("functor_list", [])]
+    scale = float(op.attr("scale", 0.0))
+
+    def apply_unary(name, v):
+        if name == "scale":
+            return v * scale
+        if name == "relu":
+            return jax.nn.relu(v)
+        if name == "sigmoid":
+            return jax.nn.sigmoid(v)
+        if name == "tanh":
+            return jnp.tanh(v)
+        raise NotImplementedError(f"fused_elemwise functor {name!r}")
+
+    def apply_binary(name, a, b):
+        if name == "elementwise_add":
+            return a + b
+        if name == "elementwise_mul":
+            return a * b
+        if name == "elementwise_sub":
+            return a - b
+        raise NotImplementedError(f"fused_elemwise functor {name!r}")
+
+    f0, f1 = functors
+    if f0.startswith("elementwise_"):
+        # binary(x, unary(y))
+        inter = apply_unary(f1, y)
+        out = apply_binary(f0, x, inter)
+    else:
+        # unary(binary(x, y))
+        inter = apply_binary(f1, x, y)
+        out = apply_unary(f0, inter)
+    return {"Out": out, "IntermediateOut": inter}
+
+
+@register_op("fused_embedding_seq_pool", diff_inputs=("W",))
+def fused_embedding_seq_pool(ctx, op, ins):
+    """operators/fused/fused_embedding_seq_pool_op.cc — embedding lookup +
+    per-row sum pool. Ids [B, T] (or [B, T, 1]); padding_idx rows add
+    zero. One gather + masked sum on the MXU-friendly padded layout."""
+    ids = ins["Ids"][0]
+    w = ins["W"][0]
+    padding_idx = int(op.attr("padding_idx", -1))
+    if ids.ndim > 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    idx = ids.astype(jnp.int32)
+    emb = w[jnp.clip(idx, 0, w.shape[0] - 1)]           # [B, T, D]
+    mask = jnp.ones(idx.shape, w.dtype)
+    if padding_idx >= 0:
+        mask = jnp.where(idx == padding_idx, 0.0, mask)
+    out = jnp.sum(emb * mask[..., None], axis=1)
+    return {"Out": out}

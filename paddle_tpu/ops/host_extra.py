@@ -391,3 +391,227 @@ def tree_conv(scope, op, exe):
             out[b, root - 1] = (acc.reshape(-1) @ W).reshape(
                 out_size, num_filters)
     _set(scope, op.output("Out")[0], out)
+
+
+@register_host_op("precision_recall")
+def precision_recall(scope, op, exe):
+    """operators/metrics/precision_recall_op.cc:222 — multiclass streaming
+    precision/recall/F1. Per-class TP/FP/TN/FN state; metrics rows are
+    [macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1]."""
+    ids = _np(scope, op.input("Indices")[0]).reshape(-1).astype(np.int64)
+    labels = _np(scope, op.input("Labels")[0]).reshape(-1).astype(np.int64)
+    cls_num = int(op.attr("class_number"))
+    w = (_np(scope, op.input("Weights")[0]).reshape(-1)
+         if op.input("Weights") else np.ones(len(ids), np.float32))
+    TP, FP, TN, FN = 0, 1, 2, 3
+    batch = np.zeros((cls_num, 4), np.float64)
+    for i in range(len(ids)):
+        idx, lab, wi = ids[i], labels[i], float(w[i])
+        batch[:, TN] += wi
+        batch[idx, TN] -= wi
+        if idx == lab:
+            batch[idx, TP] += wi
+        else:
+            batch[lab, FN] += wi
+            batch[idx, FP] += wi
+            batch[lab, TN] -= wi
+
+    def metrics(states):
+        def prec(tp, fp):
+            return tp / (tp + fp) if tp > 0 or fp > 0 else 1.0
+
+        def rec(tp, fn):
+            return tp / (tp + fn) if tp > 0 or fn > 0 else 1.0
+
+        def f1(p, r):
+            return 2 * p * r / (p + r) if p > 0 or r > 0 else 0.0
+
+        mp = float(np.mean([prec(s[TP], s[FP]) for s in states]))
+        mr = float(np.mean([rec(s[TP], s[FN]) for s in states]))
+        tot = states.sum(0)
+        up = prec(tot[TP], tot[FP])
+        ur = rec(tot[TP], tot[FN])
+        return np.asarray([mp, mr, f1(mp, mr), up, ur, f1(up, ur)],
+                          np.float64)
+
+    accum = batch.copy()
+    if op.input("StatesInfo"):
+        accum += _np(scope, op.input("StatesInfo")[0]).reshape(
+            cls_num, 4).astype(np.float64)
+    _set(scope, op.output("BatchMetrics")[0], metrics(batch))
+    _set(scope, op.output("AccumMetrics")[0], metrics(accum))
+    _set(scope, op.output("AccumStatesInfo")[0], accum.astype(np.float32))
+
+
+def _det_map_boxes(dets, lengths):
+    """Split a flat [N,6] (label, score, x1,y1,x2,y2) by per-image counts."""
+    out, s = [], 0
+    for ln in lengths:
+        out.append(dets[s:s + ln])
+        s += ln
+    return out
+
+
+@register_host_op("detection_map")
+def detection_map(scope, op, exe):
+    """operators/detection_map_op.cc:194 — VOC mAP (integral / 11point)
+    with streaming TP/FP state. DetectRes [N,6] and Label [M,6 or 5] are
+    flat over the batch; per-image counts come from optional
+    DetectResLength/LabelLength [B] inputs (the reference reads LoD; the
+    padded convention carries lengths explicitly), defaulting to one image.
+    State tensors (PosCount [C,1], TruePos/FalsePos flat [K,2] with
+    TruePosLength/FalsePosLength [C]) mirror the reference's LoD layout."""
+    det = _np(scope, op.input("DetectRes")[0]).reshape(-1, 6)
+    lab = _np(scope, op.input("Label")[0])
+    lab = lab.reshape(-1, lab.shape[-1]) if lab.size else lab.reshape(0, 6)
+    class_num = int(op.attr("class_num"))
+    ovt = float(op.attr("overlap_threshold", 0.5))
+    eval_diff = bool(op.attr("evaluate_difficult", True))
+    ap_type = str(op.attr("ap_type", "integral"))
+    background = int(op.attr("background_label", 0))
+
+    def opt_len(slot, total):
+        if op.input(slot):
+            return _np(scope, op.input(slot)[0]).reshape(-1).astype(int)
+        return np.asarray([total])
+
+    det_imgs = _det_map_boxes(det, opt_len("DetectResLength", len(det)))
+    lab_imgs = _det_map_boxes(lab, opt_len("LabelLength", len(lab)))
+
+    # ---- carried state ---------------------------------------------------
+    pos_count = {}
+    true_pos = {c: [] for c in range(class_num)}
+    false_pos = {c: [] for c in range(class_num)}
+    has_state = (int(_np(scope, op.input("HasState")[0]).reshape(-1)[0])
+                 if op.input("HasState") else 0)
+    if has_state and op.input("PosCount"):
+        pc = _np(scope, op.input("PosCount")[0]).reshape(-1)
+        for c in range(class_num):
+            pos_count[c] = int(pc[c])
+        for slot, store in (("TruePos", true_pos), ("FalsePos", false_pos)):
+            flat = _np(scope, op.input(slot)[0]).reshape(-1, 2)
+            lens = _np(scope, op.input(slot + "Length")[0]).reshape(-1) \
+                if op.input(slot + "Length") else np.asarray([len(flat)])
+            s = 0
+            for c, ln in enumerate(lens.astype(int)):
+                store[c] = [(float(r[0]), int(r[1])) for r in flat[s:s + ln]]
+                s += ln
+
+    def jaccard(a, b):
+        a = np.clip(a, 0.0, 1.0)
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        if b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]:
+            return 0.0
+        inter = (ix2 - ix1) * (iy2 - iy1)
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_b = (b[2] - b[0]) * (b[3] - b[1])
+        return inter / (area_a + area_b - inter)
+
+    # ---- this batch's TP/FP ---------------------------------------------
+    for dets_n, labs_n in zip(det_imgs, lab_imgs):
+        gts = {}
+        for row in labs_n:
+            c = int(row[0])
+            if labs_n.shape[1] == 6:
+                gts.setdefault(c, []).append((row[2:6], bool(row[1])))
+            else:
+                gts.setdefault(c, []).append((row[1:5], False))
+        for c, boxes in gts.items():
+            cnt = len(boxes) if eval_diff else \
+                sum(1 for _, d in boxes if not d)
+            if cnt:
+                pos_count[c] = pos_count.get(c, 0) + cnt
+        by_cls = {}
+        for row in dets_n:
+            by_cls.setdefault(int(row[0]), []).append(
+                (float(row[1]), row[2:6]))
+        for c, preds in by_cls.items():
+            if c not in gts:
+                for score, _ in preds:
+                    true_pos[c].append((score, 0))
+                    false_pos[c].append((score, 1))
+                continue
+            boxes = gts[c]
+            visited = [False] * len(boxes)
+            preds.sort(key=lambda p: -p[0])
+            for score, box in preds:
+                overlaps = [jaccard(box, gb) for gb, _ in boxes]
+                mi = int(np.argmax(overlaps))
+                if overlaps[mi] > ovt:
+                    if eval_diff or not boxes[mi][1]:
+                        if not visited[mi]:
+                            true_pos[c].append((score, 1))
+                            false_pos[c].append((score, 0))
+                            visited[mi] = True
+                        else:
+                            true_pos[c].append((score, 0))
+                            false_pos[c].append((score, 1))
+                else:
+                    true_pos[c].append((score, 0))
+                    false_pos[c].append((score, 1))
+
+    # ---- mAP -------------------------------------------------------------
+    mAP, count = 0.0, 0
+    for c, num_pos in pos_count.items():
+        # the reference (detection_map_op.h:422) compares the positive
+        # COUNT to background_label — an upstream quirk; skipping the
+        # background CLASS is the intended semantics, and num_pos<=0
+        # guards the recall division when carried state restores an
+        # empty class
+        if c == background or num_pos <= 0:
+            continue
+        if not true_pos.get(c):
+            count += 1
+            continue
+        tp = sorted(true_pos[c], key=lambda p: -p[0])
+        fp = sorted(false_pos[c], key=lambda p: -p[0])
+        tp_sum = np.cumsum([v for _, v in tp])
+        fp_sum = np.cumsum([v for _, v in fp])
+        precision = tp_sum / np.maximum(tp_sum + fp_sum, 1e-12)
+        recall = tp_sum / float(num_pos)
+        if ap_type == "11point":
+            maxp = np.zeros(11)
+            start = len(recall) - 1
+            for j in range(10, -1, -1):
+                for i in range(start, -1, -1):
+                    if recall[i] < j / 10.0:
+                        start = i
+                        if j > 0:
+                            maxp[j - 1] = maxp[j]
+                        break
+                    elif maxp[j] < precision[i]:
+                        maxp[j] = precision[i]
+            mAP += float(maxp.sum() / 11)
+            count += 1
+        else:  # integral
+            ap, prev = 0.0, 0.0
+            for p, r in zip(precision, recall):
+                if abs(r - prev) > 1e-6:
+                    ap += p * abs(r - prev)
+                prev = r
+            mAP += ap
+            count += 1
+    if count:
+        mAP /= count
+
+    # ---- write accumulated state ----------------------------------------
+    pc_out = np.zeros((class_num, 1), np.int32)
+    for c, v in pos_count.items():
+        if 0 <= c < class_num:
+            pc_out[c, 0] = v
+    _set(scope, op.output("AccumPosCount")[0], pc_out)
+    for slot, store in (("AccumTruePos", true_pos),
+                        ("AccumFalsePos", false_pos)):
+        rows, lens = [], []
+        for c in range(class_num):
+            vec = store.get(c, [])
+            rows.extend(vec)
+            lens.append(len(vec))
+        arr = (np.asarray(rows, np.float32) if rows
+               else np.zeros((0, 2), np.float32))
+        _set(scope, op.output(slot)[0], arr)
+        if op.output(slot + "Length"):
+            _set(scope, op.output(slot + "Length")[0],
+                 np.asarray(lens, np.int64))
+    _set(scope, op.output("MAP")[0], np.asarray(mAP, np.float32))

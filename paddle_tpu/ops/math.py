@@ -15,7 +15,7 @@ from jax import lax
 from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 # ---------------------------------------------------------------------------
 # Creation / fill ops (reference operators/fill_constant_op.cc etc.)
@@ -368,13 +368,13 @@ def isinf_v2(ctx, op, ins):
 @register_op("arg_max", grad=None)
 def arg_max(ctx, op, ins):
     axis = op.attr("axis", -1)
-    return {"Out": jnp.argmax(ins["X"][0], axis=axis).astype(_I64)}
+    return {"Out": jnp.argmax(ins["X"][0], axis=axis).astype(_I64())}
 
 
 @register_op("arg_min", grad=None)
 def arg_min(ctx, op, ins):
     axis = op.attr("axis", -1)
-    return {"Out": jnp.argmin(ins["X"][0], axis=axis).astype(_I64)}
+    return {"Out": jnp.argmin(ins["X"][0], axis=axis).astype(_I64())}
 
 
 @register_op("argsort", grad=None)
@@ -384,7 +384,7 @@ def argsort(ctx, op, ins):
     desc = op.attr("descending", False)
     idx = jnp.argsort(-x if desc else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": out, "Indices": idx.astype(_I64)}
+    return {"Out": out, "Indices": idx.astype(_I64())}
 
 
 @register_op("top_k", diff_inputs=())
@@ -394,7 +394,7 @@ def top_k(ctx, op, ins):
     if "K" in ins and ins["K"]:
         k = int(np.asarray(ins["K"][0]))
     vals, idx = lax.top_k(x, k)
-    return {"Out": vals, "Indices": idx.astype(_I64)}
+    return {"Out": vals, "Indices": idx.astype(_I64())}
 
 
 @register_op("top_k_v2", diff_inputs=())
@@ -406,7 +406,7 @@ def top_k_v2(ctx, op, ins):
     else:
         vals, idx = lax.top_k(-x, k)
         vals = -vals
-    return {"Out": vals, "Indices": idx.astype(_I64)}
+    return {"Out": vals, "Indices": idx.astype(_I64())}
 
 
 @register_op("accuracy", grad=None)

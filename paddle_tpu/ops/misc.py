@@ -11,7 +11,7 @@ import numpy as np
 from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 # -- creation / shape utilities --------------------------------------------
@@ -26,7 +26,7 @@ def eye(ctx, op, ins):
 
 @register_op("size", grad=None)
 def size(ctx, op, ins):
-    return {"Out": jnp.asarray(ins["Input"][0].size, _I64)}
+    return {"Out": jnp.asarray(ins["Input"][0].size, _I64())}
 
 
 @register_op("is_empty", grad=None)

@@ -19,7 +19,7 @@ from jax import lax
 from ..framework.core import int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 @register_op("multihead_matmul", diff_inputs=("Input", "W", "Bias"))

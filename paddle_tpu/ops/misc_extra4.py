@@ -13,7 +13,7 @@ from jax import lax
 from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 @register_op("pool3d", diff_inputs=("X",))
@@ -102,7 +102,7 @@ def sampling_id(ctx, op, ins):
     x = ins["X"][0]
     key = ctx.rng_for(op)
     ids = jax.random.categorical(key, jnp.log(x + 1e-20), axis=-1)
-    return {"Out": ids.astype(_I64)}
+    return {"Out": ids.astype(_I64())}
 
 
 @register_op("random_crop", grad=None, needs_rng=True)
@@ -223,7 +223,7 @@ def edit_distance(ctx, op, ins):
         return row[rl]
 
     dist = jax.vmap(one)(hyp, ref, hlen, rlen).astype(jnp.float32)
-    seq_num = jnp.asarray(hyp.shape[0], _I64).reshape(1)
+    seq_num = jnp.asarray(hyp.shape[0], _I64()).reshape(1)
     if op.attr("normalized", True):
         dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
     return {"Out": dist.reshape(-1, 1), "SequenceNum": seq_num}
@@ -251,8 +251,8 @@ def ctc_align(ctx, op, ins):
     gathered = jnp.take_along_axis(x, order, axis=1)
     new_len = jnp.sum(keep, axis=1)
     out = jnp.where(jnp.arange(T)[None, :] < new_len[:, None], gathered, 0)
-    return {"Output": out.astype(_I64),
-            "OutputLength": new_len.reshape(-1, 1).astype(_I64)}
+    return {"Output": out.astype(_I64()),
+            "OutputLength": new_len.reshape(-1, 1).astype(_I64())}
 
 
 @register_op("rank_attention", diff_inputs=("X", "RankParam"))

@@ -18,7 +18,7 @@ from jax import lax
 from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 # ---------------------------------------------------------------------------
@@ -110,7 +110,7 @@ def max_pool2d_with_index(ctx, op, ins):
     out, mask = _max_pool_with_index(
         x, op.attr("ksize"), op.attr("strides", [1, 1]),
         op.attr("paddings", [0, 0]))
-    return {"Out": out, "Mask": mask.astype(_I64)}
+    return {"Out": out, "Mask": mask.astype(_I64())}
 
 
 @register_op("max_pool3d_with_index", diff_inputs=("X",))
@@ -119,7 +119,7 @@ def max_pool3d_with_index(ctx, op, ins):
     out, mask = _max_pool_with_index(
         x, op.attr("ksize"), op.attr("strides", [1, 1, 1]),
         op.attr("paddings", [0, 0, 0]))
-    return {"Out": out, "Mask": mask.astype(_I64)}
+    return {"Out": out, "Mask": mask.astype(_I64())}
 
 
 @register_op("unpool", diff_inputs=("X",))

@@ -40,6 +40,152 @@ def quant_dequant(x, scale, bits):
     return _ste(x, q)
 
 
+def _quant_only(x, scale, bits):
+    """round(clip(x,-s,s)/s * range) — quantized integer levels stored as
+    float (fake_quantize_op.h ClipAndFakeQuantFunctor)."""
+    qrange = float((1 << (bits - 1)) - 1)
+    scale = jnp.maximum(scale, 1e-9)
+    return jnp.round(jnp.clip(x, -scale, scale) / scale * qrange)
+
+
+@register_op("fake_quantize_abs_max", grad=None)
+def fake_quantize_abs_max(ctx, op, ins):
+    """fake_quantize_op.cc:499 FakeQuantizeAbsMaxOp (EmptyGradOpMaker —
+    QAT passes pair this with a dequantize op; no grad of its own)."""
+    x = ins["X"][0]
+    bits = int(op.attr("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _quant_only(x, scale, bits), "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", grad=None)
+def fake_channel_wise_quantize_abs_max(ctx, op, ins):
+    """fake_quantize_op.cc:535 — per-output-channel (axis 0) scales."""
+    x = ins["X"][0]
+    bits = int(op.attr("bit_length", 8))
+    red = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    return {"Out": _quant_only(x, scale, bits), "OutScale": scale.reshape(-1)}
+
+
+@register_op("fake_quantize_range_abs_max", grad=None)
+def fake_quantize_range_abs_max(ctx, op, ins):
+    """fake_quantize_op.cc:507 FakeQuantizeRangeAbsMaxOp: sliding-window
+    abs-max scale. The reference's data-dependent "recompute window max only
+    when the evicted entry was the max" (FindRangeAbsMaxFunctor) becomes a
+    branch-free lax.select over the static window buffer — same result,
+    XLA-friendly.
+    """
+    x = ins["X"][0]
+    bits = int(op.attr("bit_length", 8))
+    window = int(op.attr("window_size", 10000))
+    in_scale = ins["InScale"][0].reshape(())
+    is_test = bool(op.attr("is_test", False)) or ctx.is_test
+    if is_test:
+        return {"Out": _quant_only(x, in_scale, bits),
+                "OutScale": in_scale.reshape(1)}
+    cur = jnp.max(jnp.abs(x))
+    it = (ins["Iter"][0].reshape(()).astype(jnp.int32)
+          if ins.get("Iter") else jnp.asarray(0, jnp.int32))
+    # OutScales is an in-out window buffer (an output-only slot in the
+    # reference op); read its current value from the environment
+    scales_names = op.outputs.get("OutScales") or []
+    if scales_names and scales_names[0] in ctx.env:
+        scales = ctx.env[scales_names[0]]
+    else:
+        scales = jnp.zeros((window,), x.dtype)
+    idx = jnp.mod(it, window)
+    removed = scales[idx]
+    scales = scales.at[idx].set(cur)
+    # valid prefix of the ring buffer: min(it, window) entries (+ the fresh
+    # write, which jnp.maximum(cur, ...) below always counts)
+    size = jnp.minimum(it, window)
+    mask = jnp.arange(window) < size
+    window_max = jnp.max(jnp.where(mask, jnp.abs(scales), 0.0))
+    last = in_scale
+    recompute = jnp.abs(removed - last) < 1e-6
+    scale = jnp.where(last < cur, cur,
+                      jnp.where(recompute, jnp.maximum(window_max, cur), last))
+    return {"Out": _quant_only(x, scale, bits),
+            "OutScale": scale.reshape(1), "OutScales": scales}
+
+
+@register_op("fake_quantize_moving_average_abs_max", grad=None)
+def fake_quantize_moving_average_abs_max(ctx, op, ins):
+    """fake_quantize_op.cc:515 — moving-average scale, quantize only."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0].reshape(())
+    bits = int(op.attr("bit_length", 8))
+    rho = float(op.attr("moving_rate", 0.9))
+    is_test = bool(op.attr("is_test", False)) or ctx.is_test
+    if is_test:
+        return {"Out": _quant_only(x, in_scale, bits),
+                "OutScale": in_scale.reshape(1)}
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else in_scale
+    state = ins["InState"][0].reshape(()) if ins.get("InState") else \
+        jnp.asarray(1.0, jnp.float32)
+    state_new = rho * state + 1.0
+    accum_new = rho * accum + jnp.max(jnp.abs(x))
+    scale = accum_new / state_new
+    return {"Out": _quant_only(x, scale, bits),
+            "OutScale": scale.reshape(1),
+            "OutAccum": accum_new.reshape(1),
+            "OutState": state_new.reshape(1)}
+
+
+@register_op("moving_average_abs_max_scale", diff_inputs=("X",))
+def moving_average_abs_max_scale(ctx, op, ins):
+    """fake_quantize_op.cc:543 MovingAverageAbsMaxScaleOp — scale
+    observation only: Out = X, OutScale tracks the moving-average abs-max
+    (quantization_pass.py:1481 inserts it after quantizable outputs)."""
+    x = ins["X"][0]
+    rho = float(op.attr("moving_rate", 0.9))
+    is_test = bool(op.attr("is_test", False)) or ctx.is_test
+    if is_test:
+        in_accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else \
+            jnp.asarray(1.0, jnp.float32)
+        in_state = ins["InState"][0].reshape(()) if ins.get("InState") else \
+            jnp.asarray(1.0, jnp.float32)
+        return {"Out": x, "OutScale": (in_accum / in_state).reshape(1)}
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else \
+        jnp.asarray(0.0, jnp.float32)
+    state = ins["InState"][0].reshape(()) if ins.get("InState") else \
+        jnp.asarray(0.0, jnp.float32)
+    state_new = rho * state + 1.0
+    accum_new = rho * accum + jnp.max(jnp.abs(x))
+    return {"Out": x, "OutScale": (accum_new / state_new).reshape(1),
+            "OutAccum": accum_new.reshape(1),
+            "OutState": state_new.reshape(1)}
+
+
+@register_op("fake_dequantize_max_abs", diff_inputs=("X",))
+def fake_dequantize_max_abs(ctx, op, ins):
+    """fake_dequantize_op.cc:182 — Out = Scale * X / max_range."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = float(op.attr("max_range", 127.0))
+    return {"Out": x * scale / max_range}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs", diff_inputs=("X",))
+def fake_channel_wise_dequantize_max_abs(ctx, op, ins):
+    """fake_dequantize_op.cc:191 ChannelDequantizeFunctor — one scale set
+    (per-channel weights, axis 0) or two (weight scales per channel on axis
+    1 + a whole-tensor activation scale)."""
+    x = ins["X"][0]
+    scales = ins["Scales"]
+    bits = [int(b) for b in (op.attr("quant_bits", [8]) or [8])]
+    max_range = 1.0
+    for b in bits[:len(scales)]:
+        max_range *= float((1 << (b - 1)) - 1)
+    if len(scales) == 1:
+        s = scales[0].reshape((-1,) + (1,) * (x.ndim - 1))
+        return {"Out": x * s / max_range}
+    s1 = scales[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+    s2 = scales[1].reshape(())
+    return {"Out": x * s1 * s2 / max_range}
+
+
 @register_op("fake_quantize_dequantize_abs_max", diff_inputs=("X",))
 def fake_quantize_dequantize_abs_max(ctx, op, ins):
     x = ins["X"][0]

@@ -17,7 +17,7 @@ from jax import lax
 from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 @register_op("sequence_mask", grad=None)
@@ -138,7 +138,7 @@ def sequence_pad(ctx, op, ins):
     t = jnp.arange(T)[None, :].reshape((1, T) + (1,) * (x.ndim - 2))
     valid = t < length.reshape((B,) + (1,) * (x.ndim - 1))
     out = jnp.where(valid, x, pad_value.astype(x.dtype))
-    return {"Out": out, "Length": length.astype(_I64)}
+    return {"Out": out, "Length": length.astype(_I64())}
 
 
 @register_op("sequence_unpad", diff_inputs=("X",))

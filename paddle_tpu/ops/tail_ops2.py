@@ -1,0 +1,343 @@
+"""Op tail, batch 2 — inference-graph and slim-int8 kernels closing the
+REGISTER_OPERATOR name diff: fc, fused_batch_norm_act,
+fused_fc_elementwise_layernorm, fusion_transpose_flatten_concat,
+fusion_seqpool_cvm_concat, dequantize_abs_max, dequantize_log,
+lookup_table_dequant, fill_zeros_like2, fake_init, seed; host ops
+delete_var, get_places, locality_aware_nms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.core import dtype_to_jax
+from ..framework.executor import register_host_op
+from ..framework.registry import register_op, get_op_spec
+
+
+@register_op("fc", diff_inputs=("Input", "W", "Bias"))
+def fc(ctx, op, ins):
+    """operators/fc_op.cc — the fused inference-graph fc (the training
+    graph uses mul+elementwise_add; fuse passes rewrite to this)."""
+    x, w = ins["Input"][0], ins["W"][0]
+    ncol = int(op.attr("in_num_col_dims", 1))
+    act = str(op.attr("activation_type", "") or "")
+    lead = x.shape[:ncol]
+    x2 = x.reshape(int(np.prod(lead)), -1)
+    out = x2 @ w
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1)
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act:
+        raise NotImplementedError(f"fc activation {act!r}")
+    return {"Out": out.reshape(tuple(lead) + (w.shape[1],))}
+
+
+@register_op("fused_batch_norm_act", diff_inputs=("X", "Scale", "Bias"))
+def fused_batch_norm_act(ctx, op, ins):
+    """operators/fused/fused_bn_activation_op.cc — batch_norm + activation
+    in one op (cuDNN-fused in the reference; XLA fuses the composition)."""
+    outs = get_op_spec("batch_norm").lower(ctx, op, ins)
+    act = str(op.attr("act_type", "relu"))
+    y = outs.get("Y")
+    if act == "relu":
+        y = jax.nn.relu(y)
+    elif act in ("sigmoid", "tanh"):
+        y = jax.nn.sigmoid(y) if act == "sigmoid" else jnp.tanh(y)
+    outs["Y"] = y
+    return outs
+
+
+@register_op("fused_fc_elementwise_layernorm",
+             diff_inputs=("X", "W", "Bias0", "Y", "Scale", "Bias1"))
+def fused_fc_elementwise_layernorm(ctx, op, ins):
+    """operators/fused/fused_fc_elementwise_layernorm_op.cc —
+    layer_norm(fc(X, W, Bias0) + Y) with affine Scale/Bias1."""
+    x, w = ins["X"][0], ins["W"][0]
+    ncol = int(op.attr("x_num_col_dims", 1))
+    eps = float(op.attr("epsilon", 1e-5))
+    begin = int(op.attr("begin_norm_axis", 1))
+    lead = x.shape[:ncol]
+    out = x.reshape(int(np.prod(lead)), -1) @ w
+    if ins.get("Bias0"):
+        out = out + ins["Bias0"][0].reshape(1, -1)
+    act = str(op.attr("activation_type", "") or "")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    out = out.reshape(tuple(lead) + (w.shape[1],))
+    y = ins["Y"][0]
+    z = out + y
+    shape = z.shape
+    z2 = z.reshape(int(np.prod(shape[:begin])), -1)
+    mean = jnp.mean(z2, axis=1, keepdims=True)
+    var = jnp.var(z2, axis=1, keepdims=True)
+    norm = (z2 - mean) * lax.rsqrt(var + eps)
+    if ins.get("Scale"):
+        norm = norm * ins["Scale"][0].reshape(1, -1)
+    if ins.get("Bias1"):
+        norm = norm + ins["Bias1"][0].reshape(1, -1)
+    return {"Out": norm.reshape(shape), "Mean": mean.reshape(-1),
+            "Variance": var.reshape(-1)}
+
+
+@register_op("fusion_transpose_flatten_concat", diff_inputs=("X",))
+def fusion_transpose_flatten_concat(ctx, op, ins):
+    """operators/fused/fusion_transpose_flatten_concat_op.cc — per input:
+    transpose(trans_axis) -> flatten2(flatten_axis), then concat."""
+    trans = [int(a) for a in op.attr("trans_axis", [])]
+    flat_axis = int(op.attr("flatten_axis", 1))
+    concat_axis = int(op.attr("concat_axis", 1))
+    pieces = []
+    for x in ins["X"]:
+        t = jnp.transpose(x, trans) if trans else x
+        lead = int(np.prod(t.shape[:flat_axis]))
+        pieces.append(t.reshape(lead, -1))
+    return {"Out": jnp.concatenate(pieces, axis=concat_axis)}
+
+
+@register_op("fusion_seqpool_cvm_concat", diff_inputs=("X",))
+def fusion_seqpool_cvm_concat(ctx, op, ins):
+    """operators/fused/fusion_seqpool_cvm_concat_op.cc — per input sequence
+    sum-pool, CVM transform, concat (CTR serving path). Padded [B,T,D]
+    inputs; CVM keeps width (use_cvm=True layout: cols 0,1 are show/click
+    -> log transforms, ops/ctr.py cvm)."""
+    pool = str(op.attr("pooltype", "SUM"))
+    use_cvm = bool(op.attr("use_cvm", True))
+    cvm_spec = get_op_spec("cvm")
+    pieces = []
+    for x in ins["X"]:
+        p = jnp.sum(x, axis=1) if x.ndim == 3 else x
+        if pool == "AVERAGE" and x.ndim == 3:
+            p = p / x.shape[1]
+        if use_cvm:
+            p = cvm_spec.lower(ctx, op, {"X": [p], "CVM": ins.get("CVM")}
+                               )["Y"]
+        pieces.append(p)
+    return {"Out": jnp.concatenate(pieces, axis=1)}
+
+
+# ---------------------------------------------------------------------------
+# slim int8 persistence kernels
+# ---------------------------------------------------------------------------
+
+@register_op("dequantize_abs_max", grad=None)
+def dequantize_abs_max(ctx, op, ins):
+    """operators/dequantize_abs_max_op.cc — int8 codes * scale/max_range."""
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = float(op.attr("max_range", 127.0))
+    return {"Out": x.astype(jnp.float32) * scale / max_range}
+
+
+@register_op("dequantize_log", grad=None)
+def dequantize_log(ctx, op, ins):
+    """operators/dequantize_log_op.cc:84 — signed log-table lookup:
+    out = x < 0 ? -dict[x+128] : dict[x]."""
+    x = ins["X"][0].astype(jnp.int32)
+    table = ins["Dict"][0].reshape(-1)
+    return {"Out": jnp.where(x < 0, -table[x + 128], table[x])}
+
+
+@register_op("lookup_table_dequant", grad=None)
+def lookup_table_dequant(ctx, op, ins):
+    """operators/lookup_table_dequant_op.h:40 — embedding rows stored as
+    [min, max, uint8x4 codes...] float32; dequant x = (max-min)/256*code
+    + min. bitcast float32->uint8x4 replaces the reference's pointer
+    reinterpret."""
+    ids = ins["Ids"][0]
+    w = ins["W"][0]
+    padding_idx = int(op.attr("padding_idx", -1))
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    idx = ids.astype(jnp.int32)
+    rows = w[jnp.clip(idx, 0, w.shape[0] - 1)]          # [..., Q]
+    mn = rows[..., 0:1]
+    mx = rows[..., 1:2]
+    codes = lax.bitcast_convert_type(rows[..., 2:], jnp.uint8)
+    codes = codes.reshape(codes.shape[:-2] + (-1,)).astype(jnp.float32)
+    out = (mx - mn) / 256.0 * codes + mn
+    if padding_idx >= 0:
+        out = jnp.where((idx == padding_idx)[..., None], 0.0, out)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# trivial program-parity kernels
+# ---------------------------------------------------------------------------
+
+@register_op("fill_zeros_like2", grad=None)
+def fill_zeros_like2(ctx, op, ins):
+    """operators/fill_zeros_like_op.cc (variant with dtype attr)."""
+    dt = dtype_to_jax(op.attr("dtype", 5))
+    return {"Out": jnp.zeros(ins["X"][0].shape, dt)}
+
+
+@register_op("fake_init", grad=None)
+def fake_init(ctx, op, ins):
+    """operators/fill_constant_op.cc sibling fake_init_op.cc — placeholder
+    init on PS trainers (the server owns the real values)."""
+    shape = [int(s) for s in op.attr("shape", [1])]
+    dt = dtype_to_jax(op.attr("dtype", 5))
+    return {"Out": jnp.zeros(shape, dt)}
+
+
+@register_op("seed", grad=None, needs_rng=True)
+def seed_op(ctx, op, ins):
+    """operators/seed_op.cc — emit an int32 seed (attr if nonzero, else a
+    fresh draw from the program rng stream)."""
+    s = int(op.attr("seed", 0))
+    if s != 0:
+        return {"Out": jnp.asarray([s], jnp.int32)}
+    key = ctx.rng_for(op)
+    return {"Out": jax.random.randint(key, (1,), 1, 2 ** 31 - 1,
+                                      dtype=jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# host ops
+# ---------------------------------------------------------------------------
+
+@register_host_op("delete_var")
+def delete_var(scope, op, exe):
+    """controlflow/op_variant.h delete_var_op — drop vars from the scope."""
+    for name in op.input("X"):
+        if hasattr(scope, "erase_var"):
+            scope.erase_var(name)
+        else:
+            v = scope.find_var(name)
+            if v is not None:
+                scope.set_var(name, None)
+
+
+@register_host_op("get_places")
+def get_places(scope, op, exe):
+    """operators/get_places_op.cc — device-count introspection (ParallelDo
+    era); emits the visible device count."""
+    import jax
+
+    n = int(op.attr("device_count", 0)) or len(jax.devices())
+    scope.set_var(op.output("Out")[0], np.asarray([n], np.int64))
+
+
+@register_host_op("locality_aware_nms")
+def locality_aware_nms(scope, op, exe):
+    """detection/locality_aware_nms_op.cc — multiclass NMS that first
+    fuses same-class overlapping detections (score-weighted box average),
+    as used by EAST-style text detection."""
+    boxes = np.asarray(scope.find_var(op.input("BBoxes")[0]))    # [N,M,4]
+    scores = np.asarray(scope.find_var(op.input("Scores")[0]))   # [N,C,M]
+    score_thresh = float(op.attr("score_threshold", 0.0))
+    nms_top_k = int(op.attr("nms_top_k", -1))
+    keep_top_k = int(op.attr("keep_top_k", -1))
+    iou_thr = float(op.attr("nms_threshold", 0.3))
+    background = int(op.attr("background_label", -1))
+
+    def iou(a, b):
+        x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+        x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    outs = []
+    for n in range(boxes.shape[0]):
+        dets = []
+        for cls in range(scores.shape[1]):
+            if cls == background:
+                continue
+            s = scores[n, cls]
+            idx = np.nonzero(s > score_thresh)[0]
+            if idx.size == 0:
+                continue
+            order = idx[np.argsort(-s[idx])]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            cand = [[s[i], boxes[n, i].astype(np.float64).copy()]
+                    for i in order]
+            # locality-aware merge: weighted-average consecutive overlaps
+            merged = []
+            for sc, box in cand:
+                if merged and iou(merged[-1][1], box) > iou_thr:
+                    psc, pbox = merged[-1]
+                    tot = psc + sc
+                    merged[-1] = [tot, (pbox * psc + box * sc) / tot] \
+                        if tot > 0 else [tot, pbox]
+                else:
+                    merged.append([sc, box])
+            merged.sort(key=lambda d: -d[0])
+            keep = []
+            for sc, box in merged:
+                if all(iou(box, kb) <= iou_thr for _, kb in keep):
+                    keep.append((sc, box))
+            for sc, box in keep:
+                dets.append([float(cls), float(sc), *box.tolist()])
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        outs.extend(dets)
+    import jax.numpy as jnp_
+
+    out = (np.asarray(outs, np.float32) if outs
+           else np.zeros((0, 6), np.float32))
+    scope.set_var(op.output("Out")[0], jnp_.asarray(out))
+
+
+# hierarchical_sigmoid_op.cc registers this full name; the layer-emitted
+# short form "hsigmoid" shares the lowering
+from .control_flow import _alias_op  # noqa: E402
+
+_alias_op("hierarchical_sigmoid", "hsigmoid",
+          diff_inputs=("X", "W", "Bias"))
+
+
+@register_op("conv2d_fusion", diff_inputs=("Input", "Filter", "Bias"))
+def conv2d_fusion(ctx, op, ins):
+    """fused/conv2d_fusion_op.cc (cuDNN fused conv+bias+act+residual in
+    the reference's inference graphs) — conv2d lowering + epilogue; XLA
+    re-fuses the epilogue into the conv."""
+    outs = get_op_spec("conv2d").lower(ctx, op, ins)
+    out = outs["Output"]
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+    if ins.get("ResidualData"):
+        out = out + ins["ResidualData"][0]
+    act = str(op.attr("activation", "relu") or "identity")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act not in ("identity", ""):
+        raise NotImplementedError(f"conv2d_fusion activation {act!r}")
+    return {"Output": out}
+
+
+@register_host_op("feed")
+def feed_op(scope, op, exe):
+    """operators/feed_op.cc — move feed-holder column `col` into the out
+    var. The executor's feed dict usually binds out vars directly; this
+    shim makes persisted programs with explicit feed ops runnable."""
+    out = op.output("Out")[0]
+    if scope.find_var(out) is not None:
+        return                              # already fed by name
+    holder = scope.find_var(op.input("X")[0])
+    if holder is None:
+        raise RuntimeError(f"feed op: neither {out!r} nor the feed holder "
+                           "is present in scope")
+    col = int(op.attr("col", 0))
+    scope.set_var(out, holder[col])
+
+
+@register_host_op("fetch")
+def fetch_op(scope, op, exe):
+    """operators/fetch_op.cc — copy the in var into the fetch holder."""
+    x = scope.find_var(op.input("X")[0])
+    holder_name = op.output("Out")[0]
+    holder = scope.find_var(holder_name)
+    col = int(op.attr("col", 0))
+    lst = list(holder) if isinstance(holder, (list, tuple)) else []
+    while len(lst) <= col:
+        lst.append(None)
+    lst[col] = x
+    scope.set_var(holder_name, lst)

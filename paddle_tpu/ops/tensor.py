@@ -15,7 +15,7 @@ from jax import lax
 from ..framework.core import dtype_to_jax, int_index_dtype
 from ..framework.registry import register_op
 
-_I64 = int_index_dtype()
+_I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
 
 def _infer_reshape(block, op):
@@ -300,7 +300,7 @@ def where_index(ctx, op, ins):
     # dynamic-shape op: returns indices of nonzero — static upper bound needed
     # on TPU; provided for CPU/host use (inference utilities).
     cond = ins["Condition"][0]
-    return {"Out": jnp.stack(jnp.nonzero(cond, size=int(np.prod(cond.shape))), axis=1).astype(_I64)}
+    return {"Out": jnp.stack(jnp.nonzero(cond, size=int(np.prod(cond.shape))), axis=1).astype(_I64())}
 
 
 @register_op("cumsum", diff_inputs=("X",))

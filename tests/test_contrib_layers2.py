@@ -1,0 +1,118 @@
+"""fluid.contrib.layers surface — parity with
+python/paddle/fluid/contrib/layers/nn.py:33 __all__. Builds each layer
+into a program and trains/runs it through the Executor."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import contrib
+
+
+def test_contrib_all_names_present():
+    ref_all = [
+        "fused_elemwise_activation", "sequence_topk_avg_pooling",
+        "var_conv_2d", "match_matrix_tensor", "tree_conv",
+        "fused_embedding_seq_pool", "multiclass_nms2",
+        "search_pyramid_hash", "shuffle_batch", "partial_concat",
+        "partial_sum", "tdm_child", "rank_attention", "tdm_sampler",
+        "batch_fc",
+    ]
+    for name in ref_all:
+        assert hasattr(contrib.layers, name), name
+
+
+def test_match_matrix_topk_pooling_trains():
+    """The text-matching composition the ops exist for: match matrix ->
+    top-k column pooling -> fc -> loss decreases."""
+    B, Tl, Tr, D, C = 2, 4, 5, 6, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [Tl, D], dtype="float32")
+        y = fluid.layers.data("y", [Tr, D], dtype="float32")
+        xl = fluid.layers.data("xl", [], dtype="int64")
+        yl = fluid.layers.data("yl", [], dtype="int64")
+        mm, _ = contrib.layers.match_matrix_tensor(
+            x, y, channel_num=C, x_len=xl, y_len=yl)
+        pooled = contrib.layers.sequence_topk_avg_pooling(
+            mm, xl, yl, topks=[1, 2], channel_num=C)
+        feat = fluid.layers.reduce_sum(pooled, dim=1)      # [B, C*2]
+        logits = fluid.layers.fc(feat, 2)
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rs = np.random.RandomState(0)
+    feed = {"x": rs.randn(B, Tl, D).astype("float32"),
+            "y": rs.randn(B, Tr, D).astype("float32"),
+            "xl": np.asarray([4, 2], "int64"),
+            "yl": np.asarray([5, 3], "int64"),
+            "label": np.asarray([[0], [1]], "int64")}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(12)]
+    assert losses[-1] < losses[0]
+
+
+def test_tdm_layers_build_and_run():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [1], dtype="int64")
+        child, mask = contrib.layers.tdm_child(x, node_nums=7, child_nums=2)
+        samples, labels, smask = contrib.layers.tdm_sampler(
+            x, neg_samples_num_list=[1], layer_node_num_list=[3],
+            leaf_node_num=3, output_list=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.asarray([[1], [2]], "int64")},
+                  fetch_list=[child, mask, samples, labels, smask])
+    assert out[0].shape[-1] == 2
+    assert out[2].shape[-1] == 2  # positive + 1 negative
+
+
+def test_fused_elemwise_activation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [4], dtype="float32")
+        out = contrib.layers.fused_elemwise_activation(
+            x, y, ["elementwise_add", "relu"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.asarray([[-1, 2, -3, 4]], "float32")
+    yv = np.asarray([[0.5, -2.5, 1.0, 1.0]], "float32")
+    got = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, xv + np.maximum(yv, 0), rtol=1e-6)
+
+
+def test_fused_embedding_seq_pool():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [3], dtype="int64")
+        out = contrib.layers.fused_embedding_seq_pool(
+            ids, size=[10, 4], padding_idx=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    idv = np.asarray([[1, 2, 0], [3, 0, 0]], "int64")
+    got, = exe.run(main, feed={"ids": idv}, fetch_list=[out])
+    assert got.shape == (2, 4)
+    # padding rows contribute zero: row1 = emb[3] alone
+    w = None
+    for p in main.global_block().all_parameters():
+        w = exe.run(main, feed={"ids": idv}, fetch_list=[p])[0]
+    np.testing.assert_allclose(got[1], w[3], rtol=1e-5)
+
+
+def test_partial_ops_and_batch_fc():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", [4], dtype="float32")
+        b = fluid.layers.data("b", [4], dtype="float32")
+        pc = contrib.layers.partial_concat([a, b], start_index=1, length=2)
+        ps = contrib.layers.partial_sum([a, b], start_index=0, length=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    av = np.arange(8, dtype="float32").reshape(2, 4)
+    bv = av + 10
+    got_pc, got_ps = exe.run(main, feed={"a": av, "b": bv},
+                             fetch_list=[pc, ps])
+    np.testing.assert_allclose(
+        got_pc, np.concatenate([av[:, 1:3], bv[:, 1:3]], 1))
+    np.testing.assert_allclose(got_ps, av[:, :3] + bv[:, :3])

@@ -1,0 +1,198 @@
+"""Op tail batch 2 tests: inference-graph fused ops, slim int8 kernels,
+the recurrent op, and host tail ops."""
+import numpy as np
+
+import paddle_tpu as fluid
+from tests.test_tail_ops import run_op
+
+
+def test_fc_op():
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 4).astype("float32")
+    w = rs.randn(4, 5).astype("float32")
+    b = rs.randn(5).astype("float32")
+    out = run_op("fc", {"Input": x, "W": w, "Bias": b}, ["Out"],
+                 {"in_num_col_dims": 1, "activation_type": "relu"})
+    np.testing.assert_allclose(out["Out"][0],
+                               np.maximum(x @ w + b, 0), rtol=1e-5)
+
+
+def test_fused_fc_elementwise_layernorm():
+    rs = np.random.RandomState(1)
+    x = rs.randn(4, 6).astype("float32")
+    w = rs.randn(6, 8).astype("float32")
+    y = rs.randn(4, 8).astype("float32")
+    scale = rs.rand(8).astype("float32") + 0.5
+    bias1 = rs.randn(8).astype("float32")
+    out = run_op("fused_fc_elementwise_layernorm",
+                 {"X": x, "W": w, "Y": y, "Scale": scale, "Bias1": bias1},
+                 ["Out", "Mean", "Variance"],
+                 {"x_num_col_dims": 1, "begin_norm_axis": 1,
+                  "epsilon": 1e-5})
+    z = x @ w + y
+    mu = z.mean(1, keepdims=True)
+    var = z.var(1, keepdims=True)
+    want = (z - mu) / np.sqrt(var + 1e-5) * scale + bias1
+    np.testing.assert_allclose(out["Out"][0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_transpose_flatten_concat():
+    rs = np.random.RandomState(2)
+    a = rs.randn(2, 3, 4).astype("float32")
+    b = rs.randn(2, 3, 4).astype("float32")
+    out = run_op("fusion_transpose_flatten_concat", {"X": [a, b]}, ["Out"],
+                 {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+                  "concat_axis": 1})
+    want = np.concatenate([a.transpose(0, 2, 1).reshape(2, -1),
+                           b.transpose(0, 2, 1).reshape(2, -1)], axis=1)
+    np.testing.assert_allclose(out["Out"][0], want, rtol=1e-6)
+
+
+def test_fusion_seqpool_cvm_concat():
+    rs = np.random.RandomState(3)
+    a = np.abs(rs.randn(2, 3, 4)).astype("float32")
+    b = np.abs(rs.randn(2, 3, 4)).astype("float32")
+    cvm = np.ones((2, 2), "float32")
+    out = run_op("fusion_seqpool_cvm_concat", {"X": [a, b], "CVM": cvm},
+                 ["Out"], {"pooltype": "SUM", "use_cvm": True})
+    def cvm_t(p):
+        c0 = np.log(p[:, :1] + 1)
+        c1 = np.log(p[:, 1:2] + 1) - c0
+        return np.concatenate([c0, c1, p[:, 2:]], 1)
+    want = np.concatenate([cvm_t(a.sum(1)), cvm_t(b.sum(1))], 1)
+    np.testing.assert_allclose(out["Out"][0], want, rtol=1e-5)
+
+
+def test_dequantize_abs_max():
+    x = np.asarray([[-127, 0, 64]], "int8")
+    out = run_op("dequantize_abs_max",
+                 {"X": x, "Scale": np.asarray([0.5], "float32")}, ["Out"],
+                 {"max_range": 127.0})
+    np.testing.assert_allclose(out["Out"][0],
+                               x.astype("float32") * 0.5 / 127.0, rtol=1e-6)
+
+
+def test_dequantize_log():
+    table = (np.arange(128, dtype="float32") / 10).astype("float32")
+    x = np.asarray([[-128, -1, 0, 5]], "int8")
+    out = run_op("dequantize_log", {"X": x, "Dict": table}, ["Out"], {})
+    want = np.asarray([[-table[0], -table[127], table[0], table[5]]])
+    np.testing.assert_allclose(out["Out"][0], want, rtol=1e-6)
+
+
+def test_lookup_table_dequant():
+    # rows: [min, max, 4 uint8 codes packed in one float32]
+    codes = np.asarray([0, 64, 128, 255], np.uint8)
+    packed = codes.view(np.float32)[0]
+    w = np.asarray([[0.0, 1.0, packed],
+                    [-1.0, 1.0, packed]], "float32")
+    ids = np.asarray([[0], [1]], "int64")
+    out = run_op("lookup_table_dequant", {"Ids": ids, "W": w}, ["Out"],
+                 {"padding_idx": -1})
+    got = out["Out"][0]
+    want0 = (1.0 - 0.0) / 256.0 * codes.astype(np.float32) + 0.0
+    want1 = (1.0 - (-1.0)) / 256.0 * codes.astype(np.float32) - 1.0
+    np.testing.assert_allclose(got[0].reshape(-1), want0, rtol=1e-5)
+    np.testing.assert_allclose(got[1].reshape(-1), want1, rtol=1e-5)
+
+
+def test_fill_zeros_like2_fake_init_seed():
+    x = np.ones((2, 3), "float32")
+    out = run_op("fill_zeros_like2", {"X": x}, ["Out"], {"dtype": 5})
+    np.testing.assert_array_equal(out["Out"][0], np.zeros((2, 3)))
+    out = run_op("fake_init", {}, ["Out"], {"shape": [4], "dtype": 5})
+    np.testing.assert_array_equal(out["Out"][0], np.zeros(4))
+    out = run_op("seed", {}, ["Out"], {"seed": 42})
+    assert int(out["Out"][0][0]) == 42
+
+
+def test_recurrent_op_matches_manual_rnn():
+    """Build a recurrent op with a real step sub-block (h = tanh(x W + h U))
+    and check against the numpy loop — the persisted-program RNN form."""
+    T, B, D, H = 4, 2, 3, 5
+    rs = np.random.RandomState(4)
+    xv = rs.randn(T, B, D).astype("float32")
+    h0v = rs.randn(B, H).astype("float32")
+    wv = rs.randn(D, H).astype("float32")
+    uv = rs.randn(H, H).astype("float32")
+
+    main = fluid.Program()
+    block = main.global_block()
+    for name, v in (("x", xv), ("h0", h0v), ("w", wv), ("u", uv)):
+        block.create_var(name=name, shape=list(v.shape), dtype="float32",
+                         is_data=True)
+    out_v = block.create_var(name="out", shape=[T, B, H], dtype="float32")
+    scopes = block.create_var(name="scopes", shape=[1], dtype="float32")
+    step = main._create_block()  # sub-block
+    # step block computes: h = tanh(x_t @ w + h_pre @ u); reads x (sliced),
+    # h_pre (ex state), writes h (state) and out_step (output)
+    step.create_var(name="xw", shape=[B, H], dtype="float32")
+    step.create_var(name="hu", shape=[B, H], dtype="float32")
+    step.create_var(name="pre_act", shape=[B, H], dtype="float32")
+    step.create_var(name="h", shape=[B, H], dtype="float32")
+    step.append_op(type="matmul", inputs={"X": ["x"], "Y": ["w"]},
+                   outputs={"Out": ["xw"]}, attrs={})
+    step.append_op(type="matmul", inputs={"X": ["h_pre"], "Y": ["u"]},
+                   outputs={"Out": ["hu"]}, attrs={})
+    step.append_op(type="elementwise_add",
+                   inputs={"X": ["xw"], "Y": ["hu"]},
+                   outputs={"Out": ["pre_act"]}, attrs={})
+    step.append_op(type="tanh", inputs={"X": ["pre_act"]},
+                   outputs={"Out": ["h"]}, attrs={})
+    main._rollback()
+    block.append_op(
+        type="recurrent",
+        inputs={"inputs": ["x"], "initial_states": ["h0"],
+                "parameters": ["w", "u"]},
+        outputs={"outputs": ["h"], "step_scopes": ["scopes"]},
+        attrs={"sub_block": step.idx, "ex_states": ["h_pre"],
+               "states": ["h"], "reverse": False, "has_states": True})
+    # NOTE: outputs slot name "h" = the step var stacked over time
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, = exe.run(main, feed={"x": xv, "h0": h0v, "w": wv, "u": uv},
+                   fetch_list=["h"])
+    h = h0v
+    want = []
+    for t in range(T):
+        h = np.tanh(xv[t] @ wv + h @ uv)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_memory_helper_and_reorder():
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+    out = run_op("rnn_memory_helper", {"X": x}, ["Out"], {})
+    np.testing.assert_array_equal(out["Out"][0], x)
+    table = np.asarray([2, 0, 1], "int64")
+    out = run_op("reorder_lod_tensor_by_rank",
+                 {"X": x, "RankTable": table}, ["Out"], {})
+    np.testing.assert_array_equal(out["Out"][0], x[[2, 0, 1]])
+
+
+def test_conditional_block_infer_alias():
+    from paddle_tpu.framework.registry import has_op
+
+    for name in ("conditional_block_infer", "merge_lod_tensor_infer",
+                 "lod_array_length"):
+        assert has_op(name), name
+
+
+def test_locality_aware_nms():
+    boxes = np.asarray([[[0.1, 0.1, 0.5, 0.5],
+                         [0.12, 0.12, 0.52, 0.52],
+                         [0.7, 0.7, 0.9, 0.9]]], "float32")
+    scores = np.asarray([[[0.0, 0.0, 0.0],
+                          [0.9, 0.8, 0.7]]], "float32")  # class 1 only
+    out = run_op("locality_aware_nms",
+                 {"BBoxes": boxes, "Scores": scores}, ["Out"],
+                 {"score_threshold": 0.1, "nms_top_k": 10,
+                  "keep_top_k": 10, "nms_threshold": 0.3,
+                  "background_label": 0})
+    dets = out["Out"][0].reshape(-1, 6)
+    # first two boxes merge (iou > 0.3), third kept separate -> 2 dets
+    assert dets.shape[0] == 2
+    assert dets[0, 0] == 1.0
+    # merged box is the score-weighted average of boxes 0 and 1
+    w = np.asarray([0.9, 0.8])
+    want = (boxes[0, 0] * 0.9 + boxes[0, 1] * 0.8) / 1.7
+    np.testing.assert_allclose(dets[0, 2:], want, rtol=1e-4)

@@ -1,0 +1,170 @@
+"""Generate a save_inference_model artifact in the REFERENCE's exact
+on-disk layout, using an encoder that is fully independent of
+framework/paddle_pb.py:
+
+- the ProgramDesc is built with google.protobuf dynamic messages compiled
+  from the reference's own schema file (framework/framework.proto) by
+  tests/proto_schema.py;
+- param files are LoDTensor streams packed by hand from the reference
+  serialization code (lod_tensor.cc:220 SerializeToStream +
+  tensor_util.cc:385 TensorToStream).
+
+The committed fixture is what the reference's io.py:1093
+save_inference_model would produce for a recognize_digits-style MLP
+(python/paddle/fluid/tests/book/test_recognize_digits.py): feed ->
+mul/elementwise_add/relu -> mul/elementwise_add -> softmax -> fetch.
+tests/test_reference_artifact.py proves paddle_tpu loads and runs it
+unmodified.
+
+Regenerate: python tools/make_reference_fixture.py
+"""
+from __future__ import annotations
+
+import os
+import struct
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+from proto_schema import load_messages  # noqa: E402
+
+PROTO_PATH = "/root/reference/paddle/fluid/framework/framework.proto"
+OUT_DIR = os.path.join(ROOT, "tests", "fixtures", "ref_recognize_digits")
+
+# proto::VarType::Type values (framework.proto:91)
+FP32, INT64 = 5, 3
+LOD_TENSOR, FEED_MINIBATCH, FETCH_LIST = 7, 9, 10
+# AttrType (framework.proto:27)
+A_INT, A_FLOAT, A_STRING, A_BOOL, A_LONG = 0, 1, 2, 6, 9
+
+
+def lod_tensor_stream(arr: np.ndarray, schema) -> bytes:
+    """lod_tensor.cc:220 + tensor_util.cc:385, packed by hand."""
+    out = struct.pack("<I", 0)                      # LoDTensor version
+    out += struct.pack("<Q", 0)                     # lod_level = 0
+    out += struct.pack("<I", 0)                     # Tensor version
+    desc = schema["VarType"].TensorDesc()
+    desc.data_type = {np.dtype("float32"): FP32,
+                      np.dtype("int64"): INT64}[arr.dtype]
+    desc.dims.extend(list(arr.shape))
+    blob = desc.SerializeToString()
+    out += struct.pack("<i", len(blob)) + blob
+    out += arr.tobytes()                            # raw row-major data
+    return out
+
+
+def main():
+    schema = load_messages(PROTO_PATH, pool_suffix="fixture")
+    prog = schema["ProgramDesc"]()
+    block = prog.blocks.add()
+    block.idx = 0
+    block.parent_idx = -1
+
+    def var(name, vtype, dims=None, dtype=FP32, persistable=False):
+        v = block.vars.add()
+        v.name = name
+        v.type.type = vtype
+        if vtype == LOD_TENSOR:
+            v.type.lod_tensor.tensor.data_type = dtype
+            v.type.lod_tensor.tensor.dims.extend(dims or [])
+        v.persistable = persistable
+        return v
+
+    def op(type_, inputs, outputs, attrs=()):
+        o = block.ops.add()
+        o.type = type_
+        for slot, args in inputs:
+            iv = o.inputs.add()
+            iv.parameter = slot
+            iv.arguments.extend(args)
+        for slot, args in outputs:
+            ov = o.outputs.add()
+            ov.parameter = slot
+            ov.arguments.extend(args)
+        for name, atype, val in attrs:
+            a = o.attrs.add()
+            a.name = name
+            a.type = atype
+            if atype == A_INT:
+                a.i = val
+            elif atype == A_FLOAT:
+                a.f = val
+            elif atype == A_STRING:
+                a.s = val
+            elif atype == A_BOOL:
+                a.b = val
+            elif atype == A_LONG:
+                a.l = val
+        return o
+
+    rs = np.random.RandomState(1234)
+    params = {
+        "fc_0.w_0": rs.randn(784, 64).astype("float32") * 0.05,
+        "fc_0.b_0": rs.randn(64).astype("float32") * 0.05,
+        "fc_1.w_0": rs.randn(64, 10).astype("float32") * 0.05,
+        "fc_1.b_0": rs.randn(10).astype("float32") * 0.05,
+    }
+
+    var("feed", FEED_MINIBATCH, persistable=True)
+    var("fetch", FETCH_LIST, persistable=True)
+    var("img", LOD_TENSOR, [-1, 784])
+    for name, arr in params.items():
+        var(name, LOD_TENSOR, list(arr.shape), persistable=True)
+    for name in ("fc_0.tmp_0", "fc_0.tmp_1", "fc_0.tmp_2",
+                 "fc_1.tmp_0", "fc_1.tmp_1", "softmax_0.tmp_0"):
+        var(name, LOD_TENSOR, [-1, 10])
+
+    op("feed", [("X", ["feed"])], [("Out", ["img"])],
+       [("col", A_INT, 0)])
+    op("mul", [("X", ["img"]), ("Y", ["fc_0.w_0"])],
+       [("Out", ["fc_0.tmp_0"])],
+       [("x_num_col_dims", A_INT, 1), ("y_num_col_dims", A_INT, 1)])
+    op("elementwise_add",
+       [("X", ["fc_0.tmp_0"]), ("Y", ["fc_0.b_0"])],
+       [("Out", ["fc_0.tmp_1"])], [("axis", A_INT, 1)])
+    op("relu", [("X", ["fc_0.tmp_1"])], [("Out", ["fc_0.tmp_2"])])
+    op("mul", [("X", ["fc_0.tmp_2"]), ("Y", ["fc_1.w_0"])],
+       [("Out", ["fc_1.tmp_0"])],
+       [("x_num_col_dims", A_INT, 1), ("y_num_col_dims", A_INT, 1)])
+    op("elementwise_add",
+       [("X", ["fc_1.tmp_0"]), ("Y", ["fc_1.b_0"])],
+       [("Out", ["fc_1.tmp_1"])], [("axis", A_INT, 1)])
+    op("softmax", [("X", ["fc_1.tmp_1"])], [("Out", ["softmax_0.tmp_0"])],
+       [("axis", A_INT, -1)])
+    op("fetch", [("X", ["softmax_0.tmp_0"])], [("Out", ["fetch"])],
+       [("col", A_INT, 0)])
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, "__model__"), "wb") as f:
+        f.write(prog.SerializeToString())
+    for name, arr in params.items():
+        with open(os.path.join(OUT_DIR, name), "wb") as f:
+            f.write(lod_tensor_stream(arr, schema))
+
+    # combined-params variant (params_filename path): one stream per var,
+    # concatenated in PROGRAM VAR ORDER (reference io.py save_vars iterates
+    # list_vars() unsorted)
+    comb_dir = OUT_DIR + "_combined"
+    os.makedirs(comb_dir, exist_ok=True)
+    with open(os.path.join(comb_dir, "__model__"), "wb") as f:
+        f.write(prog.SerializeToString())
+    with open(os.path.join(comb_dir, "__params__"), "wb") as f:
+        for name in params:                  # insertion = program var order
+            f.write(lod_tensor_stream(params[name], schema))
+
+    # expected forward outputs for the test
+    x = np.random.RandomState(7).rand(4, 784).astype("float32")
+    h = np.maximum(x @ params["fc_0.w_0"] + params["fc_0.b_0"], 0)
+    logits = h @ params["fc_1.w_0"] + params["fc_1.b_0"]
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    probs = e / e.sum(1, keepdims=True)
+    np.savez(os.path.join(OUT_DIR, "expected.npz"), x=x, probs=probs)
+    print("wrote", OUT_DIR, "and", comb_dir)
+
+
+if __name__ == "__main__":
+    main()
