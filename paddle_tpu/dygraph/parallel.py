@@ -84,14 +84,15 @@ class DataParallel(Layer):
         return apply_op(lambda l: l / self._nranks, loss)
 
     def apply_collective_grads(self):
-        """Allreduce grads across processes. With jax.distributed multi-process
-        on TPU, per-process arrays are already globally addressable; here we
-        mean-reduce leaf grads via a tiny pmapped psum when nranks>1."""
+        """Allreduce-SUM grads across processes (reference
+        DataParallel.apply_collective_grads, imperative/all_reduce.cc):
+        paired with scale_loss's 1/nranks this yields exactly the
+        full-global-batch gradient."""
         if self._nranks <= 1:
             return
         for p in self._layers.parameters():
             if p._grad is not None:
-                p._grad = _cross_process_mean(p._grad)
+                p._grad = _cross_process_sum(p._grad)
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)
@@ -103,9 +104,21 @@ class DataParallel(Layer):
         return self._layers.set_dict(*args, **kwargs)
 
 
-def _cross_process_mean(x):
-    # single-host fallback: identity; multi-process uses psum over 'dp'
+def _psum_impl(v):
+    return jax.lax.psum(v, "i")
+
+
+# module-level so jax.pmap's function-identity cache hits: one compile per
+# gradient shape, not one per call
+_PSUM = jax.pmap(_psum_impl, axis_name="i")
+
+
+def _cross_process_sum(x):
+    # single-host fallback: identity; multi-process: psum across the global
+    # device axis. Replicating onto n_local local devices would multiply
+    # this process's contribution, so pre-divide by n_local.
     if jax.process_count() == 1:
         return x
-    fn = jax.pmap(lambda v: jax.lax.psum(v, "i") / jax.device_count(), axis_name="i")
-    return fn(x[None])[0]
+    n_local = jax.local_device_count()
+    out = _PSUM(jnp.broadcast_to(x, (n_local,) + x.shape) / n_local)
+    return out[0]

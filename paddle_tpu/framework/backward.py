@@ -190,6 +190,15 @@ def append_backward(
         # transform is _RecomputePlan below, not an executor-side consumer
         program._annotations["recompute_checkpoints"] = list(ckpt_names)
 
+    # everything appended from here is the backward slice
+    # (clone(for_test=True) strips it by this role tag)
+    with program.op_role_guard(Program.OP_ROLE_BACKWARD):
+        return _append_backward_tagged(loss, block, program, requires,
+                                       no_grad, ckpt_names, parameter_list)
+
+
+def _append_backward_tagged(loss, block, program, requires, no_grad,
+                            ckpt_names, parameter_list):
     # seed: d loss / d loss = 1
     loss_grad_name = loss.name + GRAD_SUFFIX
     block.create_var(

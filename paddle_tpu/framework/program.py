@@ -174,6 +174,13 @@ class Operator:
         self.inputs: Dict[str, List[str]] = _normalize_io(inputs)
         self.outputs: Dict[str, List[str]] = _normalize_io(outputs)
         self.attrs: Dict[str, Any] = dict(attrs or {})
+        # op_role stamped at creation so EVERY insertion path (append_op,
+        # _insert_op, _prepend_op, transpilers) shares it; deserialization
+        # keeps the persisted role (already present in attrs)
+        role = getattr(getattr(block, "program", None),
+                       "_current_op_role", 0)
+        if role and "op_role" not in self.attrs:
+            self.attrs["op_role"] = role
 
     # -- accessors ----------------------------------------------------------
     def input(self, slot) -> List[str]:
@@ -344,6 +351,13 @@ class Block:
 class Program:
     """A whole program: a tree of Blocks — reference framework.py:3852."""
 
+    # OpRole values — wire parity with framework.proto OpRole / the
+    # reference's op_role attr (op_proto_maker.h:27)
+    OP_ROLE_FORWARD = 0
+    OP_ROLE_BACKWARD = 1
+    OP_ROLE_OPTIMIZE = 2
+    OP_ROLE_LRSCHED = 16
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
@@ -354,6 +368,19 @@ class Program:
         self._pass_applied = []
         # distributed annotations (filled by fleet/transpilers)
         self._annotations: Dict[str, Any] = {}
+        self._current_op_role = Program.OP_ROLE_FORWARD
+
+    @contextlib.contextmanager
+    def op_role_guard(self, role: int):
+        """Ops appended inside the guard carry attrs['op_role'] = role
+        (reference program._optimized_guard / _backward_role_guard) —
+        clone(for_test=True) strips non-forward roles."""
+        prev = self._current_op_role
+        self._current_op_role = role
+        try:
+            yield
+        finally:
+            self._current_op_role = prev
 
     # -- block management ---------------------------------------------------
     def global_block(self) -> Block:
@@ -430,6 +457,10 @@ class Program:
                 nb.vars[nv.name] = nv
             for op in blk.ops:
                 if for_test and op.attr("is_test_skip", False):
+                    continue
+                # drop backward/optimize/lr-sched ops — reference
+                # clone(for_test=True) keeps only the forward slice
+                if for_test and int(op.attr("op_role", 0) or 0) != 0:
                     continue
                 nop = Operator(
                     nb,

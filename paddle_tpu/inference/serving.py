@@ -1,0 +1,123 @@
+"""Minimal model server over an export_stablehlo artifact — the serving
+shell the reference exposes through its C API + demo servers
+(paddle/fluid/inference/capi/pd_predictor.cc, demo_ci/). TPU-native
+deployment artifact = serialized StableHLO (jax.export), so the server is
+a ~100-line stdlib HTTP host with zero framework dependency at request
+time.
+
+Protocol (JSON):
+    GET  /health            -> {"status": "ok", "inputs": [...], ...}
+    POST /predict           body {"inputs": {name: nested-list, ...}}
+                            -> {"outputs": [nested-list, ...]}
+
+Run:  python -m paddle_tpu.inference.serving --model-dir DIR --port 8866
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["ModelServer", "serve"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet by default
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path != "/health":
+            return self._json(404, {"error": "unknown path"})
+        pred = self.server.predictor
+        self._json(200, {"status": "ok",
+                         "inputs": pred.get_input_names(),
+                         "outputs": pred.get_output_names()})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            return self._json(404, {"error": "unknown path"})
+        n = int(self.headers.get("Content-Length", 0))
+        if n > self.server.max_body_bytes:
+            return self._json(413, {"error": "body too large"})
+        try:
+            req = json.loads(self.rfile.read(n).decode())
+            feed = {k: np.asarray(v) for k, v in req["inputs"].items()}
+            with self.server.lock:          # jax arrays: serialize calls
+                outs = self.server.predictor.run(feed)
+            self._json(200, {"outputs": [np.asarray(o).tolist()
+                                         for o in outs]})
+        except Exception as e:
+            self._json(400, {"error": f"{type(e).__name__}: {e}"})
+
+
+class ModelServer:
+    """Load a StableHLO export dir (or a save_inference_model dir) and
+    serve predictions on localhost."""
+
+    def __init__(self, model_dir: str, port: int = 0, host: str = "127.0.0.1",
+                 stablehlo: Optional[bool] = None, verbose: bool = False):
+        import os
+
+        if stablehlo is None:
+            stablehlo = os.path.exists(os.path.join(model_dir, "model.shlo"))
+        if stablehlo:
+            from .predictor import load_stablehlo_predictor
+
+            self.predictor = load_stablehlo_predictor(model_dir)
+        else:
+            from .predictor import Config, create_predictor
+
+            self.predictor = create_predictor(Config(model_dir))
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.predictor = self.predictor
+        self.httpd.lock = threading.Lock()
+        self.httpd.verbose = verbose
+        self.httpd.max_body_bytes = 256 << 20
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def serve(model_dir: str, port: int = 8866, host: str = "127.0.0.1"):
+    srv = ModelServer(model_dir, port=port, host=host, verbose=True)
+    print(f"serving {model_dir} on http://{host}:{srv.port}")
+    try:
+        srv.httpd.serve_forever()
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--port", type=int, default=8866)
+    ap.add_argument("--host", default="127.0.0.1")
+    a = ap.parse_args()
+    serve(a.model_dir, a.port, a.host)

@@ -110,19 +110,23 @@ class Optimizer:
 
     def apply_gradients(self, params_grads):
         params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
-        # grad clip first (reference fluid/clip.py appends clip ops), then
-        # regularization (weight decay appended onto grads).
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        params_grads = self._append_regularization_ops(params_grads)
         program = default_main_program()
-        lr = self._create_lr_var(program)
-        ops = []
-        for p, g in params_grads:
-            if g is None:
-                continue
-            ops.append(self._append_optimize_op(program.global_block(), (p, g), lr))
-        self._finish_update(program.global_block(), params_grads)
+        # every op appended here is the optimize slice
+        # (clone(for_test=True) strips it by this role tag)
+        with program.op_role_guard(program.OP_ROLE_OPTIMIZE):
+            # grad clip first (reference fluid/clip.py appends clip ops),
+            # then regularization (weight decay appended onto grads).
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            params_grads = self._append_regularization_ops(params_grads)
+            lr = self._create_lr_var(program)
+            ops = []
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                ops.append(self._append_optimize_op(
+                    program.global_block(), (p, g), lr))
+            self._finish_update(program.global_block(), params_grads)
         return ops
 
     def _append_regularization_ops(self, params_grads):

@@ -57,6 +57,17 @@ def start_profiler(state="All", tracer_option="Default"):
         pass  # device tracing optional (e.g. second start without stop)
 
 
+_attached_program = None
+
+
+def attach_program(program):
+    """Register the program whose per-op XLA cost table should be merged
+    into the chrome trace at stop_profiler (utils/op_costs.py — the
+    replacement for the reference's per-op device tracer)."""
+    global _attached_program
+    _attached_program = program
+
+
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     global _active
     _active = False
@@ -65,8 +76,20 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     except Exception:
         pass
     # chrome-trace export of host events (tools/timeline.py parity)
-    with open(profile_path + ".chrome_trace.json", "w") as f:
+    trace_path = profile_path + ".chrome_trace.json"
+    with open(trace_path, "w") as f:
         json.dump({"traceEvents": _events}, f)
+    if _attached_program is not None:
+        try:
+            from .utils import op_costs
+
+            rows = op_costs.program_cost_table(_attached_program)
+            op_costs.merge_into_trace(rows, trace_path)
+            print("[profiler] top ops by estimated device cost:")
+            op_costs.print_cost_table(rows, top=10)
+        except Exception as e:  # attribution is optional, like device trace
+            print(f"[profiler] cost attribution skipped: "
+                  f"{type(e).__name__}: {e}")
     if sorted_key:
         _print_summary(sorted_key)
 

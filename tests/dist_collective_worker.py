@@ -1,0 +1,81 @@
+"""Worker script for test_dist_launch.py — runs under parallel.launch with
+the PADDLE_* env contract, bootstraps jax.distributed from
+PADDLE_TRAINER_ENDPOINTS (the reference's gen_nccl_id moment), and trains a
+dygraph DataParallel model on this rank's shard of a deterministic global
+batch. Writes final loss + a param fingerprint for the parity assertion."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.dygraph as dg  # noqa: E402
+from paddle_tpu.dygraph import parallel as P  # noqa: E402
+from paddle_tpu.parallel import env as penv  # noqa: E402
+
+
+def main():
+    penv.init_distributed_env()
+    rank = penv.trainer_id()
+    nranks = penv.trainer_num()
+    assert jax.process_count() == nranks, (
+        jax.process_count(), nranks)
+
+    steps = int(os.environ.get("DIST_TEST_STEPS", "4"))
+    lr = 0.1
+    rng = np.random.RandomState(0)
+    xs = rng.rand(steps, 8, 4).astype("float32")        # global batches
+    w_init = rng.rand(4, 3).astype("float32")
+    ys = rng.rand(steps, 8, 3).astype("float32")
+
+    with dg.guard():
+        import paddle_tpu.dygraph.nn as nn
+
+        net = nn.Linear(4, 3)
+        net.weight.set_value(w_init)
+        net.bias.set_value(np.zeros(3, "float32"))
+        model = P.DataParallel(net)
+
+        final_loss = None
+        for t in range(steps):
+            # this rank's shard of the global batch
+            x = xs[t].reshape(nranks, -1, 4)[rank]
+            y = ys[t].reshape(nranks, -1, 3)[rank]
+            xv = dg.to_variable(x)
+            yv = dg.to_variable(y)
+            from paddle_tpu.dygraph.varbase import apply_op
+            import jax.numpy as jnp
+
+            pred = model(xv)
+            diff = pred - yv
+            loss = apply_op(lambda d: jnp.mean(d * d), diff)
+            # scale_loss (1/nranks) + allreduce-sum == full-batch gradient
+            scaled = model.scale_loss(loss)
+            scaled.backward()
+            model.apply_collective_grads()
+            for p in model.parameters():
+                if p._grad is not None:
+                    p.set_value(np.asarray(p.value)
+                                - lr * np.asarray(p._grad))
+                    p.clear_gradient()
+            final_loss = float(np.asarray(loss.value))
+
+        out = {
+            "rank": rank,
+            "nranks": nranks,
+            "loss": final_loss,
+            "w_sum": float(np.asarray(net.weight.value).sum()),
+            "w": np.asarray(net.weight.value).tolist(),
+        }
+    path = os.environ["DIST_TEST_RESULT"] + f".{rank}"
+    with open(path, "w") as f:
+        json.dump(out, f)
+    print("worker done", rank)
+
+
+if __name__ == "__main__":
+    main()
