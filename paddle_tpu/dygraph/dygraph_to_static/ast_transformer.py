@@ -272,6 +272,25 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return [body_fn, _assign_tuple(names, call)]
 
 
+class CallTransformer(ast.NodeTransformer):
+    """foo(args) -> _jst.convert_call(foo)(args): callees that are plain
+    user functions get their control flow converted too
+    (call_transformer.py). Runs LAST so the earlier passes still see
+    literal range()/super() forms; convert_call passes builtins, methods,
+    and framework callables through untouched."""
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("super", "range", "ld"):
+            return node
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == _JST:
+            return node
+        node.func = _jst_call("convert_call", [f])
+        return node
+
+
 class DygraphToStaticAst:
     """Apply the transformer stack to a FunctionDef tree
     (ast_transformer.py DygraphToStaticAst.get_static_ast)."""
@@ -279,13 +298,20 @@ class DygraphToStaticAst:
     def transform(self, tree: ast.AST) -> ast.AST:
         tree = LogicalTransformer().visit(tree)
         tree = ControlFlowTransformer().visit(tree)
+        tree = CallTransformer().visit(tree)
         ast.fix_missing_locations(tree)
         return tree
 
 
 def convert_to_static(fn):
     """Source-transform ``fn`` for staging; returns ``fn`` unchanged when
-    the source is unavailable or uses no convertible control flow."""
+    the source is unavailable or uses no convertible control flow.
+
+    Closure/global semantics: the converted function binds freevars and
+    globals to their values AT CONVERSION TIME. Under @declarative this
+    matches jax.jit, which bakes closures at trace time anyway; it only
+    diverges for standalone eager use of a converted function whose
+    nonlocals are rebound afterwards."""
     try:
         source = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(source)
@@ -294,8 +320,12 @@ def convert_to_static(fn):
     fndef = tree.body[0]
     if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
-    has_flow = any(isinstance(n, (ast.If, ast.While, ast.For, ast.BoolOp))
-                   for n in ast.walk(fndef))
+    # Calls count as "flow": a callee may carry the control flow
+    # (convert_call reaches it), so functions that merely call helpers
+    # still need the rewrite
+    has_flow = any(
+        isinstance(n, (ast.If, ast.While, ast.For, ast.BoolOp, ast.Call))
+        for n in ast.walk(fndef))
     if not has_flow:
         return fn
     fndef.decorator_list = []
@@ -318,5 +348,9 @@ def convert_to_static(fn):
         new_fn = namespace[fndef.name]
     except Exception:
         return fn
-    new_fn.__wrapped_original__ = fn
+    import weakref
+
+    # weakref: a strong backref would keep _CALL_CACHE entries immortal
+    # (value -> key) in convert_operators' WeakKeyDictionary
+    new_fn.__wrapped_original__ = weakref.ref(fn)
     return new_fn

@@ -176,3 +176,36 @@ def convert_logical_not(x):
     if not _is_traced(x):
         return not x
     return jnp.logical_not(_pred_value(x))
+
+
+import weakref
+
+# WeakKey so short-lived user functions (defined in loops/notebooks) do
+# not accumulate: the entry — and the converted twin's snapshot of the
+# defining module's globals — dies with the function
+_CALL_CACHE = weakref.WeakKeyDictionary()
+_SKIP_MODULE_PREFIXES = ("builtins", "jax", "numpy", "paddle_tpu", "np",
+                         "functools", "itertools", "math", "operator")
+
+
+def convert_call(fn):
+    """convert_operators.py convert_call parity: when a converted function
+    calls a plain user Python function, convert the callee too (its
+    control flow must also stage). Framework/stdlib callables, bound
+    methods, Layers, and builtins pass through untouched."""
+    import types
+
+    if not isinstance(fn, types.FunctionType):
+        return fn
+    mod = getattr(fn, "__module__", None) or "builtins"
+    if mod.split(".")[0] in _SKIP_MODULE_PREFIXES:
+        return fn
+    if getattr(fn, "__wrapped_original__", None) is not None:
+        return fn                      # already a converted function
+    cached = _CALL_CACHE.get(fn)
+    if cached is None:
+        from .ast_transformer import convert_to_static
+
+        cached = convert_to_static(fn)
+        _CALL_CACHE[fn] = cached
+    return cached

@@ -158,6 +158,13 @@ _GLOBAL_FLAGS = {
     "FLAGS_paddle_num_threads": 1,
     "FLAGS_use_system_allocator": False,
     "FLAGS_executor_log_deps": False,
+    # roi_align adaptive sampling: False = bounded uniform grid (fast
+    # default), True = exact reference ceil(roi/pooled) per-ROI density
+    # via a weighted static super-grid (ops/detection.py roi_align)
+    "FLAGS_roi_align_exact": False,
+    # multiplier on the exact-mode grid bound for ROIs larger than the
+    # feature map (unclipped proposals); 1 = image-derived bound
+    "FLAGS_roi_align_exact_scale": 1,
 }
 
 

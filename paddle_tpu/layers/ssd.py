@@ -12,7 +12,8 @@ from typing import List, Optional, Sequence
 
 from ..framework.layer_helper import LayerHelper
 
-__all__ = ["ssd_loss", "multi_box_head", "detection_output"]
+__all__ = ["ssd_loss", "multi_box_head", "detection_output",
+           "detection_map"]
 
 
 def detection_output(loc, scores, prior_box, prior_box_var,
@@ -202,3 +203,55 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = concat(boxes_l, axis=0)
     variances = concat(vars_l, axis=0)
     return mbox_locs, mbox_confs, boxes, variances
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", detect_res_length=None,
+                  label_length=None):
+    """fluid.layers.detection_map (detection.py:1222) — VOC mAP over
+    detection results. Runs as a host op (the reference kernel is
+    CPU-only too); DetectRes/Label are flat [N,6]/[M,5|6] with optional
+    per-image length tensors standing in for LoD."""
+    helper = LayerHelper("detection_map", **locals())
+
+    def state(dtype):
+        return helper.create_variable_for_type_inference(
+            dtype, stop_gradient=True)
+
+    map_out = state("float32")
+    inputs = {"Label": [label], "DetectRes": [detect_res]}
+    if has_state is not None:
+        inputs["HasState"] = [has_state]
+    if input_states is not None:
+        inputs["PosCount"] = [input_states[0]]
+        inputs["TruePos"] = [input_states[1]]
+        inputs["FalsePos"] = [input_states[2]]
+        if len(input_states) >= 5:   # per-class row counts of TP/FP state
+            inputs["TruePosLength"] = [input_states[3]]
+            inputs["FalsePosLength"] = [input_states[4]]
+    if detect_res_length is not None:
+        inputs["DetectResLength"] = [detect_res_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    if out_states is not None:
+        outputs = {"MAP": [map_out],
+                   "AccumPosCount": [out_states[0]],
+                   "AccumTruePos": [out_states[1]],
+                   "AccumFalsePos": [out_states[2]]}
+        if len(out_states) >= 5:
+            outputs["AccumTruePosLength"] = [out_states[3]]
+            outputs["AccumFalsePosLength"] = [out_states[4]]
+    else:
+        outputs = {"MAP": [map_out],
+                   "AccumPosCount": [state("int32")],
+                   "AccumTruePos": [state("float32")],
+                   "AccumFalsePos": [state("float32")]}
+    helper.append_op(type="detection_map", inputs=inputs, outputs=outputs,
+                     attrs={"overlap_threshold": overlap_threshold,
+                            "evaluate_difficult": evaluate_difficult,
+                            "ap_type": ap_version,
+                            "class_num": class_num,
+                            "background_label": background_label})
+    return map_out

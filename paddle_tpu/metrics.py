@@ -5,7 +5,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy", "Auc"]
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
+           "Accuracy", "Auc", "DetectionMAP"]
 
 
 class MetricBase:
@@ -139,3 +140,88 @@ class Auc(MetricBase):
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
         return auc / (tot_pos * tot_neg)
+
+
+class DetectionMAP:
+    """fluid.metrics.DetectionMAP (metrics.py:765) — evaluator building the
+    detection_map layer twice: a per-batch mAP and an accumulated mAP over
+    carried TP/FP state, with reset ops clearing the state (evaluator.py
+    DetectionMAP parity)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral",
+                 detect_res_length=None, label_length=None):
+        from . import layers
+        from .framework.program import default_main_program
+
+        if class_num is None:
+            raise ValueError("class_num is required")
+        if gt_difficult is not None:
+            label = layers.concat([gt_label, gt_difficult, gt_box], axis=1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=1)
+
+        self.cur_map = layers.detection_map(
+            input, label, class_num, background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version,
+            detect_res_length=detect_res_length, label_length=label_length)
+
+        # carried state: persistable accumulators + has_state flag
+        block = default_main_program().global_block()
+        self._has_state = block.create_var(
+            name=f"{self.cur_map.name}.has_state", shape=[1], dtype="int32",
+            persistable=True)
+        pos = block.create_var(name=f"{self.cur_map.name}.pos_count",
+                               shape=[class_num, 1], dtype="int32",
+                               persistable=True)
+        tp = block.create_var(name=f"{self.cur_map.name}.true_pos",
+                              shape=[-1, 2], dtype="float32",
+                              persistable=True)
+        fp = block.create_var(name=f"{self.cur_map.name}.false_pos",
+                              shape=[-1, 2], dtype="float32",
+                              persistable=True)
+        tp_len = block.create_var(name=f"{self.cur_map.name}.true_pos_len",
+                                  shape=[class_num], dtype="int64",
+                                  persistable=True)
+        fp_len = block.create_var(name=f"{self.cur_map.name}.false_pos_len",
+                                  shape=[class_num], dtype="int64",
+                                  persistable=True)
+        self.states = [pos, tp, fp, tp_len, fp_len]
+        # has_state starts at 0 via the STARTUP program (evaluator.py
+        # set_variable_initializer) — zeroing it in main would wipe the
+        # carried accumulators every batch
+        from .framework.program import default_startup_program
+
+        sblock = default_startup_program().global_block()
+        sblock.create_var(name=self._has_state.name, shape=[1],
+                          dtype="int32", persistable=True)
+        sblock.append_op(type="fill_constant", inputs={},
+                         outputs={"Out": [self._has_state.name]},
+                         attrs={"shape": [1], "value": 0.0, "dtype": 2})
+        self.accum_map = layers.detection_map(
+            input, label, class_num, background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            has_state=self._has_state, input_states=self.states,
+            out_states=self.states, ap_version=ap_version,
+            detect_res_length=detect_res_length, label_length=label_length)
+        layers.fill_constant(shape=[1], dtype="int32", value=1,
+                             out=self._has_state)
+
+    def get_map_var(self):
+        return self.cur_map, self.accum_map
+
+    def reset(self, executor, reset_program=None):
+        from . import Program, program_guard
+        from . import layers
+
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(reset_program):
+            layers.fill_constant(shape=[1], dtype="int32", value=0,
+                                 out=reset_program.global_block().create_var(
+                                     name=self._has_state.name, shape=[1],
+                                     dtype="int32", persistable=True))
+        executor.run(reset_program)

@@ -184,6 +184,10 @@ def roi_align(ctx, op, ins):
     scale = float(op.attr("spatial_scale", 1.0))
     ratio = int(op.attr("sampling_ratio", -1))
     if ratio <= 0:
+        from ..framework.core import get_flag
+
+        if get_flag("FLAGS_roi_align_exact", False):
+            return _roi_align_exact(x, rois, ins, op, ph, pw, scale)
         # The reference (detection/roi_align_op.cc) adaptively samples
         # ceil(roi_size/pooled_size) points per bin *per ROI* — a
         # data-dependent count XLA's static shapes cannot express. Use the
@@ -233,6 +237,70 @@ def roi_align(ctx, op, ins):
                + v10 * wy * (1 - wx) + v11 * wy * wx)   # [C, ph*r, pw*r]
         val = val.reshape(c, ph, ratio, pw, ratio).mean(axis=(2, 4))
         return val
+
+    out = jax.vmap(one_roi)(rois, batch_ids)
+    return {"Out": out}
+
+
+def _roi_align_exact(x, rois, ins, op, ph, pw, scale):
+    """Exact reference adaptive sampling (roi_align_op.cu ceil(roi/pooled)
+    per ROI) under static shapes: sample a [ph, K] x [pw, K] super-grid
+    where K is the static worst case, with per-ROI dynamic positions
+    (j+0.5)*bin/k and weights (j<k)/k — slots past this ROI's own k carry
+    zero weight, so the weighted sum equals the reference's k-point
+    average exactly. FLAGS_roi_align_exact opts in (K^2 denser gather
+    than the bounded default)."""
+    batch_ids = ins.get("RoisBatchId", [None])[0]
+    if batch_ids is None:
+        batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
+    n, c, h, w = x.shape
+    # static worst-case grid: ROIs are normally clipped to the image, so
+    # ceil(feature/pooled) covers them; unclipped over-image ROIs would
+    # need a larger bound — raise FLAGS_roi_align_exact_scale (x the
+    # image-derived bound) for those, at proportionally higher gather cost
+    from ..framework.core import get_flag
+
+    over = max(1, int(get_flag("FLAGS_roi_align_exact_scale", 1) or 1))
+    Ky = max(1, -(-h // ph)) * over
+    Kx = max(1, -(-w // pw)) * over
+
+    def one_roi(roi, bid):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        ky = jnp.clip(jnp.ceil(bin_h), 1, Ky)            # samples per bin
+        kx = jnp.clip(jnp.ceil(bin_w), 1, Kx)
+        jy = jnp.arange(Ky, dtype=x.dtype)
+        jx = jnp.arange(Kx, dtype=x.dtype)
+        iy = (jnp.arange(ph)[:, None] * bin_h + y1
+              + (jy[None, :] + 0.5) * bin_h / ky)        # [ph, Ky]
+        ix = (jnp.arange(pw)[:, None] * bin_w + x1
+              + (jx[None, :] + 0.5) * bin_w / kx)        # [pw, Kx]
+        wy = jnp.where(jy < ky, 1.0 / ky, 0.0)           # [Ky]
+        wx = jnp.where(jx < kx, 1.0 / kx, 0.0)           # [Kx]
+        iy = iy.reshape(-1)
+        ix = ix.reshape(-1)
+        y0 = jnp.clip(jnp.floor(iy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(ix), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        ly = jnp.clip(iy - y0, 0.0, 1.0)
+        lx = jnp.clip(ix - x0, 0.0, 1.0)
+        img = x[bid]
+        v00 = img[:, y0i[:, None], x0i[None, :]]
+        v01 = img[:, y0i[:, None], x1i[None, :]]
+        v10 = img[:, y1i[:, None], x0i[None, :]]
+        v11 = img[:, y1i[:, None], x1i[None, :]]
+        gy = ly[:, None]
+        gx = lx[None, :]
+        val = (v00 * (1 - gy) * (1 - gx) + v01 * (1 - gy) * gx
+               + v10 * gy * (1 - gx) + v11 * gy * gx)
+        val = val.reshape(c, ph, Ky, pw, Kx)
+        return jnp.einsum("cpyqx,y,x->cpq", val, wy, wx)
 
     out = jax.vmap(one_roi)(rois, batch_ids)
     return {"Out": out}

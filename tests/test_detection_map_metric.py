@@ -1,0 +1,73 @@
+"""fluid.layers.detection_map + fluid.metrics.DetectionMAP — evaluator
+parity (reference layers/detection.py:1222, metrics.py:765): per-batch
+mAP, cross-batch accumulated mAP with carried TP/FP state, reset."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(class_num=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", [6], dtype="float32")
+        gtl = fluid.layers.data("gtl", [1], dtype="float32")
+        gtb = fluid.layers.data("gtb", [4], dtype="float32")
+        m = fluid.metrics.DetectionMAP(det, gtl, gtb, class_num=class_num,
+                                       overlap_threshold=0.5)
+        cur, accum = m.get_map_var()
+    return main, startup, m, cur, accum
+
+
+def test_detection_map_layer_batch_value():
+    main, startup, m, cur, accum = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    det = np.asarray([[1, 0.9, 0.1, 0.1, 0.3, 0.3]], "float32")
+    gtl = np.asarray([[1.0]], "float32")
+    gtb = np.asarray([[0.1, 0.1, 0.3, 0.3]], "float32")
+    c, a = exe.run(main, feed={"det": det, "gtl": gtl, "gtb": gtb},
+                   fetch_list=[cur, accum], scope=scope)
+    np.testing.assert_allclose(float(np.asarray(c)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(a)), 1.0, rtol=1e-6)
+
+
+def test_detection_map_accumulates_across_batches():
+    main, startup, m, cur, accum = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    gtl = np.asarray([[1.0]], "float32")
+    gtb = np.asarray([[0.1, 0.1, 0.3, 0.3]], "float32")
+    hit = np.asarray([[1, 0.9, 0.1, 0.1, 0.3, 0.3]], "float32")
+    # class-2 detection with no class-2 gt: a pure false positive
+    miss = np.asarray([[2, 0.8, 0.5, 0.5, 0.7, 0.7]], "float32")
+
+    c1, a1 = exe.run(main, feed={"det": hit, "gtl": gtl, "gtb": gtb},
+                     fetch_list=[cur, accum], scope=scope)
+    assert float(np.asarray(a1)) == 1.0
+    c2, a2 = exe.run(main, feed={"det": miss, "gtl": gtl, "gtb": gtb},
+                     fetch_list=[cur, accum], scope=scope)
+    # batch 2 alone: class1 has 1 gt and no detection -> mAP 0
+    assert float(np.asarray(c2)) == 0.0
+    # accumulated: class1 has 2 gts, 1 TP -> AP 0.5 (integral)
+    np.testing.assert_allclose(float(np.asarray(a2)), 0.5, rtol=1e-6)
+
+
+def test_detection_map_reset():
+    main, startup, m, cur, accum = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    gtl = np.asarray([[1.0]], "float32")
+    gtb = np.asarray([[0.1, 0.1, 0.3, 0.3]], "float32")
+    miss = np.asarray([[2, 0.8, 0.5, 0.5, 0.7, 0.7]], "float32")
+    hit = np.asarray([[1, 0.9, 0.1, 0.1, 0.3, 0.3]], "float32")
+    exe.run(main, feed={"det": miss, "gtl": gtl, "gtb": gtb},
+            fetch_list=[accum], scope=scope)
+    with fluid.scope_guard(scope):
+        m.reset(exe)
+    _, a = exe.run(main, feed={"det": hit, "gtl": gtl, "gtb": gtb},
+                   fetch_list=[cur, accum], scope=scope)
+    # state was cleared: accumulated == this batch alone
+    np.testing.assert_allclose(float(np.asarray(a)), 1.0, rtol=1e-6)

@@ -302,3 +302,60 @@ def test_box_clip_batched_per_image():
     got = _run(prog, {"boxes": boxes, "im_info": im_info}, [out])[0]
     np.testing.assert_allclose(got[0, 0], [0, 0, 299, 299])
     np.testing.assert_allclose(got[1, 0], [0, 0, 500, 500])
+
+
+def test_roi_align_exact_mode_matches_reference_sampling():
+    """FLAGS_roi_align_exact reproduces the reference's per-ROI adaptive
+    ceil(roi/pooled) sampling density (roi_align_op.cu) exactly — checked
+    against a direct numpy transcription of that algorithm."""
+    import paddle_tpu as fluid
+    from tests.test_tail_ops import run_op
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(1, 2, 12, 12).astype("float32")
+    rois = np.asarray([[1.0, 1.0, 10.5, 9.0],
+                       [2.0, 3.0, 4.9, 11.0],
+                       [0.0, 0.0, 3.1, 3.1]], "float32")
+    ph = pw = 3
+    scale = 0.5
+
+    def oracle():
+        out = np.zeros((len(rois), 2, ph, pw), "float32")
+        H, W = 12, 12
+        for r, roi in enumerate(rois):
+            x1, y1, x2, y2 = roi * scale
+            rw = max(x2 - x1, 1.0)
+            rh = max(y2 - y1, 1.0)
+            bw, bh = rw / pw, rh / ph
+            gh, gw = int(np.ceil(bh)), int(np.ceil(bw))
+            for c in range(2):
+                for i in range(ph):
+                    for j in range(pw):
+                        acc = 0.0
+                        for iy in range(gh):
+                            yy = y1 + i * bh + (iy + 0.5) * bh / gh
+                            for ix in range(gw):
+                                xx = x1 + j * bw + (ix + 0.5) * bw / gw
+                                y0 = min(max(int(np.floor(yy)), 0), H - 1)
+                                x0 = min(max(int(np.floor(xx)), 0), W - 1)
+                                y1i = min(y0 + 1, H - 1)
+                                x1i = min(x0 + 1, W - 1)
+                                ly = min(max(yy - y0, 0.0), 1.0)
+                                lx = min(max(xx - x0, 0.0), 1.0)
+                                v = (x[0, c, y0, x0] * (1 - ly) * (1 - lx)
+                                     + x[0, c, y0, x1i] * (1 - ly) * lx
+                                     + x[0, c, y1i, x0] * ly * (1 - lx)
+                                     + x[0, c, y1i, x1i] * ly * lx)
+                                acc += v
+                        out[r, c, i, j] = acc / (gh * gw)
+        return out
+
+    fluid.set_flags({"FLAGS_roi_align_exact": True})
+    try:
+        got = run_op("roi_align", {"X": x, "ROIs": rois}, ["Out"],
+                     {"pooled_height": ph, "pooled_width": pw,
+                      "spatial_scale": scale, "sampling_ratio": -1})
+    finally:
+        fluid.set_flags({"FLAGS_roi_align_exact": False})
+    np.testing.assert_allclose(got["Out"][0], oracle(), rtol=1e-4,
+                               atol=1e-5)
