@@ -328,7 +328,19 @@ def convert_to_static(fn):
         for n in ast.walk(fndef))
     if not has_flow:
         return fn
-    fndef.decorator_list = []
+    # Strip only the staging decorators (@declarative/@to_static) — they
+    # must not re-wrap the converted twin. Other decorators are KEPT and
+    # re-applied at exec so a decorated helper reached via convert_call
+    # retains its wrapper behavior (the decorator resolves from the
+    # snapshot namespace; if it cannot, exec fails and we fall back).
+    def _is_staging_deco(d):
+        target = d.func if isinstance(d, ast.Call) else d
+        name = (target.attr if isinstance(target, ast.Attribute)
+                else getattr(target, "id", ""))
+        return name in ("declarative", "to_static", "not_to_static")
+
+    fndef.decorator_list = [d for d in fndef.decorator_list
+                            if not _is_staging_deco(d)]
     DygraphToStaticAst().transform(tree)
     namespace = dict(fn.__globals__)
     from . import convert_operators

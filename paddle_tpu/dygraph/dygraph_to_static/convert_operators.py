@@ -182,8 +182,12 @@ import weakref
 
 # WeakKey so short-lived user functions (defined in loops/notebooks) do
 # not accumulate: the entry — and the converted twin's snapshot of the
-# defining module's globals — dies with the function
+# defining module's globals — dies with the function. Identity results
+# (conversion returned fn unchanged) go in a WeakSet instead: storing
+# fn as its own WeakKeyDictionary value would be a strong value->key
+# reference and make the entry immortal.
 _CALL_CACHE = weakref.WeakKeyDictionary()
+_IDENTITY = weakref.WeakSet()
 _SKIP_MODULE_PREFIXES = ("builtins", "jax", "numpy", "paddle_tpu", "np",
                          "functools", "itertools", "math", "operator")
 
@@ -202,10 +206,15 @@ def convert_call(fn):
         return fn
     if getattr(fn, "__wrapped_original__", None) is not None:
         return fn                      # already a converted function
+    if fn in _IDENTITY:
+        return fn
     cached = _CALL_CACHE.get(fn)
     if cached is None:
         from .ast_transformer import convert_to_static
 
         cached = convert_to_static(fn)
-        _CALL_CACHE[fn] = cached
+        if cached is fn:
+            _IDENTITY.add(fn)
+        else:
+            _CALL_CACHE[fn] = cached
     return cached

@@ -27,6 +27,8 @@ def multihead_matmul(ctx, op, ins):
     """operators/fused/multihead_matmul_op.cc: fused QKV projection +
     scaled-dot attention. Input [B, S, H]; W [H, 3, nh, hd]; Bias
     [3, nh, hd]; BiasQK optional [B, nh, S, S] additive mask."""
+    import os
+
     x = ins["Input"][0]
     w = ins["W"][0]
     bias = ins["Bias"][0]
@@ -38,9 +40,27 @@ def multihead_matmul(ctx, op, ins):
     b = bias.reshape(3, nh, hd)
     qkv = jnp.einsum("bsh,hcnd->bcnsd", x, w) + b[None, :, :, None, :]
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, nh, S, hd]
+
+    bias_qk = ins["BiasQK"][0] if ins.get("BiasQK") else None
+    # Pallas flash path: O(S) memory instead of the [B,nh,S,S] logits —
+    # the same kernel family as models/gpt.py, with the additive BiasQK
+    # mask applied inside the tiles. Mosaic needs 128-lane-aligned seqs.
+    use_flash = (S % 128 == 0 and hd % 64 == 0 and
+                 (jax.default_backend() == "tpu"
+                  or os.environ.get("PADDLE_TPU_FORCE_FLASH_MHA") == "1"))
+    if use_flash and (bias_qk is None or bias_qk.ndim == 4):
+        from . import pallas_kernels as PK
+
+        blk = max(bq for bq in (512, 256, 128) if S % bq == 0)
+        to_bthd = lambda a: jnp.transpose(a, (0, 2, 1, 3))  # noqa: E731
+        out = PK.flash_attention(
+            to_bthd(q), to_bthd(k), to_bthd(v), causal=False,
+            sm_scale=alpha, block_q=blk, block_k=blk, bias=bias_qk)
+        return {"Out": out.reshape(B, S, H)}
+
     logits = jnp.einsum("bnsd,bntd->bnst", q, k) * alpha
-    if ins.get("BiasQK"):
-        logits = logits + ins["BiasQK"][0]
+    if bias_qk is not None:
+        logits = logits + bias_qk
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bnst,bntd->bsnd", probs.astype(v.dtype), v)
     return {"Out": out.reshape(B, S, H)}

@@ -58,8 +58,13 @@ def _bcast_lanes(x, n):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, num_k):
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, num_k, has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        bias_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -82,6 +87,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        if bias_ref is not None:
+            bias = bias_ref[0].astype(jnp.float32)   # (bq or 1, bk)
+            s = s + jnp.broadcast_to(bias, s.shape)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -114,7 +122,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l))
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _bias_spec(bias, bh, block_q, block_k):
+    """BlockSpec for an additive bias [BB, SQ, Sk] where BB divides bh
+    (per-head vs per-batch broadcast) and SQ is 1 (row-broadcast padding
+    mask) or the full query length."""
+    bb, sq, _sk = bias.shape
+    heads_per = bh // bb
+    q_bcast = sq == 1
+    bq_blk = 1 if q_bcast else block_q
+
+    def idx(b, qi, ki):
+        return (b // heads_per, 0 if q_bcast else qi, ki)
+
+    return pl.BlockSpec((1, bq_blk, block_k), idx)
+
+
+def _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k):
     bh, t, hd = q.shape
     tk = k.shape[1]
     block_q = min(block_q, t)
@@ -126,15 +149,21 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
     grid = (bh, nq, nk)
     kern = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k=nk)
+        block_q=block_q, block_k=block_k, num_k=nk,
+        has_bias=bias is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias, bh, block_q, block_k))
+        args.append(bias)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, NUM_LANES), lambda b, qi, ki: (b, qi, 0)),
@@ -151,7 +180,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
@@ -160,8 +189,14 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
-                   *, sm_scale, causal, block_q, block_k, num_k):
+def _bwd_dq_kernel(*refs, sm_scale, causal, block_q, block_k, num_k,
+                   has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, bias_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr = refs
+        bias_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -185,6 +220,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if bias_ref is not None:
+            s = s + jnp.broadcast_to(
+                bias_ref[0].astype(jnp.float32), s.shape)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -207,9 +245,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, sm_scale, causal, block_q, block_k, num_q):
+def _bwd_dkv_kernel(*refs, sm_scale, causal, block_q, block_k, num_q,
+                    has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, bias_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        bias_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -234,6 +278,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if bias_ref is not None:
+            s = s + jnp.broadcast_to(
+                bias_ref[0].astype(jnp.float32), s.shape)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -263,49 +310,65 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+def _bwd(q, k, v, o, lse, do, bias, causal, sm_scale, block_q, block_k):
     bh, t, hd = q.shape
     tk = k.shape[1]
     block_q = min(block_q, t)
     block_k = min(block_k, tk)
     nq, nk = t // block_q, tk // block_k
+    has_bias = bias is not None
 
     dq_kern = functools.partial(
         _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k=nk)
+        block_q=block_q, block_k=block_k, num_k=nk, has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda b, qi, ki: (b, qi, 0)),
+    ]
+    args = [q, k, v, o, do, lse]
+    if has_bias:
+        in_specs.append(_bias_spec(bias, bh, block_q, block_k))
+        args.append(bias)
     dq = pl.pallas_call(
         dq_kern,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, NUM_LANES), lambda b, qi, ki: (b, qi, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, o, do, lse)
+    )(*args)
 
     dkv_kern = functools.partial(
         _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_q=nq)
+        block_q=block_q, block_k=block_k, num_q=nq, has_bias=has_bias)
+    in_specs2 = [
+        pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
+        pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
+        pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
+        pl.BlockSpec((1, block_q, NUM_LANES), lambda b, ki, qi: (b, qi, 0)),
+    ]
+    args2 = [q, k, v, o, do, lse]
+    if has_bias:
+        bspec = _bias_spec(bias, bh, block_q, block_k)
+
+        def idx2(b, ki, qi, _inner=bspec.index_map):
+            return _inner(b, qi, ki)
+
+        in_specs2.append(pl.BlockSpec(bspec.block_shape, idx2))
+        args2.append(bias)
     dk, dv = pl.pallas_call(
         dkv_kern,
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, NUM_LANES), lambda b, ki, qi: (b, qi, 0)),
-        ],
+        in_specs=in_specs2,
         out_specs=[
             pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_k, hd), lambda b, ki, qi: (b, ki, 0)),
@@ -321,7 +384,7 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, o, do, lse)
+    )(*args2)
     return dq, dk, dv
 
 
@@ -330,25 +393,29 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k):
+    o, _ = _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k)
     return o
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k):
+    o, lse = _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k)
     # lse is lane-replicated (bh, t, 128): save ONE lane as the residual —
     # the full tensor is ~hd/1 x larger than o itself in f32 and would
     # dominate live activation memory in no-remat training.
-    return o, (q, k, v, o, lse[..., :1])
+    return o, (q, k, v, o, lse[..., :1], bias)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, bias = res
     lse = jnp.broadcast_to(lse, lse.shape[:-1] + (NUM_LANES,))
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
-    return dq, dk, dv
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, bias, causal, sm_scale,
+                      block_q, block_k)
+    # bias is an additive mask, not a trainable tensor — zero cotangent
+    # (the reference's BiasQK likewise carries no grad)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -356,11 +423,22 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512):
+                    block_q: int = 512, block_k: int = 512,
+                    bias=None):
     """FlashAttention-2 on TPU (Pallas). q,k,v: [B, T, nh, hd] -> [B, T, nh, hd].
 
     Replaces the O(T^2)-memory XLA attention in models/gpt.py when
     ``GPTConfig.use_flash``; differentiable via hand-written Pallas backward.
+
+    ``bias`` is an optional additive logit bias (padding / attention
+    mask): [B, nh, T, Tk], [B, 1, T, Tk], or the O(B*T)-memory padding
+    form [B, 1, 1, Tk] — broadcast INSIDE the kernel, so a row mask never
+    materializes the [T, Tk] square.
+
+    NOT differentiable w.r.t. ``bias``: it is treated as a constant mask
+    (the cotangent is zero, matching the reference's BiasQK semantics).
+    A trainable bias (learned relative position / ALiBi) must use the
+    plain XLA attention path instead.
     """
     b, t, nh, hd = q.shape
     if sm_scale is None:
@@ -372,5 +450,16 @@ def flash_attention(q, k, v, causal: bool = True,
     def from_bh(x):
         return x.reshape(b, nh, t, hd).transpose(0, 2, 1, 3)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, sm_scale, block_q, block_k)
+    bias_bh = None
+    if bias is not None:
+        bb, bn, bq_, bk_ = bias.shape
+        if bn == nh:                       # per-head: fold into BH
+            bias_bh = bias.reshape(b * nh, bq_, bk_)
+        elif bn == 1:                      # per-batch: kernel broadcasts
+            bias_bh = bias.reshape(b, bq_, bk_)
+        else:
+            raise ValueError(f"bias head dim {bn} must be 1 or {nh}")
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), bias_bh, causal, sm_scale,
+               block_q, block_k)
     return from_bh(o)

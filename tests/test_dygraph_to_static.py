@@ -201,3 +201,33 @@ def test_convert_call_passes_builtins_and_layers():
         out = net(to_variable(np.ones((2, 3), "float32")))
         # helper input ones(2,3): sum 6 >= cap 4 -> unchanged
         np.testing.assert_allclose(_np(out), np.ones((2, 3)))
+
+
+import functools
+
+
+def _scale_input(fn):
+    @functools.wraps(fn)
+    def wrapper(x, *a):
+        return fn(x * 100.0, *a)
+    return wrapper
+
+
+@_scale_input
+def _decorated_helper(x):
+    if x > 1000.0:
+        return x / 2.0
+    return x
+
+
+def test_convert_call_preserves_helper_decorators():
+    """A decorated callee keeps its wrapper behavior through convert_call
+    (only @declarative-style staging decorators are stripped)."""
+    from paddle_tpu.dygraph.dygraph_to_static.convert_operators import \
+        convert_call
+
+    conv = convert_call(_decorated_helper)
+    # direct call: wrapper scales 2 -> 200, below 1000 -> returned as-is
+    assert _decorated_helper(2.0) == 200.0
+    assert conv(2.0) == 200.0
+    assert conv(20.0) == _decorated_helper(20.0) == 1000.0

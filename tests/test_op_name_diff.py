@@ -1,0 +1,25 @@
+"""Coverage gate: every reference REGISTER_OPERATOR name either has a
+registered lowering/host op here or is a documented by-design absence
+with a named TPU-native replacement (tools/op_name_diff.py)."""
+import os
+
+import pytest
+
+REF = "/root/reference"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_only_documented_absences():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from op_name_diff import BY_DESIGN, compute_diff
+
+    d = compute_diff(REF)
+    assert not d["undocumented_missing"], d["undocumented_missing"]
+    # coverage floor: regressions in registration imports fail loudly
+    assert d["implemented"] >= 390, d["implemented"]
+    # documented absences actually absent (stale BY_DESIGN entries)
+    stale = [n for n in BY_DESIGN if n not in d["missing"]]
+    assert not stale, f"BY_DESIGN entries now implemented: {stale}"

@@ -55,3 +55,96 @@ def test_flash_grads_match_xla():
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=3e-4, rtol=3e-4)
+
+
+def ref_attention_bias(q, k, v, bias, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = logits + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@pytest.mark.parametrize("bias_shape", [
+    ("full", None),       # [B, nh, T, T]
+    ("batch", None),      # [B, 1, T, T]
+    ("padmask", None),    # [B, 1, 1, T]
+])
+def test_flash_bias_forward(bias_shape):
+    kind, _ = bias_shape
+    rng = np.random.RandomState(2)
+    b, t, nh, hd = 2, 256, 2, 64
+    q = jnp.asarray(rng.randn(b, t, nh, hd), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, nh, hd), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, nh, hd), jnp.float32)
+    if kind == "full":
+        bias = jnp.asarray(rng.randn(b, nh, t, t), jnp.float32)
+    elif kind == "batch":
+        bias = jnp.asarray(rng.randn(b, 1, t, t), jnp.float32)
+    else:  # padding mask: last quarter of keys masked out
+        m = np.zeros((b, 1, 1, t), np.float32)
+        m[..., 3 * t // 4:] = -1e9
+        bias = jnp.asarray(m)
+    out = flash_attention(q, k, v, causal=False, bias=bias, block_q=128,
+                          block_k=128)
+    ref = ref_attention_bias(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bias_grads_match_xla():
+    rng = np.random.RandomState(3)
+    b, t, nh, hd = 1, 128, 2, 64
+    q = jnp.asarray(rng.randn(b, t, nh, hd), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, nh, hd), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, nh, hd), jnp.float32)
+    m = np.zeros((b, 1, 1, t), np.float32)
+    m[..., t // 2:] = -1e9
+    bias = jnp.asarray(m)
+    w = jnp.asarray(rng.randn(b, t, nh, hd), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False, bias=bias,
+                                       block_q=128, block_k=128) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ref_attention_bias(q, k, v, bias) * w)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_multihead_matmul_flash_path_matches_naive(monkeypatch):
+    """The fluid multihead_matmul op through the Pallas path (forced via
+    env) must reproduce the naive XLA lowering, BiasQK mask included."""
+    import paddle_tpu as fluid
+
+    rng = np.random.RandomState(4)
+    B, S, nh, hd = 2, 128, 2, 64
+    H = nh * hd
+    x = rng.randn(B, S, H).astype("float32")
+    w = rng.randn(H, 3 * H).astype("float32")
+    bias = rng.randn(3 * H).astype("float32")
+    mask = np.zeros((B, nh, S, S), np.float32)
+    mask[:, :, :, S // 2:] = -1e9
+
+    def run(force):
+        from tests.test_tail_ops import run_op
+        import os
+
+        if force:
+            monkeypatch.setenv("PADDLE_TPU_FORCE_FLASH_MHA", "1")
+        else:
+            monkeypatch.delenv("PADDLE_TPU_FORCE_FLASH_MHA", raising=False)
+        return run_op(
+            "multihead_matmul",
+            {"Input": x, "W": w, "Bias": bias, "BiasQK": mask},
+            ["Out"], {"head_number": nh, "alpha": 1.0 / math.sqrt(hd)})
+
+    naive = run(False)["Out"][0]
+    flash = run(True)["Out"][0]
+    np.testing.assert_allclose(flash, naive, atol=3e-5, rtol=3e-5)

@@ -1,0 +1,97 @@
+"""Exact-name op coverage vs the reference registry.
+
+Scans every REGISTER_OPERATOR / REGISTER_OP_WITHOUT_GRADIENT in the
+reference's operators/ tree and diffs against this framework's registered
+lowerings + host ops. The absences must all be in BY_DESIGN — engine and
+runtime bindings whose capability is delivered by a documented TPU-native
+replacement (README op-library row). tests/test_op_name_diff.py gates it.
+
+Usage: python tools/op_name_diff.py [--ref /root/reference]
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# name -> the TPU-native replacement that covers the capability
+BY_DESIGN = {
+    "gen_nccl_id": "jax.distributed coordinator (parallel/env.py)",
+    "tensorrt_engine": "XLA is the inference compiler",
+    "lite_engine": "XLA is the inference compiler",
+    "conv2d_inception_fusion": "XLA fuses the inception subgraph",
+    "fusion_group": "Pallas kernels (ops/pallas_kernels.py)",
+    "pull_box_sparse": "BoxPS heterogeneous PS (distributed/ tables)",
+    "push_box_sparse": "BoxPS heterogeneous PS (distributed/ tables)",
+    "pull_box_extended_sparse": "BoxPS heterogeneous PS",
+    "push_box_extended_sparse": "BoxPS heterogeneous PS",
+    "fl_listen_and_serv": "federated runtime out of scope",
+    "run_program": "@declarative jit staging (dygraph/jit.py)",
+    "read": "reader.py / dataset.py host feeding",
+    "create_custom_reader": "reader.py decorators",
+    # macro parameter inside elementwise_op.h, not a real op
+    "op_type": "registration-macro artifact",
+}
+
+
+def reference_op_names(ref_root: str):
+    names = set()
+    op_dir = os.path.join(ref_root, "paddle/fluid/operators")
+    pat = re.compile(
+        r"REGISTER_(?:OPERATOR|OP_WITHOUT_GRADIENT)\(\s*([a-z0-9_]+)\s*,")
+    for root, _dirs, files in os.walk(op_dir):
+        for f in files:
+            if not f.endswith((".cc", ".cu", ".h")):
+                continue
+            try:
+                txt = open(os.path.join(root, f)).read()
+            except OSError:
+                continue
+            names.update(pat.findall(txt))
+    return names
+
+
+def our_op_names():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import paddle_tpu  # noqa: F401  (registers everything)
+    from paddle_tpu.framework.executor import _HOST_OPS
+    from paddle_tpu.framework.registry import all_op_types
+
+    return set(all_op_types()) | set(_HOST_OPS)
+
+
+def compute_diff(ref_root: str = "/root/reference"):
+    ref = reference_op_names(ref_root)
+    mine = our_op_names()
+    fwd = {n for n in ref if not n.endswith("_grad")}
+    missing = sorted(fwd - mine)
+    undocumented = [n for n in missing if n not in BY_DESIGN]
+    return {
+        "reference_forward_ops": len(fwd),
+        "implemented": len(fwd) - len(missing),
+        "missing": missing,
+        "undocumented_missing": undocumented,
+    }
+
+
+def main():
+    ref = "/root/reference"
+    if "--ref" in sys.argv:
+        ref = sys.argv[sys.argv.index("--ref") + 1]
+    d = compute_diff(ref)
+    print(f"reference forward ops : {d['reference_forward_ops']}")
+    print(f"implemented exact-name: {d['implemented']} "
+          f"({100 * d['implemented'] / d['reference_forward_ops']:.1f}%)")
+    print("by-design absences:")
+    for n in d["missing"]:
+        print(f"  {n:<28} -> {BY_DESIGN.get(n, '??? UNDOCUMENTED ???')}")
+    if d["undocumented_missing"]:
+        print("FAIL: undocumented absences:", d["undocumented_missing"])
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
