@@ -67,6 +67,12 @@ from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import io  # noqa: F401
 from . import metrics  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import average  # noqa: F401
+from . import lod_tensor  # noqa: F401
+from .lod_tensor import LoDTensor, create_lod_tensor, create_random_int_lodtensor  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import profiler  # noqa: F401
 

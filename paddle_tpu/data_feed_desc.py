@@ -1,0 +1,102 @@
+"""fluid.DataFeedDesc — parity with
+python/paddle/fluid/data_feed_desc.py: proto-text description of the
+Dataset slot layout (data_feed.proto), consumed by
+DatasetFactory-created datasets.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+__all__ = ["DataFeedDesc"]
+
+
+class _Slot:
+    def __init__(self, name="", type="uint64", is_dense=False,
+                 is_used=False, shape=None):
+        self.name = name
+        self.type = type
+        self.is_dense = is_dense
+        self.is_used = is_used
+        self.shape = shape or []
+
+
+class DataFeedDesc:
+    """Parses the proto-text in ``proto_file`` (data_feed_desc.py:27);
+    set_batch_size / set_dense_slots / set_use_slots mutate it and
+    desc() renders the text back."""
+
+    def __init__(self, proto_file: str):
+        self._name = "MultiSlotDataFeed"
+        self._batch_size = 1
+        self._pipe_command = None
+        self._slots: List[_Slot] = []
+        with open(proto_file) as f:
+            self._parse(f.read())
+        self._slot_by_name: Dict[str, _Slot] = {
+            s.name: s for s in self._slots}
+
+    def _parse(self, text: str):
+        m = re.search(r'name:\s*"([^"]+)"', text)
+        if m:
+            self._name = m.group(1)
+        m = re.search(r"batch_size:\s*(\d+)", text)
+        if m:
+            self._batch_size = int(m.group(1))
+        m = re.search(r'pipe_command:\s*"([^"]*)"', text)
+        if m:
+            self._pipe_command = m.group(1)
+        for block in re.finditer(r"slots\s*\{([^}]*)\}", text):
+            body = block.group(1)
+            slot = _Slot()
+            mm = re.search(r'name:\s*"([^"]+)"', body)
+            if mm:
+                slot.name = mm.group(1)
+            mm = re.search(r'type:\s*"([^"]+)"', body)
+            if mm:
+                slot.type = mm.group(1)
+            slot.is_dense = bool(re.search(r"is_dense:\s*true", body))
+            slot.is_used = bool(re.search(r"is_used:\s*true", body))
+            slot.shape = [int(x) for x in
+                          re.findall(r"shape:\s*(-?\d+)", body)]
+            self._slots.append(slot)
+
+    # -- reference API ------------------------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name: List[str]):
+        for n in dense_slots_name:
+            if n not in self._slot_by_name:
+                raise ValueError(f"slot {n!r} not in data feed desc")
+            self._slot_by_name[n].is_dense = True
+
+    def set_use_slots(self, use_slots_name: List[str]):
+        for n in use_slots_name:
+            if n not in self._slot_by_name:
+                raise ValueError(f"slot {n!r} not in data feed desc")
+            self._slot_by_name[n].is_used = True
+
+    def set_pipe_command(self, pipe_command: str):
+        self._pipe_command = pipe_command
+
+    def desc(self) -> str:
+        """Render valid data_feed.proto text: slots live inside the
+        multi_slot_desc message (data_feed.proto MultiSlotDesc), exactly
+        as the reference's text_format dump."""
+        lines = [f'name: "{self._name}"',
+                 f"batch_size: {self._batch_size}"]
+        if self._pipe_command is not None:
+            lines.append(f'pipe_command: "{self._pipe_command}"')
+        lines.append("multi_slot_desc {")
+        for s in self._slots:
+            lines.append("  slots {")
+            lines.append(f'    name: "{s.name}"')
+            lines.append(f'    type: "{s.type}"')
+            lines.append(f"    is_dense: {str(s.is_dense).lower()}")
+            lines.append(f"    is_used: {str(s.is_used).lower()}")
+            for d in s.shape:
+                lines.append(f"    shape: {d}")
+            lines.append("  }")
+        lines.append("}")
+        return "\n".join(lines) + "\n"

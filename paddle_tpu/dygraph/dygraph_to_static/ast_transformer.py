@@ -362,7 +362,10 @@ def convert_to_static(fn):
         return fn
     import weakref
 
-    # weakref: a strong backref would keep _CALL_CACHE entries immortal
-    # (value -> key) in convert_operators' WeakKeyDictionary
-    new_fn.__wrapped_original__ = weakref.ref(fn)
+    try:
+        # weakref: a strong backref would keep _CALL_CACHE entries immortal
+        # (value -> key) in convert_operators' WeakKeyDictionary
+        new_fn.__wrapped_original__ = weakref.ref(fn)
+    except AttributeError:
+        pass  # a retained decorator returned a slotted/frozen callable
     return new_fn

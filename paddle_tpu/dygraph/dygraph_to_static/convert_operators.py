@@ -197,10 +197,19 @@ def convert_call(fn):
     calls a plain user Python function, convert the callee too (its
     control flow must also stage). Framework/stdlib callables, bound
     methods, Layers, and builtins pass through untouched."""
+    import functools
     import types
 
+    if isinstance(fn, types.MethodType):
+        # bound method: convert the underlying function, rebind self
+        conv = convert_call(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return functools.partial(conv, fn.__self__)
     if not isinstance(fn, types.FunctionType):
         return fn
+    if getattr(fn, "_not_to_static", False):
+        return fn                      # user opted this callee out
     mod = getattr(fn, "__module__", None) or "builtins"
     if mod.split(".")[0] in _SKIP_MODULE_PREFIXES:
         return fn

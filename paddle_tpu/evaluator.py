@@ -1,0 +1,172 @@
+"""fluid.evaluator — parity with python/paddle/fluid/evaluator.py
+(Evaluator:40, ChunkEvaluator:118, EditDistance:197, DetectionMAP:273).
+
+Deprecated in the reference in favor of fluid.metrics, but still part of
+the API surface: each evaluator appends its metric op plus accumulator
+state updates to the CURRENT main program, and ``eval`` computes the
+final value from the carried state.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework.executor import global_scope
+from .framework.program import (Program, default_main_program,
+                                default_startup_program)
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP", "Evaluator"]
+
+
+class Evaluator:
+    """evaluator.py:40 — base: per-pass state vars created in the main
+    program and zero-initialized from the startup program; reset() zeroes
+    them again between passes."""
+
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper_name = name
+
+    def _create_state(self, suffix, dtype, shape):
+        main = default_main_program()
+        startup = default_startup_program()
+        name = f"{self.helper_name}_{suffix}_{len(self.states)}"
+        var = main.global_block().create_var(
+            name=name, shape=list(shape), dtype=dtype, persistable=True)
+        sblock = startup.global_block()
+        sblock.create_var(name=name, shape=list(shape), dtype=dtype,
+                          persistable=True)
+        from .framework.core import VarType, _DTYPE_TO_VARTYPE
+
+        sblock.append_op(
+            type="fill_constant", inputs={}, outputs={"Out": [name]},
+            attrs={"shape": list(shape), "value": 0.0,
+                   "dtype": int(_DTYPE_TO_VARTYPE[dtype])})
+        self.states.append(var)
+        return var
+
+    def _accumulate(self, state, value):
+        """state += value, appended to the main program."""
+        main = default_main_program()
+        main.global_block().append_op(
+            type="elementwise_add",
+            inputs={"X": [state.name], "Y": [value.name]},
+            outputs={"Out": [state.name]}, attrs={"axis": -1})
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+        from .framework.core import _DTYPE_TO_VARTYPE
+
+        block = reset_program.global_block()
+        for var in self.states:
+            block.create_var(name=var.name, shape=var.shape,
+                             dtype=var.dtype, persistable=True)
+            block.append_op(
+                type="fill_constant", inputs={},
+                outputs={"Out": [var.name]},
+                attrs={"shape": [int(s) if s and int(s) > 0 else 1
+                                 for s in (var.shape or [1])],
+                       "value": 0.0,
+                       "dtype": int(_DTYPE_TO_VARTYPE[var.dtype])})
+        executor.run(reset_program)
+
+    def _state_np(self, var):
+        v = global_scope().find_var(var.name)
+        return None if v is None else np.asarray(v)
+
+
+class ChunkEvaluator(Evaluator):
+    """evaluator.py:118 — accumulate chunk_eval counters; eval() ->
+    (precision, recall, f1) over the whole pass."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, seq_length=None):
+        super().__init__("chunk_evaluator")
+        from . import layers
+
+        kwargs = {"chunk_scheme": chunk_scheme,
+                  "num_chunk_types": num_chunk_types}
+        if excluded_chunk_types:
+            kwargs["excluded_chunk_types"] = list(excluded_chunk_types)
+        args = [input, label] + ([seq_length] if seq_length is not None
+                                 else [])
+        (precision, recall, f1, num_infer, num_label, num_correct) = \
+            layers.chunk_eval(*args, **kwargs)
+        self.num_infer_chunks = self._create_state(
+            "num_infer_chunks", "int64", [1])
+        self.num_label_chunks = self._create_state(
+            "num_label_chunks", "int64", [1])
+        self.num_correct_chunks = self._create_state(
+            "num_correct_chunks", "int64", [1])
+        self._accumulate(self.num_infer_chunks, num_infer)
+        self._accumulate(self.num_label_chunks, num_label)
+        self._accumulate(self.num_correct_chunks, num_correct)
+        self.precision, self.recall, self.f1_score = precision, recall, f1
+        self.metrics = [precision, recall, f1]
+
+    def eval(self, executor, eval_program=None):
+        infer = float(self._state_np(self.num_infer_chunks)[0])
+        lab = float(self._state_np(self.num_label_chunks)[0])
+        correct = float(self._state_np(self.num_correct_chunks)[0])
+        precision = correct / infer if infer else 0.0
+        recall = correct / lab if lab else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if correct else 0.0)
+        return np.asarray([precision]), np.asarray([recall]), \
+            np.asarray([f1])
+
+
+class EditDistance(Evaluator):
+    """evaluator.py:197 — average edit distance + instance error rate
+    accumulated over the pass."""
+
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super().__init__("edit_distance")
+        from . import layers
+
+        distances, seq_num = layers.edit_distance(
+            input, label, ignored_tokens=ignored_tokens)
+        self.total_distance = self._create_state(
+            "total_distance", "float32", [1])
+        self.seq_num = self._create_state("seq_num", "int64", [1])
+        self.instance_error = self._create_state(
+            "instance_error", "int64", [1])
+        batch_dist = layers.reduce_sum(distances)
+        batch_err = layers.reduce_sum(
+            layers.cast(layers.greater_than(
+                distances, layers.fill_constant(
+                    shape=[1], dtype=distances.dtype, value=0.0)),
+                "int64"))
+        main = default_main_program()
+        block = main.global_block()
+        # reshape the scalar sums to the state shapes, then accumulate
+        self._accumulate(self.total_distance,
+                         layers.reshape(batch_dist, [1]))
+        self._accumulate(self.seq_num, layers.reshape(seq_num, [1]))
+        self._accumulate(self.instance_error,
+                         layers.reshape(batch_err, [1]))
+        self.distances, self.seq_num_batch = distances, seq_num
+
+    def eval(self, executor, eval_program=None):
+        total = float(self._state_np(self.total_distance)[0])
+        n = float(self._state_np(self.seq_num)[0])
+        err = float(self._state_np(self.instance_error)[0])
+        if n == 0:
+            return np.asarray([0.0]), np.asarray([0.0])
+        return np.asarray([total / n], np.float32), \
+            np.asarray([err / n], np.float32)
+
+
+def DetectionMAP(input, gt_label, gt_box, gt_difficult=None, class_num=None,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral", **kwargs):
+    """evaluator.py:273 — delegates to the metrics implementation (the
+    reference likewise forwards users there)."""
+    from .metrics import DetectionMAP as _M
+
+    return _M(input, gt_label, gt_box, gt_difficult=gt_difficult,
+              class_num=class_num, background_label=background_label,
+              overlap_threshold=overlap_threshold,
+              evaluate_difficult=evaluate_difficult,
+              ap_version=ap_version, **kwargs)

@@ -443,11 +443,11 @@ _TABLE2 = [
      ["X"], ["Out"], {}, None, 1),
     ("merge_selected_rows", "merge_selected_rows", ["X"], ["Out"], {},
      None, 1),
-    ("locality_aware_nms", "multiclass_nms2", ["BBoxes", "Scores"],
-     ["Out", "Index", "NmsRoisNum"],
+    ("locality_aware_nms", "locality_aware_nms", ["BBoxes", "Scores"],
+     ["Out"],
      {"score_threshold": 0.0, "nms_top_k": 400, "keep_top_k": 100,
       "nms_threshold": 0.3, "background_label": -1},
-     {"Index": "int64", "NmsRoisNum": "int32"}, 1),
+     None, 1),
 ]
 
 for _row in _TABLE2:

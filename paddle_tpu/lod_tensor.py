@@ -1,0 +1,90 @@
+"""fluid.lod_tensor — parity with python/paddle/fluid/lod_tensor.py
+(create_lod_tensor:25, create_random_int_lodtensor:100).
+
+The reference packs ragged rows contiguously and carries LoD offsets;
+the TPU-native representation is padded [B, Tmax, ...] + explicit
+lengths (ops/sequence.py:6). ``LoDTensor`` here is the bridge object:
+it exposes the reference surface (recursive_sequence_lengths, lod,
+set_lod) while materializing as the padded array (``np.asarray`` /
+executor feeds), with ``.lengths`` for the companion length tensor.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["LoDTensor", "create_lod_tensor",
+           "create_random_int_lodtensor"]
+
+
+class LoDTensor:
+    def __init__(self, padded: np.ndarray, seq_lens: Sequence[int]):
+        self._data = np.asarray(padded)
+        self._lens = [int(x) for x in seq_lens]
+
+    # -- reference surface -------------------------------------------------
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [list(self._lens)]
+
+    def lod(self) -> List[List[int]]:
+        off = [0]
+        for n in self._lens:
+            off.append(off[-1] + n)
+        return [off]
+
+    def set_lod(self, lod):
+        off = lod[0]
+        self._lens = [off[i + 1] - off[i] for i in range(len(off) - 1)]
+
+    def set_recursive_sequence_lengths(self, lens):
+        self._lens = [int(x) for x in lens[0]]
+
+    def shape(self):
+        return list(self._data.shape)
+
+    # -- padded-convention accessors --------------------------------------
+    @property
+    def lengths(self) -> np.ndarray:
+        return np.asarray(self._lens, np.int64)
+
+    def __array__(self, dtype=None):
+        return (self._data if dtype is None
+                else self._data.astype(dtype))
+
+    def __repr__(self):
+        return (f"LoDTensor(padded {self._data.shape} "
+                f"{self._data.dtype}, lens={self._lens})")
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
+    """lod_tensor.py:25 — build from a flat [sum(lens), ...] array (or a
+    list of rows) + one-level recursive sequence lengths; stored padded."""
+    lens = [int(x) for x in recursive_seq_lens[0]]
+    if isinstance(data, (list, tuple)):
+        rows = [np.asarray(r) for r in data]
+        flat = np.concatenate([r.reshape(len(r), -1) for r in rows], axis=0)
+    else:
+        flat = np.asarray(data)
+    if flat.ndim == 1:
+        flat = flat[:, None]
+    if flat.shape[0] != sum(lens):
+        raise ValueError(
+            f"data rows {flat.shape[0]} != sum(recursive_seq_lens) "
+            f"{sum(lens)}")
+    tmax = max(lens) if lens else 0
+    out = np.zeros((len(lens), tmax) + flat.shape[1:], flat.dtype)
+    s = 0
+    for i, n in enumerate(lens):
+        out[i, :n] = flat[s:s + n]
+        s += n
+    return LoDTensor(out, lens)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high) -> LoDTensor:
+    """lod_tensor.py:100 — random ints in [low, high]."""
+    lens = [int(x) for x in recursive_seq_lens[0]]
+    flat = np.random.randint(
+        low, high + 1, size=[sum(lens)] + list(base_shape)).astype("int64")
+    return create_lod_tensor(flat, recursive_seq_lens, place)

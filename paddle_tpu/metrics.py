@@ -6,7 +6,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
-           "Accuracy", "Auc", "DetectionMAP"]
+           "Accuracy", "ChunkEvaluator", "EditDistance", "Auc",
+           "DetectionMAP"]
 
 
 class MetricBase:
@@ -225,3 +226,66 @@ class DetectionMAP:
                                      name=self._has_state.name, shape=[1],
                                      dtype="int32", persistable=True))
         executor.run(reset_program)
+
+
+class ChunkEvaluator(MetricBase):
+    """fluid.metrics.ChunkEvaluator (metrics.py:434) — accumulate
+    chunk_eval op counters; eval() -> (precision, recall, f1)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        def scalar(v):
+            a = np.asarray(v).ravel()
+            return int(a[0]) if a.size else 0
+
+        self.num_infer_chunks += scalar(num_infer_chunks)
+        self.num_label_chunks += scalar(num_label_chunks)
+        self.num_correct_chunks += scalar(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """fluid.metrics.EditDistance (metrics.py:536) — average edit
+    distance + instance error rate over batches."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances, dtype=np.float64).ravel()
+        self.total_distance += float(d.sum())
+        self.seq_num += int(np.asarray(seq_num).ravel()[0]
+                            if np.asarray(seq_num).size else seq_num)
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError(
+                "There is no data in EditDistance Metric. Please check "
+                "layers.edit_distance output has been added to EditDistance.")
+        avg_distance = self.total_distance / self.seq_num
+        avg_instance_error = self.instance_error / float(self.seq_num)
+        return avg_distance, avg_instance_error

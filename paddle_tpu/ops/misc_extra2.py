@@ -48,7 +48,10 @@ def multihead_matmul(ctx, op, ins):
     use_flash = (S % 128 == 0 and hd % 64 == 0 and
                  (jax.default_backend() == "tpu"
                   or os.environ.get("PADDLE_TPU_FORCE_FLASH_MHA") == "1"))
-    if use_flash and (bias_qk is None or bias_qk.ndim == 4):
+    bias_flashable = bias_qk is None or (
+        bias_qk.ndim == 4 and bias_qk.shape[0] == B
+        and bias_qk.shape[1] in (1, nh))
+    if use_flash and bias_flashable:
         from . import pallas_kernels as PK
 
         blk = max(bq for bq in (512, 256, 128) if S % bq == 0)

@@ -1,0 +1,61 @@
+"""fluid.ParallelExecutor — parity with
+python/paddle/fluid/parallel_executor.py (:60): the pre-CompiledProgram
+multi-device API. Thin adapter: construction builds
+CompiledProgram.with_data_parallel over the device mesh; run() delegates
+to the Executor (fetched values come back merged across the data axis,
+matching the reference's fetch concatenation).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .framework.compiler import BuildStrategy, CompiledProgram, \
+    ExecutionStrategy
+from .framework.core import XLAPlace
+from .framework.executor import Executor, Scope, global_scope
+from .framework.program import Program, default_main_program
+
+__all__ = ["ParallelExecutor"]
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda: bool, loss_name: Optional[str] = None,
+                 main_program: Optional[Program] = None,
+                 share_vars_from: Optional["ParallelExecutor"] = None,
+                 exec_strategy: Optional[ExecutionStrategy] = None,
+                 build_strategy: Optional[BuildStrategy] = None,
+                 num_trainers: int = 1, trainer_id: int = 0,
+                 scope: Optional[Scope] = None):
+        self._program = main_program or default_main_program()
+        self._scope = scope or (share_vars_from._scope
+                                if share_vars_from else global_scope())
+        self._exe = Executor(XLAPlace(0))
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy)
+
+    @property
+    def device_count(self) -> int:
+        import jax
+
+        return len(jax.devices())
+
+    def run(self, fetch_list: List, feed=None, feed_dict=None,
+            return_numpy: bool = True):
+        feed = feed if feed is not None else feed_dict
+        if isinstance(feed, (list, tuple)):
+            # per-device feed list: concatenate along the batch axis (the
+            # compiled program re-splits across the mesh)
+            merged = {}
+            for k in feed[0]:
+                merged[k] = np.concatenate(
+                    [np.asarray(f[k]) for f in feed], axis=0)
+            feed = merged
+        outs = self._exe.run(self._compiled, feed=feed or {},
+                             fetch_list=list(fetch_list),
+                             scope=self._scope)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs

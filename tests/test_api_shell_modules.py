@@ -1,0 +1,88 @@
+"""Top-level API shell modules: fluid.ParallelExecutor, fluid.average,
+fluid.lod_tensor, fluid.DataFeedDesc — parity with
+parallel_executor.py:60, average.py:30, lod_tensor.py:25,
+data_feed_desc.py:27."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_parallel_executor_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(
+                fluid.layers.fc(x, 3), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main, scope=scope)
+        rng = np.random.RandomState(0)
+        xb = rng.rand(8, 4).astype("float32")
+        yb = xb[:, :3].argmax(1).astype("int64").reshape(8, 1)
+        ls = [float(np.mean(pe.run([loss.name],
+                                   feed={"x": xb, "y": yb})[0]))
+              for _ in range(8)]
+    assert ls[-1] < ls[0]
+    # per-device feed list form merges along batch
+    with fluid.scope_guard(scope):
+        out = pe.run([loss.name], feed=[{"x": xb[:4], "y": yb[:4]},
+                                        {"x": xb[4:], "y": yb[4:]}])
+    assert np.isfinite(np.mean(out[0]))
+
+
+def test_lod_tensor_round_trip():
+    t = fluid.create_lod_tensor(
+        np.arange(6).reshape(6, 1).astype("float32"), [[2, 4]],
+        fluid.CPUPlace())
+    assert t.recursive_sequence_lengths() == [[2, 4]]
+    assert t.lod() == [[0, 2, 6]]
+    padded = np.asarray(t)
+    assert padded.shape == (2, 4, 1)
+    np.testing.assert_allclose(padded[0, :2, 0], [0, 1])
+    np.testing.assert_allclose(padded[1, :, 0], [2, 3, 4, 5])
+    np.testing.assert_array_equal(t.lengths, [2, 4])
+    r = fluid.create_random_int_lodtensor([[3, 1]], [1],
+                                          fluid.CPUPlace(), 0, 9)
+    assert np.asarray(r).shape == (2, 3, 1)
+    assert np.asarray(r).max() <= 9
+
+
+def test_weighted_average():
+    w = fluid.average.WeightedAverage()
+    with pytest.raises(ValueError):
+        w.eval()
+    w.add(2.0, 1.0)
+    w.add(4.0, 3.0)
+    np.testing.assert_allclose(w.eval(), 3.5)
+    w.reset()
+    w.add(1.0, 1.0)
+    np.testing.assert_allclose(w.eval(), 1.0)
+
+
+def test_data_feed_desc(tmp_path):
+    proto = tmp_path / "feed.proto"
+    proto.write_text(
+        'name: "MultiSlotDataFeed"\nbatch_size: 2\n'
+        'slots {\n  name: "words"\n  type: "uint64"\n'
+        '  is_dense: false\n  is_used: false\n}\n'
+        'slots {\n  name: "label"\n  type: "uint64"\n'
+        '  is_dense: false\n  is_used: false\n}\n')
+    d = fluid.DataFeedDesc(str(proto))
+    d.set_batch_size(128)
+    d.set_use_slots(["words", "label"])
+    d.set_dense_slots(["label"])
+    out = d.desc()
+    assert "batch_size: 128" in out
+    assert out.count("is_used: true") == 2
+    assert out.count("is_dense: true") == 1
+    with pytest.raises(ValueError):
+        d.set_use_slots(["nope"])

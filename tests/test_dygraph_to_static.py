@@ -231,3 +231,34 @@ def test_convert_call_preserves_helper_decorators():
     assert _decorated_helper(2.0) == 200.0
     assert conv(2.0) == 200.0
     assert conv(20.0) == _decorated_helper(20.0) == 1000.0
+
+
+def test_convert_call_bound_methods():
+    """A method with tensor control flow, called via self.<m>(), stages
+    through convert_call's MethodType path."""
+    import paddle_tpu.dygraph.nn as nn
+
+    class Net(dg.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(3, 3)
+
+        def clamp_grow(self, h, cap):
+            while h.value.sum() < cap:
+                h = h + 1.0
+            return h
+
+        @declarative
+        def forward(self, x):
+            h = x * 0.0
+            h = self.clamp_grow(h, 5.0)
+            return self.fc(h)
+
+    with dg.guard():
+        net = Net()
+        out = net(to_variable(np.zeros((1, 3), "float32")))
+        # h grows by +1.0 over 3 elements until sum >= 5 -> h = 2.0 each
+        w = np.asarray(net.fc.weight.value)
+        b = np.asarray(net.fc.bias.value)
+        want = np.full((1, 3), 2.0) @ w + b
+        np.testing.assert_allclose(_np(out), want, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,81 @@
+"""fluid.evaluator — deprecated Evaluator API parity
+(python/paddle/fluid/evaluator.py:118,197,273): program-state
+accumulation across batches + reset."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_chunk_evaluator_accumulates_and_resets():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("inp", [8], dtype="int64")
+        lab = fluid.layers.data("lab", [8], dtype="int64")
+        ln = fluid.layers.data("ln", [], dtype="int64")
+        ev = fluid.evaluator.ChunkEvaluator(inp, lab, "IOB", 2,
+                                            seq_length=ln)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    i = np.asarray([[1, 2, 0, 3, 4, 0, 0, 0]], "int64")
+    l = np.asarray([[1, 2, 0, 1, 4, 0, 0, 0]], "int64")
+    n = np.asarray([5], "int64")
+    exe.run(main, feed={"inp": i, "lab": l, "ln": n}, fetch_list=[])
+    p1, r1, f1 = ev.eval(exe)
+    exe.run(main, feed={"inp": i, "lab": l, "ln": n}, fetch_list=[])
+    p2, r2, f2 = ev.eval(exe)
+    # same batch twice: ratios unchanged, counters doubled
+    np.testing.assert_allclose(p1, p2)
+    np.testing.assert_allclose(r1, r2)
+    assert float(p1[0]) > 0
+    ev.reset(exe)
+    p3, _, _ = ev.eval(exe)
+    assert float(p3[0]) == 0.0
+
+
+def test_edit_distance_evaluator():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", [6], dtype="int64")
+        b = fluid.layers.data("b", [6], dtype="int64")
+        ev = fluid.evaluator.EditDistance(a, b)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    av = np.asarray([[1, 2, 3, 0, 0, 0], [1, 2, 3, 4, 0, 0]], "int64")
+    bv = np.asarray([[1, 2, 4, 0, 0, 0], [1, 2, 3, 4, 0, 0]], "int64")
+    exe.run(main, feed={"a": av, "b": bv}, fetch_list=[])
+    d, err = ev.eval(exe)
+    # one of two sequences differs -> instance error rate 0.5
+    np.testing.assert_allclose(float(err[0]), 0.5)
+    assert float(d[0]) > 0
+    ev.reset(exe)
+    d0, err0 = ev.eval(exe)
+    assert float(err0[0]) == 0.0
+
+
+def test_evaluator_detection_map_delegates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", [6], dtype="float32")
+        gtl = fluid.layers.data("gtl", [1], dtype="float32")
+        gtb = fluid.layers.data("gtb", [4], dtype="float32")
+        m = fluid.evaluator.DetectionMAP(det, gtl, gtb, class_num=3)
+        cur, accum = m.get_map_var()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(main, feed={
+        "det": np.asarray([[1, 0.9, 0.1, 0.1, 0.3, 0.3]], "float32"),
+        "gtl": np.asarray([[1.0]], "float32"),
+        "gtb": np.asarray([[0.1, 0.1, 0.3, 0.3]], "float32")},
+        fetch_list=[cur, accum])
+    np.testing.assert_allclose(float(np.asarray(out[0])), 1.0)
+
+
+def test_metrics_chunk_and_edit_distance_classes():
+    m = fluid.metrics.ChunkEvaluator()
+    m.update(np.array([5]), np.array([4]), np.array([3]))
+    p, r, f1 = m.eval()
+    np.testing.assert_allclose([p, r], [0.6, 0.75])
+    e = fluid.metrics.EditDistance()
+    e.update(np.array([0.0, 2.0, 1.0]), 3)
+    d, ir = e.eval()
+    np.testing.assert_allclose([d, ir], [1.0, 2 / 3])

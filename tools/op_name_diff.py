@@ -29,16 +29,21 @@ BY_DESIGN = {
     "run_program": "@declarative jit staging (dygraph/jit.py)",
     "read": "reader.py / dataset.py host feeding",
     "create_custom_reader": "reader.py decorators",
-    # macro parameter inside elementwise_op.h, not a real op
-    "op_type": "registration-macro artifact",
 }
 
 
 def reference_op_names(ref_root: str):
     names = set()
     op_dir = os.path.join(ref_root, "paddle/fluid/operators")
-    pat = re.compile(
-        r"REGISTER_(?:OPERATOR|OP_WITHOUT_GRADIENT)\(\s*([a-z0-9_]+)\s*,")
+    # direct registrations, macro wrappers (elementwise_op.h:364
+    # REGISTER_ELEMWISE_*), and kernel registrations (which always spell
+    # the literal op name even when REGISTER_OPERATOR is macro-wrapped)
+    pats = [
+        re.compile(r"REGISTER_(?:OPERATOR|OP_WITHOUT_GRADIENT)"
+                   r"\(\s*([a-z0-9_]+)\s*,"),
+        re.compile(r"REGISTER_ELEMWISE[A-Z_]*\(\s*([a-z0-9_]+)\s*,"),
+        re.compile(r"REGISTER_OP_(?:CPU|CUDA)_KERNEL\(\s*([a-z0-9_]+)\s*,"),
+    ]
     for root, _dirs, files in os.walk(op_dir):
         for f in files:
             if not f.endswith((".cc", ".cu", ".h")):
@@ -47,7 +52,10 @@ def reference_op_names(ref_root: str):
                 txt = open(os.path.join(root, f)).read()
             except OSError:
                 continue
-            names.update(pat.findall(txt))
+            for pat in pats:
+                names.update(pat.findall(txt))
+    # macro parameter names leaking from #define bodies, not real ops
+    names -= {"op_type", "kernel_type", "op_name", "name"}
     return names
 
 
