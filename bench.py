@@ -180,9 +180,23 @@ def launcher():
 
     if result is None:
         result = {"metric": "gpt_train_tokens_per_sec_per_chip", "value": 0.0,
-                  "unit": "tokens/s", "vs_baseline": 0.0, "degraded": True,
+                  "unit": "tokens/s", "vs_baseline": None, "degraded": True,
                   "detail": {"error": "all bench attempts failed/timed out"}}
     result.setdefault("degraded", False)
+    if result.get("degraded"):
+        # a CPU toy's MFU-shaped number must never masquerade as the hardware
+        # yardstick: null it and say why, keeping the raw value in detail
+        det = result.setdefault("detail", {})
+        det["degraded_reason"] = (
+            ("accelerator bench attempts failed/timed out after a successful "
+             "probe" if saw_accelerator else
+             "accelerator probe failed" if _expects_accelerator() else
+             "no accelerator expected and the CPU bench itself failed") +
+            "; CPU fallback — vs_baseline (MFU) is only meaningful on the "
+            "real chip")
+        if result.get("vs_baseline") is not None:
+            det["cpu_mfu_not_comparable"] = result["vs_baseline"]
+        result["vs_baseline"] = None
     print(json.dumps(result), flush=True)
 
 

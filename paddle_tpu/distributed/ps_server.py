@@ -82,6 +82,7 @@ def recv_msg(sock: socket.socket):
     if hdr is None:
         return None
     obj = json.loads(hdr.decode())
+    consumed = json_len
     for _ in range(ntensor):
         meta = _recv_exact(sock, _THDR.size)
         if meta is None:
@@ -98,8 +99,20 @@ def recv_msg(sock: socket.socket):
         data = _recv_exact(sock, data_len)
         if data is None:
             return None
+        # tensors merge into the same dict as the JSON scalars: a peer that
+        # names a tensor after a control field ('status', 'cmd', ...) could
+        # shadow it with an ndarray — refuse the collision outright
+        if name in obj:
+            raise ConnectionError(
+                f"PS tensor name {name!r} collides with a header field")
         arr = np.frombuffer(data, dtype=np.lib.format.descr_to_dtype(descr))
         obj[name] = arr.reshape(shape)
+        consumed += _THDR.size + name_len + dt_len + 8 * ndim + data_len
+    # the frame declared json_len + tensor-section bytes up front; a mismatch
+    # means a corrupt or lying peer and would desync every later frame
+    if consumed != total_len:
+        raise ConnectionError(
+            f"PS frame length mismatch: declared {total_len}, read {consumed}")
     return obj
 
 

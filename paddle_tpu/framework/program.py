@@ -356,7 +356,11 @@ class Program:
     OP_ROLE_FORWARD = 0
     OP_ROLE_BACKWARD = 1
     OP_ROLE_OPTIMIZE = 2
+    OP_ROLE_RPC = 4
+    OP_ROLE_DIST = 8
     OP_ROLE_LRSCHED = 16
+    OP_ROLE_LOSS = 0x100          # OR'd onto Forward/Backward on the loss op
+    OP_ROLE_NOT_SPECIFIED = 0x1000
 
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
@@ -458,9 +462,14 @@ class Program:
             for op in blk.ops:
                 if for_test and op.attr("is_test_skip", False):
                     continue
-                # drop backward/optimize/lr-sched ops — reference
-                # clone(for_test=True) keeps only the forward slice
-                if for_test and int(op.attr("op_role", 0) or 0) != 0:
+                # drop backward/optimize/lr-sched ops — op_role is a BITMASK
+                # (reference op_proto_maker.h: Loss=0x100 ORs onto Forward, so
+                # a loss op stamped Forward|Loss=256 must survive the clone);
+                # prune only when a backward/optimize/lr-sched bit is set,
+                # mirroring the reference's _is_backward_op/_is_optimize_op
+                if for_test and int(op.attr("op_role", 0) or 0) & (
+                        Program.OP_ROLE_BACKWARD | Program.OP_ROLE_OPTIMIZE |
+                        Program.OP_ROLE_LRSCHED):
                     continue
                 nop = Operator(
                     nb,

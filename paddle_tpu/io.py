@@ -6,7 +6,8 @@ Artifacts use the reference's on-disk formats so models interchange with it:
 `__model__` is a binary proto2 ProgramDesc (framework/framework.proto) with
 feed/fetch ops appended exactly like the reference's save_inference_model;
 params are LoDTensor streams (tensor_util.cc TensorToStream) — one file per
-var, or one save_combine stream (sorted by name) when a filename is given.
+var, or one save_combine stream (program var-declaration order — positional,
+no names in the stream) when a filename is given.
 The codec lives in framework/paddle_pb.py; legacy JSON/.npz artifacts from
 earlier versions of this repo still load (format is sniffed). Orbax-style
 async sharded checkpointing for the distributed path lives in
@@ -58,7 +59,10 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None):
     """filename=None saves one reference-format tensor file per var (the
     reference's per-var `save` ops); a filename saves one save_combine stream
-    with vars in sorted-name order (reference io.py save_vars)."""
+    with vars in program var-declaration order (reference io.py save_vars —
+    the stream is positional and carries no names, so save and load must
+    iterate the same order; earlier repo revisions wrote sorted-name order,
+    and combined files from those revisions will not load positionally)."""
     main_program = main_program or default_main_program()
     if vars is None:
         vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]

@@ -104,14 +104,28 @@ def fusion_seqpool_cvm_concat(ctx, op, ins):
     sum-pool, CVM transform, concat (CTR serving path). Padded [B,T,D]
     inputs; CVM keeps width (use_cvm=True layout: cols 0,1 are show/click
     -> log transforms, ops/ctr.py cvm)."""
-    pool = str(op.attr("pooltype", "SUM"))
     use_cvm = bool(op.attr("use_cvm", True))
     cvm_spec = get_op_spec("cvm")
+    pool_spec = get_op_spec("sequence_pool")
+    # Padded convention: optional Lengths (one (B,) tensor per X, or a single
+    # shared one) carries each sequence's true length — the reference divides
+    # AVERAGE by the LoD length, not the padded extent.  The masked-length
+    # pooling itself is sequence_pool's job (same pooltype attr contract).
+    lengths = ins.get("Lengths") or ins.get("Length") or []
+    if lengths and len(lengths) not in (1, len(ins["X"])):
+        raise ValueError(
+            f"fusion_seqpool_cvm_concat: got {len(lengths)} Lengths for "
+            f"{len(ins['X'])} X inputs (want 1 shared or one per input)")
     pieces = []
-    for x in ins["X"]:
-        p = jnp.sum(x, axis=1) if x.ndim == 3 else x
-        if pool == "AVERAGE" and x.ndim == 3:
-            p = p / x.shape[1]
+    for i, x in enumerate(ins["X"]):
+        if x.ndim == 3:
+            pool_ins = {"X": [x]}
+            if lengths:
+                pool_ins["Length"] = [
+                    lengths[i] if len(lengths) > 1 else lengths[0]]
+            p = pool_spec.lower(ctx, op, pool_ins)["Out"]
+        else:
+            p = x
         if use_cvm:
             p = cvm_spec.lower(ctx, op, {"X": [p], "CVM": ins.get("CVM")}
                                )["Y"]

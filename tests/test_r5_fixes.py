@@ -1,0 +1,122 @@
+"""Regression tests for the round-4 advisor findings.
+
+- clone(for_test) must treat op_role as a bitmask (reference
+  op_proto_maker.h: Loss=0x100 ORs onto Forward) — a reference-deserialized
+  loss op stamped Forward|Loss must survive the test clone.
+- The PS framed wire must reject tensor names that shadow header fields and
+  frames whose declared total_len disagrees with the bytes on the wire.
+- fusion_seqpool_cvm_concat AVERAGE divides by each sequence's true length
+  when a Lengths input is given (reference divides by the LoD length).
+"""
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed import ps_server
+from tests.test_tail_ops import run_op
+
+
+def _toy_program(loss_role):
+    main = fluid.Program()
+    blk = main.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="y", shape=[4], dtype="float32")
+    blk.create_var(name="z", shape=[1], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["y"]},
+                  attrs={"op_role": fluid.Program.OP_ROLE_FORWARD})
+    blk.append_op(type="reduce_mean", inputs={"X": ["y"]},
+                  outputs={"Out": ["z"]}, attrs={"op_role": loss_role})
+    return main
+
+
+def test_clone_for_test_keeps_forward_loss_bit():
+    # Forward|Loss = 0x100: nonzero role, but still part of the forward slice
+    main = _toy_program(fluid.Program.OP_ROLE_LOSS)
+    ops = [op.type for op in main.clone(for_test=True).global_block().ops]
+    assert ops == ["relu", "reduce_mean"]
+
+
+def test_clone_for_test_drops_backward_loss_bit():
+    # Backward|Loss = 0x101: the loss-grad op must still be pruned
+    main = _toy_program(
+        fluid.Program.OP_ROLE_BACKWARD | fluid.Program.OP_ROLE_LOSS)
+    ops = [op.type for op in main.clone(for_test=True).global_block().ops]
+    assert ops == ["relu"]
+
+
+def _wire_pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_ps_wire_roundtrip_and_scalar_tensor_sections():
+    a, b = _wire_pair()
+    try:
+        msg = {"cmd": "push", "w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        t = threading.Thread(target=ps_server.send_msg, args=(a, msg))
+        t.start()
+        got = ps_server.recv_msg(b)
+        t.join()
+        assert got["cmd"] == "push"
+        np.testing.assert_array_equal(got["w"], msg["w"])
+    finally:
+        a.close(); b.close()
+
+
+def test_ps_wire_rejects_header_shadowing_tensor():
+    a, b = _wire_pair()
+    try:
+        # hand-craft a frame whose tensor is named after the 'status'
+        # control field (send_msg itself can't produce this collision)
+        arr = np.zeros(2, np.float32)
+        hdr = b'{"status":"ok"}'
+        nb, dt = b"status", b"<f4"
+        meta = ps_server._THDR.pack(len(nb), len(dt), arr.ndim, arr.nbytes)
+        meta += nb + dt + struct.pack("<1q", 2)
+        total = len(hdr) + len(meta) + arr.nbytes
+        a.sendall(ps_server._FRAME.pack(ps_server._MAGIC, ps_server._VERSION,
+                                        1, len(hdr), total))
+        a.sendall(hdr + meta + arr.tobytes())
+        with pytest.raises(ConnectionError, match="collides"):
+            ps_server.recv_msg(b)
+    finally:
+        a.close(); b.close()
+
+
+def test_ps_wire_rejects_total_len_mismatch():
+    a, b = _wire_pair()
+    try:
+        arr = np.zeros(2, np.float32)
+        hdr = b'{}'
+        nb, dt = b"w", b"<f4"
+        meta = ps_server._THDR.pack(len(nb), len(dt), arr.ndim, arr.nbytes)
+        meta += nb + dt + struct.pack("<1q", 2)
+        true_total = len(hdr) + len(meta) + arr.nbytes
+        a.sendall(ps_server._FRAME.pack(ps_server._MAGIC, ps_server._VERSION,
+                                        1, len(hdr), true_total + 7))
+        a.sendall(hdr + meta + arr.tobytes())
+        with pytest.raises(ConnectionError, match="length mismatch"):
+            ps_server.recv_msg(b)
+    finally:
+        a.close(); b.close()
+
+
+def test_seqpool_cvm_concat_average_uses_true_lengths():
+    rs = np.random.RandomState(7)
+    a = np.abs(rs.randn(2, 4, 4)).astype("float32")
+    ln = np.asarray([2, 3], "int64")
+    # zero the padding so SUM semantics are unambiguous
+    for i, l in enumerate(ln):
+        a[i, l:] = 0.0
+    cvm = np.ones((2, 2), "float32")
+    out = run_op("fusion_seqpool_cvm_concat",
+                 {"X": [a], "CVM": cvm, "Lengths": [ln]}, ["Out"],
+                 {"pooltype": "AVERAGE", "use_cvm": False})
+    want = a.sum(1) / ln[:, None].astype("float32")
+    np.testing.assert_allclose(out["Out"][0], want, rtol=1e-5)

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Background TPU-availability probe for the axon tunnel.
+#
+# Rules (round-3/4 post-mortem, .claude/skills/verify/SKILL.md): never kill a
+# probe mid-init -- let `import jax` finish naturally even if it hangs for an
+# hour; back off >=20 min between attempts.  On success, drop a marker file so
+# the build loop can launch the single-claim MFU sweep.
+MARKER=/root/repo/.tpu_up
+LOG=/root/repo/.tpu_probe_log
+rm -f "$MARKER"
+attempt=0
+while true; do
+  attempt=$((attempt+1))
+  echo "[probe $attempt] $(date -u +%H:%M:%S) starting" >> "$LOG"
+  python -c "import jax; d=jax.devices()[0]; print('PLATFORM', d.platform, d.device_kind)" \
+      > /root/repo/.tpu_probe_out 2>&1
+  rc=$?
+  echo "[probe $attempt] $(date -u +%H:%M:%S) rc=$rc: $(tail -1 /root/repo/.tpu_probe_out)" >> "$LOG"
+  if [ $rc -eq 0 ] && grep -q "PLATFORM tpu" /root/repo/.tpu_probe_out; then
+    date -u > "$MARKER"
+    echo "[probe $attempt] TPU UP" >> "$LOG"
+    exit 0
+  fi
+  sleep 1500
+done
