@@ -179,3 +179,58 @@ def install_check():
     assert _np.isfinite(_np.asarray(out[0])).all()
     print("Your paddle_tpu works well on this machine.")
     return True
+
+
+# ---------------------------------------------------------------------------
+# paddle-2.0-preview namespaces + top-level aliases
+# (reference python/paddle/__init__.py — the DEFINE_ALIAS block)
+# ---------------------------------------------------------------------------
+from . import tensor  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import imperative  # noqa: F401,E402
+from . import declarative  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from .framework.random import manual_seed  # noqa: F401,E402
+
+from .tensor.attribute import rank, shape  # noqa: F401,E402
+from .tensor.creation import (  # noqa: F401,E402
+    arange, create_tensor, crop_tensor, diag, eye, full, full_like,
+    linspace, meshgrid, ones, ones_like, tril, triu, zeros, zeros_like,
+)
+from .tensor.linalg import (  # noqa: F401,E402
+    bmm, cholesky, cross, dist, dot, histogram, matmul, t,
+)
+from .tensor.logic import (  # noqa: F401,E402
+    allclose, elementwise_equal, equal, greater_equal, greater_than,
+    is_empty, isfinite, less_equal, less_than, logical_and, logical_not,
+    logical_or, logical_xor, not_equal, reduce_all, reduce_any,
+)
+from .tensor.manipulation import (  # noqa: F401,E402
+    cast, concat, expand, expand_as, flatten, flip, gather, gather_nd,
+    reshape, reverse, roll, scatter, scatter_nd, scatter_nd_add,
+    shard_index, slice, split, squeeze, stack, strided_slice, unbind,
+    unique, unique_with_counts, unsqueeze, unstack,
+)
+from .tensor.math import (  # noqa: F401,E402
+    abs, acos, add, addcmul, addmm, asin, atan, ceil, clamp, cos, cumsum,
+    div, elementwise_add, elementwise_div, elementwise_floordiv,
+    elementwise_max, elementwise_min, elementwise_mod, elementwise_mul,
+    elementwise_pow, elementwise_sub, elementwise_sum, erf, exp, floor,
+    increment, inverse, kron, log, log1p, logsumexp, max, min, mm, mul,
+    multiplex, pow, reciprocal, reduce_max, reduce_min, reduce_prod,
+    reduce_sum, round, rsqrt, scale, sign, sin, sqrt, square, stanh, sum,
+    sums, tanh, trace,
+)
+from .tensor.random import rand, randint, randn, randperm, shuffle  # noqa: F401,E402
+from .tensor.search import (  # noqa: F401,E402
+    argmax, argmin, argsort, has_inf, has_nan, index_sample, index_select,
+    nonzero, sort, topk, where,
+)
+from .tensor.stat import mean, reduce_mean, std, var  # noqa: F401,E402
+
+from .framework import (  # noqa: F401,E402
+    append_backward as append_backward,  # re-export parity
+    create_global_var, create_parameter, name_scope,
+)
+from .dygraph.base import in_dygraph_mode as in_imperative_mode  # noqa: F401,E402

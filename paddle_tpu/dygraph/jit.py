@@ -173,7 +173,16 @@ class _StaticFunction:
                     else ("py", v) for v in vals)
         if key not in cache:
             pure, names = self._pure(layer, call_with_self)
-            cache[key] = (jax.jit(pure), names)
+            # python scalars stay STATIC (reference contract: non-tensor
+            # args are plain python values inside the staged function, so
+            # `range(n)` / `i >= k` unroll concretely); position 0 is the
+            # param_vals list
+            static = tuple(
+                i + 1 for i, v in enumerate(vals)
+                if not hasattr(v, "shape")
+                and isinstance(v, (int, float, bool, str, bytes,
+                                   type(None))))
+            cache[key] = (jax.jit(pure, static_argnums=static), names)
         jitted, names = cache[key]
         sd = layer.state_dict() if layer is not None else {}
         param_vals = [sd[k].value for k in names]

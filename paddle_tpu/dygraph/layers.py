@@ -72,6 +72,12 @@ class Layer:
                 out.extend(l.parameters())
         return out
 
+    def clear_gradients(self):
+        """Reference dygraph/layers.py Layer.clear_gradients — zero every
+        parameter's accumulated gradient."""
+        for p in self.parameters():
+            p.clear_gradient()
+
     def named_parameters(self, prefix="") -> Iterator[Tuple[str, VarBase]]:
         for name, p in self._parameters.items():
             yield (f"{prefix}.{name}" if prefix else name), p

@@ -141,6 +141,29 @@ class VarBase:
     def __truediv__(self, o):
         return self._bin(o, jnp.divide)
 
+    def __rtruediv__(self, o):
+        return self._bin(o, jnp.divide, True)
+
+    def __pow__(self, o):
+        return self._bin(o, jnp.power)
+
+    def __matmul__(self, o):
+        return self._bin(o, jnp.matmul)
+
+    # comparisons — reference math_op_patch monkey_patch_variable installs
+    # these on VarBase too; comparisons carry no gradient
+    def __lt__(self, o):
+        return self._bin(o, jnp.less)
+
+    def __le__(self, o):
+        return self._bin(o, jnp.less_equal)
+
+    def __gt__(self, o):
+        return self._bin(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._bin(o, jnp.greater_equal)
+
     def __neg__(self):
         return apply_op(jnp.negative, self)
 
@@ -151,7 +174,14 @@ class VarBase:
         return f"VarBase(name={self.name}, shape={self.shape}, dtype={self.dtype})\n{self.value}"
 
     def __len__(self):
+        if not self.value.shape:
+            raise TypeError("len() of a 0-d VarBase")
         return int(self.value.shape[0])
+
+    def __bool__(self):
+        # concrete scalars truth-test like numpy; traced values raise
+        # jax's concretization error pointing at the @declarative fix
+        return bool(self.value)
 
 
 def _unwrap(v):

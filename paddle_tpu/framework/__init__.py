@@ -7,5 +7,29 @@ from .program import (  # noqa: F401
     Variable,
     default_main_program,
     default_startup_program,
+    name_scope,
     program_guard,
 )
+
+# paddle-2.0-preview `paddle.framework` surface (reference
+# python/paddle/framework/__init__.py) — aliases of the fluid machinery plus
+# the random-seed control.
+from . import random  # noqa: F401
+from .random import manual_seed  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .core import CPUPlace, TPUPlace, XLAPlace  # noqa: F401
+from .executor import Executor, global_scope, scope_guard  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+
+def __getattr__(name):
+    # layer-built entries of the 2.0 surface resolve lazily: the layers
+    # package imports framework, so a top-level import here would cycle
+    if name in ("Print", "py_func", "create_global_var", "create_parameter"):
+        from .. import layers
+        return getattr(layers, name)
+    if name == "ParallelExecutor":
+        from ..parallel_executor import ParallelExecutor
+        return ParallelExecutor
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,19 @@
+"""paddle.framework.random — parity with python/paddle/framework/random.py
+(manual_seed).
+
+Seeds both static programs (Program.random_seed feeds the executor's rng
+stream) and the dygraph eager rng stream.
+"""
+from __future__ import annotations
+
+from .program import default_main_program, default_startup_program
+
+__all__ = ["manual_seed"]
+
+
+def manual_seed(seed: int) -> None:
+    seed = int(seed)
+    default_main_program().random_seed = seed
+    default_startup_program().random_seed = seed
+    from ..tensor._dispatch import reset_eager_seed
+    reset_eager_seed(seed)

@@ -1,0 +1,19 @@
+"""paddle.imperative — parity with python/paddle/imperative/__init__.py
+(aliases of the fluid dygraph surface)."""
+from .dygraph import (  # noqa: F401
+    CosineDecay, DataParallel, ExponentialDecay, InverseTimeDecay,
+    NaturalExpDecay, NoamDecay, PiecewiseDecay, PolynomialDecay,
+    ProgramTranslator, TracedLayer, declarative, enabled, grad, guard,
+    no_grad, to_variable,
+)
+from .dygraph.checkpoint import load_dygraph as load  # noqa: F401
+from .dygraph.checkpoint import save_dygraph as save  # noqa: F401
+from .dygraph.parallel import ParallelEnv, prepare_context  # noqa: F401
+
+__all__ = [
+    "enabled", "grad", "guard", "load", "save", "prepare_context",
+    "to_variable", "TracedLayer", "no_grad", "ParallelEnv",
+    "ProgramTranslator", "declarative", "DataParallel", "NoamDecay",
+    "PiecewiseDecay", "NaturalExpDecay", "ExponentialDecay",
+    "InverseTimeDecay", "PolynomialDecay", "CosineDecay",
+]

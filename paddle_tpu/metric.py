@@ -1,0 +1,17 @@
+"""paddle.metric — parity with python/paddle/metric/__init__.py (aliases of
+the fluid metrics classes + metric layer ops)."""
+from .metrics import (  # noqa: F401
+    Accuracy, Auc, ChunkEvaluator, CompositeMetric, DetectionMAP,
+    EditDistance, Precision, Recall,
+)
+
+__all__ = ["Accuracy", "Auc", "ChunkEvaluator", "CompositeMetric",
+           "DetectionMAP", "EditDistance", "Precision", "Recall",
+           "accuracy", "auc", "chunk_eval", "cos_sim", "mean_iou"]
+
+
+def __getattr__(name):
+    if name in ("accuracy", "auc", "chunk_eval", "cos_sim", "mean_iou"):
+        from . import layers
+        return getattr(layers, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

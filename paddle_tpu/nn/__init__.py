@@ -1,0 +1,29 @@
+"""paddle.nn — the paddle-2.0-preview neural-network namespace, parity with
+python/paddle/nn/__init__.py."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import layer  # noqa: F401
+from .clip import (  # noqa: F401
+    GradientClipByGlobalNorm, GradientClipByNorm, GradientClipByValue, clip,
+    clip_by_norm,
+)
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from .decode import beam_search, beam_search_decode, gather_tree  # noqa: F401
+from .input import data  # noqa: F401
+from .layer import conv, loss  # noqa: F401
+from .layer.activation import HSigmoid, LogSoftmax, ReLU, Sigmoid  # noqa: F401
+from .layer.common import (  # noqa: F401
+    BilinearTensorProduct, Embedding, Linear, Pool2D, UpSample,
+)
+from .layer.conv import (  # noqa: F401
+    Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
+)
+from .layer.extension import RowConv  # noqa: F401
+from .layer.loss import (  # noqa: F401
+    BCELoss, CrossEntropyLoss, L1Loss, MSELoss, NLLLoss,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, GroupNorm, InstanceNorm, LayerNorm, SpectralNorm,
+)
+from ..dygraph.layers import Layer  # noqa: F401
+from ..dygraph.containers import LayerList, ParameterList, Sequential  # noqa: F401

@@ -1,0 +1,17 @@
+"""paddle.nn.clip — parity with python/paddle/nn/clip.py (gradient-clip
+class + functional aliases)."""
+from ..clip import (  # noqa: F401
+    GradientClipByGlobalNorm, GradientClipByNorm, GradientClipByValue,
+)
+from ..tensor._dispatch import dispatch
+
+__all__ = ["GradientClipByGlobalNorm", "GradientClipByNorm",
+           "GradientClipByValue", "clip", "clip_by_norm"]
+
+
+def clip(x, min, max, name=None):
+    return dispatch("clip", {"X": x}, {"min": float(min), "max": float(max)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return dispatch("clip_by_norm", {"X": x}, {"max_norm": float(max_norm)})

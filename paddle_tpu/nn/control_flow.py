@@ -1,0 +1,4 @@
+"""paddle.nn.control_flow — case/cond/switch_case/while_loop aliases."""
+from ..layers import case, cond, switch_case, while_loop  # noqa: F401
+
+__all__ = ["case", "cond", "switch_case", "while_loop"]

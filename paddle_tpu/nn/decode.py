@@ -1,0 +1,4 @@
+"""paddle.nn.decode — beam-search aliases."""
+from ..layers import beam_search, beam_search_decode, gather_tree  # noqa: F401
+
+__all__ = ["beam_search", "beam_search_decode", "gather_tree"]

@@ -1,0 +1,48 @@
+"""paddle.nn.functional — parity with
+python/paddle/nn/functional/__init__.py."""
+from . import activation, common, conv, extension, learning_rate, lod, \
+    loss, norm, pooling, vision  # noqa: F401
+from .activation import (  # noqa: F401
+    brelu, elu, erf, gelu, hard_shrink, hard_sigmoid, hard_swish, hsigmoid,
+    leaky_relu, log_softmax, logsigmoid, maxout, relu, relu6, selu, sigmoid,
+    soft_relu, softmax, softplus, softshrink, softsign, swish, tanh_shrink,
+    thresholded_relu,
+)
+from .common import (  # noqa: F401
+    assign, dropout, interpolate, label_smooth, one_hot, pad,
+    pad_constant_like, pad2d, unfold,
+)
+from .conv import conv2d, conv2d_transpose, conv3d, conv3d_transpose  # noqa: F401
+from .extension import (  # noqa: F401
+    add_position_encoding, continuous_value_model, diag_embed,
+    filter_by_instag, multiclass_nms, polygon_box_transform, random_crop,
+    row_conv, rpn_target_assign, similarity_focus, target_assign,
+    temporal_shift, warpctc,
+)
+from .learning_rate import (  # noqa: F401
+    cosine_decay, exponential_decay, inverse_time_decay, linear_lr_warmup,
+    natural_exp_decay, noam_decay, piecewise_decay, polynomial_decay,
+)
+from .lod import hash  # noqa: F401
+from .loss import (  # noqa: F401
+    bce_loss, bpr_loss, center_loss, cross_entropy, dice_loss,
+    edit_distance, huber_loss, iou_similarity, kldiv_loss, l1_loss,
+    log_loss, margin_rank_loss, mse_loss, nll_loss, npair_loss, rank_loss,
+    sampled_softmax_with_cross_entropy, sigmoid_cross_entropy_with_logits,
+    sigmoid_focal_loss, smooth_l1, softmax_with_cross_entropy,
+    square_error_cost, ssd_loss, teacher_student_sigmoid_loss,
+)
+from .norm import l2_normalize, lrn  # noqa: F401
+from .pooling import adaptive_pool2d, adaptive_pool3d, pool2d, pool3d  # noqa: F401
+from .vision import (  # noqa: F401
+    affine_channel, affine_grid, anchor_generator, bipartite_match,
+    box_clip, box_coder, box_decoder_and_assign, collect_fpn_proposals,
+    deformable_roi_pooling, density_prior_box, detection_output,
+    distribute_fpn_proposals, fsp_matrix, generate_mask_labels,
+    generate_proposal_labels, generate_proposals, grid_sampler,
+    image_resize, image_resize_short, pixel_shuffle, prior_box, prroi_pool,
+    psroi_pool, resize_bilinear, resize_nearest, resize_trilinear,
+    retinanet_detection_output, retinanet_target_assign, roi_align,
+    roi_perspective_transform, roi_pool, shuffle_channel, space_to_depth,
+    yolo_box, yolov3_loss,
+)

@@ -1,0 +1,120 @@
+"""paddle.nn.functional.activation — parity with
+python/paddle/nn/functional/activation.py (all DEFINE_ALIAS entries).
+
+Single-op activations dispatch through the registry, so they work in both
+dygraph and static mode.
+"""
+from __future__ import annotations
+
+from ...tensor._dispatch import dispatch
+
+__all__ = [
+    "brelu", "elu", "erf", "gelu", "hard_shrink", "hard_sigmoid",
+    "hard_swish", "hsigmoid", "leaky_relu", "logsigmoid", "maxout", "relu",
+    "relu6", "selu", "sigmoid", "soft_relu", "softmax", "softplus",
+    "softshrink", "softsign", "swish", "tanh_shrink", "thresholded_relu",
+    "log_softmax",
+]
+
+
+def _unary(op_type, **default_attrs):
+    def fn(x, name=None, **kw):
+        attrs = dict(default_attrs)
+        attrs.update({k: v for k, v in kw.items() if v is not None})
+        return dispatch(op_type, {"X": x}, attrs)
+    fn.__name__ = op_type
+    fn.__doc__ = f"paddle.nn.functional.{op_type} (activation op alias)."
+    return fn
+
+
+relu = _unary("relu")
+sigmoid = _unary("sigmoid")
+erf = _unary("erf")
+softsign = _unary("softsign")
+logsigmoid = _unary("logsigmoid")
+tanh_shrink = _unary("tanh_shrink")
+soft_relu = _unary("soft_relu")
+hard_swish = _unary("hard_swish")
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch("elu", {"X": x}, {"alpha": float(alpha)})
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch("gelu", {"X": x}, {"approximate": bool(approximate)})
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return dispatch("leaky_relu", {"X": x}, {"alpha": float(alpha)})
+
+
+def relu6(x, threshold=6.0, name=None):
+    return dispatch("relu6", {"X": x}, {"threshold": float(threshold)})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch("selu", {"X": x},
+                    {"scale": float(scale), "alpha": float(alpha)})
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return dispatch("brelu", {"X": x},
+                    {"t_min": float(t_min), "t_max": float(t_max)})
+
+
+def hard_shrink(x, threshold=0.5, name=None):
+    return dispatch("hard_shrink", {"X": x}, {"threshold": float(threshold)})
+
+
+def softshrink(x, alpha=0.5, name=None):
+    return dispatch("softshrink", {"X": x}, {"lambda": float(alpha)})
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return dispatch("thresholded_relu", {"X": x},
+                    {"threshold": float(threshold)})
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return dispatch("hard_sigmoid", {"X": x},
+                    {"slope": float(slope), "offset": float(offset)})
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return dispatch("softplus", {"X": x},
+                    {"beta": float(beta), "threshold": float(threshold)})
+
+
+def swish(x, beta=1.0, name=None):
+    return dispatch("swish", {"X": x}, {"beta": float(beta)})
+
+
+def maxout(x, groups, name=None, axis=1):
+    return dispatch("maxout", {"X": x},
+                    {"groups": int(groups), "axis": int(axis)})
+
+
+def softmax(x, axis=-1, name=None):
+    return dispatch("softmax", {"X": x}, {"axis": int(axis)})
+
+
+def log_softmax(input, axis=None, dtype=None, name=None):
+    """functional/activation.py log_softmax — composed softmax+log; XLA
+    fuses it into the numerically-stable form."""
+    ax = -1 if axis is None else int(axis)
+    if dtype is not None:
+        input = dispatch("cast", {"X": input}, {"out_dtype": str(dtype)},
+                         out_dtypes=str(dtype))
+    sm = dispatch("softmax", {"X": input}, {"axis": ax})
+    return dispatch("log", {"X": sm})
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    from ... import layers as _L
+    return _L.hsigmoid(input, label, num_classes, param_attr=param_attr,
+                       bias_attr=bias_attr, name=name,
+                       path_table=path_table, path_code=path_code,
+                       is_custom=is_custom, is_sparse=is_sparse)

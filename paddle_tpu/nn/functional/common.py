@@ -1,0 +1,79 @@
+"""paddle.nn.functional.common — parity with
+python/paddle/nn/functional/common.py (dropout/pad/one_hot/... aliases).
+"""
+from __future__ import annotations
+
+from ...tensor._dispatch import dispatch, in_dygraph_mode
+
+__all__ = ["dropout", "label_smooth", "one_hot", "pad", "pad_constant_like",
+           "pad2d", "unfold", "assign", "interpolate"]
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            dropout_implementation="downgrade_in_infer", name=None):
+    return dispatch("dropout", {"X": x},
+                    {"dropout_prob": float(dropout_prob),
+                     "is_test": bool(is_test), "seed": seed or 0,
+                     "dropout_implementation": dropout_implementation})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    return dispatch("label_smooth",
+                    {"X": label, "PriorDist": prior_dist},
+                    {"epsilon": float(epsilon)})
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return dispatch("one_hot", {"X": input},
+                    {"depth": int(depth),
+                     "allow_out_of_range": bool(allow_out_of_range)},
+                    out_dtypes="float32", stop_gradient=True)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return dispatch("pad", {"X": x},
+                    {"paddings": [int(p) for p in paddings],
+                     "pad_value": float(pad_value)})
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return dispatch("pad2d", {"X": input},
+                    {"paddings": [int(p) for p in paddings], "mode": mode,
+                     "pad_value": float(pad_value),
+                     "data_format": data_format})
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return dispatch("pad_constant_like", {"X": x, "Y": y},
+                    {"pad_value": float(pad_value)})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from ... import layers as _L
+    return _L.unfold(x, kernel_sizes, strides=strides, paddings=paddings,
+                     dilations=dilations, name=name)
+
+
+def assign(input, output=None):
+    return dispatch("assign", {"X": input})
+
+
+def interpolate(input, out_shape=None, scale=None, name=None,
+                resample="BILINEAR", actual_shape=None, align_corners=True,
+                align_mode=1, data_format="NCHW"):
+    """2.0 interpolate ≙ fluid image_resize — dual-mode over the single
+    interp op (layers/extras.py:200 builds the same attrs)."""
+    op_map = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+              "BICUBIC": "bicubic_interp", "TRILINEAR": "trilinear_interp",
+              "LINEAR": "linear_interp"}
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
+    if out_shape is not None:
+        names = {1: ["out_w"], 2: ["out_h", "out_w"],
+                 3: ["out_d", "out_h", "out_w"]}[len(out_shape)]
+        for n, v in zip(names, out_shape):
+            attrs[n] = int(v)
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return dispatch(op_map[resample.upper()], {"X": input}, attrs)

@@ -1,0 +1,116 @@
+"""paddle.nn.functional.conv — parity with
+python/paddle/nn/functional/conv.py (conv2d:91, conv2d_transpose,
+conv3d, conv3d_transpose).
+
+Unlike the fluid layer (which creates its own filter parameter), these take
+the weight/bias as tensors — the functional 2.0 signature.  The convolution
+itself is the registered conv op (lax.conv_general_dilated on the MXU), so
+both dygraph and static mode share one lowering.
+"""
+from __future__ import annotations
+
+from ...tensor._dispatch import dispatch
+
+__all__ = ["conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose"]
+
+
+def _norm_padding(padding, num_dims):
+    """Accept int | [int]*n | [int]*2n | 'SAME'/'VALID' (conv.py:44
+    _update_padding_nd, minus the batch/channel-dim forms)."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [padding] * num_dims
+    flat = []
+    for p in padding:
+        if isinstance(p, (list, tuple)):
+            flat.extend(int(x) for x in p)
+        else:
+            flat.append(int(p))
+    return flat
+
+
+def _norm_tuple(v, n):
+    return [int(v)] * n if isinstance(v, int) else [int(x) for x in v]
+
+
+def _conv(op_type, ndim, input, weight, bias, padding, stride, dilation,
+          groups, act, data_format):
+    channel_last = data_format in ("NHWC", "NDHWC")
+    attrs = {
+        "strides": _norm_tuple(stride, ndim),
+        "paddings": _norm_padding(padding, ndim),
+        "dilations": _norm_tuple(dilation, ndim),
+        "groups": int(groups),
+        "data_format": data_format,
+    }
+    out = dispatch(op_type, {"Input": input, "Filter": weight}, attrs,
+                   out_slots=("Output",))
+    if bias is not None:
+        axis = ndim + 1 if channel_last else 1
+        out = dispatch("elementwise_add", {"X": out, "Y": bias},
+                       {"axis": axis})
+    if act:
+        out = dispatch(act, {"X": out})
+    return out
+
+
+def conv2d(input, weight, bias=None, padding=0, stride=1, dilation=1,
+           groups=1, use_cudnn=True, act=None, data_format="NCHW",
+           name=None):
+    """conv.py:91 — NCHW/NHWC conv with OIHW weight."""
+    return _conv("conv2d", 2, input, weight, bias, padding, stride,
+                 dilation, groups, act, data_format)
+
+
+def conv3d(input, weight, bias=None, padding=0, stride=1, dilation=1,
+           groups=1, use_cudnn=True, act=None, data_format="NCDHW",
+           name=None):
+    return _conv("conv3d", 3, input, weight, bias, padding, stride,
+                 dilation, groups, act, data_format)
+
+
+def conv2d_transpose(input, weight, bias=None, padding=0, stride=1,
+                     dilation=1, groups=1, use_cudnn=True, act=None,
+                     output_size=None, data_format="NCHW", name=None):
+    channel_last = data_format == "NHWC"
+    attrs = {
+        "strides": _norm_tuple(stride, 2),
+        "paddings": _norm_padding(padding, 2),
+        "dilations": _norm_tuple(dilation, 2),
+        "groups": int(groups),
+        "data_format": data_format,
+    }
+    if output_size is not None:
+        attrs["output_size"] = _norm_tuple(output_size, 2)
+    out = dispatch("conv2d_transpose", {"Input": input, "Filter": weight},
+                   attrs, out_slots=("Output",))
+    if bias is not None:
+        out = dispatch("elementwise_add", {"X": out, "Y": bias},
+                       {"axis": 3 if channel_last else 1})
+    if act:
+        out = dispatch(act, {"X": out})
+    return out
+
+
+def conv3d_transpose(input, weight, bias=None, padding=0, stride=1,
+                     dilation=1, groups=1, use_cudnn=True, act=None,
+                     output_size=None, data_format="NCDHW", name=None):
+    channel_last = data_format == "NDHWC"
+    attrs = {
+        "strides": _norm_tuple(stride, 3),
+        "paddings": _norm_padding(padding, 3),
+        "dilations": _norm_tuple(dilation, 3),
+        "groups": int(groups),
+        "data_format": data_format,
+    }
+    if output_size is not None:
+        attrs["output_size"] = _norm_tuple(output_size, 3)
+    out = dispatch("conv3d_transpose", {"Input": input, "Filter": weight},
+                   attrs, out_slots=("Output",))
+    if bias is not None:
+        out = dispatch("elementwise_add", {"X": out, "Y": bias},
+                       {"axis": 4 if channel_last else 1})
+    if act:
+        out = dispatch(act, {"X": out})
+    return out

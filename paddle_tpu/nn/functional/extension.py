@@ -1,0 +1,30 @@
+"""paddle.nn.functional.extension — long-tail aliases of the fluid layer
+functions (reference nn/functional/extension.py DEFINE_ALIAS list)."""
+from ... import layers as _L
+from ...tensor._dispatch import dispatch
+
+__all__ = [
+    "add_position_encoding", "continuous_value_model", "filter_by_instag",
+    "multiclass_nms", "polygon_box_transform", "random_crop", "row_conv",
+    "rpn_target_assign", "similarity_focus", "target_assign",
+    "temporal_shift", "warpctc", "diag_embed",
+]
+
+add_position_encoding = _L.add_position_encoding
+continuous_value_model = _L.continuous_value_model
+filter_by_instag = _L.filter_by_instag
+multiclass_nms = _L.multiclass_nms
+polygon_box_transform = _L.polygon_box_transform
+random_crop = _L.random_crop
+row_conv = _L.row_conv
+rpn_target_assign = _L.rpn_target_assign
+similarity_focus = _L.similarity_focus
+target_assign = _L.target_assign
+temporal_shift = _L.temporal_shift
+warpctc = _L.warpctc
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return dispatch("diag_embed", {"Input": input},
+                    {"offset": int(offset), "dim1": int(dim1),
+                     "dim2": int(dim2)})

@@ -1,0 +1,15 @@
+"""paddle.nn.functional.learning_rate — decay-schedule aliases."""
+from ...layers import learning_rate_scheduler as _lrs
+
+__all__ = ["cosine_decay", "exponential_decay", "inverse_time_decay",
+           "natural_exp_decay", "noam_decay", "piecewise_decay",
+           "polynomial_decay", "linear_lr_warmup"]
+
+cosine_decay = _lrs.cosine_decay
+exponential_decay = _lrs.exponential_decay
+inverse_time_decay = _lrs.inverse_time_decay
+natural_exp_decay = _lrs.natural_exp_decay
+noam_decay = _lrs.noam_decay
+piecewise_decay = _lrs.piecewise_decay
+polynomial_decay = _lrs.polynomial_decay
+linear_lr_warmup = _lrs.linear_lr_warmup

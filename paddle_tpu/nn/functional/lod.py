@@ -1,0 +1,6 @@
+"""paddle.nn.functional.lod — hash alias."""
+from ... import layers as _L
+
+__all__ = ["hash"]
+
+hash = _L.hash

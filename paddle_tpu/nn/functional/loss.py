@@ -1,0 +1,170 @@
+"""paddle.nn.functional.loss — parity with
+python/paddle/nn/functional/loss.py.
+
+The core 2.0 losses (cross_entropy/mse/l1/nll/bce) are implemented
+dual-mode over registry ops so the nn.layer loss classes train in dygraph;
+the long tail aliases the fluid layer functions (static graph surface).
+"""
+from __future__ import annotations
+
+from ...tensor._dispatch import dispatch
+
+__all__ = [
+    "bpr_loss", "center_loss", "cross_entropy", "dice_loss",
+    "edit_distance", "huber_loss", "iou_similarity", "kldiv_loss",
+    "log_loss", "margin_rank_loss", "mse_loss", "npair_loss", "rank_loss",
+    "sampled_softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "sigmoid_focal_loss", "smooth_l1",
+    "softmax_with_cross_entropy", "square_error_cost", "ssd_loss",
+    "teacher_student_sigmoid_loss", "l1_loss", "nll_loss", "bce_loss",
+]
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return dispatch("reduce_mean", {"X": x},
+                        {"dim": [], "keep_dim": False, "reduce_all": True})
+    if reduction == "sum":
+        return dispatch("reduce_sum", {"X": x},
+                        {"dim": [], "keep_dim": False, "reduce_all": True})
+    return x
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    out = dispatch("softmax_with_cross_entropy", {"Logits": logits,
+                                                  "Label": label},
+                   {"soft_label": bool(soft_label),
+                    "ignore_index": int(ignore_index), "axis": int(axis)},
+                   out_slots=("Loss", "Softmax"))
+    loss, softmax = out
+    return (loss, softmax) if return_softmax else loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False):
+    """loss.py CrossEntropyLoss core — softmax CE over logits."""
+    loss = softmax_with_cross_entropy(input, label, soft_label=soft_label,
+                                      ignore_index=ignore_index)
+    if weight is not None:
+        w = dispatch("gather", {"X": weight, "Index": label})
+        loss = dispatch("elementwise_mul", {"X": loss, "Y": w}, {"axis": -1})
+    return _reduce(loss, reduction)
+
+
+def square_error_cost(input, label):
+    d = dispatch("elementwise_sub", {"X": input, "Y": label}, {"axis": -1})
+    return dispatch("square", {"X": d})
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(square_error_cost(input, label), reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    d = dispatch("elementwise_sub", {"X": input, "Y": label}, {"axis": -1})
+    return _reduce(dispatch("abs", {"X": d}), reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,
+             reduction="mean"):
+    """Negative log-likelihood over log-probability input (reference
+    functional nll_loss semantics, flattened index gather)."""
+    picked = dispatch("index_sample", {"X": input, "Index": label})
+    loss = dispatch("scale", {"X": picked}, {"scale": -1.0})
+    if weight is not None:
+        w = dispatch("gather", {"X": weight, "Index": label})
+        loss = dispatch("elementwise_mul", {"X": loss, "Y": w}, {"axis": -1})
+    return _reduce(loss, reduction)
+
+
+def bce_loss(input, label, weight=None, reduction="mean"):
+    """Binary cross entropy over probabilities (reference BCELoss)."""
+    loss = dispatch("bce_loss", {"X": input, "Label": label})
+    if weight is not None:
+        loss = dispatch("elementwise_mul", {"X": loss, "Y": weight},
+                        {"axis": -1})
+    return _reduce(loss, reduction)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    return dispatch("sigmoid_cross_entropy_with_logits",
+                    {"X": x, "Label": label},
+                    {"ignore_index": int(ignore_index),
+                     "normalize": bool(normalize)})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return dispatch("log_loss", {"Predicted": input, "Labels": label},
+                    {"epsilon": float(epsilon)})
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return dispatch("kldiv_loss", {"X": x, "Target": target},
+                    {"reduction": reduction})
+
+
+def huber_loss(input, label, delta):
+    return dispatch("huber_loss", {"X": input, "Y": label},
+                    {"delta": float(delta)}, out_slots=("Out",))
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    from ... import layers as _L
+    return _L.smooth_l1(x, y, inside_weight=inside_weight,
+                        outside_weight=outside_weight, sigma=sigma)
+
+
+def _alias(name):
+    from ... import layers as _L
+    return getattr(_L, name)
+
+
+def bpr_loss(*a, **k):
+    return _alias("bpr_loss")(*a, **k)
+
+
+def center_loss(*a, **k):
+    return _alias("center_loss")(*a, **k)
+
+
+def dice_loss(*a, **k):
+    return _alias("dice_loss")(*a, **k)
+
+
+def edit_distance(*a, **k):
+    return _alias("edit_distance")(*a, **k)
+
+
+def iou_similarity(*a, **k):
+    return _alias("iou_similarity")(*a, **k)
+
+
+def margin_rank_loss(*a, **k):
+    return _alias("margin_rank_loss")(*a, **k)
+
+
+def npair_loss(*a, **k):
+    return _alias("npair_loss")(*a, **k)
+
+
+def rank_loss(*a, **k):
+    return _alias("rank_loss")(*a, **k)
+
+
+def sampled_softmax_with_cross_entropy(*a, **k):
+    return _alias("sampled_softmax_with_cross_entropy")(*a, **k)
+
+
+def sigmoid_focal_loss(*a, **k):
+    return _alias("sigmoid_focal_loss")(*a, **k)
+
+
+def ssd_loss(*a, **k):
+    return _alias("ssd_loss")(*a, **k)
+
+
+def teacher_student_sigmoid_loss(*a, **k):
+    return _alias("teacher_student_sigmoid_loss")(*a, **k)

@@ -1,0 +1,18 @@
+"""paddle.nn.functional.norm — l2_normalize / lrn aliases."""
+from __future__ import annotations
+
+from ...tensor._dispatch import dispatch
+
+__all__ = ["l2_normalize", "lrn"]
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return dispatch("norm", {"X": x},
+                    {"axis": int(axis), "epsilon": float(epsilon)})
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return dispatch("lrn", {"X": input},
+                    {"n": int(n), "k": float(k), "alpha": float(alpha),
+                     "beta": float(beta), "data_format": data_format})

@@ -1,0 +1,55 @@
+"""paddle.nn.functional.pooling — pool2d/pool3d/adaptive aliases (dual-mode
+over the pool ops)."""
+from __future__ import annotations
+
+from ...tensor._dispatch import dispatch
+
+__all__ = ["pool2d", "pool3d", "adaptive_pool2d", "adaptive_pool3d"]
+
+
+def _ntuple(v, n):
+    return [int(v)] * n if isinstance(v, int) else [int(x) for x in v]
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    return dispatch("pool2d", {"X": input},
+                    {"pooling_type": pool_type,
+                     "ksize": _ntuple(pool_size, 2),
+                     "strides": _ntuple(pool_stride, 2),
+                     "paddings": _ntuple(pool_padding, 2),
+                     "global_pooling": bool(global_pooling),
+                     "ceil_mode": bool(ceil_mode),
+                     "exclusive": bool(exclusive),
+                     "data_format": data_format})
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    return dispatch("pool3d", {"X": input},
+                    {"pooling_type": pool_type,
+                     "ksize": _ntuple(pool_size, 3),
+                     "strides": _ntuple(pool_stride, 3),
+                     "paddings": _ntuple(pool_padding, 3),
+                     "global_pooling": bool(global_pooling),
+                     "ceil_mode": bool(ceil_mode),
+                     "exclusive": bool(exclusive),
+                     "data_format": data_format})
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    return dispatch("pool2d", {"X": input},
+                    {"pooling_type": pool_type,
+                     "ksize": _ntuple(pool_size, 2), "adaptive": True,
+                     "strides": [1, 1], "paddings": [0, 0]})
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    return dispatch("pool3d", {"X": input},
+                    {"pooling_type": pool_type,
+                     "ksize": _ntuple(pool_size, 3), "adaptive": True,
+                     "strides": [1, 1, 1], "paddings": [0, 0, 0]})

@@ -1,0 +1,21 @@
+"""paddle.nn.functional.vision — detection/vision aliases of the fluid
+layer functions (reference nn/functional/vision.py DEFINE_ALIAS list)."""
+from ... import layers as _L
+
+__all__ = [
+    "affine_channel", "affine_grid", "anchor_generator", "bipartite_match",
+    "box_clip", "box_coder", "box_decoder_and_assign",
+    "collect_fpn_proposals", "deformable_roi_pooling", "density_prior_box",
+    "detection_output", "distribute_fpn_proposals", "fsp_matrix",
+    "generate_mask_labels", "generate_proposal_labels", "generate_proposals",
+    "grid_sampler", "image_resize", "image_resize_short", "pixel_shuffle",
+    "prior_box", "prroi_pool", "psroi_pool", "resize_bilinear",
+    "resize_nearest", "resize_trilinear", "retinanet_detection_output",
+    "retinanet_target_assign", "roi_align", "roi_perspective_transform",
+    "roi_pool", "shuffle_channel", "space_to_depth", "yolo_box",
+    "yolov3_loss",
+]
+
+for _name in __all__:
+    globals()[_name] = getattr(_L, _name)
+del _L, _name

@@ -1,0 +1,11 @@
+"""paddle.nn.initializer — parity with python/paddle/nn/initializer (alias
+of the fluid initializers)."""
+from ..framework.initializer import (  # noqa: F401
+    Bilinear, BilinearInitializer, Constant, ConstantInitializer, MSRA,
+    MSRAInitializer, Normal, NormalInitializer, NumpyArrayInitializer,
+    TruncatedNormal, TruncatedNormalInitializer, Uniform,
+    UniformInitializer, Xavier, XavierInitializer,
+)
+
+__all__ = ["Bilinear", "Constant", "MSRA", "Normal", "TruncatedNormal",
+           "Uniform", "Xavier", "NumpyArrayInitializer"]

@@ -1,0 +1,10 @@
+"""paddle.nn.input — the 2.0 `data` alias (fluid.data semantics: batch dim
+included, no implicit -1 prepend)."""
+from ..layers import data as _fluid_data
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return _fluid_data(name, shape, dtype=dtype, lod_level=lod_level,
+                       append_batch_size=False)

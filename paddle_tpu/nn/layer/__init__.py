@@ -1,0 +1,1 @@
+from . import activation, common, conv, extension, loss, norm  # noqa: F401

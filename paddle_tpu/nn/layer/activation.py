@@ -1,0 +1,88 @@
+"""paddle.nn.layer.activation — parity with
+python/paddle/nn/layer/activation.py (ReLU/Sigmoid/LogSoftmax/HSigmoid)."""
+from ...dygraph.layers import Layer
+from .. import functional as F
+
+__all__ = ["ReLU", "Sigmoid", "LogSoftmax", "HSigmoid"]
+
+
+class ReLU(Layer):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, input):
+        return F.relu(input)
+
+
+class Sigmoid(Layer):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, input):
+        return F.sigmoid(input)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, input):
+        return F.log_softmax(input, axis=self._axis)
+
+
+class HSigmoid(Layer):
+    """nn/layer/activation.py HSigmoid — hierarchical softmax head.
+
+    Creates the (num_classes-1, feature) weight and bias and applies the
+    default-tree hierarchical sigmoid (ops registry `hsigmoid` path via the
+    fluid layer in static mode; eager composition in dygraph).
+    """
+
+    def __init__(self, feature_size, num_classes, param_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 dtype="float32"):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=param_attr, dtype=dtype)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1, 1], attr=bias_attr, dtype=dtype, is_bias=True))
+
+    def forward(self, input, label):
+        import jax.numpy as jnp
+
+        from ...dygraph.varbase import apply_op
+
+        num_classes = self._num_classes
+
+        def fn(x, w, label, *b):
+            # default complete-binary-tree path codes, matching the
+            # reference's SimpleCode (matrix_bit_code.h): node index walks
+            # from (label + num_classes) down to the root
+            # fixed path length bounds every leaf's code; shorter paths are
+            # masked out by `valid` below (static shapes for XLA)
+            code_len = max(1, (num_classes - 1).bit_length())
+            lbl = label.reshape(-1).astype(jnp.int32)
+            c = lbl + num_classes
+            loss = jnp.zeros((lbl.shape[0],), x.dtype)
+            for _ in range(code_len):
+                parent = c // 2
+                is_right = (c % 2).astype(x.dtype)
+                valid = parent >= 1
+                idx = jnp.clip(parent - 1, 0, num_classes - 2)
+                logit = jnp.sum(x * w[idx], axis=-1)
+                if b:
+                    logit = logit + b[0][idx, 0]
+                # sigmoid CE against the bit label
+                ce = jnp.maximum(logit, 0) - logit * is_right + \
+                    jnp.log1p(jnp.exp(-jnp.abs(logit)))
+                loss = loss + jnp.where(valid, ce, 0.0)
+                c = parent
+            return loss[:, None]
+
+        args = (input, self.weight, label) + (
+            (self.bias,) if self.bias is not None else ())
+        return apply_op(fn, *args)

@@ -1,0 +1,31 @@
+"""paddle.nn.layer.common — parity with python/paddle/nn/layer/common.py
+(Linear/Embedding/Pool2D/BilinearTensorProduct aliases + UpSample)."""
+from ...dygraph.layers import Layer
+from ...dygraph.nn import (  # noqa: F401
+    BilinearTensorProduct, Embedding, Linear, Pool2D,
+)
+
+__all__ = ["BilinearTensorProduct", "Pool2D", "Embedding", "Linear",
+           "UpSample"]
+
+
+class UpSample(Layer):
+    """nn/layer/common.py UpSample — interpolate as a layer."""
+
+    def __init__(self, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, align_mode=1, data_format="NCHW"):
+        super().__init__()
+        self._out_shape = out_shape
+        self._scale = scale
+        self._resample = resample
+        self._align_corners = align_corners
+        self._align_mode = align_mode
+        self._data_format = data_format
+
+    def forward(self, input):
+        from ..functional.common import interpolate
+        return interpolate(input, out_shape=self._out_shape,
+                           scale=self._scale, resample=self._resample,
+                           align_corners=self._align_corners,
+                           align_mode=self._align_mode,
+                           data_format=self._data_format)

@@ -1,0 +1,8 @@
+"""paddle.nn.layer.conv — parity with python/paddle/nn/layer/conv.py
+(Conv2D/Conv2DTranspose/Conv3D/Conv3DTranspose DEFINE_ALIAS of the dygraph
+layers at 2.0-alpha)."""
+from ...dygraph.nn import (  # noqa: F401
+    Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
+)
+
+__all__ = ["Conv2D", "Conv2DTranspose", "Conv3D", "Conv3DTranspose"]

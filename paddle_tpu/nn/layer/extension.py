@@ -1,0 +1,4 @@
+"""paddle.nn.layer.extension — RowConv alias."""
+from ...dygraph.nn import RowConv  # noqa: F401
+
+__all__ = ["RowConv"]

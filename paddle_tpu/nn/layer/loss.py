@@ -1,0 +1,83 @@
+"""paddle.nn.layer.loss — parity with python/paddle/nn/layer/loss.py
+(CrossEntropyLoss:29, MSELoss:147, L1Loss:251, BCELoss:341, NLLLoss:469).
+"""
+from ...dygraph.layers import Layer
+from ..functional import loss as F
+
+__all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss"]
+
+
+def _check_reduction(reduction):
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"reduction must be 'mean', 'sum' or 'none', got {reduction!r}")
+
+
+class CrossEntropyLoss(Layer):
+    """nn/layer/loss.py:29 — softmax cross entropy over logits."""
+
+    def __init__(self, weight=None, reduction="mean", ignore_index=-100):
+        super().__init__()
+        _check_reduction(reduction)
+        self._weight = weight
+        self._reduction = reduction
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, weight=self._weight,
+                               ignore_index=self._ignore_index,
+                               reduction=self._reduction)
+
+
+class MSELoss(Layer):
+    """nn/layer/loss.py:147."""
+
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        _check_reduction(reduction)
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, reduction=self._reduction)
+
+
+class L1Loss(Layer):
+    """nn/layer/loss.py:251."""
+
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        _check_reduction(reduction)
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, reduction=self._reduction)
+
+
+class BCELoss(Layer):
+    """nn/layer/loss.py:341 — binary CE over probabilities."""
+
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        _check_reduction(reduction)
+        self._weight = weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.bce_loss(input, label, weight=self._weight,
+                          reduction=self._reduction)
+
+
+class NLLLoss(Layer):
+    """nn/layer/loss.py:469 — negative log likelihood over log-probs."""
+
+    def __init__(self, weight=None, reduction="mean", ignore_index=-100):
+        super().__init__()
+        _check_reduction(reduction)
+        self._weight = weight
+        self._reduction = reduction
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, weight=self._weight,
+                          ignore_index=self._ignore_index,
+                          reduction=self._reduction)

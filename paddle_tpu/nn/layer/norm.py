@@ -1,0 +1,8 @@
+"""paddle.nn.layer.norm — parity with python/paddle/nn/layer/norm.py
+(BatchNorm/GroupNorm/LayerNorm/SpectralNorm/InstanceNorm aliases)."""
+from ...dygraph.nn import (  # noqa: F401
+    BatchNorm, GroupNorm, InstanceNorm, LayerNorm, SpectralNorm,
+)
+
+__all__ = ["BatchNorm", "GroupNorm", "LayerNorm", "SpectralNorm",
+           "InstanceNorm"]

@@ -131,6 +131,22 @@ def softshrink(ctx, op, ins):
                              jnp.where(x < -lam, x + lam, 0.0))}
 
 
+@register_op("hard_shrink", diff_inputs=("X",))
+def hard_shrink(ctx, op, ins):
+    """operators/activation_op.cc HardShrink: x if |x| > threshold else 0."""
+    t = float(op.attr("threshold", 0.5))
+    x = ins["X"][0]
+    return {"Out": jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register_op("thresholded_relu", diff_inputs=("X",))
+def thresholded_relu(ctx, op, ins):
+    """operators/activation_op.cc ThresholdedRelu: x if x > threshold else 0."""
+    t = float(op.attr("threshold", 1.0))
+    x = ins["X"][0]
+    return {"Out": jnp.where(x > t, x, 0.0)}
+
+
 @register_op("tanh_shrink", diff_inputs=("X",))
 def tanh_shrink(ctx, op, ins):
     x = ins["X"][0]

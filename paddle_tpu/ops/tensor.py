@@ -345,7 +345,15 @@ def flip(ctx, op, ins):
 
 @register_op("roll", diff_inputs=("X",))
 def roll(ctx, op, ins):
-    return {"Out": jnp.roll(ins["X"][0], op.attr("shifts"), axis=tuple(op.attr("axis")))}
+    # empty/absent axis ≙ reference roll_op.cc dims=None: roll the flattened
+    # tensor and restore the shape
+    axis = op.attr("axis") or None
+    shifts = op.attr("shifts")
+    if axis is None:
+        x = ins["X"][0]
+        sh = shifts[0] if isinstance(shifts, (list, tuple)) else shifts
+        return {"Out": jnp.roll(x.reshape(-1), sh).reshape(x.shape)}
+    return {"Out": jnp.roll(ins["X"][0], shifts, axis=tuple(axis))}
 
 
 @register_op("tril_triu", diff_inputs=("X",))
@@ -364,6 +372,17 @@ def unique(ctx, op, ins):
     x = ins["X"][0]
     out, idx = np.unique(np.asarray(x), return_inverse=True)
     return {"Out": jnp.asarray(out), "Index": jnp.asarray(idx.astype(np.int32))}
+
+
+@register_op("unique_with_counts", grad=None)
+def unique_with_counts(ctx, op, ins):
+    """operators/unique_with_counts_op.cc — host-side op (dynamic shape)."""
+    x = ins["X"][0]
+    out, idx, cnt = np.unique(np.asarray(x), return_inverse=True,
+                              return_counts=True)
+    return {"Out": jnp.asarray(out),
+            "Index": jnp.asarray(idx.astype(np.int32)),
+            "Count": jnp.asarray(cnt.astype(np.int32))}
 
 
 # ---------------------------------------------------------------------------

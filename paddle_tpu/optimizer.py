@@ -41,8 +41,78 @@ __all__ = [
 ]
 
 
+class _EagerAcc:
+    """Handle for an optimizer accumulator in dygraph mode (the eager
+    counterpart of the persistable accumulator var)."""
+
+    __slots__ = ("key", "name")
+
+    def __init__(self, key, name):
+        self.key = key
+        self.name = name
+
+
+class _EagerOptBlock:
+    """Replays ``_append_optimize_op`` eagerly for dygraph training.
+
+    The same ``_append_optimize_op`` methods that build the static optimize
+    slice are executed here against jnp arrays: each ``append_op`` call runs
+    the registered optimizer-op lowering (the single source of truth for the
+    update math — reference dygraph mode likewise calls the same op kernels
+    eagerly, imperative/tracer.cc) and writes ParamOut/accumulator outputs
+    back in place.
+    """
+
+    def __init__(self, state):
+        self.state = state          # accumulator key -> jnp array
+        self._env = {}              # var name -> value for intra-step temps
+
+    def resolve(self, v):
+        import jax.numpy as jnp
+
+        if isinstance(v, _EagerAcc):
+            return self.state[v.key]
+        if hasattr(v, "value") and hasattr(v, "_grad"):   # VarBase
+            return v.value
+        if isinstance(v, str):
+            return self._env[v]
+        if isinstance(v, (float, int)):
+            return jnp.asarray(v, jnp.float32)
+        return v                    # raw jnp/np array (the grad, lr)
+
+    def append_op(self, type, inputs, outputs, attrs=None):
+        from .framework.registry import LowerCtx, _FakeOp, get_op_spec
+
+        ins = {slot: [self.resolve(v) for v in vs]
+               for slot, vs in inputs.items() if vs}
+        out_names = {slot: [getattr(v, "name", f"__tmp_{slot}_{i}")
+                            for i, v in enumerate(vs)]
+                     for slot, vs in outputs.items()}
+        fake = _FakeOp(type, {s: [f"i{i}" for i in range(len(v))]
+                              for s, v in ins.items()},
+                       out_names, dict(attrs or {}), None)
+        spec = get_op_spec(type)
+        outs = spec.lower(LowerCtx(None, None, {}), fake, ins)
+        for slot, vs in outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for v, val in zip(vs, vals):
+                if val is None:
+                    continue
+                if isinstance(v, _EagerAcc):
+                    self.state[v.key] = val
+                elif hasattr(v, "value") and hasattr(v, "_grad"):
+                    v.value = val
+                else:
+                    self._env[getattr(v, "name", str(v))] = val
+
+
 class Optimizer:
-    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None,
+                 parameter_list=None):
         self._learning_rate = learning_rate
         self.regularization = regularization
         self._grad_clip = grad_clip
@@ -50,6 +120,10 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._lr_var: Optional[Variable] = None
         self.type = "optimizer"
+        # dygraph mode: parameters to update + eager accumulator state
+        self._parameter_list = parameter_list
+        self._eager_block: Optional[_EagerOptBlock] = None
+        self._eager_state: Dict[str, object] = {}
 
     # -- learning rate ------------------------------------------------------
     def _create_lr_var(self, program: Program) -> Variable:
@@ -76,6 +150,15 @@ class Optimizer:
     # -- accumulators -------------------------------------------------------
     def _add_accumulator(self, name: str, param: Parameter, fill_value=0.0,
                          shape=None, dtype="float32") -> Variable:
+        if self._eager_block is not None:
+            import jax.numpy as jnp
+
+            key = (param.name, name)
+            if key not in self._eager_state:
+                self._eager_state[key] = jnp.full(
+                    tuple(shape if shape is not None else param.shape),
+                    float(fill_value), dtype=jnp.float32)
+            return _EagerAcc(key, name)
         if name in self._accumulators and param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
         acc_name = unique_name.generate(f"{param.name}_{name}")
@@ -96,10 +179,93 @@ class Optimizer:
     # -- main entry ---------------------------------------------------------
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from .dygraph import base as _dyg
+
+        if _dyg.enabled():
+            return self._dygraph_minimize(
+                loss, parameter_list or self._parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph (eager) path ----------------------------------------------
+    def _dygraph_minimize(self, loss, parameter_list):
+        """Reference dygraph contract: loss.backward() fills VarBase grads,
+        minimize() applies the update (imperative optimizer path,
+        fluid/optimizer.py minimize under in_dygraph_mode)."""
+        import jax.numpy as jnp
+
+        if parameter_list is None:
+            raise ValueError(
+                "dygraph minimize() needs parameters: pass parameter_list "
+                "to the optimizer constructor or to minimize()")
+        params = [p for p in parameter_list
+                  if getattr(p, "trainable", True)
+                  and not getattr(p, "stop_gradient", False)]
+        if loss is not None and all(p._grad is None for p in params):
+            loss.backward()
+        pgs = [(p, p._grad) for p in params if p._grad is not None]
+        if self._grad_clip is not None:
+            pgs = self._eager_clip(pgs)
+        pgs = self._eager_regularize(pgs)
+        lr = jnp.asarray(self._eager_lr(), jnp.float32)
+        blk = _EagerOptBlock(self._eager_state)
+        self._eager_block = blk
+        try:
+            for p, g in pgs:
+                self._append_optimize_op(blk, (p, g), lr)
+            self._finish_update(blk, pgs)
+        finally:
+            self._eager_block = None
+        return [], pgs
+
+    def _eager_lr(self):
+        lr = self._learning_rate
+        if callable(lr) and not isinstance(lr, (int, float)):
+            val = float(lr())
+            if hasattr(lr, "step"):
+                lr.step()
+            return val
+        return float(lr)
+
+    def _eager_clip(self, pgs):
+        import jax.numpy as jnp
+
+        from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                           GradientClipByValue)
+
+        c = self._grad_clip
+        if isinstance(c, GradientClipByValue):
+            return [(p, jnp.clip(g, c.min, c.max)) for p, g in pgs]
+        if isinstance(c, GradientClipByNorm):
+            out = []
+            for p, g in pgs:
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                out.append((p, g * jnp.minimum(1.0, c.clip_norm /
+                                               jnp.maximum(n, 1e-12))))
+            return out
+        if isinstance(c, GradientClipByGlobalNorm):
+            gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for _, g in pgs))
+            scale = c.clip_norm / jnp.maximum(gn, c.clip_norm)
+            return [(p, g * scale) for p, g in pgs]
+        return pgs
+
+    def _eager_regularize(self, pgs):
+        from .regularizer import L1DecayRegularizer, L2DecayRegularizer
+
+        out = []
+        for p, g in pgs:
+            reg = (p.optimize_attr or {}).get("regularizer") \
+                if hasattr(p, "optimize_attr") and p.optimize_attr else None
+            reg = reg or self.regularization
+            if isinstance(reg, L2DecayRegularizer):
+                g = g + reg._coeff * p.value
+            elif isinstance(reg, L1DecayRegularizer):
+                import jax.numpy as jnp
+                g = g + reg._coeff * jnp.sign(p.value)
+            out.append((p, g))
+        return out
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
@@ -142,9 +308,12 @@ class Optimizer:
 
     def _param_lr(self, param: Parameter, lr_var):
         """Per-param learning-rate multiplier (ParamAttr.learning_rate)."""
-        mult = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        opt_attr = getattr(param, "optimize_attr", None)
+        mult = (opt_attr or {}).get("learning_rate", 1.0)
         if mult == 1.0:
             return lr_var
+        if self._eager_block is not None:
+            return lr_var * float(mult)
         from .layers.tensor import scale as scale_layer
 
         return scale_layer(lr_var, scale=float(mult))
@@ -153,8 +322,9 @@ class Optimizer:
 class SGDOptimizer(Optimizer):
     """fluid.optimizer.SGD (optimizer.py:842)."""
 
-    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "sgd"
 
     def _append_optimize_op(self, block, param_and_grad, lr_var):
@@ -171,8 +341,9 @@ class MomentumOptimizer(Optimizer):
     """fluid.optimizer.Momentum (optimizer.py:936)."""
 
     def __init__(self, learning_rate, momentum, use_nesterov=False,
-                 regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "momentum"
         self._momentum = momentum
         self._use_nesterov = use_nesterov
@@ -200,8 +371,9 @@ class DGCMomentumOptimizer(Optimizer):
     def __init__(self, learning_rate, momentum, rampup_begin_step,
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False,
                  num_trainers=None, regularization=None, grad_clip=None,
-                 name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "dgc_momentum"
         self._momentum = momentum
         self._rampup_begin_step = float(rampup_begin_step)
@@ -239,8 +411,9 @@ class LarsMomentumOptimizer(Optimizer):
 
     def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, regularization=None, grad_clip=None,
-                 name=None, epsilon=0.0):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 name=None, epsilon=0.0, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "lars_momentum"
         self._momentum = momentum
         self._lars_coeff = lars_coeff
@@ -263,8 +436,10 @@ class LarsMomentumOptimizer(Optimizer):
 
 class AdagradOptimizer(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
-                 grad_clip=None, name=None, initial_accumulator_value=0.0):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 grad_clip=None, name=None, initial_accumulator_value=0.0,
+                 parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "adagrad"
         self._epsilon = epsilon
         self._initial = initial_accumulator_value
@@ -283,8 +458,9 @@ class AdagradOptimizer(Optimizer):
 
 class DecayedAdagradOptimizer(Optimizer):
     def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
-                 regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "decayed_adagrad"
         self._decay = decay
         self._epsilon = epsilon
@@ -303,8 +479,9 @@ class DecayedAdagradOptimizer(Optimizer):
 
 class AdadeltaOptimizer(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
-                 regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "adadelta"
         self._epsilon = epsilon
         self._rho = rho
@@ -327,8 +504,10 @@ class AdamOptimizer(Optimizer):
     """fluid.optimizer.Adam (optimizer.py:1716)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
-                 regularization=None, grad_clip=None, name=None, lazy_mode=False):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 regularization=None, grad_clip=None, name=None, lazy_mode=False,
+                 parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "adam"
         self._beta1 = beta1
         self._beta2 = beta2
@@ -359,9 +538,9 @@ class AdamW(AdamOptimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  weight_decay=0.01, regularization=None, grad_clip=None, name=None,
-                 apply_decay_param_fun=None):
+                 apply_decay_param_fun=None, parameter_list=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
-                         grad_clip, name)
+                         grad_clip, name, parameter_list=parameter_list)
         self.type = "adamw"
         self._coeff = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
@@ -386,8 +565,9 @@ class AdamW(AdamOptimizer):
 
 class AdamaxOptimizer(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
-                 regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "adamax"
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
@@ -412,7 +592,10 @@ class AdamaxOptimizer(Optimizer):
         for p, g in params_grads:
             if g is None:
                 continue
-            b1p = self._accumulators["beta1_pow_acc"][p.name]
+            if self._eager_block is not None:
+                b1p = _EagerAcc((p.name, "beta1_pow_acc"), "beta1_pow_acc")
+            else:
+                b1p = self._accumulators["beta1_pow_acc"][p.name]
             block.append_op(
                 type="scale",
                 inputs={"X": [b1p]},
@@ -423,8 +606,9 @@ class AdamaxOptimizer(Optimizer):
 
 class DpsgdOptimizer(Optimizer):
     def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
-                 sigma=1e-8, regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 sigma=1e-8, regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "dpsgd"
         self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
 
@@ -442,8 +626,9 @@ class DpsgdOptimizer(Optimizer):
 
 class RMSPropOptimizer(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
-                 centered=False, regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 centered=False, regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "rmsprop"
         self._rho, self._epsilon = rho, epsilon
         self._momentum, self._centered = momentum, centered
@@ -468,8 +653,9 @@ class RMSPropOptimizer(Optimizer):
 
 class FtrlOptimizer(Optimizer):
     def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
-                 regularization=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, regularization, grad_clip, name)
+                 regularization=None, grad_clip=None, name=None, parameter_list=None):
+        super().__init__(learning_rate, regularization, grad_clip, name,
+                         parameter_list=parameter_list)
         self.type = "ftrl"
         self._l1, self._l2, self._lr_power = l1, l2, lr_power
 
@@ -493,9 +679,9 @@ class LambOptimizer(AdamOptimizer):
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, regularization=None, grad_clip=None,
-                 exclude_from_weight_decay_fn=None, name=None):
+                 exclude_from_weight_decay_fn=None, name=None, parameter_list=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
-                         grad_clip, name)
+                         grad_clip, name, parameter_list=parameter_list)
         self.type = "lamb"
         self._weight_decay = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn

@@ -1,0 +1,41 @@
+"""paddle.tensor — the paddle-2.0-preview tensor namespace, parity with
+python/paddle/tensor/__init__.py.  Every entry works in both dygraph and
+static mode via the registry dispatch (_dispatch.py).
+"""
+from .attribute import rank, shape  # noqa: F401
+from .creation import (  # noqa: F401
+    arange, create_tensor, crop_tensor, diag, eye, fill_constant, full,
+    full_like, linspace, meshgrid, ones, ones_like, tril, triu, zeros,
+    zeros_like,
+)
+from .io import load, save  # noqa: F401
+from .linalg import (  # noqa: F401
+    bmm, cholesky, cross, dist, dot, histogram, matmul, norm, t, transpose,
+)
+from .logic import (  # noqa: F401
+    allclose, elementwise_equal, equal, greater_equal, greater_than,
+    is_empty, isfinite, less_equal, less_than, logical_and, logical_not,
+    logical_or, logical_xor, not_equal, reduce_all, reduce_any,
+)
+from .manipulation import (  # noqa: F401
+    cast, concat, expand, expand_as, flatten, flip, gather, gather_nd,
+    reshape, reverse, roll, scatter, scatter_nd, scatter_nd_add,
+    shard_index, slice, split, squeeze, stack, strided_slice, unbind,
+    unique, unique_with_counts, unsqueeze, unstack,
+)
+from .math import (  # noqa: F401
+    abs, acos, add, addcmul, addmm, asin, atan, ceil, clamp, cos, cumsum,
+    div, elementwise_add, elementwise_div, elementwise_floordiv,
+    elementwise_max, elementwise_min, elementwise_mod, elementwise_mul,
+    elementwise_pow, elementwise_sub, elementwise_sum, erf, exp, floor,
+    increment, inverse, kron, log, log1p, logsumexp, max, min, mm, mul,
+    multiplex, pow, reciprocal, reduce_max, reduce_min, reduce_prod,
+    reduce_sum, round, rsqrt, scale, sign, sin, sqrt, square, stanh, sum,
+    sums, tanh, trace,
+)
+from .random import rand, randint, randn, randperm, shuffle  # noqa: F401
+from .search import (  # noqa: F401
+    argmax, argmin, argsort, has_inf, has_nan, index_sample, index_select,
+    nonzero, sort, topk, where,
+)
+from .stat import mean, reduce_mean, std, var  # noqa: F401

@@ -1,0 +1,117 @@
+"""Dual-mode op dispatch powering the paddle-2.0-preview API surface.
+
+In the reference, every 2.0 function branches on ``in_dygraph_mode()`` between
+an eager ``core.ops`` kernel call and a ``LayerHelper.append_op`` graph build
+(e.g. python/paddle/tensor/math.py:363 ``_elementwise_op_in_dygraph`` vs
+``_elementwise_op``).  Here the op registry is the single source of truth:
+
+- eager (dygraph) mode applies the op's XLA lowering directly to the values,
+  taped for autograd via ``dygraph.varbase.apply_op`` — the TPU-native
+  analogue of the reference's per-op eager kernel dispatch;
+- static mode appends the op to the default Program; shape metadata comes
+  from the registry's shape inference, and gradients from IR autodiff.
+
+Both modes therefore execute the *same* lowering, so numerics match by
+construction.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+
+from ..dygraph import base as dygraph_base
+from ..framework import unique_name
+from ..framework.layer_helper import LayerHelper
+from ..framework.registry import LowerCtx, _FakeOp, get_op_spec
+
+
+def in_dygraph_mode() -> bool:
+    return dygraph_base.enabled()
+
+
+# deterministic eager-mode RNG stream; framework.random.manual_seed resets it
+_EAGER_SEED = [0, 0]   # [seed, counter]
+
+
+def reset_eager_seed(seed: int) -> None:
+    _EAGER_SEED[0] = int(seed)
+    _EAGER_SEED[1] = 0
+
+
+def _next_eager_key():
+    _EAGER_SEED[1] += 1
+    return jax.random.fold_in(jax.random.PRNGKey(_EAGER_SEED[0]),
+                              _EAGER_SEED[1])
+
+
+def dispatch(op_type: str,
+             inputs: Dict[str, Any],
+             attrs: Optional[dict] = None,
+             out_slots: Sequence[str] = ("Out",),
+             out_dtypes: Any = None,
+             out_counts: Optional[Dict[str, int]] = None,
+             stop_gradient: bool = False):
+    """Run/append one registered op; returns one value per out slot.
+
+    ``inputs`` values may be a single tensor or a list (multi-var slots);
+    ``None`` slots are dropped.  A slot listed in ``out_counts`` with n > 1
+    yields a list of n outputs (static mode needs the count up front; eager
+    mode returns however many the lowering produced).
+    """
+    attrs = dict(attrs or {})
+    ins = {k: (list(v) if isinstance(v, (list, tuple)) else [v])
+           for k, v in inputs.items() if v is not None}
+    if in_dygraph_mode():
+        return _dispatch_eager(op_type, ins, attrs, tuple(out_slots))
+    return _dispatch_static(op_type, ins, attrs, tuple(out_slots),
+                            out_dtypes, out_counts or {}, stop_gradient)
+
+
+def _dispatch_eager(op_type, ins, attrs, out_slots):
+    from ..dygraph.varbase import apply_op
+
+    spec = get_op_spec(op_type)
+    layout = [(slot, len(vals)) for slot, vals in ins.items()]
+    flat = [v for vals in ins.values() for v in vals]
+    in_names = {s: [f"__eager_{s}_{i}" for i in range(n)] for s, n in layout}
+    # output names must be DETERMINISTIC under the eager seed counter, not
+    # unique_name: ctx.rng_for salts the key from them, so manual_seed(n)
+    # must reproduce both the key and the names to replay the random stream
+    rng_key = _next_eager_key()
+    out_names = {s: [f"__eager.{op_type}.{s}.{_EAGER_SEED[1]}"]
+                 for s in out_slots}
+    fake = _FakeOp(op_type, in_names, out_names, attrs, None)
+
+    def fn(*vals):
+        it = iter(vals)
+        ins_v = {slot: [next(it) for _ in range(n)] for slot, n in layout}
+        ctx = LowerCtx(None, None, {}, rng_key=rng_key)
+        outs = spec.lower(ctx, fake, ins_v)
+        res = []
+        for s in out_slots:
+            v = outs.get(s)
+            if isinstance(v, (list, tuple)) and len(v) == 1:
+                v = v[0]
+            res.append(v)
+        return tuple(res) if len(res) > 1 else res[0]
+
+    return apply_op(fn, *flat)
+
+
+def _dispatch_static(op_type, ins, attrs, out_slots, out_dtypes, out_counts,
+                     stop_gradient):
+    helper = LayerHelper(op_type)
+    first = next((v for vals in ins.values() for v in vals
+                  if hasattr(v, "dtype")), None)
+    outs, ret = {}, []
+    for s in out_slots:
+        dt = out_dtypes.get(s) if isinstance(out_dtypes, dict) else out_dtypes
+        dt = dt or (first.dtype if first is not None else "float32")
+        n = out_counts.get(s, 1)
+        vs = [helper.create_variable_for_type_inference(
+            dt, stop_gradient=stop_gradient) for _ in range(n)]
+        outs[s] = vs
+        ret.append(vs if n > 1 else vs[0])
+    helper.append_op(type=op_type, inputs=ins, outputs=outs, attrs=attrs)
+    return tuple(ret) if len(ret) > 1 else ret[0]

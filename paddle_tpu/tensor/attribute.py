@@ -1,0 +1,18 @@
+"""paddle.tensor.attribute — parity with python/paddle/tensor/attribute.py
+(rank, shape aliases).
+"""
+from __future__ import annotations
+
+from ._dispatch import dispatch, in_dygraph_mode
+
+__all__ = ["rank", "shape"]
+
+
+def shape(input):
+    return dispatch("shape", {"Input": input}, out_dtypes="int32",
+                    stop_gradient=True)
+
+
+def rank(input):
+    from .creation import fill_constant
+    return fill_constant([1], "int32", len(input.shape))

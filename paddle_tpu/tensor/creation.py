@@ -1,0 +1,135 @@
+"""paddle.tensor.creation — parity with python/paddle/tensor/creation.py
+(full:500, full_like:57, arange:586, tril:693, triu:770, meshgrid:847,
+ones:213, zeros:325, eye:437, linspace:124).
+
+Every function works in both dygraph (eager lowering) and static (Program
+append) mode via the registry dispatch — see _dispatch.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._dispatch import dispatch, in_dygraph_mode
+
+__all__ = [
+    "create_tensor", "crop_tensor", "diag", "eye", "fill_constant",
+    "linspace", "ones", "ones_like", "zeros", "zeros_like", "arange",
+    "full", "full_like", "triu", "tril", "meshgrid",
+]
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    if in_dygraph_mode():
+        return dispatch("fill_constant", {},
+                        {"shape": [int(s) for s in shape],
+                         "dtype": str(dtype), "value": value})
+    from ..layers import tensor as _lt
+    return _lt.fill_constant(shape, dtype, value, out=out, name=name)
+
+
+def full(shape, fill_value, out=None, dtype=None, device=None,
+         stop_gradient=True, name=None):
+    """creation.py:500 — constant tensor; dtype defaults from fill_value."""
+    if dtype is None:
+        dtype = ("bool" if isinstance(fill_value, bool) else
+                 "int64" if isinstance(fill_value, int) else "float32")
+    return fill_constant(shape, dtype, fill_value, out=out, name=name)
+
+
+def full_like(input, fill_value, out=None, dtype=None, device=None,
+              stop_gradient=True, name=None):
+    return dispatch("fill_any_like", {"X": input},
+                    {"value": float(fill_value),
+                     "dtype": str(dtype) if dtype else None},
+                    out_dtypes=str(dtype) if dtype else None,
+                    stop_gradient=stop_gradient)
+
+
+def ones(shape, dtype=None, out=None, device=None):
+    return fill_constant(shape, dtype or "float32", 1.0, out=out)
+
+
+def zeros(shape, dtype=None, out=None, device=None):
+    return fill_constant(shape, dtype or "float32", 0.0, out=out)
+
+
+def ones_like(input, dtype=None, device=None, name=None):
+    return full_like(input, 1.0, dtype=dtype, name=name)
+
+
+def zeros_like(input, dtype=None, device=None, name=None):
+    return full_like(input, 0.0, dtype=dtype, name=name)
+
+
+def arange(start, end=None, step=1, dtype=None, name=None):
+    """creation.py:586 — paddle.arange(start[, end, step])."""
+    if end is None:
+        start, end = 0, start
+    dtype = str(dtype or "float32")
+    if in_dygraph_mode():
+        out = dispatch("range", {"Start": np.asarray(start),
+                                 "End": np.asarray(end),
+                                 "Step": np.asarray(step)})
+        return cast(out, dtype) if str(out.dtype) != dtype else out
+    from ..layers import tensor as _lt
+    return _lt.range(start, end, step, dtype)
+
+
+def linspace(start, stop, num, dtype="float32", out=None, device=None,
+             name=None):
+    if in_dygraph_mode():
+        return dispatch("linspace", {"Start": np.asarray(start, np.float32),
+                                     "Stop": np.asarray(stop, np.float32),
+                                     "Num": np.asarray(num, np.int32)},
+                        {"dtype": str(dtype)}, out_dtypes=str(dtype))
+    from ..layers import tensor as _lt
+    return _lt.linspace(start, stop, num, dtype)
+
+
+def eye(num_rows, num_columns=None, out=None, dtype="float32", stop_gradient=True,
+        name=None):
+    return dispatch("eye", {},
+                    {"num_rows": int(num_rows),
+                     "num_columns": int(num_columns if num_columns is not None
+                                        else num_rows),
+                     "dtype": str(dtype)},
+                    out_dtypes=str(dtype), stop_gradient=stop_gradient)
+
+
+def diag(diagonal):
+    return dispatch("diag", {"Diagonal": diagonal})
+
+
+def tril(input, diagonal=0, name=None):
+    """creation.py:693 — lower-triangular part."""
+    return dispatch("tril_triu", {"X": input},
+                    {"diagonal": int(diagonal), "lower": True})
+
+
+def triu(input, diagonal=0, name=None):
+    """creation.py:770 — upper-triangular part."""
+    return dispatch("tril_triu", {"X": input},
+                    {"diagonal": int(diagonal), "lower": False})
+
+
+def meshgrid(input, name=None):
+    """creation.py:847 — N 1-D tensors -> N broadcast N-D tensors."""
+    n = len(input)
+    out = dispatch("meshgrid", {"X": list(input)}, {},
+                   out_counts={"Out": n})
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    from ..layers import tensor as _lt
+    return _lt.create_tensor(dtype, name=name, persistable=persistable)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    from ..layers import extras as _le
+    return _le.crop_tensor(x, shape=shape, offsets=offsets, name=name)
+
+
+def cast(x, dtype):
+    return dispatch("cast", {"X": x},
+                    {"out_dtype": str(dtype)}, out_dtypes=str(dtype))

@@ -1,0 +1,5 @@
+"""paddle.tensor.io — parity with python/paddle/tensor/io.py (aliases of
+fluid save/load)."""
+from ..io import save, load  # noqa: F401
+
+__all__ = ["save", "load"]

@@ -1,0 +1,78 @@
+"""paddle.tensor.linalg — parity with python/paddle/tensor/linalg.py
+(matmul:38, norm:174, dist:352, dot:453, t:512, cross:586, cholesky:651,
+bmm:707, histogram:757).
+"""
+from __future__ import annotations
+
+from ._dispatch import dispatch
+
+__all__ = ["matmul", "dot", "norm", "transpose", "dist", "t", "cross",
+           "cholesky", "bmm", "histogram"]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    """linalg.py:38."""
+    return dispatch("matmul", {"X": x, "Y": y},
+                    {"transpose_X": bool(transpose_x),
+                     "transpose_Y": bool(transpose_y),
+                     "alpha": float(alpha)})
+
+
+def dot(x, y, name=None):
+    """linalg.py:453 — 1-D/2-D row-wise dot product."""
+    return dispatch("dot", {"X": x, "Y": y})
+
+
+def bmm(x, y, name=None):
+    """linalg.py:707 — batched matmul [b,m,k]@[b,k,n]."""
+    return dispatch("bmm", {"X": x, "Y": y})
+
+
+def t(input, name=None):
+    """linalg.py:512 — transpose of a 0/1/2-D tensor."""
+    nd = len(input.shape)
+    if nd < 2:
+        return dispatch("assign", {"X": input})
+    return dispatch("transpose2", {"X": input}, {"axis": [1, 0]})
+
+
+def transpose(x, perm, name=None):
+    return dispatch("transpose2", {"X": x}, {"axis": list(perm)})
+
+
+def dist(x, y, p=2):
+    """linalg.py:352 — p-norm of x - y."""
+    return dispatch("dist", {"X": x, "Y": y}, {"p": float(p)})
+
+
+def cross(input, other, dim=None):
+    """linalg.py:586."""
+    attrs = {} if dim is None else {"dim": int(dim)}
+    return dispatch("cross", {"X": input, "Y": other}, attrs)
+
+
+def cholesky(x, upper=False):
+    """linalg.py:651."""
+    return dispatch("cholesky", {"X": x}, {"upper": bool(upper)})
+
+
+def histogram(input, bins=100, min=0, max=0):
+    """linalg.py:757 — int64 bin counts."""
+    return dispatch("histogram", {"X": input},
+                    {"bins": int(bins), "min": min, "max": max},
+                    out_dtypes="int64", stop_gradient=True)
+
+
+def norm(input, p="fro", axis=None, keepdim=False, out=None, name=None):
+    """linalg.py:174 — frobenius_norm or p_norm depending on p."""
+    if p == "fro":
+        if axis is None:
+            attrs = {"dim": [], "keep_dim": keepdim, "reduce_all": True}
+        else:
+            dims = [axis] if isinstance(axis, int) else list(axis)
+            attrs = {"dim": dims, "keep_dim": keepdim}
+        return dispatch("frobenius_norm", {"X": input}, attrs)
+    ax = axis if isinstance(axis, int) else (axis[0] if axis else -1)
+    return dispatch("p_norm", {"X": input},
+                    {"porder": float(p), "axis": int(ax),
+                     "keepdim": bool(keepdim)})

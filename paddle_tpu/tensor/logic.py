@@ -1,0 +1,93 @@
+"""paddle.tensor.logic — parity with python/paddle/tensor/logic.py
+(equal:55 — reduce-all semantics at 2.0-alpha, allclose:126,
+elementwise_equal:211).
+"""
+from __future__ import annotations
+
+from ._dispatch import dispatch
+
+__all__ = [
+    "equal", "greater_equal", "greater_than", "is_empty", "isfinite",
+    "less_equal", "less_than", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "not_equal", "reduce_all", "reduce_any", "allclose",
+    "elementwise_equal",
+]
+
+
+def _cmp(op_type):
+    def fn(x, y, cond=None, name=None):
+        return dispatch(op_type, {"X": x, "Y": y}, out_dtypes="bool")
+    fn.__name__ = op_type
+    fn.__doc__ = f"paddle.{op_type} — elementwise comparison (2.0 alias)."
+    return fn
+
+
+greater_equal = _cmp("greater_equal")
+greater_than = _cmp("greater_than")
+less_equal = _cmp("less_equal")
+less_than = _cmp("less_than")
+not_equal = _cmp("not_equal")
+elementwise_equal = _cmp("equal")
+
+
+def equal(x, y, axis=-1, name=None):
+    """logic.py:55 — 2.0-alpha `equal` reduces to ONE bool: True iff all
+    elements equal (the fluid elementwise op is `elementwise_equal` here).
+    Composed as equal -> reduce_all; XLA fuses the pair."""
+    ew = dispatch("equal", {"X": x, "Y": y}, {"axis": int(axis)},
+                  out_dtypes="bool")
+    return dispatch("reduce_all", {"X": ew},
+                    {"dim": [], "keep_dim": False, "reduce_all": True},
+                    out_dtypes="bool")
+
+
+def _logical(op_type, unary=False):
+    if unary:
+        def fn(x, out=None, name=None):
+            return dispatch(op_type, {"X": x}, out_dtypes="bool")
+    else:
+        def fn(x, y, out=None, name=None):
+            return dispatch(op_type, {"X": x, "Y": y}, out_dtypes="bool")
+    fn.__name__ = op_type
+    return fn
+
+
+logical_and = _logical("logical_and")
+logical_or = _logical("logical_or")
+logical_xor = _logical("logical_xor")
+logical_not = _logical("logical_not", unary=True)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    attrs = ({"dim": [], "keep_dim": keep_dim, "reduce_all": True}
+             if dim is None else
+             {"dim": [dim] if isinstance(dim, int) else list(dim),
+              "keep_dim": keep_dim})
+    return dispatch("reduce_all", {"X": input}, attrs, out_dtypes="bool")
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    attrs = ({"dim": [], "keep_dim": keep_dim, "reduce_all": True}
+             if dim is None else
+             {"dim": [dim] if isinstance(dim, int) else list(dim),
+              "keep_dim": keep_dim})
+    return dispatch("reduce_any", {"X": input}, attrs, out_dtypes="bool")
+
+
+def allclose(input, other, rtol=1e-05, atol=1e-08, equal_nan=False,
+             name=None):
+    """logic.py:126."""
+    return dispatch("allclose", {"Input": input, "Other": other},
+                    {"rtol": float(rtol), "atol": float(atol),
+                     "equal_nan": bool(equal_nan)}, out_dtypes="bool",
+                    stop_gradient=True)
+
+
+def is_empty(x, cond=None):
+    return dispatch("is_empty", {"X": x}, out_dtypes="bool",
+                    stop_gradient=True)
+
+
+def isfinite(x):
+    return dispatch("isfinite", {"X": x}, out_dtypes="bool",
+                    stop_gradient=True)

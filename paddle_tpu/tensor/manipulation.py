@@ -1,0 +1,171 @@
+"""paddle.tensor.manipulation — parity with
+python/paddle/tensor/manipulation.py (flip:54, roll:107, stack:181,
+split:294, squeeze:433, unsqueeze:512, gather:595, unbind:669).
+"""
+from __future__ import annotations
+
+from ._dispatch import dispatch, in_dygraph_mode
+
+__all__ = [
+    "cast", "concat", "expand", "expand_as", "flatten", "gather",
+    "gather_nd", "reshape", "reverse", "scatter", "scatter_nd_add",
+    "scatter_nd", "shard_index", "slice", "split", "squeeze", "stack",
+    "strided_slice", "transpose", "unique", "unique_with_counts",
+    "unsqueeze", "unstack", "flip", "unbind", "roll",
+]
+
+
+def cast(x, dtype):
+    return dispatch("cast", {"X": x}, {"out_dtype": str(dtype)},
+                    out_dtypes=str(dtype))
+
+
+def concat(input, axis=0, name=None):
+    return dispatch("concat", {"X": list(input)}, {"axis": int(axis)})
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    if in_dygraph_mode():
+        out = dispatch("reshape2", {"X": x}, {"shape": list(shape)})
+        return dispatch(act, {"X": out}) if act else out
+    from ..layers import tensor as _lt
+    return _lt.reshape(x, shape, actual_shape=actual_shape, act=act,
+                       inplace=inplace, name=name)
+
+
+def flatten(x, axis=1, name=None):
+    return dispatch("flatten2", {"X": x}, {"axis": int(axis)})
+
+
+def transpose(x, perm, name=None):
+    return dispatch("transpose2", {"X": x}, {"axis": list(perm)})
+
+
+def squeeze(input, axes, out=None, name=None):
+    """manipulation.py:433."""
+    return dispatch("squeeze2", {"X": input}, {"axes": list(axes)})
+
+
+def unsqueeze(input, axes, out=None, name=None):
+    """manipulation.py:512."""
+    axes = [axes] if isinstance(axes, int) else list(axes)
+    return dispatch("unsqueeze2", {"X": input}, {"axes": axes})
+
+
+def stack(x, axis=0, out=None, name=None):
+    """manipulation.py:181."""
+    return dispatch("stack", {"X": list(x)}, {"axis": int(axis)},
+                    out_slots=("Y",))
+
+
+def unstack(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    out = dispatch("unstack", {"X": x}, {"axis": int(axis), "num": int(n)},
+                   out_counts={"Y": int(n)}, out_slots=("Y",))
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    """manipulation.py:294."""
+    axis = int(dim)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": axis}
+    else:
+        secs = [int(s) for s in num_or_sections]
+        n = len(secs)
+        attrs = {"sections": secs, "axis": axis}
+    out = dispatch("split", {"X": input}, attrs, out_counts={"Out": n})
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def unbind(input, axis=0):
+    """manipulation.py:669."""
+    n = input.shape[axis]
+    out = dispatch("unbind", {"X": input}, {"axis": int(axis)},
+                   out_counts={"Out": int(n)})
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def gather(input, index, overwrite=True):
+    """manipulation.py:595."""
+    return dispatch("gather", {"X": input, "Index": index})
+
+
+def gather_nd(input, index, name=None):
+    return dispatch("gather_nd", {"X": input, "Index": index})
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    return dispatch("scatter", {"X": input, "Ids": index,
+                                "Updates": updates},
+                    {"overwrite": bool(overwrite)})
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return dispatch("scatter_nd_add", {"X": ref, "Index": index,
+                                       "Updates": updates})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return dispatch("scatter_nd", {"Index": index, "Updates": updates},
+                    {"shape": [int(s) for s in shape]})
+
+
+def expand(x, expand_times, name=None):
+    return dispatch("expand", {"X": x},
+                    {"expand_times": [int(t) for t in expand_times]})
+
+
+def expand_as(x, target_tensor, name=None):
+    return dispatch("expand_as", {"X": x, "target_tensor": target_tensor})
+
+
+def reverse(x, axis):
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return dispatch("reverse", {"X": x}, {"axis": axis})
+
+
+def flip(input, dims, name=None):
+    """manipulation.py:54."""
+    dims = [dims] if isinstance(dims, int) else list(dims)
+    return dispatch("flip", {"X": input}, {"axis": dims})
+
+
+def roll(input, shifts, dims=None):
+    """manipulation.py:107."""
+    shifts = [shifts] if isinstance(shifts, int) else list(shifts)
+    attrs = {"shifts": shifts}
+    attrs["axis"] = ([dims] if isinstance(dims, int) else list(dims)) \
+        if dims is not None else []
+    return dispatch("roll", {"X": input}, attrs)
+
+
+def slice(input, axes, starts, ends):
+    return dispatch("slice", {"Input": input},
+                    {"axes": list(axes), "starts": list(starts),
+                     "ends": list(ends)})
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    return dispatch("strided_slice", {"Input": input},
+                    {"axes": list(axes), "starts": list(starts),
+                     "ends": list(ends), "strides": list(strides)})
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return dispatch("shard_index", {"X": input},
+                    {"index_num": int(index_num), "nshards": int(nshards),
+                     "shard_id": int(shard_id),
+                     "ignore_value": int(ignore_value)})
+
+
+def unique(x, dtype="int32"):
+    """Host-side op (dynamic shape) — not for jit regions on TPU."""
+    return dispatch("unique", {"X": x}, out_slots=("Out", "Index"),
+                    stop_gradient=True)
+
+
+def unique_with_counts(x, dtype="int32"):
+    return dispatch("unique_with_counts", {"X": x},
+                    out_slots=("Out", "Index", "Count"), stop_gradient=True)

@@ -1,0 +1,244 @@
+"""paddle.tensor.math — parity with python/paddle/tensor/math.py
+(add:412, div:557, mm:913, addmm:1018, logsumexp:1087, inverse:1158,
+max:1233, min:1313, addcmul:1438, clamp:1487, trace:1575, kron:1672).
+
+Unary/elementwise entries run the registered op lowerings in both modes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._dispatch import dispatch
+
+__all__ = [
+    "abs", "acos", "asin", "atan", "ceil", "cos", "cumsum",
+    "elementwise_add", "elementwise_div", "elementwise_floordiv",
+    "elementwise_max", "elementwise_min", "elementwise_mod",
+    "elementwise_mul", "elementwise_pow", "elementwise_sub", "exp", "floor",
+    "increment", "log", "mul", "multiplex", "pow", "reciprocal",
+    "reduce_max", "reduce_min", "reduce_prod", "reduce_sum", "round",
+    "rsqrt", "scale", "sign", "sin", "sqrt", "square", "stanh", "sum",
+    "sums", "tanh", "elementwise_sum", "max", "min", "mm", "div", "add",
+    "logsumexp", "inverse", "log1p", "erf", "addcmul", "addmm", "clamp",
+    "trace", "kron",
+]
+
+
+def _unary(op_type):
+    def fn(x, out=None, name=None):
+        return dispatch(op_type, {"X": x})
+    fn.__name__ = op_type
+    fn.__doc__ = f"paddle.{op_type} — elementwise {op_type} (2.0 alias)."
+    return fn
+
+
+abs = _unary("abs")
+acos = _unary("acos")
+asin = _unary("asin")
+atan = _unary("atan")
+ceil = _unary("ceil")
+cos = _unary("cos")
+exp = _unary("exp")
+floor = _unary("floor")
+log = _unary("log")
+reciprocal = _unary("reciprocal")
+round = _unary("round")
+rsqrt = _unary("rsqrt")
+sign = _unary("sign")
+sin = _unary("sin")
+sqrt = _unary("sqrt")
+square = _unary("square")
+tanh = _unary("tanh")
+log1p = _unary("log1p")
+erf = _unary("erf")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, out=None, name=None):
+    return dispatch("stanh", {"X": x},
+                    {"scale_a": scale_a, "scale_b": scale_b})
+
+
+def _binary(op_type):
+    def fn(x, y, axis=-1, act=None, name=None):
+        out = dispatch(op_type, {"X": x, "Y": y}, {"axis": int(axis)})
+        if act:
+            out = dispatch(act, {"X": out})
+        return out
+    fn.__name__ = op_type
+    fn.__doc__ = f"paddle.{op_type} (2.0 alias of the fluid elementwise op)."
+    return fn
+
+
+elementwise_add = _binary("elementwise_add")
+elementwise_div = _binary("elementwise_div")
+elementwise_floordiv = _binary("elementwise_floordiv")
+elementwise_max = _binary("elementwise_max")
+elementwise_min = _binary("elementwise_min")
+elementwise_mod = _binary("elementwise_mod")
+elementwise_mul = _binary("elementwise_mul")
+elementwise_pow = _binary("elementwise_pow")
+elementwise_sub = _binary("elementwise_sub")
+
+
+def add(x, y, alpha=1, out=None, name=None):
+    """math.py:412 — out = x + alpha*y (alpha folds into a scale)."""
+    if alpha != 1:
+        y = scale(y, scale=alpha)
+    return dispatch("elementwise_add", {"X": x, "Y": y}, {"axis": -1})
+
+
+def div(x, y, out=None, name=None):
+    """math.py:557."""
+    return dispatch("elementwise_div", {"X": x, "Y": y}, {"axis": -1})
+
+
+def pow(input, exponent, out=None, name=None):
+    """math.py:192 — exponent may be a python scalar or a tensor."""
+    if hasattr(exponent, "dtype") and not np.isscalar(exponent):
+        return dispatch("pow", {"X": input, "FactorTensor": exponent}, {})
+    return dispatch("pow", {"X": input}, {"factor": float(exponent)})
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, out=None, name=None):
+    """math.py:263 — the fluid `mul` matmul with flattening dims."""
+    return dispatch("mul", {"X": x, "Y": y},
+                    {"x_num_col_dims": int(x_num_col_dims),
+                     "y_num_col_dims": int(y_num_col_dims)})
+
+
+def mm(input, mat2, out=None, name=None):
+    """math.py:913 — matrix multiply, no broadcast-flattening."""
+    return dispatch("matmul", {"X": input, "Y": mat2},
+                    {"transpose_X": False, "transpose_Y": False})
+
+
+def addmm(input, x, y, alpha=1.0, beta=1.0, name=None):
+    """math.py:1018 — out = alpha*x@y + beta*input."""
+    return dispatch("addmm", {"Input": input, "X": x, "Y": y},
+                    {"Alpha": float(alpha), "Beta": float(beta)})
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, out=None, name=None):
+    """math.py:1438 — input + value * tensor1 * tensor2."""
+    prod = dispatch("elementwise_mul", {"X": tensor1, "Y": tensor2},
+                    {"axis": -1})
+    if value != 1.0:
+        prod = scale(prod, scale=value)
+    return dispatch("elementwise_add", {"X": input, "Y": prod}, {"axis": -1})
+
+
+def clamp(input, min=None, max=None, output=None, name=None):
+    """math.py:1487 — clip to [min, max]."""
+    lo = float("-inf") if min is None else float(min)
+    hi = float("inf") if max is None else float(max)
+    return dispatch("clip", {"X": input}, {"min": lo, "max": hi})
+
+
+def trace(input, offset=0, dim1=0, dim2=1, out=None, name=None):
+    """math.py:1575."""
+    return dispatch("trace", {"Input": input},
+                    {"offset": int(offset), "axis1": int(dim1),
+                     "axis2": int(dim2)})
+
+
+def kron(x, y, out=None, name=None):
+    """math.py:1672 — Kronecker product."""
+    return dispatch("kron", {"X": x, "Y": y})
+
+
+def inverse(input, out=None, name=None):
+    """math.py:1158 — batched matrix inverse."""
+    return dispatch("inverse", {"Input": input})
+
+
+def logsumexp(x, dim=None, keepdim=False, out=None, name=None):
+    """math.py:1087 — log(sum(exp(x))) over dims, numerically stable.
+
+    Composed from exp/sum/log ops after max-shift; the fused XLA graph is
+    a single stable reduction.
+    """
+    m = _reduce("reduce_max", x, dim, True)
+    shifted = dispatch("elementwise_sub", {"X": x, "Y": m}, {"axis": -1})
+    s = _reduce("reduce_sum", dispatch("exp", {"X": shifted}), dim, keepdim)
+    mk = m if keepdim else _reduce("reduce_max", x, dim, keepdim)
+    return dispatch("elementwise_add",
+                    {"X": dispatch("log", {"X": s}), "Y": mk}, {"axis": -1})
+
+
+def _reduce(op_type, x, dim, keep_dim):
+    if dim is None:
+        attrs = {"dim": [], "keep_dim": keep_dim, "reduce_all": True}
+    else:
+        dims = [dim] if isinstance(dim, int) else list(dim)
+        attrs = {"dim": dims, "keep_dim": keep_dim}
+    return dispatch(op_type, {"X": x}, attrs)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim)
+
+
+def max(input, dim=None, keep_dim=False, out=None, name=None):
+    """math.py:1233 — reduce max with torch-style dim arg."""
+    return _reduce("reduce_max", input, dim, keep_dim)
+
+
+def min(input, dim=None, keep_dim=False, out=None, name=None):
+    """math.py:1313."""
+    return _reduce("reduce_min", input, dim, keep_dim)
+
+
+def sum(input, dim=None, dtype=None, keep_dim=False, name=None):
+    """math.py:710 — reduce sum (optionally casting first)."""
+    if dtype is not None:
+        input = dispatch("cast", {"X": input}, {"out_dtype": str(dtype)},
+                         out_dtypes=str(dtype))
+    return _reduce("reduce_sum", input, dim, keep_dim)
+
+
+def elementwise_sum(inputs, name=None):
+    """math.py:815 — add a list of tensors (the fluid `sum` op)."""
+    return dispatch("sum", {"X": list(inputs)})
+
+
+def sums(input, out=None):
+    return dispatch("sum", {"X": list(input)})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = dispatch("scale", {"X": x},
+                   {"scale": float(scale), "bias": float(bias),
+                    "bias_after_scale": bool(bias_after_scale)})
+    if act:
+        out = dispatch(act, {"X": out})
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    return dispatch("increment", {"X": x}, {"step": float(value)})
+
+
+def multiplex(inputs, index):
+    return dispatch("multiplex", {"X": list(inputs), "Ids": index})
+
+
+def cumsum(x, axis=None, exclusive=False, reverse=False, name=None):
+    attrs = {"exclusive": bool(exclusive), "reverse": bool(reverse)}
+    if axis is None:
+        attrs["flatten"] = True
+        attrs["axis"] = -1
+    else:
+        attrs["axis"] = int(axis)
+    return dispatch("cumsum", {"X": x}, attrs)

@@ -1,0 +1,59 @@
+"""paddle.tensor.random — parity with python/paddle/tensor/random.py
+(randint:40, randn:209, randperm:320, rand:409, shuffle:~30).
+
+Randomness lowers to jax.random with deterministic per-op keys (the
+executor's rng stream in static mode, the eager stream in dygraph mode) —
+the TPU-native replacement for the reference's curand states.
+"""
+from __future__ import annotations
+
+from ._dispatch import dispatch
+
+__all__ = ["shuffle", "randn", "rand", "randint", "randperm"]
+
+
+def randint(low, high=None, shape=(1,), out=None, dtype=None, device=None,
+            stop_gradient=False, seed=0, name=None):
+    """random.py:40."""
+    if high is None:
+        low, high = 0, low
+    return dispatch("randint", {},
+                    {"shape": [int(s) for s in shape], "low": int(low),
+                     "high": int(high), "dtype": str(dtype or "int64"),
+                     "seed": int(seed)},
+                    out_dtypes=str(dtype or "int64"),
+                    stop_gradient=stop_gradient)
+
+
+def randn(shape, out=None, dtype=None, device=None, stop_gradient=True,
+          name=None):
+    """random.py:209 — standard normal."""
+    return dispatch("gaussian_random", {},
+                    {"shape": [int(s) for s in shape], "mean": 0.0,
+                     "std": 1.0, "dtype": str(dtype or "float32")},
+                    out_dtypes=str(dtype or "float32"),
+                    stop_gradient=stop_gradient)
+
+
+def rand(shape, out=None, dtype=None, device=None, stop_gradient=True):
+    """random.py:409 — U[0, 1)."""
+    return dispatch("uniform_random", {},
+                    {"shape": [int(s) for s in shape], "min": 0.0,
+                     "max": 1.0, "dtype": str(dtype or "float32")},
+                    out_dtypes=str(dtype or "float32"),
+                    stop_gradient=stop_gradient)
+
+
+def randperm(n, out=None, dtype="int64", device=None, stop_gradient=True,
+             seed=0):
+    """random.py:320."""
+    return dispatch("randperm", {},
+                    {"n": int(n), "dtype": str(dtype), "seed": int(seed)},
+                    out_dtypes=str(dtype), stop_gradient=stop_gradient)
+
+
+def shuffle(x, seed=None):
+    """Permute along dim 0 (reference fluid.layers.shuffle alias):
+    gather over a random permutation."""
+    perm = randperm(x.shape[0], seed=int(seed or 0))
+    return dispatch("index_select", {"X": x, "Index": perm}, {"dim": 0})

@@ -1,0 +1,100 @@
+"""paddle.tensor.search — parity with python/paddle/tensor/search.py
+(argmax:45, index_select:138, nonzero:202, sort:289, where:381,
+index_sample:459).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._dispatch import dispatch, in_dygraph_mode
+
+__all__ = ["argmax", "argmin", "argsort", "has_inf", "has_nan", "topk",
+           "where", "index_select", "nonzero", "sort", "index_sample"]
+
+
+def argmax(input, axis=None, dtype=None, out=None, keepdims=False,
+           name=None):
+    """search.py:45 — axis=None flattens first (reference flatten+axis 0)."""
+    x = input
+    if axis is None:
+        x = dispatch("reshape2", {"X": x}, {"shape": [-1]})
+        axis = 0
+    out = dispatch("arg_max", {"X": x}, {"axis": int(axis)},
+                   out_dtypes="int64", stop_gradient=True)
+    if dtype is not None and str(dtype) not in ("int64",):
+        out = dispatch("cast", {"X": out}, {"out_dtype": str(dtype)},
+                       out_dtypes=str(dtype))
+    return out
+
+
+def argmin(input, axis=None, dtype=None, out=None, keepdims=False,
+           name=None):
+    x = input
+    if axis is None:
+        x = dispatch("reshape2", {"X": x}, {"shape": [-1]})
+        axis = 0
+    out = dispatch("arg_min", {"X": x}, {"axis": int(axis)},
+                   out_dtypes="int64", stop_gradient=True)
+    if dtype is not None and str(dtype) not in ("int64",):
+        out = dispatch("cast", {"X": out}, {"out_dtype": str(dtype)},
+                       out_dtypes=str(dtype))
+    return out
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    out, idx = dispatch("argsort", {"X": input},
+                        {"axis": int(axis), "descending": bool(descending)},
+                        out_slots=("Out", "Indices"),
+                        out_dtypes={"Out": None, "Indices": "int64"})
+    return out, idx
+
+
+def sort(input, axis=-1, descending=False, out=None, name=None):
+    """search.py:289 — returns (sorted, indices)."""
+    return argsort(input, axis=axis, descending=descending, name=name)
+
+
+def topk(input, k, axis=-1, largest=True, sorted=True, name=None):
+    vals, idx = dispatch("top_k", {"X": input}, {"k": int(k)},
+                         out_slots=("Out", "Indices"),
+                         out_dtypes={"Out": None, "Indices": "int64"})
+    return vals, idx
+
+
+def where(condition, x, y, name=None):
+    """search.py:381 — elementwise select."""
+    return dispatch("where", {"Condition": condition, "X": x, "Y": y})
+
+
+def index_select(input, index, dim=0):
+    """search.py:138."""
+    return dispatch("index_select", {"X": input, "Index": index},
+                    {"dim": int(dim)})
+
+
+def index_sample(x, index):
+    """search.py:459 — per-row gather."""
+    return dispatch("index_sample", {"X": x, "Index": index})
+
+
+def nonzero(input, as_tuple=False):
+    """search.py:202 — dynamic-shape host op (CPU utility on TPU)."""
+    out = dispatch("where_index", {"Condition": input}, out_dtypes="int64",
+                   stop_gradient=True)
+    if not as_tuple:
+        return out
+    nd = len(input.shape)
+    cols = [dispatch("slice", {"Input": out},
+                     {"axes": [1], "starts": [i], "ends": [i + 1]})
+            for i in range(nd)]
+    return tuple(cols)
+
+
+def has_inf(x):
+    return dispatch("has_inf", {"X": x}, out_dtypes="bool",
+                    stop_gradient=True)
+
+
+def has_nan(x):
+    return dispatch("has_nan", {"X": x}, out_dtypes="bool",
+                    stop_gradient=True)

@@ -1,0 +1,41 @@
+"""paddle.tensor.stat — parity with python/paddle/tensor/stat.py
+(var:29, std:108).
+"""
+from __future__ import annotations
+
+from ._dispatch import dispatch
+from .math import _reduce, reduce_sum, square, sqrt, scale
+
+__all__ = ["mean", "reduce_mean", "std", "var"]
+
+
+def mean(x, name=None):
+    return dispatch("mean", {"X": x})
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim)
+
+
+def var(input, axis=None, keepdim=False, unbiased=True, out=None, name=None):
+    """stat.py:29 — E[(x - E[x])^2], Bessel-corrected when unbiased."""
+    import numpy as np
+
+    m = _reduce("reduce_mean", input, axis, True)
+    diff = dispatch("elementwise_sub", {"X": input, "Y": m}, {"axis": -1})
+    v = _reduce("reduce_mean", square(diff), axis, keepdim)
+    if unbiased:
+        shape = input.shape
+        if axis is None:
+            n = int(np.prod(shape))
+        else:
+            dims = [axis] if isinstance(axis, int) else list(axis)
+            n = int(np.prod([shape[d] for d in dims]))
+        if n > 1:
+            v = scale(v, scale=n / (n - 1))
+    return v
+
+
+def std(input, axis=None, keepdim=False, unbiased=True, out=None, name=None):
+    """stat.py:108."""
+    return sqrt(var(input, axis=axis, keepdim=keepdim, unbiased=unbiased))

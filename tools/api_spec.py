@@ -36,6 +36,17 @@ _MODULES = [
     "paddle_tpu.regularizer",
     "paddle_tpu.dygraph",
     "paddle_tpu.contrib.slim.prune",
+    # paddle-2.0-preview namespaces
+    "paddle_tpu.tensor",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.functional.conv",
+    "paddle_tpu.nn.functional.loss",
+    "paddle_tpu.nn.initializer",
+    "paddle_tpu.metric",
+    "paddle_tpu.imperative",
+    "paddle_tpu.declarative",
+    "paddle_tpu.framework",
 ]
 
 
