@@ -13,20 +13,44 @@ converters in convert_operators.py:
     for i in range(n): B ->  _jst.convert_for_range(0, n, 1, __b, (v...))
     a and b / or / not   ->  _jst.convert_logical_*(lambda: a, lambda: b)
 
-Branch/loop bodies containing return/break/continue/yield, or assignments
-to attributes/subscripts, are left as plain Python (they still work for
-concrete predicates; a traced predicate then raises jax's concretization
-error, matching the reference's unsupported-construct diagnostics).
+Flow-escape statements are rewritten into dataflow first, mirroring the
+reference's transformer stack (break_continue_transformer.py,
+return_transformer.py, print_transformer.py, assert_transformer.py,
+list_transformer.py):
+
+    break/continue  ->  boolean flag vars + guarded remainders
+    return-in-flow  ->  __d2s_ret_flag/__d2s_ret_val + guarded remainders
+    print(x)        ->  _jst.convert_print(x)   (jax.debug.print if traced)
+    assert c, m     ->  _jst.convert_assert(c, m)
+    x = [...]       ->  x = _jst.convert_list([...])
+    x.append(v)     ->  _jst.convert_append(x, v)
+
+tensor.shape needs no transformer here: XLA shapes are static, so
+``x.shape[0]`` is already a concrete Python int at trace time (the
+capability of the reference's tensor_shape_transformer.py falls out of the
+design).  Bodies still containing yield, or assignments to attributes/
+subscripts, are left as plain Python with a STAGING-TIME WARNING (they
+work for concrete predicates; a traced predicate raises jax's
+concretization error).
 """
 from __future__ import annotations
 
 import ast
 import inspect
 import textwrap
+import warnings
 from typing import List, Set
 
 
 _JST = "_jst"
+
+
+def _warn_unconverted(node, reason):
+    warnings.warn(
+        f"dygraph_to_static: {type(node).__name__} at line "
+        f"{getattr(node, 'lineno', '?')} left as plain Python ({reason}); "
+        "it will only work with concrete (non-traced) predicates",
+        stacklevel=2)
 
 
 def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
@@ -132,6 +156,214 @@ def _assign_tuple(names, value) -> ast.stmt:
     return ast.Assign(targets=[target], value=value)
 
 
+def _assign_const(name, value) -> ast.stmt:
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _not(expr) -> ast.expr:
+    return ast.UnaryOp(op=ast.Not(), operand=expr)
+
+
+def _contains_direct(stmts, node_type) -> bool:
+    """node_type (Break/Continue) belonging to THIS loop level: do not
+    descend into nested loops or function defs."""
+    for s in stmts:
+        if isinstance(s, node_type):
+            return True
+        if isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                          ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            if _contains_direct(getattr(s, field, []), node_type):
+                return True
+    return False
+
+
+def _replace_flow(stmts, node_type, make_assigns):
+    """Replace break/continue/return statements with flag assignments and
+    guard the statements that follow (reference
+    break_continue_transformer.py:1 / return_transformer.py ForToWhile +
+    flag guards).  Returns (new_stmts, found, flag_test) where flag_test
+    builds the `not flag` guard expr."""
+    found = False
+    new: List[ast.stmt] = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, node_type):
+            new.extend(make_assigns(s))
+            # statements after break/continue/return in the same block are
+            # unreachable in Python — drop them
+            return new, True
+        if isinstance(s, ast.If):
+            body, f1 = _replace_flow(s.body, node_type, make_assigns)
+            orelse, f2 = _replace_flow(s.orelse, node_type, make_assigns)
+            new.append(ast.If(test=s.test, body=body or [ast.Pass()],
+                              orelse=orelse))
+            if f1 or f2:
+                found = True
+                rest, _ = _replace_flow(stmts[idx + 1:], node_type,
+                                        make_assigns)
+                if rest:
+                    # the remainder only runs when the flag did not fire
+                    new.append(("GUARD", rest))
+                return new, True
+            continue
+        if isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+            new.append(s)       # nested loop/def: its own flow scope
+            continue
+        new.append(s)
+    return new, found
+
+
+def _resolve_guards(stmts, flag):
+    """Second pass: materialize ("GUARD", rest) placeholders as
+    `if not flag: rest` (recursively)."""
+    out = []
+    for s in stmts:
+        if isinstance(s, tuple) and s[0] == "GUARD":
+            out.append(ast.If(test=_not(_name(flag)),
+                              body=_resolve_guards(s[1], flag), orelse=[]))
+        elif isinstance(s, ast.If):
+            s.body = _resolve_guards(s.body, flag) or [ast.Pass()]
+            s.orelse = _resolve_guards(s.orelse, flag)
+            out.append(s)
+        else:
+            out.append(s)
+    return out
+
+
+class BreakContinueTransformer(ast.NodeTransformer):
+    """break/continue -> flag dataflow — parity with
+    dygraph_to_static/break_continue_transformer.py:1 (289 LoC).
+
+    continue: a per-iteration flag, set instead of continuing; every
+    statement after the set point is guarded by `if not flag`.
+    break: a cross-iteration flag initialized before the loop; a While's
+    test gains `and not flag`, a For's body is wrapped in the guard so
+    remaining iterations become no-ops (XLA control flow cannot early-exit
+    a fori_loop anyway — the masked form is the TPU-native shape).
+    """
+
+    def __init__(self):
+        self._counter = 0
+
+    def _fresh(self, kind):
+        self._counter += 1
+        return f"__d2s_{kind}_{self._counter}"
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        return self._xform(node)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        return self._xform(node)
+
+    def _xform(self, node):
+        has_break = _contains_direct(node.body, ast.Break)
+        has_cont = _contains_direct(node.body, ast.Continue)
+        if not (has_break or has_cont):
+            return node
+        pre: List[ast.stmt] = []
+        body = list(node.body)
+        if has_cont:
+            cflag = self._fresh("continue")
+            body, _ = _replace_flow(
+                body, ast.Continue, lambda s: [_assign_const(cflag, True)])
+            body = _resolve_guards(body, cflag)
+            body = [_assign_const(cflag, False)] + body
+            # the flag becomes a loop-carried name once the loop converts,
+            # so it needs a binding at the loop-entry site too
+            pre.append(_assign_const(cflag, False))
+        if has_break:
+            bflag = self._fresh("break")
+            body, _ = _replace_flow(
+                body, ast.Break, lambda s: [_assign_const(bflag, True)])
+            body = _resolve_guards(body, bflag)
+            pre.append(_assign_const(bflag, False))
+            if isinstance(node, ast.While):
+                node.test = ast.BoolOp(
+                    op=ast.And(), values=[_not(_name(bflag)), node.test])
+            else:
+                body = [ast.If(test=_not(_name(bflag)), body=body,
+                               orelse=[])]
+        node.body = body
+        return pre + [node]
+
+
+class ReturnTransformer(ast.NodeTransformer):
+    """return-inside-control-flow -> flag + value dataflow — parity with
+    dygraph_to_static/return_transformer.py."""
+
+    _COUNT = 0
+
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+        in_flow = any(
+            isinstance(sub, ast.Return)
+            for s in node.body if isinstance(s, (ast.If, ast.While, ast.For))
+            for sub in ast.walk(s))
+        if not in_flow:
+            return node
+        ReturnTransformer._COUNT += 1
+        rflag = f"__d2s_ret_flag_{ReturnTransformer._COUNT}"
+        rval = f"__d2s_ret_val_{ReturnTransformer._COUNT}"
+
+        def make(s):
+            value = s.value if s.value is not None else ast.Constant(
+                value=None)
+            return [_assign_const(rflag, True),
+                    ast.Assign(targets=[_name(rval, ast.Store())],
+                               value=value)]
+
+        body, _ = _replace_flow(node.body, ast.Return, make)
+        body = _resolve_guards(body, rflag)
+        node.body = ([_assign_const(rflag, False),
+                      _assign_const(rval, None)] + body +
+                     [ast.Return(value=_name(rval))])
+        return node
+
+
+class PrintAssertTransformer(ast.NodeTransformer):
+    """print()/assert -> runtime converters — parity with
+    print_transformer.py:1 and assert_transformer.py."""
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        v = node.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
+                and v.func.id == "print" and not v.keywords:
+            node.value = _jst_call("convert_print", v.args)
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        msg = node.msg if node.msg is not None else ast.Constant(value=None)
+        return ast.Expr(value=_jst_call("convert_assert", [node.test, msg]))
+
+
+class ListTransformer(ast.NodeTransformer):
+    """list literals / append / pop -> runtime list converters — the
+    capability of list_transformer.py:1 (300 LoC) on the padded-tensor
+    convention: concrete loops keep Python list semantics; under tracing
+    the converters steer users to the bounded TensorArray."""
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        if isinstance(node.value, ast.List):
+            node.value = _jst_call("convert_list", [node.value])
+        return node
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("append", "pop") \
+                and isinstance(f.value, ast.Name) and not node.keywords:
+            return _jst_call(f"convert_{f.attr}", [f.value] + node.args)
+        return node
+
+
 class LogicalTransformer(ast.NodeTransformer):
     """a and b -> _jst.convert_logical_and(lambda: a, lambda: b), keeping
     rhs lazy (logical_transformer.py)."""
@@ -171,7 +403,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
         bodies = node.body + node.orelse
-        if _has_flow_escape(bodies) or _has_complex_assign(bodies):
+        if _has_flow_escape(bodies):
+            _warn_unconverted(node, "body contains yield or an unconverted "
+                              "return/break/continue")
+            return node
+        if _has_complex_assign(bodies):
+            _warn_unconverted(node, "body assigns to an attribute or "
+                              "subscript")
             return node
         names = sorted(_assigned_names(node.body)
                        | _assigned_names(node.orelse))
@@ -206,6 +444,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if node.orelse or _has_flow_escape(node.body) \
                 or _has_complex_assign(node.body):
+            _warn_unconverted(node, "while-else, yield, or attribute/"
+                              "subscript assignment in the loop body")
             return node
         names = sorted(_assigned_names(node.body))
         if not names:
@@ -235,12 +475,18 @@ class ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if node.orelse or _has_flow_escape(node.body) \
                 or _has_complex_assign(node.body):
+            _warn_unconverted(node, "for-else, yield, or attribute/"
+                              "subscript assignment in the loop body")
             return node
         if not (isinstance(node.target, ast.Name)
                 and isinstance(node.iter, ast.Call)
                 and isinstance(node.iter.func, ast.Name)
                 and node.iter.func.id == "range"
                 and not node.iter.keywords):
+            # non-range iterables stay as Python iteration (concrete
+            # sequences work; a traced iterable cannot be looped in Python
+            # anyway) — no warning: this is the supported idiom for
+            # containers
             return node
         names = sorted(_assigned_names(node.body) - {node.target.id})
         if not names:
@@ -296,6 +542,13 @@ class DygraphToStaticAst:
     (ast_transformer.py DygraphToStaticAst.get_static_ast)."""
 
     def transform(self, tree: ast.AST) -> ast.AST:
+        # order matters: flow-escape statements become dataflow first so
+        # the control-flow pass sees plain assignments; logical rewriting
+        # runs after them because they synthesize `and`/`not` expressions
+        tree = BreakContinueTransformer().visit(tree)
+        tree = ReturnTransformer().visit(tree)
+        tree = PrintAssertTransformer().visit(tree)
+        tree = ListTransformer().visit(tree)
         tree = LogicalTransformer().visit(tree)
         tree = ControlFlowTransformer().visit(tree)
         tree = CallTransformer().visit(tree)
@@ -324,7 +577,8 @@ def convert_to_static(fn):
     # (convert_call reaches it), so functions that merely call helpers
     # still need the rewrite
     has_flow = any(
-        isinstance(n, (ast.If, ast.While, ast.For, ast.BoolOp, ast.Call))
+        isinstance(n, (ast.If, ast.While, ast.For, ast.BoolOp, ast.Call,
+                       ast.Assert))
         for n in ast.walk(fndef))
     if not has_flow:
         return fn

@@ -178,6 +178,89 @@ def convert_logical_not(x):
     return jnp.logical_not(_pred_value(x))
 
 
+def convert_print(*args):
+    """print() inside converted code — parity with print_transformer.py:
+    traced values print via jax.debug.print (host callback at run time),
+    concrete values print immediately."""
+    if any(_is_traced(a) for a in args):
+        fmt = " ".join("{}" for _ in args)
+        jax.debug.print(fmt, *[_unwrap(a) for a in args])
+    else:
+        print(*[_unwrap(a) if hasattr(_unwrap(a), "shape") else a
+                for a in args])
+
+
+def convert_assert(cond, msg=None):
+    """assert inside converted code — parity with assert_transformer.py
+    (the reference emits an Assert op). A traced condition checks on host
+    via a debug callback; a concrete one asserts immediately."""
+    if _is_traced(cond):
+        def _check(ok):
+            if not bool(ok):
+                raise AssertionError(msg if msg is not None
+                                     else "converted assert failed")
+        jax.debug.callback(_check, _pred_value(cond))
+    else:
+        assert bool(_pred_value(cond)), msg
+
+
+class TensorArray:
+    """Bounded tensor array for traced loops — the TPU-native counterpart
+    of the reference's LoDTensorArray-backed list conversion
+    (list_transformer.py): XLA needs static shapes, so the array
+    preallocates ``capacity`` slots and tracks a traced length.  Use
+    inside converted while/for bodies where a Python list cannot stage.
+    """
+
+    def __init__(self, element_shape, capacity, dtype="float32"):
+        self.capacity = int(capacity)
+        self.buffer = jnp.zeros((self.capacity,) + tuple(element_shape),
+                                dtype)
+        self.size = jnp.asarray(0, jnp.int32)
+
+    def append(self, value):
+        self.buffer = lax.dynamic_update_index_in_dim(
+            self.buffer, _unwrap(value).astype(self.buffer.dtype),
+            self.size, 0)
+        self.size = self.size + 1
+        return self
+
+    def __getitem__(self, i):
+        return lax.dynamic_index_in_dim(self.buffer, _unwrap(i), 0,
+                                        keepdims=False)
+
+    def stack(self):
+        """The filled prefix, padded to capacity (static shape); pair with
+        ``self.size`` for the true length — the padded [B, T] convention."""
+        return self.buffer
+
+    def flatten(self):
+        return self.buffer, self.size
+
+
+class D2SList(list):
+    """Converted list: full Python-list semantics. Appending traced values
+    inside a CONCRETE (unrolled) loop is fine — the tensors stack after
+    the loop. A list crossing a lax.while_loop boundary cannot stage (the
+    functional loop carries only its declared loop vars); that case needs
+    TensorArray, and jax reports it as a leaked tracer at the use site."""
+
+
+def convert_list(init=None):
+    return D2SList(init or [])
+
+
+def convert_append(lst, value):
+    """x.append(v) — list-likes (incl. TensorArray) append; anything else
+    falls back to its own method."""
+    lst.append(value)
+    return lst
+
+
+def convert_pop(lst, *args):
+    return lst.pop(*args)
+
+
 import weakref
 
 # WeakKey so short-lived user functions (defined in loops/notebooks) do

@@ -262,3 +262,152 @@ def test_convert_call_bound_methods():
         b = np.asarray(net.fc.bias.value)
         want = np.full((1, 3), 2.0) @ w + b
         np.testing.assert_allclose(_np(out), want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-5 transformers: break/continue, return-in-flow, print/assert, lists
+# (reference break_continue_transformer.py, return_transformer.py,
+#  print_transformer.py, assert_transformer.py, list_transformer.py)
+# ---------------------------------------------------------------------------
+
+def test_break_in_while_stages():
+    @declarative
+    def fn(x):
+        i = 0
+        s = x * 0.0
+        while i < 10:
+            s = s + x
+            i = i + 1
+            if i >= 3:
+                break
+        return s
+
+    def eager(xv):
+        return xv * 3
+
+    with dg.guard():
+        x = to_variable(np.full((2,), 2.0, "float32"))
+        np.testing.assert_allclose(_np(fn(x)), eager(np.full((2,), 2.0)))
+
+
+def test_continue_in_for_stages():
+    @declarative
+    def fn(x):
+        s = x * 0.0
+        for i in range(6):
+            if i % 2 == 1:
+                continue
+            s = s + x
+        return s
+
+    with dg.guard():
+        x = to_variable(np.full((2,), 1.5, "float32"))
+        np.testing.assert_allclose(_np(fn(x)), np.full((2,), 4.5))
+
+
+def test_early_return_on_shape_condition():
+    @declarative
+    def fn(x):
+        if x.shape[0] > 1:
+            return x * 10.0
+        y = x + 1.0
+        return y
+
+    with dg.guard():
+        big = to_variable(np.ones((3, 2), "float32"))
+        small = to_variable(np.ones((1, 2), "float32"))
+        np.testing.assert_allclose(_np(fn(big)), np.ones((3, 2)) * 10)
+        np.testing.assert_allclose(_np(fn(small)), np.ones((1, 2)) + 1)
+
+
+def test_verdict_composite_list_break_return():
+    """The VERDICT done-criterion: list.append in a loop + early break +
+    shape-conditioned return, staged and matching eager."""
+    @declarative
+    def fn(x):
+        if x.shape[0] > 4:
+            return x
+        pieces = []
+        for i in range(8):
+            if i >= x.shape[0]:
+                break
+            pieces.append(x[i] * float(i))
+        import paddle_tpu as paddle
+        return paddle.stack(pieces, axis=0)
+
+    def eager(xv):
+        return np.stack([xv[i] * i for i in range(xv.shape[0])])
+
+    with dg.guard():
+        x3 = np.arange(6, dtype="float32").reshape(3, 2)
+        np.testing.assert_allclose(_np(fn(to_variable(x3))), eager(x3))
+        x5 = np.ones((5, 2), "float32")
+        np.testing.assert_allclose(_np(fn(to_variable(x5))), x5)
+
+
+def test_nested_break_guards_following_statements():
+    @declarative
+    def fn(x):
+        total = x * 0.0
+        dead = x * 0.0
+        i = 0
+        while i < 5:
+            i = i + 1
+            if i == 3:
+                break
+            total = total + x      # must NOT run on the break iteration
+        dead = dead + 1.0
+        return total + dead
+
+    with dg.guard():
+        x = to_variable(np.full((2,), 1.0, "float32"))
+        # iterations 1, 2 add x; break fires at i==3 before the add
+        np.testing.assert_allclose(_np(fn(x)), np.full((2,), 3.0))
+
+
+def test_print_and_assert_convert(capsys):
+    @declarative
+    def fn(x):
+        assert x.shape[0] == 2, "bad shape"
+        print("inside", x.shape[0])
+        return x + 1.0
+
+    with dg.guard():
+        out = fn(to_variable(np.zeros((2,), "float32")))
+        np.testing.assert_allclose(_np(out), np.ones((2,)))
+        assert "inside 2" in capsys.readouterr().out
+        with pytest.raises(AssertionError):
+            fn(to_variable(np.zeros((3,), "float32")))
+
+
+def test_unconverted_construct_warns_at_staging_time():
+    import warnings as _w
+
+    def fn(x):
+        obj = {"k": x}
+        if x.value.sum() > 0:       # traced predicate...
+            obj["k"] = x + 1        # ...but subscript assignment in body
+        return obj["k"]
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        convert_to_static(fn)
+    assert any("left as plain Python" in str(r.message) for r in rec), \
+        [str(r.message) for r in rec]
+
+
+def test_tensor_array_bounded_append():
+    from paddle_tpu.dygraph.dygraph_to_static import convert_operators as co
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        ta = co.TensorArray(element_shape=(2,), capacity=4)
+        ta = ta.append(x)
+        ta = ta.append(x * 2)
+        return ta.stack(), ta.size
+
+    buf, size = jax.jit(step)(jnp.ones((2,), jnp.float32))
+    assert int(size) == 2
+    np.testing.assert_allclose(np.asarray(buf[:2]),
+                               [[1.0, 1.0], [2.0, 2.0]])
