@@ -26,8 +26,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .framework.core import convert_dtype
-from .framework.program import Variable
+from ..framework.core import convert_dtype
+from ..framework.program import Variable
 
 __all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset", "QueueDataset"]
 
@@ -45,7 +45,7 @@ def _native_lib():
     if not _lib_tried:
         _lib_tried = True
         try:
-            from . import native
+            from .. import native
             lib = native.load_library("slot_parser")
             lib.ps_parse.restype = ctypes.c_void_p
             lib.ps_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64,
@@ -422,3 +422,13 @@ def iter_batches_threaded(dataset: DatasetBase, threads: int,
             pass
         producer.join(timeout=5)
         pool.shutdown(wait=False, cancel_futures=True)
+
+
+# ---------------------------------------------------------------------------
+# paddle.dataset built-in dataset loaders (reference python/paddle/dataset):
+# deterministic local fixtures, no network — see each submodule.
+# ---------------------------------------------------------------------------
+from . import (  # noqa: F401,E402
+    cifar, common, conll05, flowers, imdb, imikolov, mnist, movielens,
+    sentiment, uci_housing, voc2012, wmt14, wmt16,
+)

@@ -1,0 +1,43 @@
+"""paddle.dataset.cifar — parity with python/paddle/dataset/cifar.py
+(reader yields (float32[3072] in [0,1], int label); train10/test10 and
+train100/test100)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+
+
+def _creator(split, n, num_classes):
+    def reader():
+        rs = fixture_rng(f"cifar{num_classes}", split)
+        labels = rs.randint(0, num_classes, n)
+        for i in range(n):
+            base = np.full(3072, (labels[i] + 0.5) / num_classes,
+                           np.float32)
+            img = np.clip(base + rs.rand(3072).astype(np.float32) * 0.3,
+                          0, 1)
+            yield img, int(labels[i])            # cifar.py:55
+
+    return reader
+
+
+def train10():
+    return _creator("train", TRAIN_SIZE, 10)
+
+
+def test10():
+    return _creator("test", TEST_SIZE, 10)
+
+
+def train100():
+    return _creator("train", TRAIN_SIZE, 100)
+
+
+def test100():
+    return _creator("test", TEST_SIZE, 100)

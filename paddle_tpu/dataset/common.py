@@ -1,0 +1,83 @@
+"""paddle.dataset.common — parity with python/paddle/dataset/common.py.
+
+The reference's common module downloads archives into ~/.cache/paddle/
+dataset and md5-checks them.  This environment has no network, so every
+dataset here is a DETERMINISTIC LOCAL FIXTURE: records are synthesized
+once per (dataset, split) from a fixed seed and cached in-process.  The
+record SCHEMAS match the reference loaders exactly (shapes, dtypes, value
+ranges, normalization), so reader-consuming programs (paddle.batch +
+DataFeeder + the book examples) run unchanged; only the pixel/token
+content is synthetic.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+__all__ = ["DATA_HOME", "md5file", "split", "cluster_files_reader",
+           "fixture_rng"]
+
+DATA_HOME = os.path.join(
+    os.environ.get("PADDLE_TPU_DATA_HOME",
+                   os.path.join(tempfile.gettempdir(), "paddle_tpu")),
+    "dataset")
+
+
+def fixture_rng(name: str, split: str) -> np.random.RandomState:
+    """The deterministic generator every fixture dataset derives from."""
+    seed = (hash((name, split)) & 0x7FFFFFFF) or 1
+    return np.random.RandomState(seed)
+
+
+def md5file(fname):
+    import hashlib
+
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """reference common.split — dump a reader into chunked pickle files."""
+    import pickle
+
+    indx_f = 0
+    batch = []
+    out_files = []
+
+    def _dump(records, idx):
+        fname = suffix % idx
+        with open(fname, "wb") as f:
+            (dumper or pickle.dump)(records, f)
+        out_files.append(fname)
+
+    for item in reader():
+        batch.append(item)
+        if len(batch) == line_count:
+            _dump(batch, indx_f)
+            indx_f += 1
+            batch = []
+    if batch:
+        _dump(batch, indx_f)
+    return out_files
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """reference common.cluster_files_reader — shard pickled chunks."""
+    import glob
+    import pickle
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        my = flist[trainer_id::trainer_count]
+        for fn in my:
+            with open(fn, "rb") as f:
+                for item in (loader or pickle.load)(f):
+                    yield item
+
+    return reader

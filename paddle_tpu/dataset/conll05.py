@@ -1,0 +1,49 @@
+"""paddle.dataset.conll05 — parity with python/paddle/dataset/conll05.py
+(get_dict:209 returns (word, verb, label) dicts; test:~220 yields the
+9-slot SRL record — conll05.py:199:
+ word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_idx, mark, label).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["get_dict", "get_embedding", "test", "UNK_IDX"]
+
+UNK_IDX = 0
+_WORDS = 1000
+_VERBS = 50
+_LABELS = 59            # reference SRL label-dict size ballpark
+TEST_SIZE = 256
+_EMB_DIM = 32
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(_WORDS)}
+    verb_dict = {f"v{i}": i for i in range(_VERBS)}
+    label_dict = {f"l{i}": i for i in range(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rs = fixture_rng("conll05", "emb")
+    return rs.randn(_WORDS, _EMB_DIM).astype(np.float32)
+
+
+def test():
+    def reader():
+        rs = fixture_rng("conll05", "test")
+        for _ in range(TEST_SIZE):
+            ln = int(rs.randint(4, 30))
+            words = rs.randint(0, _WORDS, ln).tolist()
+            verb = int(rs.randint(0, _VERBS))
+            vpos = int(rs.randint(0, ln))
+            mark = [1 if i == vpos else 0 for i in range(ln)]
+            labels = rs.randint(0, _LABELS, ln).tolist()
+            ctx = [[int(words[max(0, min(ln - 1, vpos + d))])] * ln
+                   for d in (-2, -1, 0, 1, 2)]
+            yield (words, ctx[0], ctx[1], ctx[2], ctx[3], ctx[4],
+                   [verb] * ln, mark, labels)
+
+    return reader

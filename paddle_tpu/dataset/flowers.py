@@ -1,0 +1,39 @@
+"""paddle.dataset.flowers — parity with python/paddle/dataset/flowers.py
+(train/test/valid yield (float32[3*224*224] image, int label in [0,102))
+— flowers.py:136)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "valid"]
+
+_CLASSES = 102
+_DIM = 3 * 224 * 224
+_SIZES = {"train": 256, "test": 64, "valid": 64}
+
+
+def _creator(split, use_xmap=True):
+    def reader():
+        rs = fixture_rng("flowers", split)
+        for _ in range(_SIZES[split]):
+            label = int(rs.randint(0, _CLASSES))
+            img = np.clip(
+                np.full(_DIM, (label + 0.5) / _CLASSES, np.float32)
+                + rs.rand(_DIM).astype(np.float32) * 0.2, 0, 1)
+            yield img, label
+
+    return reader
+
+
+def train(use_xmap=True):
+    return _creator("train", use_xmap)
+
+
+def test(use_xmap=True):
+    return _creator("test", use_xmap)
+
+
+def valid(use_xmap=True):
+    return _creator("valid", use_xmap)

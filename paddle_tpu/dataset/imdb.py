@@ -1,0 +1,50 @@
+"""paddle.dataset.imdb — parity with python/paddle/dataset/imdb.py
+(train/test(word_idx) yield ([word ids], 0/1 label); word_dict())."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 5149            # reference imdb vocabulary size ballpark
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+
+
+def word_dict():
+    """word -> id map ending with '<unk>' (imdb.py build_dict contract)."""
+    d = {f"w{i}": i for i in range(_VOCAB)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _creator(split, n):
+    def creator(word_idx):
+        unk = word_idx.get("<unk>", len(word_idx) - 1)
+
+        def reader():
+            rs = fixture_rng("imdb", split)
+            vocab = len(word_idx)
+            for _ in range(n):
+                label = int(rs.randint(0, 2))
+                ln = int(rs.randint(8, 64))
+                # class-dependent token distribution so classifiers learn
+                lo, hi = (0, vocab // 2) if label == 0 else (vocab // 2,
+                                                             vocab)
+                doc = [min(int(t), unk)
+                       for t in rs.randint(lo, hi, ln)]
+                yield doc, label                    # imdb.py:92
+
+        return reader
+
+    return creator
+
+
+def train(word_idx):
+    return _creator("train", TRAIN_SIZE)(word_idx)
+
+
+def test(word_idx):
+    return _creator("test", TEST_SIZE)(word_idx)

@@ -1,0 +1,52 @@
+"""paddle.dataset.imikolov — parity with python/paddle/dataset/imikolov.py
+(build_dict; train/test(word_idx, n) yield n-gram tuples — imikolov.py:100;
+DataType.SEQ yields (src_seq, trg_seq) — :107)."""
+from __future__ import annotations
+
+from .common import fixture_rng
+
+__all__ = ["build_dict", "train", "test", "DataType"]
+
+_VOCAB = 2073            # reference imikolov dict size ballpark
+TRAIN_SENTENCES = 512
+TEST_SENTENCES = 128
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    d = {f"w{i}": i for i in range(_VOCAB)}
+    d["<unk>"] = len(d)
+    d["<s>"] = len(d)
+    d["<e>"] = len(d)
+    return d
+
+
+def _creator(split, sentences, word_idx, n, data_type):
+    def reader():
+        rs = fixture_rng("imikolov", split)
+        s_id, e_id = word_idx["<s>"], word_idx["<e>"]
+        vocab = min(len(word_idx), _VOCAB)
+        for _ in range(sentences):
+            ln = int(rs.randint(5, 20))
+            l = [s_id] + [int(t) for t in rs.randint(0, vocab, ln)] + [e_id]
+            if data_type == DataType.NGRAM:
+                if len(l) >= n:
+                    l = l[:]
+                    for i in range(n, len(l) + 1):
+                        yield tuple(l[i - n:i])     # imikolov.py:100
+            else:
+                yield l[:-1], l[1:]                 # imikolov.py:107
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _creator("train", TRAIN_SENTENCES, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _creator("test", TEST_SENTENCES, word_idx, n, data_type)

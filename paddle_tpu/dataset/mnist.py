@@ -1,0 +1,50 @@
+"""paddle.dataset.mnist — parity with python/paddle/dataset/mnist.py
+(reader_creator:41 — yields (image[784] float32 in [-1, 1], int label)).
+
+Deterministic local fixture (common.py): blob-per-digit images so a small
+model genuinely learns; same shapes/normalization as the reference's
+idx-file reader.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["train", "test"]
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+def _make(split, n):
+    rs = fixture_rng("mnist", split)
+    labels = rs.randint(0, 10, n).astype(np.int64)
+    images = np.empty((n, 784), np.float32)
+    grid = np.stack(np.meshgrid(np.arange(28), np.arange(28),
+                                indexing="ij"), -1).reshape(-1, 2)
+    for i, lbl in enumerate(labels):
+        # one gaussian blob per class at a class-specific center
+        cy, cx = 6 + (lbl % 5) * 4, 6 + (lbl // 5) * 14
+        d2 = ((grid[:, 0] - cy) ** 2 + (grid[:, 1] - cx) ** 2)
+        img = np.exp(-d2 / 18.0) + rs.rand(784) * 0.15
+        images[i] = np.clip(img, 0, 1) * 2.0 - 1.0   # reference: [-1, 1]
+    return images, labels
+
+
+def reader_creator(split, n):
+    def reader():
+        images, labels = _make(split, n)
+        for i in range(n):
+            yield images[i, :], int(labels[i])
+
+    return reader
+
+
+def train():
+    """mnist.py:92 train reader creator — (float32[784] in [-1,1], int)."""
+    return reader_creator("train", TRAIN_SIZE)
+
+
+def test():
+    return reader_creator("test", TEST_SIZE)

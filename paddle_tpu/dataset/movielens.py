@@ -1,0 +1,126 @@
+"""paddle.dataset.movielens — parity with
+python/paddle/dataset/movielens.py (records are
+usr.value() + mov.value() + [[rating]] — movielens.py:167:
+ [uid, gender(0/1), age_bucket, job_id,
+  mov_id, [category ids], [title word ids], [rating]]).
+"""
+from __future__ import annotations
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "movie_categories", "user_info",
+           "movie_info", "age_table"]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_MOVIES = 400
+_N_USERS = 600
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 1000
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index, list(self.categories), list(self.title)]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age_idx, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_idx
+        self.job_id = job_id
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+def _movies():
+    rs = fixture_rng("movielens", "movies")
+    out = {}
+    for i in range(1, _N_MOVIES + 1):
+        cats = sorted(set(rs.randint(0, _N_CATEGORIES,
+                                     rs.randint(1, 4)).tolist()))
+        title = rs.randint(0, _TITLE_VOCAB, rs.randint(1, 6)).tolist()
+        out[i] = MovieInfo(i, cats, title)
+    return out
+
+
+def _users():
+    rs = fixture_rng("movielens", "users")
+    out = {}
+    for i in range(1, _N_USERS + 1):
+        out[i] = UserInfo(i, "M" if rs.rand() < 0.5 else "F",
+                          int(rs.randint(0, len(age_table))),
+                          int(rs.randint(0, _N_JOBS)))
+    return out
+
+
+_MOVIES = None
+_USERS = None
+
+
+def _meta():
+    global _MOVIES, _USERS
+    if _MOVIES is None:
+        _MOVIES = _movies()
+        _USERS = _users()
+    return _MOVIES, _USERS
+
+
+def _creator(split, n):
+    def reader():
+        movies, users = _meta()
+        rs = fixture_rng("movielens", split)
+        for _ in range(n):
+            uid = int(rs.randint(1, _N_USERS + 1))
+            mid = int(rs.randint(1, _N_MOVIES + 1))
+            rating = float(rs.randint(1, 6)) * 2 - 5.0   # movielens.py:162
+            yield users[uid].value() + movies[mid].value() + [[rating]]
+
+    return reader
+
+
+def train():
+    return _creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _creator("test", TEST_SIZE)
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(_TITLE_VOCAB)}
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {f"c{i}": i for i in range(_N_CATEGORIES)}
+
+
+def movie_info():
+    return _meta()[0]
+
+
+def user_info():
+    return _meta()[1]

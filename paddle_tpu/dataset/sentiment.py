@@ -1,0 +1,36 @@
+"""paddle.dataset.sentiment — parity with
+python/paddle/dataset/sentiment.py (train/test yield ([word ids], 0/1) —
+sentiment.py:130; get_word_dict)."""
+from __future__ import annotations
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "get_word_dict"]
+
+_VOCAB = 800
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+
+
+def get_word_dict():
+    return [(f"w{i}", i) for i in range(_VOCAB)]
+
+
+def _creator(split, n):
+    def reader():
+        rs = fixture_rng("sentiment", split)
+        for _ in range(n):
+            label = int(rs.randint(0, 2))
+            ln = int(rs.randint(5, 40))
+            lo, hi = (0, _VOCAB // 2) if label else (_VOCAB // 2, _VOCAB)
+            yield rs.randint(lo, hi, ln).tolist(), label
+
+    return reader
+
+
+def train():
+    return _creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _creator("test", TEST_SIZE)

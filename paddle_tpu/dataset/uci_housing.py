@@ -1,0 +1,46 @@
+"""paddle.dataset.uci_housing — parity with
+python/paddle/dataset/uci_housing.py (train:85/test:~105 yield
+(float32[13] normalized features, float32[1] price)).
+
+Deterministic fixture: features ~ N(0,1) after the reference's
+feature_range normalization; price = a fixed linear model + noise so
+fit_a_line genuinely converges.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+_W = np.linspace(-1.5, 1.5, 13).astype(np.float32)
+
+
+def _make(split, n):
+    rs = fixture_rng("uci_housing", split)
+    x = rs.randn(n, 13).astype(np.float32)
+    y = (x @ _W + 22.5 + rs.randn(n).astype(np.float32) * 0.3)
+    return x, y.astype(np.float32)
+
+
+def _creator(split, n):
+    def reader():
+        x, y = _make(split, n)
+        for i in range(n):
+            yield x[i], y[i:i + 1]
+
+    return reader
+
+
+def train():
+    return _creator("train", 404)
+
+
+def test():
+    return _creator("test", 102)

@@ -1,0 +1,37 @@
+"""paddle.dataset.voc2012 — parity with python/paddle/dataset/voc2012.py
+(train/test/val yield (float32 CHW image, int32 HW segmentation mask) —
+voc2012.py:64)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "val"]
+
+_H = _W = 64            # fixture-sized; reference images are variable-size
+_CLASSES = 21
+_SIZES = {"train": 64, "test": 16, "val": 16}
+
+
+def _creator(split):
+    def reader():
+        rs = fixture_rng("voc2012", split)
+        for _ in range(_SIZES[split]):
+            img = rs.rand(3, _H, _W).astype(np.float32)
+            mask = rs.randint(0, _CLASSES, (_H, _W)).astype(np.int32)
+            yield img, mask
+
+    return reader
+
+
+def train():
+    return _creator("train")
+
+
+def test():
+    return _creator("test")
+
+
+def val():
+    return _creator("val")

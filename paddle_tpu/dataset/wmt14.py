@@ -1,0 +1,34 @@
+"""paddle.dataset.wmt14 — parity with python/paddle/dataset/wmt14.py
+(train/test(dict_size) yield (src_ids, trg_ids, trg_ids_next) —
+wmt14.py:112)."""
+from __future__ import annotations
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "N"]
+
+N = 30              # reference slices long sentences at N tokens
+_START, _END, _UNK = 0, 1, 2
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+
+
+def _creator(split, n, dict_size):
+    def reader():
+        rs = fixture_rng("wmt14", split)
+        for _ in range(n):
+            sl = int(rs.randint(3, N - 2))
+            tl = int(rs.randint(3, N - 2))
+            src = rs.randint(3, dict_size, sl).tolist()
+            trg = rs.randint(3, dict_size, tl).tolist()
+            yield src, [_START] + trg, trg + [_END]     # wmt14.py:108-112
+
+    return reader
+
+
+def train(dict_size):
+    return _creator("train", TRAIN_SIZE, dict_size)
+
+
+def test(dict_size):
+    return _creator("test", TEST_SIZE, dict_size)

@@ -1,0 +1,45 @@
+"""paddle.dataset.wmt16 — parity with python/paddle/dataset/wmt16.py
+(train/test/validation(src_dict_size, trg_dict_size) yield
+(src_ids, trg_ids, trg_ids_next) — wmt16.py:142; get_dict)."""
+from __future__ import annotations
+
+from .common import fixture_rng
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+_START, _END, _UNK = 0, 1, 2
+_SIZES = {"train": 512, "test": 128, "validation": 128}
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _creator(split, src_dict_size, trg_dict_size):
+    def reader():
+        rs = fixture_rng("wmt16", split)
+        for _ in range(_SIZES[split]):
+            sl = int(rs.randint(3, 28))
+            tl = int(rs.randint(3, 28))
+            src = rs.randint(3, src_dict_size, sl).tolist()
+            trg = rs.randint(3, trg_dict_size, tl).tolist()
+            yield src, [_START] + trg, trg + [_END]
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator("train", src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator("test", src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator("validation", src_dict_size, trg_dict_size)
