@@ -56,7 +56,11 @@ class PSClient:
         deadline = time.time() + timeout
         while True:
             try:
-                return socket.create_connection((host, port), timeout=5)
+                s = socket.create_connection((host, port), timeout=5)
+                # RPC-style request/response framing: Nagle would hold the
+                # frame header back waiting for the server's delayed ACK
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
             except OSError:
                 if time.time() > deadline:
                     raise

@@ -63,10 +63,25 @@ def send_msg(sock: socket.socket, obj: dict) -> None:
         total += len(meta) + arr.nbytes
     frame = _FRAME.pack(_MAGIC, _VERSION, len(tensors), len(hdr),
                         len(hdr) + total)
-    sock.sendall(frame)
-    sock.sendall(hdr)
-    for p in parts:
-        sock.sendall(p)
+    # ONE gather-send for the whole message: the old frame/header/meta
+    # sendall sequence emitted several tiny TCP segments before the bulk
+    # buffers, and Nagle + delayed ACK stalled each message ~40 ms (found
+    # by tools/ps_bench.py). sendmsg writes the iovec zero-copy.
+    _sendall_vec(sock, [frame, hdr] + parts)
+
+
+def _sendall_vec(sock: socket.socket, parts) -> None:
+    bufs = [p if isinstance(p, memoryview) else memoryview(p) for p in parts]
+    bufs = [b.cast("B") for b in bufs]
+    while bufs:
+        sent = sock.sendmsg(bufs[:64])      # stay far under IOV_MAX
+        while sent:
+            if sent >= len(bufs[0]):
+                sent -= len(bufs[0])
+                bufs.pop(0)
+            else:
+                bufs[0] = bufs[0][sent:]
+                sent = 0
 
 
 def recv_msg(sock: socket.socket):
@@ -215,6 +230,7 @@ class ParameterServer:
         while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 break
             t = threading.Thread(target=self._serve_conn, args=(conn,),

@@ -1,0 +1,57 @@
+"""PS wire concurrency: 4 trainer processes x 2 pservers exchange dense +
+sparse traffic concurrently and every update lands (VERDICT r4 #7).
+
+The full throughput numbers live in PS_BENCH.json (tools/ps_bench.py);
+this test keeps the concurrent path itself under CI with small payloads.
+"""
+import numpy as np
+
+from tools.ps_bench import run
+
+
+def test_four_trainers_two_servers_concurrent_traffic():
+    out = run(trainers=4, servers=2, mb=1, rounds=2)
+    assert out["trainers"] == 4 and out["pservers"] == 2
+    assert len(out["per_trainer_GBps"]) == 4
+    assert out["total_GB"] > 0
+    # every trainer actually moved bytes through the framed wire
+    assert all(v > 0 for v in out["per_trainer_GBps"].values())
+
+
+def _push_worker(rank, ep):
+    from paddle_tpu.distributed import PSClient
+
+    c = PSClient(trainer_id=rank)
+    c.ensure_init(ep, "w", np.zeros(64, np.float32))
+    for _ in range(8):
+        c.push(ep, "w", np.ones(64, np.float32), lr=0.1)
+    c.close()
+
+
+def test_push_pull_updates_apply_under_concurrency():
+    """Dense pushes from concurrent processes must all apply (async mode
+    sums whatever arrives; with lr fixed, the param must have moved from
+    its init by a deterministic-sign amount)."""
+    import multiprocessing as mp
+
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    srv = ParameterServer("127.0.0.1:0", trainer_num=2, sync_mode=False,
+                          mode=1)
+    srv.start()
+    srv.register_dense("w", [64], lr=0.1)
+    ep = f"127.0.0.1:{srv.port}"
+
+    ctx = mp.get_context("spawn")
+    ps = [ctx.Process(target=_push_worker, args=(i, ep)) for i in range(2)]
+    for p in ps:
+        p.start()
+    for p in ps:
+        p.join(timeout=120)
+    c = PSClient(trainer_id=9)
+    final = c.pull(ep, "w")
+    c.close()
+    srv.stop()
+    # 16 sgd steps of lr*1.0 against init 0 -> exactly -1.6
+    np.testing.assert_allclose(final, np.full(64, -1.6, np.float32),
+                               rtol=1e-5)
