@@ -59,7 +59,8 @@ def send_msg(sock: socket.socket, obj: dict) -> None:
         meta = _THDR.pack(len(nb), len(dt), arr.ndim, arr.nbytes) + nb + dt
         meta += struct.pack(f"<{arr.ndim}q", *arr.shape)
         parts.append(meta)
-        parts.append(memoryview(arr).cast("B"))
+        if arr.nbytes:      # zero-size tensors have no payload bytes (and
+            parts.append(memoryview(arr).cast("B"))  # cast() rejects them)
         total += len(meta) + arr.nbytes
     frame = _FRAME.pack(_MAGIC, _VERSION, len(tensors), len(hdr),
                         len(hdr) + total)
@@ -72,7 +73,9 @@ def send_msg(sock: socket.socket, obj: dict) -> None:
 
 def _sendall_vec(sock: socket.socket, parts) -> None:
     bufs = [p if isinstance(p, memoryview) else memoryview(p) for p in parts]
-    bufs = [b.cast("B") for b in bufs]
+    # drop zero-length views: sendmsg reports 0 bytes for them and the
+    # advance loop below could never retire them
+    bufs = [b.cast("B") for b in bufs if len(b)]
     while bufs:
         sent = sock.sendmsg(bufs[:64])      # stay far under IOV_MAX
         while sent:

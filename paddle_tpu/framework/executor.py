@@ -292,6 +292,29 @@ class _CompiledBlock:
             out_specs=(fetch_specs, state_specs),
             donate_argnums=donate_args)
 
+    def _hlo_text_getter(self, *call_args):
+        """Deferred optimized-HLO-text fetch for profiler attribution.
+        Abstracts the args immediately (shape/dtype only) so the getter
+        stays valid after donation invalidates the live buffers."""
+        import jax
+
+        def absify(x):
+            v = getattr(x, "value", x)
+            return jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+
+        avals = jax.tree.map(absify, call_args)
+        jitted = self._jitted
+
+        def getter():
+            # NOTE: lower().compile() is a fresh AOT compile of the same
+            # module (jax exposes no handle on the cached executable's
+            # text); it runs once per block, lazily inside stop_profiler.
+            # XLA's compilation cache usually makes it cheap; profiler.py
+            # tolerates a per-getter failure without losing the rest.
+            return jitted.lower(*avals).compile().as_text()
+
+        return getter
+
     def __call__(self, scope: Scope, feed: Dict[str, Any], rng_key):
         mutable = {}
         const = {}
@@ -308,6 +331,13 @@ class _CompiledBlock:
             else:
                 const[n] = v
         feeds = {n: feed[n] for n in self.feed_names}
+        from .. import profiler as _prof
+
+        if _prof.is_active() and not _prof.has_compiled(id(self)):
+            # capture avals BEFORE the call: mutable buffers are donated
+            _prof.register_compiled(
+                id(self), self._hlo_text_getter(mutable, const, feeds,
+                                                rng_key))
         fetches, new_state = self._jitted(mutable, const, feeds, rng_key)
         for n, v in new_state.items():
             scope.set_var(n, v)

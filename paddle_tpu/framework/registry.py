@@ -131,6 +131,20 @@ class LowerCtx:
         return self.mesh_axes.get(ring_id)
 
 
+def _op_scope_name(op) -> str:
+    """Stable trace-scope identity for one IR op: `ptop_<type>__<out>`.
+
+    run_lowering wraps every lowering in jax.named_scope with this name, so
+    the op identity rides into XLA's HLO metadata (op_name) and the device
+    profiler's measured per-instruction times can be attributed back to IR
+    ops (utils/device_trace.py — the reference's device_tracer.cc
+    correlation id serves the same purpose)."""
+    first_out = next((n for ns in op.outputs.values() for n in ns
+                      if n and n != "@EMPTY@"), "")
+    raw = f"ptop_{op.type}__{first_out}"
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in raw)
+
+
 def run_lowering(ctx: LowerCtx, op) -> None:
     """Execute one op's lowering against ctx.env (in place)."""
     spec = get_op_spec(op.type)
@@ -139,7 +153,8 @@ def run_lowering(ctx: LowerCtx, op) -> None:
         for slot, names in op.inputs.items()
         if all(n in ctx.env for n in names)
     }
-    outs = spec.lower(ctx, op, ins)
+    with jax.named_scope(_op_scope_name(op)):
+        outs = spec.lower(ctx, op, ins)
     _bind_outputs(ctx.env, op, outs)
 
 

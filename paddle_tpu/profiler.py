@@ -58,6 +58,7 @@ def start_profiler(state="All", tracer_option="Default"):
 
 
 _attached_program = None
+_compiled_hlo_getters: dict = {}
 
 
 def attach_program(program):
@@ -66,6 +67,22 @@ def attach_program(program):
     replacement for the reference's per-op device tracer)."""
     global _attached_program
     _attached_program = program
+
+
+def is_active() -> bool:
+    return _active
+
+
+def has_compiled(key) -> bool:
+    return key in _compiled_hlo_getters
+
+
+def register_compiled(key, hlo_text_getter):
+    """Executor hook: while profiling, each compiled block registers a
+    getter for its optimized HLO text so stop_profiler can map the
+    measured device events back to IR ops (utils/device_trace.py)."""
+    if _active and key not in _compiled_hlo_getters:
+        _compiled_hlo_getters[key] = hlo_text_getter
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
@@ -79,6 +96,27 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     trace_path = profile_path + ".chrome_trace.json"
     with open(trace_path, "w") as f:
         json.dump({"traceEvents": _events}, f)
+    # measured per-op device attribution (reference device_tracer.cc) —
+    # needs at least one compiled block to have run under the trace
+    if _compiled_hlo_getters and _trace_dir:
+        try:
+            from .utils import device_trace
+
+            texts = []
+            for g in _compiled_hlo_getters.values():
+                try:
+                    texts.append(g())
+                except Exception as e:   # one failed compile must not
+                    print(f"[profiler] HLO text fetch failed: {e}")
+            rows = device_trace.measured_op_rows(_trace_dir, texts)
+            if rows:
+                device_trace.merge_into_trace(rows, trace_path)
+                print("[profiler] top ops by MEASURED device time:")
+                device_trace.print_rows(rows, top=5)
+        except Exception as e:
+            print(f"[profiler] measured attribution skipped: "
+                  f"{type(e).__name__}: {e}")
+        _compiled_hlo_getters.clear()
     if _attached_program is not None:
         try:
             from .utils import op_costs

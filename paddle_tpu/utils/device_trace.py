@@ -1,0 +1,148 @@
+"""Measured per-op device attribution from jax.profiler xplane captures.
+
+The reference measures per-kernel device time with CUPTI and correlates it
+to ops by correlation id (platform/device_tracer.cc:1).  The TPU-native
+pipeline here:
+
+1. every IR-op lowering runs under ``jax.named_scope("ptop_<type>__<out>")``
+   (framework/registry.py run_lowering), so XLA stamps the op identity into
+   each HLO instruction's ``metadata.op_name``;
+2. ``jax.profiler.trace`` captures the device execution timeline (XPlane);
+   each executed HLO instruction/fusion appears as an event with an
+   ``hlo_op`` stat and a measured ``duration_ns``;
+3. the optimized HLO text of the executed program maps ``hlo_op`` back to
+   ``op_name`` and hence to the IR op — fused computations attribute to the
+   scope of their root instruction.
+
+The result is MEASURED nanoseconds per IR op for the fused step, not a
+cost-model estimate (utils/op_costs.py remains the static/modeled track).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_METADATA_RX = re.compile(
+    r"%?([\w.\-]+)\s*=\s[^\n]*?metadata=\{[^}]*?op_name=\"([^\"]+)\"")
+_SCOPE_RX = re.compile(r"(ptop_[A-Za-z0-9_]+)")
+
+
+_MODULE_RX = re.compile(r"HloModule\s+([\w.\-]+)")
+
+
+def hlo_op_name_map(hlo_text: str) -> Dict[str, str]:
+    """instruction name -> metadata op_name, from optimized HLO text."""
+    return dict(_METADATA_RX.findall(hlo_text))
+
+
+def hlo_module_name(hlo_text: str) -> str:
+    m = _MODULE_RX.search(hlo_text)
+    return m.group(1) if m else ""
+
+
+def _latest_xplane(trace_dir: str) -> Optional[str]:
+    files = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    return max(files, key=os.path.getmtime) if files else None
+
+
+def device_events(trace_dir: str) -> Iterable[Tuple[str, str, float]]:
+    """Yield (hlo_module, hlo_op, duration_ns) for every device-executed
+    HLO event in the newest capture under trace_dir."""
+    from jax.profiler import ProfileData
+
+    path = _latest_xplane(trace_dir)
+    if path is None:
+        return
+    pd = ProfileData.from_file(path)
+    for plane in pd.planes:
+        device_plane = plane.name.startswith("/device:")
+        for line in plane.lines:
+            # execution lines only: TPU device planes, or the PJRT CPU
+            # client's runtime line — host python/trace-me lines may carry
+            # hlo_op stats too and would double-count
+            exec_line = device_plane or "XLAPjRtCpuClient" in str(line.name)
+            if not exec_line:
+                continue
+            for ev in line.events:
+                try:
+                    stats = dict(ev.stats)
+                except Exception:
+                    stats = {}
+                hlo_op = stats.get("hlo_op")
+                if hlo_op is None:
+                    if not device_plane:
+                        continue
+                    # TPU device planes name events by the HLO op directly
+                    hlo_op = ev.name
+                dur = float(getattr(ev, "duration_ns", 0.0) or 0.0)
+                if dur <= 0:
+                    continue
+                yield str(stats.get("hlo_module", plane.name)), str(hlo_op), dur
+
+
+def measured_op_rows(trace_dir: str, hlo_texts: List[str]) -> List[dict]:
+    """Aggregate measured device ns per IR op (ptop_* scope).
+
+    Events whose HLO instruction carries no ptop scope (infeed, copies,
+    compiler-inserted glue) aggregate under their hlo op name so the table
+    always sums to the measured total."""
+    # per-module maps: generic instruction names (fusion.1, copy.3) repeat
+    # across compiled blocks, so a flat map would misattribute block A's
+    # events to block B's ops
+    by_module: Dict[str, Dict[str, str]] = {}
+    merged: Dict[str, str] = {}
+    for txt in hlo_texts:
+        m = hlo_op_name_map(txt)
+        by_module.setdefault(hlo_module_name(txt), {}).update(m)
+        merged.update(m)
+    agg: Dict[str, List[float]] = {}
+    for module, hlo_op, dur in device_events(trace_dir):
+        mod_map = by_module.get(module)
+        if mod_map and hlo_op in mod_map:
+            op_name = mod_map[hlo_op]
+        else:
+            op_name = merged.get(hlo_op, "")
+        m = _SCOPE_RX.search(op_name)
+        key = m.group(1) if m else f"[xla] {hlo_op.split('.')[0]}"
+        a = agg.setdefault(key, [0.0, 0])
+        a[0] += dur
+        a[1] += 1
+    rows = [{"op": k, "device_ns": int(v[0]), "events": v[1]}
+            for k, v in agg.items()]
+    rows.sort(key=lambda r: -r["device_ns"])
+    return rows
+
+
+def merge_into_trace(rows: List[dict], trace_path: str) -> None:
+    """Append the measured rows as a synthetic 'measured device' track to
+    the chrome trace (next to the host events and the modeled op_costs
+    track)."""
+    import json
+
+    try:
+        with open(trace_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {"traceEvents": []}
+    ts = 0.0
+    for r in rows:
+        doc["traceEvents"].append({
+            "name": r["op"], "ph": "X", "ts": ts,
+            "dur": r["device_ns"] / 1000.0,
+            "pid": 1, "tid": 999,
+            "args": {"events": r["events"], "track": "measured-device"},
+        })
+        ts += r["device_ns"] / 1000.0
+    with open(trace_path, "w") as f:
+        json.dump(doc, f)
+
+
+def print_rows(rows: List[dict], top: int = 5) -> None:
+    total = sum(r["device_ns"] for r in rows) or 1
+    print(f"{'Op (measured device time)':<48}{'ns':>12}{'%':>7}{'events':>8}")
+    for r in rows[:top]:
+        print(f"{r['op']:<48}{r['device_ns']:>12}"
+              f"{100.0 * r['device_ns'] / total:>6.1f}%{r['events']:>8}")

@@ -73,14 +73,32 @@ def run(trainers=4, servers=2, mb=1, rounds=16):
                          args=(i, endpoints, mb, rounds, q))
              for i in range(trainers)]
     t0 = time.perf_counter()
-    for p in procs:
-        p.start()
-    results = [q.get(timeout=300) for _ in procs]
-    for p in procs:
-        p.join(timeout=60)
-    wall = time.perf_counter() - t0
-    for s in srvs:
-        s.stop()
+    try:
+        for p in procs:
+            p.start()
+        results = []
+        deadline = time.time() + 300
+        while len(results) < len(procs):
+            try:
+                results.append(q.get(timeout=2))
+            except Exception:
+                dead = [p.exitcode for p in procs
+                        if p.exitcode not in (None, 0)]
+                if dead:
+                    raise RuntimeError(
+                        f"trainer process(es) died: exit codes {dead}")
+                if time.time() > deadline:
+                    raise TimeoutError("PS bench trainers timed out")
+        wall = time.perf_counter() - t0
+        for p in procs:
+            p.join(timeout=60)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=30)
+        for s in srvs:
+            s.stop()
     total_bytes = sum(m for _, m, _ in results)
     # steady-state aggregate: total bytes over the slowest trainer's
     # measured window (workers overlap; spawn + jax import excluded —
