@@ -429,6 +429,6 @@ def iter_batches_threaded(dataset: DatasetBase, threads: int,
 # deterministic local fixtures, no network — see each submodule.
 # ---------------------------------------------------------------------------
 from . import (  # noqa: F401,E402
-    cifar, common, conll05, flowers, imdb, imikolov, mnist, movielens,
-    sentiment, uci_housing, voc2012, wmt14, wmt16,
+    cifar, common, conll05, flowers, image, imdb, imikolov, mnist,
+    movielens, mq2007, sentiment, uci_housing, voc2012, wmt14, wmt16,
 )

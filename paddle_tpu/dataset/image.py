@@ -1,0 +1,107 @@
+"""paddle.dataset.image — parity with python/paddle/dataset/image.py
+(resize_short:197, to_chw:225, center_crop:249, random_crop:277,
+left_right_flip:305, simple_transform:327).
+
+Pure-numpy implementations (the reference shells out to cv2; the image
+math here is the same — bilinear resize, crops, flips, CHW transpose)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["resize_short", "to_chw", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform", "load_and_transform"]
+
+
+def _bilinear_resize(im, h, w):
+    ih, iw = im.shape[:2]
+    ys = np.clip((np.arange(h) + 0.5) * ih / h - 0.5, 0, ih - 1)
+    xs = np.clip((np.arange(w) + 0.5) * iw / w - 0.5, 0, iw - 1)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, ih - 1)
+    x1 = np.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    if im.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    r0 = im[y0]
+    r1 = im[y1]
+    top = r0[:, x0] * (1 - wx) + r0[:, x1] * wx
+    bot = r1[:, x0] * (1 - wx) + r1[:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if np.issubdtype(im.dtype, np.integer):
+        out = np.rint(out)      # cv2 INTER_LINEAR rounds; truncation would
+    return out.astype(im.dtype)  # bias integer images dark by up to 1 LSB
+
+
+def resize_short(im, size):
+    """image.py:197 — scale so the SHORT side equals size."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, int(round(w * size / h))
+    else:
+        nh, nw = int(round(h * size / w)), size
+    return _bilinear_resize(im, nh, nw)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """image.py:225 — HWC -> CHW."""
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """image.py:249."""
+    h, w = im.shape[:2]
+    hs = max((h - size) // 2, 0)
+    ws = max((w - size) // 2, 0)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    """image.py:277."""
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    hs = rng.randint(0, max(h - size, 0) + 1)
+    ws = rng.randint(0, max(w - size, 0) + 1)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def left_right_flip(im, is_color=True):
+    """image.py:305."""
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """image.py:327 — resize_short, crop (random+flip when training,
+    center otherwise), CHW, float32, optional mean subtraction."""
+    im = resize_short(im, resize_size)
+    rng = rng or np.random
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.randint(0, 2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]      # per-channel over CHW
+        im = im - mean                      # scalar/full-shape broadcast
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """image.py:383 — .npy fixtures replace cv2.imread (no cv2 in env)."""
+    im = np.load(filename) if str(filename).endswith(".npy") else None
+    if im is None:
+        raise ValueError(
+            "load_and_transform supports .npy image fixtures in this "
+            "environment (no cv2); got " + str(filename))
+    return simple_transform(im, resize_size, crop_size, is_train,
+                            is_color=is_color, mean=mean)

@@ -1,0 +1,56 @@
+"""paddle.dataset.mq2007 — parity with python/paddle/dataset/mq2007.py
+(LETOR learning-to-rank: 46-dim feature vectors grouped per query;
+train/test readers in pointwise/pairwise/listwise formats).
+Deterministic fixture per common.py."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import fixture_rng
+
+__all__ = ["train", "test"]
+
+_FEATURES = 46
+_QUERIES = {"train": 64, "test": 16}
+_DOCS_PER_QUERY = (8, 20)
+
+
+def _queries(split):
+    rs = fixture_rng("mq2007", split)
+    out = []
+    for qid in range(_QUERIES[split]):
+        n = int(rs.randint(*_DOCS_PER_QUERY))
+        feats = rs.rand(n, _FEATURES).astype(np.float32)
+        rel = rs.randint(0, 3, n)            # LETOR relevance in {0,1,2}
+        out.append((qid, rel, feats))
+    return out
+
+
+def _creator(split, format):
+    if format not in ("pointwise", "pairwise", "listwise"):
+        raise ValueError(
+            f"mq2007 format must be pointwise/pairwise/listwise, "
+            f"got {format!r}")
+
+    def reader():
+        for qid, rel, feats in _queries(split):
+            if format == "pointwise":
+                for r, f in zip(rel, feats):
+                    yield float(r), f
+            elif format == "pairwise":
+                for i in range(len(rel)):
+                    for j in range(len(rel)):
+                        if rel[i] > rel[j]:
+                            yield 1.0, feats[i], feats[j]
+            else:                            # listwise
+                yield qid, [float(r) for r in rel], feats
+
+    return reader
+
+
+def train(format="pairwise"):
+    return _creator("train", format)
+
+
+def test(format="pairwise"):
+    return _creator("test", format)

@@ -333,11 +333,15 @@ class _CompiledBlock:
         feeds = {n: feed[n] for n in self.feed_names}
         from .. import profiler as _prof
 
-        if _prof.is_active() and not _prof.has_compiled(id(self)):
-            # capture avals BEFORE the call: mutable buffers are donated
-            _prof.register_compiled(
-                id(self), self._hlo_text_getter(mutable, const, feeds,
-                                                rng_key))
+        if _prof.is_active():
+            # owned token, not id(self): a GC'd block's reused address
+            # would silently suppress registration of a new block
+            key = self.__dict__.setdefault("_profile_key", object())
+            if not _prof.has_compiled(key):
+                # capture avals BEFORE the call: mutable buffers are donated
+                _prof.register_compiled(
+                    key, self._hlo_text_getter(mutable, const, feeds,
+                                               rng_key))
         fetches, new_state = self._jitted(mutable, const, feeds, rng_key)
         for n, v in new_state.items():
             scope.set_var(n, v)

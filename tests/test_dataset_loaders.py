@@ -96,3 +96,27 @@ def test_book_style_mnist_consumer():
         losses.append(float(out[0]))
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, \
         (losses[:5], losses[-5:])
+
+
+def test_mq2007_formats():
+    score, f = next(dataset.mq2007.train(format="pointwise")())
+    assert f.shape == (46,) and score in (0.0, 1.0, 2.0)
+    lbl, a, b = next(dataset.mq2007.train(format="pairwise")())
+    assert lbl == 1.0 and a.shape == b.shape == (46,)
+    qid, rels, feats = next(dataset.mq2007.test(format="listwise")())
+    assert feats.shape == (len(rels), 46)
+
+
+def test_image_transforms():
+    from paddle_tpu.dataset import image as I
+    rs = np.random.RandomState(0)
+    im = rs.rand(40, 60, 3).astype(np.float32)
+    r = I.resize_short(im, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[1] == 48
+    c = I.center_crop(r, 32)
+    assert c.shape[:2] == (32, 32)
+    t = I.simple_transform(im, 36, 32, is_train=True,
+                           mean=[0.5, 0.5, 0.5], rng=rs)
+    assert t.shape == (3, 32, 32) and t.dtype == np.float32
+    f = I.left_right_flip(im)
+    np.testing.assert_array_equal(f[:, 0], im[:, -1])
