@@ -26,8 +26,12 @@ DATA_HOME = os.path.join(
 
 
 def fixture_rng(name: str, split: str) -> np.random.RandomState:
-    """The deterministic generator every fixture dataset derives from."""
-    seed = (hash((name, split)) & 0x7FFFFFFF) or 1
+    """The deterministic generator every fixture dataset derives from.
+    crc32, not hash(): python salts str hashes per process, which would
+    make every run train on different fixture data."""
+    import zlib
+
+    seed = (zlib.crc32(f"{name}:{split}".encode()) & 0x7FFFFFFF) or 1
     return np.random.RandomState(seed)
 
 

@@ -30,11 +30,16 @@ def _creator(split, n):
             for _ in range(n):
                 label = int(rs.randint(0, 2))
                 ln = int(rs.randint(8, 64))
-                # class-dependent token distribution so classifiers learn
+                # real reviews carry high-frequency sentiment words; model
+                # that: ~1/3 of tokens come from a small class-specific
+                # pool, the rest from the class's half of the vocabulary
                 lo, hi = (0, vocab // 2) if label == 0 else (vocab // 2,
                                                              vocab)
-                doc = [min(int(t), unk)
-                       for t in rs.randint(lo, hi, ln)]
+                base = rs.randint(lo, hi, ln)
+                marker = rs.randint(lo, lo + 16, ln)
+                use_marker = rs.rand(ln) < 0.34
+                doc = [min(int(m if um else t), unk)
+                       for t, m, um in zip(base, marker, use_marker)]
                 yield doc, label                    # imdb.py:92
 
         return reader

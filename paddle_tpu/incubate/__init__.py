@@ -1,2 +1,2 @@
-
+from . import complex  # noqa: F401
 from . import data_generator  # noqa: F401

@@ -1,0 +1,18 @@
+"""paddle.incubate.complex — parity with
+python/paddle/incubate/complex/__init__.py.
+
+TPU-native design departure: the reference builds ComplexVariable as a
+(real, imag) PAIR of fluid Variables because its tensors have no complex
+dtype (framework.py ComplexVariable). XLA/jax support complex64/128
+natively, so here a ComplexVariable wraps ONE complex array — every op is
+a single fused XLA computation instead of four real-arithmetic kernels.
+"""
+from . import tensor  # noqa: F401
+from .helper import is_complex, is_real  # noqa: F401
+from .tensor import (  # noqa: F401
+    elementwise_add, elementwise_div, elementwise_mul, elementwise_sub,
+    kron, matmul, reshape, sum, trace, transpose,
+)
+from .tensor_base import ComplexVariable  # noqa: F401
+
+__all__ = list(tensor.__all__)

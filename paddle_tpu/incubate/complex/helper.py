@@ -1,0 +1,29 @@
+"""paddle.incubate.complex.helper — parity with
+python/paddle/incubate/complex/helper.py."""
+from .tensor_base import ComplexVariable
+
+__all__ = ["is_complex", "is_real", "complex_variable_exists"]
+
+
+def is_complex(x) -> bool:
+    if isinstance(x, ComplexVariable):
+        return True
+    import jax.numpy as jnp
+
+    v = getattr(x, "value", x)
+    return hasattr(v, "dtype") and jnp.issubdtype(v.dtype,
+                                                  jnp.complexfloating)
+
+
+def is_real(x) -> bool:
+    return not is_complex(x) and hasattr(getattr(x, "value", x), "dtype")
+
+
+def complex_variable_exists(inputs, layer_name):
+    if any(is_complex(i) for i in inputs):
+        return
+    err = ("At least one inputs of layer complex." if len(inputs) > 1
+           else "The input of layer complex.")
+    raise ValueError(err + layer_name +
+                     "() must be ComplexVariable, please use the layer "
+                     "for real number instead.")

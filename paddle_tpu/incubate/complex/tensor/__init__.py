@@ -1,0 +1,10 @@
+from .linalg import matmul  # noqa: F401
+from .manipulation import reshape, transpose  # noqa: F401
+from .math import (  # noqa: F401
+    elementwise_add, elementwise_div, elementwise_mul, elementwise_sub,
+    kron, sum, trace,
+)
+
+__all__ = ["elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "trace", "sum", "kron", "matmul", "reshape",
+           "transpose"]

@@ -1,0 +1,25 @@
+"""paddle.incubate.complex.tensor.linalg — parity with
+python/paddle/incubate/complex/tensor/linalg.py (matmul:22)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..helper import complex_variable_exists
+from ..tensor_base import ComplexVariable, _raw
+
+__all__ = ["matmul"]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    complex_variable_exists([x, y], "matmul")
+    a = jnp.asarray(_raw(x))
+    b = jnp.asarray(_raw(y))
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.matmul(a, b)
+    if alpha != 1.0:
+        out = out * alpha
+    return ComplexVariable(out)

@@ -1,0 +1,21 @@
+"""paddle.incubate.complex.tensor.manipulation — parity with
+python/paddle/incubate/complex/tensor/manipulation.py (reshape:26,
+transpose:112)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..helper import complex_variable_exists
+from ..tensor_base import ComplexVariable, _raw
+
+__all__ = ["reshape", "transpose"]
+
+
+def reshape(x, shape, inplace=False, name=None):
+    complex_variable_exists([x], "reshape")
+    return ComplexVariable(jnp.reshape(jnp.asarray(_raw(x)), shape))
+
+
+def transpose(x, perm, name=None):
+    complex_variable_exists([x], "transpose")
+    return ComplexVariable(jnp.transpose(jnp.asarray(_raw(x)), perm))

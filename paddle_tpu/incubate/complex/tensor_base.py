@@ -1,0 +1,54 @@
+"""ComplexVariable — parity with the reference's framework.ComplexVariable
+(python/paddle/fluid/framework.py), holding ONE native complex array
+instead of a (real, imag) pair."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ComplexVariable:
+    """An eager complex tensor. Construct from a complex ndarray, or from
+    real + imag parts (the reference's layout)."""
+
+    def __init__(self, value, imag=None, name=None):
+        value = _raw(value)
+        if imag is not None:
+            value = np.asarray(value) + 1j * np.asarray(_raw(imag))
+        import jax.numpy as jnp
+
+        v = jnp.asarray(value)
+        if not jnp.issubdtype(v.dtype, jnp.complexfloating):
+            v = v.astype(jnp.complex64)
+        self.value = v
+        self.name = name
+
+    @property
+    def real(self):
+        return self.value.real
+
+    @property
+    def imag(self):
+        return self.value.imag
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def conj(self):
+        return ComplexVariable(self.value.conj())
+
+    def __repr__(self):
+        return f"ComplexVariable(shape={self.shape})\n{self.value}"
+
+
+def _raw(v):
+    if isinstance(v, ComplexVariable):
+        return v.value
+    return getattr(v, "value", v)

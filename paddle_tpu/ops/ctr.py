@@ -301,7 +301,7 @@ def sequence_enumerate(ctx, op, ins):
     pad = op.attr("pad_value", 0)
     B, T = x.shape[0], x.shape[1]
     if ins.get("Length"):
-        ln = ins["Length"][0].astype(jnp.int32)
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
     else:
         ln = jnp.full((B,), T, jnp.int32)
     padded = jnp.pad(x, ((0, 0), (0, win)), constant_values=pad)
@@ -324,7 +324,7 @@ def sequence_erase(ctx, op, ins):
     tokens = op.attr("tokens", []) or []
     B, T = x.shape[0], x.shape[1]
     if ins.get("Length"):
-        ln = ins["Length"][0].astype(jnp.int32)
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
     else:
         ln = jnp.full((B,), T, jnp.int32)
     in_seq = jnp.arange(T)[None, :] < ln[:, None]

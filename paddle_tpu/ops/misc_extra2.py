@@ -89,7 +89,7 @@ def sequence_reshape(ctx, op, ins):
     out = x.reshape(B, T * D // new_dim, new_dim)
     outs = {"Out": out}
     if ins.get("Length"):
-        ln = ins["Length"][0]
+        ln = ins["Length"][0].reshape(-1)
         outs["Length"] = (ln * D) // new_dim
     return outs
 

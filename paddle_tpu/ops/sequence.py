@@ -41,7 +41,7 @@ def sequence_pool(ctx, op, ins):
     x = ins["X"][0]
     ptype = op.attr("pooltype", "SUM").upper()
     if "Length" in ins and ins["Length"]:
-        ln = ins["Length"][0].astype(jnp.int32)
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
         mask = (jnp.arange(x.shape[1])[None, :] < ln[:, None]).astype(x.dtype)
         xm = x * mask[..., None]
         denom = jnp.maximum(ln.astype(x.dtype), 1)[:, None]
@@ -77,7 +77,7 @@ def sequence_pool(ctx, op, ins):
 def sequence_softmax(ctx, op, ins):
     x = ins["X"][0]  # (B, T)
     if "Length" in ins and ins["Length"]:
-        ln = ins["Length"][0].astype(jnp.int32)
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
         mask = jnp.arange(x.shape[1])[None, :] < ln[:, None]
         masked = jnp.where(mask, x, -jnp.inf)
         return {"Out": jax.nn.softmax(masked, axis=1)}
@@ -97,7 +97,7 @@ def sequence_expand(ctx, op, ins):
 def sequence_reverse(ctx, op, ins):
     x = ins["X"][0]
     if "Length" in ins and ins["Length"]:
-        ln = ins["Length"][0].astype(jnp.int32)
+        ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
         t = x.shape[1]
         idx = jnp.arange(t)[None, :]
         rev_idx = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)

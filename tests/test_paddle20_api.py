@@ -193,3 +193,24 @@ def test_dygraph_optimizer_accumulator_finish_update():
             loss.backward()
             o.minimize(loss)
             assert float(np.asarray(q.value)[0]) < 1.0
+
+
+def test_incubate_complex_namespace():
+    import numpy as _np
+
+    from paddle_tpu.incubate import complex as cpx
+    a = cpx.ComplexVariable(_np.asarray([[1.0, 2.0]]),
+                            imag=_np.asarray([[3.0, -1.0]]))
+    b = cpx.ComplexVariable(_np.asarray([[2.0], [0.5]]) + 0j)
+    assert cpx.is_complex(a) and not cpx.is_real(a)
+    m = cpx.matmul(a, b)
+    want = (_np.asarray([[1 + 3j, 2 - 1j]]) @ _np.asarray([[2.0], [0.5]]))
+    _np.testing.assert_allclose(m.numpy(), want, rtol=1e-6)
+    s = cpx.sum(cpx.elementwise_mul(a, a))
+    _np.testing.assert_allclose(
+        s.numpy(), ((1 + 3j) ** 2 + (2 - 1j) ** 2), rtol=1e-6)
+    t = cpx.transpose(cpx.reshape(a, [2, 1]), [1, 0])
+    assert t.shape == (1, 2)
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        cpx.trace(_np.ones((2, 2)))
