@@ -234,3 +234,87 @@ from .framework import (  # noqa: F401,E402
     create_global_var, create_parameter, name_scope,
 )
 from .dygraph.base import in_dygraph_mode as in_imperative_mode  # noqa: F401,E402
+
+# remaining fluid top-level utilities (reference fluid/__init__.py __all__)
+from . import debugger  # noqa: E402,F401
+from .dygraph.base import in_dygraph_mode  # noqa: E402,F401
+
+
+def require_version(min_version, max_version=None):
+    """reference framework.py:73 — version gate; this framework versions
+    independently of the reference, so only malformed specs error."""
+    import re as _re
+    rx = _re.compile(r"^\d+(\.\d+){0,3}([.\-]?[a-zA-Z]+\d*)?$")
+    for v in (min_version,) + ((max_version,) if max_version is not None
+                               else ()):
+        if not isinstance(v, str) or not rx.match(v):
+            raise TypeError(f"invalid version spec {v!r}")
+    return True
+
+
+def cpu_places(device_count=None):
+    """reference framework.py:352 — None (and only None) falls back to
+    CPU_NUM."""
+    import os as _os
+    n = int(_os.environ.get("CPU_NUM", 1)) if device_count is None \
+        else int(device_count)
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """reference framework.py:310 — accelerator places (TPU chips here)."""
+    import jax as _jax
+    if device_ids is None:
+        device_ids = range(len(_jax.devices()))
+    return [XLAPlace(int(i)) for i in device_ids]
+
+
+def cuda_pinned_places(device_count=None):
+    """Pinned-host staging is XLA-owned; places use the exported
+    CUDAPinnedPlace alias so isinstance dispatch stays consistent."""
+    import os as _os
+    n = int(_os.environ.get("CPU_NUM", 1)) if device_count is None \
+        else int(device_count)
+    return [CUDAPinnedPlace(0) for _ in range(n)]
+
+
+def is_compiled_with_cuda():
+    """False by definition: this build's accelerator path is TPU/XLA
+    (`is_compiled_with_tpu()` is the affirmative probe)."""
+    return False
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Deprecated no-op in the reference since 1.6
+    (memory_optimization_transpiler.py:18); XLA buffer assignment +
+    donation own memory planning here."""
+    import warnings as _w
+    _w.warn("memory_optimize is deprecated and a no-op (XLA owns buffer "
+            "planning)", DeprecationWarning, stacklevel=2)
+
+
+def release_memory(input_program, skip_opt_set=None):
+    import warnings as _w
+    _w.warn("release_memory is deprecated and a no-op", DeprecationWarning,
+            stacklevel=2)
+
+
+def load_op_library(lib_filename):
+    """reference fluid custom-op loader; native extensions load via ctypes
+    in this build (native/__init__.py)."""
+    raise NotImplementedError(
+        "load_op_library loads CUDA .so op libraries; TPU custom kernels "
+        "are Pallas/jax functions registered with register_op (see "
+        "paddle_tpu/framework/registry.py)")
+
+
+import contextlib as _contextlib  # noqa: E402
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """reference framework.py:5420 — per-op device placement hint. XLA
+    schedules ops itself; host-pinned ops are the host-op segmentation in
+    the executor, so the guard is accepted and ignored."""
+    yield

@@ -1,0 +1,64 @@
+"""Program debugging utilities — parity with
+python/paddle/fluid/debugger.py (pprint_program_codes, draw_block_graphviz)
+and net_drawer.py.
+
+Emits DOT text directly (no graphviz binary needed to produce the .dot;
+render with any graphviz viewer)."""
+from __future__ import annotations
+
+from .framework.program import Program
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def pprint_block_codes(block, show_backward=False):
+    lines = [f"block {block.idx} (parent {block.parent_idx}):"]
+    for v in block.vars.values():
+        tag = "param" if getattr(v, "persistable", False) else "var"
+        lines.append(f"  {tag} {v.name}: shape={getattr(v, 'shape', None)} "
+                     f"dtype={getattr(v, 'dtype', None)}")
+    for op in block.ops:
+        if not show_backward and op.type.endswith("_grad"):
+            continue
+        ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items() if v)
+        outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items() if v)
+        lines.append(f"  {op.type}({ins}) -> {outs}")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program: Program, show_backward=False) -> str:
+    text = "\n".join(pprint_block_codes(b, show_backward)
+                     for b in program.blocks)
+    print(text)
+    return text
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot") -> str:
+    """Write the block's op/var dataflow as a DOT digraph (reference
+    debugger.py draw_block_graphviz)."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+    for i, v in enumerate(block.vars.values()):
+        var_ids[v.name] = f"var_{i}"
+        color = ', style=filled, fillcolor="yellow"' \
+            if v.name in highlights else ""
+        shape = "box" if getattr(v, "persistable", False) else "ellipse"
+        lines.append(f'  var_{i} [label="{v.name}", shape={shape}{color}];')
+    for j, op in enumerate(block.ops):
+        lines.append(f'  op_{j} [label="{op.type}", shape=record, '
+                     f'style=filled, fillcolor="lightgrey"];')
+        for names in op.inputs.values():
+            for n in names:
+                if n in var_ids:
+                    lines.append(f"  {var_ids[n]} -> op_{j};")
+        for names in op.outputs.values():
+            for n in names:
+                if n in var_ids:
+                    lines.append(f"  op_{j} -> {var_ids[n]};")
+    lines.append("}")
+    text = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(text)
+    return text

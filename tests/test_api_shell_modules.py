@@ -86,3 +86,41 @@ def test_data_feed_desc(tmp_path):
     assert out.count("is_dense: true") == 1
     with pytest.raises(ValueError):
         d.set_use_slots(["nope"])
+
+
+def test_top_level_utility_shims():
+    import warnings
+
+    import pytest
+
+    import paddle_tpu as fluid
+    assert fluid.require_version("1.8", "2.0") is True
+    with pytest.raises(TypeError):
+        fluid.require_version("not-a-version")
+    assert len(fluid.cpu_places(3)) == 3
+    assert fluid.is_compiled_with_cuda() is False
+    assert not fluid.in_dygraph_mode()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        fluid.memory_optimize(None)
+        fluid.release_memory(None)
+    assert len(rec) == 2
+    with fluid.device_guard("cpu"):
+        pass
+    with pytest.raises(NotImplementedError):
+        fluid.load_op_library("libfoo.so")
+
+
+def test_debugger_dot_and_pprint(tmp_path, capsys):
+    import paddle_tpu as fluid
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data("x", [4])
+        fluid.layers.fc(x, 2)
+    dot = fluid.debugger.draw_block_graphviz(
+        main.global_block(), highlights=["x"],
+        path=str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph") and "fillcolor=\"yellow\"" in dot
+    assert (tmp_path / "g.dot").exists()
+    txt = fluid.debugger.pprint_program_codes(main)
+    assert "mul" in txt and "block 0" in txt
