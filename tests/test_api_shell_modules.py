@@ -91,9 +91,6 @@ def test_data_feed_desc(tmp_path):
 def test_top_level_utility_shims():
     import warnings
 
-    import pytest
-
-    import paddle_tpu as fluid
     assert fluid.require_version("1.8", "2.0") is True
     with pytest.raises(TypeError):
         fluid.require_version("not-a-version")
@@ -112,7 +109,6 @@ def test_top_level_utility_shims():
 
 
 def test_debugger_dot_and_pprint(tmp_path, capsys):
-    import paddle_tpu as fluid
     main = fluid.Program()
     with fluid.program_guard(main, fluid.Program()):
         x = fluid.layers.data("x", [4])
@@ -124,3 +120,4 @@ def test_debugger_dot_and_pprint(tmp_path, capsys):
     assert (tmp_path / "g.dot").exists()
     txt = fluid.debugger.pprint_program_codes(main)
     assert "mul" in txt and "block 0" in txt
+    assert txt in capsys.readouterr().out

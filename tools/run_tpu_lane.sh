@@ -1,0 +1,37 @@
+#!/bin/bash
+# One-command hardware lane for the moment the TPU tunnel returns
+# (tools/tpu_probe_loop.sh drops /root/repo/.tpu_up).
+#
+# Runs, in ONE session so the relay claim is held once:
+#   1. the MFU sweep (no-remat + chunked-CE configs, the bq/bk flash tile
+#      probe, and the flash=0 XLA-attention A/B that converts
+#      KERNEL_NOTES' cost-model verdict into a measured one),
+#   2. the real-chip test lane (refreshes TPU_LANE.json),
+#   3. bench.py for the round's headline BENCH line.
+#
+# Relay rules (.claude/skills/verify/SKILL.md): never SIGKILL a step; let
+# each finish naturally. Run detached: `setsid nohup bash
+# tools/run_tpu_lane.sh > tpu_lane_run.log 2>&1 &`
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== [1/3] MFU sweep $(date -u +%H:%M:%S) ==="
+# --multi treats every following arg as a spec; results are the JSON
+# lines on stdout -> MFU_SWEEP.json (one object per config)
+python tools/mfu_sweep.py --multi \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=none,celim=1073741824,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=24,remat=none,celim=1073741824,steps=8" \
+  "d=4096,L=3,nh=32,ff=16384,b=8,remat=none,celim=1073741824,steps=6" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=none,celim=1073741824,bq=1024,bk=1024,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=none,celim=1073741824,flash=0,steps=8" \
+  | tee MFU_SWEEP.json
+echo "=== sweep rc=$? ==="
+
+echo "=== [2/3] TPU test lane $(date -u +%H:%M:%S) ==="
+PADDLE_TPU_NATIVE=1 python -m pytest tests/tpu -q
+echo "=== lane rc=$? ==="
+
+echo "=== [3/3] bench $(date -u +%H:%M:%S) ==="
+python bench.py
+echo "=== bench rc=$? ==="
+date -u > .tpu_lane_done
