@@ -10,3 +10,7 @@ from . import ps_ops  # noqa: F401  (registers host ops)
 from .ps_client import PSClient  # noqa: F401
 from .ps_server import ParameterServer  # noqa: F401
 from .table import DenseTable, SparseTable  # noqa: F401
+from . import cloud_utils, fs_wrapper  # noqa: F401
+# launch_ps is NOT pre-imported: `python -m paddle_tpu.distributed.launch_ps`
+# would hit runpy's already-in-sys.modules warning
+from .fs_wrapper import FS, LocalFS  # noqa: F401

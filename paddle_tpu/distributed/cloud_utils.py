@@ -1,0 +1,35 @@
+"""paddle.distributed.cloud_utils — parity with
+python/paddle/distributed/cloud_utils.py (get_cloud_cluster:20,
+get_trainers_num:79): derive the trainer cluster from the PaddleCloud
+environment contract."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_cloud_cluster", "get_trainers_num"]
+
+
+def get_trainers_num() -> int:
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
+                      args_port=None, selected_devices=None):
+    """Cluster endpoints from the cloud env (PADDLE_TRAINERS /
+    POD_IP / PADDLE_PORT), falling back to the explicit args."""
+    node_ips = (os.getenv("PADDLE_TRAINERS") or args_node_ips
+                or "127.0.0.1")
+    if isinstance(node_ips, str):
+        node_ips = node_ips.replace(" ", ",").split(",")
+    node_ip = os.getenv("POD_IP", args_node_ip or node_ips[0])
+    port = int(os.getenv("PADDLE_PORT", args_port or 6170))
+    n_dev = len(selected_devices) if selected_devices else 1
+    endpoints = [f"{ip}:{port + d}" for ip in node_ips
+                 for d in range(n_dev)]
+    return {
+        "trainer_endpoints": endpoints,
+        "current_endpoint": f"{node_ip}:{port}",
+        "nranks": len(endpoints),
+        "rank": endpoints.index(f"{node_ip}:{port}")
+        if f"{node_ip}:{port}" in endpoints else 0,
+    }

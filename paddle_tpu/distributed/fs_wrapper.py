@@ -1,0 +1,7 @@
+"""paddle.distributed.fs_wrapper — parity with
+python/paddle/distributed/fs_wrapper.py (FS/LocalFS/BDFS): thin aliases
+over the fleet FS implementations (incubate/fleet/utils/fs.py)."""
+from ..incubate.fleet.utils.fs import FS, LocalFS  # noqa: F401
+from ..incubate.fleet.utils.fs import HDFSClient as BDFS  # noqa: F401
+
+__all__ = ["FS", "LocalFS", "BDFS"]

@@ -1,0 +1,103 @@
+"""PS-cluster launcher — parity with
+python/paddle/distributed/launch_ps.py (parse_args:24, start_procs:81,
+launch:157): spawn N pserver + M trainer processes of a user training
+script, wiring the PADDLE_* environment contract that
+fleet.PaddleCloudRoleMaker reads (incubate/fleet/base/role_maker.py).
+
+Usage (reference CLI shape):
+    python -m paddle_tpu.distributed.launch_ps \
+        --worker_num 2 --server_num 2 train.py [script args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("launch_ps")
+    p.add_argument("--worker_num", type=int, default=2)
+    p.add_argument("--server_num", type=int, default=2)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def start_procs(worker_num: int, server_num: int, training_script: str,
+                script_args: Optional[List[str]] = None, log_dir=None,
+                env=None) -> Tuple[list, list]:
+    """Spawn pservers then trainers; returns (server_procs,
+    trainer_procs). Pair with wait_procs (reference start_procs spawns
+    and waits in one call)."""
+    script_args = script_args or []
+    ports = _free_ports(server_num)
+    endpoints = ",".join(f"127.0.0.1:{p}" for p in ports)
+    base = dict(env if env is not None else os.environ)
+    base["PADDLE_PSERVERS_IP_PORT_LIST"] = endpoints
+    base["PADDLE_TRAINERS_NUM"] = str(worker_num)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    def spawn(role, idx, extra):
+        e = dict(base)
+        e["TRAINING_ROLE"] = role
+        e.update(extra)
+        out = None
+        if log_dir:
+            out = open(os.path.join(
+                log_dir, f"{role.lower()}.{idx}.log"), "w")
+        return subprocess.Popen(
+            [sys.executable, training_script] + list(script_args),
+            env=e, stdout=out, stderr=subprocess.STDOUT if out else None)
+
+    servers = [spawn("PSERVER", i, {"PADDLE_PORT": str(port),
+                                    "POD_IP": "127.0.0.1"})
+               for i, port in enumerate(ports)]
+    trainers = [spawn("TRAINER", i, {"PADDLE_TRAINER_ID": str(i)})
+                for i in range(worker_num)]
+    return servers, trainers
+
+
+def wait_procs(servers, trainers, timeout=None) -> int:
+    """Wait for every trainer, then stop the pservers (they serve until
+    told otherwise — the reference's wait loop does the same)."""
+    rc = 0
+    for p in trainers:
+        rc |= p.wait(timeout=timeout) or 0
+    for p in servers:
+        p.terminate()
+    for p in servers:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    return rc
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    servers, trainers = start_procs(
+        args.worker_num, args.server_num, args.training_script,
+        args.training_script_args, log_dir=args.log_dir)
+    return wait_procs(servers, trainers)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,47 @@
+"""Minimal fleet-PS training script driven by distributed.launch_ps
+(reference launch_ps.py's target-script contract: TRAINING_ROLE +
+PADDLE_* env decide the role)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.incubate.fleet.base.role_maker import PaddleCloudRoleMaker
+from paddle_tpu.incubate.fleet.parameter_server import fleet
+
+
+def main():
+    fleet.init(PaddleCloudRoleMaker())
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.05))
+        opt.minimize(loss)
+    if fleet.is_server():
+        fleet.init_server()
+        fleet.run_server(blocking=True)
+        return
+    exe = fluid.Executor(fluid.CPUPlace())
+    fleet.init_worker()
+    exe.run(fleet.startup_program or startup)
+    rng = np.random.RandomState(
+        int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    last = None
+    for _ in range(8):
+        xb = rng.rand(16, 4).astype("float32")
+        yb = (xb.sum(1, keepdims=True) * 0.5).astype("float32")
+        last = exe.run(fleet.main_program or main_prog,
+                       feed={"x": xb, "y": yb}, fetch_list=[loss])[0]
+    assert np.isfinite(last).all()
+    fleet.stop_worker()
+    print("TRAINER_DONE", os.environ.get("PADDLE_TRAINER_ID"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Shared TPU-lane helpers: the TPU_LANE.json round-artifact recorder."""
+import json
+import os
+
+_PATH = os.path.join(os.path.dirname(__file__), "..", "..",
+                     "TPU_LANE.json")
+
+
+def record(key, value):
+    data = {}
+    if os.path.exists(_PATH):
+        with open(_PATH) as f:
+            data = json.load(f)
+    data[key] = value
+    with open(_PATH, "w") as f:
+        json.dump(data, f, indent=1)

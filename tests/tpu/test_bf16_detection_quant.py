@@ -21,15 +21,7 @@ sys.path.insert(0, _TESTS_DIR)
 import paddle_tpu as fluid  # noqa: E402
 
 
-def _record(key, value):
-    path = os.path.join(_TESTS_DIR, "..", "TPU_LANE.json")
-    data = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            data = json.load(f)
-    data[key] = value
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+from tests.tpu._lane import record as _record
 
 
 def test_bf16_optest_sweep_on_chip():

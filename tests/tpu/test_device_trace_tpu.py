@@ -19,19 +19,9 @@ pytestmark = pytest.mark.skipif(
 
 import paddle_tpu as fluid
 from paddle_tpu import profiler
-from paddle_tpu.utils import device_trace
 
 
-def _record(key, value):
-    path = os.path.join(os.path.dirname(__file__), "..", "..",
-                        "TPU_LANE.json")
-    data = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            data = json.load(f)
-    data[key] = value
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+from tests.tpu._lane import record as _record
 
 
 def test_measured_attribution_on_tpu(tmp_path, monkeypatch, capsys):

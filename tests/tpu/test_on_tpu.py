@@ -23,16 +23,7 @@ pytestmark = pytest.mark.skipif(
 import paddle_tpu as fluid
 
 
-def _record(key, value):
-    path = os.path.join(os.path.dirname(__file__), "..", "..",
-                        "TPU_LANE.json")
-    data = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            data = json.load(f)
-    data[key] = value
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+from tests.tpu._lane import record as _record
 
 
 def test_executor_train_step_on_tpu():

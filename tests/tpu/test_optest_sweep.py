@@ -61,15 +61,7 @@ def _classes():
     ]
 
 
-def _record(key, value):
-    path = os.path.join(_TESTS_DIR, "..", "TPU_LANE.json")
-    data = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            data = json.load(f)
-    data[key] = value
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+from tests.tpu._lane import record as _record
 
 
 @pytest.mark.parametrize("cls", _classes() if jax.default_backend() == "tpu"

@@ -25,7 +25,7 @@ python tools/mfu_sweep.py --multi \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=none,celim=1073741824,bq=1024,bk=1024,steps=8" \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=none,celim=1073741824,flash=0,steps=8" \
   | tee MFU_SWEEP.json
-echo "=== sweep rc=$? ==="
+echo "=== sweep rc=${PIPESTATUS[0]} ==="
 
 echo "=== [2/3] TPU test lane $(date -u +%H:%M:%S) ==="
 PADDLE_TPU_NATIVE=1 python -m pytest tests/tpu -q
