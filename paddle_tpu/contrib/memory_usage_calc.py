@@ -1,0 +1,29 @@
+"""fluid.contrib.memory_usage_calc — parity with
+python/paddle/fluid/contrib/memory_usage_calc.py (memory_usage): estimate
+a Program's training memory from its var declarations. The reference
+sums var bytes the same way; actual placement here is XLA's buffer
+assignment, so this is the same order-of-magnitude planning tool."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["memory_usage"]
+
+_DTYPE_BYTES = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+                "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
+                "bool": 1}
+
+
+def memory_usage(program, batch_size: int = 1):
+    """Return (lower_mb, upper_mb): vars-only lower bound and a 3x upper
+    bound covering gradients + optimizer state (the reference reports the
+    same kind of band)."""
+    total = 0
+    for block in program.blocks:
+        for var in block.vars.values():
+            shape = [batch_size if (s is None or int(s) < 0) else int(s)
+                     for s in (var.shape or [])]
+            n = int(np.prod(shape)) if shape else 1
+            total += n * _DTYPE_BYTES.get(str(var.dtype), 4)
+    lower = total / (1 << 20)
+    return lower, lower * 3.0

@@ -1,0 +1,37 @@
+"""fluid.contrib.model_stat — parity with
+python/paddle/fluid/contrib/model_stat.py (summary): per-layer param and
+FLOP table for a Program, printed like the reference's pretty table.
+FLOPs come from XLA's own cost analysis (utils/op_costs.py) instead of
+hand-written per-op formulas."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(main_prog, batch_size: int = 1, print_table: bool = True):
+    """Return (total_params, total_flops, rows); optionally print the
+    reference-style summary table."""
+    from ..utils.op_costs import program_cost_table
+
+    block = main_prog.global_block()
+    total_params = 0
+    param_rows = []
+    for name, var in block.vars.items():
+        if getattr(var, "persistable", False) and var.shape and \
+                not name.startswith(("learning_rate", "@")):
+            n = int(np.prod([abs(int(s)) for s in var.shape]))
+            total_params += n
+            param_rows.append((name, tuple(var.shape), n))
+    cost_rows = program_cost_table(main_prog, batch_size=batch_size)
+    total_flops = sum(r.get("flops", 0.0) or 0.0 for r in cost_rows)
+    if print_table:
+        print(f"{'Param':<42}{'Shape':<22}{'Count':>12}")
+        for name, shape, n in sorted(param_rows, key=lambda r: -r[2])[:40]:
+            print(f"{name:<42}{str(shape):<22}{n:>12}")
+        print(f"Total params: {total_params:,} "
+              f"({total_params * 4 / (1 << 20):.2f} MB fp32)")
+        print(f"Total FLOPs (batch={batch_size}): {total_flops:,.0f} "
+              f"({total_flops / 1e9:.3f} GFLOPs)")
+    return total_params, total_flops, param_rows

@@ -1,0 +1,21 @@
+"""fluid.contrib.reader — parity with
+contrib/reader/distributed_reader.py (distributed_batch_reader:21):
+round-robin batch sharding across PADDLE_TRAINERS_NUM trainers."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.getenv("PADDLE_TRAINER_ID", 0))
+    assert trainer_id < trainers_num
+
+    def reader():
+        for batch_id, data in enumerate(batch_reader()):
+            if batch_id % trainers_num == trainer_id:
+                yield data
+
+    return reader

@@ -20,16 +20,23 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
     node_ips = (os.getenv("PADDLE_TRAINERS") or args_node_ips
                 or "127.0.0.1")
     if isinstance(node_ips, str):
-        node_ips = node_ips.replace(" ", ",").split(",")
+        node_ips = [ip.strip() for ip in node_ips.replace(" ", ",").split(",")
+                    if ip.strip()]
     node_ip = os.getenv("POD_IP", args_node_ip or node_ips[0])
     port = int(os.getenv("PADDLE_PORT", args_port or 6170))
     n_dev = len(selected_devices) if selected_devices else 1
     endpoints = [f"{ip}:{port + d}" for ip in node_ips
                  for d in range(n_dev)]
+    cur = f"{node_ip}:{port}"
+    if cur not in endpoints:
+        # fail fast (the reference's node_ips.index raises too): a silent
+        # rank-0 default would duplicate the coordinator
+        raise ValueError(
+            f"current endpoint {cur} is not in the cluster list "
+            f"{endpoints} — check POD_IP/PADDLE_TRAINERS")
     return {
         "trainer_endpoints": endpoints,
-        "current_endpoint": f"{node_ip}:{port}",
+        "current_endpoint": cur,
         "nranks": len(endpoints),
-        "rank": endpoints.index(f"{node_ip}:{port}")
-        if f"{node_ip}:{port}" in endpoints else 0,
+        "rank": endpoints.index(cur),
     }

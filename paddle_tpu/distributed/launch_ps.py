@@ -63,9 +63,14 @@ def start_procs(worker_num: int, server_num: int, training_script: str,
         if log_dir:
             out = open(os.path.join(
                 log_dir, f"{role.lower()}.{idx}.log"), "w")
-        return subprocess.Popen(
-            [sys.executable, training_script] + list(script_args),
-            env=e, stdout=out, stderr=subprocess.STDOUT if out else None)
+        try:
+            return subprocess.Popen(
+                [sys.executable, training_script] + list(script_args),
+                env=e, stdout=out,
+                stderr=subprocess.STDOUT if out else None)
+        finally:
+            if out is not None:
+                out.close()     # Popen dup'd the fd; the parent copy leaks
 
     servers = [spawn("PSERVER", i, {"PADDLE_PORT": str(port),
                                     "POD_IP": "127.0.0.1"})
@@ -76,18 +81,22 @@ def start_procs(worker_num: int, server_num: int, training_script: str,
 
 
 def wait_procs(servers, trainers, timeout=None) -> int:
-    """Wait for every trainer, then stop the pservers (they serve until
-    told otherwise — the reference's wait loop does the same)."""
+    """Wait for every trainer (``timeout`` bounds EACH wait), then stop
+    the pservers (they serve until told otherwise — the reference's wait
+    loop does the same). Servers and unfinished trainers are torn down
+    even when a trainer hangs past the timeout."""
     rc = 0
-    for p in trainers:
-        rc |= p.wait(timeout=timeout) or 0
-    for p in servers:
-        p.terminate()
-    for p in servers:
-        try:
-            p.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            p.kill()
+    try:
+        for p in trainers:
+            rc |= p.wait(timeout=timeout) or 0
+    finally:
+        for p in servers + [t for t in trainers if t.poll() is None]:
+            p.terminate()
+        for p in servers:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
     return rc
 
 

@@ -43,3 +43,16 @@ def test_cloud_utils_and_fs_wrapper(monkeypatch):
     assert c["current_endpoint"] == "10.0.0.2:6170"
     fs = fs_wrapper.LocalFS()
     assert hasattr(fs, "ls") and hasattr(fs, "mkdirs")
+
+
+def test_cloud_cluster_rejects_unknown_pod_ip(monkeypatch):
+    import pytest
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1, 10.0.0.2")
+    monkeypatch.setenv("POD_IP", "10.9.9.9")
+    monkeypatch.setenv("PADDLE_PORT", "6170")
+    with pytest.raises(ValueError, match="not in the cluster"):
+        cloud_utils.get_cloud_cluster()
+    # comma+space list parses without empty segments
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    c = cloud_utils.get_cloud_cluster()
+    assert c["nranks"] == 2 and c["rank"] == 1
