@@ -15,12 +15,15 @@ def summary(main_prog, batch_size: int = 1, print_table: bool = True):
     reference-style summary table."""
     from ..utils.op_costs import program_cost_table
 
+    from ..framework.program import Parameter
+
     block = main_prog.global_block()
     total_params = 0
     param_rows = []
     for name, var in block.vars.items():
-        if getattr(var, "persistable", False) and var.shape and \
-                not name.startswith(("learning_rate", "@")):
+        # Parameters only: optimizer accumulators (moments, beta pows) are
+        # persistable too and would inflate the count ~3x after minimize()
+        if isinstance(var, Parameter) and var.shape:
             n = int(np.prod([abs(int(s)) for s in var.shape]))
             total_params += n
             param_rows.append((name, tuple(var.shape), n))

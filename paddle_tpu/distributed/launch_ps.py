@@ -90,13 +90,15 @@ def wait_procs(servers, trainers, timeout=None) -> int:
         for p in trainers:
             rc |= p.wait(timeout=timeout) or 0
     finally:
-        for p in servers + [t for t in trainers if t.poll() is None]:
+        leftovers = servers + [t for t in trainers if t.poll() is None]
+        for p in leftovers:
             p.terminate()
-        for p in servers:
+        for p in leftovers:
             try:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+                p.wait(timeout=10)
     return rc
 
 

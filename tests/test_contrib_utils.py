@@ -17,7 +17,10 @@ def _toy_program():
 
 
 def test_model_stat_summary(capsys):
-    main, _, _ = _toy_program()
+    main, startup, loss = _toy_program()
+    # count AFTER minimize: accumulators must not inflate the param count
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
     params, flops, rows = contrib.model_stat.summary(main, batch_size=4)
     # fc1: 8*16+16, fc2: 16*2+2
     assert params == 8 * 16 + 16 + 16 * 2 + 2

@@ -1,0 +1,152 @@
+"""Numerical parity of the paddle.tensor namespace against numpy ground
+truth (eager mode) — the subtler 2.0 semantics: norms, logsumexp,
+unbiased var/std, addcmul/addmm, kron/trace/cross/dist, histogram,
+cumsum variants, clamp edges."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import dygraph
+
+rs = np.random.RandomState(42)
+
+
+def _v(a):
+    return dygraph.to_variable(np.asarray(a, "float32"))
+
+
+def _np(x):
+    return np.asarray(x.value)
+
+
+@pytest.fixture(autouse=True)
+def _guard():
+    with dygraph.guard():
+        yield
+
+
+def test_norm_fro_and_p():
+    a = rs.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(_np(paddle.tensor.norm(_v(a), p="fro")),
+                               np.linalg.norm(a), rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(paddle.tensor.norm(_v(a), p=3, axis=1)),
+        (np.abs(a) ** 3).sum(1) ** (1 / 3), rtol=1e-4)
+
+
+def test_logsumexp_against_scipy_form():
+    a = (rs.randn(4, 5) * 10).astype("float32")
+    want = np.log(np.exp(a - a.max()).sum()) + a.max()
+    np.testing.assert_allclose(_np(paddle.logsumexp(_v(a))), want,
+                               rtol=1e-5)
+    want_ax = np.log(np.exp(a - a.max(1, keepdims=True)).sum(1)) + a.max(1)
+    np.testing.assert_allclose(_np(paddle.logsumexp(_v(a), dim=1)),
+                               want_ax, rtol=1e-5)
+
+
+def test_var_std_unbiased_vs_biased():
+    a = rs.randn(6, 7).astype("float32")
+    np.testing.assert_allclose(_np(paddle.var(_v(a))), a.var(ddof=1),
+                               rtol=1e-4)
+    np.testing.assert_allclose(_np(paddle.var(_v(a), unbiased=False)),
+                               a.var(), rtol=1e-4)
+    np.testing.assert_allclose(_np(paddle.std(_v(a), axis=1)),
+                               a.std(1, ddof=1), rtol=1e-4)
+
+
+def test_addcmul_addmm():
+    x, t1, t2 = rs.randn(3, 4), rs.randn(3, 4), rs.randn(3, 4)
+    np.testing.assert_allclose(
+        _np(paddle.addcmul(_v(x), _v(t1), _v(t2), value=0.5)),
+        x + 0.5 * t1 * t2, rtol=1e-5)
+    i, a, b = rs.randn(2, 5), rs.randn(2, 3), rs.randn(3, 5)
+    np.testing.assert_allclose(
+        _np(paddle.addmm(_v(i), _v(a), _v(b), alpha=0.7, beta=0.3)),
+        0.3 * i + 0.7 * (a @ b), rtol=1e-4)
+
+
+def test_kron_trace_cross_dist():
+    a, b = rs.randn(2, 3), rs.randn(3, 2)
+    np.testing.assert_allclose(_np(paddle.kron(_v(a), _v(b))),
+                               np.kron(a, b), rtol=1e-5)
+    c = rs.randn(4, 4)
+    np.testing.assert_allclose(_np(paddle.trace(_v(c), offset=1)),
+                               np.trace(c, offset=1), rtol=1e-5)
+    u, w = rs.randn(4, 3), rs.randn(4, 3)
+    np.testing.assert_allclose(_np(paddle.cross(_v(u), _v(w), dim=1)),
+                               np.cross(u, w, axis=1), rtol=1e-4)
+    np.testing.assert_allclose(_np(paddle.dist(_v(u), _v(w), p=2)),
+                               np.linalg.norm((u - w).ravel()), rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(paddle.dist(_v(u), _v(w), p=float("inf"))),
+        np.abs(u - w).max(), rtol=1e-5)
+
+
+def test_histogram_matches_numpy():
+    a = (rs.rand(100) * 10).astype("float32")
+    got = _np(paddle.histogram(_v(a), bins=10, min=0, max=10))
+    want, _ = np.histogram(a, bins=10, range=(0, 10))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cumsum_exclusive_reverse_flatten():
+    a = rs.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(_np(paddle.cumsum(_v(a), axis=1)),
+                               np.cumsum(a, 1), rtol=1e-5)
+    np.testing.assert_allclose(_np(paddle.cumsum(_v(a))),
+                               np.cumsum(a.ravel()), rtol=1e-5)
+    got = _np(paddle.cumsum(_v(a), axis=1, reverse=True))
+    want = np.cumsum(a[:, ::-1], 1)[:, ::-1]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_clamp_one_sided():
+    a = rs.randn(10).astype("float32")
+    np.testing.assert_allclose(_np(paddle.clamp(_v(a), min=0.0)),
+                               np.maximum(a, 0), rtol=1e-6)
+    np.testing.assert_allclose(_np(paddle.clamp(_v(a), max=0.5)),
+                               np.minimum(a, 0.5), rtol=1e-6)
+
+
+def test_t_and_mm_shapes():
+    a = rs.randn(3, 5).astype("float32")
+    np.testing.assert_allclose(_np(paddle.t(_v(a))), a.T, rtol=1e-6)
+    v1 = rs.randn(7).astype("float32")
+    np.testing.assert_allclose(_np(paddle.t(_v(v1))), v1, rtol=1e-6)
+    b = rs.randn(5, 2).astype("float32")
+    np.testing.assert_allclose(_np(paddle.mm(_v(a), _v(b))), a @ b,
+                               rtol=1e-4)
+
+
+def test_index_ops():
+    a = rs.randn(5, 6).astype("float32")
+    idx = np.asarray([0, 2, 4], "int64")
+    np.testing.assert_allclose(
+        _np(paddle.index_select(_v(a), dygraph.to_variable(idx), dim=0)),
+        a[idx], rtol=1e-6)
+    samp = np.asarray([[0, 1], [2, 3], [4, 5], [0, 0], [5, 5]], "int64")
+    np.testing.assert_allclose(
+        _np(paddle.index_sample(_v(a), dygraph.to_variable(samp))),
+        np.take_along_axis(a, samp, 1), rtol=1e-6)
+
+
+def test_flip_roll_unbind():
+    a = rs.randn(2, 3, 4).astype("float32")
+    np.testing.assert_allclose(_np(paddle.flip(_v(a), dims=[0, 2])),
+                               a[::-1, :, ::-1], rtol=1e-6)
+    np.testing.assert_allclose(_np(paddle.roll(_v(a), 5)),
+                               np.roll(a, 5), rtol=1e-6)
+    np.testing.assert_allclose(_np(paddle.roll(_v(a), 2, dims=1)),
+                               np.roll(a, 2, 1), rtol=1e-6)
+    parts = paddle.unbind(_v(a), axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(_np(parts[1]), a[:, 1], rtol=1e-6)
+
+
+def test_logic_reduce_and_allclose():
+    a = np.asarray([[1.0, 2.0], [3.0, 4.0]], "float32")
+    assert bool(_np(paddle.equal(_v(a), _v(a.copy()))))
+    assert not bool(_np(paddle.equal(_v(a), _v(a + 1))))
+    assert bool(_np(paddle.allclose(_v(a), _v(a + 1e-9))))
+    ew = _np(paddle.elementwise_equal(_v(a), _v(a)))
+    assert ew.dtype == np.bool_ and ew.all()
