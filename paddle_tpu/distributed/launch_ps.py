@@ -98,7 +98,10 @@ def wait_procs(servers, trainers, timeout=None) -> int:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
-                p.wait(timeout=10)
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass    # D-state survivor: keep reaping the rest
     return rc
 
 

@@ -127,6 +127,81 @@ def distributed_push_sparse_op(scope, op, exe):
     client.push_sparse(eps[0], table, ids, grads, lr=lr)
 
 
+def _box_pull(scope, op, extended):
+    """pull_box_sparse(_extended) — reference pull_box_sparse_op.cc:20:
+    N Ids tensors (last dim 1) -> N embedding tensors ids[:-1]+[size],
+    looked up from the sparse PS table (the TPU-native stand-in for the
+    BoxPS heterogeneous store: same table contract, served by
+    native/ps_table.cpp through the framed wire)."""
+    eps = op.attr("epmap", None) or []
+    table = op.attr("table_name", "emb")
+    size = int(op.attr("size", 1))
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    outs = op.output("Out")
+    ext_outs = op.output("OutExtend") if extended else []
+    # ONE RPC for all slots (the reference does one BoxPS call): flatten
+    # every Ids tensor, pull once, split the rows back per slot
+    id_arrays = [_scope_np(scope, n) for n in op.input("Ids")]
+    flat = np.concatenate([a.reshape(-1) for a in id_arrays]).astype(
+        np.uint64)
+    rows = client.pull_sparse(eps[0], table, flat)
+    off = 0
+    for i, ids in enumerate(id_arrays):
+        n = ids.reshape(-1).size
+        slot_rows = rows[off:off + n].reshape(*ids.shape[:-1], -1)
+        off += n
+        _set_scope(scope, outs[i],
+                   np.ascontiguousarray(slot_rows[..., :size]))
+        if extended and i < len(ext_outs):
+            _set_scope(scope, ext_outs[i],
+                       np.ascontiguousarray(slot_rows[..., size:]))
+
+
+def _box_push(scope, op, extended):
+    """push_box_sparse(_extended) — the grad path of the box lookup. The
+    extended variant concatenates Out@GRAD with OutExtend@GRAD to the
+    full row width (reference pull_box_extended_sparse_op.h:63)."""
+    eps = op.attr("epmap", None) or []
+    table = op.attr("table_name", "emb")
+    tid = int(op.attr("trainer_id", 0))
+    client = PSClient.instance(tid)
+    grads = op.input("Out@GRAD") or op.input("Grad")
+    ext_grads = (op.input("OutExtend@GRAD") or op.input("GradExtend")) \
+        if extended else []
+    all_ids, all_g = [], []
+    for i, (ids_name, g_name) in enumerate(zip(op.input("Ids"), grads)):
+        ids = _scope_np(scope, ids_name).reshape(-1).astype(np.uint64)
+        g = _scope_np(scope, g_name).reshape(ids.size, -1)
+        if extended and i < len(ext_grads):
+            ge = _scope_np(scope, ext_grads[i]).reshape(ids.size, -1)
+            g = np.concatenate([g, ge], axis=1)
+        all_ids.append(ids)
+        all_g.append(g)
+    client.push_sparse(eps[0], table, np.concatenate(all_ids),
+                       np.concatenate(all_g, axis=0))
+
+
+@register_host_op("pull_box_sparse")
+def pull_box_sparse_op(scope, op, exe):
+    _box_pull(scope, op, extended=False)
+
+
+@register_host_op("pull_box_extended_sparse")
+def pull_box_extended_sparse_op(scope, op, exe):
+    _box_pull(scope, op, extended=True)
+
+
+@register_host_op("push_box_sparse")
+def push_box_sparse_op(scope, op, exe):
+    _box_push(scope, op, extended=False)
+
+
+@register_host_op("push_box_extended_sparse")
+def push_box_extended_sparse_op(scope, op, exe):
+    _box_push(scope, op, extended=True)
+
+
 @register_host_op("listen_and_serv")
 def listen_and_serv_op(scope, op, exe):
     """listen_and_serv_op.cc: the pserver main loop.  Builds tables from the

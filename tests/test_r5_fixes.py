@@ -120,3 +120,94 @@ def test_seqpool_cvm_concat_average_uses_true_lengths():
                  {"pooltype": "AVERAGE", "use_cvm": False})
     want = a.sum(1) / ln[:, None].astype("float32")
     np.testing.assert_allclose(out["Out"][0], want, rtol=1e-5)
+
+
+def test_pull_push_box_sparse_host_ops():
+    """pull/push_box_sparse against a real sparse PS table (reference
+    pull_box_sparse_op.cc semantics: N Ids [...,1] -> N [...,size])."""
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    PSClient.reset_all()
+    srv = ParameterServer("127.0.0.1:0", trainer_num=1, sync_mode=False,
+                          mode=1)
+    srv.start()
+    srv.register_sparse("emb", dim=8, lr=0.5)
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        main = fluid.Program()
+        blk = main.global_block()
+        blk.create_var(name="ids", shape=[4, 1], dtype="int64",
+                       is_data=True)
+        blk.create_var(name="emb_out", shape=[4, 8], dtype="float32")
+        blk.append_op(type="pull_box_sparse", inputs={"Ids": ["ids"]},
+                      outputs={"Out": ["emb_out"]},
+                      attrs={"epmap": [ep], "table_name": "emb",
+                             "size": 8})
+        blk.create_var(name="g", shape=[4, 8], dtype="float32",
+                       is_data=True)
+        blk.append_op(type="push_box_sparse",
+                      inputs={"Ids": ["ids"], "Grad": ["g"]},
+                      outputs={},
+                      attrs={"epmap": [ep], "table_name": "emb"})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ids = np.asarray([[1], [2], [3], [1]], "int64")
+        g = np.ones((4, 8), "float32")
+        out0 = exe.run(main, feed={"ids": ids, "g": g},
+                       fetch_list=["emb_out"])[0]
+        assert out0.shape == (4, 8)
+        # push sgd(lr=0.5) on rows 1,2,3 (row 1 twice), then re-pull
+        out1 = exe.run(main, feed={"ids": ids, "g": g},
+                       fetch_list=["emb_out"])[0]
+        np.testing.assert_allclose(out1[1], out0[1] - 0.5, rtol=1e-5)
+        np.testing.assert_allclose(out1[0], out0[0] - 1.0, rtol=1e-5)
+    finally:
+        srv.stop()
+        PSClient.reset_all()
+
+
+def test_pull_push_box_extended_sparse_host_ops():
+    """Extended variant: OutExtend carries the tail columns and its grad
+    must train them (reference pull_box_extended_sparse_op.h:63)."""
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    PSClient.reset_all()
+    srv = ParameterServer("127.0.0.1:0", trainer_num=1, sync_mode=False,
+                          mode=1)
+    srv.start()
+    srv.register_sparse("emb", dim=12, lr=0.5)     # 8 base + 4 extended
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        main = fluid.Program()
+        blk = main.global_block()
+        blk.create_var(name="ids", shape=[3, 1], dtype="int64",
+                       is_data=True)
+        blk.create_var(name="o", shape=[3, 8], dtype="float32")
+        blk.create_var(name="oe", shape=[3, 4], dtype="float32")
+        blk.append_op(type="pull_box_extended_sparse",
+                      inputs={"Ids": ["ids"]},
+                      outputs={"Out": ["o"], "OutExtend": ["oe"]},
+                      attrs={"epmap": [ep], "table_name": "emb",
+                             "size": 8})
+        blk.create_var(name="g", shape=[3, 8], dtype="float32",
+                       is_data=True)
+        blk.create_var(name="ge", shape=[3, 4], dtype="float32",
+                       is_data=True)
+        blk.append_op(type="push_box_extended_sparse",
+                      inputs={"Ids": ["ids"], "Grad": ["g"],
+                              "GradExtend": ["ge"]},
+                      outputs={},
+                      attrs={"epmap": [ep], "table_name": "emb"})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ids = np.asarray([[1], [2], [3]], "int64")
+        g = np.ones((3, 8), "float32")
+        ge = 2 * np.ones((3, 4), "float32")
+        o0, oe0 = exe.run(main, feed={"ids": ids, "g": g, "ge": ge},
+                          fetch_list=["o", "oe"])
+        o1, oe1 = exe.run(main, feed={"ids": ids, "g": g, "ge": ge},
+                          fetch_list=["o", "oe"])
+        # sgd lr=0.5: base cols -0.5, extended cols -1.0 per step
+        np.testing.assert_allclose(o1, o0 - 0.5, rtol=1e-5)
+        np.testing.assert_allclose(oe1, oe0 - 1.0, rtol=1e-5)
+    finally:
+        srv.stop()
+        PSClient.reset_all()

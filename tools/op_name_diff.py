@@ -21,10 +21,6 @@ BY_DESIGN = {
     "lite_engine": "XLA is the inference compiler",
     "conv2d_inception_fusion": "XLA fuses the inception subgraph",
     "fusion_group": "Pallas kernels (ops/pallas_kernels.py)",
-    "pull_box_sparse": "BoxPS heterogeneous PS (distributed/ tables)",
-    "push_box_sparse": "BoxPS heterogeneous PS (distributed/ tables)",
-    "pull_box_extended_sparse": "BoxPS heterogeneous PS",
-    "push_box_extended_sparse": "BoxPS heterogeneous PS",
     "fl_listen_and_serv": "federated runtime out of scope",
     "run_program": "@declarative jit staging (dygraph/jit.py)",
     "read": "reader.py / dataset.py host feeding",
