@@ -430,3 +430,84 @@ def fused_embedding_seq_pool(ctx, op, ins):
         mask = jnp.where(idx == padding_idx, 0.0, mask)
     out = jnp.sum(emb * mask[..., None], axis=1)
     return {"Out": out}
+
+
+@register_op("conv2d_inception_fusion", diff_inputs=("Input", "Filter"))
+def conv2d_inception_fusion(ctx, op, ins):
+    """operators/fused/fusion_conv_inception_op.cu — the aggregated
+    inception block (cuDNN kernel's channel plumbing, fusion_conv_inception
+    _op.cu:195-247):
+
+      branch0 = act(conv1x1(pool3x3_s1_p1(x), F0) + B0)           # oc0
+      t1      = act(conv1x1(x, F1) + B1)                          # oc1+2*ic2
+      branch1 = t1[:, :oc1]
+      t2      = act(conv3x3_g2(t1[:, oc1:], F2) + B2)             # oc2+ic3
+      branch2 = t2[:, :oc2]
+      branch3 = act(conv3x3(t2[:, oc2:], F3) + B3)                # oc3
+      out     = concat([branch0, branch1, branch2, branch3], C)
+
+    One jit graph; XLA fuses it the way cuDNN's fused kernel does."""
+    x = ins["Input"][0]
+    f = ins["Filter"]
+    b = ins.get("Bias") or [None] * 4
+    act_raw = op.attr("activation", "relu")
+    act_name = "identity" if act_raw is None else str(act_raw)
+    pool_type = str(op.attr("pooling_type", "max"))
+    exclusive = bool(op.attr("exclusive", True))
+
+    def act(v):
+        if act_name in ("identity", ""):
+            return v
+        if act_name == "relu":
+            return jax.nn.relu(v)
+        if act_name == "relu6":
+            return jnp.clip(v, 0.0, 6.0)
+        if act_name == "sigmoid":
+            return jax.nn.sigmoid(v)
+        if act_name == "tanh":
+            return jnp.tanh(v)
+        raise NotImplementedError(f"inception activation {act_name!r}")
+
+    def conv(v, w, pad, groups=1):
+        dn = lax.conv_dimension_numbers(v.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        return lax.conv_general_dilated(
+            v, w, (1, 1), [(pad, pad), (pad, pad)],
+            dimension_numbers=dn, feature_group_count=groups).astype(v.dtype)
+
+    def biased(v, w, bias, pad, groups=1):
+        out = conv(v, w, pad, groups)
+        if bias is not None:
+            out = out + bias.reshape(1, -1, 1, 1)
+        return act(out)
+
+    # 3x3 stride-1 pad-1 pool (cudnn_pool_desc: k3x3, pads k1x1, stride 1)
+    if pool_type == "max":
+        pooled = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 1, 1),
+            [(0, 0), (0, 0), (1, 1), (1, 1)])
+    else:
+        summed = lax.reduce_window(
+            x, 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+            [(0, 0), (0, 0), (1, 1), (1, 1)])
+        if exclusive:
+            counts = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+                [(0, 0), (0, 0), (1, 1), (1, 1)])
+            pooled = summed / counts
+        else:
+            pooled = summed / 9.0
+
+    ic2 = f[2].shape[1]            # per-group in-channels of the g2 conv
+    oc1 = f[1].shape[0] - 2 * ic2
+    ic3 = f[3].shape[1]
+
+    branch0 = biased(pooled, f[0], b[0], pad=0)
+    t1 = biased(x, f[1], b[1], pad=0)
+    branch1 = t1[:, :oc1]
+    t2 = biased(t1[:, oc1:], f[2], b[2], pad=1, groups=2)
+    oc2 = t2.shape[1] - ic3
+    branch2 = t2[:, :oc2]
+    branch3 = biased(t2[:, oc2:], f[3], b[3], pad=1)
+    return {"Output": jnp.concatenate(
+        [branch0, branch1, branch2, branch3], axis=1), "TempOutput": None}

@@ -220,3 +220,52 @@ def test_var_conv_2d_masks_per_image_extent():
     assert not np.allclose(o[0], 0)
     assert (o[1, :, 3:, :] == 0).all() and (o[1, :, :, 4:] == 0).all()
     assert not np.allclose(o[1, :, :3, :4], 0)
+
+
+def test_conv2d_inception_fusion():
+    """Aggregated inception block vs an independent straight-line jax
+    composition (reference fusion_conv_inception_op.cu channel layout:
+    oc0 | oc1 | oc2 | oc3 with t1 tail feeding the grouped conv and t2
+    tail feeding the final 3x3)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rs = np.random.RandomState(0)
+    N, C, H, W = 2, 8, 6, 6
+    ic2, oc1, ic3, oc2 = 3, 5, 4, 6
+    x = rs.randn(N, C, H, W).astype("float32")
+    f0 = rs.randn(4, C, 1, 1).astype("float32")
+    f1 = rs.randn(oc1 + 2 * ic2, C, 1, 1).astype("float32")
+    f2 = rs.randn(oc2 + ic3, ic2, 3, 3).astype("float32")
+    f3 = rs.randn(7, ic3, 3, 3).astype("float32")
+    b = [rs.randn(f.shape[0]).astype("float32")
+         for f in (f0, f1, f2, f3)]
+
+    out = run_single_op("conv2d_inception_fusion",
+                 {"Input": x, "Filter": [f0, f1, f2, f3], "Bias": b},
+                 ["Output"],
+                 {"activation": "relu", "pooling_type": "avg",
+                  "exclusive": True})["Output"]
+
+    def cv(v, w, pad, g=1):
+        dn = lax.conv_dimension_numbers(v.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        return np.asarray(lax.conv_general_dilated(
+            v, w, (1, 1), [(pad, pad), (pad, pad)],
+            dimension_numbers=dn, feature_group_count=g))
+
+    counts = np.asarray(lax.reduce_window(
+        jnp.ones_like(jnp.asarray(x)), 0.0, lax.add, (1, 1, 3, 3),
+        (1, 1, 1, 1), [(0, 0), (0, 0), (1, 1), (1, 1)]))
+    pooled = np.asarray(lax.reduce_window(
+        jnp.asarray(x), 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (1, 1), (1, 1)])) / counts
+    relu = lambda v: np.maximum(v, 0)
+    br0 = relu(cv(pooled, f0, 0) + b[0].reshape(1, -1, 1, 1))
+    t1 = relu(cv(x, f1, 0) + b[1].reshape(1, -1, 1, 1))
+    t2 = relu(cv(t1[:, oc1:], f2, 1, g=2) + b[2].reshape(1, -1, 1, 1))
+    br3 = relu(cv(t2[:, oc2:], f3, 1) + b[3].reshape(1, -1, 1, 1))
+    want = np.concatenate([br0, t1[:, :oc1], t2[:, :oc2], br3], axis=1)
+    assert out.shape == (N, 4 + oc1 + oc2 + 7, H, W)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)

@@ -19,7 +19,6 @@ BY_DESIGN = {
     "gen_nccl_id": "jax.distributed coordinator (parallel/env.py)",
     "tensorrt_engine": "XLA is the inference compiler",
     "lite_engine": "XLA is the inference compiler",
-    "conv2d_inception_fusion": "XLA fuses the inception subgraph",
     "fusion_group": "Pallas kernels (ops/pallas_kernels.py)",
     "fl_listen_and_serv": "federated runtime out of scope",
     "run_program": "@declarative jit staging (dygraph/jit.py)",
