@@ -1,0 +1,129 @@
+"""ctypes binding over the native PS wire loop (native/ps_wire.cpp).
+
+The C++ library owns the listen socket and the connection threads; hot
+commands run GIL-free against the ps_table.cpp handles, control commands
+come back into Python through the deferred callback (ctypes re-acquires
+the GIL per call; blocking waits inside the handler — sync rounds,
+barriers — release it again through the usual lock waits).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+from .. import native
+from . import table as _table
+
+_DEFER_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
+
+_wire_lib = None
+
+
+def enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_PS_NATIVE_WIRE", "1") not in (
+        "0", "false", "off")
+
+
+def _load():
+    global _wire_lib
+    if _wire_lib is None:
+        lib = native.load_library("ps_wire")
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.pt_wire_create.restype = ctypes.c_void_p
+        lib.pt_wire_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_int)]
+        lib.pt_wire_set_table_fns.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_void_p] * 6
+        lib.pt_wire_set_deferred.argtypes = [ctypes.c_void_p, _DEFER_CB]
+        lib.pt_wire_register.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int64, i64p, ctypes.c_int, ctypes.c_int]
+        lib.pt_wire_mark_initialized.restype = ctypes.c_int
+        lib.pt_wire_mark_initialized.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_char_p]
+        for n in ("pt_wire_start", "pt_wire_stop", "pt_wire_destroy"):
+            getattr(lib, n).argtypes = [ctypes.c_void_p]
+        lib.pt_wire_port.restype = ctypes.c_int
+        lib.pt_wire_port.argtypes = [ctypes.c_void_p]
+        _wire_lib = lib
+    return _wire_lib
+
+
+class NativeWire:
+    def __init__(self, server):
+        self._srv = server
+        self._lib = _load()
+        tl = _table._load()
+        port_out = ctypes.c_int(0)
+        # dense pushes run natively ONLY in pure-async mode; sync (0),
+        # half-async (2) and GEO (3) defer to the Python round machinery
+        async_dense = (not server.sync_mode) and server.mode == 1
+        self._h = self._lib.pt_wire_create(
+            server.host.encode(), int(server.port),
+            1 if async_dense else 0, ctypes.byref(port_out))
+        if not self._h:
+            raise RuntimeError(
+                f"native wire bind failed on {server.host}:{server.port}")
+        server.port = port_out.value
+        self._lib.pt_wire_set_table_fns(self._h, *[
+            ctypes.cast(getattr(tl, n), ctypes.c_void_p)
+            for n in ("pt_set_lr", "pt_pull_dense", "pt_push_dense",
+                      "pt_set_dense", "pt_pull_sparse", "pt_push_sparse")])
+        # the callback object must outlive the server: C++ threads call it
+        self._cb = _DEFER_CB(self._deferred)
+        self._lib.pt_wire_set_deferred(self._h, self._cb)
+        self._stopped = False
+
+    def register(self, name: str, st) -> None:
+        t = st.table
+        if isinstance(t, _table.DenseTable):
+            shape = (ctypes.c_int64 * max(len(t.shape), 1))(*(t.shape
+                                                              or (1,)))
+            self._lib.pt_wire_register(
+                self._h, name.encode(), ctypes.c_void_p(t._h), 0, t.size,
+                shape, len(t.shape) or 1, 1 if t.initialized else 0)
+        else:
+            shape = (ctypes.c_int64 * 1)(0)
+            self._lib.pt_wire_register(
+                self._h, name.encode(), ctypes.c_void_p(t._h), 1, t.dim,
+                shape, 0, 1)
+
+    def mark_initialized(self, name: str) -> bool:
+        return bool(self._lib.pt_wire_mark_initialized(self._h,
+                                                       name.encode()))
+
+    def start(self) -> None:
+        self._lib.pt_wire_start(self._h)
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._lib.pt_wire_stop(self._h)
+
+    def _deferred(self, frame_ptr, frame_len, resp_ptr, cap) -> int:
+        from . import ps_server as W
+
+        try:
+            raw = ctypes.string_at(frame_ptr, frame_len)
+            msg = W.decode_msg(raw)
+            if msg is None:
+                raise ConnectionError("truncated deferred frame")
+            reply = self._srv._handle_deferred(msg)
+            out = W.encode_msg(reply)
+            if len(out) > cap:
+                out = W.encode_msg({"status": "error",
+                                    "error": "deferred reply too large"})
+            ctypes.memmove(resp_ptr, out, len(out))
+            return len(out)
+        except Exception as e:  # the C++ thread cannot take an exception
+            try:
+                out = W.encode_msg({"status": "error", "error": repr(e)})
+                if len(out) <= cap:
+                    ctypes.memmove(resp_ptr, out, len(out))
+                    return len(out)
+            except Exception:
+                pass
+            return -1

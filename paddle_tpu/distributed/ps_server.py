@@ -39,9 +39,9 @@ _THDR = struct.Struct("<HBBQ")
 _MAX_FRAME = 1 << 34            # 16 GiB sanity bound on declared lengths
 
 
-def send_msg(sock: socket.socket, obj: dict) -> None:
-    """Serialize a flat dict of JSON scalars + ndarrays (VariableMessage
-    framing: header describes, raw buffers follow)."""
+def _build_frame(obj: dict):
+    """Shared serializer: returns (frame_header, json_header, parts) where
+    parts holds tensor metas (bytes) and zero-copy array views."""
     scalars, tensors = {}, []
     for k, v in obj.items():
         if isinstance(v, np.ndarray):
@@ -64,6 +64,13 @@ def send_msg(sock: socket.socket, obj: dict) -> None:
         total += len(meta) + arr.nbytes
     frame = _FRAME.pack(_MAGIC, _VERSION, len(tensors), len(hdr),
                         len(hdr) + total)
+    return frame, hdr, parts
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    """Serialize a flat dict of JSON scalars + ndarrays (VariableMessage
+    framing: header describes, raw buffers follow)."""
+    frame, hdr, parts = _build_frame(obj)
     # ONE gather-send for the whole message: the old frame/header/meta
     # sendall sequence emitted several tiny TCP segments before the bulk
     # buffers, and Nagle + delayed ACK stalled each message ~40 ms (found
@@ -146,6 +153,32 @@ def _recv_exact(sock, n):
     return buf          # writable: np.frombuffer views stay mutable
 
 
+class _BytesConn:
+    """recv_into-compatible reader over a captured frame (the native wire
+    hands deferred messages to Python as raw bytes)."""
+
+    def __init__(self, data):
+        self._d = memoryview(data)
+        self._o = 0
+
+    def recv_into(self, view, n):
+        n = min(n, len(self._d) - self._o)
+        view[:n] = self._d[self._o:self._o + n]
+        self._o += n
+        return n
+
+
+def decode_msg(data) -> Optional[dict]:
+    """Parse one complete frame from bytes (same checks as recv_msg)."""
+    return recv_msg(_BytesConn(data))
+
+
+def encode_msg(obj: dict) -> bytes:
+    """Serialize one frame to bytes (same layout send_msg writes)."""
+    frame, hdr, parts = _build_frame(obj)
+    return frame + hdr + b"".join(bytes(p) for p in parts)
+
+
 class _ParamState:
     def __init__(self, table):
         self.table = table
@@ -176,6 +209,7 @@ class ParameterServer:
         self._sock: Optional[socket.socket] = None
         self._threads = []
         self._completed_trainers = set()  # HeartBeatMonitor-style liveness
+        self._native = None               # native wire loop, when built
 
     # -- table config -------------------------------------------------------
     def register_dense(self, name: str, shape, optimizer="sgd", lr=0.01,
@@ -183,16 +217,38 @@ class ParameterServer:
         if name not in self.params:
             self.params[name] = _ParamState(
                 DenseTable(shape, optimizer, lr, **hparams))
+            if self._native is not None:
+                self._native.register(name, self.params[name])
 
     def register_sparse(self, name: str, dim: int, optimizer="sgd", lr=0.01,
                         **hparams):
         if name not in self.params:
             self.params[name] = _ParamState(
                 SparseTable(dim, optimizer, lr, **hparams))
+            if self._native is not None:
+                self._native.register(name, self.params[name])
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
-        """Bind + serve on a background thread; returns once listening."""
+        """Bind + serve; returns once listening.
+
+        Transport: the native C++ wire loop (native/ps_wire.cpp — hot
+        commands GIL-free against the C++ tables, control commands
+        deferred back here) when it builds, else the Python
+        thread-per-connection loop."""
+        from . import native_wire
+
+        if native_wire.enabled():
+            try:
+                self._native = native_wire.NativeWire(self)
+                for name, st in self.params.items():
+                    self._native.register(name, st)
+                self._native.start()
+                return self
+            except Exception as e:
+                print(f"[ps_server] native wire unavailable "
+                      f"({type(e).__name__}: {e}); Python transport")
+                self._native = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))
@@ -206,12 +262,15 @@ class ParameterServer:
 
     def serve_forever(self):
         """Blocking serve — what the listen_and_serv host op calls."""
-        if self._sock is None:
+        if self._native is None and self._sock is None:
             self.start()
         self._stop.wait()
 
     def stop(self):
         self._stop.set()
+        if self._native is not None:
+            self._native.stop()
+            return
         try:
             if self._sock is not None:
                 # unblock accept
@@ -256,6 +315,27 @@ class ParameterServer:
                     return
         finally:
             conn.close()
+
+    def _handle_deferred(self, msg):
+        """Entry point for control frames the native wire hands back.
+
+        init_param only defers on a dtype/size mismatch; the first-value-
+        wins flag lives in the native table registry, so consult it before
+        writing (a racing native init may have won already)."""
+        try:
+            if msg.get("cmd") == "init_param" and self._native is not None:
+                name = msg.get("param")
+                st = self.params.get(name)
+                if st is None:
+                    return {"status": "error",
+                            "error": f"unknown param {name!r}"}
+                if self._native.mark_initialized(name):
+                    with st.lock:
+                        st.table.set(msg["value"])
+                return {"status": "ok", "initialized": True}
+            return self._handle(msg)
+        except Exception as e:
+            return {"status": "error", "error": repr(e)}
 
     # -- request handlers (request_handler_impl.cc parity) -----------------
     def _handle(self, msg):

@@ -1,0 +1,572 @@
+// Native PS wire loop — the C++ transport for the parameter server.
+//
+// The reference serves PS traffic through gRPC/BRPC C++ services
+// (operators/distributed/grpc/grpc_server.cc, grpc_serde.cc zero-copy
+// serde); the Python thread-per-connection loop in ps_server.py is GIL-
+// bound under many trainers.  This library owns the listen socket and the
+// connection threads in C++ and executes the HOT commands (ping,
+// init_param, pull, async push, pull_sparse, push_sparse) directly
+// against the ps_table.cpp handles — no GIL, single copy in, single
+// gather-write out.  Control-plane commands (barriers, sync-mode
+// accumulation rounds, GEO deltas, save, stop) DEFER to a registered
+// Python callback with the raw frame; ctypes re-acquires the GIL for it.
+//
+// Frame layout (must match ps_server.py):
+//   magic 'PT' (2) | ver (1) | ntensor (1) | json_len u32 | total u64
+//   json header bytes
+//   per tensor: name_len u16 | dtype_len u8 | ndim u8 | data_len u64 |
+//               name | dtype descr | shape i64*ndim | data
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+// ps_table.cpp C ABI (same process, resolved at link of the python side via
+// two dlopens — declare here and link lazily through dlsym-free extern
+// references is not possible across .so files, so the wire library gets the
+// table entry points injected at registration time instead).
+typedef void (*pt_set_lr_fn)(void*, float);
+typedef void (*pt_pull_dense_fn)(void*, float*, int64_t);
+typedef void (*pt_push_dense_fn)(void*, const float*, int64_t);
+typedef void (*pt_set_dense_fn)(void*, const float*, int64_t);
+typedef void (*pt_pull_sparse_fn)(void*, const uint64_t*, int64_t, float*);
+typedef void (*pt_push_sparse_fn)(void*, const uint64_t*, int64_t,
+                                  const float*);
+
+namespace {
+
+constexpr uint64_t kMaxFrame = 1ull << 34;      // mirror _MAX_FRAME
+constexpr int64_t kMaxNativeJson = 1 << 20;     // defer bigger headers
+
+struct TableRef {
+  void* handle = nullptr;
+  int kind = 0;           // 0 dense, 1 sparse
+  int64_t size = 0;       // dense element count
+  int64_t dim = 0;        // sparse row width
+  std::vector<int64_t> shape;   // dense pull reply shape
+  std::atomic<bool> initialized{false};
+  std::mutex op_mu;   // serializes set_lr+push pairs (python st.lock parity)
+};
+
+// Python callback: handles one raw frame, writes the response frame into
+// resp (capacity cap); returns resp length, or -1 on "cannot handle".
+typedef int64_t (*defer_cb)(const uint8_t* frame, int64_t frame_len,
+                            uint8_t* resp, int64_t cap);
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  // dense pushes run natively ONLY in pure-async mode (mode 1): sync (0),
+  // half-async (2) and GEO (3) need the Python round/averaging machinery
+  bool async_dense = false;
+  std::atomic<bool> stop{false};
+  defer_cb deferred = nullptr;
+  std::mutex mu;  // protects tables map
+  std::unordered_map<std::string, TableRef*> tables;
+  std::thread acceptor;
+  // table entry points injected from the python side (both .so are loaded
+  // in the same process; ctypes hands us the function addresses)
+  pt_set_lr_fn set_lr = nullptr;
+  pt_pull_dense_fn pull_dense = nullptr;
+  pt_push_dense_fn push_dense = nullptr;
+  pt_set_dense_fn set_dense = nullptr;
+  pt_pull_sparse_fn pull_sparse = nullptr;
+  pt_push_sparse_fn push_sparse = nullptr;
+};
+
+bool recv_exact(int fd, uint8_t* buf, int64_t n) {
+  int64_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += r;
+  }
+  return true;
+}
+
+bool send_all(int fd, const uint8_t* buf, int64_t n) {
+  int64_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += r;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Minimal flat-JSON reader: enough for the wire's control headers
+// ({"cmd":"push","param":"w","lr":0.01,"trainer_id":0}).  Anything it cannot
+// parse makes the caller defer to Python.
+// ---------------------------------------------------------------------------
+struct JsonView {
+  std::unordered_map<std::string, std::string> strs;
+  std::unordered_map<std::string, double> nums;
+  std::unordered_map<std::string, bool> nulls;  // key present with null
+  bool ok = false;
+};
+
+JsonView parse_flat_json(const uint8_t* p, int64_t n) {
+  JsonView out;
+  int64_t i = 0;
+  auto skip_ws = [&] { while (i < n && (p[i] == ' ' || p[i] == '\t')) ++i; };
+  auto parse_string = [&](std::string* s) -> bool {
+    if (i >= n || p[i] != '"') return false;
+    ++i;
+    s->clear();
+    while (i < n && p[i] != '"') {
+      if (p[i] == '\\') {           // minimal escape handling
+        if (i + 1 >= n) return false;
+        ++i;
+        char c = static_cast<char>(p[i]);
+        if (c == 'u') return false;  // \uXXXX: defer to python's real parser
+        if (c == 'n') s->push_back('\n');
+        else if (c == 't') s->push_back('\t');
+        else s->push_back(c);       // \" \\ \/ and friends
+      } else {
+        s->push_back(static_cast<char>(p[i]));
+      }
+      ++i;
+    }
+    if (i >= n) return false;
+    ++i;  // closing quote
+    return true;
+  };
+  skip_ws();
+  if (i >= n || p[i] != '{') return out;
+  ++i;
+  skip_ws();
+  if (i < n && p[i] == '}') { out.ok = true; return out; }
+  while (i < n) {
+    skip_ws();
+    std::string key;
+    if (!parse_string(&key)) return out;
+    skip_ws();
+    if (i >= n || p[i] != ':') return out;
+    ++i;
+    skip_ws();
+    if (i < n && p[i] == '"') {
+      std::string val;
+      if (!parse_string(&val)) return out;
+      out.strs[key] = std::move(val);
+    } else if (i + 3 < n && std::memcmp(p + i, "null", 4) == 0) {
+      out.nulls[key] = true;
+      i += 4;
+    } else if (i + 3 < n && std::memcmp(p + i, "true", 4) == 0) {
+      out.nums[key] = 1.0;
+      i += 4;
+    } else if (i + 4 < n && std::memcmp(p + i, "false", 5) == 0) {
+      out.nums[key] = 0.0;
+      i += 5;
+    } else {
+      // number
+      char* end = nullptr;
+      std::string tail(reinterpret_cast<const char*>(p + i),
+                       static_cast<size_t>(std::min<int64_t>(n - i, 64)));
+      double v = std::strtod(tail.c_str(), &end);
+      if (end == tail.c_str()) return out;   // nested object/array etc.
+      out.nums[key] = v;
+      i += end - tail.c_str();
+    }
+    skip_ws();
+    if (i < n && p[i] == ',') { ++i; continue; }
+    if (i < n && p[i] == '}') { out.ok = true; return out; }
+    return out;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Frame reading/writing
+// ---------------------------------------------------------------------------
+#pragma pack(push, 1)
+struct FrameHdr {
+  char magic[2];
+  uint8_t ver;
+  uint8_t ntensor;
+  uint32_t json_len;
+  uint64_t total_len;
+};
+struct TensorHdr {
+  uint16_t name_len;
+  uint8_t dt_len;
+  uint8_t ndim;
+  uint64_t data_len;
+};
+#pragma pack(pop)
+
+struct Tensor {
+  std::string name;
+  std::string descr;
+  std::vector<int64_t> shape;
+  int64_t offset = 0;   // into the frame body buffer
+  int64_t nbytes = 0;
+};
+
+struct Frame {
+  FrameHdr hdr;
+  std::vector<uint8_t> body;      // json + tensor sections
+  JsonView json;
+  std::vector<Tensor> tensors;
+  bool ok = false;
+};
+
+bool read_frame(int fd, Frame* f) {
+  if (!recv_exact(fd, reinterpret_cast<uint8_t*>(&f->hdr), sizeof(FrameHdr)))
+    return false;
+  if (std::memcmp(f->hdr.magic, "PT", 2) != 0 || f->hdr.ver != 1) return false;
+  if (f->hdr.json_len > kMaxFrame || f->hdr.total_len > kMaxFrame) return false;
+  if (f->hdr.total_len < f->hdr.json_len) return false;
+  f->body.resize(f->hdr.total_len);
+  if (!recv_exact(fd, f->body.data(), (int64_t)f->hdr.total_len)) return false;
+  int64_t off = f->hdr.json_len;
+  for (int t = 0; t < f->hdr.ntensor; ++t) {
+    if (off + (int64_t)sizeof(TensorHdr) > (int64_t)f->body.size())
+      return false;
+    TensorHdr th;
+    std::memcpy(&th, f->body.data() + off, sizeof(TensorHdr));
+    off += sizeof(TensorHdr);
+    if (th.data_len > kMaxFrame) return false;  // guards the i64 casts below
+    int64_t meta = th.name_len + th.dt_len + 8ll * th.ndim;
+    if (off + meta + (int64_t)th.data_len > (int64_t)f->body.size())
+      return false;
+    Tensor tz;
+    tz.name.assign(reinterpret_cast<char*>(f->body.data() + off),
+                   th.name_len);
+    tz.descr.assign(
+        reinterpret_cast<char*>(f->body.data() + off + th.name_len),
+        th.dt_len);
+    tz.shape.resize(th.ndim);
+    std::memcpy(tz.shape.data(),
+                f->body.data() + off + th.name_len + th.dt_len,
+                8ll * th.ndim);
+    tz.offset = off + meta;
+    tz.nbytes = (int64_t)th.data_len;
+    f->tensors.push_back(std::move(tz));
+    off += meta + th.data_len;
+  }
+  if (off != (int64_t)f->body.size()) return false;
+  f->ok = true;
+  return true;
+}
+
+void append_tensor(std::vector<uint8_t>* out, const char* name,
+                   const char* descr, const std::vector<int64_t>& shape,
+                   const uint8_t* data, int64_t nbytes) {
+  TensorHdr th;
+  th.name_len = (uint16_t)std::strlen(name);
+  th.dt_len = (uint8_t)std::strlen(descr);
+  th.ndim = (uint8_t)shape.size();
+  th.data_len = (uint64_t)nbytes;
+  size_t base = out->size();
+  out->resize(base + sizeof(TensorHdr) + th.name_len + th.dt_len +
+              8 * shape.size() + nbytes);
+  uint8_t* p = out->data() + base;
+  std::memcpy(p, &th, sizeof(TensorHdr));
+  p += sizeof(TensorHdr);
+  std::memcpy(p, name, th.name_len);
+  p += th.name_len;
+  std::memcpy(p, descr, th.dt_len);
+  p += th.dt_len;
+  std::memcpy(p, shape.data(), 8 * shape.size());
+  p += 8 * shape.size();
+  if (nbytes) std::memcpy(p, data, nbytes);
+}
+
+bool send_frame(int fd, const std::string& json,
+                const std::vector<uint8_t>& tensor_section, int ntensor) {
+  FrameHdr h;
+  h.magic[0] = 'P';
+  h.magic[1] = 'T';
+  h.ver = 1;
+  h.ntensor = (uint8_t)ntensor;
+  h.json_len = (uint32_t)json.size();
+  h.total_len = json.size() + tensor_section.size();
+  std::vector<uint8_t> head(sizeof(FrameHdr) + json.size());
+  std::memcpy(head.data(), &h, sizeof(FrameHdr));
+  std::memcpy(head.data() + sizeof(FrameHdr), json.data(), json.size());
+  if (!send_all(fd, head.data(), (int64_t)head.size())) return false;
+  if (!tensor_section.empty() &&
+      !send_all(fd, tensor_section.data(), (int64_t)tensor_section.size()))
+    return false;
+  return true;
+}
+
+bool send_status(int fd, const char* status, const char* err = nullptr) {
+  std::string j = std::string("{\"status\":\"") + status + "\"";
+  if (err) j += std::string(",\"error\":\"") + err + "\"";
+  j += "}";
+  return send_frame(fd, j, {}, 0);
+}
+
+// ---------------------------------------------------------------------------
+// Connection servicing
+// ---------------------------------------------------------------------------
+bool defer_to_python(Server* s, int fd, const Frame& f) {
+  if (!s->deferred) return send_status(fd, "error", "no deferred handler");
+  // rebuild the full frame bytes for the python handler
+  std::vector<uint8_t> full(sizeof(FrameHdr) + f.body.size());
+  std::memcpy(full.data(), &f.hdr, sizeof(FrameHdr));
+  std::memcpy(full.data() + sizeof(FrameHdr), f.body.data(), f.body.size());
+  // control responses are small; pulls/pushes never defer with big bodies
+  std::vector<uint8_t> resp(1 << 22);
+  int64_t n = s->deferred(full.data(), (int64_t)full.size(), resp.data(),
+                          (int64_t)resp.size());
+  if (n < 0) return send_status(fd, "error", "deferred handler failed");
+  return send_all(fd, resp.data(), n);
+}
+
+const Tensor* find_tensor(const Frame& f, const char* name) {
+  for (auto& t : f.tensors)
+    if (t.name == name) return &t;
+  return nullptr;
+}
+
+bool handle_frame(Server* s, int fd, Frame& f) {
+  if ((int64_t)f.hdr.json_len > kMaxNativeJson)
+    return defer_to_python(s, fd, f);
+  f.json = parse_flat_json(f.body.data(), f.hdr.json_len);
+  if (!f.json.ok) return defer_to_python(s, fd, f);
+  auto it = f.json.strs.find("cmd");
+  if (it == f.json.strs.end()) return defer_to_python(s, fd, f);
+  const std::string& cmd = it->second;
+
+  if (cmd == "ping") return send_status(fd, "ok");
+
+  static const char* kNative[] = {"init_param", "pull", "push",
+                                  "pull_sparse", "push_sparse"};
+  bool native = false;
+  for (auto* c : kNative) native |= (cmd == c);
+  if (!native) return defer_to_python(s, fd, f);
+
+  auto pit = f.json.strs.find("param");
+  if (pit == f.json.strs.end()) return defer_to_python(s, fd, f);
+  TableRef* tr = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    auto t = s->tables.find(pit->second);
+    if (t != s->tables.end()) tr = t->second;
+  }
+  if (tr == nullptr)
+    return send_status(fd, "error", "unknown param");
+
+  if (cmd == "init_param") {
+    const Tensor* v = find_tensor(f, "value");
+    if (!v || tr->kind != 0 || v->descr != "<f4" ||
+        v->nbytes != tr->size * 4)
+      return defer_to_python(s, fd, f);
+    bool expected = false;
+    if (tr->initialized.compare_exchange_strong(expected, true)) {
+      s->set_dense(tr->handle,
+                   reinterpret_cast<const float*>(f.body.data() + v->offset),
+                   tr->size);
+    }
+    return send_frame(fd, "{\"status\":\"ok\",\"initialized\":true}", {}, 0);
+  }
+  if (cmd == "pull") {
+    if (tr->kind != 0) return defer_to_python(s, fd, f);
+    std::vector<uint8_t> section;
+    std::vector<uint8_t> data(tr->size * 4);
+    s->pull_dense(tr->handle, reinterpret_cast<float*>(data.data()),
+                  tr->size);
+    append_tensor(&section, "value", "<f4", tr->shape, data.data(),
+                  (int64_t)data.size());
+    return send_frame(fd, "{\"status\":\"ok\",\"version\":0}", section, 1);
+  }
+  if (cmd == "push") {
+    // only pure-async dense pushes run natively; sync/half-async/GEO use
+    // the Python accumulation-round machinery
+    if (!s->async_dense || tr->kind != 0) return defer_to_python(s, fd, f);
+    const Tensor* g = find_tensor(f, "value");
+    if (!g || g->descr != "<f4" || g->nbytes != tr->size * 4)
+      return defer_to_python(s, fd, f);
+    std::lock_guard<std::mutex> lk(tr->op_mu);   // lr+push atomic pair
+    auto lr = f.json.nums.find("lr");
+    if (lr != f.json.nums.end()) s->set_lr(tr->handle, (float)lr->second);
+    s->push_dense(tr->handle,
+                  reinterpret_cast<const float*>(f.body.data() + g->offset),
+                  tr->size);
+    return send_status(fd, "ok");
+  }
+  if (cmd == "pull_sparse") {
+    const Tensor* k = find_tensor(f, "keys");
+    if (!k || tr->kind != 1 || k->descr != "<u8")
+      return defer_to_python(s, fd, f);
+    int64_t nkeys = k->nbytes / 8;
+    std::vector<uint8_t> data(nkeys * tr->dim * 4);
+    s->pull_sparse(tr->handle,
+                   reinterpret_cast<const uint64_t*>(f.body.data() +
+                                                     k->offset),
+                   nkeys, reinterpret_cast<float*>(data.data()));
+    std::vector<uint8_t> section;
+    append_tensor(&section, "value", "<f4", {nkeys, tr->dim}, data.data(),
+                  (int64_t)data.size());
+    return send_frame(fd, "{\"status\":\"ok\"}", section, 1);
+  }
+  if (cmd == "push_sparse") {
+    const Tensor* k = find_tensor(f, "keys");
+    const Tensor* g = find_tensor(f, "value");
+    if (!k || !g || tr->kind != 1 || k->descr != "<u8" ||
+        g->descr != "<f4")
+      return defer_to_python(s, fd, f);
+    int64_t nkeys = k->nbytes / 8;
+    if (g->nbytes != nkeys * tr->dim * 4) return defer_to_python(s, fd, f);
+    std::lock_guard<std::mutex> lk(tr->op_mu);   // lr+push atomic pair
+    auto lr = f.json.nums.find("lr");
+    if (lr != f.json.nums.end()) s->set_lr(tr->handle, (float)lr->second);
+    s->push_sparse(tr->handle,
+                   reinterpret_cast<const uint64_t*>(f.body.data() +
+                                                     k->offset),
+                   nkeys,
+                   reinterpret_cast<const float*>(f.body.data() + g->offset));
+    return send_status(fd, "ok");
+  }
+  return defer_to_python(s, fd, f);
+}
+
+void serve_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (!s->stop.load()) {
+    Frame f;
+    if (!read_frame(fd, &f)) break;
+    if (!handle_frame(s, fd, f)) break;
+    // stop command: the deferred python handler flips s->stop
+    if (s->stop.load()) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* s) {
+  while (!s->stop.load()) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stop.load()) break;
+      continue;
+    }
+    // detached: connection threads reap themselves on exit (an unbounded
+    // joinable-handle list would leak across reconnect/backoff churn)
+    std::thread(serve_conn, s, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create + bind + listen; returns the server handle, fills *port_out.
+void* pt_wire_create(const char* host, int port, int async_dense,
+                     int* port_out) {
+  auto* s = new Server();
+  s->async_dense = async_dense != 0;
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) { delete s; return nullptr; }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = host && *host ? inet_addr(host) : INADDR_ANY;
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  if (port_out) *port_out = s->port;
+  return s;
+}
+
+void pt_wire_set_table_fns(void* h, void* set_lr, void* pull_dense,
+                           void* push_dense, void* set_dense,
+                           void* pull_sparse, void* push_sparse) {
+  auto* s = static_cast<Server*>(h);
+  s->set_lr = reinterpret_cast<pt_set_lr_fn>(set_lr);
+  s->pull_dense = reinterpret_cast<pt_pull_dense_fn>(pull_dense);
+  s->push_dense = reinterpret_cast<pt_push_dense_fn>(push_dense);
+  s->set_dense = reinterpret_cast<pt_set_dense_fn>(set_dense);
+  s->pull_sparse = reinterpret_cast<pt_pull_sparse_fn>(pull_sparse);
+  s->push_sparse = reinterpret_cast<pt_push_sparse_fn>(push_sparse);
+}
+
+void pt_wire_set_deferred(void* h, defer_cb cb) {
+  static_cast<Server*>(h)->deferred = cb;
+}
+
+void pt_wire_register(void* h, const char* name, void* table, int kind,
+                      int64_t size_or_dim, const int64_t* shape, int ndim,
+                      int initialized) {
+  auto* s = static_cast<Server*>(h);
+  auto* tr = new TableRef();
+  tr->handle = table;
+  tr->kind = kind;
+  if (kind == 0) tr->size = size_or_dim; else tr->dim = size_or_dim;
+  tr->shape.assign(shape, shape + ndim);
+  tr->initialized.store(initialized != 0);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->tables.find(name);
+  if (it != s->tables.end()) delete it->second;
+  s->tables[name] = tr;
+}
+
+int pt_wire_mark_initialized(void* h, const char* name) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  auto it = s->tables.find(name);
+  if (it == s->tables.end()) return 0;
+  bool expected = false;
+  return it->second->initialized.compare_exchange_strong(expected, true)
+             ? 1
+             : 0;
+}
+
+void pt_wire_start(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->acceptor = std::thread(accept_loop, s);
+}
+
+// Signal stop + close the listen socket; does NOT join from a connection
+// thread (the python stop handler runs inside one) — join happens in
+// pt_wire_destroy from the owner thread.
+void pt_wire_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop.store(true);
+  if (s->listen_fd >= 0) {
+    ::shutdown(s->listen_fd, SHUT_RDWR);
+    ::close(s->listen_fd);
+    s->listen_fd = -1;
+  }
+}
+
+// NOTE: the Server object is deliberately never freed while the process
+// lives — detached connection threads may still hold it; the per-server
+// footprint is a socket + table map. pt_wire_destroy exists for embedders
+// that can guarantee no connection threads remain.
+void pt_wire_destroy(void* h) {
+  auto* s = static_cast<Server*>(h);
+  pt_wire_stop(h);
+  if (s->acceptor.joinable()) s->acceptor.join();
+  std::lock_guard<std::mutex> lk(s->mu);
+  for (auto& kv : s->tables) delete kv.second;
+  delete s;
+}
+
+int pt_wire_port(void* h) { return static_cast<Server*>(h)->port; }
+
+}  // extern "C"

@@ -82,7 +82,7 @@ def test_understand_sentiment_imdb():
         for b in reader():
             _, a = exe.run(prog, feed=pad_batch(b),
                            fetch_list=[loss, acc], scope=scope)
-            accs.append(float(a))
+            accs.append(float(np.ravel(a)[0]))
     assert np.mean(accs[-8:]) > 0.85, np.mean(accs[-8:])
 
 

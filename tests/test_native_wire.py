@@ -1,0 +1,121 @@
+"""Native PS wire (native/ps_wire.cpp): transport parity with the Python
+loop, deferred control-command path, and the fallback switch.
+
+The whole PS battery (test_ps.py, fleet/geo/dgc, concurrency) already
+runs on the native wire by default; this file pins the specifics."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import ParameterServer, PSClient
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    PSClient.reset_all()
+    yield
+    PSClient.reset_all()
+
+
+def _server(**kw):
+    s = ParameterServer("127.0.0.1:0", **kw)
+    s.start()
+    return s, f"127.0.0.1:{s.port}"
+
+
+def test_native_wire_active_and_hot_commands():
+    srv, ep = _server(trainer_num=1, sync_mode=False, mode=1)
+    assert srv._native is not None, "native wire should build in this env"
+    srv.register_dense("w", [3, 4], lr=0.5)
+    try:
+        c = PSClient(trainer_id=0)
+        w0 = np.arange(12, dtype=np.float32).reshape(3, 4)
+        c.ensure_init(ep, "w", w0)
+        np.testing.assert_array_equal(c.pull(ep, "w"), w0)
+        c.push(ep, "w", np.ones((3, 4), np.float32), lr=0.5)
+        np.testing.assert_allclose(c.pull(ep, "w"), w0 - 0.5, rtol=1e-6)
+        # init is first-value-wins across the native path
+        c.ensure_init(ep, "w", np.zeros((3, 4), np.float32))
+        np.testing.assert_allclose(c.pull(ep, "w"), w0 - 0.5, rtol=1e-6)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_native_wire_sparse_and_deferred_control():
+    srv, ep = _server(trainer_num=2, sync_mode=False, mode=1)
+    srv.register_sparse("emb", dim=4, lr=1.0)
+    try:
+        c0 = PSClient(trainer_id=0)
+        keys = np.asarray([3, 9], np.uint64)
+        rows = c0.pull_sparse(ep, "emb", keys)
+        np.testing.assert_array_equal(rows, np.zeros((2, 4), np.float32))
+        c0.push_sparse(ep, "emb", keys, np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(c0.pull_sparse(ep, "emb", keys),
+                                   -np.ones((2, 4), np.float32))
+        # control commands (deferred to Python through the callback)
+        c1 = PSClient(trainer_id=1)
+        import threading
+        done = []
+
+        def other():
+            c1.barrier([ep], "b1")
+            done.append(True)
+
+        t = threading.Thread(target=other)
+        t.start()
+        c0.barrier([ep], "b1")
+        t.join(timeout=30)
+        assert done, "barrier through the deferred path deadlocked"
+        c0.complete([ep])
+        c1.complete([ep])
+        c0.close()
+        c1.close()
+    finally:
+        srv.stop()
+
+
+def test_python_fallback_parity(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PS_NATIVE_WIRE", "0")
+    srv, ep = _server(trainer_num=1, sync_mode=False, mode=1)
+    assert srv._native is None
+    srv.register_dense("w", [4], lr=0.25)
+    try:
+        c = PSClient(trainer_id=0)
+        c.ensure_init(ep, "w", np.ones(4, np.float32))
+        c.push(ep, "w", np.ones(4, np.float32), lr=0.25)
+        np.testing.assert_allclose(c.pull(ep, "w"),
+                                   np.full(4, 0.75, np.float32))
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_sync_mode_round_runs_through_deferred_push():
+    """Sync-mode dense pushes defer to the Python accumulation rounds —
+    two trainers must complete a round and see the averaged update."""
+    srv, ep = _server(trainer_num=2, sync_mode=True, mode=0)
+    srv.register_dense("w", [4], lr=1.0)
+    try:
+        c0, c1 = PSClient(trainer_id=0), PSClient(trainer_id=1)
+        c0.ensure_init(ep, "w", np.zeros(4, np.float32))
+        import threading
+        res = []
+
+        def push1():
+            c1.push(ep, "w", 3 * np.ones(4, np.float32), lr=1.0)
+            res.append(True)
+
+        t = threading.Thread(target=push1)
+        t.start()
+        c0.push(ep, "w", np.ones(4, np.float32), lr=1.0)
+        t.join(timeout=30)
+        assert res, "sync round never completed"
+        # sgd over the mean grad (1+3)/2 = 2 with lr 1.0
+        np.testing.assert_allclose(c0.pull(ep, "w"),
+                                   np.full(4, -2.0, np.float32), rtol=1e-6)
+        c0.close()
+        c1.close()
+    finally:
+        srv.stop()
