@@ -202,34 +202,60 @@ def push_box_extended_sparse_op(scope, op, exe):
     _box_push(scope, op, extended=True)
 
 
-@register_host_op("listen_and_serv")
-def listen_and_serv_op(scope, op, exe):
-    """listen_and_serv_op.cc: the pserver main loop.  Builds tables from the
-    transpiler-provided configs and serves until a stop RPC arrives."""
+def _build_and_serve(op, trainer_num, default_lr, mode, sync_mode):
+    """Shared pserver bring-up for listen_and_serv / fl_listen_and_serv:
+    construct from the transpiler table configs, start (native wire when
+    available), optionally block."""
     from .ps_server import ParameterServer
 
-    endpoint = op.attr("endpoint")
     server = ParameterServer(
-        endpoint,
-        trainer_num=int(op.attr("trainer_num", 1)),
-        sync_mode=bool(op.attr("sync_mode", True)),
-        mode=int(op.attr("mode", 0)),
+        op.attr("endpoint"),
+        trainer_num=trainer_num,
+        sync_mode=sync_mode,
+        mode=mode,
     )
     for tbl in op.attr("tables", []):
         if tbl.get("is_sparse"):
             server.register_sparse(tbl["name"], tbl["dim"],
                                    tbl.get("optimizer", "sgd"),
-                                   tbl.get("lr", 0.01),
+                                   tbl.get("lr", default_lr),
                                    **tbl.get("hparams", {}))
         else:
             server.register_dense(tbl["name"], tbl["shape"],
                                   tbl.get("optimizer", "sgd"),
-                                  tbl.get("lr", 0.01),
+                                  tbl.get("lr", default_lr),
                                   **tbl.get("hparams", {}))
     server.start()
     op._server = server  # for in-process tests / graceful shutdown
     if op.attr("blocking", True):
         server.serve_forever()
+    return server
+
+
+@register_host_op("listen_and_serv")
+def listen_and_serv_op(scope, op, exe):
+    """listen_and_serv_op.cc: the pserver main loop.  Builds tables from the
+    transpiler-provided configs and serves until a stop RPC arrives."""
+    _build_and_serve(op, trainer_num=int(op.attr("trainer_num", 1)),
+                     default_lr=0.01, mode=int(op.attr("mode", 0)),
+                     sync_mode=bool(op.attr("sync_mode", True)))
+
+
+@register_host_op("fl_listen_and_serv")
+def fl_listen_and_serv_op(scope, op, exe):
+    """fl_listen_and_serv_op.cc:246 — the federated-learning server loop.
+
+    The reference variant runs per-round barriers: clients fetch the
+    global model (get barrier), train locally, send updates (send
+    barrier), the server aggregates once per round over ``Fanin``
+    clients. That is exactly the sync accumulation-round machinery of
+    ParameterServer with trainer_num=Fanin: FedAvg emerges from clients
+    pushing (w_global - w_local) with lr=1 — the server applies
+    w -= mean(w_global - w_local) = mean(w_local)."""
+    _build_and_serve(op,
+                     trainer_num=int(op.attr("Fanin", op.attr("fanin", 1))),
+                     default_lr=1.0, mode=0,
+                     sync_mode=bool(op.attr("sync_mode", True)))
 
 
 @register_host_op("checkpoint_notify")

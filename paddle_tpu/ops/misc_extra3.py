@@ -6,8 +6,7 @@ cross_entropy_grad2 name alias.
 What remains absent after this batch is absent BY DESIGN: fusion_* /
 fused_* (XLA fusion), mkldnn/tensorrt/lite engines, nccl/gen_nccl_id
 (XLA collectives), run_program
-(dygraph partial programs stage through jax.jit directly), fl_listen_and_serv
-(federated), pyramid_hash/var_conv_2d (niche fused CPU kernels whose
+(dygraph partial programs stage through jax.jit directly), pyramid_hash/var_conv_2d (niche fused CPU kernels whose
 capability the generic op set covers; rank_attention/tree_conv/
 attention_lstm gained real lowerings after this batch).
 """

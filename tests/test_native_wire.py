@@ -119,3 +119,54 @@ def test_sync_mode_round_runs_through_deferred_push():
         c1.close()
     finally:
         srv.stop()
+
+
+def test_fl_listen_and_serv_fedavg_round():
+    """fl_listen_and_serv host op: a 2-client FedAvg round — clients
+    train locally, push (w_global - w_local) with lr=1, the server's
+    sync round averages to mean(w_local)."""
+    import threading
+
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    blk = main.global_block()
+    blk.append_op(type="fl_listen_and_serv", inputs={}, outputs={},
+                  attrs={"endpoint": "127.0.0.1:0", "Fanin": 2,
+                         "sync_mode": True, "blocking": False,
+                         "tables": [{"name": "w", "shape": [4],
+                                     "lr": 1.0}]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(main)
+    server = blk.ops[0]._server
+    ep = f"127.0.0.1:{server.port}"
+    try:
+        w_global = np.zeros(4, np.float32)
+        locals_ = [np.asarray([1, 2, 3, 4], np.float32),
+                   np.asarray([3, 2, 1, 0], np.float32)]
+
+        errs = []
+
+        def client(rank):
+            try:
+                c = PSClient(trainer_id=rank)
+                c.ensure_init(ep, "w", w_global)
+                c.push(ep, "w", w_global - locals_[rank], lr=1.0)
+                c.close()
+            except Exception as e:
+                errs.append((rank, e))
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+        assert not any(t.is_alive() for t in ts), "client thread hung"
+        c = PSClient(trainer_id=9)
+        got = c.pull(ep, "w")
+        np.testing.assert_allclose(got, (locals_[0] + locals_[1]) / 2,
+                                   rtol=1e-6)
+        c.close()
+    finally:
+        server.stop()

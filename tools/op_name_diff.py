@@ -20,7 +20,6 @@ BY_DESIGN = {
     "tensorrt_engine": "XLA is the inference compiler",
     "lite_engine": "XLA is the inference compiler",
     "fusion_group": "Pallas kernels (ops/pallas_kernels.py)",
-    "fl_listen_and_serv": "federated runtime out of scope",
     "run_program": "@declarative jit staging (dygraph/jit.py)",
     "read": "reader.py / dataset.py host feeding",
     "create_custom_reader": "reader.py decorators",
