@@ -355,12 +355,24 @@ class ListTransformer(ast.NodeTransformer):
             node.value = _jst_call("convert_list", [node.value])
         return node
 
+    def visit_Expr(self, node):
+        # statement-position append only: rewriting value-position appends
+        # would change `r = lst.append(v)` from None to the list
+        self.generic_visit(node)
+        v = node.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                and v.func.attr == "append" \
+                and isinstance(v.func.value, ast.Name) and not v.keywords:
+            node.value = _jst_call("convert_append",
+                                   [v.func.value] + v.args)
+        return node
+
     def visit_Call(self, node):
         self.generic_visit(node)
         f = node.func
-        if isinstance(f, ast.Attribute) and f.attr in ("append", "pop") \
+        if isinstance(f, ast.Attribute) and f.attr == "pop" \
                 and isinstance(f.value, ast.Name) and not node.keywords:
-            return _jst_call(f"convert_{f.attr}", [f.value] + node.args)
+            return _jst_call("convert_pop", [f.value] + node.args)
         return node
 
 

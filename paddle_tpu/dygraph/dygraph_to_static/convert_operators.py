@@ -85,7 +85,47 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, args=()):
                 is_leaf=lambda x: isinstance(x, VarBase))
         return run
 
-    out = lax.cond(_pred_value(pred), norm(true_fn), norm(false_fn), None)
+    def _placeholder(v):
+        return v is None or isinstance(v, _Undefined)
+
+    try:
+        if any(_placeholder(_unwrap(a)) for a in args):
+            raise TypeError("placeholder branch inputs")  # select fallback
+        out = lax.cond(_pred_value(pred), norm(true_fn), norm(false_fn),
+                       None)
+    except (TypeError, UnboundLocalError):
+        # branch pytrees disagree — the one-armed-return / one-sided-
+        # assignment shape: one branch produced a tensor where the other
+        # left None/UNDEFINED.  Fall back to leaf-wise select over BOTH
+        # branch results: a placeholder leaf takes the other side's value
+        # (it is only ever read behind the matching flag, so it is never
+        # observed).  Valid for the pure generated branch functions; user
+        # side effects would run for both arms — same as XLA's cond
+        # on-device anyway.
+        t_out = norm(true_fn)(None)
+        f_out = norm(false_fn)(None)
+        t_leaves = list(t_out) if isinstance(t_out, (list, tuple)) else [t_out]
+        f_leaves = list(f_out) if isinstance(f_out, (list, tuple)) else [f_out]
+        if len(t_leaves) != len(f_leaves):
+            raise
+        p = _pred_value(pred)
+        sel = []
+        for tv, fv in zip(t_leaves, f_leaves):
+            if _placeholder(tv):
+                sel.append(fv)
+            elif _placeholder(fv):
+                sel.append(tv)
+            elif jnp.shape(tv) == jnp.shape(fv):   # () for python scalars
+                sel.append(jnp.where(p, tv, fv))
+            else:
+                raise TypeError(
+                    "dygraph_to_static: branches of a traced `if` produced "
+                    f"incompatible shapes {jnp.shape(tv)} vs "
+                    f"{jnp.shape(fv)}; a one-armed return under a traced "
+                    "predicate must yield the same shape as the "
+                    "fall-through value")
+        out = type(t_out)(sel) if isinstance(t_out, (list, tuple)) \
+            else sel[0]
     return jax.tree.map(
         lambda o: VarBase(o, stop_gradient=True)
         if hasattr(o, "shape") else o, out)

@@ -520,8 +520,9 @@ void pt_wire_register(void* h, const char* name, void* table, int kind,
   tr->shape.assign(shape, shape + ndim);
   tr->initialized.store(initialized != 0);
   std::lock_guard<std::mutex> lk(s->mu);
-  auto it = s->tables.find(name);
-  if (it != s->tables.end()) delete it->second;
+  // re-registration LEAKS the old TableRef deliberately: a connection
+  // thread may still hold the raw pointer it copied out under the lock —
+  // deleting here would be a use-after-free on the GIL-free hot path
   s->tables[name] = tr;
 }
 

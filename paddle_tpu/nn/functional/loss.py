@@ -70,12 +70,42 @@ def l1_loss(input, label, reduction="mean"):
 def nll_loss(input, label, weight=None, ignore_index=-100,
              reduction="mean"):
     """Negative log-likelihood over log-probability input (reference
-    functional nll_loss semantics, flattened index gather)."""
-    picked = dispatch("index_sample", {"X": input, "Index": label})
+    functional nll_loss semantics): ignored labels contribute zero loss
+    and 'mean' divides by the non-ignored count (torch/NLLLoss
+    contract)."""
+    lbl_f = dispatch("cast", {"X": label}, {"out_dtype": "float32"},
+                     out_dtypes="float32")
+    valid = dispatch("not_equal",
+                     {"X": lbl_f,
+                      "Y": dispatch("fill_any_like", {"X": lbl_f},
+                                    {"value": float(ignore_index)})},
+                     out_dtypes="bool")
+    valid = dispatch("cast", {"X": valid}, {"out_dtype": "float32"},
+                     out_dtypes="float32")
+    # clip the label into range so the ignored rows' gather stays in
+    # bounds (their loss is zeroed by the mask anyway)
+    nclass = int(input.shape[-1])
+    safe = dispatch("clip", {"X": lbl_f}, {"min": 0.0,
+                                           "max": float(nclass - 1)})
+    safe = dispatch("cast", {"X": safe}, {"out_dtype": "int64"},
+                    out_dtypes="int64")
+    picked = dispatch("index_sample", {"X": input, "Index": safe})
     loss = dispatch("scale", {"X": picked}, {"scale": -1.0})
     if weight is not None:
-        w = dispatch("gather", {"X": weight, "Index": label})
+        w = dispatch("gather", {"X": weight, "Index": safe})
         loss = dispatch("elementwise_mul", {"X": loss, "Y": w}, {"axis": -1})
+        valid = dispatch("elementwise_mul", {"X": valid, "Y": w},
+                         {"axis": -1})
+    loss = dispatch("elementwise_mul", {"X": loss, "Y": valid}, {"axis": -1})
+    if reduction == "mean":
+        total = dispatch("reduce_sum", {"X": loss},
+                         {"dim": [], "keep_dim": False, "reduce_all": True})
+        denom = dispatch("reduce_sum", {"X": valid},
+                         {"dim": [], "keep_dim": False, "reduce_all": True})
+        denom = dispatch("clip", {"X": denom}, {"min": 1.0,
+                                                "max": float("inf")})
+        return dispatch("elementwise_div", {"X": total, "Y": denom},
+                        {"axis": -1})
     return _reduce(loss, reduction)
 
 

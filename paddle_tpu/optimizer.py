@@ -82,6 +82,7 @@ class _EagerOptBlock:
 
     def append_op(self, type, inputs, outputs, attrs=None):
         from .framework.registry import LowerCtx, _FakeOp, get_op_spec
+        from .tensor._dispatch import _next_eager_key
 
         ins = {slot: [self.resolve(v) for v in vs]
                for slot, vs in inputs.items() if vs}
@@ -92,7 +93,10 @@ class _EagerOptBlock:
                               for s, v in ins.items()},
                        out_names, dict(attrs or {}), None)
         spec = get_op_spec(type)
-        outs = spec.lower(LowerCtx(None, None, {}), fake, ins)
+        # stepped rng: rng-consuming optimizer ops (dpsgd's DP noise) must
+        # draw FRESH randomness each eager step, like the executor stream
+        outs = spec.lower(LowerCtx(None, None, {},
+                                   rng_key=_next_eager_key()), fake, ins)
         for slot, vs in outputs.items():
             vals = outs.get(slot)
             if vals is None:

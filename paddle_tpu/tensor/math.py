@@ -160,7 +160,15 @@ def logsumexp(x, dim=None, keepdim=False, out=None, name=None):
     m = _reduce("reduce_max", x, dim, True)
     shifted = dispatch("elementwise_sub", {"X": x, "Y": m}, {"axis": -1})
     s = _reduce("reduce_sum", dispatch("exp", {"X": shifted}), dim, keepdim)
-    mk = m if keepdim else _reduce("reduce_max", x, dim, keepdim)
+    if keepdim:
+        mk = m
+    else:
+        # squeeze the kept dims of the max already computed (a second
+        # reduce_max over x would be a full extra reduction)
+        nd = len(x.shape)
+        dims = list(range(nd)) if dim is None else \
+            [d % nd for d in ([dim] if isinstance(dim, int) else list(dim))]
+        mk = dispatch("squeeze2", {"X": m}, {"axes": dims})
     return dispatch("elementwise_add",
                     {"X": dispatch("log", {"X": s}), "Y": mk}, {"axis": -1})
 

@@ -12,33 +12,32 @@ __all__ = ["argmax", "argmin", "argsort", "has_inf", "has_nan", "topk",
            "where", "index_select", "nonzero", "sort", "index_sample"]
 
 
-def argmax(input, axis=None, dtype=None, out=None, keepdims=False,
-           name=None):
-    """search.py:45 — axis=None flattens first (reference flatten+axis 0)."""
+def _arg_reduce(op_type, input, axis, dtype, keepdims):
     x = input
     if axis is None:
         x = dispatch("reshape2", {"X": x}, {"shape": [-1]})
         axis = 0
-    out = dispatch("arg_max", {"X": x}, {"axis": int(axis)},
+    out = dispatch(op_type, {"X": x}, {"axis": int(axis)},
                    out_dtypes="int64", stop_gradient=True)
+    if keepdims:
+        ax = int(axis) % max(len(x.shape), 1)
+        out = dispatch("unsqueeze2", {"X": out}, {"axes": [ax]},
+                       out_dtypes="int64", stop_gradient=True)
     if dtype is not None and str(dtype) not in ("int64",):
         out = dispatch("cast", {"X": out}, {"out_dtype": str(dtype)},
                        out_dtypes=str(dtype))
     return out
+
+
+def argmax(input, axis=None, dtype=None, out=None, keepdims=False,
+           name=None):
+    """search.py:45 — axis=None flattens first (reference flatten+axis 0)."""
+    return _arg_reduce("arg_max", input, axis, dtype, keepdims)
 
 
 def argmin(input, axis=None, dtype=None, out=None, keepdims=False,
            name=None):
-    x = input
-    if axis is None:
-        x = dispatch("reshape2", {"X": x}, {"shape": [-1]})
-        axis = 0
-    out = dispatch("arg_min", {"X": x}, {"axis": int(axis)},
-                   out_dtypes="int64", stop_gradient=True)
-    if dtype is not None and str(dtype) not in ("int64",):
-        out = dispatch("cast", {"X": out}, {"out_dtype": str(dtype)},
-                       out_dtypes=str(dtype))
-    return out
+    return _arg_reduce("arg_min", input, axis, dtype, keepdims)
 
 
 def argsort(input, axis=-1, descending=False, name=None):
@@ -55,9 +54,29 @@ def sort(input, axis=-1, descending=False, out=None, name=None):
 
 
 def topk(input, k, axis=-1, largest=True, sorted=True, name=None):
-    vals, idx = dispatch("top_k", {"X": input}, {"k": int(k)},
+    """Largest/smallest k along ``axis``: non-last axes transpose to the
+    back for the top_k op and back after; smallest-k negates in and out."""
+    nd = len(input.shape)
+    ax = int(axis) % nd if nd else 0
+    x = input
+    perm = None
+    if nd and ax != nd - 1:
+        perm = [i for i in range(nd) if i != ax] + [ax]
+        x = dispatch("transpose2", {"X": x}, {"axis": perm})
+    if not largest:
+        x = dispatch("scale", {"X": x}, {"scale": -1.0})
+    vals, idx = dispatch("top_k", {"X": x}, {"k": int(k)},
                          out_slots=("Out", "Indices"),
                          out_dtypes={"Out": None, "Indices": "int64"})
+    if not largest:
+        vals = dispatch("scale", {"X": vals}, {"scale": -1.0})
+    if perm is not None:
+        inv = [0] * nd
+        for i, p in enumerate(perm):
+            inv[p] = i
+        vals = dispatch("transpose2", {"X": vals}, {"axis": inv})
+        idx = dispatch("transpose2", {"X": idx}, {"axis": inv},
+                       out_dtypes="int64", stop_gradient=True)
     return vals, idx
 
 

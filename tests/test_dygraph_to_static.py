@@ -411,3 +411,38 @@ def test_tensor_array_bounded_append():
     assert int(size) == 2
     np.testing.assert_allclose(np.asarray(buf[:2]),
                                [[1.0, 1.0], [2.0, 2.0]])
+
+
+def test_one_armed_return_traced_predicate():
+    """VERDICT flagship case: `if traced: return ...` with a fall-through
+    — the select fallback must stage it (reference return_transformer)."""
+    import paddle_tpu as paddle
+
+    @declarative
+    def fn(x):
+        s = paddle.reduce_sum(x)
+        if s > 0:
+            return x * 2.0
+        y = x + 1.0
+        return y
+
+    with dg.guard():
+        np.testing.assert_allclose(
+            _np(fn(to_variable(np.ones((2,), "float32")))), [2.0, 2.0])
+        np.testing.assert_allclose(
+            _np(fn(to_variable(-np.ones((2,), "float32")))), [0.0, 0.0])
+
+
+def test_append_statement_semantics_preserved():
+    """`r = lst.append(v)` must stay None after conversion (only
+    statement-position appends are rewritten)."""
+    def fn(x):
+        lst = []
+        r = lst.append(x)
+        lst.append(x * 2.0)
+        return r, len(lst)
+
+    conv = convert_to_static(fn)
+    with dg.guard():
+        r, n = conv(to_variable(np.ones(2, "float32")))
+        assert r is None and n == 2

@@ -214,3 +214,38 @@ def test_incubate_complex_namespace():
     import pytest as _pt
     with _pt.raises(ValueError):
         cpx.trace(_np.ones((2, 2)))
+
+
+def test_nll_loss_ignore_index():
+    with dygraph.guard():
+        logp = np.log(np.asarray([[0.7, 0.3], [0.4, 0.6], [0.5, 0.5]],
+                                 "float32"))
+        lbl = np.asarray([[0], [-100], [1]], "int64")
+        out = nn.functional.nll_loss(dygraph.to_variable(logp),
+                                     dygraph.to_variable(lbl),
+                                     reduction="mean")
+        want = -(logp[0, 0] + logp[2, 1]) / 2     # ignored row excluded
+        np.testing.assert_allclose(float(np.asarray(out.value)), want,
+                                   rtol=1e-5)
+        none = nn.functional.nll_loss(dygraph.to_variable(logp),
+                                      dygraph.to_variable(lbl),
+                                      reduction="none")
+        assert float(np.asarray(none.value)[1]) == 0.0
+
+
+def test_dpsgd_eager_noise_steps():
+    """DP noise must be fresh each eager step (reference dpsgd_op.cc draws
+    per-invocation gaussian noise)."""
+    with dygraph.guard():
+        p = dygraph.to_variable(np.ones(8, "float32"))
+        opt = paddle.optimizer.DpsgdOptimizer(
+            0.1, clip=1.0, batch_size=1.0, sigma=0.5, parameter_list=[p])
+        deltas = []
+        for _ in range(2):
+            loss = paddle.reduce_sum(p * p)
+            loss.backward()
+            before = np.asarray(p.value).copy()
+            opt.minimize(loss)
+            p.clear_gradient()
+            deltas.append(np.asarray(p.value) - before)
+        assert not np.allclose(deltas[0], deltas[1])

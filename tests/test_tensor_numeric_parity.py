@@ -150,3 +150,20 @@ def test_logic_reduce_and_allclose():
     assert bool(_np(paddle.allclose(_v(a), _v(a + 1e-9))))
     ew = _np(paddle.elementwise_equal(_v(a), _v(a)))
     assert ew.dtype == np.bool_ and ew.all()
+
+
+def test_topk_largest_axis_args():
+    v, i = paddle.topk(_v([1.0, 5.0, 3.0]), 2, largest=False)
+    assert _np(v).tolist() == [1.0, 3.0]
+    assert _np(i).tolist() == [0, 2]
+    m = _v([[1.0, 9.0], [8.0, 2.0]])
+    v, i = paddle.topk(m, 1, axis=0)
+    assert _np(v).tolist() == [[8.0, 9.0]]
+    assert _np(i).tolist() == [[1, 0]]
+
+
+def test_argmax_keepdims():
+    m = _v([[1.0, 9.0], [8.0, 2.0]])
+    assert _np(paddle.argmax(m, axis=1, keepdims=True)).shape == (2, 1)
+    assert _np(paddle.argmin(m, axis=0, keepdims=True)).shape == (1, 2)
+    assert _np(paddle.argmax(m, axis=1)).shape == (2,)
