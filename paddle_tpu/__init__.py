@@ -198,8 +198,9 @@ from .tensor.creation import (  # noqa: F401,E402
     arange, create_tensor, crop_tensor, diag, eye, full, full_like,
     linspace, meshgrid, ones, ones_like, tril, triu, zeros, zeros_like,
 )
+from .tensor.creation import fill_constant  # noqa: F401,E402
 from .tensor.linalg import (  # noqa: F401,E402
-    bmm, cholesky, cross, dist, dot, histogram, matmul, t,
+    bmm, cholesky, cross, dist, dot, histogram, matmul, norm, t, transpose,
 )
 from .tensor.logic import (  # noqa: F401,E402
     allclose, elementwise_equal, equal, greater_equal, greater_than,
@@ -236,6 +237,11 @@ from .framework import (  # noqa: F401,E402
 from .dygraph.base import in_dygraph_mode as in_imperative_mode  # noqa: F401,E402
 
 # remaining fluid top-level utilities (reference fluid/__init__.py __all__)
+from . import compat  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from .layers.extras import Print  # noqa: E402,F401
+from .layers.nn import py_func  # noqa: E402,F401
+from .incubate import hapi  # noqa: E402,F401
 from . import debugger  # noqa: E402,F401
 from .dygraph.base import in_dygraph_mode  # noqa: E402,F401
 

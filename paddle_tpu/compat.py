@@ -1,0 +1,78 @@
+"""paddle.compat — py2/py3 compatibility helpers (reference
+python/paddle/compat.py:18-248). The framework is py3-only, so the text
+helpers are straightforward, but the public contract (in-place list/set
+mutation, banker's-rounding-free ``round``) is kept.
+"""
+import math
+
+__all__ = [
+    "long_type", "to_text", "to_bytes", "round", "floor_division",
+    "get_exception_message",
+]
+
+long_type = int  # py3: int subsumes py2 long (reference compat.py:24-33)
+
+
+def _map_inplace(obj, fn, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [fn(o) for o in obj]
+            return obj
+        return [fn(o) for o in obj]
+    if isinstance(obj, set):
+        new = {fn(o) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return fn(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert bytes (or a list/set of them) to str (reference
+    compat.py:36-117). None passes through; non-bytes are str()'d only if
+    they are str already (parity: reference raises on other types)."""
+    def one(x):
+        if x is None or isinstance(x, str):
+            return x
+        if isinstance(x, (bytes, bytearray)):
+            return x.decode(encoding)
+        raise TypeError(f"unsupported type {type(x)} for to_text")
+    return _map_inplace(obj, one, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert str (or a list/set of them) to bytes (reference
+    compat.py:120-190)."""
+    def one(x):
+        if x is None or isinstance(x, bytes):
+            return x
+        if isinstance(x, str):
+            return x.encode(encoding)
+        raise TypeError(f"unsupported type {type(x)} for to_bytes")
+    return _map_inplace(obj, one, inplace)
+
+
+def round(x, d=0):
+    """Half-away-from-zero rounding — python2 semantics, NOT py3 banker's
+    rounding (reference compat.py:193-216)."""
+    if x is None:
+        raise TypeError("round() does not accept None")
+    x = float(x)
+    p = 10 ** d
+    if x >= 0.0:
+        return float(math.floor(x * p + 0.5)) / p
+    return float(math.ceil(x * p - 0.5)) / p
+
+
+def floor_division(x, y):
+    """Explicit // (reference compat.py:219-233)."""
+    return x // y
+
+
+def get_exception_message(exc):
+    """Uniform message accessor (reference compat.py:236-248)."""
+    if exc is None:
+        raise TypeError("get_exception_message() does not accept None")
+    return str(exc)

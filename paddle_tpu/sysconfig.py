@@ -1,0 +1,23 @@
+"""paddle.sysconfig — install-layout introspection (reference
+python/paddle/sysconfig.py:17-41). The TPU build has no bundled C headers
+or shared libs for users to link against; the equivalents are the package
+include dir (for the native ctypes extensions under ``native/``) and the
+directory holding the built ``.so`` files.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of C headers shipped with the package (reference
+    sysconfig.py:20-34)."""
+    return os.path.join(_PKG, "native")
+
+
+def get_lib():
+    """Directory of the package's native shared libraries (reference
+    sysconfig.py:37-41)."""
+    return os.path.join(_PKG, "native")
