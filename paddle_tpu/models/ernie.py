@@ -129,10 +129,18 @@ def _ln(x, scale, bias, eps=1e-12):
 
 def _attention(q, k, v, pad_mask, cfg: ErnieConfig):
     """Bidirectional attention with padding mask. q,k,v [B,T,nh,hd]."""
-    if cfg.use_flash and pad_mask is None:
+    if cfg.use_flash:
         from ..ops.pallas_kernels import flash_attention
 
-        return flash_attention(q, k, v, causal=False)
+        bias = None
+        if pad_mask is not None:
+            # O(B*T) padding form [B,1,1,Tk], broadcast inside the kernel
+            # tiles (the [T,T] mask square never materializes); the mask is
+            # a constant w.r.t. grad, matching the kernel's bias contract
+            bias = jnp.where(pad_mask, 0.0,
+                             -0.5 * jnp.finfo(jnp.float32).max
+                             )[:, None, None, :].astype(jnp.float32)
+        return flash_attention(q, k, v, causal=False, bias=bias)
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if pad_mask is not None:

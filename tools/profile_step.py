@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Measured device-time breakdown of the flagship GPT train step.
+
+Captures a jax.profiler xplane trace of N steps at a sweep-spec config
+(tools/mfu_sweep.py spec grammar), then aggregates per-HLO-op measured
+device nanoseconds so the MFU gap decomposes into named sinks: flash
+attention kernel, the fc matmuls, chunked-CE, the Adam fusion, and
+inter-op gaps (wall - device busy).
+
+Usage:
+  python tools/profile_step.py [spec] [--steps 6] [--dir /tmp/gpt-trace]
+
+Reference analogue: platform/device_tracer.cc (CUPTI per-kernel times);
+here the XLA device plane carries the measured per-fusion times.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    spec_str = sys.argv[1] if len(sys.argv) > 1 and "=" in sys.argv[1] else \
+        "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824"
+    trace_dir = "/tmp/gpt-trace"
+    if "--dir" in sys.argv:
+        trace_dir = sys.argv[sys.argv.index("--dir") + 1]
+
+    import numpy as np
+    import jax
+
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel import parallelize as PZ
+    from paddle_tpu.utils import device_trace as DT
+
+    spec = dict(kv.split("=") for kv in spec_str.split(","))
+    batch = int(spec.get("b", 16))
+    T = int(spec.get("T", 1024))
+    steps = int(spec.get("steps", 6))
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    bq, bk = int(spec.get("bq", 512)), int(spec.get("bk", 512))
+    if bq != 512 or bk != 512:
+        # route the spec's flash tile sizes through the default entry
+        # point, exactly like tools/mfu_sweep.py — a copied sweep row
+        # must profile the configuration it measured
+        from paddle_tpu.ops import pallas_kernels as PK
+
+        orig = PK.flash_attention
+
+        def patched(q, k, v, causal=True, sm_scale=None, block_q=512,
+                    block_k=512, bias=None):
+            return orig(q, k, v, causal=causal, sm_scale=sm_scale,
+                        block_q=bq, block_k=bk, bias=bias)
+
+        PK.flash_attention = patched
+    unknown = set(spec) - {"b", "T", "steps", "bq", "bk", "d", "L", "ff",
+                           "nh", "remat", "celim", "flash"}
+    if unknown:
+        raise SystemExit(f"profile_step: unknown spec keys {sorted(unknown)}")
+    kw = dict(
+        max_seq_len=T,
+        use_flash=spec.get("flash", "1") == "1",
+        d_model=int(spec.get("d", 768)),
+        num_layers=int(spec.get("L", 12)),
+        d_ff=int(spec.get("ff", 4 * int(spec.get("d", 768)))),
+        remat=spec.get("remat", "full") != "none",
+        remat_policy=("dots" if spec.get("remat") == "dots" else "full"),
+    )
+    if "nh" in spec:
+        kw["num_heads"] = int(spec["nh"])
+    if "celim" in spec:
+        kw["ce_direct_bytes_limit"] = int(spec["celim"])
+    cfg = G.GPT_SMALL.scaled(**kw)
+
+    dev = jax.devices()[0]
+    pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg, devices=[dev])
+    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
+
+    print(f"[profile] compiling {spec_str}", file=sys.stderr, flush=True)
+    params, opt, loss, _ = step(params, opt, tokens, labels)
+    float(loss)
+
+    print(f"[profile] tracing {steps} steps", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            params, opt, loss, _ = step(params, opt, tokens, labels)
+        float(loss)
+    wall_s = time.perf_counter() - t0
+
+    # aggregate measured device time by HLO op family
+    agg = {}
+    total_ns = 0.0
+    for _module, hlo_op, dur in DT.device_events(trace_dir):
+        fam = hlo_op.split(".")[0]
+        a = agg.setdefault(fam, [0.0, 0])
+        a[0] += dur
+        a[1] += 1
+        total_ns += dur
+    rows = sorted(
+        ({"op": k, "ms_per_step": v[0] / 1e6 / steps, "events": v[1]}
+         for k, v in agg.items()),
+        key=lambda r: -r["ms_per_step"])
+
+    wall_ms = wall_s * 1e3 / steps
+    busy_ms = total_ns / 1e6 / steps
+    print(f"\n=== {spec_str} on {getattr(dev, 'device_kind', dev.platform)}")
+    print(f"wall {wall_ms:.1f} ms/step | device busy {busy_ms:.1f} ms/step "
+          f"| gap {wall_ms - busy_ms:.1f} ms/step")
+    for r in rows[:25]:
+        print(f"{r['ms_per_step']:9.2f} ms  x{r['events']:<5d} {r['op']}")
+    out = {"spec": spec_str, "wall_ms_per_step": round(wall_ms, 2),
+           "device_busy_ms_per_step": round(busy_ms, 2),
+           "rows": [{**r, "ms_per_step": round(r["ms_per_step"], 3)}
+                    for r in rows[:40]]}
+    path = os.path.join(REPO, "PROFILE_STEP.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"[profile] wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 chip session 3: perf push after the measured session-1/2 results
+# (MFU_SWEEP.json: best 0.3511 at d=2048,L=6,dots+flash; no-remat OOMs).
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session3.sh > tpu_s3.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== [1/3] MFU sweep 3 $(date -u +%H:%M:%S) ==="
+python tools/mfu_sweep.py --multi \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=4294967296,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=full,celim=4294967296,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824,bq=1024,bk=1024,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824,bq=1024,bk=512,steps=8" \
+  "d=3072,L=3,nh=24,ff=12288,b=8,remat=dots,celim=1073741824,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=8,T=2048,remat=dots,celim=1073741824,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=24,remat=dots,celim=536870912,steps=8" \
+  | tee -a MFU_SWEEP.json
+echo "=== sweep3 rc=${PIPESTATUS[0]} ==="
+
+echo "=== [2/3] step profile $(date -u +%H:%M:%S) ==="
+python tools/profile_step.py "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824" --steps 6
+echo "=== profile rc=$? ==="
+
+echo "=== [3/3] bench (new ladder + ernie lane) $(date -u +%H:%M:%S) ==="
+python bench.py
+echo "=== bench rc=$? ==="
+date -u > .tpu_s3_done
