@@ -2,6 +2,7 @@
 python/paddle/fluid/dygraph/. Eager execution on jax arrays with an autograd
 tape; see base.py / layers.py."""
 from .base import enabled, guard, grad, no_grad, to_variable, enable_dygraph, disable_dygraph  # noqa: F401
+from ..framework.core import BackwardStrategy  # noqa: F401
 from .layers import Layer  # noqa: F401
 from .varbase import VarBase  # noqa: F401
 from .nn import (  # noqa: F401

@@ -111,7 +111,10 @@ class VarBase:
         self.value = jnp.asarray(value)
 
     # -- autograd -----------------------------------------------------------
-    def backward(self, retain_graph: bool = False):
+    def backward(self, backward_strategy=None, retain_graph: bool = False):
+        """``backward_strategy`` (reference dygraph base.py:365,507) is
+        accepted for parity; the tape replays in deterministic reverse
+        order, so sort_sum_gradient has nothing to change."""
         run_backward([self], retain_graph=retain_graph)
 
     # -- arithmetic ---------------------------------------------------------

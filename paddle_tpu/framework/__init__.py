@@ -19,6 +19,10 @@ from .random import manual_seed  # noqa: F401
 from .backward import append_backward, gradients  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .core import CPUPlace, TPUPlace, XLAPlace  # noqa: F401
+# reference paddle.framework re-exports the CUDA places; on TPU both alias
+# the accelerator place (top-level __init__ establishes the same aliases)
+from .core import XLAPlace as CUDAPlace  # noqa: F401
+from .core import XLAPlace as CUDAPinnedPlace  # noqa: F401
 from .executor import Executor, global_scope, scope_guard  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 

@@ -139,6 +139,20 @@ class XLAPlace(Place):
 TPUPlace = XLAPlace
 
 
+class BackwardStrategy:
+    """Dygraph backward knobs — reference pybind/imperative.cc:491-519
+    (``core.BackwardStrategy`` with the ``sort_sum_gradient`` property).
+
+    ``sort_sum_gradient=True`` asks the reference's BasicEngine to sum a
+    var's repeated gradients in a deterministic (sorted) order.  The tape
+    engine here replays in reverse record order, which is already
+    deterministic by construction, so the flag is accepted for API parity
+    and does not change behavior."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
 def is_compiled_with_tpu() -> bool:
     return any(d.platform not in ("cpu",) for d in jax.devices())
 

@@ -1,7 +1,7 @@
 """paddle.imperative — parity with python/paddle/imperative/__init__.py
 (aliases of the fluid dygraph surface)."""
 from .dygraph import (  # noqa: F401
-    CosineDecay, DataParallel, ExponentialDecay, InverseTimeDecay,
+    CosineDecay, DataParallel, ExponentialDecay, InverseTimeDecay, LayerList,
     NaturalExpDecay, NoamDecay, PiecewiseDecay, PolynomialDecay,
     ProgramTranslator, TracedLayer, declarative, enabled, grad, guard,
     no_grad, to_variable,
@@ -9,6 +9,8 @@ from .dygraph import (  # noqa: F401
 from .dygraph.checkpoint import load_dygraph as load  # noqa: F401
 from .dygraph.checkpoint import save_dygraph as save  # noqa: F401
 from .dygraph.parallel import ParallelEnv, prepare_context  # noqa: F401
+from .framework import core  # noqa: F401  (reference: from paddle.fluid import core)
+from .framework.core import BackwardStrategy  # noqa: F401
 
 __all__ = [
     "enabled", "grad", "guard", "load", "save", "prepare_context",

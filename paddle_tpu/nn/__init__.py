@@ -10,7 +10,11 @@ from .clip import (  # noqa: F401
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
 from .decode import beam_search, beam_search_decode, gather_tree  # noqa: F401
 from .input import data  # noqa: F401
-from .layer import conv, loss  # noqa: F401
+from .layer import common, conv, loss, norm  # noqa: F401
+# the reference's paddle.nn.extension is the FUNCTIONAL extension module
+# (nn/__init__.py: from .functional import extension — row_conv etc.);
+# the RowConv Layer class stays at nn.layer.extension
+from .functional import extension  # noqa: F401
 from .layer.activation import HSigmoid, LogSoftmax, ReLU, Sigmoid  # noqa: F401
 from .layer.common import (  # noqa: F401
     BilinearTensorProduct, Embedding, Linear, Pool2D, UpSample,
