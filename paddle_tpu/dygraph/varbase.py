@@ -114,7 +114,17 @@ class VarBase:
     def backward(self, backward_strategy=None, retain_graph: bool = False):
         """``backward_strategy`` (reference dygraph base.py:365,507) is
         accepted for parity; the tape replays in deterministic reverse
-        order, so sort_sum_gradient has nothing to change."""
+        order, so sort_sum_gradient has nothing to change.  A non-strategy
+        first positional (e.g. a bool meant for the old retain_graph slot)
+        fails loudly instead of silently dropping graph retention."""
+        from ..framework.core import BackwardStrategy
+
+        if backward_strategy is not None and \
+                not isinstance(backward_strategy, BackwardStrategy):
+            raise TypeError(
+                "backward() first argument must be a BackwardStrategy "
+                f"(got {type(backward_strategy).__name__}); pass "
+                "retain_graph by keyword")
         run_backward([self], retain_graph=retain_graph)
 
     # -- arithmetic ---------------------------------------------------------

@@ -13,9 +13,9 @@ from .framework import core  # noqa: F401  (reference: from paddle.fluid import 
 from .framework.core import BackwardStrategy  # noqa: F401
 
 __all__ = [
-    "enabled", "grad", "guard", "load", "save", "prepare_context",
-    "to_variable", "TracedLayer", "no_grad", "ParallelEnv",
-    "ProgramTranslator", "declarative", "DataParallel", "NoamDecay",
-    "PiecewiseDecay", "NaturalExpDecay", "ExponentialDecay",
+    "BackwardStrategy", "enabled", "grad", "guard", "LayerList", "load",
+    "save", "prepare_context", "to_variable", "TracedLayer", "no_grad",
+    "ParallelEnv", "ProgramTranslator", "declarative", "DataParallel",
+    "NoamDecay", "PiecewiseDecay", "NaturalExpDecay", "ExponentialDecay",
     "InverseTimeDecay", "PolynomialDecay", "CosineDecay",
 ]

@@ -15,6 +15,10 @@ from .layer import common, conv, loss, norm  # noqa: F401
 # (nn/__init__.py: from .functional import extension — row_conv etc.);
 # the RowConv Layer class stays at nn.layer.extension
 from .functional import extension  # noqa: F401
+# the reference aggregates extension.__all__ into nn.__all__ without ever
+# binding the names (a latent import-* bug there); bind them for real so
+# paddle.nn.row_conv etc. resolve
+from .functional.extension import *  # noqa: F401,F403
 from .layer.activation import HSigmoid, LogSoftmax, ReLU, Sigmoid  # noqa: F401
 from .layer.common import (  # noqa: F401
     BilinearTensorProduct, Embedding, Linear, Pool2D, UpSample,

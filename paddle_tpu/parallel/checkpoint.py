@@ -1,0 +1,114 @@
+"""Sharded async checkpointing for the 4D-parallel training path.
+
+The reference checkpoints through save/load *ops* on host tensors
+(fluid/io.py:598,902 save_persistables; operators/save_op.cc) and PS-mode
+checkpoint_notify — single-host, fully-replicated formats.  At GPT scale the
+TPU-native equivalent is an orbax-backed sharded checkpoint: every host
+writes only its own shards (OCDBT), saves run async behind the training
+step, and a restore may use a DIFFERENT mesh/topology — orbax reshards on
+load against the target shardings (the reference has no analogue; its
+closest capability is pserver-side sharded tables, SURVEY §5).
+
+The fluid-path formats (persistables / inference-model / ProgramDesc wire)
+stay in paddle_tpu.io — this module is the parallel engine's counterpart
+for ``parallelize.init_sharded``-style pytrees.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer(use_async: bool):
+    import orbax.checkpoint as ocp
+
+    if use_async:
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+
+class ShardedCheckpointer:
+    """Save/restore a (params, opt_state, step) training state.
+
+    ``save`` is non-blocking when ``use_async`` (the write overlaps the
+    next training steps; call ``wait`` or save again to join). ``restore``
+    takes the *target* shardings — restoring onto a different mesh shape
+    reshards automatically.
+    """
+
+    def __init__(self, dirname: str, use_async: bool = True):
+        self.dirname = os.path.abspath(dirname)
+        os.makedirs(self.dirname, exist_ok=True)
+        self._ckptr = _checkpointer(use_async)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.dirname, f"step_{int(step):08d}")
+
+    def save(self, step: int, state: Any, force: bool = False) -> str:
+        path = self._path(step)
+        self._ckptr.save(path, state, force=force)
+        return path
+
+    def wait(self) -> None:
+        w = getattr(self._ckptr, "wait_until_finished", None)
+        if w is not None:
+            w()
+
+    def all_steps(self):
+        if not os.path.isdir(self.dirname):
+            return []
+        out = []
+        for name in os.listdir(self.dirname):
+            if name.startswith("step_"):
+                try:
+                    out.append(int(name.split("_", 1)[1]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int, abstract_state: Any) -> Any:
+        """``abstract_state``: a pytree of jax.ShapeDtypeStruct with the
+        TARGET shardings (build with :func:`abstract_like` from live
+        arrays, or from init metadata) — orbax reshards each leaf onto
+        them, so a dp=2/tp=4 save restores onto a dp=4/tp=2 mesh."""
+        self.wait()
+        return self._ckptr.restore(self._path(step), abstract_state)
+
+    def close(self):
+        self.wait()
+        c = getattr(self._ckptr, "close", None)
+        if c is not None:
+            c()
+
+
+def abstract_like(tree: Any) -> Any:
+    """Live pytree -> ShapeDtypeStruct pytree carrying each leaf's current
+    sharding (the restore target for the same topology)."""
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=getattr(x, "sharding", None))
+        return x
+    return jax.tree_util.tree_map(one, tree)
+
+
+def abstract_for_mesh(tree: Any, specs: Any, mesh) -> Any:
+    """ShapeDtypeStruct pytree for restoring onto ``mesh`` with PartitionSpec
+    tree ``specs`` (cross-topology restore: pass the NEW mesh).
+
+    ``specs`` leaves are PartitionSpecs (tuples — hence the is_leaf guard,
+    same convention as parallelize.py's sharding builders)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    return jax.tree_util.tree_map(
+        lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+        tree, shardings)

@@ -322,10 +322,35 @@ def test_namespace_module_surfaces_complete():
                 if name.isidentifier() and not name.startswith("_"):
                     out.add(name)
         # assignment-style exports listed in __all__ (e.g. imperative's
-        # `BackwardStrategy = core.BackwardStrategy`)
-        m = re.search(r"__all__\s*=\s*\[([^\]]*)\]", src)
-        if m:
-            out.update(re.findall(r"['\"](\w+)['\"]", m.group(1)))
+        # `BackwardStrategy = core.BackwardStrategy`); all literal
+        # `__all__ = [...]` / `__all__ += [...]` blocks count — with
+        # comments stripped first, or commented-OUT entries would become
+        # phantom requirements
+        for m in re.finditer(r"__all__\s*\+?=\s*\[([^\]]*)\]", src):
+            body = re.sub(r"#[^\n]*", "", m.group(1))
+            out.update(re.findall(r"['\"](\w+)['\"]", body))
+        # `__all__ += mod.__all__` aggregation (paddle.nn builds its whole
+        # surface this way): resolve mod against the importing file's
+        # `from .X import mod` lines, then read that file's literal __all__
+        mod_src = {}
+        for m in re.finditer(r"^from \.([\w.]*) import ([^\n(]+)$", src,
+                             re.M):
+            pkg = m.group(1).replace(".", os.sep)
+            for piece in m.group(2).split(","):
+                name = piece.split("#")[0].split(" as ")[-1].strip()
+                base = os.path.join(os.path.dirname(path), pkg, name)
+                for cand in (base + ".py",
+                             os.path.join(base, "__init__.py")):
+                    if os.path.isfile(cand):
+                        mod_src[name] = cand
+        for m in re.finditer(r"__all__\s*\+=\s*(\w+)\.__all__", src):
+            sub = mod_src.get(m.group(1))
+            if sub:
+                sub_src = open(sub).read().replace("\\\n", " ")
+                for mm in re.finditer(r"__all__\s*\+?=\s*\[([^\]]*)\]",
+                                      sub_src):
+                    body = re.sub(r"#[^\n]*", "", mm.group(1))
+                    out.update(re.findall(r"['\"](\w+)['\"]", body))
         return {n for n in out
                 if not n.startswith("_")} - {"print_function", "division",
                                              "absolute_import"}
