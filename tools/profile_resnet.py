@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Measured per-op device breakdown of the fluid ResNet-50 train step.
+
+The r05 bench recorded 1,990 img/s = 0.124 analytic-flop MFU on one v5e
+chip — far below what the conv stack should reach. This drives the SAME
+user path as the bench (fluid program, bf16 AMP, momentum) under the
+profiler so stop_profiler prints MEASURED per-IR-op device time and the
+chrome trace lands next to PROFILE_RESNET.json for inspection.
+
+Usage: python tools/profile_resnet.py [--batch 128] [--hw 224] [--steps 4]
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    batch = int(sys.argv[sys.argv.index("--batch") + 1]) \
+        if "--batch" in sys.argv else 128
+    hw = int(sys.argv[sys.argv.index("--hw") + 1]) \
+        if "--hw" in sys.argv else 224
+    steps = int(sys.argv[sys.argv.index("--steps") + 1]) \
+        if "--steps" in sys.argv else 4
+
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet as R
+    from paddle_tpu.contrib.mixed_precision import decorate
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        img = fluid.layers.uniform_random(
+            [batch, 3, hw, hw], min=-1.0, max=1.0, dtype="float32")
+        img.stop_gradient = True
+        label = fluid.layers.randint(0, 1000, shape=[batch, 1],
+                                     dtype="int64")
+        logits = R.resnet(img, class_dim=1000, depth=50)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        opt = decorate(fluid.optimizer.Momentum(0.01, 0.9), use_bf16=True)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    exe.run(main_p, feed={}, fetch_list=[], scope=scope)  # compile
+    probe = main_p.global_block().all_parameters()[-1].name
+    np.asarray(scope.find_var(probe))
+
+    fluid.profiler.start_profiler(state="All")
+    fluid.profiler.attach_program(main_p)
+    import time
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        exe.run(main_p, feed={}, fetch_list=[], scope=scope)
+    np.asarray(scope.find_var(probe))
+    wall = (time.perf_counter() - t0) / steps
+    print(f"\n=== resnet50 b={batch} {hw}x{hw}: {wall * 1e3:.1f} ms/step "
+          f"({batch / wall:.0f} img/s)")
+    fluid.profiler.stop_profiler(sorted_key="total",
+                                 profile_path=f"/tmp/resnet_profile_b{batch}")
+    # one record per batch size — session scripts run several
+    out = os.path.join(REPO, f"PROFILE_RESNET_b{batch}.json")
+    with open(out, "w") as f:
+        json.dump({"batch": batch, "hw": hw, "steps": steps,
+                   "ms_per_step": round(wall * 1e3, 2),
+                   "img_per_sec": round(batch / wall, 1)}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

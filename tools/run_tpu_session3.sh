@@ -7,7 +7,7 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "=== [1/3] MFU sweep 3 $(date -u +%H:%M:%S) ==="
+echo "=== [1/5] MFU sweep 3 $(date -u +%H:%M:%S) ==="
 python tools/mfu_sweep.py --multi \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=4294967296,steps=8" \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=full,celim=4294967296,steps=8" \
@@ -19,11 +19,21 @@ python tools/mfu_sweep.py --multi \
   | tee -a MFU_SWEEP.json
 echo "=== sweep3 rc=${PIPESTATUS[0]} ==="
 
-echo "=== [2/3] step profile $(date -u +%H:%M:%S) ==="
+echo "=== [2/5] step profile $(date -u +%H:%M:%S) ==="
 python tools/profile_step.py "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824" --steps 6
 echo "=== profile rc=$? ==="
 
-echo "=== [3/3] bench (new ladder + ernie lane) $(date -u +%H:%M:%S) ==="
+echo "=== [3/5] resnet measured attribution $(date -u +%H:%M:%S) ==="
+python tools/profile_resnet.py --batch 128 --steps 4
+echo "=== resnet profile rc=$? ==="
+python tools/profile_resnet.py --batch 256 --steps 4
+echo "=== resnet b256 rc=$? ==="
+
+echo "=== [4/5] ernie flash lane test $(date -u +%H:%M:%S) ==="
+PADDLE_TPU_NATIVE=1 python -m pytest tests/tpu/test_ernie_flash_tpu.py -q
+echo "=== ernie lane rc=$? ==="
+
+echo "=== [5/5] bench (new ladder + ernie lane) $(date -u +%H:%M:%S) ==="
 python bench.py
 echo "=== bench rc=$? ==="
 date -u > .tpu_s3_done
