@@ -208,11 +208,18 @@ def launcher():
 # ---------------------------------------------------------------------------
 
 def _peak_flops(device) -> float:
-    """Best-effort peak bf16 FLOP/s for the device (fallbacks are rough)."""
+    """Peak *bf16* FLOP/s for the device (fallbacks are rough).
+
+    v5e is 197 TFLOP/s bf16 (394 is its int8 rate — the table briefly held
+    394 and understated every reported MFU 2x). Hardware evidence:
+    tools/peak_probe.py measures 173.7 TFLOP/s on a dense 16384x8192x8192
+    bf16 matmul on this chip (PEAK_PROBE.json) — 88% of 197; a matmul that
+    size could not sit at 44% of a 394 peak.
+    """
     kind = getattr(device, "device_kind", "cpu").lower()
     table = {
-        "v6e": 918e12, "v6 lite": 918e12, "v5e": 394e12, "v5 lite": 394e12,
-        "v5litepod": 394e12, "v5p": 459e12, "v4": 275e12, "v3": 123e12,
+        "v6e": 918e12, "v6 lite": 918e12, "v5e": 197e12, "v5 lite": 197e12,
+        "v5litepod": 197e12, "v5p": 459e12, "v4": 275e12, "v3": 123e12,
         "v2": 45e12,
     }
     for k, v in table.items():

@@ -42,10 +42,20 @@ class GPTConfig:
     # recompute elementwise (recovers most MFU at modest HBM cost)
     remat_policy: str = "full"
     use_flash: bool = False     # Pallas flash-attention kernel on TPU
+    # True: one lax.scan over the stacked layer axis (HLO size O(1) in
+    # depth — right for 48-layer configs). False: unroll the layer loop in
+    # the trace; at bench depths (6-12 layers) this removes the scan's
+    # per-iteration weight dynamic-slice copies and the backward's
+    # dynamic-update-slice grad accumulation, both measured as top sinks in
+    # PROFILE_STEP.json on v5e.
+    scan_layers: bool = True
     # chunked-CE threshold: f32 logits above this never materialize
     # (ce_from_hidden); lower it to trade ~1/6 vocab-head FLOPs for HBM
     # headroom (e.g. to fit no-remat training)
     ce_direct_bytes_limit: int = 4 << 30
+    # rows per CE chunk: bigger chunks = fewer, larger (more MXU-efficient)
+    # vocab matmuls in the scan, at chunk*V*4 bytes of live logits each
+    ce_chunk: int = 2048
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
@@ -220,6 +230,13 @@ def run_blocks(blocks, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
         else:
             f = jax.checkpoint(block_fn, static_argnums=(2, 3))
 
+    if not cfg.scan_layers:
+        L = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+        for i in range(L):
+            layer_p = jax.tree_util.tree_map(lambda a: a[i], blocks)
+            x = f(layer_p, x, cfg, tp_axis)
+        return x
+
     def body(h, layer_p):
         return f(layer_p, h, cfg, tp_axis), None
 
@@ -265,7 +282,8 @@ def token_ce(logits, labels, valid=None):
     return jnp.sum(ce)
 
 
-def ce_from_hidden(params, x, labels, cfg: GPTConfig, chunk: int = 2048,
+def ce_from_hidden(params, x, labels, cfg: GPTConfig,
+                   chunk: Optional[int] = None,
                    direct_bytes_limit: Optional[int] = None):
     """Summed token CE straight from hidden states, chunked over rows so the
     full [rows, V] logits tensor never materializes (at GPT vocab sizes the
@@ -273,6 +291,8 @@ def ce_from_hidden(params, x, labels, cfg: GPTConfig, chunk: int = 2048,
     chunk recomputes its logits in the backward (jax.checkpoint), costing
     one extra [chunk, D] x [D, V] matmul per chunk (~1/6 of the vocab-head
     FLOPs) for an S-fold cut in live logits memory."""
+    if chunk is None:
+        chunk = cfg.ce_chunk
     if direct_bytes_limit is None:
         direct_bytes_limit = cfg.ce_direct_bytes_limit
     head = params["lm_head"]

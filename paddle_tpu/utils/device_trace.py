@@ -48,9 +48,20 @@ def _latest_xplane(trace_dir: str) -> Optional[str]:
     return max(files, key=os.path.getmtime) if files else None
 
 
-def device_events(trace_dir: str) -> Iterable[Tuple[str, str, float]]:
+def device_events(trace_dir: str,
+                  exclusive: bool = False) -> Iterable[Tuple[str, str, float]]:
     """Yield (hlo_module, hlo_op, duration_ns) for every device-executed
-    HLO event in the newest capture under trace_dir."""
+    HLO event in the newest capture under trace_dir.
+
+    TPU device planes carry several lines: 'Steps' and 'XLA Modules' are
+    whole-step envelopes, 'Async XLA Ops' are DMA streams overlapping
+    compute, and 'XLA Ops' is the execution timeline — only the latter is
+    yielded (summing every line triple-counts: each step appears as a Step
+    event, a Module event, and its ops). 'XLA Ops' itself nests parent
+    spans (%while, call ops) above their children on the same line; with
+    ``exclusive=True`` each event's duration has its childrens' subtracted,
+    so a sum over all events equals measured device-busy time.
+    """
     from jax.profiler import ProfileData
 
     path = _latest_xplane(trace_dir)
@@ -59,13 +70,28 @@ def device_events(trace_dir: str) -> Iterable[Tuple[str, str, float]]:
     pd = ProfileData.from_file(path)
     for plane in pd.planes:
         device_plane = plane.name.startswith("/device:")
-        for line in plane.lines:
+        lines = list(plane.lines)
+        if device_plane:
+            op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
+            if op_lines:
+                lines = op_lines
+            else:
+                # unknown runtime naming: at least drop the whole-step
+                # envelope lines so the sum stays ~1x, and say so
+                import sys
+                lines = [ln for ln in lines
+                         if ln.name not in ("Steps", "XLA Modules")]
+                print(f"[device_trace] warning: no 'XLA Ops' line on "
+                      f"{plane.name}; summing {[str(l.name) for l in lines]}"
+                      f" (attribution may overlap)", file=sys.stderr)
+        for line in lines:
             # execution lines only: TPU device planes, or the PJRT CPU
             # client's runtime line — host python/trace-me lines may carry
             # hlo_op stats too and would double-count
             exec_line = device_plane or "XLAPjRtCpuClient" in str(line.name)
             if not exec_line:
                 continue
+            evs = []
             for ev in line.events:
                 try:
                     stats = dict(ev.stats)
@@ -80,7 +106,28 @@ def device_events(trace_dir: str) -> Iterable[Tuple[str, str, float]]:
                 dur = float(getattr(ev, "duration_ns", 0.0) or 0.0)
                 if dur <= 0:
                     continue
-                yield str(stats.get("hlo_module", plane.name)), str(hlo_op), dur
+                start = float(getattr(ev, "start_ns", 0.0) or 0.0)
+                evs.append([start, dur,
+                            str(stats.get("hlo_module", plane.name)),
+                            str(hlo_op)])
+            if exclusive and evs:
+                # properly nested spans: sweep by start, subtract each
+                # event's duration from its innermost enclosing parent
+                evs.sort(key=lambda r: (r[0], -r[1]))
+                stack: List[list] = []
+                for r in evs:
+                    while stack and r[0] >= stack[-1][0] + stack[-1][1]:
+                        stack.pop()
+                    if stack:
+                        stack[-1][4] -= r[1]
+                    r.append(r[1])     # r[4] = exclusive dur
+                    stack.append(r)
+                for start, dur, module, hlo_op, excl in evs:
+                    if excl > 0:
+                        yield module, hlo_op, excl
+            else:
+                for start, dur, module, hlo_op in evs:
+                    yield module, hlo_op, dur
 
 
 def measured_op_rows(trace_dir: str, hlo_texts: List[str]) -> List[dict]:
@@ -99,7 +146,7 @@ def measured_op_rows(trace_dir: str, hlo_texts: List[str]) -> List[dict]:
         by_module.setdefault(hlo_module_name(txt), {}).update(m)
         merged.update(m)
     agg: Dict[str, List[float]] = {}
-    for module, hlo_op, dur in device_events(trace_dir):
+    for module, hlo_op, dur in device_events(trace_dir, exclusive=True):
         mod_map = by_module.get(module)
         if mod_map and hlo_op in mod_map:
             op_name = mod_map[hlo_op]

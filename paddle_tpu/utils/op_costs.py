@@ -114,7 +114,7 @@ def _op_out_names(op, slot):
 
 
 def print_cost_table(rows: List[dict], top: int = 10,
-                     peak_flops: float = 394e12,
+                     peak_flops: float = 197e12,  # v5e bf16 peak (394 is int8)
                      hbm_bw: float = 819e9) -> List[dict]:
     """Top-N ops by roofline-estimated time (max of flops/peak and
     bytes/bandwidth — defaults are TPU v5 lite)."""
@@ -137,7 +137,7 @@ def print_cost_table(rows: List[dict], top: int = 10,
 
 
 def merge_into_trace(rows: List[dict], trace_path: str,
-                     peak_flops: float = 394e12,
+                     peak_flops: float = 197e12,  # v5e bf16 peak (394 is int8)
                      hbm_bw: float = 819e9) -> None:
     """Append the cost rows to a chrome trace file as a synthetic
     'xla cost estimate' track (utils/timeline.py merge target)."""

@@ -93,6 +93,50 @@ def test_train_step_decreases_loss():
     assert np.isfinite(losses).all()
 
 
+def test_bf16_moments_track_f32():
+    """moment_dtype=bf16 (init_sharded) halves Adam state HBM; the update
+    math stays f32, so short-horizon training must track the f32-moment
+    run closely (this is what lets the bench's no-remat/wide configs fit
+    a 16 GB chip — see tools/mfu_sweep.py mom= spec key)."""
+    cfg = _tiny_cfg()
+    pcfg = PZ.ParallelConfig(dp=2, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg)
+    tokens, labels = _data(jax.random.PRNGKey(7), cfg, 1, 8)
+
+    def run(moment_dtype):
+        params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh,
+                                      moment_dtype=moment_dtype)
+        if moment_dtype is not None:
+            assert all(x.dtype == moment_dtype
+                       for x in jax.tree_util.tree_leaves(opt["m"]))
+        step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-2)
+        losses = []
+        for _ in range(6):
+            params, opt, loss, _ = step(params, opt, tokens, labels)
+            losses.append(float(loss))
+        return losses
+
+    l_bf16 = run(jnp.bfloat16)
+    l_f32 = run(None)
+    assert l_bf16[-1] < l_bf16[0] - 0.2, l_bf16
+    np.testing.assert_allclose(l_bf16, l_f32, rtol=2e-2)
+
+
+def test_unrolled_layers_match_scan():
+    """scan_layers=False unrolls the depth loop (the bench-config fast path —
+    kills the scan's weight-slice copies); it must be numerically identical
+    to the scan."""
+    cfg = _tiny_cfg()
+    params = G.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    x = G.embed(params, tokens, cfg)
+    a = G.run_blocks(params["blocks"], x, cfg)
+    b = G.run_blocks(params["blocks"], x, cfg.scaled(scan_layers=False))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_single_device_forward_jit():
     cfg = _tiny_cfg()
     params = G.init_params(jax.random.PRNGKey(0), cfg)

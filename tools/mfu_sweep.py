@@ -42,8 +42,13 @@ def multi_worker(specs):
         try:
             _measure_spec(spec, np, jax)
         except Exception as e:  # OOM etc: report and continue
-            print(json.dumps({"spec": spec, "error": repr(e)[:200]}),
-                  flush=True)
+            # surface the OOM/limit lines buried in long compiler errors
+            # (str, not repr: repr escapes newlines into one giant line)
+            keyw = [ln.strip()[:200] for ln in str(e).splitlines()
+                    if any(k in ln.lower() for k in
+                           ("exhausted", "memory", "hbm", "exceeds", "oom"))]
+            print(json.dumps({"spec": spec, "error": repr(e)[:400],
+                              "error_keylines": keyw[:4]}), flush=True)
 
 
 def _measure_spec(spec_str, np, jax):
@@ -59,6 +64,8 @@ def _measure_spec(spec_str, np, jax):
     d_ff = int(spec.get("ff", 4 * d_model))
     T = int(spec.get("T", 1024))
     flash = spec.get("flash", "1") == "1"
+    mom = spec.get("mom", "f32")               # f32 | bf16 Adam moments
+    scan = spec.get("scan", "1") == "1"        # 0 = unroll the layer loop
 
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
@@ -75,10 +82,12 @@ def _measure_spec(spec_str, np, jax):
 
     kw = dict(max_seq_len=T, use_flash=flash, d_model=d_model,
               num_layers=layers, d_ff=d_ff,
-              remat=(remat != "none"),
+              remat=(remat != "none"), scan_layers=scan,
               remat_policy=("dots" if remat == "dots" else "full"))
     if "celim" in spec:
         kw["ce_direct_bytes_limit"] = int(spec["celim"])
+    if "chunk" in spec:
+        kw["ce_chunk"] = int(spec["chunk"])
     if heads:
         kw["num_heads"] = heads
     cfg = G.GPT_SMALL.scaled(**kw)
@@ -86,7 +95,10 @@ def _measure_spec(spec_str, np, jax):
     dev = jax.devices()[0]
     pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
     mesh = PZ.build_mesh(pcfg, devices=[dev])
-    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+    import jax.numpy as jnp
+    params, opt = PZ.init_sharded(
+        jax.random.PRNGKey(0), cfg, pcfg, mesh,
+        moment_dtype=jnp.bfloat16 if mom == "bf16" else None)
     step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
@@ -104,8 +116,10 @@ def _measure_spec(spec_str, np, jax):
 
     n_params = G.num_params(params)
     attn = 12 * cfg.num_layers * cfg.d_model * T
-    peak = {"v5": 394e12, "v6": 918e12, "v4": 275e12}.get(
-        getattr(dev, "device_kind", "")[:2].lower(), 394e12)
+    # bf16 peaks (v5e = 197e12; 394 is its int8 rate — see tools/peak_probe.py
+    # + PEAK_PROBE.json for the measured 173.7 TFLOP/s matmul ceiling)
+    peak = {"v5": 197e12, "v6": 918e12, "v4": 275e12}.get(
+        getattr(dev, "device_kind", "")[:2].lower(), 197e12)
     kind = getattr(dev, "device_kind", "cpu").lower()
     if "v5p" in kind:
         peak = 459e12

@@ -58,7 +58,8 @@ def main():
 
         PK.flash_attention = patched
     unknown = set(spec) - {"b", "T", "steps", "bq", "bk", "d", "L", "ff",
-                           "nh", "remat", "celim", "flash"}
+                           "nh", "remat", "celim", "flash", "scan", "mom",
+                           "chunk"}
     if unknown:
         raise SystemExit(f"profile_step: unknown spec keys {sorted(unknown)}")
     kw = dict(
@@ -69,17 +70,23 @@ def main():
         d_ff=int(spec.get("ff", 4 * int(spec.get("d", 768)))),
         remat=spec.get("remat", "full") != "none",
         remat_policy=("dots" if spec.get("remat") == "dots" else "full"),
+        scan_layers=spec.get("scan", "1") == "1",
     )
     if "nh" in spec:
         kw["num_heads"] = int(spec["nh"])
     if "celim" in spec:
         kw["ce_direct_bytes_limit"] = int(spec["celim"])
+    if "chunk" in spec:
+        kw["ce_chunk"] = int(spec["chunk"])
     cfg = G.GPT_SMALL.scaled(**kw)
 
     dev = jax.devices()[0]
     pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
     mesh = PZ.build_mesh(pcfg, devices=[dev])
-    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+    import jax.numpy as jnp
+    params, opt = PZ.init_sharded(
+        jax.random.PRNGKey(0), cfg, pcfg, mesh,
+        moment_dtype=jnp.bfloat16 if spec.get("mom") == "bf16" else None)
     step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
@@ -100,7 +107,7 @@ def main():
     # aggregate measured device time by HLO op family
     agg = {}
     total_ns = 0.0
-    for _module, hlo_op, dur in DT.device_events(trace_dir):
+    for _module, hlo_op, dur in DT.device_events(trace_dir, exclusive=True):
         fam = hlo_op.split(".")[0]
         a = agg.setdefault(fam, [0.0, 0])
         a[0] += dur
