@@ -212,7 +212,7 @@ def _peak_flops(device) -> float:
 
     v5e is 197 TFLOP/s bf16 (394 is its int8 rate — the table briefly held
     394 and understated every reported MFU 2x). Hardware evidence:
-    tools/peak_probe.py measures 173.7 TFLOP/s on a dense 16384x8192x8192
+    tools/peak_probe.py measures 171.3 TFLOP/s on a dense 16384x8192x8192
     bf16 matmul on this chip (PEAK_PROBE.json) — 88% of 197; a matmul that
     size could not sit at 44% of a 394 peak.
     """
@@ -406,10 +406,16 @@ def worker(use_flash: bool):
         per step would bill one tunnel round-trip per step (~25ms here)
         against pure device time.
         """
+        import jax.numpy as jnp
         pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
         mesh = PZ.build_mesh(pcfg, devices=[dev])
         _log(f"worker[{tag}]: init params")
-        params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+        # bf16 Adam moments on the accelerator: halves optimizer HBM (the
+        # difference between dots-remat fitting at useful batch) and
+        # measured +1.7% MFU (MFU_SWEEP.json r05 session 4)
+        params, opt = PZ.init_sharded(
+            jax.random.PRNGKey(0), cfg, pcfg, mesh,
+            moment_dtype=jnp.bfloat16 if on_acc else None)
         step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
         rng = np.random.default_rng(0)
         tokens = rng.integers(0, cfg.vocab_size, (1, batch, T),
@@ -442,18 +448,22 @@ def worker(use_flash: bool):
     if on_acc and wide_mode:
         # MXU-saturating width (d_model 2048, head_dim 128) shows the
         # framework ceiling — GPT_SMALL's 768-wide matmuls cap its MFU well
-        # below what the same code reaches on wider layers. no-remat needs
-        # batch 16 + forced chunked CE to fit HBM (its MFU numerator then
-        # matches the FLOPs actually run).
+        # below what the same code reaches on wider layers. The r05 sweep's
+        # measured winner: batch 16, remat=dots (save matmul outputs,
+        # recompute elementwise), chunked CE — 0.7168 MFU vs 0.7099 for the
+        # previous b=32 full-remat default; no-remat both fits (bf16
+        # moments) and measures WORSE (0.691 at b=8), see KERNEL_NOTES.md.
         cfg = G.GPT_SMALL.scaled(
             max_seq_len=1024, use_flash=use_flash, d_model=2048,
             num_heads=16, d_ff=8192, num_layers=6, remat=not no_remat,
-            ce_direct_bytes_limit=(1 << 30) if no_remat else (4 << 30))
-        batch, T, steps = (16, 1024, 10) if no_remat else (32, 1024, 8)
+            remat_policy="full" if no_remat else "dots",
+            ce_direct_bytes_limit=(1 << 30))
+        batch, T, steps = (16, 1024, 10)
         tag = "gpt_wide" + ("_noremat" if no_remat else "")
     elif on_acc:
         cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=use_flash,
-                                 remat=not no_remat)
+                                 remat=not no_remat,
+                                 remat_policy="full" if no_remat else "dots")
         batch, T, steps = 16, 1024, 10
         tag = "gpt_small" + ("_noremat" if no_remat else "")
     else:  # CPU smoke path so the bench always produces a line

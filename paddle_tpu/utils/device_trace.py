@@ -72,15 +72,17 @@ def device_events(trace_dir: str,
         device_plane = plane.name.startswith("/device:")
         lines = list(plane.lines)
         if device_plane:
-            op_lines = [ln for ln in lines if ln.name == "XLA Ops"]
+            op_lines = [ln for ln in lines if str(ln.name) == "XLA Ops"]
             if op_lines:
                 lines = op_lines
             else:
                 # unknown runtime naming: at least drop the whole-step
-                # envelope lines so the sum stays ~1x, and say so
+                # envelope lines and the async DMA streams (which overlap
+                # compute) so the sum stays ~1x, and say so
                 import sys
                 lines = [ln for ln in lines
-                         if ln.name not in ("Steps", "XLA Modules")]
+                         if str(ln.name) not in ("Steps", "XLA Modules",
+                                                 "Async XLA Ops")]
                 print(f"[device_trace] warning: no 'XLA Ops' line on "
                       f"{plane.name}; summing {[str(l.name) for l in lines]}"
                       f" (attribution may overlap)", file=sys.stderr)

@@ -116,14 +116,11 @@ def _measure_spec(spec_str, np, jax):
 
     n_params = G.num_params(params)
     attn = 12 * cfg.num_layers * cfg.d_model * T
-    # bf16 peaks (v5e = 197e12; 394 is its int8 rate — see tools/peak_probe.py
-    # + PEAK_PROBE.json for the measured 173.7 TFLOP/s matmul ceiling)
-    peak = {"v5": 197e12, "v6": 918e12, "v4": 275e12}.get(
-        getattr(dev, "device_kind", "")[:2].lower(), 197e12)
-    kind = getattr(dev, "device_kind", "cpu").lower()
-    if "v5p" in kind:
-        peak = 459e12
-    mfu = tokens_per_s * (6 * n_params + attn) / peak
+    # single source of truth for the bf16-peak table (bench._peak_flops:
+    # v5e = 197e12 — 394 is its int8 rate; PEAK_PROBE.json holds the
+    # measured 171.3 TFLOP/s matmul ceiling backing it)
+    from bench import _peak_flops
+    mfu = tokens_per_s * (6 * n_params + attn) / _peak_flops(dev)
     print(json.dumps({"spec": spec_str, "tokens_per_s": round(tokens_per_s, 1),
                       "mfu": round(mfu, 4), "ms_per_step": round(dt / steps * 1e3, 1),
                       "compile_s": round(compile_s, 1),
