@@ -127,14 +127,13 @@ def launcher():
     if saw_accelerator:
         budget = max(60.0, remaining() - CPU_RESERVE_S - 90)
         flash_args = []
-        # config ladder: measured-known-good first (r05 on-chip sweep:
-        # every no-remat config OOMs 16 GB HBM — 510M params hold ~8.5 GB
-        # of f32 master+grad+Adam state before activations — and
-        # remat=dots ties remat=full to 4 decimal places, so the remat
-        # config IS the winner, not a fallback; MFU_SWEEP.json holds the
-        # evidence). A failed attempt costs ~90 s of the ~390 s budget,
-        # so the ladder leads with what fits and keeps --no-flash only
-        # for a Pallas-kernel regression.
+        # config ladder: measured-known-good first (r05 session-4 sweep:
+        # b=16 remat=dots + bf16 Adam moments is the measured winner at
+        # 0.7168 MFU — no-remat fits with bf16 moments but loses, 0.691
+        # at b=8; KERNEL_NOTES.md session-4 table holds the evidence).
+        # A failed attempt costs ~90 s of the ~390 s budget, so the
+        # ladder leads with what fits and keeps --no-flash only for a
+        # Pallas-kernel regression.
         result = _run_worker(dict(os.environ), budget, [])
         if result is None and remaining() > CPU_RESERVE_S + 120:
             flash_args = ["--no-flash"]
@@ -213,7 +212,7 @@ def _peak_flops(device) -> float:
     v5e is 197 TFLOP/s bf16 (394 is its int8 rate — the table briefly held
     394 and understated every reported MFU 2x). Hardware evidence:
     tools/peak_probe.py measures 171.3 TFLOP/s on a dense 16384x8192x8192
-    bf16 matmul on this chip (PEAK_PROBE.json) — 88% of 197; a matmul that
+    bf16 matmul on this chip (PEAK_PROBE.json) — 87% of 197; a matmul that
     size could not sit at 44% of a 394 peak.
     """
     kind = getattr(device, "device_kind", "cpu").lower()
@@ -329,7 +328,10 @@ def ernie_worker():
 
     dev = jax.devices()[0]
     on_acc = dev.platform != "cpu"
-    cfg = E.ERNIE_BASE.scaled(use_flash=on_acc) if on_acc else \
+    # remat off on-chip: ERNIE-base's whole optimizer state is ~1 GB, so
+    # saved activations fit 16 GB HBM easily and the full-remat forward
+    # replay (~1/4 of step FLOPs) is pure waste at this scale
+    cfg = E.ERNIE_BASE.scaled(use_flash=on_acc, remat=False) if on_acc else \
         E.ERNIE_TINY
     batch, T, steps = (64, 512, 10) if on_acc else (4, 64, 2)
     _log(f"ernie worker: device {dev.platform} batch={batch}")

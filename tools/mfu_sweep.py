@@ -71,13 +71,18 @@ def _measure_spec(spec_str, np, jax):
     from paddle_tpu.parallel import parallelize as PZ
     from paddle_tpu.ops import pallas_kernels as PK
 
-    # route the sweep's block sizes through the default entry point
+    # route the sweep's block sizes through the default entry point; ALWAYS
+    # reset first — in a --multi process a previous spec's patch would
+    # otherwise leak into every later default-block spec
+    orig = getattr(PK, "_sweep_orig_flash", None)
+    if orig is None:
+        orig = PK._sweep_orig_flash = PK.flash_attention
+    PK.flash_attention = orig
     if bq != 512 or bk != 512:
-        orig = PK.flash_attention
         def patched(q, k, v, causal=True, sm_scale=None, block_q=512,
-                    block_k=512):
+                    block_k=512, bias=None):
             return orig(q, k, v, causal=causal, sm_scale=sm_scale,
-                        block_q=bq, block_k=bk)
+                        block_q=bq, block_k=bk, bias=bias)
         PK.flash_attention = patched
 
     kw = dict(max_seq_len=T, use_flash=flash, d_model=d_model,
