@@ -16,6 +16,7 @@ cd "$(dirname "$0")/.."
 
 echo "=== [1/5] MFU sweep 4 $(date -u +%H:%M:%S) ==="
 timeout -s INT -k 60 2700 python tools/mfu_sweep.py --multi \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=4294967296,steps=8" \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,chunk=8192,steps=8" \
   "d=2048,L=6,nh=16,ff=8192,b=24,remat=dots,mom=bf16,celim=1073741824,steps=8" \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,bq=1024,bk=512,steps=8" \
