@@ -328,12 +328,16 @@ def ernie_worker():
 
     dev = jax.devices()[0]
     on_acc = dev.platform != "cpu"
-    # remat off on-chip: ERNIE-base's whole optimizer state is ~1 GB, so
-    # saved activations fit 16 GB HBM easily and the full-remat forward
-    # replay (~1/4 of step FLOPs) is pure waste at this scale
+    # remat off on-chip: ERNIE-base's optimizer state is only ~1 GB, so the
+    # full-remat forward replay (~1/4 of step FLOPs) buys nothing — but the
+    # saved activations are ~170 MB/layer per 8 samples, so batch sizes the
+    # HBM budget (see the batch comment below)
     cfg = E.ERNIE_BASE.scaled(use_flash=on_acc, remat=False) if on_acc else \
         E.ERNIE_TINY
-    batch, T, steps = (64, 512, 10) if on_acc else (4, 64, 2)
+    # batch 48 keeps no-remat's saved activations (~8 GB) comfortably inside
+    # HBM — an OOM crash here is a relay-wedge risk for the rest of the
+    # session, not just a lost side lane
+    batch, T, steps = (48, 512, 10) if on_acc else (4, 64, 2)
     _log(f"ernie worker: device {dev.platform} batch={batch}")
 
     params = E.init_params(jax.random.PRNGKey(0), cfg)
