@@ -171,11 +171,14 @@ def main():
     if "--one" in sys.argv:
         specs = [sys.argv[sys.argv.index("--one") + 1]]
     else:
+        # default sweep = the measured-winner neighborhood (KERNEL_NOTES
+        # session-4 table: 0.7168 at b=16 dots + bf16 moments) + its two
+        # controlled A/Bs (flash off, f32 moments)
         specs = [
-            "b=16,remat=none",
-            "d=2048,L=6,nh=16,ff=8192,b=16,remat=none,celim=1073741824,steps=8",
-            "b=16,remat=full,flash=0",    # XLA attention vs Pallas flash
-            "b=16,remat=none,nh=6",       # head_dim 128 (MXU-native lanes)
+            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,steps=8",
+            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,flash=0,steps=8",
+            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824,steps=8",
+            "d=2048,L=6,nh=16,ff=8192,b=32,remat=full,mom=bf16,celim=1073741824,steps=8",
         ]
     results = []
     for s in specs:
