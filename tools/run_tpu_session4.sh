@@ -3,18 +3,36 @@
 #
 # Context (KERNEL_NOTES.md "session 4"): honest v5e bf16 peak landed
 # (197e12); measured best so far 0.7168 MFU at d=2048,L=6,b=16,remat=dots,
-# bf16 Adam moments. This session: (a) the remaining untried sweep axes,
-# (b) regenerate PROFILE_STEP.json with the fixed exclusive attribution,
-# (c) ResNet measured per-op profile (the 0.248-MFU lane), (d) final bench.
+# bf16 Adam moments. Ordered highest-value-first in case the chip window is
+# short (the backend was UNAVAILABLE for most of this session): (1) bench
+# refresh with the promoted defaults, (2) PROFILE_STEP.json regeneration
+# with the fixed exclusive attribution, (3) the remaining sweep axes,
+# (4) ResNet measured per-op profile (the 0.248-MFU lane), (5) TPU test
+# lane refresh.
 #
 # One relay claim end-to-end. timeout uses SIGINT (-s INT) with a -k grace:
-# SIGINT unwinds the PJRT client; SIGKILL/SIGTERM wedges the axon relay for
+# SIGINT unwinds the PJRT client; SIGTERM/SIGKILL wedges the axon relay for
 # hours (round-3 post-mortem + this morning's batch-3 wedge).
 # Run detached: setsid nohup bash tools/run_tpu_session4.sh > tpu_s4.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 
-echo "=== [1/5] MFU sweep 4 $(date -u +%H:%M:%S) ==="
+echo "=== [1/5] bench (promoted defaults + resnet/ernie lanes) $(date -u +%H:%M:%S) ==="
+python bench.py > .bench_s4_out.json
+rc=$?
+echo "=== bench rc=$rc ==="
+tail -1 .bench_s4_out.json
+if [ $rc -eq 0 ] && grep -q '"degraded": false' .bench_s4_out.json; then
+  tail -1 .bench_s4_out.json > BENCH_inround_r05.json
+  echo "=== BENCH_inround_r05.json refreshed ==="
+fi
+
+echo "=== [2/5] step profile (regenerate PROFILE_STEP.json, fixed exclusive attribution) $(date -u +%H:%M:%S) ==="
+timeout -s INT -k 60 900 python tools/profile_step.py \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824" --steps 6
+echo "=== profile rc=$? ==="
+
+echo "=== [3/5] MFU sweep 4 $(date -u +%H:%M:%S) ==="
 timeout -s INT -k 60 2700 python tools/mfu_sweep.py --multi \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=4294967296,steps=8" \
   "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,chunk=8192,steps=8" \
@@ -26,26 +44,11 @@ timeout -s INT -k 60 2700 python tools/mfu_sweep.py --multi \
   | tee -a MFU_SWEEP.json
 echo "=== sweep4 rc=${PIPESTATUS[0]} ==="
 
-echo "=== [2/5] step profile (regenerate PROFILE_STEP.json, fixed exclusive attribution) $(date -u +%H:%M:%S) ==="
-timeout -s INT -k 60 900 python tools/profile_step.py \
-  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824" --steps 6
-echo "=== profile rc=$? ==="
-
-echo "=== [3/5] resnet measured attribution $(date -u +%H:%M:%S) ==="
+echo "=== [4/5] resnet measured attribution $(date -u +%H:%M:%S) ==="
 timeout -s INT -k 60 900 python tools/profile_resnet.py --batch 128 --steps 4
 echo "=== resnet profile rc=$? ==="
 timeout -s INT -k 60 900 python tools/profile_resnet.py --batch 256 --steps 4
 echo "=== resnet b256 rc=$? ==="
-
-echo "=== [4/5] bench (promoted defaults + resnet/ernie lanes) $(date -u +%H:%M:%S) ==="
-python bench.py > .bench_s4_out.json
-rc=$?
-echo "=== bench rc=$rc ==="
-tail -1 .bench_s4_out.json
-if [ $rc -eq 0 ] && grep -q '"degraded": false' .bench_s4_out.json; then
-  tail -1 .bench_s4_out.json > BENCH_inround_r05.json
-  echo "=== BENCH_inround_r05.json refreshed ==="
-fi
 
 echo "=== [5/5] tpu test lane refresh $(date -u +%H:%M:%S) ==="
 PADDLE_TPU_NATIVE=1 timeout -s INT -k 60 2400 python -m pytest tests/tpu -q
