@@ -341,6 +341,11 @@ def iter_batches_threaded(dataset: DatasetBase, threads: int,
     dispatching) Executor loop — backpressure everywhere, so a streaming
     QueueDataset never materializes in memory. Batch order is identical to
     the sequential iterator.
+
+    ``Executor.train_from_dataset`` stacks ``reader.prefetch_to_device`` on
+    top of this iterator, so host->device transfer of the next batch also
+    overlaps the in-flight (asynchronously fetched) step; the assembled
+    numpy batches yielded here are consumed without an extra host copy.
     """
     import queue as queue_mod
     import threading as threading_mod

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -167,6 +168,15 @@ _GLOBAL_FLAGS = {
     "FLAGS_check_nan_inf": False,
     "FLAGS_check_nan_inf_level": "fetch",  # "fetch" | "op" (eager per-op scan)
     "FLAGS_benchmark": False,
+    # steady-state dispatch record in Executor.run (framework/executor.py):
+    # after the first step a (program, feed-sig, fetch) record skips feed
+    # re-normalization and cache-key rebuild. False = always take the
+    # full (pre-record) path; used for A/B in tools/dispatch_bench.py.
+    "FLAGS_dispatch_fast_path": True,
+    # persistent XLA compilation cache directory ('' = disabled). When set,
+    # repeated processes compiling the same program hit the on-disk cache
+    # instead of paying the cold XLA compile (jax_compilation_cache_dir).
+    "FLAGS_compile_cache_dir": _os.environ.get("FLAGS_compile_cache_dir", ""),
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_allocator_strategy": "xla_managed",
     "FLAGS_paddle_num_threads": 1,
@@ -185,6 +195,8 @@ _GLOBAL_FLAGS = {
 def set_flags(flags: dict):
     for k, v in flags.items():
         _GLOBAL_FLAGS[k] = v
+    if flags.get("FLAGS_compile_cache_dir"):
+        ensure_compile_cache()
 
 
 def get_flags(flags):
@@ -195,3 +207,49 @@ def get_flags(flags):
 
 def get_flag(name, default=None):
     return _GLOBAL_FLAGS.get(name, default)
+
+
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache (FLAGS_compile_cache_dir). The reference
+# pays every XLA compile from scratch per process; jax's on-disk cache
+# (jax_compilation_cache_dir) makes the second process a deserialize instead
+# of a compile. Hit/miss counters come from jax.monitoring events so the
+# Executor can log and RecordEvent whether a compile was served from disk.
+# ---------------------------------------------------------------------------
+
+_compile_cache_state = {"dir": None, "hits": 0, "misses": 0, "listener": False}
+
+
+def _compile_cache_listener(event, **kwargs):
+    if event == "/jax/compilation_cache/cache_hits":
+        _compile_cache_state["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        _compile_cache_state["misses"] += 1
+
+
+def ensure_compile_cache() -> bool:
+    """Point jax's persistent compilation cache at FLAGS_compile_cache_dir.
+
+    Idempotent; returns True when the cache is active. The size thresholds
+    are dropped to zero so even small programs (which this framework compiles
+    per (program, feed-sig, fetch) key) are cached across processes.
+    """
+    d = _GLOBAL_FLAGS.get("FLAGS_compile_cache_dir")
+    if not d:
+        return False
+    if _compile_cache_state["dir"] != d:
+        if not _compile_cache_state["listener"]:
+            from jax import monitoring
+
+            monitoring.register_event_listener(_compile_cache_listener)
+            _compile_cache_state["listener"] = True
+        jax.config.update("jax_compilation_cache_dir", str(d))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _compile_cache_state["dir"] = d
+    return True
+
+
+def compile_cache_counters():
+    """(hits, misses) served by the persistent cache in this process."""
+    return _compile_cache_state["hits"], _compile_cache_state["misses"]

@@ -11,6 +11,7 @@ backward and optimizer update fuse into one XLA module, parameters are donated
 from __future__ import annotations
 
 import logging
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -18,11 +19,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .core import Place, XLAPlace, dtype_to_jax, get_flag
+from .core import (Place, XLAPlace, compile_cache_counters, dtype_to_jax,
+                   ensure_compile_cache, get_flag)
 from .program import Program, Variable, default_main_program
 from .registry import LowerCtx, run_lowering, get_op_spec, has_op
 
 logger = logging.getLogger("paddle_tpu.executor")
+
+_prof_mod = None
+
+
+def _prof():
+    """The profiler module, imported lazily once (avoids the package-init
+    cycle) and cached so the steady-state path pays a global read, not an
+    import-machinery lookup."""
+    global _prof_mod
+    if _prof_mod is None:
+        from .. import profiler
+
+        _prof_mod = profiler
+    return _prof_mod
 
 
 class Scope:
@@ -193,6 +209,18 @@ class _CompiledBlock:
         mesh_axes = (mesh_plan.ring_axes if mesh_plan else {})
         block = program.global_block()
         written = set(written_names)
+        # steady-state split, computed once instead of per __call__
+        self._mutable_names = [n for n in self.param_names if n in written]
+        self._const_names = [n for n in self.param_names if n not in written]
+        # fetches that alias donated state: a fetch of a written persistable
+        # may share its buffer with the new_state output, and the NEXT step
+        # donates that scope array — an async (return_numpy=False) caller
+        # would then hold a deleted buffer. These indices get a defensive
+        # device-side copy after each call.
+        self._fetch_copy_idx = [i for i, n in enumerate(self.fetch_names)
+                                if n in written]
+        # set during the first trace: did any lowering consume an rng key?
+        self._rng_consumed = False
 
         def fn(mutable_params: Dict[str, Any], const_params: Dict[str, Any],
                feeds: Dict[str, Any], rng_key):
@@ -200,10 +228,13 @@ class _CompiledBlock:
             env.update(const_params)
             env.update(mutable_params)
             env.update(feeds)
+            rng_uses_before = LowerCtx.rng_use_count
             ctx = LowerCtx(program, block, env, rng_key=rng_key,
                            mesh_axes=mesh_axes)
             for op in block.ops:
                 run_lowering(ctx, op)
+            if LowerCtx.rng_use_count != rng_uses_before:
+                self._rng_consumed = True
             fetches = [env[n] for n in self.fetch_names]
             # a declared persistable output may legitimately stay unbound
             # (bootstrap no-op lowerings, @EMPTY@ grads) — tolerate it
@@ -316,35 +347,48 @@ class _CompiledBlock:
         return getter
 
     def __call__(self, scope: Scope, feed: Dict[str, Any], rng_key):
+        feeds = {n: feed[n] for n in self.feed_names}
+        return self.fast_call(scope, feeds, rng_key)
+
+    def fast_call(self, scope: Scope, feeds: Dict[str, Any], rng_key):
+        """Steady-state entry: ``feeds`` must already contain exactly
+        ``feed_names`` (the dispatch record guarantees it)."""
+        find = scope.find_var
         mutable = {}
         const = {}
-        written = set(self.written_names)
-        for n in self.param_names:  # persistables read from scope
-            v = scope.find_var(n)
+        for n in self._mutable_names:  # persistables read from scope
+            v = find(n)
             if v is None:
                 raise RuntimeError(
                     f"persistable var {n!r} is not initialized in scope — "
                     "run the startup program first"
                 )
-            if n in written:
-                mutable[n] = v  # donated: updated in place on device
-            else:
-                const[n] = v
-        feeds = {n: feed[n] for n in self.feed_names}
-        from .. import profiler as _prof
-
-        if _prof.is_active():
+            mutable[n] = v  # donated: updated in place on device
+        for n in self._const_names:
+            v = find(n)
+            if v is None:
+                raise RuntimeError(
+                    f"persistable var {n!r} is not initialized in scope — "
+                    "run the startup program first"
+                )
+            const[n] = v
+        prof = _prof()
+        if prof.is_active():
             # owned token, not id(self): a GC'd block's reused address
             # would silently suppress registration of a new block
             key = self.__dict__.setdefault("_profile_key", object())
-            if not _prof.has_compiled(key):
+            if not prof.has_compiled(key):
                 # capture avals BEFORE the call: mutable buffers are donated
-                _prof.register_compiled(
+                prof.register_compiled(
                     key, self._hlo_text_getter(mutable, const, feeds,
                                                rng_key))
         fetches, new_state = self._jitted(mutable, const, feeds, rng_key)
         for n, v in new_state.items():
             scope.set_var(n, v)
+        for i in self._fetch_copy_idx:
+            # detach written-persistable fetches from the donated state
+            # buffer (async dispatch; no host sync)
+            fetches[i] = jnp.copy(fetches[i])
         return fetches
 
 
@@ -369,6 +413,76 @@ def is_host_op_type(t: str) -> bool:
     return t in _HOST_OPS
 
 
+_FAST_MISS = object()
+
+
+class _DispatchRecord:
+    """Steady-state dispatch record for one (program, feed-sig, fetch) combo.
+
+    ``Executor.run`` pays a per-step Python tax on the slow path: feed dict
+    sort, ``np.asarray`` per feed, cache-key rebuild, host-op scan, mesh-plan
+    lookup. After the first step all of that is invariant, so the record
+    pins the compiled block plus a prebuilt feed flattener and the run goes
+    straight from the user's feed dict to the jitted call. Any mismatch
+    (program mutated, feed shape/dtype drift, flags) falls back to the full
+    path, which re-derives and replaces the record.
+    """
+
+    __slots__ = ("key_obj", "compiled", "dp_flag", "program", "version",
+                 "seed", "exe", "feed_checks", "nfeeds", "rng_base",
+                 "rng_used")
+
+    def __init__(self, key_obj, compiled, program, exe, feed_sig, raw_dtypes):
+        self.key_obj = key_obj
+        self.compiled = compiled
+        self.dp_flag = (compiled._is_data_parallel
+                        if compiled is not None else None)
+        self.program = program
+        self.version = program._version_token()
+        self.seed = program.random_seed
+        self.exe = exe
+        self.rng_used = exe._rng_consumed
+        # rng-free programs reuse one key; rng programs fold the step in,
+        # bit-identical to the slow path's fold_in(PRNGKey(seed), step)
+        self.rng_base = jax.random.PRNGKey(self.seed or 0)
+        checks = []
+        for name, shape, dt in feed_sig:
+            # accept the normalized dtype and its x64-narrowed compute dtype
+            # (a device-prefetched int64 feed arrives as int32)
+            accepted = frozenset({dt, str(dtype_to_jax(dt))})
+            raw = raw_dtypes.get(name)
+            cast = None
+            if raw is not None and raw not in accepted:
+                cast = jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt)
+            checks.append((name, shape, accepted, raw, cast))
+        self.feed_checks = checks
+        self.nfeeds = len(checks)
+
+    def prepare(self, feed: Dict[str, Any]):
+        """Validate + flatten the user's feed dict against the recorded
+        signature. Returns the dict to pass to the jitted call, or None when
+        the feed doesn't match (caller falls back to the full path)."""
+        if len(feed) != self.nfeeds:
+            return None
+        out = feed
+        for name, shape, accepted, raw, cast in self.feed_checks:
+            v = feed.get(name)
+            if v is None or getattr(v, "shape", None) != shape:
+                return None
+            dt = str(getattr(v, "dtype", ""))
+            if dt in accepted:
+                continue
+            if dt == raw and cast is not None:
+                # same raw dtype as at record build: prebuilt cast (e.g. the
+                # user feeds float64 into a float32 var every step)
+                if out is feed:
+                    out = dict(feed)
+                out[name] = np.asarray(v).astype(cast)
+            else:
+                return None
+        return out
+
+
 class Executor:
     """User-facing executor — API parity with fluid/executor.py:890 Executor.run."""
 
@@ -376,10 +490,13 @@ class Executor:
         self.place = place or XLAPlace(0)
         self._cache: Dict[Tuple, _CompiledBlock] = {}
         self._view_cache: Dict[Tuple, Program] = {}
+        self._dispatch_records: Dict[Tuple, _DispatchRecord] = {}
+        self._fast_hits = 0
         self._step = 0
 
     def close(self):
         self._cache.clear()
+        self._dispatch_records.clear()
 
     # ------------------------------------------------------------------
     def run(
@@ -395,6 +512,24 @@ class Executor:
     ):
         from .compiler import CompiledProgram
 
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
+        ]
+
+        # ---- steady-state fast path: dispatch record hit ----------------
+        if (self._dispatch_records and use_program_cache
+                and (feed is None or type(feed) is dict)
+                and get_flag("FLAGS_dispatch_fast_path")
+                and not get_flag("FLAGS_check_nan_inf")):
+            pkey = (id(program) if program is not None
+                    else id(default_main_program()))
+            rec = self._dispatch_records.get((pkey, tuple(fetch_names)))
+            if rec is not None:
+                out = self._try_fast_run(rec, feed if feed else {}, scope,
+                                         return_numpy)
+                if out is not _FAST_MISS:
+                    return out
+
         compiled = None
         if isinstance(program, CompiledProgram):
             compiled = program
@@ -403,9 +538,6 @@ class Executor:
             program = default_main_program()
         scope = scope or global_scope()
         feed = dict(feed or {})
-        fetch_names = [
-            v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])
-        ]
 
         if any(op.type in _HOST_OPS for op in program.global_block().ops):
             return self._run_with_host_ops(
@@ -419,7 +551,10 @@ class Executor:
         # normalize feed values to jax arrays (device put happens inside jit)
         feed_arrays: Dict[str, Any] = {}
         feed_sig = []
+        raw_dtypes: Dict[str, Optional[str]] = {}
         for name, value in sorted(feed.items()):
+            raw_dtypes[name] = (str(value.dtype)
+                                if isinstance(value, np.ndarray) else None)
             arr = _normalize_feed(program.global_block().vars.get(name),
                                   value)
             feed_arrays[name] = arr
@@ -433,12 +568,14 @@ class Executor:
             tuple(fetch_names),
             mesh_plan.signature() if mesh_plan else None,
         )
+        prof = _prof()
         exe = self._cache.get(key)
+        newly_built = exe is None
         if exe is None:
-            from ..profiler import RecordEvent
             block = program.global_block()
             param_names, written = _analyze_persistables(program)
-            with RecordEvent(f"compile/{len(block.ops)}ops"):
+            ensure_compile_cache()
+            with prof.RecordEvent(f"compile/{len(block.ops)}ops"):
                 if "pipeline" in program._annotations:
                     from ..parallel.pipeline_program import (
                         _CompiledPipelineBlock)
@@ -466,14 +603,70 @@ class Executor:
         seed = program.random_seed or 0
         rng_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
-        from ..profiler import RecordEvent
-        with RecordEvent("executor_run"):
+        # the XLA compile happens lazily at the first execution; when the
+        # persistent cache is on, attribute it as served-from-disk vs cold
+        watch_cache = newly_built and bool(get_flag("FLAGS_compile_cache_dir"))
+        if watch_cache:
+            hits0, misses0 = compile_cache_counters()
+            t0 = time.perf_counter_ns()
+        with prof.RecordEvent("executor_run"):
             fetches = exe(scope, feed_arrays, rng_key)
+        if watch_cache:
+            hits1, misses1 = compile_cache_counters()
+            if hits1 > hits0 or misses1 > misses0:
+                verdict = "hit" if hits1 > hits0 else "cold"
+                prof.add_event(f"compile_cache/{verdict}", t0,
+                               time.perf_counter_ns() - t0)
+                logger.info(
+                    "persistent compile cache %s for program (%d ops)",
+                    verdict, len(program.global_block().ops))
+
+        # pin the dispatch record so the next identical step skips all of
+        # the normalization/keying work above
+        if (use_program_cache and type(exe) is _CompiledBlock
+                and get_flag("FLAGS_dispatch_fast_path")):
+            key_obj = compiled if compiled is not None else program
+            recs = self._dispatch_records
+            if len(recs) > 256:
+                recs.clear()
+            recs[(id(key_obj), tuple(fetch_names))] = _DispatchRecord(
+                key_obj, compiled, program, exe, feed_sig, raw_dtypes)
 
         if get_flag("FLAGS_check_nan_inf"):
             from ..utils.nan_inf import check_fetches
 
             check_fetches(fetch_names, fetches)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def _try_fast_run(self, rec: _DispatchRecord, feed, scope, return_numpy):
+        """Attempt the zero-rebuild dispatch; _FAST_MISS sends the caller
+        down the full path (which re-derives and replaces the record)."""
+        program = rec.program
+        if (program._version_token() != rec.version
+                or program.random_seed != rec.seed
+                or (rec.compiled is not None
+                    and rec.compiled._is_data_parallel != rec.dp_flag)):
+            return _FAST_MISS
+        feeds = rec.prepare(feed)
+        if feeds is None:
+            return _FAST_MISS
+        if rec.rng_used:
+            rng_key = jax.random.fold_in(rec.rng_base, self._step)
+        else:
+            rng_key = rec.rng_base
+        self._step += 1
+        self._fast_hits += 1
+        prof = _prof()
+        if prof.is_active():
+            with prof.RecordEvent("executor_run"):
+                fetches = rec.exe.fast_call(scope or global_scope(), feeds,
+                                            rng_key)
+        else:
+            fetches = rec.exe.fast_call(scope or global_scope(), feeds,
+                                        rng_key)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -668,19 +861,34 @@ class Executor:
             batches = iter_batches_threaded(dataset, n_threads)
         else:
             batches = iter(dataset)
+
+        def filtered():
+            for batch_feed in batches:
+                yield {k: v for k, v in batch_feed.items()
+                       if not feed_names or k in feed_names
+                       or k.endswith("__len")}
+
+        # overlap host batch assembly + device transfer with the in-flight
+        # (asynchronously dispatched) step; fetches stay on device between
+        # print boundaries so the loop never blocks on the step it just
+        # launched
+        from ..reader import prefetch_to_device
+
         step = 0
         last_fetch = None
-        for batch_feed in batches:
-            feed = {k: v for k, v in batch_feed.items()
-                    if not feed_names or k in feed_names or k.endswith("__len")}
+        for feed in prefetch_to_device(filtered(), size=2):
             last_fetch = self.run(program=program, feed=feed,
-                                  fetch_list=fetch_list, scope=scope)
+                                  fetch_list=fetch_list, scope=scope,
+                                  return_numpy=False)
             step += 1
             if fetch_list and print_period and step % print_period == 0:
+                # the only per-step host sync point, and only when printing
                 msg = ", ".join(
                     f"{name}={np.asarray(val).ravel()[:4]}"
                     for name, val in zip(fetch_info, last_fetch))
                 logger.info("step %d: %s", step, msg)
+        if last_fetch is not None:
+            last_fetch = [np.asarray(v) for v in last_fetch]
         return last_fetch
 
 

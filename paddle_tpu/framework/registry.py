@@ -89,6 +89,14 @@ class LowerCtx:
     TPU-native replacement for NCCL ring_ids (platform/collective_helper.h).
     """
 
+    # Monotone count of rng-key consumptions across ALL contexts (sub-block
+    # contexts included).  The executor samples it around a block trace to
+    # learn whether the program consumes randomness at all; rng-free programs
+    # then skip the per-step fold_in on the dispatch fast path.  Races can
+    # only over-count (another thread tracing concurrently), which degrades
+    # to the safe per-step fold_in — never to key reuse.
+    rng_use_count: int = 0
+
     def __init__(self, program, block, env, rng_key=None, mesh_axes=None, is_test=False):
         self.program = program
         self.block = block
@@ -100,6 +108,7 @@ class LowerCtx:
         self.is_test = is_test
 
     def next_rng(self, salt: int = 0):
+        LowerCtx.rng_use_count += 1
         if self._rng_key is None:
             # deterministic fallback (e.g. shape inference)
             self._rng_key = jax.random.PRNGKey(0)
@@ -119,6 +128,7 @@ class LowerCtx:
         """
         import zlib
 
+        LowerCtx.rng_use_count += 1
         if self._rng_key is None:
             self._rng_key = jax.random.PRNGKey(0)
         names = op.attr("__rng_names__") if hasattr(op, "attr") else None

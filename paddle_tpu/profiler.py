@@ -46,6 +46,21 @@ class RecordEvent:
 record_event = RecordEvent
 
 
+def add_event(name: str, t0_ns: int, dur_ns: int):
+    """Append a host event whose name is only known after it finished (e.g.
+    'compile_cache/hit' vs 'compile_cache/cold' — the verdict exists once the
+    first execution returns)."""
+    if _active:
+        _events.append({
+            "name": name,
+            "ph": "X",
+            "ts": t0_ns / 1000.0,
+            "dur": dur_ns / 1000.0,
+            "pid": os.getpid(),
+            "tid": 0,
+        })
+
+
 def start_profiler(state="All", tracer_option="Default"):
     global _active, _trace_dir
     _active = True

@@ -48,6 +48,93 @@ def _latest_xplane(trace_dir: str) -> Optional[str]:
     return max(files, key=os.path.getmtime) if files else None
 
 
+def _line_role(name: str, event_names: Iterable[str]) -> str:
+    """Classify a device-plane trace line from OBSERVED names.
+
+    Runtimes disagree on line naming ('XLA Ops' vs bare module lines), and
+    trusting one runtime's labels is exactly what multi-counted
+    PROFILE_STEP.json (round-5 advisor): whole-step envelopes ('jit_step',
+    per-step events '0'..'7') and DMA streams ('copy-done') summed on top of
+    the real op timeline. Roles:
+      'ops'     — the execution timeline (the only line worth summing)
+      'steps'   — whole-step envelopes
+      'modules' — whole-executable envelopes
+      'async'   — DMA/infeed streams that overlap compute
+      'host'    — TraceMe/framework annotation lines
+    Line names are tried first; unknown names fall back to what the line's
+    events are called.
+    """
+    n = str(name).strip().lower()
+    if "async" in n or "dma" in n:
+        return "async"
+    if n == "steps" or n.startswith("step"):
+        return "steps"
+    if "module" in n:
+        return "modules"
+    if "traceme" in n or "framework" in n or "scope" in n:
+        return "host"
+    if "op" in n:
+        return "ops"
+    names = [str(e) for e in event_names if str(e)]
+    if names:
+        total = len(names)
+        if sum(t.isdigit() for t in names) / total > 0.5:
+            return "steps"  # per-step envelopes named 0,1,2,...
+        if sum(t.startswith(("jit_", "jit(")) or "module" in t.lower()
+               for t in names) / total > 0.5:
+            return "modules"
+        if sum(t.lower().startswith(("copy", "send", "recv", "infeed",
+                                     "outfeed"))
+               for t in names) / total > 0.8:
+            return "async"
+    return "ops"
+
+
+def _exclusive_sweep(evs: List[list]) -> Tuple[List[list], int]:
+    """Subtract child spans from their innermost enclosing parent (properly
+    nested spans assumed). Appends r[4] = exclusive duration to every row.
+
+    Partially overlapping (non-nested) spans can drive a parent's exclusive
+    duration negative; those are clamped to zero and COUNTED (returned as
+    n_clamped) instead of silently dropped, so broken attribution is visible
+    (round-5 advisor, device_trace.py:128).
+    """
+    evs.sort(key=lambda r: (r[0], -r[1]))
+    stack: List[list] = []
+    for r in evs:
+        while stack and r[0] >= stack[-1][0] + stack[-1][1]:
+            stack.pop()
+        if stack:
+            stack[-1][4] -= r[1]
+        r.append(r[1])     # r[4] = exclusive dur
+        stack.append(r)
+    n_clamped = 0
+    for r in evs:
+        if r[4] < 0:
+            r[4] = 0.0
+            n_clamped += 1
+    return evs, n_clamped
+
+
+def _check_busy_le_wall(rows: List[list], where: str,
+                        tolerance: float = 1.001) -> bool:
+    """Device planes execute serially: sum(exclusive) must fit in the wall
+    span. Returns False (and warns) when the rows are multi-counted."""
+    import sys
+
+    if not rows:
+        return True
+    wall = max(r[0] + r[1] for r in rows) - min(r[0] for r in rows)
+    busy = sum(r[4] for r in rows)
+    if busy > wall * tolerance:
+        print(f"[device_trace] warning: exclusive sum {busy / 1e6:.1f} ms "
+              f"exceeds wall {wall / 1e6:.1f} ms on {where} — events are "
+              f"multi-counted; refusing exclusive attribution",
+              file=sys.stderr)
+        return False
+    return True
+
+
 def device_events(trace_dir: str,
                   exclusive: bool = False) -> Iterable[Tuple[str, str, float]]:
     """Yield (hlo_module, hlo_op, duration_ns) for every device-executed
@@ -57,11 +144,18 @@ def device_events(trace_dir: str,
     whole-step envelopes, 'Async XLA Ops' are DMA streams overlapping
     compute, and 'XLA Ops' is the execution timeline — only the latter is
     yielded (summing every line triple-counts: each step appears as a Step
-    event, a Module event, and its ops). 'XLA Ops' itself nests parent
-    spans (%while, call ops) above their children on the same line; with
-    ``exclusive=True`` each event's duration has its childrens' subtracted,
-    so a sum over all events equals measured device-busy time.
+    event, a Module event, and its ops). Line roles are detected from the
+    OBSERVED line/event names (``_line_role``), not one runtime's labels.
+    'XLA Ops' itself nests parent spans (%while, call ops) above their
+    children on the same line; with ``exclusive=True`` each event's duration
+    has its childrens' subtracted, so a sum over all events equals measured
+    device-busy time — and that invariant is CHECKED: a line whose exclusive
+    sum exceeds its wall-clock span is multi-counted, and exclusive
+    attribution for it is refused (with a warning) rather than emitted
+    corrupt (the round-5 PROFILE_STEP.json failure mode).
     """
+    import sys
+
     from jax.profiler import ProfileData
 
     path = _latest_xplane(trace_dir)
@@ -72,20 +166,31 @@ def device_events(trace_dir: str,
         device_plane = plane.name.startswith("/device:")
         lines = list(plane.lines)
         if device_plane:
-            op_lines = [ln for ln in lines if str(ln.name) == "XLA Ops"]
+            classified = [
+                (ln, _line_role(str(ln.name), (str(ev.name)
+                                               for ev in ln.events)))
+                for ln in lines
+            ]
+            op_lines = [ln for ln, role in classified if role == "ops"]
             if op_lines:
                 lines = op_lines
+            elif exclusive:
+                print(f"[device_trace] warning: no op-role line detected on "
+                      f"{plane.name} (lines: "
+                      f"{[str(ln.name) for ln in lines]}); refusing "
+                      f"exclusive attribution for this plane",
+                      file=sys.stderr)
+                continue
             else:
-                # unknown runtime naming: at least drop the whole-step
-                # envelope lines and the async DMA streams (which overlap
-                # compute) so the sum stays ~1x, and say so
-                import sys
-                lines = [ln for ln in lines
-                         if str(ln.name) not in ("Steps", "XLA Modules",
-                                                 "Async XLA Ops")]
-                print(f"[device_trace] warning: no 'XLA Ops' line on "
-                      f"{plane.name}; summing {[str(l.name) for l in lines]}"
+                # inclusive mode keeps a permissive fallback: drop the
+                # recognized envelope/DMA lines, sum the rest, and say so
+                lines = [ln for ln, role in classified
+                         if role not in ("steps", "modules", "async")]
+                print(f"[device_trace] warning: no op-role line on "
+                      f"{plane.name}; summing "
+                      f"{[str(ln.name) for ln in lines]}"
                       f" (attribution may overlap)", file=sys.stderr)
+        plane_rows: List[list] = []   # device rows held for the plane check
         for line in lines:
             # execution lines only: TPU device planes, or the PJRT CPU
             # client's runtime line — host python/trace-me lines may carry
@@ -115,21 +220,29 @@ def device_events(trace_dir: str,
             if exclusive and evs:
                 # properly nested spans: sweep by start, subtract each
                 # event's duration from its innermost enclosing parent
-                evs.sort(key=lambda r: (r[0], -r[1]))
-                stack: List[list] = []
-                for r in evs:
-                    while stack and r[0] >= stack[-1][0] + stack[-1][1]:
-                        stack.pop()
-                    if stack:
-                        stack[-1][4] -= r[1]
-                    r.append(r[1])     # r[4] = exclusive dur
-                    stack.append(r)
-                for start, dur, module, hlo_op, excl in evs:
-                    if excl > 0:
+                evs, n_clamped = _exclusive_sweep(evs)
+                if n_clamped:
+                    print(f"[device_trace] warning: {n_clamped} event(s) on "
+                          f"'{line.name}' ({plane.name}) had negative "
+                          f"exclusive duration (non-nested overlap); "
+                          f"clamped to 0", file=sys.stderr)
+                if device_plane:
+                    plane_rows.extend(evs)
+                else:
+                    for start, dur, module, hlo_op, excl in evs:
                         yield module, hlo_op, excl
             else:
                 for start, dur, module, hlo_op in evs:
                     yield module, hlo_op, dur
+        if exclusive and plane_rows:
+            # device-busy invariant: one device executes serially, so the
+            # exclusive sum over everything about to be attributed must fit
+            # in the plane's wall span. A violation means envelope/DMA lines
+            # slipped past role detection (the PROFILE_STEP.json corruption:
+            # busy 4.2x wall) — refuse rather than emit multi-counted rows.
+            if _check_busy_le_wall(plane_rows, str(plane.name)):
+                for start, dur, module, hlo_op, excl in plane_rows:
+                    yield module, hlo_op, excl
 
 
 def measured_op_rows(trace_dir: str, hlo_texts: List[str]) -> List[dict]:

@@ -20,6 +20,57 @@ def test_hlo_op_name_map_parses_metadata():
     assert "ptop_relu__z" in m["fusion.2"]
 
 
+def test_line_role_detection_from_names():
+    """ADVICE r5: trace line roles come from OBSERVED names, not one
+    runtime's labels — envelopes and DMA streams must not be summed."""
+    role = device_trace._line_role
+    # explicit runtime labels
+    assert role("XLA Ops", []) == "ops"
+    assert role("Steps", []) == "steps"
+    assert role("XLA Modules", []) == "modules"
+    assert role("Async XLA Ops", []) == "async"
+    assert role("TensorFlow Name Scope", []) == "host"
+    # unknown labels: classify from event names (the PROFILE_STEP.json
+    # corruption shapes: per-step envelopes '0'..'7', module envelopes
+    # 'jit_step', DMA 'copy-done')
+    assert role("Line#1", ["0", "1", "2", "3"]) == "steps"
+    assert role("Line#2", ["jit_step", "jit_step"]) == "modules"
+    assert role("Line#3", ["copy-done", "copy-start", "copy.1",
+                           "copy-done", "copy-done"]) == "async"
+    assert role("Line#4", ["fusion.1", "%while", "dot.3"]) == "ops"
+
+
+def test_exclusive_sweep_clamps_negative_and_counts():
+    """ADVICE r5 (device_trace.py:128): partially overlapping (non-nested)
+    spans drove a parent's exclusive duration negative and it was silently
+    dropped; now it is clamped to zero and counted."""
+    # parent [0,100); child A [10,70); child B [50,130) overlaps A
+    evs = [[0.0, 100.0, "m", "p"],
+           [10.0, 60.0, "m", "a"],
+           [50.0, 80.0, "m", "b"]]
+    rows, n_clamped = device_trace._exclusive_sweep(evs)
+    assert n_clamped == 1
+    by_op = {r[3]: r[4] for r in rows}
+    assert by_op["a"] == 0.0          # clamped, not dropped
+    assert by_op["p"] == 40.0
+    assert by_op["b"] == 80.0
+    # clamped total still fits in the wall span
+    assert device_trace._check_busy_le_wall(rows, "test-plane")
+
+
+def test_busy_le_wall_refuses_multicounted_rows(capsys):
+    """ADVICE r5: busy 4.2x wall (envelope+DMA rows multi-counted) must be
+    refused, not emitted as 'measured exclusive per-op device time'."""
+    # two full-span copies of the same 100ns step as seen from an envelope
+    # line that slipped through: exclusive sum 300 vs wall 100
+    rows = [[0.0, 100.0, "m", "step_env", 100.0],
+            [0.0, 100.0, "m", "module_env", 100.0],
+            [0.0, 100.0, "m", "op", 100.0]]
+    assert not device_trace._check_busy_le_wall(rows, "test-plane")
+    err = capsys.readouterr().err
+    assert "refusing exclusive attribution" in err
+
+
 def test_profiler_measured_attribution(tmp_path, capsys, monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path / "trace"))
     main, startup = fluid.Program(), fluid.Program()
