@@ -69,7 +69,8 @@ def _run_timed(cmd, env, timeout_s):
 
 
 def _probe(attempts=PROBE_ATTEMPTS):
-    """Initialize the backend in a subprocess; return platform or None.
+    """Initialize the backend in a subprocess; return (platform, kind),
+    (None, None) when no backend comes up.
 
     Retries: a single timed-out probe must not forfeit the round's hardware
     number (BENCH_r03 lesson) — the axon relay claim left by a dead process
@@ -77,7 +78,8 @@ def _probe(attempts=PROBE_ATTEMPTS):
     succeeds where the first hung.
     """
     code = ("import jax; d = jax.devices()[0]; "
-            "print('PLATFORM=%s KIND=%s' % (d.platform, d.device_kind))")
+            "print('PLATFORM=%s KIND=%s' % (d.platform, "
+            "str(d.device_kind).replace(' ', '_')))")
     for attempt in range(1, attempts + 1):
         rc, out = _run_timed([sys.executable, "-c", code], dict(os.environ),
                              PROBE_TIMEOUT_S)
@@ -88,10 +90,15 @@ def _probe(attempts=PROBE_ATTEMPTS):
         if rc != 0:
             _log(f"probe attempt {attempt}/{attempts} failed rc={rc}")
             continue
+        platform = kind = None
         for tok in out.split():
             if tok.startswith("PLATFORM="):
-                return tok.split("=", 1)[1]
-    return None
+                platform = tok.split("=", 1)[1]
+            elif tok.startswith("KIND="):
+                kind = tok.split("=", 1)[1].replace("_", " ")
+        if platform:
+            return platform, kind
+    return None, None
 
 
 def _run_worker(env, timeout_s, extra_args):
@@ -121,8 +128,8 @@ def launcher():
     remaining = lambda: TOTAL_BUDGET_S - (time.time() - t0)
     result = None
 
-    platform = _probe()
-    _log(f"probe platform: {platform}")
+    platform, device_kind = _probe()
+    _log(f"probe platform: {platform} kind: {device_kind}")
     saw_accelerator = platform not in (None, "cpu")
     if saw_accelerator:
         budget = max(60.0, remaining() - CPU_RESERVE_S - 90)
@@ -185,6 +192,17 @@ def launcher():
                   "unit": "tokens/s", "vs_baseline": None, "degraded": True,
                   "detail": {"error": "all bench attempts failed/timed out"}}
     result.setdefault("degraded", False)
+    # stamp the backend + device kind the NUMBER was measured on (from the
+    # worker that produced it, falling back to the probe), and never let a
+    # non-TPU backend masquerade as a chip number (the BENCH_r05.json
+    # failure mode): backend != tpu forces degraded.
+    det = result.get("detail", {})
+    backend = det.get("platform") or platform or "unknown"
+    result["backend"] = backend
+    result["device_kind"] = det.get("device") or device_kind or backend
+    if backend != "tpu" and not result.get("degraded"):
+        _log(f"backend {backend!r} is not TPU — marking degraded")
+        result["degraded"] = True
     if result.get("degraded"):
         # a CPU toy's MFU-shaped number must never masquerade as the hardware
         # yardstick: null it and say why, keeping the raw value in detail
@@ -193,8 +211,8 @@ def launcher():
             ("accelerator bench attempts failed/timed out after a successful "
              "probe" if saw_accelerator else
              "accelerator probe failed" if _expects_accelerator() else
-             "no accelerator expected and the CPU bench itself failed") +
-            "; CPU fallback — vs_baseline (MFU) is only meaningful on the "
+             f"measured on backend {backend!r}, not TPU") +
+            "; non-TPU run — vs_baseline (MFU) is only meaningful on the "
             "real chip")
         if result.get("vs_baseline") is not None:
             det["cpu_mfu_not_comparable"] = result["vs_baseline"]
@@ -311,6 +329,7 @@ def resnet_worker():
                    "image": hw, "steps": steps,
                    "flops_per_step_g": round(flops / 1e9, 1),
                    "loss": round(loss_v, 4),
+                   "platform": dev.platform,
                    "device": str(getattr(dev, "device_kind", dev.platform))},
     }), flush=True)
 
@@ -388,6 +407,7 @@ def ernie_worker():
                    "seq_len": T, "steps": steps,
                    "model_params": int(n_params),
                    "loss": round(loss_v, 4),
+                   "platform": dev.platform,
                    "device": str(getattr(dev, "device_kind", dev.platform))},
     }), flush=True)
 
@@ -451,6 +471,21 @@ def worker(use_flash: bool):
 
     wide_mode = "--wide" in sys.argv
     no_remat = "--no-remat" in sys.argv
+    # remat selectable BY NAME through the first-class policy API
+    # (paddle_tpu.parallel.remat): --remat=none|full|dots|save_only_flash.
+    # The legacy spellings stay: --no-remat == --remat=none, and the
+    # default remains the measured winner "dots".
+    from paddle_tpu.parallel import remat as remat_mod
+
+    remat_name = next((a.split("=", 1)[1] for a in sys.argv
+                       if a.startswith("--remat=")), None)
+    if remat_name is None:
+        remat_name = "none" if no_remat else "dots"
+    rpolicy = remat_mod.resolve(remat_name)
+    # A/B lever: --ce-vchunk=N routes the LM-head loss through the
+    # vocab-chunked chunked_lm_loss path (docs/memory_levers.md)
+    ce_vchunk = int(next((a.split("=", 1)[1] for a in sys.argv
+                          if a.startswith("--ce-vchunk=")), 0))
     if on_acc and wide_mode:
         # MXU-saturating width (d_model 2048, head_dim 128) shows the
         # framework ceiling — GPT_SMALL's 768-wide matmuls cap its MFU well
@@ -461,21 +496,26 @@ def worker(use_flash: bool):
         # moments) and measures WORSE (0.691 at b=8), see KERNEL_NOTES.md.
         cfg = G.GPT_SMALL.scaled(
             max_seq_len=1024, use_flash=use_flash, d_model=2048,
-            num_heads=16, d_ff=8192, num_layers=6, remat=not no_remat,
-            remat_policy="full" if no_remat else "dots",
+            num_heads=16, d_ff=8192, num_layers=6,
+            remat=not rpolicy.is_none, remat_policy=rpolicy.name,
             ce_direct_bytes_limit=(1 << 30))
         batch, T, steps = (16, 1024, 10)
-        tag = "gpt_wide" + ("_noremat" if no_remat else "")
+        tag = "gpt_wide" + ("" if rpolicy.name == "dots"
+                            else f"_remat_{rpolicy.name}")
     elif on_acc:
         cfg = G.GPT_SMALL.scaled(max_seq_len=1024, use_flash=use_flash,
-                                 remat=not no_remat,
-                                 remat_policy="full" if no_remat else "dots")
+                                 remat=not rpolicy.is_none,
+                                 remat_policy=rpolicy.name)
         batch, T, steps = 16, 1024, 10
-        tag = "gpt_small" + ("_noremat" if no_remat else "")
+        tag = "gpt_small" + ("" if rpolicy.name == "dots"
+                             else f"_remat_{rpolicy.name}")
     else:  # CPU smoke path so the bench always produces a line
         cfg = G.GPT_TINY.scaled(num_layers=2)
         batch, T, steps = 4, 32, 3
         tag = "gpt_tiny_cpu"
+    if ce_vchunk:
+        cfg = cfg.scaled(ce_vocab_chunk=ce_vchunk, ce_direct_bytes_limit=0)
+        tag += f"_vchunk{ce_vchunk}"
 
     tokens_per_s, mfu, loss_v, n_params = measure(
         tag, cfg, batch, T, steps)
@@ -487,6 +527,7 @@ def worker(use_flash: bool):
         "seq_len": T, "batch": batch, "steps": steps,
         "device": str(getattr(dev, "device_kind", dev.platform)),
         "platform": dev.platform,
+        "remat_policy": rpolicy.name if on_acc else "none",
         "flash": bool(on_acc and use_flash),
         "loss": round(loss_v, 4),
         "tokens_per_s": round(tokens_per_s, 2),

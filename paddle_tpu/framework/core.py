@@ -173,6 +173,12 @@ _GLOBAL_FLAGS = {
     # re-normalization and cache-key rebuild. False = always take the
     # full (pre-record) path; used for A/B in tools/dispatch_bench.py.
     "FLAGS_dispatch_fast_path": True,
+    # opt-in flat-buffer fused optimizer sweep (optimizer.py
+    # apply_gradients): one fused update op per (dtype, hparam-signature)
+    # parameter group with moments in a flat megabuffer layout, instead of
+    # one update op per parameter. Equivalent to passing fuse=True to the
+    # optimizer constructor; see docs/memory_levers.md.
+    "FLAGS_fuse_optimizer": False,
     # persistent XLA compilation cache directory ('' = disabled). When set,
     # repeated processes compiling the same program hit the on-disk cache
     # instead of paying the cold XLA compile (jax_compilation_cache_dir).

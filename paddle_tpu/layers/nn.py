@@ -469,7 +469,16 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
-                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+                               numeric_stable_mode=True, return_softmax=False, axis=-1,
+                               vocab_chunk=0):
+    """``vocab_chunk > 0`` selects the chunked lowering (docs/memory_levers.md):
+    loss and its backward are blocked over the class axis so the f32
+    softmax intermediates never materialize at full vocab width. The
+    Softmax output is not produced in that mode."""
+    if vocab_chunk and (return_softmax or soft_label):
+        raise ValueError(
+            "vocab_chunk CE does not materialize the softmax; "
+            "return_softmax/soft_label need vocab_chunk=0")
     helper = LayerHelper("softmax_with_cross_entropy")
     softmax_out = helper.create_variable_for_type_inference(logits.dtype)
     loss = helper.create_variable_for_type_inference(logits.dtype)
@@ -477,7 +486,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
         type="softmax_with_cross_entropy",
         inputs={"Logits": [logits], "Label": [label]},
         outputs={"Softmax": [softmax_out], "Loss": [loss]},
-        attrs={"soft_label": soft_label, "ignore_index": ignore_index, "axis": axis},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index, "axis": axis,
+               "vocab_chunk": int(vocab_chunk)},
     )
     if return_softmax:
         return loss, softmax_out

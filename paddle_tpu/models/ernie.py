@@ -36,8 +36,14 @@ class ErnieConfig:
     d_ff: int = 3072
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # named policy (paddle_tpu.parallel.remat): none|full|dots|save_only_flash
+    remat_policy: str = "full"
     use_flash: bool = False
     max_masked: int = 20          # MLM positions per sample (static)
+    # >0: the tied-decoder MLM projection + CE runs vocab-chunked
+    # (ops/pallas_kernels.chunked_lm_loss) — [B, M, V] f32 logits never
+    # materialize
+    ce_vocab_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -174,9 +180,10 @@ def encode(params, tokens, seg_ids, pad_mask, cfg: ErnieConfig):
     x = _ln(x.astype(cfg.dtype), params["ln_emb_scale"],
             params["ln_emb_bias"])
 
-    f = _block
-    if cfg.remat:
-        f = jax.checkpoint(_block, static_argnums=(3,))
+    from ..parallel import remat as remat_mod
+
+    f = remat_mod.resolve(cfg.remat_policy, remat=cfg.remat).wrap(
+        _block, static_argnums=(3,))
 
     def body(h, layer_p):
         return f(layer_p, h, pad_mask, cfg), None
@@ -202,16 +209,26 @@ def pretrain_loss(params, batch, cfg: ErnieConfig):
         jnp.einsum("bmd,de->bme", hm, params["mlm_w"].astype(cfg.dtype))
         + params["mlm_b"].astype(cfg.dtype), approximate=False)
     hm = _ln(hm, params["mlm_ln_scale"], params["mlm_ln_bias"])
-    logits = jnp.einsum("bmd,vd->bmv", hm,
-                        params["wte"].astype(cfg.dtype)) \
-        + params["mlm_dec_bias"].astype(cfg.dtype)     # tied decoder
-    logits = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(
-        logits, batch["mlm_ids"][..., None], axis=-1)[..., 0]
-    mlm_ce = jnp.where(batch["mlm_valid"], lse - gold, 0.0)
     n_masked = jnp.maximum(jnp.sum(batch["mlm_valid"]), 1)
-    mlm_loss = jnp.sum(mlm_ce) / n_masked
+    if cfg.ce_vocab_chunk:
+        # vocab-chunked tied-decoder CE: [B, M, V] f32 logits never
+        # materialize (head_layout="vd" slices wte rows — no transpose)
+        from ..ops.pallas_kernels import chunked_lm_loss
+
+        mlm_loss = chunked_lm_loss(
+            hm, params["wte"].astype(cfg.dtype), batch["mlm_ids"],
+            bias=params["mlm_dec_bias"], valid=batch["mlm_valid"],
+            vocab_chunk=cfg.ce_vocab_chunk, head_layout="vd") / n_masked
+    else:
+        logits = jnp.einsum("bmd,vd->bmv", hm,
+                            params["wte"].astype(cfg.dtype)) \
+            + params["mlm_dec_bias"].astype(cfg.dtype)     # tied decoder
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, batch["mlm_ids"][..., None], axis=-1)[..., 0]
+        mlm_ce = jnp.where(batch["mlm_valid"], lse - gold, 0.0)
+        mlm_loss = jnp.sum(mlm_ce) / n_masked
 
     pooled = jnp.tanh(h[:, 0] @ params["pool_w"].astype(cfg.dtype)
                       + params["pool_b"].astype(cfg.dtype))

@@ -38,8 +38,10 @@ class GPTConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16   # compute dtype (params stay f32)
     remat: bool = True          # jax.checkpoint each block (HBM <-> FLOPs)
-    # "full": recompute everything (min HBM); "dots": save matmul outputs,
-    # recompute elementwise (recovers most MFU at modest HBM cost)
+    # named policy from paddle_tpu.parallel.remat: none|full|dots|
+    # save_only_flash ("full" recomputes everything, "dots" saves matmul
+    # outputs and recomputes elementwise, "save_only_flash" saves only the
+    # tagged attention outputs). Old spellings remain valid aliases.
     remat_policy: str = "full"
     use_flash: bool = False     # Pallas flash-attention kernel on TPU
     # True: one lax.scan over the stacked layer axis (HLO size O(1) in
@@ -56,12 +58,17 @@ class GPTConfig:
     # rows per CE chunk: bigger chunks = fewer, larger (more MXU-efficient)
     # vocab matmuls in the scan, at chunk*V*4 bytes of live logits each
     ce_chunk: int = 2048
+    # columns per CE vocab chunk: >0 additionally blocks the vocab axis with
+    # an online-logsumexp forward + chunked custom_vjp backward
+    # (ops/pallas_kernels.chunked_lm_loss) so even one row-chunk's logits
+    # never materialize at full vocab width
+    ce_vocab_chunk: int = 0
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots"):
-            raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got "
-                f"{self.remat_policy!r}")
+        from ..parallel import remat as remat_mod
+
+        # validates the name (old spellings resolve as aliases)
+        remat_mod.resolve(self.remat_policy)
 
     @property
     def head_dim(self) -> int:
@@ -162,17 +169,20 @@ def _causal_attention(q, k, v, cfg: GPTConfig):
     """q,k,v: [B, T, nh, hd] -> [B, T, nh, hd]. Plain XLA path; the Pallas
     flash kernel (ops/pallas_kernels.py) replaces this on TPU when
     cfg.use_flash — same signature, tiled online-softmax in VMEM."""
+    from ..parallel import remat as remat_mod
+
     if cfg.use_flash:
         from ..ops.pallas_kernels import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        # tagged so the save_only_flash remat policy can keep exactly these
+        return remat_mod.checkpoint_name(flash_attention(q, k, v, causal=True))
     T = q.shape[1]
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
     logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return remat_mod.checkpoint_name(jnp.einsum("bhqk,bkhd->bqhd", probs, v))
 
 
 def block_fn(p, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
@@ -221,14 +231,10 @@ def block_fn(p, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
 
 def run_blocks(blocks, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
     """lax.scan over the stacked layer axis of ``blocks``."""
-    f = block_fn
-    if cfg.remat:
-        if cfg.remat_policy == "dots":
-            f = jax.checkpoint(
-                block_fn, static_argnums=(2, 3),
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        else:
-            f = jax.checkpoint(block_fn, static_argnums=(2, 3))
+    from ..parallel import remat as remat_mod
+
+    policy = remat_mod.resolve(cfg.remat_policy, remat=cfg.remat)
+    f = policy.wrap(block_fn, static_argnums=(2, 3))
 
     if not cfg.scan_layers:
         L = jax.tree_util.tree_leaves(blocks)[0].shape[0]
@@ -302,6 +308,15 @@ def ce_from_hidden(params, x, labels, cfg: GPTConfig,
     rows = x.reshape(B * T, D)
     labs = labels.reshape(B * T)
     n = rows.shape[0]
+    if cfg.ce_vocab_chunk:
+        # vocab-blocked online-logsumexp CE: neither the row-chunk nor the
+        # full [rows, V] logits ever materialize (Pallas-tiled on TPU,
+        # pure-lax elsewhere)
+        from ..ops.pallas_kernels import chunked_lm_loss
+
+        return chunked_lm_loss(
+            rows, head.astype(cfg.dtype), labs,
+            vocab_chunk=cfg.ce_vocab_chunk, row_chunk=chunk)
     # direct path when the f32 logits comfortably fit (chunking buys memory
     # at ~1/6 extra vocab-head FLOPs — not worth it below ~4 GiB, a quarter
     # of v5e HBM)

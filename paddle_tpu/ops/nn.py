@@ -77,12 +77,40 @@ def log_softmax(ctx, op, ins):
 
 @register_op("softmax_with_cross_entropy", diff_inputs=("Logits",))
 def softmax_with_cross_entropy(ctx, op, ins):
-    """reference operators/softmax_with_cross_entropy_op.cc — fused, stable."""
+    """reference operators/softmax_with_cross_entropy_op.cc — fused, stable.
+
+    attrs['vocab_chunk'] > 0 selects the chunked lowering variant: the loss
+    (and its Logits grad, via custom_vjp) is computed blockwise over the
+    class axis with an online logsumexp, so the f32 log-softmax/softmax
+    intermediates never materialize at [batch*time, V] — only the Loss
+    output is produced (no Softmax), hard labels, last-axis only.
+    """
     logits = ins["Logits"][0]
     label = ins["Label"][0]
     axis = op.attr("axis", -1)
     soft_label = op.attr("soft_label", False)
     ignore_index = op.attr("ignore_index", -100)
+    vocab_chunk = int(op.attr("vocab_chunk", 0) or 0)
+    if vocab_chunk and not soft_label and axis in (-1, logits.ndim - 1):
+        from .pallas_kernels import chunked_softmax_ce_from_logits
+
+        v = logits.shape[-1]
+        vc = min(vocab_chunk, v)
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=-1)
+        lbl = lbl.astype(jnp.int32)
+        rows = logits.reshape(-1, v)
+        pad = (-v) % vc
+        if pad:  # -inf columns drop out of the logsumexp and get zero grad
+            rows = jnp.concatenate(
+                [rows, jnp.full((rows.shape[0], pad), -jnp.inf,
+                                rows.dtype)], axis=1)
+        ce = chunked_softmax_ce_from_logits(
+            rows, jnp.clip(lbl, 0, v - 1).reshape(-1), vc)
+        loss = ce.reshape(lbl.shape)[..., None].astype(logits.dtype)
+        loss = jnp.where(lbl[..., None] != ignore_index, loss, 0.0)
+        return {"Loss": loss}
     logp = jax.nn.log_softmax(logits, axis=axis)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)

@@ -369,3 +369,97 @@ def dgc_momentum(ctx, op, ins):
     return {"ParamOut": p_new.astype(p.dtype),
             "UOut": u_new.astype(u.dtype),
             "VOut": v_new.astype(v.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Fused flat-buffer update sweep (optimizer.py _apply_fused_gradients): one
+# op per (dtype, hparam-signature) parameter group. The group's params/grads
+# are concatenated into a flat megabuffer, the update runs once, and the new
+# params are sliced back out; moments live flat (the op's accumulator inputs
+# ARE the [numel] megabuffers), so the executor donates one buffer per group
+# instead of one per parameter. Elementwise math is identical to the
+# per-param ops above — parity is bit-level for f32 groups.
+# ---------------------------------------------------------------------------
+
+
+def _flat_cat(arrs, dtype):
+    flats = [a.astype(dtype).reshape(-1) for a in arrs]
+    return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+
+
+def _split_like(flat, params):
+    out, off = [], 0
+    for p in params:
+        n = int(p.size)
+        out.append(flat[off:off + n].reshape(p.shape).astype(p.dtype))
+        off += n
+    return out
+
+
+def _fused_lr(ins, op):
+    return _lr(ins).astype(jnp.float32) * float(op.attr("lr_mult", 1.0))
+
+
+@register_op("fused_sgd", grad=None, is_optimizer=True)
+def fused_sgd(ctx, op, ins):
+    ps, gs = ins["Param"], ins["Grad"]
+    dt = ps[0].dtype                     # group key pins one dtype per op
+    pf = _flat_cat(ps, dt)
+    gf = _flat_cat(gs, dt)
+    p_new = pf - _fused_lr(ins, op).astype(dt) * gf
+    return {"ParamOut": _split_like(p_new, ps)}
+
+
+@register_op("fused_momentum", grad=None, is_optimizer=True)
+def fused_momentum(ctx, op, ins):
+    ps, gs = ins["Param"], ins["Grad"]
+    v = ins["Velocity"][0]
+    lr = _fused_lr(ins, op)
+    mu = op.attr("mu", 0.9)
+    use_nesterov = op.attr("use_nesterov", False)
+    gf = _flat_cat(gs, jnp.float32)
+    pf = _flat_cat(ps, jnp.float32)
+    v_new = mu * v.astype(jnp.float32) + gf
+    if use_nesterov:
+        p_new = pf - (gf + mu * v_new) * lr
+    else:
+        p_new = pf - lr * v_new
+    return {"ParamOut": _split_like(p_new, ps),
+            "VelocityOut": v_new.astype(v.dtype)}
+
+
+def _fused_adam_impl(ctx, op, ins, coeff):
+    ps, gs = ins["Param"], ins["Grad"]
+    m, v = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    lr = _fused_lr(ins, op)
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    gf = _flat_cat(gs, jnp.float32)
+    pf = _flat_cat(ps, jnp.float32)
+    m_new = b1 * m + (1 - b1) * gf
+    v_new = b2 * v + (1 - b2) * gf * gf
+    b1p_f = b1p.reshape(()).astype(jnp.float32)
+    b2p_f = b2p.reshape(()).astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1 - b2p_f * b2) / (1 - b1p_f * b1)
+    p_new = pf - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    if coeff:
+        p_new = p_new - lr * coeff * pf    # decoupled weight decay (AdamW)
+    return {
+        "ParamOut": _split_like(p_new, ps),
+        "Moment1Out": m_new,
+        "Moment2Out": v_new,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("fused_adam", grad=None, is_optimizer=True)
+def fused_adam(ctx, op, ins):
+    return _fused_adam_impl(ctx, op, ins, coeff=0.0)
+
+
+@register_op("fused_adamw", grad=None, is_optimizer=True)
+def fused_adamw(ctx, op, ins):
+    return _fused_adam_impl(ctx, op, ins, coeff=op.attr("coeff", 0.01))

@@ -10,6 +10,14 @@ Currently:
     (tiled online softmax, O(T) memory instead of the O(T^2) logits
     materialization of the plain XLA path in models/gpt.py), with a
     hand-written backward (custom_vjp) in the same tiling.
+  * ``chunked_lm_loss`` — fused vocab-projection + cross-entropy that
+    blocks over the row (batch*time) and vocab axes: online-logsumexp
+    forward (Pallas-tiled on TPU, pure-lax scan elsewhere) and a chunked
+    custom_vjp backward, so the full-precision ``[rows, V]`` logits never
+    hit HBM. ``chunked_softmax_ce_from_logits`` is the same trick applied
+    to already-materialized logits (the ``softmax_with_cross_entropy``
+    op's ``vocab_chunk`` lowering variant): the f32 log-softmax
+    intermediates stay chunk-sized.
 
 Layout convention: the public API takes ``[B, T, nh, hd]`` (the GPT model's
 activation layout); kernels run on ``[BH, T, hd]`` with a 3-D grid
@@ -34,6 +42,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NUM_LANES = 128
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# jax renamed TPUCompilerParams -> CompilerParams across versions; take
+# whichever this jax ships
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
 
 
 def _interpret() -> bool:
@@ -177,7 +190,7 @@ def _fwd(q, k, v, bias, causal, sm_scale, block_q, block_k):
             pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
             pltpu.VMEM((block_q, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -340,7 +353,7 @@ def _bwd(q, k, v, o, lse, do, bias, causal, sm_scale, block_q, block_k):
         out_specs=pl.BlockSpec((1, block_q, hd), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -381,7 +394,7 @@ def _bwd(q, k, v, o, lse, do, bias, causal, sm_scale, block_q, block_k):
             pltpu.VMEM((block_k, hd), jnp.float32),
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args2)
@@ -463,3 +476,370 @@ def flash_attention(q, k, v, causal: bool = True,
     o = _flash(to_bh(q), to_bh(k), to_bh(v), bias_bh, causal, sm_scale,
                block_q, block_k)
     return from_bh(o)
+
+
+# ---------------------------------------------------------------------------
+# Chunked vocab-projection cross-entropy (fused linear + CE)
+# ---------------------------------------------------------------------------
+#
+# The LM-head matmul [rows, D] x [D, V] followed by softmax CE is the last
+# place a GPT training step touches an O(rows * V) buffer. Blocking over
+# both axes with an online logsumexp keeps every live temporary at
+# [row_chunk, vocab_chunk]; the backward recomputes each chunk's logits from
+# (x, head, lse) — one extra chunk matmul, the same trade flash attention
+# makes for the T^2 score matrix.
+
+
+def _ce_chunk_logits(x, head, bias, i, v_chunk, vocab, layout):
+    """Logits for vocab chunk ``i`` in f32, padded columns masked to -inf.
+
+    ``layout`` is "dv" (head [D, Vp]) or "vd" (head [Vp, D] — e.g. a tied
+    embedding decoder); slicing the chunk out of ``head`` never transposes
+    or materializes the full projection.
+    """
+    if layout == "dv":
+        h = jax.lax.dynamic_slice_in_dim(head, i * v_chunk, v_chunk, axis=1)
+        lg = jnp.dot(x, h, preferred_element_type=jnp.float32)
+    else:
+        h = jax.lax.dynamic_slice_in_dim(head, i * v_chunk, v_chunk, axis=0)
+        lg = jnp.dot(x, h.T, preferred_element_type=jnp.float32)
+    lg = lg.astype(jnp.float32)
+    if bias is not None:
+        lg = lg + jax.lax.dynamic_slice_in_dim(
+            bias, i * v_chunk, v_chunk, axis=0).astype(jnp.float32)
+    col = i * v_chunk + jnp.arange(v_chunk)
+    lg = jnp.where(col[None, :] < vocab, lg, _NEG_INF)
+    return lg, col, h
+
+
+def _ce_fwd_lax(x, head, bias, labels, v_chunk, vocab, layout):
+    """Online-logsumexp sweep over vocab chunks. Returns (lse, gold) f32 [n]."""
+    n = x.shape[0]
+    nv = (head.shape[1] if layout == "dv" else head.shape[0]) // v_chunk
+
+    def body(carry, i):
+        m, s, gold = carry
+        lg, col, _ = _ce_chunk_logits(x, head, bias, i, v_chunk, vocab, layout)
+        m_new = jnp.maximum(m, jnp.max(lg, axis=1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(lg - m_new[:, None]), axis=1)
+        gold = gold + jnp.sum(
+            jnp.where(col[None, :] == labels[:, None], lg, 0.0), axis=1)
+        return (m_new, s, gold), None
+
+    carry0 = (jnp.full((n,), -jnp.inf, jnp.float32),
+              jnp.zeros((n,), jnp.float32),
+              jnp.zeros((n,), jnp.float32))
+    (m, s, gold), _ = jax.lax.scan(body, carry0, jnp.arange(nv))
+    return m + jnp.log(s), gold
+
+
+def _ce_fwd_kernel(*refs, block_v, num_v, vocab, has_bias):
+    """Pallas forward: grid (row_blocks, vocab_blocks), vocab sequential.
+    Per-row running max / sum / gold-logit live lane-replicated in VMEM
+    scratch across vocab steps (same statistics layout as flash attention).
+    """
+    if has_bias:
+        x_ref, h_ref, lab_ref, b_ref, lse_ref, gold_ref, m_scr, l_scr, g_scr \
+            = refs
+    else:
+        x_ref, h_ref, lab_ref, lse_ref, gold_ref, m_scr, l_scr, g_scr = refs
+        b_ref = None
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        g_scr[...] = jnp.zeros(g_scr.shape, jnp.float32)
+
+    x = x_ref[...]                                     # (rb, D)
+    h = h_ref[...]                                     # (D, bv)
+    s = jax.lax.dot_general(
+        x, h, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (rb, bv)
+    if b_ref is not None:
+        s = s + jnp.broadcast_to(b_ref[...].astype(jnp.float32), s.shape)
+    rb = s.shape[0]
+    col = vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (rb, block_v), 1)
+    s = jnp.where(col < vocab, s, _NEG_INF)
+
+    m_prev = m_scr[...]                                # (rb, 128) replicated
+    m_curr = jnp.max(s, axis=1)[:, None]
+    m_next = jnp.maximum(m_prev, m_curr)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - _bcast_lanes(m_next, block_v))
+    l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=1)[:, None]
+    m_scr[...] = m_next
+
+    lab = lab_ref[...][:, :1]                          # (rb, 1) lane 0
+    g_scr[...] += jnp.sum(jnp.where(col == lab, s, 0.0), axis=1)[:, None]
+
+    @pl.when(vi == num_v - 1)
+    def _finish():
+        l = l_scr[...]
+        lse_ref[...] = m_scr[...] + jnp.log(l)
+        gold_ref[...] = g_scr[...]
+
+
+def _ce_fwd_pallas(x, head, bias, labels, v_chunk, vocab,
+                   block_rows: int = 256):
+    """Pallas-tiled (lse, gold) for head layout "dv". Requires row count
+    divisible by the row block and head width by ``v_chunk`` (the wrapper
+    pads both)."""
+    n, d = x.shape
+    vp = head.shape[1]
+    rb = block_rows if n % block_rows == 0 else n
+    nv = vp // v_chunk
+    grid = (n // rb, nv)
+    kern = functools.partial(_ce_fwd_kernel, block_v=v_chunk, num_v=nv,
+                             vocab=vocab, has_bias=bias is not None)
+    labs = jnp.broadcast_to(labels.astype(jnp.int32)[:, None],
+                            (n, NUM_LANES))
+    in_specs = [
+        pl.BlockSpec((rb, d), lambda ri, vi: (ri, 0)),
+        pl.BlockSpec((d, v_chunk), lambda ri, vi: (0, vi)),
+        pl.BlockSpec((rb, NUM_LANES), lambda ri, vi: (ri, 0)),
+    ]
+    args = [x, head, labs]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, v_chunk), lambda ri, vi: (0, vi)))
+        args.append(bias.reshape(1, vp))
+    lse, gold = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((rb, NUM_LANES), lambda ri, vi: (ri, 0)),
+            pl.BlockSpec((rb, NUM_LANES), lambda ri, vi: (ri, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, NUM_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n, NUM_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rb, NUM_LANES), jnp.float32),
+            pltpu.VMEM((rb, NUM_LANES), jnp.float32),
+            pltpu.VMEM((rb, NUM_LANES), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return lse[:, 0], gold[:, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _chunked_ce(x, head, bias, labels, valid, v_chunk, vocab, layout,
+                use_pallas):
+    """Per-row CE [n] f32 from hidden rows x [n, D] and projection head,
+    never materializing [n, Vp]. ``valid`` (bool [n] or None) zeroes rows."""
+    ce, _ = _chunked_ce_fwd(x, head, bias, labels, valid, v_chunk, vocab,
+                            layout, use_pallas)
+    return ce
+
+
+def _chunked_ce_fwd(x, head, bias, labels, valid, v_chunk, vocab, layout,
+                    use_pallas):
+    labels = labels.astype(jnp.int32)
+    # lane-replicated statistics need a lane-aligned vocab block
+    if use_pallas and layout == "dv" and v_chunk % NUM_LANES == 0:
+        lse, gold = _ce_fwd_pallas(x, head, bias, labels, v_chunk, vocab)
+    else:
+        lse, gold = _ce_fwd_lax(x, head, bias, labels, v_chunk, vocab, layout)
+    ce = lse - gold
+    if valid is not None:
+        ce = jnp.where(valid, ce, 0.0)
+    return ce, (x, head, bias, labels, valid, lse)
+
+
+def _chunked_ce_bwd(v_chunk, vocab, layout, use_pallas, res, ct):
+    import numpy as _onp
+
+    x, head, bias, labels, valid, lse = res
+    n, d = x.shape
+    vp = head.shape[1] if layout == "dv" else head.shape[0]
+    nv = vp // v_chunk
+    g = ct.astype(jnp.float32)
+    if valid is not None:
+        g = jnp.where(valid, g, 0.0)
+
+    def body(carry, i):
+        dx, dhead, dbias = carry
+        lg, col, h = _ce_chunk_logits(x, head, bias, i, v_chunk, vocab,
+                                      layout)
+        p = jnp.exp(lg - lse[:, None])                 # masked cols -> 0
+        onehot = (col[None, :] == labels[:, None]).astype(jnp.float32)
+        dl = (p - onehot) * g[:, None]                 # (n, vc) f32
+        hf = h.astype(jnp.float32)
+        if layout == "dv":
+            dx = dx + jnp.dot(dl, hf.T)
+            dh = jnp.dot(x.astype(jnp.float32).T, dl)  # (D, vc)
+            dhead = jax.lax.dynamic_update_slice_in_dim(
+                dhead, dh, i * v_chunk, axis=1)
+        else:
+            dx = dx + jnp.dot(dl, hf)
+            dh = jnp.dot(dl.T, x.astype(jnp.float32))  # (vc, D)
+            dhead = jax.lax.dynamic_update_slice_in_dim(
+                dhead, dh, i * v_chunk, axis=0)
+        if bias is not None:
+            dbias = jax.lax.dynamic_update_slice_in_dim(
+                dbias, jnp.sum(dl, axis=0), i * v_chunk, axis=0)
+        return (dx, dhead, dbias), None
+
+    dhead0 = jnp.zeros((d, vp) if layout == "dv" else (vp, d), jnp.float32)
+    carry0 = (jnp.zeros((n, d), jnp.float32), dhead0,
+              jnp.zeros((vp,), jnp.float32))
+    (dx, dhead, dbias), _ = jax.lax.scan(body, carry0, jnp.arange(nv))
+    f0 = jax.dtypes.float0
+    return (dx.astype(x.dtype), dhead.astype(head.dtype),
+            None if bias is None else dbias.astype(bias.dtype),
+            _onp.zeros(labels.shape, f0),
+            None if valid is None else _onp.zeros(valid.shape, f0))
+
+
+_chunked_ce.defvjp(_chunked_ce_fwd, _chunked_ce_bwd)
+
+
+def chunked_lm_loss(x, head, labels, bias=None, valid=None,
+                    vocab_chunk: int = 1024, row_chunk: int = 0,
+                    head_layout: str = "dv",
+                    use_pallas: Optional[bool] = None):
+    """Summed token cross-entropy from hidden states, fused with the vocab
+    projection and blocked over both the row (batch*time) and vocab axes.
+
+    ``x`` [..., D]; ``head`` [D, V] (``head_layout="dv"``) or a tied
+    embedding table [V, D] (``"vd"``); ``labels`` int [...] matching x's
+    leading dims; ``bias`` optional [V]; ``valid`` optional bool [...]
+    masks rows out of the sum (padding / unmasked MLM slots).
+
+    Matches ``sum(lse - gold)`` (models/gpt.token_ce) to f32 reduction
+    tolerance; callers normalize, so distributed shards can psum partials.
+    On TPU the forward statistics (lse, gold) run as one Pallas kernel;
+    the backward is a pure-lax chunk sweep everywhere (each chunk's logits
+    are recomputed from x, head, lse — never more than
+    ``[row_chunk, vocab_chunk]`` live at once).
+    """
+    d = x.shape[-1]
+    rows = x.reshape(-1, d)
+    labs = labels.reshape(-1).astype(jnp.int32)
+    n = rows.shape[0]
+    v = head.shape[-1] if head_layout == "dv" else head.shape[0]
+    labs = jnp.clip(labs, 0, v - 1)
+    vmask = None if valid is None else valid.reshape(-1)
+    vc = max(1, min(int(vocab_chunk) or v, v))
+    if use_pallas is None:
+        use_pallas = head_layout == "dv" and jax.default_backend() == "tpu"
+
+    # pad the vocab axis to a chunk multiple (masked to -inf in-chunk; the
+    # pad's transpose slices the head cotangent back automatically)
+    pad_v = (-v) % vc
+    if pad_v:
+        if head_layout == "dv":
+            head = jnp.pad(head, ((0, 0), (0, pad_v)))
+        else:
+            head = jnp.pad(head, ((0, pad_v), (0, 0)))
+        if bias is not None:
+            bias = jnp.pad(bias, (0, pad_v))
+
+    rc = max(1, min(int(row_chunk) or n, n))
+    pad_r = (-n) % rc
+    if pad_r:
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((pad_r, d), rows.dtype)])
+        labs = jnp.concatenate([labs, jnp.zeros((pad_r,), labs.dtype)])
+        vmask = jnp.concatenate(
+            [jnp.ones((n,), bool) if vmask is None else vmask,
+             jnp.zeros((pad_r,), bool)])
+    nr = (n + pad_r) // rc
+    if nr == 1:
+        ce = _chunked_ce(rows, head, bias, labs, vmask, vc, v, head_layout,
+                         use_pallas)
+        return jnp.sum(ce)
+
+    xcs = rows.reshape(nr, rc, d)
+    lcs = labs.reshape(nr, rc)
+    vms = None if vmask is None else vmask.reshape(nr, rc)
+
+    def body(acc, args):
+        if vms is None:
+            xc, lc = args
+            vm = None
+        else:
+            xc, lc, vm = args
+        ce = _chunked_ce(xc, head, bias, lc, vm, vc, v, head_layout,
+                         use_pallas)
+        return acc + jnp.sum(ce), None
+
+    seq = (xcs, lcs) if vms is None else (xcs, lcs, vms)
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), seq)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Chunked CE over already-materialized logits (the softmax_with_cross_entropy
+# op's vocab_chunk lowering variant): the logits buffer exists, but the f32
+# log-softmax / softmax intermediates — the usual 2-4x blowup on a bf16
+# [B, T, V] head — stay [rows, vocab_chunk].
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def chunked_softmax_ce_from_logits(logits, labels, v_chunk: int):
+    """Per-row CE [n] f32 for logits [n, V] (V divisible by ``v_chunk``;
+    pad with -inf columns otherwise), labels int [n] in [0, V)."""
+    ce, _ = _logits_ce_fwd(logits, labels, v_chunk)
+    return ce
+
+
+def _logits_chunk(logits, i, v_chunk):
+    return jax.lax.dynamic_slice_in_dim(
+        logits, i * v_chunk, v_chunk, axis=1).astype(jnp.float32)
+
+
+def _logits_ce_fwd(logits, labels, v_chunk):
+    n, vp = logits.shape
+    nv = vp // v_chunk
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, i):
+        m, s, gold = carry
+        lg = _logits_chunk(logits, i, v_chunk)
+        col = i * v_chunk + jnp.arange(v_chunk)
+        m_new = jnp.maximum(m, jnp.max(lg, axis=1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(lg - m_new[:, None]), axis=1)
+        gold = gold + jnp.sum(
+            jnp.where(col[None, :] == labels[:, None], lg, 0.0), axis=1)
+        return (m_new, s, gold), None
+
+    carry0 = (jnp.full((n,), -jnp.inf, jnp.float32),
+              jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    (m, s, gold), _ = jax.lax.scan(body, carry0, jnp.arange(nv))
+    lse = m + jnp.log(s)
+    return lse - gold, (logits, labels, lse)
+
+
+def _logits_ce_bwd(v_chunk, res, ct):
+    import numpy as _onp
+
+    logits, labels, lse = res
+    n, vp = logits.shape
+    nv = vp // v_chunk
+    g = ct.astype(jnp.float32)
+
+    def body(dlogits, i):
+        lg = _logits_chunk(logits, i, v_chunk)
+        col = i * v_chunk + jnp.arange(v_chunk)
+        p = jnp.exp(lg - lse[:, None])
+        onehot = (col[None, :] == labels[:, None]).astype(jnp.float32)
+        dl = ((p - onehot) * g[:, None]).astype(logits.dtype)
+        return jax.lax.dynamic_update_slice_in_dim(
+            dlogits, dl, i * v_chunk, axis=1), None
+
+    dlogits, _ = jax.lax.scan(body, jnp.zeros_like(logits), jnp.arange(nv))
+    return dlogits, _onp.zeros(labels.shape, jax.dtypes.float0)
+
+
+chunked_softmax_ce_from_logits.defvjp(_logits_ce_fwd, _logits_ce_bwd)

@@ -115,6 +115,10 @@ class _EagerOptBlock:
 
 
 class Optimizer:
+    # fused flat-buffer sweep support: the fused op type this optimizer
+    # lowers to when fusion is on (None = per-param path only)
+    _fused_op_type: Optional[str] = None
+
     def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None,
                  parameter_list=None):
         self._learning_rate = learning_rate
@@ -124,6 +128,8 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._lr_var: Optional[Variable] = None
         self.type = "optimizer"
+        # opt-in flat-buffer fused update sweep (see apply_gradients)
+        self._fuse = False
         # dygraph mode: parameters to update + eager accumulator state
         self._parameter_list = parameter_list
         self._eager_block: Optional[_EagerOptBlock] = None
@@ -279,6 +285,8 @@ class Optimizer:
         return self.apply_gradients(params_grads)
 
     def apply_gradients(self, params_grads):
+        from .framework.core import get_flag
+
         params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
         program = default_main_program()
         # every op appended here is the optimize slice
@@ -290,6 +298,9 @@ class Optimizer:
                 params_grads = self._grad_clip(params_grads)
             params_grads = self._append_regularization_ops(params_grads)
             lr = self._create_lr_var(program)
+            if (self._fused_op_type is not None
+                    and (self._fuse or get_flag("FLAGS_fuse_optimizer"))):
+                return self._apply_fused_gradients(program, params_grads, lr)
             ops = []
             for p, g in params_grads:
                 if g is None:
@@ -298,6 +309,66 @@ class Optimizer:
                     program.global_block(), (p, g), lr))
             self._finish_update(program.global_block(), params_grads)
         return ops
+
+    # -- fused flat-buffer sweep -------------------------------------------
+    def _fused_hparam_key(self, param: Parameter) -> Tuple:
+        """Params sharing a key share one fused update op (and one flat
+        accumulator layout): same storage dtype + same per-param
+        hyperparameters (ParamAttr.learning_rate multiplier; AdamW adds its
+        decay-exclusion bit). Regularization and clipping are already folded
+        into the grads at this point, so they never split groups."""
+        mult = (getattr(param, "optimize_attr", None) or {}) \
+            .get("learning_rate", 1.0)
+        return (str(param.dtype), float(mult))
+
+    def _apply_fused_gradients(self, program, params_grads, lr_var):
+        """One fused update op per (dtype, hparam-signature) group instead of
+        one op per parameter: the lowering concatenates the group into a
+        flat megabuffer, runs a single vectorized update, and slices the
+        new params back out. Optimizer moments live in the SAME flat layout
+        as persistable [numel] buffers — the executor donates each group's
+        moments as one buffer instead of hundreds of tiny donations, and
+        checkpoints save/restore them under one name per group
+        (docs/memory_levers.md)."""
+        block = program.global_block()
+        groups: Dict[Tuple, List[Tuple[Parameter, Variable]]] = {}
+        for p, g in params_grads:
+            if g is None:
+                continue
+            groups.setdefault(self._fused_hparam_key(p), []).append((p, g))
+        ops = []
+        for key in sorted(groups, key=repr):
+            ops.append(self._append_fused_optimize_op(
+                block, groups[key], lr_var, key))
+        self._finish_update(block, params_grads)
+        return ops
+
+    def _add_group_accumulator(self, name: str, key, numel: int,
+                               fill_value=0.0, shape=None,
+                               dtype="float32") -> Variable:
+        """Flat accumulator for one fused group (the group analogue of
+        _add_accumulator; names are deterministic given build order, so a
+        rebuilt identical program resumes from the same checkpoint)."""
+        tag = f"{name}@{key!r}"
+        if name in self._accumulators and tag in self._accumulators[name]:
+            return self._accumulators[name][tag]
+        acc_name = unique_name.generate(f"fused_{self.type}_{name}")
+        shape = list(shape if shape is not None else [numel])
+        main_block = default_main_program().global_block()
+        var = main_block.create_var(
+            name=acc_name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True,
+        )
+        startup_block = default_startup_program().global_block()
+        sv = startup_block.create_var(
+            name=acc_name, shape=shape, dtype=dtype, persistable=True
+        )
+        ConstantInitializer(fill_value)(sv, startup_block)
+        self._accumulators.setdefault(name, {})[tag] = var
+        return var
+
+    def _append_fused_optimize_op(self, block, pgs, lr_var, key):
+        raise NotImplementedError
 
     def _append_regularization_ops(self, params_grads):
         from .regularizer import append_regularization_ops
@@ -326,10 +397,14 @@ class Optimizer:
 class SGDOptimizer(Optimizer):
     """fluid.optimizer.SGD (optimizer.py:842)."""
 
-    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None, parameter_list=None):
+    _fused_op_type = "fused_sgd"
+
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None, parameter_list=None,
+                 fuse=False):
         super().__init__(learning_rate, regularization, grad_clip, name,
                          parameter_list=parameter_list)
         self.type = "sgd"
+        self._fuse = bool(fuse)
 
     def _append_optimize_op(self, block, param_and_grad, lr_var):
         p, g = param_and_grad
@@ -340,17 +415,44 @@ class SGDOptimizer(Optimizer):
             outputs={"ParamOut": [p]},
         )
 
+    def _append_fused_optimize_op(self, block, pgs, lr_var, key):
+        params = [p for p, _ in pgs]
+        return block.append_op(
+            type="fused_sgd",
+            inputs={"Param": params, "Grad": [g for _, g in pgs],
+                    "LearningRate": [lr_var]},
+            outputs={"ParamOut": params},
+            attrs={"lr_mult": key[1]},
+        )
+
 
 class MomentumOptimizer(Optimizer):
     """fluid.optimizer.Momentum (optimizer.py:936)."""
 
+    _fused_op_type = "fused_momentum"
+
     def __init__(self, learning_rate, momentum, use_nesterov=False,
-                 regularization=None, grad_clip=None, name=None, parameter_list=None):
+                 regularization=None, grad_clip=None, name=None, parameter_list=None,
+                 fuse=False):
         super().__init__(learning_rate, regularization, grad_clip, name,
                          parameter_list=parameter_list)
         self.type = "momentum"
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._fuse = bool(fuse)
+
+    def _append_fused_optimize_op(self, block, pgs, lr_var, key):
+        params = [p for p, _ in pgs]
+        numel = sum(int(np.prod(p.shape)) for p in params)
+        velocity = self._add_group_accumulator("velocity", key, numel)
+        return block.append_op(
+            type="fused_momentum",
+            inputs={"Param": params, "Grad": [g for _, g in pgs],
+                    "Velocity": [velocity], "LearningRate": [lr_var]},
+            outputs={"ParamOut": params, "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   "lr_mult": key[1]},
+        )
 
     def _append_optimize_op(self, block, param_and_grad, lr_var):
         p, g = param_and_grad
@@ -507,15 +609,41 @@ class AdadeltaOptimizer(Optimizer):
 class AdamOptimizer(Optimizer):
     """fluid.optimizer.Adam (optimizer.py:1716)."""
 
+    _fused_op_type = "fused_adam"
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  regularization=None, grad_clip=None, name=None, lazy_mode=False,
-                 parameter_list=None):
+                 parameter_list=None, fuse=False):
         super().__init__(learning_rate, regularization, grad_clip, name,
                          parameter_list=parameter_list)
         self.type = "adam"
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._fuse = bool(fuse)
+
+    def _append_fused_optimize_op(self, block, pgs, lr_var, key):
+        params = [p for p, _ in pgs]
+        numel = sum(int(np.prod(p.shape)) for p in params)
+        m1 = self._add_group_accumulator("moment1", key, numel)
+        m2 = self._add_group_accumulator("moment2", key, numel)
+        b1p = self._add_group_accumulator("beta1_pow", key, 1, fill_value=1.0)
+        b2p = self._add_group_accumulator("beta2_pow", key, 1, fill_value=1.0)
+        attrs = dict(self._op_attrs())
+        attrs["lr_mult"] = key[1]
+        if len(key) > 2 and not key[2]:    # AdamW group excluded from decay
+            attrs.pop("coeff", None)
+        return block.append_op(
+            type="fused_adamw" if "coeff" in attrs else "fused_adam",
+            inputs={"Param": params, "Grad": [g for _, g in pgs],
+                    "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [lr_var]},
+            outputs={"ParamOut": params, "Moment1Out": [m1],
+                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                     "Beta2PowOut": [b2p]},
+            attrs=attrs,
+        )
 
     def _append_optimize_op(self, block, param_and_grad, lr_var):
         p, g = param_and_grad
@@ -540,14 +668,22 @@ class AdamOptimizer(Optimizer):
 class AdamW(AdamOptimizer):
     """Decoupled weight decay Adam (paddle.optimizer.AdamW surface)."""
 
+    _fused_op_type = "fused_adamw"
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  weight_decay=0.01, regularization=None, grad_clip=None, name=None,
-                 apply_decay_param_fun=None, parameter_list=None):
+                 apply_decay_param_fun=None, parameter_list=None, fuse=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, regularization,
-                         grad_clip, name, parameter_list=parameter_list)
+                         grad_clip, name, parameter_list=parameter_list,
+                         fuse=fuse)
         self.type = "adamw"
         self._coeff = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _fused_hparam_key(self, param):
+        with_decay = (self._apply_decay_param_fun is None
+                      or bool(self._apply_decay_param_fun(param.name)))
+        return super()._fused_hparam_key(param) + (with_decay,)
 
     def _append_optimize_op(self, block, param_and_grad, lr_var):
         p, g = param_and_grad
@@ -841,7 +977,10 @@ class PipelineOptimizer:
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
-                 start_cpu_core_id=0, num_microbatches=None, num_stages=None):
+                 start_cpu_core_id=0, num_microbatches=None, num_stages=None,
+                 remat_policy=None):
+        from .parallel import remat as _remat
+
         self._optimizer = optimizer
         self._cut_list = cut_list
         if num_stages is None:
@@ -849,6 +988,11 @@ class PipelineOptimizer:
         self._num_stages = int(num_stages)
         self._num_microbatches = int(num_microbatches
                                      or max(1, self._num_stages))
+        # named remat policy (parallel/remat.py) applied to each STAGE body:
+        # stage activations are recomputed in the schedule's backward
+        # instead of saved across all M+S-1 scan ticks
+        self._remat_policy = _remat.resolve(remat_policy).name \
+            if remat_policy is not None else "none"
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -868,7 +1012,8 @@ class PipelineOptimizer:
             num_microbatches=self._num_microbatches,
             cut_list=self._cut_list,
             trainable_params=[p.name for p, g in params_grads
-                              if g is not None])
+                              if g is not None],
+            remat_policy=self._remat_policy)
         return opt_ops, params_grads
 
 
@@ -882,10 +1027,19 @@ class GradientMergeOptimizer:
     same step as feeding the full batch at once — but peak activation
     memory drops by ~k."""
 
-    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+    def __init__(self, inner_optimizer, k_steps=1, avg=True,
+                 remat_policy=None):
+        from .parallel import remat as _remat
+
         self._optimizer = inner_optimizer
         self.k_steps = int(k_steps)
         self.avg = bool(avg)
+        # named remat policy (parallel/remat.py) recorded on the annotation
+        # so one knob drives all three parallel paths; a grad-merge program
+        # carries explicit gradient ops, so non-"none" policies only change
+        # behavior when the scanned fwd/bwd region is differentiated again
+        self._remat_policy = _remat.resolve(remat_policy).name \
+            if remat_policy is not None else "none"
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -901,7 +1055,7 @@ class GradientMergeOptimizer:
         annotate_grad_merge(
             program, loss, bwd_end, self.k_steps,
             [g.name for p, g in params_grads if g is not None],
-            avg=self.avg)
+            avg=self.avg, remat_policy=self._remat_policy)
         return opt_ops, params_grads
 
 

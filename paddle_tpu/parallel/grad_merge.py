@@ -20,7 +20,9 @@ from ..framework.registry import LowerCtx, run_lowering
 
 
 def annotate_grad_merge(program, loss, bwd_end, k_steps,
-                        grad_names, avg=True):
+                        grad_names, avg=True, remat_policy="none"):
+    from . import remat as remat_mod
+
     block = program.global_block()
     # anchor the fwd/bwd <-> optimizer-tail boundary on the OPS, not on an
     # absolute index: a later fleet transpile (GradAllReduce inserts
@@ -34,6 +36,7 @@ def annotate_grad_merge(program, loss, bwd_end, k_steps,
         "loss": loss.name,
         "grads": list(grad_names),
         "avg": bool(avg),
+        "remat": remat_mod.resolve(remat_policy).name,
     }
     program._bump_version()
 
@@ -144,32 +147,44 @@ class _CompiledGradMergeBlock:
                         f, i, 0, keepdims=False) if n in batched else f)
                 return env
 
-            def run_fwd_bwd(env, key):
+            keep = (set(grad_names) | set(fwd_written) | set(fwd_fetch)
+                    | {loss_name})
+
+            def run_fwd_bwd(env0, key):
+                """One microbatch's fwd+bwd region, functionally: env in ->
+                needed outputs out (so the remat policy can wrap it)."""
+                env = dict(env0)
                 ctx = LowerCtx(program, block, env, rng_key=key,
                                mesh_axes=mesh_axes)
                 for op in ops[:bwd_end]:
                     run_lowering(ctx, op)
+                return {n: env[n] for n in keep if n in env}
+
+            from . import remat as remat_mod
+
+            policy = remat_mod.resolve(ann.get("remat", "none"))
+            if not policy.is_none:
+                run_fwd_bwd = policy.wrap(run_fwd_bwd)
 
             def body(carry, i):
                 acc, loss_acc, state, _ = carry
                 env = seed_env(i)
                 env.update(state)  # sequential persistable updates (BN)
                 # distinct randomness per microbatch (dropout masks)
-                run_fwd_bwd(env, jax.random.fold_in(rng_key, i))
-                new_acc = {g: acc[g] + env[g].astype(jnp.float32)
+                outs = run_fwd_bwd(env, jax.random.fold_in(rng_key, i))
+                new_acc = {g: acc[g] + outs[g].astype(jnp.float32)
                            for g in grad_names}
-                new_state = {n: env[n] for n in fwd_written if n in env}
-                fetched = {n: env[n] for n in fwd_fetch if n in env}
-                return (new_acc, loss_acc + env[loss_name]
+                new_state = {n: outs[n] for n in fwd_written if n in outs}
+                fetched = {n: outs[n] for n in fwd_fetch if n in outs}
+                return (new_acc, loss_acc + outs[loss_name]
                         .astype(jnp.float32), new_state, fetched), None
 
             # abstract probe shapes the accumulator / carry pytrees
             def probe():
-                env = seed_env(0)
-                run_fwd_bwd(env, jax.random.PRNGKey(0))
-                return ({g: env[g] for g in grad_names},
-                        {n: env[n] for n in fwd_written if n in env},
-                        {n: env[n] for n in fwd_fetch if n in env})
+                outs = run_fwd_bwd(seed_env(0), jax.random.PRNGKey(0))
+                return ({g: outs[g] for g in grad_names},
+                        {n: outs[n] for n in fwd_written if n in outs},
+                        {n: outs[n] for n in fwd_fetch if n in outs})
 
             g_shapes, s_shapes, f_shapes = jax.eval_shape(probe)
             acc0 = {g: jnp.zeros(sh.shape, jnp.float32)

@@ -39,7 +39,8 @@ from ..framework.registry import GRAD_SUFFIX, LowerCtx, run_lowering
 
 def annotate_pipeline(program, loss, n_fwd: int, bwd_end: int,
                       num_stages: int, num_microbatches: int,
-                      cut_list=None, trainable_params: Sequence[str] = ()):
+                      cut_list=None, trainable_params: Sequence[str] = (),
+                      remat_policy: str = "none"):
     """Record the stage split on the program; the Executor routes programs
     carrying this annotation through _CompiledPipelineBlock."""
     block = program.global_block()
@@ -78,6 +79,8 @@ def annotate_pipeline(program, loss, n_fwd: int, bwd_end: int,
         op._set_attr("__bwd_op__", 1)
     for op in block.ops[bwd_end:]:
         op._set_attr("__opt_tail__", 1)
+    from . import remat as remat_mod
+
     program._annotations["pipeline"] = {
         "stage_ranges": stage_ranges,
         "n_fwd": n_fwd,
@@ -85,6 +88,7 @@ def annotate_pipeline(program, loss, n_fwd: int, bwd_end: int,
         "loss": loss.name,
         "microbatches": int(num_microbatches),
         "trainable": list(trainable_params),
+        "remat": remat_mod.resolve(remat_policy).name,
     }
     program._bump_version()
 
@@ -310,6 +314,12 @@ class _CompiledPipelineBlock:
 
         perm = [(i, (i + 1) % S) for i in range(S)]
         n_fwd = ann["n_fwd"]
+        from . import remat as remat_mod
+
+        # stage-body remat: with a non-"none" policy each stage's
+        # activations are recomputed in the backward of the microbatch
+        # schedule instead of being saved across all M+S-1 scan ticks
+        remat_policy = remat_mod.resolve(ann.get("remat", "none"))
 
         def per_rank(mutable_params, const_params, feeds, rng_key):
             stage = jax.lax.axis_index("pp")
@@ -368,7 +378,7 @@ class _CompiledPipelineBlock:
                             return (zero_carry(),
                                     loss.reshape(()), new_fstate)
 
-                        return branch
+                        return remat_policy.wrap(branch)
 
                     out, mb_loss, new_fstate = jax.lax.switch(
                         stage, [make_branch(s) for s in range(S)],

@@ -1,0 +1,136 @@
+"""First-class rematerialization (activation checkpointing) policies.
+
+Before this module the remat knob lived as ad-hoc strings scattered through
+bench.py / GPTConfig / pipeline code ("remat=True", "remat_policy='dots'").
+This is the single registry all three execution paths consult:
+
+- :mod:`paddle_tpu.parallel.parallelize` (via :func:`models.gpt.run_blocks`)
+  applies the policy per transformer block inside the GPipe/TP shard_map;
+- :mod:`paddle_tpu.parallel.pipeline_program` applies it to each fluid
+  pipeline *stage* body (stage activations are recomputed in the backward
+  of the microbatch schedule instead of saved across all M+S-1 scan ticks);
+- :mod:`paddle_tpu.parallel.grad_merge` accepts the same annotation for its
+  per-microbatch fwd/bwd region so one knob drives every path (note: a fluid
+  grad-merge program carries *explicit* gradient ops, so policies other than
+  ``none`` only change behavior when the scanned region is differentiated
+  again — the wrap is semantically a no-op otherwise).
+
+Named policies (HBM high -> low, recompute FLOPs low -> high):
+
+==================  ========================================================
+``none``            no checkpointing: save every intermediate (max HBM,
+                    zero recompute)
+``save_only_flash`` save only tensors tagged with ``checkpoint_name`` —
+                    the flash-attention outputs (models/gpt.py tags them
+                    as ``"attn_out"``); everything else is recomputed
+``dots``            ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``:
+                    save matmul outputs, recompute elementwise — the
+                    measured MFU winner on v5e (KERNEL_NOTES session 4)
+``full``            recompute everything inside the wrapped region
+                    (min HBM, ~1/3 extra step FLOPs)
+==================  ========================================================
+
+Old spellings stay valid as aliases: ``remat=False`` == ``"none"``,
+``remat=True`` (no policy) == ``"full"``, and the jax-internal policy name
+``dots_with_no_batch_dims_saveable`` maps to ``"dots"``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+
+__all__ = [
+    "POLICY_NAMES", "RematPolicy", "resolve", "policy_names",
+    "checkpoint_name", "ATTN_CHECKPOINT_NAME",
+]
+
+POLICY_NAMES: Tuple[str, ...] = ("none", "full", "dots", "save_only_flash")
+
+# name tagged onto attention outputs (flash or plain XLA path) so
+# save_only_flash can pick them out of the block
+ATTN_CHECKPOINT_NAME = "attn_out"
+
+_ALIASES = {
+    # legacy GPTConfig / bench.py spellings
+    "off": "none",
+    "false": "none",
+    "true": "full",
+    "everything": "full",
+    # jax-internal policy names
+    "dots_with_no_batch_dims_saveable": "dots",
+    "dots_saveable": "dots",
+    "save_only_these_names": "save_only_flash",
+    "save_only_flash_attn": "save_only_flash",
+}
+
+
+def checkpoint_name(x, name: str = ATTN_CHECKPOINT_NAME):
+    """Tag ``x`` for name-based save policies (thin jax.ad_checkpoint shim)."""
+    from jax.ad_checkpoint import checkpoint_name as _cn
+
+    return _cn(x, name)
+
+
+@dataclasses.dataclass(frozen=True)
+class RematPolicy:
+    """One named policy; ``wrap(fn)`` applies it as a jax.checkpoint."""
+
+    name: str
+
+    @property
+    def is_none(self) -> bool:
+        return self.name == "none"
+
+    def jax_policy(self) -> Optional[Callable]:
+        """The jax.checkpoint ``policy=`` callable (None = save nothing,
+        i.e. full recompute; meaningless for ``none``)."""
+        if self.name == "dots":
+            return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if self.name == "save_only_flash":
+            return jax.checkpoint_policies.save_only_these_names(
+                ATTN_CHECKPOINT_NAME)
+        return None  # "full" (and "none", which never reaches checkpoint)
+
+    def wrap(self, fn: Callable, static_argnums: Tuple[int, ...] = ()) \
+            -> Callable:
+        """Return ``fn`` wrapped per this policy (identity for ``none``)."""
+        if self.is_none:
+            return fn
+        policy = self.jax_policy()
+        if policy is None:
+            return jax.checkpoint(fn, static_argnums=static_argnums)
+        return jax.checkpoint(fn, static_argnums=static_argnums,
+                              policy=policy)
+
+
+def policy_names() -> Tuple[str, ...]:
+    return POLICY_NAMES
+
+
+def resolve(policy: Union[str, RematPolicy, None] = None,
+            remat: Optional[bool] = None) -> RematPolicy:
+    """Resolve a policy name (or legacy ``remat=`` bool) to a RematPolicy.
+
+    ``resolve("dots")`` — by name; ``resolve(None, remat=False)`` /
+    ``resolve("full", remat=False)`` — the legacy bool wins when it says
+    *off* (``remat=False`` always means ``none``, matching the old
+    ``GPTConfig.remat`` contract); ``resolve(None, remat=True)`` defaults
+    to ``full``.
+    """
+    if isinstance(policy, RematPolicy):
+        name = policy.name
+    elif policy is None:
+        name = "full" if (remat is None or remat) else "none"
+    else:
+        name = str(policy).strip().lower()
+        name = _ALIASES.get(name, name)
+    if remat is False:
+        name = "none"
+    if name not in POLICY_NAMES:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; valid names: "
+            f"{', '.join(POLICY_NAMES)} (aliases: "
+            f"{', '.join(sorted(_ALIASES))})")
+    return RematPolicy(name)

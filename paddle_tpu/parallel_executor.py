@@ -47,11 +47,23 @@ class ParallelExecutor:
         feed = feed if feed is not None else feed_dict
         if isinstance(feed, (list, tuple)):
             # per-device feed list: concatenate along the batch axis (the
-            # compiled program re-splits across the mesh)
+            # compiled program re-splits across the mesh). Non-batched
+            # entries — 0-d scalars like a fed learning rate — have no batch
+            # axis to concatenate; they must be identical per device and
+            # pass through unsplit.
             merged = {}
             for k in feed[0]:
-                merged[k] = np.concatenate(
-                    [np.asarray(f[k]) for f in feed], axis=0)
+                vals = [np.asarray(f[k]) for f in feed]
+                if vals[0].ndim == 0:
+                    for i, v in enumerate(vals[1:], 1):
+                        if v != vals[0]:
+                            raise ValueError(
+                                f"scalar feed {k!r} differs across devices "
+                                f"({vals[0]!r} vs {v!r} at device {i}); "
+                                "non-batched feeds must be replicated")
+                    merged[k] = vals[0]
+                else:
+                    merged[k] = np.concatenate(vals, axis=0)
             feed = merged
         outs = self._exe.run(self._compiled, feed=feed or {},
                              fetch_list=list(fetch_list),

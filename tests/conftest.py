@@ -29,6 +29,11 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+
+
 def pytest_collection_modifyitems(config, items):
     if not _TPU_LANE:
         return

@@ -10,6 +10,17 @@ peak bf16 FLOP/s).
 Usage:
   python tools/mfu_sweep.py                 # run the standard sweep
   python tools/mfu_sweep.py --one b=32,remat=dots,bq=512,bk=512
+  # HBM-lever axes: cross the base config with CE vocab-chunk sizes and
+  # the fused flat-buffer optimizer (docs/memory_levers.md)
+  python tools/mfu_sweep.py --ce-chunk 0,1024 --fused-opt 0,1
+  python tools/mfu_sweep.py --base d=64,L=2,nh=4,ff=128,T=32,b=4,steps=2,flash=0 \
+      --ce-chunk 0,64 --fused-opt 0,1      # CPU-sized end-to-end run
+
+Spec keys: b, steps, remat (none|full|dots|save_only_flash), bq, bk, nh, d,
+L, ff, T, flash, mom (f32|bf16), scan, celim, chunk (CE row chunk),
+vchunk (CE vocab chunk, 0 = off), fused (1 = flat-buffer fused optimizer).
+Every config's result is emitted as one machine-readable JSON row on stdout
+(the ranked human table follows after).
 """
 import itertools
 import json
@@ -66,6 +77,7 @@ def _measure_spec(spec_str, np, jax):
     flash = spec.get("flash", "1") == "1"
     mom = spec.get("mom", "f32")               # f32 | bf16 Adam moments
     scan = spec.get("scan", "1") == "1"        # 0 = unroll the layer loop
+    fused = spec.get("fused", "0") == "1"      # flat-buffer fused optimizer
 
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
@@ -85,14 +97,21 @@ def _measure_spec(spec_str, np, jax):
                         block_q=bq, block_k=bk, bias=bias)
         PK.flash_attention = patched
 
+    # remat by NAME through the first-class policy API (old spellings are
+    # aliases — "none"/"full"/"dots"/"save_only_flash" all valid here)
+    from paddle_tpu.parallel import remat as remat_mod
+
+    rpolicy = remat_mod.resolve(remat)
     kw = dict(max_seq_len=T, use_flash=flash, d_model=d_model,
               num_layers=layers, d_ff=d_ff,
-              remat=(remat != "none"), scan_layers=scan,
-              remat_policy=("dots" if remat == "dots" else "full"))
+              remat=not rpolicy.is_none, scan_layers=scan,
+              remat_policy=rpolicy.name)
     if "celim" in spec:
         kw["ce_direct_bytes_limit"] = int(spec["celim"])
     if "chunk" in spec:
         kw["ce_chunk"] = int(spec["chunk"])
+    if "vchunk" in spec:
+        kw["ce_vocab_chunk"] = int(spec["vchunk"])
     if heads:
         kw["num_heads"] = heads
     cfg = G.GPT_SMALL.scaled(**kw)
@@ -103,8 +122,9 @@ def _measure_spec(spec_str, np, jax):
     import jax.numpy as jnp
     params, opt = PZ.init_sharded(
         jax.random.PRNGKey(0), cfg, pcfg, mesh,
-        moment_dtype=jnp.bfloat16 if mom == "bf16" else None)
-    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+        moment_dtype=jnp.bfloat16 if mom == "bf16" else None,
+        fused_opt=fused)
+    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4, fused_opt=fused)
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
     labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
@@ -160,6 +180,52 @@ def run_one(spec, timeout=420):
     return {"spec": spec, "error": "no json"}
 
 
+_WINNER_BASE = "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16," \
+               "celim=1073741824,steps=8"
+
+
+def _flag_values(flag, default):
+    """``--flag a,b`` -> [a, b]; bare ``--flag`` -> default; absent -> None."""
+    if flag not in sys.argv:
+        return None
+    i = sys.argv.index(flag)
+    if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("--"):
+        return sys.argv[i + 1].split(",")
+    return default
+
+
+def build_specs():
+    """The spec list for this invocation. --ce-chunk / --fused-opt cross the
+    base config (--base SPEC, default: the measured winner) with CE
+    vocab-chunk sizes and the fused flat-buffer optimizer."""
+    if "--one" in sys.argv:
+        return [sys.argv[sys.argv.index("--one") + 1]]
+    ce_axis = _flag_values("--ce-chunk", ["0", "1024"])
+    fused_axis = _flag_values("--fused-opt", ["0", "1"])
+    if ce_axis is None and fused_axis is None:
+        # default sweep = the measured-winner neighborhood (KERNEL_NOTES
+        # session-4 table: 0.7168 at b=16 dots + bf16 moments) + its two
+        # controlled A/Bs (flash off, f32 moments)
+        return [
+            _WINNER_BASE,
+            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,flash=0,steps=8",
+            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824,steps=8",
+            "d=2048,L=6,nh=16,ff=8192,b=32,remat=full,mom=bf16,celim=1073741824,steps=8",
+        ]
+    base = (sys.argv[sys.argv.index("--base") + 1]
+            if "--base" in sys.argv else _WINNER_BASE)
+    specs = []
+    for vc in (ce_axis or [None]):
+        for fo in (fused_axis or [None]):
+            s = base
+            if vc is not None and int(vc):
+                s += f",vchunk={vc}"
+            if fo is not None:
+                s += f",fused={fo}"
+            specs.append(s)
+    return specs
+
+
 def main():
     if "--multi" in sys.argv:
         i = sys.argv.index("--multi")
@@ -168,24 +234,16 @@ def main():
     if "--worker" in sys.argv:
         worker()
         return
-    if "--one" in sys.argv:
-        specs = [sys.argv[sys.argv.index("--one") + 1]]
-    else:
-        # default sweep = the measured-winner neighborhood (KERNEL_NOTES
-        # session-4 table: 0.7168 at b=16 dots + bf16 moments) + its two
-        # controlled A/Bs (flash off, f32 moments)
-        specs = [
-            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,steps=8",
-            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,flash=0,steps=8",
-            "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824,steps=8",
-            "d=2048,L=6,nh=16,ff=8192,b=32,remat=full,mom=bf16,celim=1073741824,steps=8",
-        ]
+    specs = build_specs()
     results = []
     for s in specs:
         print(f"[sweep] {s} ...", file=sys.stderr, flush=True)
         r = run_one(s)
         print(f"[sweep]   -> {r}", file=sys.stderr, flush=True)
         results.append(r)
+        # one machine-readable row per config, as it lands (errors included
+        # — a crashed config must not vanish from the record)
+        print(json.dumps(r), flush=True)
     ok = [r for r in results if "mfu" in r]
     ok.sort(key=lambda r: -r["mfu"])
     for r in ok:
