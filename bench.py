@@ -225,46 +225,23 @@ def launcher():
 # ---------------------------------------------------------------------------
 
 def _peak_flops(device) -> float:
-    """Peak *bf16* FLOP/s for the device (fallbacks are rough).
+    """Peak *bf16* FLOP/s for the device — one shared table
+    (paddle_tpu/observability/hw.py) so bench, mfu_sweep and the
+    TrainMonitor all divide by the same denominator. v5e is 197 TFLOP/s
+    bf16 (394 is its int8 rate — the table briefly held 394 and understated
+    every reported MFU 2x; PEAK_PROBE.json measures 171.3 TF on a dense
+    bf16 matmul, 87% of 197)."""
+    from paddle_tpu.observability import hw
 
-    v5e is 197 TFLOP/s bf16 (394 is its int8 rate — the table briefly held
-    394 and understated every reported MFU 2x). Hardware evidence:
-    tools/peak_probe.py measures 171.3 TFLOP/s on a dense 16384x8192x8192
-    bf16 matmul on this chip (PEAK_PROBE.json) — 87% of 197; a matmul that
-    size could not sit at 44% of a 394 peak.
-    """
-    kind = getattr(device, "device_kind", "cpu").lower()
-    table = {
-        "v6e": 918e12, "v6 lite": 918e12, "v5e": 197e12, "v5 lite": 197e12,
-        "v5litepod": 197e12, "v5p": 459e12, "v4": 275e12, "v3": 123e12,
-        "v2": 45e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    return 1e12  # CPU / unknown
+    return hw.peak_bf16_flops(device)
 
 
 def _program_train_flops(program, batch):
-    """Analytic fwd FLOPs of a built fluid program (2*MACs over conv2d +
-    matmul/mul ops), times 3 for fwd+bwd — the standard training estimate.
-    Var shapes must be static (build with append_batch_size=False)."""
-    import numpy as np
-    block = program.global_block()
-    macs = 0
-    for op in block.ops:
-        if op.type == "conv2d":
-            out = block.var(op.output("Output")[0]).shape
-            w = block.var(op.input("Filter")[0]).shape
-            groups = int(op.attr("groups", 1) or 1)
-            # out [N, Cout, H, W]; w [Cout, Cin/g, kh, kw]
-            macs += int(np.prod(out)) * int(np.prod(w[1:])) // max(groups, 1) \
-                * groups ** 0  # w already holds Cin/g
-        elif op.type in ("mul", "matmul"):
-            x = block.var(op.input("X")[0]).shape
-            y = block.var(op.input("Y")[0]).shape
-            macs += int(np.prod(x)) * int(y[-1])
-    return 6 * macs  # 2 FLOPs/MAC x 3 (fwd + bwd)
+    """Analytic fwd+bwd FLOPs of a built fluid program (shared helper in
+    paddle_tpu/observability/hw.py)."""
+    from paddle_tpu.observability import hw
+
+    return hw.program_train_flops(program, batch)
 
 
 def resnet_worker():
@@ -387,15 +364,9 @@ def ernie_worker():
     dt = time.perf_counter() - t0
     samples_s = steps * batch / dt
     n_params = E.num_params(params)
-    # honest numerator: embedding tables (wte/wpe/wse) are gathers, not
-    # per-token matmuls — 6N over all params would inflate MFU ~20% here
-    # (unlike the GPT lane, whose lm_head matmul runs at every position).
-    # The tied MLM decoder matmul runs at max_masked of T positions and is
-    # counted explicitly.
-    D, V, M = cfg.d_model, cfg.vocab_size, cfg.max_masked
-    n_emb = V * D + cfg.max_seq_len * D + cfg.type_vocab_size * D
-    attn = 12 * cfg.num_layers * D * T
-    per_token = 6 * (n_params - n_emb) + attn + 6 * M * D * V // T
+    # honest numerator (models/ernie.py pretrain_flops_per_token): embedding
+    # gathers excluded, tied MLM decoder matmul counted at max_masked of T
+    per_token = E.pretrain_flops_per_token(cfg, n_params, T)
     mfu = samples_s * T * per_token / _peak_flops(dev)
     _log(f"ernie worker: {samples_s:.1f} samples/s mfu={mfu:.3f}")
     print(json.dumps({
@@ -424,13 +395,19 @@ def worker(use_flash: bool):
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
 
+    monitor_path = next((a.split("=", 1)[1] for a in sys.argv
+                         if a.startswith("--monitor=")), None)
+
     def measure(tag, cfg, batch, T, steps):
         """Compile + run one config; returns (tokens/s, mfu, loss, params).
 
         Steps are dispatched asynchronously and the chain is forced once at
         the end — donated params serialize the steps on-device, and syncing
         per step would bill one tunnel round-trip per step (~25ms here)
-        against pure device time.
+        against pure device time. With --monitor=PATH the loop instead
+        syncs every step and emits one TrainMonitor JSONL record per step
+        (step time, dispatch/wait split, tokens/s, MFU, loss, NaN flags) —
+        the monitored number includes that per-step sync by design.
         """
         import jax.numpy as jnp
         pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
@@ -454,19 +431,37 @@ def worker(use_flash: bool):
         loss0 = float(loss)
         _log(f"worker[{tag}]: compile+step done in "
              f"{time.perf_counter() - tc:.1f}s loss={loss0:.4f}")
+        n_params = G.num_params(params)
+        flops_tok = G.train_flops_per_token(cfg, n_params, T)
+        mon = None
+        if monitor_path:
+            from paddle_tpu.observability import TrainMonitor
+
+            mon = TrainMonitor(
+                path=monitor_path, examples_per_step=batch,
+                tokens_per_step=batch * T,
+                flops_per_step=flops_tok * batch * T,
+                peak_flops=_peak_flops(dev),
+                extra_static={"config": tag})
         t0 = time.perf_counter()
-        for i in range(steps):
-            params, opt, loss, _ = step(params, opt, tokens, labels)
-        loss_v = float(loss)  # forces the whole chain
+        if mon is not None:
+            for i in range(steps):
+                with mon.step() as s:
+                    params, opt, loss, gnorm = step(params, opt, tokens,
+                                                    labels)
+                    s.dispatched()
+                    s.observe(loss=loss, grad_norm=gnorm)
+            loss_v = mon.last_record.get("loss")
+            mon.close()
+        else:
+            for i in range(steps):
+                params, opt, loss, _ = step(params, opt, tokens, labels)
+            loss_v = float(loss)  # forces the whole chain
         dt = time.perf_counter() - t0
         _log(f"worker[{tag}]: {steps} steps in {dt:.2f}s "
              f"({dt / steps * 1000:.0f} ms/step)")
         tokens_per_s = steps * batch * T / dt
-        n_params = G.num_params(params)
-        # fwd+bwd ~= 6 * N FLOPs/token (+ attention term), standard
-        # estimate: per layer fwd QK^T + AV = 4*T*d FLOPs/token, x3 fwd+bwd
-        attn = 12 * cfg.num_layers * cfg.d_model * T
-        mfu = tokens_per_s * (6 * n_params + attn) / _peak_flops(dev)
+        mfu = tokens_per_s * flops_tok / _peak_flops(dev)
         return tokens_per_s, mfu, loss_v, n_params
 
     wide_mode = "--wide" in sys.argv

@@ -75,6 +75,7 @@ from .parallel_executor import ParallelExecutor  # noqa: F401
 from .data_feed_desc import DataFeedDesc  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 
 # fluid-style aliases
 CUDAPlace = XLAPlace  # reference scripts swap transparently

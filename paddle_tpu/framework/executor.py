@@ -26,6 +26,40 @@ from .registry import LowerCtx, run_lowering, get_op_spec, has_op
 
 logger = logging.getLogger("paddle_tpu.executor")
 
+# ---------------------------------------------------------------------------
+# Always-live metrics (observability/metrics.py). Children are resolved ONCE
+# at import so the steady-state cost is a float add — unlike RecordEvents,
+# these exist whether or not a profiling session is active (the "profiling
+# started after the first step" dropped-compile-events satellite).
+# ---------------------------------------------------------------------------
+from ..observability import metrics as _obs_metrics
+
+_OBS = _obs_metrics.default_registry()
+_m_dispatch = _OBS.counter(
+    "paddle_executor_dispatch_total",
+    "Executor.run dispatches by path (fast = dispatch-record hit)",
+    ("path",))
+_m_dispatch_fast = _m_dispatch.labels("fast")
+_m_dispatch_slow = _m_dispatch.labels("slow")
+_m_compile = _OBS.counter(
+    "paddle_executor_compile_total",
+    "Compiled (program, feed-sig, fetch) blocks built")
+_m_compile_ms = _OBS.histogram(
+    "paddle_executor_compile_ms",
+    "Block build+trace wall time (ms); the XLA compile itself is lazy")
+_m_compile_cache = _OBS.counter(
+    "paddle_compile_cache_total",
+    "Persistent XLA compile cache outcomes", ("verdict",))
+_m_run_ms = _OBS.histogram(
+    "paddle_executor_run_ms",
+    "Executor.run host wall time per call (async dispatch, ms)")
+_m_device_wait_ms = _OBS.histogram(
+    "paddle_executor_device_wait_ms",
+    "Blocking device->host fetch materialization time per run (ms)")
+_m_fetch_stall = _OBS.counter(
+    "paddle_fetch_sync_stall_ms_total",
+    "train_from_dataset fetch-sync stall time at print/final boundaries (ms)")
+
 _prof_mod = None
 
 
@@ -575,7 +609,9 @@ class Executor:
             block = program.global_block()
             param_names, written = _analyze_persistables(program)
             ensure_compile_cache()
-            with prof.RecordEvent(f"compile/{len(block.ops)}ops"):
+            _m_compile.inc()
+            with _m_compile_ms.time(), \
+                    prof.RecordEvent(f"compile/{len(block.ops)}ops"):
                 if "pipeline" in program._annotations:
                     from ..parallel.pipeline_program import (
                         _CompiledPipelineBlock)
@@ -609,12 +645,18 @@ class Executor:
         if watch_cache:
             hits0, misses0 = compile_cache_counters()
             t0 = time.perf_counter_ns()
+        _m_dispatch_slow.inc()
+        t_run0 = time.perf_counter_ns()
         with prof.RecordEvent("executor_run"):
             fetches = exe(scope, feed_arrays, rng_key)
+        _m_run_ms.observe((time.perf_counter_ns() - t_run0) / 1e6)
         if watch_cache:
             hits1, misses1 = compile_cache_counters()
             if hits1 > hits0 or misses1 > misses0:
                 verdict = "hit" if hits1 > hits0 else "cold"
+                # counter is ALWAYS live; the trace event only exists while
+                # a profiling session is active (prof.add_event guards)
+                _m_compile_cache.labels(verdict).inc()
                 prof.add_event(f"compile_cache/{verdict}", t0,
                                time.perf_counter_ns() - t0)
                 logger.info(
@@ -637,7 +679,10 @@ class Executor:
 
             check_fetches(fetch_names, fetches)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            t_wait0 = time.perf_counter_ns()
+            out = [np.asarray(f) for f in fetches]
+            _m_device_wait_ms.observe((time.perf_counter_ns() - t_wait0) / 1e6)
+            return out
         return fetches
 
     # ------------------------------------------------------------------
@@ -659,6 +704,8 @@ class Executor:
             rng_key = rec.rng_base
         self._step += 1
         self._fast_hits += 1
+        _m_dispatch_fast.inc()
+        t_run0 = time.perf_counter_ns()
         prof = _prof()
         if prof.is_active():
             with prof.RecordEvent("executor_run"):
@@ -667,8 +714,12 @@ class Executor:
         else:
             fetches = rec.exe.fast_call(scope or global_scope(), feeds,
                                         rng_key)
+        _m_run_ms.observe((time.perf_counter_ns() - t_run0) / 1e6)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            t_wait0 = time.perf_counter_ns()
+            out = [np.asarray(f) for f in fetches]
+            _m_device_wait_ms.observe((time.perf_counter_ns() - t_wait0) / 1e6)
+            return out
         return fetches
 
     # ------------------------------------------------------------------
@@ -817,7 +868,7 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
                            fetch_list=None, fetch_info=None,
-                           print_period: int = 100):
+                           print_period: int = 100, monitor=None):
         """Dataset trainer path — parity with fluid/executor.py:1448.
 
         The reference hands the Dataset to C++ trainer threads
@@ -828,24 +879,31 @@ class Executor:
         (dataset.iter_batches_threaded) so host-side data work overlaps the
         asynchronously dispatched device steps — the HogwildWorker/
         MultiTrainer capability on one dispatch stream.
+
+        ``monitor``: an ``observability.TrainMonitor``; when given, every
+        step emits one structured JSONL record (step time, host-dispatch vs
+        device-wait split, throughput, loss, NaN/Inf flags). Monitored runs
+        sync the first fetch each step — that per-step device wait is the
+        quantity being measured; leave monitor=None for the fully-async
+        fast path.
         """
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period, train=True,
-                                      thread=thread)
+                                      thread=thread, monitor=monitor)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
                            fetch_list=None, fetch_info=None,
-                           print_period: int = 100):
+                           print_period: int = 100, monitor=None):
         """Parity with fluid/executor.py:1381 (no optimizer side effects is
         the caller's responsibility, as in the reference)."""
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period, train=False,
-                                      thread=thread)
+                                      thread=thread, monitor=monitor)
 
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
                           fetch_info, print_period, train: bool,
-                          thread: int = 0):
+                          thread: int = 0, monitor=None):
         if dataset is None:
             raise ValueError("dataset must be provided")
         program = program or default_main_program()
@@ -877,18 +935,40 @@ class Executor:
         step = 0
         last_fetch = None
         for feed in prefetch_to_device(filtered(), size=2):
-            last_fetch = self.run(program=program, feed=feed,
-                                  fetch_list=fetch_list, scope=scope,
-                                  return_numpy=False)
+            if monitor is not None:
+                if monitor.examples_per_step is None:
+                    # infer the per-step example count from the batch dim
+                    for v in feed.values():
+                        shape = getattr(v, "shape", None)
+                        if shape:
+                            monitor.examples_per_step = int(shape[0])
+                            break
+                with monitor.step() as s:
+                    last_fetch = self.run(program=program, feed=feed,
+                                          fetch_list=fetch_list, scope=scope,
+                                          return_numpy=False)
+                    s.dispatched()
+                    if fetch_list:
+                        # materializing the first fetch IS the device wait
+                        s.observe(loss=last_fetch[0])
+            else:
+                last_fetch = self.run(program=program, feed=feed,
+                                      fetch_list=fetch_list, scope=scope,
+                                      return_numpy=False)
             step += 1
             if fetch_list and print_period and step % print_period == 0:
-                # the only per-step host sync point, and only when printing
+                # the only per-step host sync point (monitor excepted),
+                # and only when printing
+                t0 = time.perf_counter_ns()
                 msg = ", ".join(
                     f"{name}={np.asarray(val).ravel()[:4]}"
                     for name, val in zip(fetch_info, last_fetch))
+                _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
                 logger.info("step %d: %s", step, msg)
         if last_fetch is not None:
+            t0 = time.perf_counter_ns()
             last_fetch = [np.asarray(v) for v in last_fetch]
+            _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
         return last_fetch
 
 

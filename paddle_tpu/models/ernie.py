@@ -284,3 +284,16 @@ def init_opt(params):
 
 def num_params(params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def pretrain_flops_per_token(cfg: ErnieConfig, n_params: int, T: int) -> float:
+    """Analytic fwd+bwd FLOPs per pretraining token. Honest numerator:
+    embedding tables (wte/wpe/wse) are gathers, not per-token matmuls — 6N
+    over all params would inflate MFU ~20% here (unlike GPT, whose lm_head
+    matmul runs at every position). The tied MLM decoder matmul runs at
+    max_masked of T positions and is counted explicitly. Shared by
+    bench.py's ernie lane and the TrainMonitor."""
+    D, V, M = cfg.d_model, cfg.vocab_size, cfg.max_masked
+    n_emb = V * D + cfg.max_seq_len * D + cfg.type_vocab_size * D
+    attn = 12 * cfg.num_layers * D * T
+    return 6 * (n_params - n_emb) + attn + 6 * M * D * V // T

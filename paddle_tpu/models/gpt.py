@@ -352,3 +352,11 @@ def loss_fn(params, tokens, labels, cfg: GPTConfig):
 
 def num_params(params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def train_flops_per_token(cfg: GPTConfig, n_params: int, T: int) -> float:
+    """Analytic fwd+bwd FLOPs per trained token: the standard 6N estimate
+    plus the attention term (per layer fwd QK^T + AV = 4*T*d FLOPs/token,
+    x3 fwd+bwd). Shared by bench.py and the TrainMonitor so every MFU
+    number uses the same numerator."""
+    return 6 * n_params + 12 * cfg.num_layers * cfg.d_model * T

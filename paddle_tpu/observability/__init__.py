@@ -1,0 +1,49 @@
+"""Framework-wide runtime telemetry (ISSUE 3).
+
+Three cooperating layers, mirroring the reference stack's profiler/monitor
+split (host RecordEvent + device tracer + train monitor callbacks):
+
+- :mod:`.metrics` — an in-process metrics registry (counters, gauges,
+  histograms, all with labels). Hot paths self-report through it at
+  negligible cost (one dict-free attribute bump per event); it is ALWAYS
+  live, unlike trace events which only exist while a profiler session is
+  active.
+- :mod:`.prom` — Prometheus text exposition of the registry: a textfile
+  writer plus an optional localhost HTTP scrape endpoint.
+- :mod:`.monitor` — ``TrainMonitor``/``MonitorWriter``: one structured
+  JSONL record per training step (step time, host-dispatch vs device-wait
+  split, examples/s, tokens/s, MFU against the bf16-peak denominator,
+  loss, grad norm, NaN/Inf flags, rolling percentiles). Usable from
+  ``Executor.train_from_dataset``, ``bench.py``, and the pure-JAX engine.
+- :mod:`.trace_merge` — merges the host chrome trace (profiler.py
+  RecordEvents) with the device spans of a ``jax.profiler`` capture into
+  ONE chrome-trace file with distinct host/device pids on a shared
+  (start-aligned) clock, so a single Perfetto load shows host dispatch
+  lined up against device execution.
+- :mod:`.hw` — hardware denominators shared by bench.py and the monitor:
+  bf16 peak FLOP/s per device kind and analytic train FLOPs of a fluid
+  program.
+
+See docs/observability.md.
+"""
+from __future__ import annotations
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    metrics_enabled,
+    set_metrics_enabled,
+)
+from .monitor import MonitorWriter, TrainMonitor  # noqa: F401
+from . import hw  # noqa: F401
+from . import prom  # noqa: F401
+from . import trace_merge  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
+    "metrics_enabled", "set_metrics_enabled",
+    "MonitorWriter", "TrainMonitor", "hw", "prom", "trace_merge",
+]

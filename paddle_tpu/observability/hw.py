@@ -1,0 +1,59 @@
+"""Hardware denominators for throughput/MFU reporting.
+
+One table, shared by bench.py, tools/mfu_sweep.py and the TrainMonitor, so
+every reported MFU divides by the SAME bf16-peak denominator (the round-5
+lesson: the table briefly held v5e's int8 rate and understated every MFU
+2x — PEAK_PROBE.json measures 171.3 TF on a dense bf16 matmul, 87% of 197).
+"""
+from __future__ import annotations
+
+__all__ = ["peak_bf16_flops", "program_train_flops"]
+
+# device_kind substring -> peak bf16 FLOP/s
+PEAK_BF16_FLOPS = {
+    "v6e": 918e12, "v6 lite": 918e12, "v5e": 197e12, "v5 lite": 197e12,
+    "v5litepod": 197e12, "v5p": 459e12, "v4": 275e12, "v3": 123e12,
+    "v2": 45e12,
+}
+
+_FALLBACK_FLOPS = 1e12  # CPU / unknown accelerator
+
+
+def peak_bf16_flops(device=None) -> float:
+    """Peak *bf16* FLOP/s for a jax device (or the default device)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "cpu")).lower()
+    for k, v in PEAK_BF16_FLOPS.items():
+        if k in kind:
+            return v
+    return _FALLBACK_FLOPS
+
+
+def program_train_flops(program, batch: int = 1) -> int:
+    """Analytic fwd+bwd FLOPs of one step of a built fluid program: 2*MACs
+    over conv2d + matmul/mul ops, times 3 for fwd+bwd — the standard
+    training estimate. Dynamic (-1) leading dims — data layers built with
+    append_batch_size — are substituted with ``batch``."""
+    import numpy as np
+
+    def prod(shape):
+        return int(np.prod([batch if d in (-1, None) else d for d in shape]))
+
+    block = program.global_block()
+    macs = 0
+    for op in block.ops:
+        if op.type == "conv2d":
+            out = block.var(op.output("Output")[0]).shape
+            w = block.var(op.input("Filter")[0]).shape
+            groups = int(op.attr("groups", 1) or 1)
+            # out [N, Cout, H, W]; w [Cout, Cin/g, kh, kw]
+            macs += prod(out) * prod(w[1:]) \
+                // max(groups, 1) * groups ** 0  # w already holds Cin/g
+        elif op.type in ("mul", "matmul"):
+            x = block.var(op.input("X")[0]).shape
+            y = block.var(op.input("Y")[0]).shape
+            macs += prod(x) * int(y[-1])
+    return 6 * macs  # 2 FLOPs/MAC x 3 (fwd + bwd)

@@ -1,0 +1,286 @@
+"""Per-step training monitor: one structured JSONL record per step.
+
+``TrainMonitor`` is the callback object usable from
+``Executor.train_from_dataset(monitor=...)``, ``bench.py --monitor`` and
+the pure-JAX engine. Each step it emits a record with:
+
+    step, step_time_ms, host_dispatch_ms, device_wait_ms,
+    examples_per_s, tokens_per_s, mfu, loss, grad_norm, nan_inf,
+    p50/p90/p99 rolling step-time percentiles
+
+The host-dispatch vs device-wait split mirrors the executor's async
+dispatch model: dispatch time is how long the framework took to launch the
+step (``Executor.run`` with ``return_numpy=False`` returns once the jitted
+call is enqueued), device wait is the time spent blocking on the fetched
+value (the only true sync point).
+
+Usage pattern (and what train_from_dataset does internally)::
+
+    mon = TrainMonitor(path="steps.jsonl", examples_per_step=batch,
+                       flops_per_step=flops, peak_flops=peak)
+    for batch in data:
+        with mon.step() as s:
+            out = exe.run(main, feed=batch, fetch_list=[loss],
+                          return_numpy=False)     # host dispatch
+            s.dispatched()
+            s.observe(loss=out[0])                # device wait (sync)
+    mon.close()
+
+MFU uses the bf16-peak denominator from :mod:`.hw` (the same table as
+bench.py); NaN/Inf detection reuses the scan semantics of
+utils/nan_inf.py (ml_dtypes float-likes included).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import time
+from typing import Any, Dict, IO, Optional, Union
+
+import numpy as np
+
+from . import metrics as _metrics
+
+__all__ = ["MonitorWriter", "TrainMonitor"]
+
+# keys every monitored step record carries (tools/metrics_check.py asserts
+# these exist with finite values)
+STEP_RECORD_KEYS = (
+    "step", "step_time_ms", "host_dispatch_ms", "device_wait_ms",
+    "examples_per_s", "mfu", "loss", "nan_inf",
+)
+
+
+def _is_float_like(arr: np.ndarray) -> bool:
+    # ml_dtypes kinds (bfloat16/float8) report 'V'; they are float-like
+    return arr.dtype.kind == "f" or "float" in str(arr.dtype)
+
+
+def _scan_nan_inf(value) -> bool:
+    """True when any element of a float-like value is NaN/Inf (the
+    utils/nan_inf.py scan rule, non-raising)."""
+    if value is None:
+        return False
+    arr = np.asarray(value)
+    if not _is_float_like(arr):
+        return False
+    if arr.dtype.kind != "f":
+        arr = arr.astype(np.float32)
+    return bool(np.isnan(arr).any() or np.isinf(arr).any())
+
+
+class MonitorWriter:
+    """Line-buffered JSONL sink: one json object per line, flushed per
+    write so a crashed run keeps every completed step's record."""
+
+    def __init__(self, path_or_file: Union[str, IO]):
+        if hasattr(path_or_file, "write"):
+            self._f = path_or_file
+            self._own = False
+            self.path = getattr(path_or_file, "name", None)
+        else:
+            self._f = open(path_or_file, "a")
+            self._own = True
+            self.path = str(path_or_file)
+        self.records_written = 0
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+        self.records_written += 1
+
+    def close(self) -> None:
+        if self._own and self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _StepHandle:
+    """Context for one step: times the dispatch / wait / total phases."""
+
+    __slots__ = ("mon", "t0", "t_dispatch", "t_wait", "fields")
+
+    def __init__(self, mon: "TrainMonitor"):
+        self.mon = mon
+        self.t_dispatch = None
+        self.t_wait = 0.0
+        self.fields: Dict[str, Any] = {}
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def dispatched(self) -> None:
+        """Mark the end of the host-dispatch phase (the async launch
+        returned; everything after is device wait / host bookkeeping)."""
+        if self.t_dispatch is None:
+            self.t_dispatch = time.perf_counter_ns()
+
+    def observe(self, loss=None, grad_norm=None, **extra) -> None:
+        """Record the step's fetched values. Materializing ``loss`` /
+        ``grad_norm`` here is the step's sync point — the time it takes IS
+        the device wait, so it is measured."""
+        t0 = time.perf_counter_ns()
+        if loss is not None:
+            arr = np.asarray(loss)
+            self.fields["nan_inf"] = _scan_nan_inf(arr)
+            self.fields["loss"] = float(arr.ravel()[0]) \
+                if arr.size else None
+        if grad_norm is not None:
+            arr = np.asarray(grad_norm)
+            self.fields["grad_norm"] = float(arr.ravel()[0])
+            if self.fields.get("nan_inf") is not True:
+                self.fields["nan_inf"] = _scan_nan_inf(arr)
+        self.t_wait += (time.perf_counter_ns() - t0)
+        self.fields.update(extra)
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dispatched()  # a step that never synced: all time is dispatch
+        self.mon._finish_step(self, time.perf_counter_ns())
+        return False
+
+
+class TrainMonitor:
+    """Per-step train monitor with JSONL + metrics-registry sinks.
+
+    Throughput denominators: pass ``examples_per_step`` (and optionally
+    ``tokens_per_step``); MFU needs ``flops_per_step`` and optionally
+    ``peak_flops`` (defaults to the bf16 peak of jax device 0 via
+    :func:`hw.peak_bf16_flops`).
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 writer: Optional[MonitorWriter] = None,
+                 examples_per_step: Optional[float] = None,
+                 tokens_per_step: Optional[float] = None,
+                 flops_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None,
+                 window: int = 100,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 extra_static: Optional[Dict[str, Any]] = None):
+        if writer is None and path is not None:
+            writer = MonitorWriter(path)
+        self.writer = writer
+        self.examples_per_step = examples_per_step
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_step = flops_per_step
+        self._peak_flops = peak_flops
+        self.extra_static = dict(extra_static or {})
+        self.step_count = 0
+        self.last_record: Optional[Dict[str, Any]] = None
+        self._step_times = collections.deque(maxlen=window)
+        reg = registry or _metrics.default_registry()
+        self._m_steps = reg.counter(
+            "paddle_train_steps_total", "Monitored train steps")
+        self._m_step_ms = reg.histogram(
+            "paddle_train_step_ms", "Monitored step wall time (ms)")
+        self._m_examples = reg.counter(
+            "paddle_train_examples_total", "Examples consumed")
+        self._m_nan = reg.counter(
+            "paddle_train_nan_inf_total", "Steps with NaN/Inf fetches")
+        self._m_loss = reg.gauge(
+            "paddle_train_loss", "Last observed loss")
+        self._m_mfu = reg.gauge(
+            "paddle_train_mfu", "Last step model-FLOPs-utilization (bf16 peak)")
+
+    def peak_flops(self) -> float:
+        if self._peak_flops is None:
+            from .hw import peak_bf16_flops
+
+            self._peak_flops = peak_bf16_flops()
+        return self._peak_flops
+
+    def step(self) -> _StepHandle:
+        return _StepHandle(self)
+
+    # -- one-shot convenience (pure-JAX loops that already timed) --------
+    def record_step(self, step_time_ms: float, host_dispatch_ms: float = 0.0,
+                    device_wait_ms: float = 0.0, loss=None, grad_norm=None,
+                    **extra) -> Dict[str, Any]:
+        h = _StepHandle(self)
+        h.t0 = 0
+        h.t_dispatch = int(host_dispatch_ms * 1e6)
+        if loss is not None or grad_norm is not None:
+            h.observe(loss=loss, grad_norm=grad_norm)
+        # the caller already timed the wait; observe()'s own materialization
+        # timing is noise here, so the stated value wins
+        h.t_wait = int(device_wait_ms * 1e6)
+        h.fields.update(extra)
+        self._finish_step(h, int(step_time_ms * 1e6))
+        return self.last_record
+
+    # -- internals -------------------------------------------------------
+    def _finish_step(self, h: _StepHandle, t_end_ns: int) -> None:
+        self.step_count += 1
+        step_ms = (t_end_ns - h.t0) / 1e6
+        dispatch_ms = (h.t_dispatch - h.t0) / 1e6
+        wait_ms = h.t_wait / 1e6
+        self._step_times.append(step_ms)
+        rec: Dict[str, Any] = dict(self.extra_static)
+        rec.update(
+            step=self.step_count,
+            step_time_ms=round(step_ms, 4),
+            host_dispatch_ms=round(dispatch_ms, 4),
+            device_wait_ms=round(wait_ms, 4),
+        )
+        sec = max(step_ms, 1e-9) / 1e3
+        if self.examples_per_step is not None:
+            rec["examples_per_s"] = round(self.examples_per_step / sec, 3)
+        if self.tokens_per_step is not None:
+            rec["tokens_per_s"] = round(self.tokens_per_step / sec, 3)
+        if self.flops_per_step is not None:
+            rec["mfu"] = round(
+                self.flops_per_step / sec / self.peak_flops(), 6)
+        rec.setdefault("loss", h.fields.get("loss"))
+        rec.setdefault("nan_inf", bool(h.fields.get("nan_inf", False)))
+        for k, v in h.fields.items():
+            if k not in ("loss", "nan_inf"):
+                rec[k] = v
+        for q in (50, 90, 99):
+            rec[f"p{q}_step_time_ms"] = round(self._percentile(q), 4)
+        self.last_record = rec
+        if self.writer is not None:
+            self.writer.write(rec)
+        # registry mirror: scrape-able without reading the JSONL
+        self._m_steps.inc()
+        self._m_step_ms.observe(step_ms)
+        if self.examples_per_step is not None:
+            self._m_examples.inc(self.examples_per_step)
+        if rec.get("nan_inf"):
+            self._m_nan.inc()
+        if rec.get("loss") is not None:
+            self._m_loss.set(rec["loss"])
+        if rec.get("mfu") is not None:
+            self._m_mfu.set(rec["mfu"])
+
+    def _percentile(self, q: float) -> float:
+        vals = sorted(self._step_times)
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1,
+                  max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+        return vals[idx]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "steps": self.step_count,
+            "p50_step_time_ms": round(self._percentile(50), 4),
+            "p90_step_time_ms": round(self._percentile(90), 4),
+            "p99_step_time_ms": round(self._percentile(99), 4),
+        }
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

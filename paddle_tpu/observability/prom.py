@@ -1,0 +1,148 @@
+"""Prometheus text exposition for the metrics registry.
+
+Two sinks:
+- ``render(registry)`` / ``write_textfile(path)`` — the text exposition
+  format (version 0.0.4), suitable for the node-exporter textfile
+  collector or for test validation;
+- ``MetricsHTTPServer`` — an optional localhost scrape endpoint serving
+  ``/metrics`` from a daemon thread (stdlib http.server; no dependencies).
+
+Histogram series follow the Prometheus convention: cumulative
+``_bucket{le="..."}`` samples ending in ``le="+Inf"``, plus ``_sum`` and
+``_count``.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+from .metrics import MetricsRegistry, default_registry
+from .metrics import _CounterChild, _GaugeChild, _HistogramChild  # noqa: F401
+
+__all__ = ["render", "write_textfile", "MetricsHTTPServer"]
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("\n", "\\n")
+             .replace('"', '\\"'))
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(names, values, extra=()) -> str:
+    pairs = [f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape_label(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def render(registry: Optional[MetricsRegistry] = None) -> str:
+    registry = registry or default_registry()
+    lines = []
+    for m in registry.metrics():
+        lines.append(f"# HELP {m.name} {_escape_help(m.help or m.name)}")
+        lines.append(f"# TYPE {m.name} {m.type_name}")
+        for c in m.children():
+            base = _label_str(m.labelnames, c.labels)
+            if isinstance(c, _HistogramChild):
+                cum = 0
+                for bound, count in zip(c.bounds, c.counts):
+                    cum += count
+                    lab = _label_str(m.labelnames, c.labels,
+                                     extra=[("le", _fmt_value(bound))])
+                    lines.append(f"{m.name}_bucket{lab} {cum}")
+                cum += c.counts[-1]
+                lab = _label_str(m.labelnames, c.labels,
+                                 extra=[("le", "+Inf")])
+                lines.append(f"{m.name}_bucket{lab} {cum}")
+                lines.append(f"{m.name}_sum{base} {_fmt_value(c.sum)}")
+                lines.append(f"{m.name}_count{base} {c.count}")
+            else:
+                lines.append(f"{m.name}{base} {_fmt_value(c.value)}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def write_textfile(path: str,
+                   registry: Optional[MetricsRegistry] = None) -> str:
+    """Atomic-ish textfile write (tmp + rename, the textfile-collector
+    contract: scrapers never see a half-written exposition)."""
+    import os
+
+    text = render(registry)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+class MetricsHTTPServer:
+    """Localhost /metrics scrape endpoint on a daemon thread.
+
+    >>> srv = MetricsHTTPServer(port=0)   # port=0: OS-assigned
+    >>> srv.start(); srv.port             # actual bound port
+    >>> srv.stop()
+    """
+
+    def __init__(self, port: int = 9464, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        self._registry = registry or default_registry()
+        self._host = host
+        self._port = int(port)
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> "MetricsHTTPServer":
+        if self._httpd is not None:
+            return self
+        import http.server
+
+        registry = self._registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = render(registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr spam
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self._host, self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics_http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

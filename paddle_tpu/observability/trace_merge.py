@@ -1,0 +1,165 @@
+"""Merged host + device chrome trace (trace correlation).
+
+The profiler's host RecordEvents and the jax.profiler device capture are
+two separate artifacts on two separate clocks: host events carry
+``time.perf_counter_ns`` timestamps, the XPlane capture carries the
+runtime's own timeline. This module merges them into ONE chrome-trace
+file so a single Perfetto/chrome://tracing load shows host dispatch lined
+up against device execution:
+
+- host events keep their pid (the python process) with per-thread rows
+  (real tids — profiler.py records ``threading.get_ident()``);
+- each device plane becomes its own pid with one row per trace line
+  ('XLA Ops', 'Steps', ...), so host and device spans land on distinct
+  tracks;
+- clocks are START-ALIGNED: the device capture's earliest span is pinned
+  to the host time at which ``jax.profiler.start_trace`` returned
+  (recorded by profiler.start_profiler). Within each side all relative
+  times are exact; the cross-clock offset is accurate to the trace-start
+  latency (device work cannot predate the first host dispatch, so the
+  alignment error is bounded by the start_trace call itself).
+
+Named scopes flow through both sides: RecordEvent doubles as a
+``jax.profiler.TraceAnnotation`` while a device trace is active, so the
+same name shows up on the host row (measured by perf_counter) and inside
+the XPlane host-thread lines (measured by the runtime).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["device_spans_from_xplane", "merge_events", "merge_profile"]
+
+# device pids start here so they can never collide with a real host pid
+# (linux pid_max tops out at 2^22)
+DEVICE_PID_BASE = 1 << 23
+
+
+def device_spans_from_xplane(trace_dir: str) -> List[dict]:
+    """Raw timed spans from the newest XPlane capture under ``trace_dir``.
+
+    Returns dicts ``{plane, line, name, start_ns, dur_ns}`` for every
+    positive-duration event on a device plane (all lines — the merge keeps
+    envelopes/DMA streams as separate rows rather than summing them; the
+    exclusive-attribution pipeline in utils/device_trace.py remains the
+    aggregation track). Off-TPU there are no ``/device:`` planes, so the
+    CPU client's runtime execution lines stand in as the device side —
+    the merged trace demonstrates the same host-vs-execution split on a
+    laptop run.
+    """
+    from ..utils.device_trace import _latest_xplane, profile_data_cls
+
+    path = _latest_xplane(trace_dir)
+    if path is None:
+        return []
+    pd = profile_data_cls().from_file(path)
+    spans: List[dict] = []
+    for plane in pd.planes:
+        pname = str(plane.name)
+        device_plane = pname.startswith("/device:")
+        for line in plane.lines:
+            lname = str(line.name)
+            if not device_plane and "CpuClient" not in lname:
+                continue
+            out_plane = pname if device_plane \
+                else f"{pname} (CPU runtime)"
+            for ev in line.events:
+                dur = float(getattr(ev, "duration_ns", 0.0) or 0.0)
+                if dur <= 0:
+                    continue
+                start = float(getattr(ev, "start_ns", 0.0) or 0.0)
+                spans.append({
+                    "plane": out_plane, "line": lname,
+                    "name": str(ev.name), "start_ns": start,
+                    "dur_ns": dur,
+                })
+    return spans
+
+
+def merge_events(host_events: Iterable[dict], device_spans: Iterable[dict],
+                 align_device_to_us: Optional[float] = None) -> dict:
+    """Merge host chrome-trace events with raw device spans into one
+    chrome-trace document (pure function — the testable core).
+
+    ``align_device_to_us``: host-clock microsecond timestamp the earliest
+    device span is shifted to (start alignment). ``None`` aligns the
+    earliest device span with the earliest host event.
+    """
+    host_events = [dict(e) for e in host_events]
+    device_spans = list(device_spans)
+
+    out: List[dict] = []
+    meta: List[dict] = []
+    host_pids = sorted({e.get("pid", 0) for e in host_events})
+    for pid in host_pids:
+        tracks = {e.get("args", {}).get("track") for e in host_events
+                  if e.get("pid", 0) == pid}
+        # synthetic aggregate tracks (measured-device / op-costs rows that
+        # device_trace/op_costs merged into the host file) keep their label
+        label = (f"{next(iter(tracks))} (aggregate)"
+                 if tracks and None not in tracks
+                 else f"host (pid {pid})")
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": label}})
+    out.extend(host_events)
+
+    if device_spans:
+        dev_min_ns = min(s["start_ns"] for s in device_spans)
+        if align_device_to_us is None:
+            align_device_to_us = (min(e.get("ts", 0.0) for e in host_events)
+                                  if host_events else 0.0)
+        shift_us = align_device_to_us - dev_min_ns / 1000.0
+
+        plane_pid: Dict[str, int] = {}
+        line_tid: Dict[Tuple[str, str], int] = {}
+        for s in device_spans:
+            pid = plane_pid.get(s["plane"])
+            if pid is None:
+                pid = DEVICE_PID_BASE + len(plane_pid)
+                plane_pid[s["plane"]] = pid
+                meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                             "args": {"name": f"device {s['plane']}"}})
+            key = (s["plane"], s["line"])
+            tid = line_tid.get(key)
+            if tid is None:
+                tid = len([k for k in line_tid if k[0] == s["plane"]])
+                line_tid[key] = tid
+                meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                             "tid": tid, "args": {"name": s["line"]}})
+            out.append({
+                "name": s["name"], "ph": "X",
+                "ts": s["start_ns"] / 1000.0 + shift_us,
+                "dur": s["dur_ns"] / 1000.0,
+                "pid": pid, "tid": tid,
+                "args": {"track": "device"},
+            })
+
+    out.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def merge_profile(host_trace_path: str, trace_dir: str,
+                  out_path: Optional[str] = None,
+                  align_device_to_us: Optional[float] = None) -> Optional[str]:
+    """Merge a profiler.py chrome trace with the XPlane capture it ran
+    alongside. Returns the merged path, or None when no device capture
+    exists (CPU-only runs without tracing)."""
+    try:
+        with open(host_trace_path) as f:
+            host = json.load(f).get("traceEvents", [])
+    except (OSError, ValueError):
+        host = []
+    spans = device_spans_from_xplane(trace_dir)
+    if not spans and not host:
+        return None
+    doc = merge_events(host, spans, align_device_to_us=align_device_to_us)
+    if out_path is None:
+        base = host_trace_path
+        if base.endswith(".chrome_trace.json"):
+            base = base[: -len(".chrome_trace.json")]
+        out_path = base + ".merged_trace.json"
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    return out_path

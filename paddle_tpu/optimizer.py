@@ -341,6 +341,19 @@ class Optimizer:
             ops.append(self._append_fused_optimize_op(
                 block, groups[key], lr_var, key))
         self._finish_update(block, params_grads)
+        # self-report the fusion win: N params collapsed into G update ops
+        from .observability import metrics as _obs_metrics
+
+        _reg = _obs_metrics.default_registry()
+        _reg.gauge(
+            "paddle_fused_optimizer_groups",
+            "Fused update ops in the last fused apply_gradients",
+            ("optimizer",)).labels(self.type).set(len(groups))
+        _reg.gauge(
+            "paddle_fused_optimizer_params",
+            "Parameters covered by the last fused apply_gradients",
+            ("optimizer",)).labels(self.type).set(
+                sum(len(v) for v in groups.values()))
         return ops
 
     def _add_group_accumulator(self, name: str, key, numel: int,

@@ -47,6 +47,24 @@ except ImportError:  # older jax
 from . import mesh as mesh_mod
 from ..models import gpt as gpt_mod
 from ..models.gpt import GPTConfig
+from ..observability import metrics as _obs_metrics
+
+# Collective self-reporting. Collectives execute inside ONE fused XLA
+# program, so their wall time is only observable on the device timeline:
+# every collective here is wrapped in a jax.named_scope whose name lands in
+# each HLO instruction's metadata, and the profiler's merged trace
+# (observability/trace_merge.py) then shows `collective/...` spans on the
+# device track. The counter below registers at TRACE time (once per
+# compile), giving an always-live count of collectives lowered per step.
+_m_collectives = _obs_metrics.default_registry().counter(
+    "paddle_collective_lowered_total",
+    "Collective ops lowered into compiled train steps", ("kind",))
+
+
+def _named_collective(kind: str):
+    """named_scope + lowering counter for one collective call site."""
+    _m_collectives.labels(kind).inc()
+    return jax.named_scope(f"collective/{kind}")
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -96,7 +114,10 @@ def psum_grads_by_spec(grads, specs, axis_names):
     """psum each grad leaf over the mesh axes its param is replicated on."""
     def one(g, s):
         axes = _axes_not_in_spec(s, axis_names)
-        return jax.lax.psum(g, axes) if axes else g
+        if not axes:
+            return g
+        with _named_collective("psum_grad"):
+            return jax.lax.psum(g, axes)
 
     return jax.tree_util.tree_map(one, grads, specs,
                                   is_leaf=lambda x: isinstance(x, P))
@@ -167,7 +188,11 @@ def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
         l = jax.lax.cond(valid, lambda: mb_loss(out, lbl),
                          lambda: jnp.float32(0.0))
         loss_acc = loss_acc + l
-        state = jax.lax.ppermute(out, pp_ax, perm) if S > 1 else out
+        if S > 1:
+            with _named_collective("ppermute_activation"):
+                state = jax.lax.ppermute(out, pp_ax, perm)
+        else:
+            state = out
         return (state, loss_acc), None
 
     D = cfg.d_model
@@ -314,7 +339,8 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     def grad_fn(params, tokens, labels):
         local_loss, grads = jax.value_and_grad(_pipeline_loss)(
             params, tokens, labels, cfg, pcfg)
-        loss = jax.lax.psum(local_loss, pcfg.axis_names)
+        with _named_collective("psum_loss"):
+            loss = jax.lax.psum(local_loss, pcfg.axis_names)
         grads = psum_grads_by_spec(grads, specs, pcfg.axis_names)
         return loss, grads
 
@@ -340,11 +366,15 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
              out_shardings=(param_sh, opt_sh, None, None),
              donate_argnums=(0, 1))
     def step(params, opt_state, tokens, labels):
-        loss, grads = sharded_grad(params, tokens, labels)
+        # named scopes stamp the phase into HLO metadata: the merged
+        # host+device trace shows train/grad vs train/opt_update spans
+        with jax.named_scope("train/grad"):
+            loss, grads = sharded_grad(params, tokens, labels)
         # optimizer update is elementwise: GSPMD partitions it with zero
         # communication (replaces the reference's fuse_optimizer_ops pass)
-        params, opt_state, gnorm = update(
-            params, grads, opt_state, lr, weight_decay=weight_decay)
+        with jax.named_scope("train/opt_update"):
+            params, opt_state, gnorm = update(
+                params, grads, opt_state, lr, weight_decay=weight_decay)
         return params, opt_state, loss, gnorm
 
     return step

@@ -19,6 +19,12 @@ from .framework.program import Program, default_main_program
 
 __all__ = ["ParallelExecutor"]
 
+from .observability import metrics as _obs_metrics
+
+_m_feed_merge_ms = _obs_metrics.default_registry().histogram(
+    "paddle_pexe_feed_merge_ms",
+    "ParallelExecutor per-device feed list merge wall time (ms)")
+
 
 class ParallelExecutor:
     def __init__(self, use_cuda: bool, loss_name: Optional[str] = None,
@@ -50,20 +56,23 @@ class ParallelExecutor:
             # compiled program re-splits across the mesh). Non-batched
             # entries — 0-d scalars like a fed learning rate — have no batch
             # axis to concatenate; they must be identical per device and
-            # pass through unsplit.
-            merged = {}
-            for k in feed[0]:
-                vals = [np.asarray(f[k]) for f in feed]
-                if vals[0].ndim == 0:
-                    for i, v in enumerate(vals[1:], 1):
-                        if v != vals[0]:
-                            raise ValueError(
-                                f"scalar feed {k!r} differs across devices "
-                                f"({vals[0]!r} vs {v!r} at device {i}); "
-                                "non-batched feeds must be replicated")
-                    merged[k] = vals[0]
-                else:
-                    merged[k] = np.concatenate(vals, axis=0)
+            # pass through unsplit. The merge cost is host-side per-step
+            # work, so it self-reports (paddle_pexe_feed_merge_ms).
+            with _m_feed_merge_ms.time():
+                merged = {}
+                for k in feed[0]:
+                    vals = [np.asarray(f[k]) for f in feed]
+                    if vals[0].ndim == 0:
+                        for i, v in enumerate(vals[1:], 1):
+                            if v != vals[0]:
+                                raise ValueError(
+                                    f"scalar feed {k!r} differs across "
+                                    f"devices ({vals[0]!r} vs {v!r} at "
+                                    f"device {i}); non-batched feeds must "
+                                    "be replicated")
+                        merged[k] = vals[0]
+                    else:
+                        merged[k] = np.concatenate(vals, axis=0)
             feed = merged
         outs = self._exe.run(self._compiled, feed=feed or {},
                              fetch_list=list(fetch_list),

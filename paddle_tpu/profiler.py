@@ -5,71 +5,120 @@ The reference has a host event profiler + CUPTI device tracer serialized to
 profiler.proto with chrome-trace export (tools/timeline.py). Here the device
 side is jax.profiler (XPlane, viewable in TensorBoard/Perfetto) and the host
 side is a lightweight event recorder with chrome-trace export
-(utils/timeline.py)."""
+(utils/timeline.py). stop_profiler additionally merges both sides into one
+chrome trace (observability/trace_merge.py): host RecordEvents and device
+spans on distinct pids, start-aligned clocks, so a single Perfetto load
+shows host dispatch lined up against device execution.
+
+Host events record the REAL thread id (async-fetch and prefetch threads get
+their own trace rows instead of overdrawing on row 0), and while a device
+trace is active every RecordEvent doubles as a jax.profiler.TraceAnnotation
+so the same scope name appears in the XPlane capture.
+"""
 from __future__ import annotations
 
 import contextlib
 import json
 import os
+import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 
 _events: List[dict] = []
+_thread_names: Dict[int, str] = {}
 _active = False
+_device_trace_active = False
 _trace_dir: Optional[str] = None
+# host perf_counter (us) at the moment the device trace started — the
+# shared-clock anchor for trace_merge's start alignment
+_trace_host_t0_us: Optional[float] = None
+
+
+def _note_thread(tid: int) -> None:
+    if tid not in _thread_names:
+        _thread_names[tid] = threading.current_thread().name
 
 
 class RecordEvent:
-    """RAII op-level host event — parity with platform::RecordEvent."""
+    """RAII op-level host event — parity with platform::RecordEvent.
+
+    Records the real thread id, and (while a device trace is active)
+    mirrors the scope into the XPlane capture via TraceAnnotation so the
+    host and device views share names.
+    """
 
     def __init__(self, name: str):
         self.name = name
+        self._ann = None
 
     def __enter__(self):
+        if _device_trace_active:
+            try:
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
         self.t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
+        # the event is recorded even when the guarded block raised — a
+        # failing step must still show up in the trace, not vanish
         if _active:
+            tid = threading.get_ident()
+            _note_thread(tid)
             _events.append({
                 "name": self.name,
                 "ph": "X",
                 "ts": self.t0 / 1000.0,
                 "dur": (time.perf_counter_ns() - self.t0) / 1000.0,
                 "pid": os.getpid(),
-                "tid": 0,
+                "tid": tid,
             })
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+            self._ann = None
 
 
 record_event = RecordEvent
 
 
-def add_event(name: str, t0_ns: int, dur_ns: int):
+def add_event(name: str, t0_ns: int, dur_ns: int, tid: Optional[int] = None):
     """Append a host event whose name is only known after it finished (e.g.
     'compile_cache/hit' vs 'compile_cache/cold' — the verdict exists once the
-    first execution returns)."""
+    first execution returns). ``tid`` defaults to the calling thread."""
     if _active:
+        if tid is None:
+            tid = threading.get_ident()
+            _note_thread(tid)
         _events.append({
             "name": name,
             "ph": "X",
             "ts": t0_ns / 1000.0,
             "dur": dur_ns / 1000.0,
             "pid": os.getpid(),
-            "tid": 0,
+            "tid": tid,
         })
 
 
 def start_profiler(state="All", tracer_option="Default"):
-    global _active, _trace_dir
+    global _active, _trace_dir, _device_trace_active, _trace_host_t0_us
     _active = True
     _events.clear()
+    _thread_names.clear()
     _trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
     try:
         jax.profiler.start_trace(_trace_dir)
+        _device_trace_active = True
     except Exception:
-        pass  # device tracing optional (e.g. second start without stop)
+        # device tracing optional (e.g. second start without stop)
+        _device_trace_active = False
+    _trace_host_t0_us = time.perf_counter_ns() / 1000.0
 
 
 _attached_program = None
@@ -100,17 +149,35 @@ def register_compiled(key, hlo_text_getter):
         _compiled_hlo_getters[key] = hlo_text_getter
 
 
+def _flush_host_trace(trace_path: str) -> None:
+    """Write the buffered host events (plus thread-name metadata rows) —
+    isolated so the flush happens even when the optional attribution or
+    merge stages below it fail."""
+    meta = [{"name": "thread_name", "ph": "M", "pid": os.getpid(),
+             "tid": tid, "args": {"name": name}}
+            for tid, name in sorted(_thread_names.items())]
+    with open(trace_path, "w") as f:
+        json.dump({"traceEvents": meta + _events}, f)
+
+
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    global _active
+    global _active, _device_trace_active
     _active = False
     try:
         jax.profiler.stop_trace()
     except Exception:
         pass
-    # chrome-trace export of host events (tools/timeline.py parity)
+    _device_trace_active = False
+    # chrome-trace export of host events (tools/timeline.py parity) FIRST:
+    # every stage after this point is optional attribution/merging, and a
+    # failure there must not lose the buffered events (they were already
+    # lost once, when an exception inside the profiled region skipped a
+    # non-finally stop path)
     trace_path = profile_path + ".chrome_trace.json"
-    with open(trace_path, "w") as f:
-        json.dump({"traceEvents": _events}, f)
+    try:
+        _flush_host_trace(trace_path)
+    except Exception as e:
+        print(f"[profiler] host trace write failed: {type(e).__name__}: {e}")
     # measured per-op device attribution (reference device_tracer.cc) —
     # needs at least one compiled block to have run under the trace
     if _compiled_hlo_getters and _trace_dir:
@@ -143,6 +210,19 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         except Exception as e:  # attribution is optional, like device trace
             print(f"[profiler] cost attribution skipped: "
                   f"{type(e).__name__}: {e}")
+    # merged host+device chrome trace (one Perfetto load, shared clock)
+    if _trace_dir:
+        try:
+            from .observability import trace_merge
+
+            merged = trace_merge.merge_profile(
+                trace_path, _trace_dir,
+                align_device_to_us=_trace_host_t0_us)
+            if merged:
+                print(f"[profiler] merged host+device trace: {merged}")
+        except Exception as e:
+            print(f"[profiler] host+device merge skipped: "
+                  f"{type(e).__name__}: {e}")
     if sorted_key:
         _print_summary(sorted_key)
 
@@ -169,11 +249,14 @@ def _print_summary(sorted_key="total"):
 
 def reset_profiler():
     _events.clear()
+    _thread_names.clear()
 
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
              tracer_option="Default"):
+    """Profiling context. ``finally`` guarantees the buffered events flush
+    to the chrome trace even when the profiled region raises."""
     start_profiler(state, tracer_option)
     try:
         yield

@@ -50,8 +50,27 @@ def prefetch_to_device(batches, size: int = 2):
 
     Producer exceptions re-raise in the consumer; abandoning the iterator
     unblocks and stops the producer.
+
+    Self-reporting: the metrics registry carries the ready-batch queue
+    depth (``paddle_prefetch_queue_depth`` — sampled at every consumer
+    get: a depth pinned at 0 means the device is starving on data, pinned
+    at ``size`` means the pipeline is step-bound) and the staged-batch /
+    consumer-stall totals.
     """
     import jax
+
+    from .observability import metrics as _obs_metrics
+
+    _reg = _obs_metrics.default_registry()
+    _g_depth = _reg.gauge(
+        "paddle_prefetch_queue_depth",
+        "Ready device-staged batches in the prefetch queue")
+    _c_batches = _reg.counter(
+        "paddle_prefetch_batches_total",
+        "Batches staged onto the device by prefetch_to_device")
+    _c_stall = _reg.counter(
+        "paddle_prefetch_consumer_stall_ms_total",
+        "Time the training loop spent waiting on the prefetch queue (ms)")
 
     def to_device(item):
         if isinstance(item, dict):
@@ -90,12 +109,18 @@ def prefetch_to_device(batches, size: int = 2):
                          name="device_prefetch")
     t.start()
     try:
+        import time as _time
+
         while True:
+            _g_depth.set(q.qsize())
+            t0 = _time.perf_counter_ns()
             is_err, item = q.get()
+            _c_stall.inc((_time.perf_counter_ns() - t0) / 1e6)
             if is_err:
                 raise item
             if item is _end:
                 break
+            _c_batches.inc()
             yield item
     finally:
         stop.set()

@@ -48,6 +48,20 @@ def _latest_xplane(trace_dir: str) -> Optional[str]:
     return max(files, key=os.path.getmtime) if files else None
 
 
+def profile_data_cls():
+    """The XSpace reader: jax's own ProfileData when the installed jax
+    exposes it, else the in-repo wire-format shim (utils/xplane.py — the
+    pinned jax 0.4.37 writes captures but ships no reader)."""
+    try:
+        from jax.profiler import ProfileData  # type: ignore[attr-defined]
+
+        return ProfileData
+    except ImportError:
+        from .xplane import ProfileData
+
+        return ProfileData
+
+
 def _line_role(name: str, event_names: Iterable[str]) -> str:
     """Classify a device-plane trace line from OBSERVED names.
 
@@ -156,12 +170,10 @@ def device_events(trace_dir: str,
     """
     import sys
 
-    from jax.profiler import ProfileData
-
     path = _latest_xplane(trace_dir)
     if path is None:
         return
-    pd = ProfileData.from_file(path)
+    pd = profile_data_cls().from_file(path)
     for plane in pd.planes:
         device_plane = plane.name.startswith("/device:")
         lines = list(plane.lines)
@@ -192,10 +204,11 @@ def device_events(trace_dir: str,
                       f" (attribution may overlap)", file=sys.stderr)
         plane_rows: List[list] = []   # device rows held for the plane check
         for line in lines:
-            # execution lines only: TPU device planes, or the PJRT CPU
-            # client's runtime line — host python/trace-me lines may carry
-            # hlo_op stats too and would double-count
-            exec_line = device_plane or "XLAPjRtCpuClient" in str(line.name)
+            # execution lines only: TPU device planes, or the CPU client's
+            # runtime line ('XLAPjRtCpuClient' / 'tf_XLATfrtCpuClient' —
+            # the runtime renamed it across releases) — host python/
+            # trace-me lines may carry hlo_op stats too and double-count
+            exec_line = device_plane or "CpuClient" in str(line.name)
             if not exec_line:
                 continue
             evs = []

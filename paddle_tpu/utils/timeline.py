@@ -4,6 +4,13 @@ chrome://tracing JSON, with multi-trainer merge).
 The reference converts profiler.proto dumps from N trainers into one
 chrome-trace with a pid per trainer; here profiles are the chrome-trace JSON
 files written by paddle_tpu.profiler.stop_profiler, merged the same way.
+
+Each input file may itself carry several pids (the merged host+device
+traces from observability/trace_merge.py put host and device spans on
+distinct pids): the merge remaps each (file, original pid) pair to its own
+output pid, so host/device tracks stay separate after the multi-trainer
+merge instead of collapsing onto one row. Source process_name metadata is
+preserved under a "trainer/" prefix.
 """
 from __future__ import annotations
 
@@ -21,16 +28,42 @@ class Timeline:
     def _load(self):
         merged: List[dict] = []
         metadata: List[dict] = []
-        for pid, (name, path) in enumerate(self.profile_paths):
+        next_pid = 0
+        for fi, (name, path) in enumerate(self.profile_paths):
             with open(path) as f:
                 data = json.load(f)
-            metadata.append({
-                "name": "process_name", "ph": "M", "pid": pid,
-                "args": {"name": name},
-            })
-            for ev in data.get("traceEvents", []):
+            events = data.get("traceEvents", [])
+            # source process names, keyed by original pid
+            src_names: Dict[int, str] = {
+                ev.get("pid", 0): ev.get("args", {}).get("name", "")
+                for ev in events
+                if ev.get("ph") == "M" and ev.get("name") == "process_name"
+            }
+            pid_map: Dict[int, int] = {}
+
+            def out_pid(orig, name=name, src_names=src_names,
+                        pid_map=pid_map):
+                nonlocal next_pid
+                if orig not in pid_map:
+                    pid_map[orig] = next_pid
+                    src = src_names.get(orig, "")
+                    label = f"{name}/{src}" if src else name
+                    metadata.append({
+                        "name": "process_name", "ph": "M",
+                        "pid": pid_map[orig], "args": {"name": label},
+                    })
+                    next_pid += 1
+                return pid_map[orig]
+
+            # single-pid files keep the old behavior (one pid per trainer)
+            out_pid(min(src_names) if src_names
+                    else min((ev.get("pid", 0) for ev in events
+                              if ev.get("ph") != "M"), default=0))
+            for ev in events:
+                if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                    continue  # re-emitted above with the trainer prefix
                 ev = dict(ev)
-                ev["pid"] = pid
+                ev["pid"] = out_pid(ev.get("pid", 0))
                 merged.append(ev)
         return metadata + merged
 

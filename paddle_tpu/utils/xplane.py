@@ -1,0 +1,257 @@
+"""Minimal XSpace (xplane.pb) reader — a ``jax.profiler.ProfileData`` shim.
+
+Newer jax exposes ``jax.profiler.ProfileData`` to walk a profiler capture
+(planes -> lines -> events with stats); the jax pinned in this environment
+(0.4.37) writes the capture but does not expose the reader, and no xplane
+protobuf bindings ship with it — which left utils/device_trace.py (measured
+per-op attribution) dead on arrival: 'cannot import name ProfileData'.
+
+This module decodes the XSpace protobuf wire format directly (the schema is
+tensorflow/core/profiler/protobuf/xplane.proto; only varint / fixed64 /
+length-delimited wire types occur) and exposes the same surface
+device_trace.py and observability/trace_merge.py consume:
+
+    pd = ProfileData.from_file(path)      # or from_serialized_xspace(bytes)
+    for plane in pd.planes:               # .name
+        for line in plane.lines:          # .name
+            for ev in line.events:        # .name, .start_ns, .duration_ns
+                dict(ev.stats)            # {'hlo_op': ..., 'hlo_module': ...}
+
+Times follow the jax reader's convention: ``start_ns`` is the line's
+``timestamp_ns`` plus the event's ``offset_ps/1e3``; durations convert
+ps -> ns. Stat values resolve the oneof (double/int/uint/str/bytes/ref —
+ref values dereference the plane's stat_metadata names).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Tuple
+
+__all__ = ["ProfileData"]
+
+
+def _decode_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long (corrupt xplane.pb)")
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    Length-delimited values come back as memoryview-backed bytes."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _decode_varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:          # varint
+            val, i = _decode_varint(buf, i)
+        elif wt == 1:        # fixed64
+            val = buf[i:i + 8]
+            i += 8
+        elif wt == 2:        # length-delimited
+            ln, i = _decode_varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wt == 5:        # fixed32
+            val = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} (group fields "
+                             "do not occur in xplane.proto)")
+        yield field, wt, val
+
+
+def _signed64(v: int) -> int:
+    """Two's-complement interpretation of a varint-decoded int64."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class _Stat:
+    """XStat: metadata_id + a value oneof."""
+
+    __slots__ = ("metadata_id", "kind", "raw")
+
+    def __init__(self, buf: bytes):
+        self.metadata_id = 0
+        self.kind = None
+        self.raw = None
+        for field, wt, val in _fields(buf):
+            if field == 1:
+                self.metadata_id = val
+            elif field == 2:   # double_value (fixed64)
+                self.kind, self.raw = "double", struct.unpack("<d", val)[0]
+            elif field == 3:   # uint64_value
+                self.kind, self.raw = "uint64", val
+            elif field == 4:   # int64_value
+                self.kind, self.raw = "int64", _signed64(val)
+            elif field == 5:   # str_value
+                self.kind, self.raw = "str", bytes(val).decode(
+                    "utf-8", "replace")
+            elif field == 6:   # bytes_value
+                self.kind, self.raw = "bytes", bytes(val)
+            elif field == 7:   # ref_value -> stat_metadata name
+                self.kind, self.raw = "ref", val
+
+    def resolve(self, stat_meta: Dict[int, str]):
+        if self.kind == "ref":
+            return stat_meta.get(self.raw, str(self.raw))
+        return self.raw
+
+
+class _Event:
+    """XEvent with plane metadata resolved: name / start_ns / duration_ns /
+    stats (iterable of (name, value), so ``dict(ev.stats)`` works)."""
+
+    __slots__ = ("name", "start_ns", "duration_ns", "_stats")
+
+    def __init__(self, buf: bytes, line_ts_ns: int,
+                 event_meta: Dict[int, "_EventMeta"],
+                 stat_meta: Dict[int, str]):
+        metadata_id = 0
+        offset_ps = 0
+        duration_ps = 0
+        raw_stats: List[_Stat] = []
+        for field, wt, val in _fields(buf):
+            if field == 1:
+                metadata_id = val
+            elif field == 2:   # offset_ps (oneof data)
+                offset_ps = _signed64(val)
+            elif field == 3:
+                duration_ps = val
+            elif field == 4:
+                raw_stats.append(_Stat(val))
+        meta = event_meta.get(metadata_id)
+        self.name = (meta.display_name or meta.name) if meta else ""
+        self.start_ns = line_ts_ns + offset_ps / 1e3
+        self.duration_ns = duration_ps / 1e3
+        stats: List[Tuple[str, object]] = []
+        for s in raw_stats:
+            stats.append((stat_meta.get(s.metadata_id, str(s.metadata_id)),
+                          s.resolve(stat_meta)))
+        # event-metadata-level stats apply to every occurrence (XLA Ops
+        # lines carry hlo_op/hlo_module there on some runtimes)
+        if meta is not None:
+            for s in meta.stats:
+                stats.append((stat_meta.get(s.metadata_id,
+                                            str(s.metadata_id)),
+                              s.resolve(stat_meta)))
+        self._stats = stats
+
+    @property
+    def stats(self):
+        return list(self._stats)
+
+
+class _EventMeta:
+    __slots__ = ("name", "display_name", "stats")
+
+    def __init__(self, buf: bytes):
+        self.name = ""
+        self.display_name = ""
+        self.stats: List[_Stat] = []
+        for field, wt, val in _fields(buf):
+            if field == 2:
+                self.name = bytes(val).decode("utf-8", "replace")
+            elif field == 4:
+                self.display_name = bytes(val).decode("utf-8", "replace")
+            elif field == 5:
+                self.stats.append(_Stat(val))
+
+
+class _Line:
+    __slots__ = ("name", "timestamp_ns", "_event_bufs", "_event_meta",
+                 "_stat_meta")
+
+    def __init__(self, buf: bytes, event_meta, stat_meta):
+        name = display_name = ""
+        self.timestamp_ns = 0
+        self._event_bufs: List[bytes] = []
+        for field, wt, val in _fields(buf):
+            if field == 2:
+                name = bytes(val).decode("utf-8", "replace")
+            elif field == 11:
+                display_name = bytes(val).decode("utf-8", "replace")
+            elif field == 3:
+                self.timestamp_ns = _signed64(val)
+            elif field == 4:
+                self._event_bufs.append(val)
+        self.name = display_name or name
+        self._event_meta = event_meta
+        self._stat_meta = stat_meta
+
+    @property
+    def events(self) -> Iterator[_Event]:
+        for b in self._event_bufs:
+            yield _Event(b, self.timestamp_ns, self._event_meta,
+                         self._stat_meta)
+
+
+def _parse_map_entry(buf: bytes) -> Tuple[int, bytes]:
+    """proto map<int64, Msg> entry: key=field 1 varint, value=field 2."""
+    key, val = 0, b""
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            key = v
+        elif field == 2:
+            val = v
+    return key, val
+
+
+class _Plane:
+    __slots__ = ("name", "_line_bufs", "_event_meta", "_stat_meta")
+
+    def __init__(self, buf: bytes):
+        self.name = ""
+        self._line_bufs: List[bytes] = []
+        self._event_meta: Dict[int, _EventMeta] = {}
+        self._stat_meta: Dict[int, str] = {}
+        for field, wt, val in _fields(buf):
+            if field == 2:
+                self.name = bytes(val).decode("utf-8", "replace")
+            elif field == 3:
+                self._line_bufs.append(val)
+            elif field == 4:
+                k, v = _parse_map_entry(val)
+                self._event_meta[k] = _EventMeta(v)
+            elif field == 5:
+                k, v = _parse_map_entry(val)
+                meta_name = ""
+                for f2, _, v2 in _fields(v):
+                    if f2 == 2:
+                        meta_name = bytes(v2).decode("utf-8", "replace")
+                self._stat_meta[k] = meta_name
+
+    @property
+    def lines(self) -> Iterator[_Line]:
+        for b in self._line_bufs:
+            yield _Line(b, self._event_meta, self._stat_meta)
+
+
+class ProfileData:
+    """Drop-in for the subset of ``jax.profiler.ProfileData`` used here."""
+
+    def __init__(self, plane_bufs: List[bytes]):
+        self._plane_bufs = plane_bufs
+
+    @classmethod
+    def from_serialized_xspace(cls, data: bytes) -> "ProfileData":
+        planes = [val for field, wt, val in _fields(data) if field == 1]
+        return cls(planes)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ProfileData":
+        with open(path, "rb") as f:
+            return cls.from_serialized_xspace(f.read())
+
+    @property
+    def planes(self) -> Iterator[_Plane]:
+        for b in self._plane_bufs:
+            yield _Plane(b)

@@ -1,0 +1,513 @@
+"""Unified runtime telemetry (ISSUE 3): metrics registry, Prometheus
+exposition, TrainMonitor JSONL, merged host+device chrome trace, profiler
+tid/flush satellites, and the always-live executor counters."""
+import json
+import math
+import os
+import struct
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+from paddle_tpu.observability import (MetricsRegistry, MonitorWriter,
+                                      TrainMonitor, default_registry,
+                                      metrics, prom, trace_merge)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from metrics_check import PROM_LINE_RX, validate_prom_text  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests", ("path",))
+    c.labels("fast").inc()
+    c.labels("fast").inc(2)
+    c.labels("slow").inc()
+    assert c.labels("fast").value == 3
+    assert c.labels("slow").value == 1
+
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value == 4
+
+    h = reg.histogram("t_latency_ms", "latency", buckets=(1, 10, 100))
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    child = h._unlabeled()
+    assert child.count == 4
+    assert child.sum == 555.5
+    assert child.counts == [1, 1, 1, 1]  # one per bucket + overflow
+
+
+def test_registry_get_or_create_idempotent_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("t_x_total", "x")
+    b = reg.counter("t_x_total", "x")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("t_x_total", "x")  # type conflict
+    with pytest.raises(ValueError):
+        reg.counter("t_x_total", "x", ("lbl",))  # label conflict
+    with pytest.raises(ValueError):
+        reg.counter("0bad name")
+
+
+def test_histogram_rolling_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_p_ms", "p", window=100)
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(50) in (50.0, 51.0)
+    assert h.percentile(99) in (99.0, 100.0)
+    assert h.time() is not None  # timer context exists
+    with h.time():
+        pass
+    assert h._unlabeled().count == 101
+
+
+def test_metrics_kill_switch():
+    reg = MetricsRegistry()
+    c = reg.counter("t_k_total", "k")
+    metrics.set_metrics_enabled(False)
+    try:
+        c.inc()
+        assert c.value == 0
+    finally:
+        metrics.set_metrics_enabled(True)
+    c.inc()
+    assert c.value == 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_prom_render_validates_and_cumulates():
+    reg = MetricsRegistry()
+    reg.counter("t_total", "a counter", ("kind",)).labels("x").inc(3)
+    reg.gauge("t_gauge", "a gauge").set(-1.5)
+    h = reg.histogram("t_hist_ms", "a histogram", buckets=(1, 10))
+    for v in (0.5, 0.6, 5, 50):
+        h.observe(v)
+    text = prom.render(reg)
+    validate_prom_text(text)
+    lines = text.splitlines()
+    assert 't_total{kind="x"} 3' in lines
+    assert "t_gauge -1.5" in lines
+    # histogram buckets are CUMULATIVE and end with +Inf == _count
+    assert 't_hist_ms_bucket{le="1"} 2' in lines
+    assert 't_hist_ms_bucket{le="10"} 3' in lines
+    assert 't_hist_ms_bucket{le="+Inf"} 4' in lines
+    assert "t_hist_ms_count 4" in lines
+    assert any(ln.startswith("t_hist_ms_sum ") for ln in lines)
+    # HELP/TYPE comments present and grammatical
+    assert "# TYPE t_hist_ms histogram" in lines
+    assert all(PROM_LINE_RX.match(ln) for ln in lines if ln)
+
+
+def test_prom_textfile_and_http_server(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("t_scrape_total", "scrapes").inc()
+    path = prom.write_textfile(str(tmp_path / "m.prom"), reg)
+    validate_prom_text(open(path).read())
+
+    srv = prom.MetricsHTTPServer(port=0, registry=reg).start()
+    try:
+        import urllib.request
+
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+        assert "t_scrape_total 1" in body
+        validate_prom_text(body)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# TrainMonitor / MonitorWriter
+# ---------------------------------------------------------------------------
+
+def test_monitor_writer_jsonl(tmp_path):
+    p = str(tmp_path / "w.jsonl")
+    with MonitorWriter(p) as w:
+        w.write({"a": 1})
+        w.write({"b": 2.5})
+    recs = [json.loads(ln) for ln in open(p)]
+    assert recs == [{"a": 1}, {"b": 2.5}]
+
+
+def test_train_monitor_step_flow(tmp_path):
+    p = str(tmp_path / "steps.jsonl")
+    reg = MetricsRegistry()
+    mon = TrainMonitor(path=p, examples_per_step=8, tokens_per_step=64,
+                       flops_per_step=1e6, peak_flops=1e12, registry=reg)
+    for i in range(4):
+        with mon.step() as s:
+            s.dispatched()
+            s.observe(loss=np.float32(1.5 - 0.1 * i),
+                      grad_norm=np.float32(2.0))
+    mon.close()
+    recs = [json.loads(ln) for ln in open(p)]
+    assert len(recs) == 4
+    for rec in recs:
+        for key in ("step", "step_time_ms", "host_dispatch_ms",
+                    "device_wait_ms", "examples_per_s", "tokens_per_s",
+                    "mfu", "loss", "grad_norm", "nan_inf",
+                    "p50_step_time_ms", "p90_step_time_ms",
+                    "p99_step_time_ms"):
+            assert key in rec, (key, rec)
+        assert math.isfinite(rec["step_time_ms"])
+        assert rec["nan_inf"] is False
+    assert recs[0]["step"] == 1 and recs[-1]["step"] == 4
+    assert abs(recs[-1]["loss"] - 1.2) < 1e-6
+    # registry mirror
+    assert reg.get("paddle_train_steps_total").value == 4
+    assert reg.get("paddle_train_examples_total").value == 32
+
+
+def test_train_monitor_flags_nan_and_record_step():
+    mon = TrainMonitor(examples_per_step=4, registry=MetricsRegistry())
+    rec = mon.record_step(step_time_ms=10.0, host_dispatch_ms=2.0,
+                          device_wait_ms=7.0, loss=float("nan"))
+    assert rec["nan_inf"] is True
+    assert rec["host_dispatch_ms"] == 2.0
+    assert rec["device_wait_ms"] == 7.0
+    assert abs(rec["examples_per_s"] - 400.0) < 1e-6
+    rec2 = mon.record_step(step_time_ms=5.0, loss=1.0,
+                           grad_norm=float("inf"))
+    assert rec2["nan_inf"] is True
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace merge
+# ---------------------------------------------------------------------------
+
+def _host_events():
+    return [
+        {"name": "executor_run", "ph": "X", "ts": 1000.0, "dur": 50.0,
+         "pid": 42, "tid": 7},
+        {"name": "compile/3ops", "ph": "X", "ts": 1100.0, "dur": 400.0,
+         "pid": 42, "tid": 7},
+    ]
+
+
+def _device_spans():
+    return [
+        {"plane": "/device:TPU:0", "line": "XLA Ops", "name": "fusion.1",
+         "start_ns": 5_000_000.0, "dur_ns": 30_000.0},
+        {"plane": "/device:TPU:0", "line": "XLA Ops", "name": "dot.2",
+         "start_ns": 5_040_000.0, "dur_ns": 60_000.0},
+        {"plane": "/device:TPU:0", "line": "Steps", "name": "0",
+         "start_ns": 5_000_000.0, "dur_ns": 100_000.0},
+    ]
+
+
+def test_merge_events_valid_monotonic_distinct_pids():
+    doc = trace_merge.merge_events(_host_events(), _device_spans())
+    # valid JSON round trip
+    doc = json.loads(json.dumps(doc))
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    # monotonic non-decreasing timestamps over the X events
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # host and device pids distinct
+    host_pids = {e["pid"] for e in evs if "track" not in e.get("args", {})}
+    dev_pids = {e["pid"] for e in evs
+                if e.get("args", {}).get("track") == "device"}
+    assert host_pids == {42}
+    assert dev_pids and host_pids.isdisjoint(dev_pids)
+    # process metadata names both sides
+    names = {m["args"]["name"] for m in meta if m["name"] == "process_name"}
+    assert any("host" in n for n in names)
+    assert any("/device:TPU:0" in n for n in names)
+    # device lines become named thread rows
+    tnames = {m["args"]["name"] for m in meta if m["name"] == "thread_name"}
+    assert {"XLA Ops", "Steps"} <= tnames
+    # start alignment: earliest device span lands at the earliest host ts
+    dev_ts = min(e["ts"] for e in evs
+                 if e.get("args", {}).get("track") == "device")
+    assert abs(dev_ts - 1000.0) < 1e-6
+
+
+def test_merge_events_explicit_alignment_and_empty_sides():
+    doc = trace_merge.merge_events(_host_events(), _device_spans(),
+                                   align_device_to_us=2000.0)
+    dev_ts = min(e["ts"] for e in doc["traceEvents"]
+                 if e.get("args", {}).get("track") == "device")
+    assert abs(dev_ts - 2000.0) < 1e-6
+    # host-only and device-only merges still produce valid docs
+    assert trace_merge.merge_events(_host_events(), [])["traceEvents"]
+    assert trace_merge.merge_events([], _device_spans())["traceEvents"]
+
+
+def test_merge_profile_writes_file(tmp_path):
+    host_path = str(tmp_path / "p.chrome_trace.json")
+    with open(host_path, "w") as f:
+        json.dump({"traceEvents": _host_events()}, f)
+    out = trace_merge.merge_profile(host_path, str(tmp_path / "no_trace"))
+    assert out == str(tmp_path / "p.merged_trace.json")
+    doc = json.load(open(out))
+    assert doc["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# xplane wire-format parser (the ProfileData shim)
+# ---------------------------------------------------------------------------
+
+def _varint(v):
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _field(num, wt, payload):
+    tag = _varint((num << 3) | wt)
+    if wt == 2:
+        return tag + _varint(len(payload)) + payload
+    if wt == 0:
+        return tag + _varint(payload)
+    return tag + payload
+
+
+def _build_xspace():
+    """Hand-encoded XSpace: one plane '/device:TPU:0', stat metadata
+    {1: 'hlo_op'}, event metadata {9: 'fusion.1'}, one line 'XLA Ops'
+    (timestamp 1000ns) with one event at offset 2000ps, dur 3000ps,
+    stats [hlo_op='fusion.1' (str), score=0.5 (double)]."""
+    stat_meta = _field(1, 0, 1) + _field(2, 2, b"hlo_op")
+    stat_meta_entry = _field(1, 0, 1) + _field(2, 2, stat_meta)
+    stat_meta2 = _field(1, 0, 2) + _field(2, 2, b"score")
+    stat_meta2_entry = _field(1, 0, 2) + _field(2, 2, stat_meta2)
+    ev_meta = _field(1, 0, 9) + _field(2, 2, b"fusion.1")
+    ev_meta_entry = _field(1, 0, 9) + _field(2, 2, ev_meta)
+    stat1 = _field(1, 0, 1) + _field(5, 2, b"fusion.1")
+    stat2 = _field(1, 0, 2) + _field(2, 1, struct.pack("<d", 0.5))
+    event = (_field(1, 0, 9) + _field(2, 0, 2000) + _field(3, 0, 3000)
+             + _field(4, 2, stat1) + _field(4, 2, stat2))
+    line = (_field(2, 2, b"XLA Ops") + _field(3, 0, 1000)
+            + _field(4, 2, event))
+    plane = (_field(2, 2, b"/device:TPU:0") + _field(3, 2, line)
+             + _field(4, 2, ev_meta_entry) + _field(5, 2, stat_meta_entry)
+             + _field(5, 2, stat_meta2_entry))
+    return _field(1, 2, plane)
+
+
+def test_xplane_parser_roundtrip(tmp_path):
+    from paddle_tpu.utils.xplane import ProfileData
+
+    path = str(tmp_path / "t.xplane.pb")
+    with open(path, "wb") as f:
+        f.write(_build_xspace())
+    pd = ProfileData.from_file(path)
+    planes = list(pd.planes)
+    assert [p.name for p in planes] == ["/device:TPU:0"]
+    lines = list(planes[0].lines)
+    assert [ln.name for ln in lines] == ["XLA Ops"]
+    evs = list(lines[0].events)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev.name == "fusion.1"
+    assert ev.start_ns == 1000 + 2000 / 1e3
+    assert ev.duration_ns == 3000 / 1e3
+    stats = dict(ev.stats)
+    assert stats["hlo_op"] == "fusion.1"
+    assert stats["score"] == 0.5
+
+
+def test_device_spans_from_xplane_synthetic(tmp_path, monkeypatch):
+    trace_dir = tmp_path / "trace"
+    trace_dir.mkdir()
+    with open(trace_dir / "t.xplane.pb", "wb") as f:
+        f.write(_build_xspace())
+    # force the shim even where jax exposes its own reader
+    from paddle_tpu.utils import device_trace, xplane
+
+    monkeypatch.setattr(device_trace, "profile_data_cls",
+                        lambda: xplane.ProfileData)
+    spans = trace_merge.device_spans_from_xplane(str(trace_dir))
+    assert spans == [{"plane": "/device:TPU:0", "line": "XLA Ops",
+                      "name": "fusion.1", "start_ns": 1002.0,
+                      "dur_ns": 3.0}]
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites: real tids + exception-safe flush
+# ---------------------------------------------------------------------------
+
+def test_record_event_real_thread_ids(tmp_path):
+    profiler.start_profiler()
+    with profiler.RecordEvent("main_thread_event"):
+        pass
+
+    def side():
+        with profiler.RecordEvent("worker_thread_event"):
+            pass
+
+    t = threading.Thread(target=side, name="side_worker")
+    t.start()
+    t.join()
+    profiler.stop_profiler(profile_path=str(tmp_path / "p"))
+    doc = json.load(open(str(tmp_path / "p") + ".chrome_trace.json"))
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("ph") == "X"}
+    tid_main = by_name["main_thread_event"]["tid"]
+    tid_side = by_name["worker_thread_event"]["tid"]
+    assert tid_main != 0 or tid_side != 0
+    assert tid_main != tid_side
+    # thread-name metadata row for the named worker thread
+    tnames = {m["args"]["name"] for m in doc["traceEvents"]
+              if m.get("ph") == "M" and m["name"] == "thread_name"}
+    assert "side_worker" in tnames
+
+
+def test_profiler_context_flushes_on_exception(tmp_path):
+    path = str(tmp_path / "exc")
+    with pytest.raises(RuntimeError):
+        with profiler.profiler(profile_path=path):
+            with profiler.RecordEvent("doomed_step"):
+                raise RuntimeError("boom")
+    doc = json.load(open(path + ".chrome_trace.json"))
+    names = [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert "doomed_step" in names
+
+
+def test_add_event_default_tid(tmp_path):
+    profiler.start_profiler()
+    profiler.add_event("late_named", 1000, 500)
+    profiler.stop_profiler(profile_path=str(tmp_path / "a"))
+    doc = json.load(open(str(tmp_path / "a") + ".chrome_trace.json"))
+    ev = [e for e in doc["traceEvents"] if e.get("name") == "late_named"][0]
+    assert ev["tid"] == threading.get_ident()
+
+
+# ---------------------------------------------------------------------------
+# executor self-reporting: counters live without any profiler session
+# ---------------------------------------------------------------------------
+
+def _mlp_prog():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+    return main, startup, y
+
+
+def test_executor_metrics_always_live():
+    reg = default_registry()
+    disp = reg.counter("paddle_executor_dispatch_total", "", ("path",))
+    comp = reg.counter("paddle_executor_compile_total", "")
+    slow0 = disp.labels("slow").value
+    fast0 = disp.labels("fast").value
+    comp0 = comp.value
+    main, startup, y = _mlp_prog()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.zeros((2, 4), np.float32)}
+    for _ in range(4):
+        exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+    assert comp.value >= comp0 + 2          # startup + main compiles
+    assert disp.labels("slow").value > slow0
+    assert disp.labels("fast").value >= fast0 + 2  # steady-state hits
+    h = reg.get("paddle_executor_run_ms")
+    assert h is not None and h._unlabeled().count >= 4
+
+
+def test_prefetch_reports_queue_depth():
+    from paddle_tpu.reader import prefetch_to_device
+
+    reg = default_registry()
+    batches = [{"x": np.ones((2, 2), np.float32)} for _ in range(3)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 3
+    c = reg.get("paddle_prefetch_batches_total")
+    assert c is not None and c.value >= 3
+    assert reg.get("paddle_prefetch_queue_depth") is not None
+
+
+def test_fused_optimizer_reports_groups():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        y = fluid.layers.fc(h, 2)
+        loss = fluid.layers.reduce_mean(y)
+        fluid.optimizer.SGD(0.1, fuse=True).minimize(loss)
+    g = default_registry().get("paddle_fused_optimizer_groups")
+    assert g is not None
+    assert g.labels("sgd").value >= 1
+    p = default_registry().get("paddle_fused_optimizer_params")
+    assert p.labels("sgd").value >= 4  # 2 fc layers: w + b each
+
+
+def test_monitored_train_from_dataset_jsonl(tmp_path):
+    """The acceptance-criteria path: monitored train_from_dataset emits the
+    full per-step record schema (exercised end-to-end again by
+    tools/metrics_check.py)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import metrics_check
+
+    out = metrics_check.run_check(str(tmp_path))
+    assert out["steps"] >= 5
+    rec = out["last_record"]
+    for key in metrics_check.REQUIRED_KEYS:
+        assert key in rec
+
+
+# ---------------------------------------------------------------------------
+# Timeline multi-trainer merge keeps host/device pids distinct
+# ---------------------------------------------------------------------------
+
+def test_timeline_preserves_multi_pid_files(tmp_path):
+    from paddle_tpu.utils.timeline import Timeline
+
+    # a merged host+device trace: two pids in ONE file
+    merged_doc = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 42,
+         "args": {"name": "host (pid 42)"}},
+        {"name": "process_name", "ph": "M", "pid": 8388608,
+         "args": {"name": "device /device:TPU:0"}},
+        {"name": "executor_run", "ph": "X", "ts": 1, "dur": 2, "pid": 42,
+         "tid": 7},
+        {"name": "fusion.1", "ph": "X", "ts": 1, "dur": 2, "pid": 8388608,
+         "tid": 0},
+    ]}
+    p0 = tmp_path / "t0.json"
+    p0.write_text(json.dumps(merged_doc))
+    p1 = tmp_path / "t1.json"
+    p1.write_text(json.dumps({"traceEvents": [
+        {"name": "step", "ph": "X", "ts": 1, "dur": 2, "pid": 99,
+         "tid": 0}]}))
+    out = str(tmp_path / "merged.json")
+    Timeline([("trainer0", str(p0)), ("trainer1", str(p1))]) \
+        .generate_chrome_trace(out)
+    doc = json.load(open(out))
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    # trainer0's host and device events keep DISTINCT pids; trainer1 gets
+    # its own third pid
+    assert len({e["pid"] for e in evs}) == 3
+    names = {m["args"]["name"] for m in doc["traceEvents"]
+             if m["ph"] == "M" and m["name"] == "process_name"}
+    assert "trainer0/host (pid 42)" in names
+    assert "trainer0/device /device:TPU:0" in names
+    assert "trainer1" in names
+    # real tids survive the merge
+    host_ev = [e for e in evs if e["name"] == "executor_run"][0]
+    assert host_ev["tid"] == 7

@@ -92,6 +92,18 @@ def main():
     assert exe._fast_hits > 0, "fast path never engaged"
     fast_s = time_steps(exe, main_prog, feed, loss, steps)
 
+    # telemetry A/B (ISSUE 3 acceptance: metrics enabled, trace off, must
+    # stay within 5% of the plain fast path): same steady-state loop with
+    # the registry kill switch thrown
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    obs_metrics.set_metrics_enabled(False)
+    try:
+        nometrics_s = time_steps(exe, main_prog, feed, loss, steps)
+    finally:
+        obs_metrics.set_metrics_enabled(True)
+    metrics_overhead_pct = (fast_s - nometrics_s) / nometrics_s * 100.0
+
     # floor: the raw jitted call with prebuilt args (what no framework
     # dispatch layer could beat)
     rec = exe._dispatch_records[(id(main_prog), (loss.name,))]
@@ -139,6 +151,9 @@ def main():
     print(f"speedup: total {ratio_total:.1f}x | "
           f"dispatch overhead {ratio_overhead:.1f}x "
           f"(target >= 5x)")
+    print(f"metrics registry overhead: {metrics_overhead_pct:+.2f}% "
+          f"(fast path {fast_s * 1e6:.1f} us with vs "
+          f"{nometrics_s * 1e6:.1f} us without; target < 5%)")
 
     out = {
         "metric": "executor_dispatch_overhead_us_per_step",
@@ -152,6 +167,8 @@ def main():
         "fast_overhead_us": round(fast_overhead * 1e6, 2),
         "speedup_total": round(ratio_total, 2),
         "speedup_overhead": round(ratio_overhead, 2),
+        "fast_nometrics_us_per_step": round(nometrics_s * 1e6, 2),
+        "metrics_overhead_pct": round(metrics_overhead_pct, 2),
     }
     if json_path:
         with open(json_path, "w") as f:

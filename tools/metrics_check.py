@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""End-to-end telemetry gate: a 5-step CPU MLP train with monitoring on.
+
+Runs ``Executor.train_from_dataset`` with a ``TrainMonitor`` attached,
+then asserts:
+  * the per-step JSONL contains every required key
+    ({step, step_time_ms, host_dispatch_ms, device_wait_ms, examples_per_s,
+      mfu, loss, nan_inf}) with finite values;
+  * the metrics registry caught the dispatch/compile counters;
+  * the Prometheus textfile parses line-by-line against the exposition
+    grammar (the same regex validator tests/test_observability.py uses).
+
+Wired into tier-1 as tests/test_metrics_check.py (``-m 'not slow'``), so
+the telemetry path is exercised end-to-end on every run. Standalone:
+
+  JAX_PLATFORMS=cpu python tools/metrics_check.py [--out DIR]
+"""
+import json
+import math
+import os
+import re
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REQUIRED_KEYS = ("step", "step_time_ms", "host_dispatch_ms",
+                 "device_wait_ms", "examples_per_s", "mfu", "loss",
+                 "nan_inf")
+
+# Prometheus text exposition grammar, line by line (comment | sample).
+PROM_LINE_RX = re.compile(
+    r"^(?:"
+    r"# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\n]*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\n]*\")*\})?"
+    r" (?:[+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN))"
+    r"(?: [0-9]+)?"
+    r")$")
+
+
+def validate_prom_text(text: str) -> int:
+    """Raise on the first malformed line; returns the sample count."""
+    samples = 0
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if not PROM_LINE_RX.match(line):
+            raise AssertionError(f"prom line {i} malformed: {line!r}")
+        if not line.startswith("#"):
+            samples += 1
+    if samples == 0:
+        raise AssertionError("prom exposition contains no samples")
+    return samples
+
+
+def _write_mlp_files(tmpdir, rows=96, din=8, classes=4):
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    path = os.path.join(tmpdir, "part-0")
+    with open(path, "w") as f:
+        for _ in range(rows):
+            x = rng.randn(din).astype(np.float32)
+            y = int(rng.randint(0, classes))
+            xs = " ".join(f"{v:.6f}" for v in x)
+            f.write(f"{din} {xs} 1 {y}\n")
+    return [path]
+
+
+def run_check(out_dir: str) -> dict:
+    import numpy as np  # noqa: F401
+
+    import paddle_tpu as fluid
+    from paddle_tpu.dataset import DatasetFactory
+    from paddle_tpu.observability import (TrainMonitor, default_registry, hw,
+                                          prom)
+
+    din, classes, batch = 8, 4, 16
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", [din], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        logits = fluid.layers.fc(h, classes)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    dataset = DatasetFactory().create_dataset("InMemoryDataset")
+    dataset.set_use_var([x, y])
+    dataset.set_batch_size(batch)
+    dataset.set_filelist(_write_mlp_files(out_dir))
+    dataset.load_into_memory()
+
+    jsonl_path = os.path.join(out_dir, "train_monitor.jsonl")
+    mon = TrainMonitor(
+        path=jsonl_path, examples_per_step=batch,
+        flops_per_step=hw.program_train_flops(prog, batch=batch),
+        peak_flops=hw.peak_bf16_flops())
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup)
+    exe.train_from_dataset(prog, dataset, fetch_list=[loss], monitor=mon)
+    mon.close()
+
+    # --- JSONL: >= 5 steps, required keys, finite values ---------------
+    records = [json.loads(ln) for ln in open(jsonl_path)]
+    assert len(records) >= 5, f"expected >=5 monitored steps, got " \
+                              f"{len(records)}"
+    for rec in records:
+        for key in REQUIRED_KEYS:
+            assert key in rec, f"record missing {key!r}: {rec}"
+            v = rec[key]
+            if isinstance(v, bool):
+                continue
+            assert isinstance(v, (int, float)) and math.isfinite(v), \
+                f"{key}={v!r} not finite in {rec}"
+        assert rec["nan_inf"] is False, f"NaN/Inf flagged: {rec}"
+        assert rec["step_time_ms"] >= rec["host_dispatch_ms"] >= 0, rec
+        assert rec["mfu"] >= 0, rec
+
+    # --- registry: the executor self-reported --------------------------
+    snap = default_registry().snapshot()
+    dispatched = sum(s["value"] for s in
+                     snap["paddle_executor_dispatch_total"]["series"])
+    assert dispatched >= len(records), snap.keys()
+    assert snap["paddle_executor_compile_total"]["series"][0]["value"] >= 1
+    assert "paddle_train_steps_total" in snap
+    assert "paddle_prefetch_queue_depth" in snap
+
+    # --- Prometheus exposition -----------------------------------------
+    prom_path = os.path.join(out_dir, "metrics.prom")
+    prom.write_textfile(prom_path)
+    samples = validate_prom_text(open(prom_path).read())
+
+    return {"steps": len(records), "prom_samples": samples,
+            "jsonl": jsonl_path, "prom": prom_path,
+            "last_record": records[-1]}
+
+
+def main():
+    out_dir = None
+    if "--out" in sys.argv:
+        out_dir = sys.argv[sys.argv.index("--out") + 1]
+        os.makedirs(out_dir, exist_ok=True)
+    else:
+        out_dir = tempfile.mkdtemp(prefix="metrics_check_")
+    result = run_check(out_dir)
+    print(json.dumps(result, indent=1))
+    print("[metrics_check] OK")
+    return result
+
+
+if __name__ == "__main__":
+    main()
