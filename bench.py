@@ -397,6 +397,12 @@ def worker(use_flash: bool):
 
     monitor_path = next((a.split("=", 1)[1] for a in sys.argv
                          if a.startswith("--monitor=")), None)
+    # --dump-on-anomaly=DIR: a NaN/Inf loss or a grad-norm blowup during a
+    # monitored run writes a self-contained forensics directory (monitor
+    # tail, fetch summaries, active program reports, flag state); implies
+    # per-step monitoring even without --monitor
+    dump_dir = next((a.split("=", 1)[1] for a in sys.argv
+                     if a.startswith("--dump-on-anomaly=")), None)
 
     def measure(tag, cfg, batch, T, steps):
         """Compile + run one config; returns (tokens/s, mfu, loss, params).
@@ -434,7 +440,7 @@ def worker(use_flash: bool):
         n_params = G.num_params(params)
         flops_tok = G.train_flops_per_token(cfg, n_params, T)
         mon = None
-        if monitor_path:
+        if monitor_path or dump_dir:
             from paddle_tpu.observability import TrainMonitor
 
             mon = TrainMonitor(
@@ -442,7 +448,8 @@ def worker(use_flash: bool):
                 tokens_per_step=batch * T,
                 flops_per_step=flops_tok * batch * T,
                 peak_flops=_peak_flops(dev),
-                extra_static={"config": tag})
+                extra_static={"config": tag},
+                dump_on_anomaly=dump_dir)
         t0 = time.perf_counter()
         if mon is not None:
             for i in range(steps):

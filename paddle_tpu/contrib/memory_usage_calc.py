@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["memory_usage"]
+__all__ = ["memory_usage", "reconcile"]
 
 _DTYPE_BYTES = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
                 "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
@@ -27,3 +27,13 @@ def memory_usage(program, batch_size: int = 1):
             total += n * _DTYPE_BYTES.get(str(var.dtype), 4)
     lower = total / (1 << 20)
     return lower, lower * 3.0
+
+
+def reconcile(program, batch_size: int = 1):
+    """Static estimate vs the device's MEASURED live bytes
+    (observability/program_report.py live-HBM sampler): returns a dict
+    carrying both plus their ratio, so the planning number can be sanity
+    checked against what the allocator actually holds."""
+    from ..observability.program_report import reconcile_memory_usage
+
+    return reconcile_memory_usage(program, batch_size=batch_size)

@@ -183,6 +183,11 @@ _GLOBAL_FLAGS = {
     # repeated processes compiling the same program hit the on-disk cache
     # instead of paying the cold XLA compile (jax_compilation_cache_dir).
     "FLAGS_compile_cache_dir": _os.environ.get("FLAGS_compile_cache_dir", ""),
+    # program-report JSONL sink ('' = disabled): every compiled executable
+    # writes one cost/memory introspection record under this directory
+    # (observability/program_report.py; see docs/observability.md)
+    "FLAGS_program_report_dir": _os.environ.get(
+        "FLAGS_program_report_dir", ""),
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_allocator_strategy": "xla_managed",
     "FLAGS_paddle_num_threads": 1,
@@ -213,6 +218,11 @@ def get_flags(flags):
 
 def get_flag(name, default=None):
     return _GLOBAL_FLAGS.get(name, default)
+
+
+def flags_snapshot() -> dict:
+    """Copy of the full flag state (anomaly forensics dumps record it)."""
+    return dict(_GLOBAL_FLAGS)
 
 
 # ---------------------------------------------------------------------------

@@ -233,13 +233,28 @@ class _CompiledBlock:
 
     def __init__(self, program: Program, feed_sig, fetch_names, param_names,
                  written_names, mesh_plan=None, donate: bool = True,
-                 scope: Optional["Scope"] = None):
+                 scope: Optional["Scope"] = None, report_name: str = ""):
         self.program = program
         self.feed_names = [n for n, _, _ in feed_sig]
         self.fetch_names = list(fetch_names)
         self.param_names = list(param_names)
         self.written_names = list(written_names)
         self.mesh_plan = mesh_plan
+        self.report_name = report_name or (
+            f"{fetch_names[0] if fetch_names else 'main'}"
+            f"#{len(program.global_block().ops)}ops")
+        # AOT compile state: the first call lowers + compiles explicitly and
+        # keeps BOTH handles, so the executable that runs every step is the
+        # same object that serves .as_text() for the profiler and
+        # cost/memory analysis for the program report — no re-compile for
+        # introspection (the old _hlo_text_getter paid a fresh
+        # lower().compile() per block just for HLO text).
+        self._executable = None
+        self._aot_failed = False
+        self.compile_ms: Optional[float] = None
+        self.cache_verdict: Optional[str] = None
+        self.report: Optional[Dict[str, Any]] = None
+        self._in_summary = None
         mesh_axes = (mesh_plan.ring_axes if mesh_plan else {})
         block = program.global_block()
         written = set(written_names)
@@ -371,14 +386,67 @@ class _CompiledBlock:
         jitted = self._jitted
 
         def getter():
-            # NOTE: lower().compile() is a fresh AOT compile of the same
-            # module (jax exposes no handle on the cached executable's
-            # text); it runs once per block, lazily inside stop_profiler.
-            # XLA's compilation cache usually makes it cheap; profiler.py
-            # tolerates a per-getter failure without losing the rest.
+            # the steady-state executable IS the AOT-compiled object, so
+            # HLO text is a free read off it; the fresh lower().compile()
+            # survives only as the fallback for blocks where AOT dispatch
+            # was unavailable (self._aot_failed).
+            if self._executable is not None:
+                return self._executable.as_text()
             return jitted.lower(*avals).compile().as_text()
 
         return getter
+
+    # -- explicit AOT compile: one compile serves dispatch + introspection --
+    def _aot_compile(self, mutable, const, feeds, rng_key) -> None:
+        """Lower + compile the block explicitly and keep the executable.
+        On any failure the block permanently falls back to implicit jit
+        dispatch (AOT is an optimization + introspection surface, never a
+        correctness requirement)."""
+        watch = bool(get_flag("FLAGS_compile_cache_dir"))
+        if watch:
+            h0, m0 = compile_cache_counters()
+        t0 = time.perf_counter_ns()
+        try:
+            lowered = self._jitted.lower(mutable, const, feeds, rng_key)
+            executable = lowered.compile()
+        except Exception as e:
+            self._aot_failed = True
+            logger.info("AOT compile unavailable for %s (%s: %s); "
+                        "falling back to implicit jit dispatch",
+                        self.report_name, type(e).__name__, e)
+            return
+        self.compile_ms = (time.perf_counter_ns() - t0) / 1e6
+        if watch:
+            h1, m1 = compile_cache_counters()
+            self.cache_verdict = ("hit" if h1 > h0
+                                  else "cold" if m1 > m0 else None)
+        self._executable = executable
+        # input avals summarized BEFORE the first call: donation will
+        # invalidate the mutable buffers
+        from ..observability import program_report as _prep
+
+        self._in_summary = _prep._aval_rows((mutable, const, feeds))
+
+    def _publish_report(self, fetches, new_state) -> None:
+        """Emit the per-executable program report (once, after the first
+        successful call so output avals are real)."""
+        from ..observability import program_report as _prep
+
+        self.report = _prep.capture(
+            self.report_name,
+            compiled=self._executable,
+            compile_ms=self.compile_ms,
+            cache=self.cache_verdict,
+            donated=list(self._mutable_names),
+            inputs=self._in_summary,
+            outputs=(fetches, new_state),
+            extra={
+                "mode": self.mesh_plan.mode if self.mesh_plan else "single",
+                "nops": len(self.program.global_block().ops),
+                "feeds": list(self.feed_names),
+                "fetches": list(self.fetch_names),
+            })
+        self._in_summary = None
 
     def __call__(self, scope: Scope, feed: Dict[str, Any], rng_key):
         feeds = {n: feed[n] for n in self.feed_names}
@@ -416,7 +484,29 @@ class _CompiledBlock:
                 prof.register_compiled(
                     key, self._hlo_text_getter(mutable, const, feeds,
                                                rng_key))
-        fetches, new_state = self._jitted(mutable, const, feeds, rng_key)
+        first_aot = False
+        if self._executable is None and not self._aot_failed:
+            self._aot_compile(mutable, const, feeds, rng_key)
+            first_aot = self._executable is not None
+        if self._executable is not None:
+            try:
+                fetches, new_state = self._executable(mutable, const, feeds,
+                                                      rng_key)
+            except TypeError as e:
+                # signature drift the AOT call can't absorb (raised during
+                # argument processing, before execution — no buffer was
+                # donated yet); fall back to implicit jit for good
+                logger.info("AOT dispatch mismatch for %s (%s); reverting "
+                            "to jit dispatch", self.report_name, e)
+                self._executable = None
+                self._aot_failed = True
+                first_aot = False
+                fetches, new_state = self._jitted(mutable, const, feeds,
+                                                  rng_key)
+        else:
+            fetches, new_state = self._jitted(mutable, const, feeds, rng_key)
+        if first_aot:
+            self._publish_report(fetches, new_state)
         for n, v in new_state.items():
             scope.set_var(n, v)
         for i in self._fetch_copy_idx:
@@ -525,12 +615,16 @@ class Executor:
         self._cache: Dict[Tuple, _CompiledBlock] = {}
         self._view_cache: Dict[Tuple, Program] = {}
         self._dispatch_records: Dict[Tuple, _DispatchRecord] = {}
+        # per-program compile-signature history: the recompile explainer
+        # diffs a fresh build against these siblings to name the cause
+        self._compile_history: Dict[int, List[dict]] = {}
         self._fast_hits = 0
         self._step = 0
 
     def close(self):
         self._cache.clear()
         self._dispatch_records.clear()
+        self._compile_history.clear()
 
     # ------------------------------------------------------------------
     def run(
@@ -610,6 +704,12 @@ class Executor:
             param_names, written = _analyze_persistables(program)
             ensure_compile_cache()
             _m_compile.inc()
+            report_name = str(
+                program._annotations.get("report_name")
+                or f"{fetch_names[0] if fetch_names else 'main'}"
+                   f"#{len(block.ops)}ops")
+            self._explain_rebuild(program, report_name, feed_sig,
+                                  fetch_names, mesh_plan)
             with _m_compile_ms.time(), \
                     prof.RecordEvent(f"compile/{len(block.ops)}ops"):
                 if "pipeline" in program._annotations:
@@ -628,6 +728,7 @@ class Executor:
                     exe = _CompiledBlock(
                         program, feed_sig, fetch_names, param_names, written,
                         mesh_plan=mesh_plan, scope=scope,
+                        report_name=report_name,
                     )
             self._cache[key] = exe
             logger.info(
@@ -684,6 +785,36 @@ class Executor:
             _m_device_wait_ms.observe((time.perf_counter_ns() - t_wait0) / 1e6)
             return out
         return fetches
+
+    # ------------------------------------------------------------------
+    # flags whose value changes the lowered computation: a rebuild whose
+    # feed/fetch signature is unchanged but whose flags differ is blamed
+    # on them by the recompile explainer
+    _COMPILE_FLAGS = ("FLAGS_check_nan_inf", "FLAGS_check_nan_inf_level",
+                      "FLAGS_fuse_optimizer", "FLAGS_roi_align_exact",
+                      "FLAGS_roi_align_exact_scale")
+
+    def _explain_rebuild(self, program, report_name, feed_sig, fetch_names,
+                         mesh_plan) -> None:
+        """Recompile explainer: when this program already compiled under a
+        different (feed-sig, fetch, flags) signature, diff against the
+        sibling history, count paddle_recompiles_total{cause=} and emit a
+        rate-limited human-readable cause line."""
+        from ..observability import program_report as _prep
+
+        sig = _prep.make_sig(
+            feed_sig, fetch_names,
+            flags={k: get_flag(k) for k in self._COMPILE_FLAGS},
+            version=program._version_token(),
+            mesh=mesh_plan.signature() if mesh_plan else None)
+        if len(self._compile_history) > 256:
+            self._compile_history.clear()
+        hist = self._compile_history.setdefault(id(program), [])
+        if hist:
+            cause, detail = _prep.explain_recompile(sig, hist)
+            _prep.note_recompile(report_name, cause, detail)
+        hist.append(sig)
+        del hist[:-32]  # bound sibling history per program
 
     # ------------------------------------------------------------------
     def _try_fast_run(self, rec: _DispatchRecord, feed, scope, return_numpy):
@@ -949,8 +1080,12 @@ class Executor:
                                           return_numpy=False)
                     s.dispatched()
                     if fetch_list:
-                        # materializing the first fetch IS the device wait
-                        s.observe(loss=last_fetch[0])
+                        # materializing the first fetch IS the device wait;
+                        # the full fetch list rides along (by reference, no
+                        # sync) so an anomaly dump can summarize the
+                        # offending step's values
+                        s.observe(loss=last_fetch[0], fetches=last_fetch,
+                                  fetch_names=list(fetch_info))
             else:
                 last_fetch = self.run(program=program, feed=feed,
                                       fetch_list=fetch_list, scope=scope,

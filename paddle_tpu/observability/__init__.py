@@ -23,6 +23,13 @@ split (host RecordEvent + device tracer + train monitor callbacks):
 - :mod:`.hw` — hardware denominators shared by bench.py and the monitor:
   bf16 peak FLOP/s per device kind and analytic train FLOPs of a fluid
   program.
+- :mod:`.program_report` — compile- & memory-side introspection (ISSUE 4):
+  per-executable cost/memory program reports (JSONL +
+  ``paddle_program_*`` gauges), the recompile explainer
+  (``paddle_recompiles_total{cause=}``), live HBM accounting
+  (``live_buffer_bytes``/``peak_hbm_bytes``), and the static-vs-measured
+  memory reconciliation. The TrainMonitor's ``dump_on_anomaly`` forensics
+  dumps reference its report ring.
 
 See docs/observability.md.
 """
@@ -39,11 +46,13 @@ from .metrics import (  # noqa: F401
 )
 from .monitor import MonitorWriter, TrainMonitor  # noqa: F401
 from . import hw  # noqa: F401
+from . import program_report  # noqa: F401
 from . import prom  # noqa: F401
 from . import trace_merge  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "metrics_enabled", "set_metrics_enabled",
-    "MonitorWriter", "TrainMonitor", "hw", "prom", "trace_merge",
+    "MonitorWriter", "TrainMonitor", "hw", "program_report", "prom",
+    "trace_merge",
 ]

@@ -29,6 +29,13 @@ Usage pattern (and what train_from_dataset does internally)::
 MFU uses the bf16-peak denominator from :mod:`.hw` (the same table as
 bench.py); NaN/Inf detection reuses the scan semantics of
 utils/nan_inf.py (ml_dtypes float-likes included).
+
+ISSUE 4 additions: every record also carries ``live_buffer_bytes`` /
+``peak_hbm_bytes`` from the :mod:`.program_report` HBM sampler
+(``sample_hbm=False`` opts out), and ``dump_on_anomaly=DIR`` writes a
+self-contained forensics directory (monitor tail, per-fetch summaries,
+active program reports, flag state) when a step's loss goes NaN/Inf or
+its grad norm blows past ``anomaly_grad_mult`` x the rolling p50.
 """
 from __future__ import annotations
 
@@ -104,13 +111,16 @@ class MonitorWriter:
 class _StepHandle:
     """Context for one step: times the dispatch / wait / total phases."""
 
-    __slots__ = ("mon", "t0", "t_dispatch", "t_wait", "fields")
+    __slots__ = ("mon", "t0", "t_dispatch", "t_wait", "fields",
+                 "fetch_refs", "fetch_names")
 
     def __init__(self, mon: "TrainMonitor"):
         self.mon = mon
         self.t_dispatch = None
         self.t_wait = 0.0
         self.fields: Dict[str, Any] = {}
+        self.fetch_refs = None
+        self.fetch_names = None
 
     def __enter__(self):
         self.t0 = time.perf_counter_ns()
@@ -122,10 +132,16 @@ class _StepHandle:
         if self.t_dispatch is None:
             self.t_dispatch = time.perf_counter_ns()
 
-    def observe(self, loss=None, grad_norm=None, **extra) -> None:
+    def observe(self, loss=None, grad_norm=None, fetches=None,
+                fetch_names=None, **extra) -> None:
         """Record the step's fetched values. Materializing ``loss`` /
         ``grad_norm`` here is the step's sync point — the time it takes IS
-        the device wait, so it is measured."""
+        the device wait, so it is measured. ``fetches``/``fetch_names``
+        are held by reference only (no sync): an anomaly dump summarizes
+        them if this step trips."""
+        if fetches is not None:
+            self.fetch_refs = list(fetches)
+            self.fetch_names = list(fetch_names or [])
         t0 = time.perf_counter_ns()
         if loss is not None:
             arr = np.asarray(loss)
@@ -163,7 +179,12 @@ class TrainMonitor:
                  peak_flops: Optional[float] = None,
                  window: int = 100,
                  registry: Optional[_metrics.MetricsRegistry] = None,
-                 extra_static: Optional[Dict[str, Any]] = None):
+                 extra_static: Optional[Dict[str, Any]] = None,
+                 sample_hbm: bool = True,
+                 dump_on_anomaly: Optional[str] = None,
+                 anomaly_grad_mult: float = 10.0,
+                 dump_last_n: int = 32,
+                 max_dumps: int = 5):
         if writer is None and path is not None:
             writer = MonitorWriter(path)
         self.writer = writer
@@ -175,6 +196,20 @@ class TrainMonitor:
         self.step_count = 0
         self.last_record: Optional[Dict[str, Any]] = None
         self._step_times = collections.deque(maxlen=window)
+        # live/peak HBM stamped into every record (program_report sampler);
+        # sample_hbm=False opts monitored hot loops out of the
+        # live_arrays() walk on backends without allocator counters
+        self.sample_hbm = bool(sample_hbm)
+        # anomaly forensics: NaN/Inf loss, or grad_norm blowing past
+        # anomaly_grad_mult x the rolling p50, writes a self-contained
+        # dump directory under dump_on_anomaly (None = disabled)
+        self.dump_on_anomaly = dump_on_anomaly
+        self.anomaly_grad_mult = float(anomaly_grad_mult)
+        self.max_dumps = int(max_dumps)
+        self.dumps_written = 0
+        self.dump_paths: list = []
+        self._recent_records = collections.deque(maxlen=int(dump_last_n))
+        self._grad_norms = collections.deque(maxlen=window)
         reg = registry or _metrics.default_registry()
         self._m_steps = reg.counter(
             "paddle_train_steps_total", "Monitored train steps")
@@ -188,6 +223,8 @@ class TrainMonitor:
             "paddle_train_loss", "Last observed loss")
         self._m_mfu = reg.gauge(
             "paddle_train_mfu", "Last step model-FLOPs-utilization (bf16 peak)")
+        self._m_dumps = reg.counter(
+            "paddle_anomaly_dumps_total", "Anomaly forensics dumps written")
 
     def peak_flops(self) -> float:
         if self._peak_flops is None:
@@ -244,7 +281,26 @@ class TrainMonitor:
                 rec[k] = v
         for q in (50, 90, 99):
             rec[f"p{q}_step_time_ms"] = round(self._percentile(q), 4)
+        if self.sample_hbm:
+            # live/peak device memory per step (allocator counters on TPU,
+            # live_arrays() fallback elsewhere — program_report sampler)
+            from . import program_report as _prep
+
+            live, peak = _prep.sample_hbm_gauges()
+            if live is not None:
+                rec["live_buffer_bytes"] = int(live)
+            if peak is not None:
+                rec["peak_hbm_bytes"] = int(peak)
+        reason = self._anomaly_reason(rec)
+        if reason:
+            rec["anomaly"] = reason
+            if (self.dump_on_anomaly
+                    and self.dumps_written < self.max_dumps):
+                path = self._dump_anomaly(rec, h, reason)
+                if path:
+                    rec["anomaly_dump"] = path
         self.last_record = rec
+        self._recent_records.append(rec)
         if self.writer is not None:
             self.writer.write(rec)
         # registry mirror: scrape-able without reading the JSONL
@@ -258,6 +314,92 @@ class TrainMonitor:
             self._m_loss.set(rec["loss"])
         if rec.get("mfu") is not None:
             self._m_mfu.set(rec["mfu"])
+        # grad-norm window grows AFTER the anomaly check: the rolling p50
+        # an outlier is judged against never includes the outlier itself
+        gn = rec.get("grad_norm")
+        if gn is not None and np.isfinite(gn):
+            self._grad_norms.append(float(gn))
+
+    # -- anomaly forensics ------------------------------------------------
+    def _anomaly_reason(self, rec: Dict[str, Any]) -> Optional[str]:
+        """nan_inf trip, or grad_norm > anomaly_grad_mult x rolling p50
+        (needs >= 5 prior healthy norms before it can judge)."""
+        if rec.get("nan_inf"):
+            return "nan_inf"
+        gn = rec.get("grad_norm")
+        if gn is None:
+            return None
+        if not np.isfinite(gn):
+            return "grad_norm"
+        if len(self._grad_norms) >= 5:
+            vals = sorted(self._grad_norms)
+            p50 = vals[len(vals) // 2]
+            if p50 > 0 and gn > self.anomaly_grad_mult * p50:
+                return "grad_norm"
+        return None
+
+    def _dump_anomaly(self, rec: Dict[str, Any], h: _StepHandle,
+                      reason: str) -> Optional[str]:
+        """Write a self-contained forensics directory:
+
+            <dump_on_anomaly>/step<NNNNNN>_<reason>/
+              dump_info.json        what tripped, when, against what p50
+              monitor_tail.jsonl    last-N step records + the offender
+              fetch_summaries.json  shape/dtype/finite-count/min/max per
+                                    fetch (utils/nan_inf.summarize_value)
+              program_reports.json  recent program reports (the
+                                    executables active at the anomaly)
+              flags.json            full framework flag state
+        """
+        import os
+
+        d = os.path.join(str(self.dump_on_anomaly),
+                         f"step{int(rec.get('step', 0)):06d}_{reason}")
+        try:
+            from ..framework.core import flags_snapshot
+            from ..utils.nan_inf import summarize_value
+            from . import program_report as _prep
+
+            os.makedirs(d, exist_ok=True)
+            vals = sorted(self._grad_norms)
+            info = {
+                "reason": reason,
+                "step": rec.get("step"),
+                "ts": time.time(),
+                "loss": rec.get("loss"),
+                "grad_norm": rec.get("grad_norm"),
+                "grad_norm_p50": vals[len(vals) // 2] if vals else None,
+                "anomaly_grad_mult": self.anomaly_grad_mult,
+            }
+            with open(os.path.join(d, "dump_info.json"), "w") as f:
+                json.dump(info, f, indent=1)
+            with open(os.path.join(d, "monitor_tail.jsonl"), "w") as f:
+                for r in list(self._recent_records) + [rec]:
+                    f.write(json.dumps(
+                        {k: v for k, v in r.items()}) + "\n")
+            summaries = []
+            names = h.fetch_names or []
+            for i, v in enumerate(h.fetch_refs or []):
+                name = names[i] if i < len(names) else f"fetch_{i}"
+                summaries.append(summarize_value(name, v))
+            with open(os.path.join(d, "fetch_summaries.json"), "w") as f:
+                json.dump(summaries, f, indent=1)
+            with open(os.path.join(d, "program_reports.json"), "w") as f:
+                json.dump(_prep.recent_reports(), f, indent=1)
+            with open(os.path.join(d, "flags.json"), "w") as f:
+                json.dump({k: repr(v) if not isinstance(
+                    v, (str, int, float, bool, type(None))) else v
+                    for k, v in flags_snapshot().items()}, f, indent=1)
+        except Exception as e:  # forensics must never kill the train loop
+            import logging
+
+            logging.getLogger("paddle_tpu.monitor").warning(
+                "anomaly dump to %s failed: %s", d, e)
+            return None
+        self.dumps_written += 1
+        self.dump_paths.append(d)
+        self._m_dumps.inc()
+        return d
 
     def _percentile(self, q: float) -> float:
         vals = sorted(self._step_times)

@@ -1,0 +1,469 @@
+"""Compile- & memory-side observability: per-executable program reports,
+the recompile explainer, and live HBM accounting (ISSUE 4).
+
+PR 3's telemetry answers *what each step did*; this module opens the
+compile-time black box of the trace-to-XLA core. Three surfaces:
+
+- **Program reports** — every executable the framework compiles
+  (``Executor.run`` slow path, ``parallelize.make_train_step``, and
+  ``ParallelExecutor`` runs, which flow through the executor) captures one
+  record: XLA ``cost_analysis()`` flops / bytes-accessed,
+  ``memory_analysis()`` argument/output/temp/generated-code bytes (with a
+  graceful fallback where a backend exposes neither), input/output avals,
+  the donation map, compile wall-ms and the persistent-cache verdict. The
+  record lands in a bounded in-memory ring (``recent_reports()``), as
+  JSONL under ``FLAGS_program_report_dir``, and as labeled registry
+  gauges (``paddle_program_flops{program=...}`` etc.).
+- **Recompile explainer** — the executor's compile keys already carry
+  (program, feed-sig, fetch); on a rebuild with sibling history for the
+  same program, :func:`explain_recompile` diffs the signatures and names
+  the cause (``feed_shape | feed_dtype | feed_set | fetch_list | flags |
+  program_mutation | mesh | other``). ``paddle_recompiles_total{cause=}``
+  counts every event; the human-readable cause line is rate-limited so a
+  shape-churn workload doesn't spam the log.
+- **Live HBM accounting** — :func:`live_buffer_bytes` reads
+  ``device.memory_stats()`` where the backend provides it (TPU) and falls
+  back to summing ``jax.live_arrays()`` nbytes (CPU), tracking a
+  process-wide peak. The TrainMonitor stamps both numbers into every
+  step record; :func:`reconcile_memory_usage` checks the static estimate
+  of ``contrib/memory_usage_calc.py`` against the measured numbers.
+
+GSPMD (arxiv 2105.04663) and MPK (arxiv 2512.22219) both lean on exactly
+this per-executable cost/memory introspection to make compiled tensor
+programs debuggable; see docs/observability.md for schemas.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("paddle_tpu.program_report")
+
+from . import metrics as _metrics
+
+__all__ = [
+    "build_report", "record_report", "capture", "recent_reports",
+    "explain_recompile", "note_recompile", "live_buffer_bytes",
+    "sample_hbm_gauges", "reconcile_memory_usage", "reset",
+]
+
+_OBS = _metrics.default_registry()
+_m_reports = _OBS.counter(
+    "paddle_program_reports_total", "Program reports captured")
+_m_flops = _OBS.gauge(
+    "paddle_program_flops",
+    "XLA cost-analysis flops of the compiled executable", ("program",))
+_m_bytes = _OBS.gauge(
+    "paddle_program_bytes_accessed",
+    "XLA cost-analysis bytes accessed of the compiled executable",
+    ("program",))
+_m_peak = _OBS.gauge(
+    "paddle_program_peak_hbm_bytes",
+    "XLA memory-analysis peak bytes (args+outputs+temps+code-aliased)",
+    ("program",))
+_m_compile_ms = _OBS.gauge(
+    "paddle_program_compile_ms",
+    "Wall-clock ms of the executable's XLA compile", ("program",))
+_m_recompiles = _OBS.counter(
+    "paddle_recompiles_total",
+    "Program recompiles by explained cause", ("cause",))
+_m_live = _OBS.gauge(
+    "paddle_live_buffer_bytes",
+    "Live device buffer bytes (memory_stats or live_arrays fallback)")
+_m_peak_hbm = _OBS.gauge(
+    "paddle_peak_hbm_bytes",
+    "Peak device buffer bytes observed (device counter or process max)")
+
+# bounded ring of recent reports: the anomaly-forensics dump references
+# the executables active when a step went bad
+_RECENT_MAX = 64
+_recent: "collections.deque[Dict[str, Any]]" = collections.deque(
+    maxlen=_RECENT_MAX)
+_seq_lock = threading.Lock()
+_seq = [0]
+_jsonl_state: Dict[str, Any] = {"dir": None, "file": None}
+
+
+def reset() -> None:
+    """Drop module state (tests): the report ring, the JSONL sink binding,
+    the recompile log limiter and the fallback HBM peak."""
+    _recent.clear()
+    _seq[0] = 0
+    f = _jsonl_state.get("file")
+    if f is not None:
+        try:
+            f.close()
+        except OSError:
+            pass
+    _jsonl_state.update(dir=None, file=None)
+    _log_counts.clear()
+    _hbm_state["fallback_peak"] = 0
+
+
+# ---------------------------------------------------------------------------
+# Program reports
+# ---------------------------------------------------------------------------
+
+def _first_dict(cost) -> Dict[str, Any]:
+    if isinstance(cost, (list, tuple)):
+        return dict(cost[0]) if cost else {}
+    return dict(cost) if cost else {}
+
+
+def cost_summary(compiled) -> Dict[str, Optional[float]]:
+    """flops / bytes-accessed from ``compiled.cost_analysis()``; fields are
+    None when the backend exposes no analysis (never raises)."""
+    try:
+        c = _first_dict(compiled.cost_analysis())
+    except Exception:
+        return {"flops": None, "bytes_accessed": None}
+    flops = c.get("flops")
+    nbytes = c.get("bytes accessed")
+    return {
+        "flops": float(flops) if flops is not None else None,
+        "bytes_accessed": float(nbytes) if nbytes is not None else None,
+    }
+
+
+def memory_summary(compiled) -> Dict[str, Optional[int]]:
+    """argument/output/temp/generated-code/alias bytes from
+    ``compiled.memory_analysis()`` plus a derived ``peak_hbm_bytes``
+    (args + outputs + temps + code - donated aliases). All-None when the
+    backend has no analysis (the graceful CPU fallback — current CPU
+    jaxlibs do report it, older ones return None)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is None:
+        return {k: None for k in (
+            "argument_bytes", "output_bytes", "temp_bytes",
+            "generated_code_bytes", "alias_bytes", "peak_hbm_bytes")}
+    out = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+    }
+    out["peak_hbm_bytes"] = max(
+        0, out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        + out["generated_code_bytes"] - out["alias_bytes"])
+    return out
+
+
+def _aval_rows(tree, limit: int = 24) -> Dict[str, Any]:
+    """Flatten a pytree of avals/arrays into {count, total_bytes,
+    entries[:limit]} — enough to identify an executable's signature without
+    serializing a 1000-leaf param tree."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    entries = []
+    total = 0
+    for leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        size = int(np.prod(shape)) if shape else 1
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+        total += size * int(itemsize or 4)
+        if len(entries) < limit:
+            entries.append({"shape": list(shape), "dtype": dtype})
+    return {"count": len(leaves), "total_bytes": int(total),
+            "entries": entries}
+
+
+def build_report(name: str, compiled=None, lowered=None,
+                 compile_ms: Optional[float] = None,
+                 cache: Optional[str] = None,
+                 donated: Sequence[str] = (),
+                 inputs=None, outputs=None,
+                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Assemble one program-report record. ``inputs``/``outputs`` may be
+    pytrees of avals/arrays (summarized) or pre-built summary dicts."""
+    with _seq_lock:
+        _seq[0] += 1
+        seq = _seq[0]
+    rec: Dict[str, Any] = {
+        "seq": seq,
+        "ts": round(time.time(), 3),
+        "program": str(name),
+        "compile_ms": (round(float(compile_ms), 3)
+                       if compile_ms is not None else None),
+        "cache": cache,
+        "donated": list(donated),
+    }
+    if compiled is not None:
+        rec.update(cost_summary(compiled))
+        rec["memory"] = memory_summary(compiled)
+    else:
+        rec.update({"flops": None, "bytes_accessed": None})
+        rec["memory"] = memory_summary(None)
+    if inputs is None and lowered is not None:
+        inputs = getattr(lowered, "in_avals", None)
+    if inputs is not None:
+        rec["in_avals"] = (inputs if isinstance(inputs, dict)
+                           else _aval_rows(inputs))
+    if outputs is not None:
+        rec["out_avals"] = (outputs if isinstance(outputs, dict)
+                            else _aval_rows(outputs))
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def _jsonl_sink():
+    """Open (once) the per-process JSONL file under
+    FLAGS_program_report_dir; returns None when the flag is unset."""
+    from ..framework.core import get_flag
+
+    d = get_flag("FLAGS_program_report_dir") or ""
+    if not d:
+        return None
+    if _jsonl_state["dir"] != d or _jsonl_state["file"] is None:
+        try:
+            os.makedirs(d, exist_ok=True)
+            f = open(os.path.join(
+                d, f"program_reports.{os.getpid()}.jsonl"), "a")
+        except OSError as e:
+            logger.warning("program report dir %r unusable: %s", d, e)
+            return None
+        old = _jsonl_state.get("file")
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        _jsonl_state.update(dir=d, file=f)
+    return _jsonl_state["file"]
+
+
+def record_report(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Publish a report: ring buffer + JSONL sink + labeled gauges."""
+    _recent.append(rec)
+    _m_reports.inc()
+    label = rec.get("program", "?")
+    if rec.get("flops") is not None:
+        _m_flops.labels(label).set(rec["flops"])
+    if rec.get("bytes_accessed") is not None:
+        _m_bytes.labels(label).set(rec["bytes_accessed"])
+    peak = (rec.get("memory") or {}).get("peak_hbm_bytes")
+    if peak is not None:
+        _m_peak.labels(label).set(peak)
+    if rec.get("compile_ms") is not None:
+        _m_compile_ms.labels(label).set(rec["compile_ms"])
+    f = _jsonl_sink()
+    if f is not None:
+        try:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+        except (OSError, TypeError, ValueError) as e:
+            logger.warning("program report write failed: %s", e)
+    return rec
+
+
+def capture(name: str, compiled=None, lowered=None, **kw) -> Dict[str, Any]:
+    """build_report + record_report; never raises (observability must not
+    take down the compile path it watches)."""
+    try:
+        return record_report(build_report(name, compiled=compiled,
+                                          lowered=lowered, **kw))
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("program report capture failed for %s: %s", name, e)
+        return {}
+
+
+def recent_reports(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    out = list(_recent)
+    return out if n is None else out[-n:]
+
+
+# ---------------------------------------------------------------------------
+# Recompile explainer
+# ---------------------------------------------------------------------------
+
+def make_sig(feed_sig, fetch_names, flags: Optional[Dict[str, Any]] = None,
+             version=None, mesh=None) -> Dict[str, Any]:
+    """Normalize one compile's identity for later diffing."""
+    return {
+        "feed": tuple((str(n), tuple(s), str(d)) for n, s, d in feed_sig),
+        "fetch": tuple(str(n) for n in fetch_names),
+        "flags": tuple(sorted((flags or {}).items())),
+        "version": version,
+        "mesh": mesh,
+    }
+
+
+def _diff_causes(old: Dict[str, Any], new: Dict[str, Any]):
+    """Diff two compile signatures; returns (causes, detail_lines) in
+    specificity order."""
+    causes: List[str] = []
+    details: List[str] = []
+    old_feed = {n: (s, d) for n, s, d in old["feed"]}
+    new_feed = {n: (s, d) for n, s, d in new["feed"]}
+    if set(old_feed) != set(new_feed):
+        causes.append("feed_set")
+        added = sorted(set(new_feed) - set(old_feed))
+        removed = sorted(set(old_feed) - set(new_feed))
+        details.append(f"feed names changed (+{added} -{removed})")
+    else:
+        shape_diffs = [(n, old_feed[n][0], new_feed[n][0])
+                       for n in new_feed if old_feed[n][0] != new_feed[n][0]]
+        dtype_diffs = [(n, old_feed[n][1], new_feed[n][1])
+                       for n in new_feed if old_feed[n][1] != new_feed[n][1]]
+        if shape_diffs:
+            causes.append("feed_shape")
+            details += [f"feed {n!r} shape {o} -> {w}"
+                        for n, o, w in shape_diffs[:4]]
+        if dtype_diffs:
+            causes.append("feed_dtype")
+            details += [f"feed {n!r} dtype {o} -> {w}"
+                        for n, o, w in dtype_diffs[:4]]
+    if old["fetch"] != new["fetch"]:
+        causes.append("fetch_list")
+        details.append(f"fetch list {list(old['fetch'])} -> "
+                       f"{list(new['fetch'])}")
+    if old["flags"] != new["flags"]:
+        changed = [f"{k}={dict(old['flags']).get(k)!r}->{v!r}"
+                   for k, v in new["flags"]
+                   if dict(old["flags"]).get(k) != v]
+        causes.append("flags")
+        details.append("flags changed: " + ", ".join(changed))
+    if old.get("version") != new.get("version"):
+        causes.append("program_mutation")
+        details.append("program was mutated (version token changed)")
+    if old.get("mesh") != new.get("mesh"):
+        causes.append("mesh")
+        details.append(f"mesh plan {old.get('mesh')} -> {new.get('mesh')}")
+    return causes, details
+
+
+def explain_recompile(new_sig: Dict[str, Any],
+                      siblings: Sequence[Dict[str, Any]]):
+    """Pick the *nearest* sibling signature (fewest differing components,
+    most recent sibling winning ties — the likely predecessor) and name
+    the recompile cause. Returns (cause, detail_str); cause is "other"
+    when nothing differs in a way we model."""
+    best: Optional[Tuple[List[str], List[str]]] = None
+    for old in reversed(list(siblings)):
+        causes, details = _diff_causes(old, new_sig)
+        if best is None or len(causes) < len(best[0]):
+            best = (causes, details)
+            if len(causes) == 1:
+                break
+    if best is None or not best[0]:
+        return "other", "no sibling signature difference identified"
+    causes, details = best
+    # primary cause = most specific in the fixed priority order
+    for cause in ("feed_shape", "feed_dtype", "feed_set", "fetch_list",
+                  "flags", "program_mutation", "mesh"):
+        if cause in causes:
+            return cause, "; ".join(details)
+    return causes[0], "; ".join(details)
+
+
+# log rate limit: first N occurrences per (program, cause) logged, then
+# every Kth — the counter keeps exact totals regardless
+_LOG_FIRST = 3
+_LOG_EVERY = 50
+_log_counts: Dict[Tuple[str, str], int] = {}
+
+
+def note_recompile(program_label: str, cause: str, detail: str) -> bool:
+    """Count one explained recompile; emit the human-readable cause line
+    subject to the rate limit. Returns True when the line was logged."""
+    _m_recompiles.labels(cause).inc()
+    key = (str(program_label), cause)
+    n = _log_counts.get(key, 0) + 1
+    _log_counts[key] = n
+    if n <= _LOG_FIRST or n % _LOG_EVERY == 0:
+        suffix = (f" ({n} total, logging 1/{_LOG_EVERY})"
+                  if n > _LOG_FIRST else "")
+        logger.warning("recompile of %s: cause=%s — %s%s",
+                       program_label, cause, detail, suffix)
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Live HBM accounting
+# ---------------------------------------------------------------------------
+
+_hbm_state = {"fallback_peak": 0}
+
+
+def live_buffer_bytes() -> Tuple[Optional[int], Optional[int]]:
+    """(live_bytes, peak_bytes) of device memory.
+
+    TPU path: sum ``device.memory_stats()`` bytes_in_use /
+    peak_bytes_in_use over addressable devices. CPU/backends without the
+    allocator counters: sum ``jax.live_arrays()`` nbytes, with the peak
+    tracked as a process-wide high-water mark. (None, None) if even the
+    fallback fails (jax not initialized)."""
+    try:
+        import jax
+
+        live = peak = 0
+        stats_seen = False
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if stats and "bytes_in_use" in stats:
+                stats_seen = True
+                live += int(stats.get("bytes_in_use", 0))
+                peak += int(stats.get("peak_bytes_in_use",
+                                      stats.get("bytes_in_use", 0)))
+        if not stats_seen:
+            live = sum(int(getattr(x, "nbytes", 0) or 0)
+                       for x in jax.live_arrays())
+            _hbm_state["fallback_peak"] = max(_hbm_state["fallback_peak"],
+                                              live)
+            peak = _hbm_state["fallback_peak"]
+    except Exception:
+        return None, None
+    return live, peak
+
+
+def sample_hbm_gauges() -> Tuple[Optional[int], Optional[int]]:
+    """live_buffer_bytes() + publish both numbers as registry gauges."""
+    live, peak = live_buffer_bytes()
+    if live is not None:
+        _m_live.set(live)
+    if peak is not None:
+        _m_peak_hbm.set(peak)
+    return live, peak
+
+
+def reconcile_memory_usage(program, batch_size: int = 1) -> Dict[str, Any]:
+    """Check contrib.memory_usage_calc's static estimate against the
+    measured live bytes: returns both plus whether the measurement falls
+    inside the static [lower, 3x] band (an order-of-magnitude sanity
+    check, same contract the reference tool documents)."""
+    from ..contrib.memory_usage_calc import memory_usage
+
+    lower_mb, upper_mb = memory_usage(program, batch_size=batch_size)
+    live, peak = live_buffer_bytes()
+    measured_mb = (live / (1 << 20)) if live is not None else None
+    out = {
+        "static_lower_mb": round(lower_mb, 4),
+        "static_upper_mb": round(upper_mb, 4),
+        "measured_live_mb": (round(measured_mb, 4)
+                             if measured_mb is not None else None),
+        "measured_peak_mb": (round(peak / (1 << 20), 4)
+                             if peak is not None else None),
+    }
+    if measured_mb is not None and lower_mb > 0:
+        out["measured_over_static_lower"] = round(measured_mb / lower_mb, 4)
+        # the process holds more than one program's buffers, so "within
+        # band" means the static estimate is not wildly off versus what
+        # the device actually holds — not an exact equality
+        out["within_band"] = bool(lower_mb * 0.01 <= measured_mb)
+    return out

@@ -377,7 +377,50 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                 params, grads, opt_state, lr, weight_decay=weight_decay)
         return params, opt_state, loss, gnorm
 
-    return step
+    # Program-report capture (observability/program_report.py): the first
+    # invocation lowers + compiles explicitly, keeps the executable as the
+    # dispatch target, and records cost/memory analysis, compile wall-ms
+    # and the donation map — the same introspection surface Executor.run's
+    # compiled blocks get. Any AOT failure reverts to implicit jit
+    # dispatch permanently (never a correctness dependency).
+    from ..observability import program_report as _prep
+
+    report_name = (f"parallel_train_step/dp{pcfg.dp}pp{pcfg.pp}tp{pcfg.tp}"
+                   f"mb{pcfg.microbatches}"
+                   + ("_fused" if fused_opt else ""))
+    aot = {"exec": None, "failed": False}
+
+    def step_with_report(params, opt_state, tokens, labels):
+        if aot["exec"] is None and not aot["failed"]:
+            import time as _time
+
+            t0 = _time.perf_counter_ns()
+            try:
+                lowered = step.lower(params, opt_state, tokens, labels)
+                aot["exec"] = lowered.compile()
+            except Exception:
+                aot["failed"] = True
+            else:
+                _prep.capture(
+                    report_name, compiled=aot["exec"],
+                    compile_ms=(_time.perf_counter_ns() - t0) / 1e6,
+                    donated=["params", "opt_state"],
+                    inputs=(params, opt_state, tokens, labels),
+                    extra={"mode": "gspmd+shard_map",
+                           "mesh": {a: int(s) for a, s in
+                                    zip(pcfg.axis_names,
+                                        (pcfg.dp, pcfg.pp, pcfg.tp))}})
+        if aot["exec"] is not None:
+            try:
+                return aot["exec"](params, opt_state, tokens, labels)
+            except TypeError:
+                # arg-signature drift (raised before execution, nothing
+                # donated yet): revert to jit dispatch for good
+                aot["exec"] = None
+                aot["failed"] = True
+        return step(params, opt_state, tokens, labels)
+
+    return step_with_report
 
 
 def make_forward(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh):

@@ -41,6 +41,13 @@ class ParallelExecutor:
         self._compiled = CompiledProgram(self._program).with_data_parallel(
             loss_name=loss_name, build_strategy=build_strategy,
             exec_strategy=exec_strategy)
+        # label this program's compile-time introspection records
+        # (observability/program_report.py) so multi-device runs are
+        # distinguishable from single-device runs of the same block
+        self._program._annotations.setdefault(
+            "report_name",
+            f"pexe/{loss_name or 'main'}"
+            f"#{len(self._program.global_block().ops)}ops")
 
     @property
     def device_count(self) -> int:

@@ -29,6 +29,41 @@ def check_fetches(names, values):
             raise FloatingPointError(f"Inf detected in fetch var {name!r}")
 
 
+def summarize_value(name, value):
+    """Forensics summary of one fetched value: shape/dtype/element counts
+    plus finite/nan/inf tallies and min/max/mean over the finite elements
+    (anomaly dumps — observability/monitor.py). Never raises; a value that
+    cannot even be converted reports its error instead."""
+    try:
+        arr = np.asarray(value)
+    except Exception as e:
+        return {"name": str(name), "error": f"{type(e).__name__}: {e}"}
+    out = {"name": str(name), "shape": list(arr.shape),
+           "dtype": str(arr.dtype), "size": int(arr.size)}
+    if arr.size == 0:
+        return out
+    farr = arr
+    if arr.dtype.kind != "f":
+        if "float" in str(arr.dtype):  # ml_dtypes kinds report 'V'
+            farr = arr.astype(np.float32)
+        else:
+            if arr.dtype.kind in "iub":
+                out.update(min=int(arr.min()), max=int(arr.max()))
+            return out
+    finite = np.isfinite(farr)
+    n_finite = int(finite.sum())
+    out.update(
+        finite_count=n_finite,
+        nan_count=int(np.isnan(farr).sum()),
+        inf_count=int(np.isinf(farr).sum()),
+    )
+    if n_finite:
+        fin = farr[finite].astype(np.float64)
+        out.update(min=float(fin.min()), max=float(fin.max()),
+                   mean=float(fin.mean()))
+    return out
+
+
 def check_op_outputs(op, env):
     """Scan one op's outputs in an eager (op-level) run; raises with the
     op and var responsible (nan_inf_utils_detail.cc per-op behavior)."""

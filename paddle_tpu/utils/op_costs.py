@@ -18,7 +18,79 @@ import numpy as np
 from ..framework.executor import is_host_op_type
 from ..framework.registry import LowerCtx, get_op_spec
 
-__all__ = ["program_cost_table", "print_cost_table", "merge_into_trace"]
+__all__ = ["program_cost_table", "print_cost_table", "merge_into_trace",
+           "analytic_flops", "attention_flops", "ANALYTIC_FLOPS"]
+
+
+# ---------------------------------------------------------------------------
+# Hand-maintained analytic FLOPs table — the paper-napkin formulas per op
+# type, cross-checked against XLA's cost_analysis() in
+# tests/test_op_costs.py (entries that disagree with XLA by >2x on
+# matmul/attention shapes are treated as table bugs and fixed here).
+# XLA counts a MAC as 2 FLOPs (multiply + add), so a matmul is 2*M*N*K.
+# ---------------------------------------------------------------------------
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _matmul_flops(x_shape, y_shape, transpose_x=False, transpose_y=False):
+    """2*M*N*K over the (possibly batched) contraction; leading batch dims
+    follow fluid.layers.matmul broadcasting (batch from the higher-rank
+    operand)."""
+    xs, ys = list(x_shape), list(y_shape)
+    if transpose_x:
+        xs[-2:] = xs[-1:] + xs[-2:-1]
+    if transpose_y:
+        ys[-2:] = ys[-1:] + ys[-2:-1]
+    m = xs[-2] if len(xs) >= 2 else 1
+    k = xs[-1]
+    n = ys[-1] if len(ys) >= 2 else 1
+    batch = max(_prod(xs[:-2]), _prod(ys[:-2]))
+    return 2.0 * batch * m * n * k
+
+
+def _mul_flops(x_shape, y_shape, **_):
+    """fluid's fc matmul (mul op): x [batch.., K] @ y [K, N], x flattened
+    to 2-D at num_col_dims — flops depend only on total rows."""
+    rows = _prod(x_shape[:-1])
+    return 2.0 * rows * int(x_shape[-1]) * int(y_shape[-1])
+
+
+def _conv2d_flops(out_shape, w_shape, **_):
+    """2 * output elements * (Cin/groups * kh * kw); w is
+    [Cout, Cin/g, kh, kw], so w[1:] already folds the group divide."""
+    return 2.0 * _prod(out_shape) * _prod(w_shape[1:])
+
+
+# op type -> flops formula over input/output shapes. Keys match the IR op
+# names the lowerings register; shapes are the caller's responsibility
+# (program_cost_table rows carry them implicitly via block vars).
+ANALYTIC_FLOPS = {
+    "mul": _mul_flops,
+    "matmul": _matmul_flops,
+    "matmul_v2": _matmul_flops,
+    "conv2d": _conv2d_flops,
+}
+
+
+def analytic_flops(op_type: str, *shapes, **attrs) -> float:
+    """Analytic FLOPs for one op from the hand-maintained table; raises
+    KeyError for op types the table does not model (only ops whose cost is
+    shape-derivable belong here)."""
+    return float(ANALYTIC_FLOPS[op_type](*shapes, **attrs))
+
+
+def attention_flops(batch: int, heads: int, seq: int, head_dim: int) -> float:
+    """Analytic FLOPs of one scaled-dot-product attention forward:
+    QK^T (2*B*H*T*T*Dh) + attn@V (2*B*H*T*T*Dh). The softmax between them
+    is elementwise-dominated (~5 flops/element) and intentionally excluded
+    — at T >= Dh it is <2% of the matmul cost, inside the 2x cross-check
+    band."""
+    return 2.0 * 2.0 * batch * heads * seq * seq * head_dim
 
 
 def _var_aval(var):

@@ -1,0 +1,504 @@
+"""Compile- & memory-side observability (ISSUE 4): per-executable program
+reports, executor AOT executable reuse (HLO text without recompiling),
+the recompile explainer + rate limit, live HBM accounting, static-vs-
+measured memory reconciliation, and anomaly forensics dumps."""
+import glob
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.core import get_flag, set_flags
+from paddle_tpu.observability import TrainMonitor, default_registry
+from paddle_tpu.observability import program_report as prep
+from paddle_tpu.utils.nan_inf import summarize_value
+
+
+@pytest.fixture
+def report_dir(tmp_path):
+    """Route program-report JSONL into a temp dir for one test."""
+    prev = get_flag("FLAGS_program_report_dir")
+    d = str(tmp_path / "reports")
+    set_flags({"FLAGS_program_report_dir": d})
+    yield d
+    set_flags({"FLAGS_program_report_dir": prev})
+
+
+def _mlp(din=8, hidden=16, classes=4, train=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [din], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, hidden, act="relu")
+        logits = fluid.layers.fc(h, classes)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        if train:
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss, logits
+
+
+def _feed(batch, din=8, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.rand(batch, din).astype("float32"),
+            "y": rs.randint(0, classes, (batch, 1)).astype("int64")}
+
+
+def _read_reports(d):
+    return [json.loads(ln)
+            for p in glob.glob(os.path.join(d, "program_reports.*.jsonl"))
+            for ln in open(p)]
+
+
+# ---------------------------------------------------------------------------
+# program reports
+# ---------------------------------------------------------------------------
+
+def test_executor_emits_program_report(report_dir):
+    main, startup, loss, _ = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for _ in range(2):
+        out = exe.run(main, feed=_feed(8), fetch_list=[loss], scope=scope)
+    assert np.isfinite(out[0]).all()
+
+    recs = _read_reports(report_dir)
+    assert len(recs) >= 2  # startup + main
+    train_recs = [r for r in recs if r.get("fetches") == [loss.name]]
+    assert train_recs, recs
+    rec = train_recs[-1]
+    for key in ("flops", "bytes_accessed", "compile_ms"):
+        assert isinstance(rec[key], (int, float)) \
+            and math.isfinite(rec[key]) and rec[key] >= 0, (key, rec)
+    assert rec["flops"] > 0
+    assert rec["mode"] == "single"
+    assert rec["in_avals"]["count"] >= 3   # params + feeds + rng
+    assert rec["out_avals"]["count"] >= 1
+    # donated = written persistables (the optimizer-updated params)
+    assert any(n.endswith(".w_0") or n.endswith(".b_0")
+               for n in rec["donated"]), rec["donated"]
+    # labeled gauges mirror the JSONL
+    snap = default_registry().snapshot()
+    flops_series = snap["paddle_program_flops"]["series"]
+    assert any(s["labels"] == (rec["program"],) for s in flops_series)
+    assert rec["program"] in [s["labels"][0] for s in
+                              snap["paddle_program_peak_hbm_bytes"]["series"]]
+    # and the in-memory ring holds the same executables
+    assert any(r.get("program") == rec["program"]
+               for r in prep.recent_reports())
+
+
+def test_memory_summary_graceful_without_analysis():
+    class NoAnalysis:
+        def memory_analysis(self):
+            raise NotImplementedError("backend has no analysis")
+
+        def cost_analysis(self):
+            raise NotImplementedError
+
+    mem = prep.memory_summary(NoAnalysis())
+    assert set(mem) == {"argument_bytes", "output_bytes", "temp_bytes",
+                        "generated_code_bytes", "alias_bytes",
+                        "peak_hbm_bytes"}
+    assert all(v is None for v in mem.values())
+    cost = prep.cost_summary(NoAnalysis())
+    assert cost == {"flops": None, "bytes_accessed": None}
+
+
+def test_compiled_block_reuses_executable_for_hlo_text():
+    """Satellite: _hlo_text no longer pays a fresh lower().compile() —
+    the steady-state executable serves .as_text() directly."""
+    main, startup, loss, _ = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = _feed(8)
+    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    rec = exe._dispatch_records[(id(main), (loss.name,))]
+    blk = rec.exe
+    assert blk._executable is not None, "AOT executable was not kept"
+    getter = blk._hlo_text_getter({}, {}, {}, None)
+
+    # prove no re-lowering happens: poison the jitted fallback
+    class Boom:
+        def lower(self, *a, **k):
+            raise AssertionError("getter re-compiled instead of reusing")
+
+    orig = blk._jitted
+    blk._jitted = Boom()
+    try:
+        text = getter()
+    finally:
+        blk._jitted = orig
+    assert "HloModule" in text
+    assert text == blk._executable.as_text()
+
+
+def test_aot_fallback_keeps_running(monkeypatch):
+    """A block whose AOT compile fails must still execute via implicit
+    jit dispatch (AOT is never a correctness dependency)."""
+    from paddle_tpu.framework import executor as exec_mod
+
+    monkeypatch.setattr(
+        exec_mod._CompiledBlock, "_aot_compile",
+        lambda self, *a: setattr(self, "_aot_failed", True))
+    main, startup, loss, _ = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    losses = [exe.run(main, feed=_feed(8), fetch_list=[loss],
+                      scope=scope)[0] for _ in range(3)]
+    assert all(np.isfinite(l).all() for l in losses)
+    rec = exe._dispatch_records[(id(main), (loss.name,))]
+    assert rec.exe._executable is None and rec.exe._aot_failed
+
+
+def test_make_train_step_emits_report():
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel import parallelize as PZ
+
+    import jax
+
+    pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg, devices=[jax.devices()[0]])
+    cfg = G.GPT_TINY.scaled(num_layers=1)
+    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh)
+    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-3)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (1, 2, 8), dtype=np.int32)
+    params, opt, loss, gnorm = step(params, opt, toks, toks)
+    assert np.isfinite(float(loss))
+    reps = [r for r in prep.recent_reports()
+            if r.get("program", "").startswith("parallel_train_step/")]
+    assert reps, "make_train_step did not capture a program report"
+    rep = reps[-1]
+    assert rep["flops"] and rep["flops"] > 0
+    assert rep["donated"] == ["params", "opt_state"]
+    assert rep["mesh"] == {"dp": 1, "pp": 1, "tp": 1}
+    # second step reuses the AOT executable and stays finite
+    params, opt, loss2, _ = step(params, opt, toks, toks)
+    assert np.isfinite(float(loss2))
+
+
+# ---------------------------------------------------------------------------
+# recompile explainer
+# ---------------------------------------------------------------------------
+
+def _recompile_count(cause):
+    snap = default_registry().snapshot()
+    fam = snap.get("paddle_recompiles_total", {"series": []})
+    for s in fam["series"]:
+        if s["labels"] == (cause,):
+            return s["value"]
+    return 0.0
+
+
+def test_recompile_causes_end_to_end():
+    main, startup, loss, logits = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    shape0 = _recompile_count("feed_shape")
+    dtype0 = _recompile_count("feed_dtype")
+    fetch0 = _recompile_count("fetch_list")
+
+    exe.run(main, feed=_feed(8), fetch_list=[loss], scope=scope)
+    # batch-size change: feed_shape
+    exe.run(main, feed=_feed(16), fetch_list=[loss], scope=scope)
+    assert _recompile_count("feed_shape") == shape0 + 1
+    # fetch-list change: fetch_list
+    exe.run(main, feed=_feed(16), fetch_list=[loss, logits], scope=scope)
+    assert _recompile_count("fetch_list") == fetch0 + 1
+    # dtype change on an auxiliary (undeclared) feed: feed_dtype — declared
+    # vars are dtype-normalized, so only an undeclared feed can drift
+    feed = dict(_feed(16), aux=np.zeros(3, np.float32))
+    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    feed["aux"] = np.zeros(3, np.int32)
+    exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert _recompile_count("feed_dtype") == dtype0 + 1
+
+
+def test_recompile_log_rate_limited(caplog):
+    import logging
+
+    main, startup, loss, _ = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    n0 = _recompile_count("feed_shape")
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.program_report"):
+        # shape churn: an ever-new batch size so every step rebuilds (a
+        # repeated size would hit the compile cache — not a recompile)
+        for i in range(12):
+            exe.run(main, feed=_feed(8 + i), fetch_list=[loss],
+                    scope=scope)
+    total = _recompile_count("feed_shape") - n0
+    assert total == 11  # every rebuild after the first counted exactly
+    logged = [r for r in caplog.records if "cause=feed_shape" in r.message]
+    # ...but the cause line is rate-limited (first 3 per program+cause)
+    assert 1 <= len(logged) <= prep._LOG_FIRST
+
+
+def test_explain_recompile_unit_causes():
+    base = prep.make_sig([("x", (8, 4), "float32")], ["loss"],
+                         flags={"FLAGS_check_nan_inf": False}, version=1,
+                         mesh=None)
+    shp = prep.make_sig([("x", (16, 4), "float32")], ["loss"],
+                        flags={"FLAGS_check_nan_inf": False}, version=1,
+                        mesh=None)
+    assert prep.explain_recompile(shp, [base])[0] == "feed_shape"
+    dt = prep.make_sig([("x", (8, 4), "float64")], ["loss"],
+                       flags={"FLAGS_check_nan_inf": False}, version=1,
+                       mesh=None)
+    assert prep.explain_recompile(dt, [base])[0] == "feed_dtype"
+    fs = prep.make_sig([("z", (8, 4), "float32")], ["loss"],
+                       flags={"FLAGS_check_nan_inf": False}, version=1,
+                       mesh=None)
+    assert prep.explain_recompile(fs, [base])[0] == "feed_set"
+    fl = prep.make_sig([("x", (8, 4), "float32")], ["loss"],
+                       flags={"FLAGS_check_nan_inf": True}, version=1,
+                       mesh=None)
+    cause, detail = prep.explain_recompile(fl, [base])
+    assert cause == "flags" and "FLAGS_check_nan_inf" in detail
+    mut = prep.make_sig([("x", (8, 4), "float32")], ["loss"],
+                        flags={"FLAGS_check_nan_inf": False}, version=2,
+                        mesh=None)
+    assert prep.explain_recompile(mut, [base])[0] == "program_mutation"
+    assert prep.explain_recompile(base, [base])[0] == "other"
+    # nearest sibling wins: vs {base, shp} a (16,4) fetch change is a pure
+    # fetch_list diff against shp, not shape+fetch against base
+    f2 = prep.make_sig([("x", (16, 4), "float32")], ["loss", "acc"],
+                       flags={"FLAGS_check_nan_inf": False}, version=1,
+                       mesh=None)
+    assert prep.explain_recompile(f2, [base, shp])[0] == "fetch_list"
+
+
+# ---------------------------------------------------------------------------
+# live HBM accounting
+# ---------------------------------------------------------------------------
+
+def test_live_buffer_bytes_counts_live_arrays():
+    import jax.numpy as jnp
+
+    live0, peak0 = prep.live_buffer_bytes()
+    assert live0 is not None and live0 >= 0
+    big = jnp.ones((256, 256), jnp.float32)  # 256 KiB
+    live1, peak1 = prep.live_buffer_bytes()
+    assert live1 >= live0 + big.nbytes * 0.9
+    assert peak1 >= live1 or peak1 >= peak0
+    del big
+
+
+def test_monitor_rows_carry_hbm_fields(tmp_path):
+    import jax.numpy as jnp
+
+    resident = jnp.ones((64, 64), jnp.float32)  # keep >0 bytes live
+    path = str(tmp_path / "m.jsonl")
+    mon = TrainMonitor(path=path, examples_per_step=4)
+    for _ in range(3):
+        with mon.step() as s:
+            s.dispatched()
+            s.observe(loss=np.float32(1.0))
+    mon.close()
+    rows = [json.loads(ln) for ln in open(path)]
+    assert len(rows) == 3
+    for r in rows:
+        assert r["live_buffer_bytes"] >= resident.nbytes
+        assert r["peak_hbm_bytes"] >= r["live_buffer_bytes"]
+    # opt-out leaves the rows clean
+    mon2 = TrainMonitor(examples_per_step=4, sample_hbm=False)
+    with mon2.step() as s:
+        s.observe(loss=np.float32(1.0))
+    assert "live_buffer_bytes" not in mon2.last_record
+
+
+def test_reconcile_memory_usage():
+    from paddle_tpu.contrib.memory_usage_calc import reconcile
+
+    main, _, _, _ = _mlp()
+    out = reconcile(main, batch_size=8)
+    assert out["static_lower_mb"] > 0
+    assert out["static_upper_mb"] == pytest.approx(
+        out["static_lower_mb"] * 3.0, rel=0.02)  # both rounded to 4 places
+    assert out["measured_live_mb"] is not None \
+        and out["measured_live_mb"] >= 0
+    assert "measured_over_static_lower" in out
+
+
+# ---------------------------------------------------------------------------
+# anomaly forensics dumps
+# ---------------------------------------------------------------------------
+
+def test_dump_on_nan_loss(tmp_path):
+    dump_dir = str(tmp_path / "dumps")
+    mon = TrainMonitor(path=str(tmp_path / "m.jsonl"),
+                       examples_per_step=4, dump_on_anomaly=dump_dir)
+    for i in range(4):
+        with mon.step() as s:
+            s.dispatched()
+            s.observe(loss=np.float32(0.5), grad_norm=np.float32(1.0),
+                      fetches=[np.float32(0.5), np.float32(1.0)],
+                      fetch_names=["loss", "gnorm"])
+    bad = np.float32("nan")
+    with mon.step() as s:
+        s.dispatched()
+        s.observe(loss=bad, grad_norm=np.float32(1.0),
+                  fetches=[bad, np.float32(1.0)],
+                  fetch_names=["loss", "gnorm"])
+    mon.close()
+
+    assert mon.dumps_written == 1
+    d = mon.dump_paths[0]
+    assert os.path.basename(d).endswith("_nan_inf")
+    assert mon.last_record["anomaly"] == "nan_inf"
+    assert mon.last_record["anomaly_dump"] == d
+
+    info = json.load(open(os.path.join(d, "dump_info.json")))
+    assert info["reason"] == "nan_inf" and info["step"] == 5
+    tail = [json.loads(ln)
+            for ln in open(os.path.join(d, "monitor_tail.jsonl"))]
+    assert len(tail) == 5 and tail[-1]["nan_inf"] is True
+    summaries = json.load(open(os.path.join(d, "fetch_summaries.json")))
+    assert [s["name"] for s in summaries] == ["loss", "gnorm"]
+    assert summaries[0]["nan_count"] == 1
+    assert summaries[1]["nan_count"] == 0 and summaries[1]["max"] == 1.0
+    flags = json.load(open(os.path.join(d, "flags.json")))
+    assert "FLAGS_dispatch_fast_path" in flags
+    assert os.path.exists(os.path.join(d, "program_reports.json"))
+    # the JSONL row for the offender carries the dump pointer too
+    rows = [json.loads(ln) for ln in open(str(tmp_path / "m.jsonl"))]
+    assert rows[-1].get("anomaly_dump") == d
+
+
+def test_dump_on_grad_norm_blowup_and_quota(tmp_path):
+    dump_dir = str(tmp_path / "dumps")
+    mon = TrainMonitor(examples_per_step=4, dump_on_anomaly=dump_dir,
+                       anomaly_grad_mult=5.0, max_dumps=2)
+    for _ in range(6):  # healthy baseline: p50 = 1.0
+        with mon.step() as s:
+            s.observe(loss=np.float32(0.1), grad_norm=np.float32(1.0))
+    for _ in range(4):  # four blowups, quota allows two dumps
+        with mon.step() as s:
+            s.observe(loss=np.float32(0.1), grad_norm=np.float32(100.0))
+    assert mon.dumps_written == 2
+    assert all("grad_norm" in os.path.basename(p) for p in mon.dump_paths)
+    # a healthy-magnitude step right after is NOT flagged (the p50 window
+    # excludes the outliers' own steps only after they entered; 5x of the
+    # contaminated p50 still clears 1.0)
+    with mon.step() as s:
+        s.observe(loss=np.float32(0.1), grad_norm=np.float32(1.0))
+    assert "anomaly" not in mon.last_record
+
+
+def test_monitored_train_nan_injection_dumps(tmp_path):
+    """Acceptance path: an injected NaN mid-train produces a dump with
+    monitor tail + fetch summaries, via train_from_dataset wiring."""
+    main, startup, loss, _ = _mlp()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    dump_dir = str(tmp_path / "dumps")
+    mon = TrainMonitor(examples_per_step=8, dump_on_anomaly=dump_dir)
+    feed = _feed(8)
+    for i in range(4):
+        with mon.step() as s:
+            out = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                          return_numpy=False)
+            s.dispatched()
+            s.observe(loss=out[0], fetches=out, fetch_names=[loss.name])
+    # poison a weight -> forward goes NaN
+    w = scope.find_var("fc_0.w_0")
+    import jax.numpy as jnp
+
+    scope.set_var("fc_0.w_0", jnp.asarray(np.full(np.shape(w), np.nan,
+                                                  np.float32)))
+    with mon.step() as s:
+        out = exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                      return_numpy=False)
+        s.dispatched()
+        s.observe(loss=out[0], fetches=out, fetch_names=[loss.name])
+    assert mon.dumps_written == 1
+    d = mon.dump_paths[0]
+    summaries = json.load(open(os.path.join(d, "fetch_summaries.json")))
+    assert summaries[0]["name"] == loss.name
+    assert summaries[0]["nan_count"] >= 1
+    reports = json.load(open(os.path.join(d, "program_reports.json")))
+    assert isinstance(reports, list)
+
+
+def test_train_from_dataset_dump_wiring(tmp_path):
+    """train_from_dataset hands each step's fetch list to the monitor (by
+    reference): a poisoned weight NaNs the loss and the resulting dump's
+    fetch summaries name the dataset-trainer's fetch vars."""
+    from paddle_tpu.dataset import DatasetFactory
+
+    din, classes, batch = 4, 3, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [din], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        logits = fluid.layers.fc(x, classes)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rows = []
+    rs = np.random.RandomState(0)
+    for _ in range(4 * batch):
+        xs = " ".join(f"{v:.4f}" for v in rs.randn(din))
+        rows.append(f"{din} {xs} 1 {rs.randint(classes)}\n")
+    data_path = str(tmp_path / "part-0")
+    with open(data_path, "w") as f:
+        f.writelines(rows)
+    dataset = DatasetFactory().create_dataset("InMemoryDataset")
+    dataset.set_use_var([x, y])
+    dataset.set_batch_size(batch)
+    dataset.set_filelist([data_path])
+    dataset.load_into_memory()
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(startup, scope=scope)
+    import jax.numpy as jnp
+
+    w = scope.find_var("fc_0.w_0")
+    scope.set_var("fc_0.w_0",
+                  jnp.asarray(np.full(np.shape(w), np.nan, np.float32)))
+    dump_dir = str(tmp_path / "dumps")
+    mon = TrainMonitor(examples_per_step=batch, dump_on_anomaly=dump_dir,
+                       max_dumps=1)
+    exe.train_from_dataset(main, dataset, scope=scope, fetch_list=[loss],
+                           monitor=mon)
+    assert mon.dumps_written == 1
+    summaries = json.load(open(os.path.join(
+        mon.dump_paths[0], "fetch_summaries.json")))
+    assert summaries and summaries[0]["name"] == loss.name
+    assert summaries[0]["nan_count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# fetch summaries
+# ---------------------------------------------------------------------------
+
+def test_summarize_value_kinds():
+    s = summarize_value("v", np.array([1.0, np.nan, np.inf, -2.0],
+                                      np.float32))
+    assert s["shape"] == [4] and s["size"] == 4
+    assert s["nan_count"] == 1 and s["inf_count"] == 1
+    assert s["finite_count"] == 2
+    assert s["min"] == -2.0 and s["max"] == 1.0
+    ints = summarize_value("i", np.arange(6, dtype=np.int64))
+    assert ints["min"] == 0 and ints["max"] == 5
+    assert "nan_count" not in ints
+    import ml_dtypes
+
+    bf = summarize_value("b", np.ones(3, ml_dtypes.bfloat16))
+    assert bf["finite_count"] == 3
+    empty = summarize_value("e", np.zeros((0,), np.float32))
+    assert empty["size"] == 0
+    bad = summarize_value("x", object())
+    assert "error" in bad or bad["size"] == 1

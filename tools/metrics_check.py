@@ -5,10 +5,15 @@ Runs ``Executor.train_from_dataset`` with a ``TrainMonitor`` attached,
 then asserts:
   * the per-step JSONL contains every required key
     ({step, step_time_ms, host_dispatch_ms, device_wait_ms, examples_per_s,
-      mfu, loss, nan_inf}) with finite values;
+      mfu, loss, nan_inf}) with finite values, plus the live-HBM
+    accounting field (live_buffer_bytes);
   * the metrics registry caught the dispatch/compile counters;
+  * the program-report JSONL (FLAGS_program_report_dir) holds >= 1 record
+    per compiled executable with finite flops / bytes-accessed /
+    compile wall-ms;
   * the Prometheus textfile parses line-by-line against the exposition
-    grammar (the same regex validator tests/test_observability.py uses).
+    grammar (the same regex validator tests/test_observability.py uses)
+    and carries the paddle_program_* / live-HBM gauges.
 
 Wired into tier-1 as tests/test_metrics_check.py (``-m 'not slow'``), so
 the telemetry path is exercised end-to-end on every run. Standalone:
@@ -75,6 +80,25 @@ def run_check(out_dir: str) -> dict:
 
     import paddle_tpu as fluid
     from paddle_tpu.dataset import DatasetFactory
+    from paddle_tpu.framework.core import get_flag, set_flags
+    from paddle_tpu.observability import (TrainMonitor, default_registry, hw,
+                                          prom)
+
+    prev_report_dir = get_flag("FLAGS_program_report_dir")
+    set_flags({"FLAGS_program_report_dir": out_dir})
+    try:
+        return _run_check_inner(out_dir)
+    finally:
+        set_flags({"FLAGS_program_report_dir": prev_report_dir})
+
+
+def _run_check_inner(out_dir: str) -> dict:
+    import glob
+
+    import numpy as np  # noqa: F401
+
+    import paddle_tpu as fluid
+    from paddle_tpu.dataset import DatasetFactory
     from paddle_tpu.observability import (TrainMonitor, default_registry, hw,
                                           prom)
 
@@ -120,6 +144,10 @@ def run_check(out_dir: str) -> dict:
         assert rec["nan_inf"] is False, f"NaN/Inf flagged: {rec}"
         assert rec["step_time_ms"] >= rec["host_dispatch_ms"] >= 0, rec
         assert rec["mfu"] >= 0, rec
+        # live-HBM accounting rides on every monitored row
+        assert "live_buffer_bytes" in rec, f"no live_buffer_bytes: {rec}"
+        assert isinstance(rec["live_buffer_bytes"], int) \
+            and rec["live_buffer_bytes"] > 0, rec
 
     # --- registry: the executor self-reported --------------------------
     snap = default_registry().snapshot()
@@ -130,12 +158,32 @@ def run_check(out_dir: str) -> dict:
     assert "paddle_train_steps_total" in snap
     assert "paddle_prefetch_queue_depth" in snap
 
-    # --- Prometheus exposition -----------------------------------------
+    # --- program reports: one JSONL record per compiled executable -----
+    report_files = glob.glob(
+        os.path.join(out_dir, "program_reports.*.jsonl"))
+    assert report_files, f"no program-report JSONL under {out_dir}"
+    reports = [json.loads(ln) for p in report_files for ln in open(p)]
+    assert len(reports) >= 1, "program-report JSONL is empty"
+    for rep in reports:
+        for key in ("flops", "bytes_accessed", "compile_ms"):
+            v = rep.get(key)
+            assert isinstance(v, (int, float)) and math.isfinite(v) \
+                and v >= 0, f"report {key}={v!r} not finite: {rep}"
+        assert rep.get("program"), rep
+        assert "memory" in rep, rep
+
+    # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
-    samples = validate_prom_text(open(prom_path).read())
+    prom_text = open(prom_path).read()
+    samples = validate_prom_text(prom_text)
+    for gauge in ("paddle_program_flops", "paddle_program_peak_hbm_bytes",
+                  "paddle_live_buffer_bytes"):
+        assert f"\n{gauge}" in prom_text or \
+            prom_text.startswith(gauge), f"{gauge} missing from exposition"
 
     return {"steps": len(records), "prom_samples": samples,
+            "program_reports": len(reports),
             "jsonl": jsonl_path, "prom": prom_path,
             "last_record": records[-1]}
 
