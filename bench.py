@@ -251,6 +251,9 @@ def resnet_worker():
     host->device feeds don't pollute the compute measurement; steps dispatch
     async (no fetch) and are forced once at the end."""
     _log("resnet worker: importing")
+    from paddle_tpu.sysconfig import tpu_perf_flags
+
+    tpu_perf_flags()
     import numpy as np
     import jax
     import paddle_tpu as fluid
@@ -317,6 +320,9 @@ def ernie_worker():
     models/ernie.py make_pretrain_step (the reference's ERNIE config is
     the dist_transformer/ERNIE encoder family)."""
     _log("ernie worker: importing")
+    from paddle_tpu.sysconfig import tpu_perf_flags
+
+    tpu_perf_flags()
     import numpy as np
     import jax
 
@@ -385,6 +391,12 @@ def ernie_worker():
 
 def worker(use_flash: bool):
     _log("worker: importing jax")
+    # comm/compute-overlap preset (async collectives + latency-hiding
+    # scheduler) must land in XLA_FLAGS before the backend initializes;
+    # no-op off-TPU (paddle_tpu.sysconfig.tpu_perf_flags platform gate)
+    from paddle_tpu.sysconfig import tpu_perf_flags
+
+    tpu_perf_flags()
     import numpy as np
     import jax
 

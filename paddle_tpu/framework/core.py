@@ -188,6 +188,14 @@ _GLOBAL_FLAGS = {
     # (observability/program_report.py; see docs/observability.md)
     "FLAGS_program_report_dir": _os.environ.get(
         "FLAGS_program_report_dir", ""),
+    # quantized wire payload for fluid SUM-collectives ('' = off,
+    # "bf16" | "int8"): c_allreduce_sum/avg and c_reducescatter reroute
+    # through the chunk-scaled quantized exchange (f32 accumulation) in
+    # paddle_tpu/parallel/comm_opt.py — the GradientMergeOptimizer k-step
+    # tail reduction and transpiled dp gradient sync included. See
+    # docs/comm_opt.md.
+    "FLAGS_collective_comm_dtype": _os.environ.get(
+        "FLAGS_collective_comm_dtype", ""),
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_allocator_strategy": "xla_managed",
     "FLAGS_paddle_num_threads": 1,

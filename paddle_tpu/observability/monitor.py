@@ -279,6 +279,11 @@ class TrainMonitor:
         for k, v in h.fields.items():
             if k not in ("loss", "nan_inf"):
                 rec[k] = v
+        # comm/compute overlap fraction: callers that measure it (e.g.
+        # tools/comm_bench.py via comm_opt.measure_overlap_fraction) stamp
+        # the real value through record_step/observe extras; 0.0 otherwise
+        # so the row schema is stable (tools/metrics_check.py gate)
+        rec.setdefault("overlap_fraction", 0.0)
         for q in (50, 90, 99):
             rec[f"p{q}_step_time_ms"] = round(self._percentile(q), 4)
         if self.sample_hbm:

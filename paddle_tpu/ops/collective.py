@@ -9,6 +9,18 @@ emits ICI/DCN collectives. Outside any mesh (single-device executor) they are
 identity — same semantics as a 1-rank ring. The NCCL bootstrap ops
 (c_gen_nccl_id / c_comm_init) become no-ops: jax.distributed.initialize plays
 the coordinator role.
+
+Two communication-optimization hooks (docs/comm_opt.md):
+
+- ``FLAGS_collective_comm_dtype`` ("bf16" | "int8", default off) reroutes
+  the SUM-reductions (c_allreduce_sum/avg, c_reducescatter) through the
+  chunk-scaled quantized exchange in :mod:`paddle_tpu.parallel.comm_opt`
+  (EQuARX-style: quantized wire payload, f32 accumulation). This is the
+  same lever ``make_train_step(grad_allreduce_dtype=...)`` uses, so
+  transpiled fluid programs — including GradientMergeOptimizer's k-step
+  tail reduction — get quantized gradient sync from one flag.
+- every lowering records ring-model per-rank wire bytes into
+  ``paddle_collective_bytes_total{op,dtype}`` at trace time.
 """
 from __future__ import annotations
 
@@ -24,18 +36,49 @@ def _axis(ctx, op):
     return ctx.axis_name(ring_id)
 
 
+def _comm(ctx=None):
+    from ..parallel import comm_opt
+
+    return comm_opt
+
+
+def _flag_comm_dtype():
+    from ..framework.core import get_flag
+
+    return get_flag("FLAGS_collective_comm_dtype", "") or None
+
+
+def _record(op_kind, x, ax):
+    co = _comm()
+    co.record_collective(op_kind, x.dtype, x.size * x.dtype.itemsize,
+                         co.axis_size(ax))
+
+
 def _allreduce(reduce_fn):
     def lower(ctx, op, ins):
         x = ins["X"][0]
         ax = _axis(ctx, op)
         if ax is None:
             return {"Out": x}
+        _record("psum", x, ax)
         return {"Out": reduce_fn(x, ax)}
 
     return lower
 
 
-register_op("c_allreduce_sum", diff_inputs=("X",))(_allreduce(lax.psum))
+@register_op("c_allreduce_sum", diff_inputs=("X",))
+def c_allreduce_sum(ctx, op, ins):
+    x = ins["X"][0]
+    ax = _axis(ctx, op)
+    if ax is None:
+        return {"Out": x}
+    cd = _flag_comm_dtype()
+    if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
+        return {"Out": _comm().quantized_allreduce(x, ax, cd)}
+    _record("psum", x, ax)
+    return {"Out": lax.psum(x, ax)}
+
+
 register_op("c_allreduce_max", diff_inputs=("X",))(_allreduce(lax.pmax))
 register_op("c_allreduce_min", diff_inputs=("X",))(_allreduce(lax.pmin))
 
@@ -47,6 +90,7 @@ def c_allreduce_prod(ctx, op, ins):
     if ax is None:
         return {"Out": x}
     # no lax.pprod; exp-sum-log trick is unstable — use all_gather+prod
+    _record("all_gather", x, ax)
     g = lax.all_gather(x, ax)
     return {"Out": jnp.prod(g, axis=0)}
 
@@ -58,6 +102,10 @@ def c_allgather(ctx, op, ins):
     nranks = op.attr("nranks", 1)
     if ax is None:
         return {"Out": x}
+    co = _comm()
+    co.record_collective("all_gather", x.dtype,
+                         x.size * x.dtype.itemsize * co.axis_size(ax),
+                         co.axis_size(ax))
     g = lax.all_gather(x, ax)  # (nranks, ...)
     return {"Out": jnp.reshape(g, (g.shape[0] * g.shape[1],) + g.shape[2:])}
 
@@ -68,7 +116,10 @@ def c_reducescatter(ctx, op, ins):
     ax = _axis(ctx, op)
     if ax is None:
         return {"Out": x}
-    nranks = lax.axis_size(ax)
+    cd = _flag_comm_dtype()
+    if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
+        return {"Out": _comm().quantized_reduce_scatter_op(x, ax, cd)}
+    _record("psum_scatter", x, ax)
     return {"Out": lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)}
 
 
@@ -80,6 +131,10 @@ def c_broadcast(ctx, op, ins):
     if ax is None:
         return {"Out": x}
     # select root's value on every rank: gather then index (XLA lowers to bcast)
+    co = _comm()
+    co.record_collective("all_gather", x.dtype,
+                         x.size * x.dtype.itemsize * co.axis_size(ax),
+                         co.axis_size(ax))
     g = lax.all_gather(x, ax)
     return {"Out": g[root]}
 
@@ -91,6 +146,10 @@ def c_concat(ctx, op, ins):
     ax = _axis(ctx, op)
     if ax is None:
         return {"Out": x}
+    co = _comm()
+    co.record_collective("all_gather", x.dtype,
+                         x.size * x.dtype.itemsize * co.axis_size(ax),
+                         co.axis_size(ax))
     return {"Out": lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)}
 
 
@@ -100,7 +159,7 @@ def c_split(ctx, op, ins):
     ax = _axis(ctx, op)
     if ax is None:
         return {"Out": x}
-    nranks = lax.axis_size(ax)
+    nranks = _comm().axis_size(ax)
     rank = lax.axis_index(ax)
     piece = x.shape[-1] // nranks
     return {"Out": lax.dynamic_slice_in_dim(x, rank * piece, piece, axis=x.ndim - 1)}
@@ -136,6 +195,7 @@ def legacy_allreduce(ctx, op, ins):
         return {"Out": x}
     red = op.attr("reduce_type", 0)
     fn = [lax.psum, lax.pmax, lax.pmin][red] if red in (0, 1, 2) else lax.psum
+    _record("psum", x, ax)
     return {"Out": fn(x, ax)}
 
 
@@ -148,4 +208,8 @@ def c_allreduce_avg(ctx, op, ins):
     ax = _axis(ctx, op)
     if ax is None:
         return {"Out": x}
+    cd = _flag_comm_dtype()
+    if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
+        return {"Out": _comm().quantized_allreduce(x, ax, cd, mean=True)}
+    _record("psum", x, ax)
     return {"Out": lax.pmean(x, ax)}

@@ -1041,12 +1041,21 @@ class GradientMergeOptimizer:
     memory drops by ~k."""
 
     def __init__(self, inner_optimizer, k_steps=1, avg=True,
-                 remat_policy=None):
+                 remat_policy=None, acc_dtype="float32"):
         from .parallel import remat as _remat
 
         self._optimizer = inner_optimizer
         self.k_steps = int(k_steps)
         self.avg = bool(avg)
+        # microbatch gradient-accumulator dtype. f32 default regardless of
+        # the param/grad dtype: bf16 accumulation drifts over k steps (8-bit
+        # mantissa swallows small addends once the sum grows) — tested in
+        # tests/test_comm_opt.py. Override only to trade accuracy for the
+        # accumulator's HBM (e.g. "bfloat16" halves it).
+        if acc_dtype not in ("float32", "bfloat16", "float16"):
+            raise ValueError(
+                f"acc_dtype {acc_dtype!r}: expected float32/bfloat16/float16")
+        self.acc_dtype = acc_dtype
         # named remat policy (parallel/remat.py) recorded on the annotation
         # so one knob drives all three parallel paths; a grad-merge program
         # carries explicit gradient ops, so non-"none" policies only change
@@ -1068,7 +1077,8 @@ class GradientMergeOptimizer:
         annotate_grad_merge(
             program, loss, bwd_end, self.k_steps,
             [g.name for p, g in params_grads if g is not None],
-            avg=self.avg, remat_policy=self._remat_policy)
+            avg=self.avg, remat_policy=self._remat_policy,
+            acc_dtype=str(self.acc_dtype))
         return opt_ops, params_grads
 
 

@@ -2,8 +2,10 @@
 ParallelExecutor/NCCL stack (SURVEY.md §2.3): device meshes + GSPMD shardings
 + shard_map collectives instead of SSA graphs + rings."""
 from .mesh import MeshConfig, build_mesh, current_mesh, mesh_guard  # noqa: F401
+from . import comm_opt  # noqa: F401
 from . import env  # noqa: F401
 from . import remat  # noqa: F401
+from .comm_opt import CommConfig  # noqa: F401
 from .launch import launch  # noqa: F401
 from .checkpoint import (  # noqa: F401
     ShardedCheckpointer, abstract_for_mesh, abstract_like,

@@ -20,7 +20,8 @@ from ..framework.registry import LowerCtx, run_lowering
 
 
 def annotate_grad_merge(program, loss, bwd_end, k_steps,
-                        grad_names, avg=True, remat_policy="none"):
+                        grad_names, avg=True, remat_policy="none",
+                        acc_dtype="float32"):
     from . import remat as remat_mod
 
     block = program.global_block()
@@ -37,6 +38,9 @@ def annotate_grad_merge(program, loss, bwd_end, k_steps,
         "grads": list(grad_names),
         "avg": bool(avg),
         "remat": remat_mod.resolve(remat_policy).name,
+        # accumulator dtype for the k-microbatch grad sum; f32 default
+        # regardless of param dtype (bf16 accumulation drifts over k)
+        "acc_dtype": str(acc_dtype),
     }
     program._bump_version()
 
@@ -72,6 +76,7 @@ class _CompiledGradMergeBlock:
         loss_name = ann["loss"]
         grad_names = [g for g in ann["grads"] if g]
         avg = ann["avg"]
+        acc_dtype = jnp.dtype(ann.get("acc_dtype", "float32"))
         self.program = program
         self.feed_names = [n for n, _, _ in feed_sig]
         self.fetch_names = list(fetch_names)
@@ -172,7 +177,7 @@ class _CompiledGradMergeBlock:
                 env.update(state)  # sequential persistable updates (BN)
                 # distinct randomness per microbatch (dropout masks)
                 outs = run_fwd_bwd(env, jax.random.fold_in(rng_key, i))
-                new_acc = {g: acc[g] + outs[g].astype(jnp.float32)
+                new_acc = {g: acc[g] + outs[g].astype(acc_dtype)
                            for g in grad_names}
                 new_state = {n: outs[n] for n in fwd_written if n in outs}
                 fetched = {n: outs[n] for n in fwd_fetch if n in outs}
@@ -187,7 +192,7 @@ class _CompiledGradMergeBlock:
                         {n: outs[n] for n in fwd_fetch if n in outs})
 
             g_shapes, s_shapes, f_shapes = jax.eval_shape(probe)
-            acc0 = {g: jnp.zeros(sh.shape, jnp.float32)
+            acc0 = {g: jnp.zeros(sh.shape, acc_dtype)
                     for g, sh in g_shapes.items()}
             state0 = {n: params[n].astype(s_shapes[n].dtype)
                       if n in params else jnp.zeros(s_shapes[n].shape,

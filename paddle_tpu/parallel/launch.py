@@ -29,7 +29,9 @@ def get_cluster_endpoints(node_ips: List[str], nproc_per_node: int,
 def launch(training_script: str, script_args: Optional[List[str]] = None,
            cluster_node_ips: str = "127.0.0.1", node_ip: str = "127.0.0.1",
            nproc_per_node: int = 1, started_port: int = 6070,
-           log_dir: Optional[str] = None) -> int:
+           log_dir: Optional[str] = None, perf_flags: bool = True) -> int:
+    from ..sysconfig import tpu_perf_flags
+
     node_ips = [ip.strip() for ip in cluster_node_ips.split(",")]
     endpoints = get_cluster_endpoints(node_ips, nproc_per_node, started_port)
     node_rank = node_ips.index(node_ip)
@@ -45,6 +47,11 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
             "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
         })
+        if perf_flags:
+            # comm/compute-overlap preset into each worker's XLA_FLAGS
+            # BEFORE its backend init (no-op unless the worker env targets
+            # a TPU — the platform gate in sysconfig.tpu_perf_flags)
+            tpu_perf_flags(env=env)
         out = (open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
                if log_dir else None)
         p = subprocess.Popen(
@@ -102,12 +109,15 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
     ap.add_argument("--nproc_per_node", type=int, default=1)
     ap.add_argument("--started_port", type=int, default=6070)
     ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--no_perf_flags", action="store_true",
+                    help="skip the sysconfig.tpu_perf_flags XLA preset")
     ap.add_argument("training_script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     sys.exit(launch(args.training_script, args.script_args,
                     args.cluster_node_ips, args.node_ip, args.nproc_per_node,
-                    args.started_port, args.log_dir))
+                    args.started_port, args.log_dir,
+                    perf_flags=not args.no_perf_flags))
 
 
 if __name__ == "__main__":

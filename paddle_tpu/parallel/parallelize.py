@@ -44,10 +44,12 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from . import comm_opt
 from . import mesh as mesh_mod
 from ..models import gpt as gpt_mod
 from ..models.gpt import GPTConfig
 from ..observability import metrics as _obs_metrics
+from .comm_opt import CommConfig
 
 # Collective self-reporting. Collectives execute inside ONE fused XLA
 # program, so their wall time is only observable on the device timeline:
@@ -110,13 +112,29 @@ def _axes_not_in_spec(spec: P, axis_names) -> Tuple[str, ...]:
     return tuple(a for a in axis_names if a not in used)
 
 
-def psum_grads_by_spec(grads, specs, axis_names):
-    """psum each grad leaf over the mesh axes its param is replicated on."""
+def psum_grads_by_spec(grads, specs, axis_names, skip_axes=(),
+                       comm_dtype=None, quant_chunk=256):
+    """psum each grad leaf over the mesh axes its param is replicated on.
+
+    ``skip_axes`` leaves named axes un-reduced (the reduce-scatter path
+    handles dp itself, bucketed). ``comm_dtype`` routes the reduction
+    through :func:`comm_opt.quantized_allreduce` (chunk-scaled wire payload,
+    f32 accumulation) — applied per axis, a hierarchical all-reduce.
+    """
     def one(g, s):
-        axes = _axes_not_in_spec(s, axis_names)
+        axes = tuple(a for a in _axes_not_in_spec(s, axis_names)
+                     if a not in skip_axes)
         if not axes:
             return g
         with _named_collective("psum_grad"):
+            if comm_dtype is not None:
+                for a in axes:
+                    g = comm_opt.quantized_allreduce(
+                        g, a, comm_dtype, quant_chunk=quant_chunk)
+                return g
+            comm_opt.record_collective(
+                "psum", g.dtype, g.size * g.dtype.itemsize,
+                comm_opt._axes_size(axes))
             return jax.lax.psum(g, axes)
 
     return jax.tree_util.tree_map(one, grads, specs,
@@ -135,11 +153,20 @@ def shard_params(params, specs, mesh):
 # ---------------------------------------------------------------------------
 
 def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
-                   pcfg: ParallelConfig):
+                   pcfg: ParallelConfig, double_buffer: bool = False):
     """Runs inside shard_map. Local shapes:
     tokens/labels [M, mb_local, T]; params['blocks'] leaves [L/pp, ...] with
     tp-local head/ffn dims; replicated leaves full-size.
     Returns the global mean token loss (replicated scalar).
+
+    ``double_buffer=True`` moves the stage-boundary ppermute from the tail
+    of each tick to the head of the NEXT tick (the carry holds the
+    un-permuted activation): microbatch t's activation is in flight while
+    tick t+1 computes its embedding, so XLA's async collective-permute +
+    latency-hiding scheduler (sysconfig.tpu_perf_flags) can overlap the
+    send with compute. Tick values are identical to the serial schedule
+    (the permute commutes with the carry), so the loss trajectory matches
+    bit-for-bit — tested in tests/test_comm_opt.py.
     """
     dp_ax, pp_ax, tp_ax = pcfg.axis_names
     S, M = pcfg.pp, pcfg.microbatches
@@ -166,8 +193,18 @@ def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
     perm = [(i, (i + 1) % S) for i in range(S)]
     total_tokens = M * mb * T  # per-dp-rank token count (dp summed via psum)
 
+    def _permute_act(x):
+        with _named_collective("ppermute_activation"):
+            comm_opt.record_collective(
+                "ppermute", x.dtype, x.size * x.dtype.itemsize, S)
+            return jax.lax.ppermute(x, pp_ax, perm)
+
     def tick(carry, t):
         state, loss_acc = carry
+        if double_buffer and S > 1:
+            # the carry holds LAST tick's un-permuted output: start its
+            # ppermute now so the send is in flight while this tick embeds
+            state = _permute_act(state)
         mb_in = jnp.clip(t, 0, M - 1)
         tok = jax.lax.dynamic_index_in_dim(tokens, mb_in, axis=0,
                                            keepdims=False)
@@ -188,11 +225,10 @@ def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
         l = jax.lax.cond(valid, lambda: mb_loss(out, lbl),
                          lambda: jnp.float32(0.0))
         loss_acc = loss_acc + l
-        if S > 1:
-            with _named_collective("ppermute_activation"):
-                state = jax.lax.ppermute(out, pp_ax, perm)
-        else:
+        if double_buffer or S == 1:
             state = out
+        else:
+            state = _permute_act(out)
         return (state, loss_acc), None
 
     D = cfg.d_model
@@ -240,11 +276,19 @@ def init_adamw_state(params, moment_dtype=None, fused=False):
             "step": jnp.zeros((), jnp.int32)}
 
 
+def _clip_scale(gnorm, grad_clip):
+    """grad_clip=None disables clipping with a bit-exact scale of 1.0 (the
+    reduce-scatter parity tests rely on x*1.0 == x)."""
+    if grad_clip is None:
+        return jnp.float32(1.0)
+    return jnp.minimum(1.0, grad_clip / (gnorm + 1e-6))
+
+
 def _adamw_update(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
                   weight_decay=0.1, grad_clip=1.0):
     gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                          for g in jax.tree_util.tree_leaves(grads)))
-    scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-6))
+    scale = _clip_scale(gnorm, grad_clip)
     step = opt["step"] + 1
     c1 = 1 - b1 ** step.astype(jnp.float32)
     c2 = 1 - b2 ** step.astype(jnp.float32)
@@ -294,7 +338,7 @@ def _adamw_update_fused(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
          for p, n in zip(flat_p, sizes)])
 
     gnorm = jnp.sqrt(jnp.sum(jnp.square(gf)))
-    scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-6))
+    scale = _clip_scale(gnorm, grad_clip)
     gf = gf * scale
     step = opt["step"] + 1
     c1 = 1 - b1 ** step.astype(jnp.float32)
@@ -314,9 +358,205 @@ def _adamw_update_fused(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
                    "v": vf.astype(opt["v"].dtype), "step": step}, gnorm
 
 
+def _rs_param_layout(cfg: GPTConfig, pcfg: ParallelConfig,
+                     ccfg: CommConfig):
+    """Bucket layout over the rank-LOCAL param shard shapes (tree-flatten
+    order) for the reduce-scatter path. Deterministic in (cfg, pcfg, ccfg)
+    so ``init_sharded`` and ``make_train_step`` agree."""
+    dp_ax, pp_ax, tp_ax = pcfg.axis_names
+    specs = gpt_mod.param_specs(cfg, pp=pp_ax, tp=tp_ax)
+    sizes = dict(zip(pcfg.axis_names, (pcfg.dp, pcfg.pp, pcfg.tp)))
+    avals = jax.eval_shape(partial(gpt_mod.init_params, cfg=cfg),
+                           jax.ShapeDtypeStruct((2,), jnp.uint32))
+    flat_avals, treedef = jax.tree_util.tree_flatten(avals)
+    flat_specs = treedef.flatten_up_to(specs)
+
+    def local_shape(shape, spec):
+        out = list(shape)
+        for d, entry in enumerate(spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            div = int(np.prod([sizes[a] for a in axes]))
+            if out[d] % div:
+                raise ValueError(
+                    f"param dim {shape}[{d}] not divisible by mesh {axes}")
+            out[d] //= div
+        return tuple(out)
+
+    for s in flat_specs:
+        if dp_ax in _spec_axes(s):
+            raise NotImplementedError(
+                "reduce_scatter grad path expects dp-replicated params")
+    shapes_dtypes = [(local_shape(a.shape, s), a.dtype)
+                     for a, s in zip(flat_avals, flat_specs)]
+    pad_multiple = ccfg.quant_chunk if ccfg.comm_dtype == "int8" else 1
+    layout = comm_opt.build_bucket_layout(
+        shapes_dtypes, ranks=pcfg.dp,
+        cap_bytes=int(ccfg.bucket_mb * (1 << 20)),
+        pad_multiple=pad_multiple)
+    return layout, specs, treedef
+
+
+def _spec_axes(spec: P):
+    out = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.update(entry)
+        else:
+            out.add(entry)
+    return out
+
+
+def _make_rs_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
+                  ccfg: CommConfig, lr, weight_decay, grad_clip,
+                  specs, param_sh, data_spec, data_sh, double_buffer):
+    """The reduce-scatter train step: ONE shard_map holding grad, bucketed
+    psum_scatter, the sharded flat AdamW sweep, and the param all_gather.
+
+    Per dp rank: grads are flat-concatenated into the bucket layout
+    (comm_opt.build_bucket_layout over the rank-local leaf shards), each
+    bucket is reduced with ``lax.psum_scatter`` (or the quantized
+    all_to_all exchange) so the rank owns 1/dp of it, AdamW runs on the
+    shard against dp-sharded flat moments, and the updated param shards
+    are ``all_gather``-ed back into replicated leaves. Every bucket's
+    collectives sit in ``collective/rs_bucket<i>`` / ``collective/
+    ag_bucket<i>`` named scopes so the merged trace measures overlap.
+    """
+    dp_ax = pcfg.axis_names[0]
+    dp = pcfg.dp
+    layout, _, treedef = _rs_param_layout(cfg, pcfg, ccfg)
+    buckets = layout.buckets
+    # static per-bucket flat constants: weight-decay mask (no decay on
+    # 1-D leaves) and the grad-norm replication weight (a leaf replicated
+    # over pp/tp appears on every such rank; weight 1/replication so the
+    # all-axes psum counts each unique element once)
+    sizes = dict(zip(pcfg.axis_names, (pcfg.dp, pcfg.pp, pcfg.tp)))
+    flat_specs = treedef.flatten_up_to(specs)
+    wd_masks, repl_w = [], []
+    for b in buckets:
+        parts = []
+        for idx, shape, numel in b.entries:
+            repl = int(np.prod([sizes[a] for a in pcfg.axis_names[1:]
+                                if a not in _spec_axes(flat_specs[idx])]))
+            parts.append(np.full((numel,), 1.0 / repl, np.float32))
+        parts.append(np.zeros((b.pad,), np.float32))
+        repl_w.append(np.concatenate(parts))
+        wd_masks.append(comm_opt.bucket_wd_mask(b))
+    b1, b2, eps = 0.9, 0.95, 1e-8
+
+    def per_rank(params, opt, tokens, labels):
+        local_loss, grads = jax.value_and_grad(_pipeline_loss)(
+            params, tokens, labels, cfg, pcfg, double_buffer)
+        with _named_collective("psum_loss"):
+            comm_opt.record_collective("psum", jnp.float32, 4,
+                                       pcfg.n_devices)
+            loss = jax.lax.psum(local_loss, pcfg.axis_names)
+        # pp/tp replication is still a per-leaf psum; the dp reduction is
+        # the bucketed scatter below
+        grads = psum_grads_by_spec(
+            grads, specs, pcfg.axis_names, skip_axes=(dp_ax,),
+            comm_dtype=ccfg.comm_dtype, quant_chunk=ccfg.quant_chunk)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_p = jax.tree_util.tree_leaves(params)
+        dp_idx = jax.lax.axis_index(dp_ax)
+
+        g_shards, p_shards, wd_shards, w_shards, ef_out = [], [], [], [], []
+        ef_off = 0
+        for i, b in enumerate(buckets):
+            blen = b.size // dp
+            with jax.named_scope(f"collective/rs_bucket{i}"):
+                _m_collectives.labels("psum_scatter_grad").inc()
+                vec = comm_opt.flatten_bucket(flat_g, b, jnp.float32)
+                if ccfg.error_feedback:
+                    vec = vec + jax.lax.dynamic_slice(
+                        opt["ef"], (ef_off,), (b.size,))
+                shard, resid = comm_opt.reduce_scatter_flat(vec, dp_ax, ccfg)
+                g_shards.append(shard)
+                if ccfg.error_feedback:
+                    ef_out.append(resid)
+            pvec = comm_opt.flatten_bucket(flat_p, b, jnp.float32)
+            start = dp_idx * blen
+            p_shards.append(jax.lax.dynamic_slice(pvec, (start,), (blen,)))
+            wd_shards.append(jax.lax.dynamic_slice(
+                jnp.asarray(wd_masks[i]), (start,), (blen,)))
+            w_shards.append(jax.lax.dynamic_slice(
+                jnp.asarray(repl_w[i]), (start,), (blen,)))
+            ef_off += b.size
+
+        gf = jnp.concatenate(g_shards) if len(g_shards) > 1 else g_shards[0]
+        pf = jnp.concatenate(p_shards) if len(p_shards) > 1 else p_shards[0]
+        wd_mask = jnp.concatenate(wd_shards) if len(wd_shards) > 1 \
+            else wd_shards[0]
+        w = jnp.concatenate(w_shards) if len(w_shards) > 1 else w_shards[0]
+
+        with jax.named_scope("train/opt_update"):
+            gnorm = jnp.sqrt(jax.lax.psum(
+                jnp.sum(jnp.square(gf) * w), pcfg.axis_names))
+            gf = gf * _clip_scale(gnorm, grad_clip)
+            step_no = opt["step"] + 1
+            c1 = 1 - b1 ** step_no.astype(jnp.float32)
+            c2 = 1 - b2 ** step_no.astype(jnp.float32)
+            mf = b1 * opt["m"].astype(jnp.float32) + (1 - b1) * gf
+            vf = b2 * opt["v"].astype(jnp.float32) + (1 - b2) * gf * gf
+            u = (mf / c1) / (jnp.sqrt(vf / c2) + eps)
+            new_flat = pf - lr * (u + weight_decay * wd_mask * pf)
+
+        # gather updated shards back into replicated leaves, per bucket
+        new_by_idx = {}
+        off = 0
+        for i, b in enumerate(buckets):
+            blen = b.size // dp
+            with jax.named_scope(f"collective/ag_bucket{i}"):
+                _m_collectives.labels("all_gather_params").inc()
+                full = comm_opt.all_gather_flat(new_flat[off:off + blen],
+                                                dp_ax)
+            new_by_idx.update(comm_opt.unflatten_bucket(full, b))
+            off += blen
+        new_leaves = [new_by_idx[i].astype(p.dtype)
+                      for i, p in enumerate(flat_p)]
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        new_opt = {"m": mf.astype(opt["m"].dtype),
+                   "v": vf.astype(opt["v"].dtype), "step": step_no}
+        if ccfg.error_feedback:
+            new_opt["ef"] = (jnp.concatenate(ef_out)
+                             if len(ef_out) > 1 else ef_out[0])
+        return loss, new_params, new_opt, gnorm
+
+    flat_spec = P(tuple(pcfg.axis_names))
+    opt_specs = {"m": flat_spec, "v": flat_spec, "step": P()}
+    if ccfg.error_feedback:
+        opt_specs["ef"] = flat_spec
+    sharded = shard_map_compat(
+        per_rank, mesh,
+        in_specs=(specs, opt_specs, data_spec, data_spec),
+        out_specs=(P(), specs, opt_specs, P()),
+    )
+    opt_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), opt_specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+    @partial(jax.jit,
+             in_shardings=(param_sh, opt_sh, data_sh, data_sh),
+             out_shardings=(param_sh, opt_sh, None, None),
+             donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, labels):
+        with jax.named_scope("train/grad"):
+            loss, new_params, new_opt, gnorm = sharded(
+                params, opt_state, tokens, labels)
+        return new_params, new_opt, loss, gnorm
+
+    return step
+
+
 def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                     lr: float = 3e-4, weight_decay: float = 0.1,
-                    fused_opt: bool = False):
+                    fused_opt: bool = False, grad_reduce: str = "psum",
+                    grad_allreduce_dtype=None, bucket_mb: float = 32.0,
+                    error_feedback: bool = False, grad_clip=1.0,
+                    comm: Optional[CommConfig] = None):
     """Build the jitted 4D-parallel training step.
 
     Returns ``step(params, opt_state, tokens, labels) ->
@@ -327,55 +567,93 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     (_adamw_update_fused; opt state from ``init_sharded(fused_opt=True)``).
     Single-device meshes only — concatenating differently-sharded leaves
     would force an all-gather per step.
+
+    Communication levers (docs/comm_opt.md; or pass a ready
+    :class:`CommConfig` as ``comm``):
+
+    - ``grad_reduce="reduce_scatter"``: per-leaf dp psum is replaced by
+      size-capped flat gradient buckets reduced with ``lax.psum_scatter``;
+      each dp rank applies AdamW to its shard (moments + the flat master
+      sweep live dp-sharded — opt state from
+      ``init_sharded(grad_reduce="reduce_scatter")``) and the updated
+      params return via ``all_gather``. Gradient-reduction wire bytes
+      halve; optimizer-state HBM drops by dp x. f32 comm is bit-identical
+      to the psum baseline (tests/test_comm_opt.py).
+    - ``grad_allreduce_dtype="bf16"|"int8"``: chunk-scaled quantized wire
+      payload with f32 accumulation (comm_opt.py); ``error_feedback=True``
+      (reduce_scatter mode) carries the per-rank quantization residual in
+      the train state.
+    - ``grad_clip=None`` disables gradient clipping exactly (scale 1.0).
     """
-    if fused_opt and pcfg.n_devices > 1:
+    ccfg = comm if comm is not None else CommConfig(
+        grad_reduce=grad_reduce, comm_dtype=grad_allreduce_dtype,
+        bucket_mb=bucket_mb, error_feedback=error_feedback)
+    if fused_opt and pcfg.n_devices > 1 and ccfg.grad_reduce != "reduce_scatter":
         raise NotImplementedError(
-            "fused_opt currently requires a single-device mesh "
+            "fused_opt on a multi-device mesh requires "
+            "grad_reduce='reduce_scatter' (the bucketed flat sweep) "
             f"(got dp={pcfg.dp} pp={pcfg.pp} tp={pcfg.tp})")
+    if ccfg.error_feedback and ccfg.grad_reduce != "reduce_scatter":
+        raise NotImplementedError(
+            "error_feedback requires grad_reduce='reduce_scatter' "
+            "(the residual rides the sharded train state)")
     dp_ax, pp_ax, tp_ax = pcfg.axis_names
     specs = gpt_mod.param_specs(cfg, pp=pp_ax, tp=tp_ax)
     data_spec = P(None, dp_ax, None)
+    db = ccfg.pipeline_double_buffer
 
     def grad_fn(params, tokens, labels):
         local_loss, grads = jax.value_and_grad(_pipeline_loss)(
-            params, tokens, labels, cfg, pcfg)
+            params, tokens, labels, cfg, pcfg, db)
         with _named_collective("psum_loss"):
+            comm_opt.record_collective("psum", jnp.float32, 4,
+                                       pcfg.n_devices)
             loss = jax.lax.psum(local_loss, pcfg.axis_names)
-        grads = psum_grads_by_spec(grads, specs, pcfg.axis_names)
+        grads = psum_grads_by_spec(
+            grads, specs, pcfg.axis_names,
+            comm_dtype=ccfg.comm_dtype, quant_chunk=ccfg.quant_chunk)
         return loss, grads
 
-    sharded_grad = shard_map_compat(
-        grad_fn, mesh,
-        in_specs=(specs, data_spec, data_spec),
-        out_specs=(P(), specs),
-    )
-
-    if fused_opt:
-        opt_specs = {"m": P(), "v": P(), "step": P()}
-    else:
-        opt_specs = {"m": specs, "v": specs, "step": P()}
     param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
                                       is_leaf=lambda x: isinstance(x, P))
-    opt_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), opt_specs,
-                                    is_leaf=lambda x: isinstance(x, P))
     data_sh = NamedSharding(mesh, data_spec)
-    update = _adamw_update_fused if fused_opt else _adamw_update
 
-    @partial(jax.jit,
-             in_shardings=(param_sh, opt_sh, data_sh, data_sh),
-             out_shardings=(param_sh, opt_sh, None, None),
-             donate_argnums=(0, 1))
-    def step(params, opt_state, tokens, labels):
-        # named scopes stamp the phase into HLO metadata: the merged
-        # host+device trace shows train/grad vs train/opt_update spans
-        with jax.named_scope("train/grad"):
-            loss, grads = sharded_grad(params, tokens, labels)
-        # optimizer update is elementwise: GSPMD partitions it with zero
-        # communication (replaces the reference's fuse_optimizer_ops pass)
-        with jax.named_scope("train/opt_update"):
-            params, opt_state, gnorm = update(
-                params, grads, opt_state, lr, weight_decay=weight_decay)
-        return params, opt_state, loss, gnorm
+    if ccfg.grad_reduce == "reduce_scatter":
+        step = _make_rs_step(cfg, pcfg, mesh, ccfg, lr, weight_decay,
+                             grad_clip, specs, param_sh, data_spec, data_sh,
+                             db)
+    else:
+        sharded_grad = shard_map_compat(
+            grad_fn, mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=(P(), specs),
+        )
+
+        if fused_opt:
+            opt_specs = {"m": P(), "v": P(), "step": P()}
+        else:
+            opt_specs = {"m": specs, "v": specs, "step": P()}
+        opt_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), opt_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        update = _adamw_update_fused if fused_opt else _adamw_update
+
+        @partial(jax.jit,
+                 in_shardings=(param_sh, opt_sh, data_sh, data_sh),
+                 out_shardings=(param_sh, opt_sh, None, None),
+                 donate_argnums=(0, 1))
+        def step(params, opt_state, tokens, labels):
+            # named scopes stamp the phase into HLO metadata: the merged
+            # host+device trace shows train/grad vs train/opt_update spans
+            with jax.named_scope("train/grad"):
+                loss, grads = sharded_grad(params, tokens, labels)
+            # optimizer update is elementwise: GSPMD partitions it with zero
+            # communication (replaces the reference's fuse_optimizer_ops pass)
+            with jax.named_scope("train/opt_update"):
+                params, opt_state, gnorm = update(
+                    params, grads, opt_state, lr,
+                    weight_decay=weight_decay, grad_clip=grad_clip)
+            return params, opt_state, loss, gnorm
 
     # Program-report capture (observability/program_report.py): the first
     # invocation lowers + compiles explicitly, keeps the executable as the
@@ -387,7 +665,9 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
 
     report_name = (f"parallel_train_step/dp{pcfg.dp}pp{pcfg.pp}tp{pcfg.tp}"
                    f"mb{pcfg.microbatches}"
-                   + ("_fused" if fused_opt else ""))
+                   + ("_fused" if fused_opt else "")
+                   + ("_rs" if ccfg.grad_reduce == "reduce_scatter" else "")
+                   + (f"_{ccfg.comm_dtype}" if ccfg.comm_dtype else ""))
     aot = {"exec": None, "failed": False}
 
     def step_with_report(params, opt_state, tokens, labels):
@@ -439,21 +719,51 @@ def make_forward(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh):
 
 
 def init_sharded(key, cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
-                 moment_dtype=None, fused_opt: bool = False):
+                 moment_dtype=None, fused_opt: bool = False,
+                 grad_reduce: str = "psum", bucket_mb: float = 32.0,
+                 error_feedback: bool = False, grad_allreduce_dtype=None,
+                 comm: Optional[CommConfig] = None):
     """Initialize params + AdamW state directly with mesh shardings (large
-    models never materialize unsharded)."""
+    models never materialize unsharded).
+
+    ``grad_reduce="reduce_scatter"`` (pass the same comm kwargs as
+    ``make_train_step``) lays the AdamW moments out as dp-sharded flat
+    megabuffers matching the comm_opt bucket layout — optimizer-state HBM
+    per device drops by dp x vs the replicated per-leaf layout."""
     specs = gpt_mod.param_specs(cfg, pp=pcfg.axis_names[1], tp=pcfg.axis_names[2])
     param_sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
                                       is_leaf=lambda x: isinstance(x, P))
+    ccfg = comm if comm is not None else CommConfig(
+        grad_reduce=grad_reduce, comm_dtype=grad_allreduce_dtype,
+        bucket_mb=bucket_mb, error_feedback=error_feedback)
+
+    init_jit = jax.jit(lambda k: gpt_mod.init_params(k, cfg),
+                       out_shardings=param_sh)
+    params = init_jit(key)
+
+    if ccfg.grad_reduce == "reduce_scatter":
+        layout, _, _ = _rs_param_layout(cfg, pcfg, ccfg)
+        n_dev = pcfg.n_devices
+        flat_sh = NamedSharding(mesh, P(tuple(pcfg.axis_names)))
+        mdt = moment_dtype or jnp.float32
+        shapes = {"m": ((n_dev * layout.shard_len,), mdt),
+                  "v": ((n_dev * layout.shard_len,), mdt),
+                  "step": ((), jnp.int32)}
+        opt_sh = {"m": flat_sh, "v": flat_sh,
+                  "step": NamedSharding(mesh, P())}
+        if ccfg.error_feedback:
+            shapes["ef"] = ((n_dev * layout.total_len,), jnp.float32)
+            opt_sh["ef"] = flat_sh
+        opt_jit = jax.jit(
+            lambda: {k: jnp.zeros(sh, dt) for k, (sh, dt) in shapes.items()},
+            out_shardings=opt_sh)
+        return params, opt_jit()
+
     if fused_opt:
         flat_sh = NamedSharding(mesh, P())
         opt_sh = {"m": flat_sh, "v": flat_sh, "step": None}
     else:
         opt_sh = {"m": param_sh, "v": param_sh, "step": None}
-
-    init_jit = jax.jit(lambda k: gpt_mod.init_params(k, cfg),
-                       out_shardings=param_sh)
-    params = init_jit(key)
     opt_jit = jax.jit(partial(init_adamw_state, moment_dtype=moment_dtype,
                               fused=fused_opt),
                       out_shardings=opt_sh)

@@ -342,6 +342,26 @@ class _CompiledPipelineBlock:
 
                 def tick(carry, t):
                     iface, loss_sum, fwd_state = carry
+                    # double-buffered stage boundary: the carry holds LAST
+                    # tick's un-permuted outputs, so their ppermute issues
+                    # at the head of this tick and the send is in flight
+                    # while this tick's stage body computes (async
+                    # collective-permute + latency-hiding scheduler,
+                    # sysconfig.tpu_perf_flags). Values are identical to
+                    # the permute-at-tail schedule — the permute commutes
+                    # with the scan carry.
+                    if S > 1:
+                        from . import comm_opt as _comm
+
+                        with jax.named_scope(
+                                "collective/ppermute_activation"):
+                            for _v in jax.tree_util.tree_leaves(iface):
+                                _comm.record_collective(
+                                    "ppermute", _v.dtype,
+                                    _v.size * _v.dtype.itemsize, S)
+                            iface = jax.tree_util.tree_map(
+                                lambda a: jax.lax.ppermute(a, "pp", perm),
+                                iface)
                     m = jnp.clip(t - stage, 0, M - 1)
                     feeds_mb = {
                         n: (jax.lax.dynamic_index_in_dim(f, m, 0,
@@ -393,10 +413,7 @@ class _CompiledPipelineBlock:
                         n: jnp.where(valid, new_fstate[n], fwd_state[n])
                         for n in fwd_written
                     }
-                    nxt = (jax.tree_util.tree_map(
-                        lambda a: jax.lax.ppermute(a, "pp", perm), out)
-                        if S > 1 else out)
-                    return (nxt, loss_sum, fwd_state), None
+                    return (out, loss_sum, fwd_state), None
 
                 carry0 = (zero_carry(),
                           jnp.zeros((), jnp.float32),

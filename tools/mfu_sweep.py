@@ -15,10 +15,18 @@ Usage:
   python tools/mfu_sweep.py --ce-chunk 0,1024 --fused-opt 0,1
   python tools/mfu_sweep.py --base d=64,L=2,nh=4,ff=128,T=32,b=4,steps=2,flash=0 \
       --ce-chunk 0,64 --fused-opt 0,1      # CPU-sized end-to-end run
+  # communication-lever axes (docs/comm_opt.md): cross the base config with
+  # the gradient-reduction strategy, the collective wire dtype, and the
+  # reduce-scatter bucket cap (dp>1 specs need that many devices)
+  python tools/mfu_sweep.py --base d=64,L=2,nh=4,ff=128,T=32,b=8,steps=2,flash=0,dp=8 \
+      --grad-reduce psum,reduce_scatter --comm-dtype f32,bf16 --bucket-mb 32
 
 Spec keys: b, steps, remat (none|full|dots|save_only_flash), bq, bk, nh, d,
 L, ff, T, flash, mom (f32|bf16), scan, celim, chunk (CE row chunk),
-vchunk (CE vocab chunk, 0 = off), fused (1 = flat-buffer fused optimizer).
+vchunk (CE vocab chunk, 0 = off), fused (1 = flat-buffer fused optimizer),
+dp (data-parallel ranks; b is the GLOBAL batch), gr (psum|reduce_scatter),
+cdt (f32|bf16|int8 collective wire dtype), bmb (bucket cap MiB),
+ef (1 = error-feedback residual for quantized comm).
 Every config's result is emitted as one machine-readable JSON row on stdout
 (the ranked human table follows after).
 """
@@ -32,8 +40,26 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _ensure_devices(specs):
+    """dp>1 specs need that many devices; on the host platform that means
+    forcing virtual devices BEFORE jax imports (no-op for real TPUs — the
+    flag only affects the host backend)."""
+    need = 1
+    for s in specs:
+        try:
+            need = max(need, int(dict(kv.split("=") for kv in
+                                      s.split(",")).get("dp", 1)))
+        except Exception:
+            pass
+    flags = os.environ.get("XLA_FLAGS", "")
+    if need > 1 and "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            f"{flags} --xla_force_host_platform_device_count={need}"
+
+
 def worker():
     sys.path.insert(0, REPO)
+    _ensure_devices([sys.argv[2]])
     import numpy as np
     import jax
 
@@ -45,6 +71,7 @@ def multi_worker(specs):
     under claim churn (see .claude/skills/verify/SKILL.md), so when it is
     healthy we measure everything in a single session."""
     sys.path.insert(0, REPO)
+    _ensure_devices(specs)
     import numpy as np
     import jax
 
@@ -78,6 +105,11 @@ def _measure_spec(spec_str, np, jax):
     mom = spec.get("mom", "f32")               # f32 | bf16 Adam moments
     scan = spec.get("scan", "1") == "1"        # 0 = unroll the layer loop
     fused = spec.get("fused", "0") == "1"      # flat-buffer fused optimizer
+    dp = int(spec.get("dp", 1))                # data-parallel ranks
+    grad_reduce = spec.get("gr", "psum")       # psum | reduce_scatter
+    comm_dtype = spec.get("cdt", "f32")        # f32 | bf16 | int8 wire dtype
+    bucket_mb = float(spec.get("bmb", 32))     # reduce-scatter bucket cap
+    error_fb = spec.get("ef", "0") == "1"      # quantized-comm residual
 
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
@@ -117,14 +149,19 @@ def _measure_spec(spec_str, np, jax):
     cfg = G.GPT_SMALL.scaled(**kw)
 
     dev = jax.devices()[0]
-    pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
-    mesh = PZ.build_mesh(pcfg, devices=[dev])
+    if batch % dp:
+        raise ValueError(f"global batch {batch} not divisible by dp={dp}")
+    pcfg = PZ.ParallelConfig(dp=dp, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg, devices=jax.devices()[:dp])
     import jax.numpy as jnp
+    comm_kw = dict(grad_reduce=grad_reduce, grad_allreduce_dtype=comm_dtype,
+                   bucket_mb=bucket_mb, error_feedback=error_fb)
     params, opt = PZ.init_sharded(
         jax.random.PRNGKey(0), cfg, pcfg, mesh,
         moment_dtype=jnp.bfloat16 if mom == "bf16" else None,
-        fused_opt=fused)
-    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4, fused_opt=fused)
+        fused_opt=fused, **comm_kw)
+    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4, fused_opt=fused,
+                              **comm_kw)
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
     labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
@@ -145,7 +182,8 @@ def _measure_spec(spec_str, np, jax):
     # v5e = 197e12 — 394 is its int8 rate; PEAK_PROBE.json holds the
     # measured 171.3 TFLOP/s matmul ceiling backing it)
     from bench import _peak_flops
-    mfu = tokens_per_s * (6 * n_params + attn) / _peak_flops(dev)
+    # dp ranks: tokens/s is global, so the denominator is dp x one chip
+    mfu = tokens_per_s * (6 * n_params + attn) / (_peak_flops(dev) * dp)
     print(json.dumps({"spec": spec_str, "tokens_per_s": round(tokens_per_s, 1),
                       "mfu": round(mfu, 4), "ms_per_step": round(dt / steps * 1e3, 1),
                       "compile_s": round(compile_s, 1),
@@ -195,13 +233,33 @@ def _flag_values(flag, default):
 
 
 def build_specs():
-    """The spec list for this invocation. --ce-chunk / --fused-opt cross the
-    base config (--base SPEC, default: the measured winner) with CE
-    vocab-chunk sizes and the fused flat-buffer optimizer."""
+    """The spec list for this invocation. --ce-chunk / --fused-opt /
+    --grad-reduce / --comm-dtype / --bucket-mb cross the base config
+    (--base SPEC, default: the measured winner) with CE vocab-chunk sizes,
+    the fused flat-buffer optimizer, and the communication levers."""
     if "--one" in sys.argv:
         return [sys.argv[sys.argv.index("--one") + 1]]
     ce_axis = _flag_values("--ce-chunk", ["0", "1024"])
     fused_axis = _flag_values("--fused-opt", ["0", "1"])
+    gr_axis = _flag_values("--grad-reduce", ["psum", "reduce_scatter"])
+    cdt_axis = _flag_values("--comm-dtype", ["f32", "bf16"])
+    bmb_axis = _flag_values("--bucket-mb", ["32"])
+    if gr_axis or cdt_axis or bmb_axis:
+        base = (sys.argv[sys.argv.index("--base") + 1]
+                if "--base" in sys.argv else _WINNER_BASE)
+        specs = []
+        for gr in (gr_axis or [None]):
+            for cdt in (cdt_axis or [None]):
+                for bmb in (bmb_axis or [None]):
+                    s = base
+                    if gr is not None:
+                        s += f",gr={gr}"
+                    if cdt is not None and cdt != "f32":
+                        s += f",cdt={cdt}"
+                    if bmb is not None and gr == "reduce_scatter":
+                        s += f",bmb={bmb}"
+                    specs.append(s)
+        return specs
     if ce_axis is None and fused_axis is None:
         # default sweep = the measured-winner neighborhood (KERNEL_NOTES
         # session-4 table: 0.7168 at b=16 dots + bf16 moments) + its two

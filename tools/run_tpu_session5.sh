@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-6 chip session 5: the communication lane (docs/comm_opt.md) plus the
+# still-queued matched dots-vs-full remat A/B from session 3.
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session5.sh > tpu_s5.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== [1/4] matched dots-vs-full remat A/B (queued since s3) $(date -u +%H:%M:%S) ==="
+# identical batch/celim so the pair is a controlled A/B (KERNEL_NOTES.md
+# round-5 carried only the uncontrolled hint); verdict goes to KERNEL_NOTES
+python tools/mfu_sweep.py --multi \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824,steps=8" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=full,celim=1073741824,steps=8" \
+  | tee -a MFU_SWEEP.json
+echo "=== remat A/B rc=${PIPESTATUS[0]} ==="
+
+echo "=== [2/4] comm bench: single-chip control $(date -u +%H:%M:%S) ==="
+# dp=1 on the real chip: no wire, but validates the rs/quantized paths
+# compile + run on hardware (Mosaic/XLA TPU lowering of all_to_all etc.)
+python tools/comm_bench.py --dp 1 --steps 5 --d 512 --layers 4 --T 256 \
+  --out COMM_BENCH_tpu_dp1.json
+echo "=== comm dp1 rc=$? ==="
+
+echo "=== [3/4] comm bench: multi-chip lane (needs a dp>=4 claim) $(date -u +%H:%M:%S) ==="
+# the headline A/B: psum vs reduce-scatter vs bf16 wire on real ICI with the
+# tpu_perf_flags preset active — step time + measured overlap fraction
+python tools/comm_bench.py --dp 4 --steps 8 --d 2048 --layers 6 --T 1024 \
+  --batch 32 --profile-overlap --out COMM_BENCH_tpu.json
+echo "=== comm dp4 rc=$? ==="
+
+echo "=== [4/4] mfu sweep comm axes at the winner config $(date -u +%H:%M:%S) ==="
+python tools/mfu_sweep.py --multi \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,steps=8,dp=4,gr=psum" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,steps=8,dp=4,gr=reduce_scatter" \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,mom=bf16,celim=1073741824,steps=8,dp=4,gr=reduce_scatter,cdt=bf16" \
+  | tee -a MFU_SWEEP.json
+echo "=== comm sweep rc=${PIPESTATUS[0]} ==="
+date -u > .tpu_s5_done
