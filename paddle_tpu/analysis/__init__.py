@@ -1,0 +1,43 @@
+"""Program IR static analysis: verifier + comm-safety linter (ISSUE 6).
+
+See docs/static_analysis.md for the checker catalog and severity policy.
+
+    from paddle_tpu import analysis
+    result = analysis.analyze_program(program, fetch_names=["loss"])
+    assert result.ok, result.format()
+
+Checkers (all registered on import):
+
+- ``program_verifier`` — def-before-use, dangling reads, feed/fetch/
+  persistable consistency, dead vars;
+- ``shape_dtype``     — declared vs propagated output avals (registry
+  ``infer_shape`` specs, ``jax.eval_shape`` fallback);
+- ``comm_safety``     — cross-rank collective order/axis/dtype matching,
+  conditional collectives, unmapped rings, bucket-plan divergence;
+- ``donation``        — use-after-donate against the executor/AOT
+  donation maps;
+- ``precision``       — sub-f32 reductions/accumulations without opt-in;
+- ``recompile_risk``  — static prediction of the PR 4 recompile causes.
+"""
+from .core import (ERROR, INFO, SEVERITIES, WARNING,  # noqa: F401
+                   AnalysisContext, AnalysisResult, Finding,
+                   all_checkers, analyze_program, get_checker,
+                   register_checker)
+from .collectives import check_bucket_layouts  # noqa: F401
+from .donation import derive_donated  # noqa: F401
+from .lint import (format_model_results, lint_all_models,  # noqa: F401
+                   lint_model, lint_program)
+from .model_corpus import (MODEL_BUILDERS, build_model_program,  # noqa: F401
+                           model_names)
+from .precision import check_comm_config  # noqa: F401
+from .shapes import propagate_block  # noqa: F401
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "SEVERITIES",
+    "Finding", "AnalysisContext", "AnalysisResult",
+    "analyze_program", "register_checker", "all_checkers", "get_checker",
+    "lint_program", "lint_model", "lint_all_models",
+    "format_model_results", "model_names", "build_model_program",
+    "MODEL_BUILDERS", "check_bucket_layouts", "check_comm_config",
+    "derive_donated", "propagate_block",
+]

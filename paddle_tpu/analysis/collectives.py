@@ -1,0 +1,208 @@
+"""Checker 3 — collective comm-safety: prove all ranks issue collectives
+in the same order with matched axes/ring-ids/dtypes.
+
+Deadlocks in SPMD programs are ordering bugs: rank 0 enters allreduce A
+while rank 1 enters allreduce B and both wait forever (the reference hits
+this as NCCL hangs; on TPU it is an ICI stall with no error). Statically,
+a fluid multi-rank job is a set of per-rank transpiled programs
+(transpiler/collective.py emits one per rank) — so the checker extracts
+each rank's ordered collective signature and diffs them. Three more
+silent-failure modes ride along:
+
+- a collective under data-dependent control flow (``conditional_block`` /
+  ``while`` sub-blocks): rank-divergent predicates deadlock;
+- a ``ring_id`` with no mesh-axis mapping: ops/collective.py lowers it to
+  IDENTITY (1-rank-ring semantics) — gradients silently stop syncing;
+- rank-divergent ``comm_opt`` bucket plans: the flat reduce-scatter
+  exchanges raw buffers, so two ranks disagreeing on bucket boundaries
+  accumulate garbage without any shape error
+  (:func:`check_bucket_layouts`).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .core import (ERROR, INFO, WARNING, AnalysisContext, Finding,
+                   register_checker)
+
+# ops with communication semantics, in-order-matched across ranks.
+# Bootstrap/no-op types (c_comm_init, c_gen_nccl_id, c_sync_*) exchange
+# nothing and are excluded from order matching.
+COMM_OPS = {
+    "c_allreduce_sum", "c_allreduce_avg", "c_allreduce_max",
+    "c_allreduce_min", "c_allreduce_prod", "c_allgather",
+    "c_reducescatter", "c_broadcast", "c_concat", "c_split",
+    "allreduce", "broadcast", "dgc_momentum",
+}
+
+_CTRL_FLOW_OPS = {"while", "conditional_block", "conditional_block_infer",
+                  "recurrent", "dynamic_rnn"}
+
+
+def _collective_sig(program) -> List[Tuple[int, int, str, str, str, tuple]]:
+    """Ordered (block_idx, op_idx, type, ring_id, dtype, shape) of every
+    comm op in program order (block 0 then sub-blocks in index order —
+    matching execution order for straight-line block-0 programs, which is
+    what the transpilers emit)."""
+    sig = []
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            if op.type not in COMM_OPS:
+                continue
+            ring = op.attr("ring_id", 0)
+            names = op.input("X") or [n for ns in op.inputs.values()
+                                      for n in ns]
+            dtype, shape = "?", ()
+            if names and block._has_var_recursive(names[0]):
+                v = block._var_recursive(names[0])
+                dtype, shape = v.dtype, tuple(v.shape)
+            sig.append((block.idx, i, op.type, int(ring), dtype, shape))
+    return sig
+
+
+def check_bucket_layouts(layouts: Sequence[Any],
+                         checker: str = "comm_safety") -> List[Finding]:
+    """Cross-rank consistency of ``comm_opt.BucketLayout`` plans: the
+    flat reduce-scatter path exchanges raw flat buffers, so every rank
+    must agree on bucket count, per-bucket dtype/size, and entry order."""
+    findings: List[Finding] = []
+    if len(layouts) < 2:
+        return findings
+    ref = layouts[0]
+    for r, lay in enumerate(layouts[1:], start=1):
+        if len(lay.buckets) != len(ref.buckets):
+            findings.append(Finding(
+                checker=checker, code="bucket_count_divergence",
+                severity=ERROR,
+                message=f"rank {r} builds {len(lay.buckets)} comm buckets "
+                        f"vs rank 0's {len(ref.buckets)} — the flat "
+                        "reduce-scatter would exchange misaligned buffers"))
+            continue
+        for bi, (a, b) in enumerate(zip(ref.buckets, lay.buckets)):
+            if (a.dtype, a.size) != (b.dtype, b.size):
+                findings.append(Finding(
+                    checker=checker, code="bucket_layout_divergence",
+                    severity=ERROR,
+                    message=f"bucket {bi} diverges between rank 0 "
+                            f"({a.dtype}[{a.size}]) and rank {r} "
+                            f"({b.dtype}[{b.size}]) — rank-divergent "
+                            "bucket layout accumulates garbage silently"))
+            elif a.entries != b.entries:
+                findings.append(Finding(
+                    checker=checker, code="bucket_entry_divergence",
+                    severity=ERROR,
+                    message=f"bucket {bi} packs leaves in a different "
+                            f"order on rank {r} than on rank 0 — "
+                            "gradients would be summed against the wrong "
+                            "parameters"))
+    return findings
+
+
+@register_checker("comm_safety")
+def check_collectives(ctx: AnalysisContext):
+    program = ctx.program
+    findings: List[Finding] = []
+
+    # ring_id -> axis mapping the executor would use for this program
+    ring_axes = {}
+    ann = program._annotations.get("mesh")
+    if isinstance(ann, dict):
+        ring_axes = dict(ann.get("ring_axes", {}) or {})
+    has_mesh = ann is not None or bool(ring_axes)
+
+    # sub-blocks owned by control-flow ops (conditional collectives)
+    ctrl_blocks = set()
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in _CTRL_FLOW_OPS:
+                sb = op.attr("sub_block")
+                if sb is not None:
+                    ctrl_blocks.add(int(sb))
+    # transitively: a sub-block of a conditional sub-block is conditional
+    changed = True
+    while changed:
+        changed = False
+        for block in program.blocks:
+            if block.idx in ctrl_blocks:
+                for op in block.ops:
+                    sb = op.attr("sub_block")
+                    if sb is not None and int(sb) not in ctrl_blocks:
+                        ctrl_blocks.add(int(sb))
+                        changed = True
+
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            if op.type not in COMM_OPS:
+                continue
+            if block.idx in ctrl_blocks:
+                findings.append(Finding(
+                    checker="comm_safety", code="conditional_collective",
+                    severity=ERROR, block_idx=block.idx, op_idx=i,
+                    op_type=op.type,
+                    message=f"collective {op.type!r} sits under "
+                            "data-dependent control flow — a rank-"
+                            "divergent predicate deadlocks the mesh"))
+            ring = int(op.attr("ring_id", 0))
+            if has_mesh and ring_axes and ring not in ring_axes:
+                findings.append(Finding(
+                    checker="comm_safety", code="unmapped_ring",
+                    severity=WARNING, block_idx=block.idx, op_idx=i,
+                    op_type=op.type,
+                    message=f"ring_id {ring} has no mesh-axis mapping "
+                            f"(known rings: {sorted(ring_axes)}) — the "
+                            "lowering degrades to identity and this "
+                            "collective silently stops communicating"))
+
+    # cross-rank order matching against peer programs
+    if ctx.peer_programs:
+        ref_sig = _collective_sig(program)
+        for r, peer in enumerate(ctx.peer_programs, start=1):
+            peer_sig = _collective_sig(peer)
+            n = min(len(ref_sig), len(peer_sig))
+            diverged = False
+            for k in range(n):
+                (_, op_idx, t0, ring0, dt0, _s0) = ref_sig[k]
+                (_, _, t1, ring1, dt1, _s1) = peer_sig[k]
+                if t0 != t1:
+                    findings.append(Finding(
+                        checker="comm_safety",
+                        code="collective_order_divergence",
+                        severity=ERROR, op_idx=op_idx, op_type=t0,
+                        message=f"collective #{k} is {t0!r} on rank 0 but "
+                                f"{t1!r} on rank {r} — mismatched order "
+                                "deadlocks the mesh"))
+                    diverged = True
+                    break
+                if ring0 != ring1:
+                    findings.append(Finding(
+                        checker="comm_safety",
+                        code="collective_axis_divergence",
+                        severity=ERROR, op_idx=op_idx, op_type=t0,
+                        message=f"collective #{k} ({t0}) uses ring_id "
+                                f"{ring0} on rank 0 but {ring1} on rank "
+                                f"{r} — ranks would wait on different "
+                                "rings"))
+                    diverged = True
+                    break
+                if dt0 != dt1:
+                    findings.append(Finding(
+                        checker="comm_safety",
+                        code="collective_dtype_divergence",
+                        severity=ERROR, op_idx=op_idx, op_type=t0,
+                        message=f"collective #{k} ({t0}) exchanges {dt0} "
+                                f"on rank 0 but {dt1} on rank {r} — "
+                                "byte counts differ across ranks"))
+                    diverged = True
+                    break
+            if not diverged and len(ref_sig) != len(peer_sig):
+                findings.append(Finding(
+                    checker="comm_safety",
+                    code="collective_count_divergence",
+                    severity=ERROR,
+                    message=f"rank 0 issues {len(ref_sig)} collectives but "
+                            f"rank {r} issues {len(peer_sig)} — the excess "
+                            "ranks hang waiting for peers that already "
+                            "returned"))
+
+    findings.extend(check_bucket_layouts(ctx.bucket_layouts))
+    return findings

@@ -1,0 +1,262 @@
+"""Program IR static-analysis framework: one walker, a checker registry,
+and typed findings.
+
+The reference Paddle validates programs op-by-op at build time in C++
+(``InferShape`` / ``InferVarType`` inside OpDesc construction,
+framework/op_desc.cc, plus graph passes under framework/ir/). Our
+trace-to-XLA design has no per-op kernel boundary to hang those checks on
+— a malformed ProgramDesc surfaces as a cryptic trace-time exception, and
+an inconsistent collective lowering as a multi-rank hang. This package is
+the replacement: a pure-metadata pass over the Program IR that runs in
+milliseconds, BEFORE anything is traced or compiled.
+
+Three entry points share it (docs/static_analysis.md):
+
+- ``tools/paddle_lint.py`` — CLI; ``--all-models`` runs every built-in
+  model program (``analysis/model_corpus.py``) and exits non-zero on
+  error-severity findings;
+- ``Executor.run`` — pre-compile hook behind ``FLAGS_check_program``
+  (checked once per program version, never on the dispatch fast path);
+- ``tests/test_static_analysis.py`` — the pytest gate: built-in programs
+  must be error-clean, and each seeded bad-program fixture must fire its
+  checker.
+
+Severity policy:
+
+- **error** — the program is wrong: it will crash at trace time, hang a
+  multi-rank job, or silently compute the wrong thing. Gates exit
+  non-zero; the executor hook raises.
+- **warning** — legal but almost certainly not what you meant (sub-f32
+  accumulation, recompile churn, donated-state aliasing). Logged, counted.
+- **info** — observations that feed other tooling (dead vars, inference
+  coverage gaps). Hidden by default in the CLI.
+
+Every finding increments ``paddle_lint_findings_total{severity}`` in the
+observability registry, so lint noise shows up in the same Prometheus /
+JSONL pipeline as the runtime telemetry (tools/metrics_check.py gates it).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+from ..observability import metrics as _obs_metrics
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "SEVERITIES",
+    "Finding", "AnalysisContext", "AnalysisResult",
+    "register_checker", "all_checkers", "get_checker", "analyze_program",
+    "op_reads", "op_writes", "iter_block_ops",
+]
+
+# severities, ordered: gates compare with SEVERITIES.index
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+SEVERITIES = (INFO, WARNING, ERROR)
+
+_m_findings = _obs_metrics.default_registry().counter(
+    "paddle_lint_findings_total",
+    "Static-analysis findings by severity (paddle_tpu.analysis)",
+    ("severity",))
+
+
+@dataclasses.dataclass
+class Finding:
+    """One static-analysis finding, anchored to an op and/or var."""
+
+    checker: str                    # registered checker name
+    code: str                       # stable machine code, e.g. "use_before_def"
+    severity: str                   # error | warning | info
+    message: str                    # human-readable, self-contained
+    block_idx: int = 0
+    op_idx: Optional[int] = None    # index into block.ops (None = whole block)
+    op_type: Optional[str] = None
+    var: Optional[str] = None       # offending variable name, if any
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}")
+
+    @property
+    def location(self) -> str:
+        loc = f"block {self.block_idx}"
+        if self.op_idx is not None:
+            loc += f" op {self.op_idx}"
+            if self.op_type:
+                loc += f" ({self.op_type})"
+        if self.var:
+            loc += f" var {self.var!r}"
+        return loc
+
+    def format(self) -> str:
+        return (f"[{self.severity.upper():7s}] {self.checker}:{self.code} "
+                f"@ {self.location} — {self.message}")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class AnalysisContext:
+    """Everything a checker may consult beyond the program itself.
+
+    ``peer_programs`` holds the OTHER ranks' programs for SPMD order
+    matching (transpiler output is one program per rank); ``donated`` is
+    the executable's donation map when the caller has one (PR 4 program
+    reports carry it) — otherwise checkers re-derive it from the IR the
+    same way the executor does; ``bucket_layouts`` are per-rank
+    ``comm_opt.BucketLayout`` plans for the bucket-consistency check.
+    """
+
+    def __init__(self, program, feed_names: Sequence[str] = (),
+                 fetch_names: Sequence[str] = (),
+                 peer_programs: Sequence[Any] = (),
+                 donated: Optional[Sequence[str]] = None,
+                 bucket_layouts: Sequence[Any] = (),
+                 flags: Optional[Dict[str, Any]] = None):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.peer_programs = list(peer_programs)
+        self.donated = list(donated) if donated is not None else None
+        self.bucket_layouts = list(bucket_layouts)
+        if flags is None:
+            from ..framework.core import flags_snapshot
+
+            flags = flags_snapshot()
+        self.flags = flags
+
+
+class AnalysisResult:
+    """Ordered findings + convenience filters/summary."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = list(findings)
+
+    def _sev(self, sev: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity == sev]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return self._sev(ERROR)
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return self._sev(WARNING)
+
+    @property
+    def infos(self) -> List[Finding]:
+        return self._sev(INFO)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def by_checker(self, name: str) -> List[Finding]:
+        return [f for f in self.findings if f.checker == name]
+
+    def counts(self) -> Dict[str, int]:
+        return {sev: len(self._sev(sev)) for sev in SEVERITIES}
+
+    def summary(self) -> str:
+        c = self.counts()
+        return (f"{c[ERROR]} error(s), {c[WARNING]} warning(s), "
+                f"{c[INFO]} info")
+
+    def format(self, min_severity: str = WARNING) -> str:
+        floor = SEVERITIES.index(min_severity)
+        lines = [f.format() for f in self.findings
+                 if SEVERITIES.index(f.severity) >= floor]
+        return "\n".join(lines + [self.summary()])
+
+    def __repr__(self):
+        return f"AnalysisResult({self.summary()})"
+
+
+# ---------------------------------------------------------------------------
+# Checker registry
+# ---------------------------------------------------------------------------
+
+CheckerFn = Callable[[AnalysisContext], Iterable[Finding]]
+
+_CHECKERS: "Dict[str, CheckerFn]" = {}
+
+
+def register_checker(name: str):
+    """Decorator: ``@register_checker("program_verifier")``.  A checker is
+    ``fn(ctx: AnalysisContext) -> Iterable[Finding]`` and must never
+    mutate the program (restore anything it touches)."""
+
+    def deco(fn: CheckerFn):
+        _CHECKERS[name] = fn
+        fn.checker_name = name
+        return fn
+
+    return deco
+
+
+def all_checkers() -> List[str]:
+    _load_builtin_checkers()
+    return sorted(_CHECKERS)
+
+
+def get_checker(name: str) -> CheckerFn:
+    _load_builtin_checkers()
+    return _CHECKERS[name]
+
+
+def _load_builtin_checkers():
+    # import for side effect (registration); idempotent
+    from . import (collectives, donation, precision,  # noqa: F401
+                   recompile, shapes, verifier)
+
+
+def analyze_program(program, feed_names: Sequence[str] = (),
+                    fetch_names: Sequence[str] = (),
+                    checkers: Optional[Sequence[str]] = None,
+                    **ctx_kwargs) -> AnalysisResult:
+    """Run ``checkers`` (default: all registered) over one program.
+
+    Findings are ordered (checker registration order, then program order)
+    and counted into ``paddle_lint_findings_total{severity}``. A checker
+    that raises is reported as an error-severity ``checker_crash`` finding
+    instead of taking the analysis down — the linter must stay usable on
+    programs weirder than its authors imagined.
+    """
+    _load_builtin_checkers()
+    ctx = AnalysisContext(program, feed_names=feed_names,
+                          fetch_names=fetch_names, **ctx_kwargs)
+    names = list(checkers) if checkers is not None else all_checkers()
+    findings: List[Finding] = []
+    for name in names:
+        fn = _CHECKERS[name]
+        try:
+            findings.extend(fn(ctx))
+        except Exception as e:  # pragma: no cover - defensive
+            findings.append(Finding(
+                checker=name, code="checker_crash", severity=ERROR,
+                message=f"checker raised {type(e).__name__}: {e}"))
+    for f in findings:
+        _m_findings.labels(f.severity).inc()
+    return AnalysisResult(findings)
+
+
+# ---------------------------------------------------------------------------
+# Walker helpers shared by checkers
+# ---------------------------------------------------------------------------
+
+def op_reads(op) -> List[str]:
+    return [n for names in op.inputs.values() for n in names
+            if n and n != "@EMPTY@"]
+
+
+def op_writes(op) -> List[str]:
+    return [n for names in op.outputs.values() for n in names
+            if n and n != "@EMPTY@"]
+
+
+def iter_block_ops(program):
+    """Yield (block, op_idx, op) over every block in index order."""
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            yield block, i, op

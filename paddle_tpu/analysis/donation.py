@@ -1,0 +1,134 @@
+"""Checker 4 — donation / use-after-donate.
+
+The executor donates every written persistable's buffer into the compiled
+call (``_CompiledBlock``: ``donate_argnums`` on the mutable-param dict),
+so after an in-place update the PRE-update value is gone — the donated
+HBM now holds the new state. Two hazards are statically visible in the
+IR:
+
+- an op ordered AFTER the optimizer's in-place update of a param reads
+  that param while itself belonging to the forward/backward region
+  (op_role bitmask): with donated buffers it silently consumes the
+  POST-update value, i.e. gradients computed against the wrong weights
+  (the reference caught this class with its SSA-graph dependency pass;
+  here op order in the block IS the schedule);
+- an AOT donation map (PR 4 program reports record ``donated``) listing a
+  var the IR never writes back: the call would delete the scope array and
+  produce no replacement — the next step crashes on a dead buffer.
+
+Fetching donated state is legal but aliased (the executor inserts a
+defensive device copy, executor.py ``_fetch_copy_idx``); it is reported
+as INFO so AOT embedders know to do the same.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .core import (ERROR, INFO, WARNING, AnalysisContext, Finding,
+                   op_reads, op_writes, register_checker)
+
+
+def derive_donated(program) -> List[str]:
+    """The donation map the executor would build: persistables read from
+    scope AND written back by block-0 ops (executor._analyze_persistables
+    read ∩ written — exactly ``_CompiledBlock._mutable_names``)."""
+    from ..framework.executor import _analyze_persistables
+
+    read, written = _analyze_persistables(program)
+    ws = set(written)
+    return [n for n in read if n in ws]
+
+
+def _role(op) -> int:
+    try:
+        return int(op.attr("op_role", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+@register_checker("donation")
+def check_donation(ctx: AnalysisContext):
+    from ..framework.executor import _analyze_persistables
+    from ..framework.program import Program
+
+    program = ctx.program
+    findings: List[Finding] = []
+    read, written = _analyze_persistables(program)
+    written_set = set(written)
+    ir_donated = [n for n in read if n in written_set]
+
+    # the AOT donation map (when the caller has one) must agree with the IR
+    if ctx.donated is not None:
+        for name in ctx.donated:
+            gb = program.global_block()
+            if not gb._has_var_recursive(name):
+                # pure-JAX executables (parallelize.make_train_step) donate
+                # pytree roots like "params" that are not IR vars — skip
+                continue
+            if name not in written_set:
+                findings.append(Finding(
+                    checker="donation", code="donated_never_rewritten",
+                    severity=ERROR, block_idx=0, var=name,
+                    message=f"executable donates {name!r} but no op writes "
+                            "it back — after the call the scope holds a "
+                            "deleted buffer and the next step crashes"))
+
+    donated = set(ctx.donated) & written_set if ctx.donated is not None \
+        else set(ir_donated)
+
+    OPT_ROLES = Program.OP_ROLE_OPTIMIZE
+    FWD_BWD_MASK = Program.OP_ROLE_BACKWARD
+
+    block = program.global_block()
+    first_opt_write: Dict[str, int] = {}
+    for i, op in enumerate(block.ops):
+        role = _role(op)
+        if role & OPT_ROLES:
+            for n in op_writes(op):
+                if n in donated:
+                    first_opt_write.setdefault(n, i)
+            continue
+        # forward/backward/unspecified op reading a param that an earlier
+        # optimizer op already updated in place
+        for n in op_reads(op):
+            j = first_opt_write.get(n)
+            if j is not None:
+                sev = ERROR if role & FWD_BWD_MASK or role == 0 else WARNING
+                findings.append(Finding(
+                    checker="donation", code="use_after_donate",
+                    severity=sev, block_idx=0, op_idx=i, op_type=op.type,
+                    var=n,
+                    message=f"op reads {n!r} after op {j} updated it in "
+                            "place — the donated buffer holds the POST-"
+                            "update value, the pre-update value is gone "
+                            "(gradients/stats computed against the wrong "
+                            "weights)"))
+
+    # double in-place update of one donated buffer in a single step:
+    # legal (env rebinds), but the intermediate state is unobservable and
+    # usually indicates a transpile stacked two optimizers
+    writers: Dict[str, List[int]] = {}
+    for i, op in enumerate(block.ops):
+        if _role(op) & OPT_ROLES:
+            for n in op_writes(op):
+                if n in donated:
+                    writers.setdefault(n, []).append(i)
+    for n, idxs in sorted(writers.items()):
+        if len(idxs) > 1:
+            findings.append(Finding(
+                checker="donation", code="double_update",
+                severity=WARNING, block_idx=0, op_idx=idxs[1],
+                var=n,
+                message=f"{n!r} is updated in place by ops {idxs} within "
+                        "one step — stacked optimizer writes on one "
+                        "donated buffer"))
+
+    for name in ctx.fetch_names:
+        if name in donated:
+            findings.append(Finding(
+                checker="donation", code="fetch_of_donated",
+                severity=INFO, block_idx=0, var=name,
+                message=f"fetch {name!r} aliases donated state; the "
+                        "executor copies it defensively, AOT embedders "
+                        "must do the same before the next step"))
+    return findings

@@ -1,0 +1,64 @@
+"""Lint driver: run the full checker suite over one program or the whole
+built-in model corpus. Shared by tools/paddle_lint.py, the Executor's
+``FLAGS_check_program`` hook, and tests/test_static_analysis.py.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .core import (ERROR, SEVERITIES, WARNING, AnalysisResult,
+                   analyze_program)
+from .model_corpus import ModelProgram, build_model_program, model_names
+
+__all__ = ["lint_program", "lint_model", "lint_all_models",
+           "format_model_results"]
+
+
+def lint_program(program, feed_names: Sequence[str] = (),
+                 fetch_names: Sequence[str] = (), **kw) -> AnalysisResult:
+    """All checkers over one program (thin alias of analyze_program)."""
+    return analyze_program(program, feed_names=feed_names,
+                           fetch_names=fetch_names, **kw)
+
+
+def lint_model(mp: ModelProgram) -> Dict[str, AnalysisResult]:
+    """Lint one built model: the main program (with its startup as
+    context-free sibling) plus any extra programs (PS pserver side)."""
+    out = {mp.name: analyze_program(
+        mp.main, feed_names=mp.feed_names, fetch_names=mp.fetch_names,
+        peer_programs=mp.peer_programs)}
+    if mp.startup is not None:
+        out[f"{mp.name}.startup"] = analyze_program(mp.startup)
+    for key, prog in sorted(mp.extra.items()):
+        out[f"{mp.name}.{key}"] = analyze_program(prog)
+    return out
+
+
+def lint_all_models(names: Optional[Sequence[str]] = None
+                    ) -> Dict[str, AnalysisResult]:
+    results: Dict[str, AnalysisResult] = {}
+    for name in (names or model_names()):
+        results.update(lint_model(build_model_program(name)))
+    return results
+
+
+def format_model_results(results: Dict[str, AnalysisResult],
+                         min_severity: str = WARNING,
+                         verbose: bool = False) -> str:
+    lines: List[str] = []
+    floor = SEVERITIES.index(min_severity)
+    width = max((len(n) for n in results), default=8)
+    for name in sorted(results):
+        res = results[name]
+        c = res.counts()
+        verdict = "FAIL" if c[ERROR] else "ok"
+        lines.append(f"{name:<{width}}  {verdict:>4}  "
+                     f"errors={c['error']} warnings={c['warning']} "
+                     f"info={c['info']}")
+        for f in res.findings:
+            if verbose or SEVERITIES.index(f.severity) >= floor:
+                lines.append(f"  {f.format()}")
+    total_err = sum(len(r.errors) for r in results.values())
+    lines.append(f"linted {len(results)} program(s): "
+                 f"{total_err} error(s) total")
+    return "\n".join(lines)

@@ -1,0 +1,211 @@
+"""Built-in model programs for the lint gate.
+
+Every program family the framework ships is built here in a small
+configuration and handed to the checkers: the CLI (``tools/paddle_lint.py
+--all-models``) and the pytest gate (tests/test_static_analysis.py) both
+demand zero error-severity findings on each of them, so any checker
+regression or program-builder regression trips tier-1.
+
+Builders construct under fresh ``Program``/``unique_name`` guards and
+never execute anything — transpiled PS programs include
+``listen_and_serv``/``send``/``recv`` host ops but no server is started.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["MODEL_BUILDERS", "build_model_program", "model_names",
+           "ModelProgram"]
+
+
+class ModelProgram:
+    """One built program + the feed/fetch context the checkers need."""
+
+    def __init__(self, name, main, startup=None, feed_names=(),
+                 fetch_names=(), peer_programs=(), extra=None):
+        self.name = name
+        self.main = main
+        self.startup = startup
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.peer_programs = list(peer_programs)
+        self.extra = extra or {}
+
+
+def _fluid():
+    import paddle_tpu as fluid
+
+    return fluid
+
+
+def _guarded(build):
+    """Run a builder under fresh program + unique-name guards."""
+    fluid = _fluid()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            out = build(fluid)
+    return main, startup, out
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def build_mlp() -> ModelProgram:
+    def b(fluid):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        return loss
+
+    main, startup, loss = _guarded(b)
+    return ModelProgram("mlp", main, startup, ["x", "y"], [loss.name])
+
+
+def build_gpt() -> ModelProgram:
+    """Static-graph GPT-style LM head: embedding -> fc stack -> tied
+    vocab projection -> softmax CE (the flagship decoder itself is the
+    pure-JAX models/gpt.py; this is its fluid-program counterpart at lint
+    scale)."""
+    def b(fluid):
+        V, T, D = 64, 8, 32
+        tok = fluid.layers.data("tokens", [T], dtype="int64")
+        lbl = fluid.layers.data("labels", [T, 1], dtype="int64")
+        emb = fluid.layers.embedding(tok, size=[V, D],
+                                     param_attr=fluid.ParamAttr("wte"))
+        h = fluid.layers.fc(emb, D, num_flatten_dims=2, act="relu")
+        h = fluid.layers.fc(h, D, num_flatten_dims=2, act="relu")
+        logits = fluid.layers.fc(h, V, num_flatten_dims=2)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+        return loss
+
+    main, startup, loss = _guarded(b)
+    return ModelProgram("gpt", main, startup, ["tokens", "labels"],
+                        [loss.name])
+
+
+def build_ernie() -> ModelProgram:
+    """The ERNIE program shape: the fluid transformer encoder classifier
+    (models/transformer_encoder.py — the static counterpart of
+    models/ernie.py)."""
+    def b(fluid):
+        from paddle_tpu.models.transformer_encoder import (
+            transformer_encoder_classifier)
+
+        V, T = 32, 8
+        src = fluid.layers.data("src", [T], dtype="int64")
+        pos = fluid.layers.data("pos", [T], dtype="int64")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss, _logits = transformer_encoder_classifier(
+            src, pos, label, vocab_size=V, max_pos=T, num_layers=2,
+            num_heads=4, d_model=32, d_ff=64, num_classes=2)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+        return loss
+
+    main, startup, loss = _guarded(b)
+    return ModelProgram("ernie", main, startup, ["src", "pos", "label"],
+                        [loss.name])
+
+
+def build_resnet() -> ModelProgram:
+    def b(fluid):
+        from paddle_tpu.models.resnet import resnet
+
+        img = fluid.layers.data("image", [3, 32, 32], dtype="float32")
+        lbl = fluid.layers.data("label", [1], dtype="int64")
+        logits = resnet(img, class_dim=10, depth=18)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+        return loss
+
+    main, startup, loss = _guarded(b)
+    return ModelProgram("resnet", main, startup, ["image", "label"],
+                        [loss.name])
+
+
+def build_pipeline() -> ModelProgram:
+    def b(fluid):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h1 = fluid.layers.fc(x, 16, act="relu")
+        h2 = fluid.layers.fc(h1, 16, act="relu")
+        pred = fluid.layers.fc(h2, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.05), num_stages=2,
+            num_microbatches=2).minimize(loss)
+        return loss
+
+    main, startup, loss = _guarded(b)
+    return ModelProgram("pipeline", main, startup, ["x", "y"], [loss.name])
+
+
+def build_grad_merge() -> ModelProgram:
+    def b(fluid):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.MomentumOptimizer(0.1, 0.9),
+            k_steps=2).minimize(loss)
+        return loss
+
+    main, startup, loss = _guarded(b)
+    return ModelProgram("grad_merge", main, startup, ["x", "y"],
+                        [loss.name])
+
+
+def build_ps_transpiled() -> ModelProgram:
+    """DistributeTranspiler output: the trainer program (send/recv host
+    ops) is the primary; the pserver program rides in ``extra`` and is
+    linted separately by the gate."""
+    from paddle_tpu.transpiler.distribute_transpiler import (
+        DistributeTranspiler)
+
+    def b(fluid):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        return loss
+
+    main, startup, loss = _guarded(b)
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers="127.0.0.1:0",
+                trainers=1, sync_mode=True)
+    trainer = t.get_trainer_program(wait_port=False)
+    pserver = t.get_pserver_program("127.0.0.1:0")
+    return ModelProgram("ps_transpiled", trainer, startup, ["x", "y"],
+                        [loss.name], extra={"pserver": pserver})
+
+
+MODEL_BUILDERS: "Dict[str, Callable[[], ModelProgram]]" = {
+    "mlp": build_mlp,
+    "gpt": build_gpt,
+    "ernie": build_ernie,
+    "resnet": build_resnet,
+    "pipeline": build_pipeline,
+    "grad_merge": build_grad_merge,
+    "ps_transpiled": build_ps_transpiled,
+}
+
+
+def model_names() -> List[str]:
+    return sorted(MODEL_BUILDERS)
+
+
+def build_model_program(name: str) -> ModelProgram:
+    return MODEL_BUILDERS[name]()

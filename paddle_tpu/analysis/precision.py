@@ -1,0 +1,138 @@
+"""Checker 5 — mixed-precision hygiene: reductions and accumulations
+running below f32 without an explicit opt-in.
+
+bf16 has an 8-bit mantissa: summing N terms in bf16 loses ~log2(N) bits,
+which is why every serious recipe keeps loss/grad ACCUMULATION in f32
+even when compute is bf16 (grad-merge defaults ``acc_dtype="float32"``;
+comm_opt's quantized collectives accumulate in f32 and offer error
+feedback). The statically visible violations:
+
+- a reduction op (sum/mean/softmax-CE/...) whose floating inputs are all
+  sub-f32 — the accumulator inherits the input dtype;
+- a SUM-collective (``c_allreduce_sum/avg``, ``c_reducescatter``) on a
+  sub-f32 var: the on-wire ring accumulation happens in that dtype
+  (unlike comm_opt's quantized exchange, which is wire-only);
+- ``FLAGS_collective_comm_dtype=int8`` without error feedback anywhere in
+  the program's comm path — the quantization error is biased and
+  compounds across steps (EQuARX, arXiv:2506.17615);
+- grad-merge annotations with ``acc_dtype`` below f32: the k-microbatch
+  gradient sum drifts (tests/test_comm_opt.py measured it).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .core import (ERROR, INFO, WARNING, AnalysisContext, Finding,
+                   register_checker)
+
+_SUB_F32 = {"bfloat16", "float16", "bf16", "fp16"}
+
+# ops whose lowering accumulates over many elements in the input dtype.
+# Matmuls are deliberately absent: XLA gives bf16 dots f32 MXU
+# accumulation, so they are not a hazard — elementwise sums/means/CE are.
+_REDUCTION_OPS = {
+    "sum", "reduce_sum", "reduce_mean", "mean",
+    "softmax_with_cross_entropy", "cross_entropy", "cross_entropy2",
+}
+
+# SUM-semantics collectives: the ring reduction runs in the wire dtype
+_SUM_COLLECTIVES = {"c_allreduce_sum", "c_allreduce_avg", "c_reducescatter",
+                    "allreduce", "dgc_momentum"}
+
+# attrs that mark a deliberate low-precision choice on the op itself
+_OPT_IN_ATTRS = ("use_fp32_acc", "acc_dtype", "__amp_opt_in__")
+
+
+def _floating_sub_f32(block, names) -> Optional[str]:
+    """First input var whose dtype is a sub-f32 float; None when any input
+    is f32-or-wider (mixed inputs promote) or none are floating."""
+    worst = None
+    for n in names:
+        if not n or n == "@EMPTY@" or not block._has_var_recursive(n):
+            continue
+        dt = block._var_recursive(n).dtype
+        if dt in ("float32", "float64"):
+            return None
+        if dt in _SUB_F32:
+            worst = worst or n
+    return worst
+
+
+@register_checker("precision")
+def check_precision(ctx: AnalysisContext):
+    program = ctx.program
+    findings: List[Finding] = []
+    flag_dtype = (ctx.flags or {}).get("FLAGS_collective_comm_dtype") or ""
+
+    has_sum_collective = False
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            names = [n for ns in op.inputs.values() for n in ns]
+            if op.type in _SUM_COLLECTIVES:
+                has_sum_collective = True
+                var = _floating_sub_f32(block, op.input("X") or names)
+                if var is not None:
+                    findings.append(Finding(
+                        checker="precision", code="subf32_collective",
+                        severity=WARNING, block_idx=block.idx, op_idx=i,
+                        op_type=op.type, var=var,
+                        message=f"SUM-collective accumulates {var!r} in "
+                                f"{block._var_recursive(var).dtype} — "
+                                "ring accumulation below f32 loses "
+                                "mantissa bits per hop; keep grads f32 on "
+                                "the wire or use the quantized exchange "
+                                "(f32 accumulation)"))
+                continue
+            if op.type in _REDUCTION_OPS:
+                if any(op.attr(a) for a in _OPT_IN_ATTRS):
+                    continue
+                var = _floating_sub_f32(block, names)
+                if var is not None:
+                    findings.append(Finding(
+                        checker="precision", code="subf32_accumulation",
+                        severity=WARNING, block_idx=block.idx, op_idx=i,
+                        op_type=op.type, var=var,
+                        message=f"{op.type} accumulates over {var!r} in "
+                                f"{block._var_recursive(var).dtype} with "
+                                "no explicit opt-in — reductions below "
+                                "f32 drift (~8-bit mantissa)"))
+
+    gm = program._annotations.get("grad_merge")
+    if isinstance(gm, dict):
+        acc = str(gm.get("acc_dtype", "float32"))
+        if acc in _SUB_F32:
+            findings.append(Finding(
+                checker="precision", code="grad_merge_subf32_acc",
+                severity=WARNING, block_idx=0, var=None,
+                message=f"grad-merge accumulates k={gm.get('k')} "
+                        f"microbatch gradients in {acc} — the merged "
+                        "gradient drifts vs the full-batch step; "
+                        "acc_dtype='float32' is the safe default"))
+
+    if flag_dtype == "int8" and has_sum_collective:
+        findings.append(Finding(
+            checker="precision", code="quantized_collective_no_ef",
+            severity=WARNING, block_idx=0,
+            message="FLAGS_collective_comm_dtype=int8 reroutes this "
+                    "program's SUM-collectives through the chunk-scaled "
+                    "int8 exchange, which has no error-feedback residual "
+                    "on the fluid path — the biased quantization error "
+                    "compounds across steps (use bf16, or the engine's "
+                    "error_feedback=True reduce-scatter)"))
+    return findings
+
+
+def check_comm_config(ccfg) -> List[Finding]:
+    """Standalone hygiene lint for a ``comm_opt.CommConfig`` (the pure-JAX
+    engine path has no Program IR to walk): int8 wire payload without
+    error feedback is a biased-accumulation risk."""
+    findings: List[Finding] = []
+    if ccfg.comm_dtype == "int8" and not ccfg.error_feedback:
+        findings.append(Finding(
+            checker="precision", code="quantized_collective_no_ef",
+            severity=WARNING,
+            message="CommConfig(comm_dtype='int8') without "
+                    "error_feedback=True — the per-step quantization "
+                    "error is biased and compounds; enable the residual "
+                    "(it rides the sharded train state)"))
+    return findings

@@ -1,0 +1,114 @@
+"""Checker 6 — recompile-risk lint: statically predict signature
+instability using the PR 4 recompile-explainer cause taxonomy.
+
+The runtime explainer (observability/program_report.py) names a recompile
+AFTER it happened: ``feed_shape | feed_dtype | feed_set | fetch_list |
+flags | program_mutation | mesh``. This checker predicts the same causes
+from the IR alone, so shape-churn workloads are flagged before the first
+step instead of after the hundredth compile:
+
+- ``feed_shape``: a feed slot with -1 in a NON-batch dim compiles once
+  per distinct extent (WARNING — pad or bucket); a -1 batch dim alone is
+  the normal one-compile-per-batch-size pattern (INFO);
+- ``feed_dtype``: float64/int64 feed slots — NumPy defaults — hit the
+  per-step cast path and recompile when a caller's dtype drifts;
+- ``flags``: ops whose lowering reads a compile flag recompile when the
+  flag toggles mid-run;
+- ``program_mutation``: host ops make the executor slice per-segment view
+  programs, each with its own compile key;
+- ``mesh``: a mesh annotation with unresolved (-1) axis sizes binds at
+  run time — every distinct world size is a fresh signature.
+
+Codes are ``risk_<cause>`` so dashboards can join the prediction against
+``paddle_recompiles_total{cause=}``.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .core import (INFO, WARNING, AnalysisContext, Finding,
+                   register_checker)
+
+# op type -> flags its lowering consults (executor._COMPILE_FLAGS family)
+_FLAG_SENSITIVE_OPS = {
+    "roi_align": ("FLAGS_roi_align_exact", "FLAGS_roi_align_exact_scale"),
+    "c_allreduce_sum": ("FLAGS_collective_comm_dtype",),
+    "c_allreduce_avg": ("FLAGS_collective_comm_dtype",),
+    "c_reducescatter": ("FLAGS_collective_comm_dtype",),
+}
+
+
+@register_checker("recompile_risk")
+def check_recompile_risk(ctx: AnalysisContext):
+    from ..framework.executor import is_host_op_type
+
+    program = ctx.program
+    gb = program.global_block()
+    findings: List[Finding] = []
+
+    for name, var in gb.vars.items():
+        if not var.is_data:
+            continue
+        shape = tuple(var.shape)
+        inner_dyn = [d for d, s in enumerate(shape) if s == -1 and d > 0]
+        if inner_dyn:
+            findings.append(Finding(
+                checker="recompile_risk", code="risk_feed_shape",
+                severity=WARNING, block_idx=0, var=name,
+                message=f"feed slot {name!r} declares -1 in non-batch "
+                        f"dim(s) {inner_dyn} of {list(shape)} — every "
+                        "distinct extent is a fresh XLA compile "
+                        "(cause=feed_shape); pad to a fixed length or "
+                        "bucket the shapes"))
+        elif shape and shape[0] == -1:
+            findings.append(Finding(
+                checker="recompile_risk", code="risk_feed_shape",
+                severity=INFO, block_idx=0, var=name,
+                message=f"feed slot {name!r} has a dynamic batch dim — "
+                        "one compile per distinct batch size "
+                        "(cause=feed_shape); keep batch sizes bucketed"))
+        if var.dtype in ("float64", "int64"):
+            findings.append(Finding(
+                checker="recompile_risk", code="risk_feed_dtype",
+                severity=INFO, block_idx=0, var=name,
+                message=f"feed slot {name!r} is {var.dtype} (a NumPy "
+                        "default dtype) — callers feeding the x64-widened "
+                        "twin trigger the cast path, and a drifting feed "
+                        "dtype recompiles (cause=feed_dtype)"))
+
+    flag_ops = {}
+    host_ops = []
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            if op.type in _FLAG_SENSITIVE_OPS and op.type not in flag_ops:
+                flag_ops[op.type] = (block.idx, i)
+            if is_host_op_type(op.type):
+                host_ops.append((block.idx, i, op.type))
+    for op_type, (bidx, i) in sorted(flag_ops.items()):
+        findings.append(Finding(
+            checker="recompile_risk", code="risk_flags",
+            severity=INFO, block_idx=bidx, op_idx=i, op_type=op_type,
+            message=f"{op_type!r} lowers differently under "
+                    f"{'/'.join(_FLAG_SENSITIVE_OPS[op_type])} — toggling "
+                    "them mid-run recompiles (cause=flags)"))
+    if host_ops:
+        bidx, i, t = host_ops[0]
+        findings.append(Finding(
+            checker="recompile_risk", code="risk_program_mutation",
+            severity=INFO, block_idx=bidx, op_idx=i, op_type=t,
+            message=f"program contains {len(host_ops)} host op(s) — the "
+                    "executor slices per-segment view programs, each a "
+                    "separate compile key (cause=program_mutation)"))
+
+    mesh = program._annotations.get("mesh")
+    if isinstance(mesh, dict):
+        unsized = [a for a in mesh.get("axes", ()) if tuple(a)[1] in (-1,)]
+        if unsized:
+            findings.append(Finding(
+                checker="recompile_risk", code="risk_mesh",
+                severity=INFO, block_idx=0,
+                message=f"mesh annotation leaves axis size(s) unresolved "
+                        f"({[tuple(a)[0] for a in unsized]}=-1) — the plan "
+                        "binds at run time, and each world size is a "
+                        "fresh signature (cause=mesh)"))
+    return findings

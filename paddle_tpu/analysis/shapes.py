@@ -1,0 +1,187 @@
+"""Checker 2 — shape/dtype propagation: re-run the registry's build-time
+inference (``infer_shape`` specs, ``jax.eval_shape`` fallback) over every
+op and report outputs whose DECLARED shape/dtype contradicts what
+propagation yields.
+
+This is the trace-to-XLA stand-in for the reference's per-op C++
+``InferShape`` pass: the same machinery ``Block.append_op`` runs at build
+time (framework/registry.py:infer_shape_for_op), replayed over the
+finished program so post-build mutations (transpilers, hand-edited descs,
+deserialized programs) are validated too. The block is restored exactly —
+the checker never mutates declared metadata.
+
+Ops where NEITHER path can infer (no ``infer_shape`` spec and the
+eval_shape fallback raises) are surfaced as ``no_inference`` INFO
+findings — that list is precisely the coverage gap the per-op ``infer``
+column in tools/OP_DESC.spec tracks.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .core import (ERROR, INFO, WARNING, AnalysisContext, Finding,
+                   op_writes, register_checker)
+
+
+def _compatible(declared: Tuple[int, ...], inferred: Tuple[int, ...]) -> bool:
+    """-1 is a wildcard on either side; otherwise dims must match exactly.
+    A rank change is always a contradiction — except scalar () vs (1,),
+    which the fluid surface treats interchangeably."""
+    if tuple(declared) == tuple(inferred):
+        return True
+    if {tuple(declared), tuple(inferred)} <= {(), (1,)}:
+        return True
+    if len(declared) != len(inferred):
+        return False
+    return all(d == -1 or i == -1 or d == i
+               for d, i in zip(declared, inferred))
+
+
+def _snapshot(block, names) -> Dict[str, Tuple[tuple, str]]:
+    out = {}
+    for n in names:
+        if block._has_var_recursive(n):
+            v = block._var_recursive(n)
+            out[n] = (tuple(v.shape), v.dtype)
+    return out
+
+
+def _restore(block, snap: Dict[str, Tuple[tuple, str]]):
+    for n, (shape, dtype) in snap.items():
+        v = block._var_recursive(n)
+        v.shape = shape
+        v.dtype = dtype
+
+
+def propagate_op(block, op):
+    """Re-run build-time inference for one op WITHOUT mutating the block.
+
+    Returns ``(inferred, available)`` where ``inferred`` maps output var
+    name -> (shape, dtype) as propagation sees it, and ``available`` says
+    whether any inference path ran at all."""
+    from ..framework import registry
+
+    names = op_writes(op)
+    snap = _snapshot(block, names)
+    try:
+        spec = registry.get_op_spec(op.type)
+    except NotImplementedError:
+        return {}, False
+    ran = True
+    try:
+        if spec.infer_shape is not None or op.type.endswith("_grad"):
+            registry.infer_shape_for_op(block, op)
+        else:
+            # the eval_shape fallback swallows failures by design; probe
+            # it the same way but learn whether it actually produced avals
+            before = dict(snap)
+            registry.infer_shape_for_op(block, op)
+            after = _snapshot(block, names)
+            if after == before:
+                # no mutation: either already-consistent or inference
+                # failed. Disambiguate by re-running eval_shape directly.
+                ran = _eval_shape_ran(block, op, spec)
+        inferred = _snapshot(block, names)
+    finally:
+        _restore(block, snap)
+    return inferred, ran
+
+
+def _eval_shape_ran(block, op, spec) -> bool:
+    """True when the eval_shape fallback can produce output avals for this
+    op (mirrors registry.infer_shape_for_op's try body)."""
+    import jax
+
+    from ..framework.core import dtype_to_jax
+    from ..framework.registry import _DYN, LowerCtx
+
+    try:
+        slots, flat = [], []
+        for slot, names in op.inputs.items():
+            for n in names:
+                v = block._var_recursive(n)
+                shape = tuple(_DYN if d == -1 else d for d in v.shape)
+                slots.append(slot)
+                flat.append(jax.ShapeDtypeStruct(shape,
+                                                 dtype_to_jax(v.dtype)))
+
+        def f(*args):
+            ins = {}
+            for slot, val in zip(slots, args):
+                ins.setdefault(slot, []).append(val)
+            return spec.lower(LowerCtx(block.program, block, {}), op, ins)
+
+        jax.eval_shape(f, *flat)
+        return True
+    except Exception:
+        return False
+
+
+def propagate_block(block) -> Dict[str, Tuple[tuple, str]]:
+    """Propagated (shape, dtype) per var of one block — feeds/persistables
+    seed from declared metadata, op outputs from re-run inference. Used by
+    ``paddle_tpu.debugger`` to annotate renderings."""
+    env: Dict[str, Tuple[tuple, str]] = {}
+    for name, var in block.vars.items():
+        if var.is_data or var.persistable:
+            env[name] = (tuple(var.shape), var.dtype)
+    for op in block.ops:
+        inferred, ran = propagate_op(block, op)
+        if ran:
+            env.update(inferred)
+    return env
+
+
+@register_checker("shape_dtype")
+def check_shapes(ctx: AnalysisContext):
+    from ..framework.executor import is_host_op_type
+    from ..framework import registry
+
+    findings: List[Finding] = []
+    no_inference_types = set()
+    for block in ctx.program.blocks:
+        for i, op in enumerate(block.ops):
+            if is_host_op_type(op.type):
+                continue
+            if not registry.has_op(op.type):
+                findings.append(Finding(
+                    checker="shape_dtype", code="no_lowering",
+                    severity=ERROR, block_idx=block.idx, op_idx=i,
+                    op_type=op.type,
+                    message=f"op type {op.type!r} has no registered "
+                            "lowering — the program cannot compile"))
+                continue
+            declared = _snapshot(block, op_writes(op))
+            inferred, ran = propagate_op(block, op)
+            if not ran:
+                if op.type not in no_inference_types:
+                    no_inference_types.add(op.type)
+                    findings.append(Finding(
+                        checker="shape_dtype", code="no_inference",
+                        severity=INFO, block_idx=block.idx, op_idx=i,
+                        op_type=op.type,
+                        message=f"op type {op.type!r} has no infer_shape "
+                                "spec and the eval_shape fallback cannot "
+                                "abstract it — declared output metadata is "
+                                "unverified (fill the registry gap)"))
+                continue
+            for name, (shape, dtype) in inferred.items():
+                if name not in declared:
+                    continue
+                d_shape, d_dtype = declared[name]
+                if not _compatible(d_shape, shape):
+                    findings.append(Finding(
+                        checker="shape_dtype", code="shape_mismatch",
+                        severity=ERROR, block_idx=block.idx, op_idx=i,
+                        op_type=op.type, var=name,
+                        message=f"declared shape {list(d_shape)} of "
+                                f"{name!r} contradicts propagated "
+                                f"{list(shape)}"))
+                elif d_dtype != dtype:
+                    findings.append(Finding(
+                        checker="shape_dtype", code="dtype_mismatch",
+                        severity=ERROR, block_idx=block.idx, op_idx=i,
+                        op_type=op.type, var=name,
+                        message=f"declared dtype {d_dtype!r} of {name!r} "
+                                f"contradicts propagated {dtype!r}"))
+    return findings

@@ -1,0 +1,175 @@
+"""Checker 1 — program verifier: def-before-use, dangling/duplicate
+reads and writes, feed/fetch/persistable consistency, dead vars.
+
+The reference enforces most of this in C++ at OpDesc build time
+(op_desc.cc CheckGuards + InferVarType); here it is one metadata pass.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .core import (ERROR, INFO, WARNING, AnalysisContext, Finding,
+                   op_reads, op_writes, register_checker)
+
+# ops that legitimately produce values from nothing (no data inputs) or
+# whose declared inputs are optional bootstrap state
+_SOURCE_OPS = {
+    "fill_constant", "uniform_random", "gaussian_random", "randint",
+    "truncated_gaussian_random", "assign_value", "read", "feed",
+    "fill_constant_batch_size_like", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "listen_and_serv", "recv", "seed",
+}
+
+# grad-op input slots that reference FORWARD outputs (available at grad
+# time via the recorded __fwd__ replay even when the var itself was pruned)
+_GRAD_SUFFIX = "@GRAD"
+
+
+def _is_host_op(op_type: str) -> bool:
+    from ..framework.executor import is_host_op_type
+
+    return is_host_op_type(op_type)
+
+
+def _initial_defined(block, feed_names) -> Set[str]:
+    """Names defined before any op runs: persistables, declared feed slots
+    (is_data), explicit feeds, and — for sub-blocks — everything visible in
+    the parent chain (a sub-block op executes inside its parent op, which
+    the parent-block walk validates in program order)."""
+    defined: Set[str] = set(feed_names)
+    for name, var in block.vars.items():
+        if var.persistable or var.is_data:
+            defined.add(name)
+    parent = block.parent_block
+    while parent is not None:
+        defined.update(parent.vars.keys())
+        parent = parent.parent_block
+    return defined
+
+
+@register_checker("program_verifier")
+def check_program(ctx: AnalysisContext):
+    program = ctx.program
+    findings: List[Finding] = []
+    fetch_names = set(ctx.fetch_names)
+
+    # names read anywhere / written anywhere (for dead-var + fetch checks)
+    read_anywhere: Set[str] = set()
+    written_anywhere: Set[str] = set()
+    for block in program.blocks:
+        for op in block.ops:
+            read_anywhere.update(op_reads(op))
+            written_anywhere.update(op_writes(op))
+
+    for block in program.blocks:
+        defined = _initial_defined(block, ctx.feed_names)
+        writers: Dict[str, int] = {}
+        for i, op in enumerate(block.ops):
+            if op.type in _SOURCE_OPS or _is_host_op(op.type):
+                # host ops read/write scope directly; source ops have no
+                # data dependencies worth ordering
+                defined.update(op_writes(op))
+                for n in op_writes(op):
+                    writers.setdefault(n, i)
+                continue
+            for name in op_reads(op):
+                if not block._has_var_recursive(name):
+                    findings.append(Finding(
+                        checker="program_verifier", code="undeclared_var",
+                        severity=ERROR, block_idx=block.idx, op_idx=i,
+                        op_type=op.type, var=name,
+                        message=f"op reads {name!r} but no Variable with "
+                                "that name exists in the block hierarchy "
+                                "(dangling read)"))
+                    continue
+                if name not in defined:
+                    findings.append(Finding(
+                        checker="program_verifier", code="use_before_def",
+                        severity=ERROR, block_idx=block.idx, op_idx=i,
+                        op_type=op.type, var=name,
+                        message=f"op reads {name!r} before any earlier op "
+                                "produces it (and it is neither persistable "
+                                "nor a feed slot) — the trace will fail with "
+                                "a missing-binding KeyError"))
+            # duplicate names inside ONE op's output slots: binding order
+            # is undefined (dict zip in _bind_outputs keeps the last)
+            outs = op_writes(op)
+            dupes = {n for n in outs if outs.count(n) > 1}
+            for name in sorted(dupes):
+                findings.append(Finding(
+                    checker="program_verifier", code="duplicate_output",
+                    severity=WARNING, block_idx=block.idx, op_idx=i,
+                    op_type=op.type, var=name,
+                    message=f"op lists output {name!r} more than once — "
+                            "which binding wins is undefined"))
+            for name in outs:
+                var = (block._var_recursive(name)
+                       if block._has_var_recursive(name) else None)
+                prev = writers.get(name)
+                if (prev is not None and var is not None
+                        and not var.persistable
+                        and not name.endswith(_GRAD_SUFFIX)):
+                    # re-definition of a temp (persistables are state — ok;
+                    # @GRAD vars legitimately accumulate across grad ops)
+                    findings.append(Finding(
+                        checker="program_verifier", code="var_redefined",
+                        severity=INFO, block_idx=block.idx, op_idx=i,
+                        op_type=op.type, var=name,
+                        message=f"non-persistable {name!r} already written "
+                                f"by op {prev}; later reads see only this "
+                                "newest value"))
+                writers.setdefault(name, i)
+                defined.add(name)
+
+    gb = program.global_block()
+
+    # feed consistency: declared feed slots that nothing reads, and ops
+    # overwriting a feed slot (the fed value is silently shadowed)
+    for name, var in gb.vars.items():
+        if not var.is_data:
+            continue
+        if name not in read_anywhere and name not in fetch_names:
+            findings.append(Finding(
+                checker="program_verifier", code="unused_feed",
+                severity=WARNING, block_idx=0, var=name,
+                message=f"feed slot {name!r} is never read by any op"))
+        if name in written_anywhere:
+            findings.append(Finding(
+                checker="program_verifier", code="feed_overwritten",
+                severity=WARNING, block_idx=0, var=name,
+                message=f"feed slot {name!r} is written by an op — the fed "
+                        "value is shadowed inside the program"))
+
+    # fetch consistency: every fetch must be produced or persistable
+    for name in ctx.fetch_names:
+        if not gb._has_var_recursive(name):
+            findings.append(Finding(
+                checker="program_verifier", code="bad_fetch",
+                severity=ERROR, block_idx=0, var=name,
+                message=f"fetch target {name!r} is not a variable of this "
+                        "program"))
+            continue
+        var = gb._var_recursive(name)
+        if (name not in written_anywhere and not var.persistable
+                and not var.is_data):
+            findings.append(Finding(
+                checker="program_verifier", code="fetch_never_produced",
+                severity=ERROR, block_idx=0, var=name,
+                message=f"fetch target {name!r} is neither produced by any "
+                        "op nor persistable — the run would fail"))
+
+    # dead vars: produced, non-persistable, never read / fetched. INFO:
+    # many ops emit auxiliary outputs (softmax cache, batch-norm saved
+    # stats) by contract.
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            if var.persistable or var.is_data:
+                continue
+            if (name in written_anywhere and name not in read_anywhere
+                    and name not in fetch_names):
+                findings.append(Finding(
+                    checker="program_verifier", code="dead_var",
+                    severity=INFO, block_idx=block.idx, var=name,
+                    message=f"{name!r} is produced but never read or "
+                            "fetched (dead value; XLA DCE removes it)"))
+    return findings

@@ -3,7 +3,13 @@ python/paddle/fluid/debugger.py (pprint_program_codes, draw_block_graphviz)
 and net_drawer.py.
 
 Emits DOT text directly (no graphviz binary needed to produce the .dot;
-render with any graphviz viewer)."""
+render with any graphviz viewer).
+
+Renderings annotate each variable with the shape/dtype the static
+analysis pass propagates (paddle_tpu.analysis.propagate_block — the same
+registry ``infer_shape`` / ``jax.eval_shape`` machinery the shape checker
+runs), marking ``!`` where propagation contradicts the declared
+metadata. Pass ``annotate=False`` for the raw declared view."""
 from __future__ import annotations
 
 from .framework.program import Program
@@ -12,32 +18,67 @@ __all__ = ["pprint_program_codes", "pprint_block_codes",
            "draw_block_graphviz"]
 
 
-def pprint_block_codes(block, show_backward=False):
+def _propagated(block, annotate: bool):
+    """{var name: (shape, dtype)} from the analysis pass; {} when
+    annotation is off or propagation is unavailable (never raises — a
+    debugger must render broken programs, that is its job)."""
+    if not annotate:
+        return {}
+    try:
+        from .analysis import propagate_block
+
+        return propagate_block(block)
+    except Exception:
+        return {}
+
+
+def _var_line(v, prop):
+    tag = "param" if getattr(v, "persistable", False) else "var"
+    decl_shape = getattr(v, "shape", None)
+    decl_dtype = getattr(v, "dtype", None)
+    line = f"  {tag} {v.name}: shape={decl_shape} dtype={decl_dtype}"
+    hit = prop.get(v.name)
+    if hit is not None:
+        p_shape, p_dtype = hit
+        if tuple(p_shape) != tuple(decl_shape or ()) or p_dtype != decl_dtype:
+            line += f"  [propagated shape={tuple(p_shape)} dtype={p_dtype} !]"
+        else:
+            line += "  [propagated ok]"
+    return line
+
+
+def pprint_block_codes(block, show_backward=False, annotate=True):
+    prop = _propagated(block, annotate)
     lines = [f"block {block.idx} (parent {block.parent_idx}):"]
     for v in block.vars.values():
-        tag = "param" if getattr(v, "persistable", False) else "var"
-        lines.append(f"  {tag} {v.name}: shape={getattr(v, 'shape', None)} "
-                     f"dtype={getattr(v, 'dtype', None)}")
+        lines.append(_var_line(v, prop))
     for op in block.ops:
         if not show_backward and op.type.endswith("_grad"):
             continue
-        ins = ", ".join(f"{k}={v}" for k, v in op.inputs.items() if v)
-        outs = ", ".join(f"{k}={v}" for k, v in op.outputs.items() if v)
-        lines.append(f"  {op.type}({ins}) -> {outs}")
+        ins = ", ".join(f"{k}={v}" for k, v in (op.inputs or {}).items() if v)
+        outs = ", ".join(f"{k}={v}" for k, v in (op.outputs or {}).items()
+                         if v)
+        # ops with no outputs (send, barrier, prints) render with an
+        # explicit empty arrow instead of crashing the formatter
+        lines.append(f"  {op.type}({ins}) -> {outs if outs else '()'}")
     return "\n".join(lines)
 
 
-def pprint_program_codes(program: Program, show_backward=False) -> str:
-    text = "\n".join(pprint_block_codes(b, show_backward)
+def pprint_program_codes(program: Program, show_backward=False,
+                         annotate=True) -> str:
+    text = "\n".join(pprint_block_codes(b, show_backward, annotate=annotate)
                      for b in program.blocks)
     print(text)
     return text
 
 
-def draw_block_graphviz(block, highlights=None, path="./temp.dot") -> str:
+def draw_block_graphviz(block, highlights=None, path="./temp.dot",
+                        annotate=True) -> str:
     """Write the block's op/var dataflow as a DOT digraph (reference
-    debugger.py draw_block_graphviz)."""
+    debugger.py draw_block_graphviz). Var nodes carry the propagated
+    shape/dtype annotation when available."""
     highlights = set(highlights or ())
+    prop = _propagated(block, annotate)
     lines = ["digraph G {", "  rankdir=TB;"]
     var_ids = {}
     for i, v in enumerate(block.vars.values()):
@@ -45,15 +86,20 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot") -> str:
         color = ', style=filled, fillcolor="yellow"' \
             if v.name in highlights else ""
         shape = "box" if getattr(v, "persistable", False) else "ellipse"
-        lines.append(f'  var_{i} [label="{v.name}", shape={shape}{color}];')
+        hit = prop.get(v.name)
+        label = v.name
+        if hit is not None:
+            p_shape, p_dtype = hit
+            label += f"\\n{list(p_shape)} {p_dtype}"
+        lines.append(f'  var_{i} [label="{label}", shape={shape}{color}];')
     for j, op in enumerate(block.ops):
         lines.append(f'  op_{j} [label="{op.type}", shape=record, '
                      f'style=filled, fillcolor="lightgrey"];')
-        for names in op.inputs.values():
+        for names in (op.inputs or {}).values():
             for n in names:
                 if n in var_ids:
                     lines.append(f"  {var_ids[n]} -> op_{j};")
-        for names in op.outputs.values():
+        for names in (op.outputs or {}).values():
             for n in names:
                 if n in var_ids:
                     lines.append(f"  op_{j} -> {var_ids[n]};")

@@ -196,6 +196,12 @@ _GLOBAL_FLAGS = {
     # docs/comm_opt.md.
     "FLAGS_collective_comm_dtype": _os.environ.get(
         "FLAGS_collective_comm_dtype", ""),
+    # Program IR static verifier (paddle_tpu/analysis/, see
+    # docs/static_analysis.md): when on, Executor.run lints each program
+    # once per version BEFORE compiling it — error-severity findings
+    # raise, warnings log. Never touches the dispatch fast path.
+    "FLAGS_check_program": bool(int(_os.environ.get(
+        "FLAGS_check_program", "0") or 0)),
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_allocator_strategy": "xla_managed",
     "FLAGS_paddle_num_threads": 1,

@@ -618,6 +618,8 @@ class Executor:
         # per-program compile-signature history: the recompile explainer
         # diffs a fresh build against these siblings to name the cause
         self._compile_history: Dict[int, List[dict]] = {}
+        # FLAGS_check_program: program versions already statically verified
+        self._checked_programs: set = set()
         self._fast_hits = 0
         self._step = 0
 
@@ -625,6 +627,7 @@ class Executor:
         self._cache.clear()
         self._dispatch_records.clear()
         self._compile_history.clear()
+        self._checked_programs.clear()
 
     # ------------------------------------------------------------------
     def run(
@@ -666,6 +669,9 @@ class Executor:
             program = default_main_program()
         scope = scope or global_scope()
         feed = dict(feed or {})
+
+        if get_flag("FLAGS_check_program"):
+            self._check_program(program, feed, fetch_names)
 
         if any(op.type in _HOST_OPS for op in program.global_block().ops):
             return self._run_with_host_ops(
@@ -785,6 +791,30 @@ class Executor:
             _m_device_wait_ms.observe((time.perf_counter_ns() - t_wait0) / 1e6)
             return out
         return fetches
+
+    # ------------------------------------------------------------------
+    def _check_program(self, program, feed, fetch_names) -> None:
+        """FLAGS_check_program pre-compile hook: run the static verifier
+        (paddle_tpu/analysis/) once per program version — errors raise
+        before anything is traced, warnings go to the log. The dispatch
+        fast path never reaches here (it only serves already-checked
+        (program, feed, fetch) combinations)."""
+        key = (id(program), program._version_token(), tuple(fetch_names))
+        if key in self._checked_programs:
+            return
+        from .. import analysis
+
+        result = analysis.analyze_program(
+            program, feed_names=list(feed), fetch_names=fetch_names)
+        for f in result.warnings:
+            logger.warning("check_program: %s", f.format())
+        if not result.ok:
+            raise RuntimeError(
+                "FLAGS_check_program: static verification failed:\n"
+                + "\n".join(f.format() for f in result.errors))
+        if len(self._checked_programs) > 512:
+            self._checked_programs.clear()
+        self._checked_programs.add(key)
 
     # ------------------------------------------------------------------
     # flags whose value changes the lowered computation: a rebuild whose

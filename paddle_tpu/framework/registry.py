@@ -318,6 +318,78 @@ def lower_vjp_grad(ctx: LowerCtx, op, ins, fwd_spec: OpSpec):
 _DYN = 97  # stand-in extent for -1 dims during eval_shape (prime, unlikely real)
 
 
+def set_infer_shape(op_type: str, fn) -> None:
+    """Attach (or replace) an op's declared infer_shape after registration
+    — the hook the analysis shape checker's ``no_inference`` findings ask
+    op authors to use when the eval_shape fallback cannot abstract a
+    lowering (data-dependent output shapes, host-materializing ops)."""
+    spec = _OPS[op_type]
+    _OPS[op_type] = dataclasses.replace(spec, infer_shape=fn)
+
+
+def _copy_meta(block, out_name, shape, dtype) -> None:
+    if out_name and out_name != "@EMPTY@" and \
+            block._has_var_recursive(out_name):
+        var = block._var_recursive(out_name)
+        var.shape = tuple(shape)
+        var.dtype = dtype
+
+
+def infer_identity(in_slot: str = "X", out_slot: str = "Out"):
+    """Declared infer_shape: every ``out_slot`` output takes the first
+    ``in_slot`` input's shape/dtype. Correct for unary math, activations,
+    scale/clip/sum, and the paddle elementwise family (Y broadcasts INTO
+    X's shape, so Out always has X's metadata). Declared specs also skip
+    the per-append eval_shape trace — program builds get cheaper."""
+
+    def infer(block, op):
+        names = op.inputs.get(in_slot) or []
+        if not names or not block._has_var_recursive(names[0]):
+            return
+        src = block._var_recursive(names[0])
+        for out_name in op.outputs.get(out_slot, []):
+            _copy_meta(block, out_name, src.shape, src.dtype)
+
+    return infer
+
+
+def infer_cast(block, op):
+    """cast: X's shape, attr-declared dtype."""
+    from .core import convert_dtype
+
+    names = op.inputs.get("X") or []
+    if not names or not block._has_var_recursive(names[0]):
+        return
+    src = block._var_recursive(names[0])
+    dtype = convert_dtype(op.attr("out_dtype", src.dtype))
+    for out_name in op.outputs.get("Out", []):
+        _copy_meta(block, out_name, src.shape, dtype)
+
+
+def infer_dynamic(out_dims: Dict[str, int], dtypes: Optional[Dict[str, str]]
+                  = None, like_slot: str = "X"):
+    """Declared infer_shape for data-dependent ops (unique, where_index …)
+    whose output extents only exist at run time: declare rank-correct
+    all--1 shapes per output slot so downstream build-time inference sees
+    honest unknowns instead of stale/empty metadata. ``dtypes`` pins
+    output dtypes; slots absent from it inherit the ``like_slot`` input's
+    dtype."""
+
+    def infer(block, op):
+        names = op.inputs.get(like_slot) or []
+        src_dtype = None
+        if names and block._has_var_recursive(names[0]):
+            src_dtype = block._var_recursive(names[0]).dtype
+        for slot, rank in out_dims.items():
+            dtype = (dtypes or {}).get(slot) or src_dtype
+            if dtype is None:
+                continue
+            for out_name in op.outputs.get(slot, []):
+                _copy_meta(block, out_name, (-1,) * rank, dtype)
+
+    return infer
+
+
 def infer_shape_for_op(block, op) -> None:
     """Fill output Variable shapes/dtypes at graph-build time.
 

@@ -13,7 +13,7 @@ import numpy as np
 from jax import lax
 
 from ..framework.core import dtype_to_jax, int_index_dtype
-from ..framework.registry import register_op
+from ..framework.registry import infer_dynamic, register_op
 
 _I64 = int_index_dtype  # call per use: jax_enable_x64 may toggle after import
 
@@ -365,7 +365,9 @@ def tril_triu(ctx, op, ins):
     return {"Out": jnp.triu(x, diag)}
 
 
-@register_op("unique", grad=None)
+@register_op("unique", grad=None,
+             infer_shape=infer_dynamic({"Out": 1, "Index": 1},
+                                       dtypes={"Index": "int32"}))
 def unique(ctx, op, ins):
     # host-side / CPU utility op (dynamic output shape); TPU programs should
     # not contain it inside jit regions.
@@ -374,7 +376,10 @@ def unique(ctx, op, ins):
     return {"Out": jnp.asarray(out), "Index": jnp.asarray(idx.astype(np.int32))}
 
 
-@register_op("unique_with_counts", grad=None)
+@register_op("unique_with_counts", grad=None,
+             infer_shape=infer_dynamic(
+                 {"Out": 1, "Index": 1, "Count": 1},
+                 dtypes={"Index": "int32", "Count": "int32"}))
 def unique_with_counts(ctx, op, ins):
     """operators/unique_with_counts_op.cc — host-side op (dynamic shape)."""
     x = ins["X"][0]

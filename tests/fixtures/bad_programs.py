@@ -1,0 +1,219 @@
+"""Known-bad-program corpus for the static analyzer
+(tests/test_static_analysis.py; checker catalog in
+docs/static_analysis.md).
+
+Each builder seeds EXACTLY ONE defect class and returns the program (plus
+whatever context the checker needs), so the paired test can assert the
+finding fires with the right code, severity and location. Builders
+construct IR by hand where the layer surface would (correctly) refuse to
+build the broken graph.
+"""
+from __future__ import annotations
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.program import Program
+
+
+def _fresh():
+    main = fluid.Program()
+    main.random_seed = 5
+    return main
+
+
+# ---------------------------------------------------------------------------
+# program_verifier
+# ---------------------------------------------------------------------------
+
+def use_before_def():
+    """Op 1 reads 'h' which nothing produced (not persistable, not a feed)."""
+    main = _fresh()
+    block = main.global_block()
+    x = block.create_var(name="x", shape=(-1, 4), dtype="float32",
+                         is_data=True)
+    block.create_var(name="h", shape=(-1, 4), dtype="float32")
+    block.create_var(name="o", shape=(-1, 4), dtype="float32")
+    block.append_op("relu", {"X": "h"}, {"Out": "o"})
+    return main
+
+
+def bad_fetch():
+    """Fetch target exists as a var but is never produced."""
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="x", shape=(-1, 4), dtype="float32", is_data=True)
+    block.create_var(name="y", shape=(-1, 4), dtype="float32")
+    block.append_op("relu", {"X": "x"}, {"Out": "y"})
+    block.create_var(name="ghost", shape=(4,), dtype="float32")
+    return main, ["ghost"]
+
+
+# ---------------------------------------------------------------------------
+# shape_dtype
+# ---------------------------------------------------------------------------
+
+def shape_mismatch():
+    """Declared output shape of the fc matmul contradicts propagation
+    (a post-build mutation — the class of bug transpilers introduce)."""
+    main = _fresh()
+    startup = fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        h = fluid.layers.fc(x, 16)
+    block = main.global_block()
+    bad_var = block.var(h.name)
+    bad_var.shape = (-1, 9999)  # fc produced [-1, 16]
+    return main, h.name
+
+
+# ---------------------------------------------------------------------------
+# comm_safety
+# ---------------------------------------------------------------------------
+
+def _collective_program(order):
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="g", shape=(16,), dtype="float32",
+                     persistable=True)
+    for i, op_type in enumerate(order):
+        block.create_var(name=f"g{i}", shape=(16,), dtype="float32")
+        block.append_op(op_type, {"X": "g"}, {"Out": f"g{i}"},
+                        {"ring_id": 0})
+    main._annotations["mesh"] = {"mode": "shard_map",
+                                 "axes": [("dp", 2)], "data_axis": "dp",
+                                 "ring_axes": {0: "dp"}}
+    return main
+
+
+def rank_divergent_collective_order():
+    """Rank 0 reduces sum-then-max; rank 1 max-then-sum — a deadlock."""
+    rank0 = _collective_program(["c_allreduce_sum", "c_allreduce_max"])
+    rank1 = _collective_program(["c_allreduce_max", "c_allreduce_sum"])
+    return rank0, [rank1]
+
+
+def conditional_collective():
+    """A c_allreduce_sum under a conditional_block sub-block: rank-
+    divergent predicates hang the mesh."""
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="cond", shape=(1,), dtype="bool", is_data=True)
+    block.create_var(name="g", shape=(16,), dtype="float32",
+                     persistable=True)
+    sub = main._create_block()
+    sub.create_var(name="g_red", shape=(16,), dtype="float32")
+    sub.append_op("c_allreduce_sum", {"X": "g"}, {"Out": "g_red"},
+                  {"ring_id": 0})
+    main._rollback()
+    block.append_op("conditional_block", {"Cond": "cond"}, {},
+                    {"sub_block": sub.idx})
+    main._annotations["mesh"] = {"mode": "shard_map",
+                                 "axes": [("dp", 2)], "data_axis": "dp",
+                                 "ring_axes": {0: "dp"}}
+    return main
+
+
+def unmapped_ring():
+    """Collective on ring_id 7 while the mesh only maps ring 0: the
+    lowering silently degrades to identity."""
+    main = _collective_program(["c_allreduce_sum"])
+    main.global_block().ops[0]._set_attr("ring_id", 7)
+    return main
+
+
+def divergent_bucket_layouts():
+    """Two dp ranks building comm_opt bucket plans under different caps."""
+    from paddle_tpu.parallel.comm_opt import build_bucket_layout
+
+    shapes = [((256, 256), "float32"), ((1024,), "float32"),
+              ((128, 64), "float32")]
+    rank0 = build_bucket_layout(shapes, ranks=2, cap_bytes=1 << 18)
+    rank1 = build_bucket_layout(shapes, ranks=2, cap_bytes=1 << 20)
+    return [rank0, rank1]
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+def use_after_donate():
+    """A backward-role op reads param 'w' AFTER the optimizer updated it
+    in place — with donated buffers the pre-update value is gone, so the
+    gradient is computed against the wrong weights."""
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="x", shape=(-1, 4), dtype="float32", is_data=True)
+    block.create_var(name="w", shape=(4, 4), dtype="float32",
+                     persistable=True)
+    block.create_var(name="w@GRAD", shape=(4, 4), dtype="float32",
+                     persistable=True)
+    block.create_var(name="lr", shape=(1,), dtype="float32",
+                     persistable=True)
+    block.create_var(name="y", shape=(-1, 4), dtype="float32")
+    block.create_var(name="x@GRAD", shape=(-1, 4), dtype="float32")
+    block.append_op("mul", {"X": "x", "Y": "w"}, {"Out": "y"},
+                    {"op_role": Program.OP_ROLE_FORWARD})
+    # optimizer update lands BEFORE the backward op that still needs w
+    block.append_op("sgd", {"Param": "w", "Grad": "w@GRAD",
+                            "LearningRate": "lr"},
+                    {"ParamOut": "w"},
+                    {"op_role": Program.OP_ROLE_OPTIMIZE})
+    block.append_op("mul", {"X": "y", "Y": "w"}, {"Out": "x@GRAD"},
+                    {"op_role": Program.OP_ROLE_BACKWARD})
+    return main
+
+
+def donated_never_rewritten():
+    """An AOT donation map lists 'w' but the program never writes it back
+    — the next step would read a deleted buffer."""
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="x", shape=(-1, 4), dtype="float32", is_data=True)
+    block.create_var(name="w", shape=(4, 4), dtype="float32",
+                     persistable=True)
+    block.create_var(name="y", shape=(-1, 4), dtype="float32")
+    block.append_op("mul", {"X": "x", "Y": "w"}, {"Out": "y"})
+    return main, ["w"]
+
+
+# ---------------------------------------------------------------------------
+# precision
+# ---------------------------------------------------------------------------
+
+def bf16_accumulation():
+    """reduce_sum over a bf16 activation with no opt-in attr."""
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="h", shape=(-1, 1024), dtype="bfloat16",
+                     is_data=True)
+    block.create_var(name="s", shape=(-1,), dtype="bfloat16")
+    block.append_op("reduce_sum", {"X": "h"}, {"Out": "s"}, {"dim": [1]})
+    return main
+
+
+def bf16_grad_merge_acc():
+    """grad-merge annotated to accumulate k microbatch grads in bf16."""
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="x", shape=(-1, 4), dtype="float32", is_data=True)
+    block.create_var(name="y", shape=(-1, 4), dtype="float32")
+    block.append_op("relu", {"X": "x"}, {"Out": "y"})
+    main._annotations["grad_merge"] = {
+        "bwd_end": 1, "k": 4, "loss": "y", "grads": [], "avg": True,
+        "remat": "none", "acc_dtype": "bfloat16"}
+    return main
+
+
+# ---------------------------------------------------------------------------
+# recompile_risk
+# ---------------------------------------------------------------------------
+
+def dynamic_inner_dim():
+    """Feed slot with -1 in a NON-batch dim: one XLA compile per distinct
+    sequence length."""
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="tokens", shape=(-1, -1), dtype="int64",
+                     is_data=True)
+    block.create_var(name="e", shape=(-1, -1), dtype="int64")
+    block.append_op("relu", {"X": "tokens"}, {"Out": "e"})
+    return main

@@ -1,0 +1,289 @@
+"""Program IR static verifier + comm-safety linter (paddle_tpu/analysis/).
+
+Two halves:
+- the GATE: every built-in model program (gpt/ernie/resnet, pipeline,
+  grad-merge, PS transpiler output) must lint with zero error-severity
+  findings, and ``tools/paddle_lint.py --all-models`` must exit 0;
+- the TEETH: each seeded bad-program fixture (tests/fixtures/
+  bad_programs.py) must fire its checker with the right code, severity
+  and location.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "fixtures"))
+import bad_programs as bad  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def _one(result, checker, code, severity=None):
+    hits = [f for f in result.findings
+            if f.checker == checker and f.code == code
+            and (severity is None or f.severity == severity)]
+    assert hits, (f"no {checker}:{code} finding"
+                  + (f" at severity {severity}" if severity else "")
+                  + f"; got: {[f.format() for f in result.findings]}")
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# gate: built-in model programs lint clean
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", analysis.model_names())
+def test_builtin_model_lints_clean(name):
+    results = analysis.lint_model(analysis.build_model_program(name))
+    for prog_name, res in results.items():
+        assert res.ok, (f"{prog_name} has error findings:\n"
+                        + "\n".join(f.format() for f in res.errors))
+
+
+def test_cli_all_models_exits_zero(capsys):
+    import paddle_lint
+
+    assert paddle_lint.main(["--all-models"]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s) total" in out
+
+
+def test_cli_exits_nonzero_on_error(monkeypatch, capsys):
+    from paddle_tpu.analysis import model_corpus
+
+    def broken():
+        return model_corpus.ModelProgram("broken", bad.use_before_def())
+
+    monkeypatch.setitem(model_corpus.MODEL_BUILDERS, "broken", broken)
+    import paddle_lint
+
+    assert paddle_lint.main(["--model", "broken"]) == 1
+    assert "use_before_def" in capsys.readouterr().out
+
+
+def test_cli_json_output(capsys):
+    import json
+
+    import paddle_lint
+
+    assert paddle_lint.main(["--model", "mlp", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert "mlp" in payload and "summary" in payload["mlp"]
+    assert payload["mlp"]["summary"]["error"] == 0
+
+
+# ---------------------------------------------------------------------------
+# teeth: one seeded fixture per checker
+# ---------------------------------------------------------------------------
+
+def test_verifier_use_before_def():
+    res = analysis.analyze_program(bad.use_before_def(),
+                                   checkers=["program_verifier"])
+    f = _one(res, "program_verifier", "use_before_def", analysis.ERROR)
+    assert f.var == "h"
+    assert f.block_idx == 0 and f.op_idx == 0 and f.op_type == "relu"
+    assert "block 0 op 0 (relu)" in f.location
+
+
+def test_verifier_bad_fetch():
+    prog, fetches = bad.bad_fetch()
+    res = analysis.analyze_program(prog, fetch_names=fetches,
+                                   checkers=["program_verifier"])
+    f = _one(res, "program_verifier", "fetch_never_produced", analysis.ERROR)
+    assert f.var == "ghost"
+
+
+def test_shape_checker_flags_corrupted_shape():
+    prog, var_name = bad.shape_mismatch()
+    res = analysis.analyze_program(prog, checkers=["shape_dtype"])
+    f = _one(res, "shape_dtype", "shape_mismatch", analysis.ERROR)
+    assert f.var == var_name
+    assert "9999" in f.message
+    # the checker must not repair the program it lints
+    assert tuple(prog.global_block().var(var_name).shape) == (-1, 9999)
+
+
+def test_collective_order_divergence():
+    rank0, peers = bad.rank_divergent_collective_order()
+    res = analysis.analyze_program(rank0, peer_programs=peers,
+                                   checkers=["comm_safety"])
+    f = _one(res, "comm_safety", "collective_order_divergence",
+             analysis.ERROR)
+    assert "rank 0" in f.message and "rank 1" in f.message
+    # same-rank analysis without peers stays clean
+    solo = analysis.analyze_program(rank0, checkers=["comm_safety"])
+    assert solo.ok
+
+
+def test_conditional_collective():
+    res = analysis.analyze_program(bad.conditional_collective(),
+                                   checkers=["comm_safety"])
+    f = _one(res, "comm_safety", "conditional_collective", analysis.ERROR)
+    assert f.op_type == "c_allreduce_sum"
+    assert f.block_idx == 1  # the sub-block, not block 0
+
+
+def test_unmapped_ring_warns():
+    res = analysis.analyze_program(bad.unmapped_ring(),
+                                   checkers=["comm_safety"])
+    f = _one(res, "comm_safety", "unmapped_ring", analysis.WARNING)
+    assert "ring_id 7" in f.message
+
+
+def test_divergent_bucket_layouts():
+    findings = analysis.check_bucket_layouts(bad.divergent_bucket_layouts())
+    assert findings and findings[0].severity == analysis.ERROR
+    assert findings[0].code in ("bucket_count_divergence",
+                                "bucket_layout_divergence")
+    # identical plans are clean
+    same = bad.divergent_bucket_layouts()[0]
+    assert analysis.check_bucket_layouts([same, same]) == []
+
+
+def test_use_after_donate():
+    res = analysis.analyze_program(bad.use_after_donate(),
+                                   checkers=["donation"])
+    f = _one(res, "donation", "use_after_donate", analysis.ERROR)
+    assert f.var == "w" and f.op_idx == 2
+    assert "block 0 op 2 (mul)" in f.location
+
+
+def test_donated_never_rewritten():
+    prog, donated = bad.donated_never_rewritten()
+    res = analysis.analyze_program(prog, donated=donated,
+                                   checkers=["donation"])
+    f = _one(res, "donation", "donated_never_rewritten", analysis.ERROR)
+    assert f.var == "w"
+    # without the bogus AOT donation map the IR itself is fine
+    assert analysis.analyze_program(prog, checkers=["donation"]).ok
+
+
+def test_bf16_accumulation():
+    res = analysis.analyze_program(bad.bf16_accumulation(),
+                                   checkers=["precision"])
+    f = _one(res, "precision", "subf32_accumulation", analysis.WARNING)
+    assert f.op_type == "reduce_sum" and f.var == "h"
+    assert f.block_idx == 0 and f.op_idx == 0
+
+
+def test_bf16_grad_merge_acc():
+    res = analysis.analyze_program(bad.bf16_grad_merge_acc(),
+                                   checkers=["precision"])
+    f = _one(res, "precision", "grad_merge_subf32_acc", analysis.WARNING)
+    assert "bfloat16" in f.message
+
+
+def test_comm_config_hygiene():
+    from paddle_tpu.parallel.comm_opt import CommConfig
+
+    bad_cfg = CommConfig(grad_reduce="reduce_scatter", comm_dtype="int8")
+    findings = analysis.check_comm_config(bad_cfg)
+    assert findings and findings[0].code == "quantized_collective_no_ef"
+    good = CommConfig(grad_reduce="reduce_scatter", comm_dtype="int8",
+                      error_feedback=True)
+    assert analysis.check_comm_config(good) == []
+
+
+def test_recompile_risk_dynamic_inner_dim():
+    res = analysis.analyze_program(bad.dynamic_inner_dim(),
+                                   checkers=["recompile_risk"])
+    f = _one(res, "recompile_risk", "risk_feed_shape", analysis.WARNING)
+    assert f.var == "tokens" and "feed_shape" in f.message
+
+
+# ---------------------------------------------------------------------------
+# executor hook: FLAGS_check_program
+# ---------------------------------------------------------------------------
+
+def test_executor_hook_rejects_bad_program():
+    from paddle_tpu.framework.core import get_flag, set_flags
+
+    prev = get_flag("FLAGS_check_program")
+    set_flags({"FLAGS_check_program": True})
+    try:
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        with pytest.raises(RuntimeError, match="use_before_def"):
+            exe.run(bad.use_before_def(),
+                    feed={"x": np.zeros((2, 4), np.float32)},
+                    fetch_list=[], scope=scope)
+    finally:
+        set_flags({"FLAGS_check_program": prev})
+
+
+def test_executor_hook_passes_good_program_once_per_version():
+    from paddle_tpu.framework.core import get_flag, set_flags
+
+    prev = get_flag("FLAGS_check_program")
+    set_flags({"FLAGS_check_program": True})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            y = fluid.layers.fc(x, 2)
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            feed = {"x": np.ones((3, 4), np.float32)}
+            out1 = exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+            out2 = exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+        np.testing.assert_allclose(out1[0], out2[0])
+        # memoized per (program, version, fetch): one check, two runs
+        assert len(exe._checked_programs) >= 1
+    finally:
+        set_flags({"FLAGS_check_program": prev})
+
+
+# ---------------------------------------------------------------------------
+# observability: findings land in the metrics registry
+# ---------------------------------------------------------------------------
+
+def test_findings_counted_in_registry():
+    from paddle_tpu.observability import default_registry
+
+    def count():
+        snap = default_registry().snapshot()
+        series = snap.get("paddle_lint_findings_total", {}).get("series", [])
+        return {tuple(s["labels"]): s["value"] for s in series}
+
+    before = count()
+    res = analysis.analyze_program(bad.use_before_def())
+    after = count()
+    assert sum(after.values()) - sum(before.values()) == len(res.findings)
+    assert after.get(("error",), 0) > before.get(("error",), 0)
+
+
+# ---------------------------------------------------------------------------
+# propagation surface shared with the debugger
+# ---------------------------------------------------------------------------
+
+def test_propagate_block_and_debugger_annotation(tmp_path):
+    from paddle_tpu import debugger
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+    block = main.global_block()
+    env = analysis.propagate_block(block)
+    assert tuple(env[h.name][0]) == (-1, 16)
+    assert env[h.name][1] == "float32"
+
+    # corrupt a declared shape: the rendering flags the contradiction
+    block.var(h.name).shape = (-1, 5)
+    text = debugger.pprint_block_codes(block)
+    assert "propagated" in text and "!" in text
+
+    # ops with no outputs must render, not crash
+    block.append_op("send", {"X": [h.name]}, {}, {})
+    text = debugger.pprint_block_codes(block, show_backward=True)
+    assert "send(" in text and "-> ()" in text
+    dot = debugger.draw_block_graphviz(block,
+                                       path=str(tmp_path / "g.dot"))
+    assert "digraph" in dot and (tmp_path / "g.dot").exists()

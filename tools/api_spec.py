@@ -92,6 +92,16 @@ def collect_op_desc():
             "diff_inputs": list(spec.diff_inputs or []) or None,
             "needs_rng": bool(spec.needs_rng),
             "is_optimizer": bool(spec.is_optimizer),
+            # inference-coverage column (static analysis, ISSUE 6):
+            # "declared" = a registered infer_shape spec fills output
+            # metadata directly; "eval_shape" = build-time inference leans
+            # on abstract-evaluating the lowering (registry.py fallback).
+            # The analysis shape checker's `no_inference` findings name
+            # ops where the fallback cannot abstract the lowering — fill
+            # those with registry.set_infer_shape / register_op(
+            # infer_shape=...) and this column flips to "declared".
+            "infer": ("declared" if spec.infer_shape is not None
+                      else "eval_shape"),
         }
     for name in sorted(_HOST_OPS):
         out.setdefault(name, {"host": True})

@@ -211,6 +211,32 @@ def _run_check_inner(out_dir: str) -> dict:
         assert delta == expect, \
             f"collective byte counter: got {delta}, want {expect}"
 
+    # --- static-analysis lint counter (docs/static_analysis.md) --------
+    # lint the same MLP program the train loop just ran: the program must
+    # be error-clean, and every finding must land in
+    # paddle_lint_findings_total{severity} so lint noise rides the same
+    # observability pipeline as the runtime telemetry
+    from paddle_tpu import analysis
+
+    def _lint_counts():
+        snap2 = default_registry().snapshot()
+        series = snap2.get("paddle_lint_findings_total", {}) \
+            .get("series", [])
+        return {s["labels"][0]: s["value"] for s in series}
+
+    lint_before = _lint_counts()
+    lint_res = analysis.analyze_program(prog, feed_names=["x", "y"],
+                                        fetch_names=[loss.name])
+    assert lint_res.ok, "trained MLP program has lint errors:\n" + \
+        "\n".join(f.format() for f in lint_res.errors)
+    lint_after = _lint_counts()
+    lint_delta = (sum(lint_after.values()) - sum(lint_before.values()))
+    assert lint_delta == len(lint_res.findings), \
+        f"paddle_lint_findings_total counted {lint_delta}, " \
+        f"expected {len(lint_res.findings)}"
+    assert lint_after.get("error", 0) == lint_before.get("error", 0), \
+        "error-severity lint findings appeared on the clean MLP program"
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -222,9 +248,12 @@ def _run_check_inner(out_dir: str) -> dict:
             prom_text.startswith(gauge), f"{gauge} missing from exposition"
     assert "paddle_collective_bytes_total" in prom_text, \
         "collective wire-byte counter missing from exposition"
+    assert 'paddle_lint_findings_total{severity=' in prom_text, \
+        "lint findings counter missing from exposition"
 
     return {"steps": len(records), "prom_samples": samples,
             "program_reports": len(reports),
+            "lint_findings": lint_after,
             "jsonl": jsonl_path, "prom": prom_path,
             "last_record": records[-1]}
 

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Program IR static verifier + comm-safety linter CLI
+(paddle_tpu/analysis/; checker catalog in docs/static_analysis.md).
+
+Usage:
+  python tools/paddle_lint.py --all-models            # lint every built-in
+  python tools/paddle_lint.py --model gpt --model mlp # a subset
+  python tools/paddle_lint.py --list-models
+  python tools/paddle_lint.py --all-models --json     # machine-readable
+  python tools/paddle_lint.py --all-models -v         # include INFO findings
+
+Exit status: non-zero iff any error-severity finding fires (the tier-1
+gate in tests/test_static_analysis.py runs exactly this). Every finding
+also increments ``paddle_lint_findings_total{severity}`` in the
+observability registry, gated by tools/metrics_check.py.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--all-models", action="store_true",
+                    help="lint every built-in model program")
+    ap.add_argument("--model", action="append", default=[],
+                    help="lint one built-in model (repeatable)")
+    ap.add_argument("--list-models", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON instead of text")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="include info-severity findings in text output")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import analysis
+
+    if args.list_models:
+        print("\n".join(analysis.model_names()))
+        return 0
+
+    names = analysis.model_names() if args.all_models else args.model
+    if not names:
+        ap.error("nothing to lint: pass --all-models or --model NAME")
+    unknown = sorted(set(names) - set(analysis.model_names()))
+    if unknown:
+        ap.error(f"unknown model(s) {unknown}; "
+                 f"known: {analysis.model_names()}")
+
+    results = analysis.lint_all_models(names)
+    if args.json:
+        payload = {
+            name: {
+                "summary": res.counts(),
+                "findings": [f.as_dict() for f in res.findings],
+            }
+            for name, res in sorted(results.items())
+        }
+        print(json.dumps(payload, indent=1))
+    else:
+        print(analysis.format_model_results(
+            results, verbose=args.verbose))
+    n_err = sum(len(r.errors) for r in results.values())
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
