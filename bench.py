@@ -409,6 +409,14 @@ def worker(use_flash: bool):
 
     monitor_path = next((a.split("=", 1)[1] for a in sys.argv
                          if a.startswith("--monitor=")), None)
+    # --checkpoint-dir=DIR [--checkpoint-interval=N]: periodic crash-safe
+    # checkpointing through the elastic store (docs/elastic.md); an existing
+    # committed checkpoint resumes the measured run (restored steps are
+    # skipped, so a preempted bench continues instead of restarting)
+    ckpt_dir = next((a.split("=", 1)[1] for a in sys.argv
+                     if a.startswith("--checkpoint-dir=")), None)
+    ckpt_interval = int(next((a.split("=", 1)[1] for a in sys.argv
+                              if a.startswith("--checkpoint-interval=")), 5))
     # --dump-on-anomaly=DIR: a NaN/Inf loss or a grad-norm blowup during a
     # monitored run writes a self-contained forensics directory (monitor
     # tail, fetch summaries, active program reports, flag state); implies
@@ -451,6 +459,18 @@ def worker(use_flash: bool):
              f"{time.perf_counter() - tc:.1f}s loss={loss0:.4f}")
         n_params = G.num_params(params)
         flops_tok = G.train_flops_per_token(cfg, n_params, T)
+        ck = start_step = None
+        if ckpt_dir:
+            from paddle_tpu.parallel.checkpoint import (ElasticCheckpointer,
+                                                        restore_train_state)
+
+            ck = ElasticCheckpointer(ckpt_dir, keep_last=2)
+            start_step = ck.latest_valid_step() or 0
+            if start_step:
+                params, opt, _man = restore_train_state(
+                    ck, params, opt, step=start_step)
+                _log(f"worker[{tag}]: resumed from checkpoint step "
+                     f"{start_step}")
         mon = None
         if monitor_path or dump_dir:
             from paddle_tpu.observability import TrainMonitor
@@ -462,24 +482,39 @@ def worker(use_flash: bool):
                 peak_flops=_peak_flops(dev),
                 extra_static={"config": tag},
                 dump_on_anomaly=dump_dir)
+        start0 = min(start_step or 0, steps)
+        ran = max(1, steps - start0)
+
+        def maybe_ckpt(i):
+            # async save (host snapshot is the only sync point); the final
+            # step commits synchronously so a resumed bench is consistent
+            if ck is not None and (i + 1 == steps or
+                                   (i + 1) % ckpt_interval == 0):
+                ck.save(i + 1, {"params": params, "opt": opt},
+                        data_state={"epoch": 0, "offset": i + 1})
+
         t0 = time.perf_counter()
         if mon is not None:
-            for i in range(steps):
+            for i in range(start0, steps):
                 with mon.step() as s:
                     params, opt, loss, gnorm = step(params, opt, tokens,
                                                     labels)
                     s.dispatched()
                     s.observe(loss=loss, grad_norm=gnorm)
+                maybe_ckpt(i)
             loss_v = mon.last_record.get("loss")
             mon.close()
         else:
-            for i in range(steps):
+            for i in range(start0, steps):
                 params, opt, loss, _ = step(params, opt, tokens, labels)
+                maybe_ckpt(i)
             loss_v = float(loss)  # forces the whole chain
         dt = time.perf_counter() - t0
-        _log(f"worker[{tag}]: {steps} steps in {dt:.2f}s "
-             f"({dt / steps * 1000:.0f} ms/step)")
-        tokens_per_s = steps * batch * T / dt
+        if ck is not None:
+            ck.close()
+        _log(f"worker[{tag}]: {ran} steps in {dt:.2f}s "
+             f"({dt / ran * 1000:.0f} ms/step)")
+        tokens_per_s = ran * batch * T / dt
         mfu = tokens_per_s * flops_tok / _peak_flops(dev)
         return tokens_per_s, mfu, loss_v, n_params
 

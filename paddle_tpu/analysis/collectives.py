@@ -38,6 +38,15 @@ COMM_OPS = {
 _CTRL_FLOW_OPS = {"while", "conditional_block", "conditional_block_infer",
                   "recurrent", "dynamic_rnn"}
 
+# restore-time resharding collectives (parallel/checkpoint.py's
+# build_restore_broadcast_program tags them): they naturally sit under a
+# found-checkpoint conditional, but the predicate is rank-UNIFORM by
+# construction — every rank selects the same latest COMMITTED step from
+# the shared store's atomic manifest, so the rank-divergent-predicate
+# deadlock cannot occur.  Downgraded to INFO instead of silenced: the
+# annotation is a declaration, and reviewers should still see it.
+RESTORE_RESHARD_ATTR = "__restore_reshard__"
+
 
 def _collective_sig(program) -> List[Tuple[int, int, str, str, str, tuple]]:
     """Ordered (block_idx, op_idx, type, ring_id, dtype, shape) of every
@@ -135,13 +144,25 @@ def check_collectives(ctx: AnalysisContext):
             if op.type not in COMM_OPS:
                 continue
             if block.idx in ctrl_blocks:
-                findings.append(Finding(
-                    checker="comm_safety", code="conditional_collective",
-                    severity=ERROR, block_idx=block.idx, op_idx=i,
-                    op_type=op.type,
-                    message=f"collective {op.type!r} sits under "
-                            "data-dependent control flow — a rank-"
-                            "divergent predicate deadlocks the mesh"))
+                if op.attr(RESTORE_RESHARD_ATTR):
+                    findings.append(Finding(
+                        checker="comm_safety",
+                        code="restore_conditional_collective",
+                        severity=INFO, block_idx=block.idx, op_idx=i,
+                        op_type=op.type,
+                        message=f"restore-reshard collective {op.type!r} "
+                                "under the found-checkpoint conditional: "
+                                "accepted — the predicate is rank-uniform "
+                                "(all ranks select the same committed "
+                                "step, docs/elastic.md)"))
+                else:
+                    findings.append(Finding(
+                        checker="comm_safety", code="conditional_collective",
+                        severity=ERROR, block_idx=block.idx, op_idx=i,
+                        op_type=op.type,
+                        message=f"collective {op.type!r} sits under "
+                                "data-dependent control flow — a rank-"
+                                "divergent predicate deadlocks the mesh"))
             ring = int(op.attr("ring_id", 0))
             if has_mesh and ring_axes and ring not in ring_axes:
                 findings.append(Finding(

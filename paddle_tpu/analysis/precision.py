@@ -42,6 +42,13 @@ _SUM_COLLECTIVES = {"c_allreduce_sum", "c_allreduce_avg", "c_reducescatter",
 # attrs that mark a deliberate low-precision choice on the op itself
 _OPT_IN_ATTRS = ("use_fp32_acc", "acc_dtype", "__amp_opt_in__")
 
+# restore-time resharding collectives (parallel/checkpoint.py tags them):
+# they REDISTRIBUTE committed checkpoint state verbatim — single-writer
+# data movement with no multi-term accumulation, so the sub-f32 ring-
+# accumulation hazard does not apply whatever the var dtype (bf16 moments
+# restore through c_broadcast/c_allgather losslessly)
+RESTORE_RESHARD_ATTR = "__restore_reshard__"
+
 
 def _floating_sub_f32(block, names) -> Optional[str]:
     """First input var whose dtype is a sub-f32 float; None when any input
@@ -69,6 +76,8 @@ def check_precision(ctx: AnalysisContext):
         for i, op in enumerate(block.ops):
             names = [n for ns in op.inputs.values() for n in ns]
             if op.type in _SUM_COLLECTIVES:
+                if op.attr(RESTORE_RESHARD_ATTR):
+                    continue
                 has_sum_collective = True
                 var = _floating_sub_f32(block, op.input("X") or names)
                 if var is not None:

@@ -1029,7 +1029,8 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
                            fetch_list=None, fetch_info=None,
-                           print_period: int = 100, monitor=None):
+                           print_period: int = 100, monitor=None,
+                           checkpoint_dir=None, checkpoint_interval=None):
         """Dataset trainer path — parity with fluid/executor.py:1448.
 
         The reference hands the Dataset to C++ trainer threads
@@ -1047,10 +1048,23 @@ class Executor:
         sync the first fetch each step — that per-step device wait is the
         quantity being measured; leave monitor=None for the fully-async
         fast path.
+
+        ``checkpoint_dir`` + ``checkpoint_interval``: periodic async
+        crash-safe checkpointing (docs/elastic.md).  Every ``interval``
+        steps the program's persistable vars plus the dataset position
+        ({"epoch", "offset"}) are committed through
+        ``parallel.checkpoint.ElasticCheckpointer`` (write overlapped with
+        the next steps); on entry, the latest committed step is restored
+        and the already-consumed batches are skipped, so a preempted job
+        resumes deterministically.  A SIGTERM/SIGINT mid-train triggers a
+        final synchronous checkpoint and a clean return (the launcher's
+        grace-period contract).
         """
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period, train=True,
-                                      thread=thread, monitor=monitor)
+                                      thread=thread, monitor=monitor,
+                                      checkpoint_dir=checkpoint_dir,
+                                      checkpoint_interval=checkpoint_interval)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
@@ -1062,9 +1076,31 @@ class Executor:
                                       fetch_info, print_period, train=False,
                                       thread=thread, monitor=monitor)
 
+    def _checkpoint_state(self, program, scope) -> Dict[str, Any]:
+        """Persistable vars (the trainable state) as host arrays — the
+        checkpoint payload.  Host conversion here is the snapshot point."""
+        out: Dict[str, Any] = {}
+        for name, v in program.global_block().vars.items():
+            if not v.persistable or v.is_data:
+                continue
+            val = scope.find_var(name)
+            if val is not None:
+                out[name] = np.asarray(val)
+        return out
+
+    def _restore_checkpoint_state(self, program, scope, state) -> int:
+        block = program.global_block()
+        n = 0
+        for name, arr in state.items():
+            if name in block.vars and block.vars[name].persistable:
+                scope.set_var(name, jnp.asarray(arr))
+                n += 1
+        return n
+
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
                           fetch_info, print_period, train: bool,
-                          thread: int = 0, monitor=None):
+                          thread: int = 0, monitor=None,
+                          checkpoint_dir=None, checkpoint_interval=None):
         if dataset is None:
             raise ValueError("dataset must be provided")
         program = program or default_main_program()
@@ -1087,15 +1123,49 @@ class Executor:
                        if not feed_names or k in feed_names
                        or k.endswith("__len")}
 
+        # elastic checkpointing (docs/elastic.md): restore the latest
+        # committed step into the scope, skip the consumed batches, and
+        # save periodically / on preemption
+        ckpt = preempt = None
+        start_offset = 0
+        if train and checkpoint_dir:
+            from ..parallel.checkpoint import ElasticCheckpointer
+            from ..parallel.launch import install_preemption_handler
+
+            scope = scope or global_scope()
+            ckpt = ElasticCheckpointer(checkpoint_dir, keep_last=3)
+            latest = ckpt.latest_valid_step()
+            if latest is not None:
+                state, man = ckpt.restore(latest)
+                n_restored = self._restore_checkpoint_state(
+                    program, scope, state)
+                start_offset = int((man.get("data") or {}).get("offset", 0))
+                logger.info(
+                    "resumed %d persistables from checkpoint step %d "
+                    "(skipping %d consumed batches)",
+                    n_restored, latest, start_offset)
+            preempt = install_preemption_handler()
+
+        def _save_ckpt(step_no: int, sync: bool = False):
+            ckpt.save(step_no, self._checkpoint_state(program, scope),
+                      data_state={"epoch": 0, "offset": step_no})
+            if sync:
+                ckpt.wait()
+
         # overlap host batch assembly + device transfer with the in-flight
         # (asynchronously dispatched) step; fetches stay on device between
         # print boundaries so the loop never blocks on the step it just
         # launched
         from ..reader import prefetch_to_device
 
-        step = 0
+        stream = filtered()
+        if start_offset:
+            import itertools
+
+            stream = itertools.islice(stream, start_offset, None)
+        step = start_offset
         last_fetch = None
-        for feed in prefetch_to_device(filtered(), size=2):
+        for feed in prefetch_to_device(stream, size=2):
             if monitor is not None:
                 if monitor.examples_per_step is None:
                     # infer the per-step example count from the batch dim
@@ -1121,6 +1191,17 @@ class Executor:
                                       fetch_list=fetch_list, scope=scope,
                                       return_numpy=False)
             step += 1
+            if ckpt is not None:
+                if preempt is not None and preempt.triggered:
+                    # the launcher's SIGTERM grace window: checkpoint
+                    # synchronously and return cleanly
+                    logger.info("preemption signal at step %d: "
+                                "checkpointing and exiting", step)
+                    _save_ckpt(step, sync=True)
+                    break
+                if checkpoint_interval and \
+                        step % int(checkpoint_interval) == 0:
+                    _save_ckpt(step)
             if fetch_list and print_period and step % print_period == 0:
                 # the only per-step host sync point (monitor excepted),
                 # and only when printing
@@ -1130,6 +1211,11 @@ class Executor:
                     for name, val in zip(fetch_info, last_fetch))
                 _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
                 logger.info("step %d: %s", step, msg)
+        if ckpt is not None:
+            if step > start_offset and not (preempt is not None
+                                            and preempt.triggered):
+                _save_ckpt(step, sync=True)
+            ckpt.close()
         if last_fetch is not None:
             t0 = time.perf_counter_ns()
             last_fetch = [np.asarray(v) for v in last_fetch]

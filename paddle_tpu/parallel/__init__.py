@@ -6,7 +6,12 @@ from . import comm_opt  # noqa: F401
 from . import env  # noqa: F401
 from . import remat  # noqa: F401
 from .comm_opt import CommConfig  # noqa: F401
-from .launch import launch  # noqa: F401
+from .launch import (  # noqa: F401
+    init_collective_with_retry, install_preemption_handler, launch,
+    preemption_signal,
+)
 from .checkpoint import (  # noqa: F401
-    ShardedCheckpointer, abstract_for_mesh, abstract_like,
+    CheckpointCorruptError, CheckpointError, ElasticCheckpointer,
+    ShardedCheckpointer, abstract_for_mesh, abstract_like, reshard_flat,
+    restore_train_state,
 )

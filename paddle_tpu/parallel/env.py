@@ -57,9 +57,19 @@ def init_distributed_env(coordinator: Optional[str] = None) -> None:
             "multi-trainer env without PADDLE_TRAINER_ENDPOINTS — cannot "
             "determine the jax.distributed coordinator address"
         )
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=trainer_num(),
-        process_id=trainer_id(),
+    # a slow-starting peer (or a coordinator that isn't bound yet) raises a
+    # connect error on the fast ranks — retry with backoff instead of
+    # failing the whole gang (docs/elastic.md)
+    from .launch import init_collective_with_retry
+
+    init_collective_with_retry(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=trainer_num(),
+            process_id=trainer_id(),
+        ),
+        retries=int(os.environ.get("PADDLE_INIT_RETRIES", "5")),
+        backoff_s=0.5,
+        log=lambda m: print(f"[init_distributed_env] {m}", flush=True),
     )
     _initialized = True

@@ -1,7 +1,22 @@
 """Multi-process launcher — parity with python/paddle/distributed/launch.py
 (:193 launch, utils.py:338-375 env contract): spawns one worker process per
-device/host slot, sets the PADDLE_* env, watches children and aborts the job
-on any failure (TrainerProc watch loop parity).
+device/host slot, sets the PADDLE_* env, and supervises the gang.
+
+The reference's TrainerProc watch loop aborts the whole job on any failure;
+this launcher is the elastic superset (ROADMAP item 4, docs/elastic.md):
+
+- **Graceful shutdown**: a dying gang gets SIGTERM, a grace period to
+  checkpoint-and-exit (workers install :func:`install_preemption_handler`),
+  then SIGKILL.  The first failing child's exit code propagates (signal
+  deaths map to the shell convention 128+N).
+- **Preemption tolerance**: SIGTERM/SIGINT on the launcher is trapped and
+  forwarded to the children, which checkpoint and exit cleanly; the
+  launcher then returns 0 so an external scheduler sees a clean preemption.
+- **Supervised restarts**: ``max_restarts > 0`` restarts the whole gang
+  after a worker failure (collective jobs cannot survive a lone member —
+  every rank restarts together and resumes from the latest committed
+  checkpoint), with exponential backoff between attempts.  Restarts count
+  into ``paddle_restarts_total{cause=}`` through the PR 3 registry.
 
 On TPU the normal deployment is one process per HOST (all local chips in one
 process), so --nproc_per_node defaults to 1; the per-GPU spawning of the
@@ -13,8 +28,16 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
+
+from ..observability import metrics as _obs_metrics
+
+_m_restarts = _obs_metrics.default_registry().counter(
+    "paddle_restarts_total",
+    "Supervised gang restarts by cause (worker_exit, worker_signal)",
+    ("cause",))
 
 
 def get_cluster_endpoints(node_ips: List[str], nproc_per_node: int,
@@ -26,64 +49,247 @@ def get_cluster_endpoints(node_ips: List[str], nproc_per_node: int,
     return eps
 
 
+# ---------------------------------------------------------------------------
+# Worker-side helpers
+# ---------------------------------------------------------------------------
+
+class PreemptionSignal:
+    """Process-wide preemption flag set by SIGTERM/SIGINT.  Training loops
+    poll :attr:`triggered` (or :meth:`check`) at step boundaries, save a
+    checkpoint, and exit cleanly — the launcher's grace period exists
+    exactly for this."""
+
+    def __init__(self):
+        self.triggered = False
+        self.signum: Optional[int] = None
+        self._callbacks: List[Callable[[], None]] = []
+
+    def check(self) -> bool:
+        return self.triggered
+
+    def reset(self) -> None:
+        """Clear the flag (tests, or a loop that handled the preemption
+        itself and decided to continue)."""
+        self.triggered = False
+        self.signum = None
+
+    def add_callback(self, fn: Callable[[], None]) -> None:
+        self._callbacks.append(fn)
+
+    def _fire(self, signum):
+        self.triggered = True
+        self.signum = signum
+        for fn in list(self._callbacks):
+            try:
+                fn()
+            except Exception:
+                pass
+
+
+_preemption: Optional[PreemptionSignal] = None
+
+
+def install_preemption_handler(
+        signals=(signal.SIGTERM, signal.SIGINT)) -> PreemptionSignal:
+    """Install (or return the already-installed) preemption trap.  Safe to
+    call repeatedly; outside the main thread (where signal handlers cannot
+    be installed) the returned flag simply never fires."""
+    global _preemption
+    if _preemption is not None:
+        return _preemption
+    sig = PreemptionSignal()
+
+    def handler(signum, frame):
+        sig._fire(signum)
+
+    if threading.current_thread() is threading.main_thread():
+        for s in signals:
+            signal.signal(s, handler)
+    _preemption = sig
+    return sig
+
+
+def preemption_signal() -> Optional[PreemptionSignal]:
+    """The installed preemption trap, if any (None before install)."""
+    return _preemption
+
+
+def init_collective_with_retry(init_fn: Callable[[], None],
+                               retries: int = 5, backoff_s: float = 0.5,
+                               backoff_max_s: float = 8.0,
+                               log=None) -> None:
+    """Retry-with-backoff around collective/backend bring-up
+    (``jax.distributed.initialize`` or a custom bootstrap): a slow-starting
+    peer raises a connect error on the fast ranks — retrying with
+    exponential backoff instead of failing the job lets the gang converge.
+    Re-raises the last error after ``retries`` failed attempts."""
+    delay = backoff_s
+    for attempt in range(1, max(1, retries) + 1):
+        try:
+            init_fn()
+            return
+        except Exception as e:
+            if attempt >= retries:
+                raise
+            if log is not None:
+                log(f"collective init attempt {attempt}/{retries} failed "
+                    f"({e!r}); retrying in {delay:.1f}s")
+            time.sleep(delay)
+            delay = min(delay * 2, backoff_max_s)
+
+
+# ---------------------------------------------------------------------------
+# Launcher / supervisor
+# ---------------------------------------------------------------------------
+
+def _exit_code(ret: int) -> int:
+    """Popen returncode -> propagated exit code (signal death N -> 128+N,
+    the shell convention)."""
+    return 128 - ret if ret < 0 else ret
+
+
+def _stop_gang(procs, grace_period_s: float, sig=signal.SIGTERM):
+    """Graceful shutdown: ``sig`` to every live child, wait up to the grace
+    period for them to checkpoint-and-exit, then SIGKILL stragglers."""
+    for _, p, _ in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(sig)
+            except OSError:
+                pass
+    deadline = time.time() + max(0.0, grace_period_s)
+    for _, p, _ in procs:
+        if p.poll() is not None:
+            continue
+        remaining = deadline - time.time()
+        try:
+            p.wait(timeout=max(0.1, remaining))
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for _, p, _ in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
 def launch(training_script: str, script_args: Optional[List[str]] = None,
            cluster_node_ips: str = "127.0.0.1", node_ip: str = "127.0.0.1",
            nproc_per_node: int = 1, started_port: int = 6070,
-           log_dir: Optional[str] = None, perf_flags: bool = True) -> int:
+           log_dir: Optional[str] = None, perf_flags: bool = True,
+           max_restarts: int = 0, restart_backoff_s: float = 1.0,
+           restart_backoff_max_s: float = 30.0,
+           grace_period_s: float = 15.0) -> int:
+    """Spawn and supervise the worker gang; returns the job's exit code
+    (0 on success or clean preemption; otherwise the FIRST failing child's
+    exit code, with signal deaths mapped to 128+N)."""
     from ..sysconfig import tpu_perf_flags
 
     node_ips = [ip.strip() for ip in cluster_node_ips.split(",")]
     endpoints = get_cluster_endpoints(node_ips, nproc_per_node, started_port)
     node_rank = node_ips.index(node_ip)
-    procs = []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-    for local_rank in range(nproc_per_node):
-        rank = node_rank * nproc_per_node + local_rank
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-        })
-        if perf_flags:
-            # comm/compute-overlap preset into each worker's XLA_FLAGS
-            # BEFORE its backend init (no-op unless the worker env targets
-            # a TPU — the platform gate in sysconfig.tpu_perf_flags)
-            tpu_perf_flags(env=env)
-        out = (open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
-               if log_dir else None)
-        p = subprocess.Popen(
-            [sys.executable, training_script] + list(script_args or []),
-            env=env, stdout=out, stderr=subprocess.STDOUT if out else None,
-        )
-        procs.append((rank, p, out))
-    all_procs = list(procs)
 
-    # watch loop: abort the whole job if any worker dies (parity with
-    # distributed/utils.py TrainerProc watch)
+    def spawn_gang(attempt: int):
+        procs = []
+        for local_rank in range(nproc_per_node):
+            rank = node_rank * nproc_per_node + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_RESTART_ATTEMPT": str(attempt),
+            })
+            if perf_flags:
+                # comm/compute-overlap preset into each worker's XLA_FLAGS
+                # BEFORE its backend init (no-op unless the worker env
+                # targets a TPU — the platform gate in sysconfig)
+                tpu_perf_flags(env=env)
+            # append mode: a restarted worker's log continues the file
+            out = (open(os.path.join(log_dir, f"worker.{rank}.log"), "a")
+                   if log_dir else None)
+            p = subprocess.Popen(
+                [sys.executable, training_script] + list(script_args or []),
+                env=env, stdout=out,
+                stderr=subprocess.STDOUT if out else None,
+            )
+            procs.append((rank, p, out))
+        return procs
+
+    # preemption trap: forward to children, give them the grace period to
+    # checkpoint, then return cleanly (main thread only — signal handlers
+    # cannot install elsewhere, e.g. under pytest workers calling us from
+    # a thread)
+    preempted = {"flag": False}
+    old_handlers = {}
+    in_main = threading.current_thread() is threading.main_thread()
+    if in_main:
+        def _trap(signum, frame):
+            preempted["flag"] = True
+        for s in (signal.SIGTERM, signal.SIGINT):
+            old_handlers[s] = signal.signal(s, _trap)
+
+    all_procs: List = []
     exit_code = 0
+    restarts = 0
+    backoff = restart_backoff_s
     try:
-        while procs:
-            alive = []
+        procs = spawn_gang(0)
+        all_procs = list(procs)
+        while True:
+            if preempted["flag"]:
+                sys.stderr.write("launch: preemption signal — forwarding "
+                                 "SIGTERM to workers\n")
+                _stop_gang(procs, grace_period_s)
+                # a clean preemption (children checkpointed and exited 0)
+                # is a clean job exit; a child that died badly propagates
+                codes = [_exit_code(p.poll()) for _, p, _ in procs
+                         if p.poll() not in (0, None)]
+                exit_code = codes[0] if codes else 0
+                break
+            alive, failed = [], None
             for rank, p, out in procs:
                 ret = p.poll()
                 if ret is None:
                     alive.append((rank, p, out))
-                elif ret != 0:
-                    exit_code = ret
-                    sys.stderr.write(f"worker {rank} exited with {ret}; "
-                                     "terminating job\n")
-                    for _, q, _ in procs:
-                        if q.poll() is None:
-                            q.send_signal(signal.SIGTERM)
-                    alive = []
-                    break
+                elif ret != 0 and failed is None:
+                    failed = (rank, ret)
+            if failed is not None:
+                rank, ret = failed
+                code = _exit_code(ret)
+                cause = "worker_signal" if ret < 0 else "worker_exit"
+                sys.stderr.write(
+                    f"launch: worker {rank} exited with {ret} "
+                    f"(code {code})\n")
+                _stop_gang(procs, grace_period_s)
+                if restarts < max_restarts:
+                    restarts += 1
+                    _m_restarts.labels(cause).inc()
+                    sys.stderr.write(
+                        f"launch: restarting gang (attempt {restarts}/"
+                        f"{max_restarts}) in {backoff:.1f}s\n")
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, restart_backoff_max_s)
+                    for _, _, out in procs:
+                        if out:
+                            out.close()
+                    procs = spawn_gang(restarts)
+                    all_procs.extend(procs)
+                    continue
+                exit_code = code
+                break
             procs = alive
-            if procs:
-                time.sleep(1)
+            if not procs:
+                break       # every worker exited 0
+            time.sleep(0.2)
     finally:
+        if in_main:
+            for s, h in old_handlers.items():
+                signal.signal(s, h)
         # terminate, then reap every child and close its log handle so a
         # failed job leaves no zombies and no buffered log tail unflushed
         for _, p, out in all_procs:
@@ -95,7 +301,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
-            if out:
+            if out and not out.closed:
                 out.close()
     return exit_code
 
@@ -109,6 +315,12 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
     ap.add_argument("--nproc_per_node", type=int, default=1)
     ap.add_argument("--started_port", type=int, default=6070)
     ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--max_restarts", type=int, default=0,
+                    help="restart the gang up to N times after a worker "
+                         "failure (exponential backoff)")
+    ap.add_argument("--restart_backoff", type=float, default=1.0)
+    ap.add_argument("--grace_period", type=float, default=15.0,
+                    help="seconds between SIGTERM and SIGKILL at shutdown")
     ap.add_argument("--no_perf_flags", action="store_true",
                     help="skip the sysconfig.tpu_perf_flags XLA preset")
     ap.add_argument("training_script")
@@ -117,7 +329,10 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
     sys.exit(launch(args.training_script, args.script_args,
                     args.cluster_node_ips, args.node_ip, args.nproc_per_node,
                     args.started_port, args.log_dir,
-                    perf_flags=not args.no_perf_flags))
+                    perf_flags=not args.no_perf_flags,
+                    max_restarts=args.max_restarts,
+                    restart_backoff_s=args.restart_backoff,
+                    grace_period_s=args.grace_period))
 
 
 if __name__ == "__main__":

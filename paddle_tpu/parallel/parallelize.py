@@ -398,6 +398,22 @@ def _rs_param_layout(cfg: GPTConfig, pcfg: ParallelConfig,
     return layout, specs, treedef
 
 
+def rs_param_layout(cfg: GPTConfig, pcfg: ParallelConfig,
+                    comm: Optional[CommConfig] = None,
+                    **comm_kw) -> Tuple[Any, int]:
+    """Public accessor for the reduce-scatter bucket layout: returns
+    ``(BucketLayout, repl)`` where ``repl`` (= pp*tp) is how many times each
+    dp shard repeats in the addressable flat moment buffer
+    (``init_sharded`` shards it over EVERY mesh axis).  Checkpoint
+    manifests record exactly this pair so a restore onto a different dp
+    can reshard the moments bit-exactly
+    (parallel/checkpoint.py:reshard_flat, docs/elastic.md)."""
+    ccfg = comm if comm is not None else CommConfig(
+        grad_reduce="reduce_scatter", **comm_kw)
+    layout, _, _ = _rs_param_layout(cfg, pcfg, ccfg)
+    return layout, pcfg.pp * pcfg.tp
+
+
 def _spec_axes(spec: P):
     out = set()
     for entry in spec:

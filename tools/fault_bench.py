@@ -1,0 +1,446 @@
+#!/usr/bin/env python
+"""Fault-injection harness for the elastic training layer (docs/elastic.md).
+
+Proves the ISSUE 7 acceptance bar end-to-end on the 8-virtual-device CPU
+mesh: workers are killed mid-step (SIGKILL and SIGTERM), a checkpoint shard
+is truncated, a partial (uncommitted) checkpoint is planted — and the job
+recovers automatically through ``parallel.launch``'s supervised restarts,
+resuming from the latest *committed* checkpoint to loss parity with an
+uninterrupted run (bit-exact at equal dp; the dp=8 -> dp=4 resharded
+restore is itself proven bit-exact via per-leaf moment checksums).
+
+Scenarios (full mode; ``--smoke`` runs the starred subset on a tinier
+config for the tier-1 lane):
+
+  baseline          uninterrupted run -> reference final loss + param crc
+  sigkill_midstep * worker SIGKILLs itself mid-step on its first
+                    incarnation; the supervisor restarts it (backoff) and
+                    it replays from the last committed step -> bit-exact
+  sigterm_preempt   worker gets SIGTERM, checkpoints-and-exits cleanly
+                    (the launcher grace-period contract); a relaunch
+                    resumes -> bit-exact
+  corrupt_shard   * newest checkpoint gets a truncated shard AND a fake
+                    partial (no-COMMIT) step dir; the restart must skip
+                    both and restore the older committed step -> bit-exact
+  dp_reshard        save at dp=8, restore at dp=4 (flat dp-sharded moments
+                    resharded through the manifest bucket layouts);
+                    restore proven bit-exact by leaf checksums, training
+                    continues to loss parity within tolerance
+
+Writes FAULT_BENCH.json.  Usage:
+
+  python tools/fault_bench.py [--smoke] [--out FAULT_BENCH.json]
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEVICES = 8
+
+
+def _log(msg):
+    print(f"[fault_bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _force_cpu_mesh():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={N_DEVICES}")
+
+
+# ---------------------------------------------------------------------------
+# Worker: one training incarnation (spawned via parallel.launch)
+# ---------------------------------------------------------------------------
+
+def _batch(step, cfg, batch, seqlen):
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + step)
+    toks = rng.integers(0, cfg.vocab_size, (1, batch, seqlen), dtype=np.int32)
+    labs = rng.integers(0, cfg.vocab_size, (1, batch, seqlen), dtype=np.int32)
+    return toks, labs
+
+
+def _params_crc(tree):
+    import jax
+    import numpy as np
+
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        crc = zlib.crc32(np.asarray(leaf).tobytes(), crc)
+    return crc
+
+
+def _moment_leaf_crcs(mvec, layout, repl):
+    """Per-leaf crc32 of the flat moment buffer's leaves — the layout-
+    independent identity of the optimizer state (reshard-proof)."""
+    import numpy as np
+
+    from paddle_tpu.parallel.checkpoint import reshard_flat
+
+    # normalize to repl=1 in the same layout, then walk entries
+    flat = reshard_flat(np.asarray(mvec), layout, layout,
+                        src_repl=repl, dst_repl=1)
+    out, off = {}, 0
+    for b in layout.buckets:
+        for idx, _shape, numel in b.entries:
+            out[str(idx)] = zlib.crc32(flat[off:off + numel].tobytes())
+            off += numel
+        off += b.pad
+    return out
+
+
+def worker(args):
+    _force_cpu_mesh()
+    import numpy as np  # noqa: F401
+    import jax
+
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel import parallelize as PZ
+    from paddle_tpu.parallel.checkpoint import (ElasticCheckpointer,
+                                                restore_train_state)
+    from paddle_tpu.parallel.launch import install_preemption_handler
+
+    preempt = install_preemption_handler()
+    cfg = G.GPT_TINY.scaled(num_layers=args.layers)
+    pcfg = PZ.ParallelConfig(dp=args.dp, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg)
+    layout, repl = PZ.rs_param_layout(cfg, pcfg)
+    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh,
+                                  grad_reduce="reduce_scatter")
+    step_fn = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-2,
+                                 grad_reduce="reduce_scatter")
+
+    ck = ElasticCheckpointer(args.ckpt_dir, keep_last=args.keep_last)
+    start = 0
+    restored_from = None
+    reshard_bit_exact = None
+    latest = ck.latest_valid_step()
+    if latest is not None:
+        params, opt, man = restore_train_state(
+            ck, params, opt, layout=layout, layout_repl=repl, step=latest)
+        start = int(man["step"])
+        restored_from = start
+        want = (man.get("extra") or {}).get("moment_leaf_crcs")
+        if want is not None:
+            got = _moment_leaf_crcs(opt["m"], layout, repl)
+            reshard_bit_exact = (got == want)
+        _log(f"worker pid={os.getpid()} restored step {start} "
+             f"(reshard_bit_exact={reshard_bit_exact})")
+
+    with open(os.path.join(args.ckpt_dir, "incarnations.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "pid": os.getpid(), "start_step": start,
+            "restored_from": restored_from,
+            "reshard_bit_exact": reshard_bit_exact,
+            "attempt": int(os.environ.get("PADDLE_RESTART_ATTEMPT", 0)),
+        }) + "\n")
+
+    def save(step_no):
+        ck.save(step_no, {"params": params, "opt": opt},
+                mesh={"dp": args.dp, "pp": 1, "tp": 1},
+                layout=layout, layout_repl=repl,
+                data_state={"epoch": 0, "offset": step_no},
+                extra={"moment_leaf_crcs":
+                       _moment_leaf_crcs(opt["m"], layout, repl)})
+        # commit synchronously: the harness injects faults deterministically
+        # against "step N is committed" (async overlap is covered by
+        # tests/test_elastic.py and the executor path)
+        ck.wait()
+
+    loss = None
+    for step in range(start + 1, args.steps + 1):
+        if preempt.triggered:
+            _log(f"worker preempted at step {step - 1}: checkpoint + exit 0")
+            save(step - 1)
+            ck.close()
+            sys.exit(0)
+        toks, labs = _batch(step, cfg, args.batch, args.seqlen)
+        params, opt, loss, _ = step_fn(params, opt, toks, labs)
+        if args.die_at and step == args.die_at and args.once_marker and \
+                not os.path.exists(args.once_marker):
+            # first incarnation only: fault-inject on ourselves mid-interval
+            # (the step's update is live but NOT yet checkpointed)
+            with open(args.once_marker, "w") as f:
+                f.write(str(os.getpid()))
+            sig = getattr(signal, f"SIG{args.die_sig}")
+            _log(f"worker self-injecting SIG{args.die_sig} at step {step}")
+            os.kill(os.getpid(), sig)
+            if args.die_sig == "TERM":
+                # handler has set the flag; honor the grace contract now
+                save(step)
+                ck.close()
+                sys.exit(0)
+            time.sleep(30)  # SIGKILL lands before this returns
+        if step % args.interval == 0:
+            save(step)
+
+    final_loss = float(loss) if loss is not None else None
+    result = {
+        "final_step": args.steps, "final_loss": final_loss,
+        "params_crc": _params_crc(params),
+        "restored_from": restored_from,
+        "reshard_bit_exact": reshard_bit_exact,
+        "dp": args.dp,
+    }
+    save(args.steps)
+    ck.close()
+    tmp = args.result + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, args.result)
+    _log(f"worker done: {result}")
+
+
+# ---------------------------------------------------------------------------
+# Harness
+# ---------------------------------------------------------------------------
+
+def _worker_args(ns, **over):
+    d = dict(ns)
+    d.update(over)
+    out = [os.path.abspath(__file__), "--worker"]
+    for k, v in d.items():
+        if v is not None:
+            out.append(f"--{k.replace('_', '-')}={v}")
+    return out[1:]  # launch() gets (script, args)
+
+
+def _run_job(base, max_restarts=2, **over):
+    """One supervised job: returns (rc, result dict or None)."""
+    from paddle_tpu.parallel.launch import launch
+
+    args = _worker_args(base, **over)
+    rc = launch(os.path.abspath(__file__), args, max_restarts=max_restarts,
+                restart_backoff_s=0.2, restart_backoff_max_s=1.0,
+                grace_period_s=20.0)
+    result_path = over.get("result") or base["result"]
+    result = None
+    if os.path.exists(result_path):
+        with open(result_path) as f:
+            result = json.load(f)
+    return rc, result
+
+
+def _incarnations(ckpt_dir):
+    path = os.path.join(ckpt_dir, "incarnations.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+def _match(got, want):
+    if got is None or want is None:
+        return "missing"
+    if got == want:
+        return "bit_exact"
+    rel = abs(got - want) / max(1e-12, abs(want))
+    return f"rel_diff={rel:.3e}"
+
+
+def harness(smoke, out_path):
+    _force_cpu_mesh()
+    t0 = time.time()
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="fault_bench_")
+    _log(f"workdir {work} (smoke={smoke})")
+
+    if smoke:
+        base = dict(dp=2, layers=1, batch=4, seqlen=16, steps=4, interval=2,
+                    keep_last=3)
+        die_at = 3
+    else:
+        base = dict(dp=8, layers=2, batch=8, seqlen=32, steps=8, interval=2,
+                    keep_last=3)
+        die_at = 5
+
+    scenarios = {}
+    ok = True
+
+    def run(name, **over):
+        ckpt = os.path.join(work, name)
+        os.makedirs(ckpt, exist_ok=True)
+        ns = dict(base, ckpt_dir=ckpt,
+                  result=os.path.join(work, f"{name}.json"))
+        ns.update(over)
+        return ns
+
+    # --- baseline --------------------------------------------------------
+    ns = run("baseline")
+    rc, baseline = _run_job(ns, max_restarts=0)
+    assert rc == 0 and baseline, f"baseline failed rc={rc}"
+    scenarios["baseline"] = baseline
+    _log(f"baseline loss {baseline['final_loss']}")
+
+    # --- SIGKILL mid-step: supervisor restart recovers -------------------
+    ns = run("sigkill_midstep", die_at=die_at, die_sig="KILL",
+             once_marker=os.path.join(work, "sigkill.marker"))
+    rc, res = _run_job(ns, max_restarts=2)
+    inc = _incarnations(ns["ckpt_dir"])
+    expect_restore = (die_at // base["interval"]) * base["interval"]
+    s = {
+        "rc": rc, "result": res,
+        "incarnations": len(inc),
+        "supervisor_restarts": max(0, len(inc) - 1),
+        "restored_from": [r["restored_from"] for r in inc],
+        "expected_restore": expect_restore,
+        "match_baseline": _match(res and res["final_loss"],
+                                 baseline["final_loss"]),
+        "params_match": bool(res) and
+            res["params_crc"] == baseline["params_crc"],
+    }
+    s["pass"] = (rc == 0 and s["supervisor_restarts"] >= 1
+                 and inc and inc[-1]["restored_from"] == expect_restore
+                 and s["match_baseline"] == "bit_exact" and s["params_match"])
+    scenarios["sigkill_midstep"] = s
+    ok &= s["pass"]
+    _log(f"sigkill_midstep: {s['pass']} ({s['match_baseline']})")
+
+    # --- corrupt shard + planted partial checkpoint ----------------------
+    # reuse a completed run's store: corrupt the NEWEST committed step and
+    # plant a fake partial (no COMMIT) later step — the restart must select
+    # the older committed step and recover to baseline parity
+    ns = run("corrupt_shard")
+    rc, _ = _run_job(ns, max_restarts=0)
+    assert rc == 0, f"corrupt_shard pre-run failed rc={rc}"
+    from paddle_tpu.parallel.checkpoint import ElasticCheckpointer
+    ck = ElasticCheckpointer(ns["ckpt_dir"])
+    steps_before = ck.all_steps()
+    newest = steps_before[-1]
+    expect_restore = steps_before[-2]
+    shard = os.path.join(ns["ckpt_dir"], f"step_{newest:08d}", "leaves",
+                         "leaf_0.bin")
+    with open(shard, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(shard) // 2))
+    partial = os.path.join(ns["ckpt_dir"], f"step_{newest + 2:08d}", "leaves")
+    os.makedirs(partial)
+    with open(os.path.join(partial, "leaf_0.bin"), "wb") as f:
+        f.write(b"\x00" * 128)   # mid-save kill: shards but no COMMIT
+    os.remove(ns["result"])
+    rc, res = _run_job(ns, max_restarts=1)
+    inc = _incarnations(ns["ckpt_dir"])
+    restored = inc[-1]["restored_from"] if inc else None
+    s = {
+        "rc": rc, "result": res,
+        "corrupted_step": newest, "planted_partial_step": newest + 2,
+        "restored_from": restored, "expected_restore": expect_restore,
+        "match_baseline": _match(res and res["final_loss"],
+                                 baseline["final_loss"]),
+        "params_match": bool(res) and
+            res["params_crc"] == baseline["params_crc"],
+    }
+    s["no_partial_selected"] = restored == expect_restore
+    s["pass"] = (rc == 0 and s["no_partial_selected"]
+                 and s["match_baseline"] == "bit_exact" and s["params_match"])
+    scenarios["corrupt_shard"] = s
+    ok &= s["pass"]
+    _log(f"corrupt_shard: {s['pass']} (restored {restored}, "
+         f"expected {expect_restore})")
+
+    if not smoke:
+        # --- SIGTERM preemption: checkpoint-and-exit, relaunch resumes ---
+        ns = run("sigterm_preempt", die_at=die_at, die_sig="TERM",
+                 once_marker=os.path.join(work, "sigterm.marker"))
+        rc1, res = _run_job(ns, max_restarts=0)
+        preempted_clean = rc1 == 0 and res is None
+        rc2, res = _run_job(ns, max_restarts=0)   # the re-scheduled job
+        inc = _incarnations(ns["ckpt_dir"])
+        s = {
+            "rc_preempted": rc1, "rc_resumed": rc2,
+            "preempted_clean_exit": preempted_clean,
+            "restored_from": [r["restored_from"] for r in inc],
+            "match_baseline": _match(res and res["final_loss"],
+                                     baseline["final_loss"]),
+            "params_match": bool(res) and
+                res["params_crc"] == baseline["params_crc"],
+        }
+        s["pass"] = (preempted_clean and rc2 == 0
+                     and die_at in s["restored_from"]
+                     and s["match_baseline"] == "bit_exact"
+                     and s["params_match"])
+        scenarios["sigterm_preempt"] = s
+        ok &= s["pass"]
+        _log(f"sigterm_preempt: {s['pass']}")
+
+        # --- dp=8 save -> dp=4 resharded restore -------------------------
+        half = base["steps"] // 2
+        ns = run("dp_reshard", steps=half)
+        rc1, _ = _run_job(ns, max_restarts=0)
+        os.remove(ns["result"])
+        rc2, res = _run_job(ns, max_restarts=0, dp=base["dp"] // 2,
+                            steps=base["steps"])
+        s = {
+            "rc_save_dp": rc1, "rc_restore_dp": rc2,
+            "save_dp": base["dp"], "restore_dp": base["dp"] // 2,
+            "result": res,
+            "reshard_bit_exact": bool(res) and res["reshard_bit_exact"],
+            "match_baseline": _match(res and res["final_loss"],
+                                     baseline["final_loss"]),
+        }
+        # different dp reorders the f32 reduction -> parity within
+        # tolerance; the RESTORE itself must be bit-exact
+        loss_ok = bool(res) and abs(
+            res["final_loss"] - baseline["final_loss"]) < 0.05 * max(
+                1.0, abs(baseline["final_loss"]))
+        s["pass"] = (rc1 == 0 and rc2 == 0 and s["reshard_bit_exact"]
+                     and loss_ok)
+        scenarios["dp_reshard"] = s
+        ok &= s["pass"]
+        _log(f"dp_reshard: {s['pass']} (bit_exact restore="
+             f"{s['reshard_bit_exact']}, {s['match_baseline']})")
+
+    out = {
+        "mode": "smoke" if smoke else "full",
+        "device_count": N_DEVICES,
+        "config": base,
+        "elapsed_s": round(time.time() - t0, 1),
+        "scenarios": scenarios,
+        "pass": bool(ok),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    _log(f"wrote {out_path} pass={ok} in {out['elapsed_s']}s")
+    print(json.dumps({"fault_bench": out_path, "pass": bool(ok),
+                      "mode": out["mode"],
+                      "elapsed_s": out["elapsed_s"]}))
+    return 0 if ok else 1
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny config + starred scenarios only (tier-1 lane)")
+    ap.add_argument("--out", default=os.path.join(REPO, "FAULT_BENCH.json"))
+    # worker knobs
+    ap.add_argument("--ckpt-dir")
+    ap.add_argument("--result")
+    ap.add_argument("--dp", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seqlen", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--interval", type=int, default=2)
+    ap.add_argument("--keep-last", type=int, default=3)
+    ap.add_argument("--die-at", type=int, default=0)
+    ap.add_argument("--die-sig", default="KILL", choices=("KILL", "TERM"))
+    ap.add_argument("--once-marker")
+    args = ap.parse_args()
+    if args.worker:
+        worker(args)
+        return 0
+    return harness(args.smoke, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,7 +126,9 @@ def _run_check_inner(out_dir: str) -> dict:
         peak_flops=hw.peak_bf16_flops())
     exe = fluid.Executor(fluid.XLAPlace(0))
     exe.run(startup)
-    exe.train_from_dataset(prog, dataset, fetch_list=[loss], monitor=mon)
+    ckpt_dir = os.path.join(out_dir, "ckpt")
+    exe.train_from_dataset(prog, dataset, fetch_list=[loss], monitor=mon,
+                           checkpoint_dir=ckpt_dir, checkpoint_interval=2)
     mon.close()
 
     # --- JSONL: >= 5 steps, required keys, finite values ---------------
@@ -171,6 +173,26 @@ def _run_check_inner(out_dir: str) -> dict:
                 and v >= 0, f"report {key}={v!r} not finite: {rep}"
         assert rep.get("program"), rep
         assert "memory" in rep, rep
+
+    # --- elastic checkpoint metrics (docs/elastic.md) -------------------
+    # the train loop above checkpointed every 2 steps through the elastic
+    # store: the save-time histogram and committed-bytes counter must have
+    # fired with finite values, and the store must hold >= 1 committed step
+    from paddle_tpu.parallel.checkpoint import ElasticCheckpointer
+
+    snap = default_registry().snapshot()
+    save_ms = snap["paddle_checkpoint_save_ms"]["series"][0]
+    assert save_ms["count"] >= 1 and math.isfinite(save_ms["sum"]) \
+        and save_ms["sum"] >= 0, f"paddle_checkpoint_save_ms: {save_ms}"
+    ckpt_bytes = snap["paddle_checkpoint_bytes_total"]["series"][0]["value"]
+    assert math.isfinite(ckpt_bytes) and ckpt_bytes > 0, \
+        f"paddle_checkpoint_bytes_total={ckpt_bytes}"
+    _ck = ElasticCheckpointer(ckpt_dir)
+    committed = _ck.all_steps()
+    assert committed, f"no committed checkpoint under {ckpt_dir}"
+    assert not _ck.verify(committed[-1]), "latest checkpoint fails verify"
+    # the restart counter family registers with the launcher (supervised
+    # restarts increment it); its exposition presence is gated below
 
     # --- collective wire-byte accounting (docs/comm_opt.md) ------------
     # with >=2 devices (the tier-1 conftest forces 8 virtual), trace one
@@ -250,9 +272,18 @@ def _run_check_inner(out_dir: str) -> dict:
         "collective wire-byte counter missing from exposition"
     assert 'paddle_lint_findings_total{severity=' in prom_text, \
         "lint findings counter missing from exposition"
+    # elastic checkpoint/restart metrics (docs/elastic.md): the save
+    # histogram + bytes counter carry samples; the supervised-restart
+    # counter family is registered (HELP/TYPE rendered) even when this
+    # in-process run never restarted a gang
+    for name in ("paddle_checkpoint_save_ms", "paddle_checkpoint_bytes_total",
+                 "paddle_restarts_total"):
+        assert name in prom_text, f"{name} missing from exposition"
 
     return {"steps": len(records), "prom_samples": samples,
             "program_reports": len(reports),
+            "checkpoint_steps": committed,
+            "checkpoint_bytes": ckpt_bytes,
             "lint_findings": lint_after,
             "jsonl": jsonl_path, "prom": prom_path,
             "last_record": records[-1]}
