@@ -423,6 +423,15 @@ def worker(use_flash: bool):
     # per-step monitoring even without --monitor
     dump_dir = next((a.split("=", 1)[1] for a in sys.argv
                      if a.startswith("--dump-on-anomaly=")), None)
+    # --skip-nonfinite: in-jit divergence guardrail (docs/health.md) — a
+    # step whose psum'd loss/grad-norm goes NaN/Inf keeps the old state
+    # wholesale, identically on every dp rank
+    skip_nonfinite = "--skip-nonfinite" in sys.argv
+    # hang watchdog + heartbeat from the launcher env contract (no-op
+    # when PADDLE_HEALTH_DEADLINE_S / PADDLE_HEALTH_DIR are unset)
+    from paddle_tpu.parallel import health as health_mod
+
+    health_mod.maybe_install_from_env()
 
     def measure(tag, cfg, batch, T, steps):
         """Compile + run one config; returns (tokens/s, mfu, loss, params).
@@ -445,7 +454,8 @@ def worker(use_flash: bool):
         params, opt = PZ.init_sharded(
             jax.random.PRNGKey(0), cfg, pcfg, mesh,
             moment_dtype=jnp.bfloat16 if on_acc else None)
-        step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+        step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4,
+                                  skip_nonfinite=skip_nonfinite)
         rng = np.random.default_rng(0)
         tokens = rng.integers(0, cfg.vocab_size, (1, batch, T),
                               dtype=np.int32)
@@ -485,9 +495,16 @@ def worker(use_flash: bool):
         start0 = min(start_step or 0, steps)
         ran = max(1, steps - start0)
 
+        hb_dir = os.environ.get(health_mod.ENV_DIR)
+        hb = health_mod.RankHeartbeat(
+            hb_dir, int(os.environ.get("PADDLE_TRAINER_ID", "0"))) \
+            if hb_dir else None
+
         def maybe_ckpt(i):
             # async save (host snapshot is the only sync point); the final
             # step commits synchronously so a resumed bench is consistent
+            if hb is not None:
+                hb.beat(i + 1)
             if ck is not None and (i + 1 == steps or
                                    (i + 1) % ckpt_interval == 0):
                 ck.save(i + 1, {"params": params, "opt": opt},
@@ -510,6 +527,8 @@ def worker(use_flash: bool):
                 maybe_ckpt(i)
             loss_v = float(loss)  # forces the whole chain
         dt = time.perf_counter() - t0
+        if hb is not None:
+            hb.flush()
         if ck is not None:
             ck.close()
         _log(f"worker[{tag}]: {ran} steps in {dt:.2f}s "

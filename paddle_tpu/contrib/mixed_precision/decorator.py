@@ -82,8 +82,14 @@ class OptimizerWithMixedPrecision:
         for g in grads:
             if str(g.dtype) == self._dest_dtype:
                 g.dtype = "float32"  # grads of casted params arrive via cast-grad, already f32; belt & braces
+        # persistable: the per-step overflow verdict lands in the scope, so
+        # Executor.train_from_dataset(monitor=) mirrors it into every
+        # monitor row as `bad_step` alongside `loss_scale`/`bad_steps` —
+        # AMP overflow-skips and divergence-guardrail skips read off the
+        # same JSONL stream (docs/health.md)
         found_inf = block.create_var(name="find_infinite_scale_0",
-                                     shape=[1], dtype="bool")
+                                     shape=[1], dtype="bool",
+                                     persistable=True)
         grad_names = [g.name for g in grads]
         block.append_op(
             type="check_finite_and_unscale",

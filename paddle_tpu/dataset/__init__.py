@@ -400,8 +400,8 @@ def iter_batches_threaded(dataset: DatasetBase, threads: int,
                                            dataset.drop_last):
                     if not put(pool.submit(dataset._batch_to_feed, chunk)):
                         return
-        except Exception as e:  # surface in the consumer
-            put(e)
+        except BaseException as e:  # surface in the consumer (a swallowed
+            put(e)                  # producer death would hang the loop)
         finally:
             put(_END)
 
@@ -414,7 +414,7 @@ def iter_batches_threaded(dataset: DatasetBase, threads: int,
             item = out_q.get()
             if item is _END:
                 break
-            if isinstance(item, Exception):
+            if isinstance(item, BaseException):
                 raise item
             yield item.result()
     finally:

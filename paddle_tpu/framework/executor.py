@@ -11,6 +11,7 @@ backward and optimizer update fuse into one XLA module, parameters are donated
 from __future__ import annotations
 
 import logging
+import os
 import time
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -73,6 +74,22 @@ def _prof():
 
         _prof_mod = profiler
     return _prof_mod
+
+
+_health_mod = None
+
+
+def _health():
+    """The in-run health module (parallel/health.py), lazily cached like
+    :func:`_prof`.  ``progress()`` stamps from the dispatch paths feed the
+    hang watchdog — a single global read + None check until a watchdog is
+    installed, so the fast path stays inside the dispatch-overhead gate."""
+    global _health_mod
+    if _health_mod is None:
+        from ..parallel import health
+
+        _health_mod = health
+    return _health_mod
 
 
 class Scope:
@@ -243,6 +260,13 @@ class _CompiledBlock:
         self.report_name = report_name or (
             f"{fetch_names[0] if fetch_names else 'main'}"
             f"#{len(program.global_block().ops)}ops")
+        # hang-watchdog progress site (docs/health.md): collective-carrying
+        # shard_map blocks get their own label so paddle_hangs_total{site}
+        # points at the comm path when a mismatched collective wedges
+        self.progress_site = ("collective/shard_map"
+                              if mesh_plan is not None
+                              and mesh_plan.mode == "shard_map"
+                              else "executor.run")
         # AOT compile state: the first call lowers + compiles explicitly and
         # keeps BOTH handles, so the executable that runs every step is the
         # same object that serves .as_text() for the profiler and
@@ -407,8 +431,11 @@ class _CompiledBlock:
             h0, m0 = compile_cache_counters()
         t0 = time.perf_counter_ns()
         try:
-            lowered = self._jitted.lower(mutable, const, feeds, rng_key)
-            executable = lowered.compile()
+            # a first-call XLA compile can legitimately run for minutes:
+            # pause the hang-watchdog clock for its duration
+            with _health().suspend():
+                lowered = self._jitted.lower(mutable, const, feeds, rng_key)
+                executable = lowered.compile()
         except Exception as e:
             self._aot_failed = True
             logger.info("AOT compile unavailable for %s (%s: %s); "
@@ -455,6 +482,7 @@ class _CompiledBlock:
     def fast_call(self, scope: Scope, feeds: Dict[str, Any], rng_key):
         """Steady-state entry: ``feeds`` must already contain exactly
         ``feed_names`` (the dispatch record guarantees it)."""
+        _health().progress(self.progress_site)
         find = scope.find_var
         mutable = {}
         const = {}
@@ -753,6 +781,7 @@ class Executor:
             hits0, misses0 = compile_cache_counters()
             t0 = time.perf_counter_ns()
         _m_dispatch_slow.inc()
+        _health().progress(getattr(exe, "progress_site", "executor.run"))
         t_run0 = time.perf_counter_ns()
         with prof.RecordEvent("executor_run"):
             fetches = exe(scope, feed_arrays, rng_key)
@@ -1030,7 +1059,8 @@ class Executor:
                            thread: int = 0, debug: bool = False,
                            fetch_list=None, fetch_info=None,
                            print_period: int = 100, monitor=None,
-                           checkpoint_dir=None, checkpoint_interval=None):
+                           checkpoint_dir=None, checkpoint_interval=None,
+                           guardrails=None):
         """Dataset trainer path — parity with fluid/executor.py:1448.
 
         The reference hands the Dataset to C++ trainer threads
@@ -1059,12 +1089,28 @@ class Executor:
         resumes deterministically.  A SIGTERM/SIGINT mid-train triggers a
         final synchronous checkpoint and a clean return (the launcher's
         grace-period contract).
+
+        ``guardrails``: a ``parallel.health.GuardrailConfig`` (or ``True``
+        for the defaults) arms the divergence guardrail (docs/health.md):
+        each step's loss (fetch[0]) is judged, a NaN/Inf or loss-spike step
+        is *skipped* — the pre-step persistable state is restored, so the
+        poisoned batch never lands (the full-precision generalization of
+        AMP's overflow skip; the decision depends only on the already
+        all-reduced loss, so dp ranks stay in lockstep) — and after K
+        consecutive bad steps the loop rolls back to the latest valid
+        checkpoint with an optional LR cooldown.  Guarded runs sync the
+        loss and snapshot the persistables every step — a measured,
+        documented cost; leave ``guardrails=None`` for the fully-async
+        fast path.  Skips/rollbacks are metered as
+        ``paddle_guardrail_skipped_steps_total{reason}`` /
+        ``paddle_guardrail_rollbacks_total``.
         """
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period, train=True,
                                       thread=thread, monitor=monitor,
                                       checkpoint_dir=checkpoint_dir,
-                                      checkpoint_interval=checkpoint_interval)
+                                      checkpoint_interval=checkpoint_interval,
+                                      guardrails=guardrails)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread: int = 0, debug: bool = False,
@@ -1097,17 +1143,98 @@ class Executor:
                 n += 1
         return n
 
+    def _guardrail_rollback(self, program, scope, ckpt, guard, step) -> None:
+        """K consecutive bad steps: restore the latest valid checkpoint
+        (skip-batch already rewound this step, which is all we can do
+        without a checkpoint store), cool the learning rate, and charge the
+        guard's rollback budget.  The data stream is NOT rewound —
+        divergence is a state problem, not a data problem (docs/health.md).
+        """
+        restored = None
+        if ckpt is not None:
+            latest = ckpt.latest_valid_step()
+            if latest is not None:
+                state, _man = ckpt.restore(latest)
+                self._restore_checkpoint_state(program, scope, state)
+                restored = latest
+        cool = guard.config.lr_cooldown
+        if cool != 1.0:
+            # fluid optimizers keep their rate in a persistable
+            # learning_rate_N global var (optimizer.py _create_lr_var)
+            for name, v in program.global_block().vars.items():
+                if v.persistable and name.startswith("learning_rate"):
+                    val = scope.find_var(name)
+                    if val is not None:
+                        scope.set_var(
+                            name, jnp.asarray(np.asarray(val) * cool))
+        logger.warning(
+            "guardrail: rollback at step %d -> %s (lr cooldown x%s)",
+            step,
+            f"checkpoint step {restored}" if restored is not None
+            else "pre-step snapshot (no valid checkpoint)",
+            cool)
+        guard.rolled_back()
+
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
                           fetch_info, print_period, train: bool,
                           thread: int = 0, monitor=None,
-                          checkpoint_dir=None, checkpoint_interval=None):
+                          checkpoint_dir=None, checkpoint_interval=None,
+                          guardrails=None):
         if dataset is None:
             raise ValueError("dataset must be provided")
         program = program or default_main_program()
+        scope = scope or global_scope()
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [
             (v.name if isinstance(v, Variable) else str(v)) for v in fetch_list
         ]
+        # in-run health (docs/health.md): hang watchdog from the launcher
+        # env contract, per-rank heartbeat onto the shared health dir, and
+        # the optional divergence guardrail
+        health = _health()
+        health.maybe_install_from_env()
+        hb_dir = os.environ.get(health.ENV_DIR)
+        heartbeat = (health.RankHeartbeat(
+            hb_dir, int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+            if hb_dir else None)
+        guard = None
+        if train and guardrails is not None and guardrails is not False:
+            if not fetch_list:
+                raise ValueError(
+                    "guardrails need a fetch_list (the loss is fetch[0])")
+            guard = health.DivergenceGuard(
+                guardrails if isinstance(guardrails, health.GuardrailConfig)
+                else health.GuardrailConfig())
+        # AMP visibility (docs/health.md): when the program carries the
+        # mixed-precision loss-scaling state, mirror it into every monitor
+        # row so guardrail decisions and AMP overflow-skips read off the
+        # same JSONL stream
+        amp_vars = None
+        if monitor is not None:
+            blk0 = program.global_block()
+            amp_vars = {
+                key: name for key, name in (
+                    ("loss_scale", "loss_scaling_0"),
+                    ("found_inf", "find_infinite_scale_0"),
+                    ("bad_steps", "bad_steps_0"))
+                if (v := blk0.vars.get(name)) is not None and v.persistable}
+            if not amp_vars:
+                amp_vars = None
+
+        def _amp_fields():
+            out = {}
+            if amp_vars is None:
+                return out
+            v = scope.find_var(amp_vars.get("loss_scale", ""))
+            if v is not None:
+                out["loss_scale"] = float(np.asarray(v).ravel()[0])
+            v = scope.find_var(amp_vars.get("found_inf", ""))
+            if v is not None:
+                out["bad_step"] = bool(np.asarray(v).ravel()[0])
+            v = scope.find_var(amp_vars.get("bad_steps", ""))
+            if v is not None:
+                out["bad_steps"] = int(np.asarray(v).ravel()[0])
+            return out
         feed_names = {v.name for v in getattr(dataset, "use_vars", [])}
         n_threads = int(thread) or int(getattr(dataset, "thread_num", 1) or 1)
         if n_threads > 1:
@@ -1166,6 +1293,12 @@ class Executor:
         step = start_offset
         last_fetch = None
         for feed in prefetch_to_device(stream, size=2):
+            health.progress("train_from_dataset")
+            if guard is not None:
+                # the skip-batch restore target: pre-step persistable state
+                # as host arrays (the same snapshot a checkpoint save
+                # takes — this sync + copy is guard mode's documented cost)
+                pre_state = self._checkpoint_state(program, scope)
             if monitor is not None:
                 if monitor.examples_per_step is None:
                     # infer the per-step example count from the batch dim
@@ -1184,13 +1317,31 @@ class Executor:
                         # the full fetch list rides along (by reference, no
                         # sync) so an anomaly dump can summarize the
                         # offending step's values
+                        extra = _amp_fields()
+                        if guard is not None:
+                            verdict = guard.judge(np.asarray(last_fetch[0]))
+                            if verdict != "ok":
+                                extra["bad_step"] = True
                         s.observe(loss=last_fetch[0], fetches=last_fetch,
-                                  fetch_names=list(fetch_info))
+                                  fetch_names=list(fetch_info), **extra)
             else:
                 last_fetch = self.run(program=program, feed=feed,
                                       fetch_list=fetch_list, scope=scope,
                                       return_numpy=False)
+                if guard is not None:
+                    verdict = guard.judge(np.asarray(last_fetch[0]))
             step += 1
+            if heartbeat is not None:
+                heartbeat.beat(step)
+            if guard is not None and verdict != "ok":
+                # skip-batch: the poisoned step's update never lands
+                self._restore_checkpoint_state(program, scope, pre_state)
+                logger.warning(
+                    "guardrail: step %d skipped (%s, consecutive bad %d)",
+                    step, guard.last_reason, guard.consecutive_bad)
+                if verdict == "rollback":
+                    self._guardrail_rollback(program, scope, ckpt, guard,
+                                             step)
             if ckpt is not None:
                 if preempt is not None and preempt.triggered:
                     # the launcher's SIGTERM grace window: checkpoint
@@ -1211,6 +1362,8 @@ class Executor:
                     for name, val in zip(fetch_info, last_fetch))
                 _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
                 logger.info("step %d: %s", step, msg)
+        if heartbeat is not None:
+            heartbeat.flush()
         if ckpt is not None:
             if step > start_offset and not (preempt is not None
                                             and preempt.triggered):

@@ -4,6 +4,7 @@ ParallelExecutor/NCCL stack (SURVEY.md §2.3): device meshes + GSPMD shardings
 from .mesh import MeshConfig, build_mesh, current_mesh, mesh_guard  # noqa: F401
 from . import comm_opt  # noqa: F401
 from . import env  # noqa: F401
+from . import health  # noqa: F401
 from . import remat  # noqa: F401
 from .comm_opt import CommConfig  # noqa: F401
 from .launch import (  # noqa: F401

@@ -16,7 +16,18 @@ this launcher is the elastic superset (ROADMAP item 4, docs/elastic.md):
   after a worker failure (collective jobs cannot survive a lone member —
   every rank restarts together and resumes from the latest committed
   checkpoint), with exponential backoff between attempts.  Restarts count
-  into ``paddle_restarts_total{cause=}`` through the PR 3 registry.
+  into ``paddle_restarts_total{cause=hang|crash|preempt}`` through the
+  PR 3 registry: a worker exiting with ``health.HANG_EXIT_CODE`` (its own
+  hang watchdog fired) is ``hang``, an untrapped SIGTERM death is
+  ``preempt``, and every other failure — any signal or nonzero exit — is
+  ``crash``.
+- **In-run health** (ISSUE 8, docs/health.md): ``hang_deadline_s`` /
+  ``health_dir`` export the :mod:`.health` env contract to every worker
+  (each installs a hang watchdog that stack-dumps and exits with the
+  ``hang`` code when no dispatch progress lands inside the deadline), and
+  the supervisor polls the shared heartbeat dir for stragglers —
+  ``paddle_straggler_detected_total{rank}`` plus a rate-limited warning
+  naming the slow rank.
 
 On TPU the normal deployment is one process per HOST (all local chips in one
 process), so --nproc_per_node defaults to 1; the per-GPU spawning of the
@@ -33,10 +44,11 @@ import time
 from typing import Callable, List, Optional
 
 from ..observability import metrics as _obs_metrics
+from . import health as _health
 
 _m_restarts = _obs_metrics.default_registry().counter(
     "paddle_restarts_total",
-    "Supervised gang restarts by cause (worker_exit, worker_signal)",
+    "Supervised gang restarts by cause (hang, crash, preempt)",
     ("cause",))
 
 
@@ -148,6 +160,22 @@ def _exit_code(ret: int) -> int:
     return 128 - ret if ret < 0 else ret
 
 
+def _restart_cause(ret: int) -> str:
+    """Popen returncode -> paddle_restarts_total cause label.
+
+    ``hang``: the worker's own watchdog declared it stuck and exited with
+    the distinct :data:`health.HANG_EXIT_CODE`.  ``preempt``: an untrapped
+    SIGTERM death (an external scheduler pulled the node before the worker
+    could checkpoint — a trapped preemption exits 0 and never restarts).
+    Everything else — SIGKILL/segfault/any nonzero exit — is ``crash``.
+    """
+    if ret == _health.HANG_EXIT_CODE:
+        return "hang"
+    if ret < 0:
+        return "preempt" if -ret == signal.SIGTERM else "crash"
+    return "crash"
+
+
 def _stop_gang(procs, grace_period_s: float, sig=signal.SIGTERM):
     """Graceful shutdown: ``sig`` to every live child, wait up to the grace
     period for them to checkpoint-and-exit, then SIGKILL stragglers."""
@@ -180,10 +208,21 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
            log_dir: Optional[str] = None, perf_flags: bool = True,
            max_restarts: int = 0, restart_backoff_s: float = 1.0,
            restart_backoff_max_s: float = 30.0,
-           grace_period_s: float = 15.0) -> int:
+           grace_period_s: float = 15.0,
+           hang_deadline_s: Optional[float] = None,
+           health_dir: Optional[str] = None,
+           straggler_ratio: float = 2.0,
+           straggler_warn_cooldown_s: float = 30.0) -> int:
     """Spawn and supervise the worker gang; returns the job's exit code
     (0 on success or clean preemption; otherwise the FIRST failing child's
-    exit code, with signal deaths mapped to 128+N)."""
+    exit code, with signal deaths mapped to 128+N).
+
+    ``hang_deadline_s``/``health_dir`` arm the in-run health layer
+    (docs/health.md): workers install a hang watchdog from the exported
+    env contract, write per-rank heartbeats into ``health_dir``, and the
+    supervisor polls that dir for stragglers (EWMA step time beyond
+    ``straggler_ratio`` x the gang median).
+    """
     from ..sysconfig import tpu_perf_flags
 
     node_ips = [ip.strip() for ip in cluster_node_ips.split(",")]
@@ -191,6 +230,14 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     node_rank = node_ips.index(node_ip)
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    if health_dir is None and (hang_deadline_s is not None) and log_dir:
+        health_dir = os.path.join(log_dir, "health")
+    if health_dir:
+        os.makedirs(health_dir, exist_ok=True)
+    straggler_mon = (_health.StragglerMonitor(
+        health_dir, ratio=straggler_ratio,
+        warn_cooldown_s=straggler_warn_cooldown_s)
+        if health_dir else None)
 
     def spawn_gang(attempt: int):
         procs = []
@@ -204,6 +251,12 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                 "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
                 "PADDLE_RESTART_ATTEMPT": str(attempt),
             })
+            # health env contract: workers self-install the hang watchdog
+            # and heartbeat writer (health.maybe_install_from_env)
+            if hang_deadline_s is not None:
+                env[_health.ENV_DEADLINE] = str(float(hang_deadline_s))
+            if health_dir:
+                env[_health.ENV_DIR] = health_dir
             if perf_flags:
                 # comm/compute-overlap preset into each worker's XLA_FLAGS
                 # BEFORE its backend init (no-op unless the worker env
@@ -237,6 +290,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     exit_code = 0
     restarts = 0
     backoff = restart_backoff_s
+    last_straggler_poll = 0.0
     try:
         procs = spawn_gang(0)
         all_procs = list(procs)
@@ -261,10 +315,10 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
             if failed is not None:
                 rank, ret = failed
                 code = _exit_code(ret)
-                cause = "worker_signal" if ret < 0 else "worker_exit"
+                cause = _restart_cause(ret)
                 sys.stderr.write(
                     f"launch: worker {rank} exited with {ret} "
-                    f"(code {code})\n")
+                    f"(code {code}, cause {cause})\n")
                 _stop_gang(procs, grace_period_s)
                 if restarts < max_restarts:
                     restarts += 1
@@ -285,6 +339,10 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
             procs = alive
             if not procs:
                 break       # every worker exited 0
+            if straggler_mon is not None and \
+                    time.monotonic() - last_straggler_poll >= 2.0:
+                last_straggler_poll = time.monotonic()
+                straggler_mon.poll()
             time.sleep(0.2)
     finally:
         if in_main:
@@ -321,6 +379,17 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
     ap.add_argument("--restart_backoff", type=float, default=1.0)
     ap.add_argument("--grace_period", type=float, default=15.0,
                     help="seconds between SIGTERM and SIGKILL at shutdown")
+    ap.add_argument("--hang_deadline", type=float, default=None,
+                    help="arm each worker's hang watchdog: no dispatch "
+                         "progress for this many seconds dumps stacks and "
+                         "restarts the gang with cause=hang")
+    ap.add_argument("--health_dir", default=None,
+                    help="shared dir for hang dumps + per-rank heartbeats "
+                         "(default: <log_dir>/health when the watchdog is "
+                         "armed)")
+    ap.add_argument("--straggler_ratio", type=float, default=2.0,
+                    help="flag ranks whose step-time EWMA exceeds this "
+                         "multiple of the gang median")
     ap.add_argument("--no_perf_flags", action="store_true",
                     help="skip the sysconfig.tpu_perf_flags XLA preset")
     ap.add_argument("training_script")
@@ -332,7 +401,10 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
                     perf_flags=not args.no_perf_flags,
                     max_restarts=args.max_restarts,
                     restart_backoff_s=args.restart_backoff,
-                    grace_period_s=args.grace_period))
+                    grace_period_s=args.grace_period,
+                    hang_deadline_s=args.hang_deadline,
+                    health_dir=args.health_dir,
+                    straggler_ratio=args.straggler_ratio))
 
 
 if __name__ == "__main__":

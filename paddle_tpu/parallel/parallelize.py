@@ -45,6 +45,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from . import comm_opt
+from . import health as _health
 from . import mesh as mesh_mod
 from ..models import gpt as gpt_mod
 from ..models.gpt import GPTConfig
@@ -428,7 +429,8 @@ def _spec_axes(spec: P):
 
 def _make_rs_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                   ccfg: CommConfig, lr, weight_decay, grad_clip,
-                  specs, param_sh, data_spec, data_sh, double_buffer):
+                  specs, param_sh, data_spec, data_sh, double_buffer,
+                  skip_nonfinite: bool = False):
     """The reduce-scatter train step: ONE shard_map holding grad, bucketed
     psum_scatter, the sharded flat AdamW sweep, and the param all_gather.
 
@@ -562,6 +564,13 @@ def _make_rs_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
         with jax.named_scope("train/grad"):
             loss, new_params, new_opt, gnorm = sharded(
                 params, opt_state, tokens, labels)
+        if skip_nonfinite:
+            # divergence guardrail (docs/health.md): loss and gnorm are
+            # psum'd over the whole mesh, so every rank selects the same
+            # branch and the next step's collectives stay matched
+            with jax.named_scope("train/guardrail"):
+                (new_params, new_opt), _bad = _health.nonfinite_guard(
+                    (params, opt_state), (new_params, new_opt), loss, gnorm)
         return new_params, new_opt, loss, gnorm
 
     return step
@@ -572,7 +581,8 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                     fused_opt: bool = False, grad_reduce: str = "psum",
                     grad_allreduce_dtype=None, bucket_mb: float = 32.0,
                     error_feedback: bool = False, grad_clip=1.0,
-                    comm: Optional[CommConfig] = None):
+                    comm: Optional[CommConfig] = None,
+                    skip_nonfinite: bool = False):
     """Build the jitted 4D-parallel training step.
 
     Returns ``step(params, opt_state, tokens, labels) ->
@@ -600,6 +610,12 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
       (reduce_scatter mode) carries the per-rank quantization residual in
       the train state.
     - ``grad_clip=None`` disables gradient clipping exactly (scale 1.0).
+
+    ``skip_nonfinite=True`` arms the in-jit divergence guardrail
+    (``health.nonfinite_guard``, docs/health.md): a step whose psum'd loss
+    or grad norm is NaN/Inf keeps the old ``(params, opt_state)`` wholesale
+    (step counter included) — the batch is skipped identically on every dp
+    rank, the full-precision generalization of AMP's overflow skip.
     """
     ccfg = comm if comm is not None else CommConfig(
         grad_reduce=grad_reduce, comm_dtype=grad_allreduce_dtype,
@@ -637,7 +653,7 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     if ccfg.grad_reduce == "reduce_scatter":
         step = _make_rs_step(cfg, pcfg, mesh, ccfg, lr, weight_decay,
                              grad_clip, specs, param_sh, data_spec, data_sh,
-                             db)
+                             db, skip_nonfinite=skip_nonfinite)
     else:
         sharded_grad = shard_map_compat(
             grad_fn, mesh,
@@ -666,10 +682,17 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
             # optimizer update is elementwise: GSPMD partitions it with zero
             # communication (replaces the reference's fuse_optimizer_ops pass)
             with jax.named_scope("train/opt_update"):
-                params, opt_state, gnorm = update(
+                new_params, new_opt, gnorm = update(
                     params, grads, opt_state, lr,
                     weight_decay=weight_decay, grad_clip=grad_clip)
-            return params, opt_state, loss, gnorm
+            if skip_nonfinite:
+                # loss/gnorm are already all-reduced: every rank takes the
+                # same skip branch (docs/health.md)
+                with jax.named_scope("train/guardrail"):
+                    (new_params, new_opt), _bad = _health.nonfinite_guard(
+                        (params, opt_state), (new_params, new_opt),
+                        loss, gnorm)
+            return new_params, new_opt, loss, gnorm
 
     # Program-report capture (observability/program_report.py): the first
     # invocation lowers + compiles explicitly, keeps the executable as the
@@ -687,13 +710,18 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     aot = {"exec": None, "failed": False}
 
     def step_with_report(params, opt_state, tokens, labels):
+        # hang-watchdog progress stamp (docs/health.md): one tuple store
+        _health.progress("train_step")
         if aot["exec"] is None and not aot["failed"]:
             import time as _time
 
             t0 = _time.perf_counter_ns()
             try:
-                lowered = step.lower(params, opt_state, tokens, labels)
-                aot["exec"] = lowered.compile()
+                # first-call XLA compile can run for minutes: pause the
+                # hang-watchdog deadline clock for its duration
+                with _health.suspend():
+                    lowered = step.lower(params, opt_state, tokens, labels)
+                    aot["exec"] = lowered.compile()
             except Exception:
                 aot["failed"] = True
             else:

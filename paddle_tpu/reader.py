@@ -271,10 +271,19 @@ def firstn(reader, n: int):
     return firstn_reader
 
 
+_XMAP_ERR = object()
+
+
 def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                  order: bool = False):
     """Parallel map over a reader using worker threads (reference uses
-    threads too — decorator.py xmap_readers)."""
+    threads too — decorator.py xmap_readers).
+
+    Exception safety (docs/health.md): a ``mapper`` or ``reader`` that
+    raises used to kill its thread silently, leaving the consumer blocked
+    forever on an empty queue — exactly the silent-hang class the hang
+    watchdog exists to catch.  Worker/feeder exceptions now travel to the
+    consumer and re-raise on the next pull."""
     _end = object()
 
     def xreader():
@@ -282,10 +291,16 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
         out_q: _queue.Queue = _queue.Queue(buffer_size)
 
         def feed():
-            for i, s in enumerate(reader()):
-                in_q.put((i, s))
-            for _ in range(process_num):
-                in_q.put(_end)
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+            except BaseException as e:   # surface in the consumer
+                out_q.put((_XMAP_ERR, e))
+            finally:
+                # workers always get their end markers, even on a feeder
+                # crash — nobody is left blocked on in_q
+                for _ in range(process_num):
+                    in_q.put(_end)
 
         def work():
             while True:
@@ -294,7 +309,12 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                     out_q.put(_end)
                     return
                 i, s = item
-                out_q.put((i, mapper(s)))
+                try:
+                    out_q.put((i, mapper(s)))
+                except BaseException as e:
+                    out_q.put((_XMAP_ERR, e))
+                    out_q.put(_end)
+                    return
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -310,6 +330,8 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                     finished += 1
                     continue
                 i, v = item
+                if i is _XMAP_ERR:
+                    raise v
                 pending[i] = v
                 while next_i in pending:
                     yield pending.pop(next_i)
@@ -322,6 +344,8 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                 if item is _end:
                     finished += 1
                     continue
+                if item[0] is _XMAP_ERR:
+                    raise item[1]
                 yield item[1]
     return xreader
 
@@ -357,7 +381,16 @@ def multiprocess_reader(readers, use_pipe: bool = True, queue_size: int = 1000):
         finished = 0
         try:
             while finished < len(readers):
-                item = q.get()
+                try:
+                    item = q.get(timeout=1.0)
+                except _queue.Empty:
+                    # a worker killed outright (OOM, SIGKILL) never sends
+                    # its end marker: raise instead of blocking forever
+                    if not any(p.is_alive() for p in procs):
+                        raise RuntimeError(
+                            "multiprocess_reader: worker process died "
+                            "without reporting end-of-stream (killed?)")
+                    continue
                 if item == _MP_END:
                     finished += 1
                 elif isinstance(item, tuple) and len(item) == 2 \
@@ -464,8 +497,12 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn):
         try:
             samples = [dataset[i] for i in indices]
             data_queue.put((seq, collate_fn(samples)))
-        except Exception as e:  # surface worker errors to the parent
-            data_queue.put((seq, e))
+        except BaseException as e:  # surface worker errors to the parent
+            try:
+                data_queue.put((seq, e))
+            except Exception:  # unpicklable exception: send a summary
+                data_queue.put((seq, RuntimeError(
+                    f"DataLoader worker failed: {type(e).__name__}: {e}")))
 
 
 class DataLoader:
@@ -611,7 +648,18 @@ class DataLoader:
             next_seq = 0
             received = 0
             while received < len(batches):
-                seq, cols = data_q.get()
+                try:
+                    seq, cols = data_q.get(timeout=1.0)
+                except _queue.Empty:
+                    # every worker dead with results still owed: a child
+                    # was killed outright (OOM, SIGKILL) — raise instead
+                    # of leaving the training loop blocked forever
+                    if not any(w.is_alive() for w in workers):
+                        raise RuntimeError(
+                            f"DataLoader: worker processes died with "
+                            f"{len(batches) - received} batches "
+                            "outstanding (killed?)")
+                    continue
                 received += 1
                 if isinstance(cols, Exception):
                     raise cols

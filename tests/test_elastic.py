@@ -387,7 +387,9 @@ sys.exit(0)
     assert rc == 0
     assert time.time() - t0 >= 0.2    # the backoff actually slept
     after = counts()
-    assert after.get("worker_exit", 0) == before.get("worker_exit", 0) + 1
+    # a plain nonzero exit restarts with cause=crash (ISSUE 8 taxonomy:
+    # hang | crash | preempt — see tests/test_health.py for the full set)
+    assert after.get("crash", 0) == before.get("crash", 0) + 1
 
 
 def test_launch_restarts_exhausted_propagates(tmp_path):

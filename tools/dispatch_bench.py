@@ -104,6 +104,18 @@ def main():
         obs_metrics.set_metrics_enabled(True)
     metrics_overhead_pct = (fast_s - nometrics_s) / nometrics_s * 100.0
 
+    # hang-watchdog A/B (ISSUE 8, docs/health.md): same steady-state loop
+    # with a watchdog armed — the per-step progress stamp (one tuple store)
+    # must stay inside the same <5% fast-path gate as the metrics registry
+    from paddle_tpu.parallel import health as health_mod
+
+    health_mod.install_watchdog(3600.0, exit_on_hang=False)
+    try:
+        watchdog_s = time_steps(exe, main_prog, feed, loss, steps)
+    finally:
+        health_mod.uninstall_watchdog()
+    watchdog_overhead_pct = (watchdog_s - fast_s) / fast_s * 100.0
+
     # floor: the raw jitted call with prebuilt args (what no framework
     # dispatch layer could beat)
     rec = exe._dispatch_records[(id(main_prog), (loss.name,))]
@@ -154,6 +166,9 @@ def main():
     print(f"metrics registry overhead: {metrics_overhead_pct:+.2f}% "
           f"(fast path {fast_s * 1e6:.1f} us with vs "
           f"{nometrics_s * 1e6:.1f} us without; target < 5%)")
+    print(f"hang-watchdog overhead:    {watchdog_overhead_pct:+.2f}% "
+          f"(armed {watchdog_s * 1e6:.1f} us vs "
+          f"{fast_s * 1e6:.1f} us unarmed; target < 5%)")
 
     out = {
         "metric": "executor_dispatch_overhead_us_per_step",
@@ -169,6 +184,8 @@ def main():
         "speedup_overhead": round(ratio_overhead, 2),
         "fast_nometrics_us_per_step": round(nometrics_s * 1e6, 2),
         "metrics_overhead_pct": round(metrics_overhead_pct, 2),
+        "fast_watchdog_us_per_step": round(watchdog_s * 1e6, 2),
+        "watchdog_overhead_pct": round(watchdog_overhead_pct, 2),
     }
     if json_path:
         with open(json_path, "w") as f:

@@ -1,13 +1,16 @@
 #!/usr/bin/env python
-"""Fault-injection harness for the elastic training layer (docs/elastic.md).
+"""Fault-injection harness for the elastic + in-run-health layers
+(docs/elastic.md, docs/health.md).
 
-Proves the ISSUE 7 acceptance bar end-to-end on the 8-virtual-device CPU
-mesh: workers are killed mid-step (SIGKILL and SIGTERM), a checkpoint shard
-is truncated, a partial (uncommitted) checkpoint is planted — and the job
-recovers automatically through ``parallel.launch``'s supervised restarts,
+Proves the ISSUE 7 + ISSUE 8 acceptance bars end-to-end on the
+8-virtual-device CPU mesh: workers are killed mid-step (SIGKILL and
+SIGTERM), a checkpoint shard is truncated, a partial (uncommitted)
+checkpoint is planted, a rank stalls mid-step, a batch is poisoned with
+NaNs, a run diverges for K consecutive steps — and the job recovers
+automatically, with no human intervention, through ``parallel.launch``'s
+supervised restarts and ``parallel.health``'s watchdog/guardrails,
 resuming from the latest *committed* checkpoint to loss parity with an
-uninterrupted run (bit-exact at equal dp; the dp=8 -> dp=4 resharded
-restore is itself proven bit-exact via per-leaf moment checksums).
+uninterrupted run (bit-exact at equal dp).
 
 Scenarios (full mode; ``--smoke`` runs the starred subset on a tinier
 config for the tier-1 lane):
@@ -26,6 +29,22 @@ config for the tier-1 lane):
                     resharded through the manifest bucket layouts);
                     restore proven bit-exact by leaf checksums, training
                     continues to loss parity within tolerance
+  hang            * worker deliberately stalls mid-step on its first
+                    incarnation; its hang watchdog fires within the
+                    deadline, dumps all-thread stacks, exits with the
+                    distinct hang code; the supervisor restarts with
+                    cause=hang and the rerun resumes -> bit-exact
+  poison_batch    * one dp rank's shard of one batch is NaN; the in-jit
+                    guardrail skips the step IDENTICALLY on all 8 dp ranks
+                    (per-rank skip flags asserted) -> final weights
+                    bit-exact vs a run without the poison batch
+  divergence_rollback  a huge-lr fault diverges the run; after K
+                    consecutive loss-spike steps the guard rolls back to
+                    the latest valid checkpoint with an LR cooldown and
+                    the loss trajectory recovers
+  straggler         a 2-rank gang where rank 1 sleeps every step; the
+                    supervisor's heartbeat poll flags rank 1
+                    (paddle_straggler_detected_total) within the run
 
 Writes FAULT_BENCH.json.  Usage:
 
@@ -105,22 +124,42 @@ def worker(args):
     import jax
 
     from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel import health
     from paddle_tpu.parallel import parallelize as PZ
     from paddle_tpu.parallel.checkpoint import (ElasticCheckpointer,
                                                 restore_train_state)
     from paddle_tpu.parallel.launch import install_preemption_handler
 
     preempt = install_preemption_handler()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    # a multi-rank gang gets per-rank result/checkpoint paths (the
+    # straggler scenario's ranks train independently)
+    result_path = args.result + (f".rank{rank}" if trainers > 1 else "")
+    ckpt_dir = (os.path.join(args.ckpt_dir, f"rank{rank}")
+                if trainers > 1 else args.ckpt_dir)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    base_lr = 1e-2
     cfg = G.GPT_TINY.scaled(num_layers=args.layers)
     pcfg = PZ.ParallelConfig(dp=args.dp, pp=1, tp=1, microbatches=1)
     mesh = PZ.build_mesh(pcfg)
     layout, repl = PZ.rs_param_layout(cfg, pcfg)
     params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh,
                                   grad_reduce="reduce_scatter")
-    step_fn = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-2,
-                                 grad_reduce="reduce_scatter")
+    step_fn = PZ.make_train_step(cfg, pcfg, mesh, lr=base_lr,
+                                 grad_reduce="reduce_scatter",
+                                 skip_nonfinite=True)
+    # divergence injection: a huge-lr step stands in for the real thing
+    # (lr bug, bad data segment) — the guard must catch it from the loss
+    bad_step_fn = (PZ.make_train_step(cfg, pcfg, mesh, lr=args.diverge_lr,
+                                      grad_reduce="reduce_scatter")
+                   if args.diverge_at else None)
+    guard = (health.DivergenceGuard(health.GuardrailConfig(
+        spike_mult=2.0, min_history=2, max_consecutive_bad=args.guard_k,
+        lr_cooldown=0.5, max_rollbacks=2))
+        if args.diverge_at else None)
 
-    ck = ElasticCheckpointer(args.ckpt_dir, keep_last=args.keep_last)
+    ck = ElasticCheckpointer(ckpt_dir, keep_last=args.keep_last)
     start = 0
     restored_from = None
     reshard_bit_exact = None
@@ -137,13 +176,22 @@ def worker(args):
         _log(f"worker pid={os.getpid()} restored step {start} "
              f"(reshard_bit_exact={reshard_bit_exact})")
 
-    with open(os.path.join(args.ckpt_dir, "incarnations.jsonl"), "a") as f:
+    with open(os.path.join(ckpt_dir, "incarnations.jsonl"), "a") as f:
         f.write(json.dumps({
             "pid": os.getpid(), "start_step": start,
             "restored_from": restored_from,
             "reshard_bit_exact": reshard_bit_exact,
             "attempt": int(os.environ.get("PADDLE_RESTART_ATTEMPT", 0)),
         }) + "\n")
+
+    # in-run health (docs/health.md): the watchdog arms only now — init +
+    # the first-step compile are behind us (the engine suspends its own
+    # AOT compiles, this keeps the deadline honest for everything else)
+    health.maybe_install_from_env()
+    hb_dir = os.environ.get(health.ENV_DIR)
+    heartbeat = (health.RankHeartbeat(hb_dir, rank,
+                                      min_write_interval_s=0.2)
+                 if hb_dir else None)
 
     def save(step_no):
         ck.save(step_no, {"params": params, "opt": opt},
@@ -158,14 +206,52 @@ def worker(args):
         ck.wait()
 
     loss = None
+    trajectory = []
+    rollback_restored_from = None
+    injecting = bool(args.diverge_at)
     for step in range(start + 1, args.steps + 1):
         if preempt.triggered:
             _log(f"worker preempted at step {step - 1}: checkpoint + exit 0")
             save(step - 1)
             ck.close()
             sys.exit(0)
+        if args.straggle_ms and rank == args.straggle_rank:
+            time.sleep(args.straggle_ms / 1000.0)
         toks, labs = _batch(step, cfg, args.batch, args.seqlen)
-        params, opt, loss, _ = step_fn(params, opt, toks, labs)
+        fn = (bad_step_fn if injecting and step >= args.diverge_at
+              else step_fn)
+        params, opt, loss, _ = fn(params, opt, toks, labs)
+        if heartbeat is not None:
+            heartbeat.beat(step)
+        verdict = "ok"
+        if guard is not None:
+            lv = float(loss)
+            trajectory.append(round(lv, 4))
+            verdict = guard.judge(lv)
+            if verdict == "rollback":
+                latest = ck.latest_valid_step()
+                _log(f"guardrail rollback at step {step} -> checkpoint "
+                     f"{latest} (lr cooldown x{guard.config.lr_cooldown})")
+                params, opt, _man = restore_train_state(
+                    ck, params, opt, layout=layout, layout_repl=repl,
+                    step=latest)
+                guard.rolled_back()
+                rollback_restored_from = latest
+                # the injected fault ends at rollback (a transient bad
+                # segment); training continues at the cooled rate
+                injecting = False
+                step_fn = PZ.make_train_step(
+                    cfg, pcfg, mesh,
+                    lr=base_lr * guard.config.lr_cooldown,
+                    grad_reduce="reduce_scatter", skip_nonfinite=True)
+        if args.hang_at and step == args.hang_at and args.once_marker and \
+                not os.path.exists(args.once_marker):
+            # first incarnation only: stall mid-run — the watchdog must
+            # fire within its deadline, dump stacks and exit 43
+            with open(args.once_marker, "w") as f:
+                f.write(str(os.getpid()))
+            _log(f"worker stalling at step {step} (watchdog should fire)")
+            time.sleep(600)  # the watchdog os._exit()s before this returns
         if args.die_at and step == args.die_at and args.once_marker and \
                 not os.path.exists(args.once_marker):
             # first incarnation only: fault-inject on ourselves mid-interval
@@ -181,7 +267,9 @@ def worker(args):
                 ck.close()
                 sys.exit(0)
             time.sleep(30)  # SIGKILL lands before this returns
-        if step % args.interval == 0:
+        if step % args.interval == 0 and verdict == "ok":
+            # never checkpoint a step the guard judged bad — a rollback
+            # must always find a pre-divergence target
             save(step)
 
     final_loss = float(loss) if loss is not None else None
@@ -192,12 +280,20 @@ def worker(args):
         "reshard_bit_exact": reshard_bit_exact,
         "dp": args.dp,
     }
+    if heartbeat is not None:
+        heartbeat.flush()
+    if guard is not None:
+        result.update(
+            trajectory=trajectory,
+            guard_skipped=guard.skipped_steps,
+            guard_rollbacks=guard.rollbacks,
+            rollback_restored_from=rollback_restored_from)
     save(args.steps)
     ck.close()
-    tmp = args.result + ".tmp"
+    tmp = result_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(result, f)
-    os.replace(tmp, args.result)
+    os.replace(tmp, result_path)
     _log(f"worker done: {result}")
 
 
@@ -215,20 +311,126 @@ def _worker_args(ns, **over):
     return out[1:]  # launch() gets (script, args)
 
 
-def _run_job(base, max_restarts=2, **over):
+def _run_job(base, max_restarts=2, launch_kw=None, **over):
     """One supervised job: returns (rc, result dict or None)."""
     from paddle_tpu.parallel.launch import launch
 
     args = _worker_args(base, **over)
     rc = launch(os.path.abspath(__file__), args, max_restarts=max_restarts,
                 restart_backoff_s=0.2, restart_backoff_max_s=1.0,
-                grace_period_s=20.0)
+                grace_period_s=20.0, **(launch_kw or {}))
     result_path = over.get("result") or base["result"]
     result = None
     if os.path.exists(result_path):
         with open(result_path) as f:
             result = json.load(f)
     return rc, result
+
+
+def _restart_causes():
+    """In-process paddle_restarts_total{cause} snapshot (launch() runs in
+    this process, so the supervisor counters are directly assertable)."""
+    from paddle_tpu.observability import default_registry
+
+    snap = default_registry().snapshot()
+    series = snap.get("paddle_restarts_total", {}).get("series", [])
+    return {s["labels"][0]: s["value"] for s in series}
+
+
+def _straggler_detections():
+    from paddle_tpu.observability import default_registry
+
+    snap = default_registry().snapshot()
+    series = snap.get("paddle_straggler_detected_total", {}) \
+        .get("series", [])
+    return {s["labels"][0]: s["value"] for s in series}
+
+
+# ---------------------------------------------------------------------------
+# Poison-batch scenario (in-process: the dp ranks are lanes of one 8-device
+# shard_map program — exactly the engine's dp execution model)
+# ---------------------------------------------------------------------------
+
+def poison_batch_scenario(steps=6, batch=4, din=8, poison_at=3,
+                          poison_rank=2):
+    """Linear-regression train step on the 8-device dp mesh with the in-jit
+    ``health.nonfinite_guard``: one rank's shard of one batch is NaN.  The
+    guard's predicate is the psum'd loss, so the step must be skipped
+    IDENTICALLY on all dp ranks (per-rank skip flags fetched and asserted)
+    and the final weights must be bit-exact to a run without the poison
+    batch."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.parallel import health
+    from paddle_tpu.parallel.parallelize import shard_map_compat
+
+    n = N_DEVICES
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("dp",))
+
+    def per_rank(w, x, y):
+        def local_loss(w):
+            return jnp.sum((x @ w - y) ** 2)
+
+        lval, g = jax.value_and_grad(local_loss)(w)
+        loss = jax.lax.psum(lval, "dp") / (batch * n)
+        g = jax.lax.psum(g, "dp") / (batch * n)
+        new_w = w - 0.1 * g
+        (new_w,), bad = health.nonfinite_guard((w,), (new_w,), loss)
+        return new_w, loss, jnp.atleast_1d(bad)
+
+    step = jax.jit(shard_map_compat(
+        per_rank, mesh,
+        in_specs=(P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P("dp"))))
+
+    rng = np.random.default_rng(7)
+    w_true = rng.standard_normal((din,)).astype(np.float32)
+
+    def make_batch(i, poisoned=False):
+        r = np.random.default_rng(100 + i)
+        x = r.standard_normal((n * batch, din)).astype(np.float32)
+        y = (x @ w_true + 0.01 * r.standard_normal(n * batch)
+             ).astype(np.float32)
+        if poisoned:
+            x = x.copy()
+            x[poison_rank * batch:(poison_rank + 1) * batch] = np.nan
+        return x, y
+
+    def run(poison: bool):
+        w = jnp.zeros((din,), jnp.float32)
+        flags, losses = [], []
+        for i in range(steps):
+            if not poison and i == poison_at:
+                continue  # the clean reference simply never sees it
+            x, y = make_batch(i, poisoned=poison and i == poison_at)
+            w, loss, bad = step(w, x, y)
+            flags.append(np.asarray(bad).astype(bool).tolist())
+            losses.append(float(np.asarray(loss).ravel()[0]))
+        return np.asarray(w), flags, losses
+
+    w_clean, _, _ = run(poison=False)
+    w_poison, flags, losses = run(poison=True)
+    poison_flags = flags[poison_at]
+    other_flags = [f for i, f in enumerate(flags) if i != poison_at]
+    s = {
+        "poison_step": poison_at, "poison_rank": poison_rank,
+        "dp": n,
+        "per_rank_skip_flags_at_poison": poison_flags,
+        "all_ranks_skipped_identically": all(poison_flags)
+            and len(poison_flags) == n,
+        "no_other_step_skipped": not any(any(f) for f in other_flags),
+        "weights_bit_exact_vs_no_poison":
+            w_clean.tobytes() == w_poison.tobytes(),
+        "final_loss": losses[-1],
+    }
+    s["pass"] = bool(s["all_ranks_skipped_identically"]
+                     and s["no_other_step_skipped"]
+                     and s["weights_bit_exact_vs_no_poison"]
+                     and np.isfinite(losses[-1]))
+    return s
 
 
 def _incarnations(ckpt_dir):
@@ -347,7 +549,112 @@ def harness(smoke, out_path):
     _log(f"corrupt_shard: {s['pass']} (restored {restored}, "
          f"expected {expect_restore})")
 
+    # --- hang: watchdog fires, stack dump written, cause=hang restart ----
+    health_dir = os.path.join(work, "hang_health")
+    ns = run("hang", hang_at=die_at,
+             once_marker=os.path.join(work, "hang.marker"))
+    causes_before = _restart_causes()
+    rc, res = _run_job(ns, max_restarts=2,
+                       launch_kw=dict(hang_deadline_s=4.0,
+                                      health_dir=health_dir))
+    causes_after = _restart_causes()
+    inc = _incarnations(ns["ckpt_dir"])
+    expect_restore = (die_at // base["interval"]) * base["interval"]
+    import glob as _glob
+    dumps = _glob.glob(os.path.join(health_dir, "hang_*", "stacks.txt"))
+    s = {
+        "rc": rc, "result": res,
+        "incarnations": len(inc),
+        "hang_restarts": causes_after.get("hang", 0)
+            - causes_before.get("hang", 0),
+        "stack_dumps": dumps,
+        "restored_from": [r["restored_from"] for r in inc],
+        "expected_restore": expect_restore,
+        "match_baseline": _match(res and res["final_loss"],
+                                 baseline["final_loss"]),
+        "params_match": bool(res) and
+            res["params_crc"] == baseline["params_crc"],
+    }
+    s["pass"] = (rc == 0 and s["hang_restarts"] >= 1 and len(dumps) >= 1
+                 and inc and inc[-1]["restored_from"] == expect_restore
+                 and s["match_baseline"] == "bit_exact" and s["params_match"])
+    scenarios["hang"] = s
+    ok &= s["pass"]
+    _log(f"hang: {s['pass']} (restarts cause=hang {s['hang_restarts']}, "
+         f"{len(dumps)} stack dumps, {s['match_baseline']})")
+
+    # --- poison batch: in-jit guardrail, dp-identical skip, bit-exact ----
+    s = poison_batch_scenario(poison_at=2 if smoke else 3)
+    scenarios["poison_batch"] = s
+    ok &= s["pass"]
+    _log(f"poison_batch: {s['pass']} (all ranks skipped="
+         f"{s['all_ranks_skipped_identically']}, bit_exact="
+         f"{s['weights_bit_exact_vs_no_poison']})")
+
     if not smoke:
+        # --- divergence -> guardrail rollback + LR cooldown --------------
+        dv_steps = base["steps"] + 2
+        ns = run("divergence_rollback", steps=dv_steps, diverge_at=die_at,
+                 guard_k=2)
+        rc, res = _run_job(ns, max_restarts=0)
+        traj = (res or {}).get("trajectory") or []
+        peak = max(traj) if traj else None
+        # the last checkpoint the guard never judged bad: the interval
+        # boundary at/below the first diverged step
+        expect_rb = ((die_at - 1) // base["interval"]) * base["interval"]
+        s = {
+            "rc": rc, "result": res,
+            "diverge_at": die_at, "guard_k": 2,
+            "trajectory": traj, "peak_loss": peak,
+            "skipped": (res or {}).get("guard_skipped"),
+            "rollbacks": (res or {}).get("guard_rollbacks"),
+            "rollback_restored_from":
+                (res or {}).get("rollback_restored_from"),
+            "expected_rollback_target": expect_rb,
+            "baseline_final": baseline["final_loss"],
+        }
+        import math
+        final = (res or {}).get("final_loss")
+        s["recovered"] = (final is not None and math.isfinite(final)
+                          and peak is not None and final < 0.5 * peak
+                          and final <= baseline["final_loss"] * 1.25)
+        s["pass"] = (rc == 0 and s["rollbacks"] == 1
+                     and s["skipped"] == 2
+                     and s["rollback_restored_from"] == expect_rb
+                     and s["recovered"])
+        scenarios["divergence_rollback"] = s
+        ok &= s["pass"]
+        _log(f"divergence_rollback: {s['pass']} (rollback -> "
+             f"{s['rollback_restored_from']}, final {final} vs peak {peak})")
+
+        # --- straggler: 2-rank gang, rank 1 sleeps, supervisor flags it --
+        sg_health = os.path.join(work, "straggler_health")
+        ns = run("straggler", straggle_ms=250, straggle_rank=1,
+                 steps=24, interval=100, dp=1, layers=1, batch=2, seqlen=8)
+        det_before = _straggler_detections()
+        rc, _res = _run_job(
+            ns, max_restarts=0,
+            launch_kw=dict(nproc_per_node=2, health_dir=sg_health,
+                           straggler_warn_cooldown_s=5.0))
+        det_after = _straggler_detections()
+        from paddle_tpu.parallel import health as health_mod
+        findings = health_mod.detect_stragglers(sg_health, ratio=2.0)
+        rank1_detections = det_after.get("1", 0) - det_before.get("1", 0)
+        s = {
+            "rc": rc,
+            "rank1_detections": rank1_detections,
+            "rank0_detections": det_after.get("0", 0)
+                - det_before.get("0", 0),
+            "final_heartbeat_findings": findings,
+            "flagged_ranks": sorted({f["rank"] for f in findings}),
+        }
+        s["pass"] = (rc == 0 and rank1_detections >= 1
+                     and s["rank0_detections"] == 0
+                     and s["flagged_ranks"] == [1])
+        scenarios["straggler"] = s
+        ok &= s["pass"]
+        _log(f"straggler: {s['pass']} (rank1 detections "
+             f"{rank1_detections}, findings {findings})")
         # --- SIGTERM preemption: checkpoint-and-exit, relaunch resumes ---
         ns = run("sigterm_preempt", die_at=die_at, die_sig="TERM",
                  once_marker=os.path.join(work, "sigterm.marker"))
@@ -435,6 +742,19 @@ def main():
     ap.add_argument("--die-at", type=int, default=0)
     ap.add_argument("--die-sig", default="KILL", choices=("KILL", "TERM"))
     ap.add_argument("--once-marker")
+    # in-run health injections (docs/health.md)
+    ap.add_argument("--hang-at", type=int, default=0,
+                    help="stall (sleep 600s) at this step, first "
+                         "incarnation only — the watchdog must fire")
+    ap.add_argument("--straggle-ms", type=int, default=0,
+                    help="per-step sleep applied on --straggle-rank")
+    ap.add_argument("--straggle-rank", type=int, default=1)
+    ap.add_argument("--diverge-at", type=int, default=0,
+                    help="from this step, use a huge-lr step (injected "
+                         "divergence) until the guard rolls back")
+    ap.add_argument("--diverge-lr", type=float, default=30.0)
+    ap.add_argument("--guard-k", type=int, default=2,
+                    help="consecutive bad steps before rollback")
     args = ap.parse_args()
     if args.worker:
         worker(args)

@@ -61,15 +61,18 @@ def validate_prom_text(text: str) -> int:
     return samples
 
 
-def _write_mlp_files(tmpdir, rows=96, din=8, classes=4):
+def _write_mlp_files(tmpdir, rows=96, din=8, classes=4, name="part-0",
+                     poison_rows=()):
     import numpy as np
 
     rng = np.random.RandomState(0)
-    path = os.path.join(tmpdir, "part-0")
+    path = os.path.join(tmpdir, name)
     with open(path, "w") as f:
-        for _ in range(rows):
+        for i in range(rows):
             x = rng.randn(din).astype(np.float32)
             y = int(rng.randint(0, classes))
+            if i in poison_rows:
+                x = np.full(din, np.nan, np.float32)
             xs = " ".join(f"{v:.6f}" for v in x)
             f.write(f"{din} {xs} 1 {y}\n")
     return [path]
@@ -101,6 +104,17 @@ def _run_check_inner(out_dir: str) -> dict:
     from paddle_tpu.dataset import DatasetFactory
     from paddle_tpu.observability import (TrainMonitor, default_registry, hw,
                                           prom)
+
+    def _counter_sum(name):
+        snap_h = default_registry().snapshot()
+        return sum(s["value"]
+                   for s in snap_h.get(name, {}).get("series", []))
+
+    # delta-based: an in-process caller (tests/test_observability.py) may
+    # follow watchdog tests that legitimately ticked the hang counter —
+    # the gate is that THIS clean run never moves it (a fresh standalone
+    # process asserts absolute zero by the same check)
+    hangs_before = _counter_sum("paddle_hangs_total")
 
     din, classes, batch = 8, 4, 16
     prog, startup = fluid.Program(), fluid.Program()
@@ -233,6 +247,54 @@ def _run_check_inner(out_dir: str) -> dict:
         assert delta == expect, \
             f"collective byte counter: got {delta}, want {expect}"
 
+    # --- in-run health metrics (docs/health.md) -------------------------
+    # a hang counter that ticked during this clean run would mean the
+    # watchdog misfired (delta vs the top-of-run snapshot)
+    assert _counter_sum("paddle_hangs_total") == hangs_before, \
+        "paddle_hangs_total moved during a clean run"
+
+    # guardrail skip counter, EXACT: a second guarded train over a dataset
+    # with exactly one seeded NaN batch must skip exactly one step and
+    # finish with finite weights
+    from paddle_tpu.parallel.health import GuardrailConfig
+
+    skips_before = _counter_sum("paddle_guardrail_skipped_steps_total")
+    g_prog, g_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(g_prog, g_startup):
+        gx = fluid.layers.data("gx", [din], dtype="float32")
+        gy = fluid.layers.data("gy", [1], dtype="int64")
+        gh = fluid.layers.fc(gx, 16, act="relu")
+        g_loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(
+                fluid.layers.fc(gh, classes), gy))
+        fluid.optimizer.SGD(0.1).minimize(g_loss)
+    g_ds = DatasetFactory().create_dataset("InMemoryDataset")
+    g_ds.set_use_var([gx, gy])
+    g_ds.set_batch_size(batch)
+    # rows 32..47 = batch index 2 — one poisoned batch out of six
+    g_ds.set_filelist(_write_mlp_files(
+        out_dir, name="part-guard", poison_rows=range(32, 48)))
+    g_ds.load_into_memory()
+    g_scope = fluid.Scope()
+    with fluid.scope_guard(g_scope):
+        g_exe = fluid.Executor(fluid.XLAPlace(0))
+        g_exe.run(g_startup)
+        g_final = g_exe.train_from_dataset(
+            g_prog, g_ds, fetch_list=[g_loss],
+            guardrails=GuardrailConfig())
+        import numpy as _np
+
+        for p in g_prog.global_block().all_parameters():
+            w = _np.asarray(g_scope.find_var(p.name))
+            assert _np.isfinite(w).all(), \
+                f"guarded train left non-finite weights in {p.name}"
+    assert g_final is not None and math.isfinite(float(g_final[0].ravel()[0]))
+    skips_delta = _counter_sum("paddle_guardrail_skipped_steps_total") \
+        - skips_before
+    assert skips_delta == 1, \
+        f"guardrail skip counter moved by {skips_delta}, expected exactly " \
+        "1 for the single seeded NaN batch"
+
     # --- static-analysis lint counter (docs/static_analysis.md) --------
     # lint the same MLP program the train loop just ran: the program must
     # be error-clean, and every finding must land in
@@ -279,12 +341,24 @@ def _run_check_inner(out_dir: str) -> dict:
     for name in ("paddle_checkpoint_save_ms", "paddle_checkpoint_bytes_total",
                  "paddle_restarts_total"):
         assert name in prom_text, f"{name} missing from exposition"
+    # in-run health families (docs/health.md): the hang/straggler counters
+    # are registered (HELP/TYPE rendered) even when this clean in-process
+    # run never hung or straggled; the guardrail skip counter carries the
+    # exact single-NaN-batch sample from the guarded train above
+    for name in ("paddle_hangs_total", "paddle_straggler_detected_total",
+                 "paddle_rank_step_time_ewma_ms",
+                 "paddle_guardrail_rollbacks_total"):
+        assert name in prom_text, f"{name} missing from exposition"
+    assert 'paddle_guardrail_skipped_steps_total{reason="nonfinite"} 1' \
+        in prom_text or skips_before > 0, \
+        "guardrail skip sample missing from exposition"
 
     return {"steps": len(records), "prom_samples": samples,
             "program_reports": len(reports),
             "checkpoint_steps": committed,
             "checkpoint_bytes": ckpt_bytes,
             "lint_findings": lint_after,
+            "guardrail_skips": skips_delta,
             "jsonl": jsonl_path, "prom": prom_path,
             "last_record": records[-1]}
 
