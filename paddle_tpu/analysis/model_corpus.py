@@ -192,6 +192,59 @@ def build_ps_transpiled() -> ModelProgram:
                         [loss.name], extra={"pserver": pserver})
 
 
+def build_serving_prefill() -> ModelProgram:
+    """The serving prefill program shape (docs/serving.md): a FIXED-length
+    bucket slice of a decoder — tokens [1, T] in, last-position logits
+    out. Every dim static (``append_batch_size=False``) on purpose: the
+    recompile_risk checker should find NOTHING to flag, mirroring the
+    zero-recompile contract the real engine (paddle_tpu/serving/engine.py)
+    enforces at runtime."""
+    def b(fluid):
+        V, T, D = 64, 16, 32
+        tok = fluid.layers.data("tokens", [1, T], dtype="int64",
+                                append_batch_size=False)
+        emb = fluid.layers.embedding(tok, size=[V, D],
+                                     param_attr=fluid.ParamAttr("srv_wte"))
+        h = fluid.layers.fc(emb, D, num_flatten_dims=2, act="relu")
+        h = fluid.layers.fc(h, D, num_flatten_dims=2, act="relu")
+        last = fluid.layers.slice(h, axes=[1], starts=[T - 1], ends=[T])
+        logits = fluid.layers.fc(
+            fluid.layers.reshape(last, [1, D]), V)
+        return fluid.layers.softmax(logits)
+
+    main, startup, prob = _guarded(b)
+    return ModelProgram("serving_prefill", main, startup, ["tokens"],
+                        [prob.name])
+
+
+def build_serving_decode() -> ModelProgram:
+    """The serving decode program shape: one token per slot over a static
+    [max_batch] layout plus a fixed-shape cache feed that is shifted
+    ring-buffer style and fetched back — the IR-level model of the
+    donate-in/donate-out KV slabs. Donation + recompile_risk are the
+    checkers this program exists for: fixed shapes end to end, no
+    persistable writes, the updated cache is an explicit fetch."""
+    def b(fluid):
+        V, B, S, D = 64, 4, 8, 32
+        tok = fluid.layers.data("token", [B, 1], dtype="int64",
+                                append_batch_size=False)
+        cache = fluid.layers.data("cache_k", [B, S, D], dtype="float32",
+                                  append_batch_size=False)
+        emb = fluid.layers.embedding(tok, size=[V, D],
+                                     param_attr=fluid.ParamAttr("srv_wte2"))
+        # ring shift: drop the oldest cache row, append this token's slab
+        tail = fluid.layers.slice(cache, axes=[1], starts=[1], ends=[S])
+        new_cache = fluid.layers.concat([tail, emb], axis=1)
+        pooled = fluid.layers.reduce_mean(new_cache, dim=1)    # [B, D]
+        logits = fluid.layers.fc(pooled, V)
+        return fluid.layers.softmax(logits), new_cache
+
+    main, startup, (prob, new_cache) = _guarded(b)
+    return ModelProgram("serving_decode", main, startup,
+                        ["token", "cache_k"],
+                        [prob.name, new_cache.name])
+
+
 MODEL_BUILDERS: "Dict[str, Callable[[], ModelProgram]]" = {
     "mlp": build_mlp,
     "gpt": build_gpt,
@@ -200,6 +253,8 @@ MODEL_BUILDERS: "Dict[str, Callable[[], ModelProgram]]" = {
     "pipeline": build_pipeline,
     "grad_merge": build_grad_merge,
     "ps_transpiled": build_ps_transpiled,
+    "serving_prefill": build_serving_prefill,
+    "serving_decode": build_serving_decode,
 }
 
 

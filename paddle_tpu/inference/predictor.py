@@ -63,6 +63,12 @@ class Predictor:
         self._fetch_names = list(fetch_names)
         self._scope = scope
         self._exe = Executor(XLAPlace(0))
+        # served programs are first-class observability citizens: the
+        # executor's program report / recompile-explainer lines carry a
+        # recognizable serving label instead of the "<fetch>#Nops" default
+        program._annotations.setdefault(
+            "report_name",
+            f"predict/{fetch_names[0] if fetch_names else 'main'}")
 
     # -- reference API surface ---------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -213,12 +219,27 @@ def export_stablehlo(dirname: str, program: Program,
 
 
 class StableHLOPredictor:
-    """Runs a serialized StableHLO artifact — no Program machinery needed."""
+    """Runs a serialized StableHLO artifact — no Program machinery needed.
 
-    def __init__(self, exported, feed_names, fetch_names):
+    Serving-path discipline (ISSUE 9 satellite): calls dispatch through a
+    per-signature AOT-compiled executable (the PR 1 steady-state shape:
+    compile once, then a dict hit per request) instead of re-tracing
+    ``exported.call`` every time, and every compile emits a PR 4 program
+    report plus a recompile-explainer line when a signature churns — a
+    shape-unstable client shows up in ``paddle_recompiles_total`` exactly
+    like a shape-unstable training loop would.
+    """
+
+    _MAX_EXECUTABLES = 64   # per-signature cache bound (bucketed clients)
+
+    def __init__(self, exported, feed_names, fetch_names,
+                 name: str = "stablehlo"):
         self._exported = exported
         self._feed_names = feed_names
         self._fetch_names = fetch_names
+        self._report_name = f"serve/{name}"
+        self._compiled: Dict[tuple, Any] = {}
+        self._sig_history: List[dict] = []
 
     def get_input_names(self):
         return list(self._feed_names)
@@ -226,9 +247,39 @@ class StableHLOPredictor:
     def get_output_names(self):
         return list(self._fetch_names)
 
+    def _executable(self, vals):
+        import time
+
+        from ..observability import program_report as _prep
+
+        key = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+        exe = self._compiled.get(key)
+        if exe is not None:
+            return exe
+        sig = _prep.make_sig(
+            [(n, tuple(v.shape), str(v.dtype))
+             for n, v in zip(self._feed_names, vals)], self._fetch_names)
+        if self._sig_history:
+            cause, detail = _prep.explain_recompile(sig, self._sig_history)
+            _prep.note_recompile(self._report_name, cause, detail)
+        self._sig_history.append(sig)
+        del self._sig_history[:-8]
+        t0 = time.perf_counter_ns()
+        exe = jax.jit(self._exported.call).lower(*vals).compile()
+        _prep.capture(
+            self._report_name, compiled=exe,
+            compile_ms=(time.perf_counter_ns() - t0) / 1e6,
+            inputs=list(vals),
+            extra={"feeds": list(self._feed_names),
+                   "fetches": list(self._fetch_names)})
+        if len(self._compiled) >= self._MAX_EXECUTABLES:
+            self._compiled.clear()
+        self._compiled[key] = exe
+        return exe
+
     def run(self, feed: Dict[str, Any]) -> List[np.ndarray]:
         vals = [jnp.asarray(feed[n]) for n in self._feed_names]
-        outs = self._exported.call(*vals)
+        outs = self._executable(vals)(*vals)
         return [np.asarray(o) for o in outs]
 
 

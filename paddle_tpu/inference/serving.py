@@ -1,117 +1,114 @@
-"""Minimal model server over an export_stablehlo artifact — the serving
-shell the reference exposes through its C API + demo servers
-(paddle/fluid/inference/capi/pd_predictor.cc, demo_ci/). TPU-native
-deployment artifact = serialized StableHLO (jax.export), so the server is
-a ~100-line stdlib HTTP host with zero framework dependency at request
-time.
+"""Model server — the serving front door over an exported artifact or a
+generation engine.
+
+Historically this was a ~100-line stdlib HTTP wrapper around a StableHLO
+export (the reference's capi/pd_predictor.cc demo-server parity). It is
+now a thin facade over :mod:`paddle_tpu.serving` (docs/serving.md): the
+same ``ModelServer``/``serve()`` surface, but requests flow through the
+production front door — bounded admission (429 on queue-full), per-request
+deadlines (504), JSON error bodies for handler failures (400 client / 500
+internal), graceful drain on SIGTERM, and ``paddle_serve_*`` metrics with
+a ``/metrics`` exposition endpoint.
 
 Protocol (JSON):
-    GET  /health            -> {"status": "ok", "inputs": [...], ...}
+    GET  /health            -> {"status": "ok"|"draining", "inputs": [...]}
+    GET  /metrics           -> Prometheus text exposition
     POST /predict           body {"inputs": {name: nested-list, ...}}
                             -> {"outputs": [nested-list, ...]}
+    POST /generate          (engine-backed servers) body
+                            {"prompt": [ids], "max_new_tokens": N}
+                            -> {"tokens": [...], "ttft_ms": ...}
 
 Run:  python -m paddle_tpu.inference.serving --model-dir DIR --port 8866
 """
 from __future__ import annotations
 
 import argparse
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-
-import numpy as np
 
 __all__ = ["ModelServer", "serve"]
 
 
-class _Handler(BaseHTTPRequestHandler):
-    def log_message(self, fmt, *args):  # quiet by default
-        if self.server.verbose:
-            super().log_message(fmt, *args)
-
-    def _json(self, code: int, obj) -> None:
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def do_GET(self):
-        if self.path != "/health":
-            return self._json(404, {"error": "unknown path"})
-        pred = self.server.predictor
-        self._json(200, {"status": "ok",
-                         "inputs": pred.get_input_names(),
-                         "outputs": pred.get_output_names()})
-
-    def do_POST(self):
-        if self.path != "/predict":
-            return self._json(404, {"error": "unknown path"})
-        n = int(self.headers.get("Content-Length", 0))
-        if n > self.server.max_body_bytes:
-            return self._json(413, {"error": "body too large"})
-        try:
-            req = json.loads(self.rfile.read(n).decode())
-            feed = {k: np.asarray(v) for k, v in req["inputs"].items()}
-            with self.server.lock:          # jax arrays: serialize calls
-                outs = self.server.predictor.run(feed)
-            self._json(200, {"outputs": [np.asarray(o).tolist()
-                                         for o in outs]})
-        except Exception as e:
-            self._json(400, {"error": f"{type(e).__name__}: {e}"})
-
-
 class ModelServer:
-    """Load a StableHLO export dir (or a save_inference_model dir) and
-    serve predictions on localhost."""
+    """Load a StableHLO export dir (or a save_inference_model dir) — or
+    wrap an already-built generation engine — and serve on localhost.
 
-    def __init__(self, model_dir: str, port: int = 0, host: str = "127.0.0.1",
-                 stablehlo: Optional[bool] = None, verbose: bool = False):
-        import os
+    Artifact mode (compat with the pre-ISSUE-9 surface)::
 
-        if stablehlo is None:
-            stablehlo = os.path.exists(os.path.join(model_dir, "model.shlo"))
-        if stablehlo:
-            from .predictor import load_stablehlo_predictor
+        srv = ModelServer(model_dir).start()     # POST /predict
 
-            self.predictor = load_stablehlo_predictor(model_dir)
-        else:
-            from .predictor import Config, create_predictor
+    Engine mode (docs/serving.md)::
 
-            self.predictor = create_predictor(Config(model_dir))
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
-        self.httpd.predictor = self.predictor
-        self.httpd.lock = threading.Lock()
-        self.httpd.verbose = verbose
-        self.httpd.max_body_bytes = 256 << 20
-        self._thread: Optional[threading.Thread] = None
+        srv = ModelServer(scheduler=sched).start()   # POST /generate
+    """
+
+    def __init__(self, model_dir: Optional[str] = None, port: int = 0,
+                 host: str = "127.0.0.1", stablehlo: Optional[bool] = None,
+                 verbose: bool = False, scheduler=None,
+                 max_queue: int = 64, request_timeout_s: float = 30.0):
+        from ..serving.server import FrontDoor
+
+        predictor = None
+        if model_dir is not None:
+            import os
+
+            if stablehlo is None:
+                stablehlo = os.path.exists(
+                    os.path.join(model_dir, "model.shlo"))
+            if stablehlo:
+                from .predictor import load_stablehlo_predictor
+
+                predictor = load_stablehlo_predictor(model_dir)
+            else:
+                from .predictor import Config, create_predictor
+
+                predictor = create_predictor(Config(model_dir))
+        self.predictor = predictor
+        self._front = FrontDoor(
+            scheduler=scheduler, predictor=predictor, host=host, port=port,
+            max_queue=max_queue, request_timeout_s=request_timeout_s,
+            verbose=verbose)
+        # compat: callers (and the old tests) reach for srv.httpd
+        self.httpd = self._front.httpd
 
     @property
     def port(self) -> int:
-        return self.httpd.server_address[1]
+        return self._front.port
+
+    @property
+    def front(self):
+        return self._front
 
     def start(self):
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._front.start()
         return self
 
     def stop(self):
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        self._front.stop()
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Refuse new work, finish in-flight requests, then stop."""
+        return self._front.drain(timeout_s=timeout_s)
+
+    def install_signal_handlers(self, drain_timeout_s: float = 60.0):
+        """SIGTERM/SIGINT -> graceful drain (docs/serving.md runbook)."""
+        self._front.install_signal_handlers(drain_timeout_s)
+        return self
 
 
 def serve(model_dir: str, port: int = 8866, host: str = "127.0.0.1"):
+    """Thin compat shim: host an artifact dir in the foreground with
+    graceful SIGTERM/SIGINT drain installed."""
     srv = ModelServer(model_dir, port=port, host=host, verbose=True)
+    srv.install_signal_handlers()
     print(f"serving {model_dir} on http://{host}:{srv.port}")
+    srv.start()
     try:
-        srv.httpd.serve_forever()
+        while srv._front._thread is not None and \
+                srv._front._thread.is_alive():
+            srv._front._thread.join(timeout=0.5)
     except KeyboardInterrupt:
-        srv.stop()
+        srv.drain(timeout_s=10.0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,98 @@
+"""Decode-path attention over a preallocated KV cache (serving engine).
+
+Training attention (models/gpt.py ``_causal_attention`` / the Pallas flash
+kernel) scores a whole ``[B, T]`` block against itself. Serving decode is a
+different shape class: ONE new token per sequence attends over everything
+the cache already holds, so the kernel is a ``[B, nh, hd] x [B, S, nh, hd]``
+row-score + masked online softmax — O(S) memory, no ``[T, T]`` square, and
+every shape static so the decode executable compiles exactly once
+(docs/serving.md).
+
+The helpers here are pure jnp on purpose: the shapes are MXU-trivial
+(one q row per head), so XLA's fusion is already near roofline on TPU and
+the same code path is CPU-testable. A Pallas variant only pays once decode
+batches are large enough for the HBM round-trip between the score and the
+weighted sum to show up in the step attribution — the KERNEL_NOTES
+decision-table bar every kernel in this repo has to clear first.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decode_attention", "cache_update", "prefill_attention"]
+
+
+def cache_update(cache, new, positions):
+    """Write one new per-sequence row into the cache at ``positions``.
+
+    cache:     [B, S, nh, hd]  (one layer's K or V slab, slot-major)
+    new:       [B, nh, hd]     (this step's projection per sequence)
+    positions: [B] int32       (write index per slot; traced, not static)
+
+    Returns the updated cache. A per-slot ``dynamic_update_slice`` under
+    ``vmap`` lowers to one scatter — fixed shapes, so donation makes it an
+    in-place HBM write on TPU.
+    """
+
+    def upd(c, n, p):
+        return jax.lax.dynamic_update_slice(c, n[None], (p, 0, 0))
+
+    return jax.vmap(upd)(cache, new.astype(cache.dtype), positions)
+
+
+def decode_attention(q, k_cache, v_cache, lengths,
+                     sm_scale: Optional[float] = None):
+    """One-token attention over the cache.
+
+    q:        [B, nh, hd]     — the current token's query
+    k_cache:  [B, S, nh, hd]  — cached keys (only [:lengths[b]] valid)
+    v_cache:  [B, S, nh, hd]
+    lengths:  [B] int32       — valid prefix length per slot, INCLUDING the
+                                current token (callers run
+                                :func:`cache_update` first)
+
+    Returns [B, nh, hd]. Scores are computed in f32 regardless of the
+    cache dtype (softmax stability at bf16 caches), positions >= length are
+    masked to -inf, and empty slots (length 0 — inactive batch lanes in the
+    continuous-batching decode step) produce zeros instead of NaNs.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    S = k_cache.shape[1]
+    scores = jnp.einsum("bnh,bsnh->bns", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * sm_scale
+    valid = jnp.arange(S)[None, None, :] < lengths[:, None, None]
+    scores = jnp.where(valid, scores, -jnp.inf)
+    # max over an all-masked row is -inf; pin it to 0 so exp() is finite
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.where(valid, jnp.exp(scores - m), 0.0)
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    probs = e / jnp.maximum(denom, 1e-30)
+    out = jnp.einsum("bns,bsnh->bnh", probs,
+                     v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def prefill_attention(q, k, v, sm_scale: Optional[float] = None):
+    """Causal self-attention for the prefill pass: [B, T, nh, hd] all
+    around. Numerically the same contraction order as decode_attention so
+    prefill logits and a later decode replay of the same positions agree
+    to float rounding (the parity bar tests/test_serving_engine.py holds
+    the engine to)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    T = q.shape[1]
+    scores = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    mask = jnp.tril(jnp.ones((T, T), jnp.bool_))[None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.where(mask, jnp.exp(scores - m), 0.0)
+    probs = e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bnqk,bknh->bqnh", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

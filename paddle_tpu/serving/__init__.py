@@ -1,0 +1,55 @@
+"""TPU-native serving engine (ISSUE 9, docs/serving.md).
+
+The production-inference half of the north star: AOT-compiled prefill
+(shape-bucketed ladder) and decode (static ``[max_batch]`` slot batch)
+executables over a preallocated, donated KV cache; continuous / in-flight
+batching at token boundaries; int8/bf16 serving weights through the
+comm_opt chunk-scaled quantizer; an HTTP front door with admission
+control, deadlines, backpressure and graceful drain. Steady state is
+ZERO-recompile by construction — ``paddle_recompiles_total`` (PR 4) is
+the enforced guardrail.
+
+Quick start::
+
+    from paddle_tpu.models import gpt
+    from paddle_tpu import serving
+
+    params = gpt.init_params(jax.random.PRNGKey(0), gpt.GPT_SMALL)
+    engine = serving.DecodeEngine(
+        params, gpt.GPT_SMALL,
+        serving.EngineConfig(max_batch=8, max_seq=256,
+                             weight_dtype="int8"))
+    engine.warmup()                      # all compiles happen HERE
+    sched = serving.Scheduler(engine)
+    front = serving.FrontDoor(scheduler=sched, port=8866).start()
+"""
+from .engine import (  # noqa: F401
+    DecodeEngine,
+    EngineConfig,
+    PromptTooLongError,
+    default_bucket_ladder,
+)
+from .kv_cache import CacheFullError, KVCache  # noqa: F401
+from .quant import (  # noqa: F401
+    INT8_LOGIT_TOL,
+    INT8_PPL_REL_TOL,
+    dequantize_params,
+    logit_error_stats,
+    quantize_params,
+)
+from .scheduler import (  # noqa: F401
+    QueueFullError,
+    Request,
+    Scheduler,
+    SchedulerConfig,
+)
+from .server import EngineLoop, FrontDoor  # noqa: F401
+
+__all__ = [
+    "DecodeEngine", "EngineConfig", "PromptTooLongError",
+    "default_bucket_ladder", "KVCache", "CacheFullError",
+    "quantize_params", "dequantize_params", "logit_error_stats",
+    "INT8_LOGIT_TOL", "INT8_PPL_REL_TOL",
+    "Scheduler", "SchedulerConfig", "Request", "QueueFullError",
+    "FrontDoor", "EngineLoop",
+]

@@ -1,0 +1,409 @@
+"""TPU-native decode engine: AOT prefill/decode executables over a
+preallocated KV cache (docs/serving.md).
+
+The training side already proved the ingredients — PR 1's cached dispatch,
+PR 4's explicit ``lower()+compile()`` AOT executables and recompile
+explainer, PR 5's chunk-scaled quantizer. This module assembles them into
+the serving shape:
+
+- **Two program families, compiled once.** Prefill is shape-bucketed: a
+  small fixed ladder of sequence lengths (``EngineConfig.prefill_buckets``),
+  one executable per bucket, every prompt padded up to its bucket. Decode
+  is ONE executable over the static ``[max_batch]`` slot layout — requests
+  join and leave the batch by editing host-side slot state, never a shape.
+  After :meth:`DecodeEngine.warmup`, steady-state serving performs zero
+  compiles; the PR 4 ``paddle_recompiles_total`` counter is the guardrail
+  (tools/metrics_check.py asserts its delta is exactly zero across a
+  warmed smoke serve).
+- **KV cache as carried state.** Both executables take the cache slabs as
+  arguments and return the updated slabs; on TPU the buffers are donated,
+  so the update is an in-place HBM write (donation is skipped on backends
+  that do not support it — CPU — where it would only emit warnings).
+- **Weights in serving precision.** ``weight_dtype="int8"|"bf16"`` stores
+  params through serving/quant.py; dequantization happens inside the
+  compiled functions so HBM holds the quantized bytes. The f32 reference
+  params are kept host-side for the parity bar (drop them with
+  :meth:`drop_reference_params` when HBM matters).
+
+The engine is single-threaded by contract: exactly one scheduler loop
+calls it (serving/scheduler.py). It is GPT-first (models/gpt.py param
+tree); other decoder families plug in by matching the param-tree layout.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models import gpt as gpt_mod
+from ..models.gpt import GPTConfig
+from ..observability import program_report as _prep
+from ..ops.decode_attention import (cache_update, decode_attention,
+                                    prefill_attention)
+from . import metrics as smetrics
+from .kv_cache import KVCache
+from .quant import dequantize_params, quantize_params, quantized_nbytes
+
+__all__ = ["EngineConfig", "DecodeEngine", "PromptTooLongError",
+           "default_bucket_ladder"]
+
+
+class PromptTooLongError(ValueError):
+    """Prompt exceeds the largest prefill bucket."""
+
+
+def default_bucket_ladder(max_seq: int, smallest: int = 16) -> Tuple[int, ...]:
+    """Powers of two from ``smallest`` up to ``max_seq`` (inclusive as the
+    last rung). Each rung is one AOT-compiled prefill executable — the
+    ladder trades warmup compiles against padding waste."""
+    out: List[int] = []
+    b = smallest
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(sorted(set(out)))
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static serving geometry — every field here is baked into executable
+    shapes, so changing one means a new engine (and new compiles)."""
+    max_batch: int = 8               # decode slots (the static batch)
+    max_seq: int = 256               # per-slot prompt+generation bound
+    prefill_buckets: Tuple[int, ...] = ()   # () -> default_bucket_ladder
+    weight_dtype: str = "f32"        # "f32" | "bf16" | "int8"
+    quant_chunk: int = 256           # int8 scale granularity
+    cache_dtype: Any = None          # None -> the model's compute dtype
+    eos_id: Optional[int] = None     # greedy decode stops on this token
+
+    def resolved_buckets(self) -> Tuple[int, ...]:
+        buckets = tuple(sorted(set(
+            int(b) for b in (self.prefill_buckets
+                             or default_bucket_ladder(self.max_seq)))))
+        if not buckets:
+            raise ValueError("prefill_buckets must not be empty")
+        if buckets[-1] > self.max_seq:
+            raise ValueError(
+                f"largest prefill bucket {buckets[-1]} exceeds max_seq "
+                f"{self.max_seq}")
+        return buckets
+
+
+class DecodeEngine:
+    def __init__(self, params, cfg: GPTConfig, ecfg: EngineConfig):
+        if ecfg.max_seq > cfg.max_seq_len:
+            raise ValueError(
+                f"EngineConfig.max_seq {ecfg.max_seq} exceeds the model's "
+                f"positional table {cfg.max_seq_len}")
+        self.cfg = cfg
+        self.ecfg = ecfg
+        self.buckets = ecfg.resolved_buckets()
+        self._donate = jax.default_backend() != "cpu"
+        self._ref_params = params                  # f32 truth for parity
+        self.qparams = jax.device_put(
+            quantize_params(params, ecfg.weight_dtype, ecfg.quant_chunk))
+        self.weight_nbytes = quantized_nbytes(self.qparams)
+        cache_dtype = ecfg.cache_dtype or cfg.dtype
+        self.cache = KVCache(cfg.num_layers, ecfg.max_batch, ecfg.max_seq,
+                             cfg.num_heads, cfg.head_dim, dtype=cache_dtype)
+        self._exec: Dict[str, Any] = {}
+        self._sig_history: Dict[str, List[dict]] = {}
+        self.compiles = 0
+        self.steady_state_recompiles = 0
+        self._warm = False
+        self._tokens_window: List[Tuple[float, int]] = []  # (t, n) samples
+
+    # ------------------------------------------------------------------
+    # pure functions (traced once per executable)
+    # ------------------------------------------------------------------
+    def _dequant(self, qparams):
+        return dequantize_params(qparams)
+
+    def _prefill_fn(self, qparams, ck, cv, tokens, length, slot):
+        """tokens [1, T] int32, length/slot scalars -> (ck, cv, logits[V]).
+
+        Runs the full causal forward over the padded bucket, writes the
+        per-layer K/V for positions [0, T) into the cache at ``slot``
+        (padding rows land too, but the length mask keeps decode from ever
+        reading them), and returns the logits of the LAST VALID position —
+        the first generated token comes straight out of prefill."""
+        cfg = self.cfg
+        params = self._dequant(qparams)
+        dt = cfg.dtype
+        ln = gpt_mod._layer_norm
+        x = gpt_mod.embed(params, tokens, cfg)          # [1, T, D]
+
+        def body(h, layer_p):
+            h1 = ln(h, layer_p["ln1_scale"], layer_p["ln1_bias"])
+            qkv = jnp.einsum("btd,dcnh->btcnh", h1,
+                             layer_p["w_qkv"].astype(dt))
+            qkv = qkv + layer_p["b_qkv"].astype(dt)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            a = prefill_attention(q, k, v)
+            o = jnp.einsum("btnh,nhd->btd", a, layer_p["w_proj"].astype(dt))
+            h = h + o + layer_p["b_proj"].astype(dt)
+            h2 = ln(h, layer_p["ln2_scale"], layer_p["ln2_bias"])
+            f = jnp.einsum("btd,df->btf", h2, layer_p["w_fc"].astype(dt))
+            f = jax.nn.gelu(f + layer_p["b_fc"].astype(dt), approximate=True)
+            o2 = jnp.einsum("btf,fd->btd", f, layer_p["w_out"].astype(dt))
+            h = h + o2 + layer_p["b_out"].astype(dt)
+            return h, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+        # ks: [L, 1, T, nh, hd] -> cache slab write at (slot, 0..T)
+        ck = jax.lax.dynamic_update_slice(
+            ck, ks.astype(ck.dtype), (0, slot, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cv, vs.astype(cv.dtype), (0, slot, 0, 0, 0))
+        h_last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                              keepdims=False)      # [D]
+        h_last = ln(h_last, params["ln_f_scale"], params["ln_f_bias"])
+        logits = jnp.einsum("d,dv->v", h_last,
+                            params["lm_head"].astype(dt))
+        return ck, cv, logits.astype(jnp.float32)
+
+    def _decode_fn(self, qparams, ck, cv, tokens, positions):
+        """tokens/positions [max_batch] int32 -> (ck, cv, logits[B, V]).
+
+        One token per slot: write this step's K/V at ``positions``, attend
+        over each slot's valid prefix (positions+1), emit next-token
+        logits. Inactive lanes ride along with position 0 — their writes
+        land in a dead slot's position 0, which the next prefill into that
+        slot overwrites before it can ever be read."""
+        cfg = self.cfg
+        params = self._dequant(qparams)
+        dt = cfg.dtype
+        ln = gpt_mod._layer_norm
+        x = (params["wte"][tokens] + params["wpe"][positions]).astype(dt)
+
+        def body(h, xs):
+            layer_p, ck_l, cv_l = xs
+            h1 = ln(h, layer_p["ln1_scale"], layer_p["ln1_bias"])
+            qkv = jnp.einsum("bd,dcnh->bcnh", h1,
+                             layer_p["w_qkv"].astype(dt))
+            qkv = qkv + layer_p["b_qkv"].astype(dt)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, nh, hd]
+            ck_l = cache_update(ck_l, k, positions)
+            cv_l = cache_update(cv_l, v, positions)
+            a = decode_attention(q, ck_l, cv_l, positions + 1)
+            o = jnp.einsum("bnh,nhd->bd", a, layer_p["w_proj"].astype(dt))
+            h = h + o + layer_p["b_proj"].astype(dt)
+            h2 = ln(h, layer_p["ln2_scale"], layer_p["ln2_bias"])
+            f = jnp.einsum("bd,df->bf", h2, layer_p["w_fc"].astype(dt))
+            f = jax.nn.gelu(f + layer_p["b_fc"].astype(dt), approximate=True)
+            o2 = jnp.einsum("bf,fd->bd", f, layer_p["w_out"].astype(dt))
+            h = h + o2 + layer_p["b_out"].astype(dt)
+            return h, (ck_l, cv_l)
+
+        x, (ck, cv) = jax.lax.scan(body, x,
+                                   (params["blocks"], ck, cv))
+        x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
+        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"].astype(dt))
+        return ck, cv, logits.astype(jnp.float32)
+
+    # ------------------------------------------------------------------
+    # AOT compilation (PR 4 discipline: explicit lower+compile, program
+    # report, recompile-explainer integration)
+    # ------------------------------------------------------------------
+    def _make_sig(self, example_args) -> dict:
+        leaves = jax.tree_util.tree_leaves(example_args)
+        feed_sig = [(f"arg{i}", tuple(np.shape(a)),
+                     str(jnp.result_type(a))) for i, a in enumerate(leaves)]
+        return _prep.make_sig(feed_sig, fetch_names=())
+
+    def _compile(self, name: str, fn, example_args,
+                 donate_argnums: Tuple[int, ...]) -> Any:
+        from ..parallel import health as _health
+
+        sig = self._make_sig(example_args)
+        hist = self._sig_history.setdefault(name, [])
+        if hist:
+            # a same-name rebuild is exactly what steady state must never
+            # do: explain it through the PR 4 taxonomy and count it
+            cause, detail = _prep.explain_recompile(sig, hist)
+            _prep.note_recompile(f"serve/{name}", cause, detail)
+            if self._warm:
+                self.steady_state_recompiles += 1
+        hist.append(sig)
+        del hist[:-8]
+        jitted = jax.jit(
+            fn, donate_argnums=donate_argnums if self._donate else ())
+        t0 = time.perf_counter_ns()
+        with _health.suspend():
+            lowered = jitted.lower(*example_args)
+            compiled = lowered.compile()
+        compile_ms = (time.perf_counter_ns() - t0) / 1e6
+        self.compiles += 1
+        donated = [f"arg{i}" for i in donate_argnums] if self._donate else []
+        _prep.capture(
+            f"serve/{name}", compiled=compiled, compile_ms=compile_ms,
+            donated=donated, inputs=example_args,
+            extra={"engine": {
+                "max_batch": self.ecfg.max_batch,
+                "max_seq": self.ecfg.max_seq,
+                "weight_dtype": self.ecfg.weight_dtype,
+                "cache_dtype": str(jnp.dtype(self.cache.dtype).name),
+                "buckets": list(self.buckets),
+            }})
+        return compiled
+
+    def _prefill_exec(self, bucket: int):
+        name = f"prefill_b{bucket}"
+        exe = self._exec.get(name)
+        if exe is None:
+            example = (self.qparams, self.cache.k, self.cache.v,
+                       np.zeros((1, bucket), np.int32), np.int32(1),
+                       np.int32(0))
+            exe = self._compile(name, self._prefill_fn, example,
+                                donate_argnums=(1, 2))
+            self._exec[name] = exe
+        return exe
+
+    def _decode_exec(self):
+        exe = self._exec.get("decode")
+        if exe is None:
+            B = self.ecfg.max_batch
+            example = (self.qparams, self.cache.k, self.cache.v,
+                       np.zeros((B,), np.int32), np.zeros((B,), np.int32))
+            exe = self._compile("decode", self._decode_fn, example,
+                                donate_argnums=(1, 2))
+            self._exec["decode"] = exe
+        return exe
+
+    def warmup(self) -> Dict[str, float]:
+        """Compile every executable the steady state will ever need (the
+        decode program + one prefill per bucket) and run each once so the
+        first real request pays no compile and no first-dispatch cost.
+        Returns {executable_name: compile_ms is implicit in the program
+        reports; here: wall ms per warm call}."""
+        timings: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        dec = self._decode_exec()
+        B = self.ecfg.max_batch
+        ck, cv, logits = dec(self.qparams, self.cache.k, self.cache.v,
+                             np.zeros((B,), np.int32),
+                             np.zeros((B,), np.int32))
+        jax.block_until_ready(logits)
+        self.cache.k, self.cache.v = ck, cv
+        timings["decode"] = (time.perf_counter() - t0) * 1e3
+        for bucket in self.buckets:
+            t0 = time.perf_counter()
+            exe = self._prefill_exec(bucket)
+            ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
+                                 np.zeros((1, bucket), np.int32),
+                                 np.int32(1), np.int32(0))
+            jax.block_until_ready(logits)
+            self.cache.k, self.cache.v = ck, cv
+            timings[f"prefill_b{bucket}"] = (time.perf_counter() - t0) * 1e3
+        self._warm = True
+        return timings
+
+    # ------------------------------------------------------------------
+    # host-side serving API (one scheduler thread)
+    # ------------------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise PromptTooLongError(
+            f"prompt length {n} exceeds the largest prefill bucket "
+            f"{self.buckets[-1]}")
+
+    def start_sequence(self, tokens: Sequence[int]) -> Tuple[int, np.ndarray]:
+        """Claim a slot, prefill the prompt, return (slot, logits[V]) of
+        the last prompt position — argmax of it is the first generated
+        token. Raises CacheFullError when no slot is free and
+        PromptTooLongError above the ladder."""
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty prompt")
+        bucket = self.bucket_for(n)
+        exe = self._prefill_exec(bucket)
+        slot = self.cache.alloc(length=n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = np.asarray(tokens, np.int32)
+        t0 = time.perf_counter_ns()
+        try:
+            ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
+                                 padded, np.int32(n), np.int32(slot))
+            logits = np.asarray(logits)
+        except Exception:
+            self.cache.free(slot)
+            raise
+        smetrics.m_prefill_ms.observe(
+            (time.perf_counter_ns() - t0) / 1e6)
+        self.cache.k, self.cache.v = ck, cv
+        return slot, logits
+
+    def decode_step(self, slot_tokens: Dict[int, int]) -> Dict[int, np.ndarray]:
+        """One decode step for the given {slot: input_token} map (the
+        token each sequence generated last). Returns {slot: logits[V]}.
+        Slots not in the map ride as masked lanes — same shapes, same
+        executable, zero recompiles."""
+        if not slot_tokens:
+            return {}
+        B = self.ecfg.max_batch
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        for slot, tok in slot_tokens.items():
+            if not self.cache.is_live(slot):
+                raise ValueError(f"slot {slot} is not live")
+            if self.cache.headroom(slot) < 1:
+                raise ValueError(
+                    f"slot {slot} is at max_seq {self.ecfg.max_seq}")
+            tokens[slot] = tok
+            positions[slot] = self.cache.length(slot)
+        exe = self._decode_exec()
+        t0 = time.perf_counter_ns()
+        ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
+                             tokens, positions)
+        logits = np.asarray(logits)
+        smetrics.m_decode_ms.observe((time.perf_counter_ns() - t0) / 1e6)
+        self.cache.k, self.cache.v = ck, cv
+        out: Dict[int, np.ndarray] = {}
+        for slot in slot_tokens:
+            self.cache.set_length(slot, self.cache.length(slot) + 1)
+            out[slot] = logits[slot]
+        self.note_tokens(len(slot_tokens))
+        return out
+
+    def free_sequence(self, slot: int) -> None:
+        self.cache.free(slot)
+
+    # ------------------------------------------------------------------
+    def note_tokens(self, n: int, window_s: float = 5.0) -> None:
+        now = time.monotonic()
+        smetrics.m_tokens.inc(n)
+        w = self._tokens_window
+        w.append((now, n))
+        while w and w[0][0] < now - window_s:
+            w.pop(0)
+        span = now - w[0][0] if len(w) > 1 else 0.0
+        if span > 0:
+            smetrics.m_tokens_per_s.set(sum(x[1] for x in w) / span)
+
+    # ------------------------------------------------------------------
+    # reference / parity surface (tests + serve_bench quality bar)
+    # ------------------------------------------------------------------
+    def reference_logits(self, tokens: Sequence[int]) -> np.ndarray:
+        """Full-forward f32-weight logits [T, V] for a prompt — the truth
+        the cached decode path and the quantized weights are held to."""
+        if self._ref_params is None:
+            raise RuntimeError("reference params were dropped")
+        toks = np.asarray(tokens, np.int32)[None]
+        return np.asarray(
+            gpt_mod.forward(self._ref_params, toks, self.cfg)[0],
+            np.float32)
+
+    def drop_reference_params(self) -> None:
+        self._ref_params = None
+
+    @property
+    def executables(self) -> List[str]:
+        return sorted(self._exec)

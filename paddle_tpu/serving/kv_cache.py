@@ -1,0 +1,129 @@
+"""Preallocated slot-major KV cache for the serving engine.
+
+One slab per projection: ``[num_layers, max_slots, max_seq, nh, hd]``,
+allocated ONCE at engine startup and threaded through every prefill/decode
+executable with buffer donation — steady-state serving never allocates,
+never frees, and never changes a shape (the zero-recompile contract,
+docs/serving.md).
+
+The device arrays are pure values (jax); what this class owns is the HOST
+truth the scheduler plans against: which slots are live, how long each
+slot's valid prefix is, and a per-slot generation counter so tests can
+prove a freed slot's storage really is reused. Slot state never reaches
+the compiled functions — they see only ``positions``/``lengths`` vectors,
+so join/evict at token boundaries is a host-side bookkeeping edit, not a
+recompile.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["KVCache", "CacheFullError"]
+
+
+class CacheFullError(RuntimeError):
+    """All slots are occupied (the scheduler should queue, not crash)."""
+
+
+@dataclasses.dataclass
+class _SlotState:
+    live: bool = False
+    length: int = 0          # valid prefix length (tokens written)
+    generation: int = 0      # bumped on every alloc — reuse visible to tests
+
+
+class KVCache:
+    """Slot allocator + the two cache slabs.
+
+    ``k``/``v`` are replaced wholesale by the engine after every
+    prefill/decode call (donated in, fresh handle out). ``max_seq`` bounds
+    prompt+generation per slot; ``max_slots`` is the static decode batch.
+    """
+
+    def __init__(self, num_layers: int, max_slots: int, max_seq: int,
+                 num_heads: int, head_dim: int, dtype: Any = jnp.float32):
+        if max_slots < 1 or max_seq < 1:
+            raise ValueError("max_slots and max_seq must be >= 1")
+        self.num_layers = int(num_layers)
+        self.max_slots = int(max_slots)
+        self.max_seq = int(max_seq)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (num_layers, max_slots, max_seq, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self._slots = [_SlotState() for _ in range(max_slots)]
+        self._free: List[int] = list(range(max_slots))
+
+    # -- geometry ----------------------------------------------------------
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.size + self.v.size) * jnp.dtype(self.dtype).itemsize
+
+    # -- slot bookkeeping --------------------------------------------------
+    def alloc(self, length: int = 0) -> int:
+        """Claim a free slot (lowest index first — deterministic tests);
+        raises :class:`CacheFullError` when none is free."""
+        if not self._free:
+            raise CacheFullError(
+                f"all {self.max_slots} KV-cache slots are live")
+        if length > self.max_seq:
+            raise ValueError(
+                f"sequence length {length} exceeds max_seq {self.max_seq}")
+        slot = self._free.pop(0)
+        st = self._slots[slot]
+        st.live = True
+        st.length = int(length)
+        st.generation += 1
+        return slot
+
+    def free(self, slot: int) -> None:
+        st = self._slots[slot]
+        if not st.live:
+            raise ValueError(f"slot {slot} is not live")
+        st.live = False
+        st.length = 0
+        self._free.append(slot)
+        self._free.sort()
+
+    def set_length(self, slot: int, length: int) -> None:
+        if length > self.max_seq:
+            raise ValueError(
+                f"slot {slot}: length {length} exceeds max_seq "
+                f"{self.max_seq}")
+        self._slots[slot].length = int(length)
+
+    def length(self, slot: int) -> int:
+        return self._slots[slot].length
+
+    def generation(self, slot: int) -> int:
+        return self._slots[slot].generation
+
+    def is_live(self, slot: int) -> bool:
+        return self._slots[slot].live
+
+    def live_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s.live]
+
+    def free_slot_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return (self.max_slots - len(self._free)) / self.max_slots
+
+    def lengths_vector(self) -> np.ndarray:
+        """[max_slots] int32 of valid prefix lengths (0 for dead slots) —
+        the host-side source of the decode step's positions feed."""
+        return np.array([s.length if s.live else 0 for s in self._slots],
+                        np.int32)
+
+    def headroom(self, slot: int) -> int:
+        """Tokens this slot can still grow by before hitting max_seq."""
+        return self.max_seq - self._slots[slot].length

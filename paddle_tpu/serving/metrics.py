@@ -1,0 +1,61 @@
+"""Serving metric families (docs/serving.md, gated by tools/metrics_check.py).
+
+All families live in the same default registry as the training telemetry,
+so one Prometheus exposition carries both sides of the system. Children
+are resolved once at import/call-site-build time per the registry's
+hot-path cost model (observability/metrics.py).
+"""
+from __future__ import annotations
+
+from ..observability import metrics as _obs
+
+__all__ = [
+    "m_requests", "m_queue_depth", "m_active", "m_occupancy",
+    "m_ttft_ms", "m_tpot_ms", "m_tokens", "m_tokens_per_s",
+    "m_prefill_ms", "m_decode_ms", "m_evictions", "request_code",
+]
+
+_REG = _obs.default_registry()
+
+# request outcomes by HTTP-style code ("200", "400", "429", "500", "503",
+# "504") — the front door stamps every response; engine-level drivers
+# (tools/serve_bench.py) stamp the logical equivalent
+m_requests = _REG.counter(
+    "paddle_serve_requests_total",
+    "Serving requests by response code", ("code",))
+m_queue_depth = _REG.gauge(
+    "paddle_serve_queue_depth",
+    "Requests waiting for a decode slot (admission queue)")
+m_active = _REG.gauge(
+    "paddle_serve_active_requests",
+    "Requests currently holding a decode slot")
+m_occupancy = _REG.gauge(
+    "paddle_serve_batch_occupancy",
+    "Live decode slots / max_batch at the last scheduler tick")
+# TTFT spans prefill + queueing; TPOT is the per-token decode cadence —
+# sub-ms buckets matter there
+m_ttft_ms = _REG.histogram(
+    "paddle_serve_ttft_ms",
+    "Time to first token (submit -> first generated token), ms")
+m_tpot_ms = _REG.histogram(
+    "paddle_serve_tpot_ms",
+    "Per-output-token latency after the first token, ms")
+m_tokens = _REG.counter(
+    "paddle_serve_tokens_total", "Generated tokens")
+m_tokens_per_s = _REG.gauge(
+    "paddle_serve_tokens_per_s",
+    "Generated tokens per second over the last scheduler window")
+m_prefill_ms = _REG.histogram(
+    "paddle_serve_prefill_ms",
+    "Prefill executable wall time (bucket-padded prompt), ms")
+m_decode_ms = _REG.histogram(
+    "paddle_serve_decode_step_ms",
+    "Decode executable wall time (one token across the batch), ms")
+m_evictions = _REG.counter(
+    "paddle_serve_slot_evictions_total",
+    "Decode-slot evictions by reason", ("reason",))
+
+
+def request_code(code: int) -> None:
+    """Count one request outcome."""
+    m_requests.labels(str(int(code))).inc()

@@ -1,0 +1,277 @@
+"""Continuous (in-flight) batching scheduler over the decode engine.
+
+Requests join and leave the static ``[max_batch]`` decode batch at TOKEN
+boundaries: each :meth:`Scheduler.step` (one tick of the serving loop)
+first evicts finished/expired slots, then admits queued requests into the
+freed slots (prefill through the bucket ladder), then runs exactly one
+decode step for every live slot. No shape ever changes, so a warmed
+engine ticks forever without a recompile — Orca-style iteration-level
+scheduling (the same contract vLLM's continuous batching popularized),
+implemented host-side against the AOT executables.
+
+Threading contract: ``submit``/``cancel`` may be called from any thread
+(the HTTP front door's handler pool); ``step``/``drain`` run on exactly
+one loop thread. Request completion is signaled through a per-request
+``threading.Event``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import metrics as smetrics
+from .engine import DecodeEngine, PromptTooLongError
+from .kv_cache import CacheFullError
+
+__all__ = ["Request", "Scheduler", "SchedulerConfig", "QueueFullError"]
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — the front door maps this to 429."""
+
+
+# request lifecycle
+QUEUED, ACTIVE, DONE, EXPIRED, FAILED, CANCELLED = (
+    "queued", "active", "done", "expired", "failed", "cancelled")
+
+_ids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: List[int]
+    max_new_tokens: int
+    deadline: float                       # absolute time.monotonic()
+    id: int = dataclasses.field(default_factory=lambda: next(_ids))
+    submitted: float = dataclasses.field(default_factory=time.monotonic)
+    state: str = QUEUED
+    slot: Optional[int] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    ttft_ms: Optional[float] = None
+    error: Optional[str] = None
+    finished: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.finished.wait(timeout)
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        """Mean per-token latency after the first token."""
+        if len(self.token_times) < 2:
+            return None
+        spans = np.diff(self.token_times)
+        return float(np.mean(spans) * 1e3)
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_queue: int = 64               # queued (not yet admitted) requests
+    default_timeout_s: float = 30.0   # per-request deadline when unset
+    max_new_tokens_cap: int = 1024    # server-side clamp
+
+
+class Scheduler:
+    def __init__(self, engine: DecodeEngine,
+                 cfg: Optional[SchedulerConfig] = None):
+        self.engine = engine
+        self.cfg = cfg or SchedulerConfig()
+        self._queue: Deque[Request] = deque()
+        self._active: Dict[int, Request] = {}     # slot -> request
+        self._next_token: Dict[int, int] = {}     # slot -> token to feed
+        self._lock = threading.Lock()
+        self._draining = False
+        self.steps = 0
+        self.occupancy_sum = 0.0                  # for mean occupancy
+
+    # ------------------------------------------------------------------
+    # producer side (any thread)
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               timeout_s: Optional[float] = None) -> Request:
+        """Enqueue a request; raises QueueFullError on backpressure,
+        PromptTooLongError for prompts above the bucket ladder, and
+        RuntimeError once draining."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        # validate against the ladder NOW so the caller gets a 400, not a
+        # request that dies at admission time
+        self.engine.bucket_for(len(prompt))
+        max_new = max(1, min(int(max_new_tokens),
+                             self.cfg.max_new_tokens_cap))
+        timeout = (self.cfg.default_timeout_s if timeout_s is None
+                   else float(timeout_s))
+        req = Request(prompt=prompt, max_new_tokens=max_new,
+                      deadline=time.monotonic() + timeout)
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("scheduler is draining")
+            if len(self._queue) >= self.cfg.max_queue:
+                raise QueueFullError(
+                    f"admission queue at capacity ({self.cfg.max_queue})")
+            self._queue.append(req)
+            smetrics.m_queue_depth.set(len(self._queue))
+        return req
+
+    def cancel(self, req: Request) -> bool:
+        """Cancel a QUEUED request (active ones finish their current
+        token and are evicted by deadline instead)."""
+        with self._lock:
+            if req.state == QUEUED and req in self._queue:
+                self._queue.remove(req)
+                smetrics.m_queue_depth.set(len(self._queue))
+                self._finish(req, CANCELLED)
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # loop side (one thread)
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One serving tick: evict -> admit -> decode. Returns True when
+        any work happened (False = idle, the loop may sleep)."""
+        now = time.monotonic()
+        self._expire_queued(now)
+        admitted = self._admit(now)
+        decoded = self._decode(now)
+        self.steps += 1
+        occ = self.engine.cache.occupancy
+        self.occupancy_sum += occ
+        smetrics.m_occupancy.set(occ)
+        smetrics.m_active.set(len(self._active))
+        return bool(admitted or decoded)
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Stop admitting new requests and run the loop until every
+        queued+active request finished (or the timeout hits). Returns
+        True when fully drained."""
+        with self._lock:
+            self._draining = True
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            with self._lock:
+                idle = not self._queue and not self._active
+            if idle:
+                return True
+            self.step()
+        return False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._active)
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self.occupancy_sum / self.steps if self.steps else 0.0
+
+    # ------------------------------------------------------------------
+    def _expire_queued(self, now: float) -> None:
+        with self._lock:
+            keep: Deque[Request] = deque()
+            for req in self._queue:
+                if req.deadline <= now:
+                    self._finish(req, EXPIRED,
+                                 "deadline exceeded while queued")
+                else:
+                    keep.append(req)
+            self._queue = keep
+            smetrics.m_queue_depth.set(len(self._queue))
+
+    def _admit(self, now: float) -> int:
+        """Prefill queued requests into free slots, FIFO."""
+        admitted = 0
+        while self.engine.cache.free_slot_count() > 0:
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+                smetrics.m_queue_depth.set(len(self._queue))
+            try:
+                slot, logits = self.engine.start_sequence(req.prompt)
+            except CacheFullError:       # raced headroom — requeue in order
+                with self._lock:
+                    self._queue.appendleft(req)
+                break
+            except Exception as e:
+                self._finish(req, FAILED, f"{type(e).__name__}: {e}")
+                continue
+            first = int(np.argmax(logits))
+            t = time.monotonic()
+            req.state = ACTIVE
+            req.slot = slot
+            req.tokens.append(first)
+            req.token_times.append(t)
+            req.ttft_ms = (t - req.submitted) * 1e3
+            smetrics.m_ttft_ms.observe(req.ttft_ms)
+            self.engine.note_tokens(1)
+            self._active[slot] = req
+            self._next_token[slot] = first
+            admitted += 1
+            if self._should_finish(req, first):
+                self._evict(slot, DONE)
+        return admitted
+
+    def _decode(self, now: float) -> bool:
+        # evict deadline-blown active requests at the token boundary
+        for slot in list(self._active):
+            req = self._active[slot]
+            if req.deadline <= now:
+                self._evict(slot, EXPIRED,
+                            "deadline exceeded mid-generation")
+        if not self._active:
+            return False
+        feed = {slot: self._next_token[slot] for slot in self._active}
+        out = self.engine.decode_step(feed)
+        t = time.monotonic()
+        for slot, logits in out.items():
+            req = self._active[slot]
+            tok = int(np.argmax(logits))
+            req.tokens.append(tok)
+            if len(req.token_times) >= 1:
+                smetrics.m_tpot_ms.observe(
+                    (t - req.token_times[-1]) * 1e3)
+            req.token_times.append(t)
+            self._next_token[slot] = tok
+            if self._should_finish(req, tok):
+                self._evict(slot, DONE)
+            elif self.engine.cache.headroom(slot) < 1:
+                self._evict(slot, DONE, "max_seq reached")
+        return True
+
+    def _should_finish(self, req: Request, last_token: int) -> bool:
+        eos = self.engine.ecfg.eos_id
+        if eos is not None and last_token == eos:
+            return True
+        return len(req.tokens) >= req.max_new_tokens
+
+    def _evict(self, slot: int, state: str,
+               detail: Optional[str] = None) -> None:
+        req = self._active.pop(slot)
+        self._next_token.pop(slot, None)
+        self.engine.free_sequence(slot)
+        smetrics.m_evictions.labels(
+            "done" if state == DONE else "deadline").inc()
+        self._finish(req, state, detail)
+
+    def _finish(self, req: Request, state: str,
+                detail: Optional[str] = None) -> None:
+        req.state = state
+        if detail and state in (EXPIRED, FAILED):
+            req.error = detail
+        req.finished.set()

@@ -1,0 +1,341 @@
+"""Production HTTP front door for the serving engine (docs/serving.md).
+
+One server class, two backends:
+
+- **engine backend** (``FrontDoor(scheduler=...)``): ``POST /generate``
+  with ``{"prompt": [token ids], "max_new_tokens": N, "timeout_s": T}`` —
+  requests queue into the continuous-batching scheduler and stream through
+  the AOT decode engine. A dedicated loop thread ticks the scheduler; the
+  handler thread blocks on the request's completion event.
+- **predictor backend** (``FrontDoor(predictor=...)``): ``POST /predict``
+  with ``{"inputs": {name: nested-list}}`` — the PR-era StableHLO /
+  save_inference_model artifact path, now behind the same admission
+  control.
+
+Shared production semantics (the ISSUE 9 robustness satellite):
+
+- bounded admission: queue-full -> **429** with a JSON error body;
+- per-request deadlines: blown -> **504** (a queued generate request whose
+  deadline passes is expired by the scheduler at the token boundary);
+- error taxonomy: malformed/mismatched client input -> **400**, internal
+  handler failure -> **500**, always with a JSON body (never a raw
+  traceback or an empty 500);
+- graceful drain: SIGTERM (``install_signal_handlers()``) flips the server
+  to *draining* — new work is refused with **503**, in-flight requests
+  finish, then the listener closes. ``/health`` reports the phase.
+- every response increments ``paddle_serve_requests_total{code}``;
+  ``GET /metrics`` serves the Prometheus exposition of the shared
+  registry.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import metrics as smetrics
+from .engine import PromptTooLongError
+from .scheduler import QueueFullError, Scheduler
+
+__all__ = ["FrontDoor", "EngineLoop"]
+
+
+class EngineLoop:
+    """Background thread ticking ``scheduler.step()``; parks on an event
+    when idle so an empty server burns no CPU."""
+
+    def __init__(self, scheduler: Scheduler, idle_sleep_s: float = 0.002):
+        self.scheduler = scheduler
+        self.idle_sleep_s = idle_sleep_s
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "EngineLoop":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-engine-loop")
+        self._thread.start()
+        return self
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            worked = False
+            if self.scheduler.pending():
+                worked = self.scheduler.step()
+            if not worked:
+                self._wake.wait(timeout=self.idle_sleep_s)
+                self._wake.clear()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        if self.server.front.verbose:
+            super().log_message(fmt, *args)
+
+    # -- plumbing ----------------------------------------------------------
+    def _json(self, code: int, obj: Dict[str, Any]) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; the count below still records it
+        smetrics.request_code(code)
+
+    def _read_json(self) -> Optional[Dict[str, Any]]:
+        n = int(self.headers.get("Content-Length", 0))
+        if n > self.server.front.max_body_bytes:
+            self._json(413, {"error": "body too large"})
+            return None
+        try:
+            return json.loads(self.rfile.read(n).decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            self._json(400, {"error": f"malformed JSON body: {e}"})
+            return None
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):
+        front = self.server.front
+        if self.path == "/health":
+            return self._json(200, front.health())
+        if self.path == "/metrics":
+            from ..observability import prom
+
+            text = prom.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            self.wfile.write(text)
+            smetrics.request_code(200)
+            return
+        self._json(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):
+        front = self.server.front
+        if self.path == "/generate":
+            return self._generate(front)
+        if self.path == "/predict":
+            return self._predict(front)
+        self._json(404, {"error": f"unknown path {self.path!r}"})
+
+    # -- engine backend ----------------------------------------------------
+    def _generate(self, front: "FrontDoor"):
+        if front.scheduler is None:
+            return self._json(400, {"error": "no generation engine loaded"})
+        if front.draining:
+            return self._json(503, {"error": "server is draining"})
+        req_obj = self._read_json()
+        if req_obj is None:
+            return
+        prompt = req_obj.get("prompt") or req_obj.get("tokens")
+        if not isinstance(prompt, list) or not prompt:
+            return self._json(
+                400, {"error": "body must carry a non-empty token list "
+                               "under 'prompt'"})
+        timeout_s = req_obj.get("timeout_s")
+        timeout_s = (front.request_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        try:
+            request = front.scheduler.submit(
+                prompt, max_new_tokens=int(req_obj.get(
+                    "max_new_tokens", 16)),
+                timeout_s=timeout_s)
+        except QueueFullError as e:
+            return self._json(429, {"error": str(e)})
+        except PromptTooLongError as e:
+            return self._json(400, {"error": str(e)})
+        except (TypeError, ValueError) as e:
+            return self._json(400, {"error": f"{type(e).__name__}: {e}"})
+        except RuntimeError as e:          # draining raced the check above
+            return self._json(503, {"error": str(e)})
+        front.loop.wake()
+        # the scheduler owns the deadline; +1s of slack covers loop wakeup
+        request.wait(timeout=timeout_s + 1.0)
+        if request.state == "done":
+            return self._json(200, {
+                "tokens": request.tokens,
+                "num_tokens": len(request.tokens),
+                "ttft_ms": round(request.ttft_ms, 3),
+                "tpot_ms": (round(request.tpot_ms, 3)
+                            if request.tpot_ms is not None else None),
+            })
+        if request.state in ("expired", "queued", "active"):
+            return self._json(504, {
+                "error": request.error or "deadline exceeded",
+                "partial_tokens": request.tokens})
+        return self._json(500, {"error": request.error
+                                or f"request {request.state}"})
+
+    # -- predictor backend -------------------------------------------------
+    def _predict(self, front: "FrontDoor"):
+        if front.predictor is None:
+            return self._json(400, {"error": "no predictor loaded"})
+        if front.draining:
+            return self._json(503, {"error": "server is draining"})
+        req_obj = self._read_json()
+        if req_obj is None:
+            return
+        if "inputs" not in req_obj or not isinstance(req_obj["inputs"],
+                                                     dict):
+            return self._json(400, {"error": "body must carry 'inputs'"})
+        if not front._predict_slots.acquire(blocking=False):
+            return self._json(429, {
+                "error": f"predict queue at capacity "
+                         f"({front.max_queue})"})
+        t0 = time.monotonic()
+        deadline = t0 + front.request_timeout_s
+        try:
+            feed = {k: np.asarray(v) for k, v in req_obj["inputs"].items()}
+            # predictor calls are serialized (one device queue); waiting
+            # for the run lock IS the queueing — bounded by the deadline
+            if not front._run_lock.acquire(
+                    timeout=max(0.0, deadline - time.monotonic())):
+                return self._json(504, {
+                    "error": "deadline exceeded while queued"})
+            try:
+                front._inflight += 1
+                outs = front.predictor.run(feed)
+            finally:
+                front._inflight -= 1
+                front._run_lock.release()
+        except (KeyError, ValueError, TypeError) as e:
+            # client-shaped failure: wrong names, shapes, dtypes
+            return self._json(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:
+            return self._json(500, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            front._predict_slots.release()
+        smetrics.m_ttft_ms.observe((time.monotonic() - t0) * 1e3)
+        return self._json(200, {"outputs": [np.asarray(o).tolist()
+                                            for o in outs]})
+
+
+class FrontDoor:
+    """The serving HTTP server. Construct with exactly one backend:
+    ``scheduler=`` (generation) or ``predictor=`` (artifact inference);
+    both may be present (generation servers usually also expose their
+    tokenizer-side artifact — not required)."""
+
+    def __init__(self, scheduler: Optional[Scheduler] = None,
+                 predictor=None, host: str = "127.0.0.1", port: int = 0,
+                 max_queue: int = 64, request_timeout_s: float = 30.0,
+                 max_body_bytes: int = 256 << 20, verbose: bool = False):
+        if scheduler is None and predictor is None:
+            raise ValueError("FrontDoor needs a scheduler or a predictor")
+        self.scheduler = scheduler
+        self.predictor = predictor
+        self.max_queue = int(max_queue)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.verbose = verbose
+        self._draining = False
+        self._inflight = 0
+        self._run_lock = threading.Lock()
+        self._predict_slots = threading.BoundedSemaphore(self.max_queue)
+        self.loop = (EngineLoop(scheduler).start()
+                     if scheduler is not None else None)
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.front = self
+        self._thread: Optional[threading.Thread] = None
+        self._old_handlers: Dict[int, Any] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start(self) -> "FrontDoor":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self.loop is not None:
+            self.loop.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def health(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "status": "draining" if self._draining else "ok",
+        }
+        if self.predictor is not None:
+            out["inputs"] = self.predictor.get_input_names()
+            out["outputs"] = self.predictor.get_output_names()
+        if self.scheduler is not None:
+            out["queue_depth"] = self.scheduler.queue_depth()
+            out["active"] = len(self.scheduler._active)
+            out["max_batch"] = self.scheduler.engine.ecfg.max_batch
+            out["buckets"] = list(self.scheduler.engine.buckets)
+            out["weight_dtype"] = self.scheduler.engine.ecfg.weight_dtype
+        return out
+
+    # -- graceful drain ----------------------------------------------------
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Refuse new work, finish what is in flight, then stop. Returns
+        True when everything completed inside the timeout."""
+        self._draining = True
+        ok = True
+        if self.scheduler is not None:
+            with self.scheduler._lock:
+                self.scheduler._draining = True
+            if self.loop is not None:
+                self.loop.wake()
+            end = time.monotonic() + timeout_s
+            while time.monotonic() < end and self.scheduler.pending():
+                time.sleep(0.01)
+            ok = self.scheduler.pending() == 0
+        end = time.monotonic() + max(0.1, timeout_s / 10)
+        while time.monotonic() < end and self._inflight > 0:
+            time.sleep(0.01)
+        ok = ok and self._inflight == 0
+        self.stop()
+        return ok
+
+    def install_signal_handlers(self, drain_timeout_s: float = 60.0) -> None:
+        """SIGTERM/SIGINT -> graceful drain in a helper thread (the
+        handler itself must return immediately — it may run on the main
+        thread mid-request)."""
+
+        def _on_signal(signum, frame):
+            threading.Thread(target=self.drain,
+                             kwargs={"timeout_s": drain_timeout_s},
+                             daemon=True,
+                             name="serve-drain").start()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._old_handlers[sig] = signal.signal(sig, _on_signal)
+
+    def restore_signal_handlers(self) -> None:
+        for sig, h in self._old_handlers.items():
+            signal.signal(sig, h)
+        self._old_handlers.clear()

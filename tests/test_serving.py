@@ -78,6 +78,39 @@ def test_stablehlo_server_round_trip(tmp_path):
         srv.stop()
 
 
+def test_stablehlo_predictor_is_observable(tmp_path):
+    """ISSUE 9 satellite: the StableHLO serving path dispatches through a
+    cached AOT executable (PR 1 discipline — no per-request retrace) and
+    its compile lands in the PR 4 program-report ring, so served programs
+    are visible to recent_reports() like every training executable."""
+    from paddle_tpu.observability import program_report as prep
+
+    scope = fluid.Scope()
+    main, prob, exe = _train_small(scope)
+    xb = np.random.RandomState(3).rand(4, 4).astype("float32")
+    export_stablehlo(str(tmp_path / "m"), main, {"x": xb}, [prob.name],
+                     scope=scope)
+    from paddle_tpu.inference.predictor import load_stablehlo_predictor
+
+    pred = load_stablehlo_predictor(str(tmp_path / "m"))
+
+    def serve_reports():
+        return [r for r in prep.recent_reports()
+                if r["program"] == "serve/stablehlo"]
+
+    out1 = pred.run({"x": xb})
+    reports = serve_reports()
+    assert reports, "stablehlo compile emitted no program report"
+    assert reports[-1]["compile_ms"] is not None
+    assert reports[-1]["feeds"] == ["x"]
+    # steady state: same signature -> executable-cache hit, no new
+    # compile, no new report
+    out2 = pred.run({"x": xb})
+    np.testing.assert_allclose(np.asarray(out1[0]), np.asarray(out2[0]))
+    assert len(serve_reports()) == len(reports)
+    assert len(pred._compiled) == 1
+
+
 def test_program_dir_server(tmp_path):
     """The same server also hosts a save_inference_model directory."""
     scope = fluid.Scope()

@@ -321,6 +321,65 @@ def _run_check_inner(out_dir: str) -> dict:
     assert lint_after.get("error", 0) == lint_before.get("error", 0), \
         "error-severity lint findings appeared on the clean MLP program"
 
+    # --- serving gate (docs/serving.md): warmed 20-request smoke serve --
+    # the whole point of the AOT-bucketed engine is that a WARMED server
+    # never compiles again: the recompile-explainer counter must not move
+    # across the load, every request must come back 200, and the
+    # paddle_serve_* families must carry finite samples
+    import urllib.request
+
+    import jax.random as jrandom
+
+    from paddle_tpu import serving as pserving
+    from paddle_tpu.models import gpt as gpt_model
+
+    def _recompile_total():
+        return _counter_sum("paddle_recompiles_total")
+
+    scfg = gpt_model.GPT_TINY.scaled(num_layers=2, max_seq_len=64)
+    sparams = gpt_model.init_params(jrandom.PRNGKey(7), scfg)
+    sengine = pserving.DecodeEngine(
+        sparams, scfg, pserving.EngineConfig(
+            max_batch=4, max_seq=32, prefill_buckets=(8, 16)))
+    sengine.warmup()
+    ssched = pserving.Scheduler(sengine)
+    sfront = pserving.FrontDoor(scheduler=ssched, max_queue=32).start()
+    recompiles_before = _recompile_total()
+    try:
+        srng = np.random.RandomState(3)
+        for i in range(20):
+            plen = int(srng.randint(2, 15))
+            prompt = srng.randint(0, scfg.vocab_size, size=plen).tolist()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{sfront.port}/generate",
+                data=json.dumps({"prompt": prompt,
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                body = json.loads(r.read().decode())
+                assert r.status == 200, f"serve request {i}: {r.status}"
+            assert len(body["tokens"]) == 4, body
+            assert math.isfinite(body["ttft_ms"]), body
+    finally:
+        sfront.stop()
+    serve_recompiles = _recompile_total() - recompiles_before
+    assert serve_recompiles == 0, \
+        f"warmed smoke serve recompiled {serve_recompiles} time(s) — " \
+        "the zero-recompile steady-state contract is broken"
+    assert sengine.steady_state_recompiles == 0
+    snap = default_registry().snapshot()
+    serve_200 = {tuple(s["labels"]): s["value"] for s in
+                 snap["paddle_serve_requests_total"]["series"]}
+    assert serve_200.get(("200",), 0) >= 20, serve_200
+    ttft = snap["paddle_serve_ttft_ms"]["series"][0]
+    assert ttft["count"] >= 20 and math.isfinite(ttft["sum"]) \
+        and ttft["sum"] >= 0, ttft
+    tpot = snap["paddle_serve_tpot_ms"]["series"][0]
+    assert tpot["count"] >= 20 and math.isfinite(tpot["sum"]), tpot
+    assert math.isfinite(
+        snap["paddle_serve_tokens_per_s"]["series"][0]["value"])
+    assert snap["paddle_serve_tokens_total"]["series"][0]["value"] >= 80
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -352,8 +411,18 @@ def _run_check_inner(out_dir: str) -> dict:
     assert 'paddle_guardrail_skipped_steps_total{reason="nonfinite"} 1' \
         in prom_text or skips_before > 0, \
         "guardrail skip sample missing from exposition"
+    # serving families (docs/serving.md): the smoke serve above must have
+    # left well-formed samples in the exposition
+    for name in ("paddle_serve_requests_total", "paddle_serve_queue_depth",
+                 "paddle_serve_batch_occupancy", "paddle_serve_ttft_ms",
+                 "paddle_serve_tpot_ms", "paddle_serve_tokens_per_s",
+                 "paddle_serve_prefill_ms", "paddle_serve_decode_step_ms"):
+        assert name in prom_text, f"{name} missing from exposition"
+    assert 'paddle_serve_requests_total{code="200"}' in prom_text
 
     return {"steps": len(records), "prom_samples": samples,
+            "serve_requests": int(serve_200.get(("200",), 0)),
+            "serve_steady_state_recompiles": int(serve_recompiles),
             "program_reports": len(reports),
             "checkpoint_steps": committed,
             "checkpoint_bytes": ckpt_bytes,

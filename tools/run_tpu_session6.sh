@@ -1,0 +1,38 @@
+#!/bin/bash
+# Chip session 6: the serving lane (docs/serving.md) — first on-hardware
+# numbers for the AOT prefill/decode engine — after the still-queued
+# session-5 comm lane (run that first if .tpu_s5_done is absent).
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session6.sh > tpu_s6.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+if [ ! -f .tpu_s5_done ]; then
+  echo "=== [0/3] session 5 (comm lane) still queued — running it first ==="
+  bash tools/run_tpu_session5.sh
+fi
+
+echo "=== [1/3] serve bench: GPT-small engine, bf16 weights $(date -u +%H:%M:%S) ==="
+# real-chip headline: TTFT/TPOT + tokens/s/chip under Poisson load with a
+# production-shaped model; zero-recompile gate enforced by the bench rc
+python tools/serve_bench.py \
+  --d 768 --layers 12 --nh 12 --ff 3072 --vocab 50304 \
+  --max-batch 16 --max-seq 1024 --buckets 64,128,256,512,1024 \
+  --rates 4,16,64 --requests 120 --max-new-tokens 64 \
+  --prompt-len-max 512 --eval-len 256 \
+  --weight-dtypes f32,bf16,int8 --out SERVE_BENCH_tpu.json
+echo "=== serve bench rc=$? ==="
+
+echo "=== [2/3] serve bench: saturation probe (rate sweep to the knee) $(date -u +%H:%M:%S) ==="
+python tools/serve_bench.py \
+  --d 768 --layers 12 --nh 12 --ff 3072 --vocab 50304 \
+  --max-batch 32 --max-seq 1024 --buckets 128,512,1024 \
+  --rates 128,512 --requests 200 --max-new-tokens 32 \
+  --weight-dtypes int8 --out SERVE_BENCH_tpu_sat.json
+echo "=== saturation rc=$? ==="
+
+echo "=== [3/3] metrics gate on-chip (incl. the smoke serve) $(date -u +%H:%M:%S) ==="
+python tools/metrics_check.py --out /tmp/metrics_check_tpu
+echo "=== metrics_check rc=$? ==="
+date -u > .tpu_s6_done

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Serving-engine load bench: Poisson open-loop arrivals against the
+continuous-batching scheduler -> SERVE_BENCH.json (docs/serving.md).
+
+Open-loop on purpose: arrivals follow a Poisson process at each target
+rate regardless of completions (the closed-loop trap understates tail
+latency under overload). Per rate lane the bench reports:
+
+  * TTFT p50/p99 ms (submit -> first token, queueing included)
+  * per-output-token latency (TPOT) p50/p99 ms
+  * tokens/s and tokens/s/chip
+  * mean decode-batch occupancy
+  * steady_state_recompiles — the PR 4 ``paddle_recompiles_total`` delta
+    across the whole warmed load phase, REQUIRED to be exactly 0
+
+plus the int8-vs-f32 quality bar (serving/quant.py): max spread-relative
+logit error and perplexity drift of the int8-weight decode stream against
+the f32 engine, with pass/fail against INT8_LOGIT_TOL / INT8_PPL_REL_TOL.
+
+CPU lane (default sizes) is labeled ``cpu_smoke`` — dispatch-bound, it
+validates the mechanism and the zero-recompile contract, not absolute
+throughput. The TPU lane is queued in tools/run_tpu_session6.sh.
+
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --out SERVE_BENCH.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _pct(vals, q):
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+def _recompile_total():
+    from paddle_tpu.observability import metrics as om
+
+    snap = om.default_registry().snapshot()
+    return sum(s["value"] for s in
+               snap.get("paddle_recompiles_total", {}).get("series", []))
+
+
+def decode_logits_stream(engine, seq):
+    """Teacher-forced decode over ``seq`` through the serving path:
+    prefill the first token, then feed the ground-truth stream one token
+    at a time. Returns [len(seq), V] next-token logits."""
+    slot, l0 = engine.start_sequence(seq[:1])
+    logits = [l0]
+    for tok in seq[1:]:
+        out = engine.decode_step({slot: int(tok)})
+        logits.append(out[slot])
+    engine.free_sequence(slot)
+    return np.stack(logits)
+
+
+def parity_lane(params, cfg, ecfg_kw, seed: int, eval_len: int):
+    """int8 (and bf16) decode quality vs the f32 engine."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import quant as squant
+
+    rng = np.random.RandomState(seed)
+    seq = rng.randint(0, cfg.vocab_size, size=eval_len).astype(np.int64)
+    engines = {}
+    for wd in ("f32", "int8", "bf16"):
+        engines[wd] = serving.DecodeEngine(
+            params, cfg, serving.EngineConfig(weight_dtype=wd, **ecfg_kw))
+        engines[wd].warmup()
+    streams = {wd: decode_logits_stream(e, seq)
+               for wd, e in engines.items()}
+    labels = seq[1:]
+    out = {"eval_tokens": int(eval_len),
+           "logit_tol": squant.INT8_LOGIT_TOL,
+           "ppl_rel_tol": squant.INT8_PPL_REL_TOL}
+    ppl_f32 = squant.perplexity(streams["f32"][:-1], labels)
+    out["ppl_f32"] = round(ppl_f32, 6)
+    for wd in ("int8", "bf16"):
+        stats = squant.logit_error_stats(streams["f32"], streams[wd])
+        ppl = squant.perplexity(streams[wd][:-1], labels)
+        rel = abs(ppl / ppl_f32 - 1.0)
+        stats.update(ppl=round(ppl, 6), ppl_rel_drift=round(rel, 6))
+        if wd == "int8":
+            stats["pass"] = bool(
+                stats["max_rel_err"] < squant.INT8_LOGIT_TOL
+                and rel < squant.INT8_PPL_REL_TOL)
+        out[wd] = {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in stats.items()}
+        engines[wd].drop_reference_params()
+    # weight residency (the other half of the int8 story)
+    out["weight_bytes"] = {wd: int(e.weight_nbytes)
+                           for wd, e in engines.items()}
+    return out
+
+
+def load_lane(params, cfg, ecfg_kw, weight_dtype: str, rate_rps: float,
+              n_requests: int, max_new_tokens: int, prompt_len_max: int,
+              seed: int, queue_cap: int):
+    """One Poisson open-loop lane at ``rate_rps`` requests/second."""
+    import jax
+
+    from paddle_tpu import serving
+
+    engine = serving.DecodeEngine(
+        params, cfg, serving.EngineConfig(weight_dtype=weight_dtype,
+                                          **ecfg_kw))
+    warm_ms = engine.warmup()
+    sched = serving.Scheduler(engine, serving.SchedulerConfig(
+        max_queue=queue_cap, default_timeout_s=120.0))
+    loop = serving.EngineLoop(sched).start()
+
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           size=int(rng.randint(2, prompt_len_max + 1)))
+               .tolist() for _ in range(n_requests)]
+    requests, rejected = [], 0
+    rc0 = _recompile_total()
+    t_start = time.monotonic()
+    for gap, prompt in zip(gaps, prompts):
+        time.sleep(gap)
+        try:
+            requests.append(sched.submit(prompt,
+                                         max_new_tokens=max_new_tokens))
+            loop.wake()
+        except serving.QueueFullError:
+            rejected += 1
+    for req in requests:
+        req.wait(timeout=180.0)
+    t_span = time.monotonic() - t_start
+    loop.stop()
+    recompiles = _recompile_total() - rc0
+
+    done = [r for r in requests if r.state == "done"]
+    ttfts = [r.ttft_ms for r in done if r.ttft_ms is not None]
+    tpots = []
+    for r in done:
+        tpots.extend((np.diff(r.token_times) * 1e3).tolist())
+    total_tokens = sum(len(r.tokens) for r in done)
+    n_chips = jax.device_count()
+    return {
+        "weight_dtype": weight_dtype,
+        "rate_rps": rate_rps,
+        "requests": n_requests,
+        "completed": len(done),
+        "rejected_429": rejected,
+        "failed": len(requests) - len(done),
+        "ttft_ms": {"p50": round(_pct(ttfts, 50), 3),
+                    "p99": round(_pct(ttfts, 99), 3)},
+        "tpot_ms": {"p50": round(_pct(tpots, 50), 3) if tpots else None,
+                    "p99": round(_pct(tpots, 99), 3) if tpots else None},
+        "tokens_per_s": round(total_tokens / t_span, 2),
+        "tokens_per_s_per_chip": round(total_tokens / t_span / n_chips, 2),
+        "mean_batch_occupancy": round(sched.mean_occupancy, 4),
+        "scheduler_steps": sched.steps,
+        "steady_state_recompiles": int(recompiles),
+        "warmup_ms": {k: round(v, 1) for k, v in warm_ms.items()},
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="short CPU-sized run")
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--nh", type=int, default=4)
+    ap.add_argument("--ff", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=64)
+    ap.add_argument("--buckets", default="16,32")
+    ap.add_argument("--rates", default="8,32,128")
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--prompt-len-max", type=int, default=16)
+    ap.add_argument("--weight-dtypes", default="f32,int8")
+    ap.add_argument("--eval-len", type=int, default=48,
+                    help="token stream length for the parity lane")
+    ap.add_argument("--queue-cap", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from paddle_tpu.models import gpt
+
+    if args.smoke:
+        args.rates, args.requests = "16,64", 24
+        args.eval_len = 24
+
+    import jax.numpy as jnp
+
+    compute_dtype = (jnp.float32 if jax.default_backend() == "cpu"
+                     else jnp.bfloat16)
+    cfg = gpt.GPTConfig(
+        vocab_size=args.vocab, max_seq_len=max(args.max_seq, 64),
+        num_layers=args.layers, num_heads=args.nh, d_model=args.d,
+        d_ff=args.ff, dtype=compute_dtype, remat=False)
+    params = gpt.init_params(jax.random.PRNGKey(args.seed), cfg)
+    ecfg_kw = dict(
+        max_batch=args.max_batch, max_seq=args.max_seq,
+        prefill_buckets=tuple(int(b) for b in args.buckets.split(",")))
+
+    backend = jax.default_backend()
+    result = {
+        "lane": "tpu" if backend == "tpu" else "cpu_smoke",
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_chips": jax.device_count(),
+        "model": {"d_model": args.d, "num_layers": args.layers,
+                  "num_heads": args.nh, "d_ff": args.ff,
+                  "vocab": args.vocab},
+        "engine": {"max_batch": args.max_batch, "max_seq": args.max_seq,
+                   "prefill_buckets": [int(b) for b in
+                                       args.buckets.split(",")],
+                   "max_new_tokens": args.max_new_tokens},
+        # dispatch-bound off-TPU: the lane validates mechanism + the
+        # zero-recompile contract, not absolute tokens/s
+        "degraded": backend != "tpu",
+    }
+    print(f"[serve_bench] parity lane ({args.eval_len} tokens)...",
+          flush=True)
+    result["quant_parity"] = parity_lane(
+        params, cfg, ecfg_kw, args.seed + 1, args.eval_len)
+
+    lanes = []
+    for wd in args.weight_dtypes.split(","):
+        for rate in (float(r) for r in args.rates.split(",")):
+            print(f"[serve_bench] load lane weight={wd} rate={rate}/s "
+                  f"({args.requests} requests)...", flush=True)
+            lanes.append(load_lane(
+                params, cfg, ecfg_kw, wd.strip(), rate, args.requests,
+                args.max_new_tokens, args.prompt_len_max,
+                args.seed + 2, args.queue_cap))
+    result["load"] = lanes
+    result["steady_state_recompiles"] = max(
+        l["steady_state_recompiles"] for l in lanes)
+    result["zero_recompile_pass"] = result["steady_state_recompiles"] == 0
+    result["int8_pass"] = bool(result["quant_parity"]["int8"]["pass"])
+
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({k: v for k, v in result.items() if k != "load"},
+                     indent=1))
+    print(f"[serve_bench] wrote {args.out}")
+    if not (result["zero_recompile_pass"] and result["int8_pass"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
