@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -238,7 +239,9 @@ class StableHLOPredictor:
         self._feed_names = feed_names
         self._fetch_names = fetch_names
         self._report_name = f"serve/{name}"
-        self._compiled: Dict[tuple, Any] = {}
+        # LRU: hits move to the end, overflow evicts only the coldest
+        # signature (a wholesale clear would recompile every warm shape)
+        self._compiled: "OrderedDict[tuple, Any]" = OrderedDict()
         self._sig_history: List[dict] = []
 
     def get_input_names(self):
@@ -255,6 +258,7 @@ class StableHLOPredictor:
         key = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
         exe = self._compiled.get(key)
         if exe is not None:
+            self._compiled.move_to_end(key)
             return exe
         sig = _prep.make_sig(
             [(n, tuple(v.shape), str(v.dtype))
@@ -273,7 +277,7 @@ class StableHLOPredictor:
             extra={"feeds": list(self._feed_names),
                    "fetches": list(self._fetch_names)})
         if len(self._compiled) >= self._MAX_EXECUTABLES:
-            self._compiled.clear()
+            self._compiled.popitem(last=False)
         self._compiled[key] = exe
         return exe
 

@@ -117,6 +117,10 @@ class DecodeEngine:
         self.compiles = 0
         self.steady_state_recompiles = 0
         self._warm = False
+        # set when an executable fails AFTER its cache buffers were donated
+        # (the slabs are invalidated by donation, so no later call can be
+        # trusted) — every serving entrypoint refuses from then on
+        self.poisoned: Optional[str] = None
         self._tokens_window: List[Tuple[float, int]] = []  # (t, n) samples
 
     # ------------------------------------------------------------------
@@ -307,6 +311,22 @@ class DecodeEngine:
     # ------------------------------------------------------------------
     # host-side serving API (one scheduler thread)
     # ------------------------------------------------------------------
+    def _check_poisoned(self) -> None:
+        if self.poisoned is not None:
+            raise RuntimeError(f"engine poisoned: {self.poisoned}")
+
+    def _poison_on_donation_failure(self, name: str, exc: Exception) -> None:
+        """An executable compiled with donate_argnums died mid-call: the
+        cache slabs it was handed are donation-invalidated, so cache.k/v
+        can no longer be trusted. Mark the engine fatally poisoned rather
+        than let later calls read freed buffers. (Without donation — CPU —
+        the slabs are untouched and the engine stays usable.)"""
+        if self._donate and self.poisoned is None:
+            self.poisoned = (
+                f"{name} failed after cache-buffer donation "
+                f"({type(exc).__name__}: {exc}); KV slabs invalidated — "
+                f"rebuild the engine")
+
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
             if b >= n:
@@ -320,6 +340,7 @@ class DecodeEngine:
         the last prompt position — argmax of it is the first generated
         token. Raises CacheFullError when no slot is free and
         PromptTooLongError above the ladder."""
+        self._check_poisoned()
         n = len(tokens)
         if n < 1:
             raise ValueError("empty prompt")
@@ -333,7 +354,8 @@ class DecodeEngine:
             ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
                                  padded, np.int32(n), np.int32(slot))
             logits = np.asarray(logits)
-        except Exception:
+        except Exception as e:
+            self._poison_on_donation_failure(f"prefill_b{bucket}", e)
             self.cache.free(slot)
             raise
         smetrics.m_prefill_ms.observe(
@@ -348,6 +370,7 @@ class DecodeEngine:
         executable, zero recompiles."""
         if not slot_tokens:
             return {}
+        self._check_poisoned()
         B = self.ecfg.max_batch
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -361,9 +384,13 @@ class DecodeEngine:
             positions[slot] = self.cache.length(slot)
         exe = self._decode_exec()
         t0 = time.perf_counter_ns()
-        ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
-                             tokens, positions)
-        logits = np.asarray(logits)
+        try:
+            ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
+                                 tokens, positions)
+            logits = np.asarray(logits)
+        except Exception as e:
+            self._poison_on_donation_failure("decode", e)
+            raise
         smetrics.m_decode_ms.observe((time.perf_counter_ns() - t0) / 1e6)
         self.cache.k, self.cache.v = ck, cv
         out: Dict[int, np.ndarray] = {}

@@ -164,6 +164,25 @@ class Scheduler:
             self.step()
         return False
 
+    def abort_all(self, reason: str) -> int:
+        """Fail every queued and active request (the loop's fault path —
+        a step() exception must not leave waiters hanging on events that
+        will never fire). Slots are freed; returns how many requests were
+        failed."""
+        n = 0
+        for slot in list(self._active):
+            self._evict(slot, FAILED, reason)
+            n += 1
+        with self._lock:
+            queued = list(self._queue)
+            self._queue.clear()
+            smetrics.m_queue_depth.set(0)
+        for req in queued:
+            self._finish(req, FAILED, reason)
+            n += 1
+        smetrics.m_active.set(0)
+        return n
+
     @property
     def draining(self) -> bool:
         return self._draining
@@ -225,6 +244,12 @@ class Scheduler:
             admitted += 1
             if self._should_finish(req, first):
                 self._evict(slot, DONE)
+            elif self.engine.cache.headroom(slot) < 1:
+                # prompt filled the slot to max_seq: the prefill logits
+                # already produced the one token that fits, and the next
+                # decode_step would raise — finish here instead
+                self._evict(slot, DONE, "max_seq reached",
+                            reason="max_seq")
         return admitted
 
     def _decode(self, now: float) -> bool:
@@ -251,7 +276,8 @@ class Scheduler:
             if self._should_finish(req, tok):
                 self._evict(slot, DONE)
             elif self.engine.cache.headroom(slot) < 1:
-                self._evict(slot, DONE, "max_seq reached")
+                self._evict(slot, DONE, "max_seq reached",
+                            reason="max_seq")
         return True
 
     def _should_finish(self, req: Request, last_token: int) -> bool:
@@ -260,13 +286,16 @@ class Scheduler:
             return True
         return len(req.tokens) >= req.max_new_tokens
 
+    _EVICT_REASONS = {DONE: "done", EXPIRED: "deadline", FAILED: "failed"}
+
     def _evict(self, slot: int, state: str,
-               detail: Optional[str] = None) -> None:
+               detail: Optional[str] = None,
+               reason: Optional[str] = None) -> None:
         req = self._active.pop(slot)
         self._next_token.pop(slot, None)
         self.engine.free_sequence(slot)
         smetrics.m_evictions.labels(
-            "done" if state == DONE else "deadline").inc()
+            reason or self._EVICT_REASONS.get(state, state)).inc()
         self._finish(req, state, detail)
 
     def _finish(self, req: Request, state: str,

@@ -47,11 +47,20 @@ __all__ = ["FrontDoor", "EngineLoop"]
 
 class EngineLoop:
     """Background thread ticking ``scheduler.step()``; parks on an event
-    when idle so an empty server burns no CPU."""
+    when idle so an empty server burns no CPU.
+
+    A ``step()`` exception must never kill this thread silently while the
+    HTTP server keeps accepting work (every handler would then block to
+    504 with no operator-visible signal): the loop catches it, fails every
+    queued/active request so their waiters wake with an error, records the
+    fault (``faults``/``last_fault``, surfaced through ``/health``), and
+    keeps ticking."""
 
     def __init__(self, scheduler: Scheduler, idle_sleep_s: float = 0.002):
         self.scheduler = scheduler
         self.idle_sleep_s = idle_sleep_s
+        self.faults = 0
+        self.last_fault: Optional[str] = None
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -61,6 +70,10 @@ class EngineLoop:
                                         name="serve-engine-loop")
         self._thread.start()
         return self
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
 
     def wake(self) -> None:
         self._wake.set()
@@ -75,7 +88,16 @@ class EngineLoop:
         while not self._stop.is_set():
             worked = False
             if self.scheduler.pending():
-                worked = self.scheduler.step()
+                try:
+                    worked = self.scheduler.step()
+                except Exception as e:
+                    self.faults += 1
+                    self.last_fault = f"{type(e).__name__}: {e}"
+                    try:
+                        self.scheduler.abort_all(
+                            f"engine loop fault: {self.last_fault}")
+                    except Exception:
+                        pass  # never let cleanup kill the loop either
             if not worked:
                 self._wake.wait(timeout=self.idle_sleep_s)
                 self._wake.clear()
@@ -297,6 +319,13 @@ class FrontDoor:
             out["max_batch"] = self.scheduler.engine.ecfg.max_batch
             out["buckets"] = list(self.scheduler.engine.buckets)
             out["weight_dtype"] = self.scheduler.engine.ecfg.weight_dtype
+            if self.loop is not None:
+                out["loop_alive"] = self.loop.alive
+                out["loop_faults"] = self.loop.faults
+                if self.loop.last_fault is not None:
+                    out["loop_last_fault"] = self.loop.last_fault
+                if not self.loop.alive and not self._draining:
+                    out["status"] = "degraded"
         return out
 
     # -- graceful drain ----------------------------------------------------

@@ -111,6 +111,32 @@ def test_stablehlo_predictor_is_observable(tmp_path):
     assert len(pred._compiled) == 1
 
 
+def test_stablehlo_predictor_lru_eviction():
+    """Regression: executable-cache overflow evicts only the coldest
+    signature — a wholesale clear() would recompile every warm shape."""
+    from paddle_tpu.inference.predictor import StableHLOPredictor
+
+    class _Fake:
+        @staticmethod
+        def call(x):
+            return (x * 2,)
+
+    pred = StableHLOPredictor(_Fake, ["x"], ["y"], name="lru")
+    pred._MAX_EXECUTABLES = 2
+
+    def key(n):
+        return (((n,), "float32"),)
+
+    pred.run({"x": np.ones(1, np.float32)})
+    pred.run({"x": np.ones(2, np.float32)})
+    pred.run({"x": np.ones(1, np.float32)})   # hit: shape-1 becomes MRU
+    pred.run({"x": np.ones(3, np.float32)})   # overflow: evict shape-2 only
+    assert list(pred._compiled) == [key(1), key(3)]
+    out = pred.run({"x": np.ones(1, np.float32)})   # still warm
+    np.testing.assert_allclose(out[0], np.full(1, 2.0))
+    assert list(pred._compiled) == [key(3), key(1)]
+
+
 def test_program_dir_server(tmp_path):
     """The same server also hosts a save_inference_model directory."""
     scope = fluid.Scope()

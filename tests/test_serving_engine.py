@@ -339,6 +339,91 @@ def test_scheduler_eos_stop(tiny_model):
     assert req.tokens == ref[:2]              # stopped ON the eos token
 
 
+def test_scheduler_prompt_at_max_seq_finishes(tiny_model):
+    """Regression: a prompt that fills its slot to max_seq (headroom 0)
+    must finish at admission with the one token prefill produced — not
+    stay active and blow up the next decode tick (which would hang the
+    request forever and leak the slot)."""
+    eng = make_engine(tiny_model, max_batch=2, max_seq=8,
+                      prefill_buckets=(8,))
+    eng.warmup()
+    sched = serving.Scheduler(eng)
+    req = sched.submit(list(range(1, 9)), max_new_tokens=4)
+    sched.step()
+    sched.step()                              # previously raised here
+    assert req.state == "done" and req.error is None
+    assert len(req.tokens) == 1
+    assert eng.cache.free_slot_count() == 2
+    # and the eviction is attributed to max_seq, not "done"/"deadline"
+    snap = om.default_registry().snapshot()
+    by_reason = {s["labels"][0]: s["value"] for s in
+                 snap["paddle_serve_slot_evictions_total"]["series"]}
+    assert by_reason.get("max_seq", 0) >= 1
+
+
+def test_engine_loop_survives_step_fault(tiny_model):
+    """Regression: a step() exception must fail the waiting requests and
+    surface in /health — not silently kill the loop thread while the
+    HTTP server keeps accepting work."""
+    eng = make_engine(tiny_model)
+    eng.warmup()
+    sched = serving.Scheduler(eng)
+    f = serving.FrontDoor(scheduler=sched).start()
+    try:
+        def boom():
+            raise RuntimeError("boom")
+
+        sched.step = boom
+        code, body = _post_err(f.port, "/generate",
+                               {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert code == 500
+        assert "engine loop fault" in body["error"]
+        assert "boom" in body["error"]
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{f.port}/health", timeout=10).read())
+        assert health["status"] == "ok"          # loop thread still alive
+        assert health["loop_alive"] is True
+        assert health["loop_faults"] >= 1
+        assert "boom" in health["loop_last_fault"]
+    finally:
+        f.stop()
+
+
+def test_engine_poisoned_after_donation_failure(tiny_model):
+    """Regression: an executable failure AFTER buffer donation leaves the
+    cache slabs invalidated — the engine must refuse further work instead
+    of reading donated buffers. Without donation (CPU) the slabs survive
+    and the engine stays usable."""
+    eng = make_engine(tiny_model)
+    eng.warmup()
+
+    def raiser(*a, **k):
+        raise RuntimeError("device OOM")
+
+    eng._donate = True              # simulate the TPU donation contract
+    orig = eng._exec["prefill_b8"]
+    eng._exec["prefill_b8"] = raiser
+    with pytest.raises(RuntimeError, match="device OOM"):
+        eng.start_sequence([1, 2, 3])
+    assert eng.poisoned is not None
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.start_sequence([1, 2, 3])
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.decode_step({0: 1})
+
+    eng2 = make_engine(tiny_model)  # CPU path: no donation, no poison
+    eng2.warmup()
+    orig2 = eng2._exec["prefill_b8"]
+    eng2._exec["prefill_b8"] = raiser
+    with pytest.raises(RuntimeError, match="device OOM"):
+        eng2.start_sequence([1, 2, 3])
+    assert eng2.poisoned is None
+    eng2._exec["prefill_b8"] = orig2
+    slot, logits = eng2.start_sequence([1, 2, 3])
+    assert logits.shape[-1] == eng2.cfg.vocab_size
+    eng2.free_sequence(slot)
+
+
 def test_scheduler_drain(tiny_model):
     cfg, _ = tiny_model
     eng = make_engine(tiny_model)
