@@ -33,9 +33,15 @@ logger = logging.getLogger("paddle_tpu.executor")
 # these exist whether or not a profiling session is active (the "profiling
 # started after the first step" dropped-compile-events satellite).
 # ---------------------------------------------------------------------------
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs_metrics
+from ..observability import spans as _spans
 
 _OBS = _obs_metrics.default_registry()
+# the wall-clock ledger (docs/observability.md "Goodput & tracing"): run/
+# train paths bracket themselves in exclusive-time category timers so the
+# goodput report can attribute every second of a run
+_gp = _goodput.ledger()
 _m_dispatch = _OBS.counter(
     "paddle_executor_dispatch_total",
     "Executor.run dispatches by path (fast = dispatch-record hit)",
@@ -432,8 +438,10 @@ class _CompiledBlock:
         t0 = time.perf_counter_ns()
         try:
             # a first-call XLA compile can legitimately run for minutes:
-            # pause the hang-watchdog clock for its duration
-            with _health().suspend():
+            # pause the hang-watchdog clock for its duration, and charge
+            # the wall time to the ledger's compile category
+            with _health().suspend(), _gp.timer("compile"), \
+                    _spans.span(f"compile/{self.report_name}"):
                 lowered = self._jitted.lower(mutable, const, feeds, rng_key)
                 executable = lowered.compile()
         except Exception as e:
@@ -669,6 +677,24 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ):
+        # the whole call is step wall-time; nested timers re-bucket the
+        # compile / device-wait shares out of it (exclusive accounting)
+        with _gp.timer("productive_step"):
+            return self._run_impl(program, feed, fetch_list, feed_var_name,
+                                  fetch_var_name, scope, return_numpy,
+                                  use_program_cache)
+
+    def _run_impl(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        feed_var_name: str = "feed",
+        fetch_var_name: str = "fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
         from .compiler import CompiledProgram
 
         fetch_names = [
@@ -744,7 +770,7 @@ class Executor:
                    f"#{len(block.ops)}ops")
             self._explain_rebuild(program, report_name, feed_sig,
                                   fetch_names, mesh_plan)
-            with _m_compile_ms.time(), \
+            with _m_compile_ms.time(), _gp.timer("compile"), \
                     prof.RecordEvent(f"compile/{len(block.ops)}ops"):
                 if "pipeline" in program._annotations:
                     from ..parallel.pipeline_program import (
@@ -783,9 +809,13 @@ class Executor:
         _m_dispatch_slow.inc()
         _health().progress(getattr(exe, "progress_site", "executor.run"))
         t_run0 = time.perf_counter_ns()
-        with prof.RecordEvent("executor_run"):
+        with _gp.timer("productive_step"), prof.RecordEvent("executor_run"):
             fetches = exe(scope, feed_arrays, rng_key)
-        _m_run_ms.observe((time.perf_counter_ns() - t_run0) / 1e6)
+        t_run1 = time.perf_counter_ns()
+        _m_run_ms.observe((t_run1 - t_run0) / 1e6)
+        if _spans.tracing_enabled():
+            _spans.record("executor/step", t_run0, t_run1 - t_run0,
+                          attrs={"path": "slow"})
         if watch_cache:
             hits1, misses1 = compile_cache_counters()
             if hits1 > hits0 or misses1 > misses0:
@@ -816,7 +846,8 @@ class Executor:
             check_fetches(fetch_names, fetches)
         if return_numpy:
             t_wait0 = time.perf_counter_ns()
-            out = [np.asarray(f) for f in fetches]
+            with _gp.timer("device_wait"):
+                out = [np.asarray(f) for f in fetches]
             _m_device_wait_ms.observe((time.perf_counter_ns() - t_wait0) / 1e6)
             return out
         return fetches
@@ -897,17 +928,30 @@ class Executor:
         _m_dispatch_fast.inc()
         t_run0 = time.perf_counter_ns()
         prof = _prof()
+        # no ledger timer here: the run() entry wrapper already brackets
+        # this whole call as productive_step (fast-path overhead budget)
         if prof.is_active():
             with prof.RecordEvent("executor_run"):
-                fetches = rec.exe.fast_call(scope or global_scope(), feeds,
-                                            rng_key)
+                fetches = rec.exe.fast_call(scope or global_scope(),
+                                            feeds, rng_key)
         else:
             fetches = rec.exe.fast_call(scope or global_scope(), feeds,
                                         rng_key)
-        _m_run_ms.observe((time.perf_counter_ns() - t_run0) / 1e6)
+        t_run1 = time.perf_counter_ns()
+        _m_run_ms.observe((t_run1 - t_run0) / 1e6)
+        # steady-state step spans: full fidelity while a profiler session
+        # is live (they land on the merged-trace span plane), 1-in-64
+        # sampled otherwise — a per-step record next to a ~50us jitted
+        # call costs real cache locality (the <5% tracing gate in
+        # tools/dispatch_bench.py)
+        if _spans.tracing_enabled() and (prof.is_active()
+                                         or (self._step & 63) == 0):
+            _spans.record("executor/step", t_run0, t_run1 - t_run0,
+                          attrs={"path": "fast"})
         if return_numpy:
             t_wait0 = time.perf_counter_ns()
-            out = [np.asarray(f) for f in fetches]
+            with _gp.timer("device_wait"):
+                out = [np.asarray(f) for f in fetches]
             _m_device_wait_ms.observe((time.perf_counter_ns() - t_wait0) / 1e6)
             return out
         return fetches
@@ -1180,6 +1224,29 @@ class Executor:
                           thread: int = 0, monitor=None,
                           checkpoint_dir=None, checkpoint_interval=None,
                           guardrails=None):
+        # goodput run window (docs/observability.md): every wall-second of
+        # the dataset loop is attributed to a ledger category; the window
+        # remainder becomes `other`, and the per-rank report exports to
+        # PADDLE_GOODPUT_DIR for the supervisor's gang aggregation
+        opened = _gp.start_window()
+        try:
+            return self._run_from_dataset_inner(
+                program, dataset, scope, fetch_list, fetch_info,
+                print_period, train, thread=thread, monitor=monitor,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_interval=checkpoint_interval,
+                guardrails=guardrails)
+        finally:
+            if opened:
+                _goodput.maybe_export(_gp.end_window(
+                    extra={"mode": "train" if train else "infer"}))
+
+    def _run_from_dataset_inner(self, program, dataset, scope, fetch_list,
+                                fetch_info, print_period, train: bool,
+                                thread: int = 0, monitor=None,
+                                checkpoint_dir=None,
+                                checkpoint_interval=None,
+                                guardrails=None):
         if dataset is None:
             raise ValueError("dataset must be provided")
         program = program or default_main_program()
@@ -1225,6 +1292,12 @@ class Executor:
             out = {}
             if amp_vars is None:
                 return out
+            # materializing the AMP scalars is a device sync
+            with _gp.timer("device_wait"):
+                return _amp_fields_inner()
+
+        def _amp_fields_inner():
+            out = {}
             v = scope.find_var(amp_vars.get("loss_scale", ""))
             if v is not None:
                 out["loss_scale"] = float(np.asarray(v).ravel()[0])
@@ -1256,16 +1329,20 @@ class Executor:
         ckpt = preempt = None
         start_offset = 0
         if train and checkpoint_dir:
-            from ..parallel.checkpoint import ElasticCheckpointer
-            from ..parallel.launch import install_preemption_handler
+            # store bring-up (module import + committed-step scan) is
+            # checkpoint machinery wall time
+            with _gp.timer("checkpoint_save"):
+                from ..parallel.checkpoint import ElasticCheckpointer
+                from ..parallel.launch import install_preemption_handler
 
-            scope = scope or global_scope()
-            ckpt = ElasticCheckpointer(checkpoint_dir, keep_last=3)
-            latest = ckpt.latest_valid_step()
+                scope = scope or global_scope()
+                ckpt = ElasticCheckpointer(checkpoint_dir, keep_last=3)
+                latest = ckpt.latest_valid_step()
             if latest is not None:
-                state, man = ckpt.restore(latest)
-                n_restored = self._restore_checkpoint_state(
-                    program, scope, state)
+                with _gp.timer("restore"):
+                    state, man = ckpt.restore(latest)
+                    n_restored = self._restore_checkpoint_state(
+                        program, scope, state)
                 start_offset = int((man.get("data") or {}).get("offset", 0))
                 logger.info(
                     "resumed %d persistables from checkpoint step %d "
@@ -1274,10 +1351,13 @@ class Executor:
             preempt = install_preemption_handler()
 
         def _save_ckpt(step_no: int, sync: bool = False):
-            ckpt.save(step_no, self._checkpoint_state(program, scope),
-                      data_state={"epoch": 0, "offset": step_no})
-            if sync:
-                ckpt.wait()
+            # only the synchronous share burns main-thread wall: the host
+            # snapshot + (for sync saves) the commit wait
+            with _gp.timer("checkpoint_save"):
+                ckpt.save(step_no, self._checkpoint_state(program, scope),
+                          data_state={"epoch": 0, "offset": step_no})
+                if sync:
+                    ckpt.wait()
 
         # overlap host batch assembly + device transfer with the in-flight
         # (asynchronously dispatched) step; fetches stay on device between
@@ -1293,75 +1373,85 @@ class Executor:
         step = start_offset
         last_fetch = None
         for feed in prefetch_to_device(stream, size=2):
-            health.progress("train_from_dataset")
-            if guard is not None:
-                # the skip-batch restore target: pre-step persistable state
-                # as host arrays (the same snapshot a checkpoint save
-                # takes — this sync + copy is guard mode's documented cost)
-                pre_state = self._checkpoint_state(program, scope)
-            if monitor is not None:
-                if monitor.examples_per_step is None:
-                    # infer the per-step example count from the batch dim
-                    for v in feed.values():
-                        shape = getattr(v, "shape", None)
-                        if shape:
-                            monitor.examples_per_step = int(shape[0])
-                            break
-                with monitor.step() as s:
+            with _gp.timer("productive_step"):
+                health.progress("train_from_dataset")
+                if guard is not None:
+                    # the skip-batch restore target: pre-step persistable
+                    # state as host arrays (the same snapshot a checkpoint
+                    # save takes — this sync + copy is guard mode's
+                    # documented cost, charged to the step by the
+                    # enclosing loop-body timer)
+                    pre_state = self._checkpoint_state(program, scope)
+                if monitor is not None:
+                    if monitor.examples_per_step is None:
+                        # infer the per-step example count from the batch dim
+                        for v in feed.values():
+                            shape = getattr(v, "shape", None)
+                            if shape:
+                                monitor.examples_per_step = int(shape[0])
+                                break
+                    with monitor.step() as s:
+                        last_fetch = self.run(program=program, feed=feed,
+                                              fetch_list=fetch_list, scope=scope,
+                                              return_numpy=False)
+                        s.dispatched()
+                        if fetch_list:
+                            # materializing the first fetch IS the device wait;
+                            # the full fetch list rides along (by reference, no
+                            # sync) so an anomaly dump can summarize the
+                            # offending step's values
+                            extra = _amp_fields()
+                            if guard is not None:
+                                with _gp.timer("device_wait"):
+                                    loss_host = np.asarray(last_fetch[0])
+                                verdict = guard.judge(loss_host)
+                                if verdict != "ok":
+                                    extra["bad_step"] = True
+                            s.observe(loss=last_fetch[0], fetches=last_fetch,
+                                      fetch_names=list(fetch_info), **extra)
+                else:
                     last_fetch = self.run(program=program, feed=feed,
                                           fetch_list=fetch_list, scope=scope,
                                           return_numpy=False)
-                    s.dispatched()
-                    if fetch_list:
-                        # materializing the first fetch IS the device wait;
-                        # the full fetch list rides along (by reference, no
-                        # sync) so an anomaly dump can summarize the
-                        # offending step's values
-                        extra = _amp_fields()
-                        if guard is not None:
-                            verdict = guard.judge(np.asarray(last_fetch[0]))
-                            if verdict != "ok":
-                                extra["bad_step"] = True
-                        s.observe(loss=last_fetch[0], fetches=last_fetch,
-                                  fetch_names=list(fetch_info), **extra)
-            else:
-                last_fetch = self.run(program=program, feed=feed,
-                                      fetch_list=fetch_list, scope=scope,
-                                      return_numpy=False)
-                if guard is not None:
-                    verdict = guard.judge(np.asarray(last_fetch[0]))
-            step += 1
-            if heartbeat is not None:
-                heartbeat.beat(step)
-            if guard is not None and verdict != "ok":
-                # skip-batch: the poisoned step's update never lands
-                self._restore_checkpoint_state(program, scope, pre_state)
-                logger.warning(
-                    "guardrail: step %d skipped (%s, consecutive bad %d)",
-                    step, guard.last_reason, guard.consecutive_bad)
-                if verdict == "rollback":
-                    self._guardrail_rollback(program, scope, ckpt, guard,
-                                             step)
-            if ckpt is not None:
-                if preempt is not None and preempt.triggered:
-                    # the launcher's SIGTERM grace window: checkpoint
-                    # synchronously and return cleanly
-                    logger.info("preemption signal at step %d: "
-                                "checkpointing and exiting", step)
-                    _save_ckpt(step, sync=True)
-                    break
-                if checkpoint_interval and \
-                        step % int(checkpoint_interval) == 0:
-                    _save_ckpt(step)
-            if fetch_list and print_period and step % print_period == 0:
-                # the only per-step host sync point (monitor excepted),
-                # and only when printing
-                t0 = time.perf_counter_ns()
-                msg = ", ".join(
-                    f"{name}={np.asarray(val).ravel()[:4]}"
-                    for name, val in zip(fetch_info, last_fetch))
-                _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
-                logger.info("step %d: %s", step, msg)
+                    if guard is not None:
+                        with _gp.timer("device_wait"):
+                            loss_host = np.asarray(last_fetch[0])
+                        verdict = guard.judge(loss_host)
+                step += 1
+                if heartbeat is not None:
+                    heartbeat.beat(step)
+                if guard is not None and verdict != "ok":
+                    # skip-batch: the poisoned step's update never lands
+                    with _gp.timer("rollback_replay"):
+                        self._restore_checkpoint_state(program, scope, pre_state)
+                        logger.warning(
+                            "guardrail: step %d skipped (%s, consecutive bad "
+                            "%d)", step, guard.last_reason,
+                            guard.consecutive_bad)
+                        if verdict == "rollback":
+                            self._guardrail_rollback(program, scope, ckpt,
+                                                     guard, step)
+                if ckpt is not None:
+                    if preempt is not None and preempt.triggered:
+                        # the launcher's SIGTERM grace window: checkpoint
+                        # synchronously and return cleanly
+                        logger.info("preemption signal at step %d: "
+                                    "checkpointing and exiting", step)
+                        _save_ckpt(step, sync=True)
+                        break
+                    if checkpoint_interval and \
+                            step % int(checkpoint_interval) == 0:
+                        _save_ckpt(step)
+                if fetch_list and print_period and step % print_period == 0:
+                    # the only per-step host sync point (monitor excepted),
+                    # and only when printing
+                    t0 = time.perf_counter_ns()
+                    with _gp.timer("device_wait"):
+                        msg = ", ".join(
+                            f"{name}={np.asarray(val).ravel()[:4]}"
+                            for name, val in zip(fetch_info, last_fetch))
+                    _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
+                    logger.info("step %d: %s", step, msg)
         if heartbeat is not None:
             heartbeat.flush()
         if ckpt is not None:
@@ -1371,7 +1461,8 @@ class Executor:
             ckpt.close()
         if last_fetch is not None:
             t0 = time.perf_counter_ns()
-            last_fetch = [np.asarray(v) for v in last_fetch]
+            with _gp.timer("device_wait"):
+                last_fetch = [np.asarray(v) for v in last_fetch]
             _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
         return last_fetch
 

@@ -23,6 +23,15 @@ split (host RecordEvent + device tracer + train monitor callbacks):
 - :mod:`.hw` — hardware denominators shared by bench.py and the monitor:
   bf16 peak FLOP/s per device kind and analytic train FLOPs of a fluid
   program.
+- :mod:`.spans` — the end-to-end span tracer (ISSUE 10): trace/span/parent
+  identity with cross-thread context propagation, a bounded ring + JSONL
+  sink, and its own plane in the merged chrome trace.  Serving requests
+  and training steps stamp spans so a user-visible p99 walks back to the
+  tick that caused it.
+- :mod:`.goodput` — the wall-clock ledger (ISSUE 10): every run second
+  classified into productive_step/compile/checkpoint_save/... —
+  ``paddle_goodput_seconds_total{category}``, per-rank ``GOODPUT`` window
+  reports, and the gang aggregation the supervisor writes.
 - :mod:`.program_report` — compile- & memory-side introspection (ISSUE 4):
   per-executable cost/memory program reports (JSONL +
   ``paddle_program_*`` gauges), the recompile explainer
@@ -45,14 +54,16 @@ from .metrics import (  # noqa: F401
     set_metrics_enabled,
 )
 from .monitor import MonitorWriter, TrainMonitor  # noqa: F401
+from . import goodput  # noqa: F401
 from . import hw  # noqa: F401
 from . import program_report  # noqa: F401
 from . import prom  # noqa: F401
+from . import spans  # noqa: F401
 from . import trace_merge  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "metrics_enabled", "set_metrics_enabled",
-    "MonitorWriter", "TrainMonitor", "hw", "program_report", "prom",
-    "trace_merge",
+    "MonitorWriter", "TrainMonitor", "goodput", "hw", "program_report",
+    "prom", "spans", "trace_merge",
 ]

@@ -46,6 +46,7 @@ from typing import Any, Dict, IO, Optional, Union
 
 import numpy as np
 
+from . import goodput as _goodput
 from . import metrics as _metrics
 
 __all__ = ["MonitorWriter", "TrainMonitor"]
@@ -123,6 +124,12 @@ class _StepHandle:
         self.fetch_names = None
 
     def __enter__(self):
+        # anchor the ledger for the first row's delta: later rows delta
+        # against the previous row's finish, so inter-step stalls
+        # (input_stall, checkpoint_save) land on the row that follows them
+        if self.mon._goodput_snap is None:
+            self.mon._goodput_snap = _goodput.ledger().totals(
+                include_open=True)
         self.t0 = time.perf_counter_ns()
         return self
 
@@ -143,22 +150,27 @@ class _StepHandle:
             self.fetch_refs = list(fetches)
             self.fetch_names = list(fetch_names or [])
         t0 = time.perf_counter_ns()
-        if loss is not None:
-            arr = np.asarray(loss)
-            self.fields["nan_inf"] = _scan_nan_inf(arr)
-            self.fields["loss"] = float(arr.ravel()[0]) \
-                if arr.size else None
-        if grad_norm is not None:
-            arr = np.asarray(grad_norm)
-            self.fields["grad_norm"] = float(arr.ravel()[0])
-            if self.fields.get("nan_inf") is not True:
+        with _goodput.ledger().timer("device_wait"):
+            if loss is not None:
+                arr = np.asarray(loss)
                 self.fields["nan_inf"] = _scan_nan_inf(arr)
+                self.fields["loss"] = float(arr.ravel()[0]) \
+                    if arr.size else None
+            if grad_norm is not None:
+                arr = np.asarray(grad_norm)
+                self.fields["grad_norm"] = float(arr.ravel()[0])
+                if self.fields.get("nan_inf") is not True:
+                    self.fields["nan_inf"] = _scan_nan_inf(arr)
         self.t_wait += (time.perf_counter_ns() - t0)
         self.fields.update(extra)
 
     def __exit__(self, exc_type, exc, tb):
         self.dispatched()  # a step that never synced: all time is dispatch
-        self.mon._finish_step(self, time.perf_counter_ns())
+        t_end = time.perf_counter_ns()
+        # row assembly + JSONL write is per-step bookkeeping: charge it to
+        # the step so the ledger's `other` stays honest
+        with _goodput.ledger().timer("productive_step"):
+            self.mon._finish_step(self, t_end)
         return False
 
 
@@ -210,6 +222,10 @@ class TrainMonitor:
         self.dump_paths: list = []
         self._recent_records = collections.deque(maxlen=int(dump_last_n))
         self._grad_norms = collections.deque(maxlen=window)
+        # goodput breakdown (ISSUE 10 satellite): every row carries the
+        # ledger's per-category delta since the previous row, so one JSONL
+        # stream answers "slow step: compile, input stall, or device?"
+        self._goodput_snap: Optional[Dict[str, float]] = None
         reg = registry or _metrics.default_registry()
         self._m_steps = reg.counter(
             "paddle_train_steps_total", "Monitored train steps")
@@ -284,6 +300,18 @@ class TrainMonitor:
         # the real value through record_step/observe extras; 0.0 otherwise
         # so the row schema is stable (tools/metrics_check.py gate)
         rec.setdefault("overlap_fraction", 0.0)
+        # per-row goodput category breakdown (ms since the previous row;
+        # include_open folds in the enclosing step timer's in-flight share)
+        cur = _goodput.ledger().totals(include_open=True)
+        # record_step callers never enter a step handle: their first row
+        # baselines here (empty breakdown) instead of reporting the
+        # process-cumulative totals as a "delta"
+        prev = self._goodput_snap if self._goodput_snap is not None else cur
+        rec["goodput_ms"] = {
+            c: round(dv * 1e3, 3)
+            for c, v in cur.items()
+            if (dv := v - prev.get(c, 0.0)) > 5e-7}
+        self._goodput_snap = cur
         for q in (50, 90, 99):
             rec[f"p{q}_step_time_ms"] = round(self._percentile(q), 4)
         if self.sample_hbm:

@@ -20,7 +20,8 @@ from typing import Optional
 from .metrics import MetricsRegistry, default_registry
 from .metrics import _CounterChild, _GaugeChild, _HistogramChild  # noqa: F401
 
-__all__ = ["render", "write_textfile", "MetricsHTTPServer"]
+__all__ = ["render", "write_textfile", "merge_expositions",
+           "MetricsHTTPServer"]
 
 
 def _escape_help(s: str) -> str:
@@ -86,6 +87,80 @@ def write_textfile(path: str,
         f.write(text)
     os.replace(tmp, path)
     return path
+
+
+def merge_expositions(texts) -> str:
+    """Merge several text expositions (one per gang rank) into ONE gang
+    exposition (the ISSUE 10 supervisor aggregation).
+
+    Merge rules by declared TYPE: ``counter`` and ``histogram`` samples
+    (including ``_bucket``/``_sum``/``_count``) SUM across ranks — restart
+    downtime, goodput seconds and request counts are gang totals;
+    ``gauge`` samples take the MAX (a gauge is a point-in-time level, and
+    the worst rank is the operationally interesting one).  HELP/TYPE rows
+    come from the first exposition that declared the family.  Output stays
+    valid against the 0.0.4 grammar (tools/metrics_check.py's validator).
+    """
+    types: dict = {}            # family -> type
+    helps: dict = {}            # family -> help line
+    order: list = []            # family order of first appearance
+    samples: dict = {}          # family -> {(suffix_name, labels): value}
+
+    def family_of(name: str):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types \
+                    and types[name[: -len(suffix)]] == "histogram":
+                return name[: -len(suffix)]
+        return name
+
+    for text in texts:
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# "):
+                parts = line.split(None, 3)
+                if len(parts) >= 4 and parts[1] in ("HELP", "TYPE"):
+                    fam = parts[2]
+                    if parts[1] == "TYPE":
+                        types.setdefault(fam, parts[3].strip())
+                        if fam not in order:
+                            order.append(fam)
+                    else:
+                        helps.setdefault(fam, line)
+                continue
+            brace = line.find("{")
+            space = line.rfind(" ")
+            if space <= 0:
+                continue
+            if 0 <= brace < space:
+                name = line[:brace]
+                labels = line[brace:line.rfind("}") + 1]
+            else:
+                name = line[:space]
+                labels = ""
+            try:
+                value = float(line[space + 1:])
+            except ValueError:
+                continue
+            fam = family_of(name)
+            if fam not in order:
+                order.append(fam)
+            fam_samples = samples.setdefault(fam, {})
+            key = (name, labels)
+            if key in fam_samples and types.get(fam) == "gauge":
+                fam_samples[key] = max(fam_samples[key], value)
+            else:
+                fam_samples[key] = fam_samples.get(key, 0.0) + value
+
+    lines = []
+    for fam in order:
+        if fam in helps:
+            lines.append(helps[fam])
+        if fam in types:
+            lines.append(f"# TYPE {fam} {types[fam]}")
+        for (name, labels), value in sorted(samples.get(fam, {}).items()):
+            lines.append(f"{name}{labels} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 class MetricsHTTPServer:

@@ -23,6 +23,14 @@ Named scopes flow through both sides: RecordEvent doubles as a
 ``jax.profiler.TraceAnnotation`` while a device trace is active, so the
 same name shows up on the host row (measured by perf_counter) and inside
 the XPlane host-thread lines (measured by the runtime).
+
+ISSUE 10: the span tracer (observability/spans.py) lands as a THIRD plane
+— its own pid with one row per recording thread, span identity
+(trace/span/parent ids) in the event args.  Spans share the host
+perf_counter clock, so no cross-clock shift is needed; spans opened
+BEFORE ``start_profiler`` are aligned to the merged-trace epoch (start
+clamped to the profiling window) instead of dropped or misplaced ahead
+of it.
 """
 from __future__ import annotations
 
@@ -30,11 +38,14 @@ import json
 import os
 from typing import Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["device_spans_from_xplane", "merge_events", "merge_profile"]
+__all__ = ["device_spans_from_xplane", "merge_events", "merge_profile",
+           "span_chrome_events"]
 
 # device pids start here so they can never collide with a real host pid
 # (linux pid_max tops out at 2^22)
 DEVICE_PID_BASE = 1 << 23
+# the span-tracer plane gets its own pid block above the device planes
+SPAN_PID = DEVICE_PID_BASE << 1
 
 
 def device_spans_from_xplane(trace_dir: str) -> List[dict]:
@@ -78,14 +89,67 @@ def device_spans_from_xplane(trace_dir: str) -> List[dict]:
     return spans
 
 
+def span_chrome_events(tracer_spans: Iterable[dict],
+                       epoch_us: Optional[float] = None
+                       ) -> Tuple[List[dict], List[dict]]:
+    """Tracer spans -> (metadata rows, chrome events) for the span plane.
+
+    Spans already tick on the host perf_counter clock, so their ``ts`` is
+    directly comparable to host RecordEvents.  ``epoch_us`` is the merged
+    trace's epoch (the host time ``start_profiler`` returned): a span
+    opened before it — e.g. a serving request admitted before profiling
+    began — is ALIGNED to the epoch (start clamped, duration shrunk to the
+    in-window share) rather than dropped or drawn before the trace
+    starts.  Each recording thread gets its own named row.
+    """
+    meta: List[dict] = []
+    out: List[dict] = []
+    tid_row: Dict[int, int] = {}
+    for s in tracer_spans:
+        ts_us = s["start_ns"] / 1000.0
+        dur_us = s["dur_ns"] / 1000.0
+        if epoch_us is not None and ts_us < epoch_us:
+            # clamp to the merged-trace epoch; fully-pre-epoch spans keep
+            # a zero-length marker at the epoch so their identity survives
+            dur_us = max(0.0, dur_us - (epoch_us - ts_us))
+            ts_us = epoch_us
+        tid = int(s.get("tid", 0))
+        row = tid_row.get(tid)
+        if row is None:
+            row = len(tid_row)
+            tid_row[tid] = row
+            meta.append({"name": "thread_name", "ph": "M", "pid": SPAN_PID,
+                         "tid": row,
+                         "args": {"name": f"spans:"
+                                          f"{s.get('thread', tid)}"}})
+        args = {"track": "span", "trace": f"{s['trace']:x}",
+                "span": f"{s['span']:x}"}
+        if s.get("parent"):
+            args["parent"] = f"{s['parent']:x}"
+        if s.get("attrs"):
+            args.update(s["attrs"])
+        out.append({"name": s["name"], "ph": "X", "ts": ts_us,
+                    "dur": dur_us, "pid": SPAN_PID, "tid": row,
+                    "args": args})
+    if out:
+        meta.insert(0, {"name": "process_name", "ph": "M", "pid": SPAN_PID,
+                        "args": {"name": "spans (request/step tracer)"}})
+    return meta, out
+
+
 def merge_events(host_events: Iterable[dict], device_spans: Iterable[dict],
-                 align_device_to_us: Optional[float] = None) -> dict:
-    """Merge host chrome-trace events with raw device spans into one
-    chrome-trace document (pure function — the testable core).
+                 align_device_to_us: Optional[float] = None,
+                 tracer_spans: Optional[Iterable[dict]] = None,
+                 span_epoch_us: Optional[float] = None) -> dict:
+    """Merge host chrome-trace events with raw device spans (and,
+    optionally, tracer spans as their own plane) into one chrome-trace
+    document (pure function — the testable core).
 
     ``align_device_to_us``: host-clock microsecond timestamp the earliest
     device span is shifted to (start alignment). ``None`` aligns the
     earliest device span with the earliest host event.
+    ``span_epoch_us``: merged-trace epoch pre-profiler tracer spans are
+    aligned to (defaults to ``align_device_to_us``).
     """
     host_events = [dict(e) for e in host_events]
     device_spans = list(device_spans)
@@ -136,25 +200,38 @@ def merge_events(host_events: Iterable[dict], device_spans: Iterable[dict],
                 "args": {"track": "device"},
             })
 
+    if tracer_spans:
+        smeta, sevents = span_chrome_events(
+            tracer_spans,
+            epoch_us=(span_epoch_us if span_epoch_us is not None
+                      else align_device_to_us))
+        meta.extend(smeta)
+        out.extend(sevents)
+
     out.sort(key=lambda e: e.get("ts", 0.0))
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 
 
 def merge_profile(host_trace_path: str, trace_dir: str,
                   out_path: Optional[str] = None,
-                  align_device_to_us: Optional[float] = None) -> Optional[str]:
+                  align_device_to_us: Optional[float] = None,
+                  tracer_spans: Optional[Iterable[dict]] = None
+                  ) -> Optional[str]:
     """Merge a profiler.py chrome trace with the XPlane capture it ran
-    alongside. Returns the merged path, or None when no device capture
-    exists (CPU-only runs without tracing)."""
+    alongside (plus tracer spans, when the caller passes the ring).
+    Returns the merged path, or None when no device capture exists
+    (CPU-only runs without tracing)."""
     try:
         with open(host_trace_path) as f:
             host = json.load(f).get("traceEvents", [])
     except (OSError, ValueError):
         host = []
     spans = device_spans_from_xplane(trace_dir)
-    if not spans and not host:
+    if not spans and not host and not tracer_spans:
         return None
-    doc = merge_events(host, spans, align_device_to_us=align_device_to_us)
+    doc = merge_events(host, spans, align_device_to_us=align_device_to_us,
+                       tracer_spans=tracer_spans,
+                       span_epoch_us=align_device_to_us)
     if out_path is None:
         base = host_trace_path
         if base.endswith(".chrome_trace.json"):

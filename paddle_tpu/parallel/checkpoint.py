@@ -47,7 +47,11 @@ import numpy as np
 
 import jax
 
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs_metrics
+from ..observability import spans as _spans
+
+_gp = _goodput.ledger()
 
 __all__ = [
     "CheckpointError", "CheckpointCorruptError",
@@ -335,10 +339,19 @@ class ElasticCheckpointer:
         async — ``wait()`` joins it)."""
         self._raise_pending()
         t0 = time.perf_counter_ns()
-        flat, _treedef = jax.tree_util.tree_flatten_with_path(state)
-        # synchronous device->host snapshot: the background write then holds
-        # plain numpy buffers that later donations cannot touch
-        leaves = [(_leaf_key(path), _to_host(x)) for path, x in flat]
+        # the synchronous share of a save (flatten + device->host snapshot)
+        # is main-thread wall-clock; the async write overlaps the next
+        # steps and is NOT charged to the ledger
+        span_ctx = None
+        with _gp.timer("checkpoint_save"), \
+                _spans.span("checkpoint/save",
+                            attrs={"step": int(step)}) as _sp:
+            flat, _treedef = jax.tree_util.tree_flatten_with_path(state)
+            # synchronous device->host snapshot: the background write then
+            # holds plain numpy buffers that later donations cannot touch
+            leaves = [(_leaf_key(path), _to_host(x)) for path, x in flat]
+            # the writer thread's spans parent to THIS save span
+            span_ctx = _spans.current_context()
         man: Dict[str, Any] = {
             "format": FORMAT, "step": int(step),
             "time": time.time(),
@@ -353,9 +366,10 @@ class ElasticCheckpointer:
             self._inflight.add(int(step))
         if self._use_async:
             self._ensure_thread()
-            self._queue.put((step, leaves, man, keep, t0))
+            self._queue.put((step, leaves, man, keep, t0, span_ctx))
         else:
-            self._write(step, leaves, man, keep, t0)
+            with _gp.timer("checkpoint_save"):
+                self._write(step, leaves, man, keep, t0, span_ctx)
         return self._path(step)
 
     def _ensure_thread(self):
@@ -376,7 +390,13 @@ class ElasticCheckpointer:
             finally:
                 self._queue.task_done()
 
-    def _write(self, step, leaves, man, keep, t0):
+    def _write(self, step, leaves, man, keep, t0, span_ctx=None):
+        with _spans.default_tracer().context(span_ctx), \
+                _spans.span("checkpoint/write",
+                            attrs={"step": int(step)}):
+            self._write_inner(step, leaves, man, keep, t0)
+
+    def _write_inner(self, step, leaves, man, keep, t0):
         d = self._path(step)
         # a re-save of the same step replaces any (necessarily partial or
         # stale) previous attempt
@@ -411,9 +431,11 @@ class ElasticCheckpointer:
 
     def wait(self) -> None:
         """Join every in-flight async save; re-raises the first writer
-        error."""
+        error.  Blocking here is checkpoint wall-time, so the ledger
+        charges it to ``checkpoint_save``."""
         if self._use_async and self._thread is not None:
-            self._queue.join()
+            with _gp.timer("checkpoint_save"):
+                self._queue.join()
         self._raise_pending()
 
     def _raise_pending(self):
@@ -458,6 +480,12 @@ class ElasticCheckpointer:
                       verify: bool = True) -> Tuple[Dict[str, np.ndarray],
                                                     dict]:
         """Load one committed step as a flat {keypath: array} dict."""
+        with _gp.timer("restore"), _spans.span("checkpoint/restore"):
+            return self._restore_flat_inner(step, verify)
+
+    def _restore_flat_inner(self, step: Optional[int] = None,
+                            verify: bool = True
+                            ) -> Tuple[Dict[str, np.ndarray], dict]:
         self.wait()
         if step is None:
             step = self.latest_step()

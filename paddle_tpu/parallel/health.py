@@ -268,13 +268,19 @@ def progress(site: str) -> None:
 
 @contextlib.contextmanager
 def suspend():
-    """Module-level :meth:`HangWatchdog.suspend` — no-op without a
-    watchdog.  Executor/engine compiles run under this."""
+    """Module-level :meth:`HangWatchdog.suspend` — pauses the deadline
+    clock (no-op without a watchdog).  Executor/engine compiles run under
+    this, so the window doubles as a goodput instrumentation point: its
+    wall time is charged to the ledger's ``compile`` category (nesting
+    with the executor's own compile timer is exclusive-time safe)."""
+    from ..observability import goodput as _goodput
+
     w = _watchdog
     if w is None:
-        yield
+        with _goodput.timer("compile"):
+            yield
         return
-    with w.suspend():
+    with w.suspend(), _goodput.timer("compile"):
         yield
 
 

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs_metrics
 from . import health as _health
 
@@ -212,7 +213,8 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
            hang_deadline_s: Optional[float] = None,
            health_dir: Optional[str] = None,
            straggler_ratio: float = 2.0,
-           straggler_warn_cooldown_s: float = 30.0) -> int:
+           straggler_warn_cooldown_s: float = 30.0,
+           goodput_dir: Optional[str] = None) -> int:
     """Spawn and supervise the worker gang; returns the job's exit code
     (0 on success or clean preemption; otherwise the FIRST failing child's
     exit code, with signal deaths mapped to 128+N).
@@ -222,6 +224,14 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     env contract, write per-rank heartbeats into ``health_dir``, and the
     supervisor polls that dir for stragglers (EWMA step time beyond
     ``straggler_ratio`` x the gang median).
+
+    ``goodput_dir`` (defaults to ``<log_dir>/goodput``) arms gang-wide
+    wall-clock accounting (docs/observability.md): workers export their
+    per-rank goodput ledgers + Prometheus textfiles there via the
+    ``PADDLE_GOODPUT_DIR`` env contract, the supervisor times every
+    failure-detect -> respawn window as ``restart_downtime``, and at job
+    end it merges everything into ``GOODPUT.json`` (gang goodput
+    fraction) plus one merged gang exposition.
     """
     from ..sysconfig import tpu_perf_flags
 
@@ -234,6 +244,10 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
         health_dir = os.path.join(log_dir, "health")
     if health_dir:
         os.makedirs(health_dir, exist_ok=True)
+    if goodput_dir is None and log_dir:
+        goodput_dir = os.path.join(log_dir, "goodput")
+    if goodput_dir:
+        os.makedirs(goodput_dir, exist_ok=True)
     straggler_mon = (_health.StragglerMonitor(
         health_dir, ratio=straggler_ratio,
         warn_cooldown_s=straggler_warn_cooldown_s)
@@ -257,6 +271,10 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                 env[_health.ENV_DEADLINE] = str(float(hang_deadline_s))
             if health_dir:
                 env[_health.ENV_DIR] = health_dir
+            if goodput_dir:
+                # goodput env contract: workers export their per-rank
+                # ledger + exposition here at run-window exit
+                env[_goodput.ENV_DIR] = goodput_dir
             if perf_flags:
                 # comm/compute-overlap preset into each worker's XLA_FLAGS
                 # BEFORE its backend init (no-op unless the worker env
@@ -289,6 +307,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     all_procs: List = []
     exit_code = 0
     restarts = 0
+    restart_downtime_s = 0.0
     backoff = restart_backoff_s
     last_straggler_poll = 0.0
     try:
@@ -314,6 +333,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                     failed = (rank, ret)
             if failed is not None:
                 rank, ret = failed
+                t_fail = time.monotonic()
                 code = _exit_code(ret)
                 cause = _restart_cause(ret)
                 sys.stderr.write(
@@ -333,6 +353,13 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                             out.close()
                     procs = spawn_gang(restarts)
                     all_procs.extend(procs)
+                    # failure detection -> gang respawned: the whole gang
+                    # was idle for this window (goodput restart_downtime,
+                    # attributed at the job level — a SIGKILL'd worker
+                    # cannot report its own death)
+                    dt = time.monotonic() - t_fail
+                    restart_downtime_s += dt
+                    _goodput.attribute("restart_downtime", dt)
                     continue
                 exit_code = code
                 break
@@ -361,6 +388,19 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                 p.wait()
             if out and not out.closed:
                 out.close()
+    if goodput_dir:
+        # gang aggregation: merge the per-rank ledgers + expositions the
+        # workers exported, charge the supervisor's restart-downtime
+        # windows, and write GOODPUT.json with the gang goodput fraction
+        try:
+            path = _goodput.write_gang_report(
+                goodput_dir, restart_downtime_s=restart_downtime_s,
+                nranks=len(endpoints),
+                extra={"exit_code": exit_code, "restarts": restarts})
+            if path:
+                sys.stderr.write(f"launch: gang goodput report: {path}\n")
+        except Exception as e:   # accounting must never fail the job
+            sys.stderr.write(f"launch: goodput aggregation failed: {e}\n")
     return exit_code
 
 
@@ -390,6 +430,11 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
     ap.add_argument("--straggler_ratio", type=float, default=2.0,
                     help="flag ranks whose step-time EWMA exceeds this "
                          "multiple of the gang median")
+    ap.add_argument("--goodput_dir", default=None,
+                    help="shared dir for per-rank goodput ledgers; the "
+                         "supervisor merges them (plus its restart-"
+                         "downtime windows) into GOODPUT.json (default: "
+                         "<log_dir>/goodput)")
     ap.add_argument("--no_perf_flags", action="store_true",
                     help="skip the sysconfig.tpu_perf_flags XLA preset")
     ap.add_argument("training_script")
@@ -404,7 +449,8 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
                     grace_period_s=args.grace_period,
                     hang_deadline_s=args.hang_deadline,
                     health_dir=args.health_dir,
-                    straggler_ratio=args.straggler_ratio))
+                    straggler_ratio=args.straggler_ratio,
+                    goodput_dir=args.goodput_dir))
 
 
 if __name__ == "__main__":

@@ -734,15 +734,19 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                            "mesh": {a: int(s) for a, s in
                                     zip(pcfg.axis_names,
                                         (pcfg.dp, pcfg.pp, pcfg.tp))}})
+        from ..observability import goodput as _goodput
+
         if aot["exec"] is not None:
             try:
-                return aot["exec"](params, opt_state, tokens, labels)
+                with _goodput.timer("productive_step"):
+                    return aot["exec"](params, opt_state, tokens, labels)
             except TypeError:
                 # arg-signature drift (raised before execution, nothing
                 # donated yet): revert to jit dispatch for good
                 aot["exec"] = None
                 aot["failed"] = True
-        return step(params, opt_state, tokens, labels)
+        with _goodput.timer("productive_step"):
+            return step(params, opt_state, tokens, labels)
 
     return step_with_report
 

@@ -210,14 +210,19 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         except Exception as e:  # attribution is optional, like device trace
             print(f"[profiler] cost attribution skipped: "
                   f"{type(e).__name__}: {e}")
-    # merged host+device chrome trace (one Perfetto load, shared clock)
+    # merged host+device chrome trace (one Perfetto load, shared clock).
+    # The span-tracer ring rides along as its own plane: spans share the
+    # host perf_counter clock, and spans opened BEFORE start_profiler are
+    # aligned to the trace epoch inside trace_merge (not dropped).
     if _trace_dir:
         try:
+            from .observability import spans as _spans
             from .observability import trace_merge
 
             merged = trace_merge.merge_profile(
                 trace_path, _trace_dir,
-                align_device_to_us=_trace_host_t0_us)
+                align_device_to_us=_trace_host_t0_us,
+                tracer_spans=_spans.default_tracer().spans())
             if merged:
                 print(f"[profiler] merged host+device trace: {merged}")
         except Exception as e:

@@ -59,8 +59,11 @@ def prefetch_to_device(batches, size: int = 2):
     """
     import jax
 
+    from .observability import goodput as _goodput
     from .observability import metrics as _obs_metrics
+    from .observability import spans as _spans
 
+    _gp = _goodput.ledger()
     _reg = _obs_metrics.default_registry()
     _g_depth = _reg.gauge(
         "paddle_prefetch_queue_depth",
@@ -95,26 +98,41 @@ def prefetch_to_device(batches, size: int = 2):
                 continue
         return False
 
+    # span-context propagation: the producer thread's staging spans parent
+    # to whatever span the training loop opened around this call, instead
+    # of orphaning on a fresh trace (ISSUE 10 satellite)
+    _ctx = _spans.current_context()
+    _tracer = _spans.default_tracer()
+
     def produce():
         try:
-            for b in batches:
-                if not put((False, to_device(b))):
-                    return
+            with _tracer.context(_ctx):
+                for b in batches:
+                    with _tracer.span("input/stage_batch"):
+                        staged = to_device(b)
+                    if not put((False, staged)):
+                        return
         except BaseException as e:
             put((True, e))
         else:
             put((False, _end))
 
-    t = threading.Thread(target=produce, daemon=True,
-                         name="device_prefetch")
-    t.start()
+    # pipeline spin-up (thread start) is input-side wall time: charge it
+    # to input_stall so the first batch's latency is attributed, not lost
+    with _gp.timer("input_stall"):
+        t = threading.Thread(target=produce, daemon=True,
+                             name="device_prefetch")
+        t.start()
     try:
         import time as _time
 
         while True:
             _g_depth.set(q.qsize())
             t0 = _time.perf_counter_ns()
-            is_err, item = q.get()
+            # the consumer's queue wait is the run's input stall: the
+            # device had nothing staged to chew on
+            with _gp.timer("input_stall"):
+                is_err, item = q.get()
             _c_stall.inc((_time.perf_counter_ns() - t0) / 1e6)
             if is_err:
                 raise item

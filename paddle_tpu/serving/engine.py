@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from ..models import gpt as gpt_mod
 from ..models.gpt import GPTConfig
 from ..observability import program_report as _prep
+from ..observability import spans as _spans
 from ..ops.decode_attention import (cache_update, decode_attention,
                                     prefill_attention)
 from . import metrics as smetrics
@@ -358,8 +359,13 @@ class DecodeEngine:
             self._poison_on_donation_failure(f"prefill_b{bucket}", e)
             self.cache.free(slot)
             raise
-        smetrics.m_prefill_ms.observe(
-            (time.perf_counter_ns() - t0) / 1e6)
+        t1 = time.perf_counter_ns()
+        smetrics.m_prefill_ms.observe((t1 - t0) / 1e6)
+        # inherits the scheduler's per-request span context (the admit
+        # path wraps this call in the request's trace)
+        _spans.record("serve/prefill", t0, t1 - t0,
+                      attrs={"bucket": bucket, "prompt_len": n,
+                             "slot": slot})
         self.cache.k, self.cache.v = ck, cv
         return slot, logits
 

@@ -12,7 +12,8 @@ from ..observability import metrics as _obs
 __all__ = [
     "m_requests", "m_queue_depth", "m_active", "m_occupancy",
     "m_ttft_ms", "m_tpot_ms", "m_tokens", "m_tokens_per_s",
-    "m_prefill_ms", "m_decode_ms", "m_evictions", "request_code",
+    "m_prefill_ms", "m_decode_ms", "m_evictions", "m_queue_wait_ms",
+    "request_code",
 ]
 
 _REG = _obs.default_registry()
@@ -54,6 +55,11 @@ m_decode_ms = _REG.histogram(
 m_evictions = _REG.counter(
     "paddle_serve_slot_evictions_total",
     "Decode-slot evictions by reason", ("reason",))
+# queue wait is the request's pre-TTFT tax: submit -> decode-slot
+# admission (the span tracer stamps the same window as serve/queue_wait)
+m_queue_wait_ms = _REG.histogram(
+    "paddle_serve_queue_wait_ms",
+    "Admission-queue wait (submit -> prefill start), ms")
 
 
 def request_code(code: int) -> None:

@@ -25,6 +25,8 @@ from typing import Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import goodput as _goodput
+from ..observability import spans as _spans
 from . import metrics as smetrics
 from .engine import DecodeEngine, PromptTooLongError
 from .kv_cache import CacheFullError
@@ -58,6 +60,14 @@ class Request:
     error: Optional[str] = None
     finished: threading.Event = dataclasses.field(
         default_factory=threading.Event)
+    # span identity (docs/observability.md): every lifecycle span of this
+    # request — queue wait, prefill, each decode tick, eviction — carries
+    # trace_id, parented under root_span ("serve/request"), so a slow p99
+    # walks straight back to the tick that caused it
+    trace_id: int = dataclasses.field(default_factory=_spans.gen_id)
+    root_span: int = dataclasses.field(default_factory=_spans.gen_id)
+    submit_ns: int = dataclasses.field(
+        default_factory=time.perf_counter_ns)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.finished.wait(timeout)
@@ -156,12 +166,15 @@ class Scheduler:
         with self._lock:
             self._draining = True
         end = time.monotonic() + timeout_s
-        while time.monotonic() < end:
-            with self._lock:
-                idle = not self._queue and not self._active
-            if idle:
-                return True
-            self.step()
+        # drain wall time is its own goodput category: the engine is
+        # finishing old work but admitting nothing
+        with _goodput.timer("drain"):
+            while time.monotonic() < end:
+                with self._lock:
+                    idle = not self._queue and not self._active
+                if idle:
+                    return True
+                self.step()
         return False
 
     def abort_all(self, reason: str) -> int:
@@ -221,8 +234,13 @@ class Scheduler:
                     break
                 req = self._queue.popleft()
                 smetrics.m_queue_depth.set(len(self._queue))
+            t_admit = time.perf_counter_ns()
             try:
-                slot, logits = self.engine.start_sequence(req.prompt)
+                # prefill runs inside the request's span context so the
+                # engine's serve/prefill span parents under its root
+                with _spans.default_tracer().context(
+                        (req.trace_id, req.root_span)):
+                    slot, logits = self.engine.start_sequence(req.prompt)
             except CacheFullError:       # raced headroom — requeue in order
                 with self._lock:
                     self._queue.appendleft(req)
@@ -230,6 +248,12 @@ class Scheduler:
             except Exception as e:
                 self._finish(req, FAILED, f"{type(e).__name__}: {e}")
                 continue
+            # queue wait: submit -> prefill start (span + histogram)
+            smetrics.m_queue_wait_ms.observe(
+                (t_admit - req.submit_ns) / 1e6)
+            _spans.record("serve/queue_wait", req.submit_ns,
+                          t_admit - req.submit_ns,
+                          trace=req.trace_id, parent=req.root_span)
             first = int(np.argmax(logits))
             t = time.monotonic()
             req.state = ACTIVE
@@ -262,10 +286,21 @@ class Scheduler:
         if not self._active:
             return False
         feed = {slot: self._next_token[slot] for slot in self._active}
+        t_tick0 = time.perf_counter_ns()
         out = self.engine.decode_step(feed)
+        tick_ns = time.perf_counter_ns() - t_tick0
         t = time.monotonic()
+        trace_on = _spans.tracing_enabled()
         for slot, logits in out.items():
             req = self._active[slot]
+            if trace_on:
+                # per-tick decode span on the request's trace: the whole
+                # batch shares one executable call, so every rider gets
+                # the tick's wall time (batch size in the attrs)
+                _spans.record("serve/decode_tick", t_tick0, tick_ns,
+                              trace=req.trace_id, parent=req.root_span,
+                              attrs={"batch": len(out),
+                                     "token_index": len(req.tokens)})
             tok = int(np.argmax(logits))
             req.tokens.append(tok)
             if len(req.token_times) >= 1:
@@ -293,9 +328,13 @@ class Scheduler:
                reason: Optional[str] = None) -> None:
         req = self._active.pop(slot)
         self._next_token.pop(slot, None)
+        t0 = time.perf_counter_ns()
         self.engine.free_sequence(slot)
-        smetrics.m_evictions.labels(
-            reason or self._EVICT_REASONS.get(state, state)).inc()
+        reason = reason or self._EVICT_REASONS.get(state, state)
+        smetrics.m_evictions.labels(reason).inc()
+        _spans.record("serve/evict", t0, time.perf_counter_ns() - t0,
+                      trace=req.trace_id, parent=req.root_span,
+                      attrs={"reason": reason, "slot": slot})
         self._finish(req, state, detail)
 
     def _finish(self, req: Request, state: str,
@@ -303,4 +342,12 @@ class Scheduler:
         req.state = state
         if detail and state in (EXPIRED, FAILED):
             req.error = detail
+        # close the request's root span: submit -> terminal state.  The
+        # explicit span_id is what the lifecycle children parented to.
+        end = time.perf_counter_ns()
+        _spans.record("serve/request", req.submit_ns,
+                      end - req.submit_ns, trace=req.trace_id,
+                      parent=None, span_id=req.root_span,
+                      attrs={"state": state, "tokens": len(req.tokens),
+                             "request_id": req.id})
         req.finished.set()

@@ -314,6 +314,13 @@ class FrontDoor:
             out["inputs"] = self.predictor.get_input_names()
             out["outputs"] = self.predictor.get_output_names()
         if self.scheduler is not None:
+            # per-span-name percentile rollups (queue wait, prefill,
+            # decode ticks, evictions, whole requests) off the tracer ring
+            from ..observability import spans as _ospans
+
+            out["span_rollups_ms"] = {
+                k: v for k, v in _ospans.default_tracer().summary().items()
+                if k.startswith("serve/")}
             out["queue_depth"] = self.scheduler.queue_depth()
             out["active"] = len(self.scheduler._active)
             out["max_batch"] = self.scheduler.engine.ecfg.max_batch
@@ -332,6 +339,12 @@ class FrontDoor:
     def drain(self, timeout_s: float = 60.0) -> bool:
         """Refuse new work, finish what is in flight, then stop. Returns
         True when everything completed inside the timeout."""
+        from ..observability import goodput as _goodput
+
+        with _goodput.timer("drain"):
+            return self._drain_inner(timeout_s)
+
+    def _drain_inner(self, timeout_s: float) -> bool:
         self._draining = True
         ok = True
         if self.scheduler is not None:

@@ -124,6 +124,7 @@ def worker(args):
     import jax
 
     from paddle_tpu.models import gpt as G
+    from paddle_tpu.observability import goodput
     from paddle_tpu.parallel import health
     from paddle_tpu.parallel import parallelize as PZ
     from paddle_tpu.parallel.checkpoint import (ElasticCheckpointer,
@@ -131,6 +132,14 @@ def worker(args):
     from paddle_tpu.parallel.launch import install_preemption_handler
 
     preempt = install_preemption_handler()
+    # goodput run window (docs/observability.md): the ledger attributes
+    # this incarnation's wall-clock; at window exit the per-rank report
+    # exports to PADDLE_GOODPUT_DIR (exported by the supervisor), which
+    # merges it with its restart-downtime windows into GOODPUT.json.
+    # A SIGKILL'd incarnation never exports — exactly right: its lost
+    # wall shows up as the supervisor's restart_downtime, not silence.
+    led = goodput.ledger()
+    led.start_window()
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     # a multi-rank gang gets per-rank result/checkpoint paths (the
@@ -140,20 +149,25 @@ def worker(args):
                 if trainers > 1 else args.ckpt_dir)
     os.makedirs(ckpt_dir, exist_ok=True)
     base_lr = 1e-2
-    cfg = G.GPT_TINY.scaled(num_layers=args.layers)
-    pcfg = PZ.ParallelConfig(dp=args.dp, pp=1, tp=1, microbatches=1)
-    mesh = PZ.build_mesh(pcfg)
-    layout, repl = PZ.rs_param_layout(cfg, pcfg)
-    params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh,
-                                  grad_reduce="reduce_scatter")
-    step_fn = PZ.make_train_step(cfg, pcfg, mesh, lr=base_lr,
-                                 grad_reduce="reduce_scatter",
-                                 skip_nonfinite=True)
-    # divergence injection: a huge-lr step stands in for the real thing
-    # (lr bug, bad data segment) — the guard must catch it from the loss
-    bad_step_fn = (PZ.make_train_step(cfg, pcfg, mesh, lr=args.diverge_lr,
-                                      grad_reduce="reduce_scatter")
-                   if args.diverge_at else None)
+    # model/mesh bring-up is trace+compile+device-placement work: charge
+    # it to the ledger's compile category so a restarted incarnation's
+    # init cost is attributed, not `other`
+    with led.timer("compile"):
+        cfg = G.GPT_TINY.scaled(num_layers=args.layers)
+        pcfg = PZ.ParallelConfig(dp=args.dp, pp=1, tp=1, microbatches=1)
+        mesh = PZ.build_mesh(pcfg)
+        layout, repl = PZ.rs_param_layout(cfg, pcfg)
+        params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg,
+                                      mesh, grad_reduce="reduce_scatter")
+        step_fn = PZ.make_train_step(cfg, pcfg, mesh, lr=base_lr,
+                                     grad_reduce="reduce_scatter",
+                                     skip_nonfinite=True)
+        # divergence injection: a huge-lr step stands in for the real
+        # thing (lr bug, bad data segment) — the guard must catch it from
+        # the loss
+        bad_step_fn = (PZ.make_train_step(
+            cfg, pcfg, mesh, lr=args.diverge_lr,
+            grad_reduce="reduce_scatter") if args.diverge_at else None)
     guard = (health.DivergenceGuard(health.GuardrailConfig(
         spike_mult=2.0, min_history=2, max_consecutive_bad=args.guard_k,
         lr_cooldown=0.5, max_rollbacks=2))
@@ -194,16 +208,24 @@ def worker(args):
                  if hb_dir else None)
 
     def save(step_no):
-        ck.save(step_no, {"params": params, "opt": opt},
-                mesh={"dp": args.dp, "pp": 1, "tp": 1},
-                layout=layout, layout_repl=repl,
-                data_state={"epoch": 0, "offset": step_no},
-                extra={"moment_leaf_crcs":
-                       _moment_leaf_crcs(opt["m"], layout, repl)})
-        # commit synchronously: the harness injects faults deterministically
-        # against "step N is committed" (async overlap is covered by
-        # tests/test_elastic.py and the executor path)
-        ck.wait()
+        # the whole helper (crc computation included) is checkpoint wall
+        with led.timer("checkpoint_save"):
+            ck.save(step_no, {"params": params, "opt": opt},
+                    mesh={"dp": args.dp, "pp": 1, "tp": 1},
+                    layout=layout, layout_repl=repl,
+                    data_state={"epoch": 0, "offset": step_no},
+                    extra={"moment_leaf_crcs":
+                           _moment_leaf_crcs(opt["m"], layout, repl)})
+            # commit synchronously: the harness injects faults
+            # deterministically against "step N is committed" (async
+            # overlap is covered by tests/test_elastic.py + the executor)
+            ck.wait()
+
+    def _export_goodput(**extra):
+        try:
+            goodput.maybe_export(led.end_window(extra=extra))
+        except Exception:
+            pass   # accounting must never fail the worker
 
     loss = None
     trajectory = []
@@ -214,6 +236,7 @@ def worker(args):
             _log(f"worker preempted at step {step - 1}: checkpoint + exit 0")
             save(step - 1)
             ck.close()
+            _export_goodput(exit="preempt", final_step=step - 1)
             sys.exit(0)
         if args.straggle_ms and rank == args.straggle_rank:
             time.sleep(args.straggle_ms / 1000.0)
@@ -265,6 +288,7 @@ def worker(args):
                 # handler has set the flag; honor the grace contract now
                 save(step)
                 ck.close()
+                _export_goodput(exit="sigterm", final_step=step)
                 sys.exit(0)
             time.sleep(30)  # SIGKILL lands before this returns
         if step % args.interval == 0 and verdict == "ok":
@@ -290,6 +314,7 @@ def worker(args):
             rollback_restored_from=rollback_restored_from)
     save(args.steps)
     ck.close()
+    _export_goodput(exit="complete", final_step=args.steps)
     tmp = result_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(result, f)
@@ -485,11 +510,22 @@ def harness(smoke, out_path):
     _log(f"baseline loss {baseline['final_loss']}")
 
     # --- SIGKILL mid-step: supervisor restart recovers -------------------
+    # goodput_dir arms the ISSUE 10 wall-clock attribution: the killed
+    # incarnation's death must show up as nonzero restart_downtime in the
+    # supervisor-written GOODPUT.json (not silence), with the gang
+    # goodput fraction computed from the surviving rank reports
+    gp_dir = os.path.join(work, "sigkill_goodput")
     ns = run("sigkill_midstep", die_at=die_at, die_sig="KILL",
              once_marker=os.path.join(work, "sigkill.marker"))
-    rc, res = _run_job(ns, max_restarts=2)
+    rc, res = _run_job(ns, max_restarts=2,
+                       launch_kw=dict(goodput_dir=gp_dir))
     inc = _incarnations(ns["ckpt_dir"])
     expect_restore = (die_at // base["interval"]) * base["interval"]
+    goodput_json = os.path.join(gp_dir, "GOODPUT.json")
+    gp = None
+    if os.path.exists(goodput_json):
+        with open(goodput_json) as f:
+            gp = json.load(f)
     s = {
         "rc": rc, "result": res,
         "incarnations": len(inc),
@@ -500,13 +536,23 @@ def harness(smoke, out_path):
                                  baseline["final_loss"]),
         "params_match": bool(res) and
             res["params_crc"] == baseline["params_crc"],
+        "goodput": gp,
     }
+    s["restart_downtime_attributed"] = bool(
+        gp and gp["categories"].get("restart_downtime", 0) > 0)
+    s["gang_goodput_fraction"] = gp and gp.get("gang_goodput_fraction")
     s["pass"] = (rc == 0 and s["supervisor_restarts"] >= 1
                  and inc and inc[-1]["restored_from"] == expect_restore
-                 and s["match_baseline"] == "bit_exact" and s["params_match"])
+                 and s["match_baseline"] == "bit_exact" and s["params_match"]
+                 and s["restart_downtime_attributed"]
+                 and s["gang_goodput_fraction"] is not None
+                 and 0 < s["gang_goodput_fraction"] <= 1)
     scenarios["sigkill_midstep"] = s
     ok &= s["pass"]
-    _log(f"sigkill_midstep: {s['pass']} ({s['match_baseline']})")
+    _log(f"sigkill_midstep: {s['pass']} ({s['match_baseline']}, "
+         f"restart_downtime="
+         f"{gp and gp['categories'].get('restart_downtime')}s, "
+         f"gang_goodput={s['gang_goodput_fraction']})")
 
     # --- corrupt shard + planted partial checkpoint ----------------------
     # reuse a completed run's store: corrupt the NEWEST committed step and

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Gang goodput report: merge per-rank goodput ledgers into GOODPUT.json.
+
+``parallel.launch`` runs this aggregation automatically at job end (it
+also owns the restart-downtime windows only a supervisor can see); this
+CLI re-runs it standalone — after the fact, over a copied-out goodput
+dir, or for a single-process run that exported its ledger via
+``PADDLE_GOODPUT_DIR``.
+
+  python tools/goodput_report.py --dir LOGDIR/goodput \\
+      [--out GOODPUT.json] [--restart-downtime S] [--nranks N]
+
+The report (schema in docs/observability.md "Goodput & tracing"):
+
+  {
+    "nranks": 8, "wall_s": ...,
+    "categories": {"productive_step": ..., "compile": ...,
+                   "restart_downtime": ..., "other": ...},
+    "gang_goodput_fraction": productive / attributed seconds,
+    "unaccounted_fraction": other / attributed seconds,
+    ...
+  }
+
+Exit status: 1 when no rank ever reported, or when the merged ledger
+leaves more than --max-unaccounted (default 5%) of wall-clock in
+``other`` — an instrumentation gap, not a measurement.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", required=True,
+                    help="goodput dir holding goodput.rank*.json")
+    ap.add_argument("--out", default=None,
+                    help="output path (default: <dir>/GOODPUT.json)")
+    ap.add_argument("--restart-downtime", type=float, default=0.0,
+                    help="supervisor-observed restart downtime seconds "
+                         "(charged once per rank)")
+    ap.add_argument("--nranks", type=int, default=None)
+    ap.add_argument("--max-unaccounted", type=float, default=0.05,
+                    help="fail when other/total exceeds this fraction")
+    args = ap.parse_args()
+
+    from paddle_tpu.observability import goodput
+
+    path = goodput.write_gang_report(
+        args.dir, restart_downtime_s=args.restart_downtime,
+        nranks=args.nranks, out_path=args.out)
+    if path is None:
+        print(f"[goodput_report] no rank reports under {args.dir}",
+              file=sys.stderr)
+        return 1
+    with open(path) as f:
+        gang = json.load(f)
+    print(json.dumps(gang, indent=1))
+    unacc = gang.get("unaccounted_fraction")
+    if unacc is not None and unacc > args.max_unaccounted:
+        print(f"[goodput_report] FAIL: {unacc:.1%} of wall-clock "
+              f"unaccounted (gate {args.max_unaccounted:.0%})",
+              file=sys.stderr)
+        return 1
+    print(f"[goodput_report] wrote {path} "
+          f"(gang goodput {gang.get('gang_goodput_fraction')})",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,14 @@ then asserts:
     compile wall-ms;
   * the Prometheus textfile parses line-by-line against the exposition
     grammar (the same regex validator tests/test_observability.py uses)
-    and carries the paddle_program_* / live-HBM gauges.
+    and carries the paddle_program_* / live-HBM gauges;
+  * the goodput ledger (ISSUE 10) attributes >= 99% of the monitored
+    run's wall-clock (``other`` < 1%), sums to wall-clock, exports every
+    category of ``paddle_goodput_seconds_total``, and every monitor row
+    carries the per-step ``goodput_ms`` breakdown;
+  * the serving smoke leaves complete request traces (root span +
+    queue-wait/prefill/decode-tick/evict children, no orphans, no
+    cross-request leakage) and the queue-wait histogram.
 
 Wired into tier-1 as tests/test_metrics_check.py (``-m 'not slow'``), so
 the telemetry path is exercised end-to-end on every run. Standalone:
@@ -145,6 +152,37 @@ def _run_check_inner(out_dir: str) -> dict:
                            checkpoint_dir=ckpt_dir, checkpoint_interval=2)
     mon.close()
 
+    # --- goodput ledger (docs/observability.md, ISSUE 10) ---------------
+    # the run window the train loop just closed must attribute >= 99% of
+    # its wall-clock (unaccounted `other` < 1%), the category taxonomy
+    # must be fully present with finite values, and the ledger must sum
+    # to the wall-clock it claims (exclusive accounting is exact)
+    from paddle_tpu.observability import goodput
+
+    gp_window = goodput.ledger().last_window
+    assert gp_window is not None, "train loop closed no goodput window"
+    gp_cats = gp_window["categories"]
+    assert set(gp_cats) == set(goodput.CATEGORIES), gp_cats
+    for c, v in gp_cats.items():
+        assert isinstance(v, (int, float)) and math.isfinite(v) \
+            and v >= 0, f"goodput {c}={v!r}"
+    assert abs(sum(gp_cats.values()) - gp_window["wall_s"]) \
+        <= max(0.01 * gp_window["wall_s"], 2e-3), gp_window
+    assert gp_window["unaccounted_fraction"] < 0.01, \
+        f"goodput ledger left {gp_window['unaccounted_fraction']:.2%} " \
+        f"of wall-clock unaccounted (gate < 1%): {gp_window}"
+    assert gp_window["categories"]["productive_step"] > 0, gp_window
+    assert gp_window["categories"]["compile"] >= 0, gp_window
+    assert gp_window["categories"]["checkpoint_save"] > 0, gp_window
+    snap_gp = default_registry().snapshot()
+    gp_series = {s["labels"][0]: s["value"] for s in
+                 snap_gp["paddle_goodput_seconds_total"]["series"]}
+    for c in goodput.CATEGORIES:
+        assert c in gp_series and math.isfinite(gp_series[c]), \
+            f"goodput category {c!r} missing from the counter family"
+    assert snap_gp["paddle_goodput_wall_seconds_total"]["series"][0][
+        "value"] > 0
+
     # --- JSONL: >= 5 steps, required keys, finite values ---------------
     records = [json.loads(ln) for ln in open(jsonl_path)]
     assert len(records) >= 5, f"expected >=5 monitored steps, got " \
@@ -164,6 +202,13 @@ def _run_check_inner(out_dir: str) -> dict:
         assert "live_buffer_bytes" in rec, f"no live_buffer_bytes: {rec}"
         assert isinstance(rec["live_buffer_bytes"], int) \
             and rec["live_buffer_bytes"] > 0, rec
+        # per-row goodput breakdown (ISSUE 10 satellite): ms per ledger
+        # category since the previous row
+        assert isinstance(rec.get("goodput_ms"), dict), rec
+        for c, v in rec["goodput_ms"].items():
+            assert isinstance(v, (int, float)) and math.isfinite(v) \
+                and v >= 0, f"goodput_ms[{c}]={v!r} in {rec}"
+        assert "productive_step" in rec["goodput_ms"], rec
 
     # --- registry: the executor self-reported --------------------------
     snap = default_registry().snapshot()
@@ -380,6 +425,36 @@ def _run_check_inner(out_dir: str) -> dict:
         snap["paddle_serve_tokens_per_s"]["series"][0]["value"])
     assert snap["paddle_serve_tokens_total"]["series"][0]["value"] >= 80
 
+    # request spans (ISSUE 10): every request's life is a trace — root
+    # serve/request span + queue-wait/prefill/decode-tick children with
+    # no orphans and no cross-request leakage
+    from paddle_tpu.observability import spans as ospans
+
+    ring = ospans.default_tracer().spans()
+    roots = [s for s in ring if s["name"] == "serve/request"]
+    assert len(roots) >= 20, f"only {len(roots)} serve/request spans"
+    by_trace = {}
+    for s in ring:
+        by_trace.setdefault(s["trace"], []).append(s)
+    for root in roots[-20:]:
+        fam = by_trace[root["trace"]]
+        names = {s["name"] for s in fam}
+        assert {"serve/queue_wait", "serve/prefill",
+                "serve/decode_tick", "serve/evict"} <= names, names
+        for s in fam:
+            if s["name"] == "serve/request":
+                continue
+            # children parent to THIS request's root — nothing leaks in
+            # from another request, nothing is orphaned
+            assert s["parent"] in {root["span"], *(
+                x["span"] for x in fam)}, s
+    rollup = ospans.default_tracer().summary()
+    assert rollup["serve/request"]["count"] >= 20, rollup
+    assert rollup["serve/prefill"]["p99_ms"] >= 0
+    queue_wait = snap["paddle_serve_queue_wait_ms"]["series"][0]
+    assert queue_wait["count"] >= 20 and math.isfinite(
+        queue_wait["sum"]), queue_wait
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -416,9 +491,15 @@ def _run_check_inner(out_dir: str) -> dict:
     for name in ("paddle_serve_requests_total", "paddle_serve_queue_depth",
                  "paddle_serve_batch_occupancy", "paddle_serve_ttft_ms",
                  "paddle_serve_tpot_ms", "paddle_serve_tokens_per_s",
-                 "paddle_serve_prefill_ms", "paddle_serve_decode_step_ms"):
+                 "paddle_serve_prefill_ms", "paddle_serve_decode_step_ms",
+                 "paddle_serve_queue_wait_ms"):
         assert name in prom_text, f"{name} missing from exposition"
     assert 'paddle_serve_requests_total{code="200"}' in prom_text
+    # goodput families (docs/observability.md): every category present
+    for c in goodput.CATEGORIES:
+        assert f'paddle_goodput_seconds_total{{category="{c}"}}' \
+            in prom_text, f"goodput category {c} missing from exposition"
+    assert "paddle_goodput_wall_seconds_total" in prom_text
 
     return {"steps": len(records), "prom_samples": samples,
             "serve_requests": int(serve_200.get(("200",), 0)),
@@ -428,6 +509,9 @@ def _run_check_inner(out_dir: str) -> dict:
             "checkpoint_bytes": ckpt_bytes,
             "lint_findings": lint_after,
             "guardrail_skips": skips_delta,
+            "goodput_window": gp_window,
+            "serve_span_rollups": {k: v for k, v in rollup.items()
+                                   if k.startswith("serve/")},
             "jsonl": jsonl_path, "prom": prom_path,
             "last_record": records[-1]}
 
