@@ -432,6 +432,14 @@ def worker(use_flash: bool):
     from paddle_tpu.parallel import health as health_mod
 
     health_mod.maybe_install_from_env()
+    # --stream-input: feed the measured loop from the fault-tolerant
+    # sharded streaming engine (docs/data.md) instead of one fixed tensor
+    # pair — token shards are written once, read+decoded by the stream's
+    # worker pool, and the result's detail gains the goodput ledger's
+    # input_stall share so "is the input engine keeping up with the step"
+    # is a measured number
+    stream_input = "--stream-input" in sys.argv
+    stream_stats = {}
 
     def measure(tag, cfg, batch, T, steps):
         """Compile + run one config; returns (tokens/s, mfu, loss, params).
@@ -469,6 +477,54 @@ def worker(use_flash: bool):
              f"{time.perf_counter() - tc:.1f}s loss={loss0:.4f}")
         n_params = G.num_params(params)
         flops_tok = G.train_flops_per_token(cfg, n_params, T)
+        stream_iter = None
+        if stream_input:
+            import tempfile as _tf
+
+            from paddle_tpu.dataset import streaming as STR
+            from paddle_tpu.observability import goodput as _gp_mod
+
+            sdir = _tf.mkdtemp(prefix="bench_stream_")
+            n_shards = 4
+            per_shard = (steps * batch + n_shards - 1) // n_shards
+            paths, rec_no = [], 0
+            for si in range(n_shards):
+                p = os.path.join(sdir, f"shard-{si}")
+                with open(p, "w") as f:
+                    for _ in range(per_shard):
+                        r = np.random.default_rng(rec_no)
+                        row = np.concatenate([
+                            r.integers(0, cfg.vocab_size, T),
+                            r.integers(0, cfg.vocab_size, T)])
+                        f.write(" ".join(map(str, row)) + "\n")
+                        rec_no += 1
+                paths.append(p)
+
+            def _decode(raw):
+                v = np.array(raw.split(), dtype=np.int64)
+                if v.size != 2 * T:
+                    raise ValueError(f"expected {2 * T} tokens, got {v.size}")
+                return v[:T].astype(np.int32), v[T:].astype(np.int32)
+
+            bench_stream = STR.ShardedStream(
+                paths, _decode, STR.StreamConfig(
+                    batch_size=batch, drop_last=True, num_workers=2))
+            stream_iter = bench_stream.batches()
+            stall0 = _gp_mod.ledger().category_seconds("input_stall")
+            _log(f"worker[{tag}]: stream-input lane — {rec_no} records in "
+                 f"{n_shards} shards under {sdir}")
+
+        def next_batch():
+            nonlocal stream_iter
+            if stream_iter is None:
+                return tokens, labels
+            try:
+                recs = next(stream_iter)
+            except StopIteration:    # epoch boundary: keep streaming
+                stream_iter = bench_stream.batches()
+                recs = next(stream_iter)
+            return (np.stack([x[0] for x in recs])[None],
+                    np.stack([x[1] for x in recs])[None])
         ck = start_step = None
         if ckpt_dir:
             from paddle_tpu.parallel.checkpoint import (ElasticCheckpointer,
@@ -514,8 +570,9 @@ def worker(use_flash: bool):
         if mon is not None:
             for i in range(start0, steps):
                 with mon.step() as s:
-                    params, opt, loss, gnorm = step(params, opt, tokens,
-                                                    labels)
+                    toks_i, labs_i = next_batch()
+                    params, opt, loss, gnorm = step(params, opt, toks_i,
+                                                    labs_i)
                     s.dispatched()
                     s.observe(loss=loss, grad_norm=gnorm)
                 maybe_ckpt(i)
@@ -523,10 +580,22 @@ def worker(use_flash: bool):
             mon.close()
         else:
             for i in range(start0, steps):
-                params, opt, loss, _ = step(params, opt, tokens, labels)
+                toks_i, labs_i = next_batch()
+                params, opt, loss, _ = step(params, opt, toks_i, labs_i)
                 maybe_ckpt(i)
             loss_v = float(loss)  # forces the whole chain
         dt = time.perf_counter() - t0
+        if stream_input:
+            stall_s = _gp_mod.ledger().category_seconds("input_stall") \
+                - stall0
+            stream_stats.update(
+                records=int(bench_stream.state.records),
+                input_stall_s=round(stall_s, 4),
+                input_stall_fraction=round(stall_s / max(dt, 1e-9), 4),
+                retries=int(bench_stream.retries),
+                quarantined=int(bench_stream.quarantined))
+            _log(f"worker[{tag}]: stream-input stall {stall_s:.3f}s "
+                 f"({stream_stats['input_stall_fraction']:.1%} of loop)")
         if hb is not None:
             hb.flush()
         if ck is not None:
@@ -601,6 +670,8 @@ def worker(use_flash: bool):
         "tokens_per_s": round(tokens_per_s, 2),
         "mfu": round(mfu, 4),
     }
+    if stream_stats:
+        detail["stream_input"] = stream_stats
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s, 2),

@@ -29,7 +29,8 @@ import numpy as np
 from ..framework.core import convert_dtype
 from ..framework.program import Variable
 
-__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset", "QueueDataset"]
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset", "QueueDataset",
+           "StreamingDataset"]
 
 
 # ---------------------------------------------------------------------------
@@ -320,6 +321,13 @@ class DatasetFactory:
             return InMemoryDataset()
         if datafeed_class == "QueueDataset":
             return QueueDataset()
+        if datafeed_class == "StreamingDataset":
+            # fault-tolerant sharded streaming (docs/data.md): retry/
+            # backoff on shard I/O, corrupt-record quarantine, worker
+            # watchdog, deterministic checkpointed resume
+            from .streaming import StreamingDataset
+
+            return StreamingDataset()
         raise ValueError(f"unknown dataset class {datafeed_class}")
 
 
@@ -437,3 +445,7 @@ from . import (  # noqa: F401,E402
     cifar, common, conll05, flowers, image, imdb, imikolov, mnist,
     movielens, mq2007, sentiment, uci_housing, voc2012, wmt14, wmt16,
 )
+
+# fault-tolerant sharded streaming engine (ISSUE 11, docs/data.md) —
+# imported last: it composes DatasetBase/parse_multislot from this module
+from .streaming import StreamingDataset  # noqa: E402

@@ -72,16 +72,37 @@ def split(reader, line_count, suffix="%05d.pickle", dumper=None):
 
 def cluster_files_reader(files_pattern, trainer_count, trainer_id,
                          loader=None):
-    """reference common.cluster_files_reader — shard pickled chunks."""
+    """reference common.cluster_files_reader — shard pickled chunks.
+
+    An empty shard assignment is a hard error, not a silent empty reader:
+    a trainer that matches no files (bad pattern) or draws none from the
+    round-robin split (``trainer_id`` beyond the file count) would
+    otherwise train on nothing while its loss never moves (ISSUE 11
+    satellite; :func:`paddle_tpu.dataset.streaming.assign_shards` applies
+    the same rule to streaming shards)."""
     import glob
     import pickle
 
     def reader():
         flist = sorted(glob.glob(files_pattern))
+        if not flist:
+            raise ValueError(
+                f"cluster_files_reader: pattern {files_pattern!r} matched "
+                "no files")
         my = flist[trainer_id::trainer_count]
-        for fn in my:
-            with open(fn, "rb") as f:
-                for item in (loader or pickle.load)(f):
-                    yield item
+        if not my:
+            raise ValueError(
+                f"cluster_files_reader: trainer {trainer_id}/"
+                f"{trainer_count} is assigned no files ({len(flist)} "
+                "file(s) total) — fewer matching files than trainers; "
+                "reduce trainer_count or split the input")
+
+        def gen():
+            for fn in my:
+                with open(fn, "rb") as f:
+                    for item in (loader or pickle.load)(f):
+                        yield item
+
+        return gen()
 
     return reader

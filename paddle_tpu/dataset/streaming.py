@@ -1,0 +1,897 @@
+"""Fault-tolerant sharded streaming data engine (ISSUE 11, docs/data.md).
+
+The reference Paddle's production identity is its multi-threaded
+DataFeed/Dataset pipeline (framework/data_feed.cc, data_set.cc); this module
+is its TPU-native, fault-tolerant superset for long training runs where the
+INPUT path — not the step — is the most common fault source:
+
+- **Sharded streams**: a file list is ordered deterministically per epoch
+  (optionally shuffled from the StreamState rng seed) and assigned
+  round-robin across hosts (:func:`assign_shards`, the generalization of
+  ``dataset.common.cluster_files_reader``).  An empty assignment is a hard
+  error, never a silent empty stream.
+- **Retry with backoff**: every shard open and mid-shard read goes through
+  :class:`RetryPolicy` — bounded exponential backoff with jitter and a
+  per-shard attempt budget, metered as
+  ``paddle_input_retries_total{stage=open|read}``.  A shard that exhausts
+  its budget raises :class:`ShardReadError` naming the shard.
+- **Corrupt-record quarantine**: records whose ``decode_fn``/``validate_fn``
+  raises are appended to a JSONL sidecar (shard, record index, error, raw
+  prefix) and skipped under a bounded per-shard ``skip_budget``; exceeding
+  the budget raises :class:`QuarantineOverflowError` naming the shard —
+  fail fast instead of training on a rotten shard.
+- **Worker watchdog**: decode runs on a small worker pool; a worker stuck
+  past ``watchdog_deadline_s`` on one record is abandoned (daemon thread)
+  and replaced, and its record is re-dispatched —
+  ``paddle_input_worker_recycles_total`` — so one wedged tokenizer call
+  never stalls the gang.
+- **Graceful stall degradation**: the consumer waits in bounded ticks; the
+  wait is charged to the goodput ledger's ``input_stall`` category, and a
+  sustained stall logs a supervisor-visible warning naming the slowest
+  shard plus an ``input_stall.rank<R>.json`` report into the shared health
+  dir (``PADDLE_HEALTH_DIR``) that ``parallel.launch`` surfaces.
+- **Deterministic resume**: :class:`StreamState` (shard-list hash, per-shard
+  raw-record offsets, epoch, rng seed) snapshots at every batch boundary
+  and serializes into ``ElasticCheckpointer``'s ``data_state``.  Restoring
+  the state resumes the stream bit-exactly on the same host count; on a
+  *changed* host count, :meth:`StreamState.merge` of the per-host states
+  reassigns shards and resumes each from its recorded offset — per-shard
+  record order is always total and preserved, and every record of the
+  epoch is consumed exactly once (the documented global-order guarantee;
+  cross-shard interleaving is the only thing that may change).
+
+Determinism note: retries, quarantine skips and worker recycles never
+change WHICH records a batch contains or their order — only wall-clock.
+The decoded-record stream is a pure function of (shard bytes, shard order,
+offsets), which is what makes SIGKILL-resume bit-exact
+(tools/fault_bench.py stream scenarios).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue as _queue
+import random as _random
+import tempfile
+import threading
+import time
+import zlib
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
+
+import numpy as np
+
+from ..observability import goodput as _goodput
+from ..observability import metrics as _obs_metrics
+
+__all__ = [
+    "StreamError", "ShardReadError", "QuarantineOverflowError",
+    "Shard", "make_shards", "shard_list_hash", "assign_shards",
+    "RetryPolicy", "StreamConfig", "StreamState", "ShardedStream",
+    "StreamingDataset",
+]
+
+logger = logging.getLogger("paddle_tpu.streaming")
+
+_gp = _goodput.ledger()
+_REG = _obs_metrics.default_registry()
+_m_retries = _REG.counter(
+    "paddle_input_retries_total",
+    "Input-path retries by stage (shard open / mid-shard read)",
+    ("stage",))
+_m_quarantined = _REG.counter(
+    "paddle_input_records_quarantined_total",
+    "Records quarantined to the JSONL sidecar (decode/validate failures)")
+_m_recycles = _REG.counter(
+    "paddle_input_worker_recycles_total",
+    "Stuck decode workers abandoned and replaced by the input watchdog")
+_m_stall_s = _REG.counter(
+    "paddle_input_stall_seconds_total",
+    "Wall seconds the stream consumer waited on the decode pipeline")
+_m_records = _REG.counter(
+    "paddle_input_records_total", "Records emitted by sharded streams")
+_m_batches = _REG.counter(
+    "paddle_input_batches_total", "Batches emitted by sharded streams")
+# shard label cardinality is bounded by the registry's series cap: runs
+# with more shards than the cap collapse the excess into one
+# "<other>" series instead of growing the exposition without bound
+_g_progress = _REG.gauge(
+    "paddle_input_shard_progress",
+    "Raw records consumed per shard (resume offset)", ("shard",),
+    max_series=512)
+
+
+def quarantined_total() -> float:
+    """Process-wide quarantined-record count (monitor rows carry this)."""
+    return _m_quarantined.value
+
+
+class StreamError(RuntimeError):
+    pass
+
+
+class ShardReadError(StreamError):
+    """A shard open/read exhausted its retry budget (names the shard)."""
+
+
+class QuarantineOverflowError(StreamError):
+    """A shard's corrupt-record count exceeded the skip budget (names the
+    shard) — the stream fails fast instead of silently training on noise."""
+
+
+# ---------------------------------------------------------------------------
+# Shards + assignment
+# ---------------------------------------------------------------------------
+
+class Shard:
+    """One input file: a stable ``name`` (the resume key), path, size."""
+
+    __slots__ = ("name", "path", "size")
+
+    def __init__(self, name: str, path: str, size: int):
+        self.name = name
+        self.path = path
+        self.size = int(size)
+
+    def __repr__(self):
+        return f"Shard({self.name!r}, {self.size}B)"
+
+
+def make_shards(paths: Sequence[str]) -> List[Shard]:
+    """Paths -> Shard list.  Names are basenames when unique (so a stream
+    survives the data directory moving), full paths otherwise."""
+    paths = [str(p) for p in paths]
+    if not paths:
+        raise StreamError("stream has no shards (empty file list)")
+    bases = [os.path.basename(p) for p in paths]
+    unique = len(set(bases)) == len(bases)
+    out = []
+    for p, b in zip(paths, bases):
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            size = -1   # unreadable now; the open retry path will report it
+        out.append(Shard(b if unique else p, p, size))
+    return out
+
+
+def shard_list_hash(shards: Sequence[Shard]) -> int:
+    """Identity of the shard SET (names + sizes, order-independent): a
+    StreamState only resumes a stream over the same bytes."""
+    h = 0
+    for s in sorted(shards, key=lambda s: s.name):
+        h = zlib.crc32(f"{s.name}:{s.size}\n".encode(), h)
+    return h & 0xFFFFFFFF
+
+
+def epoch_shard_order(shards: Sequence[Shard], seed: int, epoch: int,
+                      shuffle: bool = False) -> List[Shard]:
+    """Deterministic global shard order for one epoch — identical on every
+    host (assignment slices it), derived only from (seed, epoch)."""
+    out = sorted(shards, key=lambda s: s.name)
+    if shuffle:
+        _random.Random((int(seed) << 20) ^ int(epoch)).shuffle(out)
+    return out
+
+
+def assign_shards(ordered: Sequence[Shard], host_id: int,
+                  num_hosts: int) -> List[Shard]:
+    """Round-robin host assignment over the epoch order.  A host with no
+    shards is a configuration error (the "loss never moves" footgun), not
+    an empty stream."""
+    if num_hosts < 1 or not (0 <= host_id < num_hosts):
+        raise StreamError(
+            f"bad host assignment: host_id={host_id} num_hosts={num_hosts}")
+    mine = list(ordered[host_id::num_hosts])
+    if not mine:
+        raise StreamError(
+            f"host {host_id}/{num_hosts} is assigned no shards "
+            f"({len(ordered)} shard(s) total) — fewer shards than hosts; "
+            "reduce the host count or split the input files")
+    return mine
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+
+class RetryPolicy:
+    """Bounded exponential backoff with jitter for shard I/O.
+
+    ``max_attempts`` is the per-shard attempt budget per stage; jitter
+    de-synchronizes a gang hammering a recovering filesystem.  Sleeping is
+    injectable for tests."""
+
+    def __init__(self, max_attempts: int = 5, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0, jitter: float = 0.5,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.jitter = float(jitter)
+        self.sleep = sleep
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before attempt N+1 (attempts are 1-based)."""
+        d = min(self.base_delay_s * (2 ** (attempt - 1)), self.max_delay_s)
+        return d * (1.0 + self.jitter * _random.random())
+
+
+# ---------------------------------------------------------------------------
+# StreamState: the deterministic-resume token
+# ---------------------------------------------------------------------------
+
+STATE_VERSION = 1
+
+
+class StreamState:
+    """Serializable resume position of a sharded stream.
+
+    ``offsets[name]`` counts RAW records (file lines) consumed from that
+    shard — quarantined records included, so a resume skips them without
+    re-quarantining side effects changing batch composition.  Snapshots
+    are taken at batch boundaries only: a record is "consumed" once the
+    batch containing it has been yielded to the training loop.
+    """
+
+    def __init__(self, shard_hash: int, epoch: int = 0,
+                 offsets: Optional[Dict[str, int]] = None, seed: int = 0,
+                 records: int = 0):
+        self.shard_hash = int(shard_hash)
+        self.epoch = int(epoch)
+        self.offsets: Dict[str, int] = dict(offsets or {})
+        self.seed = int(seed)
+        self.records = int(records)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"version": STATE_VERSION, "shard_hash": self.shard_hash,
+                "epoch": self.epoch, "offsets": dict(self.offsets),
+                "seed": self.seed, "records": self.records}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StreamState":
+        ver = int(d.get("version", 1))
+        if ver > STATE_VERSION:
+            raise StreamError(
+                f"stream state version {ver} is newer than this runtime "
+                f"({STATE_VERSION})")
+        return cls(shard_hash=int(d["shard_hash"]),
+                   epoch=int(d.get("epoch", 0)),
+                   offsets={str(k): int(v)
+                            for k, v in (d.get("offsets") or {}).items()},
+                   seed=int(d.get("seed", 0)),
+                   records=int(d.get("records", 0)))
+
+    @classmethod
+    def merge(cls, states: Sequence["StreamState"]) -> "StreamState":
+        """Merge per-host states for a host-count change: per-shard offsets
+        union (each shard is owned by exactly one host, so keys are
+        disjoint).  All states must describe the same shard set and epoch.
+        """
+        if not states:
+            raise StreamError("cannot merge zero stream states")
+        first = states[0]
+        out = cls(first.shard_hash, first.epoch, {}, first.seed, 0)
+        for st in states:
+            if st.shard_hash != out.shard_hash:
+                raise StreamError(
+                    "cannot merge stream states over different shard sets "
+                    f"({st.shard_hash:#x} vs {out.shard_hash:#x})")
+            if st.epoch != out.epoch:
+                raise StreamError(
+                    "cannot merge stream states at different epochs "
+                    f"({st.epoch} vs {out.epoch}) — checkpoint the gang at "
+                    "one barrier")
+            for k, v in st.offsets.items():
+                out.offsets[k] = max(int(v), out.offsets.get(k, 0))
+            out.records += st.records
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+class StreamConfig:
+    def __init__(self, batch_size: int = 1, drop_last: bool = False,
+                 num_workers: int = 2, prefetch: int = 8,
+                 retry: Optional[RetryPolicy] = None,
+                 skip_budget: int = 16,
+                 quarantine_path: Optional[str] = None,
+                 watchdog_deadline_s: float = 30.0,
+                 stall_warn_s: float = 5.0,
+                 shuffle_shards: bool = False, seed: int = 0,
+                 validate_fn: Optional[Callable[[Any], None]] = None,
+                 charge_goodput: bool = True):
+        self.batch_size = max(1, int(batch_size))
+        self.drop_last = bool(drop_last)
+        self.num_workers = max(1, int(num_workers))
+        self.prefetch = max(2, int(prefetch))
+        self.retry = retry or RetryPolicy()
+        self.skip_budget = int(skip_budget)
+        self.quarantine_path = quarantine_path
+        self.watchdog_deadline_s = float(watchdog_deadline_s)
+        self.stall_warn_s = float(stall_warn_s)
+        self.shuffle_shards = bool(shuffle_shards)
+        self.seed = int(seed)
+        self.validate_fn = validate_fn
+        # the executor's prefetch_to_device already attributes consumer
+        # waits to the goodput ledger; direct consumers keep this True so
+        # stalls are attributed exactly once either way
+        self.charge_goodput = bool(charge_goodput)
+
+
+def _default_quarantine_path() -> str:
+    d = os.environ.get("PADDLE_INPUT_QUARANTINE_DIR") or \
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_quarantine")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"quarantine.{os.getpid()}.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+class _Worker:
+    __slots__ = ("thread", "current", "busy_since", "abandoned", "idx")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.thread: Optional[threading.Thread] = None
+        self.current = None          # (seq, shard_name, raw_idx, raw)
+        self.busy_since = 0.0
+        self.abandoned = False
+
+
+class ShardedStream:
+    """Background-read + parallel-decode stream over file shards with the
+    retry/quarantine/watchdog/resume discipline described in the module
+    docstring.
+
+    ``decode_fn(raw: bytes) -> record`` runs on the worker pool and must be
+    pure (a recycled record may be decoded twice).  ``open_fn(path)`` must
+    return an iterable of byte lines (injectable for fault tests).
+    """
+
+    def __init__(self, shards, decode_fn: Callable[[bytes], Any],
+                 config: Optional[StreamConfig] = None, *,
+                 host_id: int = 0, num_hosts: int = 1,
+                 state: Optional[StreamState] = None,
+                 open_fn: Optional[Callable[[str], Any]] = None,
+                 name: str = "stream"):
+        if shards and not isinstance(shards[0], Shard):
+            shards = make_shards(list(shards))
+        self.shards: List[Shard] = list(shards)
+        if not self.shards:
+            raise StreamError("stream has no shards (empty file list)")
+        self.decode_fn = decode_fn
+        self.config = config or StreamConfig()
+        self.host_id = int(host_id)
+        self.num_hosts = int(num_hosts)
+        self.open_fn = open_fn or (lambda path: open(path, "rb"))
+        self.name = name
+        shash = shard_list_hash(self.shards)
+        if state is not None:
+            if state.shard_hash != shash:
+                raise StreamError(
+                    f"stream state does not match the shard set "
+                    f"(state hash {state.shard_hash:#x}, shards {shash:#x})"
+                    " — the file list or a file's size changed since the "
+                    "checkpoint")
+            self.state = state
+        else:
+            self.state = StreamState(shash, seed=self.config.seed)
+        self._skip_counts: Dict[str, int] = {}
+        self.quarantine_path = self.config.quarantine_path \
+            or _default_quarantine_path()
+        self._quarantine_lock = threading.Lock()
+        self.quarantined = 0            # this stream's own count
+        self.retries = 0
+        self.recycles = 0
+
+    # -- resume surface ----------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Batch-boundary-aligned resume token (a deep copy — safe to hand
+        to an async checkpoint writer)."""
+        return self.state.to_dict()
+
+    def load_state_dict(self, d: Dict[str, Any]) -> None:
+        st = StreamState.from_dict(d)
+        if st.shard_hash != shard_list_hash(self.shards):
+            raise StreamError(
+                "restored stream state does not match the current shard "
+                "set — the file list or a file's size changed")
+        self.state = st
+
+    # -- retry plumbing ----------------------------------------------------
+
+    def _retrying(self, stage: str, shard: Shard, fn):
+        pol = self.config.retry
+        for attempt in range(1, pol.max_attempts + 1):
+            try:
+                return fn()
+            except (OSError, IOError) as e:
+                if attempt >= pol.max_attempts:
+                    raise ShardReadError(
+                        f"shard {shard.name!r}: {stage} failed after "
+                        f"{attempt} attempt(s): {e}") from e
+                _m_retries.labels(stage).inc()
+                self.retries += 1
+                d = pol.delay(attempt)
+                logger.warning(
+                    "input %s: shard %s %s failed (%s); retry %d/%d in "
+                    "%.2fs", self.name, shard.name, stage, e, attempt,
+                    pol.max_attempts - 1, d)
+                pol.sleep(d)
+
+    def _read_shard(self, shard: Shard, skip: int, stop: threading.Event
+                    ) -> Iterator[Tuple[int, bytes]]:
+        """Yield ``(raw_index, line)`` from ``skip`` onward, reopening and
+        re-seeking (by line count) on mid-read I/O faults.  Blank lines
+        advance the index but yield nothing."""
+        pol = self.config.retry
+        consumed = int(skip)
+        read_attempts = 0
+        while not stop.is_set():
+            f = self._retrying("open", shard,
+                               lambda: self.open_fn(shard.path))
+            try:
+                for i, raw in enumerate(f):
+                    if i < consumed:
+                        continue
+                    if stop.is_set():
+                        return
+                    line = raw.rstrip(b"\r\n") if isinstance(raw, bytes) \
+                        else raw.rstrip("\r\n").encode()
+                    if line:
+                        yield i, line
+                    consumed = i + 1
+                return
+            except (OSError, IOError) as e:
+                read_attempts += 1
+                if read_attempts >= pol.max_attempts:
+                    raise ShardReadError(
+                        f"shard {shard.name!r}: read failed after "
+                        f"{read_attempts} attempt(s) at record {consumed}: "
+                        f"{e}") from e
+                _m_retries.labels("read").inc()
+                self.retries += 1
+                d = pol.delay(read_attempts)
+                logger.warning(
+                    "input %s: shard %s read fault at record %d (%s); "
+                    "reopening in %.2fs", self.name, shard.name, consumed,
+                    e, d)
+                pol.sleep(d)
+            finally:
+                close = getattr(f, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+
+    # -- quarantine --------------------------------------------------------
+
+    def _quarantine(self, sname: str, idx: int, raw: bytes,
+                    err: BaseException) -> None:
+        n = self._skip_counts.get(sname, 0) + 1
+        self._skip_counts[sname] = n
+        _m_quarantined.inc()
+        self.quarantined += 1
+        entry = {
+            "time": time.time(), "stream": self.name, "shard": sname,
+            "record_index": int(idx),
+            "error": f"{type(err).__name__}: {err}",
+            "raw_prefix": raw[:256].decode("utf-8", "replace"),
+        }
+        try:
+            with self._quarantine_lock, open(self.quarantine_path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        except OSError as e:   # the sidecar must never kill training
+            logger.warning("input %s: quarantine sidecar write failed: %s",
+                           self.name, e)
+        logger.warning(
+            "input %s: quarantined record %d of shard %s (%s) -> %s "
+            "[%d/%d budget]", self.name, idx, sname, entry["error"],
+            self.quarantine_path, n, self.config.skip_budget)
+        if n > self.config.skip_budget:
+            raise QuarantineOverflowError(
+                f"shard {sname!r}: {n} corrupt records exceed the skip "
+                f"budget ({self.config.skip_budget}) — failing fast; "
+                f"inspect the quarantine sidecar at {self.quarantine_path} "
+                "and fix or drop the shard")
+
+    # -- stall reporting ---------------------------------------------------
+
+    def _report_stall(self, sname: Optional[str], waited_s: float) -> None:
+        logger.warning(
+            "input %s: stream stalled for %.1fs waiting on shard %s — the "
+            "decode pipeline is not keeping up (slow storage, stuck "
+            "tokenizer, or an undersized worker pool)",
+            self.name, waited_s, sname or "<unknown>")
+        try:
+            from ..parallel import health as _health
+
+            d = os.environ.get(_health.ENV_DIR)
+        except Exception:
+            d = None
+        if not d:
+            return
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        path = os.path.join(d, f"input_stall.rank{rank}.json")
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"rank": int(rank), "stream": self.name,
+                           "shard": sname, "waited_s": round(waited_s, 3),
+                           "time": time.time(), "pid": os.getpid()}, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    # -- the pipeline ------------------------------------------------------
+
+    def _events(self) -> Iterator[Tuple]:
+        """Yield ``("ok", record, shard_name, raw_idx)`` and
+        ``("skip", shard_name, raw_idx)`` events in deterministic record
+        order, running read/decode on background threads."""
+        cfg = self.config
+        order = assign_shards(
+            epoch_shard_order(self.shards, self.state.seed,
+                              self.state.epoch, cfg.shuffle_shards),
+            self.host_id, self.num_hosts)
+        stop = threading.Event()
+        in_q: _queue.Queue = _queue.Queue(maxsize=2 * cfg.num_workers)
+        out_q: _queue.Queue = _queue.Queue(maxsize=cfg.prefetch)
+        inflight: Dict[int, Tuple[str, int]] = {}
+        meta_lock = threading.Lock()
+        feed = {"done": False, "total": 0, "error": None}
+        workers: List[_Worker] = []
+        workers_lock = threading.Lock()
+
+        def _put(q, item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def feed_loop():
+            seq = 0
+            try:
+                for shard in order:
+                    skip = self.state.offsets.get(shard.name, 0)
+                    for raw_idx, raw in self._read_shard(shard, skip, stop):
+                        with meta_lock:
+                            inflight[seq] = (shard.name, raw_idx)
+                        if not _put(in_q, (seq, shard.name, raw_idx, raw)):
+                            return
+                        seq += 1
+            except BaseException as e:
+                feed["error"] = e
+            finally:
+                feed["total"] = seq
+                feed["done"] = True
+
+        def work_loop(w: _Worker):
+            while not stop.is_set() and not w.abandoned:
+                try:
+                    item = in_q.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                seq, sname, idx, raw = item
+                w.current = item
+                w.busy_since = time.monotonic()
+                try:
+                    rec = self.decode_fn(raw)
+                    if cfg.validate_fn is not None:
+                        cfg.validate_fn(rec)
+                    res = ("ok", seq, rec, sname, idx)
+                except BaseException as e:
+                    res = ("bad", seq, sname, idx, raw, e)
+                w.current = None
+                _put(out_q, res)   # late results from abandoned workers
+                if w.abandoned:    # are deduped by seq in the driver
+                    return
+
+        def spawn_worker() -> _Worker:
+            w = _Worker(len(workers))
+            t = threading.Thread(target=work_loop, args=(w,), daemon=True,
+                                 name=f"{self.name}-decode-{w.idx}")
+            w.thread = t
+            t.start()
+            return w
+
+        def watchdog_loop():
+            tick = max(0.05, min(1.0, cfg.watchdog_deadline_s / 4.0))
+            while not stop.is_set():
+                time.sleep(tick)
+                now = time.monotonic()
+                with workers_lock:
+                    live = list(workers)
+                for w in live:
+                    cur = w.current
+                    if cur is None or w.abandoned:
+                        continue
+                    if now - w.busy_since <= cfg.watchdog_deadline_s:
+                        continue
+                    w.abandoned = True
+                    _m_recycles.inc()
+                    self.recycles += 1
+                    seq, sname, idx, _raw = cur
+                    logger.warning(
+                        "input %s: decode worker stuck %.1fs on shard %s "
+                        "record %d — recycling the worker and "
+                        "re-dispatching the record", self.name,
+                        now - w.busy_since, sname, idx)
+                    with workers_lock:
+                        if w in workers:
+                            workers.remove(w)
+                        workers.append(spawn_worker())
+                    _put(in_q, cur)
+
+        feeder = threading.Thread(target=feed_loop, daemon=True,
+                                  name=f"{self.name}-read")
+        feeder.start()
+        with workers_lock:
+            for _ in range(cfg.num_workers):
+                workers.append(spawn_worker())
+        wd = threading.Thread(target=watchdog_loop, daemon=True,
+                              name=f"{self.name}-watchdog")
+        wd.start()
+
+        pending: Dict[int, Tuple] = {}
+        next_emit = 0
+        last_progress = time.monotonic()
+        warned = False
+        # bounded wait: the tick is short enough that a stall at the warn
+        # threshold is noticed within ~2 ticks even for small thresholds
+        tick = min(0.25, max(0.01, cfg.stall_warn_s / 2.0)) \
+            if cfg.stall_warn_s > 0 else 0.25
+        try:
+            while True:
+                if feed["error"] is not None:
+                    raise feed["error"]
+                if feed["done"] and next_emit >= feed["total"]:
+                    return
+                t0 = time.perf_counter_ns()
+                try:
+                    if cfg.charge_goodput:
+                        with _gp.timer("input_stall"):
+                            res = out_q.get(timeout=tick)
+                    else:
+                        res = out_q.get(timeout=tick)
+                except _queue.Empty:
+                    _m_stall_s.inc((time.perf_counter_ns() - t0) / 1e9)
+                    waited = time.monotonic() - last_progress
+                    if waited > cfg.stall_warn_s and not warned:
+                        with meta_lock:
+                            slow = inflight.get(next_emit)
+                        self._report_stall(slow[0] if slow else None, waited)
+                        warned = True
+                    continue
+                _m_stall_s.inc((time.perf_counter_ns() - t0) / 1e9)
+                seq = res[1]
+                if seq < next_emit or seq in pending:
+                    continue    # duplicate from a recycled worker
+                pending[seq] = res
+                while next_emit in pending:
+                    res = pending.pop(next_emit)
+                    with meta_lock:
+                        inflight.pop(next_emit, None)
+                    next_emit += 1
+                    last_progress = time.monotonic()
+                    warned = False
+                    if res[0] == "ok":
+                        _, _seq, rec, sname, idx = res
+                        yield ("ok", rec, sname, idx)
+                    else:
+                        _, _seq, sname, idx, raw, err = res
+                        self._quarantine(sname, idx, raw, err)
+                        yield ("skip", sname, idx)
+        finally:
+            stop.set()
+            for q in (in_q, out_q):
+                try:
+                    while True:
+                        q.get_nowait()
+                except _queue.Empty:
+                    pass
+            feeder.join(timeout=5)
+            wd.join(timeout=5)
+            with workers_lock:
+                live = list(workers)
+            for w in live:
+                if not w.abandoned and w.thread is not None:
+                    w.thread.join(timeout=5)
+
+    def records(self) -> Iterator[Any]:
+        """Decoded records in deterministic order.  NOTE: iterating this
+        directly does NOT advance the resume state — use :meth:`batches`
+        for checkpointable consumption."""
+        for ev in self._events():
+            if ev[0] == "ok":
+                yield ev[1]
+
+    def batches(self) -> Iterator[List[Any]]:
+        """One epoch of record batches.  ``self.state`` (and
+        :meth:`state_dict`) is updated ONLY at batch boundaries, so a
+        checkpoint taken between yields resumes exactly after the last
+        yielded batch.  At epoch end the epoch counter advances and the
+        offsets clear; calling again streams the next epoch."""
+        cfg = self.config
+        batch: List[Any] = []
+        pending_offsets: Dict[str, int] = {}
+        # the skip budget bounds the corrupt FRACTION of a shard, per
+        # epoch pass — a known-tolerable bad record must not accumulate
+        # across epochs until it trips the budget on epoch N
+        self._skip_counts = {}
+
+        def commit():
+            self.state.offsets.update(pending_offsets)
+            for sname, off in pending_offsets.items():
+                _g_progress.labels(sname).set(off)
+            pending_offsets.clear()
+
+        for ev in self._events():
+            if ev[0] == "skip":
+                pending_offsets[ev[1]] = ev[2] + 1
+                continue
+            _, rec, sname, idx = ev
+            pending_offsets[sname] = idx + 1
+            batch.append(rec)
+            if len(batch) >= cfg.batch_size:
+                commit()
+                self.state.records += len(batch)
+                _m_records.inc(len(batch))
+                _m_batches.inc()
+                yield batch
+                batch = []
+        if batch and not cfg.drop_last:
+            commit()
+            self.state.records += len(batch)
+            _m_records.inc(len(batch))
+            _m_batches.inc()
+            yield batch
+        # epoch complete: advance and clear so the next batches() call (or
+        # a resume from the final state) starts the next epoch cleanly
+        self.state.epoch += 1
+        self.state.offsets = {}
+
+
+# ---------------------------------------------------------------------------
+# Executor-facing dataset adapter (MultiSlot records -> feed dicts)
+# ---------------------------------------------------------------------------
+
+class StreamingDataset:
+    """A ``train_from_dataset``-compatible dataset over a fault-tolerant
+    sharded stream of MultiSlot text records (one instance per line — the
+    same wire format as :class:`..dataset.QueueDataset`, with the
+    retry/quarantine/resume discipline of :class:`ShardedStream`).
+
+    Iteration yields feed dicts; each carries a ``__stream_state__`` key
+    (the batch-aligned resume token) that the Executor pops, keeps, and
+    serializes into the elastic checkpoint's ``data_state`` — restoring it
+    via :meth:`restore_stream_state` resumes the stream without replaying
+    consumed batches (docs/data.md).
+    """
+
+    STATE_KEY = "__stream_state__"
+
+    def __init__(self):
+        from . import DatasetBase
+
+        # compose (not inherit) the schema/batching surface of DatasetBase
+        # so MultiSlot parsing and feed assembly stay one implementation
+        self._base = DatasetBase()
+        self.stream_options = StreamConfig()
+        self._engine: Optional[ShardedStream] = None
+        self._restored: Optional[Dict[str, Any]] = None
+        self.thread_num = 1     # decode threads live inside the engine
+
+    # -- reference setter surface (delegated) ------------------------------
+    def set_batch_size(self, batch_size: int):
+        self._base.set_batch_size(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self.stream_options.num_workers = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self._base.set_filelist(filelist)
+        self._engine = None
+
+    def set_use_var(self, var_list):
+        self._base.set_use_var(var_list)
+
+    def set_pad_to(self, maxlen):
+        self._base.set_pad_to(maxlen)
+
+    def set_trainer_shard(self, trainer_id: int, trainer_num: int):
+        self._base.set_trainer_shard(trainer_id, trainer_num)
+        self._engine = None
+
+    def set_stream_options(self, **kw) -> "StreamingDataset":
+        """Override StreamConfig fields (retry=, skip_budget=,
+        quarantine_path=, watchdog_deadline_s=, num_workers=, ...)."""
+        for k, v in kw.items():
+            if not hasattr(self.stream_options, k):
+                raise ValueError(f"unknown stream option {k!r}")
+            setattr(self.stream_options, k, v)
+        self._engine = None
+        return self
+
+    @property
+    def use_vars(self):
+        return self._base.use_vars
+
+    @property
+    def batch_size(self):
+        return self._base.batch_size
+
+    @property
+    def drop_last(self):
+        return self._base.drop_last
+
+    @drop_last.setter
+    def drop_last(self, v):
+        self._base.drop_last = bool(v)
+
+    # -- decode ------------------------------------------------------------
+    def _decode_line(self, raw: bytes):
+        from . import parse_multislot
+
+        is_float, _dims, _dtypes = self._base._slot_schema()
+        values, lods = parse_multislot(raw + b"\n", is_float)
+        insts = self._base._instances_of(values, lods)
+        if len(insts) != 1:
+            raise ValueError(
+                f"expected exactly 1 MultiSlot instance per line, "
+                f"got {len(insts)}")
+        return insts[0]
+
+    # -- engine ------------------------------------------------------------
+    def _ensure_engine(self) -> ShardedStream:
+        if self._engine is None:
+            cfg = self.stream_options
+            cfg.batch_size = self._base.batch_size
+            cfg.drop_last = self._base.drop_last
+            state = (StreamState.from_dict(self._restored)
+                     if self._restored else None)
+            self._engine = ShardedStream(
+                self._base.filelist, self._decode_line, cfg,
+                host_id=self._base._trainer_id,
+                num_hosts=self._base._trainer_num,
+                state=state, name="dataset")
+            self._restored = None
+        return self._engine
+
+    def __iter__(self):
+        engine = self._ensure_engine()
+        for batch in engine.batches():
+            feed = self._base._batch_to_feed(batch)
+            feed[self.STATE_KEY] = engine.state_dict()
+            yield feed
+
+    # -- executor resume protocol ------------------------------------------
+    def stream_state(self) -> Dict[str, Any]:
+        """Current resume token (the engine's live state; per-batch aligned
+        tokens ride each yielded feed under :data:`STATE_KEY`)."""
+        if self._engine is not None:
+            return self._engine.state_dict()
+        if self._restored is not None:
+            return dict(self._restored)
+        return StreamState(shard_list_hash(make_shards(self._base.filelist)),
+                           seed=self.stream_options.seed).to_dict()
+
+    def restore_stream_state(self, d: Dict[str, Any]) -> None:
+        """Install a saved resume token; must be called before iteration
+        starts (the Executor does this when the restored checkpoint's
+        ``data_state`` carries a ``stream`` entry)."""
+        if self._engine is not None:
+            self._engine.load_state_dict(d)
+        else:
+            self._restored = dict(d)

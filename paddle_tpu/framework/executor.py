@@ -67,6 +67,12 @@ _m_fetch_stall = _OBS.counter(
     "paddle_fetch_sync_stall_ms_total",
     "train_from_dataset fetch-sync stall time at print/final boundaries (ms)")
 
+# streaming datasets ride their batch-aligned resume token on each feed
+# under this key (dataset.streaming.StreamingDataset.STATE_KEY); the
+# dataset loop pops it before dispatch and serializes it into the elastic
+# checkpoint's data_state
+_STREAM_STATE_KEY = "__stream_state__"
+
 _prof_mod = None
 
 
@@ -1309,8 +1315,13 @@ class Executor:
                 out["bad_steps"] = int(np.asarray(v).ravel()[0])
             return out
         feed_names = {v.name for v in getattr(dataset, "use_vars", [])}
+        # stream-capable datasets (docs/data.md) run their own read/decode
+        # worker pool — the threaded batch pipeline would bypass their
+        # retry/quarantine/resume machinery
+        streaming = hasattr(dataset, "stream_state") \
+            and hasattr(dataset, "restore_stream_state")
         n_threads = int(thread) or int(getattr(dataset, "thread_num", 1) or 1)
-        if n_threads > 1:
+        if n_threads > 1 and not streaming:
             from ..dataset import iter_batches_threaded
 
             batches = iter_batches_threaded(dataset, n_threads)
@@ -1321,13 +1332,14 @@ class Executor:
             for batch_feed in batches:
                 yield {k: v for k, v in batch_feed.items()
                        if not feed_names or k in feed_names
-                       or k.endswith("__len")}
+                       or k.endswith("__len") or k == _STREAM_STATE_KEY}
 
         # elastic checkpointing (docs/elastic.md): restore the latest
         # committed step into the scope, skip the consumed batches, and
         # save periodically / on preemption
         ckpt = preempt = None
         start_offset = 0
+        stream_resumed = False
         if train and checkpoint_dir:
             # store bring-up (module import + committed-step scan) is
             # checkpoint machinery wall time
@@ -1343,19 +1355,33 @@ class Executor:
                     state, man = ckpt.restore(latest)
                     n_restored = self._restore_checkpoint_state(
                         program, scope, state)
-                start_offset = int((man.get("data") or {}).get("offset", 0))
+                data_man = man.get("data") or {}
+                start_offset = int(data_man.get("offset", 0))
+                if streaming and data_man.get("stream"):
+                    # a stream-capable dataset seeks to its saved per-shard
+                    # offsets instead of replaying + discarding consumed
+                    # batches (O(offset) parse work on every restart)
+                    dataset.restore_stream_state(data_man["stream"])
+                    stream_resumed = True
                 logger.info(
                     "resumed %d persistables from checkpoint step %d "
-                    "(skipping %d consumed batches)",
-                    n_restored, latest, start_offset)
+                    "(%s)", n_restored, latest,
+                    "stream state restored" if stream_resumed else
+                    f"skipping {start_offset} consumed batches")
             preempt = install_preemption_handler()
 
-        def _save_ckpt(step_no: int, sync: bool = False):
+        def _save_ckpt(step_no: int, sync: bool = False,
+                       stream_state=None):
             # only the synchronous share burns main-thread wall: the host
             # snapshot + (for sync saves) the commit wait
             with _gp.timer("checkpoint_save"):
+                data_state = {"epoch": 0, "offset": step_no}
+                if stream_state is not None:
+                    # the batch-aligned resume token of the sharded stream
+                    # (docs/data.md StreamState schema)
+                    data_state["stream"] = stream_state
                 ckpt.save(step_no, self._checkpoint_state(program, scope),
-                          data_state={"epoch": 0, "offset": step_no})
+                          data_state=data_state)
                 if sync:
                     ckpt.wait()
 
@@ -1366,13 +1392,32 @@ class Executor:
         from ..reader import prefetch_to_device
 
         stream = filtered()
-        if start_offset:
+        if start_offset and not stream_resumed:
             import itertools
 
             stream = itertools.islice(stream, start_offset, None)
         step = start_offset
         last_fetch = None
-        for feed in prefetch_to_device(stream, size=2):
+        last_stream_state = None
+        quarantined_fn = None
+        if streaming:
+            from ..dataset.streaming import quarantined_total
+
+            quarantined_fn = quarantined_total
+        batch_iter = prefetch_to_device(stream, size=2)
+        while True:
+            # the wait for the next staged batch is the step's input-side
+            # stall; it rides every monitor row as input_wait_ms
+            t_in = time.perf_counter_ns()
+            try:
+                feed = next(batch_iter)
+            except StopIteration:
+                break
+            input_wait_ms = (time.perf_counter_ns() - t_in) / 1e6
+            if isinstance(feed, dict):
+                st = feed.pop(_STREAM_STATE_KEY, None)
+                if st is not None:
+                    last_stream_state = st
             with _gp.timer("productive_step"):
                 health.progress("train_from_dataset")
                 if guard is not None:
@@ -1390,6 +1435,14 @@ class Executor:
                             if shape:
                                 monitor.examples_per_step = int(shape[0])
                                 break
+                    # input-side context on every row (ISSUE 11 satellite):
+                    # how long this step waited on the prefetch queue, and
+                    # the cumulative quarantined-record count — anomaly
+                    # dumps then show whether the input path was implicated
+                    input_extra = {"input_wait_ms": round(input_wait_ms, 4)}
+                    if quarantined_fn is not None:
+                        input_extra["quarantined_records"] = \
+                            int(quarantined_fn())
                     with monitor.step() as s:
                         last_fetch = self.run(program=program, feed=feed,
                                               fetch_list=fetch_list, scope=scope,
@@ -1401,6 +1454,7 @@ class Executor:
                             # sync) so an anomaly dump can summarize the
                             # offending step's values
                             extra = _amp_fields()
+                            extra.update(input_extra)
                             if guard is not None:
                                 with _gp.timer("device_wait"):
                                     loss_host = np.asarray(last_fetch[0])
@@ -1409,6 +1463,8 @@ class Executor:
                                     extra["bad_step"] = True
                             s.observe(loss=last_fetch[0], fetches=last_fetch,
                                       fetch_names=list(fetch_info), **extra)
+                        else:
+                            s.observe(**input_extra)
                 else:
                     last_fetch = self.run(program=program, feed=feed,
                                           fetch_list=fetch_list, scope=scope,
@@ -1437,11 +1493,12 @@ class Executor:
                         # synchronously and return cleanly
                         logger.info("preemption signal at step %d: "
                                     "checkpointing and exiting", step)
-                        _save_ckpt(step, sync=True)
+                        _save_ckpt(step, sync=True,
+                                   stream_state=last_stream_state)
                         break
                     if checkpoint_interval and \
                             step % int(checkpoint_interval) == 0:
-                        _save_ckpt(step)
+                        _save_ckpt(step, stream_state=last_stream_state)
                 if fetch_list and print_period and step % print_period == 0:
                     # the only per-step host sync point (monitor excepted),
                     # and only when printing
@@ -1457,7 +1514,12 @@ class Executor:
         if ckpt is not None:
             if step > start_offset and not (preempt is not None
                                             and preempt.triggered):
-                _save_ckpt(step, sync=True)
+                # the final save captures the dataset's CURRENT stream
+                # state (epoch advanced, offsets cleared) so a relaunch
+                # starts the next epoch instead of replaying the last batch
+                _save_ckpt(step, sync=True,
+                           stream_state=(dataset.stream_state()
+                                         if streaming else None))
             ckpt.close()
         if last_fetch is not None:
             t0 = time.perf_counter_ns()

@@ -15,7 +15,10 @@ taxonomy:
                       (supervisor-attributed; a SIGKILL'd worker cannot
                       report its own death)
     rollback_replay   divergence-guardrail skip restores and rollbacks
-    input_stall       the train loop blocked on the prefetch queue
+    input_stall       the train loop blocked on the prefetch queue or the
+                      sharded-stream decode pipeline (docs/data.md; the
+                      stream charges its own consumer waits only when not
+                      already under the prefetch accounting)
     device_wait       blocking device->host fetch materialization
     drain             serving drain windows (refuse-new, finish-in-flight)
     other             the unaccounted remainder (the gate: < 1% on a
@@ -220,6 +223,13 @@ class GoodputLedger:
                     maybe_export(report)
 
     # -- introspection -----------------------------------------------------
+    def category_seconds(self, category: str,
+                         include_open: bool = False) -> float:
+        """Cumulative seconds attributed to one category (e.g. the input
+        gates in tools/metrics_check.py delta ``input_stall`` around a
+        seeded slow-shard stream)."""
+        return self.totals(include_open=include_open).get(category, 0.0)
+
     def totals(self, include_open: bool = False) -> Dict[str, float]:
         """Cumulative seconds per category.  ``include_open=True`` adds
         the elapsed self-time of timers currently open on the CALLING

@@ -145,18 +145,28 @@ class _HistogramChild(_Child):
         return self._Timer(self)
 
 
+OVERFLOW_LABEL = "<other>"
+
+
 class _Metric:
-    """A named metric family; ``labels(*values)`` resolves a child series."""
+    """A named metric family; ``labels(*values)`` resolves a child series.
+
+    ``max_series`` bounds label cardinality: once that many children exist,
+    NEW label combinations resolve to one shared ``<other>`` overflow
+    series instead of growing the exposition without bound (per-shard
+    gauges on runs with thousands of shards stay scrape-able)."""
 
     child_cls = _CounterChild
     type_name = "counter"
 
     def __init__(self, name: str, help: str = "",
-                 labelnames: Sequence[str] = (), **child_kw):
+                 labelnames: Sequence[str] = (),
+                 max_series: Optional[int] = None, **child_kw):
         _validate_name(name)
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
+        self.max_series = max_series
         self._child_kw = child_kw
         self._children: Dict[Tuple[str, ...], _Child] = {}
         self._lock = threading.Lock()
@@ -178,8 +188,13 @@ class _Metric:
         child = self._children.get(values)
         if child is None:
             with self._lock:
-                child = self._children.setdefault(
-                    values, self._make_child(values))
+                child = self._children.get(values)
+                if child is None:
+                    if self.max_series is not None and \
+                            len(self._children) >= self.max_series:
+                        values = (OVERFLOW_LABEL,) * len(self.labelnames)
+                    child = self._children.setdefault(
+                        values, self._make_child(values))
         return child
 
     def children(self) -> List[_Child]:
@@ -270,12 +285,16 @@ class MetricsRegistry:
             return m
 
     def counter(self, name: str, help: str = "",
-                labelnames: Sequence[str] = ()) -> Counter:
-        return self._get_or_create(Counter, name, help, labelnames)
+                labelnames: Sequence[str] = (),
+                max_series: Optional[int] = None) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames,
+                                   max_series=max_series)
 
     def gauge(self, name: str, help: str = "",
-              labelnames: Sequence[str] = ()) -> Gauge:
-        return self._get_or_create(Gauge, name, help, labelnames)
+              labelnames: Sequence[str] = (),
+              max_series: Optional[int] = None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames,
+                                   max_series=max_series)
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),

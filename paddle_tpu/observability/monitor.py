@@ -300,6 +300,12 @@ class TrainMonitor:
         # the real value through record_step/observe extras; 0.0 otherwise
         # so the row schema is stable (tools/metrics_check.py gate)
         rec.setdefault("overlap_fraction", 0.0)
+        # input-side context (ISSUE 11): time this step waited on the
+        # prefetch queue and the cumulative quarantined-record count —
+        # train_from_dataset stamps the real values, defaults keep the row
+        # schema stable for pure-JAX record_step callers
+        rec.setdefault("input_wait_ms", 0.0)
+        rec.setdefault("quarantined_records", 0)
         # per-row goodput category breakdown (ms since the previous row;
         # include_open folds in the enclosing step timer's in-flight share)
         cur = _goodput.ledger().totals(include_open=True)

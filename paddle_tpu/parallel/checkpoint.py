@@ -334,10 +334,25 @@ class ElasticCheckpointer:
         """Snapshot ``state`` (a pytree) for ``step``.  ``mesh`` is a
         {axis: size} dict, ``layout`` the comm_opt BucketLayout of flat
         dp-sharded moment buffers (with ``layout_repl`` = pp*tp),
-        ``data_state`` the dataset resume position ({"epoch", "offset"}).
+        ``data_state`` the dataset resume position — ``{"epoch",
+        "offset"}``, plus an optional ``"stream"`` entry carrying a
+        sharded stream's ``StreamState.to_dict()`` (shard-list hash,
+        per-shard offsets, epoch, rng seed — docs/data.md) so a restart
+        seeks the input instead of replaying it.
         Returns the step directory path (commit may still be in flight when
         async — ``wait()`` joins it)."""
         self._raise_pending()
+        if data_state is not None:
+            # fail at save time, in the caller's frame — an unserializable
+            # resume token surfacing as an async-writer error at the NEXT
+            # save would point at the wrong step
+            try:
+                json.dumps(data_state)
+            except (TypeError, ValueError) as e:
+                raise CheckpointError(
+                    f"data_state for step {step} is not JSON-serializable "
+                    f"({e}); stream states must be plain dicts "
+                    "(StreamState.to_dict())") from e
         t0 = time.perf_counter_ns()
         # the synchronous share of a save (flatten + device->host snapshot)
         # is main-thread wall-clock; the async write overlaps the next

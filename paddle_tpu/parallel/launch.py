@@ -51,6 +51,41 @@ _m_restarts = _obs_metrics.default_registry().counter(
     "paddle_restarts_total",
     "Supervised gang restarts by cause (hang, crash, preempt)",
     ("cause",))
+_m_input_stalls = _obs_metrics.default_registry().counter(
+    "paddle_input_stall_reports_total",
+    "Input-stall reports surfaced by the supervisor, by rank", ("rank",))
+
+
+def _poll_input_stall_reports(health_dir: str, seen: dict) -> list:
+    """Surface workers' input-stall reports (docs/data.md): a stalled
+    sharded stream writes ``input_stall.rank<R>.json`` into the shared
+    health dir; the supervisor polls it alongside the straggler check so a
+    slow/corrupt shard is visible at the JOB level, not just in one
+    worker's log.  ``seen`` maps path -> last-surfaced mtime; returns the
+    new reports."""
+    import glob
+    import json as _json
+
+    out = []
+    for path in sorted(glob.glob(
+            os.path.join(health_dir, "input_stall.rank*.json"))):
+        try:
+            mtime = os.path.getmtime(path)
+            if seen.get(path) == mtime:
+                continue
+            with open(path) as f:
+                rep = _json.load(f)
+        except (OSError, ValueError):
+            continue
+        seen[path] = mtime
+        _m_input_stalls.labels(str(rep.get("rank", "?"))).inc()
+        sys.stderr.write(
+            f"launch: rank {rep.get('rank')} input stalled "
+            f"{rep.get('waited_s')}s on shard {rep.get('shard')!r} "
+            "(slow storage or a stuck decode worker — see docs/data.md "
+            "runbook)\n")
+        out.append(rep)
+    return out
 
 
 def get_cluster_endpoints(node_ips: List[str], nproc_per_node: int,
@@ -310,6 +345,7 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     restart_downtime_s = 0.0
     backoff = restart_backoff_s
     last_straggler_poll = 0.0
+    stall_seen: dict = {}
     try:
         procs = spawn_gang(0)
         all_procs = list(procs)
@@ -366,10 +402,12 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
             procs = alive
             if not procs:
                 break       # every worker exited 0
-            if straggler_mon is not None and \
+            if health_dir is not None and \
                     time.monotonic() - last_straggler_poll >= 2.0:
                 last_straggler_poll = time.monotonic()
-                straggler_mon.poll()
+                if straggler_mon is not None:
+                    straggler_mon.poll()
+                _poll_input_stall_reports(health_dir, stall_seen)
             time.sleep(0.2)
     finally:
         if in_main:

@@ -30,8 +30,55 @@ __all__ = [
     "shuffle", "buffered", "batch", "compose", "chain", "map_readers",
     "xmap_readers", "cache", "firstn", "multiprocess_reader",
     "Dataset", "IterableDataset", "BatchSampler", "DataLoader",
-    "prefetch_to_device",
+    "prefetch_to_device", "ClosingIterator",
 ]
+
+
+class ClosingIterator:
+    """Iterator wrapper with a deterministic shutdown surface for
+    producer-thread readers (``buffered``, ``prefetch_to_device``).
+
+    A consumer that exits early (exception, ``break``) used to leave the
+    daemon producer blocked on its bounded queue until interpreter exit.
+    ``close()`` (also via ``with`` or garbage collection) closes the
+    underlying generator — which signals the producer to stop and drains
+    the queue — and then JOINS the producer thread, so no run ends with a
+    leaked reader thread still holding file handles or device buffers.
+    """
+
+    def __init__(self, gen, thread_holder: Optional[list] = None,
+                 join_timeout: float = 5.0):
+        self._gen = gen
+        self._threads = thread_holder if thread_holder is not None else []
+        self._join_timeout = join_timeout
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._gen.close()    # runs the generator's finally: stop + drain
+        for t in list(self._threads):
+            if t is not None and t.is_alive():
+                t.join(timeout=self._join_timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def prefetch_to_device(batches, size: int = 2):
@@ -49,7 +96,9 @@ def prefetch_to_device(batches, size: int = 2):
     normalization, no extra host copy).
 
     Producer exceptions re-raise in the consumer; abandoning the iterator
-    unblocks and stops the producer.
+    unblocks, stops AND joins the producer (the returned
+    :class:`ClosingIterator` exposes ``close()`` and works as a context
+    manager — a consumer that breaks early leaks no thread).
 
     Self-reporting: the metrics registry carries the ready-batch queue
     depth (``paddle_prefetch_queue_depth`` — sampled at every consumer
@@ -117,36 +166,44 @@ def prefetch_to_device(batches, size: int = 2):
         else:
             put((False, _end))
 
-    # pipeline spin-up (thread start) is input-side wall time: charge it
-    # to input_stall so the first batch's latency is attributed, not lost
-    with _gp.timer("input_stall"):
-        t = threading.Thread(target=produce, daemon=True,
-                             name="device_prefetch")
-        t.start()
-    try:
-        import time as _time
+    threads: list = []
 
-        while True:
-            _g_depth.set(q.qsize())
-            t0 = _time.perf_counter_ns()
-            # the consumer's queue wait is the run's input stall: the
-            # device had nothing staged to chew on
-            with _gp.timer("input_stall"):
-                is_err, item = q.get()
-            _c_stall.inc((_time.perf_counter_ns() - t0) / 1e6)
-            if is_err:
-                raise item
-            if item is _end:
-                break
-            _c_batches.inc()
-            yield item
-    finally:
-        stop.set()
+    def consume():
+        # pipeline spin-up (thread start) is input-side wall time: charge
+        # it to input_stall so the first batch's latency is attributed,
+        # not lost
+        with _gp.timer("input_stall"):
+            t = threading.Thread(target=produce, daemon=True,
+                                 name="device_prefetch")
+            threads.append(t)
+            t.start()
         try:
+            import time as _time
+
             while True:
-                q.get_nowait()
-        except _queue.Empty:
-            pass
+                _g_depth.set(q.qsize())
+                t0 = _time.perf_counter_ns()
+                # the consumer's queue wait is the run's input stall: the
+                # device had nothing staged to chew on
+                with _gp.timer("input_stall"):
+                    is_err, item = q.get()
+                _c_stall.inc((_time.perf_counter_ns() - t0) / 1e6)
+                if is_err:
+                    raise item
+                if item is _end:
+                    break
+                _c_batches.inc()
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+            t.join(timeout=5)
+
+    return ClosingIterator(consume(), threads)
 
 
 # ---------------------------------------------------------------------------
@@ -226,30 +283,61 @@ def compose(*readers, check_alignment: bool = True):
 
 def buffered(reader, size: int):
     """Producer-thread read-ahead buffer — decorator.py buffered.
-    Producer exceptions are re-raised in the consumer, not swallowed."""
+    Producer exceptions are re-raised in the consumer, not swallowed.
+
+    Returns a reader whose iterator is a :class:`ClosingIterator`: a
+    consumer that stops early (``break``/exception/``close()``) unblocks
+    the producer's bounded put and joins the thread instead of leaking it.
+    """
     _end = object()
 
     def buffered_reader():
-        q: _queue.Queue = _queue.Queue(maxsize=size)
+        q: _queue.Queue = _queue.Queue(maxsize=max(1, int(size)))
+        stop = threading.Event()
+        threads: list = []
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for s in reader():
-                    q.put((False, s))
+                    if not put((False, s)):
+                        return
             except BaseException as e:
-                q.put((True, e))
+                put((True, e))
             else:
-                q.put((False, _end))
+                put((False, _end))
 
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
-        while True:
-            is_err, s = q.get()
-            if is_err:
-                raise s
-            if s is _end:
-                break
-            yield s
+        def consume():
+            t = threading.Thread(target=produce, daemon=True,
+                                 name="buffered_reader")
+            threads.append(t)
+            t.start()
+            try:
+                while True:
+                    is_err, s = q.get()
+                    if is_err:
+                        raise s
+                    if s is _end:
+                        break
+                    yield s
+            finally:
+                stop.set()
+                try:
+                    while True:
+                        q.get_nowait()
+                except _queue.Empty:
+                    pass
+                t.join(timeout=5)
+
+        return ClosingIterator(consume(), threads)
     return buffered_reader
 
 

@@ -45,6 +45,16 @@ config for the tier-1 lane):
   straggler         a 2-rank gang where rank 1 sleeps every step; the
                     supervisor's heartbeat poll flags rank 1
                     (paddle_straggler_detected_total) within the run
+  stream_faults   * sharded-stream input with every shard's first open
+                    failing (transient I/O) and 3 undecodable records
+                    interleaved: retries absorb the opens, the corrupt
+                    records land in the quarantine sidecar under the skip
+                    budget, and the final weights are bit-exact vs the
+                    clean stream baseline (docs/data.md)
+  stream_sigkill  * SIGKILL mid-epoch on a sharded stream; the restart
+                    restores the StreamState from the checkpoint's
+                    data_state (per-shard offsets, no batch replay) and
+                    finishes bit-exact vs the uninterrupted baseline
 
 Writes FAULT_BENCH.json.  Usage:
 
@@ -177,6 +187,7 @@ def worker(args):
     start = 0
     restored_from = None
     reshard_bit_exact = None
+    stream_restore = None
     latest = ck.latest_valid_step()
     if latest is not None:
         params, opt, man = restore_train_state(
@@ -187,8 +198,64 @@ def worker(args):
         if want is not None:
             got = _moment_leaf_crcs(opt["m"], layout, repl)
             reshard_bit_exact = (got == want)
+        stream_restore = (man.get("data") or {}).get("stream")
         _log(f"worker pid={os.getpid()} restored step {start} "
-             f"(reshard_bit_exact={reshard_bit_exact})")
+             f"(reshard_bit_exact={reshard_bit_exact}, "
+             f"stream={'yes' if stream_restore else 'no'})")
+
+    # sharded-stream input (ISSUE 11, docs/data.md): batches come from a
+    # fault-tolerant ShardedStream over token shard files instead of the
+    # per-step synthesizer; the checkpoint's data_state carries the
+    # batch-aligned StreamState, so a SIGKILL'd incarnation resumes the
+    # stream at the exact batch boundary it last committed
+    stream = None
+    if args.stream_dir:
+        import glob as _glob
+
+        from paddle_tpu.dataset import streaming as STR
+
+        shard_paths = sorted(_glob.glob(
+            os.path.join(args.stream_dir, "shard-*")))
+        seqlen = args.seqlen
+
+        def _decode(raw):
+            vals = np.array(raw.split(), dtype=np.int64)
+            if vals.size != 2 * seqlen:
+                raise ValueError(
+                    f"expected {2 * seqlen} tokens, got {vals.size}")
+            return (vals[:seqlen].astype(np.int32),
+                    vals[seqlen:].astype(np.int32))
+
+        open_fn = None
+        if args.stream_flaky:
+            # transient-I/O injection: the first N opens of every shard
+            # fail per incarnation — the retry policy must absorb them
+            flaky_counts = {}
+
+            def open_fn(path):
+                n = flaky_counts.get(path, 0)
+                if n < args.stream_flaky:
+                    flaky_counts[path] = n + 1
+                    raise OSError(
+                        f"injected transient open fault #{n + 1}")
+                return open(path, "rb")
+
+        sstate = (STR.StreamState.from_dict(stream_restore)
+                  if stream_restore else None)
+        stream = STR.ShardedStream(
+            shard_paths, _decode, STR.StreamConfig(
+                batch_size=args.batch, num_workers=2, drop_last=True,
+                skip_budget=args.stream_skip_budget,
+                quarantine_path=os.path.join(ckpt_dir, "quarantine.jsonl"),
+                retry=STR.RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                      max_delay_s=0.05)),
+            state=sstate, open_fn=open_fn, name="fault_bench")
+        stream_batches = stream.batches()
+
+    def next_stream_batch():
+        recs = next(stream_batches)
+        return (np.stack([r[0] for r in recs])[None],
+                np.stack([r[1] for r in recs])[None])
 
     with open(os.path.join(ckpt_dir, "incarnations.jsonl"), "a") as f:
         f.write(json.dumps({
@@ -210,10 +277,13 @@ def worker(args):
     def save(step_no):
         # the whole helper (crc computation included) is checkpoint wall
         with led.timer("checkpoint_save"):
+            data_state = {"epoch": 0, "offset": step_no}
+            if stream is not None:
+                data_state["stream"] = stream.state_dict()
             ck.save(step_no, {"params": params, "opt": opt},
                     mesh={"dp": args.dp, "pp": 1, "tp": 1},
                     layout=layout, layout_repl=repl,
-                    data_state={"epoch": 0, "offset": step_no},
+                    data_state=data_state,
                     extra={"moment_leaf_crcs":
                            _moment_leaf_crcs(opt["m"], layout, repl)})
             # commit synchronously: the harness injects faults
@@ -240,7 +310,8 @@ def worker(args):
             sys.exit(0)
         if args.straggle_ms and rank == args.straggle_rank:
             time.sleep(args.straggle_ms / 1000.0)
-        toks, labs = _batch(step, cfg, args.batch, args.seqlen)
+        toks, labs = (next_stream_batch() if stream is not None
+                      else _batch(step, cfg, args.batch, args.seqlen))
         fn = (bad_step_fn if injecting and step >= args.diverge_at
               else step_fn)
         params, opt, loss, _ = fn(params, opt, toks, labs)
@@ -304,6 +375,20 @@ def worker(args):
         "reshard_bit_exact": reshard_bit_exact,
         "dp": args.dp,
     }
+    if stream is not None:
+        sidecar = stream.quarantine_path
+        q_lines = 0
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                q_lines = sum(1 for ln in f if ln.strip())
+        result["stream"] = {
+            "retries": stream.retries,            # this incarnation's
+            "quarantined": stream.quarantined,    # in-process counts
+            "quarantine_sidecar": sidecar,
+            "quarantine_lines": q_lines,          # cumulative (appended)
+            "resumed_from_stream_state": bool(stream_restore),
+            "state": stream.state_dict(),
+        }
     if heartbeat is not None:
         heartbeat.flush()
     if guard is not None:
@@ -456,6 +541,35 @@ def poison_batch_scenario(steps=6, batch=4, din=8, poison_at=3,
                      and s["weights_bit_exact_vs_no_poison"]
                      and np.isfinite(losses[-1]))
     return s
+
+
+def _write_stream_shards(dirname, n_shards, n_records, seqlen, vocab,
+                         corrupt=()):
+    """Token shard files for the stream lanes: record r's content derives
+    only from rng(5000+r) — independent of the sharding — so a clean run
+    and a faulty run over the same good records are batch-identical.
+    ``corrupt`` = [(shard_idx, before_line)] INSERTS undecodable lines
+    (extra lines, not replacements): a correct quarantine path skips them
+    and the good-record stream — hence the final weights — is bit-exact
+    vs the clean layout."""
+    import numpy as np
+
+    os.makedirs(dirname, exist_ok=True)
+    per = n_records // n_shards
+    rec = 0
+    for si in range(n_shards):
+        path = os.path.join(dirname, f"shard-{si}")
+        with open(path, "w") as f:
+            for j in range(per):
+                for ci, cj in corrupt:
+                    if ci == si and cj == j:
+                        f.write("CORRUPT record not-an-int\n")
+                r = np.random.default_rng(5000 + rec)
+                row = np.concatenate([r.integers(0, vocab, seqlen),
+                                      r.integers(0, vocab, seqlen)])
+                f.write(" ".join(map(str, row)) + "\n")
+                rec += 1
+    return dirname
 
 
 def _incarnations(ckpt_dir):
@@ -637,6 +751,89 @@ def harness(smoke, out_path):
          f"{s['all_ranks_skipped_identically']}, bit_exact="
          f"{s['weights_bit_exact_vs_no_poison']})")
 
+    # --- sharded-stream lanes (ISSUE 11, docs/data.md) -------------------
+    # stream baseline: the same training but batches come from token shard
+    # files through the fault-tolerant ShardedStream — the reference for
+    # both stream fault scenarios
+    from paddle_tpu.models import gpt as _G
+    stream_vocab = _G.GPT_TINY.vocab_size
+    n_records = base["steps"] * base["batch"]
+    clean_dir = _write_stream_shards(
+        os.path.join(work, "stream_clean"), 4, n_records, base["seqlen"],
+        stream_vocab)
+    ns = run("stream_baseline", stream_dir=clean_dir)
+    rc, sbase = _run_job(ns, max_restarts=0)
+    assert rc == 0 and sbase, f"stream baseline failed rc={rc}"
+    scenarios["stream_baseline"] = sbase
+    _log(f"stream_baseline loss {sbase['final_loss']}")
+
+    # --- injected transient I/O faults + one corrupt shard ---------------
+    # every shard's first open fails once (retry/backoff must absorb it)
+    # and 3 undecodable records are interleaved into shards 1 and 2 —
+    # quarantined to the sidecar under the skip budget; the good-record
+    # stream is unchanged, so the final weights must be bit-exact vs the
+    # clean stream baseline
+    fault_dir = _write_stream_shards(
+        os.path.join(work, "stream_faulty"), 4, n_records, base["seqlen"],
+        stream_vocab, corrupt=[(1, 0), (1, 2), (2, 1)])
+    ns = run("stream_faults", stream_dir=fault_dir, stream_flaky=1,
+             stream_skip_budget=4)
+    rc, res = _run_job(ns, max_restarts=0)
+    sres = (res or {}).get("stream") or {}
+    s = {
+        "rc": rc, "result": res,
+        "injected_open_faults": 4, "injected_corrupt_records": 3,
+        "retries": sres.get("retries"),
+        "quarantined": sres.get("quarantined"),
+        "quarantine_lines": sres.get("quarantine_lines"),
+        "quarantine_sidecar": sres.get("quarantine_sidecar"),
+        "match_stream_baseline": _match(res and res["final_loss"],
+                                        sbase["final_loss"]),
+        "params_match": bool(res) and
+            res["params_crc"] == sbase["params_crc"],
+    }
+    s["pass"] = (rc == 0 and (s["retries"] or 0) >= 4
+                 and s["quarantined"] == 3 and s["quarantine_lines"] == 3
+                 and s["match_stream_baseline"] == "bit_exact"
+                 and s["params_match"])
+    scenarios["stream_faults"] = s
+    ok &= s["pass"]
+    _log(f"stream_faults: {s['pass']} (retries {s['retries']}, "
+         f"quarantined {s['quarantined']}, {s['match_stream_baseline']})")
+
+    # --- SIGKILL mid-epoch on the sharded stream -------------------------
+    # the restarted incarnation must restore the StreamState from the
+    # committed checkpoint's data_state and resume the shard offsets —
+    # final weights bit-exact vs the uninterrupted stream baseline
+    ns = run("stream_sigkill", stream_dir=clean_dir, die_at=die_at,
+             die_sig="KILL",
+             once_marker=os.path.join(work, "stream_sigkill.marker"))
+    rc, res = _run_job(ns, max_restarts=2)
+    inc = _incarnations(ns["ckpt_dir"])
+    expect_restore = (die_at // base["interval"]) * base["interval"]
+    sres = (res or {}).get("stream") or {}
+    s = {
+        "rc": rc, "result": res,
+        "incarnations": len(inc),
+        "supervisor_restarts": max(0, len(inc) - 1),
+        "restored_from": [r["restored_from"] for r in inc],
+        "expected_restore": expect_restore,
+        "resumed_from_stream_state": sres.get("resumed_from_stream_state"),
+        "match_stream_baseline": _match(res and res["final_loss"],
+                                        sbase["final_loss"]),
+        "params_match": bool(res) and
+            res["params_crc"] == sbase["params_crc"],
+    }
+    s["pass"] = (rc == 0 and s["supervisor_restarts"] >= 1
+                 and inc and inc[-1]["restored_from"] == expect_restore
+                 and s["resumed_from_stream_state"] is True
+                 and s["match_stream_baseline"] == "bit_exact"
+                 and s["params_match"])
+    scenarios["stream_sigkill"] = s
+    ok &= s["pass"]
+    _log(f"stream_sigkill: {s['pass']} (restored "
+         f"{s['restored_from']}, {s['match_stream_baseline']})")
+
     if not smoke:
         # --- divergence -> guardrail rollback + LR cooldown --------------
         dv_steps = base["steps"] + 2
@@ -801,6 +998,16 @@ def main():
     ap.add_argument("--diverge-lr", type=float, default=30.0)
     ap.add_argument("--guard-k", type=int, default=2,
                     help="consecutive bad steps before rollback")
+    # sharded-stream input lanes (ISSUE 11, docs/data.md)
+    ap.add_argument("--stream-dir",
+                    help="feed batches from token shard files through the "
+                         "fault-tolerant ShardedStream; checkpoints carry "
+                         "the StreamState for deterministic resume")
+    ap.add_argument("--stream-flaky", type=int, default=0,
+                    help="fail the first N opens of every shard per "
+                         "incarnation (transient I/O injection)")
+    ap.add_argument("--stream-skip-budget", type=int, default=8,
+                    help="per-shard corrupt-record quarantine budget")
     args = ap.parse_args()
     if args.worker:
         worker(args)

@@ -39,7 +39,8 @@ sys.path.insert(0, REPO)
 
 REQUIRED_KEYS = ("step", "step_time_ms", "host_dispatch_ms",
                  "device_wait_ms", "examples_per_s", "mfu", "loss",
-                 "nan_inf", "overlap_fraction")
+                 "nan_inf", "overlap_fraction", "input_wait_ms",
+                 "quarantined_records")
 
 # Prometheus text exposition grammar, line by line (comment | sample).
 PROM_LINE_RX = re.compile(
@@ -340,6 +341,84 @@ def _run_check_inner(out_dir: str) -> dict:
         f"guardrail skip counter moved by {skips_delta}, expected exactly " \
         "1 for the single seeded NaN batch"
 
+    # --- streaming input families (docs/data.md, ISSUE 11) --------------
+    # a seeded faulty stream: shard-0's first open fails once (the retry
+    # must absorb it), shard-1 carries one undecodable record (quarantine
+    # sidecar + counter), shard-2 decodes slowly (the consumer wait must
+    # land in the goodput ledger's input_stall and the per-shard progress
+    # gauge must expose the resume offsets)
+    import time as _time
+
+    from paddle_tpu.dataset import streaming as STR
+    from paddle_tpu.observability import goodput as goodput_mod
+
+    sdir = os.path.join(out_dir, "stream_shards")
+    os.makedirs(sdir, exist_ok=True)
+    stream_paths = []
+    for si in range(3):
+        p = os.path.join(sdir, f"shard-{si}")
+        with open(p, "w") as f:
+            for j in range(8):
+                f.write(f"{si} {j}\n")
+            if si == 1:
+                f.write("CORRUPT not-an-int\n")
+        stream_paths.append(p)
+
+    def _sdecode(raw):
+        a, b = raw.split()
+        if int(a) == 2:
+            _time.sleep(0.02)   # the seeded slow shard
+        return (int(a), int(b))
+
+    _opens = {"fails": 0}
+
+    def _sopen(path):
+        if path.endswith("shard-0") and _opens["fails"] < 1:
+            _opens["fails"] += 1
+            raise OSError("injected transient open fault")
+        return open(path, "rb")
+
+    qpath = os.path.join(out_dir, "quarantine.jsonl")
+    retries_before = _counter_sum("paddle_input_retries_total")
+    quarantined_before = _counter_sum(
+        "paddle_input_records_quarantined_total")
+    stall_before = goodput_mod.ledger().category_seconds("input_stall")
+    st = STR.ShardedStream(
+        stream_paths, _sdecode,
+        STR.StreamConfig(batch_size=4, num_workers=2, skip_budget=2,
+                         quarantine_path=qpath,
+                         retry=STR.RetryPolicy(max_attempts=3,
+                                               base_delay_s=0.01,
+                                               max_delay_s=0.02)),
+        open_fn=_sopen, name="metrics_check")
+    stream_recs = [r for b in st.batches() for r in b]
+    assert stream_recs == [(si, j) for si in range(3) for j in range(8)], \
+        f"stream yielded wrong records: {stream_recs}"
+    retries_delta = _counter_sum("paddle_input_retries_total") \
+        - retries_before
+    assert retries_delta >= 1, \
+        f"paddle_input_retries_total moved by {retries_delta} under a " \
+        "seeded transient open fault (expected >= 1)"
+    quarantined_delta = _counter_sum(
+        "paddle_input_records_quarantined_total") - quarantined_before
+    assert quarantined_delta == 1, \
+        f"quarantine counter moved by {quarantined_delta} for exactly 1 " \
+        "seeded corrupt record"
+    q_entries = [json.loads(ln) for ln in open(qpath)]
+    assert len(q_entries) == 1 and q_entries[0]["shard"] == "shard-1", \
+        q_entries
+    input_stall_delta = goodput_mod.ledger().category_seconds(
+        "input_stall") - stall_before
+    assert input_stall_delta > 0, \
+        "goodput input_stall did not move under the seeded slow shard"
+    snap = default_registry().snapshot()
+    progress = {s["labels"][0]: s["value"] for s in
+                snap["paddle_input_shard_progress"]["series"]}
+    assert progress.get("shard-0") == 8 and progress.get("shard-2") == 8, \
+        progress
+    assert progress.get("shard-1") == 9, \
+        f"shard-1 offset must include the quarantined record: {progress}"
+
     # --- static-analysis lint counter (docs/static_analysis.md) --------
     # lint the same MLP program the train loop just ran: the program must
     # be error-clean, and every finding must land in
@@ -495,6 +574,18 @@ def _run_check_inner(out_dir: str) -> dict:
                  "paddle_serve_queue_wait_ms"):
         assert name in prom_text, f"{name} missing from exposition"
     assert 'paddle_serve_requests_total{code="200"}' in prom_text
+    # streaming input families (docs/data.md): the seeded faulty stream
+    # above must have left retry/quarantine/progress samples
+    for name in ("paddle_input_retries_total",
+                 "paddle_input_records_quarantined_total",
+                 "paddle_input_shard_progress",
+                 "paddle_input_worker_recycles_total",
+                 "paddle_input_stall_seconds_total"):
+        assert name in prom_text, f"{name} missing from exposition"
+    assert 'paddle_input_retries_total{stage="open"}' in prom_text, \
+        "open-stage retry sample missing from exposition"
+    assert 'paddle_input_shard_progress{shard=' in prom_text, \
+        "per-shard progress gauge missing from exposition"
     # goodput families (docs/observability.md): every category present
     for c in goodput.CATEGORIES:
         assert f'paddle_goodput_seconds_total{{category="{c}"}}' \
@@ -502,6 +593,9 @@ def _run_check_inner(out_dir: str) -> dict:
     assert "paddle_goodput_wall_seconds_total" in prom_text
 
     return {"steps": len(records), "prom_samples": samples,
+            "input_retries": retries_delta,
+            "input_quarantined": quarantined_delta,
+            "input_stall_s": round(input_stall_delta, 4),
             "serve_requests": int(serve_200.get(("200",), 0)),
             "serve_steady_state_recompiles": int(serve_recompiles),
             "program_reports": len(reports),
