@@ -76,6 +76,7 @@ from .data_feed_desc import DataFeedDesc  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import profiler  # noqa: F401
 from . import observability  # noqa: F401
+from . import sharding  # noqa: F401
 
 # fluid-style aliases
 CUDAPlace = XLAPlace  # reference scripts swap transparently

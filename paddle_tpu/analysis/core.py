@@ -113,6 +113,7 @@ class AnalysisContext:
                  peer_programs: Sequence[Any] = (),
                  donated: Optional[Sequence[str]] = None,
                  bucket_layouts: Sequence[Any] = (),
+                 live_mesh: Optional[Dict[str, int]] = None,
                  flags: Optional[Dict[str, Any]] = None):
         self.program = program
         self.feed_names = list(feed_names)
@@ -120,6 +121,10 @@ class AnalysisContext:
         self.peer_programs = list(peer_programs)
         self.donated = list(donated) if donated is not None else None
         self.bucket_layouts = list(bucket_layouts)
+        # {axis: size} of the mesh the caller is ABOUT to run/restore on;
+        # the sharding checker diffs it against the program's annotated
+        # mesh (mesh_mismatch_at_restore)
+        self.live_mesh = dict(live_mesh) if live_mesh is not None else None
         if flags is None:
             from ..framework.core import flags_snapshot
 
@@ -208,7 +213,7 @@ def get_checker(name: str) -> CheckerFn:
 def _load_builtin_checkers():
     # import for side effect (registration); idempotent
     from . import (collectives, donation, precision,  # noqa: F401
-                   recompile, shapes, verifier)
+                   recompile, shapes, sharding, verifier)
 
 
 def analyze_program(program, feed_names: Sequence[str] = (),

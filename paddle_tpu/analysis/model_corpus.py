@@ -245,6 +245,57 @@ def build_serving_decode() -> ModelProgram:
                         [prob.name, new_cache.name])
 
 
+def build_mlp_dp() -> ModelProgram:
+    """The mlp with GSPMD-style dp annotations (ISSUE 12): ONLY the two
+    data feeds are annotated batch-sharded; propagation derives every
+    activation/grad spec, weights replicate, and the loss reduction
+    surfaces as the one implied psum edge — the sharding checker must
+    find zero errors."""
+    from paddle_tpu import sharding
+
+    mp = build_mlp()
+    sharding.annotate_program(
+        mp.main, {"x": ("dp", None), "y": ("dp", None)},
+        mesh_axes=[("dp", 8)], data_axis="dp")
+    return ModelProgram("mlp_dp", mp.main, mp.startup, mp.feed_names,
+                        mp.fetch_names)
+
+
+def build_gpt_tp2() -> ModelProgram:
+    """The fluid gpt with a Megatron tp=2 annotation set: embedding
+    replicated, first fc column-split, second fc row-split — propagation
+    derives the column-split bias, detects the partial-sum pair, and
+    records the implied psum edge (info), with zero errors."""
+    from paddle_tpu import sharding
+
+    mp = build_gpt()
+    sharding.annotate_program(
+        mp.main,
+        {"wte": (), "fc_0.w_0": (None, "tp"), "fc_1.w_0": ("tp", None)},
+        mesh_axes=[("tp", 2)])
+    return ModelProgram("gpt_tp2", mp.main, mp.startup, mp.feed_names,
+                        mp.fetch_names)
+
+
+def build_gpt_fsdp() -> ModelProgram:
+    """The fluid gpt with fsdp-style annotations: every weight matrix
+    (embedding included) sharded dim-0 over dp — propagation records the
+    implied gathers (fsdp's all-gather-for-compute) as info edges, zero
+    errors."""
+    from paddle_tpu import sharding
+
+    mp = build_gpt()
+    mesh = [("dp", 8)]
+    ann = {"wte": ("dp", None)}
+    for p in mp.main.all_parameters():
+        if p.ndim == 2 and p.name != "wte" and p.shape[0] % 8 == 0:
+            ann[p.name] = ("dp", None)
+    sharding.annotate_program(mp.main, ann, mesh_axes=mesh,
+                              data_axis="dp")
+    return ModelProgram("gpt_fsdp", mp.main, mp.startup, mp.feed_names,
+                        mp.fetch_names)
+
+
 MODEL_BUILDERS: "Dict[str, Callable[[], ModelProgram]]" = {
     "mlp": build_mlp,
     "gpt": build_gpt,
@@ -255,6 +306,9 @@ MODEL_BUILDERS: "Dict[str, Callable[[], ModelProgram]]" = {
     "ps_transpiled": build_ps_transpiled,
     "serving_prefill": build_serving_prefill,
     "serving_decode": build_serving_decode,
+    "mlp_dp": build_mlp_dp,
+    "gpt_tp2": build_gpt_tp2,
+    "gpt_fsdp": build_gpt_fsdp,
 }
 
 

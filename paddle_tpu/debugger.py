@@ -32,7 +32,31 @@ def _propagated(block, annotate: bool):
         return {}
 
 
-def _var_line(v, prop):
+def _sharding_info(block, annotate: bool):
+    """(specs, {op_idx: [reshard notes]}) from the GSPMD propagation pass
+    (paddle_tpu/sharding/) — ({}, {}) when the program carries no
+    annotations or propagation is unavailable (never raises)."""
+    if not annotate:
+        return {}, {}
+    try:
+        from .sharding import propagate_program
+        from .sharding.spec import annotated_vars, mesh_axes_of
+
+        program = block.program
+        if not annotated_vars(program) and mesh_axes_of(program) is None:
+            return {}, {}
+        res = propagate_program(program)
+        reshards = {}
+        for r in res.reshards:
+            if r.block_idx == block.idx:
+                reshards.setdefault(r.op_idx, []).append(
+                    f"{r.kind} {r.var!r} ~{r.bytes_est}B")
+        return res.specs, reshards
+    except Exception:
+        return {}, {}
+
+
+def _var_line(v, prop, shard_specs=()):
     tag = "param" if getattr(v, "persistable", False) else "var"
     decl_shape = getattr(v, "shape", None)
     decl_dtype = getattr(v, "dtype", None)
@@ -44,15 +68,24 @@ def _var_line(v, prop):
             line += f"  [propagated shape={tuple(p_shape)} dtype={p_dtype} !]"
         else:
             line += "  [propagated ok]"
+    spec = shard_specs.get(v.name) if shard_specs else None
+    if spec is not None:
+        from .sharding.spec import is_replicated, spec_str
+
+        if not is_replicated(spec):
+            line += f"  [spec {spec_str(spec)}]"
+        elif getattr(v, "sharding", None) is not None:
+            line += "  [spec replicated]"
     return line
 
 
 def pprint_block_codes(block, show_backward=False, annotate=True):
     prop = _propagated(block, annotate)
+    shard_specs, reshards = _sharding_info(block, annotate)
     lines = [f"block {block.idx} (parent {block.parent_idx}):"]
     for v in block.vars.values():
-        lines.append(_var_line(v, prop))
-    for op in block.ops:
+        lines.append(_var_line(v, prop, shard_specs))
+    for i, op in enumerate(block.ops):
         if not show_backward and op.type.endswith("_grad"):
             continue
         ins = ", ".join(f"{k}={v}" for k, v in (op.inputs or {}).items() if v)
@@ -60,7 +93,12 @@ def pprint_block_codes(block, show_backward=False, annotate=True):
                          if v)
         # ops with no outputs (send, barrier, prints) render with an
         # explicit empty arrow instead of crashing the formatter
-        lines.append(f"  {op.type}({ins}) -> {outs if outs else '()'}")
+        line = f"  {op.type}({ins}) -> {outs if outs else '()'}"
+        if i in reshards:
+            # implied layout change on this edge — the "why did this
+            # reshard" breadcrumb (docs/sharding.md runbook)
+            line += "  [RESHARD " + "; ".join(reshards[i]) + "]"
+        lines.append(line)
     return "\n".join(lines)
 
 
@@ -79,6 +117,7 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot",
     shape/dtype annotation when available."""
     highlights = set(highlights or ())
     prop = _propagated(block, annotate)
+    shard_specs, _reshards = _sharding_info(block, annotate)
     lines = ["digraph G {", "  rankdir=TB;"]
     var_ids = {}
     for i, v in enumerate(block.vars.values()):
@@ -91,6 +130,11 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot",
         if hit is not None:
             p_shape, p_dtype = hit
             label += f"\\n{list(p_shape)} {p_dtype}"
+        spec = shard_specs.get(v.name)
+        if spec is not None and any(e is not None for e in spec):
+            from .sharding.spec import spec_str
+
+            label += f"\\n{spec_str(spec)}"
         lines.append(f'  var_{i} [label="{label}", shape={shape}{color}];')
     for j, op in enumerate(block.ops):
         lines.append(f'  op_{j} [label="{op.type}", shape=record, '

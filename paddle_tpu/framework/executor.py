@@ -357,8 +357,13 @@ class _CompiledBlock:
                           for n in self.param_names if n in written}
             const_sh = {n: named_sharding(mesh, param_spec(n))
                         for n in self.param_names if n not in written}
-            feed_sh = {n: named_sharding(mesh, feed_dims(shape))
-                       for n, shape, _ in feed_sig}
+            # annotated feeds (sharding propagation, paddle_tpu/sharding/)
+            # use their propagated spec; unannotated ones keep the
+            # batch-dim heuristic
+            feed_sh = {n: named_sharding(
+                mesh, param_spec(n) if param_spec(n) is not None
+                else feed_dims(shape))
+                for n, shape, _ in feed_sig}
             rng_sh = named_sharding(mesh, None)
             self._jitted = jax.jit(
                 fn,

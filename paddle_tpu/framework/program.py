@@ -41,6 +41,7 @@ class Variable:
         is_data: bool = False,
         need_check_feed: bool = False,
         initializer=None,
+        sharding=None,
         **kwargs,
     ):
         self.block = block
@@ -56,6 +57,11 @@ class Variable:
         self.need_check_feed = need_check_feed
         # Optional initializer record (consumed when building startup programs).
         self.initializer = initializer
+        # GSPMD-style PartitionSpec annotation: per-dim axis-name tuple
+        # (None = replicated dim), set by sharding.shard_tensor / the
+        # propagation pass, consumed by the executor's gspmd mode and
+        # persisted through the desc round-trip (paddle_tpu/sharding/).
+        self.sharding = tuple(sharding) if sharding is not None else None
         # op that produced it last (filled lazily when needed)
 
     # -- info helpers -------------------------------------------------------
@@ -73,7 +79,7 @@ class Variable:
         return tensor_layers.cast(self, dtype)
 
     def _desc_dict(self):
-        return {
+        d = {
             "name": self.name,
             "shape": list(self.shape),
             "dtype": self.dtype,
@@ -82,6 +88,13 @@ class Variable:
             "stop_gradient": self.stop_gradient,
             "is_data": self.is_data,
         }
+        if getattr(self, "sharding", None) is not None:
+            # only annotated vars carry the key: unannotated programs'
+            # descs (and fingerprints) stay byte-stable
+            from ..sharding.spec import spec_to_json
+
+            d["sharding"] = spec_to_json(self.sharding)
+        return d
 
     def __repr__(self):
         return (
@@ -458,6 +471,7 @@ class Program:
                         stop_gradient=v.stop_gradient,
                         is_data=v.is_data,
                     )
+                nv.sharding = getattr(v, "sharding", None)
                 nb.vars[nv.name] = nv
             for op in blk.ops:
                 if for_test and op.attr("is_test_skip", False):
@@ -506,11 +520,20 @@ class Program:
         return hashlib.sha1(payload.encode()).hexdigest()
 
     def _desc_dict(self):
-        return {
+        d = {
             "version": 1,
             "random_seed": self.random_seed,
             "blocks": [b._desc_dict() for b in self.blocks],
         }
+        # sharding-relevant annotations ride the desc (mesh plan + the
+        # explicit annotation seed set) so annotated programs survive the
+        # save/load round-trip; absent on unannotated programs
+        ann = {k: self._annotations[k]
+               for k in ("mesh", "sharding_annotated")
+               if self._annotations.get(k) is not None}
+        if ann:
+            d["annotations"] = ann
+        return d
 
     def __repr__(self):
         return "\n".join(repr(b) for b in self.blocks)

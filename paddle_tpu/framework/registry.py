@@ -44,6 +44,11 @@ class OpSpec:
     needs_rng: bool = False
     # op mutates persistable state (optimizer ops) — affects executor outputs
     is_optimizer: bool = False
+    # GSPMD-style sharding propagation rule (paddle_tpu/sharding/rules.py):
+    # fn(RuleCtx) derives/refines PartitionSpecs for the op's vars in both
+    # directions. None -> the propagation pass falls back to conservative
+    # replication (and reports the coverage gap).
+    sharding_rule: Optional[Callable] = None
 
 
 _OPS: Dict[str, OpSpec] = {}
@@ -316,6 +321,24 @@ def lower_vjp_grad(ctx: LowerCtx, op, ins, fwd_spec: OpSpec):
 # ---------------------------------------------------------------------------
 
 _DYN = 97  # stand-in extent for -1 dims during eval_shape (prime, unlikely real)
+
+
+def set_sharding_rule(op_type: str, fn) -> None:
+    """Attach (or replace) an op's sharding-propagation rule after
+    registration — the sibling of :func:`set_infer_shape` for the
+    GSPMD-style propagation pass (paddle_tpu/sharding/).  Rules for the
+    built-in op families live in sharding/rules.py and register through
+    exactly this hook."""
+    spec = _OPS[op_type]
+    _OPS[op_type] = dataclasses.replace(spec, sharding_rule=fn)
+
+
+def get_sharding_rule(op_type: str) -> Optional[Callable]:
+    """The registered rule for ``op_type`` (grad ops resolve through
+    their forward spec only if explicitly registered; the propagation
+    pass has a generic grad tie-rule instead)."""
+    spec = _OPS.get(op_type)
+    return spec.sharding_rule if spec is not None else None
 
 
 def set_infer_shape(op_type: str, fn) -> None:

@@ -26,7 +26,7 @@ def program_from_desc(desc: Dict) -> Program:
     program._seed_counter = 0
     program._is_start_up_program = False
     program._pass_applied = []
-    program._annotations = {}
+    program._annotations = dict(desc.get("annotations", {}))
     for bdesc in desc["blocks"]:
         blk = Block(program, bdesc["idx"], bdesc.get("parent_idx", -1))
         blk.forward_block_idx = bdesc.get("forward_block_idx", -1)
@@ -51,6 +51,10 @@ def program_from_desc(desc: Dict) -> Program:
                     stop_gradient=vdesc.get("stop_gradient", False),
                     is_data=vdesc.get("is_data", False),
                 )
+            if vdesc.get("sharding") is not None:
+                from ..sharding.spec import spec_from_json
+
+                var.sharding = spec_from_json(vdesc["sharding"])
             blk.vars[var.name] = var
         for odesc in bdesc["ops"]:
             op = Operator(

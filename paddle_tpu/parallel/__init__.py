@@ -13,6 +13,7 @@ from .launch import (  # noqa: F401
 )
 from .checkpoint import (  # noqa: F401
     CheckpointCorruptError, CheckpointError, ElasticCheckpointer,
-    ShardedCheckpointer, abstract_for_mesh, abstract_like, reshard_flat,
+    MeshMismatchError, ShardedCheckpointer, abstract_for_mesh,
+    abstract_like, check_mesh_compatible, reshard_flat,
     restore_train_state,
 )

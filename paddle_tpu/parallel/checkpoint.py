@@ -54,7 +54,8 @@ from ..observability import spans as _spans
 _gp = _goodput.ledger()
 
 __all__ = [
-    "CheckpointError", "CheckpointCorruptError",
+    "CheckpointError", "CheckpointCorruptError", "MeshMismatchError",
+    "check_mesh_compatible",
     "ElasticCheckpointer", "ShardedCheckpointer",
     "abstract_for_mesh", "abstract_like",
     "serialize_layout", "deserialize_layout", "reshard_flat",
@@ -81,6 +82,44 @@ class CheckpointError(RuntimeError):
 
 class CheckpointCorruptError(CheckpointError):
     """A committed checkpoint failed integrity verification."""
+
+
+class MeshMismatchError(CheckpointError):
+    """The manifest's mesh/sharding metadata contradicts the live mesh
+    and no reshard path covers the difference — restoring would place
+    every shard wrong silently (ISSUE 12; the dynamic twin of the
+    sharding checker's ``mesh_mismatch_at_restore`` finding)."""
+
+
+def check_mesh_compatible(saved_mesh: Optional[Dict[str, int]],
+                          live_mesh: Optional[Dict[str, int]], *,
+                          reshardable: bool = False,
+                          where: str = "checkpoint") -> None:
+    """Raise :class:`MeshMismatchError` when ``saved_mesh`` (manifest
+    metadata) cannot restore onto ``live_mesh``.
+
+    Same axes + same sizes always pass; same axis NAMES with different
+    sizes pass only when the caller has a reshard path
+    (``reshardable=True`` — the flat-moment bucket relayout of
+    :func:`reshard_flat`); different axis sets never pass. ``None`` on
+    either side skips the check (older manifests / callers that don't
+    know their mesh)."""
+    if not saved_mesh or not live_mesh:
+        return
+    saved = {str(k): int(v) for k, v in dict(saved_mesh).items()}
+    live = {str(k): int(v) for k, v in dict(live_mesh).items()}
+    if saved == live:
+        return
+    if set(saved) == set(live) and reshardable:
+        return
+    detail = ("axis sets differ" if set(saved) != set(live) else
+              "axis sizes differ and no reshardable layout was provided")
+    raise MeshMismatchError(
+        f"{where}: saved mesh {saved} does not match the live mesh "
+        f"{live} ({detail}) — restoring would silently misplace shards. "
+        "Restore onto the saved topology, or provide the source+target "
+        "bucket layouts for the dp reshard path (docs/elastic.md, "
+        "docs/sharding.md).")
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -529,7 +568,8 @@ class ElasticCheckpointer:
         return by_key, man
 
     def restore(self, step: Optional[int] = None, like: Any = None,
-                verify: bool = True) -> Tuple[Any, dict]:
+                verify: bool = True,
+                mesh: Optional[Dict[str, int]] = None) -> Tuple[Any, dict]:
         """Load one committed step; returns ``(state, manifest)``.
 
         ``step=None`` selects the latest committed step.  ``verify=True``
@@ -541,8 +581,15 @@ class ElasticCheckpointer:
         reconstructed from the keypaths (flat {keypath: array} fallback
         for non-dict pytrees).  Leaves come back as numpy arrays — callers
         place them on device (see :func:`restore_train_state` for the
-        resharding path)."""
+        resharding path).
+
+        ``mesh={axis: size}`` validates the manifest's saved mesh against
+        the live one and raises :class:`MeshMismatchError` instead of a
+        silently wrong placement (the plain restore has no reshard
+        path — any topology difference is fatal here)."""
         by_key, man = self._restore_flat(step, verify=verify)
+        check_mesh_compatible(man.get("mesh"), mesh, reshardable=False,
+                              where=f"restore step {man['step']}")
         if like is None:
             return _unflatten_keystrs(by_key), man
         flat, treedef = jax.tree_util.tree_flatten_with_path(like)
@@ -574,7 +621,8 @@ _FLAT_OPT_KEYS = ("m", "v", "ef")
 
 def restore_train_state(ckpt: ElasticCheckpointer, params, opt, *,
                         layout=None, layout_repl: int = 1,
-                        step: Optional[int] = None):
+                        step: Optional[int] = None,
+                        mesh: Optional[Dict[str, int]] = None):
     """Restore a ``(params, opt)`` train state saved by
     :meth:`ElasticCheckpointer.save`, resharding onto the CURRENT topology.
 
@@ -592,6 +640,13 @@ def restore_train_state(ckpt: ElasticCheckpointer, params, opt, *,
     src_layout = src_repl = None
     if src is not None:
         src_layout, src_repl = deserialize_layout(src)
+    # mesh validation (ISSUE 12): a topology change is only legal through
+    # the flat-moment reshard path — both layouts must exist; anything
+    # else raises the named error instead of resharding wrong silently
+    check_mesh_compatible(
+        man.get("mesh"), mesh,
+        reshardable=(src_layout is not None and layout is not None),
+        where=f"restore_train_state step {man['step']}")
 
     def place(key: str, target):
         if key not in raw:

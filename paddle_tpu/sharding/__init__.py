@@ -1,0 +1,42 @@
+"""GSPMD-style sharding propagation over the Program IR (ISSUE 12;
+docs/sharding.md; GSPMD arXiv:2105.04663).
+
+One sharding layer for dp / tp / fsdp and their compositions:
+
+    from paddle_tpu import sharding
+
+    # IR side: annotate a handful of vars, propagate, lower via the
+    # executor's jax.jit + NamedSharding gspmd mode
+    sharding.annotate_program(prog, {"x": ("dp", None)},
+                              mesh_axes=[("dp", 8)], data_axis="dp")
+    result = sharding.apply_sharding(prog)
+    assert result.complete, result.report()
+
+    # engine side: the same annotations drive the pure-JAX train step
+    step = parallelize.make_train_step(cfg, pcfg, mesh, sharding="fsdp")
+"""
+from .spec import (SpecConflict, annotate_program, annotated_vars,  # noqa: F401
+                   is_replicated, merge_specs, mesh_axes_of,
+                   normalize_spec, pad_spec, shard_tensor, spec_axes,
+                   spec_from_json, spec_str, spec_to_json,
+                   to_partition_spec)
+from .propagate import (Conflict, PropagationResult, Reshard,  # noqa: F401
+                        RuleCtx, propagate_program)
+from .lower import apply_sharding, mesh_from_axes, named_shardings  # noqa: F401
+from .plan import (PRESETS, ShardingPlan, complete_pytree_specs,  # noqa: F401
+                   gpt_annotations, make_gpt_plan, resolve_plan)
+from . import rules as _rules
+
+_rules.ensure_registered()
+
+__all__ = [
+    "SpecConflict", "annotate_program", "annotated_vars", "shard_tensor",
+    "normalize_spec", "pad_spec", "merge_specs", "spec_axes", "spec_str",
+    "spec_to_json", "spec_from_json", "to_partition_spec", "is_replicated",
+    "mesh_axes_of",
+    "Conflict", "PropagationResult", "Reshard", "RuleCtx",
+    "propagate_program", "apply_sharding", "named_shardings",
+    "mesh_from_axes",
+    "PRESETS", "ShardingPlan", "complete_pytree_specs", "gpt_annotations",
+    "make_gpt_plan", "resolve_plan",
+]

@@ -1,0 +1,84 @@
+"""Lowering annotated programs through ``jax.jit`` + ``NamedSharding``.
+
+The executor's gspmd mode (framework/executor.py `_CompiledBlock`) already
+builds ``NamedSharding``s from ``var.sharding`` and a mesh annotation —
+so lowering an annotated program is: run propagation, write every
+propagated spec back onto the IR vars, stamp the mesh plan, and let
+``Executor.run`` compile it like any other gspmd program. One mechanism,
+no parallel lowering path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from . import propagate as propagate_mod
+from . import spec as spec_mod
+
+__all__ = ["apply_sharding", "named_shardings", "mesh_from_axes"]
+
+
+def mesh_from_axes(mesh_axes: Sequence[Tuple[str, int]], devices=None):
+    """Build a jax Mesh for ``[(axis, size), ...]`` (thin alias of
+    parallel.mesh.build_mesh so callers need one import)."""
+    from ..parallel.mesh import build_mesh
+
+    return build_mesh(list(mesh_axes), devices)
+
+
+def apply_sharding(program,
+                   mesh_axes: Optional[Sequence[Tuple[str, int]]] = None,
+                   data_axis: Optional[str] = None,
+                   feed_specs: Optional[Dict[str, Any]] = None,
+                   strict: bool = False):
+    """Propagate and APPLY: every var of ``program`` gets its propagated
+    spec as ``var.sharding`` and the program gets a gspmd mesh annotation
+    — after this, ``Executor.run`` lowers it through ``jax.jit`` +
+    ``NamedSharding`` on the annotated mesh.
+
+    ``strict=True`` raises on propagation conflicts (the lint checker
+    reports them with locations either way). Returns the
+    :class:`~paddle_tpu.sharding.propagate.PropagationResult`.
+    """
+    if mesh_axes is None:
+        mesh_axes = spec_mod.mesh_axes_of(program)
+        if mesh_axes is None:
+            raise ValueError(
+                "apply_sharding: no mesh_axes given and the program has "
+                "no mesh annotation (annotate_program(..., mesh_axes=))")
+    result = propagate_mod.propagate_program(
+        program, mesh_axes=mesh_axes, feed_specs=feed_specs)
+    if strict and result.conflicts:
+        raise spec_mod.SpecConflict(
+            "sharding propagation conflicts:\n" +
+            "\n".join(c.format() for c in result.conflicts))
+    # remember the explicit seeds BEFORE writing every propagated spec
+    # back, so re-propagation (lint, debugger) stays anchored to the
+    # user's annotations rather than the derived fixpoint
+    explicit = sorted(result.annotated)
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            s = result.specs.get(name)
+            if s is not None:
+                var.sharding = s
+    ann = program._annotations
+    ann["sharding_annotated"] = explicit
+    mesh = dict(ann.get("mesh") or {})
+    mesh.setdefault("mode", "gspmd")
+    mesh["axes"] = [(str(a), int(s)) for a, s in mesh_axes]
+    if data_axis is not None:
+        mesh["data_axis"] = data_axis
+    mesh.setdefault("data_axis", None)
+    mesh.setdefault("ring_axes", {})
+    ann["mesh"] = mesh
+    program._bump_version()
+    return result
+
+
+def named_shardings(result, mesh, names: Optional[Sequence[str]] = None
+                    ) -> Dict[str, Any]:
+    """{var: NamedSharding} for (a subset of) a propagation result."""
+    from jax.sharding import NamedSharding
+
+    names = list(names) if names is not None else sorted(result.specs)
+    return {n: NamedSharding(mesh, spec_mod.to_partition_spec(
+        result.specs[n])) for n in names if n in result.specs}

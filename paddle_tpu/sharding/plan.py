@@ -1,0 +1,235 @@
+"""Pytree-level sharding plans for the pure-JAX engine (ISSUE 12).
+
+The Program-IR side (propagate.py) derives specs op-by-op; the engine
+side (``parallelize.make_train_step(sharding=...)``) holds its state as
+a param pytree, so the propagation twin here is **aval-suffix
+inheritance**: the user annotates only the weight leaves (embedding +
+attention/mlp matrices — the acceptance floor), and every unannotated
+leaf inherits the trailing-dim entries of the annotated leaf whose shape
+suffix it matches (a bias ``[..., F]`` inherits its weight's ``F``
+entry; an ambiguous or unmatched leaf replicates). Optimizer moments
+mirror the param specs leaf-for-leaf — exactly how fsdp's HBM saving
+falls out.
+
+Presets (``resolve_plan("dp" | "fsdp" | "tp")``) annotate the flagship
+GPT pytree; arbitrary annotation dicts compose the same way.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from .spec import normalize_spec, pad_spec, spec_axes, spec_str
+
+__all__ = ["ShardingPlan", "complete_pytree_specs", "gpt_annotations",
+           "make_gpt_plan", "resolve_plan", "PRESETS"]
+
+PRESETS = ("dp", "fsdp", "tp", "dp+tp")
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        key = getattr(k, "key", None)
+        if key is None:
+            key = getattr(k, "idx", None)
+        parts.append(str(key) if key is not None else str(k))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass
+class ShardingPlan:
+    """A complete engine-side sharding: specs for every param leaf (as a
+    pytree of jax PartitionSpecs), the data spec, and the derivation
+    notes (leaf path -> "annotated" | "inherited:<source>" |
+    "replicated")."""
+
+    mode: str
+    axes: Tuple[Tuple[str, int], ...]
+    param_specs: Any
+    data_spec: Any
+    annotations: Dict[str, Any]
+    derived: Dict[str, str]
+
+    @property
+    def mesh_sizes(self) -> Dict[str, int]:
+        return {a: int(s) for a, s in self.axes}
+
+    def params_replicated_over(self, axis: str) -> bool:
+        """True when NO param leaf shards over ``axis`` (the comm_opt
+        grad-reduction paths require dp-replicated params)."""
+        import jax
+
+        from jax.sharding import PartitionSpec as P
+
+        for leaf in jax.tree_util.tree_leaves(
+                self.param_specs, is_leaf=lambda x: isinstance(x, P)):
+            if axis in spec_axes(tuple(leaf)):
+                return False
+        return True
+
+    def report(self) -> str:
+        lines = [f"sharding plan [{self.mode}] over mesh "
+                 f"{dict(self.axes)}:"]
+        for path in sorted(self.derived):
+            lines.append(f"  {path}: {self.derived[path]}")
+        return "\n".join(lines)
+
+
+def complete_pytree_specs(avals, annotations: Dict[str, Any],
+                          mesh_sizes: Dict[str, int]):
+    """Derive a full spec pytree from annotations on a subset of leaves.
+
+    ``avals`` is any pytree of arrays/ShapeDtypeStructs providing leaf
+    shapes. Returns ``(specs_pytree, derived_notes)`` where the pytree
+    holds jax PartitionSpecs. Inheritance: an unannotated leaf takes the
+    trailing-dim spec entries of the annotated leaf whose shape suffix
+    matches it longest; candidates that tie with different entries (or
+    entries whose axes don't divide the dim) fall back to replicated.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(avals)
+    shapes = {_path_str(p): tuple(x.shape) for p, x in flat}
+    ann = {k: normalize_spec(v) for k, v in annotations.items()}
+    unknown = sorted(set(ann) - set(shapes))
+    if unknown:
+        raise ValueError(
+            f"sharding annotations name unknown leaves {unknown}; known: "
+            f"{sorted(shapes)[:12]}...")
+
+    def divides(entry, dim) -> bool:
+        if entry is None:
+            return True
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for a in axes:
+            n *= int(mesh_sizes.get(a, 1))
+        return dim % n == 0
+
+    specs: Dict[str, Tuple] = {}
+    derived: Dict[str, str] = {}
+    for path, shape in shapes.items():
+        if path in ann:
+            s = pad_spec(ann[path], len(shape))
+            for d, e in enumerate(s):
+                if not divides(e, shape[d]):
+                    raise ValueError(
+                        f"annotation {spec_str(s)} on {path!r}: dim {d} "
+                        f"({shape[d]}) not divisible by mesh axes {e!r}")
+            specs[path] = s
+            derived[path] = "annotated"
+            continue
+        # suffix inheritance from the best-matching annotated leaf
+        best_t, best = 0, []
+        for src, sspec in ann.items():
+            sshape = shapes[src]
+            sspec = pad_spec(sspec, len(sshape))
+            t = 0
+            while (t < len(shape) and t < len(sshape)
+                   and shape[-1 - t] == sshape[-1 - t]):
+                t += 1
+            t = min(t, len(shape))
+            if t == 0:
+                continue
+            inherited = tuple(sspec[len(sshape) - t:])
+            if not all(divides(e, d) for e, d in
+                       zip(inherited, shape[len(shape) - t:])):
+                continue
+            if t > best_t:
+                best_t, best = t, [(src, inherited)]
+            elif t == best_t:
+                best.append((src, inherited))
+        entries = {inh for _, inh in best}
+        if best_t > 0 and len(entries) == 1:
+            inherited = best[0][1]
+            specs[path] = (None,) * (len(shape) - best_t) + inherited
+            derived[path] = f"inherited:{best[0][0]}"
+            if all(e is None for e in specs[path]):
+                derived[path] = "replicated"
+        else:
+            specs[path] = (None,) * len(shape)
+            derived[path] = ("replicated(ambiguous:"
+                             + ",".join(sorted(s for s, _ in best)) + ")"
+                             if best_t > 0 else "replicated")
+    leaves = [P(*specs[_path_str(p)]) for p, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves), derived
+
+
+# ---------------------------------------------------------------------------
+# GPT presets — the acceptance annotation set: embedding + attention/mlp
+# weight leaves ONLY; everything else (biases, layernorms, moments, data)
+# derives.
+# ---------------------------------------------------------------------------
+
+def gpt_annotations(mode: str, dp_axis: str = "dp",
+                    tp_axis: str = "tp") -> Dict[str, Any]:
+    if mode == "dp":
+        # pure data parallel: weights explicitly replicated
+        return {"wte": (), "lm_head": (),
+                "blocks/w_qkv": (), "blocks/w_proj": (),
+                "blocks/w_fc": (), "blocks/w_out": ()}
+    if mode == "fsdp":
+        # parameters sharded over the dp axis (one big dim per leaf);
+        # GSPMD all-gathers for compute, reduce-scatters the grads
+        return {
+            "wte": (dp_axis, None),
+            "lm_head": (None, dp_axis),
+            "blocks/w_qkv": (None, dp_axis, None, None, None),
+            "blocks/w_proj": (None, None, None, dp_axis),
+            "blocks/w_fc": (None, None, dp_axis),
+            "blocks/w_out": (None, dp_axis, None),
+        }
+    if mode in ("tp", "dp+tp"):
+        # Megatron: column-parallel QKV/fc over heads/ffn, row-parallel
+        # proj/out — the same split gpt.param_specs hand-writes, now
+        # derived from six annotations
+        return {
+            "wte": (), "lm_head": (),
+            "blocks/w_qkv": (None, None, None, tp_axis, None),
+            "blocks/w_proj": (None, tp_axis, None, None),
+            "blocks/w_fc": (None, None, tp_axis),
+            "blocks/w_out": (None, tp_axis, None),
+        }
+    raise ValueError(f"unknown sharding preset {mode!r}; "
+                     f"known: {PRESETS}")
+
+
+def make_gpt_plan(cfg, pcfg, mode: str,
+                  annotations: Optional[Dict[str, Any]] = None
+                  ) -> ShardingPlan:
+    """Plan for the flagship GPT pytree on ``pcfg``'s mesh axes.
+
+    ``annotations`` overrides the preset annotation set (same leaf-path
+    keys). Data stays batch-sharded over the dp axis in every mode."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import gpt as gpt_mod
+
+    dp_ax, _pp_ax, tp_ax = pcfg.axis_names
+    axes = tuple(zip(pcfg.axis_names, (pcfg.dp, pcfg.pp, pcfg.tp)))
+    mesh_sizes = {a: int(s) for a, s in axes}
+    if annotations is None:
+        annotations = gpt_annotations(mode, dp_axis=dp_ax, tp_axis=tp_ax)
+    avals = jax.eval_shape(lambda k: gpt_mod.init_params(k, cfg),
+                           jax.ShapeDtypeStruct((2,), jnp.uint32))
+    specs, derived = complete_pytree_specs(avals, annotations, mesh_sizes)
+    return ShardingPlan(mode=mode, axes=axes, param_specs=specs,
+                        data_spec=P(None, dp_ax, None),
+                        annotations=dict(annotations), derived=derived)
+
+
+def resolve_plan(sharding, cfg, pcfg) -> ShardingPlan:
+    """Accept a preset name or a ready :class:`ShardingPlan`."""
+    if isinstance(sharding, ShardingPlan):
+        return sharding
+    if isinstance(sharding, str):
+        return make_gpt_plan(cfg, pcfg, sharding)
+    if isinstance(sharding, dict):
+        return make_gpt_plan(cfg, pcfg, "custom", annotations=sharding)
+    raise TypeError(
+        f"sharding= expects a preset name {PRESETS}, an annotation dict, "
+        f"or a ShardingPlan; got {type(sharding).__name__}")

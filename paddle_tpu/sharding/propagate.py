@@ -1,0 +1,496 @@
+"""GSPMD-style forward/backward fixpoint sharding propagation over the
+Program IR (ISSUE 12; arXiv:2105.04663).
+
+A handful of user annotations (``spec.annotate_program``) plus per-op
+rules (rules.py, registered alongside the registry's ``infer_shape``
+specs via ``framework.registry.set_sharding_rule``) suffice to derive a
+PartitionSpec for EVERY var of a program:
+
+- each rule derives/refines specs in both directions (outputs from
+  inputs on the forward sweep, inputs from outputs on the backward
+  sweep); the driver alternates sweeps until a fixpoint;
+- merging is by *refinement* (spec.merge_specs): ``None`` dims yield to
+  named axes; two different named axes on one dim is a **conflict** —
+  recorded, never silently resolved;
+- when an op needs an operand laid out differently than its producer
+  made it (a matmul contracting over a sharded dim, a reduction over a
+  sharded dim), the rule records an implied **reshard** on that edge
+  with an estimated ring-model wire-byte cost (comm_opt.wire_bytes —
+  the same accounting the runtime collectives use) and continues as if
+  the operand had been resharded;
+- ops with no registered rule fall back to conservative replication
+  (sharded inputs get a ``replicate`` reshard record) and land in the
+  **coverage report**, the to-do list for rule authors;
+- grad ops need no rules at all: a generic tie pairs every
+  ``<slot>@GRAD`` var with its primal (cotangents shard like their
+  primals — the GSPMD invariant), which covers the default-vjp grad op
+  family wholesale.
+
+Every NEW reshard record increments
+``paddle_resharding_bytes_total{edge}`` (edge = ``op_type/var``), gated
+by tools/metrics_check.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..observability import metrics as _obs_metrics
+from . import spec as spec_mod
+from .spec import (SpecConflict, merge_specs, normalize_spec, pad_spec,
+                   spec_axes, spec_str)
+
+__all__ = ["Reshard", "Conflict", "PropagationResult", "RuleCtx",
+           "propagate_program", "GRAD_SUFFIX"]
+
+GRAD_SUFFIX = "@GRAD"
+
+# stand-in extent for -1 (batch) dims in reshard cost estimates — cost is
+# an ordering signal, not an invoice; a nominal per-feed batch keeps the
+# numbers finite and comparable
+DYNAMIC_DIM_ESTIMATE = 32
+
+_m_reshard_bytes = _obs_metrics.default_registry().counter(
+    "paddle_resharding_bytes_total",
+    "Estimated ring-model wire bytes of reshards implied by sharding "
+    "propagation, by program edge (paddle_tpu/sharding/propagate.py)",
+    ("edge",), max_series=256)
+
+_DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+                "float16": 2, "bfloat16": 2, "int16": 2, "int8": 1,
+                "uint8": 1, "bool": 1}
+
+
+@dataclasses.dataclass
+class Reshard:
+    """One implied layout change on a (producer var -> consumer op) edge."""
+
+    block_idx: int
+    op_idx: int
+    op_type: str
+    var: str
+    kind: str               # "gather" | "psum" | "replicate"
+    from_spec: Tuple
+    to_spec: Tuple
+    bytes_est: int          # ring-model per-rank wire bytes (estimate)
+    reason: str
+
+    @property
+    def edge(self) -> str:
+        return f"{self.op_type}/{self.var}"
+
+    def format(self) -> str:
+        return (f"reshard[{self.kind}] {self.var!r} "
+                f"{spec_str(self.from_spec)} -> {spec_str(self.to_spec)} "
+                f"at block {self.block_idx} op {self.op_idx} "
+                f"({self.op_type}), ~{self.bytes_est} wire B — "
+                f"{self.reason}")
+
+
+@dataclasses.dataclass
+class Conflict:
+    """Two propagation sources demanded different named axes on one dim."""
+
+    block_idx: int
+    op_idx: int
+    op_type: str
+    var: str
+    existing: Tuple
+    proposed: Tuple
+    annotated: bool         # the losing proposal hit an EXPLICIT annotation
+    detail: str
+
+    def format(self) -> str:
+        kind = "annotation" if self.annotated else "propagation"
+        return (f"{kind} conflict on {self.var!r}: kept "
+                f"{spec_str(self.existing)}, op {self.op_idx} "
+                f"({self.op_type}, block {self.block_idx}) derived "
+                f"{spec_str(self.proposed)} — {self.detail}")
+
+
+class PropagationResult:
+    def __init__(self, specs, annotated, conflicts, reshards, coverage,
+                 defaulted, mesh_sizes, sweeps):
+        self.specs: Dict[str, Tuple] = specs
+        self.annotated: Dict[str, Tuple] = annotated
+        self.conflicts: List[Conflict] = conflicts
+        self.reshards: List[Reshard] = reshards
+        # op_type -> "rule" | "grad_tie" | "fallback_replicate"
+        self.coverage: Dict[str, str] = coverage
+        self.defaulted: List[str] = defaulted
+        self.mesh_sizes: Dict[str, int] = mesh_sizes
+        self.sweeps = sweeps
+
+    @property
+    def complete(self) -> bool:
+        """Every var got a spec with zero conflicts — the acceptance bar
+        for an annotated program."""
+        return not self.conflicts
+
+    @property
+    def total_reshard_bytes(self) -> int:
+        return sum(r.bytes_est for r in self.reshards)
+
+    def uncovered_op_types(self) -> List[str]:
+        return sorted(t for t, how in self.coverage.items()
+                      if how == "fallback_replicate")
+
+    def report(self) -> str:
+        lines = [
+            f"sharding propagation: {len(self.specs)} var spec(s), "
+            f"{len(self.annotated)} annotated, "
+            f"{len(self.defaulted)} defaulted to replicated, "
+            f"{len(self.conflicts)} conflict(s), "
+            f"{len(self.reshards)} implied reshard(s) "
+            f"(~{self.total_reshard_bytes} wire B), "
+            f"{self.sweeps} sweep(s)"]
+        for c in self.conflicts:
+            lines.append("  " + c.format())
+        for r in self.reshards:
+            lines.append("  " + r.format())
+        unc = self.uncovered_op_types()
+        if unc:
+            lines.append(f"  rule coverage gaps (replicate fallback): "
+                         f"{', '.join(unc)}")
+        return "\n".join(lines)
+
+
+def _numel_est(shape) -> int:
+    n = 1
+    for d in (shape or ()):
+        n *= DYNAMIC_DIM_ESTIMATE if int(d) < 0 else max(int(d), 1)
+    return n
+
+
+class RuleCtx:
+    """What one sharding rule sees: the op, the spec environment, shapes,
+    and the propose/tie/reshard verbs. Rules never mutate the program."""
+
+    def __init__(self, engine, block, op_idx, op):
+        self._e = engine
+        self.block = block
+        self.block_idx = block.idx
+        self.op_idx = op_idx
+        self.op = op
+        self.mesh_sizes = engine.mesh_sizes
+
+    # -- reads --------------------------------------------------------------
+    def shape(self, name) -> Optional[Tuple[int, ...]]:
+        return self._e.shape(name)
+
+    def rank(self, name) -> Optional[int]:
+        s = self.shape(name)
+        return None if s is None else len(s)
+
+    def spec(self, name) -> Optional[Tuple]:
+        """Current spec of ``name`` padded to its rank; None = unknown."""
+        s = self._e.env.get(name)
+        if s is None:
+            return None
+        r = self.rank(name)
+        return pad_spec(s, r) if r is not None else s
+
+    def dtype_bytes(self, name) -> int:
+        v = self._e.var(name)
+        return _DTYPE_BYTES.get(str(getattr(v, "dtype", "float32")), 4)
+
+    def attr(self, name, default=None):
+        return self.op.attr(name, default)
+
+    # -- writes -------------------------------------------------------------
+    def propose(self, name, spec) -> None:
+        self._e.propose(self, name, spec)
+
+    def tie(self, a: str, b: str) -> None:
+        """Constrain two vars to the same spec (both directions)."""
+        sa, sb = self._e.env.get(a), self._e.env.get(b)
+        if sa is not None:
+            self._e.propose(self, b, sa)
+        if sb is not None:
+            self._e.propose(self, a, sb)
+
+    def reshard(self, name, to_spec, kind: str, reason: str) -> Tuple:
+        """Record an implied reshard of ``name`` at this op; returns the
+        post-reshard spec the rule should continue with."""
+        return self._e.reshard(self, name, to_spec, kind, reason)
+
+    def partial_sum(self, name, axes, reason: str) -> None:
+        """Record an implied cross-rank sum of ``name`` over mesh
+        ``axes`` — the value (not the layout) is partial per rank, so
+        from/to specs coincide; the wire cost is a psum of the full
+        tensor over those axes (Megatron row-parallel matmuls,
+        reductions over sharded dims)."""
+        self._e.partial_sum(self, name, axes, reason)
+
+
+class _Engine:
+    def __init__(self, program, mesh_sizes, annotated, feed_specs):
+        self.program = program
+        self.mesh_sizes = dict(mesh_sizes)
+        self.env: Dict[str, Tuple] = {}
+        self.annotated: Dict[str, Tuple] = {}
+        self.conflicts: List[Conflict] = []
+        self.reshards: List[Reshard] = []
+        self._reshard_seen: Set[Tuple] = set()
+        self._conflict_seen: Set[Tuple] = set()
+        self.coverage: Dict[str, str] = {}
+        self.changed = False
+        self._vars: Dict[str, Any] = {}
+        for block in program.blocks:
+            for name, var in block.vars.items():
+                self._vars.setdefault(name, var)
+        for name, s in annotated.items():
+            r = self.rank_of(name)
+            self.env[name] = pad_spec(s, r) if r is not None else \
+                normalize_spec(s)
+            self.annotated[name] = self.env[name]
+        for name, s in (feed_specs or {}).items():
+            if name in self._vars:
+                r = self.rank_of(name)
+                self.env[name] = pad_spec(s, r) if r is not None else \
+                    normalize_spec(s)
+                self.annotated.setdefault(name, self.env[name])
+
+    def var(self, name):
+        return self._vars.get(name)
+
+    def shape(self, name):
+        v = self._vars.get(name)
+        if v is None:
+            return None
+        return tuple(getattr(v, "shape", ()) or ())
+
+    def rank_of(self, name):
+        s = self.shape(name)
+        return None if s is None else len(s)
+
+    def propose(self, ctx: RuleCtx, name, spec) -> None:
+        if name not in self._vars:
+            return
+        r = self.rank_of(name)
+        try:
+            s = pad_spec(normalize_spec(spec), r) if r is not None \
+                else normalize_spec(spec)
+        except ValueError:
+            return  # rank mismatch (broadcasting op proposed too wide)
+        old = self.env.get(name)
+        if old is None:
+            self.env[name] = s
+            self.changed = True
+            return
+        try:
+            merged = merge_specs(old, s, rank=r)
+        except SpecConflict as e:
+            key = (ctx.block_idx, ctx.op_idx, name, old, s)
+            if key not in self._conflict_seen:
+                self._conflict_seen.add(key)
+                self.conflicts.append(Conflict(
+                    block_idx=ctx.block_idx, op_idx=ctx.op_idx,
+                    op_type=ctx.op.type, var=name, existing=old,
+                    proposed=s, annotated=name in self.annotated,
+                    detail=str(e)))
+            return
+        if merged != old:
+            if name in self.annotated and merged != self.annotated[name]:
+                # refinement of an explicit annotation is a conflict too:
+                # the user said replicated, propagation wants sharded
+                key = (ctx.block_idx, ctx.op_idx, name, old, s, "ann")
+                if key not in self._conflict_seen:
+                    self._conflict_seen.add(key)
+                    self.conflicts.append(Conflict(
+                        block_idx=ctx.block_idx, op_idx=ctx.op_idx,
+                        op_type=ctx.op.type, var=name,
+                        existing=old, proposed=s, annotated=True,
+                        detail="propagation refines an explicit "
+                               "annotation"))
+                return
+            self.env[name] = merged
+            self.changed = True
+
+    def reshard(self, ctx: RuleCtx, name, to_spec, kind, reason) -> Tuple:
+        r = self.rank_of(name)
+        frm = self.env.get(name, ())
+        frm = pad_spec(frm, r) if r is not None else normalize_spec(frm)
+        to = pad_spec(normalize_spec(to_spec), r) if r is not None \
+            else normalize_spec(to_spec)
+        if frm == to:
+            return to
+        key = (ctx.block_idx, ctx.op_idx, name, frm, to, kind)
+        if key in self._reshard_seen:
+            return to
+        self._reshard_seen.add(key)
+        # ring-model cost: payload = the full tensor, participants = every
+        # rank the union of both specs spans (comm_opt.wire_bytes — the
+        # same model runtime collectives record)
+        from ..parallel import comm_opt
+
+        axes = set(spec_axes(frm)) | set(spec_axes(to))
+        ranks = 1
+        for a in axes:
+            ranks *= int(self.mesh_sizes.get(a, 1))
+        payload = _numel_est(self.shape(name)) * \
+            _DTYPE_BYTES.get(str(getattr(self.var(name), "dtype",
+                                         "float32")), 4)
+        op_kind = "psum" if kind == "psum" else "all_gather"
+        bytes_est = comm_opt.wire_bytes(op_kind, payload, max(ranks, 1)) \
+            if ranks > 1 else 0
+        rec = Reshard(block_idx=ctx.block_idx, op_idx=ctx.op_idx,
+                      op_type=ctx.op.type, var=name, kind=kind,
+                      from_spec=frm, to_spec=to, bytes_est=bytes_est,
+                      reason=reason)
+        self.reshards.append(rec)
+        if bytes_est:
+            _m_reshard_bytes.labels(rec.edge).inc(bytes_est)
+        return to
+
+    def partial_sum(self, ctx: RuleCtx, name, axes, reason) -> None:
+        axes = tuple(a for a in axes if a)
+        if not axes:
+            return
+        key = (ctx.block_idx, ctx.op_idx, name, axes, "psum")
+        if key in self._reshard_seen:
+            return
+        self._reshard_seen.add(key)
+        from ..parallel import comm_opt
+
+        ranks = 1
+        for a in axes:
+            ranks *= int(self.mesh_sizes.get(a, 1))
+        payload = _numel_est(self.shape(name)) * \
+            _DTYPE_BYTES.get(str(getattr(self.var(name), "dtype",
+                                         "float32")), 4)
+        bytes_est = comm_opt.wire_bytes("psum", payload, max(ranks, 1)) \
+            if ranks > 1 else 0
+        r = self.rank_of(name)
+        cur = self.env.get(name, ())
+        cur = pad_spec(cur, r) if r is not None else normalize_spec(cur)
+        rec = Reshard(block_idx=ctx.block_idx, op_idx=ctx.op_idx,
+                      op_type=ctx.op.type, var=name, kind="psum",
+                      from_spec=cur, to_spec=cur, bytes_est=bytes_est,
+                      reason=f"{reason} (sum over {'/'.join(axes)})")
+        self.reshards.append(rec)
+        if bytes_est:
+            _m_reshard_bytes.labels(rec.edge).inc(bytes_est)
+
+
+def _grad_tie(ctx: RuleCtx, op) -> None:
+    """Generic grad-op rule: every ``<slot>@GRAD`` var shards like its
+    primal — cotangents inherit primal layouts (the GSPMD invariant the
+    default-vjp grad ops satisfy by construction)."""
+    io = [(op.inputs or {}), (op.outputs or {})]
+    primal_names: Dict[str, List[str]] = {}
+    for m in io:
+        for slot, names in m.items():
+            if not slot.endswith(GRAD_SUFFIX):
+                primal_names.setdefault(slot, list(names))
+    for m in io:
+        for slot, names in m.items():
+            if not slot.endswith(GRAD_SUFFIX):
+                continue
+            base = slot[: -len(GRAD_SUFFIX)]
+            for gname, pname in zip(names, primal_names.get(base, [])):
+                if gname and pname and gname != "@EMPTY@" \
+                        and pname != "@EMPTY@":
+                    ctx.tie(gname, pname)
+
+
+def propagate_program(program, mesh_axes=None, annotations=None,
+                      feed_specs=None,
+                      max_sweeps: int = 32) -> PropagationResult:
+    """Run the fixpoint pass over ``program``; returns a
+    :class:`PropagationResult` (never mutates the program — apply the
+    result with :func:`paddle_tpu.sharding.apply_sharding`).
+
+    ``annotations`` overrides the seed set ({name: spec}); by default the
+    explicit annotations recorded by ``annotate_program`` are used, or —
+    for programs annotated by hand via ``shard_tensor`` — every var
+    carrying a ``sharding`` attribute. ``feed_specs`` adds specs for feed
+    vars (the batch-axis seed the engine entry points supply).
+    """
+    from ..framework import registry
+    from . import rules as _rules  # registers built-in rules (idempotent)
+
+    _rules.ensure_registered()
+
+    if mesh_axes is None:
+        mesh_axes = spec_mod.mesh_axes_of(program) or []
+    mesh_sizes = {str(a): int(s) for a, s in mesh_axes}
+
+    if annotations is None:
+        explicit = program._annotations.get("sharding_annotated") \
+            if hasattr(program, "_annotations") else None
+        all_ann = spec_mod.annotated_vars(program)
+        if explicit:
+            annotations = {n: all_ann[n] for n in explicit if n in all_ann}
+        else:
+            annotations = all_ann
+
+    eng = _Engine(program, mesh_sizes, annotations, feed_specs)
+
+    # cache (op -> rule resolution) once
+    def rule_for(op):
+        fn = registry.get_sharding_rule(op.type)
+        if fn is not None:
+            eng.coverage.setdefault(op.type, "rule")
+            return fn
+        if op.type.endswith("_grad") or any(
+                s.endswith(GRAD_SUFFIX) for s in list(op.inputs or {})
+                + list(op.outputs or {})):
+            eng.coverage.setdefault(op.type, "grad_tie")
+            return _grad_tie
+        eng.coverage.setdefault(op.type, "fallback_replicate")
+        return _fallback_replicate
+
+    ordered = []
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            ordered.append((block, i, op))
+
+    sweeps = 0
+    for sweep in range(max_sweeps):
+        eng.changed = False
+        seq = ordered if sweep % 2 == 0 else list(reversed(ordered))
+        for block, i, op in seq:
+            ctx = RuleCtx(eng, block, i, op)
+            try:
+                rule_for(op)(ctx, op)
+            except Exception:
+                # a crashing rule must not take propagation down; the var
+                # simply stays for the replicate fallback
+                eng.coverage[op.type] = "fallback_replicate"
+        sweeps = sweep + 1
+        if not eng.changed:
+            break
+
+    # conservative fallback: every still-unknown var is replicated
+    defaulted = []
+    specs: Dict[str, Tuple] = {}
+    for name, var in eng._vars.items():
+        s = eng.env.get(name)
+        if s is None:
+            r = eng.rank_of(name) or 0
+            s = (None,) * r
+            defaulted.append(name)
+        specs[name] = s
+
+    return PropagationResult(
+        specs=specs, annotated=dict(eng.annotated),
+        conflicts=eng.conflicts, reshards=eng.reshards,
+        coverage=dict(eng.coverage), defaulted=sorted(defaulted),
+        mesh_sizes=mesh_sizes, sweeps=sweeps)
+
+
+def _fallback_replicate(ctx: RuleCtx, op) -> None:
+    """No rule: outputs replicate; sharded inputs imply a replicate
+    reshard (the conservative GSPMD fallback)."""
+    for names in (op.inputs or {}).values():
+        for n in names:
+            s = ctx.spec(n)
+            if s is not None and not spec_mod.is_replicated(s):
+                ctx.reshard(n, (None,) * len(s), "replicate",
+                            f"op {op.type!r} has no sharding rule")
+    for names in (op.outputs or {}).values():
+        for n in names:
+            r = ctx.rank(n)
+            if r is not None:
+                ctx.propose(n, (None,) * r)

@@ -1,0 +1,552 @@
+"""Per-op sharding-propagation rules (ISSUE 12; docs/sharding.md).
+
+Each rule is ``fn(ctx: propagate.RuleCtx, op)`` and registers on the op
+registry via ``framework.registry.set_sharding_rule`` — the exact sibling
+of the declared ``infer_shape`` specs, so rule coverage is auditable the
+same way (``PropagationResult.coverage`` / the lint checker's report).
+
+Rules derive specs in BOTH directions (the driver alternates forward and
+backward sweeps) and use three verbs only:
+
+- ``ctx.propose(name, spec)`` — refine a var's spec (None dims yield to
+  named axes; contradictions become recorded conflicts, never silent);
+- ``ctx.tie(a, b)`` — two vars share a layout (identity ops, optimizer
+  in-place updates, grad/primal pairs);
+- ``ctx.reshard(name, to_spec, kind, reason)`` — the op needs an operand
+  laid out differently: record the implied collective + ring-model cost
+  and continue with the post-reshard spec.
+
+Families covered first (the ISSUE 12 floor): elementwise (+broadcast
+bias adds), matmul (``mul``/``matmul``/``matmul_v2`` — row/column
+parallel and the Megatron partial-sum pair), reductions, transpose,
+reshape (conservative), embedding lookups, softmax CE, optimizer ops,
+and the shape-preserving ``c_*`` collectives. Everything else takes the
+replicate fallback and shows up in the coverage report.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .spec import is_replicated
+
+_REGISTERED = False
+
+
+def _set(op_type: str, fn) -> None:
+    from ..framework import registry
+
+    if op_type in registry._OPS:
+        registry.set_sharding_rule(op_type, fn)
+
+
+# ---------------------------------------------------------------------------
+# family rule builders
+# ---------------------------------------------------------------------------
+
+def _first(op, slot):
+    names = (op.inputs or {}).get(slot) or (op.outputs or {}).get(slot) or []
+    return names[0] if names and names[0] != "@EMPTY@" else None
+
+
+def identity_rule(in_slot: str = "X", out_slot: str = "Out"):
+    """Every ``out_slot`` output shares the matching ``in_slot`` input's
+    layout (unary math, casts, activations)."""
+
+    def rule(ctx, op):
+        ins = (op.inputs or {}).get(in_slot, [])
+        outs = (op.outputs or {}).get(out_slot, [])
+        for a, b in zip(ins, outs):
+            if a and b and a != "@EMPTY@" and b != "@EMPTY@":
+                ctx.tie(a, b)
+
+    return rule
+
+
+def elementwise_rule(ctx, op):
+    """Out shards like X; the (possibly broadcast) Y operand aligns at
+    attr ``axis`` and inherits the overlapping entries where its dims
+    match X's (a size-1 broadcast dim stays replicated)."""
+    x, y = _first(op, "X"), _first(op, "Y")
+    out = _first(op, "Out")
+    if not (x and out):
+        return
+    ctx.tie(x, out)
+    if not y:
+        return
+    rx, ry = ctx.rank(x), ctx.rank(y)
+    if rx is None or ry is None:
+        return
+    if rx == ry:
+        sx, sy = ctx.shape(x), ctx.shape(y)
+        if sx == sy:
+            ctx.tie(x, y)
+        return
+    if ry > rx:
+        return
+    axis = int(ctx.attr("axis", -1))
+    if axis < 0:
+        axis = rx - ry
+    src = ctx.spec(x) or ctx.spec(out)
+    if src is None:
+        return
+    sy = ctx.shape(y)
+    sx = ctx.shape(x)
+    prop = []
+    for d in range(ry):
+        xd = axis + d
+        if sy[d] == 1 or (sx and 0 <= xd < len(sx)
+                          and sx[xd] not in (-1, sy[d])):
+            prop.append(None)
+        else:
+            prop.append(src[xd])
+    ctx.propose(y, tuple(prop))
+
+
+def matmul_rule(ctx, op):
+    """``mul`` (x_num_col_dims/y_num_col_dims flattening) and jax-style
+    matmul: output rows shard like X's row dims, output cols like Y's col
+    dims. A sharded contracting dim on both sides (matching axes) is the
+    Megatron partial-sum pair -> implied psum on the output edge; sharded
+    on one side only -> implied gather of that operand."""
+    x, y, out = _first(op, "X"), _first(op, "Y"), _first(op, "Out")
+    if not (x and y and out):
+        return
+    rx, ry, ro = ctx.rank(x), ctx.rank(y), ctx.rank(out)
+    if None in (rx, ry, ro):
+        return
+    if op.type == "mul":
+        k = int(ctx.attr("x_num_col_dims", 1))
+        m = int(ctx.attr("y_num_col_dims", 1))
+    else:
+        k, m = rx - 1, 1
+        if bool(ctx.attr("transpose_Y", False) or
+                ctx.attr("trans_y", False)):
+            # Y [N, K]: cols come from dim 0 — handle via reversed view
+            m = ry - 1
+    sx = ctx.spec(x)
+    sy = ctx.spec(y)
+    so = ctx.spec(out)
+
+    x_contract = tuple(range(k, rx))
+    y_contract = tuple(range(0, m))
+    y_cols = tuple(range(m, ry))
+
+    # contracting-dim analysis (forward only; needs both operand specs)
+    if sx is not None and sy is not None:
+        xc = [sx[d] for d in x_contract]
+        yc = [sy[d] for d in y_contract]
+        x_sharded = any(e is not None for e in xc)
+        y_sharded = any(e is not None for e in yc)
+        if x_sharded and y_sharded:
+            if xc == yc:
+                # Megatron pair: local partial matmul + implied psum of
+                # the output
+                axes = []
+                for e in xc:
+                    if e is None:
+                        continue
+                    axes.extend(e if isinstance(e, tuple) else (e,))
+                ctx.partial_sum(out, axes,
+                                "contracting dim sharded on both "
+                                "operands (row-parallel matmul)")
+            else:
+                sx = ctx.reshard(
+                    x, tuple(sx[d] if d < k else None for d in range(rx)),
+                    "gather", "contracting-dim layouts disagree")
+        elif x_sharded:
+            sx = ctx.reshard(
+                x, tuple(sx[d] if d < k else None for d in range(rx)),
+                "gather", "contracting dim of X sharded, Y replicated")
+        elif y_sharded:
+            sy = ctx.reshard(
+                y, tuple(None if d < m else sy[d] for d in range(ry)),
+                "gather", "contracting dim of Y sharded, X replicated")
+
+    # forward: out rows from X rows, out cols from Y cols
+    prop_out: List = [None] * ro
+    known = False
+    if sx is not None:
+        for d in range(min(k, ro)):
+            prop_out[d] = sx[d]
+        known = True
+    if sy is not None:
+        for i, d in enumerate(y_cols):
+            od = k + i
+            if od < ro:
+                prop_out[od] = sy[d]
+        known = True
+    if known:
+        ctx.propose(out, tuple(prop_out))
+    # backward: X rows from out rows, Y cols from out cols
+    if so is not None:
+        px: List = [None] * rx
+        for d in range(min(k, ro)):
+            px[d] = so[d]
+        ctx.propose(x, tuple(px))
+        py: List = [None] * ry
+        for i, d in enumerate(y_cols):
+            od = k + i
+            if od < ro:
+                py[d] = so[od]
+        ctx.propose(y, tuple(py))
+
+
+def reduce_rule(ctx, op):
+    """reduce_* over attr dims: kept dims pass through; reducing a
+    sharded dim implies a psum reshard of the (replicated) output."""
+    x, out = _first(op, "X"), _first(op, "Out")
+    if not (x and out):
+        return
+    rx, ro = ctx.rank(x), ctx.rank(out)
+    if rx is None or ro is None:
+        return
+    dims = ctx.attr("dim", [])
+    reduce_all = bool(ctx.attr("reduce_all", False)) or not dims
+    keep = bool(ctx.attr("keep_dim", False))
+    if isinstance(dims, int):
+        dims = [dims]
+    dims = sorted(d % rx for d in dims) if not reduce_all \
+        else list(range(rx))
+    sx = ctx.spec(x)
+    if sx is not None:
+        red_axes = []
+        for d in dims:
+            e = sx[d]
+            if e is not None:
+                red_axes.extend(e if isinstance(e, tuple) else (e,))
+        if red_axes:
+            ctx.partial_sum(out, red_axes,
+                            "reduction over a sharded dim")
+        prop = []
+        for d in range(rx):
+            if d in dims:
+                if keep:
+                    prop.append(None)
+            else:
+                prop.append(sx[d])
+        if len(prop) == ro:
+            ctx.propose(out, tuple(prop))
+        elif ro in (0, 1):
+            ctx.propose(out, (None,) * ro)
+    so = ctx.spec(out)
+    if so is not None and not reduce_all and len(so) == ro:
+        # backward: kept dims flow back
+        px: List = [None] * rx
+        i = 0
+        for d in range(rx):
+            if d in dims:
+                if keep:
+                    i += 1
+                continue
+            if i < ro:
+                px[d] = so[i]
+            i += 1
+        ctx.propose(x, tuple(px))
+
+
+def transpose_rule(ctx, op):
+    x, out = _first(op, "X"), _first(op, "Out")
+    if not (x and out):
+        return
+    perm = ctx.attr("axis", None) or ctx.attr("perm", None)
+    rx = ctx.rank(x)
+    if perm is None or rx is None:
+        return
+    perm = [int(p) % rx for p in perm]
+    sx, so = ctx.spec(x), ctx.spec(out)
+    if sx is not None:
+        ctx.propose(out, tuple(sx[p] for p in perm))
+    if so is not None and len(so) == len(perm):
+        inv = [0] * len(perm)
+        for i, p in enumerate(perm):
+            inv[p] = i
+        ctx.propose(x, tuple(so[inv[d]] for d in range(rx)))
+
+
+def reshape_rule(ctx, op):
+    """Conservative: replicated stays replicated; a sharded input whose
+    leading dims survive unchanged carries those entries; anything else
+    reshards to replicated (GSPMD's reshape rules are richer — this is
+    the honest floor)."""
+    x, out = _first(op, "X"), _first(op, "Out")
+    if not (x and out):
+        return
+    sx_shape, so_shape = ctx.shape(x), ctx.shape(out)
+    sx, so = ctx.spec(x), ctx.spec(out)
+    ro = ctx.rank(out)
+    rx = ctx.rank(x)
+
+    def carry(src_spec, src_shape, dst_shape, dst_rank):
+        if src_spec is None:
+            return None
+        if is_replicated(src_spec):
+            return (None,) * dst_rank
+        prop: List = [None] * dst_rank
+        for d, e in enumerate(src_spec):
+            if e is None:
+                continue
+            if d < dst_rank and src_shape and dst_shape \
+                    and d < len(src_shape) and d < len(dst_shape) \
+                    and src_shape[d] == dst_shape[d] \
+                    and src_shape[:d] == dst_shape[:d]:
+                prop[d] = e
+            else:
+                return "reshard"
+        return tuple(prop)
+
+    fwd = carry(sx, sx_shape, so_shape, ro or 0)
+    if fwd == "reshard":
+        sx = ctx.reshard(x, (None,) * (rx or 0), "replicate",
+                         "reshape folds a sharded dim")
+        ctx.propose(out, (None,) * (ro or 0))
+    elif fwd is not None:
+        ctx.propose(out, fwd)
+    bwd = carry(so, so_shape, sx_shape, rx or 0)
+    if bwd not in (None, "reshard"):
+        ctx.propose(x, bwd)
+
+
+def embedding_rule(ctx, op):
+    """lookup_table(_v2): Out rows shard like Ids; Out's feature dim
+    shards like W's. A vocab-sharded table implies a psum-style combine
+    of the one-hot partial lookups."""
+    w = _first(op, "W")
+    ids = _first(op, "Ids")
+    out = _first(op, "Out")
+    if not (w and ids and out):
+        return
+    ri, ro, rw = ctx.rank(ids), ctx.rank(out), ctx.rank(w)
+    if None in (ri, ro, rw):
+        return
+    si, sw, so = ctx.spec(ids), ctx.spec(w), ctx.spec(out)
+    if sw is not None and sw[0] is not None:
+        e = sw[0]
+        ctx.partial_sum(out, e if isinstance(e, tuple) else (e,),
+                        "vocab-sharded embedding table (partial "
+                        "lookups)")
+        sw = tuple([None] + list(sw[1:]))
+    ids_shape = ctx.shape(ids)
+    # classic lookup_table ids are [..., 1]; v2 drops the trailing 1
+    squeeze = bool(ids_shape) and ids_shape[-1] == 1 and ro == ri
+    row_rank = (ri - 1) if squeeze else ri
+    prop: List = [None] * ro
+    known = False
+    if si is not None:
+        for d in range(min(row_rank, ro)):
+            prop[d] = si[d]
+        known = True
+    if sw is not None and ro >= 1:
+        prop[ro - 1] = sw[rw - 1]
+        known = True
+    if known:
+        ctx.propose(out, tuple(prop))
+    if so is not None:
+        pi: List = [None] * ri
+        for d in range(min(row_rank, ro)):
+            pi[d] = so[d]
+        ctx.propose(ids, tuple(pi))
+
+
+def softmax_ce_rule(ctx, op):
+    """softmax_with_cross_entropy: the class dim must be whole (the
+    conservative rule; a sharded-LSE rule would be the tp-native CE).
+    Loss/Softmax rows shard like Logits rows; Label ties to the rows."""
+    logits = _first(op, "Logits")
+    label = _first(op, "Label")
+    loss = _first(op, "Loss")
+    soft = _first(op, "Softmax")
+    if not (logits and loss):
+        return
+    rl = ctx.rank(logits)
+    if rl is None:
+        return
+    sl = ctx.spec(logits)
+    if sl is not None and sl[rl - 1] is not None:
+        sl = ctx.reshard(
+            logits, tuple(list(sl[:-1]) + [None]), "gather",
+            "softmax CE needs the class dim unsharded (conservative "
+            "rule)")
+    rows = None if sl is None else tuple(sl[:-1])
+    for tgt in (loss, soft, label):
+        if not tgt:
+            continue
+        rt = ctx.rank(tgt)
+        if rt is None:
+            continue
+        if rows is not None:
+            prop = list(rows[:rt]) + [None] * max(0, rt - len(rows))
+            if rt == len(rows) + 1:
+                prop = list(rows) + [None]
+            ctx.propose(tgt, tuple(prop[:rt]))
+    # backward: logits rows from loss rows
+    if loss:
+        slo = ctx.spec(loss)
+        if slo is not None:
+            prop = list(slo[:rl - 1]) + [None] * max(0, rl - len(slo))
+            prop = (prop + [None])[:rl]
+            prop[rl - 1] = None
+            ctx.propose(logits, tuple(prop))
+
+
+def optimizer_rule(ctx, op):
+    """In-place optimizer ops: every ``<Slot>Out`` output ties to its
+    ``<Slot>`` input; Grad and moments tie to Param (they share the
+    param's layout — exactly how the engine lays sharded state out)."""
+    ins = op.inputs or {}
+    outs = op.outputs or {}
+    for slot, names in outs.items():
+        base = slot[:-3] if slot.endswith("Out") else None
+        if base and base in ins:
+            for a, b in zip(ins[base], names):
+                if a and b and a != "@EMPTY@" and b != "@EMPTY@":
+                    ctx.tie(a, b)
+    param = _first(op, "Param")
+    if not param:
+        return
+    for slot in ("Grad", "Moment", "Moment1", "Moment2", "Velocity",
+                 "MeanSquare", "MeanGrad"):
+        other = _first(op, slot)
+        if other and ctx.rank(other) == ctx.rank(param):
+            ctx.tie(param, other)
+
+
+def replicated_out_rule(ctx, op):
+    """Ops whose outputs are born replicated (fill_constant & friends)."""
+    for names in (op.outputs or {}).values():
+        for n in names:
+            r = ctx.rank(n)
+            if r is not None:
+                ctx.propose(n, (None,) * r)
+
+
+def concat_rule(ctx, op):
+    """concat: non-concat dims pass through from the first input; a
+    sharded concat axis reshards to replicated."""
+    ins = [n for n in (op.inputs or {}).get("X", []) if n != "@EMPTY@"]
+    out = _first(op, "Out")
+    if not (ins and out):
+        return
+    ro = ctx.rank(out)
+    if ro is None:
+        return
+    axis = int(ctx.attr("axis", 0)) % max(ro, 1)
+    prop: List = [None] * ro
+    known = False
+    for n in ins:
+        s = ctx.spec(n)
+        if s is None or len(s) != ro:
+            continue
+        known = True
+        if s[axis] is not None:
+            ctx.reshard(n, tuple(None if d == axis else s[d]
+                                 for d in range(ro)),
+                        "gather", "concat over a sharded dim")
+            s = tuple(None if d == axis else s[d] for d in range(ro))
+        for d in range(ro):
+            if prop[d] is None:
+                prop[d] = s[d]
+    if known:
+        prop[axis] = None
+        ctx.propose(out, tuple(prop))
+    so = ctx.spec(out)
+    if so is not None:
+        back = tuple(None if d == axis else so[d] for d in range(ro))
+        for n in ins:
+            if ctx.rank(n) == ro:
+                ctx.propose(n, back)
+
+
+def slice_rule(ctx, op):
+    """slice: untouched dims pass through; slicing a sharded dim
+    reshards it whole first."""
+    x, out = _first(op, "Input") or _first(op, "X"), _first(op, "Out")
+    if not (x and out):
+        return
+    rx, ro = ctx.rank(x), ctx.rank(out)
+    if rx is None or ro is None or rx != ro:
+        return
+    axes = [int(a) % rx for a in (ctx.attr("axes", []) or [])]
+    sx = ctx.spec(x)
+    if sx is not None:
+        if any(sx[d] is not None for d in axes):
+            sx = ctx.reshard(
+                x, tuple(None if d in axes else sx[d] for d in range(rx)),
+                "gather", "slice over a sharded dim")
+        ctx.propose(out, tuple(None if d in axes else sx[d]
+                               for d in range(rx)))
+    so = ctx.spec(out)
+    if so is not None:
+        ctx.propose(x, tuple(None if d in axes else so[d]
+                             for d in range(rx)))
+
+
+# ---------------------------------------------------------------------------
+# registration
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE = ("elementwise_add", "elementwise_sub", "elementwise_mul",
+                "elementwise_div", "elementwise_pow", "elementwise_max",
+                "elementwise_min", "elementwise_mod",
+                "elementwise_floordiv")
+
+_IDENTITY = ("relu", "relu6", "gelu", "tanh", "sigmoid", "softplus",
+             "softsign", "exp", "log", "sqrt", "rsqrt", "square", "abs",
+             "ceil", "floor", "round", "reciprocal", "scale", "cast",
+             "clip", "leaky_relu", "elu", "hard_sigmoid", "hard_swish",
+             "swish", "stanh", "brelu", "soft_relu", "pow", "sign",
+             "logsigmoid", "erf", "layer_norm", "softmax", "dropout",
+             "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+             "c_allreduce_prod", "c_allreduce_avg", "c_broadcast",
+             "c_identity", "c_sync_calc_stream", "c_sync_comm_stream",
+             "assign", "share_data", "memcpy")
+
+_REDUCE = ("reduce_mean", "reduce_sum", "reduce_max", "reduce_min",
+           "reduce_prod", "reduce_any", "reduce_all", "mean")
+
+_OPTIMIZER = ("sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+              "rmsprop", "lamb", "lars_momentum", "decayed_adagrad",
+              "ftrl", "dpsgd", "fused_sgd", "fused_momentum",
+              "fused_adam", "fused_adamw")
+
+_REPLICATED_OUT = ("fill_constant", "gaussian_random", "uniform_random",
+                   "truncated_gaussian_random", "range", "shape",
+                   "fill_zeros_like", "fill_any_like", "one_hot",
+                   "one_hot_v2")
+
+
+def ensure_registered() -> None:
+    """Register every built-in rule once (idempotent; skips op types the
+    registry doesn't know so optional families never hard-fail)."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from .. import ops  # noqa: F401  (op registrations, idempotent)
+    from ..framework import registry
+
+    if not registry._OPS:  # pragma: no cover - registry not populated yet
+        return
+    _REGISTERED = True
+
+    for t in _IDENTITY:
+        _set(t, identity_rule())
+    for t in _ELEMENTWISE:
+        _set(t, elementwise_rule)
+    for t in _REDUCE:
+        _set(t, reduce_rule)
+    for t in _OPTIMIZER:
+        _set(t, optimizer_rule)
+    for t in _REPLICATED_OUT:
+        _set(t, replicated_out_rule)
+    for t in ("mul", "matmul", "matmul_v2"):
+        _set(t, matmul_rule)
+    for t in ("transpose", "transpose2"):
+        _set(t, transpose_rule)
+    for t in ("reshape", "reshape2", "squeeze", "squeeze2", "unsqueeze",
+              "unsqueeze2", "flatten", "flatten2",
+              "flatten_contiguous_range"):
+        _set(t, reshape_rule)
+    for t in ("lookup_table", "lookup_table_v2"):
+        _set(t, embedding_rule)
+    _set("softmax_with_cross_entropy", softmax_ce_rule)
+    _set("concat", concat_rule)
+    _set("slice", slice_rule)

@@ -1,0 +1,236 @@
+"""PartitionSpec annotations on the Program IR (GSPMD-style, ISSUE 12).
+
+One spec format serves three layers (docs/sharding.md):
+
+- **IR annotations**: ``shard_tensor(var, ("dp", None))`` attaches a
+  JSON-serializable per-dim axis-name tuple to a ``Variable``; the desc
+  round-trip (framework/serialization.py) and ``Program.clone`` preserve
+  it, and the executor's gspmd mode already consumes ``var.sharding`` when
+  building ``NamedSharding``s.
+- **Propagation** (propagate.py): the fixpoint pass reads annotated specs
+  and derives everything else, merging by *refinement* — ``None`` (a
+  replicated dim) may be refined to a named axis; two different named
+  axes on the same dim are a conflict.
+- **Lowering**: ``to_partition_spec`` converts to
+  ``jax.sharding.PartitionSpec`` for ``jax.jit`` + ``NamedSharding``.
+
+A spec here is a tuple with one entry per tensor dim: ``None`` (dim
+replicated), an axis name string, or a tuple of axis names (dim sharded
+over several axes, majorest first — jax PartitionSpec semantics). Specs
+shorter than the tensor rank are padded with ``None`` on the right, the
+same convention jax uses.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SpecConflict", "normalize_spec", "spec_to_json", "spec_from_json",
+    "to_partition_spec", "spec_axes", "pad_spec", "merge_specs",
+    "is_replicated", "shard_tensor", "annotate_program", "annotated_vars",
+    "mesh_axes_of", "spec_str", "shard_divisor",
+]
+
+
+class SpecConflict(ValueError):
+    """Two specs demand different named axes on the same dim."""
+
+
+def _norm_entry(entry):
+    if entry is None:
+        return None
+    if isinstance(entry, str):
+        return entry
+    if isinstance(entry, (tuple, list)):
+        axes = tuple(str(a) for a in entry)
+        if not axes:
+            return None
+        if len(axes) == 1:
+            return axes[0]
+        return axes
+    raise TypeError(f"bad PartitionSpec entry {entry!r}")
+
+
+def normalize_spec(spec) -> Tuple:
+    """Canonical tuple form from a jax PartitionSpec, list, or tuple."""
+    if spec is None:
+        return ()
+    # jax.sharding.PartitionSpec is itself a tuple subclass on modern jax;
+    # duck-type by iterating either way
+    if isinstance(spec, (str,)):
+        return (spec,)
+    return tuple(_norm_entry(e) for e in spec)
+
+
+def spec_to_json(spec) -> List:
+    """JSON-able form (tuples become lists)."""
+    out = []
+    for e in normalize_spec(spec):
+        out.append(list(e) if isinstance(e, tuple) else e)
+    return out
+
+
+def spec_from_json(data) -> Tuple:
+    if data is None:
+        return ()
+    return normalize_spec(data)
+
+
+def to_partition_spec(spec):
+    """Canonical tuple -> jax.sharding.PartitionSpec."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(*normalize_spec(spec))
+
+
+def spec_axes(spec) -> Tuple[str, ...]:
+    """Every mesh axis named by the spec, in order of first appearance."""
+    out: List[str] = []
+    for e in normalize_spec(spec):
+        if e is None:
+            continue
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a not in out:
+                out.append(a)
+    return tuple(out)
+
+
+def pad_spec(spec, rank: int) -> Tuple:
+    """Right-pad with None to ``rank`` entries (jax convention)."""
+    s = normalize_spec(spec)
+    if len(s) > rank:
+        raise ValueError(f"spec {s} has more entries than tensor rank {rank}")
+    return s + (None,) * (rank - len(s))
+
+
+def is_replicated(spec) -> bool:
+    return all(e is None for e in normalize_spec(spec))
+
+
+def spec_str(spec) -> str:
+    """Compact human form: P(dp, None) style."""
+    parts = []
+    for e in normalize_spec(spec):
+        if e is None:
+            parts.append("None")
+        elif isinstance(e, tuple):
+            parts.append("(" + ",".join(e) + ")")
+        else:
+            parts.append(str(e))
+    return "P(" + ", ".join(parts) + ")"
+
+
+def shard_divisor(spec, dim: int, mesh_sizes: Dict[str, int]) -> int:
+    """How many ways ``dim`` is split under ``spec`` on a mesh of
+    ``mesh_sizes`` ({axis: size}); unknown axes count as size 1."""
+    s = normalize_spec(spec)
+    if dim >= len(s) or s[dim] is None:
+        return 1
+    axes = s[dim] if isinstance(s[dim], tuple) else (s[dim],)
+    n = 1
+    for a in axes:
+        n *= int(mesh_sizes.get(a, 1))
+    return n
+
+
+def merge_specs(a, b, rank: Optional[int] = None) -> Tuple:
+    """Refinement merge: per dim, ``None`` yields to a named axis; two
+    different named entries raise :class:`SpecConflict`.  ``rank`` pads
+    both sides before merging (required when they differ in length)."""
+    a, b = normalize_spec(a), normalize_spec(b)
+    if rank is None:
+        rank = max(len(a), len(b))
+    a, b = pad_spec(a, rank), pad_spec(b, rank)
+    out = []
+    for d, (ea, eb) in enumerate(zip(a, b)):
+        if ea == eb or eb is None:
+            out.append(ea)
+        elif ea is None:
+            out.append(eb)
+        else:
+            raise SpecConflict(
+                f"dim {d}: {spec_str(a)} vs {spec_str(b)} "
+                f"({ea!r} != {eb!r})")
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# IR annotation API
+# ---------------------------------------------------------------------------
+
+def shard_tensor(var, spec) -> None:
+    """Annotate one IR :class:`Variable` with a PartitionSpec.
+
+    The canonical tuple lands on ``var.sharding`` (the attribute the
+    executor's gspmd mode already reads) and survives desc serialization
+    and ``Program.clone``. Rank is validated against the declared shape
+    when one exists."""
+    s = normalize_spec(spec)
+    shape = tuple(getattr(var, "shape", ()) or ())
+    if shape and len(s) > len(shape):
+        raise ValueError(
+            f"PartitionSpec {spec_str(s)} has {len(s)} entries but var "
+            f"{var.name!r} has rank {len(shape)}")
+    var.sharding = pad_spec(s, len(shape)) if shape else s
+
+
+def _find_var(program, name: str):
+    for block in program.blocks:
+        if name in block.vars:
+            return block.vars[name]
+    return None
+
+
+def annotate_program(program, annotations: Dict[str, Any],
+                     mesh_axes: Optional[Sequence[Tuple[str, int]]] = None,
+                     data_axis: Optional[str] = None) -> None:
+    """Attach PartitionSpecs to named vars of ``program`` and (optionally)
+    stamp the target mesh into ``program._annotations['mesh']`` in the
+    executor's gspmd MeshPlan schema — annotated programs then lower
+    through ``jax.jit`` + ``NamedSharding`` with no further plumbing.
+    """
+    missing = []
+    for name, spec in annotations.items():
+        var = _find_var(program, name)
+        if var is None:
+            missing.append(name)
+            continue
+        shard_tensor(var, spec)
+    if missing:
+        raise ValueError(
+            f"annotate_program: no var(s) named {sorted(missing)} in the "
+            "program")
+    # record the EXPLICIT seed set: propagation anchors to it even after
+    # apply_sharding writes derived specs onto every var
+    seen = set(program._annotations.get("sharding_annotated") or [])
+    program._annotations["sharding_annotated"] = sorted(
+        seen | set(annotations))
+    if mesh_axes is not None:
+        program._annotations["mesh"] = {
+            "mode": "gspmd",
+            "axes": [(str(a), int(s)) for a, s in mesh_axes],
+            "data_axis": data_axis,
+            "ring_axes": {},
+        }
+
+
+def annotated_vars(program) -> Dict[str, Tuple]:
+    """{var name: canonical spec} over every annotated var of every
+    block (vars defaulted by propagation — all-None specs included)."""
+    out: Dict[str, Tuple] = {}
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            s = getattr(var, "sharding", None)
+            if s is not None:
+                out[name] = normalize_spec(s)
+    return out
+
+
+def mesh_axes_of(program) -> Optional[List[Tuple[str, int]]]:
+    """The annotated mesh axes, if any ([('dp', 8), ...])."""
+    mesh = program._annotations.get("mesh") if hasattr(
+        program, "_annotations") else None
+    if not mesh:
+        return None
+    axes = mesh.get("axes") or ()
+    return [(str(a), int(s)) for a, s in axes] or None

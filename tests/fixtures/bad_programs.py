@@ -217,3 +217,51 @@ def dynamic_inner_dim():
     block.create_var(name="e", shape=(-1, -1), dtype="int64")
     block.append_op("relu", {"X": "tokens"}, {"Out": "e"})
     return main
+
+
+# ---------------------------------------------------------------------------
+# sharding
+# ---------------------------------------------------------------------------
+
+def sharding_annotation_conflict():
+    """Two explicit annotations fight across an identity op: relu input
+    batch-sharded over 'a', output over 'b' — propagation must report the
+    conflict, never silently pick a side."""
+    from paddle_tpu import sharding
+
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="x", shape=(8, 4), dtype="float32", is_data=True)
+    block.create_var(name="y", shape=(8, 4), dtype="float32")
+    block.append_op("relu", {"X": "x"}, {"Out": "y"})
+    sharding.annotate_program(main, {"x": ("a", None), "y": ("b", None)},
+                              mesh_axes=[("a", 2), ("b", 2)])
+    return main
+
+
+def sharding_indivisible_dim():
+    """A dim of 6 sharded over a 4-way axis."""
+    from paddle_tpu import sharding
+
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="x", shape=(6, 4), dtype="float32", is_data=True)
+    block.create_var(name="y", shape=(6, 4), dtype="float32")
+    block.append_op("relu", {"X": "x"}, {"Out": "y"})
+    sharding.annotate_program(main, {"x": ("dp", None)},
+                              mesh_axes=[("dp", 4)])
+    return main
+
+
+def sharding_unknown_axis():
+    """Spec names an axis the mesh annotation doesn't declare."""
+    from paddle_tpu import sharding
+
+    main = _fresh()
+    block = main.global_block()
+    block.create_var(name="x", shape=(8, 4), dtype="float32", is_data=True)
+    block.create_var(name="y", shape=(8, 4), dtype="float32")
+    block.append_op("relu", {"X": "x"}, {"Out": "y"})
+    sharding.annotate_program(main, {"x": ("tp", None)},
+                              mesh_axes=[("dp", 8)])
+    return main

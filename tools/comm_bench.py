@@ -85,7 +85,7 @@ def run_config(name, kw, cfg, pcfg, mesh, tokens, labels, steps,
 
     init_kw = {k: v for k, v in kw.items()
                if k in ("grad_reduce", "bucket_mb", "error_feedback",
-                        "grad_allreduce_dtype")}
+                        "grad_allreduce_dtype", "sharding")}
     params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg, mesh,
                                   **init_kw)
     step = PZ.make_train_step(cfg, pcfg, mesh, lr=lr, grad_clip=grad_clip,
@@ -172,6 +172,11 @@ def main():
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--bucket-mb", type=float, default=None,
                     help="override CommConfig.bucket_mb for the rs configs")
+    ap.add_argument("--sharding", default=None,
+                    help="comma list of GSPMD sharding-plan presets "
+                         "(dp,fsdp) to bench as extra gspmd_* configs "
+                         "through the propagated-NamedSharding lowering "
+                         "(docs/sharding.md)")
     ap.add_argument("--profile-overlap", action="store_true", default=None)
     ap.add_argument("--monitor", default=None,
                     help="also write TrainMonitor JSONL rows per config")
@@ -203,8 +208,19 @@ def main():
     labels = rng.integers(0, cfg.vocab_size, (m, args.batch, args.T),
                           dtype=np.int32)
 
+    configs = list(CONFIGS)
+    if args.sharding:
+        # sharding-layer lanes (ISSUE 12): same model/mesh, lowered via
+        # the propagated-NamedSharding GSPMD step; wire bytes come from
+        # the plan's static comm_opt estimate (GSPMD's own collectives
+        # aren't individually instrumented)
+        for mode in args.sharding.split(","):
+            mode = mode.strip()
+            if mode and mode != "none":
+                configs.append((f"gspmd_{mode}", {"sharding": mode}))
+
     rows, final_params = [], {}
-    for name, kw in CONFIGS:
+    for name, kw in configs:
         if args.bucket_mb is not None and kw.get("grad_reduce") == \
                 "reduce_scatter":
             kw = dict(kw, bucket_mb=args.bucket_mb)
@@ -229,6 +245,15 @@ def main():
         base["losses"] == by_name["reduce_scatter_f32"]["losses"]
     by_name["reduce_scatter_f32"]["bit_identical_to_psum"] = bool(
         bit_identical)
+
+    if "gspmd_dp" in by_name:
+        # the sharding-layer dp plan must reproduce the psum baseline's
+        # weight trajectory bit-for-bit (same grad_clip=None discipline
+        # as the rs parity pair)
+        pg = jax.tree_util.tree_leaves(final_params["gspmd_dp"])
+        by_name["gspmd_dp"]["params_bit_identical_to_psum"] = bool(all(
+            bool((np.asarray(a) == np.asarray(b)).all())
+            for a, b in zip(p0, pg)))
 
     def ratio(a, b):
         return round(a / b, 3) if b else None

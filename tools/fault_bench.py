@@ -190,8 +190,14 @@ def worker(args):
     stream_restore = None
     latest = ck.latest_valid_step()
     if latest is not None:
+        # live-mesh validation (ISSUE 12): a dp change is legal here —
+        # both bucket layouts exist, the reshard path covers it; a
+        # different axis SET would raise MeshMismatchError instead of
+        # resharding wrong silently
         params, opt, man = restore_train_state(
-            ck, params, opt, layout=layout, layout_repl=repl, step=latest)
+            ck, params, opt, layout=layout, layout_repl=repl, step=latest,
+            mesh={a: int(s) for a, s in zip(pcfg.axis_names,
+                                            (pcfg.dp, pcfg.pp, pcfg.tp))})
         start = int(man["step"])
         restored_from = start
         want = (man.get("extra") or {}).get("moment_leaf_crcs")

@@ -445,6 +445,42 @@ def _run_check_inner(out_dir: str) -> dict:
     assert lint_after.get("error", 0) == lint_before.get("error", 0), \
         "error-severity lint findings appeared on the clean MLP program"
 
+    # --- sharding propagation counter (docs/sharding.md, ISSUE 12) ------
+    # annotate the SAME trained MLP program batch-sharded over dp and
+    # propagate: the loss reduction over the sharded batch dim is one
+    # implied psum edge, which must land in
+    # paddle_resharding_bytes_total{edge} (edge names the op/var), and
+    # the propagation must be conflict-free
+    from paddle_tpu import sharding as _sharding
+
+    def _reshard_series():
+        snap3 = default_registry().snapshot()
+        series = snap3.get("paddle_resharding_bytes_total", {}) \
+            .get("series", [])
+        return {s["labels"][0]: s["value"] for s in series}
+
+    reshard_before = _reshard_series()
+    shard_prog = prog.clone()
+    _sharding.annotate_program(
+        shard_prog, {"x": ("dp", None), "y": ("dp", None)},
+        mesh_axes=[("dp", 8)], data_axis="dp")
+    shard_res = _sharding.propagate_program(shard_prog)
+    assert shard_res.complete, \
+        "sharding propagation conflicts on the annotated MLP:\n" + \
+        "\n".join(c.format() for c in shard_res.conflicts)
+    assert shard_res.reshards, \
+        "annotated MLP propagation recorded no reshard edge (the " \
+        "sharded-batch loss reduction must imply one psum)"
+    reshard_after = _reshard_series()
+    reshard_delta = (sum(reshard_after.values())
+                     - sum(reshard_before.values()))
+    assert reshard_delta == shard_res.total_reshard_bytes > 0, \
+        f"paddle_resharding_bytes_total moved {reshard_delta}, " \
+        f"expected {shard_res.total_reshard_bytes}"
+    assert any("reduce_mean" in e for e in reshard_after), \
+        f"reshard edge labels {sorted(reshard_after)} do not name the " \
+        "reduce_mean psum edge"
+
     # --- serving gate (docs/serving.md): warmed 20-request smoke serve --
     # the whole point of the AOT-bucketed engine is that a WARMED server
     # never compiles again: the recompile-explainer counter must not move
@@ -586,6 +622,12 @@ def _run_check_inner(out_dir: str) -> dict:
         "open-stage retry sample missing from exposition"
     assert 'paddle_input_shard_progress{shard=' in prom_text, \
         "per-shard progress gauge missing from exposition"
+    # sharding family (docs/sharding.md): the propagation above must have
+    # exposed its implied-reshard accounting
+    assert "paddle_resharding_bytes_total" in prom_text, \
+        "paddle_resharding_bytes_total missing from exposition"
+    assert 'paddle_resharding_bytes_total{edge=' in prom_text, \
+        "reshard edge sample missing from exposition"
     # goodput families (docs/observability.md): every category present
     for c in goodput.CATEGORIES:
         assert f'paddle_goodput_seconds_total{{category="{c}"}}' \
@@ -602,6 +644,7 @@ def _run_check_inner(out_dir: str) -> dict:
             "checkpoint_steps": committed,
             "checkpoint_bytes": ckpt_bytes,
             "lint_findings": lint_after,
+            "resharding_bytes": reshard_delta,
             "guardrail_skips": skips_delta,
             "goodput_window": gp_window,
             "serve_span_rollups": {k: v for k, v in rollup.items()

@@ -21,12 +21,18 @@ Usage:
   python tools/mfu_sweep.py --base d=64,L=2,nh=4,ff=128,T=32,b=8,steps=2,flash=0,dp=8 \
       --grad-reduce psum,reduce_scatter --comm-dtype f32,bf16 --bucket-mb 32
 
+  # sharding-layer axis (docs/sharding.md): run the same base config via
+  # the propagated-NamedSharding GSPMD step instead of the shard_map path
+  python tools/mfu_sweep.py --base d=64,L=2,nh=4,ff=128,T=32,b=8,steps=2,flash=0,dp=8 \
+      --sharding none,dp,fsdp
+
 Spec keys: b, steps, remat (none|full|dots|save_only_flash), bq, bk, nh, d,
 L, ff, T, flash, mom (f32|bf16), scan, celim, chunk (CE row chunk),
 vchunk (CE vocab chunk, 0 = off), fused (1 = flat-buffer fused optimizer),
 dp (data-parallel ranks; b is the GLOBAL batch), gr (psum|reduce_scatter),
 cdt (f32|bf16|int8 collective wire dtype), bmb (bucket cap MiB),
-ef (1 = error-feedback residual for quantized comm).
+ef (1 = error-feedback residual for quantized comm),
+shard (none|dp|fsdp|tp — lower through the GSPMD sharding plan; ISSUE 12).
 Every config's result is emitted as one machine-readable JSON row on stdout
 (the ranked human table follows after).
 """
@@ -110,6 +116,7 @@ def _measure_spec(spec_str, np, jax):
     comm_dtype = spec.get("cdt", "f32")        # f32 | bf16 | int8 wire dtype
     bucket_mb = float(spec.get("bmb", 32))     # reduce-scatter bucket cap
     error_fb = spec.get("ef", "0") == "1"      # quantized-comm residual
+    shard = spec.get("shard", "none")          # GSPMD sharding plan preset
 
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
@@ -156,6 +163,8 @@ def _measure_spec(spec_str, np, jax):
     import jax.numpy as jnp
     comm_kw = dict(grad_reduce=grad_reduce, grad_allreduce_dtype=comm_dtype,
                    bucket_mb=bucket_mb, error_feedback=error_fb)
+    if shard != "none":
+        comm_kw["sharding"] = shard   # GSPMD plan lowering (ISSUE 12)
     params, opt = PZ.init_sharded(
         jax.random.PRNGKey(0), cfg, pcfg, mesh,
         moment_dtype=jnp.bfloat16 if mom == "bf16" else None,
@@ -244,21 +253,25 @@ def build_specs():
     gr_axis = _flag_values("--grad-reduce", ["psum", "reduce_scatter"])
     cdt_axis = _flag_values("--comm-dtype", ["f32", "bf16"])
     bmb_axis = _flag_values("--bucket-mb", ["32"])
-    if gr_axis or cdt_axis or bmb_axis:
+    shard_axis = _flag_values("--sharding", ["none", "dp", "fsdp"])
+    if gr_axis or cdt_axis or bmb_axis or shard_axis:
         base = (sys.argv[sys.argv.index("--base") + 1]
                 if "--base" in sys.argv else _WINNER_BASE)
         specs = []
-        for gr in (gr_axis or [None]):
-            for cdt in (cdt_axis or [None]):
-                for bmb in (bmb_axis or [None]):
-                    s = base
-                    if gr is not None:
-                        s += f",gr={gr}"
-                    if cdt is not None and cdt != "f32":
-                        s += f",cdt={cdt}"
-                    if bmb is not None and gr == "reduce_scatter":
-                        s += f",bmb={bmb}"
-                    specs.append(s)
+        for sh in (shard_axis or [None]):
+            for gr in (gr_axis or [None]):
+                for cdt in (cdt_axis or [None]):
+                    for bmb in (bmb_axis or [None]):
+                        s = base
+                        if sh is not None and sh != "none":
+                            s += f",shard={sh}"
+                        if gr is not None:
+                            s += f",gr={gr}"
+                        if cdt is not None and cdt != "f32":
+                            s += f",cdt={cdt}"
+                        if bmb is not None and gr == "reduce_scatter":
+                            s += f",bmb={bmb}"
+                        specs.append(s)
         return specs
     if ce_axis is None and fused_axis is None:
         # default sweep = the measured-winner neighborhood (KERNEL_NOTES
