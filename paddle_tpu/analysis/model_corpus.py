@@ -245,6 +245,70 @@ def build_serving_decode() -> ModelProgram:
                         [prob.name, new_cache.name])
 
 
+def build_serving_prefill_tp2() -> ModelProgram:
+    """The serving prefill shape under a Megatron tp=2 annotation set
+    (ISSUE 13): first fc column-split, second fc row-split — the
+    IR-level model of the tensor-parallel prefill executable
+    (``EngineConfig(sharding="tp")``). Propagation must derive the
+    column-split bias, record the row-parallel partial-sum as an info
+    edge, and find ZERO errors — the static twin of the engine's
+    tp-logits-match-single-chip parity bar."""
+    from paddle_tpu import sharding
+
+    mp = build_serving_prefill()
+    params = {p.name for p in mp.main.all_parameters()}
+    fc_w = sorted(p for p in params if p.endswith(".w_0"))
+    sharding.annotate_program(
+        mp.main,
+        {"srv_wte": (), fc_w[0]: (None, "tp"), fc_w[1]: ("tp", None)},
+        mesh_axes=[("tp", 2)])
+    return ModelProgram("serving_prefill_tp2", mp.main, mp.startup,
+                        mp.feed_names, mp.fetch_names)
+
+
+def build_serving_decode_tp2() -> ModelProgram:
+    """The serving decode shape with the KV-HEAD SPLIT the tp engine
+    runs: the cache feed is [B, S, nh, hd] annotated ``tp`` on the head
+    dim (exactly how the engine shards its slab/pool at dim 3), the
+    up-projection is column-split, the logits head row-split. The
+    sharding checker must see the head split ride through the ring
+    shift (slice+concat) and the pooled reduction with zero errors."""
+    def b(fluid):
+        V, B, S, NH, HD = 64, 4, 8, 4, 8
+        D = NH * HD
+        tok = fluid.layers.data("token", [B, 1], dtype="int64",
+                                append_batch_size=False)
+        cache = fluid.layers.data("cache_k", [B, S, NH, HD],
+                                  dtype="float32",
+                                  append_batch_size=False)
+        emb = fluid.layers.embedding(
+            tok, size=[V, D], param_attr=fluid.ParamAttr("srv_wte_tp"))
+        h = fluid.layers.fc(emb, D, num_flatten_dims=2)     # column-par
+        hr = fluid.layers.reshape(h, [B, 1, NH, HD])
+        # ring shift on the head-split cache: drop the oldest row,
+        # append this token's head-split slab
+        tail = fluid.layers.slice(cache, axes=[1], starts=[1], ends=[S])
+        new_cache = fluid.layers.concat([tail, hr], axis=1)
+        pooled = fluid.layers.reduce_mean(new_cache, dim=1)  # [B,NH,HD]
+        flat = fluid.layers.reshape(pooled, [B, D])
+        logits = fluid.layers.fc(flat, V)                    # row-par
+        return fluid.layers.softmax(logits), new_cache
+
+    from paddle_tpu import sharding
+
+    main, startup, (prob, new_cache) = _guarded(b)
+    params = {p.name for p in main.all_parameters()}
+    fc_w = sorted(p for p in params if p.endswith(".w_0"))
+    sharding.annotate_program(
+        main,
+        {"cache_k": (None, None, "tp", None),
+         fc_w[0]: (None, "tp"), fc_w[1]: ("tp", None)},
+        mesh_axes=[("tp", 2)])
+    return ModelProgram("serving_decode_tp2", main, startup,
+                        ["token", "cache_k"],
+                        [prob.name, new_cache.name])
+
+
 def build_mlp_dp() -> ModelProgram:
     """The mlp with GSPMD-style dp annotations (ISSUE 12): ONLY the two
     data feeds are annotated batch-sharded; propagation derives every
@@ -306,6 +370,8 @@ MODEL_BUILDERS: "Dict[str, Callable[[], ModelProgram]]" = {
     "ps_transpiled": build_ps_transpiled,
     "serving_prefill": build_serving_prefill,
     "serving_decode": build_serving_decode,
+    "serving_prefill_tp2": build_serving_prefill_tp2,
+    "serving_decode_tp2": build_serving_decode_tp2,
     "mlp_dp": build_mlp_dp,
     "gpt_tp2": build_gpt_tp2,
     "gpt_fsdp": build_gpt_fsdp,

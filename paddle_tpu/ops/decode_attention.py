@@ -23,25 +23,41 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["decode_attention", "cache_update", "prefill_attention"]
+__all__ = ["decode_attention", "cache_update", "prefill_attention",
+           "paged_gather", "paged_cache_update", "paged_page_write",
+           "paged_prefill_attention", "window_attention",
+           "window_cache_update"]
 
 
-def cache_update(cache, new, positions):
+def cache_update(cache, new, positions, active=None):
     """Write one new per-sequence row into the cache at ``positions``.
 
     cache:     [B, S, nh, hd]  (one layer's K or V slab, slot-major)
     new:       [B, nh, hd]     (this step's projection per sequence)
     positions: [B] int32       (write index per slot; traced, not static)
+    active:    [B] bool-ish    (optional write mask: inactive lanes keep
+                                the row that was already there — a LIVE
+                                slot riding a partial batch as a masked
+                                lane must not have its row 0 clobbered)
 
     Returns the updated cache. A per-slot ``dynamic_update_slice`` under
     ``vmap`` lowers to one scatter — fixed shapes, so donation makes it an
     in-place HBM write on TPU.
     """
+    if active is None:
 
-    def upd(c, n, p):
-        return jax.lax.dynamic_update_slice(c, n[None], (p, 0, 0))
+        def upd(c, n, p):
+            return jax.lax.dynamic_update_slice(c, n[None], (p, 0, 0))
 
-    return jax.vmap(upd)(cache, new.astype(cache.dtype), positions)
+        return jax.vmap(upd)(cache, new.astype(cache.dtype), positions)
+
+    def upd_masked(c, n, p, a):
+        cur = jax.lax.dynamic_slice(c, (p, 0, 0), (1,) + c.shape[1:])
+        val = jnp.where(a != 0, n[None].astype(c.dtype), cur)
+        return jax.lax.dynamic_update_slice(c, val, (p, 0, 0))
+
+    return jax.vmap(upd_masked)(cache, new.astype(cache.dtype),
+                                positions, active)
 
 
 def decode_attention(q, k_cache, v_cache, lengths,
@@ -74,6 +90,139 @@ def decode_attention(q, k_cache, v_cache, lengths,
     denom = jnp.sum(e, axis=-1, keepdims=True)
     probs = e / jnp.maximum(denom, 1e-30)
     out = jnp.einsum("bns,bsnh->bnh", probs,
+                     v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_gather(pool, tables):
+    """Materialize per-slot contiguous cache views from a paged pool.
+
+    pool:   [P, page, nh, hd]  (one layer's K or V page pool)
+    tables: [B, M] int32       (physical page per logical page per slot;
+                                unmapped entries point at the reserved
+                                scratch page — positions there are always
+                                masked by the caller's lengths)
+
+    Returns [B, M*page, nh, hd] — the slot-major layout every attention
+    helper here already consumes, so the paged variants are gather +
+    the existing masked-softmax kernels (one fused gather under XLA; a
+    Pallas gather-attention fusion is the KERNEL_NOTES follow-up once
+    decode batches make the [B, S] round-trip measurable)."""
+    B, M = tables.shape
+    g = pool[tables]                       # [B, M, page, nh, hd]
+    return g.reshape(B, M * pool.shape[1], pool.shape[2], pool.shape[3])
+
+
+def paged_cache_update(pool, new, phys_pages, rows):
+    """Write one new row per sequence into the page pool.
+
+    pool:       [P, page, nh, hd]
+    new:        [B, nh, hd]
+    phys_pages: [B] int32   (physical page per slot — scratch for dead lanes)
+    rows:       [B] int32   (row within the page)
+
+    Batch scatter with fixed shapes — donation makes it an in-place HBM
+    write. Colliding indices only occur on the scratch page, which is
+    never read back."""
+    return pool.at[phys_pages, rows].set(new.astype(pool.dtype))
+
+
+def paged_page_write(pool, pages_data, phys_pages):
+    """Write whole pages into the pool (the prefill path).
+
+    pool:       [P, page, nh, hd]
+    pages_data: [n, page, nh, hd]  (suffix K/V reshaped to page granularity)
+    phys_pages: [n] int32
+    """
+    return pool.at[phys_pages].set(pages_data.astype(pool.dtype))
+
+
+def paged_prefill_attention(q, k_all, v_all, prefix_len,
+                            sm_scale: Optional[float] = None):
+    """Suffix prefill over a gathered paged view (prefix-cache capable).
+
+    q:          [1, T, nh, hd]  — suffix queries at global positions
+                                  ``prefix_len + i``
+    k_all/v_all:[1, S, nh, hd]  — the slot's full gathered view (cached
+                                  prefix rows + this call's suffix rows
+                                  already scattered in)
+    prefix_len: scalar int32    — tokens already cached ahead of the
+                                  suffix (page-aligned by the allocator)
+
+    Query i may attend key j iff ``j <= prefix_len + i`` — plain causal
+    attention when prefix_len == 0, continuation prefill otherwise. Same
+    f32 contraction order as :func:`decode_attention` so a decode replay
+    of the same positions agrees to float rounding."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    T, S = q.shape[1], k_all.shape[1]
+    scores = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32),
+                        k_all.astype(jnp.float32)) * sm_scale
+    mask = (jnp.arange(S)[None, :]
+            <= prefix_len + jnp.arange(T)[:, None])[None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.where(mask, jnp.exp(scores - m), 0.0)
+    probs = e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bnqk,bknh->bqnh", probs, v_all.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def window_cache_update(cache, new, starts, active=None):
+    """Write a W-token window per sequence into the slab cache.
+
+    cache:  [B, S, nh, hd]
+    new:    [B, W, nh, hd]   (the speculative-verify window's K or V)
+    starts: [B] int32        (first write position per slot)
+    active: [B] bool-ish     (optional write mask, as in
+                              :func:`cache_update`)
+
+    The window is contiguous, so one per-slot ``dynamic_update_slice``
+    under vmap covers it (the W=1 case reduces to :func:`cache_update`)."""
+    if active is None:
+
+        def upd(c, n, s):
+            return jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+
+        return jax.vmap(upd)(cache, new.astype(cache.dtype), starts)
+
+    def upd_masked(c, n, s, a):
+        cur = jax.lax.dynamic_slice(
+            c, (s, 0, 0), (n.shape[0],) + c.shape[1:])
+        val = jnp.where(a != 0, n.astype(c.dtype), cur)
+        return jax.lax.dynamic_update_slice(c, val, (s, 0, 0))
+
+    return jax.vmap(upd_masked)(cache, new.astype(cache.dtype), starts,
+                                active)
+
+
+def window_attention(q, k_cache, v_cache, starts,
+                     sm_scale: Optional[float] = None):
+    """W-query attention over the cache (speculative-verify window).
+
+    q:        [B, W, nh, hd]  — window queries; query w sits at global
+                               position ``starts[b] + w``
+    k_cache:  [B, S, nh, hd]  — cache with the window rows already written
+    starts:   [B] int32
+
+    Query w attends keys ``j <= starts + w`` (causal across the window,
+    full visibility of the prefix). W=1 is exactly
+    :func:`decode_attention` with ``lengths = starts + 1``."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    W, S = q.shape[1], k_cache.shape[1]
+    scores = jnp.einsum("bwnh,bsnh->bnws", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * sm_scale
+    mask = (jnp.arange(S)[None, None, :]
+            <= starts[:, None, None] + jnp.arange(W)[None, :, None])
+    mask = mask[:, None]                   # [B, 1, W, S]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.where(mask, jnp.exp(scores - m), 0.0)
+    probs = e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bnws,bsnh->bwnh", probs,
                      v_cache.astype(jnp.float32))
     return out.astype(q.dtype)
 

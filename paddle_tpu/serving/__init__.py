@@ -30,6 +30,13 @@ from .engine import (  # noqa: F401
     default_bucket_ladder,
 )
 from .kv_cache import CacheFullError, KVCache  # noqa: F401
+from .paged_kv import (  # noqa: F401
+    PagedKVCache,
+    PagePoolFullError,
+    PrefixCache,
+)
+from .sampling import GREEDY, SamplingParams  # noqa: F401
+from .spec_decode import SpecDecodeEngine, SpecStats  # noqa: F401
 from .quant import (  # noqa: F401
     INT8_LOGIT_TOL,
     INT8_PPL_REL_TOL,
@@ -48,6 +55,8 @@ from .server import EngineLoop, FrontDoor  # noqa: F401
 __all__ = [
     "DecodeEngine", "EngineConfig", "PromptTooLongError",
     "default_bucket_ladder", "KVCache", "CacheFullError",
+    "PagedKVCache", "PrefixCache", "PagePoolFullError",
+    "SamplingParams", "GREEDY", "SpecDecodeEngine", "SpecStats",
     "quantize_params", "dequantize_params", "logit_error_stats",
     "INT8_LOGIT_TOL", "INT8_PPL_REL_TOL",
     "Scheduler", "SchedulerConfig", "Request", "QueueFullError",

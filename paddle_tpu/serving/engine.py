@@ -3,27 +3,35 @@ preallocated KV cache (docs/serving.md).
 
 The training side already proved the ingredients — PR 1's cached dispatch,
 PR 4's explicit ``lower()+compile()`` AOT executables and recompile
-explainer, PR 5's chunk-scaled quantizer. This module assembles them into
-the serving shape:
+explainer, PR 5's chunk-scaled quantizer, PR 12's sharding plans. This
+module assembles them into the serving shape:
 
-- **Two program families, compiled once.** Prefill is shape-bucketed: a
-  small fixed ladder of sequence lengths (``EngineConfig.prefill_buckets``),
-  one executable per bucket, every prompt padded up to its bucket. Decode
-  is ONE executable over the static ``[max_batch]`` slot layout — requests
-  join and leave the batch by editing host-side slot state, never a shape.
-  After :meth:`DecodeEngine.warmup`, steady-state serving performs zero
-  compiles; the PR 4 ``paddle_recompiles_total`` counter is the guardrail
-  (tools/metrics_check.py asserts its delta is exactly zero across a
-  warmed smoke serve).
-- **KV cache as carried state.** Both executables take the cache slabs as
-  arguments and return the updated slabs; on TPU the buffers are donated,
-  so the update is an in-place HBM write (donation is skipped on backends
-  that do not support it — CPU — where it would only emit warnings).
-- **Weights in serving precision.** ``weight_dtype="int8"|"bf16"`` stores
-  params through serving/quant.py; dequantization happens inside the
-  compiled functions so HBM holds the quantized bytes. The f32 reference
-  params are kept host-side for the parity bar (drop them with
-  :meth:`drop_reference_params` when HBM matters).
+- **Compiled once, shapes static forever.** Prefill is shape-bucketed
+  (one executable per ladder rung, prompts padded up); decode is ONE
+  executable over the static ``[max_batch]`` slot layout; the optional
+  speculative-verify window is one more static ``[max_batch, W]``
+  executable. Requests join and leave the batch by editing host-side slot
+  state, never a shape. After :meth:`DecodeEngine.warmup`, steady-state
+  serving performs zero compiles; ``paddle_recompiles_total`` is the
+  guardrail.
+- **KV cache as carried state.** Slab layout
+  (``[L, max_batch, max_seq, nh, hd]``, PR 9) or the paged layout
+  (``serving/paged_kv.py``: a ``[L, num_pages, page_size, nh, hd]`` pool
+  + per-slot page tables fed as device arrays, prefix-cache capable).
+  Both are threaded through every executable with buffer donation on TPU.
+- **Sampling inside the executables** (``serving/sampling.py``):
+  per-slot temperature/top-k/top-p/seed ride as batch inputs — changing
+  them never changes a shape. ``temperature=0`` is bit-exact greedy.
+- **Tensor-parallel lowering** (``EngineConfig(sharding="tp", tp=N)``):
+  attention/MLP weights and the KV head axis shard over an N-chip mesh
+  through PR 12's plan machinery (``sharding/plan.py`` suffix
+  inheritance) + ``jax.jit`` ``in_shardings``/``out_shardings`` — the
+  AOT warmup ladder, cache donation, and the zero-recompile gate all
+  survive ``NamedSharding``.
+- **Weights in serving precision.** ``weight_dtype="int8"|"bf16"``
+  through serving/quant.py; dequantization happens inside the compiled
+  functions. (int8's flat chunk layout cannot head-shard — ``tp`` engines
+  take f32/bf16.)
 
 The engine is single-threaded by contract: exactly one scheduler loop
 calls it (serving/scheduler.py). It is GPT-first (models/gpt.py param
@@ -45,10 +53,17 @@ from ..models.gpt import GPTConfig
 from ..observability import program_report as _prep
 from ..observability import spans as _spans
 from ..ops.decode_attention import (cache_update, decode_attention,
-                                    prefill_attention)
+                                    paged_cache_update, paged_gather,
+                                    paged_page_write,
+                                    paged_prefill_attention,
+                                    prefill_attention, window_attention,
+                                    window_cache_update)
 from . import metrics as smetrics
+from . import sampling as samp
 from .kv_cache import KVCache
+from .paged_kv import PagedKVCache, PagePoolFullError, PrefixCache
 from .quant import dequantize_params, quantize_params, quantized_nbytes
+from .sampling import GREEDY, SamplingParams
 
 __all__ = ["EngineConfig", "DecodeEngine", "PromptTooLongError",
            "default_bucket_ladder"]
@@ -82,6 +97,17 @@ class EngineConfig:
     quant_chunk: int = 256           # int8 scale granularity
     cache_dtype: Any = None          # None -> the model's compute dtype
     eos_id: Optional[int] = None     # greedy decode stops on this token
+    # -- KV layout (docs/serving.md "Paged KV") -------------------------
+    kv_layout: str = "slab"          # "slab" | "paged"
+    page_size: int = 16              # tokens per page (divides buckets)
+    num_pages: int = 0               # 0 -> slab-parity pool (+1 scratch)
+    prefix_cache: bool = True        # token-hash prefix cache (paged only)
+    prefix_cache_pages: int = 0      # 0 -> bounded by the pool itself
+    # -- tensor parallelism over PR 12's sharding layer -----------------
+    sharding: Optional[str] = None   # None | "tp"
+    tp: int = 1                      # mesh size for sharding="tp"
+    # -- speculative decoding (serving/spec_decode.py) ------------------
+    verify_window: int = 0           # W>0 compiles the verify executable
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         buckets = tuple(sorted(set(
@@ -105,24 +131,102 @@ class DecodeEngine:
         self.cfg = cfg
         self.ecfg = ecfg
         self.buckets = ecfg.resolved_buckets()
+        self.paged = ecfg.kv_layout == "paged"
+        if ecfg.kv_layout not in ("slab", "paged"):
+            raise ValueError(f"kv_layout {ecfg.kv_layout!r}: "
+                             "expected 'slab' or 'paged'")
+        if self.paged:
+            bad = [b for b in self.buckets if b % ecfg.page_size]
+            if bad:
+                raise ValueError(
+                    f"paged engine: prefill buckets {bad} are not "
+                    f"multiples of page_size {ecfg.page_size}")
         self._donate = jax.default_backend() != "cpu"
         self._ref_params = params                  # f32 truth for parity
-        self.qparams = jax.device_put(
-            quantize_params(params, ecfg.weight_dtype, ecfg.quant_chunk))
+        # -- tensor-parallel mesh + shardings (PR 12 plan machinery) ----
+        self._mesh = None
+        self._param_sh = None
+        self._cache_sh = None
+        self._repl_sh = None
+        if ecfg.sharding not in (None, "tp"):
+            raise ValueError(f"sharding {ecfg.sharding!r}: expected None "
+                             "or 'tp'")
+        qparams = quantize_params(params, ecfg.weight_dtype,
+                                  ecfg.quant_chunk)
+        if ecfg.sharding == "tp":
+            self._init_tp(qparams)
+        self.qparams = jax.device_put(qparams, self._param_sh)
         self.weight_nbytes = quantized_nbytes(self.qparams)
         cache_dtype = ecfg.cache_dtype or cfg.dtype
-        self.cache = KVCache(cfg.num_layers, ecfg.max_batch, ecfg.max_seq,
-                             cfg.num_heads, cfg.head_dim, dtype=cache_dtype)
+        if self.paged:
+            self.cache = PagedKVCache(
+                cfg.num_layers, ecfg.max_batch, ecfg.max_seq,
+                cfg.num_heads, cfg.head_dim, dtype=cache_dtype,
+                page_size=ecfg.page_size, num_pages=ecfg.num_pages)
+            self.prefix = (PrefixCache(self.cache,
+                                       ecfg.prefix_cache_pages)
+                           if ecfg.prefix_cache else None)
+            if self.prefix is not None:
+                self.cache.reclaimer = self.prefix.reclaim
+        else:
+            self.cache = KVCache(cfg.num_layers, ecfg.max_batch,
+                                 ecfg.max_seq, cfg.num_heads, cfg.head_dim,
+                                 dtype=cache_dtype)
+            self.prefix = None
+        if self._cache_sh is not None:
+            self.cache.k = jax.device_put(self.cache.k, self._cache_sh)
+            self.cache.v = jax.device_put(self.cache.v, self._cache_sh)
         self._exec: Dict[str, Any] = {}
         self._sig_history: Dict[str, List[dict]] = {}
         self.compiles = 0
         self.steady_state_recompiles = 0
         self._warm = False
+        # how much slot headroom a generation step needs (the spec-decode
+        # wrapper raises this to its window size)
+        self.min_headroom = 1
+        # draft engines under spec_decode turn this off: their tokens
+        # are proposals, not served output
+        self.meter_tokens = True
         # set when an executable fails AFTER its cache buffers were donated
         # (the slabs are invalidated by donation, so no later call can be
         # trusted) — every serving entrypoint refuses from then on
         self.poisoned: Optional[str] = None
         self._tokens_window: List[Tuple[float, int]] = []  # (t, n) samples
+
+    def _init_tp(self, qparams) -> None:
+        """Mesh + NamedShardings for the tp engine: KV heads and the
+        attention/MLP weight split derive from the PR 12 GPT annotation
+        set through plan-level suffix inheritance."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import build_mesh
+        from ..sharding.plan import (complete_pytree_specs,
+                                     gpt_annotations, named_sharding_tree)
+
+        tp = int(self.ecfg.tp)
+        if tp < 2:
+            raise ValueError("sharding='tp' needs tp >= 2")
+        if self.ecfg.weight_dtype == "int8":
+            raise ValueError(
+                "sharding='tp' cannot head-shard int8's flat chunk "
+                "layout — use weight_dtype 'f32' or 'bf16'")
+        if len(jax.devices()) < tp:
+            raise ValueError(
+                f"tp={tp} needs {tp} devices, have {len(jax.devices())}")
+        if self.cfg.num_heads % tp or self.cfg.d_ff % tp:
+            raise ValueError(
+                f"tp={tp} must divide num_heads {self.cfg.num_heads} and "
+                f"d_ff {self.cfg.d_ff}")
+        self._mesh = build_mesh([("tp", tp)], jax.devices()[:tp])
+        ann = gpt_annotations("tp", tp_axis="tp")
+        specs, self.tp_derived = complete_pytree_specs(
+            qparams, ann, {"tp": tp})
+        self._param_sh = named_sharding_tree(specs, self._mesh)
+        # slab [L, B, S, nh, hd] and pool [L, P, page, nh, hd] both carry
+        # the KV head axis at dim 3 — one spec serves either layout
+        self._cache_sh = NamedSharding(
+            self._mesh, P(None, None, None, "tp", None))
+        self._repl_sh = NamedSharding(self._mesh, P())
 
     # ------------------------------------------------------------------
     # pure functions (traced once per executable)
@@ -130,14 +234,30 @@ class DecodeEngine:
     def _dequant(self, qparams):
         return dequantize_params(qparams)
 
-    def _prefill_fn(self, qparams, ck, cv, tokens, length, slot):
-        """tokens [1, T] int32, length/slot scalars -> (ck, cv, logits[V]).
+    def _block_tail(self, h, a, layer_p, dt, ln, bt: str):
+        """Shared post-attention half of a transformer block: projection,
+        residual, MLP. ``bt`` is the einsum batch prefix ("b" for decode
+        rows, "bt"/"bw" for prefill/verify)."""
+        o = jnp.einsum(f"{bt}nh,nhd->{bt}d", a,
+                       layer_p["w_proj"].astype(dt))
+        h = h + o + layer_p["b_proj"].astype(dt)
+        h2 = ln(h, layer_p["ln2_scale"], layer_p["ln2_bias"])
+        f = jnp.einsum(f"{bt}d,df->{bt}f", h2, layer_p["w_fc"].astype(dt))
+        f = jax.nn.gelu(f + layer_p["b_fc"].astype(dt), approximate=True)
+        o2 = jnp.einsum(f"{bt}f,fd->{bt}d", f, layer_p["w_out"].astype(dt))
+        return h + o2 + layer_p["b_out"].astype(dt)
+
+    def _prefill_fn(self, qparams, ck, cv, tokens, length, slot,
+                    temp, top_k, top_p, seed):
+        """tokens [1, T] int32, length/slot + sampling scalars ->
+        (ck, cv, logits[V], token).
 
         Runs the full causal forward over the padded bucket, writes the
         per-layer K/V for positions [0, T) into the cache at ``slot``
         (padding rows land too, but the length mask keeps decode from ever
-        reading them), and returns the logits of the LAST VALID position —
-        the first generated token comes straight out of prefill."""
+        reading them), and returns the logits of the LAST VALID position
+        plus the token sampled from them — the first generated token comes
+        straight out of prefill."""
         cfg = self.cfg
         params = self._dequant(qparams)
         dt = cfg.dtype
@@ -151,13 +271,7 @@ class DecodeEngine:
             qkv = qkv + layer_p["b_qkv"].astype(dt)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             a = prefill_attention(q, k, v)
-            o = jnp.einsum("btnh,nhd->btd", a, layer_p["w_proj"].astype(dt))
-            h = h + o + layer_p["b_proj"].astype(dt)
-            h2 = ln(h, layer_p["ln2_scale"], layer_p["ln2_bias"])
-            f = jnp.einsum("btd,df->btf", h2, layer_p["w_fc"].astype(dt))
-            f = jax.nn.gelu(f + layer_p["b_fc"].astype(dt), approximate=True)
-            o2 = jnp.einsum("btf,fd->btd", f, layer_p["w_out"].astype(dt))
-            h = h + o2 + layer_p["b_out"].astype(dt)
+            h = self._block_tail(h, a, layer_p, dt, ln, "bt")
             return h, (k, v)
 
         x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
@@ -171,16 +285,72 @@ class DecodeEngine:
         h_last = ln(h_last, params["ln_f_scale"], params["ln_f_bias"])
         logits = jnp.einsum("d,dv->v", h_last,
                             params["lm_head"].astype(dt))
-        return ck, cv, logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        tok = samp.sample_token(logits, temp, top_k, top_p, seed,
+                                length - 1)
+        return ck, cv, logits, tok
 
-    def _decode_fn(self, qparams, ck, cv, tokens, positions):
-        """tokens/positions [max_batch] int32 -> (ck, cv, logits[B, V]).
+    def _prefill_fn_paged(self, qparams, kp, vp, tokens, length,
+                          prefix_len, table_row, temp, top_k, top_p,
+                          seed):
+        """Paged (prefix-cache capable) prefill: tokens [1, T] is the
+        SUFFIX after ``prefix_len`` cached tokens; suffix K/V scatter
+        into the slot's own pages, attention runs over the gathered full
+        view (cached prefix + suffix). prefix_len == 0 is a plain paged
+        prefill."""
+        cfg = self.cfg
+        params = self._dequant(qparams)
+        dt = cfg.dtype
+        ln = gpt_mod._layer_norm
+        ps = self.ecfg.page_size
+        T = tokens.shape[1]
+        n_pages = T // ps
+        positions = prefix_len + jnp.arange(T)
+        x = (params["wte"][tokens]
+             + params["wpe"][positions][None]).astype(dt)    # [1, T, D]
+        suffix_pages = jax.lax.dynamic_slice(
+            table_row, (prefix_len // ps,), (n_pages,))
+
+        def body(h, xs):
+            layer_p, kp_l, vp_l = xs
+            h1 = ln(h, layer_p["ln1_scale"], layer_p["ln1_bias"])
+            qkv = jnp.einsum("btd,dcnh->btcnh", h1,
+                             layer_p["w_qkv"].astype(dt))
+            qkv = qkv + layer_p["b_qkv"].astype(dt)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            nh, hd = k.shape[2], k.shape[3]
+            kp_l = paged_page_write(
+                kp_l, k[0].reshape(n_pages, ps, nh, hd), suffix_pages)
+            vp_l = paged_page_write(
+                vp_l, v[0].reshape(n_pages, ps, nh, hd), suffix_pages)
+            k_all = paged_gather(kp_l, table_row[None])  # [1, S, nh, hd]
+            v_all = paged_gather(vp_l, table_row[None])
+            a = paged_prefill_attention(q, k_all, v_all, prefix_len)
+            h = self._block_tail(h, a, layer_p, dt, ln, "bt")
+            return h, (kp_l, vp_l)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (params["blocks"], kp, vp))
+        h_last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                              keepdims=False)
+        h_last = ln(h_last, params["ln_f_scale"], params["ln_f_bias"])
+        logits = jnp.einsum("d,dv->v", h_last,
+                            params["lm_head"].astype(dt))
+        logits = logits.astype(jnp.float32)
+        tok = samp.sample_token(logits, temp, top_k, top_p, seed,
+                                prefix_len + length - 1)
+        return kp, vp, logits, tok
+
+    def _decode_fn(self, qparams, ck, cv, tokens, positions, actives,
+                   temps, top_ks, top_ps, seeds):
+        """tokens/positions/actives/sampling [max_batch] -> (ck, cv,
+        logits[B, V], tokens[B]).
 
         One token per slot: write this step's K/V at ``positions``, attend
         over each slot's valid prefix (positions+1), emit next-token
-        logits. Inactive lanes ride along with position 0 — their writes
-        land in a dead slot's position 0, which the next prefill into that
-        slot overwrites before it can ever be read."""
+        logits plus the per-slot sampled (or argmax) next token. Lanes
+        with ``actives == 0`` ride along shape-stable but write NOTHING —
+        a live slot excluded from a partial feed (the spec draft's
+        catch-up rounds) keeps every cached row intact."""
         cfg = self.cfg
         params = self._dequant(qparams)
         dt = cfg.dtype
@@ -194,23 +364,141 @@ class DecodeEngine:
                              layer_p["w_qkv"].astype(dt))
             qkv = qkv + layer_p["b_qkv"].astype(dt)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, nh, hd]
-            ck_l = cache_update(ck_l, k, positions)
-            cv_l = cache_update(cv_l, v, positions)
+            ck_l = cache_update(ck_l, k, positions, active=actives)
+            cv_l = cache_update(cv_l, v, positions, active=actives)
             a = decode_attention(q, ck_l, cv_l, positions + 1)
-            o = jnp.einsum("bnh,nhd->bd", a, layer_p["w_proj"].astype(dt))
-            h = h + o + layer_p["b_proj"].astype(dt)
-            h2 = ln(h, layer_p["ln2_scale"], layer_p["ln2_bias"])
-            f = jnp.einsum("bd,df->bf", h2, layer_p["w_fc"].astype(dt))
-            f = jax.nn.gelu(f + layer_p["b_fc"].astype(dt), approximate=True)
-            o2 = jnp.einsum("bf,fd->bd", f, layer_p["w_out"].astype(dt))
-            h = h + o2 + layer_p["b_out"].astype(dt)
+            h = self._block_tail(h, a, layer_p, dt, ln, "b")
             return h, (ck_l, cv_l)
 
         x, (ck, cv) = jax.lax.scan(body, x,
                                    (params["blocks"], ck, cv))
         x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
         logits = jnp.einsum("bd,dv->bv", x, params["lm_head"].astype(dt))
-        return ck, cv, logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        toks = samp.sample_batch(logits, temps, top_ks, top_ps, seeds,
+                                 positions)
+        return ck, cv, logits, toks
+
+    def _decode_fn_paged(self, qparams, kp, vp, tokens, positions,
+                         tables, temps, top_ks, top_ps, seeds):
+        """Paged twin of :meth:`_decode_fn`: per-slot page tables
+        [B, max_pages] route the one-row write (scatter) and the
+        attention read (gather) through the shared pool. Lanes whose
+        table row is all-zero write into the scratch page."""
+        cfg = self.cfg
+        params = self._dequant(qparams)
+        dt = cfg.dtype
+        ln = gpt_mod._layer_norm
+        ps = self.ecfg.page_size
+        x = (params["wte"][tokens] + params["wpe"][positions]).astype(dt)
+        phys = jnp.take_along_axis(
+            tables, (positions // ps)[:, None], axis=1)[:, 0]
+        rows = positions % ps
+
+        def body(h, xs):
+            layer_p, kp_l, vp_l = xs
+            h1 = ln(h, layer_p["ln1_scale"], layer_p["ln1_bias"])
+            qkv = jnp.einsum("bd,dcnh->bcnh", h1,
+                             layer_p["w_qkv"].astype(dt))
+            qkv = qkv + layer_p["b_qkv"].astype(dt)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kp_l = paged_cache_update(kp_l, k, phys, rows)
+            vp_l = paged_cache_update(vp_l, v, phys, rows)
+            k_all = paged_gather(kp_l, tables)          # [B, S, nh, hd]
+            v_all = paged_gather(vp_l, tables)
+            a = decode_attention(q, k_all, v_all, positions + 1)
+            h = self._block_tail(h, a, layer_p, dt, ln, "b")
+            return h, (kp_l, vp_l)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (params["blocks"], kp, vp))
+        x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
+        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"].astype(dt))
+        logits = logits.astype(jnp.float32)
+        toks = samp.sample_batch(logits, temps, top_ks, top_ps, seeds,
+                                 positions)
+        return kp, vp, logits, toks
+
+    def _verify_fn(self, qparams, ck, cv, tokens, starts, actives,
+                   temps, top_ks, top_ps, seeds):
+        """Speculative-verify window: tokens [B, W] written at positions
+        ``starts + w``, causal window attention over the cache, logits
+        AND per-position sampled target tokens for every window slot in
+        one batched call (docs/serving.md "Speculative decoding")."""
+        cfg = self.cfg
+        params = self._dequant(qparams)
+        dt = cfg.dtype
+        ln = gpt_mod._layer_norm
+        W = tokens.shape[1]
+        positions = starts[:, None] + jnp.arange(W)      # [B, W]
+        x = (params["wte"][tokens] + params["wpe"][positions]).astype(dt)
+
+        def body(h, xs):
+            layer_p, ck_l, cv_l = xs
+            h1 = ln(h, layer_p["ln1_scale"], layer_p["ln1_bias"])
+            qkv = jnp.einsum("bwd,dcnh->bwcnh", h1,
+                             layer_p["w_qkv"].astype(dt))
+            qkv = qkv + layer_p["b_qkv"].astype(dt)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            ck_l = window_cache_update(ck_l, k, starts,
+                                       active=actives)
+            cv_l = window_cache_update(cv_l, v, starts,
+                                       active=actives)
+            a = window_attention(q, ck_l, cv_l, starts)
+            h = self._block_tail(h, a, layer_p, dt, ln, "bw")
+            return h, (ck_l, cv_l)
+
+        x, (ck, cv) = jax.lax.scan(body, x, (params["blocks"], ck, cv))
+        x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
+        logits = jnp.einsum("bwd,dv->bwv", x,
+                            params["lm_head"].astype(dt))
+        logits = logits.astype(jnp.float32)
+        toks = samp.sample_window(logits, temps, top_ks, top_ps, seeds,
+                                  positions)
+        return ck, cv, logits, toks
+
+    def _verify_fn_paged(self, qparams, kp, vp, tokens, starts, tables,
+                         temps, top_ks, top_ps, seeds):
+        """Paged verify window: B*W rows scatter through the page tables,
+        attention reads the gathered per-slot views."""
+        cfg = self.cfg
+        params = self._dequant(qparams)
+        dt = cfg.dtype
+        ln = gpt_mod._layer_norm
+        ps = self.ecfg.page_size
+        B, W = tokens.shape
+        positions = starts[:, None] + jnp.arange(W)      # [B, W]
+        x = (params["wte"][tokens] + params["wpe"][positions]).astype(dt)
+        phys = jnp.take_along_axis(tables, positions // ps, axis=1)
+        rows = positions % ps
+
+        def body(h, xs):
+            layer_p, kp_l, vp_l = xs
+            h1 = ln(h, layer_p["ln1_scale"], layer_p["ln1_bias"])
+            qkv = jnp.einsum("bwd,dcnh->bwcnh", h1,
+                             layer_p["w_qkv"].astype(dt))
+            qkv = qkv + layer_p["b_qkv"].astype(dt)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            nh, hd = k.shape[2], k.shape[3]
+            kp_l = paged_cache_update(
+                kp_l, k.reshape(B * W, nh, hd),
+                phys.reshape(-1), rows.reshape(-1))
+            vp_l = paged_cache_update(
+                vp_l, v.reshape(B * W, nh, hd),
+                phys.reshape(-1), rows.reshape(-1))
+            k_all = paged_gather(kp_l, tables)
+            v_all = paged_gather(vp_l, tables)
+            a = window_attention(q, k_all, v_all, starts)
+            h = self._block_tail(h, a, layer_p, dt, ln, "bw")
+            return h, (kp_l, vp_l)
+
+        x, (kp, vp) = jax.lax.scan(body, x, (params["blocks"], kp, vp))
+        x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
+        logits = jnp.einsum("bwd,dv->bwv", x,
+                            params["lm_head"].astype(dt))
+        logits = logits.astype(jnp.float32)
+        toks = samp.sample_window(logits, temps, top_ks, top_ps, seeds,
+                                  positions)
+        return kp, vp, logits, toks
 
     # ------------------------------------------------------------------
     # AOT compilation (PR 4 discipline: explicit lower+compile, program
@@ -222,8 +510,21 @@ class DecodeEngine:
                      str(jnp.result_type(a))) for i, a in enumerate(leaves)]
         return _prep.make_sig(feed_sig, fetch_names=())
 
+    def _shardings_for(self, example_args, n_outputs: int):
+        """(in_shardings, out_shardings) pytrees for the tp mesh: params
+        take the plan shardings, cache slabs the KV-head split, every
+        other input/output replicates. None/None off-mesh."""
+        if self._mesh is None:
+            return None, None
+        ins = [self._param_sh, self._cache_sh, self._cache_sh]
+        ins += [self._repl_sh] * (len(example_args) - 3)
+        outs = [self._cache_sh, self._cache_sh]
+        outs += [self._repl_sh] * (n_outputs - 2)
+        return tuple(ins), tuple(outs)
+
     def _compile(self, name: str, fn, example_args,
-                 donate_argnums: Tuple[int, ...]) -> Any:
+                 donate_argnums: Tuple[int, ...],
+                 n_outputs: int = 4) -> Any:
         from ..parallel import health as _health
 
         sig = self._make_sig(example_args)
@@ -237,8 +538,12 @@ class DecodeEngine:
                 self.steady_state_recompiles += 1
         hist.append(sig)
         del hist[:-8]
-        jitted = jax.jit(
-            fn, donate_argnums=donate_argnums if self._donate else ())
+        in_sh, out_sh = self._shardings_for(example_args, n_outputs)
+        jit_kw: Dict[str, Any] = dict(
+            donate_argnums=donate_argnums if self._donate else ())
+        if in_sh is not None:
+            jit_kw.update(in_shardings=in_sh, out_shardings=out_sh)
+        jitted = jax.jit(fn, **jit_kw)
         t0 = time.perf_counter_ns()
         with _health.suspend():
             lowered = jitted.lower(*example_args)
@@ -254,19 +559,40 @@ class DecodeEngine:
                 "max_seq": self.ecfg.max_seq,
                 "weight_dtype": self.ecfg.weight_dtype,
                 "cache_dtype": str(jnp.dtype(self.cache.dtype).name),
+                "kv_layout": self.ecfg.kv_layout,
+                "sharding": self.ecfg.sharding or "none",
+                "tp": self.ecfg.tp,
                 "buckets": list(self.buckets),
             }})
         return compiled
+
+    def _samp_scalar_examples(self):
+        return (np.float32(0.0), np.int32(0), np.float32(1.0),
+                np.int32(0))
+
+    def _samp_batch_examples(self):
+        B = self.ecfg.max_batch
+        return (np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+                np.ones((B,), np.float32), np.zeros((B,), np.int32))
 
     def _prefill_exec(self, bucket: int):
         name = f"prefill_b{bucket}"
         exe = self._exec.get(name)
         if exe is None:
-            example = (self.qparams, self.cache.k, self.cache.v,
-                       np.zeros((1, bucket), np.int32), np.int32(1),
-                       np.int32(0))
-            exe = self._compile(name, self._prefill_fn, example,
-                                donate_argnums=(1, 2))
+            if self.paged:
+                M = self.cache.max_pages_per_slot
+                example = (self.qparams, self.cache.k, self.cache.v,
+                           np.zeros((1, bucket), np.int32), np.int32(1),
+                           np.int32(0), np.zeros((M,), np.int32),
+                           *self._samp_scalar_examples())
+                exe = self._compile(name, self._prefill_fn_paged, example,
+                                    donate_argnums=(1, 2))
+            else:
+                example = (self.qparams, self.cache.k, self.cache.v,
+                           np.zeros((1, bucket), np.int32), np.int32(1),
+                           np.int32(0), *self._samp_scalar_examples())
+                exe = self._compile(name, self._prefill_fn, example,
+                                    donate_argnums=(1, 2))
             self._exec[name] = exe
         return exe
 
@@ -274,38 +600,105 @@ class DecodeEngine:
         exe = self._exec.get("decode")
         if exe is None:
             B = self.ecfg.max_batch
-            example = (self.qparams, self.cache.k, self.cache.v,
-                       np.zeros((B,), np.int32), np.zeros((B,), np.int32))
-            exe = self._compile("decode", self._decode_fn, example,
-                                donate_argnums=(1, 2))
+            if self.paged:
+                M = self.cache.max_pages_per_slot
+                example = (self.qparams, self.cache.k, self.cache.v,
+                           np.zeros((B,), np.int32),
+                           np.zeros((B,), np.int32),
+                           np.zeros((B, M), np.int32),
+                           *self._samp_batch_examples())
+                exe = self._compile("decode", self._decode_fn_paged,
+                                    example, donate_argnums=(1, 2))
+            else:
+                example = (self.qparams, self.cache.k, self.cache.v,
+                           np.zeros((B,), np.int32),
+                           np.zeros((B,), np.int32),
+                           np.zeros((B,), np.int32),
+                           *self._samp_batch_examples())
+                exe = self._compile("decode", self._decode_fn, example,
+                                    donate_argnums=(1, 2))
             self._exec["decode"] = exe
+        return exe
+
+    def _verify_exec(self):
+        W = self.ecfg.verify_window
+        if W < 2:
+            raise ValueError("verify executable needs verify_window >= 2")
+        name = f"verify_w{W}"
+        exe = self._exec.get(name)
+        if exe is None:
+            B = self.ecfg.max_batch
+            if self.paged:
+                M = self.cache.max_pages_per_slot
+                example = (self.qparams, self.cache.k, self.cache.v,
+                           np.zeros((B, W), np.int32),
+                           np.zeros((B,), np.int32),
+                           np.zeros((B, M), np.int32),
+                           *self._samp_batch_examples())
+                exe = self._compile(name, self._verify_fn_paged, example,
+                                    donate_argnums=(1, 2))
+            else:
+                example = (self.qparams, self.cache.k, self.cache.v,
+                           np.zeros((B, W), np.int32),
+                           np.zeros((B,), np.int32),
+                           np.zeros((B,), np.int32),
+                           *self._samp_batch_examples())
+                exe = self._compile(name, self._verify_fn, example,
+                                    donate_argnums=(1, 2))
+            self._exec[name] = exe
         return exe
 
     def warmup(self) -> Dict[str, float]:
         """Compile every executable the steady state will ever need (the
-        decode program + one prefill per bucket) and run each once so the
-        first real request pays no compile and no first-dispatch cost.
-        Returns {executable_name: compile_ms is implicit in the program
-        reports; here: wall ms per warm call}."""
+        decode program + one prefill per bucket + the verify window when
+        configured) and run each once so the first real request pays no
+        compile and no first-dispatch cost. Returns {executable_name:
+        wall ms per warm call}."""
         timings: Dict[str, float] = {}
-        t0 = time.perf_counter()
-        dec = self._decode_exec()
         B = self.ecfg.max_batch
-        ck, cv, logits = dec(self.qparams, self.cache.k, self.cache.v,
-                             np.zeros((B,), np.int32),
-                             np.zeros((B,), np.int32))
-        jax.block_until_ready(logits)
-        self.cache.k, self.cache.v = ck, cv
-        timings["decode"] = (time.perf_counter() - t0) * 1e3
-        for bucket in self.buckets:
+        zeros_b = np.zeros((B,), np.int32)
+
+        def _warm_call(label, exe, *args):
             t0 = time.perf_counter()
+            out = exe(self.qparams, self.cache.k, self.cache.v, *args)
+            jax.block_until_ready(out[2])
+            self.cache.k, self.cache.v = out[0], out[1]
+            timings[label] = (time.perf_counter() - t0) * 1e3
+
+        dec = self._decode_exec()
+        if self.paged:
+            M = self.cache.max_pages_per_slot
+            _warm_call("decode", dec, zeros_b, zeros_b,
+                       np.zeros((B, M), np.int32),
+                       *self._samp_batch_examples())
+        else:
+            _warm_call("decode", dec, zeros_b, zeros_b, zeros_b,
+                       *self._samp_batch_examples())
+        for bucket in self.buckets:
             exe = self._prefill_exec(bucket)
-            ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
-                                 np.zeros((1, bucket), np.int32),
-                                 np.int32(1), np.int32(0))
-            jax.block_until_ready(logits)
-            self.cache.k, self.cache.v = ck, cv
-            timings[f"prefill_b{bucket}"] = (time.perf_counter() - t0) * 1e3
+            if self.paged:
+                M = self.cache.max_pages_per_slot
+                _warm_call(f"prefill_b{bucket}", exe,
+                           np.zeros((1, bucket), np.int32), np.int32(1),
+                           np.int32(0), np.zeros((M,), np.int32),
+                           *self._samp_scalar_examples())
+            else:
+                _warm_call(f"prefill_b{bucket}", exe,
+                           np.zeros((1, bucket), np.int32), np.int32(1),
+                           np.int32(0), *self._samp_scalar_examples())
+        if self.ecfg.verify_window >= 2:
+            W = self.ecfg.verify_window
+            ver = self._verify_exec()
+            if self.paged:
+                M = self.cache.max_pages_per_slot
+                _warm_call(f"verify_w{W}", ver,
+                           np.zeros((B, W), np.int32), zeros_b,
+                           np.zeros((B, M), np.int32),
+                           *self._samp_batch_examples())
+            else:
+                _warm_call(f"verify_w{W}", ver,
+                           np.zeros((B, W), np.int32), zeros_b, zeros_b,
+                           *self._samp_batch_examples())
         self._warm = True
         return timings
 
@@ -336,15 +729,54 @@ class DecodeEngine:
             f"prompt length {n} exceeds the largest prefill bucket "
             f"{self.buckets[-1]}")
 
+    def can_admit(self, prompt_len: int) -> bool:
+        """Would a prompt admit RIGHT NOW (slot + page budget)? The
+        scheduler's head-of-line check — never raises."""
+        if self.paged:
+            # conservative: require the full prompt's pages (a prefix hit
+            # only makes admission cheaper; reclaimable cache pages count)
+            return self.cache.can_admit(prompt_len)
+        return self.cache.free_slot_count() > 0
+
+    def _trim_prefix(self, n: int, prefix_len: int,
+                     prefix_pages: Tuple[int, ...]):
+        """Shrink a prefix hit until the suffix bucket fits behind it
+        (prefix_len + bucket(suffix) <= max_seq keeps the page-write
+        slice in range)."""
+        while prefix_len > 0:
+            try:
+                bucket = self.bucket_for(n - prefix_len)
+            except PromptTooLongError:
+                bucket = None
+            if bucket is not None and prefix_len + bucket <= self.ecfg.max_seq:
+                return prefix_len, prefix_pages
+            prefix_len -= self.cache.page_size
+            prefix_pages = prefix_pages[:-1]
+        return 0, ()
+
     def start_sequence(self, tokens: Sequence[int]) -> Tuple[int, np.ndarray]:
         """Claim a slot, prefill the prompt, return (slot, logits[V]) of
         the last prompt position — argmax of it is the first generated
-        token. Raises CacheFullError when no slot is free and
+        token. Raises CacheFullError when no slot is free,
+        PagePoolFullError when the paged pool is dry, and
         PromptTooLongError above the ladder."""
+        slot, logits, _tok = self.start_sequence_sampled(tokens, GREEDY)
+        return slot, logits
+
+    def start_sequence_sampled(
+            self, tokens: Sequence[int], params: SamplingParams
+    ) -> Tuple[int, np.ndarray, int]:
+        """:meth:`start_sequence` plus in-executable sampling: returns
+        (slot, last-position logits[V], first generated token)."""
         self._check_poisoned()
         n = len(tokens)
         if n < 1:
             raise ValueError("empty prompt")
+        sp_scalars = (np.float32(params.temperature),
+                      np.int32(params.top_k), np.float32(params.top_p),
+                      np.int32(np.uint32(params.seed)))
+        if self.paged:
+            return self._start_paged(tokens, n, sp_scalars)
         bucket = self.bucket_for(n)
         exe = self._prefill_exec(bucket)
         slot = self.cache.alloc(length=n)
@@ -352,31 +784,100 @@ class DecodeEngine:
         padded[0, :n] = np.asarray(tokens, np.int32)
         t0 = time.perf_counter_ns()
         try:
-            ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
-                                 padded, np.int32(n), np.int32(slot))
+            ck, cv, logits, tok = exe(
+                self.qparams, self.cache.k, self.cache.v, padded,
+                np.int32(n), np.int32(slot), *sp_scalars)
             logits = np.asarray(logits)
+            tok = int(tok)
         except Exception as e:
             self._poison_on_donation_failure(f"prefill_b{bucket}", e)
             self.cache.free(slot)
             raise
         t1 = time.perf_counter_ns()
         smetrics.m_prefill_ms.observe((t1 - t0) / 1e6)
+        smetrics.m_prefill_tokens.inc(n)
         # inherits the scheduler's per-request span context (the admit
         # path wraps this call in the request's trace)
         _spans.record("serve/prefill", t0, t1 - t0,
                       attrs={"bucket": bucket, "prompt_len": n,
                              "slot": slot})
         self.cache.k, self.cache.v = ck, cv
-        return slot, logits
+        return slot, logits, tok
 
-    def decode_step(self, slot_tokens: Dict[int, int]) -> Dict[int, np.ndarray]:
-        """One decode step for the given {slot: input_token} map (the
-        token each sequence generated last). Returns {slot: logits[V]}.
-        Slots not in the map ride as masked lanes — same shapes, same
-        executable, zero recompiles."""
-        if not slot_tokens:
-            return {}
-        self._check_poisoned()
+    def _start_paged(self, tokens, n: int, sp_scalars):
+        prefix_len, prefix_pages = 0, ()
+        if self.prefix is not None:
+            prefix_len, prefix_pages = self.prefix.lookup(tokens)
+            prefix_len, prefix_pages = self._trim_prefix(
+                n, prefix_len, tuple(prefix_pages))
+        suffix = list(tokens[prefix_len:])
+        bucket = self.bucket_for(len(suffix))
+        exe = self._prefill_exec(bucket)
+        slot = self.cache.alloc(length=n, prefix_pages=prefix_pages)
+        table_row = self.cache.table_row(slot)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(suffix)] = np.asarray(suffix, np.int32)
+        t0 = time.perf_counter_ns()
+        try:
+            kp, vp, logits, tok = exe(
+                self.qparams, self.cache.k, self.cache.v, padded,
+                np.int32(len(suffix)), np.int32(prefix_len), table_row,
+                *sp_scalars)
+            logits = np.asarray(logits)
+            tok = int(tok)
+        except Exception as e:
+            self._poison_on_donation_failure(f"prefill_b{bucket}", e)
+            self.cache.free(slot)
+            raise
+        t1 = time.perf_counter_ns()
+        smetrics.m_prefill_ms.observe((t1 - t0) / 1e6)
+        smetrics.m_prefill_tokens.inc(len(suffix))
+        _spans.record("serve/prefill", t0, t1 - t0,
+                      attrs={"bucket": bucket, "prompt_len": n,
+                             "prefix_len": prefix_len, "slot": slot})
+        self.cache.k, self.cache.v = kp, vp
+        if self.prefix is not None:
+            self.prefix.insert(tokens, table_row)
+        return slot, logits, tok
+
+    def resume_sequence_sampled(
+            self, tokens: Sequence[int], params: SamplingParams
+    ) -> Tuple[int, np.ndarray, int]:
+        """Re-prefill a preempted request's prompt+generated stream —
+        which may exceed the bucket ladder: the head prefills through
+        the largest bucket and the tail replays through the decode
+        executable (whose sampled outputs are discarded; the stream is
+        already known). Returns (slot, last logits[V], next token) like
+        :meth:`start_sequence_sampled` — same executables, zero new
+        compiles."""
+        n = len(tokens)
+        if n <= self.buckets[-1]:
+            return self.start_sequence_sampled(tokens, params)
+        head = list(tokens[:self.buckets[-1]])
+        slot, _logits, _tok = self.start_sequence_sampled(head, params)
+        try:
+            for i in range(len(head), n - 1):
+                self.decode_step_sampled({slot: int(tokens[i])}, None)
+            out = self.decode_step_sampled(
+                {slot: int(tokens[n - 1])}, {slot: params})
+        except Exception:
+            if self.poisoned is None and self.cache.is_live(slot):
+                self.cache.free(slot)
+            raise
+        tok, logits = out[slot]
+        return slot, logits, tok
+
+    def ensure_decode_capacity(self, slot: int, extra: int = 1) -> bool:
+        """Make the next ``extra`` token positions of ``slot`` writable.
+        Paged: maps pages on demand (False = pool dry even after
+        prefix-cache reclaim — the scheduler preempts). Slab: always
+        True (the slab IS the capacity; headroom is checked separately)."""
+        if not self.paged:
+            return True
+        return self.cache.ensure_capacity(
+            slot, self.cache.length(slot) + extra)
+
+    def _decode_feed(self, slot_tokens: Dict[int, int]):
         B = self.ecfg.max_batch
         tokens = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -388,29 +889,162 @@ class DecodeEngine:
                     f"slot {slot} is at max_seq {self.ecfg.max_seq}")
             tokens[slot] = tok
             positions[slot] = self.cache.length(slot)
+        return tokens, positions
+
+    def _masked_tables(self, active_slots) -> np.ndarray:
+        """Page-table feed with non-participating lanes zeroed so their
+        writes land in the scratch page — a live slot absent from this
+        call keeps its pages untouched."""
+        tables = self.cache.tables()
+        active = set(active_slots)
+        for s in range(self.ecfg.max_batch):
+            if s not in active:
+                tables[s, :] = 0
+        return tables
+
+    def decode_step(self, slot_tokens: Dict[int, int]) -> Dict[int, np.ndarray]:
+        """One greedy-compatible decode step for the given
+        {slot: input_token} map. Returns {slot: logits[V]} (PR 9 API —
+        callers argmax host-side; :meth:`decode_step_sampled` returns the
+        in-executable sampled tokens too)."""
+        out = self.decode_step_sampled(slot_tokens, None)
+        return {slot: logits for slot, (_tok, logits) in out.items()}
+
+    def decode_step_sampled(
+            self, slot_tokens: Dict[int, int],
+            params_by_slot: Optional[Dict[int, SamplingParams]]
+    ) -> Dict[int, Tuple[int, np.ndarray]]:
+        """One decode step with per-slot sampling: {slot: input_token} ->
+        {slot: (next_token, logits[V])}. Slots not in the map ride as
+        masked lanes — same shapes, same executable, zero recompiles."""
+        if not slot_tokens:
+            return {}
+        self._check_poisoned()
+        tokens, positions = self._decode_feed(slot_tokens)
+        sp = samp.batch_arrays(params_by_slot or {}, self.ecfg.max_batch)
         exe = self._decode_exec()
         t0 = time.perf_counter_ns()
         try:
-            ck, cv, logits = exe(self.qparams, self.cache.k, self.cache.v,
-                                 tokens, positions)
+            if self.paged:
+                for slot in slot_tokens:
+                    if not self.ensure_decode_capacity(slot):
+                        raise PagePoolFullError(
+                            f"slot {slot}: no free page for position "
+                            f"{self.cache.length(slot)}")
+                tables = self._masked_tables(slot_tokens)
+                ck, cv, logits, toks = exe(
+                    self.qparams, self.cache.k, self.cache.v, tokens,
+                    positions, tables, *sp)
+            else:
+                actives = np.zeros((self.ecfg.max_batch,), np.int32)
+                for slot in slot_tokens:
+                    actives[slot] = 1
+                ck, cv, logits, toks = exe(
+                    self.qparams, self.cache.k, self.cache.v, tokens,
+                    positions, actives, *sp)
             logits = np.asarray(logits)
+            toks = np.asarray(toks)
+        except PagePoolFullError:
+            raise                      # host-side: nothing was donated
         except Exception as e:
             self._poison_on_donation_failure("decode", e)
             raise
         smetrics.m_decode_ms.observe((time.perf_counter_ns() - t0) / 1e6)
         self.cache.k, self.cache.v = ck, cv
-        out: Dict[int, np.ndarray] = {}
+        out: Dict[int, Tuple[int, np.ndarray]] = {}
         for slot in slot_tokens:
             self.cache.set_length(slot, self.cache.length(slot) + 1)
-            out[slot] = logits[slot]
+            out[slot] = (int(toks[slot]), logits[slot])
         self.note_tokens(len(slot_tokens))
         return out
+
+    def generate_step(
+            self, slot_tokens: Dict[int, int],
+            params_by_slot: Optional[Dict[int, SamplingParams]] = None
+    ) -> Dict[int, List[int]]:
+        """Uniform scheduler surface: one generation step -> {slot:
+        [emitted tokens]}. The plain engine emits exactly one token per
+        slot; the speculative wrapper (serving/spec_decode.py) emits up
+        to its window."""
+        return {slot: [tok] for slot, (tok, _logits) in
+                self.decode_step_sampled(slot_tokens,
+                                         params_by_slot).items()}
+
+    def verify_step(
+            self, windows: Dict[int, Sequence[int]],
+            params_by_slot: Optional[Dict[int, SamplingParams]] = None
+    ) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+        """Speculative verification: {slot: [W window tokens]} -> {slot:
+        (logits[W, V], target_tokens[W])} in ONE batched call. Window
+        rows are written into the cache at ``length + w``; slot lengths
+        are NOT advanced — the caller decides how many window positions
+        were accepted and calls :meth:`commit_window`."""
+        if not windows:
+            return {}
+        self._check_poisoned()
+        W = self.ecfg.verify_window
+        if W < 2:
+            raise RuntimeError("engine compiled without a verify window")
+        B = self.ecfg.max_batch
+        tokens = np.zeros((B, W), np.int32)
+        starts = np.zeros((B,), np.int32)
+        for slot, win in windows.items():
+            if len(win) != W:
+                raise ValueError(
+                    f"slot {slot}: window {len(win)} != {W}")
+            if not self.cache.is_live(slot):
+                raise ValueError(f"slot {slot} is not live")
+            if self.cache.headroom(slot) < W:
+                raise ValueError(f"slot {slot}: headroom < window {W}")
+            tokens[slot] = np.asarray(win, np.int32)
+            starts[slot] = self.cache.length(slot)
+        sp = samp.batch_arrays(params_by_slot or {}, B)
+        exe = self._verify_exec()
+        t0 = time.perf_counter_ns()
+        try:
+            if self.paged:
+                for slot in windows:
+                    if not self.ensure_decode_capacity(slot, extra=W):
+                        raise PagePoolFullError(
+                            f"slot {slot}: no free pages for a {W}-token "
+                            "verify window")
+                tables = self._masked_tables(windows)
+                ck, cv, logits, toks = exe(
+                    self.qparams, self.cache.k, self.cache.v, tokens,
+                    starts, tables, *sp)
+            else:
+                actives = np.zeros((B,), np.int32)
+                for slot in windows:
+                    actives[slot] = 1
+                ck, cv, logits, toks = exe(
+                    self.qparams, self.cache.k, self.cache.v, tokens,
+                    starts, actives, *sp)
+            logits = np.asarray(logits)
+            toks = np.asarray(toks)
+        except PagePoolFullError:
+            raise
+        except Exception as e:
+            self._poison_on_donation_failure(
+                f"verify_w{W}", e)
+            raise
+        smetrics.m_decode_ms.observe((time.perf_counter_ns() - t0) / 1e6)
+        self.cache.k, self.cache.v = ck, cv
+        return {slot: (logits[slot], toks[slot]) for slot in windows}
+
+    def commit_window(self, slot: int, n_accepted_rows: int) -> None:
+        """Advance ``slot`` past ``n_accepted_rows`` verified window rows
+        (their K/V are already in the cache; rejected rows simply get
+        overwritten by later writes)."""
+        self.cache.set_length(slot, self.cache.length(slot)
+                              + int(n_accepted_rows))
 
     def free_sequence(self, slot: int) -> None:
         self.cache.free(slot)
 
     # ------------------------------------------------------------------
     def note_tokens(self, n: int, window_s: float = 5.0) -> None:
+        if not self.meter_tokens:
+            return
         now = time.monotonic()
         smetrics.m_tokens.inc(n)
         w = self._tokens_window

@@ -13,6 +13,9 @@ __all__ = [
     "m_requests", "m_queue_depth", "m_active", "m_occupancy",
     "m_ttft_ms", "m_tpot_ms", "m_tokens", "m_tokens_per_s",
     "m_prefill_ms", "m_decode_ms", "m_evictions", "m_queue_wait_ms",
+    "m_prefix_cache", "m_prefill_tokens", "m_page_occupancy",
+    "m_page_fragmentation", "m_spec_accepted", "m_spec_proposed",
+    "m_spec_windows", "m_preemptions", "m_hol_admits",
     "request_code",
 ]
 
@@ -60,6 +63,44 @@ m_evictions = _REG.counter(
 m_queue_wait_ms = _REG.histogram(
     "paddle_serve_queue_wait_ms",
     "Admission-queue wait (submit -> prefill start), ms")
+
+
+# prefix cache (serving/paged_kv.py): a hit means the shared prompt
+# prefix attached by refcount instead of prefilling again
+m_prefix_cache = _REG.counter(
+    "paddle_serve_prefix_cache_total",
+    "Prefix-cache lookups by outcome", ("event",))
+# VALID tokens prefilled (bucket padding excluded) — with prefix caching
+# a repeated system prompt's second request only adds its suffix here,
+# which is how metrics_check proves "a shared prefix prefills once"
+m_prefill_tokens = _REG.counter(
+    "paddle_serve_prefill_tokens_total",
+    "Prompt tokens actually prefilled (prefix-cache hits excluded)")
+m_page_occupancy = _REG.gauge(
+    "paddle_serve_page_pool_occupancy",
+    "Allocated KV pages / allocatable pages (scratch page excluded)")
+m_page_fragmentation = _REG.gauge(
+    "paddle_serve_page_pool_fragmentation",
+    "Internal page waste: 1 - used rows / allocated rows")
+# speculative decoding (serving/spec_decode.py): the acceptance histogram
+# IS the speedup meter — mean accepted/window vs the draft+verify cost
+m_spec_accepted = _REG.histogram(
+    "paddle_serve_spec_accepted_tokens",
+    "Draft tokens accepted per verify window")
+m_spec_proposed = _REG.counter(
+    "paddle_serve_spec_proposed_tokens_total",
+    "Draft tokens proposed to the verifier")
+m_spec_windows = _REG.counter(
+    "paddle_serve_spec_windows_total", "Speculative verify windows run")
+# scheduler preemptions (page pool dry mid-generation -> recompute
+# requeue) and head-of-line bypass admissions
+m_preemptions = _REG.counter(
+    "paddle_serve_preemptions_total",
+    "Active requests preempted (recompute-requeued) by reason",
+    ("reason",))
+m_hol_admits = _REG.counter(
+    "paddle_serve_hol_bypass_admits_total",
+    "Requests admitted past a head-of-line prompt that did not fit")
 
 
 def request_code(code: int) -> None:

@@ -1,0 +1,437 @@
+"""Block-granular paged KV cache + token-hash prefix cache (ISSUE 13,
+docs/serving.md).
+
+PR 9's :class:`~paddle_tpu.serving.kv_cache.KVCache` gives every slot a
+private ``[max_seq]`` slab — 8 slots x 1024 positions of HBM even when
+seven of them hold 12-token chats. This module replaces the slab with a
+**page pool**: one preallocated ``[L, num_pages, page_size, nh, hd]``
+K/V pair, fixed-size pages handed out from a host-side free list, and a
+per-slot **page table** (``[max_pages_per_slot]`` int32 of physical page
+ids) that rides into the decode/prefill executables as a plain device
+array — so long-context and short-chat traffic share HBM at page
+granularity and no shape ever changes (the zero-recompile contract is
+untouched).
+
+Layout rules:
+
+- **page 0 is the scratch page** — reserved, never allocated, never
+  read. Unmapped page-table entries point at it, so bucket-padding rows
+  written past a slot's allocation land harmlessly there instead of
+  needing dynamic shapes.
+- A slot's pages are mapped in logical order; positions ``< length`` are
+  always backed by real pages (``ensure_capacity`` maps the next page at
+  the token boundary *before* the decode step that writes into it).
+- **Sharing is append-safe by construction**: shared pages are full,
+  page-aligned prompt-prefix pages; every write a slot ever performs
+  lands at positions ``>= prefix_len``, i.e. in pages it owns alone —
+  no copy-on-write machinery needed.
+
+The **prefix cache** keys page-aligned token prefixes by content hash
+(exact token match verified — hashes only narrow the lookup): after a
+prompt prefill, its full pages are published under every page-boundary
+prefix; a later prompt sharing the prefix attaches those pages by
+refcount and prefills only its suffix through the continuation-prefill
+executable. A shared system prompt therefore prefills ONCE per engine,
+metered by ``paddle_serve_prefix_cache_total{hit|miss}``. Entries are
+LRU; pool pressure reclaims cache-held pages before any allocation
+fails.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import metrics as smetrics
+from .kv_cache import CacheFullError
+
+__all__ = ["PagedKVCache", "PrefixCache", "PagePoolFullError"]
+
+
+class PagePoolFullError(RuntimeError):
+    """No free page available (after prefix-cache reclaim) — the
+    scheduler should defer admission or preempt, not crash."""
+
+
+@dataclasses.dataclass
+class _SlotState:
+    live: bool = False
+    length: int = 0          # valid prefix length (tokens written)
+    prefix_len: int = 0      # leading tokens backed by shared pages
+    mapped: int = 0          # logical pages currently mapped
+    generation: int = 0
+
+
+class PagedKVCache:
+    """Page-pool allocator + the two pooled cache slabs.
+
+    Drop-in for the slab :class:`KVCache` from the engine's point of view
+    (``k``/``v`` device values swapped wholesale per call; ``alloc`` /
+    ``free`` / ``length`` / ``headroom`` / ``lengths_vector`` keep their
+    contracts) plus the paged surface: per-slot page tables, page-budget
+    queries for the scheduler, and refcounts shared with the prefix
+    cache."""
+
+    def __init__(self, num_layers: int, max_slots: int, max_seq: int,
+                 num_heads: int, head_dim: int, dtype: Any = jnp.float32,
+                 page_size: int = 8, num_pages: int = 0):
+        if max_slots < 1 or max_seq < 1:
+            raise ValueError("max_slots and max_seq must be >= 1")
+        if page_size < 1 or max_seq % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq {max_seq}")
+        self.num_layers = int(num_layers)
+        self.max_slots = int(max_slots)
+        self.max_seq = int(max_seq)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        self.page_size = int(page_size)
+        self.max_pages_per_slot = self.max_seq // self.page_size
+        # default pool = slab parity (+1 scratch page): same worst case,
+        # but pages only bind to slots as sequences actually grow
+        self.num_pages = int(num_pages) or (
+            self.max_slots * self.max_pages_per_slot + 1)
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is scratch)")
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self._tables = np.zeros((self.max_slots, self.max_pages_per_slot),
+                                np.int32)           # 0 = scratch/unmapped
+        self._slots = [_SlotState() for _ in range(self.max_slots)]
+        self._free_slots: List[int] = list(range(self.max_slots))
+        self._ref = np.zeros((self.num_pages,), np.int64)
+        self._ref[0] = 1                             # scratch: pinned
+        self._free_pages: List[int] = list(range(1, self.num_pages))
+        self.reclaimer = None    # set by the engine: fn(n_pages) -> freed
+
+    # -- geometry ----------------------------------------------------------
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.size + self.v.size) * jnp.dtype(self.dtype).itemsize
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to back ``n_tokens`` cache rows."""
+        return -(-int(n_tokens) // self.page_size)
+
+    # -- page plumbing -----------------------------------------------------
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
+
+    def _take_pages(self, n: int) -> List[int]:
+        if n > len(self._free_pages) and self.reclaimer is not None:
+            self.reclaimer(n - len(self._free_pages))
+        if n > len(self._free_pages):
+            raise PagePoolFullError(
+                f"need {n} free page(s), have {len(self._free_pages)} "
+                f"of {self.num_pages}")
+        out = [self._free_pages.pop(0) for _ in range(n)]
+        for p in out:
+            assert self._ref[p] == 0, f"free page {p} had refs"
+            self._ref[p] = 1
+        return out
+
+    def ref_pages(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert p != 0 and self._ref[p] > 0, f"ref on dead page {p}"
+            self._ref[p] += 1
+
+    def deref_pages(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p == 0:
+                continue
+            assert self._ref[p] > 0, f"double free of page {p}"
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free_pages.append(p)
+        self._free_pages.sort()
+        self._note_pool_metrics()
+
+    # -- slot bookkeeping --------------------------------------------------
+    def can_admit(self, prompt_len: int, prefix_len: int = 0) -> bool:
+        """Would a prompt of ``prompt_len`` (with ``prefix_len`` tokens
+        already cache-backed) fit right now? Counts reclaimable
+        prefix-cache pages via the reclaimer's dry-run hook when set."""
+        if not self._free_slots:
+            return False
+        need = self.pages_for(prompt_len) - prefix_len // self.page_size
+        avail = len(self._free_pages)
+        if self.reclaimer is not None:
+            avail += getattr(self.reclaimer, "reclaimable", lambda: 0)()
+        return need <= avail
+
+    def alloc(self, length: int = 0,
+              prefix_pages: Sequence[int] = ()) -> int:
+        """Claim a slot; attach ``prefix_pages`` (shared, refcounted) and
+        map fresh pages so every position ``< length`` is backed.
+
+        Raises :class:`CacheFullError` when no slot is free and
+        :class:`PagePoolFullError` when the pool is dry (the slot is NOT
+        claimed in that case)."""
+        if not self._free_slots:
+            raise CacheFullError(
+                f"all {self.max_slots} decode slots are live")
+        if length > self.max_seq:
+            raise ValueError(
+                f"sequence length {length} exceeds max_seq {self.max_seq}")
+        n_prefix = len(prefix_pages)
+        if n_prefix * self.page_size > length:
+            raise ValueError("prefix pages cover more than the sequence")
+        n_own = self.pages_for(length) - n_prefix
+        # pin the shared prefix FIRST: _take_pages may trigger the
+        # prefix-cache reclaimer, which must not be able to free (and
+        # recycle) the very pages this slot is about to attach
+        self.ref_pages(prefix_pages)
+        try:
+            own = self._take_pages(n_own)    # may raise PagePoolFullError
+        except PagePoolFullError:
+            self.deref_pages(prefix_pages)
+            raise
+        slot = self._free_slots.pop(0)
+        st = self._slots[slot]
+        st.live = True
+        st.length = int(length)
+        st.prefix_len = n_prefix * self.page_size
+        st.mapped = n_prefix + n_own
+        st.generation += 1
+        row = self._tables[slot]
+        row[:] = 0
+        row[:n_prefix] = prefix_pages
+        row[n_prefix:st.mapped] = own
+        self._note_pool_metrics()
+        return slot
+
+    def ensure_capacity(self, slot: int, upto_len: int) -> bool:
+        """Map pages so positions ``< upto_len`` are write-backed.
+        Returns False (mapping nothing) when the pool cannot cover it —
+        the scheduler's cue to preempt."""
+        st = self._slots[slot]
+        if not st.live:
+            raise ValueError(f"slot {slot} is not live")
+        if upto_len > self.max_seq:
+            return False
+        need = self.pages_for(upto_len) - st.mapped
+        if need <= 0:
+            return True
+        try:
+            pages = self._take_pages(need)
+        except PagePoolFullError:
+            return False
+        self._tables[slot][st.mapped:st.mapped + need] = pages
+        st.mapped += need
+        self._note_pool_metrics()
+        return True
+
+    def free(self, slot: int) -> None:
+        st = self._slots[slot]
+        if not st.live:
+            raise ValueError(f"slot {slot} is not live")
+        row = self._tables[slot]
+        self.deref_pages([int(p) for p in row[:st.mapped]])
+        row[:] = 0
+        st.live = False
+        st.length = 0
+        st.prefix_len = 0
+        st.mapped = 0
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+
+    def set_length(self, slot: int, length: int) -> None:
+        st = self._slots[slot]
+        if length > self.max_seq:
+            raise ValueError(
+                f"slot {slot}: length {length} exceeds max_seq "
+                f"{self.max_seq}")
+        if self.pages_for(length) > st.mapped:
+            raise ValueError(
+                f"slot {slot}: length {length} beyond mapped pages "
+                f"({st.mapped} x {self.page_size})")
+        st.length = int(length)
+
+    def length(self, slot: int) -> int:
+        return self._slots[slot].length
+
+    def prefix_len(self, slot: int) -> int:
+        return self._slots[slot].prefix_len
+
+    def generation(self, slot: int) -> int:
+        return self._slots[slot].generation
+
+    def is_live(self, slot: int) -> bool:
+        return self._slots[slot].live
+
+    def live_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s.live]
+
+    def free_slot_count(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def occupancy(self) -> float:
+        return (self.max_slots - len(self._free_slots)) / self.max_slots
+
+    def lengths_vector(self) -> np.ndarray:
+        return np.array([s.length if s.live else 0 for s in self._slots],
+                        np.int32)
+
+    def headroom(self, slot: int) -> int:
+        return self.max_seq - self._slots[slot].length
+
+    # -- executable feeds --------------------------------------------------
+    def table_row(self, slot: int) -> np.ndarray:
+        """[max_pages_per_slot] int32 page table for one slot (copy)."""
+        return self._tables[slot].copy()
+
+    def tables(self) -> np.ndarray:
+        """[max_slots, max_pages_per_slot] int32 — the decode feed."""
+        return self._tables.copy()
+
+    # -- pool metrics ------------------------------------------------------
+    def pool_occupancy(self) -> float:
+        """Allocated pages / allocatable pages (scratch excluded)."""
+        total = self.num_pages - 1
+        return (total - len(self._free_pages)) / total
+
+    def fragmentation(self) -> float:
+        """Internal waste: 1 - used_rows / allocated_rows (0 when every
+        allocated page is full of valid tokens; pages are fixed-size so
+        there is no external fragmentation)."""
+        mapped = sum(s.mapped for s in self._slots if s.live)
+        cache_held = int(np.sum(self._ref[1:] > 0)) - sum(
+            s.mapped for s in self._slots if s.live)
+        # cache-held shared pages are full by construction; count them in
+        allocated_rows = (mapped + max(cache_held, 0)) * self.page_size
+        used_rows = sum(s.length for s in self._slots if s.live) + \
+            max(cache_held, 0) * self.page_size
+        if allocated_rows <= 0:
+            return 0.0
+        return 1.0 - used_rows / allocated_rows
+
+    def _note_pool_metrics(self) -> None:
+        smetrics.m_page_occupancy.set(self.pool_occupancy())
+        smetrics.m_page_fragmentation.set(self.fragmentation())
+
+
+class PrefixCache:
+    """Token-hash keyed, refcounted, LRU prefix cache over a page pool.
+
+    Entries are page-aligned prompt prefixes; the cache holds ONE ref on
+    every page of every entry (slots using the pages hold their own).
+    ``capacity_pages`` bounds distinct cache-held pages; LRU entries are
+    dropped on overflow and under pool pressure (:meth:`reclaim` — wired
+    as the pool's ``reclaimer`` by the engine)."""
+
+    def __init__(self, pool: PagedKVCache, capacity_pages: int = 0):
+        self.pool = pool
+        self.capacity_pages = int(capacity_pages) or pool.num_pages
+        # insertion/use-ordered: key -> (tokens tuple, pages tuple)
+        self._entries: "OrderedDict[bytes, Tuple[Tuple[int, ...], Tuple[int, ...]]]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(tokens: Sequence[int]) -> bytes:
+        return hashlib.sha1(
+            np.asarray(tokens, np.int64).tobytes()).digest()
+
+    def _held_pages(self) -> set:
+        held = set()
+        for _, pages in self._entries.values():
+            held.update(pages)
+        return held
+
+    def held_page_count(self) -> int:
+        return len(self._held_pages())
+
+    def reclaimable(self) -> int:
+        """Pages that a full reclaim could hand back to the pool (those
+        only the cache still holds)."""
+        n = 0
+        for p in self._held_pages():
+            if self.pool._ref[p] == 1:
+                n += 1
+        return n
+
+    def lookup(self, tokens: Sequence[int]
+               ) -> Tuple[int, Tuple[int, ...]]:
+        """Longest cached page-aligned prefix of ``tokens`` that still
+        leaves at least one suffix token to prefill. Returns
+        ``(prefix_len, pages)`` — (0, ()) on miss. Counts the
+        hit/miss metric and freshens LRU order on hit."""
+        ps = self.pool.page_size
+        max_j = (len(tokens) - 1) // ps
+        for j in range(max_j, 0, -1):
+            prefix = tuple(int(t) for t in tokens[:j * ps])
+            key = self._key(prefix)
+            ent = self._entries.get(key)
+            if ent is not None and ent[0] == prefix:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                smetrics.m_prefix_cache.labels("hit").inc()
+                return j * ps, ent[1]
+        self.misses += 1
+        smetrics.m_prefix_cache.labels("miss").inc()
+        return 0, ()
+
+    def insert(self, tokens: Sequence[int], table_row: np.ndarray) -> int:
+        """Publish every page-boundary prefix of ``tokens`` whose pages
+        are in ``table_row`` (the slot's mapping after prefill). Returns
+        how many NEW entries were added. New pages get one cache ref."""
+        ps = self.pool.page_size
+        full = len(tokens) // ps
+        added = 0
+        newly_held = []
+        held = self._held_pages()
+        for j in range(1, full + 1):
+            prefix = tuple(int(t) for t in tokens[:j * ps])
+            key = self._key(prefix)
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            pages = tuple(int(p) for p in table_row[:j])
+            if any(p == 0 for p in pages):
+                break                      # unmapped — nothing cacheable
+            self._entries[key] = (prefix, pages)
+            added += 1
+            for p in pages:
+                if p not in held:
+                    held.add(p)
+                    newly_held.append(p)
+        if newly_held:
+            self.pool.ref_pages(newly_held)
+        self._evict_over_capacity()
+        return added
+
+    def _drop_entry(self, key: bytes) -> None:
+        _tokens, pages = self._entries.pop(key)
+        still_held = self._held_pages()
+        self.pool.deref_pages([p for p in pages if p not in still_held])
+
+    def _evict_over_capacity(self) -> None:
+        while (self._entries
+               and self.held_page_count() > self.capacity_pages):
+            self._drop_entry(next(iter(self._entries)))
+
+    def reclaim(self, n_pages: int) -> int:
+        """Pool-pressure hook: drop LRU entries until ``n_pages`` pages
+        returned to the free list (or the cache is empty). Returns pages
+        actually freed."""
+        freed0 = self.pool.free_page_count()
+        while (self._entries
+               and self.pool.free_page_count() - freed0 < n_pages):
+            self._drop_entry(next(iter(self._entries)))
+        return self.pool.free_page_count() - freed0
+
+    def clear(self) -> None:
+        while self._entries:
+            self._drop_entry(next(iter(self._entries)))
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -1,0 +1,173 @@
+"""In-executable token sampling for the serving engine (docs/serving.md).
+
+Temperature / top-k / top-p live INSIDE the compiled decode (and prefill
+and verify) functions: per-slot parameters arrive as plain ``[max_batch]``
+batch inputs and per-slot PRNG keys derive from a per-request integer
+seed folded with the token position — so a request changing its sampling
+knobs, or two requests with different knobs sharing a decode batch, never
+changes a shape and never triggers a recompile (the zero-recompile
+contract extends to sampling by construction).
+
+Semantics per slot:
+
+- ``temperature <= 0`` — greedy argmax, bit-identical to the pre-sampling
+  engine (the parity bars and the slab/paged token-match tests key off
+  this lane);
+- ``temperature > 0`` — logits are divided by the temperature, then
+  masked by top-k (keep the k highest-logit tokens; ``k <= 0`` disables)
+  and nucleus top-p (keep the smallest set of tokens whose probability
+  mass reaches ``p``; ``p >= 1`` disables), then sampled with
+  ``jax.random.categorical`` under a key
+  ``fold_in(PRNGKey(seed), position)`` — deterministic per
+  (seed, position), independent across slots and steps.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingParams", "GREEDY", "sample_token", "sample_batch",
+           "sample_window", "batch_arrays", "adjusted_probs_np"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (host-side truth; becomes batch inputs).
+
+    ``temperature == 0`` is greedy decode — the default, and exactly the
+    engine's historical behavior."""
+    temperature: float = 0.0
+    top_k: int = 0            # 0 disables
+    top_p: float = 1.0        # 1.0 disables
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature {self.temperature} < 0")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p {self.top_p} outside (0, 1]")
+        if self.top_k < 0:
+            raise ValueError(f"top_k {self.top_k} < 0")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+GREEDY = SamplingParams()
+
+
+def _masked_logits(logits, temp, top_k, top_p):
+    """[V] f32 logits -> temperature-scaled, top-k/top-p-masked logits.
+
+    ONE descending sort serves both filters: the top-k threshold reads
+    straight off it, and the nucleus threshold converts to logit space
+    through the (monotone) softmax of the k-masked sorted row — keeping
+    the executable's compile cost down (this runs inside every decode/
+    prefill/verify program)."""
+    V = logits.shape[-1]
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    desc = jnp.sort(scaled)[::-1]
+    # top-k: threshold at the k-th largest logit (k<=0 or k>=V disables)
+    kk = jnp.where(top_k <= 0, V, jnp.minimum(top_k, V))
+    k_thresh = desc[jnp.maximum(kk - 1, 0)]
+    # top-p over the k-masked distribution, in sorted space: keep the
+    # smallest descending-probability set whose cumulative mass reaches p
+    in_k = jnp.arange(V) < kk
+    e = jnp.where(in_k, jnp.exp(desc - desc[0]), 0.0)
+    p_desc = e / jnp.sum(e)
+    cum = jnp.cumsum(p_desc)
+    idx = jnp.argmax(cum >= jnp.minimum(top_p, cum[-1]))
+    thresh = jnp.where(top_p >= 1.0, k_thresh,
+                       jnp.maximum(k_thresh, desc[idx]))
+    return jnp.where(scaled >= thresh, scaled, -jnp.inf)
+
+
+def sample_token(logits, temp, top_k, top_p, seed, position):
+    """One token from one [V] logits row (jit-traceable; scalars traced).
+
+    Greedy lane (temp <= 0) short-circuits to argmax — no PRNG consumed,
+    bitwise what the host-side ``np.argmax`` used to produce. The PRNG
+    key is the raw pair ``(position, seed)`` — deterministic per
+    (seed, position), independent across slots and steps, one threefry
+    application per draw (a fold_in chain would compile two more)."""
+    logits = logits.astype(jnp.float32)
+    greedy_tok = jnp.argmax(logits).astype(jnp.int32)
+    key = jnp.stack([position.astype(jnp.uint32),
+                     seed.astype(jnp.uint32)])
+    sampled = jax.random.categorical(
+        key, _masked_logits(logits, temp, top_k, top_p)).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy_tok, sampled)
+
+
+def sample_batch(logits, temps, top_ks, top_ps, seeds, positions):
+    """[B, V] logits + [B] per-slot params -> [B] int32 tokens."""
+    return jax.vmap(sample_token)(logits, temps, top_ks, top_ps, seeds,
+                                  positions)
+
+
+def sample_window(logits, temps, top_ks, top_ps, seeds, positions):
+    """[B, W, V] logits + [B] params + [B, W] positions -> [B, W] tokens
+    (the speculative-verify window: every window position gets its own
+    position-folded key off the slot's seed)."""
+
+    def per_slot(lg, t, k, p, s, pos):
+        return jax.vmap(
+            lambda l, q: sample_token(l, t, k, p, s, q))(lg, pos)
+
+    return jax.vmap(per_slot)(logits, temps, top_ks, top_ps, seeds,
+                              positions)
+
+
+def adjusted_probs_np(logits: np.ndarray, sp: SamplingParams
+                      ) -> np.ndarray:
+    """Numpy twin of the in-executable temperature/top-k/top-p masking:
+    the normalized distribution a slot actually samples from. Used by
+    the speculative-decoding rejection sampler (serving/spec_decode.py),
+    where target-vs-draft acceptance must be computed against EXACTLY
+    the adjusted distributions the executables sample.
+
+    Greedy (temperature <= 0) returns the argmax one-hot."""
+    logits = np.asarray(logits, np.float64).reshape(-1)
+    V = logits.shape[0]
+    if sp.greedy:
+        out = np.zeros((V,), np.float64)
+        out[int(np.argmax(logits))] = 1.0
+        return out
+    scaled = logits / max(sp.temperature, 1e-6)
+    kk = V if sp.top_k <= 0 else min(sp.top_k, V)
+    desc = np.sort(scaled)[::-1]
+    masked = np.where(scaled >= desc[kk - 1], scaled, -np.inf)
+    m = masked.max()
+    probs = np.exp(masked - m)
+    probs /= probs.sum()
+    if sp.top_p < 1.0:
+        p_desc = np.sort(probs)[::-1]
+        cum = np.cumsum(p_desc)
+        idx = int(np.argmax(cum >= min(sp.top_p, cum[-1])))
+        probs = np.where(probs >= p_desc[idx], probs, 0.0)
+        probs /= probs.sum()
+    return probs
+
+
+def batch_arrays(params_by_slot: Dict[int, SamplingParams],
+                 max_batch: int) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray, np.ndarray]:
+    """Host helper: {slot: SamplingParams} -> the four [max_batch] feed
+    vectors (temps f32, top_ks i32, top_ps f32, seeds i32). Slots absent
+    from the map ride greedy."""
+    temps = np.zeros((max_batch,), np.float32)
+    top_ks = np.zeros((max_batch,), np.int32)
+    top_ps = np.ones((max_batch,), np.float32)
+    seeds = np.zeros((max_batch,), np.int32)
+    for slot, sp in params_by_slot.items():
+        temps[slot] = sp.temperature
+        top_ks[slot] = sp.top_k
+        top_ps[slot] = sp.top_p
+        seeds[slot] = np.int32(np.uint32(sp.seed))
+    return temps, top_ks, top_ps, seeds

@@ -4,10 +4,25 @@ Requests join and leave the static ``[max_batch]`` decode batch at TOKEN
 boundaries: each :meth:`Scheduler.step` (one tick of the serving loop)
 first evicts finished/expired slots, then admits queued requests into the
 freed slots (prefill through the bucket ladder), then runs exactly one
-decode step for every live slot. No shape ever changes, so a warmed
-engine ticks forever without a recompile — Orca-style iteration-level
-scheduling (the same contract vLLM's continuous batching popularized),
-implemented host-side against the AOT executables.
+generation step for every live slot — one token per slot on the plain
+engine, up to ``k+1`` on the speculative wrapper. No shape ever changes,
+so a warmed engine ticks forever without a recompile — Orca-style
+iteration-level scheduling (the same contract vLLM's continuous batching
+popularized), implemented host-side against the AOT executables.
+
+Admission is FIFO with a bounded head-of-line bypass: when the head's
+prompt does not fit the current slot/page budget (paged engines meter
+pages, not slots), the scheduler admits the NEXT fitting request instead
+of stalling the queue — but a head that has been bypassed
+``hol_starvation_limit`` times pins the queue until it fits, so a big
+prompt is delayed, never starved.
+
+Paged engines can run the pool dry mid-generation (a slot crossing a
+page boundary with no free page): the scheduler preempts the YOUNGEST
+active request — frees its pages, requeues it at the queue head with its
+generated tokens folded into the prompt (recompute-style resume; with
+the prefix cache warm, the recompute is usually a suffix prefill) — and
+retries. ``paddle_serve_preemptions_total{reason}`` meters it.
 
 Threading contract: ``submit``/``cancel`` may be called from any thread
 (the HTTP front door's handler pool); ``step``/``drain`` run on exactly
@@ -30,6 +45,8 @@ from ..observability import spans as _spans
 from . import metrics as smetrics
 from .engine import DecodeEngine, PromptTooLongError
 from .kv_cache import CacheFullError
+from .paged_kv import PagePoolFullError
+from .sampling import GREEDY, SamplingParams
 
 __all__ = ["Request", "Scheduler", "SchedulerConfig", "QueueFullError"]
 
@@ -50,6 +67,7 @@ class Request:
     prompt: List[int]
     max_new_tokens: int
     deadline: float                       # absolute time.monotonic()
+    sampling: SamplingParams = GREEDY
     id: int = dataclasses.field(default_factory=lambda: next(_ids))
     submitted: float = dataclasses.field(default_factory=time.monotonic)
     state: str = QUEUED
@@ -58,6 +76,12 @@ class Request:
     token_times: List[float] = dataclasses.field(default_factory=list)
     ttft_ms: Optional[float] = None
     error: Optional[str] = None
+    # head-of-line bookkeeping: how many times a fitting request was
+    # admitted past this one while it sat at the queue head
+    hol_skips: int = 0
+    # preemption (page pool dry): the request resumes by re-prefilling
+    # prompt + generated-so-far — True marks it so admission knows
+    preempted: bool = False
     finished: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     # span identity (docs/observability.md): every lifecycle span of this
@@ -71,6 +95,11 @@ class Request:
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.finished.wait(timeout)
+
+    def gen_prompt(self) -> List[int]:
+        """The token stream a (re-)prefill must cover: the original
+        prompt plus everything generated before a preemption."""
+        return self.prompt + self.tokens
 
     @property
     def tpot_ms(self) -> Optional[float]:
@@ -86,6 +115,9 @@ class SchedulerConfig:
     max_queue: int = 64               # queued (not yet admitted) requests
     default_timeout_s: float = 30.0   # per-request deadline when unset
     max_new_tokens_cap: int = 1024    # server-side clamp
+    # how many times the FIFO head may be bypassed by later, fitting
+    # requests before it pins the queue (the starvation bound)
+    hol_starvation_limit: int = 32
 
 
 class Scheduler:
@@ -96,16 +128,19 @@ class Scheduler:
         self._queue: Deque[Request] = deque()
         self._active: Dict[int, Request] = {}     # slot -> request
         self._next_token: Dict[int, int] = {}     # slot -> token to feed
+        self._admit_order: List[int] = []         # slots, oldest first
         self._lock = threading.Lock()
         self._draining = False
         self.steps = 0
         self.occupancy_sum = 0.0                  # for mean occupancy
+        self.preemptions = 0
 
     # ------------------------------------------------------------------
     # producer side (any thread)
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               timeout_s: Optional[float] = None) -> Request:
+               timeout_s: Optional[float] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
         """Enqueue a request; raises QueueFullError on backpressure,
         PromptTooLongError for prompts above the bucket ladder, and
         RuntimeError once draining."""
@@ -120,7 +155,8 @@ class Scheduler:
         timeout = (self.cfg.default_timeout_s if timeout_s is None
                    else float(timeout_s))
         req = Request(prompt=prompt, max_new_tokens=max_new,
-                      deadline=time.monotonic() + timeout)
+                      deadline=time.monotonic() + timeout,
+                      sampling=sampling or GREEDY)
         with self._lock:
             if self._draining:
                 raise RuntimeError("scheduler is draining")
@@ -225,23 +261,52 @@ class Scheduler:
             self._queue = keep
             smetrics.m_queue_depth.set(len(self._queue))
 
+    def _pop_admissible(self) -> Optional[Request]:
+        """FIFO pop with bounded head-of-line bypass: the first request
+        whose prompt fits the current slot/page budget. A head bypassed
+        past the starvation limit pins the queue until it fits."""
+        with self._lock:
+            if not self._queue:
+                return None
+            head = self._queue[0]
+            for i, req in enumerate(self._queue):
+                if i > 0 and head.hol_skips >= self.cfg.hol_starvation_limit:
+                    return None       # head pinned: wait for its budget
+                if self.engine.can_admit(len(req.gen_prompt())):
+                    del self._queue[i]
+                    smetrics.m_queue_depth.set(len(self._queue))
+                    if i > 0:
+                        head.hol_skips += 1
+                        smetrics.m_hol_admits.inc()
+                    return req
+            return None
+
     def _admit(self, now: float) -> int:
-        """Prefill queued requests into free slots, FIFO."""
+        """Prefill queued requests into free slots — FIFO with the
+        head-of-line bypass above."""
         admitted = 0
         while self.engine.cache.free_slot_count() > 0:
-            with self._lock:
-                if not self._queue:
-                    break
-                req = self._queue.popleft()
-                smetrics.m_queue_depth.set(len(self._queue))
+            req = self._pop_admissible()
+            if req is None:
+                break
             t_admit = time.perf_counter_ns()
             try:
                 # prefill runs inside the request's span context so the
                 # engine's serve/prefill span parents under its root
                 with _spans.default_tracer().context(
                         (req.trace_id, req.root_span)):
-                    slot, logits = self.engine.start_sequence(req.prompt)
-            except CacheFullError:       # raced headroom — requeue in order
+                    if req.preempted:
+                        # recompute resume: may exceed the ladder — the
+                        # engine chunk-replays the known stream
+                        slot, logits, first = \
+                            self.engine.resume_sequence_sampled(
+                                req.gen_prompt(), req.sampling)
+                    else:
+                        slot, logits, first = \
+                            self.engine.start_sequence_sampled(
+                                req.gen_prompt(), req.sampling)
+            except (CacheFullError, PagePoolFullError):
+                # raced headroom / pool pressure — requeue in order
                 with self._lock:
                     self._queue.appendleft(req)
                 break
@@ -254,27 +319,75 @@ class Scheduler:
             _spans.record("serve/queue_wait", req.submit_ns,
                           t_admit - req.submit_ns,
                           trace=req.trace_id, parent=req.root_span)
-            first = int(np.argmax(logits))
             t = time.monotonic()
             req.state = ACTIVE
             req.slot = slot
-            req.tokens.append(first)
-            req.token_times.append(t)
-            req.ttft_ms = (t - req.submitted) * 1e3
-            smetrics.m_ttft_ms.observe(req.ttft_ms)
-            self.engine.note_tokens(1)
+            resumed = req.preempted
+            req.preempted = False
+            if not resumed:
+                req.tokens.append(int(first))
+                req.token_times.append(t)
+                req.ttft_ms = (t - req.submitted) * 1e3
+                smetrics.m_ttft_ms.observe(req.ttft_ms)
+                self.engine.note_tokens(1)
+                last = int(first)
+            else:
+                # resumed prefill covered prompt+generated; the sampled
+                # continuation token is the next output token
+                req.tokens.append(int(first))
+                req.token_times.append(t)
+                last = int(first)
             self._active[slot] = req
-            self._next_token[slot] = first
+            self._next_token[slot] = last
+            self._admit_order.append(slot)
             admitted += 1
-            if self._should_finish(req, first):
+            if self._should_finish(req, last):
                 self._evict(slot, DONE)
-            elif self.engine.cache.headroom(slot) < 1:
-                # prompt filled the slot to max_seq: the prefill logits
-                # already produced the one token that fits, and the next
-                # decode_step would raise — finish here instead
+            elif self.engine.cache.headroom(slot) < getattr(
+                    self.engine, "min_headroom", 1):
+                # prompt filled the slot to (near) max_seq: the prefill
+                # logits already produced the one token that fits, and
+                # the next generation step could not run — finish here
                 self._evict(slot, DONE, "max_seq reached",
                             reason="max_seq")
         return admitted
+
+    def _preempt_youngest(self, exclude_slot: Optional[int] = None) -> bool:
+        """Free the most recently admitted active request's pages and
+        requeue it at the queue head for recompute-resume. Returns False
+        when there is nothing (else) to preempt."""
+        for slot in reversed(self._admit_order):
+            if slot == exclude_slot or slot not in self._active:
+                continue
+            req = self._active.pop(slot)
+            self._next_token.pop(slot, None)
+            self._admit_order.remove(slot)
+            self.engine.free_sequence(slot)
+            req.state = QUEUED
+            req.slot = None
+            req.preempted = True
+            smetrics.m_preemptions.labels("page_pool").inc()
+            self.preemptions += 1
+            with self._lock:
+                self._queue.appendleft(req)
+                smetrics.m_queue_depth.set(len(self._queue))
+            return True
+        return False
+
+    def _ensure_step_capacity(self) -> None:
+        """Paged engines: map the pages this tick will write BEFORE the
+        batched call; preempt the youngest request(s) while the pool
+        cannot cover a slot."""
+        for slot in sorted(self._active, key=self._admit_order.index):
+            if slot not in self._active:      # preempted by an earlier
+                continue                      # iteration's pool squeeze
+            while not self.engine.ensure_decode_capacity(slot):
+                if not self._preempt_youngest(exclude_slot=slot):
+                    # nothing left to preempt: this request alone
+                    # exceeds the pool — fail it rather than livelock
+                    self._evict(slot, FAILED,
+                                "KV page pool exhausted", reason="failed")
+                    break
 
     def _decode(self, now: float) -> bool:
         # evict deadline-blown active requests at the token boundary
@@ -285,32 +398,46 @@ class Scheduler:
                             "deadline exceeded mid-generation")
         if not self._active:
             return False
+        self._ensure_step_capacity()
+        if not self._active:
+            return False
         feed = {slot: self._next_token[slot] for slot in self._active}
+        params = {slot: self._active[slot].sampling
+                  for slot in self._active}
         t_tick0 = time.perf_counter_ns()
-        out = self.engine.decode_step(feed)
+        out = self.engine.generate_step(feed, params)
         tick_ns = time.perf_counter_ns() - t_tick0
         t = time.monotonic()
         trace_on = _spans.tracing_enabled()
-        for slot, logits in out.items():
-            req = self._active[slot]
+        for slot, emitted in out.items():
+            req = self._active.get(slot)
+            if req is None:
+                continue
             if trace_on:
                 # per-tick decode span on the request's trace: the whole
                 # batch shares one executable call, so every rider gets
-                # the tick's wall time (batch size in the attrs)
+                # the tick's wall time (batch size + emitted count in
+                # the attrs — speculative ticks emit several)
                 _spans.record("serve/decode_tick", t_tick0, tick_ns,
                               trace=req.trace_id, parent=req.root_span,
                               attrs={"batch": len(out),
+                                     "emitted": len(emitted),
                                      "token_index": len(req.tokens)})
-            tok = int(np.argmax(logits))
-            req.tokens.append(tok)
-            if len(req.token_times) >= 1:
-                smetrics.m_tpot_ms.observe(
-                    (t - req.token_times[-1]) * 1e3)
-            req.token_times.append(t)
-            self._next_token[slot] = tok
-            if self._should_finish(req, tok):
-                self._evict(slot, DONE)
-            elif self.engine.cache.headroom(slot) < 1:
+            finished = False
+            for tok in emitted:
+                tok = int(tok)
+                req.tokens.append(tok)
+                if req.token_times:
+                    smetrics.m_tpot_ms.observe(
+                        (t - req.token_times[-1]) * 1e3)
+                req.token_times.append(t)
+                self._next_token[slot] = tok
+                if self._should_finish(req, tok):
+                    self._evict(slot, DONE)
+                    finished = True
+                    break
+            if not finished and self.engine.cache.headroom(slot) < getattr(
+                    self.engine, "min_headroom", 1):
                 self._evict(slot, DONE, "max_seq reached",
                             reason="max_seq")
         return True
@@ -328,6 +455,8 @@ class Scheduler:
                reason: Optional[str] = None) -> None:
         req = self._active.pop(slot)
         self._next_token.pop(slot, None)
+        if slot in self._admit_order:
+            self._admit_order.remove(slot)
         t0 = time.perf_counter_ns()
         self.engine.free_sequence(slot)
         reason = reason or self._EVICT_REASONS.get(state, state)

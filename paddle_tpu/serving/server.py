@@ -179,10 +179,20 @@ class _Handler(BaseHTTPRequestHandler):
         timeout_s = (front.request_timeout_s if timeout_s is None
                      else float(timeout_s))
         try:
+            sampling = None
+            if any(k in req_obj for k in ("temperature", "top_k", "top_p",
+                                          "seed")):
+                from .sampling import SamplingParams
+
+                sampling = SamplingParams(
+                    temperature=float(req_obj.get("temperature", 0.0)),
+                    top_k=int(req_obj.get("top_k", 0)),
+                    top_p=float(req_obj.get("top_p", 1.0)),
+                    seed=int(req_obj.get("seed", 0)))
             request = front.scheduler.submit(
                 prompt, max_new_tokens=int(req_obj.get(
                     "max_new_tokens", 16)),
-                timeout_s=timeout_s)
+                timeout_s=timeout_s, sampling=sampling)
         except QueueFullError as e:
             return self._json(429, {"error": str(e)})
         except PromptTooLongError as e:

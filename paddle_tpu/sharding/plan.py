@@ -22,7 +22,8 @@ from typing import Any, Dict, Optional, Tuple
 from .spec import normalize_spec, pad_spec, spec_axes, spec_str
 
 __all__ = ["ShardingPlan", "complete_pytree_specs", "gpt_annotations",
-           "make_gpt_plan", "resolve_plan", "PRESETS"]
+           "make_gpt_plan", "named_sharding_tree", "resolve_plan",
+           "PRESETS"]
 
 PRESETS = ("dp", "fsdp", "tp", "dp+tp")
 
@@ -155,6 +156,20 @@ def complete_pytree_specs(avals, annotations: Dict[str, Any],
                              if best_t > 0 else "replicated")
     leaves = [P(*specs[_path_str(p)]) for p, _ in flat]
     return jax.tree_util.tree_unflatten(treedef, leaves), derived
+
+
+def named_sharding_tree(specs, mesh):
+    """PartitionSpec pytree -> NamedSharding pytree on ``mesh`` (the
+    form ``jax.jit``'s in/out_shardings and ``jax.device_put`` take).
+    Shared by the engine-side lowerings — training
+    (`parallelize.make_train_step(sharding=...)`) and the serving
+    engine's tensor-parallel mode (`serving/engine.py _init_tp`)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
 
 
 # ---------------------------------------------------------------------------

@@ -259,7 +259,8 @@ def test_engine_recompile_is_explained(tiny_model):
     # same program name, drifted prompt shape — the exact failure the
     # steady-state contract forbids
     example = (eng.qparams, eng.cache.k, eng.cache.v,
-               np.zeros((1, 12), np.int32), np.int32(1), np.int32(0))
+               np.zeros((1, 12), np.int32), np.int32(1), np.int32(0),
+               *eng._samp_scalar_examples())
     eng._compile("prefill_b8", eng._prefill_fn, example,
                  donate_argnums=(1, 2))
     assert _recompile_total() - before == 1

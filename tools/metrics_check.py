@@ -570,6 +570,80 @@ def _run_check_inner(out_dir: str) -> dict:
     assert queue_wait["count"] >= 20 and math.isfinite(
         queue_wait["sum"]), queue_wait
 
+    # --- paged serving gate (ISSUE 13, docs/serving.md): the prefix
+    # cache must make a REPEATED system prompt prefill exactly once —
+    # the second request's prefill covers only its suffix tokens — and
+    # the page-pool gauges must carry live values
+    def _gauge_value(name):
+        s = default_registry().snapshot().get(name, {}).get("series", [])
+        return s[0]["value"] if s else None
+
+    def _prefill_tok_total():
+        return _counter_sum("paddle_serve_prefill_tokens_total")
+
+    pengine = pserving.DecodeEngine(
+        sparams, scfg, pserving.EngineConfig(
+            max_batch=4, max_seq=32, prefill_buckets=(8, 16),
+            kv_layout="paged", page_size=8))
+    pengine.warmup()
+    psched = pserving.Scheduler(pengine)
+    recompiles_before = _recompile_total()
+    system_prompt = [7] * 10 + [3, 5]          # 12 tokens -> 1 full page
+    tok_before = _prefill_tok_total()
+    r1 = psched.submit(system_prompt, max_new_tokens=3)
+    while psched.pending():
+        psched.step()
+    d1 = _prefill_tok_total() - tok_before
+    r2 = psched.submit(system_prompt, max_new_tokens=3)
+    while psched.pending():
+        psched.step()
+    d2 = _prefill_tok_total() - tok_before - d1
+    assert r1.state == "done" and r2.state == "done", (r1.state, r2.state)
+    assert r1.tokens == r2.tokens, "prefix-cached decode diverged"
+    assert d1 == 12, f"first prefill covered {d1} tokens, expected 12"
+    assert d2 == 4, \
+        f"repeated system prompt re-prefilled {d2} tokens (expected " \
+        "only the 4-token suffix — the shared prefix must prefill ONCE)"
+    pc = {s["labels"][0]: s["value"] for s in
+          default_registry().snapshot()
+          ["paddle_serve_prefix_cache_total"]["series"]}
+    assert pc.get("hit", 0) >= 1 and pc.get("miss", 0) >= 1, pc
+    occ = _gauge_value("paddle_serve_page_pool_occupancy")
+    frag = _gauge_value("paddle_serve_page_pool_fragmentation")
+    assert occ is not None and 0.0 <= occ <= 1.0, occ
+    assert frag is not None and 0.0 <= frag <= 1.0, frag
+    assert _recompile_total() - recompiles_before == 0, \
+        "paged smoke serve recompiled — zero-recompile contract broken"
+    assert pengine.steady_state_recompiles == 0
+
+    # --- spec-decode gate: the acceptance histogram must meter windows
+    # (draft == target -> every proposal accepted)
+    starget = pserving.DecodeEngine(
+        sparams, scfg, pserving.EngineConfig(
+            max_batch=2, max_seq=32, prefill_buckets=(8,),
+            verify_window=3))
+    sdraft = pserving.DecodeEngine(
+        sparams, scfg, pserving.EngineConfig(
+            max_batch=2, max_seq=32, prefill_buckets=(8,)))
+    sspec = pserving.SpecDecodeEngine(starget, sdraft)
+    sspec.warmup()
+    recompiles_before = _recompile_total()
+    slot, _lg, tok = sspec.start_sequence_sampled(
+        [2, 4, 6], pserving.GREEDY)
+    emitted = [tok]
+    for _ in range(3):
+        out = sspec.generate_step({slot: emitted[-1]},
+                                  {slot: pserving.GREEDY})
+        emitted.extend(out[slot])
+    sspec.free_sequence(slot)
+    assert _recompile_total() - recompiles_before == 0, \
+        "spec-decode steady state recompiled"
+    spec_hist = default_registry().snapshot()[
+        "paddle_serve_spec_accepted_tokens"]["series"][0]
+    assert spec_hist["count"] >= 3 and math.isfinite(spec_hist["sum"])
+    assert sspec.stats.acceptance_rate == 1.0, \
+        f"self-draft acceptance {sspec.stats.acceptance_rate} != 1.0"
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -607,9 +681,21 @@ def _run_check_inner(out_dir: str) -> dict:
                  "paddle_serve_batch_occupancy", "paddle_serve_ttft_ms",
                  "paddle_serve_tpot_ms", "paddle_serve_tokens_per_s",
                  "paddle_serve_prefill_ms", "paddle_serve_decode_step_ms",
-                 "paddle_serve_queue_wait_ms"):
+                 "paddle_serve_queue_wait_ms",
+                 # ISSUE 13 families: prefix cache, page pool,
+                 # spec-decode acceptance
+                 "paddle_serve_prefix_cache_total",
+                 "paddle_serve_prefill_tokens_total",
+                 "paddle_serve_page_pool_occupancy",
+                 "paddle_serve_page_pool_fragmentation",
+                 "paddle_serve_spec_accepted_tokens",
+                 "paddle_serve_spec_windows_total",
+                 "paddle_serve_preemptions_total",
+                 "paddle_serve_hol_bypass_admits_total"):
         assert name in prom_text, f"{name} missing from exposition"
     assert 'paddle_serve_requests_total{code="200"}' in prom_text
+    assert 'paddle_serve_prefix_cache_total{event="hit"}' in prom_text
+    assert 'paddle_serve_prefix_cache_total{event="miss"}' in prom_text
     # streaming input families (docs/data.md): the seeded faulty stream
     # above must have left retry/quarantine/progress samples
     for name in ("paddle_input_retries_total",
@@ -640,6 +726,11 @@ def _run_check_inner(out_dir: str) -> dict:
             "input_stall_s": round(input_stall_delta, 4),
             "serve_requests": int(serve_200.get(("200",), 0)),
             "serve_steady_state_recompiles": int(serve_recompiles),
+            "prefix_cache": {"hit": int(pc.get("hit", 0)),
+                             "miss": int(pc.get("miss", 0)),
+                             "first_prefill_tokens": int(d1),
+                             "repeat_prefill_tokens": int(d2)},
+            "spec_acceptance_rate": round(sspec.stats.acceptance_rate, 4),
             "program_reports": len(reports),
             "checkpoint_steps": committed,
             "checkpoint_bytes": ckpt_bytes,

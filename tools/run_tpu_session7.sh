@@ -1,0 +1,55 @@
+#!/bin/bash
+# Chip session 7: the ISSUE 13 serving lanes — paged KV + prefix cache,
+# tensor-parallel decode, sampling + speculative decoding, and the
+# closed-loop capacity ladders — after the still-queued session 6
+# (which itself chains session 5; run order is enforced by markers).
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session7.sh > tpu_s7.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+if [ ! -f .tpu_s6_done ]; then
+  echo "=== [0/4] session 6 (serving slab lane) still queued — running it first ==="
+  bash tools/run_tpu_session6.sh
+fi
+
+echo "=== [1/4] serve bench: full lane matrix on-chip $(date -u +%H:%M:%S) ==="
+# dtype x layout + tp + sampled + spec lanes, production-shaped model;
+# zero-recompile + paged-bit-match + tp-parity gates enforced by the rc
+python tools/serve_bench.py \
+  --d 768 --layers 12 --nh 12 --ff 3072 --vocab 50304 \
+  --max-batch 16 --max-seq 1024 --buckets 64,128,256,512,1024 \
+  --rates 4,16,64 --requests 120 --max-new-tokens 64 \
+  --prompt-len-max 512 --eval-len 256 \
+  --weight-dtypes f32,bf16 --layouts slab,paged \
+  --tp 4 --spec-k 4 --out SERVE_BENCH_tpu_13.json
+echo "=== serve bench rc=$? ==="
+
+echo "=== [2/4] capacity ladders: chips-for-N-users at the TTFT SLO $(date -u +%H:%M:%S) ==="
+python tools/serve_bench.py \
+  --d 768 --layers 12 --nh 12 --ff 3072 --vocab 50304 \
+  --max-batch 32 --max-seq 1024 --buckets 128,512,1024 \
+  --rates 16 --requests 40 --max-new-tokens 32 \
+  --weight-dtypes int8 --layouts paged --tp 4 --spec-k 4 \
+  --slo-ttft-ms 200 --capacity-rates 8,32,128,512,2048 \
+  --capacity-requests 80 --out SERVE_BENCH_tpu_capacity.json
+echo "=== capacity rc=$? ==="
+
+echo "=== [3/4] prefix-cache hit-rate probe: shared system prompt $(date -u +%H:%M:%S) ==="
+# the paged lanes above exercise the allocator; this rerun leans on a
+# repeated long system prompt so the TTFT delta of a prefix hit is a
+# measured on-chip number (read paddle_serve_prefix_cache_total +
+# prefill_ms off the metrics gate below)
+python tools/serve_bench.py \
+  --d 768 --layers 12 --nh 12 --ff 3072 --vocab 50304 \
+  --max-batch 16 --max-seq 1024 --buckets 512,1024 \
+  --rates 8 --requests 60 --max-new-tokens 16 \
+  --prompt-len-max 384 --weight-dtypes bf16 --layouts paged \
+  --tp 0 --spec-k 0 --out SERVE_BENCH_tpu_prefix.json
+echo "=== prefix probe rc=$? ==="
+
+echo "=== [4/4] metrics gate on-chip (incl. paged/prefix/spec gates) $(date -u +%H:%M:%S) ==="
+python tools/metrics_check.py --out /tmp/metrics_check_tpu_s7
+echo "=== metrics_check rc=$? ==="
+date -u > .tpu_s7_done

@@ -4,22 +4,26 @@ continuous-batching scheduler -> SERVE_BENCH.json (docs/serving.md).
 
 Open-loop on purpose: arrivals follow a Poisson process at each target
 rate regardless of completions (the closed-loop trap understates tail
-latency under overload). Per rate lane the bench reports:
+latency under overload). Per lane — a (weight_dtype, kv_layout, sharding,
+sampling, spec-decode) config x arrival rate — the bench reports:
 
   * TTFT p50/p99 ms (submit -> first token, queueing included)
   * per-output-token latency (TPOT) p50/p99 ms
   * tokens/s and tokens/s/chip
   * mean decode-batch occupancy
+  * spec-decode acceptance rate + tokens/window (spec lanes)
   * steady_state_recompiles — the PR 4 ``paddle_recompiles_total`` delta
     across the whole warmed load phase, REQUIRED to be exactly 0
 
-plus the int8-vs-f32 quality bar (serving/quant.py): max spread-relative
-logit error and perplexity drift of the int8-weight decode stream against
-the f32 engine, with pass/fail against INT8_LOGIT_TOL / INT8_PPL_REL_TOL.
+plus the int8-vs-f32 quality bar (serving/quant.py) and the CLOSED-LOOP
+capacity lanes (ISSUE 13): per config, ramp the arrival rate until the
+measured p99 TTFT breaks the SLO — ``max_sustainable_rps`` makes "how
+many chips for N users" a measured number (chips x max_rps / per-user
+rate).
 
 CPU lane (default sizes) is labeled ``cpu_smoke`` — dispatch-bound, it
 validates the mechanism and the zero-recompile contract, not absolute
-throughput. The TPU lane is queued in tools/run_tpu_session6.sh.
+throughput. The TPU lane is queued in tools/run_tpu_session7.sh.
 
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --out SERVE_BENCH.json
 """
@@ -33,6 +37,12 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# the tp lanes need a multi-device view on CPU (same trick as
+# tests/conftest.py); must land before jax import
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import numpy as np  # noqa: E402
 
@@ -102,7 +112,75 @@ def parity_lane(params, cfg, ecfg_kw, seed: int, eval_len: int):
     return out
 
 
-def load_lane(params, cfg, ecfg_kw, weight_dtype: str, rate_rps: float,
+def paged_parity_lane(params, cfg, ecfg_kw, seed: int, n_tokens: int):
+    """The ISSUE 13 acceptance bar: paged + greedy decode tokens
+    bit-match the slab engine at f32, and the tp=2 decode logits match
+    single-chip."""
+    import jax
+
+    from paddle_tpu import serving
+
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(0, cfg.vocab_size, size=6).tolist()
+
+    def greedy(engine):
+        slot, logits = engine.start_sequence(prompt)
+        toks = [int(np.argmax(logits))]
+        first_logits = np.asarray(logits)
+        for _ in range(n_tokens - 1):
+            out = engine.decode_step({slot: toks[-1]})
+            toks.append(int(np.argmax(out[slot])))
+        engine.free_sequence(slot)
+        return toks, first_logits
+
+    slab = serving.DecodeEngine(
+        params, cfg, serving.EngineConfig(**ecfg_kw))
+    slab.warmup()
+    slab_toks, slab_logits = greedy(slab)
+    paged = serving.DecodeEngine(params, cfg, serving.EngineConfig(
+        kv_layout="paged", page_size=8, **ecfg_kw))
+    paged.warmup()
+    paged_toks, _ = greedy(paged)
+    out = {"tokens": int(n_tokens),
+           "paged_tokens_match_slab": paged_toks == slab_toks}
+    if jax.device_count() >= 2:
+        tp = serving.DecodeEngine(params, cfg, serving.EngineConfig(
+            sharding="tp", tp=2, **ecfg_kw))
+        tp.warmup()
+        tp_toks, tp_logits = greedy(tp)
+        out["tp2_tokens_match"] = tp_toks == slab_toks
+        out["tp2_max_logit_diff"] = float(
+            np.max(np.abs(tp_logits - slab_logits)))
+    return out
+
+
+def build_engine(params, cfg, ecfg_kw, lane):
+    """One engine per lane config dict: {weight_dtype, kv_layout,
+    sharding, spec(k or 0)} (+ the shared geometry)."""
+    from paddle_tpu import serving
+    from paddle_tpu.models import gpt
+
+    kw = dict(ecfg_kw)
+    kw["weight_dtype"] = lane.get("weight_dtype", "f32")
+    if lane.get("kv_layout") == "paged":
+        kw.update(kv_layout="paged", page_size=lane.get("page_size", 8))
+    if lane.get("sharding") == "tp":
+        kw.update(sharding="tp", tp=lane.get("tp", 2))
+    k = int(lane.get("spec", 0))
+    if k > 0:
+        target = serving.DecodeEngine(params, cfg, serving.EngineConfig(
+            verify_window=k + 1, **kw))
+        dcfg = cfg.scaled(num_layers=max(1, cfg.num_layers // 4))
+        import jax
+
+        dparams = gpt.init_params(jax.random.PRNGKey(99), dcfg)
+        draft = serving.DecodeEngine(dparams, dcfg,
+                                     serving.EngineConfig(**kw))
+        return serving.SpecDecodeEngine(target, draft)
+    return serving.DecodeEngine(params, cfg, serving.EngineConfig(**kw))
+
+
+def load_lane(params, cfg, ecfg_kw, lane, rate_rps: float,
               n_requests: int, max_new_tokens: int, prompt_len_max: int,
               seed: int, queue_cap: int):
     """One Poisson open-loop lane at ``rate_rps`` requests/second."""
@@ -110,14 +188,18 @@ def load_lane(params, cfg, ecfg_kw, weight_dtype: str, rate_rps: float,
 
     from paddle_tpu import serving
 
-    engine = serving.DecodeEngine(
-        params, cfg, serving.EngineConfig(weight_dtype=weight_dtype,
-                                          **ecfg_kw))
+    engine = build_engine(params, cfg, ecfg_kw, lane)
     warm_ms = engine.warmup()
     sched = serving.Scheduler(engine, serving.SchedulerConfig(
         max_queue=queue_cap, default_timeout_s=120.0))
     loop = serving.EngineLoop(sched).start()
 
+    sampling = None
+    if lane.get("sampling"):
+        s = lane["sampling"]
+        sampling = serving.SamplingParams(
+            temperature=s.get("temperature", 0.8),
+            top_k=s.get("top_k", 0), top_p=s.get("top_p", 1.0))
     rng = np.random.RandomState(seed)
     gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
     prompts = [rng.randint(0, cfg.vocab_size,
@@ -126,11 +208,17 @@ def load_lane(params, cfg, ecfg_kw, weight_dtype: str, rate_rps: float,
     requests, rejected = [], 0
     rc0 = _recompile_total()
     t_start = time.monotonic()
-    for gap, prompt in zip(gaps, prompts):
+    for i, (gap, prompt) in enumerate(zip(gaps, prompts)):
         time.sleep(gap)
         try:
+            sp = sampling
+            if sp is not None:
+                sp = serving.SamplingParams(
+                    temperature=sp.temperature, top_k=sp.top_k,
+                    top_p=sp.top_p, seed=i)
             requests.append(sched.submit(prompt,
-                                         max_new_tokens=max_new_tokens))
+                                         max_new_tokens=max_new_tokens,
+                                         sampling=sp))
             loop.wake()
         except serving.QueueFullError:
             rejected += 1
@@ -146,9 +234,12 @@ def load_lane(params, cfg, ecfg_kw, weight_dtype: str, rate_rps: float,
     for r in done:
         tpots.extend((np.diff(r.token_times) * 1e3).tolist())
     total_tokens = sum(len(r.tokens) for r in done)
-    n_chips = jax.device_count()
-    return {
-        "weight_dtype": weight_dtype,
+    n_chips = (lane.get("tp", 2) if lane.get("sharding") == "tp"
+               else 1) if jax.default_backend() == "cpu" \
+        else jax.device_count()
+    result = {
+        **{k: v for k, v in lane.items() if k != "sampling"},
+        "sampled": bool(lane.get("sampling")),
         "rate_rps": rate_rps,
         "requests": n_requests,
         "completed": len(done),
@@ -159,11 +250,56 @@ def load_lane(params, cfg, ecfg_kw, weight_dtype: str, rate_rps: float,
         "tpot_ms": {"p50": round(_pct(tpots, 50), 3) if tpots else None,
                     "p99": round(_pct(tpots, 99), 3) if tpots else None},
         "tokens_per_s": round(total_tokens / t_span, 2),
-        "tokens_per_s_per_chip": round(total_tokens / t_span / n_chips, 2),
+        "tokens_per_s_per_chip": round(
+            total_tokens / t_span / n_chips, 2),
         "mean_batch_occupancy": round(sched.mean_occupancy, 4),
         "scheduler_steps": sched.steps,
+        "preemptions": sched.preemptions,
         "steady_state_recompiles": int(recompiles),
         "warmup_ms": {k: round(v, 1) for k, v in warm_ms.items()},
+    }
+    if lane.get("spec", 0) > 0:
+        st = engine.stats
+        result["spec"] = {
+            "k": int(lane["spec"]),
+            "windows": st.windows,
+            "acceptance_rate": round(st.acceptance_rate, 4),
+            "tokens_per_window": round(st.tokens_per_window, 3),
+        }
+    return result
+
+
+def capacity_lane(params, cfg, ecfg_kw, lane, slo_ttft_p99_ms: float,
+                  rate_ladder, n_requests: int, max_new_tokens: int,
+                  prompt_len_max: int, seed: int, queue_cap: int):
+    """CLOSED-LOOP capacity search: ramp the arrival rate up the ladder,
+    measure p99 TTFT at each rung, stop at the first SLO violation.
+    ``max_sustainable_rps`` is the last passing rung — the "how many
+    chips for N users" number per (chip count, dtype, spec on/off)."""
+    probes = []
+    max_ok = None
+    for rate in rate_ladder:
+        probe = load_lane(params, cfg, ecfg_kw, lane, rate, n_requests,
+                          max_new_tokens, prompt_len_max, seed,
+                          queue_cap)
+        ok = (probe["ttft_ms"]["p99"] is not None
+              and probe["ttft_ms"]["p99"] <= slo_ttft_p99_ms
+              and probe["failed"] == 0 and probe["rejected_429"] == 0)
+        probes.append({"rate_rps": rate,
+                       "ttft_p99_ms": probe["ttft_ms"]["p99"],
+                       "tokens_per_s": probe["tokens_per_s"],
+                       "recompiles": probe["steady_state_recompiles"],
+                       "slo_ok": ok})
+        if not ok:
+            break
+        max_ok = rate
+    return {
+        **{k: v for k, v in lane.items() if k != "sampling"},
+        "slo_ttft_p99_ms": slo_ttft_p99_ms,
+        "max_sustainable_rps": max_ok,
+        "probes": probes,
+        "steady_state_recompiles": max(
+            p["recompiles"] for p in probes),
     }
 
 
@@ -185,9 +321,17 @@ def main(argv=None):
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--prompt-len-max", type=int, default=16)
     ap.add_argument("--weight-dtypes", default="f32,int8")
+    ap.add_argument("--layouts", default="slab,paged")
+    ap.add_argument("--tp", type=int, default=2,
+                    help="tp size for the tensor-parallel lane (0 skips)")
+    ap.add_argument("--spec-k", type=int, default=3,
+                    help="draft tokens for the spec-decode lane (0 skips)")
     ap.add_argument("--eval-len", type=int, default=48,
                     help="token stream length for the parity lane")
     ap.add_argument("--queue-cap", type=int, default=256)
+    ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
+    ap.add_argument("--capacity-rates", default="4,16,64,256")
+    ap.add_argument("--capacity-requests", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -198,6 +342,7 @@ def main(argv=None):
     if args.smoke:
         args.rates, args.requests = "16,64", 24
         args.eval_len = 24
+        args.capacity_rates, args.capacity_requests = "8,64", 12
 
     import jax.numpy as jnp
 
@@ -233,28 +378,73 @@ def main(argv=None):
           flush=True)
     result["quant_parity"] = parity_lane(
         params, cfg, ecfg_kw, args.seed + 1, args.eval_len)
+    print("[serve_bench] paged/tp parity lane...", flush=True)
+    result["engine_parity"] = paged_parity_lane(
+        params, cfg, ecfg_kw, args.seed + 1, max(args.eval_len // 2, 8))
+
+    # lane matrix: dtype x layout open-loop rates, plus one lane each for
+    # tp, sampled, and spec-decode configs
+    lane_cfgs = []
+    for wd in args.weight_dtypes.split(","):
+        for layout in args.layouts.split(","):
+            lane_cfgs.append({"weight_dtype": wd.strip(),
+                              "kv_layout": layout.strip()})
+    if args.tp and jax.device_count() >= args.tp:
+        lane_cfgs.append({"weight_dtype": "f32", "kv_layout": "slab",
+                          "sharding": "tp", "tp": args.tp})
+    lane_cfgs.append({"weight_dtype": "f32", "kv_layout": "paged",
+                      "sampling": {"temperature": 0.8, "top_p": 0.9}})
+    if args.spec_k:
+        lane_cfgs.append({"weight_dtype": "f32", "kv_layout": "slab",
+                          "spec": args.spec_k})
 
     lanes = []
-    for wd in args.weight_dtypes.split(","):
+    for lane in lane_cfgs:
         for rate in (float(r) for r in args.rates.split(",")):
-            print(f"[serve_bench] load lane weight={wd} rate={rate}/s "
+            desc = ",".join(f"{k}={v}" for k, v in lane.items())
+            print(f"[serve_bench] load lane {desc} rate={rate}/s "
                   f"({args.requests} requests)...", flush=True)
             lanes.append(load_lane(
-                params, cfg, ecfg_kw, wd.strip(), rate, args.requests,
+                params, cfg, ecfg_kw, lane, rate, args.requests,
                 args.max_new_tokens, args.prompt_len_max,
                 args.seed + 2, args.queue_cap))
     result["load"] = lanes
-    result["steady_state_recompiles"] = max(
-        l["steady_state_recompiles"] for l in lanes)
+
+    # closed-loop capacity: per (chip count, dtype, spec on/off)
+    cap_ladder = [float(r) for r in args.capacity_rates.split(",")]
+    cap_cfgs = [{"weight_dtype": "f32", "kv_layout": "paged"},
+                {"weight_dtype": "int8", "kv_layout": "paged"}]
+    if args.spec_k:
+        cap_cfgs.append({"weight_dtype": "f32", "kv_layout": "slab",
+                         "spec": args.spec_k})
+    capacity = []
+    for lane in cap_cfgs:
+        desc = ",".join(f"{k}={v}" for k, v in lane.items())
+        print(f"[serve_bench] capacity lane {desc} "
+              f"(SLO p99 TTFT <= {args.slo_ttft_ms}ms)...", flush=True)
+        capacity.append(capacity_lane(
+            params, cfg, ecfg_kw, lane, args.slo_ttft_ms, cap_ladder,
+            args.capacity_requests, args.max_new_tokens,
+            args.prompt_len_max, args.seed + 3, args.queue_cap))
+    result["capacity"] = capacity
+
+    all_recompiles = ([l["steady_state_recompiles"] for l in lanes]
+                      + [c["steady_state_recompiles"] for c in capacity])
+    result["steady_state_recompiles"] = max(all_recompiles)
     result["zero_recompile_pass"] = result["steady_state_recompiles"] == 0
     result["int8_pass"] = bool(result["quant_parity"]["int8"]["pass"])
+    ep = result["engine_parity"]
+    result["engine_parity_pass"] = bool(
+        ep["paged_tokens_match_slab"]
+        and ep.get("tp2_tokens_match", True))
 
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
-    print(json.dumps({k: v for k, v in result.items() if k != "load"},
-                     indent=1))
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("load", "capacity")}, indent=1))
     print(f"[serve_bench] wrote {args.out}")
-    if not (result["zero_recompile_pass"] and result["int8_pass"]):
+    if not (result["zero_recompile_pass"] and result["int8_pass"]
+            and result["engine_parity_pass"]):
         return 1
     return 0
 
