@@ -432,6 +432,16 @@ def worker(use_flash: bool):
     from paddle_tpu.parallel import health as health_mod
 
     health_mod.maybe_install_from_env()
+    # --profile[=PATH]: after the measured loop, trace a few extra steps
+    # and emit the roofline attribution (ATTRIBUTION.json, ISSUE 14 —
+    # observability/attribution.py): every fusion placed on the roofline,
+    # residue ranking, config levers stamped for tools/perf_diff.py
+    profile_path = next((a.split("=", 1)[1] for a in sys.argv
+                         if a.startswith("--profile=")), None)
+    if profile_path is None and "--profile" in sys.argv:
+        profile_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "ATTRIBUTION.json")
+    attr_stats = {}
     # --stream-input: feed the measured loop from the fault-tolerant
     # sharded streaming engine (docs/data.md) instead of one fixed tensor
     # pair — token shards are written once, read+decoded by the stream's
@@ -600,6 +610,56 @@ def worker(use_flash: bool):
             hb.flush()
         if ck is not None:
             ck.close()
+        if profile_path:
+            # attribution lane OUTSIDE the timed loop: the measured
+            # number stays clean, the extra traced steps feed the join
+            import tempfile as _tf
+
+            from paddle_tpu.observability import attribution as ATT
+            from paddle_tpu.observability import program_report as PREP
+
+            tdir = _tf.mkdtemp(prefix="bench_attr_")
+            psteps = min(4, max(2, steps // 2))
+            _log(f"worker[{tag}]: tracing {psteps} steps for attribution")
+            tp0 = time.perf_counter()
+            with jax.profiler.trace(tdir):
+                for _ in range(psteps):
+                    params, opt, loss, _ = step(params, opt, tokens,
+                                                labels)
+                float(loss)
+            p_wall_ms = (time.perf_counter() - tp0) * 1e3 / psteps
+            hlo = step.hlo_text() if hasattr(step, "hlo_text") else None
+            report = next(
+                (r for r in reversed(PREP.recent_reports())
+                 if r.get("program") == getattr(step, "report_name",
+                                                None)), {})
+            attribution = ATT.build_from_trace(
+                tdir, steps=psteps, wall_ms_per_step=p_wall_ms,
+                hlo_texts=[hlo] if hlo else [], device=dev, mode="train",
+                spec=f"bench:{tag}",
+                step_flops=report.get("flops"),
+                step_bytes=report.get("bytes_accessed"),
+                programs=[report] if report else None,
+                config={"mode": "train", "config": tag,
+                        "remat": (cfg.remat_policy if cfg.remat
+                                  else "none"),
+                        "flash": bool(cfg.use_flash),
+                        "fused_opt": False, "batch": batch, "seq": T,
+                        "d_model": cfg.d_model,
+                        "layers": cfg.num_layers},
+                generated_by="bench.py --profile")
+            ATT.write(attribution, profile_path)
+            res = attribution["residue"]
+            attr_stats.update(
+                path=profile_path,
+                device_busy_ms_per_step=attribution[
+                    "device_busy_ms_per_step"],
+                gap_share=attribution["gap_share"],
+                residue_share=res["share_of_busy"],
+                residue_groups=[g["label"] for g in res["groups"][:4]])
+            _log(f"worker[{tag}]: attribution -> {profile_path} "
+                 f"(residue {res['share_of_busy']:.1%}, groups "
+                 f"{attr_stats['residue_groups']})")
         _log(f"worker[{tag}]: {ran} steps in {dt:.2f}s "
              f"({dt / ran * 1000:.0f} ms/step)")
         tokens_per_s = ran * batch * T / dt
@@ -672,6 +732,8 @@ def worker(use_flash: bool):
     }
     if stream_stats:
         detail["stream_input"] = stream_stats
+    if attr_stats:
+        detail["attribution"] = attr_stats
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s, 2),

@@ -158,11 +158,16 @@ def param_specs(cfg: GPTConfig, pp: str = "pp", tp: str = "tp") -> Dict[str, Any
 
 
 def _layer_norm(x, scale, bias, eps=1e-5):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * scale + bias).astype(x.dtype)
+    # the named_scope lands in every HLO instruction's metadata op_name —
+    # forward AND grad ops — so the roofline attribution's residue
+    # ranking (observability/attribution.py) names the layernorm tail
+    # instead of an anonymous elementwise fusion
+    with jax.named_scope("layer_norm"):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+        return (y * scale + bias).astype(x.dtype)
 
 
 def _causal_attention(q, k, v, cfg: GPTConfig):

@@ -32,6 +32,17 @@ split (host RecordEvent + device tracer + train monitor callbacks):
   classified into productive_step/compile/checkpoint_save/... —
   ``paddle_goodput_seconds_total{category}``, per-rank ``GOODPUT`` window
   reports, and the gang aggregation the supervisor writes.
+- :mod:`.attribution` — roofline attribution (ISSUE 14): the measured
+  per-fusion device time joined with static HLO flops/bytes and the
+  ``hw`` peak tables — every fusion placed on the roofline
+  (compute- vs HBM-bound, achieved-vs-peak fraction), inter-op gap
+  share, and the ranked small-op residue list, emitted as a
+  schema-versioned ``ATTRIBUTION.json``.
+- :mod:`.baseline` — the perf regression sentinel (ISSUE 14): a run's
+  artifacts (attribution, goodput, monitor rollups, bench headlines,
+  program reports) diffed against a committed ``PERF_BASELINE.json``
+  with per-metric tolerance bands and cause attribution
+  (``tools/perf_diff.py`` is the CLI).
 - :mod:`.program_report` — compile- & memory-side introspection (ISSUE 4):
   per-executable cost/memory program reports (JSONL +
   ``paddle_program_*`` gauges), the recompile explainer
@@ -54,6 +65,8 @@ from .metrics import (  # noqa: F401
     set_metrics_enabled,
 )
 from .monitor import MonitorWriter, TrainMonitor  # noqa: F401
+from . import attribution  # noqa: F401
+from . import baseline  # noqa: F401
 from . import goodput  # noqa: F401
 from . import hw  # noqa: F401
 from . import program_report  # noqa: F401
@@ -64,6 +77,6 @@ from . import trace_merge  # noqa: F401
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "metrics_enabled", "set_metrics_enabled",
-    "MonitorWriter", "TrainMonitor", "goodput", "hw", "program_report",
-    "prom", "spans", "trace_merge",
+    "MonitorWriter", "TrainMonitor", "attribution", "baseline", "goodput",
+    "hw", "program_report", "prom", "spans", "trace_merge",
 ]

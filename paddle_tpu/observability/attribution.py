@@ -1,0 +1,608 @@
+"""Roofline attribution: every fusion placed on the roofline (ISSUE 14).
+
+PRs 3/4/10 built the sensors — measured per-fusion device nanoseconds
+(``utils/device_trace.py`` over the xplane capture), per-executable
+``cost_analysis()`` flops/bytes (``program_report.py``), hardware peak
+tables (``hw.py``) — but the join lived in a hand-read script.  This
+module is the machine-readable join:
+
+- **static per-instruction costs** parsed from the optimized HLO text the
+  compiled executable already carries (``hlo_instruction_costs``): exact
+  dot flops from the printed contracting dims, operand+output bytes as
+  the HBM-traffic upper bound (XLA's own caveat: fusion eliminates
+  reuse, so bytes are a ceiling — KERNEL_NOTES.md records the same for
+  ``cost_analysis``);
+- **measured** exclusive device time per executed HLO instruction
+  (interval-union attribution over parallel streams, PR 14 satellite);
+- the join places every fusion on the roofline — achieved-vs-peak
+  fraction against the binding roof (compute vs HBM, ridge =
+  peak_flops / peak_bandwidth), inter-op gap share, and a ranked
+  **residue list** (the ~130 small-op tail from KERNEL_NOTES.md:
+  layernorm grads, adds, the optimizer update) that is ROADMAP item 3's
+  megakernel target list;
+- the result is a schema-versioned ``ATTRIBUTION.json`` emitted by
+  ``tools/profile_step.py`` (train and ``--serve`` decode-tick modes) and
+  ``bench.py --profile``, and diffed across runs by ``tools/perf_diff.py``
+  (observability/baseline.py).
+
+GSPMD's cost-model framing (arXiv:2105.04663) and the MPK residue
+analysis (arXiv:2512.22219) both presume exactly this layer: measured
+time x static cost, stable enough to diff.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+__all__ = [
+    "SCHEMA_VERSION", "hlo_instruction_costs", "classify_label",
+    "measured_fusion_rows", "build", "build_from_trace", "validate",
+    "write",
+]
+
+# ---------------------------------------------------------------------------
+# Static per-instruction costs from optimized HLO text
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8, "c128": 16,
+    "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "s4": 1, "u4": 1,
+}
+
+_SHAPE_RX = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+_COMP_RX = re.compile(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*(?:\(|=)")
+_INSTR_RX = re.compile(
+    r"^\s+(?:ROOT\s+)?%([\w.\-]+)\s*=\s*"
+    r"(\([^)]*\)|[a-z][a-z0-9]*\[[\d,]*\]\S*)\s+"
+    r"([a-z][a-z0-9\-]*)\(")
+_CALLS_RX = re.compile(r"calls=%([\w.\-]+)")
+_LHS_CONTRACT_RX = re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
+
+
+def _shape_bytes(text: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape in ``text``."""
+    total = 0
+    for dtype, dims in _SHAPE_RX.findall(text):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _operand_text(line: str, opcode: str) -> str:
+    """The operand list of an instruction line: the parenthesized span
+    right after the opcode (paren-matched — tuple-typed operands nest)."""
+    i = line.find(opcode + "(")
+    if i < 0:
+        return ""
+    i += len(opcode)
+    depth = 0
+    for j in range(i, len(line)):
+        if line[j] == "(":
+            depth += 1
+        elif line[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return line[i + 1:j]
+    return line[i + 1:]
+
+
+def _dot_flops(line: str, out_elems: int) -> Optional[float]:
+    """Exact dot flops: 2 * output elements * contracted extent, from the
+    lhs shape (first operand) and the printed lhs_contracting_dims."""
+    m = _LHS_CONTRACT_RX.search(line)
+    operands = _operand_text(line, "dot")
+    shapes = _SHAPE_RX.findall(operands)
+    if not m or not shapes:
+        return None
+    lhs_dims = [int(d) for d in shapes[0][1].split(",") if d]
+    contract = 1
+    for i in m.group(1).split(","):
+        if not i:
+            continue
+        i = int(i)
+        if i >= len(lhs_dims):
+            return None
+        contract *= lhs_dims[i]
+    return 2.0 * out_elems * contract
+
+
+def _out_elems(out_text: str) -> int:
+    n_total = 0
+    for _dtype, dims in _SHAPE_RX.findall(out_text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        n_total += n
+    return n_total
+
+
+def hlo_instruction_costs(hlo_text: str) -> Dict[str, Dict[str, Any]]:
+    """Per-instruction static costs from optimized HLO text.
+
+    Returns ``{instruction_name: {"flops", "bytes", "opcode"}}`` over ALL
+    computations (device events name instructions inside while/scan bodies
+    too, not just ENTRY).  ``flops`` is exact for ``dot`` (2 x output x
+    contracted extent from the printed dims) and, for a ``fusion``, the sum
+    of the dots inside its fused computation; ``None`` for opaque bodies
+    (custom-call kernels, while loops — their trip count is not in the
+    text).  ``bytes`` is operand + output bytes: the HBM-traffic ceiling
+    of the instruction as a standalone kernel."""
+    # pass 1: instructions per computation
+    comps: Dict[str, List[Tuple[str, str, str, str]]] = {}
+    cur: Optional[str] = None
+    for line in hlo_text.splitlines():
+        if not line.startswith((" ", "\t")):
+            m = _COMP_RX.match(line.strip())
+            if m and line.rstrip().endswith("{"):
+                cur = m.group(1)
+                comps[cur] = []
+            continue
+        m = _INSTR_RX.match(line)
+        if m and cur is not None:
+            comps[cur].append((m.group(1), m.group(2), m.group(3), line))
+
+    # pass 2: dot flops per computation (fusion bodies, while bodies, ...)
+    comp_flops: Dict[str, float] = {}
+    for comp, instrs in comps.items():
+        total = 0.0
+        for _name, out_text, opcode, line in instrs:
+            if opcode == "dot":
+                f = _dot_flops(line, _out_elems(out_text))
+                if f:
+                    total += f
+        comp_flops[comp] = total
+
+    # pass 3: per-instruction records
+    out: Dict[str, Dict[str, Any]] = {}
+    for comp, instrs in comps.items():
+        for name, out_text, opcode, line in instrs:
+            flops: Optional[float] = 0.0
+            if opcode == "dot":
+                flops = _dot_flops(line, _out_elems(out_text))
+            elif opcode == "fusion":
+                mc = _CALLS_RX.search(line)
+                flops = comp_flops.get(mc.group(1), 0.0) if mc else 0.0
+            elif opcode in ("custom-call", "while", "call", "conditional",
+                            "convolution"):
+                flops = None    # opaque body / trip count not in the text
+            nbytes = _shape_bytes(_operand_text(line, opcode)) \
+                + _shape_bytes(out_text)
+            out[name] = {"flops": flops, "bytes": nbytes, "opcode": opcode}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Residue / family classification
+# ---------------------------------------------------------------------------
+
+# keyword -> label, in specificity order; matched against the lowercased
+# HLO metadata op_name (jax scope path) first, then the hlo op name
+_LABEL_KEYWORDS = (
+    (("adam", "adamw", "sgd", "momentum", "fused_opt", "opt_update",
+      "apply_grad", "optimizer", "lamb"), "optimizer"),
+    (("layer_norm", "layernorm", "rms_norm", "rmsnorm"), "layernorm"),
+    (("flash", "attention", "attn", "tpu_custom_call", "mosaic"),
+     "attention"),
+    (("softmax", "logsumexp", "cross_entropy", "log_softmax", "lm_loss",
+      "nll"), "softmax_ce"),
+    (("embed", "take", "lookup", "one_hot"), "embedding"),
+    (("dot_general", "matmul", "convolution", "conv_general", "conv2d"),
+     "matmul"),
+    (("transpose", "reshape", "broadcast", "concatenate", "pad", "slice",
+      "gather", "scatter", "copy", "bitcast", "convert", "select"),
+     "data_movement"),
+    (("add", "sub", "mul", "div", "tanh", "gelu", "relu", "exp", "neg",
+      "rsqrt", "sqrt", "max", "min", "integer_pow", "clip", "cumsum"),
+     "elementwise"),
+    (("reduce", "sum", "mean", "norm"), "reduce"),
+    (("rng", "random", "threefry", "iota"), "rng"),
+)
+
+
+def stable_key(op_name: str = "", hlo_op: str = "") -> str:
+    """Run-stable identity for a fusion: HLO instruction numbering AND
+    suffix qualifiers shift with compilation order across processes
+    ('while.81' vs 'while.83', 'copy_bitcast_fusion' growing a '.clone'),
+    so the sentinel keys fusions by the tail of the jax scope path
+    (metadata op_name — stable for the same program) and falls back to
+    the instruction name with every dot-suffix stripped."""
+    if op_name:
+        return "/".join(str(op_name).split("/")[-3:])
+    return str(hlo_op).lstrip("%").split(".")[0] or "other"
+
+
+def classify_label(op_name: str = "", hlo_op: str = "",
+                   opcode: str = "") -> str:
+    """Residue/family label for one fusion: the HLO opcode wins for real
+    matmuls (a wgrad dot's jax scope path says 'transpose'), then a
+    keyword scan over the scope path (metadata op_name), then the HLO
+    opcode/name."""
+    if opcode == "dot" or hlo_op.startswith(("dot", "convolution")):
+        return "matmul"
+    probe = (op_name or "").lower()
+    for keys, label in _LABEL_KEYWORDS:
+        if any(k in probe for k in keys):
+            return label
+    if opcode == "custom-call" or hlo_op.startswith("custom-call"):
+        return "attention"
+    # fused-instruction names concatenate their op chain
+    # ('dynamic-slice_convert_fusion'): substring-match the chain, with
+    # hyphen/underscore spellings normalized
+    base = hlo_op.split(".")[0].lstrip("%").replace("-", "_")
+    for keys, label in _LABEL_KEYWORDS:
+        if any(k.replace("-", "_") in base for k in keys):
+            return label
+    return base or "other"
+
+
+# ---------------------------------------------------------------------------
+# Measured rows: trace x HLO join
+# ---------------------------------------------------------------------------
+
+def measured_fusion_rows(trace_dir: str,
+                         hlo_texts: Sequence[str] = (),
+                         steps: int = 1) -> List[Dict[str, Any]]:
+    """Join the capture's measured exclusive device time with the static
+    HLO instruction costs: one row per executed HLO instruction name,
+    aggregated over ``steps`` profiled steps."""
+    from ..utils import device_trace as DT
+
+    cost_by_module: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    names_by_module: Dict[str, Dict[str, str]] = {}
+    merged_costs: Dict[str, Dict[str, Any]] = {}
+    merged_names: Dict[str, str] = {}
+    for txt in hlo_texts:
+        mod = DT.hlo_module_name(txt)
+        costs = hlo_instruction_costs(txt)
+        names = DT.hlo_op_name_map(txt)
+        cost_by_module.setdefault(mod, {}).update(costs)
+        names_by_module.setdefault(mod, {}).update(names)
+        merged_costs.update(costs)
+        merged_names.update(names)
+
+    agg: Dict[Tuple[str, str], List[float]] = {}
+    for module, hlo_op, dur in DT.device_events(trace_dir, exclusive=True):
+        key = (str(module), str(hlo_op).lstrip("%"))
+        a = agg.setdefault(key, [0.0, 0])
+        a[0] += dur
+        a[1] += 1
+
+    rows: List[Dict[str, Any]] = []
+    steps = max(1, int(steps))
+    for (module, hlo_op), (ns, events) in agg.items():
+        cost = (cost_by_module.get(module) or {}).get(hlo_op) \
+            or merged_costs.get(hlo_op) or {}
+        op_name = (names_by_module.get(module) or {}).get(hlo_op) \
+            or merged_names.get(hlo_op) or ""
+        rows.append({
+            "name": hlo_op,
+            "module": module,
+            "op_name": op_name,
+            "label": classify_label(op_name, hlo_op,
+                                    cost.get("opcode", "")),
+            "events": int(events),
+            "ns": float(ns),
+            "ns_per_step": float(ns) / steps,
+            "flops": cost.get("flops"),
+            "bytes": cost.get("bytes"),
+        })
+    rows.sort(key=lambda r: (-r["ns"], r["name"]))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Roofline math (pure — the synthetic-trace tests drive this directly)
+# ---------------------------------------------------------------------------
+
+def _frac(x: Optional[float]) -> Optional[float]:
+    """Clamp a roofline fraction into [0, 1] (static bytes are unfused
+    upper bounds, so raw achieved/peak can exceed 1; the raw value rides
+    alongside)."""
+    if x is None or not math.isfinite(x):
+        return None
+    return max(0.0, min(1.0, x))
+
+
+def _place_row(row: Dict[str, Any], peak_flops: float,
+               peak_bw: float) -> Dict[str, Any]:
+    """Place one measured row on the roofline; mutates and returns it."""
+    ridge = peak_flops / peak_bw if peak_bw else float("inf")
+    ns, events = row["ns"], max(1, row["events"])
+    dur_s = ns / 1e9
+    flops, nbytes = row.get("flops"), row.get("bytes")
+    rate_f = (flops * events / dur_s) if flops and dur_s > 0 else None
+    rate_b = (nbytes * events / dur_s) if nbytes and dur_s > 0 else None
+    intensity = (flops / nbytes) if flops and nbytes else None
+    if intensity is not None:
+        bound = "compute" if intensity >= ridge else "hbm"
+    elif rate_b is not None:
+        bound = "hbm"
+    elif rate_f is not None:
+        bound = "compute"
+    else:
+        bound = "unknown"
+    compute_frac = rate_f / peak_flops if rate_f is not None else None
+    hbm_frac = rate_b / peak_bw if rate_b is not None and peak_bw else None
+    binding = compute_frac if bound == "compute" else hbm_frac
+    row.update({
+        "intensity": round(intensity, 4) if intensity is not None else None,
+        "achieved_flops_per_s": rate_f,
+        "achieved_bytes_per_s": rate_b,
+        "compute_fraction": _frac(compute_frac),
+        "hbm_fraction": _frac(hbm_frac),
+        "bound": bound,
+        "roofline_fraction": _frac(binding),
+        "roofline_fraction_raw": (round(binding, 6)
+                                  if binding is not None
+                                  and math.isfinite(binding) else None),
+    })
+    return row
+
+
+def build(rows: Iterable[Dict[str, Any]],
+          steps: int,
+          wall_ms_per_step: Optional[float],
+          peak_flops: float,
+          peak_hbm_bytes_per_s: float,
+          step_flops: Optional[float] = None,
+          step_bytes: Optional[float] = None,
+          residue_share_threshold: float = 0.01,
+          mode: str = "train",
+          spec: Optional[str] = None,
+          programs: Optional[List[Dict[str, Any]]] = None,
+          config: Optional[Dict[str, Any]] = None,
+          generated_by: str = "attribution",
+          top_fusions: int = 40) -> Dict[str, Any]:
+    """Assemble the schema-versioned attribution document.
+
+    ``rows`` carry at least {name, events, ns} (``measured_fusion_rows``
+    adds flops/bytes/label; synthetic tests can hand-build them).  The
+    residue is every row whose individual share of device-busy time is
+    below ``residue_share_threshold``, grouped by label and ranked by
+    total time — deterministically (ties break on the label/name)."""
+    rows = [dict(r) for r in rows]
+    steps = max(1, int(steps))
+    for r in rows:
+        r.setdefault("events", steps)
+        r.setdefault("ns_per_step", r["ns"] / steps)
+        r.setdefault("label", classify_label(r.get("op_name", ""),
+                                             r.get("name", "")))
+        r.setdefault("key", stable_key(r.get("op_name", ""),
+                                       r.get("name", "")))
+        _place_row(r, peak_flops, peak_hbm_bytes_per_s)
+    rows.sort(key=lambda r: (-r["ns"], r["name"]))
+    busy_ns = sum(r["ns"] for r in rows)
+    for r in rows:
+        r["share_of_busy"] = (round(r["ns"] / busy_ns, 6)
+                              if busy_ns > 0 else 0.0)
+    busy_ms_per_step = busy_ns / 1e6 / steps
+
+    gap_ms = gap_share = None
+    if wall_ms_per_step is not None and wall_ms_per_step > 0:
+        gap_ms = max(0.0, wall_ms_per_step - busy_ms_per_step)
+        gap_share = _frac(gap_ms / wall_ms_per_step)
+
+    # whole-step placement from the executable's cost_analysis totals
+    busy_s = busy_ns / 1e9 / steps
+    ridge = (peak_flops / peak_hbm_bytes_per_s
+             if peak_hbm_bytes_per_s else None)
+    step_doc: Dict[str, Any] = {
+        "flops": step_flops, "bytes_accessed": step_bytes,
+        "intensity": (round(step_flops / step_bytes, 4)
+                      if step_flops and step_bytes else None),
+    }
+    if step_flops and busy_s > 0:
+        step_doc["mfu_vs_busy"] = _frac(step_flops / busy_s / peak_flops)
+    if step_flops and wall_ms_per_step:
+        step_doc["mfu"] = _frac(
+            step_flops / (wall_ms_per_step / 1e3) / peak_flops)
+    if step_bytes and busy_s > 0 and peak_hbm_bytes_per_s:
+        step_doc["hbm_fraction"] = _frac(
+            step_bytes / busy_s / peak_hbm_bytes_per_s)
+    if step_doc["intensity"] is not None and ridge is not None:
+        step_doc["bound"] = ("compute" if step_doc["intensity"] >= ridge
+                             else "hbm")
+
+    # residue: the small-op tail (each row individually under the
+    # threshold share), grouped by label, ranked by aggregate time
+    residue_rows = [r for r in rows
+                    if busy_ns > 0
+                    and r["ns"] / busy_ns < residue_share_threshold]
+    groups: Dict[str, Dict[str, Any]] = {}
+    for r in residue_rows:
+        g = groups.setdefault(r["label"], {
+            "label": r["label"], "ns": 0.0, "events": 0, "ops": []})
+        g["ns"] += r["ns"]
+        g["events"] += r["events"]
+        g["ops"].append((r["ns"], r["name"]))
+    group_rows = []
+    for g in groups.values():
+        g["ops"].sort(key=lambda t: (-t[0], t[1]))
+        group_rows.append({
+            "label": g["label"],
+            "ns_per_step": round(g["ns"] / steps, 1),
+            "ms_per_step": round(g["ns"] / 1e6 / steps, 6),
+            "events_per_step": round(g["events"] / steps, 2),
+            "share_of_busy": (round(g["ns"] / busy_ns, 6)
+                              if busy_ns > 0 else 0.0),
+            "top_ops": [name for _ns, name in g["ops"][:5]],
+        })
+    group_rows.sort(key=lambda g: (-g["ns_per_step"], g["label"]))
+    residue_ns = sum(r["ns"] for r in residue_rows)
+
+    # run-stable fusion groups (the sentinel's tracking unit): aggregate
+    # ALL rows by stable key — instruction numbering shifts across
+    # processes, the scope-path key does not
+    fgroups: Dict[str, Dict[str, Any]] = {}
+    for r in rows:
+        g = fgroups.setdefault(r["key"], {
+            "key": r["key"], "label": r["label"], "ns": 0.0,
+            "events": 0, "rows": 0})
+        g["ns"] += r["ns"]
+        g["events"] += r["events"]
+        g["rows"] += 1
+    fusion_groups = sorted(
+        ({"key": g["key"], "label": g["label"],
+          "ms_per_step": round(g["ns"] / 1e6 / steps, 6),
+          "events_per_step": round(g["events"] / steps, 2),
+          "rows": g["rows"],
+          "share_of_busy": (round(g["ns"] / busy_ns, 6)
+                            if busy_ns > 0 else 0.0)}
+         for g in fgroups.values()),
+        key=lambda g: (-g["ms_per_step"], g["key"]))
+
+    def _round_row(r: Dict[str, Any]) -> Dict[str, Any]:
+        out = {k: r.get(k) for k in (
+            "name", "key", "label", "events", "op_name", "flops", "bytes",
+            "intensity", "bound", "compute_fraction", "hbm_fraction",
+            "roofline_fraction", "roofline_fraction_raw",
+            "share_of_busy")}
+        out["ms_per_step"] = round(r["ns_per_step"] / 1e6, 6)
+        for k in ("achieved_flops_per_s", "achieved_bytes_per_s"):
+            v = r.get(k)
+            out[k] = round(v, 1) if v is not None else None
+        if out["op_name"]:
+            out["op_name"] = out["op_name"][-120:]
+        return out
+
+    doc: Dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
+        "generated_by": generated_by,
+        "generated_at": round(time.time(), 1),
+        "mode": mode,
+        "spec": spec,
+        "steps": steps,
+        "wall_ms_per_step": (round(wall_ms_per_step, 6)
+                             if wall_ms_per_step is not None else None),
+        "device_busy_ms_per_step": round(busy_ms_per_step, 6),
+        "gap_ms_per_step": (round(gap_ms, 6)
+                            if gap_ms is not None else None),
+        "gap_share": gap_share,
+        "peak": {
+            "bf16_flops_per_s": peak_flops,
+            "hbm_bytes_per_s": peak_hbm_bytes_per_s,
+            "ridge_intensity": (round(ridge, 2)
+                                if ridge is not None else None),
+        },
+        "step": step_doc,
+        "fusions": [_round_row(r) for r in rows[:top_fusions]],
+        "fusion_groups": fusion_groups[:100],
+        "fusion_count": len(rows),
+        "residue": {
+            "threshold_share": residue_share_threshold,
+            "count": len(residue_rows),
+            "ms_per_step": round(residue_ns / 1e6 / steps, 6),
+            "share_of_busy": (round(residue_ns / busy_ns, 6)
+                              if busy_ns > 0 else 0.0),
+            "groups": group_rows,
+        },
+    }
+    if programs:
+        doc["programs"] = [
+            {k: p.get(k) for k in ("program", "flops", "bytes_accessed",
+                                   "compile_ms", "cache")}
+            for p in programs]
+    if config:
+        doc["config"] = dict(config)
+    # recompile-cause snapshot for the sentinel's cause attribution
+    try:
+        from . import metrics as _metrics
+
+        snap = _metrics.default_registry().snapshot()
+        doc["recompiles"] = {
+            s["labels"][0]: s["value"] for s in
+            snap.get("paddle_recompiles_total", {}).get("series", [])}
+    except Exception:
+        doc["recompiles"] = {}
+    return doc
+
+
+def build_from_trace(trace_dir: str, steps: int,
+                     wall_ms_per_step: Optional[float] = None,
+                     hlo_texts: Sequence[str] = (),
+                     device=None, **kw) -> Dict[str, Any]:
+    """measured_fusion_rows + peak tables + build, stamped with the
+    backend identity (``degraded: true`` off-TPU — a CPU trace validates
+    the mechanism, not the numbers)."""
+    import jax
+
+    from . import hw
+
+    dev = device if device is not None else jax.devices()[0]
+    rows = measured_fusion_rows(trace_dir, hlo_texts=hlo_texts,
+                                steps=steps)
+    doc = build(rows, steps=steps, wall_ms_per_step=wall_ms_per_step,
+                peak_flops=hw.peak_bf16_flops(dev),
+                peak_hbm_bytes_per_s=hw.peak_hbm_bytes_per_s(dev), **kw)
+    doc["backend"] = str(dev.platform)
+    doc["device_kind"] = str(getattr(dev, "device_kind", dev.platform))
+    doc["degraded"] = dev.platform != "tpu"
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Schema gate + sink
+# ---------------------------------------------------------------------------
+
+_FRACTION_KEYS = ("share_of_busy", "gap_share", "compute_fraction",
+                  "hbm_fraction", "roofline_fraction", "mfu",
+                  "mfu_vs_busy")
+
+
+def validate(doc: Dict[str, Any], require_residue: bool = False) -> None:
+    """The metrics_check gate: schema version, finite numeric values,
+    roofline fractions in [0, 1], residue present when required.  Raises
+    ``AssertionError`` naming the offending field."""
+    assert doc.get("schema_version") == SCHEMA_VERSION, \
+        f"schema_version {doc.get('schema_version')!r}"
+    assert doc.get("mode") in ("train", "decode"), doc.get("mode")
+
+    def _walk(obj, path):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                _walk(v, f"{path}.{k}")
+        elif isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                _walk(v, f"{path}[{i}]")
+        elif isinstance(obj, float):
+            assert math.isfinite(obj), f"non-finite value at {path}"
+
+    _walk(doc, "attribution")
+    for row in [doc] + list(doc.get("fusions", ())) \
+            + [doc.get("step", {})] + list(
+                doc.get("residue", {}).get("groups", ())):
+        for k in _FRACTION_KEYS:
+            v = row.get(k)
+            if v is not None:
+                assert 0.0 <= v <= 1.0, f"{k}={v!r} outside [0,1]"
+    assert doc.get("fusions"), "attribution carries no fusion rows"
+    res = doc.get("residue") or {}
+    assert 0.0 <= res.get("share_of_busy", 0.0) <= 1.0, res
+    if require_residue:
+        assert res.get("count", 0) > 0 and res.get("groups"), \
+            "residue list is empty (the small-op tail must be non-empty " \
+            "on a transformer step)"
+
+
+def write(doc: Dict[str, Any], path: str) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return path

@@ -7,7 +7,8 @@ lesson: the table briefly held v5e's int8 rate and understated every MFU
 """
 from __future__ import annotations
 
-__all__ = ["peak_bf16_flops", "program_train_flops"]
+__all__ = ["peak_bf16_flops", "peak_hbm_bytes_per_s", "ridge_intensity",
+           "program_train_flops"]
 
 # device_kind substring -> peak bf16 FLOP/s
 PEAK_BF16_FLOPS = {
@@ -16,7 +17,17 @@ PEAK_BF16_FLOPS = {
     "v2": 45e12,
 }
 
-_FALLBACK_FLOPS = 1e12  # CPU / unknown accelerator
+# device_kind substring -> peak HBM bandwidth, bytes/s (published per-chip
+# figures; the roofline's other axis — attribution.py divides achieved
+# bytes/s by this to place HBM-bound fusions)
+PEAK_HBM_BYTES_PER_S = {
+    "v6e": 1640e9, "v6 lite": 1640e9, "v5e": 819e9, "v5 lite": 819e9,
+    "v5litepod": 819e9, "v5p": 2765e9, "v4": 1228e9, "v3": 900e9,
+    "v2": 700e9,
+}
+
+_FALLBACK_FLOPS = 1e12    # CPU / unknown accelerator
+_FALLBACK_HBM_BPS = 50e9  # DDR-class fallback so CPU rooflines stay finite
 
 
 def peak_bf16_flops(device=None) -> float:
@@ -30,6 +41,27 @@ def peak_bf16_flops(device=None) -> float:
         if k in kind:
             return v
     return _FALLBACK_FLOPS
+
+
+def peak_hbm_bytes_per_s(device=None) -> float:
+    """Peak HBM bandwidth (bytes/s) for a jax device — the roofline's
+    memory axis, shared by attribution.py the same way the flops table is
+    shared by bench/monitor."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "cpu")).lower()
+    for k, v in PEAK_HBM_BYTES_PER_S.items():
+        if k in kind:
+            return v
+    return _FALLBACK_HBM_BPS
+
+
+def ridge_intensity(device=None) -> float:
+    """The roofline ridge point, flops/byte: above it a kernel is
+    compute-bound, below it HBM-bound (v5e: ~240 flops/byte)."""
+    return peak_bf16_flops(device) / peak_hbm_bytes_per_s(device)
 
 
 def program_train_flops(program, batch: int = 1) -> int:

@@ -910,6 +910,20 @@ def _wrap_step_with_report(step, pcfg: ParallelConfig, report_name: str,
         with _goodput.timer("productive_step"):
             return step(params, opt_state, tokens, labels)
 
+    def _hlo_text():
+        # optimized HLO of the kept AOT executable (None before the first
+        # call / after an AOT fallback) — the roofline attribution
+        # (observability/attribution.py) joins its per-instruction static
+        # costs with the measured device trace
+        if aot["exec"] is None:
+            return None
+        try:
+            return aot["exec"].as_text()
+        except Exception:
+            return None
+
+    step_with_report.report_name = report_name
+    step_with_report.hlo_text = _hlo_text
     return step_with_report
 
 

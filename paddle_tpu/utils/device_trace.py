@@ -104,46 +104,103 @@ def _line_role(name: str, event_names: Iterable[str]) -> str:
     return "ops"
 
 
-def _exclusive_sweep(evs: List[list]) -> Tuple[List[list], int]:
-    """Subtract child spans from their innermost enclosing parent (properly
-    nested spans assumed). Appends r[4] = exclusive duration to every row.
+def _exclusive_segments(evs: List[list]) -> List[list]:
+    """Per-line nested sweep that records each event's EXCLUSIVE time as
+    explicit ``(start, end)`` segments (r[4] = segment list, r[5] = their
+    summed ns).
 
-    Partially overlapping (non-nested) spans can drive a parent's exclusive
-    duration negative; those are clamped to zero and COUNTED (returned as
-    n_clamped) instead of silently dropped, so broken attribution is visible
-    (round-5 advisor, device_trace.py:128).
+    Within one trace line spans nest (parent %while/call envelopes above
+    their children): a parent's open segment closes when a child starts and
+    reopens when the child ends, so a parent's segments cover exactly the
+    wall time no child occupies. A partially overlapping (non-nested) span
+    simply eats the tail of its "parent"'s coverage — nothing goes
+    negative, and the cross-line interval-union pass (:func:`_union_rows`)
+    is what resolves genuinely parallel streams.
     """
     evs.sort(key=lambda r: (r[0], -r[1]))
+    # frame: [end, seg_open, segments, row]
     stack: List[list] = []
+
+    def _close_through(t: float) -> None:
+        while stack and t >= stack[-1][0]:
+            end, seg_open, segs, _row = stack.pop()
+            if end > seg_open:
+                segs.append((seg_open, end))
+            if stack:   # the parent's coverage resumes where the child ended
+                stack[-1][1] = max(stack[-1][1], end)
+
     for r in evs:
-        while stack and r[0] >= stack[-1][0] + stack[-1][1]:
-            stack.pop()
+        start, dur = r[0], r[1]
+        _close_through(start)
         if stack:
-            stack[-1][4] -= r[1]
-        r.append(r[1])     # r[4] = exclusive dur
-        stack.append(r)
-    n_clamped = 0
+            top = stack[-1]
+            if start > top[1]:
+                top[2].append((top[1], start))
+            top[1] = max(top[1], start + dur)
+        segs: List[Tuple[float, float]] = []
+        r.append(segs)
+        stack.append([start + dur, start, segs, r])
+    _close_through(float("inf"))
     for r in evs:
-        if r[4] < 0:
-            r[4] = 0.0
-            n_clamped += 1
-    return evs, n_clamped
+        r.append(sum(e - s for s, e in r[4]))   # r[5] = exclusive ns
+    return evs
+
+
+def _union_rows(rows: List[list]) -> List[list]:
+    """Interval-union exclusive attribution across overlapping lines.
+
+    ``rows`` carry per-line exclusive segments (r[4] from
+    :func:`_exclusive_segments`).  Lines of one device plane can genuinely
+    overlap (parallel streams: multiple op lines, compute vs DMA-adjacent
+    work) — summing their per-line exclusive times then exceeds wall-clock
+    and used to be *refused* outright (the pre-PR-14 behavior), which made
+    every multi-stream trace unattributable.  Instead, sweep the elementary
+    intervals of all segments and split each interval's wall time EQUALLY
+    among the events active in it.  Appends r[6] = attributed ns:
+
+      * serial traces: exactly one event active everywhere -> identical to
+        the plain exclusive sum (r[6] == r[5]);
+      * parallel streams: the attributed total equals the interval UNION,
+        so sum(attributed) <= wall by construction.
+    """
+    bounds = sorted({t for r in rows for seg in r[4] for t in seg})
+    idx = {t: i for i, t in enumerate(bounds)}
+    starts: Dict[int, List[int]] = {}
+    ends: Dict[int, List[int]] = {}
+    for rid, r in enumerate(rows):
+        r.append(0.0)                      # r[6] = union-attributed ns
+        for s, e in r[4]:
+            if e > s:
+                starts.setdefault(idx[s], []).append(rid)
+                ends.setdefault(idx[e], []).append(rid)
+    active: Dict[int, list] = {}
+    for i in range(len(bounds)):
+        for rid in ends.get(i, ()):
+            active.pop(rid, None)
+        for rid in starts.get(i, ()):
+            active[rid] = rows[rid]
+        if i + 1 < len(bounds) and active:
+            share = (bounds[i + 1] - bounds[i]) / len(active)
+            for r in active.values():
+                r[6] += share
+    return rows
 
 
 def _check_busy_le_wall(rows: List[list], where: str,
                         tolerance: float = 1.001) -> bool:
-    """Device planes execute serially: sum(exclusive) must fit in the wall
-    span. Returns False (and warns) when the rows are multi-counted."""
+    """One serial device line keeps sum(exclusive) <= wall. Returns False
+    (and says so) when lines overlap — the interval-union pass then owns
+    the attribution instead of the plain per-line exclusive sums."""
     import sys
 
     if not rows:
         return True
     wall = max(r[0] + r[1] for r in rows) - min(r[0] for r in rows)
-    busy = sum(r[4] for r in rows)
+    busy = sum(r[5] for r in rows)
     if busy > wall * tolerance:
-        print(f"[device_trace] warning: exclusive sum {busy / 1e6:.1f} ms "
-              f"exceeds wall {wall / 1e6:.1f} ms on {where} — events are "
-              f"multi-counted; refusing exclusive attribution",
+        print(f"[device_trace] note: exclusive sum {busy / 1e6:.1f} ms "
+              f"exceeds wall {wall / 1e6:.1f} ms on {where} — overlapping "
+              f"device lines; attributing by interval union",
               file=sys.stderr)
         return False
     return True
@@ -161,12 +218,16 @@ def device_events(trace_dir: str,
     event, a Module event, and its ops). Line roles are detected from the
     OBSERVED line/event names (``_line_role``), not one runtime's labels.
     'XLA Ops' itself nests parent spans (%while, call ops) above their
-    children on the same line; with ``exclusive=True`` each event's duration
-    has its childrens' subtracted, so a sum over all events equals measured
-    device-busy time — and that invariant is CHECKED: a line whose exclusive
-    sum exceeds its wall-clock span is multi-counted, and exclusive
-    attribution for it is refused (with a warning) rather than emitted
-    corrupt (the round-5 PROFILE_STEP.json failure mode).
+    children on the same line; with ``exclusive=True`` each event keeps
+    only the wall time no child covers (per-line nested sweep). When the
+    surviving lines OVERLAP — parallel streams: several op-role lines, or
+    a runtime whose envelope detection is imperfect — per-line exclusive
+    sums exceed the plane's wall span; that situation used to be refused
+    outright (the round-5 PROFILE_STEP.json multi-count defense), which
+    made every multi-stream trace unattributable. Now the plane falls back
+    to INTERVAL-UNION attribution: elementary intervals are split equally
+    among concurrently active events, so the attributed total equals the
+    busy union (<= wall by construction) and serial traces are unchanged.
     """
     import sys
 
@@ -204,11 +265,16 @@ def device_events(trace_dir: str,
                       f" (attribution may overlap)", file=sys.stderr)
         plane_rows: List[list] = []   # device rows held for the plane check
         for line in lines:
-            # execution lines only: TPU device planes, or the CPU client's
-            # runtime line ('XLAPjRtCpuClient' / 'tf_XLATfrtCpuClient' —
-            # the runtime renamed it across releases) — host python/
-            # trace-me lines may carry hlo_op stats too and double-count
-            exec_line = device_plane or "CpuClient" in str(line.name)
+            # execution lines only: TPU device planes, or the CPU
+            # runtime's execution lines — the client thread
+            # ('XLAPjRtCpuClient' / 'tf_XLATfrtCpuClient'; renamed across
+            # releases) AND the Eigen intra-op pool ('tf_XLAEigen/...'),
+            # where the thunk executor actually runs per-instruction work
+            # when it parallelizes (those lines overlap — the
+            # interval-union pass owns that). Host python/trace-me lines
+            # may carry hlo_op stats too and double-count.
+            exec_line = device_plane or "CpuClient" in str(line.name) \
+                or "XLAEigen" in str(line.name)
             if not exec_line:
                 continue
             evs = []
@@ -231,31 +297,26 @@ def device_events(trace_dir: str,
                             str(stats.get("hlo_module", plane.name)),
                             str(hlo_op)])
             if exclusive and evs:
-                # properly nested spans: sweep by start, subtract each
-                # event's duration from its innermost enclosing parent
-                evs, n_clamped = _exclusive_sweep(evs)
-                if n_clamped:
-                    print(f"[device_trace] warning: {n_clamped} event(s) on "
-                          f"'{line.name}' ({plane.name}) had negative "
-                          f"exclusive duration (non-nested overlap); "
-                          f"clamped to 0", file=sys.stderr)
-                if device_plane:
-                    plane_rows.extend(evs)
-                else:
-                    for start, dur, module, hlo_op, excl in evs:
-                        yield module, hlo_op, excl
+                # properly nested spans within the line: each event keeps
+                # explicit exclusive (start, end) coverage segments
+                plane_rows.extend(_exclusive_segments(evs))
             else:
                 for start, dur, module, hlo_op in evs:
                     yield module, hlo_op, dur
         if exclusive and plane_rows:
-            # device-busy invariant: one device executes serially, so the
-            # exclusive sum over everything about to be attributed must fit
-            # in the plane's wall span. A violation means envelope/DMA lines
-            # slipped past role detection (the PROFILE_STEP.json corruption:
-            # busy 4.2x wall) — refuse rather than emit multi-counted rows.
+            # device-busy invariant: one serial timeline keeps the
+            # exclusive sum inside the plane's wall span, and the plain
+            # per-line sums are exact. Overlapping lines (parallel
+            # streams, or envelope lines past role detection) instead go
+            # through interval-union attribution so the total can never
+            # exceed wall (the round-5 PROFILE_STEP.json multi-count was
+            # busy 4.2x wall, emitted as truth).
             if _check_busy_le_wall(plane_rows, str(plane.name)):
-                for start, dur, module, hlo_op, excl in plane_rows:
-                    yield module, hlo_op, excl
+                for r in plane_rows:
+                    yield r[2], r[3], r[5]
+            else:
+                for r in _union_rows(plane_rows):
+                    yield r[2], r[3], r[6]
 
 
 def measured_op_rows(trace_dir: str, hlo_texts: List[str]) -> List[dict]:

@@ -1,0 +1,372 @@
+"""Roofline attribution + perf regression sentinel (ISSUE 14).
+
+Covers: static HLO cost parsing, synthetic-trace roofline math, residue
+ranking determinism, the sentinel's band/cause logic on synthetic
+artifacts, and the real thing — two back-to-back profile_step smoke runs
+A/A-diff clean while a seeded config regression (remat full -> dots) is
+flagged AND attributed to the lever that actually changed.
+"""
+import importlib.util
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import attribution as ATT
+from paddle_tpu.observability import baseline as B
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Static HLO cost parsing
+# ---------------------------------------------------------------------------
+
+HLO_SAMPLE = """\
+HloModule jit_f, is_scheduled=true
+
+%fused_computation (param_0.1: f32[8,32], param_1.2: f32[8,32]) -> f32[8,32] {
+  %param_1.2 = f32[8,32]{1,0} parameter(1)
+  %param_0.1 = f32[8,32]{1,0} parameter(0)
+  %dot.inner = f32[8,32]{1,0} dot(f32[8,16]{1,0} %param_1.2, f32[16,32]{1,0} %param_0.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %add.0 = f32[8,32]{1,0} add(f32[8,32]{1,0} %dot.inner, f32[8,32]{1,0} %param_0.1), metadata={op_name="jit(f)/jit(main)/add_any"}
+}
+
+ENTRY %main.22 (Arg_0.1: f32[8,16], Arg_1.2: f32[16,32]) -> f32[8,32] {
+  %Arg_0.1 = f32[8,16]{1,0} parameter(0)
+  %Arg_1.2 = f32[16,32]{1,0} parameter(1)
+  %dot.8 = f32[8,32]{1,0} dot(f32[8,16]{1,0} %Arg_0.1, f32[16,32]{1,0} %Arg_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(f)/jit(main)/dot_general"}
+  %my_fusion = f32[8,32]{1,0} fusion(f32[8,32]{1,0} %dot.8, f32[8,32]{1,0} %dot.8), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(f)/jit(main)/add_any"}
+  ROOT %copy.1 = f32[8,32]{1,0} copy(f32[8,32]{1,0} %my_fusion)
+}
+"""
+
+
+def test_hlo_instruction_costs_dot_exact():
+    costs = ATT.hlo_instruction_costs(HLO_SAMPLE)
+    # dot [8,16] x [16,32] -> [8,32]: 2 * 8*32 * 16 = 8192 flops
+    assert costs["dot.8"]["flops"] == 8192.0
+    # bytes: operands (8*16 + 16*32) * 4 + output 8*32*4 = 3584
+    assert costs["dot.8"]["bytes"] == 3584
+    assert costs["dot.8"]["opcode"] == "dot"
+
+
+def test_hlo_instruction_costs_fusion_body_flops():
+    costs = ATT.hlo_instruction_costs(HLO_SAMPLE)
+    # the fusion's flops are the dots INSIDE its called computation
+    assert costs["my_fusion"]["flops"] == 8192.0
+    # fusion bytes: its own operands + output (3 x [8,32] f32)
+    assert costs["my_fusion"]["bytes"] == 3 * 8 * 32 * 4
+    # instructions inside non-entry computations are indexed too (device
+    # events name while/scan-body instructions directly)
+    assert costs["dot.inner"]["flops"] == 8192.0
+    # opaque bodies carry no flop claim
+    assert costs["copy.1"]["flops"] == 0.0
+
+
+def test_classify_label_and_stable_key():
+    assert ATT.classify_label("jit(step)/train/opt_update/add", "f.1",
+                              "fusion") == "optimizer"
+    assert ATT.classify_label("jit(step)/layer_norm/reduce", "f.2",
+                              "fusion") == "layernorm"
+    # opcode wins for real matmuls: a wgrad dot's scope says 'transpose'
+    assert ATT.classify_label("jit(step)/transpose", "dot.5",
+                              "dot") == "matmul"
+    assert ATT.classify_label("", "dynamic-slice_convert_fusion.3",
+                              "") == "data_movement"
+    # stable keys survive instruction renumbering across processes
+    assert ATT.stable_key("", "while.81") == ATT.stable_key("", "while.83")
+    assert ATT.stable_key("a/b/c/d/e", "x.1") == \
+        ATT.stable_key("a/b/c/d/e", "x.9")
+
+
+# ---------------------------------------------------------------------------
+# Synthetic-trace roofline math
+# ---------------------------------------------------------------------------
+
+PEAK_F, PEAK_B = 1e12, 1e10   # ridge = 100 flops/byte
+
+
+def _synthetic_rows():
+    return [
+        # compute-bound: intensity 500 >> 100, achieves half of peak
+        {"name": "dot.1", "op_name": "jit(s)/dot_general", "events": 2,
+         "ns": 2000.0, "flops": 5e5, "bytes": 1e3},
+        # hbm-bound: no flops, achieves half of peak bandwidth
+        {"name": "fusion.2", "op_name": "jit(s)/copy_chain", "events": 2,
+         "ns": 2000.0, "flops": 0.0, "bytes": 5e3},
+        # the small-op tail (each < 1% of busy)
+        {"name": "fusion.3", "op_name": "jit(s)/layer_norm/reduce",
+         "events": 2, "ns": 20.0, "flops": 0.0, "bytes": 8.0},
+        {"name": "add.4", "op_name": "jit(s)/add_any", "events": 2,
+         "ns": 16.0, "flops": 0.0, "bytes": 8.0},
+        {"name": "fusion.5", "op_name": "jit(s)/train/opt_update/mul",
+         "events": 2, "ns": 12.0, "flops": 0.0, "bytes": 8.0},
+    ]
+
+
+def test_roofline_math_and_classification():
+    doc = ATT.build(_synthetic_rows(), steps=2, wall_ms_per_step=0.003,
+                    peak_flops=PEAK_F, peak_hbm_bytes_per_s=PEAK_B,
+                    step_flops=1e6, step_bytes=1.2e4)
+    by = {r["name"]: r for r in doc["fusions"]}
+    dot = by["dot.1"]
+    assert dot["bound"] == "compute"
+    assert dot["intensity"] == 500.0
+    # 5e5 flops x 2 events / 2e-6 s = 5e11 -> half of the 1e12 roof
+    assert abs(dot["roofline_fraction"] - 0.5) < 1e-6
+    mem = by["fusion.2"]
+    assert mem["bound"] == "hbm"
+    assert abs(mem["roofline_fraction"] - 0.5) < 1e-6
+    assert mem["compute_fraction"] is None or mem["compute_fraction"] == 0
+    # busy = 4048ns total / 2 steps = 2024 ns -> 0.002024 ms
+    assert abs(doc["device_busy_ms_per_step"] - 0.002024) < 1e-9
+    # gap = wall - busy
+    assert abs(doc["gap_ms_per_step"]
+               - (0.003 - 0.002024)) < 1e-9
+    assert 0.0 <= doc["gap_share"] <= 1.0
+    # whole-step placement: intensity 1e6/1.2e4 ~ 83 < ridge -> hbm
+    assert doc["step"]["bound"] == "hbm"
+    ATT.validate(doc, require_residue=True)
+
+
+def test_residue_ranking_and_determinism():
+    rows = _synthetic_rows()
+    docs = []
+    for seed in (0, 1, 2):
+        shuffled = list(rows)
+        random.Random(seed).shuffle(shuffled)
+        docs.append(ATT.build(
+            shuffled, steps=2, wall_ms_per_step=0.003,
+            peak_flops=PEAK_F, peak_hbm_bytes_per_s=PEAK_B))
+    res = docs[0]["residue"]
+    assert res["count"] == 3
+    # ranked by aggregate time: layernorm(20) > elementwise(16) >
+    # optimizer(12)
+    assert [g["label"] for g in res["groups"]] == \
+        ["layernorm", "elementwise", "optimizer"]
+    assert res["groups"][0]["top_ops"] == ["fusion.3"]
+    # deterministic under input order shuffles — byte-identical docs
+    strip = lambda d: json.dumps(
+        {k: v for k, v in d.items() if k != "generated_at"},
+        sort_keys=True)
+    assert strip(docs[0]) == strip(docs[1]) == strip(docs[2])
+
+
+def test_validate_rejects_bad_docs():
+    doc = ATT.build(_synthetic_rows(), steps=2, wall_ms_per_step=0.003,
+                    peak_flops=PEAK_F, peak_hbm_bytes_per_s=PEAK_B)
+    bad = json.loads(json.dumps(doc))
+    bad["schema_version"] = 99
+    with pytest.raises(AssertionError):
+        ATT.validate(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["fusions"][0]["roofline_fraction"] = 1.7
+    with pytest.raises(AssertionError):
+        ATT.validate(bad)
+    bad = json.loads(json.dumps(doc))
+    bad["gap_share"] = float("nan")
+    with pytest.raises(AssertionError):
+        ATT.validate(bad)
+    # empty residue only fails when the caller requires one
+    lone = ATT.build([_synthetic_rows()[0]], steps=1,
+                     wall_ms_per_step=0.01, peak_flops=PEAK_F,
+                     peak_hbm_bytes_per_s=PEAK_B)
+    ATT.validate(lone)
+    with pytest.raises(AssertionError):
+        ATT.validate(lone, require_residue=True)
+
+
+# ---------------------------------------------------------------------------
+# Sentinel band + cause logic on synthetic artifacts
+# ---------------------------------------------------------------------------
+
+def _attr_doc(flops=1e6, remat="full", fused_opt=True, extra_cfg=None,
+              slow_fusion=None):
+    rows = _synthetic_rows()
+    if slow_fusion:
+        for r in rows:
+            if r["name"] == slow_fusion:
+                r["ns"] *= 3.0
+    cfg = {"mode": "train", "remat": remat, "fused_opt": fused_opt}
+    cfg.update(extra_cfg or {})
+    return ATT.build(
+        rows, steps=2, wall_ms_per_step=0.003, peak_flops=PEAK_F,
+        peak_hbm_bytes_per_s=PEAK_B, step_flops=flops, step_bytes=1.2e4,
+        programs=[{"program": "parallel_train_step", "flops": flops,
+                   "bytes_accessed": 1.2e4, "compile_ms": 100.0}],
+        config=cfg)
+
+
+def test_sentinel_aa_identical_artifacts_clean():
+    base = B.make_baseline({"attribution": _attr_doc()},
+                           lane="cpu_smoke", degraded=True)
+    report = B.compare({"attribution": _attr_doc()}, base)
+    assert report["ok"], (report["out_of_band"],
+                          report["structural_failures"])
+    assert report["checked"] > 10
+    assert not report["config_changes"]
+
+
+def test_sentinel_flags_config_lever_and_static_fact():
+    base = B.make_baseline({"attribution": _attr_doc()},
+                           lane="cpu_smoke", degraded=True)
+    # the seeded regression: remat lever flipped AND the compiler fact
+    # (step flops) moved with it — out-of-band, attributed to the lever
+    cur = {"attribution": _attr_doc(flops=1.3e6, remat="dots")}
+    report = B.compare(cur, base)
+    assert not report["ok"]
+    assert any(c["lever"] == "remat" for c in report["config_changes"])
+    oob = {b["metric"]: b for b in report["out_of_band"]}
+    assert "attribution.step.flops" in oob
+    assert oob["attribution.step.flops"]["cause"]["kind"] == \
+        "config_lever"
+    assert "remat" in oob["attribution.step.flops"]["cause"]["detail"]
+
+
+def test_sentinel_degraded_demotes_timing_to_structural():
+    base = B.make_baseline({"attribution": _attr_doc()},
+                           lane="cpu_smoke", degraded=True)
+    # a 3x slower fusion moves busy/wall/fusion timings — on the
+    # degraded lane those are structural-only, so the diff stays clean
+    report = B.compare({"attribution": _attr_doc(
+        slow_fusion="fusion.2")}, base)
+    assert report["ok"], report["out_of_band"]
+    # ...but on a non-degraded (chip) baseline the same change is
+    # flagged and attributed to the named fusion
+    base_tpu = B.make_baseline({"attribution": _attr_doc()},
+                               lane="tpu", degraded=False)
+    report = B.compare({"attribution": _attr_doc(
+        slow_fusion="fusion.2")}, base_tpu)
+    assert not report["ok"]
+    fusion_oob = [b for b in report["out_of_band"]
+                  if "fusion" in b["metric"]]
+    assert fusion_oob, report["out_of_band"]
+    kinds = {b["cause"]["kind"] for b in report["out_of_band"]}
+    assert "fusion" in kinds
+
+
+def test_sentinel_fused_opt_off_attributed():
+    base = B.make_baseline({"attribution": _attr_doc()},
+                           lane="cpu_smoke", degraded=True)
+    report = B.compare(
+        {"attribution": _attr_doc(flops=1.05e6, fused_opt=False)}, base)
+    assert not report["ok"]
+    assert any(c["lever"] == "fused_opt"
+               for c in report["config_changes"])
+    for b in report["out_of_band"] + report["structural_failures"]:
+        if b["metric"] == "config.fused_opt":
+            assert b["cause"]["kind"] == "config_lever"
+            break
+    else:
+        pytest.fail("config.fused_opt not flagged")
+
+
+def test_compare_goodput_bands():
+    a = {"wall_s": 100.0, "categories": {"productive_step": 90.0,
+                                         "compile": 6.0, "other": 4.0}}
+    ok = B.compare_goodput(a, json.loads(json.dumps(a)))
+    assert ok["ok"] and ok["out_of_band"] == 0
+    b = {"wall_s": 100.0, "categories": {"productive_step": 70.0,
+                                         "compile": 26.0, "other": 4.0}}
+    bad = B.compare_goodput(a, b)
+    assert not bad["ok"]
+    worst = bad["rows"][0]
+    assert worst["category"] == "compile" and worst["out_of_band"]
+
+
+# ---------------------------------------------------------------------------
+# The real thing: profiled smoke runs through the whole pipeline
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def smoke_runs(tmp_path_factory):
+    """Two back-to-back profile_step smoke runs (one warmed compile,
+    traced twice) + one run with the remat lever flipped — two tiny-GPT
+    compiles total, module-scoped."""
+    ps = _load_tool("profile_step")
+    td = tmp_path_factory.mktemp("attr")
+    (_, doc_a), (_, doc_b) = ps.train_profile(
+        ps.SMOKE_SPEC, str(td / "trace_aa"), steps=3,
+        attr_out=str(td / "ATTR_aa.json"),
+        profile_out=str(td / "PROFILE_aa.json"), runs=2)
+    _prof, doc_dots = ps.train_profile(
+        ps.SMOKE_SPEC + ",remat=dots", str(td / "trace_dots"), steps=3,
+        attr_out=str(td / "ATTR_dots.json"),
+        profile_out=str(td / "PROFILE_dots.json"))
+    return {"a": doc_a, "b": doc_b, "dots": doc_dots}
+
+
+def test_smoke_attribution_schema_and_residue(smoke_runs):
+    doc = smoke_runs["a"]
+    ATT.validate(doc, require_residue=True)
+    assert doc["degraded"] is True          # CPU lane
+    labels = {g["label"] for g in doc["residue"]["groups"]}
+    # the KERNEL_NOTES small-op tail by name: layernorm grads, adds,
+    # the optimizer update
+    assert {"layernorm", "elementwise", "optimizer"} <= labels, labels
+    assert doc["step"]["flops"] and doc["step"]["flops"] > 0
+
+
+def test_aa_two_smoke_runs_diff_clean(smoke_runs):
+    """Acceptance: two back-to-back smoke runs diff clean — zero
+    out-of-band metrics."""
+    base = B.make_baseline({"attribution": smoke_runs["a"]},
+                           lane="cpu_smoke")
+    assert base["degraded"] is True
+    report = B.compare({"attribution": smoke_runs["b"]}, base)
+    assert report["out_of_band"] == [], report["out_of_band"]
+    assert report["structural_failures"] == [], \
+        report["structural_failures"]
+    assert report["ok"] and not report["config_changes"]
+
+
+def test_seeded_remat_regression_flagged_and_attributed(smoke_runs):
+    """Acceptance: a seeded config regression (remat full -> dots)
+    produces a failing diff whose cause names the actual lever."""
+    base = B.make_baseline({"attribution": smoke_runs["a"]},
+                           lane="cpu_smoke")
+    report = B.compare({"attribution": smoke_runs["dots"]}, base)
+    assert not report["ok"]
+    assert any(c["lever"] == "remat" and c["value"] == "dots"
+               for c in report["config_changes"])
+    flagged = report["out_of_band"] + report["structural_failures"]
+    assert flagged
+    remat_attributed = [b for b in flagged
+                        if b["cause"]["kind"] == "config_lever"
+                        and "remat" in b["cause"]["detail"]]
+    assert remat_attributed, flagged
+    # the compiler fact moved with the lever (remat=dots recomputes
+    # fewer dots -> fewer cost_analysis flops)
+    assert any(b["metric"].endswith(".flops")
+               for b in report["out_of_band"]), report["out_of_band"]
+
+
+def test_perf_diff_cli_against_committed_baseline(smoke_runs, tmp_path):
+    """Tier-1 perf_diff smoke: a fresh smoke run diffs clean against the
+    COMMITTED PERF_BASELINE.json (CPU lane, degraded bands)."""
+    committed = os.path.join(REPO, "PERF_BASELINE.json")
+    assert os.path.exists(committed), \
+        "PERF_BASELINE.json is not committed at the repo root"
+    attr_path = tmp_path / "ATTRIBUTION.json"
+    ATT.write(smoke_runs["b"], str(attr_path))
+    pd = _load_tool("perf_diff")
+    rc = pd.main(["--baseline", committed,
+                  "--attribution", str(attr_path),
+                  "--out", str(tmp_path / "REGRESSION.json")])
+    report = json.loads((tmp_path / "REGRESSION.json").read_text())
+    assert rc == 0, (report["out_of_band"],
+                     report["structural_failures"])
+    assert report["ok"] and report["checked"] > 10
+    # the committed bench artifacts diff against themselves in-band
+    assert not report["config_changes"]

@@ -13,10 +13,19 @@ import paddle_tpu as fluid
 from paddle_tpu import layers
 
 
+_EXE = None
+
+
 def _run(prog, feed, fetch, scope=None):
-    exe = fluid.Executor(fluid.CPUPlace())
+    # ONE shared executor: a fresh Executor per call re-pays the full
+    # slow dispatch path every step (~0.85 s/call here — the training
+    # loops in this file ran it 60x), where the shared instance hits the
+    # PR 1 dispatch record after the first step
+    global _EXE
+    if _EXE is None:
+        _EXE = fluid.Executor(fluid.CPUPlace())
     return [np.asarray(v) for v in
-            exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)]
+            _EXE.run(prog, feed=feed, fetch_list=fetch, scope=scope)]
 
 
 def _brute_crf(emission, transition, label, length):

@@ -40,35 +40,62 @@ def test_line_role_detection_from_names():
     assert role("Line#4", ["fusion.1", "%while", "dot.3"]) == "ops"
 
 
-def test_exclusive_sweep_clamps_negative_and_counts():
-    """ADVICE r5 (device_trace.py:128): partially overlapping (non-nested)
-    spans drove a parent's exclusive duration negative and it was silently
-    dropped; now it is clamped to zero and counted."""
-    # parent [0,100); child A [10,70); child B [50,130) overlaps A
+def test_exclusive_segments_nested():
+    """Properly nested spans: the parent keeps exactly the wall time no
+    child covers, as explicit (start, end) segments."""
+    # parent [0,100); child A [10,30); grandchild [15,25); child B [60,90)
     evs = [[0.0, 100.0, "m", "p"],
-           [10.0, 60.0, "m", "a"],
-           [50.0, 80.0, "m", "b"]]
-    rows, n_clamped = device_trace._exclusive_sweep(evs)
-    assert n_clamped == 1
-    by_op = {r[3]: r[4] for r in rows}
-    assert by_op["a"] == 0.0          # clamped, not dropped
-    assert by_op["p"] == 40.0
-    assert by_op["b"] == 80.0
-    # clamped total still fits in the wall span
+           [10.0, 20.0, "m", "a"],
+           [15.0, 10.0, "m", "g"],
+           [60.0, 30.0, "m", "b"]]
+    rows = device_trace._exclusive_segments(evs)
+    by_op = {r[3]: (r[4], r[5]) for r in rows}
+    assert by_op["p"][0] == [(0.0, 10.0), (30.0, 60.0), (90.0, 100.0)]
+    assert by_op["p"][1] == 50.0
+    assert by_op["a"][0] == [(10.0, 15.0), (25.0, 30.0)]
+    assert by_op["a"][1] == 10.0
+    assert by_op["g"][1] == 10.0 and by_op["b"][1] == 30.0
+    # serial nested line: exclusive sums fit the wall span exactly
     assert device_trace._check_busy_le_wall(rows, "test-plane")
+    assert sum(v[1] for v in by_op.values()) == 100.0
 
 
-def test_busy_le_wall_refuses_multicounted_rows(capsys):
-    """ADVICE r5: busy 4.2x wall (envelope+DMA rows multi-counted) must be
-    refused, not emitted as 'measured exclusive per-op device time'."""
-    # two full-span copies of the same 100ns step as seen from an envelope
-    # line that slipped through: exclusive sum 300 vs wall 100
-    rows = [[0.0, 100.0, "m", "step_env", 100.0],
-            [0.0, 100.0, "m", "module_env", 100.0],
-            [0.0, 100.0, "m", "op", 100.0]]
+def test_union_rows_splits_parallel_streams(capsys):
+    """ISSUE 14 satellite: overlapping device lines (parallel streams) get
+    interval-union exclusive attribution — each elementary interval splits
+    equally among the active events and the attributed total equals the
+    busy UNION — instead of the old refuse-when-busy>wall behavior (the
+    PROFILE_STEP.json multi-count defense, which made every multi-stream
+    trace unattributable)."""
+    # stream 1: p [0,100) with child a [10,70); stream 2: b [50,130)
+    line1 = device_trace._exclusive_segments(
+        [[0.0, 100.0, "m", "p"], [10.0, 60.0, "m", "a"]])
+    line2 = device_trace._exclusive_segments([[50.0, 80.0, "m", "b"]])
+    rows = line1 + line2
+    # per-line exclusive sums overlap across lines: 100 + 80 > wall 130
     assert not device_trace._check_busy_le_wall(rows, "test-plane")
     err = capsys.readouterr().err
-    assert "refusing exclusive attribution" in err
+    assert "interval union" in err
+    by_op = {r[3]: r[6] for r in device_trace._union_rows(rows)}
+    # [0,10) p | [10,50) a | [50,70) a,b split | [70,100) p,b split |
+    # [100,130) b
+    assert by_op["p"] == 10.0 + 15.0
+    assert by_op["a"] == 40.0 + 10.0
+    assert by_op["b"] == 10.0 + 15.0 + 30.0
+    # the attributed total is exactly the interval union (== wall here)
+    assert sum(by_op.values()) == 130.0
+
+
+def test_union_rows_serial_identity():
+    """On a serial trace the union attribution is the plain exclusive sum
+    (one active event everywhere) — the fallback changes nothing when the
+    old invariant holds."""
+    rows = device_trace._exclusive_segments(
+        [[0.0, 100.0, "m", "p"], [10.0, 20.0, "m", "a"],
+         [60.0, 30.0, "m", "b"]])
+    out = device_trace._union_rows(rows)
+    for r in out:
+        assert r[6] == r[5], (r[3], r[6], r[5])
 
 
 def test_profiler_measured_attribution(tmp_path, capsys, monkeypatch):

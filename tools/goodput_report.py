@@ -10,6 +10,14 @@ dir, or for a single-process run that exported its ledger via
   python tools/goodput_report.py --dir LOGDIR/goodput \\
       [--out GOODPUT.json] [--restart-downtime S] [--nranks N]
 
+``--diff A.json B.json`` instead compares two goodput reports (rank
+windows or gang GOODPUT.json) per category, reusing the perf-sentinel's
+band arithmetic (observability/baseline.py, ISSUE 14): a category is
+out-of-band when its wall-share moved more than
+``tol_rel * share_A + tol_abs`` in the worse direction (productive_step
+down, any overhead category up).  Non-zero exit on any out-of-band
+category — "which category grew" as a gate, not a spreadsheet.
+
 The report (schema in docs/observability.md "Goodput & tracing"):
 
   {
@@ -34,9 +42,39 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def diff_reports(path_a: str, path_b: str, tol_rel: float,
+                 tol_abs: float) -> int:
+    from paddle_tpu.observability import baseline as B
+
+    with open(path_a) as f:
+        a = json.load(f)
+    with open(path_b) as f:
+        b = json.load(f)
+    out = B.compare_goodput(a, b, tol_rel=tol_rel, tol_abs_share=tol_abs)
+    print(f"{'category':<18}{'A share':>9}{'B share':>9}{'delta':>9}"
+          f"{'band':>8}  flag")
+    for r in out["rows"]:
+        flag = "OUT-OF-BAND" if r["out_of_band"] else ""
+        print(f"{r['category']:<18}{r['share_a']:>9.4f}"
+              f"{r['share_b']:>9.4f}{r['delta_share']:>+9.4f}"
+              f"{r['band']:>8.4f}  {flag}")
+    print(f"[goodput_report] wall {out['wall_s_a']:.3f}s -> "
+          f"{out['wall_s_b']:.3f}s, {out['out_of_band']} categor"
+          f"{'y' if out['out_of_band'] == 1 else 'ies'} out of band",
+          file=sys.stderr)
+    return 0 if out["ok"] else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--dir", required=True,
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="compare two goodput reports per category "
+                         "instead of aggregating a rank dir")
+    ap.add_argument("--tol-rel", type=float, default=0.25,
+                    help="--diff: relative share band per category")
+    ap.add_argument("--tol-abs", type=float, default=0.02,
+                    help="--diff: absolute share band floor")
+    ap.add_argument("--dir", default=None,
                     help="goodput dir holding goodput.rank*.json")
     ap.add_argument("--out", default=None,
                     help="output path (default: <dir>/GOODPUT.json)")
@@ -47,6 +85,12 @@ def main():
     ap.add_argument("--max-unaccounted", type=float, default=0.05,
                     help="fail when other/total exceeds this fraction")
     args = ap.parse_args()
+
+    if args.diff:
+        return diff_reports(args.diff[0], args.diff[1], args.tol_rel,
+                            args.tol_abs)
+    if not args.dir:
+        ap.error("--dir is required (or use --diff A.json B.json)")
 
     from paddle_tpu.observability import goodput
 

@@ -20,7 +20,10 @@ then asserts:
     carries the per-step ``goodput_ms`` breakdown;
   * the serving smoke leaves complete request traces (root span +
     queue-wait/prefill/decode-tick/evict children, no orphans, no
-    cross-request leakage) and the queue-wait histogram.
+    cross-request leakage) and the queue-wait histogram;
+  * the roofline attribution (ISSUE 14) of a profiled tiny-GPT step
+    passes its schema gate: version stamp, finite values, fractions in
+    [0,1], non-empty residue naming the layernorm/add/optimizer tail.
 
 Wired into tier-1 as tests/test_metrics_check.py (``-m 'not slow'``), so
 the telemetry path is exercised end-to-end on every run. Standalone:
@@ -644,6 +647,55 @@ def _run_check_inner(out_dir: str) -> dict:
     assert sspec.stats.acceptance_rate == 1.0, \
         f"self-draft acceptance {sspec.stats.acceptance_rate} != 1.0"
 
+    # --- roofline attribution gate (ISSUE 14, docs/observability.md) ----
+    # profile a decode tick of the ALREADY-WARMED GPT serving engine
+    # (zero extra compiles — the train-step attribution twin, with its
+    # layernorm-grad/add/optimizer residue assertions, runs its own
+    # compiles in tests/test_attribution.py) and gate the
+    # ATTRIBUTION.json schema: version stamp, finite values, roofline
+    # fractions in [0,1], and a NON-EMPTY residue list
+    from paddle_tpu.observability import attribution as ATT
+    from paddle_tpu.observability import program_report as prep_mod
+
+    aslot, alogits = sengine.start_sequence([3, 5, 7])
+    atok = int(np.argmax(alogits))
+    atrace = os.path.join(out_dir, "attr_trace")
+    import time as _t
+
+    t0 = _t.perf_counter()
+    with jax.profiler.trace(atrace):
+        for _ in range(4):
+            aout = sengine.decode_step({aslot: atok})
+            atok = int(np.argmax(aout[aslot]))
+    awall_ms = (_t.perf_counter() - t0) * 1e3 / 4
+    sengine.free_sequence(aslot)
+    try:
+        ahlo = sengine._exec["decode"].as_text()
+    except Exception:
+        ahlo = None
+    arep = next((r for r in reversed(prep_mod.recent_reports())
+                 if r.get("program") == "serve/decode"), {})
+    attr_doc = ATT.build_from_trace(
+        atrace, steps=4, wall_ms_per_step=awall_ms,
+        hlo_texts=[ahlo] if ahlo else [], mode="decode",
+        spec="metrics_check_gpt_decode_smoke",
+        step_flops=arep.get("flops"),
+        step_bytes=arep.get("bytes_accessed"),
+        programs=[arep] if arep else None,
+        config={"mode": "decode", "weight_dtype": "f32",
+                "kv_layout": "slab"},
+        generated_by="tools/metrics_check.py")
+    # the schema gate proper: raises naming the offending field
+    ATT.validate(attr_doc, require_residue=True)
+    attr_labels = {g["label"] for g in attr_doc["residue"]["groups"]}
+    assert attr_labels & {"layernorm", "elementwise", "data_movement",
+                          "matmul"}, \
+        f"GPT decode-smoke residue ranking carries no recognizable " \
+        f"small-op labels: {sorted(attr_labels)}"
+    assert attr_doc["degraded"] is (jax.devices()[0].platform != "tpu")
+    apath = os.path.join(out_dir, "ATTRIBUTION.json")
+    ATT.write(attr_doc, apath)
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -732,6 +784,14 @@ def _run_check_inner(out_dir: str) -> dict:
                              "repeat_prefill_tokens": int(d2)},
             "spec_acceptance_rate": round(sspec.stats.acceptance_rate, 4),
             "program_reports": len(reports),
+            "attribution": {
+                "path": apath,
+                "fusions": int(attr_doc["fusion_count"]),
+                "residue_count": int(attr_doc["residue"]["count"]),
+                "residue_share": attr_doc["residue"]["share_of_busy"],
+                "residue_groups": [g["label"] for g in
+                                   attr_doc["residue"]["groups"][:6]],
+            },
             "checkpoint_steps": committed,
             "checkpoint_bytes": ckpt_bytes,
             "lint_findings": lint_after,

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Perf regression sentinel CLI: diff a run's artifacts against the
+committed PERF_BASELINE.json (ISSUE 14; core logic in
+paddle_tpu/observability/baseline.py, schema in docs/observability.md).
+
+  python tools/perf_diff.py                      # repo-root artifacts
+  python tools/perf_diff.py --attribution ATTRIBUTION.json \\
+      --goodput LOGDIR/goodput/GOODPUT.json --monitor steps.jsonl \\
+      --serve SERVE_BENCH.json --out REGRESSION.json
+  python tools/perf_diff.py --update-baseline --lane tpu \\
+      --baseline PERF_BASELINE_tpu.json
+
+Every metric in the baseline that the run's artifacts cover is checked
+against its tolerance band (artifact files absent from this run are
+skipped and listed, not failed).  On a ``degraded: true`` baseline (the
+CPU smoke lane) timing/count metrics demote to structural checks —
+present and finite — while deterministic compiler facts (flops, bytes,
+wire-byte ratios), exact counters (steady-state recompiles) and flags
+keep their bands.  Each out-of-band metric is attributed to a cause: a
+config lever changed, a goodput category grew, a named executable's
+bytes/compile-ms moved, a new recompile cause, a named fusion slower,
+residue share up.  Writes REGRESSION.json and exits non-zero on any
+out-of-band or structural failure.  ``--update-baseline`` re-records the
+baseline from this run instead of diffing.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _default(path):
+    p = os.path.join(REPO, path)
+    return p if os.path.exists(p) else None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="perf regression sentinel (docs/observability.md)")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "PERF_BASELINE.json"))
+    ap.add_argument("--attribution",
+                    default=_default("ATTRIBUTION.json"))
+    ap.add_argument("--goodput", default=_default("GOODPUT.json"))
+    ap.add_argument("--monitor", default=None,
+                    help="TrainMonitor JSONL (per-step rollups)")
+    ap.add_argument("--dispatch", default=_default("DISPATCH_BENCH.json"))
+    ap.add_argument("--comm", default=_default("COMM_BENCH.json"))
+    ap.add_argument("--serve", default=_default("SERVE_BENCH.json"))
+    ap.add_argument("--bench", default=None,
+                    help="bench.py headline JSON")
+    ap.add_argument("--programs", nargs="*", default=(),
+                    help="program-report JSONL file(s)")
+    ap.add_argument("--out", default=os.path.join(REPO, "REGRESSION.json"))
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record the baseline from this run's "
+                         "artifacts instead of diffing")
+    ap.add_argument("--lane", default=None,
+                    help="baseline lane label (default: tpu when the "
+                         "attribution is non-degraded, else cpu_smoke)")
+    ap.add_argument("--notes", default="")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability import baseline as B
+
+    artifacts = B.load_artifacts(
+        attribution=args.attribution, goodput=args.goodput,
+        monitor=args.monitor, dispatch=args.dispatch, comm=args.comm,
+        serve=args.serve, bench=args.bench, programs=args.programs)
+    present = sorted(k for k, v in artifacts.items() if v)
+    if not present:
+        print("[perf_diff] no artifacts found — nothing to diff",
+              file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        att = artifacts.get("attribution") or {}
+        lane = args.lane or ("tpu" if att.get("degraded") is False
+                             else "cpu_smoke")
+        doc = B.make_baseline(artifacts, lane=lane, notes=args.notes)
+        tmp = args.baseline + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.baseline)
+        print(f"[perf_diff] baseline re-recorded: {args.baseline} "
+              f"(lane={lane}, degraded={doc['degraded']}, "
+              f"{len(doc['metrics'])} metrics from {present})")
+        return 0
+
+    base = B.load_json(args.baseline)
+    if base is None:
+        print(f"[perf_diff] no baseline at {args.baseline} — run with "
+              f"--update-baseline to record one", file=sys.stderr)
+        return 2
+
+    report = B.compare(artifacts, base, out_path=args.out)
+    print(f"[perf_diff] lane={report['baseline_lane']} "
+          f"degraded={report['degraded']} checked={report['checked']} "
+          f"artifacts={present}")
+    for ch in report["config_changes"]:
+        print(f"[perf_diff] CONFIG: lever {ch['lever']!r} "
+              f"{ch['baseline']!r} -> {ch['value']!r}")
+    for bad in report["structural_failures"]:
+        print(f"[perf_diff] STRUCTURAL {bad['metric']}: "
+              f"value={bad.get('value')!r} "
+              f"baseline={bad.get('baseline')!r} "
+              f"({bad.get('detail', bad.get('check'))}) "
+              f"<- {bad['cause']['detail']}")
+    for bad in report["out_of_band"]:
+        print(f"[perf_diff] OUT-OF-BAND {bad['metric']}: "
+              f"{bad['baseline']:.6g} -> {bad['value']:.6g} "
+              f"(band {bad['band']:.3g}, {bad['direction']}) "
+              f"<- {bad['cause']['detail']}")
+    if report["skipped_missing_artifact"]:
+        n = len(report["skipped_missing_artifact"])
+        print(f"[perf_diff] skipped {n} metric(s) whose artifact this "
+              f"run did not produce")
+    if report["ok"]:
+        print(f"[perf_diff] OK — no regressions "
+              f"(wrote {report.get('path')})")
+        return 0
+    print(f"[perf_diff] FAIL — {len(report['out_of_band'])} out-of-band, "
+          f"{len(report['structural_failures'])} structural "
+          f"(wrote {report.get('path')})", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

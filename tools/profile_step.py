@@ -1,17 +1,28 @@
 #!/usr/bin/env python
-"""Measured device-time breakdown of the flagship GPT train step.
+"""Measured device-time breakdown + roofline attribution of a step.
 
-Captures a jax.profiler xplane trace of N steps at a sweep-spec config
-(tools/mfu_sweep.py spec grammar), then aggregates per-HLO-op measured
-device nanoseconds so the MFU gap decomposes into named sinks: flash
-attention kernel, the fc matmuls, chunked-CE, the Adam fusion, and
-inter-op gaps (wall - device busy).
+Train mode captures a jax.profiler xplane trace of N steps of the
+flagship GPT train step at a sweep-spec config (tools/mfu_sweep.py spec
+grammar), aggregates per-HLO-op measured device nanoseconds (the legacy
+PROFILE_STEP.json view), and — new in ISSUE 14 — joins the measured
+per-fusion time with the static HLO flops/bytes and the hw.py peak
+tables into a schema-versioned ATTRIBUTION.json: every fusion placed on
+the roofline, inter-op gap share, and the ranked small-op residue list
+(ROADMAP item 3's megakernel target list).
+
+Serve mode (``--serve``) profiles a warmed DecodeEngine decode tick
+through the same attribution path, emitting the decode residue ranking
+ROADMAP item 3(b) needs.
 
 Usage:
   python tools/profile_step.py [spec] [--steps 6] [--dir /tmp/gpt-trace]
+      [--attr-out ATTRIBUTION.json]
+  python tools/profile_step.py --smoke          # tiny CPU-sized lane
+  python tools/profile_step.py --serve [--ticks 16] [--attr-out PATH]
 
 Reference analogue: platform/device_tracer.cc (CUPTI per-kernel times);
-here the XLA device plane carries the measured per-fusion times.
+here the XLA device plane carries the measured per-fusion times and the
+optimized HLO text carries the static costs.
 """
 import json
 import os
@@ -21,27 +32,41 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+SMOKE_SPEC = "d=32,L=2,nh=2,ff=64,b=2,T=16,vocab=512,steps=3"
+DEFAULT_SPEC = "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824"
 
-def main():
-    spec_str = sys.argv[1] if len(sys.argv) > 1 and "=" in sys.argv[1] else \
-        "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824"
-    trace_dir = "/tmp/gpt-trace"
-    if "--dir" in sys.argv:
-        trace_dir = sys.argv[sys.argv.index("--dir") + 1]
 
+def _flag(name, default=None, cast=str):
+    if name in sys.argv:
+        return cast(sys.argv[sys.argv.index(name) + 1])
+    return default
+
+
+def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
+                  attr_out: str = None, profile_out: str = None,
+                  runs: int = 1):
+    """Profile the GPT train step at ``spec_str``; returns (profile doc,
+    attribution doc) and writes PROFILE_STEP.json + ATTRIBUTION.json.
+
+    ``runs > 1`` traces the SAME warmed step that many times (one
+    compile) and returns a list of (profile, attribution) pairs — the
+    A/A-stability gate in tests/test_attribution.py diffs two
+    back-to-back runs without paying a second compile; the JSON sinks
+    record the last run."""
     import numpy as np
     import jax
 
     from paddle_tpu.models import gpt as G
+    from paddle_tpu.observability import attribution as ATT
+    from paddle_tpu.observability import goodput as GP
+    from paddle_tpu.observability import program_report as PREP
     from paddle_tpu.parallel import parallelize as PZ
     from paddle_tpu.utils import device_trace as DT
 
     spec = dict(kv.split("=") for kv in spec_str.split(","))
     batch = int(spec.get("b", 16))
     T = int(spec.get("T", 1024))
-    steps = int(spec.get("steps", 6))
-    if "--steps" in sys.argv:
-        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    steps = int(spec.get("steps", steps))
     bq, bk = int(spec.get("bq", 512)), int(spec.get("bk", 512))
     if bq != 512 or bk != 512:
         # route the spec's flash tile sizes through the default entry
@@ -59,7 +84,7 @@ def main():
         PK.flash_attention = patched
     unknown = set(spec) - {"b", "T", "steps", "bq", "bk", "d", "L", "ff",
                            "nh", "remat", "celim", "flash", "scan", "mom",
-                           "chunk"}
+                           "chunk", "vocab"}
     if unknown:
         raise SystemExit(f"profile_step: unknown spec keys {sorted(unknown)}")
     kw = dict(
@@ -74,6 +99,8 @@ def main():
     )
     if "nh" in spec:
         kw["num_heads"] = int(spec["nh"])
+    if "vocab" in spec:
+        kw["vocab_size"] = int(spec["vocab"])
     if "celim" in spec:
         kw["ce_direct_bytes_limit"] = int(spec["celim"])
     if "chunk" in spec:
@@ -96,43 +123,197 @@ def main():
     params, opt, loss, _ = step(params, opt, tokens, labels)
     float(loss)
 
-    print(f"[profile] tracing {steps} steps", file=sys.stderr, flush=True)
+    hlo = step.hlo_text() if hasattr(step, "hlo_text") else None
+    report = next((r for r in reversed(PREP.recent_reports())
+                   if r.get("program") == getattr(step, "report_name",
+                                                  None)), {})
+    config = {
+        "mode": "train", "spec": spec_str,
+        "remat": spec.get("remat", "full"),
+        "flash": spec.get("flash", "1") == "1",
+        "scan": spec.get("scan", "1") == "1",
+        "moment_dtype": spec.get("mom", "f32"),
+        "ce_chunk": int(spec.get("chunk", 0)),
+        "batch": batch, "seq": T,
+        "d_model": cfg.d_model, "layers": cfg.num_layers,
+        "fused_opt": False,
+    }
+
+    results = []
+    for run_i in range(max(1, runs)):
+        tdir = trace_dir if runs <= 1 else f"{trace_dir}_r{run_i}"
+        print(f"[profile] tracing {steps} steps"
+              + (f" (run {run_i + 1}/{runs})" if runs > 1 else ""),
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        with GP.ledger().run_window(export=False):
+            with jax.profiler.trace(tdir):
+                for _ in range(steps):
+                    params, opt, loss, _ = step(params, opt, tokens,
+                                                labels)
+                float(loss)
+        wall_s = time.perf_counter() - t0
+
+        # legacy per-HLO-family view (PROFILE_STEP.json)
+        agg = {}
+        total_ns = 0.0
+        for _module, hlo_op, dur in DT.device_events(tdir,
+                                                     exclusive=True):
+            fam = hlo_op.split(".")[0]
+            a = agg.setdefault(fam, [0.0, 0])
+            a[0] += dur
+            a[1] += 1
+            total_ns += dur
+        rows = sorted(
+            ({"op": k, "ms_per_step": v[0] / 1e6 / steps, "events": v[1]}
+             for k, v in agg.items()),
+            key=lambda r: -r["ms_per_step"])
+
+        wall_ms = wall_s * 1e3 / steps
+        busy_ms = total_ns / 1e6 / steps
+        print(f"\n=== {spec_str} on "
+              f"{getattr(dev, 'device_kind', dev.platform)}")
+        print(f"wall {wall_ms:.1f} ms/step | device busy {busy_ms:.1f} "
+              f"ms/step | gap {wall_ms - busy_ms:.1f} ms/step")
+        for r in rows[:25]:
+            print(f"{r['ms_per_step']:9.2f} ms  x{r['events']:<5d} "
+                  f"{r['op']}")
+        profile = {"spec": spec_str, "wall_ms_per_step": round(wall_ms, 2),
+                   "device_busy_ms_per_step": round(busy_ms, 2),
+                   "rows": [{**r, "ms_per_step": round(r["ms_per_step"],
+                                                       3)}
+                            for r in rows[:40]]}
+        path = profile_out or os.path.join(REPO, "PROFILE_STEP.json")
+        with open(path, "w") as f:
+            json.dump(profile, f, indent=1)
+        print(f"[profile] wrote {path}", file=sys.stderr)
+
+        # roofline attribution (ISSUE 14): measured x static HLO costs
+        attribution = ATT.build_from_trace(
+            tdir, steps=steps, wall_ms_per_step=wall_ms,
+            hlo_texts=[hlo] if hlo else [], device=dev, mode="train",
+            spec=spec_str, step_flops=report.get("flops"),
+            step_bytes=report.get("bytes_accessed"),
+            programs=[report] if report else None, config=config,
+            generated_by="tools/profile_step.py")
+        apath = attr_out or os.path.join(REPO, "ATTRIBUTION.json")
+        ATT.write(attribution, apath)
+        res = attribution["residue"]
+        print(f"[profile] attribution: {attribution['fusion_count']} "
+              f"fusions, residue {res['count']} ops "
+              f"({res['share_of_busy']:.1%} of busy; top groups "
+              f"{[g['label'] for g in res['groups'][:4]]}) -> {apath}",
+              file=sys.stderr)
+        results.append((profile, attribution))
+    return results if runs > 1 else results[0]
+
+
+def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
+                  d: int = 64, layers: int = 4, nh: int = 4, ff: int = 128,
+                  vocab: int = 256, max_batch: int = 4, max_seq: int = 64,
+                  weight_dtype: str = "f32", kv_layout: str = "slab"):
+    """Profile a warmed DecodeEngine decode tick: fill every slot, trace
+    ``ticks`` full-batch decode steps, attribute through the same
+    roofline path — the decode residue ranking is ROADMAP item 3(b)'s
+    megakernel target list."""
+    import numpy as np
+    import jax
+
+    from paddle_tpu import serving
+    from paddle_tpu.models import gpt
+    from paddle_tpu.observability import attribution as ATT
+    from paddle_tpu.observability import program_report as PREP
+
+    dev = jax.devices()[0]
+    cfg = gpt.GPTConfig(vocab_size=vocab, max_seq_len=max(max_seq, 64),
+                        num_layers=layers, num_heads=nh, d_model=d,
+                        d_ff=ff, remat=False)
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    ekw = dict(max_batch=max_batch, max_seq=max_seq,
+               prefill_buckets=(8, 16), weight_dtype=weight_dtype)
+    if kv_layout == "paged":
+        ekw.update(kv_layout="paged", page_size=8)
+    engine = serving.DecodeEngine(params, cfg,
+                                  serving.EngineConfig(**ekw))
+    print("[profile --serve] warmup (AOT prefill ladder + decode)",
+          file=sys.stderr, flush=True)
+    engine.warmup()
+
+    rng = np.random.RandomState(0)
+    slots, last = [], {}
+    for _ in range(max_batch):
+        prompt = rng.randint(0, vocab, size=6).tolist()
+        slot, logits = engine.start_sequence(prompt)
+        slots.append(slot)
+        last[slot] = int(np.argmax(logits))
+    # warm the full-batch decode signature before tracing
+    out = engine.decode_step({s: last[s] for s in slots})
+    last = {s: int(np.argmax(v)) for s, v in out.items()}
+
+    print(f"[profile --serve] tracing {ticks} decode ticks "
+          f"(batch {max_batch})", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
     with jax.profiler.trace(trace_dir):
-        for _ in range(steps):
-            params, opt, loss, _ = step(params, opt, tokens, labels)
-        float(loss)
-    wall_s = time.perf_counter() - t0
+        for _ in range(ticks):
+            out = engine.decode_step({s: last[s] for s in slots})
+            last = {s: int(np.argmax(v)) for s, v in out.items()}
+    wall_ms = (time.perf_counter() - t0) * 1e3 / ticks
+    for s in slots:
+        engine.free_sequence(s)
 
-    # aggregate measured device time by HLO op family
-    agg = {}
-    total_ns = 0.0
-    for _module, hlo_op, dur in DT.device_events(trace_dir, exclusive=True):
-        fam = hlo_op.split(".")[0]
-        a = agg.setdefault(fam, [0.0, 0])
-        a[0] += dur
-        a[1] += 1
-        total_ns += dur
-    rows = sorted(
-        ({"op": k, "ms_per_step": v[0] / 1e6 / steps, "events": v[1]}
-         for k, v in agg.items()),
-        key=lambda r: -r["ms_per_step"])
+    hlo_texts = []
+    try:
+        hlo_texts.append(engine._exec["decode"].as_text())
+    except Exception:
+        pass
+    reports = [r for r in PREP.recent_reports()
+               if str(r.get("program", "")).startswith("serve/")]
+    decode_rep = next((r for r in reversed(reports)
+                       if r.get("program") == "serve/decode"), {})
+    config = {
+        "mode": "decode", "weight_dtype": weight_dtype,
+        "kv_layout": kv_layout, "max_batch": max_batch,
+        "max_seq": max_seq, "d_model": d, "layers": layers,
+    }
+    attribution = ATT.build_from_trace(
+        trace_dir, steps=ticks, wall_ms_per_step=wall_ms,
+        hlo_texts=hlo_texts, device=dev, mode="decode",
+        spec=f"serve:d={d},L={layers},b={max_batch},"
+             f"{weight_dtype},{kv_layout}",
+        step_flops=decode_rep.get("flops"),
+        step_bytes=decode_rep.get("bytes_accessed"),
+        programs=reports[-8:] or None, config=config,
+        generated_by="tools/profile_step.py --serve")
+    apath = attr_out or os.path.join(REPO, "ATTRIBUTION_DECODE.json")
+    ATT.write(attribution, apath)
+    res = attribution["residue"]
+    print(f"[profile --serve] decode tick {wall_ms:.2f} ms | busy "
+          f"{attribution['device_busy_ms_per_step']:.2f} ms | "
+          f"{attribution['fusion_count']} fusions | residue "
+          f"{res['count']} ops ({res['share_of_busy']:.1%}) "
+          f"groups {[g['label'] for g in res['groups'][:4]]} -> {apath}",
+          file=sys.stderr)
+    return attribution
 
-    wall_ms = wall_s * 1e3 / steps
-    busy_ms = total_ns / 1e6 / steps
-    print(f"\n=== {spec_str} on {getattr(dev, 'device_kind', dev.platform)}")
-    print(f"wall {wall_ms:.1f} ms/step | device busy {busy_ms:.1f} ms/step "
-          f"| gap {wall_ms - busy_ms:.1f} ms/step")
-    for r in rows[:25]:
-        print(f"{r['ms_per_step']:9.2f} ms  x{r['events']:<5d} {r['op']}")
-    out = {"spec": spec_str, "wall_ms_per_step": round(wall_ms, 2),
-           "device_busy_ms_per_step": round(busy_ms, 2),
-           "rows": [{**r, "ms_per_step": round(r["ms_per_step"], 3)}
-                    for r in rows[:40]]}
-    path = os.path.join(REPO, "PROFILE_STEP.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(f"[profile] wrote {path}", file=sys.stderr)
+
+def main():
+    trace_dir = _flag("--dir", "/tmp/gpt-trace")
+    attr_out = _flag("--attr-out")
+    if "--serve" in sys.argv:
+        serve_profile(trace_dir, ticks=int(_flag("--ticks", 16, int)),
+                      attr_out=attr_out,
+                      weight_dtype=_flag("--weight-dtype", "f32"),
+                      kv_layout=_flag("--kv-layout", "slab"),
+                      max_batch=int(_flag("--max-batch", 4, int)))
+        return
+    if "--smoke" in sys.argv:
+        spec_str = SMOKE_SPEC
+    else:
+        spec_str = sys.argv[1] if len(sys.argv) > 1 and "=" in sys.argv[1] \
+            else DEFAULT_SPEC
+    steps = int(_flag("--steps", 6, int))
+    train_profile(spec_str, trace_dir, steps=steps, attr_out=attr_out,
+                  profile_out=_flag("--profile-out"))
 
 
 if __name__ == "__main__":

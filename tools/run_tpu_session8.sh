@@ -1,0 +1,56 @@
+#!/bin/bash
+# Chip session 8: on-chip roofline attribution + perf-sentinel baseline
+# (ISSUE 14) — after the still-queued session 7 (serving lanes, which
+# itself chains sessions 5/6; run order is enforced by markers).
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session8.sh > tpu_s8.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+if [ ! -f .tpu_s7_done ]; then
+  echo "=== [0/5] session 7 (serving lanes) still queued — running it first ==="
+  bash tools/run_tpu_session7.sh
+fi
+
+echo "=== [1/5] train attribution at the bench-winner config $(date -u +%H:%M:%S) ==="
+# the r05 measured winner (b=16 remat=dots celim=1GiB, 0.7168 MFU):
+# refreshes PROFILE_STEP.json AND writes the first on-chip
+# ATTRIBUTION.json — per-fusion roofline placement + the residue list
+# KERNEL_NOTES item 3 gates its megakernels on
+python tools/profile_step.py \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824" \
+  --steps 8 --dir /tmp/s8-train-trace --attr-out ATTRIBUTION.json
+echo "=== train attribution rc=$? ==="
+
+echo "=== [2/5] decode-tick attribution (serving residue) $(date -u +%H:%M:%S) ==="
+# warmed DecodeEngine full-batch decode tick, production-shaped model —
+# the decode residue ranking is ROADMAP item 3(b)'s fused-decode-kernel
+# target list (paged gather expected in the top groups, see item 2(b))
+python tools/profile_step.py --serve --ticks 32 --max-batch 16 \
+  --kv-layout paged --dir /tmp/s8-decode-trace \
+  --attr-out ATTRIBUTION_DECODE.json
+echo "=== decode attribution rc=$? ==="
+
+echo "=== [3/5] bench --profile (headline + attribution in one run) $(date -u +%H:%M:%S) ==="
+python bench.py --worker --wide --profile=ATTRIBUTION_BENCH_tpu.json \
+  --monitor=/tmp/s8-monitor.jsonl
+echo "=== bench profile rc=$? ==="
+
+echo "=== [4/5] perf sentinel: record/diff the TPU-lane baseline $(date -u +%H:%M:%S) ==="
+if [ ! -f PERF_BASELINE_tpu.json ]; then
+  # first chip session since the sentinel landed: record the TPU lane
+  # (real bands — timing metrics are only structural on the CPU lane)
+  python tools/perf_diff.py --update-baseline --lane tpu \
+    --baseline PERF_BASELINE_tpu.json --monitor /tmp/s8-monitor.jsonl \
+    --notes "first on-chip baseline (session 8): profile_step train attribution at the bench-winner config"
+else
+  python tools/perf_diff.py --baseline PERF_BASELINE_tpu.json \
+    --monitor /tmp/s8-monitor.jsonl --out REGRESSION_tpu.json
+fi
+echo "=== sentinel rc=$? ==="
+
+echo "=== [5/5] metrics gate on-chip (incl. the attribution schema gate) $(date -u +%H:%M:%S) ==="
+python tools/metrics_check.py --out /tmp/metrics_check_tpu_s8
+echo "=== metrics_check rc=$? ==="
+date -u > .tpu_s8_done
