@@ -50,7 +50,14 @@ from .scheduler import (  # noqa: F401
     Scheduler,
     SchedulerConfig,
 )
-from .server import EngineLoop, FrontDoor  # noqa: F401
+from .server import EngineLoop, FrontDoor, shed_decision  # noqa: F401
+from .prefix_store import PrefixStore  # noqa: F401
+from .replica import POISONED_EXIT_CODE  # noqa: F401
+from .gang import (  # noqa: F401
+    GangConfig,
+    GangFrontDoor,
+    ReplicaGang,
+)
 
 __all__ = [
     "DecodeEngine", "EngineConfig", "PromptTooLongError",
@@ -60,5 +67,7 @@ __all__ = [
     "quantize_params", "dequantize_params", "logit_error_stats",
     "INT8_LOGIT_TOL", "INT8_PPL_REL_TOL",
     "Scheduler", "SchedulerConfig", "Request", "QueueFullError",
-    "FrontDoor", "EngineLoop",
+    "FrontDoor", "EngineLoop", "shed_decision",
+    "PrefixStore", "POISONED_EXIT_CODE",
+    "ReplicaGang", "GangConfig", "GangFrontDoor",
 ]

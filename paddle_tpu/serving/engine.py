@@ -191,7 +191,21 @@ class DecodeEngine:
         # (the slabs are invalidated by donation, so no later call can be
         # trusted) — every serving entrypoint refuses from then on
         self.poisoned: Optional[str] = None
+        # optional persistent prefix store (serving/prefix_store.py):
+        # published pages survive restarts — attach_prefix_store()
+        self.prefix_store = None
         self._tokens_window: List[Tuple[float, int]] = []  # (t, n) samples
+
+    def attach_prefix_store(self, store) -> int:
+        """Arm warm restart (docs/serving.md "Resilience"): restore the
+        store's committed prefix records into the pool + prefix cache
+        NOW (call before :meth:`warmup`), and persist every later
+        publish through it. Returns how many records were restored."""
+        if not self.paged or self.prefix is None:
+            raise ValueError("prefix store needs kv_layout='paged' with "
+                             "prefix_cache enabled")
+        self.prefix_store = store
+        return store.restore_into(self)
 
     def _init_tp(self, qparams) -> None:
         """Mesh + NamedShardings for the tp engine: KV heads and the
@@ -837,7 +851,12 @@ class DecodeEngine:
                              "prefix_len": prefix_len, "slot": slot})
         self.cache.k, self.cache.v = kp, vp
         if self.prefix is not None:
-            self.prefix.insert(tokens, table_row)
+            added = self.prefix.insert(tokens, table_row)
+            if added and self.prefix_store is not None:
+                # persist at publish time: the pages just written are the
+                # ones a recycled replica restores (async, CRC-committed)
+                self.prefix_store.maybe_publish(tokens, table_row,
+                                                self.cache)
         return slot, logits, tok
 
     def resume_sequence_sampled(

@@ -1,0 +1,597 @@
+"""Replicated serving gang: N engine replicas behind one front door,
+with failover, automatic recycle, and idempotent request dispatch
+(ISSUE 15, docs/serving.md "Resilience").
+
+The training stack survives worker death through ``parallel/launch.py``'s
+supervised gang restarts; this module is the serving twin, built on the
+same contracts:
+
+- **Replicas are subprocesses** (``serving/replica.py``), each a full
+  engine + scheduler + :class:`FrontDoor` on its own ephemeral port,
+  reporting readiness through ``ready.json`` and liveness through a
+  heartbeat file (the ``RankHeartbeat`` idea, serving-shaped).
+- **Health model**: the supervisor thread watches three signals per
+  replica — process exit (43 -> ``hang``, 44 -> ``poisoned``, anything
+  else incl. signal death -> ``crash``), the ``/health`` probe (status
+  ``poisoned``/``degraded``, or unreachable), and heartbeat staleness
+  (a wedged process that still answers TCP). Any of them recycles the
+  replica: SIGTERM, grace, SIGKILL, respawn — counted into
+  ``paddle_serve_replica_restarts_total{cause}`` while the siblings
+  keep serving.
+- **Failover with idempotent request ids**: every request carries an id
+  (client-supplied ``request_id`` or gang-assigned). A replica dying
+  mid-request breaks the forwarded connection; the router discards the
+  partial and re-dispatches the SAME request to a sibling — the retry
+  re-prefills from scratch (correctness over speed), metered by
+  ``paddle_serve_failover_requests_total``. A completed id is cached, so
+  a client retry of an answered request returns the recorded response —
+  never a second generation; a duplicate arriving while the first is in
+  flight waits for it instead of racing it. A client therefore never
+  sees a lost or double-answered request.
+- **Warm restart**: each replica slot owns a persistent prefix store
+  directory (``serving/prefix_store.py``); a recycled replica restores
+  its published prefix pages on boot and serves shared-prefix traffic
+  prefill-once from its first request.
+
+TPU caveat: replicas are separate processes — on a TPU host each must be
+pinned to its own chip subset (``TPU_VISIBLE_DEVICES`` per replica, see
+tools/run_tpu_session8.sh); the committed bench lanes are the CPU smoke
+surface.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..parallel import health as _health
+from . import metrics as smetrics
+from .replica import HEARTBEAT_NAME, POISONED_EXIT_CODE, READY_NAME
+
+__all__ = ["GangConfig", "ReplicaGang", "ReplicaHandle", "GangFrontDoor"]
+
+_REPLICA_SCRIPT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "replica.py")
+
+
+def _exit_cause(ret: Optional[int]) -> str:
+    """Popen returncode -> restart-cause label. Mirrors
+    ``parallel.launch._restart_cause`` with the serving-specific
+    poisoned code added."""
+    if ret == _health.HANG_EXIT_CODE:
+        return "hang"
+    if ret == POISONED_EXIT_CODE:
+        return "poisoned"
+    return "crash"
+
+
+@dataclasses.dataclass(frozen=True)
+class GangConfig:
+    n_replicas: int = 2
+    # supervisor probe cadence + the liveness deadline: an unreachable
+    # /health or a heartbeat older than hang_deadline_s recycles the
+    # replica with cause=hang (the worker's own watchdog usually beats
+    # this by exiting 43 first — this is the backstop for a process
+    # wedged outside the engine loop)
+    probe_interval_s: float = 0.5
+    probe_timeout_s: float = 2.0
+    hang_deadline_s: float = 10.0
+    ready_timeout_s: float = 180.0
+    grace_period_s: float = 3.0
+    restart_backoff_s: float = 0.2
+    max_restarts_per_replica: int = 8
+    # failover: how many distinct replica incarnations one request may
+    # try before the router gives up with 503
+    max_failover_attempts: int = 4
+    dedup_capacity: int = 4096
+    default_timeout_s: float = 30.0
+
+
+class ReplicaHandle:
+    """One replica slot: the subprocess, its readiness/heartbeat files,
+    and restart bookkeeping. A slot survives recycles; the process (and
+    its port) changes per incarnation."""
+
+    def __init__(self, index: int, config_path: str, run_dir: str):
+        self.index = int(index)
+        self.config_path = config_path
+        self.run_dir = run_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.restored_prefix_records = 0
+        self.incarnation = 0
+        self.restarts = 0
+        self.last_cause: Optional[str] = None
+        self.inflight = 0                 # router-side load counter
+        self.probe_misses = 0
+        self._log = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def spawn(self, env: Dict[str, str]) -> None:
+        for name in (READY_NAME, HEARTBEAT_NAME):
+            try:
+                os.remove(os.path.join(self.run_dir, name))
+            except OSError:
+                pass
+        self.port = None
+        self.probe_misses = 0
+        self.incarnation += 1
+        if self._log is None or self._log.closed:
+            self._log = open(os.path.join(self.run_dir, "worker.log"), "a")
+        self.proc = subprocess.Popen(
+            [sys.executable, _REPLICA_SCRIPT, "--config", self.config_path],
+            env=env, stdout=self._log, stderr=subprocess.STDOUT)
+
+    def kill(self, sig=signal.SIGKILL) -> None:
+        """Deliver ``sig`` to the current incarnation (fault injection
+        and supervisor recycle both come through here)."""
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.send_signal(sig)
+            except OSError:
+                pass
+
+    def stop(self, grace_s: float) -> None:
+        if self.proc is None:
+            return
+        self.kill(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=max(0.1, grace_s))
+        except subprocess.TimeoutExpired:
+            self.kill(signal.SIGKILL)
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        if self._log is not None and not self._log.closed:
+            self._log.close()
+
+    # -- liveness ----------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def check_ready(self) -> bool:
+        """Refresh ``self.port`` from the incarnation's ready file (the
+        pid gate rejects a stale file from a killed predecessor)."""
+        if self.port is not None:
+            return True
+        if not self.alive:
+            return False
+        try:
+            with open(os.path.join(self.run_dir, READY_NAME)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if rec.get("pid") != self.proc.pid:
+            return False
+        self.port = int(rec["port"])
+        self.restored_prefix_records = int(
+            rec.get("restored_prefix_records", 0))
+        return True
+
+    def heartbeat_age_s(self) -> Optional[float]:
+        try:
+            with open(os.path.join(self.run_dir, HEARTBEAT_NAME)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return max(0.0, time.time() - float(rec.get("ts", 0)))
+
+    # -- HTTP --------------------------------------------------------------
+    def get_json(self, path: str, timeout_s: float) -> Dict[str, Any]:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}{path}",
+                timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def get_text(self, path: str, timeout_s: float = 5.0) -> str:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}{path}",
+                timeout=timeout_s) as r:
+            return r.read().decode()
+
+    def post_generate(self, body: Dict[str, Any],
+                      timeout_s: float) -> Tuple[int, Dict[str, Any]]:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            # 4xx/5xx with a JSON body is a PROTOCOL answer, not a
+            # transport fault — the router decides what to do with it
+            try:
+                return e.code, json.loads(e.read().decode())
+            except ValueError:
+                return e.code, {"error": f"HTTP {e.code}"}
+
+
+class ReplicaGang:
+    """Spawn, supervise, and route over ``n_replicas`` replica workers.
+
+    ``worker_config`` is the shared replica config (model/engine/
+    scheduler sections — see serving/replica.py); the gang stamps
+    per-slot ``index``/``run_dir``/``prefix_store_dir`` into each
+    replica's own config file under ``run_dir``."""
+
+    def __init__(self, worker_config: Dict[str, Any], run_dir: str,
+                 cfg: Optional[GangConfig] = None,
+                 prefix_store: bool = False,
+                 env: Optional[Dict[str, str]] = None,
+                 per_replica: Optional[Dict[int, dict]] = None):
+        self.cfg = cfg or GangConfig()
+        self.run_dir = os.path.abspath(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._env = dict(os.environ if env is None else env)
+        # health env contract (docs/health.md): the worker's engine loop
+        # stamps progress; a wedged loop exits 43 on its own
+        self._env.setdefault(_health.ENV_DEADLINE,
+                             str(float(self.cfg.hang_deadline_s)))
+        self._env.setdefault(_health.ENV_DIR,
+                             os.path.join(self.run_dir, "health"))
+        self.replicas: List[ReplicaHandle] = []
+        for i in range(self.cfg.n_replicas):
+            rdir = os.path.join(self.run_dir, f"replica{i}")
+            os.makedirs(rdir, exist_ok=True)
+            rc = dict(worker_config, index=i, run_dir=rdir)
+            # per-slot overrides (the fault bench injects faults into ONE
+            # replica while its siblings stay clean)
+            rc.update((per_replica or {}).get(i, {}))
+            if prefix_store:
+                rc["prefix_store_dir"] = os.path.join(
+                    self.run_dir, "prefix_store", f"replica{i}")
+            cpath = os.path.join(rdir, "config.json")
+            with open(cpath, "w") as f:
+                json.dump(rc, f, indent=1)
+            self.replicas.append(ReplicaHandle(i, cpath, rdir))
+        self.restart_causes: Dict[str, int] = {}
+        self.failovers = 0
+        self._rid = itertools.count(1)
+        self._dedup_lock = threading.Lock()
+        self._completed: "OrderedDict[str, Tuple[int, dict]]" = \
+            OrderedDict()
+        self._inflight: Dict[str, threading.Event] = {}
+        self._rr = itertools.count()      # round-robin tiebreak
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, wait_ready: bool = True) -> "ReplicaGang":
+        for r in self.replicas:
+            r.spawn(self._env)
+        if wait_ready:
+            self.wait_ready()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True, name="gang-monitor")
+        self._monitor.start()
+        return self
+
+    def wait_ready(self, timeout_s: Optional[float] = None) -> None:
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.cfg.ready_timeout_s)
+        while time.monotonic() < deadline:
+            pending = [r for r in self.replicas if not r.check_ready()]
+            if not pending:
+                return
+            dead = [r for r in pending if not r.alive]
+            for r in dead:
+                raise RuntimeError(
+                    f"replica {r.index} died during startup "
+                    f"(exit {r.proc.returncode}) — see "
+                    f"{os.path.join(r.run_dir, 'worker.log')}")
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"replicas {[r.index for r in self.replicas if r.port is None]}"
+            f" not ready within {self.cfg.ready_timeout_s}s")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        for r in self.replicas:
+            r.stop(self.cfg.grace_period_s)
+
+    # -- supervision -------------------------------------------------------
+    def _recycle(self, r: ReplicaHandle, cause: str, detail: str) -> None:
+        r.last_cause = cause
+        r.restarts += 1
+        self.restart_causes[cause] = self.restart_causes.get(cause, 0) + 1
+        smetrics.m_replica_restarts.labels(cause).inc()
+        sys.stderr.write(
+            f"[gang] recycling replica {r.index} (cause={cause}: "
+            f"{detail}); siblings keep serving\n")
+        r.stop(self.cfg.grace_period_s if cause == "poisoned" else 0.2)
+        if r.restarts > self.cfg.max_restarts_per_replica:
+            sys.stderr.write(
+                f"[gang] replica {r.index} exceeded "
+                f"{self.cfg.max_restarts_per_replica} restarts — "
+                "leaving it down\n")
+            return
+        time.sleep(self.cfg.restart_backoff_s)
+        r.spawn(self._env)
+
+    def _probe(self, r: ReplicaHandle) -> None:
+        """One health probe of a ready replica; classifies and recycles
+        on poisoned/degraded/unreachable/stale-heartbeat."""
+        try:
+            h = r.get_json("/health", self.cfg.probe_timeout_s)
+            r.probe_misses = 0
+        except Exception as e:
+            r.probe_misses += 1
+            hb = r.heartbeat_age_s()
+            if (r.probe_misses * self.cfg.probe_interval_s
+                    >= self.cfg.hang_deadline_s) or \
+                    (hb is not None and hb >= self.cfg.hang_deadline_s):
+                self._recycle(r, "hang",
+                              f"/health unreachable x{r.probe_misses}, "
+                              f"heartbeat age {hb}: {e}")
+            return
+        status = h.get("status")
+        if status == "poisoned":
+            self._recycle(r, "poisoned",
+                          h.get("engine_poisoned", "engine poisoned"))
+        elif status == "degraded":
+            self._recycle(r, "crash", "engine loop died (degraded)")
+        else:
+            hb = r.heartbeat_age_s()
+            if hb is not None and hb >= self.cfg.hang_deadline_s:
+                self._recycle(r, "hang", f"heartbeat stale ({hb:.1f}s)")
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.cfg.probe_interval_s):
+            for r in self.replicas:
+                if self._stop.is_set():
+                    return
+                if r.proc is None:
+                    continue
+                ret = r.proc.poll()
+                if ret is not None:
+                    self._recycle(r, _exit_cause(ret),
+                                  f"exit code {ret}")
+                    continue
+                if r.check_ready():
+                    self._probe(r)
+
+    # -- routing -----------------------------------------------------------
+    def ready_replicas(self) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.alive and r.check_ready()]
+
+    def _pick(self, exclude) -> Optional[ReplicaHandle]:
+        """Least-loaded ready replica not in ``exclude`` (an (index,
+        incarnation) set — a RECYCLED replica is a fresh candidate)."""
+        cands = [r for r in self.ready_replicas()
+                 if (r.index, r.incarnation) not in exclude]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.inflight, next(self._rr)))
+
+    def dispatch(self, body: Dict[str, Any],
+                 timeout_s: Optional[float] = None
+                 ) -> Tuple[int, Dict[str, Any]]:
+        """Route one generate request with failover + idempotency.
+        Returns ``(http_code, payload)``."""
+        timeout = (self.cfg.default_timeout_s if timeout_s is None
+                   else float(timeout_s))
+        rid = str(body.get("request_id") or
+                  f"gang-{os.getpid()}-{next(self._rid)}")
+        with self._dedup_lock:
+            hit = self._completed.get(rid)
+            if hit is not None:
+                # an answered id is never re-generated: the recorded
+                # response IS the answer (idempotency contract)
+                self._completed.move_to_end(rid)
+                return hit[0], dict(hit[1], deduplicated=True)
+            ev = self._inflight.get(rid)
+            if ev is None:
+                ev = threading.Event()
+                self._inflight[rid] = ev
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            # a duplicate of an in-flight request waits for the original
+            # instead of racing a second generation
+            ev.wait(timeout=timeout + self.cfg.probe_timeout_s)
+            with self._dedup_lock:
+                hit = self._completed.get(rid)
+            if hit is not None:
+                return hit[0], dict(hit[1], deduplicated=True)
+            return 504, {"error": "duplicate waited out its original",
+                         "request_id": rid}
+        try:
+            code, payload = self._dispatch_inner(body, timeout, rid)
+        finally:
+            with self._dedup_lock:
+                self._inflight.pop(rid, None)
+                ev.set()
+        return code, payload
+
+    def _record(self, rid: str, code: int,
+                payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        payload = dict(payload, request_id=rid)
+        with self._dedup_lock:
+            self._completed[rid] = (code, payload)
+            while len(self._completed) > self.cfg.dedup_capacity:
+                self._completed.popitem(last=False)
+        return code, payload
+
+    def _dispatch_inner(self, body, timeout: float, rid: str):
+        deadline = time.monotonic() + timeout + self.cfg.probe_timeout_s
+        tried = set()
+        shed_response = None
+        attempts = 0
+        while True:
+            r = self._pick(tried)
+            if r is None:
+                if shed_response is not None:
+                    # every replica shed (429/503): surface the shed —
+                    # its Retry-After is the client's cue
+                    return self._record(rid, *shed_response)
+                # nothing healthy right now: a recycle may be in flight —
+                # wait for a respawn (a recycled replica has a new
+                # incarnation and re-enters the candidate set) rather
+                # than failing a whole storm during one restart window
+                if time.monotonic() < deadline and not self._stop.is_set():
+                    time.sleep(self.cfg.probe_interval_s)
+                    continue
+                return self._record(rid, 503, {
+                    "error": "no healthy replica", "retry_after_s": 1})
+            tried.add((r.index, r.incarnation))
+            remaining = max(0.5, deadline - time.monotonic())
+            r.inflight += 1
+            try:
+                code, payload = r.post_generate(body, remaining)
+            except Exception as e:
+                # transport fault: the replica died (or was killed) with
+                # this request in flight — its partial tokens die with
+                # it; re-dispatch to a sibling, which re-prefills
+                attempts += 1
+                self.failovers += 1
+                smetrics.m_failover.inc()
+                sys.stderr.write(
+                    f"[gang] request {rid}: replica {r.index} faulted "
+                    f"mid-request ({type(e).__name__}) — failing over "
+                    f"(attempt {attempts})\n")
+                if attempts > self.cfg.max_failover_attempts:
+                    return self._record(rid, 503, {
+                        "error": f"replica fault after {attempts} "
+                                 f"attempts: {type(e).__name__}: {e}",
+                        "retry_after_s": 1})
+                continue
+            finally:
+                r.inflight -= 1
+            if code == 500:
+                # engine-loop fault aborted it server-side: safe to
+                # retry on a sibling (nothing was returned)
+                attempts += 1
+                self.failovers += 1
+                smetrics.m_failover.inc()
+                if attempts > self.cfg.max_failover_attempts:
+                    return self._record(rid, code, payload)
+                continue
+            if code in (429, 503):
+                # overloaded/draining replica: try a sibling; if every
+                # replica sheds, surface the shed (with its Retry-After)
+                shed_response = (code, payload)
+                continue
+            return self._record(rid, code, payload)
+
+    # -- introspection -----------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        reps = []
+        for r in self.replicas:
+            reps.append({
+                "index": r.index, "alive": r.alive,
+                "ready": r.port is not None, "port": r.port,
+                "incarnation": r.incarnation, "restarts": r.restarts,
+                "last_cause": r.last_cause,
+                "restored_prefix_records": r.restored_prefix_records,
+            })
+        n_ready = len(self.ready_replicas())
+        return {
+            "status": ("ok" if n_ready == len(self.replicas) else
+                       "degraded" if n_ready else "down"),
+            "replicas": reps,
+            "ready": n_ready,
+            "restarts": dict(self.restart_causes),
+            "failovers": self.failovers,
+        }
+
+
+class _GangHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code: int, obj: Dict[str, Any]) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        if "retry_after_s" in obj:
+            self.send_header("Retry-After", str(int(obj["retry_after_s"])))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        smetrics.request_code(code)
+
+    def do_GET(self):
+        front: "GangFrontDoor" = self.server.front
+        if self.path == "/health":
+            return self._json(200, front.gang.health())
+        if self.path == "/metrics":
+            from ..observability import prom
+
+            text = prom.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            self.wfile.write(text)
+            return
+        self._json(404, {"error": f"unknown path {self.path!r}"})
+
+    def do_POST(self):
+        front: "GangFrontDoor" = self.server.front
+        if self.path != "/generate":
+            return self._json(404, {"error": f"unknown path {self.path!r}"})
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(self.rfile.read(n).decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            return self._json(400, {"error": f"malformed JSON body: {e}"})
+        timeout_s = body.get("timeout_s")
+        code, payload = front.gang.dispatch(
+            body, None if timeout_s is None else float(timeout_s))
+        self._json(code, payload)
+
+
+class GangFrontDoor:
+    """The gang's public HTTP face: ``/generate`` routes through
+    :meth:`ReplicaGang.dispatch` (failover + idempotency), ``/health``
+    reports the gang view, ``/metrics`` serves the SUPERVISOR process's
+    registry (replica restarts, failovers; each replica's own serving
+    metrics live behind its own ``/metrics``)."""
+
+    def __init__(self, gang: ReplicaGang, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.gang = gang
+        from .server import _Server
+
+        self.httpd = _Server((host, port), _GangHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.front = self
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "GangFrontDoor":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="gang-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

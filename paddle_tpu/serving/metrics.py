@@ -16,6 +16,7 @@ __all__ = [
     "m_prefix_cache", "m_prefill_tokens", "m_page_occupancy",
     "m_page_fragmentation", "m_spec_accepted", "m_spec_proposed",
     "m_spec_windows", "m_preemptions", "m_hol_admits",
+    "m_shed", "m_replica_restarts", "m_failover", "m_prefix_store",
     "request_code",
 ]
 
@@ -101,6 +102,32 @@ m_preemptions = _REG.counter(
 m_hol_admits = _REG.counter(
     "paddle_serve_hol_bypass_admits_total",
     "Requests admitted past a head-of-line prompt that did not fit")
+
+
+# resilience families (ISSUE 15, docs/serving.md "Resilience") -----------
+# adaptive overload control: requests rejected up front instead of being
+# queued into a guaranteed 504 — "deadline" = drain ETA beyond the
+# request deadline, "queue_full" = admission queue at capacity
+m_shed = _REG.counter(
+    "paddle_serve_shed_total",
+    "Requests shed by the overload control, by reason", ("reason",))
+# gang supervisor (serving/gang.py): replica recycles by cause — crash
+# (nonzero exit / signal death), hang (exit 43 or stale health probe),
+# poisoned (exit 44 or /health status poisoned)
+m_replica_restarts = _REG.counter(
+    "paddle_serve_replica_restarts_total",
+    "Serving replica recycles by cause (crash, hang, poisoned)",
+    ("cause",))
+# in-flight requests re-dispatched to a sibling replica after their
+# replica died mid-request (partials discarded, the retry re-prefills)
+m_failover = _REG.counter(
+    "paddle_serve_failover_requests_total",
+    "Requests re-dispatched to a sibling replica after a replica fault")
+# warm restart (serving/prefix_store.py): published prefix-cache records
+# persisted / restored through the elastic checkpoint store
+m_prefix_store = _REG.counter(
+    "paddle_serve_prefix_store_total",
+    "Prefix-store operations (save, restore, restore_skipped)", ("op",))
 
 
 def request_code(code: int) -> None:

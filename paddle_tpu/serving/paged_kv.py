@@ -284,6 +284,31 @@ class PagedKVCache:
     def headroom(self, slot: int) -> int:
         return self.max_seq - self._slots[slot].length
 
+    # -- page content I/O (serving/prefix_store.py warm restart) -----------
+    def claim_pages(self, n: int) -> List[int]:
+        """Take ``n`` pages off the free list with ONE reference each —
+        the prefix cache's reference when the pages are adopted as a
+        restored cache entry. Raises :class:`PagePoolFullError` (after
+        the reclaimer hook) when the pool cannot cover it."""
+        return self._take_pages(int(n))
+
+    def read_pages(self, pages: Sequence[int]
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Host copies of the K/V contents of ``pages``:
+        ``([L, n, page_size, nh, hd] k, same v)`` — what the prefix
+        store persists at publish time."""
+        idx = np.asarray(list(pages), np.int32)
+        return (np.asarray(self.k[:, idx]), np.asarray(self.v[:, idx]))
+
+    def write_pages(self, pages: Sequence[int], k_pages: np.ndarray,
+                    v_pages: np.ndarray) -> None:
+        """Write restored K/V contents into ``pages`` (boot-time only:
+        the arrays are replaced wholesale, which is exactly how the
+        engine treats them between executable calls)."""
+        idx = np.asarray(list(pages), np.int32)
+        self.k = self.k.at[:, idx].set(jnp.asarray(k_pages, self.dtype))
+        self.v = self.v.at[:, idx].set(jnp.asarray(v_pages, self.dtype))
+
     # -- executable feeds --------------------------------------------------
     def table_row(self, slot: int) -> np.ndarray:
         """[max_pages_per_slot] int32 page table for one slot (copy)."""
@@ -379,6 +404,30 @@ class PrefixCache:
         self.misses += 1
         smetrics.m_prefix_cache.labels("miss").inc()
         return 0, ()
+
+    def adopt_nested(self, tokens: Sequence[int],
+                     pages: Sequence[int]) -> int:
+        """Register a RESTORED page-aligned prefix (warm restart,
+        serving/prefix_store.py): ``pages`` already hold their single
+        cache reference (:meth:`PagedKVCache.claim_pages`) and their
+        contents are already written into the pool. Mirrors
+        :meth:`insert`'s nested publication — every page-boundary prefix
+        of ``tokens`` becomes an entry sharing the same pages. Returns
+        how many entries were registered (existing keys are skipped)."""
+        ps = self.pool.page_size
+        pages = tuple(int(p) for p in pages)
+        if len(tokens) < len(pages) * ps:
+            raise ValueError("adopted pages cover more than the tokens")
+        registered = 0
+        for j in range(1, len(pages) + 1):
+            prefix = tuple(int(t) for t in tokens[:j * ps])
+            key = self._key(prefix)
+            if key in self._entries:
+                continue
+            self._entries[key] = (prefix, pages[:j])
+            registered += 1
+        self._evict_over_capacity()
+        return registered
 
     def insert(self, tokens: Sequence[int], table_row: np.ndarray) -> int:
         """Publish every page-boundary prefix of ``tokens`` whose pages

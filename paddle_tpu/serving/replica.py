@@ -1,0 +1,315 @@
+"""Serving replica worker: one engine incarnation under the gang
+supervisor (ISSUE 15, docs/serving.md "Resilience").
+
+Run as a SCRIPT (``python paddle_tpu/serving/replica.py --config X``) by
+:class:`~paddle_tpu.serving.gang.ReplicaGang` — one subprocess per
+replica slot. The worker:
+
+- builds the model + :class:`DecodeEngine` from the JSON config
+  (deterministic ``init_params(PRNGKey(seed))`` — every replica serves
+  identical weights, so a failed-over greedy request returns the same
+  tokens its first replica would have),
+- restores the persistent prefix store (``prefix_store_dir``) BEFORE
+  warmup, so a recycled replica serves the shared-system-prompt workload
+  prefill-once from its very first request,
+- serves through the standard :class:`FrontDoor` on an ephemeral port,
+  reported back through ``ready.json`` (port, pid, restored record
+  count),
+- arms the hang watchdog from the ``PADDLE_HEALTH_*`` env contract the
+  gang exports (the engine loop stamps ``serve/tick`` progress; a wedged
+  loop exits :data:`~paddle_tpu.parallel.health.HANG_EXIT_CODE` = 43),
+  and writes a liveness heartbeat file the supervisor probes,
+- maps a POISONED engine to a fail-fast exit with
+  :data:`POISONED_EXIT_CODE` = 44 (the gang recycles with
+  ``cause=poisoned``) instead of 500ing every request forever,
+- drains gracefully on SIGTERM and exits 0.
+
+``{"stub": {...}}`` configs run a stdlib-only protocol stub (no jax
+import — sub-second startup) implementing the same HTTP surface
+(``/generate``, ``/health``, ``/metrics``) with deterministic fake
+tokens; gang unit tests use it to exercise failover/recycle mechanics
+without paying engine warmup per test.
+
+Top-level imports here are stdlib-only on purpose: the gang imports
+this module for the exit-code contract, and the stub path must not drag
+jax in.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+#: Exit code for a poisoned engine (donation invalidated the KV slabs —
+#: engine.py). Distinct from health.HANG_EXIT_CODE (43): the gang maps
+#: 44 -> ``paddle_serve_replica_restarts_total{cause="poisoned"}``.
+POISONED_EXIT_CODE = 44
+
+READY_NAME = "ready.json"
+HEARTBEAT_NAME = "heartbeat.json"
+
+
+def _atomic_json(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # liveness files are advisory, never fatal
+
+
+def _heartbeat_loop(run_dir: str, status_fn, stop: threading.Event,
+                    interval_s: float = 0.5) -> None:
+    path = os.path.join(run_dir, HEARTBEAT_NAME)
+    # first beat lands IMMEDIATELY: staleness detection needs a baseline
+    # even when the worker wedges right after coming up
+    _atomic_json(path, {"ts": time.time(), "pid": os.getpid(),
+                        "status": "starting"})
+    while not stop.wait(interval_s):
+        try:
+            status = status_fn()
+        except Exception as e:
+            status = f"error: {e}"
+        _atomic_json(path, {"ts": time.time(), "pid": os.getpid(),
+                            "status": status})
+
+
+# ---------------------------------------------------------------------------
+# Stub worker: protocol-faithful, engine-free (gang unit tests)
+# ---------------------------------------------------------------------------
+
+def _stub_tokens(prompt, n):
+    return [(sum(prompt) * 31 + i * 7) % 97 for i in range(n)]
+
+
+def run_stub(cfg: dict) -> int:
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    stub = cfg.get("stub") or {}
+    run_dir = cfg["run_dir"]
+    os.makedirs(run_dir, exist_ok=True)
+    state = {"served": 0, "hung": False}
+    hb_frozen = threading.Event()
+
+    def status():
+        if stub.get("poison_after") and \
+                state["served"] >= stub["poison_after"]:
+            return "poisoned"
+        return "ok"
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _json(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def do_GET(self):
+            if self.path == "/health":
+                return self._json(200, {
+                    "status": status(), "loop_alive": not state["hung"],
+                    "stub": True, "served": state["served"]})
+            if self.path == "/metrics":
+                text = (f"paddle_serve_prefill_tokens_total "
+                        f"{state['served']}\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+                return
+            self._json(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": "unknown path"})
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n).decode() or "{}")
+            if stub.get("hang_after") is not None and \
+                    state["served"] >= stub["hang_after"]:
+                state["hung"] = True
+                hb_frozen.set()           # heartbeat goes stale too
+                time.sleep(600)
+            if stub.get("die_after") is not None and \
+                    state["served"] >= stub["die_after"]:
+                os._exit(int(stub.get("die_code", 1)))
+            delay = float(body.get("stub_delay_s",
+                                   stub.get("delay_s", 0.0)))
+            if delay:
+                time.sleep(delay)
+            if status() == "poisoned":
+                return self._json(503, {"error": "engine poisoned (stub)"})
+            prompt = body.get("prompt") or []
+            toks = _stub_tokens(prompt,
+                                int(body.get("max_new_tokens", 4)))
+            state["served"] += 1
+            self._json(200, {"tokens": toks, "num_tokens": len(toks),
+                             "ttft_ms": delay * 1e3, "tpot_ms": 0.0,
+                             "pid": os.getpid()})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    stop_hb = threading.Event()
+
+    def hb_status():
+        if hb_frozen.is_set():
+            time.sleep(600)               # freeze: supervisor sees stale
+        return status()
+
+    threading.Thread(target=_heartbeat_loop,
+                     args=(run_dir, hb_status, stop_hb, 0.2),
+                     daemon=True).start()
+    _atomic_json(os.path.join(run_dir, READY_NAME),
+                 {"port": httpd.server_address[1], "pid": os.getpid(),
+                  "stub": True, "restored_prefix_records": 0})
+    import signal
+
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    done.wait()
+    httpd.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Real worker: DecodeEngine + FrontDoor + prefix-store warm restart
+# ---------------------------------------------------------------------------
+
+def run_engine(cfg: dict) -> int:
+    import signal
+
+    import jax
+
+    from paddle_tpu import serving
+    from paddle_tpu.models import gpt
+    from paddle_tpu.parallel import health
+
+    run_dir = cfg["run_dir"]
+    os.makedirs(run_dir, exist_ok=True)
+    m = cfg["model"]
+    mcfg = gpt.GPTConfig(
+        vocab_size=int(m["vocab_size"]),
+        max_seq_len=int(m.get("max_seq_len", 64)),
+        num_layers=int(m["num_layers"]), num_heads=int(m["num_heads"]),
+        d_model=int(m["d_model"]), d_ff=int(m["d_ff"]), remat=False)
+    params = gpt.init_params(jax.random.PRNGKey(int(m.get("seed", 0))),
+                             mcfg)
+    ekw = dict(cfg.get("engine") or {})
+    if "prefill_buckets" in ekw:
+        ekw["prefill_buckets"] = tuple(int(b)
+                                       for b in ekw["prefill_buckets"])
+    engine = serving.DecodeEngine(params, mcfg,
+                                  serving.EngineConfig(**ekw))
+    restored = 0
+    store = None
+    if cfg.get("prefix_store_dir"):
+        from paddle_tpu.serving.prefix_store import PrefixStore
+
+        store = PrefixStore(cfg["prefix_store_dir"])
+        restored = engine.attach_prefix_store(store)
+    engine.warmup()
+    skw = dict(cfg.get("scheduler") or {})
+    sched = serving.Scheduler(engine, serving.SchedulerConfig(**skw))
+
+    inject = cfg.get("inject") or {}
+    if inject:
+        orig_step = sched.step
+
+        def step():
+            done = sched.completed
+            if inject.get("hang_after") is not None \
+                    and done >= inject["hang_after"]:
+                # wedge the loop: progress stamps stop, the watchdog
+                # (armed from the gang's PADDLE_HEALTH_* env) exits 43
+                sys.stderr.write("[replica] injected hang\n")
+                sys.stderr.flush()
+                time.sleep(3600)
+            if inject.get("poison_after") is not None and \
+                    done >= inject["poison_after"] and \
+                    engine.poisoned is None:
+                # stand-in for an executable dying after cache donation
+                engine.poisoned = ("injected poison "
+                                   "(serve_fault_bench)")
+            if inject.get("die_after") is not None \
+                    and done >= inject["die_after"]:
+                os._exit(int(inject.get("die_code", 1)))
+            return orig_step()
+
+        sched.step = step
+
+    def on_poison(reason):
+        sys.stderr.write(f"[replica] engine poisoned ({reason}) — "
+                         f"exiting {POISONED_EXIT_CODE} for the gang\n")
+        sys.stderr.flush()
+        os._exit(POISONED_EXIT_CODE)
+
+    front = serving.FrontDoor(
+        scheduler=sched, port=int(cfg.get("port", 0)),
+        max_queue=int(cfg.get("max_queue", 64)),
+        request_timeout_s=float(cfg.get("request_timeout_s", 30.0)),
+        on_poison=on_poison).start()
+    # the gang's env contract arms the hang watchdog AFTER warmup (the
+    # engine's own compiles ran under health.suspend regardless)
+    health.maybe_install_from_env()
+    front.install_signal_handlers(
+        drain_timeout_s=float(cfg.get("drain_timeout_s", 30.0)))
+
+    stop_hb = threading.Event()
+    threading.Thread(
+        target=_heartbeat_loop,
+        args=(run_dir, lambda: front.health()["status"], stop_hb),
+        daemon=True).start()
+    _atomic_json(os.path.join(run_dir, READY_NAME),
+                 {"port": front.port, "pid": os.getpid(),
+                  "restored_prefix_records": int(restored)})
+    sys.stderr.write(f"[replica] ready on port {front.port} "
+                     f"(restored {restored} prefix records)\n")
+    sys.stderr.flush()
+    try:
+        while front._thread is not None and front._thread.is_alive():
+            time.sleep(0.2)
+    finally:
+        stop_hb.set()
+        if store is not None:
+            try:
+                store.close()
+            except Exception:
+                pass
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", required=True,
+                    help="path to the replica's JSON config")
+    args = ap.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    if cfg.get("stub") is not None:
+        return run_stub(cfg)
+    return run_engine(cfg)
+
+
+if __name__ == "__main__":
+    if __package__ in (None, ""):
+        # executed as a file by the gang supervisor: make the package
+        # importable without requiring an installed paddle_tpu
+        sys.path.insert(0, os.path.abspath(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "..")))
+    sys.exit(main())

@@ -27,7 +27,16 @@ retries. ``paddle_serve_preemptions_total{reason}`` meters it.
 Threading contract: ``submit``/``cancel`` may be called from any thread
 (the HTTP front door's handler pool); ``step``/``drain`` run on exactly
 one loop thread. Request completion is signaled through a per-request
-``threading.Event``.
+``threading.Event``. ``abort_all(refuse_new=True)`` — the poisoned-
+engine fail-fast path — is safe against racing submits: the refusal
+flag is set under the queue lock before the queue drains, so a
+concurrent submit is either failed with everyone else or cleanly
+refused, never parked on a queue no step will serve again.
+
+The scheduler also measures its own drain rate (terminal requests per
+second over a trailing window): ``queue_eta_s``/``retry_after_s`` feed
+the front door's deadline-aware shedding and Retry-After responses
+(docs/serving.md "Resilience").
 """
 from __future__ import annotations
 
@@ -131,9 +140,19 @@ class Scheduler:
         self._admit_order: List[int] = []         # slots, oldest first
         self._lock = threading.Lock()
         self._draining = False
+        # set by abort_all(refuse_new=True) — the fail-fast path for a
+        # poisoned engine: later submits get a clean error instead of
+        # queueing onto a scheduler that can never serve them
+        self._refusing: Optional[str] = None
         self.steps = 0
         self.occupancy_sum = 0.0                  # for mean occupancy
         self.preemptions = 0
+        self.completed = 0                        # requests finished DONE
+        # terminal-event timestamps feeding the measured drain rate that
+        # deadline-aware shedding / Retry-After are computed from
+        # (own lock: _finish runs under self._lock on some paths)
+        self._rate_lock = threading.Lock()
+        self._done_times: Deque[float] = deque(maxlen=256)
 
     # ------------------------------------------------------------------
     # producer side (any thread)
@@ -158,6 +177,8 @@ class Scheduler:
                       deadline=time.monotonic() + timeout,
                       sampling=sampling or GREEDY)
         with self._lock:
+            if self._refusing is not None:
+                raise RuntimeError(self._refusing)
             if self._draining:
                 raise RuntimeError("scheduler is draining")
             if len(self._queue) >= self.cfg.max_queue:
@@ -213,24 +234,37 @@ class Scheduler:
                 self.step()
         return False
 
-    def abort_all(self, reason: str) -> int:
+    def abort_all(self, reason: str, refuse_new: bool = False) -> int:
         """Fail every queued and active request (the loop's fault path —
         a step() exception must not leave waiters hanging on events that
         will never fire). Slots are freed; returns how many requests were
-        failed."""
+        failed.
+
+        ``refuse_new=True`` (the poisoned-engine fail-fast path) also
+        flips the scheduler into refusal: the flag is set under the lock
+        BEFORE the queue is drained, so a ``submit`` racing this call
+        either lands in the drained snapshot (and is failed here) or
+        raises the refusal error — it can never be parked on a queue no
+        step will ever serve again."""
+        with self._lock:
+            if refuse_new:
+                self._refusing = reason
+            queued = list(self._queue)
+            self._queue.clear()
+            smetrics.m_queue_depth.set(0)
         n = 0
         for slot in list(self._active):
             self._evict(slot, FAILED, reason)
             n += 1
-        with self._lock:
-            queued = list(self._queue)
-            self._queue.clear()
-            smetrics.m_queue_depth.set(0)
         for req in queued:
             self._finish(req, FAILED, reason)
             n += 1
         smetrics.m_active.set(0)
         return n
+
+    @property
+    def refusing(self) -> Optional[str]:
+        return self._refusing
 
     @property
     def draining(self) -> bool:
@@ -247,6 +281,45 @@ class Scheduler:
     @property
     def mean_occupancy(self) -> float:
         return self.occupancy_sum / self.steps if self.steps else 0.0
+
+    # ------------------------------------------------------------------
+    # measured drain rate -> deadline-aware shedding / Retry-After
+    # (docs/serving.md "Resilience": the front door rejects requests
+    # whose queue-drain ETA already exceeds their deadline, and tells
+    # the client when to come back instead of a flat 429)
+    # ------------------------------------------------------------------
+    def drain_rate(self, window_s: float = 10.0) -> Optional[float]:
+        """Terminal requests per second over the trailing window — the
+        rate the admission queue is actually draining at. None until two
+        requests have finished (no measurable rate yet)."""
+        now = time.monotonic()
+        with self._rate_lock:
+            recent = [t for t in self._done_times if t >= now - window_s]
+        if len(recent) < 2:
+            return None
+        span = max(now - recent[0], 1e-6)
+        return len(recent) / span
+
+    def queue_eta_s(self) -> Optional[float]:
+        """Estimated seconds until a request submitted NOW reaches a
+        decode slot: queue depth over the measured drain rate. 0.0 for an
+        empty queue; None when the rate is not yet measurable."""
+        with self._lock:
+            depth = len(self._queue)
+        if depth == 0:
+            return 0.0
+        rate = self.drain_rate()
+        if rate is None or rate <= 0:
+            return None
+        return depth / rate
+
+    def retry_after_s(self, cap_s: float = 60.0) -> int:
+        """Whole seconds a shed client should wait before retrying,
+        from the measured drain rate (>= 1; capped)."""
+        eta = self.queue_eta_s()
+        if eta is None:
+            return 1
+        return int(min(max(1.0, np.ceil(eta)), cap_s))
 
     # ------------------------------------------------------------------
     def _expire_queued(self, now: float) -> None:
@@ -471,6 +544,10 @@ class Scheduler:
         req.state = state
         if detail and state in (EXPIRED, FAILED):
             req.error = detail
+        if state == DONE:
+            self.completed += 1
+        with self._rate_lock:
+            self._done_times.append(time.monotonic())
         # close the request's root span: submit -> terminal state.  The
         # explicit span_id is what the lifecycle children parented to.
         end = time.perf_counter_ns()

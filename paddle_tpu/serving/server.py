@@ -42,7 +42,22 @@ from . import metrics as smetrics
 from .engine import PromptTooLongError
 from .scheduler import QueueFullError, Scheduler
 
-__all__ = ["FrontDoor", "EngineLoop"]
+__all__ = ["FrontDoor", "EngineLoop", "shed_decision"]
+
+
+def shed_decision(scheduler: Scheduler, timeout_s: float,
+                  retry_after_cap_s: float = 60.0):
+    """Deadline-aware admission check (docs/serving.md "Resilience"):
+    when the measured queue-drain ETA already exceeds the request's
+    deadline, admitting it only guarantees a 504 after the client waited
+    the full timeout — shed NOW with a Retry-After computed from the
+    drain rate instead. Returns ``None`` (admit) or ``(reason,
+    retry_after_s)``; counts ``paddle_serve_shed_total{reason}``."""
+    eta = scheduler.queue_eta_s()
+    if eta is None or eta <= timeout_s:
+        return None
+    smetrics.m_shed.labels("deadline").inc()
+    return "deadline", scheduler.retry_after_s(retry_after_cap_s)
 
 
 class EngineLoop:
@@ -54,13 +69,29 @@ class EngineLoop:
     504 with no operator-visible signal): the loop catches it, fails every
     queued/active request so their waiters wake with an error, records the
     fault (``faults``/``last_fault``, surfaced through ``/health``), and
-    keeps ticking."""
+    keeps ticking.
 
-    def __init__(self, scheduler: Scheduler, idle_sleep_s: float = 0.002):
+    A POISONED engine is different: no later step can ever succeed
+    (donated KV slabs are invalid — engine.py), so instead of 500ing
+    every request forever the loop fails fast — it aborts everything
+    with ``refuse_new`` (late submits get a clean error), records
+    ``poison_reason``, invokes ``on_poison`` (a supervised replica exits
+    with :data:`~paddle_tpu.serving.replica.POISONED_EXIT_CODE` here so
+    the gang recycles it with ``cause=poisoned``), and stops ticking.
+    ``/health`` reports status ``poisoned``.
+
+    Every iteration stamps hang-watchdog progress (``serve/tick``), so a
+    replica armed via the ``PADDLE_HEALTH_*`` env contract exits 43 when
+    the loop wedges — the same contract training workers follow."""
+
+    def __init__(self, scheduler: Scheduler, idle_sleep_s: float = 0.002,
+                 on_poison=None):
         self.scheduler = scheduler
         self.idle_sleep_s = idle_sleep_s
         self.faults = 0
         self.last_fault: Optional[str] = None
+        self.on_poison = on_poison
+        self.poison_reason: Optional[str] = None
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -85,7 +116,12 @@ class EngineLoop:
             self._thread.join(timeout=timeout)
 
     def _run(self) -> None:
+        from ..parallel import health as _health
+
         while not self._stop.is_set():
+            _health.progress("serve/tick")
+            if self._check_poisoned():
+                return
             worked = False
             if self.scheduler.pending():
                 try:
@@ -98,9 +134,39 @@ class EngineLoop:
                             f"engine loop fault: {self.last_fault}")
                     except Exception:
                         pass  # never let cleanup kill the loop either
+                    if self._check_poisoned():
+                        return
             if not worked:
                 self._wake.wait(timeout=self.idle_sleep_s)
                 self._wake.clear()
+
+    def _check_poisoned(self) -> bool:
+        """Fail-fast on a poisoned engine: abort + refuse, fire
+        ``on_poison``, stop the loop. Returns True when poisoned."""
+        reason = getattr(self.scheduler.engine, "poisoned", None)
+        if reason is None:
+            return False
+        if self.poison_reason is None:
+            self.poison_reason = str(reason)
+            try:
+                self.scheduler.abort_all(
+                    f"engine poisoned: {reason}", refuse_new=True)
+            except Exception:
+                pass
+            if self.on_poison is not None:
+                try:
+                    self.on_poison(self.poison_reason)
+                except Exception:
+                    pass
+        self._stop.set()
+        return True
+
+
+class _Server(ThreadingHTTPServer):
+    # the stdlib default listen backlog (5) resets connections under a
+    # burst of simultaneous connects — exactly the overload moment the
+    # shedding path exists for; shed with a 429, not a TCP reset
+    request_queue_size = 128
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -111,10 +177,17 @@ class _Handler(BaseHTTPRequestHandler):
             super().log_message(fmt, *args)
 
     # -- plumbing ----------------------------------------------------------
-    def _json(self, code: int, obj: Dict[str, Any]) -> None:
+    def _json(self, code: int, obj: Dict[str, Any],
+              retry_after: Optional[int] = None) -> None:
+        if retry_after is not None:
+            # both the header (standard clients) and a JSON field
+            # (the gang router + simple SDKs read the body only)
+            obj = dict(obj, retry_after_s=int(retry_after))
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        if retry_after is not None:
+            self.send_header("Retry-After", str(int(retry_after)))
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         try:
@@ -166,7 +239,8 @@ class _Handler(BaseHTTPRequestHandler):
         if front.scheduler is None:
             return self._json(400, {"error": "no generation engine loaded"})
         if front.draining:
-            return self._json(503, {"error": "server is draining"})
+            return self._json(503, {"error": "server is draining"},
+                              retry_after=front._retry_after())
         req_obj = self._read_json()
         if req_obj is None:
             return
@@ -178,6 +252,15 @@ class _Handler(BaseHTTPRequestHandler):
         timeout_s = req_obj.get("timeout_s")
         timeout_s = (front.request_timeout_s if timeout_s is None
                      else float(timeout_s))
+        if front.shed_deadline_aware:
+            shed = shed_decision(front.scheduler, timeout_s,
+                                 front.retry_after_cap_s)
+            if shed is not None:
+                reason, after = shed
+                return self._json(429, {
+                    "error": f"queue drain ETA exceeds the request "
+                             f"deadline ({timeout_s:.1f}s) — shed "
+                             f"({reason})"}, retry_after=after)
         try:
             sampling = None
             if any(k in req_obj for k in ("temperature", "top_k", "top_p",
@@ -194,13 +277,18 @@ class _Handler(BaseHTTPRequestHandler):
                     "max_new_tokens", 16)),
                 timeout_s=timeout_s, sampling=sampling)
         except QueueFullError as e:
-            return self._json(429, {"error": str(e)})
+            smetrics.m_shed.labels("queue_full").inc()
+            return self._json(429, {"error": str(e)},
+                              retry_after=front._retry_after())
         except PromptTooLongError as e:
             return self._json(400, {"error": str(e)})
         except (TypeError, ValueError) as e:
             return self._json(400, {"error": f"{type(e).__name__}: {e}"})
-        except RuntimeError as e:          # draining raced the check above
-            return self._json(503, {"error": str(e)})
+        except RuntimeError as e:
+            # draining raced the check above, or a poisoned engine's
+            # refusal — either way: clean 503, come back later/elsewhere
+            return self._json(503, {"error": str(e)},
+                              retry_after=front._retry_after())
         front.loop.wake()
         # the scheduler owns the deadline; +1s of slack covers loop wakeup
         request.wait(timeout=timeout_s + 1.0)
@@ -232,9 +320,10 @@ class _Handler(BaseHTTPRequestHandler):
                                                      dict):
             return self._json(400, {"error": "body must carry 'inputs'"})
         if not front._predict_slots.acquire(blocking=False):
+            smetrics.m_shed.labels("queue_full").inc()
             return self._json(429, {
                 "error": f"predict queue at capacity "
-                         f"({front.max_queue})"})
+                         f"({front.max_queue})"}, retry_after=1)
         t0 = time.monotonic()
         deadline = t0 + front.request_timeout_s
         try:
@@ -272,7 +361,9 @@ class FrontDoor:
     def __init__(self, scheduler: Optional[Scheduler] = None,
                  predictor=None, host: str = "127.0.0.1", port: int = 0,
                  max_queue: int = 64, request_timeout_s: float = 30.0,
-                 max_body_bytes: int = 256 << 20, verbose: bool = False):
+                 max_body_bytes: int = 256 << 20, verbose: bool = False,
+                 shed_deadline_aware: bool = True,
+                 retry_after_cap_s: float = 60.0, on_poison=None):
         if scheduler is None and predictor is None:
             raise ValueError("FrontDoor needs a scheduler or a predictor")
         self.scheduler = scheduler
@@ -281,13 +372,18 @@ class FrontDoor:
         self.request_timeout_s = float(request_timeout_s)
         self.max_body_bytes = int(max_body_bytes)
         self.verbose = verbose
+        # adaptive overload control (docs/serving.md "Resilience"):
+        # reject requests whose measured queue-drain ETA already exceeds
+        # their deadline, with a Retry-After from the drain rate
+        self.shed_deadline_aware = bool(shed_deadline_aware)
+        self.retry_after_cap_s = float(retry_after_cap_s)
         self._draining = False
         self._inflight = 0
         self._run_lock = threading.Lock()
         self._predict_slots = threading.BoundedSemaphore(self.max_queue)
-        self.loop = (EngineLoop(scheduler).start()
+        self.loop = (EngineLoop(scheduler, on_poison=on_poison).start()
                      if scheduler is not None else None)
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd = _Server((host, port), _Handler)
         self.httpd.daemon_threads = True
         self.httpd.front = self
         self._thread: Optional[threading.Thread] = None
@@ -301,6 +397,16 @@ class FrontDoor:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def _retry_after(self) -> int:
+        """Retry-After seconds for 429/503 responses, from the measured
+        scheduler drain rate (1 when no scheduler / no rate yet)."""
+        if self.scheduler is None:
+            return 1
+        try:
+            return self.scheduler.retry_after_s(self.retry_after_cap_s)
+        except Exception:
+            return 1
 
     def start(self) -> "FrontDoor":
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -343,6 +449,15 @@ class FrontDoor:
                     out["loop_last_fault"] = self.loop.last_fault
                 if not self.loop.alive and not self._draining:
                     out["status"] = "degraded"
+            # a poisoned engine outranks everything: donation invalidated
+            # its KV slabs, no request will ever succeed again — the gang
+            # supervisor recycles the replica on this status
+            poisoned = getattr(self.scheduler.engine, "poisoned", None)
+            if self.loop is not None and self.loop.poison_reason:
+                poisoned = poisoned or self.loop.poison_reason
+            if poisoned:
+                out["status"] = "poisoned"
+                out["engine_poisoned"] = str(poisoned)
         return out
 
     # -- graceful drain ----------------------------------------------------

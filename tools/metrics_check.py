@@ -619,6 +619,89 @@ def _run_check_inner(out_dir: str) -> dict:
         "paged smoke serve recompiled — zero-recompile contract broken"
     assert pengine.steady_state_recompiles == 0
 
+    # --- serving resilience gate (ISSUE 15, docs/serving.md
+    # "Resilience"): the persistent prefix store must round-trip —
+    # publish on engine C, restore on engine D, and the repeated system
+    # prompt prefills ONLY its suffix on the restarted engine — with
+    # EXACT save/restore counter deltas; and the deadline-aware shed
+    # path must emit its counter + Retry-After from the measured drain
+    # rate
+    def _prefix_store_ops():
+        s = default_registry().snapshot().get(
+            "paddle_serve_prefix_store_total", {}).get("series", [])
+        return {tuple(x["labels"])[0]: x["value"] for x in s}
+
+    store_dir = os.path.join(out_dir, "prefix_store")
+    ps_before = _prefix_store_ops()
+    cstore = pserving.PrefixStore(store_dir)
+    cengine = pserving.DecodeEngine(
+        sparams, scfg, pserving.EngineConfig(
+            max_batch=4, max_seq=32, prefill_buckets=(8, 16),
+            kv_layout="paged", page_size=8))
+    assert cengine.attach_prefix_store(cstore) == 0
+    cengine.warmup()
+    csched = pserving.Scheduler(cengine)
+    tok_before = _prefill_tok_total()
+    cr1 = csched.submit(system_prompt, max_new_tokens=3)
+    while csched.pending():
+        csched.step()
+    cstore.wait()
+    ps_mid = _prefix_store_ops()
+    assert ps_mid.get("save", 0) - ps_before.get("save", 0) == 1, \
+        (ps_before, ps_mid)
+    # "restart": fresh engine + fresh store handle over the same dir
+    dstore = pserving.PrefixStore(store_dir)
+    dengine = pserving.DecodeEngine(
+        sparams, scfg, pserving.EngineConfig(
+            max_batch=4, max_seq=32, prefill_buckets=(8, 16),
+            kv_layout="paged", page_size=8))
+    restored = dengine.attach_prefix_store(dstore)
+    assert restored == 1, restored
+    ps_after = _prefix_store_ops()
+    assert ps_after.get("restore", 0) - ps_mid.get("restore", 0) == 1
+    assert ps_after.get("restore_skipped", 0) == \
+        ps_before.get("restore_skipped", 0)
+    dengine.warmup()
+    dsched = pserving.Scheduler(dengine)
+    tok_before = _prefill_tok_total()
+    cr2 = dsched.submit(system_prompt, max_new_tokens=3)
+    while dsched.pending():
+        dsched.step()
+    warm_delta = _prefill_tok_total() - tok_before
+    assert warm_delta == 4, \
+        f"restarted engine prefilled {warm_delta} tokens for the " \
+        "repeated system prompt (expected only the 4-token suffix — " \
+        "the prefix store must survive the restart)"
+    assert cr1.tokens == cr2.tokens, "warm-restarted decode diverged"
+
+    # deadline-aware shedding: seeded drain rate + a queued backlog ->
+    # shed_decision rejects with reason=deadline and a Retry-After
+    # computed from that rate (exact counter delta)
+    def _shed_by_reason():
+        s = default_registry().snapshot().get(
+            "paddle_serve_shed_total", {}).get("series", [])
+        return {tuple(x["labels"])[0]: x["value"] for x in s}
+
+    shsched = pserving.Scheduler(sengine, pserving.SchedulerConfig(
+        max_queue=8))
+    import time as _time2
+
+    _now = _time2.monotonic()
+    with shsched._rate_lock:
+        shsched._done_times.extend(
+            [_now - 8, _now - 6, _now - 4, _now - 2])   # ~0.5 req/s
+    for _ in range(4):
+        shsched.submit([1, 2, 3])
+    shed_before = _shed_by_reason()
+    verdict = pserving.shed_decision(shsched, timeout_s=1.0)
+    assert verdict is not None and verdict[0] == "deadline", verdict
+    assert verdict[1] >= 1
+    shed_after = _shed_by_reason()
+    assert shed_after.get("deadline", 0) - \
+        shed_before.get("deadline", 0) == 1, (shed_before, shed_after)
+    assert pserving.shed_decision(shsched, timeout_s=120.0) is None
+    shsched.abort_all("metrics_check cleanup")
+
     # --- spec-decode gate: the acceptance histogram must meter windows
     # (draft == target -> every proposal accepted)
     starget = pserving.DecodeEngine(
@@ -743,11 +826,22 @@ def _run_check_inner(out_dir: str) -> dict:
                  "paddle_serve_spec_accepted_tokens",
                  "paddle_serve_spec_windows_total",
                  "paddle_serve_preemptions_total",
-                 "paddle_serve_hol_bypass_admits_total"):
+                 "paddle_serve_hol_bypass_admits_total",
+                 # ISSUE 15 resilience families: overload shedding,
+                 # gang replica recycles, failover re-dispatch, prefix
+                 # store save/restore (docs/serving.md "Resilience")
+                 "paddle_serve_shed_total",
+                 "paddle_serve_replica_restarts_total",
+                 "paddle_serve_failover_requests_total",
+                 "paddle_serve_prefix_store_total"):
         assert name in prom_text, f"{name} missing from exposition"
     assert 'paddle_serve_requests_total{code="200"}' in prom_text
     assert 'paddle_serve_prefix_cache_total{event="hit"}' in prom_text
     assert 'paddle_serve_prefix_cache_total{event="miss"}' in prom_text
+    # the resilience smoke above left exact samples for shed + store
+    assert 'paddle_serve_shed_total{reason="deadline"}' in prom_text
+    assert 'paddle_serve_prefix_store_total{op="save"}' in prom_text
+    assert 'paddle_serve_prefix_store_total{op="restore"}' in prom_text
     # streaming input families (docs/data.md): the seeded faulty stream
     # above must have left retry/quarantine/progress samples
     for name in ("paddle_input_retries_total",
@@ -782,6 +876,10 @@ def _run_check_inner(out_dir: str) -> dict:
                              "miss": int(pc.get("miss", 0)),
                              "first_prefill_tokens": int(d1),
                              "repeat_prefill_tokens": int(d2)},
+            "prefix_store": {"saved": int(cstore.saved),
+                             "restored": int(restored),
+                             "warm_restart_prefill_tokens":
+                                 int(warm_delta)},
             "spec_acceptance_rate": round(sspec.stats.acceptance_rate, 4),
             "program_reports": len(reports),
             "attribution": {

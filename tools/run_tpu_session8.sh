@@ -53,4 +53,16 @@ echo "=== sentinel rc=$? ==="
 echo "=== [5/5] metrics gate on-chip (incl. the attribution schema gate) $(date -u +%H:%M:%S) ==="
 python tools/metrics_check.py --out /tmp/metrics_check_tpu_s8
 echo "=== metrics_check rc=$? ==="
+
+# NOT run on-chip yet — serving-gang TPU caveat (ISSUE 15): the replica
+# gang (tools/serve_fault_bench.py) spawns one ENGINE PROCESS PER
+# REPLICA, and an unpinned jax TPU process claims every local chip —
+# two replicas on one host would deadlock on device ownership. Before
+# adding a gang lane here, pin each replica to its own chip subset via
+# per-replica env in ReplicaGang(env=...):
+#   TPU_VISIBLE_DEVICES=<chip-ids> TPU_PROCESS_BOUNDS=1,1,1
+# (and give each its own TPU_MESH_CONTROLLER_* ports). Until then every
+# committed SERVE_FAULT_BENCH.json number is the CPU smoke lane
+# (degraded: true); the single-process serving lanes above are
+# unaffected.
 date -u > .tpu_s8_done
