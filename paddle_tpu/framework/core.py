@@ -179,6 +179,14 @@ _GLOBAL_FLAGS = {
     # one update op per parameter. Equivalent to passing fuse=True to the
     # optimizer constructor; see docs/memory_levers.md.
     "FLAGS_fuse_optimizer": False,
+    # lower each fused flat-buffer optimizer group through ONE Pallas
+    # megakernel launch (ops/pallas_kernels._opt_megakernel) instead of
+    # the XLA elementwise-fusion stream the attribution ranks as the
+    # optimizer residue tail. None = auto (on on TPU, off elsewhere —
+    # interpret mode would only slow the CPU lane); True/False forces.
+    # Only reached when the flat sweep itself is on (fuse=True /
+    # FLAGS_fuse_optimizer). See docs/kernels.md.
+    "FLAGS_fuse_optimizer_pallas": None,
     # persistent XLA compilation cache directory ('' = disabled). When set,
     # repeated processes compiling the same program hit the on-disk cache
     # instead of paying the cold XLA compile (jax_compilation_cache_dir).

@@ -44,6 +44,10 @@ class ErnieConfig:
     # (ops/pallas_kernels.chunked_lm_loss) — [B, M, V] f32 logits never
     # materialize
     ce_vocab_chunk: int = 0
+    # route the post-LN blocks through ops/pallas_kernels.fused_ln (the
+    # residual add + layernorm in one launch fwd and bwd); opt-in —
+    # interpret-mode Pallas is slower than XLA off-TPU (docs/kernels.md)
+    fused_ln: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -126,11 +130,17 @@ def param_specs(cfg: ErnieConfig, tp: str = "tp") -> Dict[str, Any]:
 
 
 def _ln(x, scale, bias, eps=1e-12):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(
-        x.dtype)
+    # named_scope lands in the HLO op_name of the forward AND grad
+    # instructions, so the roofline attribution's residue ranking
+    # (observability/attribution.py) names the ernie layernorm groups
+    # instead of lumping them into anonymous elementwise fusions —
+    # mirror of gpt._layer_norm's scope
+    with jax.named_scope("layer_norm"):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(
+            x.dtype)
 
 
 def _attention(q, k, v, pad_mask, cfg: ErnieConfig):
@@ -158,18 +168,28 @@ def _attention(q, k, v, pad_mask, cfg: ErnieConfig):
 
 def _block(p, x, pad_mask, cfg: ErnieConfig):
     dt = cfg.dtype
+    if cfg.fused_ln:
+        from ..ops.pallas_kernels import fused_ln as _fln
+
+        def post_ln(res, o, scale, bias):
+            # residual add + post-LN in one Pallas launch (fwd and bwd)
+            return _fln(o, scale, bias, residual=res, eps=1e-12)
+    else:
+        def post_ln(res, o, scale, bias):
+            return _ln(res + o, scale, bias)
+
     qkv = jnp.einsum("btd,dcnh->btcnh", x, p["w_qkv"].astype(dt)) \
         + p["b_qkv"].astype(dt)
     a = _attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], pad_mask, cfg)
     o = jnp.einsum("btnh,nhd->btd", a, p["w_proj"].astype(dt)) \
         + p["b_proj"].astype(dt)
-    x = _ln(x + o, p["ln1_scale"], p["ln1_bias"])      # post-LN (BERT)
+    x = post_ln(x, o, p["ln1_scale"], p["ln1_bias"])   # post-LN (BERT)
     h = jnp.einsum("btd,df->btf", x, p["w_fc"].astype(dt)) \
         + p["b_fc"].astype(dt)
     h = jax.nn.gelu(h, approximate=False)
     o = jnp.einsum("btf,fd->btd", h, p["w_out"].astype(dt)) \
         + p["b_out"].astype(dt)
-    return _ln(x + o, p["ln2_scale"], p["ln2_bias"])
+    return post_ln(x, o, p["ln2_scale"], p["ln2_bias"])
 
 
 def encode(params, tokens, seg_ids, pad_mask, cfg: ErnieConfig):

@@ -63,6 +63,12 @@ class GPTConfig:
     # (ops/pallas_kernels.chunked_lm_loss) so even one row-chunk's logits
     # never materialize at full vocab width
     ce_vocab_chunk: int = 0
+    # route every block layernorm (and the residual+bias add feeding ln2)
+    # through ops/pallas_kernels.fused_ln — one Pallas launch fwd, one bwd,
+    # instead of the add/layernorm small-fusion residue ATTRIBUTION.json
+    # ranks (docs/kernels.md). Opt-in: interpret-mode Pallas is slower
+    # than XLA off-TPU.
+    fused_ln: bool = False
 
     def __post_init__(self):
         from ..parallel import remat as remat_mod
@@ -212,8 +218,14 @@ def block_fn(p, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
             return y
         return jax.lax.psum_scatter(y, tp_axis, scatter_dimension=1, tiled=True)
 
+    if cfg.fused_ln:
+        from ..ops.pallas_kernels import fused_ln as _fln
+
     # --- attention ---
-    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    if cfg.fused_ln:
+        h = _fln(x, p["ln1_scale"], p["ln1_bias"], eps=1e-5)
+    else:
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     h = gather(h)                                     # [B, T, D]
     qkv = jnp.einsum("btd,dcnh->btcnh", h, p["w_qkv"].astype(dt))
     qkv = qkv + p["b_qkv"].astype(dt)
@@ -221,10 +233,17 @@ def block_fn(p, x, cfg: GPTConfig, tp_axis: Optional[str] = None):
     a = _causal_attention(q, k, v, cfg)               # [B, T, nh_local, hd]
     o = jnp.einsum("btnh,nhd->btd", a, p["w_proj"].astype(dt))
     o = scatter_sum(o)                                # [B, T/tp, D]
-    x = x + o + p["b_proj"].astype(dt)
 
     # --- mlp ---
-    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    if cfg.fused_ln:
+        # one launch for the (x + o) + b_proj residual AND ln2; the summed
+        # stream comes back as the next residual input
+        h, x = _fln(o, p["ln2_scale"], p["ln2_bias"], residual=x,
+                    bias_add=p["b_proj"].astype(dt), eps=1e-5,
+                    return_residual=True)
+    else:
+        x = x + o + p["b_proj"].astype(dt)
+        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     h = gather(h)
     h = jnp.einsum("btd,df->btf", h, p["w_fc"].astype(dt)) + p["b_fc"].astype(dt)
     h = jax.nn.gelu(h, approximate=True)
@@ -263,8 +282,16 @@ def embed(p, tokens, cfg: GPTConfig, pos_offset=0):
     return x.astype(cfg.dtype)
 
 
+def _final_ln(p, x, cfg: GPTConfig):
+    if cfg.fused_ln:
+        from ..ops.pallas_kernels import fused_ln as _fln
+
+        return _fln(x, p["ln_f_scale"], p["ln_f_bias"], eps=1e-5)
+    return _layer_norm(x, p["ln_f_scale"], p["ln_f_bias"])
+
+
 def logits_fn(p, x, cfg: GPTConfig):
-    x = _layer_norm(x, p["ln_f_scale"], p["ln_f_bias"])
+    x = _final_ln(p, x, cfg)
     return jnp.einsum("btd,dv->btv", x, p["lm_head"].astype(cfg.dtype))
 
 
@@ -309,7 +336,7 @@ def ce_from_hidden(params, x, labels, cfg: GPTConfig,
     head = params["lm_head"]
     B, T, D = x.shape
     V = head.shape[-1]
-    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    x = _final_ln(params, x, cfg)
     rows = x.reshape(B * T, D)
     labs = labels.reshape(B * T)
     n = rows.shape[0]

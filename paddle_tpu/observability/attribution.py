@@ -194,6 +194,14 @@ def hlo_instruction_costs(hlo_text: str) -> Dict[str, Dict[str, Any]]:
 # keyword -> label, in specificity order; matched against the lowercased
 # HLO metadata op_name (jax scope path) first, then the hlo op name
 _LABEL_KEYWORDS = (
+    # the Pallas megakernels (ops/pallas_kernels.py, docs/kernels.md) get
+    # their own family line so a before/after residue diff separates the
+    # residue each kernel ELIMINATES (its old group shrinks) from the
+    # kernel's own cost (one custom call on TPU; interpret-mode emulation
+    # ops on the CPU lane) — matched first because the scope names embed
+    # the group keywords ("fused_layernorm" contains "layernorm")
+    (("fused_layernorm", "fused_opt_megakernel", "fused_decode",
+      "fused_logits"), "megakernel"),
     (("adam", "adamw", "sgd", "momentum", "fused_opt", "opt_update",
       "apply_grad", "optimizer", "lamb"), "optimizer"),
     (("layer_norm", "layernorm", "rms_norm", "rmsnorm"), "layernorm"),

@@ -105,9 +105,13 @@ def paged_gather(pool, tables):
 
     Returns [B, M*page, nh, hd] — the slot-major layout every attention
     helper here already consumes, so the paged variants are gather +
-    the existing masked-softmax kernels (one fused gather under XLA; a
-    Pallas gather-attention fusion is the KERNEL_NOTES follow-up once
-    decode batches make the [B, S] round-trip measurable)."""
+    the existing masked-softmax kernels (one fused gather under XLA).
+    The Pallas gather-attention fusion this docstring used to promise
+    landed as ``pallas_kernels.fused_paged_decode_attention`` — the
+    one-launch decode step behind ``EngineConfig(fused_decode=True)``
+    walks the table in-kernel and skips the [B, S] round-trip entirely
+    (docs/kernels.md); this materializing path stays the default off-TPU
+    and the parity reference."""
     B, M = tables.shape
     g = pool[tables]                       # [B, M, page, nh, hd]
     return g.reshape(B, M * pool.shape[1], pool.shape[2], pool.shape[3])

@@ -400,13 +400,27 @@ def _fused_lr(ins, op):
     return _lr(ins).astype(jnp.float32) * float(op.attr("lr_mult", 1.0))
 
 
+def _use_megakernel():
+    """One Pallas launch per fused group instead of the XLA elementwise
+    stream — FLAGS_fuse_optimizer_pallas (None = auto: TPU only)."""
+    from ..framework.core import get_flag
+    from .pallas_kernels import use_opt_megakernel
+
+    return use_opt_megakernel(get_flag("FLAGS_fuse_optimizer_pallas"))
+
+
 @register_op("fused_sgd", grad=None, is_optimizer=True)
 def fused_sgd(ctx, op, ins):
     ps, gs = ins["Param"], ins["Grad"]
     dt = ps[0].dtype                     # group key pins one dtype per op
     pf = _flat_cat(ps, dt)
     gf = _flat_cat(gs, dt)
-    p_new = pf - _fused_lr(ins, op).astype(dt) * gf
+    if _use_megakernel():
+        from .pallas_kernels import megakernel_sgd
+
+        p_new = megakernel_sgd(pf, gf, _fused_lr(ins, op))
+    else:
+        p_new = pf - _fused_lr(ins, op).astype(dt) * gf
     return {"ParamOut": _split_like(p_new, ps)}
 
 
@@ -419,11 +433,17 @@ def fused_momentum(ctx, op, ins):
     use_nesterov = op.attr("use_nesterov", False)
     gf = _flat_cat(gs, jnp.float32)
     pf = _flat_cat(ps, jnp.float32)
-    v_new = mu * v.astype(jnp.float32) + gf
-    if use_nesterov:
-        p_new = pf - (gf + mu * v_new) * lr
+    if _use_megakernel():
+        from .pallas_kernels import megakernel_momentum
+
+        p_new, v_new = megakernel_momentum(
+            pf, gf, v, lr, mu=mu, nesterov=use_nesterov)
     else:
-        p_new = pf - lr * v_new
+        v_new = mu * v.astype(jnp.float32) + gf
+        if use_nesterov:
+            p_new = pf - (gf + mu * v_new) * lr
+        else:
+            p_new = pf - lr * v_new
     return {"ParamOut": _split_like(p_new, ps),
             "VelocityOut": v_new.astype(v.dtype)}
 
@@ -438,14 +458,21 @@ def _fused_adam_impl(ctx, op, ins, coeff):
     eps = op.attr("epsilon", 1e-8)
     gf = _flat_cat(gs, jnp.float32)
     pf = _flat_cat(ps, jnp.float32)
-    m_new = b1 * m + (1 - b1) * gf
-    v_new = b2 * v + (1 - b2) * gf * gf
     b1p_f = b1p.reshape(()).astype(jnp.float32)
     b2p_f = b2p.reshape(()).astype(jnp.float32)
-    lr_t = lr * jnp.sqrt(1 - b2p_f * b2) / (1 - b1p_f * b1)
-    p_new = pf - lr_t * m_new / (jnp.sqrt(v_new) + eps)
-    if coeff:
-        p_new = p_new - lr * coeff * pf    # decoupled weight decay (AdamW)
+    if _use_megakernel():
+        from .pallas_kernels import megakernel_adam
+
+        p_new, m_new, v_new = megakernel_adam(
+            pf, gf, m, v, lr, b1p_f, b2p_f, b1=b1, b2=b2, eps=eps,
+            coeff=coeff)
+    else:
+        m_new = b1 * m + (1 - b1) * gf
+        v_new = b2 * v + (1 - b2) * gf * gf
+        lr_t = lr * jnp.sqrt(1 - b2p_f * b2) / (1 - b1p_f * b1)
+        p_new = pf - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        if coeff:
+            p_new = p_new - lr * coeff * pf  # decoupled decay (AdamW)
     return {
         "ParamOut": _split_like(p_new, ps),
         "Moment1Out": m_new,

@@ -843,3 +843,749 @@ def _logits_ce_bwd(v_chunk, res, ct):
 
 
 chunked_softmax_ce_from_logits.defvjp(_logits_ce_fwd, _logits_ce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Megakernel launch accounting (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _count_launch(kernel: str) -> None:
+    """Tick ``paddle_megakernel_launches_total{kernel}``.
+
+    Incremented at TRACE time — once per megakernel instance traced into
+    a compiled executable (e.g. one per (dtype, hparam-signature) group
+    for the optimizer sweep), NOT once per executed step: Python cannot
+    observe device-side replays of a jitted program.
+    tools/metrics_check.py gates an exact delta for a fused-opt smoke
+    train on this definition."""
+    from paddle_tpu.observability.metrics import default_registry
+
+    default_registry().counter(
+        "paddle_megakernel_launches_total",
+        "Pallas megakernel launches traced into compiled executables "
+        "(counted per trace/compile, not per executed step)",
+        labelnames=("kernel",)).labels(kernel).inc()
+
+
+# ---------------------------------------------------------------------------
+# Fused layernorm + residual (+ bias-add / dropout) block kernel (ISSUE 16a)
+# ---------------------------------------------------------------------------
+#
+# The train-step attribution (ATTRIBUTION.json) ranks a layernorm residue
+# group plus the elementwise adds feeding it: every transformer block's
+# ``x + o + b`` residual add and the following layernorm (forward AND its
+# grads) lower as separate small fusions, each paying an HBM round-trip at
+# [B*T, D]. This kernel computes
+#
+#     s = dropout(x) + residual + bias_add    (in x.dtype — the exact
+#                                              "(x + o) + b" association
+#                                              of models/gpt.py block_fn)
+#     y = (s - mu) * rsqrt(var + eps) * scale + bias   (statistics in f32,
+#                                              y cast back to x.dtype)
+#
+# in ONE launch, emits the lane-replicated (mu, rstd) statistics, and
+# differentiates through a hand-written Pallas backward (custom_vjp) in
+# the same row tiling. models/gpt.py and models/ernie.py route every block
+# layernorm through fused_ln behind their ``fused_ln`` config flags
+# (default off: interpret-mode Pallas is slower than XLA off-TPU).
+
+
+def _ln_fwd_kernel(*refs, eps, has_res, has_badd, has_mask, inv_keep,
+                   emit_s):
+    it = iter(refs)
+    x_ref = next(it)
+    res_ref = next(it) if has_res else None
+    badd_ref = next(it) if has_badd else None
+    mask_ref = next(it) if has_mask else None
+    scale_ref = next(it)
+    bias_ref = next(it)
+    y_ref = next(it)
+    s_ref = next(it) if emit_s else None
+    mu_ref = next(it)
+    rstd_ref = next(it)
+
+    s = x_ref[...]
+    if mask_ref is not None:
+        s = s * mask_ref[...].astype(s.dtype) * jnp.asarray(
+            inv_keep, s.dtype)
+    if res_ref is not None:
+        s = res_ref[...] + s
+    if badd_ref is not None:
+        s = s + badd_ref[...]
+    if s_ref is not None:
+        s_ref[...] = s
+
+    s32 = s.astype(jnp.float32)
+    mu = jnp.mean(s32, axis=1, keepdims=True)             # (br, 1)
+    var = jnp.var(s32, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (s32 - mu) * rstd
+    y = y * scale_ref[...].astype(jnp.float32) \
+        + bias_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    mu_ref[...] = jnp.broadcast_to(mu, mu_ref.shape)
+    rstd_ref[...] = jnp.broadcast_to(rstd, rstd_ref.shape)
+
+
+def _ln_bwd_kernel(*refs, has_dsx, has_mask, inv_keep):
+    it = iter(refs)
+    sx_ref = next(it)
+    mu_ref = next(it)
+    rstd_ref = next(it)
+    scale_ref = next(it)
+    dy_ref = next(it)
+    dsx_ref = next(it) if has_dsx else None
+    mask_ref = next(it) if has_mask else None
+    ds_ref = next(it)
+    dx_ref = next(it) if has_mask else None
+    dscale_ref = next(it)
+    dbias_ref = next(it)
+
+    s32 = sx_ref[...].astype(jnp.float32)
+    mu = mu_ref[...][:, :1]
+    rstd = rstd_ref[...][:, :1]
+    xhat = (s32 - mu) * rstd
+    dy = dy_ref[...].astype(jnp.float32)
+    g = dy * scale_ref[...].astype(jnp.float32)
+    gm = jnp.mean(g, axis=1, keepdims=True)
+    gxm = jnp.mean(g * xhat, axis=1, keepdims=True)
+    ds = rstd * (g - gm - xhat * gxm)
+    if dsx_ref is not None:
+        ds = ds + dsx_ref[...].astype(jnp.float32)
+    ds_ref[...] = ds.astype(ds_ref.dtype)
+    if dx_ref is not None:
+        dx = ds * mask_ref[...].astype(jnp.float32) * inv_keep
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+    # per-grid-block partial reductions; the host sums the (ngrid, D)
+    # partials so the row grid stays embarrassingly parallel
+    dscale_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    dbias_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+
+
+def _ln_pad_rows(a, rp):
+    r = a.shape[0]
+    if r == rp:
+        return a
+    return jnp.concatenate(
+        [a, jnp.zeros((rp - r,) + a.shape[1:], a.dtype)], axis=0)
+
+
+def _ln_fwd(x, scale, bias, residual, badd, mask, eps, keep, block_rows):
+    r, d = x.shape
+    br = min(block_rows, max(r, 1))
+    ng = -(-r // br)
+    rp = ng * br
+    emit_s = residual is not None or badd is not None or mask is not None
+    row_spec = pl.BlockSpec((br, d), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    xp = _ln_pad_rows(x, rp)
+    args, in_specs = [xp], [row_spec]
+    if residual is not None:
+        args.append(_ln_pad_rows(residual, rp))
+        in_specs.append(row_spec)
+    if badd is not None:
+        args.append(badd.reshape(1, d))
+        in_specs.append(vec_spec)
+    if mask is not None:
+        args.append(_ln_pad_rows(mask, rp))
+        in_specs.append(row_spec)
+    args += [scale.reshape(1, d), bias.reshape(1, d)]
+    in_specs += [vec_spec, vec_spec]
+    stat_spec = pl.BlockSpec((br, NUM_LANES), lambda i: (i, 0))
+    out_specs = [row_spec]
+    out_shape = [jax.ShapeDtypeStruct((rp, d), x.dtype)]
+    if emit_s:
+        out_specs.append(row_spec)
+        out_shape.append(jax.ShapeDtypeStruct((rp, d), x.dtype))
+    out_specs += [stat_spec, stat_spec]
+    out_shape += [jax.ShapeDtypeStruct((rp, NUM_LANES), jnp.float32)] * 2
+    kern = functools.partial(
+        _ln_fwd_kernel, eps=eps, has_res=residual is not None,
+        has_badd=badd is not None, has_mask=mask is not None,
+        inv_keep=1.0 / keep, emit_s=emit_s)
+    with jax.named_scope("fused_layernorm_fwd"):
+        outs = pl.pallas_call(
+            kern, grid=(ng,), in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape,
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=_interpret(),
+        )(*args)
+    if emit_s:
+        y, s, mu, rstd = outs
+        sx = s
+    else:
+        y, mu, rstd = outs
+        s, sx = None, xp
+    return y[:r], (None if s is None else s[:r]), sx, mu, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _fused_ln(x, scale, bias, residual, badd, mask, eps, keep,
+              return_residual, block_rows):
+    y, s, _sx, _mu, _rstd = _ln_fwd(x, scale, bias, residual, badd, mask,
+                                    eps, keep, block_rows)
+    return (y, s) if return_residual else y
+
+
+def _fused_ln_vjp_fwd(x, scale, bias, residual, badd, mask, eps, keep,
+                      return_residual, block_rows):
+    y, s, sx, mu, rstd = _ln_fwd(x, scale, bias, residual, badd, mask,
+                                 eps, keep, block_rows)
+    # zero-size tags carry the optional operands' dtypes to the bwd pass
+    # without holding their values live
+    res_tag = None if residual is None else jnp.zeros((0,), residual.dtype)
+    badd_tag = None if badd is None else jnp.zeros((0,), badd.dtype)
+    bias_tag = jnp.zeros((0,), bias.dtype)
+    maskp = None if mask is None else _ln_pad_rows(mask, sx.shape[0])
+    out = (y, s) if return_residual else y
+    return out, (sx, mu, rstd, scale, maskp, res_tag, badd_tag, bias_tag)
+
+
+def _fused_ln_vjp_bwd(eps, keep, return_residual, block_rows, res, ct):
+    import numpy as _onp
+
+    sx, mu, rstd, scale, maskp, res_tag, badd_tag, bias_tag = res
+    if return_residual:
+        dy, dsx = ct
+    else:
+        dy, dsx = ct, None
+    r, d = dy.shape
+    rp = sx.shape[0]
+    br = min(block_rows, max(r, 1))
+    ng = rp // br
+    has_mask = maskp is not None
+    row_spec = pl.BlockSpec((br, d), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    stat_spec = pl.BlockSpec((br, NUM_LANES), lambda i: (i, 0))
+    part_spec = pl.BlockSpec((1, d), lambda i: (i, 0))
+    args = [sx, mu, rstd, scale.reshape(1, d), _ln_pad_rows(dy, rp)]
+    in_specs = [row_spec, stat_spec, stat_spec, vec_spec, row_spec]
+    if dsx is not None:
+        args.append(_ln_pad_rows(dsx, rp))
+        in_specs.append(row_spec)
+    if has_mask:
+        args.append(maskp)
+        in_specs.append(row_spec)
+    out_specs = [row_spec]
+    out_shape = [jax.ShapeDtypeStruct((rp, d), sx.dtype)]
+    if has_mask:
+        out_specs.append(row_spec)
+        out_shape.append(jax.ShapeDtypeStruct((rp, d), sx.dtype))
+    out_specs += [part_spec, part_spec]
+    out_shape += [jax.ShapeDtypeStruct((ng, d), jnp.float32)] * 2
+    kern = functools.partial(
+        _ln_bwd_kernel, has_dsx=dsx is not None, has_mask=has_mask,
+        inv_keep=1.0 / keep)
+    with jax.named_scope("fused_layernorm_bwd"):
+        outs = pl.pallas_call(
+            kern, grid=(ng,), in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape,
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=_interpret(),
+        )(*args)
+    if has_mask:
+        ds_p, dx_p, dscale_p, dbias_p = outs
+        dx = dx_p[:r]
+    else:
+        ds_p, dscale_p, dbias_p = outs
+        dx = ds_p[:r]
+    ds = ds_p[:r]
+    dscale = jnp.sum(dscale_p, axis=0).astype(scale.dtype)
+    dbias = jnp.sum(dbias_p, axis=0).astype(bias_tag.dtype)
+    dres = None if res_tag is None else ds.astype(res_tag.dtype)
+    dbadd = None if badd_tag is None \
+        else jnp.sum(ds, axis=0).astype(badd_tag.dtype)
+    dmask = None if maskp is None \
+        else _onp.zeros((r, d), jax.dtypes.float0)
+    return dx, dscale, dbias, dres, dbadd, dmask
+
+
+_fused_ln.defvjp(_fused_ln_vjp_fwd, _fused_ln_vjp_bwd)
+
+
+def fused_ln(x, scale, bias, residual=None, bias_add=None, *,
+             eps: float = 1e-5, dropout_rate: float = 0.0,
+             dropout_key=None, return_residual: bool = False,
+             block_rows: int = 128):
+    """Fused layernorm(+residual+bias-add+dropout) block kernel.
+
+    Computes ``s = dropout(x) + residual + bias_add`` in ``x.dtype``
+    (matching the models' ``(x + o) + b`` association) followed by a
+    layernorm over the last axis with f32 statistics — one Pallas launch
+    forward, one backward (custom_vjp), instead of the
+    add / layernorm / layernorm-grad small-fusion residue the step
+    attribution ranks (docs/kernels.md).
+
+    x:            [..., D]
+    scale, bias:  [D]
+    residual:     optional [..., D] — added to (dropped-out) ``x``
+    bias_add:     optional [D]     — broadcast-added after the residual
+    dropout_rate: inverted dropout on ``x`` (requires ``dropout_key``);
+                  the mask is drawn outside the kernel and applied inside
+    return_residual: also return ``s`` (the pre-norm sum — the models
+                  carry it forward as the next residual stream)
+
+    Returns ``y`` or ``(y, s)``, both shaped/typed like ``x``.
+    """
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    r = 1
+    for n in lead:
+        r *= int(n)
+    x2 = x.reshape(r, d)
+    res2 = None if residual is None else residual.reshape(r, d)
+    badd = None if bias_add is None else bias_add.reshape(d)
+    mask = None
+    keep = 1.0
+    if dropout_rate:
+        if dropout_key is None:
+            raise ValueError("dropout_rate > 0 requires dropout_key")
+        keep = 1.0 - float(dropout_rate)
+        mask = jax.random.bernoulli(dropout_key, keep, (r, d))
+    _count_launch("fused_ln")
+    out = _fused_ln(x2, scale, bias, res2, badd, mask, float(eps), keep,
+                    return_residual, block_rows)
+    if return_residual:
+        y, s = out
+        return y.reshape(x.shape), s.reshape(x.shape)
+    return out.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer megakernel (ISSUE 16b)
+# ---------------------------------------------------------------------------
+#
+# The attribution's optimizer residue group (~59 multiply_add_fusion
+# events/step at the smoke config) is the per-group tail of the fused
+# flat-buffer sweep: even over PR 2's [numel] megabuffers, XLA splits the
+# update expression into a stream of small elementwise fusions. This
+# single kernel sweeps the flat buffers once — ONE launch per
+# (dtype, hparam-signature) group — reproducing each unfused expression
+# ORDER exactly, so parity is bitwise at f32. Reductions (grad norm /
+# clip scale) stay outside; their results ride in as dynamic scalars via
+# scalar-prefetch SMEM next to lr and the Adam bias-correction powers.
+
+_OPT_SCALAR_SLOTS = 8
+
+
+def _opt_kernel(scal_ref, *refs, kind, b1=0.9, b2=0.999, eps=1e-8,
+                mu=0.9, nesterov=False, coeff=0.0, weight_decay=0.0):
+    # scal_ref (SMEM, f32[8]): [lr, b1pow, b2pow, clip_scale, c1, c2, -, -]
+    lr = scal_ref[0]
+    if kind == "sgd":
+        # fluid fused_sgd: dtype-native p - lr * g
+        p_ref, g_ref, po_ref = refs
+        p = p_ref[...]
+        po_ref[...] = p - lr.astype(p.dtype) * g_ref[...]
+    elif kind == "momentum":
+        p_ref, g_ref, v_ref, po_ref, vo_ref = refs
+        gf = g_ref[...].astype(jnp.float32)
+        pf = p_ref[...].astype(jnp.float32)
+        v_new = mu * v_ref[...].astype(jnp.float32) + gf
+        if nesterov:
+            p_new = pf - (gf + mu * v_new) * lr
+        else:
+            p_new = pf - lr * v_new
+        po_ref[...] = p_new.astype(po_ref.dtype)
+        vo_ref[...] = v_new.astype(vo_ref.dtype)
+    elif kind == "adam":
+        # fluid _fused_adam_impl (coeff > 0 -> AdamW decoupled decay)
+        p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref = refs
+        b1p, b2p = scal_ref[1], scal_ref[2]
+        gf = g_ref[...].astype(jnp.float32)
+        pf = p_ref[...].astype(jnp.float32)
+        m_new = b1 * m_ref[...] + (1 - b1) * gf
+        v_new = b2 * v_ref[...] + (1 - b2) * gf * gf
+        lr_t = lr * jnp.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+        p_new = pf - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        if coeff:
+            p_new = p_new - lr * coeff * pf
+        po_ref[...] = p_new.astype(po_ref.dtype)
+        mo_ref[...] = m_new
+        vo_ref[...] = v_new
+    else:  # "adamw_mask": parallel/parallelize.py flat AdamW sweep
+        p_ref, g_ref, m_ref, v_ref, wd_ref, po_ref, mo_ref, vo_ref = refs
+        scale, c1, c2 = scal_ref[3], scal_ref[4], scal_ref[5]
+        gf = g_ref[...].astype(jnp.float32) * scale
+        pf = p_ref[...]
+        mf = b1 * m_ref[...].astype(jnp.float32) + (1 - b1) * gf
+        vf = b2 * v_ref[...].astype(jnp.float32) + (1 - b2) * gf * gf
+        u = (mf / c1) / (jnp.sqrt(vf / c2) + eps)
+        po_ref[...] = pf - lr * (u + weight_decay * wd_ref[...] * pf)
+        mo_ref[...] = mf.astype(mo_ref.dtype)
+        vo_ref[...] = vf.astype(vo_ref.dtype)
+
+
+def _opt_megakernel(kind, ins, outs_dtype, scalars, aliases,
+                    block_rows=256, **static):
+    """One Pallas launch over flat [n] optimizer megabuffers.
+
+    ``ins`` are flat [n] arrays (param, grad, moments, mask —
+    kind-specific order), padded to (rows, 128) lanes and swept by one
+    row-block grid. Elementwise only — each expression matches its
+    unfused reference bit-for-bit at f32. ``aliases`` maps in-index ->
+    out-index for in-place param/moment updates (indices count the
+    scalar operand first, per pallas aliasing numbering)."""
+    n = ins[0].shape[0]
+    rows = -(-n // NUM_LANES)
+    br = min(block_rows, max(rows, 1))
+    ng = -(-rows // br)
+    padded = ng * br * NUM_LANES
+
+    def pad2(a):
+        a = a.reshape(-1)
+        if a.shape[0] != padded:
+            a = jnp.concatenate(
+                [a, jnp.zeros((padded - a.shape[0],), a.dtype)])
+        return a.reshape(ng * br, NUM_LANES)
+
+    pad_s = _OPT_SCALAR_SLOTS - len(scalars)
+    scal = jnp.stack([jnp.asarray(v, jnp.float32) for v in scalars]
+                     + [jnp.zeros((), jnp.float32)] * pad_s)
+    row_spec = pl.BlockSpec((br, NUM_LANES), lambda i, s: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(ng,),
+        in_specs=[row_spec] * len(ins),
+        out_specs=[row_spec] * len(outs_dtype))
+    outs = pl.pallas_call(
+        functools.partial(_opt_kernel, kind=kind, **static),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((ng * br, NUM_LANES), dt)
+                   for dt in outs_dtype],
+        input_output_aliases=dict(aliases),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=_interpret(),
+    )(scal, *[pad2(a) for a in ins])
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return [o.reshape(-1)[:n] for o in outs]
+
+
+def megakernel_sgd(p, g, lr):
+    """p_new = p - lr.astype(p.dtype) * g over a flat [n] group."""
+    _count_launch("opt_sgd")
+    with jax.named_scope("fused_opt_megakernel/sgd"):
+        (p_new,) = _opt_megakernel("sgd", [p, g], [p.dtype], [lr],
+                                   {1: 0})
+    return p_new
+
+
+def megakernel_momentum(p, g, v, lr, *, mu=0.9, nesterov=False):
+    _count_launch("opt_momentum")
+    with jax.named_scope("fused_opt_megakernel/momentum"):
+        p_new, v_new = _opt_megakernel(
+            "momentum", [p, g, v], [p.dtype, v.dtype], [lr],
+            {1: 0, 3: 1}, mu=float(mu), nesterov=bool(nesterov))
+    return p_new, v_new
+
+
+def megakernel_adam(p, g, m, v, lr, b1p, b2p, *, b1=0.9, b2=0.999,
+                    eps=1e-8, coeff=0.0):
+    """fluid fused_adam/fused_adamw flat group (f32 moments; the
+    Beta1Pow/Beta2Pow scalar updates stay outside)."""
+    _count_launch("opt_adamw" if coeff else "opt_adam")
+    with jax.named_scope("fused_opt_megakernel/adam"):
+        p_new, m_new, v_new = _opt_megakernel(
+            "adam", [p, g, m, v], [p.dtype, jnp.float32, jnp.float32],
+            [lr, b1p, b2p], {1: 0, 3: 1, 4: 2}, b1=float(b1),
+            b2=float(b2), eps=float(eps), coeff=float(coeff))
+    return p_new, m_new, v_new
+
+
+def megakernel_adamw_flat(p, g, m, v, wd_mask, lr, scale, c1, c2, *,
+                          b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.1):
+    """parallelize._adamw_update_fused elementwise sweep: p/g flat f32,
+    m/v flat in their storage dtype, wd_mask flat f32; grad-norm clip
+    ``scale`` and bias corrections c1/c2 precomputed outside."""
+    _count_launch("opt_adamw_flat")
+    with jax.named_scope("fused_opt_megakernel/adamw_flat"):
+        p_new, m_new, v_new = _opt_megakernel(
+            "adamw_mask", [p, g, m, v, wd_mask],
+            [p.dtype, m.dtype, v.dtype],
+            [lr, 0.0, 0.0, scale, c1, c2], {1: 0, 3: 1, 4: 2},
+            b1=float(b1), b2=float(b2), eps=float(eps),
+            weight_decay=float(weight_decay))
+    return p_new, m_new, v_new
+
+
+def use_opt_megakernel(override=None) -> bool:
+    """Resolve the optimizer-megakernel lever: explicit True/False wins;
+    None = auto (Pallas/Mosaic on TPU, plain XLA elsewhere — interpret
+    mode would only slow the CPU lane down)."""
+    if override is not None:
+        return bool(override)
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Fused decode step (ISSUE 16c)
+# ---------------------------------------------------------------------------
+#
+# ATTRIBUTION_DECODE.json ranks the decode tick's residue: per layer, the
+# cache row scatter (cache_update / paged_cache_update), the paged-view
+# gather, and the masked one-token softmax each lower as separate
+# fusions with their own HBM round trips over the [B, S, nh, hd] slabs.
+# These kernels collapse a decode tick to one launch per layer
+# (write-guarded row update + masked attention, the paged variant
+# subsuming the page-table gather) plus one launch for the final
+# layernorm + LM-head projection. Behind EngineConfig(fused_decode=True).
+
+
+def _decode_slab_kernel(pos_ref, act_ref, q_ref, k_ref, v_ref, nk_ref,
+                        nv_ref, o_ref, ko_ref, vo_ref, *, sm_scale,
+                        seq_len):
+    b = pl.program_id(0)
+    pos = pos_ref[b]
+    act = act_ref[b] != 0
+    k2 = k_ref[0, :, 0, :]                           # (S, hd)
+    v2 = v_ref[0, :, 0, :]
+    # write-guard: inactive lanes keep the row that was already there
+    # (cache_update's masked-lane semantics), and attention sees exactly
+    # the row value that lands in the cache
+    old_k = k_ref[0, pl.ds(pos, 1), 0, :]            # (1, hd)
+    old_v = v_ref[0, pl.ds(pos, 1), 0, :]
+    row_k = jnp.where(act, nk_ref[0].astype(k2.dtype), old_k)
+    row_v = jnp.where(act, nv_ref[0].astype(v2.dtype), old_v)
+    ko_ref[0, :, 0, :] = row_k
+    vo_ref[0, :, 0, :] = row_v
+
+    sel = jax.lax.broadcasted_iota(jnp.int32, (seq_len, 1), 0) == pos
+    kf = jnp.where(sel, row_k, k2).astype(jnp.float32)
+    vf = jnp.where(sel, row_v, v2).astype(jnp.float32)
+    qf = q_ref[0].astype(jnp.float32)                # (1, hd)
+    s = jax.lax.dot_general(
+        qf, kf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale       # (1, S)
+    valid = jax.lax.broadcasted_iota(
+        jnp.int32, (1, seq_len), 1) < pos + 1
+    s = jnp.where(valid, s, -jnp.inf)
+    # same masked-softmax guards as ops/decode_attention.py
+    mx = jnp.max(s, axis=1, keepdims=True)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    e = jnp.where(valid, jnp.exp(s - mx), 0.0)
+    probs = e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+    o = jax.lax.dot_general(
+        probs, vf, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # (1, hd)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def fused_decode_attention(q, k_cache, v_cache, new_k, new_v, positions,
+                           active=None, sm_scale=None):
+    """One-launch slab decode tick: write-guarded cache row update +
+    masked one-token attention — replaces cache_update (x2) +
+    decode_attention per layer when ``EngineConfig.fused_decode``.
+
+    q/new_k/new_v: [B, nh, hd]; k_cache/v_cache: [B, S, nh, hd];
+    positions: [B] int32 (write row; attention covers positions+1 rows —
+    the engine's lengths); active: [B] optional write mask — inactive
+    lanes keep their cached row (the masked-lane no-write guard).
+
+    Returns (out [B, nh, hd], k_cache', v_cache'); the caches are
+    aliased in place — only row positions[b] of slot b is touched.
+    """
+    B, S, nh, hd = k_cache.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if active is None:
+        active = jnp.ones((B,), jnp.int32)
+    _count_launch("decode_slab")
+    row4 = pl.BlockSpec((1, 1, 1, hd), lambda b, h, p, a: (b, p[b], h, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(B, nh),
+        in_specs=[
+            pl.BlockSpec((1, 1, hd), lambda b, h, p, a: (b, h, 0)),
+            pl.BlockSpec((1, S, 1, hd), lambda b, h, p, a: (b, 0, h, 0)),
+            pl.BlockSpec((1, S, 1, hd), lambda b, h, p, a: (b, 0, h, 0)),
+            pl.BlockSpec((1, 1, hd), lambda b, h, p, a: (b, h, 0)),
+            pl.BlockSpec((1, 1, hd), lambda b, h, p, a: (b, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, hd), lambda b, h, p, a: (b, h, 0)),
+            row4,
+            row4,
+        ])
+    with jax.named_scope("fused_decode_attention"):
+        o, kc, vc = pl.pallas_call(
+            functools.partial(_decode_slab_kernel, sm_scale=sm_scale,
+                              seq_len=S),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((B, nh, hd), q.dtype),
+                jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+            ],
+            input_output_aliases={3: 1, 4: 2},
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=_interpret(),
+        )(positions.astype(jnp.int32), active.astype(jnp.int32),
+          q, k_cache, v_cache, new_k, new_v)
+    return o, kc, vc
+
+
+def _decode_paged_kernel(tbl_ref, pos_ref, q_ref, kp_ref, vp_ref, nk_ref,
+                         nv_ref, o_ref, ko_ref, vo_ref, k_scr, v_scr, *,
+                         sm_scale, page, num_pages):
+    b = pl.program_id(0)
+    m = pl.program_id(2)
+    pos = pos_ref[b]
+    # stream this slot's pages into the gathered scratch view (the
+    # in-kernel paged_gather): page m covers logical rows [m*ps, (m+1)*ps)
+    pl.store(k_scr, (pl.ds(m * page, page), slice(None)),
+             kp_ref[0, :, 0, :].astype(jnp.float32))
+    pl.store(v_scr, (pl.ds(m * page, page), slice(None)),
+             vp_ref[0, :, 0, :].astype(jnp.float32))
+
+    @pl.when(m == 0)
+    def _write_row():
+        # the out row block maps to (tables[b, pos//ps], pos%ps) for every
+        # m — dead lanes' all-zero tables land it on the scratch page,
+        # which is never read back (the unfused scratch-page guard)
+        ko_ref[0, :, 0, :] = nk_ref[0].astype(ko_ref.dtype)
+        vo_ref[0, :, 0, :] = nv_ref[0].astype(vo_ref.dtype)
+
+    @pl.when(m == num_pages - 1)
+    def _attend():
+        S = num_pages * page
+        # substitute the current token's row: the unfused path scatters
+        # first and gathers it back, rounding through the pool dtype
+        sel = jax.lax.broadcasted_iota(jnp.int32, (S, 1), 0) == pos
+        nk = nk_ref[0].astype(ko_ref.dtype).astype(jnp.float32)
+        nv = nv_ref[0].astype(vo_ref.dtype).astype(jnp.float32)
+        kf = jnp.where(sel, nk, k_scr[...])
+        vf = jnp.where(sel, nv, v_scr[...])
+        qf = q_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qf, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        valid = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1) < pos + 1
+        s = jnp.where(valid, s, -jnp.inf)
+        mx = jnp.max(s, axis=1, keepdims=True)
+        mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+        e = jnp.where(valid, jnp.exp(s - mx), 0.0)
+        probs = e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+        o = jax.lax.dot_general(
+            probs, vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[0] = o.astype(o_ref.dtype)
+
+
+def fused_paged_decode_attention(q, k_pool, v_pool, new_k, new_v, tables,
+                                 positions, sm_scale=None):
+    """Paged twin of :func:`fused_decode_attention`: page-table gather +
+    row scatter + masked one-token attention in ONE launch (subsumes
+    paged_gather + paged_cache_update). The gathered view is staged in
+    VMEM scratch page-by-page, so the softmax runs single-pass in the
+    same reduction order as the unfused gathered attention.
+
+    q/new_k/new_v [B, nh, hd]; k_pool/v_pool [P, page, nh, hd];
+    tables [B, M] int32 (all-zero rows = dead lanes writing the
+    scratch page); positions [B] int32.
+
+    Returns (out [B, nh, hd], k_pool', v_pool'), pools aliased in place.
+    """
+    B, M = tables.shape
+    P, page, nh, hd = k_pool.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    _count_launch("decode_paged")
+    S = M * page
+
+    def row_idx(b, h, m, t, p):
+        return (t[b, p[b] // page], p[b] % page, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(B, nh, M),
+        in_specs=[
+            pl.BlockSpec((1, 1, hd), lambda b, h, m, t, p: (b, h, 0)),
+            pl.BlockSpec((1, page, 1, hd),
+                         lambda b, h, m, t, p: (t[b, m], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, hd),
+                         lambda b, h, m, t, p: (t[b, m], 0, h, 0)),
+            pl.BlockSpec((1, 1, hd), lambda b, h, m, t, p: (b, h, 0)),
+            pl.BlockSpec((1, 1, hd), lambda b, h, m, t, p: (b, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, hd), lambda b, h, m, t, p: (b, h, 0)),
+            pl.BlockSpec((1, 1, 1, hd), row_idx),
+            pl.BlockSpec((1, 1, 1, hd), row_idx),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((S, hd), jnp.float32),
+            pltpu.VMEM((S, hd), jnp.float32),
+        ])
+    with jax.named_scope("fused_decode_attention_paged"):
+        o, kp, vp = pl.pallas_call(
+            functools.partial(_decode_paged_kernel, sm_scale=sm_scale,
+                              page=page, num_pages=M),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((B, nh, hd), q.dtype),
+                jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            ],
+            input_output_aliases={3: 1, 4: 2},
+            # b sequential: dead lanes' scratch-page writes collide
+            # (benign — never read back — but kept ordered on TPU)
+            compiler_params=_CompilerParams(
+                dimension_semantics=("arbitrary", "parallel",
+                                     "arbitrary")),
+            interpret=_interpret(),
+        )(tables.astype(jnp.int32), positions.astype(jnp.int32),
+          q, k_pool, v_pool, new_k, new_v)
+    return o, kp, vp
+
+
+def _logits_head_kernel(x_ref, scale_ref, bias_ref, w_ref, o_ref, *, eps):
+    x32 = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x32, axis=1, keepdims=True)
+    var = jnp.var(x32, axis=1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    y = (y * scale_ref[...].astype(jnp.float32)
+         + bias_ref[...].astype(jnp.float32)).astype(x_ref.dtype)
+    o_ref[...] = jax.lax.dot_general(
+        y, w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def fused_logits_head(x, scale, bias, lm_head, *, eps: float = 1e-5,
+                      block_v: int = 1024):
+    """Final layernorm + LM-head projection in one launch per vocab tile
+    (the decode tick's ln_f + [B, D] x [D, V] matmul). The LN statistics
+    are recomputed per tile (D-length row math is free next to the
+    matmul); the product accumulates in f32 and rounds through the
+    compute dtype exactly like the unfused einsum, so greedy argmax
+    parity holds.
+
+    x [B, D]; scale/bias [D]; lm_head [D, V] -> logits [B, V] in x.dtype.
+    """
+    B, D = x.shape
+    V = lm_head.shape[1]
+    bv = min(block_v, V)
+    nv = -(-V // bv)
+    vp = nv * bv
+    w = lm_head if vp == V else jnp.concatenate(
+        [lm_head, jnp.zeros((D, vp - V), lm_head.dtype)], axis=1)
+    _count_launch("decode_logits_head")
+    with jax.named_scope("fused_logits_matmul"):
+        out = pl.pallas_call(
+            functools.partial(_logits_head_kernel, eps=float(eps)),
+            grid=(nv,),
+            in_specs=[
+                pl.BlockSpec((B, D), lambda j: (0, 0)),
+                pl.BlockSpec((1, D), lambda j: (0, 0)),
+                pl.BlockSpec((1, D), lambda j: (0, 0)),
+                pl.BlockSpec((D, bv), lambda j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((B, bv), lambda j: (0, j)),
+            out_shape=jax.ShapeDtypeStruct((B, vp), x.dtype),
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel",)),
+            interpret=_interpret(),
+        )(x, scale.reshape(1, D), bias.reshape(1, D), w)
+    return out[:, :V]

@@ -318,7 +318,7 @@ def _adamw_update(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
 
 
 def _adamw_update_fused(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
-                        weight_decay=0.1, grad_clip=1.0):
+                        weight_decay=0.1, grad_clip=1.0, use_pallas=False):
     """Flat-buffer AdamW sweep: every leaf's grad/param is concatenated into
     one f32 megabuffer, the moments live flat (init_adamw_state fused=True),
     and the whole update is ONE vectorized expression — the per-param
@@ -326,7 +326,13 @@ def _adamw_update_fused(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
     collapses to a handful of full-bandwidth passes over contiguous HBM.
     Same math as _adamw_update leaf-by-leaf; parity tested in
     tests/test_memory_levers.py. Single-device / replicated-param layouts
-    only (make_train_step guards)."""
+    only (make_train_step guards).
+
+    ``use_pallas`` routes the elementwise sweep through ONE Pallas
+    megakernel launch (ops/pallas_kernels.megakernel_adamw_flat) instead
+    of XLA's residual elementwise-fusion stream — the grad-norm reduction
+    and clip scale stay outside and ride in as scalars, so the in-kernel
+    expression order matches this function bit-for-bit at f32 moments."""
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(grads)
     sizes = [int(p.size) for p in flat_p]
@@ -340,14 +346,23 @@ def _adamw_update_fused(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
 
     gnorm = jnp.sqrt(jnp.sum(jnp.square(gf)))
     scale = _clip_scale(gnorm, grad_clip)
-    gf = gf * scale
     step = opt["step"] + 1
     c1 = 1 - b1 ** step.astype(jnp.float32)
     c2 = 1 - b2 ** step.astype(jnp.float32)
-    mf = b1 * opt["m"].astype(jnp.float32) + (1 - b1) * gf
-    vf = b2 * opt["v"].astype(jnp.float32) + (1 - b2) * gf * gf
-    u = (mf / c1) / (jnp.sqrt(vf / c2) + eps)
-    new_flat = pf - lr * (u + weight_decay * wd_mask * pf)
+    if use_pallas:
+        from ..ops.pallas_kernels import megakernel_adamw_flat
+
+        new_flat, m_out, v_out = megakernel_adamw_flat(
+            pf, gf, opt["m"], opt["v"], wd_mask, lr, scale, c1, c2,
+            b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    else:
+        gf = gf * scale
+        mf = b1 * opt["m"].astype(jnp.float32) + (1 - b1) * gf
+        vf = b2 * opt["v"].astype(jnp.float32) + (1 - b2) * gf * gf
+        u = (mf / c1) / (jnp.sqrt(vf / c2) + eps)
+        new_flat = pf - lr * (u + weight_decay * wd_mask * pf)
+        m_out = mf.astype(opt["m"].dtype)
+        v_out = vf.astype(opt["v"].dtype)
 
     new_leaves, off = [], 0
     for p, n in zip(flat_p, sizes):
@@ -355,8 +370,7 @@ def _adamw_update_fused(params, grads, opt, lr, b1=0.9, b2=0.95, eps=1e-8,
                           .astype(p.dtype))
         off += n
     new_p = treedef.unflatten(new_leaves)
-    return new_p, {"m": mf.astype(opt["m"].dtype),
-                   "v": vf.astype(opt["v"].dtype), "step": step}, gnorm
+    return new_p, {"m": m_out, "v": v_out, "step": step}, gnorm
 
 
 def _rs_param_layout(cfg: GPTConfig, pcfg: ParallelConfig,
@@ -687,7 +701,8 @@ def _make_gspmd_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
 
 def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                     lr: float = 3e-4, weight_decay: float = 0.1,
-                    fused_opt: bool = False, grad_reduce: str = "psum",
+                    fused_opt: bool = False, fused_opt_pallas=None,
+                    grad_reduce: str = "psum",
                     grad_allreduce_dtype=None, bucket_mb: float = 32.0,
                     error_feedback: bool = False, grad_clip=1.0,
                     comm: Optional[CommConfig] = None,
@@ -702,7 +717,10 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     ``fused_opt=True`` runs the optimizer as a flat-buffer sweep
     (_adamw_update_fused; opt state from ``init_sharded(fused_opt=True)``).
     Single-device meshes only — concatenating differently-sharded leaves
-    would force an all-gather per step.
+    would force an all-gather per step. ``fused_opt_pallas`` additionally
+    lowers that sweep through ONE Pallas megakernel launch
+    (ops/pallas_kernels.megakernel_adamw_flat) — None = auto (TPU only),
+    True/False forces; ignored without ``fused_opt``.
 
     Communication levers (docs/comm_opt.md; or pass a ready
     :class:`CommConfig` as ``comm``):
@@ -823,7 +841,14 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
         opt_sh = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), opt_specs,
             is_leaf=lambda x: isinstance(x, P))
-        update = _adamw_update_fused if fused_opt else _adamw_update
+        if fused_opt:
+            from ..ops.pallas_kernels import use_opt_megakernel
+
+            update = partial(
+                _adamw_update_fused,
+                use_pallas=use_opt_megakernel(fused_opt_pallas))
+        else:
+            update = _adamw_update
 
         @partial(jax.jit,
                  in_shardings=(param_sh, opt_sh, data_sh, data_sh),

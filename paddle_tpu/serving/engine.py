@@ -108,6 +108,15 @@ class EngineConfig:
     tp: int = 1                      # mesh size for sharding="tp"
     # -- speculative decoding (serving/spec_decode.py) ------------------
     verify_window: int = 0           # W>0 compiles the verify executable
+    # -- fused decode step (ops/pallas_kernels.py, docs/kernels.md) -----
+    # one Pallas launch per layer for cache-row write + masked one-token
+    # attention (the paged variant subsumes the page-table gather) plus
+    # one launch for the final layernorm + LM-head projection — replaces
+    # the decode tick's scatter/gather/attention small-fusion residue
+    # ranked by ATTRIBUTION_DECODE.json. Opt-in: interpret-mode Pallas
+    # is slower than XLA off-TPU. Masked-lane / scratch-page write-guard
+    # semantics are preserved (tests/test_pallas_fused.py).
+    fused_decode: bool = False
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         buckets = tuple(sorted(set(
@@ -248,6 +257,15 @@ class DecodeEngine:
     def _dequant(self, qparams):
         return dequantize_params(qparams)
 
+    def _decode_ln(self):
+        """The decode tick's layernorm: the fused Pallas block kernel
+        under ``EngineConfig.fused_decode``, else the XLA reference."""
+        if self.ecfg.fused_decode:
+            from ..ops.pallas_kernels import fused_ln as _fln
+
+            return lambda x, scale, bias: _fln(x, scale, bias, eps=1e-5)
+        return gpt_mod._layer_norm
+
     def _block_tail(self, h, a, layer_p, dt, ln, bt: str):
         """Shared post-attention half of a transformer block: projection,
         residual, MLP. ``bt`` is the einsum batch prefix ("b" for decode
@@ -368,7 +386,11 @@ class DecodeEngine:
         cfg = self.cfg
         params = self._dequant(qparams)
         dt = cfg.dtype
-        ln = gpt_mod._layer_norm
+        fused = self.ecfg.fused_decode
+        ln = self._decode_ln()
+        if fused:
+            from ..ops.pallas_kernels import (fused_decode_attention,
+                                              fused_logits_head)
         x = (params["wte"][tokens] + params["wpe"][positions]).astype(dt)
 
         def body(h, xs):
@@ -378,16 +400,27 @@ class DecodeEngine:
                              layer_p["w_qkv"].astype(dt))
             qkv = qkv + layer_p["b_qkv"].astype(dt)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, nh, hd]
-            ck_l = cache_update(ck_l, k, positions, active=actives)
-            cv_l = cache_update(cv_l, v, positions, active=actives)
-            a = decode_attention(q, ck_l, cv_l, positions + 1)
+            if fused:
+                # one launch: write-guarded row update + masked attention
+                a, ck_l, cv_l = fused_decode_attention(
+                    q, ck_l, cv_l, k, v, positions, active=actives)
+            else:
+                ck_l = cache_update(ck_l, k, positions, active=actives)
+                cv_l = cache_update(cv_l, v, positions, active=actives)
+                a = decode_attention(q, ck_l, cv_l, positions + 1)
             h = self._block_tail(h, a, layer_p, dt, ln, "b")
             return h, (ck_l, cv_l)
 
         x, (ck, cv) = jax.lax.scan(body, x,
                                    (params["blocks"], ck, cv))
-        x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
-        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"].astype(dt))
+        if fused:
+            logits = fused_logits_head(
+                x, params["ln_f_scale"], params["ln_f_bias"],
+                params["lm_head"].astype(dt))
+        else:
+            x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
+            logits = jnp.einsum("bd,dv->bv", x,
+                                params["lm_head"].astype(dt))
         logits = logits.astype(jnp.float32)
         toks = samp.sample_batch(logits, temps, top_ks, top_ps, seeds,
                                  positions)
@@ -402,7 +435,11 @@ class DecodeEngine:
         cfg = self.cfg
         params = self._dequant(qparams)
         dt = cfg.dtype
-        ln = gpt_mod._layer_norm
+        fused = self.ecfg.fused_decode
+        ln = self._decode_ln()
+        if fused:
+            from ..ops.pallas_kernels import (fused_logits_head,
+                                              fused_paged_decode_attention)
         ps = self.ecfg.page_size
         x = (params["wte"][tokens] + params["wpe"][positions]).astype(dt)
         phys = jnp.take_along_axis(
@@ -416,17 +453,30 @@ class DecodeEngine:
                              layer_p["w_qkv"].astype(dt))
             qkv = qkv + layer_p["b_qkv"].astype(dt)
             q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            kp_l = paged_cache_update(kp_l, k, phys, rows)
-            vp_l = paged_cache_update(vp_l, v, phys, rows)
-            k_all = paged_gather(kp_l, tables)          # [B, S, nh, hd]
-            v_all = paged_gather(vp_l, tables)
-            a = decode_attention(q, k_all, v_all, positions + 1)
+            if fused:
+                # one launch: row scatter + page gather + masked attention
+                # (dead lanes' all-zero tables land the write on the
+                # scratch page, same as paged_cache_update)
+                a, kp_l, vp_l = fused_paged_decode_attention(
+                    q, kp_l, vp_l, k, v, tables, positions)
+            else:
+                kp_l = paged_cache_update(kp_l, k, phys, rows)
+                vp_l = paged_cache_update(vp_l, v, phys, rows)
+                k_all = paged_gather(kp_l, tables)      # [B, S, nh, hd]
+                v_all = paged_gather(vp_l, tables)
+                a = decode_attention(q, k_all, v_all, positions + 1)
             h = self._block_tail(h, a, layer_p, dt, ln, "b")
             return h, (kp_l, vp_l)
 
         x, (kp, vp) = jax.lax.scan(body, x, (params["blocks"], kp, vp))
-        x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
-        logits = jnp.einsum("bd,dv->bv", x, params["lm_head"].astype(dt))
+        if fused:
+            logits = fused_logits_head(
+                x, params["ln_f_scale"], params["ln_f_bias"],
+                params["lm_head"].astype(dt))
+        else:
+            x = ln(x, params["ln_f_scale"], params["ln_f_bias"])
+            logits = jnp.einsum("bd,dv->bv", x,
+                                params["lm_head"].astype(dt))
         logits = logits.astype(jnp.float32)
         toks = samp.sample_batch(logits, temps, top_ks, top_ps, seeds,
                                  positions)

@@ -1,0 +1,700 @@
+"""ISSUE 16 megakernel gates (docs/kernels.md), interpret mode on CPU:
+
+* fused layernorm+residual(+dropout) block kernel — forward parity,
+  custom_vjp gradcheck, exact model-level equivalence behind
+  ``cfg.fused_ln`` in both flagship models;
+* the optimizer megakernel — kernel-level bit-parity against the JITTED
+  unfused expressions, fluid engine parity under
+  ``FLAGS_fuse_optimizer_pallas``, flat-moment bit-parity + checkpoint
+  resume, and the ``make_train_step(fused_opt_pallas=...)`` lever;
+* the one-launch decode step — slab/paged parity against the unfused
+  update-then-attend pipeline, the masked-lane no-write regression, and
+  greedy-token EXACTNESS through a real ``fused_decode=True`` engine.
+
+Parity methodology: the references are JITTED. The production unfused
+paths (fluid executor programs, the parallelize train step, the serving
+decode fn) all run under jit, and XLA's FMA contraction means an EAGER
+reference can differ from the same jitted expression by 1 ulp — bitwise
+asserts against eager references would test the wrong thing.
+"""
+import dataclasses
+import functools
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import unique_name
+from paddle_tpu.framework.core import get_flag, set_flags
+from paddle_tpu.ops import decode_attention as DA
+from paddle_tpu.ops import pallas_kernels as PK
+
+
+# ---------------------------------------------------------------------------
+# (a) fused layernorm block kernel
+# ---------------------------------------------------------------------------
+
+
+def _ref_ln(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5),
+                                       (jnp.bfloat16, 2e-2)],
+                         ids=["f32", "bf16"])
+def test_fused_ln_forward_parity(dtype, tol):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((5, 7, 96)), dtype)
+    res = jnp.asarray(rng.standard_normal((5, 7, 96)), dtype)
+    scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(96), jnp.float32)
+    bias = jnp.asarray(0.1 * rng.standard_normal(96), jnp.float32)
+    badd = jnp.asarray(0.1 * rng.standard_normal(96), dtype)
+
+    ref = jax.jit(lambda x: _ref_ln(x, scale, bias, 1e-5))
+    np.testing.assert_allclose(
+        np.asarray(PK.fused_ln(x, scale, bias, eps=1e-5), jnp.float32),
+        np.asarray(ref(x), jnp.float32), atol=tol, rtol=tol)
+
+    # residual + bias-add + return_residual: s must be the models' exact
+    # pre-norm stream (residual + x) + b, computed in x.dtype
+    ref_rs = jax.jit(lambda x, r, b: (res + x) + b)
+    y, s = PK.fused_ln(x, scale, bias, residual=res, bias_add=badd,
+                       eps=1e-5, return_residual=True)
+    s_ref = ref_rs(x, res, badd)
+    np.testing.assert_array_equal(np.asarray(s, jnp.float32),
+                                  np.asarray(s_ref, jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(y, jnp.float32),
+        np.asarray(ref(s_ref), jnp.float32), atol=tol, rtol=tol)
+
+
+def test_fused_ln_forward_dropout_parity():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((33, 64)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((33, 64)), jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(64), jnp.float32)
+    bias = jnp.asarray(0.1 * rng.standard_normal(64), jnp.float32)
+    key = jax.random.PRNGKey(3)
+    keep = 0.9
+
+    def ref(x, res):
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        s = x * mask.astype(x.dtype) * jnp.asarray(1.0 / keep, x.dtype)
+        s = res + s
+        return _ref_ln(s, scale, bias, 1e-5), s
+
+    y, s = PK.fused_ln(x, scale, bias, residual=res, eps=1e-5,
+                       dropout_rate=1.0 - keep, dropout_key=key,
+                       return_residual=True)
+    ry, rs = jax.jit(ref)(x, res)
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(rs))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry), atol=1e-5)
+
+
+def test_fused_ln_gradcheck():
+    """custom_vjp vs jax.grad of the jitted unfused expression — every
+    differentiable operand (x, scale, bias, residual, bias_add)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((150, 80)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((150, 80)), jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(80), jnp.float32)
+    bias = jnp.asarray(0.1 * rng.standard_normal(80), jnp.float32)
+    badd = jnp.asarray(0.1 * rng.standard_normal(80), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((150, 80)), jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((150, 80)), jnp.float32)
+
+    def fused(x, scale, bias, res, badd):
+        y, s = PK.fused_ln(x, scale, bias, residual=res, bias_add=badd,
+                           eps=1e-5, return_residual=True,
+                           block_rows=64)   # non-divisible: 3 blocks pad
+        return jnp.sum(y * w) + jnp.sum(s * w2)
+
+    def ref(x, scale, bias, res, badd):
+        s = (res + x) + badd
+        return jnp.sum(_ref_ln(s, scale, bias, 1e-5) * w) \
+            + jnp.sum(s * w2)
+
+    gf = jax.jit(jax.grad(fused, argnums=(0, 1, 2, 3, 4)))(
+        x, scale, bias, res, badd)
+    gr = jax.jit(jax.grad(ref, argnums=(0, 1, 2, 3, 4)))(
+        x, scale, bias, res, badd)
+    for a, b, name in zip(gf, gr, ("x", "scale", "bias", "res", "badd")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=1e-4, err_msg=name)
+
+
+def test_fused_ln_gradcheck_dropout():
+    # the bernoulli mask operand carries a float0 cotangent — grads must
+    # still flow through the masked x path
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((16, 64)), jnp.float32)
+    scale = jnp.ones((64,), jnp.float32)
+    bias = jnp.zeros((64,), jnp.float32)
+    key = jax.random.PRNGKey(9)
+    keep = 0.8
+
+    def fused(x):
+        return jnp.sum(PK.fused_ln(x, scale, bias, eps=1e-5,
+                                   dropout_rate=1.0 - keep,
+                                   dropout_key=key) ** 2)
+
+    def ref(x):
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        s = x * mask.astype(x.dtype) * jnp.asarray(1.0 / keep, x.dtype)
+        return jnp.sum(_ref_ln(s, scale, bias, 1e-5) ** 2)
+
+    np.testing.assert_allclose(np.asarray(jax.jit(jax.grad(fused))(x)),
+                               np.asarray(jax.jit(jax.grad(ref))(x)),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_gpt_fused_ln_model_parity():
+    """cfg.fused_ln flips every block + final layernorm to the kernel;
+    loss and logits must match the unfused model exactly."""
+    from paddle_tpu.models import gpt as G
+
+    cfg = G.GPT_TINY.scaled(num_layers=2, max_seq_len=32)
+    params = G.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)),
+                         jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)),
+                         jnp.int32)
+    fcfg = dataclasses.replace(cfg, fused_ln=True)
+    base_logits = jax.jit(lambda p, t: G.forward(p, t, cfg))(
+        params, tokens)
+    fused_logits = jax.jit(lambda p, t: G.forward(p, t, fcfg))(
+        params, tokens)
+    np.testing.assert_allclose(np.asarray(fused_logits),
+                               np.asarray(base_logits), atol=2e-5,
+                               rtol=1e-5)
+    base_loss = float(jax.jit(
+        lambda p: G.loss_fn(p, tokens, labels, cfg))(params))
+    fused_loss = float(jax.jit(
+        lambda p: G.loss_fn(p, tokens, labels, fcfg))(params))
+    assert abs(fused_loss - base_loss) < 1e-6, (fused_loss, base_loss)
+    # and gradients flow through the custom_vjp inside the real model
+    g = jax.jit(jax.grad(lambda p: G.loss_fn(p, tokens, labels, fcfg)))(
+        params)
+    gr = jax.jit(jax.grad(lambda p: G.loss_fn(p, tokens, labels, cfg)))(
+        params)
+    flat_g = jax.tree_util.tree_leaves(g)
+    flat_r = jax.tree_util.tree_leaves(gr)
+    assert all(bool(jnp.isfinite(x).all()) for x in flat_g)
+    for a, b in zip(flat_g, flat_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4, rtol=1e-3)
+
+
+def test_ernie_fused_ln_model_parity():
+    from paddle_tpu.models import ernie as E
+
+    cfg = E.ERNIE_TINY
+    params = E.init_params(jax.random.PRNGKey(1), cfg)
+    rng = np.random.default_rng(1)
+    B, T = 2, 24
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)),
+                         jnp.int32)
+    seg = jnp.zeros((B, T), jnp.int32)
+    pad = jnp.ones((B, T), jnp.float32)
+    fcfg = dataclasses.replace(cfg, fused_ln=True)
+    base = jax.jit(lambda p: E.encode(p, tokens, seg, pad, cfg))(params)
+    fused = jax.jit(lambda p: E.encode(p, tokens, seg, pad, fcfg))(params)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(base),
+                               atol=2e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# (b) optimizer megakernel
+# ---------------------------------------------------------------------------
+
+
+def _flat(rng, n, dtype=jnp.float32):
+    return jnp.asarray(rng.standard_normal(n), dtype)
+
+
+def test_megakernel_sgd_bitwise():
+    rng = np.random.default_rng(0)
+    p, g = _flat(rng, 1000), _flat(rng, 1000)
+    lr = jnp.asarray(0.01, jnp.float32)
+    ref = jax.jit(lambda p, g, lr: p - lr.astype(p.dtype) * g)
+    np.testing.assert_array_equal(np.asarray(PK.megakernel_sgd(p, g, lr)),
+                                  np.asarray(ref(p, g, lr)))
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_megakernel_momentum_parity(nesterov):
+    rng = np.random.default_rng(1)
+    p, g, v = _flat(rng, 777), _flat(rng, 777), _flat(rng, 777)
+    lr, mu = jnp.asarray(0.01, jnp.float32), 0.9
+
+    @jax.jit
+    def ref(p, g, v, lr):
+        v_new = mu * v + g
+        if nesterov:
+            p_new = p - (g + mu * v_new) * lr
+        else:
+            p_new = p - lr * v_new
+        return p_new, v_new
+
+    p2, v2 = PK.megakernel_momentum(p, g, v, lr, mu=mu, nesterov=nesterov)
+    rp, rv = ref(p, g, v, lr)
+    # FMA contraction across the two-term expression can split 1 ulp
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(rp), atol=1e-6,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(rv), atol=1e-6,
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("coeff", [0.0, 0.01], ids=["adam", "adamw"])
+def test_megakernel_adam_bitwise(coeff):
+    rng = np.random.default_rng(2)
+    p, g = _flat(rng, 1000), _flat(rng, 1000)
+    m, v = _flat(rng, 1000) * 0.1, jnp.abs(_flat(rng, 1000)) * 0.01
+    lr = jnp.asarray(1e-3, jnp.float32)
+    b1p, b2p = jnp.asarray(0.9, jnp.float32), jnp.asarray(0.999,
+                                                          jnp.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+
+    @jax.jit
+    def ref(p, g, m, v, lr, b1p, b2p):
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * g * g
+        lr_t = lr * jnp.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+        p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        if coeff:
+            p_new = p_new - lr * coeff * p
+        return p_new, m_new, v_new
+
+    outs = PK.megakernel_adam(p, g, m, v, lr, b1p, b2p, b1=b1, b2=b2,
+                              eps=eps, coeff=coeff)
+    wants = ref(p, g, m, v, lr, b1p, b2p)
+    # moments are single-expression — bitwise; the param update chains
+    # mul/div/sub so XLA may contract the hand-written ref differently
+    # than the kernel body by 1 ulp (bitwise parity vs the PRODUCTION
+    # unfused path is asserted in test_fluid_optimizer_megakernel_parity)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(wants[0]),
+                               atol=1e-8, rtol=1e-7, err_msg="p")
+    for got, want, name in zip(outs[1:], wants[1:], ("m", "v")):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=name)
+
+
+@pytest.mark.parametrize("mdt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32_moments", "bf16_moments"])
+def test_megakernel_adamw_flat_parity(mdt):
+    """parallelize's flat AdamW sweep: BITWISE at f32 moments (the
+    acceptance bar); bf16 moment storage converts split XLA's fusion
+    clusters so contraction nondeterminism allows 1 ulp on the params."""
+    rng = np.random.default_rng(3)
+    n = 1000
+    p, g = _flat(rng, n), _flat(rng, n)
+    m = _flat(rng, n, mdt) * jnp.asarray(0.1, mdt)
+    v = (jnp.abs(_flat(rng, n)) * 0.01).astype(mdt)
+    wd_mask = jnp.asarray(rng.integers(0, 2, n), jnp.float32)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    scale = jnp.asarray(0.7, jnp.float32)
+    c1, c2 = jnp.asarray(0.4, jnp.float32), jnp.asarray(0.2, jnp.float32)
+    b1, b2, eps, wd = 0.9, 0.95, 1e-8, 0.1
+
+    @jax.jit
+    def ref(p, g, m, v, wd_mask, lr, scale, c1, c2):
+        gf = g * scale
+        mf = b1 * m.astype(jnp.float32) + (1 - b1) * gf
+        vf = b2 * v.astype(jnp.float32) + (1 - b2) * gf * gf
+        u = (mf / c1) / (jnp.sqrt(vf / c2) + eps)
+        p_new = p - lr * (u + wd * wd_mask * p)
+        return p_new, mf.astype(mdt), vf.astype(mdt)
+
+    outs = PK.megakernel_adamw_flat(p, g, m, v, wd_mask, lr, scale, c1,
+                                    c2, b1=b1, b2=b2, eps=eps,
+                                    weight_decay=wd)
+    wants = ref(p, g, m, v, wd_mask, lr, scale, c1, c2)
+    if mdt is jnp.float32:
+        for got, want, name in zip(outs, wants, ("p", "m", "v")):
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want), err_msg=name)
+    else:
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   np.asarray(wants[0]), atol=2e-7,
+                                   rtol=2e-7)
+        for got, want in zip(outs[1:], wants[1:]):
+            np.testing.assert_array_equal(
+                np.asarray(got, jnp.float32), np.asarray(want, jnp.float32))
+
+
+def test_use_opt_megakernel_resolution():
+    assert PK.use_opt_megakernel(True) is True
+    assert PK.use_opt_megakernel(False) is False
+    assert PK.use_opt_megakernel(None) == (jax.default_backend() == "tpu")
+
+
+def _run_fluid_mlp(opt_factory, pallas, steps=5, seed=7):
+    """Train the memory-levers MLP with the flat fused sweep on and the
+    Pallas megakernel forced on/off; returns (loss, {param: value})."""
+    prev = get_flag("FLAGS_fuse_optimizer_pallas")
+    set_flags({"FLAGS_fuse_optimizer_pallas": pallas})
+    try:
+        with unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = seed
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[8],
+                                      dtype="float32")
+                h = fluid.layers.fc(x, size=16, act="relu")
+                y = fluid.layers.fc(h, size=1)
+                label = fluid.layers.data(name="y", shape=[1],
+                                          dtype="float32")
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square(y - label))
+                opt_factory().minimize(loss)
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.standard_normal((4, 8)).astype(np.float32),
+                "y": rng.standard_normal((4, 1)).astype(np.float32)}
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        for _ in range(steps):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        params = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.global_block().all_parameters()}
+        return float(np.asarray(lv).ravel()[0]), params
+    finally:
+        set_flags({"FLAGS_fuse_optimizer_pallas": prev})
+
+
+@pytest.mark.parametrize("opt_factory,exact", [
+    (lambda: fluid.optimizer.SGD(0.05, fuse=True), True),
+    (lambda: fluid.optimizer.Momentum(0.05, 0.9, fuse=True), False),
+    (lambda: fluid.optimizer.Adam(0.01, fuse=True), True),
+    (lambda: fluid.optimizer.AdamW(0.01, weight_decay=0.1, fuse=True),
+     True),
+], ids=["sgd", "momentum", "adam", "adamw"])
+def test_fluid_optimizer_megakernel_parity(opt_factory, exact):
+    """FLAGS_fuse_optimizer_pallas must not change a single bit of the
+    trained parameters (momentum's two-term update is the one expression
+    XLA contracts differently — 1 ulp band there)."""
+    l_xla, p_xla = _run_fluid_mlp(opt_factory, pallas=False)
+    l_pal, p_pal = _run_fluid_mlp(opt_factory, pallas=True)
+    assert abs(l_pal - l_xla) < 1e-6
+    assert set(p_pal) == set(p_xla)
+    for name in p_xla:
+        if exact:
+            np.testing.assert_array_equal(p_pal[name], p_xla[name],
+                                          err_msg=name)
+        else:
+            np.testing.assert_allclose(p_pal[name], p_xla[name],
+                                       atol=5e-8, rtol=5e-8,
+                                       err_msg=name)
+
+
+def test_fluid_megakernel_checkpoint_resume(tmp_path):
+    """Flat moments trained through the Pallas megakernel round-trip
+    through save/load_persistables and resume bit-identically."""
+    prev = get_flag("FLAGS_fuse_optimizer_pallas")
+    set_flags({"FLAGS_fuse_optimizer_pallas": True})
+    try:
+        with unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 7
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[8],
+                                      dtype="float32")
+                h = fluid.layers.fc(x, size=16, act="relu")
+                y = fluid.layers.fc(h, size=1)
+                label = fluid.layers.data(name="y", shape=[1],
+                                          dtype="float32")
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square(y - label))
+                fluid.optimizer.Adam(0.01, fuse=True).minimize(loss)
+        flat_names = [n for n in main.global_block().vars
+                      if n.startswith("fused_adam_")]
+        assert any("moment1" in n for n in flat_names), flat_names
+        rng = np.random.default_rng(1)
+        feed = {"x": rng.standard_normal((4, 8)).astype(np.float32),
+                "y": rng.standard_normal((4, 1)).astype(np.float32)}
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        ckpt = str(tmp_path / "ckpt")
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        with fluid.framework.executor.scope_guard(scope):
+            fluid.io.save_persistables(exe, ckpt, main_program=main)
+        for _ in range(2):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        expect = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.global_block().all_parameters()}
+        scope2 = fluid.Scope()
+        exe.run(startup, scope=scope2)
+        with fluid.framework.executor.scope_guard(scope2):
+            fluid.io.load_persistables(exe, ckpt, main_program=main)
+        for _ in range(2):
+            exe.run(main, feed=feed, fetch_list=[loss], scope=scope2)
+        for name, want in expect.items():
+            got = np.asarray(scope2.find_var(name))
+            np.testing.assert_array_equal(got, want, err_msg=name)
+    finally:
+        set_flags({"FLAGS_fuse_optimizer_pallas": prev})
+
+
+def test_train_step_fused_opt_pallas_bitwise():
+    """make_train_step(fused_opt=True, fused_opt_pallas=True): params
+    AND the flat f32 moment megabuffers match the XLA flat sweep
+    bit-for-bit over multiple steps."""
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel import parallelize as PZ
+
+    cfg = G.GPT_TINY.scaled(num_layers=2)
+    pcfg = PZ.ParallelConfig(dp=1, pp=1, tp=1, microbatches=1)
+    mesh = PZ.build_mesh(pcfg, devices=[jax.devices()[0]])
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (1, 4, 32), dtype=np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (1, 4, 32), dtype=np.int32)
+    out = {}
+    for pallas in (False, True):
+        params, opt = PZ.init_sharded(jax.random.PRNGKey(0), cfg, pcfg,
+                                      mesh, fused_opt=True)
+        step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-3,
+                                  fused_opt=True,
+                                  fused_opt_pallas=pallas)
+        for _ in range(3):
+            params, opt, loss, _ = step(params, opt, tokens, labels)
+        out[pallas] = (float(loss), params, opt)
+    assert out[True][0] == out[False][0], (out[True][0], out[False][0])
+    for a, b in zip(jax.tree_util.tree_leaves(out[True][1]),
+                    jax.tree_util.tree_leaves(out[False][1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for key in ("m", "v"):
+        np.testing.assert_array_equal(np.asarray(out[True][2][key]),
+                                      np.asarray(out[False][2][key]),
+                                      err_msg=key)
+
+
+# ---------------------------------------------------------------------------
+# (c) one-launch decode step
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cdt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_fused_decode_slab_parity(cdt):
+    rng = np.random.default_rng(0)
+    B, S, nh, hd = 4, 32, 2, 64
+    kc = jnp.asarray(rng.standard_normal((B, S, nh, hd)), cdt)
+    vc = jnp.asarray(rng.standard_normal((B, S, nh, hd)), cdt)
+    q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    nk = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    positions = jnp.asarray([3, 5, 0, 7], jnp.int32)
+    active = jnp.asarray([1, 1, 0, 1], jnp.int32)
+
+    @jax.jit
+    def ref(q, kc, vc, nk, nv):
+        kc2 = DA.cache_update(kc, nk, positions, active)
+        vc2 = DA.cache_update(vc, nv, positions, active)
+        lengths = jnp.where(active != 0, positions + 1, 0)
+        return DA.decode_attention(q, kc2, vc2, lengths), kc2, vc2
+
+    out, kc2, vc2 = PK.fused_decode_attention(q, kc, vc, nk, nv,
+                                              positions, active=active)
+    r_out, r_kc, r_vc = ref(q, kc, vc, nk, nv)
+    # caches: bitwise everywhere, including the masked lane (no-write)
+    np.testing.assert_array_equal(np.asarray(kc2, jnp.float32),
+                                  np.asarray(r_kc, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(vc2, jnp.float32),
+                                  np.asarray(r_vc, jnp.float32))
+    live = np.asarray(active) != 0
+    np.testing.assert_allclose(np.asarray(out)[live],
+                               np.asarray(r_out)[live], atol=2e-6,
+                               rtol=2e-6)
+
+
+def test_fused_decode_masked_lane_no_write():
+    """Regression: a dead lane's cache slab must come back bit-identical
+    — the unfused cache_update masked-lane guard, preserved in-kernel."""
+    rng = np.random.default_rng(1)
+    B, S, nh, hd = 3, 16, 2, 64
+    kc = jnp.asarray(rng.standard_normal((B, S, nh, hd)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, S, nh, hd)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    nk = jnp.full((B, nh, hd), 123.0, jnp.float32)
+    nv = jnp.full((B, nh, hd), 456.0, jnp.float32)
+    positions = jnp.asarray([2, 0, 9], jnp.int32)
+    active = jnp.asarray([1, 0, 0], jnp.int32)
+    _, kc2, vc2 = PK.fused_decode_attention(q, kc, vc, nk, nv, positions,
+                                            active=active)
+    for dead in (1, 2):
+        np.testing.assert_array_equal(np.asarray(kc2)[dead],
+                                      np.asarray(kc)[dead])
+        np.testing.assert_array_equal(np.asarray(vc2)[dead],
+                                      np.asarray(vc)[dead])
+    # and the live lane's row DID land
+    np.testing.assert_array_equal(np.asarray(kc2)[0, 2],
+                                  np.full((nh, hd), 123.0, np.float32))
+
+
+@pytest.mark.parametrize("cdt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_fused_paged_decode_parity(cdt):
+    """Disjoint page tables (the only layout the engine's allocator ever
+    produces for live slots — pages are owned exclusively; only the
+    never-read-back scratch page 0 is shared by dead lanes)."""
+    rng = np.random.default_rng(2)
+    B, M, page, nh, hd = 3, 4, 8, 2, 64
+    P = 1 + B * M                            # page 0 = scratch
+    kp = jnp.asarray(rng.standard_normal((P, page, nh, hd)), cdt)
+    vp = jnp.asarray(rng.standard_normal((P, page, nh, hd)), cdt)
+    q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    nk = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+    # slot b owns pages [1 + b*M, 1 + (b+1)*M) — disjoint by construction
+    tables = jnp.asarray(
+        [[1 + b * M + m for m in range(M)] for b in range(B)], jnp.int32)
+    positions = jnp.asarray([5, 0, 30], jnp.int32)
+
+    @jax.jit
+    def ref(q, kp, vp, nk, nv):
+        phys = tables[jnp.arange(B), positions // page]
+        rows = positions % page
+        kp2 = DA.paged_cache_update(kp, nk, phys, rows)
+        vp2 = DA.paged_cache_update(vp, nv, phys, rows)
+        gk = DA.paged_gather(kp2, tables)
+        gv = DA.paged_gather(vp2, tables)
+        return DA.decode_attention(q, gk, gv, positions + 1), kp2, vp2
+
+    out, kp2, vp2 = PK.fused_paged_decode_attention(
+        q, kp, vp, nk, nv, tables, positions)
+    r_out, r_kp, r_vp = ref(q, kp, vp, nk, nv)
+    np.testing.assert_array_equal(np.asarray(kp2, jnp.float32),
+                                  np.asarray(r_kp, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(vp2, jnp.float32),
+                                  np.asarray(r_vp, jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(r_out),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_fused_logits_head_parity():
+    rng = np.random.default_rng(3)
+    B, d, V = 4, 64, 300                     # V not a multiple of block_v
+    x = jnp.asarray(rng.standard_normal((B, d)), jnp.float32)
+    scale = jnp.asarray(1.0 + 0.1 * rng.standard_normal(d), jnp.float32)
+    bias = jnp.asarray(0.1 * rng.standard_normal(d), jnp.float32)
+    head = jnp.asarray(rng.standard_normal((d, V)) * 0.05, jnp.float32)
+
+    @jax.jit
+    def ref(x):
+        return (_ref_ln(x, scale, bias, 1e-5) @ head)
+
+    got = PK.fused_logits_head(x, scale, bias, head, eps=1e-5,
+                               block_v=128)
+    want = ref(x)
+    assert got.shape == (B, V)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-5)
+    assert (np.argmax(np.asarray(got), -1)
+            == np.argmax(np.asarray(want), -1)).all()
+
+
+def _greedy(engine, prompt, n):
+    slot, logits = engine.start_sequence(prompt)
+    tok = int(np.argmax(logits))
+    toks = [tok]
+    for _ in range(n - 1):
+        out = engine.decode_step({slot: tok})
+        tok = int(np.argmax(out[slot]))
+        toks.append(tok)
+    engine.free_sequence(slot)
+    return toks
+
+
+@pytest.mark.parametrize("kv_layout", ["slab", "paged"])
+def test_engine_greedy_tokens_exact_fused_decode(kv_layout):
+    """EngineConfig(fused_decode=True) must emit the EXACT same greedy
+    tokens as the unfused engine — both layouts, multiple prompts."""
+    from paddle_tpu import serving
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPT_TINY.scaled(num_layers=2, max_seq_len=64)
+    params = gpt.init_params(jax.random.PRNGKey(7), cfg)
+    ekw = dict(max_batch=4, max_seq=32, prefill_buckets=(8, 16))
+    if kv_layout == "paged":
+        ekw.update(kv_layout="paged", page_size=8)
+    base = serving.DecodeEngine(params, cfg, serving.EngineConfig(**ekw))
+    fused = serving.DecodeEngine(
+        params, cfg, serving.EngineConfig(fused_decode=True, **ekw))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, size=int(n)).tolist()
+               for n in (3, 6, 11)]
+    for prompt in prompts:
+        want = _greedy(base, prompt, 12)
+        got = _greedy(fused, prompt, 12)
+        assert got == want, (prompt, got, want)
+
+
+def test_fused_decode_engine_partial_batch_isolation():
+    """A fused-decode engine stepping a PARTIAL batch (live slot rides
+    next to masked lanes) must not perturb the parked slot's cache: park
+    one sequence, decode another, then resume the first — its
+    continuation must match an engine that never interleaved."""
+    from paddle_tpu import serving
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPT_TINY.scaled(num_layers=2, max_seq_len=64)
+    params = gpt.init_params(jax.random.PRNGKey(3), cfg)
+    ekw = dict(max_batch=4, max_seq=32, prefill_buckets=(8, 16),
+               fused_decode=True)
+    eng = serving.DecodeEngine(params, cfg, serving.EngineConfig(**ekw))
+    ref_eng = serving.DecodeEngine(params, cfg,
+                                   serving.EngineConfig(**ekw))
+    pa, pb = [5, 9, 2], [7, 7, 7, 1]
+
+    want = _greedy(ref_eng, pa, 8)
+    slot_a, la = eng.start_sequence(pa)
+    ta = int(np.argmax(la))
+    got = [ta]
+    for _ in range(3):                      # a alone
+        out = eng.decode_step({slot_a: ta})
+        ta = int(np.argmax(out[slot_a]))
+        got.append(ta)
+    slot_b, lb = eng.start_sequence(pb)     # b joins mid-stream
+    tb = int(np.argmax(lb))
+    for _ in range(4):                      # a and b share the batch
+        out = eng.decode_step({slot_a: ta, slot_b: tb})
+        ta = int(np.argmax(out[slot_a]))
+        tb = int(np.argmax(out[slot_b]))
+        got.append(ta)
+    eng.free_sequence(slot_a)
+    eng.free_sequence(slot_b)
+    assert got == want, (got, want)
+
+
+def test_megakernel_launch_counter_labels():
+    """paddle_megakernel_launches_total{kernel} ticks at trace time with
+    the documented label per family."""
+    from paddle_tpu.observability import default_registry
+
+    def counts():
+        s = default_registry().snapshot().get(
+            "paddle_megakernel_launches_total", {}).get("series", [])
+        return {tuple(x["labels"])[0]: x["value"] for x in s}
+
+    before = counts()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    one = jnp.ones((64,), jnp.float32)
+    PK.fused_ln(x, one, one, eps=1e-5)
+    p = jnp.zeros((130,), jnp.float32)
+    PK.megakernel_sgd(p, p, jnp.asarray(0.1, jnp.float32))
+    after = counts()
+    assert after.get("fused_ln", 0) - before.get("fused_ln", 0) == 1
+    assert after.get("opt_sgd", 0) - before.get("opt_sgd", 0) == 1

@@ -23,7 +23,12 @@ then asserts:
     cross-request leakage) and the queue-wait histogram;
   * the roofline attribution (ISSUE 14) of a profiled tiny-GPT step
     passes its schema gate: version stamp, finite values, fractions in
-    [0,1], non-empty residue naming the layernorm/add/optimizer tail.
+    [0,1], non-empty residue naming the layernorm/add/optimizer tail;
+  * the Pallas megakernel paths (docs/kernels.md): a fused-opt smoke
+    train moves ``paddle_megakernel_launches_total{kernel="opt_sgd"}``
+    by exactly one (trace-time, one launch per param group per
+    compile), and a warmed fused-decode engine serves with zero
+    steady-state recompiles and zero post-warmup launch-counter motion.
 
 Wired into tier-1 as tests/test_metrics_check.py (``-m 'not slow'``), so
 the telemetry path is exercised end-to-end on every run. Standalone:
@@ -730,6 +735,91 @@ def _run_check_inner(out_dir: str) -> dict:
     assert sspec.stats.acceptance_rate == 1.0, \
         f"self-draft acceptance {sspec.stats.acceptance_rate} != 1.0"
 
+    # --- megakernel launch gate (docs/kernels.md) -----------------------
+    # paddle_megakernel_launches_total{kernel} ticks at TRACE time — one
+    # tick per launch site per compile, never per step. Two exact checks:
+    # (1) a fused-opt smoke train (flat sweep + Pallas megakernel forced
+    # on) compiles its program ONCE and the MLP's four f32 params share a
+    # single (dtype, hparam-sig) group, so kernel="opt_sgd" must move by
+    # EXACTLY 1 — and steps 2..3 hit the dispatch cache and must not
+    # move it again; (2) a warmed fused-decode engine serves with zero
+    # steady-state recompiles AND zero post-warmup launch-counter motion
+    # (a retrace of the decode program would tick it).
+    from paddle_tpu.framework.core import get_flag as _get_flag2
+    from paddle_tpu.framework.core import set_flags as _set_flags2
+
+    def _mk_counts():
+        s = default_registry().snapshot().get(
+            "paddle_megakernel_launches_total", {}).get("series", [])
+        return {tuple(x["labels"])[0]: x["value"] for x in s}
+
+    mk_section_before = _mk_counts()
+    prev_pallas = _get_flag2("FLAGS_fuse_optimizer_pallas")
+    _set_flags2({"FLAGS_fuse_optimizer_pallas": True})
+    try:
+        f_prog, f_startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(f_prog, f_startup):
+            fx = fluid.layers.data("fx", [din], dtype="float32")
+            fy = fluid.layers.data("fy", [1], dtype="int64")
+            fh = fluid.layers.fc(fx, 16, act="relu")
+            f_loss = fluid.layers.reduce_mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    fluid.layers.fc(fh, classes), fy))
+            fluid.optimizer.SGD(0.1, fuse=True).minimize(f_loss)
+        f_scope = fluid.Scope()
+        f_rng = np.random.RandomState(11)
+        with fluid.scope_guard(f_scope):
+            f_exe = fluid.Executor(fluid.XLAPlace(0))
+            f_exe.run(f_startup)
+            # snapshot AFTER program build: append_op shape inference runs
+            # the op lowering under eval_shape once, which also traces the
+            # launch site — the exactness gate covers the compile proper
+            mk_before = _mk_counts()
+            f_feed = {"fx": f_rng.randn(batch, din).astype(np.float32),
+                      "fy": f_rng.randint(0, classes,
+                                          (batch, 1)).astype(np.int64)}
+            f_exe.run(f_prog, feed=f_feed, fetch_list=[f_loss])
+            mk_compiled = _mk_counts()
+            for _ in range(2):
+                f_exe.run(f_prog, feed=f_feed, fetch_list=[f_loss])
+    finally:
+        _set_flags2({"FLAGS_fuse_optimizer_pallas": prev_pallas})
+    mk_train = _mk_counts()
+    opt_sgd_delta = mk_train.get("opt_sgd", 0) - mk_before.get("opt_sgd", 0)
+    assert opt_sgd_delta == 1, \
+        f"opt_sgd megakernel launches moved by {opt_sgd_delta}, expected " \
+        "exactly 1 (one launch per (dtype, hparam-sig) group per compile)"
+    assert mk_train.get("opt_sgd", 0) == mk_compiled.get("opt_sgd", 0), \
+        "cached fused-opt steps re-traced the optimizer megakernel"
+
+    fengine = pserving.DecodeEngine(
+        sparams, scfg, pserving.EngineConfig(
+            max_batch=4, max_seq=32, prefill_buckets=(8, 16),
+            fused_decode=True))
+    fengine.warmup()
+    mk_warm = _mk_counts()
+    assert mk_warm.get("decode_slab", 0) > mk_train.get("decode_slab", 0), \
+        "fused-decode warmup traced no decode_slab megakernel launch"
+    assert mk_warm.get("decode_logits_head", 0) \
+        > mk_train.get("decode_logits_head", 0), \
+        "fused-decode warmup traced no decode_logits_head launch"
+    recompiles_before = _recompile_total()
+    fslot, flogits = fengine.start_sequence([3, 5, 7])
+    ftok = int(np.argmax(flogits))
+    for _ in range(6):
+        fout = fengine.decode_step({fslot: ftok})
+        ftok = int(np.argmax(fout[fslot]))
+    fengine.free_sequence(fslot)
+    fused_decode_recompiles = _recompile_total() - recompiles_before
+    assert fused_decode_recompiles == 0, \
+        f"warmed fused-decode engine recompiled {fused_decode_recompiles}" \
+        " time(s) — the zero-recompile steady-state contract is broken"
+    assert fengine.steady_state_recompiles == 0
+    mk_after = _mk_counts()
+    assert mk_after == mk_warm, \
+        f"steady-state fused decode re-traced megakernels: " \
+        f"{mk_warm} -> {mk_after}"
+
     # --- roofline attribution gate (ISSUE 14, docs/observability.md) ----
     # profile a decode tick of the ALREADY-WARMED GPT serving engine
     # (zero extra compiles — the train-step attribution twin, with its
@@ -860,6 +950,12 @@ def _run_check_inner(out_dir: str) -> dict:
         "paddle_resharding_bytes_total missing from exposition"
     assert 'paddle_resharding_bytes_total{edge=' in prom_text, \
         "reshard edge sample missing from exposition"
+    # megakernel launch counter (docs/kernels.md): the fused-opt train and
+    # fused-decode serve above left per-kernel trace-time samples
+    assert 'paddle_megakernel_launches_total{kernel="opt_sgd"}' \
+        in prom_text, "opt_sgd megakernel sample missing from exposition"
+    assert 'paddle_megakernel_launches_total{kernel="decode_slab"}' \
+        in prom_text, "decode_slab megakernel sample missing"
     # goodput families (docs/observability.md): every category present
     for c in goodput.CATEGORIES:
         assert f'paddle_goodput_seconds_total{{category="{c}"}}' \
@@ -881,6 +977,11 @@ def _run_check_inner(out_dir: str) -> dict:
                              "warm_restart_prefill_tokens":
                                  int(warm_delta)},
             "spec_acceptance_rate": round(sspec.stats.acceptance_rate, 4),
+            "megakernel_launches": {
+                k: int(v - mk_section_before.get(k, 0))
+                for k, v in mk_after.items()},
+            "fused_decode_steady_state_recompiles":
+                int(fused_decode_recompiles),
             "program_reports": len(reports),
             "attribution": {
                 "path": apath,

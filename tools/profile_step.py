@@ -19,6 +19,13 @@ Usage:
       [--attr-out ATTRIBUTION.json]
   python tools/profile_step.py --smoke          # tiny CPU-sized lane
   python tools/profile_step.py --serve [--ticks 16] [--attr-out PATH]
+      [--fused-decode]                          # one-launch decode step
+  python tools/profile_step.py --compare A.json B.json
+      # residue-diff two attribution captures (per-group ms/step and
+      # event-count deltas) — the before/after gate for each megakernel
+
+Spec keys fln=1 / fopt=1 turn on the fused layernorm block kernel and
+the Pallas optimizer megakernel (docs/kernels.md).
 
 Reference analogue: platform/device_tracer.cc (CUPTI per-kernel times);
 here the XLA device plane carries the measured per-fusion times and the
@@ -84,10 +91,18 @@ def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
         PK.flash_attention = patched
     unknown = set(spec) - {"b", "T", "steps", "bq", "bk", "d", "L", "ff",
                            "nh", "remat", "celim", "flash", "scan", "mom",
-                           "chunk", "vocab"}
+                           "chunk", "vocab", "fln", "fopt"}
     if unknown:
         raise SystemExit(f"profile_step: unknown spec keys {sorted(unknown)}")
+    # fln=1 routes block layernorms through the fused Pallas block kernel
+    # (ops/pallas_kernels.fused_ln); fopt=1 turns on the flat-buffer fused
+    # optimizer sweep AND forces the Pallas optimizer megakernel so the
+    # before/after residue capture reflects the fused lowering even on the
+    # CPU (interpret) lane. See docs/kernels.md.
+    fused_ln = spec.get("fln", "0") == "1"
+    fused_opt = spec.get("fopt", "0") == "1"
     kw = dict(
+        fused_ln=fused_ln,
         max_seq_len=T,
         use_flash=spec.get("flash", "1") == "1",
         d_model=int(spec.get("d", 768)),
@@ -112,9 +127,11 @@ def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
     mesh = PZ.build_mesh(pcfg, devices=[dev])
     import jax.numpy as jnp
     params, opt = PZ.init_sharded(
-        jax.random.PRNGKey(0), cfg, pcfg, mesh,
+        jax.random.PRNGKey(0), cfg, pcfg, mesh, fused_opt=fused_opt,
         moment_dtype=jnp.bfloat16 if spec.get("mom") == "bf16" else None)
-    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4)
+    step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4,
+                              fused_opt=fused_opt,
+                              fused_opt_pallas=True if fused_opt else None)
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
     labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
@@ -136,7 +153,8 @@ def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
         "ce_chunk": int(spec.get("chunk", 0)),
         "batch": batch, "seq": T,
         "d_model": cfg.d_model, "layers": cfg.num_layers,
-        "fused_opt": False,
+        "fused_opt": fused_opt,
+        "fused_ln": fused_ln,
     }
 
     results = []
@@ -211,7 +229,8 @@ def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
 def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
                   d: int = 64, layers: int = 4, nh: int = 4, ff: int = 128,
                   vocab: int = 256, max_batch: int = 4, max_seq: int = 64,
-                  weight_dtype: str = "f32", kv_layout: str = "slab"):
+                  weight_dtype: str = "f32", kv_layout: str = "slab",
+                  fused_decode: bool = False):
     """Profile a warmed DecodeEngine decode tick: fill every slot, trace
     ``ticks`` full-batch decode steps, attribute through the same
     roofline path — the decode residue ranking is ROADMAP item 3(b)'s
@@ -230,7 +249,8 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
                         d_ff=ff, remat=False)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     ekw = dict(max_batch=max_batch, max_seq=max_seq,
-               prefill_buckets=(8, 16), weight_dtype=weight_dtype)
+               prefill_buckets=(8, 16), weight_dtype=weight_dtype,
+               fused_decode=fused_decode)
     if kv_layout == "paged":
         ekw.update(kv_layout="paged", page_size=8)
     engine = serving.DecodeEngine(params, cfg,
@@ -274,12 +294,14 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
         "mode": "decode", "weight_dtype": weight_dtype,
         "kv_layout": kv_layout, "max_batch": max_batch,
         "max_seq": max_seq, "d_model": d, "layers": layers,
+        "fused_decode": fused_decode,
     }
     attribution = ATT.build_from_trace(
         trace_dir, steps=ticks, wall_ms_per_step=wall_ms,
         hlo_texts=hlo_texts, device=dev, mode="decode",
         spec=f"serve:d={d},L={layers},b={max_batch},"
-             f"{weight_dtype},{kv_layout}",
+             f"{weight_dtype},{kv_layout}"
+             + (",fused" if fused_decode else ""),
         step_flops=decode_rep.get("flops"),
         step_bytes=decode_rep.get("bytes_accessed"),
         programs=reports[-8:] or None, config=config,
@@ -296,15 +318,66 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
     return attribution
 
 
+def compare_attributions(path_a: str, path_b: str, out=sys.stdout):
+    """Residue-diff two attribution docs (the before/after gate for each
+    megakernel): per-residue-group ms/step and event-count deltas, plus
+    the config levers that changed between the two captures. Returns the
+    joined per-group rows so tests can assert on the deltas."""
+    with open(path_a) as f:
+        a = json.load(f)
+    with open(path_b) as f:
+        b = json.load(f)
+
+    def _groups(doc):
+        return {g["label"]: g for g in
+                doc.get("residue", {}).get("groups", [])}
+
+    ga, gb = _groups(a), _groups(b)
+    ca, cb = a.get("config") or {}, b.get("config") or {}
+    print(f"=== residue diff: A={path_a}  B={path_b}", file=out)
+    levers = sorted(k for k in set(ca) | set(cb)
+                    if ca.get(k) != cb.get(k))
+    for k in levers:
+        print(f"CONFIG {k}: {ca.get(k)!r} -> {cb.get(k)!r}", file=out)
+    ra, rb = a.get("residue", {}), b.get("residue", {})
+    print(f"residue total: {ra.get('ms_per_step', 0):.4f} -> "
+          f"{rb.get('ms_per_step', 0):.4f} ms/step | "
+          f"{ra.get('count', 0)} -> {rb.get('count', 0)} ops | "
+          f"fusions {a.get('fusion_count', 0)} -> "
+          f"{b.get('fusion_count', 0)}", file=out)
+    print(f"{'group':<16}{'ms/step A':>11}{'ms/step B':>11}"
+          f"{'d(ms)':>9}{'ev A':>8}{'ev B':>8}{'d(ev)':>8}", file=out)
+    rows = []
+    for label in sorted(set(ga) | set(gb),
+                        key=lambda l: -(ga.get(l, {})
+                                        .get("ms_per_step", 0.0))):
+        xa, xb = ga.get(label, {}), gb.get(label, {})
+        ms_a = xa.get("ms_per_step", 0.0)
+        ms_b = xb.get("ms_per_step", 0.0)
+        ev_a = xa.get("events_per_step", 0.0)
+        ev_b = xb.get("events_per_step", 0.0)
+        rows.append({"label": label, "ms_a": ms_a, "ms_b": ms_b,
+                     "ev_a": ev_a, "ev_b": ev_b})
+        print(f"{label:<16}{ms_a:>11.4f}{ms_b:>11.4f}"
+              f"{ms_b - ms_a:>+9.4f}{ev_a:>8.1f}{ev_b:>8.1f}"
+              f"{ev_b - ev_a:>+8.1f}", file=out)
+    return rows
+
+
 def main():
     trace_dir = _flag("--dir", "/tmp/gpt-trace")
     attr_out = _flag("--attr-out")
+    if "--compare" in sys.argv:
+        i = sys.argv.index("--compare")
+        compare_attributions(sys.argv[i + 1], sys.argv[i + 2])
+        return
     if "--serve" in sys.argv:
         serve_profile(trace_dir, ticks=int(_flag("--ticks", 16, int)),
                       attr_out=attr_out,
                       weight_dtype=_flag("--weight-dtype", "f32"),
                       kv_layout=_flag("--kv-layout", "slab"),
-                      max_batch=int(_flag("--max-batch", 4, int)))
+                      max_batch=int(_flag("--max-batch", 4, int)),
+                      fused_decode="--fused-decode" in sys.argv)
         return
     if "--smoke" in sys.argv:
         spec_str = SMOKE_SPEC

@@ -9,11 +9,11 @@ set -u
 cd "$(dirname "$0")/.."
 
 if [ ! -f .tpu_s7_done ]; then
-  echo "=== [0/5] session 7 (serving lanes) still queued — running it first ==="
+  echo "=== [0/7] session 7 (serving lanes) still queued — running it first ==="
   bash tools/run_tpu_session7.sh
 fi
 
-echo "=== [1/5] train attribution at the bench-winner config $(date -u +%H:%M:%S) ==="
+echo "=== [1/7] train attribution at the bench-winner config $(date -u +%H:%M:%S) ==="
 # the r05 measured winner (b=16 remat=dots celim=1GiB, 0.7168 MFU):
 # refreshes PROFILE_STEP.json AND writes the first on-chip
 # ATTRIBUTION.json — per-fusion roofline placement + the residue list
@@ -23,7 +23,7 @@ python tools/profile_step.py \
   --steps 8 --dir /tmp/s8-train-trace --attr-out ATTRIBUTION.json
 echo "=== train attribution rc=$? ==="
 
-echo "=== [2/5] decode-tick attribution (serving residue) $(date -u +%H:%M:%S) ==="
+echo "=== [2/7] decode-tick attribution (serving residue) $(date -u +%H:%M:%S) ==="
 # warmed DecodeEngine full-batch decode tick, production-shaped model —
 # the decode residue ranking is ROADMAP item 3(b)'s fused-decode-kernel
 # target list (paged gather expected in the top groups, see item 2(b))
@@ -32,12 +32,12 @@ python tools/profile_step.py --serve --ticks 32 --max-batch 16 \
   --attr-out ATTRIBUTION_DECODE.json
 echo "=== decode attribution rc=$? ==="
 
-echo "=== [3/5] bench --profile (headline + attribution in one run) $(date -u +%H:%M:%S) ==="
+echo "=== [3/7] bench --profile (headline + attribution in one run) $(date -u +%H:%M:%S) ==="
 python bench.py --worker --wide --profile=ATTRIBUTION_BENCH_tpu.json \
   --monitor=/tmp/s8-monitor.jsonl
 echo "=== bench profile rc=$? ==="
 
-echo "=== [4/5] perf sentinel: record/diff the TPU-lane baseline $(date -u +%H:%M:%S) ==="
+echo "=== [4/7] perf sentinel: record/diff the TPU-lane baseline $(date -u +%H:%M:%S) ==="
 if [ ! -f PERF_BASELINE_tpu.json ]; then
   # first chip session since the sentinel landed: record the TPU lane
   # (real bands — timing metrics are only structural on the CPU lane)
@@ -50,9 +50,33 @@ else
 fi
 echo "=== sentinel rc=$? ==="
 
-echo "=== [5/5] metrics gate on-chip (incl. the attribution schema gate) $(date -u +%H:%M:%S) ==="
+echo "=== [5/7] metrics gate on-chip (incl. the attribution schema gate) $(date -u +%H:%M:%S) ==="
 python tools/metrics_check.py --out /tmp/metrics_check_tpu_s8
 echo "=== metrics_check rc=$? ==="
+
+echo "=== [6/7] megakernel train A/B: fused ln+opt vs unfused (ISSUE 16) $(date -u +%H:%M:%S) ==="
+# the fused pair for [1/7]'s capture: same bench-winner spec, fln=1
+# (fused layernorm block kernel) + fopt=1 (Pallas optimizer megakernel).
+# The committed ATTRIBUTION_DIFF.txt is the CPU interpret-mode gate
+# (event deltas only); this is the ms verdict — on-chip each kernel is
+# one Mosaic custom call, so the CPU emulation caveat does not apply.
+python tools/profile_step.py \
+  "d=2048,L=6,nh=16,ff=8192,b=16,remat=dots,celim=1073741824,fln=1,fopt=1" \
+  --steps 8 --dir /tmp/s8-train-fused-trace \
+  --attr-out ATTRIBUTION_FUSED_tpu.json
+echo "=== fused train attribution rc=$? ==="
+python tools/profile_step.py --compare ATTRIBUTION.json \
+  ATTRIBUTION_FUSED_tpu.json | tee ATTRIBUTION_DIFF_tpu.txt
+echo "=== train compare rc=$? ==="
+
+echo "=== [7/7] megakernel decode A/B: one-launch decode step (ISSUE 16) $(date -u +%H:%M:%S) ==="
+python tools/profile_step.py --serve --ticks 32 --max-batch 16 \
+  --kv-layout paged --fused-decode --dir /tmp/s8-decode-fused-trace \
+  --attr-out ATTRIBUTION_DECODE_FUSED_tpu.json
+echo "=== fused decode attribution rc=$? ==="
+python tools/profile_step.py --compare ATTRIBUTION_DECODE.json \
+  ATTRIBUTION_DECODE_FUSED_tpu.json | tee -a ATTRIBUTION_DIFF_tpu.txt
+echo "=== decode compare rc=$? ==="
 
 # NOT run on-chip yet — serving-gang TPU caveat (ISSUE 15): the replica
 # gang (tools/serve_fault_bench.py) spawns one ENGINE PROCESS PER
