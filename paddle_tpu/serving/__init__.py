@@ -52,11 +52,23 @@ from .scheduler import (  # noqa: F401
 )
 from .server import EngineLoop, FrontDoor, shed_decision  # noqa: F401
 from .prefix_store import PrefixStore  # noqa: F401
-from .replica import POISONED_EXIT_CODE  # noqa: F401
+from .replica import POISONED_EXIT_CODE, ReplicaRole  # noqa: F401
 from .gang import (  # noqa: F401
     GangConfig,
     GangFrontDoor,
     ReplicaGang,
+)
+from .kv_transfer import (  # noqa: F401
+    CacheConfigMismatch,
+    KVTransferServer,
+    adopt_into_engine,
+    cache_fingerprint,
+    export_slot,
+)
+from .disagg import (  # noqa: F401
+    DisaggRouter,
+    LocalReplica,
+    SharedPrefixIndex,
 )
 
 __all__ = [
@@ -68,6 +80,9 @@ __all__ = [
     "INT8_LOGIT_TOL", "INT8_PPL_REL_TOL",
     "Scheduler", "SchedulerConfig", "Request", "QueueFullError",
     "FrontDoor", "EngineLoop", "shed_decision",
-    "PrefixStore", "POISONED_EXIT_CODE",
+    "PrefixStore", "POISONED_EXIT_CODE", "ReplicaRole",
     "ReplicaGang", "GangConfig", "GangFrontDoor",
+    "CacheConfigMismatch", "KVTransferServer", "cache_fingerprint",
+    "export_slot", "adopt_into_engine",
+    "DisaggRouter", "LocalReplica", "SharedPrefixIndex",
 ]

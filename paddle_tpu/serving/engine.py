@@ -106,6 +106,10 @@ class EngineConfig:
     # -- tensor parallelism over PR 12's sharding layer -----------------
     sharding: Optional[str] = None   # None | "tp"
     tp: int = 1                      # mesh size for sharding="tp"
+    # -- phase disaggregation (ISSUE 17, docs/serving.md) ----------------
+    # "prefill" | "decode" | "colocated": stamps the TTFT/TPOT metric
+    # labels and tells the disagg router which fleet this engine serves
+    role: str = "colocated"
     # -- speculative decoding (serving/spec_decode.py) ------------------
     verify_window: int = 0           # W>0 compiles the verify executable
     # -- fused decode step (ops/pallas_kernels.py, docs/kernels.md) -----
@@ -150,6 +154,10 @@ class DecodeEngine:
                 raise ValueError(
                     f"paged engine: prefill buckets {bad} are not "
                     f"multiples of page_size {ecfg.page_size}")
+        if ecfg.role not in ("prefill", "decode", "colocated"):
+            raise ValueError(f"role {ecfg.role!r}: expected 'prefill', "
+                             "'decode' or 'colocated'")
+        self.role = ecfg.role
         self._donate = jax.default_backend() != "cpu"
         self._ref_params = params                  # f32 truth for parity
         # -- tensor-parallel mesh + shardings (PR 12 plan machinery) ----
@@ -215,6 +223,31 @@ class DecodeEngine:
                              "prefix_cache enabled")
         self.prefix_store = store
         return store.restore_into(self)
+
+    # -- KV handoff surface (serving/kv_transfer.py, ISSUE 17) ----------
+    def cache_fingerprint(self):
+        """Geometry fingerprint of this engine's KV cache — the
+        compatibility check on every handoff / prefix-store restore."""
+        from .kv_transfer import cache_fingerprint
+
+        return cache_fingerprint(self.cache)
+
+    def export_request_kv(self, slot: int, tokens=None) -> dict:
+        """Serialize a live slot's KV state for migration to a decode
+        replica (chunked, CRC-stamped, fingerprinted). The slot stays
+        live until the caller frees it."""
+        from .kv_transfer import export_slot
+
+        return export_slot(self, slot, tokens=tokens)
+
+    def adopt_request_kv(self, handoff: dict) -> int:
+        """Materialize a migrated request's KV state into a fresh slot
+        (the decode half of a handoff). Raises CacheConfigMismatch on
+        geometry drift. Must run on the serving loop thread — it writes
+        the cache arrays between executable calls."""
+        from .kv_transfer import adopt_into_engine
+
+        return adopt_into_engine(self, handoff)
 
     def _init_tp(self, qparams) -> None:
         """Mesh + NamedShardings for the tp engine: KV heads and the
@@ -763,6 +796,19 @@ class DecodeEngine:
                 _warm_call(f"verify_w{W}", ver,
                            np.zeros((B, W), np.int32), zeros_b, zeros_b,
                            *self._samp_batch_examples())
+        # transfer-path gather/scatter (KV handoff + prefix store): one
+        # compiled shape each — warmed here so a disagg handoff's first
+        # export/adopt never pays a mid-request compile (~100ms)
+        t0 = time.perf_counter()
+        if self.paged:
+            k0, v0 = self.cache.read_pages([0])
+            self.cache.write_pages([0], k0, v0)
+        else:
+            from .kv_transfer import DEFAULT_CHUNK_ROWS
+            n = min(DEFAULT_CHUNK_ROWS, self.ecfg.max_seq)
+            k0, v0 = self.cache.read_rows(0, 0, n)
+            self.cache.write_rows(0, 0, k0, v0)
+        timings["kv_transfer"] = (time.perf_counter() - t0) * 1e3
         self._warm = True
         return timings
 

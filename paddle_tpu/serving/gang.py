@@ -32,6 +32,16 @@ same contracts:
   directory (``serving/prefix_store.py``); a recycled replica restores
   its published prefix pages on boot and serves shared-prefix traffic
   prefill-once from its first request.
+- **Phase disaggregation** (ISSUE 17): ``GangConfig.roles`` types each
+  slot ``prefill``/``decode``/``colocated``. With both phase fleets
+  present, ``/generate`` dispatch runs phased — prefill replica to the
+  first token, KV pages streamed to a decode replica
+  (``serving/kv_transfer.py`` socket channel; inline JSON for stubs),
+  decode continues there. Any phase failure (empty fleet, transfer
+  fault, replica death mid-handoff) degrades the SAME request to
+  classic colocated dispatch — counted in
+  ``paddle_serve_disagg_fallback_total{reason}``, never dropped, and
+  still idempotent under the request-id contract.
 
 TPU caveat: replicas are separate processes — on a TPU host each must be
 pinned to its own chip subset (``TPU_VISIBLE_DEVICES`` per replica, see
@@ -79,6 +89,13 @@ def _exit_cause(ret: Optional[int]) -> str:
 @dataclasses.dataclass(frozen=True)
 class GangConfig:
     n_replicas: int = 2
+    # phase disaggregation (ISSUE 17): one role per replica slot
+    # ("prefill" | "decode" | "colocated"). Empty = every slot
+    # colocated (the pre-disagg gang). When both a prefill and a decode
+    # slot are configured, /generate dispatch runs phased: prefill on a
+    # prefill replica, KV handoff, decode on a decode replica — any
+    # phase failure degrades to classic colocated dispatch (never drops)
+    roles: Tuple[str, ...] = ()
     # supervisor probe cadence + the liveness deadline: an unreachable
     # /health or a heartbeat older than hang_deadline_s recycles the
     # replica with cause=hang (the worker's own watchdog usually beats
@@ -103,12 +120,16 @@ class ReplicaHandle:
     and restart bookkeeping. A slot survives recycles; the process (and
     its port) changes per incarnation."""
 
-    def __init__(self, index: int, config_path: str, run_dir: str):
+    def __init__(self, index: int, config_path: str, run_dir: str,
+                 role: str = "colocated"):
         self.index = int(index)
         self.config_path = config_path
         self.run_dir = run_dir
+        self.role = str(role)
         self.proc: Optional[subprocess.Popen] = None
         self.port: Optional[int] = None
+        self.kv_port: Optional[int] = None   # KV transfer socket (decode)
+        self.queue_depth = 0                 # refreshed by /health probes
         self.restored_prefix_records = 0
         self.incarnation = 0
         self.restarts = 0
@@ -125,6 +146,8 @@ class ReplicaHandle:
             except OSError:
                 pass
         self.port = None
+        self.kv_port = None
+        self.queue_depth = 0
         self.probe_misses = 0
         self.incarnation += 1
         if self._log is None or self._log.closed:
@@ -177,6 +200,8 @@ class ReplicaHandle:
         if rec.get("pid") != self.proc.pid:
             return False
         self.port = int(rec["port"])
+        kvp = rec.get("kv_port")
+        self.kv_port = int(kvp) if kvp else None
         self.restored_prefix_records = int(
             rec.get("restored_prefix_records", 0))
         return True
@@ -202,10 +227,10 @@ class ReplicaHandle:
                 timeout=timeout_s) as r:
             return r.read().decode()
 
-    def post_generate(self, body: Dict[str, Any],
-                      timeout_s: float) -> Tuple[int, Dict[str, Any]]:
+    def post_json(self, path: str, body: Dict[str, Any],
+                  timeout_s: float) -> Tuple[int, Dict[str, Any]]:
         req = urllib.request.Request(
-            f"http://127.0.0.1:{self.port}/generate",
+            f"http://127.0.0.1:{self.port}{path}",
             data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"})
         try:
@@ -218,6 +243,10 @@ class ReplicaHandle:
                 return e.code, json.loads(e.read().decode())
             except ValueError:
                 return e.code, {"error": f"HTTP {e.code}"}
+
+    def post_generate(self, body: Dict[str, Any],
+                      timeout_s: float) -> Tuple[int, Dict[str, Any]]:
+        return self.post_json("/generate", body, timeout_s)
 
 
 class ReplicaGang:
@@ -243,11 +272,26 @@ class ReplicaGang:
                              str(float(self.cfg.hang_deadline_s)))
         self._env.setdefault(_health.ENV_DIR,
                              os.path.join(self.run_dir, "health"))
+        roles = tuple(self.cfg.roles)
+        if roles and len(roles) != self.cfg.n_replicas:
+            raise ValueError(
+                f"GangConfig.roles has {len(roles)} entries for "
+                f"{self.cfg.n_replicas} replicas")
+        for role in roles:
+            if role not in ("prefill", "decode", "colocated"):
+                raise ValueError(f"unknown replica role {role!r}")
         self.replicas: List[ReplicaHandle] = []
         for i in range(self.cfg.n_replicas):
             rdir = os.path.join(self.run_dir, f"replica{i}")
             os.makedirs(rdir, exist_ok=True)
-            rc = dict(worker_config, index=i, run_dir=rdir)
+            role = roles[i] if roles else "colocated"
+            rc = dict(worker_config, index=i, run_dir=rdir, role=role)
+            if "engine" in rc:
+                rc["engine"] = dict(rc["engine"], role=role)
+            if role == "decode" and "stub" not in rc:
+                # decode engine replicas take KV pushes over the socket
+                # channel (stubs ride the handoff inline in JSON)
+                rc["kv_server"] = True
             # per-slot overrides (the fault bench injects faults into ONE
             # replica while its siblings stay clean)
             rc.update((per_replica or {}).get(i, {}))
@@ -257,9 +301,11 @@ class ReplicaGang:
             cpath = os.path.join(rdir, "config.json")
             with open(cpath, "w") as f:
                 json.dump(rc, f, indent=1)
-            self.replicas.append(ReplicaHandle(i, cpath, rdir))
+            self.replicas.append(ReplicaHandle(i, cpath, rdir, role=role))
         self.restart_causes: Dict[str, int] = {}
         self.failovers = 0
+        self.disagg_requests = 0          # served via prefill->decode
+        self.disagg_fallbacks = 0         # degraded to colocated
         self._rid = itertools.count(1)
         self._dedup_lock = threading.Lock()
         self._completed: "OrderedDict[str, Tuple[int, dict]]" = \
@@ -340,6 +386,7 @@ class ReplicaGang:
                               f"/health unreachable x{r.probe_misses}, "
                               f"heartbeat age {hb}: {e}")
             return
+        r.queue_depth = int(h.get("queue_depth") or 0)
         status = h.get("status")
         if status == "poisoned":
             self._recycle(r, "poisoned",
@@ -367,17 +414,30 @@ class ReplicaGang:
                     self._probe(r)
 
     # -- routing -----------------------------------------------------------
-    def ready_replicas(self) -> List[ReplicaHandle]:
-        return [r for r in self.replicas if r.alive and r.check_ready()]
+    def ready_replicas(self,
+                       role: Optional[str] = None) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.alive and r.check_ready()
+                and (role is None or r.role == role)]
 
-    def _pick(self, exclude) -> Optional[ReplicaHandle]:
+    def _pick(self, exclude,
+              role: Optional[str] = None) -> Optional[ReplicaHandle]:
         """Least-loaded ready replica not in ``exclude`` (an (index,
-        incarnation) set — a RECYCLED replica is a fresh candidate)."""
-        cands = [r for r in self.ready_replicas()
+        incarnation) set — a RECYCLED replica is a fresh candidate).
+        Load = router-side inflight + the probed queue depth (the
+        drain-rate signal a remote scheduler exposes)."""
+        cands = [r for r in self.ready_replicas(role)
                  if (r.index, r.incarnation) not in exclude]
         if not cands:
             return None
-        return min(cands, key=lambda r: (r.inflight, next(self._rr)))
+        return min(cands, key=lambda r: (r.inflight + r.queue_depth,
+                                         next(self._rr)))
+
+    @property
+    def disaggregated(self) -> bool:
+        """Phased dispatch is on when both phase fleets are configured
+        (static — role assignment never changes after construction)."""
+        roles = [r.role for r in self.replicas]
+        return "prefill" in roles and "decode" in roles
 
     def dispatch(self, body: Dict[str, Any],
                  timeout_s: Optional[float] = None
@@ -413,7 +473,7 @@ class ReplicaGang:
             return 504, {"error": "duplicate waited out its original",
                          "request_id": rid}
         try:
-            code, payload = self._dispatch_inner(body, timeout, rid)
+            code, payload = self._dispatch_phased(body, timeout, rid)
         finally:
             with self._dedup_lock:
                 self._inflight.pop(rid, None)
@@ -428,6 +488,89 @@ class ReplicaGang:
             while len(self._completed) > self.cfg.dedup_capacity:
                 self._completed.popitem(last=False)
         return code, payload
+
+    def _dispatch_phased(self, body, timeout: float, rid: str):
+        """Disaggregated dispatch with the degrade-never-drop rule: try
+        prefill-replica -> KV handoff -> decode-replica; ANY phase
+        failure falls through to classic colocated dispatch
+        (:meth:`_dispatch_inner` picks from every ready replica — roles
+        are routing policy, not capability — and only the FINAL response
+        is recorded, so idempotency + failover semantics are intact)."""
+        if self.disaggregated:
+            result = self._dispatch_disagg(body, timeout, rid)
+            if result is not None:
+                return self._record(rid, *result)
+        return self._dispatch_inner(body, timeout, rid)
+
+    def _dispatch_disagg(self, body, timeout: float, rid: str):
+        """One phased attempt. Returns ``(code, payload)`` on success,
+        ``None`` to signal colocated fallback (reason already counted in
+        ``paddle_serve_disagg_fallback_total``)."""
+        def fall_back(reason: str, detail: str = ""):
+            smetrics.m_disagg_fallback.labels(reason).inc()
+            self.disagg_fallbacks += 1
+            sys.stderr.write(f"[gang] request {rid}: disagg {reason}"
+                             f"{' (' + detail + ')' if detail else ''} — "
+                             f"degrading to colocated\n")
+            return None
+
+        deadline = time.monotonic() + timeout
+        pre = self._pick(set(), role="prefill")
+        dec = self._pick(set(), role="decode")
+        if pre is None or dec is None:
+            return fall_back("no_phase_fleet")
+        tid = f"{rid}-kv"
+        pbody = {k: v for k, v in body.items()
+                 if k not in ("request_id",)}
+        pbody["transfer_id"] = tid
+        if dec.kv_port:
+            # real engines: page stream over the decode replica's KV
+            # socket; the prefill replica pushes, /resume pops by id
+            pbody["kv_target"] = {"host": "127.0.0.1",
+                                  "port": dec.kv_port,
+                                  "transfer_id": tid}
+        pre.inflight += 1
+        try:
+            code, pay = pre.post_json(
+                "/prefill", pbody, max(0.5, deadline - time.monotonic()))
+        except Exception as e:
+            return fall_back("transfer_fault",
+                             f"prefill: {type(e).__name__}")
+        finally:
+            pre.inflight -= 1
+        if code != 200:
+            return fall_back("prefill_failed", f"HTTP {code}")
+        rbody = {"first_token": pay["first_token"],
+                 "max_new_tokens": body.get("max_new_tokens", 16),
+                 "prompt": body.get("prompt") or body.get("tokens"),
+                 "timeout_s": max(0.5, deadline - time.monotonic())}
+        for k in ("temperature", "top_k", "top_p", "seed"):
+            if k in body:
+                rbody[k] = body[k]
+        if pay.get("kv") is not None:
+            rbody["kv"] = pay["kv"]          # inline channel (stubs)
+        else:
+            rbody["transfer_id"] = pay.get("transfer_id", tid)
+        dec.inflight += 1
+        try:
+            code2, pay2 = dec.post_json(
+                "/resume", rbody, max(0.5, deadline - time.monotonic()))
+        except Exception as e:
+            # mid-transfer decode death: the handoff dies with the
+            # replica; the colocated retry re-prefills from the prompt
+            return fall_back("transfer_fault",
+                             f"resume: {type(e).__name__}")
+        finally:
+            dec.inflight -= 1
+        if code2 != 200:
+            return fall_back("decode_failed", f"HTTP {code2}")
+        self.disagg_requests += 1
+        return 200, {"tokens": pay2["tokens"],
+                     "num_tokens": pay2.get("num_tokens",
+                                            len(pay2["tokens"])),
+                     "ttft_ms": pay.get("ttft_ms"),
+                     "tpot_ms": pay2.get("tpot_ms"),
+                     "disagg": True}
 
     def _dispatch_inner(self, body, timeout: float, rid: str):
         deadline = time.monotonic() + timeout + self.cfg.probe_timeout_s
@@ -497,6 +640,7 @@ class ReplicaGang:
             reps.append({
                 "index": r.index, "alive": r.alive,
                 "ready": r.port is not None, "port": r.port,
+                "role": r.role, "kv_port": r.kv_port,
                 "incarnation": r.incarnation, "restarts": r.restarts,
                 "last_cause": r.last_cause,
                 "restored_prefix_records": r.restored_prefix_records,
@@ -507,6 +651,9 @@ class ReplicaGang:
                        "degraded" if n_ready else "down"),
             "replicas": reps,
             "ready": n_ready,
+            "disaggregated": self.disaggregated,
+            "disagg_requests": self.disagg_requests,
+            "disagg_fallbacks": self.disagg_fallbacks,
             "restarts": dict(self.restart_causes),
             "failovers": self.failovers,
         }

@@ -17,13 +17,44 @@ recompile.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, List, Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
-__all__ = ["KVCache", "CacheFullError"]
+__all__ = ["KVCache", "CacheFullError", "TRANSFER_ROW_BUCKET"]
+
+# Row-window width bucket for the transfer path (read_rows/write_rows).
+# Windows are widened to a multiple of this (clamped to max_seq) so a
+# KV handoff compiles ONE slice/update shape instead of one per chunk
+# remainder; kv_transfer chunks at this same width.
+TRANSFER_ROW_BUCKET = 64
+
+
+# Transfer-path row I/O compiled ONCE per row-window size: slot and
+# start are traced scalars, so a KV handoff touching every slot at many
+# offsets reuses a single executable instead of compiling a fresh
+# gather/scatter for each (slot, start) pair (~100ms apiece). Callers
+# guarantee start + n <= max_seq — dynamic_slice would silently clamp
+# (and shift) an out-of-range window, so the host wrappers assert it.
+@functools.partial(jax.jit, static_argnames=("n",))
+def _read_rows_exec(k, v, slot, start, *, n):
+    sizes = (k.shape[0], 1, n, k.shape[3], k.shape[4])
+    zero = jnp.int32(0)
+    starts = (zero, slot, start, zero, zero)
+    return (jax.lax.dynamic_slice(k, starts, sizes)[:, 0],
+            jax.lax.dynamic_slice(v, starts, sizes)[:, 0])
+
+
+@jax.jit
+def _write_rows_exec(k, v, slot, start, k_rows, v_rows):
+    zero = jnp.int32(0)
+    starts = (zero, slot, start, zero, zero)
+    return (jax.lax.dynamic_update_slice(k, k_rows[:, None], starts),
+            jax.lax.dynamic_update_slice(v, v_rows[:, None], starts))
 
 
 class CacheFullError(RuntimeError):
@@ -123,6 +154,60 @@ class KVCache:
         the host-side source of the decode step's positions feed."""
         return np.array([s.length if s.live else 0 for s in self._slots],
                         np.int32)
+
+    # -- row content I/O (serving/kv_transfer.py handoff) ------------------
+    def read_rows(self, slot: int, start: int, n: int):
+        """Host copies of ``n`` cache rows of ``slot`` beginning at
+        position ``start``: ``([L, n, nh, hd] k, same v)`` — the slab
+        analogue of :meth:`PagedKVCache.read_pages`, chunk-sized so a KV
+        handoff never materializes a whole slot at once."""
+        if start + n > self.max_seq:
+            raise ValueError(
+                f"read_rows window [{start}, {start + n}) exceeds "
+                f"max_seq {self.max_seq}")
+        s2, bn, off = self._row_window(start, n)
+        k, v = _read_rows_exec(self.k, self.v, jnp.int32(slot),
+                               jnp.int32(s2), n=bn)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        return k[:, off:off + n], v[:, off:off + n]
+
+    def _row_window(self, start: int, n: int):
+        """Widen [start, start+n) to a bucket-multiple window inside
+        [0, max_seq): returns (window_start, window_len, offset of the
+        requested rows within the window)."""
+        bucket = min(TRANSFER_ROW_BUCKET, self.max_seq)
+        bn = min(-(-int(n) // bucket) * bucket, self.max_seq)
+        s2 = min(int(start), self.max_seq - bn)
+        return s2, bn, int(start) - s2
+
+    def write_rows(self, slot: int, start: int, k_rows: np.ndarray,
+                   v_rows: np.ndarray) -> None:
+        """Write transferred K/V rows into ``slot`` at ``start`` (host
+        path between executable calls — the arrays are replaced
+        wholesale, same as the engine does after every step)."""
+        k_rows = np.asarray(k_rows)
+        v_rows = np.asarray(v_rows)
+        n = int(k_rows.shape[1])
+        if start + n > self.max_seq:
+            raise ValueError(
+                f"write_rows window [{start}, {start + n}) exceeds "
+                f"max_seq {self.max_seq}")
+        s2, bn, off = self._row_window(start, n)
+        if bn != n or off:
+            # read-modify-write the widened window so the update keeps
+            # one compiled shape without clobbering neighbor rows
+            cur_k, cur_v = _read_rows_exec(
+                self.k, self.v, jnp.int32(slot), jnp.int32(s2), n=bn)
+            cur_k = np.array(cur_k)
+            cur_v = np.array(cur_v)
+            cur_k[:, off:off + n] = k_rows
+            cur_v[:, off:off + n] = v_rows
+            k_rows, v_rows = cur_k, cur_v
+        self.k, self.v = _write_rows_exec(
+            self.k, self.v, jnp.int32(slot), jnp.int32(s2),
+            jnp.asarray(k_rows, self.dtype),
+            jnp.asarray(v_rows, self.dtype))
 
     def headroom(self, slot: int) -> int:
         """Tokens this slot can still grow by before hitting max_seq."""

@@ -17,7 +17,8 @@ __all__ = [
     "m_page_fragmentation", "m_spec_accepted", "m_spec_proposed",
     "m_spec_windows", "m_preemptions", "m_hol_admits",
     "m_shed", "m_replica_restarts", "m_failover", "m_prefix_store",
-    "request_code",
+    "m_kv_transfer_bytes", "m_kv_transfer_ms", "m_pool_prefix",
+    "m_disagg_fallback", "request_code",
 ]
 
 _REG = _obs.default_registry()
@@ -38,13 +39,17 @@ m_occupancy = _REG.gauge(
     "paddle_serve_batch_occupancy",
     "Live decode slots / max_batch at the last scheduler tick")
 # TTFT spans prefill + queueing; TPOT is the per-token decode cadence —
-# sub-ms buckets matter there
+# sub-ms buckets matter there. Both are split by the serving phase that
+# produced the sample and the role of the replica that ran it (ISSUE 17:
+# disaggregated serving needs per-phase latency, not a blended number).
 m_ttft_ms = _REG.histogram(
     "paddle_serve_ttft_ms",
-    "Time to first token (submit -> first generated token), ms")
+    "Time to first token (submit -> first generated token), ms",
+    ("phase", "role"))
 m_tpot_ms = _REG.histogram(
     "paddle_serve_tpot_ms",
-    "Per-output-token latency after the first token, ms")
+    "Per-output-token latency after the first token, ms",
+    ("phase", "role"))
 m_tokens = _REG.counter(
     "paddle_serve_tokens_total", "Generated tokens")
 m_tokens_per_s = _REG.gauge(
@@ -128,6 +133,31 @@ m_failover = _REG.counter(
 m_prefix_store = _REG.counter(
     "paddle_serve_prefix_store_total",
     "Prefix-store operations (save, restore, restore_skipped)", ("op",))
+
+
+# disaggregation families (ISSUE 17, docs/serving.md "Disaggregation") ---
+# KV handoff volume/latency between prefill and decode replicas. These
+# move ONLY on disagg runs — tools/metrics_check.py asserts they stay
+# flat through a plain colocated serve.
+m_kv_transfer_bytes = _REG.counter(
+    "paddle_kv_transfer_bytes_total",
+    "KV page bytes shipped between replicas, by direction",
+    ("direction",))
+m_kv_transfer_ms = _REG.histogram(
+    "paddle_kv_transfer_ms",
+    "Wall time of one request's KV handoff (export+ship+adopt), ms")
+# gang-shared prefix index: a hit means a prompt prefix prefilled on ANY
+# replica was reused here without recompute
+m_pool_prefix = _REG.counter(
+    "paddle_serve_pool_prefix_cache_total",
+    "Pool-level (gang-shared) prefix index events, by phase",
+    ("event", "phase"))
+# disagg router degradations: a failed handoff or an empty phase fleet
+# falls back to colocated dispatch — degrade, never drop
+m_disagg_fallback = _REG.counter(
+    "paddle_serve_disagg_fallback_total",
+    "Disagg requests degraded to colocated dispatch, by reason",
+    ("reason",))
 
 
 def request_code(code: int) -> None:

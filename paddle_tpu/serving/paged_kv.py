@@ -45,12 +45,37 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import metrics as smetrics
 from .kv_cache import CacheFullError
 
-__all__ = ["PagedKVCache", "PrefixCache", "PagePoolFullError"]
+__all__ = ["PagedKVCache", "PrefixCache", "PagePoolFullError",
+           "TRANSFER_PAGE_BUCKET"]
+
+# Gather/scatter width bucket for the host transfer path
+# (:meth:`PagedKVCache.read_pages` / ``write_pages``). Page groups are
+# padded up to a multiple of this with the scratch page so every
+# ≤-bucket group reuses ONE compiled gather and ONE compiled scatter —
+# without it each distinct group size costs a ~100ms XLA compile the
+# first time it appears, which lands squarely on the KV-handoff TTFT
+# path. KV handoffs chunk at DEFAULT_CHUNK_PAGES == this width, so the
+# steady state is exactly one shape.
+TRANSFER_PAGE_BUCKET = 4
+
+
+# K and V move in ONE device call each way — on CPU the per-op dispatch
+# overhead (~1ms) dominates these small transfers, so halving the call
+# count roughly halves export/adopt latency on the handoff path.
+@jax.jit
+def _gather_pages_exec(k, v, idx):
+    return k[:, idx], v[:, idx]
+
+
+@jax.jit
+def _scatter_pages_exec(k, v, idx, k_pages, v_pages):
+    return k.at[:, idx].set(k_pages), v.at[:, idx].set(v_pages)
 
 
 class PagePoolFullError(RuntimeError):
@@ -298,7 +323,16 @@ class PagedKVCache:
         ``([L, n, page_size, nh, hd] k, same v)`` — what the prefix
         store persists at publish time."""
         idx = np.asarray(list(pages), np.int32)
-        return (np.asarray(self.k[:, idx]), np.asarray(self.v[:, idx]))
+        n = idx.size
+        pad = -n % TRANSFER_PAGE_BUCKET
+        if pad:
+            # pad the gather with scratch-page reads so every group in a
+            # bucket shares one compiled shape (zero-recompile contract)
+            idx = np.concatenate([idx, np.zeros(pad, np.int32)])
+        k, v = _gather_pages_exec(self.k, self.v, idx)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        return (k[:, :n], v[:, :n]) if pad else (k, v)
 
     def write_pages(self, pages: Sequence[int], k_pages: np.ndarray,
                     v_pages: np.ndarray) -> None:
@@ -306,8 +340,59 @@ class PagedKVCache:
         the arrays are replaced wholesale, which is exactly how the
         engine treats them between executable calls)."""
         idx = np.asarray(list(pages), np.int32)
-        self.k = self.k.at[:, idx].set(jnp.asarray(k_pages, self.dtype))
-        self.v = self.v.at[:, idx].set(jnp.asarray(v_pages, self.dtype))
+        n = idx.size
+        pad = -n % TRANSFER_PAGE_BUCKET
+        k_pages = np.asarray(k_pages)
+        v_pages = np.asarray(v_pages)
+        if pad:
+            # pad the scatter with writes to the scratch page (whose
+            # contents are garbage by contract) so every group in a
+            # bucket shares one compiled shape
+            idx = np.concatenate([idx, np.zeros(pad, np.int32)])
+            zeros = np.zeros(
+                k_pages.shape[:1] + (pad,) + k_pages.shape[2:],
+                k_pages.dtype)
+            k_pages = np.concatenate([k_pages, zeros], axis=1)
+            v_pages = np.concatenate([v_pages, zeros], axis=1)
+        self.k, self.v = _scatter_pages_exec(
+            self.k, self.v, idx,
+            jnp.asarray(k_pages, self.dtype),
+            jnp.asarray(v_pages, self.dtype))
+
+    def adopt_slot(self, length: int, pages: Sequence[int]) -> int:
+        """Bind already-claimed, already-written ``pages`` to a fresh
+        slot with ``length`` valid positions — the receiving half of a
+        KV handoff (serving/kv_transfer.py). The pages must carry the
+        single reference :meth:`claim_pages` gave them; that reference
+        becomes the slot's, so :meth:`free` returns them to the pool.
+        Raises :class:`CacheFullError` when no slot is free (the caller
+        still owns the pages and must deref them)."""
+        pages = [int(p) for p in pages]
+        if length > self.max_seq:
+            raise ValueError(
+                f"sequence length {length} exceeds max_seq {self.max_seq}")
+        if len(pages) != self.pages_for(length):
+            raise ValueError(
+                f"adopting {len(pages)} page(s) for length {length}; "
+                f"need {self.pages_for(length)}")
+        for p in pages:
+            if p == 0 or self._ref[p] <= 0:
+                raise ValueError(f"adopting unclaimed page {p}")
+        if not self._free_slots:
+            raise CacheFullError(
+                f"all {self.max_slots} decode slots are live")
+        slot = self._free_slots.pop(0)
+        st = self._slots[slot]
+        st.live = True
+        st.length = int(length)
+        st.prefix_len = 0
+        st.mapped = len(pages)
+        st.generation += 1
+        row = self._tables[slot]
+        row[:] = 0
+        row[:len(pages)] = pages
+        self._note_pool_metrics()
+        return slot
 
     # -- executable feeds --------------------------------------------------
     def table_row(self, slot: int) -> np.ndarray:
@@ -383,6 +468,13 @@ class PrefixCache:
             if self.pool._ref[p] == 1:
                 n += 1
         return n
+
+    def has(self, tokens: Sequence[int]) -> bool:
+        """Exact-entry probe WITHOUT metric counts or LRU freshening —
+        the disagg prefix-index's "is it already local?" check."""
+        key = self._key(tuple(int(t) for t in tokens))
+        ent = self._entries.get(key)
+        return ent is not None and ent[0] == tuple(int(t) for t in tokens)
 
     def lookup(self, tokens: Sequence[int]
                ) -> Tuple[int, Tuple[int, ...]]:

@@ -18,8 +18,13 @@ request after a recycle hits the cache exactly like the ten-thousandth
 before it.
 
 Contents are tied to the engine geometry (model hash is the caller's
-concern; layer/head/page shapes are validated per record) — a record
-whose page shape does not match the live pool is skipped, not trusted.
+concern). Records carry the writing pool's config fingerprint
+(serving/kv_transfer.py — layout, layers, heads, head_dim, dtype,
+page_size); restoring into a differently-configured engine raises
+:class:`~paddle_tpu.serving.kv_transfer.CacheConfigMismatch` naming
+every differing field instead of silently skipping (ISSUE 17 fix: the
+old shape-tail check skipped quietly, hiding a misconfigured replica).
+Legacy fingerprint-less records keep the skip-on-shape-drift behavior.
 
 Metered by ``paddle_serve_prefix_store_total{op=save|restore|
 restore_skipped}`` (gated by tools/metrics_check.py).
@@ -32,6 +37,8 @@ import numpy as np
 
 from ..parallel.checkpoint import CheckpointError, ElasticCheckpointer
 from . import metrics as smetrics
+from .kv_transfer import (CacheConfigMismatch, cache_fingerprint,
+                          fingerprint_mismatch)
 
 __all__ = ["PrefixStore"]
 
@@ -102,7 +109,8 @@ class PrefixStore:
             "k": np.asarray(k_pages),
             "v": np.asarray(v_pages),
         }, extra={"token_hash": key, "n_pages": len(pages),
-                  "page_size": ps})
+                  "page_size": ps,
+                  "fingerprint": cache_fingerprint(pool)})
         self._keys.add(key)
         self._next_step = step + 1
         self.saved += 1
@@ -112,13 +120,21 @@ class PrefixStore:
     def restore_into(self, engine) -> int:
         """Replay every committed record into ``engine``'s pool + prefix
         cache (boot time, before :meth:`DecodeEngine.warmup`). Records
-        that no longer fit — pool pressure, geometry drift, token hash
-        already live — are skipped, never half-applied. Returns how many
-        records were restored."""
+        that no longer fit — pool pressure, token hash already live —
+        are skipped, never half-applied. Returns how many records were
+        restored.
+
+        A record carrying a config fingerprint that does not match the
+        receiving pool raises :class:`CacheConfigMismatch` naming every
+        differing field — restoring KV bytes shaped for another config
+        is an operator error, not something to paper over. Legacy
+        records without a fingerprint fall back to the old silent
+        shape-tail skip."""
         if engine.prefix is None:
             raise ValueError("prefix store needs a paged engine with "
                              "prefix_cache enabled")
         pool, cache = engine.cache, engine.prefix
+        fp_local = cache_fingerprint(pool)
         expect = (pool.num_layers, pool.page_size, pool.num_heads,
                   pool.head_dim)
         n = 0
@@ -129,6 +145,16 @@ class PrefixStore:
                 self.restore_skipped += 1
                 smetrics.m_prefix_store.labels("restore_skipped").inc()
                 continue
+            fp_rec = (_man.get("extra") or {}).get("fingerprint")
+            if fp_rec is not None:
+                diffs = fingerprint_mismatch(fp_local, fp_rec)
+                if diffs:
+                    raise CacheConfigMismatch(
+                        f"prefix store {self.dirname!r} step_{step} was "
+                        f"written for a different cache config — "
+                        + "; ".join(diffs)
+                        + " (point the replica at a store written by a "
+                          "matching engine, or clear the store)")
             tokens = [int(t) for t in np.asarray(rec["tokens"])]
             k_pages = np.asarray(rec["k"])
             v_pages = np.asarray(rec["v"])

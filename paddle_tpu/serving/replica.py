@@ -52,6 +52,19 @@ READY_NAME = "ready.json"
 HEARTBEAT_NAME = "heartbeat.json"
 
 
+class ReplicaRole:
+    """Phase role of a replica in a disaggregated gang (ISSUE 17,
+    docs/serving.md "Disaggregation"). Plain string constants — this
+    module must stay stdlib-only (no enum import cost matters, but the
+    gang JSON-serializes roles into replica configs, so str is the
+    native type)."""
+
+    PREFILL = "prefill"      # serves /prefill, ships KV handoffs out
+    DECODE = "decode"        # serves /resume, adopts KV handoffs
+    COLOCATED = "colocated"  # serves /generate end to end (default)
+    ALL = (PREFILL, DECODE, COLOCATED)
+
+
 def _atomic_json(path: str, obj: dict) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
@@ -92,6 +105,7 @@ def run_stub(cfg: dict) -> int:
     stub = cfg.get("stub") or {}
     run_dir = cfg["run_dir"]
     os.makedirs(run_dir, exist_ok=True)
+    role = cfg.get("role", ReplicaRole.COLOCATED)
     state = {"served": 0, "hung": False}
     hb_frozen = threading.Event()
 
@@ -122,7 +136,8 @@ def run_stub(cfg: dict) -> int:
             if self.path == "/health":
                 return self._json(200, {
                     "status": status(), "loop_alive": not state["hung"],
-                    "stub": True, "served": state["served"]})
+                    "stub": True, "served": state["served"],
+                    "role": role})
             if self.path == "/metrics":
                 text = (f"paddle_serve_prefill_tokens_total "
                         f"{state['served']}\n").encode()
@@ -136,10 +151,14 @@ def run_stub(cfg: dict) -> int:
             self._json(404, {"error": "unknown path"})
 
         def do_POST(self):
-            if self.path != "/generate":
+            if self.path not in ("/generate", "/prefill", "/resume"):
                 return self._json(404, {"error": "unknown path"})
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n).decode() or "{}")
+            if self.path == "/resume" and stub.get("die_on_resume"):
+                # mid-transfer kill: the decode replica dies while the
+                # migrated request is in its hands (gang failover test)
+                os._exit(int(stub.get("die_code", 1)))
             if stub.get("hang_after") is not None and \
                     state["served"] >= stub["hang_after"]:
                 state["hung"] = True
@@ -154,6 +173,36 @@ def run_stub(cfg: dict) -> int:
                 time.sleep(delay)
             if status() == "poisoned":
                 return self._json(503, {"error": "engine poisoned (stub)"})
+            if self.path == "/prefill":
+                prompt = body.get("prompt") or []
+                if not prompt:
+                    return self._json(400, {"error": "empty prompt"})
+                state["served"] += 1
+                # inline fake handoff: checksum lets /resume verify the
+                # blob actually travelled router -> decode intact
+                return self._json(200, {
+                    "first_token": _stub_tokens(prompt, 1)[0],
+                    "ttft_ms": delay * 1e3,
+                    "transfer_id": body.get("transfer_id") or "stub",
+                    "kv": {"stub": True, "checksum": sum(prompt),
+                           "prompt_len": len(prompt),
+                           "tokens": list(prompt)},
+                    "pid": os.getpid()})
+            if self.path == "/resume":
+                kv = body.get("kv") or {}
+                prompt = kv.get("tokens") or body.get("prompt") or []
+                if not prompt or kv.get("checksum") != sum(prompt):
+                    return self._json(400, {
+                        "error": "stub handoff checksum mismatch"})
+                toks = _stub_tokens(prompt,
+                                    int(body.get("max_new_tokens", 4)))
+                if int(body.get("first_token", toks[0])) != toks[0]:
+                    return self._json(400, {
+                        "error": "stub first-token mismatch"})
+                state["served"] += 1
+                return self._json(200, {
+                    "tokens": toks, "num_tokens": len(toks),
+                    "tpot_ms": 0.0, "pid": os.getpid()})
             prompt = body.get("prompt") or []
             toks = _stub_tokens(prompt,
                                 int(body.get("max_new_tokens", 4)))
@@ -177,7 +226,8 @@ def run_stub(cfg: dict) -> int:
                      daemon=True).start()
     _atomic_json(os.path.join(run_dir, READY_NAME),
                  {"port": httpd.server_address[1], "pid": os.getpid(),
-                  "stub": True, "restored_prefix_records": 0})
+                  "stub": True, "role": role,
+                  "restored_prefix_records": 0})
     import signal
 
     done = threading.Event()
@@ -219,11 +269,32 @@ def run_engine(cfg: dict) -> int:
     restored = 0
     store = None
     if cfg.get("prefix_store_dir"):
+        from paddle_tpu.serving.kv_transfer import CacheConfigMismatch
         from paddle_tpu.serving.prefix_store import PrefixStore
 
         store = PrefixStore(cfg["prefix_store_dir"])
-        restored = engine.attach_prefix_store(store)
+        try:
+            restored = engine.attach_prefix_store(store)
+        except CacheConfigMismatch as e:
+            # a mismatched store must not crash-loop the replica under
+            # the gang supervisor: log loudly, serve with a cold cache,
+            # and DETACH the store so this incarnation neither trusts
+            # nor overwrites records shaped for another config
+            sys.stderr.write(f"[replica] prefix store rejected — "
+                             f"serving cold: {e}\n")
+            sys.stderr.flush()
+            engine.prefix_store = None
+            try:
+                store.close()
+            except Exception:
+                pass
+            store = None
     engine.warmup()
+    kv_server = None
+    if cfg.get("kv_server"):
+        from paddle_tpu.serving.kv_transfer import KVTransferServer
+
+        kv_server = KVTransferServer().start()
     skw = dict(cfg.get("scheduler") or {})
     sched = serving.Scheduler(engine, serving.SchedulerConfig(**skw))
 
@@ -263,7 +334,7 @@ def run_engine(cfg: dict) -> int:
         scheduler=sched, port=int(cfg.get("port", 0)),
         max_queue=int(cfg.get("max_queue", 64)),
         request_timeout_s=float(cfg.get("request_timeout_s", 30.0)),
-        on_poison=on_poison).start()
+        on_poison=on_poison, kv_server=kv_server).start()
     # the gang's env contract arms the hang watchdog AFTER warmup (the
     # engine's own compiles ran under health.suspend regardless)
     health.maybe_install_from_env()
@@ -277,8 +348,12 @@ def run_engine(cfg: dict) -> int:
         daemon=True).start()
     _atomic_json(os.path.join(run_dir, READY_NAME),
                  {"port": front.port, "pid": os.getpid(),
+                  "role": engine.role,
+                  "kv_port": (kv_server.port if kv_server is not None
+                              else None),
                   "restored_prefix_records": int(restored)})
     sys.stderr.write(f"[replica] ready on port {front.port} "
+                     f"role={engine.role} "
                      f"(restored {restored} prefix records)\n")
     sys.stderr.flush()
     try:
@@ -286,6 +361,11 @@ def run_engine(cfg: dict) -> int:
             time.sleep(0.2)
     finally:
         stop_hb.set()
+        if kv_server is not None:
+            try:
+                kv_server.close()
+            except Exception:
+                pass
         if store is not None:
             try:
                 store.close()

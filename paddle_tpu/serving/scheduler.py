@@ -91,6 +91,15 @@ class Request:
     # preemption (page pool dry): the request resumes by re-prefilling
     # prompt + generated-so-far — True marks it so admission knows
     preempted: bool = False
+    # phase disaggregation (ISSUE 17): a prefill_only request finishes
+    # at the first-token boundary with its KV serialized into
+    # ``handoff`` (serving/kv_transfer.py); on the decode side the same
+    # field carries the payload awaiting adoption at the next tick.
+    # ``prefix_blob`` is a gang-shared prefix-index record to adopt
+    # into the local pool before this request prefills.
+    prefill_only: bool = False
+    handoff: Optional[dict] = None
+    prefix_blob: Optional[dict] = None
     finished: threading.Event = dataclasses.field(
         default_factory=threading.Event)
     # span identity (docs/observability.md): every lifecycle span of this
@@ -153,16 +162,34 @@ class Scheduler:
         # (own lock: _finish runs under self._lock on some paths)
         self._rate_lock = threading.Lock()
         self._done_times: Deque[float] = deque(maxlen=256)
+        # migrated requests waiting for KV adoption — drained at the
+        # START of each tick, on the loop thread (cache writes must
+        # never race a decode step's array swap)
+        self._pending_handoffs: Deque[Request] = deque()
+        # TTFT/TPOT children resolved once: phase is structural (TTFT
+        # ends prefill, TPOT is decode cadence), role is this engine's
+        self.role = getattr(engine, "role", "colocated")
+        self._ttft_hist = smetrics.m_ttft_ms.labels("prefill", self.role)
+        self._tpot_hist = smetrics.m_tpot_ms.labels("decode", self.role)
 
     # ------------------------------------------------------------------
     # producer side (any thread)
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
                timeout_s: Optional[float] = None,
-               sampling: Optional[SamplingParams] = None) -> Request:
+               sampling: Optional[SamplingParams] = None,
+               prefill_only: bool = False,
+               prefix_blob: Optional[dict] = None) -> Request:
         """Enqueue a request; raises QueueFullError on backpressure,
         PromptTooLongError for prompts above the bucket ladder, and
-        RuntimeError once draining."""
+        RuntimeError once draining.
+
+        ``prefill_only=True`` (disaggregated serving) stops the request
+        at the first-token boundary: its KV state is serialized into
+        ``req.handoff`` and the slot is released — the caller migrates
+        the payload to a decode replica via :meth:`submit_handoff`.
+        ``prefix_blob`` is a gang-shared prefix record adopted into the
+        local pool right before prefill (best-effort)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -175,7 +202,9 @@ class Scheduler:
                    else float(timeout_s))
         req = Request(prompt=prompt, max_new_tokens=max_new,
                       deadline=time.monotonic() + timeout,
-                      sampling=sampling or GREEDY)
+                      sampling=sampling or GREEDY,
+                      prefill_only=bool(prefill_only),
+                      prefix_blob=prefix_blob)
         with self._lock:
             if self._refusing is not None:
                 raise RuntimeError(self._refusing)
@@ -186,6 +215,43 @@ class Scheduler:
                     f"admission queue at capacity ({self.cfg.max_queue})")
             self._queue.append(req)
             smetrics.m_queue_depth.set(len(self._queue))
+        return req
+
+    def submit_handoff(self, handoff: dict, first_token: int,
+                       max_new_tokens: int = 16,
+                       timeout_s: Optional[float] = None,
+                       sampling: Optional[SamplingParams] = None,
+                       prompt: Optional[Sequence[int]] = None) -> Request:
+        """Enqueue a MIGRATED request (disaggregated serving): the
+        prefill replica already produced ``first_token`` and serialized
+        its KV into ``handoff``; this scheduler adopts the payload at
+        the start of its next tick and decodes from there. The request
+        is seeded with the first token so finish counting and greedy
+        output match the colocated path bit-for-bit."""
+        prompt = [int(t) for t in
+                  (prompt if prompt is not None
+                   else (handoff.get("tokens") or []))]
+        if not prompt:
+            raise ValueError("handoff carries no prompt tokens — "
+                             "preemption resume would be impossible")
+        max_new = max(1, min(int(max_new_tokens),
+                             self.cfg.max_new_tokens_cap))
+        timeout = (self.cfg.default_timeout_s if timeout_s is None
+                   else float(timeout_s))
+        req = Request(prompt=prompt, max_new_tokens=max_new,
+                      deadline=time.monotonic() + timeout,
+                      sampling=sampling or GREEDY, handoff=handoff)
+        req.tokens.append(int(first_token))
+        req.token_times.append(time.monotonic())
+        with self._lock:
+            if self._refusing is not None:
+                raise RuntimeError(self._refusing)
+            if self._draining:
+                raise RuntimeError("scheduler is draining")
+            if len(self._pending_handoffs) >= self.cfg.max_queue:
+                raise QueueFullError(
+                    f"handoff queue at capacity ({self.cfg.max_queue})")
+            self._pending_handoffs.append(req)
         return req
 
     def cancel(self, req: Request) -> bool:
@@ -207,6 +273,7 @@ class Scheduler:
         any work happened (False = idle, the loop may sleep)."""
         now = time.monotonic()
         self._expire_queued(now)
+        ingested = self._ingest_handoffs(now)
         admitted = self._admit(now)
         decoded = self._decode(now)
         self.steps += 1
@@ -214,7 +281,45 @@ class Scheduler:
         self.occupancy_sum += occ
         smetrics.m_occupancy.set(occ)
         smetrics.m_active.set(len(self._active))
-        return bool(admitted or decoded)
+        return bool(ingested or admitted or decoded)
+
+    def _ingest_handoffs(self, now: float) -> int:
+        """Adopt migrated requests' KV payloads into the cache — at the
+        tick START, on the loop thread, because adoption swaps the cache
+        arrays and must never race a decode step doing the same."""
+        n = 0
+        while True:
+            with self._lock:
+                if not self._pending_handoffs:
+                    break
+                req = self._pending_handoffs[0]
+            if req.deadline <= now:
+                with self._lock:
+                    self._pending_handoffs.popleft()
+                self._finish(req, EXPIRED,
+                             "deadline exceeded before KV adoption")
+                continue
+            try:
+                with _spans.default_tracer().context(
+                        (req.trace_id, req.root_span)):
+                    slot = self.engine.adopt_request_kv(req.handoff)
+            except (CacheFullError, PagePoolFullError):
+                break              # slot/pool pressure — retry next tick
+            except Exception as e:
+                with self._lock:
+                    self._pending_handoffs.popleft()
+                self._finish(req, FAILED, f"{type(e).__name__}: {e}")
+                continue
+            with self._lock:
+                self._pending_handoffs.popleft()
+            req.handoff = None
+            req.state = ACTIVE
+            req.slot = slot
+            self._active[slot] = req
+            self._next_token[slot] = req.tokens[-1]
+            self._admit_order.append(slot)
+            n += 1
+        return n
 
     def drain(self, timeout_s: float = 60.0) -> bool:
         """Stop admitting new requests and run the loop until every
@@ -228,7 +333,8 @@ class Scheduler:
         with _goodput.timer("drain"):
             while time.monotonic() < end:
                 with self._lock:
-                    idle = not self._queue and not self._active
+                    idle = (not self._queue and not self._active
+                            and not self._pending_handoffs)
                 if idle:
                     return True
                 self.step()
@@ -251,6 +357,8 @@ class Scheduler:
                 self._refusing = reason
             queued = list(self._queue)
             self._queue.clear()
+            queued += list(self._pending_handoffs)
+            self._pending_handoffs.clear()
             smetrics.m_queue_depth.set(0)
         n = 0
         for slot in list(self._active):
@@ -272,7 +380,8 @@ class Scheduler:
 
     def pending(self) -> int:
         with self._lock:
-            return len(self._queue) + len(self._active)
+            return (len(self._queue) + len(self._active)
+                    + len(self._pending_handoffs))
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -363,6 +472,17 @@ class Scheduler:
             if req is None:
                 break
             t_admit = time.perf_counter_ns()
+            if req.prefix_blob is not None:
+                # gang-shared prefix record: adopt into the local pool
+                # first so the prefill below hits instead of recomputing.
+                # Best-effort — any failure just means a cold prefill.
+                blob, req.prefix_blob = req.prefix_blob, None
+                try:
+                    from .kv_transfer import adopt_prefix
+
+                    adopt_prefix(self.engine, blob)
+                except Exception:
+                    pass
             try:
                 # prefill runs inside the request's span context so the
                 # engine's serve/prefill span parents under its root
@@ -401,7 +521,7 @@ class Scheduler:
                 req.tokens.append(int(first))
                 req.token_times.append(t)
                 req.ttft_ms = (t - req.submitted) * 1e3
-                smetrics.m_ttft_ms.observe(req.ttft_ms)
+                self._ttft_hist.observe(req.ttft_ms)
                 self.engine.note_tokens(1)
                 last = int(first)
             else:
@@ -410,6 +530,23 @@ class Scheduler:
                 req.tokens.append(int(first))
                 req.token_times.append(t)
                 last = int(first)
+            if req.prefill_only:
+                # first-token boundary of a disaggregated request:
+                # serialize the prompt's KV here on the loop thread
+                # (the only context allowed to touch the cache arrays),
+                # release the slot, and finish — the router migrates
+                # req.handoff to a decode replica
+                self._active[slot] = req
+                admitted += 1
+                try:
+                    req.handoff = self.engine.export_request_kv(
+                        slot, tokens=req.prompt)
+                except Exception as e:
+                    self._evict(slot, FAILED,
+                                f"{type(e).__name__}: {e}")
+                    continue
+                self._evict(slot, DONE, reason="handoff")
+                continue
             self._active[slot] = req
             self._next_token[slot] = last
             self._admit_order.append(slot)
@@ -501,7 +638,7 @@ class Scheduler:
                 tok = int(tok)
                 req.tokens.append(tok)
                 if req.token_times:
-                    smetrics.m_tpot_ms.observe(
+                    self._tpot_hist.observe(
                         (t - req.token_times[-1]) * 1e3)
                 req.token_times.append(t)
                 self._next_token[slot] = tok

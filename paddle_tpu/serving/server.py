@@ -230,9 +230,170 @@ class _Handler(BaseHTTPRequestHandler):
         front = self.server.front
         if self.path == "/generate":
             return self._generate(front)
+        if self.path == "/prefill":
+            return self._prefill(front)
+        if self.path == "/resume":
+            return self._resume(front)
         if self.path == "/predict":
             return self._predict(front)
         self._json(404, {"error": f"unknown path {self.path!r}"})
+
+    @staticmethod
+    def _parse_sampling(req_obj):
+        if not any(k in req_obj for k in ("temperature", "top_k",
+                                          "top_p", "seed")):
+            return None
+        from .sampling import SamplingParams
+
+        return SamplingParams(
+            temperature=float(req_obj.get("temperature", 0.0)),
+            top_k=int(req_obj.get("top_k", 0)),
+            top_p=float(req_obj.get("top_p", 1.0)),
+            seed=int(req_obj.get("seed", 0)))
+
+    # -- disaggregated phases (ISSUE 17) -----------------------------------
+    def _prefill(self, front: "FrontDoor"):
+        """Prefill-only: run to the first token, then either push the
+        KV handoff to the caller-named decode replica's transfer
+        endpoint (``kv_target``) or return it inline (base64)."""
+        if front.scheduler is None:
+            return self._json(400, {"error": "no generation engine loaded"})
+        if front.draining:
+            return self._json(503, {"error": "server is draining"},
+                              retry_after=front._retry_after())
+        req_obj = self._read_json()
+        if req_obj is None:
+            return
+        prompt = req_obj.get("prompt") or req_obj.get("tokens")
+        if not isinstance(prompt, list) or not prompt:
+            return self._json(
+                400, {"error": "body must carry a non-empty token list "
+                               "under 'prompt'"})
+        timeout_s = req_obj.get("timeout_s")
+        timeout_s = (front.request_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        try:
+            request = front.scheduler.submit(
+                prompt, max_new_tokens=int(req_obj.get(
+                    "max_new_tokens", 16)),
+                timeout_s=timeout_s,
+                sampling=self._parse_sampling(req_obj),
+                prefill_only=True)
+        except QueueFullError as e:
+            smetrics.m_shed.labels("queue_full").inc()
+            return self._json(429, {"error": str(e)},
+                              retry_after=front._retry_after())
+        except PromptTooLongError as e:
+            return self._json(400, {"error": str(e)})
+        except (TypeError, ValueError) as e:
+            return self._json(400, {"error": f"{type(e).__name__}: {e}"})
+        except RuntimeError as e:
+            return self._json(503, {"error": str(e)},
+                              retry_after=front._retry_after())
+        front.loop.wake()
+        request.wait(timeout=timeout_s + 1.0)
+        if request.state != "done" or request.handoff is None:
+            if request.state in ("expired", "queued", "active"):
+                return self._json(504, {
+                    "error": request.error or "deadline exceeded"})
+            return self._json(500, {"error": request.error
+                                    or f"request {request.state}"})
+        from . import kv_transfer as kvt
+
+        handoff = request.handoff
+        resp = {"first_token": int(request.tokens[0]),
+                "ttft_ms": round(request.ttft_ms, 3),
+                "transfer_id": handoff["transfer_id"]}
+        kv_target = req_obj.get("kv_target")
+        if kv_target:
+            handoff = dict(handoff, transfer_id=str(
+                kv_target.get("transfer_id") or handoff["transfer_id"]))
+            try:
+                kvt.send_handoff(kv_target["host"],
+                                 int(kv_target["port"]), handoff,
+                                 timeout_s=timeout_s)
+            except Exception as e:
+                # the prefill itself succeeded; the handoff channel did
+                # not — 502 tells the router to degrade to colocated
+                return self._json(502, {
+                    "error": f"KV push failed: {type(e).__name__}: {e}",
+                    "first_token": int(request.tokens[0])})
+            resp["transfer_id"] = handoff["transfer_id"]
+            resp["transferred"] = True
+        else:
+            resp["kv"] = kvt.handoff_to_jsonable(handoff)
+        return self._json(200, resp)
+
+    def _resume(self, front: "FrontDoor"):
+        """Decode a migrated request: adopt its KV handoff (socket
+        transfer by id, or inline) and generate from the first token."""
+        if front.scheduler is None:
+            return self._json(400, {"error": "no generation engine loaded"})
+        if front.draining:
+            return self._json(503, {"error": "server is draining"},
+                              retry_after=front._retry_after())
+        req_obj = self._read_json()
+        if req_obj is None:
+            return
+        if "first_token" not in req_obj:
+            return self._json(400, {"error": "body must carry "
+                                             "'first_token'"})
+        prompt = req_obj.get("prompt") or []
+        timeout_s = req_obj.get("timeout_s")
+        timeout_s = (front.request_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        from . import kv_transfer as kvt
+
+        if req_obj.get("transfer_id"):
+            if front.kv_server is None:
+                return self._json(400, {
+                    "error": "no KV transfer server on this replica"})
+            try:
+                handoff = front.kv_server.pop(
+                    str(req_obj["transfer_id"]),
+                    timeout_s=min(timeout_s, 10.0))
+            except TimeoutError as e:
+                return self._json(504, {"error": str(e)})
+        elif req_obj.get("kv"):
+            try:
+                handoff = kvt.handoff_from_jsonable(req_obj["kv"])
+            except Exception as e:
+                return self._json(400, {
+                    "error": f"malformed inline handoff: {e}"})
+        else:
+            return self._json(400, {"error": "body must carry "
+                                             "'transfer_id' or 'kv'"})
+        try:
+            request = front.scheduler.submit_handoff(
+                handoff, int(req_obj["first_token"]),
+                max_new_tokens=int(req_obj.get("max_new_tokens", 16)),
+                timeout_s=timeout_s,
+                sampling=self._parse_sampling(req_obj),
+                prompt=prompt or None)
+        except QueueFullError as e:
+            smetrics.m_shed.labels("queue_full").inc()
+            return self._json(429, {"error": str(e)},
+                              retry_after=front._retry_after())
+        except (TypeError, ValueError) as e:
+            return self._json(400, {"error": f"{type(e).__name__}: {e}"})
+        except RuntimeError as e:
+            return self._json(503, {"error": str(e)},
+                              retry_after=front._retry_after())
+        front.loop.wake()
+        request.wait(timeout=timeout_s + 1.0)
+        if request.state == "done":
+            return self._json(200, {
+                "tokens": request.tokens,
+                "num_tokens": len(request.tokens),
+                "tpot_ms": (round(request.tpot_ms, 3)
+                            if request.tpot_ms is not None else None),
+            })
+        if request.state in ("expired", "queued", "active"):
+            return self._json(504, {
+                "error": request.error or "deadline exceeded",
+                "partial_tokens": request.tokens})
+        return self._json(500, {"error": request.error
+                                or f"request {request.state}"})
 
     # -- engine backend ----------------------------------------------------
     def _generate(self, front: "FrontDoor"):
@@ -262,16 +423,7 @@ class _Handler(BaseHTTPRequestHandler):
                              f"deadline ({timeout_s:.1f}s) — shed "
                              f"({reason})"}, retry_after=after)
         try:
-            sampling = None
-            if any(k in req_obj for k in ("temperature", "top_k", "top_p",
-                                          "seed")):
-                from .sampling import SamplingParams
-
-                sampling = SamplingParams(
-                    temperature=float(req_obj.get("temperature", 0.0)),
-                    top_k=int(req_obj.get("top_k", 0)),
-                    top_p=float(req_obj.get("top_p", 1.0)),
-                    seed=int(req_obj.get("seed", 0)))
+            sampling = self._parse_sampling(req_obj)
             request = front.scheduler.submit(
                 prompt, max_new_tokens=int(req_obj.get(
                     "max_new_tokens", 16)),
@@ -347,7 +499,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(500, {"error": f"{type(e).__name__}: {e}"})
         finally:
             front._predict_slots.release()
-        smetrics.m_ttft_ms.observe((time.monotonic() - t0) * 1e3)
+        smetrics.m_ttft_ms.labels("predict", "colocated").observe(
+            (time.monotonic() - t0) * 1e3)
         return self._json(200, {"outputs": [np.asarray(o).tolist()
                                             for o in outs]})
 
@@ -363,11 +516,15 @@ class FrontDoor:
                  max_queue: int = 64, request_timeout_s: float = 30.0,
                  max_body_bytes: int = 256 << 20, verbose: bool = False,
                  shed_deadline_aware: bool = True,
-                 retry_after_cap_s: float = 60.0, on_poison=None):
+                 retry_after_cap_s: float = 60.0, on_poison=None,
+                 kv_server=None):
         if scheduler is None and predictor is None:
             raise ValueError("FrontDoor needs a scheduler or a predictor")
         self.scheduler = scheduler
         self.predictor = predictor
+        # KVTransferServer for the socket handoff channel (decode-role
+        # replicas in a disaggregated gang; None = inline handoffs only)
+        self.kv_server = kv_server
         self.max_queue = int(max_queue)
         self.request_timeout_s = float(request_timeout_s)
         self.max_body_bytes = int(max_body_bytes)
@@ -426,6 +583,9 @@ class FrontDoor:
         out: Dict[str, Any] = {
             "status": "draining" if self._draining else "ok",
         }
+        if self.scheduler is not None:
+            out["role"] = getattr(self.scheduler.engine, "role",
+                                  "colocated")
         if self.predictor is not None:
             out["inputs"] = self.predictor.get_input_names()
             out["outputs"] = self.predictor.get_output_names()

@@ -643,4 +643,5 @@ def test_request_metrics_flow(tiny_model):
         f.stop()
     assert _count(sm.m_requests) >= before_req + 1
     assert sm.m_tokens._unlabeled().value >= before_tok + 5
-    assert sm.m_ttft_ms._unlabeled().count >= 1
+    # ttft is split by {phase, role} since ISSUE 17 — sum the children
+    assert sum(c.count for c in sm.m_ttft_ms.children()) >= 1

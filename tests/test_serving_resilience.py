@@ -334,11 +334,14 @@ def test_prefix_store_warm_restart_in_process(tmp_path,
     assert rc.tokens == ra.tokens
 
 
-def test_prefix_store_skips_mismatched_geometry(tmp_path,
-                                                tiny_engine_factory):
-    """A record whose page shape does not match the live pool is
-    SKIPPED (counted), never half-applied — geometry drift across a
-    redeploy must not corrupt the cache."""
+def test_prefix_store_rejects_mismatched_geometry(tmp_path,
+                                                  tiny_engine_factory):
+    """A record written for a different cache config is REFUSED with a
+    field-by-field :class:`CacheConfigMismatch` at attach time (ISSUE
+    17) — geometry drift across a redeploy fails loudly instead of
+    silently skipping records or half-applying them."""
+    import pytest
+
     from paddle_tpu import serving
 
     store = serving.PrefixStore(str(tmp_path / "store"))
@@ -352,14 +355,55 @@ def test_prefix_store_skips_mismatched_geometry(tmp_path,
     store.wait()
     assert store.saved == 1
 
-    # different page_size -> incompatible page shape
+    # different page_size -> fingerprint mismatch names the field
+    store2 = serving.PrefixStore(str(tmp_path / "store"))
+    eng2 = tiny_engine_factory(kv_layout="paged", page_size=16,
+                               prefill_buckets=(16, 32))
+    with pytest.raises(serving.CacheConfigMismatch) as ei:
+        eng2.attach_prefix_store(store2)
+    assert "page_size" in str(ei.value)
+    # serving cold after refusing the store still works (the replica
+    # supervisor detaches the store on this error — replica.py)
+    eng2.prefix_store = None
+    eng2.warmup()
+    sched2 = serving.Scheduler(eng2)
+    r = sched2.submit([7] * 12, max_new_tokens=2)
+    while sched2.pending():
+        sched2.step()
+    assert r.state == "done"
+
+
+def test_prefix_store_skips_legacy_record_shape_drift(
+        tmp_path, tiny_engine_factory, monkeypatch):
+    """Fingerprint-less records (written before the fingerprint field
+    existed) keep the old behavior: shape drift is skipped and counted,
+    never half-applied."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import kv_transfer
+
+    store = serving.PrefixStore(str(tmp_path / "store"))
+    # simulate an old writer: records carry no fingerprint
+    monkeypatch.setattr(kv_transfer, "cache_fingerprint",
+                        lambda cache: None)
+    monkeypatch.setattr("paddle_tpu.serving.prefix_store"
+                        ".cache_fingerprint", lambda cache: None)
+    eng = tiny_engine_factory(kv_layout="paged", page_size=8)
+    eng.attach_prefix_store(store)
+    eng.warmup()
+    sched = serving.Scheduler(eng)
+    sched.submit([7] * 12, max_new_tokens=2)
+    while sched.pending():
+        sched.step()
+    store.wait()
+    assert store.saved == 1
+    monkeypatch.undo()
+
     store2 = serving.PrefixStore(str(tmp_path / "store"))
     eng2 = tiny_engine_factory(kv_layout="paged", page_size=16,
                                prefill_buckets=(16, 32))
     assert eng2.attach_prefix_store(store2) == 0
     assert store2.restore_skipped == 1
     eng2.warmup()
-    # the engine still serves normally
     sched2 = serving.Scheduler(eng2)
     r = sched2.submit([7] * 12, max_new_tokens=2)
     while sched2.pending():

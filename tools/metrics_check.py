@@ -504,6 +504,19 @@ def _run_check_inner(out_dir: str) -> dict:
     def _recompile_total():
         return _counter_sum("paddle_recompiles_total")
 
+    def _kv_transfer_state():
+        snap_kv = default_registry().snapshot()
+        return {
+            "bytes": {tuple(s["labels"]): s["value"] for s in
+                      snap_kv.get("paddle_kv_transfer_bytes_total", {})
+                      .get("series", [])},
+            "count": sum(s["count"] for s in
+                         snap_kv.get("paddle_kv_transfer_ms", {})
+                         .get("series", [])),
+        }
+
+    kv_before = _kv_transfer_state()
+
     scfg = gpt_model.GPT_TINY.scaled(num_layers=2, max_seq_len=64)
     sparams = gpt_model.init_params(jrandom.PRNGKey(7), scfg)
     sengine = pserving.DecodeEngine(
@@ -539,11 +552,16 @@ def _run_check_inner(out_dir: str) -> dict:
     serve_200 = {tuple(s["labels"]): s["value"] for s in
                  snap["paddle_serve_requests_total"]["series"]}
     assert serve_200.get(("200",), 0) >= 20, serve_200
-    ttft = snap["paddle_serve_ttft_ms"]["series"][0]
-    assert ttft["count"] >= 20 and math.isfinite(ttft["sum"]) \
-        and ttft["sum"] >= 0, ttft
-    tpot = snap["paddle_serve_tpot_ms"]["series"][0]
-    assert tpot["count"] >= 20 and math.isfinite(tpot["sum"]), tpot
+    # ttft/tpot are split by {phase, role} since the disagg work — a
+    # colocated serve lands everything on one labeled child, but sum
+    # across children so the assertion survives mixed-role runs
+    ttft_series = snap["paddle_serve_ttft_ms"]["series"]
+    assert sum(s["count"] for s in ttft_series) >= 20, ttft_series
+    assert all(math.isfinite(s["sum"]) and s["sum"] >= 0
+               for s in ttft_series), ttft_series
+    tpot_series = snap["paddle_serve_tpot_ms"]["series"]
+    assert sum(s["count"] for s in tpot_series) >= 20, tpot_series
+    assert all(math.isfinite(s["sum"]) for s in tpot_series), tpot_series
     assert math.isfinite(
         snap["paddle_serve_tokens_per_s"]["series"][0]["value"])
     assert snap["paddle_serve_tokens_total"]["series"][0]["value"] >= 80
@@ -869,6 +887,47 @@ def _run_check_inner(out_dir: str) -> dict:
     apath = os.path.join(out_dir, "ATTRIBUTION.json")
     ATT.write(attr_doc, apath)
 
+    # --- disagg KV-transfer gate (ISSUE 17, docs/serving.md
+    # "Disaggregation"): the transfer counters must move ONLY on disagg
+    # runs. Everything above was plain colocated serving — slab smoke,
+    # paged prefix-cache smoke, warm restart, spec decode, fused decode
+    # — so the counters must be EXACTLY where they started; then one
+    # in-process export/adopt exchange must move them by the exact
+    # stats-reported byte totals, under the chunk-residency budget
+    from paddle_tpu.serving import kv_transfer as kvt_mod
+
+    kv_flat = _kv_transfer_state()
+    assert kv_flat == kv_before, \
+        f"KV transfer counters moved on a colocated-only run: " \
+        f"{kv_before} -> {kv_flat} (they must move only on disagg)"
+    xprompt = [2, 4, 6, 8, 10, 12, 14, 16]
+    xslot, xlogits = pengine.start_sequence(xprompt)
+    xtok = int(np.argmax(xlogits))
+    handoff = pserving.export_slot(pengine, xslot, tokens=xprompt)
+    yslot = pserving.adopt_into_engine(dengine, handoff)
+    # bit-identical greedy continuation across the handoff
+    xout = pengine.decode_step({xslot: xtok})
+    yout = dengine.decode_step({yslot: xtok})
+    assert int(np.argmax(xout[xslot])) == int(np.argmax(yout[yslot])), \
+        "greedy token diverged across the KV handoff"
+    pengine.free_sequence(xslot)
+    dengine.free_sequence(yslot)
+    exp_stats = kvt_mod.last_stats("export")
+    adp_stats = kvt_mod.last_stats("adopt")
+    assert exp_stats is not None and adp_stats is not None
+    assert adp_stats.peak_bytes <= adp_stats.budget_bytes, \
+        f"adopt peak residency {adp_stats.peak_bytes} exceeded the " \
+        f"chunk budget {adp_stats.budget_bytes}"
+    kv_moved = _kv_transfer_state()
+    assert kv_moved["bytes"].get(("out",), 0) - \
+        kv_before["bytes"].get(("out",), 0) == exp_stats.total_bytes, \
+        (kv_before, kv_moved, exp_stats.total_bytes)
+    assert kv_moved["bytes"].get(("in",), 0) - \
+        kv_before["bytes"].get(("in",), 0) == adp_stats.total_bytes, \
+        (kv_before, kv_moved, adp_stats.total_bytes)
+    assert kv_moved["count"] - kv_before["count"] == 2, \
+        (kv_before["count"], kv_moved["count"])
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -923,7 +982,14 @@ def _run_check_inner(out_dir: str) -> dict:
                  "paddle_serve_shed_total",
                  "paddle_serve_replica_restarts_total",
                  "paddle_serve_failover_requests_total",
-                 "paddle_serve_prefix_store_total"):
+                 "paddle_serve_prefix_store_total",
+                 # ISSUE 17 disagg families: KV handoff wire bytes +
+                 # latency, pool-level prefix cache, phase fallback
+                 # (docs/serving.md "Disaggregation")
+                 "paddle_kv_transfer_bytes_total",
+                 "paddle_kv_transfer_ms",
+                 "paddle_serve_pool_prefix_cache_total",
+                 "paddle_serve_disagg_fallback_total"):
         assert name in prom_text, f"{name} missing from exposition"
     assert 'paddle_serve_requests_total{code="200"}' in prom_text
     assert 'paddle_serve_prefix_cache_total{event="hit"}' in prom_text
@@ -932,6 +998,9 @@ def _run_check_inner(out_dir: str) -> dict:
     assert 'paddle_serve_shed_total{reason="deadline"}' in prom_text
     assert 'paddle_serve_prefix_store_total{op="save"}' in prom_text
     assert 'paddle_serve_prefix_store_total{op="restore"}' in prom_text
+    # the disagg exchange above left exact per-direction wire samples
+    assert 'paddle_kv_transfer_bytes_total{direction="out"}' in prom_text
+    assert 'paddle_kv_transfer_bytes_total{direction="in"}' in prom_text
     # streaming input families (docs/data.md): the seeded faulty stream
     # above must have left retry/quarantine/progress samples
     for name in ("paddle_input_retries_total",
@@ -977,6 +1046,11 @@ def _run_check_inner(out_dir: str) -> dict:
                              "warm_restart_prefill_tokens":
                                  int(warm_delta)},
             "spec_acceptance_rate": round(sspec.stats.acceptance_rate, 4),
+            "kv_transfer": {
+                "export_bytes": int(exp_stats.total_bytes),
+                "adopt_bytes": int(adp_stats.total_bytes),
+                "adopt_peak_bytes": int(adp_stats.peak_bytes),
+                "adopt_budget_bytes": int(adp_stats.budget_bytes)},
             "megakernel_launches": {
                 k: int(v - mk_section_before.get(k, 0))
                 for k, v in mk_after.items()},

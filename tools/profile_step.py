@@ -20,6 +20,9 @@ Usage:
   python tools/profile_step.py --smoke          # tiny CPU-sized lane
   python tools/profile_step.py --serve [--ticks 16] [--attr-out PATH]
       [--fused-decode]                          # one-launch decode step
+      [--disagg] [--role prefill|decode]  # stamp disagg=1 + role into
+      # the attribution config so phase-split captures diff cleanly
+      # against colocated ones (docs/serving.md "Disaggregation")
   python tools/profile_step.py --compare A.json B.json
       # residue-diff two attribution captures (per-group ms/step and
       # event-count deltas) — the before/after gate for each megakernel
@@ -230,7 +233,7 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
                   d: int = 64, layers: int = 4, nh: int = 4, ff: int = 128,
                   vocab: int = 256, max_batch: int = 4, max_seq: int = 64,
                   weight_dtype: str = "f32", kv_layout: str = "slab",
-                  fused_decode: bool = False):
+                  fused_decode: bool = False, role: str = "colocated"):
     """Profile a warmed DecodeEngine decode tick: fill every slot, trace
     ``ticks`` full-batch decode steps, attribute through the same
     roofline path — the decode residue ranking is ROADMAP item 3(b)'s
@@ -250,7 +253,7 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     ekw = dict(max_batch=max_batch, max_seq=max_seq,
                prefill_buckets=(8, 16), weight_dtype=weight_dtype,
-               fused_decode=fused_decode)
+               fused_decode=fused_decode, role=role)
     if kv_layout == "paged":
         ekw.update(kv_layout="paged", page_size=8)
     engine = serving.DecodeEngine(params, cfg,
@@ -295,13 +298,19 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
         "kv_layout": kv_layout, "max_batch": max_batch,
         "max_seq": max_seq, "d_model": d, "layers": layers,
         "fused_decode": fused_decode,
+        # disagg stamp (ISSUE 17): phase-split captures must be
+        # distinguishable from colocated ones when residue-diffed —
+        # a prefill-only replica's roofline is not a decode replica's
+        "disagg": 1 if role in ("prefill", "decode") else 0,
+        "role": role,
     }
     attribution = ATT.build_from_trace(
         trace_dir, steps=ticks, wall_ms_per_step=wall_ms,
         hlo_texts=hlo_texts, device=dev, mode="decode",
         spec=f"serve:d={d},L={layers},b={max_batch},"
              f"{weight_dtype},{kv_layout}"
-             + (",fused" if fused_decode else ""),
+             + (",fused" if fused_decode else "")
+             + (f",{role}" if role != "colocated" else ""),
         step_flops=decode_rep.get("flops"),
         step_bytes=decode_rep.get("bytes_accessed"),
         programs=reports[-8:] or None, config=config,
@@ -372,12 +381,16 @@ def main():
         compare_attributions(sys.argv[i + 1], sys.argv[i + 2])
         return
     if "--serve" in sys.argv:
+        role = _flag("--role", "colocated")
+        if "--disagg" in sys.argv and role == "colocated":
+            role = "decode"      # decode replicas are the tick being traced
         serve_profile(trace_dir, ticks=int(_flag("--ticks", 16, int)),
                       attr_out=attr_out,
                       weight_dtype=_flag("--weight-dtype", "f32"),
                       kv_layout=_flag("--kv-layout", "slab"),
                       max_batch=int(_flag("--max-batch", 4, int)),
-                      fused_decode="--fused-decode" in sys.argv)
+                      fused_decode="--fused-decode" in sys.argv,
+                      role=role)
         return
     if "--smoke" in sys.argv:
         spec_str = SMOKE_SPEC

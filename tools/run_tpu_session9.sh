@@ -1,0 +1,55 @@
+#!/bin/bash
+# Chip session 9: disaggregated prefill/decode serving on-chip
+# (ISSUE 17) — after the still-queued session 8 (attribution + fused
+# A/B, which itself chains 5/6/7; run order is enforced by markers).
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session9.sh > tpu_s9.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+if [ ! -f .tpu_s8_done ]; then
+  echo "=== [0/4] session 8 (attribution lanes) still queued — running it first ==="
+  bash tools/run_tpu_session8.sh
+fi
+
+echo "=== [1/4] serve bench incl. disagg A/B on-chip $(date -u +%H:%M:%S) ==="
+# the headline lane PLUS the in-process disagg-vs-colocated A/B: same
+# Poisson long/short mix as the committed CPU lane, on real HBM. The
+# in-process router (serving/disagg.py) runs both phase engines in ONE
+# jax process, so the single-process TPU caveat from session 8 does not
+# apply — this measures the handoff + phase-split scheduling, not
+# multi-process chip ownership.
+python tools/serve_bench.py --disagg --out SERVE_BENCH_tpu.json
+echo "=== serve bench rc=$? ==="
+
+echo "=== [2/4] phase-split decode attribution (role stamped) $(date -u +%H:%M:%S) ==="
+# the decode-replica tick under the disagg stamp: ATTRIBUTION config
+# carries disagg=1 + role so this capture residue-diffs cleanly against
+# session 8's colocated ATTRIBUTION_DECODE.json
+python tools/profile_step.py --serve --disagg --ticks 32 --max-batch 16 \
+  --kv-layout paged --dir /tmp/s9-decode-disagg-trace \
+  --attr-out ATTRIBUTION_DECODE_DISAGG_tpu.json
+echo "=== disagg decode attribution rc=$? ==="
+python tools/profile_step.py --compare ATTRIBUTION_DECODE.json \
+  ATTRIBUTION_DECODE_DISAGG_tpu.json | tee ATTRIBUTION_DIFF_DISAGG_tpu.txt
+echo "=== decode compare rc=$? ==="
+
+echo "=== [3/4] metrics gate on-chip (incl. the disagg counter gate) $(date -u +%H:%M:%S) ==="
+# asserts the KV-transfer counters stay FLAT on colocated serving and
+# MOVE by the exact stats-reported bytes on one export/adopt exchange
+python tools/metrics_check.py --out /tmp/metrics_check_tpu_s9
+echo "=== metrics_check rc=$? ==="
+
+echo "=== [4/4] disagg test lane on-chip $(date -u +%H:%M:%S) ==="
+# parity + tp=2->tp=1 redistribution + fallback matrix on real chips
+# (the tp lane shards over real devices instead of the 8-way CPU mesh)
+python -m pytest tests/test_disagg.py -q -p no:cacheprovider
+echo "=== disagg tests rc=$? ==="
+
+# The multi-process replica gang (serving/gang.py + replica.py) stays
+# CPU-lane on-chip for the same reason as session 8's fault bench: one
+# unpinned jax TPU process per replica claims every local chip. The
+# per-replica TPU_VISIBLE_DEVICES pinning noted in run_tpu_session8.sh
+# is the prerequisite for an on-chip gang disagg lane.
+date -u > .tpu_s9_done

@@ -303,6 +303,191 @@ def capacity_lane(params, cfg, ecfg_kw, lane, slo_ttft_p99_ms: float,
     }
 
 
+def _family_total(name):
+    from paddle_tpu.observability import metrics as om
+
+    snap = om.default_registry().snapshot()
+    return sum(s["value"] for s in
+               snap.get(name, {}).get("series", []))
+
+
+def disagg_lane(params, cfg, ecfg_kw, rate_rps: float, n_requests: int,
+                max_new_tokens: int, seed: int, page_size: int = 8):
+    """Disaggregated-vs-colocated A/B at EQUAL chips (ISSUE 17).
+
+    Same mixed long/short Poisson trace against two 2-engine
+    topologies: [prefill, decode] with first-token KV migration
+    (serving/disagg.py) vs [colocated, colocated] with least-loaded
+    placement (equal chips — per-role batch geometry is the tuning
+    freedom the split buys: the prefill replica's slots recycle at
+    export so it keeps the base batch, while the decode replica runs
+    2x to absorb the pooled decode stream). The rate is chosen to
+    saturate the colocated
+    pair's slot budget: once every colocated slot is held by a decoding
+    request, new prompts queue behind decode completions and colocated
+    p99 TTFT is slot-wait, not prefill time. The split removes exactly
+    that coupling — the prefill replica's prefill-only slots recycle at
+    export, so TTFT never waits on a decode stream. The cost shows up
+    where disaggregation really pays it: the decode replica absorbs the
+    pooled stream, and a request's post-migration slot wait lands in
+    its first token gap (the TPOT tail, reported below), never in
+    TTFT."""
+    import threading as _threading
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving.disagg import (DisaggRouter, LocalReplica,
+                                           SharedPrefixIndex)
+
+    base_batch = int(ecfg_kw.get("max_batch", 8))
+    kw = {k: v for k, v in ecfg_kw.items() if k != "max_batch"}
+
+    def make(role, max_batch):
+        e = serving.DecodeEngine(params, cfg, serving.EngineConfig(
+            max_batch=max_batch, kv_layout="paged",
+            page_size=page_size, role=role, **kw))
+        e.warmup()
+        return e
+
+    # -- mixed long/short Poisson trace (shared by both topologies) ----
+    buckets = sorted(ecfg_kw["prefill_buckets"])
+    long_len = buckets[-1] - 2
+    short_max = max(4, buckets[0] - 4)
+    rng = np.random.RandomState(seed)
+    prompts = []
+    for _ in range(n_requests):
+        ln = long_len if rng.rand() < 0.3 else int(
+            rng.randint(2, short_max + 1))
+        prompts.append(rng.randint(0, cfg.vocab_size, size=ln).tolist())
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+
+    def drive(generate_fn):
+        """Open-loop replay: one thread per arrival (generate blocks)."""
+        results = [None] * n_requests
+        threads = []
+        rc0 = _recompile_total()
+        t0 = time.monotonic()
+        for i, (gap, prompt) in enumerate(zip(gaps, prompts)):
+            time.sleep(gap)
+            th = _threading.Thread(
+                target=lambda i=i, p=prompt: results.__setitem__(
+                    i, generate_fn(p)), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=180.0)
+        span = time.monotonic() - t0
+        return results, span, _recompile_total() - rc0
+
+    def summarize(res, span, recompiles):
+        done = [r for r in res if r is not None and r.state == "done"]
+        ttfts = [r.ttft_ms for r in done if r.ttft_ms is not None]
+        tpots = []
+        for r in done:
+            tpots.extend((np.diff(r.token_times) * 1e3).tolist())
+        total = sum(len(r.tokens) for r in done)
+        return {
+            "requests": n_requests, "completed": len(done),
+            "failed": n_requests - len(done),
+            "ttft_ms": {"p50": round(_pct(ttfts, 50), 3),
+                        "p99": round(_pct(ttfts, 99), 3)},
+            "tpot_ms": {"p50": round(_pct(tpots, 50), 3),
+                        "p99": round(_pct(tpots, 99), 3)},
+            "tokens_per_s": round(total / span, 2),
+            "steady_state_recompiles": int(recompiles),
+        }
+
+    timeout_s = 120.0
+    parity_idx = list(range(min(4, n_requests)))
+
+    # -- topology A: two colocated engines, least-loaded placement -----
+    colo = [LocalReplica(make("colocated", base_batch), name=f"colo{i}")
+            for i in range(2)]
+
+    def colo_generate(prompt):
+        rep = min(colo, key=lambda r: r.load_eta_s())
+        req = rep.scheduler.submit(prompt,
+                                   max_new_tokens=max_new_tokens,
+                                   timeout_s=timeout_s)
+        rep.wake()
+        req.wait(timeout=timeout_s + 1.0)
+        return req
+
+    parity_colo = [list(colo_generate(prompts[i]).tokens)
+                   for i in parity_idx]
+    colo_res, colo_span, colo_rc = drive(colo_generate)
+    colo_sum = summarize(colo_res, colo_span, colo_rc)
+    for rep in colo:
+        rep.stop()
+
+    # -- topology B: prefill -> decode with KV migration ---------------
+    # (the prefix index sits out of the timed load — the random trace
+    # has no shared prefixes, so publishing would be pure prefill-path
+    # drag; its counters are exercised in the dedicated phase below)
+    reps = [LocalReplica(make("prefill", base_batch), name="prefill0"),
+            LocalReplica(make("decode", base_batch), name="decode0")]
+    router = DisaggRouter(reps)
+    bytes0 = _family_total("paddle_kv_transfer_bytes_total")
+
+    def disagg_generate(prompt):
+        return router.generate(prompt, max_new_tokens=max_new_tokens,
+                               timeout_s=timeout_s)
+
+    parity_disagg = [list(disagg_generate(prompts[i]).tokens)
+                     for i in parity_idx]
+    dis_res, dis_span, dis_rc = drive(disagg_generate)
+    dis_sum = summarize(dis_res, dis_span, dis_rc)
+    handoffs = [r.handoff_ms for r in dis_res
+                if r is not None and r.migrated
+                and r.handoff_ms is not None]
+    kv_bytes = _family_total("paddle_kv_transfer_bytes_total") - bytes0
+
+    # -- pool-level prefix cache exercise (gang-shared index) ----------
+    index = SharedPrefixIndex()
+    router.prefix_index = index
+    for rep in reps:
+        rep.engine.prefix_store = index.binding(rep.role)
+    shared = rng.randint(0, cfg.vocab_size, size=16).tolist()
+    for i in range(3):
+        tail = rng.randint(0, cfg.vocab_size, size=4 + i).tolist()
+        router.generate(shared + tail, max_new_tokens=4,
+                        timeout_s=timeout_s)
+    for rep in reps:
+        rep.stop()
+
+    dis_sum["migrated"] = router.migrated
+    dis_sum["fallbacks"] = router.fallbacks
+    dis_sum["handoff_ms"] = {
+        "p50": round(_pct(handoffs, 50), 3) if handoffs else None,
+        "p99": round(_pct(handoffs, 99), 3) if handoffs else None}
+    dis_sum["kv_transfer_bytes"] = int(kv_bytes)
+    dis_sum["pool_prefix"] = {"hits": index.hits,
+                              "misses": index.misses,
+                              "published": index.published}
+
+    tokens_match = parity_disagg == parity_colo
+    ttft_win = (dis_sum["ttft_ms"]["p99"] is not None
+                and colo_sum["ttft_ms"]["p99"] is not None
+                and dis_sum["ttft_ms"]["p99"]
+                < colo_sum["ttft_ms"]["p99"])
+    # p50 for the no-regress bar: CPU-smoke p99 TPOT is a single-tick
+    # noise sample at these request counts; 1.15x absorbs that jitter
+    tpot_ok = (dis_sum["tpot_ms"]["p50"] is not None
+               and dis_sum["tpot_ms"]["p50"]
+               <= colo_sum["tpot_ms"]["p50"] * 1.15)
+    return {
+        "rate_rps": rate_rps, "max_new_tokens": max_new_tokens,
+        "n_engines_per_topology": 2,
+        "long_prompt_len": long_len, "long_frac": 0.3,
+        "colocated": colo_sum, "disagg": dis_sum,
+        "greedy_tokens_match": bool(tokens_match),
+        "ttft_p99_win": bool(ttft_win),
+        "tpot_no_regress": bool(tpot_ok),
+        "disagg_pass": bool(tokens_match and ttft_win and tpot_ok
+                            and dis_sum["failed"] == 0
+                            and colo_sum["failed"] == 0),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
@@ -332,6 +517,16 @@ def main(argv=None):
     ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
     ap.add_argument("--capacity-rates", default="4,16,64,256")
     ap.add_argument("--capacity-requests", type=int, default=16)
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated-vs-colocated A/B lane "
+                         "(ISSUE 17) and gate on disagg_pass")
+    ap.add_argument("--disagg-rate", type=float, default=160.0,
+                    help="arrival rate for the disagg A/B — picked to "
+                         "saturate the colocated pair's slot budget")
+    ap.add_argument("--disagg-requests", type=int, default=48)
+    ap.add_argument("--disagg-max-new", type=int, default=32,
+                    help="decode length for the disagg A/B (long "
+                         "decodes are what makes slots scarce)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -343,6 +538,7 @@ def main(argv=None):
         args.rates, args.requests = "16,64", 24
         args.eval_len = 24
         args.capacity_rates, args.capacity_requests = "8,64", 12
+        args.disagg_requests = 32
 
     import jax.numpy as jnp
 
@@ -428,6 +624,15 @@ def main(argv=None):
             args.prompt_len_max, args.seed + 3, args.queue_cap))
     result["capacity"] = capacity
 
+    if args.disagg:
+        print(f"[serve_bench] disagg A/B lane "
+              f"(rate={args.disagg_rate}/s, "
+              f"{args.disagg_requests} requests)...", flush=True)
+        result["disagg"] = disagg_lane(
+            params, cfg, ecfg_kw, args.disagg_rate,
+            args.disagg_requests, args.disagg_max_new, args.seed + 4)
+        result["disagg_pass"] = result["disagg"]["disagg_pass"]
+
     all_recompiles = ([l["steady_state_recompiles"] for l in lanes]
                       + [c["steady_state_recompiles"] for c in capacity])
     result["steady_state_recompiles"] = max(all_recompiles)
@@ -444,7 +649,8 @@ def main(argv=None):
                       if k not in ("load", "capacity")}, indent=1))
     print(f"[serve_bench] wrote {args.out}")
     if not (result["zero_recompile_pass"] and result["int8_pass"]
-            and result["engine_parity_pass"]):
+            and result["engine_parity_pass"]
+            and result.get("disagg_pass", True)):
         return 1
     return 0
 
