@@ -43,6 +43,17 @@ split (host RecordEvent + device tracer + train monitor callbacks):
   program reports) diffed against a committed ``PERF_BASELINE.json``
   with per-metric tolerance bands and cause attribution
   (``tools/perf_diff.py`` is the CLI).
+- :mod:`.fleet` — live fleet aggregation (ISSUE 18): the gang
+  supervisor's poller folding per-replica ``/metrics`` + heartbeats into
+  a continuously refreshed ``FLEET.json`` (per-role rollups) and a
+  merged exposition with ``replica``/``role`` labels preserved, served
+  from the gang's ``GET /fleet``.
+- :mod:`.slo` — the live SLO engine (ISSUE 18): declarative objectives
+  (p99 TTFT, p50 TPOT, error/shed rate, availability) over rolling
+  windows, multi-window burn-rate alerting with a per-objective latch,
+  an error-budget ledger that survives warm restarts, and bounded
+  slow-request forensic dumps. ``slo_status()`` is the machine-readable
+  signal surface.
 - :mod:`.program_report` — compile- & memory-side introspection (ISSUE 4):
   per-executable cost/memory program reports (JSONL +
   ``paddle_program_*`` gauges), the recompile explainer
@@ -67,16 +78,19 @@ from .metrics import (  # noqa: F401
 from .monitor import MonitorWriter, TrainMonitor  # noqa: F401
 from . import attribution  # noqa: F401
 from . import baseline  # noqa: F401
+from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import hw  # noqa: F401
 from . import program_report  # noqa: F401
 from . import prom  # noqa: F401
+from . import slo  # noqa: F401
 from . import spans  # noqa: F401
 from . import trace_merge  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "metrics_enabled", "set_metrics_enabled",
-    "MonitorWriter", "TrainMonitor", "attribution", "baseline", "goodput",
-    "hw", "program_report", "prom", "spans", "trace_merge",
+    "MonitorWriter", "TrainMonitor", "attribution", "baseline", "fleet",
+    "goodput", "hw", "program_report", "prom", "slo", "spans",
+    "trace_merge",
 ]

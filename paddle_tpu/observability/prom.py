@@ -21,7 +21,7 @@ from .metrics import MetricsRegistry, default_registry
 from .metrics import _CounterChild, _GaugeChild, _HistogramChild  # noqa: F401
 
 __all__ = ["render", "write_textfile", "merge_expositions",
-           "MetricsHTTPServer"]
+           "GAUGE_MERGE_SUM", "MetricsHTTPServer"]
 
 
 def _escape_help(s: str) -> str:
@@ -89,17 +89,37 @@ def write_textfile(path: str,
     return path
 
 
-def merge_expositions(texts) -> str:
+# gauges that are per-rank COUNTS of live things, not levels: the gang
+# total is their sum (2 replicas each holding 3 active requests = 6
+# in-flight fleet-wide).  Every other gauge stays MAX — occupancy and
+# ratio-style gauges would be nonsense above 1.0 if summed.
+GAUGE_MERGE_SUM = frozenset({
+    "paddle_serve_queue_depth",
+    "paddle_serve_active_requests",
+})
+
+
+def merge_expositions(texts, gauge_merge=None, extra_labels=None) -> str:
     """Merge several text expositions (one per gang rank) into ONE gang
     exposition (the ISSUE 10 supervisor aggregation).
 
     Merge rules by declared TYPE: ``counter`` and ``histogram`` samples
     (including ``_bucket``/``_sum``/``_count``) SUM across ranks — restart
     downtime, goodput seconds and request counts are gang totals;
-    ``gauge`` samples take the MAX (a gauge is a point-in-time level, and
-    the worst rank is the operationally interesting one).  HELP/TYPE rows
-    come from the first exposition that declared the family.  Output stays
-    valid against the 0.0.4 grammar (tools/metrics_check.py's validator).
+    ``gauge`` samples merge per family: additive gauges (queue depth,
+    active slots — :data:`GAUGE_MERGE_SUM`, overridable via
+    ``gauge_merge={family: "sum"|"max"}``) SUM across ranks, level
+    gauges (occupancy) take the MAX — the worst rank is the
+    operationally interesting one and a summed ratio is meaningless.
+    HELP/TYPE rows come from the first exposition that declared the
+    family.  Output stays valid against the 0.0.4 grammar
+    (tools/metrics_check.py's validator).
+
+    ``extra_labels`` — a sequence parallel to ``texts`` of label-pair
+    lists (e.g. ``[("replica", "0"), ("role", "prefill")]``) injected
+    into every sample of that source BEFORE merging, so per-replica
+    series survive in a fleet exposition instead of collapsing
+    (observability/fleet.py's merged view).
     """
     types: dict = {}            # family -> type
     helps: dict = {}            # family -> help line
@@ -113,7 +133,21 @@ def merge_expositions(texts) -> str:
                 return name[: -len(suffix)]
         return name
 
-    for text in texts:
+    def gauge_policy(fam: str) -> str:
+        if gauge_merge and fam in gauge_merge:
+            return gauge_merge[fam]
+        return "sum" if fam in GAUGE_MERGE_SUM else "max"
+
+    def inject(labels: str, extra) -> str:
+        pairs = ",".join(f'{k}="{_escape_label(str(v))}"'
+                         for k, v in extra)
+        if not labels:
+            return "{" + pairs + "}"
+        return labels[:-1] + "," + pairs + "}"
+
+    for i, text in enumerate(texts):
+        extra = (list(extra_labels[i])
+                 if extra_labels and extra_labels[i] else None)
         for line in text.splitlines():
             if not line:
                 continue
@@ -142,12 +176,15 @@ def merge_expositions(texts) -> str:
                 value = float(line[space + 1:])
             except ValueError:
                 continue
+            if extra:
+                labels = inject(labels, extra)
             fam = family_of(name)
             if fam not in order:
                 order.append(fam)
             fam_samples = samples.setdefault(fam, {})
             key = (name, labels)
-            if key in fam_samples and types.get(fam) == "gauge":
+            if key in fam_samples and types.get(fam) == "gauge" \
+                    and gauge_policy(fam) == "max":
                 fam_samples[key] = max(fam_samples[key], value)
             else:
                 fam_samples[key] = fam_samples.get(key, 0.0) + value

@@ -37,6 +37,8 @@ from typing import Any, Dict, IO, List, Optional, Tuple, Union
 __all__ = [
     "SpanTracer", "default_tracer", "span", "record", "current_context",
     "gen_id", "set_tracing_enabled", "tracing_enabled",
+    "WIRE_KEY", "inject", "extract", "attach_process_sink",
+    "process_sink_path",
 ]
 
 # process-wide kill switch, mirroring metrics.set_metrics_enabled — the
@@ -69,6 +71,65 @@ def gen_id() -> int:
 
 
 Context = Tuple[int, int]  # (trace_id, span_id)
+
+# -- trace-context wire format (ISSUE 18) -----------------------------------
+# One request = ONE trace across processes: the gang front door mints a
+# context, injects it into every replica-bound JSON body (and the KV
+# handoff frame), and each hop extracts + re-injects.  The wire shape is
+# a plain JSON object under the ``trace`` key:
+#
+#     {"trace": {"trace_id": <int>, "parent_span": <int>}}
+#
+# ints, not hex strings, so stdlib-only workers (serving/replica.py stub
+# mode) round-trip it with nothing but ``json``.
+
+WIRE_KEY = "trace"
+
+
+def inject(ctx: Optional[Context]) -> Optional[Dict[str, int]]:
+    """Serialize a (trace_id, span_id) context for a JSON body / frame.
+    The receiving side's spans parent under ``parent_span``."""
+    if ctx is None:
+        return None
+    return {"trace_id": int(ctx[0]), "parent_span": int(ctx[1])}
+
+
+def extract(obj: Any) -> Optional[Context]:
+    """Inverse of :func:`inject`.  Accepts the wire dict itself or any
+    mapping carrying it under :data:`WIRE_KEY`; returns None on anything
+    malformed (a request with a garbled trace still serves — it just
+    starts a fresh trace)."""
+    if not isinstance(obj, dict):
+        return None
+    wire = obj.get(WIRE_KEY, obj)
+    if not isinstance(wire, dict):
+        return None
+    try:
+        return (int(wire["trace_id"]), int(wire["parent_span"]))
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def process_sink_path(trace_dir: str, role: str = "proc") -> str:
+    """Per-process span file inside a shared trace dir.  The pid keeps
+    sibling replicas (and restarted incarnations) from clobbering each
+    other; tools/trace_assemble.py globs ``spans-*.jsonl``."""
+    import os
+
+    return os.path.join(trace_dir, f"spans-{role}-{os.getpid()}.jsonl")
+
+
+def attach_process_sink(trace_dir: str, role: str = "proc") -> str:
+    """Point the default tracer's JSONL sink at this process's file in
+    ``trace_dir`` (created if missing).  Append-at-record with per-line
+    flush — a SIGKILLed process leaves every finished span on disk for
+    post-mortem assembly."""
+    import os
+
+    os.makedirs(trace_dir, exist_ok=True)
+    path = process_sink_path(trace_dir, role)
+    _default.set_sink(path)
+    return path
 
 
 class _OpenSpan:

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..observability import spans as _spans
 from . import metrics as smetrics
 from .kv_transfer import export_prefix
 from .sampling import SamplingParams
@@ -163,11 +164,11 @@ class DisaggResult:
     and latency accounting (prefill-side TTFT, decode-side cadence)."""
 
     __slots__ = ("tokens", "ttft_ms", "token_times", "state", "error",
-                 "migrated", "fallback_reason", "handoff_ms")
+                 "migrated", "fallback_reason", "handoff_ms", "trace_id")
 
     def __init__(self, tokens, ttft_ms, token_times, state,
                  error=None, migrated=False, fallback_reason=None,
-                 handoff_ms=None):
+                 handoff_ms=None, trace_id=None):
         self.tokens = tokens
         self.ttft_ms = ttft_ms
         self.token_times = token_times
@@ -176,6 +177,7 @@ class DisaggResult:
         self.migrated = migrated
         self.fallback_reason = fallback_reason
         self.handoff_ms = handoff_ms
+        self.trace_id = trace_id
 
     @property
     def tpot_ms(self) -> Optional[float]:
@@ -216,13 +218,40 @@ class DisaggRouter:
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
                  timeout_s: float = 30.0,
-                 sampling: Optional[SamplingParams] = None
+                 sampling: Optional[SamplingParams] = None,
+                 trace_ctx: Optional[_spans.Context] = None
                  ) -> DisaggResult:
         """Serve one request end to end (blocking — callers thread)."""
         deadline = time.monotonic() + timeout_s
+        # ISSUE 18: one trace per routed request — prefill, KV handoff,
+        # decode, AND the colocated fallback all inherit the context
+        # minted here (a degraded request is the same trace, not a new
+        # one)
+        trace_id = trace_ctx[0] if trace_ctx is not None \
+            else _spans.gen_id()
+        route_span = _spans.gen_id()
+        ctx = (trace_id, route_span)
+        t0 = time.perf_counter_ns()
+        try:
+            res = self._generate(prompt, max_new_tokens, deadline,
+                                 sampling, ctx)
+        finally:
+            attrs = {"router": "disagg"}
+            if trace_ctx is not None:
+                attrs["remote_parent"] = True
+            _spans.record(
+                "serve/route", t0, time.perf_counter_ns() - t0,
+                trace=trace_id, span_id=route_span,
+                parent=trace_ctx[1] if trace_ctx is not None else None,
+                attrs=attrs)
+        res.trace_id = trace_id
+        return res
+
+    def _generate(self, prompt, max_new_tokens, deadline, sampling,
+                  ctx: _spans.Context) -> DisaggResult:
         if not self.prefill_fleet or not self.decode_fleet:
             return self._colocated(prompt, max_new_tokens, deadline,
-                                   sampling, "no_phase_fleet")
+                                   sampling, "no_phase_fleet", ctx)
         # -- phase 1: prefill to the first token -----------------------
         pr = self._pick(self.prefill_fleet)
         blob = (self.prefix_index.fetch(prompt, "prefill")
@@ -231,15 +260,16 @@ class DisaggRouter:
             preq = pr.scheduler.submit(
                 prompt, max_new_tokens=max_new_tokens,
                 timeout_s=max(0.1, deadline - time.monotonic()),
-                sampling=sampling, prefill_only=True, prefix_blob=blob)
+                sampling=sampling, prefill_only=True, prefix_blob=blob,
+                trace_ctx=ctx)
         except Exception:
             return self._colocated(prompt, max_new_tokens, deadline,
-                                   sampling, "prefill_refused")
+                                   sampling, "prefill_refused", ctx)
         pr.wake()
         preq.wait(timeout=max(0.1, deadline - time.monotonic()) + 1.0)
         if preq.state != "done" or preq.handoff is None:
             return self._colocated(prompt, max_new_tokens, deadline,
-                                   sampling, "prefill_failed")
+                                   sampling, "prefill_failed", ctx)
         first = preq.tokens[0]
         if max_new_tokens <= 1:
             self.migrated += 1       # nothing left to decode
@@ -256,12 +286,12 @@ class DisaggRouter:
                 sampling=sampling, prompt=prompt)
         except Exception:
             return self._colocated(prompt, max_new_tokens, deadline,
-                                   sampling, "handoff_refused")
+                                   sampling, "handoff_refused", ctx)
         dr.wake()
         dreq.wait(timeout=max(0.1, deadline - time.monotonic()) + 1.0)
         if dreq.state != "done":
             return self._colocated(prompt, max_new_tokens, deadline,
-                                   sampling, "decode_failed")
+                                   sampling, "decode_failed", ctx)
         handoff_ms = ((dreq.token_times[1] - t_h0) * 1e3
                       if len(dreq.token_times) > 1 else 0.0)
         self.migrated += 1
@@ -270,8 +300,11 @@ class DisaggRouter:
                             migrated=True, handoff_ms=handoff_ms)
 
     def _colocated(self, prompt, max_new_tokens, deadline, sampling,
-                   reason: str) -> DisaggResult:
-        """Degrade, never drop: full re-dispatch on the fallback fleet."""
+                   reason: str,
+                   ctx: Optional[_spans.Context] = None) -> DisaggResult:
+        """Degrade, never drop: full re-dispatch on the fallback fleet.
+        The retry inherits the original request's trace context — it
+        shows up as a child span of the SAME trace (ISSUE 18)."""
         smetrics.m_disagg_fallback.labels(reason).inc()
         self.fallbacks += 1
         fleet = self._fallback_fleet()
@@ -284,7 +317,7 @@ class DisaggRouter:
             req = rep.scheduler.submit(
                 prompt, max_new_tokens=max_new_tokens,
                 timeout_s=max(0.1, deadline - time.monotonic()),
-                sampling=sampling)
+                sampling=sampling, trace_ctx=ctx)
         except Exception as e:
             return DisaggResult([], None, [], "failed",
                                 error=f"{type(e).__name__}: {e}",

@@ -65,6 +65,9 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..observability import fleet as _fleet
+from ..observability import slo as _slo
+from ..observability import spans as _spans
 from ..parallel import health as _health
 from . import metrics as smetrics
 from .replica import HEARTBEAT_NAME, POISONED_EXIT_CODE, READY_NAME
@@ -113,6 +116,11 @@ class GangConfig:
     max_failover_attempts: int = 4
     dedup_capacity: int = 4096
     default_timeout_s: float = 30.0
+    # fleet observability (ISSUE 18): supervisor-side poll cadence for
+    # the FLEET.json / merged-exposition view, and the bound on the
+    # slow-request forensic dir
+    fleet_poll_interval_s: float = 2.0
+    forensic_keep: int = 16
 
 
 class ReplicaHandle:
@@ -272,6 +280,11 @@ class ReplicaGang:
                              str(float(self.cfg.hang_deadline_s)))
         self._env.setdefault(_health.ENV_DIR,
                              os.path.join(self.run_dir, "health"))
+        # ISSUE 18: every process in the gang — supervisor and replicas —
+        # appends its spans to its own JSONL under ONE shared trace dir;
+        # tools/trace_assemble.py stitches them into per-request timelines
+        self.trace_dir = os.path.join(self.run_dir, "trace")
+        _spans.attach_process_sink(self.trace_dir, "gang")
         roles = tuple(self.cfg.roles)
         if roles and len(roles) != self.cfg.n_replicas:
             raise ValueError(
@@ -285,7 +298,8 @@ class ReplicaGang:
             rdir = os.path.join(self.run_dir, f"replica{i}")
             os.makedirs(rdir, exist_ok=True)
             role = roles[i] if roles else "colocated"
-            rc = dict(worker_config, index=i, run_dir=rdir, role=role)
+            rc = dict(worker_config, index=i, run_dir=rdir, role=role,
+                      trace_dir=self.trace_dir)
             if "engine" in rc:
                 rc["engine"] = dict(rc["engine"], role=role)
             if role == "decode" and "stub" not in rc:
@@ -314,6 +328,22 @@ class ReplicaGang:
         self._rr = itertools.count()      # round-robin tiebreak
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # ISSUE 18: live SLO engine (burn-rate alerting + error-budget
+        # ledger surviving warm restarts) fed from dispatch outcomes,
+        # and the fleet poller that folds replica /metrics + heartbeats
+        # into FLEET.json and the merged /fleet exposition
+        self.slo = _slo.SLOEngine(
+            ledger_dir=os.path.join(self.run_dir, "slo_ledger"),
+            forensics=_slo.ForensicDir(
+                os.path.join(self.run_dir, "forensics"),
+                keep=self.cfg.forensic_keep),
+            state_fn=self.health)
+        _slo.set_default_engine(self.slo)
+        self.fleet = _fleet.FleetPoller(
+            self._collect_fleet,
+            out_path=os.path.join(self.run_dir, "FLEET.json"),
+            interval_s=self.cfg.fleet_poll_interval_s,
+            slo=self.slo)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, wait_ready: bool = True) -> "ReplicaGang":
@@ -324,6 +354,7 @@ class ReplicaGang:
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True, name="gang-monitor")
         self._monitor.start()
+        self.fleet.start()
         return self
 
     def wait_ready(self, timeout_s: Optional[float] = None) -> None:
@@ -346,10 +377,15 @@ class ReplicaGang:
 
     def stop(self) -> None:
         self._stop.set()
+        self.fleet.stop()
         if self._monitor is not None:
             self._monitor.join(timeout=5)
         for r in self.replicas:
             r.stop(self.cfg.grace_period_s)
+        try:
+            self.slo.close()
+        except Exception:
+            pass
 
     # -- supervision -------------------------------------------------------
     def _recycle(self, r: ReplicaHandle, cause: str, detail: str) -> None:
@@ -413,6 +449,28 @@ class ReplicaGang:
                 if r.check_ready():
                     self._probe(r)
 
+    # -- fleet view (ISSUE 18) ---------------------------------------------
+    def _collect_fleet(self) -> List["_fleet.ReplicaSample"]:
+        """One fleet-poll sweep: scrape every ready replica's /metrics
+        and heartbeat into :class:`ReplicaSample` rows (the poller turns
+        them into FLEET.json + the merged exposition)."""
+        samples = []
+        for r in self.replicas:
+            alive = r.alive
+            text = None
+            if alive and r.check_ready():
+                try:
+                    text = r.get_text("/metrics",
+                                      timeout_s=self.cfg.probe_timeout_s)
+                except Exception:
+                    _fleet.m_fleet_scrape_errors.inc()
+            samples.append(_fleet.ReplicaSample(
+                index=r.index, role=r.role, alive=alive,
+                heartbeat_age_s=r.heartbeat_age_s(),
+                metrics_text=text, incarnation=r.incarnation,
+                inflight=r.inflight))
+        return samples
+
     # -- routing -----------------------------------------------------------
     def ready_replicas(self,
                        role: Optional[str] = None) -> List[ReplicaHandle]:
@@ -448,6 +506,51 @@ class ReplicaGang:
                    else float(timeout_s))
         rid = str(body.get("request_id") or
                   f"gang-{os.getpid()}-{next(self._rid)}")
+        # ISSUE 18: ONE trace per request, minted here (or adopted from
+        # the client's wire context) and injected into the body BEFORE
+        # the failover/disagg machinery — every retry attempt, phase
+        # hop, and colocated fallback sends the same context, so a
+        # replica scheduler adopts the trace instead of minting a fresh
+        # one.  A retry is a child span of the SAME trace, never a new
+        # trace (the PR-15 failover test asserts this).
+        ctx_in = _spans.extract(body)
+        trace_id = ctx_in[0] if ctx_in is not None else _spans.gen_id()
+        route_span = _spans.gen_id()
+        body = dict(body)
+        body[_spans.WIRE_KEY] = _spans.inject((trace_id, route_span))
+        t0 = time.perf_counter_ns()
+        code, payload = self._dispatch_dedup(body, timeout, rid)
+        if isinstance(payload, dict):
+            # expose the trace id to the client (and to tests); a dedup
+            # hit keeps the ORIGINAL attempt's id — the client retry is
+            # part of that trace, not a new one
+            payload.setdefault("trace_id", trace_id)
+            if not payload.get("deduplicated"):
+                try:
+                    self.slo.note_request(
+                        ttft_ms=payload.get("ttft_ms"),
+                        tpot_ms=payload.get("tpot_ms"),
+                        code=code, shed=code in (429, 503),
+                        trace_id=payload.get("trace_id"),
+                        request_id=rid)
+                except Exception:
+                    pass
+        span_trace = (payload.get("trace_id", trace_id)
+                      if isinstance(payload, dict) else trace_id)
+        attrs = {"request_id": rid, "code": code}
+        if ctx_in is not None:
+            # the parent span lives in the CLIENT's process, outside
+            # this gang's trace dir — trace_assemble treats a stamped
+            # remote parent as a legitimate root, not a broken edge
+            attrs["remote_parent"] = True
+        _spans.record("serve/route", t0, time.perf_counter_ns() - t0,
+                      trace=span_trace, span_id=route_span,
+                      parent=ctx_in[1] if ctx_in is not None else None,
+                      attrs=attrs)
+        return code, payload
+
+    def _dispatch_dedup(self, body: Dict[str, Any], timeout: float,
+                        rid: str) -> Tuple[int, Dict[str, Any]]:
         with self._dedup_lock:
             hit = self._completed.get(rid)
             if hit is not None:
@@ -544,6 +647,11 @@ class ReplicaGang:
                  "max_new_tokens": body.get("max_new_tokens", 16),
                  "prompt": body.get("prompt") or body.get("tokens"),
                  "timeout_s": max(0.5, deadline - time.monotonic())}
+        if _spans.WIRE_KEY in body:
+            # decode joins the SAME trace the router minted (the staged
+            # handoff also carries the prefill replica's context — both
+            # share one trace id)
+            rbody[_spans.WIRE_KEY] = body[_spans.WIRE_KEY]
         for k in ("temperature", "top_k", "top_p", "seed"):
             if k in body:
                 rbody[k] = body[k]
@@ -656,6 +764,7 @@ class ReplicaGang:
             "disagg_fallbacks": self.disagg_fallbacks,
             "restarts": dict(self.restart_causes),
             "failovers": self.failovers,
+            "trace_dir": self.trace_dir,
         }
 
 
@@ -687,6 +796,23 @@ class _GangHandler(BaseHTTPRequestHandler):
             from ..observability import prom
 
             text = prom.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            self.wfile.write(text)
+            return
+        if self.path in ("/fleet", "/fleet/metrics"):
+            # ISSUE 18: the live fleet view — FLEET.json document (with
+            # per-role rollups + SLO status) or the merged per-replica
+            # exposition (replica/role labels preserved)
+            fp = front.gang.fleet
+            doc = fp.fleet_doc()
+            if not doc:
+                doc = fp.tick()
+            if self.path == "/fleet":
+                return self._json(200, doc)
+            text = fp.exposition().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(text)))

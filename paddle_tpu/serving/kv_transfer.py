@@ -41,6 +41,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import spans as _spans
 from . import metrics as smetrics
 
 __all__ = [
@@ -344,6 +345,12 @@ def adopt_into_engine(engine, handoff: Dict[str, Any]) -> int:
     _note_stats("adopt", stats)
     smetrics.m_kv_transfer_bytes.labels("in").inc(stats.total_bytes)
     smetrics.m_kv_transfer_ms.observe(stats.elapsed_ms)
+    # adoption runs under the request's span context (the scheduler's
+    # handoff-ingest wrapper), so this lands inside the shared trace
+    _spans.record("serve/kv_adopt", t0,
+                  time.perf_counter_ns() - t0,
+                  attrs={"transfer_id": handoff.get("transfer_id"),
+                         "bytes": stats.total_bytes})
     return slot
 
 
@@ -528,14 +535,20 @@ def send_handoff(host: str, port: int, handoff: Dict[str, Any],
     """Stream a handoff to a :class:`KVTransferServer` and wait for its
     post-commit ACK. Raises on any transport fault — the caller's cue
     to fall back to colocated dispatch (degrade, never drop)."""
-    with socket.create_connection((host, int(port)),
-                                  timeout=timeout_s) as sock:
-        for header, payload in iter_frames(handoff):
-            _send_frame(sock, header, payload)
-        ack = _recv_exact(sock, 2)
-        if ack != b"OK":
-            raise ConnectionError(
-                f"KV transfer not acknowledged (got {ack!r})")
+    # the handoff's own trace context (stamped at export) parents the
+    # send span — the wire hop shows up inside the request's timeline
+    with _spans.default_tracer().context(_spans.extract(handoff)):
+        with _spans.span("serve/kv_send",
+                         attrs={"transfer_id": handoff["transfer_id"],
+                                "length": int(handoff["length"])}):
+            with socket.create_connection((host, int(port)),
+                                          timeout=timeout_s) as sock:
+                for header, payload in iter_frames(handoff):
+                    _send_frame(sock, header, payload)
+                ack = _recv_exact(sock, 2)
+                if ack != b"OK":
+                    raise ConnectionError(
+                        f"KV transfer not acknowledged (got {ack!r})")
 
 
 class KVTransferServer:

@@ -99,6 +99,34 @@ def _stub_tokens(prompt, n):
     return [(sum(prompt) * 31 + i * 7) % 97 for i in range(n)]
 
 
+_stub_span_lock = threading.Lock()
+_stub_span_n = [0]
+
+
+def _stub_span_append(path: str, name: str, start_ns: int, dur_ns: int,
+                      trace: int, parent, attrs: dict) -> None:
+    """Append ONE span record (same JSONL shape observability/spans.py
+    writes — tools/trace_assemble.py stitches both) with write+flush per
+    record, so a SIGKILLed stub's completed spans survive. Stdlib-only
+    on purpose: the stub path must not import the observability
+    package."""
+    with _stub_span_lock:
+        _stub_span_n[0] += 1
+        span_id = ((os.getpid() & 0xFFFF) << 40) | _stub_span_n[0]
+        rec = {"name": name, "trace": int(trace), "span": span_id,
+               "parent": None if parent is None else int(parent),
+               "start_ns": int(start_ns), "dur_ns": int(dur_ns),
+               "tid": threading.get_ident(),
+               "thread": threading.current_thread().name,
+               "attrs": attrs}
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+        except OSError:
+            pass
+
+
 def run_stub(cfg: dict) -> int:
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -108,6 +136,13 @@ def run_stub(cfg: dict) -> int:
     role = cfg.get("role", ReplicaRole.COLOCATED)
     state = {"served": 0, "hung": False}
     hb_frozen = threading.Event()
+    span_path = None
+    if cfg.get("trace_dir"):
+        # same per-process sink naming as spans.process_sink_path —
+        # assembled together with the supervisor's and siblings' files
+        os.makedirs(cfg["trace_dir"], exist_ok=True)
+        span_path = os.path.join(
+            cfg["trace_dir"], f"spans-{role}-{os.getpid()}.jsonl")
 
     def status():
         if stub.get("poison_after") and \
@@ -155,6 +190,24 @@ def run_stub(cfg: dict) -> int:
                 return self._json(404, {"error": "unknown path"})
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n).decode() or "{}")
+            # trace participation (ISSUE 18): adopt the router's wire
+            # context and append one span per handled request — flushed
+            # at record, so a killed stub's completed spans survive
+            wire = body.get("trace")
+            t0 = time.perf_counter_ns()
+            try:
+                self._post_inner(body)
+            finally:
+                if span_path and isinstance(wire, dict) \
+                        and "trace_id" in wire:
+                    _stub_span_append(
+                        span_path, "stub" + self.path, t0,
+                        time.perf_counter_ns() - t0,
+                        trace=wire["trace_id"],
+                        parent=wire.get("parent_span"),
+                        attrs={"pid": os.getpid(), "role": role})
+
+        def _post_inner(self, body):
             if self.path == "/resume" and stub.get("die_on_resume"):
                 # mid-transfer kill: the decode replica dies while the
                 # migrated request is in its hands (gang failover test)
@@ -252,6 +305,16 @@ def run_engine(cfg: dict) -> int:
 
     run_dir = cfg["run_dir"]
     os.makedirs(run_dir, exist_ok=True)
+    if cfg.get("trace_dir"):
+        # per-process span sink under the gang's shared trace dir: every
+        # span this replica records (serve/request, serve/prefill,
+        # serve/kv_send, ...) appends to spans-<role>-<pid>.jsonl,
+        # flushed per record so a SIGKILL loses at most the in-flight
+        # span; tools/trace_assemble.py stitches the fleet's files
+        from paddle_tpu.observability import spans as ospans
+
+        ospans.attach_process_sink(cfg["trace_dir"],
+                                   cfg.get("role", "engine"))
     m = cfg["model"]
     mcfg = gpt.GPTConfig(
         vocab_size=int(m["vocab_size"]),

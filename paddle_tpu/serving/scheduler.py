@@ -108,6 +108,12 @@ class Request:
     # walks straight back to the tick that caused it
     trace_id: int = dataclasses.field(default_factory=_spans.gen_id)
     root_span: int = dataclasses.field(default_factory=_spans.gen_id)
+    # cross-process propagation (ISSUE 18): a request arriving with wire
+    # trace context keeps the originating trace_id and parents its local
+    # "serve/request" span under the sender's span instead of rooting a
+    # fresh trace — one request stays ONE trace across router, prefill
+    # replica, KV transfer, decode replica, and every failover retry
+    parent_span: Optional[int] = None
     submit_ns: int = dataclasses.field(
         default_factory=time.perf_counter_ns)
 
@@ -179,7 +185,8 @@ class Scheduler:
                timeout_s: Optional[float] = None,
                sampling: Optional[SamplingParams] = None,
                prefill_only: bool = False,
-               prefix_blob: Optional[dict] = None) -> Request:
+               prefix_blob: Optional[dict] = None,
+               trace_ctx: Optional[_spans.Context] = None) -> Request:
         """Enqueue a request; raises QueueFullError on backpressure,
         PromptTooLongError for prompts above the bucket ladder, and
         RuntimeError once draining.
@@ -189,7 +196,11 @@ class Scheduler:
         ``req.handoff`` and the slot is released — the caller migrates
         the payload to a decode replica via :meth:`submit_handoff`.
         ``prefix_blob`` is a gang-shared prefix record adopted into the
-        local pool right before prefill (best-effort)."""
+        local pool right before prefill (best-effort).
+
+        ``trace_ctx`` (ISSUE 18) joins this request to an existing trace
+        — (trace_id, parent_span) extracted from the wire — instead of
+        rooting a fresh one."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -200,11 +211,15 @@ class Scheduler:
                              self.cfg.max_new_tokens_cap))
         timeout = (self.cfg.default_timeout_s if timeout_s is None
                    else float(timeout_s))
+        kw = {}
+        if trace_ctx is not None:
+            kw = {"trace_id": int(trace_ctx[0]),
+                  "parent_span": int(trace_ctx[1])}
         req = Request(prompt=prompt, max_new_tokens=max_new,
                       deadline=time.monotonic() + timeout,
                       sampling=sampling or GREEDY,
                       prefill_only=bool(prefill_only),
-                      prefix_blob=prefix_blob)
+                      prefix_blob=prefix_blob, **kw)
         with self._lock:
             if self._refusing is not None:
                 raise RuntimeError(self._refusing)
@@ -215,13 +230,22 @@ class Scheduler:
                     f"admission queue at capacity ({self.cfg.max_queue})")
             self._queue.append(req)
             smetrics.m_queue_depth.set(len(self._queue))
+        # open-sentinel root span (dur 0, attrs.open; superseded by the
+        # full "serve/request" record in _finish): a process SIGKILLed
+        # mid-request has already flushed its children's parent to disk,
+        # so the partial trace still stitches orphan-free
+        _spans.record("serve/request", req.submit_ns, 0,
+                      trace=req.trace_id, parent=req.parent_span,
+                      span_id=req.root_span, attrs={"open": True})
         return req
 
     def submit_handoff(self, handoff: dict, first_token: int,
                        max_new_tokens: int = 16,
                        timeout_s: Optional[float] = None,
                        sampling: Optional[SamplingParams] = None,
-                       prompt: Optional[Sequence[int]] = None) -> Request:
+                       prompt: Optional[Sequence[int]] = None,
+                       trace_ctx: Optional[_spans.Context] = None
+                       ) -> Request:
         """Enqueue a MIGRATED request (disaggregated serving): the
         prefill replica already produced ``first_token`` and serialized
         its KV into ``handoff``; this scheduler adopts the payload at
@@ -238,9 +262,19 @@ class Scheduler:
                              self.cfg.max_new_tokens_cap))
         timeout = (self.cfg.default_timeout_s if timeout_s is None
                    else float(timeout_s))
+        if trace_ctx is None:
+            # the handoff frame itself carries the originating trace
+            # (kv_transfer stamps it at export) — adopt it so the decode
+            # half of a migrated request lands in the SAME trace
+            trace_ctx = _spans.extract(handoff)
+        kw = {}
+        if trace_ctx is not None:
+            kw = {"trace_id": int(trace_ctx[0]),
+                  "parent_span": int(trace_ctx[1])}
         req = Request(prompt=prompt, max_new_tokens=max_new,
                       deadline=time.monotonic() + timeout,
-                      sampling=sampling or GREEDY, handoff=handoff)
+                      sampling=sampling or GREEDY, handoff=handoff,
+                      **kw)
         req.tokens.append(int(first_token))
         req.token_times.append(time.monotonic())
         with self._lock:
@@ -252,6 +286,11 @@ class Scheduler:
                 raise QueueFullError(
                     f"handoff queue at capacity ({self.cfg.max_queue})")
             self._pending_handoffs.append(req)
+        # same open-sentinel contract as submit(): the decode half of a
+        # migrated request leaves its root on disk at admission
+        _spans.record("serve/request", req.submit_ns, 0,
+                      trace=req.trace_id, parent=req.parent_span,
+                      span_id=req.root_span, attrs={"open": True})
         return req
 
     def cancel(self, req: Request) -> bool:
@@ -541,6 +580,11 @@ class Scheduler:
                 try:
                     req.handoff = self.engine.export_request_kv(
                         slot, tokens=req.prompt)
+                    # the handoff frame carries the trace so the decode
+                    # replica's subtree lands in the SAME trace whether
+                    # it arrives over the socket channel or inline
+                    req.handoff[_spans.WIRE_KEY] = _spans.inject(
+                        (req.trace_id, req.root_span))
                 except Exception as e:
                     self._evict(slot, FAILED,
                                 f"{type(e).__name__}: {e}")
@@ -686,11 +730,13 @@ class Scheduler:
         with self._rate_lock:
             self._done_times.append(time.monotonic())
         # close the request's root span: submit -> terminal state.  The
-        # explicit span_id is what the lifecycle children parented to.
+        # explicit span_id is what the lifecycle children parented to;
+        # parent_span (when the request arrived with wire trace context)
+        # links this process's subtree under the sender's span.
         end = time.perf_counter_ns()
         _spans.record("serve/request", req.submit_ns,
                       end - req.submit_ns, trace=req.trace_id,
-                      parent=None, span_id=req.root_span,
+                      parent=req.parent_span, span_id=req.root_span,
                       attrs={"state": state, "tokens": len(req.tokens),
                              "request_id": req.id})
         req.finished.set()

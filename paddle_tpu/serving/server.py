@@ -38,6 +38,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..observability import spans as _ospans
 from . import metrics as smetrics
 from .engine import PromptTooLongError
 from .scheduler import QueueFullError, Scheduler
@@ -278,7 +279,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "max_new_tokens", 16)),
                 timeout_s=timeout_s,
                 sampling=self._parse_sampling(req_obj),
-                prefill_only=True)
+                prefill_only=True,
+                trace_ctx=_ospans.extract(req_obj))
         except QueueFullError as e:
             smetrics.m_shed.labels("queue_full").inc()
             return self._json(429, {"error": str(e)},
@@ -369,7 +371,8 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=int(req_obj.get("max_new_tokens", 16)),
                 timeout_s=timeout_s,
                 sampling=self._parse_sampling(req_obj),
-                prompt=prompt or None)
+                prompt=prompt or None,
+                trace_ctx=_ospans.extract(req_obj))
         except QueueFullError as e:
             smetrics.m_shed.labels("queue_full").inc()
             return self._json(429, {"error": str(e)},
@@ -427,7 +430,8 @@ class _Handler(BaseHTTPRequestHandler):
             request = front.scheduler.submit(
                 prompt, max_new_tokens=int(req_obj.get(
                     "max_new_tokens", 16)),
-                timeout_s=timeout_s, sampling=sampling)
+                timeout_s=timeout_s, sampling=sampling,
+                trace_ctx=_ospans.extract(req_obj))
         except QueueFullError as e:
             smetrics.m_shed.labels("queue_full").inc()
             return self._json(429, {"error": str(e)},

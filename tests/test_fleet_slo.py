@@ -1,0 +1,363 @@
+"""Fleet-wide tracing + live SLO engine (ISSUE 18,
+docs/observability.md "Fleet & SLO"): wire trace-context propagation,
+per-family gauge merge with replica labels preserved, per-role rollups,
+the FleetPoller tick contract, burn-rate alert latch + bounded
+forensics, the warm-restart error-budget ledger, and
+tools/trace_assemble.py stitch checking.
+
+The cross-process half (real gang, real SIGKILL) lives in
+tests/test_serving_resilience.py and tools/serve_fault_bench.py; these
+are the fast in-process contracts those harnesses build on.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.observability import prom, spans
+from paddle_tpu.observability.fleet import (FleetPoller, ReplicaSample,
+                                            role_rollups)
+from paddle_tpu.observability.slo import (DEFAULT_OBJECTIVES, ForensicDir,
+                                          SLOEngine)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import trace_assemble  # noqa: E402
+from metrics_check import validate_prom_text  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# wire trace context
+# ---------------------------------------------------------------------------
+
+def test_wire_context_roundtrip_and_malformed():
+    wire = spans.inject((5, 7))
+    assert wire == {"trace_id": 5, "parent_span": 7}
+    # the wire dict itself, and a body carrying it under WIRE_KEY
+    assert spans.extract(wire) == (5, 7)
+    assert spans.extract({spans.WIRE_KEY: wire, "prompt": [1]}) == (5, 7)
+    assert spans.inject(None) is None
+    # anything garbled degrades to "fresh trace", never a raise
+    for bad in (None, "x", 3, {}, {"trace": "nope"},
+                {"trace": {"trace_id": "abc", "parent_span": 1}},
+                {"trace_id": 1}, {"parent_span": 2}):
+        assert spans.extract(bad) is None
+
+
+def test_process_sink_path_shape(tmp_path):
+    p = spans.process_sink_path(str(tmp_path), "decode")
+    base = os.path.basename(p)
+    assert base == f"spans-decode-{os.getpid()}.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# exposition merge: per-family gauge policy + replica labels
+# ---------------------------------------------------------------------------
+
+def _expo(queue, occupancy):
+    return (
+        "# HELP paddle_serve_queue_depth d\n"
+        "# TYPE paddle_serve_queue_depth gauge\n"
+        f"paddle_serve_queue_depth {queue}\n"
+        "# HELP paddle_serve_slot_occupancy d\n"
+        "# TYPE paddle_serve_slot_occupancy gauge\n"
+        f"paddle_serve_slot_occupancy {occupancy}\n"
+    )
+
+
+def test_merge_gauge_policy_sum_vs_max():
+    merged = prom.merge_expositions([_expo(3, 0.5), _expo(4, 0.75)])
+    validate_prom_text(merged)
+    # additive gauge sums across replicas; level gauge takes the worst
+    assert "paddle_serve_queue_depth 7" in merged
+    assert "paddle_serve_slot_occupancy 0.75" in merged
+
+
+def test_merge_keeps_replica_label_series():
+    merged = prom.merge_expositions(
+        [_expo(3, 0.5), _expo(4, 0.75)],
+        extra_labels=[[("replica", "0"), ("role", "prefill")],
+                      [("replica", "1"), ("role", "decode")]])
+    validate_prom_text(merged)
+    # distinct labels -> per-replica series survive the merge un-summed
+    assert 'paddle_serve_queue_depth{replica="0",role="prefill"} 3' \
+        in merged
+    assert 'paddle_serve_queue_depth{replica="1",role="decode"} 4' \
+        in merged
+
+
+# ---------------------------------------------------------------------------
+# per-role rollups + poller tick
+# ---------------------------------------------------------------------------
+
+def _sample(i, role, queue, occ, ttft_sum, ttft_count, alive=True,
+            hb=0.1, inflight=1):
+    text = _expo(queue, occ) + (
+        "# HELP paddle_serve_ttft_ms d\n"
+        "# TYPE paddle_serve_ttft_ms histogram\n"
+        f"paddle_serve_ttft_ms_sum {ttft_sum}\n"
+        f"paddle_serve_ttft_ms_count {ttft_count}\n"
+    )
+    return ReplicaSample(index=i, role=role, alive=alive,
+                         heartbeat_age_s=hb, metrics_text=text,
+                         inflight=inflight)
+
+
+def test_role_rollups_sum_max_and_latency_mean():
+    roles = role_rollups([
+        _sample(0, "prefill", queue=2, occ=0.5, ttft_sum=30.0,
+                ttft_count=3, hb=0.1),
+        _sample(1, "prefill", queue=3, occ=0.9, ttft_sum=20.0,
+                ttft_count=2, hb=0.4),
+        _sample(2, "decode", queue=1, occ=0.2, ttft_sum=0.0,
+                ttft_count=0, alive=False, hb=9.0, inflight=0),
+    ])
+    pre = roles["prefill"]
+    assert pre["replicas"] == 2 and pre["alive"] == 2
+    assert pre["inflight"] == 2
+    assert pre["max_heartbeat_age_s"] == 0.4
+    assert pre["sums"]["paddle_serve_queue_depth"] == 5.0
+    assert pre["maxes"]["paddle_serve_slot_occupancy"] == 0.9
+    # (30 + 20) / (3 + 2)
+    assert pre["latency_mean_ms"]["paddle_serve_ttft_ms"] == 10.0
+    dec = roles["decode"]
+    assert dec["alive"] == 0 and dec["replicas"] == 1
+    assert dec["latency_mean_ms"]["paddle_serve_ttft_ms"] is None
+
+
+def test_fleet_poller_tick_writes_doc_and_exposition(tmp_path):
+    out = str(tmp_path / "FLEET.json")
+    slo = SLOEngine(min_events=1)
+    slo.note_request(ttft_ms=5.0, tpot_ms=1.0, code=200)
+
+    def collect():
+        return [_sample(0, "prefill", 2, 0.5, 10.0, 1),
+                _sample(1, "decode", 1, 0.3, 4.0, 1)]
+
+    fp = FleetPoller(collect, out_path=out, interval_s=60.0, slo=slo)
+    doc = fp.tick()
+    assert doc["n_replicas"] == 2 and doc["n_alive"] == 2
+    assert set(doc["roles"]) == {"prefill", "decode"}
+    assert doc["slo"]["objectives"]["ttft_p99"]["meets_target"] is True
+    # atomic FLEET.json matches the returned doc
+    with open(out) as f:
+        on_disk = json.load(f)
+    assert on_disk["n_replicas"] == 2
+    assert fp.fleet_doc()["n_alive"] == 2
+    merged = fp.exposition()
+    validate_prom_text(merged)
+    assert 'replica="0"' in merged and 'role="decode"' in merged
+
+
+def test_fleet_poller_collect_failure_counts_scrape_error():
+    def boom():
+        raise RuntimeError("scrape down")
+
+    fp = FleetPoller(boom)
+    doc = fp.tick()                      # must not raise
+    assert doc["n_replicas"] == 0 and doc["replicas"] == []
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: breach exactness, latch, ledger persistence, forensics
+# ---------------------------------------------------------------------------
+
+def test_slo_breach_alert_latch_and_single_forensic(tmp_path):
+    fdir = ForensicDir(str(tmp_path / "forensics"), keep=8)
+    eng = SLOEngine(forensics=fdir, min_events=8,
+                    state_fn=lambda: {"who": "test"})
+    t0 = 1000.0
+    target = next(o for o in DEFAULT_OBJECTIVES
+                  if o.name == "ttft_p99").target
+    for i in range(20):
+        eng.note_request(ttft_ms=target * 10, tpot_ms=1.0, code=200,
+                         trace_id=77, request_id=f"r{i}", t=t0 + i * 0.1)
+    st = eng.evaluate(t0 + 20)
+    assert st["ok"] is False
+    assert st["objectives"]["ttft_p99"]["alert_fired"] is True
+    assert st["alerts_total"]["ttft_p99"] == 1
+    # latched: a second evaluation of the same excursion does NOT re-fire
+    st2 = eng.evaluate(t0 + 21)
+    assert st2["objectives"]["ttft_p99"]["alert_fired"] is False
+    assert st2["alerts_total"]["ttft_p99"] == 1
+    files = fdir.files()
+    assert len(files) == 1
+    with open(os.path.join(fdir.dirname, files[0])) as f:
+        dump = json.load(f)
+    assert dump["kind"] == "slo_breach"
+    assert dump["objective"] == "ttft_p99"
+    assert dump["worst_request"]["trace_id"] == 77
+    assert dump["state"] == {"who": "test"}
+    # recovery re-arms the latch: a later excursion fires a NEW alert
+    for i in range(20):
+        eng.note_request(ttft_ms=1.0, tpot_ms=1.0, code=200,
+                         t=t0 + 700 + i * 0.1)
+    st3 = eng.evaluate(t0 + 740)
+    assert st3["objectives"]["ttft_p99"]["alerting"] is False
+    for i in range(20):
+        eng.note_request(ttft_ms=target * 10, tpot_ms=1.0, code=200,
+                         t=t0 + 2000 + i * 0.1)
+    st4 = eng.evaluate(t0 + 2020)
+    assert st4["alerts_total"]["ttft_p99"] == 2
+    assert len(fdir.files()) == 2
+
+
+def test_slo_shed_spends_shed_budget_not_error_budget():
+    eng = SLOEngine(min_events=1)
+    t0 = 500.0
+    for i in range(10):
+        eng.note_request(code=429, shed=True, t=t0 + i * 0.01)
+    st = eng.evaluate(t0 + 1)
+    assert st["objectives"]["error_rate"]["measured"] == 0.0
+    assert st["objectives"]["shed_rate"]["measured"] == 1.0
+
+
+def test_slo_ledger_survives_warm_restart(tmp_path):
+    ldir = str(tmp_path / "ledger")
+    eng = SLOEngine(ledger_dir=ldir, min_events=1)
+    t0 = 100.0
+    for i in range(8):
+        eng.note_request(ttft_ms=1e4, tpot_ms=1.0, code=500,
+                         t=t0 + i * 0.1)
+    eng.evaluate(t0 + 1)
+    before = eng.slo_status(t0 + 1)["objectives"]["error_rate"]["ledger"]
+    assert before == {"bad": 8, "total": 8}
+    alerts_before = dict(eng.alerts_total)
+    eng.close()
+    # warm restart: a NEW engine over the same ledger dir restores the
+    # cumulative budget spend and the alert totals
+    eng2 = SLOEngine(ledger_dir=ldir, min_events=1)
+    st = eng2.evaluate(t0 + 2)          # empty windows, restored ledger
+    led = st["objectives"]["error_rate"]["ledger"]
+    assert led == {"bad": 8, "total": 8}
+    assert st["objectives"]["error_rate"]["budget_remaining"] < 1.0
+    assert eng2.alerts_total == alerts_before
+    eng2.close()
+
+
+def test_forensic_dir_is_bounded(tmp_path):
+    fdir = ForensicDir(str(tmp_path), keep=3)
+    for i in range(7):
+        fdir.dump("tag", {"i": i})
+    files = fdir.files()
+    assert len(files) == 3
+    # newest survive the GC
+    with open(os.path.join(fdir.dirname, files[-1])) as f:
+        assert json.load(f)["i"] == 6
+
+
+def test_module_level_slo_status_uses_default_engine():
+    from paddle_tpu.observability import slo as slo_mod
+
+    prev = slo_mod._default_engine
+    try:
+        eng = SLOEngine(min_events=1)
+        slo_mod.set_default_engine(eng)
+        eng.note_request(ttft_ms=1.0, tpot_ms=1.0, code=200, t=1.0)
+        st = slo_mod.slo_status()
+        assert "objectives" in st and "ok" in st
+    finally:
+        slo_mod.set_default_engine(prev)
+
+
+# ---------------------------------------------------------------------------
+# trace assembly stitch checks
+# ---------------------------------------------------------------------------
+
+def _rec(trace, span, parent, name="s", start=0, dur=10):
+    return {"name": name, "trace": trace, "span": span, "parent": parent,
+            "start_ns": start, "dur_ns": dur, "tid": 0, "thread": "t"}
+
+
+def _write_jsonl(path, recs, torn_tail=False):
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        if torn_tail:
+            f.write('{"name": "killed-mid-wri')   # no newline, no close
+
+
+def test_trace_assemble_stitches_across_files(tmp_path):
+    d = str(tmp_path)
+    _write_jsonl(os.path.join(d, "spans-gang-1.jsonl"),
+                 [_rec(10, 1, None, "serve/route", start=0, dur=100)])
+    _write_jsonl(os.path.join(d, "spans-decode-2.jsonl"),
+                 [_rec(10, 2, 1, "serve/request", start=10, dur=50)],
+                 torn_tail=True)
+    report = trace_assemble.assemble_dir(d)
+    assert report["n_traces"] == 1
+    assert report["n_spans"] == 2        # the torn tail is skipped
+    assert report["n_orphans"] == 0 and report["n_duplicates"] == 0
+    t = report["traces"][0]
+    assert t["trace"] == "a"
+    assert t["roles"] == ["decode", "gang"]
+    assert t["roots"] == ["serve/route"]
+    assert len(t["files"]) == 2
+
+
+def test_trace_assemble_flags_orphans_and_duplicates(tmp_path):
+    d = str(tmp_path)
+    _write_jsonl(os.path.join(d, "spans-gang-1.jsonl"), [
+        _rec(10, 1, None),
+        _rec(10, 3, 99),                 # parent 99 exists nowhere
+        _rec(11, 5, None),
+        _rec(11, 5, None),               # duplicate span id in trace 11
+    ])
+    report = trace_assemble.assemble_dir(d)
+    assert report["n_orphans"] == 1
+    assert report["orphans"][0]["span"] == 3
+    assert report["orphans"][0]["parent"] == 99
+    assert report["n_duplicates"] == 1
+    assert report["duplicates"][0]["trace"] == 11
+
+
+def test_trace_assemble_remote_parent_is_not_an_orphan(tmp_path):
+    # a client that carried its own wire context holds the route span's
+    # parent in ITS process — stamped remote_parent, legitimate root
+    d = str(tmp_path)
+    rec = _rec(10, 1, 7, "serve/route")
+    rec["attrs"] = {"remote_parent": True}
+    _write_jsonl(os.path.join(d, "spans-gang-1.jsonl"),
+                 [rec, _rec(10, 2, 1, "serve/request")])
+    report = trace_assemble.assemble_dir(d)
+    assert report["n_orphans"] == 0, report["orphans"]
+
+
+def test_trace_assemble_open_sentinel_collapse(tmp_path):
+    # admission flushes a dur-0 attrs.open root; _finish supersedes it.
+    # A crash leaves only the sentinel — children still stitch.
+    d = str(tmp_path)
+    open_rec = _rec(10, 1, None, "serve/request", start=0, dur=0)
+    open_rec["attrs"] = {"open": True}
+    final = _rec(10, 1, None, "serve/request", start=0, dur=90)
+    child = _rec(10, 2, 1, "serve/prefill", start=5, dur=20)
+    _write_jsonl(os.path.join(d, "spans-colocated-9.jsonl"),
+                 [open_rec, final, child])
+    report = trace_assemble.assemble_dir(d)
+    t = report["traces"][0]
+    assert report["n_duplicates"] == 0 and report["n_orphans"] == 0
+    assert t["n_spans"] == 2 and t["n_open"] == 0   # final won
+    # killed-mid-request shape: sentinel only, no final
+    d2 = str(tmp_path / "killed")
+    os.makedirs(d2)
+    _write_jsonl(os.path.join(d2, "spans-colocated-9.jsonl"),
+                 [open_rec, child])
+    r2 = trace_assemble.assemble_dir(d2)
+    assert r2["n_orphans"] == 0 and r2["n_duplicates"] == 0
+    assert r2["traces"][0]["n_open"] == 1
+
+
+def test_trace_assemble_cli_require_complete(tmp_path):
+    d = str(tmp_path)
+    _write_jsonl(os.path.join(d, "spans-gang-1.jsonl"),
+                 [_rec(1, 1, None), _rec(1, 2, 42)])
+    out = str(tmp_path / "report.json")
+    rc = trace_assemble.main([d, "--out", out, "--require-complete"])
+    assert rc == 1
+    with open(out) as f:
+        assert json.load(f)["n_orphans"] == 1
+    # empty dir is its own failure mode
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert trace_assemble.main([empty]) == 2

@@ -469,11 +469,28 @@ def test_gang_failover_dedup_and_crash_recycle(tmp_path):
         assert p["tokens"] == [(sum([9, 9]) * 31 + i * 7) % 97
                                for i in range(3)]
         assert gang.failovers >= 1
+        # ISSUE 18: the failover re-dispatch carries the ORIGINATING
+        # trace context — the sibling's spans land in the SAME trace
+        assert p.get("trace_id") is not None
         # idempotent retry returns the RECORDED response
         code, p2, _h = _post(front.port, {
             "prompt": [9, 9], "max_new_tokens": 3, "request_id": "slow"})
         assert code == 200 and p2.get("deduplicated") is True
         assert p2["tokens"] == p["tokens"]
+        # ... and comes back under the original trace id, not a new one
+        assert p2.get("trace_id") == p["trace_id"]
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import trace_assemble
+        report = trace_assemble.assemble_dir(gang.trace_dir)
+        assert report["n_orphans"] == 0, report["orphans"]
+        assert report["n_duplicates"] == 0, report["duplicates"]
+        slow = [t for t in report["traces"]
+                if t["trace"] == f"{p['trace_id']:x}"]
+        assert slow, (p["trace_id"], report["traces"])
+        # gang route span + the surviving sibling's stub span: the one
+        # trace spans at least two processes' files
+        assert len(slow[0]["files"]) >= 2, slow[0]
+        assert "gang" in slow[0]["roles"], slow[0]
         deadline = time.time() + 15
         while time.time() < deadline:
             h = gang.health()

@@ -24,6 +24,12 @@ then asserts:
   * the roofline attribution (ISSUE 14) of a profiled tiny-GPT step
     passes its schema gate: version stamp, finite values, fractions in
     [0,1], non-empty residue naming the layernorm/add/optimizer tail;
+  * the fleet tracing + live SLO layer (ISSUE 18): a stub disagg gang
+    leaves ONE stitched trace per request across router/prefill/decode
+    processes with zero orphan spans, ``GET /fleet`` serves per-role
+    rollups plus a valid replica-labeled merged exposition, and a
+    seeded SLO breach fires exactly one burn-rate alert with exactly
+    one forensic dump (latched until recovery);
   * the Pallas megakernel paths (docs/kernels.md): a fused-opt smoke
     train moves ``paddle_megakernel_launches_total{kernel="opt_sgd"}``
     by exactly one (trace-time, one launch per param group per
@@ -928,6 +934,105 @@ def _run_check_inner(out_dir: str) -> dict:
     assert kv_moved["count"] - kv_before["count"] == 2, \
         (kv_before["count"], kv_moved["count"])
 
+    # --- fleet tracing + live SLO gate (ISSUE 18, docs/observability.md
+    # "Fleet & SLO"): a stub disagg gang must leave ONE trace per request
+    # spanning router + prefill + decode processes with zero orphan spans
+    # across the stitched per-process files; GET /fleet must serve live
+    # per-role rollups and a VALID merged exposition that keeps the
+    # replica label; a seeded SLO breach must fire EXACTLY one burn-rate
+    # alert and write EXACTLY one forensic dump, and recovery must re-arm
+    # the latch without a second dump
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_assemble as TA
+
+    from paddle_tpu.observability import slo as slo_mod
+    from paddle_tpu.serving.gang import (GangConfig, GangFrontDoor,
+                                         ReplicaGang)
+
+    gang_dir = os.path.join(out_dir, "stub_gang")
+    tgang = ReplicaGang({"stub": {}}, gang_dir,
+                        GangConfig(n_replicas=2,
+                                   roles=("prefill", "decode"),
+                                   fleet_poll_interval_s=0.2)).start()
+    tfront = GangFrontDoor(tgang).start()
+    try:
+        trace_ids = []
+        for i in range(3):
+            treq = urllib.request.Request(
+                f"http://127.0.0.1:{tfront.port}/generate",
+                data=json.dumps({"prompt": [1, 2, 3 + i],
+                                 "max_new_tokens": 4,
+                                 "request_id": f"mc-trace-{i}"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(treq, timeout=15) as r:
+                tpay = json.loads(r.read().decode())
+            assert tpay.get("disagg") is True, tpay
+            assert tpay.get("trace_id"), tpay
+            trace_ids.append(int(tpay["trace_id"]))
+        ta_report = TA.assemble_dir(tgang.trace_dir)
+        assert ta_report["n_orphans"] == 0, ta_report["orphans"]
+        assert ta_report["n_duplicates"] == 0, ta_report["duplicates"]
+        ta_by_hex = {t["trace"]: t for t in ta_report["traces"]}
+        for tid in trace_ids:
+            t = ta_by_hex.get(f"{tid:x}")
+            assert t is not None, (tid, sorted(ta_by_hex))
+            # one shared trace id across the supervisor's and BOTH phase
+            # replicas' span files — the request is one end-to-end trace
+            assert {"gang", "prefill", "decode"} <= set(t["roles"]), t
+            assert len(t["files"]) >= 3, t
+        import time as _t3
+
+        _t3.sleep(0.5)               # let the poller tick at least once
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{tfront.port}/fleet", timeout=10) as r:
+            fleet_doc = json.loads(r.read().decode())
+        assert fleet_doc["n_alive"] == 2, fleet_doc
+        assert {"prefill", "decode"} <= set(fleet_doc["roles"]), fleet_doc
+        assert "objectives" in fleet_doc.get("slo", {}), fleet_doc
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{tfront.port}/fleet/metrics",
+                timeout=10) as r:
+            fleet_expo = r.read().decode()
+        validate_prom_text(fleet_expo)
+        assert 'replica="0"' in fleet_expo and 'replica="1"' in fleet_expo
+        assert 'role="prefill"' in fleet_expo and \
+            'role="decode"' in fleet_expo, "role label lost in merge"
+        gang_slo = slo_mod.slo_status()      # the gang installed itself
+        assert "objectives" in gang_slo and "ok" in gang_slo, gang_slo
+    finally:
+        tfront.stop()
+        tgang.stop()
+
+    slo_fdir = os.path.join(out_dir, "slo_forensics")
+    sforensics = slo_mod.ForensicDir(slo_fdir, keep=8)
+    seng = slo_mod.SLOEngine(forensics=sforensics, min_events=8)
+    t_base = 1000.0
+    for i in range(20):
+        seng.note_request(ttft_ms=10 * seng.objectives[0].target,
+                          tpot_ms=1.0, code=200, trace_id=1234,
+                          request_id=f"breach-{i}", t=t_base + i)
+    slo_st1 = seng.evaluate(now=t_base + 20)
+    slo_st2 = seng.evaluate(now=t_base + 21)
+    assert slo_st1["objectives"]["ttft_p99"]["alert_fired"] is True, \
+        slo_st1["objectives"]["ttft_p99"]
+    assert slo_st1["alerts_total"].get("ttft_p99") == 1, slo_st1
+    assert slo_st2["alerts_total"].get("ttft_p99") == 1, \
+        "alert latch re-fired on the second evaluation of one breach"
+    assert not slo_st1["ok"] and "ttft_p99" in slo_st1["alerting"]
+    slo_dumps = sforensics.files()
+    assert len(slo_dumps) == 1, \
+        f"seeded breach wrote {len(slo_dumps)} forensic dumps, expected 1"
+    dump_doc = json.load(open(os.path.join(slo_fdir, slo_dumps[0])))
+    assert dump_doc["kind"] == "slo_breach" and \
+        dump_doc["objective"] == "ttft_p99", dump_doc
+    assert dump_doc["worst_request"]["trace_id"] == 1234, dump_doc
+    for i in range(40):                      # recovery re-arms the latch
+        seng.note_request(ttft_ms=1.0, tpot_ms=1.0, code=200,
+                          t=t_base + 700 + i)
+    slo_st3 = seng.evaluate(now=t_base + 740)
+    assert slo_st3["ok"] and not slo_st3["alerting"], slo_st3
+    assert len(sforensics.files()) == 1, "recovery wrote a second dump"
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -989,8 +1094,22 @@ def _run_check_inner(out_dir: str) -> dict:
                  "paddle_kv_transfer_bytes_total",
                  "paddle_kv_transfer_ms",
                  "paddle_serve_pool_prefix_cache_total",
-                 "paddle_serve_disagg_fallback_total"):
+                 "paddle_serve_disagg_fallback_total",
+                 # ISSUE 18 fleet + SLO families: live fleet poller,
+                 # burn-rate alerts, error budget, forensic dumps
+                 # (docs/observability.md "Fleet & SLO")
+                 "paddle_fleet_alive_replicas",
+                 "paddle_fleet_polls_total",
+                 "paddle_fleet_scrape_errors_total",
+                 "paddle_slo_ok",
+                 "paddle_slo_burn_rate",
+                 "paddle_slo_budget_remaining",
+                 "paddle_slo_alerts_total",
+                 "paddle_slo_forensic_dumps_total"):
         assert name in prom_text, f"{name} missing from exposition"
+    # the seeded breach above left exactly one labeled alert sample
+    assert 'paddle_slo_alerts_total{objective="ttft_p99"' in prom_text, \
+        "seeded SLO breach alert sample missing from exposition"
     assert 'paddle_serve_requests_total{code="200"}' in prom_text
     assert 'paddle_serve_prefix_cache_total{event="hit"}' in prom_text
     assert 'paddle_serve_prefix_cache_total{event="miss"}' in prom_text
@@ -1071,6 +1190,13 @@ def _run_check_inner(out_dir: str) -> dict:
             "resharding_bytes": reshard_delta,
             "guardrail_skips": skips_delta,
             "goodput_window": gp_window,
+            "fleet_trace": {
+                "traces": int(ta_report["n_traces"]),
+                "spans": int(ta_report["n_spans"]),
+                "orphans": int(ta_report["n_orphans"]),
+                "span_files": len(ta_report["files"])},
+            "slo": {"alerts": dict(slo_st1["alerts_total"]),
+                    "forensic_dumps": len(slo_dumps)},
             "serve_span_rollups": {k: v for k, v in rollup.items()
                                    if k.startswith("serve/")},
             "jsonl": jsonl_path, "prom": prom_path,

@@ -1,0 +1,58 @@
+#!/bin/bash
+# Chip session 10: fleet tracing + live SLO on-chip (ISSUE 18) — after
+# the still-queued session 9 (disagg A/B, which itself chains 5..8;
+# run order is enforced by markers).
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session10.sh > tpu_s10.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+if [ ! -f .tpu_s9_done ]; then
+  echo "=== [0/4] session 9 (disagg lanes) still queued — running it first ==="
+  bash tools/run_tpu_session9.sh
+fi
+
+echo "=== [1/4] SLO-stamped serve bench on-chip $(date -u +%H:%M:%S) ==="
+# every load lane now carries lane["slo"] — the observability/slo.py
+# objectives evaluated over the lane's own per-request outcomes, so the
+# on-chip TTFT/TPOT numbers land directly on the production ruler
+python tools/serve_bench.py --disagg --out SERVE_BENCH_tpu_s10.json
+echo "=== serve bench rc=$? ==="
+
+echo "=== [2/4] metrics gate on-chip (fleet + SLO + trace gates) $(date -u +%H:%M:%S) ==="
+# includes the ISSUE 18 gates: stub-gang end-to-end trace assembly
+# (one trace id across gang/prefill/decode span files, zero orphans),
+# GET /fleet + /fleet/metrics presence, and the seeded SLO breach
+# (exactly one burn-rate alert + one forensic dump, then recovery)
+python tools/metrics_check.py --out /tmp/metrics_check_tpu_s10
+echo "=== metrics_check rc=$? ==="
+
+echo "=== [3/4] dispatch bench: tracing overhead A/B on-chip $(date -u +%H:%M:%S) ==="
+# the span tracer rides every dispatch; the A/B keeps its steady-state
+# overhead under the 5% bar on real-chip step times too
+python tools/dispatch_bench.py --out DISPATCH_BENCH_tpu_s10.json
+echo "=== dispatch bench rc=$? ==="
+
+echo "=== [4/4] fault bench smoke + fleet/trace capture $(date -u +%H:%M:%S) ==="
+# the gang lane stays CPU-pinned on-chip (unpinned jax TPU processes
+# claim every local chip — session 8's caveat), but it is precisely the
+# multi-PROCESS half of ISSUE 18: the replica_sigkill scenario now also
+# gates that the killed replica's span JSONL survives and stitches
+# orphan-free, and the gang run dir leaves FLEET.json + trace/ behind
+JAX_PLATFORMS=cpu python tools/serve_fault_bench.py --smoke \
+  --out SERVE_FAULT_BENCH_s10.json
+echo "=== serve_fault_bench rc=$? ==="
+# capture the assembled fleet trace + the last FLEET.json from the
+# bench's gang run dirs (best-effort: dirs are under the bench tmp)
+for d in /tmp/serve_fault_bench*/sigkill; do
+  if [ -d "$d/trace" ]; then
+    python tools/trace_assemble.py "$d/trace" \
+      --out TRACES_s10.json --chrome TRACE_FLEET_s10.chrome.json \
+      --require-complete
+    echo "=== trace_assemble($d) rc=$? ==="
+    [ -f "$d/FLEET.json" ] && cp "$d/FLEET.json" FLEET_s10.json
+  fi
+done
+
+date -u > .tpu_s10_done

@@ -180,6 +180,39 @@ def build_engine(params, cfg, ecfg_kw, lane):
     return serving.DecodeEngine(params, cfg, serving.EngineConfig(**kw))
 
 
+def _slo_stamp(done, rejected: int, failed: int):
+    """Replay the lane's per-request outcomes through the live SLO
+    engine (observability.slo) — the same declarative objectives the
+    serving gang burn-rate alerts on — and return its verdict, so a
+    bench lane and a production ``slo_status()`` read off one ruler."""
+    from paddle_tpu.observability import slo as _slo
+
+    eng = _slo.SLOEngine(min_events=1)
+    t = 1000.0
+    for r in done:
+        tpot = None
+        if len(r.token_times) > 1:
+            tpot = float(np.median(np.diff(r.token_times)) * 1e3)
+        eng.note_request(ttft_ms=r.ttft_ms, tpot_ms=tpot, code=200, t=t)
+        t += 0.001
+    for _ in range(rejected):
+        eng.note_request(code=429, shed=True, t=t)
+        t += 0.001
+    for _ in range(failed):
+        eng.note_request(code=500, t=t)
+        t += 0.001
+    st = eng.evaluate(t)
+    return {
+        "ok": st["ok"],
+        "objectives": {
+            name: {"measured": o["measured"], "target": o["target"],
+                   "meets_target": o["meets_target"],
+                   "burn_rate_fast": o["burn_rate"]["fast"]}
+            for name, o in st["objectives"].items()
+        },
+    }
+
+
 def load_lane(params, cfg, ecfg_kw, lane, rate_rps: float,
               n_requests: int, max_new_tokens: int, prompt_len_max: int,
               seed: int, queue_cap: int):
@@ -257,6 +290,7 @@ def load_lane(params, cfg, ecfg_kw, lane, rate_rps: float,
         "preemptions": sched.preemptions,
         "steady_state_recompiles": int(recompiles),
         "warmup_ms": {k: round(v, 1) for k, v in warm_ms.items()},
+        "slo": _slo_stamp(done, rejected, len(requests) - len(done)),
     }
     if lane.get("spec", 0) > 0:
         st = engine.stats

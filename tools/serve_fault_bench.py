@@ -13,8 +13,12 @@ tier-1 slow lane in tests/test_serving_resilience.py):
                      request completes on a sibling (failover re-prefills
                      — partials from the dead replica are discarded),
                      greedy tokens match the single-engine reference,
-                     an idempotent retry returns the recorded response,
-                     and the gang recycles the replica with cause=crash.
+                     an idempotent retry returns the recorded response
+                     under the ORIGINAL trace id, the killed
+                     incarnation's span JSONL survives the SIGKILL
+                     (flush-per-record) and stitches orphan-free via
+                     tools/trace_assemble.py, and the gang recycles the
+                     replica with cause=crash.
   engine_poisoned  * one replica self-poisons after N requests (the
                      donation-failure stand-in); its engine loop fails
                      fast — abort + refuse + exit 44 — and the gang
@@ -57,6 +61,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -215,11 +220,22 @@ def scenario_replica_sigkill(work, ref):
 
         def killer():
             # SIGKILL a replica the moment it is observed mid-request —
-            # the in-flight dispatch MUST fail over, not quietly finish
+            # the in-flight dispatch MUST fail over, not quietly finish.
+            # Wait until the victim has ANSWERED at least one request so
+            # its span JSONL deterministically holds flushed records the
+            # assembly gate below can demand survive the kill.
             deadline = time.time() + 20
             while time.time() < deadline:
                 busy = max(gang.replicas, key=lambda r: r.inflight)
                 if busy.inflight >= 1 and busy.port is not None:
+                    try:
+                        served = _replica_counter(
+                            busy, "paddle_serve_requests_total")
+                    except Exception:
+                        served = 0.0
+                    if served < 1:
+                        time.sleep(0.001)
+                        continue
                     killed["index"] = busy.index
                     killed["pid"] = busy.proc.pid
                     _log(f"SIGKILL replica {busy.index} "
@@ -252,6 +268,22 @@ def scenario_replica_sigkill(work, ref):
                 break
             time.sleep(0.2)
         h = gang.health()
+        # ISSUE 18: every span is flushed the moment it is recorded, so
+        # the SIGKILLed incarnation's partial trace file must survive
+        # the kill and still stitch cleanly with the rest of the fleet
+        import trace_assemble
+        report = trace_assemble.assemble_dir(gang.trace_dir)
+        killed_files = [f for f in report["files"]
+                        if f.endswith(f"-{killed.get('pid')}.jsonl")]
+        killed_spans = sum(report["files"][f] for f in killed_files)
+        trace_ok = (bool(killed_files) and killed_spans >= 1
+                    and report["n_orphans"] == 0
+                    and report["n_duplicates"] == 0)
+        # the dedup retry must come back under the ORIGINAL trace id —
+        # failover/retry re-dispatch never mints a fresh trace
+        retry_same_trace = (payload.get("trace_id") is not None
+                            and payload.get("trace_id")
+                            == results[rid][1].get("trace_id"))
         s = {
             "spawn_s": round(spawn_s, 1),
             "killed_replica": killed,
@@ -259,11 +291,18 @@ def scenario_replica_sigkill(work, ref):
             "failovers": gang.failovers,
             "restarts": h["restarts"],
             "idempotent_retry_ok": retry_ok,
+            "retry_same_trace": retry_same_trace,
             "gang_recovered": h["ready"] == 2,
+            "killed_replica_span_files": killed_files,
+            "killed_replica_spans": killed_spans,
+            "trace_orphans": report["n_orphans"],
+            "trace_duplicates": report["n_duplicates"],
+            "killed_trace_stitchable": trace_ok,
         }
         s["pass"] = bool(acct["ok"] and gang.failovers >= 1
                          and h["restarts"].get("crash", 0) >= 1
-                         and retry_ok and s["gang_recovered"])
+                         and retry_ok and retry_same_trace
+                         and s["gang_recovered"] and trace_ok)
         return s
     finally:
         gang.stop()
