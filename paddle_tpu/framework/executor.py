@@ -33,6 +33,7 @@ logger = logging.getLogger("paddle_tpu.executor")
 # these exist whether or not a profiling session is active (the "profiling
 # started after the first step" dropped-compile-events satellite).
 # ---------------------------------------------------------------------------
+from ..observability import flight as _flight
 from ..observability import goodput as _goodput
 from ..observability import metrics as _obs_metrics
 from ..observability import spans as _spans
@@ -937,6 +938,12 @@ class Executor:
         self._step += 1
         self._fast_hits += 1
         _m_dispatch_fast.inc()
+        # flight-recorder dispatch tick (ISSUE 19): ring-append only on
+        # this path (no sidecar write unless one is attached) — the
+        # <5% flight_overhead_pct A/B in tools/dispatch_bench.py holds
+        # this to one global read when off, one event when on
+        if _flight.flight_enabled():
+            _flight.event("dispatch", path="fast", step=self._step)
         t_run0 = time.perf_counter_ns()
         prof = _prof()
         # no ledger timer here: the run() entry wrapper already brackets
@@ -1272,9 +1279,23 @@ class Executor:
         health = _health()
         health.maybe_install_from_env()
         hb_dir = os.environ.get(health.ENV_DIR)
-        heartbeat = (health.RankHeartbeat(
-            hb_dir, int(os.environ.get("PADDLE_TRAINER_ID", "0")))
-            if hb_dir else None)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or "0")
+        heartbeat = (health.RankHeartbeat(hb_dir, rank)
+                     if hb_dir else None)
+        # flight recorder + per-rank span sink (ISSUE 19): when the
+        # launcher exports PADDLE_FLIGHT_DIR, the event ring mirrors to
+        # a crash-surviving per-rank sidecar, and the span tracer writes
+        # spans-train<R>-<pid>.jsonl into the same dir so
+        # tools/trace_assemble.py stitches per-step training traces the
+        # way it stitches serving requests
+        flight_dir = os.environ.get(_flight.ENV_DIR)
+        if flight_dir:
+            _flight.maybe_attach_from_env()
+            if _spans.tracing_enabled():
+                try:
+                    _spans.attach_process_sink(flight_dir, f"train{rank}")
+                except OSError:
+                    pass
         guard = None
         if train and guardrails is not None and guardrails is not False:
             if not fetch_list:
@@ -1376,9 +1397,10 @@ class Executor:
             preempt = install_preemption_handler()
 
         def _save_ckpt(step_no: int, sync: bool = False,
-                       stream_state=None):
+                       stream_state=None, span_ctx=None):
             # only the synchronous share burns main-thread wall: the host
             # snapshot + (for sync saves) the commit wait
+            t_ck0 = time.perf_counter_ns()
             with _gp.timer("checkpoint_save"):
                 data_state = {"epoch": 0, "offset": step_no}
                 if stream_state is not None:
@@ -1389,6 +1411,12 @@ class Executor:
                           data_state=data_state)
                 if sync:
                     ckpt.wait()
+            ck_dur = time.perf_counter_ns() - t_ck0
+            _flight.event("ckpt_write", step=step_no, dur_ns=ck_dur,
+                          sync=bool(sync))
+            if span_ctx is not None:
+                _spans.record("train/checkpoint", t_ck0, ck_dur,
+                              trace=span_ctx[0], parent=span_ctx[1])
 
         # overlap host batch assembly + device transfer with the in-flight
         # (asynchronously dispatched) step; fetches stay on device between
@@ -1423,6 +1451,18 @@ class Executor:
                 st = feed.pop(_STREAM_STATE_KEY, None)
                 if st is not None:
                     last_stream_state = st
+            # per-step flight events + a per-step root span (ISSUE 19):
+            # the trace/root ids are minted up front so the dispatch /
+            # data-wait / checkpoint children recorded along the way all
+            # parent into the train/step root emitted at step end
+            _flight.event("data_wait", dur_ns=int(input_wait_ms * 1e6),
+                          step=step + 1)
+            _flight.event("step_begin", step=step + 1)
+            if _spans.tracing_enabled():
+                step_trace, step_root = _spans.gen_id(), _spans.gen_id()
+            else:
+                step_trace = step_root = None
+            t_disp0 = t_disp1 = None
             with _gp.timer("productive_step"):
                 health.progress("train_from_dataset")
                 if guard is not None:
@@ -1449,9 +1489,16 @@ class Executor:
                         input_extra["quarantined_records"] = \
                             int(quarantined_fn())
                     with monitor.step() as s:
+                        # the dispatch IS the host-side train-step
+                        # collective boundary: one monotone seq per step,
+                        # agreed across ranks (identical step loops)
+                        _fl_seq = _flight.collective_enter("train_step")
+                        t_disp0 = time.perf_counter_ns()
                         last_fetch = self.run(program=program, feed=feed,
                                               fetch_list=fetch_list, scope=scope,
                                               return_numpy=False)
+                        t_disp1 = time.perf_counter_ns()
+                        _flight.collective_exit(_fl_seq, "train_step")
                         s.dispatched()
                         if fetch_list:
                             # materializing the first fetch IS the device wait;
@@ -1471,9 +1518,13 @@ class Executor:
                         else:
                             s.observe(**input_extra)
                 else:
+                    _fl_seq = _flight.collective_enter("train_step")
+                    t_disp0 = time.perf_counter_ns()
                     last_fetch = self.run(program=program, feed=feed,
                                           fetch_list=fetch_list, scope=scope,
                                           return_numpy=False)
+                    t_disp1 = time.perf_counter_ns()
+                    _flight.collective_exit(_fl_seq, "train_step")
                     if guard is not None:
                         with _gp.timer("device_wait"):
                             loss_host = np.asarray(last_fetch[0])
@@ -1499,11 +1550,15 @@ class Executor:
                         logger.info("preemption signal at step %d: "
                                     "checkpointing and exiting", step)
                         _save_ckpt(step, sync=True,
-                                   stream_state=last_stream_state)
+                                   stream_state=last_stream_state,
+                                   span_ctx=(step_trace, step_root)
+                                   if step_trace else None)
                         break
                     if checkpoint_interval and \
                             step % int(checkpoint_interval) == 0:
-                        _save_ckpt(step, stream_state=last_stream_state)
+                        _save_ckpt(step, stream_state=last_stream_state,
+                                   span_ctx=(step_trace, step_root)
+                                   if step_trace else None)
                 if fetch_list and print_period and step % print_period == 0:
                     # the only per-step host sync point (monitor excepted),
                     # and only when printing
@@ -1512,8 +1567,30 @@ class Executor:
                         msg = ", ".join(
                             f"{name}={np.asarray(val).ravel()[:4]}"
                             for name, val in zip(fetch_info, last_fetch))
-                    _m_fetch_stall.inc((time.perf_counter_ns() - t0) / 1e6)
+                    dev_ns = time.perf_counter_ns() - t0
+                    _m_fetch_stall.inc(dev_ns / 1e6)
+                    _flight.event("stream_fetch", step=step, dur_ns=dev_ns)
+                    if step_trace is not None:
+                        _spans.record("train/device", t0, dev_ns,
+                                      trace=step_trace, parent=step_root)
                     logger.info("step %d: %s", step, msg)
+                # step epilogue stays inside the productive_step window:
+                # the flight/span sidecar flushes are framework cost of
+                # the step, not unaccounted "other" in the goodput ledger
+                _flight.event("step_end", step=step)
+                if step_trace is not None:
+                    tr = _spans.default_tracer()
+                    tr.record("train/data_wait", t_in,
+                              int(input_wait_ms * 1e6),
+                              trace=step_trace, parent=step_root)
+                    if t_disp0 is not None:
+                        tr.record("train/dispatch", t_disp0,
+                                  t_disp1 - t_disp0,
+                                  trace=step_trace, parent=step_root)
+                    tr.record("train/step", t_in,
+                              time.perf_counter_ns() - t_in,
+                              trace=step_trace, span_id=step_root,
+                              attrs={"step": step, "rank": rank})
         if heartbeat is not None:
             heartbeat.flush()
         if ckpt is not None:

@@ -54,6 +54,13 @@ split (host RecordEvent + device tracer + train monitor callbacks):
   an error-budget ledger that survives warm restarts, and bounded
   slow-request forensic dumps. ``slo_status()`` is the machine-readable
   signal surface.
+- :mod:`.flight` — the training-gang flight recorder (ISSUE 19): a
+  per-rank bounded ring of typed step/dispatch/collective/data-wait/
+  checkpoint events with two monotone collective sequence streams
+  (host-side enter/exit + trace-time lowered stamps), mirrored to a
+  crash-surviving per-rank JSONL sidecar and auto-dumped on watchdog
+  fire / anomaly / exit.  ``tools/flight_assemble.py`` is the blame
+  engine that merges the per-rank files into a hang verdict.
 - :mod:`.program_report` — compile- & memory-side introspection (ISSUE 4):
   per-executable cost/memory program reports (JSONL +
   ``paddle_program_*`` gauges), the recompile explainer
@@ -79,6 +86,7 @@ from .monitor import MonitorWriter, TrainMonitor  # noqa: F401
 from . import attribution  # noqa: F401
 from . import baseline  # noqa: F401
 from . import fleet  # noqa: F401
+from . import flight  # noqa: F401
 from . import goodput  # noqa: F401
 from . import hw  # noqa: F401
 from . import program_report  # noqa: F401
@@ -91,6 +99,6 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
     "metrics_enabled", "set_metrics_enabled",
     "MonitorWriter", "TrainMonitor", "attribution", "baseline", "fleet",
-    "goodput", "hw", "program_report", "prom", "slo", "spans",
+    "flight", "goodput", "hw", "program_report", "prom", "slo", "spans",
     "trace_merge",
 ]

@@ -429,6 +429,11 @@ class TrainMonitor:
                 json.dump({k: repr(v) if not isinstance(
                     v, (str, int, float, bool, type(None))) else v
                     for k, v in flags_snapshot().items()}, f, indent=1)
+            # flight-recorder ring snapshot (ISSUE 19): the step /
+            # collective / data-wait event tail around the anomaly
+            from . import flight as _flight
+
+            _flight.dump("anomaly", dir_path=d)
         except Exception as e:  # forensics must never kill the train loop
             import logging
 

@@ -21,7 +21,7 @@ from .metrics import MetricsRegistry, default_registry
 from .metrics import _CounterChild, _GaugeChild, _HistogramChild  # noqa: F401
 
 __all__ = ["render", "write_textfile", "merge_expositions",
-           "GAUGE_MERGE_SUM", "MetricsHTTPServer"]
+           "GAUGE_MERGE_SUM", "GAUGE_MERGE_POLICY", "MetricsHTTPServer"]
 
 
 def _escape_help(s: str) -> str:
@@ -98,6 +98,30 @@ GAUGE_MERGE_SUM = frozenset({
     "paddle_serve_active_requests",
 })
 
+# explicit fleet merge policy for the flight-recorder families (ISSUE
+# 19) whose semantics are not guessable from the metric type alone:
+#
+#   paddle_step_skew_ms        gauge    MAX  — the fleet's worst cross-
+#                                             rank step skew is the
+#                                             signal; a sum of skews is
+#                                             meaningless
+#   paddle_blamed_rank         gauge    MAX  — a rank IDENTITY (-1 = no
+#                                             blame); MAX surfaces the
+#                                             blamed rank over the -1
+#                                             sentinels, never adds them
+#   paddle_flight_dump_total   counter  SUM  — dump occurrences per
+#                                             cause (hang/anomaly/exit)
+#                                             total across the gang, by
+#                                             the counter type rule
+#
+# Counters need no entry (TYPE counter always sums); gauge families
+# listed here are pinned so a future GAUGE_MERGE_SUM edit can't silently
+# flip them.  ``merge_expositions(gauge_merge=...)`` still overrides.
+GAUGE_MERGE_POLICY = {
+    "paddle_step_skew_ms": "max",
+    "paddle_blamed_rank": "max",
+}
+
 
 def merge_expositions(texts, gauge_merge=None, extra_labels=None) -> str:
     """Merge several text expositions (one per gang rank) into ONE gang
@@ -136,6 +160,8 @@ def merge_expositions(texts, gauge_merge=None, extra_labels=None) -> str:
     def gauge_policy(fam: str) -> str:
         if gauge_merge and fam in gauge_merge:
             return gauge_merge[fam]
+        if fam in GAUGE_MERGE_POLICY:
+            return GAUGE_MERGE_POLICY[fam]
         return "sum" if fam in GAUGE_MERGE_SUM else "max"
 
     def inject(labels: str, extra) -> str:

@@ -48,19 +48,22 @@ def _flag_comm_dtype():
     return get_flag("FLAGS_collective_comm_dtype", "") or None
 
 
-def _record(op_kind, x, ax):
+def _record(op_kind, x, ax, site=None):
+    # record_collective also stamps the flight recorder's lowered-seq
+    # stream (ISSUE 19); ``site`` names the fluid op so blame reports
+    # read "c_allreduce_sum", not just "psum"
     co = _comm()
     co.record_collective(op_kind, x.dtype, x.size * x.dtype.itemsize,
-                         co.axis_size(ax))
+                         co.axis_size(ax), site=site)
 
 
-def _allreduce(reduce_fn):
+def _allreduce(reduce_fn, site=None):
     def lower(ctx, op, ins):
         x = ins["X"][0]
         ax = _axis(ctx, op)
         if ax is None:
             return {"Out": x}
-        _record("psum", x, ax)
+        _record("psum", x, ax, site=site)
         return {"Out": reduce_fn(x, ax)}
 
     return lower
@@ -75,12 +78,14 @@ def c_allreduce_sum(ctx, op, ins):
     cd = _flag_comm_dtype()
     if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
         return {"Out": _comm().quantized_allreduce(x, ax, cd)}
-    _record("psum", x, ax)
+    _record("psum", x, ax, site="c_allreduce_sum")
     return {"Out": lax.psum(x, ax)}
 
 
-register_op("c_allreduce_max", diff_inputs=("X",))(_allreduce(lax.pmax))
-register_op("c_allreduce_min", diff_inputs=("X",))(_allreduce(lax.pmin))
+register_op("c_allreduce_max", diff_inputs=("X",))(
+    _allreduce(lax.pmax, site="c_allreduce_max"))
+register_op("c_allreduce_min", diff_inputs=("X",))(
+    _allreduce(lax.pmin, site="c_allreduce_min"))
 
 
 @register_op("c_allreduce_prod", diff_inputs=("X",))
@@ -90,7 +95,7 @@ def c_allreduce_prod(ctx, op, ins):
     if ax is None:
         return {"Out": x}
     # no lax.pprod; exp-sum-log trick is unstable — use all_gather+prod
-    _record("all_gather", x, ax)
+    _record("all_gather", x, ax, site="c_allreduce_prod")
     g = lax.all_gather(x, ax)
     return {"Out": jnp.prod(g, axis=0)}
 
@@ -105,7 +110,7 @@ def c_allgather(ctx, op, ins):
     co = _comm()
     co.record_collective("all_gather", x.dtype,
                          x.size * x.dtype.itemsize * co.axis_size(ax),
-                         co.axis_size(ax))
+                         co.axis_size(ax), site="c_allgather")
     g = lax.all_gather(x, ax)  # (nranks, ...)
     return {"Out": jnp.reshape(g, (g.shape[0] * g.shape[1],) + g.shape[2:])}
 
@@ -119,7 +124,7 @@ def c_reducescatter(ctx, op, ins):
     cd = _flag_comm_dtype()
     if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
         return {"Out": _comm().quantized_reduce_scatter_op(x, ax, cd)}
-    _record("psum_scatter", x, ax)
+    _record("psum_scatter", x, ax, site="c_reducescatter")
     return {"Out": lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)}
 
 
@@ -134,7 +139,7 @@ def c_broadcast(ctx, op, ins):
     co = _comm()
     co.record_collective("all_gather", x.dtype,
                          x.size * x.dtype.itemsize * co.axis_size(ax),
-                         co.axis_size(ax))
+                         co.axis_size(ax), site="c_broadcast")
     g = lax.all_gather(x, ax)
     return {"Out": g[root]}
 
@@ -149,7 +154,7 @@ def c_concat(ctx, op, ins):
     co = _comm()
     co.record_collective("all_gather", x.dtype,
                          x.size * x.dtype.itemsize * co.axis_size(ax),
-                         co.axis_size(ax))
+                         co.axis_size(ax), site="c_concat")
     return {"Out": lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)}
 
 
@@ -195,7 +200,7 @@ def legacy_allreduce(ctx, op, ins):
         return {"Out": x}
     red = op.attr("reduce_type", 0)
     fn = [lax.psum, lax.pmax, lax.pmin][red] if red in (0, 1, 2) else lax.psum
-    _record("psum", x, ax)
+    _record("psum", x, ax, site="allreduce")
     return {"Out": fn(x, ax)}
 
 
@@ -211,5 +216,5 @@ def c_allreduce_avg(ctx, op, ins):
     cd = _flag_comm_dtype()
     if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
         return {"Out": _comm().quantized_allreduce(x, ax, cd, mean=True)}
-    _record("psum", x, ax)
+    _record("psum", x, ax, site="c_allreduce_avg")
     return {"Out": lax.pmean(x, ax)}

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observability import flight as _flight
 from ..observability import metrics as _obs_metrics
 
 __all__ = [
@@ -94,12 +95,27 @@ def wire_bytes(op: str, payload_bytes: int, ranks: int) -> int:
     raise ValueError(f"unknown collective op {op!r}")
 
 
-def record_collective(op: str, dtype, payload_bytes: int, ranks: int) -> int:
+def record_collective(op: str, dtype, payload_bytes: int, ranks: int,
+                      site: Optional[str] = None) -> int:
     """Count one lowered collective into the wire-bytes counter; returns
-    the per-rank ring bytes recorded."""
+    the per-rank ring bytes recorded.
+
+    This is THE chokepoint every collective call site flows through
+    (ops/collective.py lowerings, parallelize.py psum/ppermute sites,
+    and this module's own bucketed/quantized wrappers), so it also
+    stamps the flight recorder's lowered-collective sequence stream
+    (ISSUE 19): one monotone (lseq, op, dtype, bytes, ranks, site)
+    event per collective baked into a traced program.  Ranks trace
+    identical programs in identical order, so the stream is the
+    cross-rank fingerprint tools/flight_assemble.py checks for
+    divergence.  ``site`` labels the calling wrapper (defaults to
+    ``op``); tools/paddle_lint.py statically verifies every wrapper
+    reaches this stamp."""
     b = wire_bytes(op, int(payload_bytes), int(ranks))
     if b:
         _m_wire_bytes.labels(op, str(jnp.dtype(dtype).name)).inc(b)
+        _flight.stamp_collective(op, jnp.dtype(dtype).name,
+                                 payload_bytes, ranks, site=site)
     return b
 
 
@@ -300,7 +316,8 @@ def reduce_scatter_flat(vec, axis, ccfg: CommConfig, residual=None,
     n = vec.shape[0]
     if ccfg.comm_dtype is None:
         if record:
-            record_collective("psum_scatter", jnp.float32, n * 4, ranks)
+            record_collective("psum_scatter", jnp.float32, n * 4, ranks,
+                              site="reduce_scatter_flat")
         if ranks == 1:
             return vec, None
         return lax.psum_scatter(vec, axis, scatter_dimension=0,
@@ -318,13 +335,15 @@ def reduce_scatter_flat(vec, axis, ccfg: CommConfig, residual=None,
 
     if record:
         record_collective(
-            "all_to_all", payload.dtype, n * payload.dtype.itemsize, ranks)
+            "all_to_all", payload.dtype, n * payload.dtype.itemsize, ranks,
+            site="reduce_scatter_flat")
     rows = lax.all_to_all(payload.reshape(ranks, n // ranks), axis,
                           split_axis=0, concat_axis=0)
     if scales is not None:
         if record:
             record_collective("all_to_all", jnp.float32,
-                              scales.size * 4, ranks)
+                              scales.size * 4, ranks,
+                              site="reduce_scatter_flat")
         srows = lax.all_to_all(scales.reshape(ranks, -1), axis,
                                split_axis=0, concat_axis=0)
         deq = jax.vmap(lambda p, s: dequantize_chunked(
@@ -341,7 +360,8 @@ def all_gather_flat(shard, axis, record: bool = True):
         return shard
     if record:
         record_collective("all_gather", shard.dtype,
-                          shard.size * shard.dtype.itemsize * ranks, ranks)
+                          shard.size * shard.dtype.itemsize * ranks, ranks,
+                          site="all_gather_flat")
     return lax.all_gather(shard, axis, tiled=True)
 
 
@@ -364,7 +384,7 @@ def quantized_allreduce(x, axis, comm_dtype, quant_chunk: int = 256,
     if cd is None or ranks == 1:
         if record:
             record_collective("psum", x.dtype, x.size * x.dtype.itemsize,
-                              ranks)
+                              ranks, site="quantized_allreduce")
         out = lax.psum(x, axis)
         return out / ranks if mean else out
     ccfg = CommConfig(comm_dtype=cd, quant_chunk=quant_chunk)
@@ -396,7 +416,8 @@ def quantized_reduce_scatter_op(x, axis, comm_dtype, quant_chunk: int = 256,
     if cd is None or ranks == 1:
         if record:
             record_collective("psum_scatter", x.dtype,
-                              x.size * x.dtype.itemsize, ranks)
+                              x.size * x.dtype.itemsize, ranks,
+                              site="quantized_reduce_scatter")
         return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
     orig_dtype = x.dtype
     shard_shape = (x.shape[0] // ranks,) + tuple(x.shape[1:])

@@ -252,6 +252,15 @@ class HangWatchdog:
                 json.dump(_REG.snapshot(), f, indent=1, default=str)
         except Exception:
             pass
+        try:
+            # flight-recorder ring snapshot (ISSUE 19): the event tail —
+            # last steps, last collective seq entered — lands next to the
+            # stacks, and tools/flight_assemble.py names the blamed rank
+            from ..observability import flight as _flight
+
+            _flight.dump("hang", dir_path=d)
+        except Exception:
+            pass
         return d
 
 

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..observability import flight as _flight
 from ..observability import goodput as _goodput
 from ..observability import metrics as _obs_metrics
 from . import health as _health
@@ -238,6 +239,52 @@ def _stop_gang(procs, grace_period_s: float, sig=signal.SIGTERM):
                 pass
 
 
+def _assemble_blame(flight_dir: str, attempt: int) -> Optional[dict]:
+    """Run the blame engine (tools/flight_assemble.py) over the dead
+    incarnation's flight files: write ``blame.attempt<K>.json`` next to
+    them (the restart record), publish ``paddle_blamed_rank`` /
+    ``paddle_step_skew_ms``, and return the verdict.  Forensics must
+    never fail the restart — any error returns None."""
+    try:
+        import importlib.util
+        import json as _json
+
+        tool = os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            "tools", "flight_assemble.py")
+        spec = importlib.util.spec_from_file_location(
+            "paddle_flight_assemble", tool)
+        if spec is None or spec.loader is None:
+            return None
+        fa = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fa)
+        report = fa.assemble_dir(flight_dir, attempt=attempt)
+        verdict = report.get("verdict") or {}
+        out = os.path.join(flight_dir, f"blame.attempt{attempt}.json")
+        with open(out, "w") as f:
+            _json.dump(report, f, indent=1)
+        blamed = verdict.get("blamed_ranks") or []
+        _flight.note_blame(blamed[0] if blamed else None,
+                           verdict.get("step_skew_ms"))
+        if blamed:
+            sys.stderr.write(
+                f"launch: blame verdict (attempt {attempt}): rank(s) "
+                f"{blamed} {verdict.get('blame_mode')} at collective seq "
+                f"{verdict.get('missed_seq')}"
+                + (f" [{verdict['missed_name']}]"
+                   if verdict.get("missed_name") else "")
+                + f" — {out}\n")
+        else:
+            sys.stderr.write(
+                f"launch: blame assembly (attempt {attempt}): no rank "
+                f"blamed — {out}\n")
+        return verdict
+    except Exception as e:
+        sys.stderr.write(f"launch: blame assembly failed: {e}\n")
+        return None
+
+
 def launch(training_script: str, script_args: Optional[List[str]] = None,
            cluster_node_ips: str = "127.0.0.1", node_ip: str = "127.0.0.1",
            nproc_per_node: int = 1, started_port: int = 6070,
@@ -249,7 +296,8 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
            health_dir: Optional[str] = None,
            straggler_ratio: float = 2.0,
            straggler_warn_cooldown_s: float = 30.0,
-           goodput_dir: Optional[str] = None) -> int:
+           goodput_dir: Optional[str] = None,
+           flight_dir: Optional[str] = None) -> int:
     """Spawn and supervise the worker gang; returns the job's exit code
     (0 on success or clean preemption; otherwise the FIRST failing child's
     exit code, with signal deaths mapped to 128+N).
@@ -267,6 +315,15 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
     failure-detect -> respawn window as ``restart_downtime``, and at job
     end it merges everything into ``GOODPUT.json`` (gang goodput
     fraction) plus one merged gang exposition.
+
+    ``flight_dir`` (defaults to ``<log_dir>/flight``, or
+    ``<health_dir>/flight`` without a log dir) arms the per-rank flight
+    recorder (ISSUE 19, docs/health.md): workers mirror their event
+    rings to crash-surviving sidecars via ``PADDLE_FLIGHT_DIR``, and on
+    a hang-cause restart the supervisor runs the blame engine
+    (tools/flight_assemble.py) over the dead incarnation's files,
+    writes ``blame.attempt<K>.json`` next to them, and publishes the
+    ``paddle_blamed_rank`` / ``paddle_step_skew_ms`` metric pair.
     """
     from ..sysconfig import tpu_perf_flags
 
@@ -283,6 +340,13 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
         goodput_dir = os.path.join(log_dir, "goodput")
     if goodput_dir:
         os.makedirs(goodput_dir, exist_ok=True)
+    if flight_dir is None:
+        if log_dir:
+            flight_dir = os.path.join(log_dir, "flight")
+        elif health_dir:
+            flight_dir = os.path.join(health_dir, "flight")
+    if flight_dir:
+        os.makedirs(flight_dir, exist_ok=True)
     straggler_mon = (_health.StragglerMonitor(
         health_dir, ratio=straggler_ratio,
         warn_cooldown_s=straggler_warn_cooldown_s)
@@ -310,6 +374,10 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                 # goodput env contract: workers export their per-rank
                 # ledger + exposition here at run-window exit
                 env[_goodput.ENV_DIR] = goodput_dir
+            if flight_dir:
+                # flight env contract: workers sidecar their event
+                # rings here (flight.maybe_attach_from_env)
+                env[_flight.ENV_DIR] = flight_dir
             if perf_flags:
                 # comm/compute-overlap preset into each worker's XLA_FLAGS
                 # BEFORE its backend init (no-op unless the worker env
@@ -376,6 +444,11 @@ def launch(training_script: str, script_args: Optional[List[str]] = None,
                     f"launch: worker {rank} exited with {ret} "
                     f"(code {code}, cause {cause})\n")
                 _stop_gang(procs, grace_period_s)
+                if cause == "hang" and flight_dir:
+                    # gang is quiesced: every surviving sidecar is
+                    # flushed — name the rank that wedged the gang and
+                    # the collective seq it missed (restart record)
+                    _assemble_blame(flight_dir, attempt=restarts)
                 if restarts < max_restarts:
                     restarts += 1
                     _m_restarts.labels(cause).inc()
@@ -473,6 +546,11 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
                          "supervisor merges them (plus its restart-"
                          "downtime windows) into GOODPUT.json (default: "
                          "<log_dir>/goodput)")
+    ap.add_argument("--flight_dir", default=None,
+                    help="shared dir for per-rank flight-recorder "
+                         "sidecars; on a hang-cause restart the "
+                         "supervisor writes blame.attempt<K>.json here "
+                         "(default: <log_dir>/flight)")
     ap.add_argument("--no_perf_flags", action="store_true",
                     help="skip the sysconfig.tpu_perf_flags XLA preset")
     ap.add_argument("training_script")
@@ -488,7 +566,8 @@ def main():  # CLI: python -m paddle_tpu.parallel.launch script.py args...
                     hang_deadline_s=args.hang_deadline,
                     health_dir=args.health_dir,
                     straggler_ratio=args.straggler_ratio,
-                    goodput_dir=args.goodput_dir))
+                    goodput_dir=args.goodput_dir,
+                    flight_dir=args.flight_dir))
 
 
 if __name__ == "__main__":

@@ -135,7 +135,7 @@ def psum_grads_by_spec(grads, specs, axis_names, skip_axes=(),
                 return g
             comm_opt.record_collective(
                 "psum", g.dtype, g.size * g.dtype.itemsize,
-                comm_opt._axes_size(axes))
+                comm_opt._axes_size(axes), site="psum_grads_by_spec")
             return jax.lax.psum(g, axes)
 
     return jax.tree_util.tree_map(one, grads, specs,
@@ -197,7 +197,8 @@ def _pipeline_loss(params, tokens, labels, cfg: GPTConfig,
     def _permute_act(x):
         with _named_collective("ppermute_activation"):
             comm_opt.record_collective(
-                "ppermute", x.dtype, x.size * x.dtype.itemsize, S)
+                "ppermute", x.dtype, x.size * x.dtype.itemsize, S,
+                site="ppermute_activation")
             return jax.lax.ppermute(x, pp_ax, perm)
 
     def tick(carry, t):
@@ -484,7 +485,7 @@ def _make_rs_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
             params, tokens, labels, cfg, pcfg, double_buffer)
         with _named_collective("psum_loss"):
             comm_opt.record_collective("psum", jnp.float32, 4,
-                                       pcfg.n_devices)
+                                       pcfg.n_devices, site="psum_loss")
             loss = jax.lax.psum(local_loss, pcfg.axis_names)
         # pp/tp replication is still a per-leaf psum; the dp reduction is
         # the bucketed scatter below
@@ -639,11 +640,14 @@ def _make_gspmd_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
             nbytes = int(np.prod(a.shape)) * 4  # f32 grads
             if dp_ax in _spec_axes_of(tuple(s)):
                 comm_opt.record_collective("psum_scatter", jnp.float32,
-                                           nbytes, dp)
+                                           nbytes, dp,
+                                           site="static_estimate")
                 comm_opt.record_collective("all_gather", jnp.float32,
-                                           nbytes, dp)
+                                           nbytes, dp,
+                                           site="static_estimate")
             else:
-                comm_opt.record_collective("psum", jnp.float32, nbytes, dp)
+                comm_opt.record_collective("psum", jnp.float32, nbytes, dp,
+                                           site="static_estimate")
 
     def loss_fn(params, tokens, labels):
         M, B, T = tokens.shape
@@ -812,7 +816,7 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
             params, tokens, labels, cfg, pcfg, db)
         with _named_collective("psum_loss"):
             comm_opt.record_collective("psum", jnp.float32, 4,
-                                       pcfg.n_devices)
+                                       pcfg.n_devices, site="psum_loss")
             loss = jax.lax.psum(local_loss, pcfg.axis_names)
         grads = psum_grads_by_spec(
             grads, specs, pcfg.axis_names,

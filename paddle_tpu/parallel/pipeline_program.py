@@ -358,7 +358,8 @@ class _CompiledPipelineBlock:
                             for _v in jax.tree_util.tree_leaves(iface):
                                 _comm.record_collective(
                                     "ppermute", _v.dtype,
-                                    _v.size * _v.dtype.itemsize, S)
+                                    _v.size * _v.dtype.itemsize, S,
+                                    site="ppermute_activation")
                             iface = jax.tree_util.tree_map(
                                 lambda a: jax.lax.ppermute(a, "pp", perm),
                                 iface)

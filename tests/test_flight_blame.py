@@ -1,0 +1,387 @@
+"""Flight recorder + cross-rank blame engine (ISSUE 19,
+docs/health.md "which rank hung, and where"): bounded event ring,
+host/lowered collective sequence stamping, crash-surviving JSONL
+sidecars, the tools/flight_assemble.py verdicts (dead rank, death
+mid-exchange, clean gang, sequence gaps, stall taxonomy, step-skew
+timeline), the goodput-category breakdown, the fleet merge policy for
+the flight metric families, and the paddle_lint --flight-stamps source
+check."""
+import importlib.util
+import json
+import os
+
+import pytest
+
+from paddle_tpu.observability import flight
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+fa = _load_tool("flight_assemble")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight():
+    flight.reset(detach=True)
+    flight.set_flight_enabled(True)
+    yield
+    flight.reset(detach=True)
+    flight.set_flight_enabled(True)
+
+
+def _counter(name):
+    from paddle_tpu.observability import default_registry
+
+    snap = default_registry().snapshot()
+    return {tuple(s["labels"]): s["value"]
+            for s in snap.get(name, {}).get("series", [])}
+
+
+def _gauge(name):
+    from paddle_tpu.observability import default_registry
+
+    snap = default_registry().snapshot()
+    series = snap.get(name, {}).get("series", [])
+    return series[0]["value"] if series else None
+
+
+# ---------------------------------------------------------------------------
+# Ring + sequence stamping
+# ---------------------------------------------------------------------------
+
+def test_ring_bounds_and_rollover():
+    rec = flight.FlightRecorder(ring=8)
+    for i in range(20):
+        rec.event("step_begin", step=i)
+    evs = rec.events()
+    assert len(evs) == 8                       # bounded
+    assert [e["step"] for e in evs] == list(range(12, 20))  # oldest evicted
+    assert rec.summary() == {"step_begin": 8}
+    rec.clear()
+    assert rec.events() == []
+
+
+def test_disabled_recorder_is_noop():
+    flight.set_flight_enabled(False)
+    flight.event("step_begin", step=1)
+    assert flight.collective_enter("allreduce_grads", 64) == 0
+    flight.collective_exit(0)
+    assert flight.stamp_collective("allreduce", "float32", 64, 8) == 0
+    assert flight.default_recorder().events() == []
+    flight.set_flight_enabled(True)
+    flight.event("step_begin", step=2)
+    assert len(flight.default_recorder().events()) == 1
+
+
+def test_host_seq_contiguous_and_paired():
+    seqs = []
+    for i in range(4):
+        with flight.collective("allreduce_grads", nbytes=128) as seq:
+            seqs.append(seq)
+    assert seqs == [1, 2, 3, 4]                # contiguous from 1
+    evs = flight.default_recorder().events()
+    enters = [e for e in evs if e["ev"] == "coll_enter"]
+    exits = [e for e in evs if e["ev"] == "coll_exit"]
+    assert [e["seq"] for e in enters] == seqs
+    assert [e["seq"] for e in exits] == seqs
+    assert enters[0]["name"] == "allreduce_grads"
+    assert enters[0]["bytes"] == 128
+
+
+def test_lowered_seq_is_a_separate_stream():
+    flight.collective_enter("barrier")
+    ls1 = flight.stamp_collective("allreduce", "bfloat16", 2048, 8,
+                                  site="psum_grads_by_spec")
+    ls2 = flight.stamp_collective("all_gather", "float32", 512, 8)
+    assert (ls1, ls2) == (1, 2)                # not advanced by host seq
+    lowered = [e for e in flight.default_recorder().events()
+               if e["ev"] == "coll_lowered"]
+    assert [e["lseq"] for e in lowered] == [1, 2]
+    assert lowered[0]["site"] == "psum_grads_by_spec"
+    assert lowered[1]["site"] == "all_gather"  # defaults to the op
+
+
+def test_reset_restarts_both_streams():
+    flight.collective_enter("a")
+    flight.stamp_collective("allreduce", "float32", 4, 2)
+    flight.reset()
+    assert flight.collective_enter("b") == 1
+    assert flight.stamp_collective("allreduce", "float32", 4, 2) == 1
+
+
+# ---------------------------------------------------------------------------
+# Sidecar discipline
+# ---------------------------------------------------------------------------
+
+def test_sidecar_appends_and_survives_torn_tail(tmp_path):
+    d = str(tmp_path)
+    path = flight.attach_sink(d)
+    assert os.path.basename(path) == \
+        f"flight-rank0-{os.getpid()}.jsonl"
+    flight.event("step_begin", step=1)
+    with flight.collective("allreduce_grads", 64):
+        pass
+    # every event is already on disk (per-line flush) — emulate a SIGKILL
+    # mid-write by appending a torn half line straight to the file
+    with open(path, "a") as f:
+        f.write('{"ev": "coll_ent')
+    files = fa.load_flight_files(d)
+    recs = files[os.path.basename(path)]
+    assert recs[0]["ev"] == "meta"             # header first
+    assert [r["ev"] for r in recs[1:]] == \
+        ["step_begin", "coll_enter", "coll_exit"]   # torn tail dropped
+
+
+def test_maybe_attach_from_env(tmp_path, monkeypatch):
+    d = str(tmp_path / "fl")
+    monkeypatch.setenv(flight.ENV_DIR, d)
+    p1 = flight.maybe_attach_from_env()
+    p2 = flight.maybe_attach_from_env()        # idempotent
+    assert p1 == p2 and p1.startswith(d)
+    flight.event("step_begin", step=7)
+    with open(p1) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines[0]["ev"] == "meta"
+    assert lines[-1] == {**lines[-1], "ev": "step_begin", "step": 7}
+
+
+def test_dump_writes_snapshot_and_counts(tmp_path):
+    d = str(tmp_path)
+    flight.event("step_begin", step=3)
+    before = _counter("paddle_flight_dump_total").get(("manual",), 0)
+    path = flight.dump("manual", dir_path=d)
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["cause"] == "manual"
+    assert doc["events"][-1]["ev"] == "step_begin"
+    after = _counter("paddle_flight_dump_total").get(("manual",), 0)
+    assert after == before + 1
+    # no flight dir configured and no dir given -> no dump, no raise
+    assert flight.dump("manual") is None or os.environ.get(flight.ENV_DIR)
+
+
+def test_note_blame_gauges():
+    flight.note_blame(3, skew_ms=12.5)
+    assert _gauge("paddle_blamed_rank") == 3
+    assert _gauge("paddle_step_skew_ms") == 12.5
+    flight.note_blame(None)
+    assert _gauge("paddle_blamed_rank") == -1
+
+
+# ---------------------------------------------------------------------------
+# Blame engine (synthetic multi-rank files)
+# ---------------------------------------------------------------------------
+
+MS = 1_000_000   # ns
+
+
+def _write_rank(d, rank, events, attempt=0, ts0=1000.0, pid=None):
+    """Synthetic per-rank sidecar: meta anchor at (t_ns=0, ts=ts0), so a
+    wall time is ts0 + t_ns/1e9 — cross-rank skew is driven purely by
+    the event t_ns offsets."""
+    pid = pid or (4000 + 10 * attempt + rank)
+    path = os.path.join(str(d), f"flight-rank{rank}-{pid}.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"ev": "meta", "t_ns": 0, "ts": ts0,
+                            "rank": rank, "pid": pid,
+                            "attempt": attempt}) + "\n")
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+def _steps_through(n_colls, t0=0, name="allreduce_grads", skew_ns=0):
+    """step_begin + matched coll enter/exit per step, one collective per
+    step, seqs 1..n_colls."""
+    evs = []
+    for i in range(1, n_colls + 1):
+        base = t0 + (i - 1) * 10 * MS + skew_ns
+        evs.append({"ev": "step_begin", "t_ns": base, "step": i})
+        evs.append({"ev": "coll_enter", "t_ns": base + 1 * MS,
+                    "seq": i, "name": name, "bytes": 1024})
+        evs.append({"ev": "coll_exit", "t_ns": base + 2 * MS, "seq": i})
+        evs.append({"ev": "step_end", "t_ns": base + 3 * MS, "step": i})
+    return evs
+
+
+def test_blame_dead_rank_never_entered(tmp_path):
+    # rank 1 freezes after step_begin 3 (never enters seq 3); rank 0
+    # enters seq 3 and wedges inside it, 40ms behind on nothing
+    r0 = _steps_through(2)
+    r0 += [{"ev": "step_begin", "t_ns": 20 * MS, "step": 3},
+           {"ev": "coll_enter", "t_ns": 21 * MS, "seq": 3,
+            "name": "allreduce_grads", "bytes": 1024}]
+    r1 = _steps_through(2)
+    r1 += [{"ev": "step_begin", "t_ns": 60 * MS, "step": 3}]
+    _write_rank(tmp_path, 0, r0)
+    _write_rank(tmp_path, 1, r1)
+
+    report = fa.assemble_dir(str(tmp_path))
+    v = report["verdict"]
+    assert v["n_ranks"] == 2
+    assert v["last_common_seq"] == 2
+    assert v["frontier_seq"] == 3
+    assert v["blamed_ranks"] == [1]
+    assert v["blame_mode"] == "never_entered"
+    assert v["missed_seq"] == 3
+    assert v["missed_name"] == "allreduce_grads"
+    assert v["seq_gaps_total"] == 0
+    # the frozen rank's quiet tail is compute; the wedged peer's is comm
+    assert v["per_rank"]["1"]["stall"] == "compute"
+    assert v["per_rank"]["0"]["stall"] == "comm"
+    assert v["per_rank"]["0"]["goodput_category"] == "device_wait"
+    # step-skew timeline: step 3 began 40ms later on rank 1
+    last = v["step_skew_timeline"][-1]
+    assert last["step"] == 3 and last["slowest"] == 1
+    assert last["skew_ms"] == pytest.approx(40.0, abs=1.0)
+    assert v["step_skew_ms"] == pytest.approx(40.0, abs=1.0)
+
+
+def test_blame_stuck_inside_the_exchange(tmp_path):
+    # both ranks enter seq 3; rank 0 exits, rank 1 dies mid-exchange
+    r0 = _steps_through(3)
+    r1 = _steps_through(2)
+    r1 += [{"ev": "step_begin", "t_ns": 20 * MS, "step": 3},
+           {"ev": "coll_enter", "t_ns": 21 * MS, "seq": 3,
+            "name": "allreduce_grads", "bytes": 1024}]
+    _write_rank(tmp_path, 0, r0)
+    _write_rank(tmp_path, 1, r1)
+
+    v = fa.assemble_dir(str(tmp_path))["verdict"]
+    assert v["blamed_ranks"] == [1]
+    assert v["blame_mode"] == "stuck_inside"
+    assert v["missed_seq"] == 3
+    assert v["per_rank"]["1"]["in_flight"] == [3]
+
+
+def test_blame_clean_gang_blames_nobody(tmp_path):
+    _write_rank(tmp_path, 0, _steps_through(4))
+    _write_rank(tmp_path, 1, _steps_through(4, skew_ns=2 * MS))
+    v = fa.assemble_dir(str(tmp_path))["verdict"]
+    assert v["blamed_ranks"] == []
+    assert v["blame_mode"] is None
+    assert v["last_common_seq"] == v["frontier_seq"] == 4
+    assert v["seq_gaps_total"] == 0
+    assert v["step_skew_ms"] == pytest.approx(2.0, abs=0.5)
+
+
+def test_seq_gap_detection(tmp_path):
+    evs = [{"ev": "coll_enter", "t_ns": 1 * MS, "seq": 1, "name": "a"},
+           {"ev": "coll_exit", "t_ns": 2 * MS, "seq": 1},
+           {"ev": "coll_enter", "t_ns": 3 * MS, "seq": 3, "name": "a"}]
+    _write_rank(tmp_path, 0, evs)
+    v = fa.assemble_dir(str(tmp_path))["verdict"]
+    assert v["per_rank"]["0"]["gaps"] == [2]
+    assert v["seq_gaps_total"] == 1
+
+
+def test_stall_taxonomy_feeds_goodput_categories(tmp_path):
+    cases = {
+        0: ({"ev": "data_wait", "t_ns": 5 * MS, "dur_ns": MS},
+            "data_wait", "input_stall"),
+        1: ({"ev": "ckpt_write", "t_ns": 5 * MS, "dur_ns": MS},
+            "checkpoint", "checkpoint_save"),
+        2: ({"ev": "stream_fetch", "t_ns": 5 * MS, "dur_ns": MS},
+            "data_wait", "input_stall"),
+    }
+    for rank, (last, _, _) in cases.items():
+        _write_rank(tmp_path, rank, _steps_through(1) + [last])
+    v = fa.assemble_dir(str(tmp_path))["verdict"]
+    for rank, (_, stall, cat) in cases.items():
+        assert v["per_rank"][str(rank)]["stall"] == stall
+        assert v["per_rank"][str(rank)]["goodput_category"] == cat
+
+
+def test_rank_goodput_breakdown():
+    evs = [
+        {"ev": "step_begin", "t_ns": 0, "step": 1},
+        {"ev": "data_wait", "t_ns": 1 * MS, "dur_ns": 500 * MS},
+        {"ev": "coll_enter", "t_ns": 600 * MS, "seq": 1, "name": "a"},
+        {"ev": "coll_exit", "t_ns": 800 * MS, "seq": 1},
+        {"ev": "ckpt_write", "t_ns": 900 * MS, "dur_ns": 250 * MS},
+        {"ev": "step_end", "t_ns": 2000 * MS, "step": 1},
+    ]
+    g = fa.rank_goodput(evs)
+    assert g["input_stall"] == pytest.approx(0.5)
+    assert g["device_wait"] == pytest.approx(0.2)
+    assert g["checkpoint_save"] == pytest.approx(0.25)
+    assert g["step_total"] == pytest.approx(2.0)
+    assert g["productive_step"] == pytest.approx(2.0 - 0.95)
+
+
+def test_lowered_stream_divergence(tmp_path):
+    lower = [{"ev": "coll_lowered", "t_ns": MS, "lseq": 1,
+              "op": "allreduce", "dtype": "float32", "bytes": 64,
+              "ranks": 8, "site": "psum_loss"}]
+    differ = [dict(lower[0], op="all_gather")]
+    _write_rank(tmp_path, 0, lower + _steps_through(1))
+    _write_rank(tmp_path, 1, differ + _steps_through(1))
+    v = fa.assemble_dir(str(tmp_path))["verdict"]
+    assert v["divergent_ranks"] in ([0], [1])   # one of them disagrees
+
+
+def test_assemble_selects_attempt(tmp_path):
+    _write_rank(tmp_path, 0, _steps_through(2), attempt=0)
+    _write_rank(tmp_path, 1, _steps_through(3), attempt=0)
+    _write_rank(tmp_path, 0, _steps_through(5), attempt=1)
+    report = fa.assemble_dir(str(tmp_path))          # default: latest
+    assert report["attempt"] == 1
+    assert report["verdict"]["n_ranks"] == 1
+    report0 = fa.assemble_dir(str(tmp_path), attempt=0)
+    assert report0["verdict"]["n_ranks"] == 2
+    assert report0["verdict"]["blamed_ranks"] == [0]  # trails at seq 2
+    assert set(report["attempts"]) == {"0", "1"}
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge policy + lint satellite
+# ---------------------------------------------------------------------------
+
+def test_prom_merge_policy_for_flight_families():
+    from paddle_tpu.observability import prom
+
+    assert prom.GAUGE_MERGE_POLICY["paddle_step_skew_ms"] == "max"
+    assert prom.GAUGE_MERGE_POLICY["paddle_blamed_rank"] == "max"
+    a = ("# HELP paddle_step_skew_ms s\n"
+         "# TYPE paddle_step_skew_ms gauge\n"
+         "paddle_step_skew_ms 40\n"
+         "# HELP paddle_flight_dump_total d\n"
+         "# TYPE paddle_flight_dump_total counter\n"
+         'paddle_flight_dump_total{cause="hang"} 1\n')
+    b = ("# HELP paddle_step_skew_ms s\n"
+         "# TYPE paddle_step_skew_ms gauge\n"
+         "paddle_step_skew_ms 10\n"
+         "# HELP paddle_flight_dump_total d\n"
+         "# TYPE paddle_flight_dump_total counter\n"
+         'paddle_flight_dump_total{cause="hang"} 2\n')
+    merged = prom.merge_expositions([a, b])
+    assert "paddle_step_skew_ms 40\n" in merged          # max, not 50
+    assert 'paddle_flight_dump_total{cause="hang"} 3' in merged  # sum
+
+
+def test_lint_flight_stamps_clean_and_dirty(tmp_path):
+    pl = _load_tool("paddle_lint")
+    # the repo's own lowering files must be fully stamped
+    assert pl.check_flight_stamps() == []
+    # an unstamped raw collective must fire
+    bad = tmp_path / "bad_lowering.py"
+    bad.write_text(
+        "from jax import lax\n"
+        "def bad(x, ax):\n"
+        "    return lax.psum(x, ax)\n"
+        "def good(x, ax):\n"
+        "    _record('allreduce', x, ax, site='good')\n"
+        "    return lax.psum(x, ax)\n")
+    findings = pl.check_flight_stamps([str(bad)])
+    assert len(findings) == 1
+    assert findings[0]["function"] == "bad"
+    assert findings[0]["raw_collectives"] == ["psum"]

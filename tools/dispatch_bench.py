@@ -130,6 +130,16 @@ def main():
     tracing_on_s, notracing_s = ab(obs_spans.set_tracing_enabled)
     tracing_overhead_pct = (tracing_on_s - notracing_s) / notracing_s * 100.0
 
+    # flight-recorder A/B (ISSUE 19): with the recorder on (the default)
+    # the fast path appends one "dispatch" event per step to the bounded
+    # ring (no sidecar attached here — the bench measures the ring, the
+    # steady-state cost every rank pays); the on/off delta must stay
+    # inside the same <5% gate
+    from paddle_tpu.observability import flight as obs_flight
+
+    flight_on_s, noflight_s = ab(obs_flight.set_flight_enabled)
+    flight_overhead_pct = (flight_on_s - noflight_s) / noflight_s * 100.0
+
     # hang-watchdog A/B (ISSUE 8, docs/health.md): same steady-state loop
     # with a watchdog armed — the per-step progress stamp (one tuple store)
     # must stay inside the same <5% fast-path gate as the metrics registry
@@ -197,6 +207,10 @@ def main():
           f"(tracing on {tracing_on_s * 1e6:.1f} us vs "
           f"off {notracing_s * 1e6:.1f} us, alternating arms; "
           f"target < 5%)")
+    print(f"flight recorder overhead:  {flight_overhead_pct:+.2f}% "
+          f"(recording {flight_on_s * 1e6:.1f} us vs "
+          f"off {noflight_s * 1e6:.1f} us, alternating arms; "
+          f"target < 5%)")
     print(f"hang-watchdog overhead:    {watchdog_overhead_pct:+.2f}% "
           f"(armed {watchdog_s * 1e6:.1f} us vs "
           f"{fast_s * 1e6:.1f} us unarmed; target < 5%)")
@@ -218,6 +232,9 @@ def main():
         "fast_tracing_us_per_step": round(tracing_on_s * 1e6, 2),
         "fast_notracing_us_per_step": round(notracing_s * 1e6, 2),
         "tracing_overhead_pct": round(tracing_overhead_pct, 2),
+        "fast_flight_us_per_step": round(flight_on_s * 1e6, 2),
+        "fast_noflight_us_per_step": round(noflight_s * 1e6, 2),
+        "flight_overhead_pct": round(flight_overhead_pct, 2),
         "fast_watchdog_us_per_step": round(watchdog_s * 1e6, 2),
         "watchdog_overhead_pct": round(watchdog_overhead_pct, 2),
     }

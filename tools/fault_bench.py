@@ -34,6 +34,13 @@ config for the tier-1 lane):
                     deadline, dumps all-thread stacks, exits with the
                     distinct hang code; the supervisor restarts with
                     cause=hang and the rerun resumes -> bit-exact
+  sigstop_blame   * 2-rank gang lock-stepped through a flight-seq-stamped
+                    barrier (the stand-in grad allreduce); rank 1
+                    SIGSTOPs itself mid-step, rank 0 wedges in the
+                    collective, its watchdog fires, and the supervisor's
+                    blame pass (tools/flight_assemble.py) must name
+                    rank 1 + the exact seq it missed, with zero sequence
+                    gaps in the surviving flight files (ISSUE 19)
   poison_batch    * one dp rank's shard of one batch is NaN; the in-jit
                     guardrail skips the step IDENTICALLY on all 8 dp ranks
                     (per-rank skip flags asserted) -> final weights
@@ -128,12 +135,37 @@ def _moment_leaf_crcs(mvec, layout, repl):
     return out
 
 
+def _gang_barrier(barrier_dir, attempt, step, rank, trainers,
+                  timeout_s=300.0):
+    """File-based per-step gang barrier.  The CPU gang's ranks train
+    independently (no cross-process collectives), so this stands in for
+    the blocking gradient allreduce: each rank drops an attempt-prefixed
+    marker and spin-waits for the full gang.  A SIGSTOPped peer never
+    writes its marker, so the healthy ranks stall here exactly like a
+    real wedged collective — their progress stamps stop, the watchdog
+    fires, and the flight recorder's ``coll_enter`` without a matching
+    exit is what the blame engine reads."""
+    os.makedirs(barrier_dir, exist_ok=True)
+    mine = os.path.join(barrier_dir, f"a{attempt}.s{step}.r{rank}")
+    with open(mine, "w") as f:
+        f.write(str(os.getpid()))
+    deadline = time.time() + timeout_s
+    want = [os.path.join(barrier_dir, f"a{attempt}.s{step}.r{r}")
+            for r in range(trainers)]
+    while time.time() < deadline:
+        if all(os.path.exists(p) for p in want):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"gang barrier timed out at step {step}")
+
+
 def worker(args):
     _force_cpu_mesh()
     import numpy as np  # noqa: F401
     import jax
 
     from paddle_tpu.models import gpt as G
+    from paddle_tpu.observability import flight
     from paddle_tpu.observability import goodput
     from paddle_tpu.parallel import health
     from paddle_tpu.parallel import parallelize as PZ
@@ -263,18 +295,24 @@ def worker(args):
         return (np.stack([r[0] for r in recs])[None],
                 np.stack([r[1] for r in recs])[None])
 
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", 0))
     with open(os.path.join(ckpt_dir, "incarnations.jsonl"), "a") as f:
         f.write(json.dumps({
             "pid": os.getpid(), "start_step": start,
             "restored_from": restored_from,
             "reshard_bit_exact": reshard_bit_exact,
-            "attempt": int(os.environ.get("PADDLE_RESTART_ATTEMPT", 0)),
+            "attempt": attempt,
         }) + "\n")
 
     # in-run health (docs/health.md): the watchdog arms only now — init +
     # the first-step compile are behind us (the engine suspends its own
     # AOT compiles, this keeps the deadline honest for everything else)
     health.maybe_install_from_env()
+    # flight recorder (ISSUE 19): per-rank event ring + jsonl sidecar
+    # under PADDLE_FLIGHT_DIR (exported by the supervisor); every gang
+    # barrier below is seq-stamped so tools/flight_assemble.py can name
+    # the rank that missed a collective
+    flight.maybe_attach_from_env()
     hb_dir = os.environ.get(health.ENV_DIR)
     heartbeat = (health.RankHeartbeat(hb_dir, rank,
                                       min_write_interval_s=0.2)
@@ -314,6 +352,7 @@ def worker(args):
             ck.close()
             _export_goodput(exit="preempt", final_step=step - 1)
             sys.exit(0)
+        flight.event("step_begin", step=step)
         if args.straggle_ms and rank == args.straggle_rank:
             time.sleep(args.straggle_ms / 1000.0)
         toks, labs = (next_stream_batch() if stream is not None
@@ -321,6 +360,26 @@ def worker(args):
         fn = (bad_step_fn if injecting and step >= args.diverge_at
               else step_fn)
         params, opt, loss, _ = fn(params, opt, toks, labs)
+        if args.gang_barrier:
+            if args.sigstop_at and step == args.sigstop_at \
+                    and rank == args.sigstop_rank and args.once_marker \
+                    and not os.path.exists(args.once_marker):
+                # first incarnation only: freeze this rank BEFORE it
+                # enters the step's collective — its flight file stops at
+                # seq N while the peers stamp coll_enter for seq N+1 and
+                # wedge; the blame engine must name this exact rank and
+                # the seq it missed.  SIGSTOP also freezes our own
+                # watchdog thread: it is a healthy PEER's watchdog that
+                # fires, which is the interesting (real-fleet) case.
+                with open(args.once_marker, "w") as f:
+                    f.write(str(os.getpid()))
+                _log(f"rank {rank} SIGSTOP before barrier of step {step} "
+                     f"(peers must wedge; their watchdog fires)")
+                os.kill(os.getpid(), signal.SIGSTOP)
+            seq = flight.collective_enter("allreduce_grads",
+                                          nbytes=8 * trainers)
+            _gang_barrier(args.gang_barrier, attempt, step, rank, trainers)
+            flight.collective_exit(seq, "allreduce_grads")
         if heartbeat is not None:
             heartbeat.beat(step)
         verdict = "ok"
@@ -372,6 +431,7 @@ def worker(args):
             # never checkpoint a step the guard judged bad — a rollback
             # must always find a pre-divergence target
             save(step)
+        flight.event("step_end", step=step)
 
     final_loss = float(loss) if loss is not None else None
     result = {
@@ -749,6 +809,64 @@ def harness(smoke, out_path):
     _log(f"hang: {s['pass']} (restarts cause=hang {s['hang_restarts']}, "
          f"{len(dumps)} stack dumps, {s['match_baseline']})")
 
+    # --- SIGSTOP blame: flight recorder names the frozen rank ------------
+    # a 2-rank gang lock-steps through a per-step barrier (the stand-in
+    # for the blocking grad allreduce, flight-seq-stamped); rank 1
+    # SIGSTOPs itself just before step 3's barrier, rank 0 wedges inside
+    # it, rank 0's watchdog fires (cause=hang), and the supervisor's
+    # blame pass must name rank 1 + the exact missed seq, with zero
+    # sequence gaps in the surviving flight files (ISSUE 19 gate)
+    fl_health = os.path.join(work, "sigstop_health")
+    sigstop_at, sigstop_rank = 3, 1
+    ns = run("sigstop_blame", dp=1, layers=1, batch=2, seqlen=8,
+             steps=5, interval=100,
+             gang_barrier=os.path.join(work, "sigstop_barrier"),
+             sigstop_at=sigstop_at, sigstop_rank=sigstop_rank,
+             once_marker=os.path.join(work, "sigstop.marker"))
+    causes_before = _restart_causes()
+    rc, _res = _run_job(ns, max_restarts=2,
+                        launch_kw=dict(nproc_per_node=2,
+                                       hang_deadline_s=4.0,
+                                       health_dir=fl_health))
+    causes_after = _restart_causes()
+    flight_dir = os.path.join(fl_health, "flight")
+    blame_path = os.path.join(flight_dir, "blame.attempt0.json")
+    verdict = {}
+    if os.path.exists(blame_path):
+        with open(blame_path) as f:
+            verdict = json.load(f).get("verdict") or {}
+    from paddle_tpu.observability import default_registry
+    snap = default_registry().snapshot()
+    blamed_gauge = next((sr["value"] for sr in
+                         snap.get("paddle_blamed_rank", {})
+                         .get("series", [])), None)
+    s = {
+        "rc": rc,
+        "hang_restarts": causes_after.get("hang", 0)
+            - causes_before.get("hang", 0),
+        "blame_report": blame_path if os.path.exists(blame_path) else None,
+        "blamed_ranks": verdict.get("blamed_ranks"),
+        "blame_mode": verdict.get("blame_mode"),
+        "missed_seq": verdict.get("missed_seq"),
+        "missed_name": verdict.get("missed_name"),
+        "expected_missed_seq": sigstop_at,
+        "seq_gaps_total": verdict.get("seq_gaps_total"),
+        "step_skew_ms": verdict.get("step_skew_ms"),
+        "paddle_blamed_rank": blamed_gauge,
+    }
+    s["pass"] = (rc == 0 and s["hang_restarts"] >= 1
+                 and s["blamed_ranks"] == [sigstop_rank]
+                 and s["blame_mode"] == "never_entered"
+                 and s["missed_seq"] == sigstop_at
+                 and s["missed_name"] == "allreduce_grads"
+                 and s["seq_gaps_total"] == 0
+                 and blamed_gauge == sigstop_rank)
+    scenarios["sigstop_blame"] = s
+    ok &= s["pass"]
+    _log(f"sigstop_blame: {s['pass']} (blamed {s['blamed_ranks']} "
+         f"{s['blame_mode']} missed seq {s['missed_seq']} "
+         f"[{s['missed_name']}], gaps {s['seq_gaps_total']})")
+
     # --- poison batch: in-jit guardrail, dp-identical skip, bit-exact ----
     s = poison_batch_scenario(poison_at=2 if smoke else 3)
     scenarios["poison_batch"] = s
@@ -998,6 +1116,17 @@ def main():
     ap.add_argument("--straggle-ms", type=int, default=0,
                     help="per-step sleep applied on --straggle-rank")
     ap.add_argument("--straggle-rank", type=int, default=1)
+    # flight-recorder blame lane (ISSUE 19, docs/health.md)
+    ap.add_argument("--gang-barrier",
+                    help="dir for the file-based per-step gang barrier "
+                         "(stands in for the blocking grad allreduce; "
+                         "each pass is flight-seq-stamped)")
+    ap.add_argument("--sigstop-at", type=int, default=0,
+                    help="SIGSTOP --sigstop-rank just before this step's "
+                         "barrier, first incarnation only — the peers' "
+                         "watchdog must fire and the blame engine must "
+                         "name the stopped rank + missed seq")
+    ap.add_argument("--sigstop-rank", type=int, default=1)
     ap.add_argument("--diverge-at", type=int, default=0,
                     help="from this step, use a huge-lr step (injected "
                          "divergence) until the guard rolls back")

@@ -29,6 +29,14 @@ The report (schema in docs/observability.md "Goodput & tracing"):
     ...
   }
 
+``--by-rank --flight-dir DIR`` derives a per-rank category breakdown
+straight from the flight-recorder sidecars (ISSUE 19,
+``observability/flight.py``): explicit data_wait/ckpt_write/stream_fetch
+durations, matched coll_enter->coll_exit comm time, and the step residue
+as productive time — the same taxonomy the blame engine's stall
+classification feeds, so "rank 3 spent 40% of its steps in device_wait"
+and "rank 3 is blamed for the hang" read off one ledger.
+
 Exit status: 1 when no rank ever reported, or when the merged ledger
 leaves more than --max-unaccounted (default 5%) of wall-clock in
 ``other`` — an instrumentation gap, not a measurement.
@@ -65,6 +73,44 @@ def diff_reports(path_a: str, path_b: str, tol_rel: float,
     return 0 if out["ok"] else 1
 
 
+def by_rank_report(flight_dir: str, attempt, out_path) -> int:
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "flight_assemble",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "flight_assemble.py"))
+    fa = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fa)
+
+    grouped = fa.group_attempts(fa.load_flight_files(flight_dir))
+    if not grouped:
+        print(f"[goodput_report] no flight-*.jsonl under {flight_dir}",
+              file=sys.stderr)
+        return 1
+    if attempt is None:
+        attempt = max(grouped)
+    per_rank = grouped.get(attempt) or {}
+    cats = ("productive_step", "input_stall", "device_wait",
+            "checkpoint_save")
+    rows = {r: fa.rank_goodput(info["events"])
+            for r, info in sorted(per_rank.items())}
+    print(f"{'rank':<6}{'steps_s':>9}" + "".join(f"{c:>17}" for c in cats))
+    for r, g in rows.items():
+        tot = g.get("step_total") or 0.0
+        print(f"{r:<6}{tot:>9.3f}" + "".join(
+            f"{g[c]:>10.3f} {g[c] / tot if tot else 0.0:>5.1%}"
+            for c in cats))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"flight_dir": os.path.abspath(flight_dir),
+                       "attempt": attempt,
+                       "by_rank": {str(r): g for r, g in rows.items()}},
+                      f, indent=1)
+        print(f"[goodput_report] wrote {out_path}", file=sys.stderr)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
@@ -84,11 +130,22 @@ def main():
     ap.add_argument("--nranks", type=int, default=None)
     ap.add_argument("--max-unaccounted", type=float, default=0.05,
                     help="fail when other/total exceeds this fraction")
+    ap.add_argument("--by-rank", action="store_true",
+                    help="per-rank category breakdown from the flight "
+                         "recorder sidecars (needs --flight-dir)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="gang flight dir holding flight-*.jsonl")
+    ap.add_argument("--attempt", type=int, default=None,
+                    help="--by-rank: restart attempt (default: latest)")
     args = ap.parse_args()
 
     if args.diff:
         return diff_reports(args.diff[0], args.diff[1], args.tol_rel,
                             args.tol_abs)
+    if args.by_rank:
+        if not args.flight_dir:
+            ap.error("--by-rank needs --flight-dir DIR")
+        return by_rank_report(args.flight_dir, args.attempt, args.out)
     if not args.dir:
         ap.error("--dir is required (or use --diff A.json B.json)")
 

@@ -9,6 +9,14 @@ Usage:
   python tools/paddle_lint.py --all-models --json     # machine-readable
   python tools/paddle_lint.py --all-models -v         # include INFO findings
 
+``--flight-stamps`` runs a source-level check instead (ISSUE 19): every
+function in ``ops/collective.py`` / ``parallel/comm_opt.py`` that emits
+a raw ``lax`` collective (psum, ppermute, all_gather, psum_scatter,
+all_to_all, ...) must also carry a flight seq stamp — a call to
+``_record`` / ``record_collective`` / ``stamp_collective`` — so no
+collective call site can silently drop out of the flight recorder's
+cross-rank sequence (tools/flight_assemble.py's blame ordinal).
+
 Exit status: non-zero iff any error-severity finding fires (the tier-1
 gate in tests/test_static_analysis.py runs exactly this). Every finding
 also increments ``paddle_lint_findings_total{severity}`` in the
@@ -24,6 +32,62 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+# the raw lax collectives a lowering may emit, and the stamping calls
+# that put a site into the flight recorder's collective sequence
+RAW_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "psum_scatter", "all_to_all",
+})
+STAMP_CALLS = frozenset({
+    "_record", "record_collective", "stamp_collective",
+})
+FLIGHT_STAMP_FILES = (
+    os.path.join("paddle_tpu", "ops", "collective.py"),
+    os.path.join("paddle_tpu", "parallel", "comm_opt.py"),
+)
+
+
+def check_flight_stamps(paths=None):
+    """AST scan: top-level functions (and methods) that call a raw lax
+    collective without a flight seq stamp in scope.  Nested helpers are
+    judged as part of their enclosing top-level function — the stamp
+    discipline is per call site, not per closure."""
+    import ast
+
+    findings = []
+    for rel in (paths or FLIGHT_STAMP_FILES):
+        path = rel if os.path.isabs(rel) else os.path.join(REPO, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        funcs = [n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for cls in (n for n in tree.body if isinstance(n, ast.ClassDef)):
+            funcs += [n for n in cls.body
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))]
+        for fn in funcs:
+            called = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute):
+                        called.add(f.attr)
+                    elif isinstance(f, ast.Name):
+                        called.add(f.id)
+            raw = sorted(called & RAW_COLLECTIVES)
+            if raw and not (called & STAMP_CALLS):
+                findings.append({
+                    "file": os.path.relpath(path, REPO),
+                    "function": fn.name,
+                    "line": fn.lineno,
+                    "raw_collectives": raw,
+                    "message": (f"{fn.name} emits {'/'.join(raw)} without "
+                                f"a flight seq stamp (_record/"
+                                f"record_collective/stamp_collective)"),
+                })
+    return findings
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--all-models", action="store_true",
@@ -35,7 +99,22 @@ def main(argv=None) -> int:
                     help="emit findings as JSON instead of text")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="include info-severity findings in text output")
+    ap.add_argument("--flight-stamps", action="store_true",
+                    help="source-level check: raw lax collectives in the "
+                         "lowering files must carry a flight seq stamp")
     args = ap.parse_args(argv)
+
+    if args.flight_stamps:
+        findings = check_flight_stamps()
+        if args.json:
+            print(json.dumps({"flight_stamps": findings}, indent=1))
+        else:
+            for f in findings:
+                print(f"ERROR {f['file']}:{f['line']} {f['message']}")
+            print(f"[paddle_lint] flight-stamp check: "
+                  f"{len(findings)} unstamped collective site(s) in "
+                  f"{', '.join(FLIGHT_STAMP_FILES)}")
+        return 1 if findings else 0
 
     from paddle_tpu import analysis
 

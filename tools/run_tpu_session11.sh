@@ -1,0 +1,74 @@
+#!/bin/bash
+# Chip session 11: training-gang flight recorder + blame engine on-chip
+# (ISSUE 19) — after session 10 (fleet tracing/SLO, which chains 5..9;
+# run order is enforced by markers).
+#
+# One relay claim end-to-end; never SIGKILL a step (axon relay rules).
+# Run detached: setsid nohup bash tools/run_tpu_session11.sh > tpu_s11.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+if [ ! -f .tpu_s10_done ]; then
+  echo "=== [0/4] session 10 (fleet/SLO lanes) still queued — running it first ==="
+  bash tools/run_tpu_session10.sh
+fi
+
+echo "=== [1/4] dispatch bench: flight-recorder overhead A/B on-chip $(date -u +%H:%M:%S) ==="
+# the flight ring now rides every fast-path dispatch; the alternating-arm
+# A/B (flight_overhead_pct) must hold the <5% bar on real-chip step
+# times, alongside the metrics/tracing/watchdog arms from prior sessions
+python tools/dispatch_bench.py --out DISPATCH_BENCH_tpu_s11.json
+echo "=== dispatch bench rc=$? ==="
+
+echo "=== [2/4] flight-stamp lint + tier-1 flight/blame tests $(date -u +%H:%M:%S) ==="
+# static half of the ISSUE 19 contract: every raw lax collective in the
+# lowering files carries a flight seq stamp, so no call site can drop
+# out of the cross-rank blame ordinal
+python tools/paddle_lint.py --flight-stamps
+echo "=== flight-stamp lint rc=$? ==="
+python -m pytest tests/test_flight_blame.py -q -p no:cacheprovider
+echo "=== flight/blame tests rc=$? ==="
+
+echo "=== [3/4] fault bench: SIGSTOP blame gang lane $(date -u +%H:%M:%S) ==="
+# the gang lane stays CPU-pinned on-chip (unpinned jax TPU processes
+# claim every local chip — session 8's caveat), but it is exactly the
+# multi-PROCESS half of ISSUE 19: a 2-rank gang lock-steps through a
+# flight-stamped barrier, rank 1 SIGSTOPs itself, rank 0's watchdog
+# fires, and the supervisor's blame pass must name rank 1 + the exact
+# missed collective seq with zero sequence gaps (sigstop_blame in
+# FAULT_BENCH_s11.json)
+JAX_PLATFORMS=cpu python tools/fault_bench.py --smoke \
+  --out FAULT_BENCH_s11.json
+echo "=== fault_bench rc=$? ==="
+# capture the assembled blame verdict + per-rank flight goodput from the
+# bench's gang run dir (best-effort: dirs are under the bench tmp)
+for d in /tmp/fault_bench_*/sigstop_health/flight; do
+  if [ -d "$d" ]; then
+    python tools/flight_assemble.py "$d" --attempt 0 \
+      --out BLAME_s11.json --require-blame
+    echo "=== flight_assemble($d) rc=$? ==="
+    JAX_PLATFORMS=cpu python tools/goodput_report.py --by-rank \
+      --flight-dir "$d" --out GOODPUT_BY_RANK_s11.json
+    echo "=== goodput --by-rank($d) rc=$? ==="
+  fi
+done
+
+echo "=== [4/4] train-loop span + flight capture on-chip $(date -u +%H:%M:%S) ==="
+# the executor's per-step train/step span tree + flight sidecar, armed
+# purely by env, over metrics_check's real train_from_dataset runs; the
+# sidecars + spans land in /tmp/flight_s11 for assembly
+rm -rf /tmp/flight_s11 && mkdir -p /tmp/flight_s11
+PADDLE_FLIGHT_DIR=/tmp/flight_s11 python tools/metrics_check.py \
+  --out /tmp/metrics_check_tpu_s11
+echo "=== metrics_check (flight-armed) rc=$? ==="
+if ls /tmp/flight_s11/spans-train*.jsonl >/dev/null 2>&1; then
+  python tools/trace_assemble.py /tmp/flight_s11 \
+    --out TRACES_train_s11.json \
+    --chrome TRACE_TRAIN_s11.chrome.json
+  echo "=== trace_assemble(train spans) rc=$? ==="
+fi
+python tools/flight_assemble.py /tmp/flight_s11 \
+  --out BLAME_train_s11.json || true
+echo "=== flight_assemble(train run) rc=$? ==="
+
+date -u > .tpu_s11_done
