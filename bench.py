@@ -13,6 +13,13 @@ plugin dials out at import). The launcher therefore never imports jax itself;
 it probes the accelerator in a subprocess under a timeout and falls back to a
 CPU run marked ``"degraded": true`` so a JSON line is always produced within
 the time budget. Progress streams to stderr throughout.
+
+``--tuned=TUNED.json`` applies the autotuner's winning train config
+(tools/autotune.py, docs/autotune.md): model-side knobs (remat policy,
+fused_ln, CE vocab chunk) scale the bench config, step-side knobs
+(grad reduction, wire dtype, bucket cap, fused optimizer) ride
+``make_train_step(tuned=)``. Fingerprint-gated; explicit flags
+(--remat=, --ce-vchunk=) beat the tuner.
 """
 import json
 import os
@@ -259,12 +266,14 @@ def resnet_worker():
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet as R
 
-    dev = jax.devices()[0]
-    on_acc = dev.platform != "cpu"
+    from paddle_tpu.tuning.probe import device_info
+
+    di = device_info()
+    dev, on_acc = di.device, di.on_acc
     batch = 128 if on_acc else 2
     hw = 224 if on_acc else 32
     steps = 8 if on_acc else 2
-    _log(f"resnet worker: device {dev.platform} batch={batch}")
+    _log(f"resnet worker: device {di.platform} batch={batch}")
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -328,8 +337,10 @@ def ernie_worker():
 
     from paddle_tpu.models import ernie as E
 
-    dev = jax.devices()[0]
-    on_acc = dev.platform != "cpu"
+    from paddle_tpu.tuning.probe import device_info
+
+    di = device_info()
+    dev, on_acc = di.device, di.on_acc
     # remat off on-chip: ERNIE-base's optimizer state is only ~1 GB, so the
     # full-remat forward replay (~1/4 of step FLOPs) buys nothing — but the
     # saved activations are ~170 MB/layer per 8 samples, so batch sizes the
@@ -340,7 +351,7 @@ def ernie_worker():
     # HBM — an OOM crash here is a relay-wedge risk for the rest of the
     # session, not just a lost side lane
     batch, T, steps = (48, 512, 10) if on_acc else (4, 64, 2)
-    _log(f"ernie worker: device {dev.platform} batch={batch}")
+    _log(f"ernie worker: device {di.platform} batch={batch}")
 
     params = E.init_params(jax.random.PRNGKey(0), cfg)
     opt = E.init_opt(params)
@@ -400,15 +411,33 @@ def worker(use_flash: bool):
     import numpy as np
     import jax
 
-    dev = jax.devices()[0]
-    on_acc = dev.platform != "cpu"
-    _log(f"worker: device {dev.platform}/{getattr(dev, 'device_kind', '?')}")
+    # one derivation of platform/device_kind/degraded for every lane —
+    # the shared probe harness owns it (paddle_tpu/tuning/probe.py)
+    from paddle_tpu.tuning import probe as tuning_probe
+
+    di = tuning_probe.device_info()
+    dev, on_acc = di.device, di.on_acc
+    _log(f"worker: device {di.platform}/{di.device_kind}"
+         + (" (degraded)" if di.degraded else ""))
 
     from paddle_tpu.models import gpt as G
     from paddle_tpu.parallel import parallelize as PZ
 
     monitor_path = next((a.split("=", 1)[1] for a in sys.argv
                          if a.startswith("--monitor=")), None)
+    # --tuned=TUNED.json: apply the autotuner's winning train config
+    # (tools/autotune.py, docs/autotune.md). Fingerprint-gated — a
+    # document recorded on different hardware warns and the committed
+    # defaults run instead of silently applying foreign knobs.
+    tuned_path = next((a.split("=", 1)[1] for a in sys.argv
+                       if a.startswith("--tuned=")), None)
+    tuned_doc = None
+    if tuned_path:
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        tuned_doc = tuned_mod.load_for_device(tuned_path, di)
+        _log(f"worker: tuned config {'applied' if tuned_doc else 'REFUSED'}"
+             f" from {tuned_path}")
     # --checkpoint-dir=DIR [--checkpoint-interval=N]: periodic crash-safe
     # checkpointing through the elastic store (docs/elastic.md); an existing
     # committed checkpoint resumes the measured run (restored steps are
@@ -451,6 +480,13 @@ def worker(use_flash: bool):
     stream_input = "--stream-input" in sys.argv
     stream_stats = {}
 
+    def _tuned_config_stamp():
+        if tuned_doc is None:
+            return {}
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        return tuned_mod.config_stamp(tuned_doc, tuned_path)
+
     def measure(tag, cfg, batch, T, steps):
         """Compile + run one config; returns (tokens/s, mfu, loss, params).
 
@@ -471,9 +507,11 @@ def worker(use_flash: bool):
         # measured +1.7% MFU (MFU_SWEEP.json r05 session 4)
         params, opt = PZ.init_sharded(
             jax.random.PRNGKey(0), cfg, pcfg, mesh,
-            moment_dtype=jnp.bfloat16 if on_acc else None)
+            moment_dtype=jnp.bfloat16 if on_acc else None,
+            tuned=tuned_doc)
         step = PZ.make_train_step(cfg, pcfg, mesh, lr=1e-4,
-                                  skip_nonfinite=skip_nonfinite)
+                                  skip_nonfinite=skip_nonfinite,
+                                  tuned=tuned_doc)
         rng = np.random.default_rng(0)
         tokens = rng.integers(0, cfg.vocab_size, (1, batch, T),
                               dtype=np.int32)
@@ -646,7 +684,11 @@ def worker(use_flash: bool):
                         "flash": bool(cfg.use_flash),
                         "fused_opt": False, "batch": batch, "seq": T,
                         "d_model": cfg.d_model,
-                        "layers": cfg.num_layers},
+                        "layers": cfg.num_layers,
+                        # full tuned-knob vector + provenance pointer so
+                        # perf_diff cause-attributes a regression to the
+                        # tuner's choice, not "config lever unknown"
+                        **(_tuned_config_stamp())},
                 generated_by="bench.py --profile")
             ATT.write(attribution, profile_path)
             res = attribution["residue"]
@@ -676,6 +718,7 @@ def worker(use_flash: bool):
 
     remat_name = next((a.split("=", 1)[1] for a in sys.argv
                        if a.startswith("--remat=")), None)
+    remat_explicit = remat_name is not None or no_remat
     if remat_name is None:
         remat_name = "none" if no_remat else "dots"
     rpolicy = remat_mod.resolve(remat_name)
@@ -713,6 +756,19 @@ def worker(use_flash: bool):
     if ce_vchunk:
         cfg = cfg.scaled(ce_vocab_chunk=ce_vchunk, ce_direct_bytes_limit=0)
         tag += f"_vchunk{ce_vchunk}"
+    if tuned_doc is not None:
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        ckw = tuned_mod.train_cfg_kwargs(tuned_doc)
+        if remat_explicit:          # an explicit --remat= / --no-remat
+            ckw.pop("remat", None)  # always beats the tuner
+            ckw.pop("remat_policy", None)
+        if ce_vchunk:               # likewise an explicit --ce-vchunk=
+            ckw.pop("ce_vocab_chunk", None)
+            ckw.pop("ce_direct_bytes_limit", None)
+        if ckw:
+            cfg = cfg.scaled(**ckw)
+        tag += "_tuned"
 
     tokens_per_s, mfu, loss_v, n_params = measure(
         tag, cfg, batch, T, steps)
@@ -724,7 +780,7 @@ def worker(use_flash: bool):
         "seq_len": T, "batch": batch, "steps": steps,
         "device": str(getattr(dev, "device_kind", dev.platform)),
         "platform": dev.platform,
-        "remat_policy": rpolicy.name if on_acc else "none",
+        "remat_policy": cfg.remat_policy if cfg.remat else "none",
         "flash": bool(on_acc and use_flash),
         "loss": round(loss_v, 4),
         "tokens_per_s": round(tokens_per_s, 2),
@@ -734,6 +790,10 @@ def worker(use_flash: bool):
         detail["stream_input"] = stream_stats
     if attr_stats:
         detail["attribution"] = attr_stats
+    if tuned_doc is not None:
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        detail["tuned"] = tuned_mod.config_stamp(tuned_doc, tuned_path)
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s, 2),

@@ -8,7 +8,7 @@ lesson: the table briefly held v5e's int8 rate and understated every MFU
 from __future__ import annotations
 
 __all__ = ["peak_bf16_flops", "peak_hbm_bytes_per_s", "ridge_intensity",
-           "program_train_flops"]
+           "hbm_capacity_bytes", "program_train_flops"]
 
 # device_kind substring -> peak bf16 FLOP/s
 PEAK_BF16_FLOPS = {
@@ -24,6 +24,15 @@ PEAK_HBM_BYTES_PER_S = {
     "v6e": 1640e9, "v6 lite": 1640e9, "v5e": 819e9, "v5 lite": 819e9,
     "v5litepod": 819e9, "v5p": 2765e9, "v4": 1228e9, "v3": 900e9,
     "v2": 700e9,
+}
+
+# device_kind substring -> on-chip HBM capacity, bytes (published per-chip
+# figures; the autotuner's over-HBM pruning budget — a candidate whose
+# predicted peak residency exceeds this never runs a probe)
+HBM_CAPACITY_BYTES = {
+    "v6e": 32e9, "v6 lite": 32e9, "v5e": 16e9, "v5 lite": 16e9,
+    "v5litepod": 16e9, "v5p": 95e9, "v4": 32e9, "v3": 32e9,
+    "v2": 16e9,
 }
 
 _FALLBACK_FLOPS = 1e12    # CPU / unknown accelerator
@@ -56,6 +65,21 @@ def peak_hbm_bytes_per_s(device=None) -> float:
         if k in kind:
             return v
     return _FALLBACK_HBM_BPS
+
+
+def hbm_capacity_bytes(device=None):
+    """On-chip HBM capacity in bytes, or ``None`` when the device has no
+    fixed budget in the table (CPU / unknown accelerator — host memory is
+    not the scarce resource the tuner prunes against)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "cpu")).lower()
+    for k, v in HBM_CAPACITY_BYTES.items():
+        if k in kind:
+            return v
+    return None
 
 
 def ridge_intensity(device=None) -> float:

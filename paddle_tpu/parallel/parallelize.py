@@ -711,7 +711,7 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                     error_feedback: bool = False, grad_clip=1.0,
                     comm: Optional[CommConfig] = None,
                     skip_nonfinite: bool = False,
-                    sharding=None):
+                    sharding=None, tuned=None):
     """Build the jitted 4D-parallel training step.
 
     Returns ``step(params, opt_state, tokens, labels) ->
@@ -762,7 +762,25 @@ def make_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     "reduce_scatter"``/quantized wire dtypes runs the existing bucketed
     shard_map path (the plan only supplies the layout contract); plans
     that shard params over dp cannot take that path and raise.
+
+    ``tuned=`` accepts a TUNED.json path (or loaded doc) from
+    tools/autotune.py. Application is fingerprint-gated (a config tuned
+    on different hardware warns and falls back to the kwargs as given)
+    and only overrides knobs left at their documented defaults — an
+    explicit caller choice, or a ready ``comm=`` CommConfig, always
+    wins over the tuner.
     """
+    if tuned is not None and comm is None:
+        kw = _resolve_tuned(tuned, pcfg, dict(
+            grad_reduce=grad_reduce,
+            grad_allreduce_dtype=grad_allreduce_dtype,
+            bucket_mb=bucket_mb, error_feedback=error_feedback,
+            fused_opt=fused_opt))
+        grad_reduce = kw["grad_reduce"]
+        grad_allreduce_dtype = kw["grad_allreduce_dtype"]
+        bucket_mb = kw["bucket_mb"]
+        error_feedback = kw["error_feedback"]
+        fused_opt = kw["fused_opt"]
     ccfg = comm if comm is not None else CommConfig(
         grad_reduce=grad_reduce, comm_dtype=grad_allreduce_dtype,
         bucket_mb=bucket_mb, error_feedback=error_feedback)
@@ -971,11 +989,23 @@ def make_forward(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh):
     return fwd
 
 
+def _resolve_tuned(tuned, pcfg, current):
+    """Fingerprint-gate + apply a TUNED.json onto the caller's step
+    kwargs (paddle_tpu/tuning/tuned.py owns the semantics)."""
+    from ..tuning import tuned as tuned_mod
+
+    doc = tuned_mod.load_for_device(tuned)
+    if doc is None:
+        return current
+    return tuned_mod.resolve_train_step_kwargs(doc, pcfg, current)
+
+
 def init_sharded(key, cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                  moment_dtype=None, fused_opt: bool = False,
                  grad_reduce: str = "psum", bucket_mb: float = 32.0,
                  error_feedback: bool = False, grad_allreduce_dtype=None,
-                 comm: Optional[CommConfig] = None, sharding=None):
+                 comm: Optional[CommConfig] = None, sharding=None,
+                 tuned=None):
     """Initialize params + AdamW state directly with mesh shardings (large
     models never materialize unsharded).
 
@@ -988,7 +1018,22 @@ def init_sharded(key, cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     ``make_train_step``) lays params AND per-leaf AdamW moments out per
     the plan's propagated specs — under ``"fsdp"`` both drop by dp x
     without the flat-buffer layout (comm levers then use the rs path
-    above instead)."""
+    above instead).
+
+    ``tuned=`` mirrors ``make_train_step(tuned=)`` — pass the SAME
+    TUNED.json to both so the optimizer-state layout matches the step
+    the tuner picked."""
+    if tuned is not None and comm is None:
+        kw = _resolve_tuned(tuned, pcfg, dict(
+            grad_reduce=grad_reduce,
+            grad_allreduce_dtype=grad_allreduce_dtype,
+            bucket_mb=bucket_mb, error_feedback=error_feedback,
+            fused_opt=fused_opt))
+        grad_reduce = kw["grad_reduce"]
+        grad_allreduce_dtype = kw["grad_allreduce_dtype"]
+        bucket_mb = kw["bucket_mb"]
+        error_feedback = kw["error_feedback"]
+        fused_opt = kw["fused_opt"]
     if sharding is not None:
         from ..sharding import resolve_plan
 

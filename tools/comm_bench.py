@@ -91,21 +91,31 @@ def run_config(name, kw, cfg, pcfg, mesh, tokens, labels, steps,
     step = PZ.make_train_step(cfg, pcfg, mesh, lr=lr, grad_clip=grad_clip,
                               **kw)
 
-    before = _wire_snapshot()
-    t0 = time.perf_counter()
-    params, opt, loss, gnorm = step(params, opt, tokens, labels)
-    compile_s = time.perf_counter() - t0
-    losses = [float(loss)]
-    # the first call traces exactly once (AOT lower+compile keeps the
-    # executable), so the counter delta across it IS the per-step bytes
-    wire = _wire_delta(before, _wire_snapshot())
+    # one shared warmup/compile/timing loop (paddle_tpu.tuning.probe,
+    # ISSUE 20); per-step-synced — wall time IS step time here. The
+    # after_compile hook snapshots the wire counters across exactly the
+    # first call: it traces exactly once (AOT lower+compile keeps the
+    # executable), so the delta IS the per-step bytes.
+    from paddle_tpu.tuning import probe as tuning_probe
 
-    times = []
-    for _ in range(steps - 1):
-        t0 = time.perf_counter()
-        params, opt, loss, gnorm = step(params, opt, tokens, labels)
-        losses.append(float(loss))  # float() syncs: wall time is step time
-        times.append(time.perf_counter() - t0)
+    state = {"params": params, "opt": opt}
+
+    def _step(i):
+        state["params"], state["opt"], loss, gnorm = step(
+            state["params"], state["opt"], tokens, labels)
+        return loss, gnorm
+
+    wire = {}
+    before = _wire_snapshot()
+    timing = tuning_probe.timed_loop(
+        _step, steps - 1, sync=lambda v: float(v[0]),
+        after_compile=lambda: wire.update(
+            _wire_delta(before, _wire_snapshot())))
+    params, opt = state["params"], state["opt"]
+    compile_s = timing.compile_s
+    losses = [float(v[0]) for v in timing.values]
+    gnorm = timing.values[-1][1]
+    times = timing.step_times_s
 
     overlap = None
     if profile_overlap:

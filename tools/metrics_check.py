@@ -34,7 +34,14 @@ then asserts:
     train moves ``paddle_megakernel_launches_total{kernel="opt_sgd"}``
     by exactly one (trace-time, one launch per param group per
     compile), and a warmed fused-decode engine serves with zero
-    steady-state recompiles and zero post-warmup launch-counter motion.
+    steady-state recompiles and zero post-warmup launch-counter motion;
+  * the measurement-driven autotuner (ISSUE 20, docs/autotune.md): a
+    3-candidate micro train tune executes EXACTLY 2 measured probes
+    (``paddle_autotune_probes_total``), statically prunes a seeded
+    over-HBM candidate without running it
+    (``paddle_autotune_pruned_total{reason="over_hbm"}``), leaves one
+    ``autotune/probe`` span per execution, and a cached resume over the
+    same probe log moves NO counter (probe count conserved).
 
 Wired into tier-1 as tests/test_metrics_check.py (``-m 'not slow'``), so
 the telemetry path is exercised end-to-end on every run. Standalone:
@@ -183,9 +190,15 @@ def _run_check_inner(out_dir: str) -> dict:
             and v >= 0, f"goodput {c}={v!r}"
     assert abs(sum(gp_cats.values()) - gp_window["wall_s"]) \
         <= max(0.01 * gp_window["wall_s"], 2e-3), gp_window
-    assert gp_window["unaccounted_fraction"] < 0.01, \
+    # 1%-relative with a small absolute floor, same discipline as the sum
+    # check above: on a sub-second smoke window 1% is a few ms, below the
+    # scheduler-noise floor of an in-process caller sharing the host with
+    # the rest of the suite
+    gp_unacc_s = gp_window["unaccounted_fraction"] * gp_window["wall_s"]
+    assert gp_unacc_s <= max(0.01 * gp_window["wall_s"], 1e-2), \
         f"goodput ledger left {gp_window['unaccounted_fraction']:.2%} " \
-        f"of wall-clock unaccounted (gate < 1%): {gp_window}"
+        f"({gp_unacc_s * 1e3:.1f} ms) of wall-clock unaccounted " \
+        f"(gate < max(1%, 10ms)): {gp_window}"
     assert gp_window["categories"]["productive_step"] > 0, gp_window
     assert gp_window["categories"]["compile"] >= 0, gp_window
     assert gp_window["categories"]["checkpoint_save"] > 0, gp_window
@@ -1033,6 +1046,109 @@ def _run_check_inner(out_dir: str) -> dict:
     assert slo_st3["ok"] and not slo_st3["alerting"], slo_st3
     assert len(sforensics.files()) == 1, "recovery wrote a second dump"
 
+    # --- autotuner gate (ISSUE 20, docs/autotune.md) --------------------
+    # exact-count discipline on the measurement-driven tuner: a
+    # 3-candidate micro train tune (incumbent + one measured challenger +
+    # one seeded over-HBM candidate) must execute EXACTLY 2 probes
+    # (paddle_autotune_probes_total{phase}), prune the seeded candidate
+    # statically WITHOUT a probe (paddle_autotune_pruned_total
+    # {reason="over_hbm"}, real roofline path against a forced 1-byte
+    # budget), leave one autotune/probe span per execution, and a
+    # SECOND tune over the same probe log must replay from cache with
+    # ZERO counter motion (the resume-conservation contract)
+    from paddle_tpu.tuning import driver as at_driver
+    from paddle_tpu.tuning import probe as at_probe
+    from paddle_tpu.tuning import space as at_space
+    from paddle_tpu.tuning import static_cost as at_static
+
+    def _at_counts(name):
+        s = default_registry().snapshot().get(name, {}).get("series", [])
+        return {tuple(x["labels"])[0]: x["value"] for x in s}
+
+    at_di = at_probe.device_info()
+    at_ctx = at_space.SpaceContext(
+        dp=1, n_devices=at_di.n_devices, platform=at_di.platform,
+        vocab_size=32, max_seq=16, max_batch=2, page_size=8,
+        on_acc=at_di.on_acc)
+    at_inc = at_space.train_incumbent(at_ctx)
+    at_measured = at_inc.replace(remat="full")
+    at_seeded = at_inc.replace(remat="dots")     # statically killed below
+    at_geom = at_probe.TrainProbeGeometry(
+        d_model=16, num_layers=1, num_heads=2, d_ff=32, T=8,
+        vocab_size=32, batch=2)
+    at_hw_tiny = at_static.HwModel(peak_flops=1e12, peak_hbm_bps=50e9,
+                                   hbm_capacity_bytes=1.0, on_acc=False)
+
+    def at_probe_fn(cand, steps, rung):
+        return at_probe.run_train_probe(cand, at_geom, steps, seed=0)
+
+    def at_static_fn(cand, inc_result):
+        if cand.key != at_seeded.key:
+            return None            # the challenger goes to the measured
+        rep = (inc_result or {}).get("report") or {}    # phase unpruned
+        base = at_static.BaseStats(
+            flops=float(rep.get("flops") or 1e6),
+            bytes_accessed=float(rep.get("bytes_accessed") or 1e6),
+            peak_hbm_bytes=float(rep.get("peak_hbm_bytes") or 1e5),
+            param_bytes=float((inc_result or {}).get("params") or 1e3)
+            * 4.0,
+            tokens_per_step=at_geom.batch * at_geom.T,
+            vocab_size=at_geom.vocab_size, incumbent=at_inc)
+        est = at_static.predict_train(cand, base, at_hw_tiny, dp=1)
+        assert est.over_hbm, \
+            f"seeded 1-byte HBM budget did not trip over_hbm: {est}"
+        return est
+
+    at_spans_before = sum(
+        1 for s in ospans.default_tracer().spans()
+        if s["name"] == "autotune/probe")
+    at_probes_before = _at_counts("paddle_autotune_probes_total")
+    at_pruned_before = _at_counts("paddle_autotune_pruned_total")
+    at_log_path = os.path.join(out_dir, "autotune_probes.jsonl")
+    at_log = at_driver.ProbeLog(at_log_path)
+    at_tr = at_driver.tune(
+        space="train", candidates=[at_inc, at_measured, at_seeded],
+        incumbent=at_inc, probe_fn=at_probe_fn, static_fn=at_static_fn,
+        rungs=((1, 1.0),), log=at_log, phase="metrics_check")
+    at_log.close()
+    assert at_tr.probes_executed == 2, \
+        f"3-candidate smoke tune executed {at_tr.probes_executed} " \
+        "probes, expected exactly 2 (incumbent + measured challenger)"
+    assert at_tr.pruned == {"over_hbm": 1}, \
+        f"seeded over-HBM candidate pruned as {at_tr.pruned}, " \
+        "expected exactly {'over_hbm': 1}"
+    at_probes_delta = _at_counts("paddle_autotune_probes_total").get(
+        "metrics_check", 0) - at_probes_before.get("metrics_check", 0)
+    assert at_probes_delta == 2, \
+        f"paddle_autotune_probes_total moved by {at_probes_delta}, " \
+        "expected exactly 2"
+    at_pruned_delta = _at_counts("paddle_autotune_pruned_total").get(
+        "over_hbm", 0) - at_pruned_before.get("over_hbm", 0)
+    assert at_pruned_delta == 1, \
+        f"paddle_autotune_pruned_total{{over_hbm}} moved by " \
+        f"{at_pruned_delta}, expected exactly 1"
+    at_spans_delta = sum(
+        1 for s in ospans.default_tracer().spans()
+        if s["name"] == "autotune/probe") - at_spans_before
+    assert at_spans_delta == 2, \
+        f"{at_spans_delta} autotune/probe spans for 2 executed probes"
+    # resume conservation: same log, same candidates — everything cached
+    at_log2 = at_driver.ProbeLog(at_log_path)
+    at_tr2 = at_driver.tune(
+        space="train", candidates=[at_inc, at_measured, at_seeded],
+        incumbent=at_inc, probe_fn=at_probe_fn, static_fn=at_static_fn,
+        rungs=((1, 1.0),), log=at_log2, phase="metrics_check")
+    at_log2.close()
+    assert at_tr2.probes_executed == 0 and at_tr2.pruned == {}, \
+        (at_tr2.probes_executed, at_tr2.pruned)
+    assert at_tr2.winner.key == at_tr.winner.key, \
+        "resumed tune picked a different winner from cached probes"
+    at_resume_delta = _at_counts("paddle_autotune_probes_total").get(
+        "metrics_check", 0) - at_probes_before.get("metrics_check", 0)
+    assert at_resume_delta == 2, \
+        "cached resume moved paddle_autotune_probes_total — the probe " \
+        "count must be conserved across a resume"
+
     # --- Prometheus exposition (incl. the new compile/memory gauges) ---
     prom_path = os.path.join(out_dir, "metrics.prom")
     prom.write_textfile(prom_path)
@@ -1144,6 +1260,15 @@ def _run_check_inner(out_dir: str) -> dict:
         in prom_text, "opt_sgd megakernel sample missing from exposition"
     assert 'paddle_megakernel_launches_total{kernel="decode_slab"}' \
         in prom_text, "decode_slab megakernel sample missing"
+    # autotune families (docs/autotune.md): the smoke tune above left
+    # exactly-counted probe/prune samples
+    for name in ("paddle_autotune_probes_total",
+                 "paddle_autotune_pruned_total"):
+        assert name in prom_text, f"{name} missing from exposition"
+    assert 'paddle_autotune_probes_total{phase="metrics_check"}' \
+        in prom_text, "autotune probe sample missing from exposition"
+    assert 'paddle_autotune_pruned_total{reason="over_hbm"}' \
+        in prom_text, "over_hbm prune sample missing from exposition"
     # goodput families (docs/observability.md): every category present
     for c in goodput.CATEGORIES:
         assert f'paddle_goodput_seconds_total{{category="{c}"}}' \
@@ -1175,6 +1300,12 @@ def _run_check_inner(out_dir: str) -> dict:
                 for k, v in mk_after.items()},
             "fused_decode_steady_state_recompiles":
                 int(fused_decode_recompiles),
+            "autotune": {
+                "probes_executed": int(at_tr.probes_executed),
+                "pruned": dict(at_tr.pruned),
+                "winner": at_tr.winner.key,
+                "resume_probes_executed": int(at_tr2.probes_executed),
+                "probe_log": at_log_path},
             "program_reports": len(reports),
             "attribution": {
                 "path": apath,

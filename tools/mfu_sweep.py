@@ -174,16 +174,24 @@ def _measure_spec(spec_str, np, jax):
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
     labels = rng.integers(0, cfg.vocab_size, (1, batch, T), dtype=np.int32)
-    tc = time.perf_counter()
-    params, opt, loss, _ = step(params, opt, tokens, labels)
-    float(loss)
-    compile_s = time.perf_counter() - tc
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt, loss, _ = step(params, opt, tokens, labels)
-    float(loss)
-    dt = time.perf_counter() - t0
-    tokens_per_s = steps * batch * T / dt
+
+    # one shared warmup/compile/timing loop (paddle_tpu.tuning.probe,
+    # ISSUE 20); block-timed with a single trailing sync — the
+    # throughput discipline, donated params serialize steps on-device
+    from paddle_tpu.tuning import probe as tuning_probe
+
+    state = {"params": params, "opt": opt}
+
+    def _step(i):
+        state["params"], state["opt"], loss, _ = step(
+            state["params"], state["opt"], tokens, labels)
+        return loss
+
+    timing = tuning_probe.timed_loop(_step, steps, sync=float,
+                                     per_step_sync=False)
+    params = state["params"]
+    compile_s = timing.compile_s
+    tokens_per_s = steps * batch * T / timing.block_s
 
     n_params = G.num_params(params)
     attn = 12 * cfg.num_layers * cfg.d_model * T
@@ -194,7 +202,8 @@ def _measure_spec(spec_str, np, jax):
     # dp ranks: tokens/s is global, so the denominator is dp x one chip
     mfu = tokens_per_s * (6 * n_params + attn) / (_peak_flops(dev) * dp)
     print(json.dumps({"spec": spec_str, "tokens_per_s": round(tokens_per_s, 1),
-                      "mfu": round(mfu, 4), "ms_per_step": round(dt / steps * 1e3, 1),
+                      "mfu": round(mfu, 4),
+                      "ms_per_step": round(timing.ms_per_step, 1),
                       "compile_s": round(compile_s, 1),
                       "params": int(n_params)}), flush=True)
 

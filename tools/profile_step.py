@@ -18,6 +18,9 @@ Usage:
   python tools/profile_step.py [spec] [--steps 6] [--dir /tmp/gpt-trace]
       [--attr-out ATTRIBUTION.json]
   python tools/profile_step.py --smoke          # tiny CPU-sized lane
+  python tools/profile_step.py --smoke --tuned=TUNED.json
+      # profile the autotuner winner; attribution config carries the
+      # full tuned knob vector + tuned_from path/hash
   python tools/profile_step.py --serve [--ticks 16] [--attr-out PATH]
       [--fused-decode]                          # one-launch decode step
       [--disagg] [--role prefill|decode]  # stamp disagg=1 + role into
@@ -29,6 +32,14 @@ Usage:
 
 Spec keys fln=1 / fopt=1 turn on the fused layernorm block kernel and
 the Pallas optimizer megakernel (docs/kernels.md).
+
+``--tuned=TUNED.json`` profiles the autotuner's winner (ISSUE 20): the
+document is hw-fingerprint gated (mismatch warns + falls back), tuned
+knobs apply only where the spec/flags left the default, and the
+attribution ``config`` stamp carries the FULL tuned knob vector per
+space (incl. disagg ratio, spec window, page pool) plus a ``tuned_from``
+path+hash pointer — perf_diff cause-attributes a regression to the
+exact tune, not "config lever unknown".
 
 Reference analogue: platform/device_tracer.cc (CUPTI per-kernel times);
 here the XLA device plane carries the measured per-fusion times and the
@@ -52,9 +63,23 @@ def _flag(name, default=None, cast=str):
     return default
 
 
+def _load_tuned(tuned_path, mode):
+    """Fingerprint-gated TUNED.json load (None when absent/REFUSED)."""
+    if not tuned_path:
+        return None
+    from paddle_tpu.tuning import probe as tuning_probe
+    from paddle_tpu.tuning import tuned as tuned_mod
+
+    doc = tuned_mod.load_for_device(tuned_path, tuning_probe.device_info())
+    print(f"[profile{' --serve' if mode == 'serve' else ''}] tuned config "
+          f"{'applied' if doc else 'REFUSED'} from {tuned_path}",
+          file=sys.stderr, flush=True)
+    return doc
+
+
 def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
                   attr_out: str = None, profile_out: str = None,
-                  runs: int = 1):
+                  runs: int = 1, tuned: str = None):
     """Profile the GPT train step at ``spec_str``; returns (profile doc,
     attribution doc) and writes PROFILE_STEP.json + ATTRIBUTION.json.
 
@@ -123,6 +148,27 @@ def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
         kw["ce_direct_bytes_limit"] = int(spec["celim"])
     if "chunk" in spec:
         kw["ce_chunk"] = int(spec["chunk"])
+    tuned_doc = _load_tuned(tuned, "train")
+    if tuned_doc is not None:
+        # tuned knobs only where the spec left the default — a spec key
+        # always beats the tuner (same discipline as bench.py --tuned)
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        ck = tuned_mod.train_cfg_kwargs(tuned_doc)
+        if "remat" not in spec and "remat" in ck:
+            kw["remat"] = ck["remat"]
+            kw["remat_policy"] = ck["remat_policy"]
+        if "fln" not in spec and ck.get("fused_ln"):
+            fused_ln = True
+            kw["fused_ln"] = True
+        if "fopt" not in spec:
+            tcfg = (tuned_doc.get("spaces") or {}).get("train", {}).get(
+                "config") or {}
+            fused_opt = fused_opt or bool(tcfg.get("fused_opt"))
+        if "chunk" not in spec and "celim" not in spec and \
+                ck.get("ce_vocab_chunk"):
+            kw["ce_vocab_chunk"] = ck["ce_vocab_chunk"]
+            kw["ce_direct_bytes_limit"] = ck["ce_direct_bytes_limit"]
     cfg = G.GPT_SMALL.scaled(**kw)
 
     dev = jax.devices()[0]
@@ -149,7 +195,7 @@ def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
                                                   None)), {})
     config = {
         "mode": "train", "spec": spec_str,
-        "remat": spec.get("remat", "full"),
+        "remat": cfg.remat_policy if cfg.remat else "none",
         "flash": spec.get("flash", "1") == "1",
         "scan": spec.get("scan", "1") == "1",
         "moment_dtype": spec.get("mom", "f32"),
@@ -159,6 +205,11 @@ def train_profile(spec_str: str, trace_dir: str, steps: int = 6,
         "fused_opt": fused_opt,
         "fused_ln": fused_ln,
     }
+    if tuned_doc is not None:
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        # full tuned-knob vector + tuned_from provenance (ISSUE 20)
+        config.update(tuned_mod.config_stamp(tuned_doc, tuned))
 
     results = []
     for run_i in range(max(1, runs)):
@@ -233,7 +284,8 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
                   d: int = 64, layers: int = 4, nh: int = 4, ff: int = 128,
                   vocab: int = 256, max_batch: int = 4, max_seq: int = 64,
                   weight_dtype: str = "f32", kv_layout: str = "slab",
-                  fused_decode: bool = False, role: str = "colocated"):
+                  fused_decode: bool = False, role: str = "colocated",
+                  tuned: str = None):
     """Profile a warmed DecodeEngine decode tick: fill every slot, trace
     ``ticks`` full-batch decode steps, attribute through the same
     roofline path — the decode residue ranking is ROADMAP item 3(b)'s
@@ -247,6 +299,19 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
     from paddle_tpu.observability import program_report as PREP
 
     dev = jax.devices()[0]
+    tuned_doc = _load_tuned(tuned, "serve")
+    if tuned_doc is not None:
+        # dtype/layout/fused-decode only where the flags stayed default
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        scfg = (tuned_doc.get("spaces") or {}).get("serve", {}).get(
+            "config") or {}
+        if weight_dtype == "f32" and scfg.get("weight_dtype"):
+            weight_dtype = scfg["weight_dtype"]
+        if kv_layout == "slab" and scfg.get("kv_layout"):
+            kv_layout = scfg["kv_layout"]
+        if not fused_decode and scfg.get("fused_decode"):
+            fused_decode = True
     cfg = gpt.GPTConfig(vocab_size=vocab, max_seq_len=max(max_seq, 64),
                         num_layers=layers, num_heads=nh, d_model=d,
                         d_ff=ff, remat=False)
@@ -256,6 +321,8 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
                fused_decode=fused_decode, role=role)
     if kv_layout == "paged":
         ekw.update(kv_layout="paged", page_size=8)
+        if tuned_doc is not None and scfg.get("num_pages"):
+            ekw["num_pages"] = int(scfg["num_pages"])
     engine = serving.DecodeEngine(params, cfg,
                                   serving.EngineConfig(**ekw))
     print("[profile --serve] warmup (AOT prefill ladder + decode)",
@@ -304,6 +371,9 @@ def serve_profile(trace_dir: str, ticks: int = 16, attr_out: str = None,
         "disagg": 1 if role in ("prefill", "decode") else 0,
         "role": role,
     }
+    if tuned_doc is not None:
+        # full tuned-knob vector + tuned_from provenance (ISSUE 20)
+        config.update(tuned_mod.config_stamp(tuned_doc, tuned))
     attribution = ATT.build_from_trace(
         trace_dir, steps=ticks, wall_ms_per_step=wall_ms,
         hlo_texts=hlo_texts, device=dev, mode="decode",
@@ -376,6 +446,9 @@ def compare_attributions(path_a: str, path_b: str, out=sys.stdout):
 def main():
     trace_dir = _flag("--dir", "/tmp/gpt-trace")
     attr_out = _flag("--attr-out")
+    tuned = _flag("--tuned") or next(
+        (a.split("=", 1)[1] for a in sys.argv
+         if a.startswith("--tuned=")), None)
     if "--compare" in sys.argv:
         i = sys.argv.index("--compare")
         compare_attributions(sys.argv[i + 1], sys.argv[i + 2])
@@ -390,7 +463,7 @@ def main():
                       kv_layout=_flag("--kv-layout", "slab"),
                       max_batch=int(_flag("--max-batch", 4, int)),
                       fused_decode="--fused-decode" in sys.argv,
-                      role=role)
+                      role=role, tuned=tuned)
         return
     if "--smoke" in sys.argv:
         spec_str = SMOKE_SPEC
@@ -399,7 +472,7 @@ def main():
             else DEFAULT_SPEC
     steps = int(_flag("--steps", 6, int))
     train_profile(spec_str, trace_dir, steps=steps, attr_out=attr_out,
-                  profile_out=_flag("--profile-out"))
+                  profile_out=_flag("--profile-out"), tuned=tuned)
 
 
 if __name__ == "__main__":

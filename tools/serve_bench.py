@@ -164,6 +164,10 @@ def build_engine(params, cfg, ecfg_kw, lane):
     kw["weight_dtype"] = lane.get("weight_dtype", "f32")
     if lane.get("kv_layout") == "paged":
         kw.update(kv_layout="paged", page_size=lane.get("page_size", 8))
+        if lane.get("num_pages"):
+            kw["num_pages"] = int(lane["num_pages"])
+    if lane.get("fused_decode"):
+        kw["fused_decode"] = True
     if lane.get("sharding") == "tp":
         kw.update(sharding="tp", tp=lane.get("tp", 2))
     k = int(lane.get("spec", 0))
@@ -561,6 +565,13 @@ def main(argv=None):
     ap.add_argument("--disagg-max-new", type=int, default=32,
                     help="decode length for the disagg A/B (long "
                          "decodes are what makes slots scarce)")
+    ap.add_argument("--tuned", default=None,
+                    help="TUNED.json from tools/autotune.py: apply the "
+                         "serve-space winner (geometry knobs only where "
+                         "the flags above were left at their defaults; "
+                         "explicit flags beat the tuner). Fingerprint-"
+                         "gated — a mismatched document warns and the "
+                         "defaults run.")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -573,6 +584,30 @@ def main(argv=None):
         args.eval_len = 24
         args.capacity_rates, args.capacity_requests = "8,64", 12
         args.disagg_requests = 32
+
+    tuned_doc = None
+    if args.tuned:
+        from paddle_tpu.tuning import probe as tuning_probe
+        from paddle_tpu.tuning import tuned as tuned_mod
+
+        tuned_doc = tuned_mod.load_for_device(
+            args.tuned, tuning_probe.device_info())
+        print(f"[serve_bench] tuned config "
+              f"{'applied' if tuned_doc else 'REFUSED'} from "
+              f"{args.tuned}", flush=True)
+    if tuned_doc is not None:
+        # geometry knobs apply only where the flag was left at its
+        # argparse default — an explicit flag always beats the tuner
+        ek = tuned_mod.engine_kwargs(tuned_doc)
+        lk = tuned_mod.serve_lane_kwargs(tuned_doc)
+        if args.max_batch == ap.get_default("max_batch") and \
+                ek.get("max_batch"):
+            args.max_batch = ek["max_batch"]
+        if args.buckets == ap.get_default("buckets") and \
+                ek.get("prefill_buckets"):
+            args.buckets = ",".join(str(b) for b in ek["prefill_buckets"])
+        if args.spec_k == ap.get_default("spec_k") and "spec" in lk:
+            args.spec_k = lk["spec"]
 
     import jax.numpy as jnp
 
@@ -604,6 +639,9 @@ def main(argv=None):
         # zero-recompile contract, not absolute tokens/s
         "degraded": backend != "tpu",
     }
+    if tuned_doc is not None:
+        # full tuned-knob vector + artifact provenance (ISSUE 20)
+        result["tuned"] = tuned_mod.config_stamp(tuned_doc, args.tuned)
     print(f"[serve_bench] parity lane ({args.eval_len} tokens)...",
           flush=True)
     result["quant_parity"] = parity_lane(
@@ -627,6 +665,25 @@ def main(argv=None):
     if args.spec_k:
         lane_cfgs.append({"weight_dtype": "f32", "kv_layout": "slab",
                           "spec": args.spec_k})
+    if tuned_doc is not None:
+        # one lane at the tuner's full serve winner (dtype + layout +
+        # page pool + fused decode + sharding + spec window)
+        scfg = (tuned_doc.get("spaces") or {}).get("serve", {}).get(
+            "config") or {}
+        tuned_lane = {"weight_dtype": scfg.get("weight_dtype", "f32"),
+                      "kv_layout": scfg.get("kv_layout", "slab")}
+        if scfg.get("num_pages"):
+            tuned_lane["num_pages"] = int(scfg["num_pages"])
+        if scfg.get("fused_decode"):
+            tuned_lane["fused_decode"] = True
+        if scfg.get("sharding", "none") != "none" and \
+                jax.device_count() >= int(scfg.get("tp", 2)):
+            tuned_lane.update(sharding=scfg["sharding"],
+                              tp=int(scfg.get("tp", 2)))
+        if scfg.get("spec"):
+            tuned_lane["spec"] = int(scfg["spec"])
+        if tuned_lane not in lane_cfgs:
+            lane_cfgs.append(tuned_lane)
 
     lanes = []
     for lane in lane_cfgs:
